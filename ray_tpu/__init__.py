@@ -1,0 +1,208 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+Public API surface mirrors the reference's (reference:
+python/ray/__init__.py — init/shutdown, @remote, get/put/wait/cancel/kill,
+actors, placement groups, runtime context), re-designed TPU-first: the
+scheduler is ICI-topology-aware, collectives are XLA collectives over
+meshes (`ray_tpu.parallel`), and the AI libraries (`data`, `train`,
+`serve`, `tune`, `rl`) run SPMD programs on TPU slices.
+"""
+
+from __future__ import annotations
+
+import inspect as _inspect
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ._version import __version__
+from .core import runtime as _runtime
+from .core.actor import ActorClass, ActorHandle, exit_actor, get_actor
+from .core.exceptions import (
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+)
+from .core.object_ref import ObjectRef
+from .core.placement_group import (
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+)
+from .core.remote_function import RemoteFunction
+from .core.runtime import ObjectRefGenerator, RuntimeContext
+from .core.task import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SliceAffinitySchedulingStrategy,
+    SpreadSchedulingStrategy,
+)
+
+__all__ = [
+    "__version__", "init", "shutdown", "is_initialized", "remote", "get",
+    "put", "wait", "cancel", "kill", "get_actor", "exit_actor", "ObjectRef",
+    "ObjectRefGenerator", "ActorClass", "ActorHandle", "RemoteFunction",
+    "PlacementGroup", "placement_group", "remove_placement_group",
+    "get_runtime_context", "cluster_resources", "available_resources",
+    "timeline", "nodes", "method",
+    "NodeAffinitySchedulingStrategy", "PlacementGroupSchedulingStrategy",
+    "SliceAffinitySchedulingStrategy", "SpreadSchedulingStrategy",
+    "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
+    "ObjectLostError", "TaskCancelledError", "GetTimeoutError",
+]
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle
+# ---------------------------------------------------------------------------
+
+def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         _system_config: Optional[Dict[str, Any]] = None,
+         ignore_reinit_error: bool = True, **_compat) -> None:
+    """Start (or connect to) the runtime.
+
+    Reference parity: ray.init (python/ray/_private/worker.py:1227).
+    """
+    if _runtime.is_initialized():
+        if ignore_reinit_error:
+            return
+        raise RuntimeError("ray_tpu is already initialized")
+    _runtime.init_runtime(
+        num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+        _system_config=_system_config)
+
+
+def shutdown() -> None:
+    _runtime.shutdown_runtime()
+
+
+def is_initialized() -> bool:
+    return _runtime.is_initialized()
+
+
+# ---------------------------------------------------------------------------
+# @remote
+# ---------------------------------------------------------------------------
+
+def remote(*args, **options):
+    """Decorate a function → RemoteFunction, or a class → ActorClass.
+
+    Supports both ``@remote`` and ``@remote(num_tpus=1, ...)`` forms
+    (reference: python/ray/__init__.py remote / worker.py make_decorator).
+    """
+    if len(args) == 1 and not options and (
+            callable(args[0]) or _inspect.isclass(args[0])):
+        return _make_remote(args[0], {})
+    if args:
+        raise TypeError("@remote takes keyword options only")
+
+    def decorator(obj):
+        return _make_remote(obj, options)
+
+    return decorator
+
+
+def _make_remote(obj, options):
+    if _inspect.isclass(obj):
+        return ActorClass(obj, options)
+    if callable(obj):
+        return RemoteFunction(obj, options)
+    raise TypeError(f"@remote target must be function or class: {obj!r}")
+
+
+def method(**opts):
+    """Per-method option decorator for actor classes (parity:
+    @ray.method(num_returns=...))."""
+
+    def decorator(f):
+        f.__ray_tpu_method_opts__ = opts
+        return f
+
+    return decorator
+
+
+# ---------------------------------------------------------------------------
+# Object API
+# ---------------------------------------------------------------------------
+
+def put(value: Any) -> ObjectRef:
+    return _runtime.global_runtime().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    rt = _runtime.global_runtime()
+    if isinstance(refs, ObjectRef):
+        return rt.get([refs], timeout)[0]
+    if isinstance(refs, ObjectRefGenerator):
+        raise TypeError(
+            "Pass individual refs from the generator to get(), not the "
+            "generator itself.")
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects ObjectRef or list, got {type(refs)}")
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() list items must be ObjectRef: {type(r)}")
+    return rt.get(list(refs), timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError(
+            f"num_returns={num_returns} exceeds {len(refs)} provided refs")
+    return _runtime.global_runtime().wait(
+        list(refs), num_returns, timeout, fetch_local)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False,
+           recursive: bool = True) -> None:
+    _runtime.global_runtime().cancel(ref, force=force)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    _runtime.global_runtime().kill_actor(
+        actor._actor_id, no_restart=no_restart)
+
+
+# ---------------------------------------------------------------------------
+# Introspection
+# ---------------------------------------------------------------------------
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _runtime.global_runtime().cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return _runtime.global_runtime().available_resources()
+
+
+def nodes() -> List[dict]:
+    rt = _runtime.global_runtime()
+    return [
+        {
+            "NodeID": n.node_id, "Alive": n.alive,
+            "Resources": n.total.to_dict(), "Labels": dict(n.labels),
+        }
+        for n in rt.scheduler.nodes()
+    ]
+
+
+def timeline(filename: Optional[str] = None):
+    """Chrome-trace dump (reference: ray timeline CLI)."""
+    events = _runtime.global_runtime().timeline()
+    if filename:
+        import json
+        with open(filename, "w") as f:
+            json.dump(events, f)
+        return None
+    return events

@@ -1,0 +1,126 @@
+"""Config/flag registry.
+
+Equivalent in capability to the reference's RayConfig X-macro registry
+(reference: src/ray/common/ray_config_def.h — 220 RAY_CONFIG(type,name,default)
+flags, overridable via RAY_<name> env vars or a JSON system-config blob pushed
+from the head node). Here: declarative flag table, `RAY_TPU_<NAME>` env
+override, plus programmatic override via ``Config.apply(dict)`` which is what
+``init(_system_config=...)`` feeds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict
+
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class _Flag:
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name: str, typ: Callable, default: Any, doc: str):
+        self.name = name
+        self.type = typ
+        self.default = default
+        self.doc = doc
+
+
+class Config:
+    """Process-wide flag registry (singleton at module bottom)."""
+
+    _FLAGS: Dict[str, _Flag] = {}
+
+    @classmethod
+    def _define(cls, name: str, typ: Callable, default: Any, doc: str = ""):
+        cls._FLAGS[name] = _Flag(name, typ, default, doc)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: Dict[str, Any] = {}
+        self.reload()
+
+    def reload(self):
+        with self._lock:
+            self._values.clear()
+            for name, flag in self._FLAGS.items():
+                env = os.environ.get(_ENV_PREFIX + name.upper())
+                if env is not None:
+                    if flag.type is bool:
+                        self._values[name] = _parse_bool(env)
+                    elif flag.type in (dict, list):
+                        self._values[name] = json.loads(env)
+                    else:
+                        self._values[name] = flag.type(env)
+                else:
+                    self._values[name] = flag.default
+
+    def apply(self, overrides: Dict[str, Any] | None):
+        if not overrides:
+            return
+        with self._lock:
+            for k, v in overrides.items():
+                if k not in self._FLAGS:
+                    raise ValueError(f"Unknown system config flag: {k!r}")
+                self._values[k] = self._FLAGS[k].type(v)
+
+    def __getattr__(self, name: str):
+        # only called when normal attribute lookup fails
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def dump(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._values)
+
+
+_D = Config._define
+
+# --- core runtime ---
+_D("task_retry_delay_ms", int, 0, "Delay between task retry attempts.")
+_D("default_max_retries", int, 3, "Default max retries for tasks.")
+_D("default_actor_max_restarts", int, 0, "Default max restarts for actors.")
+_D("inline_object_max_bytes", int, 100 * 1024,
+   "Objects smaller than this are stored inline in the in-process memory "
+   "store rather than the shared-memory store (reference inlines <100KB).")
+_D("memory_store_max_bytes", int, 2 * 1024**3,
+   "Soft cap for the in-process memory store.")
+_D("object_store_memory_bytes", int, 1 * 1024**3,
+   "Capacity of the per-node shared-memory object store.")
+_D("object_spilling_dir", str, "",
+   "Directory for spilled objects ('' = <session_dir>/spill).")
+_D("object_store_full_initial_retry_ms", int, 10, "")
+_D("object_store_full_max_retries", int, 10, "")
+_D("worker_pool_size", int, 0,
+   "Number of task-executor threads per worker (0 = num_cpus resource).")
+_D("actor_queue_max", int, 10000, "Per-actor pending-call queue bound.")
+_D("get_timeout_warning_s", float, 30.0,
+   "Warn if a blocking get waits longer than this.")
+_D("health_check_period_ms", int, 1000, "Node health-check interval.")
+_D("health_check_failure_threshold", int, 5, "")
+_D("scheduler_spread_threshold", float, 0.5,
+   "Hybrid policy: prefer local node until it is this utilized.")
+_D("scheduler_top_k_fraction", float, 0.2,
+   "Hybrid policy: best node among a random top-k fraction.")
+_D("lineage_max_bytes", int, 256 * 1024**2, "Lineage table soft cap.")
+_D("enable_timeline", bool, True, "Record task timeline events.")
+_D("task_event_buffer_max", int, 100_000, "Max buffered task state events.")
+_D("gang_schedule_timeout_s", float, 60.0,
+   "Timeout for atomically acquiring all bundles of a placement group.")
+# --- TPU / device ---
+_D("tpu_devices_per_host", int, 0, "0 = autodetect via jax.local_devices().")
+_D("prefetch_to_device_buffers", int, 2,
+   "Double-buffer depth for host→HBM input pipelines.")
+_D("mesh_allow_cpu_fallback", bool, True,
+   "Build meshes from CPU devices when no TPU is present (tests).")
+
+config = Config()

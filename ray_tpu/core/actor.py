@@ -1,0 +1,105 @@
+"""@remote for classes: ActorClass / ActorHandle / ActorMethod.
+
+Capability-equivalent to the reference's actor surface
+(reference: python/ray/actor.py — ActorClass :544, `_remote` :829,
+ActorMethod._remote :268): `.remote()` creation, `.options()` chaining,
+handle pickling (by actor id), named/detached actors, per-method options,
+`exit_actor()`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .ids import ActorID
+from .runtime import _ActorExit, global_runtime
+from .task import validate_options
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 opts: Dict[str, Any] | None = None):
+        self._handle = handle
+        self._method_name = method_name
+        self._opts = opts or {}
+
+    def remote(self, *args, **kwargs):
+        return global_runtime().submit_actor_task(
+            self._handle._actor_id, self._method_name, args, kwargs,
+            self._opts)
+
+    def options(self, **opts) -> "ActorMethod":
+        merged = dict(self._opts)
+        merged.update(opts)
+        return ActorMethod(self._handle, self._method_name, merged)
+
+    def bind(self, *args, **kwargs):
+        from ..dag.node import ActorMethodNode
+        return ActorMethodNode(self._handle, self._method_name, args, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} cannot be called directly. "
+            "Use .remote()."
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID):
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:16]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id,))
+
+    def _ray_terminate(self):
+        global_runtime().kill_actor(self._actor_id)
+
+
+class ActorClass:
+    def __init__(self, cls: type, opts: Dict[str, Any]):
+        self._cls = cls
+        self._opts = validate_options(dict(opts), is_actor=True)
+        self.__name__ = cls.__name__
+        self.__qualname__ = cls.__qualname__
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated "
+            "directly. Use .remote()."
+        )
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        actor_id = global_runtime().create_actor(
+            self._cls, args, kwargs, self._opts)
+        return ActorHandle(actor_id)
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._opts)
+        merged.update(opts)
+        return ActorClass(self._cls, merged)
+
+    def bind(self, *args, **kwargs):
+        from ..dag.node import ClassNode
+        return ClassNode(self, args, kwargs)
+
+    @property
+    def underlying_class(self) -> type:
+        return self._cls
+
+
+def exit_actor():
+    """Terminate the current actor from inside a method
+    (reference: python/ray/actor.py exit_actor)."""
+    raise _ActorExit()
+
+
+def get_actor(name: str) -> ActorHandle:
+    return ActorHandle(global_runtime().get_actor(name))

@@ -1,0 +1,151 @@
+"""Binary ID types for ray_tpu.
+
+Mirrors the capability of the reference's ID scheme
+(reference: src/ray/common/id.h) — JobID ⊂ ActorID ⊂ TaskID, and ObjectIDs
+that embed their owning TaskID plus a return/put index so that lineage
+(which task produced this object) is recoverable from the ID alone.
+
+Layout (bytes, big-endian indices):
+    JobID    = 4 random bytes
+    ActorID  = JobID (4) + 8 random            = 12
+    TaskID   = ActorID (12) + 12 random        = 24
+    ObjectID = TaskID (24) + 4-byte LE index   = 28
+The index space is split: indices < PUT_INDEX_BASE are task returns,
+indices >= PUT_INDEX_BASE are `put` objects, matching the reference's
+return/put partitioning.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_JOB_LEN = 4
+_ACTOR_LEN = 12
+_TASK_LEN = 24
+_OBJECT_LEN = 28
+
+PUT_INDEX_BASE = 1 << 24  # indices above this are ray_tpu.put() objects
+
+_NIL_TASK = b"\xff" * _TASK_LEN
+
+
+class BaseID:
+    __slots__ = ("_bytes",)
+    _LEN = 0
+
+    def __init__(self, b: bytes):
+        if len(b) != self._LEN:
+            raise ValueError(
+                f"{type(self).__name__} requires {self._LEN} bytes, got {len(b)}"
+            )
+        self._bytes = bytes(b)
+
+    @classmethod
+    def from_random(cls) -> "BaseID":
+        return cls(os.urandom(cls._LEN))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(b"\xff" * cls._LEN)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\xff" * self._LEN
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+
+class JobID(BaseID):
+    _LEN = _JOB_LEN
+
+
+class ActorID(BaseID):
+    _LEN = _ACTOR_LEN
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(job_id.binary() + os.urandom(_ACTOR_LEN - _JOB_LEN))
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:_JOB_LEN])
+
+
+class TaskID(BaseID):
+    _LEN = _TASK_LEN
+
+    @classmethod
+    def for_task(cls, job_id: JobID) -> "TaskID":
+        return cls(
+            job_id.binary()
+            + b"\x00" * (_ACTOR_LEN - _JOB_LEN)
+            + os.urandom(_TASK_LEN - _ACTOR_LEN)
+        )
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
+        return cls(actor_id.binary() + os.urandom(_TASK_LEN - _ACTOR_LEN))
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[:_ACTOR_LEN])
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:_JOB_LEN])
+
+
+class ObjectID(BaseID):
+    _LEN = _OBJECT_LEN
+
+    @classmethod
+    def for_return(cls, task_id: TaskID, return_index: int) -> "ObjectID":
+        assert 0 <= return_index < PUT_INDEX_BASE
+        return cls(task_id.binary() + return_index.to_bytes(4, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        return cls(task_id.binary() + (PUT_INDEX_BASE + put_index).to_bytes(4, "little"))
+
+    def task_id(self) -> TaskID:
+        """The task that created this object (lineage addressing)."""
+        return TaskID(self._bytes[:_TASK_LEN])
+
+    def index(self) -> int:
+        return int.from_bytes(self._bytes[_TASK_LEN:], "little")
+
+    def is_put(self) -> bool:
+        return self.index() >= PUT_INDEX_BASE
+
+    def is_return(self) -> bool:
+        return not self.is_put()
+
+    def return_index(self) -> int:
+        assert self.is_return()
+        return self.index()
+
+
+class _PutCounter:
+    """Per-process monotonically increasing put index."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def next(self) -> int:
+        with self._lock:
+            self._n += 1
+            return self._n
+
+
+put_counter = _PutCounter()

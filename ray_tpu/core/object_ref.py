@@ -1,0 +1,85 @@
+"""ObjectRef — the distributed future.
+
+Capability-equivalent to the reference's ObjectRef
+(reference: python/ray/includes/object_ref.pxi and
+src/ray/core_worker/reference_count.h for the borrowing semantics):
+a handle to an eventually-available immutable object, picklable (pickling
+inside task args registers a borrow with the owner), awaitable via
+``get``/``wait``, and carrying its lineage in the ID itself.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "__weakref__")
+
+    def __init__(self, object_id: ObjectID):
+        self._id = object_id
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def task_id(self):
+        return self._id.task_id()
+
+    # -- identity ---------------------------------------------------------
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    # -- convenience ------------------------------------------------------
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from . import runtime as _rt
+        return _rt.global_runtime().as_future(self)
+
+    def __await__(self):
+        """Allow ``await ref`` inside async actors / drivers."""
+        import asyncio
+
+        async def _aget():
+            loop = asyncio.get_running_loop()
+            from . import runtime as _rt
+            rt = _rt.global_runtime()
+            return await loop.run_in_executor(None, rt.get, [self], None)
+
+        async def _first():
+            return (await _aget())[0]
+
+        return _first().__await__()
+
+    # -- pickling: register a borrow and re-attach on the far side -------
+    def __reduce__(self):
+        from . import runtime as _rt
+        rt = _rt.global_runtime_or_none()
+        if rt is not None:
+            rt.reference_counter.add_borrow(self._id)
+            rt.serialization_noted_ref(self)
+        return (_deserialize_ref, (self._id.binary(),))
+
+
+def _deserialize_ref(id_bytes: bytes) -> "ObjectRef":
+    ref = ObjectRef(ObjectID(id_bytes))
+    from . import runtime as _rt
+    rt = _rt.global_runtime_or_none()
+    if rt is not None:
+        # Registers a local ref WITH a finalizer so deserialized copies
+        # participate in refcounting/GC like driver-created refs.
+        rt.register_ref(ref)
+    return ref

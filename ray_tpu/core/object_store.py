@@ -1,0 +1,131 @@
+"""In-process object store (memory store).
+
+Capability-equivalent to the reference's CoreWorker memory store
+(reference: src/ray/core_worker/store_provider/memory_store/memory_store.h)
+— holds serialized objects keyed by ObjectID, supports blocking gets with
+timeouts, async ready-callbacks (used by the scheduler's dependency
+resolver), error objects, deletion/loss, and simple accounting. The
+shared-memory (plasma-equivalent) store plugs in behind the same interface
+for the multiprocess runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .exceptions import GetTimeoutError
+from .ids import ObjectID
+from .serialization import SerializedObject
+
+
+class StoredObject:
+    __slots__ = ("data", "is_error", "created_at", "nbytes")
+
+    def __init__(self, data: SerializedObject, is_error: bool):
+        self.data = data
+        self.is_error = is_error
+        self.created_at = time.monotonic()
+        self.nbytes = data.total_bytes()
+
+
+class MemoryStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._objects: Dict[ObjectID, StoredObject] = {}
+        self._waiter_cbs: Dict[ObjectID, List[Callable[[ObjectID], None]]] = {}
+        self.total_bytes = 0
+
+    # -- write ------------------------------------------------------------
+    def put(self, object_id: ObjectID, data: SerializedObject,
+            is_error: bool = False) -> None:
+        with self._lock:
+            prev = self._objects.get(object_id)
+            if prev is not None:
+                self.total_bytes -= prev.nbytes
+            obj = StoredObject(data, is_error)
+            self._objects[object_id] = obj
+            self.total_bytes += obj.nbytes
+            cbs = self._waiter_cbs.pop(object_id, [])
+            self._cv.notify_all()
+        for cb in cbs:
+            cb(object_id)
+
+    def delete(self, object_ids: Sequence[ObjectID]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                obj = self._objects.pop(oid, None)
+                if obj is not None:
+                    self.total_bytes -= obj.nbytes
+
+    # -- read -------------------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get_if_exists(self, object_id: ObjectID) -> Optional[StoredObject]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def get(self, object_ids: Sequence[ObjectID],
+            timeout: Optional[float] = None) -> List[StoredObject]:
+        """Blocking get of all ids. Raises GetTimeoutError on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                missing = [o for o in object_ids if o not in self._objects]
+                if not missing:
+                    return [self._objects[o] for o in object_ids]
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise GetTimeoutError(
+                            f"Timed out waiting for {len(missing)} object(s); "
+                            f"first missing: {missing[0].hex()}"
+                        )
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait()
+
+    def wait(self, object_ids: Sequence[ObjectID], num_returns: int,
+             timeout: Optional[float]) -> tuple[List[ObjectID], List[ObjectID]]:
+        """Ray-style wait: (ready, not_ready) preserving input order."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                ready = [o for o in object_ids if o in self._objects]
+                if len(ready) >= num_returns:
+                    break
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait()
+            ready_set = set(o for o in object_ids if o in self._objects)
+        ready_list, not_ready = [], []
+        for o in object_ids:
+            (ready_list if o in ready_set and len(ready_list) < num_returns
+             else not_ready).append(o)
+        return ready_list, not_ready
+
+    # -- async ------------------------------------------------------------
+    def on_ready(self, object_id: ObjectID,
+                 callback: Callable[[ObjectID], None]) -> None:
+        """Invoke callback when object_id becomes available (maybe now)."""
+        fire = False
+        with self._lock:
+            if object_id in self._objects:
+                fire = True
+            else:
+                self._waiter_cbs.setdefault(object_id, []).append(callback)
+        if fire:
+            callback(object_id)
+
+    # -- stats ------------------------------------------------------------
+    def num_objects(self) -> int:
+        with self._lock:
+            return len(self._objects)

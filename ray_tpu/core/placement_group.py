@@ -1,0 +1,169 @@
+"""Placement groups with 2-phase bundle reservation.
+
+Capability-equivalent to the reference's placement groups
+(reference: python/ray/util/placement_group.py:41,:146 and the GCS-side
+2-phase-commit scheduler src/ray/gcs/gcs_server/gcs_placement_group_*.h,
+raylet prepare/commit in placement_group_resource_manager.h):
+bundles of resources atomically reserved across nodes under a strategy
+(PACK/SPREAD/STRICT_PACK/STRICT_SPREAD), with prepare-all-then-commit
+semantics and full rollback on failure. TPU-native: STRICT_PACK onto one
+slice is the gang-scheduling primitive for SPMD jobs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from .._private.config import config
+from .resources import ResourceSet
+from .runtime import global_runtime
+from .scheduler import NodeState
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
+                 strategy: str, name: str = ""):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+        self.name = name
+        self._bundle_nodes: List[Optional[str]] = [None] * len(bundles)
+        # Per-bundle remaining capacity; tasks scheduled into the PG are
+        # charged here (the node's capacity was already debited at reserve).
+        self._bundle_available: List[ResourceSet] = [
+            ResourceSet(b) for b in bundles]
+        self._committed = False
+        self._ready = threading.Event()
+        self._failed: Optional[str] = None
+
+    # -- API parity -------------------------------------------------------
+    def ready(self):
+        """Returns an ObjectRef that resolves when the PG is placed
+        (non-blocking; the wait happens inside a 0-CPU task)."""
+        from .. import remote
+        pg = self
+
+        @remote(num_cpus=0)
+        def _pg_ready() -> bool:
+            pg.wait(timeout=None)
+            return True
+
+        return _pg_ready.remote()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        ok = self._ready.wait(
+            timeout if timeout is not None else config.gang_schedule_timeout_s)
+        if self._failed:
+            raise RuntimeError(
+                f"Placement group {self.id} failed: {self._failed}")
+        return ok
+
+    def bundle_nodes(self, index: int) -> List[str]:
+        if index < 0:
+            return [n for n in self._bundle_nodes if n is not None]
+        node = self._bundle_nodes[index]
+        return [node] if node is not None else []
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "", lifetime: Optional[str] = None
+                    ) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"Invalid strategy {strategy!r}: {VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("Placement group requires at least one bundle")
+    pg = PlacementGroup(uuid.uuid4().hex[:16], bundles, strategy, name)
+    rt = global_runtime()
+    # Reserve in a background thread so creation is async (parity: the
+    # reference returns immediately; `ready()` awaits placement).
+    t = threading.Thread(target=_reserve, args=(rt, pg), daemon=True)
+    t.start()
+    rt.placement_groups = getattr(rt, "placement_groups", {})
+    rt.placement_groups[pg.id] = pg
+    return pg
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    rt = global_runtime()
+    for i, node_id in enumerate(pg._bundle_nodes):
+        if node_id is not None:
+            rt.scheduler.release(node_id, ResourceSet(pg.bundle_specs[i]))
+            pg._bundle_nodes[i] = None
+    getattr(rt, "placement_groups", {}).pop(pg.id, None)
+
+
+def _reserve(rt, pg: PlacementGroup) -> None:
+    deadline = time.monotonic() + config.gang_schedule_timeout_s
+    while time.monotonic() < deadline:
+        if _try_reserve_all(rt, pg):
+            pg._ready.set()
+            return
+        time.sleep(0.02)
+    pg._failed = "timed out acquiring bundles"
+    pg._ready.set()
+
+
+def _try_reserve_all(rt, pg: PlacementGroup) -> bool:
+    """Phase 1: tentatively subtract every bundle; rollback on any failure.
+
+    The scheduler lock is per-operation, so this loop is the 'prepare' and
+    a full rollback is the abort — single-process equivalent of the
+    reference's PrepareBundleResources/CommitBundleResources 2PC.
+    """
+    nodes = [n for n in rt.scheduler.nodes() if n.alive]
+    placed: List[tuple] = []
+
+    def rollback():
+        for node, rs in placed:
+            rt.scheduler.release(node.node_id, rs)
+
+    chosen: List[Optional[NodeState]] = [None] * pg.bundle_count
+    used_nodes: set = set()
+    for i, spec in enumerate(pg.bundle_specs):
+        rs = ResourceSet(spec)
+        if pg.strategy == "STRICT_PACK":
+            cands = [chosen[0]] if i > 0 and chosen[0] else nodes
+        elif pg.strategy == "STRICT_SPREAD":
+            cands = [n for n in nodes if n.node_id not in used_nodes]
+        elif pg.strategy == "SPREAD":
+            fresh = [n for n in nodes if n.node_id not in used_nodes]
+            cands = fresh or nodes
+        else:  # PACK: prefer already-used nodes
+            cands = ([n for n in nodes if n.node_id in used_nodes] +
+                     [n for n in nodes if n.node_id not in used_nodes])
+        ok = False
+        for node in cands:
+            if node is None:
+                continue
+            try:
+                # Atomic per-node reserve through the scheduler lock.
+                with rt.scheduler._lock:
+                    if rs.fits(node.available):
+                        node.available = node.available.subtract(rs)
+                        ok = True
+                    else:
+                        continue
+            except ValueError:
+                continue
+            placed.append((node, rs))
+            chosen[i] = node
+            used_nodes.add(node.node_id)
+            break
+        if not ok:
+            rollback()
+            return False
+    # Phase 2: commit — record bundle→node mapping and open the bundles
+    # for task charging.
+    for i, node in enumerate(chosen):
+        pg._bundle_nodes[i] = node.node_id
+    pg._committed = True
+    return True

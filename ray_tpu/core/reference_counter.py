@@ -1,0 +1,88 @@
+"""Distributed reference counting (single-process authority).
+
+Capability-equivalent to the reference's ReferenceCounter
+(reference: src/ray/core_worker/reference_count.h): every ObjectRef held in
+Python holds a local reference; refs serialized into task arguments create
+borrows; when the count for an object reaches zero the object is eligible
+for deletion from the store and its lineage can be released. In the
+multiprocess runtime the owner worker runs this table and borrowers report
+via the node daemon; in local mode it is simply process-wide.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Set
+
+from .ids import ObjectID
+
+
+class ReferenceCounter:
+    def __init__(self, on_zero: Optional[Callable[[ObjectID], None]] = None):
+        self._lock = threading.Lock()
+        self._local: Dict[ObjectID, int] = {}
+        self._borrows: Dict[ObjectID, int] = {}
+        self._pinned: Set[ObjectID] = set()
+        self._on_zero = on_zero
+
+    def set_on_zero(self, cb: Callable[[ObjectID], None]) -> None:
+        self._on_zero = cb
+
+    def add_local_ref(self, oid: ObjectID, n: int = 1) -> None:
+        with self._lock:
+            self._local[oid] = self._local.get(oid, 0) + n
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        fire = False
+        with self._lock:
+            c = self._local.get(oid, 0) - 1
+            if c <= 0:
+                self._local.pop(oid, None)
+                if (self._borrows.get(oid, 0) <= 0
+                        and oid not in self._pinned):
+                    fire = True
+            else:
+                self._local[oid] = c
+        if fire and self._on_zero is not None:
+            self._on_zero(oid)
+
+    def add_borrow(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._borrows[oid] = self._borrows.get(oid, 0) + 1
+
+    def remove_borrow(self, oid: ObjectID) -> None:
+        fire = False
+        with self._lock:
+            c = self._borrows.get(oid, 0) - 1
+            if c <= 0:
+                self._borrows.pop(oid, None)
+                if (self._local.get(oid, 0) <= 0
+                        and oid not in self._pinned):
+                    fire = True
+            else:
+                self._borrows[oid] = c
+        if fire and self._on_zero is not None:
+            self._on_zero(oid)
+
+    def pin(self, oid: ObjectID) -> None:
+        """Pin for the duration of task execution (args must not vanish)."""
+        with self._lock:
+            self._pinned.add(oid)
+
+    def unpin(self, oid: ObjectID) -> None:
+        fire = False
+        with self._lock:
+            self._pinned.discard(oid)
+            if (self._local.get(oid, 0) <= 0
+                    and self._borrows.get(oid, 0) <= 0):
+                fire = True
+        if fire and self._on_zero is not None:
+            self._on_zero(oid)
+
+    def count(self, oid: ObjectID) -> int:
+        with self._lock:
+            return self._local.get(oid, 0) + self._borrows.get(oid, 0)
+
+    def tracked(self) -> int:
+        with self._lock:
+            return len(self._local) + len(self._borrows)

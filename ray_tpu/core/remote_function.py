@@ -1,0 +1,55 @@
+"""@remote for functions.
+
+Capability-equivalent to the reference's RemoteFunction
+(reference: python/ray/remote_function.py:40 — `_remote` :262 routes into
+core_worker.submit_task): decorator surface, `.remote(...)`, `.options(...)`
+override chaining, and `.bind(...)` for DAG construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict
+
+from .runtime import global_runtime
+from .task import validate_options
+
+
+class RemoteFunction:
+    def __init__(self, func: Callable, opts: Dict[str, Any]):
+        self._func = func
+        self._opts = validate_options(dict(opts), is_actor=False)
+        self._descriptor = None
+        functools.update_wrapper(self, func)
+
+    def _get_descriptor(self):
+        if self._descriptor is None:
+            self._descriptor = global_runtime().function_manager.register(
+                self._func)
+        return self._descriptor
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._func.__qualname__!r} cannot be called "
+            "directly. Use .remote()."
+        )
+
+    def remote(self, *args, **kwargs):
+        return global_runtime().submit_task(
+            self._func, self._get_descriptor(), args, kwargs, self._opts)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._opts)
+        merged.update(opts)
+        rf = RemoteFunction(self._func, merged)
+        rf._descriptor = self._descriptor
+        return rf
+
+    def bind(self, *args, **kwargs):
+        """DAG-node construction (reference: python/ray/dag/dag_node.py)."""
+        from ..dag.node import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
+    @property
+    def underlying_function(self) -> Callable:
+        return self._func

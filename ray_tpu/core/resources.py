@@ -1,0 +1,115 @@
+"""Resource model.
+
+Capability-equivalent to the reference's fixed-point resource vectors
+(reference: src/ray/common/scheduling/cluster_resource_data.h,
+fixed_point.h) — resources are named quantities in 1/10000 granularity so
+fractional chips ("TPU": 0.5) behave exactly under add/subtract, with
+predefined CPU / TPU / memory / object_store_memory plus arbitrary custom
+resources (e.g. per-slice labels like "tpu-slice-0").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Mapping
+
+GRANULARITY = 10_000
+
+CPU = "CPU"
+TPU = "TPU"
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+PREDEFINED = (CPU, TPU, MEMORY, OBJECT_STORE_MEMORY)
+
+
+def _to_fixed(v: float) -> int:
+    return int(round(v * GRANULARITY))
+
+
+def _from_fixed(v: int) -> float:
+    return v / GRANULARITY
+
+
+class ResourceSet:
+    """A fixed-point bag of named resources. Immutable-style API."""
+
+    __slots__ = ("_r",)
+
+    def __init__(self, amounts: Mapping[str, float] | None = None, *,
+                 _fixed: Dict[str, int] | None = None):
+        if _fixed is not None:
+            self._r = {k: v for k, v in _fixed.items() if v != 0}
+        else:
+            self._r = {}
+            for k, v in (amounts or {}).items():
+                if v < 0:
+                    raise ValueError(f"Negative resource {k}={v}")
+                f = _to_fixed(v)
+                if f:
+                    self._r[k] = f
+
+    def get(self, name: str) -> float:
+        return _from_fixed(self._r.get(name, 0))
+
+    def is_empty(self) -> bool:
+        return not self._r
+
+    def names(self) -> Iterable[str]:
+        return self._r.keys()
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: _from_fixed(v) for k, v in self._r.items()}
+
+    def fits(self, available: "ResourceSet") -> bool:
+        return all(available._r.get(k, 0) >= v for k, v in self._r.items())
+
+    def add(self, other: "ResourceSet") -> "ResourceSet":
+        out = dict(self._r)
+        for k, v in other._r.items():
+            out[k] = out.get(k, 0) + v
+        return ResourceSet(_fixed=out)
+
+    def subtract(self, other: "ResourceSet") -> "ResourceSet":
+        out = dict(self._r)
+        for k, v in other._r.items():
+            nv = out.get(k, 0) - v
+            if nv < 0:
+                raise ValueError(
+                    f"Resource {k} would go negative: {_from_fixed(nv)}")
+            out[k] = nv
+        return ResourceSet(_fixed=out)
+
+    def scaled_utilization(self, total: "ResourceSet") -> float:
+        """Max over resources of used/total — the hybrid policy's load signal."""
+        util = 0.0
+        for k, tot in total._r.items():
+            if tot <= 0:
+                continue
+            used = tot - self._r.get(k, 0)
+            util = max(util, used / tot)
+        return util
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self._r == other._r
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+
+def task_resources(num_cpus: float | None, num_tpus: float | None,
+                   memory: float | None,
+                   resources: Mapping[str, float] | None,
+                   *, default_num_cpus: float = 1.0) -> ResourceSet:
+    """Assemble a task/actor resource request from @remote options."""
+    amounts: Dict[str, float] = {}
+    amounts[CPU] = default_num_cpus if num_cpus is None else num_cpus
+    if num_tpus:
+        amounts[TPU] = num_tpus
+    if memory:
+        amounts[MEMORY] = memory
+    for k, v in (resources or {}).items():
+        if k in (CPU, TPU):
+            raise ValueError(
+                f"Use num_cpus/num_tpus instead of resources[{k!r}]")
+        amounts[k] = v
+    return ResourceSet(amounts)

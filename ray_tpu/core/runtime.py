@@ -1,0 +1,1066 @@
+"""The per-process core runtime.
+
+Capability-equivalent to the reference's CoreWorker + raylet roles fused for
+the local (single-host) runtime (reference: src/ray/core_worker/core_worker.h
+— SubmitTask/CreateActor/SubmitActorTask/Put/Get/Wait; task retries and
+lineage reconstruction from src/ray/core_worker/task_manager.h and
+object_recovery_manager.h; actor transport semantics from
+src/ray/core_worker/transport/direct_actor_task_submitter.h).
+
+Tasks flow: submit → dependency resolution (on_ready callbacks) → scheduler
+picks a node → executes on that node's pool → returns stored → refs resolve.
+Actor calls bypass the scheduler and go straight to the actor's mailbox
+(direct transport), in submission order per caller.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+import uuid
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .._private.config import config
+from . import serialization
+from .exceptions import (
+    ActorDiedError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+)
+from .ids import ActorID, JobID, ObjectID, TaskID, put_counter
+from .object_ref import ObjectRef
+from .object_store import MemoryStore
+from .reference_counter import ReferenceCounter
+from .resources import CPU, TPU, ResourceSet
+from .scheduler import NodeState, Scheduler
+from .task import FunctionDescriptor, TaskSpec, TaskType
+
+logger = logging.getLogger("ray_tpu")
+
+
+# ---------------------------------------------------------------------------
+# Runtime context (per-thread execution info)
+# ---------------------------------------------------------------------------
+
+class _ExecCtx(threading.local):
+    def __init__(self):
+        self.task_id: Optional[TaskID] = None
+        self.actor_id: Optional[ActorID] = None
+        self.node_id: Optional[str] = None
+        self.put_index: int = 0
+
+
+_ctx = _ExecCtx()
+
+
+class RuntimeContext:
+    """Public runtime-context view (reference: python/ray/runtime_context.py)."""
+
+    @property
+    def job_id(self) -> JobID:
+        return global_runtime().job_id
+
+    def get_task_id(self) -> Optional[str]:
+        return _ctx.task_id.hex() if _ctx.task_id else None
+
+    def get_actor_id(self) -> Optional[str]:
+        return _ctx.actor_id.hex() if _ctx.actor_id else None
+
+    def get_node_id(self) -> Optional[str]:
+        return _ctx.node_id or global_runtime().head_node_id
+
+
+# ---------------------------------------------------------------------------
+# Task events / timeline
+# ---------------------------------------------------------------------------
+
+class TaskEventBuffer:
+    """Chrome-trace-compatible task event ring
+    (reference: src/ray/core_worker/task_event_buffer.h → `ray timeline`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+
+    def record(self, name: str, phase_start: float, phase_end: float,
+               node_id: str, task_id: str, category: str = "task"):
+        if not config.enable_timeline:
+            return
+        ev = {
+            "name": name, "cat": category, "ph": "X",
+            "ts": phase_start * 1e6, "dur": (phase_end - phase_start) * 1e6,
+            "pid": node_id, "tid": task_id,
+        }
+        with self._lock:
+            if len(self._events) >= config.task_event_buffer_max:
+                self._events.pop(0)
+            self._events.append(ev)
+
+    def dump(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+
+# ---------------------------------------------------------------------------
+# Function manager
+# ---------------------------------------------------------------------------
+
+class FunctionManager:
+    """Function registry (reference: python/ray/_private/function_manager.py
+    — exports pickled functions to GCS KV; workers import lazily). Local
+    mode keeps the callables; the multiprocess runtime ships pickles."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fns: Dict[bytes, Callable] = {}
+
+    def register(self, func: Callable) -> FunctionDescriptor:
+        fid = uuid.uuid4().bytes
+        with self._lock:
+            self._fns[fid] = func
+        return FunctionDescriptor(
+            module=getattr(func, "__module__", "<unknown>") or "<unknown>",
+            qualname=getattr(func, "__qualname__", repr(func)),
+            function_id=fid,
+        )
+
+    def get(self, fid: bytes) -> Callable:
+        with self._lock:
+            return self._fns[fid]
+
+
+# ---------------------------------------------------------------------------
+# Streaming generators
+# ---------------------------------------------------------------------------
+
+class _GeneratorState:
+    def __init__(self):
+        self.cv = threading.Condition()
+        self.refs: List[ObjectRef] = []
+        self.done = False
+
+
+class ObjectRefGenerator:
+    """Streaming-returns iterator
+    (reference: python/ray/_raylet.pyx:272 ObjectRefGenerator): yields
+    ObjectRefs as the remote generator produces them, with backpressure-free
+    local semantics; also usable as an async iterator."""
+
+    def __init__(self, task_id: TaskID, state: _GeneratorState):
+        self._task_id = task_id
+        self._state = state
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        st = self._state
+        with st.cv:
+            while len(st.refs) <= self._i and not st.done:
+                st.cv.wait()
+            if len(st.refs) > self._i:
+                ref = st.refs[self._i]
+                self._i += 1
+                return ref
+            raise StopIteration
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        # StopIteration can't cross a Future boundary (asyncio converts it
+        # to RuntimeError) — use a sentinel instead.
+        import asyncio
+        loop = asyncio.get_running_loop()
+        sentinel = object()
+
+        def step():
+            try:
+                return self.__next__()
+            except StopIteration:
+                return sentinel
+
+        item = await loop.run_in_executor(None, step)
+        if item is sentinel:
+            raise StopAsyncIteration
+        return item
+
+    def completed(self) -> List[ObjectRef]:
+        with self._state.cv:
+            return list(self._state.refs)
+
+
+# ---------------------------------------------------------------------------
+# Actors
+# ---------------------------------------------------------------------------
+
+class _ActorExit(BaseException):
+    pass
+
+
+class ActorState:
+    """A live actor: dedicated mailbox + executor thread(s).
+
+    Mirrors the reference's direct actor transport semantics
+    (direct_actor_task_submitter.h): per-caller ordered delivery (here:
+    one global FIFO mailbox), max_concurrency via a pool, async actors via
+    an embedded event loop. Method exceptions are stored as error objects;
+    the actor stays alive (parity with the reference)."""
+
+    def __init__(self, rt: "Runtime", actor_id: ActorID, cls: type,
+                 args, kwargs, *, node: NodeState, name: str,
+                 max_concurrency: int, max_restarts: int,
+                 resources: ResourceSet):
+        self.rt = rt
+        self.actor_id = actor_id
+        self.cls = cls
+        self.init_args = args
+        self.init_kwargs = kwargs
+        self.node = node
+        self.name = name
+        self.max_concurrency = max(1, max_concurrency)
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        self.resources = resources
+        self.mailbox: "queue.Queue" = queue.Queue(maxsize=config.actor_queue_max)
+        self.dead = threading.Event()
+        self.ready = threading.Event()
+        self.death_cause: Optional[BaseException] = None
+        self.instance = None
+        self._death_lock = threading.Lock()
+        self._death_done = False
+        self.generation = 0  # bumped on restart; stale threads no-op in _die
+        self._restartable_kill = False
+        self._is_async = any(
+            _is_coro_fn(getattr(cls, m, None)) for m in dir(cls)
+            if not m.startswith("__")
+        )
+        self._threads: List[threading.Thread] = []
+        self._start_threads()
+
+    def _start_threads(self):
+        gen = self.generation
+        if self._is_async:
+            t = threading.Thread(
+                target=self._async_main, args=(gen,),
+                name=f"actor-{self.name}", daemon=True)
+            t.start()
+            self._threads = [t]
+        else:
+            # First thread constructs the instance; extras join after ready.
+            t = threading.Thread(
+                target=self._sync_main, args=(True, gen),
+                name=f"actor-{self.name}", daemon=True)
+            t.start()
+            self._threads = [t]
+            for i in range(1, self.max_concurrency):
+                t = threading.Thread(
+                    target=self._sync_main, args=(False, gen),
+                    name=f"actor-{self.name}-{i}", daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    # -- lifecycle --------------------------------------------------------
+    def _construct(self, gen: int) -> bool:
+        try:
+            self.instance = self.cls(*self.init_args, **self.init_kwargs)
+            self.ready.set()
+            return True
+        except BaseException as e:  # noqa: BLE001
+            self.death_cause = TaskError(self.cls.__name__ + ".__init__", e)
+            self._die(gen)
+            return False
+
+    def _die(self, gen: int):
+        """Called by every worker thread on loop exit. Only the first thread
+        of the *current* generation performs death bookkeeping (resource
+        release must happen exactly once); restart bumps the generation so
+        stale threads become no-ops
+        (reference restart semantics: gcs_actor_manager.h:513
+        GcsActorManager::ReconstructActor)."""
+        with self._death_lock:
+            if gen != self.generation or self._death_done:
+                return
+            if self._restartable_kill and self.restarts < self.max_restarts:
+                self.restarts += 1
+                logger.info("Restarting actor %s (%d/%d)",
+                            self.name, self.restarts, self.max_restarts)
+                self._restartable_kill = False
+                self.death_cause = None
+                self.instance = None
+                self.generation += 1
+                self.dead.clear()
+                self.ready.clear()
+                self._start_threads()
+                return
+            self._death_done = True
+        self.dead.set()
+        self.ready.set()
+        # Drain mailbox with death errors.
+        while True:
+            try:
+                spec = self.mailbox.get_nowait()
+            except queue.Empty:
+                break
+            if spec is not None:
+                self.rt._store_error(
+                    spec,
+                    self.death_cause
+                    or ActorDiedError(self.actor_id.hex()),
+                )
+                self.rt._task_finished(spec)
+        self.rt._on_actor_dead(self)
+
+    def kill(self, *, no_restart: bool = True):
+        self.death_cause = ActorDiedError(
+            self.actor_id.hex(), "Killed via ray_tpu.kill().")
+        self._restartable_kill = not no_restart
+        self.dead.set()
+        try:
+            self.mailbox.put_nowait(None)  # wake the loop
+        except queue.Full:
+            pass
+
+    # -- execution --------------------------------------------------------
+    def _sync_main(self, constructs: bool, gen: int):
+        _ctx.actor_id = self.actor_id
+        _ctx.node_id = self.node.node_id
+        if constructs:
+            if not self._construct(gen):
+                return
+        else:
+            self.ready.wait()
+        while not self.dead.is_set() and gen == self.generation:
+            try:
+                spec = self.mailbox.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if spec is None or self.dead.is_set():
+                break
+            self._run_method(spec)
+        self._die(gen)
+
+    def _async_main(self, gen: int):
+        import asyncio
+        _ctx.actor_id = self.actor_id
+        _ctx.node_id = self.node.node_id
+        if not self._construct(gen):
+            return
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        sem = asyncio.Semaphore(self.max_concurrency)
+
+        async def runner():
+            while not self.dead.is_set():
+                try:
+                    spec = await loop.run_in_executor(
+                        None, lambda: self.mailbox.get(timeout=0.1))
+                except queue.Empty:
+                    continue
+                if spec is None:
+                    break
+
+                async def run_one(s=spec):
+                    async with sem:
+                        await self._run_method_async(s)
+
+                loop.create_task(run_one())
+            # let in-flight tasks finish
+            pending = [t for t in asyncio.all_tasks(loop)
+                       if t is not asyncio.current_task()]
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+
+        try:
+            loop.run_until_complete(runner())
+        finally:
+            loop.close()
+            self._die(gen)
+
+    def _bind_method(self, spec: TaskSpec):
+        method = getattr(self.instance, spec.method_name)
+        return method
+
+    def _run_method(self, spec: TaskSpec):
+        _ctx.task_id = spec.task_id
+        t0 = time.monotonic()
+        try:
+            method = self._bind_method(spec)
+            args, kwargs = self.rt._materialize_args(spec)
+            result = method(*args, **kwargs)
+            self.rt._store_results(spec, result, t0)
+        except _ActorExit:
+            self.rt._store_results(spec, None, t0)
+            self.death_cause = ActorDiedError(
+                self.actor_id.hex(), "exit_actor() was called.")
+            self.dead.set()
+        except BaseException as e:  # noqa: BLE001
+            self.rt._store_error(spec, _wrap(spec, e), t0)
+        finally:
+            _ctx.task_id = None
+            self.rt._task_finished(spec)
+
+    async def _run_method_async(self, spec: TaskSpec):
+        _ctx.task_id = spec.task_id
+        t0 = time.monotonic()
+        try:
+            method = self._bind_method(spec)
+            args, kwargs = self.rt._materialize_args(spec)
+            result = method(*args, **kwargs)
+            if hasattr(result, "__await__"):
+                result = await result
+            self.rt._store_results(spec, result, t0)
+        except _ActorExit:
+            self.rt._store_results(spec, None, t0)
+            self.death_cause = ActorDiedError(
+                self.actor_id.hex(), "exit_actor() was called.")
+            self.dead.set()
+        except BaseException as e:  # noqa: BLE001
+            self.rt._store_error(spec, _wrap(spec, e), t0)
+        finally:
+            _ctx.task_id = None
+            self.rt._task_finished(spec)
+
+
+def _is_coro_fn(f) -> bool:
+    import inspect
+    return f is not None and inspect.iscoroutinefunction(f)
+
+
+def _wrap(spec: TaskSpec, e: BaseException) -> BaseException:
+    if isinstance(e, (TaskError, ActorDiedError, TaskCancelledError,
+                      ObjectLostError)):
+        return e
+    return TaskError(spec.display_name(), e)
+
+
+# ---------------------------------------------------------------------------
+# Runtime
+# ---------------------------------------------------------------------------
+
+class Runtime:
+    def __init__(self, *, num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 _system_config: Optional[Dict[str, Any]] = None):
+        config.apply(_system_config)
+        self.job_id = JobID.from_random()
+        self.store = MemoryStore()
+        self.reference_counter = ReferenceCounter(self._on_refcount_zero)
+        self.function_manager = FunctionManager()
+        self.events = TaskEventBuffer()
+        self.scheduler = Scheduler(self._dispatch)
+        self.lineage: Dict[ObjectID, TaskSpec] = {}
+        self.lineage_lock = threading.Lock()
+        self._pending_tasks: Dict[TaskID, TaskSpec] = {}
+        self._pending_lock = threading.Lock()
+        self._cancelled: set = set()
+        self._generators: Dict[TaskID, _GeneratorState] = {}
+        self._actors: Dict[ActorID, ActorState] = {}
+        self._named_actors: Dict[str, ActorID] = {}
+        self._actors_lock = threading.Lock()
+        self._ref_registry: Dict[ObjectID, int] = {}
+        self._shutdown = False
+        # Zero-refcount cleanup runs on a dedicated thread: finalizers fire
+        # on whatever thread drops the last reference (possibly while locks
+        # are held), and releasing a lineage entry cascades further ref
+        # drops — doing the work here keeps it deadlock- and recursion-free.
+        self._gc_queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._gc_thread = threading.Thread(
+            target=self._gc_loop, name="ref-gc", daemon=True)
+        self._gc_thread.start()
+        # Borrows held by serialized copies inside stored objects:
+        # containing ObjectID → IDs of refs pickled inside it. Released
+        # when the containing object is deleted (reference borrowing:
+        # reference_count.h WrapObjectIds/nested-ref semantics).
+        self._contained: Dict[ObjectID, List[ObjectID]] = {}
+        self._contained_lock = threading.Lock()
+
+        if num_cpus is None:
+            import os
+            num_cpus = float(os.cpu_count() or 1)
+        if num_tpus is None:
+            num_tpus = float(self._detect_tpus())
+        total = {CPU: num_cpus}
+        if num_tpus:
+            total[TPU] = num_tpus
+        total.update(resources or {})
+        self.head_node_id = "node-head"
+        head = NodeState(
+            self.head_node_id, ResourceSet(total),
+            max_workers=max(4, int(num_cpus) * 2),
+        )
+        self.scheduler.add_node(head)
+
+    @staticmethod
+    def _detect_tpus() -> int:
+        if config.tpu_devices_per_host:
+            return config.tpu_devices_per_host
+        try:
+            import jax
+            return len([d for d in jax.local_devices()
+                        if d.platform != "cpu"])
+        except Exception:  # noqa: BLE001
+            return 0
+
+    # ------------------------------------------------------------------
+    # Ref bookkeeping
+    # ------------------------------------------------------------------
+    def register_ref(self, ref: ObjectRef) -> ObjectRef:
+        self.reference_counter.add_local_ref(ref.id())
+        weakref.finalize(ref, self._finalize_ref, ref.id())
+        return ref
+
+    def _finalize_ref(self, oid: ObjectID):
+        if not self._shutdown:
+            self.reference_counter.remove_local_ref(oid)
+
+    def _on_refcount_zero(self, oid: ObjectID):
+        self._gc_queue.put(oid)
+
+    def _gc_loop(self):
+        while True:
+            oid = self._gc_queue.get()
+            if oid is None:
+                return
+            self.store.delete([oid])
+            with self._contained_lock:
+                contained = self._contained.pop(oid, [])
+            for cid in contained:
+                self.reference_counter.remove_borrow(cid)
+            with self.lineage_lock:
+                spec = self.lineage.pop(oid, None)
+            del spec  # cascading finalizers fire here, outside any lock
+
+    def _store(self, oid: ObjectID, data, is_error: bool = False):
+        """All object writes funnel here so contained-ref borrows are
+        tracked against the containing object's lifetime."""
+        if data.contained_refs:
+            with self._contained_lock:
+                self._contained[oid] = [r.id() for r in data.contained_refs]
+        self.store.put(oid, data, is_error=is_error)
+
+    def serialization_noted_ref(self, ref: ObjectRef):
+        serialization.get_context()._note_ref(ref)
+
+    # ------------------------------------------------------------------
+    # put / get / wait
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        task_id = _ctx.task_id or TaskID.for_task(self.job_id)
+        oid = ObjectID.for_put(task_id, put_counter.next())
+        data = serialization.serialize(value)
+        self._store(oid, data)
+        return self.register_ref(ObjectRef(oid))
+
+    def get(self, refs: Sequence[ObjectRef],
+            timeout: Optional[float] = None) -> List[Any]:
+        ids = [r.id() for r in refs]
+        self._maybe_reconstruct(ids)
+        stored = self.store.get(ids, timeout)
+        out = []
+        for s in stored:
+            value = serialization.deserialize(s.data)
+            if s.is_error:
+                raise value
+            out.append(value)
+        return out
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int,
+             timeout: Optional[float],
+             fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        id_to_ref = {r.id(): r for r in refs}
+        ready, not_ready = self.store.wait(
+            [r.id() for r in refs], num_returns, timeout)
+        return ([id_to_ref[i] for i in ready], [id_to_ref[i] for i in not_ready])
+
+    def as_future(self, ref: ObjectRef):
+        from concurrent.futures import Future
+        fut: Future = Future()
+
+        def _cb(oid):
+            try:
+                fut.set_result(self.get([ref])[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        self.store.on_ready(ref.id(), _cb)
+        return fut
+
+    # ------------------------------------------------------------------
+    # Task submission
+    # ------------------------------------------------------------------
+    def submit_task(self, func: Callable, descriptor: FunctionDescriptor,
+                    args, kwargs, opts: Dict[str, Any]) -> Any:
+        from .task import build_resources
+        task_id = TaskID.for_task(self.job_id)
+        num_returns = opts.get("num_returns", 1)
+        streaming = num_returns in ("streaming", "dynamic")
+        n_rets = 0 if streaming else num_returns
+        spec = TaskSpec(
+            task_id=task_id,
+            task_type=TaskType.NORMAL_TASK,
+            descriptor=descriptor,
+            args=tuple(args),
+            kwargs=dict(kwargs),
+            num_returns=num_returns,
+            resources=build_resources(opts, is_actor=False),
+            return_ids=[ObjectID.for_return(task_id, i) for i in range(n_rets)],
+            max_retries=opts.get("max_retries", config.default_max_retries),
+            retry_exceptions=opts.get("retry_exceptions", False),
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            name=opts.get("name", ""),
+            runtime_env=opts.get("runtime_env"),
+        )
+        spec.retries_left = spec.max_retries
+        gen_state = None
+        if streaming:
+            gen_state = _GeneratorState()
+            self._generators[task_id] = gen_state
+        self._record_lineage(spec)
+        with self._pending_lock:
+            self._pending_tasks[task_id] = spec
+        self._submit_when_ready(spec)
+        if streaming:
+            return ObjectRefGenerator(task_id, gen_state)
+        refs = [self.register_ref(ObjectRef(oid)) for oid in spec.return_ids]
+        if num_returns == 0:
+            return None
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def _record_lineage(self, spec: TaskSpec):
+        with self.lineage_lock:
+            for oid in spec.return_ids:
+                self.lineage[oid] = spec
+
+    def _submit_when_ready(self, spec: TaskSpec):
+        """Dependency resolution: top-level ObjectRef args must exist."""
+        deps = [a.id() for a in spec.args if isinstance(a, ObjectRef)]
+        deps += [v.id() for v in spec.kwargs.values() if isinstance(v, ObjectRef)]
+        deps = list(dict.fromkeys(deps))
+        if not deps:
+            self.scheduler.submit(spec)
+            return
+        remaining = {"n": len(deps)}
+        lock = threading.Lock()
+
+        def on_ready(_oid):
+            with lock:
+                remaining["n"] -= 1
+                if remaining["n"] != 0:
+                    return
+            self.scheduler.submit(spec)
+
+        for d in deps:
+            self.store.on_ready(d, on_ready)
+        # Reconstruction safety net: deps might have been lost.
+        self._maybe_reconstruct(deps)
+
+    # ------------------------------------------------------------------
+    # Actor API
+    # ------------------------------------------------------------------
+    def create_actor(self, cls: type, args, kwargs,
+                     opts: Dict[str, Any]) -> "ActorID":
+        from .task import build_resources
+        name = opts.get("name") or ""
+        actor_id = ActorID.of(self.job_id)
+        if name:
+            # Reserve the name BEFORE starting any threads / holding any
+            # resources, so a duplicate-name failure leaks nothing.
+            with self._actors_lock:
+                existing = self._named_actors.get(name)
+                if existing is not None:
+                    if opts.get("get_if_exists"):
+                        return existing
+                    raise ValueError(f"Actor name {name!r} already taken")
+                self._named_actors[name] = actor_id
+        resources = build_resources(opts, is_actor=True)
+        # Acquire placement synchronously through the scheduler by running
+        # the creation as a task that starts the actor threads on a node.
+        done = threading.Event()
+        box: Dict[str, Any] = {}
+
+        creation_id = TaskID.for_actor_task(actor_id)
+        spec = TaskSpec(
+            task_id=creation_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            descriptor=FunctionDescriptor(cls.__module__, cls.__qualname__),
+            args=tuple(args), kwargs=dict(kwargs),
+            num_returns=0, resources=resources,
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            name=name, actor_id=actor_id, actor_class=cls,
+            actor_creation_opts=opts,
+        )
+
+        def on_placed(node: NodeState):
+            try:
+                st = ActorState(
+                    self, actor_id, cls, spec.args, spec.kwargs,
+                    node=node, name=name or actor_id.hex()[:8],
+                    max_concurrency=opts.get("max_concurrency", 1),
+                    max_restarts=opts.get(
+                        "max_restarts", config.default_actor_max_restarts),
+                    resources=resources,
+                )
+                with self._actors_lock:
+                    self._actors[actor_id] = st
+                box["ok"] = True
+            except BaseException as e:  # noqa: BLE001
+                box["err"] = e
+            finally:
+                done.set()
+
+        spec.actor_placement_cb = on_placed  # type: ignore[attr-defined]
+        self.scheduler.submit(spec)
+        done.wait()
+        if "err" in box:
+            if name:
+                with self._actors_lock:
+                    if self._named_actors.get(name) == actor_id:
+                        del self._named_actors[name]
+            raise box["err"]
+        return actor_id
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args, kwargs, opts: Dict[str, Any]) -> Any:
+        deadline = time.monotonic() + 5.0
+        while True:
+            with self._actors_lock:
+                st = self._actors.get(actor_id)
+            if st is not None:
+                break
+            # A name reservation may exist before placement completes
+            # (get_if_exists race) — give creation a moment.
+            if time.monotonic() > deadline:
+                raise ActorDiedError(actor_id.hex())
+            time.sleep(0.005)
+        if st.dead.is_set():
+            cause = st.death_cause
+            raise (cause if isinstance(cause, ActorDiedError)
+                   else ActorDiedError(actor_id.hex()))
+        task_id = TaskID.for_actor_task(actor_id)
+        num_returns = opts.get("num_returns", 1)
+        streaming = num_returns in ("streaming", "dynamic")
+        n_rets = 0 if streaming else num_returns
+        spec = TaskSpec(
+            task_id=task_id, task_type=TaskType.ACTOR_TASK,
+            descriptor=FunctionDescriptor(
+                st.cls.__module__, f"{st.cls.__qualname__}.{method_name}"),
+            args=tuple(args), kwargs=dict(kwargs),
+            num_returns=num_returns,
+            resources=ResourceSet({}),
+            return_ids=[ObjectID.for_return(task_id, i) for i in range(n_rets)],
+            actor_id=actor_id, method_name=method_name,
+            name=opts.get("name", ""),
+        )
+        if streaming:
+            gst = _GeneratorState()
+            self._generators[task_id] = gst
+        self._record_lineage(spec)
+        with self._pending_lock:
+            self._pending_tasks[task_id] = spec
+        st.mailbox.put(spec)
+        if streaming:
+            return ObjectRefGenerator(task_id, gst)
+        refs = [self.register_ref(ObjectRef(oid)) for oid in spec.return_ids]
+        if num_returns == 0:
+            return None
+        return refs[0] if num_returns == 1 else refs
+
+    def get_actor(self, name: str) -> ActorID:
+        with self._actors_lock:
+            aid = self._named_actors.get(name)
+        if aid is None:
+            raise ValueError(f"Failed to look up actor with name {name!r}")
+        return aid
+
+    def actor_state(self, actor_id: ActorID) -> Optional[ActorState]:
+        with self._actors_lock:
+            return self._actors.get(actor_id)
+
+    def kill_actor(self, actor_id: ActorID, *, no_restart: bool = True):
+        with self._actors_lock:
+            st = self._actors.get(actor_id)
+        if st is not None:
+            st.kill(no_restart=no_restart)
+
+    def _on_actor_dead(self, st: ActorState):
+        self.scheduler.release(st.node.node_id, st.resources)
+        with self._actors_lock:
+            if st.name in self._named_actors and \
+                    self._named_actors.get(st.name) == st.actor_id:
+                del self._named_actors[st.name]
+
+    # ------------------------------------------------------------------
+    # Dispatch & execution (normal tasks)
+    # ------------------------------------------------------------------
+    def _dispatch(self, spec: TaskSpec, node: NodeState):
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            # Resources stay held by the actor until death.
+            spec.actor_placement_cb(node)  # type: ignore[attr-defined]
+            return
+        node.executor.submit(self._execute, spec, node)
+
+    def _execute(self, spec: TaskSpec, node: NodeState):
+        t0 = time.monotonic()
+        prev_task, prev_node = _ctx.task_id, _ctx.node_id
+        _ctx.task_id, _ctx.node_id = spec.task_id, node.node_id
+        retried = False
+        try:
+            if spec.task_id in self._cancelled:
+                raise TaskCancelledError(spec.display_name())
+            func = self.function_manager.get(spec.descriptor.function_id)
+            args, kwargs = self._materialize_args(spec)
+            result = func(*args, **kwargs)
+            self._store_results(spec, result, t0)
+        except BaseException as e:  # noqa: BLE001
+            retried = self._maybe_retry(spec, e)
+            if not retried:
+                self._store_error(spec, _wrap(spec, e), t0)
+        finally:
+            _ctx.task_id, _ctx.node_id = prev_task, prev_node
+            if not retried:
+                self._task_finished(spec)
+            self.scheduler.release_task(spec, node.node_id)
+            self.events.record(
+                spec.display_name(), t0, time.monotonic(),
+                node.node_id, spec.task_id.hex())
+
+    def _maybe_retry(self, spec: TaskSpec, e: BaseException) -> bool:
+        if isinstance(e, (TaskCancelledError, _ActorExit)):
+            return False
+        retry_on_app_error = (
+            spec.retry_exceptions is True
+            or (isinstance(spec.retry_exceptions, (list, tuple))
+                and isinstance(e, tuple(spec.retry_exceptions)))
+        )
+        if not retry_on_app_error or spec.retries_left <= 0:
+            return False
+        spec.retries_left -= 1
+        logger.warning(
+            "Task %s failed (%s); retrying (%d left).",
+            spec.display_name(), type(e).__name__, spec.retries_left)
+        if config.task_retry_delay_ms:
+            time.sleep(config.task_retry_delay_ms / 1000)
+        with self._pending_lock:
+            self._pending_tasks[spec.task_id] = spec
+        self._submit_when_ready(spec)
+        return True
+
+    def _materialize_args(self, spec: TaskSpec):
+        """Resolve top-level ObjectRef args (error-poisoning included)."""
+        def resolve(v):
+            if isinstance(v, ObjectRef):
+                stored = self.store.get_if_exists(v.id())
+                if stored is None:
+                    # Dependency lost between readiness and execution.
+                    self._maybe_reconstruct([v.id()])
+                    stored = self.store.get([v.id()],
+                                            timeout=None)[0]
+                value = serialization.deserialize(stored.data)
+                if stored.is_error:
+                    raise value
+                return value
+            return v
+
+        args = tuple(resolve(a) for a in spec.args)
+        kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    def _store_results(self, spec: TaskSpec, result: Any, t0: float):
+        if spec.num_returns in ("streaming", "dynamic"):
+            self._consume_generator(spec, result)
+            return
+        n = spec.num_returns
+        if n == 0:
+            return
+        values = (result,) if n == 1 else tuple(result)
+        if n > 1 and len(values) != n:
+            err = _wrap(spec, ValueError(
+                f"Task {spec.display_name()} declared num_returns={n} but "
+                f"returned {len(values)} values"))
+            self._store_error(spec, err, t0)
+            return
+        for oid, v in zip(spec.return_ids, values):
+            self._store(oid, serialization.serialize(v))
+
+    def _consume_generator(self, spec: TaskSpec, gen):
+        st = self._generators[spec.task_id]
+        i = 0
+        try:
+            for item in gen:
+                oid = ObjectID.for_return(spec.task_id, i)
+                with self.lineage_lock:
+                    self.lineage[oid] = spec
+                self._store(oid, serialization.serialize(item))
+                ref = self.register_ref(ObjectRef(oid))
+                with st.cv:
+                    st.refs.append(ref)
+                    st.cv.notify_all()
+                i += 1
+        except BaseException as e:  # noqa: BLE001
+            oid = ObjectID.for_return(spec.task_id, i)
+            self._store(oid, serialization.serialize(_wrap(spec, e)),
+                        is_error=True)
+            ref = self.register_ref(ObjectRef(oid))
+            with st.cv:
+                st.refs.append(ref)
+                st.cv.notify_all()
+        finally:
+            with st.cv:
+                st.done = True
+                st.cv.notify_all()
+            # The consumer's ObjectRefGenerator holds the state directly;
+            # drop the table entry so streaming calls don't accumulate.
+            self._generators.pop(spec.task_id, None)
+
+    def _store_error(self, spec: TaskSpec, err: BaseException,
+                     t0: Optional[float] = None):
+        data = serialization.serialize(err)
+        ids = spec.return_ids
+        if spec.num_returns in ("streaming", "dynamic"):
+            st = self._generators.pop(spec.task_id, None)
+            if st is not None:
+                oid = ObjectID.for_return(spec.task_id, len(st.refs))
+                self._store(oid, data, is_error=True)
+                ref = self.register_ref(ObjectRef(oid))
+                with st.cv:
+                    st.refs.append(ref)
+                    st.done = True
+                    st.cv.notify_all()
+            return
+        for oid in ids:
+            self._store(oid, data, is_error=True)
+
+    # ------------------------------------------------------------------
+    # Cancellation
+    # ------------------------------------------------------------------
+    def cancel(self, ref: ObjectRef, *, force: bool = False):
+        task_id = ref.task_id()
+        self._cancelled.add(task_id)
+        if self.scheduler.cancel(task_id):
+            with self._pending_lock:
+                spec = self._pending_tasks.pop(task_id, None)
+            if spec is not None:
+                self._store_error(
+                    spec, TaskCancelledError(spec.display_name()))
+
+    # ------------------------------------------------------------------
+    # Lineage reconstruction
+    # ------------------------------------------------------------------
+    def _task_finished(self, spec: TaskSpec):
+        with self._pending_lock:
+            self._pending_tasks.pop(spec.task_id, None)
+
+    def _maybe_reconstruct(self, ids: Sequence[ObjectID]):
+        """Resubmit creating tasks for objects that are lost (not stored,
+        not pending). Recursive through the lineage graph
+        (reference: object_recovery_manager.h:96-106)."""
+        for oid in ids:
+            if self.store.contains(oid):
+                continue
+            with self.lineage_lock:
+                spec = self.lineage.get(oid)
+            if spec is None:
+                continue  # put object or unknown → will block / timeout
+            with self._pending_lock:
+                if spec.task_id in self._pending_tasks:
+                    continue  # already in flight
+                self._pending_tasks[spec.task_id] = spec
+            if spec.is_actor_task():
+                # Actor-task returns are only recomputable while the actor
+                # lives (reference: actor lineage is not reconstructed).
+                st = self.actor_state(spec.actor_id)
+                if st is not None and not st.dead.is_set():
+                    st.mailbox.put(spec)
+                else:
+                    self._task_finished(spec)
+                continue
+            logger.info("Reconstructing object %s via task %s",
+                        oid.hex()[:16], spec.display_name())
+            # Recursively ensure arg lineage first.
+            dep_ids = [a.id() for a in spec.args if isinstance(a, ObjectRef)]
+            dep_ids += [v.id() for v in spec.kwargs.values()
+                        if isinstance(v, ObjectRef)]
+            if dep_ids:
+                self._maybe_reconstruct(dep_ids)
+            self._submit_when_ready(spec)
+
+    def delete_objects(self, refs: Sequence[ObjectRef]):
+        """Evict objects from the store (keeps lineage → reconstructable)."""
+        self.store.delete([r.id() for r in refs])
+
+    # ------------------------------------------------------------------
+    # Introspection / shutdown
+    # ------------------------------------------------------------------
+    def cluster_resources(self) -> Dict[str, float]:
+        total = ResourceSet({})
+        for n in self.scheduler.nodes():
+            total = total.add(n.total)
+        return total.to_dict()
+
+    def available_resources(self) -> Dict[str, float]:
+        total = ResourceSet({})
+        for n in self.scheduler.nodes():
+            total = total.add(n.available)
+        return total.to_dict()
+
+    def timeline(self) -> List[dict]:
+        return self.events.dump()
+
+    def shutdown(self):
+        self._shutdown = True
+        self._gc_queue.put(None)
+        with self._actors_lock:
+            actors = list(self._actors.values())
+        for st in actors:
+            st.kill()
+        for node in self.scheduler.nodes():
+            node.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Globals
+# ---------------------------------------------------------------------------
+
+_global_runtime: Optional[Runtime] = None
+_global_lock = threading.Lock()
+
+
+def init_runtime(**kwargs) -> Runtime:
+    global _global_runtime
+    with _global_lock:
+        if _global_runtime is not None:
+            return _global_runtime
+        _global_runtime = Runtime(**kwargs)
+        return _global_runtime
+
+
+def global_runtime() -> Runtime:
+    rt = _global_runtime
+    if rt is None:
+        return init_runtime()
+    return rt
+
+
+def global_runtime_or_none() -> Optional[Runtime]:
+    return _global_runtime
+
+
+def shutdown_runtime():
+    global _global_runtime
+    with _global_lock:
+        if _global_runtime is not None:
+            _global_runtime.shutdown()
+            _global_runtime = None
+
+
+def is_initialized() -> bool:
+    return _global_runtime is not None

@@ -1,0 +1,233 @@
+"""Cluster/task scheduling.
+
+Capability-equivalent to the reference's raylet scheduling stack
+(reference: src/ray/raylet/scheduling/cluster_task_manager.h,
+cluster_resource_scheduler.h and the policies in
+src/ray/raylet/scheduling/policy/ — hybrid/spread/node-affinity/bundle,
+scored by least-resource): tasks wait for dependencies, then a policy picks
+a node from the cluster resource view; infeasible tasks are queued and
+surfaced as autoscaler demand. TPU-native addition: SliceAffinity — gang
+placement onto a single ICI slice via slice-label resources.
+
+In the local runtime every "node" executes in-process (a thread pool),
+which is the moral equivalent of the reference's in-process multi-raylet
+test Cluster (reference: python/ray/cluster_utils.py:108) — it exercises
+real scheduling/spillback decisions without real remote nodes.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+from .._private.config import config
+from .resources import ResourceSet
+from .task import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SliceAffinitySchedulingStrategy,
+    SpreadSchedulingStrategy,
+    TaskSpec,
+)
+
+
+class NodeState:
+    """One schedulable node: a resource view plus an executor."""
+
+    def __init__(self, node_id: str, total: ResourceSet, max_workers: int):
+        self.node_id = node_id
+        self.total = total
+        self.available = total
+        self.labels: Dict[str, str] = {}
+        self.alive = True
+        self.executor = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix=f"worker-{node_id}"
+        )
+
+    def utilization(self) -> float:
+        return self.available.scaled_utilization(self.total)
+
+    def shutdown(self):
+        self.alive = False
+        self.executor.shutdown(wait=False, cancel_futures=True)
+
+
+class Scheduler:
+    """Resource-aware dispatcher over a set of nodes.
+
+    Dispatch is event-driven: ``submit`` enqueues a dependency-resolved
+    task; ``_pump`` (called on submit and on every resource release) grants
+    resources and hands (task, node) to the dispatch callback.
+    """
+
+    def __init__(self, dispatch: Callable[[TaskSpec, NodeState], None]):
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, NodeState] = {}
+        self._queue: List[TaskSpec] = []
+        self._infeasible: List[TaskSpec] = []
+        self._dispatch = dispatch
+        self._rng = random.Random(0)
+
+    # -- topology ---------------------------------------------------------
+    def add_node(self, node: NodeState) -> None:
+        with self._lock:
+            self._nodes[node.node_id] = node
+        self._pump()
+
+    def remove_node(self, node_id: str) -> Optional[NodeState]:
+        with self._lock:
+            node = self._nodes.pop(node_id, None)
+        if node:
+            node.shutdown()
+        return node
+
+    def nodes(self) -> List[NodeState]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def get_node(self, node_id: str) -> Optional[NodeState]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    # -- demand (autoscaler signal) --------------------------------------
+    def pending_demand(self) -> List[ResourceSet]:
+        with self._lock:
+            return [t.resources for t in self._queue + self._infeasible]
+
+    # -- scheduling -------------------------------------------------------
+    def submit(self, spec: TaskSpec) -> None:
+        with self._lock:
+            self._queue.append(spec)
+        self._pump()
+
+    def cancel(self, task_id) -> bool:
+        with self._lock:
+            for q in (self._queue, self._infeasible):
+                for i, t in enumerate(q):
+                    if t.task_id == task_id:
+                        del q[i]
+                        return True
+        return False
+
+    def release(self, node_id: str, resources: ResourceSet) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node.available = node.available.add(resources)
+        self._pump()
+
+    def release_task(self, spec: TaskSpec, node_id: str) -> None:
+        """Return a finished task's resources to wherever they were
+        charged (PG bundle or node)."""
+        charge = getattr(spec, "_pg_charge", None)
+        if charge is not None:
+            pg, idx = charge
+            with self._lock:
+                pg._bundle_available[idx] = \
+                    pg._bundle_available[idx].add(spec.resources)
+            self._pump()
+        else:
+            self.release(node_id, spec.resources)
+
+    def _pump(self) -> None:
+        granted = []
+        with self._lock:
+            # Re-examine infeasible tasks when topology changed.
+            self._queue.extend(self._infeasible)
+            self._infeasible = []
+            still = []
+            for spec in self._queue:
+                node = self._pick_node(spec)
+                if node is None:
+                    if self._feasible_anywhere(spec):
+                        still.append(spec)
+                    else:
+                        self._infeasible.append(spec)
+                    continue
+                charge = getattr(spec, "_pg_charge", None)
+                if charge is not None:
+                    # Bundle resources were already reserved on the node at
+                    # PG creation; charge the bundle, not the node.
+                    pg, idx = charge
+                    pg._bundle_available[idx] = \
+                        pg._bundle_available[idx].subtract(spec.resources)
+                else:
+                    node.available = node.available.subtract(spec.resources)
+                granted.append((spec, node))
+            self._queue = still
+        for spec, node in granted:
+            self._dispatch(spec, node)
+
+    def _feasible_anywhere(self, spec: TaskSpec) -> bool:
+        return any(
+            spec.resources.fits(n.total) for n in self._nodes.values() if n.alive
+        )
+
+    # -- policies ---------------------------------------------------------
+    def _pick_node(self, spec: TaskSpec) -> Optional[NodeState]:
+        strat = spec.scheduling_strategy
+
+        if isinstance(strat, PlacementGroupSchedulingStrategy):
+            # PG tasks consume the bundle's reserved resources (which were
+            # subtracted from node.available at PG creation), so fitness is
+            # checked against the bundle, not the node
+            # (reference: bundle resource accounting in
+            # placement_group_resource_manager.h).
+            pg = strat.placement_group
+            if not getattr(pg, "_committed", False):
+                return None  # bundles not placed yet — keep queued
+            idx = strat.placement_group_bundle_index
+            indices = ([idx] if idx >= 0
+                       else range(len(pg._bundle_available)))
+            for i in indices:
+                node = self._nodes.get(pg._bundle_nodes[i] or "")
+                if node is None or not node.alive:
+                    continue
+                if spec.resources.fits(pg._bundle_available[i]):
+                    spec._pg_charge = (pg, i)
+                    return node
+            return None
+
+        fitting = [
+            n for n in self._nodes.values()
+            if n.alive and spec.resources.fits(n.available)
+        ]
+        if not fitting:
+            return None
+
+        if isinstance(strat, NodeAffinitySchedulingStrategy):
+            node = self._nodes.get(strat.node_id)
+            if node is not None and node.alive and spec.resources.fits(
+                    node.available):
+                return node
+            return self._hybrid(fitting) if strat.soft else None
+
+        if isinstance(strat, SliceAffinitySchedulingStrategy):
+            # Slice membership is modeled as a node label.
+            on_slice = [n for n in fitting
+                        if n.labels.get("tpu-slice") == strat.slice_id]
+            if on_slice:
+                return self._least_loaded(on_slice)
+            return self._hybrid(fitting) if strat.soft else None
+
+        if isinstance(strat, SpreadSchedulingStrategy):
+            return self._least_loaded(fitting)
+
+        return self._hybrid(fitting)
+
+    def _hybrid(self, fitting: List[NodeState]) -> NodeState:
+        """Reference default (hybrid_scheduling_policy.h:50): prefer the
+        local/first node until its utilization crosses spread_threshold,
+        then pick the least-loaded of a random top-k sample."""
+        local = fitting[0]
+        if local.utilization() < config.scheduler_spread_threshold:
+            return local
+        k = max(1, int(len(fitting) * config.scheduler_top_k_fraction))
+        sample = self._rng.sample(fitting, min(k, len(fitting)))
+        return self._least_loaded(sample)
+
+    @staticmethod
+    def _least_loaded(nodes: List[NodeState]) -> NodeState:
+        return min(nodes, key=lambda n: n.utilization())

@@ -1,0 +1,115 @@
+"""Serialization layer.
+
+Capability-equivalent to the reference's SerializationContext
+(reference: python/ray/_private/serialization.py) — cloudpickle with
+out-of-band buffer support so large numpy/jax arrays round-trip without an
+extra copy, and ObjectRef capture during serialization so that refs pickled
+inside arguments are tracked for distributed refcounting (borrowing).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+import cloudpickle
+import numpy as np
+
+
+class SerializedObject:
+    """A serialized value: a pickle stream plus raw out-of-band buffers."""
+
+    __slots__ = ("payload", "buffers", "contained_refs")
+
+    def __init__(self, payload: bytes, buffers: List[bytes],
+                 contained_refs: List[Any]):
+        self.payload = payload
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    def total_bytes(self) -> int:
+        return len(self.payload) + sum(len(b) for b in self.buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten to a single contiguous frame (for shared-memory storage).
+
+        Layout: [4B nbuf][8B len payload][payload][8B len buf0][buf0]...
+        """
+        out = io.BytesIO()
+        out.write(len(self.buffers).to_bytes(4, "little"))
+        out.write(len(self.payload).to_bytes(8, "little"))
+        out.write(self.payload)
+        for b in self.buffers:
+            out.write(len(b).to_bytes(8, "little"))
+            out.write(b)
+        return out.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: memoryview | bytes) -> "SerializedObject":
+        mv = memoryview(data)
+        nbuf = int.from_bytes(mv[:4], "little")
+        off = 4
+        plen = int.from_bytes(mv[off:off + 8], "little")
+        off += 8
+        payload = bytes(mv[off:off + plen])
+        off += plen
+        bufs = []
+        for _ in range(nbuf):
+            blen = int.from_bytes(mv[off:off + 8], "little")
+            off += 8
+            bufs.append(bytes(mv[off:off + blen]))
+            off += blen
+        return cls(payload, bufs, [])
+
+
+class SerializationContext:
+    """Pickle-5 out-of-band serializer with ObjectRef tracking."""
+
+    def __init__(self):
+        self._local = threading.local()
+
+    # -- ObjectRef capture ------------------------------------------------
+    def _note_ref(self, ref):
+        refs = getattr(self._local, "captured_refs", None)
+        if refs is not None:
+            refs.append(ref)
+
+    def serialize(self, value: Any) -> SerializedObject:
+        self._local.captured_refs = []
+        buffers: List[pickle.PickleBuffer] = []
+
+        def buffer_callback(buf: pickle.PickleBuffer) -> bool:
+            buffers.append(buf)
+            return False  # out-of-band
+
+        try:
+            payload = cloudpickle.dumps(
+                value, protocol=5, buffer_callback=buffer_callback
+            )
+            raw = [bytes(b.raw()) for b in buffers]
+            return SerializedObject(payload, raw, list(self._local.captured_refs))
+        finally:
+            self._local.captured_refs = None
+
+    def deserialize(self, s: SerializedObject) -> Any:
+        return pickle.loads(s.payload, buffers=[memoryview(b) for b in s.buffers])
+
+
+_context: Optional[SerializationContext] = None
+
+
+def get_context() -> SerializationContext:
+    global _context
+    if _context is None:
+        _context = SerializationContext()
+    return _context
+
+
+def serialize(value: Any) -> SerializedObject:
+    return get_context().serialize(value)
+
+
+def deserialize(s: SerializedObject) -> Any:
+    return get_context().deserialize(s)

@@ -1,0 +1,151 @@
+"""TaskSpec and options.
+
+Capability-equivalent to the reference's TaskSpecification + @ray.remote
+option set (reference: src/ray/common/task/task_spec.h and
+python/ray/_private/ray_option_utils.py): the full option surface —
+num_cpus/num_tpus/resources/memory, num_returns, max_retries /
+retry_exceptions, max_restarts / max_task_retries, name, scheduling
+strategy, placement-group bundles, runtime_env, concurrency groups,
+lifetime, max_concurrency — validated in one place.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .ids import ActorID, ObjectID, TaskID
+from .resources import ResourceSet, task_resources
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+# ---------------------------------------------------------------------------
+# Options
+# ---------------------------------------------------------------------------
+
+_TASK_ONLY = {"num_returns", "max_retries", "retry_exceptions"}
+_ACTOR_ONLY = {"max_restarts", "max_task_retries", "max_concurrency",
+               "lifetime", "get_if_exists", "namespace"}
+
+_VALID = {
+    "num_cpus", "num_tpus", "num_gpus", "memory", "resources", "name",
+    "scheduling_strategy", "placement_group", "placement_group_bundle_index",
+    "runtime_env", "max_calls", "accelerator_type", "label_selector",
+} | _TASK_ONLY | _ACTOR_ONLY
+
+
+def validate_options(opts: Dict[str, Any], *, is_actor: bool) -> Dict[str, Any]:
+    for k in opts:
+        if k not in _VALID:
+            raise ValueError(f"Unknown option {k!r}. Valid: {sorted(_VALID)}")
+        if is_actor and k in _TASK_ONLY:
+            raise ValueError(f"Option {k!r} is only valid for tasks")
+        if not is_actor and k in _ACTOR_ONLY:
+            raise ValueError(f"Option {k!r} is only valid for actors")
+    if "num_gpus" in opts and opts["num_gpus"]:
+        raise ValueError(
+            "num_gpus is not supported on the TPU runtime; use num_tpus")
+    nr = opts.get("num_returns", 1)
+    if not (nr == "streaming" or nr == "dynamic"
+            or (isinstance(nr, int) and nr >= 0)):
+        raise ValueError(f"num_returns must be int>=0 or 'streaming': {nr!r}")
+    resources = opts.get("resources")
+    if resources is not None and not isinstance(resources, dict):
+        raise ValueError("resources must be a dict")
+    return opts
+
+
+@dataclass
+class SchedulingStrategy:
+    """Base; see parallel/placement for SliceAffinity and bundles."""
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    node_id: str
+    soft: bool = False
+
+
+@dataclass
+class SpreadSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy(SchedulingStrategy):
+    placement_group: Any
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class SliceAffinitySchedulingStrategy(SchedulingStrategy):
+    """TPU-native: co-schedule onto one ICI slice (gang member)."""
+    slice_id: str
+    soft: bool = False
+
+
+# ---------------------------------------------------------------------------
+# TaskSpec
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FunctionDescriptor:
+    module: str
+    qualname: str
+    # Serialized callable; workers in other processes unpickle it once and
+    # cache by function_id (reference: _private/function_manager.py).
+    function_id: bytes = b""
+
+    def name(self) -> str:
+        return f"{self.module}.{self.qualname}"
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    task_type: TaskType
+    descriptor: FunctionDescriptor
+    # args/kwargs may contain ObjectRefs — resolved before dispatch.
+    args: Tuple[Any, ...]
+    kwargs: Dict[str, Any]
+    num_returns: Any  # int or "streaming"
+    resources: ResourceSet
+    return_ids: List[ObjectID] = field(default_factory=list)
+    # retry policy
+    max_retries: int = 0
+    retry_exceptions: Any = False  # False | True | list[type]
+    retries_left: int = 0
+    # actor linkage
+    actor_id: Optional[ActorID] = None
+    method_name: Optional[str] = None
+    # scheduling
+    scheduling_strategy: Optional[SchedulingStrategy] = None
+    name: str = ""
+    runtime_env: Optional[Dict[str, Any]] = None
+    # set for actor-creation tasks
+    actor_class: Any = None
+    actor_creation_opts: Optional[Dict[str, Any]] = None
+
+    def is_actor_task(self) -> bool:
+        return self.task_type == TaskType.ACTOR_TASK
+
+    def display_name(self) -> str:
+        return self.name or self.descriptor.name()
+
+
+def build_resources(opts: Dict[str, Any], *, is_actor: bool) -> ResourceSet:
+    # Actors default to 1 CPU for creation but 0 for running (reference
+    # semantics: actor methods consume no resources by default; the process
+    # holds its creation resources). We model the held resources only.
+    default_cpus = 1.0 if not is_actor else 1.0
+    return task_resources(
+        opts.get("num_cpus"), opts.get("num_tpus"), opts.get("memory"),
+        opts.get("resources"), default_num_cpus=default_cpus,
+    )

@@ -1,0 +1,13 @@
+from .node import (
+    ActorMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "DAGNode", "FunctionNode", "ClassNode", "ActorMethodNode",
+    "InputNode", "MultiOutputNode",
+]

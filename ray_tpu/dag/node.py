@@ -1,0 +1,141 @@
+"""Lazy DAG construction via .bind().
+
+Capability-equivalent to the reference's DAG layer
+(reference: python/ray/dag/dag_node.py — DAGNode/InputNode/OutputNode and
+`python/ray/dag/compiled_dag_node.py` for the compiled execution): builds a
+static graph of function/actor-method calls that can be executed repeatedly
+with `dag.execute(input)`, the substrate for serve app graphs and compiled
+channel execution.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Tuple
+
+
+class DAGNode:
+    def __init__(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- traversal --------------------------------------------------------
+    def _deps(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def execute(self, *input_args, **input_kwargs):
+        """Execute the DAG rooted at this node; returns ObjectRef(s)."""
+        cache: Dict[int, Any] = {}
+        return self._execute_node(cache, input_args, input_kwargs)
+
+    def _resolve_args(self, cache, input_args, input_kwargs):
+        def r(v):
+            if isinstance(v, DAGNode):
+                return v._execute_node(cache, input_args, input_kwargs)
+            return v
+
+        args = tuple(r(a) for a in self._bound_args)
+        kwargs = {k: r(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_node(self, cache, input_args, input_kwargs):
+        key = id(self)
+        if key not in cache:
+            cache[key] = self._execute_impl(cache, input_args, input_kwargs)
+        return cache[key]
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for the DAG's runtime input. Context-manager style:
+    ``with InputNode() as inp: ...`` (parity with the reference)."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        if len(input_args) == 1 and not input_kwargs:
+            return input_args[0]
+        return input_args
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        args, kwargs = self._resolve_args(cache, input_args, input_kwargs)
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """An actor-to-be in the DAG; instantiated once per DAG (lazily)."""
+
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+        self._handle = None
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cache, input_args, input_kwargs):
+        with self._lock:
+            if self._handle is None:
+                args, kwargs = self._resolve_args(
+                    cache, input_args, input_kwargs)
+                self._handle = self._actor_cls.remote(*args, **kwargs)
+            return self._handle
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _UnboundMethodBinder(self, name)
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        return self._get_or_create(cache, input_args, input_kwargs)
+
+
+class _UnboundMethodBinder:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "ActorMethodNode":
+        return ActorMethodNode(
+            self._class_node, self._method_name, args, kwargs)
+
+
+class ActorMethodNode(DAGNode):
+    def __init__(self, target, method_name: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._target = target  # ActorHandle or ClassNode
+        self._method_name = method_name
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        args, kwargs = self._resolve_args(cache, input_args, input_kwargs)
+        target = self._target
+        if isinstance(target, ClassNode):
+            target = target._get_or_create(cache, input_args, input_kwargs)
+        method = getattr(target, self._method_name)
+        return method.remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        return [o._execute_node(cache, input_args, input_kwargs)
+                for o in self._bound_args]

@@ -1,0 +1,53 @@
+"""Test fixtures.
+
+Forces an 8-device virtual CPU platform (before any jax import) so sharding
+/ mesh tests exercise real multi-device SPMD semantics without TPU hardware,
+mirroring how the reference tests multi-node behavior in-process
+(reference: python/ray/tests/conftest.py ray_start_cluster →
+cluster_utils.Cluster).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start():
+    """A fresh runtime per test (4 CPUs, no TPU)."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-node in-process cluster fixture
+    (reference: python/ray/tests/conftest.py:492 ray_start_cluster)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    yield cluster
+    cluster.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh8():
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices[:8]

@@ -1,0 +1,252 @@
+"""Actor tests (reference: python/ray/tests/test_actor.py,
+test_actor_failures.py, test_async_actor.py coverage model)."""
+
+import time
+
+import pytest
+
+
+def test_basic_actor(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray.get(c.incr.remote()) == 11
+    assert ray.get(c.incr.remote(5)) == 16
+    assert ray.get(c.value.remote()) == 16
+
+
+def test_actor_method_ordering(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return len(self.items)
+
+        def get_all(self):
+            return self.items
+
+    a = Appender.remote()
+    for i in range(20):
+        a.add.remote(i)
+    assert ray.get(a.get_all.remote()) == list(range(20))
+
+
+def test_actor_exception_keeps_actor_alive(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Fragile:
+        def fail(self):
+            raise ValueError("method error")
+
+        def ok(self):
+            return "alive"
+
+    f = Fragile.remote()
+    with pytest.raises(ray.TaskError):
+        ray.get(f.fail.remote())
+    assert ray.get(f.ok.remote()) == "alive"
+
+
+def test_actor_constructor_failure(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("init failed")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises((ray.TaskError, ray.ActorDiedError)):
+        ray.get(b.m.remote(), timeout=5)
+
+
+def test_named_actor(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Service:
+        def ping(self):
+            return "pong"
+
+    Service.options(name="svc").remote()
+    h = ray.get_actor("svc")
+    assert ray.get(h.ping.remote()) == "pong"
+    with pytest.raises(ValueError):
+        ray.get_actor("missing")
+
+
+def test_get_if_exists(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class S:
+        def __init__(self):
+            self.t = time.monotonic()
+
+        def created_at(self):
+            return self.t
+
+    a = S.options(name="singleton", get_if_exists=True).remote()
+    b = S.options(name="singleton", get_if_exists=True).remote()
+    assert ray.get(a.created_at.remote()) == ray.get(b.created_at.remote())
+
+
+def test_kill_actor(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray.get(v.ping.remote()) == "pong"
+    ray.kill(v)
+    time.sleep(0.3)
+    with pytest.raises(ray.ActorDiedError):
+        ray.get(v.ping.remote(), timeout=5)
+
+
+def test_exit_actor(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Quitter:
+        def quit(self):
+            ray.exit_actor()
+
+        def ping(self):
+            return "pong"
+
+    q = Quitter.remote()
+    assert ray.get(q.ping.remote()) == "pong"
+    q.quit.remote()
+    time.sleep(0.3)
+    with pytest.raises(ray.ActorDiedError):
+        ray.get(q.ping.remote(), timeout=5)
+
+
+def test_actor_handle_pickling(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def set(self, k, v):
+            self.v[k] = v
+
+        def get(self, k):
+            return self.v.get(k)
+
+    @ray.remote
+    def writer(handle, k, v):
+        import ray_tpu
+        ray_tpu.get(handle.set.remote(k, v))
+        return "done"
+
+    s = Store.remote()
+    ray.get(writer.remote(s, "x", 99))
+    assert ray.get(s.get.remote("x")) == 99
+
+
+def test_async_actor(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    w = AsyncWorker.remote()
+    refs = [w.work.remote(i) for i in range(10)]
+    assert ray.get(refs) == [i * 2 for i in range(10)]
+
+
+def test_async_actor_concurrency(ray_start):
+    ray = ray_start
+
+    @ray.remote(max_concurrency=8)
+    class Sleeper:
+        async def nap(self):
+            import asyncio
+            await asyncio.sleep(0.3)
+            return 1
+
+    s = Sleeper.remote()
+    t0 = time.monotonic()
+    refs = [s.nap.remote() for _ in range(8)]
+    assert sum(ray.get(refs)) == 8
+    # 8 naps of 0.3s run concurrently → far less than 2.4s serial time.
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_threaded_actor_concurrency(ray_start):
+    ray = ray_start
+
+    @ray.remote(max_concurrency=4)
+    class Blocking:
+        def nap(self):
+            time.sleep(0.3)
+            return 1
+
+    b = Blocking.remote()
+    t0 = time.monotonic()
+    assert sum(ray.get([b.nap.remote() for _ in range(4)])) == 4
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_actor_streaming_method(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Gen:
+        def stream(self, n):
+            for i in range(n):
+                yield i
+
+    g = Gen.remote()
+    it = g.stream.options(num_returns="streaming").remote(4)
+    assert [ray.get(r) for r in it] == [0, 1, 2, 3]
+
+
+def test_actor_resources_held_and_released(ray_start):
+    ray = ray_start
+
+    @ray.remote(num_cpus=2)
+    class Big:
+        def ping(self):
+            return 1
+
+    b = Big.remote()
+    ray.get(b.ping.remote())
+    avail = ray.available_resources()
+    assert avail.get("CPU", 0) == 2.0
+    ray.kill(b)
+    time.sleep(0.3)
+    avail = ray.available_resources()
+    assert avail.get("CPU", 0) == 4.0

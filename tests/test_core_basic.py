@@ -1,0 +1,324 @@
+"""Core task/object API tests.
+
+Mirrors the reference's basic test coverage
+(reference: python/ray/tests/test_basic.py and test_basic_2.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_put_get(ray_start):
+    ray = ray_start
+    ref = ray.put(42)
+    assert ray.get(ref) == 42
+    ref2 = ray.put({"a": [1, 2, 3]})
+    assert ray.get(ref2) == {"a": [1, 2, 3]}
+
+
+def test_put_get_numpy_roundtrip(ray_start):
+    ray = ray_start
+    arr = np.arange(1000, dtype=np.float32).reshape(10, 100)
+    ref = ray.put(arr)
+    out = ray.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_put_is_immutable_snapshot(ray_start):
+    ray = ray_start
+    d = {"x": 1}
+    ref = ray.put(d)
+    d["x"] = 2
+    assert ray.get(ref) == {"x": 1}
+
+
+def test_simple_task(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def f(x):
+        return x + 1
+
+    assert ray.get(f.remote(1)) == 2
+
+
+def test_task_with_kwargs_and_defaults(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray.get(f.remote(1)) == 111
+    assert ray.get(f.remote(1, 2, c=3)) == 6
+
+
+def test_task_dependency_chain(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(9):
+        ref = inc.remote(ref)
+    assert ray.get(ref) == 10
+
+
+def test_task_fanout_fanin(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def sq(x):
+        return x * x
+
+    @ray.remote
+    def total(*xs):
+        return sum(xs)
+
+    refs = [sq.remote(i) for i in range(10)]
+    assert ray.get(total.remote(*refs)) == sum(i * i for i in range(10))
+
+
+def test_num_returns(ray_start):
+    ray = ray_start
+
+    @ray.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray.get([a, b, c]) == [1, 2, 3]
+
+
+def test_num_returns_zero(ray_start):
+    ray = ray_start
+
+    @ray.remote(num_returns=0)
+    def nothing():
+        pass
+
+    assert nothing.remote() is None
+
+
+def test_options_override(ray_start):
+    ray = ray_start
+
+    @ray.remote(num_cpus=1)
+    def f():
+        return "ok"
+
+    assert ray.get(f.options(num_cpus=2, name="custom").remote()) == "ok"
+
+
+def test_task_error_propagates(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def boom():
+        raise ValueError("broken")
+
+    with pytest.raises(ray.TaskError) as ei:
+        ray.get(boom.remote())
+    assert "broken" in str(ei.value)
+
+
+def test_error_poisoning_through_dependents(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def boom():
+        raise ValueError("root cause")
+
+    @ray.remote
+    def dependent(x):
+        return x
+
+    with pytest.raises(ray.TaskError) as ei:
+        ray.get(dependent.remote(boom.remote()))
+    assert "root cause" in str(ei.value)
+
+
+def test_retry_exceptions(ray_start):
+    ray = ray_start
+    state = {"n": 0}
+    holder = ray.put(0)  # force a fresh closure each submit
+
+    attempts = []
+
+    @ray.remote(max_retries=3, retry_exceptions=True)
+    def flaky(marker):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RuntimeError("transient")
+        return "recovered"
+
+    assert ray.get(flaky.remote(holder)) == "recovered"
+    assert len(attempts) == 3
+
+
+def test_no_retry_by_default(ray_start):
+    ray = ray_start
+    attempts = []
+
+    @ray.remote
+    def flaky():
+        attempts.append(1)
+        raise RuntimeError("app error")
+
+    with pytest.raises(ray.TaskError):
+        ray.get(flaky.remote())
+    assert len(attempts) == 1
+
+
+def test_wait(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def fast():
+        return "fast"
+
+    @ray.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f] and not_ready == [s]
+
+
+def test_wait_timeout_empty(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def slow():
+        time.sleep(5)
+
+    r = slow.remote()
+    ready, not_ready = ray.wait([r], num_returns=1, timeout=0.1)
+    assert ready == [] and not_ready == [r]
+
+
+def test_get_timeout(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(ray.GetTimeoutError):
+        ray.get(slow.remote(), timeout=0.2)
+
+
+def test_nested_tasks(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def inner(x):
+        return x * 2
+
+    @ray.remote
+    def outer(x):
+        import ray_tpu
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray.get(outer.remote(10)) == 21
+
+
+def test_ref_passed_nested_in_container(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def make():
+        return 7
+
+    @ray.remote
+    def peek(container):
+        import ray_tpu
+        # Nested refs are NOT auto-resolved (reference semantics).
+        ref = container["ref"]
+        return ray_tpu.get(ref)
+
+    assert ray.get(peek.remote({"ref": make.remote()})) == 7
+
+
+def test_streaming_generator(ray_start):
+    ray = ray_start
+
+    @ray.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    out = [ray.get(ref) for ref in gen.remote(5)]
+    assert out == [0, 1, 4, 9, 16]
+
+
+def test_streaming_generator_error_mid_stream(ray_start):
+    ray = ray_start
+
+    @ray.remote(num_returns="streaming")
+    def gen():
+        yield 1
+        raise RuntimeError("mid-stream failure")
+
+    it = gen.remote()
+    refs = list(it)
+    assert ray.get(refs[0]) == 1
+    with pytest.raises(ray.TaskError):
+        ray.get(refs[1])
+
+
+def test_cancel_pending_task(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def blocker():
+        time.sleep(30)
+
+    @ray.remote
+    def never():
+        return 1
+
+    # Saturate the 4 CPUs so `never` stays queued, then cancel it.
+    blockers = [blocker.remote() for _ in range(4)]
+    time.sleep(0.2)
+    target = never.remote()
+    time.sleep(0.1)
+    ray.cancel(target)
+    with pytest.raises(ray.TaskCancelledError):
+        ray.get(target, timeout=5)
+    del blockers
+
+
+def test_cluster_resources(ray_start):
+    ray = ray_start
+    res = ray.cluster_resources()
+    assert res["CPU"] == 4.0
+
+
+def test_object_ref_identity_and_pickle(ray_start):
+    ray = ray_start
+    import pickle
+
+    ref = ray.put("hello")
+    ref2 = pickle.loads(pickle.dumps(ref))
+    assert ref == ref2
+    assert ray.get(ref2) == "hello"
+
+
+def test_timeline_events_recorded(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def f():
+        return 1
+
+    ray.get([f.remote() for _ in range(3)])
+    events = ray.timeline()
+    assert len(events) >= 3
+    assert all(ev["ph"] == "X" for ev in events)

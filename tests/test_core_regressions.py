@@ -1,0 +1,136 @@
+"""Regression tests for bugs found in review: resource accounting on actor
+death, PG bundle charging, nested-ref borrowing, name-collision leaks,
+async streaming termination, generator-table growth, actor restart,
+non-blocking pg.ready()."""
+
+import asyncio
+import gc
+import time
+
+import pytest
+
+from ray_tpu.core import runtime as _rt
+from ray_tpu.core.placement_group import (
+    placement_group,
+    remove_placement_group,
+)
+
+
+def test_kill_concurrent_actor_releases_resources_once(ray_start):
+    ray = ray_start
+
+    @ray.remote(num_cpus=1, max_concurrency=3)
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    ray.get(a.ping.remote())
+    ray.kill(a)
+    time.sleep(0.5)
+    assert ray.available_resources().get("CPU") == 4.0
+
+
+def test_pg_task_consumes_bundle_not_node(ray_start):
+    ray = ray_start
+    pg = placement_group([{"CPU": 4}], strategy="PACK")
+    assert pg.wait(timeout=5)
+
+    @ray.remote(num_cpus=1,
+                scheduling_strategy=ray.PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=0))
+    def inpg():
+        return "in-pg"
+
+    assert ray.get(inpg.remote(), timeout=5) == "in-pg"
+    remove_placement_group(pg)
+
+
+def test_nested_ref_borrow_released_on_container_delete(ray_start):
+    ray = ray_start
+    rt = _rt.global_runtime()
+    inner = ray.put("x" * 1000)
+    iid = inner.id()
+    outer = ray.put([inner])
+    del inner, outer
+    gc.collect()
+    time.sleep(0.3)
+    assert rt.reference_counter.count(iid) == 0
+    assert not rt.store.contains(iid)
+
+
+def test_duplicate_actor_name_leaks_nothing(ray_start):
+    ray = ray_start
+
+    @ray.remote(num_cpus=1)
+    class B:
+        def ping(self):
+            return 1
+
+    B.options(name="dup").remote()
+    with pytest.raises(ValueError):
+        B.options(name="dup").remote()
+    time.sleep(0.2)
+    assert ray.available_resources().get("CPU") == 3.0
+
+
+def test_async_iteration_over_streaming(ray_start):
+    ray = ray_start
+
+    @ray.remote(num_returns="streaming")
+    def gen():
+        yield 1
+        yield 2
+
+    async def drain():
+        out = []
+        async for ref in gen.remote():
+            out.append(ray.get(ref))
+        return out
+
+    assert asyncio.run(drain()) == [1, 2]
+
+
+def test_generator_table_bounded(ray_start):
+    ray = ray_start
+    rt = _rt.global_runtime()
+
+    @ray.remote(num_returns="streaming")
+    def gen():
+        yield 1
+
+    for _ in range(5):
+        list(gen.remote())
+    time.sleep(0.3)
+    assert len(rt._generators) <= 1
+
+
+def test_actor_restart(ray_start):
+    ray = ray_start
+
+    @ray.remote(max_restarts=2)
+    class R:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    r = R.remote()
+    assert ray.get(r.incr.remote()) == 1
+    ray.kill(r, no_restart=False)
+    time.sleep(0.5)
+    # Restarted with fresh state.
+    assert ray.get(r.incr.remote(), timeout=5) == 1
+    # Second restartable kill uses the last allowed restart.
+    ray.kill(r, no_restart=False)
+    time.sleep(0.5)
+    assert ray.get(r.incr.remote(), timeout=5) == 1
+
+
+def test_pg_ready_nonblocking(ray_start):
+    t0 = time.monotonic()
+    pg = placement_group([{"CPU": 99}], strategy="PACK")
+    pg.ready()
+    assert time.monotonic() - t0 < 1.0
