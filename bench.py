@@ -1,0 +1,113 @@
+"""Benchmark: flagship train-step throughput on the available accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/s", "vs_baseline": N}
+
+North-star metric (BASELINE.md): tokens/sec/chip training the BASELINE
+config-1 model (GPT-2-125M class). The reference publishes no tokens/sec
+number (SURVEY.md §6) — vs_baseline is the ratio against the previous
+recorded round in BENCH_HISTORY.json (1.0 on first measurement).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny config + fewer steps (smoke test)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=0, help="0 = auto")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import configs
+    from ray_tpu.parallel import ParallelPlan, make_mesh
+    from ray_tpu.train.step import (
+        init_state,
+        make_optimizer,
+        make_train_step,
+        shard_batch,
+    )
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform not in ("cpu",)
+    n_dev = len(devices)
+
+    if args.quick or not on_tpu:
+        cfg = configs.tiny_test()
+        batch, seq, steps = 8, 128, 5
+        metric = "tiny_train_tokens_per_sec_smoke"
+    else:
+        cfg = configs.gpt2_125m()
+        batch, seq, steps = (args.batch or 16), 1024, args.steps
+        metric = "gpt2_125m_train_tokens_per_sec_per_chip"
+
+    plan = ParallelPlan.auto(n_dev) if n_dev > 1 else ParallelPlan()
+    mesh = make_mesh(plan, devices=devices[:plan.num_devices])
+    opt = make_optimizer(lr=3e-4, warmup_steps=10, total_steps=10_000)
+
+    with jax.sharding.set_mesh(mesh):
+        state = init_state(cfg, mesh, opt, seed=0)
+        step_fn = make_train_step(cfg, opt)
+        k = jax.random.key(0)
+        tokens = jax.random.randint(k, (batch, seq), 0, cfg.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=1)
+        mask = jnp.ones_like(tokens, dtype=jnp.float32)
+        b = shard_batch(
+            {"t": tokens, "y": targets, "m": mask}, mesh)
+
+        # Warmup / compile.
+        state, m = step_fn(state, b["t"], b["y"], b["m"])
+        jax.block_until_ready(m["loss"])
+        state, m = step_fn(state, b["t"], b["y"], b["m"])
+        jax.block_until_ready(m["loss"])
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step_fn(state, b["t"], b["y"], b["m"])
+        jax.block_until_ready(m["loss"])
+        dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    per_chip = tokens_per_sec / max(1, plan.num_devices)
+
+    # vs_baseline: ratio to the previous recorded measurement.
+    hist_path = os.path.join(os.path.dirname(__file__), "BENCH_HISTORY.json")
+    history = []
+    if os.path.exists(hist_path):
+        try:
+            history = json.load(open(hist_path))
+        except Exception:  # noqa: BLE001
+            history = []
+    prev = next((h["value"] for h in reversed(history)
+                 if h.get("metric") == metric), None)
+    vs = (per_chip / prev) if prev else 1.0
+    history.append({
+        "metric": metric, "value": per_chip, "unit": "tokens/s/chip",
+        "ts": time.time(), "devices": n_dev,
+        "platform": devices[0].platform, "batch": batch, "seq": seq,
+    })
+    try:
+        json.dump(history, open(hist_path, "w"), indent=1)
+    except Exception:  # noqa: BLE001
+        pass
+
+    print(json.dumps({
+        "metric": metric,
+        "value": round(per_chip, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+from .transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_logical_axes,
+)
+from . import configs
+
+__all__ = [
+    "TransformerConfig", "init_params", "forward", "loss_fn",
+    "param_logical_axes", "configs",
+]

@@ -1,0 +1,63 @@
+"""Named model configs matching BASELINE.json's target families."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .transformer import TransformerConfig
+
+
+def tiny_test(vocab: int = 256) -> TransformerConfig:
+    """Milliseconds-scale config for unit tests (CPU mesh)."""
+    return TransformerConfig(
+        vocab_size=vocab, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False)
+
+
+def tiny_moe_test(vocab: int = 256) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=vocab, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False,
+        moe_experts=4, moe_top_k=2)
+
+
+def gpt2_125m() -> TransformerConfig:
+    """BASELINE config 1 (GPT-2 125M equivalent param count; rotary in
+    place of learned positions — TPU-first choice, same capability)."""
+    return TransformerConfig(
+        vocab_size=50304,  # padded to 128 multiple for MXU tiling
+        d_model=768, n_layers=12, n_heads=12, n_kv_heads=12, d_ff=3072,
+        max_seq_len=1024, tie_embeddings=True)
+
+
+def llama3_8b() -> TransformerConfig:
+    """BASELINE config 2 (Llama-3-8B shapes)."""
+    return TransformerConfig(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14336, max_seq_len=8192, rope_theta=500000.0,
+        tie_embeddings=False)
+
+
+def mixtral_8x7b() -> TransformerConfig:
+    """BASELINE config 3 (Mixtral 8×7B shapes, top-2 MoE)."""
+    return TransformerConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14336, max_seq_len=8192, rope_theta=1e6,
+        tie_embeddings=False, moe_experts=8, moe_top_k=2)
+
+
+NAMED = {
+    "tiny": tiny_test,
+    "tiny_moe": tiny_moe_test,
+    "gpt2-125m": gpt2_125m,
+    "llama3-8b": llama3_8b,
+    "mixtral-8x7b": mixtral_8x7b,
+}
+
+
+def get(name: str) -> TransformerConfig:
+    if name not in NAMED:
+        raise ValueError(f"Unknown config {name!r}; have {sorted(NAMED)}")
+    return NAMED[name]()

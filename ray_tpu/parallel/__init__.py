@@ -1,0 +1,15 @@
+from .mesh import MeshSpec, make_mesh, mesh_devices
+from .plan import ParallelPlan
+from .sharding import (
+    DEFAULT_RULES,
+    logical_to_mesh_axes,
+    logical_to_sharding,
+    shard_pytree,
+    with_sharding_constraint,
+)
+
+__all__ = [
+    "MeshSpec", "make_mesh", "mesh_devices", "ParallelPlan",
+    "DEFAULT_RULES", "logical_to_mesh_axes", "logical_to_sharding",
+    "shard_pytree", "with_sharding_constraint",
+]

@@ -1,0 +1,93 @@
+"""ParallelPlan — the composable parallelism declaration.
+
+This is new TPU-native capability (the reference delegates TP/PP/SP/EP to
+integrated frameworks — see SURVEY.md §5; reference Train provides only
+DP/FSDP via torch DDP/FSDP wrappers, train/torch/train_loop_utils.py:74).
+Here every axis is first-class: a single declaration
+
+    ParallelPlan(dp=2, fsdp=4, tp=2, sp=1, ep=1, pp=1)
+
+maps onto a jax.sharding.Mesh whose axes ride ICI (within a slice) and DCN
+(the `dcn` outer axis for multi-slice data parallelism), with XLA inserting
+the collectives.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ParallelPlan:
+    """Sizes of each parallelism axis.
+
+    dp    — pure data parallel (params replicated)
+    fsdp  — data parallel with sharded params/optimizer (ZeRO-3-style;
+            in XLA this is just sharding params over the axis and letting
+            the compiler all-gather per layer)
+    tp    — tensor parallel (megatron-style: shard heads/mlp)
+    sp    — sequence/context parallel (ring attention / all-to-all)
+    ep    — expert parallel (MoE expert sharding + all-to-all dispatch)
+    pp    — pipeline parallel (stage-per-actor over channels)
+    dcn   — outermost data-parallel axis across slices (multi-host DCN)
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+    dcn: int = 1
+
+    def __post_init__(self):
+        for name, v in self.axis_sizes().items():
+            if v < 1:
+                raise ValueError(f"axis {name} must be >=1, got {v}")
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"dcn": self.dcn, "dp": self.dp, "fsdp": self.fsdp,
+                "ep": self.ep, "sp": self.sp, "tp": self.tp}
+
+    @property
+    def num_devices(self) -> int:
+        """Devices needed per pipeline stage group."""
+        n = 1
+        for v in self.axis_sizes().values():
+            n *= v
+        return n
+
+    @property
+    def total_devices(self) -> int:
+        return self.num_devices * self.pp
+
+    @property
+    def mesh_axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.axis_sizes().keys())
+
+    @property
+    def mesh_shape(self) -> Tuple[int, ...]:
+        return tuple(self.axis_sizes().values())
+
+    @property
+    def data_axes(self) -> Tuple[str, ...]:
+        """Mesh axes the batch dimension is sharded over."""
+        return ("dcn", "dp", "fsdp", "ep")
+
+    def global_batch_divisor(self) -> int:
+        return self.dcn * self.dp * self.fsdp * self.ep
+
+    @classmethod
+    def auto(cls, n_devices: int, *, prefer: str = "fsdp") -> "ParallelPlan":
+        """Fill a single axis with all devices (the common default)."""
+        if prefer not in ("dp", "fsdp", "tp", "sp"):
+            raise ValueError(f"prefer must be an axis name: {prefer}")
+        return cls(**{prefer: n_devices})
+
+    def describe(self) -> str:
+        parts = [f"{k}={v}" for k, v in self.axis_sizes().items() if v > 1]
+        if self.pp > 1:
+            parts.append(f"pp={self.pp}")
+        return "ParallelPlan(" + (", ".join(parts) or "single-device") + ")"

@@ -1,0 +1,126 @@
+"""Logical-axis sharding rules.
+
+New TPU-native capability (no reference equivalent — the reference's
+sharding lives inside torch FSDP/DeepSpeed): model code annotates arrays
+with *logical* axis names ("batch", "embed", "heads", ...); a rule table
+maps logical axes → mesh axes; `logical_to_sharding` produces
+NamedShardings so the same model runs under any ParallelPlan unchanged.
+This is the t5x/maxtext-style pattern, the idiomatic way to drive pjit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+Rules = Sequence[Tuple[str, MeshAxes]]
+
+# Default rule table: how model-logical dimensions map onto plan axes.
+# Parameter axes and activation axes are distinct name spaces — the same
+# mesh axis (fsdp) shards parameters along their embed dim but shards
+# activations along batch, and one PartitionSpec may use a mesh axis only
+# once.
+#   batch   → all data-parallel axes (dcn outermost, then dp, fsdp, ep)
+#   embed   → fsdp (ZeRO-3-style parameter sharding; params only)
+#   heads/mlp/vocab → tp (megatron-style; params)
+#   act_*   → activation dims (act_mlp/act_heads ride tp; act_embed full)
+#   seq     → sp (sequence/context parallel)
+#   expert  → ep (MoE expert parallel)
+#   layers  → None (scanned layer dim stays replicated)
+DEFAULT_RULES: Rules = (
+    # activations
+    ("batch", ("dcn", "dp", "fsdp", "ep")),
+    ("seq", "sp"),
+    ("kv_seq", None),
+    ("act_embed", None),
+    ("act_mlp", "tp"),
+    ("act_heads", "tp"),
+    ("act_kv_heads", "tp"),
+    ("act_vocab", "tp"),
+    ("expert", "ep"),
+    # parameters
+    ("embed", "fsdp"),
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("mlp", "tp"),
+    ("vocab", "tp"),
+    ("expert_mlp", "tp"),
+    ("head_dim", None),
+    ("layers", None),
+    ("norm", None),
+)
+
+
+def logical_to_mesh_axes(
+    logical_axes: Optional[Tuple[Optional[str], ...]],
+    rules: Rules = DEFAULT_RULES,
+    mesh: Optional[Mesh] = None,
+) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec.
+
+    Axes not in the rules (or mapped to None) are unsharded. If a mesh is
+    given, mesh axes of size 1 are dropped (cheaper SPMD)."""
+    if logical_axes is None:
+        return P()
+    table: Dict[str, MeshAxes] = dict(rules)
+    spec: List[MeshAxes] = []
+    for ax in logical_axes:
+        if ax is None:
+            spec.append(None)
+            continue
+        target = table.get(ax)
+        if target is None:
+            spec.append(None)
+            continue
+        if mesh is not None:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if isinstance(target, tuple):
+                target = tuple(t for t in target if sizes.get(t, 1) > 1)
+                target = target if target else None
+            elif sizes.get(target, 1) <= 1:
+                target = None
+        spec.append(target)
+    return P(*spec)
+
+
+def logical_to_sharding(
+    logical_axes: Optional[Tuple[Optional[str], ...]],
+    mesh: Mesh,
+    rules: Rules = DEFAULT_RULES,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_mesh_axes(logical_axes, rules, mesh))
+
+
+def tree_shardings(logical_tree: Any, mesh: Mesh,
+                   rules: Rules = DEFAULT_RULES) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: logical_to_sharding(axes, mesh, rules),
+        logical_tree,
+        is_leaf=lambda x: x is None or (
+            isinstance(x, tuple)
+            and all(isinstance(e, (str, type(None))) for e in x)),
+    )
+
+
+def shard_pytree(tree: Any, logical_tree: Any, mesh: Mesh,
+                 rules: Rules = DEFAULT_RULES) -> Any:
+    """Device-put a pytree with shardings derived from its logical axes."""
+    shardings = tree_shardings(logical_tree, mesh, rules)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s), tree, shardings)
+
+
+def with_sharding_constraint(x: Any,
+                             logical_axes: Tuple[Optional[str], ...],
+                             rules: Rules = DEFAULT_RULES) -> Any:
+    """In-jit sharding annotation by logical axes. Uses the ambient mesh
+    (jax.sharding.use_mesh / mesh context) when present; no-op outside."""
+    try:
+        spec = logical_to_mesh_axes(logical_axes, rules)
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x  # no ambient mesh — single-device execution

@@ -1,0 +1,3 @@
+from .step import TrainState, init_state, make_optimizer, make_train_step
+
+__all__ = ["TrainState", "init_state", "make_optimizer", "make_train_step"]

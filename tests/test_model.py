@@ -1,0 +1,177 @@
+"""Transformer model + train-step tests (tiny configs, CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import configs
+from ray_tpu.models.transformer import (
+    forward,
+    init_params,
+    loss_fn,
+    param_logical_axes,
+)
+from ray_tpu.parallel import ParallelPlan, make_mesh
+from ray_tpu.train.step import (
+    init_state,
+    make_optimizer,
+    make_train_step,
+    shard_batch,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return configs.tiny_test()
+
+
+def _batch(cfg, key, batch=4, seq=32):
+    k1, k2 = jax.random.split(jax.random.key(key))
+    tokens = jax.random.randint(k1, (batch, seq), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.ones_like(tokens, dtype=jnp.float32)
+    return tokens, targets, mask
+
+
+def test_forward_shapes(tiny):
+    params = init_params(tiny, jax.random.key(0))
+    tokens, _, _ = _batch(tiny, 0)
+    logits, aux = forward(tiny, params, tokens)
+    assert logits.shape == (4, 32, tiny.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_param_axes_match_structure(tiny):
+    params = init_params(tiny, jax.random.key(0))
+    axes = param_logical_axes(tiny)
+    ps = jax.tree.structure(params)
+    As = jax.tree.structure(
+        axes, is_leaf=lambda x: x is None or isinstance(x, tuple))
+    assert ps == As
+    # rank of each axes tuple matches param rank
+    flat_p = jax.tree.leaves(params)
+    flat_a = jax.tree.leaves(
+        axes, is_leaf=lambda x: x is None or isinstance(x, tuple))
+    for p, a in zip(flat_p, flat_a):
+        assert len(a) == p.ndim, f"{p.shape} vs {a}"
+
+
+def test_causality(tiny):
+    """Changing a future token must not affect earlier logits."""
+    params = init_params(tiny, jax.random.key(0))
+    tokens, _, _ = _batch(tiny, 1, batch=1, seq=16)
+    logits1, _ = forward(tiny, params, tokens)
+    tokens2 = tokens.at[0, -1].set((tokens[0, -1] + 1) % tiny.vocab_size)
+    logits2, _ = forward(tiny, params, tokens2)
+    np.testing.assert_allclose(
+        np.asarray(logits1[0, :-1]), np.asarray(logits2[0, :-1]),
+        rtol=2e-4, atol=2e-4)
+
+
+def test_loss_decreases_single_device(tiny):
+    opt = make_optimizer(lr=1e-2, warmup_steps=1, total_steps=100)
+    params = init_params(tiny, jax.random.key(0))
+    state_params = params
+    opt_state = opt.init(params)
+
+    tokens, targets, mask = _batch(tiny, 0)
+
+    @jax.jit
+    def step(params, opt_state):
+        (_, m), g = jax.value_and_grad(
+            lambda p: loss_fn(tiny, p, tokens, targets, mask),
+            has_aux=True)(params)
+        upd, opt_state = opt.update(g, opt_state, params)
+        import optax
+        return optax.apply_updates(params, upd), opt_state, m
+
+    first = None
+    for i in range(10):
+        state_params, opt_state, m = step(state_params, opt_state)
+        if first is None:
+            first = float(m["loss"])
+    assert float(m["loss"]) < first
+
+
+def test_train_step_on_mesh_fsdp_tp(cpu_mesh8, tiny):
+    """Full train step under dp=2,fsdp=2,tp=2 on 8 virtual devices."""
+    plan = ParallelPlan(dp=2, fsdp=2, tp=2)
+    mesh = make_mesh(plan, devices=cpu_mesh8)
+    opt = make_optimizer(lr=1e-2, warmup_steps=1, total_steps=100)
+    with jax.sharding.set_mesh(mesh):
+        state = init_state(tiny, mesh, opt, seed=0)
+        step_fn = make_train_step(tiny, opt)
+        tokens, targets, mask = _batch(tiny, 0, batch=8, seq=32)
+        batch = shard_batch(
+            {"tokens": tokens, "targets": targets, "mask": mask}, mesh)
+        losses = []
+        for _ in range(5):
+            state, m = step_fn(
+                state, batch["tokens"], batch["targets"], batch["mask"])
+            losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 5
+    # FSDP actually sharded the embedding across fsdp axis.
+    emb = state.params["embed"]
+    assert any(
+        s.data.shape != emb.shape for s in emb.addressable_shards)
+
+
+def test_mesh_equals_single_device(tiny, cpu_mesh8):
+    """Sharded forward == unsharded forward (numerical SPMD parity)."""
+    params = init_params(tiny, jax.random.key(0))
+    tokens, targets, mask = _batch(tiny, 0, batch=8)
+    expected, _ = forward(tiny, params, tokens)
+
+    plan = ParallelPlan(dp=2, tp=2, fsdp=2)
+    mesh = make_mesh(plan, devices=cpu_mesh8)
+    from ray_tpu.parallel.sharding import shard_pytree
+    from ray_tpu.models.transformer import param_logical_axes
+    with jax.sharding.set_mesh(mesh):
+        sp = shard_pytree(params, param_logical_axes(tiny), mesh)
+        st = shard_batch({"tokens": tokens}, mesh)
+        got, _ = jax.jit(lambda p, t: forward(tiny, p, t))(sp, st["tokens"])
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=5e-4, atol=5e-4)
+
+
+def test_moe_forward_and_grad():
+    cfg = configs.tiny_moe_test()
+    params = init_params(cfg, jax.random.key(0))
+    tokens, targets, mask = _batch(cfg, 0)
+    logits, aux = forward(cfg, params, tokens)
+    assert logits.shape == (4, 32, cfg.vocab_size)
+    assert float(aux) > 0  # load-balance loss active
+
+    g = jax.grad(
+        lambda p: loss_fn(cfg, p, tokens, targets, mask)[0])(params)
+    gn = jax.tree.leaves(jax.tree.map(lambda x: float(jnp.sum(x * x)), g))
+    assert sum(gn) > 0
+
+
+def test_moe_on_ep_mesh(cpu_mesh8):
+    cfg = configs.tiny_moe_test()
+    plan = ParallelPlan(ep=4, dp=2)
+    mesh = make_mesh(plan, devices=cpu_mesh8)
+    opt = make_optimizer(lr=1e-2, warmup_steps=1, total_steps=50)
+    with jax.sharding.set_mesh(mesh):
+        state = init_state(cfg, mesh, opt, seed=0)
+        step_fn = make_train_step(cfg, opt)
+        tokens, targets, mask = _batch(cfg, 0, batch=8)
+        b = shard_batch(
+            {"t": tokens, "y": targets, "m": mask}, mesh)
+        # warmup lr(step0)=0 → first update is a no-op; compare over 3.
+        state, m1 = step_fn(state, b["t"], b["y"], b["m"])
+        state, _ = step_fn(state, b["t"], b["y"], b["m"])
+        state, m3 = step_fn(state, b["t"], b["y"], b["m"])
+    assert float(m3["loss"]) < float(m1["loss"])
+
+
+def test_num_params_accounting():
+    cfg = configs.gpt2_125m()
+    params = init_params(configs.tiny_test(), jax.random.key(0))
+    reported = cfg.num_params()
+    # ~124-163M with the padded vocab — sanity band.
+    assert 1.0e8 < reported < 2.0e8
