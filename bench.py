@@ -74,8 +74,9 @@ def main() -> None:
         t0 = time.perf_counter()
         for _ in range(steps):
             state, m = step_fn(state, b["t"], b["y"], b["m"])
-        jax.block_until_ready(m["loss"])
+        final_loss = float(m["loss"])  # host fetch = hard sync barrier
         dt = time.perf_counter() - t0
+        assert final_loss == final_loss, "non-finite loss"
 
     tokens_per_sec = batch * seq * steps / dt
     per_chip = tokens_per_sec / max(1, plan.num_devices)

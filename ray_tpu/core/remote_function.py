@@ -20,12 +20,14 @@ class RemoteFunction:
         self._func = func
         self._opts = validate_options(dict(opts), is_actor=False)
         self._descriptor = None
+        self._descriptor_runtime = None  # invalidate across shutdown/init
         functools.update_wrapper(self, func)
 
     def _get_descriptor(self):
-        if self._descriptor is None:
-            self._descriptor = global_runtime().function_manager.register(
-                self._func)
+        rt = global_runtime()
+        if self._descriptor is None or self._descriptor_runtime is not rt:
+            self._descriptor = rt.function_manager.register(self._func)
+            self._descriptor_runtime = rt
         return self._descriptor
 
     def __call__(self, *args, **kwargs):
@@ -43,6 +45,7 @@ class RemoteFunction:
         merged.update(opts)
         rf = RemoteFunction(self._func, merged)
         rf._descriptor = self._descriptor
+        rf._descriptor_runtime = self._descriptor_runtime
         return rf
 
     def bind(self, *args, **kwargs):

@@ -1,0 +1,26 @@
+from .block import Block, BlockAccessor
+from .dataset import Dataset
+from .iterator import DataIterator
+from .read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_images,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+    write_parquet,
+)
+
+__all__ = [
+    "Dataset", "DataIterator", "Block", "BlockAccessor",
+    "from_items", "from_pandas", "from_numpy", "from_arrow", "range",
+    "range_tensor", "read_parquet", "read_csv", "read_json", "read_text",
+    "read_binary_files", "read_numpy", "read_images", "write_parquet",
+]

@@ -1,0 +1,116 @@
+"""Blocks — the unit of distributed data.
+
+Capability-equivalent to the reference's block layer
+(reference: python/ray/data/_internal/arrow_block.py, pandas_block.py):
+a Block is a pyarrow Table; BlockAccessor adapts tables/dicts/pandas and
+formats batches (numpy/pandas/pyarrow/dict). TPU-first addition: numpy
+batches are produced as contiguous arrays ready for jax.device_put.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+BatchFormat = str  # "numpy" | "pandas" | "pyarrow" | "dict"
+
+
+def _to_table(data: Any) -> pa.Table:
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, dict):
+        cols = {}
+        for k, v in data.items():
+            v = np.asarray(v)
+            if v.ndim > 1:
+                # tensor column → fixed-shape list array
+                cols[k] = _tensor_to_arrow(v)
+            else:
+                cols[k] = pa.array(v)
+        return pa.table(cols)
+    try:
+        import pandas as pd
+
+        if isinstance(data, pd.DataFrame):
+            return pa.Table.from_pandas(data, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(data, list):
+        if not data:
+            return pa.table({})
+        if isinstance(data[0], dict):
+            return pa.Table.from_pylist(data)
+        return pa.table({"item": pa.array(data)})
+    raise TypeError(f"Cannot convert {type(data)} to a Block")
+
+
+def _tensor_to_arrow(arr: np.ndarray) -> pa.Array:
+    flat = arr.reshape(len(arr), -1)
+    inner = pa.list_(pa.from_numpy_dtype(arr.dtype), flat.shape[1])
+    values = pa.array(flat.reshape(-1))
+    storage = pa.FixedSizeListArray.from_arrays(values, flat.shape[1])
+    meta = {"shape": list(arr.shape[1:])}
+    return storage
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self.block = block
+
+    @classmethod
+    def for_block(cls, data: Any) -> "BlockAccessor":
+        return cls(_to_table(data))
+
+    def num_rows(self) -> int:
+        return self.block.num_rows
+
+    def size_bytes(self) -> int:
+        return self.block.nbytes
+
+    def schema(self):
+        return self.block.schema
+
+    def slice(self, start: int, end: int) -> Block:
+        return self.block.slice(start, end - start)
+
+    def to_batch(self, batch_format: BatchFormat = "numpy") -> Any:
+        if batch_format in ("numpy", "dict"):
+            out: Dict[str, np.ndarray] = {}
+            for name in self.block.column_names:
+                col = self.block.column(name)
+                if pa.types.is_fixed_size_list(col.type):
+                    width = col.type.list_size
+                    flat = col.combine_chunks().flatten().to_numpy(
+                        zero_copy_only=False)
+                    out[name] = flat.reshape(self.block.num_rows, width)
+                else:
+                    out[name] = col.to_numpy(zero_copy_only=False)
+            return out
+        if batch_format == "pandas":
+            return self.block.to_pandas()
+        if batch_format == "pyarrow":
+            return self.block
+        raise ValueError(f"Unknown batch_format {batch_format!r}")
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for row in self.block.to_pylist():
+            yield row
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    tables = [b for b in blocks if b.num_rows > 0]
+    if not tables:
+        return blocks[0] if blocks else pa.table({})
+    return pa.concat_tables(tables, promote_options="permissive")
+
+
+def split_block(block: Block, n: int) -> List[Block]:
+    rows = block.num_rows
+    per = max(1, rows // n)
+    out = []
+    for i in range(0, rows, per):
+        out.append(block.slice(i, min(per, rows - i)))
+    return out

@@ -1,0 +1,206 @@
+"""Dataset — the public lazy distributed data API.
+
+Capability-equivalent to the reference's Dataset
+(reference: python/ray/data/dataset.py:158 — map_batches :412,
+streaming_split :1272, iter_batches :3720, materialize :4694, plus
+map/filter/flat_map/limit/take/count/schema/repartition/random_shuffle/
+sort/union/split/zip surface): a chain of logical ops executed by the
+streaming executor on the task/actor runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .block import BlockAccessor, concat_blocks
+from .executor import execute
+from .iterator import DataIterator, SplitIterator, _SplitState
+from .plan import (
+    FromBlocks,
+    Limit,
+    LogicalOp,
+    MapLike,
+    RandomShuffle,
+    Read,
+    Repartition,
+    Sort,
+    Union,
+    _MapSpec,
+)
+
+
+class Dataset:
+    def __init__(self, op: LogicalOp):
+        self._op = op
+        self._materialized: Optional[List] = None
+
+    # ------------------------------------------------------------------
+    # Transforms (lazy)
+    # ------------------------------------------------------------------
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    compute: Optional[str] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None,
+                    num_cpus: float = 1, num_tpus: float = 0,
+                    concurrency: Optional[int] = None) -> "Dataset":
+        spec = _MapSpec("batches", fn, batch_size, batch_format,
+                        fn_constructor_args, fn_constructor_kwargs or {})
+        return Dataset(MapLike(self._op, spec, compute=compute,
+                               num_cpus=num_cpus, num_tpus=num_tpus,
+                               concurrency=concurrency))
+
+    def map(self, fn: Callable, **kwargs) -> "Dataset":
+        return Dataset(MapLike(self._op, _MapSpec("rows", fn), **_mk(kwargs)))
+
+    def filter(self, fn: Callable, **kwargs) -> "Dataset":
+        return Dataset(
+            MapLike(self._op, _MapSpec("filter", fn), **_mk(kwargs)))
+
+    def flat_map(self, fn: Callable, **kwargs) -> "Dataset":
+        return Dataset(MapLike(self._op, _MapSpec("flat", fn), **_mk(kwargs)))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def _add(batch):
+            batch[name] = fn(batch)
+            return batch
+        return self.map_batches(_add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def _drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+        return self.map_batches(_drop)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def _sel(batch):
+            return {k: batch[k] for k in cols}
+        return self.map_batches(_sel)
+
+    def limit(self, n: int) -> "Dataset":
+        return Dataset(Limit(self._op, n))
+
+    def repartition(self, n: int) -> "Dataset":
+        return Dataset(Repartition(self._op, n))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return Dataset(RandomShuffle(self._op, seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return Dataset(Sort(self._op, key, descending))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return Dataset(Union(self._op, [o._op for o in others]))
+
+    # ------------------------------------------------------------------
+    # Execution
+    # ------------------------------------------------------------------
+    def _refs(self) -> Iterator:
+        if self._materialized is not None:
+            return iter(self._materialized)
+        return execute(self._op)
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        return DataIterator(self._refs).iter_batches(**kwargs)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        return DataIterator(self._refs).iter_rows()
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._refs)
+
+    def streaming_split(self, n: int, *, equal: bool = True
+                        ) -> List[SplitIterator]:
+        """One shared streaming execution feeding n consumers
+        (reference: dataset.py:1272)."""
+        state = _SplitState(self._refs(), n, equal)
+        return [SplitIterator(state, i) for i in range(n)]
+
+    def materialize(self) -> "Dataset":
+        from .. import get as ray_get, put as ray_put
+
+        blocks = [ray_put(ray_get(r)) for r in self._refs()]
+        out = Dataset(FromBlocks(blocks, "materialized"))
+        out._materialized = blocks
+        return out
+
+    # -- consumption ----------------------------------------------------
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        from .. import get as ray_get
+
+        total = 0
+        for ref in self._refs():
+            total += BlockAccessor.for_block(ray_get(ref)).num_rows()
+        return total
+
+    def schema(self):
+        from .. import get as ray_get
+
+        for ref in self._refs():
+            block = ray_get(ref)
+            if block.num_rows or block.schema.names:
+                return block.schema
+        return None
+
+    def to_pandas(self):
+        from .. import get as ray_get
+
+        blocks = [ray_get(r) for r in self._refs()]
+        return concat_blocks(blocks).to_pandas()
+
+    def split(self, n: int) -> List["Dataset"]:
+        from .. import get as ray_get, put as ray_put
+
+        blocks = [ray_get(r) for r in self._refs()]
+        merged = concat_blocks(blocks)
+        rows = merged.num_rows
+        per = rows // n
+        out = []
+        start = 0
+        for i in range(n):
+            end = rows if i == n - 1 else start + per
+            ref = ray_put(merged.slice(start, end - start))
+            d = Dataset(FromBlocks([ref], f"split_{i}"))
+            d._materialized = [ref]
+            out.append(d)
+            start = end
+        return out
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        from .. import get as ray_get, put as ray_put
+        import pyarrow as pa
+
+        a = concat_blocks([ray_get(r) for r in self._refs()])
+        b = concat_blocks([ray_get(r) for r in other._refs()])
+        n = min(a.num_rows, b.num_rows)
+        a, b = a.slice(0, n), b.slice(0, n)
+        cols = {}
+        for name in a.column_names:
+            cols[name] = a.column(name)
+        for name in b.column_names:
+            key = name if name not in cols else f"{name}_1"
+            cols[key] = b.column(name)
+        ref = ray_put(pa.table(cols))
+        d = Dataset(FromBlocks([ref], "zip"))
+        d._materialized = [ref]
+        return d
+
+    def __repr__(self):
+        return f"Dataset({' -> '.join(op.name for op in self._op.chain())})"
+
+
+def _mk(kwargs):
+    return {k: v for k, v in kwargs.items()
+            if k in ("compute", "num_cpus", "num_tpus", "concurrency")}

@@ -1,0 +1,275 @@
+"""Streaming executor: logical plan → pipelined remote tasks.
+
+Capability-equivalent to the reference's streaming execution
+(reference: python/ray/data/_internal/execution/streaming_executor.py:57
+StreamingExecutor and operators/ — TaskPoolMapOperator submitting one
+remote task per block bundle :64, ActorPoolMapOperator for stateful UDFs,
+bounded in-flight for backpressure): blocks flow through operator stages
+as ObjectRefs; each map stage keeps at most `max_in_flight` tasks
+outstanding; stateful (class) UDFs run on a reusable actor pool.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .. import get as ray_get, put as ray_put, remote, wait as ray_wait
+from ..core.object_ref import ObjectRef
+from .block import BlockAccessor, concat_blocks, split_block
+from .plan import (
+    FromBlocks,
+    Limit,
+    LogicalOp,
+    MapLike,
+    RandomShuffle,
+    Read,
+    Repartition,
+    Sort,
+    Union,
+    _MapSpec,
+)
+
+DEFAULT_MAX_IN_FLIGHT = 8
+
+
+# ---------------------------------------------------------------------------
+# Remote transforms
+# ---------------------------------------------------------------------------
+
+def _apply_specs(block, specs: List[_MapSpec], fns: List[Any]):
+    import pyarrow as pa
+
+    for spec, fn in zip(specs, fns):
+        acc = BlockAccessor.for_block(block)
+        if spec.kind == "batches":
+            if spec.batch_size and acc.num_rows() > spec.batch_size:
+                outs = []
+                for i in range(0, acc.num_rows(), spec.batch_size):
+                    sub = acc.slice(i, min(i + spec.batch_size,
+                                           acc.num_rows()))
+                    batch = BlockAccessor.for_block(sub).to_batch(
+                        spec.batch_format)
+                    outs.append(BlockAccessor.for_block(fn(batch)).block)
+                block = concat_blocks(outs)
+            else:
+                batch = acc.to_batch(spec.batch_format)
+                block = BlockAccessor.for_block(fn(batch)).block
+        elif spec.kind == "rows":
+            rows = [fn(r) for r in acc.iter_rows()]
+            block = BlockAccessor.for_block(rows).block
+        elif spec.kind == "filter":
+            rows = [r for r in acc.iter_rows() if fn(r)]
+            block = (BlockAccessor.for_block(rows).block
+                     if rows else acc.block.slice(0, 0))
+        elif spec.kind == "flat":
+            rows = [o for r in acc.iter_rows() for o in fn(r)]
+            block = BlockAccessor.for_block(rows).block
+        else:
+            raise ValueError(spec.kind)
+    return block
+
+
+def _build_fns(specs: List[_MapSpec]) -> List[Any]:
+    fns = []
+    for spec in specs:
+        fn = spec.fn
+        if isinstance(fn, type):  # class UDF → construct once
+            fn_obj = fn(*spec.fn_constructor_args,
+                        **spec.fn_constructor_kwargs)
+            fns.append(fn_obj)
+        else:
+            fns.append(fn)
+    return fns
+
+
+@remote
+def _map_block_task(block, specs: List[_MapSpec]):
+    return _apply_specs(block, specs, _build_fns(specs))
+
+
+@remote
+def _read_task(fn):
+    block = fn()
+    return BlockAccessor.for_block(block).block
+
+
+class _MapWorker:
+    """Actor-pool worker: constructs class UDFs once, reuses across blocks
+    (reference: ActorPoolMapOperator)."""
+
+    def __init__(self, specs: List[_MapSpec]):
+        self.specs = specs
+        self.fns = _build_fns(specs)
+
+    def apply(self, block):
+        return _apply_specs(block, self.specs, self.fns)
+
+
+# ---------------------------------------------------------------------------
+# Streaming stages
+# ---------------------------------------------------------------------------
+
+def _stage_read(op: Read, max_in_flight: int) -> Iterator[ObjectRef]:
+    window: deque = deque()
+    tasks = iter(op.read_tasks)
+    try:
+        while True:
+            while len(window) < max_in_flight:
+                fn = next(tasks, None)
+                if fn is None:
+                    break
+                window.append(_read_task.remote(ray_put(fn)))
+            if not window:
+                return
+            yield window.popleft()
+    finally:
+        pass
+
+
+def _stage_map_tasks(op: MapLike, upstream: Iterator[ObjectRef],
+                     max_in_flight: int) -> Iterator[ObjectRef]:
+    window: deque = deque()
+    specs_ref = ray_put(op.specs)
+    opts: Dict[str, Any] = {"num_cpus": op.num_cpus}
+    if op.num_tpus:
+        opts["num_tpus"] = op.num_tpus
+    task = _map_block_task.options(**opts)
+    limit = op.concurrency or max_in_flight
+    for ref in upstream:
+        window.append(task.remote(ref, specs_ref))
+        if len(window) >= limit:
+            yield window.popleft()
+    while window:
+        yield window.popleft()
+
+
+def _stage_map_actors(op: MapLike, upstream: Iterator[ObjectRef],
+                      max_in_flight: int) -> Iterator[ObjectRef]:
+    from .. import kill as ray_kill
+
+    pool_size = op.concurrency or 2
+    Worker = remote(num_cpus=op.num_cpus,
+                    num_tpus=op.num_tpus or None)(_MapWorker)
+    actors = [Worker.remote(op.specs) for _ in range(pool_size)]
+    try:
+        window: deque = deque()
+        i = 0
+        for ref in upstream:
+            actor = actors[i % pool_size]
+            i += 1
+            window.append(actor.apply.remote(ref))
+            if len(window) >= max_in_flight:
+                yield window.popleft()
+        while window:
+            yield window.popleft()
+    finally:
+        for a in actors:
+            try:
+                ray_kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _stage_limit(op: Limit, upstream: Iterator[ObjectRef]
+                 ) -> Iterator[ObjectRef]:
+    remaining = op.n
+    for ref in upstream:
+        if remaining <= 0:
+            return
+        block = ray_get(ref)
+        rows = BlockAccessor.for_block(block).num_rows()
+        if rows <= remaining:
+            remaining -= rows
+            yield ref
+        else:
+            yield ray_put(block.slice(0, remaining))
+            remaining = 0
+            return
+
+
+def _stage_repartition(op: Repartition, upstream: Iterator[ObjectRef]
+                       ) -> Iterator[ObjectRef]:
+    blocks = [ray_get(r) for r in upstream]
+    merged = concat_blocks(blocks) if blocks else None
+    if merged is None:
+        return
+    rows = merged.num_rows
+    per = max(1, rows // op.n)
+    start = 0
+    for i in range(op.n):
+        end = rows if i == op.n - 1 else min(start + per, rows)
+        if start >= end and i < op.n - 1:
+            continue
+        yield ray_put(merged.slice(start, end - start))
+        start = end
+
+
+def _stage_shuffle(op: RandomShuffle, upstream: Iterator[ObjectRef]
+                   ) -> Iterator[ObjectRef]:
+    rng = np.random.RandomState(op.seed)
+    blocks = [ray_get(r) for r in upstream]
+    if not blocks:
+        return
+    merged = concat_blocks(blocks)
+    perm = rng.permutation(merged.num_rows)
+    shuffled = merged.take(perm)
+    for piece in split_block(shuffled, max(1, len(blocks))):
+        yield ray_put(piece)
+
+
+def _stage_sort(op: Sort, upstream: Iterator[ObjectRef]
+                ) -> Iterator[ObjectRef]:
+    blocks = [ray_get(r) for r in upstream]
+    if not blocks:
+        return
+    merged = concat_blocks(blocks)
+    order = "descending" if op.descending else "ascending"
+    yield ray_put(merged.sort_by([(op.key, order)]))
+
+
+def execute(root: LogicalOp, *, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+            ) -> Iterator[ObjectRef]:
+    """Compile the logical chain into a lazy pipelined iterator of block
+    refs. Backpressure = bounded windows per map/read stage."""
+    from .plan import optimize
+
+    stream: Optional[Iterator[ObjectRef]] = None
+    for op in optimize(root).chain():
+        if isinstance(op, Read):
+            stream = _stage_read(op, max_in_flight)
+        elif isinstance(op, FromBlocks):
+            def _emit(blocks=op.blocks):
+                for b in blocks:
+                    yield b if isinstance(b, ObjectRef) else ray_put(b)
+            stream = _emit()
+        elif isinstance(op, MapLike):
+            if op.compute == "actors" or (
+                    op.compute is None and any(
+                        isinstance(s.fn, type) for s in op.specs)):
+                stream = _stage_map_actors(op, stream, max_in_flight)
+            else:
+                stream = _stage_map_tasks(op, stream, max_in_flight)
+        elif isinstance(op, Limit):
+            stream = _stage_limit(op, stream)
+        elif isinstance(op, Repartition):
+            stream = _stage_repartition(op, stream)
+        elif isinstance(op, RandomShuffle):
+            stream = _stage_shuffle(op, stream)
+        elif isinstance(op, Sort):
+            stream = _stage_sort(op, stream)
+        elif isinstance(op, Union):
+            def _union(main=stream, others=op.others):
+                for r in main:
+                    yield r
+                for other in others:
+                    for r in execute(other, max_in_flight=max_in_flight):
+                        yield r
+            stream = _union()
+        else:
+            raise ValueError(f"Unknown op {op}")
+    assert stream is not None
+    return stream

@@ -1,0 +1,130 @@
+"""Logical plan + rule-based optimizer.
+
+Capability-equivalent to the reference's plan layer
+(reference: python/ray/data/_internal/logical/ — logical operators,
+optimizers.py rewrite rules; planner/ logical→physical): datasets are a
+chain of logical ops; optimization fuses adjacent row/batch transforms so
+one task does the whole fused stage per block (the reference's operator
+fusion rule).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class LogicalOp:
+    """Base logical operator; `parent` forms the chain."""
+
+    def __init__(self, parent: Optional["LogicalOp"], name: str):
+        self.parent = parent
+        self.name = name
+
+    def chain(self) -> List["LogicalOp"]:
+        ops: List[LogicalOp] = []
+        op: Optional[LogicalOp] = self
+        while op is not None:
+            ops.append(op)
+            op = op.parent
+        return list(reversed(ops))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name})"
+
+
+class Read(LogicalOp):
+    def __init__(self, read_tasks: List[Callable[[], Any]], name: str):
+        super().__init__(None, name)
+        self.read_tasks = read_tasks
+
+
+class FromBlocks(LogicalOp):
+    def __init__(self, blocks: List[Any], name: str = "from_blocks"):
+        super().__init__(None, name)
+        self.blocks = blocks
+
+
+@dataclass
+class _MapSpec:
+    kind: str                     # "batches" | "rows" | "filter" | "flat"
+    fn: Any                       # callable or (cls, args, kwargs)
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+    fn_constructor_args: Tuple = ()
+    fn_constructor_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+class MapLike(LogicalOp):
+    def __init__(self, parent: LogicalOp, spec: _MapSpec, *,
+                 compute: Optional[Any] = None,
+                 num_cpus: float = 1, num_tpus: float = 0,
+                 concurrency: Optional[int] = None):
+        super().__init__(parent, f"Map[{spec.kind}]")
+        self.specs = [spec]
+        self.compute = compute
+        self.num_cpus = num_cpus
+        self.num_tpus = num_tpus
+        self.concurrency = concurrency
+
+    def can_fuse_with(self, other: "MapLike") -> bool:
+        return (self.compute is None and other.compute is None
+                and self.num_tpus == other.num_tpus == 0)
+
+
+class Limit(LogicalOp):
+    def __init__(self, parent: LogicalOp, n: int):
+        super().__init__(parent, f"Limit[{n}]")
+        self.n = n
+
+
+class Repartition(LogicalOp):
+    def __init__(self, parent: LogicalOp, n: int):
+        super().__init__(parent, f"Repartition[{n}]")
+        self.n = n
+
+
+class RandomShuffle(LogicalOp):
+    def __init__(self, parent: LogicalOp, seed: Optional[int]):
+        super().__init__(parent, "RandomShuffle")
+        self.seed = seed
+
+
+class Union(LogicalOp):
+    def __init__(self, parent: LogicalOp, others: List[LogicalOp]):
+        super().__init__(parent, "Union")
+        self.others = others
+
+
+class Sort(LogicalOp):
+    def __init__(self, parent: LogicalOp, key: str, descending: bool):
+        super().__init__(parent, f"Sort[{key}]")
+        self.key = key
+        self.descending = descending
+
+
+def optimize(root: LogicalOp) -> LogicalOp:
+    """Fuse adjacent MapLike ops (reference: operator fusion rule in
+    data/_internal/logical/rules/operator_fusion.py)."""
+    ops = root.chain()
+    out: List[LogicalOp] = []
+    for op in ops:
+        if (out and isinstance(op, MapLike) and isinstance(out[-1], MapLike)
+                and out[-1].can_fuse_with(op)):
+            prev = out[-1]
+            fused = MapLike(prev.parent, prev.specs[0],
+                            compute=prev.compute, num_cpus=prev.num_cpus,
+                            num_tpus=prev.num_tpus,
+                            concurrency=prev.concurrency)
+            fused.specs = prev.specs + op.specs
+            fused.name = f"Fused[{'+'.join(s.kind for s in fused.specs)}]"
+            out[-1] = fused
+        else:
+            out.append(op)
+    # Re-link the chain.
+    prev = None
+    for op in out:
+        op.parent = prev
+        prev = op
+    return out[-1]

@@ -1,0 +1,206 @@
+"""Dataset creation: in-memory builders + file readers.
+
+Capability-equivalent to the reference's read API
+(reference: python/ray/data/read_api.py read_* builders and
+data/datasource/ — parquet/csv/json/text/binary/images readers; the
+Datasource/Datasink ABCs live in datasource.py here)."""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .block import BlockAccessor
+from .dataset import Dataset
+from .plan import FromBlocks, Read
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pat = os.path.join(p, f"**/*{suffix or ''}")
+            out.extend(sorted(_glob.glob(pat, recursive=True)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    return [p for p in out if os.path.isfile(p)]
+
+
+def _reader_dataset(paths: List[str], read_one: Callable[[str], Any],
+                    name: str, parallelism: int = -1) -> Dataset:
+    if not paths:
+        raise ValueError(f"{name}: no input files found")
+    tasks = [(lambda p=p: read_one(p)) for p in paths]
+    return Dataset(Read(tasks, name))
+
+
+# ---------------------------------------------------------------------------
+# In-memory
+# ---------------------------------------------------------------------------
+
+def from_items(items: List[Any]) -> Dataset:
+    rows = [i if isinstance(i, dict) else {"item": i} for i in items]
+    block = BlockAccessor.for_block(rows).block
+    return Dataset(FromBlocks([block], "from_items"))
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    per = max(1, n // max(1, parallelism))
+    tasks = []
+    start = 0
+    while start < n:
+        end = min(start + per, n)
+        tasks.append(lambda s=start, e=end: {"id": np.arange(s, e)})
+        start = end
+    return Dataset(Read(tasks, f"range({n})"))
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Dataset:
+    per = max(1, n // max(1, parallelism))
+    tasks = []
+    start = 0
+    while start < n:
+        end = min(start + per, n)
+
+        def mk(s=start, e=end):
+            count = e - s
+            data = np.broadcast_to(
+                np.arange(s, e).reshape((count,) + (1,) * len(shape)),
+                (count,) + tuple(shape)).copy()
+            return {"data": data}
+
+        tasks.append(mk)
+        start = end
+    return Dataset(Read(tasks, f"range_tensor({n})"))
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset(FromBlocks(
+        [BlockAccessor.for_block(df).block], "from_pandas"))
+
+
+def from_numpy(arr: np.ndarray, column: str = "data") -> Dataset:
+    return Dataset(FromBlocks(
+        [BlockAccessor.for_block({column: arr}).block], "from_numpy"))
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset(FromBlocks([table], "from_arrow"))
+
+
+# ---------------------------------------------------------------------------
+# Files
+# ---------------------------------------------------------------------------
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 parallelism: int = -1) -> Dataset:
+    import pyarrow.parquet as pq
+
+    files = _expand_paths(paths, ".parquet")
+
+    def read_one(path):
+        return pq.read_table(path, columns=columns)
+
+    return _reader_dataset(files, read_one, "read_parquet")
+
+
+def read_csv(paths, *, parallelism: int = -1, **csv_kwargs) -> Dataset:
+    import pyarrow.csv as pacsv
+
+    files = _expand_paths(paths, ".csv")
+
+    def read_one(path):
+        return pacsv.read_csv(path)
+
+    return _reader_dataset(files, read_one, "read_csv")
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    import pyarrow.json as pajson
+
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        return pajson.read_json(path)
+
+    return _reader_dataset(files, read_one, "read_json")
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        with open(path, "r") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return {"text": np.array(lines, dtype=object)}
+
+    return _reader_dataset(files, read_one, "read_text")
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      parallelism: int = -1) -> Dataset:
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        with open(path, "rb") as f:
+            data = f.read()
+        row: Dict[str, Any] = {"bytes": [data]}
+        if include_paths:
+            row["path"] = [path]
+        return row
+
+    return _reader_dataset(files, read_one, "read_binary_files")
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    files = _expand_paths(paths, ".npy")
+
+    def read_one(path):
+        return {"data": np.load(path)}
+
+    return _reader_dataset(files, read_one, "read_numpy")
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                parallelism: int = -1) -> Dataset:
+    """Image reader (ViT/CLIP ingest path, BASELINE config 4). Decodes
+    via PIL when available; raw-npy fallback."""
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        try:
+            from PIL import Image
+
+            img = Image.open(path).convert("RGB")
+            if size is not None:
+                img = img.resize(size)
+            return {"image": np.asarray(img)[None]}
+        except ImportError:
+            return {"image": np.load(path)[None]}
+
+    return _reader_dataset(files, read_one, "read_images")
+
+
+# ---------------------------------------------------------------------------
+# Datasink
+# ---------------------------------------------------------------------------
+
+def write_parquet(ds: Dataset, path: str) -> List[str]:
+    import pyarrow.parquet as pq
+    from .. import get as ray_get
+
+    os.makedirs(path, exist_ok=True)
+    written = []
+    for i, ref in enumerate(ds._refs()):
+        block = ray_get(ref)
+        out = os.path.join(path, f"part-{i:05d}.parquet")
+        pq.write_table(block, out)
+        written.append(out)
+    return written

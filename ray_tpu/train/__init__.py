@@ -1,3 +1,12 @@
+from .checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .session import get_context, get_dataset_shard, get_mesh, report
 from .step import TrainState, init_state, make_optimizer, make_train_step
+from .trainer import Result, TpuTrainer
 
-__all__ = ["TrainState", "init_state", "make_optimizer", "make_train_step"]
+__all__ = [
+    "TpuTrainer", "Result", "ScalingConfig", "RunConfig", "FailureConfig",
+    "CheckpointConfig", "Checkpoint", "CheckpointManager", "save_pytree",
+    "load_pytree", "report", "get_context", "get_dataset_shard", "get_mesh",
+    "TrainState", "init_state", "make_optimizer", "make_train_step",
+]

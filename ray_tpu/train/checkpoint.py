@@ -1,0 +1,178 @@
+"""Checkpoints: directory-based handles + top-K retention + jax pytree IO.
+
+Capability-equivalent to the reference's checkpoint stack
+(reference: python/ray/train/_checkpoint.py:55 Checkpoint,
+train/_internal/checkpoint_manager.py top-K retention,
+train/_internal/storage.py StorageContext): a Checkpoint is a directory;
+the manager persists/retains; pytree state rides orbax when available
+(async-capable), with a numpy .npz fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Checkpoint:
+    """A directory full of state (reference: train/_checkpoint.py:55)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_pytree(cls, tree: Any, path: Optional[str] = None
+                    ) -> "Checkpoint":
+        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        save_pytree(tree, path)
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_pytree(self) -> Any:
+        return load_pytree(self.path)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+# ---------------------------------------------------------------------------
+# Pytree IO (orbax preferred, npz fallback)
+# ---------------------------------------------------------------------------
+
+def save_pytree(tree: Any, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        target = os.path.join(path, "state")
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        ckptr.save(target, tree)
+        return
+    except Exception:  # noqa: BLE001 — fall back to npz
+        pass
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree.flatten(tree)
+    np.savez(
+        os.path.join(path, "state.npz"),
+        **{str(i): np.asarray(x) for i, x in enumerate(leaves)})
+    with open(os.path.join(path, "treedef.json"), "w") as f:
+        json.dump({"n": len(leaves)}, f)
+    import pickle
+
+    with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+        pickle.dump(treedef, f)
+
+
+def load_pytree(path: str, like: Any = None) -> Any:
+    orbax_dir = os.path.join(path, "state")
+    if os.path.isdir(orbax_dir):
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(orbax_dir)
+        if like is not None:
+            import jax
+            return jax.tree.unflatten(
+                jax.tree.structure(like), jax.tree.leaves(restored))
+        return restored
+    import pickle
+
+    import jax
+    import numpy as np
+
+    with open(os.path.join(path, "treedef.pkl"), "rb") as f:
+        treedef = pickle.load(f)
+    data = np.load(os.path.join(path, "state.npz"))
+    leaves = [data[str(i)] for i in range(len(data.files))]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Manager
+# ---------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Persist reported checkpoints under storage_path; keep top-K
+    (reference: train/_internal/checkpoint_manager.py)."""
+
+    def __init__(self, storage_path: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._lock = threading.Lock()
+        self._records: List[Dict[str, Any]] = []
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Dict[str, Any]) -> Checkpoint:
+        with self._lock:
+            idx = len(self._records)
+            dest = os.path.join(self.storage_path, f"checkpoint_{idx:06d}")
+            if os.path.abspath(checkpoint.path) != dest:
+                if os.path.exists(dest):
+                    shutil.rmtree(dest)
+                shutil.copytree(checkpoint.path, dest)
+            rec = {"path": dest, "metrics": dict(metrics),
+                   "ts": time.time(), "index": idx}
+            self._records.append(rec)
+            self._evict_locked()
+            self._write_manifest_locked()
+            return Checkpoint(dest)
+
+    def _score(self, rec) -> float:
+        if not self.score_attribute:
+            return rec["index"]
+        v = rec["metrics"].get(self.score_attribute)
+        if v is None:
+            return float("-inf")
+        return v if self.score_order == "max" else -v
+
+    def _evict_locked(self):
+        if not self.num_to_keep:
+            return
+        alive = [r for r in self._records if os.path.exists(r["path"])]
+        if len(alive) <= self.num_to_keep:
+            return
+        alive.sort(key=self._score)
+        for rec in alive[: len(alive) - self.num_to_keep]:
+            shutil.rmtree(rec["path"], ignore_errors=True)
+
+    def _write_manifest_locked(self):
+        manifest = [
+            {k: r[k] for k in ("path", "metrics", "ts", "index")}
+            for r in self._records if os.path.exists(r["path"])
+        ]
+        with open(os.path.join(self.storage_path, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1, default=str)
+
+    def latest(self) -> Optional[Checkpoint]:
+        with self._lock:
+            for rec in reversed(self._records):
+                if os.path.exists(rec["path"]):
+                    return Checkpoint(rec["path"])
+        return None
+
+    def best(self) -> Optional[Checkpoint]:
+        with self._lock:
+            alive = [r for r in self._records if os.path.exists(r["path"])]
+            if not alive:
+                return None
+            return Checkpoint(max(alive, key=self._score)["path"])

@@ -1,0 +1,70 @@
+"""Shared training configs.
+
+Capability-equivalent to the reference's AIR configs
+(reference: python/ray/air/config.py — ScalingConfig :101,
+FailureConfig :377, CheckpointConfig :427, RunConfig :576), extended
+TPU-first: ScalingConfig carries a ParallelPlan and slice topology rather
+than GPU counts.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..parallel.plan import ParallelPlan
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers and how the model is parallelized.
+
+    num_workers   — SPMD worker processes (hosts on a pod; actors locally)
+    tpus_per_worker — chips each worker drives (0 = CPU worker)
+    plan          — in-framework parallelism declaration (dp/fsdp/tp/sp/ep);
+                    replaces the reference's use_gpu/NCCL wiring
+    slice_id      — gang-schedule all workers onto one ICI slice
+    """
+
+    num_workers: int = 1
+    tpus_per_worker: float = 0
+    cpus_per_worker: float = 1
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    plan: Optional[ParallelPlan] = None
+    slice_id: Optional[str] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        r = {"CPU": self.cpus_per_worker}
+        if self.tpus_per_worker:
+            r["TPU"] = self.tpus_per_worker
+        r.update(self.resources_per_worker)
+        return r
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+
+    def resolve_storage(self) -> str:
+        base = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        name = self.name or "run"
+        return os.path.join(base, name)

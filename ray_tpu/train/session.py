@@ -1,0 +1,111 @@
+"""Per-worker training session.
+
+Capability-equivalent to the reference's _TrainSession
+(reference: python/ray/train/_internal/session.py — report /
+get_dataset_shard :464, world rank/size accessors): the user's
+train_loop_per_worker calls `ray_tpu.train.report(metrics, checkpoint=...)`
+and reads its context/mesh/dataset shard from here.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ReportItem:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Any] = None  # Checkpoint
+    rank: int = 0
+
+
+class TrainContext:
+    def __init__(self, rank: int, world_size: int, session: "_TrainSession"):
+        self._rank = rank
+        self._world = world_size
+        self._session = session
+
+    def get_world_rank(self) -> int:
+        return self._rank
+
+    def get_world_size(self) -> int:
+        return self._world
+
+    def get_trial_name(self) -> str:
+        return self._session.name
+
+
+class _TrainSession:
+    def __init__(self, rank: int, world_size: int, name: str,
+                 loop_config: Optional[Dict[str, Any]] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 plan=None):
+        self.rank = rank
+        self.world_size = world_size
+        self.name = name
+        self.loop_config = loop_config or {}
+        self.dataset_shards = dataset_shards or {}
+        self.plan = plan
+        self.queue: "queue.Queue[Optional[ReportItem]]" = queue.Queue()
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def report(self, metrics: Dict[str, Any], checkpoint=None):
+        self.queue.put(ReportItem(dict(metrics), checkpoint, self.rank))
+
+    def mesh(self):
+        """Build the worker's mesh from the ScalingConfig plan (local
+        devices; on a multi-host pod jax.distributed makes jax.devices()
+        span hosts — same code path)."""
+        from ..parallel.mesh import make_mesh
+        from ..parallel.plan import ParallelPlan
+        import jax
+
+        plan = self.plan or ParallelPlan.auto(len(jax.devices()))
+        return make_mesh(plan)
+
+
+_local = threading.local()
+
+
+def _set_session(s: Optional[_TrainSession]):
+    _local.session = s
+
+
+def _get_session() -> Optional[_TrainSession]:
+    return getattr(_local, "session", None)
+
+
+def report(metrics: Dict[str, Any], checkpoint=None) -> None:
+    s = _get_session()
+    if s is None:
+        raise RuntimeError(
+            "ray_tpu.train.report() called outside a training session")
+    s.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("No active training session")
+    return TrainContext(s.rank, s.world_size, s)
+
+
+def get_dataset_shard(name: str = "train"):
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("No active training session")
+    if name not in s.dataset_shards:
+        raise KeyError(
+            f"No dataset shard {name!r}; have {sorted(s.dataset_shards)}")
+    return s.dataset_shards[name]
+
+
+def get_mesh():
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("No active training session")
+    return s.mesh()
