@@ -23,14 +23,33 @@ def _to_table(data: Any) -> pa.Table:
         return data
     if isinstance(data, dict):
         cols = {}
+        fields = []
         for k, v in data.items():
+            if isinstance(v, list) and v and isinstance(
+                    v[0], (bytes, bytearray)):
+                # bytes must NOT round-trip through numpy ('S' dtype
+                # strips trailing \x00s) — go straight to arrow binary.
+                arr = pa.array(v, type=pa.binary())
+                cols[k] = arr
+                fields.append(pa.field(k, arr.type))
+                continue
             v = np.asarray(v)
             if v.ndim > 1:
-                # tensor column → fixed-shape list array
-                cols[k] = _tensor_to_arrow(v)
+                arr, shape = _tensor_to_arrow(v)
+                cols[k] = arr
+                fields.append(pa.field(
+                    k, arr.type,
+                    metadata={b"tensor_shape": _shape_bytes(shape)}))
             else:
-                cols[k] = pa.array(v)
-        return pa.table(cols)
+                if v.dtype.kind == "S":
+                    arr = pa.array(
+                        [bytes(x) for x in v.tolist()], type=pa.binary())
+                else:
+                    arr = pa.array(v)
+                cols[k] = arr
+                fields.append(pa.field(k, arr.type))
+        return pa.Table.from_arrays(
+            list(cols.values()), schema=pa.schema(fields))
     try:
         import pandas as pd
 
@@ -47,13 +66,21 @@ def _to_table(data: Any) -> pa.Table:
     raise TypeError(f"Cannot convert {type(data)} to a Block")
 
 
-def _tensor_to_arrow(arr: np.ndarray) -> pa.Array:
+def _shape_bytes(shape) -> bytes:
+    return ",".join(str(int(s)) for s in shape).encode()
+
+
+def _shape_from_bytes(b: bytes):
+    return tuple(int(x) for x in b.decode().split(",") if x)
+
+
+def _tensor_to_arrow(arr: np.ndarray):
+    """N-d tensor column → fixed-size-list array + per-row shape (stored
+    in the field metadata so to_batch can restore the original rank)."""
     flat = arr.reshape(len(arr), -1)
-    inner = pa.list_(pa.from_numpy_dtype(arr.dtype), flat.shape[1])
     values = pa.array(flat.reshape(-1))
     storage = pa.FixedSizeListArray.from_arrays(values, flat.shape[1])
-    meta = {"shape": list(arr.shape[1:])}
-    return storage
+    return storage, arr.shape[1:]
 
 
 class BlockAccessor:
@@ -79,13 +106,24 @@ class BlockAccessor:
     def to_batch(self, batch_format: BatchFormat = "numpy") -> Any:
         if batch_format in ("numpy", "dict"):
             out: Dict[str, np.ndarray] = {}
-            for name in self.block.column_names:
+            for i, name in enumerate(self.block.column_names):
                 col = self.block.column(name)
+                field = self.block.schema.field(i)
                 if pa.types.is_fixed_size_list(col.type):
                     width = col.type.list_size
                     flat = col.combine_chunks().flatten().to_numpy(
                         zero_copy_only=False)
-                    out[name] = flat.reshape(self.block.num_rows, width)
+                    meta = field.metadata or {}
+                    if b"tensor_shape" in meta:
+                        shape = _shape_from_bytes(meta[b"tensor_shape"])
+                        out[name] = flat.reshape(
+                            (self.block.num_rows,) + shape)
+                    else:
+                        out[name] = flat.reshape(self.block.num_rows, width)
+                elif pa.types.is_binary(col.type) or \
+                        pa.types.is_large_binary(col.type):
+                    out[name] = np.array(
+                        col.to_pylist(), dtype=object)
                 else:
                     out[name] = col.to_numpy(zero_copy_only=False)
             return out

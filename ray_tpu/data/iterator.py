@@ -144,6 +144,7 @@ class _SplitState:
             target=self._feed, args=(ref_iter,), daemon=True,
             name="streaming-split-feeder")
         self._started = False
+        self._exhausted = [False] * n  # re-iteration returns empty, no hang
         self._lock = threading.Lock()
 
     def _ensure_started(self):
@@ -166,11 +167,18 @@ class _SplitState:
                 q.put(self.DONE)
 
     def consume(self, index: int):
+        with self._lock:
+            if self._exhausted[index]:
+                # A streaming split is single-pass (one shared execution);
+                # a second epoch sees an empty stream rather than a hang.
+                return
         self._ensure_started()
         q = self.queues[index]
         while True:
             item = q.get()
             if item is self.DONE:
+                with self._lock:
+                    self._exhausted[index] = True
                 return
             if isinstance(item, BaseException):
                 raise item

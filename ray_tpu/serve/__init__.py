@@ -1,0 +1,22 @@
+from .api import (
+    delete,
+    get_deployment_handle,
+    run,
+    shutdown,
+    status,
+)
+from .batching import batch, multiplexed
+from .deployment import (
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    DeploymentConfig,
+    deployment,
+)
+from .handle import DeploymentHandle
+
+__all__ = [
+    "deployment", "Deployment", "DeploymentConfig", "AutoscalingConfig",
+    "Application", "run", "delete", "shutdown", "status",
+    "get_deployment_handle", "DeploymentHandle", "batch", "multiplexed",
+]

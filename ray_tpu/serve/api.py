@@ -1,0 +1,115 @@
+"""serve public API.
+
+Capability-equivalent to the reference's API module
+(reference: python/ray/serve/api.py — serve.run :449, serve.delete,
+serve.shutdown, serve.status, get_deployment_handle): deploys an
+Application graph onto the controller, wiring nested bound deployments
+into DeploymentHandles, and optionally exposes the ingress over HTTP.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .. import get_actor, kill as ray_kill, remote
+from .controller import ServeController
+from .deployment import Application, Deployment
+from .handle import DeploymentHandle
+from .proxy import HttpProxy
+
+_CONTROLLER_NAME = "serve::controller"
+_lock = threading.Lock()
+_proxy: Optional[HttpProxy] = None
+
+
+def _get_or_create_controller():
+    try:
+        return get_actor(_CONTROLLER_NAME)
+    except ValueError:
+        Controller = remote(num_cpus=0, max_concurrency=32)(ServeController)
+        return Controller.options(
+            name=_CONTROLLER_NAME, get_if_exists=True).remote()
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = None,
+        blocking: bool = False,
+        http: bool = False, http_port: int = 8000) -> DeploymentHandle:
+    """Deploy the application; returns the ingress handle
+    (reference: serve/api.py:449)."""
+    global _proxy
+    if not isinstance(app, Application):
+        raise TypeError("serve.run expects a bound Application "
+                        "(deployment.bind(...))")
+    controller = _get_or_create_controller()
+
+    # Deploy dependencies first; replace nested Applications in init args
+    # with handles to their deployments.
+    handles: Dict[int, DeploymentHandle] = {}
+    for node in app.flatten():
+        init_args = tuple(
+            handles[id(a)] if isinstance(a, Application) else a
+            for a in node.init_args)
+        init_kwargs = {
+            k: handles[id(v)] if isinstance(v, Application) else v
+            for k, v in node.init_kwargs.items()}
+        from .. import get as ray_get
+
+        ray_get(controller.deploy.remote(
+            node.deployment, init_args, init_kwargs))
+        handles[id(node)] = DeploymentHandle(
+            controller, node.deployment.name)
+
+    ingress = handles[id(app)]
+    if http:
+        with _lock:
+            if _proxy is None:
+                _proxy = HttpProxy(port=http_port)
+                _proxy.start()
+            _proxy.add_route(route_prefix or name, ingress)
+    return ingress
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    controller = get_actor(_CONTROLLER_NAME)
+    return DeploymentHandle(controller, deployment_name)
+
+
+def status() -> Dict[str, Any]:
+    from .. import get as ray_get
+
+    try:
+        controller = get_actor(_CONTROLLER_NAME)
+    except ValueError:
+        return {}
+    return ray_get(controller.status.remote())
+
+
+def delete(name: str):
+    from .. import get as ray_get
+
+    controller = get_actor(_CONTROLLER_NAME)
+    ray_get(controller.delete.remote(name))
+    if _proxy is not None:
+        _proxy.remove_route(name)
+
+
+def shutdown():
+    global _proxy
+    from .. import get as ray_get
+
+    try:
+        controller = get_actor(_CONTROLLER_NAME)
+    except ValueError:
+        controller = None
+    if controller is not None:
+        try:
+            ray_get(controller.shutdown.remote(), timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        ray_kill(controller)
+    if _proxy is not None:
+        _proxy.stop()
+        _proxy = None

@@ -1,0 +1,162 @@
+"""@serve.batch — transparent request batching.
+
+Capability-equivalent to the reference's batching
+(reference: python/ray/serve/batching.py:65 _BatchQueue + @serve.batch):
+calls buffer up to max_batch_size or batch_wait_timeout_s, then the
+wrapped function runs once on the list of requests; each caller gets its
+element back. On TPU this is what keeps the MXU fed with batched matmuls
+instead of batch-1 calls.
+
+Implementation note: the wrappers close over only picklable config (the
+queue/lock state lives in a process-local registry), so batched methods
+survive cloudpickle into replica actors.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# Process-local state registries (never pickled — module globals).
+_registry_lock = threading.Lock()
+_batch_queues: Dict[Tuple[int, str], "_BatchQueue"] = {}
+_mux_caches: Dict[Tuple[int, str], "_MuxCache"] = {}
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self._lock = threading.Lock()
+        self._items: List[Any] = []
+        self._futures: List[Future] = []
+        self._timer: Optional[threading.Timer] = None
+
+    def submit(self, item, instance=None) -> Future:
+        fut: Future = Future()
+        flush_now = False
+        with self._lock:
+            self._items.append(item)
+            self._futures.append(fut)
+            if len(self._items) >= self.max_batch_size:
+                flush_now = True
+            elif self._timer is None:
+                self._timer = threading.Timer(
+                    self.timeout, self._flush, args=(instance,))
+                self._timer.daemon = True
+                self._timer.start()
+        if flush_now:
+            self._flush(instance)
+        return fut
+
+    def _flush(self, instance=None):
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            items, futures = self._items, self._futures
+            self._items, self._futures = [], []
+        if not items:
+            return
+        try:
+            if instance is not None:
+                results = self.fn(instance, items)
+            else:
+                results = self.fn(items)
+            if len(results) != len(items):
+                raise ValueError(
+                    f"@serve.batch function returned {len(results)} results "
+                    f"for {len(items)} inputs")
+            for f, r in zip(futures, results):
+                f.set_result(r)
+        except BaseException as e:  # noqa: BLE001
+            for f in futures:
+                if not f.done():
+                    f.set_exception(e)
+
+
+def _get_batch_queue(fn, instance, max_batch_size, timeout) -> _BatchQueue:
+    key = (id(instance) if instance is not None else 0,
+           getattr(fn, "__qualname__", repr(fn)))
+    with _registry_lock:
+        q = _batch_queues.get(key)
+        if q is None:
+            q = _BatchQueue(fn, max_batch_size, timeout)
+            _batch_queues[key] = q
+        return q
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: fn(self, items: list) -> list (method) or
+    fn(items) -> list (function). Callers invoke with a single item and
+    block for their single result."""
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def wrapper(*args):
+            if len(args) == 2:          # bound method call: (self, item)
+                instance, item = args
+            else:
+                (item,) = args
+                instance = None
+            q = _get_batch_queue(
+                fn, instance, max_batch_size, batch_wait_timeout_s)
+            return q.submit(item, instance).result()
+
+        wrapper._ray_tpu_batched = True
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
+class _MuxCache:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.lock = threading.Lock()
+        self.cache: Dict[str, Any] = {}
+        self.order: List[str] = []
+
+
+def multiplexed(_fn: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """@serve.multiplexed — per-replica LRU model cache
+    (reference: serve/api.py:569 + serve/multiplex.py)."""
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def wrapper(*args):
+            *head, model_id = args
+            instance = head[0] if head else None
+            key = (id(instance) if instance is not None else 0,
+                   getattr(fn, "__qualname__", repr(fn)))
+            with _registry_lock:
+                state = _mux_caches.get(key)
+                if state is None:
+                    state = _MuxCache(max_num_models_per_replica)
+                    _mux_caches[key] = state
+            with state.lock:
+                if model_id in state.cache:
+                    state.order.remove(model_id)
+                    state.order.append(model_id)
+                    return state.cache[model_id]
+            model = fn(*args)
+            with state.lock:
+                state.cache[model_id] = model
+                state.order.append(model_id)
+                while len(state.order) > state.capacity:
+                    evict = state.order.pop(0)
+                    state.cache.pop(evict, None)
+            return model
+
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,179 @@
+"""Serve control plane.
+
+Capability-equivalent to the reference's controller
+(reference: python/ray/serve/_private/controller.py:89 ServeController,
+run_control_loop :346; deployment_state.py:1212 DeploymentState replica
+FSM + should_autoscale :1268; autoscaling_policy.py): reconciles target
+deployment configs to live replica actors, runs the autoscaling loop on
+ongoing-request metrics, performs rolling updates on redeploy."""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import get as ray_get, kill as ray_kill, remote
+from .deployment import AutoscalingConfig, Deployment
+from .replica import Replica
+
+
+class _ReplicaSet:
+    def __init__(self, deployment: Deployment):
+        import cloudpickle
+
+        self.deployment = deployment
+        self.target_bytes = cloudpickle.dumps(deployment.target)
+        self.replicas: List[Any] = []      # actor handles
+        self.version = 0
+        now = time.monotonic()
+        self._last_scale_up = now
+        self._last_scale_down = now
+
+    def scale_to(self, n: int, init_args=(), init_kwargs=None):
+        cfg = self.deployment.config
+        ReplicaActor = remote(
+            max_concurrency=cfg.max_concurrency,
+            **_actor_opts(cfg.ray_actor_options))(Replica)
+        while len(self.replicas) < n:
+            self.replicas.append(ReplicaActor.remote(
+                self.target_bytes, tuple(init_args), init_kwargs or {},
+                cfg.user_config))
+        while len(self.replicas) > n:
+            victim = self.replicas.pop()
+            try:
+                ray_kill(victim)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def ongoing(self) -> int:
+        total = 0
+        for r in list(self.replicas):
+            try:
+                total += ray_get(r.stats.remote(), timeout=1.0)["ongoing"]
+            except Exception:  # noqa: BLE001
+                pass
+        return total
+
+
+def _actor_opts(ray_actor_options: Dict[str, Any]) -> Dict[str, Any]:
+    opts = {}
+    for k in ("num_cpus", "num_tpus", "resources"):
+        if k in ray_actor_options:
+            opts[k] = ray_actor_options[k]
+    if "num_cpus" not in opts:
+        opts["num_cpus"] = 0.1
+    return opts
+
+
+class ServeController:
+    """Runs as a named detached actor ("serve::controller")."""
+
+    def __init__(self):
+        self._sets: Dict[str, _ReplicaSet] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._loop = threading.Thread(
+            target=self._control_loop, daemon=True, name="serve-control")
+        self._loop.start()
+
+    # -- deploy / delete -------------------------------------------------
+    def deploy(self, deployment: Deployment, init_args=(),
+               init_kwargs=None) -> str:
+        with self._lock:
+            name = deployment.name
+            existing = self._sets.get(name)
+            cfg = deployment.config
+            n = (cfg.autoscaling_config.min_replicas
+                 if cfg.autoscaling_config else cfg.num_replicas)
+            if existing is None:
+                rs = _ReplicaSet(deployment)
+                rs.init_args = tuple(init_args)
+                rs.init_kwargs = init_kwargs or {}
+                rs.scale_to(n, init_args, init_kwargs)
+                self._sets[name] = rs
+            else:
+                # Rolling update: replace replicas with the new version
+                # (reference: DeploymentState rolling updates).
+                existing.deployment = deployment
+                import cloudpickle
+
+                existing.target_bytes = cloudpickle.dumps(deployment.target)
+                existing.init_args = tuple(init_args)
+                existing.init_kwargs = init_kwargs or {}
+                existing.version += 1
+                old = existing.replicas
+                existing.replicas = []
+                existing.scale_to(n, init_args, init_kwargs)
+                for r in old:
+                    try:
+                        ray_kill(r)
+                    except Exception:  # noqa: BLE001
+                        pass
+            return name
+
+    def delete(self, name: str):
+        with self._lock:
+            rs = self._sets.pop(name, None)
+        if rs:
+            rs.scale_to(0)
+
+    def shutdown(self):
+        self._stop.set()
+        with self._lock:
+            names = list(self._sets)
+        for n in names:
+            self.delete(n)
+
+    # -- discovery -------------------------------------------------------
+    def get_replicas(self, name: str):
+        with self._lock:
+            rs = self._sets.get(name)
+            if rs is None:
+                raise KeyError(f"No deployment {name!r}")
+            return list(rs.replicas), rs.version
+
+    def list_deployments(self) -> List[str]:
+        with self._lock:
+            return list(self._sets)
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "replicas": len(rs.replicas),
+                    "version": rs.version,
+                    "deployment": rs.deployment.name,
+                }
+                for name, rs in self._sets.items()
+            }
+
+    # -- autoscaling -----------------------------------------------------
+    def _control_loop(self):
+        while not self._stop.wait(0.25):
+            with self._lock:
+                sets = list(self._sets.values())
+            for rs in sets:
+                asc = rs.deployment.config.autoscaling_config
+                if asc is None:
+                    continue
+                try:
+                    self._autoscale(rs, asc)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _autoscale(self, rs: _ReplicaSet, asc: AutoscalingConfig):
+        ongoing = rs.ongoing()
+        current = len(rs.replicas)
+        desired = math.ceil(ongoing / max(asc.target_ongoing_requests, 1e-9))
+        desired = max(asc.min_replicas, min(asc.max_replicas, desired))
+        now = time.monotonic()
+        if desired > current:
+            if now - rs._last_scale_up >= asc.upscale_delay_s:
+                rs.scale_to(desired, rs.init_args, rs.init_kwargs)
+                rs._last_scale_up = now
+        elif desired < current:
+            if now - rs._last_scale_down >= asc.downscale_delay_s:
+                rs.scale_to(desired, rs.init_args, rs.init_kwargs)
+                rs._last_scale_down = now

@@ -1,0 +1,132 @@
+"""Deployment declaration + application graph.
+
+Capability-equivalent to the reference's deployment surface
+(reference: python/ray/serve/api.py:262 @serve.deployment,
+serve/deployment.py Deployment; autoscaling config from
+serve/_private/autoscaling_policy.py): a Deployment wraps a class or
+function with replica/autoscaling/resource config; `.bind(...)` produces
+an Application node (possibly with other bound deployments as arguments,
+forming the app DAG).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    user_config: Optional[Dict[str, Any]] = None
+    max_concurrency: int = 16
+
+
+class Deployment:
+    def __init__(self, target: Callable, name: str,
+                 config: DeploymentConfig):
+        self.target = target
+        self.name = name
+        self.config = config
+
+    def options(self, *, num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                autoscaling_config: Optional[Any] = None,
+                ray_actor_options: Optional[Dict[str, Any]] = None,
+                user_config: Optional[Dict[str, Any]] = None,
+                name: Optional[str] = None) -> "Deployment":
+        import copy
+
+        cfg = copy.deepcopy(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if autoscaling_config is not None:
+            if isinstance(autoscaling_config, dict):
+                autoscaling_config = AutoscalingConfig(**autoscaling_config)
+            cfg.autoscaling_config = autoscaling_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = ray_actor_options
+        if user_config is not None:
+            cfg.user_config = user_config
+        return Deployment(self.target, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def __repr__(self):
+        return f"Deployment({self.name})"
+
+
+class Application:
+    """A bound deployment; args may contain other Applications (the
+    composition DAG — reference: serve app graphs)."""
+
+    def __init__(self, deployment: Deployment, args: Tuple, kwargs: Dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+    def dependencies(self) -> List["Application"]:
+        out = []
+        for a in list(self.init_args) + list(self.init_kwargs.values()):
+            if isinstance(a, Application):
+                out.append(a)
+        return out
+
+    def flatten(self) -> List["Application"]:
+        """Topological order, dependencies first."""
+        seen: Dict[int, Application] = {}
+        order: List[Application] = []
+
+        def visit(app: "Application"):
+            if id(app) in seen:
+                return
+            seen[id(app)] = app
+            for dep in app.dependencies():
+                visit(dep)
+            order.append(app)
+
+        visit(self)
+        return order
+
+
+def deployment(target: Optional[Callable] = None, *,
+               name: Optional[str] = None, num_replicas: int = 1,
+               max_ongoing_requests: int = 100,
+               autoscaling_config: Optional[Any] = None,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               user_config: Optional[Dict[str, Any]] = None,
+               max_concurrency: int = 16):
+    """@serve.deployment decorator (class or function)."""
+
+    def wrap(t):
+        asc = autoscaling_config
+        if isinstance(asc, dict):
+            asc = AutoscalingConfig(**asc)
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=asc,
+            ray_actor_options=ray_actor_options or {},
+            user_config=user_config,
+            max_concurrency=max_concurrency,
+        )
+        return Deployment(t, name or t.__name__, cfg)
+
+    if target is not None:
+        return wrap(target)
+    return wrap

@@ -1,0 +1,141 @@
+"""DeploymentHandle + router.
+
+Capability-equivalent to the reference's handle/router pair
+(reference: python/ray/serve/handle.py:827 DeploymentHandle,
+serve/_private/router.py:924 Router with
+PowerOfTwoChoicesReplicaScheduler :295 — two random replicas probed,
+lower queue length wins; local ongoing-request accounting)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import get as ray_get
+
+
+class Router:
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._lock = threading.Lock()
+        self._ongoing: Dict[Any, int] = {}
+        self._rng = random.Random()
+
+    def _refresh(self):
+        replicas, version = ray_get(
+            self._controller.get_replicas.remote(self._name))
+        with self._lock:
+            self._replicas = replicas
+            self._version = version
+            self._ongoing = {id(r): self._ongoing.get(id(r), 0)
+                             for r in replicas}
+            self._by_id = {id(r): r for r in replicas}
+
+    def pick(self):
+        """Power-of-two-choices on local ongoing counts."""
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            self._refresh()
+            with self._lock:
+                replicas = list(self._replicas)
+            if not replicas:
+                raise RuntimeError(
+                    f"Deployment {self._name!r} has no replicas")
+        if len(replicas) == 1:
+            chosen = replicas[0]
+        else:
+            a, b = self._rng.sample(replicas, 2)
+            with self._lock:
+                chosen = (a if self._ongoing.get(id(a), 0)
+                          <= self._ongoing.get(id(b), 0) else b)
+        with self._lock:
+            self._ongoing[id(chosen)] = self._ongoing.get(id(chosen), 0) + 1
+        return chosen
+
+    def done(self, replica):
+        with self._lock:
+            if id(replica) in self._ongoing:
+                self._ongoing[id(replica)] = max(
+                    0, self._ongoing[id(replica)] - 1)
+
+    def maybe_refresh(self):
+        try:
+            self._refresh()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class _ResponseFuture:
+    """Wraps the underlying ObjectRef; `.result()` / ray-get-able."""
+
+    def __init__(self, ref, router: Router, replica):
+        self._ref = ref
+        self._router = router
+        self._replica = replica
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None):
+        try:
+            return ray_get(self._ref, timeout=timeout)
+        finally:
+            self._mark()
+
+    def _mark(self):
+        if not self._done:
+            self._done = True
+            self._router.done(self._replica)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, controller, deployment_name: str,
+                 method_name: str = "__call__", stream: bool = False):
+        self._controller = controller
+        self._name = deployment_name
+        self._method = method_name
+        self._stream = stream
+        self._router = Router(controller, deployment_name)
+
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self._controller, self._name,
+            method_name or self._method,
+            self._stream if stream is None else stream)
+        h._router = self._router
+        return h
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs):
+        self._router.maybe_refresh()
+        replica = self._router.pick()
+        method = "__call__" if self._method == "__call__" else self._method
+        if self._stream:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming").remote(method, args, kwargs)
+            self._router.done(replica)
+            return gen
+        ref = replica.handle_request.remote(method, args, kwargs)
+        fut = _ResponseFuture(ref, self._router, replica)
+        # Auto-release the slot when the result lands (async accounting).
+        from ..core.runtime import global_runtime
+
+        global_runtime().store.on_ready(ref.id(), lambda _oid: fut._mark())
+        return fut
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self._controller, self._name, self._method, self._stream))

@@ -1,0 +1,101 @@
+"""HTTP ingress proxy (aiohttp).
+
+Capability-equivalent to the reference's proxy
+(reference: python/ray/serve/_private/proxy.py:1100 ProxyActor /
+HTTPProxy :764 — per-node ASGI server routing requests to deployment
+handles, with streaming responses): routes `/<app_name>` (POST/GET,
+JSON body) to the app's ingress handle.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+
+class HttpProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._routes: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._started = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._runner = None
+
+    def add_route(self, prefix: str, handle):
+        with self._lock:
+            self._routes[prefix.strip("/")] = handle
+
+    def remove_route(self, prefix: str):
+        with self._lock:
+            self._routes.pop(prefix.strip("/"), None)
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._serve, daemon=True, name="serve-http-proxy")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("HTTP proxy failed to start")
+
+    def stop(self):
+        if self._loop is not None:
+            loop = self._loop
+
+            async def _shutdown():
+                await self._runner.cleanup()
+                loop.stop()
+
+            asyncio.run_coroutine_threadsafe(_shutdown(), loop)
+            self._thread = None
+
+    def _serve(self):
+        from aiohttp import web
+
+        async def handler(request: "web.Request"):
+            name = request.match_info.get("app", "").strip("/")
+            with self._lock:
+                handle = self._routes.get(name)
+            if handle is None:
+                return web.json_response(
+                    {"error": f"no app {name!r}"}, status=404)
+            if request.method == "POST":
+                try:
+                    payload = await request.json()
+                except json.JSONDecodeError:
+                    payload = (await request.read()).decode()
+            else:
+                payload = dict(request.query)
+            loop = asyncio.get_running_loop()
+            try:
+                fut = handle.remote(payload)
+                result = await loop.run_in_executor(
+                    None, lambda: fut.result(timeout=30))
+            except BaseException as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": str(e)[:500]}, status=500)
+            try:
+                return web.json_response({"result": result})
+            except TypeError:
+                return web.json_response({"result": str(result)})
+
+        async def health(_request):
+            return web.json_response({"status": "ok"})
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        app = web.Application()
+        app.router.add_route("*", "/-/healthz", health)
+        app.router.add_route("*", "/{app:.*}", handler)
+        self._runner = web.AppRunner(app)
+        loop.run_until_complete(self._runner.setup())
+        site = web.TCPSite(self._runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._started.set()
+        loop.run_forever()

@@ -1,0 +1,77 @@
+"""Replica actor.
+
+Capability-equivalent to the reference's ReplicaActor
+(reference: python/ray/serve/_private/replica.py:252 — user callable
+hosting, handle_request / handle_request_streaming :489, ongoing-request
+accounting feeding autoscaling, reconfigure via user_config)."""
+
+from __future__ import annotations
+
+import inspect
+import threading
+from typing import Any, Dict, Optional
+
+
+class Replica:
+    def __init__(self, target_bytes: bytes, init_args: tuple,
+                 init_kwargs: dict,
+                 user_config: Optional[Dict[str, Any]] = None):
+        import cloudpickle
+
+        target = cloudpickle.loads(target_bytes)
+        self._is_function = not inspect.isclass(target)
+        if self._is_function:
+            self._callable = target
+        else:
+            self._callable = target(*init_args, **init_kwargs)
+            if user_config is not None and hasattr(
+                    self._callable, "reconfigure"):
+                self._callable.reconfigure(user_config)
+        self._ongoing = 0
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def reconfigure(self, user_config: Dict[str, Any]):
+        if not self._is_function and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"ongoing": self._ongoing, "total": self._total}
+
+    def _enter(self):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+
+    def _exit(self):
+        with self._lock:
+            self._ongoing -= 1
+
+    def handle_request(self, method_name: str, args, kwargs):
+        self._enter()
+        try:
+            fn = (self._callable if self._is_function
+                  else getattr(self._callable, method_name))
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                import asyncio
+                result = asyncio.get_event_loop().run_until_complete(result)
+            return result
+        finally:
+            self._exit()
+
+    def handle_request_streaming(self, method_name: str, args, kwargs):
+        self._enter()
+        try:
+            fn = (self._callable if self._is_function
+                  else getattr(self._callable, method_name))
+            yield from fn(*args, **kwargs)
+        finally:
+            self._exit()
+
+    def health_check(self) -> bool:
+        if not self._is_function and hasattr(
+                self._callable, "check_health"):
+            self._callable.check_health()
+        return True

@@ -22,8 +22,12 @@ from typing import Any, Dict, List, Optional
 class Checkpoint:
     """A directory full of state (reference: train/_checkpoint.py:55)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, *, _ephemeral: bool = False):
         self.path = os.path.abspath(path)
+        # Ephemeral checkpoints (from_pytree temp dirs) are MOVED into
+        # storage by the manager instead of copied, so /tmp doesn't
+        # accumulate one model copy per report().
+        self._ephemeral = _ephemeral
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
@@ -32,9 +36,10 @@ class Checkpoint:
     @classmethod
     def from_pytree(cls, tree: Any, path: Optional[str] = None
                     ) -> "Checkpoint":
+        ephemeral = path is None
         path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
         save_pytree(tree, path)
-        return cls(path)
+        return cls(path, _ephemeral=ephemeral)
 
     def as_directory(self) -> str:
         return self.path
@@ -52,17 +57,19 @@ class Checkpoint:
 
 def save_pytree(tree: Any, path: str) -> None:
     os.makedirs(path, exist_ok=True)
+    target = os.path.join(path, "state")
     try:
         import orbax.checkpoint as ocp
 
         ckptr = ocp.PyTreeCheckpointer()
-        target = os.path.join(path, "state")
         if os.path.exists(target):
             shutil.rmtree(target)
         ckptr.save(target, tree)
         return
     except Exception:  # noqa: BLE001 — fall back to npz
-        pass
+        # Remove any partially written orbax dir: load_pytree prefers
+        # `state/`, so leftovers would shadow the valid npz fallback.
+        shutil.rmtree(target, ignore_errors=True)
     import jax
     import numpy as np
 
@@ -122,19 +129,27 @@ class CheckpointManager:
         os.makedirs(storage_path, exist_ok=True)
 
     def register(self, checkpoint: Checkpoint,
-                 metrics: Dict[str, Any]) -> Checkpoint:
+                 metrics: Dict[str, Any]) -> Optional[Checkpoint]:
+        """Persist a reported checkpoint. Returns the stored handle, or
+        None if retention evicted it immediately (score below the kept
+        top-K) — callers must not treat None as the latest checkpoint."""
         with self._lock:
             idx = len(self._records)
             dest = os.path.join(self.storage_path, f"checkpoint_{idx:06d}")
             if os.path.abspath(checkpoint.path) != dest:
                 if os.path.exists(dest):
                     shutil.rmtree(dest)
-                shutil.copytree(checkpoint.path, dest)
+                if checkpoint._ephemeral:
+                    shutil.move(checkpoint.path, dest)
+                else:
+                    shutil.copytree(checkpoint.path, dest)
             rec = {"path": dest, "metrics": dict(metrics),
                    "ts": time.time(), "index": idx}
             self._records.append(rec)
             self._evict_locked()
             self._write_manifest_locked()
+            if not os.path.exists(dest):
+                return None
             return Checkpoint(dest)
 
     def _score(self, rec) -> float:

@@ -52,9 +52,14 @@ class _TrainSession:
         self.queue: "queue.Queue[Optional[ReportItem]]" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
+        # Set by the tune scheduler to early-stop a trial; report() raises
+        # StopTrial at the next call (function-API trials unwind cleanly).
+        self.stop_requested = threading.Event()
 
     def report(self, metrics: Dict[str, Any], checkpoint=None):
         self.queue.put(ReportItem(dict(metrics), checkpoint, self.rank))
+        if self.stop_requested.is_set():
+            raise StopTrial()
 
     def mesh(self):
         """Build the worker's mesh from the ScalingConfig plan (local
@@ -66,6 +71,10 @@ class _TrainSession:
 
         plan = self.plan or ParallelPlan.auto(len(jax.devices()))
         return make_mesh(plan)
+
+
+class StopTrial(BaseException):
+    """Raised inside a trial when the scheduler early-stops it."""
 
 
 _local = threading.local()

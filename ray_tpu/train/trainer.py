@@ -123,7 +123,9 @@ class TpuTrainer:
         while True:
             try:
                 return self._fit_once()
-            except BaseException as e:  # noqa: BLE001
+            except (KeyboardInterrupt, SystemExit):
+                raise  # user interrupts are not trial failures
+            except Exception as e:  # noqa: BLE001
                 attempt += 1
                 if failures_allowed >= 0 and attempt > failures_allowed:
                     storage = self.run_config.resolve_storage()
@@ -147,82 +149,86 @@ class TpuTrainer:
         # Gang placement: one bundle per worker (reference:
         # BackendExecutor start creates the PG; TPU-native default is
         # PACK onto one slice).
+        from .. import get as ray_get, kill as ray_kill
+
         pg = placement_group(
             [sc.worker_resources() for _ in range(n)],
             strategy=sc.placement_strategy)
-        pg.wait(timeout=None)
-
-        WorkerActor = remote(num_cpus=0)(_TrainWorker)
-        plan_bytes = cloudpickle.dumps(sc.plan) if sc.plan else None
-        workers = []
-        for rank in range(n):
-            strategy = PlacementGroupSchedulingStrategy(
-                placement_group=pg, placement_group_bundle_index=rank)
-            workers.append(
-                WorkerActor.options(
-                    scheduling_strategy=strategy,
-                    num_cpus=sc.cpus_per_worker,
-                    num_tpus=sc.tpus_per_worker or None,
-                    resources=sc.resources_per_worker or None,
-                ).remote(rank, n, self.run_config.name or "train", plan_bytes))
-
-        # Shard datasets across workers (streaming_split when available).
-        shards_per_worker: List[Dict[str, Any]] = [dict() for _ in range(n)]
-        for name, ds in self.datasets.items():
-            if hasattr(ds, "streaming_split"):
-                split = ds.streaming_split(n, equal=True)
-                for r in range(n):
-                    shards_per_worker[r][name] = split[r]
-            else:
-                for r in range(n):
-                    shards_per_worker[r][name] = ds
-
-        fn_bytes = cloudpickle.dumps(self.train_loop)
-        streams = [
-            w.run.options(num_returns="streaming").remote(
-                fn_bytes, self.train_loop_config, shards_per_worker[r])
-            for r, w in enumerate(workers)
-        ]
-
-        # Drain all workers' report streams; rank-0 metrics drive results,
-        # any rank's checkpoint is persisted (rank 0 by convention).
-        from .. import get as ray_get, kill as ray_kill
-
+        workers: List[Any] = []
         history: List[Dict[str, Any]] = []
         last_ckpt: Optional[Checkpoint] = None
         error: Optional[BaseException] = None
+        try:
+            pg.wait(timeout=None)
 
-        def drain(stream, rank):
-            nonlocal last_ckpt, error
-            try:
-                for ref in stream:
-                    item: ReportItem = ray_get(ref)
-                    if item.metrics.get("__final__"):
-                        continue
-                    if item.checkpoint is not None and rank == 0:
-                        ckpt = manager.register(item.checkpoint, item.metrics)
-                        last_ckpt = ckpt
-                    if rank == 0:
-                        history.append(item.metrics)
-            except BaseException as e:  # noqa: BLE001
-                if error is None:
-                    error = e
+            WorkerActor = remote(num_cpus=0)(_TrainWorker)
+            plan_bytes = cloudpickle.dumps(sc.plan) if sc.plan else None
+            for rank in range(n):
+                strategy = PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=rank)
+                workers.append(
+                    WorkerActor.options(
+                        scheduling_strategy=strategy,
+                        num_cpus=sc.cpus_per_worker,
+                        num_tpus=sc.tpus_per_worker or None,
+                        resources=sc.resources_per_worker or None,
+                    ).remote(rank, n, self.run_config.name or "train",
+                             plan_bytes))
 
-        threads = [
-            threading.Thread(target=drain, args=(s, r), daemon=True)
-            for r, s in enumerate(streams)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+            # Shard datasets across workers (streaming_split if possible).
+            shards_per_worker: List[Dict[str, Any]] = [
+                dict() for _ in range(n)]
+            for name, ds in self.datasets.items():
+                if hasattr(ds, "streaming_split"):
+                    split = ds.streaming_split(n, equal=True)
+                    for r in range(n):
+                        shards_per_worker[r][name] = split[r]
+                else:
+                    for r in range(n):
+                        shards_per_worker[r][name] = ds
 
-        for w in workers:
-            try:
-                ray_kill(w)
-            except Exception:  # noqa: BLE001
-                pass
-        remove_placement_group(pg)
+            fn_bytes = cloudpickle.dumps(self.train_loop)
+            streams = [
+                w.run.options(num_returns="streaming").remote(
+                    fn_bytes, self.train_loop_config, shards_per_worker[r])
+                for r, w in enumerate(workers)
+            ]
+
+            # Drain all workers' report streams; rank-0 metrics drive
+            # results, rank-0 checkpoints are persisted.
+            def drain(stream, rank):
+                nonlocal last_ckpt, error
+                try:
+                    for ref in stream:
+                        item: ReportItem = ray_get(ref)
+                        if item.metrics.get("__final__"):
+                            continue
+                        if item.checkpoint is not None and rank == 0:
+                            stored = manager.register(
+                                item.checkpoint, item.metrics)
+                            if stored is not None:
+                                last_ckpt = stored
+                        if rank == 0:
+                            history.append(item.metrics)
+                except BaseException as e:  # noqa: BLE001
+                    if error is None:
+                        error = e
+
+            threads = [
+                threading.Thread(target=drain, args=(s, r), daemon=True)
+                for r, s in enumerate(streams)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            for w in workers:
+                try:
+                    ray_kill(w)
+                except Exception:  # noqa: BLE001
+                    pass
+            remove_placement_group(pg)
 
         if error is not None:
             raise error

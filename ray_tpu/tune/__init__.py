@@ -1,0 +1,22 @@
+from ..train.session import report  # tune.report == train.report surface
+from .schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    TrialScheduler,
+)
+from .search import (
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    uniform,
+)
+from .tuner import ResultGrid, TrialResult, TuneConfig, Tuner
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
+    "uniform", "loguniform", "quniform", "randint", "choice", "grid_search",
+    "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule", "TrialScheduler",
+]

@@ -1,0 +1,93 @@
+"""Trial schedulers: FIFO, ASHA (async successive halving), median
+stopping.
+
+Capability-equivalent to the reference's schedulers
+(reference: python/ray/tune/schedulers/async_hyperband.py ASHA,
+median_stopping_rule.py; PBT lands with the RL stack): decide per
+reported result whether a trial CONTINUEs or STOPs."""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_result(self, trial_id: str, step: int, metric_value: float) -> str:
+        return CONTINUE
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Async successive halving: rungs at grace_period * eta^k; at each
+    rung a trial continues only if in the top 1/eta of completions so far
+    (reference: async_hyperband.py semantics, single bracket)."""
+
+    def __init__(self, *, metric: str = "loss", mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 3):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.eta = reduction_factor
+        self._rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self._rungs.append(t)
+            t *= reduction_factor
+        # rung milestone -> recorded metric values
+        self._recorded: Dict[int, List[float]] = {
+            r: [] for r in self._rungs}
+        self._trial_rung: Dict[str, int] = {}
+
+    def on_result(self, trial_id: str, step: int, value: float) -> str:
+        if self.mode == "max":
+            value = -value  # normalize to minimization
+        decision = CONTINUE
+        for rung in self._rungs:
+            if step < rung:
+                break
+            if self._trial_rung.get(trial_id, -1) >= rung:
+                continue
+            self._trial_rung[trial_id] = rung
+            recorded = self._recorded[rung]
+            recorded.append(value)
+            k = max(1, len(recorded) // self.eta)
+            threshold = sorted(recorded)[k - 1]
+            if value > threshold:
+                decision = STOP
+        if step >= self.max_t:
+            decision = STOP
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    def __init__(self, *, metric: str = "loss", mode: str = "min",
+                 min_samples: int = 3, grace_period: int = 1):
+        self.metric = metric
+        self.mode = mode
+        self.min_samples = min_samples
+        self.grace = grace_period
+        self._best: Dict[str, float] = {}
+
+    def on_result(self, trial_id: str, step: int, value: float) -> str:
+        if self.mode == "max":
+            value = -value
+        prev = self._best.get(trial_id)
+        self._best[trial_id] = value if prev is None else min(prev, value)
+        if step < self.grace or len(self._best) < self.min_samples:
+            return CONTINUE
+        others = [v for k, v in self._best.items() if k != trial_id]
+        if not others:
+            return CONTINUE
+        med = sorted(others)[len(others) // 2]
+        return STOP if self._best[trial_id] > med else CONTINUE

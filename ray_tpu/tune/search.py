@@ -1,0 +1,104 @@
+"""Search spaces + variant generation.
+
+Capability-equivalent to the reference's sampling layer
+(reference: python/ray/tune/search/sample.py — Domain/Float/Integer/
+Categorical, grid_search; search/variant_generator.py — resolving a
+param_space dict into concrete trial configs)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, low: float, high: float, log: bool = False,
+                 q: Optional[float] = None):
+        self.low, self.high, self.log, self.q = low, high, log, q
+
+    def sample(self, rng):
+        import math
+
+        if self.log:
+            v = math.exp(rng.uniform(math.log(self.low),
+                                     math.log(self.high)))
+        else:
+            v = rng.uniform(self.low, self.high)
+        if self.q:
+            v = round(v / self.q) * self.q
+        return v
+
+
+class Integer(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class GridSearch:
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+def uniform(low: float, high: float) -> Float:
+    return Float(low, high)
+
+
+def loguniform(low: float, high: float) -> Float:
+    return Float(low, high, log=True)
+
+
+def quniform(low: float, high: float, q: float) -> Float:
+    return Float(low, high, q=q)
+
+
+def randint(low: int, high: int) -> Integer:
+    return Integer(low, high)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int,
+                      seed: Optional[int] = None
+                      ) -> Iterator[Dict[str, Any]]:
+    """Grid dims form a cartesian product; each product point is repeated
+    num_samples times with fresh random draws for Domain dims
+    (reference variant_generator semantics)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items()
+                 if isinstance(v, GridSearch)]
+    import itertools
+
+    grids = [param_space[k].values for k in grid_keys]
+    for combo in itertools.product(*grids) if grids else [()]:
+        for _ in range(num_samples):
+            cfg = {}
+            for k, v in param_space.items():
+                if isinstance(v, GridSearch):
+                    cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            yield cfg

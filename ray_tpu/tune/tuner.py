@@ -1,0 +1,223 @@
+"""Tuner — trial orchestration.
+
+Capability-equivalent to the reference's Tune stack
+(reference: python/ray/tune/tuner.py:54 Tuner, tune/tune.py:234 run,
+tune/execution/tune_controller.py:72 TuneController.step :709 — trials
+as actors, scheduler decisions applied per result, experiment state
+persisted, ResultGrid output)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import get as ray_get, kill as ray_kill, remote
+from ..train.checkpoint import Checkpoint, CheckpointManager
+from ..train.config import RunConfig
+from ..train.session import ReportItem, StopTrial, _set_session, _TrainSession
+from .schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from .search import generate_variants
+
+
+@dataclass
+class TuneConfig:
+    num_samples: int = 1
+    metric: Optional[str] = None
+    mode: str = "min"
+    scheduler: Optional[TrialScheduler] = None
+    max_concurrent_trials: int = 4
+    seed: Optional[int] = None
+    resources_per_trial: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    stopped_early: bool = False
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> TrialResult:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[TrialResult]:
+        return [r for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric)")
+        valid = [r for r in self._results
+                 if not r.error and metric in r.metrics]
+        if not valid:
+            raise RuntimeError("No successful trials reported "
+                               f"metric {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return (max if mode == "max" else min)(valid, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([
+            {"trial_id": r.trial_id, **r.config, **r.metrics}
+            for r in self._results])
+
+
+class _TrialWorker:
+    """Actor hosting one trial's function execution with early-stop."""
+
+    def __init__(self, trial_id: str):
+        self.trial_id = trial_id
+        self.session: Optional[_TrainSession] = None
+
+    def request_stop(self):
+        if self.session is not None:
+            self.session.stop_requested.set()
+        return True
+
+    def run(self, fn_bytes: bytes, config: Dict[str, Any]):
+        import cloudpickle
+
+        fn = cloudpickle.loads(fn_bytes)
+        session = _TrainSession(0, 1, self.trial_id, config)
+        self.session = session
+        stopped = {"early": False}
+
+        def _target():
+            _set_session(session)
+            try:
+                fn(config)
+            except StopTrial:
+                stopped["early"] = True
+            except BaseException as e:  # noqa: BLE001
+                session.error = e
+            finally:
+                _set_session(None)
+                session.queue.put(None)
+
+        t = threading.Thread(target=_target, daemon=True,
+                             name=f"trial-{self.trial_id}")
+        t.start()
+        while True:
+            item = session.queue.get()
+            if item is None:
+                break
+            yield item
+        if session.error is not None:
+            raise session.error
+        yield ReportItem({"__trial_done__": True,
+                          "__stopped_early__": stopped["early"]}, None, 0)
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        import cloudpickle
+
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        configs = list(generate_variants(
+            self.param_space, tc.num_samples, tc.seed))
+        storage = self.run_config.resolve_storage()
+        os.makedirs(storage, exist_ok=True)
+
+        fn_bytes = cloudpickle.dumps(self.trainable)
+        results: List[TrialResult] = []
+        results_lock = threading.Lock()
+        sem = threading.Semaphore(max(1, tc.max_concurrent_trials))
+
+        def run_trial(i: int, config: Dict[str, Any]):
+            trial_id = f"trial_{i:04d}_{uuid.uuid4().hex[:6]}"
+            tr = TrialResult(trial_id, config)
+            # max_concurrency=2: one thread streams `run`, the other must
+            # stay free for request_stop (scheduler early termination).
+            actor_opts: Dict[str, Any] = {
+                "num_cpus": tc.resources_per_trial.get("cpu", 1),
+                "max_concurrency": 2,
+            }
+            if tc.resources_per_trial.get("tpu"):
+                actor_opts["num_tpus"] = tc.resources_per_trial["tpu"]
+            Worker = remote(**actor_opts)(_TrialWorker)
+            worker = Worker.remote(trial_id)
+            step = 0
+            try:
+                stream = worker.run.options(
+                    num_returns="streaming").remote(fn_bytes, config)
+                for ref in stream:
+                    item: ReportItem = ray_get(ref)
+                    if item.metrics.get("__trial_done__"):
+                        tr.stopped_early = item.metrics.get(
+                            "__stopped_early__", False)
+                        continue
+                    step += 1
+                    tr.metrics = item.metrics
+                    tr.metrics_history.append(item.metrics)
+                    if item.checkpoint is not None:
+                        tr.checkpoint = item.checkpoint
+                    if tc.metric and tc.metric in item.metrics:
+                        decision = scheduler.on_result(
+                            trial_id, step, item.metrics[tc.metric])
+                        if decision == STOP:
+                            worker.request_stop.remote()
+            except BaseException as e:  # noqa: BLE001
+                tr.error = f"{type(e).__name__}: {e}"
+            finally:
+                try:
+                    ray_kill(worker)
+                except Exception:  # noqa: BLE001
+                    pass
+                with results_lock:
+                    results.append(tr)
+                sem.release()
+
+        threads = []
+        for i, config in enumerate(configs):
+            sem.acquire()
+            t = threading.Thread(target=run_trial, args=(i, config),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+        # Persist experiment summary (reference: experiment_state.py).
+        with open(os.path.join(storage, "experiment_state.json"), "w") as f:
+            json.dump([
+                {"trial_id": r.trial_id, "config": r.config,
+                 "metrics": r.metrics, "error": r.error,
+                 "stopped_early": r.stopped_early}
+                for r in results], f, indent=1, default=str)
+        results.sort(key=lambda r: r.trial_id)
+        return ResultGrid(results, tc.metric, tc.mode)

@@ -1,0 +1,225 @@
+"""Serve tests (reference coverage model: python/ray/serve/tests/
+test_deployment_*.py, test_handle.py, test_batching.py,
+test_autoscaling_policy.py)."""
+
+import time
+
+import pytest
+
+
+@pytest.fixture
+def serve(ray_start):
+    import ray_tpu.serve as serve
+    yield serve
+    serve.shutdown()
+
+
+def test_function_deployment(serve):
+    @serve.deployment
+    def echo(x):
+        return {"echo": x}
+
+    handle = serve.run(echo.bind())
+    assert handle.remote("hi").result(timeout=10) == {"echo": "hi"}
+
+
+def test_class_deployment_with_state(serve):
+    @serve.deployment
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def __call__(self, inc):
+            self.n += inc
+            return self.n
+
+    handle = serve.run(Counter.bind(100))
+    assert handle.remote(1).result(timeout=10) == 101
+    assert handle.remote(2).result(timeout=10) == 103
+
+
+def test_method_routing(serve):
+    @serve.deployment
+    class Api:
+        def hello(self, name):
+            return f"hello {name}"
+
+        def bye(self, name):
+            return f"bye {name}"
+
+    handle = serve.run(Api.bind())
+    assert handle.hello.remote("a").result(timeout=10) == "hello a"
+    assert handle.bye.remote("b").result(timeout=10) == "bye b"
+
+
+def test_multi_replica_load_spread(serve):
+    @serve.deployment(num_replicas=3)
+    class WhoAmI:
+        def __init__(self):
+            import uuid
+
+            self.id = uuid.uuid4().hex[:8]
+
+        def __call__(self, _):
+            time.sleep(0.05)
+            return self.id
+
+    handle = serve.run(WhoAmI.bind())
+    futs = [handle.remote(i) for i in range(12)]
+    ids = {f.result(timeout=10) for f in futs}
+    assert len(ids) >= 2  # requests spread over replicas
+
+
+def test_composition_graph(serve):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            y = self.pre.remote(x).result(timeout=10)
+            return y + 1
+
+    handle = serve.run(Model.bind(Preprocess.bind()))
+    assert handle.remote(10).result(timeout=10) == 21
+
+
+def test_streaming_response(serve):
+    @serve.deployment
+    class Streamer:
+        def stream(self, n):
+            for i in range(n):
+                yield {"token": i}
+
+    import ray_tpu
+
+    handle = serve.run(Streamer.bind())
+    gen = handle.options(method_name="stream", stream=True).remote(3)
+    out = [ray_tpu.get(r)["token"] for r in gen]
+    assert out == [0, 1, 2]
+
+
+def test_batching(serve):
+    import ray_tpu.serve as s
+
+    batch_sizes = []
+
+    @serve.deployment(max_concurrency=16)
+    class Batched:
+        @s.batch(max_batch_size=4, batch_wait_timeout_s=0.1)
+        def handle_batch(self, items):
+            batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+        def __call__(self, x):
+            return self.handle_batch(x)
+
+    handle = serve.run(Batched.bind())
+    futs = [handle.remote(i) for i in range(8)]
+    results = sorted(f.result(timeout=10) for f in futs)
+    assert results == [i * 10 for i in range(8)]
+
+
+def test_multiplexed_lru(serve):
+    import ray_tpu.serve as s
+
+    loads = []
+
+    @s.multiplexed(max_num_models_per_replica=2)
+    def load_model(model_id):
+        loads.append(model_id)
+        return {"model": model_id}
+
+    assert load_model("a")["model"] == "a"
+    assert load_model("a")["model"] == "a"
+    assert loads == ["a"]
+    load_model("b")
+    load_model("c")  # evicts "a"
+    load_model("a")
+    assert loads == ["a", "b", "c", "a"]
+
+
+def test_rolling_update(serve):
+    @serve.deployment(name="svc")
+    def v1(_):
+        return "v1"
+
+    handle = serve.run(v1.bind())
+    assert handle.remote(None).result(timeout=10) == "v1"
+
+    @serve.deployment(name="svc")
+    def v2(_):
+        return "v2"
+
+    handle = serve.run(v2.bind())
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if handle.remote(None).result(timeout=10) == "v2":
+            break
+        time.sleep(0.1)
+    assert handle.remote(None).result(timeout=10) == "v2"
+
+
+def test_autoscaling_up(serve):
+    import ray_tpu
+
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0, "upscale_delay_s": 0.1})
+    class Slow:
+        def __call__(self, _):
+            time.sleep(1.0)
+            return "done"
+
+    handle = serve.run(Slow.bind())
+    futs = [handle.remote(i) for i in range(6)]
+    # Poll for scale-up while requests are in flight.
+    deadline = time.monotonic() + 5
+    peak = 1
+    while time.monotonic() < deadline:
+        peak = max(peak, serve.status()["Slow"]["replicas"])
+        if peak >= 2:
+            break
+        time.sleep(0.1)
+    assert peak >= 2
+    for f in futs:
+        assert f.result(timeout=30) == "done"
+
+
+def test_http_proxy(serve):
+    import json
+    import urllib.request
+
+    @serve.deployment
+    def api(payload):
+        return {"got": payload}
+
+    serve.run(api.bind(), name="api", http=True, http_port=18231)
+    req = urllib.request.Request(
+        "http://127.0.0.1:18231/api",
+        data=json.dumps({"k": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = json.load(resp)
+    assert body == {"result": {"got": {"k": 1}}}
+
+    # health endpoint
+    with urllib.request.urlopen(
+            "http://127.0.0.1:18231/-/healthz", timeout=10) as resp:
+        assert json.load(resp)["status"] == "ok"
+
+
+def test_delete_deployment(serve):
+    @serve.deployment
+    def f(_):
+        return 1
+
+    handle = serve.run(f.bind())
+    assert handle.remote(None).result(timeout=10) == 1
+    serve.delete("f")
+    assert "f" not in serve.status()

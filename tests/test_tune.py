@@ -1,0 +1,179 @@
+"""Tune tests (reference coverage model: python/ray/tune/tests/
+test_tune_restore.py, test_trial_scheduler.py, test_sample.py)."""
+
+import pytest
+
+
+def test_search_space_sampling():
+    from ray_tpu.tune.search import (
+        choice, generate_variants, grid_search, loguniform, randint, uniform)
+
+    space = {
+        "lr": loguniform(1e-5, 1e-1),
+        "bs": choice([16, 32]),
+        "n": randint(1, 10),
+        "g": grid_search([1, 2, 3]),
+        "fixed": "constant",
+    }
+    variants = list(generate_variants(space, num_samples=2, seed=0))
+    assert len(variants) == 6  # 3 grid x 2 samples
+    for v in variants:
+        assert 1e-5 <= v["lr"] <= 1e-1
+        assert v["bs"] in (16, 32)
+        assert 1 <= v["n"] < 10
+        assert v["g"] in (1, 2, 3)
+        assert v["fixed"] == "constant"
+    assert {v["g"] for v in variants} == {1, 2, 3}
+
+
+def test_asha_scheduler_stops_bad_trials():
+    from ray_tpu.tune.schedulers import ASHAScheduler, CONTINUE, STOP
+
+    sched = ASHAScheduler(metric="loss", mode="min", max_t=27,
+                          grace_period=1, reduction_factor=3)
+    # 9 trials report at rung 1; bad ones should be stopped.
+    decisions = {}
+    for i in range(9):
+        decisions[i] = sched.on_result(f"t{i}", 1, float(i))
+    stopped = [i for i, d in decisions.items() if d == STOP]
+    assert 0 not in stopped          # best trial survives
+    assert len(stopped) >= 4         # most bad trials cut
+
+
+def test_tuner_basic(ray_start, tmp_path):
+    import ray_tpu.tune as tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        score = (config["x"] - 3) ** 2
+        tune.report({"score": score})
+
+    grid = tune.grid_search([0, 1, 2, 3, 4, 5])
+    results = tune.Tuner(
+        objective,
+        param_space={"x": grid},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="min", max_concurrent_trials=3),
+        run_config=RunConfig(name="tb", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 6
+    best = results.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == 0
+
+
+def test_tuner_random_search(ray_start, tmp_path):
+    import ray_tpu.tune as tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        tune.report({"val": config["lr"]})
+
+    results = tune.Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+        tune_config=tune.TuneConfig(num_samples=5, metric="val",
+                                    mode="max", seed=1),
+        run_config=RunConfig(name="rs", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 5
+    vals = [r.metrics["val"] for r in results]
+    assert results.get_best_result().metrics["val"] == max(vals)
+
+
+def test_tuner_trial_error_isolated(ray_start, tmp_path):
+    import ray_tpu.tune as tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        if config["x"] == 1:
+            raise RuntimeError("bad trial")
+        tune.report({"ok": config["x"]})
+
+    results = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+        run_config=RunConfig(name="te", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 3
+    assert len(results.errors) == 1
+    assert "bad trial" in results.errors[0].error
+    assert results.get_best_result().config["x"] == 2
+
+
+def test_tuner_asha_early_stops(ray_start, tmp_path):
+    import ray_tpu.tune as tune
+    from ray_tpu.train import RunConfig
+
+    steps_run = {}
+
+    def objective(config):
+        import time
+
+        # quality differs by config; bad trials plateau high. The sleep
+        # paces reports so scheduler decisions land mid-trial.
+        for step in range(20):
+            loss = config["q"] + 1.0 / (step + 1)
+            tune.report({"loss": loss, "step": step})
+            time.sleep(0.03)
+
+    results = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search([0.0, 5.0, 10.0, 20.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", max_concurrent_trials=4,
+            scheduler=tune.ASHAScheduler(
+                metric="loss", mode="min", max_t=20, grace_period=2,
+                reduction_factor=2)),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    assert best.config["q"] == 0.0
+    # at least one bad trial stopped early
+    assert any(r.stopped_early for r in results)
+
+
+def test_tuner_with_real_model(ray_start, tmp_path):
+    """Mini HPO over the tiny transformer's lr."""
+    import ray_tpu.tune as tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models import configs as mconfigs
+        from ray_tpu.models.transformer import init_params, loss_fn
+
+        cfg = mconfigs.tiny_test()
+        params = init_params(cfg, jax.random.key(0))
+        opt = optax.adam(config["lr"])
+        opt_state = opt.init(params)
+        tokens = jax.random.randint(
+            jax.random.key(1), (4, 16), 0, cfg.vocab_size)
+        targets = jnp.roll(tokens, -1, 1)
+
+        @jax.jit
+        def step(params, opt_state):
+            (_, m), g = jax.value_and_grad(
+                lambda p: loss_fn(cfg, p, tokens, targets), has_aux=True
+            )(params)
+            u, opt_state = opt.update(g, opt_state)
+            return optax.apply_updates(params, u), opt_state, m
+
+        for _ in range(5):
+            params, opt_state, m = step(params, opt_state)
+        tune.report({"loss": float(m["loss"])})
+
+    results = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([1e-1, 1e-3])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_concurrent_trials=1),
+        run_config=RunConfig(name="hpo", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 2
+    assert results.get_best_result().error is None
