@@ -1,0 +1,203 @@
+"""ctypes binding for the C++ shared-memory object store.
+
+Capability-equivalent to the reference's PlasmaClient
+(reference: src/ray/object_manager/plasma/client.h — Create/Seal/Get/
+Release/Delete/Contains + mutable-object acquire/release): each process
+attaches the named arena (/dev/shm) and reads objects as zero-copy
+memoryviews over the shared mmap. The build lives in src/shm_store.cc;
+`make -C src` produces ray_tpu/_native/libshm_store.so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import time
+from typing import Optional, Tuple
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libshm_store.so")
+
+ID_LEN = 28
+
+
+class ShmStoreError(Exception):
+    pass
+
+
+class ObjectExistsError(ShmStoreError):
+    pass
+
+
+class StoreFullError(ShmStoreError):
+    pass
+
+
+def _load_lib():
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.rts_connect.restype = ctypes.c_void_p
+    lib.rts_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                ctypes.c_int]
+    lib.rts_disconnect.argtypes = [ctypes.c_void_p]
+    lib.rts_unlink.argtypes = [ctypes.c_char_p]
+    for name in ("rts_create", "rts_ch_create", "rts_ch_write_acquire"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                       ctypes.POINTER(ctypes.c_uint64)]
+    lib.rts_seal.restype = ctypes.c_int
+    lib.rts_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_get.restype = ctypes.c_int
+    lib.rts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                            ctypes.POINTER(ctypes.c_uint64),
+                            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+    lib.rts_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_contains.restype = ctypes.c_int
+    lib.rts_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_delete.restype = ctypes.c_int
+    lib.rts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    for name in ("rts_used", "rts_capacity", "rts_num_objects"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_uint64
+        fn.argtypes = [ctypes.c_void_p]
+    lib.rts_ch_write_release.restype = ctypes.c_int
+    lib.rts_ch_write_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_ch_read.restype = ctypes.c_int64
+    lib.rts_ch_read.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.POINTER(ctypes.c_uint64),
+                                ctypes.POINTER(ctypes.c_uint64)]
+    return lib
+
+
+_lib = None
+
+
+def lib():
+    global _lib
+    if _lib is None:
+        _lib = _load_lib()
+    return _lib
+
+
+def available() -> bool:
+    return os.path.exists(_LIB_PATH)
+
+
+class ShmStore:
+    def __init__(self, name: str, capacity: int = 256 * 1024 * 1024,
+                 create: bool = True):
+        self.name = name
+        self._handle = lib().rts_connect(
+            name.encode(), capacity, 1 if create else 0)
+        if not self._handle:
+            raise ShmStoreError(f"Failed to attach shm store {name!r}")
+        # mmap the same arena for zero-copy buffer views.
+        fd = os.open(f"/dev/shm{name}", os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            self._map = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+
+    # -- immutable objects ------------------------------------------------
+    def put(self, object_id: bytes, data: bytes | memoryview) -> None:
+        assert len(object_id) == ID_LEN
+        off = ctypes.c_uint64()
+        rc = lib().rts_create(self._handle, object_id, len(data),
+                              ctypes.byref(off))
+        if rc == -1:
+            raise ObjectExistsError(object_id.hex())
+        if rc == -2:
+            raise StoreFullError(
+                f"{len(data)} bytes do not fit "
+                f"(used {self.used()}/{self.capacity()})")
+        if rc != 0:
+            raise ShmStoreError(f"create failed rc={rc}")
+        self._map[off.value:off.value + len(data)] = bytes(data)
+        if lib().rts_seal(self._handle, object_id) != 0:
+            raise ShmStoreError("seal failed")
+
+    def get(self, object_id: bytes, *, pin: bool = False
+            ) -> Optional[memoryview]:
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = lib().rts_get(self._handle, object_id, ctypes.byref(off),
+                           ctypes.byref(size), 1 if pin else 0)
+        if rc != 0:
+            return None
+        return memoryview(self._map)[off.value:off.value + size.value]
+
+    def release(self, object_id: bytes) -> None:
+        lib().rts_release(self._handle, object_id)
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(lib().rts_contains(self._handle, object_id))
+
+    def delete(self, object_id: bytes) -> bool:
+        if not self._handle:
+            return False
+        return lib().rts_delete(self._handle, object_id) == 0
+
+    def used(self) -> int:
+        return lib().rts_used(self._handle)
+
+    def capacity(self) -> int:
+        return lib().rts_capacity(self._handle)
+
+    def num_objects(self) -> int:
+        return lib().rts_num_objects(self._handle)
+
+    # -- mutable channel objects -----------------------------------------
+    def channel_create(self, object_id: bytes, max_size: int) -> None:
+        off = ctypes.c_uint64()
+        rc = lib().rts_ch_create(self._handle, object_id, max_size,
+                                 ctypes.byref(off))
+        if rc == -1:
+            raise ObjectExistsError(object_id.hex())
+        if rc != 0:
+            raise ShmStoreError(f"channel create failed rc={rc}")
+
+    def channel_write(self, object_id: bytes, data: bytes) -> None:
+        off = ctypes.c_uint64()
+        rc = lib().rts_ch_write_acquire(
+            self._handle, object_id, len(data), ctypes.byref(off))
+        if rc != 0:
+            raise ShmStoreError(f"write_acquire failed rc={rc}")
+        self._map[off.value:off.value + len(data)] = data
+        if lib().rts_ch_write_release(self._handle, object_id) != 0:
+            raise ShmStoreError("write_release failed")
+
+    def channel_read(self, object_id: bytes, *, min_version: int = -1,
+                     timeout: float = 10.0) -> Tuple[bytes, int]:
+        """Read the channel; blocks until version > min_version (a new
+        write since the reader's last version)."""
+        deadline = time.monotonic() + timeout
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        while True:
+            v = lib().rts_ch_read(self._handle, object_id,
+                                  ctypes.byref(off), ctypes.byref(size))
+            if v >= 0 and v > min_version and size.value > 0:
+                data = bytes(
+                    self._map[off.value:off.value + size.value])
+                # seqlock re-check: version must be unchanged after copy
+                v2 = lib().rts_ch_read(self._handle, object_id,
+                                       ctypes.byref(off),
+                                       ctypes.byref(size))
+                if v2 == v:
+                    return data, int(v)
+            if v == -1:
+                raise ShmStoreError("channel missing")
+            if time.monotonic() > deadline:
+                raise TimeoutError("channel read timed out")
+            time.sleep(0.0002)
+
+    def close(self):
+        if self._handle:
+            lib().rts_disconnect(self._handle)
+            self._handle = None
+            self._map.close()
+
+    @staticmethod
+    def unlink(name: str):
+        lib().rts_unlink(name.encode())

@@ -439,6 +439,19 @@ def _wrap(spec: TaskSpec, e: BaseException) -> BaseException:
     return TaskError(spec.display_name(), e)
 
 
+class _ShmMarker:
+    """Memory-store placeholder for a payload living in the shm plane."""
+
+    __slots__ = ("key", "contained_refs")
+
+    def __init__(self, key: bytes):
+        self.key = key
+        self.contained_refs = ()
+
+    def total_bytes(self) -> int:
+        return len(self.key)  # marker itself is tiny; payload is in shm
+
+
 # ---------------------------------------------------------------------------
 # Runtime
 # ---------------------------------------------------------------------------
@@ -480,6 +493,20 @@ class Runtime:
         # reference_count.h WrapObjectIds/nested-ref semantics).
         self._contained: Dict[ObjectID, List[ObjectID]] = {}
         self._contained_lock = threading.Lock()
+        # Native shared-memory plane for large objects (plasma-equivalent;
+        # src/shm_store.cc). Inline objects stay in the memory store
+        # (reference inlines <100KB, core_worker.h memory store).
+        self.shm = None
+        try:
+            from .._native.shm_store import ShmStore, available
+
+            if available():
+                self._shm_name = f"/ray_tpu_{self.job_id.hex()}"
+                self.shm = ShmStore(
+                    self._shm_name,
+                    capacity=config.object_store_memory_bytes)
+        except Exception:  # noqa: BLE001 — shm plane is optional
+            self.shm = None
 
         if num_cpus is None:
             import os
@@ -529,6 +556,11 @@ class Runtime:
             if oid is None:
                 return
             self.store.delete([oid])
+            if self.shm is not None:
+                try:
+                    self.shm.delete(oid.binary())
+                except Exception:  # noqa: BLE001
+                    pass
             with self._contained_lock:
                 contained = self._contained.pop(oid, [])
             for cid in contained:
@@ -539,11 +571,32 @@ class Runtime:
 
     def _store(self, oid: ObjectID, data, is_error: bool = False):
         """All object writes funnel here so contained-ref borrows are
-        tracked against the containing object's lifetime."""
+        tracked against the containing object's lifetime. Large payloads
+        go to the shared-memory plane; the memory store keeps a marker."""
         if data.contained_refs:
             with self._contained_lock:
                 self._contained[oid] = [r.id() for r in data.contained_refs]
+        if (self.shm is not None and not is_error
+                and data.total_bytes() > config.inline_object_max_bytes):
+            try:
+                self.shm.put(oid.binary(), data.to_bytes())
+                self.store.put(oid, _ShmMarker(oid.binary()),
+                               is_error=False)
+                return
+            except Exception:  # noqa: BLE001 — full/duplicate: keep inline
+                pass
         self.store.put(oid, data, is_error=is_error)
+
+    def _load_data(self, stored) -> "serialization.SerializedObject":
+        """Resolve a stored entry, pulling shm-resident payloads back as
+        zero-copy views. Raises KeyError if the shm copy was evicted."""
+        d = stored.data
+        if not isinstance(d, _ShmMarker):
+            return d
+        view = self.shm.get(d.key) if self.shm is not None else None
+        if view is None:
+            raise KeyError(d.key)
+        return serialization.SerializedObject.from_bytes(view)
 
     def serialization_noted_ref(self, ref: ObjectRef):
         serialization.get_context()._note_ref(ref)
@@ -562,14 +615,31 @@ class Runtime:
             timeout: Optional[float] = None) -> List[Any]:
         ids = [r.id() for r in refs]
         self._maybe_reconstruct(ids)
-        stored = self.store.get(ids, timeout)
-        out = []
-        for s in stored:
-            value = serialization.deserialize(s.data)
-            if s.is_error:
-                raise value
-            out.append(value)
-        return out
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = (None if deadline is None
+                         else max(0.001, deadline - time.monotonic()))
+            stored = self.store.get(ids, remaining)
+            evicted: List[ObjectID] = []
+            loaded = []
+            for oid, s in zip(ids, stored):
+                try:
+                    loaded.append((s, self._load_data(s)))
+                except KeyError:
+                    evicted.append(oid)  # shm copy evicted under pressure
+            if not evicted:
+                out = []
+                for s, data in loaded:
+                    value = serialization.deserialize(data)
+                    if s.is_error:
+                        raise value
+                    out.append(value)
+                return out
+            # Reconstruct evicted objects through their lineage
+            # (reference: object_recovery_manager.h — spilled/lost copies
+            # rebuilt by resubmitting the creating task).
+            self.store.delete(evicted)
+            self._maybe_reconstruct(evicted)
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
              timeout: Optional[float],
@@ -858,16 +928,22 @@ class Runtime:
         """Resolve top-level ObjectRef args (error-poisoning included)."""
         def resolve(v):
             if isinstance(v, ObjectRef):
-                stored = self.store.get_if_exists(v.id())
-                if stored is None:
-                    # Dependency lost between readiness and execution.
-                    self._maybe_reconstruct([v.id()])
-                    stored = self.store.get([v.id()],
-                                            timeout=None)[0]
-                value = serialization.deserialize(stored.data)
-                if stored.is_error:
-                    raise value
-                return value
+                while True:
+                    stored = self.store.get_if_exists(v.id())
+                    if stored is None:
+                        # Dependency lost between readiness and execution.
+                        self._maybe_reconstruct([v.id()])
+                        stored = self.store.get([v.id()], timeout=None)[0]
+                    try:
+                        data = self._load_data(stored)
+                    except KeyError:  # shm copy evicted — reconstruct
+                        self.store.delete([v.id()])
+                        self._maybe_reconstruct([v.id()])
+                        continue
+                    value = serialization.deserialize(data)
+                    if stored.is_error:
+                        raise value
+                    return value
             return v
 
         args = tuple(resolve(a) for a in spec.args)
@@ -1018,6 +1094,16 @@ class Runtime:
     def shutdown(self):
         self._shutdown = True
         self._gc_queue.put(None)
+        # The GC thread touches the shm mapping — it must finish before
+        # munmap, or a queued delete dereferences unmapped memory.
+        self._gc_thread.join(timeout=5)
+        if self.shm is not None:
+            try:
+                self.shm.close()
+                type(self.shm).unlink(self._shm_name)
+            except Exception:  # noqa: BLE001
+                pass
+            self.shm = None
         with self._actors_lock:
             actors = list(self._actors.values())
         for st in actors:

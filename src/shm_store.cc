@@ -1,0 +1,462 @@
+// Shared-memory object store — the native per-host object plane.
+//
+// Capability-equivalent to the reference's plasma store
+// (reference: src/ray/object_manager/plasma/ — store.h:55 PlasmaStore,
+// object_lifecycle_manager.h, eviction_policy.h LRU,
+// client.h ExperimentalMutableObjectWriteAcquire/Release): a POSIX
+// shared-memory arena holding immutable sealed objects addressed by
+// 28-byte ObjectIDs, with create/seal/get(pin)/release/delete, LRU
+// eviction of unpinned sealed objects under memory pressure, and
+// seqlock-style MUTABLE objects used as compiled-DAG channels.
+//
+// Design differences from the reference (TPU-first, simpler):
+//  - one mmap'd arena per host, attached by every worker process
+//    (fd-passing unnecessary: attach by name, offsets are stable)
+//  - allocation: first-fit free list guarded by a process-shared mutex
+//    (the store is the buffer plane; the hot compute path lives in HBM)
+//  - buffers are 256-byte aligned so jax/numpy dlpack views stay aligned
+//
+// Built as a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x52545053;  // "RTPS"
+constexpr uint32_t kIdLen = 28;
+constexpr uint32_t kAlign = 256;
+constexpr uint32_t kMaxObjects = 1 << 16;  // hash slots
+
+enum SlotState : uint32_t {
+  SLOT_FREE = 0,
+  SLOT_CREATED = 1,   // allocated, being written
+  SLOT_SEALED = 2,    // immutable, readable
+  SLOT_MUTABLE = 3,   // channel object (seqlock)
+  SLOT_TOMBSTONE = 4, // deleted (keeps probe chains alive)
+};
+
+struct Slot {
+  uint8_t id[kIdLen];
+  uint32_t state;
+  uint64_t offset;     // data offset in arena
+  uint64_t size;       // payload size
+  uint64_t alloc_size; // rounded allocation size
+  int64_t pins;        // pinned readers (not evictable while > 0)
+  uint64_t seal_seq;   // LRU clock (monotonic seal/touch counter)
+  uint64_t version;    // mutable-object version (seqlock: odd = writing)
+};
+
+struct FreeNode {           // free-list node stored at block start
+  uint64_t size;            // block size (incl. node)
+  uint64_t next;            // arena offset of next free block (0 = none)
+};
+
+struct Header {
+  uint32_t magic;
+  uint32_t id_len;
+  uint64_t capacity;        // arena bytes
+  uint64_t data_start;      // offset of first data byte
+  uint64_t used;            // allocated bytes
+  uint64_t free_head;       // offset of first free block (0 = none)
+  uint64_t seq;             // LRU clock
+  uint64_t num_objects;
+  pthread_mutex_t mu;
+  Slot slots[kMaxObjects];
+};
+
+struct Store {
+  Header* hdr;
+  uint8_t* base;
+  uint64_t map_size;
+  int fd;
+};
+
+uint64_t Align(uint64_t n) { return (n + kAlign - 1) & ~uint64_t(kAlign - 1); }
+
+uint32_t Hash(const uint8_t* id) {
+  // FNV-1a over the 28-byte id.
+  uint32_t h = 2166136261u;
+  for (uint32_t i = 0; i < kIdLen; i++) {
+    h ^= id[i];
+    h *= 16777619u;
+  }
+  return h;
+}
+
+Slot* FindSlot(Header* hdr, const uint8_t* id, bool for_insert) {
+  uint32_t idx = Hash(id) & (kMaxObjects - 1);
+  Slot* first_tomb = nullptr;
+  for (uint32_t probe = 0; probe < kMaxObjects; probe++) {
+    Slot* s = &hdr->slots[(idx + probe) & (kMaxObjects - 1)];
+    if (s->state == SLOT_FREE) {
+      if (for_insert) return first_tomb ? first_tomb : s;
+      return nullptr;
+    }
+    if (s->state == SLOT_TOMBSTONE) {
+      if (for_insert && !first_tomb) first_tomb = s;
+      continue;
+    }
+    if (memcmp(s->id, id, kIdLen) == 0) return s;
+  }
+  return for_insert ? first_tomb : nullptr;
+}
+
+// -- allocator (first-fit free list; caller holds mu) -----------------------
+
+uint64_t AllocLocked(Store* st, uint64_t need) {
+  Header* h = st->hdr;
+  need = Align(need);
+  uint64_t prev = 0, cur = h->free_head;
+  while (cur) {
+    FreeNode* node = reinterpret_cast<FreeNode*>(st->base + cur);
+    if (node->size >= need) {
+      uint64_t remain = node->size - need;
+      if (remain >= kAlign * 2) {
+        uint64_t tail = cur + need;
+        FreeNode* tn = reinterpret_cast<FreeNode*>(st->base + tail);
+        tn->size = remain;
+        tn->next = node->next;
+        if (prev) reinterpret_cast<FreeNode*>(st->base + prev)->next = tail;
+        else h->free_head = tail;
+      } else {
+        need = node->size;
+        if (prev) reinterpret_cast<FreeNode*>(st->base + prev)->next = node->next;
+        else h->free_head = node->next;
+      }
+      h->used += need;
+      return cur;
+    }
+    prev = cur;
+    cur = node->next;
+  }
+  return 0;
+}
+
+void FreeLocked(Store* st, uint64_t offset, uint64_t size) {
+  // Insert sorted by offset and coalesce with neighbors.
+  Header* h = st->hdr;
+  size = Align(size);
+  h->used -= size;
+  uint64_t prev = 0, cur = h->free_head;
+  while (cur && cur < offset) {
+    prev = cur;
+    cur = reinterpret_cast<FreeNode*>(st->base + cur)->next;
+  }
+  FreeNode* node = reinterpret_cast<FreeNode*>(st->base + offset);
+  node->size = size;
+  node->next = cur;
+  if (prev) {
+    FreeNode* pn = reinterpret_cast<FreeNode*>(st->base + prev);
+    pn->next = offset;
+    if (prev + pn->size == offset) {  // coalesce with prev
+      pn->size += node->size;
+      pn->next = node->next;
+      node = pn;
+      offset = prev;
+    }
+  } else {
+    h->free_head = offset;
+  }
+  if (node->next && offset + node->size == node->next) {  // coalesce next
+    FreeNode* nn = reinterpret_cast<FreeNode*>(st->base + node->next);
+    node->size += nn->size;
+    node->next = nn->next;
+  }
+}
+
+// Evict least-recently-sealed unpinned objects until `need` fits
+// (reference: eviction_policy.h LRU).
+bool EvictLocked(Store* st, uint64_t need) {
+  Header* h = st->hdr;
+  for (;;) {
+    if (AllocLocked(st, 0) || true) {
+      // quick check: is there already a block big enough?
+      uint64_t prev_head = h->free_head;
+      (void)prev_head;
+    }
+    // Try allocation first.
+    uint64_t off = AllocLocked(st, need);
+    if (off) {
+      FreeLocked(st, off, need);  // give it back; caller re-allocs
+      return true;
+    }
+    // Find LRU sealed, unpinned object.
+    Slot* victim = nullptr;
+    for (uint32_t i = 0; i < kMaxObjects; i++) {
+      Slot* s = &h->slots[i];
+      if (s->state == SLOT_SEALED && s->pins == 0) {
+        if (!victim || s->seal_seq < victim->seal_seq) victim = s;
+      }
+    }
+    if (!victim) return false;
+    FreeLocked(st, victim->offset, victim->alloc_size);
+    victim->state = SLOT_TOMBSTONE;
+    h->num_objects--;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle (or null). create=1 initializes a new arena.
+void* rts_connect(const char* name, uint64_t capacity, int create) {
+  int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t map_size = sizeof(Header) + capacity;
+  struct stat stbuf;
+  if (fstat(fd, &stbuf) != 0) { close(fd); return nullptr; }
+  bool init = false;
+  if (static_cast<uint64_t>(stbuf.st_size) < map_size) {
+    if (!create) { close(fd); return nullptr; }
+    if (ftruncate(fd, map_size) != 0) { close(fd); return nullptr; }
+    init = true;
+  } else {
+    map_size = stbuf.st_size;
+  }
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  if (mem == MAP_FAILED) { close(fd); return nullptr; }
+  Store* st = new Store();
+  st->hdr = reinterpret_cast<Header*>(mem);
+  st->base = reinterpret_cast<uint8_t*>(mem);
+  st->map_size = map_size;
+  st->fd = fd;
+  if (init || st->hdr->magic != kMagic) {
+    memset(st->hdr, 0, sizeof(Header));
+    st->hdr->magic = kMagic;
+    st->hdr->id_len = kIdLen;
+    st->hdr->capacity = capacity;
+    st->hdr->data_start = Align(sizeof(Header));
+    st->hdr->used = 0;
+    st->hdr->seq = 1;
+    // One big free block spanning the arena.
+    uint64_t start = st->hdr->data_start;
+    FreeNode* node = reinterpret_cast<FreeNode*>(st->base + start);
+    node->size = map_size - start;
+    node->next = 0;
+    st->hdr->free_head = start;
+    pthread_mutexattr_t attr;
+    pthread_mutexattr_init(&attr);
+    pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&st->hdr->mu, &attr);
+  }
+  return st;
+}
+
+void rts_disconnect(void* handle) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  munmap(st->base, st->map_size);
+  close(st->fd);
+  delete st;
+}
+
+int rts_unlink(const char* name) { return shm_unlink(name); }
+
+static void Lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+}
+
+// Create an object buffer. Returns 0 ok, -1 exists, -2 full, -3 table full.
+int rts_create(void* handle, const uint8_t* id, uint64_t size,
+               uint64_t* offset_out) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  if (FindSlot(h, id, false)) { pthread_mutex_unlock(&h->mu); return -1; }
+  uint64_t need = Align(size ? size : 1);
+  if (!EvictLocked(st, need)) { pthread_mutex_unlock(&h->mu); return -2; }
+  uint64_t off = AllocLocked(st, need);
+  if (!off) { pthread_mutex_unlock(&h->mu); return -2; }
+  Slot* s = FindSlot(h, id, true);
+  if (!s) { FreeLocked(st, off, need); pthread_mutex_unlock(&h->mu); return -3; }
+  memcpy(s->id, id, kIdLen);
+  s->state = SLOT_CREATED;
+  s->offset = off;
+  s->size = size;
+  s->alloc_size = need;
+  s->pins = 0;
+  s->version = 0;
+  h->num_objects++;
+  *offset_out = off;
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+int rts_seal(void* handle, const uint8_t* id) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  Slot* s = FindSlot(h, id, false);
+  if (!s || s->state != SLOT_CREATED) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  s->state = SLOT_SEALED;
+  s->seal_seq = h->seq++;
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Get a sealed object. pin=1 increments the pin count (caller must
+// rts_release). Returns 0 ok, -1 missing/unsealed.
+int rts_get(void* handle, const uint8_t* id, uint64_t* offset_out,
+            uint64_t* size_out, int pin) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  Slot* s = FindSlot(h, id, false);
+  if (!s || s->state != SLOT_SEALED) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  s->seal_seq = h->seq++;  // LRU touch
+  if (pin) s->pins++;
+  *offset_out = s->offset;
+  *size_out = s->size;
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+int rts_release(void* handle, const uint8_t* id) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  Slot* s = FindSlot(h, id, false);
+  if (s && s->pins > 0) s->pins--;
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+int rts_contains(void* handle, const uint8_t* id) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  Slot* s = FindSlot(h, id, false);
+  int ok = (s && s->state == SLOT_SEALED) ? 1 : 0;
+  pthread_mutex_unlock(&h->mu);
+  return ok;
+}
+
+int rts_delete(void* handle, const uint8_t* id) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  Slot* s = FindSlot(h, id, false);
+  if (!s) { pthread_mutex_unlock(&h->mu); return -1; }
+  if (s->pins > 0) { pthread_mutex_unlock(&h->mu); return -2; }
+  FreeLocked(st, s->offset, s->alloc_size);
+  s->state = SLOT_TOMBSTONE;
+  h->num_objects--;
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+uint64_t rts_used(void* handle) {
+  return reinterpret_cast<Store*>(handle)->hdr->used;
+}
+
+uint64_t rts_capacity(void* handle) {
+  return reinterpret_cast<Store*>(handle)->hdr->capacity;
+}
+
+uint64_t rts_num_objects(void* handle) {
+  return reinterpret_cast<Store*>(handle)->hdr->num_objects;
+}
+
+// ---------------------------------------------------------------------------
+// Mutable objects (compiled-DAG channels) — seqlock protocol
+// (reference: plasma client.h:98 ExperimentalMutableObjectWriteAcquire/
+// Release; experimental/channel.py builds Channels on these).
+// version is even when stable, odd while a write is in progress.
+// ---------------------------------------------------------------------------
+
+int rts_ch_create(void* handle, const uint8_t* id, uint64_t max_size,
+                  uint64_t* offset_out) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  if (FindSlot(h, id, false)) { pthread_mutex_unlock(&h->mu); return -1; }
+  uint64_t need = Align(max_size ? max_size : 1);
+  if (!EvictLocked(st, need)) { pthread_mutex_unlock(&h->mu); return -2; }
+  uint64_t off = AllocLocked(st, need);
+  if (!off) { pthread_mutex_unlock(&h->mu); return -2; }
+  Slot* s = FindSlot(h, id, true);
+  if (!s) { FreeLocked(st, off, need); pthread_mutex_unlock(&h->mu); return -3; }
+  memcpy(s->id, id, kIdLen);
+  s->state = SLOT_MUTABLE;
+  s->offset = off;
+  s->size = 0;
+  s->alloc_size = need;
+  s->pins = 0;
+  s->version = 0;
+  h->num_objects++;
+  *offset_out = off;
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+int rts_ch_write_acquire(void* handle, const uint8_t* id, uint64_t size,
+                         uint64_t* offset_out) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  Slot* s = FindSlot(h, id, false);
+  if (!s || s->state != SLOT_MUTABLE || size > s->alloc_size) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  __atomic_fetch_add(&s->version, 1, __ATOMIC_ACQ_REL);  // odd: writing
+  s->size = size;
+  *offset_out = s->offset;
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+int rts_ch_write_release(void* handle, const uint8_t* id) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  Slot* s = FindSlot(h, id, false);
+  if (!s || s->state != SLOT_MUTABLE || (s->version % 2) == 0) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  __atomic_fetch_add(&s->version, 1, __ATOMIC_ACQ_REL);  // even: stable
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Snapshot read: returns version (even) + offset/size, or -1 if missing,
+// -2 if a write is in progress (caller retries).
+int64_t rts_ch_read(void* handle, const uint8_t* id, uint64_t* offset_out,
+                    uint64_t* size_out) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  Slot* s = FindSlot(h, id, false);
+  if (!s || s->state != SLOT_MUTABLE) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint64_t v = __atomic_load_n(&s->version, __ATOMIC_ACQUIRE);
+  if (v % 2 == 1) { pthread_mutex_unlock(&h->mu); return -2; }
+  *offset_out = s->offset;
+  *size_out = s->size;
+  pthread_mutex_unlock(&h->mu);
+  return static_cast<int64_t>(v);
+}
+
+}  // extern "C"
