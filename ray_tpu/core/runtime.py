@@ -593,10 +593,15 @@ class Runtime:
         d = stored.data
         if not isinstance(d, _ShmMarker):
             return d
-        view = self.shm.get(d.key) if self.shm is not None else None
+        # Pin while copying out: an unpinned region can be evicted and
+        # its bytes reused by a concurrent put mid-read.
+        view = self.shm.get(d.key, pin=True) if self.shm is not None else None
         if view is None:
             raise KeyError(d.key)
-        return serialization.SerializedObject.from_bytes(view)
+        try:
+            return serialization.SerializedObject.from_bytes(view)
+        finally:
+            self.shm.release(d.key)
 
     def serialization_noted_ref(self, ref: ObjectRef):
         serialization.get_context()._note_ref(ref)
@@ -637,7 +642,17 @@ class Runtime:
                 return out
             # Reconstruct evicted objects through their lineage
             # (reference: object_recovery_manager.h — spilled/lost copies
-            # rebuilt by resubmitting the creating task).
+            # rebuilt by resubmitting the creating task). Objects with no
+            # lineage (ray.put data) can never come back — fail fast
+            # instead of blocking forever.
+            with self.lineage_lock:
+                unrecoverable = [o for o in evicted
+                                 if o not in self.lineage]
+            if unrecoverable:
+                raise ObjectLostError(
+                    "object(s) evicted from the shared-memory store and "
+                    "not reconstructable (no lineage): "
+                    + ", ".join(o.hex()[:16] for o in unrecoverable))
             self.store.delete(evicted)
             self._maybe_reconstruct(evicted)
 
@@ -1097,6 +1112,11 @@ class Runtime:
         # The GC thread touches the shm mapping — it must finish before
         # munmap, or a queued delete dereferences unmapped memory.
         self._gc_thread.join(timeout=5)
+        if self._gc_thread.is_alive():
+            # A stuck GC thread (e.g. waiting on the process-shared mutex
+            # of a crashed peer) still references the mapping — leak it
+            # rather than munmap under its feet.
+            self.shm = None
         if self.shm is not None:
             try:
                 self.shm.close()

@@ -112,7 +112,10 @@ Slot* FindSlot(Header* hdr, const uint8_t* id, bool for_insert) {
 
 // -- allocator (first-fit free list; caller holds mu) -----------------------
 
-uint64_t AllocLocked(Store* st, uint64_t need) {
+// Allocates >= need bytes; *got_out receives the actual block size
+// consumed (the whole free block when the remainder is too small to
+// split) — callers must record and later free exactly *got_out bytes.
+uint64_t AllocLocked(Store* st, uint64_t need, uint64_t* got_out) {
   Header* h = st->hdr;
   need = Align(need);
   uint64_t prev = 0, cur = h->free_head;
@@ -133,6 +136,7 @@ uint64_t AllocLocked(Store* st, uint64_t need) {
         else h->free_head = node->next;
       }
       h->used += need;
+      *got_out = need;
       return cur;
     }
     prev = cur;
@@ -173,22 +177,15 @@ void FreeLocked(Store* st, uint64_t offset, uint64_t size) {
   }
 }
 
-// Evict least-recently-sealed unpinned objects until `need` fits
-// (reference: eviction_policy.h LRU).
-bool EvictLocked(Store* st, uint64_t need) {
+// Allocate `need` bytes, evicting least-recently-sealed unpinned objects
+// until the allocation succeeds (reference: eviction_policy.h LRU).
+// Returns the allocation offset (0 = full even after eviction); the
+// consumed block size lands in *got_out.
+uint64_t AllocOrEvictLocked(Store* st, uint64_t need, uint64_t* got_out) {
   Header* h = st->hdr;
   for (;;) {
-    if (AllocLocked(st, 0) || true) {
-      // quick check: is there already a block big enough?
-      uint64_t prev_head = h->free_head;
-      (void)prev_head;
-    }
-    // Try allocation first.
-    uint64_t off = AllocLocked(st, need);
-    if (off) {
-      FreeLocked(st, off, need);  // give it back; caller re-allocs
-      return true;
-    }
+    uint64_t off = AllocLocked(st, need, got_out);
+    if (off) return off;
     // Find LRU sealed, unpinned object.
     Slot* victim = nullptr;
     for (uint32_t i = 0; i < kMaxObjects; i++) {
@@ -197,7 +194,7 @@ bool EvictLocked(Store* st, uint64_t need) {
         if (!victim || s->seal_seq < victim->seal_seq) victim = s;
       }
     }
-    if (!victim) return false;
+    if (!victim) return 0;
     FreeLocked(st, victim->offset, victim->alloc_size);
     victim->state = SLOT_TOMBSTONE;
     h->num_objects--;
@@ -208,20 +205,40 @@ bool EvictLocked(Store* st, uint64_t need) {
 
 extern "C" {
 
-// Returns an opaque handle (or null). create=1 initializes a new arena.
+// Returns an opaque handle (or null). create=1 initializes a new arena
+// if (and only if) this call creates the shm file; attaching to a live
+// arena never re-initializes it — concurrent creators race via
+// O_CREAT|O_EXCL, losers attach and wait for the winner's init to
+// finish (magic is published last, with release semantics).
 void* rts_connect(const char* name, uint64_t capacity, int create) {
-  int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
-  int fd = shm_open(name, flags, 0600);
-  if (fd < 0) return nullptr;
   uint64_t map_size = sizeof(Header) + capacity;
-  struct stat stbuf;
-  if (fstat(fd, &stbuf) != 0) { close(fd); return nullptr; }
+  int fd = -1;
   bool init = false;
-  if (static_cast<uint64_t>(stbuf.st_size) < map_size) {
-    if (!create) { close(fd); return nullptr; }
-    if (ftruncate(fd, map_size) != 0) { close(fd); return nullptr; }
-    init = true;
-  } else {
+  if (create) {
+    fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd >= 0) {
+      init = true;
+      if (ftruncate(fd, map_size) != 0) {
+        close(fd);
+        shm_unlink(name);
+        return nullptr;
+      }
+    }
+  }
+  if (fd < 0) {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    // Existing arena: adopt its size; wait for the creator's ftruncate.
+    struct stat stbuf;
+    for (int spin = 0; spin < 5000; spin++) {  // <= ~5s
+      if (fstat(fd, &stbuf) != 0) { close(fd); return nullptr; }
+      if (static_cast<uint64_t>(stbuf.st_size) >= sizeof(Header)) break;
+      usleep(1000);
+    }
+    if (static_cast<uint64_t>(stbuf.st_size) < sizeof(Header)) {
+      close(fd);
+      return nullptr;
+    }
     map_size = stbuf.st_size;
   }
   void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED,
@@ -232,9 +249,8 @@ void* rts_connect(const char* name, uint64_t capacity, int create) {
   st->base = reinterpret_cast<uint8_t*>(mem);
   st->map_size = map_size;
   st->fd = fd;
-  if (init || st->hdr->magic != kMagic) {
+  if (init) {
     memset(st->hdr, 0, sizeof(Header));
-    st->hdr->magic = kMagic;
     st->hdr->id_len = kIdLen;
     st->hdr->capacity = capacity;
     st->hdr->data_start = Align(sizeof(Header));
@@ -251,6 +267,20 @@ void* rts_connect(const char* name, uint64_t capacity, int create) {
     pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
     pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
     pthread_mutex_init(&st->hdr->mu, &attr);
+    __atomic_store_n(&st->hdr->magic, kMagic, __ATOMIC_RELEASE);
+  } else {
+    // Wait for the creator to publish the header.
+    for (int spin = 0; spin < 5000; spin++) {
+      if (__atomic_load_n(&st->hdr->magic, __ATOMIC_ACQUIRE) == kMagic)
+        break;
+      usleep(1000);
+    }
+    if (__atomic_load_n(&st->hdr->magic, __ATOMIC_ACQUIRE) != kMagic) {
+      munmap(mem, map_size);
+      close(fd);
+      delete st;
+      return nullptr;
+    }
   }
   return st;
 }
@@ -277,16 +307,16 @@ int rts_create(void* handle, const uint8_t* id, uint64_t size,
   Lock(h);
   if (FindSlot(h, id, false)) { pthread_mutex_unlock(&h->mu); return -1; }
   uint64_t need = Align(size ? size : 1);
-  if (!EvictLocked(st, need)) { pthread_mutex_unlock(&h->mu); return -2; }
-  uint64_t off = AllocLocked(st, need);
+  uint64_t got = 0;
+  uint64_t off = AllocOrEvictLocked(st, need, &got);
   if (!off) { pthread_mutex_unlock(&h->mu); return -2; }
   Slot* s = FindSlot(h, id, true);
-  if (!s) { FreeLocked(st, off, need); pthread_mutex_unlock(&h->mu); return -3; }
+  if (!s) { FreeLocked(st, off, got); pthread_mutex_unlock(&h->mu); return -3; }
   memcpy(s->id, id, kIdLen);
   s->state = SLOT_CREATED;
   s->offset = off;
   s->size = size;
-  s->alloc_size = need;
+  s->alloc_size = got;
   s->pins = 0;
   s->version = 0;
   h->num_objects++;
@@ -390,16 +420,16 @@ int rts_ch_create(void* handle, const uint8_t* id, uint64_t max_size,
   Lock(h);
   if (FindSlot(h, id, false)) { pthread_mutex_unlock(&h->mu); return -1; }
   uint64_t need = Align(max_size ? max_size : 1);
-  if (!EvictLocked(st, need)) { pthread_mutex_unlock(&h->mu); return -2; }
-  uint64_t off = AllocLocked(st, need);
+  uint64_t got = 0;
+  uint64_t off = AllocOrEvictLocked(st, need, &got);
   if (!off) { pthread_mutex_unlock(&h->mu); return -2; }
   Slot* s = FindSlot(h, id, true);
-  if (!s) { FreeLocked(st, off, need); pthread_mutex_unlock(&h->mu); return -3; }
+  if (!s) { FreeLocked(st, off, got); pthread_mutex_unlock(&h->mu); return -3; }
   memcpy(s->id, id, kIdLen);
   s->state = SLOT_MUTABLE;
   s->offset = off;
   s->size = 0;
-  s->alloc_size = need;
+  s->alloc_size = got;
   s->pins = 0;
   s->version = 0;
   h->num_objects++;
