@@ -46,6 +46,11 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32   # master weights
     tie_embeddings: bool = True
     remat: bool = True
+    # attention: "auto" = pallas flash on TPU / XLA-fused reference on CPU;
+    # "reference" forces the einsum path. seq_parallel picks the sequence-
+    # parallel strategy when the mesh has an sp axis > 1 (ops/ kernels).
+    attn_impl: str = "auto"
+    seq_parallel: str = "ring"       # "ring" | "ulysses"
     # MoE (0 experts = dense FFN)
     moe_experts: int = 0
     moe_top_k: int = 2
@@ -202,6 +207,53 @@ def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
+def _attend(cfg: TransformerConfig, q: jax.Array, k: jax.Array,
+            v: jax.Array) -> jax.Array:
+    """Dispatch causal attention to the right kernel for the ambient mesh.
+
+    No mesh (or all relevant axes size 1): plain fused flash attention
+    (pallas on TPU, XLA-fused reference elsewhere). Sharded mesh: a
+    shard_map manual region — pallas kernels are opaque to the auto
+    partitioner, so sharded attention MUST be manual. With an `sp` axis
+    > 1 the sequence stays sharded end-to-end: ring attention rotates kv
+    shards over ICI (or Ulysses all-to-all, per cfg.seq_parallel) —
+    never an all-gather of the sequence.
+    """
+    from ..ops import flash_attention, ring_attention, ulysses_attention
+    from ..parallel.sharding import logical_to_mesh_axes
+
+    force_ref = jax.default_backend() != "tpu"
+    if cfg.attn_impl == "reference":
+        return flash_attention(q, k, v, causal=True, force_reference=True)
+
+    mesh = jax.sharding.get_abstract_mesh()
+    sizes = dict(getattr(mesh, "shape", None) or {})
+    used = {a for a, n in sizes.items() if n > 1} & {
+        "dcn", "dp", "fsdp", "ep", "tp", "sp"}
+    if not used:
+        return flash_attention(q, k, v, causal=True,
+                               force_reference=force_ref)
+
+    q_axes = ("batch", "seq", "act_heads", None)
+    kv_axes = ("batch", "seq", "act_kv_heads", None)
+    qspec = logical_to_mesh_axes(q_axes, mesh=mesh)
+    kvspec = logical_to_mesh_axes(kv_axes, mesh=mesh)
+    sp = sizes.get("sp", 1)
+
+    def local_attn(q, k, v):
+        if sp > 1:
+            if cfg.seq_parallel == "ulysses":
+                return ulysses_attention(q, k, v, axis_name="sp",
+                                         causal=True)
+            return ring_attention(q, k, v, axis_name="sp", causal=True)
+        return flash_attention(q, k, v, causal=True,
+                               force_reference=force_ref)
+
+    return jax.shard_map(
+        local_attn, mesh=mesh, in_specs=(qspec, kvspec, kvspec),
+        out_specs=qspec, check_vma=False)(q, k, v)
+
+
 def attention(cfg: TransformerConfig, lp: Dict[str, jax.Array],
               x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
     """Causal self-attention with GQA. x: (B, S, D) in activation dtype."""
@@ -213,22 +265,11 @@ def attention(cfg: TransformerConfig, lp: Dict[str, jax.Array],
     v = (x @ lp["wv"].astype(x.dtype)).reshape(B, S, KVH, Dh)
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
-    # TP shards heads; SP currently gathers sequence for full attention
-    # (ring-attention pallas kernel replaces this gather — ops/pallas).
     q = wsc(q, ("batch", "seq", "act_heads", None))
-    k = wsc(k, ("batch", "kv_seq", "act_kv_heads", None))
-    v = wsc(v, ("batch", "kv_seq", "act_kv_heads", None))
+    k = wsc(k, ("batch", "seq", "act_kv_heads", None))
+    v = wsc(v, ("batch", "seq", "act_kv_heads", None))
 
-    if KVH != H:
-        rep = H // KVH
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(Dh)
-    causal = jnp.tril(jnp.ones((S, S), dtype=bool))
-    scores = jnp.where(causal[None, None, :, :], scores, -1e30)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H * Dh)
+    out = _attend(cfg, q, k, v).reshape(B, S, H * Dh)
     out = out @ lp["wo"].astype(x.dtype)
     return wsc(out, ("batch", "seq", "act_embed"))
 

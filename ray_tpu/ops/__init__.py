@@ -1,0 +1,19 @@
+"""ray_tpu.ops — pallas TPU kernels for the hot ops.
+
+New TPU-native capability: the reference delegates fused attention to
+torch/vLLM/DeepSpeed internals (SURVEY.md §5 long-context: "not present
+in the reference"); here flash attention, ring attention (sequence/
+context parallelism over the ICI ring) and Ulysses all-to-all sequence
+parallelism are first-class, in-framework kernels.
+"""
+
+from .flash_attention import attention, flash_attention
+from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
+
+__all__ = [
+    "attention",
+    "flash_attention",
+    "ring_attention",
+    "ulysses_attention",
+]

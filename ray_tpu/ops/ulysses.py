@@ -1,0 +1,53 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+Alternative to ring attention for long sequences: instead of rotating
+kv around the ring, one `lax.all_to_all` reshards q/k/v from
+sequence-sharded to head-sharded, each device runs *full-sequence*
+flash attention over its head subset, and a second all_to_all reshards
+the output back to sequence-sharded. Two collectives total (vs N-1 ring
+hops) — wins when heads >= devices and the ICI all-to-all bandwidth is
+good (it rides the same links XLA uses for expert-parallel dispatch).
+
+The reference has no sequence parallelism (SURVEY.md §5). Call inside
+shard_map with tensors sequence-sharded along `axis_name`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .flash_attention import flash_attention
+
+
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True,
+                      sm_scale: Optional[float] = None,
+                      block_q: int = 128, block_k: int = 128) -> jax.Array:
+    """q: (B, S_local, H, D); k, v: (B, S_local, KVH, D), sharded on dim 1
+    along `axis_name`. H and KVH must be divisible by the axis size.
+    Returns (B, S_local, H, D)."""
+    n = lax.axis_size(axis_name)
+    B, S, H, D = q.shape
+    kvh = k.shape[2]
+    if H % n or kvh % n:
+        raise ValueError(
+            f"heads ({H}/{kvh}) must divide the '{axis_name}' axis ({n})")
+
+    # seq-sharded → head-sharded: split heads, gather sequence.
+    qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+
+    out = flash_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k)
+
+    # head-sharded → seq-sharded.
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)

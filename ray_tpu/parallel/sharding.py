@@ -76,7 +76,7 @@ def logical_to_mesh_axes(
             spec.append(None)
             continue
         if mesh is not None:
-            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            sizes = dict(mesh.shape)  # works for Mesh and AbstractMesh
             if isinstance(target, tuple):
                 target = tuple(t for t in target if sizes.get(t, 1) > 1)
                 target = target if target else None
