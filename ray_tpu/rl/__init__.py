@@ -1,0 +1,29 @@
+"""ray_tpu.rl — reinforcement learning on the TPU-native runtime.
+
+Capability-equivalent to the reference's RLlib new stack (reference:
+rllib/ — RLModule, EnvRunner, Learner, Algorithm; SURVEY.md §2.3 RLlib
+row): parallel env-rollout actors + a jitted learner, PPO for control,
+GRPO for LLM RLHF (BASELINE config 5).
+"""
+
+from .algorithm import Algorithm
+from .buffer import ReplayBuffer
+from .env import (
+    ENV_REGISTRY,
+    CartPole,
+    Env,
+    GridWorld,
+    VectorEnv,
+    make_env,
+    register_env,
+)
+from .env_runner import EnvRunner
+from .grpo import GRPO, GRPOConfig
+from .module import MLPModuleSpec
+from .ppo import PPO, PPOConfig
+
+__all__ = [
+    "Algorithm", "ReplayBuffer", "Env", "CartPole", "GridWorld",
+    "VectorEnv", "make_env", "register_env", "ENV_REGISTRY", "EnvRunner",
+    "MLPModuleSpec", "PPO", "PPOConfig", "GRPO", "GRPOConfig",
+]

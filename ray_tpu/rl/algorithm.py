@@ -1,0 +1,75 @@
+"""Algorithm base — the RL trainable.
+
+Capability-equivalent to the reference's Algorithm(Trainable)
+(reference: rllib/algorithms/algorithm.py:189 — step() :790 calls
+training_step() :1569, checkpointing, Tune integration via the
+Trainable interface). Here an Algorithm exposes step()/train() and an
+as_trainable() adapter so Tuner can drive it like any other trainable.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Algorithm:
+    def __init__(self, config):
+        self.config = config
+        self.iteration = 0
+        self.setup()
+
+    def setup(self) -> None:  # pragma: no cover - overridden
+        pass
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self) -> Dict[str, Any]:
+        res = self.training_step()
+        self.iteration += 1
+        res.setdefault("training_iteration", self.iteration)
+        return res
+
+    def train(self, iterations: int = 1) -> List[Dict[str, Any]]:
+        return [self.step() for _ in range(iterations)]
+
+    def stop(self) -> None:
+        pass
+
+    # -- checkpointing ------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        return {"iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.iteration = state.get("iteration", 0)
+
+    def save(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(self.get_state(), f)
+        return path
+
+    def restore(self, path: str) -> None:
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            self.set_state(pickle.load(f))
+
+    # -- Tune integration ---------------------------------------------
+    @classmethod
+    def as_trainable(cls, base_config) -> Callable[[Dict[str, Any]], None]:
+        """→ a function trainable for ray_tpu.tune.Tuner: each trial
+        builds the algorithm with config overrides and reports every
+        iteration's metrics."""
+        from ..train.session import report
+
+        def trainable(tune_config: Dict[str, Any]) -> None:
+            cfg = base_config.with_overrides(**tune_config)
+            algo = cls(cfg)
+            try:
+                for _ in range(getattr(cfg, "train_iterations", 10)):
+                    report(algo.step())
+            finally:
+                algo.stop()
+
+        return trainable

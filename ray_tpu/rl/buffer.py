@@ -1,0 +1,42 @@
+"""Replay buffer for off-policy algorithms.
+
+Capability-equivalent to the reference's replay buffer family
+(reference: rllib/utils/replay_buffers/ — EpisodeReplayBuffer,
+PrioritizedEpisodeReplayBuffer): a bounded FIFO of transitions with
+uniform sampling; numpy-backed so EnvRunner actors can feed it directly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, seed: Optional[int] = None):
+        self.capacity = capacity
+        self._storage: Dict[str, np.ndarray] = {}
+        self._size = 0
+        self._next = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, batch: Dict[str, np.ndarray]) -> None:
+        """batch: dict of (N, ...) arrays with a common N."""
+        n = len(next(iter(batch.values())))
+        if not self._storage:
+            for k, v in batch.items():
+                self._storage[k] = np.zeros(
+                    (self.capacity,) + v.shape[1:], v.dtype)
+        for k, v in batch.items():
+            idx = (self._next + np.arange(n)) % self.capacity
+            self._storage[k][idx] = v
+        self._next = (self._next + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return {k: v[idx] for k, v in self._storage.items()}

@@ -1,0 +1,176 @@
+"""RL environment API + built-in toy envs.
+
+Capability reference: rllib's env stack (reference: rllib/env/env_runner.py
+:15 EnvRunner, rllib/env/single_agent_env_runner.py:31) uses gymnasium
+envs; here the Env protocol is gymnasium-compatible (reset/step with
+terminated/truncated) but self-contained — no gym dependency — with a
+numpy CartPole (classic control physics) and a GridWorld for tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Env:
+    """Minimal single-agent env protocol (gymnasium-style)."""
+
+    observation_size: int
+    num_actions: int
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, bool]:
+        """→ (obs, reward, terminated, truncated)."""
+        raise NotImplementedError
+
+
+class CartPole(Env):
+    """Classic cart-pole balancing (the standard control benchmark —
+    pure numpy physics, Euler integration, 500-step limit)."""
+
+    observation_size = 4
+    num_actions = 2
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros(4, np.float64)
+        self._t = 0
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._t = 0
+        return self._state.astype(np.float32)
+
+    def step(self, action: int):
+        x, x_dot, th, th_dot = self._state
+        force = self.FORCE if action == 1 else -self.FORCE
+        total_m = self.CART_MASS + self.POLE_MASS
+        pm_len = self.POLE_MASS * self.POLE_HALF_LEN
+        cos, sin = np.cos(th), np.sin(th)
+        temp = (force + pm_len * th_dot ** 2 * sin) / total_m
+        th_acc = (self.GRAVITY * sin - cos * temp) / (
+            self.POLE_HALF_LEN * (4.0 / 3.0
+                                  - self.POLE_MASS * cos ** 2 / total_m))
+        x_acc = temp - pm_len * th_acc * cos / total_m
+        x += self.DT * x_dot
+        x_dot += self.DT * x_acc
+        th += self.DT * th_dot
+        th_dot += self.DT * th_acc
+        self._state = np.array([x, x_dot, th, th_dot])
+        self._t += 1
+        terminated = bool(abs(x) > self.X_LIMIT
+                          or abs(th) > self.THETA_LIMIT)
+        truncated = self._t >= self.MAX_STEPS
+        return self._state.astype(np.float32), 1.0, terminated, truncated
+
+
+class GridWorld(Env):
+    """N×N grid, reach the corner. Deterministic; good for exact tests."""
+
+    num_actions = 4  # up/down/left/right
+
+    def __init__(self, n: int = 5, max_steps: int = 50):
+        self.n = n
+        self.max_steps = max_steps
+        self.observation_size = 2
+        self._pos = (0, 0)
+        self._t = 0
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        self._pos = (0, 0)
+        self._t = 0
+        return self._obs()
+
+    def _obs(self) -> np.ndarray:
+        return np.array(self._pos, np.float32) / (self.n - 1)
+
+    def step(self, action: int):
+        r, c = self._pos
+        if action == 0:
+            r = max(0, r - 1)
+        elif action == 1:
+            r = min(self.n - 1, r + 1)
+        elif action == 2:
+            c = max(0, c - 1)
+        else:
+            c = min(self.n - 1, c + 1)
+        self._pos = (r, c)
+        self._t += 1
+        done = self._pos == (self.n - 1, self.n - 1)
+        reward = 1.0 if done else -0.01
+        return self._obs(), reward, done, self._t >= self.max_steps
+
+
+class VectorEnv:
+    """K independent env copies stepped as a batch, auto-resetting —
+    the unit an EnvRunner drives (reference: rllib env vectorization)."""
+
+    def __init__(self, env_fn: Callable[[], Env], num_envs: int,
+                 seed: Optional[int] = None):
+        self.envs: List[Env] = [env_fn() for _ in range(num_envs)]
+        self.num_envs = num_envs
+        base = 0 if seed is None else seed
+        self._obs = np.stack([e.reset(seed=base + i)
+                              for i, e in enumerate(self.envs)])
+        self.episode_returns = np.zeros(num_envs)
+        self.completed_returns: List[float] = []
+
+    @property
+    def observations(self) -> np.ndarray:
+        return self._obs
+
+    def step(self, actions: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """→ (obs, rewards, dones). Auto-resets finished envs; `dones`
+        marks boundaries for GAE."""
+        obs, rewards, dones = [], [], []
+        for i, (e, a) in enumerate(zip(self.envs, actions)):
+            o, r, term, trunc = e.step(int(a))
+            self.episode_returns[i] += r
+            if term or trunc:
+                self.completed_returns.append(self.episode_returns[i])
+                self.episode_returns[i] = 0.0
+                o = e.reset()
+            obs.append(o)
+            rewards.append(r)
+            dones.append(term or trunc)
+        self._obs = np.stack(obs)
+        return self._obs, np.asarray(rewards, np.float32), \
+            np.asarray(dones, np.bool_)
+
+    def pop_episode_returns(self) -> List[float]:
+        out = self.completed_returns
+        self.completed_returns = []
+        return out
+
+
+ENV_REGISTRY: Dict[str, Callable[[], Env]] = {
+    "CartPole": CartPole,
+    "GridWorld": GridWorld,
+}
+
+
+def register_env(name: str, fn: Callable[[], Env]) -> None:
+    ENV_REGISTRY[name] = fn
+
+
+def make_env(spec: Any) -> Env:
+    if callable(spec):
+        return spec()
+    return ENV_REGISTRY[spec]()

@@ -1,0 +1,64 @@
+"""EnvRunner — rollout collection actor.
+
+Capability-equivalent to the reference's EnvRunner / RolloutWorker
+(reference: rllib/env/env_runner.py:15, rllib/env/
+single_agent_env_runner.py:31 — sample() with current weights, env
+vectorization, episode metrics). Runs as a ray_tpu actor: the learner
+broadcasts params via the object store, runners step numpy envs on CPU
+and batch policy inference through the module's jax apply.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from .env import VectorEnv, make_env
+from .module import sample_actions
+
+
+class EnvRunner:
+    def __init__(self, env_spec: Any, module_spec, num_envs: int = 8,
+                 seed: int = 0):
+        self.spec = module_spec
+        self.vec = VectorEnv(lambda: make_env(env_spec), num_envs,
+                             seed=seed)
+        self._key = jax.random.key(seed)
+
+    def sample(self, params, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect num_steps per env with the given params.
+
+        Returns time-major arrays (T, K, ...): obs, actions, log_probs,
+        values, rewards, dones, plus last_values for GAE bootstrap and
+        episode_returns for metrics."""
+        K = self.vec.num_envs
+        obs_l, act_l, logp_l, val_l, rew_l, done_l = [], [], [], [], [], []
+        for _ in range(num_steps):
+            obs = self.vec.observations
+            self._key, k = jax.random.split(self._key)
+            actions, logp, values = sample_actions(
+                self.spec, params, obs, k)
+            next_obs, rewards, dones = self.vec.step(actions)
+            obs_l.append(obs)
+            act_l.append(actions)
+            logp_l.append(logp)
+            val_l.append(values)
+            rew_l.append(rewards)
+            done_l.append(dones)
+        # Bootstrap value for the state after the last step.
+        self._key, k = jax.random.split(self._key)
+        _, _, last_values = sample_actions(
+            self.spec, params, self.vec.observations, k)
+        return {
+            "obs": np.stack(obs_l),
+            "actions": np.stack(act_l),
+            "log_probs": np.stack(logp_l),
+            "values": np.stack(val_l),
+            "rewards": np.stack(rew_l),
+            "dones": np.stack(done_l),
+            "last_values": last_values,
+            "episode_returns": np.asarray(
+                self.vec.pop_episode_returns(), np.float32),
+        }

@@ -1,0 +1,188 @@
+"""GRPO — group-relative policy optimization for LLM RLHF.
+
+Capability target: the reference ecosystem does RLHF by wiring RLlib/
+external trainers around LLMs (BASELINE config 5 "PPO/GRPO RLHF:
+learner + rollout actors"); here GRPO is in-framework on the TPU-native
+transformer (models/transformer.py). Per prompt, sample a group of G
+completions, reward each, and use group-normalized advantages — no
+value network — with a token-level clipped ratio and a k3 KL penalty
+against the sampling policy.
+
+Generation uses a fixed-shape token buffer so the sampling forward is
+ONE compiled XLA program reused every decode step (static shapes;
+compiler-friendly control flow).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..models.transformer import TransformerConfig, forward, init_params
+from .algorithm import Algorithm
+
+
+@dataclass(frozen=True)
+class GRPOConfig:
+    model: TransformerConfig = field(
+        default_factory=lambda: TransformerConfig(
+            vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=4, d_ff=128, max_seq_len=64,
+            dtype=jnp.float32, param_dtype=jnp.float32, remat=False))
+    # reward_fn: (completions (N, max_new) int32) -> (N,) float rewards
+    reward_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None
+    num_prompts: int = 4
+    prompt_len: int = 8
+    group_size: int = 4
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+    clip_eps: float = 0.2
+    kl_coef: float = 0.02
+    lr: float = 1e-4
+    seed: int = 0
+    train_iterations: int = 10
+
+    def with_overrides(self, **kw) -> "GRPOConfig":
+        return replace(self, **kw)
+
+
+def make_sampler(cfg: GRPOConfig):
+    """→ jitted (params, tokens, length, key) -> next-token sampler over
+    a fixed (N, S) buffer; logits read at position length-1."""
+    mcfg = cfg.model
+
+    @jax.jit
+    def next_token(params, tokens, length, key):
+        logits, _ = forward(mcfg, params, tokens)
+        last = logits[:, length - 1, :] / cfg.temperature
+        return jax.random.categorical(key, last, axis=-1)
+
+    return next_token
+
+
+def generate(cfg: GRPOConfig, next_token, params, prompts: np.ndarray,
+             key: jax.Array) -> np.ndarray:
+    """prompts (N, P) → full sequences (N, P + max_new)."""
+    N, P = prompts.shape
+    S = P + cfg.max_new_tokens
+    buf = np.zeros((N, S), np.int32)
+    buf[:, :P] = prompts
+    tokens = jnp.asarray(buf)
+    for t in range(cfg.max_new_tokens):
+        key, k = jax.random.split(key)
+        nxt = next_token(params, tokens, P + t, k)
+        tokens = tokens.at[:, P + t].set(nxt)
+    return np.asarray(tokens)
+
+
+def make_grpo_update(cfg: GRPOConfig):
+    mcfg = cfg.model
+    opt = optax.adam(cfg.lr)
+
+    def token_logp(params, tokens):
+        """logp of tokens[:, 1:] under the model. → (N, S-1)."""
+        logits, _ = forward(mcfg, params, tokens)
+        logp = jax.nn.log_softmax(logits[:, :-1, :], axis=-1)
+        return jnp.take_along_axis(
+            logp, tokens[:, 1:, None], axis=-1)[..., 0]
+
+    def loss_fn(params, tokens, old_logp, advantages, comp_mask):
+        lp = token_logp(params, tokens)
+        ratio = jnp.exp(lp - old_logp)
+        adv = advantages[:, None]
+        unclipped = ratio * adv
+        clipped = jnp.clip(ratio, 1 - cfg.clip_eps,
+                           1 + cfg.clip_eps) * adv
+        pg = jnp.minimum(unclipped, clipped)
+        # k3 KL estimator vs the sampling policy.
+        log_r = old_logp - lp
+        kl = jnp.exp(log_r) - log_r - 1.0
+        per_tok = -(pg - cfg.kl_coef * kl) * comp_mask
+        denom = jnp.maximum(comp_mask.sum(), 1.0)
+        loss = per_tok.sum() / denom
+        return loss, {"pg_loss": -(pg * comp_mask).sum() / denom,
+                      "kl": (kl * comp_mask).sum() / denom}
+
+    @jax.jit
+    def update(params, opt_state, tokens, old_logp, advantages,
+               comp_mask):
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, tokens, old_logp, advantages,
+                                   comp_mask)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, **metrics}
+
+    return opt, update, jax.jit(token_logp)
+
+
+class GRPO(Algorithm):
+    def setup(self):
+        cfg: GRPOConfig = self.config
+        if cfg.reward_fn is None:
+            raise ValueError("GRPOConfig.reward_fn is required")
+        self._key = jax.random.key(cfg.seed)
+        self._key, k = jax.random.split(self._key)
+        self.params = init_params(cfg.model, k)
+        self.opt, self._update, self._token_logp = make_grpo_update(cfg)
+        self.opt_state = self.opt.init(self.params)
+        self._next_token = make_sampler(cfg)
+
+    def sample_prompts(self) -> np.ndarray:
+        cfg: GRPOConfig = self.config
+        self._key, k = jax.random.split(self._key)
+        return np.asarray(jax.random.randint(
+            k, (cfg.num_prompts, cfg.prompt_len), 0,
+            cfg.model.vocab_size, dtype=jnp.int32))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: GRPOConfig = self.config
+        t0 = time.perf_counter()
+        prompts = self.sample_prompts()
+        # Group: G completions per prompt.
+        grouped = np.repeat(prompts, cfg.group_size, axis=0)  # (N*G, P)
+        self._key, k = jax.random.split(self._key)
+        seqs = generate(cfg, self._next_token, self.params, grouped, k)
+        gen_s = time.perf_counter() - t0
+
+        completions = seqs[:, cfg.prompt_len:]
+        rewards = np.asarray(cfg.reward_fn(completions), np.float32)
+        groups = rewards.reshape(cfg.num_prompts, cfg.group_size)
+        mean = groups.mean(axis=1, keepdims=True)
+        std = groups.std(axis=1, keepdims=True) + 1e-6
+        advantages = ((groups - mean) / std).reshape(-1)
+
+        tokens = jnp.asarray(seqs)
+        old_logp = self._token_logp(self.params, tokens)
+        # Completion-token mask over the shifted (S-1) axis.
+        S = seqs.shape[1]
+        pos = np.arange(S - 1)
+        comp_mask = jnp.asarray(
+            (pos >= cfg.prompt_len - 1).astype(np.float32)[None, :]
+            * np.ones((seqs.shape[0], 1), np.float32))
+
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, tokens, old_logp,
+            jnp.asarray(advantages), comp_mask)
+        return {
+            "reward_mean": float(rewards.mean()),
+            "reward_std": float(rewards.std()),
+            "gen_time_s": gen_s,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def get_state(self):
+        return {"iteration": self.iteration,
+                "params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state)}
+
+    def set_state(self, state):
+        self.iteration = state["iteration"]
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]

@@ -1,0 +1,73 @@
+"""RLModule — the trainable policy/value network.
+
+Capability-equivalent to the reference's new-stack RLModule (reference:
+rllib/core/rl_module/rl_module.py — forward_inference /
+forward_exploration / forward_train over a framework-specific network),
+re-designed functional-jax: a module is (init, apply) pure functions
+over a params pytree, so the Learner can jit/pjit the whole update and
+EnvRunners can run the same apply on CPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MLPModuleSpec:
+    """Categorical-action policy + value head on a shared MLP torso."""
+
+    observation_size: int
+    num_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        sizes = (self.observation_size,) + tuple(self.hidden)
+        params: Dict[str, Any] = {"torso": []}
+        keys = jax.random.split(key, len(sizes) + 1)
+        for i in range(len(sizes) - 1):
+            w = jax.random.normal(keys[i], (sizes[i], sizes[i + 1]),
+                                  jnp.float32)
+            w = w * np.sqrt(2.0 / sizes[i])
+            params["torso"].append(
+                {"w": w, "b": jnp.zeros((sizes[i + 1],), jnp.float32)})
+        d = sizes[-1]
+        params["pi_w"] = jax.random.normal(
+            keys[-2], (d, self.num_actions), jnp.float32) * 0.01
+        params["pi_b"] = jnp.zeros((self.num_actions,), jnp.float32)
+        params["v_w"] = jax.random.normal(keys[-1], (d, 1),
+                                          jnp.float32) * 1.0
+        params["v_b"] = jnp.zeros((1,), jnp.float32)
+        return params
+
+    def apply(self, params: Dict[str, Any], obs: jax.Array
+              ) -> Tuple[jax.Array, jax.Array]:
+        """obs (B, obs_size) → (logits (B, A), value (B,))."""
+        h = obs
+        for layer in params["torso"]:
+            h = jnp.tanh(h @ layer["w"] + layer["b"])
+        logits = h @ params["pi_w"] + params["pi_b"]
+        value = (h @ params["v_w"] + params["v_b"])[..., 0]
+        return logits, value
+
+
+def sample_actions(spec, params, obs: np.ndarray, key: jax.Array
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Exploration forward: sample from the categorical policy.
+    → (actions, log_probs, values) as numpy."""
+    logits, value = spec.apply(params, jnp.asarray(obs))
+    actions = jax.random.categorical(key, logits, axis=-1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    alogp = jnp.take_along_axis(logp, actions[:, None], axis=-1)[:, 0]
+    return (np.asarray(actions), np.asarray(alogp), np.asarray(value))
+
+
+def greedy_actions(spec, params, obs: np.ndarray) -> np.ndarray:
+    """Inference forward: argmax policy."""
+    logits, _ = spec.apply(params, jnp.asarray(obs))
+    return np.asarray(jnp.argmax(logits, axis=-1))

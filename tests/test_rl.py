@@ -1,0 +1,147 @@
+"""RL library tests (reference test style: rllib per-algorithm tests
+with toy envs + learning-improvement assertions)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.rl import (
+    GRPO,
+    GRPOConfig,
+    PPO,
+    PPOConfig,
+    CartPole,
+    GridWorld,
+    MLPModuleSpec,
+    ReplayBuffer,
+    VectorEnv,
+)
+from ray_tpu.rl.ppo import compute_gae
+
+
+class TestEnvs:
+    def test_cartpole_physics(self):
+        env = CartPole(seed=0)
+        obs = env.reset()
+        assert obs.shape == (4,)
+        total = 0
+        for _ in range(600):
+            obs, r, term, trunc = env.step(np.random.randint(2))
+            total += r
+            if term or trunc:
+                break
+        assert term or trunc  # random policy falls over
+
+    def test_vector_env_autoreset(self):
+        vec = VectorEnv(lambda: GridWorld(3, max_steps=5), 4, seed=0)
+        for _ in range(12):
+            obs, r, d = vec.step(np.array([3, 3, 1, 0]))
+        assert len(vec.completed_returns) > 0
+        assert obs.shape == (4, 2)
+
+
+class TestGAE:
+    def test_matches_manual(self):
+        # T=3, K=1, no dones
+        rewards = jnp.array([[1.0], [1.0], [1.0]])
+        values = jnp.array([[0.5], [0.5], [0.5]])
+        dones = jnp.zeros((3, 1), bool)
+        last = jnp.array([0.5])
+        adv, ret = compute_gae(rewards, values, dones, last, 0.9, 0.8)
+        # manual backward recursion
+        expected = []
+        a = 0.0
+        for t in reversed(range(3)):
+            v_next = 0.5
+            delta = 1.0 + 0.9 * v_next - 0.5
+            a = delta + 0.9 * 0.8 * a
+            expected.append(a)
+        expected = expected[::-1]
+        np.testing.assert_allclose(adv[:, 0], expected, rtol=1e-6)
+        np.testing.assert_allclose(ret, adv + values, rtol=1e-6)
+
+    def test_done_cuts_bootstrap(self):
+        rewards = jnp.array([[1.0], [1.0]])
+        values = jnp.array([[0.0], [0.0]])
+        dones = jnp.array([[True], [False]])
+        last = jnp.array([100.0])
+        adv, _ = compute_gae(rewards, values, dones, last, 0.99, 0.95)
+        # step 0 ends an episode: no bootstrap through it
+        assert float(adv[0, 0]) == pytest.approx(1.0)
+
+
+class TestPPO:
+    def test_learns_gridworld(self, ray_start):
+        cfg = PPOConfig(env="GridWorld", num_env_runners=2,
+                        num_envs_per_runner=4, rollout_length=64,
+                        hidden=(32,), lr=3e-3, seed=0)
+        algo = PPO(cfg)
+        first = algo.step()
+        for _ in range(8):
+            res = algo.step()
+        algo.stop()
+        assert res["episode_return_mean"] is not None
+        # GridWorld optimum ≈ +0.93; random walk is near -0.2
+        assert res["episode_return_mean"] > first["episode_return_mean"]
+
+    def test_checkpoint_roundtrip(self, ray_start, tmp_path):
+        cfg = PPOConfig(env="GridWorld", num_env_runners=1,
+                        num_envs_per_runner=2, rollout_length=16,
+                        hidden=(16,))
+        algo = PPO(cfg)
+        algo.step()
+        path = algo.save(str(tmp_path / "ckpt"))
+        algo2 = PPO(cfg)
+        algo2.restore(path)
+        assert algo2.iteration == 1
+        a = jax.tree.leaves(algo.params)[0]
+        b = jax.tree.leaves(algo2.params)[0]
+        np.testing.assert_array_equal(a, b)
+        algo.stop(); algo2.stop()
+
+    def test_compute_single_action(self, ray_start):
+        cfg = PPOConfig(env="GridWorld", num_env_runners=1,
+                        num_envs_per_runner=2, rollout_length=8,
+                        hidden=(16,))
+        algo = PPO(cfg)
+        a = algo.compute_single_action(np.zeros(2, np.float32))
+        assert 0 <= a < 4
+        algo.stop()
+
+
+class TestGRPO:
+    def test_reward_improves(self):
+        target = 7
+
+        def reward_fn(completions):
+            return (completions == target).mean(axis=1)
+
+        cfg = GRPOConfig(reward_fn=reward_fn, num_prompts=4,
+                         group_size=4, prompt_len=4, max_new_tokens=8,
+                         lr=3e-3, seed=0)
+        algo = GRPO(cfg)
+        rewards = [algo.step()["reward_mean"] for _ in range(10)]
+        # policy should steer towards emitting the rewarded token
+        assert np.mean(rewards[-3:]) > np.mean(rewards[:3])
+
+    def test_metrics_shape(self):
+        cfg = GRPOConfig(reward_fn=lambda c: np.zeros(len(c)),
+                         num_prompts=2, group_size=2, prompt_len=4,
+                         max_new_tokens=4)
+        algo = GRPO(cfg)
+        res = algo.step()
+        for k in ("reward_mean", "loss", "pg_loss", "kl"):
+            assert np.isfinite(res[k])
+
+
+class TestReplayBuffer:
+    def test_fifo_and_sample(self):
+        buf = ReplayBuffer(capacity=8, seed=0)
+        buf.add_batch({"x": np.arange(6, dtype=np.float32)})
+        assert len(buf) == 6
+        buf.add_batch({"x": np.arange(6, 12, dtype=np.float32)})
+        assert len(buf) == 8  # wrapped
+        s = buf.sample(16)
+        assert s["x"].shape == (16,)
+        assert s["x"].max() >= 6  # newer data present
