@@ -1,0 +1,32 @@
+"""ray_tpu.workflow — durable, crash-resumable DAG execution.
+
+Capability-equivalent to the reference's Workflow library (reference:
+python/ray/workflow/ — SURVEY.md §2.3 Workflow row: every step's result
+checkpointed to storage, resumable, events, subworkflows via nested
+DAGs).
+"""
+
+from .api import (
+    FAILED,
+    RESUMABLE,
+    RUNNING,
+    SUCCESSFUL,
+    delete,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+    wait_for_event,
+)
+from .event import EventListener, QueueEventProvider, TimerListener
+from .storage import WorkflowStorage
+
+__all__ = [
+    "run", "run_async", "resume", "get_output", "get_status", "list_all",
+    "delete", "init", "wait_for_event", "EventListener", "TimerListener",
+    "QueueEventProvider", "WorkflowStorage", "RUNNING", "SUCCESSFUL",
+    "FAILED", "RESUMABLE",
+]

@@ -1,0 +1,124 @@
+"""Workflow public API.
+
+Capability-equivalent to the reference's workflow API (reference:
+python/ray/workflow/api.py — run :120, run_async :174, resume :251,
+get_output, get_status, list_all, delete, wait_for_event): durable,
+crash-resumable DAG execution. A workflow is a ray_tpu DAG built with
+`.bind()`; every step result is checkpointed before dependents run, so
+`resume()` after a crash replays completed steps from storage.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import uuid
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..dag.node import DAGNode
+from .event import EventListener, TimerListener
+from .executor import WorkflowExecutor
+from .storage import WorkflowStorage
+
+# Workflow status values (parity with the reference's WorkflowStatus).
+RUNNING = "RUNNING"
+SUCCESSFUL = "SUCCESSFUL"
+FAILED = "FAILED"
+RESUMABLE = "RESUMABLE"
+
+_default_storage: Optional[WorkflowStorage] = None
+_lock = threading.Lock()
+
+
+def init(storage_root: Optional[str] = None) -> None:
+    """Configure workflow storage (reference: workflow.init)."""
+    global _default_storage
+    with _lock:
+        _default_storage = WorkflowStorage(storage_root)
+
+
+def _storage() -> WorkflowStorage:
+    global _default_storage
+    with _lock:
+        if _default_storage is None:
+            _default_storage = WorkflowStorage()
+        return _default_storage
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None) -> Any:
+    """Execute a DAG durably; returns the final result."""
+    store = _storage()
+    wid = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
+    try:
+        store.save_dag(wid, pickle.dumps((dag, args)))
+    except Exception:  # noqa: BLE001 — unpicklable DAGs still run
+        pass
+    store.set_status(wid, RUNNING)
+    try:
+        result = WorkflowExecutor(store, wid).execute(dag, *args)
+    except Exception:
+        store.set_status(wid, RESUMABLE)
+        raise
+    store.save_output(wid, result)
+    store.set_status(wid, SUCCESSFUL)
+    return result
+
+
+def run_async(dag: DAGNode, *args,
+              workflow_id: Optional[str] = None) -> Future:
+    fut: Future = Future()
+
+    def target():
+        try:
+            fut.set_result(run(dag, *args, workflow_id=workflow_id))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=target, daemon=True).start()
+    return fut
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a failed/crashed workflow; completed steps replay from
+    storage (reference: workflow/api.py resume)."""
+    store = _storage()
+    if store.has_output(workflow_id):
+        return store.load_output(workflow_id)
+    blob = store.load_dag(workflow_id)
+    if blob is None:
+        raise ValueError(f"workflow {workflow_id!r} not found or its "
+                         "DAG was not persisted")
+    dag, args = pickle.loads(blob)
+    return run(dag, *args, workflow_id=workflow_id)
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    return _storage().get_status(workflow_id)
+
+
+def get_output(workflow_id: str) -> Any:
+    store = _storage()
+    if not store.has_output(workflow_id):
+        raise ValueError(f"workflow {workflow_id!r} has no output "
+                         f"(status={store.get_status(workflow_id)})")
+    return store.load_output(workflow_id)
+
+
+def list_all(status_filter: Optional[str] = None
+             ) -> List[Tuple[str, str]]:
+    out = _storage().list_workflows()
+    if status_filter:
+        out = [(w, s) for w, s in out if s == status_filter]
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    _storage().delete_workflow(workflow_id)
+
+
+def wait_for_event(listener: EventListener, timeout: Optional[float] = None
+                   ) -> Any:
+    """Block a workflow step on an external event (reference:
+    workflow/api.py wait_for_event + event_listener.py)."""
+    return listener.poll_for_event(timeout)

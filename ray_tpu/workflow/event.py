@@ -1,0 +1,54 @@
+"""Workflow event listeners.
+
+Capability-equivalent to the reference's event system (reference:
+python/ray/workflow/event_listener.py EventListener ABC + TimerListener,
+http_event_provider.py): a listener blocks a workflow step until an
+external event arrives.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+
+class EventListener:
+    def poll_for_event(self, timeout: Optional[float] = None) -> Any:
+        raise NotImplementedError
+
+
+class TimerListener(EventListener):
+    """Fires after a duration (reference: event_listener.py TimerListener)."""
+
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+    def poll_for_event(self, timeout: Optional[float] = None) -> Any:
+        time.sleep(self.seconds)
+        return time.time()
+
+
+class QueueEventProvider(EventListener):
+    """In-process event queue — post() from anywhere unblocks the step
+    (stand-in for the reference's HTTP event provider)."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._events: list = []
+
+    def post(self, event: Any) -> None:
+        with self._cv:
+            self._events.append(event)
+            self._cv.notify_all()
+
+    def poll_for_event(self, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while not self._events:
+                remain = (None if deadline is None
+                          else deadline - time.monotonic())
+                if remain is not None and remain <= 0:
+                    raise TimeoutError("no event before timeout")
+                self._cv.wait(remain)
+            return self._events.pop(0)

@@ -1,0 +1,113 @@
+"""Durable workflow storage.
+
+Capability-equivalent to the reference's workflow storage layer
+(reference: python/ray/workflow/workflow_storage.py — step-result
+persistence keyed by workflow_id + step id, workflow status/metadata,
+`ray.storage` filesystem backends): a directory tree
+
+    <root>/<workflow_id>/status.json
+    <root>/<workflow_id>/steps/<step_key>.pkl
+
+Results are written atomically (tmp + rename) so a crash mid-write never
+leaves a readable-but-torn step result.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+_DEFAULT_ROOT = os.path.join(
+    tempfile.gettempdir(), "ray_tpu_workflows")
+
+
+class WorkflowStorage:
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or os.environ.get(
+            "RAY_TPU_WORKFLOW_ROOT", _DEFAULT_ROOT)
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- workflow-level ----------------------------------------------
+    def _wf_dir(self, workflow_id: str) -> str:
+        return os.path.join(self.root, workflow_id)
+
+    def list_workflows(self) -> List[Tuple[str, str]]:
+        out = []
+        if not os.path.isdir(self.root):
+            return out
+        for wid in sorted(os.listdir(self.root)):
+            status = self.get_status(wid)
+            if status is not None:
+                out.append((wid, status))
+        return out
+
+    def set_status(self, workflow_id: str, status: str,
+                   extra: Optional[Dict[str, Any]] = None) -> None:
+        d = self._wf_dir(workflow_id)
+        os.makedirs(d, exist_ok=True)
+        self._atomic_write(
+            os.path.join(d, "status.json"),
+            json.dumps({"status": status, **(extra or {})}).encode())
+
+    def get_status(self, workflow_id: str) -> Optional[str]:
+        p = os.path.join(self._wf_dir(workflow_id), "status.json")
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return json.load(f).get("status")
+
+    def delete_workflow(self, workflow_id: str) -> None:
+        import shutil
+        shutil.rmtree(self._wf_dir(workflow_id), ignore_errors=True)
+
+    # -- step-level ---------------------------------------------------
+    def _step_path(self, workflow_id: str, step_key: str) -> str:
+        return os.path.join(self._wf_dir(workflow_id), "steps",
+                            f"{step_key}.pkl")
+
+    def has_step(self, workflow_id: str, step_key: str) -> bool:
+        return os.path.exists(self._step_path(workflow_id, step_key))
+
+    def save_step(self, workflow_id: str, step_key: str,
+                  result: Any) -> None:
+        p = self._step_path(workflow_id, step_key)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        self._atomic_write(p, pickle.dumps(result))
+
+    def load_step(self, workflow_id: str, step_key: str) -> Any:
+        with open(self._step_path(workflow_id, step_key), "rb") as f:
+            return pickle.load(f)
+
+    def save_output(self, workflow_id: str, result: Any) -> None:
+        self.save_step(workflow_id, "__output__", result)
+
+    def load_output(self, workflow_id: str) -> Any:
+        return self.load_step(workflow_id, "__output__")
+
+    def has_output(self, workflow_id: str) -> bool:
+        return self.has_step(workflow_id, "__output__")
+
+    # -- dag persistence (for resume) ---------------------------------
+    def save_dag(self, workflow_id: str, dag_bytes: bytes) -> None:
+        d = self._wf_dir(workflow_id)
+        os.makedirs(d, exist_ok=True)
+        self._atomic_write(os.path.join(d, "dag.pkl"), dag_bytes)
+
+    def load_dag(self, workflow_id: str) -> Optional[bytes]:
+        p = os.path.join(self._wf_dir(workflow_id), "dag.pkl")
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+    @staticmethod
+    def _atomic_write(path: str, data: bytes) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)

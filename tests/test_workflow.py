@@ -1,0 +1,153 @@
+"""Workflow tests (reference style: python/ray/workflow/tests —
+durability, resume-skips-completed-steps, failure status, events)."""
+
+import threading
+import time
+
+import pytest
+
+
+@pytest.fixture
+def wf(ray_start, tmp_path):
+    from ray_tpu import workflow
+    workflow.init(str(tmp_path / "wf"))
+    yield workflow
+
+
+def test_linear_dag(wf, ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def one():
+        return 1
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    dag = add.bind(one.bind(), 10)
+    assert wf.run(dag, workflow_id="lin") == 11
+    assert wf.get_status("lin") == wf.SUCCESSFUL
+    assert wf.get_output("lin") == 11
+
+
+def test_diamond_shares_step(wf, ray_start):
+    ray = ray_start
+    calls = {"n": 0}
+
+    @ray.remote
+    def base():
+        calls["n"] += 1
+        return 5
+
+    @ray.remote
+    def double(x):
+        return 2 * x
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    b = base.bind()
+    dag = add.bind(double.bind(b), double.bind(b))
+    assert wf.run(dag) == 20
+    assert calls["n"] == 1  # shared dep executed once
+
+
+def test_resume_skips_completed(wf, ray_start, tmp_path):
+    ray = ray_start
+    marker = tmp_path / "fail_once"
+    marker.write_text("fail")
+    counts = {"a": 0, "b": 0}
+
+    @ray.remote
+    def step_a():
+        counts["a"] += 1
+        return 7
+
+    @ray.remote
+    def flaky(x):
+        counts["b"] += 1
+        if marker.exists():
+            raise RuntimeError("injected crash")
+        return x * 3
+
+    dag = flaky.bind(step_a.bind())
+    with pytest.raises(Exception):
+        wf.run(dag, workflow_id="crashy")
+    assert wf.get_status("crashy") == wf.RESUMABLE
+    assert counts == {"a": 1, "b": 1}
+
+    marker.unlink()
+    # Rebuild the same DAG (as a restarted driver would) and resume.
+    dag2 = flaky.bind(step_a.bind())
+    assert wf.run(dag2, workflow_id="crashy") == 21
+    assert counts["a"] == 1  # step_a replayed from storage, not re-run
+    assert counts["b"] == 2
+    assert wf.get_status("crashy") == wf.SUCCESSFUL
+
+
+def test_resume_api_replays_persisted_dag(wf, ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def inc(x):
+        return x + 1
+
+    wf.run(inc.bind(inc.bind(0)), workflow_id="p")
+    assert wf.resume("p") == 2  # output replay, no re-execution
+
+
+def test_list_and_delete(wf, ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def f():
+        return 1
+
+    wf.run(f.bind(), workflow_id="w1")
+    ids = [w for w, _ in wf.list_all()]
+    assert "w1" in ids
+    assert ("w1", wf.SUCCESSFUL) in wf.list_all(wf.SUCCESSFUL)
+    wf.delete("w1")
+    assert "w1" not in [w for w, _ in wf.list_all()]
+
+
+def test_run_async(wf, ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def slow():
+        time.sleep(0.1)
+        return "done"
+
+    fut = wf.run_async(slow.bind(), workflow_id="async1")
+    assert fut.result(timeout=30) == "done"
+
+
+def test_input_node(wf, ray_start):
+    ray = ray_start
+    from ray_tpu.dag import InputNode
+
+    @ray.remote
+    def mul(x, k):
+        return x * k
+
+    with InputNode() as inp:
+        dag = mul.bind(inp, 4)
+    assert wf.run(dag, 5) == 20
+
+
+def test_event_listener(wf, ray_start):
+    provider = wf.QueueEventProvider()
+
+    def poster():
+        time.sleep(0.1)
+        provider.post({"payload": 42})
+
+    threading.Thread(target=poster, daemon=True).start()
+    ev = wf.wait_for_event(provider, timeout=10)
+    assert ev == {"payload": 42}
+
+    with pytest.raises(TimeoutError):
+        wf.wait_for_event(wf.QueueEventProvider(), timeout=0.05)
