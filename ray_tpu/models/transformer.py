@@ -364,11 +364,10 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
     return logits, jnp.sum(aux)
 
 
-def loss_fn(cfg: TransformerConfig, params: Dict[str, Any],
-            tokens: jax.Array, targets: jax.Array,
-            mask: Optional[jax.Array] = None) -> Tuple[jax.Array, Dict]:
-    """Next-token cross entropy (mean over unmasked positions)."""
-    logits, aux = forward(cfg, params, tokens)
+def token_cross_entropy(logits: jax.Array, targets: jax.Array,
+                        mask: Optional[jax.Array], aux: jax.Array
+                        ) -> Tuple[jax.Array, Dict]:
+    """Next-token cross entropy (mean over unmasked positions) + metrics."""
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(
         logits, targets[..., None], axis=-1)[..., 0]
@@ -381,3 +380,10 @@ def loss_fn(cfg: TransformerConfig, params: Dict[str, Any],
     total = ce + aux
     return total, {"loss": total, "ce": ce, "aux": aux,
                    "tokens": jnp.sum(mask)}
+
+
+def loss_fn(cfg: TransformerConfig, params: Dict[str, Any],
+            tokens: jax.Array, targets: jax.Array,
+            mask: Optional[jax.Array] = None) -> Tuple[jax.Array, Dict]:
+    logits, aux = forward(cfg, params, tokens)
+    return token_cross_entropy(logits, targets, mask, aux)

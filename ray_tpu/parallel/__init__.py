@@ -1,4 +1,11 @@
 from .mesh import MeshSpec, make_mesh, mesh_devices
+from .pipeline import (
+    merge_layer_params,
+    partition_layer_params,
+    pipeline_forward,
+    pipeline_loss_fn,
+    pp_param_logical_axes,
+)
 from .plan import ParallelPlan
 from .sharding import (
     DEFAULT_RULES,
@@ -12,4 +19,6 @@ __all__ = [
     "MeshSpec", "make_mesh", "mesh_devices", "ParallelPlan",
     "DEFAULT_RULES", "logical_to_mesh_axes", "logical_to_sharding",
     "shard_pytree", "with_sharding_constraint",
+    "partition_layer_params", "merge_layer_params", "pipeline_forward",
+    "pipeline_loss_fn", "pp_param_logical_axes",
 ]

@@ -1,0 +1,185 @@
+"""Pipeline parallelism, compiled GPipe-style inside a single jit.
+
+New TPU-native capability: the reference has no in-framework pipeline
+parallelism (SURVEY.md §5 — PP is reached only through DeepSpeed/vLLM
+integrations). The TPU-idiomatic formulation avoids per-stage processes
+and hand-written sends entirely:
+
+- the stacked layer params (L, ...) are partitioned into (pp, L/pp, ...)
+  with the leading `stage` dim sharded over the `pp` mesh axis;
+- each pipeline tick runs every stage in parallel as a vmap over the
+  stage dim (one compiled stage body — same trick as lax.scan over
+  layers);
+- the stage hand-off is `jnp.roll` along the sharded stage dim, which
+  XLA lowers to a collective-permute riding ICI;
+- the whole (microbatch x tick) schedule is a lax.scan, so the bubble
+  structure is static and the compiler overlaps the permute with the
+  next tick's compute.
+
+This composes with dp/fsdp/ep/tp via sharding constraints: inside the
+pipeline body activations carry the usual logical axes. With pp > 1 the
+attention runs the einsum flash path under the automatic partitioner
+(the pallas kernel's shard_map manual region does not nest under the
+stage vmap); tp/sp sharding of attention then comes from XLA's own
+partitioning of the einsums.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .sharding import with_sharding_constraint as wsc
+
+
+def partition_layer_params(layers: Any, pp: int) -> Any:
+    """Reshape every stacked-layer leaf (L, ...) -> (pp, L/pp, ...)."""
+
+    def part(x):
+        L = x.shape[0]
+        if L % pp:
+            raise ValueError(f"n_layers={L} not divisible by pp={pp}")
+        return x.reshape((pp, L // pp) + x.shape[1:])
+
+    return jax.tree.map(part, layers)
+
+
+def merge_layer_params(layers: Any) -> Any:
+    """Inverse of partition_layer_params: (pp, L/pp, ...) -> (L, ...)."""
+    return jax.tree.map(
+        lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]),
+        layers)
+
+
+def pp_param_logical_axes(cfg) -> Dict[str, Any]:
+    """param_logical_axes with the layer leaves prefixed by the sharded
+    `stage` dim."""
+    from ..models.transformer import param_logical_axes
+
+    axes = dict(param_logical_axes(cfg))
+    axes["layers"] = {
+        k: ("stage",) + tuple(v)
+        for k, v in axes["layers"].items()
+    }
+    return axes
+
+
+def _pipeline_cfg(cfg, mesh_sizes: Dict[str, int]):
+    """Under the stage vmap, attention can neither enter a shard_map
+    manual region nor emit a pallas custom call (opaque to the GSPMD
+    partitioner while its operands are sharded over pp); force the
+    auto-partitioned einsum path whenever any mesh axis is sharded."""
+    used = {a for a, n in mesh_sizes.items() if n > 1} & {
+        "dcn", "pp", "dp", "fsdp", "ep", "tp", "sp"}
+    if used and cfg.attn_impl != "reference":
+        from dataclasses import replace
+        return replace(cfg, attn_impl="reference")
+    return cfg
+
+
+def pipeline_forward(cfg, params: Dict[str, Any], tokens: jax.Array,
+                     *, pp: int, num_microbatches: Optional[int] = None
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """GPipe forward: tokens (B, S) -> (logits (B, S, V) f32, aux_loss).
+
+    params["layers"] must be stage-partitioned (pp, L/pp, ...).
+    B must be divisible by num_microbatches (default pp).
+    """
+    from ..models.transformer import _layer, rms_norm, rope_tables
+
+    M = num_microbatches or pp
+    B, S = tokens.shape
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mb = B // M
+    D = cfg.d_model
+
+    try:
+        mesh_sizes = dict(jax.sharding.get_abstract_mesh().shape or {})
+    except Exception:  # noqa: BLE001 — no ambient mesh
+        mesh_sizes = {}
+    cfg = _pipeline_cfg(cfg, mesh_sizes)
+
+    sin, cos = rope_tables(cfg, S)
+
+    # Embed every microbatch up front; keep the microbatch dim unsharded
+    # and the within-microbatch batch dim on the data axes.
+    x = params["embed"].astype(cfg.dtype)[tokens]            # (B, S, D)
+    x_mb = x.reshape(M, mb, S, D)
+    x_mb = wsc(x_mb, (None, "batch", "seq", "act_embed"))
+
+    layer = partial(_layer, cfg)
+    if cfg.remat:
+        layer = jax.checkpoint(layer)
+
+    def stage_fn(stage_lp, x):
+        """Run one stage's layer stack on its current microbatch."""
+        (x, _, _), aux = lax.scan(layer, (x, sin, cos), stage_lp)
+        return x, jnp.sum(aux)
+
+    vstage = jax.vmap(stage_fn, in_axes=(0, 0))
+
+    state0 = jnp.zeros((pp, mb, S, D), cfg.dtype)
+    out0 = jnp.zeros((M, mb, S, D), cfg.dtype)
+    stage_ids = jnp.arange(pp)
+
+    def tick(carry, t):
+        state, outputs, aux = carry
+        # Stage 0 ingests microbatch t (bubble ticks recycle the last one;
+        # their results are masked out).
+        inp = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+        state = state.at[0].set(inp)
+        state = wsc(state, ("stage", "batch", "seq", "act_embed"))
+
+        new_state, aux_t = vstage(params["layers"], state)
+        new_state = wsc(new_state, ("stage", "batch", "seq", "act_embed"))
+
+        # Stage s at tick t is computing microbatch t - s; only count its
+        # aux loss when that is a real microbatch.
+        valid = (t - stage_ids >= 0) & (t - stage_ids < M)
+        aux = aux + jnp.sum(jnp.where(valid, aux_t, 0.0))
+
+        # Collect the last stage's finished microbatch (index t-(pp-1)).
+        out_idx = t - (pp - 1)
+        done = new_state[pp - 1]
+        outputs = lax.cond(
+            out_idx >= 0,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, done.astype(o.dtype), jnp.maximum(out_idx, 0), axis=0),
+            lambda o: o,
+            outputs)
+
+        # Hand each stage's result to the next stage: a roll along the
+        # pp-sharded dim == collective-permute over ICI.
+        state = jnp.roll(new_state, 1, axis=0)
+        return (state, outputs, aux), None
+
+    (_, outputs, aux), _ = lax.scan(
+        tick, (state0, out0, jnp.zeros((), jnp.float32)),
+        jnp.arange(M + pp - 1))
+
+    x = outputs.reshape(B, S, D)
+    x = wsc(x, ("batch", "seq", "act_embed"))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(cfg.dtype)
+    logits = (x @ head).astype(jnp.float32)
+    logits = wsc(logits, ("batch", "seq", "act_vocab"))
+    return logits, aux / M
+
+
+def pipeline_loss_fn(cfg, params, tokens, targets,
+                     mask: Optional[jax.Array] = None, *,
+                     pp: int, num_microbatches: Optional[int] = None
+                     ) -> Tuple[jax.Array, Dict]:
+    """Next-token cross entropy through the pipelined forward."""
+    from ..models.transformer import token_cross_entropy
+
+    logits, aux = pipeline_forward(
+        cfg, params, tokens, pp=pp, num_microbatches=num_microbatches)
+    return token_cross_entropy(logits, targets, mask, aux)

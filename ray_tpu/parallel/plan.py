@@ -30,7 +30,9 @@ class ParallelPlan:
     tp    — tensor parallel (megatron-style: shard heads/mlp)
     sp    — sequence/context parallel (ring attention / all-to-all)
     ep    — expert parallel (MoE expert sharding + all-to-all dispatch)
-    pp    — pipeline parallel (stage-per-actor over channels)
+    pp    — pipeline parallel (GPipe schedule compiled into the jit:
+            stage-sharded layer stack + collective-permute hand-offs,
+            parallel/pipeline.py)
     dcn   — outermost data-parallel axis across slices (multi-host DCN)
     """
 
@@ -48,20 +50,20 @@ class ParallelPlan:
                 raise ValueError(f"axis {name} must be >=1, got {v}")
 
     def axis_sizes(self) -> Dict[str, int]:
-        return {"dcn": self.dcn, "dp": self.dp, "fsdp": self.fsdp,
-                "ep": self.ep, "sp": self.sp, "tp": self.tp}
+        """Mesh axes, outermost (least-communicating) first. `pp` sits
+        between the DCN axis and the intra-stage axes: stage hand-offs are
+        a single activation collective-permute per tick, far lighter than
+        tp/sp traffic, so pp gets the longer ICI paths."""
+        return {"dcn": self.dcn, "pp": self.pp, "dp": self.dp,
+                "fsdp": self.fsdp, "ep": self.ep, "sp": self.sp,
+                "tp": self.tp}
 
     @property
     def num_devices(self) -> int:
-        """Devices needed per pipeline stage group."""
         n = 1
         for v in self.axis_sizes().values():
             n *= v
         return n
-
-    @property
-    def total_devices(self) -> int:
-        return self.num_devices * self.pp
 
     @property
     def mesh_axis_names(self) -> Tuple[str, ...]:
@@ -88,6 +90,4 @@ class ParallelPlan:
 
     def describe(self) -> str:
         parts = [f"{k}={v}" for k, v in self.axis_sizes().items() if v > 1]
-        if self.pp > 1:
-            parts.append(f"pp={self.pp}")
         return "ParallelPlan(" + (", ".join(parts) or "single-device") + ")"

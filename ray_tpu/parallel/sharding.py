@@ -51,6 +51,9 @@ DEFAULT_RULES: Rules = (
     ("head_dim", None),
     ("layers", None),
     ("norm", None),
+    # pipeline parallelism: the partitioned layer stack's leading stage
+    # dim and the per-stage activation buffers ride the pp mesh axis
+    ("stage", "pp"),
 )
 
 

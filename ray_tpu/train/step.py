@@ -64,11 +64,14 @@ def init_state(cfg: TransformerConfig, mesh, optimizer,
     return TrainState(step=step, params=params, opt_state=opt_state)
 
 
-def make_train_step(cfg: TransformerConfig, optimizer):
+def make_train_step(cfg: TransformerConfig, optimizer, *, loss=None):
     """Returns step(state, tokens, targets, mask) -> (state, metrics),
-    jit-compiled; call under `jax.sharding.set_mesh(mesh)`."""
+    jit-compiled; call under `jax.sharding.set_mesh(mesh)`. `loss`
+    overrides the loss closure (signature of loss_fn minus cfg)."""
 
     def _loss(params, tokens, targets, mask):
+        if loss is not None:
+            return loss(params, tokens, targets, mask)
         return loss_fn(cfg, params, tokens, targets, mask)
 
     @partial(jax.jit, donate_argnums=(0,))
@@ -93,6 +96,43 @@ def shard_batch(batch: Dict[str, jax.Array], mesh) -> Dict[str, jax.Array]:
     """Place a host batch onto the mesh with (batch, seq) sharding."""
     sh = logical_to_sharding(("batch", "seq"), mesh)
     return {k: jax.device_put(v, sh) for k, v in batch.items()}
+
+
+def init_pp_state(cfg: TransformerConfig, mesh, optimizer, *, pp: int,
+                  seed: int = 0) -> TrainState:
+    """init_state with the layer stack partitioned into pp stages, each
+    leaf sharded (stage -> pp mesh axis) at init (no host round-trip)."""
+    from ..parallel.pipeline import (
+        partition_layer_params,
+        pp_param_logical_axes,
+    )
+
+    p_shardings = tree_shardings(pp_param_logical_axes(cfg), mesh)
+
+    @partial(jax.jit, out_shardings=p_shardings)
+    def _init(key):
+        params = init_params(cfg, key)
+        params["layers"] = partition_layer_params(params["layers"], pp)
+        return params
+
+    with jax.sharding.set_mesh(mesh):
+        params = _init(jax.random.key(seed))
+        opt_state = jax.jit(optimizer.init)(params)
+        step = jnp.zeros((), jnp.int32)
+    return TrainState(step=step, params=params, opt_state=opt_state)
+
+
+def make_pp_train_step(cfg: TransformerConfig, optimizer, *, pp: int,
+                       num_microbatches: Optional[int] = None):
+    """Pipelined train step (GPipe schedule compiled into the jit; see
+    parallel/pipeline.py). Same signature as make_train_step."""
+    from ..parallel.pipeline import pipeline_loss_fn
+
+    def _loss(params, tokens, targets, mask):
+        return pipeline_loss_fn(cfg, params, tokens, targets, mask,
+                                pp=pp, num_microbatches=num_microbatches)
+
+    return make_train_step(cfg, optimizer, loss=_loss)
 
 
 def make_eval_step(cfg: TransformerConfig):

@@ -29,8 +29,8 @@ def test_plan_auto():
 
 def test_make_mesh_shapes(cpu_mesh8):
     mesh = make_mesh(ParallelPlan(dp=2, tp=4), devices=cpu_mesh8)
-    assert mesh.axis_names == ("dcn", "dp", "fsdp", "ep", "sp", "tp")
-    assert mesh.devices.shape == (1, 2, 1, 1, 1, 4)
+    assert mesh.axis_names == ("dcn", "pp", "dp", "fsdp", "ep", "sp", "tp")
+    assert mesh.devices.shape == (1, 1, 2, 1, 1, 1, 4)
 
 
 def test_make_mesh_too_few_devices(cpu_mesh8):
