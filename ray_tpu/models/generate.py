@@ -1,0 +1,263 @@
+"""Autoregressive generation: prefill/decode split with a static KV cache.
+
+TPU-native inference path (the reference serves LLMs only through vLLM
+integration — SURVEY.md §2.3 Serve row, doc vllm_example.py; this is
+in-framework capability). Design for XLA's compilation model:
+
+- **Static shapes everywhere.** The KV cache is a fixed (L, B, S_max,
+  KVH, Dh) buffer; sequences occupy slots. Prompt lengths are bucketed
+  (powers of two) so prefill compiles once per bucket, decode compiles
+  once, period.
+- **Prefill/decode split.** Prefill runs the full prompt through the
+  flash-attention forward (MXU-heavy, one sequence at a time into its
+  slot); decode runs one token for ALL slots per step (batched matmuls
+  keep the MXU fed; attention reads the cache with a length mask).
+- **Per-slot positions.** Each slot sits at its own position; RoPE tables
+  are gathered per slot, so one compiled decode step serves any mix of
+  sequence lengths (the continuous-batching property).
+
+The cache favors a contiguous per-slot layout over a paged one: with
+slot-bucketed static shapes XLA keeps the whole cache resident in HBM,
+prefill writes are dynamic-update-slices and decode writes are one-row
+scatters; a page table would force gathers on the attention read path.
+Capacity control comes from S_max buckets instead of pages.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.sharding import with_sharding_constraint as wsc
+from .transformer import (
+    TransformerConfig,
+    apply_rope,
+    dense_ffn,
+    moe_ffn,
+    rms_norm,
+    rope_tables,
+)
+
+
+class KVCache(NamedTuple):
+    """Static decode state. k/v: (L, B, S_max, KVH, Dh) activation dtype;
+    seq_lens: (B,) int32 — tokens already written per slot."""
+
+    k: jax.Array
+    v: jax.Array
+    seq_lens: jax.Array
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+
+def init_kv_cache(cfg: TransformerConfig, num_slots: int,
+                  max_seq_len: Optional[int] = None) -> KVCache:
+    S = max_seq_len or cfg.max_seq_len
+    shape = (cfg.n_layers, num_slots, S, cfg.n_kv_heads, cfg.head_dim)
+    k = jnp.zeros(shape, cfg.dtype)
+    k = wsc(k, ("layers", None, None, "act_kv_heads", None))
+    v = jnp.zeros(shape, cfg.dtype)
+    v = wsc(v, ("layers", None, None, "act_kv_heads", None))
+    return KVCache(k=k, v=v, seq_lens=jnp.zeros((num_slots,), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Layer bodies (reuse transformer pieces; differ only in KV handling)
+# ---------------------------------------------------------------------------
+
+def _rope(x, sin, cos):
+    """apply_rope accepting either shared (S, half) tables or per-slot
+    (B, S, half) tables (decode: every slot is at its own position)."""
+    if sin.ndim == 2:
+        return apply_rope(x, sin, cos)
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    sin = sin[:, :, None, :].astype(x.dtype)     # (B, S, 1, half)
+    cos = cos[:, :, None, :].astype(x.dtype)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _qkv(cfg: TransformerConfig, lp, x, sin, cos):
+    B, S, _ = x.shape
+    H, KVH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q = (x @ lp["wq"].astype(x.dtype)).reshape(B, S, H, Dh)
+    k = (x @ lp["wk"].astype(x.dtype)).reshape(B, S, KVH, Dh)
+    v = (x @ lp["wv"].astype(x.dtype)).reshape(B, S, KVH, Dh)
+    return _rope(q, sin, cos), _rope(k, sin, cos), v
+
+
+def _ffn(cfg: TransformerConfig, lp, x):
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    if cfg.is_moe:
+        f, _ = moe_ffn(cfg, lp, h)
+    else:
+        f = dense_ffn(lp, h)
+    return x + f
+
+
+def _prefill_layer(cfg: TransformerConfig, carry, lp):
+    """Full-prompt layer body; emits this layer's (k, v) for the cache."""
+    from ..ops import flash_attention
+
+    x, sin, cos = carry
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q, k, v = _qkv(cfg, lp, h, sin, cos)
+    force_ref = jax.default_backend() != "tpu"
+    out = flash_attention(q, k, v, causal=True, force_reference=force_ref)
+    B, S, _, _ = q.shape
+    x = x + (out.reshape(B, S, -1) @ lp["wo"].astype(x.dtype))
+    x = _ffn(cfg, lp, x)
+    return (x, sin, cos), (k, v)
+
+
+def _decode_layer(cfg: TransformerConfig, carry, scanned):
+    """One-token layer body reading/writing the KV cache.
+
+    carry: (x (B,1,D), sin (B,1,half), cos, positions (B,))
+    scanned: (lp, k_cache (B,S,KVH,Dh), v_cache)
+    """
+    x, sin, cos, positions = carry
+    lp, k_cache, v_cache = scanned
+    B, S = k_cache.shape[0], k_cache.shape[1]
+    H, KVH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q, k, v = _qkv(cfg, lp, h, sin, cos)       # q (B,1,H,Dh); k,v (B,1,KVH,Dh)
+
+    # Write new kv at each slot's position. A true scatter (one row per
+    # slot), overwriting — prefill leaves pad-position kv beyond
+    # `length`, so the target row may hold stale values.
+    rows = jnp.arange(B)
+    k_cache = k_cache.at[rows, positions].set(k[:, 0])
+    v_cache = v_cache.at[rows, positions].set(v[:, 0])
+
+    # GQA decode attention over the cache with a length mask.
+    G = H // KVH
+    qg = q.reshape(B, KVH, G, Dh)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) / (Dh ** 0.5)
+    valid = (jnp.arange(S)[None, :] <= positions[:, None])  # (B, S)
+    scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(k_cache.dtype)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v_cache)
+    out = out.reshape(B, 1, H * Dh)
+
+    x = x + (out @ lp["wo"].astype(x.dtype))
+    x = _ffn(cfg, lp, x)
+    return (x, sin, cos, positions), (k_cache, v_cache)
+
+
+# ---------------------------------------------------------------------------
+# Prefill / decode steps
+# ---------------------------------------------------------------------------
+
+def _head_logits(cfg: TransformerConfig, params, x):
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(cfg.dtype)
+    return (x @ head).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def prefill(cfg: TransformerConfig, params, cache: KVCache,
+            tokens: jax.Array, length: jax.Array, slot: jax.Array
+            ) -> Tuple[KVCache, jax.Array]:
+    """Run one padded prompt (1, S_bucket) through the model, write its
+    KV into `slot`, return last-real-token logits (V,).
+
+    `length` = real prompt length; `slot` = cache row. Compiles once per
+    (S_bucket,) — callers bucket prompt lengths.
+    """
+    S = tokens.shape[1]
+    x = params["embed"].astype(cfg.dtype)[tokens]          # (1, S, D)
+    sin, cos = rope_tables(cfg, S)
+
+    layer = partial(_prefill_layer, cfg)
+    (x, _, _), (ks, vs) = lax.scan(layer, (x, sin, cos), params["layers"])
+    # ks: (L, 1, S, KVH, Dh) → write into cache[:, slot, :S]
+    k = lax.dynamic_update_slice(
+        cache.k, ks.astype(cache.k.dtype),
+        (0, slot, 0, 0, 0))
+    v = lax.dynamic_update_slice(
+        cache.v, vs.astype(cache.v.dtype),
+        (0, slot, 0, 0, 0))
+    seq_lens = cache.seq_lens.at[slot].set(length)
+
+    logits = _head_logits(cfg, params, x)                  # (1, S, V)
+    last = jnp.take_along_axis(
+        logits, (length - 1)[None, None, None].astype(jnp.int32),
+        axis=1)[0, 0]
+    return KVCache(k=k, v=v, seq_lens=seq_lens), last
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def decode_step(cfg: TransformerConfig, params, cache: KVCache,
+                tokens: jax.Array) -> Tuple[KVCache, jax.Array]:
+    """One decode step for every slot. tokens: (B,) int32 (last emitted
+    token per slot). Returns (cache', logits (B, V)). Slots advance their
+    seq_lens by 1; inactive slots are advanced too — the host engine
+    simply ignores their output and reuses the slot via prefill."""
+    B = cache.num_slots
+    positions = cache.seq_lens                              # (B,)
+    x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]  # (B,1,D)
+
+    sin_t, cos_t = rope_tables(cfg, cache.max_seq_len)
+    sin = sin_t[positions][:, None, :]                      # (B,1,half)
+    cos = cos_t[positions][:, None, :]
+
+    # Scan over layers, threading each layer's cache rows.
+    layer = partial(_decode_layer, cfg)
+    (x, _, _, _), (k_new, v_new) = lax.scan(
+        layer, (x, sin, cos, positions),
+        (params["layers"], cache.k, cache.v))
+
+    logits = _head_logits(cfg, params, x)[:, 0]             # (B, V)
+    return KVCache(k=k_new, v=v_new, seq_lens=positions + 1), logits
+
+
+def sample(logits: jax.Array, key: jax.Array, *,
+           temperature=0.0, top_k: int = 0) -> jax.Array:
+    """Greedy (temperature<=0) or temperature/top-k sampling.
+    (..., V) -> (...,). `temperature` may be a scalar or a per-row array
+    (continuous batching: each slot has its own config)."""
+    temps = jnp.broadcast_to(
+        jnp.asarray(temperature, jnp.float32), logits.shape[:-1])
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    scaled = logits / jnp.maximum(temps, 1e-6)[..., None]
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+def greedy_generate(cfg: TransformerConfig, params, prompt: jax.Array,
+                    max_new_tokens: int) -> jax.Array:
+    """Reference single-sequence generation (tests / simple use):
+    prefill then greedy decode. prompt: (S,) int32 → (max_new_tokens,)."""
+    S = int(prompt.shape[0])
+    bucket = max(8, 1 << (S - 1).bit_length())
+    cache = init_kv_cache(cfg, num_slots=1,
+                          max_seq_len=bucket + max_new_tokens)
+    padded = jnp.zeros((1, bucket), jnp.int32).at[0, :S].set(prompt)
+    cache, logits = prefill(cfg, params, cache, padded,
+                            jnp.int32(S), jnp.int32(0))
+    out = []
+    tok = jnp.argmax(logits)[None].astype(jnp.int32)
+    for _ in range(max_new_tokens):
+        out.append(int(tok[0]))
+        cache, logits = decode_step(cfg, params, cache, tok)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.asarray(out, jnp.int32)

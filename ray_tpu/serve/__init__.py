@@ -14,9 +14,11 @@ from .deployment import (
     deployment,
 )
 from .handle import DeploymentHandle
+from .llm import GenRequest, LLMEngine, LLMServer
 
 __all__ = [
     "deployment", "Deployment", "DeploymentConfig", "AutoscalingConfig",
     "Application", "run", "delete", "shutdown", "status",
     "get_deployment_handle", "DeploymentHandle", "batch", "multiplexed",
+    "LLMEngine", "LLMServer", "GenRequest",
 ]

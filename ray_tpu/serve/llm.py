@@ -1,0 +1,343 @@
+"""Continuous-batching LLM inference engine + serve deployment.
+
+TPU-native serving path (SURVEY.md §7 step 9: "continuous batching
+replica — KV cache in HBM, prefill/decode split — for the Llama-8B
+serving target"; the reference delegates this entirely to vLLM,
+doc/source/serve/doc_code/vllm_example.py).
+
+Engine design around XLA's static shapes:
+- a fixed pool of `num_slots` sequence slots backed by one static KV
+  cache (models/generate.py); admission = prefill into a free slot,
+  one bucketed-compile per prompt-length bucket;
+- every engine tick runs ONE compiled decode step for ALL slots (the
+  continuous-batching property: sequences join/leave between ticks,
+  the compiled program never changes shape);
+- per-slot temperature rides a (B,) operand, so mixed sampling configs
+  share the tick; finished slots are ignored until readmission.
+
+TTFT = submit→first-token (prefill-bound); per-request metrics are
+recorded for the serving benchmark (BASELINE.md north-star: req/s +
+p50 TTFT).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generate import (
+    KVCache,
+    decode_step,
+    init_kv_cache,
+    prefill,
+)
+from ..models.transformer import TransformerConfig, init_params
+
+
+def default_buckets(max_prompt_len: int) -> List[int]:
+    out, b = [], 16
+    while b < max_prompt_len:
+        out.append(b)
+        b *= 2
+    out.append(max_prompt_len)
+    return out
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _sample_batch(logits: jax.Array, temps: jax.Array, key: jax.Array,
+                  top_k: int) -> jax.Array:
+    """(B,V) logits -> (B,) tokens; temp<=0 slots decode greedily."""
+    from ..models.generate import sample
+
+    return sample(logits, key, temperature=temps, top_k=top_k)
+
+
+@dataclass
+class GenRequest:
+    prompt: List[int]
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    eos_token: Optional[int] = None
+    # filled by the engine
+    id: int = 0
+    submit_ts: float = 0.0
+    first_token_ts: float = 0.0
+    finish_ts: float = 0.0
+    stream: "queue.Queue" = field(default_factory=queue.Queue)
+    tokens: List[int] = field(default_factory=list)
+    error: Optional[str] = None
+
+    @property
+    def ttft_s(self) -> float:
+        return self.first_token_ts - self.submit_ts
+
+    @property
+    def latency_s(self) -> float:
+        return self.finish_ts - self.submit_ts
+
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            tok = self.stream.get()
+            if tok is None:
+                if self.error is not None:
+                    raise RuntimeError(f"generation failed: {self.error}")
+                return
+            yield tok
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        out = []
+        while True:
+            left = (max(0.0, deadline - time.monotonic())
+                    if deadline is not None else None)
+            tok = self.stream.get(timeout=left)
+            if tok is None:
+                if self.error is not None:
+                    raise RuntimeError(
+                        f"generation failed: {self.error}")
+                return out
+            out.append(tok)
+
+
+class _Slot:
+    __slots__ = ("req", "emitted", "length")
+
+    def __init__(self, req: GenRequest, prompt_len: int):
+        self.req = req
+        self.emitted = 0
+        self.length = prompt_len  # tokens in cache (grows per tick)
+
+
+class LLMEngine:
+    """Host-side continuous-batching loop over the compiled
+    prefill/decode steps. Thread-safe submit; `step()` is driven either
+    by `run_forever()` (background thread) or manually (tests)."""
+
+    def __init__(self, cfg: TransformerConfig, params: Any, *,
+                 num_slots: int = 4, max_seq_len: Optional[int] = None,
+                 top_k: int = 0, seed: int = 0):
+        self.cfg = cfg
+        self.params = params
+        self.num_slots = num_slots
+        self.max_seq_len = max_seq_len or cfg.max_seq_len
+        self.top_k = top_k
+        self.cache: KVCache = init_kv_cache(cfg, num_slots, self.max_seq_len)
+        self.cur_tokens = jnp.zeros((num_slots,), jnp.int32)
+        self._temps = np.zeros((num_slots,), np.float32)
+        self._key = jax.random.key(seed)
+        self.slots: List[Optional[_Slot]] = [None] * num_slots
+        self.waiting: deque = deque()
+        self.lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = False
+        self._next_id = 0
+        self.buckets = default_buckets(self.max_seq_len)
+        # aggregate stats
+        self.decode_ticks = 0
+        self.tokens_out = 0
+        self.finished: List[Dict[str, float]] = []
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *, max_new_tokens: int = 64,
+               temperature: float = 0.0,
+               eos_token: Optional[int] = None) -> GenRequest:
+        if self._stop:
+            raise RuntimeError("engine is stopped")
+        if len(prompt) >= self.max_seq_len:
+            raise ValueError(
+                f"prompt len {len(prompt)} >= max_seq_len {self.max_seq_len}")
+        req = GenRequest(prompt=list(prompt), max_new_tokens=max_new_tokens,
+                         temperature=temperature, eos_token=eos_token)
+        with self.lock:
+            req.id = self._next_id
+            self._next_id += 1
+        req.submit_ts = time.monotonic()
+        with self.lock:
+            self.waiting.append(req)
+        self._work.set()
+        return req
+
+    # -- engine internals ---------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _emit(self, slot: _Slot, tok: int) -> None:
+        slot.req.tokens.append(tok)
+        slot.req.stream.put(tok)
+        slot.emitted += 1
+        slot.length += 1
+        self.tokens_out += 1
+
+    def _finish(self, idx: int) -> None:
+        slot = self.slots[idx]
+        slot.req.finish_ts = time.monotonic()
+        slot.req.stream.put(None)
+        self.finished.append({
+            "id": slot.req.id,
+            "ttft_s": slot.req.ttft_s,
+            "latency_s": slot.req.latency_s,
+            "new_tokens": slot.emitted,
+        })
+        self.slots[idx] = None
+
+    def _admit(self) -> None:
+        """Prefill waiting requests into free slots."""
+        while True:
+            with self.lock:
+                free = [i for i, s in enumerate(self.slots) if s is None]
+                if not free or not self.waiting:
+                    return
+                req = self.waiting.popleft()
+            idx = free[0]
+            plen = len(req.prompt)
+            bucket = self._bucket_for(plen)
+            padded = jnp.zeros((1, bucket), jnp.int32).at[0, :plen].set(
+                jnp.asarray(req.prompt, jnp.int32))
+            try:
+                self.cache, logits = prefill(
+                    self.cfg, self.params, self.cache, padded,
+                    jnp.int32(plen), jnp.int32(idx))
+            except Exception:
+                # put it back so _fail_all can notify its client
+                with self.lock:
+                    self.waiting.appendleft(req)
+                raise
+            self._key, sub = jax.random.split(self._key)
+            tok = int(_sample_batch(
+                logits[None], jnp.asarray([req.temperature], jnp.float32),
+                sub, self.top_k)[0])
+            req.first_token_ts = time.monotonic()
+            slot = _Slot(req, plen)
+            self.slots[idx] = slot
+            self._temps[idx] = req.temperature
+            self.cur_tokens = self.cur_tokens.at[idx].set(tok)
+            self._emit(slot, tok)
+            if (tok == req.eos_token or slot.emitted >= req.max_new_tokens):
+                self._finish(idx)
+
+    def step(self) -> bool:
+        """One engine tick: admit, then one decode step for all slots.
+        Returns False when there is nothing to do."""
+        self._admit()
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return False
+
+        self.cache, logits = decode_step(
+            self.cfg, self.params, self.cache, self.cur_tokens)
+        self._key, sub = jax.random.split(self._key)
+        toks = _sample_batch(
+            logits, jnp.asarray(self._temps), sub, self.top_k)
+        self.cur_tokens = toks
+        host_toks = np.asarray(toks)
+        self.decode_ticks += 1
+
+        for i in active:
+            slot = self.slots[i]
+            tok = int(host_toks[i])
+            self._emit(slot, tok)
+            done = (tok == slot.req.eos_token
+                    or slot.emitted >= slot.req.max_new_tokens
+                    or slot.length >= self.max_seq_len - 1)
+            if done:
+                self._finish(i)
+        return True
+
+    def run_forever(self) -> None:
+        while not self._stop:
+            try:
+                busy = self.step()
+            except Exception as e:  # noqa: BLE001 — device/XLA errors
+                self._fail_all(e)
+                raise
+            if not busy:
+                self._work.clear()
+                self._work.wait(timeout=0.1)
+
+    def _fail_all(self, exc: Exception) -> None:
+        """A step blew up (OOM, XLA error): unblock every waiting client
+        with the error instead of hanging their streams forever."""
+        self._stop = True
+        msg = f"{type(exc).__name__}: {exc}"
+        with self.lock:
+            pending = list(self.waiting)
+            self.waiting.clear()
+        for i, slot in enumerate(self.slots):
+            if slot is not None:
+                slot.req.error = msg
+                slot.req.finish_ts = time.monotonic()
+                slot.req.stream.put(None)
+                self.slots[i] = None
+        for req in pending:
+            req.error = msg
+            req.finish_ts = time.monotonic()
+            req.stream.put(None)
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.run_forever, daemon=True,
+                             name="llm-engine")
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop = True
+        self._work.set()
+
+    def stats(self) -> Dict[str, Any]:
+        fin = self.finished
+        ttfts = sorted(f["ttft_s"] for f in fin)
+        out: Dict[str, Any] = {
+            "finished": len(fin),
+            "decode_ticks": self.decode_ticks,
+            "tokens_out": self.tokens_out,
+            "waiting": len(self.waiting),
+            "active": sum(s is not None for s in self.slots),
+        }
+        if ttfts:
+            out["ttft_p50_s"] = ttfts[len(ttfts) // 2]
+            out["ttft_p99_s"] = ttfts[min(len(ttfts) - 1,
+                                          int(len(ttfts) * 0.99))]
+        return out
+
+
+class LLMServer:
+    """Serve deployment wrapper: one engine per replica, background
+    loop. Use with @serve.deployment / serve.run; methods are invoked
+    through DeploymentHandles."""
+
+    def __init__(self, cfg: TransformerConfig, params: Any = None, *,
+                 num_slots: int = 4, max_seq_len: Optional[int] = None,
+                 seed: int = 0):
+        if params is None:
+            params = init_params(cfg, jax.random.key(seed))
+        self.engine = LLMEngine(cfg, params, num_slots=num_slots,
+                                max_seq_len=max_seq_len)
+        self.engine.start()
+
+    def generate(self, prompt: Sequence[int], *, max_new_tokens: int = 64,
+                 temperature: float = 0.0,
+                 eos_token: Optional[int] = None) -> Dict[str, Any]:
+        req = self.engine.submit(
+            prompt, max_new_tokens=max_new_tokens, temperature=temperature,
+            eos_token=eos_token)
+        tokens = req.result()
+        return {"tokens": tokens, "ttft_s": req.ttft_s,
+                "latency_s": req.latency_s}
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
