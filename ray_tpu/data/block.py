@@ -61,6 +61,17 @@ def _to_table(data: Any) -> pa.Table:
         if not data:
             return pa.table({})
         if isinstance(data[0], dict):
+            keys = list(data[0].keys())
+            if (any(isinstance(v, np.ndarray) for v in data[0].values())
+                    and all(isinstance(d, dict) and set(d.keys()) == set(keys)
+                            for d in data)):
+                # ndarray-valued rows (e.g. images): go through the
+                # column path so the tensor-extension encoding applies.
+                try:
+                    return _to_table({k: [d[k] for d in data]
+                                      for k in keys})
+                except (ValueError, pa.ArrowInvalid):
+                    pass  # ragged shapes — fall through to pylist
             return pa.Table.from_pylist(data)
         return pa.table({"item": pa.array(data)})
     raise TypeError(f"Cannot convert {type(data)} to a Block")

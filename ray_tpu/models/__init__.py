@@ -6,8 +6,10 @@ from .transformer import (
     param_logical_axes,
 )
 from . import configs
+from . import generate
+from . import vit
 
 __all__ = [
     "TransformerConfig", "init_params", "forward", "loss_fn",
-    "param_logical_axes", "configs",
+    "param_logical_axes", "configs", "generate", "vit",
 ]

@@ -154,6 +154,8 @@ class LLMEngine:
                eos_token: Optional[int] = None) -> GenRequest:
         if self._stop:
             raise RuntimeError("engine is stopped")
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
         if len(prompt) >= self.max_seq_len:
             raise ValueError(
                 f"prompt len {len(prompt)} >= max_seq_len {self.max_seq_len}")
@@ -249,6 +251,8 @@ class LLMEngine:
 
         for i in active:
             slot = self.slots[i]
+            if slot is None:  # drained by a concurrent stop()
+                continue
             tok = int(host_toks[i])
             self._emit(slot, tok)
             done = (tok == slot.req.eos_token
@@ -295,8 +299,12 @@ class LLMEngine:
         return t
 
     def stop(self) -> None:
+        """Stop the engine and drain pending requests: every in-flight or
+        waiting client gets an 'engine stopped' error instead of hanging
+        on its stream."""
         self._stop = True
         self._work.set()
+        self._fail_all(RuntimeError("engine stopped"))
 
     def stats(self) -> Dict[str, Any]:
         fin = self.finished
