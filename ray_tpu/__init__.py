@@ -60,9 +60,14 @@ __all__ = [
 
 def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
+         num_worker_procs: int = 0,
          _system_config: Optional[Dict[str, Any]] = None,
          ignore_reinit_error: bool = True, **_compat) -> None:
     """Start (or connect to) the runtime.
+
+    num_worker_procs > 0 adds an out-of-process execution plane: that
+    many spawned worker processes (true parallelism, crash isolation)
+    sharing the zero-copy shm object store (core/worker_proc.py).
 
     Reference parity: ray.init (python/ray/_private/worker.py:1227).
     """
@@ -72,6 +77,7 @@ def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
         raise RuntimeError("ray_tpu is already initialized")
     _runtime.init_runtime(
         num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+        num_worker_procs=num_worker_procs,
         _system_config=_system_config)
 
 

@@ -427,6 +427,143 @@ class ActorState:
             self.rt._task_finished(spec)
 
 
+class ProcActorState(ActorState):
+    """An actor hosted by a dedicated worker PROCESS (worker_proc.py).
+
+    Reuses ActorState's mailbox/restart/death machinery; only
+    construction and method execution are overridden to round-trip
+    through the worker. A worker crash is an actor death that follows
+    the normal max_restarts policy — the restart's _construct leases a
+    fresh worker and re-runs __init__ (reference:
+    gcs_actor_manager.h:513 ReconstructActor after worker failure)."""
+
+    def __init__(self, *args, **kwargs):
+        self._worker = None
+        # One worker socket == one in-flight call; concurrency groups
+        # stay an in-process-actor feature.
+        kwargs["max_concurrency"] = 1
+        super().__init__(*args, **kwargs)
+
+    @property
+    def _pool(self):
+        return self.node.pool
+
+    def _start_threads(self):
+        # Always the sync mailbox loop: coroutine methods are awaited
+        # worker-side (asyncio.run in worker_main).
+        self._is_async = False
+        super()._start_threads()
+
+    def _construct(self, gen: int) -> bool:
+        import cloudpickle
+
+        from .worker_proc import WorkerCrashedError
+
+        if self._worker is not None:  # restart: retire the old worker
+            self._pool.retire(self._worker)
+            self._worker = None
+        w = None
+        try:
+            # A dedicated worker per actor (reference: the raylet spawns
+            # a fresh worker process for every actor) — actors never
+            # drain the task pool.
+            w = self._pool.spawn_dedicated()
+            reply = w.run_task({
+                "type": "actor_create",
+                "task_id": None,
+                "actor_id": self.actor_id.binary(),
+                "cls": cloudpickle.dumps(self.cls),
+                "args": tuple(self.rt._pack_arg(a) for a in self.init_args),
+                "kwargs": {k: self.rt._pack_arg(v)
+                           for k, v in self.init_kwargs.items()},
+            })
+            if reply.get("error") is not None:
+                raise self.rt._unpack_error(reply["error"])
+            self._worker = w
+            self.instance = w  # marker: lives remotely
+            self.ready.set()
+            return True
+        except BaseException as e:  # noqa: BLE001
+            if w is not None:
+                self._pool.retire(w)
+            if isinstance(e, WorkerCrashedError):
+                self._restartable_kill = True  # worker death is restartable
+            self.death_cause = TaskError(self.cls.__name__ + ".__init__", e)
+            self._die(gen)
+            return False
+
+    def _run_method(self, spec: TaskSpec):
+        from .worker_proc import WorkerCrashedError
+
+        _ctx.task_id = spec.task_id
+        t0 = time.monotonic()
+        streaming = spec.num_returns in ("streaming", "dynamic")
+        gst = self.rt._generators.get(spec.task_id) if streaming else None
+        try:
+            msg = {
+                "type": "actor_call",
+                "task_id": spec.task_id,
+                "actor_id": self.actor_id.binary(),
+                "method": spec.method_name,
+                "args": tuple(self.rt._pack_arg(a) for a in spec.args),
+                "kwargs": {k: self.rt._pack_arg(v)
+                           for k, v in spec.kwargs.items()},
+                "num_returns": 0 if streaming else spec.num_returns,
+                "return_ids": [oid.binary() for oid in spec.return_ids],
+                "streaming": streaming,
+            }
+
+            def on_stream(item):
+                oid = ObjectID.for_return(spec.task_id, item["index"])
+                with self.rt.lineage_lock:
+                    self.rt.lineage[oid] = spec
+                self.rt._store_packed(oid, item["payload"])
+                if gst is not None:
+                    ref = self.rt.register_ref(ObjectRef(oid))
+                    with gst.cv:
+                        gst.refs.append(ref)
+                        gst.cv.notify_all()
+
+            reply = self._worker.run_task(
+                msg, on_stream=on_stream if streaming else None)
+            if reply.get("error") is not None:
+                err = self.rt._unpack_error(reply["error"])
+                if isinstance(err, _ActorExit):
+                    self.rt._store_results(spec, None, t0)
+                    self.death_cause = ActorDiedError(
+                        self.actor_id.hex(), "exit_actor() was called.")
+                    self.dead.set()
+                    return
+                raise err
+            if streaming and gst is not None:
+                with gst.cv:
+                    gst.done = True
+                    gst.cv.notify_all()
+                self.rt._generators.pop(spec.task_id, None)
+            else:
+                for oid, packed in zip(spec.return_ids, reply["returns"]):
+                    self.rt._store_packed(oid, packed)
+        except WorkerCrashedError as e:
+            self.rt._store_error(spec, _wrap(spec, e), t0)
+            self.death_cause = ActorDiedError(
+                self.actor_id.hex(), f"worker process died: {e}")
+            self._restartable_kill = True  # honor max_restarts
+            self.dead.set()
+        except BaseException as e:  # noqa: BLE001
+            self.rt._store_error(spec, _wrap(spec, e), t0)
+        finally:
+            _ctx.task_id = None
+            self.rt._task_finished(spec)
+
+    def _die(self, gen: int):
+        super()._die(gen)
+        # Final death (not a restart): retire the dedicated worker.
+        if self.dead.is_set() and self._worker is not None:
+            w = self._worker
+            self._worker = None
+            self._pool.retire(w)
+
+
 def _is_coro_fn(f) -> bool:
     import inspect
     return f is not None and inspect.iscoroutinefunction(f)
@@ -456,10 +593,27 @@ class _ShmMarker:
 # Runtime
 # ---------------------------------------------------------------------------
 
+class ProcNodeState(NodeState):
+    """A schedulable node whose tasks execute in spawned worker
+    PROCESSES (worker_proc.py) instead of in-process threads. The
+    thread-pool executor threads only drive the socket round-trips; the
+    user code runs out-of-process (true parallelism, crash isolation).
+    Actors are hosted by dedicated workers leased from the same pool."""
+
+    def __init__(self, node_id: str, total, pool):
+        super().__init__(node_id, total, max_workers=pool.num_workers + 4)
+        self.pool = pool
+
+    def shutdown(self):
+        super().shutdown()
+        self.pool.shutdown()
+
+
 class Runtime:
     def __init__(self, *, num_cpus: Optional[float] = None,
                  num_tpus: Optional[float] = None,
                  resources: Optional[Dict[str, float]] = None,
+                 num_worker_procs: int = 0,
                  _system_config: Optional[Dict[str, Any]] = None):
         config.apply(_system_config)
         self.job_id = JobID.from_random()
@@ -523,6 +677,20 @@ class Runtime:
             max_workers=max(4, int(num_cpus) * 2),
         )
         self.scheduler.add_node(head)
+
+        # Out-of-process execution plane: spawned worker processes behind
+        # a pool node (see worker_proc.py). Objects ride the shared shm
+        # store; only ids cross the sockets.
+        self.worker_pool = None
+        if num_worker_procs > 0:
+            from .worker_proc import WorkerPool
+
+            self.worker_pool = WorkerPool(
+                num_worker_procs,
+                shm_name=(self._shm_name if self.shm is not None else None))
+            self.scheduler.add_node(ProcNodeState(
+                "node-procs", ResourceSet({CPU: float(num_worker_procs)}),
+                self.worker_pool))
 
     @staticmethod
     def _detect_tpus() -> int:
@@ -786,7 +954,9 @@ class Runtime:
 
         def on_placed(node: NodeState):
             try:
-                st = ActorState(
+                state_cls = (ProcActorState if isinstance(
+                    node, ProcNodeState) else ActorState)
+                st = state_cls(
                     self, actor_id, cls, spec.args, spec.kwargs,
                     node=node, name=name or actor_id.hex()[:8],
                     max_concurrency=opts.get("max_concurrency", 1),
@@ -891,7 +1061,158 @@ class Runtime:
             # Resources stay held by the actor until death.
             spec.actor_placement_cb(node)  # type: ignore[attr-defined]
             return
+        if isinstance(node, ProcNodeState):
+            node.executor.submit(self._execute_proc, spec, node)
+            return
         node.executor.submit(self._execute, spec, node)
+
+    # ------------------------------------------------------------------
+    # Out-of-process execution (worker_proc.py plane)
+    # ------------------------------------------------------------------
+    def _pack_arg(self, v):
+        """Top-level ObjectRef → wire marker (shm key or serialized
+        bytes); plain values pass through (cloudpickled with the task)."""
+        from .worker_proc import SerArg, ShmArg
+
+        if not isinstance(v, ObjectRef):
+            return v
+        while True:
+            stored = self.store.get_if_exists(v.id())
+            if stored is None:
+                self._require_recoverable(v.id())
+                self._maybe_reconstruct([v.id()])
+                stored = self.store.get([v.id()], timeout=None)[0]
+            d = stored.data
+            if isinstance(d, _ShmMarker):
+                if self.shm is not None and self.shm.contains(d.key):
+                    return ShmArg(d.key, stored.is_error)
+                self._require_recoverable(v.id())
+                self.store.delete([v.id()])  # evicted — reconstruct
+                self._maybe_reconstruct([v.id()])
+                continue
+            return SerArg(d.to_bytes(), stored.is_error)
+
+    def _require_recoverable(self, oid: ObjectID) -> None:
+        """Fail fast (like Runtime.get) instead of blocking forever on an
+        object that can never come back: no lineage and not in flight."""
+        with self.lineage_lock:
+            if oid in self.lineage:
+                return
+        with self._pending_lock:
+            if any(oid in t.return_ids for t in self._pending_tasks.values()):
+                return
+        raise ObjectLostError(
+            f"object {oid.hex()[:16]} evicted and not reconstructable "
+            "(no lineage)")
+
+    def _pack_task_msg(self, spec: TaskSpec, worker) -> Dict[str, Any]:
+        import cloudpickle
+
+        streaming = spec.num_returns in ("streaming", "dynamic")
+        fid = spec.descriptor.function_id
+        msg = {
+            "type": "task", "task_id": spec.task_id, "fid": fid,
+            "args": tuple(self._pack_arg(a) for a in spec.args),
+            "kwargs": {k: self._pack_arg(v)
+                       for k, v in spec.kwargs.items()},
+            "num_returns": 0 if streaming else spec.num_returns,
+            "return_ids": [oid.binary() for oid in spec.return_ids],
+            "streaming": streaming,
+        }
+        if fid not in worker.exported_fns:
+            msg["fn"] = cloudpickle.dumps(
+                self.function_manager.get(fid))
+        return msg
+
+    def _store_packed(self, oid: ObjectID, packed):
+        """Store a worker-produced ('shm'|'ser', payload) wire value."""
+        kind, payload = packed
+        if kind == "shm":
+            # Worker already wrote the bytes under the return id.
+            self.store.put(oid, _ShmMarker(payload))
+        else:
+            self.store.put(
+                oid, serialization.SerializedObject.from_bytes(payload))
+
+    def _unpack_error(self, packed) -> BaseException:
+        _, payload = packed
+        return serialization.deserialize(
+            serialization.SerializedObject.from_bytes(payload))
+
+    def _maybe_retry_system(self, spec: TaskSpec, e: BaseException) -> bool:
+        """Worker-process death: always retryable while retries remain
+        (reference: system failures consume max_retries regardless of
+        retry_exceptions, task_manager.h)."""
+        if spec.num_returns in ("streaming", "dynamic"):
+            return False  # partial stream already delivered
+        if spec.retries_left <= 0:
+            return False
+        spec.retries_left -= 1
+        logger.warning("Worker died running %s; retrying (%d left): %s",
+                       spec.display_name(), spec.retries_left, e)
+        with self._pending_lock:
+            self._pending_tasks[spec.task_id] = spec
+        self._submit_when_ready(spec)
+        return True
+
+    def _execute_proc(self, spec: TaskSpec, node: "ProcNodeState"):
+        from .worker_proc import WorkerCrashedError
+
+        t0 = time.monotonic()
+        retried = False
+        worker = None
+        streaming = spec.num_returns in ("streaming", "dynamic")
+        gst = self._generators.get(spec.task_id) if streaming else None
+        try:
+            if spec.task_id in self._cancelled:
+                raise TaskCancelledError(spec.display_name())
+            worker = node.pool.acquire(timeout=60)
+            msg = self._pack_task_msg(spec, worker)
+
+            def on_stream(item):
+                # gst is None when the task is a lineage RECONSTRUCTION
+                # of an evicted stream item: re-store the items (that is
+                # the point), nobody holds a live generator.
+                oid = ObjectID.for_return(spec.task_id, item["index"])
+                with self.lineage_lock:
+                    self.lineage[oid] = spec
+                self._store_packed(oid, item["payload"])
+                if gst is not None:
+                    ref = self.register_ref(ObjectRef(oid))
+                    with gst.cv:
+                        gst.refs.append(ref)
+                        gst.cv.notify_all()
+
+            reply = worker.run_task(
+                msg, on_stream=on_stream if streaming else None)
+            worker.exported_fns.add(msg["fid"])
+            if reply.get("error") is not None:
+                raise self._unpack_error(reply["error"])
+            if streaming and gst is not None:
+                with gst.cv:
+                    gst.done = True
+                    gst.cv.notify_all()
+                self._generators.pop(spec.task_id, None)
+            else:
+                for oid, packed in zip(spec.return_ids, reply["returns"]):
+                    self._store_packed(oid, packed)
+        except WorkerCrashedError as e:
+            retried = self._maybe_retry_system(spec, e)
+            if not retried:
+                self._store_error(spec, _wrap(spec, e), t0)
+        except BaseException as e:  # noqa: BLE001
+            retried = self._maybe_retry(spec, e)
+            if not retried:
+                self._store_error(spec, _wrap(spec, e), t0)
+        finally:
+            if worker is not None:
+                node.pool.release(worker)
+            if not retried:
+                self._task_finished(spec)
+            self.scheduler.release_task(spec, node.node_id)
+            self.events.record(
+                spec.display_name(), t0, time.monotonic(),
+                node.node_id, spec.task_id.hex())
 
     def _execute(self, spec: TaskSpec, node: NodeState):
         t0 = time.monotonic()
@@ -983,7 +1304,9 @@ class Runtime:
             self._store(oid, serialization.serialize(v))
 
     def _consume_generator(self, spec: TaskSpec, gen):
-        st = self._generators[spec.task_id]
+        # Reconstruction re-runs have no live consumer: use a throwaway
+        # state so the items still get re-stored.
+        st = self._generators.get(spec.task_id) or _GeneratorState()
         i = 0
         try:
             for item in gen:

@@ -1,0 +1,217 @@
+"""Worker process entry point.
+
+Capability-equivalent to the reference's default_worker.py + the
+CoreWorker task-execution loop (reference:
+_private/workers/default_worker.py; CoreWorkerProcess::
+RunTaskExecutionLoop → execute_task _raylet.pyx:1644): connect back to
+the driver's socket, register, then loop executing pushed tasks. Objects
+larger than the inline threshold are written to / read from the shared
+C++ shm store; only ids cross the socket.
+
+Also hosts actor instances: `actor_create` instantiates the class in
+this process; subsequent `actor_call`s run its methods here, in arrival
+order (the per-caller ordering the reference's actor submit queue
+guarantees — there is a single caller, the driver).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import traceback
+from typing import Any, Dict, Optional
+
+
+def _setup(args):
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(args.socket)
+    shm = None
+    if args.shm:
+        try:
+            from ray_tpu._native.shm_store import ShmStore
+
+            shm = ShmStore(args.shm, create=False)
+        except Exception:  # noqa: BLE001 — shm optional; fall back inline
+            shm = None
+    return sock, shm
+
+
+def _unpack_args(packed_args, packed_kwargs, shm):
+    from ray_tpu.core import serialization
+    from ray_tpu.core.worker_proc import SerArg, ShmArg
+
+    def resolve(v):
+        if isinstance(v, (ShmArg, SerArg)):
+            if isinstance(v, ShmArg):
+                if shm is None:
+                    raise RuntimeError("shm arg but no shm store attached")
+                view = shm.get(v.key, pin=True)
+                if view is None:
+                    raise KeyError(v.key.hex())
+                try:
+                    data = serialization.SerializedObject.from_bytes(view)
+                    value = serialization.deserialize(data)
+                finally:
+                    shm.release(v.key)
+            else:
+                value = serialization.deserialize(
+                    serialization.SerializedObject.from_bytes(v.data))
+            if v.is_error:
+                raise value
+            return value
+        return v
+
+    args = tuple(resolve(a) for a in packed_args)
+    kwargs = {k: resolve(v) for k, v in packed_kwargs.items()}
+    return args, kwargs
+
+
+def _pack_value(value, shm, inline_max: int, key: bytes):
+    """serialize; big payloads go to shm under `key` (the return
+    ObjectID — so the driver's store/lineage see the same id), small
+    payloads ship inline. Returns a wire tuple."""
+    from ray_tpu.core import serialization
+
+    data = serialization.serialize(value)
+    blob = data.to_bytes()
+    if shm is not None and len(blob) > inline_max:
+        try:
+            shm.put(key, blob)
+            return ("shm", key)
+        except Exception:  # noqa: BLE001 — store full/dup: ship inline
+            pass
+    return ("ser", blob)
+
+
+def _pack_error(exc: BaseException):
+    from ray_tpu.core import serialization
+
+    try:
+        data = serialization.serialize(exc)
+    except Exception:  # noqa: BLE001 — unpicklable exception
+        data = serialization.serialize(
+            RuntimeError("".join(traceback.format_exception(exc))))
+    return ("ser", data.to_bytes())
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--worker-id", type=int, required=True)
+    ap.add_argument("--shm", default=None)
+    ap.add_argument("--inline-max", type=int, default=100 * 1024)
+    args = ap.parse_args()
+
+    from ray_tpu.core.worker_proc import recv_msg, send_msg
+
+    sock, shm = _setup(args)
+    send_msg(sock, {"type": "hello", "worker_id": args.worker_id,
+                    "pid": os.getpid()})
+
+    fn_cache: Dict[bytes, Any] = {}
+    actors: Dict[bytes, Any] = {}
+
+    def get_fn(msg):
+        fid = msg["fid"]
+        if fid not in fn_cache:
+            import cloudpickle
+
+            fn_cache[fid] = cloudpickle.loads(msg["fn"])
+        return fn_cache[fid]
+
+    while True:
+        msg = recv_msg(sock)
+        mtype = msg.get("type")
+        if mtype == "shutdown":
+            return
+        if mtype == "ping":
+            send_msg(sock, {"type": "pong", "worker_id": args.worker_id})
+            continue
+
+        task_id = msg.get("task_id")
+        try:
+            if mtype == "task":
+                fn = get_fn(msg)
+                call_args, call_kwargs = _unpack_args(
+                    msg["args"], msg["kwargs"], shm)
+                result = fn(*call_args, **call_kwargs)
+            elif mtype == "actor_create":
+                import cloudpickle
+
+                cls = cloudpickle.loads(msg["cls"])
+                call_args, call_kwargs = _unpack_args(
+                    msg["args"], msg["kwargs"], shm)
+                actors[msg["actor_id"]] = cls(*call_args, **call_kwargs)
+                result = None
+            elif mtype == "actor_call":
+                inst = actors.get(msg["actor_id"])
+                if inst is None:
+                    raise RuntimeError(
+                        f"actor {msg['actor_id'].hex()} not in this worker")
+                method = getattr(inst, msg["method"])
+                call_args, call_kwargs = _unpack_args(
+                    msg["args"], msg["kwargs"], shm)
+                result = method(*call_args, **call_kwargs)
+            elif mtype == "actor_kill":
+                actors.pop(msg["actor_id"], None)
+                result = None
+            else:
+                raise RuntimeError(f"unknown message type {mtype!r}")
+            import inspect
+
+            if inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.run(result)
+        except BaseException as e:  # noqa: BLE001 — user code may raise anything
+            send_msg(sock, {"type": "result", "task_id": task_id,
+                            "error": _pack_error(e)})
+            continue
+
+        streaming = msg.get("streaming", False)
+        if streaming and hasattr(result, "__next__"):
+            from ray_tpu.core.ids import ObjectID
+
+            i = 0
+            try:
+                for item in result:
+                    key = ObjectID.for_return(task_id, i).binary()
+                    send_msg(sock, {
+                        "type": "gen_item", "task_id": task_id, "index": i,
+                        "payload": _pack_value(item, shm, args.inline_max,
+                                               key)})
+                    i += 1
+                send_msg(sock, {"type": "result", "task_id": task_id,
+                                "error": None, "returns": [],
+                                "gen_count": i})
+            except BaseException as e:  # noqa: BLE001
+                send_msg(sock, {"type": "result", "task_id": task_id,
+                                "error": _pack_error(e), "gen_count": i})
+            continue
+
+        n = msg.get("num_returns", 1)
+        return_ids = msg.get("return_ids", [])
+        if n == 0 or task_id is None:
+            returns = []
+        elif n == 1:
+            returns = [_pack_value(result, shm, args.inline_max,
+                                   return_ids[0])]
+        else:
+            values = tuple(result)
+            if len(values) != n:
+                send_msg(sock, {
+                    "type": "result", "task_id": task_id,
+                    "error": _pack_error(ValueError(
+                        f"declared num_returns={n} but returned "
+                        f"{len(values)} values"))})
+                continue
+            returns = [_pack_value(v, shm, args.inline_max, return_ids[i])
+                       for i, v in enumerate(values)]
+        send_msg(sock, {"type": "result", "task_id": task_id,
+                        "error": None, "returns": returns})
+
+
+if __name__ == "__main__":
+    main()

@@ -78,7 +78,7 @@ def test_actor_constructor_failure(ray_start):
 
     b = Broken.remote()
     with pytest.raises((ray.TaskError, ray.ActorDiedError)):
-        ray.get(b.m.remote(), timeout=5)
+        ray.get(b.m.remote(), timeout=30)
 
 
 def test_named_actor(ray_start):

@@ -1,0 +1,283 @@
+"""Out-of-process execution plane (core/worker_proc.py): real worker
+processes, shm-backed object flow, crash recovery, proc-hosted actors."""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime import global_runtime
+from ray_tpu.core.task import NodeAffinitySchedulingStrategy
+
+PROC = NodeAffinitySchedulingStrategy(node_id="node-procs", soft=False)
+
+
+@pytest.fixture
+def ray_procs():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, num_tpus=0, num_worker_procs=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_tasks_run_out_of_process(ray_procs):
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC)
+    def pid():
+        return os.getpid()
+
+    pids = set(ray.get([pid.remote() for _ in range(6)]))
+    assert os.getpid() not in pids
+    assert 1 <= len(pids) <= 2
+
+
+def test_large_objects_flow_through_shm(ray_procs):
+    ray = ray_procs
+    rt = global_runtime()
+
+    @ray.remote(scheduling_strategy=PROC)
+    def make():
+        return np.ones((256, 1024), np.float32)
+
+    @ray.remote(scheduling_strategy=PROC)
+    def total(a):
+        return float(a.sum())
+
+    ref = make.remote()
+    assert ray.get(total.remote(ref)) == 256 * 1024
+    if rt.shm is not None:
+        # The 1MB result must live in the shm plane, not the socket path.
+        stored = rt.store.get_if_exists(ref.id())
+        from ray_tpu.core.runtime import _ShmMarker
+
+        assert isinstance(stored.data, _ShmMarker)
+
+
+def test_driver_put_readable_by_worker(ray_procs):
+    ray = ray_procs
+    big = np.arange(500_000, dtype=np.int64)
+    ref = ray.put(big)
+
+    @ray.remote(scheduling_strategy=PROC)
+    def head(a):
+        return int(a[:10].sum())
+
+    assert ray.get(head.remote(ref)) == 45
+
+
+def test_errors_propagate_and_retries_respected(ray_procs):
+    ray = ray_procs
+    calls = []
+
+    @ray.remote(scheduling_strategy=PROC, max_retries=0)
+    def boom():
+        raise ValueError("application error")
+
+    with pytest.raises(ray_tpu.TaskError):
+        ray.get(boom.remote())
+
+
+def test_streaming_generator(ray_procs):
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC, num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield {"i": i}
+
+    vals = [ray.get(r)["i"] for r in gen.remote(4)]
+    assert vals == [0, 1, 2, 3]
+
+
+def test_multi_returns(ray_procs):
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC, num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray.get([a, b, c]) == [1, 2, 3]
+
+
+def test_worker_crash_retries_task(ray_procs):
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC, max_retries=3)
+    def slow(x):
+        time.sleep(0.8)
+        return x + 1
+
+    futs = [slow.remote(i) for i in range(2)]
+    time.sleep(0.3)
+    for w in global_runtime().worker_pool.workers():
+        w.kill()
+    # Generous timeout: respawn + retry on this single-core box can be
+    # slow when the whole file runs back to back.
+    assert ray.get(futs, timeout=120) == [1, 2]
+
+
+def test_worker_crash_without_retries_errors(ray_procs):
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC, max_retries=0)
+    def slow():
+        time.sleep(5)
+
+    fut = slow.remote()
+    time.sleep(0.3)
+    for w in global_runtime().worker_pool.workers():
+        w.kill()
+    with pytest.raises(ray_tpu.TaskError):
+        ray.get(fut, timeout=60)
+
+
+def test_proc_actor_state_and_restart(ray_procs):
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC, max_restarts=2)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+        def mypid(self):
+            return os.getpid()
+
+    c = Counter.remote()
+    assert ray.get([c.inc.remote() for _ in range(3)]) == [1, 2, 3]
+    pid1 = ray.get(c.mypid.remote())
+    assert pid1 != os.getpid()
+
+    os.kill(pid1, signal.SIGKILL)
+    deadline = time.monotonic() + 30
+    while True:
+        try:
+            v = ray.get(c.inc.remote(), timeout=30)
+            break
+        except Exception:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+    # Fresh state after restart, new process.
+    assert v == 1
+    assert ray.get(c.mypid.remote()) != pid1
+
+
+def test_proc_actor_kill(ray_procs):
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC)
+    class A:
+        def f(self):
+            return "ok"
+
+    a = A.remote()
+    assert ray.get(a.f.remote()) == "ok"
+    ray.kill(a)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray.get(a.f.remote(), timeout=30)
+
+
+def test_proc_actor_async_method(ray_procs):
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC)
+    class Aio:
+        async def add(self, a, b):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return a + b
+
+    a = Aio.remote()
+    assert ray.get(a.add.remote(2, 3)) == 5
+
+
+def test_failed_actor_init_does_not_shrink_pool(ray_procs):
+    """Actor __init__ raising must not leak its dedicated worker or eat
+    task-pool capacity."""
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC, max_restarts=0)
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("init failed")
+
+        def f(self):
+            return 1
+
+    a = Bad.remote()
+    with pytest.raises(Exception):
+        ray.get(a.f.remote(), timeout=30)
+
+    @ray.remote(scheduling_strategy=PROC)
+    def ok():
+        return "alive"
+
+    # Task pool must still have both workers.
+    assert ray.get([ok.remote() for _ in range(4)], timeout=30) \
+        == ["alive"] * 4
+
+
+def test_zero_cpu_actors_dont_starve_tasks(ray_procs):
+    """Actors get dedicated workers — even num_cpus=0 actors leave the
+    task pool untouched."""
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC, num_cpus=0)
+    class A:
+        def f(self):
+            return os.getpid()
+
+    actors = [A.remote() for _ in range(2)]
+    apids = ray.get([a.f.remote() for a in actors], timeout=60)
+
+    @ray.remote(scheduling_strategy=PROC)
+    def t():
+        return os.getpid()
+
+    tpids = ray.get([t.remote() for _ in range(4)], timeout=30)
+    assert set(apids).isdisjoint(set(tpids))
+
+
+def test_lost_put_object_arg_fails_fast(ray_procs):
+    """An shm-evicted ray.put object passed to a proc task must raise
+    ObjectLostError, not hang the executor."""
+    ray = ray_procs
+    rt = global_runtime()
+    if rt.shm is None:
+        pytest.skip("shm store not built")
+    big = np.ones(300_000, np.float64)
+    ref = ray.put(big)
+    rt.shm.delete(ref.id().binary())  # simulate eviction under pressure
+
+    @ray.remote(scheduling_strategy=PROC, max_retries=0)
+    def use(a):
+        return a.shape
+
+    with pytest.raises((ray_tpu.ObjectLostError, ray_tpu.TaskError)):
+        ray.get(use.remote(ref), timeout=30)
+
+
+def test_pool_respawns_to_capacity(ray_procs):
+    ray = ray_procs
+    pool = global_runtime().worker_pool
+    for w in pool.workers():
+        w.kill()
+
+    @ray.remote(scheduling_strategy=PROC, max_retries=1)
+    def ok():
+        return 42
+
+    assert ray.get(ok.remote(), timeout=60) == 42
+    deadline = time.monotonic() + 10
+    while len(pool.workers()) < 2 and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert len(pool.workers()) == 2
