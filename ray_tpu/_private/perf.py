@@ -1,0 +1,114 @@
+"""Core-runtime microbenchmarks.
+
+Capability-equivalent to the reference's microbenchmark suite
+(reference: python/ray/_private/ray_perf.py:129-399 — single/multi
+client tasks, 1:1 and n:n sync/async actor calls, puts; run by
+`ray microbenchmark`, recorded in release_logs microbenchmark.json =
+the BASELINE.md rows). Each workload prints `name: N ops/s`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator, List
+
+
+def _rate(fn: Callable[[], int], min_seconds: float) -> float:
+    """Run fn repeatedly for >= min_seconds; returns ops/sec."""
+    total = 0
+    t0 = time.perf_counter()
+    while True:
+        total += fn()
+        dt = time.perf_counter() - t0
+        if dt >= min_seconds:
+            return total / dt
+
+
+def run_microbenchmarks(quick: bool = False) -> Iterator[str]:
+    import numpy as np
+
+    import ray_tpu as ray
+
+    ray.shutdown()
+    ray.init(num_cpus=4, num_tpus=0)
+    dur = 0.5 if quick else 3.0
+    batch = 100 if quick else 1000
+
+    @ray.remote
+    def tiny():
+        return b"ok"
+
+    # warmup
+    ray.get([tiny.remote() for _ in range(10)])
+
+    def tasks_batch():
+        ray.get([tiny.remote() for _ in range(batch)])
+        return batch
+
+    yield (f"tasks_per_second: "
+           f"{_rate(tasks_batch, dur):.1f} ops/s")
+
+    @ray.remote
+    class Pong:
+        def ping(self, x=None):
+            return x
+
+    actor = Pong.remote()
+    ray.get(actor.ping.remote())
+
+    def sync_actor_calls():
+        n = batch // 10
+        for _ in range(n):
+            ray.get(actor.ping.remote())
+        return n
+
+    yield (f"actor_calls_1_1_sync_per_second: "
+           f"{_rate(sync_actor_calls, dur):.1f} ops/s")
+
+    def async_actor_calls():
+        ray.get([actor.ping.remote() for _ in range(batch)])
+        return batch
+
+    yield (f"actor_calls_1_1_async_per_second: "
+           f"{_rate(async_actor_calls, dur):.1f} ops/s")
+
+    actors = [Pong.remote() for _ in range(4)]
+    ray.get([a.ping.remote() for a in actors])
+
+    def nn_actor_calls():
+        futs = []
+        for a in actors:
+            futs.extend(a.ping.remote() for _ in range(batch // 4))
+        ray.get(futs)
+        return batch
+
+    yield (f"actor_calls_n_n_async_per_second: "
+           f"{_rate(nn_actor_calls, dur):.1f} ops/s")
+
+    small = b"x" * 1024
+
+    def puts():
+        refs = [ray.put(small) for _ in range(batch)]
+        del refs
+        return batch
+
+    yield f"puts_1kb_per_second: {_rate(puts, dur):.1f} ops/s"
+
+    mb = np.zeros(1_000_000 // 8, dtype=np.float64)  # 1 MB
+
+    def put_gigabytes():
+        n = batch // 10
+        for _ in range(n):
+            r = ray.put(mb)
+            del r
+        return n
+
+    rate = _rate(put_gigabytes, dur)
+    yield f"put_gigabytes_per_second: {rate * 1e6 / 1e9:.3f} GB/s"
+
+    ray.shutdown()
+
+
+if __name__ == "__main__":
+    for line in run_microbenchmarks():
+        print(line)

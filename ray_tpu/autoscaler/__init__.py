@@ -1,0 +1,11 @@
+from .autoscaler import (
+    AutoscalerConfig,
+    LocalNodeProvider,
+    MockProvider,
+    Monitor,
+    NodeProvider,
+    StandardAutoscaler,
+)
+
+__all__ = ["AutoscalerConfig", "NodeProvider", "LocalNodeProvider",
+           "MockProvider", "StandardAutoscaler", "Monitor"]

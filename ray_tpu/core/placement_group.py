@@ -92,6 +92,12 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
     return pg
 
 
+def _live_placement_groups() -> List[PlacementGroup]:
+    """All registered PGs of the current runtime (state API)."""
+    rt = global_runtime()
+    return list(getattr(rt, "placement_groups", {}).values())
+
+
 def remove_placement_group(pg: PlacementGroup) -> None:
     rt = global_runtime()
     for i, node_id in enumerate(pg._bundle_nodes):

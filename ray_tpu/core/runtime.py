@@ -1119,6 +1119,8 @@ class Runtime:
             "return_ids": [oid.binary() for oid in spec.return_ids],
             "streaming": streaming,
         }
+        if spec.runtime_env:
+            msg["runtime_env"] = spec.runtime_env
         if fid not in worker.exported_fns:
             msg["fn"] = cloudpickle.dumps(
                 self.function_manager.get(fid))

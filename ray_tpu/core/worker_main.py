@@ -136,7 +136,8 @@ def main() -> None:
                 fn = get_fn(msg)
                 call_args, call_kwargs = _unpack_args(
                     msg["args"], msg["kwargs"], shm)
-                result = fn(*call_args, **call_kwargs)
+                with _runtime_env(msg.get("runtime_env")):
+                    result = fn(*call_args, **call_kwargs)
             elif mtype == "actor_create":
                 import cloudpickle
 

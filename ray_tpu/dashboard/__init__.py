@@ -1,0 +1,3 @@
+from .server import DashboardServer, start_dashboard
+
+__all__ = ["DashboardServer", "start_dashboard"]

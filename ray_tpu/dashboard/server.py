@@ -1,0 +1,178 @@
+"""Dashboard — REST observability + job API over aiohttp.
+
+Capability-equivalent to the reference's dashboard head REST plane
+(reference: dashboard/head.py DashboardHead :81 and modules/
+{node,actor,job,state,healthz,metrics} — aiohttp app aggregating
+cluster state; the React frontend is out of scope, the API surface is
+what tooling consumes). Runs inside the driver process on a thread
+with its own event loop.
+
+Endpoints:
+  GET  /api/version            GET  /api/cluster_status
+  GET  /api/nodes              GET  /api/actors
+  GET  /api/tasks              GET  /api/objects
+  GET  /api/workers            GET  /api/placement_groups
+  GET  /api/timeline           GET  /healthz
+  GET  /metrics                (Prometheus text)
+  POST /api/jobs/              GET  /api/jobs/
+  GET  /api/jobs/{id}          GET  /api/jobs/{id}/logs
+  POST /api/jobs/{id}/stop
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Optional
+
+from .._version import __version__
+
+
+def _json(data: Any):
+    from aiohttp import web
+
+    return web.Response(text=json.dumps(data, default=str),
+                        content_type="application/json")
+
+
+class DashboardServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._runner = None
+
+    # -- handlers ----------------------------------------------------------
+    def _build_app(self):
+        from aiohttp import web
+
+        from .. import state
+        from ..job.manager import job_manager
+        from ..util import metrics as metrics_mod
+
+        app = web.Application()
+        r = app.router
+
+        async def version(_):
+            return _json({"version": __version__})
+
+        async def healthz(_):
+            return web.Response(text="success")
+
+        async def cluster_status(_):
+            return _json(state.cluster_status())
+
+        def lister(fn):
+            async def h(request):
+                limit = int(request.query.get("limit", "100"))
+                return _json(fn(limit=limit))
+            return h
+
+        async def timeline(_):
+            from ..core.runtime import global_runtime
+
+            return _json(global_runtime().timeline())
+
+        async def prom_metrics(_):
+            return web.Response(text=metrics_mod.prometheus_text(),
+                                content_type="text/plain")
+
+        async def submit_job(request):
+            body = await request.json()
+            job_id = job_manager().submit(
+                body["entrypoint"],
+                runtime_env=body.get("runtime_env"),
+                metadata=body.get("metadata"),
+                submission_id=body.get("submission_id"))
+            return _json({"job_id": job_id})
+
+        async def list_jobs(_):
+            return _json([j.to_dict() for j in job_manager().list()])
+
+        async def job_info(request):
+            try:
+                info = job_manager().status(request.match_info["job_id"])
+            except KeyError:
+                raise web.HTTPNotFound()
+            return _json(info.to_dict())
+
+        async def job_logs(request):
+            try:
+                logs = job_manager().logs(request.match_info["job_id"])
+            except KeyError:
+                raise web.HTTPNotFound()
+            return _json({"logs": logs})
+
+        async def job_stop(request):
+            try:
+                stopped = job_manager().stop(request.match_info["job_id"])
+            except KeyError:
+                raise web.HTTPNotFound()
+            return _json({"stopped": stopped})
+
+        r.add_get("/api/version", version)
+        r.add_get("/healthz", healthz)
+        r.add_get("/api/cluster_status", cluster_status)
+        r.add_get("/api/nodes", lister(state.list_nodes))
+        r.add_get("/api/actors", lister(state.list_actors))
+        r.add_get("/api/tasks", lister(state.list_tasks))
+        r.add_get("/api/objects", lister(state.list_objects))
+        r.add_get("/api/workers", lister(state.list_workers))
+        r.add_get("/api/placement_groups",
+                  lister(state.list_placement_groups))
+        r.add_get("/api/timeline", timeline)
+        r.add_get("/metrics", prom_metrics)
+        r.add_post("/api/jobs/", submit_job)
+        r.add_get("/api/jobs/", list_jobs)
+        r.add_get("/api/jobs/{job_id}", job_info)
+        r.add_get("/api/jobs/{job_id}/logs", job_logs)
+        r.add_post("/api/jobs/{job_id}/stop", job_stop)
+        return app
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "DashboardServer":
+        from aiohttp import web
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            app = self._build_app()
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+            # TCPSite with port 0 picks a free port; recover it.
+            server = site._server
+            if server and server.sockets:
+                self.port = server.sockets[0].getsockname()[1]
+            self._runner = runner
+            self._started.set()
+            loop.run_forever()
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="dashboard")
+        self._thread.start()
+        if not self._started.wait(timeout=15):
+            raise RuntimeError("dashboard failed to start")
+        return self
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265
+                    ) -> DashboardServer:
+    return DashboardServer(host, port).start()

@@ -1,0 +1,5 @@
+from .manager import JobInfo, JobManager, JobStatus, job_manager
+from .sdk import JobSubmissionClient
+
+__all__ = ["JobManager", "JobInfo", "JobStatus", "job_manager",
+           "JobSubmissionClient"]

@@ -1,0 +1,181 @@
+"""Job manager — run driver entrypoints as supervised subprocesses.
+
+Capability-equivalent to the reference's job submission backend
+(reference: dashboard/modules/job/job_manager.py — runs each job's
+entrypoint as a subprocess of a JobSupervisor on the head node, tracks
+PENDING/RUNNING/SUCCEEDED/FAILED/STOPPED, captures logs per job;
+runtime_env env_vars/working_dir applied to the driver process).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@dataclass
+class JobInfo:
+    job_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    submission_time: float = field(default_factory=time.time)
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    return_code: Optional[int] = None
+    metadata: Dict[str, str] = field(default_factory=dict)
+    runtime_env: Dict[str, Any] = field(default_factory=dict)
+    log_path: str = ""
+    message: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in (
+            "job_id", "entrypoint", "status", "submission_time",
+            "start_time", "end_time", "return_code", "metadata",
+            "runtime_env", "log_path", "message")}
+
+
+class JobManager:
+    """Supervises job subprocesses; one monitor thread per job."""
+
+    def __init__(self, log_dir: Optional[str] = None):
+        self._jobs: Dict[str, JobInfo] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self._log_dir = log_dir or os.path.join(
+            tempfile.gettempdir(), "ray_tpu", "job_logs")
+        os.makedirs(self._log_dir, exist_ok=True)
+
+    def submit(self, entrypoint: str, *,
+               runtime_env: Optional[Dict[str, Any]] = None,
+               metadata: Optional[Dict[str, str]] = None,
+               submission_id: Optional[str] = None) -> str:
+        job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+        with self._lock:
+            if job_id in self._jobs:
+                raise ValueError(f"job {job_id!r} already exists")
+            info = JobInfo(
+                job_id=job_id, entrypoint=entrypoint,
+                runtime_env=dict(runtime_env or {}),
+                metadata=dict(metadata or {}),
+                log_path=os.path.join(self._log_dir, f"{job_id}.log"))
+            self._jobs[job_id] = info
+        threading.Thread(target=self._run, args=(info,), daemon=True,
+                         name=f"job-{job_id}").start()
+        return job_id
+
+    def _run(self, info: JobInfo) -> None:
+        env = dict(os.environ)
+        renv = info.runtime_env
+        env.update({str(k): str(v)
+                    for k, v in (renv.get("env_vars") or {}).items()})
+        cwd = renv.get("working_dir") or os.getcwd()
+        py_modules = renv.get("py_modules") or []
+        if py_modules:
+            env["PYTHONPATH"] = os.pathsep.join(
+                list(py_modules) + [env.get("PYTHONPATH", "")])
+        try:
+            log_f = open(info.log_path, "wb")
+        except OSError as e:
+            info.status = JobStatus.FAILED
+            info.message = f"cannot open log file: {e}"
+            return
+        try:
+            proc = subprocess.Popen(
+                info.entrypoint, shell=True, cwd=cwd, env=env,
+                stdout=log_f, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        except OSError as e:
+            info.status = JobStatus.FAILED
+            info.message = str(e)
+            log_f.close()
+            return
+        with self._lock:
+            self._procs[info.job_id] = proc
+        info.status = JobStatus.RUNNING
+        info.start_time = time.time()
+        rc = proc.wait()
+        log_f.close()
+        info.end_time = time.time()
+        info.return_code = rc
+        if info.status != JobStatus.STOPPED:
+            info.status = (JobStatus.SUCCEEDED if rc == 0
+                           else JobStatus.FAILED)
+            if rc != 0:
+                info.message = f"entrypoint exited with code {rc}"
+        with self._lock:
+            self._procs.pop(info.job_id, None)
+
+    def status(self, job_id: str) -> JobInfo:
+        with self._lock:
+            info = self._jobs.get(job_id)
+        if info is None:
+            raise KeyError(job_id)
+        return info
+
+    def list(self) -> List[JobInfo]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def stop(self, job_id: str) -> bool:
+        info = self.status(job_id)
+        with self._lock:
+            proc = self._procs.get(job_id)
+        if proc is None or proc.poll() is not None:
+            return False
+        info.status = JobStatus.STOPPED
+        info.message = "stopped by user"
+        try:
+            # Kill the whole process group (entrypoint may have children).
+            os.killpg(os.getpgid(proc.pid), 15)
+        except (OSError, ProcessLookupError):
+            proc.terminate()
+        return True
+
+    def logs(self, job_id: str, *, tail: Optional[int] = None) -> str:
+        info = self.status(job_id)
+        try:
+            with open(info.log_path, "r", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            return ""
+        if tail is not None:
+            text = "\n".join(text.splitlines()[-tail:])
+        return text
+
+    def wait(self, job_id: str, timeout: float = 300.0) -> JobInfo:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.status(job_id)
+            if info.status in JobStatus.TERMINAL:
+                return info
+            time.sleep(0.1)
+        raise TimeoutError(f"job {job_id} still {self.status(job_id).status}")
+
+
+_manager: Optional[JobManager] = None
+_manager_lock = threading.Lock()
+
+
+def job_manager() -> JobManager:
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = JobManager()
+        return _manager

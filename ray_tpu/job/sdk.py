@@ -1,0 +1,75 @@
+"""JobSubmissionClient — submit jobs locally or to a dashboard address.
+
+Capability-equivalent to the reference's client
+(reference: dashboard/modules/job/sdk.py:39 JobSubmissionClient —
+submit_job/get_job_status/get_job_logs/stop_job/list_jobs over the
+dashboard REST API). address=None uses the in-process JobManager;
+"http://host:port" talks to a running dashboard.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from .manager import JobInfo, job_manager
+
+
+class JobSubmissionClient:
+    def __init__(self, address: Optional[str] = None):
+        self._address = address.rstrip("/") if address else None
+
+    # -- HTTP plumbing -----------------------------------------------------
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None) -> Any:
+        url = f"{self._address}{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            payload = resp.read().decode()
+        return json.loads(payload) if payload else None
+
+    # -- API ---------------------------------------------------------------
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None,
+                   submission_id: Optional[str] = None) -> str:
+        if self._address is None:
+            return job_manager().submit(
+                entrypoint, runtime_env=runtime_env, metadata=metadata,
+                submission_id=submission_id)
+        out = self._request("POST", "/api/jobs/", {
+            "entrypoint": entrypoint, "runtime_env": runtime_env or {},
+            "metadata": metadata or {}, "submission_id": submission_id})
+        return out["job_id"]
+
+    def get_job_status(self, job_id: str) -> str:
+        if self._address is None:
+            return job_manager().status(job_id).status
+        return self._request("GET", f"/api/jobs/{job_id}")["status"]
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        if self._address is None:
+            return job_manager().status(job_id).to_dict()
+        return self._request("GET", f"/api/jobs/{job_id}")
+
+    def get_job_logs(self, job_id: str) -> str:
+        if self._address is None:
+            return job_manager().logs(job_id)
+        return self._request("GET", f"/api/jobs/{job_id}/logs")["logs"]
+
+    def stop_job(self, job_id: str) -> bool:
+        if self._address is None:
+            return job_manager().stop(job_id)
+        return self._request("POST", f"/api/jobs/{job_id}/stop")["stopped"]
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        if self._address is None:
+            return [j.to_dict() for j in job_manager().list()]
+        return self._request("GET", "/api/jobs/")
+
+    def tail_job_logs(self, job_id: str):  # pragma: no cover - thin alias
+        yield self.get_job_logs(job_id)

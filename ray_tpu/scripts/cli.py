@@ -1,0 +1,188 @@
+"""ray-tpu CLI.
+
+Capability-equivalent to the reference's ray CLI + state CLI
+(reference: scripts/scripts.py — status :, timeline, memory,
+microbenchmark :1859; experimental/state/state_cli.py — ray list /
+ray summary). Commands that inspect a LIVE cluster take --address of a
+running dashboard (the reference talks to GCS the same way); without an
+address they start a local throwaway runtime.
+
+  ray-tpu status [--address URL]
+  ray-tpu list {nodes,actors,tasks,objects,workers,placement-groups}
+  ray-tpu summary {tasks,actors,objects}
+  ray-tpu timeline [--output FILE]
+  ray-tpu memory
+  ray-tpu microbenchmark
+  ray-tpu job submit -- <entrypoint...>   / status / logs / stop / list
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Any, Optional
+
+
+def _fetch(address: str, path: str) -> Any:
+    with urllib.request.urlopen(address.rstrip("/") + path,
+                                timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _local_state():
+    import ray_tpu
+    from ray_tpu import state
+
+    ray_tpu.init(num_cpus=1, num_tpus=0)
+    return state
+
+
+def _print(data: Any) -> None:
+    print(json.dumps(data, indent=2, default=str))
+
+
+def cmd_status(args) -> int:
+    if args.address:
+        _print(_fetch(args.address, "/api/cluster_status"))
+        return 0
+    state = _local_state()
+    _print(state.cluster_status())
+    return 0
+
+
+def cmd_list(args) -> int:
+    kind = args.kind.replace("-", "_")
+    if args.address:
+        _print(_fetch(args.address, f"/api/{kind}?limit={args.limit}"))
+        return 0
+    state = _local_state()
+    fn = getattr(state, f"list_{kind}")
+    _print(fn(limit=args.limit))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    state = _local_state()
+    _print(getattr(state, f"summarize_{args.kind}")())
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    if args.address:
+        events = _fetch(args.address, "/api/timeline")
+    else:
+        import ray_tpu
+        from ray_tpu.core.runtime import global_runtime
+
+        ray_tpu.init(num_cpus=1, num_tpus=0)
+        events = global_runtime().timeline()
+    out = args.output or "timeline.json"
+    with open(out, "w") as f:
+        json.dump(events, f)
+    print(f"Wrote {len(events)} events to {out} "
+          "(chrome://tracing compatible)")
+    return 0
+
+
+def cmd_memory(args) -> int:
+    state = _local_state()
+    _print(state.summarize_objects())
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    from ray_tpu._private.perf import run_microbenchmarks
+
+    for line in run_microbenchmarks(quick=args.quick):
+        print(line)
+    return 0
+
+
+def cmd_job(args) -> int:
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address)
+    if args.job_cmd == "submit":
+        entrypoint = " ".join(args.entrypoint)
+        env = json.loads(args.runtime_env_json) \
+            if args.runtime_env_json else None
+        job_id = client.submit_job(entrypoint=entrypoint, runtime_env=env)
+        print(job_id)
+        if args.wait:
+            from ray_tpu.job.manager import job_manager
+
+            info = job_manager().wait(job_id, timeout=args.timeout)
+            print(info.status)
+            return 0 if info.status == "SUCCEEDED" else 1
+        return 0
+    if args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+        return 0
+    if args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+        return 0
+    if args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.job_id) else "not running")
+        return 0
+    if args.job_cmd == "list":
+        _print(client.list_jobs())
+        return 0
+    return 2
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray-tpu", description="ray_tpu cluster CLI")
+    p.add_argument("--address", default=None,
+                   help="dashboard address of a running cluster "
+                        "(e.g. http://127.0.0.1:8265)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+
+    lp = sub.add_parser("list")
+    lp.add_argument("kind", choices=[
+        "nodes", "actors", "tasks", "objects", "workers",
+        "placement-groups"])
+    lp.add_argument("--limit", type=int, default=100)
+    lp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary")
+    sp.add_argument("kind", choices=["tasks", "actors", "objects"])
+    sp.set_defaults(fn=cmd_summary)
+
+    tp = sub.add_parser("timeline")
+    tp.add_argument("--output", default=None)
+    tp.set_defaults(fn=cmd_timeline)
+
+    sub.add_parser("memory").set_defaults(fn=cmd_memory)
+
+    mb = sub.add_parser("microbenchmark")
+    mb.add_argument("--quick", action="store_true")
+    mb.set_defaults(fn=cmd_microbenchmark)
+
+    jp = sub.add_parser("job")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--runtime-env-json", default=None)
+    js.add_argument("--wait", action="store_true")
+    js.add_argument("--timeout", type=float, default=300.0)
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    js.set_defaults(fn=cmd_job)
+    for name in ("status", "logs", "stop"):
+        jc = jsub.add_parser(name)
+        jc.add_argument("job_id")
+        jc.set_defaults(fn=cmd_job)
+    jsub.add_parser("list").set_defaults(fn=cmd_job)
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

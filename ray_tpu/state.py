@@ -1,0 +1,217 @@
+"""State API — list/summarize cluster entities.
+
+Capability-equivalent to the reference's state API
+(reference: python/ray/experimental/state/api.py — list_actors :
+list_tasks/list_objects/list_nodes/list_workers, summarize_tasks :
+summarize_actors, backed by GCS + raylet RPCs; here the runtime's own
+tables are the source of truth). Same record shapes: plain dicts with
+stable keys, filterable, limited.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .core import runtime as _runtime
+
+Filter = Tuple[str, str, Any]  # (key, "="|"!=", value)
+
+
+def _apply_filters(rows: List[Dict[str, Any]],
+                   filters: Optional[Sequence[Filter]],
+                   limit: int) -> List[Dict[str, Any]]:
+    if filters:
+        for key, op, val in filters:
+            if op == "=":
+                rows = [r for r in rows if r.get(key) == val]
+            elif op == "!=":
+                rows = [r for r in rows if r.get(key) != val]
+            else:
+                raise ValueError(f"unsupported filter op {op!r}")
+    return rows[:limit]
+
+
+def _rt():
+    rt = _runtime.global_runtime_or_none()
+    if rt is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return rt
+
+
+def list_nodes(*, filters: Optional[Sequence[Filter]] = None,
+               limit: int = 100) -> List[Dict[str, Any]]:
+    rt = _rt()
+    rows = []
+    for n in rt.scheduler.nodes():
+        rows.append({
+            "node_id": n.node_id,
+            "alive": n.alive,
+            "resources_total": n.total.to_dict(),
+            "resources_available": n.available.to_dict(),
+            "labels": dict(n.labels),
+            "is_head": n.node_id == rt.head_node_id,
+            "utilization": round(n.utilization(), 4),
+        })
+    return _apply_filters(rows, filters, limit)
+
+
+def list_actors(*, filters: Optional[Sequence[Filter]] = None,
+                limit: int = 100) -> List[Dict[str, Any]]:
+    rt = _rt()
+    with rt._actors_lock:
+        actors = list(rt._actors.items())
+    rows = []
+    for aid, st in actors:
+        if st.dead.is_set():
+            state = "DEAD"
+        elif st.ready.is_set():
+            state = "ALIVE"
+        else:
+            state = "PENDING_CREATION"
+        rows.append({
+            "actor_id": aid.hex(),
+            "class_name": st.cls.__qualname__,
+            "name": st.name,
+            "state": state,
+            "node_id": st.node.node_id,
+            "restarts": st.restarts,
+            "max_restarts": st.max_restarts,
+            "pid": getattr(getattr(st, "_worker", None), "pid", None),
+        })
+    return _apply_filters(rows, filters, limit)
+
+
+def list_tasks(*, filters: Optional[Sequence[Filter]] = None,
+               limit: int = 100) -> List[Dict[str, Any]]:
+    """Pending/running tasks (from the pending table) + recently
+    finished ones (from the task-event buffer)."""
+    rt = _rt()
+    rows = []
+    with rt._pending_lock:
+        pending = list(rt._pending_tasks.values())
+    for spec in pending:
+        rows.append({
+            "task_id": spec.task_id.hex(),
+            "name": spec.display_name(),
+            "state": "PENDING_OR_RUNNING",
+            "type": spec.task_type.name,
+            "required_resources": spec.resources.to_dict(),
+        })
+    for ev in rt.events.dump()[-limit:]:
+        rows.append({
+            "task_id": ev.get("tid"),
+            "name": ev.get("name"),
+            "state": "FINISHED",
+            "type": "TASK_EVENT",
+            "duration_ms": round(ev.get("dur", 0) / 1000, 3),
+        })
+    return _apply_filters(rows, filters, limit)
+
+
+def list_objects(*, filters: Optional[Sequence[Filter]] = None,
+                 limit: int = 100) -> List[Dict[str, Any]]:
+    rt = _rt()
+    rows = []
+    with rt.store._lock:
+        items = list(rt.store._objects.items())
+    from .core.runtime import _ShmMarker
+
+    with rt.reference_counter._lock:
+        local_counts = dict(rt.reference_counter._local)
+    for oid, obj in items:
+        in_shm = isinstance(obj.data, _ShmMarker)
+        rows.append({
+            "object_id": oid.hex(),
+            "size_bytes": obj.nbytes if not in_shm else None,
+            "in_shm": in_shm,
+            "is_error": obj.is_error,
+            "local_refs": local_counts.get(oid, 0),
+        })
+    return _apply_filters(rows, filters, limit)
+
+
+def list_workers(*, filters: Optional[Sequence[Filter]] = None,
+                 limit: int = 100) -> List[Dict[str, Any]]:
+    rt = _rt()
+    rows = []
+    if rt.worker_pool is not None:
+        for w in rt.worker_pool.workers():
+            rows.append({
+                "worker_id": w.worker_id,
+                "pid": w.pid,
+                "alive": w.alive and w.proc.poll() is None,
+                "dedicated": w.dedicated,
+                "exported_functions": len(w.exported_fns),
+            })
+    return _apply_filters(rows, filters, limit)
+
+
+def list_placement_groups(*, filters: Optional[Sequence[Filter]] = None,
+                          limit: int = 100) -> List[Dict[str, Any]]:
+    from .core import placement_group as pg_mod
+
+    rows = []
+    for pg in pg_mod._live_placement_groups():
+        rows.append({
+            "placement_group_id": pg.id,
+            "name": pg.name,
+            "state": "CREATED" if getattr(pg, "_committed", False)
+            else "PENDING",
+            "bundles": list(pg.bundle_specs),
+            "strategy": pg.strategy,
+        })
+    return _apply_filters(rows, filters, limit)
+
+
+# ---------------------------------------------------------------------------
+# Summaries (reference: summarize_tasks/actors/objects)
+# ---------------------------------------------------------------------------
+
+def summarize_tasks() -> Dict[str, Any]:
+    rows = list_tasks(limit=10_000)
+    by_name: Dict[str, Dict[str, int]] = {}
+    for r in rows:
+        d = by_name.setdefault(r["name"] or "?", {})
+        d[r["state"]] = d.get(r["state"], 0) + 1
+    return {"total": len(rows), "by_func_name": by_name}
+
+
+def summarize_actors() -> Dict[str, Any]:
+    rows = list_actors(limit=10_000)
+    by_class: Dict[str, Dict[str, int]] = {}
+    for r in rows:
+        d = by_class.setdefault(r["class_name"], {})
+        d[r["state"]] = d.get(r["state"], 0) + 1
+    return {"total": len(rows), "by_class": by_class}
+
+
+def summarize_objects() -> Dict[str, Any]:
+    rows = list_objects(limit=100_000)
+    rt = _rt()
+    out = {
+        "total": len(rows),
+        "total_inline_bytes": sum(r["size_bytes"] or 0 for r in rows),
+        "num_in_shm": sum(1 for r in rows if r["in_shm"]),
+        "num_errors": sum(1 for r in rows if r["is_error"]),
+    }
+    if rt.shm is not None:
+        out["shm_used_bytes"] = rt.shm.used()
+        out["shm_capacity_bytes"] = rt.shm.capacity()
+    return out
+
+
+def cluster_status() -> Dict[str, Any]:
+    """One-shot status blob (CLI `ray-tpu status`, dashboard)."""
+    rt = _rt()
+    demand = rt.scheduler.pending_demand()
+    return {
+        "timestamp": time.time(),
+        "nodes": list_nodes(),
+        "resources_total": rt.cluster_resources(),
+        "resources_available": rt.available_resources(),
+        "pending_tasks": len(demand),
+        "pending_demand": [d.to_dict() for d in demand],
+        "actors": summarize_actors(),
+        "objects": summarize_objects(),
+    }

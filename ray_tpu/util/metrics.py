@@ -1,0 +1,161 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Capability-equivalent to the reference's ray.util.metrics
+(reference: python/ray/util/metrics.py — Counter :inc, Gauge :set,
+Histogram :observe, tag_keys/default_tags) plus the Prometheus text
+exposition the reference produces via its per-node metrics agent
+(reference: _private/metrics_agent.py:11-22, prometheus_exporter.py).
+The dashboard serves `prometheus_text()` at /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: Dict[str, "Metric"] = {}
+
+TagMap = Tuple[Tuple[str, str], ...]
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> TagMap:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name or not name.replace("_", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _REGISTRY_LOCK:
+            existing = _REGISTRY.get(name)
+            if existing is not None and type(existing) is not type(self):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(existing).__name__}")
+            _REGISTRY[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> TagMap:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        extra = set(merged) - set(self._tag_keys)
+        if extra:
+            raise ValueError(
+                f"tags {sorted(extra)} not in declared tag_keys "
+                f"{self._tag_keys}")
+        return _tags_key(merged)
+
+    @property
+    def info(self) -> Dict[str, object]:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys}
+
+
+class Counter(Metric):
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[TagMap, float] = {}
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter can only increase")
+        k = self._merged(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[TagMap, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[self._merged(tags)] = float(value)
+
+
+class Histogram(Metric):
+    def __init__(self, name, description="",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._bounds = sorted(boundaries or
+                              (0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10))
+        # per tag-set: (bucket counts, sum, count)
+        self._values: Dict[TagMap, List] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        k = self._merged(tags)
+        with self._lock:
+            st = self._values.setdefault(
+                k, [[0] * (len(self._bounds) + 1), 0.0, 0])
+            buckets, _, _ = st
+            for i, b in enumerate(self._bounds):
+                if value <= b:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            st[1] += value
+            st[2] += 1
+
+
+def _fmt_tags(tags: TagMap, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in tags]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_text() -> str:
+    """Render every registered metric in Prometheus exposition format."""
+    out: List[str] = []
+    with _REGISTRY_LOCK:
+        metrics = list(_REGISTRY.values())
+    for m in metrics:
+        name = m._name
+        if isinstance(m, Counter):
+            out.append(f"# TYPE {name} counter")
+            with m._lock:
+                for tags, v in m._values.items():
+                    out.append(f"{name}{_fmt_tags(tags)} {v}")
+        elif isinstance(m, Gauge):
+            out.append(f"# TYPE {name} gauge")
+            with m._lock:
+                for tags, v in m._values.items():
+                    out.append(f"{name}{_fmt_tags(tags)} {v}")
+        elif isinstance(m, Histogram):
+            out.append(f"# TYPE {name} histogram")
+            with m._lock:
+                for tags, (buckets, total, count) in m._values.items():
+                    acc = 0
+                    for i, b in enumerate(m._bounds):
+                        acc += buckets[i]
+                        out.append(
+                            f"{name}_bucket"
+                            f"{_fmt_tags(tags, f'le=\"{b}\"')} {acc}")
+                    acc += buckets[-1]
+                    out.append(
+                        f"{name}_bucket{_fmt_tags(tags, 'le=\"+Inf\"')} "
+                        f"{acc}")
+                    out.append(f"{name}_sum{_fmt_tags(tags)} {total}")
+                    out.append(f"{name}_count{_fmt_tags(tags)} {count}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def clear_registry() -> None:
+    """Test hook."""
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()

@@ -1,0 +1,235 @@
+"""Cluster tooling: state API, metrics, dashboard REST, job submission,
+CLI."""
+
+import json
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.util import metrics
+
+
+# ---------------------------------------------------------------------------
+# State API
+# ---------------------------------------------------------------------------
+
+def test_list_nodes_and_status(ray_start):
+    nodes = state.list_nodes()
+    assert len(nodes) == 1
+    assert nodes[0]["is_head"]
+    st = state.cluster_status()
+    assert st["resources_total"]["CPU"] == 4
+    assert st["actors"]["total"] == 0
+
+
+def test_list_actors_and_summary(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class A:
+        def f(self):
+            return 1
+
+    a1, a2 = A.remote(), A.remote()
+    ray.get([a1.f.remote(), a2.f.remote()])
+    rows = state.list_actors()
+    assert len(rows) == 2
+    assert all(r["state"] == "ALIVE" for r in rows)
+    by_class = state.summarize_actors()["by_class"]
+    key = next(k for k in by_class if k.endswith("A"))
+    assert by_class[key]["ALIVE"] == 2
+
+    ray.kill(a1)
+    time.sleep(0.3)
+    states = sorted(r["state"] for r in state.list_actors())
+    assert states == ["ALIVE", "DEAD"]
+
+
+def test_list_objects_and_filters(ray_start):
+    ray = ray_start
+    refs = [ray.put(i) for i in range(5)]
+    rows = state.list_objects(limit=1000)
+    assert len(rows) >= 5
+    errs = state.list_objects(filters=[("is_error", "=", True)])
+    assert errs == []
+    summary = state.summarize_objects()
+    assert summary["total"] >= 5
+    del refs
+
+
+def test_list_tasks_records_finished(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def f():
+        return 1
+
+    ray.get([f.remote() for _ in range(3)])
+    rows = state.list_tasks(limit=50)
+    finished = [r for r in rows if r["state"] == "FINISHED"]
+    assert len(finished) >= 3
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+def test_metrics_counter_gauge_histogram():
+    metrics.clear_registry()
+    c = metrics.Counter("req_total", "requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = metrics.Gauge("inflight", tag_keys=())
+    g.set(7)
+    h = metrics.Histogram("latency_s", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = metrics.prometheus_text()
+    assert 'req_total{route="/a"} 3.0' in text
+    assert "inflight 7.0" in text
+    assert 'latency_s_bucket{le="0.1"} 1' in text
+    assert 'latency_s_bucket{le="+Inf"} 3' in text
+    assert "latency_s_count 3" in text
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        c.inc(tags={"bogus": "x"})
+    metrics.clear_registry()
+
+
+# ---------------------------------------------------------------------------
+# Jobs
+# ---------------------------------------------------------------------------
+
+def test_job_submit_success_and_logs(tmp_path):
+    from ray_tpu.job import JobSubmissionClient
+    from ray_tpu.job.manager import JobManager
+
+    mgr = JobManager(log_dir=str(tmp_path))
+    jid = mgr.submit(f"{sys.executable} -c \"print('hello from job')\"")
+    info = mgr.wait(jid, timeout=60)
+    assert info.status == "SUCCEEDED"
+    assert "hello from job" in mgr.logs(jid)
+
+
+def test_job_failure_and_env(tmp_path):
+    from ray_tpu.job.manager import JobManager
+
+    mgr = JobManager(log_dir=str(tmp_path))
+    jid = mgr.submit(
+        f"{sys.executable} -c \"import os,sys; "
+        f"print(os.environ['MY_FLAG']); sys.exit(3)\"",
+        runtime_env={"env_vars": {"MY_FLAG": "on"}})
+    info = mgr.wait(jid, timeout=60)
+    assert info.status == "FAILED"
+    assert info.return_code == 3
+    assert "on" in mgr.logs(jid)
+
+
+def test_job_stop(tmp_path):
+    from ray_tpu.job.manager import JobManager
+
+    mgr = JobManager(log_dir=str(tmp_path))
+    jid = mgr.submit(f"{sys.executable} -c \"import time; time.sleep(60)\"")
+    deadline = time.monotonic() + 30
+    while mgr.status(jid).status == "PENDING":
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    assert mgr.stop(jid)
+    info = mgr.wait(jid, timeout=30)
+    assert info.status == "STOPPED"
+
+
+# ---------------------------------------------------------------------------
+# Dashboard REST
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def dashboard(ray_start):
+    from ray_tpu.dashboard import start_dashboard
+
+    server = start_dashboard(port=0)
+    yield server
+    server.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(server.address + path, timeout=30) as r:
+        body = r.read().decode()
+    return json.loads(body) if body.startswith(("{", "[")) else body
+
+
+def test_dashboard_endpoints(dashboard, ray_start):
+    ray = ray_start
+    assert _get(dashboard, "/api/version")["version"]
+    assert _get(dashboard, "/healthz") == "success"
+
+    @ray.remote
+    def f():
+        return np.zeros(4)
+
+    ray.get(f.remote())
+    st = _get(dashboard, "/api/cluster_status")
+    assert st["resources_total"]["CPU"] == 4
+    assert isinstance(_get(dashboard, "/api/nodes"), list)
+    assert isinstance(_get(dashboard, "/api/actors"), list)
+    assert isinstance(_get(dashboard, "/api/timeline"), list)
+
+    metrics.clear_registry()
+    metrics.Counter("dash_hits", tag_keys=()).inc()
+    with urllib.request.urlopen(dashboard.address + "/metrics",
+                                timeout=30) as r:
+        assert "dash_hits 1.0" in r.read().decode()
+    metrics.clear_registry()
+
+
+def test_dashboard_job_api(dashboard):
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient(dashboard.address)
+    jid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('via rest')\"")
+    deadline = time.monotonic() + 60
+    while client.get_job_status(jid) not in (
+            "SUCCEEDED", "FAILED", "STOPPED"):
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+    assert client.get_job_status(jid) == "SUCCEEDED"
+    assert "via rest" in client.get_job_logs(jid)
+    assert any(j["job_id"] == jid for j in client.list_jobs())
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_status_and_list(ray_start, capsys):
+    from ray_tpu.scripts.cli import main
+
+    assert main(["status"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["resources_total"]["CPU"] == 4
+
+    assert main(["list", "nodes"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert rows[0]["is_head"]
+
+
+def test_cli_timeline(ray_start, tmp_path, capsys):
+    from ray_tpu.scripts.cli import main
+
+    @ray_start.remote
+    def f():
+        return 1
+
+    ray_start.get(f.remote())
+    out = str(tmp_path / "t.json")
+    assert main(["timeline", "--output", out]) == 0
+    data = json.load(open(out))
+    assert isinstance(data, list)
