@@ -124,14 +124,29 @@ class StandardAutoscaler:
     # -- sizing ------------------------------------------------------------
     def _demand_nodes_needed(self) -> int:
         """Bin-pack pending demand into worker-node-sized bins
-        (reference: resource_demand_scheduler.py get_nodes_for)."""
+        (reference: resource_demand_scheduler.py get_nodes_for).
+
+        Demand is first absorbed by the free capacity of nodes that
+        already exist (the reference packs onto existing nodes'
+        available resources before asking for new ones) — otherwise a
+        transiently-queued task next to an idle worker launches a node.
+        """
         demand = self._rt.scheduler.pending_demand()
         if not demand:
             return 0
+        free = [n.available for n in self._rt.scheduler.nodes()]
+        unmet = []
+        for req in sorted(demand, key=lambda r: -sum(r.to_dict().values())):
+            for i, f in enumerate(free):
+                if req.fits(f):
+                    free[i] = f.subtract(req)
+                    break
+            else:
+                unmet.append(req)
         cap = ResourceSet(self.config.worker_resources)
         nodes_needed = 0
         remaining = None
-        for req in sorted(demand, key=lambda r: -sum(r.to_dict().values())):
+        for req in unmet:
             if not req.fits(cap):
                 continue  # never satisfiable by this node type
             if remaining is not None and req.fits(remaining):
@@ -163,7 +178,9 @@ class StandardAutoscaler:
         now = time.monotonic()
         demand = self._rt.scheduler.pending_demand()
         by_id = {n.node_id: n for n in self._rt.scheduler.nodes()}
-        for node_id in self.provider.non_terminated_nodes():
+        candidates = self.provider.non_terminated_nodes()
+        n_alive = len(candidates)
+        for node_id in candidates:
             node = by_id.get(node_id)
             busy = node is not None and (
                 node.total.to_dict() != node.available.to_dict())
@@ -171,7 +188,6 @@ class StandardAutoscaler:
                 self._idle_since.pop(node_id, None)
                 continue
             since = self._idle_since.setdefault(node_id, now)
-            n_alive = len(self.provider.non_terminated_nodes())
             if (now - since >= self.config.idle_timeout_s
                     and n_alive - terminated > self.config.min_workers):
                 self.provider.terminate_node(node_id)

@@ -36,6 +36,7 @@ from .object_ref import ObjectRef
 from .object_store import MemoryStore
 from .reference_counter import ReferenceCounter
 from .resources import CPU, TPU, ResourceSet
+from .runtime_env import applied as _renv_applied
 from .scheduler import NodeState, Scheduler
 from .task import FunctionDescriptor, TaskSpec, TaskType
 
@@ -215,12 +216,14 @@ class ActorState:
     def __init__(self, rt: "Runtime", actor_id: ActorID, cls: type,
                  args, kwargs, *, node: NodeState, name: str,
                  max_concurrency: int, max_restarts: int,
-                 resources: ResourceSet):
+                 resources: ResourceSet,
+                 runtime_env: Optional[Dict[str, Any]] = None):
         self.rt = rt
         self.actor_id = actor_id
         self.cls = cls
         self.init_args = args
         self.init_kwargs = kwargs
+        self.runtime_env = runtime_env
         self.node = node
         self.name = name
         self.max_concurrency = max(1, max_concurrency)
@@ -268,7 +271,9 @@ class ActorState:
     # -- lifecycle --------------------------------------------------------
     def _construct(self, gen: int) -> bool:
         try:
-            self.instance = self.cls(*self.init_args, **self.init_kwargs)
+            with _renv_applied(self.runtime_env):
+                self.instance = self.cls(*self.init_args,
+                                         **self.init_kwargs)
             self.ready.set()
             return True
         except BaseException as e:  # noqa: BLE001
@@ -392,7 +397,8 @@ class ActorState:
         try:
             method = self._bind_method(spec)
             args, kwargs = self.rt._materialize_args(spec)
-            result = method(*args, **kwargs)
+            with _renv_applied(self.runtime_env):
+                result = method(*args, **kwargs)
             self.rt._store_results(spec, result, t0)
         except _ActorExit:
             self.rt._store_results(spec, None, t0)
@@ -411,9 +417,10 @@ class ActorState:
         try:
             method = self._bind_method(spec)
             args, kwargs = self.rt._materialize_args(spec)
-            result = method(*args, **kwargs)
-            if hasattr(result, "__await__"):
-                result = await result
+            with _renv_applied(self.runtime_env):
+                result = method(*args, **kwargs)
+                if hasattr(result, "__await__"):
+                    result = await result
             self.rt._store_results(spec, result, t0)
         except _ActorExit:
             self.rt._store_results(spec, None, t0)
@@ -468,7 +475,7 @@ class ProcActorState(ActorState):
             # a fresh worker process for every actor) — actors never
             # drain the task pool.
             w = self._pool.spawn_dedicated()
-            reply = w.run_task({
+            create_msg = {
                 "type": "actor_create",
                 "task_id": None,
                 "actor_id": self.actor_id.binary(),
@@ -476,7 +483,10 @@ class ProcActorState(ActorState):
                 "args": tuple(self.rt._pack_arg(a) for a in self.init_args),
                 "kwargs": {k: self.rt._pack_arg(v)
                            for k, v in self.init_kwargs.items()},
-            })
+            }
+            if self.runtime_env:
+                create_msg["runtime_env"] = self.runtime_env
+            reply = w.run_task(create_msg)
             if reply.get("error") is not None:
                 raise self.rt._unpack_error(reply["error"])
             self._worker = w
@@ -512,6 +522,8 @@ class ProcActorState(ActorState):
                 "return_ids": [oid.binary() for oid in spec.return_ids],
                 "streaming": streaming,
             }
+            if self.runtime_env:
+                msg["runtime_env"] = self.runtime_env
 
             def on_stream(item):
                 oid = ObjectID.for_return(spec.task_id, item["index"])
@@ -963,6 +975,7 @@ class Runtime:
                     max_restarts=opts.get(
                         "max_restarts", config.default_actor_max_restarts),
                     resources=resources,
+                    runtime_env=opts.get("runtime_env"),
                 )
                 with self._actors_lock:
                     self._actors[actor_id] = st
@@ -1226,7 +1239,8 @@ class Runtime:
                 raise TaskCancelledError(spec.display_name())
             func = self.function_manager.get(spec.descriptor.function_id)
             args, kwargs = self._materialize_args(spec)
-            result = func(*args, **kwargs)
+            with _renv_applied(spec.runtime_env):
+                result = func(*args, **kwargs)
             self._store_results(spec, result, t0)
         except BaseException as e:  # noqa: BLE001
             retried = self._maybe_retry(spec, e)

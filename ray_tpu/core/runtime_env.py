@@ -1,0 +1,75 @@
+"""Per-task/actor runtime environments.
+
+Capability-equivalent of the reference's runtime_env plugin vocabulary
+(reference: python/ray/runtime_env/, _private/runtime_env/ — plugins
+pip/conda/working_dir/py_modules/env_vars; applied by the per-node agent
+before a lease is granted). Here the supported, hermetic subset —
+env_vars, working_dir, py_modules — is applied around each user-code
+invocation and fully restored afterwards, in whichever process executes
+the task (driver-embedded node or spawned worker).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+from typing import Any, Dict, Optional
+
+VALID_KEYS = frozenset({"env_vars", "working_dir", "py_modules"})
+
+
+def validate(renv: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    if not renv:
+        return None
+    if not isinstance(renv, dict):
+        raise TypeError(f"runtime_env must be a dict, got {type(renv)}")
+    unknown = set(renv) - VALID_KEYS
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; "
+            f"supported: {sorted(VALID_KEYS)}")
+    return renv
+
+
+@contextlib.contextmanager
+def applied(renv: Optional[Dict[str, Any]]):
+    """Apply `renv` for the duration of one task; restore on exit.
+
+    env vars are set and restored, working_dir chdir'd, py_modules
+    prepended to sys.path. Process-global by nature — concurrent tasks
+    in the same process observe each other's env while active (the
+    reference gives each runtime_env its own worker process; the
+    spawned-worker path here does too).
+    """
+    if not renv:
+        yield
+        return
+    saved_env: Dict[str, Optional[str]] = {}
+    saved_cwd = None
+    added_paths = []
+    try:
+        for k, v in (renv.get("env_vars") or {}).items():
+            saved_env[str(k)] = os.environ.get(str(k))
+            os.environ[str(k)] = str(v)
+        wd = renv.get("working_dir")
+        if wd:
+            saved_cwd = os.getcwd()
+            os.chdir(wd)
+        for p in (renv.get("py_modules") or []):
+            p = str(p)
+            if p not in sys.path:
+                sys.path.insert(0, p)
+                added_paths.append(p)
+        yield
+    finally:
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        if saved_cwd is not None:
+            os.chdir(saved_cwd)
+        for p in added_paths:
+            with contextlib.suppress(ValueError):
+                sys.path.remove(p)

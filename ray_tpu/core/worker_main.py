@@ -24,6 +24,15 @@ import traceback
 from typing import Any, Dict, Optional
 
 
+def _runtime_env(renv: Optional[Dict[str, Any]]):
+    # Lazy import: pulling in ray_tpu.core.runtime_env at module scope
+    # would run the full ray_tpu package __init__ (jax and friends) at
+    # worker startup and blow the spawn-accept deadline.
+    from ray_tpu.core.runtime_env import applied
+
+    return applied(renv)
+
+
 def _setup(args):
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.connect(args.socket)
@@ -144,7 +153,8 @@ def main() -> None:
                 cls = cloudpickle.loads(msg["cls"])
                 call_args, call_kwargs = _unpack_args(
                     msg["args"], msg["kwargs"], shm)
-                actors[msg["actor_id"]] = cls(*call_args, **call_kwargs)
+                with _runtime_env(msg.get("runtime_env")):
+                    actors[msg["actor_id"]] = cls(*call_args, **call_kwargs)
                 result = None
             elif mtype == "actor_call":
                 inst = actors.get(msg["actor_id"])
@@ -154,7 +164,8 @@ def main() -> None:
                 method = getattr(inst, msg["method"])
                 call_args, call_kwargs = _unpack_args(
                     msg["args"], msg["kwargs"], shm)
-                result = method(*call_args, **call_kwargs)
+                with _runtime_env(msg.get("runtime_env")):
+                    result = method(*call_args, **call_kwargs)
             elif mtype == "actor_kill":
                 actors.pop(msg["actor_id"], None)
                 result = None

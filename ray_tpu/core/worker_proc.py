@@ -28,6 +28,7 @@ parallel, unlike the in-process thread-pool nodes.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import queue
@@ -184,9 +185,78 @@ class WorkerPool:
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self._sock_path)
         self._listener.listen(max(8, num_workers))
+        # Hello routing: concurrent spawns (e.g. two crashed workers
+        # respawning from different threads) must not steal each
+        # other's connections off the shared listener — a stolen-and-
+        # closed hello kills the other spawn's worker. One thread
+        # accepts at a time; arrived connections are parked by
+        # worker_id for their waiter.
+        self._accept_lock = threading.Lock()
+        self._hello_cv = threading.Condition()
+        self._hellos: Dict[int, socket.socket] = {}
 
         for _ in range(num_workers):
             self._spawn()
+
+    def _await_hello(self, wid: int, deadline: float) -> socket.socket:
+        while True:
+            with self._hello_cv:
+                conn = self._hellos.pop(wid, None)
+                if conn is not None:
+                    return conn
+            if time.monotonic() >= deadline:
+                # A late hello may still get parked for us by another
+                # accepter; reap it so the fd cannot leak.
+                with self._hello_cv:
+                    conn = self._hellos.pop(wid, None)
+                if conn is not None:
+                    return conn
+                raise TimeoutError(
+                    f"worker {wid} did not connect before deadline")
+            # One accepter at a time; everyone else waits on the cv.
+            if self._accept_lock.acquire(timeout=0.1):
+                try:
+                    with self._hello_cv:
+                        conn = self._hellos.pop(wid, None)
+                    if conn is not None:
+                        return conn
+                    self._listener.settimeout(
+                        max(0.1, deadline - time.monotonic()))
+                    try:
+                        conn, _ = self._listener.accept()
+                    except (socket.timeout, TimeoutError):
+                        continue
+                    try:
+                        # A connected-but-silent or crashed-at-startup
+                        # worker must not wedge (we hold _accept_lock)
+                        # or abort an unrelated spawn.
+                        conn.settimeout(5)
+                        hello = recv_msg(conn)
+                        conn.settimeout(None)
+                    except Exception:  # noqa: BLE001
+                        with contextlib.suppress(OSError):
+                            conn.close()
+                        continue
+                    got = hello.get("worker_id") if isinstance(
+                        hello, dict) else None
+                    if got == wid:
+                        return conn
+                    if not isinstance(got, int):
+                        with contextlib.suppress(OSError):
+                            conn.close()
+                        continue
+                    with self._hello_cv:
+                        stale = self._hellos.pop(got, None)
+                        self._hellos[got] = conn
+                        self._hello_cv.notify_all()
+                    if stale is not None:
+                        with contextlib.suppress(OSError):
+                            stale.close()
+                finally:
+                    self._accept_lock.release()
+            else:
+                with self._hello_cv:
+                    self._hello_cv.wait(timeout=0.1)
 
     def _spawn_proc(self) -> WorkerProcess:
         with self._lock:
@@ -202,13 +272,18 @@ class WorkerPool:
         # Workers must not grab the (single) TPU chip the driver owns.
         env.setdefault("JAX_PLATFORMS", "cpu")
         proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd())
-        self._listener.settimeout(30)
-        while True:
-            conn, _ = self._listener.accept()
-            hello = recv_msg(conn)
-            if hello.get("worker_id") == wid:
-                break
-            conn.close()  # stale connection from a previous spawn
+        try:
+            conn = self._await_hello(wid, time.monotonic() + 30)
+        except TimeoutError:
+            with contextlib.suppress(Exception):
+                proc.kill()
+            # A hello parked for us after the deadline would leak its fd.
+            with self._hello_cv:
+                late = self._hellos.pop(wid, None)
+            if late is not None:
+                with contextlib.suppress(OSError):
+                    late.close()
+            raise
         w = WorkerProcess(wid, proc, conn)
         with self._lock:
             self._all[wid] = w
@@ -281,6 +356,12 @@ class WorkerPool:
             w.shutdown()
         with self._lock:
             self._all.clear()
+        with self._hello_cv:
+            parked = list(self._hellos.values())
+            self._hellos.clear()
+        for conn in parked:
+            with contextlib.suppress(OSError):
+                conn.close()
         try:
             self._listener.close()
             os.unlink(self._sock_path)

@@ -123,6 +123,14 @@ class DashboardServer:
         r.add_get("/api/workers", lister(state.list_workers))
         r.add_get("/api/placement_groups",
                   lister(state.list_placement_groups))
+        async def summary(request):
+            kind = request.match_info["kind"]
+            fn = getattr(state, f"summarize_{kind}", None)
+            if fn is None:
+                raise web.HTTPNotFound()
+            return _json(fn())
+
+        r.add_get("/api/summary/{kind}", summary)
         r.add_get("/api/timeline", timeline)
         r.add_get("/metrics", prom_metrics)
         r.add_post("/api/jobs/", submit_job)
@@ -140,11 +148,17 @@ class DashboardServer:
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             self._loop = loop
-            app = self._build_app()
-            runner = web.AppRunner(app)
-            loop.run_until_complete(runner.setup())
-            site = web.TCPSite(runner, self.host, self.port)
-            loop.run_until_complete(site.start())
+            try:
+                app = self._build_app()
+                runner = web.AppRunner(app)
+                loop.run_until_complete(runner.setup())
+                site = web.TCPSite(runner, self.host, self.port)
+                loop.run_until_complete(site.start())
+            except BaseException as e:  # noqa: BLE001 — surface to caller
+                self._start_error = e
+                self._started.set()
+                loop.close()
+                return
             # TCPSite with port 0 picks a free port; recover it.
             server = site._server
             if server and server.sockets:
@@ -155,11 +169,16 @@ class DashboardServer:
             loop.run_until_complete(runner.cleanup())
             loop.close()
 
+        self._start_error: Optional[BaseException] = None
         self._thread = threading.Thread(
             target=run, daemon=True, name="dashboard")
         self._thread.start()
         if not self._started.wait(timeout=15):
-            raise RuntimeError("dashboard failed to start")
+            raise RuntimeError("dashboard failed to start (timeout)")
+        if self._start_error is not None:
+            raise RuntimeError(
+                f"dashboard failed to start on {self.host}:{self.port}"
+            ) from self._start_error
         return self
 
     @property

@@ -10,6 +10,7 @@ dashboard REST API). address=None uses the in-process JobManager;
 from __future__ import annotations
 
 import json
+import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
@@ -70,6 +71,24 @@ class JobSubmissionClient:
         if self._address is None:
             return [j.to_dict() for j in job_manager().list()]
         return self._request("GET", "/api/jobs/")
+
+    def wait_job(self, job_id: str, timeout: float = 300.0,
+                 poll_s: float = 0.5) -> str:
+        """Block until the job reaches a terminal status; works both
+        locally and against a remote dashboard (the reference CLI polls
+        the REST API the same way)."""
+        if self._address is None:
+            return job_manager().wait(job_id, timeout=timeout).status
+        deadline = time.monotonic() + timeout
+        while True:
+            status = self.get_job_status(job_id)
+            if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+                return status
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"job {job_id} not done after {timeout}s "
+                    f"(status={status})")
+            time.sleep(poll_s)
 
     def tail_job_logs(self, job_id: str):  # pragma: no cover - thin alias
         yield self.get_job_logs(job_id)

@@ -64,6 +64,9 @@ def cmd_list(args) -> int:
 
 
 def cmd_summary(args) -> int:
+    if args.address:
+        _print(_fetch(args.address, f"/api/summary/{args.kind}"))
+        return 0
     state = _local_state()
     _print(getattr(state, f"summarize_{args.kind}")())
     return 0
@@ -87,6 +90,9 @@ def cmd_timeline(args) -> int:
 
 
 def cmd_memory(args) -> int:
+    if args.address:
+        _print(_fetch(args.address, "/api/summary/objects"))
+        return 0
     state = _local_state()
     _print(state.summarize_objects())
     return 0
@@ -105,17 +111,23 @@ def cmd_job(args) -> int:
 
     client = JobSubmissionClient(args.address)
     if args.job_cmd == "submit":
-        entrypoint = " ".join(args.entrypoint)
+        words = list(args.entrypoint)
+        if words and words[0] == "--":  # REMAINDER keeps the separator
+            words = words[1:]
+        if not words:
+            print("error: empty entrypoint", file=sys.stderr)
+            return 2
+        import shlex
+
+        entrypoint = shlex.join(words)
         env = json.loads(args.runtime_env_json) \
             if args.runtime_env_json else None
         job_id = client.submit_job(entrypoint=entrypoint, runtime_env=env)
         print(job_id)
         if args.wait:
-            from ray_tpu.job.manager import job_manager
-
-            info = job_manager().wait(job_id, timeout=args.timeout)
-            print(info.status)
-            return 0 if info.status == "SUCCEEDED" else 1
+            status = client.wait_job(job_id, timeout=args.timeout)
+            print(status)
+            return 0 if status == "SUCCEEDED" else 1
         return 0
     if args.job_cmd == "status":
         print(client.get_job_status(args.job_id))

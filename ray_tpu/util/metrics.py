@@ -112,8 +112,14 @@ class Histogram(Metric):
             st[2] += 1
 
 
+def _escape_label(v: str) -> str:
+    # Prometheus exposition format: label values must escape \, ", \n.
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_tags(tags: TagMap, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in tags]
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in tags]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""

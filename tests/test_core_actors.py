@@ -250,3 +250,18 @@ def test_actor_resources_held_and_released(ray_start):
     time.sleep(0.3)
     avail = ray.available_resources()
     assert avail.get("CPU", 0) == 4.0
+
+
+def test_inprocess_actor_runtime_env(ray_start):
+    import os
+
+    ray = ray_start
+
+    @ray.remote(runtime_env={"env_vars": {"INPROC_RT_ENV": "1"}})
+    class Probe:
+        def read(self):
+            return os.environ.get("INPROC_RT_ENV")
+
+    p = Probe.remote()
+    assert ray.get(p.read.remote()) == "1"
+    assert os.environ.get("INPROC_RT_ENV") is None

@@ -233,3 +233,82 @@ def test_cli_timeline(ray_start, tmp_path, capsys):
     assert main(["timeline", "--output", out]) == 0
     data = json.load(open(out))
     assert isinstance(data, list)
+
+
+def test_metrics_label_escaping():
+    metrics.clear_registry()
+    c = metrics.Counter("errs_total", "errors", tag_keys=("msg",))
+    c.inc(tags={"msg": 'bad "input"\nwith \\slash'})
+    text = metrics.prometheus_text()
+    assert 'msg="bad \\"input\\"\\nwith \\\\slash"' in text
+    metrics.clear_registry()
+
+
+def test_cli_job_submit_strips_separator(tmp_path, capsys):
+    from ray_tpu.scripts.cli import main
+
+    rc = main(["job", "submit", "--wait", "--timeout", "60", "--",
+               sys.executable, "-c", "print('ok')"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "SUCCEEDED" in out
+
+
+def test_idle_scale_down_single_tick():
+    """One update() must scale all the way down to min_workers
+    (terminations must not be double-counted against the alive set)."""
+    from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig,
+                                               StandardAutoscaler)
+    from tests.test_autoscaler import MockProvider
+
+    provider = MockProvider()
+
+    class FakeSched:
+        def pending_demand(self):
+            return []
+
+        def nodes(self):
+            return []
+
+    class FakeRt:
+        scheduler = FakeSched()
+
+    asc = StandardAutoscaler(
+        AutoscalerConfig(min_workers=0, max_workers=5,
+                         idle_timeout_s=0.0), provider,
+        runtime=FakeRt())
+    for _ in range(3):
+        provider.create_node({"CPU": 1.0}, {})
+    asc.update()
+    assert len(provider.non_terminated_nodes()) == 0
+
+
+def test_no_scale_up_when_existing_capacity_covers_demand():
+    from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig,
+                                               StandardAutoscaler)
+    from ray_tpu.core.resources import ResourceSet
+    from tests.test_autoscaler import MockProvider
+
+    provider = MockProvider()
+
+    class FakeNode:
+        node_id = "n0"
+        total = ResourceSet({"CPU": 2.0})
+        available = ResourceSet({"CPU": 2.0})
+
+    class FakeSched:
+        def pending_demand(self):
+            return [ResourceSet({"CPU": 1.0})]
+
+        def nodes(self):
+            return [FakeNode()]
+
+    class FakeRt:
+        scheduler = FakeSched()
+
+    asc = StandardAutoscaler(
+        AutoscalerConfig(min_workers=0, max_workers=5,
+                         idle_timeout_s=3600.0), provider,
+        runtime=FakeRt())
+    out = asc.update()
+    assert out["launched"] == 0

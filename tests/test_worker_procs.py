@@ -281,3 +281,37 @@ def test_pool_respawns_to_capacity(ray_procs):
     while len(pool.workers()) < 2 and time.monotonic() < deadline:
         time.sleep(0.1)
     assert len(pool.workers()) == 2
+
+
+def test_task_runtime_env_applied_and_restored(ray_procs):
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC,
+                runtime_env={"env_vars": {"RT_ENV_PROBE": "yes"}})
+    def read_env():
+        return os.environ.get("RT_ENV_PROBE")
+
+    @ray.remote(scheduling_strategy=PROC)
+    def read_env_plain():
+        return os.environ.get("RT_ENV_PROBE")
+
+    assert ray.get(read_env.remote()) == "yes"
+    # The env var must not leak into subsequent tasks on the same worker.
+    assert all(v is None for v in
+               ray.get([read_env_plain.remote() for _ in range(4)]))
+
+
+def test_actor_runtime_env_applied(ray_procs):
+    ray = ray_procs
+
+    @ray.remote(scheduling_strategy=PROC,
+                runtime_env={"env_vars": {"ACTOR_RT_ENV": "on"}})
+    class Probe:
+        def __init__(self):
+            self.at_init = os.environ.get("ACTOR_RT_ENV")
+
+        def read(self):
+            return self.at_init, os.environ.get("ACTOR_RT_ENV")
+
+    p = Probe.remote()
+    assert ray.get(p.read.remote()) == ("on", "on")
