@@ -131,12 +131,22 @@ class StandardAutoscaler:
         available resources before asking for new ones) — otherwise a
         transiently-queued task next to an idle worker launches a node.
         """
-        demand = self._rt.scheduler.pending_demand()
+        sched = self._rt.scheduler
+        if hasattr(sched, "pending_demand_detailed"):
+            demand = sched.pending_demand_detailed()
+        else:
+            demand = [(r, False) for r in sched.pending_demand()]
         if not demand:
             return 0
         free = [n.available for n in self._rt.scheduler.nodes()]
         unmet = []
-        for req in sorted(demand, key=lambda r: -sum(r.to_dict().values())):
+        for req, constrained in sorted(
+                demand, key=lambda rc: -sum(rc[0].to_dict().values())):
+            if constrained:
+                # Hard affinity / PG demand can't be satisfied by
+                # arbitrary free capacity — always counts as unmet.
+                unmet.append(req)
+                continue
             for i, f in enumerate(free):
                 if req.fits(f):
                     free[i] = f.subtract(req)

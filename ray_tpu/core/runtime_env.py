@@ -29,6 +29,18 @@ def validate(renv: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unknown)}; "
             f"supported: {sorted(VALID_KEYS)}")
+    ev = renv.get("env_vars")
+    if ev is not None and (not isinstance(ev, dict) or not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in ev.items())):
+        raise ValueError("runtime_env['env_vars'] must be a dict[str, str]")
+    wd = renv.get("working_dir")
+    if wd is not None and not isinstance(wd, (str, os.PathLike)):
+        raise ValueError("runtime_env['working_dir'] must be a path")
+    pm = renv.get("py_modules")
+    if pm is not None and (not isinstance(pm, (list, tuple)) or not all(
+            isinstance(p, (str, os.PathLike)) for p in pm)):
+        raise ValueError("runtime_env['py_modules'] must be a list of paths")
     return renv
 
 
@@ -48,9 +60,11 @@ def applied(renv: Optional[Dict[str, Any]]):
     saved_env: Dict[str, Optional[str]] = {}
     saved_cwd = None
     added_paths = []
+    set_env: Dict[str, str] = {}
     try:
         for k, v in (renv.get("env_vars") or {}).items():
             saved_env[str(k)] = os.environ.get(str(k))
+            set_env[str(k)] = str(v)
             os.environ[str(k)] = str(v)
         wd = renv.get("working_dir")
         if wd:
@@ -64,12 +78,23 @@ def applied(renv: Optional[Dict[str, Any]]):
         yield
     finally:
         for k, old in saved_env.items():
+            # CAS-style restore: only undo values this context set and
+            # that nobody overwrote since — overlapping contexts ending
+            # out of order must not reinstate each other's values.
+            if os.environ.get(k) != set_env.get(k):
+                continue
             if old is None:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = old
         if saved_cwd is not None:
-            os.chdir(saved_cwd)
+            try:
+                still_ours = os.path.samefile(
+                    os.getcwd(), renv.get("working_dir"))
+            except OSError:
+                still_ours = False
+            if still_ours:
+                os.chdir(saved_cwd)
         for p in added_paths:
             with contextlib.suppress(ValueError):
                 sys.path.remove(p)

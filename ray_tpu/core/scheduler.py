@@ -96,6 +96,16 @@ class Scheduler:
         with self._lock:
             return [t.resources for t in self._queue + self._infeasible]
 
+    def pending_demand_detailed(self) -> List[tuple]:
+        """[(ResourceSet, placement_constrained)] — constrained demand
+        (hard affinity / PG bundles) can't be absorbed by arbitrary free
+        capacity, so the autoscaler must not net it out."""
+        with self._lock:
+            out = []
+            for t in self._queue + self._infeasible:
+                out.append((t.resources, t.scheduling_strategy is not None))
+            return out
+
     # -- scheduling -------------------------------------------------------
     def submit(self, spec: TaskSpec) -> None:
         with self._lock:

@@ -58,6 +58,9 @@ def validate_options(opts: Dict[str, Any], *, is_actor: bool) -> Dict[str, Any]:
     resources = opts.get("resources")
     if resources is not None and not isinstance(resources, dict):
         raise ValueError("resources must be a dict")
+    if "runtime_env" in opts:
+        from .runtime_env import validate as _validate_renv
+        _validate_renv(opts["runtime_env"])
     return opts
 
 

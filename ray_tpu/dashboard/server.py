@@ -22,6 +22,7 @@ Endpoints:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import threading
 from typing import Any, Optional
@@ -148,6 +149,7 @@ class DashboardServer:
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             self._loop = loop
+            runner = None
             try:
                 app = self._build_app()
                 runner = web.AppRunner(app)
@@ -156,6 +158,12 @@ class DashboardServer:
                 loop.run_until_complete(site.start())
             except BaseException as e:  # noqa: BLE001 — surface to caller
                 self._start_error = e
+                if runner is not None:
+                    with contextlib.suppress(BaseException):
+                        # runner.setup() may have succeeded before
+                        # site.start() failed — release its resources.
+                        loop.run_until_complete(runner.cleanup())
+                self._loop = None
                 self._started.set()
                 loop.close()
                 return

@@ -1,0 +1,7 @@
+"""ray_tpu.util — utilities built on the task/actor/object core
+(reference: python/ray/util/)."""
+
+from .actor_pool import ActorPool
+from .queue import Queue
+
+__all__ = ["ActorPool", "Queue", "collective", "metrics"]

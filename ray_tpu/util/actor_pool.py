@@ -1,0 +1,103 @@
+"""ActorPool — round-robin work distribution over a fixed set of actors.
+
+Capability-equivalent to the reference's ``ray.util.ActorPool``
+(reference: python/ray/util/actor_pool.py — map/map_unordered/submit/
+get_next/get_next_unordered/has_next/push/pop_idle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        import ray_tpu  # late: avoid import cycle
+
+        self._ray = ray_tpu
+        self._idle: List[Any] = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict = {}
+        self._next_task_index = 0
+        self._pending_submits: List[tuple] = []
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queued if no actor is idle."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = actor
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in submission order (smallest outstanding index)."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        self._drain_submits()
+        idx = min(self._index_to_future)
+        future = self._index_to_future[idx]
+        if timeout is not None:
+            # Probe first so a timeout leaves the pool state intact.
+            ready, _ = self._ray.wait(
+                [future], num_returns=1, timeout=timeout)
+            if not ready:
+                raise TimeoutError("get_next timed out")
+        del self._index_to_future[idx]
+        value = self._ray.get(future)
+        self._return_actor(future)
+        return value
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Next result in completion order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        self._drain_submits()
+        ready, _ = self._ray.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        future = ready[0]
+        for idx, fut in list(self._index_to_future.items()):
+            if fut == future:
+                del self._index_to_future[idx]
+                break
+        value = self._ray.get(future)
+        self._return_actor(future)
+        return value
+
+    def _return_actor(self, future) -> None:
+        actor = self._future_to_actor.pop(future)
+        self._idle.append(actor)
+        self._drain_submits()
+
+    def _drain_submits(self) -> None:
+        while self._pending_submits and self._idle:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def push(self, actor: Any) -> None:
+        self._idle.append(actor)
+        self._drain_submits()
+
+    def pop_idle(self) -> Optional[Any]:
+        return self._idle.pop() if self._idle else None

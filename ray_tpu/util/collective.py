@@ -1,0 +1,357 @@
+"""Out-of-band collectives between actors/tasks.
+
+Capability-equivalent to the reference's ``ray.util.collective``
+(reference: python/ray/util/collective/collective.py :120-615 —
+init_collective_group / create_collective_group / destroy_collective_group,
+allreduce / allgather / reducescatter / broadcast / reduce / barrier /
+send / recv), re-designed TPU-first:
+
+- **In-program collectives are XLA's.** Gradient/tensor collectives inside
+  a training step ride ICI via ``psum``/``all_gather``/``ppermute`` under
+  ``shard_map``/pjit (``ray_tpu.parallel``) — there is no NCCL and no
+  cupy here, and nothing to initialise (reference's NCCLGroup,
+  nccl_collective_group.py:127, has no TPU analog: the compiler inserts
+  the collectives).
+- **This module is the host-side control plane**: coordination between
+  independently-jitted programs in different actors — metric averaging,
+  parameter broadcast at init, rendezvous barriers, cross-job exchange.
+  Arrays move through the shared-memory object plane (host RAM), which is
+  the TPU-native equivalent of the reference's gloo/CPU backend
+  (gloo_collective_group.py — rendezvous via internal KV :66).
+
+Implementation: a named coordinator actor per group (the rendezvous
+authority, like the reference's named-store rendezvous) gathers one
+contribution per rank per round, applies the reduction once, and unblocks
+every member. Contributions are numpy arrays (jax arrays are accepted and
+converted; callers ``jax.device_put`` results as needed).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda arrs: np.sum(arrs, axis=0),
+    ReduceOp.PRODUCT: lambda arrs: np.prod(arrs, axis=0),
+    ReduceOp.MIN: lambda arrs: np.min(arrs, axis=0),
+    ReduceOp.MAX: lambda arrs: np.max(arrs, axis=0),
+    ReduceOp.MEAN: lambda arrs: np.mean(arrs, axis=0),
+}
+
+_COORD_PREFIX = "_rtc_coord:"
+_MAX_WORLD = 1024
+
+
+class _Coordinator:
+    """Rendezvous + reduction authority for one collective group.
+
+    Runs with max_concurrency == world_size so every rank's blocking
+    collect call can park on an Event simultaneously.
+    """
+
+    def __init__(self, world_size: int):
+        self.world = world_size
+        self._lock = threading.Lock()
+        # round key -> {"vals": {rank: payload}, "done": Event, "out": ...}
+        self._rounds: Dict[Tuple[str, int], dict] = {}
+        # point-to-point mailboxes: (src, dst, tag) -> [payload, Event]
+        self._p2p: Dict[Tuple[int, int, int], dict] = {}
+
+    def world_size(self) -> int:
+        return self.world
+
+    def _round(self, kind: str, seq: int) -> dict:
+        key = (kind, seq)
+        st = self._rounds.get(key)
+        if st is None:
+            st = {"vals": {}, "done": threading.Event(), "out": None}
+            self._rounds[key] = st
+        return st
+
+    def collect(self, kind: str, seq: int, rank: int, payload,
+                op: str, timeout: float):
+        """One rank's contribution to round (kind, seq); blocks until all
+        world_size ranks have contributed, then returns the round result."""
+        with self._lock:
+            st = self._round(kind, seq)
+            if rank in st["vals"]:
+                raise RuntimeError(
+                    f"rank {rank} contributed twice to {kind}#{seq}")
+            st["vals"][rank] = payload
+            ready = len(st["vals"]) == self.world
+            if ready:
+                st["out"] = self._finish(kind, st["vals"], op)
+                st["done"].set()
+        if not st["done"].wait(timeout):
+            raise TimeoutError(
+                f"collective {kind}#{seq}: only {len(st['vals'])}/"
+                f"{self.world} ranks arrived within {timeout}s")
+        out = st["out"]
+        with self._lock:
+            # Last rank out tears the round down.
+            key = (kind, seq)
+            if key in self._rounds:
+                st["readers"] = st.get("readers", 0) + 1
+                if st["readers"] >= self.world:
+                    del self._rounds[key]
+        return out
+
+    @staticmethod
+    def _finish(kind: str, vals: Dict[int, Any], op: str):
+        ordered = [vals[r] for r in sorted(vals)]
+        if kind == "allreduce" or kind == "reduce":
+            return _REDUCERS[op](np.stack(ordered))
+        if kind == "allgather":
+            return ordered
+        if kind == "reducescatter":
+            red = _REDUCERS[op](np.stack(ordered))
+            return np.array_split(red, len(ordered), axis=0)
+        if kind == "broadcast":
+            src = [v for v in ordered if v is not None]
+            if len(src) != 1:
+                raise RuntimeError("broadcast needs exactly one src payload")
+            return src[0]
+        if kind == "barrier":
+            return None
+        raise ValueError(f"unknown collective kind {kind!r}")
+
+    def send(self, src: int, dst: int, tag: int, payload, timeout: float):
+        with self._lock:
+            key = (src, dst, tag)
+            st = self._p2p.get(key)
+            if st is None:
+                st = {"done": threading.Event(), "val": None,
+                      "taken": threading.Event()}
+                self._p2p[key] = st
+            st["val"] = payload
+            st["done"].set()
+        if not st["taken"].wait(timeout):
+            # Withdraw the undelivered payload: a later recv must not see
+            # a message whose sender was told it failed.
+            with self._lock:
+                self._p2p.pop((src, dst, tag), None)
+            raise TimeoutError(f"send {src}->{dst} tag {tag}: no receiver")
+
+    def recv(self, src: int, dst: int, tag: int, timeout: float):
+        with self._lock:
+            key = (src, dst, tag)
+            st = self._p2p.get(key)
+            if st is None:
+                st = {"done": threading.Event(), "val": None,
+                      "taken": threading.Event()}
+                self._p2p[key] = st
+        if not st["done"].wait(timeout):
+            raise TimeoutError(f"recv {dst}<-{src} tag {tag}: no sender")
+        val = st["val"]
+        with self._lock:
+            st["taken"].set()
+            self._p2p.pop((src, dst, tag), None)
+        return val
+
+
+class _GroupHandle:
+    """Per-process (per-member) view of a group: rank + op sequencing."""
+
+    def __init__(self, name: str, world_size: int, rank: int, coord):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.coord = coord
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def next_seq(self) -> int:
+        with self._lock:
+            s = self._seq
+            self._seq += 1
+            return s
+
+
+_REGISTRY: Dict[Any, Dict[str, _GroupHandle]] = {}
+_REG_LOCK = threading.Lock()
+
+
+def _registry_key():
+    """Group membership is per-actor (shared across an actor's
+    max_concurrency threads) or per-thread for driver/task code."""
+    import ray_tpu
+
+    aid = ray_tpu.get_runtime_context().get_actor_id()
+    if aid:
+        return ("actor", aid)
+    return ("thread", threading.get_ident())
+
+
+def _groups() -> Dict[str, _GroupHandle]:
+    with _REG_LOCK:
+        return _REGISTRY.setdefault(_registry_key(), {})
+
+
+def _as_np(tensor) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "shm",
+                          group_name: str = "default") -> None:
+    """Join a collective group; call once from each member
+    (reference: collective.py init_collective_group :120)."""
+    import ray_tpu
+
+    if backend not in ("shm", "cpu", "host"):
+        raise ValueError(
+            f"backend {backend!r} unsupported: TPU in-program collectives "
+            "are XLA's (ray_tpu.parallel); out-of-band groups use the "
+            "shared-memory host backend ('shm')")
+    if not 0 <= rank < world_size <= _MAX_WORLD:
+        raise ValueError(f"bad rank/world: {rank}/{world_size}")
+    if group_name in _groups():
+        raise RuntimeError(f"group {group_name!r} already initialized here")
+
+    coord_cls = ray_tpu.remote(_Coordinator)
+    coord = coord_cls.options(
+        name=_COORD_PREFIX + group_name, get_if_exists=True,
+        max_concurrency=world_size + 2,
+        lifetime="detached").remote(world_size)
+    have = ray_tpu.get(coord.world_size.remote())
+    if have != world_size:
+        raise RuntimeError(
+            f"group {group_name!r} exists with world_size={have}, "
+            f"asked for {world_size}")
+    _groups()[group_name] = _GroupHandle(group_name, world_size, rank, coord)
+
+
+def create_collective_group(actors: List[Any], world_size: int,
+                            ranks: List[int],
+                            backend: str = "shm",
+                            group_name: str = "default") -> None:
+    """Driver-side declaration: make every member actor join
+    (reference: collective.py create_collective_group :182 — there the
+    metadata goes to the internal KV; here we push the init into each
+    actor via a remote call to this module)."""
+    import ray_tpu
+
+    if len(actors) != len(ranks) or len(actors) != world_size:
+        raise ValueError("need exactly world_size actors + ranks")
+    refs = [a.collective_init.remote(world_size, r, backend, group_name)
+            for a, r in zip(actors, ranks)]
+    ray_tpu.get(refs)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups()
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _get(group_name).world_size
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Leave + tear down the local view (the coordinator dies with the
+    runtime; reference: collective.py destroy_collective_group :217)."""
+    _groups().pop(group_name, None)
+
+
+def _get(group_name: str) -> _GroupHandle:
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this "
+            "process; call init_collective_group first")
+    return g
+
+
+def _run(g: _GroupHandle, kind: str, payload, op: str, timeout: float):
+    import ray_tpu
+
+    seq = g.next_seq()
+    return ray_tpu.get(
+        g.coord.collect.remote(kind, seq, g.rank, payload, op, timeout),
+        timeout=timeout + 5.0)
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM, timeout: float = 60.0) -> np.ndarray:
+    g = _get(group_name)
+    return _run(g, "allreduce", _as_np(tensor), op, timeout)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = ReduceOp.SUM, timeout: float = 60.0):
+    g = _get(group_name)
+    out = _run(g, "reduce", _as_np(tensor), op, timeout)
+    return out if g.rank == dst_rank else None
+
+
+def allgather(tensor, group_name: str = "default",
+              timeout: float = 60.0) -> List[np.ndarray]:
+    g = _get(group_name)
+    return _run(g, "allgather", _as_np(tensor), ReduceOp.SUM, timeout)
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM,
+                  timeout: float = 60.0) -> np.ndarray:
+    """Each rank gets the rank-th shard (axis 0) of the reduction."""
+    g = _get(group_name)
+    shards = _run(g, "reducescatter", _as_np(tensor), op, timeout)
+    return shards[g.rank]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              timeout: float = 60.0) -> np.ndarray:
+    g = _get(group_name)
+    payload = _as_np(tensor) if g.rank == src_rank else None
+    return _run(g, "broadcast", payload, ReduceOp.SUM, timeout)
+
+
+def barrier(group_name: str = "default", timeout: float = 60.0) -> None:
+    _run(_get(group_name), "barrier", None, ReduceOp.SUM, timeout)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         tag: int = 0, timeout: float = 60.0) -> None:
+    import ray_tpu
+
+    g = _get(group_name)
+    if dst_rank == g.rank:
+        raise ValueError("cannot send to self")
+    ray_tpu.get(g.coord.send.remote(
+        g.rank, dst_rank, tag, _as_np(tensor), timeout),
+        timeout=timeout + 5.0)
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0,
+         timeout: float = 60.0) -> np.ndarray:
+    import ray_tpu
+
+    g = _get(group_name)
+    if src_rank == g.rank:
+        raise ValueError("cannot recv from self")
+    return ray_tpu.get(g.coord.recv.remote(
+        src_rank, g.rank, tag, timeout), timeout=timeout + 5.0)
+
+
+class CollectiveActorMixin:
+    """Mix into an actor class to make it addressable by
+    create_collective_group (adds the collective_init entry point)."""
+
+    def collective_init(self, world_size: int, rank: int, backend: str,
+                         group_name: str) -> None:
+        init_collective_group(world_size, rank, backend, group_name)

@@ -1,0 +1,128 @@
+"""Distributed FIFO queue backed by a named actor.
+
+Capability-equivalent to the reference's ``ray.util.queue.Queue``
+(reference: python/ray/util/queue.py — put/get/put_nowait/get_nowait/
+qsize/empty/full over an _QueueActor), usable from any actor/task.
+"""
+
+from __future__ import annotations
+
+import queue as _pyqueue
+from typing import Any, List, Optional
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._q = _pyqueue.Queue(maxsize=maxsize)
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def full(self) -> bool:
+        return self._q.full()
+
+    def put(self, item: Any, block: bool, timeout: Optional[float]) -> bool:
+        # Bounded wait only: blocking forever would pin an actor thread
+        # (clients implement indefinite blocking as a poll loop).
+        try:
+            if block and (timeout is None or timeout > 0.2):
+                timeout = 0.2
+            self._q.put(item, block=block, timeout=timeout if block else None)
+            return True
+        except _pyqueue.Full:
+            return False
+
+    def get(self, block: bool, timeout: Optional[float]):
+        try:
+            if block and (timeout is None or timeout > 0.2):
+                timeout = 0.2
+            return True, self._q.get(
+                block=block, timeout=timeout if block else None)
+        except _pyqueue.Empty:
+            return False, None
+
+    def put_batch(self, items: List[Any]) -> bool:
+        """All-or-nothing nowait batch; False if it doesn't fit."""
+        if self._q.maxsize > 0 and \
+                self._q.qsize() + len(items) > self._q.maxsize:
+            return False
+        for it in items:
+            self._q.put_nowait(it)
+        return True
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: dict = None):
+        import ray_tpu
+
+        opts = dict(actor_options or {})
+        # Blocking put/get park inside the actor: give the mailbox
+        # enough threads that a blocked get can't wedge a put.
+        opts.setdefault("max_concurrency", 8)
+        self._actor = ray_tpu.remote(_QueueActor).options(**opts).remote(
+            maxsize)
+        self.maxsize = maxsize
+
+    def qsize(self) -> int:
+        import ray_tpu
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        import ray_tpu
+        return ray_tpu.get(self._actor.empty.remote())
+
+    def full(self) -> bool:
+        import ray_tpu
+        return ray_tpu.get(self._actor.full.remote())
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        import time as _time
+        import ray_tpu
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            ok = ray_tpu.get(self._actor.put.remote(item, block, timeout))
+            if ok:
+                return
+            if not block or (deadline is not None
+                             and _time.monotonic() >= deadline):
+                raise Full()
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        import ray_tpu
+        if not ray_tpu.get(self._actor.put_batch.remote(list(items))):
+            raise Full()
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        import time as _time
+        import ray_tpu
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self._actor.get.remote(block, timeout))
+            if ok:
+                return item
+            if not block or (deadline is not None
+                             and _time.monotonic() >= deadline):
+                raise Empty()
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+        ray_tpu.kill(self._actor)

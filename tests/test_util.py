@@ -1,0 +1,181 @@
+"""ray_tpu.util: collectives, ActorPool, Queue.
+
+Mirrors the reference's test approach for ray.util.collective
+(reference: python/ray/util/collective/tests/) with the shm host
+backend — each member is an actor, ops checked against numpy.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Queue
+from ray_tpu.util import collective as col
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Member(col.CollectiveActorMixin):
+    def __init__(self, rank: int, world: int, group: str):
+        self.rank = rank
+        col.init_collective_group(world, rank, group_name=group)
+
+    def do_allreduce(self, x):
+        return col.allreduce(np.asarray(x), group_name=self._g())
+
+    def _g(self):
+        return "g" + str(getattr(self, "_gid", ""))
+
+    def set_gid(self, gid):
+        self._gid = gid
+
+    def run(self, op, *args, **kw):
+        return getattr(col, op)(*args, group_name=kw.pop("group"), **kw)
+
+
+class TestCollective:
+    def test_allreduce_sum(self, rt):
+        world = 4
+        members = [Member.options(max_concurrency=2).remote(r, world, "ar")
+                   for r in range(world)]
+        refs = [m.run.remote("allreduce", np.full((3,), float(r + 1)),
+                             group="ar")
+                for r, m in enumerate(members)]
+        outs = ray_tpu.get(refs)
+        for o in outs:
+            np.testing.assert_allclose(o, np.full((3,), 10.0))
+
+    def test_broadcast_and_allgather(self, rt):
+        world = 3
+        members = [Member.options(max_concurrency=2).remote(r, world, "bg")
+                   for r in range(world)]
+        # broadcast from rank 0
+        refs = []
+        for r, m in enumerate(members):
+            refs.append(m.run.remote(
+                "broadcast", np.arange(4.0) if r == 0 else np.zeros(4),
+                group="bg", src_rank=0))
+        for o in ray_tpu.get(refs):
+            np.testing.assert_allclose(o, np.arange(4.0))
+        # allgather
+        refs = [m.run.remote("allgather", np.full((2,), float(r)),
+                             group="bg")
+                for r, m in enumerate(members)]
+        for o in ray_tpu.get(refs):
+            assert len(o) == world
+            np.testing.assert_allclose(o[2], np.full((2,), 2.0))
+
+    def test_reducescatter(self, rt):
+        world = 2
+        members = [Member.options(max_concurrency=2).remote(r, world, "rs")
+                   for r in range(world)]
+        x = np.arange(8.0)
+        refs = [m.run.remote("reducescatter", x, group="rs")
+                for m in members]
+        outs = ray_tpu.get(refs)
+        np.testing.assert_allclose(outs[0], np.arange(4.0) * 2)
+        np.testing.assert_allclose(outs[1], np.arange(4.0, 8.0) * 2)
+
+    def test_sendrecv_and_barrier(self, rt):
+        world = 2
+        members = [Member.options(max_concurrency=2).remote(r, world, "sr")
+                   for r in range(world)]
+        r_send = members[0].run.remote(
+            "send", np.full((2, 2), 7.0), 1, group="sr")
+        r_recv = members[1].run.remote("recv", 0, group="sr")
+        ray_tpu.get(r_send)
+        np.testing.assert_allclose(
+            ray_tpu.get(r_recv), np.full((2, 2), 7.0))
+        ray_tpu.get([m.run.remote("barrier", group="sr") for m in members])
+
+    def test_create_collective_group(self, rt):
+        world = 2
+        members = [Member.options(max_concurrency=2).remote(r, world, "pre")
+                   for r in range(world)]
+        col.create_collective_group(
+            members, world, list(range(world)), group_name="declared")
+        refs = [m.run.remote("allreduce", np.ones(2), group="declared")
+                for m in members]
+        for o in ray_tpu.get(refs):
+            np.testing.assert_allclose(o, np.full((2,), 2.0))
+
+
+class TestActorPool:
+    def test_map_ordered(self, rt):
+        @ray_tpu.remote
+        class W:
+            def double(self, x):
+                return 2 * x
+
+        pool = ActorPool([W.remote() for _ in range(3)])
+        out = list(pool.map(lambda a, v: a.double.remote(v), range(10)))
+        assert out == [2 * i for i in range(10)]
+
+    def test_map_unordered_and_reuse(self, rt):
+        @ray_tpu.remote
+        class W:
+            def sq(self, x):
+                return x * x
+
+        pool = ActorPool([W.remote() for _ in range(2)])
+        out = sorted(pool.map_unordered(
+            lambda a, v: a.sq.remote(v), range(8)))
+        assert out == sorted(i * i for i in range(8))
+        # pool reusable after map
+        pool.submit(lambda a, v: a.sq.remote(v), 5)
+        assert pool.get_next() == 25
+
+    def test_push_pop_idle(self, rt):
+        @ray_tpu.remote
+        class W:
+            def f(self, x):
+                return x
+
+        pool = ActorPool([W.remote()])
+        a = pool.pop_idle()
+        assert a is not None
+        assert pool.pop_idle() is None
+        pool.push(a)
+        assert list(pool.map(lambda a, v: a.f.remote(v), [1])) == [1]
+
+
+class TestQueue:
+    def test_fifo(self, rt):
+        q = Queue()
+        for i in range(5):
+            q.put(i)
+        assert q.qsize() == 5
+        assert [q.get() for _ in range(5)] == list(range(5))
+        assert q.empty()
+
+    def test_maxsize_and_nowait(self, rt):
+        from ray_tpu.util.queue import Empty, Full
+
+        q = Queue(maxsize=2)
+        q.put_nowait(1)
+        q.put_nowait(2)
+        with pytest.raises(Full):
+            q.put_nowait(3)
+        assert q.get_nowait() == 1
+        q.shutdown()
+
+    def test_cross_actor(self, rt):
+        q = Queue()
+
+        @ray_tpu.remote
+        class Producer:
+            def produce(self, q, n):
+                for i in range(n):
+                    q.put(i)
+                return n
+
+        p = Producer.remote()
+        assert ray_tpu.get(p.produce.remote(q, 4)) == 4
+        assert [q.get() for _ in range(4)] == [0, 1, 2, 3]
