@@ -33,6 +33,19 @@ from .task import (
 )
 
 
+def _is_constrained(strategy) -> bool:
+    """True only for strategies that free capacity on an arbitrary node
+    cannot absorb: hard node/slice affinity and PG bundles. Spread and
+    soft affinity schedule anywhere, so they must be netted against free
+    capacity like default tasks or the autoscaler over-scales."""
+    if strategy is None or isinstance(strategy, SpreadSchedulingStrategy):
+        return False
+    if isinstance(strategy, (NodeAffinitySchedulingStrategy,
+                             SliceAffinitySchedulingStrategy)):
+        return not strategy.soft
+    return True
+
+
 class NodeState:
     """One schedulable node: a resource view plus an executor."""
 
@@ -103,7 +116,8 @@ class Scheduler:
         with self._lock:
             out = []
             for t in self._queue + self._infeasible:
-                out.append((t.resources, t.scheduling_strategy is not None))
+                out.append((t.resources, _is_constrained(
+                    t.scheduling_strategy)))
             return out
 
     # -- scheduling -------------------------------------------------------

@@ -1,5 +1,7 @@
+from . import aggregate
 from .block import Block, BlockAccessor
 from .dataset import Dataset
+from .grouped_data import GroupedData
 from .iterator import DataIterator
 from .read_api import (
     from_arrow,
@@ -14,13 +16,24 @@ from .read_api import (
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
+    read_tfrecords,
+    read_webdataset,
+    write_csv,
+    write_json,
+    write_numpy,
     write_parquet,
+    write_tfrecords,
 )
 
 __all__ = [
-    "Dataset", "DataIterator", "Block", "BlockAccessor",
+    "Dataset", "DataIterator", "Block", "BlockAccessor", "GroupedData",
+    "aggregate",
     "from_items", "from_pandas", "from_numpy", "from_arrow", "range",
     "range_tensor", "read_parquet", "read_csv", "read_json", "read_text",
-    "read_binary_files", "read_numpy", "read_images", "write_parquet",
+    "read_binary_files", "read_numpy", "read_images", "read_tfrecords",
+    "read_webdataset", "read_sql",
+    "write_parquet", "write_csv", "write_json", "write_numpy",
+    "write_tfrecords",
 ]

@@ -1,0 +1,210 @@
+"""Aggregation functions for Dataset / GroupedData.
+
+Capability-equivalent to the reference's aggregate vocabulary
+(reference: python/ray/data/aggregate.py — AggregateFn, Count, Sum, Min,
+Max, Mean, Std, AbsMax, Quantile, Unique): each aggregate is defined by
+(init, per-block accumulate, cross-block merge, finalize) so blocks can
+be reduced in parallel remote tasks and combined on the driver.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from .block import BlockAccessor
+
+
+class AggregateFn:
+    """(init, accumulate_block, merge, finalize) over a column."""
+
+    def __init__(self, *, init: Callable[[], Any],
+                 accumulate_block: Callable[[Any, Any], Any],
+                 merge: Callable[[Any, Any], Any],
+                 finalize: Callable[[Any], Any] = lambda a: a,
+                 name: str = "agg"):
+        self.init = init
+        self.accumulate_block = accumulate_block
+        self.merge = merge
+        self.finalize = finalize
+        self.name = name
+
+    # pyarrow group_by kernel this aggregate maps to, if any (used by the
+    # grouped fast path); None → generic accumulate path.
+    arrow_kernel: Optional[str] = None
+    arrow_options: Optional[Any] = None
+    on: Optional[str] = None
+
+
+def _col(block, on: str) -> np.ndarray:
+    acc = BlockAccessor.for_block(block)
+    batch = acc.to_batch("numpy")
+    if on not in batch:
+        raise KeyError(f"no column {on!r}; have {list(batch)}")
+    return np.asarray(batch[on])
+
+
+class Count(AggregateFn):
+    arrow_kernel = "count"
+
+    def __init__(self):
+        super().__init__(
+            init=lambda: 0,
+            accumulate_block=lambda a, b: a + BlockAccessor.for_block(
+                b).num_rows(),
+            merge=lambda a, b: a + b,
+            name="count()")
+
+
+class Sum(AggregateFn):
+    arrow_kernel = "sum"
+
+    def __init__(self, on: str):
+        self.on = on
+        super().__init__(
+            init=lambda: 0,
+            accumulate_block=lambda a, b: a + _col(b, on).sum(),
+            merge=lambda a, b: a + b,
+            name=f"sum({on})")
+
+
+class Min(AggregateFn):
+    arrow_kernel = "min"
+
+    def __init__(self, on: str):
+        self.on = on
+        super().__init__(
+            init=lambda: None,
+            accumulate_block=lambda a, b: _opt_reduce(min, a, _col(b, on)),
+            merge=lambda a, b: _opt(min, a, b),
+            name=f"min({on})")
+
+
+class Max(AggregateFn):
+    arrow_kernel = "max"
+
+    def __init__(self, on: str):
+        self.on = on
+        super().__init__(
+            init=lambda: None,
+            accumulate_block=lambda a, b: _opt_reduce(max, a, _col(b, on)),
+            merge=lambda a, b: _opt(max, a, b),
+            name=f"max({on})")
+
+
+class AbsMax(AggregateFn):
+    def __init__(self, on: str):
+        self.on = on
+        super().__init__(
+            init=lambda: None,
+            accumulate_block=lambda a, b: _opt_reduce(
+                max, a, np.abs(_col(b, on))),
+            merge=lambda a, b: _opt(max, a, b),
+            name=f"abs_max({on})")
+
+
+class Mean(AggregateFn):
+    arrow_kernel = "mean"
+
+    def __init__(self, on: str):
+        self.on = on
+        super().__init__(
+            init=lambda: (0.0, 0),
+            accumulate_block=lambda a, b: (
+                a[0] + float(_col(b, on).sum()), a[1] + len(_col(b, on))),
+            merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+            finalize=lambda a: a[0] / a[1] if a[1] else float("nan"),
+            name=f"mean({on})")
+
+
+class Std(AggregateFn):
+    """Sample std via parallel Welford / Chan merge."""
+
+    arrow_kernel = "stddev"
+
+    def __init__(self, on: str, ddof: int = 1):
+        import pyarrow.compute as pc
+
+        self.on = on
+        self.ddof = ddof
+        self.arrow_options = pc.VarianceOptions(ddof=ddof)
+
+        def acc(a, block):
+            x = _col(block, on).astype(np.float64)
+            n, mean, m2 = len(x), float(x.mean()) if len(x) else 0.0, \
+                float(((x - x.mean()) ** 2).sum()) if len(x) else 0.0
+            return _chan(a, (n, mean, m2))
+
+        super().__init__(
+            init=lambda: (0, 0.0, 0.0),
+            accumulate_block=acc,
+            merge=_chan,
+            finalize=lambda a: (
+                math.sqrt(a[2] / (a[0] - ddof)) if a[0] > ddof
+                else float("nan")),
+            name=f"std({on})")
+
+
+class Unique(AggregateFn):
+    def __init__(self, on: str):
+        self.on = on
+        super().__init__(
+            init=lambda: set(),
+            accumulate_block=lambda a, b: a | set(
+                np.asarray(_col(b, on)).tolist()),
+            merge=lambda a, b: a | b,
+            finalize=lambda a: sorted(a),
+            name=f"unique({on})")
+
+
+class Quantile(AggregateFn):
+    """Exact quantile (collects the column; fine for block-scale data)."""
+
+    def __init__(self, on: str, q: float = 0.5):
+        self.on = on
+        self.q = q
+        super().__init__(
+            init=lambda: [],
+            accumulate_block=lambda a, b: a + _col(b, on).tolist(),
+            merge=lambda a, b: a + b,
+            finalize=lambda a: (
+                float(np.quantile(np.asarray(a), q)) if a else float("nan")),
+            name=f"quantile({on})")
+
+
+def _opt(op, a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return op(a, b)
+
+
+def _opt_reduce(op, acc, col: np.ndarray):
+    """Fold a column into acc, skipping empty blocks (min/max of a
+    zero-size array has no identity)."""
+    if len(col) == 0:
+        return acc
+    return _opt(op, acc, col.min() if op is min else col.max())
+
+
+def _chan(a, b):
+    """Chan et al. parallel variance merge of (n, mean, M2) triples."""
+    na, ma, m2a = a
+    nb, mb, m2b = b
+    n = na + nb
+    if n == 0:
+        return (0, 0.0, 0.0)
+    delta = mb - ma
+    mean = ma + delta * nb / n
+    m2 = m2a + m2b + delta * delta * na * nb / n
+    return (n, mean, m2)
+
+
+def reduce_blocks(agg: AggregateFn, blocks) -> Any:
+    acc = agg.init()
+    for b in blocks:
+        acc = agg.accumulate_block(acc, b)
+    return acc

@@ -94,6 +94,91 @@ class Dataset:
         return Dataset(Union(self._op, [o._op for o in others]))
 
     # ------------------------------------------------------------------
+    # GroupBy / aggregation (reference: dataset.py groupby :2213,
+    # aggregate/sum/min/max/mean/std :2281-2554, unique)
+    # ------------------------------------------------------------------
+    def groupby(self, key: str, *, num_partitions: Optional[int] = None):
+        from .grouped_data import DEFAULT_NUM_PARTITIONS, GroupedData
+
+        return GroupedData(self, key,
+                           num_partitions or DEFAULT_NUM_PARTITIONS)
+
+    def aggregate(self, *aggs) -> Dict[str, Any]:
+        """Whole-dataset aggregation: per-block parallel accumulate +
+        driver-side merge (reference: Dataset.aggregate)."""
+        from .. import get as ray_get, remote
+
+        @remote
+        def _acc_block(block, aggs_):
+            return [a.accumulate_block(a.init(), block) for a in aggs_]
+
+        aggs_l = list(aggs)
+        refs = [_acc_block.remote(r, aggs_l) for r in self._refs()]
+        states = [a.init() for a in aggs_l]
+        for partials in ray_get(refs):
+            states = [a.merge(s, p)
+                      for a, s, p in zip(aggs_l, states, partials)]
+        return {a.name: a.finalize(s) for a, s in zip(aggs_l, states)}
+
+    def sum(self, on: str):
+        from .aggregate import Sum
+
+        return self.aggregate(Sum(on))[f"sum({on})"]
+
+    def min(self, on: str):
+        from .aggregate import Min
+
+        return self.aggregate(Min(on))[f"min({on})"]
+
+    def max(self, on: str):
+        from .aggregate import Max
+
+        return self.aggregate(Max(on))[f"max({on})"]
+
+    def mean(self, on: str):
+        from .aggregate import Mean
+
+        return self.aggregate(Mean(on))[f"mean({on})"]
+
+    def std(self, on: str, ddof: int = 1):
+        from .aggregate import Std
+
+        return self.aggregate(Std(on, ddof))[f"std({on})"]
+
+    def unique(self, on: str) -> List[Any]:
+        from .aggregate import Unique
+
+        return self.aggregate(Unique(on))[f"unique({on})"]
+
+    # ------------------------------------------------------------------
+    # Writes (reference: Dataset.write_parquet :2774 etc.)
+    # ------------------------------------------------------------------
+    def write_parquet(self, path: str) -> List[str]:
+        from .read_api import write_parquet
+
+        return write_parquet(self, path)
+
+    def write_csv(self, path: str) -> List[str]:
+        from .read_api import write_csv
+
+        return write_csv(self, path)
+
+    def write_json(self, path: str) -> List[str]:
+        from .read_api import write_json
+
+        return write_json(self, path)
+
+    def write_numpy(self, path: str, *, column: str) -> List[str]:
+        from .read_api import write_numpy
+
+        return write_numpy(self, path, column)
+
+    def write_tfrecords(self, path: str) -> List[str]:
+        from .read_api import write_tfrecords
+
+        return write_tfrecords(self, path)
+
+    # ------------------------------------------------------------------
     # Execution
     # ------------------------------------------------------------------
     def _refs(self) -> Iterator:

@@ -1,0 +1,185 @@
+"""GroupedData — distributed group-by aggregation.
+
+Capability-equivalent to the reference's grouped data
+(reference: python/ray/data/grouped_data.py — GroupedData.aggregate,
+count/sum/min/max/mean/std, map_groups): blocks are hash-partitioned by
+key in parallel remote tasks (each key lands wholly in one partition),
+then each partition is aggregated with pyarrow group_by kernels (or a
+user fn for map_groups) in its own remote task — a one-round push-style
+shuffle rather than the reference's sort-based two-stage shuffle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import get as ray_get, put as ray_put, remote
+from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from .block import BlockAccessor, concat_blocks
+
+DEFAULT_NUM_PARTITIONS = 8
+
+
+@remote
+def _partition_block(block, key: str, n: int):
+    """Hash-partition one block by key → list of n piece refs (None for
+    empty pieces). Pieces go straight into the object plane so the
+    driver only ever handles refs, not data."""
+    from .. import put as ray_put_
+
+    acc = BlockAccessor.for_block(block)
+    t = acc.block
+    if t.num_rows == 0:
+        return [None] * n
+    col = t.column(key).to_numpy(zero_copy_only=False)
+    # Stable per-value hash (numpy value hash — not PYTHONHASHSEED'd).
+    if col.dtype.kind in "iufb":
+        idx = (col.astype(np.int64, copy=False) % n + n) % n
+    else:
+        import zlib
+
+        idx = np.array([zlib.crc32(str(v).encode()) % n for v in col],
+                       dtype=np.int64)
+    out = []
+    for i in range(n):
+        piece = t.take(np.nonzero(idx == i)[0])
+        out.append(ray_put_(piece) if piece.num_rows else None)
+    return out
+
+
+@remote
+def _agg_partition(pieces, key: Optional[str], aggs: List[AggregateFn]):
+    """Aggregate one partition (given its piece refs). Fast path: every
+    agg maps to a pyarrow group_by kernel; else generic accumulate."""
+    from .. import get as ray_get_
+
+    t = concat_blocks([ray_get_(p) for p in pieces])
+    if t.num_rows == 0:
+        return t.slice(0, 0)
+    if key is None:
+        raise ValueError("partition aggregation requires a key")
+    if all(a.arrow_kernel for a in aggs):
+        pairs, names = [], []
+        for a in aggs:
+            col = key if a.arrow_kernel == "count" else a.on
+            if a.arrow_options is not None:
+                pairs.append((col, a.arrow_kernel, a.arrow_options))
+            else:
+                pairs.append((col, a.arrow_kernel))
+            names.append(a.name)
+        out = t.group_by(key).aggregate(pairs)
+        # pyarrow names result columns "<col>_<kernel>"; column order has
+        # changed across versions — select by name, normalize to
+        # [key, agg1, agg2, ...].
+        import pyarrow as pa
+
+        cols = [out.column(key)] + [
+            out.column(f"{p[0]}_{p[1]}") for p in pairs]
+        return pa.table(cols, names=[key] + names)
+    # Generic path: split into per-key groups, run accumulate/finalize.
+    sorted_t = t.sort_by([(key, "ascending")])
+    keys = sorted_t.column(key).to_numpy(zero_copy_only=False)
+    bounds = np.nonzero(keys[1:] != keys[:-1])[0] + 1
+    starts = np.concatenate([[0], bounds])
+    ends = np.concatenate([bounds, [len(keys)]])
+    rows = []
+    for s, e in zip(starts, ends):
+        grp = sorted_t.slice(s, e - s)
+        row: Dict[str, Any] = {key: keys[s]}
+        for a in aggs:
+            acc = a.accumulate_block(a.init(), grp)
+            row[a.name] = a.finalize(acc)
+        rows.append(row)
+    return BlockAccessor.for_block(rows).block
+
+
+@remote
+def _map_groups_partition(pieces, key: str, fn, batch_format: str):
+    from .. import get as ray_get_
+
+    t = concat_blocks([ray_get_(p) for p in pieces])
+    if t.num_rows == 0:
+        return t.slice(0, 0)
+    sorted_t = t.sort_by([(key, "ascending")])
+    keys = sorted_t.column(key).to_numpy(zero_copy_only=False)
+    bounds = np.nonzero(keys[1:] != keys[:-1])[0] + 1
+    starts = np.concatenate([[0], bounds])
+    ends = np.concatenate([bounds, [len(keys)]])
+    outs = []
+    for s, e in zip(starts, ends):
+        grp = sorted_t.slice(s, e - s)
+        batch = BlockAccessor.for_block(grp).to_batch(batch_format)
+        outs.append(BlockAccessor.for_block(fn(batch)).block)
+    return concat_blocks(outs)
+
+
+class GroupedData:
+    def __init__(self, dataset, key: str,
+                 num_partitions: int = DEFAULT_NUM_PARTITIONS):
+        self._ds = dataset
+        self._key = key
+        self._n = num_partitions
+
+    def _partitions(self) -> List[List[Any]]:
+        """Hash-shuffle the dataset's blocks → n lists of piece refs.
+        Only refs transit the driver; piece data stays in the object
+        plane until the per-partition aggregation task pulls it."""
+        part_refs = [_partition_block.remote(ref, self._key, self._n)
+                     for ref in self._ds._refs()]
+        parts: List[List[Any]] = [[] for _ in range(self._n)]
+        for ref in part_refs:
+            for i, piece_ref in enumerate(ray_get(ref)):
+                if piece_ref is not None:
+                    parts[i].append(piece_ref)
+        return parts
+
+    def aggregate(self, *aggs: AggregateFn):
+        from .dataset import Dataset
+        from .plan import FromBlocks
+
+        refs = [_agg_partition.remote(part, self._key, list(aggs))
+                for part in self._partitions() if part]
+        blocks = [b for b in ray_get(refs) if b.num_rows]
+        merged = concat_blocks(blocks) if blocks else None
+        if merged is None:
+            import pyarrow as pa
+
+            merged = pa.table({})
+        merged = (merged.sort_by([(self._key, "ascending")])
+                  if merged.num_rows else merged)
+        out_ref = ray_put(merged)
+        d = Dataset(FromBlocks([out_ref], "aggregate"))
+        d._materialized = [out_ref]
+        return d
+
+    def map_groups(self, fn: Callable, *, batch_format: str = "numpy"):
+        from .dataset import Dataset
+        from .plan import FromBlocks
+
+        refs = [_map_groups_partition.remote(part, self._key, fn,
+                                             batch_format)
+                for part in self._partitions() if part]
+        d = Dataset(FromBlocks(refs, "map_groups"))
+        d._materialized = refs
+        return d
+
+    # -- sugar ----------------------------------------------------------
+    def count(self):
+        return self.aggregate(Count())
+
+    def sum(self, on: str):
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str):
+        return self.aggregate(Min(on))
+
+    def max(self, on: str):
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str):
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str, ddof: int = 1):
+        return self.aggregate(Std(on, ddof))

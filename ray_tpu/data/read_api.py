@@ -188,19 +188,167 @@ def read_images(paths, *, size: Optional[tuple] = None,
     return _reader_dataset(files, read_one, "read_images")
 
 
+def read_tfrecords(paths, *, parallelism: int = -1,
+                   verify_crc: bool = True) -> Dataset:
+    """TFRecord reader — pure-python wire format + tf.train.Example codec
+    (reference: data/datasource/tfrecords_datasource.py, sans tensorflow).
+    Set verify_crc=False to skip checksums on trusted large shards."""
+    from . import tfrecord
+
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        examples = [tfrecord.decode_example(p)
+                    for p in tfrecord.read_records(path, verify=verify_crc)]
+        return tfrecord.examples_to_batch(examples)
+
+    return _reader_dataset(files, read_one, "read_tfrecords")
+
+
+def read_webdataset(paths, *, parallelism: int = -1,
+                    decode: bool = True) -> Dataset:
+    """WebDataset tar reader: files sharing a basename form one sample,
+    keyed by extension (reference: data/datasource/webdataset_datasource.py).
+    """
+    files = _expand_paths(paths, ".tar")
+
+    def read_one(path):
+        import tarfile
+
+        rows: List[Dict[str, Any]] = []
+        cur: Dict[str, Any] = {}
+        cur_key = None
+        with tarfile.open(path) as tf:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                base, _, ext = os.path.basename(member.name).partition(".")
+                if base != cur_key:
+                    if cur:
+                        rows.append(cur)
+                    cur, cur_key = {"__key__": base}, base
+                data = tf.extractfile(member).read()
+                cur[ext] = _wds_decode(ext, data) if decode else data
+        if cur:
+            rows.append(cur)
+        return rows
+
+    return _reader_dataset(files, read_one, "read_webdataset")
+
+
+def _wds_decode(ext: str, data: bytes):
+    import json as _json
+
+    if ext in ("json",):
+        return _json.loads(data)
+    if ext in ("txt", "text", "cls2", "info"):
+        return data.decode()
+    if ext in ("cls", "index", "id"):
+        try:
+            return int(data.decode().strip())
+        except ValueError:
+            return data.decode()
+    if ext in ("npy",):
+        import io
+
+        return np.load(io.BytesIO(data))
+    if ext in ("jpg", "jpeg", "png", "ppm", "webp"):
+        try:
+            import io
+
+            from PIL import Image
+
+            return np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+        except ImportError:
+            return data
+    return data
+
+
+def read_sql(sql: str, connection_factory: Callable[[], Any], *,
+             parallelism: int = -1) -> Dataset:
+    """Read from any DBAPI2 source (reference:
+    data/datasource/sql_datasource.py). `connection_factory` returns a new
+    DBAPI connection (e.g. `lambda: sqlite3.connect(path)`)."""
+
+    def read_one(_):
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        return [dict(zip(cols, r)) for r in rows] or {
+            c: np.array([]) for c in cols}
+
+    return Dataset(Read([lambda: read_one(None)], "read_sql"))
+
+
 # ---------------------------------------------------------------------------
-# Datasink
+# Datasinks (parallel writes — one remote task per block; reference:
+# python/ray/data/datasource/datasink.py + Dataset.write_*)
 # ---------------------------------------------------------------------------
+
+def _write_blocks(ds: Dataset, path: str, write_one: Callable[[Any, str], None],
+                  ext: str) -> List[str]:
+    from .. import get as ray_get, remote
+
+    os.makedirs(path, exist_ok=True)
+
+    @remote
+    def _task(block, out):
+        write_one(block, out)
+        return out
+
+    refs = []
+    for i, ref in enumerate(ds._refs()):
+        out = os.path.join(path, f"part-{i:05d}{ext}")
+        refs.append(_task.remote(ref, out))
+    return list(ray_get(refs))
+
 
 def write_parquet(ds: Dataset, path: str) -> List[str]:
     import pyarrow.parquet as pq
-    from .. import get as ray_get
 
-    os.makedirs(path, exist_ok=True)
-    written = []
-    for i, ref in enumerate(ds._refs()):
-        block = ray_get(ref)
-        out = os.path.join(path, f"part-{i:05d}.parquet")
-        pq.write_table(block, out)
-        written.append(out)
-    return written
+    return _write_blocks(
+        ds, path, lambda b, out: pq.write_table(b, out), ".parquet")
+
+
+def write_csv(ds: Dataset, path: str) -> List[str]:
+    import pyarrow.csv as pacsv
+
+    return _write_blocks(
+        ds, path, lambda b, out: pacsv.write_csv(b, out), ".csv")
+
+
+def write_json(ds: Dataset, path: str) -> List[str]:
+    import json as _json
+
+    def _w(block, out):
+        rows = BlockAccessor.for_block(block).iter_rows()
+        with open(out, "w") as f:
+            for r in rows:
+                f.write(_json.dumps(
+                    {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                     for k, v in r.items()}) + "\n")
+
+    return _write_blocks(ds, path, _w, ".jsonl")
+
+
+def write_numpy(ds: Dataset, path: str, column: str) -> List[str]:
+    def _w(block, out):
+        batch = BlockAccessor.for_block(block).to_batch("numpy")
+        np.save(out, batch[column])
+
+    return _write_blocks(ds, path, _w, ".npy")
+
+
+def write_tfrecords(ds: Dataset, path: str) -> List[str]:
+    from . import tfrecord
+
+    def _w(block, out):
+        batch = BlockAccessor.for_block(block).to_batch("numpy")
+        tfrecord.write_records(out, tfrecord.batch_to_examples(batch))
+
+    return _write_blocks(ds, path, _w, ".tfrecords")

@@ -49,9 +49,11 @@ class ActorPool:
             if not ready:
                 raise TimeoutError("get_next timed out")
         del self._index_to_future[idx]
-        value = self._ray.get(future)
+        # Return the actor BEFORE get(): if the task raised, the actor must
+        # still rejoin the idle set or the pool wedges (reference:
+        # ray.util.actor_pool orders it the same way).
         self._return_actor(future)
-        return value
+        return self._ray.get(future)
 
     def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
         """Next result in completion order."""
@@ -67,9 +69,8 @@ class ActorPool:
             if fut == future:
                 del self._index_to_future[idx]
                 break
-        value = self._ray.get(future)
         self._return_actor(future)
-        return value
+        return self._ray.get(future)
 
     def _return_actor(self, future) -> None:
         actor = self._future_to_actor.pop(future)

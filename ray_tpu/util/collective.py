@@ -95,9 +95,18 @@ class _Coordinator:
                 st["out"] = self._finish(kind, st["vals"], op)
                 st["done"].set()
         if not st["done"].wait(timeout):
-            raise TimeoutError(
-                f"collective {kind}#{seq}: only {len(st['vals'])}/"
-                f"{self.world} ranks arrived within {timeout}s")
+            with self._lock:
+                # Withdraw this rank's contribution so the round state
+                # stays consistent (a retry may contribute again), and
+                # tear the round down entirely once nobody is left in it.
+                if not st["done"].is_set():
+                    st["vals"].pop(rank, None)
+                    if not st["vals"]:
+                        self._rounds.pop((kind, seq), None)
+                    raise TimeoutError(
+                        f"collective {kind}#{seq}: only {len(st['vals'])}/"
+                        f"{self.world} ranks arrived within {timeout}s")
+                # Round completed in the race window — fall through.
         out = st["out"]
         with self._lock:
             # Last rank out tears the round down.
@@ -127,38 +136,43 @@ class _Coordinator:
             return None
         raise ValueError(f"unknown collective kind {kind!r}")
 
+    def _p2p_entry(self, key) -> dict:
+        st = self._p2p.get(key)
+        if st is None:
+            st = {"done": threading.Event(), "val": None,
+                  "taken": threading.Event(), "state": "pending"}
+            self._p2p[key] = st
+        return st
+
     def send(self, src: int, dst: int, tag: int, payload, timeout: float):
         with self._lock:
-            key = (src, dst, tag)
-            st = self._p2p.get(key)
-            if st is None:
-                st = {"done": threading.Event(), "val": None,
-                      "taken": threading.Event()}
-                self._p2p[key] = st
+            st = self._p2p_entry((src, dst, tag))
             st["val"] = payload
             st["done"].set()
         if not st["taken"].wait(timeout):
-            # Withdraw the undelivered payload: a later recv must not see
-            # a message whose sender was told it failed.
+            # Arbitrate under the lock: the receiver may have taken the
+            # message in the race window between its done.wait() and
+            # acquiring the lock — then the send DID succeed.
             with self._lock:
+                if st["state"] == "taken":
+                    return
+                st["state"] = "withdrawn"
                 self._p2p.pop((src, dst, tag), None)
             raise TimeoutError(f"send {src}->{dst} tag {tag}: no receiver")
 
     def recv(self, src: int, dst: int, tag: int, timeout: float):
         with self._lock:
-            key = (src, dst, tag)
-            st = self._p2p.get(key)
-            if st is None:
-                st = {"done": threading.Event(), "val": None,
-                      "taken": threading.Event()}
-                self._p2p[key] = st
+            st = self._p2p_entry((src, dst, tag))
         if not st["done"].wait(timeout):
             raise TimeoutError(f"recv {dst}<-{src} tag {tag}: no sender")
-        val = st["val"]
         with self._lock:
+            if st["state"] == "withdrawn":
+                raise TimeoutError(
+                    f"recv {dst}<-{src} tag {tag}: sender withdrew")
+            st["state"] = "taken"
             st["taken"].set()
             self._p2p.pop((src, dst, tag), None)
-        return val
+        return st["val"]
 
 
 class _GroupHandle:

@@ -53,12 +53,16 @@ class _QueueActor:
             return False, None
 
     def put_batch(self, items: List[Any]) -> bool:
-        """All-or-nothing nowait batch; False if it doesn't fit."""
-        if self._q.maxsize > 0 and \
-                self._q.qsize() + len(items) > self._q.maxsize:
-            return False
-        for it in items:
-            self._q.put_nowait(it)
+        """All-or-nothing nowait batch; False if it doesn't fit. The actor
+        runs with max_concurrency > 1, so check+insert happens atomically
+        under the queue's own mutex."""
+        with self._q.mutex:
+            if self._q.maxsize > 0 and \
+                    len(self._q.queue) + len(items) > self._q.maxsize:
+                return False
+            self._q.queue.extend(items)
+            self._q.not_empty.notify(len(items))
+            self._q.unfinished_tasks += len(items)
         return True
 
 

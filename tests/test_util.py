@@ -179,3 +179,34 @@ class TestQueue:
         p = Producer.remote()
         assert ray_tpu.get(p.produce.remote(q, 4)) == 4
         assert [q.get() for _ in range(4)] == [0, 1, 2, 3]
+
+
+class TestReviewRegressions:
+    def test_actor_pool_survives_task_error(self, rt):
+        """A raising task must return the actor to the idle set
+        (review finding: pool wedged forever after one failure)."""
+        @ray_tpu.remote
+        class W:
+            def f(self, x):
+                if x == 1:
+                    raise ValueError("boom")
+                return x
+
+        pool = ActorPool([W.remote()])
+        pool.submit(lambda a, v: a.f.remote(v), 1)
+        pool.submit(lambda a, v: a.f.remote(v), 2)
+        with pytest.raises(Exception):
+            pool.get_next()
+        assert pool.get_next() == 2  # pool still alive
+
+    def test_queue_put_batch_all_or_nothing(self, rt):
+        from ray_tpu.util.queue import Full, Queue
+
+        q = Queue(maxsize=3)
+        q.put_nowait_batch([1, 2])
+        with pytest.raises(Full):
+            q.put_nowait_batch([3, 4])  # doesn't fit
+        assert q.qsize() == 2  # nothing partially inserted
+        q.put_nowait_batch([3])
+        assert [q.get_nowait() for _ in range(3)] == [1, 2, 3]
+        q.shutdown()
