@@ -110,10 +110,14 @@ class Mean(AggregateFn):
 
     def __init__(self, on: str):
         self.on = on
+
+        def acc(a, b):
+            c = _col(b, on)
+            return (a[0] + float(c.sum()), a[1] + len(c))
+
         super().__init__(
             init=lambda: (0.0, 0),
-            accumulate_block=lambda a, b: (
-                a[0] + float(_col(b, on).sum()), a[1] + len(_col(b, on))),
+            accumulate_block=acc,
             merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
             finalize=lambda a: a[0] / a[1] if a[1] else float("nan"),
             name=f"mean({on})")
@@ -171,7 +175,7 @@ class Quantile(AggregateFn):
             merge=lambda a, b: a + b,
             finalize=lambda a: (
                 float(np.quantile(np.asarray(a), q)) if a else float("nan")),
-            name=f"quantile({on})")
+            name=f"quantile({on},q={q})")
 
 
 def _opt(op, a, b):

@@ -49,6 +49,17 @@ def _partition_block(block, key: str, n: int):
     return out
 
 
+def _iter_key_groups(t, key: str):
+    """Sort by key, yield (key_value, group_slice) per distinct key."""
+    sorted_t = t.sort_by([(key, "ascending")])
+    keys = sorted_t.column(key).to_numpy(zero_copy_only=False)
+    bounds = np.nonzero(keys[1:] != keys[:-1])[0] + 1
+    starts = np.concatenate([[0], bounds])
+    ends = np.concatenate([bounds, [len(keys)]])
+    for s, e in zip(starts, ends):
+        yield keys[s], sorted_t.slice(s, e - s)
+
+
 @remote
 def _agg_partition(pieces, key: Optional[str], aggs: List[AggregateFn]):
     """Aggregate one partition (given its piece refs). Fast path: every
@@ -79,15 +90,9 @@ def _agg_partition(pieces, key: Optional[str], aggs: List[AggregateFn]):
             out.column(f"{p[0]}_{p[1]}") for p in pairs]
         return pa.table(cols, names=[key] + names)
     # Generic path: split into per-key groups, run accumulate/finalize.
-    sorted_t = t.sort_by([(key, "ascending")])
-    keys = sorted_t.column(key).to_numpy(zero_copy_only=False)
-    bounds = np.nonzero(keys[1:] != keys[:-1])[0] + 1
-    starts = np.concatenate([[0], bounds])
-    ends = np.concatenate([bounds, [len(keys)]])
     rows = []
-    for s, e in zip(starts, ends):
-        grp = sorted_t.slice(s, e - s)
-        row: Dict[str, Any] = {key: keys[s]}
+    for key_val, grp in _iter_key_groups(t, key):
+        row: Dict[str, Any] = {key: key_val}
         for a in aggs:
             acc = a.accumulate_block(a.init(), grp)
             row[a.name] = a.finalize(acc)
@@ -102,14 +107,8 @@ def _map_groups_partition(pieces, key: str, fn, batch_format: str):
     t = concat_blocks([ray_get_(p) for p in pieces])
     if t.num_rows == 0:
         return t.slice(0, 0)
-    sorted_t = t.sort_by([(key, "ascending")])
-    keys = sorted_t.column(key).to_numpy(zero_copy_only=False)
-    bounds = np.nonzero(keys[1:] != keys[:-1])[0] + 1
-    starts = np.concatenate([[0], bounds])
-    ends = np.concatenate([bounds, [len(keys)]])
     outs = []
-    for s, e in zip(starts, ends):
-        grp = sorted_t.slice(s, e - s)
+    for _, grp in _iter_key_groups(t, key):
         batch = BlockAccessor.for_block(grp).to_batch(batch_format)
         outs.append(BlockAccessor.for_block(fn(batch)).block)
     return concat_blocks(outs)

@@ -91,14 +91,19 @@ def read_records(path: str, *, verify: bool = True) -> Iterator[bytes]:
     with open(path, "rb") as f:
         while True:
             header = f.read(12)
-            if len(header) < 12:
+            if not header:
                 return
+            if len(header) < 12:
+                raise ValueError(f"{path}: truncated record header")
             (length,) = struct.unpack("<Q", header[:8])
             (lcrc,) = struct.unpack("<I", header[8:])
             if verify and _masked_crc(header[:8]) != lcrc:
                 raise ValueError(f"{path}: corrupt record length crc")
             data = f.read(length)
-            (dcrc,) = struct.unpack("<I", f.read(4))
+            tail = f.read(4)
+            if len(data) < length or len(tail) < 4:
+                raise ValueError(f"{path}: truncated record payload")
+            (dcrc,) = struct.unpack("<I", tail)
             if verify and _masked_crc(data) != dcrc:
                 raise ValueError(f"{path}: corrupt record data crc")
             yield data

@@ -42,13 +42,17 @@ class _TrainSession:
     def __init__(self, rank: int, world_size: int, name: str,
                  loop_config: Optional[Dict[str, Any]] = None,
                  dataset_shards: Optional[Dict[str, Any]] = None,
-                 plan=None):
+                 plan=None, start_checkpoint=None):
         self.rank = rank
         self.world_size = world_size
         self.name = name
         self.loop_config = loop_config or {}
         self.dataset_shards = dataset_shards or {}
         self.plan = plan
+        # Checkpoint to resume from (trial restore / PBT exploit); user
+        # code reads it via get_checkpoint() (reference:
+        # ray.train.get_checkpoint / session.get_checkpoint).
+        self.start_checkpoint = start_checkpoint
         self.queue: "queue.Queue[Optional[ReportItem]]" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
@@ -94,6 +98,15 @@ def report(metrics: Dict[str, Any], checkpoint=None) -> None:
         raise RuntimeError(
             "ray_tpu.train.report() called outside a training session")
     s.report(metrics, checkpoint)
+
+
+def get_checkpoint():
+    """The checkpoint this trial/worker should resume from, or None
+    (reference: ray.train.get_checkpoint)."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("No active training session")
+    return s.start_checkpoint
 
 
 def get_context() -> TrainContext:

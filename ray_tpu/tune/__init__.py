@@ -1,11 +1,16 @@
-from ..train.session import report  # tune.report == train.report surface
+from ..train.session import get_checkpoint, report  # tune surface == train
 from .schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PopulationBasedTraining,
     TrialScheduler,
 )
 from .search import (
+    BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -17,6 +22,9 @@ from .tuner import ResultGrid, TrialResult, TuneConfig, Tuner
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
+    "get_checkpoint",
     "uniform", "loguniform", "quniform", "randint", "choice", "grid_search",
-    "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule", "TrialScheduler",
+    "Searcher", "BasicVariantGenerator", "TPESearcher",
+    "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule",
+    "HyperBandScheduler", "PopulationBasedTraining", "TrialScheduler",
 ]

@@ -1,24 +1,34 @@
 """Trial schedulers: FIFO, ASHA (async successive halving), median
-stopping.
+stopping, HyperBand, Population Based Training.
 
 Capability-equivalent to the reference's schedulers
 (reference: python/ray/tune/schedulers/async_hyperband.py ASHA,
-median_stopping_rule.py; PBT lands with the RL stack): decide per
-reported result whether a trial CONTINUEs or STOPs."""
+median_stopping_rule.py, hyperband.py, pbt.py): decide per reported
+result whether a trial CONTINUEs, STOPs, or (PBT) EXPLOITs — restarts
+from a better trial's checkpoint with mutated hyperparams."""
 
 from __future__ import annotations
 
 import collections
 import math
-from typing import Dict, List, Optional
+import random
+from typing import Any, Dict, List, Optional, Tuple
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+EXPLOIT = "EXPLOIT"
 
 
 class TrialScheduler:
     def on_result(self, trial_id: str, step: int, metric_value: float) -> str:
         return CONTINUE
+
+    def on_result_full(self, trial_id: str, step: int, metric_value: float,
+                       config: Dict[str, Any], checkpoint: Any):
+        """Richer hook used by the Tuner: default delegates to on_result.
+        PBT overrides it and may return (EXPLOIT, new_config,
+        donor_checkpoint)."""
+        return self.on_result(trial_id, step, metric_value)
 
 
 class FIFOScheduler(TrialScheduler):
@@ -91,3 +101,112 @@ class MedianStoppingRule(TrialScheduler):
             return CONTINUE
         med = sorted(others)[len(others) // 2]
         return STOP if self._best[trial_id] > med else CONTINUE
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Multi-bracket HyperBand: trials are assigned round-robin to
+    brackets with geometrically staggered grace periods; each bracket is
+    successive halving (reference: tune/schedulers/hyperband.py — the
+    async per-result formulation, like ASHA per bracket)."""
+
+    def __init__(self, *, metric: str = "loss", mode: str = "min",
+                 max_t: int = 81, reduction_factor: int = 3):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.eta = reduction_factor
+        s_max = int(math.log(max_t) / math.log(reduction_factor))
+        self._brackets = [
+            ASHAScheduler(metric=metric, mode=mode, max_t=max_t,
+                          grace_period=max(1, reduction_factor ** s),
+                          reduction_factor=reduction_factor)
+            for s in range(s_max, -1, -1)]
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def _bracket(self, trial_id: str) -> "ASHAScheduler":
+        if trial_id not in self._assignment:
+            self._assignment[trial_id] = self._next % len(self._brackets)
+            self._next += 1
+        return self._brackets[self._assignment[trial_id]]
+
+    def on_result(self, trial_id: str, step: int, value: float) -> str:
+        return self._bracket(trial_id).on_result(trial_id, step, value)
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py): every
+    perturbation_interval steps, a trial in the bottom quantile stops and
+    EXPLOITs — clones the config + latest checkpoint of a top-quantile
+    trial with hyperparams mutated by `hyperparam_mutations` (factor
+    0.8/1.2 perturbation, or resample with `resample_probability`)."""
+
+    def __init__(self, *, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        assert mode in ("min", "max")
+        assert 0 < quantile_fraction <= 0.5
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        # trial_id -> (last value, step, config, checkpoint)
+        self._state: Dict[str, tuple] = {}
+        self._last_perturb: Dict[str, int] = {}
+
+    def _norm(self, v: float) -> float:
+        return -v if self.mode == "max" else v
+
+    def on_result_full(self, trial_id: str, step: int, value: float,
+                       config: Dict[str, Any], checkpoint: Any):
+        self._state[trial_id] = (self._norm(value), step, dict(config),
+                                 checkpoint)
+        if step - self._last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = step
+        pop = sorted(self._state.items(), key=lambda kv: kv[1][0])
+        n = len(pop)
+        k = max(1, int(n * self.quantile))
+        if n < 2:
+            return CONTINUE
+        bottom_ids = {tid for tid, _ in pop[-k:]}
+        if trial_id not in bottom_ids:
+            return CONTINUE
+        # Exploit: clone a random top-quantile trial, explore its config.
+        donors = [kv for kv in pop[:k] if kv[0] != trial_id
+                  and kv[1][3] is not None]
+        if not donors:
+            return CONTINUE
+        _, (_, _, donor_cfg, donor_ckpt) = self._rng.choice(donors)
+        return (EXPLOIT, self._explore(donor_cfg), donor_ckpt)
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from .search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            cur = out.get(key)
+            if self._rng.random() < self.resample_prob or cur is None:
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self._rng)
+                elif isinstance(spec, (list, tuple)):
+                    out[key] = self._rng.choice(list(spec))
+                elif callable(spec):
+                    out[key] = spec()
+            elif isinstance(spec, (list, tuple)):
+                # Move to a neighboring categorical value.
+                vals = list(spec)
+                i = vals.index(cur) if cur in vals else 0
+                out[key] = vals[max(0, min(len(vals) - 1,
+                                           i + self._rng.choice((-1, 1))))]
+            elif isinstance(cur, (int, float)):
+                factor = self._rng.choice((0.8, 1.2))
+                out[key] = type(cur)(cur * factor)
+        return out

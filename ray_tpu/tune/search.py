@@ -79,6 +79,158 @@ def grid_search(values: List[Any]) -> GridSearch:
     return GridSearch(values)
 
 
+class Searcher:
+    """Sequential config suggester (reference:
+    python/ray/tune/search/searcher.py — suggest/on_trial_complete).
+    suggest() returning None ends the experiment."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid + random sampling from a param_space (reference:
+    tune/search/basic_variant.py)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self._it = generate_variants(param_space, num_samples, seed)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        return next(self._it, None)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (reference capability:
+    tune/search/hyperopt/hyperopt_search.py, implemented natively):
+    after n_initial random trials, observations split into good (top
+    gamma quantile) and bad; candidates are drawn from a Parzen mixture
+    over the good points and ranked by the density ratio l(x)/g(x),
+    independently per dimension. Categorical dims use re-weighted
+    empirical frequencies."""
+
+    def __init__(self, param_space: Dict[str, Any], *, metric: str,
+                 mode: str = "min", num_samples: int = 32,
+                 n_initial: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        assert mode in ("min", "max")
+        self.space = param_space
+        self.metric = metric
+        self.mode = mode
+        self.limit = num_samples
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._suggested = 0
+        self._pending: Dict[str, Dict[str, Any]] = {}
+        self._observed: List[tuple] = []  # (norm_value, config)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.limit:
+            return None
+        self._suggested += 1
+        if len(self._observed) < self.n_initial:
+            cfg = self._random_config()
+        else:
+            cfg = self._tpe_config()
+        self._pending[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None) -> None:
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is None or not result or self.metric not in result:
+            return
+        v = float(result[self.metric])
+        self._observed.append((-v if self.mode == "max" else v, cfg))
+
+    # -- internals ------------------------------------------------------
+    def _random_config(self) -> Dict[str, Any]:
+        cfg = {}
+        for k, v in self.space.items():
+            if isinstance(v, GridSearch):
+                cfg[k] = self._rng.choice(v.values)
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self._rng)
+            else:
+                cfg[k] = v
+        return cfg
+
+    def _split(self):
+        obs = sorted(self._observed, key=lambda t: t[0])
+        n_good = max(1, int(len(obs) * self.gamma))
+        return [c for _, c in obs[:n_good]], [c for _, c in obs[n_good:]]
+
+    def _tpe_config(self) -> Dict[str, Any]:
+        import math
+
+        good, bad = self._split()
+        cfg = {}
+        for k, v in self.space.items():
+            if isinstance(v, Float) or isinstance(v, Integer):
+                lo = v.low if isinstance(v, Integer) else v.low
+                hi = v.high if isinstance(v, Integer) else v.high
+                log = getattr(v, "log", False)
+                tx = (lambda x: math.log(x)) if log else (lambda x: x)
+                inv = (lambda x: math.exp(x)) if log else (lambda x: x)
+                gx = [tx(c[k]) for c in good if k in c]
+                bx = [tx(c[k]) for c in bad if k in c] or gx
+                width = (tx(hi) - tx(lo)) or 1.0
+                bw = max(width / max(2, len(gx)), 1e-6)
+                best, best_score = None, -math.inf
+                for _ in range(self.n_candidates):
+                    mu = self._rng.choice(gx)
+                    x = self._rng.gauss(mu, bw)
+                    x = min(max(x, tx(lo)), tx(hi))
+                    score = (self._parzen(x, gx, bw)
+                             / (self._parzen(x, bx, bw) + 1e-12))
+                    if score > best_score:
+                        best, best_score = x, score
+                val = inv(best)
+                if isinstance(v, Integer):
+                    val = int(round(val))
+                    val = min(max(val, v.low), v.high - 1)
+                elif v.q:
+                    val = round(val / v.q) * v.q
+                cfg[k] = val
+            elif isinstance(v, Categorical) or isinstance(v, GridSearch):
+                cats = v.categories if isinstance(v, Categorical) \
+                    else v.values
+                counts = {c: 1.0 for c in cats}  # +1 smoothing
+                for c in good:
+                    if k in c and c[k] in counts:
+                        counts[c[k]] += 1.0
+                total = sum(counts.values())
+                r = self._rng.random() * total
+                acc = 0.0
+                for cat, w in counts.items():
+                    acc += w
+                    if r <= acc:
+                        cfg[k] = cat
+                        break
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self._rng)
+            else:
+                cfg[k] = v
+        return cfg
+
+    @staticmethod
+    def _parzen(x: float, centers: List[float], bw: float) -> float:
+        import math
+
+        if not centers:
+            return 0.0
+        s = 0.0
+        for mu in centers:
+            s += math.exp(-0.5 * ((x - mu) / bw) ** 2)
+        return s / (len(centers) * bw * math.sqrt(2 * math.pi))
+
+
 def generate_variants(param_space: Dict[str, Any], num_samples: int,
                       seed: Optional[int] = None
                       ) -> Iterator[Dict[str, Any]]:

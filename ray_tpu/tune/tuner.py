@@ -19,8 +19,8 @@ from .. import get as ray_get, kill as ray_kill, remote
 from ..train.checkpoint import Checkpoint, CheckpointManager
 from ..train.config import RunConfig
 from ..train.session import ReportItem, StopTrial, _set_session, _TrainSession
-from .schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
-from .search import generate_variants
+from .schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler, TrialScheduler
+from .search import BasicVariantGenerator, Searcher, generate_variants
 
 
 @dataclass
@@ -29,6 +29,7 @@ class TuneConfig:
     metric: Optional[str] = None
     mode: str = "min"
     scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
     max_concurrent_trials: int = 4
     seed: Optional[int] = None
     resources_per_trial: Dict[str, float] = field(default_factory=dict)
@@ -99,11 +100,13 @@ class _TrialWorker:
             self.session.stop_requested.set()
         return True
 
-    def run(self, fn_bytes: bytes, config: Dict[str, Any]):
+    def run(self, fn_bytes: bytes, config: Dict[str, Any],
+            start_checkpoint=None):
         import cloudpickle
 
         fn = cloudpickle.loads(fn_bytes)
-        session = _TrainSession(0, 1, self.trial_id, config)
+        session = _TrainSession(0, 1, self.trial_id, config,
+                                start_checkpoint=start_checkpoint)
         self.session = session
         stopped = {"early": False}
 
@@ -142,24 +145,70 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._prior_results: List[TrialResult] = []
+        self._prior_records: List[dict] = []
+        self._resume_configs: Optional[List[Dict[str, Any]]] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable, *,
+                tune_config: Optional[TuneConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its storage directory
+        (reference: Tuner.restore — finished trials are kept, unfinished
+        trial configs re-run)."""
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            state = json.load(f)
+        t = cls(trainable, tune_config=tune_config,
+                run_config=RunConfig(storage_path=os.path.dirname(path),
+                                     name=os.path.basename(path)))
+        t._resume_configs = []
+        t._prior_records = []
+        for rec in state["trials"]:
+            if rec["status"] == "completed":
+                t._prior_results.append(TrialResult(
+                    rec["trial_id"], rec["config"],
+                    metrics=rec.get("metrics") or {},
+                    error=rec.get("error"),
+                    stopped_early=rec.get("stopped_early", False)))
+                t._prior_records.append(rec)
+            else:
+                t._resume_configs.append(rec["config"])
+        return t
 
     def fit(self) -> ResultGrid:
         import cloudpickle
 
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
-        configs = list(generate_variants(
-            self.param_space, tc.num_samples, tc.seed))
+        if self._resume_configs is not None:
+            searcher: Searcher = BasicVariantGenerator({}, 0)
+            searcher._it = iter(self._resume_configs)
+        elif tc.search_alg is not None:
+            searcher = tc.search_alg
+        else:
+            searcher = BasicVariantGenerator(
+                self.param_space, tc.num_samples, tc.seed)
         storage = self.run_config.resolve_storage()
         os.makedirs(storage, exist_ok=True)
 
         fn_bytes = cloudpickle.dumps(self.trainable)
-        results: List[TrialResult] = []
-        results_lock = threading.Lock()
+        results: List[TrialResult] = list(self._prior_results)
+        # Seed the persisted state with carried-over completed trials so
+        # a second interruption + restore doesn't lose them.
+        trial_status: Dict[str, dict] = {
+            rec["trial_id"]: dict(rec) for rec in self._prior_records}
+        state_lock = threading.Lock()
         sem = threading.Semaphore(max(1, tc.max_concurrent_trials))
 
-        def run_trial(i: int, config: Dict[str, Any]):
-            trial_id = f"trial_{i:04d}_{uuid.uuid4().hex[:6]}"
+        def persist():
+            # Called under state_lock. Reference: experiment_state.py —
+            # rewritten after every trial state change so an interrupted
+            # experiment can Tuner.restore().
+            with open(os.path.join(storage, "experiment_state.json"),
+                      "w") as f:
+                json.dump({"trials": list(trial_status.values())},
+                          f, indent=1, default=str)
+
+        def run_trial(trial_id: str, config: Dict[str, Any]):
             tr = TrialResult(trial_id, config)
             # max_concurrency=2: one thread streams `run`, the other must
             # stay free for request_stop (scheduler early termination).
@@ -170,54 +219,82 @@ class Tuner:
             if tc.resources_per_trial.get("tpu"):
                 actor_opts["num_tpus"] = tc.resources_per_trial["tpu"]
             Worker = remote(**actor_opts)(_TrialWorker)
-            worker = Worker.remote(trial_id)
             step = 0
+            start_ckpt = None
             try:
-                stream = worker.run.options(
-                    num_returns="streaming").remote(fn_bytes, config)
-                for ref in stream:
-                    item: ReportItem = ray_get(ref)
-                    if item.metrics.get("__trial_done__"):
-                        tr.stopped_early = item.metrics.get(
-                            "__stopped_early__", False)
-                        continue
-                    step += 1
-                    tr.metrics = item.metrics
-                    tr.metrics_history.append(item.metrics)
-                    if item.checkpoint is not None:
-                        tr.checkpoint = item.checkpoint
-                    if tc.metric and tc.metric in item.metrics:
-                        decision = scheduler.on_result(
-                            trial_id, step, item.metrics[tc.metric])
-                        if decision == STOP:
-                            worker.request_stop.remote()
+                while True:  # restarts on PBT exploit
+                    worker = Worker.remote(trial_id)
+                    exploit: Optional[tuple] = None
+                    try:
+                        stream = worker.run.options(
+                            num_returns="streaming").remote(
+                                fn_bytes, config, start_ckpt)
+                        for ref in stream:
+                            item: ReportItem = ray_get(ref)
+                            if item.metrics.get("__trial_done__"):
+                                tr.stopped_early = item.metrics.get(
+                                    "__stopped_early__", False)
+                                continue
+                            step += 1
+                            tr.metrics = item.metrics
+                            tr.metrics_history.append(item.metrics)
+                            if item.checkpoint is not None:
+                                tr.checkpoint = item.checkpoint
+                            if tc.metric and tc.metric in item.metrics:
+                                decision = scheduler.on_result_full(
+                                    trial_id, step,
+                                    item.metrics[tc.metric],
+                                    config, tr.checkpoint)
+                                if decision == STOP:
+                                    worker.request_stop.remote()
+                                elif (isinstance(decision, tuple)
+                                      and decision[0] == EXPLOIT):
+                                    exploit = decision[1:]
+                                    worker.request_stop.remote()
+                    finally:
+                        try:
+                            ray_kill(worker)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    if exploit is None:
+                        break
+                    config, start_ckpt = exploit
+                    tr.config = config
+                    tr.stopped_early = False
             except BaseException as e:  # noqa: BLE001
                 tr.error = f"{type(e).__name__}: {e}"
             finally:
-                try:
-                    ray_kill(worker)
-                except Exception:  # noqa: BLE001
-                    pass
-                with results_lock:
+                searcher.on_trial_complete(trial_id, tr.metrics)
+                with state_lock:
                     results.append(tr)
+                    trial_status[trial_id].update(
+                        status="error" if tr.error else "completed",
+                        config=tr.config, metrics=tr.metrics,
+                        error=tr.error, stopped_early=tr.stopped_early)
+                    persist()
                 sem.release()
 
         threads = []
-        for i, config in enumerate(configs):
+        i = 0
+        while True:
+            with state_lock:
+                trial_id = f"trial_{i:04d}_{uuid.uuid4().hex[:6]}"
+                config = searcher.suggest(trial_id)
+                if config is None:
+                    break
+                trial_status[trial_id] = {
+                    "trial_id": trial_id, "config": config,
+                    "status": "running", "metrics": None, "error": None,
+                    "stopped_early": False}
+                persist()
             sem.acquire()
-            t = threading.Thread(target=run_trial, args=(i, config),
-                                 daemon=True)
+            t = threading.Thread(target=run_trial,
+                                 args=(trial_id, config), daemon=True)
             t.start()
             threads.append(t)
+            i += 1
         for t in threads:
             t.join()
 
-        # Persist experiment summary (reference: experiment_state.py).
-        with open(os.path.join(storage, "experiment_state.json"), "w") as f:
-            json.dump([
-                {"trial_id": r.trial_id, "config": r.config,
-                 "metrics": r.metrics, "error": r.error,
-                 "stopped_early": r.stopped_early}
-                for r in results], f, indent=1, default=str)
         results.sort(key=lambda r: r.trial_id)
         return ResultGrid(results, tc.metric, tc.mode)

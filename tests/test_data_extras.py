@@ -47,12 +47,13 @@ def test_aggregate_multiple(data):
 
     ds = data.range(50, parallelism=4)
     out = ds.aggregate(Count(), Sum("id"), Max("id"), Mean("id"),
-                       Quantile("id", 0.5))
+                       Quantile("id", 0.25), Quantile("id", 0.5))
     assert out["count()"] == 50
     assert out["sum(id)"] == 1225
     assert out["max(id)"] == 49
     assert out["mean(id)"] == 24.5
-    assert out["quantile(id)"] == 24.5
+    assert out["quantile(id,q=0.5)"] == 24.5
+    assert out["quantile(id,q=0.25)"] == 12.25
 
 
 # ---------------------------------------------------------------------------
