@@ -2,8 +2,9 @@
 
 Capability-equivalent to the reference's RLlib new stack (reference:
 rllib/ — RLModule, EnvRunner, Learner, Algorithm; SURVEY.md §2.3 RLlib
-row): parallel env-rollout actors + a jitted learner, PPO for control,
-GRPO for LLM RLHF (BASELINE config 5).
+row): parallel env-rollout actors + a jitted learner. On-policy: PPO for
+control, GRPO for LLM RLHF (BASELINE config 5). Off-policy: double DQN
+and discrete SAC over a replay buffer.
 """
 
 from .algorithm import Algorithm
@@ -17,13 +18,16 @@ from .env import (
     make_env,
     register_env,
 )
+from .dqn import DQN, DQNConfig
 from .env_runner import EnvRunner
 from .grpo import GRPO, GRPOConfig
-from .module import MLPModuleSpec
+from .module import MLPModuleSpec, QMLPSpec
 from .ppo import PPO, PPOConfig
+from .sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm", "ReplayBuffer", "Env", "CartPole", "GridWorld",
     "VectorEnv", "make_env", "register_env", "ENV_REGISTRY", "EnvRunner",
-    "MLPModuleSpec", "PPO", "PPOConfig", "GRPO", "GRPOConfig",
+    "MLPModuleSpec", "QMLPSpec", "PPO", "PPOConfig", "GRPO", "GRPOConfig",
+    "DQN", "DQNConfig", "SAC", "SACConfig",
 ]

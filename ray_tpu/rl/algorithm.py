@@ -60,15 +60,26 @@ class Algorithm:
     def as_trainable(cls, base_config) -> Callable[[Dict[str, Any]], None]:
         """→ a function trainable for ray_tpu.tune.Tuner: each trial
         builds the algorithm with config overrides and reports every
-        iteration's metrics."""
-        from ..train.session import report
+        iteration's metrics + a state checkpoint. Consumes
+        tune.get_checkpoint() so PBT exploit restarts resume from the
+        donor's state instead of scratch."""
+        import tempfile as _tempfile
+
+        from ..train.checkpoint import Checkpoint
+        from ..train.session import get_checkpoint, report
 
         def trainable(tune_config: Dict[str, Any]) -> None:
             cfg = base_config.with_overrides(**tune_config)
             algo = cls(cfg)
+            start = get_checkpoint()
+            if start is not None:
+                algo.restore(start.as_directory())
             try:
                 for _ in range(getattr(cfg, "train_iterations", 10)):
-                    report(algo.step())
+                    res = algo.step()
+                    path = _tempfile.mkdtemp(prefix="rl_ckpt_")
+                    algo.save(path)
+                    report(res, checkpoint=Checkpoint(path))
             finally:
                 algo.stop()
 

@@ -62,3 +62,49 @@ class EnvRunner:
             "episode_returns": np.asarray(
                 self.vec.pop_episode_returns(), np.float32),
         }
+
+    def sample_transitions(self, params, num_steps: int, *,
+                           epsilon: Optional[float] = None
+                           ) -> Dict[str, np.ndarray]:
+        """Off-policy collection: flat (s, a, r, s', done) transitions
+        (reference: rllib EnvRunner sampling for DQN/SAC replay).
+
+        epsilon set → epsilon-greedy over the spec's action scores
+        (Q-values for a QMLPSpec, logits otherwise); epsilon None →
+        categorical sampling from the scores as logits (SAC-style
+        stochastic policy)."""
+        import jax.numpy as jnp
+
+        K = self.vec.num_envs
+        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        for _ in range(num_steps):
+            obs = self.vec.observations
+            out = self.spec.apply(params, jnp.asarray(obs))
+            scores = out[0] if isinstance(out, tuple) else out
+            self._key, k = jax.random.split(self._key)
+            if epsilon is not None:
+                greedy = np.asarray(jnp.argmax(scores, axis=-1))
+                explore = np.asarray(
+                    jax.random.uniform(k, (K,))) < epsilon
+                self._key, k2 = jax.random.split(self._key)
+                randa = np.asarray(jax.random.randint(
+                    k2, (K,), 0, scores.shape[-1]))
+                actions = np.where(explore, randa, greedy)
+            else:
+                actions = np.asarray(
+                    jax.random.categorical(k, scores, axis=-1))
+            next_obs, rewards, dones = self.vec.step(actions)
+            obs_l.append(obs)
+            act_l.append(actions)
+            rew_l.append(rewards)
+            next_l.append(next_obs)
+            done_l.append(dones)
+        return {
+            "obs": np.concatenate(obs_l),
+            "actions": np.concatenate(act_l),
+            "rewards": np.concatenate(rew_l).astype(np.float32),
+            "next_obs": np.concatenate(next_l),
+            "dones": np.concatenate(done_l).astype(np.float32),
+            "episode_returns": np.asarray(
+                self.vec.pop_episode_returns(), np.float32),
+        }

@@ -56,6 +56,38 @@ class MLPModuleSpec:
         return logits, value
 
 
+@dataclass(frozen=True)
+class QMLPSpec:
+    """Q-network: MLP torso → per-action Q-values (for DQN/SAC critics;
+    reference: rllib's DQN RLModule capability)."""
+
+    observation_size: int
+    num_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        sizes = (self.observation_size,) + tuple(self.hidden)
+        params: Dict[str, Any] = {"torso": []}
+        keys = jax.random.split(key, len(sizes))
+        for i in range(len(sizes) - 1):
+            w = jax.random.normal(keys[i], (sizes[i], sizes[i + 1]),
+                                  jnp.float32)
+            w = w * np.sqrt(2.0 / sizes[i])
+            params["torso"].append(
+                {"w": w, "b": jnp.zeros((sizes[i + 1],), jnp.float32)})
+        params["q_w"] = jax.random.normal(
+            keys[-1], (sizes[-1], self.num_actions), jnp.float32) * 0.01
+        params["q_b"] = jnp.zeros((self.num_actions,), jnp.float32)
+        return params
+
+    def apply(self, params: Dict[str, Any], obs: jax.Array) -> jax.Array:
+        """obs (B, obs_size) → q-values (B, A)."""
+        h = obs
+        for layer in params["torso"]:
+            h = jnp.tanh(h @ layer["w"] + layer["b"])
+        return h @ params["q_w"] + params["q_b"]
+
+
 def sample_actions(spec, params, obs: np.ndarray, key: jax.Array
                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Exploration forward: sample from the categorical policy.

@@ -116,7 +116,9 @@ class HyperBandScheduler(TrialScheduler):
         self.mode = mode
         self.max_t = max_t
         self.eta = reduction_factor
-        s_max = int(math.log(max_t) / math.log(reduction_factor))
+        # floor with epsilon: bare int() truncates on float error
+        # (log(243)/log(3) = 4.9999...) and would drop a bracket.
+        s_max = int(math.log(max_t) / math.log(reduction_factor) + 1e-9)
         self._brackets = [
             ASHAScheduler(metric=metric, mode=mode, max_t=max_t,
                           grace_period=max(1, reduction_factor ** s),

@@ -94,11 +94,14 @@ class Searcher:
 
 class BasicVariantGenerator(Searcher):
     """Grid + random sampling from a param_space (reference:
-    tune/search/basic_variant.py)."""
+    tune/search/basic_variant.py). Pass `configs` to replay an explicit
+    list instead (used by Tuner.restore)."""
 
     def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
-                 seed: Optional[int] = None):
-        self._it = generate_variants(param_space, num_samples, seed)
+                 seed: Optional[int] = None,
+                 configs: Optional[List[Dict[str, Any]]] = None):
+        self._it = (iter(configs) if configs is not None
+                    else generate_variants(param_space, num_samples, seed))
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         return next(self._it, None)

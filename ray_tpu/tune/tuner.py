@@ -20,7 +20,7 @@ from ..train.checkpoint import Checkpoint, CheckpointManager
 from ..train.config import RunConfig
 from ..train.session import ReportItem, StopTrial, _set_session, _TrainSession
 from .schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler, TrialScheduler
-from .search import BasicVariantGenerator, Searcher, generate_variants
+from .search import BasicVariantGenerator, Searcher
 
 
 @dataclass
@@ -180,8 +180,8 @@ class Tuner:
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
         if self._resume_configs is not None:
-            searcher: Searcher = BasicVariantGenerator({}, 0)
-            searcher._it = iter(self._resume_configs)
+            searcher: Searcher = BasicVariantGenerator(
+                {}, 0, configs=self._resume_configs)
         elif tc.search_alg is not None:
             searcher = tc.search_alg
         else:
@@ -202,11 +202,14 @@ class Tuner:
         def persist():
             # Called under state_lock. Reference: experiment_state.py —
             # rewritten after every trial state change so an interrupted
-            # experiment can Tuner.restore().
-            with open(os.path.join(storage, "experiment_state.json"),
-                      "w") as f:
+            # experiment can Tuner.restore(). Atomic tmp+rename: the
+            # interruption restore exists for must not corrupt the file.
+            final = os.path.join(storage, "experiment_state.json")
+            tmp = final + ".tmp"
+            with open(tmp, "w") as f:
                 json.dump({"trials": list(trial_status.values())},
                           f, indent=1, default=str)
+            os.replace(tmp, final)
 
         def run_trial(trial_id: str, config: Dict[str, Any]):
             tr = TrialResult(trial_id, config)
@@ -221,8 +224,12 @@ class Tuner:
             Worker = remote(**actor_opts)(_TrialWorker)
             step = 0
             start_ckpt = None
+            exploits = 0
             try:
-                while True:  # restarts on PBT exploit
+                # Exploit restarts are capped: a trainable that never
+                # consumes tune.get_checkpoint() would otherwise reset to
+                # scratch, stay in the bottom quantile, and loop forever.
+                while exploits <= 32:
                     worker = Worker.remote(trial_id)
                     exploit: Optional[tuple] = None
                     try:
@@ -261,6 +268,7 @@ class Tuner:
                     config, start_ckpt = exploit
                     tr.config = config
                     tr.stopped_early = False
+                    exploits += 1
             except BaseException as e:  # noqa: BLE001
                 tr.error = f"{type(e).__name__}: {e}"
             finally:

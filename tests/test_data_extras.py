@@ -260,6 +260,20 @@ def test_read_sql(data, tmp_path):
     assert rows[2]["b"] == "row2"
 
 
+def test_tfrecord_truncated_file_raises(tmp_path):
+    from ray_tpu.data.tfrecord import (
+        encode_example, read_records, write_records)
+
+    path = str(tmp_path / "t.tfrecords")
+    write_records(path, [encode_example({"a": [1]})])
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-2])  # chop trailing crc
+    with pytest.raises(ValueError, match="truncated"):
+        list(read_records(path))
+    with pytest.raises(ValueError, match="truncated"):
+        list(read_records(path, verify=False))
+
+
 def test_min_max_skip_empty_blocks(data):
     """Review finding: min/max crashed on zero-row blocks from filter."""
     ds = data.from_items([{"x": 1}, {"x": 2}]).filter(lambda r: r["x"] > 1)
