@@ -58,12 +58,18 @@ __all__ = [
 # Lifecycle
 # ---------------------------------------------------------------------------
 
-def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
          num_worker_procs: int = 0,
          _system_config: Optional[Dict[str, Any]] = None,
          ignore_reinit_error: bool = True, **_compat) -> None:
     """Start (or connect to) the runtime.
+
+    address="tpu://host:port" enters CLIENT MODE: this process becomes a
+    remote driver against a ClientServer-hosted runtime (reference:
+    ray.init("ray://...") → python/ray/util/client/). All other options
+    start a local runtime.
 
     num_worker_procs > 0 adds an out-of-process execution plane: that
     many spawned worker processes (true parallelism, crash isolation)
@@ -71,6 +77,15 @@ def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
 
     Reference parity: ray.init (python/ray/_private/worker.py:1227).
     """
+    if address is not None:
+        from . import client as _client_mod
+
+        if _client_mod.get_client() is not None:
+            if ignore_reinit_error:
+                return
+            raise RuntimeError("already connected in client mode")
+        _client_mod.connect(address)
+        return
     if _runtime.is_initialized():
         if ignore_reinit_error:
             return
@@ -82,11 +97,24 @@ def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
 
 
 def shutdown() -> None:
+    from . import client as _client_mod
+
+    _client_mod.disconnect()
     _runtime.shutdown_runtime()
 
 
 def is_initialized() -> bool:
-    return _runtime.is_initialized()
+    from . import client as _client_mod
+
+    return (_runtime.is_initialized()
+            or _client_mod.get_client() is not None)
+
+
+def _client():
+    """Active client context, or None (client-mode routing hook)."""
+    from . import client as _client_mod
+
+    return _client_mod.get_client()
 
 
 # ---------------------------------------------------------------------------
@@ -135,11 +163,21 @@ def method(**opts):
 # ---------------------------------------------------------------------------
 
 def put(value: Any) -> ObjectRef:
+    c = _client()
+    if c is not None:
+        return c.put(value)
     return _runtime.global_runtime().put(value)
 
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None):
+    c = _client()
+    if c is not None:
+        from .client.common import ClientObjectRef
+
+        if isinstance(refs, ClientObjectRef):
+            return c.get(refs, timeout)
+        return c.get(list(refs), timeout)
     rt = _runtime.global_runtime()
     if isinstance(refs, ObjectRef):
         return rt.get([refs], timeout)[0]
@@ -162,16 +200,30 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
     if num_returns > len(refs):
         raise ValueError(
             f"num_returns={num_returns} exceeds {len(refs)} provided refs")
+    c = _client()
+    if c is not None:
+        return c.wait(list(refs), num_returns, timeout)
     return _runtime.global_runtime().wait(
         list(refs), num_returns, timeout, fetch_local)
 
 
 def cancel(ref: ObjectRef, *, force: bool = False,
            recursive: bool = True) -> None:
+    c = _client()
+    if c is not None:
+        c.cancel(ref, force=force)
+        return
     _runtime.global_runtime().cancel(ref, force=force)
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    c = _client()
+    if c is not None:
+        from .client.client import ClientActorHandle
+
+        if isinstance(actor, ClientActorHandle):
+            c.kill_actor(actor._actor_id, no_restart=no_restart)
+            return
     _runtime.global_runtime().kill_actor(
         actor._actor_id, no_restart=no_restart)
 
@@ -185,10 +237,16 @@ def get_runtime_context() -> RuntimeContext:
 
 
 def cluster_resources() -> Dict[str, float]:
+    c = _client()
+    if c is not None:
+        return c.cluster_resources()
     return _runtime.global_runtime().cluster_resources()
 
 
 def available_resources() -> Dict[str, float]:
+    c = _client()
+    if c is not None:
+        return c.available_resources()
     return _runtime.global_runtime().available_resources()
 
 

@@ -1,0 +1,53 @@
+"""Client mode — drive a remote ray_tpu runtime over a socket.
+
+Reference capability: python/ray/util/client/ — ray.init("ray://…").
+Usage:
+    server side:  ray_tpu.client.ClientServer(port=10001).start()
+    client side:  ray_tpu.init(address="tpu://host:10001")
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .client import ClientActorHandle, ClientContext
+from .common import ClientObjectRef
+from .server import ClientServer
+
+_client: Optional[ClientContext] = None
+_lock = threading.Lock()
+
+
+def connect(address: str, **kwargs) -> ClientContext:
+    """address: 'tpu://host:port' (or 'host:port')."""
+    global _client
+    addr = address
+    for prefix in ("tpu://", "ray://"):
+        if addr.startswith(prefix):
+            addr = addr[len(prefix):]
+    host, _, port = addr.rpartition(":")
+    with _lock:
+        if _client is not None:
+            raise RuntimeError(
+                "already connected in client mode; disconnect() first")
+        _client = ClientContext(host or "127.0.0.1", int(port), **kwargs)
+    return _client
+
+
+def disconnect() -> None:
+    global _client
+    with _lock:
+        if _client is not None:
+            _client.close()
+            _client = None
+
+
+def get_client() -> Optional[ClientContext]:
+    return _client
+
+
+__all__ = [
+    "ClientServer", "ClientContext", "ClientObjectRef",
+    "ClientActorHandle", "connect", "disconnect", "get_client",
+]

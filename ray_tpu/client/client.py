@@ -1,0 +1,215 @@
+"""Client context — the remote-driver side of client mode.
+
+Capability-equivalent of the reference's Ray Client
+(reference: python/ray/util/client/__init__.py RayAPIStub,
+client/worker.py Worker — ray.init("ray://host:port") turns every
+ray.* call into an RPC against a server-hosted driver): here
+ray_tpu.init(address="tpu://host:port") connects this context, and the
+top-level API + RemoteFunction/ActorClass route through it while
+connected.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .common import ClientActorRef, ClientObjectRef, recv_msg, send_msg
+
+
+class ClientContext:
+    def __init__(self, host: str, port: int, *, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+        self._sent_hashes: set = set()   # fn/cls payloads the server has
+        # Client-side ref counting: rid -> live local instances; zero →
+        # queued for a batched release on the next call.
+        self._ref_lock = threading.Lock()
+        self._ref_counts: Dict[str, int] = {}
+        self._pending_release: List[str] = []
+        self._closed = False
+        self.server_info = self._call({"op": "ping"})
+
+    # -- transport ------------------------------------------------------
+    def _call(self, req: Dict[str, Any]) -> Any:
+        with self._lock:
+            self._flush_releases_locked()
+            send_msg(self._sock, req)
+            resp = recv_msg(self._sock)
+        if resp["ok"]:
+            return resp["value"]
+        raise resp["error"]
+
+    def _flush_releases_locked(self) -> None:
+        with self._ref_lock:
+            pending, self._pending_release = self._pending_release, []
+        if pending:
+            send_msg(self._sock, {"op": "release", "refs": pending})
+            recv_msg(self._sock)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- client-side ref counting --------------------------------------
+    def _incref(self, rid: str) -> None:
+        with self._ref_lock:
+            self._ref_counts[rid] = self._ref_counts.get(rid, 0) + 1
+
+    def _decref(self, rid: str) -> None:
+        if self._closed:
+            return
+        with self._ref_lock:
+            n = self._ref_counts.get(rid, 0) - 1
+            if n > 0:
+                self._ref_counts[rid] = n
+            else:
+                self._ref_counts.pop(rid, None)
+                self._pending_release.append(rid)
+
+    def _make_ref(self, rid: str) -> ClientObjectRef:
+        return ClientObjectRef(rid, _ctx=self)
+
+    # -- object API -----------------------------------------------------
+    def put(self, value: Any) -> ClientObjectRef:
+        return self._make_ref(self._call({"op": "put", "value": value}))
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        if single:
+            refs = [refs]
+        out = self._call({"op": "get",
+                          "refs": [r.ref_id for r in refs],
+                          "timeout": timeout})
+        return out[0] if single else out
+
+    def wait(self, refs, num_returns: int, timeout: Optional[float]
+             ) -> Tuple[List[ClientObjectRef], List[ClientObjectRef]]:
+        ready, pending = self._call({
+            "op": "wait", "refs": [r.ref_id for r in refs],
+            "num_returns": num_returns, "timeout": timeout})
+        return ([self._make_ref(r) for r in ready],
+                [self._make_ref(r) for r in pending])
+
+    def cancel(self, ref: ClientObjectRef, force: bool = False) -> None:
+        self._call({"op": "cancel", "ref": ref.ref_id, "force": force})
+
+    # -- tasks ----------------------------------------------------------
+    def call_function(self, fn, args, kwargs, options):
+        req: Dict[str, Any] = {
+            "op": "call_fn",
+            "args": self._outbound(args),
+            "kwargs": self._outbound(kwargs),
+            "options": dict(options or {}),
+        }
+        # Content-addressed payload dedup: always hash the pickled bytes
+        # (id()-keyed caching is unsound — CPython reuses addresses after
+        # gc, which would silently run a stale function server-side).
+        req.update(self._payload("fn", fn))
+        out = self._call(req)
+        if "refs" in out:
+            return tuple(self._make_ref(r) for r in out["refs"])
+        return self._make_ref(out["ref"])
+
+    def _payload(self, kind: str, obj) -> Dict[str, Any]:
+        import cloudpickle
+
+        data = cloudpickle.dumps(obj)
+        h = hashlib.sha256(data).hexdigest()
+        out = {f"{kind}_hash": h}
+        if h not in self._sent_hashes:
+            out[f"{kind}_bytes"] = data
+            self._sent_hashes.add(h)
+        return out
+
+    # -- actors ---------------------------------------------------------
+    def create_actor(self, cls, args, kwargs, options
+                     ) -> "ClientActorHandle":
+        req: Dict[str, Any] = {
+            "op": "create_actor",
+            "args": self._outbound(args),
+            "kwargs": self._outbound(kwargs),
+            "options": dict(options or {}),
+        }
+        req.update(self._payload("cls", cls))
+        return ClientActorHandle(self, self._call(req))
+
+    def actor_call(self, actor_id: str, method: str, args, kwargs,
+                   options):
+        out = self._call({
+            "op": "actor_call", "actor_id": actor_id, "method": method,
+            "args": self._outbound(args),
+            "kwargs": self._outbound(kwargs),
+            "options": dict(options or {}),
+        })
+        if "refs" in out:
+            return tuple(self._make_ref(r) for r in out["refs"])
+        return self._make_ref(out["ref"])
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self._call({"op": "kill_actor", "actor_id": actor_id,
+                    "no_restart": no_restart})
+
+    def get_named_actor(self, name: str) -> "ClientActorHandle":
+        return ClientActorHandle(self, self._call(
+            {"op": "get_named_actor", "name": name}))
+
+    # -- introspection --------------------------------------------------
+    def cluster_resources(self) -> Dict[str, float]:
+        return self._call({"op": "cluster_resources"})
+
+    def available_resources(self) -> Dict[str, float]:
+        return self._call({"op": "available_resources"})
+
+    # -- helpers --------------------------------------------------------
+    @staticmethod
+    def _outbound(obj):
+        """Client handles cross the wire as marker refs."""
+        from .common import tree_substitute
+
+        def sub(x):
+            if isinstance(x, ClientActorHandle):
+                return ClientActorRef(x._actor_id)
+            return x
+
+        if isinstance(obj, tuple):
+            return tuple(tree_substitute(list(obj), sub))
+        return tree_substitute(obj, sub)
+
+
+class ClientActorMethod:
+    def __init__(self, handle: "ClientActorHandle", name: str,
+                 opts: Optional[Dict[str, Any]] = None):
+        self._handle = handle
+        self._name = name
+        self._opts = opts or {}
+
+    def remote(self, *args, **kwargs):
+        return self._handle._client.actor_call(
+            self._handle._actor_id, self._name, args, kwargs, self._opts)
+
+    def options(self, **opts) -> "ClientActorMethod":
+        merged = dict(self._opts)
+        merged.update(opts)
+        return ClientActorMethod(self._handle, self._name, merged)
+
+
+class ClientActorHandle:
+    def __init__(self, client: ClientContext, actor_id: str):
+        self._client = client
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> ClientActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ClientActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ClientActorHandle({self._actor_id[:16]})"

@@ -1,0 +1,113 @@
+"""Client wire protocol: length-prefixed cloudpickle messages + ref
+markers shared by both sides.
+
+Capability-equivalent of the reference's Ray Client data layer
+(reference: python/ray/util/client/ — ray_client.proto messages,
+client/common.py ClientObjectRef/ClientActorRef): here the transport is
+a plain TCP socket with 8-byte length framing instead of gRPC (gRPC
+wire-compat is not a goal; the *capability* — a remote driver — is).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Tuple
+
+_LEN = struct.Struct(">Q")
+MAX_MSG = 1 << 34  # 16 GiB sanity bound
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    import cloudpickle
+
+    data = cloudpickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(data)))
+    sock.sendall(data)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    import cloudpickle
+
+    header = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(header)
+    if n > MAX_MSG:
+        raise ConnectionError(f"message size {n} exceeds bound")
+    return cloudpickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class ClientObjectRef:
+    """Client-side handle to a server-held ObjectRef.
+
+    Instances created by a ClientContext participate in client-side
+    refcounting: when the last local instance for a ref_id is collected,
+    the context sends a batched `release` so the server can drop the
+    pinned ObjectRef (reference: Ray Client's ref streaming/release)."""
+
+    def __init__(self, ref_id: str, _ctx=None):
+        self.ref_id = ref_id
+        self._ctx = _ctx
+        if _ctx is not None:
+            _ctx._incref(ref_id)
+
+    def __del__(self):
+        ctx = getattr(self, "_ctx", None)
+        if ctx is not None:
+            try:
+                ctx._decref(self.ref_id)
+            except Exception:  # noqa: BLE001 - interpreter shutdown
+                pass
+
+    def __reduce__(self):
+        # The wire marker carries only the id (the server side must not
+        # run client refcounting).
+        return (ClientObjectRef, (self.ref_id,))
+
+    def __hash__(self):
+        return hash(self.ref_id)
+
+    def __eq__(self, other):
+        return (isinstance(other, ClientObjectRef)
+                and other.ref_id == self.ref_id)
+
+    def __repr__(self):
+        return f"ClientObjectRef({self.ref_id[:16]})"
+
+
+class ClientActorRef:
+    """Marker for an actor handle crossing the wire."""
+
+    def __init__(self, actor_id: str):
+        self.actor_id = actor_id
+
+    def __reduce__(self):
+        return (ClientActorRef, (self.actor_id,))
+
+    def __repr__(self):
+        return f"ClientActorRef({self.actor_id[:16]})"
+
+
+def tree_substitute(obj: Any, fn) -> Any:
+    """Recursively rebuild lists/tuples/dicts applying fn to leaves
+    (used to swap ClientObjectRef <-> real ObjectRef at the boundary)."""
+    out = fn(obj)
+    if out is not obj:
+        return out
+    if isinstance(obj, list):
+        return [tree_substitute(x, fn) for x in obj]
+    if isinstance(obj, tuple):
+        return tuple(tree_substitute(x, fn) for x in obj)
+    if isinstance(obj, dict):
+        return {k: tree_substitute(v, fn) for k, v in obj.items()}
+    return obj

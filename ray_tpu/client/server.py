@@ -1,0 +1,275 @@
+"""Client server — hosts remote drivers over TCP.
+
+Capability-equivalent of the reference's Ray Client server/proxier
+(reference: python/ray/util/client/server/server.py RayletServicer,
+proxier.py — remote clients drive a cluster through pickled stubs):
+each connection is an isolated session holding its refs/actors/functions;
+session state is dropped (refs released) on disconnect.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import socket
+import socketserver
+import threading
+import uuid
+from typing import Any, Dict, Optional
+
+from .common import (
+    ClientActorRef,
+    ClientObjectRef,
+    recv_msg,
+    send_msg,
+    tree_substitute,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class _Session:
+    def __init__(self):
+        self.refs: Dict[str, Any] = {}          # ref_id -> ObjectRef
+        self.actors: Dict[str, Any] = {}        # actor_id -> ActorHandle
+        # Actors this session CREATED (killed at teardown) vs handles it
+        # merely looked up via get_named_actor (must survive the session).
+        self.owned_actors: set = set()
+        self.functions: Dict[str, Any] = {}     # fn_hash -> callable
+        self.classes: Dict[str, type] = {}      # cls_hash -> class
+
+
+class ClientServer:
+    """Serve ray_tpu to remote clients. The hosting process must have
+    (or will lazily) ray_tpu.init()'d the real runtime."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 10001,
+                 **init_kwargs):
+        self.host = host
+        self.port = port
+        self._init_kwargs = init_kwargs
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "ClientServer":
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(**self._init_kwargs)
+
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                outer._serve_connection(self.request)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="ray-tpu-client-server")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    @property
+    def address(self) -> str:
+        return f"tpu://{self.host}:{self.port}"
+
+    # -- per-connection loop -------------------------------------------
+    def _serve_connection(self, sock: socket.socket) -> None:
+        session = _Session()
+        try:
+            while True:
+                try:
+                    req = recv_msg(sock)
+                except ConnectionError:
+                    return
+                try:
+                    resp = {"ok": True,
+                            "value": self._dispatch(session, req)}
+                except BaseException as e:  # noqa: BLE001
+                    resp = {"ok": False, "error": _picklable_error(e)}
+                try:
+                    send_msg(sock, resp)
+                except ConnectionError:
+                    return
+        finally:
+            self._teardown(session)
+
+    def _teardown(self, session: _Session) -> None:
+        import ray_tpu
+
+        # Actors the session created die with it (reference: client
+        # actors are owned by their proxied driver). Handles it only
+        # looked up by name belong to someone else — leave them alive.
+        for actor_id in session.owned_actors:
+            handle = session.actors.get(actor_id)
+            if handle is None:
+                continue
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+        session.refs.clear()
+
+    # -- op dispatch ----------------------------------------------------
+    def _dispatch(self, s: _Session, req: Dict[str, Any]) -> Any:
+        import ray_tpu
+
+        op = req["op"]
+        if op == "ping":
+            return {"version": ray_tpu.__version__}
+
+        if op == "put":
+            ref = ray_tpu.put(req["value"])
+            return self._track(s, ref)
+
+        if op == "get":
+            refs = [self._ref(s, r) for r in req["refs"]]
+            return ray_tpu.get(refs, timeout=req.get("timeout"))
+
+        if op == "wait":
+            refs = [self._ref(s, r) for r in req["refs"]]
+            by_ref = {ref: rid for rid, ref in s.refs.items()}
+            ready, pending = ray_tpu.wait(
+                refs, num_returns=req["num_returns"],
+                timeout=req.get("timeout"))
+            return ([by_ref[r] for r in ready],
+                    [by_ref[r] for r in pending])
+
+        if op == "call_fn":
+            fn = self._function(s, req)
+            args, kwargs = self._resolve_args(s, req)
+            opts = req.get("options") or {}
+            rf = ray_tpu.remote(**opts)(fn) if opts else ray_tpu.remote(fn)
+            return self._submit_result(s, rf.remote(*args, **kwargs))
+
+        if op == "create_actor":
+            cls = self._cls(s, req)
+            args, kwargs = self._resolve_args(s, req)
+            opts = req.get("options") or {}
+            ac = ray_tpu.remote(**opts)(cls) if opts else ray_tpu.remote(cls)
+            handle = ac.remote(*args, **kwargs)
+            actor_id = uuid.uuid4().hex
+            s.actors[actor_id] = handle
+            # Detached actors outlive their creator by contract.
+            if (req.get("options") or {}).get("lifetime") != "detached":
+                s.owned_actors.add(actor_id)
+            return actor_id
+
+        if op == "actor_call":
+            handle = s.actors[req["actor_id"]]
+            args, kwargs = self._resolve_args(s, req)
+            opts = req.get("options") or {}
+            method = getattr(handle, req["method"])
+            if opts:
+                method = method.options(**opts)
+            return self._submit_result(s, method.remote(*args, **kwargs))
+
+        if op == "kill_actor":
+            handle = s.actors.pop(req["actor_id"], None)
+            if handle is not None:
+                ray_tpu.kill(handle,
+                             no_restart=req.get("no_restart", True))
+            return None
+
+        if op == "get_named_actor":
+            handle = ray_tpu.get_actor(req["name"])
+            actor_id = uuid.uuid4().hex
+            s.actors[actor_id] = handle
+            return actor_id
+
+        if op == "cancel":
+            ray_tpu.cancel(self._ref(s, req["ref"]),
+                           force=req.get("force", False))
+            return None
+
+        if op == "release":
+            for rid in req["refs"]:
+                s.refs.pop(rid, None)
+            return None
+
+        if op == "cluster_resources":
+            return ray_tpu.cluster_resources()
+
+        if op == "available_resources":
+            return ray_tpu.available_resources()
+
+        raise ValueError(f"unknown client op {op!r}")
+
+    # -- helpers --------------------------------------------------------
+    def _submit_result(self, s: _Session, out):
+        from ray_tpu import ObjectRefGenerator
+
+        if isinstance(out, ObjectRefGenerator):
+            raise NotImplementedError(
+                "streaming generators are not supported over client "
+                "mode yet; use num_returns=<int>")
+        if isinstance(out, (list, tuple)):
+            return {"refs": [self._track(s, r) for r in out]}
+        return {"ref": self._track(s, out)}
+
+    def _track(self, s: _Session, ref) -> str:
+        rid = uuid.uuid4().hex
+        s.refs[rid] = ref
+        return rid
+
+    def _ref(self, s: _Session, rid: str):
+        if rid not in s.refs:
+            raise KeyError(f"unknown (or released) client ref {rid}")
+        return s.refs[rid]
+
+    def _resolve_args(self, s: _Session, req):
+        def sub(x):
+            if isinstance(x, ClientObjectRef):
+                return self._ref(s, x.ref_id)
+            if isinstance(x, ClientActorRef):
+                return s.actors[x.actor_id]
+            return x
+
+        args = tree_substitute(list(req.get("args") or ()), sub)
+        kwargs = tree_substitute(req.get("kwargs") or {}, sub)
+        return tuple(args), kwargs
+
+    def _function(self, s: _Session, req):
+        import cloudpickle
+
+        if "fn_hash" in req and req["fn_hash"] in s.functions:
+            return s.functions[req["fn_hash"]]
+        fn = cloudpickle.loads(req["fn_bytes"])
+        h = req.get("fn_hash") or hashlib.sha256(
+            req["fn_bytes"]).hexdigest()
+        s.functions[h] = fn
+        return fn
+
+    def _cls(self, s: _Session, req):
+        import cloudpickle
+
+        if "cls_hash" in req and req["cls_hash"] in s.classes:
+            return s.classes[req["cls_hash"]]
+        cls = cloudpickle.loads(req["cls_bytes"])
+        h = req.get("cls_hash") or hashlib.sha256(
+            req["cls_bytes"]).hexdigest()
+        s.classes[h] = cls
+        return cls
+
+
+def _picklable_error(e: BaseException):
+    import cloudpickle
+
+    try:
+        cloudpickle.dumps(e)
+        return e
+    except Exception:  # noqa: BLE001
+        return RuntimeError(f"{type(e).__name__}: {e}")

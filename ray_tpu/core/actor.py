@@ -77,6 +77,11 @@ class ActorClass:
         )
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        from ..client import get_client
+
+        c = get_client()
+        if c is not None:
+            return c.create_actor(self._cls, args, kwargs, self._opts)
         actor_id = global_runtime().create_actor(
             self._cls, args, kwargs, self._opts)
         return ActorHandle(actor_id)
@@ -102,4 +107,9 @@ def exit_actor():
 
 
 def get_actor(name: str) -> ActorHandle:
+    from ..client import get_client
+
+    c = get_client()
+    if c is not None:
+        return c.get_named_actor(name)
     return ActorHandle(global_runtime().get_actor(name))

@@ -37,6 +37,11 @@ class RemoteFunction:
         )
 
     def remote(self, *args, **kwargs):
+        from ..client import get_client
+
+        c = get_client()
+        if c is not None:
+            return c.call_function(self._func, args, kwargs, self._opts)
         return global_runtime().submit_task(
             self._func, self._get_descriptor(), args, kwargs, self._opts)
 

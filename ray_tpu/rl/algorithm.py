@@ -74,10 +74,12 @@ class Algorithm:
             start = get_checkpoint()
             if start is not None:
                 algo.restore(start.as_directory())
+            # One directory per trial run, overwritten each iteration —
+            # a dir per report would pile up in /tmp.
+            path = _tempfile.mkdtemp(prefix="rl_ckpt_")
             try:
                 for _ in range(getattr(cfg, "train_iterations", 10)):
                     res = algo.step()
-                    path = _tempfile.mkdtemp(prefix="rl_ckpt_")
                     algo.save(path)
                     report(res, checkpoint=Checkpoint(path))
             finally:
