@@ -113,6 +113,8 @@ _D("scheduler_top_k_fraction", float, 0.2,
    "Hybrid policy: best node among a random top-k fraction.")
 _D("lineage_max_bytes", int, 256 * 1024**2, "Lineage table soft cap.")
 _D("enable_timeline", bool, True, "Record task timeline events.")
+_D("log_to_driver", bool, True,
+   "Tail spawned-worker logs back to the driver's stderr.")
 _D("task_event_buffer_max", int, 100_000, "Max buffered task state events.")
 _D("gang_schedule_timeout_s", float, 60.0,
    "Timeout for atomically acquiring all bundles of a placement group.")

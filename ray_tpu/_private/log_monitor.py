@@ -1,0 +1,92 @@
+"""Log monitor — tails session worker logs back to the driver.
+
+Capability-equivalent of the reference's LogMonitor
+(reference: python/ray/_private/log_monitor.py:103 — tails
+/tmp/ray/session_*/logs/*, publishes lines to the driver, which prints
+them with a worker prefix; `log_to_driver`): here a daemon thread polls
+the session's logs/ directory and forwards appended lines to a sink
+(default: driver stdout with an `(worker N)` prefix).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+DEFAULT_POLL_S = 0.2
+
+
+class LogMonitor:
+    def __init__(self, logs_dir: str,
+                 sink: Optional[Callable[[str, str], None]] = None,
+                 poll_interval: float = DEFAULT_POLL_S):
+        self.logs_dir = logs_dir
+        self.sink = sink or self._print_sink
+        self.poll = poll_interval
+        self._offsets: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "LogMonitor":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ray-tpu-log-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.poll + 1)
+        self.poll_once()  # final drain so shutdown doesn't drop lines
+
+    # -- tailing --------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - never kill the tailer
+                pass
+
+    def poll_once(self) -> int:
+        """Forward any newly appended lines. → number of lines."""
+        n = 0
+        for path in sorted(glob.glob(os.path.join(self.logs_dir, "*"))):
+            if not os.path.isfile(path):
+                continue
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            offset = self._offsets.get(path, 0)
+            if size <= offset:
+                if size < offset:  # truncated/rotated: restart
+                    self._offsets[path] = 0
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(size - offset)
+            except OSError:
+                continue
+            # Only complete lines; keep the partial tail for next poll
+            # (binary offsets — text decoding must not skew them).
+            done = data.rfind(b"\n")
+            if done < 0:
+                continue
+            self._offsets[path] = offset + done + 1
+            source = os.path.basename(path)
+            for line in data[:done].decode("utf-8", "replace").split("\n"):
+                self.sink(source, line)
+                n += 1
+        return n
+
+    @staticmethod
+    def _print_sink(source: str, line: str) -> None:
+        # "(worker-3.out) hello" — mirrors the reference's
+        # "(pid=...) hello" driver echo.
+        print(f"({source}) {line}", file=sys.stderr, flush=True)

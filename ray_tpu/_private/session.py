@@ -1,0 +1,57 @@
+"""Per-runtime session directory.
+
+Capability-equivalent of the reference's session layout
+(reference: python/ray/_private/node.py — /tmp/ray/session_<ts>_<pid>/
+with logs/ underneath, tailed by the log monitor and served by the
+dashboard's log viewer): every runtime init gets a fresh directory; it
+is left on disk at shutdown for postmortem inspection.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_session_dir: Optional[str] = None
+
+BASE = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+
+
+def new_session() -> str:
+    """Create and activate a fresh session directory."""
+    global _session_dir
+    ts = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S-%f")
+    path = os.path.join(BASE, f"session_{ts}_{os.getpid()}")
+    os.makedirs(os.path.join(path, "logs"), exist_ok=True)
+    with _lock:
+        _session_dir = path
+    # "session_latest" convenience symlink (reference keeps the same).
+    link = os.path.join(BASE, "session_latest")
+    try:
+        if os.path.islink(link) or os.path.exists(link):
+            os.remove(link)
+        os.symlink(path, link)
+    except OSError:
+        pass
+    return path
+
+
+def session_dir() -> str:
+    """Active session dir (creating one if the runtime never did)."""
+    with _lock:
+        if _session_dir is not None:
+            return _session_dir
+    return new_session()
+
+
+def logs_dir() -> str:
+    return os.path.join(session_dir(), "logs")
+
+
+def clear_session() -> None:
+    global _session_dir
+    with _lock:
+        _session_dir = None

@@ -1,0 +1,61 @@
+"""Usage stats — local-only, opt-out recording stub.
+
+Capability-equivalent of the reference's usage reporting
+(reference: python/ray/_private/usage/usage_lib.py — opt-out telemetry
+ping with cluster metadata). This environment has zero egress, so the
+capability is reduced to its honest core: collect the same shape of
+report and write it to the session directory; nothing ever leaves the
+machine. Disable with RAY_TPU_USAGE_STATS_ENABLED=0 (reference env:
+RAY_USAGE_STATS_ENABLED).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import threading
+import time
+from typing import Any, Dict
+
+_lock = threading.Lock()
+_features: set = set()
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") not in (
+        "0", "false", "False")
+
+
+def record_library_usage(feature: str) -> None:
+    """Tag a library/feature as used this session (reference:
+    usage_lib.record_library_usage)."""
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _features.add(feature)
+
+
+def build_report() -> Dict[str, Any]:
+    import ray_tpu
+
+    with _lock:
+        feats = sorted(_features)
+    return {
+        "schema_version": 1,
+        "timestamp": time.time(),
+        "version": ray_tpu.__version__,
+        "python_version": platform.python_version(),
+        "os": platform.system().lower(),
+        "libraries_used": feats,
+    }
+
+
+def write_report() -> str:
+    """Persist the report into the session dir (no network egress)."""
+    from .session import session_dir
+
+    path = os.path.join(session_dir(), "usage_stats.json")
+    with open(path, "w") as f:
+        json.dump(build_report(), f, indent=1)
+    return path

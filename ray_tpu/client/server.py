@@ -35,6 +35,7 @@ class _Session:
         # Actors this session CREATED (killed at teardown) vs handles it
         # merely looked up via get_named_actor (must survive the session).
         self.owned_actors: set = set()
+        self.named_lookups: Dict[str, str] = {}  # name -> actor_id
         self.functions: Dict[str, Any] = {}     # fn_hash -> callable
         self.classes: Dict[str, type] = {}      # cls_hash -> class
 
@@ -50,6 +51,8 @@ class ClientServer:
         self._init_kwargs = init_kwargs
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "ClientServer":
@@ -81,6 +84,18 @@ class ClientServer:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        # Sever live sessions too — stop() must actually stop serving.
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     @property
     def address(self) -> str:
@@ -89,6 +104,8 @@ class ClientServer:
     # -- per-connection loop -------------------------------------------
     def _serve_connection(self, sock: socket.socket) -> None:
         session = _Session()
+        with self._conns_lock:
+            self._conns.add(sock)
         try:
             while True:
                 try:
@@ -104,7 +121,18 @@ class ClientServer:
                     send_msg(sock, resp)
                 except ConnectionError:
                     return
+                except Exception as e:  # noqa: BLE001
+                    # Unpicklable RESULT value: report it as an error
+                    # instead of tearing the whole session down.
+                    try:
+                        send_msg(sock, {"ok": False, "error": RuntimeError(
+                            f"result not serializable over client mode: "
+                            f"{type(e).__name__}: {e}")})
+                    except Exception:  # noqa: BLE001
+                        return
         finally:
+            with self._conns_lock:
+                self._conns.discard(sock)
             self._teardown(session)
 
     def _teardown(self, session: _Session) -> None:
@@ -185,9 +213,15 @@ class ClientServer:
             return None
 
         if op == "get_named_actor":
-            handle = ray_tpu.get_actor(req["name"])
+            name = req["name"]
+            # Dedup repeated lookups: one session entry per name.
+            cached = s.named_lookups.get(name)
+            if cached is not None and cached in s.actors:
+                return cached
+            handle = ray_tpu.get_actor(name)
             actor_id = uuid.uuid4().hex
             s.actors[actor_id] = handle
+            s.named_lookups[name] = actor_id
             return actor_id
 
         if op == "cancel":

@@ -16,6 +16,7 @@ Actor calls bypass the scheduler and go straight to the actor's mailbox
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -89,13 +90,17 @@ class TaskEventBuffer:
 
     def record(self, name: str, phase_start: float, phase_end: float,
                node_id: str, task_id: str, category: str = "task"):
-        if not config.enable_timeline:
-            return
-        ev = {
+        self.record_raw({
             "name": name, "cat": category, "ph": "X",
             "ts": phase_start * 1e6, "dur": (phase_end - phase_start) * 1e6,
             "pid": node_id, "tid": task_id,
-        }
+        })
+
+    def record_raw(self, ev: dict) -> None:
+        """Append a pre-built chrome-trace event (tasks + tracing spans).
+        Honors the enable_timeline gate."""
+        if not config.enable_timeline:
+            return
         with self._lock:
             if len(self._events) >= config.task_event_buffer_max:
                 self._events.pop(0)
@@ -675,7 +680,6 @@ class Runtime:
             self.shm = None
 
         if num_cpus is None:
-            import os
             num_cpus = float(os.cpu_count() or 1)
         if num_tpus is None:
             num_tpus = float(self._detect_tpus())
@@ -690,19 +694,32 @@ class Runtime:
         )
         self.scheduler.add_node(head)
 
+        # Session directory: logs + usage stats + spill live here
+        # (reference: /tmp/ray/session_*/; _private/node.py).
+        from .._private import session as _session
+
+        self.session_dir = _session.new_session()
+
         # Out-of-process execution plane: spawned worker processes behind
         # a pool node (see worker_proc.py). Objects ride the shared shm
         # store; only ids cross the sockets.
         self.worker_pool = None
+        self.log_monitor = None
         if num_worker_procs > 0:
             from .worker_proc import WorkerPool
 
             self.worker_pool = WorkerPool(
                 num_worker_procs,
-                shm_name=(self._shm_name if self.shm is not None else None))
+                shm_name=(self._shm_name if self.shm is not None else None),
+                logs_dir=os.path.join(self.session_dir, "logs"))
             self.scheduler.add_node(ProcNodeState(
                 "node-procs", ResourceSet({CPU: float(num_worker_procs)}),
                 self.worker_pool))
+            if config.log_to_driver:
+                from .._private.log_monitor import LogMonitor
+
+                self.log_monitor = LogMonitor(
+                    os.path.join(self.session_dir, "logs")).start()
 
     @staticmethod
     def _detect_tpus() -> int:
@@ -1447,6 +1464,15 @@ class Runtime:
 
     def shutdown(self):
         self._shutdown = True
+        if self.log_monitor is not None:
+            try:
+                self.log_monitor.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            self.log_monitor = None
+        from .._private import session as _session
+
+        _session.clear_session()
         self._gc_queue.put(None)
         # The GC thread touches the shm mapping — it must finish before
         # munmap, or a queued delete dereferences unmapped memory.

@@ -170,10 +170,12 @@ class WorkerPool:
     Dead workers are discarded and respawned to keep capacity."""
 
     def __init__(self, num_workers: int, *, shm_name: Optional[str],
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 logs_dir: Optional[str] = None):
         self.num_workers = num_workers
         self.shm_name = shm_name
         self._env = env
+        self._logs_dir = logs_dir
         self._idle: "queue.Queue[WorkerProcess]" = queue.Queue()
         self._all: Dict[int, WorkerProcess] = {}
         self._lock = threading.Lock()
@@ -271,7 +273,20 @@ class WorkerPool:
             env.update(self._env)
         # Workers must not grab the (single) TPU chip the driver owns.
         env.setdefault("JAX_PLATFORMS", "cpu")
-        proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd())
+        # Worker stdout/stderr go to per-worker session log files, tailed
+        # back to the driver by the LogMonitor (reference: the raylet
+        # redirects worker logs under /tmp/ray/session_*/logs).
+        stdout = stderr = None
+        if self._logs_dir:
+            stdout = open(os.path.join(
+                self._logs_dir, f"worker-{wid}.out"), "ab", buffering=0)
+            stderr = open(os.path.join(
+                self._logs_dir, f"worker-{wid}.err"), "ab", buffering=0)
+        proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd(),
+                                stdout=stdout, stderr=stderr)
+        if stdout is not None:
+            stdout.close()
+            stderr.close()
         try:
             conn = self._await_hello(wid, time.monotonic() + 30)
         except TimeoutError:

@@ -69,19 +69,30 @@ class Algorithm:
         from ..train.session import get_checkpoint, report
 
         def trainable(tune_config: Dict[str, Any]) -> None:
+            import collections
+            import shutil
+
             cfg = base_config.with_overrides(**tune_config)
             algo = cls(cfg)
             start = get_checkpoint()
             if start is not None:
                 algo.restore(start.as_directory())
-            # One directory per trial run, overwritten each iteration —
-            # a dir per report would pile up in /tmp.
-            path = _tempfile.mkdtemp(prefix="rl_ckpt_")
+            # Fresh dir per report (checkpoints must be immutable — PBT
+            # exploiters restore a donor's recorded path while the donor
+            # keeps training), retaining the trailing 2 so the recorded
+            # latest is never deleted under a reader, without piling up
+            # one dir per iteration in /tmp.
+            recent: "collections.deque" = collections.deque()
             try:
                 for _ in range(getattr(cfg, "train_iterations", 10)):
                     res = algo.step()
+                    path = _tempfile.mkdtemp(prefix="rl_ckpt_")
                     algo.save(path)
                     report(res, checkpoint=Checkpoint(path))
+                    recent.append(path)
+                    while len(recent) > 2:
+                        shutil.rmtree(recent.popleft(),
+                                      ignore_errors=True)
             finally:
                 algo.stop()
 

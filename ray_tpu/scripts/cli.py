@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import urllib.request
 from typing import Any, Optional
@@ -86,6 +87,30 @@ def cmd_timeline(args) -> int:
         json.dump(events, f)
     print(f"Wrote {len(events)} events to {out} "
           "(chrome://tracing compatible)")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """List or print session logs (reference: `ray logs` state CLI)."""
+    import glob as _glob
+
+    from .._private.session import BASE
+
+    session = args.session or os.path.join(BASE, "session_latest")
+    logs = os.path.join(session, "logs")
+    if not os.path.isdir(logs):
+        print(f"No session logs at {logs}")
+        return 1
+    if args.filename is None:
+        for p in sorted(_glob.glob(os.path.join(logs, "*"))):
+            print(f"{os.path.getsize(p):>10}  {os.path.basename(p)}")
+        return 0
+    path = os.path.join(logs, args.filename)
+    with open(path, "r", errors="replace") as f:
+        lines = f.readlines()
+    if args.tail:
+        lines = lines[-args.tail:]
+    sys.stdout.writelines(lines)
     return 0
 
 
@@ -170,6 +195,16 @@ def build_parser() -> argparse.ArgumentParser:
     tp.set_defaults(fn=cmd_timeline)
 
     sub.add_parser("memory").set_defaults(fn=cmd_memory)
+
+    lg = sub.add_parser("logs",
+                        help="list/print session log files")
+    lg.add_argument("filename", nargs="?", default=None,
+                    help="log file to print (omit to list)")
+    lg.add_argument("--session", default=None,
+                    help="session dir (default: session_latest)")
+    lg.add_argument("--tail", type=int, default=0,
+                    help="print only the last N lines")
+    lg.set_defaults(fn=cmd_logs)
 
     mb = sub.add_parser("microbenchmark")
     mb.add_argument("--quick", action="store_true")

@@ -4,4 +4,4 @@
 from .actor_pool import ActorPool
 from .queue import Queue
 
-__all__ = ["ActorPool", "Queue", "collective", "metrics"]
+__all__ = ["ActorPool", "Queue", "collective", "metrics", "tracing"]

@@ -1,0 +1,132 @@
+"""Tracing + profiling.
+
+Capability-equivalent of the reference's tracing/profiling stack
+(reference: python/ray/util/tracing/tracing_helper.py — opt-in span
+decorators around .remote() and execution, context propagated in task
+specs; _private/profiling.py + `ray timeline` for chrome traces;
+dashboard's py-spy hooks for CPU profiles):
+
+- span(name): context manager recording a chrome-trace span into the
+  runtime's task-event buffer, with parent links via a contextvar.
+- setup_tracing(hook): register an exporter callback invoked with every
+  finished span (the reference's _tracing_startup_hook analog); also
+  reads RAY_TPU_TRACING_HOOK="module:function" at init.
+- profile_tpu(logdir): the TPU-native profiler — wraps jax.profiler
+  (xprof/tensorboard trace), replacing the reference's py-spy path.
+- export_chrome_trace(path): dump everything `ray timeline`-style.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+_current_span: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("ray_tpu_span", default=None)
+
+_hooks: List[Callable[[Dict[str, Any]], None]] = []
+_hooks_lock = threading.Lock()
+_env_hook_added = False
+
+
+def setup_tracing(hook: Optional[Callable[[Dict[str, Any]], None]] = None
+                  ) -> None:
+    """Enable span export. `hook(span_dict)` runs for every finished
+    span. Also honors RAY_TPU_TRACING_HOOK=module:function."""
+    from .._private.config import config
+
+    global _env_hook_added
+
+    config.enable_timeline = True
+    with _hooks_lock:
+        if hook is not None:
+            _hooks.append(hook)
+    env = os.environ.get("RAY_TPU_TRACING_HOOK")
+    if env and ":" in env and not _env_hook_added:
+        mod, _, fn = env.partition(":")
+        import importlib
+
+        with _hooks_lock:
+            _hooks.append(getattr(importlib.import_module(mod), fn))
+            _env_hook_added = True
+
+
+def clear_tracing() -> None:
+    global _env_hook_added
+    with _hooks_lock:
+        _hooks.clear()
+        _env_hook_added = False
+
+
+@contextlib.contextmanager
+def span(name: str, category: str = "span", **attributes):
+    """Record a chrome-trace span; nests via contextvar parent links."""
+    span_id = uuid.uuid4().hex[:16]
+    parent = _current_span.get()
+    token = _current_span.set(span_id)
+    t0 = time.time()
+    try:
+        yield span_id
+    finally:
+        t1 = time.time()
+        _current_span.reset(token)
+        ev = {
+            "name": name, "cat": category, "ph": "X",
+            "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+            "pid": "driver", "tid": f"span:{span_id}",
+            "args": {"parent": parent, **attributes},
+        }
+        _record(ev)
+
+
+def _record(ev: Dict[str, Any]) -> None:
+    from ..core.runtime import global_runtime_or_none
+
+    rt = global_runtime_or_none()
+    if rt is not None:
+        rt.events.record_raw(ev)
+    with _hooks_lock:
+        hooks = list(_hooks)
+    for h in hooks:
+        try:
+            h(ev)
+        except Exception:  # noqa: BLE001 - exporters must not break apps
+            pass
+
+
+def current_span_id() -> Optional[str]:
+    return _current_span.get()
+
+
+def export_chrome_trace(path: str) -> int:
+    """Dump all runtime events (tasks + spans) as chrome://tracing JSON.
+    → number of events."""
+    import json
+
+    from ..core.runtime import global_runtime
+
+    events = global_runtime().timeline()
+    with open(path, "w") as f:
+        json.dump(events, f)
+    return len(events)
+
+
+@contextlib.contextmanager
+def profile_tpu(logdir: str, *, host_tracer_level: int = 2):
+    """TPU-native profiler capture: everything inside the block is
+    recorded by the jax/XLA profiler (view with tensorboard/xprof —
+    MXU utilisation, HBM traffic, ICI transfers). Replaces the
+    reference's py-spy/memray host profiling for device work."""
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()

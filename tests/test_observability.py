@@ -1,0 +1,203 @@
+"""Observability tests: session dirs, log monitor, tracing spans,
+usage stats (reference coverage model: python/ray/tests/test_logging.py
+log-monitor tests, test_tracing.py, _private/usage tests)."""
+
+import json
+import os
+import time
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# Session dirs
+# ---------------------------------------------------------------------------
+
+def test_session_dir_created(ray_start):
+    from ray_tpu.core.runtime import global_runtime
+
+    sd = global_runtime().session_dir
+    assert os.path.isdir(os.path.join(sd, "logs"))
+    assert "session_" in os.path.basename(sd)
+
+
+def test_session_latest_symlink(ray_start):
+    from ray_tpu._private.session import BASE
+    from ray_tpu.core.runtime import global_runtime
+
+    link = os.path.join(BASE, "session_latest")
+    assert os.path.realpath(link) == os.path.realpath(
+        global_runtime().session_dir)
+
+
+# ---------------------------------------------------------------------------
+# Log monitor
+# ---------------------------------------------------------------------------
+
+def test_log_monitor_tails_appended_lines(tmp_path):
+    from ray_tpu._private.log_monitor import LogMonitor
+
+    seen = []
+    mon = LogMonitor(str(tmp_path), sink=lambda src, ln: seen.append(
+        (src, ln)))
+    with open(tmp_path / "worker-0.out", "w") as f:
+        f.write("hello\nworld\npartial")
+        f.flush()
+    mon.poll_once()
+    assert ("worker-0.out", "hello") in seen
+    assert ("worker-0.out", "world") in seen
+    assert all(ln != "partial" for _, ln in seen)  # incomplete line held
+    with open(tmp_path / "worker-0.out", "a") as f:
+        f.write(" line\n")
+    mon.poll_once()
+    assert ("worker-0.out", "partial line") in seen
+
+
+def test_log_monitor_multibyte_offsets(tmp_path):
+    from ray_tpu._private.log_monitor import LogMonitor
+
+    seen = []
+    mon = LogMonitor(str(tmp_path), sink=lambda s, ln: seen.append(ln))
+    with open(tmp_path / "w.out", "w", encoding="utf-8") as f:
+        f.write("héllo wörld ✓\n")
+    mon.poll_once()
+    with open(tmp_path / "w.out", "a", encoding="utf-8") as f:
+        f.write("second\n")
+    mon.poll_once()
+    assert seen == ["héllo wörld ✓", "second"]
+
+
+def test_worker_proc_logs_flow_to_session(ray_start_cluster):
+    """Spawned workers' prints land in session log files."""
+    import ray_tpu
+    from ray_tpu.core.runtime import global_runtime
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0, num_worker_procs=1)
+    try:
+        @ray_tpu.remote
+        def noisy():
+            print("FINDME-log-line", flush=True)
+            return 1
+
+        # Route to the proc pool by requiring its node's resources.
+        import ray_tpu.core.task as task_mod
+
+        strategy = ray_tpu.NodeAffinitySchedulingStrategy(
+            node_id="node-procs", soft=False)
+        assert ray_tpu.get(noisy.options(
+            scheduling_strategy=strategy).remote()) == 1
+        logs_dir = os.path.join(global_runtime().session_dir, "logs")
+        deadline = time.time() + 10
+        found = False
+        while time.time() < deadline and not found:
+            for name in os.listdir(logs_dir):
+                with open(os.path.join(logs_dir, name),
+                          errors="replace") as f:
+                    if "FINDME-log-line" in f.read():
+                        found = True
+                        break
+            time.sleep(0.1)
+        assert found, f"worker print not found in {logs_dir}"
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Tracing
+# ---------------------------------------------------------------------------
+
+def test_span_records_into_timeline(ray_start):
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    with tracing.span("outer", kind="test"):
+        with tracing.span("inner"):
+            pass
+    events = ray_tpu.timeline()
+    spans = [e for e in events if e.get("cat") == "span"]
+    names = {e["name"] for e in spans}
+    assert {"outer", "inner"} <= names
+    inner = next(e for e in spans if e["name"] == "inner")
+    outer = next(e for e in spans if e["name"] == "outer")
+    # Parent link threads through the contextvar.
+    assert inner["args"]["parent"] == outer["tid"].split("span:")[1]
+    assert outer["args"]["kind"] == "test"
+
+
+def test_tracing_hook_exporter(ray_start):
+    from ray_tpu.util import tracing
+
+    exported = []
+    tracing.setup_tracing(exported.append)
+    try:
+        with tracing.span("hooked"):
+            pass
+        assert any(e["name"] == "hooked" for e in exported)
+    finally:
+        tracing.clear_tracing()
+
+
+def test_export_chrome_trace(ray_start, tmp_path):
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    with tracing.span("alongside"):
+        pass
+    out = str(tmp_path / "trace.json")
+    n = tracing.export_chrome_trace(out)
+    assert n >= 1
+    events = json.load(open(out))
+    assert all("ts" in e and "ph" in e for e in events)
+
+
+# ---------------------------------------------------------------------------
+# Usage stats
+# ---------------------------------------------------------------------------
+
+def test_usage_stats_report(ray_start, monkeypatch):
+    from ray_tpu._private import usage_stats
+
+    usage_stats.record_library_usage("data")
+    usage_stats.record_library_usage("tune")
+    report = usage_stats.build_report()
+    assert {"data", "tune"} <= set(report["libraries_used"])
+    path = usage_stats.write_report()
+    assert os.path.exists(path)
+    on_disk = json.load(open(path))
+    assert on_disk["schema_version"] == 1
+
+
+def test_usage_stats_opt_out(monkeypatch):
+    from ray_tpu._private import usage_stats
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    before = set(usage_stats.build_report()["libraries_used"])
+    usage_stats.record_library_usage("should-not-appear")
+    assert "should-not-appear" not in set(
+        usage_stats.build_report()["libraries_used"]) - before | before
+
+
+# ---------------------------------------------------------------------------
+# CLI logs
+# ---------------------------------------------------------------------------
+
+def test_cli_logs_lists_and_prints(ray_start, capsys):
+    from ray_tpu.core.runtime import global_runtime
+    from ray_tpu.scripts.cli import main
+
+    sd = global_runtime().session_dir
+    with open(os.path.join(sd, "logs", "worker-9.out"), "w") as f:
+        f.write("alpha\nbeta\ngamma\n")
+    assert main(["logs", "--session", sd]) == 0
+    out = capsys.readouterr().out
+    assert "worker-9.out" in out
+    assert main(["logs", "--session", sd, "worker-9.out",
+                 "--tail", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "beta\ngamma" in out and "alpha" not in out
