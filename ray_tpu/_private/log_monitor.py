@@ -74,15 +74,19 @@ class LogMonitor:
             except OSError:
                 continue
             # Only complete lines; keep the partial tail for next poll
-            # (binary offsets — text decoding must not skew them).
-            done = data.rfind(b"\n")
+            # (binary offsets — text decoding must not skew them). \r
+            # counts as a terminator too, or progress-bar output would
+            # be re-read forever and never forwarded.
+            done = max(data.rfind(b"\n"), data.rfind(b"\r"))
             if done < 0:
                 continue
             self._offsets[path] = offset + done + 1
             source = os.path.basename(path)
-            for line in data[:done].decode("utf-8", "replace").split("\n"):
-                self.sink(source, line)
-                n += 1
+            text = data[:done].decode("utf-8", "replace")
+            for line in text.replace("\r", "\n").split("\n"):
+                if line:
+                    self.sink(source, line)
+                    n += 1
         return n
 
     @staticmethod

@@ -214,11 +214,12 @@ class ClientServer:
 
         if op == "get_named_actor":
             name = req["name"]
-            # Dedup repeated lookups: one session entry per name.
+            handle = ray_tpu.get_actor(name)  # always re-resolve: the
+            # name may now point at a replacement actor.
             cached = s.named_lookups.get(name)
-            if cached is not None and cached in s.actors:
+            if cached is not None and cached in s.actors and \
+                    s.actors[cached]._actor_id == handle._actor_id:
                 return cached
-            handle = ray_tpu.get_actor(name)
             actor_id = uuid.uuid4().hex
             s.actors[actor_id] = handle
             s.named_lookups[name] = actor_id

@@ -106,12 +106,32 @@ def cmd_logs(args) -> int:
             print(f"{os.path.getsize(p):>10}  {os.path.basename(p)}")
         return 0
     path = os.path.join(logs, args.filename)
-    with open(path, "r", errors="replace") as f:
-        lines = f.readlines()
+    if not os.path.isfile(path):
+        print(f"No such log file: {path}")
+        return 1
     if args.tail:
-        lines = lines[-args.tail:]
-    sys.stdout.writelines(lines)
+        sys.stdout.writelines(_tail_lines(path, args.tail))
+    else:
+        with open(path, "r", errors="replace") as f:
+            for line in f:
+                sys.stdout.write(line)
     return 0
+
+
+def _tail_lines(path: str, n: int) -> list:
+    """Last n lines by reading backward in blocks (no full-file read)."""
+    block = 1 << 16
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        end = f.tell()
+        data = b""
+        while end > 0 and data.count(b"\n") <= n:
+            start = max(0, end - block)
+            f.seek(start)
+            data = f.read(end - start) + data
+            end = start
+    lines = data.decode("utf-8", "replace").splitlines(keepends=True)
+    return lines[-n:]
 
 
 def cmd_memory(args) -> int:

@@ -136,6 +136,22 @@ class _TrialWorker:
                           "__stopped_early__": stopped["early"]}, None, 0)
 
 
+def _snapshot_checkpoint(ckpt):
+    """Copy a (possibly shared, possibly soon-deleted) checkpoint dir to
+    a private temp dir; None if it vanished."""
+    import shutil
+    import tempfile
+
+    if ckpt is None:
+        return None
+    try:
+        dst = tempfile.mkdtemp(prefix="tune_exploit_")
+        shutil.copytree(ckpt.as_directory(), dst, dirs_exist_ok=True)
+        return Checkpoint(dst)
+    except (FileNotFoundError, OSError):
+        return None
+
+
 class Tuner:
     def __init__(self, trainable: Callable, *,
                  param_space: Optional[Dict[str, Any]] = None,
@@ -265,7 +281,13 @@ class Tuner:
                             pass
                     if exploit is None:
                         break
-                    config, start_ckpt = exploit
+                    config, donor_ckpt = exploit
+                    # Snapshot the donor's checkpoint NOW: the donor
+                    # trial keeps training and may rotate/delete the
+                    # recorded directory before our new worker restores.
+                    start_ckpt = _snapshot_checkpoint(donor_ckpt)
+                    if start_ckpt is None:
+                        break  # donor checkpoint gone; keep own progress
                     tr.config = config
                     tr.stopped_early = False
                     exploits += 1

@@ -4,4 +4,5 @@
 from .actor_pool import ActorPool
 from .queue import Queue
 
-__all__ = ["ActorPool", "Queue", "collective", "metrics", "tracing"]
+__all__ = ["ActorPool", "Queue", "collective", "metrics", "tracing",
+           "multiprocessing", "joblib"]

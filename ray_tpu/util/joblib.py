@@ -1,0 +1,70 @@
+"""joblib backend running Parallel() jobs on the runtime.
+
+Capability-equivalent to the reference's ``ray.util.joblib``
+(reference: python/ray/util/joblib/__init__.py register_ray +
+ray_backend.py RayBackend over the multiprocessing Pool): after
+``register_ray_tpu()``, ``joblib.parallel_backend("ray_tpu")`` routes
+scikit-learn / joblib.Parallel workloads onto ray_tpu actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def register_ray_tpu() -> None:
+    """Register the 'ray_tpu' joblib backend (call once)."""
+    try:
+        from joblib.parallel import register_parallel_backend
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "joblib is required for the ray_tpu joblib backend") from e
+    register_parallel_backend("ray_tpu", _make_backend_class())
+
+
+def _make_backend_class():
+    from joblib._parallel_backends import MultiprocessingBackend
+
+    from .multiprocessing import Pool
+
+    class RayTpuBackend(MultiprocessingBackend):
+        """joblib backend: MultiprocessingBackend with the pool swapped
+        for the actor-based Pool (same shape as the reference's
+        RayBackend, ray_backend.py:10)."""
+
+        supports_timeout = True
+
+        def effective_n_jobs(self, n_jobs: int) -> int:
+            import ray_tpu
+
+            if n_jobs == 1:
+                return 1
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1)) \
+                if ray_tpu.is_initialized() else None
+            if n_jobs is None or n_jobs == -1:
+                return cpus or super().effective_n_jobs(-1)
+            if n_jobs < 0:
+                # joblib semantics: -2 = all CPUs but one, etc.
+                base = cpus or super().effective_n_jobs(-1)
+                return max(1, base + 1 + n_jobs)
+            return n_jobs
+
+        def configure(self, n_jobs: int = 1, parallel: Any = None,
+                      prefer: Optional[str] = None,
+                      require: Optional[str] = None,
+                      idle_worker_timeout: Optional[float] = None,
+                      **memmappingpool_args) -> int:
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+        def terminate(self) -> None:
+            if getattr(self, "_pool", None) is not None:
+                self._pool.terminate()
+                self._pool = None
+
+        def apply_async(self, func: Any, callback: Any = None) -> Any:
+            return self._pool.apply_async(func, callback=callback)
+
+    return RayTpuBackend

@@ -1,0 +1,271 @@
+"""multiprocessing.Pool drop-in over the task/actor runtime.
+
+Capability-equivalent to the reference's ``ray.util.multiprocessing``
+(reference: python/ray/util/multiprocessing/pool.py — Pool with
+apply/apply_async/map/map_async/imap/imap_unordered/starmap over actor
+workers): each pool worker is an actor that executes submitted
+callables; results come back through object refs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+
+class TimeoutError(Exception):  # noqa: A001 - mirrors mp.TimeoutError
+    pass
+
+
+class _PoolWorker:
+    """Actor executing pool callables (reference: pool.py PoolActor)."""
+
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run(self, fn: Callable, args: tuple, kwargs: dict):
+        return fn(*args, **(kwargs or {}))
+
+    def run_batch(self, fn: Callable, chunk: List[tuple]):
+        return [fn(*args) for args in chunk]
+
+
+class AsyncResult:
+    """Mirror of multiprocessing.pool.AsyncResult.
+
+    Resolution is lazy — results are fetched in get()/wait() on the
+    caller's thread; a background collector thread is spawned ONLY when
+    a callback is registered (a thread per fan-out call would not scale
+    the way the stdlib's single result-handler does)."""
+
+    def __init__(self, refs, single: bool,
+                 callback=None, error_callback=None):
+        self._refs = refs if isinstance(refs, list) else [refs]
+        self._single = single
+        self._callback = callback
+        self._error_callback = error_callback
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        if callback is not None or error_callback is not None:
+            threading.Thread(target=self._finalize, daemon=True).start()
+
+    def _shape(self, out: List[Any]) -> Any:
+        return out[0] if self._single else out
+
+    def _finalize(self, timeout: Optional[float] = None) -> None:
+        """Resolve (idempotent; safe from multiple threads)."""
+        if self._done.is_set():
+            return
+        import ray_tpu
+
+        try:
+            out = ray_tpu.get(self._refs, timeout=timeout)
+        except ray_tpu.GetTimeoutError:
+            raise TimeoutError("result not ready within timeout") from None
+        except BaseException as e:  # noqa: BLE001
+            with self._lock:
+                if self._done.is_set():
+                    return
+                self._error = e
+                self._done.set()
+            if self._error_callback is not None:
+                self._error_callback(e)
+            return
+        with self._lock:
+            if self._done.is_set():
+                return
+            self._value = self._shape(out)
+            self._done.set()
+        if self._callback is not None:
+            self._callback(self._value)
+
+    def ready(self) -> bool:
+        if self._done.is_set():
+            return True
+        import ray_tpu
+
+        ready, _ = ray_tpu.wait(self._refs,
+                                num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self._done.is_set() and not self.ready():
+            raise ValueError("result not ready")
+        self.wait()
+        return self._error is None
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        try:
+            self._finalize(timeout)
+        except TimeoutError:
+            pass
+
+    def get(self, timeout: Optional[float] = None):
+        self._finalize(timeout)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class Pool:
+    """Process-pool drop-in running on ray_tpu actors."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = (),
+                 ray_remote_args: Optional[dict] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            cpus = ray_tpu.cluster_resources().get("CPU", 1)
+            processes = max(1, int(cpus))
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._ray = ray_tpu
+        self._size = processes
+        opts = dict(ray_remote_args or {})
+        opts.setdefault("num_cpus", 1)
+        worker_cls = ray_tpu.remote(**opts)(_PoolWorker)
+        self._workers = [worker_cls.remote(initializer, initargs)
+                         for _ in range(processes)]
+        self._rr = itertools.count()
+        self._closed = False
+        self._pending: List[AsyncResult] = []
+        self._pending_lock = threading.Lock()
+
+    # -- helpers --------------------------------------------------------
+    def _next_worker(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+        return self._workers[next(self._rr) % self._size]
+
+    @staticmethod
+    def _chunks(iterable: Iterable, chunksize: int) -> List[List]:
+        out, cur = [], []
+        for item in iterable:
+            cur.append(item)
+            if len(cur) >= chunksize:
+                out.append(cur)
+                cur = []
+        if cur:
+            out.append(cur)
+        return out
+
+    def _auto_chunksize(self, n: int) -> int:
+        return max(1, n // (self._size * 4))
+
+    # -- apply ----------------------------------------------------------
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict = None, callback=None,
+                    error_callback=None) -> AsyncResult:
+        ref = self._next_worker().run.remote(fn, args, kwds or {})
+        return self._track(AsyncResult(
+            ref, single=True, callback=callback,
+            error_callback=error_callback))
+
+    def _track(self, result: AsyncResult) -> AsyncResult:
+        with self._pending_lock:
+            self._pending = [r for r in self._pending
+                             if not r._done.is_set()]
+            self._pending.append(result)
+        return result
+
+    # -- map ------------------------------------------------------------
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None, callback=None,
+                  error_callback=None) -> AsyncResult:
+        items = [(x,) for x in iterable]
+        return self._starmap_async(fn, items, chunksize, callback,
+                                   error_callback)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn: Callable, iterable: Iterable[tuple],
+                      chunksize: Optional[int] = None, callback=None,
+                      error_callback=None) -> AsyncResult:
+        return self._starmap_async(fn, list(iterable), chunksize,
+                                   callback, error_callback)
+
+    def _starmap_async(self, fn, items: List[tuple],
+                       chunksize: Optional[int], callback,
+                       error_callback) -> AsyncResult:
+        chunksize = chunksize or self._auto_chunksize(len(items))
+        chunks = self._chunks(items, chunksize)
+        refs = [self._next_worker().run_batch.remote(fn, chunk)
+                for chunk in chunks]
+        return self._track(_FlattenResult(
+            refs, single=False, callback=callback,
+            error_callback=error_callback))
+
+    # -- imap -----------------------------------------------------------
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: int = 1) -> Iterator[Any]:
+        refs = [self._next_worker().run_batch.remote(
+            fn, chunk) for chunk in self._chunks(
+                [(x,) for x in iterable], chunksize)]
+        for ref in refs:
+            for item in self._ray.get(ref):
+                yield item
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: int = 1) -> Iterator[Any]:
+        pending = {self._next_worker().run_batch.remote(fn, chunk)
+                   for chunk in self._chunks(
+                       [(x,) for x in iterable], chunksize)}
+        while pending:
+            ready, pending_list = self._ray.wait(
+                list(pending), num_returns=1)
+            pending = set(pending_list)
+            for item in self._ray.get(ready[0]):
+                yield item
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+        for w in self._workers:
+            try:
+                self._ray.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self._workers = []
+
+    def join(self) -> None:
+        """Wait for all outstanding async work (stdlib contract:
+        close() then join() guarantees every task finished)."""
+        if not self._closed:
+            raise ValueError("Pool is still running; call close() first")
+        with self._pending_lock:
+            pending = list(self._pending)
+        for r in pending:
+            r.wait()
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()
+
+
+class _FlattenResult(AsyncResult):
+    """AsyncResult over chunked batches, flattened in order."""
+
+    def _shape(self, out: List[Any]) -> Any:
+        return [x for batch in out for x in batch]

@@ -1,0 +1,114 @@
+"""Ecosystem-shim tests: multiprocessing.Pool drop-in + joblib backend
+(reference coverage model: python/ray/tests/test_multiprocessing.py,
+test_joblib.py)."""
+
+import time
+
+import pytest
+
+
+@pytest.fixture
+def pool(ray_start):
+    from ray_tpu.util.multiprocessing import Pool
+
+    p = Pool(processes=3)
+    yield p
+    p.terminate()
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+class TestPool:
+    def test_apply(self, pool):
+        assert pool.apply(_add, (2, 3)) == 5
+
+    def test_apply_async(self, pool):
+        r = pool.apply_async(_sq, (7,))
+        assert r.get(timeout=30) == 49
+        assert r.ready() and r.successful()
+
+    def test_apply_async_error(self, pool):
+        def boom():
+            raise RuntimeError("pool-boom")
+
+        r = pool.apply_async(boom)
+        with pytest.raises(Exception, match="pool-boom"):
+            r.get(timeout=30)
+        assert not r.successful()
+
+    def test_map(self, pool):
+        assert pool.map(_sq, range(10)) == [x * x for x in range(10)]
+
+    def test_map_chunked(self, pool):
+        out = pool.map(_sq, range(23), chunksize=4)
+        assert out == [x * x for x in range(23)]
+
+    def test_map_async_callback(self, pool):
+        got = []
+        r = pool.map_async(_sq, range(5), callback=got.append)
+        assert r.get(timeout=30) == [0, 1, 4, 9, 16]
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [[0, 1, 4, 9, 16]]
+
+    def test_starmap(self, pool):
+        assert pool.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+
+    def test_imap_ordered(self, pool):
+        assert list(pool.imap(_sq, range(8), chunksize=2)) == \
+            [x * x for x in range(8)]
+
+    def test_imap_unordered(self, pool):
+        out = sorted(pool.imap_unordered(_sq, range(8), chunksize=2))
+        assert out == sorted(x * x for x in range(8))
+
+    def test_initializer(self, ray_start):
+        from ray_tpu.util.multiprocessing import Pool
+
+        def init_env(tag):
+            import os
+
+            os.environ["POOL_TAG"] = tag
+
+        def read_env():
+            import os
+
+            return os.environ.get("POOL_TAG")
+
+        p = Pool(processes=2, initializer=init_env, initargs=("hello",))
+        try:
+            assert p.apply(read_env) == "hello"
+        finally:
+            p.terminate()
+
+    def test_closed_pool_rejects(self, pool):
+        pool.close()
+        with pytest.raises(ValueError):
+            pool.apply(_sq, (1,))
+        pool.join()
+
+    def test_context_manager(self, ray_start):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            assert p.map(_sq, [1, 2, 3]) == [1, 4, 9]
+
+
+class TestJoblib:
+    def test_parallel_backend(self, ray_start):
+        import joblib
+
+        from ray_tpu.util.joblib import register_ray_tpu
+
+        register_ray_tpu()
+        with joblib.parallel_backend("ray_tpu", n_jobs=3):
+            out = joblib.Parallel()(
+                joblib.delayed(_sq)(i) for i in range(12))
+        assert out == [i * i for i in range(12)]
