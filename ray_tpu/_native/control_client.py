@@ -1,0 +1,330 @@
+"""Client for the native control-plane daemon (src/control_plane.cc).
+
+Capability-equivalent of the reference's GcsClient
+(reference: src/ray/gcs/gcs_client/gcs_client.h:66 — InternalKVAccessor,
+NodeInfoAccessor, ActorInfoAccessor, pubsub subscribe): a socket client
+with a reader thread demuxing responses from pubsub pushes; subscribe
+callbacks fire on a dedicated delivery thread.
+
+launch_control_plane() spawns the daemon binary (the reference's
+gcs_server process) and returns (Popen, port).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import struct
+import subprocess
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_BIN = os.path.join(os.path.dirname(__file__), "control_plane")
+
+# Ops — keep in sync with control_plane.cc.
+OP_PING = 0
+OP_KV_PUT, OP_KV_GET, OP_KV_DEL, OP_KV_KEYS, OP_KV_EXISTS = 1, 2, 3, 4, 5
+OP_SUBSCRIBE, OP_UNSUBSCRIBE, OP_PUBLISH = 10, 11, 12
+OP_REGISTER_NODE, OP_HEARTBEAT, OP_LIST_NODES, OP_DRAIN_NODE = 20, 21, 22, 23
+OP_REGISTER_ACTOR, OP_UPDATE_ACTOR, OP_GET_ACTOR = 30, 31, 32
+OP_LIST_ACTORS, OP_GET_NAMED_ACTOR = 33, 34
+OP_ADD_JOB, OP_LIST_JOBS = 40, 41
+OP_STATS = 50
+
+ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_BAD_REQUEST = 0, 1, 2, 3
+
+_ST_NAMES = {1: "NOT_FOUND", 2: "EXISTS", 3: "BAD_REQUEST"}
+
+
+class ControlPlaneError(Exception):
+    pass
+
+
+class NotFoundError(ControlPlaneError):
+    pass
+
+
+class AlreadyExistsError(ControlPlaneError):
+    pass
+
+
+def available() -> bool:
+    return os.path.exists(_BIN)
+
+
+def launch_control_plane(*, port: int = 0, health_timeout_ms: int = 5000
+                         ) -> Tuple[subprocess.Popen, int]:
+    """Spawn the daemon; returns (process, bound port)."""
+    proc = subprocess.Popen(
+        [_BIN, "--port", str(port),
+         "--health-timeout-ms", str(health_timeout_ms)],
+        stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    if not line.startswith("PORT="):
+        proc.kill()
+        raise ControlPlaneError(f"daemon failed to start: {line!r}")
+    return proc, int(line.strip().split("=", 1)[1])
+
+
+# -- wire helpers -----------------------------------------------------------
+
+def _pack_str(s) -> bytes:
+    if isinstance(s, str):
+        s = s.encode()
+    return struct.pack("<I", len(s)) + s
+
+
+class _Resp:
+    __slots__ = ("event", "payload")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.payload: Optional[bytes] = None
+
+
+class _RespReader:
+    """Cursor over a response payload (after req_id + status)."""
+
+    def __init__(self, data: bytes, off: int = 0):
+        self.d = data
+        self.o = off
+
+    def u8(self) -> int:
+        v = self.d[self.o]
+        self.o += 1
+        return v
+
+    def u32(self) -> int:
+        (v,) = struct.unpack_from("<I", self.d, self.o)
+        self.o += 4
+        return v
+
+    def u64(self) -> int:
+        (v,) = struct.unpack_from("<Q", self.d, self.o)
+        self.o += 8
+        return v
+
+    def bytes_(self) -> bytes:
+        n = self.u32()
+        v = self.d[self.o:self.o + n]
+        self.o += n
+        return v
+
+    def str_(self) -> str:
+        return self.bytes_().decode()
+
+
+class ControlClient:
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._req_id = 0
+        self._pending: Dict[int, _Resp] = {}
+        self._plock = threading.Lock()
+        self._subs: Dict[str, List[Callable[[bytes], None]]] = {}
+        self._push_q: "queue.Queue" = queue.Queue()
+        self._closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True, name="ctrl-reader")
+        self._reader.start()
+        self._deliverer = threading.Thread(target=self._deliver_loop,
+                                           daemon=True, name="ctrl-pubsub")
+        self._deliverer.start()
+
+    # -- transport ------------------------------------------------------
+    def close(self) -> None:
+        self._closed.set()
+        self._push_q.put(None)
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("control plane connection closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def _read_loop(self) -> None:
+        try:
+            while not self._closed.is_set():
+                (length,) = struct.unpack("<I", self._read_exact(4))
+                body = self._read_exact(length)
+                ftype = body[0]
+                if ftype == 0:  # response
+                    (req_id,) = struct.unpack_from("<Q", body, 1)
+                    with self._plock:
+                        resp = self._pending.pop(req_id, None)
+                    if resp is not None:
+                        resp.payload = body[9:]  # status + result
+                        resp.event.set()
+                else:  # pubsub push
+                    r = _RespReader(body, 1)
+                    channel = r.str_()
+                    payload = r.bytes_()
+                    self._push_q.put((channel, payload))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            # Unblock every waiter — the connection is gone.
+            with self._plock:
+                pending = list(self._pending.values())
+                self._pending.clear()
+            for resp in pending:
+                resp.event.set()
+
+    def _deliver_loop(self) -> None:
+        while True:
+            item = self._push_q.get()
+            if item is None:
+                return
+            channel, payload = item
+            for cb in self._subs.get(channel, []):
+                try:
+                    cb(payload)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _request(self, op: int, body: bytes = b"",
+                 timeout: float = 30.0) -> _RespReader:
+        resp = _Resp()
+        with self._wlock:
+            self._req_id += 1
+            req_id = self._req_id
+            with self._plock:
+                self._pending[req_id] = resp
+            frame_body = b"\x00" + struct.pack("<Q", req_id) + \
+                bytes([op]) + body
+            self._sock.sendall(
+                struct.pack("<I", len(frame_body)) + frame_body)
+        if not resp.event.wait(timeout):
+            with self._plock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError(f"control plane op {op} timed out")
+        if resp.payload is None:
+            raise ConnectionError("control plane connection closed")
+        r = _RespReader(resp.payload)
+        status = r.u8()
+        if status == ST_NOT_FOUND:
+            raise NotFoundError(f"op {op}: not found")
+        if status == ST_EXISTS:
+            raise AlreadyExistsError(f"op {op}: already exists")
+        if status != ST_OK:
+            raise ControlPlaneError(
+                f"op {op}: {_ST_NAMES.get(status, status)}")
+        return r
+
+    # -- KV (reference: InternalKVAccessor) -----------------------------
+    def kv_put(self, key, value, overwrite: bool = True) -> None:
+        self._request(OP_KV_PUT, _pack_str(key) + _pack_str(value)
+                      + bytes([1 if overwrite else 0]))
+
+    def kv_get(self, key) -> bytes:
+        return self._request(OP_KV_GET, _pack_str(key)).bytes_()
+
+    def kv_del(self, key) -> bool:
+        try:
+            self._request(OP_KV_DEL, _pack_str(key))
+            return True
+        except NotFoundError:
+            return False
+
+    def kv_exists(self, key) -> bool:
+        return self._request(OP_KV_EXISTS, _pack_str(key)).u8() == 1
+
+    def kv_keys(self, prefix="") -> List[str]:
+        r = self._request(OP_KV_KEYS, _pack_str(prefix))
+        return [r.str_() for _ in range(r.u32())]
+
+    # -- pubsub (reference: InternalPubSub) -----------------------------
+    def subscribe(self, channel: str,
+                  callback: Callable[[bytes], None]) -> None:
+        self._subs.setdefault(channel, []).append(callback)
+        self._request(OP_SUBSCRIBE, _pack_str(channel))
+
+    def unsubscribe(self, channel: str) -> None:
+        self._subs.pop(channel, None)
+        self._request(OP_UNSUBSCRIBE, _pack_str(channel))
+
+    def publish(self, channel: str, payload) -> int:
+        r = self._request(OP_PUBLISH,
+                          _pack_str(channel) + _pack_str(payload))
+        return r.u32()
+
+    # -- nodes (reference: NodeInfoAccessor + health) -------------------
+    def register_node(self, node_id: str, meta: str = "") -> None:
+        self._request(OP_REGISTER_NODE,
+                      _pack_str(node_id) + _pack_str(meta))
+
+    def heartbeat(self, node_id: str) -> None:
+        self._request(OP_HEARTBEAT, _pack_str(node_id))
+
+    def drain_node(self, node_id: str) -> None:
+        self._request(OP_DRAIN_NODE, _pack_str(node_id))
+
+    def list_nodes(self) -> List[dict]:
+        r = self._request(OP_LIST_NODES)
+        out = []
+        for _ in range(r.u32()):
+            out.append({
+                "node_id": r.str_(), "meta": r.str_(),
+                "alive": bool(r.u8()), "draining": bool(r.u8()),
+                "ms_since_heartbeat": r.u64(),
+            })
+        return out
+
+    # -- actors (reference: ActorInfoAccessor) --------------------------
+    def register_actor(self, actor_id: str, name: str = "",
+                       meta: str = "") -> None:
+        self._request(OP_REGISTER_ACTOR, _pack_str(actor_id)
+                      + _pack_str(name) + _pack_str(meta))
+
+    def update_actor(self, actor_id: str, state: str) -> None:
+        self._request(OP_UPDATE_ACTOR,
+                      _pack_str(actor_id) + _pack_str(state))
+
+    def get_actor(self, actor_id: str) -> dict:
+        r = self._request(OP_GET_ACTOR, _pack_str(actor_id))
+        return {"name": r.str_(), "state": r.str_(), "meta": r.str_()}
+
+    def get_named_actor(self, name: str) -> str:
+        return self._request(OP_GET_NAMED_ACTOR, _pack_str(name)).str_()
+
+    def list_actors(self) -> List[dict]:
+        r = self._request(OP_LIST_ACTORS)
+        return [{"actor_id": r.str_(), "name": r.str_(),
+                 "state": r.str_()} for _ in range(r.u32())]
+
+    # -- jobs -----------------------------------------------------------
+    def add_job(self, job_id: str, meta: str = "") -> None:
+        self._request(OP_ADD_JOB, _pack_str(job_id) + _pack_str(meta))
+
+    def list_jobs(self) -> List[dict]:
+        r = self._request(OP_LIST_JOBS)
+        return [{"job_id": r.str_(), "meta": r.str_()}
+                for _ in range(r.u32())]
+
+    # -- stats (reference: event_stats.cc per-handler stats) ------------
+    def stats(self) -> Dict[int, dict]:
+        r = self._request(OP_STATS)
+        out = {}
+        for _ in range(r.u32()):
+            op = r.u8()
+            count = r.u64()
+            total = r.u64()
+            out[op] = {"count": count, "total_us": total,
+                       "mean_us": total / count if count else 0.0}
+        return out
+
+    def ping(self) -> int:
+        return self._request(OP_PING).u64()

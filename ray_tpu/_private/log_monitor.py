@@ -83,10 +83,16 @@ class LogMonitor:
             self._offsets[path] = offset + done + 1
             source = os.path.basename(path)
             text = data[:done].decode("utf-8", "replace")
-            for line in text.replace("\r", "\n").split("\n"):
-                if line:
-                    self.sink(source, line)
-                    n += 1
+            # CRLF collapses first so \r handling can't fabricate blank
+            # lines; REAL blank lines are forwarded (print() separators).
+            text = text.replace("\r\n", "\n").replace("\r", "\n")
+            lines = text.split("\n")
+            if lines and lines[-1] == "" and text.endswith("\n"):
+                # data[:done] ending in CRLF leaves one artifact "".
+                lines.pop()
+            for line in lines:
+                self.sink(source, line)
+                n += 1
         return n
 
     @staticmethod

@@ -5,10 +5,20 @@ Capability-equivalent to the reference's Cluster
 remove_node :247): runs multiple schedulable nodes so that spillback
 scheduling, placement-group spreading, and node-failure recovery are
 testable on one machine.
+
+With enable_control_plane=True the cluster also runs the NATIVE
+control-plane daemon (src/control_plane.cc, the GCS-equivalent): every
+node registers there and heartbeats from a background thread; removing
+a node stops its heartbeats, so the daemon's health checker marks it
+DEAD and publishes the death on "node_events" — the same
+register/heartbeat/expiry/publish flow the reference runs between
+raylets and the GCS (gcs_health_check_manager.h).
 """
 
 from __future__ import annotations
 
+import json
+import threading
 from typing import Dict, Optional
 
 from .core import runtime as _runtime
@@ -17,10 +27,28 @@ from .core.scheduler import NodeState
 
 
 class Cluster:
-    def __init__(self):
+    def __init__(self, *, enable_control_plane: bool = False,
+                 health_timeout_ms: int = 1000):
         self._count = 0
         self._rt: Optional[_runtime.Runtime] = None
+        self._cp_proc = None
+        self.control_client = None
+        self._hb_stop = threading.Event()
+        self._hb_nodes: set = set()
+        self._hb_lock = threading.Lock()
+        self._hb_thread: Optional[threading.Thread] = None
+        if enable_control_plane:
+            from ._native import control_client as cc
 
+            if not cc.available():
+                raise RuntimeError(
+                    "control_plane binary not built (make -C src)")
+            self._cp_proc, port = cc.launch_control_plane(
+                health_timeout_ms=health_timeout_ms)
+            self.control_client = cc.ControlClient(port)
+            self.control_plane_port = port
+
+    # -- membership -----------------------------------------------------
     def add_node(self, *, num_cpus: float = 1, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None) -> str:
@@ -31,6 +59,7 @@ class Cluster:
             node = self._rt.scheduler.get_node(self._rt.head_node_id)
             node.labels.update(labels or {})
             self._count += 1
+            self._register_cp(node.node_id, node.total)
             return node.node_id
         self._count += 1
         node_id = f"node-{self._count}"
@@ -42,16 +71,62 @@ class Cluster:
                          max_workers=max(2, int(num_cpus) * 2))
         node.labels.update(labels or {})
         self._rt.scheduler.add_node(node)
+        self._register_cp(node_id, node.total)
         return node_id
 
     def remove_node(self, node_id: str) -> None:
         assert self._rt is not None
         self._rt.scheduler.remove_node(node_id)
+        # Stop heartbeating: the daemon's health expiry declares the
+        # death (we do NOT eagerly deregister — that would bypass the
+        # failure-detection path under test).
+        with self._hb_lock:
+            self._hb_nodes.discard(node_id)
+
+    # -- native control plane -------------------------------------------
+    def _register_cp(self, node_id: str, total: ResourceSet) -> None:
+        if self.control_client is None:
+            return
+        self.control_client.register_node(
+            node_id, meta=json.dumps(total.to_dict()))
+        with self._hb_lock:
+            self._hb_nodes.add(node_id)
+        if self._hb_thread is None:
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, daemon=True,
+                name="cluster-heartbeats")
+            self._hb_thread.start()
+
+    def _hb_loop(self) -> None:
+        while not self._hb_stop.wait(0.2):
+            with self._hb_lock:
+                nodes = list(self._hb_nodes)
+            for nid in nodes:
+                try:
+                    self.control_client.heartbeat(nid)
+                except Exception:  # noqa: BLE001
+                    pass
 
     @property
     def runtime(self):
         return self._rt
 
     def shutdown(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+        if self.control_client is not None:
+            try:
+                self.control_client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self.control_client = None
+        if self._cp_proc is not None:
+            self._cp_proc.terminate()
+            try:
+                self._cp_proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                self._cp_proc.kill()
+            self._cp_proc = None
         _runtime.shutdown_runtime()
         self._rt = None

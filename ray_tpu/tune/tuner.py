@@ -148,8 +148,17 @@ def _snapshot_checkpoint(ckpt):
         dst = tempfile.mkdtemp(prefix="tune_exploit_")
         shutil.copytree(ckpt.as_directory(), dst, dirs_exist_ok=True)
         return Checkpoint(dst)
-    except (FileNotFoundError, OSError):
+    except OSError:
         return None
+
+
+def _drop_snapshot(ckpt) -> None:
+    """Delete a snapshot made by _snapshot_checkpoint (one dir per
+    exploit would otherwise accumulate for the whole experiment)."""
+    import shutil
+
+    if ckpt is not None:
+        shutil.rmtree(ckpt.as_directory(), ignore_errors=True)
 
 
 class Tuner:
@@ -279,6 +288,9 @@ class Tuner:
                             ray_kill(worker)
                         except Exception:  # noqa: BLE001
                             pass
+                        # The finished run has consumed its snapshot.
+                        _drop_snapshot(start_ckpt)
+                        start_ckpt = None
                     if exploit is None:
                         break
                     config, donor_ckpt = exploit

@@ -11,11 +11,8 @@ from __future__ import annotations
 
 import itertools
 import threading
+from multiprocessing import TimeoutError  # noqa: A004 - drop-in except
 from typing import Any, Callable, Iterable, Iterator, List, Optional
-
-
-class TimeoutError(Exception):  # noqa: A001 - mirrors mp.TimeoutError
-    pass
 
 
 class _PoolWorker:
@@ -213,21 +210,47 @@ class Pool:
             error_callback=error_callback))
 
     # -- imap -----------------------------------------------------------
+    def _iter_chunks(self, iterable: Iterable,
+                     chunksize: int) -> Iterator[List[tuple]]:
+        """Lazy chunking — imap must stream unbounded iterables."""
+        it = iter(iterable)
+        while True:
+            chunk = [(x,) for x in itertools.islice(it, chunksize)]
+            if not chunk:
+                return
+            yield chunk
+
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: int = 1) -> Iterator[Any]:
-        refs = [self._next_worker().run_batch.remote(
-            fn, chunk) for chunk in self._chunks(
-                [(x,) for x in iterable], chunksize)]
-        for ref in refs:
+        chunks = self._iter_chunks(iterable, chunksize)
+        window: List[Any] = []
+        limit = self._size * 2
+        for chunk in chunks:
+            window.append(
+                self._next_worker().run_batch.remote(fn, chunk))
+            if len(window) >= limit:
+                for item in self._ray.get(window.pop(0)):
+                    yield item
+        for ref in window:
             for item in self._ray.get(ref):
                 yield item
 
     def imap_unordered(self, fn: Callable, iterable: Iterable,
                        chunksize: int = 1) -> Iterator[Any]:
-        pending = {self._next_worker().run_batch.remote(fn, chunk)
-                   for chunk in self._chunks(
-                       [(x,) for x in iterable], chunksize)}
-        while pending:
+        chunks = self._iter_chunks(iterable, chunksize)
+        pending: set = set()
+        limit = self._size * 2
+        exhausted = False
+        while not exhausted or pending:
+            while not exhausted and len(pending) < limit:
+                chunk = next(chunks, None)
+                if chunk is None:
+                    exhausted = True
+                    break
+                pending.add(
+                    self._next_worker().run_batch.remote(fn, chunk))
+            if not pending:
+                break
             ready, pending_list = self._ray.wait(
                 list(pending), num_returns=1)
             pending = set(pending_list)
@@ -240,22 +263,28 @@ class Pool:
 
     def terminate(self) -> None:
         self._closed = True
-        for w in self._workers:
-            try:
-                self._ray.kill(w)
-            except Exception:  # noqa: BLE001
-                pass
-        self._workers = []
+        self._kill_workers()
 
     def join(self) -> None:
-        """Wait for all outstanding async work (stdlib contract:
-        close() then join() guarantees every task finished)."""
+        """Wait for all outstanding async work, then release the worker
+        actors (stdlib contract: close()+join() finishes every task AND
+        tears the pool down — leaving actors alive would pin their CPUs
+        for the life of the runtime)."""
         if not self._closed:
             raise ValueError("Pool is still running; call close() first")
         with self._pending_lock:
             pending = list(self._pending)
         for r in pending:
             r.wait()
+        self._kill_workers()
+
+    def _kill_workers(self) -> None:
+        for w in self._workers:
+            try:
+                self._ray.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self._workers = []
 
     def __enter__(self) -> "Pool":
         return self

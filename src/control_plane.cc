@@ -1,0 +1,622 @@
+// Control-plane daemon — the GCS-equivalent native service.
+//
+// Capability-equivalent of the reference's GCS server
+// (reference: src/ray/gcs/gcs_server/ — GcsKvManager/StoreClientKV,
+// InternalPubSub, GcsNodeManager + GcsHealthCheckManager,
+// GcsActorManager's actor table, GcsJobManager), re-designed for this
+// runtime: one single-threaded epoll event loop (the reference's
+// instrumented_io_context analog, with the same per-handler latency
+// accounting as common/event_stats.cc) serving a length-prefixed binary
+// protocol over TCP. No locks — all state is owned by the loop thread.
+//
+// Frame:    [u32 len][u8 type][body]     type 0 = request/response,
+//                                        type 1 = pubsub push
+// Request:  [u64 req_id][u8 op][args...]
+// Response: [u64 req_id][u8 status][result...]   status 0 = OK
+// Push:     [str channel][bytes payload]
+// Strings/bytes are u32-length-prefixed; integers little-endian.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <deque>
+#include <map>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Ops
+// ---------------------------------------------------------------------------
+enum Op : uint8_t {
+  OP_PING = 0,
+  OP_KV_PUT = 1,
+  OP_KV_GET = 2,
+  OP_KV_DEL = 3,
+  OP_KV_KEYS = 4,
+  OP_KV_EXISTS = 5,
+  OP_SUBSCRIBE = 10,
+  OP_UNSUBSCRIBE = 11,
+  OP_PUBLISH = 12,
+  OP_REGISTER_NODE = 20,
+  OP_HEARTBEAT = 21,
+  OP_LIST_NODES = 22,
+  OP_DRAIN_NODE = 23,
+  OP_REGISTER_ACTOR = 30,
+  OP_UPDATE_ACTOR = 31,
+  OP_GET_ACTOR = 32,
+  OP_LIST_ACTORS = 33,
+  OP_GET_NAMED_ACTOR = 34,
+  OP_ADD_JOB = 40,
+  OP_LIST_JOBS = 41,
+  OP_STATS = 50,
+};
+
+enum Status : uint8_t {
+  ST_OK = 0,
+  ST_NOT_FOUND = 1,
+  ST_EXISTS = 2,
+  ST_BAD_REQUEST = 3,
+};
+
+uint64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+uint64_t now_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// Wire helpers
+// ---------------------------------------------------------------------------
+struct Reader {
+  const uint8_t* p;
+  size_t left;
+  bool ok = true;
+
+  Reader(const uint8_t* data, size_t n) : p(data), left(n) {}
+
+  uint8_t u8() {
+    if (left < 1) { ok = false; return 0; }
+    uint8_t v = *p; p += 1; left -= 1; return v;
+  }
+  uint32_t u32() {
+    if (left < 4) { ok = false; return 0; }
+    uint32_t v; memcpy(&v, p, 4); p += 4; left -= 4; return v;
+  }
+  uint64_t u64() {
+    if (left < 8) { ok = false; return 0; }
+    uint64_t v; memcpy(&v, p, 8); p += 8; left -= 8; return v;
+  }
+  std::string str() {
+    uint32_t n = u32();
+    if (!ok || left < n) { ok = false; return {}; }
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n; left -= n;
+    return s;
+  }
+};
+
+struct Writer {
+  std::vector<uint8_t> buf;
+
+  void u8(uint8_t v) { buf.push_back(v); }
+  void u32(uint32_t v) {
+    size_t n = buf.size(); buf.resize(n + 4); memcpy(&buf[n], &v, 4);
+  }
+  void u64(uint64_t v) {
+    size_t n = buf.size(); buf.resize(n + 8); memcpy(&buf[n], &v, 8);
+  }
+  void str(const std::string& s) {
+    u32(static_cast<uint32_t>(s.size()));
+    buf.insert(buf.end(), s.begin(), s.end());
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Server state
+// ---------------------------------------------------------------------------
+struct Conn {
+  int fd = -1;
+  std::vector<uint8_t> inbuf;
+  std::deque<std::vector<uint8_t>> outq;  // framed, pending write
+  size_t out_off = 0;                     // offset into outq.front()
+  std::set<std::string> subs;
+};
+
+struct NodeInfo {
+  std::string meta;
+  uint64_t last_heartbeat_ms = 0;
+  bool alive = true;
+  bool draining = false;
+};
+
+struct ActorInfo {
+  std::string name;
+  std::string state;  // PENDING/ALIVE/RESTARTING/DEAD (free-form)
+  std::string meta;
+};
+
+struct OpStat {
+  uint64_t count = 0;
+  uint64_t total_us = 0;
+};
+
+struct Server {
+  int epfd = -1;
+  int listen_fd = -1;
+  std::unordered_map<int, Conn> conns;
+  // State tables (reference: gcs_table_storage.h typed tables).
+  std::map<std::string, std::string> kv;
+  std::unordered_map<std::string, std::set<int>> channels;  // chan -> fds
+  std::map<std::string, NodeInfo> nodes;
+  std::map<std::string, ActorInfo> actors;
+  std::unordered_map<std::string, std::string> named_actors;
+  std::map<std::string, std::string> jobs;
+  std::map<uint8_t, OpStat> stats;   // per-op event stats
+  uint64_t health_timeout_ms = 5000;
+};
+
+void set_nonblock(int fd) {
+  // Edge cases aside, the loop never blocks on a socket.
+  int flags = 0;
+  flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+void arm_events(Server& s, Conn& c) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (c.outq.empty() ? 0 : EPOLLOUT);
+  ev.data.fd = c.fd;
+  epoll_ctl(s.epfd, EPOLL_CTL_MOD, c.fd, &ev);
+}
+
+void queue_frame(Server& s, Conn& c, uint8_t type,
+                 const std::vector<uint8_t>& body) {
+  std::vector<uint8_t> frame(5 + body.size());
+  uint32_t len = static_cast<uint32_t>(1 + body.size());
+  memcpy(&frame[0], &len, 4);
+  frame[4] = type;
+  memcpy(frame.data() + 5, body.data(), body.size());
+  c.outq.push_back(std::move(frame));
+  arm_events(s, c);
+}
+
+void close_conn(Server& s, int fd) {
+  auto it = s.conns.find(fd);
+  if (it == s.conns.end()) return;
+  for (const auto& ch : it->second.subs) {
+    auto cit = s.channels.find(ch);
+    if (cit != s.channels.end()) {
+      cit->second.erase(fd);
+      if (cit->second.empty()) s.channels.erase(cit);
+    }
+  }
+  epoll_ctl(s.epfd, EPOLL_CTL_DEL, fd, nullptr);
+  close(fd);
+  s.conns.erase(it);
+}
+
+void publish(Server& s, const std::string& channel,
+             const std::string& payload) {
+  auto it = s.channels.find(channel);
+  if (it == s.channels.end()) return;
+  Writer w;
+  w.str(channel);
+  w.str(payload);
+  // Copy the fd set: queue_frame may drop a dead conn via arm failure.
+  std::vector<int> fds(it->second.begin(), it->second.end());
+  for (int fd : fds) {
+    auto cit = s.conns.find(fd);
+    if (cit != s.conns.end()) queue_frame(s, cit->second, 1, w.buf);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Request dispatch
+// ---------------------------------------------------------------------------
+void dispatch(Server& s, Conn& c, Reader& r) {
+  uint64_t req_id = r.u64();
+  uint8_t op = r.u8();
+  Writer w;
+  w.u64(req_id);
+  uint64_t t0 = now_us();
+
+  auto finish = [&](void) {
+    queue_frame(s, c, 0, w.buf);
+    OpStat& st = s.stats[op];
+    st.count += 1;
+    st.total_us += now_us() - t0;
+  };
+
+  if (!r.ok) { w.u8(ST_BAD_REQUEST); finish(); return; }
+
+  switch (op) {
+    case OP_PING: {
+      w.u8(ST_OK);
+      w.u64(now_ms());
+      break;
+    }
+    case OP_KV_PUT: {
+      std::string key = r.str(), val = r.str();
+      uint8_t overwrite = r.u8();
+      if (!r.ok) { w.u8(ST_BAD_REQUEST); break; }
+      auto it = s.kv.find(key);
+      if (it != s.kv.end() && !overwrite) {
+        w.u8(ST_EXISTS);
+      } else {
+        s.kv[key] = val;
+        w.u8(ST_OK);
+      }
+      break;
+    }
+    case OP_KV_GET: {
+      std::string key = r.str();
+      auto it = s.kv.find(key);
+      if (it == s.kv.end()) { w.u8(ST_NOT_FOUND); }
+      else { w.u8(ST_OK); w.str(it->second); }
+      break;
+    }
+    case OP_KV_DEL: {
+      std::string key = r.str();
+      w.u8(s.kv.erase(key) ? ST_OK : ST_NOT_FOUND);
+      break;
+    }
+    case OP_KV_EXISTS: {
+      std::string key = r.str();
+      w.u8(ST_OK);
+      w.u8(s.kv.count(key) ? 1 : 0);
+      break;
+    }
+    case OP_KV_KEYS: {
+      std::string prefix = r.str();
+      std::vector<const std::string*> keys;
+      for (auto it = s.kv.lower_bound(prefix); it != s.kv.end(); ++it) {
+        if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+        keys.push_back(&it->first);
+      }
+      w.u8(ST_OK);
+      w.u32(static_cast<uint32_t>(keys.size()));
+      for (auto* k : keys) w.str(*k);
+      break;
+    }
+    case OP_SUBSCRIBE: {
+      std::string ch = r.str();
+      c.subs.insert(ch);
+      s.channels[ch].insert(c.fd);
+      w.u8(ST_OK);
+      break;
+    }
+    case OP_UNSUBSCRIBE: {
+      std::string ch = r.str();
+      c.subs.erase(ch);
+      auto it = s.channels.find(ch);
+      if (it != s.channels.end()) {
+        it->second.erase(c.fd);
+        if (it->second.empty()) s.channels.erase(it);
+      }
+      w.u8(ST_OK);
+      break;
+    }
+    case OP_PUBLISH: {
+      std::string ch = r.str(), payload = r.str();
+      uint32_t n = 0;
+      auto it = s.channels.find(ch);
+      if (it != s.channels.end())
+        n = static_cast<uint32_t>(it->second.size());
+      publish(s, ch, payload);
+      w.u8(ST_OK);
+      w.u32(n);
+      break;
+    }
+    case OP_REGISTER_NODE: {
+      std::string node_id = r.str(), meta = r.str();
+      NodeInfo& n = s.nodes[node_id];
+      n.meta = meta;
+      n.last_heartbeat_ms = now_ms();
+      n.alive = true;
+      n.draining = false;
+      publish(s, "node_events", "ALIVE:" + node_id);
+      w.u8(ST_OK);
+      break;
+    }
+    case OP_HEARTBEAT: {
+      std::string node_id = r.str();
+      auto it = s.nodes.find(node_id);
+      if (it == s.nodes.end()) { w.u8(ST_NOT_FOUND); break; }
+      it->second.last_heartbeat_ms = now_ms();
+      if (!it->second.alive) {
+        it->second.alive = true;
+        publish(s, "node_events", "ALIVE:" + node_id);
+      }
+      w.u8(ST_OK);
+      break;
+    }
+    case OP_DRAIN_NODE: {
+      std::string node_id = r.str();
+      auto it = s.nodes.find(node_id);
+      if (it == s.nodes.end()) { w.u8(ST_NOT_FOUND); break; }
+      it->second.draining = true;
+      publish(s, "node_events", "DRAINING:" + node_id);
+      w.u8(ST_OK);
+      break;
+    }
+    case OP_LIST_NODES: {
+      w.u8(ST_OK);
+      w.u32(static_cast<uint32_t>(s.nodes.size()));
+      uint64_t now = now_ms();
+      for (const auto& [nid, n] : s.nodes) {
+        w.str(nid);
+        w.str(n.meta);
+        w.u8(n.alive ? 1 : 0);
+        w.u8(n.draining ? 1 : 0);
+        w.u64(now - n.last_heartbeat_ms);
+      }
+      break;
+    }
+    case OP_REGISTER_ACTOR: {
+      std::string actor_id = r.str(), name = r.str(), meta = r.str();
+      if (!name.empty()) {
+        auto nit = s.named_actors.find(name);
+        if (nit != s.named_actors.end()) {
+          // Name taken by a live actor → reject (reference:
+          // GcsActorManager duplicate-name creation error).
+          auto ait = s.actors.find(nit->second);
+          if (ait != s.actors.end() && ait->second.state != "DEAD") {
+            w.u8(ST_EXISTS);
+            break;
+          }
+        }
+        s.named_actors[name] = actor_id;
+      }
+      ActorInfo& a = s.actors[actor_id];
+      a.name = name;
+      a.state = "PENDING";
+      a.meta = meta;
+      publish(s, "actor_events", "PENDING:" + actor_id);
+      w.u8(ST_OK);
+      break;
+    }
+    case OP_UPDATE_ACTOR: {
+      std::string actor_id = r.str(), state = r.str();
+      auto it = s.actors.find(actor_id);
+      if (it == s.actors.end()) { w.u8(ST_NOT_FOUND); break; }
+      it->second.state = state;
+      if (state == "DEAD" && !it->second.name.empty()) {
+        auto nit = s.named_actors.find(it->second.name);
+        if (nit != s.named_actors.end() && nit->second == actor_id)
+          s.named_actors.erase(nit);
+      }
+      publish(s, "actor_events", state + ":" + actor_id);
+      w.u8(ST_OK);
+      break;
+    }
+    case OP_GET_ACTOR: {
+      std::string actor_id = r.str();
+      auto it = s.actors.find(actor_id);
+      if (it == s.actors.end()) { w.u8(ST_NOT_FOUND); break; }
+      w.u8(ST_OK);
+      w.str(it->second.name);
+      w.str(it->second.state);
+      w.str(it->second.meta);
+      break;
+    }
+    case OP_GET_NAMED_ACTOR: {
+      std::string name = r.str();
+      auto it = s.named_actors.find(name);
+      if (it == s.named_actors.end()) { w.u8(ST_NOT_FOUND); break; }
+      w.u8(ST_OK);
+      w.str(it->second);
+      break;
+    }
+    case OP_LIST_ACTORS: {
+      w.u8(ST_OK);
+      w.u32(static_cast<uint32_t>(s.actors.size()));
+      for (const auto& [aid, a] : s.actors) {
+        w.str(aid);
+        w.str(a.name);
+        w.str(a.state);
+      }
+      break;
+    }
+    case OP_ADD_JOB: {
+      std::string job_id = r.str(), meta = r.str();
+      s.jobs[job_id] = meta;
+      w.u8(ST_OK);
+      break;
+    }
+    case OP_LIST_JOBS: {
+      w.u8(ST_OK);
+      w.u32(static_cast<uint32_t>(s.jobs.size()));
+      for (const auto& [jid, meta] : s.jobs) {
+        w.str(jid);
+        w.str(meta);
+      }
+      break;
+    }
+    case OP_STATS: {
+      w.u8(ST_OK);
+      w.u32(static_cast<uint32_t>(s.stats.size()));
+      for (const auto& [o, st] : s.stats) {
+        w.u8(o);
+        w.u64(st.count);
+        w.u64(st.total_us);
+      }
+      break;
+    }
+    default:
+      w.u8(ST_BAD_REQUEST);
+  }
+  finish();
+}
+
+// ---------------------------------------------------------------------------
+// Event loop
+// ---------------------------------------------------------------------------
+void handle_readable(Server& s, int fd) {
+  auto it = s.conns.find(fd);
+  if (it == s.conns.end()) return;
+  Conn& c = it->second;
+  char buf[65536];
+  for (;;) {
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n > 0) {
+      c.inbuf.insert(c.inbuf.end(), buf, buf + n);
+    } else if (n == 0) {
+      close_conn(s, fd);
+      return;
+    } else {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      close_conn(s, fd);
+      return;
+    }
+  }
+  // Drain complete frames.
+  size_t off = 0;
+  while (c.inbuf.size() - off >= 4) {
+    uint32_t len;
+    memcpy(&len, c.inbuf.data() + off, 4);
+    if (len > (64u << 20)) { close_conn(s, fd); return; }
+    if (c.inbuf.size() - off - 4 < len) break;
+    const uint8_t* body = c.inbuf.data() + off + 4;
+    // body[0] = frame type (requests only from clients).
+    if (len >= 1 && body[0] == 0) {
+      Reader r(body + 1, len - 1);
+      dispatch(s, c, r);
+      // dispatch may close conns (never its own); re-find ours.
+      if (s.conns.find(fd) == s.conns.end()) return;
+    }
+    off += 4 + len;
+  }
+  if (off > 0) c.inbuf.erase(c.inbuf.begin(), c.inbuf.begin() + off);
+}
+
+void handle_writable(Server& s, int fd) {
+  auto it = s.conns.find(fd);
+  if (it == s.conns.end()) return;
+  Conn& c = it->second;
+  while (!c.outq.empty()) {
+    auto& front = c.outq.front();
+    ssize_t n = send(fd, front.data() + c.out_off,
+                     front.size() - c.out_off, MSG_NOSIGNAL);
+    if (n > 0) {
+      c.out_off += n;
+      if (c.out_off == front.size()) {
+        c.outq.pop_front();
+        c.out_off = 0;
+      }
+    } else {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      close_conn(s, fd);
+      return;
+    }
+  }
+  arm_events(s, c);
+}
+
+void check_health(Server& s) {
+  uint64_t now = now_ms();
+  for (auto& [nid, n] : s.nodes) {
+    if (n.alive && now - n.last_heartbeat_ms > s.health_timeout_ms) {
+      n.alive = false;
+      publish(s, "node_events", "DEAD:" + nid);
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 0;
+  uint64_t health_timeout_ms = 5000;
+  for (int i = 1; i < argc - 1; i++) {
+    if (strcmp(argv[i], "--port") == 0) port = atoi(argv[i + 1]);
+    if (strcmp(argv[i], "--health-timeout-ms") == 0)
+      health_timeout_ms = strtoull(argv[i + 1], nullptr, 10);
+  }
+
+  Server s;
+  s.health_timeout_ms = health_timeout_ms;
+  s.listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(s.listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(s.listen_fd, reinterpret_cast<sockaddr*>(&addr),
+           sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s.listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  listen(s.listen_fd, 128);
+  set_nonblock(s.listen_fd);
+
+  s.epfd = epoll_create1(0);
+  epoll_event lev{};
+  lev.events = EPOLLIN;
+  lev.data.fd = s.listen_fd;
+  epoll_ctl(s.epfd, EPOLL_CTL_ADD, s.listen_fd, &lev);
+
+  printf("PORT=%d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  epoll_event events[256];
+  for (;;) {
+    int n = epoll_wait(s.epfd, events, 256, 500);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      perror("epoll_wait");
+      return 1;
+    }
+    for (int i = 0; i < n; i++) {
+      int fd = events[i].data.fd;
+      if (fd == s.listen_fd) {
+        for (;;) {
+          int cfd = accept(s.listen_fd, nullptr, nullptr);
+          if (cfd < 0) break;
+          set_nonblock(cfd);
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.fd = cfd;
+          epoll_ctl(s.epfd, EPOLL_CTL_ADD, cfd, &ev);
+          s.conns[cfd].fd = cfd;
+        }
+        continue;
+      }
+      if (events[i].events & (EPOLLHUP | EPOLLERR)) {
+        close_conn(s, fd);
+        continue;
+      }
+      if (events[i].events & EPOLLIN) handle_readable(s, fd);
+      if (events[i].events & EPOLLOUT) handle_writable(s, fd);
+    }
+    check_health(s);
+  }
+  return 0;
+}

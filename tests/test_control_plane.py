@@ -1,0 +1,290 @@
+"""Native control-plane daemon tests (reference coverage model:
+src/ray/gcs/gcs_server/test/ — kv/pubsub/node/actor manager tests,
+python/ray/tests/test_gcs_fault_tolerance.py health-expiry behavior)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from ray_tpu._native import control_client as cc
+
+pytestmark = pytest.mark.skipif(
+    not cc.available(), reason="control_plane binary not built")
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    proc, port = cc.launch_control_plane(health_timeout_ms=600)
+    yield port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+@pytest.fixture
+def client(daemon):
+    c = cc.ControlClient(daemon)
+    yield c
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# KV
+# ---------------------------------------------------------------------------
+
+class TestKV:
+    def test_put_get_roundtrip(self, client):
+        client.kv_put("alpha", b"value-1")
+        assert client.kv_get("alpha") == b"value-1"
+
+    def test_overwrite_semantics(self, client):
+        client.kv_put("beta", b"v1")
+        with pytest.raises(cc.AlreadyExistsError):
+            client.kv_put("beta", b"v2", overwrite=False)
+        client.kv_put("beta", b"v2", overwrite=True)
+        assert client.kv_get("beta") == b"v2"
+
+    def test_missing_key(self, client):
+        with pytest.raises(cc.NotFoundError):
+            client.kv_get("nope")
+        assert not client.kv_exists("nope")
+
+    def test_delete(self, client):
+        client.kv_put("gone", b"x")
+        assert client.kv_del("gone")
+        assert not client.kv_del("gone")
+
+    def test_prefix_keys(self, client):
+        for i in range(5):
+            client.kv_put(f"pfx/{i}", b"")
+        client.kv_put("other", b"")
+        keys = client.kv_keys("pfx/")
+        assert keys == [f"pfx/{i}" for i in range(5)]
+
+    def test_binary_values(self, client):
+        blob = bytes(range(256)) * 100
+        client.kv_put("bin", blob)
+        assert client.kv_get("bin") == blob
+
+    def test_kv_visible_across_clients(self, daemon):
+        a, b = cc.ControlClient(daemon), cc.ControlClient(daemon)
+        try:
+            a.kv_put("shared", b"from-a")
+            assert b.kv_get("shared") == b"from-a"
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# Pubsub
+# ---------------------------------------------------------------------------
+
+class TestPubsub:
+    def test_publish_subscribe(self, daemon):
+        pub, sub = cc.ControlClient(daemon), cc.ControlClient(daemon)
+        try:
+            got = []
+            sub.subscribe("news", got.append)
+            n = pub.publish("news", b"hello")
+            assert n == 1
+            deadline = time.time() + 5
+            while not got and time.time() < deadline:
+                time.sleep(0.01)
+            assert got == [b"hello"]
+        finally:
+            pub.close()
+            sub.close()
+
+    def test_multiple_subscribers(self, daemon):
+        clients = [cc.ControlClient(daemon) for _ in range(3)]
+        try:
+            boxes = [[] for _ in clients]
+            for c, box in zip(clients[:2], boxes[:2]):
+                c.subscribe("fanout", box.append)
+            assert clients[2].publish("fanout", b"msg") == 2
+            deadline = time.time() + 5
+            while not all(boxes[:2]) and time.time() < deadline:
+                time.sleep(0.01)
+            assert boxes[0] == [b"msg"] and boxes[1] == [b"msg"]
+            assert boxes[2] == []
+        finally:
+            for c in clients:
+                c.close()
+
+    def test_unsubscribe(self, daemon):
+        pub, sub = cc.ControlClient(daemon), cc.ControlClient(daemon)
+        try:
+            got = []
+            sub.subscribe("quiet", got.append)
+            sub.unsubscribe("quiet")
+            assert pub.publish("quiet", b"x") == 0
+        finally:
+            pub.close()
+            sub.close()
+
+
+# ---------------------------------------------------------------------------
+# Node table + health
+# ---------------------------------------------------------------------------
+
+class TestNodes:
+    def test_register_and_list(self, client):
+        client.register_node("n1", meta='{"CPU": 8}')
+        nodes = {n["node_id"]: n for n in client.list_nodes()}
+        assert nodes["n1"]["alive"]
+        assert nodes["n1"]["meta"] == '{"CPU": 8}'
+
+    def test_heartbeat_expiry_and_recovery(self, daemon):
+        """Health check: a silent node goes DEAD (published), a late
+        heartbeat resurrects it (reference: gcs_health_check_manager)."""
+        c = cc.ControlClient(daemon)
+        try:
+            events = []
+            c.subscribe("node_events", events.append)
+            c.register_node("flaky")
+            # Expiry is 600ms in this fixture; epoll tick is 500ms.
+            deadline = time.time() + 5
+            while not any(b"DEAD:flaky" in e for e in events) \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert any(b"DEAD:flaky" in e for e in events)
+            nodes = {n["node_id"]: n for n in c.list_nodes()}
+            assert not nodes["flaky"]["alive"]
+            c.heartbeat("flaky")
+            nodes = {n["node_id"]: n for n in c.list_nodes()}
+            assert nodes["flaky"]["alive"]
+            assert any(b"ALIVE:flaky" in e for e in events)
+        finally:
+            c.close()
+
+    def test_drain(self, client):
+        client.register_node("draining-node")
+        client.drain_node("draining-node")
+        nodes = {n["node_id"]: n for n in client.list_nodes()}
+        assert nodes["draining-node"]["draining"]
+
+    def test_heartbeat_unknown_node(self, client):
+        with pytest.raises(cc.NotFoundError):
+            client.heartbeat("ghost")
+
+
+# ---------------------------------------------------------------------------
+# Actor table
+# ---------------------------------------------------------------------------
+
+class TestActors:
+    def test_lifecycle_fsm(self, client):
+        events = []
+        client.subscribe("actor_events", events.append)
+        client.register_actor("a1", name="svc", meta="{}")
+        assert client.get_actor("a1")["state"] == "PENDING"
+        client.update_actor("a1", "ALIVE")
+        assert client.get_actor("a1")["state"] == "ALIVE"
+        assert client.get_named_actor("svc") == "a1"
+        client.update_actor("a1", "DEAD")
+        with pytest.raises(cc.NotFoundError):
+            client.get_named_actor("svc")  # name freed on death
+        deadline = time.time() + 5
+        while len(events) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert [e.split(b":")[0] for e in events[:3]] == [
+            b"PENDING", b"ALIVE", b"DEAD"]
+
+    def test_duplicate_name_rejected(self, client):
+        client.register_actor("d1", name="taken")
+        with pytest.raises(cc.AlreadyExistsError):
+            client.register_actor("d2", name="taken")
+        # After the holder dies the name is reusable.
+        client.update_actor("d1", "DEAD")
+        client.register_actor("d2", name="taken")
+        assert client.get_named_actor("taken") == "d2"
+
+    def test_list_actors(self, client):
+        client.register_actor("l1")
+        client.register_actor("l2")
+        ids = {a["actor_id"] for a in client.list_actors()}
+        assert {"l1", "l2"} <= ids
+
+
+# ---------------------------------------------------------------------------
+# Jobs, stats, concurrency
+# ---------------------------------------------------------------------------
+
+class TestMisc:
+    def test_jobs(self, client):
+        client.add_job("job-1", meta='{"entrypoint": "train.py"}')
+        jobs = {j["job_id"]: j for j in client.list_jobs()}
+        assert "job-1" in jobs
+
+    def test_ping(self, client):
+        assert client.ping() > 0
+
+    def test_stats_accounting(self, client):
+        for i in range(10):
+            client.kv_put(f"stat/{i}", b"x")
+        stats = client.stats()
+        assert stats[cc.OP_KV_PUT]["count"] >= 10
+        assert stats[cc.OP_KV_PUT]["mean_us"] >= 0
+
+    def test_many_concurrent_clients(self, daemon):
+        import threading
+
+        errors = []
+
+        def worker(i):
+            try:
+                c = cc.ControlClient(daemon)
+                for j in range(20):
+                    c.kv_put(f"conc/{i}/{j}", str(j))
+                assert len(c.kv_keys(f"conc/{i}/")) == 20
+                c.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
+# ---------------------------------------------------------------------------
+# Cluster integration
+# ---------------------------------------------------------------------------
+
+class TestClusterIntegration:
+    def test_cluster_nodes_register_and_die(self):
+        """Cluster nodes register + heartbeat with the native daemon;
+        removing a node lets health expiry declare it DEAD."""
+        import ray_tpu
+        from ray_tpu.cluster_utils import Cluster
+
+        ray_tpu.shutdown()
+        cluster = Cluster(enable_control_plane=True,
+                          health_timeout_ms=700)
+        try:
+            head = cluster.add_node(num_cpus=2)
+            n2 = cluster.add_node(num_cpus=1)
+            events = []
+            cluster.control_client.subscribe("node_events", events.append)
+            nodes = {n["node_id"]: n
+                     for n in cluster.control_client.list_nodes()}
+            assert nodes[head]["alive"] and nodes[n2]["alive"]
+            assert json.loads(nodes[n2]["meta"]).get("CPU") == 1
+
+            cluster.remove_node(n2)
+            deadline = time.time() + 6
+            while not any(f"DEAD:{n2}".encode() in e for e in events) \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert any(f"DEAD:{n2}".encode() in e for e in events)
+            nodes = {n["node_id"]: n
+                     for n in cluster.control_client.list_nodes()}
+            assert not nodes[n2]["alive"]
+            assert nodes[head]["alive"]  # head still heartbeating
+        finally:
+            cluster.shutdown()
