@@ -1,0 +1,109 @@
+"""ctypes binding for the native metrics registry (src/metrics.cc).
+
+Capability-equivalent of the reference's native stats plumbing
+(reference: src/ray/stats/metric.h — native metric objects whose values
+are aggregated natively and exported as Prometheus text by the metrics
+agent). ray_tpu.util.metrics routes its Counter/Gauge/Histogram storage
+here when the library is built; pure-python fallback otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libmetrics.so")
+
+KIND_COUNTER, KIND_GAUGE, KIND_HISTOGRAM = 0, 1, 2
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.rtm_declare.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                ctypes.c_char_p]
+    lib.rtm_counter_add.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_double]
+    lib.rtm_gauge_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                  ctypes.c_double]
+    lib.rtm_hist_observe.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_double,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int]
+    lib.rtm_collect.restype = ctypes.c_long
+    lib.rtm_collect.argtypes = [ctypes.c_char_p, ctypes.c_long]
+    lib.rtm_read.restype = ctypes.c_int
+    lib.rtm_read.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                             ctypes.POINTER(ctypes.c_double)]
+    lib.rtm_reset.argtypes = []
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return False
+    try:
+        _load()
+        return True
+    except (OSError, AttributeError):
+        # AttributeError: stale .so missing a newer symbol.
+        return False
+
+
+def declare(name: str, kind: int, help_text: str = "") -> None:
+    _load().rtm_declare(name.encode(), kind, help_text.encode())
+
+
+def counter_add(name: str, labels: str, value: float) -> None:
+    _load().rtm_counter_add(name.encode(), labels.encode(), value)
+
+
+def gauge_set(name: str, labels: str, value: float) -> None:
+    _load().rtm_gauge_set(name.encode(), labels.encode(), value)
+
+
+def make_bounds(bounds: Sequence[float]):
+    """Prebuilt ctypes bounds array for the observe hot path."""
+    return (ctypes.c_double * len(bounds))(*bounds)
+
+
+def hist_observe(name: str, labels: str, value: float,
+                 bounds: Sequence[float]) -> None:
+    hist_observe_raw(name, labels, value, make_bounds(bounds),
+                     len(bounds))
+
+
+def hist_observe_raw(name: str, labels: str, value: float,
+                     c_bounds, n: int) -> None:
+    _load().rtm_hist_observe(name.encode(), labels.encode(), value,
+                             c_bounds, n)
+
+
+def read(name: str, labels: str = "") -> Optional[float]:
+    v = ctypes.c_double()
+    if _load().rtm_read(name.encode(), labels.encode(),
+                        ctypes.byref(v)):
+        return v.value
+    return None
+
+
+def collect() -> str:
+    lib = _load()
+    # Size-then-fill races concurrent writers (the registry lock is
+    # released between the two calls) — retry until the fill fits.
+    needed = lib.rtm_collect(None, 0)
+    while True:
+        cap = needed + 256
+        buf = ctypes.create_string_buffer(cap)
+        needed = lib.rtm_collect(buf, cap)
+        if needed < cap:
+            return buf.value.decode()
+
+
+def reset() -> None:
+    _load().rtm_reset()

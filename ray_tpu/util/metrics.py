@@ -6,6 +6,11 @@ Histogram :observe, tag_keys/default_tags) plus the Prometheus text
 exposition the reference produces via its per-node metrics agent
 (reference: _private/metrics_agent.py:11-22, prometheus_exporter.py).
 The dashboard serves `prometheus_text()` at /metrics.
+
+Value storage is NATIVE when src/metrics.cc is built (the reference
+aggregates metric values in C++, src/ray/stats/metric.h): the python
+classes keep tag validation and route increments/sets/observations into
+libmetrics.so; pure-python storage is the fallback.
 """
 
 from __future__ import annotations
@@ -18,9 +23,21 @@ _REGISTRY: Dict[str, "Metric"] = {}
 
 TagMap = Tuple[Tuple[str, str], ...]
 
+try:
+    from .._native import metrics as _native
+    _NATIVE = _native.available()
+except Exception:  # noqa: BLE001
+    _native = None
+    _NATIVE = False
+
 
 def _tags_key(tags: Optional[Dict[str, str]]) -> TagMap:
     return tuple(sorted((tags or {}).items()))
+
+
+def _label_str(tags: TagMap) -> str:
+    """Pre-rendered Prometheus label body (no braces)."""
+    return ",".join(f'{k}="{_escape_label(v)}"' for k, v in tags)
 
 
 class Metric:
@@ -33,6 +50,7 @@ class Metric:
         self._tag_keys = tuple(tag_keys or ())
         self._default_tags: Dict[str, str] = {}
         self._lock = threading.Lock()
+        self._label_cache: Dict[TagMap, str] = {}
         with _REGISTRY_LOCK:
             existing = _REGISTRY.get(name)
             if existing is not None and type(existing) is not type(self):
@@ -55,6 +73,14 @@ class Metric:
                 f"{self._tag_keys}")
         return _tags_key(merged)
 
+    def _labels(self, k: TagMap) -> str:
+        """Memoized label body — the native inc/observe hot path must
+        not re-render per sample."""
+        s = self._label_cache.get(k)
+        if s is None:
+            s = self._label_cache[k] = _label_str(k)
+        return s
+
     @property
     def info(self) -> Dict[str, object]:
         return {"name": self._name, "description": self._description,
@@ -65,12 +91,17 @@ class Counter(Metric):
     def __init__(self, name, description="", tag_keys=None):
         super().__init__(name, description, tag_keys)
         self._values: Dict[TagMap, float] = {}
+        if _NATIVE:
+            _native.declare(name, _native.KIND_COUNTER, description)
 
     def inc(self, value: float = 1.0,
             tags: Optional[Dict[str, str]] = None):
         if value < 0:
             raise ValueError("Counter can only increase")
         k = self._merged(tags)
+        if _NATIVE:
+            _native.counter_add(self._name, self._labels(k), value)
+            return
         with self._lock:
             self._values[k] = self._values.get(k, 0.0) + value
 
@@ -79,10 +110,16 @@ class Gauge(Metric):
     def __init__(self, name, description="", tag_keys=None):
         super().__init__(name, description, tag_keys)
         self._values: Dict[TagMap, float] = {}
+        if _NATIVE:
+            _native.declare(name, _native.KIND_GAUGE, description)
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = self._merged(tags)
+        if _NATIVE:
+            _native.gauge_set(self._name, self._labels(k), float(value))
+            return
         with self._lock:
-            self._values[self._merged(tags)] = float(value)
+            self._values[k] = float(value)
 
 
 class Histogram(Metric):
@@ -94,10 +131,20 @@ class Histogram(Metric):
                               (0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10))
         # per tag-set: (bucket counts, sum, count)
         self._values: Dict[TagMap, List] = {}
+        if _NATIVE:
+            _native.declare(name, _native.KIND_HISTOGRAM, description)
+            # Bounds are fixed per histogram — build the ctypes array
+            # once, not per observation.
+            self._c_bounds = _native.make_bounds(self._bounds)
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None):
         k = self._merged(tags)
+        if _NATIVE:
+            _native.hist_observe_raw(self._name, self._labels(k),
+                                     float(value), self._c_bounds,
+                                     len(self._bounds))
+            return
         with self._lock:
             st = self._values.setdefault(
                 k, [[0] * (len(self._bounds) + 1), 0.0, 0])
@@ -112,6 +159,11 @@ class Histogram(Metric):
             st[2] += 1
 
 
+def _fmt_value(v: float) -> str:
+    """Shortest-form float (matches the native exposition's %.12g)."""
+    return f"{float(v):.12g}"
+
+
 def _escape_label(v: str) -> str:
     # Prometheus exposition format: label values must escape \, ", \n.
     return (str(v).replace("\\", "\\\\").replace('"', '\\"')
@@ -119,14 +171,17 @@ def _escape_label(v: str) -> str:
 
 
 def _fmt_tags(tags: TagMap, extra: str = "") -> str:
-    parts = [f'{k}="{_escape_label(v)}"' for k, v in tags]
+    body = _label_str(tags)
     if extra:
-        parts.append(extra)
-    return "{" + ",".join(parts) + "}" if parts else ""
+        body = f"{body},{extra}" if body else extra
+    return "{" + body + "}" if body else ""
 
 
 def prometheus_text() -> str:
-    """Render every registered metric in Prometheus exposition format."""
+    """Render every registered metric in Prometheus exposition format.
+    Native-backed registries render in C++ (rtm_collect)."""
+    if _NATIVE:
+        return _native.collect()
     out: List[str] = []
     with _REGISTRY_LOCK:
         metrics = list(_REGISTRY.values())
@@ -136,12 +191,12 @@ def prometheus_text() -> str:
             out.append(f"# TYPE {name} counter")
             with m._lock:
                 for tags, v in m._values.items():
-                    out.append(f"{name}{_fmt_tags(tags)} {v}")
+                    out.append(f"{name}{_fmt_tags(tags)} {_fmt_value(v)}")
         elif isinstance(m, Gauge):
             out.append(f"# TYPE {name} gauge")
             with m._lock:
                 for tags, v in m._values.items():
-                    out.append(f"{name}{_fmt_tags(tags)} {v}")
+                    out.append(f"{name}{_fmt_tags(tags)} {_fmt_value(v)}")
         elif isinstance(m, Histogram):
             out.append(f"# TYPE {name} histogram")
             with m._lock:
@@ -156,7 +211,7 @@ def prometheus_text() -> str:
                     out.append(
                         f"{name}_bucket{_fmt_tags(tags, 'le=\"+Inf\"')} "
                         f"{acc}")
-                    out.append(f"{name}_sum{_fmt_tags(tags)} {total}")
+                    out.append(f"{name}_sum{_fmt_tags(tags)} {_fmt_value(total)}")
                     out.append(f"{name}_count{_fmt_tags(tags)} {count}")
     return "\n".join(out) + ("\n" if out else "")
 
@@ -165,3 +220,5 @@ def clear_registry() -> None:
     """Test hook."""
     with _REGISTRY_LOCK:
         _REGISTRY.clear()
+    if _NATIVE:
+        _native.reset()

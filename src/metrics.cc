@@ -1,0 +1,184 @@
+// Native metrics registry — counters / gauges / histograms with
+// Prometheus text exposition.
+//
+// Capability-equivalent of the reference's native stats layer
+// (reference: src/ray/stats/metric.h:103 Metric/Gauge/Count/Histogram +
+// metric_defs.cc, exported through the per-node agent to Prometheus via
+// _private/metrics_agent.py). Process-global registry guarded by one
+// mutex; Python binds via ctypes (ray_tpu/_native/metrics.py) and keeps
+// tag validation / help text on its side, passing pre-rendered
+// Prometheus label strings down.
+
+#include <math.h>
+#include <stdio.h>
+#include <string.h>
+
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+enum Kind { KIND_COUNTER = 0, KIND_GAUGE = 1, KIND_HISTOGRAM = 2 };
+
+struct Series {
+  Kind kind = KIND_COUNTER;
+  double value = 0.0;                 // counter / gauge
+  std::vector<double> bounds;         // histogram
+  std::vector<uint64_t> buckets;      // size = bounds + 1 (+Inf)
+  double sum = 0.0;
+  uint64_t count = 0;
+};
+
+struct MetricMeta {
+  Kind kind;
+  std::string help;
+};
+
+std::mutex g_mu;
+// (metric name, label string) -> series. std::map keeps exposition
+// output deterministic.
+std::map<std::pair<std::string, std::string>, Series> g_series;
+std::map<std::string, MetricMeta> g_meta;
+
+Series& series(const char* name, const char* labels, Kind kind) {
+  auto key = std::make_pair(std::string(name),
+                            std::string(labels ? labels : ""));
+  Series& s = g_series[key];
+  s.kind = kind;
+  return s;
+}
+
+}  // namespace
+
+extern "C" {
+
+void rtm_declare(const char* name, int kind, const char* help) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_meta[name] = MetricMeta{static_cast<Kind>(kind),
+                            help ? help : ""};
+}
+
+void rtm_counter_add(const char* name, const char* labels, double v) {
+  if (v < 0) return;  // counters are monotone
+  std::lock_guard<std::mutex> lock(g_mu);
+  series(name, labels, KIND_COUNTER).value += v;
+}
+
+void rtm_gauge_set(const char* name, const char* labels, double v) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  series(name, labels, KIND_GAUGE).value = v;
+}
+
+void rtm_hist_observe(const char* name, const char* labels, double v,
+                      const double* bounds, int nb) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  Series& s = series(name, labels, KIND_HISTOGRAM);
+  if (s.buckets.empty()) {
+    s.bounds.assign(bounds, bounds + nb);
+    s.buckets.assign(nb + 1, 0);
+  }
+  size_t i = 0;
+  for (; i < s.bounds.size(); i++) {
+    if (v <= s.bounds[i]) break;
+  }
+  s.buckets[i] += 1;
+  s.sum += v;
+  s.count += 1;
+}
+
+// Render the whole registry in Prometheus exposition format. Returns
+// the number of bytes required (excluding NUL); writes up to cap-1
+// bytes + NUL into buf. Call with cap=0 to size, then again.
+long rtm_collect(char* buf, long cap) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  std::string out;
+  out.reserve(4096);
+  std::string last_name;
+  char line[512];
+  for (const auto& [key, s] : g_series) {
+    const std::string& name = key.first;
+    const std::string& labels = key.second;
+    if (name != last_name) {
+      last_name = name;
+      auto mit = g_meta.find(name);
+      const char* type =
+          s.kind == KIND_COUNTER ? "counter"
+          : s.kind == KIND_GAUGE ? "gauge" : "histogram";
+      if (mit != g_meta.end() && !mit->second.help.empty()) {
+        out += "# HELP " + name + " " + mit->second.help + "\n";
+      }
+      out += "# TYPE " + name + " " + type + "\n";
+    }
+    auto wrap = [&](const std::string& extra) -> std::string {
+      if (labels.empty() && extra.empty()) return "";
+      if (labels.empty()) return "{" + extra + "}";
+      if (extra.empty()) return "{" + labels + "}";
+      return "{" + labels + "," + extra + "}";
+    };
+    if (s.kind == KIND_HISTOGRAM) {
+      uint64_t cum = 0;
+      for (size_t i = 0; i < s.bounds.size(); i++) {
+        cum += s.buckets[i];
+        snprintf(line, sizeof(line), "%.12g", s.bounds[i]);
+        out += name + "_bucket" +
+               wrap(std::string("le=\"") + line + "\"") + " " +
+               std::to_string(cum) + "\n";
+      }
+      cum += s.buckets.empty() ? 0 : s.buckets.back();
+      out += name + "_bucket" + wrap("le=\"+Inf\"") + " " +
+             std::to_string(cum) + "\n";
+      snprintf(line, sizeof(line), "%.12g", s.sum);
+      out += name + "_sum" + wrap("") + " " + line + "\n";
+      out += name + "_count" + wrap("") + " " +
+             std::to_string(s.count) + "\n";
+    } else {
+      snprintf(line, sizeof(line), "%.12g", s.value);
+      out += name + wrap("") + " " + line + "\n";
+    }
+  }
+  // Declared-but-never-sampled metrics still expose HELP/TYPE (parity
+  // with the python fallback; absent() alerting depends on it).
+  for (const auto& [name, meta] : g_meta) {
+    bool has_series = false;
+    auto it = g_series.lower_bound(std::make_pair(name, std::string()));
+    if (it != g_series.end() && it->first.first == name)
+      has_series = true;
+    if (has_series) continue;
+    const char* type =
+        meta.kind == KIND_COUNTER ? "counter"
+        : meta.kind == KIND_GAUGE ? "gauge" : "histogram";
+    if (!meta.help.empty())
+      out += "# HELP " + name + " " + meta.help + "\n";
+    out += "# TYPE " + name + " " + type + "\n";
+  }
+  long needed = static_cast<long>(out.size());
+  if (buf != nullptr && cap > 0) {
+    long n = needed < cap - 1 ? needed : cap - 1;
+    memcpy(buf, out.data(), n);
+    buf[n] = '\0';
+  }
+  return needed;
+}
+
+// Read back a single scalar series (tests / introspection).
+// Returns 1 if found.
+int rtm_read(const char* name, const char* labels, double* value) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  auto it = g_series.find(std::make_pair(
+      std::string(name), std::string(labels ? labels : "")));
+  if (it == g_series.end()) return 0;
+  *value = it->second.kind == KIND_HISTOGRAM
+               ? static_cast<double>(it->second.count)
+               : it->second.value;
+  return 1;
+}
+
+void rtm_reset() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_series.clear();
+  g_meta.clear();
+}
+
+}  // extern "C"
