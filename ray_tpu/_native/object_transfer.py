@@ -1,0 +1,143 @@
+"""ctypes binding for the native object transfer plane
+(src/object_transfer.cc).
+
+Capability-equivalent of the reference's object manager client surface
+(reference: src/ray/object_manager/ — PullManager/PushManager chunked
+transfers between per-node plasma stores): each node serves its shm
+arena on a TCP port; peers pull/push 28-byte-id objects in 4MiB chunks,
+pinned on the sender and created+sealed on the receiver.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+from .shm_store import ID_LEN
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__),
+                         "libobject_transfer.so")
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.rto_serve.restype = ctypes.c_void_p
+    lib.rto_serve.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                              ctypes.c_int, ctypes.c_int]
+    lib.rto_port.restype = ctypes.c_int
+    lib.rto_port.argtypes = [ctypes.c_void_p]
+    lib.rto_stop.argtypes = [ctypes.c_void_p]
+    lib.rto_connect.restype = ctypes.c_void_p
+    lib.rto_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.rto_close.argtypes = [ctypes.c_void_p]
+    for name in ("rto_pull", "rto_push"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                       ctypes.c_char_p]
+    # This library embeds its own store core — rts_connect et al for
+    # attaching the LOCAL arena the transfer functions operate on.
+    lib.rts_connect.restype = ctypes.c_void_p
+    lib.rts_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                ctypes.c_int]
+    lib.rts_disconnect.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return False
+    try:
+        _load()
+        return True
+    except (OSError, AttributeError):
+        return False
+
+
+class TransferError(Exception):
+    pass
+
+
+_ERRORS = {
+    -1: "object not found",
+    -2: "store full",
+    -3: "wire error",
+}
+
+
+def _check_id(object_id: bytes) -> bytes:
+    if len(object_id) != ID_LEN:
+        raise ValueError(f"object id must be {ID_LEN} bytes")
+    return object_id
+
+
+class TransferServer:
+    """Serve this node's arena to peers (one per node). bind_all=True
+    listens on 0.0.0.0 for real multi-host topologies; the default
+    loopback bind keeps same-host tests unexposed."""
+
+    def __init__(self, shm_name: str, port: int = 0,
+                 bind_all: bool = False):
+        lib = _load()
+        self._h = lib.rto_serve(shm_name.encode(), 0, port,
+                                1 if bind_all else 0)
+        if not self._h:
+            raise TransferError(
+                f"failed to serve transfer plane for {shm_name}")
+        self.port = lib.rto_port(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            _load().rto_stop(self._h)
+            self._h = None
+
+
+class TransferClient:
+    """Persistent connection to one peer's transfer server, bound to
+    the LOCAL arena objects land in / depart from."""
+
+    def __init__(self, host: str, port: int, local_shm_name: str):
+        lib = _load()
+        self._conn = lib.rto_connect(host.encode(), port)
+        if not self._conn:
+            raise TransferError(f"cannot connect to {host}:{port}")
+        self._store = lib.rts_connect(local_shm_name.encode(), 0, 0)
+        if not self._store:
+            lib.rto_close(self._conn)
+            raise TransferError(f"cannot attach arena {local_shm_name}")
+
+    def pull(self, object_id: bytes) -> bool:
+        """Fetch the object from the peer into the local arena.
+        True = transferred; False = already present locally."""
+        rc = _load().rto_pull(self._conn, self._store,
+                              _check_id(object_id))
+        if rc == 0:
+            return True
+        if rc == -4:
+            return False
+        raise TransferError(
+            f"pull failed: {_ERRORS.get(rc, rc)}")
+
+    def push(self, object_id: bytes) -> None:
+        """Send a local object to the peer (idempotent on the peer)."""
+        rc = _load().rto_push(self._conn, self._store,
+                              _check_id(object_id))
+        if rc != 0:
+            raise TransferError(
+                f"push failed: {_ERRORS.get(rc, rc)}")
+
+    def close(self) -> None:
+        lib = _load()
+        if self._conn:
+            lib.rto_close(self._conn)
+            self._conn = None
+        if self._store:
+            lib.rts_disconnect(self._store)
+            self._store = None

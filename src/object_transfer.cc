@@ -1,0 +1,303 @@
+// Object transfer plane — node-to-node bulk object movement between
+// per-node shared-memory stores.
+//
+// Capability-equivalent of the reference's object manager
+// (reference: src/ray/object_manager/object_manager.h:117 — PullManager
+// pull_manager.h:52, PushManager push_manager.h:30, chunked transfer
+// over dedicated gRPC channels in object_manager.proto Push/Pull): each
+// node runs a server thread bound to its shm arena; peers PULL objects
+// (zero-copy read from the pinned arena mapping on the sending side,
+// streamed in chunks, created+sealed into the receiving arena) or PUSH
+// them proactively. Plain TCP instead of gRPC — the capability is the
+// chunked bulk plane, not wire compatibility.
+//
+// Builds WITH the store core: #include "shm_store.cc" gives this
+// library its own connection to the named arena; coordination with
+// other processes happens through the arena's process-shared mutex.
+
+#include "shm_store.cc"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" int rts_abort(void* handle, const uint8_t* id);
+
+namespace {
+
+constexpr uint64_t kChunk = 4ull << 20;  // 4 MiB write chunks
+constexpr uint8_t OP_PULL = 1;
+constexpr uint8_t OP_PUSH = 2;
+
+bool send_all(int fd, const void* data, uint64_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t w = send(fd, p, n > kChunk ? kChunk : n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= w;
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* data, uint64_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+struct TransferServer {
+  void* store = nullptr;     // rts_connect handle (owned)
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread acceptor;
+  // Connection workers run DETACHED (no zombie std::thread per
+  // connection); stop() shuts their sockets down and waits for the
+  // active count to drain.
+  std::atomic<int> active_workers{0};
+  std::mutex fd_mu;
+  std::vector<int> conn_fds;
+
+  ~TransferServer() = default;
+};
+
+void drain(int fd, uint64_t left) {
+  std::vector<char> sink(left > kChunk ? kChunk : left);
+  while (left > 0) {
+    uint64_t n = left > sink.size() ? sink.size() : left;
+    if (!recv_all(fd, sink.data(), n)) return;
+    left -= n;
+  }
+}
+
+void serve_conn(TransferServer* ts, int fd) {
+  Store* st = reinterpret_cast<Store*>(ts->store);
+  for (;;) {
+    uint8_t op;
+    if (!recv_all(fd, &op, 1)) break;
+    uint8_t id[kIdLen];
+    if (!recv_all(fd, id, kIdLen)) break;
+
+    if (op == OP_PULL) {
+      uint64_t off = 0, size = 0;
+      int64_t rsize = -1;
+      // Pin while sending so eviction can't pull the mapping out from
+      // under the send (reference: object pinning during transfer).
+      bool pinned = rts_get(ts->store, id, &off, &size, 1) == 0;
+      if (pinned) rsize = static_cast<int64_t>(size);
+      if (!send_all(fd, &rsize, 8)) {
+        if (pinned) rts_release(ts->store, id);
+        break;
+      }
+      bool ok = true;
+      if (pinned) {
+        ok = send_all(fd, st->base + off, size);
+        rts_release(ts->store, id);
+      }
+      if (!ok) break;
+    } else if (op == OP_PUSH) {
+      uint64_t size = 0;
+      if (!recv_all(fd, &size, 8)) break;
+      uint64_t off = 0;
+      uint8_t status = 0;
+      int rc = rts_create(ts->store, id, size, &off);
+      if (rc == 0) {
+        if (!recv_all(fd, st->base + off, size)) {
+          rts_abort(ts->store, id);
+          break;
+        }
+        rts_seal(ts->store, id);
+      } else {
+        // Duplicate (-1, idempotent success) or store full (status 2):
+        // either way the payload is in flight — drain it so the
+        // persistent connection stays framed and the peer gets the
+        // REAL status instead of a reset mid-send.
+        drain(fd, size);
+        if (rc != -1) status = 2;
+      }
+      if (!send_all(fd, &status, 1)) break;
+    } else {
+      break;
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Abort a created-but-unsealed object (receiver-side failure path).
+int rts_abort(void* handle, const uint8_t* id) {
+  return rts_delete(handle, id);
+}
+
+// bind_all != 0 → 0.0.0.0 (real node-to-node topologies); 0 →
+// loopback (same-host testing without exposing the arena).
+void* rto_serve(const char* shm_name, uint64_t capacity, int port,
+                int bind_all) {
+  void* store = rts_connect(shm_name, capacity, 0);
+  if (store == nullptr) return nullptr;
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(bind_all ? INADDR_ANY : INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    rts_disconnect(store);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+
+  TransferServer* ts = new TransferServer();
+  ts->store = store;
+  ts->listen_fd = fd;
+  ts->port = ntohs(addr.sin_port);
+  ts->acceptor = std::thread([ts]() {
+    for (;;) {
+      int cfd = accept(ts->listen_fd, nullptr, nullptr);
+      if (cfd < 0) {
+        if (ts->stopping.load()) return;
+        if (errno == EINTR) continue;
+        return;
+      }
+      int one = 1;
+      setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> lock(ts->fd_mu);
+        if (ts->stopping.load()) {
+          close(cfd);
+          continue;
+        }
+        ts->conn_fds.push_back(cfd);
+      }
+      ts->active_workers.fetch_add(1);
+      std::thread([ts, cfd]() {
+        serve_conn(ts, cfd);
+        {
+          std::lock_guard<std::mutex> lock(ts->fd_mu);
+          auto& v = ts->conn_fds;
+          v.erase(std::remove(v.begin(), v.end(), cfd), v.end());
+        }
+        ts->active_workers.fetch_sub(1);
+      }).detach();
+    }
+  });
+  return ts;
+}
+
+int rto_port(void* handle) {
+  return reinterpret_cast<TransferServer*>(handle)->port;
+}
+
+void rto_stop(void* handle) {
+  TransferServer* ts = reinterpret_cast<TransferServer*>(handle);
+  ts->stopping.store(true);
+  shutdown(ts->listen_fd, SHUT_RDWR);
+  close(ts->listen_fd);
+  if (ts->acceptor.joinable()) ts->acceptor.join();
+  // Kick idle workers out of recv_all — an open-but-quiet client must
+  // not wedge stop().
+  {
+    std::lock_guard<std::mutex> lock(ts->fd_mu);
+    for (int fd : ts->conn_fds) shutdown(fd, SHUT_RDWR);
+  }
+  while (ts->active_workers.load() > 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  rts_disconnect(ts->store);
+  delete ts;
+}
+
+// Client-side persistent connection to a peer's transfer server.
+void* rto_connect(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return reinterpret_cast<void*>(static_cast<intptr_t>(fd) + 1);
+}
+
+void rto_close(void* conn) {
+  close(static_cast<int>(reinterpret_cast<intptr_t>(conn)) - 1);
+}
+
+// Pull `id` from the peer into the local arena. Returns 0 on success,
+// -1 remote miss, -2 local store full, -3 wire error, -4 local dup.
+int rto_pull(void* conn, void* local_store, const uint8_t* id) {
+  int fd = static_cast<int>(reinterpret_cast<intptr_t>(conn)) - 1;
+  Store* st = reinterpret_cast<Store*>(local_store);
+  uint8_t op = OP_PULL;
+  if (!send_all(fd, &op, 1) || !send_all(fd, id, kIdLen)) return -3;
+  int64_t size;
+  if (!recv_all(fd, &size, 8)) return -3;
+  if (size < 0) return -1;
+  uint64_t off = 0;
+  int rc = rts_create(local_store, id, size, &off);
+  if (rc != 0) {
+    // Duplicate (-1) or local store full: the server is already
+    // streaming `size` bytes — drain them or the persistent
+    // connection desyncs and every later request reads payload bytes
+    // as headers.
+    drain(fd, size);
+    return rc == -1 ? -4 : -2;
+  }
+  if (!recv_all(fd, st->base + off, size)) {
+    rts_abort(local_store, id);
+    return -3;
+  }
+  rts_seal(local_store, id);
+  return 0;
+}
+
+// Push a local object to the peer. Returns 0 ok, -1 local miss,
+// -2 peer full, -3 wire error.
+int rto_push(void* conn, void* local_store, const uint8_t* id) {
+  int fd = static_cast<int>(reinterpret_cast<intptr_t>(conn)) - 1;
+  Store* st = reinterpret_cast<Store*>(local_store);
+  uint64_t off = 0, size = 0;
+  if (rts_get(local_store, id, &off, &size, 1) != 0) return -1;
+  uint8_t op = OP_PUSH;
+  bool ok = send_all(fd, &op, 1) && send_all(fd, id, kIdLen) &&
+            send_all(fd, &size, 8) && send_all(fd, st->base + off, size);
+  rts_release(local_store, id);
+  if (!ok) return -3;
+  uint8_t status = 0;
+  if (!recv_all(fd, &status, 1)) return -3;
+  return status == 0 ? 0 : -2;
+}
+
+}  // extern "C"

@@ -78,7 +78,7 @@ def test_thread_safety_under_contention():
     assert nm.read("contended") == 8000
 
 
-def test_python_api_routes_native(ray_start):
+def test_python_api_routes_native():
     from ray_tpu.util import metrics
 
     metrics.clear_registry()
