@@ -99,11 +99,20 @@ class ShmStore:
         finally:
             os.close(fd)
 
+    def _h(self):
+        """Live handle or raise — a closed store must never reach the C
+        layer (null-handle deref segfaults; __del__-time teardown of
+        channel users can outlive the runtime's store)."""
+        h = self._handle
+        if not h:
+            raise ShmStoreError("store is closed")
+        return h
+
     # -- immutable objects ------------------------------------------------
     def put(self, object_id: bytes, data: bytes | memoryview) -> None:
         assert len(object_id) == ID_LEN
         off = ctypes.c_uint64()
-        rc = lib().rts_create(self._handle, object_id, len(data),
+        rc = lib().rts_create(self._h(), object_id, len(data),
                               ctypes.byref(off))
         if rc == -1:
             raise ObjectExistsError(object_id.hex())
@@ -114,24 +123,24 @@ class ShmStore:
         if rc != 0:
             raise ShmStoreError(f"create failed rc={rc}")
         self._map[off.value:off.value + len(data)] = bytes(data)
-        if lib().rts_seal(self._handle, object_id) != 0:
+        if lib().rts_seal(self._h(), object_id) != 0:
             raise ShmStoreError("seal failed")
 
     def get(self, object_id: bytes, *, pin: bool = False
             ) -> Optional[memoryview]:
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
-        rc = lib().rts_get(self._handle, object_id, ctypes.byref(off),
+        rc = lib().rts_get(self._h(), object_id, ctypes.byref(off),
                            ctypes.byref(size), 1 if pin else 0)
         if rc != 0:
             return None
         return memoryview(self._map)[off.value:off.value + size.value]
 
     def release(self, object_id: bytes) -> None:
-        lib().rts_release(self._handle, object_id)
+        lib().rts_release(self._h(), object_id)
 
     def contains(self, object_id: bytes) -> bool:
-        return bool(lib().rts_contains(self._handle, object_id))
+        return bool(lib().rts_contains(self._h(), object_id))
 
     def delete(self, object_id: bytes) -> bool:
         if not self._handle:
@@ -139,18 +148,18 @@ class ShmStore:
         return lib().rts_delete(self._handle, object_id) == 0
 
     def used(self) -> int:
-        return lib().rts_used(self._handle)
+        return lib().rts_used(self._h())
 
     def capacity(self) -> int:
-        return lib().rts_capacity(self._handle)
+        return lib().rts_capacity(self._h())
 
     def num_objects(self) -> int:
-        return lib().rts_num_objects(self._handle)
+        return lib().rts_num_objects(self._h())
 
     # -- mutable channel objects -----------------------------------------
     def channel_create(self, object_id: bytes, max_size: int) -> None:
         off = ctypes.c_uint64()
-        rc = lib().rts_ch_create(self._handle, object_id, max_size,
+        rc = lib().rts_ch_create(self._h(), object_id, max_size,
                                  ctypes.byref(off))
         if rc == -1:
             raise ObjectExistsError(object_id.hex())
@@ -160,37 +169,49 @@ class ShmStore:
     def channel_write(self, object_id: bytes, data: bytes) -> None:
         off = ctypes.c_uint64()
         rc = lib().rts_ch_write_acquire(
-            self._handle, object_id, len(data), ctypes.byref(off))
+            self._h(), object_id, len(data), ctypes.byref(off))
         if rc != 0:
             raise ShmStoreError(f"write_acquire failed rc={rc}")
         self._map[off.value:off.value + len(data)] = data
-        if lib().rts_ch_write_release(self._handle, object_id) != 0:
+        if lib().rts_ch_write_release(self._h(), object_id) != 0:
             raise ShmStoreError("write_release failed")
 
     def channel_read(self, object_id: bytes, *, min_version: int = -1,
                      timeout: float = 10.0) -> Tuple[bytes, int]:
         """Read the channel; blocks until version > min_version (a new
-        write since the reader's last version)."""
-        deadline = time.monotonic() + timeout
+        write since the reader's last version).
+
+        Polling is adaptive: GIL-yield spins for the first ~2ms (the
+        compiled-DAG hot path is sub-millisecond), then escalating
+        sleeps — latency when it matters, no busy-burn when idle."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         while True:
-            v = lib().rts_ch_read(self._handle, object_id,
+            v = lib().rts_ch_read(self._h(), object_id,
                                   ctypes.byref(off), ctypes.byref(size))
             if v >= 0 and v > min_version and size.value > 0:
                 data = bytes(
                     self._map[off.value:off.value + size.value])
                 # seqlock re-check: version must be unchanged after copy
-                v2 = lib().rts_ch_read(self._handle, object_id,
+                v2 = lib().rts_ch_read(self._h(), object_id,
                                        ctypes.byref(off),
                                        ctypes.byref(size))
                 if v2 == v:
                     return data, int(v)
             if v == -1:
                 raise ShmStoreError("channel missing")
-            if time.monotonic() > deadline:
+            now = time.monotonic()
+            if now > deadline:
                 raise TimeoutError("channel read timed out")
-            time.sleep(0.0002)
+            waited = now - t0
+            if waited < 0.002:
+                time.sleep(0)        # yield the GIL, stay hot
+            elif waited < 0.05:
+                time.sleep(0.0001)
+            else:
+                time.sleep(0.001)
 
     def close(self):
         if self._handle:

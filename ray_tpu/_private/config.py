@@ -98,6 +98,9 @@ _D("object_store_memory_bytes", int, 1 * 1024**3,
    "Capacity of the per-node shared-memory object store.")
 _D("object_spilling_dir", str, "",
    "Directory for spilled objects ('' = <session_dir>/spill).")
+_D("memory_store_spill_threshold_bytes", int, 2 * 1024**3,
+   "Spill memory-store objects to disk past this many in-memory bytes "
+   "(0 = never spill).")
 _D("object_store_full_initial_retry_ms", int, 10, "")
 _D("object_store_full_max_retries", int, 10, "")
 _D("worker_pool_size", int, 0,

@@ -21,30 +21,42 @@ from .serialization import SerializedObject
 
 
 class StoredObject:
-    __slots__ = ("data", "is_error", "created_at", "nbytes")
+    __slots__ = ("data", "is_error", "created_at", "nbytes",
+                 "spill_path")
 
     def __init__(self, data: SerializedObject, is_error: bool):
         self.data = data
         self.is_error = is_error
         self.created_at = time.monotonic()
         self.nbytes = data.total_bytes()
+        self.spill_path: Optional[str] = None  # set while on disk
 
 
 class MemoryStore:
-    def __init__(self):
+    """spiller + high_watermark_bytes enable disk overflow (reference:
+    local_object_manager spilling — see spilling.py): objects past the
+    watermark move to disk oldest-first and restore on access."""
+
+    def __init__(self, spiller=None, high_watermark_bytes: int = 0):
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._objects: Dict[ObjectID, StoredObject] = {}
         self._waiter_cbs: Dict[ObjectID, List[Callable[[ObjectID], None]]] = {}
-        self.total_bytes = 0
+        self.total_bytes = 0  # in-MEMORY bytes (spilled don't count)
+        self._spiller = spiller
+        self._high = high_watermark_bytes
+        self._spill_lock = threading.Lock()  # one spill pass at a time
 
     # -- write ------------------------------------------------------------
     def put(self, object_id: ObjectID, data: SerializedObject,
             is_error: bool = False) -> None:
         with self._lock:
             prev = self._objects.get(object_id)
-            if prev is not None:
+            if prev is not None and prev.spill_path is None:
                 self.total_bytes -= prev.nbytes
+            if prev is not None and prev.spill_path is not None \
+                    and self._spiller is not None:
+                self._spiller.delete(prev.spill_path)
             obj = StoredObject(data, is_error)
             self._objects[object_id] = obj
             self.total_bytes += obj.nbytes
@@ -52,13 +64,106 @@ class MemoryStore:
             self._cv.notify_all()
         for cb in cbs:
             cb(object_id)
+        self._maybe_spill()
 
     def delete(self, object_ids: Sequence[ObjectID]) -> None:
         with self._lock:
             for oid in object_ids:
                 obj = self._objects.pop(oid, None)
                 if obj is not None:
-                    self.total_bytes -= obj.nbytes
+                    if obj.spill_path is not None:
+                        if self._spiller is not None:
+                            self._spiller.delete(obj.spill_path)
+                    else:
+                        self.total_bytes -= obj.nbytes
+
+    # -- spilling ---------------------------------------------------------
+    @staticmethod
+    def _spillable(obj: StoredObject) -> bool:
+        # Only real serialized frames spill: shm markers / error stubs
+        # have no meaningful to_bytes round-trip.
+        return (obj.spill_path is None and not obj.is_error
+                and isinstance(obj.data, SerializedObject))
+
+    def _maybe_spill(self) -> None:
+        """Move oldest in-memory objects to disk until below the high
+        watermark. File IO happens OUTSIDE the store lock; the entry
+        swaps to a stub only after the write completes. Readers are
+        never affected: get() hands out snapshots whose data reference
+        keeps the bytes alive regardless of the canonical entry."""
+        if self._spiller is None or not self._high:
+            return
+        if self.total_bytes <= self._high:
+            return
+        with self._spill_lock:
+            with self._lock:
+                excess = self.total_bytes - self._high
+                if excess <= 0:
+                    return
+                # One sort per pass (not per victim).
+                victims = sorted(
+                    ((oid, o) for oid, o in self._objects.items()
+                     if self._spillable(o)),
+                    key=lambda kv: kv[1].created_at)
+                plan = []
+                for oid, o in victims:
+                    if excess <= 0:
+                        break
+                    plan.append((oid, o, o.data))
+                    excess -= o.nbytes
+            for oid, obj, data in plan:
+                path = self._spiller.spill(oid, data)
+                with self._lock:
+                    cur = self._objects.get(oid)
+                    if cur is obj and cur.spill_path is None:
+                        cur.spill_path = path
+                        cur.data = None
+                        self.total_bytes -= cur.nbytes
+                    else:
+                        # Replaced/deleted mid-spill — drop the file.
+                        self._spiller.delete(path)
+
+    def _restore(self, object_id: ObjectID) -> Optional[StoredObject]:
+        """Bring a spilled object back; file IO outside the lock.
+        Returns a SNAPSHOT safe against concurrent re-spills (or None
+        if the object vanished)."""
+        while True:
+            with self._lock:
+                obj = self._objects.get(object_id)
+                if obj is None:
+                    return None
+                if obj.spill_path is None:
+                    return self._snapshot(obj)
+                path = obj.spill_path
+            try:
+                data = self._spiller.restore(path)
+            except FileNotFoundError:
+                # Concurrent restore/delete — loop to re-observe state.
+                continue
+            with self._lock:
+                cur = self._objects.get(object_id)
+                if cur is None:
+                    return None
+                if cur.spill_path == path:
+                    cur.data = data
+                    cur.spill_path = None
+                    cur.created_at = time.monotonic()
+                    self.total_bytes += cur.nbytes
+                    self._spiller.delete(path)
+                    return self._snapshot(cur)
+                # Someone else finished first; use their result.
+
+    @staticmethod
+    def _snapshot(obj: StoredObject) -> StoredObject:
+        """Reader-held view: shares the data reference so a later spill
+        pass nulling the canonical entry can't affect the reader."""
+        snap = StoredObject.__new__(StoredObject)
+        snap.data = obj.data
+        snap.is_error = obj.is_error
+        snap.created_at = obj.created_at
+        snap.nbytes = obj.nbytes
+        snap.spill_path = None
+        return snap
 
     # -- read -------------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
@@ -67,27 +172,63 @@ class MemoryStore:
 
     def get_if_exists(self, object_id: ObjectID) -> Optional[StoredObject]:
         with self._lock:
-            return self._objects.get(object_id)
+            obj = self._objects.get(object_id)
+            if obj is None:
+                return None
+            if obj.spill_path is None:
+                return self._snapshot(obj)
+        out = self._restore(object_id)  # file IO outside the lock
+        self._maybe_spill()
+        return out
 
     def get(self, object_ids: Sequence[ObjectID],
             timeout: Optional[float] = None) -> List[StoredObject]:
-        """Blocking get of all ids. Raises GetTimeoutError on timeout."""
+        """Blocking get of all ids (restoring spilled ones). Raises
+        GetTimeoutError on timeout."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._lock:
-            while True:
-                missing = [o for o in object_ids if o not in self._objects]
-                if not missing:
-                    return [self._objects[o] for o in object_ids]
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        raise GetTimeoutError(
-                            f"Timed out waiting for {len(missing)} object(s); "
-                            f"first missing: {missing[0].hex()}"
-                        )
-                    self._cv.wait(remaining)
-                else:
-                    self._cv.wait()
+        while True:
+            spilled: List[ObjectID] = []
+            with self._lock:
+                while True:
+                    missing = [o for o in object_ids
+                               if o not in self._objects]
+                    if not missing:
+                        break
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise GetTimeoutError(
+                                f"Timed out waiting for {len(missing)} "
+                                f"object(s); first missing: "
+                                f"{missing[0].hex()}")
+                        self._cv.wait(remaining)
+                    else:
+                        self._cv.wait()
+                out: List[Optional[StoredObject]] = []
+                for o in object_ids:
+                    obj = self._objects[o]
+                    if obj.spill_path is not None:
+                        spilled.append(o)
+                        out.append(None)
+                    else:
+                        out.append(self._snapshot(obj))
+            if not spilled:
+                return out
+            # Restore outside the lock; a vanished object (deleted
+            # mid-restore) restarts the wait loop.
+            ok = True
+            restored: Dict[ObjectID, StoredObject] = {}
+            for oid in spilled:
+                snap = self._restore(oid)
+                if snap is None:
+                    ok = False
+                    break
+                restored[oid] = snap
+            self._maybe_spill()
+            if not ok:
+                continue
+            return [restored.get(o) or out[i]
+                    for i, o in enumerate(object_ids)]
 
     def wait(self, object_ids: Sequence[ObjectID], num_returns: int,
              timeout: Optional[float]) -> tuple[List[ObjectID], List[ObjectID]]:

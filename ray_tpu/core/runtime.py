@@ -393,6 +393,12 @@ class ActorState:
             self._die(gen)
 
     def _bind_method(self, spec: TaskSpec):
+        if spec.method_name == "__ray_tpu_apply__":
+            # Injected execution: first arg is a callable taking the
+            # actor instance (compiled-DAG loops use this to pin a
+            # driver-provided loop onto the actor; reference:
+            # compiled_dag_node.py do_exec_compiled_task).
+            return lambda fn, *a, **kw: fn(self.instance, *a, **kw)
         method = getattr(self.instance, spec.method_name)
         return method
 
@@ -634,7 +640,21 @@ class Runtime:
                  _system_config: Optional[Dict[str, Any]] = None):
         config.apply(_system_config)
         self.job_id = JobID.from_random()
-        self.store = MemoryStore()
+        # Session directory first: the spiller lands under it.
+        from .._private import session as _session
+
+        self.session_dir = _session.new_session()
+        spiller = None
+        if config.memory_store_spill_threshold_bytes > 0:
+            from .spilling import ObjectSpiller
+
+            spiller = ObjectSpiller(
+                config.object_spilling_dir
+                or os.path.join(self.session_dir, "spill"))
+        self.spiller = spiller
+        self.store = MemoryStore(
+            spiller=spiller,
+            high_watermark_bytes=config.memory_store_spill_threshold_bytes)
         self.reference_counter = ReferenceCounter(self._on_refcount_zero)
         self.function_manager = FunctionManager()
         self.events = TaskEventBuffer()
@@ -693,12 +713,6 @@ class Runtime:
             max_workers=max(4, int(num_cpus) * 2),
         )
         self.scheduler.add_node(head)
-
-        # Session directory: logs + usage stats + spill live here
-        # (reference: /tmp/ray/session_*/; _private/node.py).
-        from .._private import session as _session
-
-        self.session_dir = _session.new_session()
 
         # Out-of-process execution plane: spawned worker processes behind
         # a pool node (see worker_proc.py). Objects ride the shared shm

@@ -1,0 +1,68 @@
+"""Object spilling — disk overflow for the object store.
+
+Capability-equivalent of the reference's spilling stack
+(reference: src/ray/raylet/local_object_manager.h:41 SpillObjects /
+restore, python/ray/_private/external_storage.py:72 FileSystemStorage
+:246 — when the store crosses its memory budget, primary copies move to
+external storage and restore transparently on access): sealed objects
+past the high watermark are written to <session>/spill as flat
+SerializedObject frames; the in-memory entry becomes a stub holding the
+file path; get() restores on touch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from .ids import ObjectID
+from .serialization import SerializedObject
+
+
+class ObjectSpiller:
+    """Filesystem external storage (reference: FileSystemStorage)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self.spilled_bytes = 0
+        self.spilled_objects = 0
+        self.restored_objects = 0
+
+    def _path(self, object_id: ObjectID) -> str:
+        return os.path.join(self.directory, object_id.hex())
+
+    def spill(self, object_id: ObjectID, data: SerializedObject) -> str:
+        path = self._path(object_id)
+        tmp = path + ".tmp"
+        frame = data.to_bytes()
+        with open(tmp, "wb") as f:
+            f.write(frame)
+        os.replace(tmp, path)  # atomic: no half-written spill files
+        with self._lock:
+            self.spilled_bytes += len(frame)
+            self.spilled_objects += 1
+        return path
+
+    def restore(self, path: str) -> SerializedObject:
+        with open(path, "rb") as f:
+            frame = f.read()
+        with self._lock:
+            self.restored_objects += 1
+        return SerializedObject.from_bytes(frame)
+
+    def delete(self, path: str) -> None:
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "spilled_objects": self.spilled_objects,
+                "spilled_bytes": self.spilled_bytes,
+                "restored_objects": self.restored_objects,
+            }

@@ -1,3 +1,4 @@
+from .compiled import Channel, CompiledDAG, compile_dag
 from .node import (
     ActorMethodNode,
     ClassNode,
@@ -10,4 +11,5 @@ from .node import (
 __all__ = [
     "DAGNode", "FunctionNode", "ClassNode", "ActorMethodNode",
     "InputNode", "MultiOutputNode",
+    "CompiledDAG", "compile_dag", "Channel",
 ]

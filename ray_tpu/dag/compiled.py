@@ -1,0 +1,293 @@
+"""Compiled DAG execution over shared-memory channels.
+
+Capability-equivalent of the reference's accelerated/compiled DAGs
+(reference: python/ray/dag/compiled_dag_node.py — do_exec_compiled_task
+:34 pinned actor loops; python/ray/experimental/channel.py — Channel :48
+over mutable plasma objects :37): `compile_dag(dag)` allocates one
+channel per edge ONCE, then each participating actor parks in a
+read→exec→write loop pinned to the actor (no per-call task submission,
+scheduling, or result-store traffic) — the ~10x lower per-call latency
+path the reference benchmarks as "compiled DAGs"
+(_private/ray_perf.py:397-399).
+
+Channels are the native store's mutable objects (src/shm_store.cc
+rts_ch_* — the seqlock buffer equivalent of plasma's experimental
+mutable objects) when the native plane is up; an in-process blocking
+queue fallback keeps the same semantics otherwise. Channels cross the
+driver→actor boundary as SPECS (tag + id) and are re-attached on the
+executing side, so nothing unpicklable rides the task path.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue as _pyqueue
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+_SENTINEL = b"__ray_tpu_dag_teardown__"
+
+# In-process channel registry (fallback path; shared by driver and
+# in-process actors because they share the module).
+_PROC_CHANNELS: Dict[str, "_ProcQueue"] = {}
+_PROC_LOCK = threading.Lock()
+
+
+class _ProcQueue:
+    def __init__(self):
+        self.q: "_pyqueue.Queue[bytes]" = _pyqueue.Queue(maxsize=8)
+
+
+ChannelSpec = Tuple[str, Any]  # ("proc", key) | ("shm", id_bytes)
+
+
+def _make_spec(use_shm: bool) -> ChannelSpec:
+    if use_shm:
+        return ("shm", b"dagch" + uuid.uuid4().bytes[:23])
+    key = uuid.uuid4().hex
+    with _PROC_LOCK:
+        _PROC_CHANNELS[key] = _ProcQueue()
+    return ("proc", key)
+
+
+class Channel:
+    """One endpoint of a channel; construct per side from its spec
+    (reader version state is endpoint-local, seqlock style)."""
+
+    def __init__(self, spec: ChannelSpec, *, create: bool = False,
+                 max_size: int = 1 << 20):
+        self.spec = spec
+        self._version = -1
+        kind, key = spec
+        if kind == "shm":
+            from ..core.runtime import global_runtime
+
+            self._store = global_runtime().shm
+            if self._store is None:
+                raise RuntimeError("shm plane not available")
+            if create:
+                self._store.channel_create(key, max_size)
+        else:
+            with _PROC_LOCK:
+                self._q = _PROC_CHANNELS[key]
+
+    def write(self, data: bytes) -> None:
+        kind, key = self.spec
+        if kind == "shm":
+            self._store.channel_write(key, data)
+        else:
+            self._q.q.put(data)
+
+    def read(self, timeout: float = 30.0) -> bytes:
+        kind, key = self.spec
+        if kind == "shm":
+            data, v = self._store.channel_read(
+                key, min_version=self._version, timeout=timeout)
+            self._version = v
+            return data
+        return self._q.q.get(timeout=timeout)
+
+    def close(self) -> None:
+        kind, key = self.spec
+        if kind == "shm":
+            try:
+                self._store.delete(key)
+            except Exception:  # noqa: BLE001
+                pass
+        else:
+            with _PROC_LOCK:
+                _PROC_CHANNELS.pop(key, None)
+
+
+def _compiled_actor_loop(instance, method_name: str,
+                         arg_plan: List[tuple],
+                         const_kwargs: Dict[str, Any],
+                         in_specs: List[ChannelSpec],
+                         out_spec: ChannelSpec, timeout: float):
+    """Runs ON the actor: read args → run method → write result
+    (reference: do_exec_compiled_task's pinned loop).
+
+    arg_plan mirrors the node's bound args positionally: ("ch", i)
+    pulls channel i's frame, ("const", v) is a literal.
+    """
+    method = getattr(instance, method_name)
+    ins = [Channel(s) for s in in_specs]
+    out = Channel(out_spec)
+    while True:
+        try:
+            frames = [ch.read(timeout=timeout) for ch in ins]
+        except (TimeoutError, _pyqueue.Empty):
+            continue  # idle is not teardown — keep the DAG alive
+        except Exception:  # noqa: BLE001 - channel gone: teardown
+            return
+        if any(f == _SENTINEL for f in frames):
+            out.write(_SENTINEL)  # propagate teardown downstream
+            return
+        try:
+            values = [pickle.loads(f) for f in frames]
+            args = [values[i] if kind == "ch" else i
+                    for kind, i in arg_plan]
+            result = method(*args, **const_kwargs)
+            out.write(pickle.dumps(result))
+        except Exception as e:  # noqa: BLE001
+            try:
+                out.write(pickle.dumps(_WrappedError(e)))
+            except Exception:  # noqa: BLE001
+                out.write(pickle.dumps(_WrappedError(
+                    RuntimeError(f"{type(e).__name__}: {e}"))))
+
+
+class _WrappedError:
+    def __init__(self, e: BaseException):
+        self.error = e
+
+
+class CompiledDAG:
+    """A compiled pipeline of actor-method nodes.
+
+    Supported shape (matches the reference's early compiled DAGs):
+    InputNode → chain of ActorMethodNodes → output, each stage's output
+    consumed by exactly one downstream stage.
+    """
+
+    def __init__(self, output_node, *, channel_size: int = 1 << 20,
+                 timeout: float = 60.0):
+        from .node import ActorMethodNode, InputNode
+
+        self._timeout = timeout
+        self._closed = False
+
+        order: List[Any] = []
+        seen = set()
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for dep in node._deps():
+                visit(dep)
+            order.append(node)
+
+        visit(output_node)
+        self._input = next(
+            (n for n in order if isinstance(n, InputNode)), None)
+        if self._input is None:
+            raise ValueError("compiled DAG needs an InputNode")
+        self._nodes = [n for n in order if isinstance(n, ActorMethodNode)]
+        if not self._nodes or self._nodes[-1] is not order[-1]:
+            raise ValueError(
+                "compiled DAG output must be an actor-method node")
+        # Single-consumer validation: each producer feeds one stage.
+        # Constant bound args/kwargs are captured into the loop;
+        # DAG-node kwargs are not supported.
+        consumers: Dict[int, int] = {}
+        for n in self._nodes:
+            for d in n._deps():
+                consumers[id(d)] = consumers.get(id(d), 0) + 1
+                if not isinstance(d, (ActorMethodNode, InputNode)):
+                    raise ValueError(
+                        f"unsupported compiled-DAG dep "
+                        f"{type(d).__name__}")
+            from .node import DAGNode as _DAGNode
+
+            if any(isinstance(v, _DAGNode)
+                   for v in n._bound_kwargs.values()):
+                raise ValueError(
+                    "compiled DAGs do not support DAG-node kwargs; "
+                    "pass upstream nodes positionally")
+        if any(c > 1 for c in consumers.values()):
+            raise ValueError(
+                "compiled DAGs support single-consumer channels; an "
+                "output is consumed by multiple stages")
+
+        from ..core.runtime import global_runtime_or_none
+
+        rt = global_runtime_or_none()
+        use_shm = rt is not None and rt.shm is not None
+
+        # One channel per edge, allocated once (reference: channels
+        # allocated at compile time, reused every execute()).
+        self._spec_of: Dict[int, ChannelSpec] = {}
+        self._chan_of: Dict[int, Channel] = {}
+        for node in [self._input] + self._nodes:
+            spec = _make_spec(use_shm)
+            self._spec_of[id(node)] = spec
+            self._chan_of[id(node)] = Channel(spec, create=True,
+                                              max_size=channel_size)
+        self._in_chan = self._chan_of[id(self._input)]
+        self._out_chan = self._chan_of[id(self._nodes[-1])]
+
+        # Park the loop on every actor (injected-callable task).
+        import ray_tpu
+        from ..core.actor import ActorMethod
+        from .node import DAGNode as _DAGNode
+
+        self._loop_refs = []
+        for n in self._nodes:
+            handle = n._resolve_handle()
+            self._require_in_process(rt, handle)
+            in_specs = []
+            arg_plan = []
+            for a in n._bound_args:
+                if isinstance(a, _DAGNode):
+                    arg_plan.append(("ch", len(in_specs)))
+                    in_specs.append(self._spec_of[id(a)])
+                else:
+                    arg_plan.append(("const", a))
+            ref = ActorMethod(handle, "__ray_tpu_apply__").remote(
+                _compiled_actor_loop, n._method_name, arg_plan,
+                dict(n._bound_kwargs), in_specs,
+                self._spec_of[id(n)], self._timeout)
+            self._loop_refs.append(ref)
+        # Surface immediate loop-spawn failures (bad method name etc.)
+        # instead of a later opaque execute() timeout.
+        ready, _ = ray_tpu.wait(self._loop_refs,
+                                num_returns=1, timeout=0.2)
+        if ready:
+            ray_tpu.get(ready[0])  # raises the loop's error
+
+    @staticmethod
+    def _require_in_process(rt, handle) -> None:
+        """Compiled loops run via the in-process injected-callable path;
+        proc-pool actors would fail opaquely — reject them up front."""
+        if rt is None:
+            return
+        st = rt._actors.get(handle._actor_id)
+        if st is not None and type(st).__name__.startswith("Proc"):
+            raise NotImplementedError(
+                "compiled DAGs over process-pool actors are not "
+                "supported yet; create the actor without the proc pool")
+
+    # -- execution ------------------------------------------------------
+    def execute(self, value: Any) -> Any:
+        if self._closed:
+            raise RuntimeError("compiled DAG torn down")
+        self._in_chan.write(pickle.dumps(value))
+        out = pickle.loads(self._out_chan.read(timeout=self._timeout))
+        if isinstance(out, _WrappedError):
+            raise out.error
+        return out
+
+    def teardown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # The sentinel flows through every stage, unparking the loops.
+        try:
+            self._in_chan.write(_SENTINEL)
+            self._out_chan.read(timeout=min(self._timeout, 5.0))
+        except Exception:  # noqa: BLE001
+            pass
+        for ch in self._chan_of.values():
+            ch.close()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def compile_dag(output_node, **kwargs) -> CompiledDAG:
+    return CompiledDAG(output_node, **kwargs)

@@ -27,6 +27,14 @@ class DAGNode:
                 out.append(a)
         return out
 
+    def experimental_compile(self, **kwargs):
+        """Compile this DAG into pinned actor loops over shared-memory
+        channels (reference: dag.experimental_compile / compiled DAGs).
+        Returns a CompiledDAG with .execute(value)/.teardown()."""
+        from .compiled import CompiledDAG
+
+        return CompiledDAG(self, **kwargs)
+
     def execute(self, *input_args, **input_kwargs):
         """Execute the DAG rooted at this node; returns ObjectRef(s)."""
         cache: Dict[int, Any] = {}
@@ -122,6 +130,13 @@ class ActorMethodNode(DAGNode):
         super().__init__(args, kwargs)
         self._target = target  # ActorHandle or ClassNode
         self._method_name = method_name
+
+    def _resolve_handle(self):
+        """The bound actor handle (creating the ClassNode actor if this
+        DAG never ran dynamically) — used by experimental_compile."""
+        if isinstance(self._target, ClassNode):
+            return self._target._get_or_create({}, (), {})
+        return self._target
 
     def _execute_impl(self, cache, input_args, input_kwargs):
         args, kwargs = self._resolve_args(cache, input_args, input_kwargs)

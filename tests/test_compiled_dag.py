@@ -1,0 +1,212 @@
+"""Compiled DAG tests (reference coverage model:
+python/ray/dag/tests/experimental/test_accelerated_dag.py — compile,
+repeated execute, error propagation, teardown; latency advantage over
+dynamic dispatch as in _private/ray_perf.py:397-399)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture
+def actors(ray_start):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return 2 * x
+
+    @ray_tpu.remote
+    class Adder:
+        def __init__(self, inc):
+            self.inc = inc
+
+        def add(self, x):
+            return x + self.inc
+
+        def boom(self, x):
+            raise ValueError(f"boom on {x}")
+
+    return Doubler, Adder
+
+
+def test_compiled_chain(actors):
+    Doubler, Adder = actors
+    d, a = Doubler.remote(), Adder.remote(10)
+    with InputNode() as inp:
+        dag = a.add.bind(d.double.bind(inp))
+    cdag = dag.experimental_compile()
+    try:
+        assert cdag.execute(1) == 12
+        assert cdag.execute(5) == 20
+        # Channels are reused — many iterations stay correct.
+        for i in range(50):
+            assert cdag.execute(i) == 2 * i + 10
+    finally:
+        cdag.teardown()
+
+
+def test_compiled_matches_dynamic(actors):
+    Doubler, _ = actors
+    d = Doubler.remote()
+    with InputNode() as inp:
+        dag = d.double.bind(inp)
+    dynamic = ray_tpu.get(dag.execute(21))
+    cdag = dag.experimental_compile()
+    try:
+        assert cdag.execute(21) == dynamic == 42
+    finally:
+        cdag.teardown()
+
+
+def test_error_propagates_and_dag_survives(actors):
+    _, Adder = actors
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.boom.bind(inp)
+    cdag = dag.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="boom on 3"):
+            cdag.execute(3)
+        # The loop keeps running after a user error.
+        with pytest.raises(ValueError, match="boom on 4"):
+            cdag.execute(4)
+    finally:
+        cdag.teardown()
+
+
+def test_teardown_then_execute_raises(actors):
+    Doubler, _ = actors
+    d = Doubler.remote()
+    with InputNode() as inp:
+        dag = d.double.bind(inp)
+    cdag = dag.experimental_compile()
+    assert cdag.execute(2) == 4
+    cdag.teardown()
+    with pytest.raises(RuntimeError, match="torn down"):
+        cdag.execute(1)
+
+
+def test_actor_usable_after_teardown(actors):
+    Doubler, _ = actors
+    d = Doubler.remote()
+    with InputNode() as inp:
+        dag = d.double.bind(inp)
+    cdag = dag.experimental_compile()
+    assert cdag.execute(3) == 6
+    cdag.teardown()
+    # The pinned loop exited; normal actor calls work again.
+    assert ray_tpu.get(d.double.remote(7)) == 14
+
+
+def test_multi_stage_pipeline(actors):
+    Doubler, Adder = actors
+    d1, a1, a2 = Doubler.remote(), Adder.remote(100), Adder.remote(1000)
+    with InputNode() as inp:
+        dag = a2.add.bind(a1.add.bind(d1.double.bind(inp)))
+    cdag = dag.experimental_compile()
+    try:
+        assert cdag.execute(5) == 5 * 2 + 100 + 1000
+    finally:
+        cdag.teardown()
+
+
+def test_compiled_latency_beats_dynamic(actors):
+    """The point of compiling: per-call latency avoids task submission
+    (reference microbench: compiled ~10x faster per call)."""
+    Doubler, _ = actors
+    d = Doubler.remote()
+    with InputNode() as inp:
+        dag = d.double.bind(inp)
+
+    n = 200
+    t0 = time.perf_counter()
+    for i in range(n):
+        ray_tpu.get(dag.execute(i))
+    dynamic_s = time.perf_counter() - t0
+
+    cdag = dag.experimental_compile()
+    try:
+        cdag.execute(0)  # warm
+        t0 = time.perf_counter()
+        for i in range(n):
+            cdag.execute(i)
+        compiled_s = time.perf_counter() - t0
+    finally:
+        cdag.teardown()
+    # In-process (GIL-shared) the two paths are comparable — the
+    # compiled win is architectural (no submit/schedule/store per call)
+    # and shows up cross-process. Guard against regression only.
+    assert compiled_s < dynamic_s * 1.5, (compiled_s, dynamic_s)
+
+
+def test_rejects_fanout(actors):
+    Doubler, Adder = actors
+    d, a = Doubler.remote(), Adder.remote(1)
+    with InputNode() as inp:
+        mid = d.double.bind(inp)
+        dag = a.add.bind(mid)
+        _other = a.add.bind(mid)  # second consumer of mid
+    # Compile only sees dag's subtree — single consumer, fine. Build a
+    # DAG that really fans out:
+    with InputNode() as inp:
+        x = d.double.bind(inp)
+        from ray_tpu.dag import MultiOutputNode
+
+        fan = MultiOutputNode([a.add.bind(x), a.add.bind(x)])
+    with pytest.raises(ValueError):
+        fan.experimental_compile()
+
+
+def test_constant_args_and_kwargs(actors):
+    """Review finding: constant bound args/kwargs must reach the method."""
+    @ray_tpu.remote
+    class Scaler:
+        def scale(self, x, factor, offset=0):
+            return x * factor + offset
+
+    s = Scaler.remote()
+    with InputNode() as inp:
+        dag = s.scale.bind(inp, 3, offset=100)
+    # Dynamic result first: while compiled, the pinned loop occupies the
+    # actor's mailbox, so normal calls would queue behind it.
+    assert ray_tpu.get(dag.execute(5)) == 115
+    cdag = dag.experimental_compile()
+    try:
+        assert cdag.execute(5) == 115  # matches dynamic
+    finally:
+        cdag.teardown()
+
+
+def test_bad_method_name_fails_fast(ray_start):
+    """Review finding: loop-spawn failures surface at compile, not as
+    a later execute() timeout."""
+    @ray_tpu.remote
+    class A:
+        def ok(self, x):
+            return x
+
+    a = A.remote()
+    from ray_tpu.dag.node import ActorMethodNode
+    with InputNode() as inp:
+        dag = ActorMethodNode(a, "missing_method", (inp,), {})
+    with pytest.raises(Exception):
+        dag.experimental_compile(timeout=5)
+
+
+def test_dag_survives_idle_period(actors):
+    """Review finding: an idle compiled DAG must not self-destruct when
+    the channel-read timeout elapses."""
+    Doubler, _ = actors
+    d = Doubler.remote()
+    with InputNode() as inp:
+        dag = d.double.bind(inp)
+    cdag = dag.experimental_compile(timeout=1.0)
+    try:
+        assert cdag.execute(1) == 2
+        time.sleep(2.5)  # > loop read timeout
+        assert cdag.execute(2) == 4  # still alive
+    finally:
+        cdag.teardown()
