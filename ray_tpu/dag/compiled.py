@@ -126,6 +126,14 @@ def _compiled_actor_loop(instance, method_name: str,
             return
         try:
             values = [pickle.loads(f) for f in frames]
+            # An upstream stage's error flows through untouched — the
+            # failing stage's exception must reach the driver, not be
+            # fed into this method as a bogus argument.
+            upstream_err = next(
+                (v for v in values if isinstance(v, _WrappedError)), None)
+            if upstream_err is not None:
+                out.write(pickle.dumps(upstream_err))
+                continue
             args = [values[i] if kind == "ch" else i
                     for kind, i in arg_plan]
             result = method(*args, **const_kwargs)
@@ -241,9 +249,16 @@ class CompiledDAG:
                 self._spec_of[id(n)], self._timeout)
             self._loop_refs.append(ref)
         # Surface immediate loop-spawn failures (bad method name etc.)
-        # instead of a later opaque execute() timeout.
+        # instead of a later opaque execute() timeout. Healthy loops
+        # never complete, so keep the probe short; execute() re-checks
+        # the refs whenever a read times out.
+        self._probe_loops(timeout=0.05)
+
+    def _probe_loops(self, timeout: float) -> None:
+        import ray_tpu
+
         ready, _ = ray_tpu.wait(self._loop_refs,
-                                num_returns=1, timeout=0.2)
+                                num_returns=1, timeout=timeout)
         if ready:
             ray_tpu.get(ready[0])  # raises the loop's error
 
@@ -264,7 +279,20 @@ class CompiledDAG:
         if self._closed:
             raise RuntimeError("compiled DAG torn down")
         self._in_chan.write(pickle.dumps(value))
-        out = pickle.loads(self._out_chan.read(timeout=self._timeout))
+        try:
+            frame = self._out_chan.read(timeout=self._timeout)
+        except (TimeoutError, _pyqueue.Empty):
+            # The in-flight result may still land later; consuming it on
+            # the NEXT execute would desync input/output pairing — the
+            # DAG is no longer trustworthy. Surface a loop error if one
+            # exists, else a normalized timeout; either way, brick it.
+            self._closed = True
+            self._probe_loops(timeout=0)
+            raise TimeoutError(
+                f"compiled DAG execute() timed out after "
+                f"{self._timeout}s; DAG torn down (results could no "
+                f"longer be paired with inputs)")
+        out = pickle.loads(frame)
         if isinstance(out, _WrappedError):
             raise out.error
         return out

@@ -191,44 +191,141 @@ def _stage_limit(op: Limit, upstream: Iterator[ObjectRef]
             return
 
 
+# ---------------------------------------------------------------------------
+# Push-based shuffle (reference: data/_internal/push_based_shuffle.py —
+# two stages: map tasks partition each block and push pieces into the
+# object plane; merge tasks combine one output partition each. Only
+# refs transit the driver.)
+# ---------------------------------------------------------------------------
+
+@remote
+def _shuffle_map(block, n_out: int, mode: str, arg, seed):
+    """Split one block into n_out pieces → list of piece refs (None for
+    empty pieces). mode: 'random' (arg unused), 'range' (arg =
+    (key, boundaries, descending)), 'rr' (round-robin contiguous)."""
+    from .. import put as ray_put_
+
+    acc = BlockAccessor.for_block(block)
+    t = acc.block
+    n_rows = t.num_rows
+    if n_rows == 0:
+        return [None] * n_out
+    if mode == "random":
+        rng = np.random.RandomState(seed)
+        assign = rng.randint(0, n_out, size=n_rows)
+        pieces = [t.take(np.nonzero(assign == i)[0])
+                  for i in range(n_out)]
+    elif mode == "range":
+        key, boundaries, descending = arg
+        col = t.column(key).to_numpy(zero_copy_only=False)
+        assign = np.searchsorted(np.asarray(boundaries), col,
+                                 side="right")
+        if descending:
+            assign = (n_out - 1) - assign
+        pieces = [t.take(np.nonzero(assign == i)[0])
+                  for i in range(n_out)]
+    else:  # "rr": contiguous split for repartition
+        per = max(1, -(-n_rows // n_out))
+        pieces = [t.slice(i * per, min(per, n_rows - i * per))
+                  for i in range(n_out) if i * per < n_rows]
+        pieces += [t.slice(0, 0)] * (n_out - len(pieces))
+    return [ray_put_(p) if p.num_rows else None for p in pieces]
+
+
+@remote
+def _shuffle_merge(piece_refs, mode: str, arg, seed):
+    """Combine one output partition's pieces into a block."""
+    from .. import get as ray_get_
+
+    t = concat_blocks([ray_get_(p) for p in piece_refs])
+    if mode == "random":
+        rng = np.random.RandomState(seed)
+        return t.take(rng.permutation(t.num_rows))
+    if mode == "range":
+        key, _, descending = arg
+        order = "descending" if descending else "ascending"
+        return t.sort_by([(key, order)])
+    return t
+
+
+@remote
+def _sample_bounds(block, key: str, n_samples: int, seed):
+    acc = BlockAccessor.for_block(block)
+    t = acc.block
+    if t.num_rows == 0:
+        return np.array([])
+    col = t.column(key).to_numpy(zero_copy_only=False)
+    rng = np.random.RandomState(seed)
+    k = min(n_samples, len(col))
+    return col[rng.choice(len(col), size=k, replace=False)]
+
+
+def _push_shuffle(upstream: Iterator[ObjectRef], n_out: int, mode: str,
+                  arg, seed) -> Iterator[ObjectRef]:
+    map_refs = [_shuffle_map.remote(ref, n_out, mode, arg,
+                                    None if seed is None else seed + i)
+                for i, ref in enumerate(upstream)]
+    parts: List[List[ObjectRef]] = [[] for _ in range(n_out)]
+    for ref in map_refs:
+        for i, piece in enumerate(ray_get(ref)):
+            if piece is not None:
+                parts[i].append(piece)
+    merge_refs = [
+        _shuffle_merge.remote(part, mode, arg,
+                              None if seed is None else seed + 7919 * i)
+        for i, part in enumerate(parts) if part]
+    for ref in merge_refs:
+        yield ref
+
+
 def _stage_repartition(op: Repartition, upstream: Iterator[ObjectRef]
                        ) -> Iterator[ObjectRef]:
-    blocks = [ray_get(r) for r in upstream]
-    merged = concat_blocks(blocks) if blocks else None
-    if merged is None:
-        return
-    rows = merged.num_rows
-    per = max(1, rows // op.n)
-    start = 0
-    for i in range(op.n):
-        end = rows if i == op.n - 1 else min(start + per, rows)
-        if start >= end and i < op.n - 1:
-            continue
-        yield ray_put(merged.slice(start, end - start))
-        start = end
+    yield from _push_shuffle(upstream, op.n, "rr", None, None)
 
 
 def _stage_shuffle(op: RandomShuffle, upstream: Iterator[ObjectRef]
                    ) -> Iterator[ObjectRef]:
-    rng = np.random.RandomState(op.seed)
-    blocks = [ray_get(r) for r in upstream]
-    if not blocks:
+    refs = list(upstream)
+    if not refs:
         return
-    merged = concat_blocks(blocks)
-    perm = rng.permutation(merged.num_rows)
-    shuffled = merged.take(perm)
-    for piece in split_block(shuffled, max(1, len(blocks))):
-        yield ray_put(piece)
+    n_out = max(1, len(refs))
+    # seed None stays None end-to-end → OS entropy per map task
+    # (an unseeded shuffle must differ across runs).
+    yield from _push_shuffle(iter(refs), n_out, "random", None, op.seed)
 
 
 def _stage_sort(op: Sort, upstream: Iterator[ObjectRef]
                 ) -> Iterator[ObjectRef]:
-    blocks = [ray_get(r) for r in upstream]
-    if not blocks:
+    """Sample-partitioned distributed sort: sample key values, compute
+    range boundaries, range-partition in map tasks, sort each partition
+    in merge tasks; partitions stream out in global key order."""
+    refs = list(upstream)
+    if not refs:
         return
-    merged = concat_blocks(blocks)
-    order = "descending" if op.descending else "ascending"
-    yield ray_put(merged.sort_by([(op.key, order)]))
+    n_out = max(1, len(refs))
+    if n_out == 1:
+        block = ray_get(refs[0])
+        order = "descending" if op.descending else "ascending"
+        yield ray_put(block.sort_by([(op.key, order)]))
+        return
+    sample_arrays = [
+        np.asarray(s) for s in ray_get(
+            [_sample_bounds.remote(r, op.key, 32, i)
+             for i, r in enumerate(refs)]) if len(s)]
+    # All-empty upstream (e.g. filter dropped everything): no samples,
+    # no boundaries — everything range-partitions to partition 0.
+    samples = (np.concatenate(sample_arrays) if sample_arrays
+               else np.array([]))
+    # Positional boundaries from the sorted sample (works for string
+    # keys too, where quantile interpolation wouldn't).
+    if len(samples):
+        s = np.sort(samples)
+        idx = [int(len(s) * (i + 1) / n_out) for i in range(n_out - 1)]
+        boundaries = [s[min(j, len(s) - 1)] for j in idx]
+    else:
+        boundaries = []
+    arg = (op.key, boundaries, op.descending)
+    yield from _push_shuffle(iter(refs), n_out, "range", arg, None)
 
 
 def execute(root: LogicalOp, *, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT

@@ -210,3 +210,22 @@ def test_dag_survives_idle_period(actors):
         assert cdag.execute(2) == 4  # still alive
     finally:
         cdag.teardown()
+
+
+def test_error_propagates_through_multi_stage(actors):
+    """Review finding: an upstream stage's exception must reach the
+    driver unchanged, not be fed to downstream methods as an arg."""
+    _, Adder = actors
+    bad, downstream = Adder.remote(1), Adder.remote(5)
+    with InputNode() as inp:
+        dag = downstream.add.bind(bad.boom.bind(inp))
+    cdag = dag.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="boom on 9"):
+            cdag.execute(9)
+        # And the pipeline still works for the next request? boom always
+        # raises, so just confirm the error stays the original type.
+        with pytest.raises(ValueError, match="boom on 10"):
+            cdag.execute(10)
+    finally:
+        cdag.teardown()

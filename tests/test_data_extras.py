@@ -279,3 +279,67 @@ def test_min_max_skip_empty_blocks(data):
     ds = data.from_items([{"x": 1}, {"x": 2}]).filter(lambda r: r["x"] > 1)
     assert ds.min("x") == 2
     assert ds.max("x") == 2
+
+
+# ---------------------------------------------------------------------------
+# Push-based shuffle (reference: data/_internal/push_based_shuffle.py)
+# ---------------------------------------------------------------------------
+
+def test_distributed_sort_global_order(data):
+    rng = np.random.RandomState(3)
+    vals = rng.permutation(500)
+    ds = data.from_items([{"v": int(v)} for v in vals]).repartition(8)
+    out = [r["v"] for r in ds.sort("v").take_all()]
+    assert out == sorted(vals.tolist())
+
+
+def test_distributed_sort_descending(data):
+    ds = data.range(200, parallelism=6)
+    out = [r["id"] for r in ds.sort("id", descending=True).take_all()]
+    assert out == list(reversed(range(200)))
+
+
+def test_sort_string_keys(data):
+    names = [f"k{i:03d}" for i in range(100)]
+    import random as _r
+
+    shuffled = names[:]
+    _r.Random(0).shuffle(shuffled)
+    ds = data.from_items([{"n": n} for n in shuffled]).repartition(5)
+    out = [r["n"] for r in ds.sort("n").take_all()]
+    assert out == names
+
+
+def test_random_shuffle_is_permutation(data):
+    ds = data.range(300, parallelism=6)
+    out = sorted(r["id"] for r in ds.random_shuffle(seed=1).take_all())
+    assert out == list(range(300))
+
+
+def test_random_shuffle_seed_deterministic(data):
+    ds = data.range(100, parallelism=4)
+    a = [r["id"] for r in ds.random_shuffle(seed=7).take_all()]
+    b = [r["id"] for r in ds.random_shuffle(seed=7).take_all()]
+    c = [r["id"] for r in ds.random_shuffle(seed=8).take_all()]
+    assert a == b
+    assert a != c
+    assert a != list(range(100))  # actually shuffled
+
+
+def test_repartition_balanced(data):
+    ds = data.range(100, parallelism=2).repartition(5)
+    from ray_tpu import get as ray_get
+    from ray_tpu.data.block import BlockAccessor
+
+    sizes = [BlockAccessor.for_block(ray_get(r)).num_rows()
+             for r in ds._refs()]
+    assert sum(sizes) == 100
+    assert len(sizes) == 5
+    assert max(sizes) - min(sizes) <= len(sizes)  # roughly balanced
+
+
+def test_sort_all_empty_blocks(data):
+    """Review finding: sorting a fully-filtered dataset must not crash
+    on empty sample concatenation."""
+    ds = data.range(100, parallelism=4).filter(lambda r: False)
+    assert ds.sort("id").take_all() == []
