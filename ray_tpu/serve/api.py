@@ -16,11 +16,14 @@ from .. import get_actor, kill as ray_kill, remote
 from .controller import ServeController
 from .deployment import Application, Deployment
 from .handle import DeploymentHandle
+from .grpc_proxy import GrpcProxy
 from .proxy import HttpProxy
 
 _CONTROLLER_NAME = "serve::controller"
 _lock = threading.Lock()
 _proxy: Optional[HttpProxy] = None
+_grpc_proxy: Optional[GrpcProxy] = None
+_route_of_app: Dict[str, str] = {}  # app name -> proxy route
 
 
 def _get_or_create_controller():
@@ -35,10 +38,12 @@ def _get_or_create_controller():
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = None,
         blocking: bool = False,
-        http: bool = False, http_port: int = 8000) -> DeploymentHandle:
+        http: bool = False, http_port: int = 8000,
+        grpc: bool = False, grpc_port: int = 9000) -> DeploymentHandle:
     """Deploy the application; returns the ingress handle
-    (reference: serve/api.py:449)."""
-    global _proxy
+    (reference: serve/api.py:449). http/grpc start the respective
+    ingress proxies and route this app on them."""
+    global _proxy, _grpc_proxy
     if not isinstance(app, Application):
         raise TypeError("serve.run expects a bound Application "
                         "(deployment.bind(...))")
@@ -62,12 +67,18 @@ def run(app: Application, *, name: str = "default",
             controller, node.deployment.name)
 
     ingress = handles[id(app)]
+    _route_of_app[name] = route_prefix or name
     if http:
         with _lock:
             if _proxy is None:
                 _proxy = HttpProxy(port=http_port)
                 _proxy.start()
             _proxy.add_route(route_prefix or name, ingress)
+    if grpc:
+        with _lock:
+            if _grpc_proxy is None:
+                _grpc_proxy = GrpcProxy(port=grpc_port).start()
+            _grpc_proxy.add_route(route_prefix or name, ingress)
     return ingress
 
 
@@ -92,12 +103,17 @@ def delete(name: str):
 
     controller = get_actor(_CONTROLLER_NAME)
     ray_get(controller.delete.remote(name))
+    # Routes are registered under route_prefix (falling back to the app
+    # name) — remove the route actually registered.
+    route = _route_of_app.pop(name, name)
     if _proxy is not None:
-        _proxy.remove_route(name)
+        _proxy.remove_route(route)
+    if _grpc_proxy is not None:
+        _grpc_proxy.remove_route(route)
 
 
 def shutdown():
-    global _proxy
+    global _proxy, _grpc_proxy
     from .. import get as ray_get
 
     try:
@@ -113,3 +129,6 @@ def shutdown():
     if _proxy is not None:
         _proxy.stop()
         _proxy = None
+    if _grpc_proxy is not None:
+        _grpc_proxy.stop()
+        _grpc_proxy = None

@@ -1,0 +1,140 @@
+"""gRPC ingress proxy.
+
+Capability-equivalent to the reference's gRPC proxy
+(reference: python/ray/serve/_private/proxy.py:547 gRPCProxy — a
+grpc.aio server routing RPCs to deployment handles by app name, with
+the application selected via request metadata). Served without protoc:
+a GenericRpcHandler exposes one service
+
+    /ray_tpu.serve.GenericService/Predict
+
+taking a pickled request payload and returning the pickled result; the
+target application comes from the ``application`` metadata key (the
+reference uses the same metadata convention). Wire compat with Ray's
+per-user-proto servicers is not a goal — the capability (gRPC ingress
+into deployments) is.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from concurrent import futures
+from typing import Any, Dict, Optional
+
+SERVICE = "ray_tpu.serve.GenericService"
+METHOD = f"/{SERVICE}/Predict"
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Requests come off the network: refuse to resolve ANY class, so a
+    crafted payload cannot execute code via __reduce__ (plain-data
+    payloads — dict/list/tuple/str/num/bytes — never need find_class)."""
+
+    def find_class(self, module, name):
+        raise pickle.UnpicklingError(
+            f"request payloads must be plain data; refusing "
+            f"{module}.{name}")
+
+
+def _restricted_loads(data: bytes):
+    import io
+
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+class GrpcProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+        self.host = host
+        self.port = port
+        self._routes: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._server = None
+
+    def add_route(self, name: str, handle) -> None:
+        with self._lock:
+            self._routes[name.strip("/")] = handle
+
+    def remove_route(self, name: str) -> None:
+        with self._lock:
+            self._routes.pop(name.strip("/"), None)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "GrpcProxy":
+        import grpc
+
+        proxy = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                if handler_call_details.method != METHOD:
+                    return None
+                meta = dict(handler_call_details.invocation_metadata)
+
+                def unary(request_bytes, context):
+                    return proxy._handle(request_bytes, meta, context)
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b)
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+            self._server = None
+
+    # -- request path ---------------------------------------------------
+    def _handle(self, request_bytes: bytes, meta: Dict[str, str],
+                context) -> bytes:
+        import grpc
+
+        app = meta.get("application", "").strip("/")
+        with self._lock:
+            handle = self._routes.get(app)
+        if handle is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no application {app!r}")
+        try:
+            payload = _restricted_loads(request_bytes)
+        except Exception:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "request must be a pickled plain-data payload "
+                          "(dict/list/str/num/bytes — no custom classes)")
+        try:
+            result = handle.remote(payload).result(timeout=30)
+        except BaseException as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e)[:500])
+        return pickle.dumps(result)
+
+
+class GrpcClient:
+    """Convenience client for the generic service (tests / quick use;
+    any gRPC stack can call the method directly)."""
+
+    def __init__(self, address: str):
+        import grpc
+
+        self._channel = grpc.insecure_channel(address)
+        self._call = self._channel.unary_unary(
+            METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+
+    def predict(self, application: str, payload: Any,
+                timeout: float = 30.0) -> Any:
+        out = self._call(pickle.dumps(payload),
+                         metadata=(("application", application),),
+                         timeout=timeout)
+        return pickle.loads(out)
+
+    def close(self) -> None:
+        self._channel.close()

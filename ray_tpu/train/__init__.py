@@ -5,8 +5,18 @@ from .step import TrainState, init_state, make_optimizer, make_train_step
 from .trainer import Result, TpuTrainer
 
 __all__ = [
-    "TpuTrainer", "Result", "ScalingConfig", "RunConfig", "FailureConfig",
+    "TpuTrainer", "TorchTrainer", "Result", "ScalingConfig", "RunConfig",
+    "FailureConfig",
     "CheckpointConfig", "Checkpoint", "CheckpointManager", "save_pytree",
     "load_pytree", "report", "get_context", "get_dataset_shard", "get_mesh",
     "TrainState", "init_state", "make_optimizer", "make_train_step",
 ]
+
+
+def __getattr__(name):
+    # TorchTrainer imports torch (heavy) — load lazily.
+    if name == "TorchTrainer":
+        from .torch import TorchTrainer
+
+        return TorchTrainer
+    raise AttributeError(name)

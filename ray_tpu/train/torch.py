@@ -1,0 +1,185 @@
+"""TorchTrainer — distributed torch training over worker processes.
+
+Capability-equivalent of the reference's torch Train path
+(reference: python/ray/train/torch/torch_trainer.py:14 TorchTrainer;
+torch/config.py:62 _setup_torch_process_group — rank-0 TCP rendezvous +
+dist.init_process_group; torch/train_loop_utils.py:74 prepare_model
+(DDP wrap) and :116 prepare_data_loader (DistributedSampler)): each
+worker runs in its own PROCESS (the spawned-worker plane — gloo process
+groups are per-process), rendezvouses over a TCP init_method, and runs
+the user loop with ray_tpu.train.report() streaming back to the driver.
+
+On this framework torch runs CPU/gloo (the TPU compute path is jax);
+the capability carried over is the reference's worker-group
+orchestration + DDP data parallelism for torch workloads.
+"""
+
+from __future__ import annotations
+
+import inspect
+import socket
+from typing import Any, Callable, Dict, Optional
+
+from ..core.task import NodeAffinitySchedulingStrategy
+from .config import RunConfig, ScalingConfig
+from .trainer import Result, TpuTrainer
+
+
+class TorchConfig:
+    """(reference: train/torch/config.py TorchConfig)."""
+
+    def __init__(self, backend: str = "gloo",
+                 init_timeout_s: float = 120.0):
+        self.backend = backend
+        self.init_timeout_s = init_timeout_s
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _make_torch_loop(user_fn: Callable, backend: str, addr: str,
+                     timeout_s: float) -> Callable:
+    """Wrap the user loop with process-group setup/teardown (reference:
+    _TorchBackend.on_start → _setup_torch_process_group)."""
+    takes_config = len(inspect.signature(user_fn).parameters) >= 1
+
+    def loop(config: Optional[Dict[str, Any]] = None) -> None:
+        import datetime
+
+        import torch.distributed as dist
+
+        from .session import get_context
+
+        ctx = get_context()
+        dist.init_process_group(
+            backend,
+            init_method=f"tcp://{addr}",
+            rank=ctx.get_world_rank(),
+            world_size=ctx.get_world_size(),
+            timeout=datetime.timedelta(seconds=timeout_s))
+        try:
+            if takes_config and config is not None:
+                user_fn(config)
+            else:
+                user_fn()
+        finally:
+            dist.destroy_process_group()
+
+    return loop
+
+
+class TorchTrainer(TpuTrainer):
+    """TorchTrainer(train_loop_per_worker, scaling_config=
+    ScalingConfig(num_workers=N)).fit() — the reference surface.
+
+    Requires the out-of-process execution plane:
+    ``ray_tpu.init(num_worker_procs=N)`` (gloo process groups need one
+    OS process per rank)."""
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 torch_config: Optional[TorchConfig] = None):
+        super().__init__(train_loop_per_worker,
+                         train_loop_config=train_loop_config,
+                         scaling_config=scaling_config,
+                         run_config=run_config, datasets=datasets)
+        self.torch_config = torch_config or TorchConfig()
+        self._user_loop = train_loop_per_worker
+        # Hard placement on the spawned-worker node: every rank is its
+        # own OS process there.
+        self._strategy_factory = lambda rank: \
+            NodeAffinitySchedulingStrategy(node_id="node-procs",
+                                           soft=False)
+
+    def fit(self) -> Result:
+        from ..core.runtime import global_runtime
+
+        rt = global_runtime()
+        n = self.scaling_config.num_workers
+        if rt.worker_pool is None or rt.worker_pool.num_workers < n:
+            have = 0 if rt.worker_pool is None \
+                else rt.worker_pool.num_workers
+            raise RuntimeError(
+                f"TorchTrainer needs {n} worker processes (gloo process "
+                f"groups are per-process) but the runtime has {have}; "
+                f"call ray_tpu.init(num_worker_procs={n})")
+        return super().fit()
+
+    def _fit_once(self) -> Result:
+        # Fresh rendezvous address per attempt: picking it at __init__
+        # would race other port users until fit() AND reuse a possibly-
+        # dead address across FailureConfig retries.
+        tc = self.torch_config
+        addr = f"127.0.0.1:{_free_port()}"
+        self.train_loop = _make_torch_loop(
+            self._user_loop, tc.backend, addr, tc.init_timeout_s)
+        return super()._fit_once()
+
+
+# ---------------------------------------------------------------------------
+# Loop utilities (reference: train/torch/train_loop_utils.py)
+# ---------------------------------------------------------------------------
+
+def prepare_model(model):
+    """Wrap in DistributedDataParallel when world_size > 1
+    (reference: prepare_model :74 — DDP/FSDP wrap + device move; here
+    CPU/gloo, so the wrap is the capability)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Re-create the DataLoader with a DistributedSampler so each rank
+    sees its shard (reference: prepare_data_loader :116). Loaders a
+    DistributedSampler cannot shard (IterableDataset, custom
+    batch_sampler) are returned unchanged with a warning."""
+    import warnings
+
+    import torch
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, DistributedSampler
+
+    if not (dist.is_initialized() and dist.get_world_size() > 1):
+        return data_loader
+    if isinstance(data_loader.dataset,
+                  torch.utils.data.IterableDataset):
+        warnings.warn(
+            "prepare_data_loader: IterableDataset cannot use a "
+            "DistributedSampler; shard inside the dataset instead. "
+            "Returning the loader unchanged.")
+        return data_loader
+    if not isinstance(
+            data_loader.batch_sampler,
+            torch.utils.data.sampler.BatchSampler):
+        warnings.warn(
+            "prepare_data_loader: custom batch_sampler is not "
+            "re-shardable; returning the loader unchanged.")
+        return data_loader
+    sampler = DistributedSampler(
+        data_loader.dataset, num_replicas=dist.get_world_size(),
+        rank=dist.get_rank(),
+        shuffle=not isinstance(
+            data_loader.sampler, torch.utils.data.SequentialSampler))
+    return DataLoader(
+        data_loader.dataset, batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        pin_memory=data_loader.pin_memory,
+        drop_last=data_loader.drop_last,
+        timeout=data_loader.timeout,
+        worker_init_fn=data_loader.worker_init_fn,
+        generator=data_loader.generator,
+        persistent_workers=data_loader.persistent_workers)

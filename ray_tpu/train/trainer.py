@@ -115,6 +115,9 @@ class TpuTrainer:
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
+        # Subclass hook (TorchTrainer): rank -> SchedulingStrategy,
+        # replacing the default placement-group gang placement.
+        self._strategy_factory: Optional[Callable[[int], Any]] = None
 
     # ------------------------------------------------------------------
     def fit(self) -> Result:
@@ -151,21 +154,28 @@ class TpuTrainer:
         # PACK onto one slice).
         from .. import get as ray_get, kill as ray_kill
 
-        pg = placement_group(
-            [sc.worker_resources() for _ in range(n)],
-            strategy=sc.placement_strategy)
+        pg = None
+        if self._strategy_factory is None:
+            pg = placement_group(
+                [sc.worker_resources() for _ in range(n)],
+                strategy=sc.placement_strategy)
         workers: List[Any] = []
         history: List[Dict[str, Any]] = []
         last_ckpt: Optional[Checkpoint] = None
         error: Optional[BaseException] = None
         try:
-            pg.wait(timeout=None)
+            if pg is not None:
+                pg.wait(timeout=None)
 
             WorkerActor = remote(num_cpus=0)(_TrainWorker)
             plan_bytes = cloudpickle.dumps(sc.plan) if sc.plan else None
             for rank in range(n):
-                strategy = PlacementGroupSchedulingStrategy(
-                    placement_group=pg, placement_group_bundle_index=rank)
+                if self._strategy_factory is not None:
+                    strategy = self._strategy_factory(rank)
+                else:
+                    strategy = PlacementGroupSchedulingStrategy(
+                        placement_group=pg,
+                        placement_group_bundle_index=rank)
                 workers.append(
                     WorkerActor.options(
                         scheduling_strategy=strategy,
@@ -228,7 +238,8 @@ class TpuTrainer:
                     ray_kill(w)
                 except Exception:  # noqa: BLE001
                     pass
-            remove_placement_group(pg)
+            if pg is not None:
+                remove_placement_group(pg)
 
         if error is not None:
             raise error

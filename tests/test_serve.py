@@ -223,3 +223,51 @@ def test_delete_deployment(serve):
     assert handle.remote(None).result(timeout=10) == 1
     serve.delete("f")
     assert "f" not in serve.status()
+
+
+class TestGrpcIngress:
+    def test_grpc_roundtrip(self, ray_start):
+        """gRPC ingress (reference: serve/_private/proxy.py gRPCProxy):
+        route by `application` metadata, pickled payloads."""
+        import ray_tpu.serve as serve
+        from ray_tpu.serve.grpc_proxy import GrpcClient
+
+        @serve.deployment
+        class Echo:
+            def __call__(self, req):
+                return {"echo": req, "squared": req.get("x", 0) ** 2}
+
+        serve.run(Echo.bind(), name="gecho", grpc=True, grpc_port=0)
+        try:
+            from ray_tpu.serve import api as serve_api
+
+            addr = f"127.0.0.1:{serve_api._grpc_proxy.port}"
+            client = GrpcClient(addr)
+            out = client.predict("gecho", {"x": 7})
+            assert out == {"echo": {"x": 7}, "squared": 49}
+            client.close()
+        finally:
+            serve.shutdown()
+
+    def test_grpc_unknown_app(self, ray_start):
+        import grpc
+
+        import ray_tpu.serve as serve
+        from ray_tpu.serve.grpc_proxy import GrpcClient
+
+        @serve.deployment
+        def noop(req):
+            return req
+
+        serve.run(noop.bind(), name="known", grpc=True, grpc_port=0)
+        try:
+            from ray_tpu.serve import api as serve_api
+
+            client = GrpcClient(
+                f"127.0.0.1:{serve_api._grpc_proxy.port}")
+            with pytest.raises(grpc.RpcError) as ei:
+                client.predict("missing", {})
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+            client.close()
+        finally:
+            serve.shutdown()

@@ -1,0 +1,108 @@
+"""TorchTrainer tests: real gloo DDP across spawned worker processes
+(reference coverage model: python/ray/train/tests/test_torch_trainer.py,
+test_backend.py — rendezvous, DDP gradient sync, report streaming)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture
+def proc_runtime():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0, num_worker_procs=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_requires_worker_procs(ray_start):
+    from ray_tpu.train import ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    t = TorchTrainer(lambda: None,
+                     scaling_config=ScalingConfig(num_workers=2))
+    with pytest.raises(RuntimeError, match="num_worker_procs"):
+        t.fit()
+
+
+def test_ddp_gradient_sync(proc_runtime, tmp_path):
+    """2 ranks, different data: DDP must average gradients so both
+    ranks hold identical weights after a step."""
+    from ray_tpu.train import RunConfig, ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+        from torch import nn
+
+        from ray_tpu.train import report
+        from ray_tpu.train.session import get_context
+        from ray_tpu.train.torch import prepare_model
+
+        rank = get_context().get_world_rank()
+        torch.manual_seed(0)  # same init on both ranks
+        model = prepare_model(nn.Linear(4, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        # Rank-dependent data: without DDP gradient averaging, the
+        # ranks' weights would diverge immediately.
+        torch.manual_seed(100 + rank)
+        x = torch.randn(8, 4)
+        y = torch.randn(8, 1)
+        for step in range(3):
+            opt.zero_grad()
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+        w = [p.detach().clone() for p in model.parameters()]
+        # Compare rank weights via allreduce of the difference.
+        flat = torch.cat([p.reshape(-1) for p in w])
+        mine = flat.clone()
+        dist.all_reduce(flat, op=dist.ReduceOp.SUM)
+        max_diff = float((flat / dist.get_world_size() - mine)
+                         .abs().max())
+        report({"loss": float(loss), "rank": rank,
+                "max_weight_diff": max_diff,
+                "world": dist.get_world_size()})
+
+    result = TorchTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1),
+        run_config=RunConfig(name="ddp", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    m = result.metrics
+    assert m["world"] == 2
+    assert np.isfinite(m["loss"])
+    # Identical weights across ranks == gradients were averaged.
+    assert m["max_weight_diff"] < 1e-6
+
+
+def test_prepare_data_loader_shards(proc_runtime, tmp_path):
+    from ray_tpu.train import RunConfig, ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    def loop(config):
+        import torch
+        from torch.utils.data import DataLoader, TensorDataset
+
+        from ray_tpu.train import report
+        from ray_tpu.train.torch import prepare_data_loader
+
+        ds = TensorDataset(torch.arange(16).float().reshape(-1, 1))
+        loader = prepare_data_loader(
+            DataLoader(ds, batch_size=2, shuffle=False))
+        seen = sum(len(b[0]) for b in loader)
+        report({"seen": seen})
+
+    result = TorchTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1),
+        run_config=RunConfig(name="shard", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["seen"] == 8  # 16 rows over 2 ranks
