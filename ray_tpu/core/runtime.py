@@ -739,12 +739,12 @@ class Runtime:
     def _detect_tpus() -> int:
         if config.tpu_devices_per_host:
             return config.tpu_devices_per_host
-        try:
-            import jax
-            return len([d for d in jax.local_devices()
-                        if d.platform != "cpu"])
-        except Exception:  # noqa: BLE001
-            return 0
+        # Env-based discovery first (TPU_CHIPS_PER_HOST_BOUNDS /
+        # TPU_VISIBLE_CHIPS — reference: _private/accelerators/tpu.py);
+        # falls back to probing jax.
+        from .._private import accelerators
+
+        return accelerators.num_chips_per_host()
 
     # ------------------------------------------------------------------
     # Ref bookkeeping

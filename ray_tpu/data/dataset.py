@@ -189,6 +189,9 @@ class Dataset:
     def iter_batches(self, **kwargs) -> Iterator[Any]:
         return DataIterator(self._refs).iter_batches(**kwargs)
 
+    def iter_torch_batches(self, **kwargs) -> Iterator[Any]:
+        return DataIterator(self._refs).iter_torch_batches(**kwargs)
+
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         return DataIterator(self._refs).iter_rows()
 

@@ -33,6 +33,34 @@ class DataIterator:
             yield ray_get(ref)
 
     # -- public -----------------------------------------------------------
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes: Optional[Dict[str, Any]] = None,
+                           device: Optional[str] = None,
+                           drop_last: bool = False) -> Iterator[Any]:
+        """Batches as dicts of torch tensors (reference:
+        iterator.py iter_torch_batches — numpy → torch conversion with
+        optional per-column dtypes and target device)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                if v.dtype == object:
+                    out[k] = list(v)  # strings/bytes stay python
+                    continue
+                arr = np.ascontiguousarray(v)
+                if not arr.flags.writeable:
+                    arr = arr.copy()  # torch refuses non-writable views
+                t = torch.from_numpy(arr)
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                if device:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
                      prefetch_batches: int = 1,

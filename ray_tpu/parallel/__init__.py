@@ -1,4 +1,5 @@
 from .mesh import MeshSpec, make_mesh, mesh_devices
+from .multihost import init_multihost, shutdown_multihost
 from .pipeline import (
     merge_layer_params,
     partition_layer_params,
@@ -17,6 +18,7 @@ from .sharding import (
 
 __all__ = [
     "MeshSpec", "make_mesh", "mesh_devices", "ParallelPlan",
+    "init_multihost", "shutdown_multihost",
     "DEFAULT_RULES", "logical_to_mesh_axes", "logical_to_sharding",
     "shard_pytree", "with_sharding_constraint",
     "partition_layer_params", "merge_layer_params", "pipeline_forward",

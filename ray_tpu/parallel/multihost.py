@@ -1,0 +1,92 @@
+"""Multi-host bootstrap — jax.distributed rendezvous for TPU pods.
+
+Capability-equivalent of the reference's process-group bootstrapping
+(reference: python/ray/train/torch/config.py:62
+_setup_torch_process_group — a rank-0 TCP store every worker joins),
+TPU-native: `jax.distributed.initialize` makes every host's
+`jax.devices()` span the whole pod, after which the SAME pjit/mesh code
+that runs single-host runs pod-wide (SURVEY.md §5: jax.distributed init
+replaces the TCP store; collectives ride ICI via XLA).
+
+Coordinator discovery, in order:
+1. explicit arguments,
+2. the TPU pod env (TPU_WORKER_HOSTNAMES / TPU_WORKER_ID — set by GKE),
+3. the control-plane KV (first caller claims coordinatorship; peers
+   read the address) when a ControlClient is provided.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .._private import accelerators
+
+DEFAULT_PORT = 8476
+_initialized = False
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None,
+                   *, control_client=None,
+                   kv_key: str = "multihost/coordinator",
+                   port: int = DEFAULT_PORT) -> dict:
+    """Initialize jax.distributed across the pod. Returns the resolved
+    {coordinator_address, num_processes, process_id}. Single-process
+    (num_processes == 1) skips jax.distributed entirely — the common
+    dev path — while still returning the resolved topology."""
+    global _initialized
+
+    if num_processes is None:
+        num_processes = accelerators.pod_worker_count()
+    if process_id is None:
+        process_id = accelerators.worker_id()
+
+    if coordinator_address is None:
+        hosts = os.environ.get(accelerators.WORKER_HOSTNAMES_ENV, "")
+        first = next((h.strip() for h in hosts.split(",") if h.strip()),
+                     None)
+        if first is not None:
+            coordinator_address = f"{first}:{port}"
+    if coordinator_address is None and control_client is not None:
+        # KV rendezvous through the native control plane (reference
+        # analog: the TCP-store address published via GCS internal KV).
+        import socket
+
+        me = f"{socket.gethostbyname(socket.gethostname())}:{port}"
+        try:
+            control_client.kv_put(kv_key, me, overwrite=False)
+            coordinator_address = me
+        except Exception:  # noqa: BLE001 - someone else claimed it
+            coordinator_address = control_client.kv_get(kv_key).decode()
+    if coordinator_address is None:
+        coordinator_address = f"127.0.0.1:{port}"
+
+    resolved = {
+        "coordinator_address": coordinator_address,
+        "num_processes": num_processes,
+        "process_id": process_id,
+    }
+    if num_processes <= 1:
+        return resolved
+    if _initialized:
+        return resolved
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+    return resolved
+
+
+def shutdown_multihost() -> None:
+    global _initialized
+    if _initialized:
+        import jax
+
+        jax.distributed.shutdown()
+        _initialized = False

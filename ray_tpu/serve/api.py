@@ -67,7 +67,16 @@ def run(app: Application, *, name: str = "default",
             controller, node.deployment.name)
 
     ingress = handles[id(app)]
-    _route_of_app[name] = route_prefix or name
+    new_route = route_prefix or name
+    old_route = _route_of_app.get(name)
+    if old_route is not None and old_route != new_route:
+        # Re-run under a new prefix: the old route must not keep
+        # serving a stale handle.
+        if _proxy is not None:
+            _proxy.remove_route(old_route)
+        if _grpc_proxy is not None:
+            _grpc_proxy.remove_route(old_route)
+    _route_of_app[name] = new_route
     if http:
         with _lock:
             if _proxy is None:
@@ -132,3 +141,4 @@ def shutdown():
     if _grpc_proxy is not None:
         _grpc_proxy.stop()
         _grpc_proxy = None
+    _route_of_app.clear()

@@ -82,8 +82,15 @@ class GrpcProxy:
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=16))
         self._server.add_generic_rpc_handlers((Handler(),))
-        self.port = self._server.add_insecure_port(
+        requested = self.port
+        bound = self._server.add_insecure_port(
             f"{self.host}:{self.port}")
+        if bound == 0:
+            # grpc reports bind failure by returning port 0.
+            self._server = None
+            raise OSError(
+                f"gRPC proxy could not bind {self.host}:{requested}")
+        self.port = bound
         self._server.start()
         return self
 
@@ -109,8 +116,11 @@ class GrpcProxy:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           "request must be a pickled plain-data payload "
                           "(dict/list/str/num/bytes — no custom classes)")
+        # Honor the client's deadline (default 30s when none given).
+        remaining = context.time_remaining()
+        timeout = remaining if remaining is not None else 30.0
         try:
-            result = handle.remote(payload).result(timeout=30)
+            result = handle.remote(payload).result(timeout=timeout)
         except BaseException as e:  # noqa: BLE001
             context.abort(grpc.StatusCode.INTERNAL, str(e)[:500])
         return pickle.dumps(result)
@@ -134,7 +144,9 @@ class GrpcClient:
         out = self._call(pickle.dumps(payload),
                          metadata=(("application", application),),
                          timeout=timeout)
-        return pickle.loads(out)
+        # Responses get the same restricted unpickling as requests: the
+        # channel is insecure, so the peer is untrusted by default.
+        return _restricted_loads(out)
 
     def close(self) -> None:
         self._channel.close()
