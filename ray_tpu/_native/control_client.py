@@ -31,6 +31,7 @@ OP_REGISTER_ACTOR, OP_UPDATE_ACTOR, OP_GET_ACTOR = 30, 31, 32
 OP_LIST_ACTORS, OP_GET_NAMED_ACTOR = 33, 34
 OP_ADD_JOB, OP_LIST_JOBS = 40, 41
 OP_STATS = 50
+OP_SNAPSHOT = 60
 
 ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_BAD_REQUEST = 0, 1, 2, 3
 
@@ -53,13 +54,17 @@ def available() -> bool:
     return os.path.exists(_BIN)
 
 
-def launch_control_plane(*, port: int = 0, health_timeout_ms: int = 5000
+def launch_control_plane(*, port: int = 0, health_timeout_ms: int = 5000,
+                         persist_path: Optional[str] = None
                          ) -> Tuple[subprocess.Popen, int]:
-    """Spawn the daemon; returns (process, bound port)."""
-    proc = subprocess.Popen(
-        [_BIN, "--port", str(port),
-         "--health-timeout-ms", str(health_timeout_ms)],
-        stdout=subprocess.PIPE, text=True)
+    """Spawn the daemon; returns (process, bound port). persist_path
+    enables crash-restart state recovery (reference: Redis-backed GCS
+    fault tolerance, tests/test_gcs_fault_tolerance.py)."""
+    cmd = [_BIN, "--port", str(port),
+           "--health-timeout-ms", str(health_timeout_ms)]
+    if persist_path:
+        cmd += ["--persist", persist_path]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
     line = proc.stdout.readline()
     if not line.startswith("PORT="):
         proc.kill()
@@ -325,6 +330,10 @@ class ControlClient:
             out[op] = {"count": count, "total_us": total,
                        "mean_us": total / count if count else 0.0}
         return out
+
+    def snapshot(self) -> None:
+        """Force a durable snapshot now (normally timer-driven)."""
+        self._request(OP_SNAPSHOT)
 
     def ping(self) -> int:
         return self._request(OP_PING).u64()

@@ -24,10 +24,12 @@ CHIPS_PER_HOST_BOUNDS_ENV = "TPU_CHIPS_PER_HOST_BOUNDS"  # e.g. "2,2,1"
 
 
 def get_visible_chips() -> Optional[List[str]]:
-    """Chip ids this process may use, or None = all
-    (reference: tpu.py get_current_process_visible_accelerator_ids)."""
+    """Chip ids this process may use; None = unrestricted. An EMPTY env
+    value means ZERO chips (the CUDA_VISIBLE_DEVICES contract — '' is a
+    restriction, not an absence of one; reference: tpu.py
+    get_current_process_visible_accelerator_ids)."""
     v = os.environ.get(VISIBLE_CHIPS_ENV)
-    if v is None or v == "":
+    if v is None:
         return None
     return [c.strip() for c in v.split(",") if c.strip() != ""]
 

@@ -52,14 +52,30 @@ def init_multihost(coordinator_address: Optional[str] = None,
     if coordinator_address is None and control_client is not None:
         # KV rendezvous through the native control plane (reference
         # analog: the TCP-store address published via GCS internal KV).
+        # jax.distributed runs the coordinator service ON process 0, so
+        # only process 0 may claim the key (it overwrites, so a stale
+        # address from a previous run with the same kv_key is replaced
+        # — still, use a per-job kv_key when reusing a control plane).
         import socket
+        import time
 
-        me = f"{socket.gethostbyname(socket.gethostname())}:{port}"
-        try:
-            control_client.kv_put(kv_key, me, overwrite=False)
+        if process_id == 0:
+            me = f"{socket.gethostbyname(socket.gethostname())}:{port}"
+            control_client.kv_put(kv_key, me, overwrite=True)
             coordinator_address = me
-        except Exception:  # noqa: BLE001 - someone else claimed it
-            coordinator_address = control_client.kv_get(kv_key).decode()
+        else:
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    coordinator_address = \
+                        control_client.kv_get(kv_key).decode()
+                    break
+                except Exception:  # noqa: BLE001 - not published yet
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"no coordinator published at KV key "
+                            f"{kv_key!r} within 60s")
+                    time.sleep(0.2)
     if coordinator_address is None:
         coordinator_address = f"127.0.0.1:{port}"
 

@@ -134,6 +134,50 @@ def _tail_lines(path: str, n: int) -> list:
     return lines[-n:]
 
 
+def cmd_serve(args) -> int:
+    """serve deploy/status/shutdown (reference: serve/scripts.py).
+
+    --address tpu://host:port targets a long-lived runtime via client
+    mode; without it a LOCAL runtime is created, which dies with this
+    process — so a local `deploy` implies --blocking."""
+    import ray_tpu
+
+    if args.address and args.address.startswith("tpu://"):
+        ray_tpu.init(address=args.address)
+        local = False
+    else:
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+        local = True
+    import ray_tpu.serve as serve
+
+    if args.serve_cmd == "deploy":
+        from ..serve.config import apply_config_file
+
+        routes = apply_config_file(args.config_file)
+        _print({"deployed": routes})
+        if local and not args.blocking:
+            print("note: local runtime dies with this process; "
+                  "blocking (pass --address tpu://... to deploy to a "
+                  "persistent runtime)")
+        if args.blocking or local:
+            import time as _time
+
+            try:
+                while True:
+                    _time.sleep(1)
+            except KeyboardInterrupt:
+                pass
+        return 0
+    if args.serve_cmd == "status":
+        _print(serve.status())
+        return 0
+    if args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+        return 0
+    return 1
+
+
 def cmd_memory(args) -> int:
     if args.address:
         _print(_fetch(args.address, "/api/summary/objects"))
@@ -229,6 +273,15 @@ def build_parser() -> argparse.ArgumentParser:
     mb = sub.add_parser("microbenchmark")
     mb.add_argument("--quick", action="store_true")
     mb.set_defaults(fn=cmd_microbenchmark)
+
+    sv = sub.add_parser("serve")
+    svsub = sv.add_subparsers(dest="serve_cmd", required=True)
+    sd = svsub.add_parser("deploy")
+    sd.add_argument("config_file")
+    sd.add_argument("--blocking", action="store_true")
+    sd.set_defaults(fn=cmd_serve)
+    svsub.add_parser("status").set_defaults(fn=cmd_serve)
+    svsub.add_parser("shutdown").set_defaults(fn=cmd_serve)
 
     jp = sub.add_parser("job")
     jsub = jp.add_subparsers(dest="job_cmd", required=True)

@@ -26,14 +26,28 @@ SERVICE = "ray_tpu.serve.GenericService"
 METHOD = f"/{SERVICE}/Predict"
 
 
+# Payloads off the network may contain plain data + numpy arrays (the
+# normal inference request/response shape) and nothing else — anything
+# resolvable through find_class can execute code via __reduce__.
+_SAFE_CLASSES = {
+    ("numpy", "ndarray"), ("numpy", "dtype"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.multiarray", "scalar"),
+}
+
+
 class _RestrictedUnpickler(pickle.Unpickler):
-    """Requests come off the network: refuse to resolve ANY class, so a
-    crafted payload cannot execute code via __reduce__ (plain-data
-    payloads — dict/list/tuple/str/num/bytes — never need find_class)."""
+    """Resolve only the numpy allow-list; refuse everything else."""
 
     def find_class(self, module, name):
+        if (module, name) in _SAFE_CLASSES:
+            import importlib
+
+            return getattr(importlib.import_module(module), name)
         raise pickle.UnpicklingError(
-            f"request payloads must be plain data; refusing "
+            f"payloads must be plain data (+ numpy arrays); refusing "
             f"{module}.{name}")
 
 

@@ -45,6 +45,7 @@ namespace {
 // ---------------------------------------------------------------------------
 enum Op : uint8_t {
   OP_PING = 0,
+  OP_SNAPSHOT = 60,  // persist state tables to --persist file
   OP_KV_PUT = 1,
   OP_KV_GET = 2,
   OP_KV_DEL = 3,
@@ -175,7 +176,113 @@ struct Server {
   std::map<std::string, std::string> jobs;
   std::map<uint8_t, OpStat> stats;   // per-op event stats
   uint64_t health_timeout_ms = 5000;
+  std::string persist_path;          // "" = no persistence
+  bool dirty = false;                // state changed since last snapshot
+  uint64_t last_snapshot_ms = 0;     // snapshot throttle
 };
+
+// ---------------------------------------------------------------------------
+// Persistence (reference: gcs persistence via store_client/ — Redis or
+// in-memory; on restart gcs_init_data.cc reloads the tables. Here the
+// durable backend is a length-prefixed snapshot file, rewritten
+// atomically on a timer whenever state changed.)
+// ---------------------------------------------------------------------------
+
+void put_str(std::string& out, const std::string& s) {
+  uint32_t n = static_cast<uint32_t>(s.size());
+  out.append(reinterpret_cast<const char*>(&n), 4);
+  out.append(s);
+}
+
+bool get_str(const std::string& in, size_t& off, std::string& s) {
+  if (off + 4 > in.size()) return false;
+  uint32_t n;
+  memcpy(&n, in.data() + off, 4);
+  off += 4;
+  if (off + n > in.size()) return false;
+  s.assign(in, off, n);
+  off += n;
+  return true;
+}
+
+void snapshot_state(Server& s) {
+  if (s.persist_path.empty()) return;
+  std::string out = "RTCP1";
+  uint32_t n = static_cast<uint32_t>(s.kv.size());
+  out.append(reinterpret_cast<const char*>(&n), 4);
+  for (const auto& [k, v] : s.kv) { put_str(out, k); put_str(out, v); }
+  n = static_cast<uint32_t>(s.actors.size());
+  out.append(reinterpret_cast<const char*>(&n), 4);
+  for (const auto& [aid, a] : s.actors) {
+    put_str(out, aid);
+    put_str(out, a.name);
+    put_str(out, a.state);
+    put_str(out, a.meta);
+  }
+  n = static_cast<uint32_t>(s.jobs.size());
+  out.append(reinterpret_cast<const char*>(&n), 4);
+  for (const auto& [j, m] : s.jobs) { put_str(out, j); put_str(out, m); }
+
+  std::string tmp = s.persist_path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (f == nullptr) return;
+  // A failed/short write must NOT clobber the last good snapshot.
+  size_t wrote = fwrite(out.data(), 1, out.size(), f);
+  bool ok = wrote == out.size();
+  if (ok) ok = fflush(f) == 0 && fsync(fileno(f)) == 0;
+  ok = (fclose(f) == 0) && ok;
+  if (!ok) {
+    remove(tmp.c_str());
+    return;  // stay dirty; retry on the next tick
+  }
+  rename(tmp.c_str(), s.persist_path.c_str());
+  s.dirty = false;
+  s.last_snapshot_ms = now_ms();
+}
+
+void restore_state(Server& s) {
+  if (s.persist_path.empty()) return;
+  FILE* f = fopen(s.persist_path.c_str(), "rb");
+  if (f == nullptr) return;
+  std::string in;
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) in.append(buf, n);
+  fclose(f);
+  if (in.compare(0, 5, "RTCP1") != 0) return;
+  size_t off = 5;
+  auto read_count = [&](uint32_t& c) {
+    if (off + 4 > in.size()) return false;
+    memcpy(&c, in.data() + off, 4);
+    off += 4;
+    return true;
+  };
+  uint32_t count;
+  if (!read_count(count)) return;
+  for (uint32_t i = 0; i < count; i++) {
+    std::string k, v;
+    if (!get_str(in, off, k) || !get_str(in, off, v)) return;
+    s.kv[k] = v;
+  }
+  if (!read_count(count)) return;
+  for (uint32_t i = 0; i < count; i++) {
+    std::string aid, name, state, meta;
+    if (!get_str(in, off, aid) || !get_str(in, off, name) ||
+        !get_str(in, off, state) || !get_str(in, off, meta))
+      return;
+    ActorInfo& a = s.actors[aid];
+    a.name = name;
+    a.state = state;
+    a.meta = meta;
+    if (!name.empty() && state != "DEAD") s.named_actors[name] = aid;
+  }
+  if (!read_count(count)) return;
+  for (uint32_t i = 0; i < count; i++) {
+    std::string j, m;
+    if (!get_str(in, off, j) || !get_str(in, off, m)) return;
+    s.jobs[j] = m;
+  }
+}
 
 void set_nonblock(int fd) {
   // Edge cases aside, the loop never blocks on a socket.
@@ -266,6 +373,7 @@ void dispatch(Server& s, Conn& c, Reader& r) {
         w.u8(ST_EXISTS);
       } else {
         s.kv[key] = val;
+        s.dirty = true;
         w.u8(ST_OK);
       }
       break;
@@ -279,7 +387,9 @@ void dispatch(Server& s, Conn& c, Reader& r) {
     }
     case OP_KV_DEL: {
       std::string key = r.str();
-      w.u8(s.kv.erase(key) ? ST_OK : ST_NOT_FOUND);
+      bool erased = s.kv.erase(key) > 0;
+      if (erased) s.dirty = true;
+      w.u8(erased ? ST_OK : ST_NOT_FOUND);
       break;
     }
     case OP_KV_EXISTS: {
@@ -393,6 +503,7 @@ void dispatch(Server& s, Conn& c, Reader& r) {
       a.name = name;
       a.state = "PENDING";
       a.meta = meta;
+      s.dirty = true;
       publish(s, "actor_events", "PENDING:" + actor_id);
       w.u8(ST_OK);
       break;
@@ -402,6 +513,7 @@ void dispatch(Server& s, Conn& c, Reader& r) {
       auto it = s.actors.find(actor_id);
       if (it == s.actors.end()) { w.u8(ST_NOT_FOUND); break; }
       it->second.state = state;
+      s.dirty = true;
       if (state == "DEAD" && !it->second.name.empty()) {
         auto nit = s.named_actors.find(it->second.name);
         if (nit != s.named_actors.end() && nit->second == actor_id)
@@ -442,6 +554,7 @@ void dispatch(Server& s, Conn& c, Reader& r) {
     case OP_ADD_JOB: {
       std::string job_id = r.str(), meta = r.str();
       s.jobs[job_id] = meta;
+      s.dirty = true;
       w.u8(ST_OK);
       break;
     }
@@ -452,6 +565,11 @@ void dispatch(Server& s, Conn& c, Reader& r) {
         w.str(jid);
         w.str(meta);
       }
+      break;
+    }
+    case OP_SNAPSHOT: {
+      snapshot_state(s);
+      w.u8(ST_OK);
       break;
     }
     case OP_STATS: {
@@ -551,14 +669,20 @@ void check_health(Server& s) {
 int main(int argc, char** argv) {
   int port = 0;
   uint64_t health_timeout_ms = 5000;
+  const char* persist = nullptr;
   for (int i = 1; i < argc - 1; i++) {
     if (strcmp(argv[i], "--port") == 0) port = atoi(argv[i + 1]);
     if (strcmp(argv[i], "--health-timeout-ms") == 0)
       health_timeout_ms = strtoull(argv[i + 1], nullptr, 10);
+    if (strcmp(argv[i], "--persist") == 0) persist = argv[i + 1];
   }
 
   Server s;
   s.health_timeout_ms = health_timeout_ms;
+  if (persist != nullptr) {
+    s.persist_path = persist;
+    restore_state(s);  // reference: gcs_init_data.cc reload on restart
+  }
   s.listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   setsockopt(s.listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
@@ -617,6 +741,10 @@ int main(int argc, char** argv) {
       if (events[i].events & EPOLLOUT) handle_writable(s, fd);
     }
     check_health(s);
+    // Throttled snapshots: full-state rewrites on every epoll tick
+    // would be O(state) I/O per write under load.
+    if (s.dirty && now_ms() - s.last_snapshot_ms >= 1000)
+      snapshot_state(s);
   }
   return 0;
 }

@@ -119,3 +119,12 @@ class TestTorchBatches:
         (batch,) = list(ds.iter_torch_batches(
             batch_size=8, dtypes={"id": torch.float32}))
         assert batch["id"].dtype == torch.float32
+
+
+def test_empty_visibility_means_zero_chips(monkeypatch):
+    """Review finding: TPU_VISIBLE_CHIPS='' is a restriction to ZERO
+    chips, not an absence of restriction."""
+    monkeypatch.setenv(acc.VISIBLE_CHIPS_ENV, "")
+    monkeypatch.setenv(acc.CHIPS_PER_HOST_BOUNDS_ENV, "2,2,1")
+    assert acc.get_visible_chips() == []
+    assert acc.num_chips_per_host() == 0

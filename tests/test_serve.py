@@ -271,3 +271,29 @@ class TestGrpcIngress:
             client.close()
         finally:
             serve.shutdown()
+
+
+def test_grpc_numpy_payloads(ray_start):
+    """Review finding: numpy arrays are the normal inference payload
+    shape and must survive the restricted unpickling in both
+    directions."""
+    import numpy as np
+
+    import ray_tpu.serve as serve
+    from ray_tpu.serve.grpc_proxy import GrpcClient
+
+    @serve.deployment
+    class Infer:
+        def __call__(self, req):
+            return {"logits": req["x"] * 2.0}
+
+    serve.run(Infer.bind(), name="np_app", grpc=True, grpc_port=0)
+    try:
+        from ray_tpu.serve import api as serve_api
+
+        client = GrpcClient(f"127.0.0.1:{serve_api._grpc_proxy.port}")
+        out = client.predict("np_app", {"x": np.arange(4.0)})
+        np.testing.assert_array_equal(out["logits"], np.arange(4.0) * 2)
+        client.close()
+    finally:
+        serve.shutdown()

@@ -1,0 +1,109 @@
+"""Declarative serve config tests (reference coverage model:
+python/ray/serve/tests/test_config_files + test_cli deploy/status)."""
+
+import sys
+import textwrap
+
+import pytest
+
+
+@pytest.fixture
+def demo_module(tmp_path, monkeypatch):
+    """A user module exposing deployments the config imports."""
+    mod = tmp_path / "serve_demo_mod.py"
+    mod.write_text(textwrap.dedent("""
+        import ray_tpu.serve as serve
+
+        @serve.deployment
+        class Upper:
+            def __call__(self, req):
+                return {"text": str(req.get("text", "")).upper()}
+
+        app = Upper.bind()
+
+        @serve.deployment
+        class Scorer:
+            def __call__(self, req):
+                return {"score": len(str(req.get("text", "")))}
+    """))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    yield "serve_demo_mod"
+    sys.modules.pop("serve_demo_mod", None)
+
+
+def test_build_app_variants(ray_start, demo_module):
+    from ray_tpu.serve.config import build_app
+    from ray_tpu.serve.deployment import Application
+
+    assert isinstance(build_app(f"{demo_module}:app"), Application)
+    assert isinstance(build_app(f"{demo_module}:Scorer"), Application)
+    with pytest.raises(ValueError):
+        build_app("no_colon_path")
+
+
+def test_apply_config_deploys_and_serves(ray_start, demo_module):
+    import ray_tpu.serve as serve
+    from ray_tpu.serve.config import apply_config
+
+    config = {
+        "applications": [
+            {"name": "upper", "import_path": f"{demo_module}:app"},
+            {"name": "scorer", "import_path": f"{demo_module}:Scorer",
+             "deployments": [
+                 {"name": "Scorer", "num_replicas": 2}]},
+        ],
+    }
+    try:
+        routes = apply_config(config)
+        assert routes == {"upper": "upper", "scorer": "scorer"}
+        h = serve.get_deployment_handle("Upper")
+        assert h.remote({"text": "abc"}).result(timeout=30) == \
+            {"text": "ABC"}
+        st = serve.status()
+        scorer = st["deployments"]["Scorer"] if "deployments" in st \
+            else None
+        # Status shape is implementation-defined; replica override must
+        # at least reach the controller.
+        assert "Scorer" in str(st)
+    finally:
+        serve.shutdown()
+
+
+def test_apply_config_file_and_overrides(ray_start, demo_module,
+                                         tmp_path):
+    import yaml
+
+    import ray_tpu.serve as serve
+    from ray_tpu.serve.config import apply_config_file
+
+    cfg = {
+        "applications": [{
+            "name": "u",
+            "import_path": f"{demo_module}:app",
+            "deployments": [{"name": "Upper", "num_replicas": 2}],
+        }],
+    }
+    path = tmp_path / "serve.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    try:
+        routes = apply_config_file(str(path))
+        assert routes == {"u": "u"}
+        h = serve.get_deployment_handle("Upper")
+        assert h.remote({"text": "x"}).result(timeout=30) == {"text": "X"}
+    finally:
+        serve.shutdown()
+
+
+def test_unknown_deployment_override_rejected(ray_start, demo_module):
+    import ray_tpu.serve as serve
+    from ray_tpu.serve.config import apply_config
+
+    config = {"applications": [{
+        "name": "bad", "import_path": f"{demo_module}:app",
+        "deployments": [{"name": "DoesNotExist", "num_replicas": 2}],
+    }]}
+    try:
+        with pytest.raises(ValueError, match="unknown deployment"):
+            apply_config(config)
+    finally:
+        serve.shutdown()
