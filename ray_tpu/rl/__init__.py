@@ -21,6 +21,7 @@ from .env import (
 from .dqn import DQN, DQNConfig
 from .env_runner import EnvRunner
 from .grpo import GRPO, GRPOConfig
+from .impala import IMPALA, IMPALAConfig
 from .module import MLPModuleSpec, QMLPSpec
 from .ppo import PPO, PPOConfig
 from .sac import SAC, SACConfig
@@ -29,5 +30,5 @@ __all__ = [
     "Algorithm", "ReplayBuffer", "Env", "CartPole", "GridWorld",
     "VectorEnv", "make_env", "register_env", "ENV_REGISTRY", "EnvRunner",
     "MLPModuleSpec", "QMLPSpec", "PPO", "PPOConfig", "GRPO", "GRPOConfig",
-    "DQN", "DQNConfig", "SAC", "SACConfig",
+    "DQN", "DQNConfig", "SAC", "SACConfig", "IMPALA", "IMPALAConfig",
 ]

@@ -59,6 +59,9 @@ class EnvRunner:
             "rewards": np.stack(rew_l),
             "dones": np.stack(done_l),
             "last_values": last_values,
+            # The state AFTER the final step — the correct bootstrap
+            # input (obs[-1] is the state BEFORE the last action).
+            "last_obs": np.asarray(self.vec.observations),
             "episode_returns": np.asarray(
                 self.vec.pop_episode_returns(), np.float32),
         }

@@ -161,3 +161,87 @@ class TestTuneIntegration:
         assert not res.errors
         for r in res:
             assert r.checkpoint is not None
+
+
+class TestIMPALA:
+    def test_vtrace_reduces_to_gae_like_onpolicy(self):
+        """Unit: with target == behavior policy (rho = 1) and no dones,
+        V-trace vs equals the n-step TD(lambda=1)-style return."""
+        import jax.numpy as jnp
+        from ray_tpu.rl.impala import vtrace
+
+        T, K = 5, 3
+        rng = np.random.RandomState(0)
+        logp = jnp.asarray(rng.randn(T, K) * 0.1)
+        rewards = jnp.asarray(rng.randn(T, K))
+        values = jnp.asarray(rng.randn(T, K))
+        dones = jnp.zeros((T, K))
+        boot = jnp.asarray(rng.randn(K))
+        vs, adv = vtrace(logp, logp, rewards, values, dones, boot,
+                         gamma=0.9, rho_bar=1.0, c_bar=1.0)
+        # On-policy, rho=1: vs_t = sum_{k>=t} gamma^{k-t} delta_k + V_t
+        # == the Monte-Carlo-corrected value.
+        expected = np.array(values)
+        deltas = np.array(rewards) + 0.9 * np.concatenate(
+            [np.array(values[1:]), np.array(boot)[None]]) \
+            - np.array(values)
+        acc = np.zeros(K)
+        out = np.zeros((T, K))
+        for t in reversed(range(T)):
+            acc = deltas[t] + 0.9 * acc
+            out[t] = acc
+        np.testing.assert_allclose(np.array(vs), expected + out,
+                                   rtol=1e-5)
+
+    def test_vtrace_clips_large_ratios(self):
+        import jax.numpy as jnp
+        from ray_tpu.rl.impala import vtrace
+
+        behavior = jnp.zeros((3, 2))
+        target = jnp.full((3, 2), 5.0)  # rho = e^5 >> 1
+        vs, adv = vtrace(behavior, target, jnp.ones((3, 2)),
+                         jnp.zeros((3, 2)), jnp.zeros((3, 2)),
+                         jnp.zeros(2), 0.9, 1.0, 1.0)
+        # Clipped at rho_bar=1: same as on-policy values.
+        vs2, _ = vtrace(behavior, behavior, jnp.ones((3, 2)),
+                        jnp.zeros((3, 2)), jnp.zeros((3, 2)),
+                        jnp.zeros(2), 0.9, 1.0, 1.0)
+        np.testing.assert_allclose(np.array(vs), np.array(vs2),
+                                   rtol=1e-5)
+
+    def test_learns_cartpole(self, ray_start):
+        """CartPole rather than GridWorld: single-pass PG (no PPO-style
+        sample reuse) is seed-fragile on GridWorld's corner-goal local
+        optimum, while CartPole learns across seeds (swept 0/1/7)."""
+        from ray_tpu.rl import IMPALA, IMPALAConfig
+
+        algo = IMPALA(IMPALAConfig(
+            env="CartPole", num_env_runners=2, num_envs_per_runner=8,
+            rollout_length=48, hidden=(32,), lr=1e-3, seed=0))
+        rets = []
+        for _ in range(70):
+            r = algo.step()
+            rets.append(r["episode_return_mean"])
+        algo.stop()
+        tail = [x for x in rets[-5:] if x is not None]
+        # Random policy scores ~20; learned runs sweep 54-69.
+        assert tail and np.mean(tail) > 35
+        # The pipeline stayed async: other runners' futures overlapped
+        # with the update.
+        assert r["inflight"] >= 1
+
+    def test_checkpoint_roundtrip(self, ray_start, tmp_path):
+        from ray_tpu.rl import IMPALA, IMPALAConfig
+
+        cfg = IMPALAConfig(env="GridWorld", num_env_runners=1,
+                           num_envs_per_runner=2, rollout_length=8,
+                           hidden=(16,))
+        algo = IMPALA(cfg)
+        algo.step()
+        path = algo.save(str(tmp_path / "imp"))
+        algo2 = IMPALA(cfg)
+        algo2.restore(path)
+        a = jax.tree.leaves(algo.params)[0]
+        b = jax.tree.leaves(algo2.params)[0]
+        np.testing.assert_array_equal(a, b)
+        algo.stop(); algo2.stop()
