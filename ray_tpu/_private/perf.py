@@ -72,6 +72,10 @@ def run_microbenchmarks(quick: bool = False) -> Iterator[str]:
     yield (f"actor_calls_1_1_async_per_second: "
            f"{_rate(async_actor_calls, dur):.1f} ops/s")
 
+    # Release the 1:1 actor's CPU before the n:n pool — on a 4-CPU
+    # runtime a 5th 1-CPU actor would never schedule and the benchmark
+    # would wait forever.
+    ray.kill(actor)
     actors = [Pong.remote() for _ in range(4)]
     ray.get([a.ping.remote() for a in actors])
 

@@ -1,5 +1,6 @@
 from . import aggregate
 from .block import Block, BlockAccessor
+from .context import DataContext
 from .dataset import Dataset
 from .grouped_data import GroupedData
 from .iterator import DataIterator
@@ -29,6 +30,7 @@ from .read_api import (
 
 __all__ = [
     "Dataset", "DataIterator", "Block", "BlockAccessor", "GroupedData",
+    "DataContext",
     "aggregate",
     "from_items", "from_pandas", "from_numpy", "from_arrow", "range",
     "range_tensor", "read_parquet", "read_csv", "read_json", "read_text",

@@ -1,0 +1,41 @@
+"""DataContext — per-process execution configuration for Data.
+
+Capability-equivalent of the reference's DataContext
+(reference: python/ray/data/context.py — a get_current() singleton of
+execution toggles read by the planner/executor): bounds the streaming
+executor's in-flight window, default batch sizes, and shuffle
+parallelism without threading arguments through every operator.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import ClassVar, Optional
+
+
+@dataclass
+class DataContext:
+    #: Max in-flight tasks per streaming map/read stage (backpressure).
+    max_in_flight_tasks: int = 8
+    #: Default rows per batch for iter_batches when unspecified.
+    default_batch_size: int = 256
+    #: Default output partitions for groupby's hash shuffle.
+    groupby_num_partitions: int = 8
+    #: Verify CRCs when reading TFRecord files.
+    tfrecord_verify_crc: bool = True
+
+    _instance: ClassVar[Optional["DataContext"]] = None
+    _lock: ClassVar[threading.Lock] = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def _set_current(cls, ctx: Optional["DataContext"]) -> None:
+        with cls._lock:
+            cls._instance = ctx

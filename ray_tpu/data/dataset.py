@@ -98,10 +98,9 @@ class Dataset:
     # aggregate/sum/min/max/mean/std :2281-2554, unique)
     # ------------------------------------------------------------------
     def groupby(self, key: str, *, num_partitions: Optional[int] = None):
-        from .grouped_data import DEFAULT_NUM_PARTITIONS, GroupedData
+        from .grouped_data import GroupedData
 
-        return GroupedData(self, key,
-                           num_partitions or DEFAULT_NUM_PARTITIONS)
+        return GroupedData(self, key, num_partitions)
 
     def aggregate(self, *aggs) -> Dict[str, Any]:
         """Whole-dataset aggregation: per-block parallel accumulate +

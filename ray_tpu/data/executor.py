@@ -33,9 +33,6 @@ from .plan import (
     _MapSpec,
 )
 
-DEFAULT_MAX_IN_FLIGHT = 8
-
-
 # ---------------------------------------------------------------------------
 # Remote transforms
 # ---------------------------------------------------------------------------
@@ -328,11 +325,16 @@ def _stage_sort(op: Sort, upstream: Iterator[ObjectRef]
     yield from _push_shuffle(iter(refs), n_out, "range", arg, None)
 
 
-def execute(root: LogicalOp, *, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+def execute(root: LogicalOp, *, max_in_flight: Optional[int] = None
             ) -> Iterator[ObjectRef]:
     """Compile the logical chain into a lazy pipelined iterator of block
-    refs. Backpressure = bounded windows per map/read stage."""
+    refs. Backpressure = bounded windows per map/read stage; the window
+    defaults to DataContext.max_in_flight_tasks."""
+    from .context import DataContext
     from .plan import optimize
+
+    if max_in_flight is None:
+        max_in_flight = DataContext.get_current().max_in_flight_tasks
 
     stream: Optional[Iterator[ObjectRef]] = None
     for op in optimize(root).chain():

@@ -19,9 +19,6 @@ from .. import get as ray_get, put as ray_put, remote
 from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
 from .block import BlockAccessor, concat_blocks
 
-DEFAULT_NUM_PARTITIONS = 8
-
-
 @remote
 def _partition_block(block, key: str, n: int):
     """Hash-partition one block by key → list of n piece refs (None for
@@ -116,10 +113,13 @@ def _map_groups_partition(pieces, key: str, fn, batch_format: str):
 
 class GroupedData:
     def __init__(self, dataset, key: str,
-                 num_partitions: int = DEFAULT_NUM_PARTITIONS):
+                 num_partitions: Optional[int] = None):
+        from .context import DataContext
+
         self._ds = dataset
         self._key = key
-        self._n = num_partitions
+        self._n = (num_partitions
+                   or DataContext.get_current().groupby_num_partitions)
 
     def _partitions(self) -> List[List[Any]]:
         """Hash-shuffle the dataset's blocks → n lists of piece refs.

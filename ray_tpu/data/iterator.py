@@ -61,14 +61,19 @@ class DataIterator:
                 out[k] = t
             yield out
 
-    def iter_batches(self, *, batch_size: Optional[int] = 256,
+    def iter_batches(self, *, batch_size: Optional[int] = -1,
                      batch_format: str = "numpy",
                      prefetch_batches: int = 1,
                      device_put: bool = False,
                      sharding: Optional[Any] = None,
                      drop_last: bool = False) -> Iterator[Any]:
-        """Re-batch blocks to `batch_size` rows. With device_put=True,
-        batches are staged into device memory `prefetch_batches` ahead."""
+        """Re-batch blocks to `batch_size` rows (-1 = the DataContext
+        default). With device_put=True, batches are staged into device
+        memory `prefetch_batches` ahead."""
+        if batch_size == -1:
+            from .context import DataContext
+
+            batch_size = DataContext.get_current().default_batch_size
         def host_batches():
             carry: List = []
             carry_rows = 0

@@ -189,12 +189,15 @@ def read_images(paths, *, size: Optional[tuple] = None,
 
 
 def read_tfrecords(paths, *, parallelism: int = -1,
-                   verify_crc: bool = True) -> Dataset:
+                   verify_crc: Optional[bool] = None) -> Dataset:
     """TFRecord reader — pure-python wire format + tf.train.Example codec
     (reference: data/datasource/tfrecords_datasource.py, sans tensorflow).
     Set verify_crc=False to skip checksums on trusted large shards."""
     from . import tfrecord
+    from .context import DataContext
 
+    if verify_crc is None:
+        verify_crc = DataContext.get_current().tfrecord_verify_crc
     files = _expand_paths(paths)
 
     def read_one(path):

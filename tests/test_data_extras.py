@@ -343,3 +343,17 @@ def test_sort_all_empty_blocks(data):
     on empty sample concatenation."""
     ds = data.range(100, parallelism=4).filter(lambda r: False)
     assert ds.sort("id").take_all() == []
+
+
+def test_data_context_toggles(data):
+    from ray_tpu.data import DataContext
+
+    ctx = DataContext.get_current()
+    assert ctx is DataContext.get_current()  # singleton
+    old = ctx.groupby_num_partitions
+    try:
+        ctx.groupby_num_partitions = 3
+        g = data.range(30, parallelism=2).groupby("id")
+        assert g._n == 3
+    finally:
+        ctx.groupby_num_partitions = old
