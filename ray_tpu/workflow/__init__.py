@@ -21,12 +21,14 @@ from .api import (
     run_async,
     wait_for_event,
 )
-from .event import EventListener, QueueEventProvider, TimerListener
+from .event import (EventListener, HTTPEventProvider,
+                    QueueEventProvider, TimerListener)
 from .storage import WorkflowStorage
 
 __all__ = [
     "run", "run_async", "resume", "get_output", "get_status", "list_all",
     "delete", "init", "wait_for_event", "EventListener", "TimerListener",
+    "HTTPEventProvider",
     "QueueEventProvider", "WorkflowStorage", "RUNNING", "SUCCESSFUL",
     "FAILED", "RESUMABLE",
 ]

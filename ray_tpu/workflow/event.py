@@ -52,3 +52,125 @@ class QueueEventProvider(EventListener):
                     raise TimeoutError("no event before timeout")
                 self._cv.wait(remain)
             return self._events.pop(0)
+
+
+class HTTPEventProvider:
+    """HTTP ingress for workflow events (reference:
+    workflow/http_event_provider.py — workflows block on
+    `listener(key)` until `POST /event/<key>` arrives with a JSON
+    body). One provider serves many keys; each key is an independent
+    event queue."""
+
+    MAX_KEYS = 1024  # unauthenticated endpoint: bound key growth
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._queues: dict = {}
+        self._lock = threading.Lock()
+        self._started = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._loop = None
+        self._runner = None
+        self._serve_error: Optional[BaseException] = None
+
+    def _queue(self, key: str,
+               create: bool = True) -> Optional[QueueEventProvider]:
+        with self._lock:
+            q = self._queues.get(key)
+            if q is None and create:
+                if len(self._queues) >= self.MAX_KEYS:
+                    return None
+                q = self._queues[key] = QueueEventProvider()
+            return q
+
+    def listener(self, key: str) -> EventListener:
+        """The EventListener a workflow step blocks on for `key`."""
+        q = self._queue(key)
+        if q is None:
+            raise RuntimeError(f"event key limit ({self.MAX_KEYS}) hit")
+        return q
+
+    def remove_listener(self, key: str) -> None:
+        """Drop a consumed key's queue (long-lived providers should
+        evict keys their workflows have finished with)."""
+        with self._lock:
+            self._queues.pop(key, None)
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "HTTPEventProvider":
+        if self._thread is not None:
+            return self
+        self._serve_error = None
+        self._started.clear()
+        self._thread = threading.Thread(
+            target=self._serve, daemon=True, name="workflow-events")
+        self._thread.start()
+        if not self._started.wait(10):
+            self._thread = None  # a retry must not pretend it's up
+            cause = self._serve_error
+            raise RuntimeError(
+                "event provider failed to start") from cause
+        return self
+
+    def stop(self) -> None:
+        import asyncio
+
+        if self._loop is not None:
+            loop = self._loop
+
+            async def _shutdown():
+                await self._runner.cleanup()
+                loop.stop()
+
+            asyncio.run_coroutine_threadsafe(_shutdown(), loop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._loop is not None:
+            self._loop.close()  # release the selector fd
+            self._loop = None
+        self._runner = None
+        self._started.clear()
+
+    def _serve(self) -> None:
+        import asyncio
+        import json
+
+        from aiohttp import web
+
+        async def post_event(request: "web.Request"):
+            key = request.match_info["key"]
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                payload = (await request.read()).decode()
+            q = self._queue(key)
+            if q is None:
+                return web.json_response(
+                    {"error": "event key limit reached"}, status=429)
+            q.post(payload)
+            return web.json_response({"status": "posted", "key": key})
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        try:
+            app = web.Application()
+            app.router.add_post("/event/{key}", post_event)
+            self._runner = web.AppRunner(app)
+            loop.run_until_complete(self._runner.setup())
+            site = web.TCPSite(self._runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+            # Public API for the bound address (port=0 resolution).
+            self.port = self._runner.addresses[0][1]
+        except BaseException as e:  # noqa: BLE001
+            self._serve_error = e
+            loop.close()
+            self._loop = None
+            return
+        self._started.set()
+        loop.run_forever()

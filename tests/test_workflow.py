@@ -151,3 +151,55 @@ def test_event_listener(wf, ray_start):
 
     with pytest.raises(TimeoutError):
         wf.wait_for_event(wf.QueueEventProvider(), timeout=0.05)
+
+
+class TestHTTPEvents:
+    def test_http_event_unblocks_workflow_step(self, ray_start):
+        """Reference capability: http_event_provider.py — a workflow
+        step blocks until POST /event/<key> arrives."""
+        import json
+        import threading
+        import urllib.request
+
+        from ray_tpu.workflow.event import HTTPEventProvider
+
+        provider = HTTPEventProvider(port=0).start()
+        try:
+            listener = provider.listener("order-123")
+            got = {}
+
+            def wait_step():
+                got["event"] = listener.poll_for_event(timeout=30)
+
+            t = threading.Thread(target=wait_step, daemon=True)
+            t.start()
+            req = urllib.request.Request(
+                provider.address + "/event/order-123",
+                data=json.dumps({"paid": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert json.load(r)["status"] == "posted"
+            t.join(timeout=10)
+            assert got["event"] == {"paid": True}
+        finally:
+            provider.stop()
+
+    def test_keys_are_independent(self, ray_start):
+        import json
+        import urllib.request
+
+        from ray_tpu.workflow.event import HTTPEventProvider
+
+        provider = HTTPEventProvider(port=0).start()
+        try:
+            req = urllib.request.Request(
+                provider.address + "/event/a",
+                data=json.dumps({"n": 1}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=10).close()
+            with pytest.raises(TimeoutError):
+                provider.listener("b").poll_for_event(timeout=0.3)
+            assert provider.listener("a").poll_for_event(
+                timeout=5) == {"n": 1}
+        finally:
+            provider.stop()
