@@ -6,9 +6,11 @@ from .grouped_data import GroupedData
 from .iterator import DataIterator
 from .read_api import (
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
+    from_torch,
     range,
     range_tensor,
     read_binary_files,
@@ -32,7 +34,8 @@ __all__ = [
     "Dataset", "DataIterator", "Block", "BlockAccessor", "GroupedData",
     "DataContext",
     "aggregate",
-    "from_items", "from_pandas", "from_numpy", "from_arrow", "range",
+    "from_items", "from_pandas", "from_numpy", "from_arrow",
+    "from_torch", "from_huggingface", "range",
     "range_tensor", "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images", "read_tfrecords",
     "read_webdataset", "read_sql",

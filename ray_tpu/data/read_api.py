@@ -95,6 +95,64 @@ def from_arrow(table) -> Dataset:
     return Dataset(FromBlocks([table], "from_arrow"))
 
 
+def from_torch(torch_dataset) -> Dataset:
+    """Map-style torch Dataset → Dataset (reference:
+    read_api.from_torch). Rows become {"item": value} unless the
+    dataset yields dicts."""
+    import builtins
+
+    def to_np(x):
+        if hasattr(x, "detach"):  # torch tensor: device/grad-safe path
+            x = x.detach().cpu().numpy()
+        elif hasattr(x, "numpy"):
+            x = x.numpy()
+        if isinstance(x, np.ndarray) and x.ndim == 0:
+            x = x.item()
+        return x
+
+    rows = []
+    # NB: this module's `range` is the dataset builder.
+    for i in builtins.range(len(torch_dataset)):
+        item = torch_dataset[i]
+        if isinstance(item, dict):
+            rows.append({k: to_np(v) for k, v in item.items()})
+            continue
+        if isinstance(item, tuple):
+            # Tuples are the multi-output convention (TensorDataset);
+            # plain lists stay one value (e.g. variable-length tokens).
+            vals = [to_np(x) for x in item]
+            rows.append({"item": vals[0]} if len(vals) == 1 else
+                        {f"item_{j}": v for j, v in enumerate(vals)})
+            continue
+        rows.append({"item": to_np(item)})
+    return Dataset(FromBlocks(
+        [BlockAccessor.for_block(rows).block], "from_torch"))
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """HuggingFace datasets.Dataset → Dataset via its arrow table
+    (reference: read_api.from_huggingface; zero-copy when possible)."""
+    # A shuffled/selected/filtered HF dataset keeps the ORIGINAL rows
+    # in .data and records the view in _indices — materialize the view
+    # first or we'd silently return wrong rows.
+    orig = hf_dataset
+    table = None
+    if getattr(hf_dataset, "_indices", None) is not None:
+        try:
+            hf_dataset = hf_dataset.flatten_indices()
+        except Exception:  # noqa: BLE001 - fall back to row iteration
+            hf_dataset = None
+    if hf_dataset is not None:
+        table = getattr(getattr(hf_dataset, "data", None), "table", None)
+    if table is not None:
+        return Dataset(FromBlocks([table], "from_huggingface"))
+    hf_dataset = orig  # row-iteration fallback sees the user's VIEW
+    # Fallback: row iteration (datasets lib variants without .data).
+    rows = [dict(r) for r in hf_dataset]
+    return Dataset(FromBlocks(
+        [BlockAccessor.for_block(rows).block], "from_huggingface"))
+
+
 # ---------------------------------------------------------------------------
 # Files
 # ---------------------------------------------------------------------------

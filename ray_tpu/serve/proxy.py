@@ -12,7 +12,42 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time as _time
 from typing import Any, Dict, Optional
+
+_METRICS = {}
+_METRICS_LOCK = threading.Lock()
+
+
+def _request_metrics(metrics_mod, app: str, code: str,
+                     latency_s: float) -> None:
+    """Per-request ingress metrics (reference: serve's
+    serve_num_http_requests / processing-latency metrics)."""
+    with _METRICS_LOCK:
+        if not _METRICS:
+            # Build BOTH before publishing either: a partial init would
+            # silently drop latency recording forever.
+            try:
+                count = metrics_mod.Counter(
+                    "serve_num_http_requests", "HTTP ingress requests",
+                    tag_keys=("application", "status"))
+                latency = metrics_mod.Histogram(
+                    "serve_http_request_latency_s",
+                    "HTTP request latency",
+                    boundaries=[0.005, 0.02, 0.1, 0.5, 2.0],
+                    tag_keys=("application",))
+            except ValueError:
+                return  # registry clash (tests clearing registries)
+            _METRICS["count"] = count
+            _METRICS["latency"] = latency
+    try:
+        _METRICS["count"].inc(
+            tags={"application": app, "status": code})
+        if latency_s > 0:
+            _METRICS["latency"].observe(
+                latency_s, tags={"application": app})
+    except Exception:  # noqa: BLE001 - metrics must not break serving
+        pass
 
 
 class HttpProxy:
@@ -57,11 +92,15 @@ class HttpProxy:
     def _serve(self):
         from aiohttp import web
 
+        from ..util import metrics as _metrics
+
         async def handler(request: "web.Request"):
+            t0 = _time.perf_counter()
             name = request.match_info.get("app", "").strip("/")
             with self._lock:
                 handle = self._routes.get(name)
             if handle is None:
+                _request_metrics(_metrics, name, "404", 0.0)
                 return web.json_response(
                     {"error": f"no app {name!r}"}, status=404)
             if request.method == "POST":
@@ -77,8 +116,12 @@ class HttpProxy:
                 result = await loop.run_in_executor(
                     None, lambda: fut.result(timeout=30))
             except BaseException as e:  # noqa: BLE001
+                _request_metrics(_metrics, name, "500",
+                                 _time.perf_counter() - t0)
                 return web.json_response(
                     {"error": str(e)[:500]}, status=500)
+            _request_metrics(_metrics, name, "200",
+                             _time.perf_counter() - t0)
             try:
                 return web.json_response({"result": result})
             except TypeError:

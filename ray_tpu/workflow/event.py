@@ -109,11 +109,18 @@ class HTTPEventProvider:
         self._thread = threading.Thread(
             target=self._serve, daemon=True, name="workflow-events")
         self._thread.start()
-        if not self._started.wait(10):
-            self._thread = None  # a retry must not pretend it's up
-            cause = self._serve_error
+        self._started.wait(10)
+        if self._serve_error is not None:
+            # _serve signals failures immediately (no 10s stall).
+            self._thread = None
             raise RuntimeError(
-                "event provider failed to start") from cause
+                "event provider failed to start") from self._serve_error
+        if not self._started.is_set():
+            # Setup genuinely slow: KEEP the thread reference so stop()
+            # can still join it — a retry must not double-bind.
+            raise RuntimeError(
+                "event provider did not start within 10s; call stop() "
+                "before retrying")
         return self
 
     def stop(self) -> None:
@@ -171,6 +178,7 @@ class HTTPEventProvider:
             self._serve_error = e
             loop.close()
             self._loop = None
+            self._started.set()  # wake the waiter NOW with the error
             return
         self._started.set()
         loop.run_forever()

@@ -357,3 +357,24 @@ def test_data_context_toggles(data):
         assert g._n == 3
     finally:
         ctx.groupby_num_partitions = old
+
+
+def test_from_torch(data):
+    import torch
+    from torch.utils.data import TensorDataset
+
+    ds = data.from_torch(TensorDataset(torch.arange(6).float()))
+    rows = ds.take_all()
+    assert len(rows) == 6
+
+
+def test_from_torch_dict_rows(data):
+    class DictDS:
+        def __len__(self):
+            return 3
+
+        def __getitem__(self, i):
+            return {"x": i, "y": i * 10}
+
+    rows = data.from_torch(DictDS()).take_all()
+    assert rows == [{"x": i, "y": i * 10} for i in range(3)]

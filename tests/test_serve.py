@@ -297,3 +297,28 @@ def test_grpc_numpy_payloads(ray_start):
         client.close()
     finally:
         serve.shutdown()
+
+
+def test_http_proxy_records_metrics(ray_start):
+    import json
+    import urllib.request
+
+    import ray_tpu.serve as serve
+    from ray_tpu.util import metrics
+
+    @serve.deployment
+    def echo(req):
+        return req
+
+    serve.run(echo.bind(), name="mx", http=True, http_port=18231)
+    try:
+        req = urllib.request.Request(
+            "http://127.0.0.1:18231/mx",
+            data=json.dumps({"a": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=30).close()
+        text = metrics.prometheus_text()
+        assert 'serve_num_http_requests' in text
+        assert 'application="mx"' in text
+    finally:
+        serve.shutdown()
