@@ -1,0 +1,139 @@
+"""Fault injection — chaos harness for resilience testing.
+
+Capability-equivalent of the reference's chaos tooling
+(reference: python/ray/_private/test_utils.py — NodeKillerActor :1464,
+ResourceKillerActor :1396, kill_raylet :1874; release/nightly_tests
+setup_chaos.py; `ray kill-random-node` CLI scripts.py:1378): actors
+that periodically kill cluster components while a workload runs, so
+retries / lineage reconstruction / actor restarts are exercised under
+real failure interleavings rather than single hand-placed faults.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+
+class NodeKiller:
+    """Periodically removes random non-head nodes from the scheduler
+    (reference: NodeKillerActor). Run as a plain object in the driver —
+    killing the node that hosts you is the reference actor's classic
+    self-inflicted failure mode."""
+
+    def __init__(self, *, interval_s: float = 1.0,
+                 max_kills: int = 3, seed: Optional[int] = None,
+                 respawn: bool = False):
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.respawn = respawn
+        self._rng = random.Random(seed)
+        self.killed: List[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "NodeKiller":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="node-killer")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 2)
+            self._thread = None
+
+    def _run(self) -> None:
+        from ..core.runtime import global_runtime_or_none
+
+        while not self._stop.wait(self.interval_s):
+            if len(self.killed) >= self.max_kills:
+                return
+            rt = global_runtime_or_none()
+            if rt is None:
+                return
+            victims = [n for n in rt.scheduler.nodes()
+                       if n.node_id != rt.head_node_id and n.alive]
+            if not victims:
+                continue
+            node = self._rng.choice(victims)
+            self.kill_node(node.node_id)
+
+    def kill_node(self, node_id: str) -> None:
+        from ..core.runtime import global_runtime_or_none
+
+        rt = global_runtime_or_none()
+        if rt is None:
+            return
+        rt.scheduler.remove_node(node_id)
+        self.killed.append(node_id)
+
+
+def kill_random_node(exclude_head: bool = True) -> Optional[str]:
+    """One-shot random node kill (reference: `ray kill-random-node`,
+    scripts.py:1378). Returns the killed node id or None."""
+    from ..core.runtime import global_runtime_or_none
+
+    rt = global_runtime_or_none()
+    if rt is None:
+        return None
+    victims = [n for n in rt.scheduler.nodes()
+               if n.alive and not (exclude_head
+                                   and n.node_id == rt.head_node_id)]
+    if not victims:
+        return None
+    node = random.choice(victims)
+    rt.scheduler.remove_node(node.node_id)
+    return node.node_id
+
+
+class WorkerKiller:
+    """Kills random spawned worker PROCESSES mid-task (reference:
+    ResourceKillerActor targeting workers): exercises worker-crash
+    recovery — the pool respawns, tasks retry per max_retries, actors
+    restart per max_restarts."""
+
+    def __init__(self, *, interval_s: float = 0.5,
+                 max_kills: int = 2, seed: Optional[int] = None):
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self._rng = random.Random(seed)
+        self.killed: List[int] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "WorkerKiller":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="worker-killer")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 2)
+            self._thread = None
+
+    def _run(self) -> None:
+        from ..core.runtime import global_runtime_or_none
+
+        while not self._stop.wait(self.interval_s):
+            if len(self.killed) >= self.max_kills:
+                return
+            rt = global_runtime_or_none()
+            if rt is None or rt.worker_pool is None:
+                return
+            with rt.worker_pool._lock:
+                workers = [w for w in rt.worker_pool._all.values()
+                           if w.proc.poll() is None]
+            if not workers:
+                continue
+            w = self._rng.choice(workers)
+            try:
+                w.proc.kill()
+                self.killed.append(w.worker_id)
+            except Exception:  # noqa: BLE001
+                pass

@@ -132,6 +132,15 @@ class DashboardServer:
             return _json(fn())
 
         r.add_get("/api/summary/{kind}", summary)
+
+        async def kill_random_node(_request):
+            # Chaos endpoint (reference: `ray kill-random-node`).
+            from .._private.fault_injection import kill_random_node
+
+            killed = kill_random_node(exclude_head=True)
+            return _json({"killed": killed})
+
+        r.add_post("/api/kill_random_node", kill_random_node)
         r.add_get("/api/timeline", timeline)
         r.add_get("/metrics", prom_metrics)
         r.add_post("/api/jobs/", submit_job)

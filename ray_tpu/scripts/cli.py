@@ -178,6 +178,25 @@ def cmd_serve(args) -> int:
     return 1
 
 
+def cmd_kill_random_node(args) -> int:
+    """Chaos helper (reference: `ray kill-random-node`,
+    scripts.py:1378). Targets a LIVE cluster via --address (a fresh
+    local runtime would only ever contain its own head node)."""
+    if not args.address:
+        print("kill-random-node needs --address of a running "
+              "cluster's dashboard (a throwaway local runtime has "
+              "only a head node)", file=sys.stderr)
+        return 2
+    req = urllib.request.Request(
+        args.address.rstrip("/") + "/api/kill_random_node",
+        method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read().decode())
+    killed = out.get("killed")
+    print(f"killed: {killed}" if killed else "no killable node")
+    return 0 if killed else 1
+
+
 def cmd_memory(args) -> int:
     if args.address:
         _print(_fetch(args.address, "/api/summary/objects"))
@@ -259,6 +278,10 @@ def build_parser() -> argparse.ArgumentParser:
     tp.set_defaults(fn=cmd_timeline)
 
     sub.add_parser("memory").set_defaults(fn=cmd_memory)
+
+    kn = sub.add_parser("kill-random-node",
+                        help="chaos: remove a random non-head node")
+    kn.set_defaults(fn=cmd_kill_random_node)
 
     lg = sub.add_parser("logs",
                         help="list/print session log files")

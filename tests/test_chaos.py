@@ -1,0 +1,111 @@
+"""Chaos / fault-injection tests (reference coverage model:
+release/nightly_tests chaos_test + python/ray/tests/chaos/ —
+workloads complete despite random component kills)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+class TestNodeKiller:
+    def test_workload_survives_node_kills(self, ray_start_cluster):
+        """Tasks scheduled onto killed nodes retry elsewhere; the
+        workload still completes correctly."""
+        from ray_tpu._private.fault_injection import NodeKiller
+
+        cluster = ray_start_cluster
+        cluster.add_node(num_cpus=2)
+        extra = [cluster.add_node(num_cpus=2) for _ in range(3)]
+
+        @ray_tpu.remote(max_retries=5)
+        def slow_square(x):
+            time.sleep(0.05)
+            return x * x
+
+        killer = NodeKiller(interval_s=0.15, max_kills=2, seed=0)
+        killer.start()
+        try:
+            refs = [slow_square.remote(i) for i in range(60)]
+            out = ray_tpu.get(refs, timeout=120)
+        finally:
+            killer.stop()
+        assert out == [i * i for i in range(60)]
+        assert len(killer.killed) >= 1  # chaos actually happened
+        assert all(k in [n for n in killer.killed] for k in killer.killed)
+
+    def test_kill_random_node_spares_head(self, ray_start_cluster):
+        from ray_tpu._private.fault_injection import kill_random_node
+
+        cluster = ray_start_cluster
+        head = cluster.add_node(num_cpus=1)
+        cluster.add_node(num_cpus=1)
+        killed = kill_random_node(exclude_head=True)
+        assert killed is not None and killed != head
+
+    def test_kill_random_node_none_left(self, ray_start_cluster):
+        from ray_tpu._private.fault_injection import kill_random_node
+
+        cluster = ray_start_cluster
+        cluster.add_node(num_cpus=1)  # head only
+        assert kill_random_node(exclude_head=True) is None
+
+
+class TestWorkerKiller:
+    def test_tasks_survive_worker_crashes(self):
+        """Killed worker processes respawn; retriable tasks complete."""
+        from ray_tpu._private.fault_injection import WorkerKiller
+        from ray_tpu.core.task import NodeAffinitySchedulingStrategy
+
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=2, num_tpus=0, num_worker_procs=2)
+        try:
+            @ray_tpu.remote(max_retries=5)
+            def work(x):
+                time.sleep(0.05)
+                return x + 1
+
+            strategy = NodeAffinitySchedulingStrategy(
+                node_id="node-procs", soft=False)
+            killer = WorkerKiller(interval_s=0.2, max_kills=1, seed=1)
+            killer.start()
+            try:
+                refs = [work.options(
+                    scheduling_strategy=strategy).remote(i)
+                    for i in range(30)]
+                out = ray_tpu.get(refs, timeout=180)
+            finally:
+                killer.stop()
+            assert out == [i + 1 for i in range(30)]
+        finally:
+            ray_tpu.shutdown()
+
+
+class TestKillRandomNodeEndpoint:
+    def test_dashboard_endpoint_and_cli(self, ray_start_cluster, capsys):
+        import json
+        import urllib.request
+
+        from ray_tpu.dashboard.server import DashboardServer
+        from ray_tpu.scripts.cli import main
+
+        cluster = ray_start_cluster
+        cluster.add_node(num_cpus=1)
+        n2 = cluster.add_node(num_cpus=1)
+        dash = DashboardServer(port=0).start()
+        try:
+            addr = dash.address
+            assert main(["--address", addr, "kill-random-node"]) == 0
+            out = capsys.readouterr().out
+            assert f"killed: {n2}" in out
+            # Nothing left to kill → exit 1.
+            assert main(["--address", addr, "kill-random-node"]) == 1
+        finally:
+            dash.stop()
+
+    def test_cli_requires_address(self, capsys):
+        from ray_tpu.scripts.cli import main
+
+        assert main(["kill-random-node"]) == 2
