@@ -158,6 +158,13 @@ class ShmStore:
 
     # -- mutable channel objects -----------------------------------------
     def channel_create(self, object_id: bytes, max_size: int) -> None:
+        # ValueError, not assert: this guards a native OUT-OF-BOUNDS
+        # read and must survive python -O.
+        if len(object_id) != ID_LEN:
+            raise ValueError(
+                f"channel id must be {ID_LEN} bytes "
+                f"(got {len(object_id)}: a short id makes the native "
+                "side hash past the buffer)")
         off = ctypes.c_uint64()
         rc = lib().rts_ch_create(self._h(), object_id, max_size,
                                  ctypes.byref(off))
@@ -167,6 +174,8 @@ class ShmStore:
             raise ShmStoreError(f"channel create failed rc={rc}")
 
     def channel_write(self, object_id: bytes, data: bytes) -> None:
+        if len(object_id) != ID_LEN:
+            raise ValueError(f"channel id must be {ID_LEN} bytes")
         off = ctypes.c_uint64()
         rc = lib().rts_ch_write_acquire(
             self._h(), object_id, len(data), ctypes.byref(off))

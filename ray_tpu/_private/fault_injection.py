@@ -24,11 +24,9 @@ class NodeKiller:
     self-inflicted failure mode."""
 
     def __init__(self, *, interval_s: float = 1.0,
-                 max_kills: int = 3, seed: Optional[int] = None,
-                 respawn: bool = False):
+                 max_kills: int = 3, seed: Optional[int] = None):
         self.interval_s = interval_s
         self.max_kills = max_kills
-        self.respawn = respawn
         self._rng = random.Random(seed)
         self.killed: List[str] = []
         self._stop = threading.Event()
@@ -54,7 +52,7 @@ class NodeKiller:
                 return
             rt = global_runtime_or_none()
             if rt is None:
-                return
+                continue  # runtime not up yet — keep polling
             victims = [n for n in rt.scheduler.nodes()
                        if n.node_id != rt.head_node_id and n.alive]
             if not victims:
@@ -125,7 +123,7 @@ class WorkerKiller:
                 return
             rt = global_runtime_or_none()
             if rt is None or rt.worker_pool is None:
-                return
+                continue  # runtime/pool not up yet — keep polling
             with rt.worker_pool._lock:
                 workers = [w for w in rt.worker_pool._all.values()
                            if w.proc.poll() is None]

@@ -105,7 +105,14 @@ def _pack_error(exc: BaseException):
     return ("ser", data.to_bytes())
 
 
+# The worker's shm attachment, for components that need the store
+# outside the task path (compiled-DAG channels resolve through here —
+# a worker process has no global Runtime).
+WORKER_SHM = None
+
+
 def main() -> None:
+    global WORKER_SHM
     ap = argparse.ArgumentParser()
     ap.add_argument("--socket", required=True)
     ap.add_argument("--worker-id", type=int, required=True)
@@ -116,6 +123,12 @@ def main() -> None:
     from ray_tpu.core.worker_proc import recv_msg, send_msg
 
     sock, shm = _setup(args)
+    WORKER_SHM = shm
+    # Run as `python -m ...` this module is `__main__`; consumers import
+    # the canonical name — publish the attachment there too.
+    import ray_tpu.core.worker_main as _canonical
+
+    _canonical.WORKER_SHM = shm
     send_msg(sock, {"type": "hello", "worker_id": args.worker_id,
                     "pid": os.getpid()})
 
@@ -161,7 +174,13 @@ def main() -> None:
                 if inst is None:
                     raise RuntimeError(
                         f"actor {msg['actor_id'].hex()} not in this worker")
-                method = getattr(inst, msg["method"])
+                if msg["method"] == "__ray_tpu_apply__":
+                    # Injected-callable execution (compiled-DAG pinned
+                    # loops; mirrors ActorState._bind_method).
+                    def method(fn, *a, _inst=inst, **kw):
+                        return fn(_inst, *a, **kw)
+                else:
+                    method = getattr(inst, msg["method"])
                 call_args, call_kwargs = _unpack_args(
                     msg["args"], msg["kwargs"], shm)
                 with _runtime_env(msg.get("runtime_env")):

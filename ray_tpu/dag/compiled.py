@@ -42,9 +42,27 @@ class _ProcQueue:
 ChannelSpec = Tuple[str, Any]  # ("proc", key) | ("shm", id_bytes)
 
 
+def _resolve_shm():
+    """This process's shm attachment: the driver's runtime store, or —
+    inside a spawned worker process — worker_main's attachment."""
+    from ..core.runtime import global_runtime_or_none
+
+    rt = global_runtime_or_none()
+    if rt is not None and rt.shm is not None:
+        return rt.shm
+    from ..core import worker_main
+
+    return worker_main.WORKER_SHM
+
+
 def _make_spec(use_shm: bool) -> ChannelSpec:
     if use_shm:
-        return ("shm", b"dagch" + uuid.uuid4().bytes[:23])
+        # EXACTLY 28 bytes (ID_LEN): uuid4().bytes is 16 bytes — a
+        # short id makes the C side hash garbage past the buffer,
+        # which differs per process and breaks cross-process lookup.
+        cid = b"dagch" + uuid.uuid4().bytes + uuid.uuid4().bytes[:7]
+        assert len(cid) == 28
+        return ("shm", cid)
     key = uuid.uuid4().hex
     with _PROC_LOCK:
         _PROC_CHANNELS[key] = _ProcQueue()
@@ -61,9 +79,7 @@ class Channel:
         self._version = -1
         kind, key = spec
         if kind == "shm":
-            from ..core.runtime import global_runtime
-
-            self._store = global_runtime().shm
+            self._store = _resolve_shm()
             if self._store is None:
                 raise RuntimeError("shm plane not available")
             if create:
@@ -234,7 +250,7 @@ class CompiledDAG:
         self._loop_refs = []
         for n in self._nodes:
             handle = n._resolve_handle()
-            self._require_in_process(rt, handle)
+            self._check_placement(rt, handle, use_shm)
             in_specs = []
             arg_plan = []
             for a in n._bound_args:
@@ -263,16 +279,17 @@ class CompiledDAG:
             ray_tpu.get(ready[0])  # raises the loop's error
 
     @staticmethod
-    def _require_in_process(rt, handle) -> None:
-        """Compiled loops run via the in-process injected-callable path;
-        proc-pool actors would fail opaquely — reject them up front."""
-        if rt is None:
+    def _check_placement(rt, handle, use_shm: bool) -> None:
+        """Proc-pool actors join compiled DAGs through shared-memory
+        channels; without the shm plane the in-process queue fallback
+        cannot cross process boundaries."""
+        if rt is None or use_shm:
             return
         st = rt._actors.get(handle._actor_id)
         if st is not None and type(st).__name__.startswith("Proc"):
-            raise NotImplementedError(
-                "compiled DAGs over process-pool actors are not "
-                "supported yet; create the actor without the proc pool")
+            raise RuntimeError(
+                "compiled DAGs over process-pool actors need the native "
+                "shm store (build src/ and enable the shm plane)")
 
     # -- execution ------------------------------------------------------
     def execute(self, value: Any) -> Any:

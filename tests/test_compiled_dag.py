@@ -229,3 +229,42 @@ def test_error_propagates_through_multi_stage(actors):
             cdag.execute(10)
     finally:
         cdag.teardown()
+
+
+def test_compiled_dag_over_worker_processes():
+    """The cross-process path — pinned loops in SPAWNED WORKER
+    PROCESSES exchanging frames through shm channels (no GIL sharing,
+    no task round-trips; the deployment shape where compiling pays)."""
+    from ray_tpu.core.task import NodeAffinitySchedulingStrategy
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0, num_worker_procs=2)
+    try:
+        from ray_tpu.core.runtime import global_runtime
+
+        if global_runtime().shm is None:
+            pytest.skip("native shm store not built")
+
+        strategy = NodeAffinitySchedulingStrategy(
+            node_id="node-procs", soft=False)
+
+        @ray_tpu.remote(scheduling_strategy=strategy)
+        class Stage:
+            def __init__(self, mul):
+                self.mul = mul
+
+            def apply(self, x):
+                return x * self.mul
+
+        s1 = Stage.remote(3)
+        s2 = Stage.remote(7)
+        with InputNode() as inp:
+            dag = s2.apply.bind(s1.apply.bind(inp))
+        cdag = dag.experimental_compile(timeout=30)
+        try:
+            for i in range(20):
+                assert cdag.execute(i) == i * 21
+        finally:
+            cdag.teardown()
+    finally:
+        ray_tpu.shutdown()
