@@ -75,6 +75,8 @@ class GenRequest:
     stream: "queue.Queue" = field(default_factory=queue.Queue)
     tokens: List[int] = field(default_factory=list)
     error: Optional[str] = None
+    # Set once the terminal None has been consumed (engine-internal).
+    _done: bool = field(default=False, repr=False)
 
     @property
     def ttft_s(self) -> float:
@@ -88,25 +90,33 @@ class GenRequest:
         while True:
             tok = self.stream.get()
             if tok is None:
+                self._done = True  # result() must not block after this
                 if self.error is not None:
                     raise RuntimeError(f"generation failed: {self.error}")
                 return
             yield tok
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
+        """ALL generated tokens, regardless of how many were already
+        consumed via streaming — idempotent and safe after __iter__."""
+        if self._done:
+            if self.error is not None:
+                raise RuntimeError(f"generation failed: {self.error}")
+            return list(self.tokens)
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
-        out = []
         while True:
             left = (max(0.0, deadline - time.monotonic())
                     if deadline is not None else None)
             tok = self.stream.get(timeout=left)
             if tok is None:
+                self._done = True
                 if self.error is not None:
                     raise RuntimeError(
                         f"generation failed: {self.error}")
-                return out
-            out.append(tok)
+                # self.tokens has every emitted token (the engine
+                # appends there before the stream put).
+                return list(self.tokens)
 
 
 class _Slot:
@@ -208,8 +218,12 @@ class LLMEngine:
             idx = free[0]
             plen = len(req.prompt)
             bucket = self._bucket_for(plen)
-            padded = jnp.zeros((1, bucket), jnp.int32).at[0, :plen].set(
-                jnp.asarray(req.prompt, jnp.int32))
+            # Pad on the HOST: an eager .at[:plen].set() compiles a
+            # scatter kernel per distinct prompt length (seconds each),
+            # wrecking admission latency; numpy + one transfer doesn't.
+            buf = np.zeros((1, bucket), np.int32)
+            buf[0, :plen] = np.asarray(req.prompt, np.int32)
+            padded = jnp.asarray(buf)
             try:
                 self.cache, logits = prefill(
                     self.cfg, self.params, self.cache, padded,

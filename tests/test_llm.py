@@ -166,3 +166,37 @@ def test_llm_serve_deployment(ray_start):
         assert out["ttft_s"] >= 0
     finally:
         serve.shutdown()
+
+
+def test_result_is_idempotent(tiny_model):
+    """Review-of-use finding: a second result() call must return the
+    cached tokens, not block forever on the drained stream."""
+    cfg, params = tiny_model
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(cfg, params, num_slots=2, max_seq_len=64)
+    eng.start()
+    try:
+        req = eng.submit(list(range(1, 9)), max_new_tokens=6)
+        first = req.result(timeout=60)
+        second = req.result(timeout=1)  # must not block
+        assert first == second and len(first) == 6
+    finally:
+        eng.stop()
+
+
+def test_result_after_streaming_iteration(tiny_model):
+    """result() after consuming via __iter__ returns all tokens
+    instead of blocking on the drained stream."""
+    cfg, params = tiny_model
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(cfg, params, num_slots=2, max_seq_len=64)
+    eng.start()
+    try:
+        req = eng.submit(list(range(1, 9)), max_new_tokens=5)
+        streamed = list(req)          # __iter__ drains the stream
+        assert len(streamed) == 5
+        assert req.result(timeout=1) == streamed  # no block, full list
+    finally:
+        eng.stop()
