@@ -66,18 +66,52 @@ class DataIterator:
                      prefetch_batches: int = 1,
                      device_put: bool = False,
                      sharding: Optional[Any] = None,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None,
                      drop_last: bool = False) -> Iterator[Any]:
         """Re-batch blocks to `batch_size` rows (-1 = the DataContext
         default). With device_put=True, batches are staged into device
-        memory `prefetch_batches` ahead."""
+        memory `prefetch_batches` ahead. local_shuffle_buffer_size
+        enables windowed row shuffling (reference:
+        iterator.py iter_batches local_shuffle_buffer_size — randomize
+        training ingest without a full-dataset shuffle)."""
         if batch_size == -1:
             from .context import DataContext
 
             batch_size = DataContext.get_current().default_batch_size
+
+        def blocks_maybe_shuffled():
+            if not local_shuffle_buffer_size:
+                yield from self._blocks()
+                return
+            # Sliding-buffer shuffle (reference semantics): keep
+            # buffer_size rows resident; overflow rows are emitted in a
+            # random order while the RETAINED rows are also randomly
+            # chosen — so held-back rows mix with later arrivals across
+            # window boundaries (not a disjoint-partition shuffle).
+            rng = np.random.RandomState(local_shuffle_seed)
+            buf: List = []
+            rows = 0
+            for block in self._blocks():
+                if block.num_rows == 0:
+                    continue
+                buf.append(block)
+                rows += block.num_rows
+                if rows > local_shuffle_buffer_size:
+                    merged = concat_blocks(buf)
+                    perm = rng.permutation(merged.num_rows)
+                    emit = merged.num_rows - local_shuffle_buffer_size
+                    yield merged.take(perm[:emit])
+                    buf = [merged.take(perm[emit:])]
+                    rows = local_shuffle_buffer_size
+            if buf:
+                merged = concat_blocks(buf)
+                yield merged.take(rng.permutation(merged.num_rows))
+
         def host_batches():
             carry: List = []
             carry_rows = 0
-            for block in self._blocks():
+            for block in blocks_maybe_shuffled():
                 if block.num_rows == 0:
                     continue
                 if batch_size is None:

@@ -87,6 +87,13 @@ class GenRequest:
         return self.finish_ts - self.submit_ts
 
     def __iter__(self) -> Iterator[int]:
+        if self._done:
+            # Replay: the stream was already drained (by result() or a
+            # prior iteration) — blocking on it again would hang.
+            if self.error is not None:
+                raise RuntimeError(f"generation failed: {self.error}")
+            yield from list(self.tokens)
+            return
         while True:
             tok = self.stream.get()
             if tok is None:

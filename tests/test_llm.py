@@ -200,3 +200,19 @@ def test_result_after_streaming_iteration(tiny_model):
         assert req.result(timeout=1) == streamed  # no block, full list
     finally:
         eng.stop()
+
+
+def test_iteration_replay_after_drain(tiny_model):
+    """A second iteration (or iteration after result()) replays the
+    cached tokens instead of blocking on the drained stream."""
+    cfg, params = tiny_model
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(cfg, params, num_slots=2, max_seq_len=64)
+    eng.start()
+    try:
+        req = eng.submit(list(range(1, 9)), max_new_tokens=4)
+        toks = req.result(timeout=60)
+        assert list(req) == toks  # does not hang, replays
+    finally:
+        eng.stop()
