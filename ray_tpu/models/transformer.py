@@ -46,6 +46,9 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32   # master weights
     tie_embeddings: bool = True
     remat: bool = True
+    # None = full per-layer remat; "dots" = save matmul outputs and
+    # recompute only elementwise ops (less recompute, more HBM).
+    remat_policy: Optional[str] = None
     # attention: "auto" = pallas flash on TPU / XLA-fused reference on CPU;
     # "reference" forces the einsum path. seq_parallel picks the sequence-
     # parallel strategy when the mesh has an sp axis > 1 (ops/ kernels).
@@ -353,7 +356,15 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
 
     layer = partial(_layer, cfg)
     if cfg.remat:
-        layer = jax.checkpoint(layer)
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy is None:
+            policy = None
+        else:
+            raise ValueError(
+                f"remat_policy must be None or 'dots', got "
+                f"{cfg.remat_policy!r}")
+        layer = jax.checkpoint(layer, policy=policy)
     (x, _, _), aux = lax.scan(layer, (x, sin, cos), params["layers"])
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

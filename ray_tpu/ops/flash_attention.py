@@ -424,12 +424,16 @@ def _expand_kv(x: jax.Array, n_heads: int) -> jax.Array:
     return jnp.repeat(x, n_heads // kvh, axis=1)
 
 
+_XLA_CROSSOVER_SKV = 2048
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 256, block_k: int = 512,
                     q_offset=0, kv_offset=0,
                     interpret: Optional[bool] = None,
-                    force_reference: bool = False) -> jax.Array:
+                    force_reference: bool = False,
+                    force_pallas: bool = False) -> jax.Array:
     """Fused multi-head attention.
 
     q: (B, Sq, H, D); k, v: (B, Skv, KVH, D) with H % KVH == 0 (GQA).
@@ -453,6 +457,14 @@ def flash_attention(q, k, v, *, causal: bool = True,
     # Tiling floor: tiny/ragged shapes route to the fused-by-XLA reference.
     use_pallas = (not force_reference and bq >= 8 and bk >= 8
                   and D % 8 == 0)
+    # Crossover dispatch (measured on v5e): below ~2k kv positions XLA's
+    # own attention fusion beats the pallas kernels (the O(S^2) buffer is
+    # still cheap and XLA overlaps the surrounding matmuls better); the
+    # pallas path wins once the score matrix dominates HBM. interpret
+    # mode (CPU tests) always runs the kernels — that's its purpose.
+    if (use_pallas and not interpret and not force_pallas
+            and Skv < _XLA_CROSSOVER_SKV):
+        use_pallas = False
     # pallas interpret mode (CPU tests) can't run under shard_map's
     # varying-axes checks — those tests exercise the jnp reference.
     if interpret and jax.typeof(qt).vma:
@@ -470,4 +482,7 @@ def attention(q, k, v, *, causal: bool = True,
     if impl == "reference":
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                force_reference=True, **kw)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               force_pallas=True, **kw)
     return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale, **kw)

@@ -189,7 +189,7 @@ _ring.defvjp(lambda q, k, v, a, c, s, bq, bk, up:
 
 def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
                    sm_scale: Optional[float] = None,
-                   block_q: int = 128, block_k: int = 128) -> jax.Array:
+                   block_q: int = 256, block_k: int = 512) -> jax.Array:
     """Exact attention over a sequence sharded along `axis_name`.
 
     Call inside shard_map. q: (B, S_local, H, D); k, v: (B, S_local,

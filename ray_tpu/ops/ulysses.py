@@ -26,7 +26,7 @@ from .flash_attention import flash_attention
 
 def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True,
                       sm_scale: Optional[float] = None,
-                      block_q: int = 128, block_k: int = 128) -> jax.Array:
+                      block_q: int = 256, block_k: int = 512) -> jax.Array:
     """q: (B, S_local, H, D); k, v: (B, S_local, KVH, D), sharded on dim 1
     along `axis_name`. H and KVH must be divisible by the axis size.
     Returns (B, S_local, H, D)."""
