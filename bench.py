@@ -18,13 +18,100 @@ import sys
 import time
 
 
+def push_history(metric: str, value: float, unit: str, match: dict,
+                 extra: dict):
+    """Append a BENCH_HISTORY.json entry; return the most recent prior
+    value whose entry matches `match` (metric + the config fields that
+    make measurements comparable — comparing across configs would report
+    config changes as speedups)."""
+    hist_path = os.path.join(os.path.dirname(__file__),
+                             "BENCH_HISTORY.json")
+    history = []
+    if os.path.exists(hist_path):
+        try:
+            history = json.load(open(hist_path))
+        except Exception:  # noqa: BLE001
+            history = []
+    prev = next((h["value"] for h in reversed(history)
+                 if h.get("metric") == metric
+                 and all(h.get(k) == v for k, v in match.items())), None)
+    history.append({"metric": metric, "value": value, "unit": unit,
+                    "ts": time.time(), **match, **extra})
+    try:
+        json.dump(history, open(hist_path, "w"), indent=1)
+    except Exception:  # noqa: BLE001
+        pass
+    return prev
+
+
+def bench_serve(quick: bool) -> None:
+    """Serving north-star (BASELINE.md): req/s + p50 TTFT from the
+    continuous-batching engine. Prints one JSON line."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import configs
+    from ray_tpu.models.transformer import init_params
+    from ray_tpu.serve.llm import LLMEngine
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if quick or not on_tpu:
+        cfg, n_req, slots, metric = (
+            configs.tiny_test(), 8, 4, "tiny_serve_req_per_sec_smoke")
+        prompt_len, max_new, max_seq = 16, 16, 128
+    else:
+        cfg, n_req, slots, metric = (
+            configs.gpt2_125m(), 64, 16, "gpt2_125m_serve_req_per_sec")
+        prompt_len, max_new, max_seq = 128, 64, 1024
+
+    params = init_params(cfg, jax.random.key(0))
+    engine = LLMEngine(cfg, params, num_slots=slots, max_seq_len=max_seq)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len).tolist()
+               for _ in range(n_req)]
+
+    # Warm the compile caches (prefill bucket + decode tick) off-clock.
+    engine.start()
+    engine.submit(prompts[0], max_new_tokens=2).result()
+
+    t0 = time.perf_counter()
+    reqs = [engine.submit(p, max_new_tokens=max_new) for p in prompts]
+    for r in reqs:
+        r.result()
+    dt = time.perf_counter() - t0
+    engine.stop()
+
+    ttfts = sorted(r.ttft_s for r in reqs)
+    p50 = ttfts[len(ttfts) // 2]
+    req_s = n_req / dt
+    prev = push_history(
+        metric, req_s, "req/s",
+        match={"prompt_len": prompt_len, "max_new": max_new,
+               "slots": slots, "platform": jax.devices()[0].platform},
+        extra={"ttft_p50_s": p50})
+    print(json.dumps({
+        "metric": metric, "value": round(req_s, 2), "unit": "req/s",
+        "vs_baseline": round(req_s / prev, 3) if prev else 1.0,
+        "ttft_p50_ms": round(p50 * 1e3, 1),
+        "gen_tokens_per_sec": round(
+            sum(len(r.tokens) for r in reqs) / dt, 1),
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="tiny config + fewer steps (smoke test)")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=0, help="0 = auto")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving benchmark (req/s + TTFT) instead of "
+                         "the train step")
     args = ap.parse_args()
+
+    if args.serve:
+        bench_serve(args.quick)
+        return
 
     import jax
     import jax.numpy as jnp
@@ -86,31 +173,15 @@ def main() -> None:
     tokens_per_sec = batch * seq * seg / dt
     per_chip = tokens_per_sec / max(1, plan.num_devices)
 
-    # vs_baseline: ratio to the previous recorded measurement.
-    hist_path = os.path.join(os.path.dirname(__file__), "BENCH_HISTORY.json")
-    history = []
-    if os.path.exists(hist_path):
-        try:
-            history = json.load(open(hist_path))
-        except Exception:  # noqa: BLE001
-            history = []
-    # Compare only against entries timed the same way — mixing the old
-    # whole-run mean with best-of-segments would misattribute the
-    # methodology change as speedup.
-    method = "best-of-3-segments"
-    prev = next((h["value"] for h in reversed(history)
-                 if h.get("metric") == metric
-                 and h.get("method") == method), None)
+    # vs_baseline: ratio to the previous comparable measurement. "method"
+    # distinguishes best-of-segments timing from the older whole-run
+    # mean; batch/seq/platform are part of the config identity.
+    prev = push_history(
+        metric, per_chip, "tokens/s/chip",
+        match={"method": "best-of-3-segments", "batch": batch, "seq": seq,
+               "platform": devices[0].platform},
+        extra={"devices": n_dev})
     vs = (per_chip / prev) if prev else 1.0
-    history.append({
-        "metric": metric, "value": per_chip, "unit": "tokens/s/chip",
-        "ts": time.time(), "devices": n_dev, "method": method,
-        "platform": devices[0].platform, "batch": batch, "seq": seq,
-    })
-    try:
-        json.dump(history, open(hist_path, "w"), indent=1)
-    except Exception:  # noqa: BLE001
-        pass
 
     print(json.dumps({
         "metric": metric,

@@ -170,16 +170,9 @@ def _head_logits(cfg: TransformerConfig, params, x):
     return (x @ head).astype(jnp.float32)
 
 
-@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def prefill(cfg: TransformerConfig, params, cache: KVCache,
-            tokens: jax.Array, length: jax.Array, slot: jax.Array
-            ) -> Tuple[KVCache, jax.Array]:
-    """Run one padded prompt (1, S_bucket) through the model, write its
-    KV into `slot`, return last-real-token logits (V,).
-
-    `length` = real prompt length; `slot` = cache row. Compiles once per
-    (S_bucket,) — callers bucket prompt lengths.
-    """
+def _prefill_core(cfg: TransformerConfig, params, cache: KVCache,
+                  tokens: jax.Array, length: jax.Array, slot: jax.Array
+                  ) -> Tuple[KVCache, jax.Array]:
     S = tokens.shape[1]
     x = params["embed"].astype(cfg.dtype)[tokens]          # (1, S, D)
     sin, cos = rope_tables(cfg, S)
@@ -203,12 +196,34 @@ def prefill(cfg: TransformerConfig, params, cache: KVCache,
 
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def decode_step(cfg: TransformerConfig, params, cache: KVCache,
-                tokens: jax.Array) -> Tuple[KVCache, jax.Array]:
-    """One decode step for every slot. tokens: (B,) int32 (last emitted
-    token per slot). Returns (cache', logits (B, V)). Slots advance their
-    seq_lens by 1; inactive slots are advanced too — the host engine
-    simply ignores their output and reuses the slot via prefill."""
+def prefill(cfg: TransformerConfig, params, cache: KVCache,
+            tokens: jax.Array, length: jax.Array, slot: jax.Array
+            ) -> Tuple[KVCache, jax.Array]:
+    """Run one padded prompt (1, S_bucket) through the model, write its
+    KV into `slot`, return last-real-token logits (V,).
+
+    `length` = real prompt length; `slot` = cache row. Compiles once per
+    (S_bucket,) — callers bucket prompt lengths.
+    """
+    return _prefill_core(cfg, params, cache, tokens, length, slot)
+
+
+@partial(jax.jit, static_argnums=(0, 6), donate_argnums=(2,))
+def prefill_sample(cfg: TransformerConfig, params, cache: KVCache,
+                   tokens: jax.Array, length: jax.Array, slot: jax.Array,
+                   top_k: int, temperature: jax.Array, key: jax.Array
+                   ) -> Tuple[KVCache, jax.Array]:
+    """prefill + first-token sampling in ONE dispatch (halves the
+    admission round trips — TTFT is round-trip-bound on remote chips).
+    Returns (cache', token ())."""
+    cache, last = _prefill_core(cfg, params, cache, tokens, length, slot)
+    tok = sample(last[None], key, temperature=temperature[None],
+                 top_k=top_k)[0]
+    return cache, tok
+
+
+def _decode_core(cfg: TransformerConfig, params, cache: KVCache,
+                 tokens: jax.Array) -> Tuple[KVCache, jax.Array]:
     B = cache.num_slots
     positions = cache.seq_lens                              # (B,)
     x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]  # (B,1,D)
@@ -225,6 +240,42 @@ def decode_step(cfg: TransformerConfig, params, cache: KVCache,
 
     logits = _head_logits(cfg, params, x)[:, 0]             # (B, V)
     return KVCache(k=k_new, v=v_new, seq_lens=positions + 1), logits
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def decode_step(cfg: TransformerConfig, params, cache: KVCache,
+                tokens: jax.Array) -> Tuple[KVCache, jax.Array]:
+    """One decode step for every slot. tokens: (B,) int32 (last emitted
+    token per slot). Returns (cache', logits (B, V)). Slots advance their
+    seq_lens by 1; inactive slots are advanced too — the host engine
+    simply ignores their output and reuses the slot via prefill."""
+    return _decode_core(cfg, params, cache, tokens)
+
+
+@partial(jax.jit, static_argnums=(0, 5, 6), donate_argnums=(2,))
+def decode_multi(cfg: TransformerConfig, params, cache: KVCache,
+                 tokens: jax.Array, temps: jax.Array, num_steps: int,
+                 top_k: int, key: jax.Array
+                 ) -> Tuple[KVCache, jax.Array]:
+    """`num_steps` fused decode+sample ticks in ONE dispatch.
+
+    tokens: (B,) last emitted token per slot; temps: (B,) per-slot
+    temperature. Returns (cache', toks (num_steps, B)). The host engine
+    truncates per-slot output at eos/max_new_tokens — slots that finish
+    mid-block burn at most num_steps-1 wasted ticks, the price of
+    amortizing the host↔device round trip (which dominates decode on
+    tunneled/remote chips) over num_steps tokens.
+    """
+
+    def body(carry, sub):
+        cache, tok = carry
+        cache, logits = _decode_core(cfg, params, cache, tok)
+        tok = sample(logits, sub, temperature=temps, top_k=top_k)
+        return (cache, tok), tok
+
+    subs = jax.random.split(key, num_steps)
+    (cache, _), toks = lax.scan(body, (cache, tokens), subs)
+    return cache, toks
 
 
 def sample(logits: jax.Array, key: jax.Array, *,

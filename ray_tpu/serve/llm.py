@@ -36,9 +36,10 @@ import numpy as np
 
 from ..models.generate import (
     KVCache,
+    decode_multi,
     decode_step,
     init_kv_cache,
-    prefill,
+    prefill_sample,
 )
 from ..models.transformer import TransformerConfig, init_params
 
@@ -142,15 +143,21 @@ class LLMEngine:
 
     def __init__(self, cfg: TransformerConfig, params: Any, *,
                  num_slots: int = 4, max_seq_len: Optional[int] = None,
-                 top_k: int = 0, seed: int = 0):
+                 top_k: int = 0, seed: int = 0, decode_block: int = 16):
         self.cfg = cfg
         self.params = params
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len or cfg.max_seq_len
         self.top_k = top_k
+        # Ticks fused per dispatch (decode_multi). >1 amortizes the
+        # host↔device round trip; slots finishing mid-block waste the
+        # remainder. Power of two keeps the compile-cache small.
+        self.decode_block = max(1, decode_block)
         self.cache: KVCache = init_kv_cache(cfg, num_slots, self.max_seq_len)
         self.cur_tokens = jnp.zeros((num_slots,), jnp.int32)
-        self._temps = np.zeros((num_slots,), np.float32)
+        # Device-resident per-slot temperatures: updated by scatter at
+        # admission, never re-uploaded per tick.
+        self._temps = jnp.zeros((num_slots,), jnp.float32)
         self._key = jax.random.key(seed)
         self.slots: List[Optional[_Slot]] = [None] * num_slots
         self.waiting: deque = deque()
@@ -215,12 +222,19 @@ class LLMEngine:
         self.slots[idx] = None
 
     def _admit(self) -> None:
-        """Prefill waiting requests into free slots."""
+        """Prefill waiting requests into free slots.
+
+        All admissions in this pass are dispatched back-to-back (async)
+        and their first tokens fetched with ONE host sync at the end —
+        on remote/tunneled chips each sync costs a full round trip, so
+        per-admission syncs would serialize RTTs.
+        """
+        admitted: List = []  # (idx, tok_dev)
         while True:
             with self.lock:
                 free = [i for i, s in enumerate(self.slots) if s is None]
                 if not free or not self.waiting:
-                    return
+                    break
                 req = self.waiting.popleft()
             idx = free[0]
             plen = len(req.prompt)
@@ -231,56 +245,89 @@ class LLMEngine:
             buf = np.zeros((1, bucket), np.int32)
             buf[0, :plen] = np.asarray(req.prompt, np.int32)
             padded = jnp.asarray(buf)
+            self._key, sub = jax.random.split(self._key)
             try:
-                self.cache, logits = prefill(
+                # prefill + first-token sample fused into one dispatch.
+                self.cache, tok_dev = prefill_sample(
                     self.cfg, self.params, self.cache, padded,
-                    jnp.int32(plen), jnp.int32(idx))
+                    jnp.int32(plen), jnp.int32(idx), self.top_k,
+                    jnp.float32(req.temperature), sub)
             except Exception:
                 # put it back so _fail_all can notify its client
                 with self.lock:
                     self.waiting.appendleft(req)
                 raise
-            self._key, sub = jax.random.split(self._key)
-            tok = int(_sample_batch(
-                logits[None], jnp.asarray([req.temperature], jnp.float32),
-                sub, self.top_k)[0])
-            req.first_token_ts = time.monotonic()
-            slot = _Slot(req, plen)
-            self.slots[idx] = slot
-            self._temps[idx] = req.temperature
-            self.cur_tokens = self.cur_tokens.at[idx].set(tok)
+            self.slots[idx] = _Slot(req, plen)
+            self._temps = self._temps.at[idx].set(req.temperature)
+            self.cur_tokens = self.cur_tokens.at[idx].set(tok_dev)
+            admitted.append((idx, tok_dev))
+        if not admitted:
+            return
+        host_toks = np.asarray(jnp.stack([t for _, t in admitted]))
+        now = time.monotonic()
+        for (idx, _), tok in zip(admitted, host_toks):
+            slot = self.slots[idx]
+            if slot is None:  # drained by a concurrent stop()
+                continue
+            tok = int(tok)
+            slot.req.first_token_ts = now
             self._emit(slot, tok)
-            if (tok == req.eos_token or slot.emitted >= req.max_new_tokens):
+            if (tok == slot.req.eos_token
+                    or slot.emitted >= slot.req.max_new_tokens):
                 self._finish(idx)
 
     def step(self) -> bool:
-        """One engine tick: admit, then one decode step for all slots.
-        Returns False when there is nothing to do."""
+        """One engine tick: admit, then one fused block of decode steps
+        for all slots. Returns False when there is nothing to do."""
         self._admit()
-        active = [i for i, s in enumerate(self.slots) if s is not None]
+        # Snapshot: a concurrent stop()/_fail_all may None-out entries
+        # under us; every later access goes through the snapshot or
+        # re-checks self.slots[i].
+        snap = list(self.slots)
+        active = [i for i, s in enumerate(snap) if s is not None]
         if not active:
             return False
 
-        self.cache, logits = decode_step(
-            self.cfg, self.params, self.cache, self.cur_tokens)
+        # Block size: capped by every active slot's cache headroom so no
+        # in-block write can run past max_seq_len. Powers of two only —
+        # each distinct size is its own XLA compile.
+        headroom = min(self.max_seq_len - 1 - snap[i].length
+                       for i in active)
+        k_block = min(self.decode_block, max(1, headroom))
+        while k_block & (k_block - 1):
+            k_block &= k_block - 1
+
         self._key, sub = jax.random.split(self._key)
-        toks = _sample_batch(
-            logits, jnp.asarray(self._temps), sub, self.top_k)
-        self.cur_tokens = toks
-        host_toks = np.asarray(toks)
-        self.decode_ticks += 1
+        if k_block == 1:
+            self.cache, logits = decode_step(
+                self.cfg, self.params, self.cache, self.cur_tokens)
+            toks = _sample_batch(logits, self._temps, sub, self.top_k)
+            self.cur_tokens = toks
+            host_toks = np.asarray(toks)[None]             # (1, B)
+        else:
+            self.cache, toks = decode_multi(
+                self.cfg, self.params, self.cache, self.cur_tokens,
+                self._temps, k_block, self.top_k, sub)
+            self.cur_tokens = toks[-1]
+            host_toks = np.asarray(toks)                   # (k, B)
+        self.decode_ticks += k_block
 
         for i in active:
             slot = self.slots[i]
-            if slot is None:  # drained by a concurrent stop()
-                continue
-            tok = int(host_toks[i])
-            self._emit(slot, tok)
-            done = (tok == slot.req.eos_token
-                    or slot.emitted >= slot.req.max_new_tokens
-                    or slot.length >= self.max_seq_len - 1)
-            if done:
-                self._finish(i)
+            for t in range(k_block):
+                if slot is None:  # drained by a concurrent stop()
+                    break
+                tok = int(host_toks[t, i])
+                self._emit(slot, tok)
+                done = (tok == slot.req.eos_token
+                        or slot.emitted >= slot.req.max_new_tokens
+                        or slot.length >= self.max_seq_len - 1)
+                if done:
+                    # Remaining in-block tokens for this slot are
+                    # discarded; the slot frees for readmission.
+                    self._finish(i)
+                    break
+                slot = self.slots[i]
         return True
 
     def run_forever(self) -> None:
