@@ -4,8 +4,11 @@ from .autoscaler import (
     MockProvider,
     Monitor,
     NodeProvider,
+    NodeTypeConfig,
     StandardAutoscaler,
 )
+from .cluster_config import ClusterConfig, ClusterLauncher, make_provider
 
-__all__ = ["AutoscalerConfig", "NodeProvider", "LocalNodeProvider",
-           "MockProvider", "StandardAutoscaler", "Monitor"]
+__all__ = ["AutoscalerConfig", "NodeTypeConfig", "NodeProvider",
+           "LocalNodeProvider", "MockProvider", "StandardAutoscaler",
+           "Monitor", "ClusterConfig", "ClusterLauncher", "make_provider"]

@@ -30,6 +30,20 @@ logger = logging.getLogger("ray_tpu")
 
 
 @dataclass
+class NodeTypeConfig:
+    """One launchable worker shape (reference: available_node_types in
+    the cluster YAML, autoscaler/ray-schema.json)."""
+
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 8
+    labels: Dict[str, str] = field(default_factory=dict)
+    # Provider-specific launch parameters (e.g. GCE TPU accelerator_type,
+    # runtime_version) — passed through to the provider opaquely.
+    node_config: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
 class AutoscalerConfig:
     min_workers: int = 0
     max_workers: int = 8
@@ -41,13 +55,17 @@ class AutoscalerConfig:
     # reference: upscaling_speed).
     upscaling_speed: float = 1.0
     worker_labels: Dict[str, str] = field(default_factory=dict)
+    # Multi-shape mode: when set, demand is packed per node type and
+    # worker_resources/worker_labels are ignored.
+    node_types: Dict[str, NodeTypeConfig] = field(default_factory=dict)
 
 
 class NodeProvider:
     """Provider ABC (reference: autoscaler/node_provider.py:13)."""
 
     def create_node(self, resources: Dict[str, float],
-                    labels: Dict[str, str]) -> str:
+                    labels: Dict[str, str],
+                    node_type: str = "") -> str:
         raise NotImplementedError
 
     def terminate_node(self, node_id: str) -> None:
@@ -55,6 +73,10 @@ class NodeProvider:
 
     def non_terminated_nodes(self) -> List[str]:
         raise NotImplementedError
+
+    def node_type_of(self, node_id: str) -> str:
+        """Node type a node was launched as ('' if untyped)."""
+        return ""
 
 
 class LocalNodeProvider(NodeProvider):
@@ -67,14 +89,16 @@ class LocalNodeProvider(NodeProvider):
 
         self._rt = runtime or _rt.global_runtime()
         self._nodes: List[str] = []
+        self._types: Dict[str, str] = {}
 
-    def create_node(self, resources, labels) -> str:
+    def create_node(self, resources, labels, node_type: str = "") -> str:
         node_id = f"as-worker-{uuid.uuid4().hex[:8]}"
         node = NodeState(node_id, ResourceSet(resources),
                          max_workers=max(1, int(resources.get("CPU", 1))))
         node.labels.update(labels)
         self._rt.scheduler.add_node(node)
         self._nodes.append(node_id)
+        self._types[node_id] = node_type
         return node_id
 
     def terminate_node(self, node_id: str) -> None:
@@ -84,6 +108,9 @@ class LocalNodeProvider(NodeProvider):
 
     def non_terminated_nodes(self) -> List[str]:
         return list(self._nodes)
+
+    def node_type_of(self, node_id: str) -> str:
+        return self._types.get(node_id, "")
 
 
 class MockProvider(NodeProvider):
@@ -95,10 +122,10 @@ class MockProvider(NodeProvider):
         self.terminated: List[str] = []
         self._alive: List[str] = []
 
-    def create_node(self, resources, labels) -> str:
+    def create_node(self, resources, labels, node_type: str = "") -> str:
         node_id = f"mock-{len(self.created)}"
         self.created.append({"node_id": node_id, "resources": resources,
-                             "labels": labels})
+                             "labels": labels, "node_type": node_type})
         self._alive.append(node_id)
         return node_id
 
@@ -109,6 +136,12 @@ class MockProvider(NodeProvider):
 
     def non_terminated_nodes(self) -> List[str]:
         return list(self._alive)
+
+    def node_type_of(self, node_id: str) -> str:
+        for c in self.created:
+            if c["node_id"] == node_id:
+                return c.get("node_type", "")
+        return ""
 
 
 class StandardAutoscaler:
@@ -130,29 +163,10 @@ class StandardAutoscaler:
         already exist (the reference packs onto existing nodes'
         available resources before asking for new ones) — otherwise a
         transiently-queued task next to an idle worker launches a node.
+        Hard affinity / PG demand can't be satisfied by arbitrary free
+        capacity — it always counts as unmet.
         """
-        sched = self._rt.scheduler
-        if hasattr(sched, "pending_demand_detailed"):
-            demand = sched.pending_demand_detailed()
-        else:
-            demand = [(r, False) for r in sched.pending_demand()]
-        if not demand:
-            return 0
-        free = [n.available for n in self._rt.scheduler.nodes()]
-        unmet = []
-        for req, constrained in sorted(
-                demand, key=lambda rc: -sum(rc[0].to_dict().values())):
-            if constrained:
-                # Hard affinity / PG demand can't be satisfied by
-                # arbitrary free capacity — always counts as unmet.
-                unmet.append(req)
-                continue
-            for i, f in enumerate(free):
-                if req.fits(f):
-                    free[i] = f.subtract(req)
-                    break
-            else:
-                unmet.append(req)
+        unmet = self._unmet_demand()
         cap = ResourceSet(self.config.worker_resources)
         nodes_needed = 0
         remaining = None
@@ -166,9 +180,114 @@ class StandardAutoscaler:
             remaining = cap.subtract(req)
         return nodes_needed
 
+    def _unmet_demand(self) -> List[ResourceSet]:
+        """Pending requests not coverable by existing free capacity."""
+        sched = self._rt.scheduler
+        if hasattr(sched, "pending_demand_detailed"):
+            demand = sched.pending_demand_detailed()
+        else:
+            demand = [(r, False) for r in sched.pending_demand()]
+        free = [n.available for n in sched.nodes()]
+        unmet = []
+        for req, constrained in sorted(
+                demand, key=lambda rc: -sum(rc[0].to_dict().values())):
+            if constrained:
+                unmet.append(req)
+                continue
+            for i, f in enumerate(free):
+                if req.fits(f):
+                    free[i] = f.subtract(req)
+                    break
+            else:
+                unmet.append(req)
+        return unmet
+
+    def _demand_by_type(self, alive_by_type: Dict[str, int]
+                        ) -> Dict[str, int]:
+        """Pack unmet demand into node types (smallest type that fits
+        each request first — reference: resource_demand_scheduler
+        get_nodes_for / _utilization_scorer). A type at its max_workers
+        stops opening bins; demand spills to the next-larger fitting
+        type rather than hanging."""
+        types = self.config.node_types
+        # Smallest-first so a CPU task doesn't claim a TPU host.
+        order = sorted(
+            types, key=lambda t: sum(types[t].resources.values()))
+        caps = {t: ResourceSet(types[t].resources) for t in types}
+        needed: Dict[str, int] = {t: 0 for t in types}
+        open_bins: List = []  # (type, remaining)
+        for req in self._unmet_demand():
+            placed = False
+            for i, (t, rem) in enumerate(open_bins):
+                if req.fits(rem):
+                    open_bins[i] = (t, rem.subtract(req))
+                    placed = True
+                    break
+            if placed:
+                continue
+            for t in order:
+                launchable = (types[t].max_workers
+                              - alive_by_type.get(t, 0) - needed[t])
+                if launchable > 0 and req.fits(caps[t]):
+                    needed[t] += 1
+                    open_bins.append((t, caps[t].subtract(req)))
+                    break
+        return needed
+
+    def _update_multi_type(self) -> Dict[str, int]:
+        """Reconciliation when node_types are configured: per-type
+        min/max + demand packing, global max_workers cap."""
+        types = self.config.node_types
+        alive = self.provider.non_terminated_nodes()
+        by_type: Dict[str, List[str]] = {t: [] for t in types}
+        for nid in alive:
+            t = self.provider.node_type_of(nid)
+            if t in by_type:
+                by_type[t].append(nid)
+        launched = terminated = 0
+        needed = self._demand_by_type(
+            {t: len(ids) for t, ids in by_type.items()})
+        total = len(alive)
+        for t, tc in types.items():
+            cur = len(by_type[t])
+            target = max(cur + needed.get(t, 0), tc.min_workers)
+            target = min(target, tc.max_workers)
+            while (cur < target and total < self.config.max_workers):
+                labels = dict(tc.labels)
+                labels.setdefault("node-type", t)
+                self.provider.create_node(dict(tc.resources), labels, t)
+                launched += 1
+                cur += 1
+                total += 1
+
+        # Scale down per type, respecting per-type min_workers.
+        now = time.monotonic()
+        demand = self._rt.scheduler.pending_demand()
+        by_id = {n.node_id: n for n in self._rt.scheduler.nodes()}
+        for t, tc in types.items():
+            n_alive = len(by_type[t])
+            term_t = 0
+            for node_id in by_type[t]:
+                node = by_id.get(node_id)
+                busy = node is not None and (
+                    node.total.to_dict() != node.available.to_dict())
+                if busy or demand:
+                    self._idle_since.pop(node_id, None)
+                    continue
+                since = self._idle_since.setdefault(node_id, now)
+                if (now - since >= self.config.idle_timeout_s
+                        and n_alive - term_t > tc.min_workers):
+                    self.provider.terminate_node(node_id)
+                    self._idle_since.pop(node_id, None)
+                    terminated += 1
+                    term_t += 1
+        return {"launched": launched, "terminated": terminated}
+
     def update(self) -> Dict[str, int]:
         """One reconciliation step; returns {'launched': n,
         'terminated': m}."""
+        if self.config.node_types:
+            return self._update_multi_type()
         alive = self.provider.non_terminated_nodes()
         launched = terminated = 0
 

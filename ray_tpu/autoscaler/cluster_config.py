@@ -1,0 +1,193 @@
+"""Cluster YAML config + up/down launcher.
+
+Capability-equivalent to the reference's `ray up/down cluster.yaml`
+(reference: scripts/scripts.py up :1276 / down :1352, schema
+autoscaler/ray-schema.json). TPU-first differences: the "head" is the
+process running `up` (TPU pods are reached from a controller host, not
+ssh'd into to become a head), and worker node types are TPU slices.
+
+YAML shape:
+
+    cluster_name: demo
+    max_workers: 8
+    idle_timeout_minutes: 5
+    provider:
+      type: gce_tpu            # gce_tpu | local | mock
+      project: my-project
+      zone: us-central2-b
+    auth:
+      ssh_user: root
+      ssh_private_key: ~/.ssh/id_rsa
+    available_node_types:
+      tpu_v5e_8:
+        resources: {TPU: 8, CPU: 96}
+        min_workers: 0
+        max_workers: 4
+        node_config:
+          accelerator_type: v5litepod-8
+          runtime_version: v2-alpha-tpuv5-lite
+    setup_commands:
+      - pip install -e .
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .autoscaler import (
+    AutoscalerConfig,
+    LocalNodeProvider,
+    MockProvider,
+    Monitor,
+    NodeProvider,
+    NodeTypeConfig,
+    StandardAutoscaler,
+)
+
+logger = logging.getLogger("ray_tpu")
+
+
+@dataclass
+class ClusterConfig:
+    cluster_name: str
+    provider: Dict[str, object]
+    max_workers: int = 8
+    idle_timeout_minutes: float = 5.0
+    auth: Dict[str, str] = field(default_factory=dict)
+    available_node_types: Dict[str, NodeTypeConfig] = field(
+        default_factory=dict)
+    setup_commands: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ClusterConfig":
+        import yaml  # lazy: PyYAML isn't a package dependency
+
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "ClusterConfig":
+        if "cluster_name" not in raw:
+            raise ValueError("cluster config needs cluster_name")
+        if "provider" not in raw or "type" not in raw["provider"]:
+            raise ValueError("cluster config needs provider.type")
+        types = {}
+        for name, spec in (raw.get("available_node_types") or {}).items():
+            if "resources" not in spec:
+                raise ValueError(f"node type {name!r} needs resources")
+            types[name] = NodeTypeConfig(
+                resources={k: float(v)
+                           for k, v in spec["resources"].items()},
+                min_workers=int(spec.get("min_workers", 0)),
+                max_workers=int(spec.get("max_workers",
+                                         raw.get("max_workers", 8))),
+                labels=dict(spec.get("labels", {})),
+                node_config=dict(spec.get("node_config", {})),
+            )
+        return cls(
+            cluster_name=raw["cluster_name"],
+            provider=dict(raw["provider"]),
+            max_workers=int(raw.get("max_workers", 8)),
+            idle_timeout_minutes=float(raw.get("idle_timeout_minutes", 5)),
+            auth=dict(raw.get("auth", {})),
+            available_node_types=types,
+            setup_commands=list(raw.get("setup_commands", [])),
+        )
+
+
+def make_provider(cfg: ClusterConfig, **overrides) -> NodeProvider:
+    ptype = cfg.provider["type"]
+    if ptype == "mock":
+        return MockProvider()
+    if ptype == "local":
+        return LocalNodeProvider()
+    if ptype == "gce_tpu":
+        from .providers import GceTpuNodeProvider
+
+        kw = {k: v for k, v in cfg.provider.items()
+              if k in ("accelerator_type", "runtime_version")}
+        kw.setdefault("node_configs", {
+            name: tc.node_config
+            for name, tc in cfg.available_node_types.items()})
+        kw.update(overrides)
+        return GceTpuNodeProvider(
+            project=str(cfg.provider["project"]),
+            zone=str(cfg.provider["zone"]),
+            cluster_name=cfg.cluster_name, **kw)
+    raise ValueError(f"unknown provider type {ptype!r}")
+
+
+class ClusterLauncher:
+    """`up` = ensure per-type min_workers and run the autoscaler monitor
+    in this process; `down` = terminate every provider node."""
+
+    def __init__(self, cfg: ClusterConfig,
+                 provider: Optional[NodeProvider] = None,
+                 runner_factory=None):
+        self.cfg = cfg
+        self.provider = provider or make_provider(cfg)
+        self.autoscaler: Optional[StandardAutoscaler] = None
+        self.monitor: Optional[Monitor] = None
+        # runner_factory(ip) -> object with .run(cmd); injectable so
+        # setup is testable without ssh targets.
+        self._runner_factory = runner_factory or self._default_runner
+
+    def _default_runner(self, ip: str):
+        from .providers import SSHCommandRunner
+
+        return SSHCommandRunner(
+            ip, user=self.cfg.auth.get("ssh_user", "root"),
+            key_path=self.cfg.auth.get("ssh_private_key"))
+
+    def _setup_node(self, node_id: str) -> bool:
+        """Wait for the node and run setup_commands over ssh (providers
+        without wait_ready/node_ip — mock/local — skip silently)."""
+        if not self.cfg.setup_commands:
+            return True
+        wait = getattr(self.provider, "wait_ready", None)
+        get_ip = getattr(self.provider, "node_ip", None)
+        if wait is None or get_ip is None:
+            return True
+        if not wait(node_id):
+            logger.warning("node %s never became ready", node_id)
+            return False
+        ip = get_ip(node_id)
+        if not ip:
+            logger.warning("node %s has no reachable IP", node_id)
+            return False
+        runner = self._runner_factory(ip)
+        for cmd in self.cfg.setup_commands:
+            runner.run(cmd)
+        return True
+
+    def up(self, *, start_monitor: bool = True,
+           monitor_interval_s: float = 5.0) -> Dict[str, int]:
+        as_cfg = AutoscalerConfig(
+            max_workers=self.cfg.max_workers,
+            idle_timeout_s=self.cfg.idle_timeout_minutes * 60.0,
+            node_types=dict(self.cfg.available_node_types),
+        )
+        self.autoscaler = StandardAutoscaler(as_cfg, self.provider)
+        result = self.autoscaler.update()  # satisfies min_workers floors
+        for node_id in self.provider.non_terminated_nodes():
+            self._setup_node(node_id)
+        if start_monitor:
+            self.monitor = Monitor(self.autoscaler,
+                                   interval_s=monitor_interval_s).start()
+        logger.info("cluster %s up: %s", self.cfg.cluster_name, result)
+        return result
+
+    def down(self) -> int:
+        if self.monitor is not None:
+            self.monitor.stop()
+            self.monitor = None
+        n = 0
+        for node_id in self.provider.non_terminated_nodes():
+            self.provider.terminate_node(node_id)
+            n += 1
+        logger.info("cluster %s down: terminated %d nodes",
+                    self.cfg.cluster_name, n)
+        return n

@@ -114,6 +114,15 @@ class DashboardServer:
                 raise web.HTTPNotFound()
             return _json({"stopped": stopped})
 
+        async def index(_):
+            import os
+
+            path = os.path.join(os.path.dirname(__file__), "index.html")
+            with open(path, encoding="utf-8") as f:
+                return web.Response(text=f.read(),
+                                    content_type="text/html")
+
+        r.add_get("/", index)
         r.add_get("/api/version", version)
         r.add_get("/healthz", healthz)
         r.add_get("/api/cluster_status", cluster_status)

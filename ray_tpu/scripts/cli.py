@@ -252,6 +252,39 @@ def cmd_job(args) -> int:
     return 2
 
 
+def cmd_up(args) -> int:
+    """reference: scripts/scripts.py up :1276."""
+    from ..autoscaler import ClusterConfig, ClusterLauncher
+
+    cfg = ClusterConfig.from_yaml(args.config_file)
+    launcher = ClusterLauncher(cfg)
+    result = launcher.up(start_monitor=not args.no_monitor)
+    print(f"cluster {cfg.cluster_name}: launched {result['launched']} "
+          f"node(s)")
+    if not args.no_monitor:
+        print("autoscaler monitor running; Ctrl-C to stop "
+              "(nodes keep running — use `ray-tpu down` to terminate)")
+        try:
+            import signal
+
+            signal.pause()
+        except (KeyboardInterrupt, AttributeError):
+            pass
+        launcher.monitor.stop()
+    return 0
+
+
+def cmd_down(args) -> int:
+    """reference: scripts/scripts.py down :1352."""
+    from ..autoscaler import ClusterConfig, ClusterLauncher
+
+    cfg = ClusterConfig.from_yaml(args.config_file)
+    launcher = ClusterLauncher(cfg)
+    n = launcher.down()
+    print(f"cluster {cfg.cluster_name}: terminated {n} node(s)")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ray-tpu", description="ray_tpu cluster CLI")
@@ -319,6 +352,17 @@ def build_parser() -> argparse.ArgumentParser:
         jc.add_argument("job_id")
         jc.set_defaults(fn=cmd_job)
     jsub.add_parser("list").set_defaults(fn=cmd_job)
+
+    up = sub.add_parser("up", help="launch a cluster from a YAML config")
+    up.add_argument("config_file")
+    up.add_argument("--no-monitor", action="store_true",
+                    help="launch min_workers only; don't run the "
+                         "autoscaler loop")
+    up.set_defaults(fn=cmd_up)
+    dn = sub.add_parser("down",
+                        help="terminate all nodes of a YAML cluster")
+    dn.add_argument("config_file")
+    dn.set_defaults(fn=cmd_down)
     return p
 
 

@@ -312,3 +312,37 @@ def test_no_scale_up_when_existing_capacity_covers_demand():
         runtime=FakeRt())
     out = asc.update()
     assert out["launched"] == 0
+
+
+def test_dashboard_index_page(dashboard, ray_start):
+    """The UI page is served at / and its JS only references API routes
+    and JSON fields the server actually provides (no browser/node on
+    this box — consistency is checked statically against live data)."""
+    import re
+    import urllib.request
+
+    with urllib.request.urlopen(dashboard.address + "/", timeout=5) as r:
+        html = r.read().decode()
+    assert r.status == 200
+    assert "ray_tpu" in html and "<script>" in html
+
+    # Every fetch target in the page must exist on the server.
+    for url in re.findall(r'j\("([^"]+)"\)', html):
+        full = dashboard.address + url
+        with urllib.request.urlopen(full, timeout=5) as resp:
+            assert resp.status == 200, url
+
+    # Fields the page reads must match what the API returns.
+    import json
+
+    def get(url):
+        with urllib.request.urlopen(dashboard.address + url,
+                                    timeout=5) as resp:
+            return json.load(resp)
+
+    node = get("/api/nodes?limit=1")[0]
+    for field in ("node_id", "alive", "resources_total", "labels",
+                  "is_head", "utilization"):
+        assert field in node, field
+    cs = get("/api/cluster_status")
+    assert "resources_total" in cs and "resources_available" in cs
