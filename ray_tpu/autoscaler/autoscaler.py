@@ -146,13 +146,24 @@ class MockProvider(NodeProvider):
 
 class StandardAutoscaler:
     def __init__(self, config: AutoscalerConfig, provider: NodeProvider,
-                 runtime=None):
+                 runtime=None, on_node_launched=None):
         from ..core import runtime as _rt
 
         self.config = config
         self.provider = provider
         self._rt = runtime or _rt.global_runtime()
         self._idle_since: Dict[str, float] = {}
+        # Called with each new node_id right after create_node — the
+        # cluster launcher hangs node provisioning (setup_commands)
+        # here so Monitor-launched nodes get set up too.
+        self._on_node_launched = on_node_launched
+
+    def _launched(self, node_id: str) -> None:
+        if self._on_node_launched is not None:
+            try:
+                self._on_node_launched(node_id)
+            except Exception:  # noqa: BLE001
+                logger.exception("node %s provisioning failed", node_id)
 
     # -- sizing ------------------------------------------------------------
     def _demand_nodes_needed(self) -> int:
@@ -255,7 +266,9 @@ class StandardAutoscaler:
             while (cur < target and total < self.config.max_workers):
                 labels = dict(tc.labels)
                 labels.setdefault("node-type", t)
-                self.provider.create_node(dict(tc.resources), labels, t)
+                nid = self.provider.create_node(dict(tc.resources),
+                                                labels, t)
+                self._launched(nid)
                 launched += 1
                 cur += 1
                 total += 1
@@ -299,8 +312,10 @@ class StandardAutoscaler:
                               * max(1, len(alive))))
         to_launch = min(target - len(alive), headroom)
         for _ in range(max(0, to_launch)):
-            self.provider.create_node(dict(self.config.worker_resources),
-                                      dict(self.config.worker_labels))
+            nid = self.provider.create_node(
+                dict(self.config.worker_resources),
+                dict(self.config.worker_labels))
+            self._launched(nid)
             launched += 1
 
         # Scale down: fully idle beyond the timeout, above min_workers.

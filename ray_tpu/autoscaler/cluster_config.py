@@ -170,10 +170,12 @@ class ClusterLauncher:
             idle_timeout_s=self.cfg.idle_timeout_minutes * 60.0,
             node_types=dict(self.cfg.available_node_types),
         )
-        self.autoscaler = StandardAutoscaler(as_cfg, self.provider)
+        # Provisioning rides the autoscaler's launch hook so nodes the
+        # Monitor adds later get setup_commands too, not just the
+        # min_workers launched here.
+        self.autoscaler = StandardAutoscaler(
+            as_cfg, self.provider, on_node_launched=self._setup_node)
         result = self.autoscaler.update()  # satisfies min_workers floors
-        for node_id in self.provider.non_terminated_nodes():
-            self._setup_node(node_id)
         if start_monitor:
             self.monitor = Monitor(self.autoscaler,
                                    interval_s=monitor_interval_s).start()

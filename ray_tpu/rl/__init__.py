@@ -8,6 +8,7 @@ and discrete SAC over a replay buffer.
 """
 
 from .algorithm import Algorithm
+from .appo import APPO, APPOConfig
 from .buffer import ReplayBuffer
 from .env import (
     ENV_REGISTRY,
@@ -23,6 +24,7 @@ from .env_runner import EnvRunner
 from .grpo import GRPO, GRPOConfig
 from .impala import IMPALA, IMPALAConfig
 from .module import MLPModuleSpec, QMLPSpec
+from .offline import BC, CQL, BCConfig, CQLConfig, OfflineDataset
 from .ppo import PPO, PPOConfig
 from .sac import SAC, SACConfig
 
@@ -31,4 +33,6 @@ __all__ = [
     "VectorEnv", "make_env", "register_env", "ENV_REGISTRY", "EnvRunner",
     "MLPModuleSpec", "QMLPSpec", "PPO", "PPOConfig", "GRPO", "GRPOConfig",
     "DQN", "DQNConfig", "SAC", "SACConfig", "IMPALA", "IMPALAConfig",
+    "APPO", "APPOConfig", "BC", "BCConfig", "CQL", "CQLConfig",
+    "OfflineDataset",
 ]

@@ -1,0 +1,191 @@
+"""APPO — asynchronous PPO (IMPALA pipeline + clipped surrogate).
+
+Capability-equivalent to the reference's APPO
+(reference: rllib/algorithms/appo/appo.py — IMPALA-style decoupled
+rollout/learner with the PPO clipped objective over V-trace-corrected
+advantages instead of the plain policy-gradient loss). TPU-first shape
+as in impala.py: the entire epoch loop (n_sgd_iters over the batch) is
+one jitted lax.scan — one device dispatch per arriving rollout.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .env import make_env
+from .impala import vtrace
+from .module import MLPModuleSpec
+
+
+@dataclass(frozen=True)
+class APPOConfig:
+    env: Any = "CartPole"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 8
+    rollout_length: int = 64
+    gamma: float = 0.99
+    clip_rho_threshold: float = 1.0
+    clip_c_threshold: float = 1.0
+    clip_param: float = 0.2            # PPO surrogate clip
+    num_sgd_iter: int = 2              # epochs over each async batch
+    value_coef: float = 0.5
+    entropy_coef: float = 0.01
+    lr: float = 5e-4
+    max_grad_norm: float = 40.0
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+    train_iterations: int = 20
+
+    def with_overrides(self, **kw) -> "APPOConfig":
+        return replace(self, **kw)
+
+
+def make_appo_update(spec: MLPModuleSpec, cfg: APPOConfig):
+    opt = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adam(cfg.lr))
+
+    def forward(params, batch):
+        T, K = batch["actions"].shape
+        logits, values = spec.apply(params, batch["obs"].reshape(T * K, -1))
+        logits = logits.reshape(T, K, -1)
+        values = values.reshape(T, K)
+        logp_all = jax.nn.log_softmax(logits, axis=-1)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        return logp_all, target_logp, values
+
+    def loss_fn(params, batch, vs, pg_adv):
+        logp_all, target_logp, values = forward(params, batch)
+        # PPO clipped surrogate against the BEHAVIOR policy's log-probs
+        # (the async lag the clip is guarding against).
+        ratio = jnp.exp(target_logp - batch["log_probs"])
+        clipped = jnp.clip(ratio, 1.0 - cfg.clip_param,
+                           1.0 + cfg.clip_param)
+        pi_loss = -jnp.mean(jnp.minimum(ratio * pg_adv,
+                                        clipped * pg_adv))
+        v_loss = 0.5 * jnp.mean((values - vs) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = (pi_loss + cfg.value_coef * v_loss
+                 - cfg.entropy_coef * entropy)
+        return total, {"pi_loss": pi_loss, "v_loss": v_loss,
+                       "entropy": entropy,
+                       "clip_frac": jnp.mean(
+                           (jnp.abs(ratio - 1.0)
+                            > cfg.clip_param).astype(jnp.float32))}
+
+    @jax.jit
+    def update(params, opt_state, batch):
+        # V-trace targets from the CURRENT policy, once per batch (as
+        # the reference does — targets are not recomputed per epoch).
+        _, target_logp, values = forward(params, batch)
+        _, bootstrap = spec.apply(params, batch["last_obs"])
+        vs, pg_adv = vtrace(
+            batch["log_probs"], target_logp, batch["rewards"], values,
+            batch["dones"], bootstrap, cfg.gamma,
+            cfg.clip_rho_threshold, cfg.clip_c_threshold)
+
+        def epoch(carry, _):
+            params, opt_state = carry
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch, vs, pg_adv)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), metrics
+
+        (params, opt_state), metrics = jax.lax.scan(
+            epoch, (params, opt_state), None, length=cfg.num_sgd_iter)
+        return params, opt_state, jax.tree.map(lambda m: m[-1], metrics)
+
+    return opt, update
+
+
+class APPO(Algorithm):
+    """Async PPO: same pipelined rollout futures as IMPALA, PPO clipped
+    objective on V-trace advantages."""
+
+    def setup(self):
+        import ray_tpu as ray
+
+        cfg: APPOConfig = self.config
+        probe = make_env(cfg.env)
+        self.spec = MLPModuleSpec(
+            observation_size=probe.observation_size,
+            num_actions=probe.num_actions, hidden=cfg.hidden)
+        self.params = self.spec.init(jax.random.key(cfg.seed))
+        self.opt, self._update = make_appo_update(self.spec, cfg)
+        self.opt_state = self.opt.init(self.params)
+
+        from .env_runner import EnvRunner
+        runner_cls = ray.remote(EnvRunner)
+        self.runners = [
+            runner_cls.remote(cfg.env, self.spec,
+                              num_envs=cfg.num_envs_per_runner,
+                              seed=cfg.seed + 1000 * (i + 1))
+            for i in range(cfg.num_env_runners)]
+        self._ray = ray
+        self._inflight: Dict[Any, Any] = {}
+        for r in self.runners:
+            self._submit(r)
+
+    def _submit(self, runner) -> None:
+        cfg = self.config
+        params_ref = self._ray.put(jax.device_get(self.params))
+        ref = runner.sample.remote(params_ref, cfg.rollout_length)
+        self._inflight[ref] = runner
+
+    def training_step(self) -> Dict[str, Any]:
+        ray = self._ray
+        t0 = time.perf_counter()
+        ready, _ = ray.wait(list(self._inflight), num_returns=1)
+        batch = ray.get(ready[0])
+        runner = self._inflight.pop(ready[0])
+        wait_s = time.perf_counter() - t0
+
+        jb = {k: jnp.asarray(batch[k]) for k in
+              ("obs", "actions", "log_probs", "rewards", "dones",
+               "last_obs")}
+        t1 = time.perf_counter()
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, jb)
+        train_s = time.perf_counter() - t1
+        self._submit(runner)
+
+        ep = batch["episode_returns"]
+        return {
+            "episode_return_mean": (
+                float(np.mean(ep)) if len(ep) else None),
+            "num_env_steps": batch["rewards"].size,
+            "wait_time_s": wait_s,
+            "train_time_s": train_s,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def get_state(self):
+        return {"iteration": self.iteration,
+                "params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state)}
+
+    def set_state(self, state):
+        self.iteration = state["iteration"]
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+    def compute_single_action(self, obs: np.ndarray) -> int:
+        from .module import greedy_actions
+        return int(greedy_actions(self.spec, self.params, obs[None])[0])
+
+    def stop(self):
+        import ray_tpu as ray
+
+        for r in self.runners:
+            ray.kill(r)

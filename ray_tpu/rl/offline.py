@@ -1,0 +1,346 @@
+"""Offline RL — learning from logged transitions, no environment.
+
+Capability-equivalent to the reference's offline-RL stack
+(reference: rllib/offline/ — dataset readers feeding algorithms like
+BC/CQL/MARWIL that train from recorded SampleBatches instead of live
+rollouts). TPU-first shape as elsewhere in rl/: the entire
+updates-per-iteration loop over pre-sampled minibatch indices is one
+jitted lax.scan — a single device dispatch per training_step.
+
+Data comes in as columns (obs, actions, rewards, next_obs, dones):
+from numpy dicts, from a ray_tpu.data Dataset of row-dicts, or recorded
+straight from an EnvRunner policy evaluation.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .env import make_env
+from .module import MLPModuleSpec, QMLPSpec
+
+_COLUMNS = ("obs", "actions", "rewards", "next_obs", "dones")
+
+
+class OfflineDataset:
+    """Column store of transitions with uniform minibatch sampling."""
+
+    def __init__(self, columns: Dict[str, np.ndarray], *,
+                 seed: Optional[int] = None):
+        missing = [c for c in ("obs", "actions") if c not in columns]
+        if missing:
+            raise ValueError(f"offline data needs columns {missing}")
+        n = len(columns["obs"])
+        for k, v in columns.items():
+            if len(v) != n:
+                raise ValueError(
+                    f"column {k!r} has {len(v)} rows, expected {n}")
+        self.columns = {k: np.asarray(v) for k, v in columns.items()}
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return len(self.columns["obs"])
+
+    @classmethod
+    def from_dataset(cls, ds, *, seed: Optional[int] = None
+                     ) -> "OfflineDataset":
+        """From a ray_tpu.data Dataset whose rows are transition dicts
+        (reference: rllib/offline dataset input via ray.data)."""
+        rows = ds.take_all()
+        if not rows:
+            raise ValueError("empty dataset")
+        cols = {k: np.asarray([r[k] for r in rows])
+                for k in rows[0] if k in _COLUMNS}
+        return cls(cols, seed=seed)
+
+    @classmethod
+    def from_env_rollouts(cls, env_name: Any, spec, params, *,
+                          num_steps: int = 1000, num_envs: int = 8,
+                          epsilon: Optional[float] = 0.05,
+                          seed: int = 0) -> "OfflineDataset":
+        """Record a behavior dataset by running a policy (the standard
+        way offline benchmarks build their corpora). epsilon: greedy
+        with that exploration rate; None samples from the policy's
+        scores as logits (much noisier data)."""
+        from .env_runner import EnvRunner
+
+        runner = EnvRunner(env_name, spec, num_envs=num_envs, seed=seed)
+        batch = runner.sample_transitions(params, num_steps,
+                                          epsilon=epsilon)
+        return cls({k: batch[k] for k in _COLUMNS if k in batch},
+                   seed=seed)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, len(self), size=batch_size)
+        return {k: v[idx] for k, v in self.columns.items()}
+
+    def sample_indices(self, n_batches: int,
+                       batch_size: int) -> np.ndarray:
+        return self._rng.integers(
+            0, len(self), size=(n_batches, batch_size))
+
+
+# ---------------------------------------------------------------------------
+# BC — behavior cloning
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class BCConfig:
+    env: Any = "CartPole"            # used only to size the model/eval
+    dataset: Optional[OfflineDataset] = None
+    lr: float = 1e-3
+    batch_size: int = 256
+    updates_per_iteration: int = 32
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+    train_iterations: int = 20
+    evaluate_episodes: int = 0       # >0: rollout eval each iteration
+
+    def with_overrides(self, **kw) -> "BCConfig":
+        return replace(self, **kw)
+
+
+class BC(Algorithm):
+    """Behavior cloning: max-likelihood on the dataset's actions
+    (reference: rllib/algorithms/bc/bc.py)."""
+
+    def setup(self):
+        cfg: BCConfig = self.config
+        if cfg.dataset is None:
+            raise ValueError("BCConfig.dataset is required")
+        probe = make_env(cfg.env)
+        self.spec = MLPModuleSpec(
+            observation_size=probe.observation_size,
+            num_actions=probe.num_actions, hidden=cfg.hidden)
+        self.params = self.spec.init(jax.random.key(cfg.seed))
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.dataset = cfg.dataset
+        spec, opt = self.spec, self.opt
+
+        def nll(params, mb):
+            logits, _ = spec.apply(params, mb["obs"])
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            chosen = jnp.take_along_axis(
+                logp, mb["actions"][:, None], axis=-1)[:, 0]
+            loss = -jnp.mean(chosen)
+            acc = jnp.mean((jnp.argmax(logits, axis=-1)
+                            == mb["actions"]).astype(jnp.float32))
+            return loss, {"bc_loss": loss, "action_accuracy": acc}
+
+        @jax.jit
+        def update(params, opt_state, batch, idx):
+            def one(carry, mb_idx):
+                params, opt_state = carry
+                mb = jax.tree.map(lambda x: x[mb_idx], batch)
+                (loss, metrics), grads = jax.value_and_grad(
+                    nll, has_aux=True)(params, mb)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), metrics
+
+            (params, opt_state), metrics = jax.lax.scan(
+                one, (params, opt_state), idx)
+            return params, opt_state, jax.tree.map(jnp.mean, metrics)
+
+        self._update = update
+        # The dataset is immutable — upload it to device ONCE, not per
+        # training_step (per-step re-upload of a large corpus would
+        # dominate the jitted update).
+        self._device_batch = {
+            "obs": jnp.asarray(self.dataset.columns["obs"]),
+            "actions": jnp.asarray(self.dataset.columns["actions"]),
+        }
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: BCConfig = self.config
+        t0 = time.perf_counter()
+        idx = jnp.asarray(self.dataset.sample_indices(
+            cfg.updates_per_iteration, cfg.batch_size))
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, self._device_batch, idx)
+        out = {k: float(v) for k, v in metrics.items()}
+        out["train_time_s"] = time.perf_counter() - t0
+        if cfg.evaluate_episodes > 0:
+            out["episode_return_mean"] = self.evaluate(
+                cfg.evaluate_episodes)
+        return out
+
+    def evaluate(self, episodes: int = 4) -> float:
+        from .module import greedy_actions
+
+        returns = []
+        env = make_env(self.config.env)
+        for ep in range(episodes):
+            obs = env.reset(seed=self.config.seed + 7000 + ep)
+            total, done = 0.0, False
+            for _ in range(1000):
+                a = int(greedy_actions(
+                    self.spec, self.params, np.asarray(obs)[None])[0])
+                obs, r, term, trunc = env.step(a)
+                total += r
+                if term or trunc:
+                    break
+            returns.append(total)
+        return float(np.mean(returns))
+
+    def compute_single_action(self, obs: np.ndarray) -> int:
+        from .module import greedy_actions
+        return int(greedy_actions(self.spec, self.params, obs[None])[0])
+
+    def get_state(self):
+        return {"iteration": self.iteration,
+                "params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state)}
+
+    def set_state(self, state):
+        self.iteration = state["iteration"]
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+
+# ---------------------------------------------------------------------------
+# CQL — conservative Q-learning (discrete)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CQLConfig:
+    env: Any = "CartPole"
+    dataset: Optional[OfflineDataset] = None
+    gamma: float = 0.99
+    lr: float = 1e-3
+    batch_size: int = 256
+    updates_per_iteration: int = 32
+    cql_alpha: float = 1.0           # conservatism weight
+    target_update_interval: int = 4  # iterations between target syncs
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+    train_iterations: int = 20
+    evaluate_episodes: int = 0
+
+    def with_overrides(self, **kw) -> "CQLConfig":
+        return replace(self, **kw)
+
+
+class CQL(Algorithm):
+    """Discrete CQL: double-DQN TD loss + the conservative regularizer
+    alpha * (logsumexp_a Q(s,a) - Q(s, a_data)), which pushes down
+    out-of-distribution action values (Kumar et al. 2020; reference:
+    rllib/algorithms/cql/cql.py, continuous SAC-based variant)."""
+
+    def setup(self):
+        cfg: CQLConfig = self.config
+        if cfg.dataset is None:
+            raise ValueError("CQLConfig.dataset is required")
+        for col in ("rewards", "next_obs", "dones"):
+            if col not in cfg.dataset.columns:
+                raise ValueError(f"CQL needs column {col!r}")
+        probe = make_env(cfg.env)
+        self.spec = QMLPSpec(
+            observation_size=probe.observation_size,
+            num_actions=probe.num_actions, hidden=cfg.hidden)
+        self.params = self.spec.init(jax.random.key(cfg.seed))
+        self.target_params = self.params
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.dataset = cfg.dataset
+        spec, opt = self.spec, self.opt
+
+        def loss_fn(params, target_params, mb):
+            q = spec.apply(params, mb["obs"])
+            qa = jnp.take_along_axis(
+                q, mb["actions"][:, None], axis=-1)[:, 0]
+            # Double-DQN target from the dataset's next states.
+            a_star = jnp.argmax(spec.apply(params, mb["next_obs"]),
+                                axis=-1)
+            q_next = jnp.take_along_axis(
+                spec.apply(target_params, mb["next_obs"]),
+                a_star[:, None], axis=-1)[:, 0]
+            y = mb["rewards"] + cfg.gamma * (1.0 - mb["dones"]) * \
+                jax.lax.stop_gradient(q_next)
+            err = qa - y
+            td = jnp.mean(jnp.where(jnp.abs(err) < 1.0,
+                                    0.5 * err ** 2, jnp.abs(err) - 0.5))
+            # Conservative term: minimize values of unseen actions
+            # relative to the logged ones.
+            cql = jnp.mean(jax.nn.logsumexp(q, axis=-1) - qa)
+            loss = td + cfg.cql_alpha * cql
+            return loss, {"td_loss": td, "cql_gap": cql,
+                          "q_data_mean": jnp.mean(qa)}
+
+        @jax.jit
+        def update(params, target_params, opt_state, batch, idx):
+            def one(carry, mb_idx):
+                params, opt_state = carry
+                mb = jax.tree.map(lambda x: x[mb_idx], batch)
+                (loss, metrics), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, target_params, mb)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), metrics
+
+            (params, opt_state), metrics = jax.lax.scan(
+                one, (params, opt_state), idx)
+            return params, opt_state, jax.tree.map(jnp.mean, metrics)
+
+        self._update = update
+        # Immutable dataset → one-time device upload (see BC.setup).
+        self._device_batch = {k: jnp.asarray(v)
+                              for k, v in self.dataset.columns.items()
+                              if k in _COLUMNS}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: CQLConfig = self.config
+        t0 = time.perf_counter()
+        idx = jnp.asarray(self.dataset.sample_indices(
+            cfg.updates_per_iteration, cfg.batch_size))
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.target_params, self.opt_state,
+            self._device_batch, idx)
+        if (self.iteration + 1) % cfg.target_update_interval == 0:
+            self.target_params = self.params
+        out = {k: float(v) for k, v in metrics.items()}
+        out["train_time_s"] = time.perf_counter() - t0
+        if cfg.evaluate_episodes > 0:
+            out["episode_return_mean"] = self.evaluate(
+                cfg.evaluate_episodes)
+        return out
+
+    def evaluate(self, episodes: int = 4) -> float:
+        returns = []
+        env = make_env(self.config.env)
+        for ep in range(episodes):
+            obs = env.reset(seed=self.config.seed + 7000 + ep)
+            total, done = 0.0, False
+            for _ in range(1000):
+                q = self.spec.apply(self.params, np.asarray(obs)[None])
+                obs, r, term, trunc = env.step(int(jnp.argmax(q[0])))
+                total += r
+                if term or trunc:
+                    break
+            returns.append(total)
+        return float(np.mean(returns))
+
+    def compute_single_action(self, obs: np.ndarray) -> int:
+        q = self.spec.apply(self.params, np.asarray(obs)[None])
+        return int(jnp.argmax(q[0]))
+
+    def get_state(self):
+        return {"iteration": self.iteration,
+                "params": jax.device_get(self.params),
+                "target_params": jax.device_get(self.target_params),
+                "opt_state": jax.device_get(self.opt_state)}
+
+    def set_state(self, state):
+        self.iteration = state["iteration"]
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = state["opt_state"]

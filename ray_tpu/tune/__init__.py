@@ -9,6 +9,8 @@ from .schedulers import (
 )
 from .search import (
     BasicVariantGenerator,
+    BOHBSearcher,
+    GPSearcher,
     Searcher,
     TPESearcher,
     choice,
@@ -24,7 +26,8 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
     "get_checkpoint",
     "uniform", "loguniform", "quniform", "randint", "choice", "grid_search",
-    "Searcher", "BasicVariantGenerator", "TPESearcher",
+    "Searcher", "BasicVariantGenerator", "TPESearcher", "GPSearcher",
+    "BOHBSearcher",
     "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule",
     "HyperBandScheduler", "PopulationBasedTraining", "TrialScheduler",
 ]

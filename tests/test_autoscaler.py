@@ -251,6 +251,8 @@ class _FakeTpuApi:
             self.nodes[node_id] = {
                 "name": f"projects/p/locations/z/nodes/{node_id}",
                 "state": "READY", "labels": body["labels"],
+                "acceleratorType": body.get("acceleratorType"),
+                "runtimeVersion": body.get("runtimeVersion"),
                 "networkEndpoints": [{"ipAddress": "10.0.0.5"}],
             }
             return 200, {"name": f"operations/create-{node_id}"}
@@ -388,9 +390,8 @@ def test_gce_provider_node_config_reaches_api(tmp_path):
     prov = make_provider(cfg, transport=api, token="fake-token")
     prov.create_node({"TPU": 4.0}, {}, "v4")
     created = list(api.nodes.values())[0]
-    # The fake stores the POST body's labels; re-check via the raw call
-    # log isn't kept, so assert through provider config instead:
-    assert prov.node_configs["v4"]["accelerator_type"] == "v4-8"
+    # The override must reach the actual API request body.
+    assert created["acceleratorType"] == "v4-8"
 
 
 def test_cluster_setup_commands_run(tmp_path):

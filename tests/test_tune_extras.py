@@ -245,3 +245,116 @@ def test_restore_preserves_prior_completed_in_state(ray_start, tmp_path):
     assert all(t["status"] == "completed" for t in state["trials"])
     xs = sorted(t["config"]["x"] for t in state["trials"])
     assert xs == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# GP Bayesian-opt searcher
+# ---------------------------------------------------------------------------
+
+def test_gp_beats_random_on_quadratic(ray_start, tmp_path):
+    import ray_tpu.tune as tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        tune.report({"loss": (config["x"] - 2.0) ** 2
+                     + (config["y"] + 1.0) ** 2})
+
+    space = {"x": tune.uniform(-10, 10), "y": tune.uniform(-10, 10)}
+    gp = tune.GPSearcher(space, metric="loss", mode="min",
+                         num_samples=30, n_initial=8, seed=0)
+    res = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    search_alg=gp,
+                                    max_concurrent_trials=1),
+        run_config=RunConfig(name="gp", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(res) == 30
+    best = res.get_best_result()
+    # 2-D quadratic over [-10,10]^2: 30 random samples average best
+    # ~3-6; the GP should land near the optimum.
+    assert best.metrics["loss"] < 1.0
+    xs = [(r.config["x"], r.config["y"])
+          for r in sorted(res, key=lambda r: r.trial_id)]
+    early = np.mean([abs(x - 2) + abs(y + 1) for x, y in xs[:8]])
+    late = np.mean([abs(x - 2) + abs(y + 1) for x, y in xs[-8:]])
+    assert late < early
+
+
+def test_gp_mixed_space_handles_categoricals(ray_start, tmp_path):
+    import ray_tpu.tune as tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        tune.report({"loss": (config["x"] - 1.0) ** 2
+                     + (0.0 if config["opt"] == "adam" else 4.0)})
+
+    space = {"x": tune.loguniform(1e-2, 1e2),
+             "opt": tune.choice(["sgd", "adam"])}
+    gp = tune.GPSearcher(space, metric="loss", num_samples=25,
+                         n_initial=6, seed=3)
+    res = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(metric="loss", search_alg=gp,
+                                    max_concurrent_trials=1),
+        run_config=RunConfig(name="gpm", storage_path=str(tmp_path)),
+    ).fit()
+    assert res.get_best_result().metrics["loss"] < 2.0
+
+
+# ---------------------------------------------------------------------------
+# BOHB searcher
+# ---------------------------------------------------------------------------
+
+def test_bohb_model_prefers_high_budget_observations():
+    """Unit: low-budget (early-stopped) results steer the model only
+    until enough high-budget results exist."""
+    import ray_tpu.tune as tune
+
+    space = {"x": tune.uniform(0, 10)}
+    bohb = tune.BOHBSearcher(space, metric="loss", num_samples=100,
+                             n_initial=2, min_points_in_model=3, seed=0)
+    # 6 low-budget results say x=9 is good; 3 high-budget say x=1.
+    for i, x in enumerate([9.0, 9.1, 9.2, 8.9, 9.3, 9.05]):
+        tid = f"lo{i}"
+        bohb._pending[tid] = {"x": x}
+        bohb.on_trial_complete(
+            tid, {"loss": abs(x - 9), "training_iteration": 1})
+    for i, x in enumerate([1.0, 1.1, 0.9]):
+        tid = f"hi{i}"
+        bohb._pending[tid] = {"x": x}
+        bohb.on_trial_complete(
+            tid, {"loss": abs(x - 1), "training_iteration": 9})
+    # Model must now be built from the 3 high-budget points only.
+    assert len(bohb._observed) == 3
+    assert all(c["x"] < 2.0 for _, c in bohb._observed)
+    samples = [bohb._tpe_config()["x"] for _ in range(20)]
+    assert np.median(samples) < 5.0
+
+
+def test_bohb_with_hyperband_end_to_end(ray_start, tmp_path):
+    import ray_tpu.tune as tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        # Converges toward its asymptote |x-3|^2 over 9 steps.
+        for step in range(9):
+            frac = (step + 1) / 9
+            tune.report({"loss": (config["x"] - 3.0) ** 2 * frac
+                         + (1 - frac) * 10.0,
+                         "training_iteration": step + 1})
+
+    space = {"x": tune.uniform(-10, 10)}
+    bohb = tune.BOHBSearcher(space, metric="loss", num_samples=20,
+                             n_initial=6, seed=0)
+    res = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", search_alg=bohb,
+            scheduler=tune.HyperBandScheduler(
+                metric="loss", mode="min", max_t=9),
+            max_concurrent_trials=2),
+        run_config=RunConfig(name="bohb", storage_path=str(tmp_path)),
+    ).fit()
+    best = res.get_best_result()
+    assert best.metrics["loss"] < 2.0
