@@ -134,6 +134,7 @@ class ClusterLauncher:
         # runner_factory(ip) -> object with .run(cmd); injectable so
         # setup is testable without ssh targets.
         self._runner_factory = runner_factory or self._default_runner
+        self._provisioned: set = set()
 
     def _default_runner(self, ip: str):
         from .providers import SSHCommandRunner
@@ -144,23 +145,24 @@ class ClusterLauncher:
 
     def _setup_node(self, node_id: str) -> bool:
         """Wait for the node and run setup_commands over ssh (providers
-        without wait_ready/node_ip — mock/local — skip silently)."""
-        if not self.cfg.setup_commands:
+        without wait_ready/node_ip — mock/local — skip silently).
+        Idempotent per node; raises on command failure (the Monitor-path
+        wrapper logs instead, autoscaler.py _launched)."""
+        if not self.cfg.setup_commands or node_id in self._provisioned:
             return True
         wait = getattr(self.provider, "wait_ready", None)
         get_ip = getattr(self.provider, "node_ip", None)
         if wait is None or get_ip is None:
             return True
         if not wait(node_id):
-            logger.warning("node %s never became ready", node_id)
-            return False
+            raise RuntimeError(f"node {node_id} never became ready")
         ip = get_ip(node_id)
         if not ip:
-            logger.warning("node %s has no reachable IP", node_id)
-            return False
+            raise RuntimeError(f"node {node_id} has no reachable IP")
         runner = self._runner_factory(ip)
         for cmd in self.cfg.setup_commands:
             runner.run(cmd)
+        self._provisioned.add(node_id)
         return True
 
     def up(self, *, start_monitor: bool = True,
@@ -171,11 +173,17 @@ class ClusterLauncher:
             node_types=dict(self.cfg.available_node_types),
         )
         # Provisioning rides the autoscaler's launch hook so nodes the
-        # Monitor adds later get setup_commands too, not just the
-        # min_workers launched here.
+        # Monitor adds later get setup_commands too (failures there are
+        # logged, not raised — there is no caller to raise to).
         self.autoscaler = StandardAutoscaler(
             as_cfg, self.provider, on_node_launched=self._setup_node)
         result = self.autoscaler.update()  # satisfies min_workers floors
+        # Synchronous pass over EVERY live node — pre-existing nodes
+        # (launcher restart, changed setup_commands) get provisioned,
+        # and failures here propagate to the up() caller. Idempotent:
+        # nodes the hook already set up are skipped.
+        for node_id in self.provider.non_terminated_nodes():
+            self._setup_node(node_id)
         if start_monitor:
             self.monitor = Monitor(self.autoscaler,
                                    interval_s=monitor_interval_s).start()

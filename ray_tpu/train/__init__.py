@@ -5,8 +5,8 @@ from .step import TrainState, init_state, make_optimizer, make_train_step
 from .trainer import Result, TpuTrainer
 
 __all__ = [
-    "TpuTrainer", "TorchTrainer", "Result", "ScalingConfig", "RunConfig",
-    "FailureConfig",
+    "TpuTrainer", "TorchTrainer", "TransformersTrainer", "Result",
+    "ScalingConfig", "RunConfig", "FailureConfig",
     "CheckpointConfig", "Checkpoint", "CheckpointManager", "save_pytree",
     "load_pytree", "report", "get_context", "get_dataset_shard", "get_mesh",
     "TrainState", "init_state", "make_optimizer", "make_train_step",
@@ -14,9 +14,14 @@ __all__ = [
 
 
 def __getattr__(name):
-    # TorchTrainer imports torch (heavy) — load lazily.
+    # TorchTrainer imports torch, TransformersTrainer also transformers
+    # (heavy) — load lazily.
     if name == "TorchTrainer":
         from .torch import TorchTrainer
 
         return TorchTrainer
+    if name == "TransformersTrainer":
+        from .huggingface import TransformersTrainer
+
+        return TransformersTrainer
     raise AttributeError(name)

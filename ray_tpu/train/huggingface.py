@@ -1,0 +1,118 @@
+"""HF Transformers integration.
+
+Capability-equivalent to the reference's transformers glue
+(reference: python/ray/train/huggingface/transformers/
+_transformers_utils.py — RayTrainReportCallback forwarding HF Trainer
+logs/checkpoints into ray.train.report, prepare_trainer wiring it in).
+Run a stock `transformers.Trainer` inside a TorchTrainer worker loop;
+the callback streams HF's logs + saved checkpoints to the driver
+through the session report channel.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+
+def _trainer_callback_base():
+    from transformers.trainer_callback import TrainerCallback
+
+    return TrainerCallback
+
+
+class RayTrainReportCallback(_trainer_callback_base()):
+    """Forwards transformers Trainer events to ray_tpu.train.report
+    (reference: _transformers_utils.py RayTrainReportCallback).
+
+    - on_log: every HF log record (loss, lr, epoch…) becomes a report.
+    - on_save: the just-written HF checkpoint directory rides along as
+      the report's checkpoint, so CheckpointConfig retention and
+      Result.checkpoint work unchanged.
+    """
+
+    def __init__(self):
+        self._last_metrics: Dict[str, Any] = {}
+
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        from . import session
+
+        if not logs:
+            return
+        metrics = {k: v for k, v in logs.items()
+                   if isinstance(v, (int, float))}
+        metrics["step"] = state.global_step
+        metrics["epoch"] = float(state.epoch or 0.0)
+        self._last_metrics = metrics
+        session.report(metrics)
+
+    def on_save(self, args, state, control, **kwargs):
+        from . import session
+        from .checkpoint import Checkpoint
+
+        ckpt_dir = os.path.join(
+            args.output_dir, f"checkpoint-{state.global_step}")
+        if not os.path.isdir(ckpt_dir):
+            return
+        metrics = dict(self._last_metrics)
+        metrics["step"] = state.global_step
+        session.report(metrics,
+                       checkpoint=Checkpoint.from_directory(ckpt_dir))
+
+
+def prepare_trainer(trainer):
+    """Attach RayTrainReportCallback if absent and return the trainer
+    (reference: _transformers_utils.py prepare_trainer)."""
+    has = any(isinstance(cb, RayTrainReportCallback)
+              for cb in trainer.callback_handler.callbacks)
+    if not has:
+        trainer.add_callback(RayTrainReportCallback())
+    return trainer
+
+
+class TransformersTrainer:
+    """Convenience wrapper (reference capability:
+    TransformersTrainer, deprecated in the reference in favor of
+    TorchTrainer + prepare_trainer — both shapes work here).
+
+    trainer_init_per_worker(config) -> transformers.Trainer runs on
+    each worker; the HF Trainer's own torch.distributed support picks
+    up the gloo process group TorchTrainer already created.
+    """
+
+    def __init__(self, trainer_init_per_worker, *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config=None, run_config=None,
+                 torch_config=None):
+        from .torch import TorchTrainer
+
+        def loop(config: Optional[Dict[str, Any]] = None) -> None:
+            hf_trainer = trainer_init_per_worker(config or {})
+            prepare_trainer(hf_trainer)
+            hf_trainer.train()
+
+        self._inner = TorchTrainer(
+            loop, train_loop_config=train_loop_config,
+            scaling_config=scaling_config, run_config=run_config,
+            torch_config=torch_config)
+
+    def fit(self):
+        return self._inner.fit()
+
+
+def default_training_args(output_dir: Optional[str] = None, **overrides):
+    """TrainingArguments tuned for this runtime: no hub/external
+    reporting, CPU-only unless overridden."""
+    from transformers import TrainingArguments
+
+    kw: Dict[str, Any] = dict(
+        output_dir=output_dir or tempfile.mkdtemp(prefix="hf_out_"),
+        report_to=[],
+        use_cpu=True,
+        save_strategy="no",
+        logging_steps=1,
+        disable_tqdm=True,
+    )
+    kw.update(overrides)
+    return TrainingArguments(**kw)

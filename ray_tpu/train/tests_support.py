@@ -1,0 +1,32 @@
+"""Importable test-support builders (worker processes can import
+ray_tpu.* but not the tests/ directory — loops that run on spawned
+workers get their fixtures from here)."""
+
+from __future__ import annotations
+
+
+def tiny_hf_trainer(output_dir, max_steps: int = 4, save_steps=None):
+    """A from-scratch tiny BERT classifier on synthetic data — no hub
+    downloads (zero-egress environments)."""
+    import numpy as np
+    from transformers import (
+        BertConfig,
+        BertForSequenceClassification,
+        Trainer,
+    )
+
+    from .huggingface import default_training_args
+
+    cfg = BertConfig(vocab_size=64, hidden_size=16,
+                     num_hidden_layers=1, num_attention_heads=2,
+                     intermediate_size=32, max_position_embeddings=32)
+    model = BertForSequenceClassification(cfg)
+    rng = np.random.default_rng(0)
+    data = [{"input_ids": rng.integers(0, 64, size=8).tolist(),
+             "attention_mask": [1] * 8,
+             "labels": int(i % 2)} for i in range(16)]
+    kw = dict(max_steps=max_steps, per_device_train_batch_size=4)
+    if save_steps:
+        kw.update(save_strategy="steps", save_steps=save_steps)
+    args = default_training_args(str(output_dir), **kw)
+    return Trainer(model=model, args=args, train_dataset=data)

@@ -234,16 +234,16 @@ class TPESearcher(Searcher):
         return s / (len(centers) * bw * math.sqrt(2 * math.pi))
 
 
-class GPSearcher(Searcher):
+class GPSearcher(TPESearcher):
     """Bayesian optimization with a Gaussian process + expected
     improvement (reference capability: tune/search/bayesopt/
     bayesopt_search.py over the bayes_opt package; implemented natively
     with numpy — no external dependency).
 
     Numeric dims (Float/Integer) are normalized to [0,1] (log-space for
-    log dims) and modeled jointly under an RBF-kernel GP; categorical/
-    grid dims are sampled from re-weighted empirical frequencies of the
-    good points (TPE-style) since a GP over one-hots at these trial
+    log dims) and modeled jointly under an RBF-kernel GP; non-numeric
+    dims fall back to the inherited TPE machinery (good-biased
+    categorical sampling) since a GP over one-hots at these trial
     counts adds noise, not signal. EI is maximized over random
     candidates."""
 
@@ -252,20 +252,13 @@ class GPSearcher(Searcher):
                  n_initial: int = 8, n_candidates: int = 256,
                  length_scale: float = 0.25, noise: float = 1e-4,
                  xi: float = 0.01, seed: Optional[int] = None):
-        assert mode in ("min", "max")
-        self.space = param_space
-        self.metric = metric
-        self.mode = mode
-        self.limit = num_samples
-        self.n_initial = n_initial
-        self.n_candidates = n_candidates
+        super().__init__(param_space, metric=metric, mode=mode,
+                         num_samples=num_samples, n_initial=n_initial,
+                         seed=seed)
+        self.gp_candidates = n_candidates
         self.length_scale = length_scale
         self.noise = noise
         self.xi = xi
-        self._rng = random.Random(seed)
-        self._suggested = 0
-        self._pending: Dict[str, Dict[str, Any]] = {}
-        self._observed: List[tuple] = []  # (norm_value, config)
         self._num_keys = [k for k, v in param_space.items()
                           if isinstance(v, (Float, Integer))]
 
@@ -276,33 +269,11 @@ class GPSearcher(Searcher):
         if len(self._observed) < self.n_initial:
             cfg = self._random_config()
         else:
-            # _gp_config handles numeric dims with the GP and the rest
-            # with good-biased sampling; with no numeric dims it is the
-            # categorical sampler alone.
             cfg = self._gp_config()
         self._pending[trial_id] = cfg
         return cfg
 
-    def on_trial_complete(self, trial_id: str,
-                          result: Optional[Dict[str, Any]] = None) -> None:
-        cfg = self._pending.pop(trial_id, None)
-        if cfg is None or not result or self.metric not in result:
-            return
-        v = float(result[self.metric])
-        self._observed.append((-v if self.mode == "max" else v, cfg))
-
     # -- internals ------------------------------------------------------
-    def _random_config(self) -> Dict[str, Any]:
-        cfg = {}
-        for k, v in self.space.items():
-            if isinstance(v, GridSearch):
-                cfg[k] = self._rng.choice(v.values)
-            elif isinstance(v, Domain):
-                cfg[k] = v.sample(self._rng)
-            else:
-                cfg[k] = v
-        return cfg
-
     def _to_unit(self, k: str, x: float) -> float:
         import math
 
@@ -333,7 +304,9 @@ class GPSearcher(Searcher):
 
         import numpy as np
 
-        cfg = {}
+        # Non-numeric dims via the inherited TPE sampler; its numeric
+        # suggestions are overwritten by the GP below.
+        cfg = self._tpe_config()
         if self._num_keys:
             X = np.array([[self._to_unit(k, c[k])
                            for k in self._num_keys]
@@ -352,7 +325,7 @@ class GPSearcher(Searcher):
 
             cand = np.array([[self._rng.random()
                               for _ in self._num_keys]
-                             for _ in range(self.n_candidates)])
+                             for _ in range(self.gp_candidates)])
             Ks = kern(cand, X)                       # (C, N)
             mu = Ks @ alpha
             v = np.linalg.solve(L, Ks.T)             # (N, C)
@@ -368,32 +341,6 @@ class GPSearcher(Searcher):
             u = cand[int(np.argmax(ei))]
             for i, k in enumerate(self._num_keys):
                 cfg[k] = self._from_unit(k, float(u[i]))
-        # Non-numeric dims: good-biased empirical sampling.
-        good_n = max(1, int(len(self._observed) * 0.25))
-        good = [c for _, c in
-                sorted(self._observed, key=lambda t: t[0])[:good_n]]
-        for k, v in self.space.items():
-            if k in cfg:
-                continue
-            if isinstance(v, (Categorical, GridSearch)):
-                cats = v.categories if isinstance(v, Categorical) \
-                    else v.values
-                counts = {c: 1.0 for c in cats}
-                for c in good:
-                    if k in c and c[k] in counts:
-                        counts[c[k]] += 1.0
-                total = sum(counts.values())
-                r = self._rng.random() * total
-                acc = 0.0
-                for cat, w in counts.items():
-                    acc += w
-                    if r <= acc:
-                        cfg[k] = cat
-                        break
-            elif isinstance(v, Domain):
-                cfg[k] = v.sample(self._rng)
-            else:
-                cfg[k] = v
         return cfg
 
 
