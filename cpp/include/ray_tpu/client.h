@@ -1,0 +1,136 @@
+// C++ client API for the ray_tpu runtime's native planes.
+//
+// Capability-reference: the reference ships a C++ language API
+// (reference: cpp/include/ray/api/*.h — ray::Init, ray::Put/Get over
+// the plasma store, actor/task calls through the C++ core worker).
+// Here the C++ surface covers the native planes a C++ process talks to
+// directly — the shared-memory object store (zero-copy Put/Get/
+// channels) and the control plane (KV, pubsub, node/actor/job tables);
+// task/actor *submission* stays in the Python runtime, which is the
+// documented scope difference (PARITY.md §2.1 "C++ worker API").
+//
+// Both clients are wire/ABI-compatible with the Python bindings
+// (ray_tpu/_native/shm_store.py, control_client.py): a C++ process and
+// a Python process attach the same arena / daemon and exchange data.
+
+#ifndef RAY_TPU_CLIENT_H_
+#define RAY_TPU_CLIENT_H_
+
+#include <array>
+#include <cstdint>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+constexpr int kObjectIdLen = 28;  // mirrors shm_store.cc kIdLen
+
+using ObjectID = std::array<uint8_t, kObjectIdLen>;
+
+// Deterministic id from a string name (for cross-language rendezvous
+// on well-known ids; cryptographic strength is not required here).
+ObjectID IdFromName(const std::string& name);
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+// ---------------------------------------------------------------------------
+// Shared-memory object store (reference: cpp plasma client usage)
+// ---------------------------------------------------------------------------
+
+class ObjectStoreClient {
+ public:
+  // Attach (or create) the named arena, e.g. "/ray_tpu_<session>".
+  ObjectStoreClient(const std::string& name, uint64_t capacity = 0,
+                    bool create = false);
+  ~ObjectStoreClient();
+  ObjectStoreClient(const ObjectStoreClient&) = delete;
+  ObjectStoreClient& operator=(const ObjectStoreClient&) = delete;
+
+  // Copy `data` into a new sealed object. Throws on duplicate/full.
+  void Put(const ObjectID& id, const void* data, uint64_t size);
+
+  // Zero-copy view of a sealed object (valid while pinned; callers
+  // that need the data past Release must copy). pin=true increments
+  // the pin count — call Release(id) when done.
+  struct Buffer {
+    const uint8_t* data;
+    uint64_t size;
+  };
+  Buffer Get(const ObjectID& id, bool pin = true);
+  void Release(const ObjectID& id);
+  bool Contains(const ObjectID& id);
+  void Delete(const ObjectID& id);
+
+  // Mutable channel objects (seqlock; compiled-DAG channels).
+  void ChannelCreate(const ObjectID& id, uint64_t max_size);
+  void ChannelWrite(const ObjectID& id, const void* data, uint64_t size);
+  // Returns false if no stable version is available yet.
+  bool ChannelRead(const ObjectID& id, std::vector<uint8_t>* out,
+                   uint64_t* version);
+
+  uint64_t Used();
+  uint64_t Capacity();
+  uint64_t NumObjects();
+
+ private:
+  void* handle_;
+  uint8_t* base_;
+};
+
+// ---------------------------------------------------------------------------
+// Control plane client (reference: cpp GcsClient usage)
+// ---------------------------------------------------------------------------
+
+class ControlClient {
+ public:
+  ControlClient(const std::string& host, int port,
+                double timeout_s = 30.0);
+  ~ControlClient();
+  ControlClient(const ControlClient&) = delete;
+  ControlClient& operator=(const ControlClient&) = delete;
+
+  void Ping();
+
+  // KV (reference: InternalKVAccessor).
+  void KvPut(const std::string& key, const std::string& value,
+             bool overwrite = true);
+  // Returns false when the key is absent.
+  bool KvGet(const std::string& key, std::string* value);
+  bool KvDel(const std::string& key);
+  bool KvExists(const std::string& key);
+  std::vector<std::string> KvKeys(const std::string& prefix);
+
+  // Pubsub: publish now; subscription drains pushes received so far
+  // (poll-style — the Python client owns the callback thread model).
+  void Publish(const std::string& channel, const std::string& payload);
+  void Subscribe(const std::string& channel);
+  // Non-blocking-ish: reads frames already buffered on the socket for
+  // up to timeout_s, appending (channel, payload) pairs.
+  std::vector<std::pair<std::string, std::string>> PollPushes(
+      double timeout_s);
+
+  // Tables.
+  std::vector<std::string> ListNodes();         // node ids
+  std::map<std::string, uint64_t> Stats();      // op -> count
+
+ private:
+  std::vector<uint8_t> Request(uint8_t op,
+                               const std::vector<uint8_t>& body);
+  void SendFrame(const std::vector<uint8_t>& frame_body);
+  bool ReadFrame(std::vector<uint8_t>* body, double timeout_s);
+
+  int fd_;
+  uint64_t req_id_ = 0;
+  double timeout_s_;
+  std::vector<uint8_t> rxbuf_;  // partial-frame carryover
+  std::vector<std::pair<std::string, std::string>> pushes_;
+};
+
+}  // namespace ray_tpu
+
+#endif  // RAY_TPU_CLIENT_H_

@@ -1,0 +1,77 @@
+// Cross-language smoke test driven by tests/test_cpp_api.py.
+//
+// argv: <mode> <arena_name> <host> <port>
+//   mode "produce": put an object + channel write + KV puts, then exit
+//   mode "consume": read the object Python wrote, echo KV, publish
+//
+// Prints "OK <detail>" lines; any failure throws and exits nonzero.
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "ray_tpu/client.h"
+
+using ray_tpu::ControlClient;
+using ray_tpu::IdFromName;
+using ray_tpu::ObjectStoreClient;
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    std::fprintf(stderr,
+                 "usage: smoke_test <mode> <arena> <host> <port>\n");
+    return 2;
+  }
+  std::string mode = argv[1];
+  std::string arena = argv[2];
+  std::string host = argv[3];
+  int port = std::atoi(argv[4]);
+
+  ObjectStoreClient store(arena);
+  ControlClient ctl(host, port);
+  ctl.Ping();
+  std::printf("OK connected used=%llu cap=%llu\n",
+              (unsigned long long)store.Used(),
+              (unsigned long long)store.Capacity());
+
+  if (mode == "produce") {
+    const char* payload = "hello from c++";
+    store.Put(IdFromName("cpp-object"), payload, std::strlen(payload));
+    store.ChannelCreate(IdFromName("cpp-channel"), 128);
+    store.ChannelWrite(IdFromName("cpp-channel"), "tick-1", 6);
+    ctl.KvPut("cpp/greeting", "bonjour");
+    std::printf("OK produced objects=%llu\n",
+                (unsigned long long)store.NumObjects());
+  } else if (mode == "consume") {
+    auto buf = store.Get(IdFromName("py-object"));
+    std::string text(reinterpret_cast<const char*>(buf.data), buf.size);
+    store.Release(IdFromName("py-object"));
+    std::printf("OK object=%s\n", text.c_str());
+
+    std::vector<uint8_t> ch;
+    uint64_t version = 0;
+    if (!store.ChannelRead(IdFromName("py-channel"), &ch, &version)) {
+      std::fprintf(stderr, "channel read failed\n");
+      return 1;
+    }
+    std::printf("OK channel=%s v=%llu\n",
+                std::string(ch.begin(), ch.end()).c_str(),
+                (unsigned long long)version);
+
+    std::string v;
+    if (!ctl.KvGet("py/greeting", &v)) {
+      std::fprintf(stderr, "kv missing\n");
+      return 1;
+    }
+    std::printf("OK kv=%s keys=%zu\n", v.c_str(),
+                ctl.KvKeys("py/").size());
+    ctl.KvPut("cpp/echo", v + "+cpp");
+    ctl.Publish("cpp-events", "done");
+    std::printf("OK stats_ops=%zu nodes=%zu\n", ctl.Stats().size(),
+                ctl.ListNodes().size());
+  } else {
+    std::fprintf(stderr, "unknown mode %s\n", mode.c_str());
+    return 2;
+  }
+  return 0;
+}

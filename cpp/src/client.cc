@@ -1,0 +1,465 @@
+// C++ client implementation. See include/ray_tpu/client.h.
+//
+// Links against the same libshm_store.so the Python bindings load (the
+// arena protocol lives in shared memory; both languages are peers) and
+// speaks the control-plane wire protocol documented at the top of
+// src/control_plane.cc ([u32 len][u8 type][body]; request body =
+// [u64 req_id][u8 op][args]).
+
+#include "ray_tpu/client.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+
+// ---------------------------------------------------------------------------
+// extern "C" surface of libshm_store.so
+// ---------------------------------------------------------------------------
+extern "C" {
+void* rts_connect(const char* name, uint64_t capacity, int create);
+void rts_disconnect(void* handle);
+int rts_create(void* handle, const uint8_t* id, uint64_t size,
+               uint64_t* offset_out);
+int rts_seal(void* handle, const uint8_t* id);
+int rts_get(void* handle, const uint8_t* id, uint64_t* offset_out,
+            uint64_t* size_out, int pin);
+int rts_release(void* handle, const uint8_t* id);
+int rts_contains(void* handle, const uint8_t* id);
+int rts_delete(void* handle, const uint8_t* id);
+uint64_t rts_used(void* handle);
+uint64_t rts_capacity(void* handle);
+uint64_t rts_num_objects(void* handle);
+void* rts_base(void* handle);
+int rts_ch_create(void* handle, const uint8_t* id, uint64_t max_size,
+                  uint64_t* offset_out);
+int rts_ch_write_acquire(void* handle, const uint8_t* id, uint64_t size,
+                         uint64_t* offset_out);
+int rts_ch_write_release(void* handle, const uint8_t* id);
+int64_t rts_ch_read(void* handle, const uint8_t* id,
+                    uint64_t* offset_out, uint64_t* size_out);
+}
+
+namespace ray_tpu {
+
+ObjectID IdFromName(const std::string& name) {
+  // FNV-1a stretched over the id width — matches no Python helper by
+  // necessity (ids are opaque bytes on both sides); deterministic so
+  // two processes can derive the same id from a shared name.
+  ObjectID id{};
+  uint64_t h = 1469598103934665603ull;
+  for (unsigned char c : name) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  for (int i = 0; i < kObjectIdLen; i++) {
+    id[i] = static_cast<uint8_t>(h >> ((i % 8) * 8));
+    if (i % 8 == 7) {
+      h ^= h >> 33;
+      h *= 0xff51afd7ed558ccdull;
+    }
+  }
+  return id;
+}
+
+// ---------------------------------------------------------------------------
+// ObjectStoreClient
+// ---------------------------------------------------------------------------
+
+ObjectStoreClient::ObjectStoreClient(const std::string& name,
+                                     uint64_t capacity, bool create) {
+  handle_ = rts_connect(name.c_str(), capacity, create ? 1 : 0);
+  if (handle_ == nullptr) {
+    throw Error("cannot attach shm arena " + name);
+  }
+  base_ = static_cast<uint8_t*>(rts_base(handle_));
+}
+
+ObjectStoreClient::~ObjectStoreClient() {
+  if (handle_ != nullptr) rts_disconnect(handle_);
+}
+
+void ObjectStoreClient::Put(const ObjectID& id, const void* data,
+                            uint64_t size) {
+  uint64_t off = 0;
+  int rc = rts_create(handle_, id.data(), size, &off);
+  if (rc == -1) throw Error("object already exists");
+  if (rc == -2) throw Error("object store full");
+  if (rc != 0) throw Error("object table full");
+  std::memcpy(base_ + off, data, size);
+  if (rts_seal(handle_, id.data()) != 0) throw Error("seal failed");
+}
+
+ObjectStoreClient::Buffer ObjectStoreClient::Get(const ObjectID& id,
+                                                 bool pin) {
+  uint64_t off = 0, size = 0;
+  if (rts_get(handle_, id.data(), &off, &size, pin ? 1 : 0) != 0) {
+    throw Error("object not found (or unsealed)");
+  }
+  return Buffer{base_ + off, size};
+}
+
+void ObjectStoreClient::Release(const ObjectID& id) {
+  rts_release(handle_, id.data());
+}
+
+bool ObjectStoreClient::Contains(const ObjectID& id) {
+  return rts_contains(handle_, id.data()) == 1;
+}
+
+void ObjectStoreClient::Delete(const ObjectID& id) {
+  int rc = rts_delete(handle_, id.data());
+  if (rc == -2) throw Error("object is pinned");
+  if (rc != 0) throw Error("object not found");
+}
+
+void ObjectStoreClient::ChannelCreate(const ObjectID& id,
+                                      uint64_t max_size) {
+  uint64_t off = 0;
+  int rc = rts_ch_create(handle_, id.data(), max_size, &off);
+  if (rc == -1) throw Error("channel already exists");
+  if (rc == -2) throw Error("object store full");
+  if (rc != 0) throw Error("object table full");
+}
+
+void ObjectStoreClient::ChannelWrite(const ObjectID& id, const void* data,
+                                     uint64_t size) {
+  uint64_t off = 0;
+  if (rts_ch_write_acquire(handle_, id.data(), size, &off) != 0) {
+    throw Error("channel write acquire failed (missing or too large)");
+  }
+  std::memcpy(base_ + off, data, size);
+  if (rts_ch_write_release(handle_, id.data()) != 0) {
+    throw Error("channel write release failed");
+  }
+}
+
+bool ObjectStoreClient::ChannelRead(const ObjectID& id,
+                                    std::vector<uint8_t>* out,
+                                    uint64_t* version) {
+  for (int attempt = 0; attempt < 1000; attempt++) {
+    uint64_t off = 0, size = 0;
+    int64_t v = rts_ch_read(handle_, id.data(), &off, &size);
+    if (v == -1) throw Error("channel not found");
+    if (v == -2) {  // writer in progress — retry
+      usleep(100);
+      continue;
+    }
+    out->assign(base_ + off, base_ + off + size);
+    // Seqlock validation: the version must be unchanged after the copy.
+    uint64_t off2 = 0, size2 = 0;
+    int64_t v2 = rts_ch_read(handle_, id.data(), &off2, &size2);
+    if (v2 == v) {
+      if (version != nullptr) *version = static_cast<uint64_t>(v);
+      return true;
+    }
+  }
+  return false;
+}
+
+uint64_t ObjectStoreClient::Used() { return rts_used(handle_); }
+uint64_t ObjectStoreClient::Capacity() { return rts_capacity(handle_); }
+uint64_t ObjectStoreClient::NumObjects() {
+  return rts_num_objects(handle_);
+}
+
+// ---------------------------------------------------------------------------
+// ControlClient
+// ---------------------------------------------------------------------------
+
+namespace {
+
+enum Op : uint8_t {
+  OP_PING = 0,
+  OP_KV_PUT = 1,
+  OP_KV_GET = 2,
+  OP_KV_DEL = 3,
+  OP_KV_KEYS = 4,
+  OP_KV_EXISTS = 5,
+  OP_SUBSCRIBE = 10,
+  OP_UNSUBSCRIBE = 11,
+  OP_PUBLISH = 12,
+  OP_LIST_NODES = 22,
+  OP_STATS = 50,
+};
+
+enum Status : uint8_t {
+  ST_OK = 0,
+  ST_NOT_FOUND = 1,
+  ST_EXISTS = 2,
+};
+
+void put_u32(std::vector<uint8_t>* b, uint32_t v) {
+  size_t n = b->size();
+  b->resize(n + 4);
+  std::memcpy(b->data() + n, &v, 4);
+}
+
+void put_u64(std::vector<uint8_t>* b, uint64_t v) {
+  size_t n = b->size();
+  b->resize(n + 8);
+  std::memcpy(b->data() + n, &v, 8);
+}
+
+void put_str(std::vector<uint8_t>* b, const std::string& s) {
+  put_u32(b, static_cast<uint32_t>(s.size()));
+  b->insert(b->end(), s.begin(), s.end());
+}
+
+struct Cursor {
+  const uint8_t* p;
+  size_t left;
+
+  uint8_t u8() {
+    if (left < 1) throw Error("short response");
+    uint8_t v = *p;
+    p++;
+    left--;
+    return v;
+  }
+  uint32_t u32() {
+    if (left < 4) throw Error("short response");
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    p += 4;
+    left -= 4;
+    return v;
+  }
+  uint64_t u64() {
+    if (left < 8) throw Error("short response");
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    p += 8;
+    left -= 8;
+    return v;
+  }
+  std::string str() {
+    uint32_t n = u32();
+    if (left < n) throw Error("short response");
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    left -= n;
+    return s;
+  }
+};
+
+}  // namespace
+
+ControlClient::ControlClient(const std::string& host, int port,
+                             double timeout_s)
+    : timeout_s_(timeout_s) {
+  fd_ = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) throw Error("socket() failed");
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    close(fd_);
+    throw Error("bad host " + host);
+  }
+  if (connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    close(fd_);
+    throw Error("cannot connect to control plane");
+  }
+}
+
+ControlClient::~ControlClient() {
+  if (fd_ >= 0) close(fd_);
+}
+
+void ControlClient::SendFrame(const std::vector<uint8_t>& frame_body) {
+  std::vector<uint8_t> frame;
+  put_u32(&frame, static_cast<uint32_t>(frame_body.size()));
+  frame.insert(frame.end(), frame_body.begin(), frame_body.end());
+  size_t sent = 0;
+  while (sent < frame.size()) {
+    ssize_t n = send(fd_, frame.data() + sent, frame.size() - sent, 0);
+    if (n <= 0) throw Error("control plane send failed");
+    sent += static_cast<size_t>(n);
+  }
+}
+
+bool ControlClient::ReadFrame(std::vector<uint8_t>* body,
+                              double timeout_s) {
+  // All-or-nothing framing over a persistent receive buffer: a timeout
+  // mid-frame leaves the partial bytes in rxbuf_ for the next call —
+  // never desynchronizing the stream.
+  while (true) {
+    if (rxbuf_.size() >= 4) {
+      uint32_t len;
+      std::memcpy(&len, rxbuf_.data(), 4);
+      if (rxbuf_.size() >= 4 + static_cast<size_t>(len)) {
+        body->assign(rxbuf_.begin() + 4, rxbuf_.begin() + 4 + len);
+        rxbuf_.erase(rxbuf_.begin(), rxbuf_.begin() + 4 + len);
+        return true;
+      }
+    }
+    pollfd pfd{fd_, POLLIN, 0};
+    int pr = poll(&pfd, 1, static_cast<int>(timeout_s * 1000));
+    if (pr <= 0) return false;
+    uint8_t chunk[65536];
+    ssize_t r = recv(fd_, chunk, sizeof(chunk), 0);
+    if (r <= 0) throw Error("control plane connection closed");
+    rxbuf_.insert(rxbuf_.end(), chunk, chunk + r);
+  }
+}
+
+std::vector<uint8_t> ControlClient::Request(
+    uint8_t op, const std::vector<uint8_t>& body) {
+  req_id_++;
+  std::vector<uint8_t> frame_body;
+  frame_body.push_back(0);  // type: request
+  put_u64(&frame_body, req_id_);
+  frame_body.push_back(op);
+  frame_body.insert(frame_body.end(), body.begin(), body.end());
+  SendFrame(frame_body);
+
+  // Read until OUR response; pushes received meanwhile are queued.
+  std::vector<uint8_t> resp;
+  while (true) {
+    if (!ReadFrame(&resp, timeout_s_)) {
+      throw Error("control plane request timed out");
+    }
+    if (resp.empty()) throw Error("empty frame");
+    if (resp[0] == 0) {  // response
+      if (resp.size() < 9) throw Error("short response frame");
+      uint64_t rid;
+      std::memcpy(&rid, resp.data() + 1, 8);
+      if (rid != req_id_) continue;  // stale (shouldn't happen: sync)
+      return std::vector<uint8_t>(resp.begin() + 9, resp.end());
+    }
+    Cursor c{resp.data() + 1, resp.size() - 1};
+    std::string channel = c.str();
+    std::string payload = c.str();
+    pushes_.emplace_back(channel, payload);
+  }
+}
+
+void ControlClient::Ping() { Request(OP_PING, {}); }
+
+void ControlClient::KvPut(const std::string& key, const std::string& value,
+                          bool overwrite) {
+  std::vector<uint8_t> b;
+  put_str(&b, key);
+  put_str(&b, value);
+  b.push_back(overwrite ? 1 : 0);
+  auto r = Request(OP_KV_PUT, b);
+  if (r.empty()) throw Error("kv put: empty response");
+  if (r[0] == ST_EXISTS) throw Error("key exists (overwrite=false)");
+  if (r[0] != ST_OK) {
+    throw Error("kv put failed (status " + std::to_string(r[0]) + ")");
+  }
+}
+
+bool ControlClient::KvGet(const std::string& key, std::string* value) {
+  std::vector<uint8_t> b;
+  put_str(&b, key);
+  auto r = Request(OP_KV_GET, b);
+  Cursor c{r.data(), r.size()};
+  uint8_t st = c.u8();
+  if (st == ST_NOT_FOUND) return false;
+  if (st != ST_OK) throw Error("kv get failed");
+  *value = c.str();
+  return true;
+}
+
+bool ControlClient::KvDel(const std::string& key) {
+  std::vector<uint8_t> b;
+  put_str(&b, key);
+  auto r = Request(OP_KV_DEL, b);
+  return !r.empty() && r[0] == ST_OK;
+}
+
+bool ControlClient::KvExists(const std::string& key) {
+  std::vector<uint8_t> b;
+  put_str(&b, key);
+  auto r = Request(OP_KV_EXISTS, b);
+  Cursor c{r.data(), r.size()};
+  if (c.u8() != ST_OK) throw Error("kv exists failed");
+  return c.u8() == 1;
+}
+
+std::vector<std::string> ControlClient::KvKeys(const std::string& prefix) {
+  std::vector<uint8_t> b;
+  put_str(&b, prefix);
+  auto r = Request(OP_KV_KEYS, b);
+  Cursor c{r.data(), r.size()};
+  if (c.u8() != ST_OK) throw Error("kv keys failed");
+  uint32_t n = c.u32();
+  std::vector<std::string> out;
+  out.reserve(n);
+  for (uint32_t i = 0; i < n; i++) out.push_back(c.str());
+  return out;
+}
+
+void ControlClient::Publish(const std::string& channel,
+                            const std::string& payload) {
+  std::vector<uint8_t> b;
+  put_str(&b, channel);
+  put_str(&b, payload);
+  Request(OP_PUBLISH, b);
+}
+
+void ControlClient::Subscribe(const std::string& channel) {
+  std::vector<uint8_t> b;
+  put_str(&b, channel);
+  Request(OP_SUBSCRIBE, b);
+}
+
+std::vector<std::pair<std::string, std::string>> ControlClient::PollPushes(
+    double timeout_s) {
+  std::vector<uint8_t> frame;
+  while (ReadFrame(&frame, timeout_s)) {
+    if (frame.empty()) break;
+    if (frame[0] != 0) {
+      Cursor c{frame.data() + 1, frame.size() - 1};
+      std::string channel = c.str();
+      std::string payload = c.str();
+      pushes_.emplace_back(channel, payload);
+      timeout_s = 0.01;  // drain whatever else is buffered
+    }
+  }
+  auto out = std::move(pushes_);
+  pushes_.clear();
+  return out;
+}
+
+std::vector<std::string> ControlClient::ListNodes() {
+  auto r = Request(OP_LIST_NODES, {});
+  Cursor c{r.data(), r.size()};
+  if (c.u8() != ST_OK) throw Error("list nodes failed");
+  uint32_t n = c.u32();
+  std::vector<std::string> out;
+  for (uint32_t i = 0; i < n; i++) {
+    out.push_back(c.str());      // node_id
+    c.str();                     // meta (opaque here)
+    c.u8();                      // alive
+    c.u8();                      // draining
+    c.u64();                     // ms since last heartbeat
+  }
+  return out;
+}
+
+std::map<std::string, uint64_t> ControlClient::Stats() {
+  auto r = Request(OP_STATS, {});
+  Cursor c{r.data(), r.size()};
+  if (c.u8() != ST_OK) throw Error("stats failed");
+  uint32_t n = c.u32();
+  std::map<std::string, uint64_t> out;  // "op_<n>" -> call count
+  for (uint32_t i = 0; i < n; i++) {
+    uint8_t op = c.u8();
+    uint64_t count = c.u64();
+    c.u64();  // total_us
+    out["op_" + std::to_string(op)] = count;
+  }
+  return out;
+}
+
+}  // namespace ray_tpu

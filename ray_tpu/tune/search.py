@@ -140,9 +140,14 @@ class TPESearcher(Searcher):
         if len(self._observed) < self.n_initial:
             cfg = self._random_config()
         else:
-            cfg = self._tpe_config()
+            cfg = self._model_config()
         self._pending[trial_id] = cfg
         return cfg
+
+    def _model_config(self) -> Dict[str, Any]:
+        """Model-guided suggestion once past the random phase —
+        subclasses (GPSearcher) override this single hook."""
+        return self._tpe_config()
 
     def on_trial_complete(self, trial_id: str,
                           result: Optional[Dict[str, Any]] = None) -> None:
@@ -262,16 +267,8 @@ class GPSearcher(TPESearcher):
         self._num_keys = [k for k, v in param_space.items()
                           if isinstance(v, (Float, Integer))]
 
-    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
-        if self._suggested >= self.limit:
-            return None
-        self._suggested += 1
-        if len(self._observed) < self.n_initial:
-            cfg = self._random_config()
-        else:
-            cfg = self._gp_config()
-        self._pending[trial_id] = cfg
-        return cfg
+    def _model_config(self) -> Dict[str, Any]:
+        return self._gp_config()
 
     # -- internals ------------------------------------------------------
     def _to_unit(self, k: str, x: float) -> float:

@@ -398,6 +398,12 @@ uint64_t rts_used(void* handle) {
   return reinterpret_cast<Store*>(handle)->hdr->used;
 }
 
+// Arena base pointer — offsets from rts_get/rts_ch_read are relative
+// to this (the C++ client reads in-process; Python mmaps separately).
+void* rts_base(void* handle) {
+  return reinterpret_cast<Store*>(handle)->base;
+}
+
 uint64_t rts_capacity(void* handle) {
   return reinterpret_cast<Store*>(handle)->hdr->capacity;
 }
