@@ -10,6 +10,7 @@
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
+#include <netdb.h>
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/socket.h>
@@ -149,6 +150,8 @@ bool ObjectStoreClient::ChannelRead(const ObjectID& id,
       usleep(100);
       continue;
     }
+    if (v == 0) return false;  // created but never written (matches
+                               // the Python binding's size>0 gate)
     out->assign(base_ + off, base_ + off + size);
     // Seqlock validation: the version must be unchanged after the copy.
     uint64_t off2 = 0, size2 = 0;
@@ -260,8 +263,20 @@ ControlClient::ControlClient(const std::string& host, int port,
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
-    close(fd_);
-    throw Error("bad host " + host);
+    // Not a numeric address — resolve the hostname (the Python client
+    // accepts "localhost" etc.; so must we).
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 ||
+        res == nullptr) {
+      close(fd_);
+      throw Error("cannot resolve host " + host);
+    }
+    addr.sin_addr =
+        reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
   }
   if (connect(fd_, reinterpret_cast<sockaddr*>(&addr),
               sizeof(addr)) != 0) {

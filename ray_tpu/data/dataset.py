@@ -183,7 +183,19 @@ class Dataset:
     def _refs(self) -> Iterator:
         if self._materialized is not None:
             return iter(self._materialized)
-        return execute(self._op)
+        from .executor import ExecStats
+
+        self._last_stats = ExecStats()
+        return execute(self._op, stats=self._last_stats)
+
+    def stats(self) -> str:
+        """Execution statistics of the most recent run of this dataset
+        (reference: dataset.py Dataset.stats()). Stages report blocks
+        produced and pipelined wall time."""
+        st = getattr(self, "_last_stats", None)
+        if st is None:
+            return "No execution stats: dataset has not been executed."
+        return st.summary()
 
     def iter_batches(self, **kwargs) -> Iterator[Any]:
         return DataIterator(self._refs).iter_batches(**kwargs)

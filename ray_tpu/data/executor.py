@@ -325,8 +325,47 @@ def _stage_sort(op: Sort, upstream: Iterator[ObjectRef]
     yield from _push_shuffle(iter(refs), n_out, "range", arg, None)
 
 
-def execute(root: LogicalOp, *, max_in_flight: Optional[int] = None
-            ) -> Iterator[ObjectRef]:
+class ExecStats:
+    """Per-stage execution statistics for one dataset run
+    (reference: data/_internal/stats.py DatasetStats → ds.stats()).
+
+    Wall time per stage is measured pipelined — a stage's clock runs
+    from its first block request to its last block yield, so stages
+    overlap and the numbers say where the pipeline spent its time, not
+    a serial breakdown.
+    """
+
+    def __init__(self):
+        self.stages: List[dict] = []
+
+    def _track(self, name: str, stream: Iterator) -> Iterator:
+        import time as _time
+
+        rec = {"stage": name, "blocks": 0, "wall_s": 0.0}
+        self.stages.append(rec)
+
+        def gen():
+            t0 = _time.perf_counter()
+            for ref in stream:
+                rec["blocks"] += 1
+                rec["wall_s"] = _time.perf_counter() - t0
+                yield ref
+
+        return gen()
+
+    def summary(self) -> str:
+        if not self.stages:
+            return "No execution stats: dataset has not been executed."
+        lines = []
+        for rec in self.stages:
+            lines.append(
+                f"Stage {rec['stage']}: {rec['blocks']} blocks, "
+                f"{rec['wall_s'] * 1000:.1f}ms wall (pipelined)")
+        return "\n".join(lines)
+
+
+def execute(root: LogicalOp, *, max_in_flight: Optional[int] = None,
+            stats: Optional[ExecStats] = None) -> Iterator[ObjectRef]:
     """Compile the logical chain into a lazy pipelined iterator of block
     refs. Backpressure = bounded windows per map/read stage; the window
     defaults to DataContext.max_in_flight_tasks."""
@@ -365,10 +404,15 @@ def execute(root: LogicalOp, *, max_in_flight: Optional[int] = None
                 for r in main:
                     yield r
                 for other in others:
-                    for r in execute(other, max_in_flight=max_in_flight):
+                    # Branch stages land in the same stats object so
+                    # union pipelines show the full breakdown.
+                    for r in execute(other, max_in_flight=max_in_flight,
+                                     stats=stats):
                         yield r
             stream = _union()
         else:
             raise ValueError(f"Unknown op {op}")
+        if stats is not None:
+            stream = stats._track(type(op).__name__, stream)
     assert stream is not None
     return stream

@@ -398,3 +398,17 @@ def test_local_shuffle_buffer(data):
                              local_shuffle_seed=0):
         again.extend(b["id"].tolist())
     assert seen == again
+
+
+def test_dataset_stats(ray_start):
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items([{"x": i} for i in range(20)]) \
+        .map_batches(lambda b: b)
+    assert "has not been executed" in ds.stats()
+    assert ds.count() == 20
+    s = ds.stats()
+    assert "Stage" in s and "blocks" in s
+    # Both the source and the map stage appear.
+    assert "FromBlocks" in s or "Read" in s
+    assert "Map" in s
