@@ -58,6 +58,14 @@ class RemoteFunction:
         from ..dag.node import FunctionNode
         return FunctionNode(self, args, kwargs)
 
+    def __getstate__(self):
+        # The descriptor cache pins the live Runtime (locks, threads) —
+        # never ship it; deserialized copies re-register lazily.
+        state = dict(self.__dict__)
+        state["_descriptor"] = None
+        state["_descriptor_runtime"] = None
+        return state
+
     @property
     def underlying_function(self) -> Callable:
         return self._func

@@ -2,7 +2,8 @@
 (reference: python/ray/util/)."""
 
 from .actor_pool import ActorPool
+from .check_serialize import inspect_serializability
 from .queue import Queue
 
-__all__ = ["ActorPool", "Queue", "collective", "metrics", "tracing",
-           "multiprocessing", "joblib"]
+__all__ = ["ActorPool", "Queue", "inspect_serializability", "collective",
+           "metrics", "tracing", "multiprocessing", "joblib"]

@@ -1,0 +1,118 @@
+"""Serializability inspection.
+
+Capability-equivalent to the reference's
+`ray.util.inspect_serializability` (reference:
+python/ray/util/check_serialize.py — walks an object to find exactly
+which nested member fails cloudpickle, printing a trace instead of an
+opaque TypeError deep in a task submission).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, List, Optional, Set, Tuple
+
+import cloudpickle
+
+
+class FailureTuple:
+    """One unserializable leaf: the object, its name, and its parent."""
+
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailureTuple(obj={self.obj!r}, name={self.name!r})"
+
+
+def _is_serializable(obj: Any) -> bool:
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:  # noqa: BLE001 — any failure means "no"
+        return False
+
+
+def _inspect(obj: Any, name: str, parent: Any, depth: int,
+             failures: List[FailureTuple], seen: dict,
+             printer) -> bool:
+    """Returns True when `obj` serializes. Otherwise records the
+    deepest failing members. `seen` caches each visited object's
+    verdict — a second path to a known-bad object must still report
+    False (not masquerade as fine) or its container gets blamed."""
+    if id(obj) in seen:
+        return seen[id(obj)]
+    ok = _is_serializable(obj)
+    seen[id(obj)] = ok
+    if ok:
+        return True
+    printer(f"{'  ' * depth}Checking {name!r} "
+            f"({type(obj).__name__}): FAILED")
+
+    found_deeper = False
+    # Closures of functions.
+    if inspect.isfunction(obj):
+        closure = getattr(obj, "__closure__", None) or ()
+        names = (obj.__code__.co_freevars
+                 if hasattr(obj, "__code__") else ())
+        for cell_name, cell in zip(names, closure):
+            try:
+                inner = cell.cell_contents
+            except ValueError:
+                continue
+            if not _inspect(inner, cell_name, obj, depth + 1, failures,
+                            seen, printer):
+                found_deeper = True
+        g = getattr(obj, "__globals__", {})
+        for gname in getattr(obj, "__code__").co_names \
+                if hasattr(obj, "__code__") else ():
+            if gname in g and not _is_serializable(g[gname]):
+                if not _inspect(g[gname], gname, obj, depth + 1,
+                                failures, seen, printer):
+                    found_deeper = True
+    # Instance attributes.
+    elif hasattr(obj, "__dict__") and isinstance(obj.__dict__, dict):
+        for aname, aval in obj.__dict__.items():
+            if not _is_serializable(aval):
+                if not _inspect(aval, f"{name}.{aname}", obj, depth + 1,
+                                failures, seen, printer):
+                    found_deeper = True
+    elif isinstance(obj, (list, tuple, set)):
+        for i, item in enumerate(obj):
+            if not _is_serializable(item):
+                if not _inspect(item, f"{name}[{i}]", obj, depth + 1,
+                                failures, seen, printer):
+                    found_deeper = True
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            if not _is_serializable(v):
+                if not _inspect(v, f"{name}[{k!r}]", obj, depth + 1,
+                                failures, seen, printer):
+                    found_deeper = True
+
+    if not found_deeper:
+        # This object itself is the leaf cause.
+        failures.append(FailureTuple(obj, name, parent))
+    return False
+
+
+def inspect_serializability(obj: Any, name: Optional[str] = None,
+                            *, print_file=None
+                            ) -> Tuple[bool, Set[FailureTuple]]:
+    """Returns (serializable, failure_set); prints a trace of which
+    nested members fail (reference: inspect_serializability)."""
+    name = name or getattr(obj, "__qualname__", type(obj).__name__)
+
+    def printer(msg):
+        print(msg, file=print_file)
+
+    failures: List[FailureTuple] = []
+    ok = _inspect(obj, name, None, 0, failures, {}, printer)
+    if ok:
+        printer(f"{name!r} is serializable.")
+    else:
+        for f in failures:
+            printer(f"  blocker: {f.name!r} = {f.obj!r}")
+    return ok, set(failures)

@@ -7,18 +7,25 @@ DAGs).
 """
 
 from .api import (
+    CANCELED,
     FAILED,
     RESUMABLE,
     RUNNING,
     SUCCESSFUL,
+    cancel,
     delete,
+    get_metadata,
     get_output,
+    get_output_async,
     get_status,
     init,
     list_all,
     resume,
+    resume_all,
+    resume_async,
     run,
     run_async,
+    sleep,
     wait_for_event,
 )
 from .event import (EventListener, HTTPEventProvider,
@@ -26,9 +33,10 @@ from .event import (EventListener, HTTPEventProvider,
 from .storage import WorkflowStorage
 
 __all__ = [
-    "run", "run_async", "resume", "get_output", "get_status", "list_all",
-    "delete", "init", "wait_for_event", "EventListener", "TimerListener",
-    "HTTPEventProvider",
+    "run", "run_async", "resume", "resume_async", "resume_all",
+    "get_output", "get_output_async", "get_status", "get_metadata",
+    "list_all", "delete", "cancel", "sleep", "init", "wait_for_event",
+    "EventListener", "TimerListener", "HTTPEventProvider",
     "QueueEventProvider", "WorkflowStorage", "RUNNING", "SUCCESSFUL",
-    "FAILED", "RESUMABLE",
+    "FAILED", "RESUMABLE", "CANCELED",
 ]

@@ -10,15 +10,16 @@ crash-resumable DAG execution. A workflow is a ray_tpu DAG built with
 
 from __future__ import annotations
 
-import pickle
 import threading
 import uuid
+
+import cloudpickle as pickle  # locally-defined DAG fns must persist
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..dag.node import DAGNode
 from .event import EventListener, TimerListener
-from .executor import WorkflowExecutor
+from .executor import WorkflowCanceled, WorkflowExecutor
 from .storage import WorkflowStorage
 
 # Workflow status values (parity with the reference's WorkflowStatus).
@@ -26,6 +27,7 @@ RUNNING = "RUNNING"
 SUCCESSFUL = "SUCCESSFUL"
 FAILED = "FAILED"
 RESUMABLE = "RESUMABLE"
+CANCELED = "CANCELED"
 
 _default_storage: Optional[WorkflowStorage] = None
 _lock = threading.Lock()
@@ -50,6 +52,10 @@ def run(dag: DAGNode, *args, workflow_id: Optional[str] = None) -> Any:
     """Execute a DAG durably; returns the final result."""
     store = _storage()
     wid = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
+    if store.get_status(wid) == CANCELED:
+        # cancel() may land between run_async() and here; a canceled id
+        # stays canceled until explicitly delete()d.
+        raise WorkflowCanceled(wid)
     try:
         store.save_dag(wid, pickle.dumps((dag, args)))
     except Exception:  # noqa: BLE001 — unpicklable DAGs still run
@@ -57,9 +63,21 @@ def run(dag: DAGNode, *args, workflow_id: Optional[str] = None) -> Any:
     store.set_status(wid, RUNNING)
     try:
         result = WorkflowExecutor(store, wid).execute(dag, *args)
-    except Exception:
-        store.set_status(wid, RESUMABLE)
+    except WorkflowCanceled:
+        # cancel() already set CANCELED; don't downgrade to RESUMABLE.
         raise
+    except Exception as e:
+        # Reference semantics: application errors (a task raised) are
+        # FAILED; infrastructure interruptions are RESUMABLE.
+        from ..core.exceptions import TaskError
+
+        store.set_status(
+            wid, FAILED if isinstance(e, TaskError) else RESUMABLE)
+        raise
+    if store.get_status(wid) == CANCELED:
+        # cancel() landed while the final step was executing: the
+        # cancellation wins; no output is recorded.
+        raise WorkflowCanceled(wid)
     store.save_output(wid, result)
     store.set_status(wid, SUCCESSFUL)
     return result
@@ -122,3 +140,102 @@ def wait_for_event(listener: EventListener, timeout: Optional[float] = None
     """Block a workflow step on an external event (reference:
     workflow/api.py wait_for_event + event_listener.py)."""
     return listener.poll_for_event(timeout)
+
+
+def resume_async(workflow_id: str) -> Future:
+    """(reference: workflow/api.py resume_async :271)."""
+    fut: Future = Future()
+
+    def target():
+        try:
+            fut.set_result(resume(workflow_id))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=target, daemon=True).start()
+    return fut
+
+
+def resume_all(include_failed: bool = False
+               ) -> List[Tuple[str, Future]]:
+    """Resume every interrupted workflow (reference: workflow/api.py
+    resume_all :499). Covers RESUMABLE plus workflows stuck RUNNING
+    with no output — a hard crash (kill -9, power loss) never gets to
+    write RESUMABLE, so stale-RUNNING is the normal crash signature.
+    Only call after confirming no other process is still driving them.
+    include_failed adds FAILED (application-error) workflows."""
+    store = _storage()
+    states = {RESUMABLE}
+    if include_failed:
+        states.add(FAILED)
+    out = []
+    for wid, status in list_all():
+        stale_running = (status == RUNNING
+                         and not store.has_output(wid))
+        if status in states or stale_running:
+            out.append((wid, resume_async(wid)))
+    return out
+
+
+def get_output_async(workflow_id: str) -> Future:
+    """(reference: workflow/api.py get_output_async :350). Waits for a
+    RUNNING workflow to finish rather than raising."""
+    import time as _time
+
+    fut: Future = Future()
+
+    def target():
+        try:
+            store = _storage()
+            while (not store.has_output(workflow_id)
+                   and store.get_status(workflow_id) == RUNNING):
+                _time.sleep(0.05)
+            fut.set_result(get_output(workflow_id))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=target, daemon=True).start()
+    return fut
+
+
+def cancel(workflow_id: str) -> None:
+    """Mark a workflow CANCELED; its executor stops before the next
+    step (reference: workflow/api.py cancel :709 — checkpointed state
+    is kept, unlike delete). Terminal workflows cannot be canceled."""
+    store = _storage()
+    status = store.get_status(workflow_id)
+    if status is None:
+        raise ValueError(f"workflow {workflow_id!r} not found")
+    if status in (SUCCESSFUL, CANCELED):
+        raise ValueError(
+            f"workflow {workflow_id!r} is {status}; cannot cancel")
+    store.set_status(workflow_id, CANCELED)
+
+
+def get_metadata(workflow_id: str) -> Dict[str, Any]:
+    """Status + per-step checkpoint info (reference: workflow/api.py
+    get_metadata :646)."""
+    store = _storage()
+    status = store.get_status(workflow_id)
+    if status is None:
+        raise ValueError(f"workflow {workflow_id!r} not found")
+    return {
+        "workflow_id": workflow_id,
+        "status": status,
+        "steps_checkpointed": store.list_steps(workflow_id),
+        "has_output": store.has_output(workflow_id),
+    }
+
+
+def sleep(duration: float) -> DAGNode:
+    """A bindable step that blocks the workflow for `duration` seconds
+    (reference: workflow/api.py sleep :632 — returns a DAG node so the
+    timer participates in the durable DAG)."""
+    from .. import remote
+
+    @remote
+    def _workflow_sleep(d: float) -> float:
+        wait_for_event(TimerListener(d))
+        return d
+
+    return _workflow_sleep.bind(duration)

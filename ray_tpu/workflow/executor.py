@@ -47,6 +47,10 @@ def step_key(node: DAGNode, dep_keys: Dict[int, str]) -> str:
     return hashlib.sha1("|".join(parts).encode()).hexdigest()[:20]
 
 
+class WorkflowCanceled(Exception):
+    """Raised inside a run when cancel() flipped the workflow state."""
+
+
 class WorkflowExecutor:
     def __init__(self, storage: WorkflowStorage, workflow_id: str):
         self.storage = storage
@@ -75,6 +79,11 @@ class WorkflowExecutor:
             elif self.storage.has_step(self.workflow_id, key):
                 value = self.storage.load_step(self.workflow_id, key)
             elif isinstance(node, FunctionNode):
+                # Cancellation gate: checked before every fresh step
+                # (reference: cancel marks the state; checkpointed
+                # steps stay for a later resume).
+                if self.storage.get_status(self.workflow_id) == "CANCELED":
+                    raise WorkflowCanceled(self.workflow_id)
                 args = tuple(
                     results[id(a)] if isinstance(a, DAGNode) else a
                     for a in node._bound_args)

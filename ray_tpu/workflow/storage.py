@@ -71,6 +71,15 @@ class WorkflowStorage:
     def has_step(self, workflow_id: str, step_key: str) -> bool:
         return os.path.exists(self._step_path(workflow_id, step_key))
 
+    def list_steps(self, workflow_id: str) -> List[str]:
+        """Checkpointed step keys (reference: get_metadata surface).
+        The workflow-level output record is not a step."""
+        d = os.path.join(self._wf_dir(workflow_id), "steps")
+        if not os.path.isdir(d):
+            return []
+        return sorted(f[:-4] for f in os.listdir(d)
+                      if f.endswith(".pkl") and f != "__output__.pkl")
+
     def save_step(self, workflow_id: str, step_key: str,
                   result: Any) -> None:
         p = self._step_path(workflow_id, step_key)

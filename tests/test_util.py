@@ -210,3 +210,76 @@ class TestReviewRegressions:
         q.put_nowait_batch([3])
         assert [q.get_nowait() for _ in range(3)] == [1, 2, 3]
         q.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# inspect_serializability (reference: ray.util.check_serialize)
+# ---------------------------------------------------------------------------
+
+def test_inspect_serializability_finds_blocker():
+    import io
+    import threading
+
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    lock = threading.Lock()
+
+    def captures_lock():
+        return lock
+
+    buf = io.StringIO()
+    ok, failures = inspect_serializability(
+        captures_lock, print_file=buf)
+    assert not ok
+    assert any("lock" in repr(f.obj).lower() for f in failures)
+    assert "FAILED" in buf.getvalue()
+
+
+def test_inspect_serializability_clean_object():
+    import io
+
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    ok, failures = inspect_serializability(
+        {"a": [1, 2, 3]}, name="data", print_file=io.StringIO())
+    assert ok and not failures
+
+
+def test_inspect_serializability_nested_attr():
+    import io
+    import threading
+
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    class Holder:
+        def __init__(self):
+            self.fine = 42
+            self.bad = threading.Lock()
+
+    ok, failures = inspect_serializability(
+        Holder(), name="holder", print_file=io.StringIO())
+    assert not ok
+    assert any(".bad" in f.name for f in failures)
+
+
+def test_inspect_serializability_shared_blocker():
+    """A second path to the same unserializable object must not blame
+    its container."""
+    import io
+    import threading
+
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    lock = threading.Lock()
+
+    class Holder:
+        def __init__(self):
+            self.a = lock
+            self.b = [lock]
+
+    ok, failures = inspect_serializability(
+        Holder(), name="holder", print_file=io.StringIO())
+    assert not ok
+    # The lock (not the list in .b) is reported as a blocker.
+    assert any(isinstance(f.obj, type(lock)) for f in failures)
+    assert not any(isinstance(f.obj, list) for f in failures)

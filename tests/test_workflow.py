@@ -75,7 +75,9 @@ def test_resume_skips_completed(wf, ray_start, tmp_path):
     dag = flaky.bind(step_a.bind())
     with pytest.raises(Exception):
         wf.run(dag, workflow_id="crashy")
-    assert wf.get_status("crashy") == wf.RESUMABLE
+    # A task raising = application error → FAILED (RESUMABLE is for
+    # infrastructure interruptions); both resume the same way.
+    assert wf.get_status("crashy") == wf.FAILED
     assert counts == {"a": 1, "b": 1}
 
     marker.unlink()
@@ -203,3 +205,153 @@ class TestHTTPEvents:
                 timeout=5) == {"n": 1}
         finally:
             provider.stop()
+
+
+# ---------------------------------------------------------------------------
+# cancel / metadata / resume_all / sleep (reference: api.py cancel :709,
+# get_metadata :646, resume_all :499, sleep :632)
+# ---------------------------------------------------------------------------
+
+def test_cancel_stops_before_next_step(wf, ray_start):
+    from ray_tpu import remote, workflow
+
+    started = threading.Event()
+    release = threading.Event()
+
+    @remote
+    def slow_first():
+        started.set()
+        release.wait(20)
+        return 1
+
+    @remote
+    def second(x):
+        return x + 1
+
+    dag = second.bind(slow_first.bind())
+    fut = workflow.run_async(dag, workflow_id="wf-cancel")
+    assert started.wait(10)
+    workflow.cancel("wf-cancel")
+    release.set()
+    with pytest.raises(Exception):
+        fut.result(timeout=20)
+    assert workflow.get_status("wf-cancel") == workflow.CANCELED
+    # Checkpointed state is retained (unlike delete).
+    meta = workflow.get_metadata("wf-cancel")
+    assert meta["status"] == workflow.CANCELED
+    assert len(meta["steps_checkpointed"]) == 1  # slow_first committed
+
+
+def test_get_metadata_and_output_async(wf, ray_start):
+    from ray_tpu import remote, workflow
+
+    @remote
+    def f():
+        return 41
+
+    @remote
+    def g(x):
+        return x + 1
+
+    workflow.run(g.bind(f.bind()), workflow_id="wf-meta")
+    meta = workflow.get_metadata("wf-meta")
+    assert meta["has_output"] and len(meta["steps_checkpointed"]) == 2
+    assert workflow.get_output_async("wf-meta").result(timeout=10) == 42
+    with pytest.raises(ValueError):
+        workflow.get_metadata("no-such-wf")
+
+
+def test_resume_all(wf, ray_start, tmp_path):
+    from ray_tpu import remote, workflow
+
+    # Persisted DAGs replay the pickled closure, so fail-once state must
+    # live OUTSIDE the process (the standard crash-recovery shape).
+    flag_file = tmp_path / "fail-once"
+    flag_file.write_text("fail")
+
+    @remote
+    def flaky(path):
+        import os
+
+        if os.path.exists(path):
+            raise RuntimeError("first attempt fails")
+        return "ok"
+
+    for wid in ("wf-ra-1", "wf-ra-2"):
+        with pytest.raises(Exception):
+            workflow.run(flaky.bind(str(flag_file)), workflow_id=wid)
+        # A task raising is an application error → FAILED.
+        assert workflow.get_status(wid) == workflow.FAILED
+
+    flag_file.unlink()
+    assert workflow.resume_all() == []  # FAILED needs the opt-in
+    resumed = workflow.resume_all(include_failed=True)
+    assert {wid for wid, _ in resumed} == {"wf-ra-1", "wf-ra-2"}
+    for _, fut in resumed:
+        assert fut.result(timeout=20) == "ok"
+
+
+def test_workflow_sleep_step(wf, ray_start):
+    from ray_tpu import remote, workflow
+
+    @remote
+    def after(x):
+        return "woke"
+
+    t0 = time.monotonic()
+    out = workflow.run(after.bind(workflow.sleep(0.3)),
+                       workflow_id="wf-sleep")
+    assert out == "woke"
+    assert time.monotonic() - t0 >= 0.3
+
+
+def test_task_error_marks_failed_and_include_failed(wf, ray_start):
+    """Application errors → FAILED (reference WorkflowStatus), resumed
+    only with include_failed=True."""
+    from ray_tpu import remote, workflow
+
+    @remote
+    def boom():
+        raise ValueError("app error")
+
+    with pytest.raises(Exception):
+        workflow.run(boom.bind(), workflow_id="wf-fail")
+    assert workflow.get_status("wf-fail") == workflow.FAILED
+    assert workflow.resume_all(include_failed=False) == []
+    resumed = workflow.resume_all(include_failed=True)
+    assert [w for w, _ in resumed] == ["wf-fail"]
+    with pytest.raises(Exception):
+        resumed[0][1].result(timeout=20)
+
+
+def test_cancel_terminal_rejected(wf, ray_start):
+    from ray_tpu import remote, workflow
+
+    @remote
+    def f():
+        return 1
+
+    workflow.run(f.bind(), workflow_id="wf-done")
+    with pytest.raises(ValueError, match="SUCCESSFUL"):
+        workflow.cancel("wf-done")
+    assert workflow.get_status("wf-done") == workflow.SUCCESSFUL
+
+
+def test_resume_all_recovers_stale_running(wf, ray_start):
+    """Hard crashes leave RUNNING with no output — resume_all treats
+    that as the crash signature."""
+    from ray_tpu import remote, workflow
+    from ray_tpu.workflow.api import _storage
+
+    @remote
+    def f():
+        return 7
+
+    # Simulate a kill -9: persisted dag + RUNNING status, no output.
+    import cloudpickle
+    store = _storage()
+    store.save_dag("wf-stale", cloudpickle.dumps((f.bind(), ())))
+    store.set_status("wf-stale", workflow.RUNNING)
+    resumed = workflow.resume_all()
+    assert [w for w, _ in resumed] == ["wf-stale"]
+    assert resumed[0][1].result(timeout=20) == 7
