@@ -110,19 +110,30 @@ class ShmStore:
 
     # -- immutable objects ------------------------------------------------
     def put(self, object_id: bytes, data: bytes | memoryview) -> None:
+        self.put_frames(object_id, [data])
+
+    def put_frames(self, object_id: bytes, parts) -> None:
+        """Write a list of bytes-like parts as one object — each part
+        memcpy'd straight into the arena (no host-side join)."""
         assert len(object_id) == ID_LEN
+        parts = list(parts)  # sized twice below; generators must not drain
+        total = sum(len(p) for p in parts)
         off = ctypes.c_uint64()
-        rc = lib().rts_create(self._h(), object_id, len(data),
+        rc = lib().rts_create(self._h(), object_id, total,
                               ctypes.byref(off))
         if rc == -1:
             raise ObjectExistsError(object_id.hex())
         if rc == -2:
             raise StoreFullError(
-                f"{len(data)} bytes do not fit "
+                f"{total} bytes do not fit "
                 f"(used {self.used()}/{self.capacity()})")
         if rc != 0:
             raise ShmStoreError(f"create failed rc={rc}")
-        self._map[off.value:off.value + len(data)] = bytes(data)
+        pos = off.value
+        for p in parts:
+            n = len(p)
+            self._map[pos:pos + n] = p
+            pos += n
         if lib().rts_seal(self._h(), object_id) != 0:
             raise ShmStoreError("seal failed")
 

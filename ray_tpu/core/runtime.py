@@ -790,13 +790,18 @@ class Runtime:
         if (self.shm is not None and not is_error
                 and data.total_bytes() > config.inline_object_max_bytes):
             try:
-                self.shm.put(oid.binary(), data.to_bytes())
+                # frames() parts are memcpy'd straight into the arena —
+                # the single copy this path needs.
+                self.shm.put_frames(oid.binary(), data.frames())
                 self.store.put(oid, _ShmMarker(oid.binary()),
                                is_error=False)
                 return
             except Exception:  # noqa: BLE001 — full/duplicate: keep inline
                 pass
-        self.store.put(oid, data, is_error=is_error)
+        # The memory store RETAINS the object: materialize any borrowed
+        # buffer views first or a later caller-side mutation (e.g. the
+        # task reusing its result array) would corrupt the store.
+        self.store.put(oid, data.ensure_owned(), is_error=is_error)
 
     def _load_data(self, stored) -> "serialization.SerializedObject":
         """Resolve a stored entry, pulling shm-resident payloads back as

@@ -9,7 +9,6 @@ inside arguments are tracked for distributed refcounting (borrowing).
 
 from __future__ import annotations
 
-import io
 import pickle
 import threading
 from typing import Any, Callable, List, Optional, Tuple
@@ -19,7 +18,15 @@ import numpy as np
 
 
 class SerializedObject:
-    """A serialized value: a pickle stream plus raw out-of-band buffers."""
+    """A serialized value: a pickle stream plus raw out-of-band buffers.
+
+    Buffers may be zero-copy memoryviews of the CALLER's memory (numpy
+    arrays etc.) — consumers must either copy them out within the
+    originating call (shm/socket/spill writes do) or call
+    `ensure_owned()` before retaining the object (the in-process memory
+    store does), otherwise a later caller-side mutation would corrupt
+    the stored value.
+    """
 
     __slots__ = ("payload", "buffers", "contained_refs")
 
@@ -32,19 +39,34 @@ class SerializedObject:
     def total_bytes(self) -> int:
         return len(self.payload) + sum(len(b) for b in self.buffers)
 
-    def to_bytes(self) -> bytes:
-        """Flatten to a single contiguous frame (for shared-memory storage).
+    def ensure_owned(self) -> "SerializedObject":
+        """Materialize borrowed buffer views into owned bytes
+        (idempotent; one copy per borrowed buffer)."""
+        self.buffers = [b if isinstance(b, bytes) else bytes(b)
+                        for b in self.buffers]
+        return self
 
-        Layout: [4B nbuf][8B len payload][payload][8B len buf0][buf0]...
-        """
-        out = io.BytesIO()
-        out.write(len(self.buffers).to_bytes(4, "little"))
-        out.write(len(self.payload).to_bytes(8, "little"))
-        out.write(self.payload)
+    def frames(self) -> List[Any]:
+        """The flat-frame parts (same layout as to_bytes) WITHOUT
+        joining — lets writers copy straight into their destination
+        (shm arena, socket) with a single memcpy per part."""
+        parts: List[Any] = [
+            len(self.buffers).to_bytes(4, "little"),
+            len(self.payload).to_bytes(8, "little"),
+            self.payload,
+        ]
         for b in self.buffers:
-            out.write(len(b).to_bytes(8, "little"))
-            out.write(b)
-        return out.getvalue()
+            parts.append(len(b).to_bytes(8, "little"))
+            parts.append(b)
+        return parts
+
+    def to_bytes(self) -> bytes:
+        """Flatten to a single contiguous frame (for spill files and
+        socket sends). Layout: [4B nbuf][8B len payload][payload]
+        [8B len buf0][buf0]... (join copies each part exactly once —
+        memoryview parts are buffer-protocol inputs, not pre-copied).
+        """
+        return b"".join(self.frames())
 
     @classmethod
     def from_bytes(cls, data: memoryview | bytes) -> "SerializedObject":
@@ -88,7 +110,10 @@ class SerializationContext:
             payload = cloudpickle.dumps(
                 value, protocol=5, buffer_callback=buffer_callback
             )
-            raw = [bytes(b.raw()) for b in buffers]
+            # Zero-copy: raw views of the value's own buffers. Retainers
+            # call ensure_owned(); immediate writers (shm/socket/spill)
+            # copy exactly once, into their destination.
+            raw = [b.raw() for b in buffers]
             return SerializedObject(payload, raw, list(self._local.captured_refs))
         finally:
             self._local.captured_refs = None

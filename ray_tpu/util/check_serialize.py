@@ -51,7 +51,17 @@ def _inspect(obj: Any, name: str, parent: Any, depth: int,
     printer(f"{'  ' * depth}Checking {name!r} "
             f"({type(obj).__name__}): FAILED")
 
+    # _inspect checks each member's serializability itself (and caches
+    # the verdict) — no pre-filtering, or every failing member would be
+    # pickled twice per level.
     found_deeper = False
+
+    def member(inner, inner_name):
+        nonlocal found_deeper
+        if not _inspect(inner, inner_name, obj, depth + 1, failures,
+                        seen, printer):
+            found_deeper = True
+
     # Closures of functions.
     if inspect.isfunction(obj):
         closure = getattr(obj, "__closure__", None) or ()
@@ -62,35 +72,22 @@ def _inspect(obj: Any, name: str, parent: Any, depth: int,
                 inner = cell.cell_contents
             except ValueError:
                 continue
-            if not _inspect(inner, cell_name, obj, depth + 1, failures,
-                            seen, printer):
-                found_deeper = True
+            member(inner, cell_name)
         g = getattr(obj, "__globals__", {})
         for gname in getattr(obj, "__code__").co_names \
                 if hasattr(obj, "__code__") else ():
-            if gname in g and not _is_serializable(g[gname]):
-                if not _inspect(g[gname], gname, obj, depth + 1,
-                                failures, seen, printer):
-                    found_deeper = True
+            if gname in g:
+                member(g[gname], gname)
     # Instance attributes.
     elif hasattr(obj, "__dict__") and isinstance(obj.__dict__, dict):
         for aname, aval in obj.__dict__.items():
-            if not _is_serializable(aval):
-                if not _inspect(aval, f"{name}.{aname}", obj, depth + 1,
-                                failures, seen, printer):
-                    found_deeper = True
+            member(aval, f"{name}.{aname}")
     elif isinstance(obj, (list, tuple, set)):
         for i, item in enumerate(obj):
-            if not _is_serializable(item):
-                if not _inspect(item, f"{name}[{i}]", obj, depth + 1,
-                                failures, seen, printer):
-                    found_deeper = True
+            member(item, f"{name}[{i}]")
     elif isinstance(obj, dict):
         for k, v in obj.items():
-            if not _is_serializable(v):
-                if not _inspect(v, f"{name}[{k!r}]", obj, depth + 1,
-                                failures, seen, printer):
-                    found_deeper = True
+            member(v, f"{name}[{k!r}]")
 
     if not found_deeper:
         # This object itself is the leaf cause.

@@ -31,6 +31,11 @@ CANCELED = "CANCELED"
 
 _default_storage: Optional[WorkflowStorage] = None
 _lock = threading.Lock()
+# Workflow ids being driven by THIS process (guards resume_all's
+# stale-RUNNING heuristic and the cancel-vs-start status race; the
+# status file itself has no compare-and-swap).
+_active_local: set = set()
+_status_lock = threading.Lock()
 
 
 def init(storage_root: Optional[str] = None) -> None:
@@ -52,17 +57,29 @@ def run(dag: DAGNode, *args, workflow_id: Optional[str] = None) -> Any:
     """Execute a DAG durably; returns the final result."""
     store = _storage()
     wid = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
-    if store.get_status(wid) == CANCELED:
-        # cancel() may land between run_async() and here; a canceled id
-        # stays canceled until explicitly delete()d.
-        raise WorkflowCanceled(wid)
     try:
         store.save_dag(wid, pickle.dumps((dag, args)))
     except Exception:  # noqa: BLE001 — unpicklable DAGs still run
         pass
-    store.set_status(wid, RUNNING)
+    # Check-and-set under the same lock cancel() takes, so a cancel
+    # landing between the check and the RUNNING write cannot be erased
+    # (same-process; the file store has no cross-process CAS).
+    with _status_lock:
+        if store.get_status(wid) == CANCELED:
+            # A canceled id stays canceled until explicitly delete()d.
+            raise WorkflowCanceled(wid)
+        store.set_status(wid, RUNNING)
+        _active_local.add(wid)
     try:
         result = WorkflowExecutor(store, wid).execute(dag, *args)
+        with _status_lock:
+            if store.get_status(wid) == CANCELED:
+                # cancel() landed while the final step was executing:
+                # the cancellation wins; no output is recorded.
+                raise WorkflowCanceled(wid)
+            store.save_output(wid, result)
+            store.set_status(wid, SUCCESSFUL)
+        return result
     except WorkflowCanceled:
         # cancel() already set CANCELED; don't downgrade to RESUMABLE.
         raise
@@ -74,13 +91,9 @@ def run(dag: DAGNode, *args, workflow_id: Optional[str] = None) -> Any:
         store.set_status(
             wid, FAILED if isinstance(e, TaskError) else RESUMABLE)
         raise
-    if store.get_status(wid) == CANCELED:
-        # cancel() landed while the final step was executing: the
-        # cancellation wins; no output is recorded.
-        raise WorkflowCanceled(wid)
-    store.save_output(wid, result)
-    store.set_status(wid, SUCCESSFUL)
-    return result
+    finally:
+        with _status_lock:
+            _active_local.discard(wid)
 
 
 def run_async(dag: DAGNode, *args,
@@ -169,8 +182,12 @@ def resume_all(include_failed: bool = False
     if include_failed:
         states.add(FAILED)
     out = []
+    with _status_lock:
+        active = set(_active_local)
     for wid, status in list_all():
-        stale_running = (status == RUNNING
+        # RUNNING ids driven by THIS process are live, not stale —
+        # resuming them would double-execute their steps.
+        stale_running = (status == RUNNING and wid not in active
                          and not store.has_output(wid))
         if status in states or stale_running:
             out.append((wid, resume_async(wid)))
@@ -187,8 +204,21 @@ def get_output_async(workflow_id: str) -> Future:
     def target():
         try:
             store = _storage()
-            while (not store.has_output(workflow_id)
-                   and store.get_status(workflow_id) == RUNNING):
+            # status None = run_async's thread hasn't written RUNNING
+            # yet (save_dag runs first) — give it a startup grace so a
+            # get_output_async issued right after run_async waits
+            # instead of failing; beyond the grace, None means the id
+            # doesn't exist.
+            grace_deadline = _time.monotonic() + 5.0
+            while not store.has_output(workflow_id):
+                status = store.get_status(workflow_id)
+                if status == RUNNING:
+                    pass
+                elif status is None:
+                    if _time.monotonic() >= grace_deadline:
+                        break
+                else:
+                    break
                 _time.sleep(0.05)
             fut.set_result(get_output(workflow_id))
         except BaseException as e:  # noqa: BLE001
@@ -203,13 +233,14 @@ def cancel(workflow_id: str) -> None:
     step (reference: workflow/api.py cancel :709 — checkpointed state
     is kept, unlike delete). Terminal workflows cannot be canceled."""
     store = _storage()
-    status = store.get_status(workflow_id)
-    if status is None:
-        raise ValueError(f"workflow {workflow_id!r} not found")
-    if status in (SUCCESSFUL, CANCELED):
-        raise ValueError(
-            f"workflow {workflow_id!r} is {status}; cannot cancel")
-    store.set_status(workflow_id, CANCELED)
+    with _status_lock:
+        status = store.get_status(workflow_id)
+        if status is None:
+            raise ValueError(f"workflow {workflow_id!r} not found")
+        if status in (SUCCESSFUL, CANCELED):
+            raise ValueError(
+                f"workflow {workflow_id!r} is {status}; cannot cancel")
+        store.set_status(workflow_id, CANCELED)
 
 
 def get_metadata(workflow_id: str) -> Dict[str, Any]:
