@@ -81,6 +81,37 @@ class DashboardServer:
             return web.Response(text=metrics_mod.prometheus_text(),
                                 content_type="text/plain")
 
+        async def node_stats(_):
+            # Host-level psutil stats (reference: dashboard
+            # modules/reporter — per-node agent stats via psutil).
+            # Degrades to {"available": false} rather than 500ing: the
+            # UI fetches this in the same Promise.all as every table.
+            try:
+                import os as _os
+
+                import psutil
+
+                vm = psutil.virtual_memory()
+                du = psutil.disk_usage("/")
+                try:
+                    load = list(_os.getloadavg())
+                except (AttributeError, OSError):
+                    load = []
+                return _json({
+                    "available": True,
+                    "cpu_percent": psutil.cpu_percent(interval=None),
+                    "cpu_count": psutil.cpu_count(),
+                    "mem_total": vm.total,
+                    "mem_used": vm.used,
+                    "mem_percent": vm.percent,
+                    "disk_total": du.total,
+                    "disk_used": du.used,
+                    "disk_percent": du.percent,
+                    "load_avg": load,
+                })
+            except Exception:  # noqa: BLE001 — optional dep/platform
+                return _json({"available": False})
+
         async def submit_job(request):
             body = await request.json()
             job_id = job_manager().submit(
@@ -151,6 +182,7 @@ class DashboardServer:
 
         r.add_post("/api/kill_random_node", kill_random_node)
         r.add_get("/api/timeline", timeline)
+        r.add_get("/api/node_stats", node_stats)
         r.add_get("/metrics", prom_metrics)
         r.add_post("/api/jobs/", submit_job)
         r.add_get("/api/jobs/", list_jobs)

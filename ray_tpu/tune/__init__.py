@@ -20,10 +20,10 @@ from .search import (
     randint,
     uniform,
 )
-from .tuner import ResultGrid, TrialResult, TuneConfig, Tuner
+from .tuner import ResultGrid, TrialResult, TuneConfig, Tuner, run
 
 __all__ = [
-    "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
+    "Tuner", "TuneConfig", "run", "ResultGrid", "TrialResult", "report",
     "get_checkpoint",
     "uniform", "loguniform", "quniform", "randint", "choice", "grid_search",
     "Searcher", "BasicVariantGenerator", "TPESearcher", "GPSearcher",

@@ -340,3 +340,23 @@ class Tuner:
 
         results.sort(key=lambda r: r.trial_id)
         return ResultGrid(results, tc.metric, tc.mode)
+
+
+def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
+        metric: Optional[str] = None, mode: str = "min",
+        num_samples: int = 1, search_alg=None, scheduler=None,
+        max_concurrent_trials: int = 4,
+        name: Optional[str] = None,
+        storage_path: Optional[str] = None) -> "ResultGrid":
+    """Functional entrypoint (reference: tune/tune.py run :234 — the
+    pre-Tuner surface many callers still use). Thin wrapper over Tuner.
+    """
+    rc = RunConfig(name=name or "tune_run", storage_path=storage_path)
+    return Tuner(
+        trainable, param_space=config or {},
+        tune_config=TuneConfig(
+            metric=metric, mode=mode, num_samples=num_samples,
+            search_alg=search_alg, scheduler=scheduler,
+            max_concurrent_trials=max_concurrent_trials),
+        run_config=rc,
+    ).fit()

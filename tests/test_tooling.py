@@ -346,3 +346,19 @@ def test_dashboard_index_page(dashboard, ray_start):
         assert field in node, field
     cs = get("/api/cluster_status")
     assert "resources_total" in cs and "resources_available" in cs
+
+
+def test_dashboard_node_stats(dashboard, ray_start):
+    """Host psutil stats (reference: dashboard modules/reporter)."""
+    import json
+    import urllib.request
+
+    import pytest as _pytest
+
+    _pytest.importorskip("psutil")  # optional dep; endpoint degrades
+    with urllib.request.urlopen(dashboard.address + "/api/node_stats",
+                                timeout=5) as r:
+        stats = json.load(r)
+    assert stats["available"]
+    assert stats["cpu_count"] >= 1
+    assert 0 <= stats["mem_percent"] <= 100

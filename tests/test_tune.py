@@ -177,3 +177,17 @@ def test_tuner_with_real_model(ray_start, tmp_path):
     ).fit()
     assert len(results) == 2
     assert results.get_best_result().error is None
+
+
+def test_tune_run_functional_api(ray_start, tmp_path):
+    """reference: tune/tune.py run :234 — functional entrypoint."""
+    import ray_tpu.tune as tune
+
+    def objective(config):
+        tune.report({"score": config["x"] * 2})
+
+    res = tune.run(objective, config={"x": tune.grid_search([1, 2, 3])},
+                   metric="score", mode="max",
+                   storage_path=str(tmp_path))
+    assert len(res) == 3
+    assert res.get_best_result().metrics["score"] == 6
