@@ -1,0 +1,42 @@
+"""Hyperparameter search over an RL algorithm with Tune.
+
+DQN on GridWorld as a Tune trainable; ASHA stops weak lrs early.
+
+    python examples/tune_rl.py
+"""
+
+import tempfile
+
+import ray_tpu as ray
+import ray_tpu.tune as tune
+from ray_tpu.rl import DQN, DQNConfig
+
+
+def main():
+    ray.init(num_cpus=4, num_tpus=0)
+
+    base = DQNConfig(env="GridWorld", num_env_runners=1,
+                     num_envs_per_runner=8, rollout_length=32,
+                     hidden=(32,), learning_starts=256, batch_size=64,
+                     updates_per_iteration=8, epsilon_decay_iters=10,
+                     train_iterations=15)
+    trainable = DQN.as_trainable(base)
+
+    res = tune.run(
+        trainable,
+        config={"lr": tune.grid_search([3e-4, 1e-3, 3e-3])},
+        metric="episode_return_mean", mode="max",
+        scheduler=tune.ASHAScheduler(
+            metric="episode_return_mean", mode="max", max_t=15,
+            grace_period=5),
+        storage_path=tempfile.mkdtemp(),
+        max_concurrent_trials=1,
+    )
+    best = res.get_best_result()
+    print(f"best lr={best.config['lr']}: "
+          f"return={best.metrics['episode_return_mean']:.2f}")
+    ray.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,14 @@ class DashboardServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 8265):
         self.host = host
         self.port = port
+        # Prime psutil's cpu_percent baseline: its first call per
+        # process always reports 0.0.
+        try:
+            import psutil
+
+            psutil.cpu_percent(interval=None)
+        except Exception:  # noqa: BLE001 — optional dep
+            pass
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()

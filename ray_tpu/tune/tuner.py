@@ -351,7 +351,12 @@ def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
     """Functional entrypoint (reference: tune/tune.py run :234 — the
     pre-Tuner surface many callers still use). Thin wrapper over Tuner.
     """
-    rc = RunConfig(name=name or "tune_run", storage_path=storage_path)
+    import uuid as _uuid
+
+    # Unique default name: concurrent anonymous runs must not share a
+    # storage directory (their experiment_state.json would interleave).
+    rc = RunConfig(name=name or f"tune_run_{_uuid.uuid4().hex[:8]}",
+                   storage_path=storage_path)
     return Tuner(
         trainable, param_space=config or {},
         tune_config=TuneConfig(
