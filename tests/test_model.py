@@ -14,6 +14,7 @@ from ray_tpu.models.transformer import (
 )
 from ray_tpu.parallel import ParallelPlan, make_mesh
 from ray_tpu.train.step import (
+    TrainState,
     init_state,
     make_optimizer,
     make_train_step,
@@ -175,3 +176,68 @@ def test_num_params_accounting():
     reported = cfg.num_params()
     # ~124-163M with the padded vocab — sanity band.
     assert 1.0e8 < reported < 2.0e8
+
+
+class TestFullScaleConfigs:
+    """BASELINE configs 2/3 (Llama-3-8B, Mixtral 8x7B) at their REAL
+    dimensions: abstract evaluation of the sharded train step under a
+    production-shaped plan. jax.eval_shape traces the full program —
+    shape/dtype/sharding-rule consistency at 8B/47B scale — without
+    allocating parameters (single-host CI cannot hold them)."""
+
+    def _abstract_step(self, cfg, plan, cpu_devices):
+        from ray_tpu.parallel.sharding import tree_shardings
+        from ray_tpu.models.transformer import param_logical_axes
+
+        devices = cpu_devices[:plan.num_devices]
+        mesh = make_mesh(plan, devices=devices)
+        opt = make_optimizer(lr=3e-4, warmup_steps=10, total_steps=100)
+        with jax.sharding.set_mesh(mesh):
+            p_axes = param_logical_axes(cfg)
+            tree_shardings(p_axes, mesh)  # sharding rules resolve
+
+            def init_abstract():
+                return init_params(cfg, jax.random.key(0))
+
+            params_shape = jax.eval_shape(init_abstract)
+            step_fn = make_train_step(cfg, opt)
+            B, S = 8, 512
+
+            def full_step(params, tokens, targets, mask):
+                state = TrainState(
+                    step=jnp.zeros((), jnp.int32), params=params,
+                    opt_state=jax.eval_shape(opt.init, params))
+                # Only shapes flow here — eval_shape never executes.
+                return step_fn(state, tokens, targets, mask)
+
+            out = jax.eval_shape(
+                full_step, params_shape,
+                jax.ShapeDtypeStruct((B, S), jnp.int32),
+                jax.ShapeDtypeStruct((B, S), jnp.int32),
+                jax.ShapeDtypeStruct((B, S), jnp.float32))
+        return params_shape, out
+
+    def test_llama3_8b_sharded_step_shapes(self, cpu_mesh8):
+        from ray_tpu.models import configs
+
+        cfg = configs.llama3_8b()
+        n_params = cfg.num_params()
+        assert 7.5e9 < n_params < 8.5e9  # 8B-class
+        params_shape, (state_out, metrics) = self._abstract_step(
+            cfg, ParallelPlan(fsdp=4, tp=2), cpu_mesh8)
+        assert metrics["loss"].shape == ()
+        assert state_out.params["embed"].shape == (
+            cfg.vocab_size, cfg.d_model)
+
+    def test_mixtral_8x7b_sharded_step_shapes(self, cpu_mesh8):
+        from ray_tpu.models import configs
+
+        cfg = configs.mixtral_8x7b()
+        n_params = cfg.num_params()
+        assert 4.4e10 < n_params < 5.0e10  # 8x7B sparse total ≈ 47B
+        params_shape, (state_out, metrics) = self._abstract_step(
+            cfg, ParallelPlan(fsdp=2, ep=2, tp=2), cpu_mesh8)
+        assert metrics["loss"].shape == ()
+        # Expert tensors exist at full dimension in the abstract tree.
+        assert params_shape["layers"]["w_gate"].shape == (
+            cfg.n_layers, cfg.moe_experts, cfg.d_model, cfg.d_ff)
