@@ -915,6 +915,7 @@ class Runtime:
             max_retries=opts.get("max_retries", config.default_max_retries),
             retry_exceptions=opts.get("retry_exceptions", False),
             scheduling_strategy=opts.get("scheduling_strategy"),
+            label_selector=opts.get("label_selector"),
             name=opts.get("name", ""),
             runtime_env=opts.get("runtime_env"),
         )
@@ -996,6 +997,7 @@ class Runtime:
             args=tuple(args), kwargs=dict(kwargs),
             num_returns=0, resources=resources,
             scheduling_strategy=opts.get("scheduling_strategy"),
+            label_selector=opts.get("label_selector"),
             name=name, actor_id=actor_id, actor_class=cls,
             actor_creation_opts=opts,
         )

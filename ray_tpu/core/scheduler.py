@@ -33,6 +33,15 @@ from .task import (
 )
 
 
+def _labels_match(spec, node) -> bool:
+    """Hard node-label constraint: every selector key must equal the
+    node's label (reference: NodeLabelSchedulingPolicy)."""
+    if not spec.label_selector:
+        return True
+    return all(node.labels.get(k) == v
+               for k, v in spec.label_selector.items())
+
+
 def _is_constrained(strategy) -> bool:
     """True only for strategies that free capacity on an arbitrary node
     cannot absorb: hard node/slice affinity and PG bundles. Spread and
@@ -116,8 +125,9 @@ class Scheduler:
         with self._lock:
             out = []
             for t in self._queue + self._infeasible:
-                out.append((t.resources, _is_constrained(
-                    t.scheduling_strategy)))
+                constrained = (_is_constrained(t.scheduling_strategy)
+                               or bool(t.label_selector))
+                out.append((t.resources, constrained))
             return out
 
     # -- scheduling -------------------------------------------------------
@@ -186,7 +196,8 @@ class Scheduler:
 
     def _feasible_anywhere(self, spec: TaskSpec) -> bool:
         return any(
-            spec.resources.fits(n.total) for n in self._nodes.values() if n.alive
+            spec.resources.fits(n.total) and _labels_match(spec, n)
+            for n in self._nodes.values() if n.alive
         )
 
     # -- policies ---------------------------------------------------------
@@ -209,6 +220,8 @@ class Scheduler:
                 node = self._nodes.get(pg._bundle_nodes[i] or "")
                 if node is None or not node.alive:
                     continue
+                if not _labels_match(spec, node):
+                    continue  # hard label constraint applies to bundles
                 if spec.resources.fits(pg._bundle_available[i]):
                     spec._pg_charge = (pg, i)
                     return node
@@ -218,13 +231,15 @@ class Scheduler:
             n for n in self._nodes.values()
             if n.alive and spec.resources.fits(n.available)
         ]
+        fitting = [n for n in fitting if _labels_match(spec, n)]
         if not fitting:
             return None
 
         if isinstance(strat, NodeAffinitySchedulingStrategy):
             node = self._nodes.get(strat.node_id)
-            if node is not None and node.alive and spec.resources.fits(
-                    node.available):
+            if (node is not None and node.alive
+                    and _labels_match(spec, node)
+                    and spec.resources.fits(node.available)):
                 return node
             return self._hybrid(fitting) if strat.soft else None
 

@@ -58,6 +58,14 @@ def validate_options(opts: Dict[str, Any], *, is_actor: bool) -> Dict[str, Any]:
     resources = opts.get("resources")
     if resources is not None and not isinstance(resources, dict):
         raise ValueError("resources must be a dict")
+    ls = opts.get("label_selector")
+    if ls is not None and not (
+            isinstance(ls, dict)
+            and all(isinstance(k, str) and isinstance(v, str)
+                    for k, v in ls.items())):
+        raise ValueError(
+            "label_selector must be a dict of str->str "
+            f"(got {ls!r})")
     if "runtime_env" in opts:
         from .runtime_env import validate as _validate_renv
         _validate_renv(opts["runtime_env"])
@@ -130,6 +138,9 @@ class TaskSpec:
     method_name: Optional[str] = None
     # scheduling
     scheduling_strategy: Optional[SchedulingStrategy] = None
+    # Hard node-label constraint: every key must match the node's label
+    # (reference: NodeLabelSchedulingPolicy / label_selector option).
+    label_selector: Optional[Dict[str, str]] = None
     name: str = ""
     runtime_env: Optional[Dict[str, Any]] = None
     # set for actor-creation tasks
