@@ -62,6 +62,7 @@ def init(address: Optional[str] = None, *,
          num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
          num_worker_procs: int = 0,
+         namespace: Optional[str] = None,
          _system_config: Optional[Dict[str, Any]] = None,
          ignore_reinit_error: bool = True, **_compat) -> None:
     """Start (or connect to) the runtime.
@@ -84,7 +85,7 @@ def init(address: Optional[str] = None, *,
             if ignore_reinit_error:
                 return
             raise RuntimeError("already connected in client mode")
-        _client_mod.connect(address)
+        _client_mod.connect(address, namespace=namespace)
         return
     if _runtime.is_initialized():
         if ignore_reinit_error:
@@ -94,6 +95,8 @@ def init(address: Optional[str] = None, *,
         num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
         num_worker_procs=num_worker_procs,
         _system_config=_system_config)
+    if namespace:
+        _runtime.global_runtime().namespace = namespace
 
 
 def shutdown() -> None:

@@ -20,7 +20,11 @@ from .common import ClientActorRef, ClientObjectRef, recv_msg, send_msg
 
 
 class ClientContext:
-    def __init__(self, host: str, port: int, *, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, *, timeout: float = 10.0,
+                 namespace=None):
+        # Default namespace for named actors created/looked up through
+        # this client session (reference: ray.init(namespace=...)).
+        self.namespace = namespace
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout)
         self._sock.settimeout(None)
@@ -132,11 +136,15 @@ class ClientContext:
     # -- actors ---------------------------------------------------------
     def create_actor(self, cls, args, kwargs, options
                      ) -> "ClientActorHandle":
+        opts = dict(options or {})
+        if opts.get("name") and not opts.get("namespace") \
+                and self.namespace:
+            opts["namespace"] = self.namespace
         req: Dict[str, Any] = {
             "op": "create_actor",
             "args": self._outbound(args),
             "kwargs": self._outbound(kwargs),
-            "options": dict(options or {}),
+            "options": opts,
         }
         req.update(self._payload("cls", cls))
         return ClientActorHandle(self, self._call(req))
@@ -157,9 +165,11 @@ class ClientContext:
         self._call({"op": "kill_actor", "actor_id": actor_id,
                     "no_restart": no_restart})
 
-    def get_named_actor(self, name: str) -> "ClientActorHandle":
+    def get_named_actor(self, name: str,
+                        namespace=None) -> "ClientActorHandle":
         return ClientActorHandle(self, self._call(
-            {"op": "get_named_actor", "name": name}))
+            {"op": "get_named_actor", "name": name,
+             "namespace": namespace or self.namespace}))
 
     # -- introspection --------------------------------------------------
     def cluster_resources(self) -> Dict[str, float]:

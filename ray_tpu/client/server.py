@@ -214,15 +214,18 @@ class ClientServer:
 
         if op == "get_named_actor":
             name = req["name"]
-            handle = ray_tpu.get_actor(name)  # always re-resolve: the
-            # name may now point at a replacement actor.
-            cached = s.named_lookups.get(name)
+            ns = req.get("namespace")
+            handle = ray_tpu.get_actor(name, namespace=ns)
+            # always re-resolve: the name may now point at a
+            # replacement actor. Cache key includes the namespace.
+            key = f"{ns or ''}/{name}"
+            cached = s.named_lookups.get(key)
             if cached is not None and cached in s.actors and \
                     s.actors[cached]._actor_id == handle._actor_id:
                 return cached
             actor_id = uuid.uuid4().hex
             s.actors[actor_id] = handle
-            s.named_lookups[name] = actor_id
+            s.named_lookups[key] = actor_id
             return actor_id
 
         if op == "cancel":

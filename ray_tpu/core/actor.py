@@ -106,10 +106,10 @@ def exit_actor():
     raise _ActorExit()
 
 
-def get_actor(name: str) -> ActorHandle:
+def get_actor(name: str, namespace: "str | None" = None) -> ActorHandle:
     from ..client import get_client
 
     c = get_client()
     if c is not None:
-        return c.get_named_actor(name)
-    return ActorHandle(global_runtime().get_actor(name))
+        return c.get_named_actor(name, namespace)
+    return ActorHandle(global_runtime().get_actor(name, namespace))

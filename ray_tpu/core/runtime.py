@@ -666,7 +666,11 @@ class Runtime:
         self._cancelled: set = set()
         self._generators: Dict[TaskID, _GeneratorState] = {}
         self._actors: Dict[ActorID, ActorState] = {}
+        # Named actors keyed "namespace/name" (reference: namespaces —
+        # names are scoped, usage-guide namespace semantics).
         self._named_actors: Dict[str, ActorID] = {}
+        self._scoped_by_actor: Dict[ActorID, str] = {}
+        self.namespace: str = "default"  # init(namespace=...) overrides
         self._actors_lock = threading.Lock()
         self._ref_registry: Dict[ObjectID, int] = {}
         self._shutdown = False
@@ -706,6 +710,12 @@ class Runtime:
         total = {CPU: num_cpus}
         if num_tpus:
             total[TPU] = num_tpus
+            # Advertise accelerator identity: "TPU-<type>" (+ pod
+            # membership) so accelerator_type= and SliceAffinity route
+            # here (reference: tpu.py accelerator resources).
+            from .._private import accelerators
+
+            total.update(accelerators.pod_resources())
         total.update(resources or {})
         self.head_node_id = "node-head"
         head = NodeState(
@@ -974,15 +984,20 @@ class Runtime:
         name = opts.get("name") or ""
         actor_id = ActorID.of(self.job_id)
         if name:
+            ns = opts.get("namespace") or self.namespace
+            scoped = f"{ns}/{name}"
             # Reserve the name BEFORE starting any threads / holding any
             # resources, so a duplicate-name failure leaks nothing.
             with self._actors_lock:
-                existing = self._named_actors.get(name)
+                existing = self._named_actors.get(scoped)
                 if existing is not None:
                     if opts.get("get_if_exists"):
                         return existing
-                    raise ValueError(f"Actor name {name!r} already taken")
-                self._named_actors[name] = actor_id
+                    raise ValueError(
+                        f"Actor name {name!r} already taken in "
+                        f"namespace {ns!r}")
+                self._named_actors[scoped] = actor_id
+                self._scoped_by_actor[actor_id] = scoped
         resources = build_resources(opts, is_actor=True)
         # Acquire placement synchronously through the scheduler by running
         # the creation as a task that starts the actor threads on a node.
@@ -1029,8 +1044,9 @@ class Runtime:
         if "err" in box:
             if name:
                 with self._actors_lock:
-                    if self._named_actors.get(name) == actor_id:
-                        del self._named_actors[name]
+                    scoped = self._scoped_by_actor.pop(actor_id, None)
+                    if scoped and self._named_actors.get(scoped) == actor_id:
+                        del self._named_actors[scoped]
             raise box["err"]
         return actor_id
 
@@ -1080,11 +1096,15 @@ class Runtime:
             return None
         return refs[0] if num_returns == 1 else refs
 
-    def get_actor(self, name: str) -> ActorID:
+    def get_actor(self, name: str,
+                  namespace: "Optional[str]" = None) -> ActorID:
+        ns = namespace or self.namespace
         with self._actors_lock:
-            aid = self._named_actors.get(name)
+            aid = self._named_actors.get(f"{ns}/{name}")
         if aid is None:
-            raise ValueError(f"Failed to look up actor with name {name!r}")
+            raise ValueError(
+                f"Failed to look up actor with name {name!r} in "
+                f"namespace {ns!r}")
         return aid
 
     def actor_state(self, actor_id: ActorID) -> Optional[ActorState]:
@@ -1100,9 +1120,9 @@ class Runtime:
     def _on_actor_dead(self, st: ActorState):
         self.scheduler.release(st.node.node_id, st.resources)
         with self._actors_lock:
-            if st.name in self._named_actors and \
-                    self._named_actors.get(st.name) == st.actor_id:
-                del self._named_actors[st.name]
+            scoped = self._scoped_by_actor.pop(st.actor_id, None)
+            if scoped and self._named_actors.get(scoped) == st.actor_id:
+                del self._named_actors[scoped]
 
     # ------------------------------------------------------------------
     # Dispatch & execution (normal tasks)

@@ -159,7 +159,18 @@ def build_resources(opts: Dict[str, Any], *, is_actor: bool) -> ResourceSet:
     # semantics: actor methods consume no resources by default; the process
     # holds its creation resources). We model the held resources only.
     default_cpus = 1.0 if not is_actor else 1.0
+    extra = opts.get("resources")
+    acc = opts.get("accelerator_type")
+    if acc:
+        # accelerator_type must be the node's advertised type string
+        # (e.g. "v5litepod-8", what _private/accelerators
+        # accelerator_type() reports) → a sliver of the node's
+        # "TPU-<type>" resource (reference: accelerator_type becomes
+        # an implicit 0.001 accelerator resource; nodes advertise
+        # theirs at Runtime init via accelerators.pod_resources).
+        extra = dict(extra or {})
+        extra.setdefault(f"TPU-{acc}", 0.001)
     return task_resources(
         opts.get("num_cpus"), opts.get("num_tpus"), opts.get("memory"),
-        opts.get("resources"), default_num_cpus=default_cpus,
+        extra, default_num_cpus=default_cpus,
     )

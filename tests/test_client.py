@@ -191,3 +191,20 @@ def test_looked_up_named_actor_survives_disconnect(client_server):
     h = ray_tpu.get_actor("survivor")
     assert ray_tpu.get(h.ping.remote()) == "pong"
     client_mod.disconnect()
+
+
+def test_named_actor_namespaces_via_client(client):
+    """Namespaced names resolve through the client protocol
+    (reference: namespaces work through Ray Client)."""
+    @client.remote
+    class Svc:
+        def tag(self):
+            return "x"
+
+    Svc.options(name="nsvc", namespace="team-a").remote()
+    h = client.get_actor("nsvc", namespace="team-a")
+    assert client.get(h.tag.remote()) == "x"
+    import pytest as _p
+
+    with _p.raises(Exception):
+        client.get_actor("nsvc", namespace="team-b")

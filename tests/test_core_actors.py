@@ -265,3 +265,64 @@ def test_inprocess_actor_runtime_env(ray_start):
     p = Probe.remote()
     assert ray.get(p.read.remote()) == "1"
     assert os.environ.get("INPROC_RT_ENV") is None
+
+
+class TestNamespaces:
+    """Actor-name namespaces (reference: ray namespaces — named actors
+    are visible only within their namespace)."""
+
+    def test_names_scoped_by_namespace(self, ray_start):
+        ray = ray_start
+        from ray_tpu.core.runtime import global_runtime
+
+        @ray.remote
+        class A:
+            def who(self):
+                return "a"
+
+        # Same name in two namespaces coexist.
+        a1 = A.options(name="svc", namespace="team-a").remote()
+        a2 = A.options(name="svc", namespace="team-b").remote()
+        assert ray.get(a1.who.remote()) == "a"
+        h1 = ray.get_actor("svc", namespace="team-a")
+        h2 = ray.get_actor("svc", namespace="team-b")
+        assert h1._actor_id != h2._actor_id
+
+        # Default namespace does not see them.
+        import pytest as _p
+
+        with _p.raises(ValueError, match="namespace"):
+            ray.get_actor("svc")
+
+    def test_duplicate_in_same_namespace_rejected(self, ray_start):
+        ray = ray_start
+
+        @ray.remote
+        class A:
+            def ping(self):
+                return 1
+
+        A.options(name="dup", namespace="x").remote()
+        import pytest as _p
+
+        with _p.raises(ValueError, match="already taken"):
+            A.options(name="dup", namespace="x").remote()
+
+    def test_accelerator_type_resource_constraint(self, ray_start):
+        """accelerator_type option routes to nodes advertising the
+        TPU-<type> resource (reference: implicit accelerator resource)."""
+        ray = ray_start
+        from ray_tpu.core.resources import ResourceSet
+        from ray_tpu.core.runtime import global_runtime
+        from ray_tpu.core.scheduler import NodeState
+
+        rt = global_runtime()
+        node = NodeState("node-v5e-x", ResourceSet(
+            {"CPU": 2.0, "TPU-v5e": 1.0}), max_workers=2)
+        rt.scheduler.add_node(node)
+
+        @ray.remote(accelerator_type="v5e")
+        def where():
+            return ray.get_runtime_context().get_node_id()
+
+        assert ray.get(where.remote()) == "node-v5e-x"
