@@ -222,7 +222,8 @@ class ActorState:
                  args, kwargs, *, node: NodeState, name: str,
                  max_concurrency: int, max_restarts: int,
                  resources: ResourceSet,
-                 runtime_env: Optional[Dict[str, Any]] = None):
+                 runtime_env: Optional[Dict[str, Any]] = None,
+                 max_task_retries: int = 0):
         self.rt = rt
         self.actor_id = actor_id
         self.cls = cls
@@ -233,9 +234,17 @@ class ActorState:
         self.name = name
         self.max_concurrency = max(1, max_concurrency)
         self.max_restarts = max_restarts
+        # Method calls interrupted by a restartable actor death are
+        # re-delivered after the restart up to this many times
+        # (reference: max_task_retries).
+        self.max_task_retries = max_task_retries
         self.restarts = 0
         self.resources = resources
         self.mailbox: "queue.Queue" = queue.Queue(maxsize=config.actor_queue_max)
+        # Crash-interrupted calls re-enter HERE, consumed before the
+        # mailbox — redelivery must not jump behind later submissions
+        # (ordered-delivery contract) and must never block (unbounded).
+        self.redeliver_q: "queue.Queue" = queue.Queue()
         self.dead = threading.Event()
         self.ready = threading.Event()
         self.death_cause: Optional[BaseException] = None
@@ -311,12 +320,15 @@ class ActorState:
             self._death_done = True
         self.dead.set()
         self.ready.set()
-        # Drain mailbox with death errors.
+        # Drain mailbox (+ redelivery queue) with death errors.
         while True:
             try:
-                spec = self.mailbox.get_nowait()
+                spec = self.redeliver_q.get_nowait()
             except queue.Empty:
-                break
+                try:
+                    spec = self.mailbox.get_nowait()
+                except queue.Empty:
+                    break
             if spec is not None:
                 self.rt._store_error(
                     spec,
@@ -347,9 +359,12 @@ class ActorState:
             self.ready.wait()
         while not self.dead.is_set() and gen == self.generation:
             try:
-                spec = self.mailbox.get(timeout=0.1)
+                spec = self.redeliver_q.get_nowait()
             except queue.Empty:
-                continue
+                try:
+                    spec = self.mailbox.get(timeout=0.1)
+                except queue.Empty:
+                    continue
             if spec is None or self.dead.is_set():
                 break
             self._run_method(spec)
@@ -516,6 +531,7 @@ class ProcActorState(ActorState):
     def _run_method(self, spec: TaskSpec):
         from .worker_proc import WorkerCrashedError
 
+        spec.redelivered = False  # fresh delivery (incl. retry passes)
         _ctx.task_id = spec.task_id
         t0 = time.monotonic()
         streaming = spec.num_returns in ("streaming", "dynamic")
@@ -567,16 +583,35 @@ class ProcActorState(ActorState):
                 for oid, packed in zip(spec.return_ids, reply["returns"]):
                     self.rt._store_packed(oid, packed)
         except WorkerCrashedError as e:
-            self.rt._store_error(spec, _wrap(spec, e), t0)
+            left = spec.task_retries_left
+            if left is None:
+                left = self.max_task_retries
+            will_restart = self.restarts < self.max_restarts
             self.death_cause = ActorDiedError(
                 self.actor_id.hex(), f"worker process died: {e}")
             self._restartable_kill = True  # honor max_restarts
+            # -1 = retry forever (reference max_task_retries semantics).
+            # Streaming calls are NOT redelivered: their generator state
+            # already holds delivered items and a rerun would duplicate
+            # them for the consumer.
+            if (left != 0) and will_restart and not streaming:
+                # Re-deliver the interrupted call to the restarted
+                # actor instead of erroring it. The task stays pending
+                # (the finally must not pop it, or a concurrent get()
+                # could lineage-resubmit it).
+                spec.task_retries_left = left - 1 if left > 0 else left
+                spec.redelivered = True
+                self.redeliver_q.put(spec)
+                self.dead.set()
+                return
+            self.rt._store_error(spec, _wrap(spec, e), t0)
             self.dead.set()
         except BaseException as e:  # noqa: BLE001
             self.rt._store_error(spec, _wrap(spec, e), t0)
         finally:
             _ctx.task_id = None
-            self.rt._task_finished(spec)
+            if not spec.redelivered:
+                self.rt._task_finished(spec)
 
     def _die(self, gen: int):
         super()._die(gen)
@@ -1027,6 +1062,7 @@ class Runtime:
                     max_concurrency=opts.get("max_concurrency", 1),
                     max_restarts=opts.get(
                         "max_restarts", config.default_actor_max_restarts),
+                    max_task_retries=opts.get("max_task_retries", 0),
                     resources=resources,
                     runtime_env=opts.get("runtime_env"),
                 )

@@ -133,6 +133,11 @@ class TaskSpec:
     max_retries: int = 0
     retry_exceptions: Any = False  # False | True | list[type]
     retries_left: int = 0
+    # actor-method redelivery (max_task_retries): None = not yet
+    # initialized from the actor's budget; redelivered marks a spec
+    # requeued after a crash (its pending entry must be preserved).
+    task_retries_left: Optional[int] = None
+    redelivered: bool = False
     # actor linkage
     actor_id: Optional[ActorID] = None
     method_name: Optional[str] = None

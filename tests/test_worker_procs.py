@@ -315,3 +315,61 @@ def test_actor_runtime_env_applied(ray_procs):
 
     p = Probe.remote()
     assert ray.get(p.read.remote()) == ("on", "on")
+
+
+def test_max_task_retries_redelivers_after_crash(ray_procs, tmp_path):
+    """An actor method interrupted by a worker crash is re-delivered to
+    the restarted actor up to max_task_retries (reference:
+    max_task_retries semantics)."""
+    ray = ray_procs
+    marker = tmp_path / "crash-once"
+    marker.write_text("x")
+
+    @ray.remote(max_restarts=2, max_task_retries=2,
+                scheduling_strategy=PROC)
+    class Crashy:
+        def work(self, path):
+            import os
+
+            if os.path.exists(path):
+                os.unlink(path)  # crash only the first delivery
+                os._exit(1)
+            return "recovered"
+
+    a = Crashy.remote()
+    assert ray.get(a.work.remote(str(marker)), timeout=60) == "recovered"
+
+
+def test_no_task_retries_errors_on_crash(ray_procs):
+    ray = ray_procs
+
+    @ray.remote(max_restarts=2,  # max_task_retries defaults to 0
+                scheduling_strategy=PROC)
+    class Crashy:
+        def die(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            return "alive"
+
+    a = Crashy.remote()
+    import time as _t
+
+    import pytest as _p
+
+    with _p.raises(Exception):
+        ray.get(a.die.remote(), timeout=60)
+    # The actor itself restarts (max_restarts honored) — but the error
+    # is stored slightly before the restart clears the dead flag, so
+    # tolerate transient ActorDiedError while the restart completes.
+    deadline = _t.monotonic() + 30
+    while True:
+        try:
+            assert ray.get(a.ping.remote(), timeout=60) == "alive"
+            break
+        except Exception:
+            if _t.monotonic() > deadline:
+                raise
+            _t.sleep(0.1)
