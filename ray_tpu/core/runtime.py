@@ -963,6 +963,7 @@ class Runtime:
             label_selector=opts.get("label_selector"),
             name=opts.get("name", ""),
             runtime_env=opts.get("runtime_env"),
+            max_calls=opts.get("max_calls", 0),
         )
         spec.retries_left = spec.max_retries
         gen_state = None
@@ -1270,6 +1271,7 @@ class Runtime:
         t0 = time.monotonic()
         retried = False
         worker = None
+        ran_on_worker = False
         streaming = spec.num_returns in ("streaming", "dynamic")
         gst = self._generators.get(spec.task_id) if streaming else None
         try:
@@ -1292,6 +1294,7 @@ class Runtime:
                         gst.refs.append(ref)
                         gst.cv.notify_all()
 
+            ran_on_worker = True  # run_task reached the worker
             reply = worker.run_task(
                 msg, on_stream=on_stream if streaming else None)
             worker.exported_fns.add(msg["fid"])
@@ -1315,7 +1318,20 @@ class Runtime:
                 self._store_error(spec, _wrap(spec, e), t0)
         finally:
             if worker is not None:
-                node.pool.release(worker)
+                # Count only calls that actually reached the worker —
+                # pre-execution failures (arg packing etc.) must not
+                # burn max_calls budget.
+                fid = spec.descriptor.function_id
+                if ran_on_worker:
+                    worker.fn_calls[fid] = worker.fn_calls.get(fid, 0) + 1
+                if (ran_on_worker and spec.max_calls > 0
+                        and worker.fn_calls[fid] >= spec.max_calls):
+                    # max_calls: retire this worker process (the pool
+                    # respawns a fresh one in the background) — bounds
+                    # state leaked by the user function.
+                    node.pool.recycle(worker)
+                else:
+                    node.pool.release(worker)
             if not retried:
                 self._task_finished(spec)
             self.scheduler.release_task(spec, node.node_id)

@@ -29,14 +29,15 @@ class TaskType(enum.Enum):
 # Options
 # ---------------------------------------------------------------------------
 
-_TASK_ONLY = {"num_returns", "max_retries", "retry_exceptions"}
+_TASK_ONLY = {"num_returns", "max_retries", "retry_exceptions",
+              "max_calls"}
 _ACTOR_ONLY = {"max_restarts", "max_task_retries", "max_concurrency",
                "lifetime", "get_if_exists", "namespace"}
 
 _VALID = {
     "num_cpus", "num_tpus", "num_gpus", "memory", "resources", "name",
     "scheduling_strategy", "placement_group", "placement_group_bundle_index",
-    "runtime_env", "max_calls", "accelerator_type", "label_selector",
+    "runtime_env", "accelerator_type", "label_selector",
 } | _TASK_ONLY | _ACTOR_ONLY
 
 
@@ -138,6 +139,10 @@ class TaskSpec:
     # requeued after a crash (its pending entry must be preserved).
     task_retries_left: Optional[int] = None
     redelivered: bool = False
+    # Worker recycling: retire the executing worker process after it has
+    # run this function max_calls times (reference: max_calls — bounds
+    # leaky user code). 0 = unlimited.
+    max_calls: int = 0
     # actor linkage
     actor_id: Optional[ActorID] = None
     method_name: Optional[str] = None

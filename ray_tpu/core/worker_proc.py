@@ -114,6 +114,7 @@ class WorkerProcess:
         self.proc = proc
         self.sock = sock
         self.exported_fns: set = set()   # function ids pushed to this worker
+        self.fn_calls: dict = {}         # function id -> executions (max_calls)
         self.alive = True
         self.pid = proc.pid
         self.dedicated = False           # actor-owned: not in the idle pool
@@ -337,6 +338,29 @@ class WorkerPool:
             if w.alive and w.proc.poll() is None:
                 return w
             self._discard(w)
+
+    def recycle(self, w: WorkerProcess) -> None:
+        """Retire a pool worker; the replacement spawns on a
+        background thread so task completion doesn't pay the process
+        start (reference: the raylet replaces workers asynchronously).
+        """
+        with self._lock:
+            self._all.pop(w.worker_id, None)
+        try:
+            w.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        if self._closed or w.dedicated:
+            return
+
+        def respawn():
+            try:
+                self._spawn()
+            except Exception:  # noqa: BLE001
+                logger.exception("worker respawn failed")
+
+        threading.Thread(target=respawn, daemon=True,
+                         name="worker-respawn").start()
 
     def release(self, w: WorkerProcess) -> None:
         if self._closed:

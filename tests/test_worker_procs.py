@@ -373,3 +373,39 @@ def test_no_task_retries_errors_on_crash(ray_procs):
             if _t.monotonic() > deadline:
                 raise
             _t.sleep(0.1)
+
+
+def test_max_calls_recycles_worker(ray_procs):
+    """Workers are replaced after executing a function max_calls times
+    (reference: max_calls — bounds leaky user code)."""
+    ray = ray_procs
+
+    @ray.remote(max_calls=2, scheduling_strategy=PROC)
+    def leaky():
+        import os
+
+        return os.getpid()
+
+    pids = ray.get([leaky.remote() for _ in range(6)])
+    # 6 calls / max_calls=2 → at least 3 distinct worker processes.
+    assert len(set(pids)) >= 3, pids
+
+    @ray.remote(scheduling_strategy=PROC)
+    def stable():
+        import os
+
+        return os.getpid()
+
+    pids2 = ray.get([stable.remote() for _ in range(6)])
+    # Unlimited functions keep reusing the pool's workers.
+    assert len(set(pids2)) <= 2
+
+
+def test_max_calls_rejected_for_actors(ray_procs):
+    ray = ray_procs
+    import pytest as _p
+
+    with _p.raises(ValueError, match="only valid for tasks"):
+        @ray.remote(max_calls=3)
+        class A:
+            pass
