@@ -15,7 +15,8 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ._version import __version__
 from .core import runtime as _runtime
-from .core.actor import ActorClass, ActorHandle, exit_actor, get_actor
+from .core.actor import (ActorClass, ActorHandle, exit_actor,
+                         get_actor, method)
 from .core.exceptions import (
     ActorDiedError,
     ActorError,
@@ -42,7 +43,8 @@ from .core.task import (
 
 __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "get",
-    "put", "wait", "cancel", "kill", "get_actor", "exit_actor", "ObjectRef",
+    "put", "wait", "cancel", "kill", "get_actor", "exit_actor", "method",
+    "ObjectRef",
     "ObjectRefGenerator", "ActorClass", "ActorHandle", "RemoteFunction",
     "PlacementGroup", "placement_group", "remove_placement_group",
     "get_runtime_context", "cluster_resources", "available_resources",
@@ -150,15 +152,8 @@ def _make_remote(obj, options):
     raise TypeError(f"@remote target must be function or class: {obj!r}")
 
 
-def method(**opts):
-    """Per-method option decorator for actor classes (parity:
-    @ray.method(num_returns=...))."""
-
-    def decorator(f):
-        f.__ray_tpu_method_opts__ = opts
-        return f
-
-    return decorator
+# @method lives in core.actor (imported above): per-method defaults for
+# num_returns / concurrency_group, consumed at submit time.
 
 
 # ---------------------------------------------------------------------------

@@ -16,6 +16,23 @@ from .runtime import _ActorExit, global_runtime
 from .task import validate_options
 
 
+def method(**opts):
+    """Per-method defaults (reference: @ray.method — num_returns,
+    concurrency_group). Stored on the function; the runtime reads them
+    at submit time."""
+    allowed = {"num_returns", "concurrency_group"}
+    bad = set(opts) - allowed
+    if bad:
+        raise ValueError(
+            f"@method supports {sorted(allowed)}; got {sorted(bad)}")
+
+    def wrap(fn):
+        fn._ray_method_opts = dict(opts)
+        return fn
+
+    return wrap
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
                  opts: Dict[str, Any] | None = None):

@@ -223,7 +223,8 @@ class ActorState:
                  max_concurrency: int, max_restarts: int,
                  resources: ResourceSet,
                  runtime_env: Optional[Dict[str, Any]] = None,
-                 max_task_retries: int = 0):
+                 max_task_retries: int = 0,
+                 concurrency_groups: Optional[Dict[str, int]] = None):
         self.rt = rt
         self.actor_id = actor_id
         self.cls = cls
@@ -245,6 +246,17 @@ class ActorState:
         # mailbox — redelivery must not jump behind later submissions
         # (ordered-delivery contract) and must never block (unbounded).
         self.redeliver_q: "queue.Queue" = queue.Queue()
+        # Named concurrency groups: each group gets its own mailbox +
+        # thread pool, so slow methods in one group don't head-of-line
+        # block another (reference: concurrency_group_manager.h).
+        # Thread-based actors only — a proc actor's dedicated worker is
+        # one process and serializes regardless (see ProcActorState).
+        self.concurrency_groups = dict(concurrency_groups or {})
+        # Bounded like the main mailbox: group routing must not bypass
+        # actor backpressure.
+        self.group_mailboxes: Dict[str, "queue.Queue"] = {
+            g: queue.Queue(maxsize=config.actor_queue_max)
+            for g in self.concurrency_groups}
         self.dead = threading.Event()
         self.ready = threading.Event()
         self.death_cause: Optional[BaseException] = None
@@ -281,6 +293,15 @@ class ActorState:
                     name=f"actor-{self.name}-{i}", daemon=True)
                 t.start()
                 self._threads.append(t)
+            for group, limit in self._group_pools().items():
+                mbox = self.group_mailboxes[group]
+                for i in range(limit):
+                    t = threading.Thread(
+                        target=self._sync_main, args=(False, gen, mbox),
+                        name=f"actor-{self.name}-{group}-{i}",
+                        daemon=True)
+                    t.start()
+                    self._threads.append(t)
 
     # -- lifecycle --------------------------------------------------------
     def _construct(self, gen: int) -> bool:
@@ -320,15 +341,20 @@ class ActorState:
             self._death_done = True
         self.dead.set()
         self.ready.set()
-        # Drain mailbox (+ redelivery queue) with death errors.
-        while True:
-            try:
-                spec = self.redeliver_q.get_nowait()
-            except queue.Empty:
+        # Drain all mailboxes (+ redelivery queue) with death errors.
+        drains = [self.redeliver_q, self.mailbox,
+                  *self.group_mailboxes.values()]
+        def _next_spec():
+            for q_ in drains:
                 try:
-                    spec = self.mailbox.get_nowait()
+                    return q_.get_nowait()
                 except queue.Empty:
-                    break
+                    continue
+            return StopIteration
+        while True:
+            spec = _next_spec()
+            if spec is StopIteration:
+                break
             if spec is not None:
                 self.rt._store_error(
                     spec,
@@ -348,8 +374,15 @@ class ActorState:
         except queue.Full:
             pass
 
+    def _group_pools(self) -> Dict[str, int]:
+        """Groups that get dedicated threads (ProcActorState: none —
+        its dedicated worker process is a single pipeline; async actors:
+        none — the event loop is already concurrent and only the main
+        mailbox is drained)."""
+        return {} if self._is_async else self.concurrency_groups
+
     # -- execution --------------------------------------------------------
-    def _sync_main(self, constructs: bool, gen: int):
+    def _sync_main(self, constructs: bool, gen: int, mbox=None):
         _ctx.actor_id = self.actor_id
         _ctx.node_id = self.node.node_id
         if constructs:
@@ -357,12 +390,17 @@ class ActorState:
                 return
         else:
             self.ready.wait()
+        own_mbox = mbox if mbox is not None else self.mailbox
+        main_loop = mbox is None
         while not self.dead.is_set() and gen == self.generation:
             try:
+                # Redelivered calls are drained by the main pool only.
+                if not main_loop:
+                    raise queue.Empty
                 spec = self.redeliver_q.get_nowait()
             except queue.Empty:
                 try:
-                    spec = self.mailbox.get(timeout=0.1)
+                    spec = own_mbox.get(timeout=0.1)
                 except queue.Empty:
                     continue
             if spec is None or self.dead.is_set():
@@ -527,6 +565,12 @@ class ProcActorState(ActorState):
             self.death_cause = TaskError(self.cls.__name__ + ".__init__", e)
             self._die(gen)
             return False
+
+    def _group_pools(self) -> Dict[str, int]:
+        # The dedicated worker is ONE process: group threads would race
+        # on its socket for no parallelism — groups collapse into the
+        # ordered mailbox (routing in submit_actor_task).
+        return {}
 
     def _run_method(self, spec: TaskSpec):
         from .worker_proc import WorkerCrashedError
@@ -1064,6 +1108,7 @@ class Runtime:
                     max_restarts=opts.get(
                         "max_restarts", config.default_actor_max_restarts),
                     max_task_retries=opts.get("max_task_retries", 0),
+                    concurrency_groups=opts.get("concurrency_groups"),
                     resources=resources,
                     runtime_env=opts.get("runtime_env"),
                 )
@@ -1105,7 +1150,19 @@ class Runtime:
             raise (cause if isinstance(cause, ActorDiedError)
                    else ActorDiedError(actor_id.hex()))
         task_id = TaskID.for_actor_task(actor_id)
-        num_returns = opts.get("num_returns", 1)
+        # @method(...) defaults; call-site .options(...) wins.
+        _m = getattr(st.cls, method_name, None)
+        _mdefaults = getattr(_m, "_ray_method_opts", {})
+        num_returns = opts.get("num_returns",
+                               _mdefaults.get("num_returns", 1))
+        # Validate the concurrency group BEFORE any registration —
+        # lineage/generator entries must not leak for a rejected call.
+        group = opts.get("concurrency_group",
+                         _mdefaults.get("concurrency_group"))
+        if group is not None and group not in st.group_mailboxes:
+            raise ValueError(
+                f"Unknown concurrency group {group!r}; declared: "
+                f"{sorted(st.concurrency_groups)}")
         streaming = num_returns in ("streaming", "dynamic")
         n_rets = 0 if streaming else num_returns
         spec = TaskSpec(
@@ -1125,7 +1182,13 @@ class Runtime:
         self._record_lineage(spec)
         with self._pending_lock:
             self._pending_tasks[task_id] = spec
-        st.mailbox.put(spec)
+        # Concurrency-group routing (validated above). Actors without
+        # dedicated group pools (proc/async) collapse groups into the
+        # single ordered mailbox.
+        if group is not None and st._group_pools():
+            st.group_mailboxes[group].put(spec)
+        else:
+            st.mailbox.put(spec)
         if streaming:
             return ObjectRefGenerator(task_id, gst)
         refs = [self.register_ref(ObjectRef(oid)) for oid in spec.return_ids]

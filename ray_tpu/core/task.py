@@ -32,7 +32,8 @@ class TaskType(enum.Enum):
 _TASK_ONLY = {"num_returns", "max_retries", "retry_exceptions",
               "max_calls"}
 _ACTOR_ONLY = {"max_restarts", "max_task_retries", "max_concurrency",
-               "lifetime", "get_if_exists", "namespace"}
+               "lifetime", "get_if_exists", "namespace",
+               "concurrency_groups"}
 
 _VALID = {
     "num_cpus", "num_tpus", "num_gpus", "memory", "resources", "name",
@@ -67,6 +68,14 @@ def validate_options(opts: Dict[str, Any], *, is_actor: bool) -> Dict[str, Any]:
         raise ValueError(
             "label_selector must be a dict of str->str "
             f"(got {ls!r})")
+    cg = opts.get("concurrency_groups")
+    if cg is not None and not (
+            isinstance(cg, dict)
+            and all(isinstance(k, str) and isinstance(v, int) and v > 0
+                    for k, v in cg.items())):
+        raise ValueError(
+            "concurrency_groups must be a dict of str -> int>0 "
+            f"(got {cg!r})")
     if "runtime_env" in opts:
         from .runtime_env import validate as _validate_renv
         _validate_renv(opts["runtime_env"])

@@ -344,6 +344,20 @@ class WorkerPool:
         background thread so task completion doesn't pay the process
         start (reference: the raylet replaces workers asynchronously).
         """
+        self._discard(w, respawn_in_background=True)
+
+    def release(self, w: WorkerProcess) -> None:
+        if self._closed:
+            return
+        if w.alive and w.proc.poll() is None:
+            self._idle.put(w)
+        else:
+            self._discard(w)
+
+    def _discard(self, w: WorkerProcess,
+                 respawn_in_background: bool = False) -> None:
+        """Drop a worker and respawn a replacement (pool workers
+        only; dedicated actor workers are replaced by actor restart)."""
         with self._lock:
             self._all.pop(w.worker_id, None)
         try:
@@ -354,36 +368,21 @@ class WorkerPool:
             return
 
         def respawn():
+            # Re-check at spawn time: shutdown() may have landed while
+            # this thread was starting (else an orphan worker Popens
+            # against a closed listener and blocks its hello ~30s).
+            if self._closed:
+                return
             try:
                 self._spawn()
             except Exception:  # noqa: BLE001
                 logger.exception("worker respawn failed")
 
-        threading.Thread(target=respawn, daemon=True,
-                         name="worker-respawn").start()
-
-    def release(self, w: WorkerProcess) -> None:
-        if self._closed:
-            return
-        if w.alive and w.proc.poll() is None:
-            self._idle.put(w)
+        if respawn_in_background:
+            threading.Thread(target=respawn, daemon=True,
+                             name="worker-respawn").start()
         else:
-            self._discard(w)
-
-    def _discard(self, w: WorkerProcess) -> None:
-        """Drop a dead worker and respawn a replacement (pool workers
-        only; dedicated actor workers are replaced by actor restart)."""
-        with self._lock:
-            self._all.pop(w.worker_id, None)
-        try:
-            w.shutdown()
-        except Exception:  # noqa: BLE001
-            pass
-        if not self._closed and not w.dedicated:
-            try:
-                self._spawn()
-            except Exception:  # noqa: BLE001
-                logger.exception("worker respawn failed")
+            respawn()
 
     def workers(self) -> List[WorkerProcess]:
         with self._lock:

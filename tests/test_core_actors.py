@@ -326,3 +326,105 @@ class TestNamespaces:
             return ray.get_runtime_context().get_node_id()
 
         assert ray.get(where.remote()) == "node-v5e-x"
+
+
+class TestConcurrencyGroups:
+    """Named per-group thread pools (reference: concurrency groups —
+    concurrency_group_manager.h; @ray.method(concurrency_group=...))."""
+
+    def test_groups_avoid_head_of_line_blocking(self, ray_start):
+        ray = ray_start
+        import threading
+        import time as _t
+
+        release = threading.Event()
+
+        @ray.remote(concurrency_groups={"io": 2})
+        class Mixed:
+            def block(self, _evt_holder=None):
+                release.wait(20)
+                return "unblocked"
+
+            @ray.method(concurrency_group="io")
+            def quick(self):
+                return "io-done"
+
+        a = Mixed.remote()
+        slow = a.block.remote()
+        # The io-group method must complete while the default group is
+        # fully occupied by the blocking call.
+        assert ray.get(a.quick.remote(), timeout=5) == "io-done"
+        release.set()
+        assert ray.get(slow, timeout=20) == "unblocked"
+
+    def test_call_site_group_override(self, ray_start):
+        ray = ray_start
+        import threading
+
+        release = threading.Event()
+
+        @ray.remote(concurrency_groups={"aux": 1})
+        class A:
+            def busy(self):
+                release.wait(20)
+                return 1
+
+            def ping(self):
+                return "pong"
+
+        a = A.remote()
+        a.busy.remote()
+        out = ray.get(a.ping.options(concurrency_group="aux").remote(),
+                      timeout=5)
+        assert out == "pong"
+        release.set()
+
+    def test_unknown_group_rejected(self, ray_start):
+        ray = ray_start
+
+        @ray.remote
+        class A:
+            def f(self):
+                return 1
+
+        a = A.remote()
+        import pytest as _p
+
+        with _p.raises(ValueError, match="concurrency group"):
+            a.f.options(concurrency_group="nope").remote()
+
+    def test_method_num_returns_default(self, ray_start):
+        ray = ray_start
+
+        @ray.remote
+        class A:
+            @ray.method(num_returns=2)
+            def pair(self):
+                return 1, 2
+
+        a = A.remote()
+        r1, r2 = a.pair.remote()
+        assert ray.get([r1, r2]) == [1, 2]
+
+    def test_bad_group_spec_rejected(self, ray_start):
+        ray = ray_start
+        import pytest as _p
+
+        with _p.raises(ValueError, match="concurrency_groups"):
+            @ray.remote(concurrency_groups={"io": 0})
+            class A:
+                pass
+
+    def test_async_actor_groups_collapse_to_main_loop(self, ray_start):
+        """Async actors drain only the main mailbox — group routing
+        must not strand calls in undrained queues."""
+        ray = ray_start
+
+        @ray.remote(concurrency_groups={"io": 2})
+        class Aio:
+            @ray.method(concurrency_group="io")
+            async def f(self):
+                return "async-ok"
+
+        a = Aio.remote()
+        assert ray.get(a.f.remote(), timeout=10) == "async-ok"
