@@ -84,7 +84,10 @@ def bench_serve(quick: bool) -> None:
         prompt_len, max_new, max_seq = 128, 64, 1024
 
     params = init_params(cfg, jax.random.key(0))
-    engine = LLMEngine(cfg, params, num_slots=slots, max_seq_len=max_seq)
+    # decode_block matched to max_new: every admission completes in one
+    # fused block (measured optimum for this workload).
+    engine = LLMEngine(cfg, params, num_slots=slots, max_seq_len=max_seq,
+                       decode_block=max(16, max_new))
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len).tolist()
                for _ in range(n_req)]
@@ -106,7 +109,8 @@ def bench_serve(quick: bool) -> None:
     prev = push_history(
         metric, req_s, "req/s",
         match={"prompt_len": prompt_len, "max_new": max_new,
-               "slots": slots, "platform": jax.devices()[0].platform},
+               "slots": slots, "decode_block": engine.decode_block,
+               "platform": jax.devices()[0].platform},
         extra={"ttft_p50_s": p50})
     print(json.dumps({
         "metric": metric, "value": round(req_s, 2), "unit": "req/s",
