@@ -350,8 +350,16 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
             tokens: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """tokens (B, S) int32 → (logits (B, S, V) float32, aux_loss)."""
     B, S = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    x = wsc(x, ("batch", "seq", "act_embed"))
+    # Constrain the table to replicated for the lookup: the stored param
+    # is (vocab→tp, embed→fsdp)-sharded, and a gather from an
+    # embed-sharded operand into a batch-fsdp-sharded activation makes
+    # XLA's SPMD partitioner fall back to "involuntary full
+    # rematerialization" (the fsdp axis must move between tensor dims,
+    # which gather can't reshard in place). Replicating first turns that
+    # into one explicit all-gather + a local gather + a free slice.
+    tokens = wsc(tokens, ("batch", "seq"))
+    emb = wsc(params["embed"].astype(cfg.dtype), (None, None))
+    x = wsc(emb[tokens], ("batch", "seq", "act_embed"))
     sin, cos = rope_tables(cfg, S)
 
     layer = partial(_layer, cfg)
