@@ -458,6 +458,7 @@ std::vector<std::string> ControlClient::ListNodes() {
     c.u8();                      // alive
     c.u8();                      // draining
     c.u64();                     // ms since last heartbeat
+    c.str();                     // load report (opaque here)
   }
   return out;
 }

@@ -80,7 +80,8 @@ def init(address: Optional[str] = None, *,
 
     Reference parity: ray.init (python/ray/_private/worker.py:1227).
     """
-    if address is not None:
+    if address is not None and (address.startswith("tpu://")
+                                or address.startswith("ray://")):
         from . import client as _client_mod
 
         if _client_mod.get_client() is not None:
@@ -93,9 +94,14 @@ def init(address: Optional[str] = None, *,
         if ignore_reinit_error:
             return
         raise RuntimeError("ray_tpu is already initialized")
+    # A bare "host:port" address joins a daemon-backed cluster as a
+    # driver (reference: ray.init(address="host:port") joining a
+    # `ray start` cluster); node daemons appear as schedulable nodes.
     _runtime.init_runtime(
         num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
         num_worker_procs=num_worker_procs,
+        cluster_address=address,
+        advertise_host=_compat.get("advertise_host", "127.0.0.1"),
         _system_config=_system_config)
     if namespace:
         _runtime.global_runtime().namespace = namespace

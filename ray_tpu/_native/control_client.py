@@ -55,15 +55,19 @@ def available() -> bool:
 
 
 def launch_control_plane(*, port: int = 0, health_timeout_ms: int = 5000,
-                         persist_path: Optional[str] = None
+                         persist_path: Optional[str] = None,
+                         bind_all: bool = False
                          ) -> Tuple[subprocess.Popen, int]:
     """Spawn the daemon; returns (process, bound port). persist_path
     enables crash-restart state recovery (reference: Redis-backed GCS
-    fault tolerance, tests/test_gcs_fault_tolerance.py)."""
+    fault tolerance, tests/test_gcs_fault_tolerance.py). bind_all
+    listens on 0.0.0.0 so other hosts can join (multi-host clusters)."""
     cmd = [_BIN, "--port", str(port),
            "--health-timeout-ms", str(health_timeout_ms)]
     if persist_path:
         cmd += ["--persist", persist_path]
+    if bind_all:
+        cmd += ["--bind-all"]
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
     line = proc.stdout.readline()
     if not line.startswith("PORT="):
@@ -271,8 +275,14 @@ class ControlClient:
         self._request(OP_REGISTER_NODE,
                       _pack_str(node_id) + _pack_str(meta))
 
-    def heartbeat(self, node_id: str) -> None:
-        self._request(OP_HEARTBEAT, _pack_str(node_id))
+    def heartbeat(self, node_id: str, load: str = "") -> None:
+        """Heartbeat, optionally piggybacking a load report (resource-view
+        sync — the capability of reference ray_syncer.h:88; schedulers
+        read the merged view back via list_nodes)."""
+        body = _pack_str(node_id)
+        if load:
+            body += _pack_str(load)
+        self._request(OP_HEARTBEAT, body)
 
     def drain_node(self, node_id: str) -> None:
         self._request(OP_DRAIN_NODE, _pack_str(node_id))
@@ -285,6 +295,7 @@ class ControlClient:
                 "node_id": r.str_(), "meta": r.str_(),
                 "alive": bool(r.u8()), "draining": bool(r.u8()),
                 "ms_since_heartbeat": r.u64(),
+                "load": r.str_(),
             })
         return out
 

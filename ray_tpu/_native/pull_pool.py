@@ -1,0 +1,62 @@
+"""Shared pool of object-transfer pull clients.
+
+One persistent TransferClient per peer endpoint, each serialized by its
+own lock (the native connection handles one transfer at a time), with
+drop-and-reconnect on error. Used by both the node daemon (pulling task
+args into its arena) and the driver's RemotePlane (pulling results) —
+the raylet PullManager role, reference: src/ray/object_manager/
+pull_manager.h.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Hashable, Tuple
+
+
+class PullClientPool:
+    def __init__(self, local_shm_name: str):
+        self._shm_name = local_shm_name
+        self._clients: Dict[Hashable, object] = {}
+        self._locks: Dict[Hashable, threading.Lock] = {}
+        self._lock = threading.Lock()
+
+    def pull(self, key: Hashable, endpoint: Tuple[str, int],
+             object_id: bytes) -> None:
+        """Pull object_id from the peer at `endpoint` into the local
+        arena. Raises on failure (after dropping the cached client so
+        a restarted peer gets a fresh connection)."""
+        from .object_transfer import TransferClient
+
+        with self._lock:
+            client = self._clients.get(key)
+            if client is None:
+                client = TransferClient(endpoint[0], endpoint[1],
+                                        self._shm_name)
+                self._clients[key] = client
+                self._locks[key] = threading.Lock()
+            lock = self._locks[key]
+        try:
+            with lock:
+                client.pull(object_id)
+        except Exception:
+            self.drop(key)
+            raise
+
+    def drop(self, key: Hashable) -> None:
+        with self._lock:
+            client = self._clients.pop(key, None)
+            self._locks.pop(key, None)
+        if client is not None:
+            with contextlib.suppress(Exception):
+                client.close()
+
+    def close(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+            self._locks.clear()
+        for c in clients:
+            with contextlib.suppress(Exception):
+                c.close()

@@ -106,6 +106,10 @@ _D("object_store_full_max_retries", int, 10, "")
 _D("worker_pool_size", int, 0,
    "Number of task-executor threads per worker (0 = num_cpus resource).")
 _D("actor_queue_max", int, 10000, "Per-actor pending-call queue bound.")
+_D("generator_backpressure_max_items", int, 16,
+   "Streaming generators pause the producer once this many yielded "
+   "items await consumption (reference: GeneratorWaiter backpressure, "
+   "core_worker.h). 0 disables backpressure.")
 _D("get_timeout_warning_s", float, 30.0,
    "Warn if a blocking get waits longer than this.")
 _D("health_check_period_ms", int, 1000, "Node health-check interval.")
@@ -121,6 +125,12 @@ _D("log_to_driver", bool, True,
 _D("task_event_buffer_max", int, 100_000, "Max buffered task state events.")
 _D("gang_schedule_timeout_s", float, 60.0,
    "Timeout for atomically acquiring all bundles of a placement group.")
+_D("cluster_poll_interval_s", float, 0.5,
+   "Driver-side poll interval for cluster membership + load reports "
+   "(resource-view sync; capability of reference ray_syncer.h).")
+_D("actor_replace_timeout_s", float, 10.0,
+   "How long a restarting actor waits for a surviving node with "
+   "capacity before giving up (multi-host actor recovery).")
 # --- TPU / device ---
 _D("tpu_devices_per_host", int, 0, "0 = autodetect via jax.local_devices().")
 _D("prefetch_to_device_buffers", int, 2,

@@ -55,6 +55,10 @@ class AutoscalerConfig:
     # reference: upscaling_speed).
     upscaling_speed: float = 1.0
     worker_labels: Dict[str, str] = field(default_factory=dict)
+    # How long a launched-but-not-joined node's capacity is credited
+    # against demand before it is presumed failed and relaunchable
+    # (GCE TPU pods take minutes to boot + join).
+    launch_grace_s: float = 600.0
     # Multi-shape mode: when set, demand is packed per node type and
     # worker_resources/worker_labels are ignored.
     node_types: Dict[str, NodeTypeConfig] = field(default_factory=dict)
@@ -153,12 +157,16 @@ class StandardAutoscaler:
         self.provider = provider
         self._rt = runtime or _rt.global_runtime()
         self._idle_since: Dict[str, float] = {}
+        # node_id -> launch time; capacity of these is credited against
+        # demand until the node joins or the grace period lapses.
+        self._pending_launch: Dict[str, float] = {}
         # Called with each new node_id right after create_node — the
         # cluster launcher hangs node provisioning (setup_commands)
         # here so Monitor-launched nodes get set up too.
         self._on_node_launched = on_node_launched
 
     def _launched(self, node_id: str) -> None:
+        self._pending_launch[node_id] = time.monotonic()
         if self._on_node_launched is not None:
             try:
                 self._on_node_launched(node_id)
@@ -166,24 +174,74 @@ class StandardAutoscaler:
                 logger.exception("node %s provisioning failed", node_id)
 
     # -- sizing ------------------------------------------------------------
+    @staticmethod
+    def _selector_ok(selector: Dict[str, str],
+                     labels: Dict[str, str]) -> bool:
+        if not selector:
+            return True
+        return all(labels.get(k) == v for k, v in selector.items())
+
+    def _type_labels(self, t: str) -> Dict[str, str]:
+        tc = self.config.node_types[t]
+        labels = dict(tc.labels)
+        labels.setdefault("node-type", t)
+        return labels
+
+    def _pending_capacity(self) -> List[tuple]:
+        """[(capacity, labels)] of nodes LAUNCHED but not yet joined
+        (provider-alive but absent from the scheduler). Crediting these
+        against unmet demand damps relaunch storms while slow nodes
+        (GCE TPU pods take minutes) boot — the reference autoscaler
+        tracks launching nodes the same way."""
+        joined = {n.node_id for n in self._rt.scheduler.nodes()}
+        now = time.monotonic()
+        out = []
+        for nid in self.provider.non_terminated_nodes():
+            if nid in joined:
+                # Joined under its provider id (setup_commands pass
+                # --node-id={node_id}) — no longer pending.
+                self._pending_launch.pop(nid, None)
+                continue
+            since = self._pending_launch.get(nid)
+            if since is None or now - since > self.config.launch_grace_s:
+                # Unknown to this autoscaler instance or stuck past the
+                # grace window: don't credit phantom capacity forever.
+                continue
+            if self.config.node_types:
+                t = self.provider.node_type_of(nid)
+                tc = self.config.node_types.get(t)
+                if tc is None:
+                    continue
+                out.append((ResourceSet(tc.resources),
+                            self._type_labels(t)))
+            else:
+                labels = dict(self.config.worker_labels)
+                out.append((ResourceSet(self.config.worker_resources),
+                            labels))
+        return out
+
     def _demand_nodes_needed(self) -> int:
         """Bin-pack pending demand into worker-node-sized bins
         (reference: resource_demand_scheduler.py get_nodes_for).
 
         Demand is first absorbed by the free capacity of nodes that
-        already exist (the reference packs onto existing nodes'
-        available resources before asking for new ones) — otherwise a
-        transiently-queued task next to an idle worker launches a node.
+        already exist or are still launching (the reference packs onto
+        existing nodes' available resources before asking for new ones)
+        — otherwise a transiently-queued task next to an idle worker
+        launches a node, and slow-booting nodes relaunch every tick.
         Hard affinity / PG demand can't be satisfied by arbitrary free
         capacity — it always counts as unmet.
         """
         unmet = self._unmet_demand()
         cap = ResourceSet(self.config.worker_resources)
+        worker_labels = dict(self.config.worker_labels)
         nodes_needed = 0
         remaining = None
-        for req in unmet:
+        for req, selector in unmet:
             if not req.fits(cap):
                 continue  # never satisfiable by this node type
+            if not self._selector_ok(selector, worker_labels):
+                continue  # no launchable node can match the selector
             if remaining is not None and req.fits(remaining):
                 remaining = remaining.subtract(req)
                 continue
@@ -191,26 +249,29 @@ class StandardAutoscaler:
             remaining = cap.subtract(req)
         return nodes_needed
 
-    def _unmet_demand(self) -> List[ResourceSet]:
-        """Pending requests not coverable by existing free capacity."""
+    def _unmet_demand(self) -> List[tuple]:
+        """[(request, label_selector)] not coverable by existing free
+        or pending-launch capacity (label-matched)."""
         sched = self._rt.scheduler
         if hasattr(sched, "pending_demand_detailed"):
             demand = sched.pending_demand_detailed()
         else:
-            demand = [(r, False) for r in sched.pending_demand()]
-        free = [n.available for n in sched.nodes()]
+            demand = [(r, False, {}) for r in sched.pending_demand()]
+        free = [(n.available, getattr(n, "labels", {}))
+                for n in sched.nodes()]
+        free += self._pending_capacity()
         unmet = []
-        for req, constrained in sorted(
+        for req, hard, selector in sorted(
                 demand, key=lambda rc: -sum(rc[0].to_dict().values())):
-            if constrained:
-                unmet.append(req)
+            if hard:
+                unmet.append((req, selector))
                 continue
-            for i, f in enumerate(free):
-                if req.fits(f):
-                    free[i] = f.subtract(req)
+            for i, (f, labels) in enumerate(free):
+                if req.fits(f) and self._selector_ok(selector, labels):
+                    free[i] = (f.subtract(req), labels)
                     break
             else:
-                unmet.append(req)
+                unmet.append((req, selector))
         return unmet
 
     def _demand_by_type(self, alive_by_type: Dict[str, int]
@@ -219,7 +280,9 @@ class StandardAutoscaler:
         each request first — reference: resource_demand_scheduler
         get_nodes_for / _utilization_scorer). A type at its max_workers
         stops opening bins; demand spills to the next-larger fitting
-        type rather than hanging."""
+        type rather than hanging. Demand with a label_selector only
+        opens bins of types whose labels satisfy the selector —
+        launching a type that can never match would loop forever."""
         types = self.config.node_types
         # Smallest-first so a CPU task doesn't claim a TPU host.
         order = sorted(
@@ -227,10 +290,11 @@ class StandardAutoscaler:
         caps = {t: ResourceSet(types[t].resources) for t in types}
         needed: Dict[str, int] = {t: 0 for t in types}
         open_bins: List = []  # (type, remaining)
-        for req in self._unmet_demand():
+        for req, selector in self._unmet_demand():
             placed = False
             for i, (t, rem) in enumerate(open_bins):
-                if req.fits(rem):
+                if req.fits(rem) and self._selector_ok(
+                        selector, self._type_labels(t)):
                     open_bins[i] = (t, rem.subtract(req))
                     placed = True
                     break
@@ -239,7 +303,9 @@ class StandardAutoscaler:
             for t in order:
                 launchable = (types[t].max_workers
                               - alive_by_type.get(t, 0) - needed[t])
-                if launchable > 0 and req.fits(caps[t]):
+                if (launchable > 0 and req.fits(caps[t])
+                        and self._selector_ok(selector,
+                                              self._type_labels(t))):
                     needed[t] += 1
                     open_bins.append((t, caps[t].subtract(req)))
                     break

@@ -161,6 +161,12 @@ class ClusterLauncher:
             raise RuntimeError(f"node {node_id} has no reachable IP")
         runner = self._runner_factory(ip)
         for cmd in self.cfg.setup_commands:
+            # Template vars so a setup command can register the daemon
+            # under the PROVIDER's node id ("ray-tpu start
+            # --address=... --node-id={node_id}") — that id match is
+            # what lets the autoscaler stop counting the node as
+            # pending-launch capacity once it joins the scheduler.
+            cmd = cmd.replace("{node_id}", node_id)
             runner.run(cmd)
         self._provisioned.add(node_id)
         return True

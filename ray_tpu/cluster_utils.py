@@ -130,3 +130,114 @@ class Cluster:
             self._cp_proc = None
         _runtime.shutdown_runtime()
         self._rt = None
+
+
+class RealCluster:
+    """Real-PROCESS multi-node cluster: a native control-plane daemon
+    plus one NodeDaemon OS process per node, with this process as the
+    driver (reference: python/ray/cluster_utils.py:108 — `Cluster` runs
+    multiple real raylets as separate processes on one machine; this is
+    the same fixture for the multi-host plane)."""
+
+    def __init__(self, *, health_timeout_ms: int = 1500):
+        import subprocess  # noqa: F401 — re-exported for tests
+
+        from ._native import control_client as cc
+
+        if not cc.available():
+            raise RuntimeError(
+                "control_plane binary not built (make -C src)")
+        self._cp_proc, self.port = cc.launch_control_plane(
+            health_timeout_ms=health_timeout_ms)
+        self.address = f"127.0.0.1:{self.port}"
+        self._daemons: Dict[str, object] = {}
+        self._count = 0
+
+    def add_node(self, *, num_cpus: float = 2, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 wait: bool = True, timeout: float = 60.0) -> str:
+        import subprocess
+        import sys
+
+        self._count += 1
+        node_id = f"daemon-{self._count}"
+        cmd = [sys.executable, "-m", "ray_tpu.node.daemon",
+               "--address", self.address, "--node-id", node_id,
+               "--num-cpus", str(num_cpus), "--num-tpus", str(num_tpus)]
+        if resources:
+            cmd += ["--resources", json.dumps(resources)]
+        if labels:
+            cmd += ["--labels", json.dumps(labels)]
+        import os
+
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True)
+        self._daemons[node_id] = proc
+        if wait:
+            import time
+
+            deadline = time.monotonic() + timeout
+            ready = False
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    break
+                if line.startswith("{"):
+                    ready = True
+                    break
+            if not ready:
+                raise RuntimeError(f"node daemon {node_id} did not start")
+            self._wait_joined(node_id, deadline)
+        return node_id
+
+    def _wait_joined(self, node_id: str, deadline: float) -> None:
+        """If a driver runtime is up, block until its scheduler sees the
+        node (registration → list_nodes → RemotePlane sync)."""
+        import time
+
+        rt = _runtime.global_runtime_or_none()
+        if rt is None or rt.remote_plane is None:
+            return
+        while time.monotonic() < deadline:
+            rt.remote_plane.sync_nodes()
+            if rt.scheduler.get_node(node_id) is not None:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"{node_id} never joined the driver's view")
+
+    def connect(self, **init_kwargs):
+        """Join as a driver; returns the ray_tpu module."""
+        import ray_tpu
+
+        ray_tpu.init(address=self.address, **init_kwargs)
+        return ray_tpu
+
+    def kill_node(self, node_id: str) -> None:
+        """SIGKILL a daemon (fault injection — reference NodeKiller)."""
+        proc = self._daemons.pop(node_id, None)
+        if proc is not None:
+            proc.kill()
+
+    def remove_node(self, node_id: str) -> None:
+        proc = self._daemons.pop(node_id, None)
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+
+    def shutdown(self):
+        _runtime.shutdown_runtime()
+        for node_id in list(self._daemons):
+            self.remove_node(node_id)
+        if self._cp_proc is not None:
+            self._cp_proc.terminate()
+            try:
+                self._cp_proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                self._cp_proc.kill()
+            self._cp_proc = None

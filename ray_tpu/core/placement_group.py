@@ -154,7 +154,9 @@ def _try_reserve_all(rt, pg: PlacementGroup) -> bool:
                 # Atomic per-node reserve through the scheduler lock.
                 with rt.scheduler._lock:
                     if rs.fits(node.available):
-                        node.available = node.available.subtract(rs)
+                        # charge() (not a bare subtract) so heartbeat
+                        # load reports account for this reservation.
+                        node.charge(rs)
                         ok = True
                     else:
                         continue

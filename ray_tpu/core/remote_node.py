@@ -1,0 +1,653 @@
+"""Driver-side integration of the per-host node-daemon plane.
+
+Gives the Runtime real REMOTE nodes: `RemoteNodeState` entries in the
+scheduler whose dispatch pushes packed tasks to a `NodeDaemon` over TCP
+(node/client.py), with bulk objects moving between per-host shm arenas
+on the native object-transfer plane and the driver's resource view kept
+in sync from heartbeat load reports (the ray_syncer.h:88 capability).
+
+Reference capabilities mirrored: the driver⇄raylet⇄worker dispatch path
+(node_manager.proto RequestWorkerLease + core_worker.proto PushTask),
+ownership-based object locations (OwnershipBasedObjectDirectory — here
+the owner's store records each object's node in its `_ShmMarker`), and
+actor restart-with-replacement on node death
+(gcs_actor_manager.h:513 ReconstructActor).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .._private.config import config
+from .exceptions import ActorDiedError, ObjectLostError, TaskCancelledError
+from .ids import ObjectID
+from .object_ref import ObjectRef
+from .resources import ResourceSet
+from .scheduler import NodeState
+from .task import TaskSpec, TaskType
+
+logger = logging.getLogger("ray_tpu")
+
+
+class _FetchLost(Exception):
+    """An arg's payload is on a node that is gone — reconstruct."""
+
+    def __init__(self, oid: ObjectID):
+        self.oid = oid
+
+
+class RemoteNodeState(NodeState):
+    """A schedulable node hosted by a NodeDaemon on (possibly) another
+    machine. The executor threads only drive socket round-trips."""
+
+    is_remote = True
+
+    def __init__(self, node_id: str, total: ResourceSet, meta: dict):
+        from ..node.client import NodeClient
+
+        n_cpus = int(total.to_dict().get("CPU", 1) or 1)
+        super().__init__(node_id, total,
+                         max_workers=max(4, n_cpus * 2 + 4))
+        self.meta = meta
+        self.host = meta.get("host", "127.0.0.1")
+        self.dispatch_port = int(meta["dispatch_port"])
+        self.object_port = int(meta["object_port"])
+        self.client = NodeClient(node_id, self.host, self.dispatch_port,
+                                 self.object_port)
+        self.exported_fids: set = set()
+        self.reported_queued = 0   # from heartbeat load reports
+
+    def utilization(self) -> float:
+        # Queue depth reported by the daemon (other drivers' load too)
+        # breaks ties toward idle nodes.
+        return (self.available.scaled_utilization(self.total)
+                + 0.01 * self.reported_queued)
+
+    def shutdown(self):
+        super().shutdown()
+        self.client.close()
+
+
+class RemotePlane:
+    """Everything cluster-mode: control-plane attach, node membership,
+    resource-view sync, remote task execution, cross-node object pulls."""
+
+    def __init__(self, rt, address: str, advertise_host: str = "127.0.0.1"):
+        from .._native import control_client as cc
+
+        self.rt = rt
+        self.address = address
+        self.advertise_host = advertise_host
+        host, _, port = address.partition(":")
+        self.control = cc.ControlClient(int(port), host=host)
+
+        # Serve the driver's own arena so daemons can pull `ray.put`
+        # args. Bind 0.0.0.0 only when the driver advertises a
+        # non-loopback address — an unauthenticated transfer port must
+        # not be exposed for single-machine clusters.
+        self.transfer_server = None
+        self.object_port = 0
+        if rt.shm is not None:
+            from .._native.object_transfer import TransferServer
+
+            bind_all = advertise_host not in ("127.0.0.1", "localhost")
+            self.transfer_server = TransferServer(
+                rt._shm_name, 0, bind_all=bind_all)
+            self.object_port = self.transfer_server.port
+
+        # node_id -> (host, object_port): survives until node death.
+        self._endpoints: Dict[str, Tuple[str, int]] = {}
+        from .._native.pull_pool import PullClientPool
+
+        self._pulls = (PullClientPool(rt._shm_name)
+                       if rt.shm is not None else None)
+        self._stop = threading.Event()
+        self._known: set = set()
+        # Guards membership mutation: sync_nodes runs from the poll
+        # thread AND the pubsub callback — without this two racers
+        # could each build a RemoteNodeState for the same node (one
+        # leaking its executor + connections).
+        self._sync_lock = threading.Lock()
+
+        self.sync_nodes()
+        with contextlib.suppress(Exception):
+            self.control.subscribe("node_events", self._on_node_event)
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, daemon=True, name="remote-plane-poll")
+        self._poll_thread.start()
+
+    # -- membership + resource-view sync --------------------------------
+    def sync_nodes(self) -> None:
+        try:
+            nodes = self.control.list_nodes()
+        except Exception:  # noqa: BLE001 — control plane hiccup
+            return
+        with self._sync_lock:
+            self._sync_nodes_locked(nodes)
+
+    def _sync_nodes_locked(self, nodes) -> None:
+        for n in nodes:
+            nid = n["node_id"]
+            try:
+                meta = json.loads(n["meta"]) if n["meta"] else {}
+            except ValueError:
+                meta = {}
+            if meta.get("node_kind") != "daemon":
+                continue
+            if not n["alive"]:
+                if nid in self._known:
+                    self._drop_node(nid)
+                continue
+            if nid not in self._known:
+                total = ResourceSet(meta.get("resources", {"CPU": 1.0}))
+                node = RemoteNodeState(nid, total, meta)
+                node.labels.update(meta.get("labels") or {})
+                self._known.add(nid)
+                self._endpoints[nid] = (node.host, node.object_port)
+                self.rt.scheduler.add_node(node)
+                logger.info("joined remote node %s (%s:%d)",
+                            nid, node.host, node.dispatch_port)
+            if n.get("load"):
+                with contextlib.suppress(ValueError):
+                    load = json.loads(n["load"])
+                    self.rt.scheduler.update_node_report(
+                        nid, ResourceSet(load.get("available", {})),
+                        int(load.get("queued", 0)))
+
+    def _on_node_event(self, payload: bytes) -> None:
+        text = payload.decode(errors="replace")
+        state, _, nid = text.partition(":")
+        if state == "DEAD":
+            self._drop_node(nid)
+        elif state == "ALIVE":
+            self.sync_nodes()
+
+    def _drop_node(self, node_id: str) -> None:
+        with self._sync_lock:
+            if node_id not in self._known:
+                return
+            self._known.discard(node_id)
+        self._endpoints.pop(node_id, None)
+        if self._pulls is not None:
+            self._pulls.drop(node_id)
+        node = self.rt.scheduler.remove_node(node_id)
+        logger.warning("remote node %s died", node_id)
+        # Actors placed there: sever their connections so their mailbox
+        # threads observe the death NOW and run restart-with-replacement
+        # instead of waiting on a half-open TCP connection.
+        with self.rt._actors_lock:
+            actors = [st for st in self.rt._actors.values()
+                      if getattr(st.node, "node_id", None) == node_id]
+        for st in actors:
+            conn = getattr(st, "_conn", None)
+            if conn is not None:
+                conn.close()
+        del node
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(config.cluster_poll_interval_s):
+            self.sync_nodes()
+
+    # -- arg packing ------------------------------------------------------
+    def pack_arg(self, v, fetch: List[Tuple[bytes, str, int]],
+                 target: RemoteNodeState):
+        """ObjectRef → wire marker + fetch hint. Mirrors
+        Runtime._pack_arg but payloads may live on ANY node's arena."""
+        from ..core.runtime import _ShmMarker
+        from .worker_proc import SerArg, ShmArg
+
+        if not isinstance(v, ObjectRef):
+            return v
+        rt = self.rt
+        while True:
+            stored = rt.store.get_if_exists(v.id())
+            if stored is None:
+                rt._require_recoverable(v.id())
+                rt._maybe_reconstruct([v.id()])
+                stored = rt.store.get([v.id()], timeout=None)[0]
+            d = stored.data
+            if not isinstance(d, _ShmMarker):
+                return SerArg(d.to_bytes(), stored.is_error)
+            loc = getattr(d, "node_id", None)
+            if loc is None:
+                # Owner-local (driver arena): daemon pulls from us.
+                if rt.shm is not None and rt.shm.contains(d.key):
+                    fetch.append((d.key, self.advertise_host,
+                                  self.object_port))
+                    return ShmArg(d.key, stored.is_error)
+            else:
+                # Remote arena — including the target's own: the fetch
+                # entry makes the daemon CHECK presence (contains()
+                # short-circuits a self-pull), so a payload evicted on
+                # the target surfaces as fetch_failed → reconstruction
+                # instead of a user-visible KeyError in the worker.
+                ep = self._endpoints.get(loc)
+                if ep is not None:
+                    fetch.append((d.key, ep[0], ep[1]))
+                    return ShmArg(d.key, stored.is_error)
+            # Payload gone (evicted locally / node dead) — reconstruct.
+            rt.store.delete([v.id()])
+            rt._require_recoverable(v.id())
+            rt._maybe_reconstruct([v.id()])
+
+    # -- remote execution -------------------------------------------------
+    def _build_task_msg(self, spec: TaskSpec, node: RemoteNodeState
+                        ) -> Dict[str, Any]:
+        import cloudpickle
+
+        streaming = spec.num_returns in ("streaming", "dynamic")
+        fetch: List[Tuple[bytes, str, int]] = []
+        msg = {
+            "type": "task", "task_id": spec.task_id,
+            "fid": spec.descriptor.function_id,
+            "args": tuple(self.pack_arg(a, fetch, node)
+                          for a in spec.args),
+            "kwargs": {k: self.pack_arg(v, fetch, node)
+                       for k, v in spec.kwargs.items()},
+            "num_returns": 0 if streaming else spec.num_returns,
+            "return_ids": [oid.binary() for oid in spec.return_ids],
+            "streaming": streaming,
+            "fetch": fetch,
+            "resources": spec.resources.to_dict(),
+            "max_calls": spec.max_calls,
+        }
+        if streaming and spec.task_id in self.rt._generators:
+            # Live consumer only — reconstruction re-runs have nobody
+            # sending credits; a watermark would deadlock the worker.
+            msg["backpressure"] = config.generator_backpressure_max_items
+        if spec.runtime_env:
+            msg["runtime_env"] = spec.runtime_env
+        if spec.descriptor.function_id not in node.exported_fids:
+            msg["fn"] = cloudpickle.dumps(
+                self.rt.function_manager.get(spec.descriptor.function_id))
+        return msg
+
+    def execute_remote(self, spec: TaskSpec, node: RemoteNodeState) -> None:
+        from ..node.client import NodeDispatchError
+        from .runtime import _wrap
+        from .worker_proc import WorkerCrashedError
+
+        rt = self.rt
+        t0 = time.monotonic()
+        retried = False
+        streaming = spec.num_returns in ("streaming", "dynamic")
+        gst = rt._generators.get(spec.task_id) if streaming else None
+        try:
+            if spec.task_id in rt._cancelled:
+                raise TaskCancelledError(spec.display_name())
+
+            def on_stream(item):
+                oid = ObjectID.for_return(spec.task_id, item["index"])
+                with rt.lineage_lock:
+                    rt.lineage[oid] = spec
+                rt._store_packed(oid, item["payload"],
+                                 node_id=node.node_id)
+                if gst is not None:
+                    ref = rt.register_ref(ObjectRef(oid))
+                    with gst.cv:
+                        gst.refs.append(ref)
+                        gst.cv.notify_all()
+
+            def set_ack(fn):
+                if gst is not None:
+                    with gst.cv:
+                        gst.ack_cb = fn
+
+            reply = None
+            for _attempt in (0, 1):
+                msg = self._build_task_msg(spec, node)
+                if _attempt:
+                    import cloudpickle
+
+                    msg["fn"] = cloudpickle.dumps(
+                        rt.function_manager.get(
+                            spec.descriptor.function_id))
+                reply = node.client.call(
+                    msg, on_stream=on_stream if streaming else None,
+                    ack_setter=set_ack if streaming else None)
+                if not reply.get("need_fn"):
+                    break
+            node.exported_fids.add(spec.descriptor.function_id)
+            if reply.get("fetch_failed"):
+                # An arg's payload vanished between packing and the
+                # daemon's pull: reconstruct it and requeue without
+                # burning user retries (object loss, not task failure —
+                # reference: object_recovery_manager.h).
+                key = reply["fetch_failed"]
+                oid = ObjectID(key)
+                spec._fetch_retries = getattr(spec, "_fetch_retries", 0) + 1
+                if spec._fetch_retries > 3:
+                    raise ObjectLostError(
+                        f"arg {oid.hex()[:16]} unfetchable after "
+                        "3 reconstruction attempts")
+                rt.store.delete([oid])
+                rt._maybe_reconstruct([oid])
+                retried = True
+                rt._submit_when_ready(spec)
+                return
+            if reply.get("crashed"):
+                raise WorkerCrashedError(reply["crashed"])
+            if reply.get("error") is not None:
+                raise rt._unpack_error(reply["error"])
+            if streaming and gst is not None:
+                with gst.cv:
+                    gst.done = True
+                    gst.cv.notify_all()
+                rt._generators.pop(spec.task_id, None)
+            else:
+                for oid, packed in zip(spec.return_ids, reply["returns"]):
+                    rt._store_packed(oid, packed, node_id=node.node_id)
+        except NodeDispatchError as e:
+            # Connection-level failure: the daemon is unreachable. Drop
+            # the node NOW (socket-error failure detection — reference:
+            # workers detect raylet death via the socket) so the retry
+            # lands elsewhere; if the daemon is actually fine, the next
+            # membership sync re-adds it.
+            self._drop_node(node.node_id)
+            retried = rt._maybe_retry_system(spec, e)
+            if not retried:
+                rt._store_error(spec, _wrap(spec, e), t0)
+        except WorkerCrashedError as e:
+            retried = rt._maybe_retry_system(spec, e)
+            if not retried:
+                rt._store_error(spec, _wrap(spec, e), t0)
+        except BaseException as e:  # noqa: BLE001
+            retried = rt._maybe_retry(spec, e)
+            if not retried:
+                rt._store_error(spec, _wrap(spec, e), t0)
+        finally:
+            if not retried:
+                rt._task_finished(spec)
+            rt.scheduler.release_task(spec, node.node_id)
+            rt.events.record(spec.display_name(), t0, time.monotonic(),
+                             node.node_id, spec.task_id.hex())
+
+    # -- cross-node object pulls (driver get) ----------------------------
+    def ensure_local(self, marker) -> None:
+        """Pull a remote-located object into the driver's arena.
+        Raises KeyError when it cannot be fetched (→ reconstruction)."""
+        rt = self.rt
+        if rt.shm is None or self._pulls is None:
+            raise KeyError(marker.key)
+        if rt.shm.contains(marker.key):
+            return
+        ep = self._endpoints.get(marker.node_id)
+        if ep is None:
+            raise KeyError(marker.key)
+        try:
+            self._pulls.pull(marker.node_id, ep, marker.key)
+        except Exception:  # noqa: BLE001 — node died mid-pull
+            if not rt.shm.contains(marker.key):
+                raise KeyError(marker.key) from None
+
+    # -- actor placement --------------------------------------------------
+    def replace_node_for(self, st) -> Optional[RemoteNodeState]:
+        """Find a new home for an actor whose node died; charges the
+        actor's resources on the chosen node (the old charge died with
+        the old node). Reference: GcsActorScheduler re-leasing a worker
+        on a live node after node failure."""
+        deadline = time.monotonic() + config.actor_replace_timeout_s
+        while time.monotonic() < deadline:
+            nodes = [n for n in self.rt.scheduler.nodes()
+                     if isinstance(n, RemoteNodeState) and n.alive
+                     and st.resources.fits(n.available)]
+            if nodes:
+                node = min(nodes, key=lambda n: n.utilization())
+                with self.rt.scheduler._lock:
+                    node.charge(st.resources)
+                return node
+            time.sleep(0.1)
+        return None
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with contextlib.suppress(Exception):
+            self.control.close()
+        if self._pulls is not None:
+            self._pulls.close()
+        if self.transfer_server is not None:
+            with contextlib.suppress(Exception):
+                self.transfer_server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Remote actors
+# ---------------------------------------------------------------------------
+
+_remote_actor_cls = None
+
+
+def remote_actor_state_cls():
+    """RemoteProcActorState, built lazily (runtime.py imports this
+    module's names lazily too — a top-level subclass would be a cycle)."""
+    global _remote_actor_cls
+    if _remote_actor_cls is not None:
+        return _remote_actor_cls
+
+    import cloudpickle
+
+    from ..node.client import NodeDispatchError
+    from .exceptions import TaskError
+    from .runtime import ProcActorState, _wrap
+    from .worker_proc import WorkerCrashedError
+
+    class RemoteProcActorState(ProcActorState):
+        """An actor hosted by a dedicated worker on a REMOTE node
+        daemon. Reuses ActorState's mailbox/restart machinery; the
+        dedicated long-lived connection (one in-flight call, serial)
+        preserves per-caller call order. Node death severs the
+        connection → the normal restartable-crash path runs, and
+        _construct re-places the actor on a surviving node
+        (reference: gcs_actor_manager.h:513 ReconstructActor)."""
+
+        def __init__(self, *args, **kwargs):
+            self._conn = None
+            super().__init__(*args, **kwargs)
+
+        @property
+        def _plane(self) -> RemotePlane:
+            return self.rt.remote_plane
+
+        def _construct(self, gen: int) -> bool:
+            plane = self._plane
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+            # Node-resolution loop: an unreachable node is DROPPED and a
+            # replacement picked without burning max_restarts — node
+            # unreachability is placement failure, not actor failure
+            # (reference: GcsActorScheduler retries leasing elsewhere).
+            deadline = time.monotonic() + config.actor_replace_timeout_s
+            last_err: Optional[BaseException] = None
+            while time.monotonic() < deadline:
+                if (not self.node.alive
+                        or self.node.node_id not in plane._known):
+                    node = plane.replace_node_for(self)
+                    if node is None:
+                        break
+                    self.node = node
+                conn = None
+                try:
+                    fetch: List[Tuple[bytes, str, int]] = []
+                    msg = {
+                        "type": "actor_create", "task_id": None,
+                        "actor_id": self.actor_id.binary(),
+                        "cls": cloudpickle.dumps(self.cls),
+                        "args": tuple(
+                            plane.pack_arg(a, fetch, self.node)
+                            for a in self.init_args),
+                        "kwargs": {
+                            k: plane.pack_arg(v, fetch, self.node)
+                            for k, v in self.init_kwargs.items()},
+                        "fetch": fetch,
+                        "resources": self.resources.to_dict(),
+                    }
+                    if self.runtime_env:
+                        msg["runtime_env"] = self.runtime_env
+                    conn = self.node.client.open_conn()
+                    reply = conn.request(msg)
+                except NodeDispatchError as e:
+                    if conn is not None:
+                        conn.close()
+                    last_err = e
+                    plane._drop_node(self.node.node_id)
+                    time.sleep(0.1)
+                    continue
+                except OSError as e:  # open_conn refused
+                    last_err = e
+                    plane._drop_node(self.node.node_id)
+                    time.sleep(0.1)
+                    continue
+                try:
+                    if reply.get("crashed"):
+                        raise WorkerCrashedError(reply["crashed"])
+                    if reply.get("fetch_failed"):
+                        raise WorkerCrashedError(
+                            "actor init arg unfetchable "
+                            f"({ObjectID(reply['fetch_failed']).hex()[:16]})")
+                    if reply.get("error") is not None:
+                        raise self.rt._unpack_error(reply["error"])
+                    self._conn = conn
+                    self.instance = conn  # marker: lives remotely
+                    self.ready.set()
+                    return True
+                except BaseException as e:  # noqa: BLE001
+                    conn.close()
+                    if isinstance(e, WorkerCrashedError):
+                        self._restartable_kill = True
+                    self.death_cause = TaskError(
+                        self.cls.__name__ + ".__init__", e)
+                    self._die(gen)
+                    return False
+            self.death_cause = ActorDiedError(
+                self.actor_id.hex(),
+                f"no surviving node can host this actor "
+                f"(last error: {last_err})")
+            self._restartable_kill = False
+            self._die(gen)
+            return False
+
+        def _run_method(self, spec: TaskSpec):
+            rt = self.rt
+            plane = self._plane
+            spec.redelivered = False
+            t0 = time.monotonic()
+            streaming = spec.num_returns in ("streaming", "dynamic")
+            gst = rt._generators.get(spec.task_id) if streaming else None
+            try:
+                fetch: List[Tuple[bytes, str, int]] = []
+                msg = {
+                    "type": "actor_call", "task_id": spec.task_id,
+                    "actor_id": self.actor_id.binary(),
+                    "method": spec.method_name,
+                    "args": tuple(plane.pack_arg(a, fetch, self.node)
+                                  for a in spec.args),
+                    "kwargs": {k: plane.pack_arg(v, fetch, self.node)
+                               for k, v in spec.kwargs.items()},
+                    "num_returns": 0 if streaming else spec.num_returns,
+                    "return_ids": [oid.binary()
+                                   for oid in spec.return_ids],
+                    "streaming": streaming,
+                    "fetch": fetch,
+                }
+                if streaming and gst is not None:
+                    msg["backpressure"] = \
+                        config.generator_backpressure_max_items
+                if self.runtime_env:
+                    msg["runtime_env"] = self.runtime_env
+
+                def on_stream(item):
+                    oid = ObjectID.for_return(spec.task_id, item["index"])
+                    with rt.lineage_lock:
+                        rt.lineage[oid] = spec
+                    rt._store_packed(oid, item["payload"],
+                                     node_id=self.node.node_id)
+                    if gst is not None:
+                        ref = rt.register_ref(ObjectRef(oid))
+                        with gst.cv:
+                            gst.refs.append(ref)
+                            gst.cv.notify_all()
+
+                if gst is not None:
+                    with gst.cv:
+                        gst.ack_cb = self._conn.send_ack
+                try:
+                    reply = self._conn.request(
+                        msg, on_stream=on_stream if streaming else None)
+                finally:
+                    if gst is not None:
+                        with gst.cv:
+                            gst.ack_cb = None
+                if reply.get("crashed"):
+                    raise WorkerCrashedError(reply["crashed"])
+                if reply.get("fetch_failed"):
+                    raise WorkerCrashedError(
+                        "actor call arg unfetchable")
+                if reply.get("error") is not None:
+                    err = rt._unpack_error(reply["error"])
+                    from .runtime import _ActorExit
+
+                    if isinstance(err, _ActorExit):
+                        rt._store_results(spec, None, t0)
+                        self.death_cause = ActorDiedError(
+                            self.actor_id.hex(),
+                            "exit_actor() was called.")
+                        self.dead.set()
+                        return
+                    raise err
+                if streaming and gst is not None:
+                    with gst.cv:
+                        gst.done = True
+                        gst.cv.notify_all()
+                    rt._generators.pop(spec.task_id, None)
+                else:
+                    for oid, packed in zip(spec.return_ids,
+                                           reply["returns"]):
+                        rt._store_packed(oid, packed,
+                                         node_id=self.node.node_id)
+            except (WorkerCrashedError, NodeDispatchError) as e:
+                left = spec.task_retries_left
+                if left is None:
+                    left = self.max_task_retries
+                will_restart = self.restarts < self.max_restarts
+                self.death_cause = ActorDiedError(
+                    self.actor_id.hex(), f"actor worker died: {e}")
+                self._restartable_kill = True
+                if (left != 0) and will_restart and not streaming:
+                    spec.task_retries_left = (left - 1 if left > 0
+                                              else left)
+                    spec.redelivered = True
+                    self.redeliver_q.put(spec)
+                    self.dead.set()
+                    return
+                rt._store_error(spec, _wrap(spec, e), t0)
+                self.dead.set()
+            except BaseException as e:  # noqa: BLE001
+                rt._store_error(spec, _wrap(spec, e), t0)
+            finally:
+                if not spec.redelivered:
+                    rt._task_finished(spec)
+
+        def _die(self, gen: int):
+            # Skip ProcActorState._die (pool retire) — the worker lives
+            # on the daemon; tell it to drop the actor instead.
+            from .runtime import ActorState
+
+            ActorState._die(self, gen)
+            if self.dead.is_set():
+                conn, self._conn = self._conn, None
+                if conn is not None:
+                    conn.close()
+                if self.node.alive:
+                    with contextlib.suppress(Exception):
+                        self.node.client.call({
+                            "type": "actor_kill",
+                            "actor_id": self.actor_id.binary()})
+
+    _remote_actor_cls = RemoteProcActorState
+    return _remote_actor_cls

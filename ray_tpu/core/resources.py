@@ -79,6 +79,15 @@ class ResourceSet:
             out[k] = nv
         return ResourceSet(_fixed=out)
 
+    def sub_clamp0(self, other: "ResourceSet") -> "ResourceSet":
+        """Element-wise subtraction clamped at zero (availability-view
+        arithmetic for resource-view sync, where stale reports must not
+        drive a view negative)."""
+        out = dict(self._r)
+        for k, v in other._r.items():
+            out[k] = max(0, out.get(k, 0) - v)
+        return ResourceSet(_fixed=out)
+
     def scaled_utilization(self, total: "ResourceSet") -> float:
         """Max over resources of used/total — the hybrid policy's load signal."""
         util = 0.0

@@ -148,13 +148,23 @@ class _GeneratorState:
         self.cv = threading.Condition()
         self.refs: List[ObjectRef] = []
         self.done = False
+        # Backpressure (reference: GeneratorWaiter, core_worker.h):
+        # `consumed` advances as the iterator hands out refs; producers
+        # pause while produced − consumed exceeds the watermark.
+        # `ack_cb` (set while an out-of-process producer is streaming)
+        # forwards consumption credits to the producing worker; call it
+        # under `cv` — the producer side clears it under the same lock.
+        self.consumed = 0
+        self.ack_cb = None
+        self.abandoned = False
 
 
 class ObjectRefGenerator:
     """Streaming-returns iterator
     (reference: python/ray/_raylet.pyx:272 ObjectRefGenerator): yields
-    ObjectRefs as the remote generator produces them, with backpressure-free
-    local semantics; also usable as an async iterator."""
+    ObjectRefs as the remote generator produces them; consumption feeds
+    producer backpressure (generator_backpressure_max_items); also
+    usable as an async iterator."""
 
     def __init__(self, task_id: TaskID, state: _GeneratorState):
         self._task_id = task_id
@@ -172,8 +182,27 @@ class ObjectRefGenerator:
             if len(st.refs) > self._i:
                 ref = st.refs[self._i]
                 self._i += 1
+                if self._i > st.consumed:
+                    st.consumed = self._i
+                    if st.ack_cb is not None:
+                        st.ack_cb(1)
+                    st.cv.notify_all()
                 return ref
             raise StopIteration
+
+    def __del__(self):
+        # Consumer gone: release any paused producer for good.
+        st = getattr(self, "_state", None)
+        if st is None:
+            return
+        try:
+            with st.cv:
+                st.abandoned = True
+                if st.ack_cb is not None:
+                    st.ack_cb(1 << 20)
+                st.cv.notify_all()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
     def __aiter__(self):
         return self
@@ -593,6 +622,9 @@ class ProcActorState(ActorState):
                 "return_ids": [oid.binary() for oid in spec.return_ids],
                 "streaming": streaming,
             }
+            if streaming and gst is not None:
+                msg["backpressure"] = \
+                    config.generator_backpressure_max_items
             if self.runtime_env:
                 msg["runtime_env"] = self.runtime_env
 
@@ -607,8 +639,16 @@ class ProcActorState(ActorState):
                         gst.refs.append(ref)
                         gst.cv.notify_all()
 
-            reply = self._worker.run_task(
-                msg, on_stream=on_stream if streaming else None)
+            if gst is not None:
+                with gst.cv:
+                    gst.ack_cb = self._worker.send_ack
+            try:
+                reply = self._worker.run_task(
+                    msg, on_stream=on_stream if streaming else None)
+            finally:
+                if gst is not None:
+                    with gst.cv:
+                        gst.ack_cb = None
             if reply.get("error") is not None:
                 err = self.rt._unpack_error(reply["error"])
                 if isinstance(err, _ActorExit):
@@ -679,12 +719,18 @@ def _wrap(spec: TaskSpec, e: BaseException) -> BaseException:
 
 
 class _ShmMarker:
-    """Memory-store placeholder for a payload living in the shm plane."""
+    """Memory-store placeholder for a payload living in the shm plane.
 
-    __slots__ = ("key", "contained_refs")
+    node_id records which node's arena holds the payload (None = this
+    process's own arena) — the ownership-based object directory of the
+    multi-host plane (reference: ownership_based_object_directory.h:
+    the owner knows each object's locations)."""
 
-    def __init__(self, key: bytes):
+    __slots__ = ("key", "contained_refs", "node_id")
+
+    def __init__(self, key: bytes, node_id: Optional[str] = None):
         self.key = key
+        self.node_id = node_id
         self.contained_refs = ()
 
     def total_bytes(self) -> int:
@@ -716,9 +762,12 @@ class Runtime:
                  num_tpus: Optional[float] = None,
                  resources: Optional[Dict[str, float]] = None,
                  num_worker_procs: int = 0,
+                 cluster_address: Optional[str] = None,
+                 advertise_host: str = "127.0.0.1",
                  _system_config: Optional[Dict[str, Any]] = None):
         config.apply(_system_config)
         self.job_id = JobID.from_random()
+        self.remote_plane = None  # set below in cluster mode
         # Session directory first: the spiller lands under it.
         from .._private import session as _session
 
@@ -782,6 +831,15 @@ class Runtime:
         except Exception:  # noqa: BLE001 — shm plane is optional
             self.shm = None
 
+        if cluster_address is not None:
+            # Joining a daemon-backed cluster: the driver contributes no
+            # schedulable resources by default — work goes to the node
+            # daemons (reference: a driver's raylet still schedules, but
+            # the TPU deployment model is drivers on CPU frontends).
+            if num_cpus is None:
+                num_cpus = 0.0
+            if num_tpus is None:
+                num_tpus = 0.0
         if num_cpus is None:
             num_cpus = float(os.cpu_count() or 1)
         if num_tpus is None:
@@ -823,6 +881,15 @@ class Runtime:
 
                 self.log_monitor = LogMonitor(
                     os.path.join(self.session_dir, "logs")).start()
+
+        # Multi-host plane: join a control-plane-backed cluster of node
+        # daemons (ray-tpu start); their nodes appear in the scheduler
+        # as RemoteNodeState entries (core/remote_node.py).
+        if cluster_address is not None:
+            from .remote_node import RemotePlane
+
+            self.remote_plane = RemotePlane(
+                self, cluster_address, advertise_host=advertise_host)
 
     @staticmethod
     def _detect_tpus() -> int:
@@ -898,6 +965,12 @@ class Runtime:
         d = stored.data
         if not isinstance(d, _ShmMarker):
             return d
+        # Remote-located payload (multi-host plane): pull it into the
+        # local arena first (reference: raylet PullManager restoring a
+        # needed object from its remote location).
+        if (d.node_id is not None and self.remote_plane is not None
+                and (self.shm is None or not self.shm.contains(d.key))):
+            self.remote_plane.ensure_local(d)
         # Pin while copying out: an unpinned region can be evicted and
         # its bytes reused by a concurrent put mid-read.
         view = self.shm.get(d.key, pin=True) if self.shm is not None else None
@@ -1099,8 +1172,13 @@ class Runtime:
 
         def on_placed(node: NodeState):
             try:
-                state_cls = (ProcActorState if isinstance(
-                    node, ProcNodeState) else ActorState)
+                if node.is_remote:
+                    from .remote_node import remote_actor_state_cls
+
+                    state_cls = remote_actor_state_cls()
+                else:
+                    state_cls = (ProcActorState if isinstance(
+                        node, ProcNodeState) else ActorState)
                 st = state_cls(
                     self, actor_id, cls, spec.args, spec.kwargs,
                     node=node, name=name or actor_id.hex()[:8],
@@ -1232,6 +1310,22 @@ class Runtime:
             # Resources stay held by the actor until death.
             spec.actor_placement_cb(node)  # type: ignore[attr-defined]
             return
+        if node.is_remote:
+            fut = node.executor.submit(
+                self.remote_plane.execute_remote, spec, node)
+
+            # Node death shuts the executor with cancel_futures=True:
+            # granted-but-unstarted tasks would otherwise vanish (refs
+            # never resolve). Requeue them — their charge is released
+            # and the scheduler places them on a survivor.
+            def _requeue_if_cancelled(f, spec=spec, node=node):
+                if not f.cancelled() or self._shutdown:
+                    return
+                self.scheduler.release_task(spec, node.node_id)
+                self._submit_when_ready(spec)
+
+            fut.add_done_callback(_requeue_if_cancelled)
+            return
         if isinstance(node, ProcNodeState):
             node.executor.submit(self._execute_proc, spec, node)
             return
@@ -1257,6 +1351,14 @@ class Runtime:
             if isinstance(d, _ShmMarker):
                 if self.shm is not None and self.shm.contains(d.key):
                     return ShmArg(d.key, stored.is_error)
+                if d.node_id is not None and self.remote_plane is not None:
+                    # Remote-located (multi-host plane): pull it into
+                    # the local arena for the local worker.
+                    try:
+                        self.remote_plane.ensure_local(d)
+                        return ShmArg(d.key, stored.is_error)
+                    except KeyError:
+                        pass  # source node gone — reconstruct below
                 self._require_recoverable(v.id())
                 self.store.delete([v.id()])  # evicted — reconstruct
                 self._maybe_reconstruct([v.id()])
@@ -1290,6 +1392,10 @@ class Runtime:
             "return_ids": [oid.binary() for oid in spec.return_ids],
             "streaming": streaming,
         }
+        if streaming and spec.task_id in self._generators:
+            # Only with a LIVE consumer: reconstruction re-runs have
+            # nobody sending credits — a watermark would deadlock them.
+            msg["backpressure"] = config.generator_backpressure_max_items
         if spec.runtime_env:
             msg["runtime_env"] = spec.runtime_env
         if fid not in worker.exported_fns:
@@ -1297,12 +1403,15 @@ class Runtime:
                 self.function_manager.get(fid))
         return msg
 
-    def _store_packed(self, oid: ObjectID, packed):
-        """Store a worker-produced ('shm'|'ser', payload) wire value."""
+    def _store_packed(self, oid: ObjectID, packed,
+                      node_id: Optional[str] = None):
+        """Store a worker-produced ('shm'|'ser', payload) wire value.
+        node_id = which node's arena holds a 'shm' payload (None =
+        the local arena)."""
         kind, payload = packed
         if kind == "shm":
             # Worker already wrote the bytes under the return id.
-            self.store.put(oid, _ShmMarker(payload))
+            self.store.put(oid, _ShmMarker(payload, node_id=node_id))
         else:
             self.store.put(
                 oid, serialization.SerializedObject.from_bytes(payload))
@@ -1358,8 +1467,16 @@ class Runtime:
                         gst.cv.notify_all()
 
             ran_on_worker = True  # run_task reached the worker
-            reply = worker.run_task(
-                msg, on_stream=on_stream if streaming else None)
+            if gst is not None:
+                with gst.cv:
+                    gst.ack_cb = worker.send_ack
+            try:
+                reply = worker.run_task(
+                    msg, on_stream=on_stream if streaming else None)
+            finally:
+                if gst is not None:
+                    with gst.cv:
+                        gst.ack_cb = None
             worker.exported_fns.add(msg["fid"])
             if reply.get("error") is not None:
                 raise self._unpack_error(reply["error"])
@@ -1495,7 +1612,9 @@ class Runtime:
     def _consume_generator(self, spec: TaskSpec, gen):
         # Reconstruction re-runs have no live consumer: use a throwaway
         # state so the items still get re-stored.
-        st = self._generators.get(spec.task_id) or _GeneratorState()
+        live = self._generators.get(spec.task_id)
+        st = live or _GeneratorState()
+        bp = config.generator_backpressure_max_items
         i = 0
         try:
             for item in gen:
@@ -1507,6 +1626,15 @@ class Runtime:
                 with st.cv:
                     st.refs.append(ref)
                     st.cv.notify_all()
+                    # Pause the producer while the consumer lags
+                    # (reference: GeneratorWaiter backpressure). Only
+                    # for live consumers — a reconstruction run just
+                    # re-stores.
+                    if bp > 0 and live is not None:
+                        while (len(st.refs) - st.consumed >= bp
+                               and not st.abandoned
+                               and spec.task_id not in self._cancelled):
+                            st.cv.wait(timeout=0.5)
                 i += 1
         except BaseException as e:  # noqa: BLE001
             oid = ObjectID.for_return(spec.task_id, i)
@@ -1620,6 +1748,11 @@ class Runtime:
 
     def shutdown(self):
         self._shutdown = True
+        if self.remote_plane is not None:
+            try:
+                self.remote_plane.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
         if self.log_monitor is not None:
             try:
                 self.log_monitor.stop()

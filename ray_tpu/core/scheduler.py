@@ -58,15 +58,41 @@ def _is_constrained(strategy) -> bool:
 class NodeState:
     """One schedulable node: a resource view plus an executor."""
 
+    is_remote = False  # RemoteNodeState (node-daemon plane) overrides
+
     def __init__(self, node_id: str, total: ResourceSet, max_workers: int):
         self.node_id = node_id
         self.total = total
         self.available = total
+        # Resource-view bookkeeping: `available` is DERIVED as
+        # total − charged − foreign. `charged` holds this scheduler's
+        # own grants (tasks in flight, live actors, PG reservations);
+        # `foreign` is other schedulers' usage estimated from heartbeat
+        # load reports (resource-view sync, reference ray_syncer.h:88).
+        self.charged = ResourceSet({})
+        self.foreign = ResourceSet({})
         self.labels: Dict[str, str] = {}
         self.alive = True
         self.executor = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix=f"worker-{node_id}"
         )
+
+    # Call only under the owning Scheduler's lock.
+    def charge(self, resources: ResourceSet) -> None:
+        self.charged = self.charged.add(resources)
+        self._recompute_available()
+
+    def uncharge(self, resources: ResourceSet) -> None:
+        self.charged = self.charged.sub_clamp0(resources)
+        self._recompute_available()
+
+    def set_foreign(self, foreign: ResourceSet) -> None:
+        self.foreign = foreign
+        self._recompute_available()
+
+    def _recompute_available(self) -> None:
+        self.available = self.total.sub_clamp0(
+            self.charged).sub_clamp0(self.foreign)
 
     def utilization(self) -> float:
         return self.available.scaled_utilization(self.total)
@@ -119,15 +145,18 @@ class Scheduler:
             return [t.resources for t in self._queue + self._infeasible]
 
     def pending_demand_detailed(self) -> List[tuple]:
-        """[(ResourceSet, placement_constrained)] — constrained demand
-        (hard affinity / PG bundles) can't be absorbed by arbitrary free
-        capacity, so the autoscaler must not net it out."""
+        """[(ResourceSet, hard_constrained, label_selector)] —
+        hard-constrained demand (PG bundles / hard node or slice
+        affinity) can't be absorbed by arbitrary free capacity, so the
+        autoscaler must not net it out; label-selector demand CAN be
+        netted, but only against capacity whose labels satisfy the
+        selector."""
         with self._lock:
             out = []
             for t in self._queue + self._infeasible:
-                constrained = (_is_constrained(t.scheduling_strategy)
-                               or bool(t.label_selector))
-                out.append((t.resources, constrained))
+                hard = _is_constrained(t.scheduling_strategy)
+                out.append((t.resources, hard,
+                            dict(t.label_selector or {})))
             return out
 
     # -- scheduling -------------------------------------------------------
@@ -149,7 +178,26 @@ class Scheduler:
         with self._lock:
             node = self._nodes.get(node_id)
             if node is not None:
-                node.available = node.available.add(resources)
+                node.uncharge(resources)
+        self._pump()
+
+    def update_node_report(self, node_id: str,
+                           reported_available: ResourceSet,
+                           queued: int) -> None:
+        """Merge a node's heartbeat load report into the local view
+        (resource-view sync — capability of reference ray_syncer.h:88:
+        every scheduler sees every node's load, including other
+        drivers'). Foreign usage = reported usage minus our own charges
+        (the daemon observes our dispatched tasks too); stale reports
+        only make the view temporarily pessimistic — the next report
+        recomputes it from scratch, so there is no drift."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            reported_used = node.total.sub_clamp0(reported_available)
+            node.set_foreign(reported_used.sub_clamp0(node.charged))
+            node.reported_queued = queued
         self._pump()
 
     def release_task(self, spec: TaskSpec, node_id: str) -> None:
@@ -188,7 +236,7 @@ class Scheduler:
                     pg._bundle_available[idx] = \
                         pg._bundle_available[idx].subtract(spec.resources)
                 else:
-                    node.available = node.available.subtract(spec.resources)
+                    node.charge(spec.resources)
                 granted.append((spec, node))
             self._queue = still
         for spec, node in granted:

@@ -151,6 +151,9 @@ def main() -> None:
         if mtype == "ping":
             send_msg(sock, {"type": "pong", "worker_id": args.worker_id})
             continue
+        if mtype == "gen_ack":
+            # Late consumption credit from a finished stream — ignore.
+            continue
 
         task_id = msg.get("task_id")
         try:
@@ -205,6 +208,12 @@ def main() -> None:
         if streaming and hasattr(result, "__next__"):
             from ray_tpu.core.ids import ObjectID
 
+            # Credit-based backpressure (reference: GeneratorWaiter,
+            # core_worker.h): pause after `bp` unacknowledged items;
+            # the driver grants a credit whenever the consumer takes
+            # one. 0 = unbounded.
+            bp = msg.get("backpressure", 0)
+            inflight = 0
             i = 0
             try:
                 for item in result:
@@ -214,6 +223,15 @@ def main() -> None:
                         "payload": _pack_value(item, shm, args.inline_max,
                                                key)})
                     i += 1
+                    inflight += 1
+                    while bp and inflight >= bp:
+                        note = recv_msg(sock)
+                        ntype = note.get("type")
+                        if ntype == "gen_ack":
+                            inflight -= note.get("n", 1)
+                        elif ntype == "shutdown":
+                            return
+                        # anything else mid-stream is unexpected; skip
                 send_msg(sock, {"type": "result", "task_id": task_id,
                                 "error": None, "returns": [],
                                 "gen_count": i})

@@ -118,6 +118,18 @@ class WorkerProcess:
         self.alive = True
         self.pid = proc.pid
         self.dedicated = False           # actor-owned: not in the idle pool
+        # Consumer threads send gen_ack credits while run_task's thread
+        # is mid-conversation — sends must not interleave.
+        self._send_lock = threading.Lock()
+
+    def send_ack(self, n: int) -> None:
+        """Grant the streaming producer `n` consumption credits
+        (generator backpressure — reference: GeneratorWaiter)."""
+        try:
+            with self._send_lock:
+                send_msg(self.sock, {"type": "gen_ack", "n": n})
+        except OSError:
+            pass  # worker died; run_task surfaces it
 
     def run_task(self, msg: Dict[str, Any],
                  on_stream: Optional[Callable[[Dict[str, Any]], None]] = None
@@ -125,7 +137,8 @@ class WorkerProcess:
         """Push one task and read messages until its terminal reply.
         Streaming items (generators) are handed to on_stream."""
         try:
-            send_msg(self.sock, msg)
+            with self._send_lock:
+                send_msg(self.sock, msg)
             while True:
                 reply = recv_msg(self.sock)
                 if reply.get("type") == "gen_item":

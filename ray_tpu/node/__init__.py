@@ -1,0 +1,35 @@
+"""Per-host node plane: the raylet-equivalent daemon + its client.
+
+`ray-tpu start --head` / `--address=<control-plane>` runs a NodeDaemon
+(daemon.py) on each host; drivers connect with
+`ray_tpu.init(address="host:port")` and dispatch tasks/actors to the
+daemons over TCP (client.py), with bulk objects riding the native
+object-transfer plane between per-host shm arenas.
+
+Reference: src/ray/raylet/main.cc:119 (per-node daemon),
+node_manager.proto:365-404 (RequestWorkerLease/ReturnWorker wire
+protocol) — re-designed here as a lease-free push protocol: the driver's
+scheduler owns placement (its resource view is synced through the
+control plane's heartbeat load reports, the ray_syncer.h capability) and
+pushes ready tasks straight to the chosen daemon.
+"""
+
+# Lazy exports: `python -m ray_tpu.node.daemon` must not re-import the
+# daemon module through the package (runpy double-import warning).
+_EXPORTS = {
+    "NodeClient": "client",
+    "NodeConn": "client",
+    "NodeDispatchError": "client",
+    "NodeDaemon": "daemon",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(name)

@@ -1,0 +1,134 @@
+"""Driver-side client for a node daemon's dispatch protocol.
+
+One NodeClient per remote node. call() leases a pooled TCP connection
+for one request (a small pool gives task parallelism); open_conn()
+hands out a dedicated long-lived connection (actors — serial execution
+over one connection preserves per-actor call order, the reference's
+actor submit-queue contract, direct_actor_task_submitter.h).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.worker_proc import WorkerCrashedError, recv_msg, send_msg
+
+
+class NodeDispatchError(RuntimeError):
+    """The daemon (or the network to it) failed mid-request."""
+
+
+class NodeConn:
+    """One TCP connection; one request in flight at a time."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.settimeout(None)
+        self.alive = True
+        # Consumer threads send gen_ack credits while request()'s
+        # thread is reading the stream — sends must not interleave.
+        self._send_lock = threading.Lock()
+
+    def send_ack(self, n: int) -> None:
+        """Forward a streaming-consumption credit to the daemon
+        (generator backpressure); the daemon relays it to the worker."""
+        try:
+            with self._send_lock:
+                send_msg(self.sock, {"type": "gen_ack", "n": n})
+        except OSError:
+            self.alive = False
+
+    def request(self, msg: Dict[str, Any],
+                on_stream: Optional[Callable] = None) -> Dict[str, Any]:
+        try:
+            with self._send_lock:
+                send_msg(self.sock, msg)
+            while True:
+                reply = recv_msg(self.sock)
+                if reply.get("type") == "gen_item":
+                    if on_stream is not None:
+                        try:
+                            on_stream(reply)
+                        except BaseException:
+                            # The stream is mid-flight: this connection
+                            # must NOT return to the pool or the next
+                            # request would read leftover frames as its
+                            # own reply.
+                            self.close()
+                            raise
+                    continue
+                return reply
+        except (WorkerCrashedError, OSError, EOFError) as e:
+            self.alive = False
+            raise NodeDispatchError(str(e)) from e
+
+    def close(self) -> None:
+        self.alive = False
+        with contextlib.suppress(OSError):
+            self.sock.close()
+
+
+class NodeClient:
+    def __init__(self, node_id: str, host: str, dispatch_port: int,
+                 object_port: int):
+        self.node_id = node_id
+        self.host = host
+        self.dispatch_port = dispatch_port
+        self.object_port = object_port
+        self._idle: List[NodeConn] = []
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _get_conn(self) -> NodeConn:
+        with self._lock:
+            if self._closed:
+                raise NodeDispatchError(f"node {self.node_id} client closed")
+            if self._idle:
+                return self._idle.pop()
+        try:
+            return NodeConn(self.host, self.dispatch_port)
+        except OSError as e:
+            raise NodeDispatchError(
+                f"cannot reach node {self.node_id}: {e}") from e
+
+    def _put_conn(self, conn: NodeConn) -> None:
+        with self._lock:
+            if conn.alive and not self._closed and len(self._idle) < 32:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def call(self, msg: Dict[str, Any],
+             on_stream: Optional[Callable] = None,
+             ack_setter: Optional[Callable] = None) -> Dict[str, Any]:
+        """ack_setter (streaming): called with the connection's
+        send_ack before the request and with None after — the caller
+        wires it to the consumer so consumption credits flow back to
+        the producer while the stream is live."""
+        conn = self._get_conn()
+        try:
+            if ack_setter is not None:
+                ack_setter(conn.send_ack)
+            return conn.request(msg, on_stream=on_stream)
+        finally:
+            if ack_setter is not None:
+                ack_setter(None)
+            self._put_conn(conn)
+
+    def open_conn(self) -> NodeConn:
+        """Dedicated connection (actor lifetime); caller owns closing."""
+        return NodeConn(self.host, self.dispatch_port)
+
+    def ping(self) -> Dict[str, Any]:
+        return self.call({"type": "ping"})
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for c in idle:
+            c.close()

@@ -1,0 +1,564 @@
+"""The per-host node daemon (raylet-equivalent).
+
+One process per host. Owns the host's worker pool, shm object arena and
+object-transfer server; registers with the control plane and heartbeats
+a load report; serves task/actor dispatch over a framed-TCP protocol.
+
+Reference capabilities mirrored (not the wire protocol):
+  - src/ray/raylet/main.cc:119 — the per-node daemon composition
+    (worker pool + object manager + scheduler glue).
+  - src/ray/raylet/worker_pool.h:156 — spawn/cache workers (reused
+    directly: core/worker_proc.WorkerPool).
+  - node_manager.proto RequestWorkerLease/ReturnWorker — here the
+    driver-side scheduler pushes a ready task; the daemon leases a
+    worker from its pool for the task's duration.
+  - ray_syncer.h:88 — load reports piggybacked on heartbeats.
+
+Dispatch protocol (framed cloudpickle, one request in flight per
+connection; drivers open a small pool of connections for parallelism):
+
+  {"type": "task"|"actor_create"|"actor_call", ...worker msg fields...,
+   "fetch": [(key, host, port), ...],   # objects to pull into local shm
+   "resources": {...},                  # advisory accounting for load
+   "max_calls": N, "fn": bytes|absent}
+  → streaming {"type": "gen_item", ...} frames, then a terminal
+    {"type": "result", ...} frame. Worker-process death is reported as
+    {"type": "result", "crashed": "<why>"} so the driver can run its
+    normal retry/restart machinery.
+  {"type": "actor_kill", "actor_id": ...} → result
+  {"type": "ping"} → {"type": "pong", "load": {...}}
+  {"type": "shutdown"} → daemon exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import logging
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+logger = logging.getLogger("ray_tpu.node")
+
+
+def _load_modules():
+    """Deferred heavy imports (keep daemon start fast)."""
+    from ray_tpu._native import control_client as cc
+    from ray_tpu._native.object_transfer import TransferClient, TransferServer
+    from ray_tpu._native.shm_store import ShmStore
+    from ray_tpu.core.worker_proc import (
+        WorkerCrashedError,
+        WorkerPool,
+        recv_msg,
+        send_msg,
+    )
+
+    return cc, TransferClient, TransferServer, ShmStore, WorkerPool, \
+        WorkerCrashedError, recv_msg, send_msg
+
+
+class NodeDaemon:
+    def __init__(self, control_address: str, *,
+                 node_id: Optional[str] = None,
+                 num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 dispatch_port: int = 0,
+                 object_port: int = 0,
+                 advertise_host: str = "127.0.0.1",
+                 bind_all: bool = False,
+                 session_dir: Optional[str] = None,
+                 shm_capacity: Optional[int] = None,
+                 heartbeat_interval_s: float = 0.2):
+        (cc, TransferClient, TransferServer, ShmStore, WorkerPool,
+         WorkerCrashedError, recv_msg, send_msg) = _load_modules()
+        self._cc_mod = cc
+        self._TransferClient = TransferClient
+        self._WorkerCrashedError = WorkerCrashedError
+        self._recv_msg = recv_msg
+        self._send_msg = send_msg
+
+        from ray_tpu._private.config import config
+
+        self.node_id = node_id or f"node-{uuid.uuid4().hex[:12]}"
+        self.advertise_host = advertise_host
+        if num_cpus is None:
+            num_cpus = float(os.cpu_count() or 1)
+        if num_tpus is None:
+            from ray_tpu._private import accelerators
+
+            num_tpus = float(accelerators.num_chips_per_host())
+        self._stop = threading.Event()
+
+        # Session dir for worker logs.
+        if session_dir is None:
+            from ray_tpu._private import session as _session
+
+            session_dir = _session.new_session()
+        self.session_dir = session_dir
+        self.logs_dir = os.path.join(session_dir, "logs")
+        os.makedirs(self.logs_dir, exist_ok=True)
+
+        # Object plane: shm arena + transfer server.
+        self.shm_name = f"/rtn_{self.node_id.replace('-', '')[:20]}"
+        self.shm = ShmStore(
+            self.shm_name,
+            capacity=shm_capacity or config.object_store_memory_bytes)
+        self.transfer = TransferServer(self.shm_name, object_port,
+                                       bind_all=bind_all)
+        from ray_tpu._native.pull_pool import PullClientPool
+
+        self._pulls = PullClientPool(self.shm_name)
+
+        # Execution plane: real OS worker processes.
+        n_workers = max(1, int(num_cpus))
+        self.pool = WorkerPool(n_workers, shm_name=self.shm_name,
+                               logs_dir=self.logs_dir)
+
+        # Resource view (advisory: the driver's scheduler owns placement;
+        # this feeds the heartbeat load report for resource-view sync).
+        from ray_tpu.core.resources import CPU, TPU, ResourceSet
+
+        total = {CPU: float(num_cpus)}
+        if num_tpus:
+            total[TPU] = float(num_tpus)
+            from ray_tpu._private import accelerators
+
+            total.update(accelerators.pod_resources())
+        total.update(resources or {})
+        self.total = ResourceSet(total)
+        self._avail_lock = threading.Lock()
+        self.available = self.total
+        self._queued = 0          # tasks waiting for a worker
+        self._running = 0
+
+        # Actors hosted here: actor_id(bytes) -> dedicated WorkerProcess.
+        self._actors: Dict[bytes, Any] = {}
+        self._actors_lock = threading.Lock()
+        # Daemon-wide function cache: fid -> cloudpickled bytes.
+        self._fn_cache: Dict[bytes, bytes] = {}
+        self._fn_lock = threading.Lock()
+
+        # Dispatch server.
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("" if bind_all else "127.0.0.1", dispatch_port))
+        self._listener.listen(128)
+        self.dispatch_port = self._listener.getsockname()[1]
+
+        # Control plane registration + heartbeats.
+        host, _, port = control_address.partition(":")
+        self.control = cc.ControlClient(int(port), host=host)
+        meta = {
+            "resources": self.total.to_dict(),
+            "labels": labels or {},
+            "host": advertise_host,
+            "dispatch_port": self.dispatch_port,
+            "object_port": self.transfer.port,
+            "pid": os.getpid(),
+            "session_dir": session_dir,
+            "node_kind": "daemon",
+        }
+        self.control.register_node(self.node_id, meta=json.dumps(meta))
+        self._hb_interval = heartbeat_interval_s
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, daemon=True, name="node-heartbeat")
+        self._hb_thread.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="node-accept")
+        self._accept_thread.start()
+        logger.info("node daemon %s up: dispatch=%s:%d object=%d cpus=%s",
+                    self.node_id, advertise_host, self.dispatch_port,
+                    self.transfer.port, num_cpus)
+
+    # -- load report (resource-view sync) -------------------------------
+    def _load_report(self) -> dict:
+        with self._avail_lock:
+            return {
+                "available": self.available.to_dict(),
+                "total": self.total.to_dict(),
+                "queued": self._queued,
+                "running": self._running,
+            }
+
+    def _hb_loop(self):
+        while not self._stop.wait(self._hb_interval):
+            try:
+                self.control.heartbeat(
+                    self.node_id, load=json.dumps(self._load_report()))
+            except Exception:  # noqa: BLE001 — control plane hiccup
+                pass
+
+    def _charge(self, res) -> None:
+        with self._avail_lock:
+            self.available = self.available.subtract(res)
+            self._running += 1
+
+    def _uncharge(self, res) -> None:
+        with self._avail_lock:
+            self.available = self.available.add(res)
+            self._running -= 1
+
+    # -- object fetching -------------------------------------------------
+    def _ensure_local(self, fetch) -> Optional[bytes]:
+        """Pull each (key, host, port) into the local arena. Returns the
+        first key that could not be fetched (for the error reply)."""
+        for key, host, port in fetch or ():
+            if self.shm.contains(key):
+                continue
+            try:
+                self._pulls.pull((host, port), (host, port), key)
+            except Exception:  # noqa: BLE001 — source gone/evicted
+                if not self.shm.contains(key):
+                    return key
+        return None
+
+    # -- dispatch server -------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="node-conn").start()
+
+    def _serve_conn(self, conn: socket.socket):
+        """One request in flight per connection; actor connections are
+        long-lived and serial, which preserves per-actor call order."""
+        recv_msg, send_msg = self._recv_msg, self._send_msg
+        conn_actors: list = []  # actors created over this connection
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_msg(conn)
+                except (self._WorkerCrashedError, OSError, EOFError):
+                    return
+                mtype = msg.get("type")
+                if mtype == "shutdown":
+                    self.stop()
+                    return
+                if mtype == "ping":
+                    send_msg(conn, {"type": "pong",
+                                    "node_id": self.node_id,
+                                    "load": self._load_report()})
+                    continue
+                if mtype == "actor_kill":
+                    self._kill_actor(msg.get("actor_id"))
+                    send_msg(conn, {"type": "result", "error": None,
+                                    "returns": []})
+                    continue
+                if mtype == "gen_ack":
+                    # Late consumption credit from a finished stream.
+                    continue
+                if mtype in ("task", "actor_create", "actor_call"):
+                    self._handle_exec(conn, msg, conn_actors)
+                    continue
+                send_msg(conn, {"type": "result",
+                                "crashed": f"unknown message {mtype!r}"})
+        finally:
+            with contextlib.suppress(OSError):
+                conn.close()
+            # Driver hung up: actors created over this connection die
+            # with it (the driver holds one dedicated conn per actor; a
+            # deliberate kill arrives as actor_kill first).
+            for aid in conn_actors:
+                self._kill_actor(aid)
+
+    def _kill_actor(self, aid) -> None:
+        if aid is None:
+            return
+        with self._actors_lock:
+            entry = self._actors.pop(aid, None)
+        if entry is not None:
+            w, res = entry
+            self.pool.retire(w)
+            self._uncharge(res)
+
+    def _handle_exec(self, conn, msg: Dict[str, Any], conn_actors) -> None:
+        from ray_tpu.core.resources import ResourceSet
+
+        send_msg = self._send_msg
+        mtype = msg.pop("type")
+        fetch = msg.pop("fetch", None)
+        res = ResourceSet(msg.pop("resources", None) or {})
+        max_calls = msg.pop("max_calls", 0)
+        fn_bytes = msg.pop("fn", None)
+        fid = msg.get("fid")
+        if fn_bytes is not None and fid is not None:
+            with self._fn_lock:
+                self._fn_cache[fid] = fn_bytes
+
+        missing = self._ensure_local(fetch)
+        if missing is not None:
+            send_msg(conn, {"type": "result", "task_id": msg.get("task_id"),
+                            "fetch_failed": missing})
+            return
+
+        msg["type"] = mtype
+        if mtype == "actor_call":
+            self._run_actor_call(conn, msg)
+            return
+        if mtype == "actor_create":
+            self._run_actor_create(conn, msg, res, conn_actors)
+            return
+        self._run_task(conn, msg, res, max_calls, fid)
+
+    def _inject_fn(self, conn, msg, worker) -> bool:
+        """Ensure the worker has the function body; True = ok."""
+        fid = msg.get("fid")
+        if fid is None or fid in worker.exported_fns:
+            msg.pop("fn", None)
+            return True
+        with self._fn_lock:
+            fn_bytes = self._fn_cache.get(fid)
+        if fn_bytes is None:
+            self._send_msg(conn, {
+                "type": "result", "task_id": msg.get("task_id"),
+                "need_fn": True})
+            return False
+        msg["fn"] = fn_bytes
+        return True
+
+    def _relay_streaming(self, conn, worker, msg) -> None:
+        """Bidirectional relay for a streaming task: gen_item frames
+        flow worker→driver, gen_ack credits flow driver→worker
+        (generator backpressure), until the worker's terminal result.
+        Raises WorkerCrashedError on worker death."""
+        import selectors
+
+        recv_msg, send_msg = self._recv_msg, self._send_msg
+        with worker._send_lock:
+            send_msg(worker.sock, msg)
+        def drain_worker(last_reply) -> None:
+            # Driver hung up mid-stream: unwedge the worker (it may be
+            # waiting on credits) and drain it to a clean state so it
+            # can safely re-enter the pool.
+            worker.send_ack(1 << 30)
+            reply = last_reply
+            while reply is None or reply.get("type") != "result":
+                reply = recv_msg(worker.sock)
+
+        sel = selectors.DefaultSelector()
+        sel.register(worker.sock, selectors.EVENT_READ, "worker")
+        sel.register(conn, selectors.EVENT_READ, "driver")
+        try:
+            while True:
+                for key, _ in sel.select():
+                    if key.data == "worker":
+                        reply = recv_msg(worker.sock)  # raises on crash
+                        try:
+                            send_msg(conn, reply)
+                        except OSError:
+                            drain_worker(reply)
+                            return
+                        if reply.get("type") == "result":
+                            return
+                    else:
+                        try:
+                            note = recv_msg(conn)
+                        except (self._WorkerCrashedError, OSError):
+                            # DRIVER died (recv_msg raises the same
+                            # error type for any socket EOF) — this is
+                            # not a worker crash: drain the worker and
+                            # hand it back clean.
+                            sel.unregister(conn)
+                            drain_worker(None)
+                            return
+                        if note.get("type") == "gen_ack":
+                            with worker._send_lock:
+                                send_msg(worker.sock, note)
+        finally:
+            sel.close()
+
+    def _run_task(self, conn, msg, res, max_calls, fid) -> None:
+        send_msg = self._send_msg
+        with self._avail_lock:
+            self._queued += 1
+        worker = None
+        try:
+            worker = self.pool.acquire(timeout=300)
+        except Exception as e:  # noqa: BLE001 — pool exhausted/shutdown
+            with self._avail_lock:
+                self._queued -= 1
+            send_msg(conn, {"type": "result",
+                            "task_id": msg.get("task_id"),
+                            "crashed": f"no worker available: {e}"})
+            return
+        with self._avail_lock:
+            self._queued -= 1
+        self._charge(res)
+        ran = False
+        try:
+            if msg.get("task_id") is None:
+                msg["task_id"] = b""
+            if not self._inject_fn(conn, msg, worker):
+                return
+            ran = True
+            if msg.get("streaming"):
+                self._relay_streaming(conn, worker, msg)
+            else:
+                reply = worker.run_task(
+                    msg, on_stream=lambda item: send_msg(conn, item))
+                send_msg(conn, reply)
+            if fid is not None:
+                worker.exported_fns.add(fid)
+        except self._WorkerCrashedError as e:
+            with contextlib.suppress(Exception):
+                send_msg(conn, {"type": "result",
+                                "task_id": msg.get("task_id"),
+                                "crashed": str(e)})
+        finally:
+            self._uncharge(res)
+            if worker is not None:
+                if ran and fid is not None and max_calls > 0:
+                    worker.fn_calls[fid] = worker.fn_calls.get(fid, 0) + 1
+                    if worker.fn_calls[fid] >= max_calls:
+                        self.pool.recycle(worker)
+                        return
+                self.pool.release(worker)
+
+    def _run_actor_create(self, conn, msg, res, conn_actors) -> None:
+        send_msg = self._send_msg
+        aid = msg["actor_id"]
+        worker = None
+        try:
+            worker = self.pool.spawn_dedicated()
+            reply = worker.run_task(msg)
+            if reply.get("error") is None:
+                with self._actors_lock:
+                    self._actors[aid] = (worker, res)
+                self._charge(res)
+                conn_actors.append(aid)
+            else:
+                self.pool.retire(worker)
+            send_msg(conn, reply)
+        except self._WorkerCrashedError as e:
+            if worker is not None:
+                self.pool.retire(worker)
+            with contextlib.suppress(Exception):
+                send_msg(conn, {"type": "result",
+                                "task_id": msg.get("task_id"),
+                                "crashed": str(e)})
+
+    def _run_actor_call(self, conn, msg) -> None:
+        send_msg = self._send_msg
+        aid = msg["actor_id"]
+        with self._actors_lock:
+            entry = self._actors.get(aid)
+        if entry is None:
+            send_msg(conn, {"type": "result", "task_id": msg.get("task_id"),
+                            "crashed": "actor not hosted on this node"})
+            return
+        worker, res = entry
+        try:
+            if msg.get("streaming"):
+                self._relay_streaming(conn, worker, msg)
+            else:
+                reply = worker.run_task(
+                    msg, on_stream=lambda item: send_msg(conn, item))
+                send_msg(conn, reply)
+        except self._WorkerCrashedError as e:
+            self._kill_actor(aid)
+            with contextlib.suppress(Exception):
+                send_msg(conn, {"type": "result",
+                                "task_id": msg.get("task_id"),
+                                "crashed": str(e)})
+
+    # -- lifecycle --------------------------------------------------------
+    def run_forever(self) -> None:
+        try:
+            while not self._stop.wait(0.5):
+                pass
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        with contextlib.suppress(OSError):
+            self._listener.close()
+        with self._actors_lock:
+            actors = list(self._actors.values())
+            self._actors.clear()
+        for w, _res in actors:
+            with contextlib.suppress(Exception):
+                self.pool.retire(w)
+        self.pool.shutdown()
+        self._pulls.close()
+        with contextlib.suppress(Exception):
+            self.transfer.stop()
+        with contextlib.suppress(Exception):
+            self.shm.close()
+        # Unlink the arena — a daemon-sized /dev/shm segment must not
+        # outlive the daemon (Runtime.shutdown does the same).
+        with contextlib.suppress(Exception):
+            from ray_tpu._native.shm_store import ShmStore
+
+            ShmStore.unlink(self.shm_name)
+        with contextlib.suppress(Exception):
+            self.control.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="ray_tpu node daemon")
+    ap.add_argument("--address", required=True,
+                    help="control plane host:port")
+    ap.add_argument("--node-id", default=None)
+    ap.add_argument("--num-cpus", type=float, default=None)
+    ap.add_argument("--num-tpus", type=float, default=None)
+    ap.add_argument("--resources", default=None, help="JSON dict")
+    ap.add_argument("--labels", default=None, help="JSON dict")
+    ap.add_argument("--dispatch-port", type=int, default=0)
+    ap.add_argument("--object-port", type=int, default=0)
+    ap.add_argument("--advertise-host", default="127.0.0.1")
+    ap.add_argument("--bind-all", action="store_true")
+    ap.add_argument("--session-dir", default=None)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    daemon = NodeDaemon(
+        args.address,
+        node_id=args.node_id,
+        num_cpus=args.num_cpus,
+        num_tpus=args.num_tpus,
+        resources=json.loads(args.resources) if args.resources else None,
+        labels=json.loads(args.labels) if args.labels else None,
+        dispatch_port=args.dispatch_port,
+        object_port=args.object_port,
+        advertise_host=args.advertise_host,
+        bind_all=args.bind_all,
+        session_dir=args.session_dir,
+    )
+    # Graceful SIGTERM (`ray-tpu stop`): run stop() so the shm arena is
+    # unlinked and workers are torn down.
+    import signal
+    import sys
+
+    def _on_term(_sig, _frm):
+        daemon.stop()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+
+    # Ready marker for process supervisors (cluster_utils / CLI).
+    print(json.dumps({
+        "node_id": daemon.node_id,
+        "dispatch_port": daemon.dispatch_port,
+        "object_port": daemon.transfer.port,
+        "session_dir": daemon.session_dir,
+    }), flush=True)
+    daemon.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,115 @@ def _print(data: Any) -> None:
     print(json.dumps(data, indent=2, default=str))
 
 
+_CLUSTER_STATE_DIR = "/tmp/ray_tpu/cluster"
+
+
+def cmd_start(args) -> int:
+    """Start the per-host node daemon (+ control plane with --head).
+
+    Reference: `ray start --head / --address` (scripts/scripts.py:565)
+    spawning gcs_server + raylet; here: control_plane (native) + a
+    NodeDaemon for this host.
+    """
+    import subprocess
+
+    os.makedirs(_CLUSTER_STATE_DIR, exist_ok=True)
+    pids = []
+    cp_proc = None
+    if args.head:
+        from ray_tpu._native import control_client as cc
+
+        if not cc.available():
+            print("control_plane binary not built (make -C src)",
+                  file=sys.stderr)
+            return 1
+        cp_proc, port = cc.launch_control_plane(
+            port=args.port or 0,
+            health_timeout_ms=args.health_timeout_ms,
+            bind_all=args.bind_all)
+        address = f"{args.advertise_host}:{port}"
+        pids.append(cp_proc.pid)
+        print(f"control plane started at {address}")
+        print(f"  connect drivers with: ray_tpu.init(address={address!r})")
+        print(f"  join other hosts with: ray-tpu start --address={address}")
+    else:
+        if not args.address:
+            print("either --head or --address=<host:port> is required",
+                  file=sys.stderr)
+            return 1
+        address = args.address
+
+    cmd = [sys.executable, "-m", "ray_tpu.node.daemon",
+           "--address", address,
+           "--advertise-host", args.advertise_host]
+    if args.node_id:
+        cmd += ["--node-id", args.node_id]
+    if args.num_cpus is not None:
+        cmd += ["--num-cpus", str(args.num_cpus)]
+    if args.num_tpus is not None:
+        cmd += ["--num-tpus", str(args.num_tpus)]
+    if args.resources:
+        cmd += ["--resources", args.resources]
+    if args.labels:
+        cmd += ["--labels", args.labels]
+    if args.bind_all:
+        cmd += ["--bind-all"]
+    daemon = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                              stderr=None, text=True)
+    info = None
+    for line in daemon.stdout:
+        line = line.strip()
+        if line.startswith("{"):
+            info = json.loads(line)
+            break
+    if info is None:
+        print("node daemon failed to start", file=sys.stderr)
+        if cp_proc is not None:
+            cp_proc.terminate()  # don't leak an unrecorded control plane
+        return 1
+    pids.append(daemon.pid)
+    print(f"node daemon up: {info['node_id']} "
+          f"(dispatch port {info['dispatch_port']})")
+    # Unique per invocation (daemon pid) — a worker `start` against the
+    # same address must not overwrite the head's pid record.
+    state_file = os.path.join(
+        _CLUSTER_STATE_DIR,
+        f"{address.replace(':', '_')}_{daemon.pid}.json")
+    with open(state_file, "w") as f:
+        json.dump({"address": address, "pids": pids}, f)
+    if args.block:
+        try:
+            daemon.wait()
+        except KeyboardInterrupt:
+            pass
+        return 0
+    return 0
+
+
+def cmd_stop(args) -> int:
+    """Stop daemons started by `ray-tpu start` on this host
+    (reference: `ray stop`, scripts/scripts.py:1041)."""
+    import glob
+    import signal
+
+    stopped = 0
+    for state_file in glob.glob(os.path.join(_CLUSTER_STATE_DIR, "*.json")):
+        try:
+            info = json.load(open(state_file))
+        except ValueError:
+            os.unlink(state_file)
+            continue
+        for pid in info.get("pids", []):
+            try:
+                os.kill(pid, signal.SIGTERM)
+                stopped += 1
+            except ProcessLookupError:
+                pass
+        os.unlink(state_file)
+    print(f"stopped {stopped} process(es)")
+    return 0
+
+
 def cmd_status(args) -> int:
     if args.address:
         _print(_fetch(args.address, "/api/cluster_status"))
@@ -292,6 +401,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dashboard address of a running cluster "
                         "(e.g. http://127.0.0.1:8265)")
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    st = sub.add_parser("start",
+                        help="start this host's node daemon "
+                             "(+ control plane with --head)")
+    st.add_argument("--head", action="store_true")
+    st.add_argument("--address", default=None,
+                    help="control plane host:port (worker hosts)")
+    st.add_argument("--port", type=int, default=0,
+                    help="control plane port (--head)")
+    st.add_argument("--advertise-host", default="127.0.0.1")
+    st.add_argument("--node-id", default=None,
+                    help="register under this node id (cluster "
+                         "launchers pass the provider's id)")
+    st.add_argument("--num-cpus", type=float, default=None)
+    st.add_argument("--num-tpus", type=float, default=None)
+    st.add_argument("--resources", default=None, help="JSON dict")
+    st.add_argument("--labels", default=None, help="JSON dict")
+    st.add_argument("--bind-all", action="store_true",
+                    help="listen on 0.0.0.0 (multi-host)")
+    st.add_argument("--health-timeout-ms", type=int, default=5000)
+    st.add_argument("--block", action="store_true")
+    st.set_defaults(fn=cmd_start)
+
+    sub.add_parser("stop", help="stop daemons started on this host"
+                   ).set_defaults(fn=cmd_stop)
 
     sub.add_parser("status").set_defaults(fn=cmd_status)
 

@@ -293,7 +293,9 @@ class GPSearcher(TPESearcher):
         if isinstance(v, Integer):
             return min(max(int(round(x)), v.low), v.high - 1)
         if getattr(v, "q", None):
-            x = round(x / v.q) * v.q
+            # Clamp after q-rounding: round(x/q)*q can step outside
+            # [low, high] (e.g. high=1.0, q=0.35 → 1.05).
+            x = min(max(round(x / v.q) * v.q, v.low), v.high)
         return x
 
     def _gp_config(self) -> Dict[str, Any]:

@@ -147,6 +147,11 @@ struct Conn {
 
 struct NodeInfo {
   std::string meta;
+  // Latest load report piggybacked on a heartbeat (resource-view sync:
+  // the capability of the reference's ray_syncer.h — every scheduler
+  // reads the merged per-node load from here instead of gossiping
+  // raylet-to-raylet).
+  std::string load;
   uint64_t last_heartbeat_ms = 0;
   bool alive = true;
   bool draining = false;
@@ -454,6 +459,11 @@ void dispatch(Server& s, Conn& c, Reader& r) {
       std::string node_id = r.str();
       auto it = s.nodes.find(node_id);
       if (it == s.nodes.end()) { w.u8(ST_NOT_FOUND); break; }
+      // Optional trailing load report (older clients omit it).
+      if (r.left > 0) {
+        std::string load = r.str();
+        if (r.ok) it->second.load = std::move(load);
+      }
       it->second.last_heartbeat_ms = now_ms();
       if (!it->second.alive) {
         it->second.alive = true;
@@ -481,6 +491,7 @@ void dispatch(Server& s, Conn& c, Reader& r) {
         w.u8(n.alive ? 1 : 0);
         w.u8(n.draining ? 1 : 0);
         w.u64(now - n.last_heartbeat_ms);
+        w.str(n.load);
       }
       break;
     }
@@ -670,7 +681,10 @@ int main(int argc, char** argv) {
   int port = 0;
   uint64_t health_timeout_ms = 5000;
   const char* persist = nullptr;
-  for (int i = 1; i < argc - 1; i++) {
+  bool bind_all = false;  // 0.0.0.0 for multi-host clusters
+  for (int i = 1; i < argc; i++) {
+    if (strcmp(argv[i], "--bind-all") == 0) bind_all = true;
+    if (i >= argc - 1) continue;
     if (strcmp(argv[i], "--port") == 0) port = atoi(argv[i + 1]);
     if (strcmp(argv[i], "--health-timeout-ms") == 0)
       health_timeout_ms = strtoull(argv[i + 1], nullptr, 10);
@@ -688,7 +702,7 @@ int main(int argc, char** argv) {
   setsockopt(s.listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_addr.s_addr = htonl(bind_all ? INADDR_ANY : INADDR_LOOPBACK);
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (bind(s.listen_fd, reinterpret_cast<sockaddr*>(&addr),
            sizeof(addr)) != 0) {

@@ -271,9 +271,12 @@ class TestLabelSelector:
         while (not rt.scheduler.pending_demand()
                and _t.monotonic() < deadline):
             _t.sleep(0.02)
-        # Queued as infeasible demand, flagged constrained.
+        # Queued as infeasible demand, carrying its label selector (so
+        # the autoscaler can restrict candidate node types to matching
+        # ones instead of flagging it opaquely constrained).
         demand = rt.scheduler.pending_demand_detailed()
-        assert any(constrained for _, constrained in demand)
+        assert any(selector.get("accel") == "v5e"
+                   for _, _, selector in demand)
 
         node = NodeState("node-v5e", ResourceSet({"CPU": 2.0}),
                          max_workers=2)

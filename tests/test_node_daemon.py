@@ -1,0 +1,280 @@
+"""Multi-host node-daemon plane tests.
+
+The verdict-level contract (reference: cluster_utils.Cluster running
+real raylet processes, python/ray/cluster_utils.py:108): two daemons as
+separate OS processes on one machine run tasks + actors + PGs across
+daemons; killing one triggers retry / lineage reconstruction / actor
+restart on the survivor; resource-view sync steers work to idle nodes.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.cluster_utils import RealCluster
+from ray_tpu.core import runtime as _runtime
+
+
+@pytest.fixture(scope="module")
+def cluster2():
+    """One control plane + two 2-CPU node daemons + this driver."""
+    cluster = RealCluster()
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2)
+        cluster.connect()
+        yield cluster
+    finally:
+        cluster.shutdown()
+
+
+def _rt():
+    return _runtime.global_runtime()
+
+
+def test_nodes_join(cluster2):
+    nodes = {n.node_id for n in _rt().scheduler.nodes() if n.is_remote}
+    assert nodes == {"daemon-1", "daemon-2"}
+
+
+def test_tasks_across_daemons(cluster2):
+    @ray.remote
+    def pid_of(x):
+        import os
+
+        return x, os.getpid()
+
+    out = ray.get([pid_of.remote(i) for i in range(12)])
+    assert sorted(x for x, _ in out) == list(range(12))
+    # 4 worker processes across the two daemons; >1 distinct pid proves
+    # out-of-process, cross-daemon execution.
+    assert len({p for _, p in out}) > 1
+
+
+def test_object_flow_between_daemons(cluster2):
+    @ray.remote
+    def make():
+        return np.arange(400_000, dtype=np.float32)  # 1.6MB → shm
+
+    @ray.remote
+    def total(a):
+        return float(a.sum())
+
+    ref = make.remote()
+    # Consumed by tasks that may land on the OTHER daemon (the arg is
+    # pulled arena→arena over the transfer plane) and by the driver.
+    sums = ray.get([total.remote(ref) for _ in range(4)])
+    expect = float(np.arange(400_000, dtype=np.float32).sum())
+    assert sums == [expect] * 4
+    assert float(ray.get(ref).sum()) == expect
+
+
+def test_inline_and_error_args(cluster2):
+    @ray.remote
+    def fail():
+        raise ValueError("boom")
+
+    @ray.remote
+    def use(x):
+        return x
+
+    with pytest.raises(ray.TaskError):
+        ray.get(use.remote(fail.remote()))
+
+
+def test_actor_on_daemon(cluster2):
+    @ray.remote
+    class Counter:
+        def __init__(self, base):
+            self.n = base
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def where(self):
+            import os
+
+            return os.getpid()
+
+    c = Counter.remote(10)
+    assert ray.get([c.inc.remote() for _ in range(3)]) == [11, 12, 13]
+    import os
+
+    assert ray.get(c.where.remote()) != os.getpid()  # runs out-of-process
+
+
+def test_streaming_generator_remote(cluster2):
+    @ray.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    got = [ray.get(r) for r in gen.remote(5)]
+    assert got == [0, 1, 4, 9, 16]
+
+
+def test_placement_group_across_daemons(cluster2):
+    pg = ray.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="SPREAD")
+    ray.get(pg.ready())
+    nodes = {pg._bundle_nodes[0], pg._bundle_nodes[1]}
+    assert len(nodes) == 2  # bundles landed on different daemons
+
+    @ray.remote(num_cpus=1)
+    def where():
+        import os
+
+        return os.getpid()
+
+    strat = ray.PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    assert isinstance(ray.get(
+        where.options(scheduling_strategy=strat).remote()), int)
+    ray.remove_placement_group(pg)
+
+
+def test_load_report_foreign_usage(cluster2):
+    """Resource-view sync: another driver's usage shows up as foreign
+    load and steers placement (capability of reference ray_syncer.h)."""
+    from ray_tpu.core.resources import ResourceSet
+
+    sched = _rt().scheduler
+    node = sched.get_node("daemon-1")
+    before = node.available.to_dict().get("CPU", 0)
+    # Simulate a heartbeat report where some OTHER driver occupies the
+    # whole node.
+    sched.update_node_report("daemon-1", ResourceSet({}), queued=3)
+    assert node.available.to_dict().get("CPU", 0) == 0
+    assert node.reported_queued == 3
+
+    # Tasks now prefer daemon-2 (daemon-1 reports no capacity).
+    @ray.remote(num_cpus=1)
+    def f():
+        return 1
+
+    assert ray.get([f.remote() for _ in range(2)]) == [1, 1]
+    # A fresh truthful report restores the prior view (no drift: the
+    # view is recomputed from total - charged - foreign each report).
+    sched.update_node_report(
+        "daemon-1", ResourceSet({"CPU": 2.0}), queued=0)
+    assert node.available.to_dict().get("CPU", 0) == before
+
+
+class TestFaultTolerance:
+    """Daemon death: retries, lineage reconstruction, actor restart on
+    the survivor. Own cluster — these tests kill nodes."""
+
+    @pytest.fixture(scope="class")
+    def chaos_cluster(self):
+        ray.shutdown()  # leave any module-scoped cluster's runtime
+        cluster = RealCluster()
+        try:
+            cluster.add_node(num_cpus=2)
+            cluster.add_node(num_cpus=2)
+            cluster.connect()
+            yield cluster
+        finally:
+            cluster.shutdown()
+
+    def test_kill_daemon_recovers(self, chaos_cluster):
+        rt = _rt()
+
+        # Pin a big object's lineage to a task, locate its node, kill
+        # that node, and get() again: lineage reconstruction must rerun
+        # the task on the survivor.
+        @ray.remote(max_retries=3)
+        def big(seed):
+            return np.full(300_000, seed, dtype=np.float32)
+
+        ref = big.remote(7)
+        assert float(ray.get(ref)[0]) == 7.0
+
+        stored = rt.store.get_if_exists(ref.id())
+        home = getattr(stored.data, "node_id", None)
+        assert home in ("daemon-1", "daemon-2")
+
+        # Drop the driver's local copy so the next get must re-pull
+        # from `home` — which we are about to kill.
+        if rt.shm is not None:
+            rt.shm.delete(ref.id().binary())
+        chaos_cluster.kill_node(home)
+
+        # Heartbeat expiry marks the node dead; the driver's plane
+        # drops it.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if rt.scheduler.get_node(home) is None:
+                break
+            time.sleep(0.2)
+        assert rt.scheduler.get_node(home) is None
+
+        # Lineage reconstruction on the survivor.
+        arr = ray.get(ref, timeout=60)
+        assert float(arr[0]) == 7.0
+        survivors = {n.node_id for n in rt.scheduler.nodes()
+                     if n.is_remote}
+        assert home not in survivors and len(survivors) == 1
+
+    def test_actor_restart_on_survivor(self, chaos_cluster):
+        # The surviving daemon hosts a restartable actor; kill requires
+        # a fresh second node so the actor can migrate.
+        new_node = chaos_cluster.add_node(num_cpus=2)
+
+        @ray.remote(max_restarts=2, max_task_retries=2)
+        class Sticky:
+            def __init__(self):
+                self.calls = 0
+
+            def bump(self):
+                self.calls += 1
+                return self.calls
+
+        a = Sticky.remote()
+        assert ray.get(a.bump.remote()) == 1
+
+        rt = _rt()
+        st = rt.actor_state(a._actor_id)
+        home = st.node.node_id
+        chaos_cluster.kill_node(home)
+
+        # The interrupted/next call is redelivered to the restarted
+        # actor on the surviving node (state resets: fresh __init__).
+        val = ray.get(a.bump.remote(), timeout=60)
+        assert val == 1
+        assert st.node.node_id != home
+        assert st.node.node_id in {new_node, "daemon-1", "daemon-2"}
+
+
+def test_generator_backpressure_through_daemon(cluster2, tmp_path):
+    """Credits relayed driver→daemon→worker pace a remote producer."""
+    from ray_tpu._private.config import config
+
+    old = config.generator_backpressure_max_items
+    config.apply({"generator_backpressure_max_items": 4})
+    try:
+        marker = str(tmp_path / "progress")
+
+        @ray.remote(num_returns="streaming")
+        def gen(path):
+            for i in range(30):
+                with open(path, "w") as f:
+                    f.write(str(i + 1))
+                yield i
+
+        consumed = 0
+        max_lead = 0
+        for r in gen.remote(marker):
+            time.sleep(0.02)
+            assert ray.get(r) == consumed
+            consumed += 1
+            try:
+                produced = int(open(marker).read() or 0)
+            except (ValueError, FileNotFoundError):
+                produced = 0
+            max_lead = max(max_lead, produced - consumed)
+        assert consumed == 30
+        assert max_lead <= 5, f"producer ran {max_lead} ahead"
+    finally:
+        config.apply({"generator_backpressure_max_items": old})

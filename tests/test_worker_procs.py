@@ -409,3 +409,65 @@ def test_max_calls_rejected_for_actors(ray_procs):
         @ray.remote(max_calls=3)
         class A:
             pass
+
+
+def test_generator_backpressure_paces_proc_producer(tmp_path):
+    """Fast producer + slow consumer: the worker pauses after the
+    watermark of unconsumed items (reference: GeneratorWaiter
+    backpressure) instead of streaming unboundedly."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, num_tpus=0, num_worker_procs=1,
+                 _system_config={"generator_backpressure_max_items": 4})
+    try:
+        marker = str(tmp_path / "progress")
+
+        @ray_tpu.remote(scheduling_strategy=PROC,
+                        num_returns="streaming")
+        def gen(path):
+            for i in range(30):
+                with open(path, "w") as f:
+                    f.write(str(i + 1))  # items produced so far
+                yield i
+
+        consumed = 0
+        max_lead = 0
+        for r in gen.remote(marker):
+            time.sleep(0.02)
+            assert ray_tpu.get(r) == consumed
+            consumed += 1
+            try:
+                produced = int(open(marker).read() or 0)
+            except ValueError:
+                produced = 0
+            max_lead = max(max_lead, produced - consumed)
+        assert consumed == 30
+        # watermark 4 (+1: the item written before the yield blocks)
+        assert max_lead <= 5, f"producer ran {max_lead} ahead"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_generator_backpressure_inprocess(tmp_path):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, num_tpus=0,
+                 _system_config={"generator_backpressure_max_items": 4})
+    try:
+        produced = []
+
+        @ray_tpu.remote(num_returns="streaming")
+        def gen():
+            for i in range(30):
+                produced.append(i)
+                yield i
+
+        consumed = 0
+        max_lead = 0
+        for r in gen.remote():
+            time.sleep(0.01)
+            assert ray_tpu.get(r) == consumed
+            consumed += 1
+            max_lead = max(max_lead, len(produced) - consumed)
+        assert consumed == 30
+        assert max_lead <= 5, f"producer ran {max_lead} ahead"
+    finally:
+        ray_tpu.shutdown()
