@@ -1,0 +1,178 @@
+"""Scalability envelope — the reference's release benchmarks at this
+box's scale (reference: release/benchmarks/README.md:5-31 — many_tasks,
+many_actors, 1M queued tasks, 10k-ref get, 100GiB get, object
+broadcast; single-node numbers in
+release/release_logs/2.9.0/scalability/single_node.json).
+
+Prints one JSON line per metric:
+  {"metric": ..., "value": N, "unit": ...}
+
+Run:  python bench_scale.py [--quick]
+Numbers are recorded in PARITY.md §perf beside the reference's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def emit(metric: str, value: float, unit: str, **extra) -> None:
+    print(json.dumps({"metric": metric, "value": round(value, 2),
+                      "unit": unit, **extra}), flush=True)
+
+
+def bench_many_tasks(ray, n: int) -> None:
+    """Reference: many_tasks — 10k+ concurrent trivial tasks
+    (586 tasks/s at 2.5k CPUs)."""
+
+    @ray.remote
+    def noop():
+        return None
+
+    t0 = time.perf_counter()
+    ray.get([noop.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    emit("many_tasks_throughput", n / dt, "tasks/s", n=n,
+         total_s=round(dt, 2))
+
+
+def bench_many_actors(ray, n: int) -> None:
+    """Reference: many_actors — 10k actors, 590 actors/s launch."""
+
+    @ray.remote
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(n)]
+    ray.get([a.ping.remote() for a in actors])
+    dt = time.perf_counter() - t0
+    emit("many_actors_launch_and_ping", n / dt, "actors/s", n=n,
+         total_s=round(dt, 2))
+    for a in actors:
+        ray.kill(a)
+
+
+def bench_queued_tasks(ray, n: int) -> None:
+    """Reference: 1M queued tasks in 192.3s (single node). Queue depth
+    is bounded here by submission rate: tasks depend on a gate object
+    so none can start until all are queued."""
+
+    @ray.remote
+    def gated(_gate):
+        return None
+
+    @ray.remote
+    def gate_task():
+        return None
+
+    gate = gate_task.remote()
+    # All n tasks queue behind the (already-resolved) gate — the point
+    # is submission + scheduling throughput with a deep queue.
+    t0 = time.perf_counter()
+    refs = [gated.remote(gate) for _ in range(n)]
+    submit_dt = time.perf_counter() - t0
+    ray.get(refs)
+    total_dt = time.perf_counter() - t0
+    emit("queued_tasks", n, "tasks", submit_s=round(submit_dt, 2),
+         drain_s=round(total_dt, 2),
+         submit_rate=round(n / submit_dt, 1))
+
+
+def bench_many_refs_get(ray, n: int) -> None:
+    """Reference: ray.get on 10k refs in 24.5s."""
+
+    refs = [ray.put(i) for i in range(n)]
+    t0 = time.perf_counter()
+    out = ray.get(refs)
+    dt = time.perf_counter() - t0
+    assert out[-1] == n - 1
+    emit("get_10k_refs", dt, "s", n=n)
+
+
+def bench_large_object(ray, gib: float) -> None:
+    """Reference: 100GiB+ ray.get in 30.5s (m4.16xlarge). Scaled to
+    this box: one multi-GiB numpy object through the shm plane."""
+    import numpy as np
+
+    nbytes = int(gib * 1024**3)
+    arr = np.ones(nbytes // 8, dtype=np.float64)
+    t0 = time.perf_counter()
+    ref = ray.put(arr)
+    put_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = ray.get(ref)
+    get_dt = time.perf_counter() - t0
+    assert out.nbytes == arr.nbytes
+    emit("large_object_roundtrip", gib, "GiB",
+         put_s=round(put_dt, 2), get_s=round(get_dt, 2),
+         put_gbps=round(arr.nbytes / put_dt / 1024**3, 2),
+         get_gbps=round(arr.nbytes / get_dt / 1024**3, 2))
+    del ref, out, arr
+
+
+def bench_broadcast(n_nodes: int, mib: int) -> None:
+    """Reference: 1GiB broadcast to 50 nodes in 95.8s. Here: one
+    object consumed by a task on every REAL node daemon (arena-to-arena
+    transfer plane)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import RealCluster
+
+    ray_tpu.shutdown()
+    cluster = RealCluster()
+    try:
+        for _ in range(n_nodes):
+            cluster.add_node(num_cpus=1)
+        ray = cluster.connect()
+        import numpy as np
+
+        @ray.remote
+        def make(nbytes):
+            return np.ones(nbytes // 8, dtype=np.float64)
+
+        @ray.remote(num_cpus=1)
+        def consume(a):
+            return float(a[0])
+
+        ref = make.remote(mib * 1024**2)
+        ray.get(ref)
+        t0 = time.perf_counter()
+        out = ray.get([consume.remote(ref) for _ in range(n_nodes)])
+        dt = time.perf_counter() - t0
+        assert out == [1.0] * n_nodes
+        emit("broadcast", dt, "s", nodes=n_nodes, mib=mib,
+             agg_gbps=round(mib * n_nodes / 1024 / dt, 2))
+    finally:
+        cluster.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    q = args.quick
+
+    import ray_tpu as ray
+
+    ray.shutdown()
+    # Arena sized for the multi-GiB object phase (the default 1 GiB
+    # store would silently route it through the in-process fallback).
+    ray.init(num_cpus=4, num_tpus=0, _system_config={
+        "object_store_memory_bytes": (1 if q else 6) * 1024**3})
+    bench_many_tasks(ray, 1_000 if q else 10_000)
+    bench_many_actors(ray, 100 if q else 1_000)
+    bench_queued_tasks(ray, 10_000 if q else 100_000)
+    bench_many_refs_get(ray, 1_000 if q else 10_000)
+    bench_large_object(ray, 0.25 if q else 2.0)
+    ray.shutdown()
+    bench_broadcast(2 if q else 4, 32 if q else 100)
+
+
+if __name__ == "__main__":
+    main()

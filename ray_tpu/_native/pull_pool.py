@@ -26,19 +26,24 @@ class PullClientPool:
              object_id: bytes) -> None:
         """Pull object_id from the peer at `endpoint` into the local
         arena. Raises on failure (after dropping the cached client so
-        a restarted peer gets a fresh connection)."""
+        a restarted peer gets a fresh connection). Connecting happens
+        under the PER-KEY lock only — one unreachable peer (kernel
+        connect timeout) must not serialize pulls to healthy peers."""
         from .object_transfer import TransferClient
 
         with self._lock:
-            client = self._clients.get(key)
-            if client is None:
-                client = TransferClient(endpoint[0], endpoint[1],
-                                        self._shm_name)
-                self._clients[key] = client
-                self._locks[key] = threading.Lock()
-            lock = self._locks[key]
+            lock = self._locks.get(key)
+            if lock is None:
+                lock = self._locks[key] = threading.Lock()
         try:
             with lock:
+                with self._lock:
+                    client = self._clients.get(key)
+                if client is None:
+                    client = TransferClient(endpoint[0], endpoint[1],
+                                            self._shm_name)
+                    with self._lock:
+                        self._clients[key] = client
                 client.pull(object_id)
         except Exception:
             self.drop(key)

@@ -106,6 +106,16 @@ _D("object_store_full_max_retries", int, 10, "")
 _D("worker_pool_size", int, 0,
    "Number of task-executor threads per worker (0 = num_cpus resource).")
 _D("actor_queue_max", int, 10000, "Per-actor pending-call queue bound.")
+_D("memory_monitor_threshold", float, 0.95,
+   "Node memory-usage fraction above which the memory monitor kills "
+   "the last-submitted retriable task's worker to relieve pressure "
+   "(reference: memory_monitor.h + worker_killing_policy.h). "
+   "0 disables the monitor.")
+_D("memory_monitor_interval_ms", int, 250,
+   "Memory monitor sampling interval.")
+_D("memory_monitor_usage_file", str, "",
+   "Chaos/fault-injection hook: read the usage fraction from this "
+   "file instead of /proc/meminfo.")
 _D("generator_backpressure_max_items", int, 16,
    "Streaming generators pause the producer once this many yielded "
    "items await consumption (reference: GeneratorWaiter backpressure, "

@@ -156,6 +156,7 @@ class RealCluster:
     def add_node(self, *, num_cpus: float = 2, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
+                 env: Optional[Dict[str, str]] = None,
                  wait: bool = True, timeout: float = 60.0) -> str:
         import subprocess
         import sys
@@ -171,9 +172,10 @@ class RealCluster:
             cmd += ["--labels", json.dumps(labels)]
         import os
 
-        env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+        penv = dict(os.environ)
+        penv.setdefault("JAX_PLATFORMS", "cpu")
+        penv.update(env or {})
+        proc = subprocess.Popen(cmd, env=penv, stdout=subprocess.PIPE,
                                 stderr=subprocess.DEVNULL, text=True)
         self._daemons[node_id] = proc
         if wait:

@@ -1,0 +1,146 @@
+"""Node memory monitor + OOM worker-killing policy.
+
+Capability-equivalent of the reference's memory monitor
+(reference: src/ray/common/memory_monitor.h:52 — sample node memory
+usage on a timer, compare against a usage threshold) and its
+worker-killing policies (reference: src/ray/raylet/
+worker_killing_policy.h — RetriableFIFO: kill the task submitted LAST
+among retriable ones first, so the oldest work survives and the kill
+is recoverable). Killing a worker process surfaces as a retryable
+system failure to the owner, which reschedules the task — instead of
+the kernel OOM-killer taking the whole node down.
+
+Usage is node-level (total − MemAvailable)/total from /proc/meminfo,
+like the reference; an injectable usage_fn supports deterministic
+tests and cgroup-scoped deployments.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+logger = logging.getLogger("ray_tpu")
+
+
+def proc_meminfo_usage() -> float:
+    """Fraction of node memory in use, from /proc/meminfo."""
+    total = avail = None
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1])
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1])
+                if total is not None and avail is not None:
+                    break
+    except OSError:
+        return 0.0
+    if not total or avail is None:
+        return 0.0
+    return 1.0 - avail / total
+
+
+def usage_fn_from_config():
+    """The configured usage source: the fault-injection file if set
+    (chaos tests), else /proc/meminfo."""
+    from .._private.config import config
+
+    path = config.memory_monitor_usage_file
+    if not path:
+        return proc_meminfo_usage
+
+    def from_file() -> float:
+        try:
+            with open(path) as f:
+                return float(f.read().strip() or 0.0)
+        except (OSError, ValueError):
+            return 0.0
+
+    return from_file
+
+
+class MemoryMonitor:
+    """Samples memory usage; above the threshold, kills one victim per
+    tick (retriable-last-submitted first — RetriableFIFO).
+
+    victims_fn() → [(submit_order_key, retriable, kill_cb, label)].
+    kill_cb() must make the kill surface as a retryable system failure
+    for retriable victims.
+    """
+
+    def __init__(self, victims_fn: Callable[[], List[Tuple]],
+                 *, threshold: float,
+                 interval_s: float = 0.25,
+                 usage_fn: Optional[Callable[[], float]] = None,
+                 min_kill_interval_s: float = 1.0):
+        self._victims_fn = victims_fn
+        self.threshold = threshold
+        self.interval_s = interval_s
+        self.usage_fn = usage_fn or proc_meminfo_usage
+        self.min_kill_interval_s = min_kill_interval_s
+        self.kills = 0
+        self._last_kill = 0.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="memory-monitor")
+
+    def start(self) -> "MemoryMonitor":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — monitor must not die
+                logger.exception("memory monitor tick failed")
+
+    def tick(self) -> bool:
+        """One sample; returns True if a victim was killed."""
+        usage = self.usage_fn()
+        if usage < self.threshold:
+            return False
+        now = time.monotonic()
+        if now - self._last_kill < self.min_kill_interval_s:
+            return False  # give the previous kill time to free memory
+        victim = self._pick_victim(self._victims_fn())
+        if victim is None:
+            logger.warning(
+                "memory usage %.1f%% above threshold %.1f%% but no "
+                "killable worker task", usage * 100,
+                self.threshold * 100)
+            return False
+        order, retriable, kill_cb, label = victim
+        logger.warning(
+            "memory usage %.1f%% ≥ %.1f%%: killing %s task %s to "
+            "relieve pressure (it will be retried)" if retriable else
+            "memory usage %.1f%% ≥ %.1f%%: killing %s task %s "
+            "(NOT retriable — it will fail)",
+            usage * 100, self.threshold * 100,
+            "retriable" if retriable else "non-retriable", label)
+        try:
+            kill_cb()
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to kill %s", label)
+            return False
+        self.kills += 1
+        self._last_kill = now
+        return True
+
+    @staticmethod
+    def _pick_victim(victims: List[Tuple]) -> Optional[Tuple]:
+        """RetriableFIFO (reference worker_killing_policy.h): among
+        retriable tasks pick the LAST submitted; only if none are
+        retriable, the last-submitted non-retriable one."""
+        if not victims:
+            return None
+        retriable = [v for v in victims if v[1]]
+        pool = retriable or victims
+        return max(pool, key=lambda v: v[0])

@@ -255,6 +255,9 @@ class RemotePlane:
             "fetch": fetch,
             "resources": spec.resources.to_dict(),
             "max_calls": spec.max_calls,
+            # The daemon's memory monitor prefers retriable victims
+            # (worker_killing_policy.h RetriableFIFO).
+            "retriable": spec.retries_left > 0,
         }
         if streaming and spec.task_id in self.rt._generators:
             # Live consumer only — reconstruction re-runs have nobody

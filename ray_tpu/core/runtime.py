@@ -882,6 +882,25 @@ class Runtime:
                 self.log_monitor = LogMonitor(
                     os.path.join(self.session_dir, "logs")).start()
 
+        # Memory monitor + OOM worker-killing (reference:
+        # memory_monitor.h, worker_killing_policy.h): above the usage
+        # threshold, kill the last-submitted retriable task's worker —
+        # it retries instead of the kernel OOM-killer downing the node.
+        self.memory_monitor = None
+        self._running_proc: Dict[TaskID, tuple] = {}
+        self._running_seq = 0
+        self._running_lock = threading.Lock()
+        if (self.worker_pool is not None
+                and config.memory_monitor_threshold > 0):
+            from .memory_monitor import MemoryMonitor, usage_fn_from_config
+
+            self.memory_monitor = MemoryMonitor(
+                self._memory_victims,
+                threshold=config.memory_monitor_threshold,
+                interval_s=config.memory_monitor_interval_ms / 1000.0,
+                usage_fn=usage_fn_from_config(),
+            ).start()
+
         # Multi-host plane: join a control-plane-backed cluster of node
         # daemons (ray-tpu start); their nodes appear in the scheduler
         # as RemoteNodeState entries (core/remote_node.py).
@@ -1421,6 +1440,30 @@ class Runtime:
         return serialization.deserialize(
             serialization.SerializedObject.from_bytes(payload))
 
+    def _memory_victims(self):
+        """Running out-of-process tasks as OOM-kill candidates:
+        (submit_order, retriable, kill_cb, label). kill_cb re-validates
+        under the lock that the task still owns that worker — between
+        the snapshot and the kill the task may finish and the worker be
+        re-leased to an innocent (possibly non-retriable) task."""
+        with self._running_lock:
+            entries = list(self._running_proc.items())
+        out = []
+        for task_id, (seq, spec, worker) in entries:
+            retriable = (spec.retries_left > 0
+                         and spec.num_returns not in ("streaming",
+                                                      "dynamic"))
+
+            def kill(task_id=task_id, seq=seq, worker=worker):
+                with self._running_lock:
+                    cur = self._running_proc.get(task_id)
+                    if cur is None or cur[0] != seq or cur[2] is not worker:
+                        return  # task already finished; worker re-leased
+                    worker.kill()
+
+            out.append((seq, retriable, kill, spec.display_name()))
+        return out
+
     def _maybe_retry_system(self, spec: TaskSpec, e: BaseException) -> bool:
         """Worker-process death: always retryable while retries remain
         (reference: system failures consume max_retries regardless of
@@ -1450,6 +1493,10 @@ class Runtime:
             if spec.task_id in self._cancelled:
                 raise TaskCancelledError(spec.display_name())
             worker = node.pool.acquire(timeout=60)
+            with self._running_lock:
+                self._running_seq += 1
+                self._running_proc[spec.task_id] = (
+                    self._running_seq, spec, worker)
             msg = self._pack_task_msg(spec, worker)
 
             def on_stream(item):
@@ -1497,6 +1544,8 @@ class Runtime:
             if not retried:
                 self._store_error(spec, _wrap(spec, e), t0)
         finally:
+            with self._running_lock:
+                self._running_proc.pop(spec.task_id, None)
             if worker is not None:
                 # Count only calls that actually reached the worker —
                 # pre-execution failures (arg packing etc.) must not
@@ -1748,6 +1797,9 @@ class Runtime:
 
     def shutdown(self):
         self._shutdown = True
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
+            self.memory_monitor = None
         if self.remote_plane is not None:
             try:
                 self.remote_plane.shutdown()

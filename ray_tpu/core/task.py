@@ -174,10 +174,12 @@ class TaskSpec:
 
 
 def build_resources(opts: Dict[str, Any], *, is_actor: bool) -> ResourceSet:
-    # Actors default to 1 CPU for creation but 0 for running (reference
-    # semantics: actor methods consume no resources by default; the process
-    # holds its creation resources). We model the held resources only.
-    default_cpus = 1.0 if not is_actor else 1.0
+    # Actors default to 1 CPU for creation-task placement but 0 HELD
+    # while alive (reference: _private/ray_option_utils.py — actors
+    # default num_cpus=0 lifetime; that is what lets 10k+ actors share
+    # a node, release/benchmarks many_actors). We model the held
+    # resources, so the actor default is 0; tasks stay 1.
+    default_cpus = 1.0 if not is_actor else 0.0
     extra = opts.get("resources")
     acc = opts.get("accelerator_type")
     if acc:

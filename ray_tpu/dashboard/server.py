@@ -24,7 +24,9 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import os
 import threading
+import time
 from typing import Any, Optional
 
 from .._version import __version__
@@ -35,6 +37,81 @@ def _json(data: Any):
 
     return web.Response(text=json.dumps(data, default=str),
                         content_type="application/json")
+
+
+class MetricsHistory:
+    """Ring buffer of periodically-sampled cluster metrics
+    (reference: dashboard/modules/metrics keeps Prometheus time
+    series; here an in-process ring serves the same live-charting
+    need without an external TSDB)."""
+
+    def __init__(self, interval_s: float = 1.0, maxlen: int = 3600):
+        from collections import deque
+
+        self.interval_s = interval_s
+        self._ring = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="metrics-history")
+
+    def start(self) -> "MetricsHistory":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._sample()
+            except Exception:  # noqa: BLE001 — sampling must not die
+                pass
+
+    def _sample(self) -> None:
+        import time as _time
+
+        from ..core import runtime as _runtime
+
+        point = {"ts": _time.time()}
+        try:
+            import psutil
+
+            point["cpu_percent"] = psutil.cpu_percent(interval=None)
+            point["mem_percent"] = psutil.virtual_memory().percent
+        except Exception:  # noqa: BLE001
+            pass
+        rt = _runtime.global_runtime_or_none()
+        if rt is not None:
+            avail = rt.available_resources()
+            total = rt.cluster_resources()
+            point["cpu_available"] = avail.get("CPU", 0)
+            point["cpu_total"] = total.get("CPU", 0)
+            with rt._pending_lock:
+                point["pending_tasks"] = len(rt._pending_tasks)
+            if rt.shm is not None:
+                try:
+                    point["object_store_bytes"] = rt.shm.used()
+                except Exception:  # noqa: BLE001
+                    pass
+        # App-level gauges/counters (e.g. a trainer reporting
+        # tokens/sec through util.metrics) ride along so the UI can
+        # chart training throughput live.
+        try:
+            from ..util import metrics as metrics_mod
+
+            for name, value in metrics_mod.snapshot_scalars().items():
+                point[f"m:{name}"] = value
+        except Exception:  # noqa: BLE001
+            pass
+        with self._lock:
+            self._ring.append(point)
+
+    def dump(self, limit: int = 0):
+        with self._lock:
+            data = list(self._ring)
+        return data[-limit:] if limit else data
 
 
 class DashboardServer:
@@ -53,6 +130,7 @@ class DashboardServer:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
         self._runner = None
+        self.history = MetricsHistory().start()
 
     # -- handlers ----------------------------------------------------------
     def _build_app(self):
@@ -188,6 +266,110 @@ class DashboardServer:
             killed = kill_random_node(exclude_head=True)
             return _json({"killed": killed})
 
+        async def metrics_history(request):
+            limit = int(request.query.get("limit", "0"))
+            return _json(self.history.dump(limit))
+
+        async def worker_stats(_):
+            # Per-worker process stats (reference: dashboard
+            # modules/reporter — per-node agents reporting worker
+            # psutil stats) + remote daemons' load reports.
+            from ..core.runtime import global_runtime_or_none
+
+            rt = global_runtime_or_none()
+            out = {"workers": [], "remote_nodes": []}
+            if rt is None:
+                return _json(out)
+            if rt.worker_pool is not None:
+                for w in rt.worker_pool.workers():
+                    entry = {"worker_id": w.worker_id, "pid": w.pid,
+                             "alive": w.alive and w.proc.poll() is None,
+                             "dedicated": w.dedicated}
+                    try:
+                        with open(f"/proc/{w.pid}/statm") as f:
+                            pages = int(f.read().split()[1])
+                        entry["rss_bytes"] = pages * os.sysconf(
+                            "SC_PAGE_SIZE")
+                    except (OSError, ValueError, IndexError):
+                        pass
+                    out["workers"].append(entry)
+            for node in rt.scheduler.nodes():
+                if not node.is_remote:
+                    continue
+                out["remote_nodes"].append({
+                    "node_id": node.node_id,
+                    "host": node.host,
+                    "available": node.available.to_dict(),
+                    "total": node.total.to_dict(),
+                    "queued": node.reported_queued,
+                })
+            return _json(out)
+
+        def _session_logs_dir():
+            from .._private import session as _session
+
+            return _session.logs_dir()
+
+        async def list_logs(_):
+            # Reference: dashboard log viewer lists per-worker files.
+            d = _session_logs_dir()
+            if not d or not os.path.isdir(d):
+                return _json({"files": []})
+            files = []
+            for name in sorted(os.listdir(d)):
+                p = os.path.join(d, name)
+                if os.path.isfile(p):
+                    files.append({"name": name,
+                                  "size": os.path.getsize(p)})
+            return _json({"files": files})
+
+        async def tail_log(request):
+            d = _session_logs_dir()
+            name = os.path.basename(request.match_info["name"])
+            if not d:
+                raise web.HTTPNotFound()
+            path = os.path.join(d, name)
+            if not os.path.isfile(path):
+                raise web.HTTPNotFound()
+            lines = int(request.query.get("lines", "200"))
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 256 * 1024))
+                text = f.read().decode(errors="replace")
+            tail = "\n".join(text.splitlines()[-lines:])
+            return web.Response(text=tail, content_type="text/plain")
+
+        async def capture_profile(request):
+            # On-demand accelerator profile (reference: dashboard
+            # reporter's py-spy/memray buttons — the TPU-native answer
+            # is the jax/XLA profiler, util/tracing.profile_tpu).
+            duration_ms = int(request.query.get("duration_ms", "1000"))
+            duration_ms = min(duration_ms, 60_000)
+            from .._private import session as _session
+            from ..util.tracing import profile_tpu
+
+            logdir = os.path.join(
+                _session.session_dir(), "profiles",
+                f"profile_{int(time.time())}")
+
+            def run_profile():
+                with profile_tpu(logdir):
+                    time.sleep(duration_ms / 1000.0)
+
+            await asyncio.get_event_loop().run_in_executor(
+                None, run_profile)
+            files = []
+            for root, _dirs, names in os.walk(logdir):
+                files += [os.path.join(root, n) for n in names]
+            return _json({"logdir": logdir, "files": files,
+                          "hint": "view with tensorboard --logdir"})
+
+        r.add_get("/api/metrics_history", metrics_history)
+        r.add_get("/api/worker_stats", worker_stats)
+        r.add_get("/api/logs", list_logs)
+        r.add_get("/api/logs/{name}", tail_log)
+        r.add_post("/api/profile", capture_profile)
         r.add_post("/api/kill_random_node", kill_random_node)
         r.add_get("/api/timeline", timeline)
         r.add_get("/api/node_stats", node_stats)
@@ -252,6 +434,7 @@ class DashboardServer:
         return f"http://{self.host}:{self.port}"
 
     def stop(self) -> None:
+        self.history.stop()
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:

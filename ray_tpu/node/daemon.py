@@ -141,6 +141,24 @@ class NodeDaemon:
         # Actors hosted here: actor_id(bytes) -> dedicated WorkerProcess.
         self._actors: Dict[bytes, Any] = {}
         self._actors_lock = threading.Lock()
+        # Running tasks (OOM-kill candidates): id -> (seq, retriable,
+        # worker, label).
+        self._running_tasks: Dict[int, tuple] = {}
+        self._running_seq = 0
+        self._running_lock = threading.Lock()
+        self.memory_monitor = None
+        if config.memory_monitor_threshold > 0:
+            from ray_tpu.core.memory_monitor import (
+                MemoryMonitor,
+                usage_fn_from_config,
+            )
+
+            self.memory_monitor = MemoryMonitor(
+                self._memory_victims,
+                threshold=config.memory_monitor_threshold,
+                interval_s=config.memory_monitor_interval_ms / 1000.0,
+                usage_fn=usage_fn_from_config(),
+            ).start()
         # Daemon-wide function cache: fid -> cloudpickled bytes.
         self._fn_cache: Dict[bytes, bytes] = {}
         self._fn_lock = threading.Lock()
@@ -290,6 +308,7 @@ class NodeDaemon:
         fetch = msg.pop("fetch", None)
         res = ResourceSet(msg.pop("resources", None) or {})
         max_calls = msg.pop("max_calls", 0)
+        retriable = msg.pop("retriable", False)
         fn_bytes = msg.pop("fn", None)
         fid = msg.get("fid")
         if fn_bytes is not None and fid is not None:
@@ -309,7 +328,26 @@ class NodeDaemon:
         if mtype == "actor_create":
             self._run_actor_create(conn, msg, res, conn_actors)
             return
-        self._run_task(conn, msg, res, max_calls, fid)
+        self._run_task(conn, msg, res, max_calls, fid, retriable)
+
+    def _memory_victims(self):
+        with self._running_lock:
+            entries = list(self._running_tasks.items())
+        out = []
+        for run_key, (seq, retriable, worker, label) in entries:
+
+            def kill(run_key=run_key, worker=worker):
+                # Re-validate under the lock: between the snapshot and
+                # this kill the task may have finished and the worker
+                # been re-leased to an innocent task.
+                with self._running_lock:
+                    cur = self._running_tasks.get(run_key)
+                    if cur is None or cur[2] is not worker:
+                        return
+                    worker.kill()
+
+            out.append((seq, retriable, kill, label))
+        return out
 
     def _inject_fn(self, conn, msg, worker) -> bool:
         """Ensure the worker has the function body; True = ok."""
@@ -378,7 +416,8 @@ class NodeDaemon:
         finally:
             sel.close()
 
-    def _run_task(self, conn, msg, res, max_calls, fid) -> None:
+    def _run_task(self, conn, msg, res, max_calls, fid,
+                  retriable: bool = False) -> None:
         send_msg = self._send_msg
         with self._avail_lock:
             self._queued += 1
@@ -395,6 +434,13 @@ class NodeDaemon:
         with self._avail_lock:
             self._queued -= 1
         self._charge(res)
+        with self._running_lock:
+            self._running_seq += 1
+            run_key = self._running_seq
+            tid = msg.get("task_id")
+            self._running_tasks[run_key] = (
+                run_key, retriable and not msg.get("streaming"), worker,
+                tid.hex() if isinstance(tid, bytes) and tid else "task")
         ran = False
         try:
             if msg.get("task_id") is None:
@@ -416,6 +462,8 @@ class NodeDaemon:
                                 "task_id": msg.get("task_id"),
                                 "crashed": str(e)})
         finally:
+            with self._running_lock:
+                self._running_tasks.pop(run_key, None)
             self._uncharge(res)
             if worker is not None:
                 if ran and fid is not None and max_calls > 0:
@@ -486,6 +534,8 @@ class NodeDaemon:
         if self._stop.is_set():
             return
         self._stop.set()
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
         with contextlib.suppress(OSError):
             self._listener.close()
         with self._actors_lock:

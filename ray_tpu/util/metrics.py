@@ -216,6 +216,31 @@ def prometheus_text() -> str:
     return "\n".join(out) + ("\n" if out else "")
 
 
+def snapshot_scalars() -> Dict[str, float]:
+    """{metric_name: value} for counters and gauges (summed across tag
+    variants) — the dashboard's metrics-history sampler charts these.
+    Parsed from the exposition text so it works for both the native
+    and the pure-Python registry backends."""
+    out: Dict[str, float] = {}
+    types: Dict[str, str] = {}
+    for line in prometheus_text().splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, value = line.rsplit(" ", 1)
+            name = key.split("{", 1)[0]
+            if types.get(name) in ("counter", "gauge"):
+                out[name] = out.get(name, 0.0) + float(value)
+        except ValueError:
+            continue
+    return out
+
+
 def clear_registry() -> None:
     """Test hook."""
     with _REGISTRY_LOCK:

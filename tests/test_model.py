@@ -241,3 +241,37 @@ class TestFullScaleConfigs:
         # Expert tensors exist at full dimension in the abstract tree.
         assert params_shape["layers"]["w_gate"].shape == (
             cfg.n_layers, cfg.moe_experts, cfg.d_model, cfg.d_ff)
+
+
+def test_chunked_cross_entropy_matches_full():
+    """ce_chunk>0 (blockwise vocab projection, chunked_cross_entropy)
+    is numerically identical to the full-logits loss."""
+    from dataclasses import replace
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import configs
+    from ray_tpu.models.transformer import init_params, loss_fn
+
+    cfg0 = configs.tiny_test()
+    cfgc = replace(cfg0, ce_chunk=32)
+    p = init_params(cfg0, jax.random.key(0))
+    tok = jax.random.randint(jax.random.key(1), (2, 128), 0,
+                             cfg0.vocab_size)
+    tgt = jnp.roll(tok, -1, 1)
+    mask = (tok % 7 != 0).astype(jnp.float32)
+
+    l0, m0 = loss_fn(cfg0, p, tok, tgt, mask)
+    l1, m1 = loss_fn(cfgc, p, tok, tgt, mask)
+    assert abs(float(l0) - float(l1)) < 1e-4
+    assert float(m0["tokens"]) == float(m1["tokens"])
+
+    g0 = jax.grad(lambda pp: loss_fn(cfg0, pp, tok, tgt, mask)[0])(p)
+    g1 = jax.grad(lambda pp: loss_fn(cfgc, pp, tok, tgt, mask)[0])(p)
+    import numpy as np
+
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)

@@ -362,3 +362,54 @@ def test_dashboard_node_stats(dashboard, ray_start):
     assert stats["available"]
     assert stats["cpu_count"] >= 1
     assert 0 <= stats["mem_percent"] <= 100
+
+
+def test_dashboard_metrics_history_and_worker_stats(dashboard, ray_start):
+    ray = ray_start
+    # App metric rides into the history sampler.
+    metrics.clear_registry()
+    metrics.Gauge("train_tokens_per_sec", tag_keys=()).set(123.0)
+
+    # Sampler ticks every 1s.
+    deadline = time.monotonic() + 10
+    hist = []
+    while time.monotonic() < deadline:
+        hist = _get(dashboard, "/api/metrics_history")
+        if hist and any("m:train_tokens_per_sec" in p for p in hist):
+            break
+        time.sleep(0.3)
+    assert hist, "no history points sampled"
+    point = hist[-1]
+    assert "ts" in point
+    assert point.get("m:train_tokens_per_sec") == 123.0
+    assert "cpu_total" in point
+
+    ws = _get(dashboard, "/api/worker_stats")
+    assert "workers" in ws and "remote_nodes" in ws
+    metrics.clear_registry()
+
+
+def test_dashboard_log_endpoints(dashboard, ray_start):
+    import os
+
+    from ray_tpu._private import session as _session
+
+    logs_dir = _session.logs_dir()
+    with open(os.path.join(logs_dir, "worker-99.out"), "w") as f:
+        f.write("line-a\nline-b\n")
+    files = _get(dashboard, "/api/logs")["files"]
+    assert any(e["name"] == "worker-99.out" for e in files)
+    tail = _get(dashboard, "/api/logs/worker-99.out?lines=1")
+    assert tail.strip() == "line-b"
+
+
+def test_dashboard_profile_capture(dashboard, ray_start):
+    import urllib.request as _rq
+
+    req = _rq.Request(dashboard.address + "/api/profile?duration_ms=200",
+                      method="POST")
+    with _rq.urlopen(req, timeout=60) as r:
+        out = json.loads(r.read().decode())
+    assert "logdir" in out
+    # jax profiler wrote a trace directory (plugins/profile/...)
+    assert isinstance(out["files"], list)
