@@ -105,17 +105,22 @@ def bench_serve(quick: bool) -> None:
         prompt_len, max_new, max_seq = 128, 64, 1024
 
     params = init_params(cfg, jax.random.key(0))
-    # decode_block matched to max_new: every admission completes in one
-    # fused block (measured optimum for this workload).
-    engine = LLMEngine(cfg, params, num_slots=slots, max_seq_len=max_seq,
-                       decode_block=max(16, max_new))
+    # No decode_block tuning: the engine adapts the fused-block size
+    # online to the active slots' remaining budgets (llm.py step()).
+    engine = LLMEngine(cfg, params, num_slots=slots, max_seq_len=max_seq)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len).tolist()
                for _ in range(n_req)]
 
-    # Warm the compile caches (prefill bucket + decode tick) off-clock.
+    # Warm the compile caches off-clock: one full-length request
+    # (prefill bucket + the adaptive decode block the run will use) and
+    # an over-subscribed mini-burst (queue-side first-token path).
     engine.start()
-    engine.submit(prompts[0], max_new_tokens=2).result()
+    engine.submit(prompts[0], max_new_tokens=max_new).result()
+    warm = [engine.submit(p, max_new_tokens=2)
+            for p in prompts[:slots + 4]]
+    for r in warm:
+        r.result()
 
     t0 = time.perf_counter()
     reqs = [engine.submit(p, max_new_tokens=max_new) for p in prompts]

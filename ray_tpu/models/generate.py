@@ -222,6 +222,32 @@ def prefill_sample(cfg: TransformerConfig, params, cache: KVCache,
     return cache, tok
 
 
+@partial(jax.jit, static_argnums=(0, 5))
+def first_token_sample(cfg: TransformerConfig, params, tokens: jax.Array,
+                       lengths: jax.Array, temps: jax.Array, top_k: int,
+                       key: jax.Array) -> jax.Array:
+    """First token for a BATCH of prompts without touching any KV cache
+    (tokens (W, S_bucket), lengths (W,), temps (W,) → (W,) tokens).
+
+    The serving engine uses this to give QUEUED requests their first
+    token while every cache slot is busy — TTFT decoupled from slot
+    availability. When a slot frees, the request is prefilled normally
+    and decode continues from this token (the engine overrides the
+    slot's cur_token), so no recomputed sample can diverge from what
+    the client already saw."""
+    from .transformer import _lm_head, forward_hidden
+
+    # forward_hidden output is ALREADY final-norm'd — apply the head
+    # directly (going through _head_logits would norm twice and sample
+    # from distorted logits for any final_norm gain != 1).
+    x, _aux = forward_hidden(cfg, params, tokens)         # (W, S, D)
+    idx = (lengths - 1).astype(jnp.int32)[:, None, None]
+    last = jnp.take_along_axis(
+        x, jnp.broadcast_to(idx, (x.shape[0], 1, x.shape[2])), axis=1)
+    logits = (last @ _lm_head(cfg, params)).astype(jnp.float32)[:, 0]
+    return sample(logits, key, temperature=temps, top_k=top_k)
+
+
 def _decode_core(cfg: TransformerConfig, params, cache: KVCache,
                  tokens: jax.Array) -> Tuple[KVCache, jax.Array]:
     B = cache.num_slots

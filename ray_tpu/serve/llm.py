@@ -38,7 +38,9 @@ from ..models.generate import (
     KVCache,
     decode_multi,
     decode_step,
+    first_token_sample,
     init_kv_cache,
+    prefill,
     prefill_sample,
 )
 from ..models.transformer import TransformerConfig, init_params
@@ -78,6 +80,9 @@ class GenRequest:
     error: Optional[str] = None
     # Set once the terminal None has been consumed (engine-internal).
     _done: bool = field(default=False, repr=False)
+    # First token served queue-side before any slot freed (engine-
+    # internal; _admit resumes decode from it).
+    _early_tok: Optional[int] = field(default=None, repr=False)
 
     @property
     def ttft_s(self) -> float:
@@ -143,19 +148,20 @@ class LLMEngine:
 
     def __init__(self, cfg: TransformerConfig, params: Any, *,
                  num_slots: int = 4, max_seq_len: Optional[int] = None,
-                 top_k: int = 0, seed: int = 0, decode_block: int = 32):
+                 top_k: int = 0, seed: int = 0, decode_block: int = 64):
         self.cfg = cfg
         self.params = params
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len or cfg.max_seq_len
         self.top_k = top_k
-        # Ticks fused per dispatch (decode_multi). Bigger blocks
-        # amortize the host↔device round trip (measured on a ~150ms-RTT
-        # tunnel: 16→6.9, 32→7.7, 64→8.4 req/s on the 64-token bench)
-        # but raise admission latency for queued requests and waste the
-        # block remainder when slots finish mid-block — match it to the
-        # workload's typical generation length. Power of two keeps the
-        # compile cache small.
+        # UPPER BOUND on ticks fused per dispatch (decode_multi); the
+        # actual block size adapts ONLINE each step to the minimum
+        # remaining generation budget among active slots, so a block
+        # ends exactly when the first slot completes and its
+        # replacement is admitted (no workload-tuned constant — the cap
+        # only bounds the compile cache and worst-case admission
+        # latency). Bigger fused blocks amortize the host↔device round
+        # trip (~150 ms on a tunneled chip).
         self.decode_block = max(1, decode_block)
         self.cache: KVCache = init_kv_cache(cfg, num_slots, self.max_seq_len)
         self.cur_tokens = jnp.zeros((num_slots,), jnp.int32)
@@ -213,27 +219,44 @@ class LLMEngine:
         slot.length += 1
         self.tokens_out += 1
 
+    def _complete(self, req: GenRequest, new_tokens: int) -> None:
+        """Single place for request-completion bookkeeping (slot-path
+        and queue-side finishes alike)."""
+        req.finish_ts = time.monotonic()
+        req.stream.put(None)
+        self.finished.append({
+            "id": req.id,
+            "ttft_s": req.ttft_s,
+            "latency_s": req.latency_s,
+            "new_tokens": new_tokens,
+        })
+
     def _finish(self, idx: int) -> None:
         slot = self.slots[idx]
-        slot.req.finish_ts = time.monotonic()
-        slot.req.stream.put(None)
-        self.finished.append({
-            "id": slot.req.id,
-            "ttft_s": slot.req.ttft_s,
-            "latency_s": slot.req.latency_s,
-            "new_tokens": slot.emitted,
-        })
+        self._complete(slot.req, slot.emitted)
         self.slots[idx] = None
 
-    def _admit(self) -> None:
-        """Prefill waiting requests into free slots.
+    def _pad_prompt(self, req: GenRequest) -> Any:
+        """Pad on the HOST: an eager .at[:plen].set() compiles a
+        scatter kernel per distinct prompt length (seconds each),
+        wrecking admission latency; numpy + one transfer doesn't."""
+        plen = len(req.prompt)
+        bucket = self._bucket_for(plen)
+        buf = np.zeros((1, bucket), np.int32)
+        buf[0, :plen] = np.asarray(req.prompt, np.int32)
+        return jnp.asarray(buf)
 
-        All admissions in this pass are dispatched back-to-back (async)
-        and their first tokens fetched with ONE host sync at the end —
-        on remote/tunneled chips each sync costs a full round trip, so
-        per-admission syncs would serialize RTTs.
+    def _admit(self) -> List:
+        """Prefill waiting requests into free slots (arrival order).
+
+        All admissions are DISPATCHED here (async); the first tokens
+        are fetched later by _deliver_first_tokens with one fused host
+        sync — on remote/tunneled chips each sync costs a full round
+        trip. Requests whose first token was already served by
+        _early_first_tokens() are prefilled without sampling and their
+        decode continues from that token. Returns [(idx, tok_dev)].
         """
-        admitted: List = []  # (idx, tok_dev)
+        admitted: List = []  # (idx, tok_dev) — first token pending
         while True:
             with self.lock:
                 free = [i for i, s in enumerate(self.slots) if s is None]
@@ -242,62 +265,175 @@ class LLMEngine:
                 req = self.waiting.popleft()
             idx = free[0]
             plen = len(req.prompt)
-            bucket = self._bucket_for(plen)
-            # Pad on the HOST: an eager .at[:plen].set() compiles a
-            # scatter kernel per distinct prompt length (seconds each),
-            # wrecking admission latency; numpy + one transfer doesn't.
-            buf = np.zeros((1, bucket), np.int32)
-            buf[0, :plen] = np.asarray(req.prompt, np.int32)
-            padded = jnp.asarray(buf)
-            self._key, sub = jax.random.split(self._key)
+            padded = self._pad_prompt(req)
+            early_tok = getattr(req, "_early_tok", None)
             try:
-                # prefill + first-token sample fused into one dispatch.
-                self.cache, tok_dev = prefill_sample(
-                    self.cfg, self.params, self.cache, padded,
-                    jnp.int32(plen), jnp.int32(idx), self.top_k,
-                    jnp.float32(req.temperature), sub)
+                if early_tok is not None:
+                    # First token already delivered queue-side: write
+                    # the prompt KV only; decode continues from the
+                    # token the client saw.
+                    self.cache, _last = prefill(
+                        self.cfg, self.params, self.cache, padded,
+                        jnp.int32(plen), jnp.int32(idx))
+                else:
+                    self._key, sub = jax.random.split(self._key)
+                    # prefill + first-token sample in one dispatch.
+                    self.cache, tok_dev = prefill_sample(
+                        self.cfg, self.params, self.cache, padded,
+                        jnp.int32(plen), jnp.int32(idx), self.top_k,
+                        jnp.float32(req.temperature), sub)
             except Exception:
                 # put it back so _fail_all can notify its client
                 with self.lock:
                     self.waiting.appendleft(req)
                 raise
-            self.slots[idx] = _Slot(req, plen)
+            slot = _Slot(req, plen)
+            self.slots[idx] = slot
             self._temps = self._temps.at[idx].set(req.temperature)
-            self.cur_tokens = self.cur_tokens.at[idx].set(tok_dev)
-            admitted.append((idx, tok_dev))
-        if not admitted:
+            if early_tok is not None:
+                slot.emitted = len(req.tokens)
+                slot.length = plen + slot.emitted
+                self.cur_tokens = self.cur_tokens.at[idx].set(
+                    int(early_tok))
+            else:
+                self.cur_tokens = self.cur_tokens.at[idx].set(tok_dev)
+                admitted.append((idx, tok_dev))
+        return admitted
+
+    def _early_first_tokens(self) -> List:
+        """TTFT decoupled from slot availability: queued requests that
+        could not be admitted get their FIRST token from a cache-free
+        batched forward (models/generate.first_token_sample), in
+        arrival order, one dispatch per prompt-bucket tile. When a slot
+        frees, _admit prefills the prompt and decode resumes from this
+        token — the client's stream stays consistent. Returns
+        [(chunk_requests, toks_dev)]; fetched by
+        _deliver_first_tokens."""
+        with self.lock:
+            todo = [r for r in self.waiting
+                    if r.first_token_ts == 0.0]
+        if not todo:
+            return []
+        by_bucket: Dict[int, List[GenRequest]] = {}
+        for r in todo:
+            by_bucket.setdefault(
+                self._bucket_for(len(r.prompt)), []).append(r)
+        outs = []
+        W = 8  # fixed batch tile: ONE compile per bucket, ever
+        for bucket, reqs in sorted(by_bucket.items()):
+            for off in range(0, len(reqs), W):
+                chunk = reqs[off:off + W]
+                buf = np.zeros((W, bucket), np.int32)
+                lens = np.ones((W,), np.int32)
+                temps = np.zeros((W,), np.float32)
+                for j, r in enumerate(chunk):
+                    pl = len(r.prompt)
+                    buf[j, :pl] = np.asarray(r.prompt, np.int32)
+                    lens[j] = pl
+                    temps[j] = r.temperature
+                self._key, sub = jax.random.split(self._key)
+                toks = first_token_sample(
+                    self.cfg, self.params, jnp.asarray(buf),
+                    jnp.asarray(lens), jnp.asarray(temps), self.top_k,
+                    sub)
+                outs.append((chunk, toks))
+        return outs
+
+    def _fuse_first_tokens(self, admitted: List, outs: List):
+        """Concatenate every pending first token into ONE device array
+        and start its host copy — enqueued BEFORE the decode block so
+        the device serves it first (device execution is in-order; a
+        fetch enqueued after the block would wait out the whole
+        block)."""
+        if not admitted and not outs:
+            return None
+        parts = []
+        if admitted:
+            parts.append(jnp.stack([t for _, t in admitted]))
+        parts += [t for _, t in outs]
+        fused = jnp.concatenate(parts)
+        try:
+            fused.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — backend without async copy
+            pass
+        return fused
+
+    def _deliver_first_tokens(self, fused, admitted: List,
+                              outs: List) -> None:
+        """Emit the fused first tokens (one host sync, usually already
+        in flight via copy_to_host_async)."""
+        if fused is None:
             return
-        host_toks = np.asarray(jnp.stack([t for _, t in admitted]))
+        fused = np.asarray(fused)
+        pos = 0
         now = time.monotonic()
-        for (idx, _), tok in zip(admitted, host_toks):
-            slot = self.slots[idx]
-            if slot is None:  # drained by a concurrent stop()
-                continue
-            tok = int(tok)
-            slot.req.first_token_ts = now
-            self._emit(slot, tok)
-            if (tok == slot.req.eos_token
-                    or slot.emitted >= slot.req.max_new_tokens):
-                self._finish(idx)
+        if admitted:
+            for (idx, _), tok in zip(admitted,
+                                     fused[:len(admitted)]):
+                slot = self.slots[idx]
+                if slot is None:  # drained by a concurrent stop()
+                    continue
+                tok = int(tok)
+                slot.req.first_token_ts = now
+                self._emit(slot, tok)
+                if (tok == slot.req.eos_token
+                        or slot.emitted >= slot.req.max_new_tokens):
+                    self._finish(idx)
+            pos = len(admitted)
+        for reqs, toks in outs:
+            host = fused[pos:pos + toks.shape[0]]
+            pos += toks.shape[0]
+            for j, r in enumerate(reqs):
+                tok = int(host[j])
+                r.first_token_ts = now
+                r._early_tok = tok
+                r.tokens.append(tok)
+                r.stream.put(tok)
+                self.tokens_out += 1
+                if tok == r.eos_token or r.max_new_tokens <= 1:
+                    # Finished before ever occupying a slot.
+                    with self.lock:
+                        try:
+                            self.waiting.remove(r)
+                        except ValueError:
+                            continue  # already admitted concurrently
+                    self._complete(r, len(r.tokens))
 
     def step(self) -> bool:
-        """One engine tick: admit, then one fused block of decode steps
-        for all slots. Returns False when there is nothing to do."""
-        self._admit()
+        """One engine tick: admit, serve queued requests' first tokens
+        (cache-free path — TTFT does not wait for a slot), then one
+        fused block of decode steps for all slots. All device work is
+        dispatched before any host fetch, so round trips overlap
+        compute. Returns False when there is nothing to do."""
+        admitted = self._admit()
+        outs = self._early_first_tokens()
         # Snapshot: a concurrent stop()/_fail_all may None-out entries
         # under us; every later access goes through the snapshot or
         # re-checks self.slots[i].
+        fused = self._fuse_first_tokens(admitted, outs)
         snap = list(self.slots)
         active = [i for i, s in enumerate(snap) if s is not None]
         if not active:
-            return False
+            self._deliver_first_tokens(fused, admitted, outs)
+            return bool(admitted or outs)
 
-        # Block size: capped by every active slot's cache headroom so no
-        # in-block write can run past max_seq_len. Powers of two only —
-        # each distinct size is its own XLA compile.
+        # Block size (adaptive, per step): sized to the minimum
+        # remaining generation budget among active slots, rounded UP to
+        # a power of two (each distinct size is its own XLA compile) —
+        # rounding down would split a 63-token budget into ~7 dispatches
+        # and pay the host↔device round trip for each; rounding up
+        # wastes at most the finishing slot's share of the overshoot
+        # ticks. Capped by self.decode_block (compile-cache/latency
+        # bound) and by every slot's cache headroom so no in-block
+        # write can run past max_seq_len.
         headroom = min(self.max_seq_len - 1 - snap[i].length
                        for i in active)
-        k_block = min(self.decode_block, max(1, headroom))
+        remaining = max(1, min(snap[i].req.max_new_tokens
+                               - snap[i].emitted for i in active))
+        k_block = 1
+        while k_block < remaining:
+            k_block *= 2
+        k_block = min(k_block, self.decode_block, max(1, headroom))
         while k_block & (k_block - 1):
             k_block &= k_block - 1
 
@@ -305,15 +441,18 @@ class LLMEngine:
         if k_block == 1:
             self.cache, logits = decode_step(
                 self.cfg, self.params, self.cache, self.cur_tokens)
-            toks = _sample_batch(logits, self._temps, sub, self.top_k)
-            self.cur_tokens = toks
-            host_toks = np.asarray(toks)[None]             # (1, B)
+            toks = _sample_batch(logits, self._temps, sub,
+                                 self.top_k)[None]         # (1, B)
         else:
             self.cache, toks = decode_multi(
                 self.cfg, self.params, self.cache, self.cur_tokens,
-                self._temps, k_block, self.top_k, sub)
-            self.cur_tokens = toks[-1]
-            host_toks = np.asarray(toks)                   # (k, B)
+                self._temps, k_block, self.top_k, sub)     # (k, B)
+        self.cur_tokens = toks[-1]
+        # First tokens (this step's admissions + queued requests) were
+        # enqueued for copy before the block — emit them while the
+        # block computes.
+        self._deliver_first_tokens(fused, admitted, outs)
+        host_toks = np.asarray(toks)
         self.decode_ticks += k_block
 
         for i in active:

@@ -216,3 +216,69 @@ def test_iteration_replay_after_drain(tiny_model):
         assert list(req) == toks  # does not hang, replays
     finally:
         eng.stop()
+
+
+def test_queue_side_first_token_matches_slot_path():
+    """first_token_sample (cache-free, queue-side TTFT path) must agree
+    with the prefill path's greedy first token — including with
+    NON-unit final_norm gains (a double-norm bug would only show on
+    trained-like weights)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import configs
+    from ray_tpu.models.generate import (
+        first_token_sample,
+        init_kv_cache,
+        prefill_sample,
+    )
+    from ray_tpu.models.transformer import init_params
+
+    cfg = configs.tiny_test()
+    params = init_params(cfg, jax.random.key(0))
+    # Perturb the final norm gain so a double-norm diverges.
+    params["final_norm"] = params["final_norm"] * 3.0 + 0.5
+
+    prompt = jax.random.randint(jax.random.key(1), (24,), 0,
+                                cfg.vocab_size)
+    bucket = 32
+    padded = jnp.zeros((1, bucket), jnp.int32).at[0, :24].set(prompt)
+
+    cache = init_kv_cache(cfg, 2, 64)
+    _, tok_slot = prefill_sample(
+        cfg, params, cache, padded, jnp.int32(24), jnp.int32(0), 0,
+        jnp.float32(0.0), jax.random.key(2))
+
+    toks = first_token_sample(
+        cfg, params, jnp.broadcast_to(padded, (4, bucket)),
+        jnp.full((4,), 24, jnp.int32), jnp.zeros((4,), jnp.float32), 0,
+        jax.random.key(3))
+    assert int(toks[0]) == int(tok_slot)
+
+
+def test_oversubscribed_burst_first_tokens_before_slots_free():
+    """Queued requests get a first token while every slot is busy, and
+    full results still complete correctly."""
+    import jax
+
+    from ray_tpu.models import configs
+    from ray_tpu.models.transformer import init_params
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg = configs.tiny_test()
+    params = init_params(cfg, jax.random.key(0))
+    engine = LLMEngine(cfg, params, num_slots=2, max_seq_len=64)
+    prompts = [[1 + i, 2, 3] for i in range(6)]
+    reqs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+    # Run steps manually until all finish.
+    for _ in range(200):
+        if all(r.finish_ts for r in reqs):
+            break
+        engine.step()
+    outs = [r.result(timeout=10) for r in reqs]
+    assert all(len(o) == 8 for o in outs)
+    # Every request (including over-subscribed ones) got a TTFT stamp.
+    assert all(r.first_token_ts > 0 for r in reqs)
+    # The first emitted token equals the full result's first token.
+    for r, o in zip(reqs, outs):
+        assert o[0] == r.tokens[0]
