@@ -56,11 +56,16 @@ def available() -> bool:
 
 def launch_control_plane(*, port: int = 0, health_timeout_ms: int = 5000,
                          persist_path: Optional[str] = None,
-                         bind_all: bool = False
+                         bind_all: bool = False,
+                         mirror_address: Optional[str] = None,
+                         mirror_interval_ms: int = 200
                          ) -> Tuple[subprocess.Popen, int]:
     """Spawn the daemon; returns (process, bound port). persist_path
-    enables crash-restart state recovery (reference: Redis-backed GCS
-    fault tolerance, tests/test_gcs_fault_tolerance.py). bind_all
+    enables crash-restart recovery from a local snapshot file;
+    mirror_address="host:port" write-throughs state to an EXTERNAL
+    store (another control-plane daemon in KV-only mode) so a fresh
+    control plane on any host can take over — the capability of the
+    reference's Redis-backed GCS (redis_store_client.h). bind_all
     listens on 0.0.0.0 so other hosts can join (multi-host clusters)."""
     cmd = [_BIN, "--port", str(port),
            "--health-timeout-ms", str(health_timeout_ms)]
@@ -68,6 +73,9 @@ def launch_control_plane(*, port: int = 0, health_timeout_ms: int = 5000,
         cmd += ["--persist", persist_path]
     if bind_all:
         cmd += ["--bind-all"]
+    if mirror_address:
+        cmd += ["--mirror", mirror_address,
+                "--mirror-interval-ms", str(mirror_interval_ms)]
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
     line = proc.stdout.readline()
     if not line.startswith("PORT="):

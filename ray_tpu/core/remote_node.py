@@ -114,6 +114,12 @@ class RemotePlane:
         # leaking its executor + connections).
         self._sync_lock = threading.Lock()
 
+        # runtime_env packaging: local dirs → content-addressed pkg://
+        # URIs uploaded once to the control plane's KV; daemons
+        # materialize them (runtime_env_packaging.py).
+        # abspath → (tree_signature, uri)
+        self._renv_uri_cache: Dict[str, Tuple[str, str]] = {}
+
         self.sync_nodes()
         with contextlib.suppress(Exception):
             self.control.subscribe("node_events", self._on_node_event)
@@ -235,6 +241,25 @@ class RemotePlane:
             rt._require_recoverable(v.id())
             rt._maybe_reconstruct([v.id()])
 
+    def prepare_runtime_env(self, renv):
+        """Local working_dir/py_modules dirs → pkg:// URIs in the
+        control plane's KV (uploaded once per content hash). No lock
+        around the zip/upload I/O — a large tree must not serialize
+        every other submission; a concurrent double-zip of the same
+        tree is benign (content-addressed, idempotent upload)."""
+        if not renv:
+            return renv
+        from . import runtime_env_packaging as pkg
+        from .._native.control_client import AlreadyExistsError
+
+        def upload(uri: str, blob: bytes) -> None:
+            with contextlib.suppress(AlreadyExistsError):
+                self.control.kv_put(pkg.KV_PREFIX + uri, blob,
+                                    overwrite=False)
+
+        return pkg.prepare_for_upload(renv, upload,
+                                      self._renv_uri_cache)
+
     # -- remote execution -------------------------------------------------
     def _build_task_msg(self, spec: TaskSpec, node: RemoteNodeState
                         ) -> Dict[str, Any]:
@@ -264,7 +289,8 @@ class RemotePlane:
             # sending credits; a watermark would deadlock the worker.
             msg["backpressure"] = config.generator_backpressure_max_items
         if spec.runtime_env:
-            msg["runtime_env"] = spec.runtime_env
+            msg["runtime_env"] = self.prepare_runtime_env(
+                spec.runtime_env)
         if spec.descriptor.function_id not in node.exported_fids:
             msg["fn"] = cloudpickle.dumps(
                 self.rt.function_manager.get(spec.descriptor.function_id))
@@ -491,7 +517,8 @@ def remote_actor_state_cls():
                         "resources": self.resources.to_dict(),
                     }
                     if self.runtime_env:
-                        msg["runtime_env"] = self.runtime_env
+                        msg["runtime_env"] = plane.prepare_runtime_env(
+                            self.runtime_env)
                     conn = self.node.client.open_conn()
                     reply = conn.request(msg)
                 except NodeDispatchError as e:
@@ -562,7 +589,8 @@ def remote_actor_state_cls():
                     msg["backpressure"] = \
                         config.generator_backpressure_max_items
                 if self.runtime_env:
-                    msg["runtime_env"] = self.runtime_env
+                    msg["runtime_env"] = plane.prepare_runtime_env(
+                        self.runtime_env)
 
                 def on_stream(item):
                     oid = ObjectID.for_return(spec.task_id, item["index"])

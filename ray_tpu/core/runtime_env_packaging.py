@@ -1,0 +1,242 @@
+"""Runtime-env packaging + URI cache.
+
+Capability-equivalent of the reference's runtime-env packaging pipeline
+(reference: python/ray/_private/runtime_env/packaging.py — zip a local
+working_dir/py_modules dir, content-address it as a gcs:// URI, upload
+to GCS KV; uri_cache.py — per-node cache of materialized URIs with
+size-based eviction; the per-node agent materializes envs before a
+lease is granted, runtime_env_agent.py:161).
+
+Here: directories are zipped deterministically, content-addressed as
+``pkg://<sha256-16>.zip``, uploaded once to the control plane's KV; the
+NODE DAEMON materializes them into a local URICache before forwarding
+the task to a worker (node/daemon.py), so worker code sees plain local
+paths. ``file://`` URIs (shared filesystems) skip the KV hop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import os
+import shutil
+import threading
+import zipfile
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+KV_PREFIX = "_renv_pkg/"
+PKG_SCHEME = "pkg://"
+# Must stay under the control plane's inbound frame cap — an oversized
+# kv_put would kill the driver's shared control connection. Bigger
+# trees should ship as file:// URIs on a shared filesystem.
+_MAX_PACKAGE_BYTES = 48 * 1024 * 1024
+
+
+def package_directory(path: str) -> Tuple[str, bytes]:
+    """Zip `path` deterministically; returns (uri, zip_bytes). The URI
+    is content-addressed, so identical trees dedupe across jobs."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"not a directory: {path}")
+    buf = io.BytesIO()
+    entries: List[Tuple[str, str]] = []
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            entries.append((full, os.path.relpath(full, path)))
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for full, rel in entries:
+            # Fixed timestamp → byte-stable archive → stable hash.
+            info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+            info.external_attr = 0o644 << 16
+            with open(full, "rb") as f:
+                zf.writestr(info, f.read())
+    blob = buf.getvalue()
+    if len(blob) > _MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package for {path} is {len(blob)} bytes "
+            f"(max {_MAX_PACKAGE_BYTES}); ship large trees as a "
+            f"file:// URI on a shared filesystem instead")
+    digest = hashlib.sha256(blob).hexdigest()[:16]
+    return f"{PKG_SCHEME}{digest}.zip", blob
+
+
+def is_uri(value: str) -> bool:
+    return isinstance(value, str) and (
+        value.startswith(PKG_SCHEME) or value.startswith("file://"))
+
+
+class URICache:
+    """Materialized-URI cache with total-size LRU eviction
+    (reference: _private/runtime_env/uri_cache.py). get() returns the
+    extracted directory for a URI, fetching + unzipping at most once."""
+
+    def __init__(self, base_dir: str,
+                 max_total_bytes: int = 2 * 1024**3,
+                 min_idle_before_evict_s: float = 3600.0):
+        self.base_dir = base_dir
+        self.max_total_bytes = max_total_bytes
+        # Entries touched more recently than this are never evicted —
+        # a materialized working_dir may be the cwd of a RUNNING task
+        # (the reference's uri_cache only evicts unreferenced URIs; an
+        # idle window is the bound used here).
+        self.min_idle_before_evict_s = min_idle_before_evict_s
+        os.makedirs(base_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._sizes: Dict[str, int] = {}
+        self._last_used: Dict[str, float] = {}
+        self._order: List[str] = []  # LRU: oldest first
+
+    def _dir_for(self, uri: str) -> str:
+        name = hashlib.sha256(uri.encode()).hexdigest()[:24]
+        return os.path.join(self.base_dir, name)
+
+    def get(self, uri: str,
+            fetch: Callable[[str], bytes]) -> str:
+        """Local directory containing the URI's extracted contents.
+        `fetch(uri)` must return the zip bytes on a cache miss."""
+        import time as _time
+
+        target = self._dir_for(uri)
+        with self._lock:
+            if uri in self._sizes:
+                self._order.remove(uri)
+                self._order.append(uri)
+                self._last_used[uri] = _time.monotonic()
+                return target
+        if uri.startswith("file://"):
+            blob = open(uri[len("file://"):], "rb").read()
+        else:
+            blob = fetch(uri)
+        # Per-thread scratch dir: concurrent misses of the same URI
+        # (thread-per-connection daemon) must not extract into each
+        # other's tree; the loser's install is discarded under the lock.
+        tmp = f"{target}.tmp{os.getpid()}-{threading.get_ident()}"
+        with contextlib.suppress(FileNotFoundError):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+            for info in zf.infolist():
+                # Zip-slip guard: entries must extract under tmp.
+                dest = os.path.realpath(os.path.join(tmp, info.filename))
+                if not dest.startswith(os.path.realpath(tmp) + os.sep):
+                    raise ValueError(
+                        f"unsafe path in package: {info.filename!r}")
+            zf.extractall(tmp)
+        size = sum(os.path.getsize(os.path.join(r, f))
+                   for r, _d, fs in os.walk(tmp) for f in fs)
+        with self._lock:
+            if uri not in self._sizes:
+                with contextlib.suppress(FileNotFoundError):
+                    shutil.rmtree(target)
+                os.replace(tmp, target)
+                self._sizes[uri] = size
+                self._order.append(uri)
+                self._last_used[uri] = _time.monotonic()
+                self._evict_locked()
+            else:
+                with contextlib.suppress(FileNotFoundError):
+                    shutil.rmtree(tmp)
+        return target
+
+    def _evict_locked(self) -> None:
+        import time as _time
+
+        total = sum(self._sizes.values())
+        now = _time.monotonic()
+        i = 0
+        while total > self.max_total_bytes and i < len(self._order):
+            victim = self._order[i]
+            # Skip recently-used entries: a running task may be chdir'd
+            # into (or importing from) that directory.
+            if (now - self._last_used.get(victim, 0.0)
+                    < self.min_idle_before_evict_s):
+                i += 1
+                continue
+            self._order.pop(i)
+            total -= self._sizes.pop(victim, 0)
+            self._last_used.pop(victim, None)
+            with contextlib.suppress(FileNotFoundError):
+                shutil.rmtree(self._dir_for(victim))
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"entries": len(self._sizes),
+                    "total_bytes": sum(self._sizes.values())}
+
+
+def tree_signature(path: str) -> str:
+    """Cheap change-detection signature of a directory (paths + sizes +
+    mtimes): repeated submissions re-zip only when the tree changed
+    (the reference re-hashes on every upload_package_if_needed)."""
+    sig = hashlib.sha256()
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            sig.update(f"{os.path.relpath(full, path)}:{st.st_size}:"
+                       f"{st.st_mtime_ns};".encode())
+    return sig.hexdigest()
+
+
+def prepare_for_upload(renv: Optional[Dict[str, Any]],
+                       upload: Callable[[str, bytes], None],
+                       _cache: Dict[str, Tuple[str, str]]
+                       ) -> Optional[Dict[str, Any]]:
+    """Rewrite local directories in a runtime_env to content-addressed
+    pkg:// URIs, uploading each distinct tree once (driver side —
+    reference: upload_package_if_needed). `_cache` maps abspath →
+    (tree_signature, uri); an edited tree re-zips and re-uploads."""
+    if not renv:
+        return renv
+    out = dict(renv)
+
+    def to_uri(path: str) -> str:
+        if is_uri(path):
+            return path
+        key = os.path.abspath(str(path))
+        sig = tree_signature(key)
+        cached = _cache.get(key)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        uri, blob = package_directory(key)
+        upload(uri, blob)
+        _cache[key] = (sig, uri)
+        return uri
+
+    wd = out.get("working_dir")
+    if wd and not is_uri(str(wd)):
+        out["working_dir"] = to_uri(str(wd))
+    pm = out.get("py_modules")
+    if pm:
+        out["py_modules"] = [
+            to_uri(str(p)) if os.path.isdir(str(p)) or is_uri(str(p))
+            else str(p)
+            for p in pm]
+    return out
+
+
+def materialize(renv: Optional[Dict[str, Any]], cache: URICache,
+                fetch: Callable[[str], bytes]
+                ) -> Optional[Dict[str, Any]]:
+    """Resolve pkg://+file:// URIs in a runtime_env to local extracted
+    directories (node-daemon side — the reference's per-node agent
+    GetOrCreateRuntimeEnv step)."""
+    if not renv:
+        return renv
+    out = dict(renv)
+    wd = out.get("working_dir")
+    if wd and is_uri(str(wd)):
+        out["working_dir"] = cache.get(str(wd), fetch)
+    pm = out.get("py_modules")
+    if pm:
+        out["py_modules"] = [
+            cache.get(str(p), fetch) if is_uri(str(p)) else str(p)
+            for p in pm]
+    return out

@@ -162,6 +162,13 @@ class NodeDaemon:
         # Daemon-wide function cache: fid -> cloudpickled bytes.
         self._fn_cache: Dict[bytes, bytes] = {}
         self._fn_lock = threading.Lock()
+        # Runtime-env materialization (the reference's per-node agent
+        # role): pkg:// URIs from the control plane's KV are extracted
+        # into a local size-evicted cache before tasks reach workers.
+        from ray_tpu.core.runtime_env_packaging import URICache
+
+        self._renv_cache = URICache(
+            os.path.join(session_dir, "runtime_env_cache"))
 
         # Dispatch server.
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -320,6 +327,23 @@ class NodeDaemon:
             send_msg(conn, {"type": "result", "task_id": msg.get("task_id"),
                             "fetch_failed": missing})
             return
+
+        if msg.get("runtime_env"):
+            from ray_tpu.core.runtime_env_packaging import (
+                KV_PREFIX,
+                materialize,
+            )
+
+            try:
+                msg["runtime_env"] = materialize(
+                    msg["runtime_env"], self._renv_cache,
+                    lambda uri: self.control.kv_get(KV_PREFIX + uri))
+            except Exception as e:  # noqa: BLE001 — bad/missing package
+                send_msg(conn, {"type": "result",
+                                "task_id": msg.get("task_id"),
+                                "crashed": f"runtime_env setup failed: "
+                                           f"{e}"})
+                return
 
         msg["type"] = mtype
         if mtype == "actor_call":

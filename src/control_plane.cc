@@ -20,6 +20,7 @@
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
+#include <netdb.h>
 #include <netinet/tcp.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -184,7 +185,24 @@ struct Server {
   std::string persist_path;          // "" = no persistence
   bool dirty = false;                // state changed since last snapshot
   uint64_t last_snapshot_ms = 0;     // snapshot throttle
+  // External-store mirroring (reference: store_client/redis_store_client.h
+  // — GCS state lives in an external store so a FRESH control plane on
+  // any host can take over after total host loss). The external store
+  // is another control-plane daemon used in KV-only mode; the full
+  // state snapshot is written through to one KV key, throttled.
+  std::string mirror_host;
+  int mirror_port = 0;
+  int mirror_fd = -1;
+  uint64_t mirror_interval_ms = 200;
+  uint64_t last_mirror_ms = 0;
+  uint64_t mirror_req_id = 1;
+  bool mirror_dirty = true;  // push once at boot (baseline the store)
 };
+
+void mark_dirty(Server& s) {
+  s.dirty = true;
+  s.mirror_dirty = true;
+}
 
 // ---------------------------------------------------------------------------
 // Persistence (reference: gcs persistence via store_client/ — Redis or
@@ -210,8 +228,7 @@ bool get_str(const std::string& in, size_t& off, std::string& s) {
   return true;
 }
 
-void snapshot_state(Server& s) {
-  if (s.persist_path.empty()) return;
+std::string serialize_state(Server& s) {
   std::string out = "RTCP1";
   uint32_t n = static_cast<uint32_t>(s.kv.size());
   out.append(reinterpret_cast<const char*>(&n), 4);
@@ -227,6 +244,12 @@ void snapshot_state(Server& s) {
   n = static_cast<uint32_t>(s.jobs.size());
   out.append(reinterpret_cast<const char*>(&n), 4);
   for (const auto& [j, m] : s.jobs) { put_str(out, j); put_str(out, m); }
+  return out;
+}
+
+void snapshot_state(Server& s) {
+  if (s.persist_path.empty()) return;
+  std::string out = serialize_state(s);
 
   std::string tmp = s.persist_path + ".tmp";
   FILE* f = fopen(tmp.c_str(), "wb");
@@ -245,6 +268,8 @@ void snapshot_state(Server& s) {
   s.last_snapshot_ms = now_ms();
 }
 
+void deserialize_state(Server& s, const std::string& in);
+
 void restore_state(Server& s) {
   if (s.persist_path.empty()) return;
   FILE* f = fopen(s.persist_path.c_str(), "rb");
@@ -254,6 +279,10 @@ void restore_state(Server& s) {
   size_t n;
   while ((n = fread(buf, 1, sizeof(buf), f)) > 0) in.append(buf, n);
   fclose(f);
+  deserialize_state(s, in);
+}
+
+void deserialize_state(Server& s, const std::string& in) {
   if (in.compare(0, 5, "RTCP1") != 0) return;
   size_t off = 5;
   auto read_count = [&](uint32_t& c) {
@@ -287,6 +316,131 @@ void restore_state(Server& s) {
     if (!get_str(in, off, j) || !get_str(in, off, m)) return;
     s.jobs[j] = m;
   }
+}
+
+// ---------------------------------------------------------------------------
+// External-store mirror client (blocking, bounded by socket timeouts so
+// a dead store can stall the loop by at most ~2s per throttled push).
+// ---------------------------------------------------------------------------
+
+static const char kMirrorKey[] = "_cp_mirror";
+
+int mirror_dial(const std::string& host, int port) {
+  // Hostnames allowed (getaddrinfo), not just numeric IPs.
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portbuf[16];
+  snprintf(portbuf, sizeof(portbuf), "%d", port);
+  if (getaddrinfo(host.c_str(), portbuf, &hints, &res) != 0 ||
+      res == nullptr)
+    return -1;
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0) { freeaddrinfo(res); return -1; }
+  timeval tv{2, 0};
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  bool ok = connect(fd, res->ai_addr, res->ai_addrlen) == 0;
+  freeaddrinfo(res);
+  if (!ok) { close(fd); return -1; }
+  return fd;
+}
+
+bool mirror_write_all(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t w = send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool mirror_read_all(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// Send one request frame and read its response body (skipping pubsub
+// pushes). Returns false on any socket/protocol error.
+bool mirror_request(int fd, uint64_t req_id, uint8_t op,
+                    const std::string& args, std::string& resp_body) {
+  std::string p;
+  p.push_back(0);  // frame type: request
+  p.append(reinterpret_cast<const char*>(&req_id), 8);
+  p.push_back(static_cast<char>(op));
+  p.append(args);
+  uint32_t len = static_cast<uint32_t>(p.size());
+  if (!mirror_write_all(fd, &len, 4) ||
+      !mirror_write_all(fd, p.data(), p.size()))
+    return false;
+  for (;;) {
+    uint32_t rlen;
+    if (!mirror_read_all(fd, &rlen, 4) || rlen < 1 ||
+        rlen > (256u << 20))
+      return false;
+    std::string frame(rlen, '\0');
+    if (!mirror_read_all(fd, frame.data(), rlen)) return false;
+    if (frame[0] != 0) continue;  // pubsub push — not for us
+    if (rlen < 9) return false;
+    resp_body.assign(frame, 9, std::string::npos);
+    return true;
+  }
+}
+
+void mirror_push(Server& s) {
+  if (s.mirror_port == 0 || !s.mirror_dirty) return;
+  s.last_mirror_ms = now_ms();
+  if (s.mirror_fd < 0)
+    s.mirror_fd = mirror_dial(s.mirror_host, s.mirror_port);
+  if (s.mirror_fd < 0) {
+    fprintf(stderr, "mirror %s:%d unreachable; state not mirrored\n",
+            s.mirror_host.c_str(), s.mirror_port);
+    return;  // stays dirty; retried next interval
+  }
+  std::string args;
+  put_str(args, kMirrorKey);
+  put_str(args, serialize_state(s));
+  args.push_back(1);  // overwrite
+  std::string resp;
+  if (!mirror_request(s.mirror_fd, s.mirror_req_id++, OP_KV_PUT, args,
+                      resp) ||
+      resp.empty() || resp[0] != ST_OK) {
+    fprintf(stderr, "mirror push to %s:%d failed; will retry\n",
+            s.mirror_host.c_str(), s.mirror_port);
+    close(s.mirror_fd);
+    s.mirror_fd = -1;
+  } else {
+    s.mirror_dirty = false;
+  }
+}
+
+bool mirror_restore(Server& s) {
+  int fd = mirror_dial(s.mirror_host, s.mirror_port);
+  if (fd < 0) return false;
+  std::string args;
+  put_str(args, kMirrorKey);
+  std::string resp;
+  bool ok = mirror_request(fd, 1, OP_KV_GET, args, resp);
+  close(fd);
+  if (!ok || resp.size() < 1 || resp[0] != ST_OK) return false;
+  size_t off = 1;
+  std::string blob;
+  if (!get_str(resp, off, blob)) return false;
+  deserialize_state(s, blob);
+  fprintf(stderr, "restored state from mirror %s:%d (%zu bytes)\n",
+          s.mirror_host.c_str(), s.mirror_port, blob.size());
+  return true;
 }
 
 void set_nonblock(int fd) {
@@ -378,7 +532,7 @@ void dispatch(Server& s, Conn& c, Reader& r) {
         w.u8(ST_EXISTS);
       } else {
         s.kv[key] = val;
-        s.dirty = true;
+        mark_dirty(s);
         w.u8(ST_OK);
       }
       break;
@@ -393,7 +547,7 @@ void dispatch(Server& s, Conn& c, Reader& r) {
     case OP_KV_DEL: {
       std::string key = r.str();
       bool erased = s.kv.erase(key) > 0;
-      if (erased) s.dirty = true;
+      if (erased) mark_dirty(s);
       w.u8(erased ? ST_OK : ST_NOT_FOUND);
       break;
     }
@@ -514,7 +668,7 @@ void dispatch(Server& s, Conn& c, Reader& r) {
       a.name = name;
       a.state = "PENDING";
       a.meta = meta;
-      s.dirty = true;
+      mark_dirty(s);
       publish(s, "actor_events", "PENDING:" + actor_id);
       w.u8(ST_OK);
       break;
@@ -524,7 +678,7 @@ void dispatch(Server& s, Conn& c, Reader& r) {
       auto it = s.actors.find(actor_id);
       if (it == s.actors.end()) { w.u8(ST_NOT_FOUND); break; }
       it->second.state = state;
-      s.dirty = true;
+      mark_dirty(s);
       if (state == "DEAD" && !it->second.name.empty()) {
         auto nit = s.named_actors.find(it->second.name);
         if (nit != s.named_actors.end() && nit->second == actor_id)
@@ -565,7 +719,7 @@ void dispatch(Server& s, Conn& c, Reader& r) {
     case OP_ADD_JOB: {
       std::string job_id = r.str(), meta = r.str();
       s.jobs[job_id] = meta;
-      s.dirty = true;
+      mark_dirty(s);
       w.u8(ST_OK);
       break;
     }
@@ -626,7 +780,7 @@ void handle_readable(Server& s, int fd) {
   while (c.inbuf.size() - off >= 4) {
     uint32_t len;
     memcpy(&len, c.inbuf.data() + off, 4);
-    if (len > (64u << 20)) { close_conn(s, fd); return; }
+    if (len > (256u << 20)) { close_conn(s, fd); return; }  // frame cap (fits mirror blobs)
     if (c.inbuf.size() - off - 4 < len) break;
     const uint8_t* body = c.inbuf.data() + off + 4;
     // body[0] = frame type (requests only from clients).
@@ -682,6 +836,8 @@ int main(int argc, char** argv) {
   uint64_t health_timeout_ms = 5000;
   const char* persist = nullptr;
   bool bind_all = false;  // 0.0.0.0 for multi-host clusters
+  const char* mirror = nullptr;  // "host:port" of the external store
+  uint64_t mirror_interval_ms = 200;
   for (int i = 1; i < argc; i++) {
     if (strcmp(argv[i], "--bind-all") == 0) bind_all = true;
     if (i >= argc - 1) continue;
@@ -689,6 +845,9 @@ int main(int argc, char** argv) {
     if (strcmp(argv[i], "--health-timeout-ms") == 0)
       health_timeout_ms = strtoull(argv[i + 1], nullptr, 10);
     if (strcmp(argv[i], "--persist") == 0) persist = argv[i + 1];
+    if (strcmp(argv[i], "--mirror") == 0) mirror = argv[i + 1];
+    if (strcmp(argv[i], "--mirror-interval-ms") == 0)
+      mirror_interval_ms = strtoull(argv[i + 1], nullptr, 10);
   }
 
   Server s;
@@ -696,6 +855,22 @@ int main(int argc, char** argv) {
   if (persist != nullptr) {
     s.persist_path = persist;
     restore_state(s);  // reference: gcs_init_data.cc reload on restart
+  }
+  if (mirror != nullptr) {
+    std::string m(mirror);
+    size_t colon = m.rfind(':');
+    if (colon == std::string::npos ||
+        atoi(m.c_str() + colon + 1) <= 0) {
+      fprintf(stderr, "--mirror must be host:port (got %s)\n", mirror);
+      return 1;  // accepted != enforced: never run believing HA is on
+    }
+    s.mirror_host = m.substr(0, colon);
+    s.mirror_port = atoi(m.c_str() + colon + 1);
+    s.mirror_interval_ms = mirror_interval_ms;
+    // Take over from the external store when local state is empty
+    // (fresh host after losing the previous control plane).
+    if (s.kv.empty() && s.actors.empty() && s.jobs.empty())
+      mirror_restore(s);
   }
   s.listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
@@ -725,7 +900,12 @@ int main(int argc, char** argv) {
 
   epoll_event events[256];
   for (;;) {
-    int n = epoll_wait(s.epfd, events, 256, 500);
+    // Wake at least as often as the mirror interval — otherwise a
+    // quiet cluster's last mutations sit unmirrored for up to 500ms.
+    int wait_ms = 500;
+    if (s.mirror_port != 0 && s.mirror_interval_ms < 500)
+      wait_ms = static_cast<int>(s.mirror_interval_ms);
+    int n = epoll_wait(s.epfd, events, 256, wait_ms);
     if (n < 0) {
       if (errno == EINTR) continue;
       perror("epoll_wait");
@@ -757,6 +937,9 @@ int main(int argc, char** argv) {
     check_health(s);
     // Throttled snapshots: full-state rewrites on every epoll tick
     // would be O(state) I/O per write under load.
+    if (s.mirror_port != 0
+        && now_ms() - s.last_mirror_ms >= s.mirror_interval_ms)
+      mirror_push(s);
     if (s.dirty && now_ms() - s.last_snapshot_ms >= 1000)
       snapshot_state(s);
   }

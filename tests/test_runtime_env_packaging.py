@@ -1,0 +1,122 @@
+"""Runtime-env packaging + URI cache
+(reference: _private/runtime_env/packaging.py, uri_cache.py, and the
+per-node agent flow runtime_env_agent.py:161)."""
+
+import os
+
+import pytest
+
+from ray_tpu.core import runtime_env_packaging as pkg
+
+
+@pytest.fixture
+def module_dir(tmp_path):
+    d = tmp_path / "mymod"
+    d.mkdir()
+    (d / "envmod.py").write_text("MAGIC = 'from-pkg'\n")
+    (d / "data.txt").write_text("hello-data\n")
+    return str(d)
+
+
+class TestPackaging:
+    def test_content_addressed_and_deterministic(self, module_dir):
+        uri1, blob1 = pkg.package_directory(module_dir)
+        uri2, blob2 = pkg.package_directory(module_dir)
+        assert uri1 == uri2 and blob1 == blob2
+        assert uri1.startswith("pkg://") and uri1.endswith(".zip")
+
+    def test_content_change_changes_uri(self, module_dir):
+        uri1, _ = pkg.package_directory(module_dir)
+        with open(os.path.join(module_dir, "envmod.py"), "a") as f:
+            f.write("X = 2\n")
+        uri2, _ = pkg.package_directory(module_dir)
+        assert uri1 != uri2
+
+    def test_uri_cache_fetches_once(self, module_dir, tmp_path):
+        uri, blob = pkg.package_directory(module_dir)
+        cache = pkg.URICache(str(tmp_path / "cache"))
+        calls = []
+
+        def fetch(u):
+            calls.append(u)
+            return blob
+
+        d1 = cache.get(uri, fetch)
+        d2 = cache.get(uri, fetch)
+        assert d1 == d2
+        assert calls == [uri]
+        assert open(os.path.join(d1, "envmod.py")).read().startswith(
+            "MAGIC")
+
+    def test_uri_cache_evicts_by_size(self, tmp_path):
+        cache = pkg.URICache(str(tmp_path / "cache"),
+                             max_total_bytes=1500,
+                             min_idle_before_evict_s=0.0)
+        blobs = {}
+        for i in range(3):
+            d = tmp_path / f"src{i}"
+            d.mkdir()
+            (d / "f.bin").write_bytes(bytes([i]) * 1000)
+            uri, blob = pkg.package_directory(str(d))
+            blobs[uri] = blob
+            cache.get(uri, lambda u, b=blob: b)
+        st = cache.stats()
+        assert st["entries"] < 3  # oldest evicted
+        assert st["total_bytes"] <= 2000
+
+    def test_prepare_for_upload_rewrites_and_dedupes(self, module_dir):
+        uploads = []
+        cache = {}
+        renv = {"working_dir": module_dir, "py_modules": [module_dir],
+                "env_vars": {"A": "1"}}
+        out = pkg.prepare_for_upload(
+            renv, lambda uri, blob: uploads.append(uri), cache)
+        assert out["working_dir"].startswith("pkg://")
+        assert out["py_modules"][0] == out["working_dir"]
+        assert out["env_vars"] == {"A": "1"}
+        assert len(uploads) == 1  # same tree uploaded once
+        # Second prepare: no new upload (path cache).
+        pkg.prepare_for_upload(renv, lambda u, b: uploads.append(u),
+                               cache)
+        assert len(uploads) == 1
+
+    def test_zip_slip_rejected(self, tmp_path):
+        import io
+        import zipfile
+
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            zf.writestr("../evil.txt", "nope")
+        cache = pkg.URICache(str(tmp_path / "cache"))
+        with pytest.raises(ValueError, match="unsafe path"):
+            cache.get("pkg://deadbeef.zip", lambda u: buf.getvalue())
+
+
+def test_runtime_env_uri_flows_to_daemon_workers(module_dir):
+    """E2E (reference flow: driver uploads once → per-node agent
+    materializes → worker imports): a task on a node daemon imports a
+    module and reads working_dir data shipped as pkg:// URIs."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import RealCluster
+
+    ray_tpu.shutdown()
+    cluster = RealCluster()
+    try:
+        cluster.add_node(num_cpus=2)
+        ray = cluster.connect()
+
+        @ray.remote(runtime_env={"py_modules": [module_dir],
+                                 "working_dir": module_dir})
+        def use_env():
+            import envmod
+
+            return envmod.MAGIC, open("data.txt").read().strip()
+
+        magic, data = ray.get(use_env.remote(), timeout=60)
+        assert magic == "from-pkg"
+        assert data == "hello-data"
+
+        # Second call reuses the daemon's materialized cache.
+        assert ray.get(use_env.remote(), timeout=60)[0] == "from-pkg"
+    finally:
+        cluster.shutdown()
