@@ -44,11 +44,16 @@ class PlacementGroup:
     # -- API parity -------------------------------------------------------
     def ready(self):
         """Returns an ObjectRef that resolves when the PG is placed
-        (non-blocking; the wait happens inside a 0-CPU task)."""
+        (non-blocking; the wait happens inside a 0-CPU task pinned to
+        the DRIVER's node — it waits on this process's in-memory
+        placement event and must not ship to a remote daemon)."""
         from .. import remote
+        from .runtime import global_runtime
+        from .task import NodeAffinitySchedulingStrategy
         pg = self
 
-        @remote(num_cpus=0)
+        @remote(num_cpus=0, scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=global_runtime().head_node_id, soft=False))
         def _pg_ready() -> bool:
             pg.wait(timeout=None)
             return True

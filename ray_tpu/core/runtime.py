@@ -859,6 +859,12 @@ class Runtime:
             self.head_node_id, ResourceSet(total),
             max_workers=max(4, int(num_cpus) * 2),
         )
+        if cluster_address is not None and not any(total.values()):
+            # Zero-resource driver joining a daemon cluster: keep the
+            # head node OUT of placement, or zero-resource tasks and
+            # actors (the actor default) would all run local-first in
+            # the driver instead of on the daemons.
+            head.schedulable = False
         self.scheduler.add_node(head)
 
         # Out-of-process execution plane: spawned worker processes behind

@@ -59,6 +59,10 @@ class NodeState:
     """One schedulable node: a resource view plus an executor."""
 
     is_remote = False  # RemoteNodeState (node-daemon plane) overrides
+    # False = excluded from placement (a cluster-mode driver's head
+    # node: zero-resource work would otherwise all land local-first
+    # on the driver instead of the daemons).
+    schedulable = True
 
     def __init__(self, node_id: str, total: ResourceSet, max_workers: int):
         self.node_id = node_id
@@ -245,7 +249,8 @@ class Scheduler:
     def _feasible_anywhere(self, spec: TaskSpec) -> bool:
         return any(
             spec.resources.fits(n.total) and _labels_match(spec, n)
-            for n in self._nodes.values() if n.alive
+            for n in self._nodes.values()
+            if n.alive and n.schedulable
         )
 
     # -- policies ---------------------------------------------------------
@@ -277,7 +282,8 @@ class Scheduler:
 
         fitting = [
             n for n in self._nodes.values()
-            if n.alive and spec.resources.fits(n.available)
+            if n.alive and n.schedulable
+            and spec.resources.fits(n.available)
         ]
         fitting = [n for n in fitting if _labels_match(spec, n)]
         if not fitting:
