@@ -3,36 +3,66 @@
 Capability-equivalent to the reference's RLlib new stack (reference:
 rllib/ — RLModule, EnvRunner, Learner, Algorithm; SURVEY.md §2.3 RLlib
 row): parallel env-rollout actors + a jitted learner. On-policy: PPO for
-control, GRPO for LLM RLHF (BASELINE config 5). Off-policy: double DQN
-and discrete SAC over a replay buffer.
+control, GRPO for LLM RLHF (BASELINE config 5), IMPALA/APPO. Off-policy:
+double DQN, discrete SAC, and the continuous-control family (SAC/TD3/
+DDPG over a Gaussian or deterministic policy) with uniform, prioritized
+and sequence replay. Multi-agent: MultiAgentEnv + policy-mapped PPO.
+Offline: BC and CQL over logged datasets.
 """
 
 from .algorithm import Algorithm
 from .appo import APPO, APPOConfig
-from .buffer import ReplayBuffer
+from .buffer import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    SequenceReplayBuffer,
+)
+from .continuous import (
+    DDPG,
+    TD3,
+    ContinuousConfig,
+    ContinuousEnvRunner,
+    GaussianPolicySpec,
+    QSASpec,
+    SACContinuous,
+)
+from .dqn import DQN, DQNConfig
 from .env import (
     ENV_REGISTRY,
     CartPole,
+    ContinuousEnv,
     Env,
     GridWorld,
+    MultiAgentEnv,
+    MultiAgentTargets,
+    Pendulum,
     VectorEnv,
     make_env,
     register_env,
 )
-from .dqn import DQN, DQNConfig
 from .env_runner import EnvRunner
 from .grpo import GRPO, GRPOConfig
 from .impala import IMPALA, IMPALAConfig
 from .module import MLPModuleSpec, QMLPSpec
+from .multi_agent import (
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from .offline import BC, CQL, BCConfig, CQLConfig, OfflineDataset
 from .ppo import PPO, PPOConfig
 from .sac import SAC, SACConfig
 
 __all__ = [
-    "Algorithm", "ReplayBuffer", "Env", "CartPole", "GridWorld",
+    "Algorithm", "ReplayBuffer", "PrioritizedReplayBuffer",
+    "SequenceReplayBuffer", "Env", "ContinuousEnv", "CartPole",
+    "GridWorld", "Pendulum", "MultiAgentEnv", "MultiAgentTargets",
     "VectorEnv", "make_env", "register_env", "ENV_REGISTRY", "EnvRunner",
-    "MLPModuleSpec", "QMLPSpec", "PPO", "PPOConfig", "GRPO", "GRPOConfig",
-    "DQN", "DQNConfig", "SAC", "SACConfig", "IMPALA", "IMPALAConfig",
-    "APPO", "APPOConfig", "BC", "BCConfig", "CQL", "CQLConfig",
-    "OfflineDataset",
+    "ContinuousEnvRunner", "MultiAgentEnvRunner",
+    "MLPModuleSpec", "QMLPSpec", "GaussianPolicySpec", "QSASpec",
+    "PPO", "PPOConfig", "GRPO", "GRPOConfig",
+    "DQN", "DQNConfig", "SAC", "SACConfig", "SACContinuous",
+    "TD3", "DDPG", "ContinuousConfig", "IMPALA", "IMPALAConfig",
+    "APPO", "APPOConfig", "MultiAgentPPO", "MultiAgentPPOConfig",
+    "BC", "BCConfig", "CQL", "CQLConfig", "OfflineDataset",
 ]

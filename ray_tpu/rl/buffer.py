@@ -1,14 +1,15 @@
-"""Replay buffer for off-policy algorithms.
+"""Replay buffer family for off-policy algorithms.
 
 Capability-equivalent to the reference's replay buffer family
-(reference: rllib/utils/replay_buffers/ — EpisodeReplayBuffer,
-PrioritizedEpisodeReplayBuffer): a bounded FIFO of transitions with
-uniform sampling; numpy-backed so EnvRunner actors can feed it directly.
+(reference: rllib/utils/replay_buffers/ — ReplayBuffer,
+PrioritizedEpisodeReplayBuffer with proportional priorities +
+importance weights, and sequence sampling for recurrent learners):
+numpy-backed so EnvRunner actors can feed them directly.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -40,3 +41,119 @@ class ReplayBuffer:
     def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
         idx = self._rng.integers(0, self._size, size=batch_size)
         return {k: v[idx] for k, v in self._storage.items()}
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (Schaul et al. 2016; reference:
+    rllib/utils/replay_buffers/prioritized_episode_buffer.py
+    capability): P(i) ∝ p_i^alpha, importance weights
+    w_i = (N·P(i))^-beta normalized by max. New transitions get the
+    current max priority; the learner calls update_priorities with
+    fresh TD errors."""
+
+    def __init__(self, capacity: int, *, alpha: float = 0.6,
+                 beta: float = 0.4, eps: float = 1e-6,
+                 seed: Optional[int] = None):
+        super().__init__(capacity, seed=seed)
+        self.alpha = alpha
+        self.beta = beta
+        self.eps = eps
+        self._priorities = np.zeros((capacity,), np.float64)
+        self._max_priority = 1.0
+
+    def add_batch(self, batch: Dict[str, np.ndarray]) -> None:
+        n = len(next(iter(batch.values())))
+        idx = (self._next + np.arange(n)) % self.capacity
+        super().add_batch(batch)
+        self._priorities[idx] = self._max_priority
+
+    def sample(self, batch_size: int
+               ) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]:
+        """→ (batch, indices, importance_weights). Feed `indices` back
+        to update_priorities after computing TD errors."""
+        p = self._priorities[:self._size] ** self.alpha
+        probs = p / p.sum()
+        idx = self._rng.choice(self._size, size=batch_size, p=probs)
+        w = (self._size * probs[idx]) ** (-self.beta)
+        w = (w / w.max()).astype(np.float32)
+        return ({k: v[idx] for k, v in self._storage.items()}, idx, w)
+
+    def update_priorities(self, idx: np.ndarray,
+                          td_errors: np.ndarray) -> None:
+        pr = np.abs(np.asarray(td_errors, np.float64)) + self.eps
+        self._priorities[idx] = pr
+        self._max_priority = max(self._max_priority, float(pr.max()))
+
+
+class SequenceReplayBuffer:
+    """Samples CONTIGUOUS fixed-length sequences per environment stream
+    (reference: rllib sequence/episode sampling for recurrent and
+    multi-step learners). add_rollout stores time-major (T, K, ...)
+    rollouts; sample returns (B, L, ...) windows that never cross an
+    episode boundary (`dones` gates eligibility)."""
+
+    def __init__(self, capacity_per_env: int, num_envs: int,
+                 seq_len: int, seed: Optional[int] = None):
+        self.capacity = capacity_per_env
+        self.num_envs = num_envs
+        self.seq_len = seq_len
+        self._storage: Dict[str, np.ndarray] = {}
+        self._size = 0
+        self._next = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size * self.num_envs
+
+    def add_rollout(self, rollout: Dict[str, np.ndarray]) -> None:
+        """rollout: dict of time-major (T, K, ...) arrays; must include
+        'dones' (T, K)."""
+        t = len(next(iter(rollout.values())))
+        if not self._storage:
+            for k, v in rollout.items():
+                self._storage[k] = np.zeros(
+                    (self.capacity,) + v.shape[1:], v.dtype)
+        idx = (self._next + np.arange(t)) % self.capacity
+        for k, v in rollout.items():
+            self._storage[k][idx] = v
+        self._next = (self._next + t) % self.capacity
+        self._size = min(self._size + t, self.capacity)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        """→ dict of (B, L, ...) sequences."""
+        L = self.seq_len
+        if self._size < L:
+            raise ValueError(f"buffer has {self._size} steps < "
+                             f"seq_len {L}")
+        dones = self._storage["dones"]
+        starts, envs = [], []
+        tries = 0
+        while len(starts) < batch_size and tries < batch_size * 20:
+            tries += 1
+            s = int(self._rng.integers(0, self._size - L + 1))
+            e = int(self._rng.integers(0, self.num_envs))
+            # Reject windows that span an episode boundary (a done at
+            # any step but the last ends the episode mid-window) or the
+            # ring-buffer write head (temporally discontinuous).
+            if self._size == self.capacity:
+                head = self._next
+                if s < head <= s + L - 1 and head != 0:
+                    continue
+            if np.any(dones[s:s + L - 1, e]):
+                continue
+            starts.append(s)
+            envs.append(e)
+        if not starts:
+            raise ValueError("no boundary-free sequences available")
+        if len(starts) < batch_size:
+            # Keep the batch shape FIXED (jitted learners compile per
+            # shape): top up by resampling accepted windows.
+            fill = self._rng.integers(0, len(starts),
+                                      size=batch_size - len(starts))
+            starts += [starts[i] for i in fill]
+            envs += [envs[i] for i in fill]
+        out = {}
+        for k, v in self._storage.items():
+            out[k] = np.stack([v[s:s + L, e]
+                               for s, e in zip(starts, envs)])
+        return out

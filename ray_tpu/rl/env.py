@@ -117,6 +117,143 @@ class GridWorld(Env):
         return self._obs(), reward, done, self._t >= self.max_steps
 
 
+class ContinuousEnv(Env):
+    """Continuous-action env protocol: actions are float vectors in
+    [-action_limit, action_limit]^action_size."""
+
+    action_size: int
+    action_limit: float = 1.0
+
+
+class Pendulum(ContinuousEnv):
+    """Classic torque-controlled pendulum swing-up (the standard
+    continuous-control benchmark — pure numpy physics)."""
+
+    observation_size = 3  # (cos θ, sin θ, θ̇)
+    num_actions = 0       # continuous
+    action_size = 1
+    action_limit = 2.0
+
+    G = 10.0
+    MASS = 1.0
+    LENGTH = 1.0
+    DT = 0.05
+    MAX_SPEED = 8.0
+    MAX_STEPS = 200
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = np.random.default_rng(seed)
+        self._theta = 0.0
+        self._thetadot = 0.0
+        self._t = 0
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._theta = self._rng.uniform(-np.pi, np.pi)
+        self._thetadot = self._rng.uniform(-1.0, 1.0)
+        self._t = 0
+        return self._obs()
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self._theta), np.sin(self._theta),
+                         self._thetadot], np.float32)
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.action_limit, self.action_limit))
+        th, thdot = self._theta, self._thetadot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + (3 * self.G / (2 * self.LENGTH) * np.sin(th)
+                         + 3.0 / (self.MASS * self.LENGTH ** 2) * u) \
+            * self.DT
+        thdot = float(np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED))
+        self._theta = th + thdot * self.DT
+        self._thetadot = thdot
+        self._t += 1
+        return self._obs(), -cost, False, self._t >= self.MAX_STEPS
+
+
+class MultiAgentEnv:
+    """Multi-agent env protocol (reference:
+    rllib/env/multi_agent_env.py — dict-keyed observations/rewards per
+    agent id; agents may finish at different times; '__all__' in the
+    terminated dict ends the episode)."""
+
+    agent_ids: Tuple[str, ...]
+    observation_size: int
+    num_actions: int
+
+    def reset(self, seed: Optional[int] = None) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, int]) -> Tuple[
+            Dict[str, np.ndarray], Dict[str, float],
+            Dict[str, bool], Dict[str, bool]]:
+        """→ (obs, rewards, terminated, truncated); terminated/truncated
+        include the '__all__' key."""
+        raise NotImplementedError
+
+
+class MultiAgentTargets(MultiAgentEnv):
+    """Cooperative toy: each agent walks a 1-D line to its own target;
+    per-agent shaped reward + a shared bonus when ALL arrive. Agents
+    that reach their target stop acting (dynamic agent sets — the part
+    of the multi-agent contract single-agent wrappers can't express)."""
+
+    def __init__(self, n_agents: int = 2, size: int = 8,
+                 seed: Optional[int] = None):
+        self.agent_ids = tuple(f"agent_{i}" for i in range(n_agents))
+        self.size = size
+        self.observation_size = 2  # (my pos, my target), normalized
+        self.num_actions = 3       # left / stay / right
+        self.max_steps = 4 * size
+        self._rng = np.random.default_rng(seed)
+
+    def reset(self, seed: Optional[int] = None) -> Dict[str, np.ndarray]:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        n = len(self.agent_ids)
+        self._pos = self._rng.integers(0, self.size, size=n)
+        self._tgt = self._rng.integers(0, self.size, size=n)
+        self._done = np.zeros(n, bool)
+        self._t = 0
+        return {a: self._obs(i) for i, a in enumerate(self.agent_ids)
+                if not self._done[i]}
+
+    def _obs(self, i: int) -> np.ndarray:
+        return np.array([self._pos[i], self._tgt[i]],
+                        np.float32) / (self.size - 1)
+
+    def step(self, actions: Dict[str, int]):
+        self._t += 1
+        rewards: Dict[str, float] = {}
+        for i, a in enumerate(self.agent_ids):
+            if self._done[i] or a not in actions:
+                continue
+            move = int(actions[a]) - 1  # {0,1,2} → {-1,0,+1}
+            self._pos[i] = int(np.clip(self._pos[i] + move, 0,
+                                       self.size - 1))
+            if self._pos[i] == self._tgt[i]:
+                rewards[a] = 1.0
+                self._done[i] = True
+            else:
+                rewards[a] = -0.05
+        all_done = bool(self._done.all())
+        if all_done:
+            rewards = {a: r + 1.0 for a, r in rewards.items()}
+        truncated = self._t >= self.max_steps
+        obs = {a: self._obs(i) for i, a in enumerate(self.agent_ids)
+               if not self._done[i]}
+        terminated = {a: bool(self._done[i])
+                      for i, a in enumerate(self.agent_ids)}
+        terminated["__all__"] = all_done
+        trunc = {a: truncated for a in self.agent_ids}
+        trunc["__all__"] = truncated
+        return obs, rewards, terminated, trunc
+
+
 class VectorEnv:
     """K independent env copies stepped as a batch, auto-resetting —
     the unit an EnvRunner drives (reference: rllib env vectorization)."""
@@ -140,8 +277,9 @@ class VectorEnv:
         """→ (obs, rewards, dones). Auto-resets finished envs; `dones`
         marks boundaries for GAE."""
         obs, rewards, dones = [], [], []
+        continuous = getattr(self.envs[0], "action_size", 0) > 0
         for i, (e, a) in enumerate(zip(self.envs, actions)):
-            o, r, term, trunc = e.step(int(a))
+            o, r, term, trunc = e.step(a if continuous else int(a))
             self.episode_returns[i] += r
             if term or trunc:
                 self.completed_returns.append(self.episode_returns[i])
@@ -163,6 +301,8 @@ class VectorEnv:
 ENV_REGISTRY: Dict[str, Callable[[], Env]] = {
     "CartPole": CartPole,
     "GridWorld": GridWorld,
+    "Pendulum": Pendulum,
+    "MultiAgentTargets": MultiAgentTargets,
 }
 
 

@@ -18,6 +18,36 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def mlp_init(key: jax.Array, sizes: Sequence[int]):
+    """He-initialized dense stack: [{'w', 'b'}] per layer — THE shared
+    torso builder for every RL module spec (drift between specs was a
+    maintenance hazard)."""
+    params = []
+    keys = jax.random.split(key, max(2, len(sizes)))
+    for i in range(len(sizes) - 1):
+        w = jax.random.normal(keys[i], (sizes[i], sizes[i + 1]),
+                              jnp.float32) * np.sqrt(2.0 / sizes[i])
+        params.append({"w": w,
+                       "b": jnp.zeros((sizes[i + 1],), jnp.float32)})
+    return params
+
+
+def mlp_torso(layers, x: jax.Array) -> jax.Array:
+    """tanh after EVERY layer (heads apply their own linear on top)."""
+    for layer in layers:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    return x
+
+
+def mlp_apply(layers, x: jax.Array) -> jax.Array:
+    """tanh between layers, linear final layer (self-contained nets)."""
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
 @dataclass(frozen=True)
 class MLPModuleSpec:
     """Categorical-action policy + value head on a shared MLP torso."""
@@ -27,30 +57,22 @@ class MLPModuleSpec:
     hidden: Tuple[int, ...] = (64, 64)
 
     def init(self, key: jax.Array) -> Dict[str, Any]:
+        k_torso, k_pi, k_v = jax.random.split(key, 3)
         sizes = (self.observation_size,) + tuple(self.hidden)
-        params: Dict[str, Any] = {"torso": []}
-        keys = jax.random.split(key, len(sizes) + 1)
-        for i in range(len(sizes) - 1):
-            w = jax.random.normal(keys[i], (sizes[i], sizes[i + 1]),
-                                  jnp.float32)
-            w = w * np.sqrt(2.0 / sizes[i])
-            params["torso"].append(
-                {"w": w, "b": jnp.zeros((sizes[i + 1],), jnp.float32)})
         d = sizes[-1]
-        params["pi_w"] = jax.random.normal(
-            keys[-2], (d, self.num_actions), jnp.float32) * 0.01
-        params["pi_b"] = jnp.zeros((self.num_actions,), jnp.float32)
-        params["v_w"] = jax.random.normal(keys[-1], (d, 1),
-                                          jnp.float32) * 1.0
-        params["v_b"] = jnp.zeros((1,), jnp.float32)
-        return params
+        return {
+            "torso": mlp_init(k_torso, sizes),
+            "pi_w": jax.random.normal(
+                k_pi, (d, self.num_actions), jnp.float32) * 0.01,
+            "pi_b": jnp.zeros((self.num_actions,), jnp.float32),
+            "v_w": jax.random.normal(k_v, (d, 1), jnp.float32),
+            "v_b": jnp.zeros((1,), jnp.float32),
+        }
 
     def apply(self, params: Dict[str, Any], obs: jax.Array
               ) -> Tuple[jax.Array, jax.Array]:
         """obs (B, obs_size) → (logits (B, A), value (B,))."""
-        h = obs
-        for layer in params["torso"]:
-            h = jnp.tanh(h @ layer["w"] + layer["b"])
+        h = mlp_torso(params["torso"], obs)
         logits = h @ params["pi_w"] + params["pi_b"]
         value = (h @ params["v_w"] + params["v_b"])[..., 0]
         return logits, value
@@ -66,25 +88,18 @@ class QMLPSpec:
     hidden: Tuple[int, ...] = (64, 64)
 
     def init(self, key: jax.Array) -> Dict[str, Any]:
+        k_torso, k_q = jax.random.split(key)
         sizes = (self.observation_size,) + tuple(self.hidden)
-        params: Dict[str, Any] = {"torso": []}
-        keys = jax.random.split(key, len(sizes))
-        for i in range(len(sizes) - 1):
-            w = jax.random.normal(keys[i], (sizes[i], sizes[i + 1]),
-                                  jnp.float32)
-            w = w * np.sqrt(2.0 / sizes[i])
-            params["torso"].append(
-                {"w": w, "b": jnp.zeros((sizes[i + 1],), jnp.float32)})
-        params["q_w"] = jax.random.normal(
-            keys[-1], (sizes[-1], self.num_actions), jnp.float32) * 0.01
-        params["q_b"] = jnp.zeros((self.num_actions,), jnp.float32)
-        return params
+        return {
+            "torso": mlp_init(k_torso, sizes),
+            "q_w": jax.random.normal(
+                k_q, (sizes[-1], self.num_actions), jnp.float32) * 0.01,
+            "q_b": jnp.zeros((self.num_actions,), jnp.float32),
+        }
 
     def apply(self, params: Dict[str, Any], obs: jax.Array) -> jax.Array:
         """obs (B, obs_size) → q-values (B, A)."""
-        h = obs
-        for layer in params["torso"]:
-            h = jnp.tanh(h @ layer["w"] + layer["b"])
+        h = mlp_torso(params["torso"], obs)
         return h @ params["q_w"] + params["q_b"]
 
 

@@ -1,0 +1,124 @@
+"""Continuous-control RL family + replay buffer family
+(reference coverage model: rllib per-algorithm learning tests on toy
+envs + replay-buffer unit tests, rllib/utils/replay_buffers/tests)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl import (
+    DDPG,
+    TD3,
+    ContinuousConfig,
+    GaussianPolicySpec,
+    Pendulum,
+    PrioritizedReplayBuffer,
+    SACContinuous,
+    SequenceReplayBuffer,
+)
+
+
+class TestBuffers:
+    def test_prioritized_sampling_prefers_high_priority(self):
+        buf = PrioritizedReplayBuffer(1000, seed=0, alpha=1.0)
+        buf.add_batch({"x": np.arange(100, dtype=np.float32)})
+        # Give item 7 overwhelming priority.
+        buf.update_priorities(np.array([7]), np.array([1e6]))
+        batch, idx, w = buf.sample(256)
+        assert (idx == 7).mean() > 0.9
+        assert batch["x"].shape == (256,)
+        # IS weights: the over-sampled item gets the SMALLEST weight.
+        assert w[idx == 7].max() <= w.max()
+        assert w.max() <= 1.0 + 1e-6
+
+    def test_prioritized_new_items_get_max_priority(self):
+        buf = PrioritizedReplayBuffer(100, seed=1)
+        buf.add_batch({"x": np.zeros(10, np.float32)})
+        buf.update_priorities(np.arange(10), np.full(10, 100.0))
+        buf.add_batch({"x": np.ones(10, np.float32)})
+        _, idx, _ = buf.sample(200)
+        # Fresh items (indices 10..19) are sampled, not starved.
+        assert (idx >= 10).sum() > 20
+
+    def test_sequence_buffer_respects_episode_boundaries(self):
+        buf = SequenceReplayBuffer(64, num_envs=2, seq_len=4, seed=0)
+        T = 32
+        dones = np.zeros((T, 2), np.float32)
+        dones[10, 0] = 1.0  # boundary mid-stream for env 0
+        buf.add_rollout({
+            "obs": np.tile(np.arange(T, dtype=np.float32)[:, None],
+                           (1, 2)),
+            "dones": dones,
+        })
+        out = buf.sample(32)
+        assert out["obs"].shape == (32, 4)
+        # No window crosses the done at t=10 for env 0: a done may only
+        # appear at the LAST position of a window.
+        assert not np.any(out["dones"][:, :-1])
+        # Sequences are contiguous in time.
+        diffs = np.diff(out["obs"], axis=1)
+        assert np.all(diffs == 1.0)
+
+
+class TestPolicy:
+    def test_tanh_gaussian_logprob_and_bounds(self):
+        import jax
+
+        spec = GaussianPolicySpec(observation_size=3, action_size=2,
+                                  action_limit=2.0)
+        params = spec.init(jax.random.key(0))
+        obs = np.random.default_rng(0).normal(size=(16, 3)).astype(
+            np.float32)
+        act, logp = spec.sample(params, obs, jax.random.key(1))
+        act = np.asarray(act)
+        assert act.shape == (16, 2) and np.all(np.abs(act) <= 2.0)
+        assert np.all(np.isfinite(np.asarray(logp)))
+        mean = np.asarray(spec.mean_action(params, obs))
+        assert np.all(np.abs(mean) <= 2.0)
+
+
+@pytest.mark.parametrize("algo_cls", [SACContinuous, TD3, DDPG])
+def test_continuous_algorithms_train_end_to_end(ray_start, algo_cls):
+    """Functional bar (Pendulum needs ~10k+ steps to visibly improve —
+    too slow for this 1-core box; rllib's learning tests run on real
+    CI fleets): the full rollout→replay→jitted-update loop executes,
+    metrics are finite, params move, actions respect bounds, and a
+    checkpoint roundtrips exactly."""
+    import jax
+
+    cfg = ContinuousConfig(
+        num_env_runners=1, num_envs_per_runner=4, rollout_length=64,
+        learning_starts=256, batch_size=64, updates_per_iteration=16,
+        seed=0)
+    algo = algo_cls(cfg)
+    try:
+        before = jax.device_get(algo.state["pi"])
+        trained = None
+        for _ in range(4):
+            trained = algo.step()
+        assert trained["buffer_size"] >= 256
+        assert np.isfinite(trained["q_loss"])
+        assert np.isfinite(trained["q_mean"])
+        after = jax.device_get(algo.state["pi"])
+        changed = any(
+            not np.allclose(a, b)
+            for a, b in zip(jax.tree_util.tree_leaves(before),
+                            jax.tree_util.tree_leaves(after)))
+        assert changed, "policy params never updated"
+        a = algo.compute_single_action(Pendulum(seed=0).reset())
+        assert a.shape == (1,) and abs(float(a[0])) <= 2.0
+
+        # Checkpoint roundtrip (Algorithm save/restore contract).
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            algo.save(d)
+            algo2 = algo_cls(cfg.with_overrides(num_env_runners=1))
+            try:
+                algo2.restore(d)
+                a2 = algo2.compute_single_action(
+                    Pendulum(seed=0).reset())
+                np.testing.assert_array_equal(a, a2)
+            finally:
+                algo2.stop()
+    finally:
+        algo.stop()

@@ -1,0 +1,78 @@
+"""Multi-agent RL (reference: rllib/env/multi_agent_env.py + the
+policy-mapping training capability)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl import (
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    MultiAgentTargets,
+)
+
+
+class TestMultiAgentEnv:
+    def test_protocol_and_dynamic_agents(self):
+        env = MultiAgentTargets(n_agents=2, size=5, seed=3)
+        obs = env.reset()
+        assert set(obs) <= {"agent_0", "agent_1"}
+        total_steps = 0
+        done = False
+        while not done and total_steps < 100:
+            # Walk each agent toward its target.
+            acts = {}
+            for a, o in obs.items():
+                pos, tgt = o
+                acts[a] = 2 if tgt > pos else (0 if tgt < pos else 1)
+            obs, rews, term, trunc = env.step(acts)
+            assert "__all__" in term and "__all__" in trunc
+            # Finished agents drop out of the obs dict.
+            for a, t in term.items():
+                if a != "__all__" and t:
+                    assert a not in obs
+            done = term["__all__"] or trunc["__all__"]
+            total_steps += 1
+        assert term["__all__"]  # goal-seeking policy finishes
+
+
+def test_multi_agent_ppo_shared_policy_learns(ray_start):
+    cfg = MultiAgentPPOConfig(
+        num_env_runners=1, num_envs_per_runner=4, rollout_length=64,
+        num_epochs=4, minibatch_size=64, train_iterations=5, seed=0)
+    algo = MultiAgentPPO(cfg)
+    try:
+        returns = []
+        for _ in range(14):
+            res = algo.step()
+            if res["episode_return_mean"] is not None:
+                returns.append(res["episode_return_mean"])
+        assert returns, "no episodes completed"
+        # Cooperative targets: shaped reward improves with training.
+        assert np.mean(returns[-3:]) > np.mean(returns[:3]) - 0.5
+        # Greedy joint action works on a fresh env.
+        env = MultiAgentTargets(n_agents=2, seed=7)
+        acts = algo.compute_actions(env.reset())
+        assert set(acts) <= {"agent_0", "agent_1"}
+        assert all(a in (0, 1, 2) for a in acts.values())
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_ppo_per_policy_mapping(ray_start):
+    """Two policies, one per agent (no parameter tying): both receive
+    batches and update independently."""
+    cfg = MultiAgentPPOConfig(
+        policies=("p0", "p1"),
+        policy_mapping={"agent_0": "p0", "agent_1": "p1"},
+        num_env_runners=1, num_envs_per_runner=2, rollout_length=48,
+        num_epochs=2, minibatch_size=32, seed=1)
+    algo = MultiAgentPPO(cfg)
+    try:
+        res = algo.step()
+        assert "p0/pi_loss" in res and "p1/pi_loss" in res
+        # Params diverge (independent updates from different streams).
+        w0 = np.asarray(algo.params["p0"]["pi_w"])
+        w1 = np.asarray(algo.params["p1"]["pi_w"])
+        assert not np.allclose(w0, w1)
+    finally:
+        algo.stop()
