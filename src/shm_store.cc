@@ -25,6 +25,9 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+
+#include <algorithm>
+#include <vector>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -69,6 +72,7 @@ struct Header {
   uint64_t free_head;       // offset of first free block (0 = none)
   uint64_t seq;             // LRU clock
   uint64_t num_objects;
+  uint64_t map_size;        // total mapping bytes (free space ends here)
   pthread_mutex_t mu;
   Slot slots[kMaxObjects];
 };
@@ -256,6 +260,7 @@ void* rts_connect(const char* name, uint64_t capacity, int create) {
     st->hdr->data_start = Align(sizeof(Header));
     st->hdr->used = 0;
     st->hdr->seq = 1;
+    st->hdr->map_size = map_size;
     // One big free block spanning the arena.
     uint64_t start = st->hdr->data_start;
     FreeNode* node = reinterpret_cast<FreeNode*>(st->base + start);
@@ -294,9 +299,62 @@ void rts_disconnect(void* handle) {
 
 int rts_unlink(const char* name) { return shm_unlink(name); }
 
+// A process died while HOLDING the arena mutex: the free list may be
+// mid-splice and its unsealed slots are garbage. pthread's robust-mutex
+// recovery only makes the lock usable again — the shared state must be
+// repaired too. The slot table is the authoritative record of
+// allocated spans, so rebuild the free list (and `used`) from it and
+// tombstone in-flight (SLOT_CREATED) slots.
+// Known limitation (documented): pins held by the dead process leak —
+// per-process pin accounting would be needed to reclaim them safely.
+static void RepairAfterOwnerDeath(Header* h) {
+  uint8_t* base = reinterpret_cast<uint8_t*>(h);  // header sits at base
+  struct Span { uint64_t off, size; };
+  std::vector<Span> spans;
+  spans.reserve(256);
+  for (uint32_t i = 0; i < kMaxObjects; i++) {
+    Slot* s = &h->slots[i];
+    if (s->state == SLOT_CREATED) {
+      // The dead writer owned this slot; the payload was mid-write.
+      s->state = SLOT_TOMBSTONE;
+      if (h->num_objects > 0) h->num_objects--;
+      continue;  // its span returns to the free pool below
+    }
+    if (s->state == SLOT_SEALED || s->state == SLOT_MUTABLE)
+      spans.push_back({s->offset, Align(s->alloc_size)});
+  }
+  std::sort(spans.begin(), spans.end(),
+            [](const Span& a, const Span& b) { return a.off < b.off; });
+  uint64_t used = 0;
+  uint64_t cursor = h->data_start;
+  uint64_t prev_free = 0;
+  h->free_head = 0;
+  auto add_free = [&](uint64_t off, uint64_t size) {
+    if (size < sizeof(FreeNode)) return;  // unusable sliver
+    FreeNode* node = reinterpret_cast<FreeNode*>(base + off);
+    node->size = size;
+    node->next = 0;
+    if (prev_free)
+      reinterpret_cast<FreeNode*>(base + prev_free)->next = off;
+    else
+      h->free_head = off;
+    prev_free = off;
+  };
+  for (const Span& sp : spans) {
+    if (sp.off > cursor) add_free(cursor, sp.off - cursor);
+    cursor = sp.off + sp.size;
+    used += sp.size;
+  }
+  if (cursor < h->map_size) add_free(cursor, h->map_size - cursor);
+  h->used = used;
+}
+
 static void Lock(Header* h) {
   int rc = pthread_mutex_lock(&h->mu);
-  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+  if (rc == EOWNERDEAD) {
+    RepairAfterOwnerDeath(h);
+    pthread_mutex_consistent(&h->mu);
+  }
 }
 
 // Create an object buffer. Returns 0 ok, -1 exists, -2 full, -3 table full.
@@ -476,6 +534,34 @@ int rts_ch_write_release(void* handle, const uint8_t* id) {
 }
 
 // Snapshot read: returns version (even) + offset/size, or -1 if missing,
+// Test-only fault injection (crash-window coverage — reference: the
+// plasma store's crash tests): allocate a span + an UNSEALED slot,
+// poison the free-list head, then die WHILE HOLDING the arena mutex.
+// The next peer to lock must take the EOWNERDEAD path and repair
+// (RepairAfterOwnerDeath): recovered free list, tombstoned slot, no
+// leaked capacity, no deadlock.
+int rts_debug_die_locked(void* handle, const uint8_t* id, uint64_t size) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  uint64_t got = 0;
+  uint64_t off = AllocOrEvictLocked(st, Align(size ? size : 1), &got);
+  if (off) {
+    Slot* s = FindSlot(h, id, true);
+    if (s) {
+      memcpy(s->id, id, kIdLen);
+      s->state = SLOT_CREATED;  // never sealed: mid-write crash
+      s->offset = off;
+      s->size = size;
+      s->alloc_size = got;
+      s->pins = 0;
+      h->num_objects++;
+    }
+  }
+  h->free_head = 12345;  // poison: repair must rebuild, not trust it
+  _exit(42);             // mutex still held
+}
+
 // -2 if a write is in progress (caller retries).
 int64_t rts_ch_read(void* handle, const uint8_t* id, uint64_t* offset_out,
                     uint64_t* size_out) {

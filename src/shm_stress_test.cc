@@ -1,0 +1,115 @@
+// Sanitizer stress harness for the shm arena (reference: the C++ core's
+// TSAN/ASAN CI coverage, SURVEY.md §5 — plasma store tested under
+// sanitizers). Build via `make -C src sanitize` (asan + tsan variants)
+// and run; any data race / heap error fails the process.
+//
+// Workload: N threads over ONE arena handle each (cross-"process" via
+// separate rts_connect attachments), hammering create→write→seal→
+// get(pin)→verify→release→delete with per-thread id spaces plus a
+// shared id space for contention. The seqlock CHANNEL path is excluded
+// here: its readers intentionally race the writer's buffer and resolve
+// via version validation, which TSAN would flag by design.
+
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+extern "C" {
+void* rts_connect(const char* name, uint64_t capacity, int create);
+void rts_disconnect(void* handle);
+int rts_unlink(const char* name);
+int rts_create(void* h, const uint8_t* id, uint64_t size, uint64_t* off);
+int rts_seal(void* h, const uint8_t* id);
+int rts_get(void* h, const uint8_t* id, uint64_t* off, uint64_t* size,
+            int pin);
+int rts_release(void* h, const uint8_t* id);
+int rts_delete(void* h, const uint8_t* id);
+uint8_t* rts_base(void* h);
+}
+
+namespace {
+
+constexpr int kThreads = 8;
+constexpr int kOps = 4000;
+constexpr uint64_t kCapacity = 8ull << 20;
+
+char g_name[64];
+
+void make_id(uint8_t* id, int thread, int slot) {
+  memset(id, 0, 28);
+  id[0] = static_cast<uint8_t>(thread);
+  memcpy(id + 1, &slot, sizeof(slot));
+}
+
+void* worker(void* arg) {
+  long tid = reinterpret_cast<long>(arg);
+  void* h = rts_connect(g_name, 0, 0);
+  if (h == nullptr) {
+    fprintf(stderr, "thread %ld: connect failed\n", tid);
+    abort();
+  }
+  uint8_t* base = rts_base(h);
+  unsigned seed = static_cast<unsigned>(tid) * 7919 + 13;
+  for (int i = 0; i < kOps; i++) {
+    int slot = rand_r(&seed) % 64;
+    // Thread 0..5 use private id spaces; 6..7 contend on a shared one.
+    int owner = (tid < 6) ? static_cast<int>(tid) : 99;
+    uint8_t id[28];
+    make_id(id, owner, slot);
+    uint64_t off = 0, size = 0;
+    int op = rand_r(&seed) % 4;
+    if (op == 0) {
+      uint64_t n = 64 + (rand_r(&seed) % 2048);
+      if (rts_create(h, id, n, &off) == 0) {
+        memset(base + off, static_cast<int>(id[0] ^ id[1]), n);
+        if (rts_seal(h, id) != 0) {
+          fprintf(stderr, "seal failed after create\n");
+          abort();
+        }
+      }
+    } else if (op == 1) {
+      if (rts_get(h, id, &off, &size, 1) == 0) {
+        uint8_t expect = static_cast<uint8_t>(id[0] ^ id[1]);
+        for (uint64_t j = 0; j < size; j += 97) {
+          if (base[off + j] != expect) {
+            fprintf(stderr, "payload corruption at %lu\n",
+                    static_cast<unsigned long>(off + j));
+            abort();
+          }
+        }
+        rts_release(h, id);
+      }
+    } else if (op == 2) {
+      rts_delete(h, id);  // -2 (pinned) and -1 (missing) are fine
+    } else {
+      uint64_t ignored_off = 0, ignored_sz = 0;
+      rts_get(h, id, &ignored_off, &ignored_sz, 0);
+    }
+  }
+  rts_disconnect(h);
+  return nullptr;
+}
+
+}  // namespace
+
+int main() {
+  snprintf(g_name, sizeof(g_name), "/rts_stress_%d", getpid());
+  void* h = rts_connect(g_name, kCapacity, 1);
+  if (h == nullptr) {
+    fprintf(stderr, "create failed\n");
+    return 1;
+  }
+  pthread_t threads[kThreads];
+  for (long t = 0; t < kThreads; t++)
+    pthread_create(&threads[t], nullptr, worker,
+                   reinterpret_cast<void*>(t));
+  for (int t = 0; t < kThreads; t++)
+    pthread_join(threads[t], nullptr);
+  rts_disconnect(h);
+  rts_unlink(g_name);
+  printf("OK %d threads x %d ops\n", kThreads, kOps);
+  return 0;
+}
