@@ -131,6 +131,40 @@ class ControlClient {
   std::vector<std::pair<std::string, std::string>> pushes_;
 };
 
+// Task/actor submission from C++ — the cross-language worker surface
+// (reference capability: cpp/ worker submitting tasks by
+// FunctionDescriptor + msgpack args, python/ray/cross_language.py).
+// Speaks the node daemon's dispatch protocol with JSON frames: a task
+// is a qualified Python name + JSON-encoded args; results come back as
+// JSON. Actors created here live on the daemon and die with this
+// client's connection (or on the daemon's actor_kill).
+class TaskClient {
+ public:
+  TaskClient(const std::string& host, int port);
+  ~TaskClient();
+  TaskClient(const TaskClient&) = delete;
+  TaskClient& operator=(const TaskClient&) = delete;
+
+  // "math.hypot" with args_json "[3, 4]" → "5.0" (JSON result).
+  // args_json may be a JSON array (positional) or object (kwargs).
+  std::string SubmitPyTask(const std::string& qualname,
+                           const std::string& args_json);
+
+  // Create a Python actor by class qualname; returns its actor id.
+  std::string CreatePyActor(const std::string& qualname,
+                            const std::string& args_json);
+  // Call a method on it; returns the JSON result. Calls on one
+  // TaskClient are serial → per-actor ordering holds.
+  std::string CallPyActor(const std::string& actor_id,
+                          const std::string& method,
+                          const std::string& args_json);
+
+ private:
+  std::string Roundtrip(const std::string& json_msg);
+
+  int fd_;
+};
+
 }  // namespace ray_tpu
 
 #endif  // RAY_TPU_CLIENT_H_

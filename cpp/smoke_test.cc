@@ -27,6 +27,21 @@ int main(int argc, char** argv) {
   std::string host = argv[3];
   int port = std::atoi(argv[4]);
 
+  if (mode == "tasks") {
+    // Task/actor submission from C++ (host/port = a NODE DAEMON's
+    // dispatch endpoint; the arena argument is unused: "-").
+    ray_tpu::TaskClient tasks(host, port);
+    std::string r = tasks.SubmitPyTask("math.hypot", "[3, 4]");
+    std::printf("OK task=%s\n", r.c_str());
+    std::string aid = tasks.CreatePyActor("builtins.list",
+                                          "[[\"a\"]]");
+    std::printf("OK actor=%zu\n", aid.size());
+    tasks.CallPyActor(aid, "append", "[\"b\"]");
+    std::string copy = tasks.CallPyActor(aid, "copy", "[]");
+    std::printf("OK actor_state=%s\n", copy.c_str());
+    return 0;
+  }
+
   ObjectStoreClient store(arena);
   ControlClient ctl(host, port);
   ctl.Ping();

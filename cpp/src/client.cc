@@ -478,4 +478,182 @@ std::map<std::string, uint64_t> ControlClient::Stats() {
   return out;
 }
 
+// ---------------------------------------------------------------------------
+// TaskClient — dispatch-protocol JSON frames ([u64 big-endian len][json])
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Minimal JSON string escaping for the fields this client sends.
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char ch : s) {
+    switch (ch) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(ch) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", ch);
+          out += buf;
+        } else {
+          out += ch;
+        }
+    }
+  }
+  return out;
+}
+
+// Extract a top-level string/raw value from the daemon's flat reply
+// ({"type": "result", "result": ..., "error": ...}). The result is a
+// JSON value returned VERBATIM as text; "__none__" when absent.
+std::string JsonField(const std::string& doc, const std::string& key) {
+  std::string pat = "\"" + key + "\":";
+  size_t p = doc.find(pat);
+  if (p == std::string::npos) return "__none__";
+  p += pat.size();
+  while (p < doc.size() && (doc[p] == ' ')) p++;
+  if (p >= doc.size()) return "__none__";
+  if (doc[p] == '"') {
+    std::string out;
+    for (size_t i = p + 1; i < doc.size(); i++) {
+      if (doc[i] == '\\' && i + 1 < doc.size()) {
+        char n = doc[++i];
+        out += (n == 'n') ? '\n' : (n == 't') ? '\t' : n;
+      } else if (doc[i] == '"') {
+        return out;
+      } else {
+        out += doc[i];
+      }
+    }
+    return out;
+  }
+  // Raw value (number/bool/null/array/object): scan to the matching
+  // end at depth 0, skipping string contents (']' '}' ',' inside a
+  // quoted string are data, not structure).
+  int depth = 0;
+  bool in_string = false;
+  size_t i = p;
+  for (; i < doc.size(); i++) {
+    char ch = doc[i];
+    if (in_string) {
+      if (ch == '\\') i++;  // skip the escaped char
+      else if (ch == '"') in_string = false;
+      continue;
+    }
+    if (ch == '"') { in_string = true; continue; }
+    if (ch == '[' || ch == '{') depth++;
+    if (ch == ']' || ch == '}') {
+      if (depth == 0) break;
+      depth--;
+    }
+    if ((ch == ',') && depth == 0) break;
+  }
+  return doc.substr(p, i - p);
+}
+
+}  // namespace
+
+TaskClient::TaskClient(const std::string& host, int port) {
+  fd_ = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) throw Error("socket failed");
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 ||
+        res == nullptr) {
+      close(fd_);
+      throw Error("cannot resolve host " + host);
+    }
+    addr.sin_addr =
+        reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+  }
+  if (connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    close(fd_);
+    throw Error("cannot connect to node daemon");
+  }
+}
+
+TaskClient::~TaskClient() {
+  if (fd_ >= 0) close(fd_);
+}
+
+std::string TaskClient::Roundtrip(const std::string& json_msg) {
+  // [u64 BIG-ENDIAN length][payload] — the dispatch protocol's framing
+  // (node/daemon.py; struct "!Q").
+  uint64_t n = json_msg.size();
+  uint8_t header[8];
+  for (int i = 0; i < 8; i++)
+    header[i] = static_cast<uint8_t>((n >> (8 * (7 - i))) & 0xff);
+  std::string frame(reinterpret_cast<char*>(header), 8);
+  frame += json_msg;
+  size_t sent = 0;
+  while (sent < frame.size()) {
+    ssize_t w = send(fd_, frame.data() + sent, frame.size() - sent, 0);
+    if (w <= 0) throw Error("daemon send failed");
+    sent += static_cast<size_t>(w);
+  }
+  uint8_t rh[8];
+  size_t got = 0;
+  while (got < 8) {
+    ssize_t r = recv(fd_, rh + got, 8 - got, 0);
+    if (r <= 0) throw Error("daemon connection closed");
+    got += static_cast<size_t>(r);
+  }
+  uint64_t rlen = 0;
+  for (int i = 0; i < 8; i++) rlen = (rlen << 8) | rh[i];
+  if (rlen > (1ull << 30)) throw Error("oversized daemon reply");
+  std::string resp(rlen, '\0');
+  got = 0;
+  while (got < rlen) {
+    ssize_t r = recv(fd_, resp.data() + got, rlen - got, 0);
+    if (r <= 0) throw Error("daemon connection closed");
+    got += static_cast<size_t>(r);
+  }
+  std::string err = JsonField(resp, "error");
+  if (err != "__none__" && err != "null")
+    throw Error("remote task failed: " + err);
+  return JsonField(resp, "result");
+}
+
+std::string TaskClient::SubmitPyTask(const std::string& qualname,
+                                     const std::string& args_json) {
+  std::string msg = "{\"type\": \"task_xlang\", \"qualname\": \"" +
+                    JsonEscape(qualname) + "\", \"args_json\": \"" +
+                    JsonEscape(args_json) + "\"}";
+  return Roundtrip(msg);
+}
+
+std::string TaskClient::CreatePyActor(const std::string& qualname,
+                                      const std::string& args_json) {
+  std::string msg =
+      "{\"type\": \"actor_create_xlang\", \"qualname\": \"" +
+      JsonEscape(qualname) + "\", \"args_json\": \"" +
+      JsonEscape(args_json) + "\"}";
+  return Roundtrip(msg);
+}
+
+std::string TaskClient::CallPyActor(const std::string& actor_id,
+                                    const std::string& method,
+                                    const std::string& args_json) {
+  std::string msg = "{\"type\": \"actor_call_xlang\", \"actor_id\": \"" +
+                    JsonEscape(actor_id) + "\", \"method\": \"" +
+                    JsonEscape(method) + "\", \"args_json\": \"" +
+                    JsonEscape(args_json) + "\"}";
+  return Roundtrip(msg);
+}
+
 }  // namespace ray_tpu

@@ -1,0 +1,158 @@
+"""Autoscaler v2 — control-plane-owned autoscaling state.
+
+Capability-equivalent of the reference's autoscaler v2 (reference:
+python/ray/autoscaler/v2/ + src/ray/gcs/gcs_server/
+gcs_autoscaler_state_manager.h — the GCS owns the authoritative
+cluster-state view: resource demand, node states; the autoscaler reads
+it from there rather than living inside one driver's runtime):
+
+- every DRIVER publishes its pending demand to the control plane's KV
+  (`_as/demand/<driver>`, refreshed by the RemotePlane poll loop and
+  deleted on shutdown);
+- node daemons already report load via heartbeats (LIST_NODES);
+- `MonitorV2` merges the cluster-wide view and drives the SAME
+  bin-packing/reconciliation logic as v1 (StandardAutoscaler) through a
+  control-plane-backed adapter — so a cluster with many drivers (or no
+  driver at all) still scales on total demand.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.resources import ResourceSet
+
+DEMAND_PREFIX = "_as/demand/"
+DEMAND_STALE_S = 15.0
+
+
+def serialize_demand(detailed: List[Tuple]) -> str:
+    """[(ResourceSet, hard, selector)] → JSON for the KV."""
+    return json.dumps({
+        "ts": time.time(),
+        "demand": [
+            {"resources": rs.to_dict(), "hard": bool(hard),
+             "selector": dict(selector or {})}
+            for rs, hard, selector in detailed
+        ],
+    })
+
+
+class _NodeView:
+    """Duck-typed NodeState for the autoscaler's read paths."""
+
+    def __init__(self, node_id: str, total: ResourceSet,
+                 available: ResourceSet, labels: Dict[str, str],
+                 alive: bool):
+        self.node_id = node_id
+        self.total = total
+        self.available = available
+        self.labels = labels
+        self.alive = alive
+
+
+class ControlPlaneView:
+    """The scheduler-shaped adapter over control-plane state: what the
+    v1 autoscaler reads (`pending_demand_detailed`, `pending_demand`,
+    `nodes`), sourced cluster-wide instead of from one driver."""
+
+    def __init__(self, control_client):
+        self.control = control_client
+
+    def pending_demand_detailed(self) -> List[Tuple]:
+        out: List[Tuple] = []
+        now = time.time()
+        try:
+            keys = self.control.kv_keys(DEMAND_PREFIX)
+        except Exception:  # noqa: BLE001 — control plane hiccup
+            return out
+        for key in keys:
+            try:
+                doc = json.loads(self.control.kv_get(key))
+            except Exception:  # noqa: BLE001 — racing delete/corrupt
+                continue
+            if now - float(doc.get("ts", 0)) > DEMAND_STALE_S:
+                continue  # dead driver's report
+            for d in doc.get("demand", []):
+                out.append((ResourceSet(d.get("resources", {})),
+                            bool(d.get("hard")),
+                            dict(d.get("selector") or {})))
+        return out
+
+    def pending_demand(self) -> List[ResourceSet]:
+        return [rs for rs, _h, _s in self.pending_demand_detailed()]
+
+    def nodes(self) -> List[_NodeView]:
+        out = []
+        try:
+            rows = self.control.list_nodes()
+        except Exception:  # noqa: BLE001
+            return out
+        for n in rows:
+            try:
+                meta = json.loads(n["meta"]) if n["meta"] else {}
+            except ValueError:
+                meta = {}
+            if meta.get("node_kind") != "daemon":
+                continue
+            total = ResourceSet(meta.get("resources", {}))
+            available = total
+            if n.get("load"):
+                try:
+                    load = json.loads(n["load"])
+                    available = ResourceSet(load.get("available", {}))
+                except ValueError:
+                    pass
+            out.append(_NodeView(
+                n["node_id"], total, available,
+                dict(meta.get("labels") or {}), bool(n["alive"])))
+        return [v for v in out if v.alive]
+
+
+class _ViewRuntime:
+    """What StandardAutoscaler expects of `runtime`."""
+
+    def __init__(self, view: ControlPlaneView):
+        self.scheduler = view
+
+
+class MonitorV2:
+    """The reconciliation loop over control-plane-owned state
+    (reference: autoscaler/v2 instance_manager + the Monitor role).
+    Reuses v1's bin-packing/min-max/idle logic unchanged — only the
+    STATE SOURCE moves to the control plane."""
+
+    def __init__(self, control_client, config, provider,
+                 on_node_launched=None):
+        from .autoscaler import StandardAutoscaler
+
+        self.view = ControlPlaneView(control_client)
+        self.autoscaler = StandardAutoscaler(
+            config, provider, runtime=_ViewRuntime(self.view),
+            on_node_launched=on_node_launched)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def update(self) -> Dict[str, int]:
+        return self.autoscaler.update()
+
+    def start(self, interval_s: float = 5.0) -> "MonitorV2":
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.update()
+                except Exception:  # noqa: BLE001 — keep reconciling
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="autoscaler-v2")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -217,6 +217,13 @@ class RealCluster:
         ray_tpu.init(address=self.address, **init_kwargs)
         return ray_tpu
 
+    def control_client(self):
+        """A fresh client to this cluster's control plane (caller
+        closes it)."""
+        from ._native import control_client as cc
+
+        return cc.ControlClient(self.port)
+
     def kill_node(self, node_id: str) -> None:
         """SIGKILL a daemon (fault injection — reference NodeKiller)."""
         proc = self._daemons.pop(node_id, None)

@@ -198,6 +198,22 @@ class RemotePlane:
     def _poll_loop(self) -> None:
         while not self._stop.wait(config.cluster_poll_interval_s):
             self.sync_nodes()
+            self._publish_demand()
+
+    def _publish_demand(self) -> None:
+        """Publish this driver's pending demand to the control plane
+        (autoscaler v2: the control plane owns the cluster-wide demand
+        view — reference gcs_autoscaler_state_manager.h; MonitorV2
+        merges every driver's report)."""
+        try:
+            from ..autoscaler.v2 import DEMAND_PREFIX, serialize_demand
+
+            detailed = self.rt.scheduler.pending_demand_detailed()
+            self.control.kv_put(
+                DEMAND_PREFIX + self.rt.job_id.hex(),
+                serialize_demand(detailed))
+        except Exception:  # noqa: BLE001 — best-effort report
+            pass
 
     # -- arg packing ------------------------------------------------------
     def pack_arg(self, v, fetch: List[Tuple[bytes, str, int]],
@@ -435,6 +451,10 @@ class RemotePlane:
 
     def shutdown(self) -> None:
         self._stop.set()
+        with contextlib.suppress(Exception):
+            from ..autoscaler.v2 import DEMAND_PREFIX
+
+            self.control.kv_del(DEMAND_PREFIX + self.rt.job_id.hex())
         with contextlib.suppress(Exception):
             self.control.close()
         if self._pulls is not None:

@@ -255,10 +255,40 @@ class NodeDaemon:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="node-conn").start()
 
+    def _recv_any(self, conn):
+        """Frame decode with cross-language support: JSON frames (first
+        byte '{') from non-Python clients, cloudpickle otherwise
+        (reference: cross-language calls via msgpack-framed
+        FunctionDescriptors, python/ray/cross_language.py — here the
+        wire vocabulary is JSON, the native-friendly equivalent)."""
+        import json as _json
+        import struct as _struct
+
+        from ray_tpu.core.worker_proc import _recv_exact
+
+        header = _recv_exact(conn, 8)
+        (n,) = _struct.Struct("!Q").unpack(header)
+        payload = _recv_exact(conn, n)
+        if payload[:1] == b"{":
+            msg = _json.loads(payload.decode())
+            msg["_json"] = True
+            return msg
+        import pickle
+
+        return pickle.loads(payload)
+
+    @staticmethod
+    def _send_json(conn, obj) -> None:
+        import json as _json
+        import struct as _struct
+
+        payload = _json.dumps(obj).encode()
+        conn.sendall(_struct.Struct("!Q").pack(len(payload)) + payload)
+
     def _serve_conn(self, conn: socket.socket):
         """One request in flight per connection; actor connections are
         long-lived and serial, which preserves per-actor call order."""
-        recv_msg, send_msg = self._recv_msg, self._send_msg
+        recv_msg, send_msg = self._recv_any, self._send_msg
         conn_actors: list = []  # actors created over this connection
         try:
             while not self._stop.is_set():
@@ -271,9 +301,12 @@ class NodeDaemon:
                     self.stop()
                     return
                 if mtype == "ping":
-                    send_msg(conn, {"type": "pong",
-                                    "node_id": self.node_id,
-                                    "load": self._load_report()})
+                    reply = {"type": "pong", "node_id": self.node_id,
+                             "load": self._load_report()}
+                    if msg.get("_json"):
+                        self._send_json(conn, reply)
+                    else:
+                        send_msg(conn, reply)
                     continue
                 if mtype == "actor_kill":
                     self._kill_actor(msg.get("actor_id"))
@@ -283,11 +316,20 @@ class NodeDaemon:
                 if mtype == "gen_ack":
                     # Late consumption credit from a finished stream.
                     continue
+                if mtype in ("task_xlang", "actor_create_xlang",
+                             "actor_call_xlang"):
+                    self._handle_xlang(conn, msg, conn_actors)
+                    continue
                 if mtype in ("task", "actor_create", "actor_call"):
                     self._handle_exec(conn, msg, conn_actors)
                     continue
-                send_msg(conn, {"type": "result",
-                                "crashed": f"unknown message {mtype!r}"})
+                reply = {"type": "result",
+                         "error": f"unknown message {mtype!r}",
+                         "crashed": f"unknown message {mtype!r}"}
+                if msg.get("_json"):
+                    self._send_json(conn, reply)
+                else:
+                    send_msg(conn, reply)
         finally:
             with contextlib.suppress(OSError):
                 conn.close()
@@ -372,6 +414,169 @@ class NodeDaemon:
 
             out.append((seq, retriable, kill, label))
         return out
+
+    # -- cross-language execution (C++ clients) --------------------------
+    def _handle_xlang(self, conn, msg, conn_actors) -> None:
+        """Tasks/actors submitted by NON-Python clients: a qualified
+        Python name + JSON args over JSON frames (the C++ worker API's
+        task-submission surface — reference capability: cpp/ worker
+        submitting cross-language tasks by FunctionDescriptor). Results
+        are JSON; errors come back as {"error": ...}."""
+        import cloudpickle
+
+        mtype = msg["type"]
+        try:
+            if mtype == "task_xlang":
+                result = self._xlang_task(msg)
+            elif mtype == "actor_create_xlang":
+                result = self._xlang_actor_create(msg, conn_actors)
+            else:
+                result = self._xlang_actor_call(msg)
+            # "error" FIRST: the C++ client's flat JSON scan relies on
+            # the top-level key appearing before any same-named key
+            # nested inside the result value.
+            self._send_json(conn, {"type": "result", "error": None,
+                                   "result": result})
+        except Exception as e:  # noqa: BLE001 — report, don't kill conn
+            self._send_json(conn, {"type": "result",
+                                   "error": f"{type(e).__name__}: {e}"})
+
+    def _xlang_fid_and_msg(self, qualname: str, json_args: str):
+        import cloudpickle
+
+        def shim(qn, ja):
+            import importlib
+            import json as _j
+
+            mod, _, fn = qn.rpartition(".")
+            f = getattr(importlib.import_module(mod), fn)
+            a = _j.loads(ja) if ja else []
+            out = f(**a) if isinstance(a, dict) else f(*a)
+            return _j.dumps(out)
+
+        fid = b"_xlang_task_shim_" + b"0" * 11  # stable per daemon
+        with self._fn_lock:
+            if fid not in self._fn_cache:
+                self._fn_cache[fid] = cloudpickle.dumps(shim)
+        rid = os.urandom(28)
+        return {
+            "type": "task", "task_id": rid, "fid": fid,
+            "args": (qualname, json_args), "kwargs": {},
+            "num_returns": 1, "return_ids": [rid], "streaming": False,
+        }, rid
+
+    def _unpack_worker_json(self, packed) -> Any:
+        """Worker return of the shim's json.dumps string → value."""
+        import json as _json
+
+        from ray_tpu.core import serialization
+
+        kind, payload = packed
+        if kind == "shm":
+            view = self.shm.get(payload, pin=True)
+            try:
+                data = serialization.SerializedObject.from_bytes(view)
+                text = serialization.deserialize(data)
+            finally:
+                self.shm.release(payload)
+            self.shm.delete(payload)
+        else:
+            text = serialization.deserialize(
+                serialization.SerializedObject.from_bytes(payload))
+        return _json.loads(text)
+
+    def _xlang_task(self, msg) -> Any:
+        wmsg, _rid = self._xlang_fid_and_msg(
+            msg["qualname"], msg.get("args_json", ""))
+        worker = self.pool.acquire(timeout=300)
+        try:
+            if not self._inject_fn(None, wmsg, worker):
+                raise RuntimeError("xlang shim missing")
+            reply = worker.run_task(wmsg)
+            worker.exported_fns.add(wmsg["fid"])
+            if reply.get("error") is not None:
+                from ray_tpu.core import serialization
+
+                raise serialization.deserialize(
+                    serialization.SerializedObject.from_bytes(
+                        reply["error"][1]))
+            return self._unpack_worker_json(reply["returns"][0])
+        finally:
+            self.pool.release(worker)
+
+    class _XlangActorShim:
+        def __init__(self, qualname, json_args):
+            import importlib
+            import json as _j
+
+            mod, _, cls = qualname.rpartition(".")
+            c = getattr(importlib.import_module(mod), cls)
+            a = _j.loads(json_args) if json_args else []
+            self.inst = c(**a) if isinstance(a, dict) else c(*a)
+
+        def call(self, method, json_args):
+            import json as _j
+
+            a = _j.loads(json_args) if json_args else []
+            m = getattr(self.inst, method)
+            out = m(**a) if isinstance(a, dict) else m(*a)
+            return _j.dumps(out)
+
+    def _xlang_actor_create(self, msg, conn_actors) -> str:
+        import cloudpickle
+
+        aid = os.urandom(16)
+        worker = self.pool.spawn_dedicated()
+        worker._xlang_call_lock = threading.Lock()
+        reply = worker.run_task({
+            "type": "actor_create", "task_id": None,
+            "actor_id": aid,
+            "cls": cloudpickle.dumps(NodeDaemon._XlangActorShim),
+            "args": (msg["qualname"], msg.get("args_json", "")),
+            "kwargs": {},
+        })
+        if reply.get("error") is not None:
+            self.pool.retire(worker)
+            from ray_tpu.core import serialization
+
+            raise serialization.deserialize(
+                serialization.SerializedObject.from_bytes(
+                    reply["error"][1]))
+        from ray_tpu.core.resources import ResourceSet
+
+        with self._actors_lock:
+            self._actors[aid] = (worker, ResourceSet({}))
+        conn_actors.append(aid)
+        return aid.hex()
+
+    def _xlang_actor_call(self, msg) -> Any:
+        aid = bytes.fromhex(msg["actor_id"])
+        with self._actors_lock:
+            entry = self._actors.get(aid)
+        if entry is None:
+            raise KeyError("actor not hosted on this node")
+        worker, _res = entry
+        rid = os.urandom(28)
+        # Any connection may address this actor by id: serialize the
+        # socket round trip per worker or two daemon threads interleave
+        # reads of one reply stream.
+        lock = getattr(worker, "_xlang_call_lock", None)
+        ctx = lock if lock is not None else contextlib.nullcontext()
+        with ctx:
+            reply = worker.run_task({
+                "type": "actor_call", "task_id": rid, "actor_id": aid,
+                "method": "call",
+                "args": (msg["method"], msg.get("args_json", "")),
+                "kwargs": {}, "num_returns": 1, "return_ids": [rid],
+                "streaming": False,
+            })
+        if reply.get("error") is not None:
+            from ray_tpu.core import serialization
+
+            raise serialization.deserialize(
+                serialization.SerializedObject.from_bytes(
+                    reply["error"][1]))
+        return self._unpack_worker_json(reply["returns"][0])
 
     def _inject_fn(self, conn, msg, worker) -> bool:
         """Ensure the worker has the function body; True = ok."""

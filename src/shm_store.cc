@@ -25,6 +25,7 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 
 #include <algorithm>
 #include <vector>
@@ -56,6 +57,7 @@ struct Slot {
   int64_t pins;        // pinned readers (not evictable while > 0)
   uint64_t seal_seq;   // LRU clock (monotonic seal/touch counter)
   uint64_t version;    // mutable-object version (seqlock: odd = writing)
+  int32_t owner_pid;   // creator, while SLOT_CREATED (crash repair)
 };
 
 struct FreeNode {           // free-list node stored at block start
@@ -315,12 +317,19 @@ static void RepairAfterOwnerDeath(Header* h) {
   for (uint32_t i = 0; i < kMaxObjects; i++) {
     Slot* s = &h->slots[i];
     if (s->state == SLOT_CREATED) {
-      // The dead writer owned this slot; the payload was mid-write.
-      s->state = SLOT_TOMBSTONE;
-      if (h->num_objects > 0) h->num_objects--;
-      continue;  // its span returns to the free pool below
+      // In-flight slot: reap it ONLY if its creator is gone — writers
+      // fill their span without the lock, so a LIVE process may be
+      // mid-write here (kill(pid, 0) == ESRCH means no such process).
+      bool owner_dead = s->owner_pid <= 0 ||
+                        (kill(s->owner_pid, 0) != 0 && errno == ESRCH);
+      if (owner_dead) {
+        s->state = SLOT_TOMBSTONE;
+        if (h->num_objects > 0) h->num_objects--;
+        continue;  // its span returns to the free pool below
+      }
     }
-    if (s->state == SLOT_SEALED || s->state == SLOT_MUTABLE)
+    if (s->state == SLOT_CREATED || s->state == SLOT_SEALED ||
+        s->state == SLOT_MUTABLE)
       spans.push_back({s->offset, Align(s->alloc_size)});
   }
   std::sort(spans.begin(), spans.end(),
@@ -377,6 +386,7 @@ int rts_create(void* handle, const uint8_t* id, uint64_t size,
   s->alloc_size = got;
   s->pins = 0;
   s->version = 0;
+  s->owner_pid = static_cast<int32_t>(getpid());
   h->num_objects++;
   *offset_out = off;
   pthread_mutex_unlock(&h->mu);
@@ -445,6 +455,15 @@ int rts_delete(void* handle, const uint8_t* id) {
   Slot* s = FindSlot(h, id, false);
   if (!s) { pthread_mutex_unlock(&h->mu); return -1; }
   if (s->pins > 0) { pthread_mutex_unlock(&h->mu); return -2; }
+  if (s->state == SLOT_CREATED) {
+    // The creator (possibly another THREAD of this process) is
+    // mid-write into this span — create→seal runs unlocked; freeing
+    // it under the writer corrupts whoever reallocates the span.
+    // (Crash cleanup of dead creators happens in
+    // RepairAfterOwnerDeath, not here.)
+    pthread_mutex_unlock(&h->mu);
+    return -3;
+  }
   FreeLocked(st, s->offset, s->alloc_size);
   s->state = SLOT_TOMBSTONE;
   h->num_objects--;
@@ -534,6 +553,25 @@ int rts_ch_write_release(void* handle, const uint8_t* id) {
 }
 
 // Snapshot read: returns version (even) + offset/size, or -1 if missing,
+// -2 if a write is in progress (caller retries).
+int64_t rts_ch_read(void* handle, const uint8_t* id, uint64_t* offset_out,
+                    uint64_t* size_out) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  Slot* s = FindSlot(h, id, false);
+  if (!s || s->state != SLOT_MUTABLE) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint64_t v = __atomic_load_n(&s->version, __ATOMIC_ACQUIRE);
+  if (v % 2 == 1) { pthread_mutex_unlock(&h->mu); return -2; }
+  *offset_out = s->offset;
+  *size_out = s->size;
+  pthread_mutex_unlock(&h->mu);
+  return static_cast<int64_t>(v);
+}
+
 // Test-only fault injection (crash-window coverage — reference: the
 // plasma store's crash tests): allocate a span + an UNSEALED slot,
 // poison the free-list head, then die WHILE HOLDING the arena mutex.
@@ -560,25 +598,6 @@ int rts_debug_die_locked(void* handle, const uint8_t* id, uint64_t size) {
   }
   h->free_head = 12345;  // poison: repair must rebuild, not trust it
   _exit(42);             // mutex still held
-}
-
-// -2 if a write is in progress (caller retries).
-int64_t rts_ch_read(void* handle, const uint8_t* id, uint64_t* offset_out,
-                    uint64_t* size_out) {
-  Store* st = reinterpret_cast<Store*>(handle);
-  Header* h = st->hdr;
-  Lock(h);
-  Slot* s = FindSlot(h, id, false);
-  if (!s || s->state != SLOT_MUTABLE) {
-    pthread_mutex_unlock(&h->mu);
-    return -1;
-  }
-  uint64_t v = __atomic_load_n(&s->version, __ATOMIC_ACQUIRE);
-  if (v % 2 == 1) { pthread_mutex_unlock(&h->mu); return -2; }
-  *offset_out = s->offset;
-  *size_out = s->size;
-  pthread_mutex_unlock(&h->mu);
-  return static_cast<int64_t>(v);
 }
 
 }  // extern "C"

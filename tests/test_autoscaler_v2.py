@@ -1,0 +1,131 @@
+"""Autoscaler v2: control-plane-owned state
+(reference: autoscaler/v2 + gcs_autoscaler_state_manager.h — demand
+and node state live in the control plane; the monitor is driver-
+independent)."""
+
+import json
+import time
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig
+from ray_tpu.autoscaler.v2 import (
+    DEMAND_PREFIX,
+    ControlPlaneView,
+    MonitorV2,
+    serialize_demand,
+)
+from ray_tpu.core.resources import ResourceSet
+from tests.test_autoscaler import MockProvider
+
+
+@pytest.fixture
+def control():
+    from ray_tpu._native import control_client as cc
+
+    proc, port = cc.launch_control_plane(health_timeout_ms=60_000)
+    client = cc.ControlClient(port)
+    yield client
+    client.close()
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def _publish(client, driver: str, demand):
+    client.kv_put(DEMAND_PREFIX + driver, serialize_demand(demand))
+
+
+class TestControlPlaneView:
+    def test_merges_demand_across_drivers(self, control):
+        _publish(control, "d1",
+                 [(ResourceSet({"CPU": 2.0}), False, {})])
+        _publish(control, "d2",
+                 [(ResourceSet({"CPU": 1.0}), False, {}),
+                  (ResourceSet({"CPU": 1.0}), True, {"a": "b"})])
+        view = ControlPlaneView(control)
+        demand = view.pending_demand_detailed()
+        assert len(demand) == 3
+        assert sum(1 for _r, hard, _s in demand if hard) == 1
+
+    def test_stale_demand_dropped(self, control):
+        doc = json.loads(serialize_demand(
+            [(ResourceSet({"CPU": 4.0}), False, {})]))
+        doc["ts"] = time.time() - 120
+        control.kv_put(DEMAND_PREFIX + "dead", json.dumps(doc))
+        assert ControlPlaneView(control).pending_demand_detailed() == []
+
+    def test_nodes_from_daemon_registrations(self, control):
+        control.register_node("w1", meta=json.dumps({
+            "node_kind": "daemon", "resources": {"CPU": 4.0},
+            "labels": {"zone": "a"}}))
+        control.heartbeat("w1", load=json.dumps(
+            {"available": {"CPU": 1.0}, "queued": 2}))
+        control.register_node("not-a-daemon", meta="{}")
+        nodes = ControlPlaneView(control).nodes()
+        assert [n.node_id for n in nodes] == ["w1"]
+        assert nodes[0].total.to_dict() == {"CPU": 4.0}
+        assert nodes[0].available.to_dict() == {"CPU": 1.0}
+        assert nodes[0].labels == {"zone": "a"}
+
+
+class TestMonitorV2:
+    def test_scales_on_merged_cluster_demand(self, control):
+        # Two drivers' unmet demand exceeds one 4-CPU worker.
+        _publish(control, "d1",
+                 [(ResourceSet({"CPU": 4.0}), False, {})])
+        _publish(control, "d2",
+                 [(ResourceSet({"CPU": 4.0}), False, {})])
+        provider = MockProvider()
+        mon = MonitorV2(control, AutoscalerConfig(
+            max_workers=8, worker_resources={"CPU": 4.0},
+            launch_grace_s=0.0), provider)
+        # upscaling_speed throttles launches per tick; reconcile twice.
+        mon.update()
+        mon.update()
+        assert len(provider.non_terminated_nodes()) == 2
+
+        # Daemons join (register under provider ids) with free CPU and
+        # the demand drains → no further scale-up.
+        for nid in provider.non_terminated_nodes():
+            control.register_node(nid, meta=json.dumps({
+                "node_kind": "daemon", "resources": {"CPU": 4.0}}))
+            control.heartbeat(nid, load=json.dumps(
+                {"available": {"CPU": 4.0}, "queued": 0}))
+        control.kv_del(DEMAND_PREFIX + "d1")
+        control.kv_del(DEMAND_PREFIX + "d2")
+        mon.update()
+        assert len(provider.non_terminated_nodes()) == 2
+
+    def test_driver_publishes_demand_to_control_plane(self):
+        """End-to-end: a cluster driver's RemotePlane writes its demand
+        into the control plane where a v2 monitor can read it."""
+        import ray_tpu
+        from ray_tpu.cluster_utils import RealCluster
+
+        ray_tpu.shutdown()
+        cluster = RealCluster()
+        try:
+            cluster.add_node(num_cpus=1)
+            ray = cluster.connect(
+                _system_config={"cluster_poll_interval_s": 0.1})
+
+            @ray.remote(num_cpus=8)  # infeasible on a 1-CPU daemon
+            def big():
+                return 1
+
+            ref = big.remote()
+            client = cluster.control_client()
+            try:
+                view = ControlPlaneView(client)
+                deadline = time.monotonic() + 15
+                demand = []
+                while time.monotonic() < deadline and not demand:
+                    demand = view.pending_demand_detailed()
+                    time.sleep(0.2)
+                assert any(rs.to_dict().get("CPU") == 8.0
+                           for rs, _h, _s in demand)
+            finally:
+                client.close()
+            del ref
+        finally:
+            cluster.shutdown()

@@ -8,6 +8,7 @@ bindings. The smoke binary is built by src/Makefile into
 ray_tpu/_native/cpp_smoke_test.
 """
 
+import json
 import os
 import subprocess
 
@@ -104,3 +105,33 @@ def test_cpp_pubsub_reaches_python(native_planes):
     client.kv_put("py/greeting", b"hi")
     _run("consume", arena, port)
     assert got.get(timeout=5) == b"done"
+
+
+def test_cpp_task_and_actor_submission():
+    """C++ task/actor submission (the cross-language worker surface —
+    reference capability: cpp/ worker submitting tasks; here JSON
+    frames against a node daemon's dispatch port)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import RealCluster
+
+    ray_tpu.shutdown()
+    cluster = RealCluster()
+    try:
+        cluster.add_node(num_cpus=1)
+        client = cluster.control_client()
+        try:
+            nodes = client.list_nodes()
+            meta = json.loads(nodes[0]["meta"])
+        finally:
+            client.close()
+        out = subprocess.run(
+            [SMOKE, "tasks", "-", meta["host"],
+             str(meta["dispatch_port"])],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        lines = out.stdout.strip().splitlines()
+        assert "OK task=5.0" in lines[0]
+        assert lines[1].startswith("OK actor=32")
+        assert lines[2] == 'OK actor_state=["a", "b"]'
+    finally:
+        cluster.shutdown()
