@@ -139,7 +139,11 @@ class RealCluster:
     multiple real raylets as separate processes on one machine; this is
     the same fixture for the multi-host plane)."""
 
-    def __init__(self, *, health_timeout_ms: int = 1500):
+    def __init__(self, *, health_timeout_ms: int = 4000):
+        # 4s expiry: on a loaded 1-core box the GIL can starve a
+        # daemon's 200ms heartbeat thread past a short window, and a
+        # spurious DEAD mid-test breaks kill/recovery assertions.
+        # Real-death detection stays well under the tests' 30s waits.
         import subprocess  # noqa: F401 — re-exported for tests
 
         from ._native import control_client as cc

@@ -113,6 +113,9 @@ class RemotePlane:
         # could each build a RemoteNodeState for the same node (one
         # leaking its executor + connections).
         self._sync_lock = threading.Lock()
+        # Guards cross-driver actor attachment (a duplicate proxy
+        # would leak its threads + daemon connection).
+        self._attach_lock = threading.Lock()
 
         # runtime_env packaging: local dirs → content-addressed pkg://
         # URIs uploaded once to the control plane's KV; daemons
@@ -430,6 +433,48 @@ class RemotePlane:
             if not rt.shm.contains(marker.key):
                 raise KeyError(marker.key) from None
 
+    # -- cross-driver actors ----------------------------------------------
+    def attach_named_actor(self, scoped: str):
+        """Look a named actor up in the control plane's actor table and
+        attach a local PROXY through which this driver's calls reach
+        the daemon hosting it (reference: cross-job named-actor lookup
+        via GcsActorManager). Returns the ActorID or None."""
+        import json as _json
+
+        from .ids import ActorID
+
+        try:
+            hexid = self.control.get_named_actor(scoped)
+            info = self.control.get_actor(hexid)
+        except Exception:  # noqa: BLE001 — unknown name
+            return None
+        if info.get("state") == "DEAD":
+            return None
+        try:
+            meta = _json.loads(info.get("meta") or "{}")
+        except ValueError:
+            meta = {}
+        node = self.rt.scheduler.get_node(meta.get("node_id", ""))
+        if node is None or not getattr(node, "is_remote", False):
+            return None
+        aid = ActorID(bytes.fromhex(hexid))
+        proxy_cls = remote_actor_proxy_cls()
+        with self._attach_lock:
+            with self.rt._actors_lock:
+                if aid in self.rt._actors:
+                    return aid
+            st = proxy_cls(
+                self.rt, aid, _ProxyStub, (), {},
+                node=node, name=scoped,
+                max_concurrency=1, max_restarts=0,
+                resources=_EMPTY_RESOURCES)
+            st.method_defaults = dict(meta.get("method_defaults") or {})
+            with self.rt._actors_lock:
+                self.rt._actors[aid] = st
+                self.rt._named_actors.setdefault(scoped, aid)
+                self.rt._scoped_by_actor.setdefault(aid, scoped)
+        return aid
+
     # -- actor placement --------------------------------------------------
     def replace_node_for(self, st) -> Optional[RemoteNodeState]:
         """Find a new home for an actor whose node died; charges the
@@ -535,6 +580,7 @@ def remote_actor_state_cls():
                             for k, v in self.init_kwargs.items()},
                         "fetch": fetch,
                         "resources": self.resources.to_dict(),
+                        "detached": self.detached,
                     }
                     if self.runtime_env:
                         msg["runtime_env"] = plane.prepare_runtime_env(
@@ -565,6 +611,31 @@ def remote_actor_state_cls():
                     self._conn = conn
                     self.instance = conn  # marker: lives remotely
                     self.ready.set()
+                    # Restart/migration: refresh the actor-table
+                    # location so cross-driver lookups find the NEW
+                    # node (the registration at creation recorded the
+                    # original one).
+                    if self.generation > 0 and (
+                            self.detached
+                            or self.rt._scoped_by_actor.get(
+                                self.actor_id)):
+                        import json as _json
+
+                        scoped = self.rt._scoped_by_actor.get(
+                            self.actor_id) or ""
+                        name = scoped
+                        with contextlib.suppress(Exception):
+                            plane.control.register_actor(
+                                self.actor_id.hex(), name=name,
+                                meta=_json.dumps({
+                                    "node_id": self.node.node_id,
+                                    "class": self.cls.__name__,
+                                    "detached": self.detached,
+                                    "method_defaults":
+                                        self.method_defaults,
+                                }))
+                            plane.control.update_actor(
+                                self.actor_id.hex(), "ALIVE")
                     return True
                 except BaseException as e:  # noqa: BLE001
                     conn.close()
@@ -662,6 +733,26 @@ def remote_actor_state_cls():
                         rt._store_packed(oid, packed,
                                          node_id=self.node.node_id)
             except (WorkerCrashedError, NodeDispatchError) as e:
+                # A KILLED detached/named actor must not be resurrected
+                # by its owner's restart machinery: another driver's
+                # ray.kill records DEAD in the actor table — honor it
+                # (reference: GcsActorManager kill marks the actor
+                # non-restartable cluster-wide).
+                if getattr(self, "_cp_registered", False) or \
+                        self.detached:
+                    try:
+                        info = plane.control.get_actor(
+                            self.actor_id.hex())
+                        if info.get("state") == "DEAD":
+                            self.death_cause = ActorDiedError(
+                                self.actor_id.hex(),
+                                "killed via ray.kill() (cross-driver)")
+                            self._restartable_kill = False
+                            rt._store_error(spec, self.death_cause, t0)
+                            self.dead.set()
+                            return
+                    except Exception:  # noqa: BLE001
+                        pass
                 left = spec.task_retries_left
                 if left is None:
                     left = self.max_task_retries
@@ -702,3 +793,74 @@ def remote_actor_state_cls():
 
     _remote_actor_cls = RemoteProcActorState
     return _remote_actor_cls
+
+
+class _ProxyStub:
+    """Placeholder class for attached (non-owned) remote actors."""
+
+
+_EMPTY_RESOURCES = ResourceSet({})
+_remote_proxy_cls = None
+
+
+def remote_actor_proxy_cls():
+    """Proxy for an actor OWNED BY ANOTHER DRIVER (attached via the
+    control plane's actor table): calls flow over a dedicated daemon
+    connection like an owned remote actor, but this driver neither
+    constructs, restarts, nor (implicitly) kills it."""
+    global _remote_proxy_cls
+    if _remote_proxy_cls is not None:
+        return _remote_proxy_cls
+
+    from .exceptions import ActorDiedError as _ADE
+    from .runtime import ActorState
+
+    base = remote_actor_state_cls()
+
+    class RemoteActorProxy(base):  # type: ignore[misc,valid-type]
+        def __init__(self, *args, **kwargs):
+            self._explicit_kill = False
+            super().__init__(*args, **kwargs)
+            # The underlying actor belongs to another driver: OUR
+            # shutdown must not reap it (only explicit ray.kill).
+            self.detached = True
+
+        def _construct(self, gen: int) -> bool:
+            # Attach, don't create: the actor already lives on the
+            # daemon; just open this driver's call connection.
+            try:
+                self._conn = self.node.client.open_conn()
+                self.instance = self._conn
+                self.ready.set()
+                return True
+            except Exception as e:  # noqa: BLE001
+                self.death_cause = _ADE(self.actor_id.hex(),
+                                        f"cannot reach host: {e}")
+                self._restartable_kill = False
+                self._die(gen)
+                return False
+
+        def kill(self, *, no_restart: bool = True):
+            # Explicit cross-driver kill IS allowed (reference:
+            # ray.kill on a detached actor from any job).
+            self._explicit_kill = True
+            super().kill(no_restart=no_restart)
+
+        def _die(self, gen: int):
+            ActorState._die(self, gen)
+            if self.dead.is_set():
+                conn, self._conn = self._conn, None
+                if conn is not None:
+                    conn.close()
+                if self._explicit_kill and self.node.alive:
+                    with contextlib.suppress(Exception):
+                        self.node.client.call({
+                            "type": "actor_kill",
+                            "actor_id": self.actor_id.binary()})
+                    # Record the death for other drivers' lookups.
+                    with contextlib.suppress(Exception):
+                        self.rt.remote_plane.control.update_actor(
+                            self.actor_id.hex(), "DEAD")
+
+    _remote_proxy_cls = RemoteActorProxy
+    return _remote_proxy_cls

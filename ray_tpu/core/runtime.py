@@ -253,9 +253,14 @@ class ActorState:
                  resources: ResourceSet,
                  runtime_env: Optional[Dict[str, Any]] = None,
                  max_task_retries: int = 0,
-                 concurrency_groups: Optional[Dict[str, int]] = None):
+                 concurrency_groups: Optional[Dict[str, int]] = None,
+                 detached: bool = False):
         self.rt = rt
         self.actor_id = actor_id
+        # lifetime="detached": survives this driver (reference:
+        # gcs_actor_manager.h detached actors); on the daemon plane the
+        # hosting worker outlives the creator's connection.
+        self.detached = detached
         self.cls = cls
         self.init_args = args
         self.init_kwargs = kwargs
@@ -288,6 +293,15 @@ class ActorState:
             for g in self.concurrency_groups}
         self.dead = threading.Event()
         self.ready = threading.Event()
+        # @method(...) per-method defaults, resolvable even when the
+        # class body is not importable locally (cross-driver proxies
+        # receive these from the control plane's actor table).
+        self.method_defaults: Dict[str, Dict[str, Any]] = {
+            m: dict(getattr(getattr(cls, m), "_ray_method_opts"))
+            for m in dir(cls)
+            if not m.startswith("__")
+            and hasattr(getattr(cls, m, None), "_ray_method_opts")
+        }
         self.death_cause: Optional[BaseException] = None
         self.instance = None
         self._death_lock = threading.Lock()
@@ -1214,9 +1228,36 @@ class Runtime:
                     concurrency_groups=opts.get("concurrency_groups"),
                     resources=resources,
                     runtime_env=opts.get("runtime_env"),
+                    detached=opts.get("lifetime") == "detached",
                 )
                 with self._actors_lock:
                     self._actors[actor_id] = st
+                # Named/detached actors on the daemon plane are
+                # registered in the control plane's actor table so ANY
+                # driver can find them (reference: GcsActorManager +
+                # named-actor lookup across jobs).
+                if (self.remote_plane is not None and node.is_remote
+                        and (name or st.detached)):
+                    import json as _json
+
+                    ns = opts.get("namespace") or self.namespace
+                    try:
+                        self.remote_plane.control.register_actor(
+                            actor_id.hex(),
+                            name=f"{ns}/{name}" if name else "",
+                            meta=_json.dumps({
+                                "node_id": node.node_id,
+                                "class": cls.__name__,
+                                "detached": st.detached,
+                                # so cross-driver proxies keep
+                                # @method(...) defaults
+                                "method_defaults": st.method_defaults,
+                            }))
+                        self.remote_plane.control.update_actor(
+                            actor_id.hex(), "ALIVE")
+                        st._cp_registered = True
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
                 box["ok"] = True
             except BaseException as e:  # noqa: BLE001
                 box["err"] = e
@@ -1253,9 +1294,10 @@ class Runtime:
             raise (cause if isinstance(cause, ActorDiedError)
                    else ActorDiedError(actor_id.hex()))
         task_id = TaskID.for_actor_task(actor_id)
-        # @method(...) defaults; call-site .options(...) wins.
-        _m = getattr(st.cls, method_name, None)
-        _mdefaults = getattr(_m, "_ray_method_opts", {})
+        # @method(...) defaults; call-site .options(...) wins. Resolved
+        # through st.method_defaults so cross-driver proxies (whose cls
+        # is a stub) keep the decorated behavior.
+        _mdefaults = st.method_defaults.get(method_name, {})
         num_returns = opts.get("num_returns",
                                _mdefaults.get("num_returns", 1))
         # Validate the concurrency group BEFORE any registration —
@@ -1302,13 +1344,21 @@ class Runtime:
     def get_actor(self, name: str,
                   namespace: "Optional[str]" = None) -> ActorID:
         ns = namespace or self.namespace
+        scoped = f"{ns}/{name}"
         with self._actors_lock:
-            aid = self._named_actors.get(f"{ns}/{name}")
-        if aid is None:
-            raise ValueError(
-                f"Failed to look up actor with name {name!r} in "
-                f"namespace {ns!r}")
-        return aid
+            aid = self._named_actors.get(scoped)
+        if aid is not None:
+            return aid
+        # Cluster mode: another driver may own the named actor — look
+        # it up in the control plane's actor table and attach a proxy
+        # (reference: cross-job named-actor lookup via the GCS).
+        if self.remote_plane is not None:
+            aid = self.remote_plane.attach_named_actor(scoped)
+            if aid is not None:
+                return aid
+        raise ValueError(
+            f"Failed to look up actor with name {name!r} in "
+            f"namespace {ns!r}")
 
     def actor_state(self, actor_id: ActorID) -> Optional[ActorState]:
         with self._actors_lock:
@@ -1326,6 +1376,13 @@ class Runtime:
             scoped = self._scoped_by_actor.pop(st.actor_id, None)
             if scoped and self._named_actors.get(scoped) == st.actor_id:
                 del self._named_actors[scoped]
+        if getattr(st, "_cp_registered", False) and \
+                self.remote_plane is not None:
+            try:
+                self.remote_plane.control.update_actor(
+                    st.actor_id.hex(), "DEAD")
+            except Exception:  # noqa: BLE001
+                pass
 
     # ------------------------------------------------------------------
     # Dispatch & execution (normal tasks)
@@ -1839,7 +1896,13 @@ class Runtime:
         with self._actors_lock:
             actors = list(self._actors.values())
         for st in actors:
-            st.kill()
+            # Detached actors survive their driver (reference
+            # lifetime="detached" semantics) — but only on the daemon
+            # plane; an in-process actor cannot outlive this process,
+            # so skipping its kill would only leak threads.
+            if not (getattr(st, "detached", False)
+                    and st.node.is_remote):
+                st.kill()
         for node in self.scheduler.nodes():
             node.shutdown()
 

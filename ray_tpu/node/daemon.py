@@ -705,15 +705,23 @@ class NodeDaemon:
     def _run_actor_create(self, conn, msg, res, conn_actors) -> None:
         send_msg = self._send_msg
         aid = msg["actor_id"]
+        # Detached actors (reference: lifetime="detached",
+        # gcs_actor_manager.h) outlive their creator's connection — any
+        # driver may address them later via the control plane's actor
+        # table; they die only on explicit actor_kill or daemon stop.
+        detached = bool(msg.pop("detached", False))
         worker = None
         try:
             worker = self.pool.spawn_dedicated()
+            # Cross-driver calls share this worker's socket: serialize.
+            worker._xlang_call_lock = threading.Lock()
             reply = worker.run_task(msg)
             if reply.get("error") is None:
                 with self._actors_lock:
                     self._actors[aid] = (worker, res)
                 self._charge(res)
-                conn_actors.append(aid)
+                if not detached:
+                    conn_actors.append(aid)
             else:
                 self.pool.retire(worker)
             send_msg(conn, reply)
@@ -735,13 +743,18 @@ class NodeDaemon:
                             "crashed": "actor not hosted on this node"})
             return
         worker, res = entry
+        # Cross-driver/detached actors can be addressed from several
+        # connections; one worker socket carries one request at a time.
+        lock = getattr(worker, "_xlang_call_lock", None)
+        ctx = lock if lock is not None else contextlib.nullcontext()
         try:
-            if msg.get("streaming"):
-                self._relay_streaming(conn, worker, msg)
-            else:
-                reply = worker.run_task(
-                    msg, on_stream=lambda item: send_msg(conn, item))
-                send_msg(conn, reply)
+            with ctx:
+                if msg.get("streaming"):
+                    self._relay_streaming(conn, worker, msg)
+                else:
+                    reply = worker.run_task(
+                        msg, on_stream=lambda item: send_msg(conn, item))
+                    send_msg(conn, reply)
         except self._WorkerCrashedError as e:
             self._kill_actor(aid)
             with contextlib.suppress(Exception):

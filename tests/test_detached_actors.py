@@ -1,0 +1,77 @@
+"""Detached + cross-driver named actors on the daemon plane
+(reference: lifetime="detached" + cross-job named-actor lookup via the
+GCS actor table, gcs_actor_manager.h)."""
+
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.cluster_utils import RealCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = RealCluster()
+    try:
+        c.add_node(num_cpus=2)
+        yield c
+    finally:
+        c.shutdown()
+
+
+def test_detached_actor_survives_driver_and_is_reattachable(cluster):
+    # Driver A creates a named detached actor, mutates it, exits.
+    ray.shutdown()
+    cluster.connect()
+
+    @ray.remote(lifetime="detached", name="registry")
+    class KV:
+        def __init__(self):
+            self.d = {}
+
+        def put(self, k, v):
+            self.d[k] = v
+            return len(self.d)
+
+        def get(self, k):
+            return self.d.get(k)
+
+    a = KV.remote()
+    assert ray.get(a.put.remote("alpha", 1)) == 1
+    ray.shutdown()  # driver A gone; the actor must survive
+
+    # Driver B attaches by name and sees A's state.
+    cluster.connect()
+    try:
+        h = ray.get_actor("registry")
+        assert ray.get(h.get.remote("alpha"), timeout=30) == 1
+        assert ray.get(h.put.remote("beta", 2), timeout=30) == 2
+
+        # Explicit cross-driver kill reaps it.
+        ray.kill(h)
+        deadline = time.monotonic() + 10
+        gone = False
+        while time.monotonic() < deadline:
+            ray.shutdown()
+            cluster.connect()
+            try:
+                h2 = ray.get_actor("registry")
+                ray.get(h2.get.remote("alpha"), timeout=5)
+            except Exception:
+                gone = True
+                break
+            time.sleep(0.5)
+        assert gone, "detached actor still reachable after kill"
+    finally:
+        ray.shutdown()
+
+
+def test_unknown_name_still_errors(cluster):
+    ray.shutdown()
+    cluster.connect()
+    try:
+        with pytest.raises(ValueError, match="look up actor"):
+            ray.get_actor("no-such-actor")
+    finally:
+        ray.shutdown()
