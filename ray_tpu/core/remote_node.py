@@ -461,17 +461,26 @@ class RemotePlane:
         proxy_cls = remote_actor_proxy_cls()
         with self._attach_lock:
             with self.rt._actors_lock:
-                if aid in self.rt._actors:
-                    return aid
+                existing = self.rt._actors.get(aid)
+                if existing is not None:
+                    if not existing.dead.is_set():
+                        return aid
+                    # A previously-attached proxy died (e.g. transient
+                    # network failure) while the REAL actor may live
+                    # on: drop it and re-attach fresh.
+                    self.rt._actors.pop(aid, None)
             st = proxy_cls(
                 self.rt, aid, _ProxyStub, (), {},
                 node=node, name=scoped,
                 max_concurrency=1, max_restarts=0,
-                resources=_EMPTY_RESOURCES)
+                resources=_EMPTY_RESOURCES,
+                concurrency_groups=dict(
+                    meta.get("concurrency_groups") or {}))
             st.method_defaults = dict(meta.get("method_defaults") or {})
             with self.rt._actors_lock:
                 self.rt._actors[aid] = st
-                self.rt._named_actors.setdefault(scoped, aid)
+                self.rt._named_actors.pop(scoped, None)
+                self.rt._named_actors[scoped] = aid
                 self.rt._scoped_by_actor.setdefault(aid, scoped)
         return aid
 
@@ -613,29 +622,18 @@ def remote_actor_state_cls():
                     self.ready.set()
                     # Restart/migration: refresh the actor-table
                     # location so cross-driver lookups find the NEW
-                    # node (the registration at creation recorded the
-                    # original one).
-                    if self.generation > 0 and (
+                    # node (idempotent — the table accepts a same-id
+                    # re-registration; creation-time registration
+                    # happens in create_actor via the same helper).
+                    if getattr(self, "_cp_registered", False) or (
                             self.detached
                             or self.rt._scoped_by_actor.get(
                                 self.actor_id)):
-                        import json as _json
-
                         scoped = self.rt._scoped_by_actor.get(
                             self.actor_id) or ""
-                        name = scoped
                         with contextlib.suppress(Exception):
-                            plane.control.register_actor(
-                                self.actor_id.hex(), name=name,
-                                meta=_json.dumps({
-                                    "node_id": self.node.node_id,
-                                    "class": self.cls.__name__,
-                                    "detached": self.detached,
-                                    "method_defaults":
-                                        self.method_defaults,
-                                }))
-                            plane.control.update_actor(
-                                self.actor_id.hex(), "ALIVE")
+                            self.rt.register_in_actor_table(
+                                self, scoped)
                     return True
                 except BaseException as e:  # noqa: BLE001
                     conn.close()

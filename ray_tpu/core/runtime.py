@@ -1235,27 +1235,25 @@ class Runtime:
                 # Named/detached actors on the daemon plane are
                 # registered in the control plane's actor table so ANY
                 # driver can find them (reference: GcsActorManager +
-                # named-actor lookup across jobs).
+                # named-actor lookup across jobs). A name held by
+                # ANOTHER driver's live actor is a duplicate — the
+                # same cross-job error the reference raises.
                 if (self.remote_plane is not None and node.is_remote
                         and (name or st.detached)):
-                    import json as _json
+                    from .._native.control_client import (
+                        AlreadyExistsError,
+                    )
 
                     ns = opts.get("namespace") or self.namespace
+                    scoped = f"{ns}/{name}" if name else ""
                     try:
-                        self.remote_plane.control.register_actor(
-                            actor_id.hex(),
-                            name=f"{ns}/{name}" if name else "",
-                            meta=_json.dumps({
-                                "node_id": node.node_id,
-                                "class": cls.__name__,
-                                "detached": st.detached,
-                                # so cross-driver proxies keep
-                                # @method(...) defaults
-                                "method_defaults": st.method_defaults,
-                            }))
-                        self.remote_plane.control.update_actor(
-                            actor_id.hex(), "ALIVE")
-                        st._cp_registered = True
+                        self.register_in_actor_table(st, scoped)
+                    except AlreadyExistsError:
+                        st.kill()
+                        raise ValueError(
+                            f"Actor name {name!r} already taken in "
+                            f"namespace {ns!r} (held by another "
+                            f"driver)") from None
                     except Exception:  # noqa: BLE001 — best-effort
                         pass
                 box["ok"] = True
@@ -1369,6 +1367,30 @@ class Runtime:
             st = self._actors.get(actor_id)
         if st is not None:
             st.kill(no_restart=no_restart)
+
+    def register_in_actor_table(self, st: "ActorState",
+                                scoped_name: str) -> None:
+        """(Re)register an actor's location + metadata in the control
+        plane's actor table — the ONE place the table schema lives
+        (creation and restart-refresh both come through here).
+        Raises AlreadyExistsError when the name belongs to a different
+        live actor."""
+        import json as _json
+
+        self.remote_plane.control.register_actor(
+            st.actor_id.hex(), name=scoped_name,
+            meta=_json.dumps({
+                "node_id": st.node.node_id,
+                "class": st.cls.__name__,
+                "detached": st.detached,
+                # so cross-driver proxies keep @method defaults and
+                # declared concurrency groups
+                "method_defaults": st.method_defaults,
+                "concurrency_groups": st.concurrency_groups,
+            }))
+        self.remote_plane.control.update_actor(st.actor_id.hex(),
+                                               "ALIVE")
+        st._cp_registered = True
 
     def _on_actor_dead(self, st: ActorState):
         self.scheduler.release(st.node.node_id, st.resources)

@@ -154,6 +154,53 @@ def cmd_stop(args) -> int:
 
 
 def cmd_status(args) -> int:
+    if getattr(args, "cluster", None):
+        # Straight against the control plane (no dashboard needed):
+        # node membership + heartbeat load reports + pending demand
+        # (reference: `ray status` reads the GCS).
+        from ray_tpu._native import control_client as cc
+        from ray_tpu.autoscaler.v2 import ControlPlaneView
+
+        host, _, port = args.cluster.partition(":")
+        if not host or not port.isdigit():
+            print("--cluster must be host:port "
+                  f"(got {args.cluster!r})", file=sys.stderr)
+            return 2
+        client = cc.ControlClient(int(port), host=host)
+        try:
+            view = ControlPlaneView(client)
+            nodes = []
+            for n in client.list_nodes():
+                try:
+                    meta = json.loads(n["meta"]) if n["meta"] else {}
+                except ValueError:
+                    meta = {}
+                if meta.get("node_kind") != "daemon":
+                    continue
+                load = {}
+                if n.get("load"):
+                    try:
+                        load = json.loads(n["load"])
+                    except ValueError:
+                        pass
+                nodes.append({
+                    "node_id": n["node_id"],
+                    "alive": n["alive"],
+                    "host": meta.get("host"),
+                    "resources": meta.get("resources", {}),
+                    "available": load.get("available", {}),
+                    "queued": load.get("queued", 0),
+                    "ms_since_heartbeat": n["ms_since_heartbeat"],
+                })
+            demand = [
+                {"resources": rs.to_dict(), "hard": hard,
+                 "selector": sel}
+                for rs, hard, sel in view.pending_demand_detailed()]
+            _print({"nodes": nodes, "pending_demand": demand,
+                    "actors": client.list_actors()})
+        finally:
+            client.close()
+        return 0
     if args.address:
         _print(_fetch(args.address, "/api/cluster_status"))
         return 0
@@ -427,7 +474,11 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("stop", help="stop daemons started on this host"
                    ).set_defaults(fn=cmd_stop)
 
-    sub.add_parser("status").set_defaults(fn=cmd_status)
+    stat = sub.add_parser("status")
+    stat.add_argument("--cluster", default=None,
+                      help="control plane host:port — read node/"
+                           "load/demand state directly (no dashboard)")
+    stat.set_defaults(fn=cmd_status)
 
     lp = sub.add_parser("list")
     lp.add_argument("kind", choices=[

@@ -653,9 +653,11 @@ void dispatch(Server& s, Conn& c, Reader& r) {
       std::string actor_id = r.str(), name = r.str(), meta = r.str();
       if (!name.empty()) {
         auto nit = s.named_actors.find(name);
-        if (nit != s.named_actors.end()) {
-          // Name taken by a live actor → reject (reference:
-          // GcsActorManager duplicate-name creation error).
+        if (nit != s.named_actors.end() && nit->second != actor_id) {
+          // Name taken by a DIFFERENT live actor → reject (reference:
+          // GcsActorManager duplicate-name creation error). The same
+          // actor may re-register to refresh its location metadata
+          // (restart-with-replacement).
           auto ait = s.actors.find(nit->second);
           if (ait != s.actors.end() && ait->second.state != "DEAD") {
             w.u8(ST_EXISTS);

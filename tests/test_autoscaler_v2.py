@@ -129,3 +129,28 @@ class TestMonitorV2:
             del ref
         finally:
             cluster.shutdown()
+
+
+def test_cli_cluster_status(control, capsys):
+    """`ray-tpu status --cluster host:port` reads membership/load/
+    demand straight from the control plane (reference: `ray status`
+    against the GCS)."""
+    import json as _json
+
+    from ray_tpu.core.resources import ResourceSet
+    from ray_tpu.scripts.cli import main as cli_main
+
+    control.register_node("w1", meta=_json.dumps({
+        "node_kind": "daemon", "resources": {"CPU": 4.0},
+        "host": "127.0.0.1", "dispatch_port": 1, "object_port": 2}))
+    control.heartbeat("w1", load=_json.dumps(
+        {"available": {"CPU": 3.0}, "queued": 1}))
+    _publish(control, "d9", [(ResourceSet({"CPU": 2.0}), False, {})])
+
+    port = control._sock.getpeername()[1]
+    rc = cli_main(["status", "--cluster", f"127.0.0.1:{port}"])
+    assert rc == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["nodes"][0]["node_id"] == "w1"
+    assert out["nodes"][0]["available"] == {"CPU": 3.0}
+    assert out["pending_demand"][0]["resources"] == {"CPU": 2.0}

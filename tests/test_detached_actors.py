@@ -75,3 +75,31 @@ def test_unknown_name_still_errors(cluster):
             ray.get_actor("no-such-actor")
     finally:
         ray.shutdown()
+
+
+def test_duplicate_name_across_drivers_rejected(cluster):
+    """A second driver creating a detached actor under a LIVE name
+    gets the duplicate-name error (reference: GcsActorManager
+    cross-job duplicate rejection)."""
+    ray.shutdown()
+    cluster.connect()
+
+    @ray.remote(lifetime="detached", name="unique-svc")
+    class A:
+        def ping(self):
+            return "a"
+
+    a = A.remote()
+    assert ray.get(a.ping.remote()) == "a"
+    ray.shutdown()
+
+    cluster.connect()
+    try:
+        with pytest.raises(ValueError, match="already taken"):
+            A.options(lifetime="detached", name="unique-svc").remote()
+        # The original is still reachable and then killable.
+        h = ray.get_actor("unique-svc")
+        assert ray.get(h.ping.remote(), timeout=30) == "a"
+        ray.kill(h)
+    finally:
+        ray.shutdown()
