@@ -5,8 +5,9 @@ Prints ONE JSON line:
 
 North-star metric (BASELINE.md): tokens/sec/chip training the BASELINE
 config-1 model (GPT-2-125M class). The reference publishes no tokens/sec
-number (SURVEY.md §6) — vs_baseline is the ratio against the previous
-recorded round in BENCH_HISTORY.json (1.0 on first measurement).
+number (SURVEY.md §6) — vs_baseline is the ratio against the pinned bar
+in BASELINE.json "published" (falling back to the previous comparable
+BENCH_HISTORY.json entry; 1.0 on first measurement).
 """
 
 from __future__ import annotations
@@ -18,23 +19,59 @@ import sys
 import time
 
 
-def time_best_of(step_once, sync, *, steps: int, n_seg: int = 3) -> float:
-    """Seconds per step, best of n_seg segments of `steps` calls each.
+def time_best_of(step_once, sync, *, steps: int, n_seg: int = 3,
+                 converge: float = 0.01, max_seg: int = 10) -> float:
+    """Seconds per step: best segment of `steps` calls each, repeated
+    until the measurement is noise-proof.
 
     `sync()` must force completion with a host fetch — on tunneled
     backends block_until_ready alone does not flush the remote queue.
     Best-of because the tunnel has large run-to-run variance; the
-    fastest segment reflects the machine's rate.
+    fastest segment reflects the machine's rate. One recorded sample
+    used to decide a round, so segments repeat (up to `max_seg`) until
+    the two fastest agree within `converge` — the best is then a stable
+    property of the code, not of one tunnel draw.
     """
     sync()  # flush warmup/compile before the clock starts
-    best = float("inf")
-    for _ in range(n_seg):
+    times: list[float] = []
+    while len(times) < max_seg:
         t0 = time.perf_counter()
         for _ in range(steps):
             step_once()
         sync()
-        best = min(best, (time.perf_counter() - t0) / steps)
-    return best
+        times.append((time.perf_counter() - t0) / steps)
+        if len(times) >= n_seg:
+            a, b = sorted(times)[:2]
+            if b - a <= converge * a:
+                break
+    return min(times)
+
+
+def pinned_baseline(metric: str, match: dict | None = None):
+    """Fixed scoreboard bar for `metric` from BASELINE.json "published".
+
+    vs_baseline must compare against a *pinned* number — comparing to
+    the most recent history entry made every round a ratchet against
+    its own tunnel noise (VERDICT r2 weak #1). A pin only applies when
+    the run's config matches the pin's recorded "match" fields (batch/
+    seq/platform — comparing across configs would report config changes
+    as speedups). Returns None if no applicable pin exists.
+    """
+    path = os.path.join(os.path.dirname(__file__), "BASELINE.json")
+    try:
+        pub = json.load(open(path)).get("published", {})
+        entry = pub.get(metric)
+        if isinstance(entry, dict):
+            pin_cfg = entry.get("match", {})
+            if match is not None and any(
+                    match.get(k) != v for k, v in pin_cfg.items()):
+                return None
+            return float(entry["value"])
+        if entry is not None:
+            return float(entry)
+    except Exception:  # noqa: BLE001
+        pass
+    return None
 
 
 def push_history(metric: str, value: float, unit: str, match: dict,
@@ -132,15 +169,15 @@ def bench_serve(quick: bool) -> None:
     ttfts = sorted(r.ttft_s for r in reqs)
     p50 = ttfts[len(ttfts) // 2]
     req_s = n_req / dt
-    prev = push_history(
-        metric, req_s, "req/s",
-        match={"prompt_len": prompt_len, "max_new": max_new,
-               "slots": slots, "decode_block": engine.decode_block,
-               "platform": jax.devices()[0].platform},
-        extra={"ttft_p50_s": p50})
+    run_match = {"prompt_len": prompt_len, "max_new": max_new,
+                 "slots": slots, "decode_block": engine.decode_block,
+                 "platform": jax.devices()[0].platform}
+    prev = push_history(metric, req_s, "req/s",
+                        match=run_match, extra={"ttft_p50_s": p50})
+    base = pinned_baseline(metric, run_match) or prev
     print(json.dumps({
         "metric": metric, "value": round(req_s, 2), "unit": "req/s",
-        "vs_baseline": round(req_s / prev, 3) if prev else 1.0,
+        "vs_baseline": round(req_s / base, 3) if base else 1.0,
         "ttft_p50_ms": round(p50 * 1e3, 1),
         "gen_tokens_per_sec": round(
             sum(len(r.tokens) for r in reqs) / dt, 1),
@@ -168,7 +205,9 @@ def bench_vit(quick: bool) -> None:
         cfg = vit.ViTConfig(image_size=224, patch_size=16, d_model=768,
                             n_layers=12, n_heads=12, d_ff=3072,
                             n_classes=1000)
-        batch, steps = 64, 12
+        # 60-step segments amortize the tunnel-RTT sync (same rationale
+        # as the flagship default --steps).
+        batch, steps = 64, 60
         metric = "vit_b16_train_images_per_sec_per_chip"
 
     params = vit.init_params(cfg, jax.random.key(0))
@@ -201,13 +240,14 @@ def bench_vit(quick: bool) -> None:
     step_once()
     img_s = batch / time_best_of(
         step_once, lambda: float(state["loss"]), steps=steps)
-    prev = push_history(
-        metric, img_s, "images/s",
-        match={"batch": batch, "platform": jax.devices()[0].platform,
-               "method": "best-of-3-segments"}, extra={})
+    run_match = {"batch": batch, "platform": jax.devices()[0].platform,
+                 "method": "best-of-segments", "seg_steps": steps}
+    prev = push_history(metric, img_s, "images/s",
+                        match=run_match, extra={})
+    base = pinned_baseline(metric, run_match) or prev
     print(json.dumps({
         "metric": metric, "value": round(img_s, 1), "unit": "images/s",
-        "vs_baseline": round(img_s / prev, 3) if prev else 1.0,
+        "vs_baseline": round(img_s / base, 3) if base else 1.0,
     }))
 
 
@@ -215,7 +255,11 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="tiny config + fewer steps (smoke test)")
-    ap.add_argument("--steps", type=int, default=20)
+    # 180 → 60-step segments: on the ~150ms-RTT tunneled chip the final
+    # sync's RTT is amortized over the segment, so short segments
+    # under-report the device rate by ~10% (6-step segments) vs ~1%
+    # (60-step). Segments repeat until the two fastest agree within 1%.
+    ap.add_argument("--steps", type=int, default=180)
     ap.add_argument("--batch", type=int, default=0, help="0 = auto")
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--serve", action="store_true",
@@ -286,9 +330,10 @@ def main() -> None:
             state, holder["m"] = step_fn(state, b["t"], b["y"], b["m"])
 
         step_once()  # warmup/compile
+        seg_steps = max(1, steps // 3)
         per_step = time_best_of(
             step_once, lambda: float(holder["m"]["loss"]),
-            steps=max(1, steps // 3))
+            steps=seg_steps)
         assert float(holder["m"]["loss"]) == float(
             holder["m"]["loss"]), "non-finite loss"
 
@@ -304,15 +349,17 @@ def main() -> None:
     peak = _chip_peak_flops(devices[0])
     mfu = (per_chip * flops_per_token / peak) if peak else None
 
-    # vs_baseline: ratio to the previous comparable measurement. "method"
-    # distinguishes best-of-segments timing from the older whole-run
-    # mean; batch/seq/platform are part of the config identity.
-    prev = push_history(
-        metric, per_chip, "tokens/s/chip",
-        match={"method": "best-of-3-segments", "batch": batch, "seq": seq,
-               "platform": devices[0].platform},
-        extra={"devices": n_dev})
-    vs = (per_chip / prev) if prev else 1.0
+    # vs_baseline: ratio to the pinned bar in BASELINE.json "published"
+    # (falls back to the previous comparable measurement when no pin
+    # exists). "method" distinguishes best-of-segments timing from the
+    # older whole-run mean; batch/seq/platform are the config identity.
+    run_match = {"method": "best-of-segments", "seg_steps": seg_steps,
+                 "batch": batch, "seq": seq,
+                 "platform": devices[0].platform}
+    prev = push_history(metric, per_chip, "tokens/s/chip",
+                        match=run_match, extra={"devices": n_dev})
+    base = pinned_baseline(metric, run_match) or prev
+    vs = (per_chip / base) if base else 1.0
 
     out = {
         "metric": metric,
