@@ -222,6 +222,45 @@ def prefill_sample(cfg: TransformerConfig, params, cache: KVCache,
     return cache, tok
 
 
+@partial(jax.jit, static_argnums=(0, 6), donate_argnums=(2,))
+def prefill_sample_batch(cfg: TransformerConfig, params, cache: KVCache,
+                         tokens: jax.Array, lengths: jax.Array,
+                         slots: jax.Array, top_k: int,
+                         temps: jax.Array, key: jax.Array
+                         ) -> Tuple[KVCache, jax.Array]:
+    """Prefill a BATCH of padded prompts (W, S_bucket) into their cache
+    slots and sample each one's first token in ONE dispatch.
+
+    Admission waves are the engine's second-largest device cost: each
+    single-sequence prefill streams the full weights from HBM, so W
+    serial prefills cost ~W× one batched prefill (memory-bound). Rows
+    whose slot index is out of range (the fixed-W tile's padding) are
+    dropped by the scatter and their sampled token is garbage the
+    caller ignores. Compiles once per (W, S_bucket)."""
+    W, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]          # (W, S, D)
+    sin, cos = rope_tables(cfg, S)
+    layer = partial(_prefill_layer, cfg)
+    (x, _, _), (ks, vs) = lax.scan(layer, (x, sin, cos), params["layers"])
+    # ks: (L, W, S, KVH, Dh) → scatter into cache rows; padding rows
+    # (slot == num_slots) fall out of bounds and are dropped.
+    k = cache.k.at[:, slots, :S].set(ks.astype(cache.k.dtype),
+                                     mode="drop")
+    v = cache.v.at[:, slots, :S].set(vs.astype(cache.v.dtype),
+                                     mode="drop")
+    seq_lens = cache.seq_lens.at[slots].set(lengths, mode="drop")
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    idx = (lengths - 1).astype(jnp.int32)[:, None, None]
+    last = jnp.take_along_axis(
+        x, jnp.broadcast_to(idx, (W, 1, x.shape[2])), axis=1)  # (W,1,D)
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(cfg.dtype)
+    logits = (last @ head).astype(jnp.float32)[:, 0]       # (W, V)
+    toks = sample(logits, key, temperature=temps, top_k=top_k)
+    return KVCache(k=k, v=v, seq_lens=seq_lens), toks
+
+
 @partial(jax.jit, static_argnums=(0, 5))
 def first_token_sample(cfg: TransformerConfig, params, tokens: jax.Array,
                        lengths: jax.Array, temps: jax.Array, top_k: int,

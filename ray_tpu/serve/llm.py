@@ -40,8 +40,7 @@ from ..models.generate import (
     decode_step,
     first_token_sample,
     init_kv_cache,
-    prefill,
-    prefill_sample,
+    prefill_sample_batch,
 )
 from ..models.transformer import TransformerConfig, init_params
 
@@ -133,12 +132,16 @@ class GenRequest:
 
 
 class _Slot:
-    __slots__ = ("req", "emitted", "length")
+    __slots__ = ("req", "emitted", "length", "inflight")
 
     def __init__(self, req: GenRequest, prompt_len: int):
         self.req = req
         self.emitted = 0
         self.length = prompt_len  # tokens in cache (grows per tick)
+        # Decode ticks dispatched to the device but not yet processed
+        # on the host (the pipelined block in flight). The device-side
+        # cache position for this slot is length + inflight.
+        self.inflight = 0
 
 
 class LLMEngine:
@@ -170,6 +173,10 @@ class LLMEngine:
         self._temps = jnp.zeros((num_slots,), jnp.float32)
         self._key = jax.random.key(seed)
         self.slots: List[Optional[_Slot]] = [None] * num_slots
+        # One decode block pipelined: dispatched last tick, its tokens
+        # fetched/emitted next tick (overlaps the round trip with the
+        # next block's compute).
+        self._pending = None
         self.waiting: deque = deque()
         self.lock = threading.Lock()
         self._work = threading.Event()
@@ -236,68 +243,97 @@ class LLMEngine:
         self._complete(slot.req, slot.emitted)
         self.slots[idx] = None
 
-    def _pad_prompt(self, req: GenRequest) -> Any:
-        """Pad on the HOST: an eager .at[:plen].set() compiles a
-        scatter kernel per distinct prompt length (seconds each),
-        wrecking admission latency; numpy + one transfer doesn't."""
-        plen = len(req.prompt)
-        bucket = self._bucket_for(plen)
-        buf = np.zeros((1, bucket), np.int32)
-        buf[0, :plen] = np.asarray(req.prompt, np.int32)
-        return jnp.asarray(buf)
+    _ADMIT_TILE = 8  # fixed batch tile: ONE compile per bucket, ever
+
+    @classmethod
+    def _build_tile(cls, bucket: int, reqs: Sequence[GenRequest]):
+        """Pad up to _ADMIT_TILE prompts into one (W, bucket) host
+        tile (+ lengths and temps). Padding on the HOST: an eager
+        .at[].set() per prompt would compile a scatter kernel per
+        distinct length (seconds each); numpy + one transfer doesn't."""
+        W = cls._ADMIT_TILE
+        buf = np.zeros((W, bucket), np.int32)
+        lens = np.ones((W,), np.int32)
+        temps = np.zeros((W,), np.float32)
+        for j, r in enumerate(reqs):
+            pl = len(r.prompt)
+            buf[j, :pl] = np.asarray(r.prompt, np.int32)
+            lens[j] = pl
+            temps[j] = r.temperature
+        return buf, lens, temps
 
     def _admit(self) -> List:
         """Prefill waiting requests into free slots (arrival order).
 
-        All admissions are DISPATCHED here (async); the first tokens
-        are fetched later by _deliver_first_tokens with one fused host
-        sync — on remote/tunneled chips each sync costs a full round
-        trip. Requests whose first token was already served by
-        _early_first_tokens() are prefilled without sampling and their
-        decode continues from that token. Returns [(idx, tok_dev)].
+        Admissions are BATCHED per prompt-length bucket into fixed
+        W-row tiles and dispatched through prefill_sample_batch — a
+        single-sequence prefill streams the full weights from HBM, so
+        per-slot serial prefills made admission waves cost ~W× more
+        device time than one batched call. All dispatches are async;
+        first tokens are fetched later by _deliver_first_tokens with
+        one fused host sync. Requests whose first token was already
+        served by _early_first_tokens() are prefilled in the same
+        batch (their sampled token is discarded and decode continues
+        from the token the client saw). Returns [(idx, tok_dev)].
         """
+        with self.lock:
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            take: List = []
+            while free[len(take):] and self.waiting:
+                take.append(self.waiting.popleft())
+        if not take:
+            return []
+
         admitted: List = []  # (idx, tok_dev) — first token pending
-        while True:
-            with self.lock:
-                free = [i for i, s in enumerate(self.slots) if s is None]
-                if not free or not self.waiting:
-                    break
-                req = self.waiting.popleft()
-            idx = free[0]
-            plen = len(req.prompt)
-            padded = self._pad_prompt(req)
-            early_tok = getattr(req, "_early_tok", None)
+        by_bucket: Dict[int, List] = {}
+        for req, idx in zip(take, free):
+            by_bucket.setdefault(
+                self._bucket_for(len(req.prompt)), []).append((req, idx))
+        W = self._ADMIT_TILE
+        chunks = [(bucket, pairs[off:off + W])
+                  for bucket, pairs in sorted(by_bucket.items())
+                  for off in range(0, len(pairs), W)]
+        for ci, (bucket, chunk) in enumerate(chunks):
+            buf, lens, temps = self._build_tile(
+                bucket, [req for req, _ in chunk])
+            # Padding rows scatter out of bounds (slot==num_slots) and
+            # are dropped on device.
+            slot_idx = np.full((W,), self.num_slots, np.int32)
+            for j, (_, idx) in enumerate(chunk):
+                slot_idx[j] = idx
+            self._key, sub = jax.random.split(self._key)
             try:
-                if early_tok is not None:
-                    # First token already delivered queue-side: write
-                    # the prompt KV only; decode continues from the
-                    # token the client saw.
-                    self.cache, _last = prefill(
-                        self.cfg, self.params, self.cache, padded,
-                        jnp.int32(plen), jnp.int32(idx))
-                else:
-                    self._key, sub = jax.random.split(self._key)
-                    # prefill + first-token sample in one dispatch.
-                    self.cache, tok_dev = prefill_sample(
-                        self.cfg, self.params, self.cache, padded,
-                        jnp.int32(plen), jnp.int32(idx), self.top_k,
-                        jnp.float32(req.temperature), sub)
+                self.cache, toks = prefill_sample_batch(
+                    self.cfg, self.params, self.cache,
+                    jnp.asarray(buf), jnp.asarray(lens),
+                    jnp.asarray(slot_idx), self.top_k,
+                    jnp.asarray(temps), sub)
             except Exception:
-                # put it back so _fail_all can notify its client
+                # put this and every unprocessed request back so
+                # _fail_all can notify their clients
                 with self.lock:
-                    self.waiting.appendleft(req)
+                    for _, later in reversed(chunks[ci:]):
+                        for req, _ in reversed(later):
+                            self.waiting.appendleft(req)
                 raise
-            slot = _Slot(req, plen)
-            self.slots[idx] = slot
-            self._temps = self._temps.at[idx].set(req.temperature)
-            if early_tok is not None:
-                slot.emitted = len(req.tokens)
-                slot.length = plen + slot.emitted
-                self.cur_tokens = self.cur_tokens.at[idx].set(
-                    int(early_tok))
-            else:
-                self.cur_tokens = self.cur_tokens.at[idx].set(tok_dev)
-                admitted.append((idx, tok_dev))
+            self._temps = self._temps.at[slot_idx].set(
+                jnp.asarray(temps), mode="drop")
+            self.cur_tokens = self.cur_tokens.at[slot_idx].set(
+                toks, mode="drop")
+            for j, (req, idx) in enumerate(chunk):
+                slot = _Slot(req, len(req.prompt))
+                self.slots[idx] = slot
+                early_tok = getattr(req, "_early_tok", None)
+                if early_tok is not None:
+                    # First token already delivered queue-side: decode
+                    # continues from the token the client saw, not this
+                    # batch's sample.
+                    slot.emitted = len(req.tokens)
+                    slot.length = len(req.prompt) + slot.emitted
+                    self.cur_tokens = self.cur_tokens.at[idx].set(
+                        int(early_tok))
+                else:
+                    admitted.append((idx, toks[j]))
         return admitted
 
     def _early_first_tokens(self) -> List:
@@ -319,18 +355,11 @@ class LLMEngine:
             by_bucket.setdefault(
                 self._bucket_for(len(r.prompt)), []).append(r)
         outs = []
-        W = 8  # fixed batch tile: ONE compile per bucket, ever
+        W = self._ADMIT_TILE
         for bucket, reqs in sorted(by_bucket.items()):
             for off in range(0, len(reqs), W):
                 chunk = reqs[off:off + W]
-                buf = np.zeros((W, bucket), np.int32)
-                lens = np.ones((W,), np.int32)
-                temps = np.zeros((W,), np.float32)
-                for j, r in enumerate(chunk):
-                    pl = len(r.prompt)
-                    buf[j, :pl] = np.asarray(r.prompt, np.int32)
-                    lens[j] = pl
-                    temps[j] = r.temperature
+                buf, lens, temps = self._build_tile(bucket, chunk)
                 self._key, sub = jax.random.split(self._key)
                 toks = first_token_sample(
                     self.cfg, self.params, jnp.asarray(buf),
@@ -401,10 +430,16 @@ class LLMEngine:
 
     def step(self) -> bool:
         """One engine tick: admit, serve queued requests' first tokens
-        (cache-free path — TTFT does not wait for a slot), then one
-        fused block of decode steps for all slots. All device work is
-        dispatched before any host fetch, so round trips overlap
-        compute. Returns False when there is nothing to do."""
+        (cache-free path — TTFT does not wait for a slot), dispatch one
+        fused block of decode steps for all slots, then process the
+        PREVIOUS tick's block. The one-block pipeline means the host
+        fetch of block N overlaps the device computing block N+1 —
+        without it the chip idles a full host↔device round trip
+        (~150 ms tunneled) per block, which dominates decode for small
+        models. The on-device dependency chain (cache, cur_tokens) is
+        exact; the host only lags by one block in observing tokens, so
+        EOS/finish frees a slot one tick late (bounded overshoot, same
+        class as mid-block overshoot). Returns False when idle."""
         admitted = self._admit()
         outs = self._early_first_tokens()
         # Snapshot: a concurrent stop()/_fail_all may None-out entries
@@ -413,53 +448,93 @@ class LLMEngine:
         fused = self._fuse_first_tokens(admitted, outs)
         snap = list(self.slots)
         active = [i for i, s in enumerate(snap) if s is not None]
-        if not active:
-            self._deliver_first_tokens(fused, admitted, outs)
-            return bool(admitted or outs)
+        block = None
+        if active:
+            # Block size (adaptive, per step): sized to the minimum
+            # remaining generation budget among active slots — counting
+            # ticks already in flight — rounded UP to a power of two
+            # (each distinct size is its own XLA compile): rounding
+            # down would split a 63-token budget into ~7 dispatches and
+            # pay the round trip for each; rounding up wastes at most
+            # the finishing slot's share of the overshoot ticks. Capped
+            # by self.decode_block (compile-cache/latency bound) and by
+            # every slot's DEVICE-side cache headroom (length +
+            # inflight) so no in-block write can run past max_seq_len.
+            headroom = min(self.max_seq_len - 1
+                           - snap[i].length - snap[i].inflight
+                           for i in active)
+            budget = max(snap[i].req.max_new_tokens - snap[i].emitted
+                         - snap[i].inflight for i in active)
+            if budget > 0 or self._pending is None:
+                remaining = max(1, min(
+                    max(1, snap[i].req.max_new_tokens - snap[i].emitted
+                        - snap[i].inflight) for i in active))
+                k_block = 1
+                while k_block < remaining:
+                    k_block *= 2
+                k_block = min(k_block, self.decode_block,
+                              max(1, headroom))
+                while k_block & (k_block - 1):
+                    k_block &= k_block - 1
 
-        # Block size (adaptive, per step): sized to the minimum
-        # remaining generation budget among active slots, rounded UP to
-        # a power of two (each distinct size is its own XLA compile) —
-        # rounding down would split a 63-token budget into ~7 dispatches
-        # and pay the host↔device round trip for each; rounding up
-        # wastes at most the finishing slot's share of the overshoot
-        # ticks. Capped by self.decode_block (compile-cache/latency
-        # bound) and by every slot's cache headroom so no in-block
-        # write can run past max_seq_len.
-        headroom = min(self.max_seq_len - 1 - snap[i].length
-                       for i in active)
-        remaining = max(1, min(snap[i].req.max_new_tokens
-                               - snap[i].emitted for i in active))
-        k_block = 1
-        while k_block < remaining:
-            k_block *= 2
-        k_block = min(k_block, self.decode_block, max(1, headroom))
-        while k_block & (k_block - 1):
-            k_block &= k_block - 1
+                self._key, sub = jax.random.split(self._key)
+                if k_block == 1:
+                    self.cache, logits = decode_step(
+                        self.cfg, self.params, self.cache,
+                        self.cur_tokens)
+                    toks = _sample_batch(logits, self._temps, sub,
+                                         self.top_k)[None]     # (1, B)
+                else:
+                    self.cache, toks = decode_multi(
+                        self.cfg, self.params, self.cache,
+                        self.cur_tokens, self._temps, k_block,
+                        self.top_k, sub)                       # (k, B)
+                self.cur_tokens = toks[-1]
+                # Start the host copy NOW, before the next tick enqueues
+                # prefills/the next block: the tunnel serves plain fetch
+                # responses only after ALL enqueued work, so a fetch
+                # without the async copy would wait out work enqueued
+                # AFTER the block it wants (measured 1.6s vs 0.37s per
+                # 654M block).
+                try:
+                    toks.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — backend without it
+                    pass
+                self.decode_ticks += k_block
+                for i in active:
+                    snap[i].inflight += k_block
+                block = (toks, k_block, [(i, snap[i]) for i in active])
+            # else: every active slot's budget is already covered by
+            # the in-flight block — dispatching more would only burn
+            # wasted ticks; process the pending block instead.
 
-        self._key, sub = jax.random.split(self._key)
-        if k_block == 1:
-            self.cache, logits = decode_step(
-                self.cfg, self.params, self.cache, self.cur_tokens)
-            toks = _sample_batch(logits, self._temps, sub,
-                                 self.top_k)[None]         # (1, B)
-        else:
-            self.cache, toks = decode_multi(
-                self.cfg, self.params, self.cache, self.cur_tokens,
-                self._temps, k_block, self.top_k, sub)     # (k, B)
-        self.cur_tokens = toks[-1]
         # First tokens (this step's admissions + queued requests) were
         # enqueued for copy before the block — emit them while the
         # block computes.
         self._deliver_first_tokens(fused, admitted, outs)
-        host_toks = np.asarray(toks)
-        self.decode_ticks += k_block
+        prev, self._pending = self._pending, block
+        if prev is not None:
+            self._process_block(prev)
+        return bool(admitted or outs or block or prev)
 
-        for i in active:
+    def _process_block(self, block) -> None:
+        """Fetch a dispatched decode block's tokens and emit them.
+
+        The block's slot snapshot carries the _Slot OBJECTS from
+        dispatch time: a slot index freed and readmitted while the
+        block was in flight now holds a different request, and the
+        identity check keeps the dead request's overshoot tokens out
+        of the new request's stream."""
+        toks, k_block, slot_snap = block
+        host_toks = np.asarray(toks)
+        for i, slot0 in slot_snap:
+            slot0.inflight -= k_block
             slot = self.slots[i]
+            if slot is not slot0:
+                continue  # freed (and possibly readmitted) meanwhile
             for t in range(k_block):
-                if slot is None:  # drained by a concurrent stop()
-                    break
+                if slot is None or slot is not slot0:
+                    break  # drained by stop() / finished below
                 tok = int(host_toks[t, i])
                 self._emit(slot, tok)
                 done = (tok == slot.req.eos_token
@@ -471,7 +546,6 @@ class LLMEngine:
                     self._finish(i)
                     break
                 slot = self.slots[i]
-        return True
 
     def run_forever(self) -> None:
         while not self._stop:

@@ -124,7 +124,7 @@ def test_engine_failure_unblocks_clients(tiny_model, monkeypatch):
     def boom(*a, **k):
         raise RuntimeError("synthetic device OOM")
 
-    monkeypatch.setattr("ray_tpu.serve.llm.prefill_sample", boom)
+    monkeypatch.setattr("ray_tpu.serve.llm.prefill_sample_batch", boom)
     r = eng.submit([1, 2, 3], max_new_tokens=4)
     t = eng.start()
     t.join(timeout=10)
