@@ -28,7 +28,7 @@ from .exceptions import ActorDiedError, ObjectLostError, TaskCancelledError
 from .ids import ObjectID
 from .object_ref import ObjectRef
 from .resources import ResourceSet
-from .scheduler import NodeState
+from .scheduler import NodeState, _is_constrained
 from .task import TaskSpec, TaskType
 
 logger = logging.getLogger("ray_tpu")
@@ -302,6 +302,13 @@ class RemotePlane:
             # The daemon's memory monitor prefers retriable victims
             # (worker_killing_policy.h RetriableFIFO).
             "retriable": spec.retries_left > 0,
+            # Freely-placed tasks may be refused by a saturated daemon
+            # (spillback) and rescheduled here; constrained placement
+            # (node affinity, PG bundles — their resources are already
+            # reserved) must run where sent.
+            "spillable": (getattr(spec, "_pg_charge", None) is None
+                          and not _is_constrained(
+                              spec.scheduling_strategy)),
         }
         if streaming and spec.task_id in self.rt._generators:
             # Live consumer only — reconstruction re-runs have nobody
@@ -323,6 +330,7 @@ class RemotePlane:
         rt = self.rt
         t0 = time.monotonic()
         retried = False
+        released = False  # charge already returned (spillback path)
         streaming = spec.num_returns in ("streaming", "dynamic")
         gst = rt._generators.get(spec.task_id) if streaming else None
         try:
@@ -361,6 +369,25 @@ class RemotePlane:
                 if not reply.get("need_fn"):
                     break
             node.exported_fids.add(spec.descriptor.function_id)
+            if reply.get("spillback"):
+                # The daemon is saturated (another driver raced us for
+                # its capacity — our heartbeat view was stale). Release
+                # our charge FIRST — with it still held, any concurrent
+                # heartbeat's foreign-netting would hide exactly the
+                # usage that caused the refusal — then correct the view
+                # from the refusal's authoritative load and reschedule;
+                # no user retry is burned (reference: lease spillback,
+                # hybrid_scheduling_policy.h:50).
+                released = True
+                rt.scheduler.release_task(spec, node.node_id)
+                load = reply.get("load") or {}
+                rt.scheduler.update_node_report(
+                    node.node_id,
+                    ResourceSet(load.get("available") or {}),
+                    int(load.get("queued") or 0))
+                retried = True
+                rt._submit_when_ready(spec)
+                return
             if reply.get("fetch_failed"):
                 # An arg's payload vanished between packing and the
                 # daemon's pull: reconstruct it and requeue without
@@ -411,7 +438,8 @@ class RemotePlane:
         finally:
             if not retried:
                 rt._task_finished(spec)
-            rt.scheduler.release_task(spec, node.node_id)
+            if not released:
+                rt.scheduler.release_task(spec, node.node_id)
             rt.events.record(spec.display_name(), t0, time.monotonic(),
                              node.node_id, spec.task_id.hex())
 

@@ -73,7 +73,10 @@ class RuntimeContext:
         return _ctx.actor_id.hex() if _ctx.actor_id else None
 
     def get_node_id(self) -> Optional[str]:
-        return _ctx.node_id or global_runtime().head_node_id
+        # Daemon workers learn their host daemon's id from the spawn
+        # env (reference: runtime_context reporting the raylet's node).
+        return (_ctx.node_id or os.environ.get("RAY_TPU_NODE_ID")
+                or global_runtime().head_node_id)
 
 
 # ---------------------------------------------------------------------------

@@ -119,7 +119,8 @@ class NodeDaemon:
         # Execution plane: real OS worker processes.
         n_workers = max(1, int(num_cpus))
         self.pool = WorkerPool(n_workers, shm_name=self.shm_name,
-                               logs_dir=self.logs_dir)
+                               logs_dir=self.logs_dir,
+                               env={"RAY_TPU_NODE_ID": self.node_id})
 
         # Resource view (advisory: the driver's scheduler owns placement;
         # this feeds the heartbeat load report for resource-view sync).
@@ -137,6 +138,7 @@ class NodeDaemon:
         self.available = self.total
         self._queued = 0          # tasks waiting for a worker
         self._running = 0
+        self._spilled = 0         # spillable tasks refused (stats)
 
         # Actors hosted here: actor_id(bytes) -> dedicated WorkerProcess.
         self._actors: Dict[bytes, Any] = {}
@@ -210,6 +212,7 @@ class NodeDaemon:
                 "total": self.total.to_dict(),
                 "queued": self._queued,
                 "running": self._running,
+                "spilled": self._spilled,
             }
 
     def _hb_loop(self):
@@ -358,6 +361,7 @@ class NodeDaemon:
         res = ResourceSet(msg.pop("resources", None) or {})
         max_calls = msg.pop("max_calls", 0)
         retriable = msg.pop("retriable", False)
+        spillable = msg.pop("spillable", False)
         fn_bytes = msg.pop("fn", None)
         fid = msg.get("fid")
         if fn_bytes is not None and fid is not None:
@@ -394,7 +398,34 @@ class NodeDaemon:
         if mtype == "actor_create":
             self._run_actor_create(conn, msg, res, conn_actors)
             return
-        self._run_task(conn, msg, res, max_calls, fid, retriable)
+
+        # Spillback (reference: RequestWorkerLease replying with a
+        # spillback address, node_manager.proto:365-379): a saturated
+        # daemon REFUSES a spillable task instead of queueing it — with
+        # several drivers, each one's view is heartbeat-stale and two
+        # can race the same free slot; the loser's task would sit here
+        # behind the winner's while another node idles. Admission is an
+        # atomic check-and-charge; the reply carries the authoritative
+        # load so the driver corrects its view before rescheduling.
+        # Only driver-marked spillable tasks (free placement, no PG
+        # reservation / node affinity) are refused.
+        precharged = False
+        if spillable and not res.is_empty():
+            with self._avail_lock:
+                ok = res.fits(self.available)
+                if ok:
+                    self.available = self.available.subtract(res)
+                    self._running += 1
+            if not ok:
+                self._spilled += 1
+                send_msg(conn, {"type": "result",
+                                "task_id": msg.get("task_id"),
+                                "spillback": True,
+                                "load": self._load_report()})
+                return
+            precharged = True
+        self._run_task(conn, msg, res, max_calls, fid, retriable,
+                       precharged=precharged)
 
     def _memory_victims(self):
         with self._running_lock:
@@ -646,7 +677,8 @@ class NodeDaemon:
             sel.close()
 
     def _run_task(self, conn, msg, res, max_calls, fid,
-                  retriable: bool = False) -> None:
+                  retriable: bool = False,
+                  precharged: bool = False) -> None:
         send_msg = self._send_msg
         with self._avail_lock:
             self._queued += 1
@@ -656,13 +688,16 @@ class NodeDaemon:
         except Exception as e:  # noqa: BLE001 — pool exhausted/shutdown
             with self._avail_lock:
                 self._queued -= 1
+            if precharged:
+                self._uncharge(res)
             send_msg(conn, {"type": "result",
                             "task_id": msg.get("task_id"),
                             "crashed": f"no worker available: {e}"})
             return
         with self._avail_lock:
             self._queued -= 1
-        self._charge(res)
+        if not precharged:
+            self._charge(res)
         with self._running_lock:
             self._running_seq += 1
             run_key = self._running_seq
