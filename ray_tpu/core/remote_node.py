@@ -371,18 +371,17 @@ class RemotePlane:
             node.exported_fids.add(spec.descriptor.function_id)
             if reply.get("spillback"):
                 # The daemon is saturated (another driver raced us for
-                # its capacity — our heartbeat view was stale). Release
-                # our charge FIRST — with it still held, any concurrent
-                # heartbeat's foreign-netting would hide exactly the
-                # usage that caused the refusal — then correct the view
-                # from the refusal's authoritative load and reschedule;
-                # no user retry is burned (reference: lease spillback,
+                # its capacity — our heartbeat view was stale). In one
+                # locked step, release our charge (held, it would make
+                # heartbeat foreign-netting hide exactly the usage that
+                # caused the refusal) and merge the refusal's
+                # authoritative load; then reschedule. No user retry is
+                # burned (reference: lease spillback,
                 # hybrid_scheduling_policy.h:50).
                 released = True
-                rt.scheduler.release_task(spec, node.node_id)
                 load = reply.get("load") or {}
-                rt.scheduler.update_node_report(
-                    node.node_id,
+                rt.scheduler.apply_spill_refusal(
+                    spec, node.node_id,
                     ResourceSet(load.get("available") or {}),
                     int(load.get("queued") or 0))
                 retried = True

@@ -204,6 +204,25 @@ class Scheduler:
             node.reported_queued = queued
         self._pump()
 
+    def apply_spill_refusal(self, spec: TaskSpec, node_id: str,
+                            reported_available: ResourceSet,
+                            queued: int) -> None:
+        """A daemon refused a spillable task: under ONE lock, return
+        the task's charge and merge the refusal's authoritative load,
+        then pump once. Split calls would pump between the two steps
+        with the view still showing the refusing node free — granting
+        more queued tasks to the node that just refused."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            node.uncharge(spec.resources)
+            if node.alive:
+                reported_used = node.total.sub_clamp0(reported_available)
+                node.set_foreign(reported_used.sub_clamp0(node.charged))
+                node.reported_queued = queued
+        self._pump()
+
     def release_task(self, spec: TaskSpec, node_id: str) -> None:
         """Return a finished task's resources to wherever they were
         charged (PG bundle or node)."""

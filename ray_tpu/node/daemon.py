@@ -368,8 +368,45 @@ class NodeDaemon:
             with self._fn_lock:
                 self._fn_cache[fid] = fn_bytes
 
+        # Spillback (reference: RequestWorkerLease replying with a
+        # spillback address, node_manager.proto:365-379): a saturated
+        # daemon REFUSES a spillable task instead of queueing it — with
+        # several drivers, each one's view is heartbeat-stale and two
+        # can race the same free slot; the loser's task would sit here
+        # behind the winner's while another node idles. Admission is an
+        # atomic check-and-charge; the reply carries the authoritative
+        # load so the driver corrects its view before rescheduling.
+        # Only driver-marked spillable tasks (free placement, no PG
+        # reservation / node affinity) are refused. The check runs
+        # BEFORE arg fetch / runtime_env setup: a refusal must not pull
+        # payloads into (or build envs on) the node that won't run the
+        # task. The reservation holds no _running/_queued count yet —
+        # _run_task takes those over (no double-counting in the load
+        # report while the task waits for a worker).
+        precharged = False
+        if mtype == "task" and spillable and not res.is_empty():
+            with self._avail_lock:
+                ok = res.fits(self.available)
+                if ok:
+                    self.available = self.available.subtract(res)
+                else:
+                    self._spilled += 1
+            if not ok:
+                send_msg(conn, {"type": "result",
+                                "task_id": msg.get("task_id"),
+                                "spillback": True,
+                                "load": self._load_report()})
+                return
+            precharged = True
+
+        def unreserve():
+            with self._avail_lock:
+                self.available = self.available.add(res)
+
         missing = self._ensure_local(fetch)
         if missing is not None:
+            if precharged:
+                unreserve()
             send_msg(conn, {"type": "result", "task_id": msg.get("task_id"),
                             "fetch_failed": missing})
             return
@@ -385,6 +422,8 @@ class NodeDaemon:
                     msg["runtime_env"], self._renv_cache,
                     lambda uri: self.control.kv_get(KV_PREFIX + uri))
             except Exception as e:  # noqa: BLE001 — bad/missing package
+                if precharged:
+                    unreserve()
                 send_msg(conn, {"type": "result",
                                 "task_id": msg.get("task_id"),
                                 "crashed": f"runtime_env setup failed: "
@@ -398,32 +437,6 @@ class NodeDaemon:
         if mtype == "actor_create":
             self._run_actor_create(conn, msg, res, conn_actors)
             return
-
-        # Spillback (reference: RequestWorkerLease replying with a
-        # spillback address, node_manager.proto:365-379): a saturated
-        # daemon REFUSES a spillable task instead of queueing it — with
-        # several drivers, each one's view is heartbeat-stale and two
-        # can race the same free slot; the loser's task would sit here
-        # behind the winner's while another node idles. Admission is an
-        # atomic check-and-charge; the reply carries the authoritative
-        # load so the driver corrects its view before rescheduling.
-        # Only driver-marked spillable tasks (free placement, no PG
-        # reservation / node affinity) are refused.
-        precharged = False
-        if spillable and not res.is_empty():
-            with self._avail_lock:
-                ok = res.fits(self.available)
-                if ok:
-                    self.available = self.available.subtract(res)
-                    self._running += 1
-            if not ok:
-                self._spilled += 1
-                send_msg(conn, {"type": "result",
-                                "task_id": msg.get("task_id"),
-                                "spillback": True,
-                                "load": self._load_report()})
-                return
-            precharged = True
         self._run_task(conn, msg, res, max_calls, fid, retriable,
                        precharged=precharged)
 
@@ -688,15 +701,21 @@ class NodeDaemon:
         except Exception as e:  # noqa: BLE001 — pool exhausted/shutdown
             with self._avail_lock:
                 self._queued -= 1
-            if precharged:
-                self._uncharge(res)
+                if precharged:
+                    self.available = self.available.add(res)
             send_msg(conn, {"type": "result",
                             "task_id": msg.get("task_id"),
                             "crashed": f"no worker available: {e}"})
             return
         with self._avail_lock:
             self._queued -= 1
-        if not precharged:
+        if precharged:
+            # Admission already reserved the resources; only the
+            # running count starts now (a precharged task waiting in
+            # pool.acquire must not show as running in load reports).
+            with self._avail_lock:
+                self._running += 1
+        else:
             self._charge(res)
         with self._running_lock:
             self._running_seq += 1
@@ -705,6 +724,21 @@ class NodeDaemon:
             self._running_tasks[run_key] = (
                 run_key, retriable and not msg.get("streaming"), worker,
                 tid.hex() if isinstance(tid, bytes) and tid else "task")
+        charged = True
+
+        def done():
+            # Return the charge BEFORE the result reply goes out: the
+            # driver reacts to the reply instantly (release → dispatch
+            # the next task here), and an admission check racing the
+            # finally block would spuriously refuse a free node.
+            nonlocal charged
+            if not charged:
+                return
+            charged = False
+            with self._running_lock:
+                self._running_tasks.pop(run_key, None)
+            self._uncharge(res)
+
         ran = False
         try:
             if msg.get("task_id") is None:
@@ -714,21 +748,22 @@ class NodeDaemon:
             ran = True
             if msg.get("streaming"):
                 self._relay_streaming(conn, worker, msg)
+                done()
             else:
                 reply = worker.run_task(
                     msg, on_stream=lambda item: send_msg(conn, item))
+                done()
                 send_msg(conn, reply)
             if fid is not None:
                 worker.exported_fns.add(fid)
         except self._WorkerCrashedError as e:
+            done()
             with contextlib.suppress(Exception):
                 send_msg(conn, {"type": "result",
                                 "task_id": msg.get("task_id"),
                                 "crashed": str(e)})
         finally:
-            with self._running_lock:
-                self._running_tasks.pop(run_key, None)
-            self._uncharge(res)
+            done()
             if worker is not None:
                 if ran and fid is not None and max_calls > 0:
                     worker.fn_calls[fid] = worker.fn_calls.get(fid, 0) + 1
