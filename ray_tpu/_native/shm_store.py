@@ -56,6 +56,8 @@ def _load_lib():
     lib.rts_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.rts_delete.restype = ctypes.c_int
     lib.rts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_reclaim_dead_pins.restype = ctypes.c_int64
+    lib.rts_reclaim_dead_pins.argtypes = [ctypes.c_void_p]
     for name in ("rts_used", "rts_capacity", "rts_num_objects"):
         fn = getattr(lib, name)
         fn.restype = ctypes.c_uint64
@@ -152,6 +154,13 @@ class ShmStore:
 
     def contains(self, object_id: bytes) -> bool:
         return bool(lib().rts_contains(self._h(), object_id))
+
+    def reclaim_dead_pins(self) -> int:
+        """Drop pins recorded by crashed processes; returns how many
+        were reclaimed (reference: plasma client-disconnect cleanup).
+        The allocator also does this lazily under memory pressure —
+        call it eagerly when a worker death is observed."""
+        return int(lib().rts_reclaim_dead_pins(self._h()))
 
     def delete(self, object_id: bytes) -> bool:
         if not self._handle:

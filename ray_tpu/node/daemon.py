@@ -351,6 +351,8 @@ class NodeDaemon:
             w, res = entry
             self.pool.retire(w)
             self._uncharge(res)
+            with contextlib.suppress(Exception):
+                self.shm.reclaim_dead_pins()
 
     def _handle_exec(self, conn, msg: Dict[str, Any], conn_actors) -> None:
         from ray_tpu.core.resources import ResourceSet
@@ -758,6 +760,10 @@ class NodeDaemon:
                 worker.exported_fns.add(fid)
         except self._WorkerCrashedError as e:
             done()
+            # The dead worker's read pins must not strand arena
+            # capacity (reference: plasma client-disconnect cleanup).
+            with contextlib.suppress(Exception):
+                self.shm.reclaim_dead_pins()
             with contextlib.suppress(Exception):
                 send_msg(conn, {"type": "result",
                                 "task_id": msg.get("task_id"),

@@ -21,6 +21,7 @@
 #include <atomic>
 #include <cerrno>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 
 #include <fcntl.h>
@@ -35,9 +36,15 @@
 
 namespace {
 
-constexpr uint32_t kMagic = 0x52545053;  // "RTPS"
+constexpr uint32_t kMagic = 0x52545054;  // "RTPT" (v2: per-pid pin records)
 constexpr uint32_t kIdLen = 28;
 constexpr uint32_t kAlign = 256;
+// Per-slot pin records: enough for the realistic concurrent-pinner
+// count (driver + a few workers reading one object). Pins beyond this
+// are still counted in `pins` but untracked — they leak if their
+// process crashes (hdr->pin_overflows counts how often that risk
+// existed).
+constexpr int kPinnersPerSlot = 4;
 constexpr uint32_t kMaxObjects = 1 << 16;  // hash slots
 
 enum SlotState : uint32_t {
@@ -46,6 +53,12 @@ enum SlotState : uint32_t {
   SLOT_SEALED = 2,    // immutable, readable
   SLOT_MUTABLE = 3,   // channel object (seqlock)
   SLOT_TOMBSTONE = 4, // deleted (keeps probe chains alive)
+};
+
+struct PinRec {        // per-process pin accounting (crash reclaim)
+  int32_t pid;
+  int32_t count;
+  uint64_t start;      // /proc starttime: disambiguates recycled pids
 };
 
 struct Slot {
@@ -58,6 +71,8 @@ struct Slot {
   uint64_t seal_seq;   // LRU clock (monotonic seal/touch counter)
   uint64_t version;    // mutable-object version (seqlock: odd = writing)
   int32_t owner_pid;   // creator, while SLOT_CREATED (crash repair)
+  uint64_t owner_start;  // creator's starttime (recycled-pid guard)
+  PinRec pinners[kPinnersPerSlot];  // who holds the pins (by pid)
 };
 
 struct FreeNode {           // free-list node stored at block start
@@ -75,6 +90,7 @@ struct Header {
   uint64_t seq;             // LRU clock
   uint64_t num_objects;
   uint64_t map_size;        // total mapping bytes (free space ends here)
+  uint64_t pin_overflows;   // pins taken beyond kPinnersPerSlot records
   pthread_mutex_t mu;
   Slot slots[kMaxObjects];
 };
@@ -183,12 +199,112 @@ void FreeLocked(Store* st, uint64_t offset, uint64_t size) {
   }
 }
 
+// Start time (clock ticks since boot) of a LIVE, non-zombie process;
+// 0 when the process is gone or a zombie (a zombie holds no mappings
+// and can't be mid-anything — its pins are reclaimable, and kill(pid,
+// 0) alone would miss it: daemons observe worker crashes BEFORE the
+// child is reaped). kNoProcFS on /proc-less systems — consistent
+// between record and reclaim, degrading to pid-only matching.
+constexpr uint64_t kNoProcFS = ~uint64_t(0);
+
+uint64_t LiveStartTime(int32_t pid) {
+  if (pid <= 0) return 0;
+  if (kill(pid, 0) != 0) return 0;  // ESRCH or EPERM: not ours anyway
+  char path[64];
+  snprintf(path, sizeof(path), "/proc/%d/stat", pid);
+  FILE* f = fopen(path, "r");
+  if (!f) return kNoProcFS;
+  char buf[1024];
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  buf[n] = 0;
+  // Fields: pid (comm) state ...; comm may contain spaces/parens —
+  // the state char follows the LAST ')'. starttime is field 22, i.e.
+  // the 19th token after state.
+  char* rp = strrchr(buf, ')');
+  if (!rp) return kNoProcFS;
+  while (*++rp == ' ') {
+  }
+  char state = *rp;
+  if (state == 'Z' || state == 'X' || state == 0) return 0;
+  unsigned long long start = 0;
+  if (sscanf(rp,
+             "%*c %*s %*s %*s %*s %*s %*s %*s %*s %*s %*s %*s %*s "
+             "%*s %*s %*s %*s %*s %*s %llu",
+             &start) != 1)
+    return kNoProcFS;
+  return static_cast<uint64_t>(start);
+}
+
+uint64_t OwnStartTime() {
+  static uint64_t cached = LiveStartTime(static_cast<int32_t>(getpid()));
+  return cached;
+}
+
+void RecordPinLocked(Header* h, Slot* s, int32_t pid, uint64_t start) {
+  for (int i = 0; i < kPinnersPerSlot; i++) {
+    PinRec* p = &s->pinners[i];
+    if (p->pid == pid && p->start == start) { p->count++; return; }
+  }
+  for (int i = 0; i < kPinnersPerSlot; i++) {
+    PinRec* p = &s->pinners[i];
+    if (p->pid == pid) {
+      // Same pid, different incarnation: the old holder is dead and
+      // its pid was recycled — reclaim its pins inline instead of
+      // merging (merging would strand them under a "live" pid forever).
+      s->pins -= p->count;
+      if (s->pins < 0) s->pins = 0;
+      *p = {pid, 1, start};
+      return;
+    }
+  }
+  for (int i = 0; i < kPinnersPerSlot; i++)
+    if (s->pinners[i].pid == 0) { s->pinners[i] = {pid, 1, start}; return; }
+  h->pin_overflows++;  // untracked: reclaim can't see this pin
+}
+
+void ReleasePinLocked(Slot* s, int32_t pid, uint64_t start) {
+  for (int i = 0; i < kPinnersPerSlot; i++) {
+    PinRec* p = &s->pinners[i];
+    if (p->pid == pid && p->start == start) {
+      if (--p->count <= 0) *p = {0, 0, 0};
+      return;
+    }
+  }
+}
+
+// Drop pins recorded by processes that no longer exist (reference:
+// plasma releasing a disconnected client's pins, store.h:55). A
+// long-running daemon otherwise loses arena capacity to every crashed
+// pinned-reader. Returns the number of pins reclaimed.
+int64_t ReclaimDeadPinsLocked(Header* h) {
+  int64_t reclaimed = 0;
+  for (uint32_t i = 0; i < kMaxObjects; i++) {
+    Slot* s = &h->slots[i];
+    if (s->pins <= 0) continue;
+    if (s->state == SLOT_FREE || s->state == SLOT_TOMBSTONE) continue;
+    for (int j = 0; j < kPinnersPerSlot; j++) {
+      PinRec* p = &s->pinners[j];
+      if (p->pid <= 0) continue;
+      uint64_t live = LiveStartTime(p->pid);
+      if (live == 0 || live != p->start) {  // gone, zombie or recycled
+        s->pins -= p->count;
+        reclaimed += p->count;
+        *p = {0, 0, 0};
+      }
+    }
+    if (s->pins < 0) s->pins = 0;
+  }
+  return reclaimed;
+}
+
 // Allocate `need` bytes, evicting least-recently-sealed unpinned objects
 // until the allocation succeeds (reference: eviction_policy.h LRU).
 // Returns the allocation offset (0 = full even after eviction); the
 // consumed block size lands in *got_out.
 uint64_t AllocOrEvictLocked(Store* st, uint64_t need, uint64_t* got_out) {
   Header* h = st->hdr;
+  bool reclaimed_dead = false;
   for (;;) {
     uint64_t off = AllocLocked(st, need, got_out);
     if (off) return off;
@@ -200,7 +316,16 @@ uint64_t AllocOrEvictLocked(Store* st, uint64_t need, uint64_t* got_out) {
         if (!victim || s->seal_seq < victim->seal_seq) victim = s;
       }
     }
-    if (!victim) return 0;
+    if (!victim) {
+      // Everything left is pinned: some pins may belong to crashed
+      // processes — reclaim once and retry before declaring the arena
+      // full (self-healing even if no one calls the explicit API).
+      if (!reclaimed_dead && ReclaimDeadPinsLocked(h) > 0) {
+        reclaimed_dead = true;
+        continue;
+      }
+      return 0;
+    }
     FreeLocked(st, victim->offset, victim->alloc_size);
     victim->state = SLOT_TOMBSTONE;
     h->num_objects--;
@@ -305,10 +430,9 @@ int rts_unlink(const char* name) { return shm_unlink(name); }
 // mid-splice and its unsealed slots are garbage. pthread's robust-mutex
 // recovery only makes the lock usable again — the shared state must be
 // repaired too. The slot table is the authoritative record of
-// allocated spans, so rebuild the free list (and `used`) from it and
-// tombstone in-flight (SLOT_CREATED) slots.
-// Known limitation (documented): pins held by the dead process leak —
-// per-process pin accounting would be needed to reclaim them safely.
+// allocated spans, so rebuild the free list (and `used`) from it,
+// tombstone in-flight (SLOT_CREATED) slots, and reclaim pins recorded
+// by dead processes (per-pid pin records in each slot).
 static void RepairAfterOwnerDeath(Header* h) {
   uint8_t* base = reinterpret_cast<uint8_t*>(h);  // header sits at base
   struct Span { uint64_t off, size; };
@@ -319,9 +443,10 @@ static void RepairAfterOwnerDeath(Header* h) {
     if (s->state == SLOT_CREATED) {
       // In-flight slot: reap it ONLY if its creator is gone — writers
       // fill their span without the lock, so a LIVE process may be
-      // mid-write here (kill(pid, 0) == ESRCH means no such process).
-      bool owner_dead = s->owner_pid <= 0 ||
-                        (kill(s->owner_pid, 0) != 0 && errno == ESRCH);
+      // mid-write here. Zombies and recycled pids (different
+      // starttime) count as gone.
+      uint64_t live = s->owner_pid > 0 ? LiveStartTime(s->owner_pid) : 0;
+      bool owner_dead = live == 0 || live != s->owner_start;
       if (owner_dead) {
         s->state = SLOT_TOMBSTONE;
         if (h->num_objects > 0) h->num_objects--;
@@ -356,6 +481,7 @@ static void RepairAfterOwnerDeath(Header* h) {
   }
   if (cursor < h->map_size) add_free(cursor, h->map_size - cursor);
   h->used = used;
+  ReclaimDeadPinsLocked(h);
 }
 
 static void Lock(Header* h) {
@@ -387,10 +513,24 @@ int rts_create(void* handle, const uint8_t* id, uint64_t size,
   s->pins = 0;
   s->version = 0;
   s->owner_pid = static_cast<int32_t>(getpid());
+  s->owner_start = OwnStartTime();
+  memset(s->pinners, 0, sizeof(s->pinners));
   h->num_objects++;
   *offset_out = off;
   pthread_mutex_unlock(&h->mu);
   return 0;
+}
+
+// Reclaim pins held by crashed processes (callable by the daemon when
+// it observes a worker death; the allocator also does this lazily on
+// pressure). Returns the number of pins reclaimed.
+int64_t rts_reclaim_dead_pins(void* handle) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  int64_t n = ReclaimDeadPinsLocked(h);
+  pthread_mutex_unlock(&h->mu);
+  return n;
 }
 
 int rts_seal(void* handle, const uint8_t* id) {
@@ -421,7 +561,11 @@ int rts_get(void* handle, const uint8_t* id, uint64_t* offset_out,
     return -1;
   }
   s->seal_seq = h->seq++;  // LRU touch
-  if (pin) s->pins++;
+  if (pin) {
+    s->pins++;
+    RecordPinLocked(h, s, static_cast<int32_t>(getpid()),
+                    OwnStartTime());
+  }
   *offset_out = s->offset;
   *size_out = s->size;
   pthread_mutex_unlock(&h->mu);
@@ -433,7 +577,11 @@ int rts_release(void* handle, const uint8_t* id) {
   Header* h = st->hdr;
   Lock(h);
   Slot* s = FindSlot(h, id, false);
-  if (s && s->pins > 0) s->pins--;
+  if (s && s->pins > 0) {
+    s->pins--;
+    ReleasePinLocked(s, static_cast<int32_t>(getpid()),
+                     OwnStartTime());
+  }
   pthread_mutex_unlock(&h->mu);
   return 0;
 }
@@ -515,6 +663,7 @@ int rts_ch_create(void* handle, const uint8_t* id, uint64_t max_size,
   s->alloc_size = got;
   s->pins = 0;
   s->version = 0;
+  memset(s->pinners, 0, sizeof(s->pinners));
   h->num_objects++;
   *offset_out = off;
   pthread_mutex_unlock(&h->mu);
@@ -593,6 +742,9 @@ int rts_debug_die_locked(void* handle, const uint8_t* id, uint64_t size) {
       s->size = size;
       s->alloc_size = got;
       s->pins = 0;
+      s->owner_pid = 0;  // "creator unknown": repair reaps the slot
+      s->owner_start = 0;
+      memset(s->pinners, 0, sizeof(s->pinners));
       h->num_objects++;
     }
   }

@@ -242,3 +242,119 @@ class TestCrashRecovery:
         finally:
             store.close()
             ssm.ShmStore.unlink(name)
+
+
+class TestDeadPinReclaim:
+    """Pins held by a crashed process must not strand arena capacity
+    (VERDICT r2 weak #7; reference: plasma reclaiming a disconnected
+    client's pins, store.h:55). Per-pid pin records in each slot let
+    the survivor subtract exactly the dead process's pins."""
+
+    def test_dead_pinner_reclaimed_explicitly(self):
+        import subprocess
+        import sys
+
+        from ray_tpu._native import shm_store as ssm
+
+        name = f"/rts_pin_{os.getpid()}"
+        store = ssm.ShmStore(name, capacity=2 * 1024 * 1024)
+        try:
+            oid = b"P" * 28
+            store.put(oid, b"pinned" * 100)
+
+            # Child pins the object twice and dies WITHOUT releasing.
+            code = (
+                "import os\n"
+                "from ray_tpu._native import shm_store as ssm\n"
+                f"st = ssm.ShmStore({name!r}, create=False)\n"
+                f"assert st.get({oid!r}, pin=True) is not None\n"
+                f"assert st.get({oid!r}, pin=True) is not None\n"
+                "os._exit(0)\n"
+            )
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  timeout=60)
+            assert proc.returncode == 0
+            # The pins block deletion until reclaimed.
+            assert store.delete(oid) is False
+            assert store.reclaim_dead_pins() == 2
+            assert store.delete(oid) is True
+        finally:
+            store.close()
+            ssm.ShmStore.unlink(name)
+
+    def test_allocator_self_heals_under_pressure(self):
+        """Even with no explicit reclaim call, an allocation that would
+        otherwise fail (everything pinned) reclaims dead pins and
+        evicts — arena bytes return after a pinned-holder dies."""
+        import subprocess
+        import sys
+
+        from ray_tpu._native import shm_store as ssm
+
+        name = f"/rts_pin2_{os.getpid()}"
+        cap = 2 * 1024 * 1024
+        store = ssm.ShmStore(name, capacity=cap)
+        try:
+            big = b"G" * 28
+            store.put(big, b"g" * (cap - 256 * 1024))  # dominates arena
+
+            code = (
+                "import os\n"
+                "from ray_tpu._native import shm_store as ssm\n"
+                f"st = ssm.ShmStore({name!r}, create=False)\n"
+                f"assert st.get({big!r}, pin=True) is not None\n"
+                "os._exit(0)\n"
+            )
+            assert subprocess.run([sys.executable, "-c", code],
+                                  timeout=60).returncode == 0
+
+            # A live pin would make this allocation impossible; the
+            # dead process's pin is reclaimed in the allocator and the
+            # big object is evicted to make room.
+            new = b"N" * 28
+            store.put(new, b"n" * (cap - 256 * 1024))
+            assert store.contains(new)
+            assert not store.contains(big)  # evicted
+        finally:
+            store.close()
+            ssm.ShmStore.unlink(name)
+
+    def test_zombie_pinner_reclaimed_before_reap(self):
+        """The daemon observes a worker crash BEFORE reaping the child:
+        a zombie passes kill(pid,0), so reclaim must detect the Z state
+        from /proc (review finding)."""
+        import subprocess
+        import sys
+        import time
+
+        from ray_tpu._native import shm_store as ssm
+
+        name = f"/rts_pin3_{os.getpid()}"
+        store = ssm.ShmStore(name, capacity=2 * 1024 * 1024)
+        try:
+            oid = b"Z" * 28
+            store.put(oid, b"zzz" * 100)
+            code = (
+                "import os\n"
+                "from ray_tpu._native import shm_store as ssm\n"
+                f"st = ssm.ShmStore({name!r}, create=False)\n"
+                f"assert st.get({oid!r}, pin=True) is not None\n"
+                "os._exit(0)\n"
+            )
+            proc = subprocess.Popen([sys.executable, "-c", code])
+            # Wait for exit WITHOUT reaping (no proc.wait/poll): poll
+            # /proc state until the child is a zombie.
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with open(f"/proc/{proc.pid}/stat") as f:
+                    if f.read().rsplit(")", 1)[1].split()[0] == "Z":
+                        break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("child never became a zombie")
+            assert store.reclaim_dead_pins() == 1  # zombie counts dead
+            assert store.delete(oid) is True
+            proc.wait(timeout=10)
+        finally:
+            store.close()
+            ssm.ShmStore.unlink(name)
