@@ -91,6 +91,13 @@ class ShmStore:
         self.name = name
         self._handle = lib().rts_connect(
             name.encode(), capacity, 1 if create else 0)
+        if not self._handle and create:
+            # A stale same-named arena from a dead session (or an
+            # incompatible-layout build — magic mismatch) blocks
+            # attachment forever; the creator owns the name, so
+            # recreate it rather than wedge every worker spawn.
+            lib().rts_unlink(name.encode())
+            self._handle = lib().rts_connect(name.encode(), capacity, 1)
         if not self._handle:
             raise ShmStoreError(f"Failed to attach shm store {name!r}")
         # mmap the same arena for zero-copy buffer views.

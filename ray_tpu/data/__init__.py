@@ -29,6 +29,13 @@ from .read_api import (
     write_parquet,
     write_tfrecords,
 )
+from .datasource import (
+    Datasink,
+    Datasource,
+    ReadTask,
+    read_datasource,
+    write_datasink,
+)
 
 __all__ = [
     "Dataset", "DataIterator", "Block", "BlockAccessor", "GroupedData",
@@ -41,4 +48,6 @@ __all__ = [
     "read_webdataset", "read_sql",
     "write_parquet", "write_csv", "write_json", "write_numpy",
     "write_tfrecords",
+    "Datasource", "Datasink", "ReadTask", "read_datasource",
+    "write_datasink",
 ]

@@ -177,6 +177,13 @@ class Dataset:
 
         return write_tfrecords(self, path)
 
+    def write_datasink(self, datasink) -> List[Any]:
+        """Write through a custom Datasink connector (reference:
+        Dataset.write_datasink)."""
+        from .datasource import write_datasink
+
+        return write_datasink(self, datasink)
+
     # ------------------------------------------------------------------
     # Execution
     # ------------------------------------------------------------------

@@ -1,0 +1,135 @@
+"""Datasource/Datasink — the data connector extension point.
+
+Capability-equivalent of the reference's custom-connector ABCs
+(reference: python/ray/data/datasource/datasource.py Datasource +
+get_read_tasks/estimate_inmemory_data_size;
+python/ray/data/datasource/datasink.py Datasink with the
+on_write_start/write/on_write_complete/on_write_failed lifecycle):
+third-party IO (mongo/bigquery-class connectors) plugs in WITHOUT
+touching the built-in read_*/write_* functions.
+
+- A Datasource turns itself into independent ReadTasks; each runs as
+  one distributed task producing ONE block (a datasource wanting more
+  output blocks returns more ReadTasks — block structure stays
+  explicit instead of hiding a second fan-out inside a task).
+- A Datasink is cloudpickled into per-block distributed write tasks;
+  the driver runs the lifecycle hooks around them.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Callable, List, Optional
+
+from .plan import Read
+
+
+class ReadTask:
+    """One unit of distributed read work: a zero-arg callable returning
+    one block. num_rows/size_bytes are informational metadata carried
+    for connector introspection — the planner does not consume them
+    yet (declaring them load-bearing here would be a silent no-op)."""
+
+    def __init__(self, fn: Callable[[], Any], *,
+                 num_rows: Optional[int] = None,
+                 size_bytes: Optional[int] = None):
+        self._fn = fn
+        self.num_rows = num_rows
+        self.size_bytes = size_bytes
+
+    def __call__(self) -> Any:
+        return self._fn()
+
+
+class Datasource(ABC):
+    """Custom read connector (reference: datasource.py:18).
+
+    Subclass and implement get_read_tasks (and, when cheap,
+    estimate_inmemory_data_size); pass an instance to
+    read_datasource()."""
+
+    def get_name(self) -> str:
+        name = type(self).__name__
+        if name.endswith("Datasource"):
+            name = name[: -len("Datasource")]
+        return name
+
+    @abstractmethod
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        """Split this source into at most `parallelism` ReadTasks
+        (fewer is fine — e.g. one per file/shard/partition)."""
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        """Estimated decompressed in-memory bytes, or None.
+        Informational (callers/tests may read it); the planner does
+        not consume it yet."""
+        return None
+
+
+class Datasink(ABC):
+    """Custom write connector (reference: datasink.py:9).
+
+    write() runs as a distributed task per block — the instance is
+    serialized into each task, so keep per-run mutable state out of it
+    and return per-block results instead; the driver-side lifecycle
+    hooks see all of them."""
+
+    def on_write_start(self) -> None:
+        """Driver-side, before any write task is submitted."""
+
+    @abstractmethod
+    def write(self, block: Any) -> Any:
+        """Write one block (runs remotely); the return value is
+        collected into on_write_complete's list."""
+
+    def on_write_complete(self, write_results: List[Any]) -> None:
+        """Driver-side, after every write task succeeded."""
+
+    def on_write_failed(self, error: Exception) -> None:
+        """Driver-side, when any write task failed (before the error
+        re-raises)."""
+
+    def get_name(self) -> str:
+        name = type(self).__name__
+        if name.endswith("Datasink"):
+            name = name[: -len("Datasink")]
+        return name
+
+
+def read_datasource(datasource: Datasource, *,
+                    parallelism: int = -1):
+    """Dataset from a custom Datasource (reference:
+    read_api.read_datasource)."""
+    from .dataset import Dataset
+
+    if parallelism <= 0:
+        parallelism = 200  # reference default ceiling for auto
+    tasks = datasource.get_read_tasks(parallelism)
+    if not tasks:
+        raise ValueError(
+            f"{datasource.get_name()}: get_read_tasks returned no work")
+    return Dataset(Read(list(tasks), datasource.get_name()))
+
+
+def write_datasink(ds, datasink: Datasink) -> List[Any]:
+    """Write every block of `ds` through a custom Datasink; returns the
+    per-block write results (reference: Dataset.write_datasink)."""
+    from .. import get as ray_get, remote
+
+    @remote
+    def _write(block, sink):
+        return sink.write(block)
+
+    datasink.on_write_start()
+    try:
+        refs = [_write.remote(ref, datasink) for ref in ds._refs()]
+        results = list(ray_get(refs))
+    except Exception as e:  # noqa: BLE001 — surface via the hook, then raise
+        datasink.on_write_failed(e)
+        raise
+    datasink.on_write_complete(results)
+    return results
+
+
+__all__ = ["Datasource", "Datasink", "ReadTask", "read_datasource",
+           "write_datasink"]

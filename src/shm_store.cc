@@ -237,8 +237,19 @@ uint64_t LiveStartTime(int32_t pid) {
 }
 
 uint64_t OwnStartTime() {
-  static uint64_t cached = LiveStartTime(static_cast<int32_t>(getpid()));
-  return cached;
+  // Keyed on pid so a fork()ed child (Python multiprocessing default)
+  // re-reads ITS OWN start time — a static surviving the fork would
+  // record the parent's, making every liveness check see the child as
+  // a recycled pid and reclaim a live reader's pins. Callers hold the
+  // arena mutex, which serializes access to these statics.
+  static int32_t cached_pid = 0;
+  static uint64_t cached_start = 0;
+  int32_t pid = static_cast<int32_t>(getpid());
+  if (pid != cached_pid) {
+    cached_start = LiveStartTime(pid);
+    cached_pid = pid;
+  }
+  return cached_start;
 }
 
 void RecordPinLocked(Header* h, Slot* s, int32_t pid, uint64_t start) {
@@ -279,6 +290,18 @@ void ReleasePinLocked(Slot* s, int32_t pid, uint64_t start) {
 // pinned-reader. Returns the number of pins reclaimed.
 int64_t ReclaimDeadPinsLocked(Header* h) {
   int64_t reclaimed = 0;
+  // Memoize pid -> starttime for the scan: it runs under the arena
+  // mutex, and the same live pid (e.g. the daemon itself) can hold
+  // pins on many slots — one /proc read each, not one per record.
+  struct Memo { int32_t pid; uint64_t live; };
+  std::vector<Memo> memo;
+  auto live_of = [&memo](int32_t pid) {
+    for (const Memo& m : memo)
+      if (m.pid == pid) return m.live;
+    uint64_t v = LiveStartTime(pid);
+    memo.push_back({pid, v});
+    return v;
+  };
   for (uint32_t i = 0; i < kMaxObjects; i++) {
     Slot* s = &h->slots[i];
     if (s->pins <= 0) continue;
@@ -286,7 +309,7 @@ int64_t ReclaimDeadPinsLocked(Header* h) {
     for (int j = 0; j < kPinnersPerSlot; j++) {
       PinRec* p = &s->pinners[j];
       if (p->pid <= 0) continue;
-      uint64_t live = LiveStartTime(p->pid);
+      uint64_t live = live_of(p->pid);
       if (live == 0 || live != p->start) {  // gone, zombie or recycled
         s->pins -= p->count;
         reclaimed += p->count;
