@@ -96,8 +96,19 @@ class ShmStore:
             # incompatible-layout build — magic mismatch) blocks
             # attachment forever; the creator owns the name, so
             # recreate it rather than wedge every worker spawn.
-            lib().rts_unlink(name.encode())
-            self._handle = lib().rts_connect(name.encode(), capacity, 1)
+            # Recovery is serialized under an flock so two racing
+            # creators can't unlink each other's freshly-recreated
+            # arena (the loser re-attaches to the winner's instead).
+            import fcntl
+
+            with open(f"/dev/shm{name}.lock", "w") as lf:
+                fcntl.flock(lf, fcntl.LOCK_EX)
+                self._handle = lib().rts_connect(
+                    name.encode(), capacity, 1)
+                if not self._handle:
+                    lib().rts_unlink(name.encode())
+                    self._handle = lib().rts_connect(
+                        name.encode(), capacity, 1)
         if not self._handle:
             raise ShmStoreError(f"Failed to attach shm store {name!r}")
         # mmap the same arena for zero-copy buffer views.

@@ -1,0 +1,28 @@
+"""One host-stats schema for every reporter (reference: the psutil
+collection in dashboard/modules/reporter) — the daemon's heartbeat
+host section and the dashboard head's own entry must stay
+field-compatible, so both build it here."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def collect_host_stats() -> Dict[str, Any]:
+    """cpu/mem/disk snapshot; {} when psutil is unavailable."""
+    try:
+        import psutil
+    except Exception:  # noqa: BLE001 — optional dep
+        return {}
+    try:
+        vm = psutil.virtual_memory()
+        du = psutil.disk_usage("/")
+        return {
+            "cpu_percent": psutil.cpu_percent(interval=None),
+            "cpu_count": psutil.cpu_count(),
+            "mem_total": vm.total,
+            "mem_percent": vm.percent,
+            "disk_percent": du.percent,
+        }
+    except Exception:  # noqa: BLE001 — platform quirk
+        return {}

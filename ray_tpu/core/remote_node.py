@@ -167,6 +167,11 @@ class RemotePlane:
                     self.rt.scheduler.update_node_report(
                         nid, ResourceSet(load.get("available", {})),
                         int(load.get("queued", 0)))
+                    node = self.rt.scheduler.get_node(nid)
+                    if node is not None:
+                        # Full report (incl. per-host stats) for the
+                        # dashboard's cluster view.
+                        node.last_load = load
 
     def _on_node_event(self, payload: bytes) -> None:
         text = payload.decode(errors="replace")

