@@ -3,10 +3,13 @@
 Capability-equivalent of the reference's runtime_env plugin vocabulary
 (reference: python/ray/runtime_env/, _private/runtime_env/ — plugins
 pip/conda/working_dir/py_modules/env_vars; applied by the per-node agent
-before a lease is granted). Here the supported, hermetic subset —
-env_vars, working_dir, py_modules — is applied around each user-code
+before a lease is granted). Here the supported subset — env_vars,
+working_dir, py_modules, and an OFFLINE pip plugin (local-wheelhouse
+installs, runtime_env_pip.py) — is applied around each user-code
 invocation and fully restored afterwards, in whichever process executes
-the task (driver-embedded node or spawned worker).
+the task (driver-embedded node or spawned worker). Caveat shared with
+the reference's worker reuse: modules already imported from an env
+linger in sys.modules after the path is removed.
 """
 
 from __future__ import annotations
@@ -16,7 +19,7 @@ import os
 import sys
 from typing import Any, Dict, Optional
 
-VALID_KEYS = frozenset({"env_vars", "working_dir", "py_modules"})
+VALID_KEYS = frozenset({"env_vars", "working_dir", "py_modules", "pip"})
 
 
 def validate(renv: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -41,6 +44,11 @@ def validate(renv: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     if pm is not None and (not isinstance(pm, (list, tuple)) or not all(
             isinstance(p, (str, os.PathLike)) for p in pm)):
         raise ValueError("runtime_env['py_modules'] must be a list of paths")
+    if renv.get("pip") is not None:
+        from .runtime_env_pip import normalize_pip
+
+        renv = dict(renv)
+        renv["pip"] = normalize_pip(renv["pip"])
     return renv
 
 
@@ -75,6 +83,15 @@ def applied(renv: Optional[Dict[str, Any]]):
             if p not in sys.path:
                 sys.path.insert(0, p)
                 added_paths.append(p)
+        if renv.get("pip"):
+            # Materialized once per content hash per host (flock +
+            # .ready marker); later tasks reuse the cached dir.
+            from .runtime_env_pip import materialize_pip
+
+            env_dir = materialize_pip(renv["pip"])
+            if env_dir not in sys.path:
+                sys.path.insert(0, env_dir)
+                added_paths.append(env_dir)
         yield
     finally:
         for k, old in saved_env.items():

@@ -1,0 +1,115 @@
+"""pip runtime-env plugin — offline dependency isolation.
+
+Capability-equivalent of the reference's pip plugin (reference:
+python/ray/_private/runtime_env/pip.py — a per-env virtualenv built by
+the runtime-env agent, URI-cached by content hash). Adapted to a
+no-network TPU image:
+
+- wheels come from a LOCAL wheelhouse (``find_links`` in the env spec,
+  or the ``RAY_TPU_WHEELHOUSE`` env var); ``pip install --no-index``
+  never touches the network, so a missing wheel is an immediate,
+  clearly-attributed error instead of a hang.
+- the env is materialized with ``pip install --target`` into a
+  content-addressed cache dir and PREPENDED to ``sys.path`` for the
+  task, rather than swapping interpreters: the base image is itself a
+  venv, so a nested venv would lose jax/numpy (``--system-site-packages``
+  does not chain), and the worker must keep the TPU stack. Same
+  isolation semantics as the reference's env activation for pure-Python
+  and same-interpreter binary wheels.
+- one build per (packages, find_links) content hash per host, guarded
+  by an flock; concurrent tasks wait for the winner's ``.ready`` marker
+  (the reference's URI cache + per-env lock, uri_cache.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+WHEELHOUSE_ENV = "RAY_TPU_WHEELHOUSE"
+
+
+def normalize_pip(spec: Any) -> Dict[str, Any]:
+    """Accept ``"pip": [pkgs]`` or ``{"packages": [...], "find_links":
+    path}``; returns the canonical dict or raises."""
+    if isinstance(spec, (list, tuple)):
+        spec = {"packages": list(spec)}
+    if not isinstance(spec, dict):
+        raise ValueError(
+            "runtime_env['pip'] must be a list of requirements or a "
+            "dict {'packages': [...], 'find_links': path}")
+    pkgs = spec.get("packages")
+    if (not isinstance(pkgs, (list, tuple)) or not pkgs
+            or not all(isinstance(p, str) for p in pkgs)):
+        raise ValueError(
+            "runtime_env['pip']['packages'] must be a non-empty "
+            "list of requirement strings")
+    find_links = spec.get("find_links") or os.environ.get(WHEELHOUSE_ENV)
+    if not find_links:
+        raise ValueError(
+            "runtime_env['pip'] needs a local wheelhouse: set "
+            "'find_links' in the spec or the RAY_TPU_WHEELHOUSE env "
+            "var (this image has no network for an index)")
+    unknown = set(spec) - {"packages", "find_links"}
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env['pip'] keys {sorted(unknown)}")
+    return {"packages": sorted(pkgs), "find_links": str(find_links)}
+
+
+def env_hash(spec: Dict[str, Any]) -> str:
+    h = hashlib.sha256()
+    for p in spec["packages"]:
+        h.update(p.encode())
+        h.update(b"\0")
+    h.update(spec["find_links"].encode())
+    return h.hexdigest()[:16]
+
+
+def cache_base() -> str:
+    return os.path.join(tempfile.gettempdir(), "ray_tpu", "pip_cache")
+
+
+def materialize_pip(spec: Dict[str, Any],
+                    base_dir: Optional[str] = None) -> str:
+    """Build (or reuse) the env dir for `spec`; returns the path to
+    prepend to sys.path. Raises RuntimeError with pip's output when a
+    wheel is missing from the wheelhouse — the documented offline
+    failure mode."""
+    import fcntl
+
+    base = base_dir or cache_base()
+    os.makedirs(base, exist_ok=True)
+    dest = os.path.join(base, env_hash(spec))
+    ready = os.path.join(dest, ".ready")
+    if os.path.exists(ready):
+        return dest
+    with open(dest + ".lock", "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        if os.path.exists(ready):  # built while we waited
+            return dest
+        if os.path.exists(dest):
+            shutil.rmtree(dest, ignore_errors=True)  # half-built
+        cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+               "--no-index", "--find-links", spec["find_links"],
+               "--target", dest] + list(spec["packages"])
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            shutil.rmtree(dest, ignore_errors=True)
+            raise RuntimeError(
+                f"pip runtime_env build failed (offline install from "
+                f"wheelhouse {spec['find_links']!r}): "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        with open(ready, "w") as f:
+            f.write("\n".join(spec["packages"]) + "\n")
+    return dest
+
+
+def clear_cache(base_dir: Optional[str] = None) -> None:
+    shutil.rmtree(base_dir or cache_base(), ignore_errors=True)

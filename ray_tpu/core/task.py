@@ -78,7 +78,11 @@ def validate_options(opts: Dict[str, Any], *, is_actor: bool) -> Dict[str, Any]:
             f"(got {cg!r})")
     if "runtime_env" in opts:
         from .runtime_env import validate as _validate_renv
-        _validate_renv(opts["runtime_env"])
+
+        # Keep the NORMALIZED env (validate canonicalizes e.g. the pip
+        # list form and resolves the wheelhouse env var at submission
+        # time) — discarding it would ship the raw spec to workers.
+        opts["runtime_env"] = _validate_renv(opts["runtime_env"])
     return opts
 
 

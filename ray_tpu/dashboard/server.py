@@ -76,6 +76,11 @@ class MetricsHistory:
 
         with open(self._spill_path, errors="replace") as f:
             tail = _dq(f, maxlen=maxlen)
+        # A crash mid-write can leave a final newline-less fragment;
+        # keeping it would concatenate the next appended sample onto
+        # it, corrupting both records.
+        if tail and not tail[-1].endswith("\n"):
+            tail.pop()
         for line in tail:
             try:
                 self._ring.append(json.loads(line))
@@ -474,10 +479,14 @@ class DashboardServer:
             node = _remote_node(request.match_info["node_id"])
             if node is None:
                 return _json({"error": "unknown remote node"})
+            try:
+                nbytes = int(request.query.get("nbytes", "65536"))
+            except ValueError:
+                return _json({"error": "nbytes must be an integer"})
             reply = await _daemon_call(node, {
                 "type": "log_tail",
                 "name": request.match_info["name"],
-                "nbytes": int(request.query.get("nbytes", "65536")),
+                "nbytes": nbytes,
             })
             if reply.get("error"):
                 return _json({"error": reply["error"]})
