@@ -117,6 +117,14 @@ def make_provider(cfg: ClusterConfig, **overrides) -> NodeProvider:
             project=str(cfg.provider["project"]),
             zone=str(cfg.provider["zone"]),
             cluster_name=cfg.cluster_name, **kw)
+    if ptype == "kuberay":
+        from .providers import KubeTpuNodeProvider
+
+        kw = {k: v for k, v in cfg.provider.items()
+              if k in ("namespace", "api_server", "crd_group",
+                       "crd_version", "default_group")}
+        kw.update(overrides)
+        return KubeTpuNodeProvider(cluster_name=cfg.cluster_name, **kw)
     raise ValueError(f"unknown provider type {ptype!r}")
 
 

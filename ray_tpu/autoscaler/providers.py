@@ -231,3 +231,267 @@ class SSHCommandRunner:
         ssh = " ".join(self._ssh_base()[:-1])
         return ["rsync", "-az", "-e", ssh, local,
                 f"{self.user}@{self.ip}:{remote}"]
+
+
+class KubeTpuNodeProvider(NodeProvider):
+    """KubeRay/GKE-shaped provider (reference:
+    autoscaler/_private/kuberay/node_provider.py): scaling is
+    DECLARATIVE against a RayCluster-style custom resource — the
+    provider PATCHes ``workerGroupSpecs[*].replicas`` (and
+    ``scaleStrategy.workersToDelete`` for targeted scale-down) through
+    the Kubernetes API and an operator reconciles the pods; node
+    identity comes back from pod listings by label selector. This is
+    how real TPU pods are provisioned on GKE (node pools == worker
+    groups with a TPU accelerator/topology per group).
+
+    Same injectable-transport design as GceTpuNodeProvider: the
+    Kubernetes API server is a `transport` callable, so the provider
+    is fully testable against an in-memory operator fake.
+    """
+
+    GROUP_LABEL = "ray.io/group"
+    CLUSTER_LABEL = "ray.io/cluster"
+    TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+
+    def __init__(self, cluster_name: str, *,
+                 namespace: str = "default",
+                 api_server: str = "https://kubernetes.default.svc",
+                 crd_group: str = "ray.io", crd_version: str = "v1",
+                 default_group: str = "workers",
+                 token: Optional[str] = None,
+                 transport: Optional[Transport] = None,
+                 poll_interval_s: float = 1.0):
+        self.cluster_name = cluster_name
+        self.namespace = namespace
+        self.api_server = api_server.rstrip("/")
+        self.crd_group = crd_group
+        self.crd_version = crd_version
+        self.default_group = default_group
+        self.poll_interval_s = poll_interval_s
+        self._transport = transport or _default_transport
+        self._token = token
+        self._label_cache: Dict[str, Dict[str, str]] = {}
+        self._ip_cache: Dict[str, str] = {}
+        self._phase_cache: Dict[str, str] = {}
+        # Pending create handles → group, and handle → pod aliases
+        # once the operator materializes them.
+        self._pending: Dict[str, str] = {}
+        self._alias: Dict[str, str] = {}
+        self._pending_seq = 0
+
+    # -- Kubernetes API plumbing ---------------------------------------
+
+    def _headers(self) -> Dict[str, str]:
+        if self._token is None:
+            try:
+                with open(self.TOKEN_PATH) as f:
+                    self._token = f.read().strip()
+            except OSError:
+                self._token = ""  # out-of-cluster kubeconfig proxies
+        hdrs = {"Content-Type": "application/json"}
+        if self._token:
+            hdrs["Authorization"] = f"Bearer {self._token}"
+        return hdrs
+
+    def _call(self, method: str, path: str, body=None, *,
+              content_type: Optional[str] = None,
+              conflict_ok: bool = False) -> Optional[dict]:
+        hdrs = self._headers()
+        if content_type:
+            hdrs["Content-Type"] = content_type
+        status, payload = self._transport(
+            method, f"{self.api_server}{path}", body, hdrs)
+        if conflict_ok and status == 409:
+            return None
+        if status >= 300:
+            raise RuntimeError(
+                f"Kubernetes API {method} {path} failed "
+                f"({status}): {payload}")
+        return payload
+
+    @property
+    def _cr_path(self) -> str:
+        return (f"/apis/{self.crd_group}/{self.crd_version}/namespaces/"
+                f"{self.namespace}/rayclusters/{self.cluster_name}")
+
+    def _get_cr(self) -> dict:
+        return self._call("GET", self._cr_path)
+
+    def _group_specs(self, cr: dict) -> List[dict]:
+        return cr.setdefault("spec", {}).setdefault(
+            "workerGroupSpecs", [])
+
+    def _list_pods(self) -> List[dict]:
+        sel = f"{self.CLUSTER_LABEL}={self.cluster_name}"
+        out = self._call(
+            "GET",
+            f"/api/v1/namespaces/{self.namespace}/pods"
+            f"?labelSelector={sel}")
+        pods = out.get("items", [])
+        self._label_cache = {}
+        self._ip_cache = {}
+        self._phase_cache = {}
+        for p in pods:
+            name = p["metadata"]["name"]
+            self._label_cache[name] = p["metadata"].get("labels", {})
+            ip = (p.get("status") or {}).get("podIP")
+            if ip:
+                self._ip_cache[name] = ip
+            self._phase_cache[name] = (p.get("status") or {}).get(
+                "phase", "Pending")
+        return pods
+
+    def _patch_group(self, group: str, mutate) -> None:
+        """Index-targeted JSON Patch of ONE worker group's fields,
+        guarded by a resourceVersion test op — a whole-array merge
+        patch from a stale snapshot would clobber concurrent CR writers
+        (the operator clearing workersToDelete, another client scaling
+        a different group). `mutate(idx, spec)` returns the patch ops.
+        Retries the read-modify-write on 409."""
+        for _ in range(8):
+            cr = self._get_cr()
+            specs = self._group_specs(cr)
+            idx = next((i for i, s in enumerate(specs)
+                        if s.get("groupName") == group), None)
+            if idx is None:
+                raise ValueError(
+                    f"RayCluster {self.cluster_name!r} has no worker "
+                    f"group {group!r} (available: "
+                    f"{[s.get('groupName') for s in specs]})")
+            ops = list(mutate(idx, specs[idx]))
+            rv = (cr.get("metadata") or {}).get("resourceVersion")
+            if rv is not None:
+                ops.insert(0, {"op": "test",
+                               "path": "/metadata/resourceVersion",
+                               "value": rv})
+            if self._call("PATCH", self._cr_path, ops,
+                          content_type="application/json-patch+json",
+                          conflict_ok=True) is not None:
+                return
+            time.sleep(self.poll_interval_s)
+        raise RuntimeError(
+            f"CR patch for group {group!r} kept conflicting")
+
+    # -- NodeProvider ---------------------------------------------------
+
+    def create_node(self, resources: Dict[str, float],
+                    labels: Dict[str, str],
+                    node_type: str = "") -> str:
+        """Non-blocking (the autoscaler's update loop calls this
+        inline): bump the group's replicas and return a PENDING handle
+        immediately; the handle resolves to the operator-created pod on
+        any later listing (non_terminated_nodes / wait_ready /
+        node_ip). A TPU node pool can take minutes to provision —
+        polling here would stall all reconciliation."""
+        group = node_type or self.default_group
+
+        def bump(idx, spec):
+            return [{"op": "replace" if "replicas" in spec else "add",
+                     "path": f"/spec/workerGroupSpecs/{idx}/replicas",
+                     "value": int(spec.get("replicas", 0)) + 1}]
+
+        self._patch_group(group, bump)
+        self._pending_seq += 1
+        handle = f"pending-{group}-{self._pending_seq}"
+        self._pending[handle] = group
+        return handle
+
+    def _resolve_pending(self) -> None:
+        """Match operator-created pods to outstanding pending handles
+        (FIFO per group). Called after every pod listing."""
+        claimed = set(self._alias.values())
+        for handle in list(self._pending):
+            group = self._pending[handle]
+            for name, labels in self._label_cache.items():
+                if name in claimed:
+                    continue
+                if labels.get(self.GROUP_LABEL) == group:
+                    self._alias[handle] = name
+                    claimed.add(name)
+                    del self._pending[handle]
+                    break
+
+    def _refresh(self) -> None:
+        self._list_pods()
+        self._resolve_pending()
+
+    def _real_id(self, node_id: str) -> Optional[str]:
+        if node_id in self._alias:
+            return self._alias[node_id]
+        if node_id in self._pending:
+            return None  # not materialized yet
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        self._refresh()
+        if node_id in self._pending:
+            # Never materialized: just undo the replica bump.
+            group = self._pending.pop(node_id)
+            self._patch_group(group, lambda idx, spec: [
+                {"op": "replace",
+                 "path": f"/spec/workerGroupSpecs/{idx}/replicas",
+                 "value": max(0, int(spec.get("replicas", 0)) - 1)}])
+            return
+        real = self._alias.pop(node_id, node_id)
+        labels = self._label_cache.get(real)
+        if labels is None:
+            # Unknown pod (already deleted / stale id): guessing a
+            # group here would scale down an unrelated pool.
+            return
+        group = labels.get(self.GROUP_LABEL, self.default_group)
+
+        def down_and_name(idx, spec):
+            strategy = spec.get("scaleStrategy") or {}
+            to_delete = list(strategy.get("workersToDelete") or [])
+            if real not in to_delete:
+                to_delete.append(real)
+            return [
+                {"op": "replace",
+                 "path": f"/spec/workerGroupSpecs/{idx}/replicas",
+                 "value": max(0, int(spec.get("replicas", 0)) - 1)},
+                {"op": "add",
+                 "path": f"/spec/workerGroupSpecs/{idx}/scaleStrategy",
+                 "value": dict(strategy,
+                               workersToDelete=to_delete)},
+            ]
+
+        self._patch_group(group, down_and_name)
+
+    def non_terminated_nodes(self) -> List[str]:
+        self._refresh()
+        out = []
+        for name, phase in self._phase_cache.items():
+            if phase in ("Running", "Pending") \
+                    and name not in self._alias.values():
+                out.append(name)
+        # Resolved handles keep their original id for the autoscaler's
+        # pending-launch bookkeeping; unresolved ones count as alive
+        # (capacity being provisioned).
+        out.extend(self._alias)
+        out.extend(self._pending)
+        return out
+
+    def node_type_of(self, node_id: str) -> str:
+        if node_id in self._pending:
+            return self._pending[node_id]
+        self._refresh()
+        if node_id in self._pending:
+            return self._pending[node_id]
+        real = self._real_id(node_id)
+        return self._label_cache.get(real or "", {}).get(
+            self.GROUP_LABEL, "")
+
+    def node_ip(self, node_id: str) -> Optional[str]:
+        self._refresh()
+        real = self._real_id(node_id)
+        return self._ip_cache.get(real) if real else None
+
+    def wait_ready(self, node_id: str, timeout_s: float = 600.0) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            self._refresh()
+            real = self._real_id(node_id)
+            if real and self._phase_cache.get(real) == "Running":
+                return True
+            time.sleep(self.poll_interval_s)
+        return False

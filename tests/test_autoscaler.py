@@ -425,3 +425,158 @@ def test_cluster_setup_commands_run(tmp_path):
     assert ("10.0.0.5", "echo hello") in ran
     assert ("10.0.0.5", "pip check") in ran
     launcher.down()
+
+
+class _FakeKubeApi:
+    """In-memory Kubernetes API server + KubeRay operator
+    (transport-level fake): PATCHing the RayCluster CR reconciles pods
+    to the declared replicas, honoring scaleStrategy.workersToDelete —
+    the contract the reference's kuberay node provider drives."""
+
+    def __init__(self, groups=("workers",)):
+        self.cr = {"metadata": {"resourceVersion": "1"},
+                   "spec": {"workerGroupSpecs": [
+                       {"groupName": g, "replicas": 0} for g in groups]}}
+        self.pods = {}
+        self._counter = 0
+
+    def _reconcile(self):
+        for spec in self.cr["spec"]["workerGroupSpecs"]:
+            group = spec["groupName"]
+            to_delete = spec.get("scaleStrategy", {}).get(
+                "workersToDelete", [])
+            for name in list(to_delete):
+                self.pods.pop(name, None)
+            existing = [n for n, p in self.pods.items()
+                        if p["metadata"]["labels"]["ray.io/group"] == group]
+            while len(existing) < int(spec.get("replicas", 0)):
+                self._counter += 1
+                name = f"raycluster-{group}-{self._counter}"
+                self.pods[name] = {
+                    "metadata": {"name": name, "labels": {
+                        "ray.io/cluster": "demo",
+                        "ray.io/group": group}},
+                    "status": {"phase": "Running",
+                               "podIP": f"10.1.0.{self._counter}"},
+                }
+                existing.append(name)
+
+    def _apply_json_patch(self, ops):
+        """Minimal JSON Patch (test/replace/add on the paths the
+        provider emits) with optimistic concurrency on
+        /metadata/resourceVersion (409 = conflict, like a real API
+        server)."""
+        import copy
+
+        cr = copy.deepcopy(self.cr)
+        for op in ops:
+            parts = [p for p in op["path"].split("/") if p]
+            if op["op"] == "test":
+                node = cr
+                for p in parts:
+                    node = node[int(p) if p.isdigit() else p]
+                if node != op["value"]:
+                    return 409, {"error": "resourceVersion conflict"}
+                continue
+            node = cr
+            for p in parts[:-1]:
+                node = node[int(p) if p.isdigit() else p]
+            last = parts[-1]
+            node[int(last) if last.isdigit() else last] = op["value"]
+        cr["metadata"]["resourceVersion"] = str(
+            int(cr["metadata"]["resourceVersion"]) + 1)
+        self.cr = cr
+        return 200, cr
+
+    def __call__(self, method, url, body, headers):
+        if "/rayclusters/" in url:
+            if method == "GET":
+                import copy
+
+                return 200, copy.deepcopy(self.cr)
+            if method == "PATCH":
+                assert headers.get("Content-Type") == \
+                    "application/json-patch+json"
+                status, payload = self._apply_json_patch(body)
+                if status == 200:
+                    self._reconcile()
+                return status, payload
+        if method == "GET" and "/pods" in url:
+            assert "labelSelector=ray.io/cluster=demo" in url
+            return 200, {"items": list(self.pods.values())}
+        return 400, {"error": f"bad request {method} {url}"}
+
+
+def test_kuberay_provider_lifecycle():
+    """KubeRay/GKE-shaped declarative scaling (reference:
+    autoscaler/_private/kuberay/node_provider.py)."""
+    from ray_tpu.autoscaler.providers import KubeTpuNodeProvider
+
+    api = _FakeKubeApi(groups=("workers", "tpu-v5e"))
+    prov = KubeTpuNodeProvider("demo", token="t", transport=api,
+                               poll_interval_s=0.01)
+    n1 = prov.create_node({"CPU": 1.0}, {}, "workers")
+    n2 = prov.create_node({"TPU": 8.0}, {}, "tpu-v5e")
+    assert sorted(prov.non_terminated_nodes()) == sorted([n1, n2])
+    assert prov.node_type_of(n2) == "tpu-v5e"
+    assert prov.node_ip(n1).startswith("10.1.0.")
+    assert prov.wait_ready(n1, timeout_s=1)
+    # Declarative state reflects the scaling.
+    assert api.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
+    assert api.cr["spec"]["workerGroupSpecs"][1]["replicas"] == 1
+
+    # Targeted scale-down: replicas decremented AND the specific pod
+    # named in workersToDelete.
+    prov.terminate_node(n1)
+    assert prov.non_terminated_nodes() == [n2]
+    spec0 = api.cr["spec"]["workerGroupSpecs"][0]
+    assert spec0["replicas"] == 0
+    # The CR names the REAL pod (handles are provider-local ids).
+    assert spec0["scaleStrategy"]["workersToDelete"] == \
+        ["raycluster-workers-1"]
+
+    # Terminating an unknown/stale id must be a no-op, not a guess
+    # that scales down some default group.
+    before = api.cr["spec"]["workerGroupSpecs"][1]["replicas"]
+    prov.terminate_node("no-such-pod")
+    assert api.cr["spec"]["workerGroupSpecs"][1]["replicas"] == before
+
+    # Terminating a handle the operator never materialized just rolls
+    # the replica bump back.
+    api_slow = _FakeKubeApi(groups=("workers",))
+    api_slow._reconcile = lambda: None  # operator asleep
+    slow = KubeTpuNodeProvider("demo", token="t", transport=api_slow,
+                               poll_interval_s=0.01)
+    h = slow.create_node({}, {}, "workers")
+    assert h.startswith("pending-")
+    assert api_slow.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
+    slow.terminate_node(h)
+    assert api_slow.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 0
+
+
+def test_kuberay_unknown_group_rejected():
+    from ray_tpu.autoscaler.providers import KubeTpuNodeProvider
+
+    api = _FakeKubeApi()
+    prov = KubeTpuNodeProvider("demo", token="t", transport=api)
+    with pytest.raises(ValueError, match="no worker group"):
+        prov.create_node({}, {}, "nonexistent-pool")
+
+
+def test_kuberay_provider_from_cluster_config():
+    from ray_tpu.autoscaler.cluster_config import make_provider
+
+    api = _FakeKubeApi(groups=("tpu-v5e",))
+    cfg = ClusterConfig.from_dict({
+        "cluster_name": "demo",
+        "provider": {"type": "kuberay", "namespace": "ml",
+                     "default_group": "tpu-v5e"},
+        "available_node_types": {
+            "tpu-v5e": {"resources": {"TPU": 8}},
+        },
+    })
+    prov = make_provider(cfg, transport=api, token="t",
+                         poll_interval_s=0.01)
+    nid = prov.create_node({"TPU": 8.0}, {}, "")
+    assert prov.node_type_of(nid) == "tpu-v5e"
+    assert prov.namespace == "ml"
