@@ -64,6 +64,9 @@ def _load_lib():
         fn.argtypes = [ctypes.c_void_p]
     lib.rts_ch_write_release.restype = ctypes.c_int
     lib.rts_ch_write_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_ch_wait.restype = ctypes.c_int64
+    lib.rts_ch_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint32, ctypes.c_int]
     lib.rts_ch_read.restype = ctypes.c_int64
     lib.rts_ch_read.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                 ctypes.POINTER(ctypes.c_uint64),
@@ -228,13 +231,19 @@ class ShmStore:
         """Read the channel; blocks until version > min_version (a new
         write since the reader's last version).
 
-        Polling is adaptive: GIL-yield spins for the first ~2ms (the
-        compiled-DAG hot path is sub-millisecond), then escalating
-        sleeps — latency when it matters, no busy-burn when idle."""
-        t0 = time.monotonic()
-        deadline = t0 + timeout
+        Waiting is futex-based (rts_ch_wait): the reader parks in the
+        kernel on the channel's wake counter and the writer's
+        futex_wake hands control straight over — polling here burned
+        the single core the writer needed (167µs/call compiled-DAG
+        floor came from exactly that). The ctypes call releases the
+        GIL, so other Python threads keep running. The wake counter is
+        sampled BEFORE each version check: a write landing between the
+        check and the wait flips the counter and the wait returns
+        immediately (no missed wakeup)."""
+        deadline = time.monotonic() + timeout
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
+        seen = lib().rts_ch_wait(self._h(), object_id, 0xFFFFFFFF, 0)
         while True:
             v = lib().rts_ch_read(self._h(), object_id,
                                   ctypes.byref(off), ctypes.byref(size))
@@ -249,16 +258,21 @@ class ShmStore:
                     return data, int(v)
             if v == -1:
                 raise ShmStoreError("channel missing")
-            now = time.monotonic()
-            if now > deadline:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise TimeoutError("channel read timed out")
-            waited = now - t0
-            if waited < 0.002:
-                time.sleep(0)        # yield the GIL, stay hot
-            elif waited < 0.05:
-                time.sleep(0.0001)
-            else:
-                time.sleep(0.001)
+            if seen == -1:
+                # Initial sample raced channel creation (the channel
+                # exists now — rts_ch_read just found it): re-sample
+                # without blocking and re-check the version first.
+                seen = lib().rts_ch_wait(self._h(), object_id,
+                                         0xFFFFFFFF, 0)
+                continue
+            # Block until the next write (bounded so the deadline
+            # holds); re-sample the counter for the next iteration.
+            seen = lib().rts_ch_wait(
+                self._h(), object_id, seen,
+                max(1, int(min(remaining, 0.5) * 1000)))
 
     def close(self):
         if self._handle:

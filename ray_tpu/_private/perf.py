@@ -110,6 +110,43 @@ def run_microbenchmarks(quick: bool = False) -> Iterator[str]:
     rate = _rate(put_gigabytes, dur)
     yield f"put_gigabytes_per_second: {rate * 1e6 / 1e9:.3f} GB/s"
 
+    # Compiled-DAG vs dynamic 2-stage call (reference:
+    # _private/ray_perf.py:397-399 compiled DAG benchmarks).
+    from ray_tpu.dag import InputNode, compile_dag
+
+    @ray.remote
+    class Stage:
+        def work(self, x):
+            return x + 1
+
+    s1, s2 = Stage.remote(), Stage.remote()
+
+    def dyn():
+        n = batch // 10
+        for i in range(n):
+            ray.get(s2.work.remote(s1.work.remote(i)))
+        return n
+
+    dyn_rate = _rate(dyn, dur)
+    yield (f"dynamic_2stage_per_second: {dyn_rate:.1f} ops/s "
+           f"({1e6 / dyn_rate:.0f} us/call)")
+
+    with InputNode() as inp:
+        dag = s2.work.bind(s1.work.bind(inp))
+    cdag = compile_dag(dag)
+    assert cdag.execute(1) == 3
+
+    def comp():
+        for i in range(batch):
+            cdag.execute(i)
+        return batch
+
+    comp_rate = _rate(comp, dur)
+    yield (f"compiled_2stage_per_second: {comp_rate:.1f} ops/s "
+           f"({1e6 / comp_rate:.0f} us/call, "
+           f"{comp_rate / dyn_rate:.1f}x over dynamic)")
+    cdag.teardown()
+
     ray.shutdown()
 
 

@@ -276,8 +276,14 @@ class KubeTpuNodeProvider(NodeProvider):
         # Pending create handles → group, and handle → pod aliases
         # once the operator materializes them.
         self._pending: Dict[str, str] = {}
+        self._pending_ts: Dict[str, float] = {}
         self._alias: Dict[str, str] = {}
         self._pending_seq = 0
+        # A pending handle the operator never materializes (quota
+        # exhausted, bad group config) must not count as provisioning
+        # capacity forever: after this grace it is dropped and its
+        # replica bump rolled back so the autoscaler can retry.
+        self.pending_timeout_s = 900.0
 
     # -- Kubernetes API plumbing ---------------------------------------
 
@@ -394,6 +400,7 @@ class KubeTpuNodeProvider(NodeProvider):
         self._pending_seq += 1
         handle = f"pending-{group}-{self._pending_seq}"
         self._pending[handle] = group
+        self._pending_ts[handle] = time.monotonic()
         return handle
 
     def _resolve_pending(self) -> None:
@@ -422,15 +429,19 @@ class KubeTpuNodeProvider(NodeProvider):
             return None  # not materialized yet
         return node_id
 
+    def _drop_pending(self, handle: str) -> None:
+        """Undo a never-materialized handle's replica bump."""
+        group = self._pending.pop(handle)
+        self._pending_ts.pop(handle, None)
+        self._patch_group(group, lambda idx, spec: [
+            {"op": "replace",
+             "path": f"/spec/workerGroupSpecs/{idx}/replicas",
+             "value": max(0, int(spec.get("replicas", 0)) - 1)}])
+
     def terminate_node(self, node_id: str) -> None:
         self._refresh()
         if node_id in self._pending:
-            # Never materialized: just undo the replica bump.
-            group = self._pending.pop(node_id)
-            self._patch_group(group, lambda idx, spec: [
-                {"op": "replace",
-                 "path": f"/spec/workerGroupSpecs/{idx}/replicas",
-                 "value": max(0, int(spec.get("replicas", 0)) - 1)}])
+            self._drop_pending(node_id)
             return
         real = self._alias.pop(node_id, node_id)
         labels = self._label_cache.get(real)
@@ -459,36 +470,55 @@ class KubeTpuNodeProvider(NodeProvider):
 
     def non_terminated_nodes(self) -> List[str]:
         self._refresh()
-        out = []
-        for name, phase in self._phase_cache.items():
-            if phase in ("Running", "Pending") \
-                    and name not in self._alias.values():
-                out.append(name)
+        alive_phase = ("Running", "Pending")
+        aliased = set(self._alias.values())
+        out = [name for name, phase in self._phase_cache.items()
+               if phase in alive_phase and name not in aliased]
         # Resolved handles keep their original id for the autoscaler's
-        # pending-launch bookkeeping; unresolved ones count as alive
-        # (capacity being provisioned).
-        out.extend(self._alias)
-        out.extend(self._pending)
+        # pending-launch bookkeeping — but only while their POD is
+        # alive: a preempted/failed pod behind an alias would otherwise
+        # count as capacity forever and never be replaced.
+        for handle, real in list(self._alias.items()):
+            if self._phase_cache.get(real) in alive_phase:
+                out.append(handle)
+            else:
+                del self._alias[handle]
+        # Unresolved handles count as provisioning capacity within the
+        # grace window; stale ones are dropped (replica bump undone).
+        now = time.monotonic()
+        for handle in list(self._pending):
+            if (now - self._pending_ts.get(handle, now)
+                    > self.pending_timeout_s):
+                self._drop_pending(handle)
+            else:
+                out.append(handle)
         return out
 
     def node_type_of(self, node_id: str) -> str:
-        if node_id in self._pending:
-            return self._pending[node_id]
-        self._refresh()
+        # Served from the caches the last listing filled — reconcile
+        # loops call this once per node right after
+        # non_terminated_nodes(); a list call per lookup would be N+1
+        # API GETs per tick (the GCE provider caches for the same
+        # reason).
         if node_id in self._pending:
             return self._pending[node_id]
         real = self._real_id(node_id)
+        if real is not None and real not in self._label_cache:
+            self._refresh()
+            real = self._real_id(node_id)
         return self._label_cache.get(real or "", {}).get(
             self.GROUP_LABEL, "")
 
     def node_ip(self, node_id: str) -> Optional[str]:
-        self._refresh()
         real = self._real_id(node_id)
+        if real is None or real not in self._ip_cache:
+            self._refresh()
+            real = self._real_id(node_id)
         return self._ip_cache.get(real) if real else None
 
     def wait_ready(self, node_id: str, timeout_s: float = 600.0) -> bool:
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
             self._refresh()
             real = self._real_id(node_id)
             if real and self._phase_cache.get(real) == "Running":

@@ -24,9 +24,14 @@
 #include <cstdio>
 #include <cstring>
 
+#include <climits>
+
 #include <fcntl.h>
+#include <linux/futex.h>
 #include <pthread.h>
 #include <signal.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
 
 #include <algorithm>
 #include <vector>
@@ -36,7 +41,7 @@
 
 namespace {
 
-constexpr uint32_t kMagic = 0x52545054;  // "RTPT" (v2: per-pid pin records)
+constexpr uint32_t kMagic = 0x52545055;  // "RTPU" (v3: pin records + futex channels)
 constexpr uint32_t kIdLen = 28;
 constexpr uint32_t kAlign = 256;
 // Per-slot pin records: enough for the realistic concurrent-pinner
@@ -73,6 +78,11 @@ struct Slot {
   int32_t owner_pid;   // creator, while SLOT_CREATED (crash repair)
   uint64_t owner_start;  // creator's starttime (recycled-pid guard)
   PinRec pinners[kPinnersPerSlot];  // who holds the pins (by pid)
+  // Channel wake counter (futex word): bumped + futex-woken on every
+  // write_release so readers block in the kernel instead of polling —
+  // on single-core hosts a polling reader starves the very writer it
+  // waits for.
+  uint32_t wake_seq;
 };
 
 struct FreeNode {           // free-list node stored at block start
@@ -103,6 +113,22 @@ struct Store {
 };
 
 uint64_t Align(uint64_t n) { return (n + kAlign - 1) & ~uint64_t(kAlign - 1); }
+
+// Cross-process futex on a shared-memory word (NOT FUTEX_PRIVATE).
+long FutexWait(uint32_t* addr, uint32_t expected, int timeout_ms) {
+  struct timespec ts;
+  struct timespec* tp = nullptr;
+  if (timeout_ms >= 0) {
+    ts.tv_sec = timeout_ms / 1000;
+    ts.tv_nsec = static_cast<long>(timeout_ms % 1000) * 1000000L;
+    tp = &ts;
+  }
+  return syscall(SYS_futex, addr, FUTEX_WAIT, expected, tp, nullptr, 0);
+}
+
+void FutexWakeAll(uint32_t* addr) {
+  syscall(SYS_futex, addr, FUTEX_WAKE, INT_MAX, nullptr, nullptr, 0);
+}
 
 uint32_t Hash(const uint8_t* id) {
   // FNV-1a over the 28-byte id.
@@ -641,9 +667,16 @@ int rts_delete(void* handle, const uint8_t* id) {
     pthread_mutex_unlock(&h->mu);
     return -3;
   }
+  bool was_channel = s->state == SLOT_MUTABLE;
   FreeLocked(st, s->offset, s->alloc_size);
   s->state = SLOT_TOMBSTONE;
   h->num_objects--;
+  if (was_channel) {
+    // Unpark blocked readers so they observe the deletion now
+    // instead of waiting out their timeout.
+    __atomic_fetch_add(&s->wake_seq, 1, __ATOMIC_ACQ_REL);
+    FutexWakeAll(&s->wake_seq);
+  }
   pthread_mutex_unlock(&h->mu);
   return 0;
 }
@@ -692,6 +725,7 @@ int rts_ch_create(void* handle, const uint8_t* id, uint64_t max_size,
   s->alloc_size = got;
   s->pins = 0;
   s->version = 0;
+  s->wake_seq = 0;
   memset(s->pinners, 0, sizeof(s->pinners));
   h->num_objects++;
   *offset_out = off;
@@ -726,8 +760,38 @@ int rts_ch_write_release(void* handle, const uint8_t* id) {
     return -1;
   }
   __atomic_fetch_add(&s->version, 1, __ATOMIC_ACQ_REL);  // even: stable
+  __atomic_fetch_add(&s->wake_seq, 1, __ATOMIC_ACQ_REL);
+  FutexWakeAll(&s->wake_seq);
   pthread_mutex_unlock(&h->mu);
   return 0;
+}
+
+// Block until the channel's wake counter departs from `seen` (or
+// timeout_ms elapses; negative = wait forever). Returns the current
+// counter, or -1 if the channel is missing. Readers loop
+// read→wait(seen)→read: `seen` is sampled from THIS call's return, so
+// a write landing between the read and the wait flips the counter and
+// FUTEX_WAIT returns immediately (no missed wakeup). The caller's
+// ctypes FFI releases the GIL, so a blocked reader burns no CPU and
+// the writer's wake hands the core straight over.
+int64_t rts_ch_wait(void* handle, const uint8_t* id, uint32_t seen,
+                    int timeout_ms) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  Slot* s = FindSlot(h, id, false);
+  if (!s || s->state != SLOT_MUTABLE) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint32_t* addr = &s->wake_seq;  // slot table is stable storage
+  pthread_mutex_unlock(&h->mu);
+  uint32_t cur = __atomic_load_n(addr, __ATOMIC_ACQUIRE);
+  if (cur == seen) {
+    FutexWait(addr, seen, timeout_ms);
+    cur = __atomic_load_n(addr, __ATOMIC_ACQUIRE);
+  }
+  return static_cast<int64_t>(cur);
 }
 
 // Snapshot read: returns version (even) + offset/size, or -1 if missing,
