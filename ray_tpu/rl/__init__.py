@@ -27,6 +27,7 @@ from .continuous import (
     SACContinuous,
 )
 from .dqn import DQN, DQNConfig
+from .dreamer import Dreamer, DreamerConfig
 from .env import (
     ENV_REGISTRY,
     CartPole,
@@ -65,4 +66,5 @@ __all__ = [
     "TD3", "DDPG", "ContinuousConfig", "IMPALA", "IMPALAConfig",
     "APPO", "APPOConfig", "MultiAgentPPO", "MultiAgentPPOConfig",
     "BC", "BCConfig", "CQL", "CQLConfig", "OfflineDataset",
+    "Dreamer", "DreamerConfig",
 ]

@@ -1,0 +1,478 @@
+"""Dreamer — model-based RL: learn a latent world model, train the
+policy on imagined rollouts.
+
+Capability-equivalent of the reference's DreamerV3 family (reference:
+rllib/algorithms/dreamerv3/ — RSSM world model, imagination-trained
+actor-critic; the one model-based family RLlib ships). Compact
+TPU-first formulation, all three phases jitted end-to-end:
+
+- **World model** (RSSM): GRU core ``h' = f(h, [z, a])``, Gaussian
+  prior ``p(z'|h')`` and posterior ``q(z'|h', enc(obs'))``, decoder /
+  reward / continue heads. Trained on replayed sequences with
+  reconstruction + reward + continue losses and KL balancing
+  (posterior→prior vs prior→posterior, the DreamerV3 trick that keeps
+  the prior usable for imagination).
+- **Imagination**: from every posterior state of the model batch, the
+  actor rolls the PRIOR forward H steps (lax.scan — no environment,
+  no pixels, pure latent compute: ideal MXU work).
+- **Actor-critic**: λ-returns over imagined rewards/continues;
+  actor ascends them (entropy-regularized, straight-through through
+  the sampled action); critic regresses λ-returns against an EMA
+  target critic.
+
+Simplifications vs full DreamerV3 (documented, deliberate): Gaussian
+latents instead of 32×32 categorical, no symlog/two-hot reward
+transform, MLP encoder/decoder (the proprioceptive envs in rl/env.py
+have no pixels). The model-based FAMILY — world model + imagination
+training — is the capability row this file fills.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .buffer import SequenceReplayBuffer
+from .env import VectorEnv, make_env
+
+
+@dataclass(frozen=True)
+class DreamerConfig:
+    env: Any = "CartPole"
+    num_envs: int = 8
+    rollout_length: int = 32          # env steps per iteration per env
+    seq_len: int = 16                 # world-model training window
+    batch_size: int = 16              # sequences per model batch
+    buffer_capacity: int = 4_000      # steps per env stream
+    learning_starts: int = 200        # steps before updates begin
+
+    deter_dim: int = 64               # GRU (deterministic) state
+    stoch_dim: int = 16               # stochastic latent
+    hidden: int = 64                  # MLP width everywhere
+    free_nats: float = 1.0            # KL floor (don't over-regularize)
+    kl_balance: float = 0.8           # posterior-stopgrad share
+
+    imagine_horizon: int = 10
+    gamma: float = 0.99
+    lam: float = 0.95                 # λ-returns
+    entropy_coef: float = 1e-3
+    critic_ema: float = 0.98
+
+    model_lr: float = 3e-4
+    actor_lr: float = 1e-4
+    critic_lr: float = 3e-4
+    updates_per_iteration: int = 8
+    seed: int = 0
+    train_iterations: int = 30
+
+    def with_overrides(self, **kw) -> "DreamerConfig":
+        return replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Parameter init (plain pytrees, matching rl/module.py's style)
+# ---------------------------------------------------------------------------
+
+def _dense(key, n_in, n_out):
+    k1, _ = jax.random.split(key)
+    scale = jnp.sqrt(2.0 / n_in)
+    return {"w": jax.random.normal(k1, (n_in, n_out)) * scale,
+            "b": jnp.zeros((n_out,))}
+
+
+def _mlp(key, sizes):
+    keys = jax.random.split(key, len(sizes) - 1)
+    return [_dense(k, a, b)
+            for k, a, b in zip(keys, sizes[:-1], sizes[1:])]
+
+
+def _apply_mlp(layers, x, final_act=None):
+    for i, lp in enumerate(layers):
+        x = x @ lp["w"] + lp["b"]
+        if i < len(layers) - 1:
+            x = jax.nn.silu(x)
+        elif final_act is not None:
+            x = final_act(x)
+    return x
+
+
+def init_dreamer_params(cfg: DreamerConfig, obs_dim: int,
+                        num_actions: int, key) -> Dict[str, Any]:
+    ks = jax.random.split(key, 10)
+    D, S, H = cfg.deter_dim, cfg.stoch_dim, cfg.hidden
+    return {
+        "encoder": _mlp(ks[0], (obs_dim, H, H)),
+        # GRU: one fused kernel for reset/update/candidate gates.
+        "gru": {"wx": _dense(ks[1], S + num_actions, 3 * D),
+                "wh": _dense(ks[2], D, 3 * D)},
+        "prior": _mlp(ks[3], (D, H, 2 * S)),
+        "posterior": _mlp(ks[4], (D + H, H, 2 * S)),
+        "decoder": _mlp(ks[5], (D + S, H, obs_dim)),
+        "reward": _mlp(ks[6], (D + S, H, 1)),
+        "cont": _mlp(ks[7], (D + S, H, 1)),
+        "actor": _mlp(ks[8], (D + S, H, num_actions)),
+        "critic": _mlp(ks[9], (D + S, H, 1)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# RSSM pieces
+# ---------------------------------------------------------------------------
+
+def _gru(p, x, h):
+    gx = x @ p["wx"]["w"] + p["wx"]["b"]
+    gh = h @ p["wh"]["w"] + p["wh"]["b"]
+    D = h.shape[-1]
+    r = jax.nn.sigmoid(gx[..., :D] + gh[..., :D])
+    u = jax.nn.sigmoid(gx[..., D:2 * D] + gh[..., D:2 * D])
+    c = jnp.tanh(gx[..., 2 * D:] + r * gh[..., 2 * D:])
+    return u * h + (1 - u) * c
+
+
+def _gaussian(stats):
+    mean, raw_std = jnp.split(stats, 2, axis=-1)
+    std = jax.nn.softplus(raw_std) + 0.1
+    return mean, std
+
+
+def _kl(mean_a, std_a, mean_b, std_b):
+    """KL(N_a || N_b), summed over the latent dim."""
+    var_a, var_b = std_a ** 2, std_b ** 2
+    return 0.5 * jnp.sum(
+        (var_a + (mean_a - mean_b) ** 2) / var_b - 1.0
+        + jnp.log(var_b) - jnp.log(var_a), axis=-1)
+
+
+def _feat(h, z):
+    return jnp.concatenate([h, z], axis=-1)
+
+
+def make_dreamer_update(cfg: DreamerConfig, obs_dim: int,
+                        num_actions: int):
+    model_opt = optax.adam(cfg.model_lr)
+    actor_opt = optax.adam(cfg.actor_lr)
+    critic_opt = optax.adam(cfg.critic_lr)
+
+    def observe(params, obs_seq, act_seq, reset_seq, key):
+        """Filter a (B, L, ...) batch through the RSSM posteriors.
+        Returns features (B, L, D+S) + KL stats."""
+        B = obs_seq.shape[0]
+        embed = _apply_mlp(params["encoder"], obs_seq)       # (B,L,H)
+        h0 = jnp.zeros((B, cfg.deter_dim))
+        z0 = jnp.zeros((B, cfg.stoch_dim))
+        keys = jax.random.split(key, obs_seq.shape[1])
+
+        def step(carry, inp):
+            h, z = carry
+            emb_t, act_t, reset_t, k = inp
+            # Episode boundary: the model must not carry state across
+            # (reset before integrating this step's observation).
+            mask = (1.0 - reset_t)[:, None]
+            h, z = h * mask, z * mask
+            a_1hot = jax.nn.one_hot(act_t, num_actions)
+            h = _gru(params["gru"], jnp.concatenate([z, a_1hot], -1), h)
+            prior_m, prior_s = _gaussian(
+                _apply_mlp(params["prior"], h))
+            post_m, post_s = _gaussian(_apply_mlp(
+                params["posterior"], jnp.concatenate([h, emb_t], -1)))
+            z = post_m + post_s * jax.random.normal(k, post_s.shape)
+            return (h, z), (h, z, prior_m, prior_s, post_m, post_s)
+
+        (_, _), (hs, zs, pm, ps, qm, qs) = jax.lax.scan(
+            step, (h0, z0),
+            (embed.transpose(1, 0, 2), act_seq.T, reset_seq.T, keys))
+        # time-major -> (B, L, ...)
+        sw = lambda x: x.transpose(1, 0, *range(2, x.ndim))  # noqa: E731
+        return (sw(hs), sw(zs)), (sw(pm), sw(ps), sw(qm), sw(qs))
+
+    def model_loss(params, batch, key):
+        obs, act = batch["obs"], batch["actions"]
+        rew, cont = batch["rewards"], 1.0 - batch["dones"]
+        resets = batch["resets"]
+        (hs, zs), (pm, ps, qm, qs) = observe(params, obs, act,
+                                             resets, key)
+        feat = _feat(hs, zs)
+        recon = _apply_mlp(params["decoder"], feat)
+        rhat = _apply_mlp(params["reward"], feat)[..., 0]
+        chat = _apply_mlp(params["cont"], feat)[..., 0]
+        recon_l = jnp.mean(jnp.sum((recon - obs) ** 2, -1))
+        reward_l = jnp.mean((rhat - rew) ** 2)
+        cont_l = jnp.mean(
+            optax.sigmoid_binary_cross_entropy(chat, cont))
+        # KL balancing (DreamerV3): train the prior toward the
+        # posterior more strongly than the reverse.
+        sg = jax.lax.stop_gradient
+        kl_prior = jnp.maximum(
+            jnp.mean(_kl(sg(qm), sg(qs), pm, ps)), cfg.free_nats)
+        kl_post = jnp.maximum(
+            jnp.mean(_kl(qm, qs, sg(pm), sg(ps))), cfg.free_nats)
+        kl = cfg.kl_balance * kl_prior + (1 - cfg.kl_balance) * kl_post
+        loss = recon_l + reward_l + cont_l + kl
+        aux = {"model_loss": loss, "recon_loss": recon_l,
+               "reward_loss": reward_l, "kl": kl,
+               "feat": feat}
+        return loss, aux
+
+    def imagine(params, h0, z0, key):
+        """Roll the PRIOR forward H steps with the current actor.
+        h0/z0: (N, ...) flattened posterior states."""
+        keys = jax.random.split(key, cfg.imagine_horizon)
+
+        def step(carry, k):
+            h, z = carry
+            ka, kz = jax.random.split(k)
+            logits = _apply_mlp(params["actor"], _feat(h, z))
+            a = jax.random.categorical(ka, logits)
+            logp = jax.nn.log_softmax(logits)
+            a_1hot = jax.nn.one_hot(a, num_actions)
+            h = _gru(params["gru"], jnp.concatenate([z, a_1hot], -1), h)
+            m, s = _gaussian(_apply_mlp(params["prior"], h))
+            z = m + s * jax.random.normal(kz, s.shape)
+            ent = -jnp.sum(jnp.exp(logp) * logp, -1)
+            chosen_logp = jnp.take_along_axis(
+                logp, a[:, None], axis=1)[:, 0]
+            return (h, z), (h, z, chosen_logp, ent)
+
+        (_, _), (hs, zs, logps, ents) = jax.lax.scan(
+            step, (h0, z0), keys)
+        return hs, zs, logps, ents  # time-major (H, N, ...)
+
+    def lambda_returns(rewards, conts, values):
+        """(H, N) λ-returns (Dreamer's imagination targets)."""
+        def step(nxt, inp):
+            r, c, v_next = inp
+            ret = r + cfg.gamma * c * (
+                (1 - cfg.lam) * v_next + cfg.lam * nxt)
+            return ret, ret
+
+        last = values[-1]
+        _, rets = jax.lax.scan(
+            step, last,
+            (rewards[:-1], conts[:-1], values[1:]), reverse=True)
+        return rets  # (H-1, N)
+
+    def behavior_loss(ac_params, model_params, target_critic,
+                      feat_flat, key):
+        """Actor + critic losses on imagined rollouts (model frozen).
+        λ-return bootstraps come from the EMA TARGET critic so the
+        live critic is not chasing its own moving bootstrap."""
+        mp = {**model_params, "actor": ac_params["actor"],
+              "critic": ac_params["critic"]}
+        D = cfg.deter_dim
+        h0, z0 = feat_flat[:, :D], feat_flat[:, D:]
+        hs, zs, logps, ents = imagine(mp, h0, z0, key)
+        feat = _feat(hs, zs)                              # (H, N, F)
+        sg = jax.lax.stop_gradient
+        rew = _apply_mlp(mp["reward"], feat)[..., 0]
+        cont = jax.nn.sigmoid(_apply_mlp(mp["cont"], feat)[..., 0])
+        boot = _apply_mlp(target_critic, sg(feat))[..., 0]
+        values = _apply_mlp(ac_params["critic"], sg(feat))[..., 0]
+        rets = lambda_returns(rew, cont, boot)            # (H-1, N)
+        # Discount weights: trajectories fade after predicted episode
+        # ends.
+        w = sg(jnp.cumprod(
+            jnp.concatenate([jnp.ones((1,) + cont.shape[1:]),
+                             cfg.gamma * cont[:-1]], 0), 0))[:-1]
+        # Actor: REINFORCE on the model's differentiable returns with
+        # the critic baseline + entropy bonus.
+        adv = sg(rets - values[:-1])
+        actor_l = -jnp.mean(w * (logps[:-1] * adv
+                                 + cfg.entropy_coef * ents[:-1]))
+        critic_l = jnp.mean(w * (values[:-1] - sg(rets)) ** 2)
+        aux = {"actor_loss": actor_l, "critic_loss": critic_l,
+               "imagined_return": jnp.mean(rets),
+               "entropy": jnp.mean(ents)}
+        return actor_l + critic_l, aux
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def update(state, batch, key):
+        k_model, k_beh = jax.random.split(key)
+        (params, m_opt, ac, a_opt, c_opt, target_critic) = state
+        (_, aux), grads = jax.value_and_grad(
+            model_loss, has_aux=True)(params, batch, k_model)
+        upd, m_opt = model_opt.update(grads, m_opt, params)
+        params = optax.apply_updates(params, upd)
+
+        feat_flat = jax.lax.stop_gradient(
+            aux.pop("feat").reshape(-1, cfg.deter_dim + cfg.stoch_dim))
+        (_, baux), ac_grads = jax.value_and_grad(
+            behavior_loss, has_aux=True)(ac, params, target_critic,
+                                         feat_flat, k_beh)
+        a_upd, a_opt = actor_opt.update(
+            {"actor": ac_grads["actor"]}, a_opt,
+            {"actor": ac["actor"]})
+        c_upd, c_opt = critic_opt.update(
+            {"critic": ac_grads["critic"]}, c_opt,
+            {"critic": ac["critic"]})
+        ac = optax.apply_updates(ac, {**a_upd, **c_upd})
+        target_critic = jax.tree.map(
+            lambda t, o: cfg.critic_ema * t + (1 - cfg.critic_ema) * o,
+            target_critic, ac["critic"])
+        # The live actor/critic ride inside the model params for
+        # collection-side convenience.
+        params = {**params, "actor": ac["actor"],
+                  "critic": ac["critic"]}
+        metrics = {**aux, **baux}
+        return (params, m_opt, ac, a_opt, c_opt, target_critic), metrics
+
+    return update, observe
+
+
+class _LatentCollector:
+    """Steps the vector env acting FROM LATENT STATE (the world-model
+    policy is recurrent: h, z thread across env steps; reset on done)."""
+
+    def __init__(self, cfg: DreamerConfig, num_actions: int):
+        self.cfg = cfg
+        self.num_actions = num_actions
+        self.vec = VectorEnv(lambda: make_env(cfg.env), cfg.num_envs,
+                             seed=cfg.seed)
+        self.h = np.zeros((cfg.num_envs, cfg.deter_dim), np.float32)
+        self.z = np.zeros((cfg.num_envs, cfg.stoch_dim), np.float32)
+        self.prev_action = np.zeros((cfg.num_envs,), np.int32)
+        self.prev_done = np.ones((cfg.num_envs,), np.float32)
+        self._key = jax.random.key(cfg.seed + 1)
+        self._step = self._build_step()
+
+    def _build_step(self):
+        cfg, num_actions = self.cfg, self.num_actions
+
+        @jax.jit
+        def policy_step(params, h, z, obs, prev_a, reset, key):
+            mask = (1.0 - reset)[:, None]
+            h, z = h * mask, z * mask
+            emb = _apply_mlp(params["encoder"], obs)
+            a_1hot = jax.nn.one_hot(prev_a, num_actions) * mask
+            h = _gru(params["gru"],
+                     jnp.concatenate([z, a_1hot], -1), h)
+            m, s = _gaussian(_apply_mlp(
+                params["posterior"], jnp.concatenate([h, emb], -1)))
+            kz, ka = jax.random.split(key)
+            z = m + s * jax.random.normal(kz, s.shape)
+            logits = _apply_mlp(params["actor"], _feat(h, z))
+            a = jax.random.categorical(ka, logits)
+            return h, z, a
+
+        return policy_step
+
+    def collect(self, params, num_steps: int) -> Dict[str, np.ndarray]:
+        obs_l, act_l, rew_l, done_l, reset_l = [], [], [], [], []
+        for _ in range(num_steps):
+            obs = np.asarray(self.vec.observations, np.float32)
+            self._key, k = jax.random.split(self._key)
+            h, z, a = self._step(params, self.h, self.z, obs,
+                                 self.prev_action, self.prev_done, k)
+            self.h, self.z = np.asarray(h), np.asarray(z)
+            actions = np.asarray(a)
+            _, rewards, dones = self.vec.step(actions)
+            obs_l.append(obs)
+            act_l.append(actions)
+            rew_l.append(np.asarray(rewards, np.float32))
+            done_l.append(np.asarray(dones, np.float32))
+            reset_l.append(self.prev_done.copy())
+            self.prev_action = actions
+            self.prev_done = np.asarray(dones, np.float32)
+        return {
+            "obs": np.stack(obs_l),
+            "actions": np.stack(act_l),
+            "rewards": np.stack(rew_l),
+            "dones": np.stack(done_l),
+            # 1.0 where a NEW episode starts at this step (the RSSM
+            # must drop carried state there).
+            "resets": np.stack(reset_l),
+            "episode_returns": np.asarray(
+                self.vec.pop_episode_returns(), np.float32),
+        }
+
+
+class Dreamer(Algorithm):
+    """Model-based RL via latent imagination (reference:
+    rllib/algorithms/dreamerv3/dreamerv3.py)."""
+
+    def setup(self):
+        cfg = self.config
+        probe = make_env(cfg.env)
+        self.obs_dim = int(probe.observation_size)
+        self.num_actions = int(probe.num_actions)
+        self.collector = _LatentCollector(cfg, self.num_actions)
+        key = jax.random.key(cfg.seed)
+        self.params = init_dreamer_params(
+            cfg, self.obs_dim, self.num_actions, key)
+        model_opt = optax.adam(cfg.model_lr)
+        actor_opt = optax.adam(cfg.actor_lr)
+        critic_opt = optax.adam(cfg.critic_lr)
+        ac = {"actor": self.params["actor"],
+              "critic": self.params["critic"]}
+        self._state = (
+            self.params, model_opt.init(self.params), ac,
+            actor_opt.init({"actor": ac["actor"]}),
+            critic_opt.init({"critic": ac["critic"]}),
+            jax.tree.map(jnp.copy, ac["critic"]))
+        self.update, _ = make_dreamer_update(
+            cfg, self.obs_dim, self.num_actions)
+        self.buffer = SequenceReplayBuffer(
+            cfg.buffer_capacity, cfg.num_envs, cfg.seq_len,
+            seed=cfg.seed)
+        self._key = jax.random.key(cfg.seed + 2)
+        self.total_env_steps = 0
+        self._returns: list = []
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.monotonic()
+        rollout = self.collector.collect(self._state[0],
+                                         cfg.rollout_length)
+        returns = rollout.pop("episode_returns")
+        self._returns.extend(returns.tolist())
+        self.buffer.add_rollout(rollout)
+        self.total_env_steps += cfg.rollout_length * cfg.num_envs
+
+        metrics: Dict[str, Any] = {}
+        if self.total_env_steps >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.batch_size)
+                self._key, k = jax.random.split(self._key)
+                self._state, m = self.update(
+                    self._state,
+                    {n: jnp.asarray(v) for n, v in batch.items()},
+                    k)
+            metrics = {n: float(v) for n, v in m.items()}
+        recent = self._returns[-20:]
+        metrics.update({
+            "env_steps": self.total_env_steps,
+            "episodes": len(self._returns),
+            "episode_return_mean":
+                float(np.mean(recent)) if recent else 0.0,
+            "time_s": time.monotonic() - t0,
+        })
+        return metrics
+
+    # -- checkpointing -------------------------------------------------
+    def get_state(self):
+        return {"iteration": self.iteration,
+                "state": jax.device_get(self._state),
+                "total_env_steps": self.total_env_steps}
+
+    def set_state(self, state):
+        self.iteration = state["iteration"]
+        self._state = jax.device_put(state["state"])
+        self.total_env_steps = state["total_env_steps"]
+
+    def compute_single_action(self, obs: np.ndarray) -> int:
+        obs = np.asarray(obs, np.float32)[None]
+        self.collector._key, k = jax.random.split(self.collector._key)
+        h, z, a = self.collector._step(
+            self._state[0],
+            np.zeros((1, self.config.deter_dim), np.float32),
+            np.zeros((1, self.config.stoch_dim), np.float32),
+            obs, np.zeros((1,), np.int32),
+            np.ones((1,), np.float32), k)
+        return int(np.asarray(a)[0])
