@@ -81,17 +81,14 @@ class DreamerConfig:
 # Parameter init (plain pytrees, matching rl/module.py's style)
 # ---------------------------------------------------------------------------
 
-def _dense(key, n_in, n_out):
-    k1, _ = jax.random.split(key)
-    scale = jnp.sqrt(2.0 / n_in)
-    return {"w": jax.random.normal(k1, (n_in, n_out)) * scale,
-            "b": jnp.zeros((n_out,))}
-
-
 def _mlp(key, sizes):
-    keys = jax.random.split(key, len(sizes) - 1)
-    return [_dense(k, a, b)
-            for k, a, b in zip(keys, sizes[:-1], sizes[1:])]
+    from .module import mlp_init  # THE shared He-init stack
+
+    return mlp_init(key, sizes)
+
+
+def _dense(key, n_in, n_out):
+    return _mlp(key, (n_in, n_out))[0]
 
 
 def _apply_mlp(layers, x, final_act=None):
@@ -161,9 +158,10 @@ def make_dreamer_update(cfg: DreamerConfig, obs_dim: int,
     actor_opt = optax.adam(cfg.actor_lr)
     critic_opt = optax.adam(cfg.critic_lr)
 
-    def observe(params, obs_seq, act_seq, reset_seq, key):
+    def observe(params, obs_seq, prev_act_seq, reset_seq, key):
         """Filter a (B, L, ...) batch through the RSSM posteriors.
-        Returns features (B, L, D+S) + KL stats."""
+        prev_act_seq[t] is the action taken at step t-1 (recorded by
+        the collector). Returns features (B, L, D+S) + KL stats."""
         B = obs_seq.shape[0]
         embed = _apply_mlp(params["encoder"], obs_seq)       # (B,L,H)
         h0 = jnp.zeros((B, cfg.deter_dim))
@@ -172,12 +170,16 @@ def make_dreamer_update(cfg: DreamerConfig, obs_dim: int,
 
         def step(carry, inp):
             h, z = carry
-            emb_t, act_t, reset_t, k = inp
+            emb_t, prev_a_t, reset_t, k = inp
             # Episode boundary: the model must not carry state across
             # (reset before integrating this step's observation).
             mask = (1.0 - reset_t)[:, None]
             h, z = h * mask, z * mask
-            a_1hot = jax.nn.one_hot(act_t, num_actions)
+            # PREVIOUS action (the one that produced this observation)
+            # — the same convention as the collector and imagination;
+            # conditioning on the action chosen AFTER seeing obs_t
+            # would leak the future into the prediction of obs_t.
+            a_1hot = jax.nn.one_hot(prev_a_t, num_actions) * mask
             h = _gru(params["gru"], jnp.concatenate([z, a_1hot], -1), h)
             prior_m, prior_s = _gaussian(
                 _apply_mlp(params["prior"], h))
@@ -188,16 +190,17 @@ def make_dreamer_update(cfg: DreamerConfig, obs_dim: int,
 
         (_, _), (hs, zs, pm, ps, qm, qs) = jax.lax.scan(
             step, (h0, z0),
-            (embed.transpose(1, 0, 2), act_seq.T, reset_seq.T, keys))
+            (embed.transpose(1, 0, 2), prev_act_seq.T, reset_seq.T,
+             keys))
         # time-major -> (B, L, ...)
         sw = lambda x: x.transpose(1, 0, *range(2, x.ndim))  # noqa: E731
         return (sw(hs), sw(zs)), (sw(pm), sw(ps), sw(qm), sw(qs))
 
     def model_loss(params, batch, key):
-        obs, act = batch["obs"], batch["actions"]
+        obs, prev_act = batch["obs"], batch["prev_actions"]
         rew, cont = batch["rewards"], 1.0 - batch["dones"]
         resets = batch["resets"]
-        (hs, zs), (pm, ps, qm, qs) = observe(params, obs, act,
+        (hs, zs), (pm, ps, qm, qs) = observe(params, obs, prev_act,
                                              resets, key)
         feat = _feat(hs, zs)
         recon = _apply_mlp(params["decoder"], feat)
@@ -364,10 +367,12 @@ class _LatentCollector:
         return policy_step
 
     def collect(self, params, num_steps: int) -> Dict[str, np.ndarray]:
-        obs_l, act_l, rew_l, done_l, reset_l = [], [], [], [], []
+        obs_l, act_l, prev_l, rew_l, done_l, reset_l = \
+            [], [], [], [], [], []
         for _ in range(num_steps):
             obs = np.asarray(self.vec.observations, np.float32)
             self._key, k = jax.random.split(self._key)
+            prev_l.append(self.prev_action.copy())
             h, z, a = self._step(params, self.h, self.z, obs,
                                  self.prev_action, self.prev_done, k)
             self.h, self.z = np.asarray(h), np.asarray(z)
@@ -383,6 +388,9 @@ class _LatentCollector:
         return {
             "obs": np.stack(obs_l),
             "actions": np.stack(act_l),
+            # Action taken at t-1 — what the RSSM conditions the
+            # transition INTO step t on (masked at resets).
+            "prev_actions": np.stack(prev_l),
             "rewards": np.stack(rew_l),
             "dones": np.stack(done_l),
             # 1.0 where a NEW episode starts at this step (the RSSM
@@ -437,6 +445,7 @@ class Dreamer(Algorithm):
 
         metrics: Dict[str, Any] = {}
         if self.total_env_steps >= cfg.learning_starts:
+            m = None
             for _ in range(cfg.updates_per_iteration):
                 batch = self.buffer.sample(cfg.batch_size)
                 self._key, k = jax.random.split(self._key)
@@ -444,7 +453,8 @@ class Dreamer(Algorithm):
                     self._state,
                     {n: jnp.asarray(v) for n, v in batch.items()},
                     k)
-            metrics = {n: float(v) for n, v in m.items()}
+            if m is not None:
+                metrics = {n: float(v) for n, v in m.items()}
         recent = self._returns[-20:]
         metrics.update({
             "env_steps": self.total_env_steps,

@@ -5,7 +5,8 @@ from .step import TrainState, init_state, make_optimizer, make_train_step
 from .trainer import Result, TpuTrainer
 
 __all__ = [
-    "TpuTrainer", "TorchTrainer", "TransformersTrainer", "Result",
+    "TpuTrainer", "TorchTrainer", "TensorflowTrainer",
+    "TransformersTrainer", "Result",
     "ScalingConfig", "RunConfig", "FailureConfig",
     "CheckpointConfig", "Checkpoint", "CheckpointManager", "save_pytree",
     "load_pytree", "report", "get_context", "get_dataset_shard", "get_mesh",
@@ -20,6 +21,10 @@ def __getattr__(name):
         from .torch import TorchTrainer
 
         return TorchTrainer
+    if name == "TensorflowTrainer":
+        from .tensorflow import TensorflowTrainer
+
+        return TensorflowTrainer
     if name == "TransformersTrainer":
         from .huggingface import TransformersTrainer
 

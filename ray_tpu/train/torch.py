@@ -20,9 +20,8 @@ import inspect
 import socket
 from typing import Any, Callable, Dict, Optional
 
-from ..core.task import NodeAffinitySchedulingStrategy
 from .config import RunConfig, ScalingConfig
-from .trainer import Result, TpuTrainer
+from .trainer import ProcessPlaneTrainerMixin, Result, TpuTrainer
 
 
 class TorchConfig:
@@ -73,7 +72,7 @@ def _make_torch_loop(user_fn: Callable, backend: str, addr: str,
     return loop
 
 
-class TorchTrainer(TpuTrainer):
+class TorchTrainer(ProcessPlaneTrainerMixin, TpuTrainer):
     """TorchTrainer(train_loop_per_worker, scaling_config=
     ScalingConfig(num_workers=N)).fit() — the reference surface.
 
@@ -93,24 +92,10 @@ class TorchTrainer(TpuTrainer):
                          run_config=run_config, datasets=datasets)
         self.torch_config = torch_config or TorchConfig()
         self._user_loop = train_loop_per_worker
-        # Hard placement on the spawned-worker node: every rank is its
-        # own OS process there.
-        self._strategy_factory = lambda rank: \
-            NodeAffinitySchedulingStrategy(node_id="node-procs",
-                                           soft=False)
+        self._init_process_plane()
 
     def fit(self) -> Result:
-        from ..core.runtime import global_runtime
-
-        rt = global_runtime()
-        n = self.scaling_config.num_workers
-        if rt.worker_pool is None or rt.worker_pool.num_workers < n:
-            have = 0 if rt.worker_pool is None \
-                else rt.worker_pool.num_workers
-            raise RuntimeError(
-                f"TorchTrainer needs {n} worker processes (gloo process "
-                f"groups are per-process) but the runtime has {have}; "
-                f"call ray_tpu.init(num_worker_procs={n})")
+        self._require_worker_procs("TorchTrainer")
         return super().fit()
 
     def _fit_once(self) -> Result:

@@ -249,3 +249,31 @@ class TpuTrainer:
             path=storage,
             metrics_history=history,
         )
+
+
+class ProcessPlaneTrainerMixin:
+    """Shared scaffolding for trainers whose ranks each need their own
+    OS process (torch gloo process groups, TF collective servers).
+    Rank actors run as DEDICATED worker processes (worker_proc.py
+    spawn_dedicated) that die with the actor — every fit attempt gets
+    fresh processes, which is what lets frameworks with no in-process
+    teardown (TF) re-rendezvous on retries."""
+
+    def _init_process_plane(self) -> None:
+        from ..core.task import NodeAffinitySchedulingStrategy
+
+        self._strategy_factory = lambda rank: \
+            NodeAffinitySchedulingStrategy(node_id="node-procs",
+                                           soft=False)
+
+    def _require_worker_procs(self, what: str) -> "None":
+        from ..core.runtime import global_runtime
+
+        rt = global_runtime()
+        n = self.scaling_config.num_workers
+        if rt.worker_pool is None or rt.worker_pool.num_workers < n:
+            have = 0 if rt.worker_pool is None \
+                else rt.worker_pool.num_workers
+            raise RuntimeError(
+                f"{what} needs {n} worker processes but the runtime "
+                f"has {have}; call ray_tpu.init(num_worker_procs={n})")
