@@ -59,17 +59,22 @@ class DreamerConfig:
     hidden: int = 64                  # MLP width everywhere
     free_nats: float = 1.0            # KL floor (don't over-regularize)
     kl_balance: float = 0.8           # posterior-stopgrad share
+    cont_pos_weight: float = 10.0     # upweight rare termination steps
 
-    imagine_horizon: int = 10
-    gamma: float = 0.99
+    # Defaults = the recipe validated on CartPole: a short horizon and
+    # strong entropy keep the actor from exploiting world-model error
+    # (imagined returns outrunning anything achievable) and from
+    # collapsing to one action before the model is trustworthy.
+    imagine_horizon: int = 8
+    gamma: float = 0.95
     lam: float = 0.95                 # λ-returns
-    entropy_coef: float = 1e-3
+    entropy_coef: float = 0.03
     critic_ema: float = 0.98
 
     model_lr: float = 3e-4
-    actor_lr: float = 1e-4
+    actor_lr: float = 3e-4
     critic_lr: float = 3e-4
-    updates_per_iteration: int = 8
+    updates_per_iteration: int = 12
     seed: int = 0
     train_iterations: int = 30
 
@@ -208,8 +213,14 @@ def make_dreamer_update(cfg: DreamerConfig, obs_dim: int,
         chat = _apply_mlp(params["cont"], feat)[..., 0]
         recon_l = jnp.mean(jnp.sum((recon - obs) ** 2, -1))
         reward_l = jnp.mean((rhat - rew) ** 2)
+        # Termination examples are rare (one per episode, and sequence
+        # windows put them only at window ends) yet in constant-reward
+        # envs the continue head is the ONLY state-quality signal —
+        # upweight them or the head collapses to "always continues"
+        # and imagination rewards pure fantasy.
+        cont_w = 1.0 + cfg.cont_pos_weight * (1.0 - cont)
         cont_l = jnp.mean(
-            optax.sigmoid_binary_cross_entropy(chat, cont))
+            cont_w * optax.sigmoid_binary_cross_entropy(chat, cont))
         # KL balancing (DreamerV3): train the prior toward the
         # posterior more strongly than the reverse.
         sg = jax.lax.stop_gradient
@@ -226,7 +237,13 @@ def make_dreamer_update(cfg: DreamerConfig, obs_dim: int,
 
     def imagine(params, h0, z0, key):
         """Roll the PRIOR forward H steps with the current actor.
-        h0/z0: (N, ...) flattened posterior states."""
+        h0/z0: (N, ...) flattened posterior states. Emits the
+        PRE-ACTION state at each index: states[t] is where action t
+        (logps[t]/ents[t]) was chosen — the only convention under
+        which V(states[t]) is a valid REINFORCE baseline for action t
+        (a post-action emission silently turns the advantage into
+        r_t + (γ−1)·V(s_{t+1}), which REWARDS reaching low-value
+        states)."""
         keys = jax.random.split(key, cfg.imagine_horizon)
 
         def step(carry, k):
@@ -235,21 +252,25 @@ def make_dreamer_update(cfg: DreamerConfig, obs_dim: int,
             logits = _apply_mlp(params["actor"], _feat(h, z))
             a = jax.random.categorical(ka, logits)
             logp = jax.nn.log_softmax(logits)
-            a_1hot = jax.nn.one_hot(a, num_actions)
-            h = _gru(params["gru"], jnp.concatenate([z, a_1hot], -1), h)
-            m, s = _gaussian(_apply_mlp(params["prior"], h))
-            z = m + s * jax.random.normal(kz, s.shape)
             ent = -jnp.sum(jnp.exp(logp) * logp, -1)
             chosen_logp = jnp.take_along_axis(
                 logp, a[:, None], axis=1)[:, 0]
-            return (h, z), (h, z, chosen_logp, ent)
+            a_1hot = jax.nn.one_hot(a, num_actions)
+            h2 = _gru(params["gru"],
+                      jnp.concatenate([z, a_1hot], -1), h)
+            m, s = _gaussian(_apply_mlp(params["prior"], h2))
+            z2 = m + s * jax.random.normal(kz, s.shape)
+            return (h2, z2), (h, z, chosen_logp, ent)
 
         (_, _), (hs, zs, logps, ents) = jax.lax.scan(
             step, (h0, z0), keys)
         return hs, zs, logps, ents  # time-major (H, N, ...)
 
     def lambda_returns(rewards, conts, values):
-        """(H, N) λ-returns (Dreamer's imagination targets)."""
+        """λ-returns from each pre-action state. rewards/conts are the
+        (H-1,) per-transition arrival predictions; values the (H,)
+        per-state bootstraps. rets[t] = return of taking action t at
+        states[t]."""
         def step(nxt, inp):
             r, c, v_next = inp
             ret = r + cfg.gamma * c * (
@@ -258,8 +279,7 @@ def make_dreamer_update(cfg: DreamerConfig, obs_dim: int,
 
         last = values[-1]
         _, rets = jax.lax.scan(
-            step, last,
-            (rewards[:-1], conts[:-1], values[1:]), reverse=True)
+            step, last, (rewards, conts, values[1:]), reverse=True)
         return rets  # (H-1, N)
 
     def behavior_loss(ac_params, model_params, target_critic,
@@ -272,21 +292,30 @@ def make_dreamer_update(cfg: DreamerConfig, obs_dim: int,
         D = cfg.deter_dim
         h0, z0 = feat_flat[:, :D], feat_flat[:, D:]
         hs, zs, logps, ents = imagine(mp, h0, z0, key)
-        feat = _feat(hs, zs)                              # (H, N, F)
+        feat = _feat(hs, zs)                    # (H, N, F) pre-action
         sg = jax.lax.stop_gradient
-        rew = _apply_mlp(mp["reward"], feat)[..., 0]
-        cont = jax.nn.sigmoid(_apply_mlp(mp["cont"], feat)[..., 0])
-        boot = _apply_mlp(target_critic, sg(feat))[..., 0]
-        values = _apply_mlp(ac_params["critic"], sg(feat))[..., 0]
-        rets = lambda_returns(rew, cont, boot)            # (H-1, N)
+        # DEPARTURE convention, matching how model_loss trains the
+        # heads on replay (reward(s_t) ≈ r_t, cont(s_t) ≈ 1-done_t —
+        # the outcome of acting FROM s_t; the terminal successor
+        # observation is never stored, so the heads flag the
+        # pre-terminal state). Querying successors instead would gate
+        # termination one step late through an imagined post-terminal
+        # state the prior was never trained past.
+        rew = _apply_mlp(mp["reward"], feat[:-1])[..., 0]    # (H-1, N)
+        cont = jax.nn.sigmoid(
+            _apply_mlp(mp["cont"], feat[:-1])[..., 0])       # (H-1, N)
+        boot = _apply_mlp(target_critic, sg(feat))[..., 0]   # (H, N)
+        values = _apply_mlp(ac_params["critic"],
+                            sg(feat))[..., 0]                # (H, N)
+        rets = lambda_returns(rew, cont, boot)               # (H-1, N)
         # Discount weights: trajectories fade after predicted episode
-        # ends.
+        # ends (product of γ·cont over the transitions BEFORE step t).
         w = sg(jnp.cumprod(
             jnp.concatenate([jnp.ones((1,) + cont.shape[1:]),
-                             cfg.gamma * cont[:-1]], 0), 0))[:-1]
-        # Actor: REINFORCE on the model's differentiable returns with
-        # the critic baseline + entropy bonus.
-        adv = sg(rets - values[:-1])
+                             cfg.gamma * cont[:-1]], 0), 0))
+        # Actor: REINFORCE with the pre-action-state critic baseline
+        # + entropy bonus.
+        adv = sg(rets - boot[:-1])
         actor_l = -jnp.mean(w * (logps[:-1] * adv
                                  + cfg.entropy_coef * ents[:-1]))
         critic_l = jnp.mean(w * (values[:-1] - sg(rets)) ** 2)
