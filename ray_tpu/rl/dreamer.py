@@ -267,8 +267,10 @@ def make_dreamer_update(cfg: DreamerConfig, obs_dim: int,
         return hs, zs, logps, ents  # time-major (H, N, ...)
 
     def lambda_returns(rewards, conts, values):
-        """λ-returns from each pre-action state. rewards/conts are the
-        (H-1,) per-transition arrival predictions; values the (H,)
+        """λ-returns from each pre-action state. DEPARTURE convention
+        (matches behavior_loss): rewards[t]/conts[t] are the reward-head
+        outputs at the state the agent acts FROM — reward(s_t) ~ r_t,
+        heads queried at feat[:-1], shape (H-1,); values the (H,)
         per-state bootstraps. rets[t] = return of taking action t at
         states[t]."""
         def step(nxt, inp):

@@ -6,7 +6,8 @@ from .trainer import Result, TpuTrainer
 
 __all__ = [
     "TpuTrainer", "TorchTrainer", "TensorflowTrainer",
-    "TransformersTrainer", "Result",
+    "TransformersTrainer", "XGBoostTrainer", "LightGBMTrainer",
+    "GBDTTrainer", "Result",
     "ScalingConfig", "RunConfig", "FailureConfig",
     "CheckpointConfig", "Checkpoint", "CheckpointManager", "save_pytree",
     "load_pytree", "report", "get_context", "get_dataset_shard", "get_mesh",
@@ -29,4 +30,8 @@ def __getattr__(name):
         from .huggingface import TransformersTrainer
 
         return TransformersTrainer
+    if name in ("XGBoostTrainer", "LightGBMTrainer", "GBDTTrainer"):
+        from . import gbdt
+
+        return getattr(gbdt, name)
     raise AttributeError(name)

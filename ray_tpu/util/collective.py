@@ -276,10 +276,22 @@ def get_collective_group_size(group_name: str = "default") -> int:
     return _get(group_name).world_size
 
 
-def destroy_collective_group(group_name: str = "default") -> None:
-    """Leave + tear down the local view (the coordinator dies with the
-    runtime; reference: collective.py destroy_collective_group :217)."""
-    _groups().pop(group_name, None)
+def destroy_collective_group(group_name: str = "default", *,
+                             release_coordinator: bool = False) -> None:
+    """Leave + tear down the local view (reference: collective.py
+    destroy_collective_group :217). With ``release_coordinator`` the named
+    coordinator actor is killed too — exactly one member (by convention
+    rank 0, after a barrier) should pass it, or other members' in-flight
+    rounds die with it. Without it, the detached coordinator lives until
+    runtime shutdown."""
+    g = _groups().pop(group_name, None)
+    if release_coordinator and g is not None:
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(g.coord)
+        except Exception:  # noqa: BLE001 — already dead / runtime down
+            pass
 
 
 def _get(group_name: str) -> _GroupHandle:
