@@ -315,6 +315,11 @@ class RemotePlane:
                           and not _is_constrained(
                               spec.scheduling_strategy)),
         }
+        excl = getattr(spec, "_spill_excluded", None)
+        if msg["spillable"] and excl:
+            # Nodes that already refused this task: a refusing daemon's
+            # redirect must not bounce it back to one of them.
+            msg["spill_exclude"] = sorted(excl)
         if streaming and spec.task_id in self.rt._generators:
             # Live consumer only — reconstruction re-runs have nobody
             # sending credits; a watermark would deadlock the worker.
@@ -385,6 +390,16 @@ class RemotePlane:
                 # hybrid_scheduling_policy.h:50).
                 released = True
                 load = reply.get("load") or {}
+                excl = getattr(spec, "_spill_excluded", None) or set()
+                excl.add(node.node_id)
+                spec._spill_excluded = excl
+                # Honor the daemon's redirect (reference: the client
+                # retries AT retry_at_raylet_address): the refuser's view
+                # of the cluster is usually fresher than ours — the
+                # scheduler tries the named node first on reschedule.
+                hint = reply.get("retry_at")
+                if hint and hint not in excl:
+                    spec._spill_hint = hint
                 rt.scheduler.apply_spill_refusal(
                     spec, node.node_id,
                     ResourceSet(load.get("available") or {}),

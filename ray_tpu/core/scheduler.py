@@ -299,12 +299,38 @@ class Scheduler:
                     return node
             return None
 
+        # Spillback redirect (reference: client retry at the refusal's
+        # retry_at_raylet_address): a daemon that refused this task named
+        # a better node off its own, fresher view — try it first. The
+        # hint is consumed whether or not it lands, so a stale redirect
+        # can't pin the task.
+        hint = getattr(spec, "_spill_hint", None)
+        if hint is not None:
+            spec._spill_hint = None
+            node = self._nodes.get(hint)
+            # Deliberately NO local fits() check: our own view of the
+            # hinted node may be the stale thing that caused the refusal.
+            # The target daemon re-checks admission and can refuse again
+            # (with the refuser now excluded), so a bad hint costs one
+            # round-trip, not correctness.
+            if (node is not None and node.alive and node.schedulable
+                    and _labels_match(spec, node)):
+                return node
+
         fitting = [
             n for n in self._nodes.values()
             if n.alive and n.schedulable
             and spec.resources.fits(n.available)
         ]
         fitting = [n for n in fitting if _labels_match(spec, n)]
+        excluded = getattr(spec, "_spill_excluded", None)
+        if excluded:
+            # Prefer nodes that haven't refused this task; fall back to
+            # them only when nothing else fits (their capacity may have
+            # freed since the refusal).
+            fresh = [n for n in fitting if n.node_id not in excluded]
+            if fresh:
+                fitting = fresh
         if not fitting:
             return None
 

@@ -41,7 +41,7 @@ import socket
 import threading
 import time
 import uuid
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("ray_tpu.node")
 
@@ -141,6 +141,11 @@ class NodeDaemon:
         self._spilled = 0         # spillable tasks refused (stats)
         self._host_stats_cache: Dict[str, Any] = {}
         self._host_stats_ts = -1e9
+        # Peer view for spillback redirection (control-plane node table +
+        # heartbeat loads), refreshed lazily on refusal.
+        self._peer_view: List[dict] = []
+        self._peer_view_ts = -1e9
+        self._peer_view_lock = threading.Lock()
 
         # Actors hosted here: actor_id(bytes) -> dedicated WorkerProcess.
         self._actors: Dict[bytes, Any] = {}
@@ -236,6 +241,52 @@ class NodeDaemon:
                 "spilled": self._spilled,
                 "host": host,
             }
+
+    def _recommend_spill_target(self, res, exclude) -> Optional[str]:
+        """Pick a feasible peer for a refused task off the control-plane
+        node table (reference: the raylet's cluster view backing
+        retry_at_raylet_address selection, hybrid_scheduling_policy.h:50).
+        Returns a node_id or None. The view is cached briefly — refusals
+        are rare, but a refusal burst (many racing drivers) must not turn
+        into a list_nodes stampede."""
+        from ray_tpu.core.resources import ResourceSet
+
+        exclude = set(exclude) | {self.node_id}
+        now = time.monotonic()
+        with self._peer_view_lock:
+            if now - self._peer_view_ts > 0.5 * self._hb_interval + 0.1:
+                try:
+                    self._peer_view = self.control.list_nodes()
+                    self._peer_view_ts = now
+                except Exception:  # noqa: BLE001 — control plane briefly away
+                    return None
+            peers = list(self._peer_view)
+        best = None
+        best_score = None
+        for n in peers:
+            if not n.get("alive") or n.get("draining"):
+                continue
+            nid = n.get("node_id")
+            if not nid or nid in exclude:
+                continue
+            try:
+                load = json.loads(n["load"]) if n.get("load") else {}
+            except (ValueError, TypeError):
+                continue
+            avail = ResourceSet(load.get("available") or {})
+            if not res.fits(avail):
+                continue
+            # Least queued first, then most NORMALIZED headroom — raw
+            # sums would let byte-denominated resources (memory) dwarf
+            # CPU/TPU counts.
+            total = ResourceSet(load.get("total") or {}).to_dict()
+            av = avail.to_dict()
+            fracs = [av.get(k, 0.0) / v for k, v in total.items() if v > 0]
+            headroom = sum(fracs) / len(fracs) if fracs else 0.0
+            score = (-(load.get("queued") or 0), headroom)
+            if best_score is None or score > best_score:
+                best, best_score = nid, score
+        return best
 
     def _hb_loop(self):
         while not self._stop.wait(self._hb_interval):
@@ -425,6 +476,7 @@ class NodeDaemon:
         max_calls = msg.pop("max_calls", 0)
         retriable = msg.pop("retriable", False)
         spillable = msg.pop("spillable", False)
+        spill_exclude = msg.pop("spill_exclude", None) or []
         fn_bytes = msg.pop("fn", None)
         fid = msg.get("fid")
         if fn_bytes is not None and fid is not None:
@@ -455,9 +507,16 @@ class NodeDaemon:
                 else:
                     self._spilled += 1
             if not ok:
+                # Refuse WITH a redirect (reference: the spillback reply's
+                # retry_at_raylet_address, node_manager.proto:365-379): this
+                # daemon names a feasible peer off its OWN control-plane
+                # view — usually fresher than the refused driver's, and the
+                # exclude list prevents refusal ping-pong.
                 send_msg(conn, {"type": "result",
                                 "task_id": msg.get("task_id"),
                                 "spillback": True,
+                                "retry_at": self._recommend_spill_target(
+                                    res, set(spill_exclude)),
                                 "load": self._load_report()})
                 return
             precharged = True

@@ -372,3 +372,130 @@ print(ray.get(ref), flush=True)
                     "daemon-1 never refused a raced push in 5 attempts")
         finally:
             saturator.wait(timeout=30)
+
+
+class TestSpillbackRedirect:
+    """Refuse-with-redirect (reference: the spillback reply's
+    retry_at_raylet_address, node_manager.proto:365-379): a refusing
+    daemon names a feasible peer off its own control-plane view, the
+    driver retries there first, and the task's exclude list prevents
+    refusal ping-pong."""
+
+    @pytest.fixture(scope="class")
+    def redirect_cluster(self):
+        ray.shutdown()
+        cluster = RealCluster()
+        try:
+            cluster.add_node(num_cpus=1)  # daemon-1
+            cluster.add_node(num_cpus=1)  # daemon-2
+            cluster.add_node(num_cpus=1)  # daemon-3
+            cluster.connect(num_cpus=0)
+            yield cluster
+        finally:
+            cluster.shutdown()
+
+    def _saturate(self, node_id, hold_s):
+        from ray_tpu import NodeAffinitySchedulingStrategy
+
+        @ray.remote(num_cpus=1, scheduling_strategy=(
+            NodeAffinitySchedulingStrategy(node_id, soft=False)))
+        def hold(s):
+            time.sleep(s)
+            return "held"
+
+        return hold.remote(hold_s)
+
+    def test_refusal_reply_names_feasible_peer(self, redirect_cluster):
+        """Protocol-level: a crafted spillable push to a saturated daemon
+        is refused with retry_at pointing at an idle peer, honoring the
+        exclude list."""
+        holder = self._saturate("daemon-1", 8.0)
+        time.sleep(0.6)  # reach daemon-1's worker + one heartbeat cycle
+        node1 = _rt().scheduler.get_node("daemon-1")
+
+        def push(exclude):
+            return node1.client.call({
+                "type": "task", "task_id": b"probe-redirect",
+                "args": (), "kwargs": {}, "num_returns": 1,
+                "return_ids": [], "resources": {"CPU": 1.0},
+                "spillable": True, "spill_exclude": exclude,
+            })
+
+        r = push([])
+        assert r.get("spillback") is True
+        assert r.get("retry_at") in ("daemon-2", "daemon-3")
+        r2 = push(["daemon-2"])
+        assert r2.get("spillback") is True
+        assert r2.get("retry_at") == "daemon-3"
+        r3 = push(["daemon-2", "daemon-3"])
+        assert r3.get("spillback") is True
+        assert r3.get("retry_at") is None  # nothing feasible: plain refusal
+        ray.get(holder, timeout=30)
+
+    def test_redirect_end_to_end(self, redirect_cluster):
+        """daemon-1 saturated by a SECOND OS-process driver (its usage is
+        foreign, so this driver's view can be forced stale), daemon-2
+        saturated by us, driver's view forced to 'daemon-1 free, daemon-3
+        busy': the push to daemon-1 is refused with retry_at=daemon-3 and
+        the task must land there without waiting out the hold."""
+        import subprocess
+        import sys
+
+        from ray_tpu.core.resources import ResourceSet
+
+        hold_s = 6.0
+        saturator = subprocess.Popen(
+            [sys.executable, "-c", f'''
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import ray_tpu as ray
+from ray_tpu import NodeAffinitySchedulingStrategy
+ray.init(address="{redirect_cluster.address}", num_tpus=0)
+
+@ray.remote(num_cpus=1, scheduling_strategy=NodeAffinitySchedulingStrategy(
+    "daemon-1", soft=False))
+def hold():
+    import time
+    time.sleep({hold_s})
+    return "held"
+
+ref = hold.remote()
+import time
+time.sleep(0.5)
+print("SATURATED", flush=True)
+print(ray.get(ref), flush=True)
+'''],
+            stdout=subprocess.PIPE, text=True)
+        holder2 = self._saturate("daemon-2", hold_s + 4)
+        try:
+            assert saturator.stdout.readline().strip() == "SATURATED"
+            time.sleep(0.4)  # daemon-2's hold reaches its worker
+
+            @ray.remote(num_cpus=1)
+            def where():
+                return ray.get_runtime_context().get_node_id()
+
+            sched = _rt().scheduler
+            node1 = sched.get_node("daemon-1")
+            spilled0 = node1.client.call({"type": "ping"})["load"]["spilled"]
+            for _attempt in range(5):
+                # Stale view: daemon-3 looks busy, daemon-1 looks free.
+                sched.update_node_report("daemon-3", ResourceSet({}), 5)
+                t0 = time.monotonic()
+                ref = where.remote()
+                sched.update_node_report(
+                    "daemon-1", ResourceSet({"CPU": 1.0}), 0)
+                node_id = ray.get(ref, timeout=30)
+                elapsed = time.monotonic() - t0
+                assert node_id == "daemon-3", node_id
+                assert elapsed < hold_s / 2, f"took {elapsed:.1f}s"
+                spilled = node1.client.call(
+                    {"type": "ping"})["load"]["spilled"]
+                if spilled > spilled0:
+                    break
+            else:
+                raise AssertionError(
+                    "daemon-1 never refused a raced push in 5 attempts")
+            ray.get(holder2, timeout=30)
+        finally:
+            saturator.wait(timeout=30)
