@@ -7,7 +7,13 @@ from .trainer import Result, TpuTrainer
 __all__ = [
     "TpuTrainer", "TorchTrainer", "TensorflowTrainer",
     "TransformersTrainer", "XGBoostTrainer", "LightGBMTrainer",
-    "GBDTTrainer", "Result",
+    "GBDTTrainer", "HorovodTrainer", "HorovodConfig", "Result",
+    "ZeROTranslation", "translate_deepspeed_config", "init_zero_state",
+    "zero_param_rules",
+    # NOTE: the Lightning helpers (RayDDPStrategy & co., .lightning) are
+    # reachable via attribute access but deliberately NOT in __all__ —
+    # they raise ImportError without pytorch-lightning installed, which
+    # would break `import *` in this image.
     "ScalingConfig", "RunConfig", "FailureConfig",
     "CheckpointConfig", "Checkpoint", "CheckpointManager", "save_pytree",
     "load_pytree", "report", "get_context", "get_dataset_shard", "get_mesh",
@@ -34,4 +40,18 @@ def __getattr__(name):
         from . import gbdt
 
         return getattr(gbdt, name)
+    if name in ("HorovodTrainer", "HorovodConfig"):
+        from . import horovod
+
+        return getattr(horovod, name)
+    if name in ("ZeROTranslation", "translate_deepspeed_config",
+                "init_zero_state", "zero_param_rules"):
+        from . import zero
+
+        return getattr(zero, name)
+    if name in ("RayDDPStrategy", "RayLightningEnvironment",
+                "RayTrainReportCallback", "prepare_trainer"):
+        from . import lightning
+
+        return getattr(lightning, name)
     raise AttributeError(name)

@@ -1,0 +1,145 @@
+"""PyTorch Lightning integration (gated on the package being installed).
+
+Capability-equivalent to the reference's Lightning utilities
+(reference: python/ray/train/lightning/_lightning_utils.py —
+RayDDPStrategy :87, RayLightningEnvironment :132, RayTrainReportCallback
+:186, prepare_trainer :238): Lightning runs INSIDE a TorchTrainer worker
+loop; these helpers make a ``pl.Trainer`` cooperate with the already-
+initialized torch process group and stream report()/checkpoints back.
+
+This image does not ship pytorch-lightning, so every entry point raises
+a clear ImportError until the package is installed (the classes are
+built lazily on first attribute access — they need Lightning base
+classes to exist). The distributed substrate they attach to
+(TorchTrainer's per-process gloo rendezvous, train/torch.py) is fully
+implemented and tested without Lightning.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_PL_ERROR = (
+    "pytorch-lightning is not installed in this environment. "
+    "LightningTrainer-style training runs as: TorchTrainer(loop) where "
+    "the loop builds a pl.Trainer with RayDDPStrategy + "
+    "RayLightningEnvironment + RayTrainReportCallback (this module), "
+    "mirroring the reference's train.lightning utilities. Install "
+    "pytorch-lightning (or lightning) to use it; for native training "
+    "use TpuTrainer, for plain torch use TorchTrainer."
+)
+
+_LAZY = ("RayDDPStrategy", "RayLightningEnvironment",
+         "RayTrainReportCallback", "prepare_trainer")
+
+__all__ = list(_LAZY)
+
+
+def _import_pl():
+    try:
+        import pytorch_lightning as pl  # noqa: F401
+
+        return pl
+    except ImportError:
+        try:
+            from lightning import pytorch as pl  # noqa: F401
+
+            return pl
+        except ImportError:
+            raise ImportError(_PL_ERROR) from None
+
+
+def _build(pl) -> dict:
+    import ray_tpu.train as train
+
+    class RayLightningEnvironment(pl.plugins.environments.LightningEnvironment):
+        """Rank/world topology from the train session (reference:
+        _lightning_utils.py:132)."""
+
+        @property
+        def creates_processes_externally(self) -> bool:
+            # The TorchTrainer worker IS the rank process; Lightning must
+            # never fork its own local ranks (reference:
+            # _lightning_utils.py RayLightningEnvironment pins this).
+            return True
+
+        def world_size(self) -> int:
+            return train.get_context().get_world_size()
+
+        def global_rank(self) -> int:
+            return train.get_context().get_world_rank()
+
+        def local_rank(self) -> int:
+            return train.get_context().get_world_rank()
+
+        def node_rank(self) -> int:
+            return 0
+
+        def set_world_size(self, size: int) -> None:
+            pass
+
+        def set_global_rank(self, rank: int) -> None:
+            pass
+
+    class RayDDPStrategy(pl.strategies.DDPStrategy):
+        """DDP over the process group TorchTrainer already initialized
+        (reference: _lightning_utils.py:87)."""
+
+        @property
+        def root_device(self):
+            import torch
+
+            return torch.device("cpu")
+
+        @property
+        def distributed_sampler_kwargs(self) -> dict:
+            ctx = train.get_context()
+            return dict(num_replicas=ctx.get_world_size(),
+                        rank=ctx.get_world_rank())
+
+    class RayTrainReportCallback(pl.callbacks.Callback):
+        """Streams metrics (and rank-0 checkpoints) to
+        ray_tpu.train.report at each epoch end (reference:
+        _lightning_utils.py:186)."""
+
+        def on_train_epoch_end(self, trainer, pl_module) -> None:
+            metrics = {k: (v.item() if hasattr(v, "item") else v)
+                       for k, v in trainer.callback_metrics.items()}
+            metrics["epoch"] = trainer.current_epoch
+            metrics["step"] = trainer.global_step
+            ckpt = None
+            if train.get_context().get_world_rank() == 0:
+                import os
+                import tempfile
+
+                d = tempfile.mkdtemp(prefix="ray_tpu_pl_")
+                trainer.save_checkpoint(os.path.join(d, "checkpoint.ckpt"))
+                ckpt = train.Checkpoint(d, _ephemeral=True)
+            train.report(metrics, checkpoint=ckpt)
+
+    def prepare_trainer(trainer):
+        """Validate a pl.Trainer is wired for this runtime (reference:
+        prepare_trainer :238)."""
+        if not isinstance(trainer.strategy, RayDDPStrategy):
+            raise RuntimeError(
+                "pl.Trainer must use strategy=RayDDPStrategy() inside a "
+                "TorchTrainer worker loop")
+        return trainer
+
+    return {
+        "RayLightningEnvironment": RayLightningEnvironment,
+        "RayDDPStrategy": RayDDPStrategy,
+        "RayTrainReportCallback": RayTrainReportCallback,
+        "prepare_trainer": prepare_trainer,
+    }
+
+
+_cache: dict = {}
+
+
+def __getattr__(name: str) -> Any:
+    if name in _LAZY:
+        if not _cache:
+            _cache.update(_build(_import_pl()))
+        return _cache[name]
+    raise AttributeError(name)
