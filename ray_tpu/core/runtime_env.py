@@ -19,7 +19,8 @@ import os
 import sys
 from typing import Any, Dict, Optional
 
-VALID_KEYS = frozenset({"env_vars", "working_dir", "py_modules", "pip"})
+VALID_KEYS = frozenset({"env_vars", "working_dir", "py_modules", "pip",
+                        "conda", "container"})
 
 
 def validate(renv: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -44,11 +45,26 @@ def validate(renv: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     if pm is not None and (not isinstance(pm, (list, tuple)) or not all(
             isinstance(p, (str, os.PathLike)) for p in pm)):
         raise ValueError("runtime_env['py_modules'] must be a list of paths")
+    if renv.get("pip") is not None and renv.get("conda") is not None:
+        raise ValueError(
+            "runtime_env cannot combine 'pip' and 'conda' "
+            "(reference semantics: pip installs ride inside the "
+            "conda spec's dependencies instead)")
     if renv.get("pip") is not None:
         from .runtime_env_pip import normalize_pip
 
         renv = dict(renv)
         renv["pip"] = normalize_pip(renv["pip"])
+    if renv.get("conda") is not None:
+        from .runtime_env_isolation import normalize_conda
+
+        renv = dict(renv)
+        renv["conda"] = normalize_conda(renv["conda"])
+    if renv.get("container") is not None:
+        from .runtime_env_isolation import normalize_container
+
+        renv = dict(renv)
+        renv["container"] = normalize_container(renv["container"])
     return renv
 
 
@@ -65,6 +81,23 @@ def applied(renv: Optional[Dict[str, Any]]):
     if not renv:
         yield
         return
+    if renv.get("conda") is not None or renv.get("container") is not None:
+        # Spawn-level plugins (the worker process itself must change —
+        # reference: conda.py / container.py launch the worker inside the
+        # env/image). The command-wrapping building blocks exist
+        # (runtime_env_isolation.wrap_cmd_*), but this image ships
+        # neither conda nor podman/docker, so execution refuses with the
+        # supported alternative rather than silently ignoring the key.
+        from .runtime_env_isolation import RuntimeEnvUnsupportedError
+
+        missing = "conda" if renv.get("conda") is not None else "container"
+        raise RuntimeEnvUnsupportedError(
+            f"runtime_env[{missing!r}] requires spawn-level worker "
+            "isolation backed by a host conda/container runtime, which "
+            "this environment does not provide. Use the offline pip "
+            "plugin for dependency isolation (runtime_env={'pip': [...]}, "
+            "local wheelhouse via RAY_TPU_WHEELHOUSE) and "
+            "working_dir/py_modules for code shipping.")
     saved_env: Dict[str, Optional[str]] = {}
     saved_cwd = None
     added_paths = []

@@ -1,0 +1,236 @@
+"""conda / container runtime-env plugins (spawn-level isolation).
+
+Capability-equivalent to the reference's conda and container plugins
+(reference: python/ray/_private/runtime_env/conda.py — env creation +
+worker launched via the env's own interpreter; container.py — worker
+command wrapped in `podman run` with the session dir mounted). Unlike
+env_vars/working_dir/py_modules/pip (applied around the invocation,
+runtime_env.py), these two change THE WORKER PROCESS ITSELF, so they act
+at spawn time: the worker command line is wrapped.
+
+This image ships neither conda nor podman/docker and blocks installs, so
+the integration is GATED: shape validation and command assembly are pure
+functions (tested), the binary probe decides between the real spawn
+wrap and a documented refusal that points at the supported alternative
+(the offline pip wheelhouse plugin, runtime_env_pip.py, covers
+dependency isolation without either binary).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional, Union
+
+__all__ = [
+    "normalize_conda",
+    "normalize_container",
+    "conda_binary",
+    "container_runtime",
+    "wrap_cmd_conda",
+    "wrap_cmd_container",
+    "materialize_conda",
+    "RuntimeEnvUnsupportedError",
+]
+
+
+class RuntimeEnvUnsupportedError(RuntimeError):
+    """A runtime_env plugin's host dependency is missing."""
+
+
+# ---------------------------------------------------------------------------
+# Normalization (pure; mirrors the reference's accepted shapes)
+# ---------------------------------------------------------------------------
+
+def normalize_conda(spec: Union[str, Dict[str, Any], List[str]]
+                    ) -> Dict[str, Any]:
+    """Accepted shapes (reference: conda.py get_conda_dict):
+    - "env-name" or "environment.yml" path (str)
+    - {"dependencies": [...]} environment dict
+    - ["numpy", "pandas"] dependency list
+    Returns a canonical {"kind": "name"|"yaml"|"spec", ...} dict."""
+    if isinstance(spec, str):
+        if spec.endswith((".yml", ".yaml")):
+            if not os.path.isfile(spec):
+                raise ValueError(f"conda yaml not found: {spec}")
+            with open(spec) as f:
+                content = f.read()
+            return {"kind": "yaml", "content": content,
+                    "path": os.path.abspath(spec)}
+        return {"kind": "name", "name": spec}
+    if isinstance(spec, (list, tuple)):
+        deps = [str(d) for d in spec]
+        if not deps:
+            raise ValueError("conda dependency list is empty")
+        return {"kind": "spec", "env": {"dependencies": deps}}
+    if isinstance(spec, dict):
+        if "dependencies" not in spec:
+            raise ValueError(
+                "conda environment dict needs a 'dependencies' key")
+        return {"kind": "spec", "env": dict(spec)}
+    raise TypeError(f"runtime_env['conda'] must be a str, list or dict, "
+                    f"got {type(spec).__name__}")
+
+
+def normalize_container(spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Accepted shape (reference: container.py — {"image": ...,
+    "worker_path"?, "run_options"?: [...]}.)"""
+    if not isinstance(spec, dict):
+        raise TypeError("runtime_env['container'] must be a dict")
+    if not spec.get("image"):
+        raise ValueError("runtime_env['container'] needs an 'image'")
+    out = {"image": str(spec["image"])}
+    ro = spec.get("run_options", [])
+    if not isinstance(ro, (list, tuple)) or not all(
+            isinstance(o, str) for o in ro):
+        raise ValueError("container.run_options must be a list of strings")
+    out["run_options"] = [str(o) for o in ro]
+    unknown = set(spec) - {"image", "run_options", "worker_path"}
+    if unknown:
+        raise ValueError(f"unsupported container keys {sorted(unknown)}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host probes
+# ---------------------------------------------------------------------------
+
+def conda_binary() -> Optional[str]:
+    for name in ("conda", "mamba", "micromamba"):
+        p = shutil.which(name)
+        if p:
+            return p
+    return None
+
+
+def container_runtime() -> Optional[str]:
+    for name in ("podman", "docker"):
+        p = shutil.which(name)
+        if p:
+            return p
+    return None
+
+
+def _require(binary: Optional[str], what: str, alternative: str) -> str:
+    if binary is None:
+        raise RuntimeEnvUnsupportedError(
+            f"runtime_env[{what!r}] needs a {what} runtime on the host "
+            f"and none was found. {alternative}")
+    return binary
+
+
+_CONDA_ALT = (
+    "This image has no conda and blocks installs; for dependency "
+    "isolation use the offline pip plugin instead — "
+    "runtime_env={'pip': [...]} resolves against a local wheelhouse "
+    "(RAY_TPU_WHEELHOUSE) with content-addressed caching "
+    "(core/runtime_env_pip.py)."
+)
+_CONTAINER_ALT = (
+    "Install podman or docker on every node, or ship code with "
+    "working_dir/py_modules packages and dependencies via the offline "
+    "pip plugin."
+)
+
+
+# ---------------------------------------------------------------------------
+# Spawn-command wrapping (pure given a binary path)
+# ---------------------------------------------------------------------------
+
+def wrap_cmd_conda(cmd: List[str], conda: Dict[str, Any],
+                   *, binary: Optional[str] = None,
+                   cache_root: Optional[str] = None) -> List[str]:
+    """Worker command -> `conda run` inside the env (reference:
+    conda.py — the worker's py_executable becomes the env python)."""
+    binary = binary or _require(conda_binary(), "conda", _CONDA_ALT)
+    if conda["kind"] == "name":
+        return [binary, "run", "-n", conda["name"], "--no-capture-output",
+                *cmd]
+    prefix = materialize_conda(conda, binary=binary, cache_root=cache_root)
+    return [binary, "run", "-p", prefix, "--no-capture-output", *cmd]
+
+
+def wrap_cmd_container(cmd: List[str], container: Dict[str, Any],
+                       *, binary: Optional[str] = None,
+                       session_dir: Optional[str] = None) -> List[str]:
+    """Worker command -> `podman run` with the session dir and shm
+    plane mounted and host networking (the worker must reach the
+    daemon's unix socket + shm arena) — reference: container.py
+    get_container_driver command assembly."""
+    binary = binary or _require(container_runtime(), "container",
+                                _CONTAINER_ALT)
+    wrapped = [binary, "run", "--rm", "--network", "host",
+               "-v", "/dev/shm:/dev/shm"]
+    if session_dir:
+        wrapped += ["-v", f"{session_dir}:{session_dir}"]
+    cwd = os.getcwd()
+    wrapped += ["-v", f"{cwd}:{cwd}", "-w", cwd]
+    wrapped += list(container.get("run_options", []))
+    wrapped.append(container["image"])
+    wrapped += list(cmd)
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Conda env materialization (content-addressed, flock'd like the pip
+# plugin's wheelhouse cache)
+# ---------------------------------------------------------------------------
+
+def _conda_cache_root() -> str:
+    return os.environ.get(
+        "RAY_TPU_CONDA_CACHE",
+        os.path.join(os.path.expanduser("~"), ".ray_tpu", "conda_envs"))
+
+
+def materialize_conda(conda: Dict[str, Any], *,
+                      binary: Optional[str] = None,
+                      cache_root: Optional[str] = None) -> str:
+    """Create (once per content hash per host) and return the env
+    prefix. Named envs are assumed to exist already."""
+    binary = binary or _require(conda_binary(), "conda", _CONDA_ALT)
+    if conda["kind"] == "name":
+        raise ValueError("named conda envs are used in place, not created")
+    content = conda.get("content") or json.dumps(conda["env"],
+                                                 sort_keys=True)
+    h = hashlib.sha256(content.encode()).hexdigest()[:16]
+    root = cache_root or _conda_cache_root()
+    prefix = os.path.join(root, h)
+    ready = os.path.join(prefix, ".ray_tpu_ready")
+    if os.path.exists(ready):
+        return prefix
+    os.makedirs(root, exist_ok=True)
+    import fcntl
+
+    lock_path = os.path.join(root, f".{h}.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.exists(ready):
+            return prefix
+        spec_path = os.path.join(root, f"{h}.yml")
+        if conda["kind"] == "yaml":
+            with open(spec_path, "w") as f:
+                f.write(conda["content"])
+            args = [binary, "env", "create", "-p", prefix, "-f", spec_path]
+        else:
+            deps = [d for d in conda["env"].get("dependencies", [])
+                    if isinstance(d, str)]
+            args = [binary, "create", "-y", "-p", prefix, *deps]
+        try:
+            subprocess.run(args, check=True, capture_output=True,
+                           text=True, timeout=1800)
+        except subprocess.CalledProcessError as e:
+            shutil.rmtree(prefix, ignore_errors=True)
+            raise RuntimeEnvUnsupportedError(
+                f"conda env creation failed: {e.stderr[-2000:]}") from e
+        except subprocess.TimeoutExpired as e:
+            # A half-built prefix with no .ready marker would poison the
+            # cache slot forever (conda refuses an existing prefix).
+            shutil.rmtree(prefix, ignore_errors=True)
+            raise RuntimeEnvUnsupportedError(
+                "conda env creation timed out after 1800s") from e
+        with open(ready, "w") as f:
+            f.write(h)
+    return prefix

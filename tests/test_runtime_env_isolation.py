@@ -1,0 +1,122 @@
+"""conda/container runtime-env plugins: shape normalization, spawn
+command assembly, and the documented refusal path in this no-conda,
+no-container image (reference coverage model:
+python/ray/tests/test_runtime_env_conda_and_pip.py,
+test_runtime_env_container.py)."""
+
+import os
+
+import pytest
+
+from ray_tpu.core import runtime_env
+from ray_tpu.core.runtime_env_isolation import (
+    RuntimeEnvUnsupportedError,
+    normalize_conda,
+    normalize_container,
+    wrap_cmd_conda,
+    wrap_cmd_container,
+)
+
+
+class TestNormalization:
+    def test_conda_shapes(self, tmp_path):
+        assert normalize_conda("myenv") == {"kind": "name", "name": "myenv"}
+        assert normalize_conda(["numpy", "pandas"]) == {
+            "kind": "spec", "env": {"dependencies": ["numpy", "pandas"]}}
+        assert normalize_conda({"dependencies": ["numpy"]})["kind"] == "spec"
+        yml = tmp_path / "env.yml"
+        yml.write_text("dependencies:\n  - numpy\n")
+        out = normalize_conda(str(yml))
+        assert out["kind"] == "yaml" and "numpy" in out["content"]
+
+    def test_conda_bad_shapes(self):
+        with pytest.raises(ValueError, match="not found"):
+            normalize_conda("/nope/env.yml")
+        with pytest.raises(ValueError, match="empty"):
+            normalize_conda([])
+        with pytest.raises(ValueError, match="dependencies"):
+            normalize_conda({"name": "x"})
+        with pytest.raises(TypeError):
+            normalize_conda(7)
+
+    def test_container_shapes(self):
+        out = normalize_container(
+            {"image": "repo/img:tag", "run_options": ["--privileged"]})
+        assert out == {"image": "repo/img:tag",
+                       "run_options": ["--privileged"]}
+        with pytest.raises(ValueError, match="image"):
+            normalize_container({})
+        with pytest.raises(ValueError, match="run_options"):
+            normalize_container({"image": "x", "run_options": "nope"})
+        with pytest.raises(ValueError, match="unsupported"):
+            normalize_container({"image": "x", "cpu": 2})
+
+    def test_validate_accepts_and_normalizes(self):
+        renv = runtime_env.validate(
+            {"conda": ["numpy"], "env_vars": {"A": "1"}})
+        assert renv["conda"]["kind"] == "spec"
+        renv = runtime_env.validate({"container": {"image": "img"}})
+        assert renv["container"]["image"] == "img"
+
+    def test_pip_conda_exclusive(self):
+        with pytest.raises(ValueError, match="pip.*conda"):
+            runtime_env.validate({"pip": ["numpy"], "conda": ["numpy"]})
+
+
+class TestCommandAssembly:
+    """Pure spawn-wrap logic, driven with an injected binary path (no
+    conda/podman exists in this image)."""
+
+    def test_conda_named_env(self):
+        cmd = wrap_cmd_conda(["python", "-m", "w"],
+                             {"kind": "name", "name": "ml"},
+                             binary="/usr/bin/conda")
+        assert cmd == ["/usr/bin/conda", "run", "-n", "ml",
+                       "--no-capture-output", "python", "-m", "w"]
+
+    def test_container_wrap(self):
+        cmd = wrap_cmd_container(
+            ["python", "-m", "w"],
+            {"image": "img:1", "run_options": ["--privileged"]},
+            binary="/usr/bin/podman", session_dir="/tmp/sess")
+        assert cmd[:4] == ["/usr/bin/podman", "run", "--rm", "--network"]
+        assert "-v" in cmd and "/dev/shm:/dev/shm" in cmd
+        assert "/tmp/sess:/tmp/sess" in cmd
+        cwd = os.getcwd()
+        assert f"{cwd}:{cwd}" in cmd
+        i = cmd.index("img:1")
+        assert "--privileged" in cmd[:i]          # options before image
+        assert cmd[i + 1:] == ["python", "-m", "w"]
+
+
+class TestRefusal:
+    def _no_binaries(self):
+        from ray_tpu.core import runtime_env_isolation as iso
+
+        return iso.conda_binary() is None and iso.container_runtime() is None
+
+    def test_wrap_refuses_without_binary(self):
+        if not self._no_binaries():
+            pytest.skip("conda/podman present; refusal not applicable")
+        with pytest.raises(RuntimeEnvUnsupportedError, match="pip"):
+            wrap_cmd_conda(["python"], {"kind": "name", "name": "x"})
+        with pytest.raises(RuntimeEnvUnsupportedError, match="podman"):
+            wrap_cmd_container(["python"], {"image": "x"})
+
+    def test_applied_refuses_with_guidance(self):
+        renv = runtime_env.validate({"conda": ["numpy"]})
+        with pytest.raises(RuntimeEnvUnsupportedError, match="pip"):
+            with runtime_env.applied(renv):
+                pass
+
+    def test_task_level_refusal_is_a_clean_task_error(self, ray_start):
+        """A task submitted with a conda env fails with the guidance
+        message, not a hang or a silent ignore."""
+        ray = ray_start
+
+        @ray.remote(runtime_env={"conda": ["numpy"]})
+        def f():
+            return 1
+
+        with pytest.raises(Exception, match="pip"):
+            ray.get(f.remote(), timeout=30)
