@@ -1,0 +1,79 @@
+"""Drive native ZeRO-sharded training from a DeepSpeed JSON config.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     JAX_PLATFORMS=cpu python examples/deepspeed_config_train.py
+
+A reference user's ds_config carries over unchanged: the stages become
+sharding declarations (stage 2 = optimizer-state sharded over the fsdp
+mesh axis, params whole; stage 3 = params sharded too), XLA inserts the
+reduce-scatter/all-gather collectives.
+"""
+
+import os
+
+# Hard-set (not setdefault): this example demonstrates an 8-device mesh,
+# which needs the virtual CPU platform when only one real chip exists.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8"
+                           ).strip()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import configs
+from ray_tpu.parallel.mesh import make_mesh
+from ray_tpu.train import (
+    init_zero_state,
+    make_zero_train_step,
+    translate_deepspeed_config,
+)
+
+DS_CONFIG = {
+    "train_batch_size": 64,
+    "gradient_accumulation_steps": 2,
+    "zero_optimization": {"stage": 2},
+    "bf16": {"enabled": True},
+    "gradient_clipping": 1.0,
+    "optimizer": {"type": "AdamW",
+                  "params": {"lr": 3e-4, "betas": [0.9, 0.95],
+                             "weight_decay": 0.1}},
+    "scheduler": {"type": "WarmupLR",
+                  "params": {"warmup_num_steps": 10,
+                             "total_num_steps": 100}},
+}
+
+
+def main():
+    n = len(jax.devices())
+    t = translate_deepspeed_config(DS_CONFIG, n_devices=n)
+    print(f"stage={t.stage} plan={t.plan.describe()} "
+          f"micro_batch/device={t.micro_batch_per_device} "
+          f"accum={t.gradient_accumulation_steps} dtype={t.dtype.__name__}")
+
+    cfg = configs.tiny_test()
+    mesh = make_mesh(t.plan)
+    opt = t.make_optimizer()
+    rng = np.random.default_rng(0)
+    B = t.micro_batch_per_device * n
+    tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, 32)), jnp.int32)
+    mask = jnp.ones((B, 32), jnp.float32)
+
+    with jax.sharding.set_mesh(mesh):
+        state = init_zero_state(cfg, mesh, opt, stage=t.stage)
+        step = make_zero_train_step(cfg, opt, mesh, stage=t.stage)
+        for i in range(5):
+            state, metrics = step(state, tok, tok, mask)
+            print(f"step {i}: loss={float(metrics['loss']):.4f}")
+
+    # The ZeRO property, visible in the shardings:
+    mu_leaf = [x for x in jax.tree.leaves(state.opt_state)
+               if hasattr(x, "sharding") and x.ndim >= 2][0]
+    p_leaf = [x for x in jax.tree.leaves(state.params) if x.ndim >= 2][0]
+    print(f"param spec:     {p_leaf.sharding.spec}")
+    print(f"opt-state spec: {mu_leaf.sharding.spec}")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,7 @@ __all__ = [
     "TransformersTrainer", "XGBoostTrainer", "LightGBMTrainer",
     "GBDTTrainer", "HorovodTrainer", "HorovodConfig", "Result",
     "ZeROTranslation", "translate_deepspeed_config", "init_zero_state",
-    "zero_param_rules",
+    "zero_param_rules", "make_zero_train_step",
     # NOTE: the Lightning helpers (RayDDPStrategy & co., .lightning) are
     # reachable via attribute access but deliberately NOT in __all__ —
     # they raise ImportError without pytorch-lightning installed, which
@@ -45,7 +45,8 @@ def __getattr__(name):
 
         return getattr(horovod, name)
     if name in ("ZeROTranslation", "translate_deepspeed_config",
-                "init_zero_state", "zero_param_rules"):
+                "init_zero_state", "zero_param_rules",
+                "make_zero_train_step"):
         from . import zero
 
         return getattr(zero, name)

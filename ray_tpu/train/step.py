@@ -64,10 +64,16 @@ def init_state(cfg: TransformerConfig, mesh, optimizer,
     return TrainState(step=step, params=params, opt_state=opt_state)
 
 
-def make_train_step(cfg: TransformerConfig, optimizer, *, loss=None):
+def make_train_step(cfg: TransformerConfig, optimizer, *, loss=None,
+                    param_pspecs=None):
     """Returns step(state, tokens, targets, mask) -> (state, metrics),
     jit-compiled; call under `jax.sharding.set_mesh(mesh)`. `loss`
-    overrides the loss closure (signature of loss_fn minus cfg)."""
+    overrides the loss closure (signature of loss_fn minus cfg).
+    `param_pspecs` (pytree of PartitionSpecs matching params) pins the
+    OUTPUT params' shardings — needed when params' at-rest sharding
+    differs from what GSPMD would pick for the update math (ZeRO-1/2:
+    updates compute fsdp-sharded, params must come back whole, or the
+    state silently drifts to stage-3 sharding and recompiles)."""
 
     def _loss(params, tokens, targets, mask):
         if loss is not None:
@@ -82,6 +88,8 @@ def make_train_step(cfg: TransformerConfig, optimizer, *, loss=None):
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        if param_pspecs is not None:
+            params = jax.lax.with_sharding_constraint(params, param_pspecs)
         gnorm = optax.global_norm(grads)
         new_state = TrainState(
             step=state.step + 1, params=params, opt_state=opt_state)

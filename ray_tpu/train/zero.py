@@ -56,6 +56,7 @@ __all__ = [
     "translate_deepspeed_config",
     "zero_param_rules",
     "init_zero_state",
+    "make_zero_train_step",
 ]
 
 
@@ -100,6 +101,25 @@ def init_zero_state(cfg: TransformerConfig, mesh, optimizer,
             optimizer.init, out_shardings=opt_shardings)(params)
         step = jnp.zeros((), jnp.int32)
     return TrainState(step=step, params=params, opt_state=opt_state)
+
+
+def make_zero_train_step(cfg: TransformerConfig, optimizer, mesh,
+                         *, stage: int, loss=None):
+    """``make_train_step`` with the stage's param shardings pinned on the
+    OUTPUT. Without the pin, GSPMD keeps stage-1/2 params in the
+    fsdp-sharded layout the update math used — silently drifting the
+    state to stage-3 sharding and forcing a recompile on the next call."""
+    from ..parallel.sharding import logical_to_mesh_axes
+    from .step import make_train_step
+
+    rules = zero_param_rules(stage)
+    pspecs = jax.tree.map(
+        lambda ax: logical_to_mesh_axes(ax, rules, mesh),
+        param_logical_axes(cfg),
+        is_leaf=lambda x: x is None or (
+            isinstance(x, tuple)
+            and all(isinstance(e, (str, type(None))) for e in x)))
+    return make_train_step(cfg, optimizer, loss=loss, param_pspecs=pspecs)
 
 
 # ---------------------------------------------------------------------------

@@ -14,10 +14,11 @@ from ray_tpu.parallel.mesh import make_mesh
 from ray_tpu.parallel.plan import ParallelPlan
 from ray_tpu.train.zero import (
     init_zero_state,
+    make_zero_train_step,
     translate_deepspeed_config,
     zero_param_rules,
 )
-from ray_tpu.train.step import make_optimizer, make_train_step
+from ray_tpu.train.step import make_optimizer
 
 
 # ---------------------------------------------------------------------------
@@ -151,7 +152,7 @@ class TestZeROStages:
             with jax.sharding.set_mesh(mesh):
                 state = init_zero_state(cfg, mesh, opt, stage=stage,
                                         seed=0)
-                step = make_train_step(cfg, opt)
+                step = make_zero_train_step(cfg, opt, mesh, stage=stage)
                 state, metrics = step(state, tokens, targets, mask)
                 results[name] = (
                     jax.tree.map(np.asarray, jax.device_get(state.params)),
@@ -165,6 +166,32 @@ class TestZeROStages:
             flat_b = jax.tree.leaves(p)
             for a, b in zip(flat_a, flat_b):
                 np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+    def test_stage2_params_stay_whole_across_steps(self):
+        """Regression: without the output constraint, GSPMD keeps the
+        post-update params in the fsdp-sharded layout the update math
+        used — ZeRO-2 silently drifting to ZeRO-3 + a recompile."""
+        cfg = configs.tiny_test()
+        mesh = make_mesh(ParallelPlan(fsdp=8))
+        opt = make_optimizer(1e-3)
+        rng = np.random.default_rng(1)
+        tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 16)),
+                          jnp.int32)
+        mask = jnp.ones((8, 16), jnp.float32)
+        with jax.sharding.set_mesh(mesh):
+            state = init_zero_state(cfg, mesh, opt, stage=2)
+            step = make_zero_train_step(cfg, opt, mesh, stage=2)
+            for _ in range(2):
+                state, _ = step(state, tok, tok, mask)
+        p_axes = set()
+        for leaf in jax.tree.leaves(state.params):
+            p_axes |= _spec_axes(leaf)
+        assert "fsdp" not in p_axes
+        o_axes = set()
+        for leaf in jax.tree.leaves(state.opt_state):
+            if hasattr(leaf, "sharding") and leaf.ndim > 0:
+                o_axes |= _spec_axes(leaf)
+        assert "fsdp" in o_axes  # and the ZeRO property survives stepping
 
     def test_param_rules(self):
         r1 = dict(zero_param_rules(1))
