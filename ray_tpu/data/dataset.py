@@ -10,7 +10,7 @@ streaming executor on the task/actor runtime.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -283,6 +283,60 @@ class Dataset:
             out.append(d)
             start = end
         return out
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        """Split at global row indices (reference: dataset.py
+        split_at_indices). len(indices)+1 datasets."""
+        from .. import get as ray_get, put as ray_put
+
+        if any(i < 0 for i in indices) or list(indices) != sorted(indices):
+            raise ValueError("indices must be non-negative and sorted")
+        merged = concat_blocks([ray_get(r) for r in self._refs()])
+        rows = merged.num_rows
+        bounds = [0] + [min(i, rows) for i in indices] + [rows]
+        out = []
+        for i in range(len(bounds) - 1):
+            start, end = bounds[i], max(bounds[i], bounds[i + 1])
+            ref = ray_put(merged.slice(start, end - start))
+            d = Dataset(FromBlocks([ref], f"split_at_{i}"))
+            d._materialized = [ref]
+            out.append(d)
+        return out
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False, seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        """(train, test) split by fraction (reference: dataset.py
+        train_test_split)."""
+        if not 0 < test_size < 1:
+            raise ValueError("test_size must be in (0, 1)")
+        # Materialize ONCE before counting: count() + split_at_indices()
+        # on a lazy pipeline would execute it twice — wrong row counts
+        # if any stage is nondeterministic, double work otherwise.
+        ds = (self.random_shuffle(seed=seed) if shuffle
+              else self).materialize()
+        n = ds.count()
+        cut = n - int(n * test_size)
+        train, test = ds.split_at_indices([cut])
+        return train, test
+
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: dataset.py random_sample)."""
+        if not 0 <= fraction <= 1:
+            raise ValueError("fraction must be in [0, 1]")
+        # Per-block sampling (reference random_sample does the same);
+        # with a fixed seed every block draws the same mask pattern for
+        # equal block sizes — deterministic, but correlated across
+        # blocks, same caveat as the reference.
+        def _sample(batch):
+            cols = dict(batch)
+            n = len(next(iter(cols.values()))) if cols else 0
+            rng = np.random.default_rng(seed)
+            keep = rng.random(n) < fraction
+            return {k: np.asarray(v)[keep] for k, v in cols.items()}
+
+        return self.map_batches(_sample)
 
     def zip(self, other: "Dataset") -> "Dataset":
         from .. import get as ray_get, put as ray_put

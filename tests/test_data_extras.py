@@ -412,3 +412,51 @@ def test_dataset_stats(ray_start):
     # Both the source and the map stage appear.
     assert "FromBlocks" in s or "Read" in s
     assert "Map" in s
+
+
+class TestSplitsAndSampling:
+    """reference: dataset.py split_at_indices / train_test_split /
+    random_sample."""
+
+    def test_split_at_indices(self, ray_start):
+        from ray_tpu import data
+
+        ds = data.range(10)
+        a, b, c = ds.split_at_indices([3, 7])
+        assert [r["id"] for r in a.take_all()] == [0, 1, 2]
+        assert [r["id"] for r in b.take_all()] == [3, 4, 5, 6]
+        assert [r["id"] for r in c.take_all()] == [7, 8, 9]
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="sorted"):
+            ds.split_at_indices([7, 3])
+
+    def test_split_at_indices_past_end(self, ray_start):
+        from ray_tpu import data
+
+        a, b = data.range(5).split_at_indices([100])
+        assert a.count() == 5
+        assert b.count() == 0
+
+    def test_train_test_split(self, ray_start):
+        from ray_tpu import data
+
+        train, test = data.range(100).train_test_split(0.25)
+        assert train.count() == 75
+        assert test.count() == 25
+        # Unshuffled split is a prefix/suffix partition.
+        assert [r["id"] for r in test.take_all()] == list(range(75, 100))
+        tr2, te2 = data.range(100).train_test_split(
+            0.2, shuffle=True, seed=7)
+        ids = sorted(r["id"] for r in tr2.take_all()) \
+            + sorted(r["id"] for r in te2.take_all())
+        assert sorted(ids) == list(range(100))
+        assert te2.count() == 20
+
+    def test_random_sample(self, ray_start):
+        from ray_tpu import data
+
+        n = data.range(2000).random_sample(0.5, seed=3).count()
+        assert 700 < n < 1300  # loose: per-block correlated draws
+        assert data.range(50).random_sample(0.0).count() == 0
+        assert data.range(50).random_sample(1.0).count() == 50
