@@ -148,6 +148,10 @@ class MetricsHistory:
                 point[f"m:{name}"] = value
         except Exception:  # noqa: BLE001
             pass
+        try:
+            self._publish_prom(point, rt)
+        except Exception:  # noqa: BLE001 — exposition must not kill sampling
+            pass
         with self._lock:
             self._ring.append(point)
             if self._spill_fh is not None:
@@ -155,6 +159,69 @@ class MetricsHistory:
                     self._spill_fh.write(json.dumps(point) + "\n")
                 except Exception:  # noqa: BLE001 — disk full etc.
                     self._spill_fh = None
+
+    _prom_gauges = None
+
+    def _publish_prom(self, point, rt) -> None:
+        """Re-export the sampled series (head + every daemon's heartbeat
+        host stats) as native gauges, so an external Prometheus scraping
+        the head's /metrics sees per-node ray_tpu_node_* time series —
+        the capability of the reference's per-node metrics agents +
+        prometheus service discovery (dashboard/modules/metrics,
+        reporter agent), with the heartbeat plane replacing the extra
+        agent processes."""
+        from ..util import metrics as mm
+
+        if self._prom_gauges is None:
+            tag = ("node_id",)
+            self._prom_gauges = {
+                "cpu_percent": mm.Gauge(
+                    "ray_tpu_node_cpu_percent", "Host CPU percent", tag),
+                "mem_percent": mm.Gauge(
+                    "ray_tpu_node_mem_percent", "Host memory percent", tag),
+                "disk_percent": mm.Gauge(
+                    "ray_tpu_node_disk_percent", "Host disk percent", tag),
+                "queued": mm.Gauge(
+                    "ray_tpu_node_queued_tasks",
+                    "Tasks waiting for a worker on the node", tag),
+                "running": mm.Gauge(
+                    "ray_tpu_node_running_tasks",
+                    "Tasks executing on the node", tag),
+                "spilled": mm.Gauge(
+                    "ray_tpu_node_spilled_tasks",
+                    "Spillable pushes the node refused", tag),
+                "object_store_bytes": mm.Gauge(
+                    "ray_tpu_object_store_bytes",
+                    "Shared-memory arena bytes in use", tag),
+                "pending_tasks": mm.Gauge(
+                    "ray_tpu_scheduler_pending_tasks",
+                    "Tasks queued in this driver's scheduler", tag),
+            }
+        g = self._prom_gauges
+        head_id = getattr(rt, "head_node_id", None) or "head" \
+            if rt is not None else "head"
+
+        def put(key, value, node_id):
+            if value is not None:
+                g[key].set(float(value), {"node_id": node_id})
+
+        put("cpu_percent", point.get("cpu_percent"), head_id)
+        put("mem_percent", point.get("mem_percent"), head_id)
+        put("object_store_bytes", point.get("object_store_bytes"), head_id)
+        put("pending_tasks", point.get("pending_tasks"), head_id)
+        if rt is None:
+            return
+        for node in rt.scheduler.nodes():
+            load = getattr(node, "last_load", None)
+            if not load:
+                continue
+            host = load.get("host") or {}
+            put("cpu_percent", host.get("cpu_percent"), node.node_id)
+            put("mem_percent", host.get("mem_percent"), node.node_id)
+            put("disk_percent", host.get("disk_percent"), node.node_id)
+            put("queued", load.get("queued"), node.node_id)
+            put("running", load.get("running"), node.node_id)
+            put("spilled", load.get("spilled"), node.node_id)
 
     def dump(self, limit: int = 0):
         with self._lock:

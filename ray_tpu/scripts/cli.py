@@ -362,6 +362,23 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_metrics_export(args) -> int:
+    """reference: `ray metrics launch-prometheus` + the shipped grafana
+    provisioning bundle (dashboard/modules/metrics/export/)."""
+    from ray_tpu.dashboard.metrics_export import export_configs
+
+    paths = export_configs(
+        args.out, metrics_addr=args.metrics_addr,
+        prometheus_url=args.prometheus_url,
+        extra_targets=args.extra_target or None)
+    for kind, path in paths.items():
+        print(f"{kind}: {path}")
+    print(f"\nrun:  prometheus --config.file={paths['prometheus']}")
+    print("      grafana: point provisioning at "
+          f"{args.out}/grafana/provisioning")
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     from ray_tpu._private.perf import run_microbenchmarks
 
@@ -514,6 +531,20 @@ def build_parser() -> argparse.ArgumentParser:
     mb = sub.add_parser("microbenchmark")
     mb.add_argument("--quick", action="store_true")
     mb.set_defaults(fn=cmd_microbenchmark)
+
+    mx = sub.add_parser("metrics",
+                        help="monitoring-stack config export")
+    mxsub = mx.add_subparsers(dest="metrics_cmd", required=True)
+    me = mxsub.add_parser(
+        "export-configs",
+        help="write prometheus.yml + grafana provisioning/dashboards")
+    me.add_argument("--out", default="./monitoring")
+    me.add_argument("--metrics-addr", default="127.0.0.1:8265",
+                    help="head dashboard host:port to scrape")
+    me.add_argument("--prometheus-url", default="http://127.0.0.1:9090")
+    me.add_argument("--extra-target", action="append",
+                    help="additional host:port scrape targets")
+    me.set_defaults(fn=cmd_metrics_export)
 
     sv = sub.add_parser("serve")
     svsub = sv.add_subparsers(dest="serve_cmd", required=True)
