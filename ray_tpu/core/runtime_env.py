@@ -81,23 +81,54 @@ def applied(renv: Optional[Dict[str, Any]]):
     if not renv:
         yield
         return
-    if renv.get("conda") is not None or renv.get("container") is not None:
-        # Spawn-level plugins (the worker process itself must change —
-        # reference: conda.py / container.py launch the worker inside the
-        # env/image). The command-wrapping building blocks exist
-        # (runtime_env_isolation.wrap_cmd_*), but this image ships
-        # neither conda nor podman/docker, so execution refuses with the
-        # supported alternative rather than silently ignoring the key.
+    if renv.get("container") is not None:
+        # A container can only take effect by launching the worker
+        # process inside the image (reference: container.py); it can
+        # never be applied to an already-running worker. The command
+        # wrap exists (runtime_env_isolation.wrap_cmd_container) but is
+        # not wired into this execution plane — refuse rather than
+        # silently ignore.
         from .runtime_env_isolation import RuntimeEnvUnsupportedError
 
-        missing = "conda" if renv.get("conda") is not None else "container"
         raise RuntimeEnvUnsupportedError(
-            f"runtime_env[{missing!r}] requires spawn-level worker "
-            "isolation backed by a host conda/container runtime, which "
-            "this environment does not provide. Use the offline pip "
-            "plugin for dependency isolation (runtime_env={'pip': [...]}, "
-            "local wheelhouse via RAY_TPU_WHEELHOUSE) and "
-            "working_dir/py_modules for code shipping.")
+            "runtime_env['container'] needs the worker launched inside "
+            "the image (podman/docker), which this execution plane does "
+            "not do. Ship code with working_dir/py_modules and "
+            "dependencies via the offline pip plugin "
+            "(runtime_env={'pip': [...]}).")
+    conda_env_dir: Optional[str] = None
+    if renv.get("conda") is not None:
+        # With a conda binary on the host, materialize the env and apply
+        # its site-packages in-process (same interpreter-stays caveat as
+        # the pip plugin). Without one — this image — refuse with the
+        # supported alternative.
+        from .runtime_env_isolation import (
+            RuntimeEnvUnsupportedError,
+            conda_binary,
+            conda_site_packages,
+            materialize_conda,
+        )
+
+        spec = renv["conda"]
+        if conda_binary() is None:
+            raise RuntimeEnvUnsupportedError(
+                "runtime_env['conda'] needs a conda binary on the host "
+                "and none was found. Use the offline pip plugin for "
+                "dependency isolation (runtime_env={'pip': [...]}, local "
+                "wheelhouse via RAY_TPU_WHEELHOUSE) and "
+                "working_dir/py_modules for code shipping.")
+        if spec.get("kind") == "name":
+            raise RuntimeEnvUnsupportedError(
+                "named conda envs need the worker launched via `conda "
+                "run -n` (spawn-level, runtime_env_isolation."
+                "wrap_cmd_conda); pass a dependency list or environment "
+                "dict/yaml instead to apply the env in place.")
+        prefix = materialize_conda(spec)
+        conda_env_dir = conda_site_packages(prefix)
+        if conda_env_dir is None:
+            raise RuntimeEnvUnsupportedError(
+                f"conda env at {prefix} has no site-packages (no python "
+                "in its dependencies?)")
     saved_env: Dict[str, Optional[str]] = {}
     saved_cwd = None
     added_paths = []
@@ -125,6 +156,9 @@ def applied(renv: Optional[Dict[str, Any]]):
             if env_dir not in sys.path:
                 sys.path.insert(0, env_dir)
                 added_paths.append(env_dir)
+        if conda_env_dir is not None and conda_env_dir not in sys.path:
+            sys.path.insert(0, conda_env_dir)
+            added_paths.append(conda_env_dir)
         yield
     finally:
         for k, old in saved_env.items():

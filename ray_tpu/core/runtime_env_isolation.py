@@ -33,6 +33,8 @@ __all__ = [
     "wrap_cmd_conda",
     "wrap_cmd_container",
     "materialize_conda",
+    "conda_spec_file_content",
+    "conda_site_packages",
     "RuntimeEnvUnsupportedError",
 ]
 
@@ -88,6 +90,8 @@ def normalize_container(spec: Dict[str, Any]) -> Dict[str, Any]:
             isinstance(o, str) for o in ro):
         raise ValueError("container.run_options must be a list of strings")
     out["run_options"] = [str(o) for o in ro]
+    if spec.get("worker_path"):
+        out["worker_path"] = str(spec["worker_path"])
     unknown = set(spec) - {"image", "run_options", "worker_path"}
     if unknown:
         raise ValueError(f"unsupported container keys {sorted(unknown)}")
@@ -179,6 +183,27 @@ def wrap_cmd_container(cmd: List[str], container: Dict[str, Any],
 # plugin's wheelhouse cache)
 # ---------------------------------------------------------------------------
 
+def conda_spec_file_content(conda: Dict[str, Any]) -> str:
+    """Environment-file text for `conda env create -f`. A 'yaml' kind
+    passes through verbatim; a 'spec' kind emits its env dict as JSON —
+    a strict YAML subset conda accepts — preserving nested entries
+    (channels, the {"pip": [...]} dependency dict) exactly."""
+    if conda["kind"] == "yaml":
+        return conda["content"]
+    if conda["kind"] == "spec":
+        return json.dumps(conda["env"], indent=2)
+    raise ValueError(f"no spec file for conda kind {conda['kind']!r}")
+
+
+def conda_site_packages(prefix: str) -> Optional[str]:
+    """The env's site-packages dir, for in-process path application
+    (same interpreter-stays caveat as the pip plugin)."""
+    import glob as _glob
+
+    hits = sorted(_glob.glob(
+        os.path.join(prefix, "lib", "python*", "site-packages")))
+    return hits[-1] if hits else None
+
 def _conda_cache_root() -> str:
     return os.environ.get(
         "RAY_TPU_CONDA_CACHE",
@@ -210,14 +235,12 @@ def materialize_conda(conda: Dict[str, Any], *,
         if os.path.exists(ready):
             return prefix
         spec_path = os.path.join(root, f"{h}.yml")
-        if conda["kind"] == "yaml":
-            with open(spec_path, "w") as f:
-                f.write(conda["content"])
-            args = [binary, "env", "create", "-p", prefix, "-f", spec_path]
-        else:
-            deps = [d for d in conda["env"].get("dependencies", [])
-                    if isinstance(d, str)]
-            args = [binary, "create", "-y", "-p", prefix, *deps]
+        with open(spec_path, "w") as f:
+            f.write(conda_spec_file_content(conda))
+        # Always `env create -f`: a flat `conda create <deps>` would drop
+        # non-string dependency entries — the nested {"pip": [...]} dict
+        # and channels that validate() tells users to put here.
+        args = [binary, "env", "create", "-p", prefix, "-f", spec_path]
         try:
             subprocess.run(args, check=True, capture_output=True,
                            text=True, timeout=1800)

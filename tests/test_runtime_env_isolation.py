@@ -11,6 +11,8 @@ import pytest
 from ray_tpu.core import runtime_env
 from ray_tpu.core.runtime_env_isolation import (
     RuntimeEnvUnsupportedError,
+    conda_site_packages,
+    conda_spec_file_content,
     normalize_conda,
     normalize_container,
     wrap_cmd_conda,
@@ -44,6 +46,9 @@ class TestNormalization:
             {"image": "repo/img:tag", "run_options": ["--privileged"]})
         assert out == {"image": "repo/img:tag",
                        "run_options": ["--privileged"]}
+        # worker_path survives normalization (not silently dropped).
+        out = normalize_container({"image": "i", "worker_path": "/w.py"})
+        assert out["worker_path"] == "/w.py"
         with pytest.raises(ValueError, match="image"):
             normalize_container({})
         with pytest.raises(ValueError, match="run_options"):
@@ -87,6 +92,33 @@ class TestCommandAssembly:
         i = cmd.index("img:1")
         assert "--privileged" in cmd[:i]          # options before image
         assert cmd[i + 1:] == ["python", "-m", "w"]
+
+
+class TestCondaSpecFile:
+    def test_spec_kind_preserves_nested_pip_and_channels(self):
+        """The env-file path must carry the nested {"pip": [...]} dict
+        and channels — a flat `conda create <deps>` would drop them."""
+        import json as _json
+
+        conda = normalize_conda(
+            {"channels": ["conda-forge"],
+             "dependencies": ["python=3.10", {"pip": ["requests"]}]})
+        content = conda_spec_file_content(conda)
+        parsed = _json.loads(content)  # JSON is a YAML subset
+        assert parsed["channels"] == ["conda-forge"]
+        assert {"pip": ["requests"]} in parsed["dependencies"]
+
+    def test_yaml_kind_passes_through(self, tmp_path):
+        yml = tmp_path / "e.yml"
+        yml.write_text("dependencies:\n  - numpy\n")
+        conda = normalize_conda(str(yml))
+        assert conda_spec_file_content(conda) == yml.read_text()
+
+    def test_conda_site_packages(self, tmp_path):
+        assert conda_site_packages(str(tmp_path)) is None
+        sp = tmp_path / "lib" / "python3.11" / "site-packages"
+        sp.mkdir(parents=True)
+        assert conda_site_packages(str(tmp_path)) == str(sp)
 
 
 class TestRefusal:
