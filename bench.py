@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -223,6 +224,182 @@ def bench_serve(quick: bool, model: str = "gpt2-125m",
     }))
 
 
+def bench_serve_prefix(quick: bool, model: str = "llama-654m",
+                       trials: int = 5) -> None:
+    """Prefix-caching serving scenario: a long shared system prompt
+    (480 tok) + short user suffixes (32 tok) — the chat-serving shape
+    vLLM's automatic prefix caching targets.
+
+    The recorded value is the ADMISSION-WAVE DEVICE-TIME speedup:
+    dispatch-to-ready of one full-prompt prefill tile vs the
+    prefix-cached suffix tile, best-of-K paired (deterministic device
+    compute — the quantity the feature actually changes). An
+    engine-level end-to-end burst rides along as extra; on this
+    single tunneled chip the burst wall is round-trip-bound (each
+    engine tick pays ~150 ms of tunnel before any FLOPs), so the e2e
+    number under-reports the saving a local or larger-model deployment
+    sees. Prints one JSON line."""
+    import statistics
+    from dataclasses import replace
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import configs
+    from ray_tpu.models.generate import (
+        compute_prefix_kv,
+        init_kv_cache,
+        prefill_sample_batch,
+        prefill_suffix_batch,
+    )
+    from ray_tpu.models.transformer import init_params
+    from ray_tpu.serve.llm import LLMEngine
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if quick or not on_tpu:
+        cfg = configs.tiny_test()
+        cfg = replace(cfg, max_seq_len=128)
+        pre, suf, n_req, new, slots, max_seq = 48, 8, 8, 4, 4, 128
+        metric = "tiny_serve_prefix_speedup_smoke"
+        trials = 1
+    else:
+        cfg = configs.get(model)
+        cfg = replace(cfg, param_dtype=jnp.bfloat16, max_seq_len=1024)
+        pre, suf, n_req, new, slots, max_seq = 480, 32, 64, 4, 4, 1024
+        metric = f"{model.replace('-', '_')}_serve_prefix_speedup"
+
+    params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, cfg.vocab_size, pre).tolist()
+    prompts = [prefix + rng.integers(0, cfg.vocab_size, suf).tolist()
+               for _ in range(n_req)]
+
+    # ---- primary: paired device time per admission wave ----
+    W = LLMEngine._ADMIT_TILE
+    pk, pv = compute_prefix_kv(cfg, params, prefix)
+    full_bucket = 1
+    while full_bucket < pre + suf:
+        full_bucket *= 2
+    suf_bucket = 1
+    while suf_bucket < suf:
+        suf_bucket *= 2
+    fbuf = np.zeros((W, full_bucket), np.int32)
+    sbuf = np.zeros((W, suf_bucket), np.int32)
+    for j in range(W):
+        p = prompts[j % n_req]
+        fbuf[j, :len(p)] = p
+        sbuf[j, :suf] = p[pre:]
+    flens = np.full((W,), pre + suf, np.int32)
+    slens = np.full((W,), suf, np.int32)
+    slot_idx = np.arange(W, dtype=np.int32) % slots
+    temps = np.zeros((W,), np.float32)
+    key = jax.random.key(0)
+
+    # Hoist device transfers out of the timed closures: the loop must
+    # measure the prefill work alone, and the 512-wide full buffer's
+    # per-dispatch upload would bias the two arms asymmetrically.
+    fbuf_d, flens_d = jnp.asarray(fbuf), jnp.asarray(flens)
+    sbuf_d, slens_d = jnp.asarray(sbuf), jnp.asarray(slens)
+    slot_d, temps_d = jnp.asarray(slot_idx), jnp.asarray(temps)
+
+    def wave_full(cache):
+        return prefill_sample_batch(
+            cfg, params, cache, fbuf_d, flens_d, slot_d, 0, temps_d, key)
+
+    def wave_suffix(cache):
+        return prefill_suffix_batch(
+            cfg, params, cache, pk, pv, sbuf_d, slens_d, slot_d, 0,
+            temps_d, key)
+
+    def null_rtt():
+        """Host<->device round trip with no compute (the tunnel's
+        block_until_ready can return before execution; a real host
+        fetch is the only reliable sync, and it costs one RTT that
+        must be subtracted from chained timings)."""
+        x = jnp.zeros((8,), jnp.float32) + 1
+        np.asarray(x)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(x + 1)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def time_wave(fn, rtt, reps=3):
+        """Per-wave device time: K cache-chained waves (serial on
+        device) behind ONE real host sync, K sized so the chain runs
+        >=0.5 s — the subtracted RTT and its jitter stay <20% of the
+        measurement even for the ~ms suffix waves."""
+        cache = init_kv_cache(cfg, slots, max_seq)
+        cache, toks = fn(cache)            # compile + warm
+        np.asarray(toks)
+
+        def run(k):
+            nonlocal cache
+            t0 = time.perf_counter()
+            for _ in range(k):
+                cache, toks = fn(cache)
+            np.asarray(toks)
+            return time.perf_counter() - t0
+
+        K = 8
+        est = max(1e-4, (run(K) - rtt) / K)
+        K = int(min(512, max(K, math.ceil(0.5 / est))))
+        best = min(run(K) for _ in range(reps))
+        return max(1e-5, (best - rtt) / K)
+
+    rtt = null_rtt()
+    t_full = time_wave(wave_full, rtt)
+    t_suffix = time_wave(wave_suffix, rtt)
+    wave_speedup = t_full / t_suffix
+
+    # ---- extra: engine-level end-to-end burst (RTT-bound here) ----
+    def burst(register: bool):
+        eng = LLMEngine(cfg, params, num_slots=slots,
+                        max_seq_len=max_seq)
+        if register:
+            eng.register_prefix(prefix)
+        warm = eng.submit(prompts[0], max_new_tokens=2)
+        while eng.step():
+            pass
+        warm.result(timeout=300)
+        reqs = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        t0 = time.perf_counter()
+        while eng.step():
+            pass
+        wall = time.perf_counter() - t0
+        for r in reqs:
+            r.result(timeout=300)
+        return wall
+
+    walls = []
+    for t in range(max(1, trials)):
+        # Alternate pair order so slow monotone tunnel drift cancels.
+        if t % 2 == 0:
+            w_off, w_on = burst(False), burst(True)
+        else:
+            w_on, w_off = burst(True), burst(False)
+        walls.append(w_off / w_on)
+    e2e_x = statistics.median(walls)
+
+    run_match = {"prefix_len": pre, "suffix_len": suf, "tile": W,
+                 "slots": slots,
+                 "platform": jax.devices()[0].platform}
+    push_history(metric, wave_speedup, "x", match=run_match,
+                 extra={"wave_ms_full": round(t_full * 1e3, 1),
+                        "wave_ms_suffix": round(t_suffix * 1e3, 1),
+                        "e2e_burst_speedup": round(e2e_x, 2),
+                        "trials": len(walls)})
+    print(json.dumps({
+        "metric": metric, "value": round(wave_speedup, 2), "unit": "x",
+        "vs_baseline": round(wave_speedup, 2),  # feature baseline lacks
+        "wave_ms_full": round(t_full * 1e3, 1),
+        "wave_ms_suffix": round(t_suffix * 1e3, 1),
+        "e2e_burst_speedup": round(e2e_x, 2),
+    }))
+
+
 def bench_vit(quick: bool) -> None:
     """BASELINE config 4 (ViT-L/CLIP image path): images/s training a
     ViT classifier. Prints one JSON line."""
@@ -304,6 +481,8 @@ def main() -> None:
     ap.add_argument("--model", default="gpt2-125m",
                     help="named model config for the train benchmark "
                          "(gpt2-125m, llama-654m, llama-1b4)")
+    ap.add_argument("--serve-prefix", action="store_true",
+                    help="prefix-caching serving scenario (TTFT speedup)")
     ap.add_argument("--serve", action="store_true",
                     help="serving benchmark (req/s + TTFT) instead of "
                          "the train step")
@@ -311,6 +490,10 @@ def main() -> None:
                     help="image-model benchmark (BASELINE config 4)")
     args = ap.parse_args()
 
+    if args.serve_prefix:
+        model = args.model if args.model != "gpt2-125m" else "llama-654m"
+        bench_serve_prefix(args.quick, model=model)
+        return
     if args.serve:
         bench_serve(args.quick, model=args.model)
         return

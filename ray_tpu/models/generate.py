@@ -27,7 +27,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Dict, NamedTuple, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -259,6 +259,141 @@ def prefill_sample_batch(cfg: TransformerConfig, params, cache: KVCache,
     logits = (last @ head).astype(jnp.float32)[:, 0]       # (W, V)
     toks = sample(logits, key, temperature=temps, top_k=top_k)
     return KVCache(k=k, v=v, seq_lens=seq_lens), toks
+
+
+def _suffix_layer(cfg: TransformerConfig, q_offset: int, sin, cos,
+                  carry, scanned):
+    """Suffix-prefill layer: queries at global positions [Sp, Sp+Sq)
+    attend to the shared prefix KV plus their own causal block."""
+    from ..ops import flash_attention
+
+    (x,) = carry
+    lp, pk, pv = scanned                 # pk/pv: (Sp, KVH, Dh)
+    W, Sq, _ = x.shape
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q, k_s, v_s = _qkv(cfg, lp, h, sin, cos)
+    pk_b = jnp.broadcast_to(pk[None].astype(q.dtype),
+                            (W,) + pk.shape)
+    pv_b = jnp.broadcast_to(pv[None].astype(q.dtype),
+                            (W,) + pv.shape)
+    kk = jnp.concatenate([pk_b, k_s], axis=1)     # (W, Sp+Sq, KVH, Dh)
+    vv = jnp.concatenate([pv_b, v_s], axis=1)
+    force_ref = jax.default_backend() != "tpu"
+    out = flash_attention(q, kk, vv, causal=True, q_offset=q_offset,
+                          force_reference=force_ref)
+    x = x + (out.reshape(W, Sq, -1) @ lp["wo"].astype(x.dtype))
+    x = _ffn(cfg, lp, x)
+    return (x,), (k_s, v_s)
+
+
+def _suffix_forward(cfg: TransformerConfig, params, prefix_k, prefix_v,
+                    tokens):
+    """Shared suffix forward (admission prefill AND queue-side first
+    token — one implementation so the two paths can never drift apart,
+    the _prefill_core pattern): returns (x final-normed (W, Sq, D),
+    ks, vs (L, W, Sq, KVH, Dh))."""
+    W, Sq = tokens.shape
+    Sp = prefix_k.shape[1]
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    sin_t, cos_t = rope_tables(cfg, Sp + Sq)
+    sin, cos = sin_t[Sp:], cos_t[Sp:]
+    layer = partial(_suffix_layer, cfg, Sp, sin, cos)
+    (x,), (ks, vs) = lax.scan(
+        layer, (x,), (params["layers"], prefix_k, prefix_v))
+    return rms_norm(x, params["final_norm"], cfg.norm_eps), ks, vs
+
+
+def _last_token_sample(cfg: TransformerConfig, params, x, lens, temps,
+                       top_k, key):
+    """Sample one token per row from the last REAL position of a
+    final-normed batch (W, S, D)."""
+    W = x.shape[0]
+    idx = (lens - 1).astype(jnp.int32)[:, None, None]
+    last = jnp.take_along_axis(
+        x, jnp.broadcast_to(idx, (W, 1, x.shape[2])), axis=1)
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(cfg.dtype)
+    logits = (last @ head).astype(jnp.float32)[:, 0]
+    return sample(logits, key, temperature=temps, top_k=top_k)
+
+
+@partial(jax.jit, static_argnums=(0, 8), donate_argnums=(2,))
+def prefill_suffix_batch(cfg: TransformerConfig, params, cache: KVCache,
+                         prefix_k: jax.Array, prefix_v: jax.Array,
+                         tokens: jax.Array, suffix_lens: jax.Array,
+                         slots: jax.Array, top_k: int, temps: jax.Array,
+                         key: jax.Array) -> Tuple[KVCache, jax.Array]:
+    """Prefix-cached admission: install a REGISTERED prefix's KV
+    (prefix_k/v: (L, Sp, KVH, Dh), computed once at registration) into
+    each request's cache slot by copy — zero FLOPs — then prefill only
+    the SUFFIX tokens (W, Sq_bucket) at global positions [Sp, Sp+Sq),
+    attending to the prefix via flash attention's q_offset. The prefill
+    FLOPs for the shared prefix are paid once per registration instead
+    of once per request (capability of vLLM-style automatic prefix
+    caching, scoped to explicitly registered prefixes — this cache is
+    slot-contiguous, not paged; reference delegates the whole feature
+    to vLLM, doc/source/serve/doc_code/vllm_example.py).
+
+    suffix_lens: REAL suffix token counts (>= 1; the engine never
+    routes an exact-prefix prompt here). Returns (cache', first tokens
+    (W,)). Compiles once per (W, Sp, Sq_bucket)."""
+    W, Sq = tokens.shape
+    Sp = prefix_k.shape[1]
+    # 1. Prefix KV into the slot rows (broadcast copy; padding rows
+    #    drop out of bounds).
+    k = cache.k.at[:, slots, :Sp].set(
+        jnp.broadcast_to(prefix_k[:, None],
+                         (prefix_k.shape[0], W) + prefix_k.shape[1:]
+                         ).astype(cache.k.dtype), mode="drop")
+    v = cache.v.at[:, slots, :Sp].set(
+        jnp.broadcast_to(prefix_v[:, None],
+                         (prefix_v.shape[0], W) + prefix_v.shape[1:]
+                         ).astype(cache.v.dtype), mode="drop")
+
+    # 2. Suffix forward at offset positions (shared core).
+    x, ks, vs = _suffix_forward(cfg, params, prefix_k, prefix_v, tokens)
+
+    # 3. Suffix KV behind the prefix (static offset).
+    k = k.at[:, slots, Sp:Sp + Sq].set(ks.astype(k.dtype), mode="drop")
+    v = v.at[:, slots, Sp:Sp + Sq].set(vs.astype(v.dtype), mode="drop")
+    seq_lens = cache.seq_lens.at[slots].set(
+        Sp + suffix_lens, mode="drop")
+
+    # 4. First token from the last REAL suffix position.
+    toks = _last_token_sample(cfg, params, x, suffix_lens, temps,
+                              top_k, key)
+    return KVCache(k=k, v=v, seq_lens=seq_lens), toks
+
+
+@partial(jax.jit, static_argnums=(0, 7))
+def first_token_suffix_sample(cfg: TransformerConfig, params,
+                              prefix_k: jax.Array, prefix_v: jax.Array,
+                              tokens: jax.Array, suffix_lens: jax.Array,
+                              temps: jax.Array, top_k: int,
+                              key: jax.Array) -> jax.Array:
+    """Cache-free first token for prompts sharing a REGISTERED prefix:
+    runs only the suffix forward against the stored prefix KV (the
+    queue-side analog of prefill_suffix_batch — without it, every
+    queued request's early first token would re-pay the full-prefix
+    FLOPs the prefix cache exists to save). tokens (W, Sq_bucket),
+    suffix_lens (W,) real counts; returns (W,) tokens."""
+    x, _, _ = _suffix_forward(cfg, params, prefix_k, prefix_v, tokens)
+    return _last_token_sample(cfg, params, x, suffix_lens, temps,
+                              top_k, key)
+
+
+def compute_prefix_kv(cfg: TransformerConfig, params,
+                      prefix: Sequence[int]
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """KV for a prompt prefix, computed ONCE (registration-time half of
+    prefix caching): (L, Sp, KVH, Dh) k/v in the cache dtype."""
+    Sp = len(prefix)
+    scratch = init_kv_cache(cfg, 1, Sp)
+    tokens = jnp.asarray(list(prefix), jnp.int32)[None]    # (1, Sp)
+    scratch, _ = prefill(cfg, params, scratch, tokens,
+                         jnp.asarray(Sp, jnp.int32),
+                         jnp.asarray(0, jnp.int32))
+    return scratch.k[:, 0], scratch.v[:, 0]
 
 
 @partial(jax.jit, static_argnums=(0, 5))

@@ -36,11 +36,14 @@ import numpy as np
 
 from ..models.generate import (
     KVCache,
+    compute_prefix_kv,
     decode_multi,
     decode_step,
     first_token_sample,
+    first_token_suffix_sample,
     init_kv_cache,
     prefill_sample_batch,
+    prefill_suffix_batch,
 )
 from ..models.transformer import TransformerConfig, init_params
 
@@ -183,6 +186,18 @@ class LLMEngine:
         self._stop = False
         self._next_id = 0
         self.buckets = default_buckets(self.max_seq_len)
+        # Registered prompt prefixes (system prompts): token-tuple ->
+        # {"k","v"} device KV computed once; admission copies it into
+        # the slot and prefills only the suffix (vLLM-style prefix
+        # caching scoped to explicit registration — the KV cache here
+        # is slot-contiguous, not paged).
+        from collections import OrderedDict
+
+        self._prefixes: "OrderedDict[tuple, Dict[str, Any]]" = \
+            OrderedDict()
+        self.max_cached_prefixes = 8
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
         # aggregate stats
         self.decode_ticks = 0
         self.tokens_out = 0
@@ -210,6 +225,87 @@ class LLMEngine:
             self.waiting.append(req)
         self._work.set()
         return req
+
+    def register_prefix(self, tokens: Sequence[int]) -> None:
+        """Precompute + pin the KV of a shared prompt prefix (system
+        prompt). Later prompts starting with it skip its prefill
+        entirely. LRU-capped at max_cached_prefixes."""
+        key = tuple(int(t) for t in tokens)
+        if not key:
+            raise ValueError("empty prefix")
+        if len(key) >= self.max_seq_len - 1:
+            raise ValueError(
+                f"prefix len {len(key)} leaves no room for a suffix "
+                f"(max_seq_len {self.max_seq_len})")
+        with self.lock:
+            if key in self._prefixes:
+                self._prefixes.move_to_end(key)
+                return
+        pk, pv = compute_prefix_kv(self.cfg, self.params, key)
+        with self.lock:
+            self._prefixes[key] = {"k": pk, "v": pv}
+            while len(self._prefixes) > self.max_cached_prefixes:
+                self._prefixes.popitem(last=False)
+
+    def _match_prefix(self, prompt: List[int]):
+        """Longest registered prefix that strictly prefixes `prompt`
+        (>=1 suffix token must remain) and whose install still fits the
+        cache after suffix-bucket rounding. Returns the key or None."""
+        if not self._prefixes:
+            return None
+        with self.lock:
+            cands = sorted(self._prefixes, key=len, reverse=True)
+        for key in cands:
+            sp = len(key)
+            if len(prompt) <= sp or tuple(prompt[:sp]) != key:
+                continue
+            if sp + self._bucket_for(len(prompt) - sp) > self.max_seq_len:
+                continue
+            with self.lock:
+                entry = self._prefixes.get(key)
+                if entry is not None:
+                    self._prefixes.move_to_end(key)
+                    # Entry captured under the lock: a concurrent
+                    # register_prefix may LRU-evict the key before
+                    # dispatch; the captured arrays stay valid.
+                    return key, entry
+        return None
+
+    def _group_by_route(self, items: List, prompt_of):
+        """Shared admission/early-token routing: split items into
+        full-prefill tiles and prefix-suffix tiles (fixed W rows each).
+        Returns (full [(bucket, chunk)], suffix [(pkey, entry, bucket,
+        chunk)]) — ONE implementation so the two call sites can never
+        route the same prompt differently."""
+        by_bucket: Dict[int, List] = {}
+        by_prefix: Dict[tuple, List] = {}
+        entries: Dict[tuple, Dict[str, Any]] = {}
+        for it in items:
+            prompt = prompt_of(it)
+            match = self._match_prefix(prompt)
+            if match is not None:
+                pkey, entry = match
+                entries[pkey] = entry
+                by_prefix.setdefault(pkey, []).append(it)
+            else:
+                by_bucket.setdefault(
+                    self._bucket_for(len(prompt)), []).append(it)
+        W = self._ADMIT_TILE
+        full = [(b, p[off:off + W])
+                for b, p in sorted(by_bucket.items())
+                for off in range(0, len(p), W)]
+        suffix = []
+        for pkey, its in by_prefix.items():
+            sub: Dict[int, List] = {}
+            for it in its:
+                sub.setdefault(
+                    self._bucket_for(len(prompt_of(it)) - len(pkey)),
+                    []).append(it)
+            for b, p in sorted(sub.items()):
+                for off in range(0, len(p), W):
+                    suffix.append((pkey, entries[pkey], b,
+                                   p[off:off + W]))
+        return full, suffix
 
     # -- engine internals ---------------------------------------------
 
@@ -246,20 +342,21 @@ class LLMEngine:
     _ADMIT_TILE = 8  # fixed batch tile: ONE compile per bucket, ever
 
     @classmethod
-    def _build_tile(cls, bucket: int, reqs: Sequence[GenRequest]):
-        """Pad up to _ADMIT_TILE prompts into one (W, bucket) host
-        tile (+ lengths and temps). Padding on the HOST: an eager
-        .at[].set() per prompt would compile a scatter kernel per
-        distinct length (seconds each); numpy + one transfer doesn't."""
+    def _build_tile(cls, bucket: int, rows: Sequence):
+        """Pad up to _ADMIT_TILE token lists into one (W, bucket) host
+        tile (+ lengths and temps). rows: [(tokens, temperature)].
+        Padding on the HOST: an eager .at[].set() per prompt would
+        compile a scatter kernel per distinct length (seconds each);
+        numpy + one transfer doesn't."""
         W = cls._ADMIT_TILE
         buf = np.zeros((W, bucket), np.int32)
         lens = np.ones((W,), np.int32)
         temps = np.zeros((W,), np.float32)
-        for j, r in enumerate(reqs):
-            pl = len(r.prompt)
-            buf[j, :pl] = np.asarray(r.prompt, np.int32)
+        for j, (tokens, temp) in enumerate(rows):
+            pl = len(tokens)
+            buf[j, :pl] = np.asarray(tokens, np.int32)
             lens[j] = pl
-            temps[j] = r.temperature
+            temps[j] = temp
         return buf, lens, temps
 
     def _admit(self) -> List:
@@ -285,17 +382,18 @@ class LLMEngine:
             return []
 
         admitted: List = []  # (idx, tok_dev) — first token pending
-        by_bucket: Dict[int, List] = {}
-        for req, idx in zip(take, free):
-            by_bucket.setdefault(
-                self._bucket_for(len(req.prompt)), []).append((req, idx))
+        # Route: prompts strictly extending a registered prefix go
+        # through the suffix path (prefix KV copied, only the suffix
+        # prefilled); the rest through the full path.
         W = self._ADMIT_TILE
-        chunks = [(bucket, pairs[off:off + W])
-                  for bucket, pairs in sorted(by_bucket.items())
-                  for off in range(0, len(pairs), W)]
-        for ci, (bucket, chunk) in enumerate(chunks):
-            buf, lens, temps = self._build_tile(
-                bucket, [req for req, _ in chunk])
+        full, suffix = self._group_by_route(
+            list(zip(take, free)), lambda it: it[0].prompt)
+        chunks: List = [("full", bucket, None, chunk)
+                        for bucket, chunk in full]
+        chunks += [("suffix", (pkey, bucket), entry, chunk)
+                   for pkey, entry, bucket, chunk in suffix]
+
+        for ci, (kind, binfo, entry, chunk) in enumerate(chunks):
             # Padding rows scatter out of bounds (slot==num_slots) and
             # are dropped on device.
             slot_idx = np.full((W,), self.num_slots, np.int32)
@@ -303,16 +401,37 @@ class LLMEngine:
                 slot_idx[j] = idx
             self._key, sub = jax.random.split(self._key)
             try:
-                self.cache, toks = prefill_sample_batch(
-                    self.cfg, self.params, self.cache,
-                    jnp.asarray(buf), jnp.asarray(lens),
-                    jnp.asarray(slot_idx), self.top_k,
-                    jnp.asarray(temps), sub)
+                if kind == "full":
+                    bucket = binfo
+                    buf, lens, temps = self._build_tile(
+                        bucket,
+                        [(req.prompt, req.temperature)
+                         for req, _ in chunk])
+                    self.cache, toks = prefill_sample_batch(
+                        self.cfg, self.params, self.cache,
+                        jnp.asarray(buf), jnp.asarray(lens),
+                        jnp.asarray(slot_idx), self.top_k,
+                        jnp.asarray(temps), sub)
+                else:
+                    pkey, bucket = binfo
+                    sp = len(pkey)
+                    buf, lens, temps = self._build_tile(
+                        bucket,
+                        [(req.prompt[sp:], req.temperature)
+                         for req, _ in chunk])
+                    self.cache, toks = prefill_suffix_batch(
+                        self.cfg, self.params, self.cache,
+                        entry["k"], entry["v"],
+                        jnp.asarray(buf), jnp.asarray(lens),
+                        jnp.asarray(slot_idx), self.top_k,
+                        jnp.asarray(temps), sub)
+                    self.prefix_hits += len(chunk)
+                    self.prefix_tokens_saved += sp * len(chunk)
             except Exception:
                 # put this and every unprocessed request back so
                 # _fail_all can notify their clients
                 with self.lock:
-                    for _, later in reversed(chunks[ci:]):
+                    for _, _, _, later in reversed(chunks[ci:]):
                         for req, _ in reversed(later):
                             self.waiting.appendleft(req)
                 raise
@@ -350,22 +469,32 @@ class LLMEngine:
                     if r.first_token_ts == 0.0]
         if not todo:
             return []
-        by_bucket: Dict[int, List[GenRequest]] = {}
-        for r in todo:
-            by_bucket.setdefault(
-                self._bucket_for(len(r.prompt)), []).append(r)
         outs = []
-        W = self._ADMIT_TILE
-        for bucket, reqs in sorted(by_bucket.items()):
-            for off in range(0, len(reqs), W):
-                chunk = reqs[off:off + W]
-                buf, lens, temps = self._build_tile(bucket, chunk)
-                self._key, sub = jax.random.split(self._key)
-                toks = first_token_sample(
-                    self.cfg, self.params, jnp.asarray(buf),
-                    jnp.asarray(lens), jnp.asarray(temps), self.top_k,
-                    sub)
-                outs.append((chunk, toks))
+        full, suffix = self._group_by_route(todo, lambda r: r.prompt)
+        for bucket, chunk in full:
+            buf, lens, temps = self._build_tile(
+                bucket, [(r.prompt, r.temperature) for r in chunk])
+            self._key, sub = jax.random.split(self._key)
+            toks = first_token_sample(
+                self.cfg, self.params, jnp.asarray(buf),
+                jnp.asarray(lens), jnp.asarray(temps), self.top_k,
+                sub)
+            outs.append((chunk, toks))
+        # Prefix-matched queued requests: suffix-only forward against
+        # the stored prefix KV (same FLOP saving as slot admission).
+        for pkey, entry, bucket, chunk in suffix:
+            sp = len(pkey)
+            buf, lens, temps = self._build_tile(
+                bucket, [(r.prompt[sp:], r.temperature)
+                         for r in chunk])
+            self._key, sub = jax.random.split(self._key)
+            toks = first_token_suffix_sample(
+                self.cfg, self.params, entry["k"], entry["v"],
+                jnp.asarray(buf), jnp.asarray(lens),
+                jnp.asarray(temps), self.top_k, sub)
+            self.prefix_hits += len(chunk)
+            self.prefix_tokens_saved += sp * len(chunk)
+            outs.append((chunk, toks))
         return outs
 
     def _fuse_first_tokens(self, admitted: List, outs: List):
@@ -600,6 +729,9 @@ class LLMEngine:
             "tokens_out": self.tokens_out,
             "waiting": len(self.waiting),
             "active": sum(s is not None for s in self.slots),
+            "prefix_hits": self.prefix_hits,
+            "prefix_tokens_saved": self.prefix_tokens_saved,
+            "cached_prefixes": len(self._prefixes),
         }
         if ttfts:
             out["ttft_p50_s"] = ttfts[len(ttfts) // 2]
@@ -631,6 +763,10 @@ class LLMServer:
         tokens = req.result()
         return {"tokens": tokens, "ttft_s": req.ttft_s,
                 "latency_s": req.latency_s}
+
+    def register_prefix(self, tokens: Sequence[int]) -> None:
+        """Precompute a shared prompt prefix's KV on this replica."""
+        self.engine.register_prefix(tokens)
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()

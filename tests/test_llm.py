@@ -282,3 +282,109 @@ def test_oversubscribed_burst_first_tokens_before_slots_free():
     # The first emitted token equals the full result's first token.
     for r, o in zip(reqs, outs):
         assert o[0] == r.tokens[0]
+
+
+class TestPrefixCaching:
+    """Registered-prefix KV reuse (capability of vLLM's prefix caching;
+    the reference delegates serving to vLLM,
+    doc/source/serve/doc_code/vllm_example.py): admission copies the
+    prefix KV and prefills only the suffix — outputs must be identical
+    to the full-prefill path."""
+
+    def _model(self):
+        from ray_tpu.models import configs
+        from ray_tpu.models.transformer import init_params
+
+        cfg = configs.tiny_test()
+        return cfg, init_params(cfg, jax.random.key(0))
+
+    def test_outputs_match_full_prefill_exactly(self):
+        cfg, params = self._model()
+        rng = np.random.RandomState(1)
+        prefix = list(rng.randint(0, cfg.vocab_size, size=13))
+        prompts = [prefix + list(rng.randint(0, cfg.vocab_size, size=n))
+                   for n in (4, 9, 1, 6)]
+        prompts.append(list(rng.randint(0, cfg.vocab_size, size=8)))
+
+        base = LLMEngine(cfg, params, num_slots=3, max_seq_len=64)
+        base_reqs = [base.submit(p, max_new_tokens=5) for p in prompts]
+        while base.step():
+            pass
+        expected = [r.result(timeout=5) for r in base_reqs]
+
+        eng = LLMEngine(cfg, params, num_slots=3, max_seq_len=64)
+        eng.register_prefix(prefix)
+        reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        while eng.step():
+            pass
+        for exp, r in zip(expected, reqs):
+            assert r.result(timeout=5) == exp
+        st = eng.stats()
+        # >= 4: each matched prompt hits at admission, and any that
+        # queued also hit the prefix-aware early-first-token path.
+        assert st["prefix_hits"] >= 4
+        assert st["prefix_tokens_saved"] >= 4 * len(prefix)
+        assert st["cached_prefixes"] == 1
+
+    def test_exact_prefix_prompt_uses_full_path(self):
+        """A prompt EQUAL to the prefix has no suffix token — it must
+        fall back to full prefill, not crash."""
+        cfg, params = self._model()
+        rng = np.random.RandomState(2)
+        prefix = list(rng.randint(0, cfg.vocab_size, size=10))
+        eng = LLMEngine(cfg, params, num_slots=2, max_seq_len=64)
+        eng.register_prefix(prefix)
+        ref = list(np.asarray(greedy_generate(
+            cfg, params, jnp.asarray(prefix, jnp.int32), 4)))
+        r = eng.submit(prefix, max_new_tokens=4)
+        while eng.step():
+            pass
+        assert r.result(timeout=5) == ref
+        assert eng.stats()["prefix_hits"] == 0
+
+    def test_longest_prefix_wins_and_lru_caps(self):
+        cfg, params = self._model()
+        rng = np.random.RandomState(3)
+        p_short = list(rng.randint(0, cfg.vocab_size, size=6))
+        p_long = p_short + list(rng.randint(0, cfg.vocab_size, size=6))
+        eng = LLMEngine(cfg, params, num_slots=2, max_seq_len=64)
+        eng.register_prefix(p_short)
+        eng.register_prefix(p_long)
+        prompt = p_long + [1, 2, 3]
+        r = eng.submit(prompt, max_new_tokens=3)
+        while eng.step():
+            pass
+        r.result(timeout=5)
+        # Longest prefix matched (every hit saved len(p_long) tokens).
+        assert eng.prefix_tokens_saved % len(p_long) == 0
+        assert eng.prefix_tokens_saved >= len(p_long)
+        # LRU cap evicts oldest
+        eng.max_cached_prefixes = 2
+        eng.register_prefix([5] * 4)
+        assert eng.stats()["cached_prefixes"] == 2
+
+    def test_register_validation(self):
+        cfg, params = self._model()
+        eng = LLMEngine(cfg, params, num_slots=1, max_seq_len=32)
+        with pytest.raises(ValueError, match="empty"):
+            eng.register_prefix([])
+        with pytest.raises(ValueError, match="room"):
+            eng.register_prefix([1] * 40)
+
+    def test_temperature_rides_suffix_path(self):
+        """Sampled (non-greedy) requests through the prefix path run to
+        completion with valid tokens."""
+        cfg, params = self._model()
+        rng = np.random.RandomState(4)
+        prefix = list(rng.randint(0, cfg.vocab_size, size=8))
+        eng = LLMEngine(cfg, params, num_slots=2, max_seq_len=64)
+        eng.register_prefix(prefix)
+        reqs = [eng.submit(prefix + [7, 8], max_new_tokens=4,
+                           temperature=0.8) for _ in range(3)]
+        while eng.step():
+            pass
+        for r in reqs:
+            toks = r.result(timeout=5)
+            assert len(toks) == 4
+            assert all(0 <= t < cfg.vocab_size for t in toks)
+        assert eng.stats()["prefix_hits"] >= 3
