@@ -12,7 +12,7 @@ YAML shape:
     max_workers: 8
     idle_timeout_minutes: 5
     provider:
-      type: gce_tpu            # gce_tpu | local | mock
+      type: gce_tpu            # gce_tpu | kuberay | on_prem | local | mock
       project: my-project
       zone: us-central2-b
     auth:
@@ -125,6 +125,16 @@ def make_provider(cfg: ClusterConfig, **overrides) -> NodeProvider:
                        "crd_version", "default_group")}
         kw.update(overrides)
         return KubeTpuNodeProvider(cluster_name=cfg.cluster_name, **kw)
+    if ptype == "on_prem":
+        from .providers import OnPremNodeProvider
+
+        kw = {k: v for k, v in cfg.provider.items()
+              if k in ("state_path", "start_command", "stop_command",
+                       "ssh_user", "ssh_key_path")}
+        kw.update(overrides)
+        return OnPremNodeProvider(
+            list(cfg.provider.get("hosts") or []),
+            cluster_name=cfg.cluster_name, **kw)
     raise ValueError(f"unknown provider type {ptype!r}")
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import subprocess
 import time
 import urllib.error
@@ -525,3 +526,125 @@ class KubeTpuNodeProvider(NodeProvider):
                 return True
             time.sleep(self.poll_interval_s)
         return False
+
+
+class OnPremNodeProvider(NodeProvider):
+    """Fixed host inventory for bare-metal / reserved TPU pods
+    (reference: autoscaler/_private/local/node_provider.py
+    LocalNodeProvider over a configured worker_ips list, with
+    ClusterState :33 — a lock-guarded json file recording which hosts
+    are claimed, so concurrent monitors and restarts agree).
+
+    "Scaling up" CLAIMS an idle host from the pool and runs the
+    configured start command on it over ssh; scaling down runs the stop
+    command and releases the claim. Hosts are dicts
+    {"ip": ..., "type": ..., "labels": {...}} (or bare ip strings).
+    The command executor is injectable for tests."""
+
+    def __init__(self, hosts: List, *, cluster_name: str = "default",
+                 state_path: Optional[str] = None,
+                 start_command: Optional[str] = None,
+                 stop_command: Optional[str] = None,
+                 ssh_user: str = "root",
+                 ssh_key_path: Optional[str] = None,
+                 exec_fn=None):
+        self.hosts: Dict[str, Dict] = {}
+        for h in hosts:
+            if isinstance(h, str):
+                h = {"ip": h}
+            ip = str(h["ip"])
+            self.hosts[ip] = {"ip": ip,
+                              "type": str(h.get("type", "")),
+                              "labels": dict(h.get("labels") or {})}
+        if not self.hosts:
+            raise ValueError("on_prem provider needs a non-empty host list")
+        self.cluster_name = cluster_name
+        self.state_path = state_path or os.path.join(
+            os.path.expanduser("~"), ".ray_tpu",
+            f"onprem-{cluster_name}.json")
+        state_dir = os.path.dirname(self.state_path)
+        if state_dir:  # bare filename = cwd, nothing to create
+            os.makedirs(state_dir, exist_ok=True)
+        self.start_command = start_command
+        self.stop_command = stop_command
+        self._runner = lambda ip: SSHCommandRunner(
+            ip, user=ssh_user, key_path=ssh_key_path)
+        # exec_fn(ip, command) — defaults to ssh; injectable.
+        self._exec = exec_fn or (
+            lambda ip, cmd: self._runner(ip).run(cmd))
+
+    # -- claim state (flock'd json: reference ClusterState) ------------
+    def _with_state(self, mutate):
+        import fcntl
+
+        with open(self.state_path, "a+") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            f.seek(0)
+            raw = f.read()
+            try:
+                state = json.loads(raw) if raw.strip() else {}
+            except ValueError:
+                state = {}
+            claims = state.setdefault("claims", {})
+            out = mutate(claims)
+            f.seek(0)
+            f.truncate()
+            json.dump(state, f)
+        return out
+
+    # -- NodeProvider ---------------------------------------------------
+    def create_node(self, resources: Dict[str, float],
+                    labels: Dict[str, str], node_type: str = "") -> str:
+        def claim(claims):
+            for ip, h in self.hosts.items():
+                if ip in claims:
+                    continue
+                if node_type and h["type"] and h["type"] != node_type:
+                    continue
+                # Label-selector claiming: every requested label must be
+                # present on the host (same semantics as the scheduler's
+                # label constraints).
+                if labels and any(h["labels"].get(k) != v
+                                  for k, v in labels.items()):
+                    continue
+                claims[ip] = {"type": node_type or h["type"],
+                              "ts": time.time()}
+                return ip
+            raise RuntimeError(
+                f"on_prem pool exhausted: all {len(self.hosts)} hosts "
+                f"claimed (or none matches type {node_type!r} / labels "
+                f"{labels!r})")
+
+        ip = self._with_state(claim)
+        if self.start_command:
+            try:
+                self._exec(ip, self.start_command)
+            except Exception:
+                # Release the claim: a host whose start failed must not
+                # leak out of the pool.
+                self._with_state(lambda c: c.pop(ip, None))
+                raise
+        return ip
+
+    def terminate_node(self, node_id: str) -> None:
+        if self.stop_command:
+            try:
+                self._exec(node_id, self.stop_command)
+            except Exception:  # noqa: BLE001 — host may be dead already
+                pass
+        self._with_state(lambda c: c.pop(node_id, None))
+
+    def non_terminated_nodes(self) -> List[str]:
+        return self._with_state(
+            lambda c: [ip for ip in c if ip in self.hosts])
+
+    def node_type_of(self, node_id: str) -> str:
+        return self._with_state(
+            lambda c: (c.get(node_id) or {}).get("type", "")) or \
+            self.hosts.get(node_id, {}).get("type", "")
+
+    def node_ip(self, node_id: str) -> Optional[str]:
+        return node_id if node_id in self.hosts else None
+
+    def wait_ready(self, node_id: str, timeout_s: float = 60.0) -> bool:
+        return node_id in self.non_terminated_nodes()

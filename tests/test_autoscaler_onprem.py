@@ -1,0 +1,144 @@
+"""On-prem fixed-inventory provider (reference coverage model:
+python/ray/tests/test_autoscaler.py local-provider cases +
+autoscaler/_private/local/node_provider.py ClusterState)."""
+
+import json
+import threading
+
+import pytest
+
+from ray_tpu.autoscaler.cluster_config import ClusterConfig, make_provider
+from ray_tpu.autoscaler.providers import OnPremNodeProvider
+
+
+def _provider(tmp_path, hosts=None, **kw):
+    calls = []
+    p = OnPremNodeProvider(
+        hosts or ["10.0.0.1", "10.0.0.2", "10.0.0.3"],
+        cluster_name="t",
+        state_path=str(tmp_path / "state.json"),
+        exec_fn=lambda ip, cmd: calls.append((ip, cmd)), **kw)
+    return p, calls
+
+
+class TestOnPremProvider:
+    def test_claim_release_cycle(self, tmp_path):
+        p, _ = _provider(tmp_path)
+        a = p.create_node({"CPU": 1}, {})
+        b = p.create_node({"CPU": 1}, {})
+        assert {a, b} <= {"10.0.0.1", "10.0.0.2", "10.0.0.3"}
+        assert a != b
+        assert set(p.non_terminated_nodes()) == {a, b}
+        p.terminate_node(a)
+        assert p.non_terminated_nodes() == [b]
+        c = p.create_node({"CPU": 1}, {})
+        assert c == a  # released host is reusable
+
+    def test_pool_exhaustion(self, tmp_path):
+        p, _ = _provider(tmp_path, hosts=["10.0.0.1"])
+        p.create_node({}, {})
+        with pytest.raises(RuntimeError, match="exhausted"):
+            p.create_node({}, {})
+
+    def test_typed_hosts(self, tmp_path):
+        hosts = [{"ip": "10.0.0.1", "type": "cpu"},
+                 {"ip": "10.0.0.2", "type": "tpu_v5e_8"}]
+        p, _ = _provider(tmp_path, hosts=hosts)
+        n = p.create_node({}, {}, node_type="tpu_v5e_8")
+        assert n == "10.0.0.2"
+        assert p.node_type_of(n) == "tpu_v5e_8"
+        with pytest.raises(RuntimeError, match="exhausted"):
+            p.create_node({}, {}, node_type="tpu_v5e_8")
+
+    def test_label_selector_claiming(self, tmp_path):
+        hosts = [{"ip": "10.0.0.1", "labels": {"zone": "a"}},
+                 {"ip": "10.0.0.2", "labels": {"zone": "b", "gen": "v5"}}]
+        p, _ = _provider(tmp_path, hosts=hosts)
+        n = p.create_node({}, {"zone": "b"})
+        assert n == "10.0.0.2"
+        with pytest.raises(RuntimeError, match="exhausted"):
+            p.create_node({}, {"zone": "b"})
+        assert p.create_node({}, {}) == "10.0.0.1"
+
+    def test_bare_filename_state_path(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        p = OnPremNodeProvider(["10.0.0.1"], state_path="bare.json")
+        p.create_node({}, {})
+        assert (tmp_path / "bare.json").exists()
+
+    def test_start_stop_commands(self, tmp_path):
+        p, calls = _provider(
+            tmp_path, hosts=["10.0.0.9"],
+            start_command="ray-tpu start --address=head:6379",
+            stop_command="ray-tpu stop")
+        n = p.create_node({}, {})
+        assert calls == [("10.0.0.9", "ray-tpu start --address=head:6379")]
+        p.terminate_node(n)
+        assert calls[-1] == ("10.0.0.9", "ray-tpu stop")
+
+    def test_failed_start_releases_claim(self, tmp_path):
+        def boom(ip, cmd):
+            raise RuntimeError("ssh refused")
+
+        p = OnPremNodeProvider(
+            ["10.0.0.1"], cluster_name="t",
+            state_path=str(tmp_path / "s.json"),
+            start_command="start", exec_fn=boom)
+        with pytest.raises(RuntimeError, match="refused"):
+            p.create_node({}, {})
+        # Host returned to the pool — a second provider sees it free.
+        assert p.non_terminated_nodes() == []
+
+    def test_state_shared_across_instances(self, tmp_path):
+        """Two provider objects (monitor restart / concurrent monitors)
+        agree on claims through the flock'd state file."""
+        p1, _ = _provider(tmp_path)
+        p2, _ = _provider(tmp_path)
+        a = p1.create_node({}, {})
+        assert a in p2.non_terminated_nodes()
+        b = p2.create_node({}, {})
+        assert b != a
+        p2.terminate_node(a)
+        assert a not in p1.non_terminated_nodes()
+
+    def test_concurrent_claims_no_double_assignment(self, tmp_path):
+        p, _ = _provider(tmp_path)
+        got, errs = [], []
+
+        def claim():
+            try:
+                got.append(p.create_node({}, {}))
+            except RuntimeError as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=claim) for _ in range(5)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(got) == 3 and len(set(got)) == 3  # pool size
+        assert len(errs) == 2
+
+    def test_corrupt_state_file_recovers(self, tmp_path):
+        sp = tmp_path / "state.json"
+        sp.write_text("{not json")
+        p = OnPremNodeProvider(["10.0.0.1"], state_path=str(sp))
+        assert p.non_terminated_nodes() == []
+        p.create_node({}, {})
+        assert json.loads(sp.read_text())["claims"]
+
+    def test_cluster_config_wiring(self, tmp_path):
+        cfg = ClusterConfig.from_dict({
+            "cluster_name": "prem",
+            "provider": {"type": "on_prem",
+                         "hosts": ["10.1.0.1", "10.1.0.2"],
+                         "state_path": str(tmp_path / "s.json"),
+                         "start_command": "echo hi"},
+            "available_node_types": {
+                "worker": {"resources": {"CPU": 4}}},
+        })
+        calls = []
+        p = make_provider(cfg, exec_fn=lambda ip, c: calls.append(ip))
+        assert isinstance(p, OnPremNodeProvider)
+        n = p.create_node({}, {})
+        assert calls == [n]
