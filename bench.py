@@ -478,11 +478,15 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=180)
     ap.add_argument("--batch", type=int, default=0, help="0 = auto")
     ap.add_argument("--seq", type=int, default=1024)
-    ap.add_argument("--model", default="gpt2-125m",
-                    help="named model config for the train benchmark "
-                         "(gpt2-125m, llama-654m, llama-1b4)")
+    ap.add_argument("--model", default=None,
+                    help="named model config "
+                         "(gpt2-125m, llama-654m, llama-1b4); default "
+                         "gpt2-125m, except --serve-prefix defaults to "
+                         "llama-654m")
     ap.add_argument("--serve-prefix", action="store_true",
-                    help="prefix-caching serving scenario (TTFT speedup)")
+                    help="prefix-caching serving scenario (admission-"
+                         "wave device-time speedup; default model "
+                         "llama-654m)")
     ap.add_argument("--serve", action="store_true",
                     help="serving benchmark (req/s + TTFT) instead of "
                          "the train step")
@@ -491,9 +495,9 @@ def main() -> None:
     args = ap.parse_args()
 
     if args.serve_prefix:
-        model = args.model if args.model != "gpt2-125m" else "llama-654m"
-        bench_serve_prefix(args.quick, model=model)
+        bench_serve_prefix(args.quick, model=args.model or "llama-654m")
         return
+    args.model = args.model or "gpt2-125m"
     if args.serve:
         bench_serve(args.quick, model=args.model)
         return
