@@ -154,7 +154,9 @@ class LLMEngine:
 
     def __init__(self, cfg: TransformerConfig, params: Any, *,
                  num_slots: int = 4, max_seq_len: Optional[int] = None,
-                 top_k: int = 0, seed: int = 0, decode_block: int = 64):
+                 top_k: int = 0, seed: int = 0, decode_block: int = 64,
+                 auto_prefix_min_hits: int = 0,
+                 auto_prefix_lens: Sequence[int] = (64, 128, 256, 512)):
         self.cfg = cfg
         self.params = params
         self.num_slots = num_slots
@@ -198,6 +200,18 @@ class LLMEngine:
         self.max_cached_prefixes = 8
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
+        # Automatic capture (vLLM's "automatic" in automatic prefix
+        # caching, at registered-prefix granularity): count block-length
+        # prompt prefixes at submit; one that repeats
+        # auto_prefix_min_hits times registers itself on the next engine
+        # tick (registration prefills once — done engine-side so no
+        # client submit blocks on it). 0 = off.
+        self.auto_prefix_min_hits = int(auto_prefix_min_hits)
+        self.auto_prefix_lens = tuple(sorted(auto_prefix_lens))
+        self._auto_counts: "OrderedDict[tuple, int]" = OrderedDict()
+        self._auto_pending: deque = deque()
+        self._auto_inflight: set = set()
+        self.prefix_register_failures = 0
         # aggregate stats
         self.decode_ticks = 0
         self.tokens_out = 0
@@ -221,10 +235,65 @@ class LLMEngine:
             req.id = self._next_id
             self._next_id += 1
         req.submit_ts = time.monotonic()
+        if self.auto_prefix_min_hits > 0:
+            self._note_prefix_candidates(prompt)
         with self.lock:
             self.waiting.append(req)
         self._work.set()
         return req
+
+    def _note_prefix_candidates(self, prompt: Sequence[int]) -> None:
+        """Count the LONGEST applicable block-length prefix of this
+        prompt (shorter nested lengths would register too, then never
+        serve a hit — longest-match always wins); enqueue it for
+        engine-side registration once hot. Bounded table (LRU, 512)."""
+        L = 0
+        for cand in self.auto_prefix_lens:
+            if cand < len(prompt) and cand < self.max_seq_len - 1:
+                L = cand
+        if L == 0:
+            return
+        key = tuple(int(t) for t in prompt[:L])
+        with self.lock:
+            if key in self._prefixes or key in self._auto_inflight:
+                return
+            n = self._auto_counts.get(key, 0) + 1
+            self._auto_counts[key] = n
+            self._auto_counts.move_to_end(key)
+            if n >= self.auto_prefix_min_hits:
+                del self._auto_counts[key]
+                self._auto_inflight.add(key)
+                self._auto_pending.append(key)
+            while len(self._auto_counts) > 512:
+                self._auto_counts.popitem(last=False)
+
+    def _drain_auto_registrations(self) -> bool:
+        """Register ONE pending hot prefix per tick (each registration
+        is a prefill-sized dispatch; spreading them keeps admission
+        latency bounded)."""
+        with self.lock:
+            if not self._auto_pending:
+                return False
+            key = self._auto_pending.popleft()
+        try:
+            self.register_prefix(key)
+        except ValueError:
+            # The documented race: prompt family no longer fits (e.g.
+            # max_seq_len shrunk relative to the candidate length).
+            pass
+        except Exception:  # noqa: BLE001 — device/XLA failure
+            # Dropped, counted, and logged: a silently-vanishing hot
+            # prefix would read as "caching stopped working".
+            self.prefix_register_failures += 1
+            import logging
+
+            logging.getLogger("ray_tpu.serve").warning(
+                "auto prefix registration failed (len %d); dropping",
+                len(key), exc_info=True)
+        finally:
+            with self.lock:
+                self._auto_inflight.discard(key)
+        return True
 
     def register_prefix(self, tokens: Sequence[int]) -> None:
         """Precompute + pin the KV of a shared prompt prefix (system
@@ -569,6 +638,8 @@ class LLMEngine:
         exact; the host only lags by one block in observing tokens, so
         EOS/finish frees a slot one tick late (bounded overshoot, same
         class as mid-block overshoot). Returns False when idle."""
+        registered = (self._drain_auto_registrations()
+                      if self.auto_prefix_min_hits > 0 else False)
         admitted = self._admit()
         outs = self._early_first_tokens()
         # Snapshot: a concurrent stop()/_fail_all may None-out entries
@@ -644,7 +715,7 @@ class LLMEngine:
         prev, self._pending = self._pending, block
         if prev is not None:
             self._process_block(prev)
-        return bool(admitted or outs or block or prev)
+        return bool(admitted or outs or block or prev or registered)
 
     def _process_block(self, block) -> None:
         """Fetch a dispatched decode block's tokens and emit them.
@@ -747,11 +818,14 @@ class LLMServer:
 
     def __init__(self, cfg: TransformerConfig, params: Any = None, *,
                  num_slots: int = 4, max_seq_len: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0, auto_prefix_min_hits: int = 0,
+                 auto_prefix_lens: Sequence[int] = (64, 128, 256, 512)):
         if params is None:
             params = init_params(cfg, jax.random.key(seed))
         self.engine = LLMEngine(cfg, params, num_slots=num_slots,
-                                max_seq_len=max_seq_len)
+                                max_seq_len=max_seq_len,
+                                auto_prefix_min_hits=auto_prefix_min_hits,
+                                auto_prefix_lens=auto_prefix_lens)
         self.engine.start()
 
     def generate(self, prompt: Sequence[int], *, max_new_tokens: int = 64,

@@ -371,6 +371,64 @@ class TestPrefixCaching:
         with pytest.raises(ValueError, match="room"):
             eng.register_prefix([1] * 40)
 
+    def test_auto_capture_registers_hot_prefixes(self):
+        """auto_prefix_min_hits: a block-length prefix seen N times
+        registers itself; later prompts hit it and outputs stay
+        identical to an uncached engine."""
+        cfg, params = self._model()
+        rng = np.random.RandomState(6)
+        hot = list(rng.randint(0, cfg.vocab_size, size=8))
+        prompts = [hot + list(rng.randint(0, cfg.vocab_size, size=n))
+                   for n in (3, 5, 2, 7, 4)]
+
+        base = LLMEngine(cfg, params, num_slots=2, max_seq_len=64)
+        expected = []
+        for p in prompts:
+            r = base.submit(p, max_new_tokens=4)
+            while base.step():
+                pass
+            expected.append(r.result(timeout=5))
+
+        eng = LLMEngine(cfg, params, num_slots=2, max_seq_len=64,
+                        auto_prefix_min_hits=2, auto_prefix_lens=(8,))
+        got = []
+        for p in prompts:
+            r = eng.submit(p, max_new_tokens=4)
+            while eng.step():
+                pass
+            got.append(r.result(timeout=5))
+        assert got == expected
+        st = eng.stats()
+        assert st["cached_prefixes"] == 1      # hot prefix captured
+        assert st["prefix_hits"] >= 2          # later prompts hit it
+
+    def test_auto_capture_burst_dedup(self):
+        """A burst of identical prompts must enqueue ONE registration,
+        not one per submission past the threshold."""
+        cfg, params = self._model()
+        eng = LLMEngine(cfg, params, num_slots=2, max_seq_len=64,
+                        auto_prefix_min_hits=2, auto_prefix_lens=(8,))
+        hot = list(range(1, 9))
+        reqs = [eng.submit(hot + [10 + i], max_new_tokens=2)
+                for i in range(10)]          # all before the first tick
+        assert len(eng._auto_pending) == 1
+        while eng.step():
+            pass
+        for r in reqs:
+            r.result(timeout=5)
+        assert eng.stats()["cached_prefixes"] == 1
+        assert not eng._auto_pending and not eng._auto_inflight
+
+    def test_auto_capture_off_by_default(self):
+        cfg, params = self._model()
+        eng = LLMEngine(cfg, params, num_slots=1, max_seq_len=64)
+        for _ in range(3):
+            r = eng.submit([1, 2, 3, 4, 5, 6, 7, 8, 9], max_new_tokens=2)
+            while eng.step():
+                pass
+            r.result(timeout=5)
+        assert eng.stats()["cached_prefixes"] == 0
+
     def test_temperature_rides_suffix_path(self):
         """Sampled (non-greedy) requests through the prefix path run to
         completion with valid tokens."""
