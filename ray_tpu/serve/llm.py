@@ -243,38 +243,60 @@ class LLMEngine:
         return req
 
     def _note_prefix_candidates(self, prompt: Sequence[int]) -> None:
-        """Count the LONGEST applicable block-length prefix of this
-        prompt (shorter nested lengths would register too, then never
-        serve a hit — longest-match always wins); enqueue it for
-        engine-side registration once hot. Bounded table (LRU, 512)."""
-        L = 0
-        for cand in self.auto_prefix_lens:
-            if cand < len(prompt) and cand < self.max_seq_len - 1:
-                L = cand
-        if L == 0:
-            return
-        key = tuple(int(t) for t in prompt[:L])
+        """Count every applicable block-length prefix BEYOND what a
+        registered prefix already covers. Counting only the longest
+        length would miss the feature's main target — a hot short
+        system prompt followed by divergent user content (all
+        longest-length keys distinct, none ever hot); counting covered
+        lengths would re-register what longest-match already serves.
+        Hot keys enqueue for engine-side registration; the drain's
+        longest-first + covered-skip keeps nested keys of identical
+        prompts from each costing a registration. Bounded table
+        (LRU, 512)."""
+        tokens = [int(t) for t in prompt]
         with self.lock:
-            if key in self._prefixes or key in self._auto_inflight:
-                return
-            n = self._auto_counts.get(key, 0) + 1
-            self._auto_counts[key] = n
-            self._auto_counts.move_to_end(key)
-            if n >= self.auto_prefix_min_hits:
-                del self._auto_counts[key]
-                self._auto_inflight.add(key)
-                self._auto_pending.append(key)
+            covered = 0
+            for reg in self._prefixes:
+                if (len(reg) > covered and len(reg) < len(tokens)
+                        and tokens[:len(reg)] == list(reg)):
+                    covered = len(reg)
+            for L in self.auto_prefix_lens:
+                if L >= len(tokens) or L >= self.max_seq_len - 1:
+                    break
+                if L <= covered:
+                    continue
+                key = tuple(tokens[:L])
+                if key in self._prefixes or key in self._auto_inflight:
+                    continue
+                n = self._auto_counts.get(key, 0) + 1
+                self._auto_counts[key] = n
+                self._auto_counts.move_to_end(key)
+                if n >= self.auto_prefix_min_hits:
+                    del self._auto_counts[key]
+                    self._auto_inflight.add(key)
+                    self._auto_pending.append(key)
             while len(self._auto_counts) > 512:
                 self._auto_counts.popitem(last=False)
 
     def _drain_auto_registrations(self) -> bool:
         """Register ONE pending hot prefix per tick (each registration
         is a prefill-sized dispatch; spreading them keeps admission
-        latency bounded)."""
+        latency bounded). Longest pending first; a pending key that is
+        a PREFIX of an already-registered one is dropped — its prompts
+        are almost always served by the longer registration, and if
+        genuinely divergent traffic reappears it simply re-accumulates."""
         with self.lock:
-            if not self._auto_pending:
+            while self._auto_pending:
+                key = max(self._auto_pending, key=len)
+                self._auto_pending.remove(key)
+                if any(len(reg) >= len(key)
+                       and reg[:len(key)] == key
+                       for reg in self._prefixes):
+                    self._auto_inflight.discard(key)
+                    continue
+                break
+            else:
                 return False
-            key = self._auto_pending.popleft()
         try:
             self.register_prefix(key)
         except ValueError:

@@ -121,7 +121,9 @@ class TPESearcher(Searcher):
                  n_initial: int = 8, gamma: float = 0.25,
                  n_candidates: int = 24, seed: Optional[int] = None):
         assert mode in ("min", "max")
-        self.space = param_space
+        # Flat internal view (nested user spaces welcome); every config
+        # leaves through suggest() re-nested via _unflatten_config.
+        self.space = _flatten_space(param_space)
         self.metric = metric
         self.mode = mode
         self.limit = num_samples
@@ -141,8 +143,8 @@ class TPESearcher(Searcher):
             cfg = self._random_config()
         else:
             cfg = self._model_config()
-        self._pending[trial_id] = cfg
-        return cfg
+        self._pending[trial_id] = cfg        # internal state stays flat
+        return _unflatten_config(cfg)
 
     def _model_config(self) -> Dict[str, Any]:
         """Model-guided suggestion once past the random phase —
@@ -264,7 +266,9 @@ class GPSearcher(TPESearcher):
         self.length_scale = length_scale
         self.noise = noise
         self.xi = xi
-        self._num_keys = [k for k, v in param_space.items()
+        # From the FLAT view super().__init__ built — nested user spaces
+        # must resolve the same dims the sampler iterates.
+        self._num_keys = [k for k, v in self.space.items()
                           if isinstance(v, (Float, Integer))]
 
     def _model_config(self) -> Dict[str, Any]:
@@ -375,8 +379,8 @@ class BOHBSearcher(TPESearcher):
             cfg = self._random_config()
         else:
             cfg = self._tpe_config()
-        self._pending[trial_id] = cfg
-        return cfg
+        self._pending[trial_id] = cfg        # internal state stays flat
+        return _unflatten_config(cfg)
 
     def on_trial_complete(self, trial_id: str,
                           result: Optional[Dict[str, Any]] = None) -> None:
@@ -398,13 +402,43 @@ class BOHBSearcher(TPESearcher):
         self._observed = [(nv, c) for _, nv, c in self._budgeted]
 
 
+_SEP = "\x1f"  # flatten separator: cannot appear in sane config keys
+
+
+def _flatten_space(space: Dict[str, Any], prefix: str = ""
+                   ) -> Dict[str, Any]:
+    flat: Dict[str, Any] = {}
+    for k, v in space.items():
+        kk = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten_space(v, kk + _SEP))
+        else:
+            flat[kk] = v
+    return flat
+
+
+def _unflatten_config(cfg: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in cfg.items():
+        parts = k.split(_SEP)
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return out
+
+
 def generate_variants(param_space: Dict[str, Any], num_samples: int,
                       seed: Optional[int] = None
                       ) -> Iterator[Dict[str, Any]]:
     """Grid dims form a cartesian product; each product point is repeated
     num_samples times with fresh random draws for Domain dims
-    (reference variant_generator semantics)."""
+    (reference variant_generator semantics). NESTED dicts are searched
+    through: {"train_loop_config": {"lr": grid_search(...)}} works — the
+    space is flattened for resolution and each config is re-nested
+    (reference: variant_generator's recursive resolution)."""
     rng = random.Random(seed)
+    param_space = _flatten_space(param_space)
     grid_keys = [k for k, v in param_space.items()
                  if isinstance(v, GridSearch)]
     import itertools
@@ -420,4 +454,4 @@ def generate_variants(param_space: Dict[str, Any], num_samples: int,
                     cfg[k] = v.sample(rng)
                 else:
                     cfg[k] = v
-            yield cfg
+            yield _unflatten_config(cfg)

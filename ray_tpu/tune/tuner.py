@@ -161,11 +161,84 @@ def _drop_snapshot(ckpt) -> None:
         shutil.rmtree(ckpt.as_directory(), ignore_errors=True)
 
 
+def _deep_merge_dict(dst: dict, src: dict) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge_dict(dst[k], v)
+        else:
+            dst[k] = v
+
+
+def _trainer_to_trainable(trainer) -> Callable:
+    """Wrap a Trainer INSTANCE as a function trainable (reference:
+    Tuner(trainer, param_space={"train_loop_config": {...}}) —
+    base_trainer.py as_trainable): each trial deep-copies the trainer,
+    merges its sampled config onto matching attributes (nested dicts
+    merge — {"train_loop_config": {"params": {...}}} reaches a GBDT
+    trainer's booster params), runs fit() in the trial, and re-reports
+    the result's metric history through the trial session."""
+    import cloudpickle
+
+    blob = cloudpickle.dumps(trainer)
+
+    def run(config):
+        import cloudpickle as cp
+
+        from ..train.session import get_context, report
+
+        t = cp.loads(blob)
+        for k, v in (config or {}).items():
+            cur = getattr(t, k, None)
+            if isinstance(v, dict) and isinstance(cur, dict):
+                _deep_merge_dict(cur, v)
+            else:
+                setattr(t, k, v)
+        # Per-trial storage name: concurrent trials must not write the
+        # same checkpoint directory.
+        try:
+            t.run_config.name = ((t.run_config.name or "trial")
+                                 + "-" + get_context().get_trial_name())
+        except Exception:  # noqa: BLE001 — no session (direct call)
+            pass
+        result = t.fit()
+        if result.error is not None:
+            raise result.error
+        history = result.metrics_history or [result.metrics]
+        # The whole history arrives AFTER fit() finished, so a scheduler
+        # STOP lands mid-replay as StopTrial; the stop saves no compute
+        # here — swallow it and still deliver the final row (report()
+        # enqueues the item BEFORE raising, so delivery is ordered).
+        from ..train.session import StopTrial
+
+        try:
+            for m in history[:-1]:
+                report(dict(m))
+        except StopTrial:
+            pass
+        # Final report carries the LAST value of every metric seen —
+        # a trainer's final history row is often a bare completion
+        # record ({"done": True}), which would otherwise become the
+        # trial's metrics and hide the training curve's endpoint —
+        # plus the fitted trainer's checkpoint, so
+        # get_best_result().checkpoint loads the tuned model.
+        final: Dict[str, Any] = {}
+        for m in history:
+            final.update(m)
+        try:
+            report(final, checkpoint=result.checkpoint)
+        except StopTrial:
+            pass
+
+    return run
+
+
 class Tuner:
     def __init__(self, trainable: Callable, *,
                  param_space: Optional[Dict[str, Any]] = None,
                  tune_config: Optional[TuneConfig] = None,
                  run_config: Optional[RunConfig] = None):
+        if not callable(trainable) and hasattr(trainable, "fit"):
+            trainable = _trainer_to_trainable(trainable)
         self.trainable = trainable
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()

@@ -402,6 +402,28 @@ class TestPrefixCaching:
         assert st["cached_prefixes"] == 1      # hot prefix captured
         assert st["prefix_hits"] >= 2          # later prompts hit it
 
+    def test_auto_capture_divergent_continuations(self):
+        """The feature's main target: a hot SHORT system prompt with
+        varied longer content. Longest-length keys are all distinct —
+        the short length must still be counted and captured."""
+        cfg, params = self._model()
+        rng = np.random.RandomState(8)
+        hot = list(rng.randint(0, cfg.vocab_size, size=8))
+        eng = LLMEngine(cfg, params, num_slots=2, max_seq_len=64,
+                        auto_prefix_min_hits=2,
+                        auto_prefix_lens=(8, 16))
+        for i in range(4):
+            # 16+ tokens each, all continuations distinct.
+            user = list(rng.randint(0, cfg.vocab_size, size=12))
+            r = eng.submit(hot + user, max_new_tokens=2)
+            while eng.step():
+                pass
+            r.result(timeout=5)
+        st = eng.stats()
+        assert st["cached_prefixes"] >= 1
+        assert tuple(hot) in eng._prefixes     # the short key, not a 16-key
+        assert st["prefix_hits"] >= 1
+
     def test_auto_capture_burst_dedup(self):
         """A burst of identical prompts must enqueue ONE registration,
         not one per submission past the threshold."""

@@ -1,0 +1,96 @@
+"""Tuner over Trainer instances + nested param spaces (reference
+coverage model: python/ray/tune/tests/test_tuner.py — Tuner(trainer)
+with param_space reaching train_loop_config, variant_generator nested
+resolution)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ray_tpu.tune.search import generate_variants, grid_search, uniform
+
+
+class TestNestedVariants:
+    def test_nested_grid(self):
+        space = {"train_loop_config": {"lr": grid_search([0.1, 0.2]),
+                                       "fixed": 7},
+                 "top": grid_search(["a", "b"])}
+        out = list(generate_variants(space, 1, seed=0))
+        assert len(out) == 4
+        assert all(c["train_loop_config"]["fixed"] == 7 for c in out)
+        lrs = {c["train_loop_config"]["lr"] for c in out}
+        assert lrs == {0.1, 0.2}
+        assert {c["top"] for c in out} == {"a", "b"}
+
+    def test_nested_random(self):
+        space = {"a": {"b": {"c": uniform(0.0, 1.0)}}}
+        outs = list(generate_variants(space, 3, seed=1))
+        vals = [c["a"]["b"]["c"] for c in outs]
+        assert len(set(vals)) == 3
+        assert all(0.0 <= v <= 1.0 for v in vals)
+
+
+def _frame(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.1 * rng.normal(size=n)
+    df = pd.DataFrame({f"x{i}": X[:, i] for i in range(4)})
+    df["y"] = y
+    return df
+
+
+class TestTunerOverTrainers:
+    def test_tune_gbdt_params(self, ray_start, tmp_path):
+        """Tuner(XGBoostTrainer) grid over booster params: the sampled
+        config must reach the booster through train_loop_config."""
+        from ray_tpu import data
+        from ray_tpu.train import RunConfig, ScalingConfig, XGBoostTrainer
+        from ray_tpu.tune import TuneConfig, Tuner
+
+        trainer = XGBoostTrainer(
+            params={"objective": "reg:squarederror", "eta": 0.3},
+            label_column="y",
+            datasets={"train": data.from_pandas(_frame())},
+            num_boost_round=6,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="inner", storage_path=str(tmp_path)),
+        )
+        grid = Tuner(
+            trainer,
+            param_space={"train_loop_config": {
+                "params": {"max_depth": grid_search([1, 5])}}},
+            tune_config=TuneConfig(metric="train-rmse", mode="min",
+                                   num_samples=1),
+            run_config=RunConfig(name="exp", storage_path=str(tmp_path)),
+        ).fit()
+        assert len(grid) == 2
+        assert all(r.error is None for r in grid)
+        best = grid.get_best_result()
+        # Depth-5 trees fit the training set far better than stumps.
+        assert best.config["train_loop_config"]["params"]["max_depth"] == 5
+        rmses = {r.config["train_loop_config"]["params"]["max_depth"]:
+                 r.metrics["train-rmse"] for r in grid}
+        assert rmses[5] < rmses[1] * 0.8
+
+    def test_tune_tpu_trainer_loop_config(self, ray_start, tmp_path):
+        from ray_tpu import train as rt_train
+        from ray_tpu.train import RunConfig, ScalingConfig, TpuTrainer
+        from ray_tpu.tune import TuneConfig, Tuner
+
+        def loop(config):
+            rt_train.report({"score": config["base"] * config["mult"]})
+
+        trainer = TpuTrainer(
+            loop, train_loop_config={"base": 10, "mult": 1},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="inner", storage_path=str(tmp_path)))
+        grid = Tuner(
+            trainer,
+            param_space={"train_loop_config": {
+                "mult": grid_search([2, 3])}},
+            tune_config=TuneConfig(metric="score", mode="max",
+                                   num_samples=1),
+            run_config=RunConfig(name="exp2", storage_path=str(tmp_path)),
+        ).fit()
+        assert sorted(r.metrics["score"] for r in grid) == [20, 30]
+        assert grid.get_best_result().metrics["score"] == 30
