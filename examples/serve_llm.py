@@ -8,8 +8,14 @@ sharing decode ticks.
 """
 
 import json
+import os
 import threading
 import urllib.request
+
+# Hard-set (not setdefault): this demo serves a tiny random-weight model
+# — it must not grab (or fail to share) a real TPU chip another process
+# holds. Real-chip serving runs through `python bench.py --serve`.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import ray_tpu as ray
 from ray_tpu import serve
@@ -21,6 +27,11 @@ PORT = 18260
 def main():
     ray.init(num_cpus=2, num_tpus=0)
 
+    # The shared system prompt every request starts with: registered
+    # once per replica, its prefill cost is paid once (prefix caching);
+    # auto_prefix_min_hits would capture it automatically instead.
+    SYSTEM_PROMPT = list(range(1, 17))
+
     @serve.deployment
     class Llm:
         def __init__(self):
@@ -28,13 +39,16 @@ def main():
 
             self.server = LLMServer(configs.tiny_test(), num_slots=4,
                                     max_seq_len=128)
+            self.server.register_prefix(SYSTEM_PROMPT)
 
         def __call__(self, payload):
             out = self.server.generate(
-                payload["prompt"],
+                SYSTEM_PROMPT + payload["prompt"],
                 max_new_tokens=payload.get("max_tokens", 16))
+            st = self.server.stats()
             return {"tokens": out["tokens"],
-                    "ttft_ms": round(out["ttft_s"] * 1e3, 1)}
+                    "ttft_ms": round(out["ttft_s"] * 1e3, 1),
+                    "prefix_hits": st["prefix_hits"]}
 
     serve.run(Llm.bind(), name="llm", http=True, http_port=PORT)
 

@@ -410,9 +410,11 @@ def _flatten_space(space: Dict[str, Any], prefix: str = ""
     flat: Dict[str, Any] = {}
     for k, v in space.items():
         kk = f"{prefix}{k}"
-        if isinstance(v, dict):
+        if isinstance(v, dict) and v:
             flat.update(_flatten_space(v, kk + _SEP))
         else:
+            # {} stays a leaf constant — recursing would drop the key
+            # from every generated config.
             flat[kk] = v
     return flat
 

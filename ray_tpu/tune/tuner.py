@@ -188,11 +188,27 @@ def _trainer_to_trainable(trainer) -> Callable:
 
         t = cp.loads(blob)
         for k, v in (config or {}).items():
-            cur = getattr(t, k, None)
+            if not hasattr(t, k):
+                # A misnamed dimension would silently setattr a dead
+                # attribute and every trial would train identically.
+                raise ValueError(
+                    f"param_space key {k!r} is not an attribute of "
+                    f"{type(t).__name__}; hyperparameters usually nest "
+                    "under 'train_loop_config' (e.g. {'train_loop_"
+                    "config': {'params': {...}}} for GBDT trainers)")
+            cur = getattr(t, k)
             if isinstance(v, dict) and isinstance(cur, dict):
                 _deep_merge_dict(cur, v)
             else:
                 setattr(t, k, v)
+        # PBT exploit / trial restore: the session's start checkpoint
+        # must reach the trainer's workers, or every exploit re-fits
+        # from scratch (train loops read it via train.get_checkpoint()).
+        from ..train.session import _get_session
+
+        sess = _get_session()
+        if sess is not None and sess.start_checkpoint is not None:
+            t.resume_from_checkpoint = sess.start_checkpoint
         # Per-trial storage name: concurrent trials must not write the
         # same checkpoint directory.
         try:
