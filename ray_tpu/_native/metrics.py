@@ -31,6 +31,7 @@ def _load():
                                     ctypes.c_double]
     lib.rtm_gauge_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                   ctypes.c_double]
+    lib.rtm_series_remove.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     lib.rtm_hist_observe.argtypes = [
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_double,
         ctypes.POINTER(ctypes.c_double), ctypes.c_int]
@@ -65,6 +66,10 @@ def counter_add(name: str, labels: str, value: float) -> None:
 
 def gauge_set(name: str, labels: str, value: float) -> None:
     _load().rtm_gauge_set(name.encode(), labels.encode(), value)
+
+
+def series_remove(name: str, labels: str) -> None:
+    _load().rtm_series_remove(name.encode(), labels.encode())
 
 
 def make_bounds(bounds: Sequence[float]):

@@ -161,6 +161,8 @@ class MetricsHistory:
                     self._spill_fh = None
 
     _prom_gauges = None
+    _published_nodes: set = frozenset()
+    _spilled_seen: dict
 
     def _publish_prom(self, point, rt) -> None:
         """Re-export the sampled series (head + every daemon's heartbeat
@@ -174,6 +176,8 @@ class MetricsHistory:
 
         if self._prom_gauges is None:
             tag = ("node_id",)
+            self._published_nodes = set()
+            self._spilled_seen = {}
             self._prom_gauges = {
                 "cpu_percent": mm.Gauge(
                     "ray_tpu_node_cpu_percent", "Host CPU percent", tag),
@@ -187,9 +191,9 @@ class MetricsHistory:
                 "running": mm.Gauge(
                     "ray_tpu_node_running_tasks",
                     "Tasks executing on the node", tag),
-                "spilled": mm.Gauge(
-                    "ray_tpu_node_spilled_tasks",
-                    "Spillable pushes the node refused", tag),
+                "spilled": mm.Counter(
+                    "ray_tpu_node_spilled_tasks_total",
+                    "Spillable pushes the node refused (cumulative)", tag),
                 "object_store_bytes": mm.Gauge(
                     "ray_tpu_object_store_bytes",
                     "Shared-memory arena bytes in use", tag),
@@ -211,17 +215,42 @@ class MetricsHistory:
         put("pending_tasks", point.get("pending_tasks"), head_id)
         if rt is None:
             return
+        live = {head_id}
         for node in rt.scheduler.nodes():
             load = getattr(node, "last_load", None)
-            if not load:
+            if not load or not getattr(node, "alive", True):
                 continue
+            live.add(node.node_id)
             host = load.get("host") or {}
             put("cpu_percent", host.get("cpu_percent"), node.node_id)
             put("mem_percent", host.get("mem_percent"), node.node_id)
             put("disk_percent", host.get("disk_percent"), node.node_id)
             put("queued", load.get("queued"), node.node_id)
             put("running", load.get("running"), node.node_id)
-            put("spilled", load.get("spilled"), node.node_id)
+            # The load report carries a cumulative count; the exported
+            # counter advances by the delta (a restarted daemon resets
+            # its count — treat a decrease as a fresh start).
+            cum = load.get("spilled")
+            if cum is not None:
+                prev = self._spilled_seen.get(node.node_id, 0.0)
+                delta = float(cum) - prev if float(cum) >= prev \
+                    else float(cum)
+                self._spilled_seen[node.node_id] = float(cum)
+                if delta > 0:
+                    g["spilled"].inc(delta, {"node_id": node.node_id})
+        # Dead/removed nodes must stop being exported, or their last
+        # cpu/mem/queued values freeze in the scrape forever.
+        for node_id in self._published_nodes - live:
+            for key in ("cpu_percent", "mem_percent", "disk_percent",
+                        "queued", "running"):
+                try:
+                    g[key].remove({"node_id": node_id})
+                except Exception:  # noqa: BLE001
+                    pass
+            # _spilled_seen is intentionally kept: a rejoining daemon
+            # reports the same cumulative count, and forgetting the
+            # prior value would re-add its whole history to the counter.
+        self._published_nodes = live
 
     def dump(self, limit: int = 0):
         with self._lock:

@@ -303,20 +303,32 @@ class Dataset:
             out.append(d)
         return out
 
-    def train_test_split(self, test_size: float, *,
+    def train_test_split(self, test_size, *,
                          shuffle: bool = False, seed: Optional[int] = None
                          ) -> Tuple["Dataset", "Dataset"]:
-        """(train, test) split by fraction (reference: dataset.py
-        train_test_split)."""
-        if not 0 < test_size < 1:
-            raise ValueError("test_size must be in (0, 1)")
+        """(train, test) split by fraction or absolute test-row count
+        (reference: dataset.py train_test_split — float in (0,1) or
+        int number of test rows)."""
+        is_int = isinstance(test_size, (int, np.integer)) \
+            and not isinstance(test_size, bool)
+        if is_int:
+            if test_size <= 0:
+                raise ValueError("int test_size must be positive")
+        elif not 0 < test_size < 1:
+            raise ValueError("float test_size must be in (0, 1)")
         # Materialize ONCE before counting: count() + split_at_indices()
         # on a lazy pipeline would execute it twice — wrong row counts
         # if any stage is nondeterministic, double work otherwise.
         ds = (self.random_shuffle(seed=seed) if shuffle
               else self).materialize()
         n = ds.count()
-        cut = n - int(n * test_size)
+        if is_int:
+            if test_size >= n:
+                raise ValueError(
+                    f"test_size {test_size} must be < dataset size {n}")
+            cut = n - test_size
+        else:
+            cut = n - int(n * test_size)
         train, test = ds.split_at_indices([cut])
         return train, test
 
@@ -325,14 +337,31 @@ class Dataset:
         """Bernoulli row sample (reference: dataset.py random_sample)."""
         if not 0 <= fraction <= 1:
             raise ValueError("fraction must be in [0, 1]")
-        # Per-block sampling (reference random_sample does the same);
-        # with a fixed seed every block draws the same mask pattern for
-        # equal block sizes — deterministic, but correlated across
-        # blocks, same caveat as the reference.
+        # Per-block sampling (reference random_sample does the same).
+        # Each block folds a digest of its own bytes into the seed, so
+        # equal-sized blocks draw INDEPENDENT masks (a bare shared seed
+        # would select identical row positions in every block) while the
+        # overall sample stays deterministic for a given dataset+seed.
         def _sample(batch):
+            import zlib
+
             cols = dict(batch)
             n = len(next(iter(cols.values()))) if cols else 0
-            rng = np.random.default_rng(seed)
+            if seed is None:
+                rng = np.random.default_rng()
+            else:
+                salt = 0
+                for k in sorted(cols):
+                    a = np.asarray(cols[k])
+                    if a.dtype == object:
+                        a = np.asarray([str(x) for x in a.ravel()[:256]],
+                                       dtype="U")
+                    # Slice BEFORE tobytes: a full-column copy per block
+                    # just to CRC 64 KB would dominate the sample cost.
+                    flat = a.ravel()[:65536 // max(1, a.itemsize)]
+                    salt = zlib.crc32(
+                        np.ascontiguousarray(flat).tobytes(), salt)
+                rng = np.random.default_rng([seed, n, salt])
             keep = rng.random(n) < fraction
             return {k: np.asarray(v)[keep] for k, v in cols.items()}
 

@@ -1,6 +1,7 @@
 from .checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
-from .session import get_context, get_dataset_shard, get_mesh, report
+from .session import (get_checkpoint, get_context, get_dataset_shard,
+                      get_mesh, report)
 from .step import TrainState, init_state, make_optimizer, make_train_step
 from .trainer import Result, TpuTrainer
 
@@ -16,7 +17,8 @@ __all__ = [
     # would break `import *` in this image.
     "ScalingConfig", "RunConfig", "FailureConfig",
     "CheckpointConfig", "Checkpoint", "CheckpointManager", "save_pytree",
-    "load_pytree", "report", "get_context", "get_dataset_shard", "get_mesh",
+    "load_pytree", "report", "get_checkpoint", "get_context",
+    "get_dataset_shard", "get_mesh",
     "TrainState", "init_state", "make_optimizer", "make_train_step",
 ]
 

@@ -660,6 +660,11 @@ class Booster:
     def margin(self, X: np.ndarray,
                num_rounds: Optional[int] = None) -> np.ndarray:
         X = self._coerce(X)
+        # When early stopping fired, inference defaults to the best
+        # iteration — xgboost/lightgbm semantics — not the overfit tail;
+        # pass num_rounds=len(trees) explicitly to use every round.
+        if num_rounds is None and self.best_iteration is not None:
+            num_rounds = self.best_iteration + 1
         rounds = (self.trees[:num_rounds] if num_rounds is not None
                   else self.trees)
         out = np.full((X.shape[0], self.K), self.cfg.base_score,
@@ -1007,13 +1012,13 @@ class GBDTTrainer(TpuTrainer):
             run_config=run_config,
             datasets=datasets)
 
-    def _fit_once(self) -> Result:
+    def _fit_once(self, manager) -> Result:
         # Fresh collective group per attempt: a failure-retry must never
         # rejoin a coordinator holding a crashed gang's round state.
         import uuid
 
         self.train_loop_config["group"] = f"_gbdt:{uuid.uuid4().hex[:12]}"
-        return super()._fit_once()
+        return super()._fit_once(manager)
 
     @classmethod
     def get_model(cls, checkpoint: Checkpoint) -> Booster:

@@ -131,7 +131,7 @@ class HorovodTrainer(ProcessPlaneTrainerMixin, TpuTrainer):
         self._require_worker_procs("HorovodTrainer")
         return super().fit()
 
-    def _fit_once(self) -> Result:
+    def _fit_once(self, manager) -> Result:
         # Fresh rendezvous server per attempt (a retry must not reuse a
         # dead gang's KV state — same reasoning as TorchTrainer's
         # per-attempt address).
@@ -140,7 +140,7 @@ class HorovodTrainer(ProcessPlaneTrainerMixin, TpuTrainer):
         try:
             self.train_loop = _make_hvd_loop(
                 self._user_loop, self.horovod_config, hostname, port)
-            return super()._fit_once()
+            return super()._fit_once(manager)
         finally:
             stop = getattr(server, "stop_server", None) or getattr(
                 server, "stop", None)

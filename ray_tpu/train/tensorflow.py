@@ -103,13 +103,13 @@ class TensorflowTrainer(ProcessPlaneTrainerMixin, TpuTrainer):
         self._require_worker_procs("TensorflowTrainer")
         return super().fit()
 
-    def _fit_once(self) -> Result:
+    def _fit_once(self, manager) -> Result:
         # Fresh cluster spec per attempt (ports could be dead after a
         # FailureConfig retry).
         n = self.scaling_config.num_workers
         workers = [f"127.0.0.1:{p}" for p in _free_ports(n)]
         self.train_loop = _make_tf_loop(self._user_loop, workers)
-        return super()._fit_once()
+        return super()._fit_once(manager)
 
 
 def prepare_dataset_shard(dataset):

@@ -98,7 +98,7 @@ class TorchTrainer(ProcessPlaneTrainerMixin, TpuTrainer):
         self._require_worker_procs("TorchTrainer")
         return super().fit()
 
-    def _fit_once(self) -> Result:
+    def _fit_once(self, manager) -> Result:
         # Fresh rendezvous address per attempt: picking it at __init__
         # would race other port users until fit() AND reuse a possibly-
         # dead address across FailureConfig retries.
@@ -106,7 +106,7 @@ class TorchTrainer(ProcessPlaneTrainerMixin, TpuTrainer):
         addr = f"127.0.0.1:{_free_port()}"
         self.train_loop = _make_torch_loop(
             self._user_loop, tc.backend, addr, tc.init_timeout_s)
-        return super()._fit_once()
+        return super()._fit_once(manager)
 
 
 # ---------------------------------------------------------------------------

@@ -62,13 +62,14 @@ class _TrainWorker:
         self.plan = cloudpickle.loads(plan_bytes) if plan_bytes else None
 
     def run(self, fn_bytes: bytes, loop_config: Optional[Dict[str, Any]],
-            dataset_shards: Optional[Dict[str, Any]]):
+            dataset_shards: Optional[Dict[str, Any]],
+            start_checkpoint=None):
         import cloudpickle
 
         fn = cloudpickle.loads(fn_bytes)
         session = _TrainSession(
             self.rank, self.world_size, self.name, loop_config,
-            dataset_shards, self.plan)
+            dataset_shards, self.plan, start_checkpoint=start_checkpoint)
 
         def _target():
             _set_session(session)
@@ -109,12 +110,18 @@ class TpuTrainer:
                  *, train_loop_config: Optional[Dict[str, Any]] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
-                 datasets: Optional[Dict[str, Any]] = None):
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
         self.train_loop = train_loop_per_worker
         self.train_loop_config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
+        # Checkpoint every worker's session starts from; train loops read
+        # it via train.get_checkpoint() (reference:
+        # base_trainer.py resume_from_checkpoint → session checkpoint).
+        # Tune's PBT exploit and trial restore set this between fits.
+        self.resume_from_checkpoint = resume_from_checkpoint
         # Subclass hook (TorchTrainer): rank -> SchedulingStrategy,
         # replacing the default placement-group gang placement.
         self._strategy_factory: Optional[Callable[[int], Any]] = None
@@ -123,31 +130,37 @@ class TpuTrainer:
     def fit(self) -> Result:
         failures_allowed = self.run_config.failure_config.max_failures
         attempt = 0
-        while True:
-            try:
-                return self._fit_once()
-            except (KeyboardInterrupt, SystemExit):
-                raise  # user interrupts are not trial failures
-            except Exception as e:  # noqa: BLE001
-                attempt += 1
-                if failures_allowed >= 0 and attempt > failures_allowed:
-                    storage = self.run_config.resolve_storage()
-                    return Result(error=e, path=storage)
-                logger.warning(
-                    "Training attempt %d failed (%s); restarting worker "
-                    "group (%d restarts left).", attempt,
-                    type(e).__name__, failures_allowed - attempt)
-
-    def _fit_once(self) -> Result:
-        import cloudpickle
-
-        sc = self.scaling_config
-        n = sc.num_workers
         storage = self.run_config.resolve_storage()
         cc = self.run_config.checkpoint_config
         manager = CheckpointManager(
             storage, cc.num_to_keep, cc.checkpoint_score_attribute,
             cc.checkpoint_score_order)
+        while True:
+            try:
+                return self._fit_once(manager)
+            except (KeyboardInterrupt, SystemExit):
+                raise  # user interrupts are not trial failures
+            except Exception as e:  # noqa: BLE001
+                attempt += 1
+                if failures_allowed >= 0 and attempt > failures_allowed:
+                    return Result(error=e, path=storage)
+                # Restarted groups resume from the newest checkpoint the
+                # failed attempt registered (reference: FailureConfig
+                # recovery restores the latest reported checkpoint).
+                latest = manager.latest()
+                if latest is not None:
+                    self.resume_from_checkpoint = latest
+                logger.warning(
+                    "Training attempt %d failed (%s); restarting worker "
+                    "group (%d restarts left).", attempt,
+                    type(e).__name__, failures_allowed - attempt)
+
+    def _fit_once(self, manager: CheckpointManager) -> Result:
+        import cloudpickle
+
+        sc = self.scaling_config
+        n = sc.num_workers
+        storage = self.run_config.resolve_storage()
 
         # Gang placement: one bundle per worker (reference:
         # BackendExecutor start creates the PG; TPU-native default is
@@ -200,7 +213,8 @@ class TpuTrainer:
             fn_bytes = cloudpickle.dumps(self.train_loop)
             streams = [
                 w.run.options(num_returns="streaming").remote(
-                    fn_bytes, self.train_loop_config, shards_per_worker[r])
+                    fn_bytes, self.train_loop_config, shards_per_worker[r],
+                    self.resume_from_checkpoint)
                 for r, w in enumerate(workers)
             ]
 

@@ -229,17 +229,29 @@ def translate_deepspeed_config(ds_config: Dict[str, Any],
             opt_kwargs["weight_decay"] = float(p["weight_decay"])
 
     sched = dict(ds.pop("scheduler", {}) or {})
+    sched_unsupported = None
     if sched:
         sp = dict(sched.get("params", {}) or {})
         if "warmup_num_steps" in sp and sp["warmup_num_steps"] != _AUTO:
             opt_kwargs["warmup_steps"] = int(sp["warmup_num_steps"])
         if "total_num_steps" in sp and sp["total_num_steps"] != _AUTO:
             opt_kwargs["total_steps"] = int(sp["total_num_steps"])
+        # Only WarmupLR/WarmupDecayLR map onto the native warmup-cosine
+        # schedule; any other scheduler type is replaced by it — record
+        # the substitution (same 'recorded, not dropped' policy as the
+        # other no-analog keys).
+        styp = str(sched.get("type", ""))
+        if styp and styp not in ("WarmupLR", "WarmupDecayLR"):
+            sched_unsupported = {
+                "type": styp,
+                "replaced_with": "native warmup-cosine"}
 
     # Everything else (offload_param, offload_optimizer, overlap_comm,
     # allgather_bucket_size, aio, ...) has no XLA analog: XLA manages
     # HBM and overlaps collectives itself. Recorded, not dropped.
     unsupported = {}
+    if sched_unsupported is not None:
+        unsupported["scheduler"] = sched_unsupported
     if zero:
         unsupported["zero_optimization"] = zero
     unsupported.update({k: ds[k] for k in list(ds)})

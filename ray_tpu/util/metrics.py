@@ -121,6 +121,17 @@ class Gauge(Metric):
         with self._lock:
             self._values[k] = float(value)
 
+    def remove(self, tags: Optional[Dict[str, str]] = None) -> None:
+        """Drop one labeled series — a gauge for a departed entity (dead
+        node, removed replica) must stop being exported, not freeze at
+        its last value."""
+        k = self._merged(tags)
+        if _NATIVE:
+            _native.series_remove(self._name, self._labels(k))
+            return
+        with self._lock:
+            self._values.pop(k, None)
+
 
 class Histogram(Metric):
     def __init__(self, name, description="",

@@ -71,6 +71,12 @@ void rtm_gauge_set(const char* name, const char* labels, double v) {
   series(name, labels, KIND_GAUGE).value = v;
 }
 
+void rtm_series_remove(const char* name, const char* labels) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_series.erase(std::make_pair(std::string(name),
+                                std::string(labels ? labels : "")));
+}
+
 void rtm_hist_observe(const char* name, const char* labels, double v,
                       const double* bounds, int nb) {
   std::lock_guard<std::mutex> lock(g_mu);

@@ -460,3 +460,38 @@ class TestSplitsAndSampling:
         assert 700 < n < 1300  # loose: per-block correlated draws
         assert data.range(50).random_sample(0.0).count() == 0
         assert data.range(50).random_sample(1.0).count() == 50
+
+
+class TestSplitSampleRegressions:
+    def test_train_test_split_int(self, ray_start):
+        """int test_size = absolute test-row count (reference:
+        dataset.py train_test_split accepts both)."""
+        from ray_tpu import data
+
+        train, test = data.range(100).train_test_split(10)
+        assert train.count() == 90
+        assert test.count() == 10
+        assert [r["id"] for r in test.take_all()] == list(range(90, 100))
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            data.range(10).train_test_split(10)  # >= dataset size
+        with _pytest.raises(ValueError):
+            data.range(10).train_test_split(0)
+
+    def test_random_sample_blocks_decorrelated(self, ray_start):
+        """Equal-sized blocks must NOT select identical row positions
+        when seeded — each block's mask is salted by its content."""
+        from ray_tpu import data
+
+        # 4 equal blocks of 500 rows.
+        ds = data.range(2000).repartition(4).materialize()
+        kept = [r["id"] for r in
+                ds.random_sample(0.5, seed=3).take_all()]
+        positions = [sorted(i % 500 for i in kept if i // 500 == b)
+                     for b in range(4)]
+        assert not all(p == positions[0] for p in positions[1:])
+        # Determinism: same dataset + seed -> same sample.
+        kept2 = [r["id"] for r in
+                 ds.random_sample(0.5, seed=3).take_all()]
+        assert kept == kept2

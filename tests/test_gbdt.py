@@ -301,3 +301,23 @@ class TestDistributedTrainers:
             XGBoostTrainer(
                 params={"objective": "reg:squarederror"}, label_column="y",
                 datasets={"eval": data.from_items([{"y": 1.0, "x": 1.0}])})
+
+
+class TestEarlyStopInference:
+    def test_predict_defaults_to_best_iteration(self):
+        """After early stopping, margin/predict use best_iteration+1
+        rounds by default (xgboost/lightgbm semantics), not the overfit
+        tail — explicit num_rounds still overrides."""
+        X, y = _regression_data(800, seed=3)
+        Xv, yv = _regression_data(300, seed=4)
+        b = train({"objective": "reg:squarederror", "eta": 0.5,
+                   "max_depth": 6}, (X, y), num_boost_round=500,
+                  evals=[((Xv, yv), "valid")], early_stopping_rounds=5)
+        assert b.best_iteration is not None
+        best = b.best_iteration
+        default_m = b.margin(Xv)
+        np.testing.assert_allclose(
+            default_m, b.margin(Xv, num_rounds=best + 1))
+        if b.num_boosted_rounds > best + 1:
+            full_m = b.margin(Xv, num_rounds=b.num_boosted_rounds)
+            assert not np.allclose(default_m, full_m)

@@ -96,3 +96,21 @@ def test_declared_but_unsampled_still_exposed():
     text = nm.collect()
     assert "# HELP never_hit_total errors" in text
     assert "# TYPE never_hit_total counter" in text
+
+
+def test_gauge_remove_drops_series():
+    """Gauge.remove drops one labeled series from the exposition — a
+    departed node must stop being exported, not freeze at its last
+    value."""
+    from ray_tpu.util import metrics as mm
+
+    g = mm.Gauge("test_remove_gauge", "t", ("node",))
+    g.set(1.0, {"node": "a"})
+    g.set(2.0, {"node": "b"})
+    text = mm.prometheus_text()
+    assert 'node="a"' in text and 'node="b"' in text
+    g.remove({"node": "a"})
+    text = mm.prometheus_text()
+    assert 'node="a"' not in text or \
+        'test_remove_gauge{node="a"}' not in text
+    assert 'node="b"' in text

@@ -176,3 +176,72 @@ def test_trainer_real_train_step(ray_start, tmp_path):
     assert result.checkpoint is not None
     restored = result.checkpoint.to_pytree()
     assert "params" in restored
+
+
+def test_trainer_resume_from_checkpoint(ray_start, tmp_path):
+    """resume_from_checkpoint reaches every worker's session:
+    train.get_checkpoint() returns it inside the loop (reference:
+    base_trainer.py resume_from_checkpoint -> session.get_checkpoint)."""
+    import ray_tpu.train as train
+    from ray_tpu.train import (
+        Checkpoint,
+        RunConfig,
+        ScalingConfig,
+        TpuTrainer,
+    )
+
+    start = Checkpoint.from_pytree({"step": 7})
+
+    def loop():
+        ckpt = train.get_checkpoint()
+        assert ckpt is not None
+        train.report({"resumed_step": int(ckpt.to_pytree()["step"])})
+
+    result = TpuTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t7", storage_path=str(tmp_path)),
+        resume_from_checkpoint=start,
+    ).fit()
+    assert result.error is None
+    assert result.metrics["resumed_step"] == 7
+
+
+def test_trainer_retry_resumes_from_latest_checkpoint(ray_start, tmp_path):
+    """A FailureConfig restart hands the new worker group the newest
+    checkpoint the failed attempt registered (reference: FailureConfig
+    recovery restores the latest reported checkpoint)."""
+    import ray_tpu.train as train
+    from ray_tpu.train import (
+        Checkpoint,
+        FailureConfig,
+        RunConfig,
+        ScalingConfig,
+        TpuTrainer,
+    )
+
+    latch = tmp_path / "attempted"
+
+    def loop():
+        start = train.get_checkpoint()
+        first = 0 if start is None else int(start.to_pytree()["step"]) + 1
+        for i in range(first, 4):
+            train.report(
+                {"step": i},
+                checkpoint=Checkpoint.from_pytree({"step": i}))
+            if i == 1 and not latch.exists():
+                latch.write_text("1")
+                raise RuntimeError("crash after step 1")
+
+    result = TpuTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="t8", storage_path=str(tmp_path / "store"),
+            failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert result.error is None
+    # Second attempt resumed at step 2 (checkpoint step 1 + 1): the
+    # surviving history is exactly steps 2 and 3 — no refit from zero.
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps == [2, 3]

@@ -94,3 +94,43 @@ class TestTunerOverTrainers:
         ).fit()
         assert sorted(r.metrics["score"] for r in grid) == [20, 30]
         assert grid.get_best_result().metrics["score"] == 30
+
+
+class TestExploitCheckpointPlumbing:
+    def test_session_checkpoint_reaches_trainer_workers(
+            self, ray_start, tmp_path):
+        """PBT exploit / trial restore: the trial session's
+        start_checkpoint must reach the wrapped trainer's workers via
+        train.get_checkpoint() — not silently refit from scratch."""
+        import ray_tpu.train as train
+        from ray_tpu.train import (
+            Checkpoint, RunConfig, ScalingConfig, TpuTrainer)
+        from ray_tpu.train.session import (
+            _TrainSession, _set_session)
+        from ray_tpu.tune.tuner import _trainer_to_trainable
+
+        def loop():
+            ckpt = train.get_checkpoint()
+            step = -1 if ckpt is None else int(ckpt.to_pytree()["step"])
+            train.report({"resumed_from": step})
+
+        trainer = TpuTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="inner",
+                                 storage_path=str(tmp_path)))
+        trainable = _trainer_to_trainable(trainer)
+
+        exploited = Checkpoint.from_pytree({"step": 41})
+        sess = _TrainSession(0, 1, "trial-x", {},
+                             start_checkpoint=exploited)
+        _set_session(sess)
+        try:
+            trainable({})
+        finally:
+            _set_session(None)
+        items = []
+        while not sess.queue.empty():
+            items.append(sess.queue.get())
+        finals = [i.metrics for i in items if i is not None]
+        assert any(m.get("resumed_from") == 41 for m in finals)

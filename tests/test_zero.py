@@ -232,3 +232,27 @@ class TestGatedAdapters:
 
         assert train.translate_deepspeed_config is not None
         assert train.HorovodConfig is not None
+
+
+def test_translate_records_unsupported_scheduler():
+    """A DeepSpeed scheduler with no native analog (OneCycle, ...) is
+    replaced by the warmup-cosine schedule AND recorded in unsupported
+    — the module's 'recorded, not dropped' policy."""
+    from ray_tpu.train.zero import translate_deepspeed_config
+
+    t = translate_deepspeed_config({
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "scheduler": {"type": "OneCycle",
+                      "params": {"cycle_min_lr": 1e-5}},
+    }, n_devices=8)
+    assert t.unsupported["scheduler"]["type"] == "OneCycle"
+
+    t2 = translate_deepspeed_config({
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 10}},
+    }, n_devices=8)
+    assert "scheduler" not in t2.unsupported
+    assert t2.optimizer_kwargs["warmup_steps"] == 10
