@@ -77,6 +77,9 @@ class Cluster:
     def remove_node(self, node_id: str) -> None:
         assert self._rt is not None
         self._rt.scheduler.remove_node(node_id)
+        from .core.placement_group import repair_for_dead_node
+
+        repair_for_dead_node(self._rt, node_id)
         # Stop heartbeating: the daemon's health expiry declares the
         # death (we do NOT eagerly deregister — that would bypass the
         # failure-detection path under test).

@@ -40,6 +40,15 @@ class PlacementGroup:
         self._committed = False
         self._ready = threading.Event()
         self._failed: Optional[str] = None
+        # Guards bundle_nodes mutation vs removal: a reserve/repair
+        # thread must never commit charges into a PG that was removed
+        # while it was looping (the charge would leak forever).
+        self._state_lock = threading.Lock()
+        # Serializes repair threads: two nodes dying close together
+        # must not compute used_nodes concurrently (STRICT_SPREAD
+        # would co-locate both replacement bundles).
+        self._repair_lock = threading.Lock()
+        self._removed = False
 
     # -- API parity -------------------------------------------------------
     def ready(self):
@@ -61,11 +70,31 @@ class PlacementGroup:
         return _pg_ready.remote()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until placed. timeout=None waits the gang-schedule
+        timeout and RAISES if still unplaced — silently returning False
+        would let a gang run against an unplaced group and queue its
+        tasks forever."""
         ok = self._ready.wait(
             timeout if timeout is not None else config.gang_schedule_timeout_s)
         if self._failed:
             raise RuntimeError(
                 f"Placement group {self.id} failed: {self._failed}")
+        if not ok and timeout is None:
+            # The reserve thread's deadline is independent of ours: a
+            # commit can land exactly at our timeout. Re-check before
+            # declaring failure, or a successfully charged placement
+            # hides behind a 'never placed' error and leaks.
+            if self._ready.is_set():
+                if self._failed:
+                    raise RuntimeError(
+                        f"Placement group {self.id} failed: "
+                        f"{self._failed}")
+                return True
+            raise RuntimeError(
+                f"Placement group {self.id} not placed within "
+                f"{config.gang_schedule_timeout_s}s "
+                f"(bundles={self.bundle_specs}, "
+                f"strategy={self.strategy})")
         return ok
 
     def bundle_nodes(self, index: int) -> List[str]:
@@ -105,22 +134,130 @@ def _live_placement_groups() -> List[PlacementGroup]:
 
 def remove_placement_group(pg: PlacementGroup) -> None:
     rt = global_runtime()
-    for i, node_id in enumerate(pg._bundle_nodes):
-        if node_id is not None:
-            rt.scheduler.release(node_id, ResourceSet(pg.bundle_specs[i]))
-            pg._bundle_nodes[i] = None
+    with pg._state_lock:
+        pg._removed = True
+        for i, node_id in enumerate(pg._bundle_nodes):
+            if node_id is not None:
+                rt.scheduler.release(node_id,
+                                     ResourceSet(pg.bundle_specs[i]))
+                pg._bundle_nodes[i] = None
     getattr(rt, "placement_groups", {}).pop(pg.id, None)
 
 
 def _reserve(rt, pg: PlacementGroup) -> None:
     deadline = time.monotonic() + config.gang_schedule_timeout_s
     while time.monotonic() < deadline:
+        if pg._removed:
+            return
         if _try_reserve_all(rt, pg):
             pg._ready.set()
             return
         time.sleep(0.02)
     pg._failed = "timed out acquiring bundles"
     pg._ready.set()
+
+
+def repair_for_dead_node(rt, node_id: str) -> None:
+    """Re-place bundles lost to a dead node onto survivors (reference:
+    gcs_placement_group_manager.h — the GCS reschedules a PG's bundles
+    when their node dies; tasks targeting the bundle stay queued until
+    the new placement commits). Without this, an actor submitted into a
+    bundle whose node died waits forever."""
+    for pg in list(getattr(rt, "placement_groups", {}).values()):
+        with pg._state_lock:
+            lost = [i for i, n in enumerate(pg._bundle_nodes)
+                    if n == node_id]
+            if not lost:
+                continue
+            for i in lost:
+                pg._bundle_nodes[i] = None
+            # _bundle_available is deliberately NOT reset: in-flight
+            # tasks charged into the lost bundle release their charge
+            # back through release_task when their dispatch observes
+            # the death — resetting here would double-credit and
+            # oversubscribe the replacement node. _committed stays
+            # True so surviving bundles keep dispatching.
+        threading.Thread(
+            target=_re_reserve, args=(rt, pg, lost), daemon=True,
+            name=f"pg-repair-{pg.id[:6]}").start()
+
+
+def _re_reserve(rt, pg: PlacementGroup, indices: List[int]) -> None:
+    deadline = time.monotonic() + config.gang_schedule_timeout_s
+    while time.monotonic() < deadline:
+        if pg._removed:
+            return
+        with pg._repair_lock:
+            ok = _try_reserve_indices(rt, pg, indices)
+        if ok:
+            pg._ready.set()
+            # Queued tasks targeting the repaired bundles re-evaluate.
+            rt.scheduler._pump()
+            return
+        time.sleep(0.05)
+    pg._failed = f"could not re-place bundles {indices} after node death"
+    pg._ready.set()
+
+
+def _candidates(nodes: List[NodeState], strategy: str,
+                used_nodes: set, anchor: Optional[str]
+                ) -> List[NodeState]:
+    if strategy == "STRICT_PACK":
+        return ([n for n in nodes if n.node_id == anchor]
+                if anchor else nodes)
+    if strategy == "STRICT_SPREAD":
+        return [n for n in nodes if n.node_id not in used_nodes]
+    if strategy == "SPREAD":
+        # Soft spread: fresh nodes first, but fall back to reusing a
+        # node — "fresh or nodes" would pin the bundle to a fresh node
+        # that can never fit it (e.g. the unschedulable driver head)
+        # while a survivor has room.
+        fresh = [n for n in nodes if n.node_id not in used_nodes]
+        return fresh + [n for n in nodes if n.node_id in used_nodes]
+    # PACK: prefer already-used nodes.
+    return ([n for n in nodes if n.node_id in used_nodes] +
+            [n for n in nodes if n.node_id not in used_nodes])
+
+
+def _try_reserve_indices(rt, pg: PlacementGroup,
+                         indices: List[int]) -> bool:
+    """Phase-1 reserve for a SUBSET of bundles (repair path), honoring
+    the strategy against the surviving bundles' placements."""
+    nodes = [n for n in rt.scheduler.nodes()
+             if n.alive and getattr(n, "schedulable", True)]
+    placed: List[tuple] = []
+    used_nodes = {n for n in pg._bundle_nodes if n is not None}
+    anchor = next((n for n in pg._bundle_nodes if n is not None), None)
+    chosen: Dict[int, NodeState] = {}
+    for i in indices:
+        rs = ResourceSet(pg.bundle_specs[i])
+        ok = False
+        for node in _candidates(nodes, pg.strategy, used_nodes, anchor):
+            with rt.scheduler._lock:
+                if rs.fits(node.available):
+                    node.charge(rs)
+                    ok = True
+            if ok:
+                placed.append((node, rs))
+                chosen[i] = node
+                used_nodes.add(node.node_id)
+                if anchor is None:
+                    anchor = node.node_id
+                break
+        if not ok:
+            for node, rs2 in placed:
+                rt.scheduler.release(node.node_id, rs2)
+            return False
+    with pg._state_lock:
+        if pg._removed or any(
+                not node.alive for node in chosen.values()):
+            for node, rs2 in placed:
+                rt.scheduler.release(node.node_id, rs2)
+            return False
+        for i, node in chosen.items():
+            pg._bundle_nodes[i] = node.node_id
+        pg._committed = True
+    return True
 
 
 def _try_reserve_all(rt, pg: PlacementGroup) -> bool:
@@ -130,7 +267,8 @@ def _try_reserve_all(rt, pg: PlacementGroup) -> bool:
     a full rollback is the abort — single-process equivalent of the
     reference's PrepareBundleResources/CommitBundleResources 2PC.
     """
-    nodes = [n for n in rt.scheduler.nodes() if n.alive]
+    nodes = [n for n in rt.scheduler.nodes()
+             if n.alive and getattr(n, "schedulable", True)]
     placed: List[tuple] = []
 
     def rollback():
@@ -141,16 +279,8 @@ def _try_reserve_all(rt, pg: PlacementGroup) -> bool:
     used_nodes: set = set()
     for i, spec in enumerate(pg.bundle_specs):
         rs = ResourceSet(spec)
-        if pg.strategy == "STRICT_PACK":
-            cands = [chosen[0]] if i > 0 and chosen[0] else nodes
-        elif pg.strategy == "STRICT_SPREAD":
-            cands = [n for n in nodes if n.node_id not in used_nodes]
-        elif pg.strategy == "SPREAD":
-            fresh = [n for n in nodes if n.node_id not in used_nodes]
-            cands = fresh or nodes
-        else:  # PACK: prefer already-used nodes
-            cands = ([n for n in nodes if n.node_id in used_nodes] +
-                     [n for n in nodes if n.node_id not in used_nodes])
+        anchor = chosen[0].node_id if (i > 0 and chosen[0]) else None
+        cands = _candidates(nodes, pg.strategy, used_nodes, anchor)
         ok = False
         for node in cands:
             if node is None:
@@ -175,8 +305,17 @@ def _try_reserve_all(rt, pg: PlacementGroup) -> bool:
             rollback()
             return False
     # Phase 2: commit — record bundle→node mapping and open the bundles
-    # for task charging.
-    for i, node in enumerate(chosen):
-        pg._bundle_nodes[i] = node.node_id
-    pg._committed = True
+    # for task charging. Atomic vs removal: committing into a PG that
+    # was removed mid-reserve would leak the charges forever. A node
+    # that died between the snapshot and now must also abort: a bundle
+    # committed onto a removed node never dispatches and — the death
+    # event having already fired — would never be repaired either.
+    with pg._state_lock:
+        if pg._removed or any(
+                node is None or not node.alive for node in chosen):
+            rollback()
+            return False
+        for i, node in enumerate(chosen):
+            pg._bundle_nodes[i] = node.node_id
+        pg._committed = True
     return True

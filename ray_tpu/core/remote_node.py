@@ -191,6 +191,13 @@ class RemotePlane:
             self._pulls.drop(node_id)
         node = self.rt.scheduler.remove_node(node_id)
         logger.warning("remote node %s died", node_id)
+        # Placement groups with bundles on the dead node re-place them
+        # on survivors (reference: gcs_placement_group_manager.h
+        # reschedule-on-node-death); queued work targeting those
+        # bundles dispatches once the repair commits.
+        from .placement_group import repair_for_dead_node
+
+        repair_for_dead_node(self.rt, node_id)
         # Actors placed there: sever their connections so their mailbox
         # threads observe the death NOW and run restart-with-replacement
         # instead of waiting on a half-open TCP connection.

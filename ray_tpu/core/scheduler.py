@@ -283,8 +283,10 @@ class Scheduler:
             # (reference: bundle resource accounting in
             # placement_group_resource_manager.h).
             pg = strat.placement_group
-            if not getattr(pg, "_committed", False):
-                return None  # bundles not placed yet — keep queued
+            # Per-bundle gating (no whole-PG _committed check): after a
+            # node death, surviving bundles keep dispatching while the
+            # lost ones are re-placed — an unplaced bundle is simply
+            # absent from _bundle_nodes and skipped below.
             idx = strat.placement_group_bundle_index
             indices = ([idx] if idx >= 0
                        else range(len(pg._bundle_available)))

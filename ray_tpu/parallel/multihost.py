@@ -10,9 +10,11 @@ replaces the TCP store; collectives ride ICI via XLA).
 
 Coordinator discovery, in order:
 1. explicit arguments,
-2. the TPU pod env (TPU_WORKER_HOSTNAMES / TPU_WORKER_ID — set by GKE),
-3. the control-plane KV (first caller claims coordinatorship; peers
-   read the address) when a ControlClient is provided.
+2. the control-plane KV (rank 0 claims coordinatorship and publishes
+   its address; peers read it) when a ControlClient is provided — it
+   outranks the pod env because a caller passing a client is forming a
+   specific GANG, not joining the ambient pod,
+3. the TPU pod env (TPU_WORKER_HOSTNAMES / TPU_WORKER_ID — set by GKE).
 """
 
 from __future__ import annotations
@@ -31,7 +33,7 @@ def init_multihost(coordinator_address: Optional[str] = None,
                    process_id: Optional[int] = None,
                    *, control_client=None,
                    kv_key: str = "multihost/coordinator",
-                   port: int = DEFAULT_PORT) -> dict:
+                   port: Optional[int] = DEFAULT_PORT) -> dict:
     """Initialize jax.distributed across the pod. Returns the resolved
     {coordinator_address, num_processes, process_id}. Single-process
     (num_processes == 1) skips jax.distributed entirely — the common
@@ -43,12 +45,6 @@ def init_multihost(coordinator_address: Optional[str] = None,
     if process_id is None:
         process_id = accelerators.worker_id()
 
-    if coordinator_address is None:
-        hosts = os.environ.get(accelerators.WORKER_HOSTNAMES_ENV, "")
-        first = next((h.strip() for h in hosts.split(",") if h.strip()),
-                     None)
-        if first is not None:
-            coordinator_address = f"{first}:{port}"
     if coordinator_address is None and control_client is not None:
         # KV rendezvous through the native control plane (reference
         # analog: the TCP-store address published via GCS internal KV).
@@ -60,6 +56,14 @@ def init_multihost(coordinator_address: Optional[str] = None,
         import time
 
         if process_id == 0:
+            if port is None:
+                # Rank 0 binds the coordinator, so only a probe on
+                # RANK 0's host proves the port free — a driver-side
+                # probe is a cross-host TOCTOU. Peers learn the full
+                # address from the KV either way.
+                with socket.socket() as s:
+                    s.bind(("", 0))
+                    port = s.getsockname()[1]
             me = f"{socket.gethostbyname(socket.gethostname())}:{port}"
             control_client.kv_put(kv_key, me, overwrite=True)
             coordinator_address = me
@@ -77,7 +81,13 @@ def init_multihost(coordinator_address: Optional[str] = None,
                             f"{kv_key!r} within 60s")
                     time.sleep(0.2)
     if coordinator_address is None:
-        coordinator_address = f"127.0.0.1:{port}"
+        hosts = os.environ.get(accelerators.WORKER_HOSTNAMES_ENV, "")
+        first = next((h.strip() for h in hosts.split(",") if h.strip()),
+                     None)
+        if first is not None:
+            coordinator_address = f"{first}:{port or DEFAULT_PORT}"
+    if coordinator_address is None:
+        coordinator_address = f"127.0.0.1:{port or DEFAULT_PORT}"
 
     resolved = {
         "coordinator_address": coordinator_address,

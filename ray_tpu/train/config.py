@@ -25,6 +25,14 @@ class ScalingConfig:
     plan          — in-framework parallelism declaration (dp/fsdp/tp/sp/ep);
                     replaces the reference's use_gpu/NCCL wiring
     slice_id      — gang-schedule all workers onto one ICI slice
+    multihost     — rendezvous jax.distributed across the worker gang
+                    before the loop runs: every worker's jax.devices()
+                    then spans all workers' chips, and the SAME
+                    pjit/mesh code runs pod-wide (reference capability:
+                    train/torch/config.py:62 _setup_torch_process_group
+                    — a rank-0 store every worker joins; here the
+                    coordinator address travels through the control
+                    plane's KV).
     """
 
     num_workers: int = 1
@@ -34,6 +42,7 @@ class ScalingConfig:
     plan: Optional[ParallelPlan] = None
     slice_id: Optional[str] = None
     placement_strategy: str = "PACK"
+    multihost: bool = False
 
     def worker_resources(self) -> Dict[str, float]:
         r = {"CPU": self.cpus_per_worker}

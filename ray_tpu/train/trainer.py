@@ -15,6 +15,7 @@ one ICI slice.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import traceback
 from dataclasses import dataclass, field
@@ -63,7 +64,8 @@ class _TrainWorker:
 
     def run(self, fn_bytes: bytes, loop_config: Optional[Dict[str, Any]],
             dataset_shards: Optional[Dict[str, Any]],
-            start_checkpoint=None):
+            start_checkpoint=None, rendezvous: Optional[Dict[str, Any]]
+            = None):
         import cloudpickle
 
         fn = cloudpickle.loads(fn_bytes)
@@ -73,7 +75,24 @@ class _TrainWorker:
 
         def _target():
             _set_session(session)
+            joined = False
             try:
+                # Pin jax to the platform this worker's environment
+                # requests BEFORE any backend/rendezvous init: a
+                # sitecustomize-registered accelerator plugin can
+                # otherwise override the JAX_PLATFORMS env var and grab
+                # a chip the gang doesn't own.
+                plat = os.environ.get("JAX_PLATFORMS")
+                if plat and "," not in plat:
+                    import jax
+
+                    try:
+                        jax.config.update("jax_platforms", plat)
+                    except Exception:  # noqa: BLE001 — backend is live
+                        pass
+                if rendezvous is not None:
+                    self._join_gang(rendezvous)
+                    joined = True
                 import inspect
 
                 if loop_config is not None and len(
@@ -81,6 +100,13 @@ class _TrainWorker:
                     fn(loop_config)
                 else:
                     fn()
+                if joined:
+                    # Clean finish only: after a failure peers may be
+                    # stuck in a collective and shutdown would block;
+                    # the dedicated worker process dies with the actor.
+                    from ..parallel.multihost import shutdown_multihost
+
+                    shutdown_multihost()
             except BaseException as e:  # noqa: BLE001
                 session.error = e
             finally:
@@ -99,6 +125,42 @@ class _TrainWorker:
         if session.error is not None:
             raise session.error
         yield ReportItem({"__final__": True}, None, self.rank)
+
+    def _join_gang(self, rdv: Dict[str, Any]) -> None:
+        """jax.distributed rendezvous for this rank (reference:
+        backend_executor.py:124 start → worker group → rendezvous →
+        train; torch/config.py:62 TCP store ↔ here the coordinator
+        address rides the control plane's KV)."""
+        from ..parallel.multihost import init_multihost
+
+        from ..parallel import multihost as mh
+
+        if mh._initialized:
+            # jax.distributed.initialize is once-per-process: a second
+            # rank landing in this process would silently skip init and
+            # hang the whole gang at the coordinator. Surface it.
+            raise RuntimeError(
+                "multihost rank cannot share a process with another "
+                "rank (jax.distributed already initialized here); "
+                "ensure each worker gets its own OS process — daemon "
+                "placement or ray_tpu.init(num_worker_procs=...)")
+        client = None
+        if rdv.get("control_address"):
+            from .._native.control_client import ControlClient
+
+            host, _, port = rdv["control_address"].partition(":")
+            client = ControlClient(int(port), host=host)
+        try:
+            init_multihost(
+                coordinator_address=rdv.get("coordinator_address"),
+                num_processes=self.world_size,
+                process_id=self.rank,
+                control_client=client,
+                kv_key=rdv["kv_key"],
+                port=rdv["coordinator_port"])
+        finally:
+            if client is not None:
+                client.close()
 
 
 class TpuTrainer:
@@ -135,25 +197,65 @@ class TpuTrainer:
         manager = CheckpointManager(
             storage, cc.num_to_keep, cc.checkpoint_score_attribute,
             cc.checkpoint_score_order)
-        while True:
-            try:
-                return self._fit_once(manager)
-            except (KeyboardInterrupt, SystemExit):
-                raise  # user interrupts are not trial failures
-            except Exception as e:  # noqa: BLE001
-                attempt += 1
-                if failures_allowed >= 0 and attempt > failures_allowed:
-                    return Result(error=e, path=storage)
-                # Restarted groups resume from the newest checkpoint the
-                # failed attempt registered (reference: FailureConfig
-                # recovery restores the latest reported checkpoint).
-                latest = manager.latest()
-                if latest is not None:
-                    self.resume_from_checkpoint = latest
-                logger.warning(
-                    "Training attempt %d failed (%s); restarting worker "
-                    "group (%d restarts left).", attempt,
-                    type(e).__name__, failures_allowed - attempt)
+        # Retries resume from the newest checkpoint WITHIN this fit;
+        # the caller's resume_from_checkpoint is restored afterwards so
+        # a reused trainer instance (Tuner trials) starts fresh.
+        orig_resume = self.resume_from_checkpoint
+        try:
+            while True:
+                try:
+                    return self._fit_once(manager)
+                except (KeyboardInterrupt, SystemExit):
+                    raise  # user interrupts are not trial failures
+                except Exception as e:  # noqa: BLE001
+                    attempt += 1
+                    if failures_allowed >= 0 \
+                            and attempt > failures_allowed:
+                        return Result(error=e, path=storage)
+                    # Restarted groups resume from the newest checkpoint
+                    # the failed attempt registered (reference:
+                    # FailureConfig recovery restores the latest
+                    # reported checkpoint).
+                    latest = manager.latest()
+                    if latest is not None:
+                        self.resume_from_checkpoint = latest
+                    logger.warning(
+                        "Training attempt %d failed (%s); restarting "
+                        "worker group (%d restarts left).", attempt,
+                        type(e).__name__, failures_allowed - attempt)
+        finally:
+            self.resume_from_checkpoint = orig_resume
+
+    def _make_rendezvous(self, n: int) -> Dict[str, Any]:
+        """Per-attempt rendezvous spec: a fresh coordinator port and a
+        fresh KV key, so a retried gang can never join a crashed gang's
+        coordinator (reference: backend_executor re-creates the TCP
+        store on restart)."""
+        import uuid
+
+        from ..core.runtime import global_runtime
+
+        rt = global_runtime()
+        rdv: Dict[str, Any] = {
+            "coordinator_port": None,
+            "kv_key": f"multihost/{self.run_config.name or 'train'}/"
+                      f"{uuid.uuid4().hex[:12]}",
+            "control_address": None,
+            "coordinator_address": None,
+        }
+        if rt.remote_plane is not None:
+            # Cluster mode: rank 0 picks a port free on ITS host and
+            # publishes the coordinator address in the control plane's
+            # KV; peers poll it (SURVEY §3.3 — the rendezvous path the
+            # whole stack exists to serve).
+            rdv["control_address"] = rt.remote_plane.address
+        else:
+            # Single-machine worker processes share the driver's host,
+            # so a driver-side port probe is authoritative here.
+            port = _free_port()
+            rdv["coordinator_port"] = port
+            rdv["coordinator_address"] = f"127.0.0.1:{port}"
+        return rdv
 
     def _fit_once(self, manager: CheckpointManager) -> Result:
         import cloudpickle
@@ -166,6 +268,30 @@ class TpuTrainer:
         # BackendExecutor start creates the PG; TPU-native default is
         # PACK onto one slice).
         from .. import get as ray_get, kill as ray_kill
+
+        if sc.multihost and n > 1 and self._strategy_factory is None:
+            rt = None
+            from ..core.runtime import global_runtime
+
+            rt = global_runtime()
+            if rt.remote_plane is None:
+                # Local mode: each rank MUST be its own OS process —
+                # jax.distributed.initialize is once-per-process, so
+                # thread actors sharing the driver process cannot form
+                # a gang. Route ranks to dedicated worker processes
+                # (same plane the torch/TF trainers use).
+                if (rt.worker_pool is None
+                        or rt.worker_pool.num_workers < 1):
+                    raise RuntimeError(
+                        "ScalingConfig(multihost=True) outside a daemon "
+                        "cluster needs worker processes: call "
+                        "ray_tpu.init(num_worker_procs=...) or connect "
+                        "to a cluster (ray_tpu.init(address=...))")
+                from ..core.task import NodeAffinitySchedulingStrategy
+
+                self._strategy_factory = lambda rank: \
+                    NodeAffinitySchedulingStrategy(node_id="node-procs",
+                                                   soft=False)
 
         pg = None
         if self._strategy_factory is None:
@@ -211,10 +337,13 @@ class TpuTrainer:
                         shards_per_worker[r][name] = ds
 
             fn_bytes = cloudpickle.dumps(self.train_loop)
+            rendezvous = None
+            if sc.multihost and n > 1:
+                rendezvous = self._make_rendezvous(n)
             streams = [
                 w.run.options(num_returns="streaming").remote(
                     fn_bytes, self.train_loop_config, shards_per_worker[r],
-                    self.resume_from_checkpoint)
+                    self.resume_from_checkpoint, rendezvous)
                 for r, w in enumerate(workers)
             ]
 
@@ -244,8 +373,17 @@ class TpuTrainer:
             ]
             for t in threads:
                 t.start()
-            for t in threads:
-                t.join()
+            # Abort the attempt on the FIRST rank failure: surviving
+            # ranks may be blocked in a collective/rendezvous with the
+            # dead peer and their streams stay silent for minutes — the
+            # group teardown below unblocks them (reference:
+            # backend_executor shuts the whole worker group down when
+            # any worker fails).
+            while True:
+                alive = [t for t in threads if t.is_alive()]
+                if not alive or error is not None:
+                    break
+                alive[0].join(timeout=0.2)
         finally:
             for w in workers:
                 try:
@@ -263,6 +401,14 @@ class TpuTrainer:
             path=storage,
             metrics_history=history,
         )
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 class ProcessPlaneTrainerMixin:

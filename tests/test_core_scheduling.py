@@ -334,3 +334,85 @@ class TestLabelSelector:
             @ray.remote(label_selector="zone=us")
             def bad():
                 return 1
+
+
+class TestPlacementGroupRepair:
+    """PG bundles lost to node death re-place on survivors
+    (reference: gcs_placement_group_manager.h ReschedulePlacementGroup)
+    and reserve threads never leak charges into removed groups."""
+
+    def test_bundle_replaced_after_node_death(self, ray_start_cluster):
+        import ray_tpu as ray
+
+        cluster = ray_start_cluster
+        a = cluster.add_node(num_cpus=2)
+        b = cluster.add_node(num_cpus=2)
+        pg = ray.placement_group([{"CPU": 1}, {"CPU": 1}],
+                                 strategy="SPREAD")
+        pg.wait(timeout=None)
+        nodes = dict(enumerate(pg._bundle_nodes))
+        assert set(nodes.values()) == {a, b}
+        victim_idx = next(i for i, n in nodes.items() if n == b)
+        cluster.remove_node(b)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if pg._bundle_nodes[victim_idx] == a:
+                break
+            time.sleep(0.05)
+        assert pg._bundle_nodes[victim_idx] == a
+        # The repaired bundle still schedules work.
+        @ray.remote(num_cpus=1)
+        def where():
+            return ray.get_runtime_context().get_node_id()
+
+        strat = ray.PlacementGroupSchedulingStrategy(
+            placement_group=pg,
+            placement_group_bundle_index=victim_idx)
+        assert ray.get(
+            where.options(scheduling_strategy=strat).remote()) == a
+        ray.remove_placement_group(pg)
+
+    def test_repair_of_removed_pg_leaks_nothing(self, ray_start_cluster):
+        """A PG removed while its repair thread is still looping must
+        not commit charges afterwards (the leak starves every later
+        placement)."""
+        import ray_tpu as ray
+        from ray_tpu.core import runtime as _runtime
+
+        cluster = ray_start_cluster
+        cluster.add_node(num_cpus=1)
+        b = cluster.add_node(num_cpus=1)
+        pg = ray.placement_group([{"CPU": 1}, {"CPU": 1}],
+                                 strategy="SPREAD")
+        pg.wait(timeout=None)
+        # Kill b: its bundle repair cannot fit anywhere (every other
+        # node is full with the OTHER bundle) so the repair thread
+        # loops; removing the PG mid-repair must stop it cleanly.
+        cluster.remove_node(b)
+        time.sleep(0.2)
+        ray.remove_placement_group(pg)
+        time.sleep(0.5)
+        rt = _runtime.global_runtime()
+        for n in rt.scheduler.nodes():
+            assert not any(n.charged.to_dict().values()), (
+                n.node_id, n.charged.to_dict())
+        # The survivor's full capacity is placeable again.
+        pg2 = ray.placement_group([{"CPU": 1}])
+        assert pg2.wait(timeout=10)
+        ray.remove_placement_group(pg2)
+
+    def test_wait_none_raises_on_unplaceable(self, ray_start,
+                                             monkeypatch):
+        """pg.wait(timeout=None) must raise when placement cannot
+        happen — silently returning False lets gangs run against an
+        unplaced group."""
+        import pytest as _pytest
+
+        import ray_tpu as ray
+        from ray_tpu._private.config import config as _cfg
+
+        monkeypatch.setattr(_cfg, "gang_schedule_timeout_s", 1.0)
+        pg = ray.placement_group([{"CPU": 64.0}])  # never fits
+        with _pytest.raises(RuntimeError):
+            pg.wait(timeout=None)
+        ray.remove_placement_group(pg)

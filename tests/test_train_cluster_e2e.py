@@ -1,0 +1,262 @@
+"""The full reference call-stack, end-to-end, over real daemons.
+
+VERDICT r3 #1 / SURVEY §3.3: driver → placement-group gang on
+RealCluster node daemons → TpuTrainer workers in dedicated daemon
+worker processes → jax.distributed rendezvous through the control
+plane's KV → one spanning mesh over every worker's devices → sharded
+train step (psum needs both hosts' data) → checkpoints → daemon
+SIGKILL mid-run → FailureConfig restart resumes from the newest
+checkpoint and completes training.
+
+Reference composition being mirrored:
+python/ray/train/_internal/backend_executor.py:124 (start → worker
+group in PG → rendezvous → train) + train/torch/config.py:62
+(_setup_torch_process_group: rank-0 store every worker joins).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu._native import control_client as cc
+from ray_tpu.cluster_utils import RealCluster
+
+pytestmark = pytest.mark.skipif(
+    not cc.available(), reason="control plane not built")
+
+
+@pytest.fixture(scope="module")
+def train_cluster():
+    """Control plane + two daemons, each daemon's workers seeing TWO
+    virtual CPU devices — a 2-host × 2-chip pod in miniature."""
+    # 15s health expiry: four fresh worker processes compiling jax on a
+    # 1-core box can starve a daemon's 200ms heartbeat thread past the
+    # default window, and a spurious DEAD breaks the recovery
+    # assertions. Real kills are still detected instantly through the
+    # severed actor connections.
+    cluster = RealCluster(health_timeout_ms=15000)
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+    try:
+        cluster.add_node(num_cpus=2, env=env)
+        cluster.add_node(num_cpus=2, env=env)
+        cluster.connect()
+        yield cluster
+    finally:
+        cluster.shutdown()
+
+
+def _make_loop(scratch_dir: str):
+    """SPMD training loop: replicated scalar w descends toward the
+    global data mean — the gradient is a psum over BOTH processes'
+    shards, so a wrong rendezvous produces a wrong optimum."""
+
+    def loop(config):
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as P
+
+        import ray_tpu.train as train
+        from ray_tpu.parallel import ParallelPlan, make_mesh
+        from ray_tpu.train import Checkpoint
+
+        ctx = train.get_context()
+        rank, world = ctx.get_world_rank(), ctx.get_world_size()
+        # Placement proof for the chaos test: which daemon hosts me.
+        with open(os.path.join(scratch_dir, f"rank{rank}.node"),
+                  "w") as f:
+            f.write(os.environ.get("RAY_TPU_NODE_ID", "?"))
+
+        assert jax.process_count() == world, jax.process_count()
+        devs = jax.devices()
+        assert len(devs) == 2 * world, devs
+        mesh = make_mesh(ParallelPlan(dp=2 * world), devices=devs)
+
+        ckpt = train.get_checkpoint()
+        if ckpt is None:
+            w, start = 0.0, 0
+        else:
+            st = ckpt.to_pytree()
+            w, start = float(st["w"]), int(st["step"]) + 1
+
+        # Host r contributes [r+1, r+1]: global mean = 1.5 for world=2.
+        x_local = np.full((2,), rank + 1.0, np.float32)
+        x = multihost_utils.host_local_array_to_global_array(
+            x_local, mesh, P(("dcn", "pp", "dp")))
+        n_global = 2.0 * world
+
+        def grad_loss(w_arr, x_arr):
+            g = lax.psum(jnp.sum(2.0 * (w_arr - x_arr)), "dp") / n_global
+            l = lax.psum(jnp.sum((w_arr - x_arr) ** 2), "dp") / n_global
+            return g, l
+
+        f = jax.jit(jax.shard_map(
+            grad_loss, mesh=mesh, in_specs=(P(), P("dp")),
+            out_specs=(P(), P())))
+
+        for i in range(start, config["steps"]):
+            g, l = f(jnp.float32(w), x)
+            w = w - 0.4 * float(np.asarray(g.addressable_data(0)))
+            loss = float(np.asarray(l.addressable_data(0)))
+            if rank == 0:
+                train.report(
+                    {"step": i, "loss": loss, "w": w,
+                     "procs": jax.process_count(),
+                     "resumed_at": start},
+                    checkpoint=Checkpoint.from_pytree(
+                        {"w": w, "step": i}))
+            if config.get("step_sleep"):
+                time.sleep(config["step_sleep"])
+
+    return loop
+
+
+def test_spmd_training_over_daemons(train_cluster, tmp_path):
+    """Happy path: gang PG → rendezvous via control-plane KV → global
+    psum train step → checkpointed Result."""
+    from ray_tpu.train import (
+        RunConfig,
+        ScalingConfig,
+        TpuTrainer,
+    )
+
+    scratch = tmp_path / "scratch"
+    scratch.mkdir()
+    trainer = TpuTrainer(
+        _make_loop(str(scratch)),
+        train_loop_config={"steps": 6},
+        scaling_config=ScalingConfig(
+            num_workers=2, cpus_per_worker=1,
+            placement_strategy="SPREAD", multihost=True),
+        run_config=RunConfig(name="e2e",
+                             storage_path=str(tmp_path / "store")),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    # Both processes rendezvoused: the step ran over a 2-process mesh.
+    assert result.metrics["procs"] == 2
+    # The optimum needs BOTH shards: mean([1,1,2,2]) = 1.5.
+    assert abs(result.metrics["w"] - 1.5) < 0.1
+    assert result.metrics_history[0]["step"] == 0
+    assert result.checkpoint is not None
+    assert int(result.checkpoint.to_pytree()["step"]) == 5
+    # SPREAD placed the two ranks on different daemons.
+    nodes = {(scratch / f"rank{r}.node").read_text() for r in range(2)}
+    assert len(nodes) == 2, nodes
+
+
+def test_daemon_kill_midrun_recovers(train_cluster, tmp_path):
+    """Chaos: SIGKILL the daemon hosting rank 1 while training runs.
+    The stream errors, FailureConfig restarts the gang (fresh KV key +
+    coordinator), and the new gang resumes from the newest registered
+    checkpoint and finishes."""
+    from ray_tpu.train import (
+        FailureConfig,
+        RunConfig,
+        ScalingConfig,
+        TpuTrainer,
+    )
+
+    scratch = tmp_path / "scratch"
+    scratch.mkdir()
+    store = tmp_path / "store"
+    trainer = TpuTrainer(
+        _make_loop(str(scratch)),
+        train_loop_config={"steps": 8, "step_sleep": 0.6},
+        scaling_config=ScalingConfig(
+            num_workers=2, cpus_per_worker=1,
+            placement_strategy="SPREAD", multihost=True),
+        run_config=RunConfig(
+            name="chaos", storage_path=str(store),
+            failure_config=FailureConfig(max_failures=5)),
+    )
+
+    box = {}
+
+    def run():
+        box["result"] = trainer.fit()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+
+    # Wait for rank placement + the first registered checkpoint.
+    rank1_file = scratch / "rank1.node"
+    deadline = time.monotonic() + 120
+    ckpt_dir = store / "chaos"
+    while time.monotonic() < deadline:
+        if rank1_file.exists() and ckpt_dir.exists() and any(
+                d.startswith("checkpoint_")
+                for d in os.listdir(ckpt_dir)):
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("training never produced a checkpoint")
+
+    victim = rank1_file.read_text()
+    assert victim.startswith("daemon-")
+    train_cluster.kill_node(victim)
+
+    t.join(timeout=240)
+    assert not t.is_alive(), "fit() did not finish after daemon kill"
+    result = box["result"]
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 7
+    assert result.metrics["procs"] == 2
+    # The surviving attempt RESUMED (started past step 0), not refit.
+    assert result.metrics["resumed_at"] > 0
+    assert abs(result.metrics["w"] - 1.5) < 0.1
+
+
+def test_multihost_local_worker_procs(tmp_path):
+    """Local mode: multihost gangs route ranks into dedicated worker
+    processes (one jax.distributed process per rank); thread actors in
+    the driver process cannot form a gang."""
+    import ray_tpu
+    from ray_tpu.train import RunConfig, ScalingConfig, TpuTrainer
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0, num_worker_procs=2)
+    try:
+        def loop(config):
+            import jax
+
+            import ray_tpu.train as train
+
+            train.report({"procs": jax.process_count(),
+                          "rank": train.get_context().get_world_rank()})
+
+        result = TpuTrainer(
+            loop,
+            train_loop_config={},
+            scaling_config=ScalingConfig(num_workers=2, multihost=True),
+            run_config=RunConfig(name="local-mh",
+                                 storage_path=str(tmp_path)),
+        ).fit()
+        assert result.error is None, result.error
+        assert result.metrics["procs"] == 2
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_multihost_local_without_procs_raises(tmp_path):
+    import ray_tpu
+    from ray_tpu.train import RunConfig, ScalingConfig, TpuTrainer
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    try:
+        result = TpuTrainer(
+            lambda: None,
+            scaling_config=ScalingConfig(num_workers=2, multihost=True),
+            run_config=RunConfig(name="bad-mh",
+                                 storage_path=str(tmp_path)),
+        ).fit()
+        assert result.error is not None
+        assert "num_worker_procs" in str(result.error)
+    finally:
+        ray_tpu.shutdown()
