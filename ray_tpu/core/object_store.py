@@ -170,6 +170,15 @@ class MemoryStore:
         with self._lock:
             return object_id in self._objects
 
+    def nbytes_if_exists(self, object_id: ObjectID) -> Optional[int]:
+        """Size of a stored object without materializing it (spilled
+        objects are NOT restored — their recorded size is returned).
+        Used by Data's byte-budget backpressure to cost completed
+        blocks."""
+        with self._lock:
+            obj = self._objects.get(object_id)
+            return None if obj is None else obj.nbytes
+
     def get_if_exists(self, object_id: ObjectID) -> Optional[StoredObject]:
         with self._lock:
             obj = self._objects.get(object_id)

@@ -18,6 +18,14 @@ from typing import ClassVar, Optional
 class DataContext:
     #: Max in-flight tasks per streaming map/read stage (backpressure).
     max_in_flight_tasks: int = 8
+    #: Byte budget for a stage's in-flight outputs (backpressure in
+    #: BYTES, reference: streaming_executor_state.py:525 — dispatch
+    #: under object-store budgets). None = auto: a quarter of the shm
+    #: arena when one exists, else 256 MiB. The streaming executor
+    #: shrinks a stage's task window to ~budget/observed-block-size, so
+    #: pipelines over huge blocks stop queueing arena-blowing amounts
+    #: of output.
+    max_in_flight_bytes: Optional[int] = None
     #: Default rows per batch for iter_batches when unspecified.
     default_batch_size: int = 256
     #: Default output partitions for groupby's hash shuffle.

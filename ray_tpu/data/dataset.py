@@ -45,7 +45,10 @@ class Dataset:
                     fn_constructor_args: tuple = (),
                     fn_constructor_kwargs: Optional[dict] = None,
                     num_cpus: float = 1, num_tpus: float = 0,
-                    concurrency: Optional[int] = None) -> "Dataset":
+                    concurrency=None) -> "Dataset":
+        """concurrency: int = fixed class-UDF actor pool; (min, max)
+        tuple = autoscaling pool that grows when inputs queue
+        (reference: ActorPoolStrategy(min_size, max_size))."""
         spec = _MapSpec("batches", fn, batch_size, batch_format,
                         fn_constructor_args, fn_constructor_kwargs or {})
         return Dataset(MapLike(self._op, spec, compute=compute,

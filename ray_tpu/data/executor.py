@@ -110,60 +110,190 @@ class _MapWorker:
 # Streaming stages
 # ---------------------------------------------------------------------------
 
-def _stage_read(op: Read, max_in_flight: int) -> Iterator[ObjectRef]:
-    window: deque = deque()
-    tasks = iter(op.read_tasks)
+def _ref_nbytes(ref: ObjectRef) -> Optional[int]:
+    """Size of a completed block, or None if not (yet) locally known."""
     try:
-        while True:
-            while len(window) < max_in_flight:
-                fn = next(tasks, None)
-                if fn is None:
-                    break
-                window.append(_read_task.remote(ray_put(fn)))
-            if not window:
-                return
-            yield window.popleft()
-    finally:
-        pass
+        from ..core.runtime import global_runtime_or_none
+
+        rt = global_runtime_or_none()
+        if rt is None:
+            return None
+        return rt.store.nbytes_if_exists(ref.id())
+    except Exception:  # noqa: BLE001 — costing must never break the run
+        return None
+
+
+class _ByteWindow:
+    """Adaptive in-flight window: counts TASKS until block sizes are
+    observed, then bounds the window so outstanding output bytes stay
+    near the stage's byte budget (reference:
+    streaming_executor_state.py:525 select_operator_to_run dispatching
+    under object-store budgets + backpressure_policy/). A pipeline of
+    1 MiB blocks keeps the full task window; a pipeline of 512 MiB
+    blocks shrinks toward one-in-flight."""
+
+    def __init__(self, budget_bytes: int, max_tasks: int):
+        self.budget = max(1, budget_bytes)
+        self.max_tasks = max(1, max_tasks)
+        self._avg: Optional[float] = None
+
+    def observe(self, ref: ObjectRef) -> None:
+        n = _ref_nbytes(ref)
+        if n is None or n <= 0:
+            return
+        self._avg = (float(n) if self._avg is None
+                     else 0.8 * self._avg + 0.2 * n)
+
+    def limit(self) -> int:
+        if not self._avg:
+            return self.max_tasks
+        return max(1, min(self.max_tasks,
+                          int(self.budget // max(1.0, self._avg))))
+
+
+def _stage_read(op: Read, max_in_flight: int,
+                budget_bytes: int) -> Iterator[ObjectRef]:
+    window: deque = deque()
+    bw = _ByteWindow(budget_bytes, max_in_flight)
+    tasks = iter(op.read_tasks)
+    while True:
+        while len(window) < bw.limit():
+            fn = next(tasks, None)
+            if fn is None:
+                break
+            window.append(_read_task.remote(ray_put(fn)))
+            # Probe the window head while filling: the first completed
+            # block's size shrinks the limit BEFORE the cold-start
+            # flood finishes submitting a full task-count window.
+            bw.observe(window[0])
+        if not window:
+            return
+        ref = window.popleft()
+        bw.observe(ref)
+        yield ref
 
 
 def _stage_map_tasks(op: MapLike, upstream: Iterator[ObjectRef],
-                     max_in_flight: int) -> Iterator[ObjectRef]:
+                     max_in_flight: int,
+                     budget_bytes: int) -> Iterator[ObjectRef]:
     window: deque = deque()
     specs_ref = ray_put(op.specs)
     opts: Dict[str, Any] = {"num_cpus": op.num_cpus}
     if op.num_tpus:
         opts["num_tpus"] = op.num_tpus
     task = _map_block_task.options(**opts)
-    limit = op.concurrency or max_in_flight
+    if op.concurrency is not None and not isinstance(op.concurrency, int):
+        raise ValueError(
+            "tuple concurrency bounds an autoscaling ACTOR pool and "
+            "requires a class UDF (or compute='actors'); task-based "
+            "maps take an int concurrency")
+    bw = _ByteWindow(budget_bytes, op.concurrency or max_in_flight)
     for ref in upstream:
         window.append(task.remote(ref, specs_ref))
-        if len(window) >= limit:
-            yield window.popleft()
+        bw.observe(window[0])
+        while len(window) >= bw.limit():
+            out = window.popleft()
+            bw.observe(out)
+            yield out
     while window:
         yield window.popleft()
 
 
+def _pool_bounds(concurrency) -> Tuple[int, int]:
+    """(min, max) pool size from the user's concurrency:
+    int → fixed pool, (lo, hi) → autoscaling pool
+    (reference: ActorPoolStrategy(min_size, max_size) /
+    actor_pool_map_operator.py autoscaling)."""
+    if concurrency is None:
+        return 2, 2
+    if isinstance(concurrency, int):
+        return concurrency, concurrency
+    lo, hi = concurrency
+    if lo < 1 or hi < lo:
+        raise ValueError(
+            f"concurrency bounds must satisfy 1 <= min <= max, "
+            f"got {concurrency}")
+    return int(lo), int(hi)
+
+
 def _stage_map_actors(op: MapLike, upstream: Iterator[ObjectRef],
-                      max_in_flight: int) -> Iterator[ObjectRef]:
+                      max_in_flight: int,
+                      budget_bytes: int) -> Iterator[ObjectRef]:
     from .. import kill as ray_kill
 
-    pool_size = op.concurrency or 2
+    lo, hi = _pool_bounds(op.concurrency)
     Worker = remote(num_cpus=op.num_cpus,
                     num_tpus=op.num_tpus or None)(_MapWorker)
-    actors = [Worker.remote(op.specs) for _ in range(pool_size)]
+    actors = [Worker.remote(op.specs) for _ in range(lo)]
+    # In-flight calls per actor (least-loaded dispatch beats blind
+    # round-robin when block costs vary).
+    load = [0] * len(actors)
+    window: deque = deque()  # (ref, actor_index)
+    # Dispatched results not yet known complete. Pruned as they finish
+    # so this NEVER pins completed blocks (that would defeat the byte
+    # budget); what remains at teardown is what the drain must await.
+    issued: List[ObjectRef] = []
+    bw = _ByteWindow(budget_bytes, max_in_flight)
     try:
-        window: deque = deque()
-        i = 0
+        def can_grow() -> bool:
+            # A new actor must not consume the cluster's LAST cpu:
+            # upstream read/map tasks still need somewhere to run, and
+            # a pool holding every CPU deadlocks the very pipeline it
+            # serves.
+            if len(actors) >= hi:
+                return False
+            if not op.num_cpus:
+                return True
+            try:
+                from ..core.runtime import global_runtime
+
+                avail = global_runtime().available_resources()
+                return avail.get("CPU", 0.0) - op.num_cpus >= 1.0
+            except Exception:  # noqa: BLE001 — can't tell: don't grow
+                return False
+
+        def dispatch(ref):
+            i = min(range(len(actors)), key=lambda j: load[j])
+            # Queue depth beyond one call per actor = demand the pool
+            # can't absorb → scale up toward max (reference:
+            # actor_pool_map_operator autoscaling on queued inputs).
+            if load[i] >= 1 and can_grow():
+                actors.append(Worker.remote(op.specs))
+                load.append(0)
+                i = len(actors) - 1
+            load[i] += 1
+            out = actor_apply(actors[i], ref)
+            issued.append(out)
+            window.append((out, i))
+            if len(issued) > 2 * bw.max_tasks:
+                done, pending = ray_wait(
+                    issued, num_returns=len(issued), timeout=0)
+                issued[:] = pending
+
+        def actor_apply(actor, ref):
+            return actor.apply.remote(ref)
+
         for ref in upstream:
-            actor = actors[i % pool_size]
-            i += 1
-            window.append(actor.apply.remote(ref))
-            if len(window) >= max_in_flight:
-                yield window.popleft()
+            dispatch(ref)
+            while len(window) >= bw.limit():
+                out, i = window.popleft()
+                load[i] -= 1
+                bw.observe(out)
+                yield out
         while window:
-            yield window.popleft()
+            out, i = window.popleft()
+            load[i] -= 1
+            yield out
     finally:
+        # Drain before teardown: downstream stages may not have read
+        # the yielded futures yet — killing an actor mid-call turns
+        # them into ActorDiedError. Only not-yet-complete calls remain
+        # in `issued` (pruned above).
+        try:
+            if issued:
+                ray_wait(issued, num_returns=len(issued), timeout=60)
+        except Exception:  # noqa: BLE001 — best-effort drain
+            pass
         for a in actors:
             try:
                 ray_kill(a)
@@ -372,13 +502,25 @@ def execute(root: LogicalOp, *, max_in_flight: Optional[int] = None,
     from .context import DataContext
     from .plan import optimize
 
+    ctx = DataContext.get_current()
     if max_in_flight is None:
-        max_in_flight = DataContext.get_current().max_in_flight_tasks
+        max_in_flight = ctx.max_in_flight_tasks
+    budget_bytes = ctx.max_in_flight_bytes
+    if budget_bytes is None:
+        budget_bytes = 256 << 20
+        try:
+            from ..core.runtime import global_runtime_or_none
+
+            rt = global_runtime_or_none()
+            if rt is not None and rt.shm is not None:
+                budget_bytes = max(1 << 20, rt.shm.capacity() // 4)
+        except Exception:  # noqa: BLE001 — default budget stands
+            pass
 
     stream: Optional[Iterator[ObjectRef]] = None
     for op in optimize(root).chain():
         if isinstance(op, Read):
-            stream = _stage_read(op, max_in_flight)
+            stream = _stage_read(op, max_in_flight, budget_bytes)
         elif isinstance(op, FromBlocks):
             def _emit(blocks=op.blocks):
                 for b in blocks:
@@ -388,9 +530,11 @@ def execute(root: LogicalOp, *, max_in_flight: Optional[int] = None,
             if op.compute == "actors" or (
                     op.compute is None and any(
                         isinstance(s.fn, type) for s in op.specs)):
-                stream = _stage_map_actors(op, stream, max_in_flight)
+                stream = _stage_map_actors(op, stream, max_in_flight,
+                                           budget_bytes)
             else:
-                stream = _stage_map_tasks(op, stream, max_in_flight)
+                stream = _stage_map_tasks(op, stream, max_in_flight,
+                                          budget_bytes)
         elif isinstance(op, Limit):
             stream = _stage_limit(op, stream)
         elif isinstance(op, Repartition):

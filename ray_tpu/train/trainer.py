@@ -281,12 +281,16 @@ class TpuTrainer:
                 # a gang. Route ranks to dedicated worker processes
                 # (same plane the torch/TF trainers use).
                 if (rt.worker_pool is None
-                        or rt.worker_pool.num_workers < 1):
+                        or rt.worker_pool.num_workers < n):
+                    have = (0 if rt.worker_pool is None
+                            else rt.worker_pool.num_workers)
                     raise RuntimeError(
-                        "ScalingConfig(multihost=True) outside a daemon "
-                        "cluster needs worker processes: call "
-                        "ray_tpu.init(num_worker_procs=...) or connect "
-                        "to a cluster (ray_tpu.init(address=...))")
+                        f"ScalingConfig(multihost=True) outside a "
+                        f"daemon cluster needs {n} worker processes "
+                        f"but the runtime has {have}: call "
+                        f"ray_tpu.init(num_worker_procs={n}) or "
+                        "connect to a cluster "
+                        "(ray_tpu.init(address=...))")
                 from ..core.task import NodeAffinitySchedulingStrategy
 
                 self._strategy_factory = lambda rank: \
