@@ -224,6 +224,68 @@ def bench_serve(quick: bool, model: str = "gpt2-125m",
     }))
 
 
+def _smoke_prefix_equivalence() -> None:
+    """Prefix-cache smoke gate: greedy tokens from a prefix-cached
+    suffix prefill must EQUAL the full-prompt prefill's (same model,
+    same prompts). Prints one JSON line with value 1.0 on equivalence.
+    """
+    from dataclasses import replace
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import configs
+    from ray_tpu.models.generate import (
+        compute_prefix_kv,
+        init_kv_cache,
+        prefill_sample_batch,
+        prefill_suffix_batch,
+    )
+    from ray_tpu.models.transformer import init_params
+
+    cfg = replace(configs.tiny_test(), max_seq_len=128)
+    pre, suf, slots, max_seq, W = 48, 8, 4, 128, 4
+    params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, cfg.vocab_size, pre).tolist()
+    prompts = [prefix + rng.integers(0, cfg.vocab_size, suf).tolist()
+               for _ in range(W)]
+
+    import jax.numpy as jnp
+
+    pk, pv = compute_prefix_kv(cfg, params, prefix)
+    fbuf = np.zeros((W, 64), np.int32)
+    sbuf = np.zeros((W, 8), np.int32)
+    for j, p in enumerate(prompts):
+        fbuf[j, :len(p)] = p
+        sbuf[j, :suf] = p[pre:]
+    flens = jnp.full((W,), pre + suf, jnp.int32)
+    slens = jnp.full((W,), suf, jnp.int32)
+    slot_idx = jnp.arange(W, dtype=jnp.int32) % slots
+    temps = jnp.zeros((W,), jnp.float32)  # greedy
+    key = jax.random.key(0)
+
+    _, toks_full = prefill_sample_batch(
+        cfg, params, init_kv_cache(cfg, slots, max_seq),
+        jnp.asarray(fbuf), flens, slot_idx, 0, temps, key)
+    _, toks_suffix = prefill_suffix_batch(
+        cfg, params, init_kv_cache(cfg, slots, max_seq), pk, pv,
+        jnp.asarray(sbuf), slens, slot_idx, 0, temps, key)
+    same = bool(np.array_equal(np.asarray(toks_full),
+                               np.asarray(toks_suffix)))
+    metric = "tiny_serve_prefix_equivalence_smoke"
+    push_history(metric, 1.0 if same else 0.0, "ok",
+                 match={"prefix_len": pre, "suffix_len": suf,
+                        "platform": jax.devices()[0].platform},
+                 extra={})
+    print(json.dumps({
+        "metric": metric, "value": 1.0 if same else 0.0, "unit": "ok",
+        "vs_baseline": 1.0 if same else 0.0,
+    }))
+    if not same:
+        sys.exit("prefix-cached prefill diverged from full prefill")
+
+
 def bench_serve_prefix(quick: bool, model: str = "llama-654m",
                        trials: int = 5) -> None:
     """Prefix-caching serving scenario: a long shared system prompt
@@ -258,16 +320,19 @@ def bench_serve_prefix(quick: bool, model: str = "llama-654m",
 
     on_tpu = jax.devices()[0].platform not in ("cpu",)
     if quick or not on_tpu:
-        cfg = configs.tiny_test()
-        cfg = replace(cfg, max_seq_len=128)
-        pre, suf, n_req, new, slots, max_seq = 48, 8, 8, 4, 4, 128
-        metric = "tiny_serve_prefix_speedup_smoke"
-        trials = 1
-    else:
-        cfg = configs.get(model)
-        cfg = replace(cfg, param_dtype=jnp.bfloat16, max_seq_len=1024)
-        pre, suf, n_req, new, slots, max_seq = 480, 32, 64, 4, 4, 1024
-        metric = f"{model.replace('-', '_')}_serve_prefix_speedup"
+        # Smoke = CORRECTNESS, not speed: the tiny model's waves are
+        # microseconds of device time, unresolvable behind the ~150 ms
+        # tunnel RTT — the old speedup smoke once recorded a 0.86×
+        # "slowdown" with both arms pinned at the timer floor (VERDICT
+        # r3 weak #1). Equivalence (prefix-cached prefill ≡ full
+        # prefill, greedy) is exactly what must not regress; the real
+        # speedup gate is the pinned llama_654m_serve_prefix_speedup.
+        _smoke_prefix_equivalence()
+        return
+    cfg = configs.get(model)
+    cfg = replace(cfg, param_dtype=jnp.bfloat16, max_seq_len=1024)
+    pre, suf, n_req, new, slots, max_seq = 480, 32, 64, 4, 4, 1024
+    metric = f"{model.replace('-', '_')}_serve_prefix_speedup"
 
     params = init_params(cfg, jax.random.key(0))
     rng = np.random.default_rng(0)
@@ -391,9 +456,14 @@ def bench_serve_prefix(quick: bool, model: str = "llama-654m",
                         "wave_ms_suffix": round(t_suffix * 1e3, 1),
                         "e2e_burst_speedup": round(e2e_x, 2),
                         "trials": len(walls)})
+    # Pinned gate (VERDICT r3 #7c): vs_baseline compares the device-
+    # time speedup against the bar in BASELINE.json; <1.0 = the
+    # prefix-cache device-time win regressed.
+    bar = pinned_baseline(metric, run_match)
     print(json.dumps({
         "metric": metric, "value": round(wave_speedup, 2), "unit": "x",
-        "vs_baseline": round(wave_speedup, 2),  # feature baseline lacks
+        "vs_baseline": round(wave_speedup / bar, 3) if bar
+        else round(wave_speedup, 2),
         "wave_ms_full": round(t_full * 1e3, 1),
         "wave_ms_suffix": round(t_suffix * 1e3, 1),
         "e2e_burst_speedup": round(e2e_x, 2),
@@ -617,6 +687,18 @@ def main() -> None:
         out["mfu"] = round(mfu, 4)
         out["peak_flops_assumed"] = peak
         out["params"] = n_params
+        # MFU pinned gate (VERDICT r3 #7b): at a matmul-saturated size
+        # (654M+) MFU is the number the engine is judged on — the
+        # flagship 125M sits at ~39% MFU by CONSTRUCTION (d768 matmuls
+        # under-fill the 128x128 MXU), so a tokens/s gate there can't
+        # see engine regressions the way an MFU bar at 654M can.
+        if not metric.startswith("tiny_"):
+            mfu_metric = metric.split("_train_")[0] + "_train_mfu"
+            push_history(mfu_metric, mfu, "mfu", match=run_match,
+                         extra={"peak_flops_assumed": peak})
+            mfu_bar = pinned_baseline(mfu_metric, run_match)
+            if mfu_bar:
+                out["mfu_vs_bar"] = round(mfu / mfu_bar, 3)
     print(json.dumps(out))
 
 

@@ -182,8 +182,17 @@ class RealCluster:
         penv = dict(os.environ)
         penv.setdefault("JAX_PLATFORMS", "cpu")
         penv.update(env or {})
+        # RAY_TPU_DAEMON_STDERR=<dir>: keep daemon stderr for debugging
+        # (default: discarded).
+        err_dir = os.environ.get("RAY_TPU_DAEMON_STDERR")
+        if err_dir:
+            os.makedirs(err_dir, exist_ok=True)
+        stderr = (open(os.path.join(err_dir, f"{node_id}.err"), "wb")
+                  if err_dir else subprocess.DEVNULL)
         proc = subprocess.Popen(cmd, env=penv, stdout=subprocess.PIPE,
-                                stderr=subprocess.DEVNULL, text=True)
+                                stderr=stderr, text=True)
+        if stderr is not subprocess.DEVNULL:
+            stderr.close()
         self._daemons[node_id] = proc
         if wait:
             import time
@@ -218,9 +227,17 @@ class RealCluster:
         raise TimeoutError(f"{node_id} never joined the driver's view")
 
     def connect(self, **init_kwargs):
-        """Join as a driver; returns the ray_tpu module."""
+        """Join as a driver; returns the ray_tpu module. A leftover
+        runtime attached to a DIFFERENT (or no) cluster is torn down
+        first — init is idempotent, so connecting through a stale
+        runtime would silently yield a driver with zero remote nodes."""
         import ray_tpu
 
+        rt = _runtime.global_runtime_or_none()
+        if rt is not None and (
+                rt.remote_plane is None
+                or rt.remote_plane.address != self.address):
+            ray_tpu.shutdown()
         ray_tpu.init(address=self.address, **init_kwargs)
         return ray_tpu
 

@@ -1,0 +1,271 @@
+"""Pluggable external storage — one plane for spilling AND checkpoints.
+
+Capability-equivalent of the reference's external-storage stack
+(reference: python/ray/_private/external_storage.py:72 ExternalStorage
+ABC, :246 FileSystemStorage, :445 ExternalStorageSmartOpenImpl — the
+S3-style remote driver behind object spilling; and
+train/_internal/storage.py:98-110 — pyarrow.fs URI resolution behind
+checkpoint persistence). TPU-native twist: the remote-shaped backend
+rides the control plane's KV (`cp://host:port/prefix`), so spilled
+objects and checkpoints survive the death of the host that wrote them
+without any cloud dependency — and a real cloud driver is one subclass
+away (same blob/dir interface).
+
+URL schemes:
+  file:///abs/dir      — local filesystem (also plain paths, no scheme)
+  cp://host:port/pre   — control-plane KV ("remote": URL-addressed,
+                         byte-stream up/download, no shared local paths)
+  mem://bucket/pre     — in-process dict (unit tests)
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import shutil
+import tarfile
+import threading
+from typing import Dict, List, Tuple
+
+
+class ExternalStorage:
+    """Blob + directory storage addressed by URL. put/upload return the
+    full URL; get/download/delete take URLs produced by ANY process
+    (restore-on-survivor needs no shared local state)."""
+
+    # -- blobs (spilled objects) ------------------------------------------
+    def put_blob(self, key: str, data: bytes) -> str:
+        raise NotImplementedError
+
+    def get_blob(self, url: str) -> bytes:
+        raise NotImplementedError
+
+    def delete_blob(self, url: str) -> None:
+        raise NotImplementedError
+
+    # -- directories (checkpoints) ----------------------------------------
+    def upload_dir(self, local_dir: str, key: str) -> str:
+        raise NotImplementedError
+
+    def download_dir(self, url: str, local_dir: str) -> None:
+        raise NotImplementedError
+
+    def delete_dir(self, url: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, url: str) -> bool:
+        raise NotImplementedError
+
+
+def _tar_dir(local_dir: str) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        tf.add(local_dir, arcname=".")
+    return buf.getvalue()
+
+
+def _untar_dir(data: bytes, local_dir: str) -> None:
+    os.makedirs(local_dir, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r") as tf:
+        tf.extractall(local_dir, filter="data")
+
+
+class FileSystemStorage(ExternalStorage):
+    """reference: _private/external_storage.py:246 FileSystemStorage."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, url: str) -> str:
+        if url.startswith("file://"):
+            return url[len("file://"):]
+        return url
+
+    def put_blob(self, key: str, data: bytes) -> str:
+        path = os.path.join(self.root, key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic: no half-written blobs
+        return "file://" + path
+
+    def get_blob(self, url: str) -> bytes:
+        with open(self._path(url), "rb") as f:
+            return f.read()
+
+    def delete_blob(self, url: str) -> None:
+        try:
+            os.remove(self._path(url))
+        except FileNotFoundError:
+            pass
+
+    def upload_dir(self, local_dir: str, key: str) -> str:
+        dest = os.path.join(self.root, key)
+        if os.path.abspath(local_dir) != dest:
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(local_dir, dest)
+        return "file://" + dest
+
+    def download_dir(self, url: str, local_dir: str) -> None:
+        src = self._path(url)
+        if os.path.abspath(src) != os.path.abspath(local_dir):
+            shutil.copytree(src, local_dir, dirs_exist_ok=True)
+
+    def delete_dir(self, url: str) -> None:
+        shutil.rmtree(self._path(url), ignore_errors=True)
+
+    def exists(self, url: str) -> bool:
+        return os.path.exists(self._path(url))
+
+
+class ControlPlaneStorage(ExternalStorage):
+    """Remote-shaped storage on the control plane's KV: URL-addressed,
+    explicit byte up/download, nothing local shared — what spilled
+    objects and checkpoints need to outlive their writer's host
+    (reference capability: ExternalStorageSmartOpenImpl / S3)."""
+
+    KV_PREFIX = "extstore/"
+
+    def __init__(self, address: str):
+        self.address = address  # host:port
+
+    # One client per (address, thread-agnostic) — the ControlClient is
+    # internally thread-safe (reader thread demuxes replies).
+    _clients: Dict[str, object] = {}
+    _clients_lock = threading.Lock()
+
+    def _client(self):
+        with ControlPlaneStorage._clients_lock:
+            cli = ControlPlaneStorage._clients.get(self.address)
+            if cli is None:
+                from .._native.control_client import ControlClient
+
+                host, _, port = self.address.partition(":")
+                cli = ControlClient(int(port), host=host)
+                ControlPlaneStorage._clients[self.address] = cli
+            return cli
+
+    def _kv_key(self, url_or_key: str) -> str:
+        if url_or_key.startswith("cp://"):
+            rest = url_or_key[len("cp://"):]
+            _, _, key = rest.partition("/")
+        else:
+            key = url_or_key
+        return self.KV_PREFIX + key
+
+    def _url(self, key: str) -> str:
+        return f"cp://{self.address}/{key}"
+
+    def put_blob(self, key: str, data: bytes) -> str:
+        self._client().kv_put(self._kv_key(key), data, overwrite=True)
+        return self._url(key)
+
+    def get_blob(self, url: str) -> bytes:
+        return self._client().kv_get(self._kv_key(url))
+
+    def delete_blob(self, url: str) -> None:
+        try:
+            self._client().kv_del(self._kv_key(url))
+        except Exception:  # noqa: BLE001 — delete is best-effort
+            pass
+
+    def upload_dir(self, local_dir: str, key: str) -> str:
+        self._client().kv_put(self._kv_key(key + ".tar"),
+                              _tar_dir(local_dir), overwrite=True)
+        return self._url(key)
+
+    def download_dir(self, url: str, local_dir: str) -> None:
+        _untar_dir(self._client().kv_get(self._kv_key(url) + ".tar"),
+                   local_dir)
+
+    def delete_dir(self, url: str) -> None:
+        try:
+            self._client().kv_del(self._kv_key(url) + ".tar")
+        except Exception:  # noqa: BLE001
+            pass
+
+    def exists(self, url: str) -> bool:
+        cli = self._client()
+        k = self._kv_key(url)
+        return bool(cli.kv_exists(k) or cli.kv_exists(k + ".tar"))
+
+
+class InMemoryStorage(ExternalStorage):
+    """Process-local fake with remote semantics (unit tests)."""
+
+    _buckets: Dict[str, Dict[str, bytes]] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, bucket: str):
+        self.bucket = bucket
+        with InMemoryStorage._lock:
+            InMemoryStorage._buckets.setdefault(bucket, {})
+
+    def _store(self) -> Dict[str, bytes]:
+        return InMemoryStorage._buckets[self.bucket]
+
+    def _key(self, url_or_key: str) -> str:
+        if url_or_key.startswith("mem://"):
+            rest = url_or_key[len("mem://"):]
+            _, _, key = rest.partition("/")
+            return key
+        return url_or_key
+
+    def _url(self, key: str) -> str:
+        return f"mem://{self.bucket}/{key}"
+
+    def put_blob(self, key: str, data: bytes) -> str:
+        with InMemoryStorage._lock:
+            self._store()[self._key(key)] = bytes(data)
+        return self._url(key)
+
+    def get_blob(self, url: str) -> bytes:
+        with InMemoryStorage._lock:
+            return self._store()[self._key(url)]
+
+    def delete_blob(self, url: str) -> None:
+        with InMemoryStorage._lock:
+            self._store().pop(self._key(url), None)
+
+    def upload_dir(self, local_dir: str, key: str) -> str:
+        with InMemoryStorage._lock:
+            self._store()[self._key(key) + ".tar"] = _tar_dir(local_dir)
+        return self._url(key)
+
+    def download_dir(self, url: str, local_dir: str) -> None:
+        with InMemoryStorage._lock:
+            data = self._store()[self._key(url) + ".tar"]
+        _untar_dir(data, local_dir)
+
+    def delete_dir(self, url: str) -> None:
+        with InMemoryStorage._lock:
+            self._store().pop(self._key(url) + ".tar", None)
+
+    def exists(self, url: str) -> bool:
+        with InMemoryStorage._lock:
+            k = self._key(url)
+            return k in self._store() or (k + ".tar") in self._store()
+
+
+def is_url(path: str) -> bool:
+    return isinstance(path, str) and "://" in path
+
+
+def storage_for_url(url: str) -> ExternalStorage:
+    """Resolve the storage backend from any URL this plane produced.
+    Works in ANY process — restore needs only the URL."""
+    if url.startswith("file://") or "://" not in url:
+        path = url[len("file://"):] if url.startswith("file://") else url
+        return FileSystemStorage(os.path.dirname(path) or "/")
+    if url.startswith("cp://"):
+        rest = url[len("cp://"):]
+        address, _, _ = rest.partition("/")
+        return ControlPlaneStorage(address)
+    if url.startswith("mem://"):
+        rest = url[len("mem://"):]
+        bucket, _, _ = rest.partition("/")
+        return InMemoryStorage(bucket)
+    raise ValueError(f"unknown storage scheme in {url!r}")

@@ -108,6 +108,10 @@ class RemotePlane:
                        if rt.shm is not None else None)
         self._stop = threading.Event()
         self._known: set = set()
+        # node_id -> monotonic time of a connection-failure drop; gates
+        # re-join from the control plane's stale ALIVE view (see
+        # _sync_nodes_locked quarantine).
+        self._dropped_at: Dict[str, float] = {}
         # Guards membership mutation: sync_nodes runs from the poll
         # thread AND the pubsub callback — without this two racers
         # could each build a RemoteNodeState for the same node (one
@@ -137,9 +141,14 @@ class RemotePlane:
         except Exception:  # noqa: BLE001 — control plane hiccup
             return
         with self._sync_lock:
-            self._sync_nodes_locked(nodes)
+            to_drop = self._sync_nodes_locked(nodes)
+        # Dropped OUTSIDE the lock: _drop_node re-acquires it
+        # (re-entering a plain Lock deadlocks the poll thread).
+        for nid in to_drop:
+            self._drop_node(nid)
 
-    def _sync_nodes_locked(self, nodes) -> None:
+    def _sync_nodes_locked(self, nodes) -> List[str]:
+        to_drop: List[str] = []
         for n in nodes:
             nid = n["node_id"]
             try:
@@ -150,8 +159,22 @@ class RemotePlane:
                 continue
             if not n["alive"]:
                 if nid in self._known:
-                    self._drop_node(nid)
+                    to_drop.append(nid)
                 continue
+            # Quarantine: a node WE dropped on a connection failure must
+            # not rejoin from the control plane's still-ALIVE view until
+            # its health expiry had a chance to run — otherwise a dead
+            # daemon ping-pongs back into the scheduler (and PG repair
+            # re-places bundles onto it) every poll for the whole
+            # expiry window. A merely-slow node rejoins after the
+            # quarantine lapses.
+            dropped_at = self._dropped_at.get(nid)
+            if dropped_at is not None:
+                import time as _time
+
+                if _time.monotonic() - dropped_at < 15.0:
+                    continue
+                del self._dropped_at[nid]
             if nid not in self._known:
                 total = ResourceSet(meta.get("resources", {"CPU": 1.0}))
                 node = RemoteNodeState(nid, total, meta)
@@ -172,6 +195,7 @@ class RemotePlane:
                         # Full report (incl. per-host stats) for the
                         # dashboard's cluster view.
                         node.last_load = load
+        return to_drop
 
     def _on_node_event(self, payload: bytes) -> None:
         text = payload.decode(errors="replace")
@@ -181,11 +205,24 @@ class RemotePlane:
         elif state == "ALIVE":
             self.sync_nodes()
 
-    def _drop_node(self, node_id: str) -> None:
+    @staticmethod
+    def _is_refused(err) -> bool:
+        """Connection REFUSED = the daemon process is gone (its
+        listener died with it) — worth quarantining. Timeouts/resets
+        under load are transient and must heal on the next sync."""
+        return isinstance(err, ConnectionRefusedError) or \
+            "refused" in str(err).lower()
+
+    def _drop_node(self, node_id: str, *,
+                   quarantine: bool = False) -> None:
         with self._sync_lock:
             if node_id not in self._known:
                 return
             self._known.discard(node_id)
+            if quarantine:
+                import time as _time
+
+                self._dropped_at[node_id] = _time.monotonic()
         self._endpoints.pop(node_id, None)
         if self._pulls is not None:
             self._pulls.drop(node_id)
@@ -448,8 +485,11 @@ class RemotePlane:
             # the node NOW (socket-error failure detection — reference:
             # workers detect raylet death via the socket) so the retry
             # lands elsewhere; if the daemon is actually fine, the next
-            # membership sync re-adds it.
-            self._drop_node(node.node_id)
+            # membership sync re-adds it. A REFUSED connection means
+            # the process is gone — quarantine so the control plane's
+            # stale ALIVE view can't ping-pong it back in.
+            self._drop_node(node.node_id,
+                            quarantine=self._is_refused(e))
             retried = rt._maybe_retry_system(spec, e)
             if not retried:
                 rt._store_error(spec, _wrap(spec, e), t0)
@@ -654,12 +694,14 @@ def remote_actor_state_cls():
                     if conn is not None:
                         conn.close()
                     last_err = e
-                    plane._drop_node(self.node.node_id)
+                    plane._drop_node(self.node.node_id,
+                                     quarantine=plane._is_refused(e))
                     time.sleep(0.1)
                     continue
                 except OSError as e:  # open_conn refused
                     last_err = e
-                    plane._drop_node(self.node.node_id)
+                    plane._drop_node(self.node.node_id,
+                                     quarantine=plane._is_refused(e))
                     time.sleep(0.1)
                     continue
                 try:
@@ -827,6 +869,42 @@ def remote_actor_state_cls():
                 if not spec.redelivered:
                     rt._task_finished(spec)
 
+        def _send_actor_kill(self) -> None:
+            """Deliver actor_kill to the daemon, surviving a closed
+            NodeClient: after a (possibly stale) driver-side drop the
+            pooled client raises immediately, so fall back to ONE
+            fresh direct connection — a genuinely dead daemon refuses
+            it fast, a stale-dropped one processes the kill and frees
+            the actor's charge."""
+            msg = {"type": "actor_kill",
+                   "actor_id": self.actor_id.binary()}
+            try:
+                self.node.client.call(msg)
+                return
+            except Exception:  # noqa: BLE001 — client closed/broken
+                pass
+            try:
+                from ..node.client import NodeConn
+
+                conn = NodeConn(self.node.host, self.node.dispatch_port,
+                                timeout=2.0)
+                try:
+                    conn.request(msg)
+                finally:
+                    conn.close()
+            except Exception:  # noqa: BLE001 — daemon really gone
+                pass
+
+        def kill(self, *, no_restart: bool = True):
+            # Kill the daemon-side instance EAGERLY: an in-flight call
+            # blocks this actor's mailbox thread in conn.request until
+            # the worker process dies, and _die (which also fires
+            # actor_kill) only runs after that thread exits — waiting
+            # for _die to send the kill would deadlock a stuck actor
+            # and leak its daemon + driver resource charges forever.
+            self._send_actor_kill()
+            super().kill(no_restart=no_restart)
+
         def _die(self, gen: int):
             # Skip ProcActorState._die (pool retire) — the worker lives
             # on the daemon; tell it to drop the actor instead.
@@ -837,11 +915,10 @@ def remote_actor_state_cls():
                 conn, self._conn = self._conn, None
                 if conn is not None:
                     conn.close()
-                if self.node.alive:
-                    with contextlib.suppress(Exception):
-                        self.node.client.call({
-                            "type": "actor_kill",
-                            "actor_id": self.actor_id.binary()})
+                # Best-effort even when the driver's view says the node
+                # is dead — the view can be a stale drop while the
+                # daemon still hosts (and charges for) the actor.
+                self._send_actor_kill()
 
     _remote_actor_cls = RemoteProcActorState
     return _remote_actor_cls
@@ -894,7 +971,8 @@ def remote_actor_proxy_cls():
 
         def kill(self, *, no_restart: bool = True):
             # Explicit cross-driver kill IS allowed (reference:
-            # ray.kill on a detached actor from any job).
+            # ray.kill on a detached actor from any job). The base
+            # class sends the daemon-side kill eagerly.
             self._explicit_kill = True
             super().kill(no_restart=no_restart)
 
@@ -904,11 +982,8 @@ def remote_actor_proxy_cls():
                 conn, self._conn = self._conn, None
                 if conn is not None:
                     conn.close()
-                if self._explicit_kill and self.node.alive:
-                    with contextlib.suppress(Exception):
-                        self.node.client.call({
-                            "type": "actor_kill",
-                            "actor_id": self.actor_id.binary()})
+                if self._explicit_kill:
+                    self._send_actor_kill()
                     # Record the death for other drivers' lookups.
                     with contextlib.suppress(Exception):
                         self.rt.remote_plane.control.update_actor(

@@ -1,63 +1,71 @@
-"""Object spilling — disk overflow for the object store.
+"""Object spilling — overflow for the object store, onto any storage.
 
 Capability-equivalent of the reference's spilling stack
 (reference: src/ray/raylet/local_object_manager.h:41 SpillObjects /
 restore, python/ray/_private/external_storage.py:72 FileSystemStorage
-:246 — when the store crosses its memory budget, primary copies move to
-external storage and restore transparently on access): sealed objects
-past the high watermark are written to <session>/spill as flat
-SerializedObject frames; the in-memory entry becomes a stub holding the
-file path; get() restores on touch.
+:246, :445 ExternalStorageSmartOpenImpl for S3 — when the store crosses
+its memory budget, primary copies move to external storage and restore
+transparently on access): sealed objects past the high watermark are
+written as flat SerializedObject frames through the pluggable
+ExternalStorage plane; the in-memory entry becomes a stub holding the
+blob URL; get() restores on touch. With a `cp://` spill target the
+blobs live in the control plane's KV and outlive the writing host —
+restore needs only the URL, from any process.
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from typing import Optional
 
+from .external_storage import (
+    ExternalStorage,
+    FileSystemStorage,
+    is_url,
+    storage_for_url,
+)
 from .ids import ObjectID
 from .serialization import SerializedObject
 
 
 class ObjectSpiller:
-    """Filesystem external storage (reference: FileSystemStorage)."""
+    """Spill/restore through an ExternalStorage backend. `target` is a
+    local directory (classic file spilling) or any storage URL
+    (`cp://host:port/spill`, `mem://bucket/spill`)."""
 
-    def __init__(self, directory: str):
-        self.directory = directory
-        os.makedirs(directory, exist_ok=True)
+    def __init__(self, target: str):
+        if is_url(target):
+            self.storage: ExternalStorage = storage_for_url(target)
+            rest = target.split("://", 1)[1]
+            _, _, prefix = rest.partition("/")
+            self._prefix = (prefix.rstrip("/") + "/") if prefix else ""
+        else:
+            os.makedirs(target, exist_ok=True)
+            self.storage = FileSystemStorage(target)
+            self._prefix = ""
+        self.directory = target  # kept name: session wiring reads it
         self._lock = threading.Lock()
         self.spilled_bytes = 0
         self.spilled_objects = 0
         self.restored_objects = 0
 
-    def _path(self, object_id: ObjectID) -> str:
-        return os.path.join(self.directory, object_id.hex())
-
     def spill(self, object_id: ObjectID, data: SerializedObject) -> str:
-        path = self._path(object_id)
-        tmp = path + ".tmp"
         frame = data.to_bytes()
-        with open(tmp, "wb") as f:
-            f.write(frame)
-        os.replace(tmp, path)  # atomic: no half-written spill files
+        url = self.storage.put_blob(self._prefix + object_id.hex(),
+                                    frame)
         with self._lock:
             self.spilled_bytes += len(frame)
             self.spilled_objects += 1
-        return path
+        return url
 
-    def restore(self, path: str) -> SerializedObject:
-        with open(path, "rb") as f:
-            frame = f.read()
+    def restore(self, url: str) -> SerializedObject:
+        frame = self.storage.get_blob(url)
         with self._lock:
             self.restored_objects += 1
         return SerializedObject.from_bytes(frame)
 
-    def delete(self, path: str) -> None:
-        try:
-            os.remove(path)
-        except FileNotFoundError:
-            pass
+    def delete(self, url: str) -> None:
+        self.storage.delete_blob(url)
 
     def stats(self) -> dict:
         with self._lock:
@@ -66,3 +74,10 @@ class ObjectSpiller:
                 "spilled_bytes": self.spilled_bytes,
                 "restored_objects": self.restored_objects,
             }
+
+
+def restore_from_url(url: str) -> SerializedObject:
+    """Restore a spilled object from its URL alone — any process, no
+    spiller instance needed (reference capability:
+    object_manager restoring by spilled URL recorded with the owner)."""
+    return SerializedObject.from_bytes(storage_for_url(url).get_blob(url))

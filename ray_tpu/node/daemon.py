@@ -301,6 +301,18 @@ class NodeDaemon:
             self.available = self.available.subtract(res)
             self._running += 1
 
+    def _try_charge(self, res) -> bool:
+        """Atomic check-and-charge. A failed charge must be a REFUSAL
+        reply, never an exception — a driver's stale view can race a
+        kill's release, and unwinding the conn thread on that race
+        reads as a daemon death driver-side."""
+        with self._avail_lock:
+            if not res.fits(self.available):
+                return False
+            self.available = self.available.subtract(res)
+            self._running += 1
+        return True
+
     def _uncharge(self, res) -> None:
         with self._avail_lock:
             self.available = self.available.add(res)
@@ -407,7 +419,23 @@ class NodeDaemon:
                     self._handle_xlang(conn, msg, conn_actors)
                     continue
                 if mtype in ("task", "actor_create", "actor_call"):
-                    self._handle_exec(conn, msg, conn_actors)
+                    try:
+                        self._handle_exec(conn, msg, conn_actors)
+                    except (self._WorkerCrashedError, OSError, EOFError):
+                        return  # the connection itself is gone
+                    except Exception as e:  # noqa: BLE001
+                        # A handler bug must degrade to ONE failed
+                        # request, not kill this conn thread — the
+                        # driver reads a dead dedicated conn as a dead
+                        # ACTOR, and repeated conn deaths as a dead
+                        # NODE (cascading a single bad request into a
+                        # spurious cluster-membership change).
+                        with contextlib.suppress(Exception):
+                            send_msg(conn, {
+                                "type": "result",
+                                "task_id": msg.get("task_id"),
+                                "crashed": f"daemon handler error: "
+                                           f"{type(e).__name__}: {e}"})
                     continue
                 reply = {"type": "result",
                          "error": f"unknown message {mtype!r}",
@@ -906,7 +934,15 @@ class NodeDaemon:
         # driver may address them later via the control plane's actor
         # table; they die only on explicit actor_kill or daemon stop.
         detached = bool(msg.pop("detached", False))
+        if not self._try_charge(res):
+            send_msg(conn, {"type": "result",
+                            "task_id": msg.get("task_id"),
+                            "crashed": "insufficient resources for "
+                                       "actor (create raced a release; "
+                                       "retry places elsewhere)"})
+            return
         worker = None
+        registered = False
         try:
             worker = self.pool.spawn_dedicated()
             # Cross-driver calls share this worker's socket: serialize.
@@ -915,19 +951,25 @@ class NodeDaemon:
             if reply.get("error") is None:
                 with self._actors_lock:
                     self._actors[aid] = (worker, res)
-                self._charge(res)
+                registered = True
                 if not detached:
                     conn_actors.append(aid)
-            else:
-                self.pool.retire(worker)
             send_msg(conn, reply)
         except self._WorkerCrashedError as e:
-            if worker is not None:
-                self.pool.retire(worker)
             with contextlib.suppress(Exception):
                 send_msg(conn, {"type": "result",
                                 "task_id": msg.get("task_id"),
                                 "crashed": str(e)})
+        finally:
+            # EVERY non-registered outcome (init error, worker crash,
+            # spawn failure, handler exception) returns the admission
+            # charge and retires the worker — a leaked charge shrinks
+            # this node's capacity forever.
+            if not registered:
+                if worker is not None:
+                    with contextlib.suppress(Exception):
+                        self.pool.retire(worker)
+                self._uncharge(res)
 
     def _run_actor_call(self, conn, msg) -> None:
         send_msg = self._send_msg
@@ -999,6 +1041,12 @@ class NodeDaemon:
 
 
 def main() -> None:
+    # SIGUSR1 → thread dump on stderr (live-debugging a wedged daemon).
+    import faulthandler
+    import signal
+
+    with contextlib.suppress(Exception):
+        faulthandler.register(signal.SIGUSR1)
     ap = argparse.ArgumentParser(description="ray_tpu node daemon")
     ap.add_argument("--address", required=True,
                     help="control plane host:port")

@@ -20,10 +20,24 @@ from typing import Any, Dict, List, Optional
 
 
 class Checkpoint:
-    """A directory full of state (reference: train/_checkpoint.py:55)."""
+    """A directory full of state, addressed by local path OR storage
+    URL (reference: train/_checkpoint.py:55 Checkpoint + from_uri —
+    URI-addressed checkpoints download lazily through the external
+    storage plane, so a checkpoint written on a host that later died
+    still restores anywhere)."""
 
     def __init__(self, path: str, *, _ephemeral: bool = False):
-        self.path = os.path.abspath(path)
+        from ..core.external_storage import is_url
+
+        if is_url(path) and not path.startswith("file://"):
+            self.uri: Optional[str] = path
+            self.path = ""  # resolved lazily by as_directory()
+        else:
+            if path.startswith("file://"):
+                path = path[len("file://"):]
+            self.uri = None
+            self.path = os.path.abspath(path)
+        self._local_cache: Optional[str] = None
         # Ephemeral checkpoints (from_pytree temp dirs) are MOVED into
         # storage by the manager instead of copied, so /tmp doesn't
         # accumulate one model copy per report().
@@ -34,6 +48,11 @@ class Checkpoint:
         return cls(path)
 
     @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        """reference: train Checkpoint.from_uri."""
+        return cls(uri)
+
+    @classmethod
     def from_pytree(cls, tree: Any, path: Optional[str] = None
                     ) -> "Checkpoint":
         ephemeral = path is None
@@ -42,13 +61,33 @@ class Checkpoint:
         return cls(path, _ephemeral=ephemeral)
 
     def as_directory(self) -> str:
+        if self.uri is not None:
+            if self._local_cache is None:
+                import weakref
+
+                from ..core.external_storage import storage_for_url
+
+                local = tempfile.mkdtemp(prefix="ray_tpu_ckpt_dl_")
+                storage_for_url(self.uri).download_dir(self.uri, local)
+                self._local_cache = local
+                # The download cache dies with the handle — otherwise
+                # every resume leaves one model copy in /tmp (the
+                # accumulation _ephemeral exists to prevent).
+                weakref.finalize(self, shutil.rmtree, local, True)
+            return self._local_cache
         return self.path
 
     def to_pytree(self) -> Any:
-        return load_pytree(self.path)
+        return load_pytree(self.as_directory())
+
+    def __getstate__(self):
+        # The download cache is host-local; a shipped handle re-fetches.
+        state = dict(self.__dict__)
+        state["_local_cache"] = None
+        return state
 
     def __repr__(self):
-        return f"Checkpoint({self.path})"
+        return f"Checkpoint({self.uri or self.path})"
 
 
 # ---------------------------------------------------------------------------
@@ -120,13 +159,41 @@ class CheckpointManager:
     def __init__(self, storage_path: str, num_to_keep: Optional[int] = None,
                  score_attribute: Optional[str] = None,
                  score_order: str = "max"):
+        from ..core.external_storage import is_url, storage_for_url
+
         self.storage_path = storage_path
         self.num_to_keep = num_to_keep
         self.score_attribute = score_attribute
         self.score_order = score_order
         self._lock = threading.Lock()
         self._records: List[Dict[str, Any]] = []
-        os.makedirs(storage_path, exist_ok=True)
+        self._next_index = 0
+        # Remote storage_path (cp://, mem://): checkpoints upload
+        # through the external-storage plane and the records hold URLs
+        # (reference: train/_internal/storage.py URI storage_path).
+        if is_url(storage_path) and not storage_path.startswith("file://"):
+            self._storage = storage_for_url(storage_path)
+            rest = storage_path.split("://", 1)[1]
+            _, _, prefix = rest.partition("/")
+            self._key_prefix = (prefix.rstrip("/") + "/") if prefix else ""
+        else:
+            self._storage = None
+            self._key_prefix = ""
+            if storage_path.startswith("file://"):
+                self.storage_path = storage_path[len("file://"):]
+            os.makedirs(self.storage_path, exist_ok=True)
+
+    def _exists(self, rec_or_path) -> bool:
+        """Liveness of a record/path. Remote records carry a local
+        `alive` flag (set False on evict) instead of paying one
+        network round trip per record per call."""
+        if isinstance(rec_or_path, dict):
+            if self._storage is not None:
+                return rec_or_path.get("alive", True)
+            return os.path.exists(rec_or_path["path"])
+        if self._storage is not None:
+            return self._storage.exists(rec_or_path)
+        return os.path.exists(rec_or_path)
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Dict[str, Any]) -> Optional[Checkpoint]:
@@ -134,8 +201,19 @@ class CheckpointManager:
         None if retention evicted it immediately (score below the kept
         top-K) — callers must not treat None as the latest checkpoint."""
         with self._lock:
-            idx = len(self._records)
-            dest = os.path.join(self.storage_path, f"checkpoint_{idx:06d}")
+            idx = self._next_index
+            self._next_index += 1
+        name = f"checkpoint_{idx:06d}"
+        # Upload OUTSIDE the lock: a multi-hundred-MB transfer must not
+        # block latest()/best() (the resume path) for its duration.
+        if self._storage is not None:
+            dest = self._storage.upload_dir(
+                checkpoint.as_directory(), self._key_prefix + name)
+            if checkpoint._ephemeral:
+                shutil.rmtree(checkpoint.as_directory(),
+                              ignore_errors=True)
+        else:
+            dest = os.path.join(self.storage_path, name)
             if os.path.abspath(checkpoint.path) != dest:
                 if os.path.exists(dest):
                     shutil.rmtree(dest)
@@ -143,14 +221,22 @@ class CheckpointManager:
                     shutil.move(checkpoint.path, dest)
                 else:
                     shutil.copytree(checkpoint.path, dest)
-            rec = {"path": dest, "metrics": dict(metrics),
-                   "ts": time.time(), "index": idx}
+        rec = {"path": dest, "metrics": dict(metrics),
+               "ts": time.time(), "index": idx, "alive": True}
+        with self._lock:
             self._records.append(rec)
-            self._evict_locked()
-            self._write_manifest_locked()
-            if not os.path.exists(dest):
-                return None
-            return Checkpoint(dest)
+            evicted = self._evict_locked()
+            manifest = self._manifest_locked()
+        # Storage deletions + manifest write outside the lock too.
+        for gone in evicted:
+            if self._storage is not None:
+                self._storage.delete_dir(gone["path"])
+            else:
+                shutil.rmtree(gone["path"], ignore_errors=True)
+        self._write_manifest(manifest)
+        if not self._exists(rec):
+            return None
+        return Checkpoint(dest)
 
     def _score(self, rec) -> float:
         if not self.score_attribute:
@@ -160,34 +246,48 @@ class CheckpointManager:
             return float("-inf")
         return v if self.score_order == "max" else -v
 
-    def _evict_locked(self):
+    def _evict_locked(self) -> List[Dict[str, Any]]:
+        """Pick + mark evictions under the lock; the caller performs
+        the (possibly remote) deletions outside it."""
         if not self.num_to_keep:
-            return
-        alive = [r for r in self._records if os.path.exists(r["path"])]
+            return []
+        alive = [r for r in self._records if self._exists(r)]
         if len(alive) <= self.num_to_keep:
-            return
+            return []
         alive.sort(key=self._score)
-        for rec in alive[: len(alive) - self.num_to_keep]:
-            shutil.rmtree(rec["path"], ignore_errors=True)
+        evicted = alive[: len(alive) - self.num_to_keep]
+        for rec in evicted:
+            rec["alive"] = False
+        return evicted
 
-    def _write_manifest_locked(self):
-        manifest = [
+    def _manifest_locked(self) -> str:
+        return json.dumps([
             {k: r[k] for k in ("path", "metrics", "ts", "index")}
-            for r in self._records if os.path.exists(r["path"])
-        ]
-        with open(os.path.join(self.storage_path, "manifest.json"), "w") as f:
-            json.dump(manifest, f, indent=1, default=str)
+            for r in self._records if self._exists(r)
+        ], indent=1, default=str)
+
+    def _write_manifest(self, blob: str) -> None:
+        try:
+            if self._storage is not None:
+                self._storage.put_blob(
+                    self._key_prefix + "manifest.json", blob.encode())
+                return
+            with open(os.path.join(self.storage_path,
+                                   "manifest.json"), "w") as f:
+                f.write(blob)
+        except Exception:  # noqa: BLE001 — manifest is advisory
+            pass
 
     def latest(self) -> Optional[Checkpoint]:
         with self._lock:
             for rec in reversed(self._records):
-                if os.path.exists(rec["path"]):
+                if self._exists(rec):
                     return Checkpoint(rec["path"])
         return None
 
     def best(self) -> Optional[Checkpoint]:
         with self._lock:
-            alive = [r for r in self._records if os.path.exists(r["path"])]
+            alive = [r for r in self._records if self._exists(r)]
             if not alive:
                 return None
             return Checkpoint(max(alive, key=self._score)["path"])

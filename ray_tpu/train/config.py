@@ -76,4 +76,9 @@ class RunConfig:
         base = self.storage_path or os.path.join(
             os.path.expanduser("~"), "ray_tpu_results")
         name = self.name or "run"
+        if "://" in base:
+            # Storage URL (cp://host:port/prefix, mem://bucket/...):
+            # checkpoints persist through the external-storage plane
+            # and survive the writing host.
+            return base.rstrip("/") + "/" + name
         return os.path.join(base, name)

@@ -48,6 +48,24 @@ def train_cluster():
         cluster.shutdown()
 
 
+_DAEMON_ENV = {"JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+
+
+def _ensure_daemons(cluster, n: int = 2) -> None:
+    """Chaos tests kill daemons and the local-mode tests tear down the
+    driver runtime; refill the pool and re-attach before each cluster
+    test."""
+    from ray_tpu.core import runtime as _runtime
+
+    rt = _runtime.global_runtime_or_none()
+    if rt is None or rt.remote_plane is None:
+        _runtime.shutdown_runtime()
+        cluster.connect()
+    while len(cluster._daemons) < n:
+        cluster.add_node(num_cpus=2, env=_DAEMON_ENV)
+
+
 def _make_loop(scratch_dir: str):
     """SPMD training loop: replicated scalar w descends toward the
     global data mean — the gradient is a psum over BOTH processes'
@@ -125,6 +143,7 @@ def test_spmd_training_over_daemons(train_cluster, tmp_path):
         TpuTrainer,
     )
 
+    _ensure_daemons(train_cluster)
     scratch = tmp_path / "scratch"
     scratch.mkdir()
     trainer = TpuTrainer(
@@ -162,6 +181,7 @@ def test_daemon_kill_midrun_recovers(train_cluster, tmp_path):
         TpuTrainer,
     )
 
+    _ensure_daemons(train_cluster)
     scratch = tmp_path / "scratch"
     scratch.mkdir()
     store = tmp_path / "store"
@@ -260,3 +280,66 @@ def test_multihost_local_without_procs_raises(tmp_path):
         assert "num_worker_procs" in str(result.error)
     finally:
         ray_tpu.shutdown()
+
+
+def test_checkpoints_on_control_plane_survive_writer_death(
+        train_cluster, tmp_path):
+    """Remote checkpoint storage (VERDICT r3 #5): RunConfig.storage_path
+    = cp://... sends every checkpoint through the external-storage
+    plane into the control plane's KV. SIGKILLing the daemon that WROTE
+    the checkpoints (rank 0's host) must not lose them — the restarted
+    gang resumes from remote storage on the survivor."""
+    from ray_tpu.core.external_storage import ControlPlaneStorage
+    from ray_tpu.train import (
+        FailureConfig,
+        RunConfig,
+        ScalingConfig,
+        TpuTrainer,
+    )
+
+    _ensure_daemons(train_cluster)
+    scratch = tmp_path / "scratch"
+    scratch.mkdir()
+    store_url = f"cp://{train_cluster.address}/ckpt-chaos"
+    trainer = TpuTrainer(
+        _make_loop(str(scratch)),
+        train_loop_config={"steps": 8, "step_sleep": 0.6},
+        scaling_config=ScalingConfig(
+            num_workers=2, cpus_per_worker=1,
+            placement_strategy="SPREAD", multihost=True),
+        run_config=RunConfig(
+            name="cpchaos", storage_path=store_url,
+            failure_config=FailureConfig(max_failures=5)),
+    )
+
+    box = {}
+    t = threading.Thread(
+        target=lambda: box.update(result=trainer.fit()), daemon=True)
+    t.start()
+
+    storage = ControlPlaneStorage(train_cluster.address)
+    rank0_file = scratch / "rank0.node"
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if rank0_file.exists() and storage.exists(
+                f"cp://{train_cluster.address}/"
+                "ckpt-chaos/cpchaos/checkpoint_000000"):
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("no checkpoint reached the control plane")
+
+    victim = rank0_file.read_text()
+    assert victim.startswith("daemon-")
+    train_cluster.kill_node(victim)
+
+    t.join(timeout=240)
+    assert not t.is_alive(), "fit() did not finish after daemon kill"
+    result = box["result"]
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 7
+    # Resumed from the REMOTE checkpoint, not from scratch.
+    assert result.metrics["resumed_at"] > 0
+    assert result.checkpoint is not None and result.checkpoint.uri
+    assert int(result.checkpoint.to_pytree()["step"]) == 7
+    assert abs(result.metrics["w"] - 1.5) < 0.1
