@@ -309,6 +309,50 @@ class RemotePlane:
             rt._require_recoverable(v.id())
             rt._maybe_reconstruct([v.id()])
 
+    def persist_detached_spec(self, st) -> None:
+        """Persist a detached actor's creation spec in the control
+        plane's KV so ANY surviving daemon can reconstruct it after its
+        node dies — with no driver attached (reference:
+        gcs_actor_manager.h:513 ReconstructActor; the GCS owns the
+        actor FSM cluster-wide). The spec's restarts_left is the ONE
+        cluster-wide restart budget: drivers never recreate detached
+        actors themselves (they re-attach to the reconstruction), so
+        the budget cannot be double-spent."""
+        import cloudpickle
+
+        def _has_ref(x) -> bool:
+            if isinstance(x, ObjectRef):
+                return True
+            if isinstance(x, (list, tuple, set)):
+                return any(_has_ref(v) for v in x)
+            if isinstance(x, dict):
+                return any(_has_ref(v) for v in x.values())
+            return False
+
+        if _has_ref(st.init_args) or _has_ref(st.init_kwargs):
+            # A reconstruction has no driver to resolve refs (and the
+            # ref's owner may be the thing that died). Plain-value
+            # constructor args are the supported shape; say so once
+            # instead of persisting a spec that crashes on restart.
+            logger.warning(
+                "detached actor %s has ObjectRef constructor args; "
+                "cluster-owned reconstruction disabled for it (pass "
+                "plain values to keep restarts available)",
+                st.actor_id.hex()[:12])
+            return
+        spec = {
+            "cls": cloudpickle.dumps(st.cls),
+            "args": cloudpickle.dumps(st.init_args),
+            "kwargs": cloudpickle.dumps(st.init_kwargs),
+            "resources": st.resources.to_dict(),
+            "restarts_left": int(st.max_restarts),
+        }
+        if st.runtime_env:
+            spec["runtime_env"] = self.prepare_runtime_env(
+                st.runtime_env)
+        self.control.kv_put("detached_spec/" + st.actor_id.hex(),
+                            cloudpickle.dumps(spec), overwrite=True)
+
     def prepare_runtime_env(self, renv):
         """Local working_dir/py_modules dirs → pkg:// URIs in the
         control plane's KV (uploaded once per content hash). No lock
@@ -655,6 +699,14 @@ def remote_actor_state_cls():
             if self._conn is not None:
                 self._conn.close()
                 self._conn = None
+            if gen > 0 and self.detached:
+                # Detached restart is CLUSTER-owned (a surviving daemon
+                # reconstructs from the persisted spec; reference:
+                # gcs_actor_manager.h ReconstructActor). The driver
+                # only RE-ATTACHES — recreating here would race the
+                # adoption into two live instances and double-spend
+                # the restart budget.
+                return self._rebind_detached(gen)
             # Node-resolution loop: an unreachable node is DROPPED and a
             # replacement picked without burning max_restarts — node
             # unreachability is placement failure, not actor failure
@@ -868,6 +920,43 @@ def remote_actor_state_cls():
             finally:
                 if not spec.redelivered:
                     rt._task_finished(spec)
+
+        def _rebind_detached(self, gen: int) -> bool:
+            """Wait for the cluster's reconstruction of this detached
+            actor and point this driver's mailbox at its new home."""
+            plane = self._plane
+            old_node_id = self.node.node_id
+            deadline = time.monotonic() + config.actor_replace_timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    info = plane.control.get_actor(self.actor_id.hex())
+                    meta = json.loads(info.get("meta") or "{}")
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.5)
+                    continue
+                if info.get("state") == "DEAD":
+                    break
+                nid = meta.get("node_id", "")
+                node = self.rt.scheduler.get_node(nid)
+                if (nid and nid != old_node_id and node is not None
+                        and node.alive
+                        and getattr(node, "is_remote", False)):
+                    try:
+                        conn = node.client.open_conn()
+                    except Exception:  # noqa: BLE001
+                        time.sleep(0.5)
+                        continue
+                    self.node = node
+                    self._conn = conn
+                    self.instance = conn
+                    self.ready.set()
+                    return True
+                time.sleep(0.5)
+            self.death_cause = ActorDiedError(
+                self.actor_id.hex(),
+                "detached actor was not reconstructed in time")
+            self._die(gen)
+            return False
 
         def _send_actor_kill(self) -> None:
             """Deliver actor_kill to the daemon, surviving a closed

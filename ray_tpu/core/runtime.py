@@ -1251,6 +1251,11 @@ class Runtime:
                     scoped = f"{ns}/{name}" if name else ""
                     try:
                         self.register_in_actor_table(st, scoped)
+                        if st.detached and st.max_restarts > 0:
+                            # Cluster-owned reconstruction: survivors
+                            # recreate it from this spec after a node
+                            # death, no driver required.
+                            self.remote_plane.persist_detached_spec(st)
                     except AlreadyExistsError:
                         st.kill()
                         raise ValueError(
@@ -1380,17 +1385,31 @@ class Runtime:
         live actor."""
         import json as _json
 
+        meta = {
+            "node_id": st.node.node_id,
+            "class": st.cls.__name__,
+            "detached": st.detached,
+            # so cross-driver proxies keep @method defaults and
+            # declared concurrency groups
+            "method_defaults": st.method_defaults,
+            "concurrency_groups": st.concurrency_groups,
+        }
+        # Preserve the incarnation counter across re-registrations:
+        # daemon adoption fences its KV claims on it, and a refresh
+        # that reset it to 0 would make every future claim collide
+        # with a spent key (reconstruction permanently stuck).
+        try:
+            prev = self.remote_plane.control.get_actor(
+                st.actor_id.hex())
+            inc = _json.loads(prev.get("meta") or "{}").get(
+                "incarnation")
+            if inc is not None:
+                meta["incarnation"] = int(inc)
+        except Exception:  # noqa: BLE001 — first registration
+            pass
         self.remote_plane.control.register_actor(
             st.actor_id.hex(), name=scoped_name,
-            meta=_json.dumps({
-                "node_id": st.node.node_id,
-                "class": st.cls.__name__,
-                "detached": st.detached,
-                # so cross-driver proxies keep @method defaults and
-                # declared concurrency groups
-                "method_defaults": st.method_defaults,
-                "concurrency_groups": st.concurrency_groups,
-            }))
+            meta=_json.dumps(meta))
         self.remote_plane.control.update_actor(st.actor_id.hex(),
                                                "ALIVE")
         st._cp_registered = True

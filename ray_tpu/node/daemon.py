@@ -200,6 +200,14 @@ class NodeDaemon:
             "node_kind": "daemon",
         }
         self.control.register_node(self.node_id, meta=json.dumps(meta))
+        # Detached-actor reconstruction (reference:
+        # gcs_actor_manager.h:513 ReconstructActor — the control plane
+        # owns the actor FSM cluster-wide): every daemon watches node
+        # deaths; survivors race a KV claim for each detached actor the
+        # dead node hosted and the winner recreates it locally from the
+        # spec persisted at creation — no driver needs to be attached.
+        with contextlib.suppress(Exception):
+            self.control.subscribe("node_events", self._on_node_event)
         self._hb_interval = heartbeat_interval_s
         self._hb_thread = threading.Thread(
             target=self._hb_loop, daemon=True, name="node-heartbeat")
@@ -481,6 +489,199 @@ class NodeDaemon:
                     "data": data.decode(errors="replace")}
         except Exception as e:  # noqa: BLE001 — report, don't kill conn
             return {"type": "result", "error": f"{type(e).__name__}: {e}"}
+
+    # -- detached-actor reconstruction ----------------------------------
+    def _on_node_event(self, payload: bytes) -> None:
+        text = payload.decode(errors="replace")
+        state, _, nid = text.partition(":")
+        if state != "DEAD":
+            return
+        if nid == self.node_id:
+            # The control plane declared US dead (e.g. a long stall):
+            # survivors are adopting our detached actors right now.
+            # FENCE: kill the local copies so a false-positive death
+            # cannot leave two live incarnations (reference: a raylet
+            # declared dead by the GCS does not keep serving).
+            threading.Thread(target=self._fence_detached,
+                             daemon=True, name="fence-self").start()
+            return
+        threading.Thread(
+            target=self._adopt_detached_from, args=(nid,),
+            daemon=True, name=f"adopt-{nid}").start()
+
+    def _fence_detached(self) -> None:
+        with self._actors_lock:
+            aids = list(self._actors.keys())
+        killed = 0
+        for aid in aids:
+            try:
+                hexid = aid.hex()
+                info = self.control.get_actor(hexid)
+                meta = json.loads(info.get("meta") or "{}")
+            except Exception:  # noqa: BLE001
+                continue
+            if meta.get("detached"):
+                self._kill_actor(aid)
+                killed += 1
+        if killed:
+            logger.warning(
+                "declared DEAD by the control plane; fenced %d local "
+                "detached actor copies", killed)
+
+    def _adopt_detached_from(self, dead_node_id: str,
+                             attempt: int = 0) -> None:
+        """Recreate the dead node's detached actors here (winner of the
+        per-actor KV claim). Reference: GcsActorManager::ReconstructActor
+        — restart is owned by the cluster, not by any driver."""
+        import cloudpickle
+
+        from ray_tpu._native.control_client import AlreadyExistsError
+
+        retry = False
+        try:
+            actors = self.control.list_actors()
+        except Exception:  # noqa: BLE001 — control plane unreachable
+            return
+        for a in actors:
+            if a.get("state") == "DEAD":
+                continue
+            aid_hex = a["actor_id"]
+            try:
+                info = self.control.get_actor(aid_hex)
+                actor_meta = json.loads(info.get("meta") or "{}")
+            except Exception:  # noqa: BLE001
+                continue
+            if not actor_meta.get("detached") \
+                    or actor_meta.get("node_id") != dead_node_id:
+                continue
+            try:
+                spec = cloudpickle.loads(
+                    self.control.kv_get("detached_spec/" + aid_hex))
+            except Exception:  # noqa: BLE001 — no persisted spec
+                continue
+            if spec.get("restarts_left", 0) <= 0:
+                continue
+            inc = int(actor_meta.get("incarnation", 0))
+            claim = f"detached_claim/{aid_hex}/{inc}"
+            try:
+                self.control.kv_put(claim, self.node_id,
+                                    overwrite=False)
+            except AlreadyExistsError:
+                continue  # another survivor won this incarnation
+            except Exception:  # noqa: BLE001
+                continue
+            try:
+                ok = self._restart_detached(aid_hex, info, actor_meta,
+                                            spec, inc)
+            except Exception:  # noqa: BLE001
+                logger.exception("detached restart of %s failed",
+                                 aid_hex[:12])
+                ok = False
+            if not ok:
+                # Release the claim so another survivor may try — and
+                # RE-RUN adoption after a delay: the one-shot DEAD
+                # event has already passed every other survivor by, so
+                # without a retry a failed winner (e.g. no local
+                # capacity) would strand the actor forever.
+                with contextlib.suppress(Exception):
+                    self.control.kv_del(claim)
+                retry = True
+        if retry and attempt < 5 and not self._stop.is_set():
+            def _later():
+                time.sleep(2.0 * (attempt + 1))
+                self._adopt_detached_from(dead_node_id, attempt + 1)
+
+            threading.Thread(target=_later, daemon=True,
+                             name=f"adopt-retry-{dead_node_id}").start()
+
+    def _spawn_actor_worker(self, aid: bytes, msg: dict,
+                            res) -> Tuple[Any, dict]:
+        """Charge → spawn a dedicated worker → run the actor_create →
+        register. Returns (worker, reply); worker is None on failure
+        with EVERY side effect rolled back (a leaked charge shrinks
+        this node's capacity forever). The ONE implementation of this
+        sequence — the create paths (driver-submitted, reconstruction)
+        must not drift on charge/retire semantics."""
+        if not self._try_charge(res):
+            return None, {"type": "result",
+                          "task_id": msg.get("task_id"),
+                          "crashed": "insufficient resources for "
+                                     "actor (create raced a release; "
+                                     "retry places elsewhere)"}
+        worker = None
+        try:
+            worker = self.pool.spawn_dedicated()
+            # Cross-driver calls share this worker's socket: serialize.
+            worker._xlang_call_lock = threading.Lock()
+            reply = worker.run_task(msg)
+        except Exception as e:  # noqa: BLE001
+            if worker is not None:
+                with contextlib.suppress(Exception):
+                    self.pool.retire(worker)
+            self._uncharge(res)
+            return None, {"type": "result",
+                          "task_id": msg.get("task_id"),
+                          "crashed": str(e)}
+        if reply.get("error") is not None or reply.get("crashed"):
+            with contextlib.suppress(Exception):
+                self.pool.retire(worker)
+            self._uncharge(res)
+            return None, reply
+        with self._actors_lock:
+            self._actors[aid] = (worker, res)
+        return worker, reply
+
+    def _restart_detached(self, aid_hex: str, info: dict,
+                          actor_meta: dict, spec: dict,
+                          inc: int) -> bool:
+        import cloudpickle
+
+        from ray_tpu.core.resources import ResourceSet
+
+        res = ResourceSet(spec.get("resources") or {})
+        aid = bytes.fromhex(aid_hex)
+        msg = {
+            "type": "actor_create", "task_id": None,
+            "num_returns": 0,
+            "actor_id": aid,
+            "cls": spec["cls"],
+            "args": cloudpickle.loads(spec["args"]),
+            "kwargs": cloudpickle.loads(spec["kwargs"]),
+        }
+        if spec.get("runtime_env"):
+            from ray_tpu.core.runtime_env_packaging import (
+                KV_PREFIX,
+                materialize,
+            )
+
+            try:
+                msg["runtime_env"] = materialize(
+                    spec["runtime_env"], self._renv_cache,
+                    lambda uri: self.control.kv_get(KV_PREFIX + uri))
+            except Exception as e:  # noqa: BLE001
+                logger.info("detached reconstruct of %s: runtime_env "
+                            "setup failed: %s", aid_hex[:12], e)
+                return False
+        worker, reply = self._spawn_actor_worker(aid, msg, res)
+        if worker is None:
+            logger.info("detached reconstruct of %s failed: %s",
+                        aid_hex[:12],
+                        reply.get("crashed") or reply.get("error"))
+            return False
+        spec["restarts_left"] = int(spec["restarts_left"]) - 1
+        with contextlib.suppress(Exception):
+            self.control.kv_put("detached_spec/" + aid_hex,
+                                cloudpickle.dumps(spec), overwrite=True)
+        actor_meta["node_id"] = self.node_id
+        actor_meta["incarnation"] = inc + 1
+        with contextlib.suppress(Exception):
+            self.control.register_actor(
+                aid_hex, name=info.get("name") or "",
+                meta=json.dumps(actor_meta))
+            self.control.update_actor(aid_hex, "ALIVE")
+        logger.info("reconstructed detached actor %s (incarnation %d)",
+                    aid_hex[:12], inc + 1)
+        return True
 
     def _kill_actor(self, aid) -> None:
         if aid is None:
@@ -927,49 +1128,17 @@ class NodeDaemon:
                 self.pool.release(worker)
 
     def _run_actor_create(self, conn, msg, res, conn_actors) -> None:
-        send_msg = self._send_msg
         aid = msg["actor_id"]
         # Detached actors (reference: lifetime="detached",
         # gcs_actor_manager.h) outlive their creator's connection — any
         # driver may address them later via the control plane's actor
         # table; they die only on explicit actor_kill or daemon stop.
         detached = bool(msg.pop("detached", False))
-        if not self._try_charge(res):
-            send_msg(conn, {"type": "result",
-                            "task_id": msg.get("task_id"),
-                            "crashed": "insufficient resources for "
-                                       "actor (create raced a release; "
-                                       "retry places elsewhere)"})
-            return
-        worker = None
-        registered = False
-        try:
-            worker = self.pool.spawn_dedicated()
-            # Cross-driver calls share this worker's socket: serialize.
-            worker._xlang_call_lock = threading.Lock()
-            reply = worker.run_task(msg)
-            if reply.get("error") is None:
-                with self._actors_lock:
-                    self._actors[aid] = (worker, res)
-                registered = True
-                if not detached:
-                    conn_actors.append(aid)
-            send_msg(conn, reply)
-        except self._WorkerCrashedError as e:
-            with contextlib.suppress(Exception):
-                send_msg(conn, {"type": "result",
-                                "task_id": msg.get("task_id"),
-                                "crashed": str(e)})
-        finally:
-            # EVERY non-registered outcome (init error, worker crash,
-            # spawn failure, handler exception) returns the admission
-            # charge and retires the worker — a leaked charge shrinks
-            # this node's capacity forever.
-            if not registered:
-                if worker is not None:
-                    with contextlib.suppress(Exception):
-                        self.pool.retire(worker)
-                self._uncharge(res)
+        worker, reply = self._spawn_actor_worker(aid, msg, res)
+        if worker is not None and not detached:
+            conn_actors.append(aid)
+        with contextlib.suppress(Exception):
+            self._send_msg(conn, reply)
 
     def _run_actor_call(self, conn, msg) -> None:
         send_msg = self._send_msg

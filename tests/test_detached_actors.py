@@ -103,3 +103,73 @@ def test_duplicate_name_across_drivers_rejected(cluster):
         ray.kill(h)
     finally:
         ray.shutdown()
+
+
+@pytest.fixture(scope="module")
+def cluster2():
+    c = RealCluster()
+    try:
+        c.add_node(num_cpus=2)
+        c.add_node(num_cpus=2)
+        yield c
+    finally:
+        c.shutdown()
+
+
+def test_detached_actor_restarted_by_control_plane(cluster2):
+    """VERDICT r3 #6 (reference: gcs_actor_manager.h:513
+    ReconstructActor): the CONTROL PLANE owns detached-actor restart.
+    Driver A creates a detached actor and exits; the daemon hosting it
+    is SIGKILLed with NO driver attached; a surviving daemon wins the
+    KV claim and recreates it from the persisted spec; driver B then
+    attaches by name and finds the restarted actor."""
+    ray.shutdown()
+    cluster2.connect()
+
+    @ray.remote(lifetime="detached", name="phoenix", max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.incarnation_marker = "fresh"
+
+        def where(self):
+            import os
+
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+        def marker(self):
+            return self.incarnation_marker
+
+        def set_marker(self, v):
+            self.incarnation_marker = v
+            return v
+
+    a = Phoenix.remote()
+    home = ray.get(a.where.remote())
+    assert home.startswith("daemon-")
+    assert ray.get(a.set_marker.remote("driver-A-state")) \
+        == "driver-A-state"
+    ray.shutdown()  # driver A gone — nothing owns the actor now
+
+    cluster2.kill_node(home)  # the actor's host dies, driverless
+
+    # A survivor must adopt it (health expiry + claim + recreate).
+    deadline = time.monotonic() + 60
+    restarted_on = None
+    while time.monotonic() < deadline:
+        cluster2.connect()
+        try:
+            h = ray.get_actor("phoenix")
+            restarted_on = ray.get(h.where.remote(), timeout=10)
+            if restarted_on and restarted_on != home:
+                break
+        except Exception:
+            pass
+        ray.shutdown()
+        time.sleep(1.0)
+    assert restarted_on is not None and restarted_on != home, (
+        f"actor not reconstructed (home={home}, now={restarted_on})")
+    # Restart re-ran __init__ (reference semantics): state is fresh.
+    h = ray.get_actor("phoenix")
+    assert ray.get(h.marker.remote(), timeout=10) == "fresh"
+    ray.kill(h)
+    ray.shutdown()
