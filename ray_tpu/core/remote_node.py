@@ -699,13 +699,19 @@ def remote_actor_state_cls():
             if self._conn is not None:
                 self._conn.close()
                 self._conn = None
-            if gen > 0 and self.detached:
-                # Detached restart is CLUSTER-owned (a surviving daemon
-                # reconstructs from the persisted spec; reference:
-                # gcs_actor_manager.h ReconstructActor). The driver
-                # only RE-ATTACHES — recreating here would race the
-                # adoption into two live instances and double-spend
-                # the restart budget.
+            node_gone = (not self.node.alive
+                         or self.node.node_id not in plane._known)
+            if gen > 0 and self.detached and node_gone:
+                # NODE-death restart of a detached actor is
+                # CLUSTER-owned (a surviving daemon reconstructs from
+                # the persisted spec; reference: gcs_actor_manager.h
+                # ReconstructActor). The driver only RE-ATTACHES —
+                # recreating here would race the adoption into two
+                # live instances and double-spend the restart budget.
+                # A worker crash with the node ALIVE follows the
+                # normal driver recreate below (the daemon also
+                # self-restarts crashed detached actors; create is
+                # idempotent on the daemon side via the actor map).
                 return self._rebind_detached(gen)
             # Node-resolution loop: an unreachable node is DROPPED and a
             # replacement picked without burning max_restarts — node
@@ -926,7 +932,11 @@ def remote_actor_state_cls():
             actor and point this driver's mailbox at its new home."""
             plane = self._plane
             old_node_id = self.node.node_id
-            deadline = time.monotonic() + config.actor_replace_timeout_s
+            # Reconstruction worst case = health expiry + adoption
+            # retries (2+4+...s) + env setup; actor_replace_timeout_s
+            # (placement-failure scale) is far too short for it.
+            deadline = time.monotonic() + max(
+                60.0, 3 * config.actor_replace_timeout_s)
             while time.monotonic() < deadline:
                 try:
                     info = plane.control.get_actor(self.actor_id.hex())

@@ -147,7 +147,10 @@ class NodeDaemon:
         self._peer_view_ts = -1e9
         self._peer_view_lock = threading.Lock()
 
-        # Actors hosted here: actor_id(bytes) -> dedicated WorkerProcess.
+        # Actors hosted here: actor_id(bytes) ->
+        # (WorkerProcess, ResourceSet, detached: bool). detached is
+        # recorded LOCALLY so fencing and crash-restart decisions never
+        # depend on reaching the control plane.
         self._actors: Dict[bytes, Any] = {}
         self._actors_lock = threading.Lock()
         # Running tasks (OOM-kill candidates): id -> (seq, retriable,
@@ -296,13 +299,30 @@ class NodeDaemon:
                 best, best_score = nid, score
         return best
 
+    _hb_failures = 0
+
     def _hb_loop(self):
+        fenced = False
         while not self._stop.wait(self._hb_interval):
             try:
                 self.control.heartbeat(
                     self.node_id, load=json.dumps(self._load_report()))
+                self._hb_failures = 0
+                fenced = False
             except Exception:  # noqa: BLE001 — control plane hiccup
-                pass
+                self._hb_failures += 1
+                # Partitioned from the control plane long enough that
+                # it has certainly declared us dead and survivors are
+                # adopting our detached actors — the one-shot DEAD
+                # pubsub event cannot reach us, so self-fence on the
+                # heartbeat failure streak (reference: a raylet the
+                # GCS declared dead stops serving).
+                if (not fenced and self._hb_failures
+                        * self._hb_interval > 30.0):
+                    fenced = True
+                    threading.Thread(target=self._fence_detached,
+                                     daemon=True,
+                                     name="fence-partition").start()
 
     def _charge(self, res) -> None:
         with self._avail_lock:
@@ -405,7 +425,16 @@ class NodeDaemon:
                         send_msg(conn, reply)
                     continue
                 if mtype == "actor_kill":
-                    self._kill_actor(msg.get("actor_id"))
+                    entry = self._kill_actor(msg.get("actor_id"))
+                    if entry is not None and len(entry) > 2 and entry[2]:
+                        # Explicit kill of a detached actor: drop its
+                        # persisted spec so no reconstruction path can
+                        # resurrect it (reference: GCS removes a killed
+                        # detached actor from the table for good).
+                        aid_hex = msg["actor_id"].hex()
+                        with contextlib.suppress(Exception):
+                            self.control.kv_del(
+                                "detached_spec/" + aid_hex)
                     send_msg(conn, {"type": "result", "error": None,
                                     "returns": []})
                     continue
@@ -510,23 +539,18 @@ class NodeDaemon:
             daemon=True, name=f"adopt-{nid}").start()
 
     def _fence_detached(self) -> None:
+        # Decided from LOCAL state only: in the most common false-death
+        # cause (a partition from the control plane) no lookup there
+        # can succeed.
         with self._actors_lock:
-            aids = list(self._actors.keys())
-        killed = 0
+            aids = [aid for aid, entry in self._actors.items()
+                    if len(entry) > 2 and entry[2]]
         for aid in aids:
-            try:
-                hexid = aid.hex()
-                info = self.control.get_actor(hexid)
-                meta = json.loads(info.get("meta") or "{}")
-            except Exception:  # noqa: BLE001
-                continue
-            if meta.get("detached"):
-                self._kill_actor(aid)
-                killed += 1
-        if killed:
+            self._kill_actor(aid)
+        if aids:
             logger.warning(
                 "declared DEAD by the control plane; fenced %d local "
-                "detached actor copies", killed)
+                "detached actor copies", len(aids))
 
     def _adopt_detached_from(self, dead_node_id: str,
                              attempt: int = 0) -> None:
@@ -594,8 +618,8 @@ class NodeDaemon:
             threading.Thread(target=_later, daemon=True,
                              name=f"adopt-retry-{dead_node_id}").start()
 
-    def _spawn_actor_worker(self, aid: bytes, msg: dict,
-                            res) -> Tuple[Any, dict]:
+    def _spawn_actor_worker(self, aid: bytes, msg: dict, res,
+                            detached: bool = False) -> Tuple[Any, dict]:
         """Charge → spawn a dedicated worker → run the actor_create →
         register. Returns (worker, reply); worker is None on failure
         with EVERY side effect rolled back (a leaked charge shrinks
@@ -628,7 +652,15 @@ class NodeDaemon:
             self._uncharge(res)
             return None, reply
         with self._actors_lock:
-            self._actors[aid] = (worker, res)
+            old = self._actors.pop(aid, None)
+            self._actors[aid] = (worker, res, detached)
+        if old is not None:
+            # Replace semantics: a concurrent recreate (driver recreate
+            # racing the daemon's own crash-restart) must not leak the
+            # superseded worker or its charge.
+            with contextlib.suppress(Exception):
+                self.pool.retire(old[0])
+            self._uncharge(old[1])
         return worker, reply
 
     def _restart_detached(self, aid_hex: str, info: dict,
@@ -662,7 +694,8 @@ class NodeDaemon:
                 logger.info("detached reconstruct of %s: runtime_env "
                             "setup failed: %s", aid_hex[:12], e)
                 return False
-        worker, reply = self._spawn_actor_worker(aid, msg, res)
+        worker, reply = self._spawn_actor_worker(aid, msg, res,
+                                                 detached=True)
         if worker is None:
             logger.info("detached reconstruct of %s failed: %s",
                         aid_hex[:12],
@@ -674,26 +707,43 @@ class NodeDaemon:
                                 cloudpickle.dumps(spec), overwrite=True)
         actor_meta["node_id"] = self.node_id
         actor_meta["incarnation"] = inc + 1
-        with contextlib.suppress(Exception):
-            self.control.register_actor(
-                aid_hex, name=info.get("name") or "",
-                meta=json.dumps(actor_meta))
-            self.control.update_actor(aid_hex, "ALIVE")
+        # The table update is what makes the reconstruction REACHABLE
+        # (drivers re-attach by reading it) — retry hard rather than
+        # leaving a live-but-undiscoverable actor behind a one-shot
+        # network hiccup.
+        updated = False
+        for _ in range(5):
+            try:
+                self.control.register_actor(
+                    aid_hex, name=info.get("name") or "",
+                    meta=json.dumps(actor_meta))
+                self.control.update_actor(aid_hex, "ALIVE")
+                updated = True
+                break
+            except Exception:  # noqa: BLE001
+                time.sleep(1.0)
+        if not updated:
+            logger.error(
+                "reconstructed detached actor %s but could not update "
+                "the actor table; it is running here (%s) but "
+                "undiscoverable until the table is refreshed",
+                aid_hex[:12], self.node_id)
         logger.info("reconstructed detached actor %s (incarnation %d)",
                     aid_hex[:12], inc + 1)
         return True
 
-    def _kill_actor(self, aid) -> None:
+    def _kill_actor(self, aid):
         if aid is None:
-            return
+            return None
         with self._actors_lock:
             entry = self._actors.pop(aid, None)
         if entry is not None:
-            w, res = entry
+            w, res = entry[0], entry[1]
             self.pool.retire(w)
             self._uncharge(res)
             with contextlib.suppress(Exception):
                 self.shm.reclaim_dead_pins()
+        return entry
 
     def _handle_exec(self, conn, msg: Dict[str, Any], conn_actors) -> None:
         from ray_tpu.core.resources import ResourceSet
@@ -940,7 +990,7 @@ class NodeDaemon:
         from ray_tpu.core.resources import ResourceSet
 
         with self._actors_lock:
-            self._actors[aid] = (worker, ResourceSet({}))
+            self._actors[aid] = (worker, ResourceSet({}), False)
         conn_actors.append(aid)
         return aid.hex()
 
@@ -950,7 +1000,7 @@ class NodeDaemon:
             entry = self._actors.get(aid)
         if entry is None:
             raise KeyError("actor not hosted on this node")
-        worker, _res = entry
+        worker = entry[0]
         rid = os.urandom(28)
         # Any connection may address this actor by id: serialize the
         # socket round trip per worker or two daemon threads interleave
@@ -1134,7 +1184,7 @@ class NodeDaemon:
         # driver may address them later via the control plane's actor
         # table; they die only on explicit actor_kill or daemon stop.
         detached = bool(msg.pop("detached", False))
-        worker, reply = self._spawn_actor_worker(aid, msg, res)
+        worker, reply = self._spawn_actor_worker(aid, msg, res, detached)
         if worker is not None and not detached:
             conn_actors.append(aid)
         with contextlib.suppress(Exception):
@@ -1149,7 +1199,7 @@ class NodeDaemon:
             send_msg(conn, {"type": "result", "task_id": msg.get("task_id"),
                             "crashed": "actor not hosted on this node"})
             return
-        worker, res = entry
+        worker = entry[0]
         # Cross-driver/detached actors can be addressed from several
         # connections; one worker socket carries one request at a time.
         lock = getattr(worker, "_xlang_call_lock", None)
@@ -1163,7 +1213,19 @@ class NodeDaemon:
                         msg, on_stream=lambda item: send_msg(conn, item))
                     send_msg(conn, reply)
         except self._WorkerCrashedError as e:
+            was_detached = len(entry) > 2 and entry[2]
             self._kill_actor(aid)
+            if was_detached:
+                # Worker crash with the NODE alive: nobody publishes a
+                # death event, so the cluster reconstruction path never
+                # fires — this daemon restarts its own detached actor
+                # from the spec (budget still enforced via the claim).
+                def _local_adopt():
+                    time.sleep(1.0)  # let an explicit kill's DEAD land
+                    self._adopt_detached_from(self.node_id)
+
+                threading.Thread(target=_local_adopt, daemon=True,
+                                 name="adopt-local-crash").start()
             with contextlib.suppress(Exception):
                 send_msg(conn, {"type": "result",
                                 "task_id": msg.get("task_id"),
@@ -1190,7 +1252,8 @@ class NodeDaemon:
         with self._actors_lock:
             actors = list(self._actors.values())
             self._actors.clear()
-        for w, _res in actors:
+        for entry in actors:
+            w = entry[0]
             with contextlib.suppress(Exception):
                 self.pool.retire(w)
         self.pool.shutdown()

@@ -26,13 +26,44 @@ _grpc_proxy: Optional[GrpcProxy] = None
 _route_of_app: Dict[str, str] = {}  # app name -> proxy route
 
 
+def _cluster_plane():
+    from ..core.runtime import global_runtime_or_none
+
+    rt = global_runtime_or_none()
+    return rt.remote_plane if rt is not None else None
+
+
 def _get_or_create_controller():
     try:
         return get_actor(_CONTROLLER_NAME)
     except ValueError:
+        opts = {"name": _CONTROLLER_NAME, "get_if_exists": True}
+        if _cluster_plane() is not None:
+            # Cluster mode: the controller must live IN the driver
+            # runtime — it owns replica placement and reads actor
+            # locations from the driver's scheduler (the reference's
+            # GCS-resident controller maps to the driver-resident
+            # control plane here; PARITY.md scheduler note).
+            from ..core.runtime import global_runtime
+            from ..core.task import NodeAffinitySchedulingStrategy
+
+            opts["scheduling_strategy"] = NodeAffinitySchedulingStrategy(
+                node_id=global_runtime().head_node_id, soft=False)
         Controller = remote(num_cpus=0, max_concurrency=32)(ServeController)
-        return Controller.options(
-            name=_CONTROLLER_NAME, get_if_exists=True).remote()
+        return Controller.options(**opts).remote()
+
+
+def _start_node_proxies() -> None:
+    """One HTTP ingress per daemon (reference: per-node ProxyActor,
+    serve/_private/proxy.py:1100). The CONTROLLER owns proxy
+    membership (it reconciles joins/deaths every ~2s); this just
+    triggers the first reconcile synchronously."""
+    from .. import get as ray_get
+
+    if _cluster_plane() is None:
+        return
+    controller = _get_or_create_controller()
+    ray_get(controller.ensure_proxies.remote(), timeout=60)
 
 
 def run(app: Application, *, name: str = "default",
@@ -83,6 +114,14 @@ def run(app: Application, *, name: str = "default",
                 _proxy = HttpProxy(port=http_port)
                 _proxy.start()
             _proxy.add_route(route_prefix or name, ingress)
+        if _cluster_plane() is not None:
+            # Multi-node data plane: per-daemon proxies + the shared
+            # route table through the control plane.
+            from .. import get as ray_get
+
+            ray_get(controller.set_route.remote(
+                new_route, ingress._name))
+            _start_node_proxies()
     if grpc:
         with _lock:
             if _grpc_proxy is None:
@@ -113,8 +152,13 @@ def delete(name: str):
     controller = get_actor(_CONTROLLER_NAME)
     ray_get(controller.delete.remote(name))
     # Routes are registered under route_prefix (falling back to the app
-    # name) — remove the route actually registered.
+    # name) — remove the route actually registered, on the local
+    # proxies AND the cluster route table.
     route = _route_of_app.pop(name, name)
+    try:
+        ray_get(controller.remove_route.remote(route), timeout=10)
+    except Exception:  # noqa: BLE001
+        pass
     if _proxy is not None:
         _proxy.remove_route(route)
     if _grpc_proxy is not None:
@@ -135,6 +179,16 @@ def shutdown():
         except Exception:  # noqa: BLE001
             pass
         ray_kill(controller)
+    plane = _cluster_plane()
+    if plane is not None:
+        # The shared route table must not outlive Serve: the next
+        # serve.run's proxies would read stale replica endpoints.
+        from .node_proxy import ROUTES_KEY
+
+        try:
+            plane.control.kv_del(ROUTES_KEY)
+        except Exception:  # noqa: BLE001
+            pass
     if _proxy is not None:
         _proxy.stop()
         _proxy = None

@@ -9,6 +9,7 @@ ongoing-request metrics, performs rolling updates on redeploy."""
 
 from __future__ import annotations
 
+import contextlib
 import math
 import threading
 import time
@@ -32,10 +33,21 @@ class _ReplicaSet:
         self._last_scale_down = now
 
     def scale_to(self, n: int, init_args=(), init_kwargs=None):
+        from ..core.task import SpreadSchedulingStrategy
+
         cfg = self.deployment.config
+        # Deployment-aware SPREAD (reference:
+        # serve/_private/deployment_scheduler.py — replicas default to
+        # spreading across nodes so one node death takes out a
+        # fraction, not the whole deployment) + restartable actors so
+        # the runtime's restart-with-replacement reschedules a dead
+        # node's replicas onto survivors.
+        opts = _actor_opts(cfg.ray_actor_options)
+        opts.setdefault("max_restarts", 10)
         ReplicaActor = remote(
             max_concurrency=cfg.max_concurrency,
-            **_actor_opts(cfg.ray_actor_options))(Replica)
+            scheduling_strategy=SpreadSchedulingStrategy(),
+            **opts)(Replica)
         while len(self.replicas) < n:
             self.replicas.append(ReplicaActor.remote(
                 self.target_bytes, tuple(init_args), init_kwargs or {},
@@ -72,6 +84,8 @@ class ServeController:
 
     def __init__(self):
         self._sets: Dict[str, _ReplicaSet] = {}
+        self._routes: Dict[str, str] = {}  # http route -> deployment
+        self._proxies: Dict[str, Any] = {}  # node_id -> NodeProxy
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._loop = threading.Thread(
@@ -123,8 +137,19 @@ class ServeController:
         self._stop.set()
         with self._lock:
             names = list(self._sets)
+            proxies = dict(self._proxies)
+            self._proxies.clear()
         for n in names:
             self.delete(n)
+        for nid, p in proxies.items():
+            try:
+                ray_get(p.stop.remote(), timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                ray_kill(p)
+            except Exception:  # noqa: BLE001
+                pass
 
     # -- discovery -------------------------------------------------------
     def get_replicas(self, name: str):
@@ -133,6 +158,121 @@ class ServeController:
             if rs is None:
                 raise KeyError(f"No deployment {name!r}")
             return list(rs.replicas), rs.version
+
+    def set_route(self, route: str, deployment_name: str):
+        """Bind an HTTP route to a deployment; the control loop keeps
+        the shared route table (control-plane KV) pointing at the live
+        replica set (reference: the controller broadcasting route
+        configs to every node's proxy, proxy_state.py)."""
+        route = route.strip("/")
+        with self._lock:
+            self._routes[route] = deployment_name
+        self._publish_routes()
+        return True
+
+    def remove_route(self, route: str):
+        with self._lock:
+            self._routes.pop(route.strip("/"), None)
+        self._publish_routes()
+        return True
+
+    def replica_locations(self, name: str):
+        """[(aid_hex, node_id, host, dispatch_port, transfer_port)] for
+        a deployment's live replicas. The controller runs in the driver
+        runtime, which owns actor placement."""
+        from ..core.runtime import global_runtime_or_none
+
+        with self._lock:
+            rs = self._sets.get(name)
+            replicas = list(rs.replicas) if rs else []
+        rt = global_runtime_or_none()
+        out = []
+        for r in replicas:
+            aid = getattr(r, "_actor_id", None)
+            if aid is None or rt is None:
+                continue
+            st = rt._actors.get(aid)
+            if st is None or st.dead.is_set():
+                continue
+            node = st.node
+            if not getattr(node, "alive", True):
+                # Mid-restart after its node died — routable again once
+                # restart-with-replacement lands it on a survivor.
+                continue
+            meta = getattr(node, "meta", None) or {}
+            out.append((aid.hex(), node.node_id,
+                        getattr(node, "host", "127.0.0.1"),
+                        int(getattr(node, "dispatch_port", 0)),
+                        int(meta.get("object_port", 0) or
+                            getattr(node, "object_port", 0))))
+        return out
+
+    def ensure_proxies(self):
+        """Proxy membership is reconciled state, not a deploy-time
+        snapshot (reference: proxy_state.py — the controller keeps one
+        proxy per node): nodes that join later get an ingress; dead
+        nodes' proxy registrations are removed so discovery never
+        returns dead addresses."""
+        from ..core.runtime import global_runtime_or_none
+        from ..core.task import NodeAffinitySchedulingStrategy
+        from .node_proxy import PROXY_PREFIX, NodeProxy
+
+        rt = global_runtime_or_none()
+        if rt is None or rt.remote_plane is None:
+            return 0
+        with self._lock:
+            if not self._routes:
+                return len(self._proxies)
+        alive = {n.node_id: n for n in rt.scheduler.nodes()
+                 if getattr(n, "is_remote", False) and n.alive}
+        with self._lock:
+            have = dict(self._proxies)
+        for nid in list(have):
+            if nid not in alive:
+                with self._lock:
+                    p = self._proxies.pop(nid, None)
+                with contextlib.suppress(Exception):
+                    rt.remote_plane.control.kv_del(PROXY_PREFIX + nid)
+                if p is not None:
+                    with contextlib.suppress(Exception):
+                        ray_kill(p)
+        for nid in alive:
+            if nid in have:
+                continue
+            try:
+                Proxy = remote(
+                    num_cpus=0,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=nid, soft=False))(NodeProxy)
+                actor = Proxy.remote(rt.remote_plane.address)
+                ray_get(actor.ping.remote(), timeout=30)
+                with self._lock:
+                    self._proxies[nid] = actor
+            except Exception:  # noqa: BLE001 — next tick retries
+                pass
+        with self._lock:
+            return len(self._proxies)
+
+    def _publish_routes(self):
+        from ..core.runtime import global_runtime_or_none
+
+        rt = global_runtime_or_none()
+        if rt is None or rt.remote_plane is None:
+            return  # local mode: the in-process proxy routes directly
+        with self._lock:
+            routes = dict(self._routes)
+        table = {}
+        for route, dep in routes.items():
+            table[route] = {
+                "deployment": dep,
+                "replicas": self.replica_locations(dep),
+            }
+        try:
+            from .node_proxy import publish_routes
+
+            publish_routes(rt.remote_plane.control, table)
+        except Exception:  # noqa: BLE001 — next loop tick retries
+            pass
 
     def list_deployments(self) -> List[str]:
         with self._lock:
@@ -149,9 +289,11 @@ class ServeController:
                 for name, rs in self._sets.items()
             }
 
-    # -- autoscaling -----------------------------------------------------
+    # -- autoscaling + reconciliation ------------------------------------
     def _control_loop(self):
+        ticks = 0
         while not self._stop.wait(0.25):
+            ticks += 1
             with self._lock:
                 sets = list(self._sets.values())
             for rs in sets:
@@ -162,6 +304,61 @@ class ServeController:
                     self._autoscale(rs, asc)
                 except Exception:  # noqa: BLE001
                     pass
+            if ticks % 4 == 0:  # every ~1s
+                try:
+                    self._reconcile()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._publish_routes()
+            if ticks % 8 == 0:  # every ~2s
+                try:
+                    self.ensure_proxies()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _reconcile(self):
+        """Replace replicas that died for good (restarts exhausted) —
+        the runtime's restart-with-replacement handles transient node
+        deaths; this closes the gap when it gives up (reference:
+        DeploymentState replacing FAILED replicas)."""
+        from ..core.runtime import global_runtime_or_none
+
+        rt = global_runtime_or_none()
+        if rt is None:
+            return
+        with self._lock:
+            sets = list(self._sets.items())
+        for name, rs in sets:
+            # Classify under the lock; poke/scale OUTSIDE it — replica
+            # creation and pings are network-visible work and every
+            # other controller call (deploy/status/route publishing)
+            # queues behind this lock.
+            with self._lock:
+                alive, dead, to_poke = [], 0, []
+                for r in rs.replicas:
+                    st = rt._actors.get(getattr(r, "_actor_id", None))
+                    if st is not None and st.dead.is_set():
+                        dead += 1
+                        continue
+                    alive.append(r)
+                    if st is not None and not getattr(
+                            st.node, "alive", True):
+                        to_poke.append(r)
+                if dead:
+                    rs.replicas = alive
+            for r in to_poke:
+                # Idle replica on a DEAD node: its mailbox only notices
+                # the severed connection at the next call — poke it so
+                # restart-with-replacement moves it to a survivor NOW.
+                try:
+                    r.stats.remote()
+                except Exception:  # noqa: BLE001
+                    pass
+            if dead:
+                with self._lock:
+                    target = len(rs.replicas) + dead
+                    rs.scale_to(target, getattr(rs, "init_args", ()),
+                                getattr(rs, "init_kwargs", {}))
 
     def _autoscale(self, rs: _ReplicaSet, asc: AutoscalingConfig):
         ongoing = rs.ongoing()

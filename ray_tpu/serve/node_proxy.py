@@ -1,0 +1,280 @@
+"""Per-daemon HTTP ingress — the multi-node Serve data plane.
+
+Capability-equivalent of the reference's per-node ProxyActor
+(reference: python/ray/serve/_private/proxy.py:1100 — every node runs
+an HTTP proxy; the controller keeps their route tables in sync; routing
+prefers same-node replicas). TPU-native shape: the proxy runs as an
+actor in a daemon worker process, reads the shared route table from the
+control plane's KV (where the driver-side Serve controller publishes
+it), and forwards requests to replica actors DIRECTLY over the daemon
+dispatch protocol (node/client.NodeConn actor_call) — no driver in the
+data path. Locality: replicas on the proxy's own node are preferred;
+remote replicas are the fallback (reference:
+replica_scheduler locality-aware routing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+ROUTES_KEY = "serve/routes"
+PROXY_PREFIX = "serve/proxy/"
+
+
+def publish_routes(control, table: Dict[str, Any]) -> None:
+    """Controller-side: write the shared route table.
+    table: {route: {"deployment": str,
+                    "replicas": [(aid_hex, node_id, host, dispatch_port,
+                                  transfer_port), ...]}}"""
+    import cloudpickle
+
+    control.kv_put(ROUTES_KEY, cloudpickle.dumps(table), overwrite=True)
+
+
+def read_routes(control) -> Dict[str, Any]:
+    import cloudpickle
+
+    try:
+        return cloudpickle.loads(control.kv_get(ROUTES_KEY))
+    except Exception:  # noqa: BLE001 — not published yet
+        return {}
+
+
+def list_proxies(control) -> Dict[str, str]:
+    """node_id -> host:port of every live proxy."""
+    out = {}
+    for key in control.kv_keys(PROXY_PREFIX):
+        try:
+            out[key[len(PROXY_PREFIX):]] = control.kv_get(key).decode()
+        except Exception:  # noqa: BLE001
+            pass
+    return out
+
+
+class _ReplicaCall:
+    """Direct replica invocation over the daemon dispatch protocol."""
+
+    def __init__(self):
+        self._conns: Dict[Tuple[str, int], Any] = {}
+        self._lock = threading.Lock()
+
+    def _conn(self, host: str, port: int):
+        from ..node.client import NodeConn
+
+        key = (host, port)
+        with self._lock:
+            conn = self._conns.pop(key, None)
+        if conn is None or not conn.alive:
+            conn = NodeConn(host, port, timeout=5.0)
+        return key, conn
+
+    def _put(self, key, conn) -> None:
+        with self._lock:
+            if conn.alive and key not in self._conns:
+                self._conns[key] = conn
+                return
+        conn.close()
+
+    def call(self, entry, method: str, args: tuple,
+             kwargs: dict) -> Any:
+        """Synchronous call; returns the deserialized result or raises."""
+        from ..core.serialization import SerializedObject, deserialize
+
+        aid_hex, node_id, host, dport, tport = entry
+        rid = os.urandom(16)
+        msg = {
+            "type": "actor_call", "task_id": os.urandom(12),
+            "actor_id": bytes.fromhex(aid_hex),
+            "method": method, "args": args, "kwargs": kwargs,
+            "num_returns": 1, "return_ids": [rid],
+            "streaming": False,
+        }
+        key, conn = self._conn(host, dport)
+        try:
+            reply = conn.request(msg)
+        except Exception:
+            conn.close()
+            raise
+        self._put(key, conn)
+        if reply.get("crashed"):
+            raise RuntimeError(f"replica crashed: {reply['crashed']}")
+        if reply.get("error") is not None:
+            raise RuntimeError(f"replica error: {reply['error']!r}")
+        returns = reply.get("returns") or []
+        if not returns:
+            return None
+        kind, payload = returns[0]  # _pack_value wire tuple
+        if kind == "ser":
+            return deserialize(SerializedObject.from_bytes(payload))
+        if kind == "shm":
+            return self._fetch_shm(payload, host, tport)
+        raise RuntimeError(f"unknown return packing {kind!r}")
+
+    def _fetch_shm(self, obj_key: bytes, host: str, tport: int):
+        """Large result living in the replica daemon's arena: pull it
+        into THIS node's arena over the transfer plane, then read."""
+        from .._native.object_transfer import TransferClient
+        from .._native.shm_store import ShmStore
+        from ..core.serialization import SerializedObject, deserialize
+
+        nid = os.environ.get("RAY_TPU_NODE_ID", "")
+        shm_name = f"/rtn_{nid.replace('-', '')[:20]}"
+        tc = TransferClient(host, tport, shm_name)
+        try:
+            tc.pull(obj_key)
+        finally:
+            with contextlib.suppress(Exception):
+                tc.close()
+        shm = ShmStore(shm_name, create=False)
+        view = shm.get(obj_key, pin=True)
+        try:
+            return deserialize(SerializedObject.from_bytes(bytes(view)))
+        finally:
+            with contextlib.suppress(Exception):
+                shm.unpin(obj_key)
+
+
+class NodeProxy:
+    """HTTP ingress for one daemon. Created by serve.run over every
+    alive node; registers its bound address in the control plane so
+    clients (and tests) can discover it."""
+
+    def __init__(self, control_address: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        from .._native.control_client import ControlClient
+
+        chost, _, cport = control_address.partition(":")
+        self._control = ControlClient(int(cport), host=chost)
+        self.node_id = os.environ.get("RAY_TPU_NODE_ID", "head")
+        self._routes: Dict[str, Any] = {}
+        self._call = _ReplicaCall()
+        self._ongoing: Dict[str, int] = {}  # aid_hex -> in-flight
+        self._olock = threading.Lock()
+        self._rng = random.Random()
+        self._stop = threading.Event()
+
+        import asyncio
+
+        from aiohttp import web
+
+        self._host = host
+        self._ready = threading.Event()
+        self.bound_port: int = 0
+
+        async def handler(request: "web.Request"):
+            path = request.path.strip("/")
+            route = path.split("/", 1)[0]
+            info = self._routes.get(route)
+            if info is None:
+                # Route-miss: refresh synchronously once before 404 —
+                # a freshly registered route must not bounce requests
+                # for a poll interval.
+                try:
+                    self._routes = read_routes(self._control)
+                except Exception:  # noqa: BLE001
+                    pass
+                info = self._routes.get(route)
+            if info is None:
+                return web.json_response(
+                    {"error": f"no route {route!r}"}, status=404)
+            replicas = info.get("replicas") or []
+            if not replicas:
+                return web.json_response(
+                    {"error": "no replicas"}, status=503)
+            entry = self._pick(replicas)
+            if request.can_read_body:
+                try:
+                    body = await request.json()
+                except Exception:  # noqa: BLE001
+                    body = (await request.read()).decode(
+                        errors="replace")
+            else:
+                body = dict(request.query)
+            aid = entry[0]
+            with self._olock:
+                self._ongoing[aid] = self._ongoing.get(aid, 0) + 1
+            try:
+                result = await asyncio.get_event_loop().run_in_executor(
+                    None, self._call.call, entry, "handle_request",
+                    ("__call__", (body,), {}), {})
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)}, status=500)
+            finally:
+                with self._olock:
+                    self._ongoing[aid] = max(
+                        0, self._ongoing.get(aid, 1) - 1)
+            if isinstance(result, (dict, list, int, float, str,
+                                   type(None))):
+                return web.json_response({"result": result})
+            return web.Response(body=repr(result).encode())
+
+        async def health(_request):
+            return web.Response(text="ok")
+
+        def serve_thread():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            app = web.Application()
+            app.router.add_get("/-/healthz", health)
+            app.router.add_route("*", "/{tail:.*}", handler)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, host, port)
+            loop.run_until_complete(site.start())
+            self.bound_port = site._server.sockets[0].getsockname()[1]
+            self._ready.set()
+            try:
+                loop.run_forever()
+            finally:
+                loop.run_until_complete(runner.cleanup())
+
+        self._thread = threading.Thread(target=serve_thread, daemon=True,
+                                        name="node-proxy-http")
+        self._thread.start()
+        self._ready.wait(timeout=15)
+        self._control.kv_put(PROXY_PREFIX + self.node_id,
+                             f"{host}:{self.bound_port}".encode(),
+                             overwrite=True)
+        self._poller = threading.Thread(target=self._poll_routes,
+                                        daemon=True,
+                                        name="node-proxy-routes")
+        self._poller.start()
+
+    # -- routing ---------------------------------------------------------
+    def _pick(self, replicas: List[tuple]) -> tuple:
+        """Locality-preferring power-of-two: same-node replicas first
+        (ICI/host-local latency), fall back to the whole set."""
+        local = [r for r in replicas if r[1] == self.node_id]
+        pool = local or list(replicas)
+        if len(pool) == 1:
+            return pool[0]
+        a, b = self._rng.sample(pool, 2)
+        with self._olock:
+            return (a if self._ongoing.get(a[0], 0)
+                    <= self._ongoing.get(b[0], 0) else b)
+
+    def _poll_routes(self) -> None:
+        while not self._stop.wait(0.5):
+            try:
+                self._routes = read_routes(self._control)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- actor surface ---------------------------------------------------
+    def address(self) -> str:
+        return f"{self._host}:{self.bound_port}"
+
+    def ping(self) -> bool:
+        return True
+
+    def stop(self) -> bool:
+        self._stop.set()
+        with contextlib.suppress(Exception):
+            self._control.kv_del(PROXY_PREFIX + self.node_id)
+        return True

@@ -173,3 +173,53 @@ def test_detached_actor_restarted_by_control_plane(cluster2):
     assert ray.get(h.marker.remote(), timeout=10) == "fresh"
     ray.kill(h)
     ray.shutdown()
+
+
+def test_detached_actor_worker_crash_restarts_on_same_node(cluster2):
+    """Worker crash with the NODE alive: the daemon self-restarts the
+    detached actor from the persisted spec (no node-death event fires,
+    so the adoption path alone would never run)."""
+    ray.shutdown()
+    cluster2.connect()
+
+    @ray.remote(lifetime="detached", name="crashy", max_restarts=2)
+    class Crashy:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def boom(self):
+            import os
+
+            os._exit(1)
+
+    a = Crashy.remote()
+    pid0 = ray.get(a.pid.remote())
+    ray.shutdown()  # no driver attached
+
+    # Crash the worker from outside (driver B's first call may observe
+    # the crash; the daemon then reconstructs locally).
+    import os as _os
+    import signal as _signal
+
+    _os.kill(pid0, _signal.SIGKILL)
+
+    cluster2.connect()
+    deadline = time.monotonic() + 60
+    new_pid = None
+    while time.monotonic() < deadline:
+        try:
+            h = ray.get_actor("crashy")
+            new_pid = ray.get(h.pid.remote(), timeout=10)
+            if new_pid and new_pid != pid0:
+                break
+        except Exception:
+            pass
+        ray.shutdown()
+        time.sleep(1.0)
+        cluster2.connect()
+    assert new_pid is not None and new_pid != pid0
+    h = ray.get_actor("crashy")
+    ray.kill(h)
+    ray.shutdown()
