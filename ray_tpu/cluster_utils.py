@@ -156,6 +156,7 @@ class RealCluster:
                 "control_plane binary not built (make -C src)")
         self._cp_proc, self.port = cc.launch_control_plane(
             health_timeout_ms=health_timeout_ms)
+        self.health_timeout_ms = health_timeout_ms
         self.address = f"127.0.0.1:{self.port}"
         self._daemons: Dict[str, object] = {}
         self._count = 0
@@ -181,6 +182,10 @@ class RealCluster:
 
         penv = dict(os.environ)
         penv.setdefault("JAX_PLATFORMS", "cpu")
+        # Daemons scale their self-fencing to the cluster's health
+        # expiry (see NodeDaemon._fence_after_s).
+        penv.setdefault("RAY_TPU_CP_HEALTH_TIMEOUT_MS",
+                        str(self.health_timeout_ms))
         penv.update(env or {})
         # RAY_TPU_DAEMON_STDERR=<dir>: keep daemon stderr for debugging
         # (default: discarded).

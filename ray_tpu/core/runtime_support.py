@@ -1,0 +1,219 @@
+"""Runtime support: execution context, task events, function
+distribution, streaming generators.
+
+Split out of core/runtime.py (VERDICT r3 #9 — the fused
+CoreWorker+raylet file was growing without bound); every name is
+re-exported from runtime for compatibility. Reference capabilities:
+runtime_context.py, task_event_buffer.h, _private/function_manager.py,
+ObjectRefGenerator (_raylet.pyx:272).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .._private.config import config
+from .exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+)
+from .ids import ActorID, JobID, ObjectID, TaskID
+from .object_ref import ObjectRef
+
+logger = logging.getLogger("ray_tpu")
+
+# ---------------------------------------------------------------------------
+# Runtime context (per-thread execution info)
+# ---------------------------------------------------------------------------
+
+class _ExecCtx(threading.local):
+    def __init__(self):
+        self.task_id: Optional[TaskID] = None
+        self.actor_id: Optional[ActorID] = None
+        self.node_id: Optional[str] = None
+        self.put_index: int = 0
+
+
+_ctx = _ExecCtx()
+
+
+class RuntimeContext:
+    """Public runtime-context view (reference: python/ray/runtime_context.py)."""
+
+    @property
+    def job_id(self) -> JobID:
+        return global_runtime().job_id
+
+    def get_task_id(self) -> Optional[str]:
+        return _ctx.task_id.hex() if _ctx.task_id else None
+
+    def get_actor_id(self) -> Optional[str]:
+        return _ctx.actor_id.hex() if _ctx.actor_id else None
+
+    def get_node_id(self) -> Optional[str]:
+        # Daemon workers learn their host daemon's id from the spawn
+        # env (reference: runtime_context reporting the raylet's node).
+        return (_ctx.node_id or os.environ.get("RAY_TPU_NODE_ID")
+                or global_runtime().head_node_id)
+
+
+# ---------------------------------------------------------------------------
+# Task events / timeline
+# ---------------------------------------------------------------------------
+
+class TaskEventBuffer:
+    """Chrome-trace-compatible task event ring
+    (reference: src/ray/core_worker/task_event_buffer.h → `ray timeline`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+
+    def record(self, name: str, phase_start: float, phase_end: float,
+               node_id: str, task_id: str, category: str = "task"):
+        self.record_raw({
+            "name": name, "cat": category, "ph": "X",
+            "ts": phase_start * 1e6, "dur": (phase_end - phase_start) * 1e6,
+            "pid": node_id, "tid": task_id,
+        })
+
+    def record_raw(self, ev: dict) -> None:
+        """Append a pre-built chrome-trace event (tasks + tracing spans).
+        Honors the enable_timeline gate."""
+        if not config.enable_timeline:
+            return
+        with self._lock:
+            if len(self._events) >= config.task_event_buffer_max:
+                self._events.pop(0)
+            self._events.append(ev)
+
+    def dump(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+
+# ---------------------------------------------------------------------------
+# Function manager
+# ---------------------------------------------------------------------------
+
+class FunctionManager:
+    """Function registry (reference: python/ray/_private/function_manager.py
+    — exports pickled functions to GCS KV; workers import lazily). Local
+    mode keeps the callables; the multiprocess runtime ships pickles."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fns: Dict[bytes, Callable] = {}
+
+    def register(self, func: Callable) -> FunctionDescriptor:
+        fid = uuid.uuid4().bytes
+        with self._lock:
+            self._fns[fid] = func
+        return FunctionDescriptor(
+            module=getattr(func, "__module__", "<unknown>") or "<unknown>",
+            qualname=getattr(func, "__qualname__", repr(func)),
+            function_id=fid,
+        )
+
+    def get(self, fid: bytes) -> Callable:
+        with self._lock:
+            return self._fns[fid]
+
+
+# ---------------------------------------------------------------------------
+# Streaming generators
+# ---------------------------------------------------------------------------
+
+class _GeneratorState:
+    def __init__(self):
+        self.cv = threading.Condition()
+        self.refs: List[ObjectRef] = []
+        self.done = False
+        # Backpressure (reference: GeneratorWaiter, core_worker.h):
+        # `consumed` advances as the iterator hands out refs; producers
+        # pause while produced − consumed exceeds the watermark.
+        # `ack_cb` (set while an out-of-process producer is streaming)
+        # forwards consumption credits to the producing worker; call it
+        # under `cv` — the producer side clears it under the same lock.
+        self.consumed = 0
+        self.ack_cb = None
+        self.abandoned = False
+
+
+class ObjectRefGenerator:
+    """Streaming-returns iterator
+    (reference: python/ray/_raylet.pyx:272 ObjectRefGenerator): yields
+    ObjectRefs as the remote generator produces them; consumption feeds
+    producer backpressure (generator_backpressure_max_items); also
+    usable as an async iterator."""
+
+    def __init__(self, task_id: TaskID, state: _GeneratorState):
+        self._task_id = task_id
+        self._state = state
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        st = self._state
+        with st.cv:
+            while len(st.refs) <= self._i and not st.done:
+                st.cv.wait()
+            if len(st.refs) > self._i:
+                ref = st.refs[self._i]
+                self._i += 1
+                if self._i > st.consumed:
+                    st.consumed = self._i
+                    if st.ack_cb is not None:
+                        st.ack_cb(1)
+                    st.cv.notify_all()
+                return ref
+            raise StopIteration
+
+    def __del__(self):
+        # Consumer gone: release any paused producer for good.
+        st = getattr(self, "_state", None)
+        if st is None:
+            return
+        try:
+            with st.cv:
+                st.abandoned = True
+                if st.ack_cb is not None:
+                    st.ack_cb(1 << 20)
+                st.cv.notify_all()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        # StopIteration can't cross a Future boundary (asyncio converts it
+        # to RuntimeError) — use a sentinel instead.
+        import asyncio
+        loop = asyncio.get_running_loop()
+        sentinel = object()
+
+        def step():
+            try:
+                return self.__next__()
+            except StopIteration:
+                return sentinel
+
+        item = await loop.run_in_executor(None, step)
+        if item is sentinel:
+            raise StopAsyncIteration
+        return item
+
+    def completed(self) -> List[ObjectRef]:
+        with self._state.cv:
+            return list(self._state.refs)
+

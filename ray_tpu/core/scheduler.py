@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import random
 import threading
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
@@ -117,7 +118,18 @@ class Scheduler:
     def __init__(self, dispatch: Callable[[TaskSpec, NodeState], None]):
         self._lock = threading.Lock()
         self._nodes: Dict[str, NodeState] = {}
-        self._queue: List[TaskSpec] = []
+        self._queue: "deque[TaskSpec]" = deque()
+        self._pump_state_lock = threading.Lock()
+        self._pumping = False
+        self._pump_again = False
+        # Resource shapes proven unplaceable since the last capacity
+        # change: a submit of a known-barren shape onto a saturated
+        # cluster skips the pump entirely (amortized O(1) submission at
+        # the 1M-queued-tasks scale point). Cleared whenever capacity
+        # can have changed.
+        self._barren_shapes: set = set()
+        # shape key -> deque of parked specs (see _pump_once).
+        self._parked: Dict[tuple, "deque[TaskSpec]"] = {}
         self._infeasible: List[TaskSpec] = []
         self._dispatch = dispatch
         self._rng = random.Random(0)
@@ -126,6 +138,7 @@ class Scheduler:
     def add_node(self, node: NodeState) -> None:
         with self._lock:
             self._nodes[node.node_id] = node
+            self._barren_shapes.clear()
         self._pump()
 
     def remove_node(self, node_id: str) -> Optional[NodeState]:
@@ -146,7 +159,10 @@ class Scheduler:
     # -- demand (autoscaler signal) --------------------------------------
     def pending_demand(self) -> List[ResourceSet]:
         with self._lock:
-            return [t.resources for t in self._queue + self._infeasible]
+            pending = list(self._queue) + self._infeasible
+            for q in self._parked.values():
+                pending.extend(q)
+            return [t.resources for t in pending]
 
     def pending_demand_detailed(self) -> List[tuple]:
         """[(ResourceSet, hard_constrained, label_selector)] —
@@ -157,21 +173,35 @@ class Scheduler:
         selector."""
         with self._lock:
             out = []
-            for t in self._queue + self._infeasible:
+            pending = list(self._queue) + self._infeasible
+            for q in self._parked.values():
+                pending.extend(q)
+            for t in pending:
                 hard = _is_constrained(t.scheduling_strategy)
                 out.append((t.resources, hard,
                             dict(t.label_selector or {})))
             return out
 
     # -- scheduling -------------------------------------------------------
+    @staticmethod
+    def _shape_key(spec: TaskSpec):
+        """Cache key for unconstrained specs only — strategies and
+        label selectors change placement beyond raw capacity."""
+        if spec.scheduling_strategy is not None or spec.label_selector:
+            return None
+        return tuple(sorted(spec.resources.to_dict().items()))
+
     def submit(self, spec: TaskSpec) -> None:
         with self._lock:
             self._queue.append(spec)
+            if self._shape_key(spec) in self._barren_shapes:
+                return  # saturated for this shape; next release pumps
         self._pump()
 
     def cancel(self, task_id) -> bool:
         with self._lock:
-            for q in (self._queue, self._infeasible):
+            for q in (self._queue, self._infeasible,
+                      *self._parked.values()):
                 for i, t in enumerate(q):
                     if t.task_id == task_id:
                         del q[i]
@@ -183,6 +213,7 @@ class Scheduler:
             node = self._nodes.get(node_id)
             if node is not None:
                 node.uncharge(resources)
+            self._barren_shapes.clear()
         self._pump()
 
     def update_node_report(self, node_id: str,
@@ -202,6 +233,7 @@ class Scheduler:
             reported_used = node.total.sub_clamp0(reported_available)
             node.set_foreign(reported_used.sub_clamp0(node.charged))
             node.reported_queued = queued
+            self._barren_shapes.clear()
         self._pump()
 
     def apply_spill_refusal(self, spec: TaskSpec, node_id: str,
@@ -221,6 +253,7 @@ class Scheduler:
                 reported_used = node.total.sub_clamp0(reported_available)
                 node.set_foreign(reported_used.sub_clamp0(node.charged))
                 node.reported_queued = queued
+            self._barren_shapes.clear()
         self._pump()
 
     def release_task(self, spec: TaskSpec, node_id: str) -> None:
@@ -236,32 +269,114 @@ class Scheduler:
         else:
             self.release(node_id, spec.resources)
 
+    # After this many consecutive placement failures a pump pass stops
+    # scanning: with a saturated cluster and a DEEP queue (the 1M
+    # queued-tasks scale point), an uncapped scan makes every
+    # submit/release O(queue) — O(n²) end to end. Tail tasks wait for
+    # the next pump (every completion pumps, so nothing starves
+    # indefinitely; bounded head-of-line unfairness is the same
+    # trade the reference's per-tick dispatch caps make).
+    _PUMP_FAIL_CAP = 64
+
     def _pump(self) -> None:
+        # Coalesce concurrent pumps: hundreds of task completions per
+        # second would otherwise convoy on the scheduler lock scanning
+        # the same queue. _pumping is cleared under the SAME lock hold
+        # that checks _pump_again — a separate finally would drop a
+        # request arriving between the check and the clear (lost
+        # wakeup: the last release's pump never runs → hang).
+        with self._pump_state_lock:
+            if self._pumping:
+                self._pump_again = True
+                return
+            self._pumping = True
+        while True:
+            try:
+                self._pump_once()
+            except BaseException:
+                with self._pump_state_lock:
+                    self._pumping = False
+                raise
+            with self._pump_state_lock:
+                if not self._pump_again:
+                    self._pumping = False
+                    return
+                self._pump_again = False
+
+    def _grant_locked(self, spec: TaskSpec, node) -> None:
+        charge = getattr(spec, "_pg_charge", None)
+        if charge is not None:
+            # Bundle resources were already reserved on the node at
+            # PG creation; charge the bundle, not the node.
+            pg, idx = charge
+            pg._bundle_available[idx] = \
+                pg._bundle_available[idx].subtract(spec.resources)
+        else:
+            node.charge(spec.resources)
+
+    def _pump_once(self) -> None:
         granted = []
         with self._lock:
             # Re-examine infeasible tasks when topology changed.
-            self._queue.extend(self._infeasible)
-            self._infeasible = []
-            still = []
-            for spec in self._queue:
+            if self._infeasible:
+                self._queue.extend(self._infeasible)
+                self._infeasible = []
+            # Head-window scan on a deque: unplaced items go back to
+            # the FRONT in order and the unscanned tail is never
+            # touched — a list rebuild here copies the whole queue
+            # every pump, which is O(n²) end-to-end at the
+            # 1M-queued-tasks scale point. Tasks of a shape that
+            # already failed this capacity epoch are PARKED per shape
+            # (not left in the queue): a placeable task is never
+            # hidden behind an arbitrarily long run of unplaceable
+            # ones, and the scan never re-reads them.
+            still: List[TaskSpec] = []
+            fails = 0
+            scanned = 0
+            limit = len(self._queue)
+            while (self._queue and scanned < limit
+                   and fails < self._PUMP_FAIL_CAP):
+                spec = self._queue.popleft()
+                scanned += 1
+                key = self._shape_key(spec)
+                if key is not None and key in self._barren_shapes:
+                    self._parked.setdefault(key, deque()).append(spec)
+                    continue  # cheap skip — NOT a scan failure
                 node = self._pick_node(spec)
                 if node is None:
-                    if self._feasible_anywhere(spec):
+                    fails += 1
+                    if key is not None:
+                        self._barren_shapes.add(key)
+                        self._parked.setdefault(key,
+                                                deque()).append(spec)
+                    elif self._feasible_anywhere(spec):
                         still.append(spec)
                     else:
                         self._infeasible.append(spec)
                     continue
-                charge = getattr(spec, "_pg_charge", None)
-                if charge is not None:
-                    # Bundle resources were already reserved on the node at
-                    # PG creation; charge the bundle, not the node.
-                    pg, idx = charge
-                    pg._bundle_available[idx] = \
-                        pg._bundle_available[idx].subtract(spec.resources)
-                else:
-                    node.charge(spec.resources)
+                self._grant_locked(spec, node)
                 granted.append((spec, node))
-            self._queue = still
+            self._queue.extendleft(reversed(still))
+            # Parked shapes: one placement probe per shape per pump —
+            # O(#distinct shapes + grants), independent of how many
+            # tasks are parked.
+            for key in list(self._parked):
+                q = self._parked[key]
+                while q:
+                    spec = q[0]
+                    node = self._pick_node(spec)
+                    if node is None:
+                        self._barren_shapes.add(key)
+                        if not self._feasible_anywhere(spec):
+                            self._infeasible.extend(q)
+                            q.clear()
+                        break
+                    self._barren_shapes.discard(key)
+                    q.popleft()
+                    self._grant_locked(spec, node)
+                    granted.append((spec, node))
+                if not q:
+                    del self._parked[key]
         for spec, node in granted:
             self._dispatch(spec, node)
 

@@ -118,9 +118,18 @@ class NodeDaemon:
 
         # Execution plane: real OS worker processes.
         n_workers = max(1, int(num_cpus))
+        worker_env = {"RAY_TPU_NODE_ID": self.node_id}
+        if not num_tpus:
+            # CPU-only node: workers must not load the TPU plugin at
+            # interpreter startup (the sitecustomize registers it in
+            # every process when this env var is set; concurrent
+            # registrations from a worker-spawn burst can segfault in
+            # the PJRT client — observed as sporadic
+            # 'worker died: connection reset' actor-create failures).
+            worker_env["PALLAS_AXON_POOL_IPS"] = ""
         self.pool = WorkerPool(n_workers, shm_name=self.shm_name,
                                logs_dir=self.logs_dir,
-                               env={"RAY_TPU_NODE_ID": self.node_id})
+                               env=worker_env)
 
         # Resource view (advisory: the driver's scheduler owns placement;
         # this feeds the heartbeat load report for resource-view sync).
@@ -212,6 +221,16 @@ class NodeDaemon:
         with contextlib.suppress(Exception):
             self.control.subscribe("node_events", self._on_node_event)
         self._hb_interval = heartbeat_interval_s
+        # Self-fence only AFTER the control plane has certainly
+        # expired us: a fence before that kills healthy actors no
+        # survivor will adopt. The timeout is the cluster operator's
+        # (env, set by the launcher); default is conservative.
+        try:
+            cp_timeout_s = float(os.environ.get(
+                "RAY_TPU_CP_HEALTH_TIMEOUT_MS", "0")) / 1000.0
+        except ValueError:
+            cp_timeout_s = 0.0
+        self._fence_after_s = max(30.0, 3.0 * cp_timeout_s)
         self._hb_thread = threading.Thread(
             target=self._hb_loop, daemon=True, name="node-heartbeat")
         self._hb_thread.start()
@@ -318,7 +337,7 @@ class NodeDaemon:
                 # heartbeat failure streak (reference: a raylet the
                 # GCS declared dead stops serving).
                 if (not fenced and self._hb_failures
-                        * self._hb_interval > 30.0):
+                        * self._hb_interval > self._fence_after_s):
                     fenced = True
                     threading.Thread(target=self._fence_detached,
                                      daemon=True,
@@ -553,7 +572,8 @@ class NodeDaemon:
                 "detached actor copies", len(aids))
 
     def _adopt_detached_from(self, dead_node_id: str,
-                             attempt: int = 0) -> None:
+                             attempt: int = 0,
+                             only_aid: Optional[str] = None) -> None:
         """Recreate the dead node's detached actors here (winner of the
         per-actor KV claim). Reference: GcsActorManager::ReconstructActor
         — restart is owned by the cluster, not by any driver."""
@@ -570,6 +590,11 @@ class NodeDaemon:
             if a.get("state") == "DEAD":
                 continue
             aid_hex = a["actor_id"]
+            if only_aid is not None and aid_hex != only_aid:
+                continue
+            with self._actors_lock:
+                if bytes.fromhex(aid_hex) in self._actors:
+                    continue  # alive HERE — never restart a healthy copy
             try:
                 info = self.control.get_actor(aid_hex)
                 actor_meta = json.loads(info.get("meta") or "{}")
@@ -1220,9 +1245,12 @@ class NodeDaemon:
                 # death event, so the cluster reconstruction path never
                 # fires — this daemon restarts its own detached actor
                 # from the spec (budget still enforced via the claim).
+                crashed_hex = aid.hex()
+
                 def _local_adopt():
                     time.sleep(1.0)  # let an explicit kill's DEAD land
-                    self._adopt_detached_from(self.node_id)
+                    self._adopt_detached_from(self.node_id,
+                                              only_aid=crashed_hex)
 
                 threading.Thread(target=_local_adopt, daemon=True,
                                  name="adopt-local-crash").start()

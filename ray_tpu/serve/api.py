@@ -108,20 +108,21 @@ def run(app: Application, *, name: str = "default",
         if _grpc_proxy is not None:
             _grpc_proxy.remove_route(old_route)
     _route_of_app[name] = new_route
+    if _cluster_plane() is not None:
+        # Multi-node data plane: per-daemon proxies + the shared route
+        # table through the control plane. NOT gated on `http` — that
+        # flag only controls the DRIVER-LOCAL proxy; on a cluster the
+        # per-node ingress is the data plane.
+        from .. import get as ray_get
+
+        ray_get(controller.set_route.remote(new_route, ingress._name))
+        _start_node_proxies()
     if http:
         with _lock:
             if _proxy is None:
                 _proxy = HttpProxy(port=http_port)
                 _proxy.start()
             _proxy.add_route(route_prefix or name, ingress)
-        if _cluster_plane() is not None:
-            # Multi-node data plane: per-daemon proxies + the shared
-            # route table through the control plane.
-            from .. import get as ray_get
-
-            ray_get(controller.set_route.remote(
-                new_route, ingress._name))
-            _start_node_proxies()
     if grpc:
         with _lock:
             if _grpc_proxy is None:

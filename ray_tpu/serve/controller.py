@@ -86,6 +86,9 @@ class ServeController:
         self._sets: Dict[str, _ReplicaSet] = {}
         self._routes: Dict[str, str] = {}  # http route -> deployment
         self._proxies: Dict[str, Any] = {}  # node_id -> NodeProxy
+        # ensure_proxies is called from the control loop AND the RPC
+        # path; concurrent runs double-create proxies for a node.
+        self._proxy_ensure_lock = threading.Lock()
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._loop = threading.Thread(
@@ -220,6 +223,17 @@ class ServeController:
         rt = global_runtime_or_none()
         if rt is None or rt.remote_plane is None:
             return 0
+        if not self._proxy_ensure_lock.acquire(blocking=False):
+            return len(self._proxies)  # another reconcile is running
+        try:
+            return self._ensure_proxies_locked(rt)
+        finally:
+            self._proxy_ensure_lock.release()
+
+    def _ensure_proxies_locked(self, rt) -> int:
+        from ..core.task import NodeAffinitySchedulingStrategy
+        from .node_proxy import PROXY_PREFIX, NodeProxy
+
         with self._lock:
             if not self._routes:
                 return len(self._proxies)
@@ -249,7 +263,10 @@ class ServeController:
                 with self._lock:
                     self._proxies[nid] = actor
             except Exception:  # noqa: BLE001 — next tick retries
-                pass
+                import logging as _lg
+
+                _lg.getLogger("ray_tpu.serve").warning(
+                    "proxy create for %s failed", nid, exc_info=True)
         with self._lock:
             return len(self._proxies)
 
@@ -355,10 +372,12 @@ class ServeController:
                 except Exception:  # noqa: BLE001
                     pass
             if dead:
-                with self._lock:
-                    target = len(rs.replicas) + dead
-                    rs.scale_to(target, getattr(rs, "init_args", ()),
-                                getattr(rs, "init_kwargs", {}))
+                # scale_to builds replica actors — network-visible
+                # work that must not hold the controller lock (every
+                # RPC queues behind it).
+                rs.scale_to(len(rs.replicas) + dead,
+                            getattr(rs, "init_args", ()),
+                            getattr(rs, "init_kwargs", {}))
 
     def _autoscale(self, rs: _ReplicaSet, asc: AutoscalingConfig):
         ongoing = rs.ongoing()

@@ -237,7 +237,10 @@ class NodeProxy:
         self._thread = threading.Thread(target=serve_thread, daemon=True,
                                         name="node-proxy-http")
         self._thread.start()
-        self._ready.wait(timeout=15)
+        if not self._ready.wait(timeout=15) or not self.bound_port:
+            raise RuntimeError(
+                f"node proxy HTTP server failed to start on "
+                f"{host} (node {self.node_id})")
         self._control.kv_put(PROXY_PREFIX + self.node_id,
                              f"{host}:{self.bound_port}".encode(),
                              overwrite=True)

@@ -131,16 +131,49 @@ def init_pp_state(cfg: TransformerConfig, mesh, optimizer, *, pp: int,
 
 
 def make_pp_train_step(cfg: TransformerConfig, optimizer, *, pp: int,
-                       num_microbatches: Optional[int] = None):
-    """Pipelined train step (GPipe schedule compiled into the jit; see
-    parallel/pipeline.py). Same signature as make_train_step."""
-    from ..parallel.pipeline import pipeline_loss_fn
+                       num_microbatches: Optional[int] = None,
+                       schedule: str = "gpipe"):
+    """Pipelined train step, compiled into one jit (parallel/pipeline.py).
+    Same signature as make_train_step.
 
-    def _loss(params, tokens, targets, mask):
-        return pipeline_loss_fn(cfg, params, tokens, targets, mask,
-                                pp=pp, num_microbatches=num_microbatches)
+    schedule:
+      "gpipe" — forward scan + autodiff backward; residuals for all M
+                microbatches live at once (fine for modest M).
+      "1f1b"  — interleaved forward/backward with O(pp) in-flight
+                microbatches per stage (the schedule that matters at
+                real pp depths / large M).
+    """
+    if schedule == "gpipe":
+        from ..parallel.pipeline import pipeline_loss_fn
 
-    return make_train_step(cfg, optimizer, loss=_loss)
+        def _loss(params, tokens, targets, mask):
+            return pipeline_loss_fn(
+                cfg, params, tokens, targets, mask,
+                pp=pp, num_microbatches=num_microbatches)
+
+        return make_train_step(cfg, optimizer, loss=_loss)
+    if schedule != "1f1b":
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
+    from ..parallel.pipeline import pipeline_1f1b_grads
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, tokens, targets, mask
+                   ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        grads, metrics = pipeline_1f1b_grads(
+            cfg, state.params, tokens, targets, mask,
+            pp=pp, num_microbatches=num_microbatches)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(
+            step=state.step + 1, params=params, opt_state=opt_state)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = gnorm
+        return new_state, metrics
+
+    return train_step
 
 
 def make_eval_step(cfg: TransformerConfig):

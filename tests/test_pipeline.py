@@ -144,3 +144,85 @@ def test_pp_batch_not_divisible():
     with pytest.raises(ValueError):
         pipeline_forward(cfg, pparams, _tokens(cfg, batch=7), pp=2,
                          num_microbatches=4)
+
+
+class Test1F1B:
+    """1F1B-interleaved schedule (VERDICT r3 #10): numerically identical
+    to plain autodiff, composes with dp sharding, and its in-flight
+    buffer is O(pp) — not O(M) like GPipe-under-autodiff."""
+
+    def test_1f1b_grads_match_autodiff(self):
+        from ray_tpu.models.transformer import loss_fn
+        from ray_tpu.parallel.pipeline import pipeline_1f1b_grads
+
+        cfg = configs.tiny_test()
+        pp, M = 2, 4
+        params = init_params(cfg, jax.random.key(0))
+        tokens = _tokens(cfg)
+        targets = jnp.roll(tokens, -1, 1)
+        mask = jnp.ones_like(tokens, jnp.float32)
+
+        (ref_loss, _), ref_g = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, targets, mask),
+            has_aux=True)(params)
+
+        pparams = dict(params)
+        pparams["layers"] = partition_layer_params(params["layers"], pp)
+        grads, metrics = jax.jit(
+            lambda p: pipeline_1f1b_grads(
+                cfg, p, tokens, targets, mask, pp=pp,
+                num_microbatches=M))(pparams)
+        np.testing.assert_allclose(float(metrics["loss"]),
+                                   float(ref_loss), rtol=1e-4)
+        merged = dict(grads)
+        merged["layers"] = merge_layer_params(grads["layers"])
+        ref_leaves = jax.tree_util.tree_flatten_with_path(ref_g)[0]
+        got = {jax.tree_util.keystr(k): v for k, v in
+               jax.tree_util.tree_flatten_with_path(merged)[0]}
+        for k, v in ref_leaves:
+            ks = jax.tree_util.keystr(k)
+            denom = float(jnp.max(jnp.abs(v))) + 1e-8
+            err = float(jnp.max(jnp.abs(v - got[ks]))) / denom
+            assert err < 2e-3, (ks, err)
+
+    def test_1f1b_train_step_matches_dense(self, cpu_mesh8):
+        """Sharded pp=2/dp=2 1F1B step == non-pipelined step: same loss,
+        same updated weights."""
+        cfg = configs.tiny_test()
+        opt = make_optimizer(lr=1e-3, warmup_steps=1, total_steps=100)
+        tokens = _tokens(cfg)
+        targets = jnp.roll(tokens, -1, axis=1)
+        mask = jnp.ones_like(tokens, jnp.float32)
+
+        mesh_d = make_mesh(ParallelPlan(), devices=cpu_mesh8[:1])
+        with jax.sharding.set_mesh(mesh_d):
+            st = init_state(cfg, mesh_d, opt, seed=0)
+            st, m1 = make_train_step(cfg, opt)(st, tokens, targets,
+                                               mask)
+        dense_layers = jax.device_get(st.params)["layers"]
+
+        plan = ParallelPlan(pp=2, dp=2)
+        mesh = make_mesh(plan, devices=cpu_mesh8[:plan.num_devices])
+        with jax.sharding.set_mesh(mesh):
+            pst = init_pp_state(cfg, mesh, opt, pp=2, seed=0)
+            b = shard_batch({"t": tokens, "y": targets, "m": mask},
+                            mesh)
+            step = make_pp_train_step(cfg, opt, pp=2,
+                                      num_microbatches=4,
+                                      schedule="1f1b")
+            pst, m2 = step(pst, b["t"], b["y"], b["m"])
+
+        np.testing.assert_allclose(float(m1["loss"]),
+                                   float(m2["loss"]), rtol=1e-4)
+        pp_layers = merge_layer_params(
+            jax.device_get(pst.params)["layers"])
+        for k in pp_layers:
+            np.testing.assert_allclose(
+                np.asarray(pp_layers[k]), np.asarray(dense_layers[k]),
+                atol=3e-5, rtol=3e-3, err_msg=k)
+
+    def test_unknown_schedule_rejected(self):
+        cfg = configs.tiny_test()
+        with pytest.raises(ValueError, match="schedule"):
+            make_pp_train_step(cfg, make_optimizer(), pp=2,
+                               schedule="zigzag")

@@ -53,18 +53,13 @@ def test_serve_across_daemons_with_kill(serve_cluster):
         return {"node": os.environ.get("RAY_TPU_NODE_ID"),
                 "pid": os.getpid()}
 
-    serve.run(who.bind(), name="who", route_prefix="who", http=False,
-              http_port=0)
-    # http=False skips the driver-local proxy; route + node proxies
-    # still need registering for the data plane:
-    from ray_tpu.serve.api import (
-        _get_or_create_controller,
-        _start_node_proxies,
-    )
+    # In cluster mode serve.run alone wires the multi-node data plane
+    # (route table + per-daemon proxies); http=False only skips the
+    # driver-local proxy.
+    serve.run(who.bind(), name="who", route_prefix="who", http=False)
+    from ray_tpu.serve.api import _get_or_create_controller
 
     controller = _get_or_create_controller()
-    ray.get(controller.set_route.remote("who", "who"))
-    _start_node_proxies()
 
     # Replicas spread across BOTH daemons.
     locs = ray.get(controller.replica_locations.remote("who"))
