@@ -48,6 +48,74 @@ def time_best_of(step_once, sync, *, steps: int, n_seg: int = 3,
     return min(times)
 
 
+def core_api_smoke() -> None:
+    """Gate: exercise the task/actor API itself before any model bench.
+
+    VERDICT r4 weak #1: the round-4 snapshot shipped with a broken
+    FunctionManager because bench + dryrun only touched the model/
+    parallel path — a snapshot where `ray.get(f.remote())` raises could
+    still pass every gate. This runs submit/get, error propagation,
+    retries, streaming generators, actor calls and the runtime context
+    in ~2s and aborts the bench (non-zero exit) on any failure.
+    """
+    import ray_tpu as ray
+
+    ray.shutdown()
+    ray.init(num_cpus=2, num_tpus=0)
+    try:
+        @ray.remote
+        def add(a, b):
+            return a + b
+
+        assert ray.get(add.remote(40, 2)) == 42
+
+        @ray.remote
+        def boom():
+            raise RuntimeError("expected")
+
+        try:
+            ray.get(boom.remote())
+            raise AssertionError("task error did not propagate")
+        except ray.TaskError:
+            pass
+
+        attempts = []
+
+        @ray.remote(max_retries=3, retry_exceptions=True)
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 2:
+                raise RuntimeError("transient")
+            return "recovered"
+
+        assert ray.get(flaky.remote()) == "recovered"
+
+        @ray.remote(num_returns="streaming")
+        def gen(n):
+            for i in range(n):
+                yield i * i
+
+        assert [ray.get(r) for r in gen.remote(4)] == [0, 1, 4, 9]
+
+        @ray.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray.get([c.inc.remote() for _ in range(3)]) == [1, 2, 3]
+
+        ctx = ray.get_runtime_context()
+        assert ctx.job_id is not None
+        assert ctx.get_node_id() is not None
+    finally:
+        ray.shutdown()
+
+
 def pinned_baseline(metric: str, match: dict | None = None):
     """Fixed scoreboard bar for `metric` from BASELINE.json "published".
 
@@ -123,7 +191,7 @@ def _chip_peak_flops(device) -> float:
 
 
 def bench_serve(quick: bool, model: str = "gpt2-125m",
-                trials: int = 7) -> None:
+                trials: int = 7, emit: bool = True) -> dict:
     """Serving north-star (BASELINE.md): req/s + p50 TTFT from the
     continuous-batching engine. Protocol (VERDICT r2 weak #2): the
     request burst repeats `trials` times and ONE history entry records
@@ -215,13 +283,16 @@ def bench_serve(quick: bool, model: str = "gpt2-125m",
                "top3_range": round(top3_range, 3),
                "trial_rates": [round(x, 2) for x in rates]})
     base = pinned_baseline(metric, run_match) or prev
-    print(json.dumps({
+    out = {
         "metric": metric, "value": round(req_s, 2), "unit": "req/s",
         "vs_baseline": round(req_s / base, 3) if base else 1.0,
         "ttft_p50_ms": round(p50 * 1e3, 1),
         "trials": len(rates), "top3_range": round(top3_range, 3),
         "gen_tokens_per_sec": round(statistics.median(tok_rates), 1),
-    }))
+    }
+    if emit:
+        print(json.dumps(out))
+    return out
 
 
 def _smoke_prefix_equivalence() -> None:
@@ -564,6 +635,11 @@ def main() -> None:
                     help="image-model benchmark (BASELINE config 4)")
     args = ap.parse_args()
 
+    # The gate's first check is the framework's identity, not the model
+    # path (VERDICT r4 #1): a broken task API must fail the bench run.
+    core_api_smoke()
+    print("core API smoke OK", file=sys.stderr)
+
     if args.serve_prefix:
         bench_serve_prefix(args.quick, model=args.model or "llama-654m")
         return
@@ -575,6 +651,39 @@ def main() -> None:
         bench_vit(args.quick)
         return
 
+    out = bench_train(model=args.model, quick=args.quick,
+                      steps=args.steps, batch=args.batch, seq=args.seq)
+
+    # Gate promotion (VERDICT r4 #7): the driver-captured line must
+    # reflect the stack's real MFU (654M is matmul-saturated; the 125M
+    # flagship is d768-bound at ~39% by construction) and the serving
+    # path. One JSON line, three metrics: flagship train + 654M train
+    # MFU + 654M serve burst ride along under "extra_metrics". The
+    # ride-alongs run at their PINNED configs (seq=1024, 7-trial burst
+    # protocol) regardless of --seq, or the bars silently stop applying.
+    on_tpu = out.get("platform") not in ("cpu", None)
+    if on_tpu and not args.quick and args.model == "gpt2-125m":
+        extras = []
+        try:
+            extras.append(bench_train(model="llama-654m", quick=False,
+                                      steps=180, batch=0, seq=1024))
+        except (Exception, SystemExit) as e:  # noqa: BLE001 - incl.
+            # sys.exit; the flagship line must print no matter what the
+            # extra does (Ctrl-C still interrupts)
+            extras.append({"metric": "llama_654m_train", "error": repr(e)})
+        try:
+            extras.append(bench_serve(False, model="llama-654m",
+                                      trials=7, emit=False))
+        except (Exception, SystemExit) as e:  # noqa: BLE001
+            extras.append({"metric": "llama_654m_serve", "error": repr(e)})
+        out["extra_metrics"] = extras
+    print(json.dumps(out))
+
+
+def bench_train(model: str, quick: bool, steps: int, batch: int,
+                seq: int) -> dict:
+    """Train-step throughput for one model config; pushes history and
+    returns the result dict (caller prints)."""
     import jax
     import jax.numpy as jnp
 
@@ -591,25 +700,24 @@ def main() -> None:
     on_tpu = devices[0].platform not in ("cpu",)
     n_dev = len(devices)
 
-    if args.quick or not on_tpu:
-        if args.model != "gpt2-125m":
-            sys.exit(f"--model {args.model} needs the full TPU run "
+    if quick or not on_tpu:
+        if model != "gpt2-125m":
+            sys.exit(f"--model {model} needs the full TPU run "
                      "(it would be silently replaced by the tiny smoke "
                      "config here)")
         cfg = configs.tiny_test()
         batch, seq, steps = 8, 128, 5
         metric = "tiny_train_tokens_per_sec_smoke"
-    elif args.model != "gpt2-125m":
+    elif model != "gpt2-125m":
         # Scale points (VERDICT r2 #1): per-model batch chosen so
         # params + Adam state + full-remat activations fit 16 GiB.
-        cfg = configs.get(args.model)
-        if args.seq > cfg.max_seq_len:
-            sys.exit(f"--seq {args.seq} exceeds {args.model} "
+        cfg = configs.get(model)
+        if seq > cfg.max_seq_len:
+            sys.exit(f"--seq {seq} exceeds {model} "
                      f"max_seq_len {cfg.max_seq_len}")
-        seq = args.seq
-        auto_batch = {"llama-654m": 8, "llama-1b4": 8}.get(args.model, 4)
-        batch, steps = (args.batch or auto_batch), args.steps
-        slug = args.model.replace("-", "_")
+        auto_batch = {"llama-654m": 8, "llama-1b4": 8}.get(model, 4)
+        batch = batch or auto_batch
+        slug = model.replace("-", "_")
         metric = (f"{slug}_train_tokens_per_sec_per_chip" if seq == 1024
                   else f"{slug}_train_tokens_per_sec_per_chip_seq{seq}")
     else:
@@ -618,10 +726,9 @@ def main() -> None:
         # remat_policy="dots" measured best at this scale (the full
         # remat/chunked-CE/batch sweep is recorded in PARITY.md).
         cfg = replace(configs.gpt2_125m(), remat_policy="dots")
-        seq = args.seq
         # Long sequences need smaller batches to fit activations.
         auto_batch = max(1, 16 * 1024 // seq)
-        batch, steps = (args.batch or auto_batch), args.steps
+        batch = batch or auto_batch
         metric = ("gpt2_125m_train_tokens_per_sec_per_chip" if seq == 1024
                   else f"gpt2_125m_train_tokens_per_sec_per_chip_seq{seq}")
 
@@ -682,6 +789,7 @@ def main() -> None:
         "value": round(per_chip, 1),
         "unit": "tokens/s",
         "vs_baseline": round(vs, 3),
+        "platform": devices[0].platform,
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
@@ -699,7 +807,7 @@ def main() -> None:
             mfu_bar = pinned_baseline(mfu_metric, run_match)
             if mfu_bar:
                 out["mfu_vs_bar"] = round(mfu / mfu_bar, 3)
-    print(json.dumps(out))
+    return out
 
 
 if __name__ == "__main__":

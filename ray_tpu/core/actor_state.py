@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from .._private.config import config
 from .exceptions import (
     ActorDiedError,
+    ObjectLostError,
     TaskCancelledError,
     TaskError,
 )

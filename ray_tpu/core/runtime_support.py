@@ -11,9 +11,11 @@ ObjectRefGenerator (_raylet.pyx:272).
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .._private.config import config
@@ -26,8 +28,16 @@ from .exceptions import (
 )
 from .ids import ActorID, JobID, ObjectID, TaskID
 from .object_ref import ObjectRef
+from .task import FunctionDescriptor
 
 logger = logging.getLogger("ray_tpu")
+
+
+def global_runtime():
+    # Lazy: runtime.py imports this module at load time, so the real
+    # global_runtime can only be resolved after both modules exist.
+    from .runtime import global_runtime as _gr
+    return _gr()
 
 # ---------------------------------------------------------------------------
 # Runtime context (per-thread execution info)
