@@ -1,0 +1,92 @@
+"""Multi-chip (TP / TP+FSDP) serving engine tests.
+
+VERDICT r4 #3: the engine must shard weights + KV cache over a device
+mesh so models larger than one chip (the Llama-8B serving north-star)
+can serve. The reference reaches multi-accelerator serving only through
+vLLM tensor parallelism (doc/source/serve/doc_code/vllm_example.py);
+here the same compiled prefill/decode steps run SPMD under an ambient
+mesh with XLA-inserted collectives.
+"""
+
+from dataclasses import replace
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.models import configs
+from ray_tpu.models.transformer import init_params
+from ray_tpu.parallel import ParallelPlan, make_mesh
+from ray_tpu.serve.llm import LLMEngine
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    cfg = replace(configs.tiny_test(), max_seq_len=128)
+    params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, size=int(n)).tolist()
+               for n in rng.integers(5, 40, size=6)]
+    return cfg, params, prompts
+
+
+def _run(cfg, params, prompts, mesh, **kw):
+    eng = LLMEngine(cfg, params, num_slots=4, max_seq_len=128,
+                    mesh=mesh, **kw)
+    reqs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+    while eng.step():
+        pass
+    outs = [r.result(timeout=120) for r in reqs]
+    eng._stop = True
+    return eng, outs
+
+
+def test_tp2_matches_single_chip(tiny_setup):
+    cfg, params, prompts = tiny_setup
+    _, single = _run(cfg, params, prompts, None)
+    mesh = make_mesh(ParallelPlan(tp=2), devices=jax.devices()[:2])
+    eng, tp = _run(cfg, params, prompts, mesh)
+    assert tp == single
+    # The weights and KV cache must actually live sharded on the mesh
+    # (not replicated): kv-heads ride tp.
+    kspec = eng.cache.k.sharding.spec
+    assert "tp" in str(kspec), f"KV cache not TP-sharded: {kspec}"
+
+
+def test_tp2_fsdp2_matches_single_chip(tiny_setup):
+    cfg, params, prompts = tiny_setup
+    _, single = _run(cfg, params, prompts, None)
+    mesh = make_mesh(ParallelPlan(tp=2, fsdp=2),
+                     devices=jax.devices()[:4])
+    eng, out = _run(cfg, params, prompts, mesh)
+    assert out == single
+    # embed-dim weight sharding (ZeRO-style) must be on the fsdp axis.
+    flat = jax.tree_util.tree_leaves_with_path(eng.params)
+    specs = " ".join(str(x.sharding.spec) for _, x in flat
+                     if hasattr(x, "sharding"))
+    assert "fsdp" in specs and "tp" in specs
+
+
+def test_tp2_prefix_cache_matches(tiny_setup):
+    """Registered-prefix suffix path under TP: same tokens as the
+    single-chip engine serving the same prompts."""
+    cfg, params, _ = tiny_setup
+    rng = np.random.default_rng(1)
+    prefix = rng.integers(0, cfg.vocab_size, 24).tolist()
+    prompts = [prefix + rng.integers(0, cfg.vocab_size, 8).tolist()
+               for _ in range(4)]
+
+    def run(mesh):
+        eng = LLMEngine(cfg, params, num_slots=4, max_seq_len=128,
+                        mesh=mesh)
+        eng.register_prefix(prefix)
+        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        while eng.step():
+            pass
+        outs = [r.result(timeout=120) for r in reqs]
+        assert eng.prefix_hits >= len(prompts)
+        eng._stop = True
+        return outs
+
+    mesh = make_mesh(ParallelPlan(tp=2), devices=jax.devices()[:2])
+    assert run(mesh) == run(None)
