@@ -41,6 +41,29 @@ def _load():
         fn.restype = ctypes.c_int
         fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                        ctypes.c_char_p]
+    # Pull/push manager (policy layer — fair queueing, byte budget,
+    # retry; reference pull_manager.h:52 / push_manager.h:30). Guarded:
+    # a stale pre-manager .so must degrade to the plain client path,
+    # not break the whole module.
+    if hasattr(lib, "rtp_start"):
+        lib.rto_stat.restype = ctypes.c_int64
+        lib.rto_stat.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtp_start.restype = ctypes.c_void_p
+        lib.rtp_start.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int]
+        lib.rtp_submit.restype = ctypes.c_uint64
+        lib.rtp_submit.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.c_char_p, ctypes.c_int]
+        lib.rtp_wait.restype = ctypes.c_int
+        lib.rtp_wait.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                 ctypes.c_int]
+        lib.rtp_stats.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.POINTER(ctypes.c_uint64)]
+        lib.rtp_stop.argtypes = [ctypes.c_void_p]
     # This library embeds its own store core — rts_connect et al for
     # attaching the LOCAL arena the transfer functions operate on.
     lib.rts_connect.restype = ctypes.c_void_p
@@ -141,3 +164,78 @@ class TransferClient:
         if self._store:
             lib.rts_disconnect(self._store)
             self._store = None
+
+
+_MGR_ERRORS = {
+    -1: "object not found",
+    -2: "store full",
+    -3: "wire error (sender died or timed out, after retries)",
+    -5: "wait timeout",
+    -6: "manager stopped",
+    -7: "unknown ticket",
+}
+
+
+class PullManager:
+    """Native transfer-policy layer: N worker threads drain per-
+    requester queues fairly (round-robin), admit transfers against a
+    global in-flight byte budget tied to the local arena's capacity,
+    retry wire errors with fresh connections, and surface sender-death
+    aborts to the waiter. Concurrent pulls of one object coalesce.
+
+    Reference: src/ray/object_manager/pull_manager.h:52 (fair queueing,
+    in-flight budget, retry/cancel) and push_manager.h:30 (push
+    scheduling under the same budget).
+    """
+
+    def __init__(self, local_shm_name: str, *, budget_bytes: int = 0,
+                 workers: int = 4, timeout_ms: int = 30000,
+                 retries: int = 2):
+        lib = _load()
+        if not hasattr(lib, "rtp_start"):
+            raise TransferError(
+                "libobject_transfer.so predates the pull manager — "
+                "rebuild with `make -C src`")
+        self._h = lib.rtp_start(local_shm_name.encode(), budget_bytes,
+                                workers, timeout_ms, retries)
+        if not self._h:
+            raise TransferError(
+                f"cannot start pull manager on {local_shm_name}")
+
+    def submit_pull(self, requester: int, host: str, port: int,
+                    object_id: bytes) -> int:
+        return _load().rtp_submit(self._h, requester, host.encode(),
+                                  port, _check_id(object_id), 0)
+
+    def submit_push(self, requester: int, host: str, port: int,
+                    object_id: bytes) -> int:
+        return _load().rtp_submit(self._h, requester, host.encode(),
+                                  port, _check_id(object_id), 1)
+
+    def wait(self, ticket: int, timeout_ms: int = -1) -> None:
+        """Block until the ticketed transfer completes; raises
+        TransferError (with the failure cause) on anything but
+        success."""
+        rc = _load().rtp_wait(self._h, ticket, timeout_ms)
+        if rc != 0:
+            raise TransferError(
+                f"transfer failed: {_MGR_ERRORS.get(rc, rc)}")
+
+    def pull(self, requester: int, host: str, port: int,
+             object_id: bytes, timeout_ms: int = -1) -> None:
+        self.wait(self.submit_pull(requester, host, port, object_id),
+                  timeout_ms)
+
+    def stats(self) -> dict:
+        inflight = ctypes.c_uint64()
+        queued = ctypes.c_uint64()
+        active = ctypes.c_uint64()
+        _load().rtp_stats(self._h, ctypes.byref(inflight),
+                          ctypes.byref(queued), ctypes.byref(active))
+        return {"inflight_bytes": inflight.value,
+                "queued": queued.value, "active": active.value}
+
+    def stop(self) -> None:
+        if self._h:
+            _load().rtp_stop(self._h)
+            self._h = None

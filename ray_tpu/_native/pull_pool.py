@@ -1,11 +1,14 @@
-"""Shared pool of object-transfer pull clients.
+"""Shared pull plane for node daemons and the driver's RemotePlane.
 
-One persistent TransferClient per peer endpoint, each serialized by its
-own lock (the native connection handles one transfer at a time), with
-drop-and-reconnect on error. Used by both the node daemon (pulling task
-args into its arena) and the driver's RemotePlane (pulling results) —
-the raylet PullManager role, reference: src/ray/object_manager/
-pull_manager.h.
+Backed by the native PullManager (src/object_transfer.cc rtp_*): per-
+requester fair queueing, a global in-flight byte budget tied to the
+local arena's capacity, wire-error retry with reconnect, sender-death
+abort surfaced to the caller, and same-object coalescing — the raylet
+PullManager/PushManager policy, reference: src/ray/object_manager/
+pull_manager.h:52, push_manager.h:30.
+
+The old per-peer serial-lock client pool remains as the fallback when
+the native library predates the manager (rebuild with `make -C src`).
 """
 
 from __future__ import annotations
@@ -21,14 +24,35 @@ class PullClientPool:
         self._clients: Dict[Hashable, object] = {}
         self._locks: Dict[Hashable, threading.Lock] = {}
         self._lock = threading.Lock()
+        self._mgr = None
+        try:
+            from .object_transfer import PullManager
+
+            # budget 0 = half the arena (admission headroom for the
+            # non-transfer users of the arena); 4 workers keep distinct
+            # peers streaming concurrently under the shared budget.
+            self._mgr = PullManager(local_shm_name)
+        except Exception:  # noqa: BLE001 - stale .so without rtp_*
+            self._mgr = None
 
     def pull(self, key: Hashable, endpoint: Tuple[str, int],
              object_id: bytes) -> None:
         """Pull object_id from the peer at `endpoint` into the local
-        arena. Raises on failure (after dropping the cached client so
-        a restarted peer gets a fresh connection). Connecting happens
-        under the PER-KEY lock only — one unreachable peer (kernel
-        connect timeout) must not serialize pulls to healthy peers."""
+        arena. Raises on failure. `key` doubles as the fairness bucket:
+        requests from different keys round-robin, so one peer's (or
+        consumer's) flood cannot starve the rest."""
+        if self._mgr is not None:
+            self._mgr.pull(hash(key) & 0x7FFFFFFFFFFFFFFF,
+                           endpoint[0], endpoint[1], object_id)
+            return
+        self._pull_fallback(key, endpoint, object_id)
+
+    def _pull_fallback(self, key: Hashable, endpoint: Tuple[str, int],
+                       object_id: bytes) -> None:
+        """Per-peer serial client (pre-manager behavior). Connecting
+        happens under the PER-KEY lock only — one unreachable peer
+        (kernel connect timeout) must not serialize pulls to healthy
+        peers."""
         from .object_transfer import TransferClient
 
         with self._lock:
@@ -49,6 +73,11 @@ class PullClientPool:
             self.drop(key)
             raise
 
+    def stats(self) -> dict:
+        if self._mgr is not None:
+            return self._mgr.stats()
+        return {}
+
     def drop(self, key: Hashable) -> None:
         with self._lock:
             client = self._clients.pop(key, None)
@@ -58,6 +87,10 @@ class PullClientPool:
                 client.close()
 
     def close(self) -> None:
+        if self._mgr is not None:
+            with contextlib.suppress(Exception):
+                self._mgr.stop()
+            self._mgr = None
         with self._lock:
             clients = list(self._clients.values())
             self._clients.clear()

@@ -24,8 +24,13 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <map>
 #include <mutex>
+#include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 extern "C" int rts_abort(void* handle, const uint8_t* id);
@@ -35,6 +40,9 @@ namespace {
 constexpr uint64_t kChunk = 4ull << 20;  // 4 MiB write chunks
 constexpr uint8_t OP_PULL = 1;
 constexpr uint8_t OP_PUSH = 2;
+constexpr uint8_t OP_STAT = 3;  // size query (no payload) — the pull
+                                // manager's admission control needs the
+                                // size BEFORE committing budget
 
 bool send_all(int fd, const void* data, uint64_t n) {
   const char* p = static_cast<const char*>(data);
@@ -114,6 +122,12 @@ void serve_conn(TransferServer* ts, int fd) {
         rts_release(ts->store, id);
       }
       if (!ok) break;
+    } else if (op == OP_STAT) {
+      uint64_t off = 0, size = 0;
+      int64_t rsize = -1;
+      if (rts_get(ts->store, id, &off, &size, 0) == 0)
+        rsize = static_cast<int64_t>(size);
+      if (!send_all(fd, &rsize, 8)) break;
     } else if (op == OP_PUSH) {
       uint64_t size = 0;
       if (!recv_all(fd, &size, 8)) break;
@@ -283,6 +297,17 @@ int rto_pull(void* conn, void* local_store, const uint8_t* id) {
   return 0;
 }
 
+// Size of `id` on the peer without transferring it. >=0 size, -1 miss,
+// -3 wire error.
+int64_t rto_stat(void* conn, const uint8_t* id) {
+  int fd = static_cast<int>(reinterpret_cast<intptr_t>(conn)) - 1;
+  uint8_t op = OP_STAT;
+  if (!send_all(fd, &op, 1) || !send_all(fd, id, kIdLen)) return -3;
+  int64_t size;
+  if (!recv_all(fd, &size, 8)) return -3;
+  return size;
+}
+
 // Push a local object to the peer. Returns 0 ok, -1 local miss,
 // -2 peer full, -3 wire error.
 int rto_push(void* conn, void* local_store, const uint8_t* id) {
@@ -298,6 +323,404 @@ int rto_push(void* conn, void* local_store, const uint8_t* id) {
   uint8_t status = 0;
   if (!recv_all(fd, &status, 1)) return -3;
   return status == 0 ? 0 : -2;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Pull/Push manager — the transfer-plane POLICY layer.
+//
+// Reference capabilities (re-designed, not translated):
+//   pull_manager.h:52  — fair queueing across requesters, a global
+//                        in-flight byte budget, retry, cancellation,
+//                        sender-death abort surfaced to the puller;
+//   push_manager.h:30  — chunked push scheduling under the same
+//                        in-flight budget.
+//
+// Architecture: N worker threads drain per-requester FIFO queues in
+// round-robin order (one requester's thousand pulls cannot starve
+// another's one). Before streaming, a worker learns the object's size
+// (OP_STAT) and blocks until the global in-flight byte total fits the
+// budget (an oversized object is admitted only alone, so it can never
+// deadlock). Wire errors retry with a fresh connection; every socket
+// carries SO_RCVTIMEO/SO_SNDTIMEO so a dead or wedged sender turns
+// into a timeout, the partially-created local object is aborted
+// (rts_abort inside rto_pull) and the final status is surfaced to the
+// waiter. Concurrent requests for the same id coalesce onto one
+// transfer (reference: PullManager object deduplication).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct PullOp {
+  uint64_t requester;
+  std::string host;
+  int port;
+  std::string ep;                   // "host:port" concurrency bucket
+  uint8_t id[kIdLen];
+  bool is_push;
+  std::atomic<int> status{1};       // 1 = pending/running
+  std::vector<uint64_t> tickets;    // all waiters coalesced onto this op
+  bool queued = true;
+};
+
+struct PullMgr {
+  void* store = nullptr;            // local arena (owned)
+  uint64_t budget;
+  uint64_t inflight = 0;
+  int timeout_ms;
+  int retries;
+  int ep_cap = 3;  // max workers on ONE endpoint: a dead peer's
+                   // timeouts must not occupy every worker and stall
+                   // pulls from healthy peers
+  std::mutex mu;
+  std::condition_variable work_cv;  // queue -> workers
+  std::condition_variable done_cv;  // op completion -> waiters
+  std::condition_variable budget_cv;
+  std::map<uint64_t, std::deque<PullOp*>> queues;  // per requester
+  uint64_t rr_key = 0;              // fair cursor (next requester >=)
+  std::unordered_map<std::string, int> ep_active;
+  std::unordered_map<std::string, PullOp*> by_id;  // coalesce (pulls,
+                                                   // keyed id+endpoint)
+  std::unordered_map<uint64_t, PullOp*> tickets;
+  uint64_t next_ticket = 1;
+  uint64_t queued_ops = 0, active_ops = 0;
+  int wait_refs = 0;  // rtp_wait callers inside the manager — rtp_stop
+                      // must not free the manager under them
+  bool stopping = false;
+  std::vector<std::thread> workers;
+};
+
+std::string coalesce_key(const uint8_t* id, const std::string& ep) {
+  // Endpoint is part of the identity: a pull naming a HEALTHY source
+  // must not coalesce onto (and inherit the failure of) an in-flight
+  // pull of the same object from a dead one.
+  return std::string(reinterpret_cast<const char*>(id), kIdLen) + "@" +
+         ep;
+}
+
+void set_socket_timeouts(int fd, int timeout_ms) {
+  timeval tv{};
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+// Per-worker endpoint->connection cache. Keyed by "host:port".
+struct WorkerConns {
+  std::unordered_map<std::string, void*> conns;
+
+  void* get(const std::string& host, int port, int timeout_ms) {
+    std::string key = host + ":" + std::to_string(port);
+    auto it = conns.find(key);
+    if (it != conns.end()) return it->second;
+    void* c = rto_connect(host.c_str(), port);
+    if (c != nullptr) {
+      int fd = static_cast<int>(reinterpret_cast<intptr_t>(c)) - 1;
+      set_socket_timeouts(fd, timeout_ms);
+      conns[key] = c;
+    }
+    return c;
+  }
+
+  void drop(const std::string& host, int port) {
+    std::string key = host + ":" + std::to_string(port);
+    auto it = conns.find(key);
+    if (it != conns.end()) {
+      rto_close(it->second);
+      conns.erase(it);
+    }
+  }
+
+  void close_all() {
+    for (auto& kv : conns) rto_close(kv.second);
+    conns.clear();
+  }
+};
+
+// Fair pick: round-robin over requester queues, skipping ops whose
+// endpoint already has ep_cap workers on it. Returns nullptr when no
+// eligible op exists (caller re-waits).
+PullOp* next_op_locked(PullMgr* m) {
+  if (m->queues.empty()) return nullptr;
+  std::vector<uint64_t> keys;
+  keys.reserve(m->queues.size());
+  for (auto& kv : m->queues) keys.push_back(kv.first);
+  size_t start = 0;
+  for (size_t i = 0; i < keys.size(); i++) {
+    if (keys[i] >= m->rr_key) {
+      start = i;
+      break;
+    }
+  }
+  for (size_t k = 0; k < keys.size(); k++) {
+    uint64_t key = keys[(start + k) % keys.size()];
+    auto it = m->queues.find(key);
+    if (it == m->queues.end() || it->second.empty()) continue;
+    PullOp* op = it->second.front();
+    if (m->ep_active[op->ep] >= m->ep_cap) continue;
+    it->second.pop_front();
+    if (it->second.empty()) m->queues.erase(it);
+    m->rr_key = key + 1;
+    m->ep_active[op->ep]++;
+    return op;
+  }
+  return nullptr;
+}
+
+void finish_op_locked(PullMgr* m, PullOp* op, int status) {
+  op->status.store(status);
+  if (!op->is_push) {
+    m->by_id.erase(coalesce_key(op->id, op->ep));
+  }
+  auto ea = m->ep_active.find(op->ep);
+  if (ea != m->ep_active.end() && --ea->second <= 0)
+    m->ep_active.erase(ea);
+  m->active_ops--;
+  m->done_cv.notify_all();
+  m->work_cv.notify_all();  // endpoint slot freed — re-run the pick
+}
+
+void pull_worker(PullMgr* m) {
+  WorkerConns conns;
+  for (;;) {
+    PullOp* op;
+    {
+      std::unique_lock<std::mutex> lk(m->mu);
+      // wait_for (not wait): with work queued but every op's endpoint
+      // saturated, the predicate is true yet nothing is runnable — the
+      // timeout turns that state into a cheap poll; completions also
+      // notify, so pickup is normally immediate.
+      m->work_cv.wait_for(lk, std::chrono::milliseconds(50), [m] {
+        return m->stopping || m->queued_ops > 0;
+      });
+      if (m->stopping) break;
+      op = next_op_locked(m);
+      if (op == nullptr) continue;
+      m->queued_ops--;
+      m->active_ops++;
+      op->queued = false;
+    }
+
+    int rc = -3;
+    uint64_t admitted = 0;
+    for (int attempt = 0; attempt <= m->retries; attempt++) {
+      // Local-presence FIRST: an object already in the local arena
+      // must succeed even when its source peer is dead (no connect).
+      if (!op->is_push && rts_contains(m->store, op->id)) {
+        rc = 0;
+        break;
+      }
+      void* conn = conns.get(op->host, op->port, m->timeout_ms);
+      if (conn == nullptr) {
+        rc = -3;
+        continue;  // connect refused/timed out — retry
+      }
+      int64_t size;
+      if (op->is_push) {
+        uint64_t off = 0, sz = 0;
+        if (rts_get(m->store, op->id, &off, &sz, 0) != 0) {
+          rc = -1;
+          break;  // local miss: nothing to push, no retry will help
+        }
+        size = static_cast<int64_t>(sz);
+      } else {
+        size = rto_stat(conn, op->id);
+        if (size == -1) {
+          rc = -1;
+          break;  // remote miss is authoritative, not retryable here
+        }
+        if (size < 0) {
+          conns.drop(op->host, op->port);
+          rc = -3;
+          continue;
+        }
+      }
+      {
+        std::unique_lock<std::mutex> lk(m->mu);
+        uint64_t need = static_cast<uint64_t>(size);
+        m->budget_cv.wait(lk, [m, need] {
+          return m->stopping || m->inflight + need <= m->budget ||
+                 m->inflight == 0;  // oversized: admit alone
+        });
+        if (m->stopping) {
+          rc = -6;
+          break;
+        }
+        m->inflight += need;
+        admitted = need;
+      }
+      rc = op->is_push ? rto_push(conn, m->store, op->id)
+                       : rto_pull(conn, m->store, op->id);
+      {
+        std::lock_guard<std::mutex> lk(m->mu);
+        m->inflight -= admitted;
+        admitted = 0;
+        m->budget_cv.notify_all();
+      }
+      if (rc == -4) rc = 0;  // already present locally = success
+      if (rc != -3) break;   // success or non-wire error: done
+      // Wire error (sender died / timed out mid-transfer): the partial
+      // local object was aborted inside rto_pull; reconnect and retry.
+      conns.drop(op->host, op->port);
+    }
+    if (admitted) {
+      std::lock_guard<std::mutex> lk(m->mu);
+      m->inflight -= admitted;
+      m->budget_cv.notify_all();
+    }
+    {
+      std::lock_guard<std::mutex> lk(m->mu);
+      finish_op_locked(m, op, rc);
+    }
+  }
+  conns.close_all();
+}
+
+}  // namespace
+
+extern "C" {
+
+// budget_bytes: global in-flight byte cap (0 = half the arena — tied
+// to the receiving arena's capacity so concurrent pulls cannot blow it
+// out). timeout_ms guards every socket op; retries = extra attempts
+// after a wire error.
+void* rtp_start(const char* shm_name, uint64_t budget_bytes,
+                int nworkers, int timeout_ms, int retries) {
+  void* store = rts_connect(shm_name, 0, 0);
+  if (store == nullptr) return nullptr;
+  PullMgr* m = new PullMgr();
+  m->store = store;
+  m->budget = budget_bytes ? budget_bytes : rts_capacity(store) / 2;
+  m->timeout_ms = timeout_ms > 0 ? timeout_ms : 30000;
+  m->retries = retries >= 0 ? retries : 2;
+  if (nworkers <= 0) nworkers = 4;
+  // Leave at least one worker free of any single endpoint so a dead
+  // peer's socket timeouts cannot stall pulls from healthy peers.
+  m->ep_cap = nworkers > 1 ? nworkers - 1 : 1;
+  for (int i = 0; i < nworkers; i++) {
+    m->workers.emplace_back(pull_worker, m);
+  }
+  return m;
+}
+
+// Enqueue a pull (is_push=0) of `id` from host:port into the local
+// arena, or a push (is_push=1) of local `id` to host:port. `requester`
+// is the fairness key (per consumer). Returns a ticket for rtp_wait.
+uint64_t rtp_submit(void* handle, uint64_t requester, const char* host,
+                    int port, const uint8_t* id, int is_push) {
+  PullMgr* m = reinterpret_cast<PullMgr*>(handle);
+  std::string ep = std::string(host) + ":" + std::to_string(port);
+  std::lock_guard<std::mutex> lk(m->mu);
+  uint64_t t = m->next_ticket++;
+  if (!is_push) {
+    // Coalesce onto an in-flight pull of the same object FROM THE
+    // SAME endpoint (a healthy alternate source must not inherit a
+    // dead source's failure).
+    auto it = m->by_id.find(coalesce_key(id, ep));
+    if (it != m->by_id.end()) {
+      it->second->tickets.push_back(t);
+      m->tickets[t] = it->second;
+      return t;
+    }
+  }
+  PullOp* op = new PullOp();
+  op->requester = requester;
+  op->host = host;
+  op->port = port;
+  op->ep = std::move(ep);
+  memcpy(op->id, id, kIdLen);
+  op->is_push = is_push != 0;
+  op->tickets.push_back(t);
+  if (!is_push) {
+    m->by_id[coalesce_key(id, op->ep)] = op;
+  }
+  m->tickets[t] = op;
+  m->queues[requester].push_back(op);
+  m->queued_ops++;
+  m->work_cv.notify_one();
+  return t;
+}
+
+// Block until the ticket's transfer completes (or timeout_ms passes).
+// Returns the transfer status (0 ok, -1 miss, -2 store full, -3 wire
+// error after retries, -6 manager stopping) or -5 on wait timeout.
+// A completed ticket is consumed; the op is freed with its last ticket.
+int rtp_wait(void* handle, uint64_t ticket, int timeout_ms) {
+  PullMgr* m = reinterpret_cast<PullMgr*>(handle);
+  std::unique_lock<std::mutex> lk(m->mu);
+  auto it = m->tickets.find(ticket);
+  if (it == m->tickets.end()) return -7;  // unknown/already consumed
+  PullOp* op = it->second;
+  m->wait_refs++;
+  auto pred = [m, op] {
+    return m->stopping || op->status.load() != 1;
+  };
+  bool timed_out = false;
+  if (timeout_ms < 0) {
+    m->done_cv.wait(lk, pred);
+  } else if (!m->done_cv.wait_for(
+                 lk, std::chrono::milliseconds(timeout_ms), pred)) {
+    timed_out = true;
+  }
+  m->wait_refs--;
+  m->done_cv.notify_all();  // rtp_stop waits on wait_refs == 0
+  if (timed_out) return -5;
+  int st = op->status.load();
+  if (st == 1) st = -6;  // woken by stop while still pending
+  m->tickets.erase(ticket);
+  auto& tk = op->tickets;
+  tk.erase(std::remove(tk.begin(), tk.end(), ticket), tk.end());
+  if (tk.empty()) delete op;
+  return st;
+}
+
+void rtp_stats(void* handle, uint64_t* inflight_bytes,
+               uint64_t* queued, uint64_t* active) {
+  PullMgr* m = reinterpret_cast<PullMgr*>(handle);
+  std::lock_guard<std::mutex> lk(m->mu);
+  if (inflight_bytes) *inflight_bytes = m->inflight;
+  if (queued) *queued = m->queued_ops;
+  if (active) *active = m->active_ops;
+}
+
+void rtp_stop(void* handle) {
+  PullMgr* m = reinterpret_cast<PullMgr*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(m->mu);
+    m->stopping = true;
+    m->work_cv.notify_all();
+    m->budget_cv.notify_all();
+  }
+  for (auto& w : m->workers) w.join();
+  {
+    std::unique_lock<std::mutex> lk(m->mu);
+    // Fail every queued (never-started) op so waiters unblock.
+    for (auto& kv : m->queues) {
+      for (PullOp* op : kv.second) {
+        op->status.store(-6);
+      }
+    }
+    m->queues.clear();
+    m->done_cv.notify_all();
+    // Blocked rtp_wait callers woke on `stopping`; let them leave the
+    // manager before it is freed.
+    m->done_cv.wait(lk, [m] { return m->wait_refs == 0; });
+    // Free every op still registered (never-waited tickets included —
+    // after stop there is nothing left to wait on). Ops appear under
+    // one ticket per waiter; delete each once.
+    std::vector<PullOp*> unique_ops;
+    for (auto& kv : m->tickets) {
+      if (std::find(unique_ops.begin(), unique_ops.end(), kv.second) ==
+          unique_ops.end())
+        unique_ops.push_back(kv.second);
+    }
+    m->tickets.clear();
+    for (PullOp* op : unique_ops) delete op;
+  }
+  rts_disconnect(m->store);
+  delete m;
 }
 
 }  // extern "C"

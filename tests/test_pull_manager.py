@@ -1,0 +1,248 @@
+"""Pull/push manager (transfer-plane policy) tests.
+
+VERDICT r4 #4: fair queueing across requesters, a global in-flight byte
+budget tied to arena headroom, retry/timeout, sender-death abort
+surfaced to the puller, and behavior under contention (N pullers x
+large objects through a small arena). Reference coverage model:
+src/ray/object_manager/test/ + pull_manager.h:52 / push_manager.h:30.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._native import object_transfer as ot
+from ray_tpu._native.shm_store import ID_LEN, ShmStore, available
+
+pytestmark = pytest.mark.skipif(
+    not (available() and ot.available()),
+    reason="native libraries not built")
+
+
+def _id(tag: int) -> bytes:
+    return tag.to_bytes(4, "little") + b"\x00" * (ID_LEN - 4)
+
+
+@pytest.fixture
+def nodes():
+    """Local arena A (destination) + remote arena B behind a transfer
+    server, plus a manager bound to A."""
+    pid = os.getpid()
+    name_a, name_b = f"/rt_pma_{pid}", f"/rt_pmb_{pid}"
+    a = ShmStore(name_a, capacity=48 << 20)
+    b = ShmStore(name_b, capacity=256 << 20)
+    server_b = ot.TransferServer(name_b)
+    mgr = ot.PullManager(name_a, budget_bytes=16 << 20, workers=4,
+                         timeout_ms=5000, retries=1)
+    yield a, b, server_b, mgr, name_a
+    mgr.stop()
+    server_b.stop()
+    a.close()
+    b.close()
+    ShmStore.unlink(name_a)
+    ShmStore.unlink(name_b)
+
+
+def test_basic_pull(nodes):
+    a, b, server_b, mgr, _ = nodes
+    payload = np.random.default_rng(0).bytes(1 << 20)
+    b.put(_id(1), payload)
+    mgr.pull(1, "127.0.0.1", server_b.port, _id(1), timeout_ms=20000)
+    assert bytes(a.get(_id(1))) == payload
+
+
+def test_remote_miss_surfaces(nodes):
+    _, _, server_b, mgr, _ = nodes
+    t = mgr.submit_pull(1, "127.0.0.1", server_b.port, _id(404))
+    with pytest.raises(ot.TransferError, match="not found"):
+        mgr.wait(t, timeout_ms=20000)
+
+
+def test_push_through_manager(nodes):
+    a, b, server_b, mgr, name_a = nodes
+    # Manager is bound to arena A; serve A->push is exercised by
+    # pushing a local-A object to B's server.
+    payload = b"push-payload" * 1000
+    a.put(_id(7), payload)
+    t = mgr.submit_push(1, "127.0.0.1", server_b.port, _id(7))
+    mgr.wait(t, timeout_ms=20000)
+    assert bytes(b.get(_id(7))) == payload
+
+
+def test_contention_byte_budget_respected(nodes):
+    """N concurrent large pulls through a 16 MiB budget into a 48 MiB
+    arena: all complete, and the manager's in-flight byte gauge never
+    exceeds the budget (single oversized admissions excepted — none
+    here since every object fits)."""
+    a, b, server_b, mgr, _ = nodes
+    rng = np.random.default_rng(1)
+    n, size = 10, 6 << 20  # 60 MiB total through a 16 MiB budget
+    payloads = {}
+    for i in range(n):
+        payloads[i] = rng.bytes(size)
+        b.put(_id(100 + i), payloads[i])
+
+    peak = {"v": 0}
+    stop = threading.Event()
+
+    def watch():
+        while not stop.is_set():
+            peak["v"] = max(peak["v"], mgr.stats()["inflight_bytes"])
+            time.sleep(0.002)
+
+    w = threading.Thread(target=watch, daemon=True)
+    w.start()
+    tickets = [mgr.submit_pull(i % 3, "127.0.0.1", server_b.port,
+                               _id(100 + i)) for i in range(n)]
+    errs = []
+    for i, t in enumerate(tickets):
+        try:
+            mgr.wait(t, timeout_ms=60000)
+        except ot.TransferError as e:
+            # Arena (48 MiB) cannot hold all 10 x 6 MiB: "store full"
+            # is an acceptable terminal status for the tail — the
+            # budget kept concurrency bounded; full is the arena's
+            # capacity, not a manager bug.
+            errs.append((i, str(e)))
+    stop.set()
+    w.join(timeout=2)
+    done = [i for i in range(n) if a.contains(_id(100 + i))]
+    assert len(done) >= 6, f"too few completed: {done}, errs={errs}"
+    for i in done:
+        assert bytes(a.get(_id(100 + i))) == payloads[i]
+    assert peak["v"] <= 16 << 20, f"budget exceeded: {peak['v']}"
+
+
+def test_fair_queueing_across_requesters(nodes):
+    """Requester Y's single pull must not wait behind requester X's
+    long queue: with 1 worker, round-robin serves Y second, not 21st."""
+    a, b, server_b, _, name_a = nodes
+    mgr1 = ot.PullManager(name_a, budget_bytes=64 << 20, workers=1,
+                          timeout_ms=5000, retries=1)
+    try:
+        rng = np.random.default_rng(2)
+        # x0 is large so the single worker is still streaming it while
+        # the rest of the flood and y's request queue up behind it —
+        # the pick order after x0 is then purely the manager's policy.
+        b.put(_id(300), rng.bytes(24 << 20))
+        for i in range(1, 20):
+            b.put(_id(300 + i), rng.bytes(1 << 20))
+        b.put(_id(399), rng.bytes(1 << 20))
+
+        order = []
+        lock = threading.Lock()
+
+        # X floods 20 pulls first...
+        tx = [mgr1.submit_pull(111, "127.0.0.1", server_b.port,
+                               _id(300 + i)) for i in range(20)]
+        # ...then Y submits one.
+        ty = mgr1.submit_pull(222, "127.0.0.1", server_b.port, _id(399))
+
+        def waiter(tag, t):
+            mgr1.wait(t, timeout_ms=60000)
+            with lock:
+                order.append(tag)
+
+        threads = [threading.Thread(target=waiter, args=("x", t))
+                   for t in tx]
+        threads.append(threading.Thread(target=waiter, args=("y", ty)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        # Y lands within the first few completions, never after the
+        # whole X flood (would be index 20).
+        assert "y" in order
+        assert order.index("y") <= 3, f"y starved: {order}"
+    finally:
+        mgr1.stop()
+
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SENDER_SRC = """
+import sys, time
+sys.path.insert(0, {root!r})
+from ray_tpu._native import object_transfer as ot
+from ray_tpu._native.shm_store import ShmStore
+st = ShmStore({name!r}, capacity=256 << 20)
+st.put({oid!r}, b"\\xabx" * (96 << 20))
+srv = ot.TransferServer({name!r})
+print(srv.port, flush=True)
+time.sleep(120)
+"""
+
+
+def test_sender_death_mid_transfer_surfaces():
+    """Kill the sender process mid-stream: the puller gets a wire-error
+    after retries (not a hang), and the partially-received local object
+    is aborted, never visible. The 192 MiB payload takes long enough
+    through loopback + first-touch arena faults that a kill shortly
+    after submit lands mid-transfer; an attempt loop guards the race.
+    """
+    pid = os.getpid()
+    name_d = f"/rt_pmd_{pid}"
+    dst = ShmStore(name_d, capacity=256 << 20)
+    mgr = ot.PullManager(name_d, budget_bytes=0, workers=2,
+                         timeout_ms=3000, retries=1)
+    try:
+        saw_error = False
+        for attempt, delay in enumerate((0.03, 0.01)):
+            oid = bytes([0x60 + attempt]) + b"\x00" * (ID_LEN - 1)
+            name_c = f"/rt_pmc_{pid}_{attempt}"
+            child = subprocess.Popen(
+                [sys.executable, "-c", _SENDER_SRC.format(
+                    root=_REPO_ROOT, name=name_c, oid=oid)],
+                stdout=subprocess.PIPE, text=True)
+            try:
+                port = int(child.stdout.readline())
+                t = mgr.submit_pull(9, "127.0.0.1", port, oid)
+                time.sleep(delay)
+                child.kill()
+                try:
+                    mgr.wait(t, timeout_ms=30000)
+                    # Transfer won the race — completed before the
+                    # kill. Object must then be fully intact.
+                    assert bytes(dst.get(oid)) == b"\xabx" * (96 << 20)
+                except ot.TransferError:
+                    saw_error = True
+                    # Aborted partial must not be visible.
+                    assert not dst.contains(oid)
+                    break
+            finally:
+                child.kill()
+                child.wait(timeout=10)
+                ShmStore.unlink(name_c)
+        assert saw_error, "kill never landed mid-transfer (racy rig?)"
+    finally:
+        mgr.stop()
+        dst.close()
+        ShmStore.unlink(name_d)
+
+
+def test_dedup_coalesces_same_object(nodes):
+    a, b, server_b, mgr, _ = nodes
+    b.put(_id(500), b"shared" * 1000)
+    ts = [mgr.submit_pull(i, "127.0.0.1", server_b.port, _id(500))
+          for i in range(6)]
+    for t in ts:
+        mgr.wait(t, timeout_ms=20000)
+    assert bytes(a.get(_id(500))) == b"shared" * 1000
+
+
+def test_local_presence_wins_over_dead_source(nodes):
+    """An object already in the local arena must pull successfully even
+    when the named source endpoint is dead (no connect attempt can
+    succeed) — review finding r5: the presence check runs BEFORE the
+    connect."""
+    a, _, _, mgr, _ = nodes
+    a.put(_id(600), b"already-here")
+    # Port 1 refuses connections instantly on this host.
+    t = mgr.submit_pull(3, "127.0.0.1", 1, _id(600))
+    mgr.wait(t, timeout_ms=20000)
+    assert bytes(a.get(_id(600))) == b"already-here"
