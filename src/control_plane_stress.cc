@@ -1,0 +1,273 @@
+// Sanitizer stress harness for the control-plane daemon (reference:
+// the C++ core's ASAN CI over gcs_server tests, SURVEY.md §4.2).
+//
+// The daemon is a single-threaded epoll loop (no data races by
+// construction — TSAN is moot), so the valuable coverage is ASAN over
+// the FRAME PARSER and connection lifecycle under hostile concurrent
+// load. This harness fork/execs the SANITIZED daemon binary (path in
+// argv[1]), then hammers it from N client threads:
+//   - valid traffic: KV put/get/del/keys, subscribe/publish,
+//     register_node/heartbeat/list_nodes;
+//   - hostile traffic: garbage frames, truncated frames, oversized
+//     length prefixes, RST mid-frame.
+// Afterwards it verifies the daemon still answers PING, SIGTERMs it,
+// and requires death-by-SIGTERM (an ASAN abort exits differently).
+
+#include <pthread.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+
+#include <string>
+
+namespace {
+
+int g_port = 0;
+
+bool write_all(int fd, const void* p, size_t n) {
+  const char* c = static_cast<const char*>(p);
+  while (n > 0) {
+    ssize_t w = send(fd, c, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    c += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool read_all(int fd, void* p, size_t n) {
+  char* c = static_cast<char*>(p);
+  while (n > 0) {
+    ssize_t r = recv(fd, c, n, 0);
+    if (r <= 0) return false;
+    c += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+int dial() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in a{};
+  a.sin_family = AF_INET;
+  a.sin_port = htons(static_cast<uint16_t>(g_port));
+  inet_pton(AF_INET, "127.0.0.1", &a.sin_addr);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&a), sizeof(a)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void put_str(std::string& out, const std::string& s) {
+  uint32_t n = static_cast<uint32_t>(s.size());
+  out.append(reinterpret_cast<const char*>(&n), 4);
+  out.append(s);
+}
+
+// One request; returns response body (after status byte position 0) or
+// empty on error. Skips pubsub pushes.
+bool request(int fd, uint64_t req_id, uint8_t op,
+             const std::string& args, std::string* body) {
+  std::string p;
+  p.push_back(0);
+  p.append(reinterpret_cast<const char*>(&req_id), 8);
+  p.push_back(static_cast<char>(op));
+  p.append(args);
+  uint32_t len = static_cast<uint32_t>(p.size());
+  if (!write_all(fd, &len, 4) || !write_all(fd, p.data(), p.size()))
+    return false;
+  for (;;) {
+    uint32_t rlen;
+    if (!read_all(fd, &rlen, 4) || rlen < 1 || rlen > (64u << 20))
+      return false;
+    std::string frame(rlen, '\0');
+    if (!read_all(fd, frame.data(), rlen)) return false;
+    if (frame[0] != 0) continue;  // pubsub push
+    if (rlen < 9) return false;
+    if (body != nullptr) body->assign(frame, 9, std::string::npos);
+    return true;
+  }
+}
+
+void* valid_client(void* arg) {
+  long tid = reinterpret_cast<long>(arg);
+  int fd = dial();
+  if (fd < 0) abort();
+  unsigned seed = static_cast<unsigned>(tid) * 65521 + 11;
+  uint64_t req = 1;
+  char node_id[32];
+  snprintf(node_id, sizeof(node_id), "stress-node-%ld", tid);
+  {
+    std::string args;
+    put_str(args, node_id);
+    put_str(args, "{}");
+    if (!request(fd, req++, 20 /*REGISTER_NODE*/, args, nullptr))
+      abort();
+  }
+  {
+    std::string args;
+    put_str(args, "stress-chan");
+    if (!request(fd, req++, 10 /*SUBSCRIBE*/, args, nullptr)) abort();
+  }
+  for (int i = 0; i < 400; i++) {
+    int op = rand_r(&seed) % 6;
+    std::string args, body;
+    char key[48];
+    snprintf(key, sizeof(key), "k-%ld-%d", tid, rand_r(&seed) % 32);
+    bool ok = true;
+    if (op == 0) {
+      put_str(args, key);
+      put_str(args, std::string(1 + rand_r(&seed) % 900, 'v'));
+      args.push_back(1);
+      ok = request(fd, req++, 1 /*KV_PUT*/, args, nullptr);
+    } else if (op == 1) {
+      put_str(args, key);
+      ok = request(fd, req++, 2 /*KV_GET*/, args, &body);
+    } else if (op == 2) {
+      put_str(args, key);
+      ok = request(fd, req++, 3 /*KV_DEL*/, args, nullptr);
+    } else if (op == 3) {
+      put_str(args, node_id);
+      ok = request(fd, req++, 21 /*HEARTBEAT*/, args, nullptr);
+    } else if (op == 4) {
+      put_str(args, "stress-chan");
+      put_str(args, "payload");
+      ok = request(fd, req++, 12 /*PUBLISH*/, args, nullptr);
+    } else {
+      ok = request(fd, req++, 22 /*LIST_NODES*/, args, &body);
+    }
+    if (!ok) abort();
+  }
+  close(fd);
+  return nullptr;
+}
+
+void* hostile_client(void* arg) {
+  long tid = reinterpret_cast<long>(arg);
+  unsigned seed = static_cast<unsigned>(tid) * 2 + 999;
+  for (int i = 0; i < 80; i++) {
+    int fd = dial();
+    if (fd < 0) continue;
+    int mode = rand_r(&seed) % 4;
+    if (mode == 0) {
+      // Random garbage (random "length" + junk).
+      char junk[128];
+      for (size_t j = 0; j < sizeof(junk); j++)
+        junk[j] = static_cast<char>(rand_r(&seed));
+      write_all(fd, junk, sizeof(junk));
+    } else if (mode == 1) {
+      // Oversized length prefix — server must reject, not allocate.
+      uint32_t len = 0x7fffffff;
+      write_all(fd, &len, 4);
+    } else if (mode == 2) {
+      // Truncated valid-looking frame, then RST.
+      uint32_t len = 64;
+      write_all(fd, &len, 4);
+      char half[10] = {0};
+      write_all(fd, half, sizeof(half));
+      struct linger lg {1, 0};
+      setsockopt(fd, SOL_SOCKET, SO_LINGER, &lg, sizeof(lg));
+    } else {
+      // Frame whose inner strings overrun the frame (parser bounds).
+      std::string p;
+      p.push_back(0);
+      uint64_t rid = 7;
+      p.append(reinterpret_cast<const char*>(&rid), 8);
+      p.push_back(1);  // KV_PUT
+      uint32_t huge = 0x00ffffff;
+      p.append(reinterpret_cast<const char*>(&huge), 4);  // key len lie
+      p.append("short");
+      uint32_t len = static_cast<uint32_t>(p.size());
+      write_all(fd, &len, 4);
+      write_all(fd, p.data(), p.size());
+      char resp[4];
+      recv(fd, resp, sizeof(resp), MSG_DONTWAIT);
+    }
+    close(fd);
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <control_plane_binary>\n", argv[0]);
+    return 2;
+  }
+  int outpipe[2];
+  if (pipe(outpipe) != 0) return 2;
+  pid_t child = fork();
+  if (child == 0) {
+    dup2(outpipe[1], 1);
+    close(outpipe[0]);
+    close(outpipe[1]);
+    execl(argv[1], argv[1], "--port", "0", "--health-timeout-ms",
+          "2000", static_cast<char*>(nullptr));
+    _exit(127);
+  }
+  close(outpipe[1]);
+  {
+    char line[128] = {0};
+    size_t got = 0;
+    while (got < sizeof(line) - 1) {
+      ssize_t r = read(outpipe[0], line + got, 1);
+      if (r <= 0 || line[got] == '\n') break;
+      got++;
+    }
+    if (sscanf(line, "PORT=%d", &g_port) != 1 || g_port <= 0) {
+      fprintf(stderr, "no PORT= from daemon: '%s'\n", line);
+      kill(child, SIGKILL);
+      return 1;
+    }
+  }
+
+  pthread_t threads[6];
+  for (long t = 0; t < 4; t++)
+    pthread_create(&threads[t], nullptr, valid_client,
+                   reinterpret_cast<void*>(t));
+  for (long t = 4; t < 6; t++)
+    pthread_create(&threads[t], nullptr, hostile_client,
+                   reinterpret_cast<void*>(t));
+  for (int t = 0; t < 6; t++) pthread_join(threads[t], nullptr);
+
+  // Daemon must still be alive and answering.
+  int fd = dial();
+  if (fd < 0) {
+    fprintf(stderr, "daemon unreachable after stress\n");
+    kill(child, SIGKILL);
+    return 1;
+  }
+  std::string body;
+  if (!request(fd, 1, 0 /*PING*/, "", &body)) {
+    fprintf(stderr, "daemon not answering PING after stress\n");
+    kill(child, SIGKILL);
+    return 1;
+  }
+  close(fd);
+
+  kill(child, SIGTERM);
+  int status = 0;
+  waitpid(child, &status, 0);
+  // Clean SIGTERM death (no handler installed) — an ASAN abort or
+  // nonzero exit is a failure.
+  if (!(WIFSIGNALED(status) && WTERMSIG(status) == SIGTERM) &&
+      !(WIFEXITED(status) && WEXITSTATUS(status) == 0)) {
+    fprintf(stderr, "daemon died badly: status=%d\n", status);
+    return 1;
+  }
+  printf("OK control-plane stress\n");
+  return 0;
+}

@@ -1,0 +1,213 @@
+// Sanitizer stress harness for the object-transfer plane (server +
+// pull/push manager), reference: the C++ core's TSAN/ASAN CI coverage
+// over object_manager tests (SURVEY.md §4.2). Build + run via
+// `make -C src sanitize`.
+//
+// Workload: two arenas (src serves, dst receives) on loopback.
+//  - 4 submitter threads × pulls through ONE PullManager (fair queues,
+//    budget admission, dedup) — ids mix present/missing objects;
+//  - 2 raw-client threads doing rto_pull/rto_stat on their own
+//    connections (concurrent with manager traffic);
+//  - 1 disruptor thread that connects, writes garbage, half-frames,
+//    and slams the connection shut (server must survive + stay framed);
+//  - pushes from dst→src through the same manager;
+//  - a final rtp_stop with requests still queued (stop-path coverage).
+
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+
+extern "C" {
+void* rts_connect(const char* name, uint64_t capacity, int create);
+void rts_disconnect(void* handle);
+int rts_unlink(const char* name);
+int rts_create(void* h, const uint8_t* id, uint64_t size, uint64_t* off);
+int rts_seal(void* h, const uint8_t* id);
+uint8_t* rts_base(void* h);
+void* rto_serve(const char* shm, uint64_t cap, int port, int bind_all);
+int rto_port(void* h);
+void rto_stop(void* h);
+void* rto_connect(const char* host, int port);
+void rto_close(void* conn);
+int rto_pull(void* conn, void* store, const uint8_t* id);
+int64_t rto_stat(void* conn, const uint8_t* id);
+void* rtp_start(const char* shm, uint64_t budget, int workers,
+                int timeout_ms, int retries);
+uint64_t rtp_submit(void* h, uint64_t requester, const char* host,
+                    int port, const uint8_t* id, int is_push);
+int rtp_wait(void* h, uint64_t ticket, int timeout_ms);
+void rtp_stats(void* h, uint64_t* inflight, uint64_t* queued,
+               uint64_t* active);
+void rtp_stop(void* h);
+}
+
+namespace {
+
+char g_src[64], g_dst[64];
+int g_src_port = 0, g_dst_port = 0;
+void* g_mgr = nullptr;     // dst-bound manager (pull from src)
+void* g_push_mgr = nullptr;  // src-bound? no: dst-local, pushes to dst? see main
+constexpr int kObjects = 48;
+
+void make_id(uint8_t* id, int tag) {
+  memset(id, 0, 28);
+  memcpy(id, &tag, sizeof(tag));
+}
+
+void* submitter(void* arg) {
+  long tid = reinterpret_cast<long>(arg);
+  unsigned seed = static_cast<unsigned>(tid) * 104729 + 7;
+  for (int i = 0; i < 120; i++) {
+    uint8_t id[28];
+    // 1 in 4 targets a missing object (error path).
+    int tag = rand_r(&seed) % (kObjects + kObjects / 4);
+    make_id(id, tag);
+    uint64_t t = rtp_submit(g_mgr, static_cast<uint64_t>(tid),
+                            "127.0.0.1", g_src_port, id, 0);
+    int rc = rtp_wait(g_mgr, t, 30000);
+    if (rc != 0 && rc != -1 && rc != -2 && rc != -6) {
+      fprintf(stderr, "pull rc=%d tag=%d\n", rc, tag);
+      abort();
+    }
+  }
+  return nullptr;
+}
+
+void* raw_client(void* arg) {
+  long tid = reinterpret_cast<long>(arg);
+  void* dst = rts_connect(g_dst, 0, 0);
+  void* conn = rto_connect("127.0.0.1", g_src_port);
+  if (conn == nullptr || dst == nullptr) abort();
+  unsigned seed = static_cast<unsigned>(tid) * 31337 + 1;
+  for (int i = 0; i < 150; i++) {
+    uint8_t id[28];
+    make_id(id, rand_r(&seed) % (kObjects + 8));
+    if (rand_r(&seed) % 2) {
+      int64_t sz = rto_stat(conn, id);
+      if (sz < -1) abort();
+    } else {
+      int rc = rto_pull(conn, dst, id);
+      if (rc != 0 && rc != -1 && rc != -2 && rc != -4) abort();
+    }
+  }
+  rto_close(conn);
+  rts_disconnect(dst);
+  return nullptr;
+}
+
+void* disruptor(void*) {
+  unsigned seed = 42;
+  for (int i = 0; i < 60; i++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in a{};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(static_cast<uint16_t>(g_src_port));
+    inet_pton(AF_INET, "127.0.0.1", &a.sin_addr);
+    if (connect(fd, reinterpret_cast<sockaddr*>(&a), sizeof(a)) == 0) {
+      char junk[64];
+      for (size_t j = 0; j < sizeof(junk); j++)
+        junk[j] = static_cast<char>(rand_r(&seed));
+      // Garbage op, half a frame, or nothing — then slam shut.
+      int mode = rand_r(&seed) % 3;
+      if (mode == 0) (void)!write(fd, junk, sizeof(junk));
+      if (mode == 1) (void)!write(fd, junk, 3);
+      struct linger lg {1, 0};  // RST on close
+      setsockopt(fd, SOL_SOCKET, SO_LINGER, &lg, sizeof(lg));
+    }
+    close(fd);
+  }
+  return nullptr;
+}
+
+void* pusher(void* arg) {
+  long tid = reinterpret_cast<long>(arg);
+  unsigned seed = static_cast<unsigned>(tid) * 7 + 3;
+  for (int i = 0; i < 60; i++) {
+    uint8_t id[28];
+    make_id(id, 1000 + (rand_r(&seed) % kObjects));  // src-side ids
+    uint64_t t = rtp_submit(g_push_mgr, static_cast<uint64_t>(tid),
+                            "127.0.0.1", g_dst_port, id, 1);
+    int rc = rtp_wait(g_push_mgr, t, 30000);
+    if (rc != 0 && rc != -1 && rc != -2 && rc != -6) {
+      fprintf(stderr, "push rc=%d\n", rc);
+      abort();
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+int main() {
+  snprintf(g_src, sizeof(g_src), "/rto_stress_s_%d", getpid());
+  snprintf(g_dst, sizeof(g_dst), "/rto_stress_d_%d", getpid());
+  void* src = rts_connect(g_src, 32ull << 20, 1);
+  void* dst = rts_connect(g_dst, 32ull << 20, 1);
+  if (src == nullptr || dst == nullptr) return 1;
+  uint8_t* base = rts_base(src);
+  unsigned seed = 1;
+  for (int i = 0; i < kObjects; i++) {
+    uint8_t id[28];
+    make_id(id, i);
+    uint64_t off = 0;
+    uint64_t n = 256 + (rand_r(&seed) % (96 << 10));
+    if (rts_create(src, id, n, &off) != 0) return 1;
+    memset(base + off, i & 0xff, n);
+    rts_seal(src, id);
+  }
+  // Push sources on the src arena under a distinct tag space.
+  for (int i = 0; i < kObjects; i++) {
+    uint8_t id[28];
+    make_id(id, 1000 + i);
+    uint64_t off = 0;
+    uint64_t n = 128 + (rand_r(&seed) % (16 << 10));
+    if (rts_create(src, id, n, &off) != 0) return 1;
+    memset(base + off, 0x5a, n);
+    rts_seal(src, id);
+  }
+
+  void* srv_src = rto_serve(g_src, 0, 0, 0);
+  void* srv_dst = rto_serve(g_dst, 0, 0, 0);
+  if (srv_src == nullptr || srv_dst == nullptr) return 1;
+  g_src_port = rto_port(srv_src);
+  g_dst_port = rto_port(srv_dst);
+  g_mgr = rtp_start(g_dst, 4ull << 20, 3, 5000, 1);
+  g_push_mgr = rtp_start(g_src, 4ull << 20, 2, 5000, 1);
+  if (g_mgr == nullptr || g_push_mgr == nullptr) return 1;
+
+  pthread_t threads[8];
+  for (long t = 0; t < 4; t++)
+    pthread_create(&threads[t], nullptr, submitter,
+                   reinterpret_cast<void*>(t));
+  for (long t = 4; t < 6; t++)
+    pthread_create(&threads[t], nullptr, raw_client,
+                   reinterpret_cast<void*>(t));
+  pthread_create(&threads[6], nullptr, disruptor, nullptr);
+  pthread_create(&threads[7], nullptr, pusher,
+                 reinterpret_cast<void*>(7L));
+  for (int t = 0; t < 8; t++) pthread_join(threads[t], nullptr);
+
+  // Stop with work still queued: submit without waiting, then stop.
+  for (int i = 0; i < 16; i++) {
+    uint8_t id[28];
+    make_id(id, i);
+    rtp_submit(g_mgr, 99, "127.0.0.1", g_src_port, id, 0);
+  }
+  rtp_stop(g_mgr);
+  rtp_stop(g_push_mgr);
+  rto_stop(srv_src);
+  rto_stop(srv_dst);
+  rts_disconnect(src);
+  rts_disconnect(dst);
+  rts_unlink(g_src);
+  rts_unlink(g_dst);
+  printf("OK transfer stress\n");
+  return 0;
+}
