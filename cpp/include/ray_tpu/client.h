@@ -18,7 +18,9 @@
 
 #include <array>
 #include <cstdint>
+#include <deque>
 #include <map>
+#include <mutex>
 #include <stdexcept>
 #include <string>
 #include <vector>
@@ -153,16 +155,37 @@ class TaskClient {
   // Create a Python actor by class qualname; returns its actor id.
   std::string CreatePyActor(const std::string& qualname,
                             const std::string& args_json);
-  // Call a method on it; returns the JSON result. Calls on one
-  // TaskClient are serial → per-actor ordering holds.
+  // Call a method on it; returns the JSON result.
   std::string CallPyActor(const std::string& actor_id,
                           const std::string& method,
                           const std::string& args_json);
 
+  // -- pipelined (async) submission ---------------------------------
+  // Reference capability: the C++ API's asynchronous task callers
+  // (cpp/include/ray/api/task_caller.h) — K submissions in flight
+  // before the first reply. The daemon processes one connection's
+  // frames strictly in order and replies in order, so the pipeline IS
+  // the per-actor sequence (the actor_submit_queue.h sequence-number
+  // idea realized by the transport): ordering holds with any mix of
+  // async and sync calls on one client. Wait(ticket) returns the JSON
+  // result or throws Error with the remote failure.
+  uint64_t SubmitPyTaskAsync(const std::string& qualname,
+                             const std::string& args_json);
+  uint64_t CallPyActorAsync(const std::string& actor_id,
+                            const std::string& method,
+                            const std::string& args_json);
+  std::string Wait(uint64_t ticket);
+
  private:
   std::string Roundtrip(const std::string& json_msg);
+  uint64_t SendAsync(const std::string& json_msg);
+  void ReadOneResponse();  // assigns to the oldest in-flight ticket
 
   int fd_;
+  std::mutex mu_;
+  uint64_t next_ticket_ = 1;
+  std::deque<uint64_t> inflight_;               // send order = reply order
+  std::map<uint64_t, std::pair<bool, std::string>> done_;  // ok, payload
 };
 
 }  // namespace ray_tpu

@@ -39,6 +39,36 @@ int main(int argc, char** argv) {
     tasks.CallPyActor(aid, "append", "[\"b\"]");
     std::string copy = tasks.CallPyActor(aid, "copy", "[]");
     std::printf("OK actor_state=%s\n", copy.c_str());
+
+    // Pipelined: K submissions in flight BEFORE the first Wait, mixed
+    // tasks + ordered actor calls, results claimed out of order.
+    std::vector<uint64_t> tickets;
+    for (int i = 0; i < 8; i++) {
+      char args[32];
+      std::snprintf(args, sizeof(args), "[%d, %d]", 3 * i, 4 * i);
+      tickets.push_back(tasks.SubmitPyTaskAsync("math.hypot", args));
+    }
+    for (int i = 0; i < 4; i++)
+      tickets.push_back(tasks.CallPyActorAsync(aid, "append", "[1]"));
+    tickets.push_back(tasks.CallPyActorAsync(aid, "__len__", "[]"));
+    // Claim the LAST first (out-of-order wait over the pipeline).
+    std::string len = tasks.Wait(tickets.back());
+    if (len != "6") {  // ["a","b"] + 4 appends → 6
+      std::fprintf(stderr, "pipelined actor order broken: len=%s\n",
+                   len.c_str());
+      return 1;
+    }
+    for (int i = 0; i < 8; i++) {
+      std::string got = tasks.Wait(tickets[i]);
+      char expect[32];
+      std::snprintf(expect, sizeof(expect), "%.1f", 5.0 * i);
+      if (got != expect) {
+        std::fprintf(stderr, "pipelined task %d: %s != %s\n", i,
+                     got.c_str(), expect);
+        return 1;
+      }
+    }
+    std::printf("OK pipelined=13\n");
     return 0;
   }
 

@@ -16,6 +16,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstring>
 
 // ---------------------------------------------------------------------------
@@ -591,7 +592,7 @@ TaskClient::~TaskClient() {
   if (fd_ >= 0) close(fd_);
 }
 
-std::string TaskClient::Roundtrip(const std::string& json_msg) {
+uint64_t TaskClient::SendAsync(const std::string& json_msg) {
   // [u64 BIG-ENDIAN length][payload] — the dispatch protocol's framing
   // (node/daemon.py; struct "!Q").
   uint64_t n = json_msg.size();
@@ -600,12 +601,21 @@ std::string TaskClient::Roundtrip(const std::string& json_msg) {
     header[i] = static_cast<uint8_t>((n >> (8 * (7 - i))) & 0xff);
   std::string frame(reinterpret_cast<char*>(header), 8);
   frame += json_msg;
+  std::lock_guard<std::mutex> lk(mu_);
   size_t sent = 0;
   while (sent < frame.size()) {
     ssize_t w = send(fd_, frame.data() + sent, frame.size() - sent, 0);
     if (w <= 0) throw Error("daemon send failed");
     sent += static_cast<size_t>(w);
   }
+  uint64_t t = next_ticket_++;
+  inflight_.push_back(t);
+  return t;
+}
+
+void TaskClient::ReadOneResponse() {
+  // Caller holds mu_. Responses arrive in submission order; this one
+  // belongs to the oldest in-flight ticket.
   uint8_t rh[8];
   size_t got = 0;
   while (got < 8) {
@@ -623,10 +633,58 @@ std::string TaskClient::Roundtrip(const std::string& json_msg) {
     if (r <= 0) throw Error("daemon connection closed");
     got += static_cast<size_t>(r);
   }
+  if (inflight_.empty())
+    throw Error("daemon reply with no in-flight request");
+  uint64_t t = inflight_.front();
+  inflight_.pop_front();
   std::string err = JsonField(resp, "error");
   if (err != "__none__" && err != "null")
-    throw Error("remote task failed: " + err);
-  return JsonField(resp, "result");
+    done_[t] = {false, "remote task failed: " + err};
+  else
+    done_[t] = {true, JsonField(resp, "result")};
+}
+
+std::string TaskClient::Wait(uint64_t ticket) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (;;) {
+    auto it = done_.find(ticket);
+    if (it != done_.end()) {
+      std::pair<bool, std::string> r = it->second;
+      done_.erase(it);
+      if (!r.first) throw Error(r.second);
+      return r.second;
+    }
+    // A ticket that is neither done nor in flight (double-claimed or
+    // never issued) can never resolve — waiting would block in recv
+    // forever with the client mutex held.
+    if (ticket >= next_ticket_ ||
+        std::find(inflight_.begin(), inflight_.end(), ticket) ==
+            inflight_.end())
+      throw Error("unknown or already-claimed ticket");
+    ReadOneResponse();
+  }
+}
+
+std::string TaskClient::Roundtrip(const std::string& json_msg) {
+  return Wait(SendAsync(json_msg));
+}
+
+uint64_t TaskClient::SubmitPyTaskAsync(const std::string& qualname,
+                                       const std::string& args_json) {
+  std::string msg = "{\"type\": \"task_xlang\", \"qualname\": \"" +
+                    JsonEscape(qualname) + "\", \"args_json\": \"" +
+                    JsonEscape(args_json) + "\"}";
+  return SendAsync(msg);
+}
+
+uint64_t TaskClient::CallPyActorAsync(const std::string& actor_id,
+                                      const std::string& method,
+                                      const std::string& args_json) {
+  std::string msg = "{\"type\": \"actor_call_xlang\", \"actor_id\": \"" +
+                    JsonEscape(actor_id) + "\", \"method\": \"" +
+                    JsonEscape(method) + "\", \"args_json\": \"" +
+                    JsonEscape(args_json) + "\"}";
+  return SendAsync(msg);
 }
 
 std::string TaskClient::SubmitPyTask(const std::string& qualname,
