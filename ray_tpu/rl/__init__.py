@@ -27,6 +27,7 @@ from .continuous import (
     SACContinuous,
 )
 from .dqn import DQN, DQNConfig
+from .r2d2 import R2D2, R2D2Config, RecurrentQSpec
 from .dreamer import Dreamer, DreamerConfig
 from .env import (
     ENV_REGISTRY,
@@ -62,7 +63,8 @@ __all__ = [
     "ContinuousEnvRunner", "MultiAgentEnvRunner",
     "MLPModuleSpec", "QMLPSpec", "GaussianPolicySpec", "QSASpec",
     "PPO", "PPOConfig", "GRPO", "GRPOConfig",
-    "DQN", "DQNConfig", "SAC", "SACConfig", "SACContinuous",
+    "DQN", "DQNConfig", "R2D2", "R2D2Config", "RecurrentQSpec",
+    "SAC", "SACConfig", "SACContinuous",
     "TD3", "DDPG", "ContinuousConfig", "IMPALA", "IMPALAConfig",
     "APPO", "APPOConfig", "MultiAgentPPO", "MultiAgentPPOConfig",
     "BC", "BCConfig", "CQL", "CQLConfig", "OfflineDataset",

@@ -66,6 +66,56 @@ class EnvRunner:
                 self.vec.pop_episode_returns(), np.float32),
         }
 
+    def sample_recurrent(self, params, num_steps: int, *,
+                         epsilon: float = 0.0) -> Dict[str, np.ndarray]:
+        """Recurrent off-policy collection (R2D2): epsilon-greedy over
+        a stateful Q-module (spec.step(params, h, obs) → (q, h')),
+        carrying the hidden state ACROSS calls (the replay stream stays
+        temporally contiguous between iterations) and zeroing it on
+        episode boundaries. Returns time-major (T, K, ...) arrays —
+        obs/actions/rewards/dones plus `h`, the recurrent state BEFORE
+        each step (the stored state a sampled window trains from;
+        reference: R2D2 stored-state replay,
+        rllib/algorithms/r2d2/r2d2.py)."""
+        import jax.numpy as jnp
+
+        K = self.vec.num_envs
+        if not hasattr(self, "_rnn_h"):
+            self._rnn_h = np.asarray(self.spec.init_state(K))
+        obs_l, act_l, rew_l, done_l, h_l = [], [], [], [], []
+        for _ in range(num_steps):
+            obs = self.vec.observations
+            h_l.append(self._rnn_h.copy())
+            q, h_next = self.spec.step(params, jnp.asarray(self._rnn_h),
+                                       jnp.asarray(obs, jnp.float32))
+            self._key, k = jax.random.split(self._key)
+            greedy = np.asarray(jnp.argmax(q, axis=-1))
+            explore = np.asarray(jax.random.uniform(k, (K,))) < epsilon
+            self._key, k2 = jax.random.split(self._key)
+            randa = np.asarray(jax.random.randint(
+                k2, (K,), 0, q.shape[-1]))
+            actions = np.where(explore, randa, greedy)
+            _, rewards, dones = self.vec.step(actions)
+            # Auto-reset: a finished env restarts from a fresh episode,
+            # so its recurrent state restarts too.
+            h_np = np.array(h_next)  # owned copy (asarray may alias
+            # the read-only jax buffer)
+            h_np[np.asarray(dones)] = 0.0
+            self._rnn_h = h_np
+            obs_l.append(obs)
+            act_l.append(actions)
+            rew_l.append(rewards)
+            done_l.append(dones)
+        return {
+            "obs": np.stack(obs_l).astype(np.float32),
+            "actions": np.stack(act_l).astype(np.int64),
+            "rewards": np.stack(rew_l).astype(np.float32),
+            "dones": np.stack(done_l).astype(np.float32),
+            "h": np.stack(h_l).astype(np.float32),
+            "episode_returns": np.asarray(
+                self.vec.pop_episode_returns(), np.float32),
+        }
+
     def sample_transitions(self, params, num_steps: int, *,
                            epsilon: Optional[float] = None
                            ) -> Dict[str, np.ndarray]:

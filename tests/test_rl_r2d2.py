@@ -1,0 +1,127 @@
+"""R2D2 tests — recurrent replay DQN (reference coverage model:
+rllib/algorithms/r2d2/tests/test_r2d2.py — compile/learn/checkpoint,
+sequence replay + stored-state burn-in mechanics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.rl import R2D2, R2D2Config, RecurrentQSpec
+
+
+def _small(**kw):
+    # gamma=0.99 / lr=1e-3 / 16 updates: the stable point from a config
+    # scan on this env (3e-3 on the GRU oscillates; 0.997 over-credits
+    # GridWorld's short horizon).
+    base = dict(env="GridWorld", num_env_runners=1,
+                num_envs_per_runner=8, rollout_length=40,
+                seq_len=10, burn_in=2, hidden=32, gamma=0.99,
+                learning_starts=320, batch_size=32,
+                updates_per_iteration=16, epsilon_decay_iters=10,
+                lr=1e-3, seed=1)
+    base.update(kw)
+    return R2D2Config(**base)
+
+
+class TestRecurrentQSpec:
+    def test_step_unroll_consistency(self):
+        """Stepwise rollout and scan unroll must produce identical
+        hidden states and Q-values (the runner uses step, the learner
+        uses unroll — divergence would corrupt stored-state replay)."""
+        spec = RecurrentQSpec(observation_size=3, num_actions=4,
+                              hidden=8)
+        params = spec.init(jax.random.key(0))
+        obs = jax.random.normal(jax.random.key(1), (2, 5, 3))
+        h = spec.init_state(2)
+        qs = []
+        for t in range(5):
+            q, h = spec.step(params, h, obs[:, t])
+            qs.append(q)
+        q_step = jnp.stack(qs, axis=1)
+        q_unroll, h_last = spec.unroll(params, spec.init_state(2), obs)
+        np.testing.assert_allclose(np.asarray(q_step),
+                                   np.asarray(q_unroll), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(h), np.asarray(h_last),
+                                   rtol=1e-5)
+
+    def test_state_carries_information(self):
+        """Same observation, different histories → different Q-values
+        (the recurrence is live, not a pass-through)."""
+        spec = RecurrentQSpec(observation_size=2, num_actions=2,
+                              hidden=8)
+        params = spec.init(jax.random.key(0))
+        obs = jnp.ones((1, 2))
+        _, h_a = spec.step(params, spec.init_state(1), obs * 0.0)
+        _, h_b = spec.step(params, spec.init_state(1), obs * 5.0)
+        q_a, _ = spec.step(params, h_a, obs)
+        q_b, _ = spec.step(params, h_b, obs)
+        assert not np.allclose(np.asarray(q_a), np.asarray(q_b))
+
+
+class TestR2D2:
+    def test_learns_gridworld(self, ray_start):
+        algo = R2D2(_small())
+        rets = [algo.step()["episode_return_mean"] for _ in range(20)]
+        eps_final = algo.epsilon()
+        algo.stop()
+        tail = [r for r in rets[-3:] if r is not None]
+        assert tail and np.mean(tail) > 0.5
+        assert eps_final < 0.1
+
+    def test_sequence_replay_and_stored_state(self, ray_start):
+        """The buffer holds contiguous windows with the actor's stored
+        recurrent state; training consumes them without shape drift."""
+        algo = R2D2(_small(rollout_length=24, learning_starts=160,
+                           updates_per_iteration=2))
+        res = None
+        for _ in range(3):
+            res = algo.step()
+        assert res["buffer_size"] >= 160
+        assert "td_loss" in res and np.isfinite(res["td_loss"])
+        sample = algo.buffer.sample(4)
+        assert sample["obs"].shape[:2] == (4, algo.config.seq_len)
+        assert sample["h"].shape == (4, algo.config.seq_len,
+                                     algo.config.hidden)
+        algo.stop()
+
+    def test_checkpoint_roundtrip(self, ray_start, tmp_path):
+        cfg = _small(num_envs_per_runner=2, rollout_length=12,
+                     learning_starts=10_000)  # no updates needed
+        algo = R2D2(cfg)
+        algo.step()
+        path = algo.save(str(tmp_path / "r2d2"))
+        algo2 = R2D2(cfg)
+        algo2.restore(path)
+        assert algo2.iteration == 1
+        a = jax.tree.leaves(algo.params)[0]
+        b = jax.tree.leaves(algo2.params)[0]
+        np.testing.assert_array_equal(a, b)
+        algo.stop(); algo2.stop()
+
+    def test_compute_single_action_stateful(self, ray_start):
+        algo = R2D2(_small(num_envs_per_runner=2, rollout_length=4))
+        a1, h = algo.compute_single_action(np.zeros(2, np.float32))
+        a2, h = algo.compute_single_action(np.zeros(2, np.float32), h)
+        assert 0 <= a1 < 4 and 0 <= a2 < 4
+        assert h.shape == (1, algo.config.hidden)
+        algo.stop()
+
+
+def test_r2d2_tune_integration(ray_start, tmp_path):
+    """R2D2 drives through Tuner like any trainable (reference:
+    rllib algorithms registered as Tune trainables)."""
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+
+    trainable = R2D2.as_trainable(_small(
+        num_envs_per_runner=2, rollout_length=8,
+        learning_starts=10_000, train_iterations=2))
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([1e-3, 3e-3])},
+        run_config=RunConfig(name="r2d2-t", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+    assert all(r.error is None for r in results)
