@@ -4,6 +4,22 @@ from .context import DataContext
 from .dataset import Dataset
 from .grouped_data import GroupedData
 from .iterator import DataIterator
+from .connectors import (
+    BigQueryDatasource,
+    DeltaDatasource,
+    IcebergDatasource,
+    MongoDatasource,
+    read_avro,
+    read_bigquery,
+    read_clickhouse,
+    read_delta,
+    read_iceberg,
+    read_mongo,
+    read_snowflake,
+    write_bigquery,
+    write_mongo,
+    write_sql,
+)
 from .read_api import (
     from_arrow,
     from_huggingface,
@@ -45,7 +61,11 @@ __all__ = [
     "from_torch", "from_huggingface", "range",
     "range_tensor", "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images", "read_tfrecords",
-    "read_webdataset", "read_sql",
+    "read_webdataset", "read_sql", "read_mongo", "read_bigquery",
+    "read_iceberg", "read_delta", "read_clickhouse", "read_snowflake",
+    "read_avro", "write_mongo", "write_bigquery", "write_sql",
+    "MongoDatasource", "BigQueryDatasource", "IcebergDatasource",
+    "DeltaDatasource",
     "write_parquet", "write_csv", "write_json", "write_numpy",
     "write_tfrecords",
     "Datasource", "Datasink", "ReadTask", "read_datasource",

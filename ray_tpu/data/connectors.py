@@ -1,0 +1,410 @@
+"""External-system connectors on the Datasource/Datasink ABCs.
+
+Breadth-parity with the reference's datasource library (reference:
+python/ray/data/datasource/ — mongo_datasource.py,
+bigquery_datasource.py, iceberg (read_iceberg), delta-style tables,
+clickhouse, snowflake, avro, lance; 38 files): each connector plans
+partitioned ReadTasks the streaming executor runs as ordinary tasks.
+
+Design differences from the reference, deliberate:
+- every connector takes an injectable `client_factory` so the
+  partition-planning logic is exercised without the vendor package
+  (the reference mocks at the package level in its tests);
+- vendor packages are GATED, not vendored: the factory default raises
+  an actionable ImportError when the package is absent (this image
+  ships none of them — SURVEY.md environment constraints).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .dataset import Dataset
+from .datasource import Datasink, Datasource, ReadTask, read_datasource
+
+
+def _rows(block: Any) -> List[Dict[str, Any]]:
+    from .block import BlockAccessor
+
+    return list(BlockAccessor.for_block(block).iter_rows())
+
+
+def _require(pkg: str, feature: str):
+    try:
+        return __import__(pkg)
+    except ImportError as e:
+        raise ImportError(
+            f"{feature} requires the '{pkg}' package, which is not "
+            f"installed. Pass client_factory=... to use an existing "
+            f"client/connection instead.") from e
+
+
+# ---------------------------------------------------------------------------
+# MongoDB (reference: data/datasource/mongo_datasource.py)
+# ---------------------------------------------------------------------------
+
+class MongoDatasource(Datasource):
+    """Partitions a collection into skip/limit windows; each ReadTask
+    opens its own client (serializable plan, one connection per task)."""
+
+    def __init__(self, uri: str, database: str, collection: str, *,
+                 pipeline: Optional[List[Dict]] = None,
+                 client_factory: Optional[Callable[[], Any]] = None):
+        self.uri = uri
+        self.database = database
+        self.collection = collection
+        self.pipeline = pipeline or []
+        self.client_factory = client_factory or (
+            lambda: _require("pymongo", "read_mongo").MongoClient(uri))
+
+    def get_name(self) -> str:
+        return f"mongo({self.database}.{self.collection})"
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        client = self.client_factory()
+        coll = client[self.database][self.collection]
+        total = int(coll.count_documents({}))
+        n = max(1, min(parallelism, total) if total else 1)
+        per = (total + n - 1) // n if total else 0
+
+        def make(skip: int, limit: int):
+            def read():
+                c = self.client_factory()
+                cl = c[self.database][self.collection]
+                stages = list(self.pipeline) + [
+                    {"$skip": skip}, {"$limit": limit}]
+                return list(cl.aggregate(stages))
+            return read
+
+        return [ReadTask(make(i * per, per)) for i in range(n)
+                if total] or [ReadTask(lambda: [])]
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: Optional[List[Dict]] = None,
+               parallelism: int = 8,
+               client_factory: Optional[Callable[[], Any]] = None
+               ) -> Dataset:
+    return read_datasource(
+        MongoDatasource(uri, database, collection, pipeline=pipeline,
+                        client_factory=client_factory),
+        parallelism=parallelism)
+
+
+class MongoDatasink(Datasink):
+    def __init__(self, uri: str, database: str, collection: str, *,
+                 client_factory: Optional[Callable[[], Any]] = None):
+        self.uri = uri
+        self.database = database
+        self.collection = collection
+        self.client_factory = client_factory or (
+            lambda: _require("pymongo", "write_mongo").MongoClient(uri))
+
+    def write(self, block: Any) -> Any:
+        rows = _rows(block)
+        if rows:
+            c = self.client_factory()
+            c[self.database][self.collection].insert_many(rows)
+        return len(rows)
+
+
+def write_mongo(ds: Dataset, uri: str, database: str, collection: str,
+                *, client_factory=None) -> List[Any]:
+    from .datasource import write_datasink
+
+    return write_datasink(ds, MongoDatasink(
+        uri, database, collection, client_factory=client_factory))
+
+
+# ---------------------------------------------------------------------------
+# BigQuery (reference: data/datasource/bigquery_datasource.py)
+# ---------------------------------------------------------------------------
+
+class BigQueryDatasource(Datasource):
+    """Row-range partitions over a table or query result."""
+
+    def __init__(self, project: str, dataset_table: Optional[str] = None,
+                 *, query: Optional[str] = None,
+                 client_factory: Optional[Callable[[], Any]] = None):
+        if (dataset_table is None) == (query is None):
+            raise ValueError(
+                "exactly one of dataset_table / query is required")
+        self.project = project
+        self.dataset_table = dataset_table
+        self.query = query
+        self.client_factory = client_factory or (
+            lambda: _require(
+                "google.cloud.bigquery", "read_bigquery"
+            ).Client(project=project))
+
+    def get_name(self) -> str:
+        return f"bigquery({self.dataset_table or 'query'})"
+
+    def _base_query(self) -> str:
+        return self.query or f"SELECT * FROM `{self.dataset_table}`"
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        client = self.client_factory()
+        count_q = (f"SELECT COUNT(*) AS n FROM "
+                   f"({self._base_query()})")
+        total = int(next(iter(client.query(count_q).result()))["n"])
+        n = max(1, min(parallelism, total) if total else 1)
+        per = (total + n - 1) // n if total else 0
+
+        def make(offset: int, limit: int):
+            def read():
+                c = self.client_factory()
+                q = (f"SELECT * FROM ({self._base_query()}) "
+                     f"LIMIT {limit} OFFSET {offset}")
+                return [dict(r) for r in c.query(q).result()]
+            return read
+
+        return [ReadTask(make(i * per, per)) for i in range(n)
+                if total] or [ReadTask(lambda: [])]
+
+
+def read_bigquery(project: str, dataset_table: Optional[str] = None, *,
+                  query: Optional[str] = None, parallelism: int = 8,
+                  client_factory=None) -> Dataset:
+    return read_datasource(
+        BigQueryDatasource(project, dataset_table, query=query,
+                           client_factory=client_factory),
+        parallelism=parallelism)
+
+
+class BigQueryDatasink(Datasink):
+    def __init__(self, project: str, dataset_table: str, *,
+                 client_factory=None):
+        self.project = project
+        self.dataset_table = dataset_table
+        self.client_factory = client_factory or (
+            lambda: _require(
+                "google.cloud.bigquery", "write_bigquery"
+            ).Client(project=project))
+
+    def write(self, block: Any) -> Any:
+        rows = _rows(block)
+        if rows:
+            c = self.client_factory()
+            c.load_table_from_json(rows, self.dataset_table).result()
+        return len(rows)
+
+
+def write_bigquery(ds: Dataset, project: str, dataset_table: str, *,
+                   client_factory=None) -> List[Any]:
+    from .datasource import write_datasink
+
+    return write_datasink(ds, BigQueryDatasink(
+        project, dataset_table, client_factory=client_factory))
+
+
+# ---------------------------------------------------------------------------
+# SQL writes (reference: data/datasource/sql_datasource.py write path).
+# Works against any DBAPI2 connection — really testable with sqlite.
+# ---------------------------------------------------------------------------
+
+class SQLDatasink(Datasink):
+    def __init__(self, table: str,
+                 connection_factory: Callable[[], Any]):
+        self.table = table
+        self.connection_factory = connection_factory
+
+    def write(self, block: Any) -> Any:
+        rows = _rows(block)
+        if not rows:
+            return 0
+        cols = list(rows[0].keys())
+        conn = self.connection_factory()
+        try:
+            ph = ", ".join(["?"] * len(cols))
+            sql = (f"INSERT INTO {self.table} "
+                   f"({', '.join(cols)}) VALUES ({ph})")
+            conn.executemany(
+                sql, [tuple(r.get(c) for c in cols) for r in rows])
+            conn.commit()
+        finally:
+            conn.close()
+        return len(rows)
+
+
+def write_sql(ds: Dataset, table: str,
+              connection_factory: Callable[[], Any]) -> List[Any]:
+    from .datasource import write_datasink
+
+    return write_datasink(ds, SQLDatasink(table, connection_factory))
+
+
+# ---------------------------------------------------------------------------
+# Iceberg-class table formats (reference: read_iceberg / read_delta /
+# read_lance). File-level partitioning: one ReadTask per data file the
+# table's current snapshot references.
+# ---------------------------------------------------------------------------
+
+class IcebergDatasource(Datasource):
+    def __init__(self, table_identifier: str, *,
+                 row_filter: Any = None,
+                 catalog_factory: Optional[Callable[[], Any]] = None):
+        self.table_identifier = table_identifier
+        self.row_filter = row_filter
+        self.catalog_factory = catalog_factory or (
+            lambda: _require("pyiceberg.catalog", "read_iceberg")
+            .load_catalog("default"))
+
+    def get_name(self) -> str:
+        return f"iceberg({self.table_identifier})"
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        catalog = self.catalog_factory()
+        table = catalog.load_table(self.table_identifier)
+        scan = (table.scan(row_filter=self.row_filter)
+                if self.row_filter is not None else table.scan())
+        files = list(scan.plan_files())
+        if not files:
+            return [ReadTask(lambda: [])]
+
+        def make(task_file):
+            def read():
+                # Each planned file scans independently (pyiceberg
+                # returns arrow through to_arrow on a per-file scan).
+                return task_file.to_arrow().to_pylist() \
+                    if hasattr(task_file, "to_arrow") else \
+                    _read_parquet_rows(task_file.file.file_path)
+            return read
+
+        return [ReadTask(make(f)) for f in files]
+
+
+def _read_parquet_rows(path: str) -> List[Dict]:
+    import pandas as pd
+
+    return pd.read_parquet(path).to_dict("records")
+
+
+def read_iceberg(table_identifier: str, *, row_filter=None,
+                 parallelism: int = 8, catalog_factory=None) -> Dataset:
+    return read_datasource(
+        IcebergDatasource(table_identifier, row_filter=row_filter,
+                          catalog_factory=catalog_factory),
+        parallelism=parallelism)
+
+
+class DeltaDatasource(Datasource):
+    """Delta-style table: reads the current-version parquet file set
+    (via deltalake when installed, or an injected table_factory)."""
+
+    def __init__(self, table_uri: str, *,
+                 table_factory: Optional[Callable[[], Any]] = None):
+        self.table_uri = table_uri
+        self.table_factory = table_factory or (
+            lambda: _require("deltalake", "read_delta")
+            .DeltaTable(table_uri))
+
+    def get_name(self) -> str:
+        return f"delta({self.table_uri})"
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        table = self.table_factory()
+        files = list(table.file_uris())
+        if not files:
+            return [ReadTask(lambda: [])]
+
+        def make(path):
+            return ReadTask(lambda: _read_parquet_rows(path))
+
+        return [make(f) for f in files]
+
+
+def read_delta(table_uri: str, *, parallelism: int = 8,
+               table_factory=None) -> Dataset:
+    return read_datasource(
+        DeltaDatasource(table_uri, table_factory=table_factory),
+        parallelism=parallelism)
+
+
+# ---------------------------------------------------------------------------
+# ClickHouse / Snowflake (reference: clickhouse_datasource.py,
+# snowflake_datasource.py) — query-partitioned like BigQuery.
+# ---------------------------------------------------------------------------
+
+def read_clickhouse(table: str, dsn: str, *, columns=None,
+                    parallelism: int = 8, client_factory=None
+                    ) -> Dataset:
+    cols = ", ".join(columns) if columns else "*"
+    factory = client_factory or (
+        lambda: _require("clickhouse_connect", "read_clickhouse")
+        .get_client(dsn=dsn))
+
+    class _CH(Datasource):
+        def get_name(self):
+            return f"clickhouse({table})"
+
+        def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+            client = factory()
+            total = int(client.command(
+                f"SELECT count() FROM {table}"))
+            n = max(1, min(parallelism, total) if total else 1)
+            per = (total + n - 1) // n if total else 0
+
+            def make(off, lim):
+                def read():
+                    c = factory()
+                    res = c.query(f"SELECT {cols} FROM {table} "
+                                  f"LIMIT {lim} OFFSET {off}")
+                    names = res.column_names
+                    return [dict(zip(names, row))
+                            for row in res.result_rows]
+                return read
+
+            return [ReadTask(make(i * per, per)) for i in range(n)
+                    if total] or [ReadTask(lambda: [])]
+
+    return read_datasource(_CH(), parallelism=parallelism)
+
+
+def read_snowflake(sql: str, connection_parameters: Dict[str, Any], *,
+                   parallelism: int = 8, connection_factory=None
+                   ) -> Dataset:
+    factory = connection_factory or (
+        lambda: _require("snowflake.connector", "read_snowflake")
+        .connect(**connection_parameters))
+
+    class _SF(Datasource):
+        def get_name(self):
+            return "snowflake"
+
+        def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+            # Snowflake cursors expose result batches; partition by
+            # fetching batches per task index round-robin.
+            def make(i, n):
+                def read():
+                    conn = factory()
+                    try:
+                        cur = conn.cursor()
+                        cur.execute(sql)
+                        cols = [d[0] for d in cur.description]
+                        rows = cur.fetchall()
+                    finally:
+                        conn.close()
+                    return [dict(zip(cols, r))
+                            for r in rows[i::n]]
+                return read
+
+            n = max(1, parallelism)
+            return [ReadTask(make(i, n)) for i in range(n)]
+
+    return read_datasource(_SF(), parallelism=parallelism)
+
+
+# ---------------------------------------------------------------------------
+# Avro (reference: avro_datasource.py) — file-partitioned.
+# ---------------------------------------------------------------------------
+
+def read_avro(paths, *, parallelism: int = 8) -> Dataset:
+    from .read_api import _expand_paths, _reader_dataset
+
+    def read_one(path: str):
+        fastavro = _require("fastavro", "read_avro")
+        with open(path, "rb") as f:
+            return list(fastavro.reader(f))
+
+    return _reader_dataset(_expand_paths(paths), read_one, "read_avro")

@@ -1,0 +1,291 @@
+"""External-connector tests (reference coverage model:
+python/ray/data/tests/test_mongo.py, test_bigquery.py — partition
+planning + roundtrips with the vendor client mocked out).
+
+Fake clients exercise the REAL partition-planning and write paths; the
+vendor packages themselves are absent from this image, so the default
+factories' gating (actionable ImportError) is asserted too.
+"""
+
+import re
+import sqlite3
+
+import pytest
+
+from ray_tpu import data
+
+
+# ---------------------------------------------------------------------------
+# Fakes
+# ---------------------------------------------------------------------------
+
+class _FakeColl:
+    def __init__(self, store):
+        self.store = store
+
+    def count_documents(self, _filter):
+        return len(self.store)
+
+    def aggregate(self, stages):
+        rows = list(self.store)
+        for st in stages:
+            if "$skip" in st:
+                rows = rows[st["$skip"]:]
+            elif "$limit" in st:
+                rows = rows[:st["$limit"]]
+            elif "$match" in st:
+                rows = [r for r in rows
+                        if all(r.get(k) == v
+                               for k, v in st["$match"].items())]
+        return iter(rows)
+
+    def insert_many(self, rows):
+        self.store.extend(rows)
+
+
+class FakeMongoClient:
+    dbs: dict = {}
+
+    def __getitem__(self, db):
+        return {c: _FakeColl(s)
+                for c, s in self.dbs.setdefault(db, {}).items()} or \
+            _FakeDB(self.dbs[db])
+
+
+class _FakeDB:
+    def __init__(self, colls):
+        self.colls = colls
+
+    def __getitem__(self, coll):
+        return _FakeColl(self.colls.setdefault(coll, []))
+
+
+class FakeBQRow(dict):
+    pass
+
+
+class FakeBQJob:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def result(self):
+        return iter(self.rows)
+
+
+class FakeBQClient:
+    def __init__(self, table_rows):
+        self.table_rows = table_rows
+        self.loaded = []
+
+    def query(self, q):
+        if q.startswith("SELECT COUNT(*)"):
+            return FakeBQJob([FakeBQRow(n=len(self.table_rows))])
+        m = re.search(r"LIMIT (\d+) OFFSET (\d+)", q)
+        limit, offset = int(m.group(1)), int(m.group(2))
+        return FakeBQJob(
+            [FakeBQRow(r) for r in
+             self.table_rows[offset:offset + limit]])
+
+    def load_table_from_json(self, rows, _table):
+        self.loaded.extend(rows)
+        return FakeBQJob([])
+
+
+# ---------------------------------------------------------------------------
+# Mongo
+# ---------------------------------------------------------------------------
+
+class TestMongo:
+    def test_read_partitions_cover_collection(self, ray_start):
+        docs = [{"i": i, "v": i * i} for i in range(37)]
+        FakeMongoClient.dbs = {"db": {"c": list(docs)}}
+        ds = data.read_mongo("mongodb://x", "db", "c", parallelism=4,
+                             client_factory=FakeMongoClient)
+        got = sorted(ds.take_all(), key=lambda r: r["i"])
+        assert got == docs
+
+    def test_read_with_pipeline(self, ray_start):
+        FakeMongoClient.dbs = {"db": {"c": [{"i": i, "k": i % 2}
+                                            for i in range(10)]}}
+        ds = data.read_mongo("mongodb://x", "db", "c",
+                             pipeline=[{"$match": {"k": 1}}],
+                             parallelism=2,
+                             client_factory=FakeMongoClient)
+        assert all(r["k"] == 1 for r in ds.take_all())
+
+    def test_write_roundtrip(self, ray_start):
+        FakeMongoClient.dbs = {"db": {"out": []}}
+        ds = data.from_items([{"a": i} for i in range(8)])
+        counts = data.write_mongo(ds, "mongodb://x", "db", "out",
+                                  client_factory=FakeMongoClient)
+        assert sum(counts) == 8
+        assert len(FakeMongoClient.dbs["db"]["out"]) == 8
+
+    def test_missing_package_actionable(self):
+        src = data.MongoDatasource("mongodb://x", "db", "c")
+        with pytest.raises(ImportError, match="pymongo"):
+            src.get_read_tasks(2)
+
+
+# ---------------------------------------------------------------------------
+# BigQuery
+# ---------------------------------------------------------------------------
+
+class TestBigQuery:
+    def test_read_table_partitions(self, ray_start):
+        rows = [{"x": i} for i in range(23)]
+        client = FakeBQClient(rows)
+        ds = data.read_bigquery("proj", "d.t", parallelism=4,
+                                client_factory=lambda: client)
+        got = sorted(ds.take_all(), key=lambda r: r["x"])
+        assert got == rows
+
+    def test_read_query(self, ray_start):
+        client = FakeBQClient([{"x": 1}, {"x": 2}])
+        ds = data.read_bigquery("proj", query="SELECT x FROM t",
+                                parallelism=2,
+                                client_factory=lambda: client)
+        assert len(ds.take_all()) == 2
+
+    def test_write(self, ray_start):
+        client = FakeBQClient([])
+        ds = data.from_items([{"a": 1}, {"a": 2}])
+        data.write_bigquery(ds, "proj", "d.t",
+                            client_factory=lambda: client)
+        assert sorted(r["a"] for r in client.loaded) == [1, 2]
+
+    def test_requires_exactly_one_source(self):
+        with pytest.raises(ValueError):
+            data.BigQueryDatasource("p")
+        with pytest.raises(ValueError):
+            data.BigQueryDatasource("p", "d.t", query="SELECT 1")
+
+
+# ---------------------------------------------------------------------------
+# SQL write (REAL sqlite roundtrip through read_sql)
+# ---------------------------------------------------------------------------
+
+def test_write_sql_roundtrip(ray_start, tmp_path):
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE points (i INTEGER, v REAL)")
+    conn.commit()
+    conn.close()
+    ds = data.from_items([{"i": i, "v": i / 2} for i in range(16)])
+    counts = data.write_sql(ds, "points",
+                            lambda: sqlite3.connect(db))
+    assert sum(counts) == 16
+    back = data.read_sql("SELECT * FROM points ORDER BY i",
+                         lambda: sqlite3.connect(db))
+    rows = back.take_all()
+    assert len(rows) == 16 and rows[3]["v"] == 1.5
+
+
+# ---------------------------------------------------------------------------
+# Table formats
+# ---------------------------------------------------------------------------
+
+def test_read_delta_reads_current_files(ray_start, tmp_path):
+    import pandas as pd
+
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"part{i}.parquet")
+        pd.DataFrame({"i": [i * 10, i * 10 + 1]}).to_parquet(p)
+        paths.append(p)
+
+    class FakeDeltaTable:
+        def file_uris(self):
+            return paths
+
+    ds = data.read_delta("s3://t", table_factory=FakeDeltaTable)
+    got = sorted(r["i"] for r in ds.take_all())
+    assert got == [0, 1, 10, 11, 20, 21]
+
+
+def test_read_iceberg_plan_files(ray_start):
+    class FakeArrow:
+        def __init__(self, rows):
+            self.rows = rows
+
+        def to_pylist(self):
+            return self.rows
+
+    class FakeFileTask:
+        def __init__(self, rows):
+            self._rows = rows
+
+        def to_arrow(self):
+            return FakeArrow(self._rows)
+
+    class FakeScan:
+        def plan_files(self):
+            return [FakeFileTask([{"a": 1}]), FakeFileTask([{"a": 2}])]
+
+    class FakeTable:
+        def scan(self, row_filter=None):
+            return FakeScan()
+
+    class FakeCatalog:
+        def load_table(self, ident):
+            assert ident == "ns.tbl"
+            return FakeTable()
+
+    ds = data.read_iceberg("ns.tbl", catalog_factory=FakeCatalog)
+    assert sorted(r["a"] for r in ds.take_all()) == [1, 2]
+
+
+def test_read_clickhouse_partitions(ray_start):
+    rows = [(i, f"s{i}") for i in range(11)]
+
+    class FakeResult:
+        def __init__(self, rs):
+            self.column_names = ["i", "s"]
+            self.result_rows = rs
+
+    class FakeCH:
+        def command(self, q):
+            return len(rows)
+
+        def query(self, q):
+            m = re.search(r"LIMIT (\d+) OFFSET (\d+)", q)
+            lim, off = int(m.group(1)), int(m.group(2))
+            return FakeResult(rows[off:off + lim])
+
+    ds = data.read_clickhouse("t", "dsn", parallelism=3,
+                              client_factory=FakeCH)
+    assert sorted(r["i"] for r in ds.take_all()) == list(range(11))
+
+
+def test_read_snowflake_round_robin(ray_start):
+    class FakeCursor:
+        description = [("A",), ("B",)]
+
+        def execute(self, sql):
+            pass
+
+        def fetchall(self):
+            return [(i, i * 2) for i in range(9)]
+
+    class FakeConn:
+        def cursor(self):
+            return FakeCursor()
+
+        def close(self):
+            pass
+
+    ds = data.read_snowflake("SELECT * FROM t", {}, parallelism=3,
+                             connection_factory=FakeConn)
+    assert sorted(r["A"] for r in ds.take_all()) == list(range(9))
+
+
+def test_read_avro_gated(ray_start, tmp_path):
+    import ray_tpu
+
+    p = tmp_path / "x.avro"
+    p.write_bytes(b"Obj\x01")
+    # The read runs as a task; the gating ImportError surfaces through
+    # the task-error path with the actionable package name intact.
+    with pytest.raises((ImportError, ray_tpu.TaskError),
+                       match="fastavro"):
+        data.read_avro(str(p)).take_all()
