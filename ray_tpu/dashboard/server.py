@@ -403,6 +403,86 @@ class DashboardServer:
 
         r.add_get("/api/summary/{kind}", summary)
 
+        async def task_detail(request):
+            """Per-task drill-down (reference: dashboard task detail
+            page, modules/reporter): state-API row + this task's
+            timeline spans (start/end/duration/node)."""
+            from ..core.runtime import global_runtime
+
+            tid = request.match_info["task_id"]
+            rows = [t for t in state.list_tasks()
+                    if t.get("task_id", "").startswith(tid)]
+            spans = [e for e in global_runtime().timeline()
+                     if str(e.get("args", {}).get("task_id",
+                                                  "")).startswith(tid)
+                     or str(e.get("tid", "")).startswith(tid)]
+            if not rows and not spans:
+                raise web.HTTPNotFound()
+            return _json({"task": rows[0] if rows else None,
+                          "spans": spans})
+
+        r.add_get("/api/tasks/{task_id}", task_detail)
+
+        async def log_search(request):
+            """Substring search across this session's log files and
+            every daemon's remote logs (reference: dashboard log-viewer
+            search). Returns (file, line_no, line) matches, capped."""
+            q = request.query.get("q", "")
+            cap = min(int(request.query.get("max", "200")), 1000)
+            if not q:
+                return _json({"matches": []})
+            matches = []
+
+            def scan_text(source, text):
+                for i, line in enumerate(text.splitlines()):
+                    if q in line:
+                        matches.append({"file": source, "line": i + 1,
+                                        "text": line[:500]})
+                        if len(matches) >= cap:
+                            return True
+                return False
+
+            d = _session_logs_dir()
+            if d and os.path.isdir(d):
+                for name in sorted(os.listdir(d)):
+                    p = os.path.join(d, name)
+                    if not os.path.isfile(p):
+                        continue
+                    try:
+                        with open(p, "rb") as f:
+                            f.seek(0, os.SEEK_END)
+                            f.seek(max(0, f.tell() - (1 << 20)))
+                            text = f.read().decode("utf-8", "replace")
+                    except OSError:
+                        continue
+                    if scan_text(name, text):
+                        break
+            # Remote daemons' logs ride the dispatch protocol.
+            if len(matches) < cap:
+                for node in state.list_nodes():
+                    nid = node.get("node_id")
+                    rnode = _remote_node(nid) if nid else None
+                    if rnode is None:
+                        continue
+                    try:
+                        listing = await _daemon_call(
+                            rnode, {"type": "log_list"})
+                        for fi in listing.get("files", [])[:20]:
+                            reply = await _daemon_call(rnode, {
+                                "type": "log_tail",
+                                "name": fi["name"],
+                                "nbytes": 1 << 20})
+                            if scan_text(f"{nid[:8]}/{fi['name']}",
+                                         reply.get("data", "")):
+                                break
+                    except Exception:  # noqa: BLE001 - node gone
+                        continue
+                    if len(matches) >= cap:
+                        break
+            return _json({"matches": matches, "query": q})
+
+        r.add_get("/api/logs/search", log_search)
+
         async def kill_random_node(_request):
             # Chaos endpoint (reference: `ray kill-random-node`).
             from .._private.fault_injection import kill_random_node

@@ -492,3 +492,42 @@ def test_dashboard_cluster_node_stats_and_remote_logs():
             server.stop()
     finally:
         cluster.shutdown()
+
+
+def test_dashboard_task_detail_and_log_search(dashboard, ray_start):
+    """Drill-down endpoints (reference: dashboard task detail page +
+    log-viewer search, dashboard/modules/reporter)."""
+    import os
+
+    from ray_tpu._private import session as _session
+
+    ray = ray_start
+
+    @ray.remote
+    def traced():
+        return 1
+
+    ray.get(traced.remote())
+    tasks = _get(dashboard, "/api/tasks")
+    assert tasks, "no tasks listed"
+    tid = tasks[-1]["task_id"]
+    detail = _get(dashboard, f"/api/tasks/{tid}")
+    assert detail["task"] is not None or detail["spans"]
+    if detail["task"] is not None:
+        assert detail["task"]["task_id"] == tid
+
+    # Unknown id → 404, not a 500.
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(dashboard, "/api/tasks/ffffffffffffffff")
+    assert ei.value.code == 404
+
+    logs_dir = _session.logs_dir()
+    with open(os.path.join(logs_dir, "worker-42.out"), "w") as f:
+        f.write("alpha needle-xyz beta\nplain line\nneedle-xyz again\n")
+    res = _get(dashboard, "/api/logs/search?q=needle-xyz")
+    assert len(res["matches"]) == 2
+    assert res["matches"][0]["file"] == "worker-42.out"
+    assert "needle-xyz" in res["matches"][0]["text"]
+    assert _get(dashboard, "/api/logs/search?q=")["matches"] == []
