@@ -1,0 +1,136 @@
+"""Multi-client dispatch-plane scaling (VERDICT r4 #5).
+
+Reference bar: release_logs/2.9.0/microbenchmark.json publishes
+MULTI-CLIENT rows (24.3k tasks/s, 26.7k n:n actor calls/s on 64 cores);
+every repo number so far was single-driver. This bench runs the same
+shapes with N separate DRIVER PROCESSES joined to one real daemon
+plane (control-plane daemon + node-daemon OS processes) and records
+per-client and aggregate rates for N = 1, 2, 4 — the per-client
+degradation curve is the scaling story for the dispatch plane on this
+1-core box (clients, daemons, and workers all share one core, so the
+aggregate ceiling here is the core, not the protocol; the recorded
+curve shows how gracefully the plane shares it).
+
+Run: python bench_multiclient.py [--quick]
+Prints one JSON line per N; records scale_multiclient_* in
+BENCH_HISTORY.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_CHILD = r"""
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.getcwd())  # parent sets cwd to the repo root
+import ray_tpu as ray
+
+addr, n_tasks, n_calls = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+# num_cpus=0: this driver contributes no execution resources, so every
+# task goes through the daemon dispatch plane (the thing under test).
+ray.init(address=addr, num_cpus=0, num_tpus=0)
+
+@ray.remote
+def noop():
+    return None
+
+ray.get([noop.remote() for _ in range(16)])  # warm dispatch + workers
+t0 = time.perf_counter()
+ray.get([noop.remote() for _ in range(n_tasks)])
+task_dt = time.perf_counter() - t0
+
+@ray.remote
+class Echo:
+    def ping(self):
+        return None
+
+a = Echo.remote()
+ray.get(a.ping.remote())
+t0 = time.perf_counter()
+ray.get([a.ping.remote() for _ in range(n_calls)])
+act_dt = time.perf_counter() - t0
+print(json.dumps({"tasks_s": n_tasks / task_dt,
+                  "actor_calls_s": n_calls / act_dt}))
+"""
+
+
+def run_clients(addr: str, n_clients: int, n_tasks: int,
+                n_calls: int) -> dict:
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CHILD, addr, str(n_tasks), str(n_calls)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+        for _ in range(n_clients)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        line = out.strip().splitlines()[-1]
+        outs.append(json.loads(line))
+    return {
+        "clients": n_clients,
+        "agg_tasks_s": sum(o["tasks_s"] for o in outs),
+        "per_client_tasks_s": [round(o["tasks_s"], 1) for o in outs],
+        "agg_actor_calls_s": sum(o["actor_calls_s"] for o in outs),
+        "per_client_actor_calls_s": [round(o["actor_calls_s"], 1)
+                                     for o in outs],
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    n_tasks = 200 if args.quick else 2000
+    n_calls = 200 if args.quick else 2000
+
+    from ray_tpu.cluster_utils import RealCluster
+
+    cluster = RealCluster()
+    try:
+        for _ in range(2):
+            cluster.add_node(num_cpus=4)
+        base = None
+        for n in (1, 2, 4):
+            r = run_clients(cluster.address, n, n_tasks, n_calls)
+            if base is None:
+                base = r
+            # Degradation: per-client rate vs the single-client rate.
+            r["tasks_per_client_vs_1"] = round(
+                (r["agg_tasks_s"] / n) / base["agg_tasks_s"], 3)
+            r["actor_calls_per_client_vs_1"] = round(
+                (r["agg_actor_calls_s"] / n)
+                / base["agg_actor_calls_s"], 3)
+            print(json.dumps({
+                "metric": f"multiclient_{n}",
+                "value": round(r["agg_tasks_s"], 1),
+                "unit": "tasks/s", **{k: v for k, v in r.items()
+                                      if k != "clients"}}), flush=True)
+            try:
+                import bench
+
+                bench.push_history(
+                    f"scale_multiclient_{n}_tasks_s",
+                    r["agg_tasks_s"], "tasks/s", match={},
+                    extra={"per_client": r["per_client_tasks_s"],
+                           "vs_1client": r["tasks_per_client_vs_1"]})
+                bench.push_history(
+                    f"scale_multiclient_{n}_actor_calls_s",
+                    r["agg_actor_calls_s"], "calls/s", match={},
+                    extra={"per_client": r["per_client_actor_calls_s"],
+                           "vs_1client": r["actor_calls_per_client_vs_1"]})
+            except Exception:  # noqa: BLE001
+                pass
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()
