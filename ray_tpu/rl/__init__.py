@@ -12,6 +12,13 @@ Offline: BC and CQL over logged datasets.
 
 from .algorithm import Algorithm
 from .appo import APPO, APPOConfig
+from .bandit import (
+    BanditConfig,
+    BanditLinTS,
+    BanditLinUCB,
+    ContextualBanditEnv,
+    LinearBandit,
+)
 from .buffer import (
     PrioritizedReplayBuffer,
     ReplayBuffer,
@@ -26,6 +33,7 @@ from .continuous import (
     QSASpec,
     SACContinuous,
 )
+from .c51 import C51, C51Config, C51Spec
 from .dqn import DQN, DQNConfig
 from .r2d2 import R2D2, R2D2Config, RecurrentQSpec
 from .dreamer import Dreamer, DreamerConfig
@@ -63,10 +71,13 @@ __all__ = [
     "ContinuousEnvRunner", "MultiAgentEnvRunner",
     "MLPModuleSpec", "QMLPSpec", "GaussianPolicySpec", "QSASpec",
     "PPO", "PPOConfig", "GRPO", "GRPOConfig",
-    "DQN", "DQNConfig", "R2D2", "R2D2Config", "RecurrentQSpec",
+    "DQN", "DQNConfig", "C51", "C51Config", "C51Spec",
+    "R2D2", "R2D2Config", "RecurrentQSpec",
     "SAC", "SACConfig", "SACContinuous",
     "TD3", "DDPG", "ContinuousConfig", "IMPALA", "IMPALAConfig",
     "APPO", "APPOConfig", "MultiAgentPPO", "MultiAgentPPOConfig",
+    "BanditLinUCB", "BanditLinTS", "LinearBandit", "BanditConfig",
+    "ContextualBanditEnv",
     "BC", "BCConfig", "CQL", "CQLConfig", "OfflineDataset",
     "Dreamer", "DreamerConfig",
 ]

@@ -1,0 +1,168 @@
+"""C51 — categorical distributional DQN.
+
+Capability-equivalent of the reference's distributional DQN
+(reference: rllib/algorithms/dqn/dqn.py `num_atoms > 1` — the C51
+categorical return distribution with the Bellman-projected
+cross-entropy loss), re-designed TPU-first: the atom projection is a
+dense (B, N, N) einsum against a precomputed support-overlap kernel
+shape (no scatter; XLA fuses it into the loss), and the whole gradient
+phase (n_updates × minibatch) is one jitted `lax.scan` dispatch, as in
+dqn.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .dqn import DQN
+from .module import mlp_init, mlp_torso
+
+
+@dataclass(frozen=True)
+class C51Spec:
+    """Distributional Q-network: torso → per-action atom logits."""
+
+    observation_size: int
+    num_actions: int
+    num_atoms: int = 51
+    hidden: Tuple[int, ...] = (64, 64)
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        k_torso, k_q = jax.random.split(key)
+        sizes = (self.observation_size,) + tuple(self.hidden)
+        out = self.num_actions * self.num_atoms
+        return {
+            "torso": mlp_init(k_torso, sizes),
+            "z_w": jax.random.normal(
+                k_q, (sizes[-1], out), jnp.float32) * 0.01,
+            "z_b": jnp.zeros((out,), jnp.float32),
+        }
+
+    def logits(self, params, obs: jax.Array) -> jax.Array:
+        """obs (B, O) → atom logits (B, A, N)."""
+        h = mlp_torso(params["torso"], obs)
+        out = h @ params["z_w"] + params["z_b"]
+        return out.reshape(obs.shape[0], self.num_actions,
+                           self.num_atoms)
+
+    def apply(self, params, obs: jax.Array) -> jax.Array:
+        """Expected Q-values (B, A) — the greedy-policy view (lets the
+        shared epsilon-greedy EnvRunner path drive this spec)."""
+        probs = jax.nn.softmax(self.logits(params, obs), axis=-1)
+        z = jnp.linspace(self.v_min, self.v_max, self.num_atoms)
+        return jnp.einsum("ban,n->ba", probs, z)
+
+    # Set by C51Config plumbing (support bounds ride the spec so apply
+    # stays a pure function of params+obs).
+    v_min: float = -10.0
+    v_max: float = 10.0
+
+
+@dataclass(frozen=True)
+class C51Config:
+    env: Any = "CartPole"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 8
+    rollout_length: int = 32
+    buffer_capacity: int = 50_000
+    learning_starts: int = 1_000
+    batch_size: int = 128
+    updates_per_iteration: int = 16
+    gamma: float = 0.99
+    lr: float = 1e-3
+    target_update_interval: int = 4
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_iters: int = 30
+    num_atoms: int = 51
+    v_min: float = -10.0
+    v_max: float = 10.0
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+    train_iterations: int = 40
+
+    def with_overrides(self, **kw) -> "C51Config":
+        return replace(self, **kw)
+
+
+def bellman_project(z: jax.Array, gamma: float, v_min: float,
+                    v_max: float, rewards: jax.Array, dones: jax.Array,
+                    target_probs: jax.Array) -> jax.Array:
+    """Bellman-project a target distribution onto the fixed support
+    (C51 eq. 7) as a dense overlap product — scatter-free, so XLA keeps
+    it on the MXU path. Conserves probability mass (unit-tested
+    directly in tests/test_rl_c51.py)."""
+    dz = (v_max - v_min) / (z.shape[0] - 1)
+    tz = jnp.clip(rewards[:, None] + gamma
+                  * (1.0 - dones[:, None]) * z[None, :],
+                  v_min, v_max)                      # (B, N)
+    # overlap[b, i, j]: how much of target atom j lands on atom i.
+    w = jnp.clip(1.0 - jnp.abs(tz[:, None, :] - z[None, :, None])
+                 / dz, 0.0, 1.0)                     # (B, N, N)
+    return jnp.einsum("bij,bj->bi", w, target_probs)
+
+
+def make_c51_update(spec: C51Spec, cfg: C51Config):
+    opt = optax.adam(cfg.lr)
+    N = cfg.num_atoms
+    z = jnp.linspace(cfg.v_min, cfg.v_max, N)
+
+    def loss_fn(params, target_params, mb):
+        logits = spec.logits(params, mb["obs"])          # (B, A, N)
+        logp = jax.nn.log_softmax(
+            jnp.take_along_axis(
+                logits, mb["actions"][:, None, None].repeat(N, -1),
+                axis=1)[:, 0], axis=-1)                  # (B, N)
+        # Double-C51: online expectation picks a*, target supplies the
+        # distribution to project.
+        next_logits_on = spec.logits(params, mb["next_obs"])
+        q_next_on = jnp.einsum(
+            "ban,n->ba", jax.nn.softmax(next_logits_on, -1), z)
+        a_star = jnp.argmax(q_next_on, axis=-1)
+        next_logits_tg = spec.logits(target_params, mb["next_obs"])
+        p_next = jax.nn.softmax(jnp.take_along_axis(
+            next_logits_tg, a_star[:, None, None].repeat(N, -1),
+            axis=1)[:, 0], axis=-1)                      # (B, N)
+        m = jax.lax.stop_gradient(bellman_project(
+            z, cfg.gamma, cfg.v_min, cfg.v_max,
+            mb["rewards"], mb["dones"], p_next))
+        loss = -jnp.mean(jnp.sum(m * logp, axis=-1))
+        q_taken = jnp.einsum("bn,n->b", jnp.exp(logp), z)
+        return loss, {"ce_loss": loss, "q_mean": jnp.mean(q_taken)}
+
+    @jax.jit
+    def update(params, target_params, opt_state, batch, idx):
+        def one(carry, mb_idx):
+            params, opt_state = carry
+            mb = jax.tree.map(lambda x: x[mb_idx], batch)
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, mb)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), metrics
+
+        (params, opt_state), metrics = jax.lax.scan(
+            one, (params, opt_state), idx)
+        return params, opt_state, jax.tree.map(jnp.mean, metrics)
+
+    return opt, update
+
+
+class C51(DQN):
+    """Categorical distributional double-DQN over replay — the DQN
+    loop with the categorical spec + projected cross-entropy update."""
+
+    def _make_spec(self, probe):
+        cfg: C51Config = self.config
+        return C51Spec(
+            observation_size=probe.observation_size,
+            num_actions=probe.num_actions, num_atoms=cfg.num_atoms,
+            hidden=cfg.hidden, v_min=cfg.v_min, v_max=cfg.v_max)
+
+    def _make_update(self):
+        return make_c51_update(self.spec, self.config)

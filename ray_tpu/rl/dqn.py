@@ -93,21 +93,33 @@ def make_dqn_update(spec: QMLPSpec, cfg: DQNConfig):
 
 
 class DQN(Algorithm):
-    """Double DQN over epsilon-greedy EnvRunner actors + replay."""
+    """Double DQN over epsilon-greedy EnvRunner actors + replay.
+
+    Variants override _make_spec/_make_update (C51 swaps in the
+    categorical spec + projected cross-entropy) and inherit the whole
+    rollout/replay/train loop — one loop, no drift between variants.
+    """
+
+    def _make_spec(self, probe):
+        cfg = self.config
+        return QMLPSpec(observation_size=probe.observation_size,
+                        num_actions=probe.num_actions,
+                        hidden=cfg.hidden)
+
+    def _make_update(self):
+        return make_dqn_update(self.spec, self.config)
 
     def setup(self):
         import ray_tpu as ray
 
         cfg: DQNConfig = self.config
         probe = make_env(cfg.env)
-        self.spec = QMLPSpec(
-            observation_size=probe.observation_size,
-            num_actions=probe.num_actions, hidden=cfg.hidden)
+        self.spec = self._make_spec(probe)
         self._key = jax.random.key(cfg.seed)
         self._key, k = jax.random.split(self._key)
         self.params = self.spec.init(k)
         self.target_params = self.params
-        self.opt, self._update = make_dqn_update(self.spec, cfg)
+        self.opt, self._update = self._make_update()
         self.opt_state = self.opt.init(self.params)
         self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
 

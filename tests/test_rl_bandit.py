@@ -1,0 +1,86 @@
+"""Contextual bandit tests (reference coverage model:
+rllib/algorithms/bandit/tests/test_bandits.py — LinUCB/LinTS learn on
+a linear env; regret flattens)."""
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.rl import (
+    BanditConfig,
+    BanditLinTS,
+    BanditLinUCB,
+    ContextualBanditEnv,
+    LinearBandit,
+)
+
+
+@pytest.mark.parametrize("cls", [BanditLinUCB, BanditLinTS])
+def test_regret_flattens(cls):
+    """Per-step regret in late iterations must be far below the
+    uniform-random policy's (the model actually learned the arms)."""
+    algo = cls(BanditConfig(num_arms=5, context_dim=8,
+                            steps_per_iteration=64, seed=3))
+    results = algo.train(12)
+    early = results[0]["regret_per_step"]
+    late = np.mean([r["regret_per_step"] for r in results[-3:]])
+    assert late < early * 0.5, (early, late)
+    assert late < 0.25, f"late regret too high: {late}"
+
+
+def test_update_shifts_selection():
+    """Exact incremental update: after many rewards for arm 2 in a
+    fixed context direction, arm 2 wins that context."""
+    algo = LinearBandit(BanditConfig(num_arms=3, context_dim=4,
+                                     exploration="ucb", alpha=0.1))
+    x = np.array([1.0, 0, 0, 0], np.float32)
+    for _ in range(50):
+        algo.observe_reward(x, 2, 1.0)
+        algo.observe_reward(x, 0, 0.0)
+    assert algo.select_arm(x) == 2
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    algo = BanditLinUCB(BanditConfig(seed=1))
+    algo.train(3)
+    path = algo.save(str(tmp_path / "bandit"))
+    algo2 = BanditLinUCB(BanditConfig(seed=1))
+    algo2.restore(path)
+    assert algo2.total_pulls == algo.total_pulls
+    np.testing.assert_array_equal(np.asarray(algo.A),
+                                  np.asarray(algo2.A))
+    x = np.ones(8, np.float32)
+    assert algo.select_arm(x) == algo2.select_arm(x)
+
+
+def test_ts_explores_ucb_consistent():
+    """UCB with the same state is deterministic; TS samples (two keys
+    can disagree on a near-tie)."""
+    env = ContextualBanditEnv(num_arms=4, context_dim=6, seed=0)
+    ucb = BanditLinUCB(BanditConfig(
+        env=lambda: env, num_arms=4, context_dim=6))
+    x = np.ones(6, np.float32)
+    assert ucb.select_arm(x) == ucb.select_arm(x) or True  # no crash
+    a1 = [ucb.select_arm(x) for _ in range(5)]
+    assert len(set(a1)) == 1  # deterministic given unchanged state
+
+    ts = BanditLinTS(BanditConfig(
+        env=lambda: env, num_arms=4, context_dim=6, alpha=5.0))
+    picks = {ts.select_arm(x) for _ in range(30)}
+    assert len(picks) > 1  # posterior sampling varies on a fresh model
+
+
+def test_tune_integration(ray_start, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+
+    trainable = LinearBandit.as_trainable(
+        BanditConfig(steps_per_iteration=16, train_iterations=2))
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"alpha": tune.grid_search([0.5, 2.0])},
+        run_config=RunConfig(name="bandit-t",
+                             storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 2 and all(r.error is None for r in results)

@@ -1,0 +1,131 @@
+"""C51 distributional DQN tests (reference coverage model:
+rllib DQN num_atoms>1 tests — projection correctness + learning)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import C51, C51Config, C51Spec
+from ray_tpu.rl.c51 import bellman_project
+
+
+def _small(**kw):
+    base = dict(env="GridWorld", num_env_runners=1,
+                num_envs_per_runner=8, rollout_length=32,
+                hidden=(32,), learning_starts=256, batch_size=64,
+                updates_per_iteration=16, num_atoms=31,
+                v_min=-2.0, v_max=2.0, epsilon_decay_iters=10, seed=1)
+    base.update(kw)
+    return C51Config(**base)
+
+
+class TestProjection:
+    def test_projection_conserves_mass(self):
+        """The Bellman projection maps distributions to distributions:
+        output mass sums to 1 for every row, including rewards outside
+        the support (clipped) and terminal rows."""
+        z = jnp.linspace(-1.0, 1.0, 11)
+        rng = np.random.default_rng(0)
+        probs = rng.random((8, 11))
+        probs /= probs.sum(axis=1, keepdims=True)
+        out = bellman_project(
+            z, 0.9, -1.0, 1.0,
+            jnp.linspace(-2.0, 2.0, 8),      # incl. out-of-range
+            jnp.array([0., 1.] * 4),
+            jnp.asarray(probs, jnp.float32))
+        np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0,
+                                   rtol=1e-5)
+        assert np.all(np.asarray(out) >= -1e-7)
+
+    def test_terminal_projection_is_point_mass(self):
+        """done=1, reward exactly on an atom: all mass lands there."""
+        z = jnp.linspace(-1.0, 1.0, 5)
+        out = bellman_project(
+            z, 0.99, -1.0, 1.0, jnp.array([0.5]), jnp.array([1.0]),
+            jnp.full((1, 5), 0.2))
+        np.testing.assert_allclose(
+            np.asarray(out)[0], [0, 0, 0, 1, 0], atol=1e-6)
+
+    def test_distribution_normalized_after_projection(self):
+        """End-to-end loss path stays finite and in-support."""
+        from ray_tpu.rl.c51 import make_c51_update
+
+        spec = C51Spec(observation_size=2, num_actions=3,
+                       num_atoms=11, v_min=-1.0, v_max=1.0)
+        cfg = _small(num_atoms=11, v_min=-1.0, v_max=1.0, gamma=0.9)
+        opt, update = make_c51_update(spec, cfg)
+        k = jax.random.key(0)
+        params = spec.init(k)
+        batch = {
+            "obs": jnp.zeros((8, 2)), "next_obs": jnp.ones((8, 2)),
+            "actions": jnp.zeros((8,), jnp.int32),
+            "rewards": jnp.linspace(-2.0, 2.0, 8),  # incl. out-of-range
+            "dones": jnp.array([0., 1.] * 4),
+        }
+        idx = jnp.arange(8).reshape(1, 8)
+        p, _, metrics = update(params, params, opt.init(params),
+                               batch, idx)
+        assert np.isfinite(metrics["ce_loss"])
+        # The spec's expected-Q view stays within the support bounds.
+        q = spec.apply(p, jnp.zeros((4, 2)))
+        assert np.all(np.asarray(q) >= -1.0 - 1e-5)
+        assert np.all(np.asarray(q) <= 1.0 + 1e-5)
+
+    def test_terminal_projects_reward_only(self):
+        """done=1 → the target distribution is a point mass at the
+        clipped reward, independent of the next-state distribution."""
+        spec = C51Spec(observation_size=2, num_actions=2,
+                       num_atoms=5, v_min=-1.0, v_max=1.0)
+        from ray_tpu.rl.c51 import make_c51_update
+
+        cfg = _small(num_atoms=5, v_min=-1.0, v_max=1.0, gamma=0.99)
+        _, update = make_c51_update(spec, cfg)
+        # Internal projection check via the public loss: terminal at
+        # reward 0.5 must land mass on atoms 0.5 (exactly atom index 3
+        # of [-1,-0.5,0,0.5,1]); verified indirectly by finite loss and
+        # the q estimate moving toward 0.5 under repeated updates.
+        params = spec.init(jax.random.key(0))
+        import optax
+
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(params)
+        batch = {
+            "obs": jnp.zeros((16, 2)),
+            "next_obs": jnp.zeros((16, 2)),
+            "actions": jnp.zeros((16,), jnp.int32),
+            "rewards": jnp.full((16,), 0.5),
+            "dones": jnp.ones((16,)),
+        }
+        idx = jnp.tile(jnp.arange(16)[None], (200, 1))
+        params, _, _ = update(params, params, opt_state, batch, idx)
+        q = spec.apply(params, jnp.zeros((1, 2)))
+        assert abs(float(q[0, 0]) - 0.5) < 0.1
+
+
+class TestC51:
+    def test_learns_gridworld(self, ray_start):
+        algo = C51(_small())
+        rets = [algo.step()["episode_return_mean"] for _ in range(20)]
+        algo.stop()
+        tail = [r for r in rets[-3:] if r is not None]
+        assert tail and np.mean(tail) > 0.6
+
+    def test_checkpoint_roundtrip(self, ray_start, tmp_path):
+        cfg = _small(num_envs_per_runner=2, rollout_length=8,
+                     learning_starts=10_000)
+        algo = C51(cfg)
+        algo.step()
+        path = algo.save(str(tmp_path / "c51"))
+        algo2 = C51(cfg)
+        algo2.restore(path)
+        assert algo2.iteration == 1
+        a = jax.tree.leaves(algo.params)[0]
+        b = jax.tree.leaves(algo2.params)[0]
+        np.testing.assert_array_equal(a, b)
+        algo.stop(); algo2.stop()
+
+    def test_compute_single_action(self, ray_start):
+        algo = C51(_small(num_envs_per_runner=2, rollout_length=4))
+        a = algo.compute_single_action(np.zeros(2, np.float32))
+        assert 0 <= a < 4
+        algo.stop()
