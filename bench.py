@@ -662,7 +662,9 @@ def main() -> None:
     # ride-alongs run at their PINNED configs (seq=1024, 7-trial burst
     # protocol) regardless of --seq, or the bars silently stop applying.
     on_tpu = out.get("platform") not in ("cpu", None)
-    if on_tpu and not args.quick and args.model == "gpt2-125m":
+    if (on_tpu and not args.quick and args.model == "gpt2-125m"
+            and args.seq == 1024):  # the driver's default invocation;
+        # long-seq sweeps are their own measurement, not gate runs
         extras = []
         try:
             extras.append(bench_train(model="llama-654m", quick=False,

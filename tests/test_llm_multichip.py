@@ -67,6 +67,24 @@ def test_tp2_fsdp2_matches_single_chip(tiny_setup):
     assert "fsdp" in specs and "tp" in specs
 
 
+def test_llmserver_plan_builds_mesh(tiny_setup):
+    """The deployment-facing path: LLMServer(plan=...) builds its mesh
+    from visible devices and serves through the sharded engine."""
+    from ray_tpu.parallel import ParallelPlan
+    from ray_tpu.serve.llm import LLMServer
+
+    cfg, params, prompts = tiny_setup
+    srv = LLMServer(cfg, params, num_slots=4, max_seq_len=128,
+                    plan=ParallelPlan(tp=2))
+    try:
+        out = srv.generate(prompts[0], max_new_tokens=6)
+        assert len(out["tokens"]) == 6
+        assert srv.engine.mesh is not None
+        assert "tp" in str(srv.engine.cache.k.sharding.spec)
+    finally:
+        srv.engine.stop()
+
+
 def test_tp2_prefix_cache_matches(tiny_setup):
     """Registered-prefix suffix path under TP: same tokens as the
     single-chip engine serving the same prompts."""
