@@ -59,6 +59,7 @@ def _load():
         lib.rtp_wait.restype = ctypes.c_int
         lib.rtp_wait.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                  ctypes.c_int]
+        lib.rtp_cancel.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.rtp_stats.argtypes = [ctypes.c_void_p,
                                   ctypes.POINTER(ctypes.c_uint64),
                                   ctypes.POINTER(ctypes.c_uint64),
@@ -215,9 +216,13 @@ class PullManager:
     def wait(self, ticket: int, timeout_ms: int = -1) -> None:
         """Block until the ticketed transfer completes; raises
         TransferError (with the failure cause) on anything but
-        success."""
+        success. A timed-out wait CANCELS the ticket (the transfer
+        itself keeps running for any coalesced waiters) so abandoned
+        tickets cannot accumulate in a long-lived daemon."""
         rc = _load().rtp_wait(self._h, ticket, timeout_ms)
         if rc != 0:
+            if rc == -5:
+                _load().rtp_cancel(self._h, ticket)
             raise TransferError(
                 f"transfer failed: {_MGR_ERRORS.get(rc, rc)}")
 

@@ -266,6 +266,27 @@ class ActorState:
                 # with its returns forever pending.
                 if spec is not None:
                     self.redeliver_q.put(spec)
+                    # _death_done is set BEFORE the drain runs, so if
+                    # it is visible the drain may already have passed
+                    # redeliver_q — drain any leftovers here (pop
+                    # ownership is exclusive, so this never double-
+                    # stores against the real drain).
+                    with self._death_lock:
+                        death_done = self._death_done
+                    if death_done:
+                        while True:
+                            try:
+                                s2 = self.redeliver_q.get_nowait()
+                            except queue.Empty:
+                                break
+                            try:
+                                self.rt._store_error(
+                                    s2, self.death_cause
+                                    or ActorDiedError(
+                                        self.actor_id.hex()))
+                                self.rt._task_finished(s2)
+                            except BaseException as e:  # noqa: BLE001
+                                self.rt._fail_spec_internal(s2, e)
                 break
             try:
                 self._run_method(spec)

@@ -451,8 +451,19 @@ class DashboardServer:
                     try:
                         with open(p, "rb") as f:
                             f.seek(0, os.SEEK_END)
-                            f.seek(max(0, f.tell() - (1 << 20)))
-                            text = f.read().decode("utf-8", "replace")
+                            size = f.tell()
+                            truncated = size > (1 << 20)
+                            f.seek(max(0, size - (1 << 20)))
+                            raw = f.read()
+                        if truncated:
+                            # Drop the torn first line; line numbers
+                            # below are tail-relative, so label the
+                            # source accordingly instead of reporting
+                            # wrong absolute numbers.
+                            nl = raw.find(b"\n")
+                            raw = raw[nl + 1:] if nl >= 0 else raw
+                            name = f"{name} (last 1MiB)"
+                        text = raw.decode("utf-8", "replace")
                     except OSError:
                         continue
                     if scan_text(name, text):

@@ -44,16 +44,22 @@ def _require(pkg: str, feature: str):
 # ---------------------------------------------------------------------------
 
 class MongoDatasource(Datasource):
-    """Partitions a collection into skip/limit windows; each ReadTask
-    opens its own client (serializable plan, one connection per task)."""
+    """Partitions the PIPELINE OUTPUT into skip/limit windows over a
+    stable `$sort` (partitioning without a total order would let
+    separate executions hand different rows to different windows).
+    The row count is taken through the pipeline too, so expanding
+    stages ($unwind) and filters partition correctly. Each ReadTask
+    opens its own client (serializable plan, one connection/task)."""
 
     def __init__(self, uri: str, database: str, collection: str, *,
                  pipeline: Optional[List[Dict]] = None,
+                 sort_field: str = "_id",
                  client_factory: Optional[Callable[[], Any]] = None):
         self.uri = uri
         self.database = database
         self.collection = collection
         self.pipeline = pipeline or []
+        self.sort_field = sort_field
         self.client_factory = client_factory or (
             lambda: _require("pymongo", "read_mongo").MongoClient(uri))
 
@@ -63,15 +69,19 @@ class MongoDatasource(Datasource):
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         client = self.client_factory()
         coll = client[self.database][self.collection]
-        total = int(coll.count_documents({}))
+        counted = list(coll.aggregate(
+            list(self.pipeline) + [{"$count": "n"}]))
+        total = int(counted[0]["n"]) if counted else 0
         n = max(1, min(parallelism, total) if total else 1)
         per = (total + n - 1) // n if total else 0
+        order = ([{"$sort": {self.sort_field: 1}}]
+                 if self.sort_field else [])
 
         def make(skip: int, limit: int):
             def read():
                 c = self.client_factory()
                 cl = c[self.database][self.collection]
-                stages = list(self.pipeline) + [
+                stages = list(self.pipeline) + order + [
                     {"$skip": skip}, {"$limit": limit}]
                 return list(cl.aggregate(stages))
             return read
@@ -82,11 +92,12 @@ class MongoDatasource(Datasource):
 
 def read_mongo(uri: str, database: str, collection: str, *,
                pipeline: Optional[List[Dict]] = None,
-               parallelism: int = 8,
+               sort_field: str = "_id", parallelism: int = 8,
                client_factory: Optional[Callable[[], Any]] = None
                ) -> Dataset:
     return read_datasource(
         MongoDatasource(uri, database, collection, pipeline=pipeline,
+                        sort_field=sort_field,
                         client_factory=client_factory),
         parallelism=parallelism)
 
@@ -121,10 +132,17 @@ def write_mongo(ds: Dataset, uri: str, database: str, collection: str,
 # ---------------------------------------------------------------------------
 
 class BigQueryDatasource(Datasource):
-    """Row-range partitions over a table or query result."""
+    """Row-range partitions over a table or query result.
+
+    LIMIT/OFFSET partitioning is only sound over a TOTAL ORDER — the
+    engine documents result order as undefined otherwise, so separate
+    per-partition query executions could overlap or miss rows. With no
+    `order_by` the read degrades to ONE task (correct, unpartitioned);
+    pass order_by="<unique col>" to enable parallel partitions."""
 
     def __init__(self, project: str, dataset_table: Optional[str] = None,
                  *, query: Optional[str] = None,
+                 order_by: Optional[str] = None,
                  client_factory: Optional[Callable[[], Any]] = None):
         if (dataset_table is None) == (query is None):
             raise ValueError(
@@ -132,6 +150,7 @@ class BigQueryDatasource(Datasource):
         self.project = project
         self.dataset_table = dataset_table
         self.query = query
+        self.order_by = order_by
         self.client_factory = client_factory or (
             lambda: _require(
                 "google.cloud.bigquery", "read_bigquery"
@@ -144,6 +163,13 @@ class BigQueryDatasource(Datasource):
         return self.query or f"SELECT * FROM `{self.dataset_table}`"
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        if self.order_by is None:
+            def read_all():
+                c = self.client_factory()
+                return [dict(r)
+                        for r in c.query(self._base_query()).result()]
+
+            return [ReadTask(read_all)]
         client = self.client_factory()
         count_q = (f"SELECT COUNT(*) AS n FROM "
                    f"({self._base_query()})")
@@ -155,6 +181,7 @@ class BigQueryDatasource(Datasource):
             def read():
                 c = self.client_factory()
                 q = (f"SELECT * FROM ({self._base_query()}) "
+                     f"ORDER BY {self.order_by} "
                      f"LIMIT {limit} OFFSET {offset}")
                 return [dict(r) for r in c.query(q).result()]
             return read
@@ -164,10 +191,12 @@ class BigQueryDatasource(Datasource):
 
 
 def read_bigquery(project: str, dataset_table: Optional[str] = None, *,
-                  query: Optional[str] = None, parallelism: int = 8,
+                  query: Optional[str] = None,
+                  order_by: Optional[str] = None, parallelism: int = 8,
                   client_factory=None) -> Dataset:
     return read_datasource(
         BigQueryDatasource(project, dataset_table, query=query,
+                           order_by=order_by,
                            client_factory=client_factory),
         parallelism=parallelism)
 
@@ -327,8 +356,12 @@ def read_delta(table_uri: str, *, parallelism: int = 8,
 # ---------------------------------------------------------------------------
 
 def read_clickhouse(table: str, dsn: str, *, columns=None,
+                    order_by: Optional[str] = None,
                     parallelism: int = 8, client_factory=None
                     ) -> Dataset:
+    """LIMIT/OFFSET partitioning needs a total order (ClickHouse result
+    order is undefined without ORDER BY): pass order_by to parallelize;
+    without it the read is one correct unpartitioned task."""
     cols = ", ".join(columns) if columns else "*"
     factory = client_factory or (
         lambda: _require("clickhouse_connect", "read_clickhouse")
@@ -339,6 +372,15 @@ def read_clickhouse(table: str, dsn: str, *, columns=None,
             return f"clickhouse({table})"
 
         def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+            if order_by is None:
+                def read_all():
+                    c = factory()
+                    res = c.query(f"SELECT {cols} FROM {table}")
+                    names = res.column_names
+                    return [dict(zip(names, row))
+                            for row in res.result_rows]
+
+                return [ReadTask(read_all)]
             client = factory()
             total = int(client.command(
                 f"SELECT count() FROM {table}"))
@@ -349,6 +391,7 @@ def read_clickhouse(table: str, dsn: str, *, columns=None,
                 def read():
                     c = factory()
                     res = c.query(f"SELECT {cols} FROM {table} "
+                                  f"ORDER BY {order_by} "
                                   f"LIMIT {lim} OFFSET {off}")
                     names = res.column_names
                     return [dict(zip(names, row))
@@ -373,24 +416,24 @@ def read_snowflake(sql: str, connection_parameters: Dict[str, Any], *,
             return "snowflake"
 
         def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
-            # Snowflake cursors expose result batches; partition by
-            # fetching batches per task index round-robin.
-            def make(i, n):
-                def read():
-                    conn = factory()
-                    try:
-                        cur = conn.cursor()
-                        cur.execute(sql)
-                        cols = [d[0] for d in cur.description]
-                        rows = cur.fetchall()
-                    finally:
-                        conn.close()
-                    return [dict(zip(cols, r))
-                            for r in rows[i::n]]
-                return read
+            # ONE execution, one transfer: stride-slicing across n
+            # separate executions would depend on a row order the
+            # engine does not guarantee (and pay n full transfers).
+            # Result-batch partitioning (cursor.get_result_batches) is
+            # the parallel upgrade path when the vendor package is
+            # present.
+            def read():
+                conn = factory()
+                try:
+                    cur = conn.cursor()
+                    cur.execute(sql)
+                    cols = [d[0] for d in cur.description]
+                    rows = cur.fetchall()
+                finally:
+                    conn.close()
+                return [dict(zip(cols, r)) for r in rows]
 
-            n = max(1, parallelism)
-            return [ReadTask(make(i, n)) for i in range(n)]
+            return [ReadTask(read)]
 
     return read_datasource(_SF(), parallelism=parallelism)
 

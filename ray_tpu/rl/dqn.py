@@ -109,6 +109,10 @@ class DQN(Algorithm):
     def _make_update(self):
         return make_dqn_update(self.spec, self.config)
 
+    def _make_buffer(self):
+        cfg = self.config
+        return ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+
     def setup(self):
         import ray_tpu as ray
 
@@ -121,7 +125,7 @@ class DQN(Algorithm):
         self.target_params = self.params
         self.opt, self._update = self._make_update()
         self.opt_state = self.opt.init(self.params)
-        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self.buffer = self._make_buffer()
 
         from .env_runner import EnvRunner
         runner_cls = ray.remote(EnvRunner)

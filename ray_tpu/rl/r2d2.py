@@ -27,9 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from .algorithm import Algorithm
 from .buffer import SequenceReplayBuffer
-from .env import make_env
+from .dqn import DQN
 
 
 # ---------------------------------------------------------------------------
@@ -146,18 +145,31 @@ def make_r2d2_update(spec: RecurrentQSpec, cfg: R2D2Config):
         q_tg, _ = spec.unroll(target_params, h_tg, obs)
         qa = jnp.take_along_axis(q_on, acts[..., None], axis=-1)[..., 0]
         # Double-Q within the window: online argmax at t+1, target
-        # value. The window's final transition has no successor inside
-        # the window — mask it out of the loss.
+        # value.
         a_star = jnp.argmax(q_on[:, 1:], axis=-1)
         q_next = jnp.take_along_axis(
             q_tg[:, 1:], a_star[..., None], axis=-1)[..., 0]
         y = rews[:, :-1] + cfg.gamma * (1.0 - dones[:, :-1]) * \
             jax.lax.stop_gradient(q_next)
         err = qa[:, :-1] - y
-        huber = jnp.where(jnp.abs(err) < 1.0, 0.5 * err ** 2,
-                          jnp.abs(err) - 0.5)
-        loss = jnp.mean(huber)
-        return loss, {"td_loss": loss, "q_mean": jnp.mean(qa)}
+
+        def huber(e):
+            return jnp.where(jnp.abs(e) < 1.0, 0.5 * e ** 2,
+                             jnp.abs(e) - 0.5)
+
+        # Terminal grounding: the buffer's boundary-free sampling only
+        # ever places a done at the window's LAST position, and that
+        # transition has no in-window successor — dropping it outright
+        # would mean TERMINAL REWARDS NEVER ENTER ANY TARGET (fatal in
+        # sparse-reward envs where the only signal is at episode end).
+        # When done, its target needs no successor: y = r exactly.
+        last_mask = dones[:, -1]
+        h_last = huber(qa[:, -1] - rews[:, -1]) * last_mask
+        denom = err.size + jnp.maximum(jnp.sum(last_mask), 0.0)
+        loss = (jnp.sum(huber(err)) + jnp.sum(h_last)) \
+            / jnp.maximum(denom, 1.0)
+        return loss, {"td_loss": loss, "q_mean": jnp.mean(qa),
+                      "terminal_frac": jnp.mean(last_mask)}
 
     @jax.jit
     def update(params, target_params, opt_state, batch, idx):
@@ -179,42 +191,30 @@ def make_r2d2_update(spec: RecurrentQSpec, cfg: R2D2Config):
     return opt, update
 
 
-class R2D2(Algorithm):
-    """Recurrent double-DQN over sequence replay with stored state."""
+class R2D2(DQN):
+    """Recurrent double-DQN over sequence replay with stored state.
 
-    def setup(self):
-        import ray_tpu as ray
+    Inherits the DQN scaffold (setup/epsilon/checkpoint/stop via the
+    _make_spec/_make_update/_make_buffer hooks); only the genuinely
+    recurrent pieces — sequence collection, window-batch assembly, and
+    the stateful action API — are overridden.
+    """
 
+    def _make_spec(self, probe):
         cfg: R2D2Config = self.config
-        probe = make_env(cfg.env)
-        self.spec = RecurrentQSpec(
+        return RecurrentQSpec(
             observation_size=probe.observation_size,
             num_actions=probe.num_actions, hidden=cfg.hidden)
-        self._key = jax.random.key(cfg.seed)
-        self._key, k = jax.random.split(self._key)
-        self.params = self.spec.init(k)
-        self.target_params = self.params
-        self.opt, self._update = make_r2d2_update(self.spec, cfg)
-        self.opt_state = self.opt.init(self.params)
+
+    def _make_update(self):
+        return make_r2d2_update(self.spec, self.config)
+
+    def _make_buffer(self):
+        cfg: R2D2Config = self.config
         total_envs = cfg.num_env_runners * cfg.num_envs_per_runner
-        self.buffer = SequenceReplayBuffer(
+        return SequenceReplayBuffer(
             cfg.buffer_capacity_per_env, num_envs=total_envs,
             seq_len=cfg.seq_len, seed=cfg.seed)
-
-        from .env_runner import EnvRunner
-        runner_cls = ray.remote(EnvRunner)
-        self.runners = [
-            runner_cls.remote(cfg.env, self.spec,
-                              num_envs=cfg.num_envs_per_runner,
-                              seed=cfg.seed + 1000 * (i + 1))
-            for i in range(cfg.num_env_runners)]
-        self._ray = ray
-
-    def epsilon(self) -> float:
-        cfg = self.config
-        frac = min(1.0, self.iteration / max(1, cfg.epsilon_decay_iters))
-        return cfg.epsilon_start + frac * (cfg.epsilon_end
-                                           - cfg.epsilon_start)
 
     def training_step(self) -> Dict[str, Any]:
         cfg: R2D2Config = self.config
@@ -275,28 +275,9 @@ class R2D2(Algorithm):
             **metrics,
         }
 
-    def get_state(self):
-        return {"iteration": self.iteration,
-                "params": jax.device_get(self.params),
-                "target_params": jax.device_get(self.target_params),
-                "opt_state": jax.device_get(self.opt_state)}
-
-    def set_state(self, state):
-        self.iteration = state["iteration"]
-        self.params = state["params"]
-        self.target_params = state["target_params"]
-        self.opt_state = state["opt_state"]
-
     def compute_single_action(self, obs: np.ndarray, h=None):
         """Greedy action + next recurrent state (pass h across steps)."""
         if h is None:
             h = self.spec.init_state(1)
         q, h = self.spec.step(self.params, h, jnp.asarray(obs[None]))
         return int(jnp.argmax(q, axis=-1)[0]), h
-
-    def stop(self):
-        for r in self.runners:
-            try:
-                self._ray.kill(r)
-            except Exception:  # noqa: BLE001
-                pass

@@ -480,6 +480,12 @@ void finish_op_locked(PullMgr* m, PullOp* op, int status) {
   m->active_ops--;
   m->done_cv.notify_all();
   m->work_cv.notify_all();  // endpoint slot freed — re-run the pick
+  if (op->tickets.empty()) {
+    // Every waiter cancelled (rtp_cancel) while the op ran: nobody
+    // will ever rtp_wait it — free it now or it leaks for the
+    // manager's lifetime.
+    delete op;
+  }
 }
 
 void pull_worker(PullMgr* m) {
@@ -676,6 +682,27 @@ int rtp_wait(void* handle, uint64_t ticket, int timeout_ms) {
   return st;
 }
 
+// Abandon a ticket (e.g. after a wait timeout the caller will not
+// retry). The underlying transfer keeps running — other coalesced
+// waiters still get it — but this ticket's registration is dropped so
+// an abandoned op cannot accumulate for the manager's lifetime
+// (review r5: each timed-out wait leaked its op + ticket entry).
+void rtp_cancel(void* handle, uint64_t ticket) {
+  PullMgr* m = reinterpret_cast<PullMgr*>(handle);
+  std::lock_guard<std::mutex> lk(m->mu);
+  auto it = m->tickets.find(ticket);
+  if (it == m->tickets.end()) return;
+  PullOp* op = it->second;
+  m->tickets.erase(it);
+  auto& tk = op->tickets;
+  tk.erase(std::remove(tk.begin(), tk.end(), ticket), tk.end());
+  // Completed op with no waiters left: free now. A still-pending/
+  // running op stays — the worker's finish_op_locked frees it when it
+  // completes with no tickets (queued ops keep running: a coalesced
+  // submit may still attach before completion).
+  if (tk.empty() && op->status.load() != 1) delete op;
+}
+
 void rtp_stats(void* handle, uint64_t* inflight_bytes,
                uint64_t* queued, uint64_t* active) {
   PullMgr* m = reinterpret_cast<PullMgr*>(handle);
@@ -696,10 +723,16 @@ void rtp_stop(void* handle) {
   for (auto& w : m->workers) w.join();
   {
     std::unique_lock<std::mutex> lk(m->mu);
-    // Fail every queued (never-started) op so waiters unblock.
+    // Fail every queued (never-started) op so waiters unblock; a
+    // queued op whose waiters all cancelled has no owner left — free
+    // it here (it is not in the tickets map the sweep below walks).
     for (auto& kv : m->queues) {
       for (PullOp* op : kv.second) {
-        op->status.store(-6);
+        if (op->tickets.empty()) {
+          delete op;
+        } else {
+          op->status.store(-6);
+        }
       }
     }
     m->queues.clear();

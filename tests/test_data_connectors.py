@@ -37,6 +37,16 @@ class _FakeColl:
                 rows = [r for r in rows
                         if all(r.get(k) == v
                                for k, v in st["$match"].items())]
+            elif "$sort" in st:
+                for k, direction in reversed(list(st["$sort"].items())):
+                    rows = sorted(rows, key=lambda r: r.get(k, 0),
+                                  reverse=direction < 0)
+            elif "$unwind" in st:
+                field = st["$unwind"].lstrip("$")
+                rows = [{**r, field: item}
+                        for r in rows for item in r.get(field, [])]
+            elif "$count" in st:
+                rows = [{st["$count"]: len(rows)}]
         return iter(rows)
 
     def insert_many(self, rows):
@@ -81,10 +91,15 @@ class FakeBQClient:
         if q.startswith("SELECT COUNT(*)"):
             return FakeBQJob([FakeBQRow(n=len(self.table_rows))])
         m = re.search(r"LIMIT (\d+) OFFSET (\d+)", q)
+        if m is None:  # unpartitioned full read (no order_by)
+            return FakeBQJob([FakeBQRow(r) for r in self.table_rows])
         limit, offset = int(m.group(1)), int(m.group(2))
+        rows = self.table_rows
+        om = re.search(r"ORDER BY (\w+)", q)
+        if om:
+            rows = sorted(rows, key=lambda r: r[om.group(1)])
         return FakeBQJob(
-            [FakeBQRow(r) for r in
-             self.table_rows[offset:offset + limit]])
+            [FakeBQRow(r) for r in rows[offset:offset + limit]])
 
     def load_table_from_json(self, rows, _table):
         self.loaded.extend(rows)
@@ -97,7 +112,7 @@ class FakeBQClient:
 
 class TestMongo:
     def test_read_partitions_cover_collection(self, ray_start):
-        docs = [{"i": i, "v": i * i} for i in range(37)]
+        docs = [{"_id": i, "i": i, "v": i * i} for i in range(37)]
         FakeMongoClient.dbs = {"db": {"c": list(docs)}}
         ds = data.read_mongo("mongodb://x", "db", "c", parallelism=4,
                              client_factory=FakeMongoClient)
@@ -105,13 +120,27 @@ class TestMongo:
         assert got == docs
 
     def test_read_with_pipeline(self, ray_start):
-        FakeMongoClient.dbs = {"db": {"c": [{"i": i, "k": i % 2}
+        FakeMongoClient.dbs = {"db": {"c": [{"_id": i, "i": i,
+                                             "k": i % 2}
                                             for i in range(10)]}}
         ds = data.read_mongo("mongodb://x", "db", "c",
                              pipeline=[{"$match": {"k": 1}}],
                              parallelism=2,
                              client_factory=FakeMongoClient)
         assert all(r["k"] == 1 for r in ds.take_all())
+
+    def test_expanding_pipeline_covers_all_rows(self, ray_start):
+        """$unwind triples the row count; partition planning counts
+        through the pipeline, so every output row is read."""
+        FakeMongoClient.dbs = {"db": {"c": [
+            {"_id": i, "items": [3 * i, 3 * i + 1, 3 * i + 2]}
+            for i in range(10)]}}
+        ds = data.read_mongo("mongodb://x", "db", "c",
+                             pipeline=[{"$unwind": "$items"}],
+                             sort_field="items", parallelism=4,
+                             client_factory=FakeMongoClient)
+        got = sorted(r["items"] for r in ds.take_all())
+        assert got == list(range(30))
 
     def test_write_roundtrip(self, ray_start):
         FakeMongoClient.dbs = {"db": {"out": []}}
@@ -135,7 +164,8 @@ class TestBigQuery:
     def test_read_table_partitions(self, ray_start):
         rows = [{"x": i} for i in range(23)]
         client = FakeBQClient(rows)
-        ds = data.read_bigquery("proj", "d.t", parallelism=4,
+        ds = data.read_bigquery("proj", "d.t", order_by="x",
+                                parallelism=4,
                                 client_factory=lambda: client)
         got = sorted(ds.take_all(), key=lambda r: r["x"])
         assert got == rows
@@ -145,6 +175,7 @@ class TestBigQuery:
         ds = data.read_bigquery("proj", query="SELECT x FROM t",
                                 parallelism=2,
                                 client_factory=lambda: client)
+        # No order_by -> ONE correct unpartitioned task.
         assert len(ds.take_all()) == 2
 
     def test_write(self, ray_start):
@@ -252,12 +283,12 @@ def test_read_clickhouse_partitions(ray_start):
             lim, off = int(m.group(1)), int(m.group(2))
             return FakeResult(rows[off:off + lim])
 
-    ds = data.read_clickhouse("t", "dsn", parallelism=3,
-                              client_factory=FakeCH)
+    ds = data.read_clickhouse("t", "dsn", order_by="i",
+                              parallelism=3, client_factory=FakeCH)
     assert sorted(r["i"] for r in ds.take_all()) == list(range(11))
 
 
-def test_read_snowflake_round_robin(ray_start):
+def test_read_snowflake_single_correct_task(ray_start):
     class FakeCursor:
         description = [("A",), ("B",)]
 
@@ -274,6 +305,9 @@ def test_read_snowflake_round_robin(ray_start):
         def close(self):
             pass
 
+    # Stride-slicing across separate executions would depend on an
+    # unguaranteed row order; the read is one execution, every row
+    # exactly once.
     ds = data.read_snowflake("SELECT * FROM t", {}, parallelism=3,
                              connection_factory=FakeConn)
     assert sorted(r["A"] for r in ds.take_all()) == list(range(9))

@@ -125,3 +125,40 @@ def test_r2d2_tune_integration(ray_start, tmp_path):
     results = tuner.fit()
     assert len(results) == 2
     assert all(r.error is None for r in results)
+
+
+def test_terminal_reward_grounds_q(ray_start):
+    """Review r5: windows whose LAST transition is terminal must feed
+    that reward into the loss (the only grounded signal in sparse-
+    reward envs). With done at the window end and reward 1, repeated
+    updates pull Q(s_last, a_last) toward 1."""
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rl.r2d2 import (
+        R2D2Config,
+        RecurrentQSpec,
+        make_r2d2_update,
+    )
+
+    spec = RecurrentQSpec(observation_size=2, num_actions=2, hidden=8)
+    cfg = R2D2Config(seq_len=4, burn_in=0, gamma=0.99, lr=1e-2)
+    opt, update = make_r2d2_update(spec, cfg)
+    params = spec.init(jax.random.key(0))
+    B, L = 8, 4
+    batch = {
+        "obs": jnp.zeros((B, L, 2)),
+        "actions": jnp.zeros((B, L), jnp.int32),
+        "rewards": jnp.concatenate(
+            [jnp.zeros((B, L - 1)), jnp.ones((B, 1))], axis=1),
+        "dones": jnp.concatenate(
+            [jnp.zeros((B, L - 1)), jnp.ones((B, 1))], axis=1),
+        "h0": spec.init_state(B),
+    }
+    idx = jnp.tile(jnp.arange(B)[None], (150, 1))
+    params, _, m = update(params, params, opt.init(params), batch, idx)
+    assert float(m["terminal_frac"]) == 1.0
+    # Q at the terminal step approaches the terminal reward.
+    q, _ = spec.unroll(params, spec.init_state(1),
+                       jnp.zeros((1, L, 2)))
+    assert abs(float(q[0, -1, 0]) - 1.0) < 0.25
