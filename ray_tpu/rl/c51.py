@@ -82,6 +82,10 @@ class C51Config:
     num_atoms: int = 51
     v_min: float = -10.0
     v_max: float = 10.0
+    prioritized_replay: bool = False
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    per_beta_anneal_iters: int = 0
     hidden: Tuple[int, ...] = (64, 64)
     seed: int = 0
     train_iterations: int = 40
@@ -131,24 +135,29 @@ def make_c51_update(spec: C51Spec, cfg: C51Config):
         m = jax.lax.stop_gradient(bellman_project(
             z, cfg.gamma, cfg.v_min, cfg.v_max,
             mb["rewards"], mb["dones"], p_next))
-        loss = -jnp.mean(jnp.sum(m * logp, axis=-1))
+        ce = -jnp.sum(m * logp, axis=-1)             # per-sample CE
+        w = mb.get("w", jnp.ones_like(ce))           # PER weights
+        loss = jnp.mean(w * ce)
         q_taken = jnp.einsum("bn,n->b", jnp.exp(logp), z)
-        return loss, {"ce_loss": loss, "q_mean": jnp.mean(q_taken)}
+        return loss, ({"ce_loss": loss, "q_mean": jnp.mean(q_taken)},
+                      ce)
 
     @jax.jit
     def update(params, target_params, opt_state, batch, idx):
         def one(carry, mb_idx):
             params, opt_state = carry
             mb = jax.tree.map(lambda x: x[mb_idx], batch)
-            (loss, metrics), grads = jax.value_and_grad(
+            (loss, (metrics, ce)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, target_params, mb)
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return (params, opt_state), metrics
+            return (params, opt_state), (metrics, ce)
 
-        (params, opt_state), metrics = jax.lax.scan(
+        (params, opt_state), (metrics, ce) = jax.lax.scan(
             one, (params, opt_state), idx)
-        return params, opt_state, jax.tree.map(jnp.mean, metrics)
+        # Per-sample cross-entropy doubles as the PER priority signal
+        # (the distributional analog of |TD error|).
+        return params, opt_state, jax.tree.map(jnp.mean, metrics), ce
 
     return opt, update
 

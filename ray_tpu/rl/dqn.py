@@ -42,6 +42,12 @@ class DQNConfig:
     epsilon_end: float = 0.05
     epsilon_decay_iters: int = 30
     double_q: bool = True
+    prioritized_replay: bool = False    # PER (Schaul et al. 2016)
+    per_alpha: float = 0.6
+    per_beta: float = 0.4               # IS-correction start...
+    per_beta_anneal_iters: int = 0      # ...annealed linearly to 1.0
+                                        # over this many iterations
+                                        # (0 = stay at per_beta)
     hidden: Tuple[int, ...] = (64, 64)
     seed: int = 0
     train_iterations: int = 40          # used by as_trainable
@@ -67,27 +73,34 @@ def make_dqn_update(spec: QMLPSpec, cfg: DQNConfig):
         y = mb["rewards"] + cfg.gamma * (1.0 - mb["dones"]) * \
             jax.lax.stop_gradient(q_next)
         err = qa - y
-        # Huber loss (standard DQN stability choice).
-        loss = jnp.mean(jnp.where(jnp.abs(err) < 1.0,
-                                  0.5 * err ** 2, jnp.abs(err) - 0.5))
-        return loss, {"td_loss": loss, "q_mean": jnp.mean(qa)}
+        # Huber loss (standard DQN stability choice); "w" carries
+        # prioritized-replay importance weights when present.
+        huber = jnp.where(jnp.abs(err) < 1.0,
+                          0.5 * err ** 2, jnp.abs(err) - 0.5)
+        w = mb.get("w", jnp.ones_like(huber))
+        loss = jnp.mean(w * huber)
+        return loss, ({"td_loss": loss, "q_mean": jnp.mean(qa)},
+                      jnp.abs(err))
 
     @jax.jit
     def update(params, target_params, opt_state, batch, idx):
         """One device dispatch: scan over pre-sampled minibatch indices
-        idx (n_updates, batch_size)."""
+        idx (n_updates, batch_size). Returns per-sample |TD error|
+        (n_updates, batch_size) alongside the mean metrics — the
+        prioritized buffer's fresh priorities."""
         def one(carry, mb_idx):
             params, opt_state = carry
             mb = jax.tree.map(lambda x: x[mb_idx], batch)
-            (loss, metrics), grads = jax.value_and_grad(
+            (loss, (metrics, td_abs)), grads = jax.value_and_grad(
                 td_loss, has_aux=True)(params, target_params, mb)
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return (params, opt_state), metrics
+            return (params, opt_state), (metrics, td_abs)
 
-        (params, opt_state), metrics = jax.lax.scan(
+        (params, opt_state), (metrics, td_abs) = jax.lax.scan(
             one, (params, opt_state), idx)
-        return params, opt_state, jax.tree.map(jnp.mean, metrics)
+        return params, opt_state, jax.tree.map(jnp.mean, metrics), \
+            td_abs
 
     return opt, update
 
@@ -111,6 +124,12 @@ class DQN(Algorithm):
 
     def _make_buffer(self):
         cfg = self.config
+        if getattr(cfg, "prioritized_replay", False):
+            from .buffer import PrioritizedReplayBuffer
+
+            return PrioritizedReplayBuffer(
+                cfg.buffer_capacity, alpha=cfg.per_alpha,
+                beta=cfg.per_beta, seed=cfg.seed)
         return ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
 
     def setup(self):
@@ -164,12 +183,33 @@ class DQN(Algorithm):
         if len(self.buffer) >= max(cfg.learning_starts, cfg.batch_size):
             t1 = time.perf_counter()
             n = cfg.updates_per_iteration
-            sample = self.buffer.sample(n * cfg.batch_size)
+            from .buffer import PrioritizedReplayBuffer
+
+            per = isinstance(self.buffer, PrioritizedReplayBuffer)
+            per_idx = None
+            if per:
+                # Anneal the IS correction toward 1.0 (Schaul et al.:
+                # the bias correction must be full near convergence).
+                if cfg.per_beta_anneal_iters > 0:
+                    frac = min(1.0, self.iteration
+                               / cfg.per_beta_anneal_iters)
+                    self.buffer.beta = (cfg.per_beta
+                                        + frac * (1.0 - cfg.per_beta))
+                sample, per_idx, is_w = self.buffer.sample(
+                    n * cfg.batch_size)
+                sample = {**sample, "w": is_w}
+            else:
+                sample = self.buffer.sample(n * cfg.batch_size)
             idx = jnp.arange(n * cfg.batch_size).reshape(n, cfg.batch_size)
             batch = jax.tree.map(jnp.asarray, sample)
-            self.params, self.opt_state, m = self._update(
+            self.params, self.opt_state, m, td_abs = self._update(
                 self.params, self.target_params, self.opt_state,
                 batch, idx)
+            if per_idx is not None:
+                # idx sliced the sample contiguously, so the flattened
+                # (n, B) errors align 1:1 with the buffer indices.
+                self.buffer.update_priorities(
+                    per_idx, np.asarray(td_abs).reshape(-1))
             metrics = {k: float(v) for k, v in m.items()}
             train_s = time.perf_counter() - t1
             if (self.iteration + 1) % cfg.target_update_interval == 0:

@@ -63,8 +63,8 @@ class TestProjection:
             "dones": jnp.array([0., 1.] * 4),
         }
         idx = jnp.arange(8).reshape(1, 8)
-        p, _, metrics = update(params, params, opt.init(params),
-                               batch, idx)
+        p, _, metrics, _ = update(params, params, opt.init(params),
+                                  batch, idx)
         assert np.isfinite(metrics["ce_loss"])
         # The spec's expected-Q view stays within the support bounds.
         q = spec.apply(p, jnp.zeros((4, 2)))
@@ -97,7 +97,7 @@ class TestProjection:
             "dones": jnp.ones((16,)),
         }
         idx = jnp.tile(jnp.arange(16)[None], (200, 1))
-        params, _, _ = update(params, params, opt_state, batch, idx)
+        params, _, _, _ = update(params, params, opt_state, batch, idx)
         q = spec.apply(params, jnp.zeros((1, 2)))
         assert abs(float(q[0, 0]) - 0.5) < 0.1
 

@@ -73,9 +73,10 @@ class TestDQN:
             "rewards": jnp.ones((4,)), "dones": jnp.zeros((4,)),
         }
         idx = jnp.arange(4).reshape(1, 4)
-        p, _, metrics = update(online, target, opt.init(online),
-                               batch, idx)
+        p, _, metrics, td_abs = update(online, target, opt.init(online),
+                                       batch, idx)
         assert np.isfinite(metrics["td_loss"])
+        assert td_abs.shape == (1, 4)
 
     def test_compute_single_action(self, ray_start):
         algo = DQN(_small_dqn(num_envs_per_runner=2, rollout_length=4))
@@ -249,3 +250,57 @@ class TestIMPALA:
         b = jax.tree.leaves(algo2.params)[0]
         np.testing.assert_array_equal(a, b)
         algo.stop(); algo2.stop()
+
+
+class TestPrioritizedDQN:
+    def test_per_learns_and_updates_priorities(self, ray_start):
+        """DQN with prioritized replay: learns GridWorld, and the
+        buffer's priorities move off their insert default as TD errors
+        feed back (the PER loop is live, not decorative)."""
+        from ray_tpu.rl.buffer import PrioritizedReplayBuffer
+
+        # PER reshapes the sampling distribution; the uniform-replay
+        # lr is too hot for it here — 1e-3 with more updates is the
+        # stable point from a config scan.
+        algo = DQN(_small_dqn(prioritized_replay=True, lr=1e-3,
+                              updates_per_iteration=16))
+        assert isinstance(algo.buffer, PrioritizedReplayBuffer)
+        rets = [algo.step()["episode_return_mean"] for _ in range(20)]
+        pr = algo.buffer._priorities[:algo.buffer._size]
+        algo.stop()
+        tail = [r for r in rets[-3:] if r is not None]
+        assert tail and np.mean(tail) > 0.6
+        # Sampled-and-trained transitions carry fresh |TD| priorities.
+        assert len(np.unique(np.round(pr, 6))) > 2
+
+    def test_per_c51_smoke(self, ray_start):
+        """C51 + prioritized replay composes (per-sample CE is the
+        priority signal)."""
+        from ray_tpu.rl import C51, C51Config
+
+        algo = C51(C51Config(
+            env="GridWorld", num_env_runners=1, num_envs_per_runner=4,
+            rollout_length=16, hidden=(16,), learning_starts=64,
+            batch_size=32, updates_per_iteration=2, num_atoms=11,
+            v_min=-2.0, v_max=2.0, prioritized_replay=True, seed=0))
+        res = None
+        for _ in range(4):
+            res = algo.step()
+        algo.stop()
+        assert np.isfinite(res["ce_loss"])
+
+    def test_per_beta_anneals(self, ray_start):
+        """per_beta_anneal_iters walks the IS correction toward 1.0."""
+        algo = DQN(_small_dqn(prioritized_replay=True,
+                              per_beta_anneal_iters=4,
+                              learning_starts=64, batch_size=32,
+                              updates_per_iteration=2,
+                              num_envs_per_runner=4,
+                              rollout_length=16))
+        betas = []
+        for _ in range(5):
+            algo.step()
+            betas.append(algo.buffer.beta)
+        algo.stop()
+        assert betas[-1] == 1.0
+        assert betas[0] < betas[-1]
