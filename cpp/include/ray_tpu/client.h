@@ -17,6 +17,7 @@
 #define RAY_TPU_CLIENT_H_
 
 #include <array>
+#include <condition_variable>
 #include <cstdint>
 #include <deque>
 #include <map>
@@ -179,10 +180,18 @@ class TaskClient {
  private:
   std::string Roundtrip(const std::string& json_msg);
   uint64_t SendAsync(const std::string& json_msg);
-  void ReadOneResponse();  // assigns to the oldest in-flight ticket
+  // Reads one length-prefixed reply frame off the socket. Called with
+  // mu_ RELEASED — rx_busy_ makes the caller the sole reader, so two
+  // threads never interleave partial frames.
+  std::string ReadFrame();
 
   int fd_;
   std::mutex mu_;
+  // Designated-reader handoff: exactly one waiter reads the socket
+  // with mu_ dropped (rx_busy_ set); the rest sleep on cv_ and
+  // re-check done_ whenever a reply is published.
+  std::condition_variable cv_;
+  bool rx_busy_ = false;
   uint64_t next_ticket_ = 1;
   std::deque<uint64_t> inflight_;               // send order = reply order
   std::map<uint64_t, std::pair<bool, std::string>> done_;  // ok, payload

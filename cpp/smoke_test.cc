@@ -6,9 +6,11 @@
 //
 // Prints "OK <detail>" lines; any failure throws and exits nonzero.
 
+#include <atomic>
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <thread>
 
 #include "ray_tpu/client.h"
 
@@ -69,6 +71,56 @@ int main(int argc, char** argv) {
       }
     }
     std::printf("OK pipelined=13\n");
+    return 0;
+  }
+
+  if (mode == "tasks-threaded") {
+    // One TaskClient shared by several threads, each pipelining its
+    // own submissions and claiming its own tickets. Exercises the
+    // designated-reader Wait: whichever thread holds the socket
+    // publishes replies for everyone; the others sleep on the cv
+    // until their ticket lands in done_.
+    ray_tpu::TaskClient tasks(host, port);
+    const int kThreads = 4;
+    const int kPerThread = 8;
+    std::atomic<int> failures{0};
+    std::vector<std::thread> threads;
+    for (int t = 0; t < kThreads; t++) {
+      threads.emplace_back([&tasks, &failures, t]() {
+        try {
+          std::vector<uint64_t> tickets;
+          for (int i = 0; i < kPerThread; i++) {
+            int k = t * kPerThread + i;
+            char args[32];
+            std::snprintf(args, sizeof(args), "[%d, %d]", 3 * k,
+                          4 * k);
+            tickets.push_back(
+                tasks.SubmitPyTaskAsync("math.hypot", args));
+          }
+          // Claim newest-first so most waits target a ticket BEHIND
+          // the socket's reply cursor — the waiter must drain other
+          // threads' replies (or sleep while another thread does).
+          for (int i = kPerThread - 1; i >= 0; i--) {
+            int k = t * kPerThread + i;
+            std::string got = tasks.Wait(tickets[i]);
+            char expect[32];
+            std::snprintf(expect, sizeof(expect), "%.1f", 5.0 * k);
+            if (got != expect) {
+              std::fprintf(stderr, "thread %d ticket %d: %s != %s\n",
+                           t, i, got.c_str(), expect);
+              failures++;
+              return;
+            }
+          }
+        } catch (const std::exception& e) {
+          std::fprintf(stderr, "thread %d: %s\n", t, e.what());
+          failures++;
+        }
+      });
+    }
+    for (auto& th : threads) th.join();
+    if (failures.load() != 0) return 1;
+    std::printf("OK threaded=%d\n", kThreads * kPerThread);
     return 0;
   }
 

@@ -613,9 +613,11 @@ uint64_t TaskClient::SendAsync(const std::string& json_msg) {
   return t;
 }
 
-void TaskClient::ReadOneResponse() {
-  // Caller holds mu_. Responses arrive in submission order; this one
-  // belongs to the oldest in-flight ticket.
+std::string TaskClient::ReadFrame() {
+  // Called with mu_ RELEASED; rx_busy_ guarantees a single reader, so
+  // the two recv loops below never interleave with another thread's.
+  // Dropping the mutex here is what lets other threads keep
+  // pipelining SendAsync() while one waiter blocks in recv.
   uint8_t rh[8];
   size_t got = 0;
   while (got < 8) {
@@ -633,19 +635,11 @@ void TaskClient::ReadOneResponse() {
     if (r <= 0) throw Error("daemon connection closed");
     got += static_cast<size_t>(r);
   }
-  if (inflight_.empty())
-    throw Error("daemon reply with no in-flight request");
-  uint64_t t = inflight_.front();
-  inflight_.pop_front();
-  std::string err = JsonField(resp, "error");
-  if (err != "__none__" && err != "null")
-    done_[t] = {false, "remote task failed: " + err};
-  else
-    done_[t] = {true, JsonField(resp, "result")};
+  return resp;
 }
 
 std::string TaskClient::Wait(uint64_t ticket) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::unique_lock<std::mutex> lk(mu_);
   for (;;) {
     auto it = done_.find(ticket);
     if (it != done_.end()) {
@@ -655,13 +649,45 @@ std::string TaskClient::Wait(uint64_t ticket) {
       return r.second;
     }
     // A ticket that is neither done nor in flight (double-claimed or
-    // never issued) can never resolve — waiting would block in recv
-    // forever with the client mutex held.
+    // never issued) can never resolve — waiting would never return.
     if (ticket >= next_ticket_ ||
         std::find(inflight_.begin(), inflight_.end(), ticket) ==
             inflight_.end())
       throw Error("unknown or already-claimed ticket");
-    ReadOneResponse();
+    if (rx_busy_) {
+      // Another waiter owns the socket; it publishes into done_ and
+      // notifies after every frame (including on error, where it
+      // clears rx_busy_ so a survivor can take over the read side).
+      cv_.wait(lk);
+      continue;
+    }
+    rx_busy_ = true;
+    lk.unlock();
+    std::string resp;
+    try {
+      resp = ReadFrame();
+    } catch (...) {
+      lk.lock();
+      rx_busy_ = false;
+      cv_.notify_all();
+      throw;
+    }
+    lk.lock();
+    rx_busy_ = false;
+    // Responses arrive in submission order; this frame belongs to the
+    // oldest in-flight ticket.
+    if (inflight_.empty()) {
+      cv_.notify_all();
+      throw Error("daemon reply with no in-flight request");
+    }
+    uint64_t t = inflight_.front();
+    inflight_.pop_front();
+    std::string err = JsonField(resp, "error");
+    if (err != "__none__" && err != "null")
+      done_[t] = {false, "remote task failed: " + err};
+    else
+      done_[t] = {true, JsonField(resp, "result")};
+    cv_.notify_all();
   }
 }
 

@@ -1,0 +1,61 @@
+"""Reader/writer guard for native-handle lifecycles.
+
+Every ctypes wrapper in ``_native`` hands a raw pointer (``self._h``)
+into C calls. Teardown (``stop``/``close``) frees that pointer; a
+concurrent call racing the teardown dereferences freed memory inside
+the native library (ADVICE.md finding 1 — the ``rtp_stop`` /
+``rtp_wait`` race). The fix is a tiny reader/writer lock:
+
+- every native call takes the **read** side (many may run at once —
+  the native layer is internally thread-safe while the handle lives);
+- teardown takes the **write** side, so it waits for in-flight calls
+  to drain and blocks new ones while it frees and nulls the handle.
+
+Writers are preferred: once a teardown is waiting, new readers queue
+behind it, so a steady stream of calls cannot starve shutdown.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class HandleGuard:
+    """``with guard.read():`` around handle use, ``with
+    guard.write():`` around teardown."""
+
+    def __init__(self):
+        self._cond = threading.Condition(threading.Lock())
+        self._readers = 0
+        self._writing = False
+        self._writers_waiting = 0
+
+    @contextmanager
+    def read(self):
+        with self._cond:
+            while self._writing or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._readers -= 1
+                if self._readers == 0:
+                    self._cond.notify_all()
+
+    @contextmanager
+    def write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            while self._writing or self._readers:
+                self._cond.wait()
+            self._writers_waiting -= 1
+            self._writing = True
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._writing = False
+                self._cond.notify_all()

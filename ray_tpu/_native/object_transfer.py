@@ -12,8 +12,10 @@ from __future__ import annotations
 
 import ctypes
 import os
+import time
 from typing import Optional
 
+from .handle_guard import HandleGuard
 from .shm_store import ID_LEN
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__),
@@ -129,19 +131,24 @@ class TransferClient:
 
     def __init__(self, host: str, port: int, local_shm_name: str):
         lib = _load()
+        self._lock = HandleGuard()
         self._conn = lib.rto_connect(host.encode(), port)
         if not self._conn:
             raise TransferError(f"cannot connect to {host}:{port}")
         self._store = lib.rts_connect(local_shm_name.encode(), 0, 0)
         if not self._store:
             lib.rto_close(self._conn)
+            self._conn = None
             raise TransferError(f"cannot attach arena {local_shm_name}")
 
     def pull(self, object_id: bytes) -> bool:
         """Fetch the object from the peer into the local arena.
         True = transferred; False = already present locally."""
-        rc = _load().rto_pull(self._conn, self._store,
-                              _check_id(object_id))
+        with self._lock.read():
+            if not self._conn:
+                raise TransferError("client closed")
+            rc = _load().rto_pull(self._conn, self._store,
+                                  _check_id(object_id))
         if rc == 0:
             return True
         if rc == -4:
@@ -151,20 +158,26 @@ class TransferClient:
 
     def push(self, object_id: bytes) -> None:
         """Send a local object to the peer (idempotent on the peer)."""
-        rc = _load().rto_push(self._conn, self._store,
-                              _check_id(object_id))
+        with self._lock.read():
+            if not self._conn:
+                raise TransferError("client closed")
+            rc = _load().rto_push(self._conn, self._store,
+                                  _check_id(object_id))
         if rc != 0:
             raise TransferError(
                 f"push failed: {_ERRORS.get(rc, rc)}")
 
     def close(self) -> None:
         lib = _load()
-        if self._conn:
-            lib.rto_close(self._conn)
-            self._conn = None
-        if self._store:
-            lib.rts_disconnect(self._store)
-            self._store = None
+        # Write side: wait out in-flight pull/push before freeing the
+        # native connection/arena handles they dereference.
+        with self._lock.write():
+            if self._conn:
+                lib.rto_close(self._conn)
+                self._conn = None
+            if self._store:
+                lib.rts_disconnect(self._store)
+                self._store = None
 
 
 _MGR_ERRORS = {
@@ -197,21 +210,45 @@ class PullManager:
             raise TransferError(
                 "libobject_transfer.so predates the pull manager — "
                 "rebuild with `make -C src`")
+        # rtp_stop deletes the native manager after draining only the
+        # waiters already inside rtp_wait; a Python thread entering any
+        # rtp_* concurrently with stop() would dereference freed memory
+        # (ADVICE.md finding 1). Reader/writer guard: every native call
+        # takes the read side, stop() takes the write side before
+        # nulling the handle.
+        self._lock = HandleGuard()
         self._h = lib.rtp_start(local_shm_name.encode(), budget_bytes,
                                 workers, timeout_ms, retries)
         if not self._h:
             raise TransferError(
                 f"cannot start pull manager on {local_shm_name}")
 
+    def _handle(self) -> int:
+        # Callers hold self._lock.read().
+        if not self._h:
+            raise TransferError(
+                f"transfer failed: {_MGR_ERRORS[-6]}")
+        return self._h
+
     def submit_pull(self, requester: int, host: str, port: int,
                     object_id: bytes) -> int:
-        return _load().rtp_submit(self._h, requester, host.encode(),
-                                  port, _check_id(object_id), 0)
+        with self._lock.read():
+            return _load().rtp_submit(self._handle(), requester,
+                                      host.encode(), port,
+                                      _check_id(object_id), 0)
 
     def submit_push(self, requester: int, host: str, port: int,
                     object_id: bytes) -> int:
-        return _load().rtp_submit(self._h, requester, host.encode(),
-                                  port, _check_id(object_id), 1)
+        with self._lock.read():
+            return _load().rtp_submit(self._handle(), requester,
+                                      host.encode(), port,
+                                      _check_id(object_id), 1)
+
+    # One native wait slice. Short on purpose: a waiter must not pin
+    # the read side of the teardown guard for an unbounded time, or
+    # stop()'s write acquisition (which is what wakes native waiters
+    # via rtp_stop) could never proceed.
+    _WAIT_SLICE_MS = 50
 
     def wait(self, ticket: int, timeout_ms: int = -1) -> None:
         """Block until the ticketed transfer completes; raises
@@ -219,10 +256,23 @@ class PullManager:
         success. A timed-out wait CANCELS the ticket (the transfer
         itself keeps running for any coalesced waiters) so abandoned
         tickets cannot accumulate in a long-lived daemon."""
-        rc = _load().rtp_wait(self._h, ticket, timeout_ms)
+        deadline = (None if timeout_ms < 0
+                    else time.monotonic() + timeout_ms / 1000.0)
+        while True:
+            if deadline is None:
+                chunk = self._WAIT_SLICE_MS
+            else:
+                remaining = int((deadline - time.monotonic()) * 1000)
+                chunk = max(0, min(self._WAIT_SLICE_MS, remaining))
+            with self._lock.read():
+                rc = _load().rtp_wait(self._handle(), ticket, chunk)
+                if rc == -5 and (deadline is not None
+                                 and time.monotonic() >= deadline):
+                    _load().rtp_cancel(self._h, ticket)
+                    break
+            if rc != -5:
+                break  # completed (or failed) within this slice
         if rc != 0:
-            if rc == -5:
-                _load().rtp_cancel(self._h, ticket)
             raise TransferError(
                 f"transfer failed: {_MGR_ERRORS.get(rc, rc)}")
 
@@ -235,12 +285,19 @@ class PullManager:
         inflight = ctypes.c_uint64()
         queued = ctypes.c_uint64()
         active = ctypes.c_uint64()
-        _load().rtp_stats(self._h, ctypes.byref(inflight),
-                          ctypes.byref(queued), ctypes.byref(active))
+        with self._lock.read():
+            _load().rtp_stats(self._handle(), ctypes.byref(inflight),
+                              ctypes.byref(queued),
+                              ctypes.byref(active))
         return {"inflight_bytes": inflight.value,
                 "queued": queued.value, "active": active.value}
 
     def stop(self) -> None:
-        if self._h:
-            _load().rtp_stop(self._h)
-            self._h = None
+        # Write side: drains in-flight rtp_* readers (rtp_wait itself
+        # returns -6 once the native side starts stopping, so readers
+        # cannot hold the guard forever) and blocks new ones while the
+        # native manager is freed.
+        with self._lock.write():
+            if self._h:
+                _load().rtp_stop(self._h)
+                self._h = None

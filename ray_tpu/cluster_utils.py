@@ -132,7 +132,7 @@ class Cluster:
                 self._cp_proc.kill()
             self._cp_proc = None
         _runtime.shutdown_runtime()
-        self._rt = None
+        self._rt = None  # raylint: disable=unguarded-handle-teardown -- single-threaded test driver; shutdown() and remove_node() are only called from the driver thread
 
 
 class RealCluster:

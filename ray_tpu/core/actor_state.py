@@ -176,7 +176,7 @@ class ActorState:
                             self.name, self.restarts, self.max_restarts)
                 self._restartable_kill = False
                 self.death_cause = None
-                self.instance = None
+                self.instance = None  # raylint: disable=unguarded-handle-teardown -- Python object, not a native handle; stale-generation worker threads no-op on the generation check before touching instance
                 self.generation += 1
                 self.dead.clear()
                 self.ready.clear()
@@ -568,7 +568,7 @@ class ProcActorState(ActorState):
         # Final death (not a restart): retire the dedicated worker.
         if self.dead.is_set() and self._worker is not None:
             w = self._worker
-            self._worker = None
+            self._worker = None  # raylint: disable=unguarded-handle-teardown -- lifecycle-ordered: _construct() runs before the worker loop that can reach _die(), and the null copies to a local first
             self._pool.retire(w)
 
 

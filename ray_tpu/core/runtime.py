@@ -1242,6 +1242,13 @@ class Runtime:
             with self._pending_lock:
                 if spec.task_id in self._pending_tasks:
                     continue  # already in flight
+                # Completion stores results BEFORE un-pending the task,
+                # so not-pending + stored means it finished between the
+                # contains check above and here — without this re-check
+                # that window resubmits a finished task (observed as
+                # double execution under RAY_TPU_LOCKTRACE).
+                if self.store.contains(oid):
+                    continue
                 self._pending_tasks[spec.task_id] = spec
             if spec.is_actor_task():
                 # Actor-task returns are only recomputable while the actor

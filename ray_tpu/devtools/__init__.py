@@ -1,0 +1,21 @@
+"""Developer tooling for the ray_tpu runtime itself.
+
+Two checkers police the distributed-runtime invariants that the
+round-5 advisor audit found violated by hand (ADVICE.md) and that CI
+must catch mechanically at production scale (the reference codebase
+leans on TSan builds and ``ray.util.inspect_serializability`` the same
+way):
+
+- :mod:`ray_tpu.devtools.raylint` — an AST static-analysis pass with a
+  rule registry (blocking-under-lock, unguarded-handle-teardown,
+  state-roundtrip-asymmetry, naked-get-in-actor, unserializable-capture,
+  lock-order-inversion) run in tier-1 over the whole tree and exposed
+  as the ``ray-tpu raylint`` CLI subcommand.
+- :mod:`ray_tpu.devtools.locktrace` — a runtime lock-discipline
+  checker that wraps ``threading.Lock``/``RLock`` to record per-thread
+  held-lock sets during a test run (``RAY_TPU_LOCKTRACE=1``).
+"""
+
+from . import locktrace, raylint  # noqa: F401
+
+__all__ = ["raylint", "locktrace"]

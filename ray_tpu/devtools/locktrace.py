@@ -1,0 +1,335 @@
+"""Runtime lock-discipline checker.
+
+The static pass (``raylint``) sees what the source *says*; this module
+watches what the process *does*. When installed it wraps the
+``threading.Lock`` / ``threading.RLock`` factories so every lock
+created afterwards records, per thread, the stack of locks currently
+held, and flags two families of hazard as they happen:
+
+- **blocking-under-lock** — a thread calls a known blocking primitive
+  (``time.sleep``, ``queue.Queue.get``/``put`` without immediate
+  semantics, ``threading.Event.wait``, ``socket.recv``) while holding
+  a traced lock;
+- **lock-order-inversion** — lock *B* is acquired while *A* is held
+  somewhere, and elsewhere *A* is acquired while *B* is held. Each
+  acquisition adds held→new edges to a global order graph; an edge
+  whose reverse is already present is a potential deadlock cycle.
+
+Violations are recorded (not raised) so a test run completes and the
+full report surfaces at teardown. The tier-1 suite arms this via an
+autouse fixture in ``tests/conftest.py`` when ``RAY_TPU_LOCKTRACE=1``:
+
+    RAY_TPU_LOCKTRACE=1 pytest tests/ -q -m 'not slow'
+
+``threading.Condition.wait`` *releases* its lock while waiting, so a
+condition-variable wait under its own lock is not flagged — only waits
+under *other* traced locks are.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import queue
+import sys
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# Violations are only REPORTED when the offending call site lives in
+# this repo (package root's parent, which also covers tests/): stdlib
+# and third-party internals (ThreadPoolExecutor, logging, jax) hold
+# their own locks around their own waits by design, and flagging them
+# would bury the signal this checker exists for — OUR lock discipline.
+_SCOPE = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+__all__ = [
+    "install", "uninstall", "is_installed", "violations",
+    "clear_violations", "report", "TracedLock",
+]
+
+_STATE_LOCK = threading.Lock()  # raylint: disable=lock-order-inversion -- tracer-internal; never held across user code
+_installed = False
+_violations: List["Violation"] = []
+# Directed lock-order edges: (name_a, name_b) means "b acquired while
+# a held". Seeded with the site that first created each edge so the
+# inversion report can show BOTH sides.
+_order_edges: Dict[Tuple[str, str], str] = {}
+_seen_keys: Set[Tuple[str, ...]] = set()
+
+# Per-thread stack of (lock_name, site) currently held. threading.local
+# is per-thread by construction, no locking needed.
+_tls = threading.local()
+
+
+def _held() -> List[Tuple[str, str]]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+@dataclass
+class Violation:
+    kind: str                 # blocking-under-lock | lock-order-inversion
+    thread: str
+    detail: str
+    site: str                 # "file:line" of the offending call
+    held: Tuple[str, ...] = field(default_factory=tuple)
+
+    def render(self) -> str:
+        held = f" [held: {', '.join(self.held)}]" if self.held else ""
+        return (f"{self.site}: {self.kind} ({self.thread}): "
+                f"{self.detail}{held}")
+
+
+def _site(depth_skip: int = 0) -> str:
+    """file:line of the first frame outside this module (and outside
+    threading/queue internals)."""
+    for frame in reversed(traceback.extract_stack()):
+        fn = frame.filename
+        if (__file__ in fn or fn.endswith("threading.py")
+                or fn.endswith("queue.py")):
+            continue
+        return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _record(kind: str, detail: str,
+            held: Optional[List[Tuple[str, str]]] = None) -> None:
+    site = _site()
+    if not site.startswith(_SCOPE):
+        return
+    v = Violation(kind=kind, thread=threading.current_thread().name,
+                  detail=detail, site=site,
+                  held=tuple(n for n, _ in (held or [])))
+    key = (v.kind, v.site, v.detail)
+    with _STATE_LOCK:
+        if key in _seen_keys:  # dedupe hot loops
+            return
+        _seen_keys.add(key)
+        _violations.append(v)
+
+
+def _name_lock(obj: object) -> str:
+    """Stable human name: creation site of the traced lock."""
+    return getattr(obj, "_lt_name", f"lock@{id(obj):#x}")
+
+
+class TracedLock:
+    """Proxy around a real Lock/RLock that maintains the per-thread
+    held stack and the global order graph."""
+
+    def __init__(self, factory, kind: str):
+        self._inner = factory()
+        self._kind = kind
+        self._lt_name = f"{kind}@{_site()}"
+
+    # -- order / held-stack bookkeeping ------------------------------
+
+    def _on_acquired(self) -> None:
+        held = _held()
+        if self._kind == "RLock" and any(
+                n == self._lt_name for n, _ in held):
+            held.append((self._lt_name, "reentrant"))
+            return
+        site = _site()
+        with _STATE_LOCK:
+            for held_name, _ in held:
+                if held_name == self._lt_name:
+                    continue
+                edge = (held_name, self._lt_name)
+                rev = (self._lt_name, held_name)
+                if edge not in _order_edges:
+                    _order_edges[edge] = site
+                other = _order_edges.get(rev)
+                vkey = ("lock-order-inversion", held_name,
+                        self._lt_name)
+                if (other is not None and site.startswith(_SCOPE)
+                        and vkey not in _seen_keys):
+                    _seen_keys.add(vkey)
+                    _violations.append(Violation(
+                        kind="lock-order-inversion",
+                        thread=threading.current_thread().name,
+                        detail=(f"{self._lt_name} acquired while "
+                                f"{held_name} held, but the reverse "
+                                f"order was taken at {other}"),
+                        site=site,
+                        held=tuple(n for n, _ in held)))
+        held.append((self._lt_name, site))
+
+    def _on_released(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == self._lt_name:
+                del held[i]
+                return
+
+    # -- Lock protocol ----------------------------------------------
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._on_acquired()
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._on_released()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    # Condition() probes the lock for these; the proxy always has
+    # them, so they must also work when the inner lock is a plain
+    # Lock (Condition's own fallback is release()/acquire()).
+    def _acquire_restore(self, state):
+        inner = getattr(self._inner, "_acquire_restore", None)
+        if inner is not None:
+            inner(state)
+        else:
+            self._inner.acquire()
+        self._on_acquired()
+
+    def _release_save(self):
+        inner = getattr(self._inner, "_release_save", None)
+        if inner is not None:
+            state = inner()
+        else:
+            self._inner.release()
+            state = None
+        self._on_released()
+        return state
+
+    def _is_owned(self):
+        inner = getattr(self._inner, "_is_owned", None)
+        if inner is not None:
+            return inner()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return f"<TracedLock {self._lt_name}>"
+
+
+def _blocking_hook(label: str, is_blocking):
+    """Wrap a blocking callable: before delegating, flag if any traced
+    lock is held by this thread (unless the call is non-blocking)."""
+    def deco(orig):
+        @functools.wraps(orig)
+        def wrapper(*args, **kwargs):
+            if is_blocking(args, kwargs):
+                held = _held()
+                # Only OUR calls count: when the direct caller is the
+                # stdlib (e.g. Thread.start()'s bootstrap Event.wait,
+                # Timer.start()), the wait is the library's own
+                # discipline — _site() would mis-attribute it to the
+                # nearest in-repo frame and report a phantom hazard.
+                if held and sys._getframe(1).f_code.co_filename.startswith(
+                        _SCOPE):
+                    _record("blocking-under-lock",
+                            f"{label} while holding a traced lock",
+                            held)
+            return orig(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def _sleep_blocks(args, kwargs) -> bool:
+    return bool(args) and (args[0] or 0) > 0
+
+
+def _wait_blocks(args, kwargs) -> bool:
+    # Event.wait(0) / wait(timeout=0) is a poll, not a block.
+    t = kwargs.get("timeout", args[1] if len(args) > 1 else None)
+    return t is None or t > 0
+
+
+def _queue_blocks(args, kwargs) -> bool:
+    # Queue.get(block=False) / get_nowait() don't block.
+    block = kwargs.get("block", args[1] if len(args) > 1 else True)
+    return bool(block)
+
+
+_originals: Dict[str, object] = {}
+
+
+def install() -> None:
+    """Patch the lock factories + blocking primitives. Idempotent."""
+    global _installed
+    with _STATE_LOCK:
+        if _installed:
+            return
+        _installed = True
+    _originals["Lock"] = threading.Lock
+    _originals["RLock"] = threading.RLock
+    _originals["sleep"] = time.sleep
+    _originals["Event.wait"] = threading.Event.wait
+    _originals["Queue.get"] = queue.Queue.get
+    _originals["Queue.put"] = queue.Queue.put
+
+    real_lock, real_rlock = threading.Lock, threading.RLock
+    threading.Lock = lambda: TracedLock(real_lock, "Lock")
+    threading.RLock = lambda: TracedLock(real_rlock, "RLock")
+    time.sleep = _blocking_hook("time.sleep", _sleep_blocks)(
+        _originals["sleep"])
+    threading.Event.wait = _blocking_hook(
+        "Event.wait", _wait_blocks)(_originals["Event.wait"])
+    queue.Queue.get = _blocking_hook(
+        "Queue.get", _queue_blocks)(_originals["Queue.get"])
+    queue.Queue.put = _blocking_hook(
+        "Queue.put", _queue_blocks)(_originals["Queue.put"])
+
+
+def uninstall() -> None:
+    """Restore the patched factories. Locks created while installed
+    keep tracing (they are TracedLock instances) but new ones do not."""
+    global _installed
+    with _STATE_LOCK:
+        if not _installed:
+            return
+        _installed = False
+    threading.Lock = _originals["Lock"]
+    threading.RLock = _originals["RLock"]
+    time.sleep = _originals["sleep"]
+    threading.Event.wait = _originals["Event.wait"]
+    queue.Queue.get = _originals["Queue.get"]
+    queue.Queue.put = _originals["Queue.put"]
+
+
+def is_installed() -> bool:
+    return _installed
+
+
+def violations() -> List[Violation]:
+    with _STATE_LOCK:
+        return list(_violations)
+
+
+def clear_violations() -> None:
+    with _STATE_LOCK:
+        _violations.clear()
+        _seen_keys.clear()
+        _order_edges.clear()
+
+
+def report() -> str:
+    vs = violations()
+    if not vs:
+        return "locktrace: no violations"
+    lines = [f"locktrace: {len(vs)} violation(s)"]
+    lines += [f"  {v.render()}" for v in vs]
+    return "\n".join(lines)

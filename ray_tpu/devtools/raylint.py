@@ -1,0 +1,787 @@
+"""raylint — AST static analysis for distributed-runtime hazards.
+
+The round-5 advisor audit (ADVICE.md) found latent defects by hand —
+a blocking ``recv`` held under a mutex, a native handle nulled without
+the lock guarding its dereference, a PRNG key dropped from checkpoint
+state — and every one belongs to a mechanically detectable class. This
+pass detects those classes over the ``ray_tpu`` tree the way the
+reference codebase polices its runtime invariants with TSan builds and
+``ray.util.inspect_serializability``.
+
+Rules (registry below; ``raylint --list-rules`` prints this table):
+
+- ``blocking-under-lock``     — a blocking call (``ray_tpu.get``,
+  socket ``recv``, ``queue.get``, ``time.sleep``, ``Event.wait``)
+  inside a ``with <lock>:`` body or an ``acquire()``/``release()``
+  region. Blocking while holding a mutex serializes every other
+  acquirer behind one I/O latency (the TaskClient::Wait bug class).
+- ``unguarded-handle-teardown`` — ``self._h = None`` in a teardown
+  method while other methods pass ``self._h`` into calls without a
+  common lock (the PullManager use-after-free class).
+- ``state-roundtrip-asymmetry`` — mutable algorithm state initialized
+  in ``__init__``/``setup`` and reassigned during stepping, but absent
+  from ``get_state`` (the LinearBandit dropped-PRNG-key class).
+- ``naked-get-in-actor``      — ``ray_tpu.get(...)`` without
+  ``timeout=`` inside a ``@remote`` actor method: a distributed
+  deadlock hazard (actor A blocks forever on actor B blocking on A).
+- ``unserializable-capture``  — a ``@remote`` function/class closing
+  over a name bound to a known-unpicklable factory (``threading.Lock``,
+  ``open``, ``socket.socket``, ...). The runtime diagnosis twin of this
+  static rule is ``ray_tpu.util.check_serialize.inspect_serializability``,
+  which walks a live object to the exact failing member.
+- ``lock-order-inversion``    — two locks acquired in opposite nested
+  orders across methods of one class (or one module's functions): a
+  deadlock the moment both paths run concurrently.
+
+Suppressions are per line, must name the rule, and must carry a
+justification after ``--``::
+
+    self._h = None  # raylint: disable=unguarded-handle-teardown -- single-owner shutdown, documented in the class docstring
+
+A disable comment without a justification is itself reported
+(``unjustified-suppression``) so the clean-tree gate in
+``tests/test_lint_clean.py`` stays meaningful.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Findings + rule registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+    suppressed: bool = False
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "message": self.message, "suppressed": self.suppressed}
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+RULES: Dict[str, "Rule"] = {}
+
+
+@dataclass
+class Rule:
+    name: str
+    doc: str
+    check: Callable[["FileContext"], List[Finding]]
+
+
+def rule(name: str, doc: str):
+    def deco(fn):
+        RULES[name] = Rule(name, doc, fn)
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Shared helpers
+# ---------------------------------------------------------------------------
+
+# Terminal-name patterns. "mu" is in the set because the C++ side's
+# naming convention (mu_) leaks into bindings and tests.
+_LOCKISH = re.compile(r"(^|_)(lock|locks|rlock|mutex|mu|guard)($|_)|lock$",
+                      re.IGNORECASE)
+# Condition variables acquire their lock as a context manager too, but
+# `with cv: cv.wait()` RELEASES the lock while waiting — the canonical
+# pattern, not a hazard. Names that look like CVs are not "locks" here.
+_CVISH = re.compile(r"(^|_)(cv|cond|condition)($|_)", re.IGNORECASE)
+_QUEUEISH = re.compile(r"(^|_)(q|queue|queues|inbox|mailbox)$",
+                       re.IGNORECASE)
+_EVENTISH = re.compile(r"event|(^|_)ev$", re.IGNORECASE)
+
+_SOCKET_READS = {"recv", "recv_into", "recvfrom", "recvmsg", "accept"}
+
+_RAY_MODULES = {"ray", "ray_tpu"}
+
+
+def _terminal_name(expr: ast.AST) -> Optional[str]:
+    """The rightmost identifier of a Name/Attribute, or — for a call
+    like ``self._lock.read()`` — the receiver's name, so guard-object
+    factory methods still read as lock-ish."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Attribute):
+            inner = _terminal_name(f.value)
+            if inner is not None and _LOCKISH.search(inner):
+                return inner
+            return f.attr
+        return _terminal_name(f)
+    return None
+
+
+def _lockish(expr: ast.AST) -> bool:
+    name = _terminal_name(expr)
+    return bool(name and _LOCKISH.search(name) and not _CVISH.search(name))
+
+
+def _expr_key(expr: ast.AST) -> str:
+    """Stable identity string for a lock expression (``self._lock``)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return f"{_expr_key(expr.value)}.{expr.attr}"
+    if isinstance(expr, ast.Call):
+        return f"{_expr_key(expr.func)}()"
+    try:
+        return ast.unparse(expr)
+    except Exception:
+        return repr(expr)
+
+
+def _blocking_call(call: ast.Call) -> Optional[str]:
+    """A human description when `call` is a known-blocking primitive."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv_name = _terminal_name(f.value) or ""
+    if f.attr in _SOCKET_READS:
+        return f"blocking socket {recv_name}.{f.attr}()"
+    if f.attr == "sleep" and recv_name == "time":
+        return "time.sleep()"
+    if f.attr == "get" and recv_name in _RAY_MODULES:
+        return f"{recv_name}.get()"
+    if (f.attr == "get" and _QUEUEISH.search(recv_name)
+            and not call.args):
+        # positional args mean dict.get(key, default) — a queue-ish
+        # NAME on a plain mapping must not count as blocking
+        return f"queue {recv_name}.get()"
+    if f.attr == "wait" and _EVENTISH.search(recv_name):
+        return f"{recv_name}.wait()"
+    return None
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SKIP_NODES = _FUNC_NODES + (ast.ClassDef, ast.Lambda)
+
+
+def _calls_outside_nested_defs(node: ast.AST) -> Iterable[ast.Call]:
+    """Call nodes in `node`, not descending into nested function/class
+    definitions (those run in another context, with their own scan)."""
+    stack = list(ast.iter_child_nodes(node))
+    if isinstance(node, ast.Call):
+        yield node
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _SKIP_NODES):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@dataclass
+class LockScan:
+    """Everything one pass over a function body learns about locks."""
+    # (lock_key, blocking description, call node)
+    blocking: List[Tuple[str, str, ast.Call]]
+    # (outer_key, inner_key, node) — inner acquired while outer held
+    edges: List[Tuple[str, str, ast.AST]]
+    # attr -> [(node, was_lock_held)] for `self.X = None` assignments
+    null_assigns: Dict[str, List[Tuple[ast.AST, bool]]]
+    # attr -> [(node, was_lock_held)] for `self.X` passed as a call arg
+    handle_args: Dict[str, List[Tuple[ast.AST, bool]]]
+
+
+def _scan_function(fn: ast.AST) -> LockScan:
+    scan = LockScan([], [], {}, {})
+    held: List[str] = []
+
+    def record_acquire(key: str, node: ast.AST) -> None:
+        for outer in held:
+            if outer != key:
+                scan.edges.append((outer, key, node))
+
+    def note_self_none(stmt: ast.stmt) -> None:
+        if not isinstance(stmt, ast.Assign):
+            return
+        if not (isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is None):
+            return
+        for tgt in stmt.targets:
+            tgts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+            for t in tgts:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    scan.null_assigns.setdefault(t.attr, []).append(
+                        (stmt, bool(held)))
+
+    def note_handle_args(stmt: ast.stmt) -> None:
+        for call in _calls_outside_nested_defs(stmt):
+            argv = list(call.args) + [k.value for k in call.keywords]
+            for a in argv:
+                if isinstance(a, ast.Starred):
+                    a = a.value
+                if (isinstance(a, ast.Attribute)
+                        and isinstance(a.value, ast.Name)
+                        and a.value.id == "self"):
+                    scan.handle_args.setdefault(a.attr, []).append(
+                        (call, bool(held)))
+
+    def scan_blocking(stmt: ast.stmt) -> None:
+        if not held:
+            return
+        for call in _calls_outside_nested_defs(stmt):
+            desc = _blocking_call(call)
+            if desc:
+                scan.blocking.append((held[-1], desc, call))
+
+    def process(stmts: List[ast.stmt]) -> None:
+        # Compound statements record only their HEADER expressions at
+        # this level and recurse into bodies — running the recording
+        # helpers on the whole subtree would log body sites a second
+        # time with the pre-`with` held state.
+        for stmt in stmts:
+            note_self_none(stmt)
+            if isinstance(stmt, _SKIP_NODES):
+                continue
+            # explicit acquire()/release() as statements
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                         ast.Call):
+                c = stmt.value
+                if (isinstance(c.func, ast.Attribute)
+                        and c.func.attr in ("acquire", "release")
+                        and _lockish(c.func.value)):
+                    key = _expr_key(c.func.value)
+                    if c.func.attr == "acquire":
+                        record_acquire(key, c)
+                        held.append(key)
+                    elif key in held:
+                        # remove the most recent acquisition
+                        for i in range(len(held) - 1, -1, -1):
+                            if held[i] == key:
+                                del held[i]
+                                break
+                    continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                taken = []
+                for item in stmt.items:
+                    note_handle_args(item.context_expr)
+                    if _lockish(item.context_expr):
+                        key = _expr_key(item.context_expr)
+                        record_acquire(key, item.context_expr)
+                        held.append(key)
+                        taken.append(key)
+                    else:
+                        scan_blocking(ast.Expr(value=item.context_expr)
+                                      if isinstance(item.context_expr,
+                                                    ast.Call)
+                                      else stmt)
+                process(stmt.body)
+                for _ in taken:
+                    held.pop()
+                continue
+            # compound statements: scan headers, recurse into bodies
+            if isinstance(stmt, (ast.If, ast.While)):
+                note_handle_args(stmt.test)
+                scan_blocking(ast.Expr(value=stmt.test))
+                process(stmt.body)
+                process(stmt.orelse)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                note_handle_args(stmt.iter)
+                scan_blocking(ast.Expr(value=stmt.iter))
+                process(stmt.body)
+                process(stmt.orelse)
+                continue
+            if isinstance(stmt, ast.Try):
+                process(stmt.body)
+                for h in stmt.handlers:
+                    process(h.body)
+                process(stmt.orelse)
+                process(stmt.finalbody)
+                continue
+            note_handle_args(stmt)
+            scan_blocking(stmt)
+
+    body = getattr(fn, "body", [])
+    process(body)
+    return scan
+
+
+def _functions(tree: ast.AST) -> List[ast.AST]:
+    return [n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)]
+
+
+def _is_remote_decorated(node: ast.AST) -> bool:
+    for dec in getattr(node, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Name) and target.id == "remote":
+            return True
+        if isinstance(target, ast.Attribute) and target.attr == "remote":
+            return True
+    return False
+
+
+def _attr_stores(fn: ast.AST) -> Dict[str, ast.AST]:
+    """self.X assignment targets inside `fn` (first node per attr)."""
+    out: Dict[str, ast.AST] = {}
+
+    def note(t: ast.AST, node: ast.AST) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                note(e, node)
+        elif isinstance(t, ast.Starred):
+            note(t.value, node)
+        elif (isinstance(t, ast.Attribute)
+              and isinstance(t.value, ast.Name) and t.value.id == "self"):
+            out.setdefault(t.attr, node)
+
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                note(t, n)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            note(n.target, n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Per-file context: source, tree, suppressions
+# ---------------------------------------------------------------------------
+
+_DISABLE_RE = re.compile(
+    r"#\s*raylint:\s*disable=([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(\S.*))?$")
+
+
+class FileContext:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        # line -> (set of rule names, has_justification)
+        self.suppressions: Dict[int, Tuple[set, bool]] = {}
+        self._parse_suppressions()
+        self._scans: Optional[Dict[ast.AST, LockScan]] = None
+
+    def _parse_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _DISABLE_RE.search(tok.string)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")
+                             if r.strip()}
+                    self.suppressions[tok.start[0]] = (
+                        rules, m.group(2) is not None)
+        except tokenize.TokenizeError:
+            pass
+
+    def lock_scans(self) -> Dict[ast.AST, LockScan]:
+        if self._scans is None:
+            self._scans = {fn: _scan_function(fn)
+                           for fn in _functions(self.tree)}
+        return self._scans
+
+    def finding(self, node_or_line, rule_name: str,
+                message: str) -> Finding:
+        line = (node_or_line if isinstance(node_or_line, int)
+                else getattr(node_or_line, "lineno", 0))
+        return Finding(self.path, line, rule_name, message)
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+@rule("blocking-under-lock",
+      "blocking call (ray_tpu.get / socket recv / queue.get / "
+      "time.sleep / Event.wait) while holding a lock")
+def _check_blocking_under_lock(ctx: FileContext) -> List[Finding]:
+    out = []
+    for fn, scan in ctx.lock_scans().items():
+        for lock_key, desc, call in scan.blocking:
+            out.append(ctx.finding(
+                call, "blocking-under-lock",
+                f"{desc} while holding `{lock_key}` in "
+                f"{getattr(fn, 'name', '<fn>')}(): every other acquirer "
+                f"stalls behind this call's latency"))
+    return out
+
+
+@rule("lock-order-inversion",
+      "two locks acquired in opposite nested orders across methods "
+      "of one class/module")
+def _check_lock_order(ctx: FileContext) -> List[Finding]:
+    out = []
+    scans = ctx.lock_scans()
+
+    # group function nodes by owning class (None = module level)
+    owner: Dict[ast.AST, Optional[str]] = {}
+
+    def assign_owner(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                assign_owner(child, child.name)
+            else:
+                if isinstance(child, _FUNC_NODES):
+                    owner[child] = cls
+                assign_owner(child, cls)
+
+    assign_owner(ctx.tree, None)
+
+    groups: Dict[Optional[str], List[Tuple[str, str, ast.AST, str]]] = {}
+    for fn, scan in scans.items():
+        for a, b, node in scan.edges:
+            groups.setdefault(owner.get(fn), []).append(
+                (a, b, node, getattr(fn, "name", "<fn>")))
+
+    for cls, edges in groups.items():
+        seen = {(a, b) for a, b, _, _ in edges}
+        reported = set()
+        for a, b, node, fname in edges:
+            if (b, a) in seen and (b, a) not in reported:
+                reported.add((a, b))
+                where = f"class {cls}" if cls else "module"
+                out.append(ctx.finding(
+                    node, "lock-order-inversion",
+                    f"`{a}` then `{b}` acquired here ({fname}()), but "
+                    f"the opposite order exists elsewhere in {where} — "
+                    f"deadlock when both paths run concurrently"))
+    return out
+
+
+@rule("unguarded-handle-teardown",
+      "self.<attr> nulled in a teardown method while other methods "
+      "pass it into calls without a lock")
+def _check_handle_teardown(ctx: FileContext) -> List[Finding]:
+    out = []
+    scans = ctx.lock_scans()
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = [n for n in cls.body if isinstance(n, _FUNC_NODES)]
+        nulls: Dict[str, List[Tuple[str, ast.AST, bool]]] = {}
+        uses: Dict[str, List[Tuple[str, ast.AST, bool]]] = {}
+        for m in methods:
+            scan = scans.get(m)
+            if scan is None:
+                continue
+            # `self.X = None` in __init__/setup is initialization, not
+            # teardown; uses in __init__ precede any sharing — neither
+            # side can race construction.
+            if m.name not in _INIT_METHODS:
+                for attr, sites in scan.null_assigns.items():
+                    for node, under_lock in sites:
+                        nulls.setdefault(attr, []).append(
+                            (m.name, node, under_lock))
+                for attr, sites in scan.handle_args.items():
+                    for node, under_lock in sites:
+                        uses.setdefault(attr, []).append(
+                            (m.name, node, under_lock))
+        for attr, null_sites in nulls.items():
+            for tname, tnode, t_locked in null_sites:
+                other = [(mname, node, locked)
+                         for mname, node, locked in uses.get(attr, [])
+                         if mname != tname]
+                if not other:
+                    continue
+                unlocked_uses = [mname for mname, _, locked in other
+                                 if not locked]
+                if t_locked and not unlocked_uses:
+                    continue  # both sides guarded
+                using = sorted(set(m for m, _, _ in other))
+                out.append(ctx.finding(
+                    tnode, "unguarded-handle-teardown",
+                    f"self.{attr} is nulled in {tname}() but passed "
+                    f"into calls by {', '.join(using)}() — without a "
+                    f"common lock a concurrent caller dereferences a "
+                    f"freed handle (use-after-free)"))
+                break  # one report per attr is enough
+    return out
+
+
+_INIT_METHODS = {"__init__", "setup", "__post_init__"}
+_STATE_METHODS = {"get_state", "set_state"}
+
+
+@rule("state-roundtrip-asymmetry",
+      "mutable algorithm state missing from get_state/set_state")
+def _check_state_roundtrip(ctx: FileContext) -> List[Finding]:
+    out = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, _FUNC_NODES)}
+        if not _STATE_METHODS <= set(methods):
+            continue
+        get_state = methods["get_state"]
+        # A get_state that defers to super()/helpers packs attrs we
+        # cannot see statically — skip the class rather than guess.
+        delegates = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+            and n.func.id == "super"
+            for n in ast.walk(get_state))
+        if delegates:
+            continue
+        init_attrs = {}
+        for name in _INIT_METHODS & set(methods):
+            init_attrs.update(_attr_stores(methods[name]))
+        mutated = {}
+        for name, m in methods.items():
+            if name in _INIT_METHODS | _STATE_METHODS:
+                continue
+            mutated.update(_attr_stores(m))
+        get_names = {n.attr for n in ast.walk(get_state)
+                     if isinstance(n, ast.Attribute)
+                     and isinstance(n.value, ast.Name)
+                     and n.value.id == "self"}
+        get_keys = {n.value for n in ast.walk(get_state)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)}
+        for attr in sorted(set(init_attrs) & set(mutated)):
+            if attr.startswith("__"):
+                continue
+            if attr in get_names or attr in get_keys \
+                    or attr.lstrip("_") in get_keys:
+                continue
+            out.append(ctx.finding(
+                mutated[attr], "state-roundtrip-asymmetry",
+                f"self.{attr} is initialized in "
+                f"{'/'.join(sorted(_INIT_METHODS & set(methods)))} and "
+                f"reassigned here, but {cls.name}.get_state() never "
+                f"serializes it — a restored run diverges from the "
+                f"original (the dropped-PRNG-key bug class)"))
+    return out
+
+
+@rule("naked-get-in-actor",
+      "ray_tpu.get() without timeout= inside an actor method")
+def _check_naked_get(ctx: FileContext) -> List[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if not _is_remote_decorated(node):
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if (isinstance(f, ast.Attribute) and f.attr == "get"
+                    and (_terminal_name(f.value) or "") in _RAY_MODULES):
+                if not any(k.arg == "timeout" for k in call.keywords):
+                    out.append(ctx.finding(
+                        call, "naked-get-in-actor",
+                        "ray_tpu.get() without timeout= inside an actor "
+                        "method: if the awaited task (transitively) "
+                        "needs this actor, the cluster deadlocks — "
+                        "pass timeout= and surface the failure"))
+    return out
+
+
+_UNPICKLABLE_FACTORIES = {
+    ("threading", "Lock"), ("threading", "RLock"),
+    ("threading", "Condition"), ("threading", "Event"),
+    ("threading", "Semaphore"), ("threading", "BoundedSemaphore"),
+    ("socket", "socket"), ("ctypes", "CDLL"),
+    ("subprocess", "Popen"), ("sqlite3", "connect"),
+    ("mmap", "mmap"), ("_thread", "allocate_lock"),
+}
+_UNPICKLABLE_BUILTINS = {"open"}
+
+
+def _unpicklable_factory(value: ast.AST) -> Optional[str]:
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Name) and f.id in _UNPICKLABLE_BUILTINS:
+        return f.id + "(...)"
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and (f.value.id, f.attr) in _UNPICKLABLE_FACTORIES):
+        return f"{f.value.id}.{f.attr}()"
+    return None
+
+
+@rule("unserializable-capture",
+      "@remote function/class capturing a known-unpicklable object")
+def _check_unserializable_capture(ctx: FileContext) -> List[Finding]:
+    out = []
+
+    def scope_bindings(scope: ast.AST) -> Dict[str, str]:
+        """name -> factory description for unpicklable assignments made
+        directly in `scope` (not in nested defs)."""
+        found: Dict[str, str] = {}
+        body = getattr(scope, "body", None)
+        if not isinstance(body, list):
+            return found
+        for stmt in body:
+            if isinstance(stmt, ast.Assign):
+                desc = _unpicklable_factory(stmt.value)
+                if desc:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            found[t.id] = desc
+        return found
+
+    def child_scopes(node: ast.AST) -> Iterable[ast.AST]:
+        for c in ast.iter_child_nodes(node):
+            if isinstance(c, _FUNC_NODES + (ast.ClassDef,)):
+                yield c
+            else:
+                yield from child_scopes(c)
+
+    def visit(scope: ast.AST, bindings: Dict[str, str]) -> None:
+        merged = dict(bindings)
+        merged.update(scope_bindings(scope))
+        for child in child_scopes(scope):
+            if _is_remote_decorated(child) and merged:
+                loaded = {n.id for n in ast.walk(child)
+                          if isinstance(n, ast.Name)
+                          and isinstance(n.ctx, ast.Load)}
+                for name in sorted(loaded & set(merged)):
+                    out.append(ctx.finding(
+                        child, "unserializable-capture",
+                        f"@remote {child.name} captures `{name}` = "
+                        f"{merged[name]}, which cloudpickle cannot "
+                        f"serialize — task submission will fail at "
+                        f"runtime (diagnose live objects with "
+                        f"ray_tpu.util.check_serialize."
+                        f"inspect_serializability)"))
+            visit(child, merged)
+
+    visit(ctx.tree, {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    files = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+        else:
+            for root, dirs, names in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                files.extend(os.path.join(root, n)
+                             for n in sorted(names)
+                             if n.endswith(".py"))
+    return sorted(set(files))
+
+
+def lint_file(path: str,
+              select: Optional[Iterable[str]] = None) -> List[Finding]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        ctx = FileContext(path, source)
+    except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        return [Finding(path, getattr(e, "lineno", 0) or 0,
+                        "parse-error", str(e))]
+    findings: List[Finding] = []
+    names = list(select) if select else list(RULES)
+    for name in names:
+        findings.extend(RULES[name].check(ctx))
+    # apply suppressions + demand justifications
+    for f in findings:
+        supp = ctx.suppressions.get(f.line)
+        if supp and (f.rule in supp[0] or "*" in supp[0]):
+            f.suppressed = True
+    for line, (rules, justified) in sorted(ctx.suppressions.items()):
+        if not justified:
+            findings.append(Finding(
+                path, line, "unjustified-suppression",
+                f"raylint suppression of {', '.join(sorted(rules))} "
+                f"carries no `-- <justification>`: every disable must "
+                f"say why the hazard does not apply"))
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return findings
+
+
+def lint_paths(paths: Iterable[str],
+               select: Optional[Iterable[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path, select))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="raylint",
+        description="static analysis for distributed-runtime hazards")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (default: the "
+                         "installed ray_tpu package)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule names to run")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable JSON report")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="include suppressed findings in the report")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, r in sorted(RULES.items()):
+            print(f"{name:28s} {r.doc}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        paths = [os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))]
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+        unknown = [s for s in select if s not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    findings = lint_paths(paths, select)
+    active = [f for f in findings if not f.suppressed]
+    shown = findings if args.show_suppressed else active
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in shown],
+            "total": len(active),
+            "suppressed": sum(1 for f in findings if f.suppressed),
+        }, indent=2))
+    else:
+        for f in shown:
+            print(f.render())
+        print(f"raylint: {len(active)} finding(s), "
+              f"{sum(1 for f in findings if f.suppressed)} suppressed")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -160,7 +160,11 @@ class LinearBandit(Algorithm):
         return {"iteration": self.iteration,
                 "A": np.asarray(self.A), "b": np.asarray(self.b),
                 "cumulative_regret": self.cumulative_regret,
-                "total_pulls": self.total_pulls}
+                "total_pulls": self.total_pulls,
+                # key_data: typed PRNG keys don't pickle as-is, and
+                # dropping the key makes a restored run diverge.
+                "prng_key": jax.device_get(
+                    jax.random.key_data(self._key))}
 
     def set_state(self, state):
         self.iteration = state["iteration"]
@@ -168,6 +172,9 @@ class LinearBandit(Algorithm):
         self.b = jnp.asarray(state["b"])
         self.cumulative_regret = state["cumulative_regret"]
         self.total_pulls = state["total_pulls"]
+        if "prng_key" in state:  # older checkpoints predate the key
+            self._key = jax.random.wrap_key_data(
+                jnp.asarray(state["prng_key"]))
 
 
 class BanditLinUCB(LinearBandit):

@@ -252,11 +252,16 @@ class _OffPolicyContinuous(Algorithm):
 
     def get_state(self):
         return {"iteration": self.iteration,
-                "state": jax.device_get(self.state)}
+                "state": jax.device_get(self.state),
+                "prng_key": jax.device_get(
+                    jax.random.key_data(self._key))}
 
     def set_state(self, state):
         self.iteration = state["iteration"]
         self.state = state["state"]
+        if "prng_key" in state:  # older checkpoints predate the key
+            self._key = jax.random.wrap_key_data(
+                jnp.asarray(state["prng_key"]))
 
     def stop(self):
         for r in self.runners:

@@ -500,12 +500,17 @@ class Dreamer(Algorithm):
     def get_state(self):
         return {"iteration": self.iteration,
                 "state": jax.device_get(self._state),
-                "total_env_steps": self.total_env_steps}
+                "total_env_steps": self.total_env_steps,
+                "prng_key": jax.device_get(
+                    jax.random.key_data(self._key))}
 
     def set_state(self, state):
         self.iteration = state["iteration"]
         self._state = jax.device_put(state["state"])
         self.total_env_steps = state["total_env_steps"]
+        if "prng_key" in state:  # older checkpoints predate the key
+            self._key = jax.random.wrap_key_data(
+                jnp.asarray(state["prng_key"]))
 
     def compute_single_action(self, obs: np.ndarray) -> int:
         obs = np.asarray(obs, np.float32)[None]

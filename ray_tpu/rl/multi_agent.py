@@ -370,12 +370,17 @@ class MultiAgentPPO(Algorithm):
     def get_state(self):
         return {"iteration": self.iteration,
                 "params": jax.device_get(self.params),
-                "opt_states": jax.device_get(self.opt_states)}
+                "opt_states": jax.device_get(self.opt_states),
+                "prng_key": jax.device_get(
+                    jax.random.key_data(self._key))}
 
     def set_state(self, state):
         self.iteration = state["iteration"]
         self.params = state["params"]
         self.opt_states = state["opt_states"]
+        if "prng_key" in state:  # older checkpoints predate the key
+            self._key = jax.random.wrap_key_data(
+                jnp.asarray(state["prng_key"]))
 
     def stop(self):
         for r in self.runners:

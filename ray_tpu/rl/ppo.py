@@ -186,12 +186,17 @@ class PPO(Algorithm):
     def get_state(self):
         return {"iteration": self.iteration,
                 "params": jax.device_get(self.params),
-                "opt_state": jax.device_get(self.opt_state)}
+                "opt_state": jax.device_get(self.opt_state),
+                "prng_key": jax.device_get(
+                    jax.random.key_data(self._key))}
 
     def set_state(self, state):
         self.iteration = state["iteration"]
         self.params = state["params"]
         self.opt_state = state["opt_state"]
+        if "prng_key" in state:  # older checkpoints predate the key
+            self._key = jax.random.wrap_key_data(
+                jnp.asarray(state["prng_key"]))
 
     def compute_single_action(self, obs: np.ndarray) -> int:
         from .module import greedy_actions

@@ -458,6 +458,24 @@ def cmd_down(args) -> int:
     return 0
 
 
+def cmd_raylint(args) -> int:
+    """Distributed-runtime static analysis (ray_tpu.devtools.raylint):
+    lock discipline, handle-teardown races, state-roundtrip asymmetry,
+    serialization hazards."""
+    from ..devtools import raylint
+
+    argv = list(args.paths)
+    if args.json:
+        argv.append("--json")
+    if args.show_suppressed:
+        argv.append("--show-suppressed")
+    if args.select:
+        argv += ["--select", args.select]
+    if args.list_rules:
+        argv.append("--list-rules")
+    return raylint.main(argv)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ray-tpu", description="ray_tpu cluster CLI")
@@ -579,6 +597,23 @@ def build_parser() -> argparse.ArgumentParser:
                         help="terminate all nodes of a YAML cluster")
     dn.add_argument("config_file")
     dn.set_defaults(fn=cmd_down)
+
+    rl = sub.add_parser(
+        "raylint",
+        help="static analysis for distributed-runtime hazards "
+             "(lock discipline, teardown races, state roundtrips)")
+    rl.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint (default: the "
+                         "installed ray_tpu package)")
+    rl.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    rl.add_argument("--select", default=None,
+                    help="comma-separated rule names to run")
+    rl.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    rl.add_argument("--list-rules", action="store_true",
+                    help="list registered rules and exit")
+    rl.set_defaults(fn=cmd_raylint)
     return p
 
 

@@ -118,11 +118,15 @@ def run(app: Application, *, name: str = "default",
         ray_get(controller.set_route.remote(new_route, ingress._name))
         _start_node_proxies()
     if http:
+        # Publish the instance under the lock; start() — which waits
+        # up to 10s for the server thread — runs OUTSIDE it (start()
+        # is idempotent and internally synchronized).
         with _lock:
             if _proxy is None:
                 _proxy = HttpProxy(port=http_port)
-                _proxy.start()
-            _proxy.add_route(route_prefix or name, ingress)
+            proxy = _proxy
+        proxy.start()
+        proxy.add_route(route_prefix or name, ingress)
     if grpc:
         with _lock:
             if _grpc_proxy is None:

@@ -98,6 +98,7 @@ class ServeController:
     # -- deploy / delete -------------------------------------------------
     def deploy(self, deployment: Deployment, init_args=(),
                init_kwargs=None) -> str:
+        old: List[Any] = []
         with self._lock:
             name = deployment.name
             existing = self._sets.get(name)
@@ -108,7 +109,6 @@ class ServeController:
                 rs = _ReplicaSet(deployment)
                 rs.init_args = tuple(init_args)
                 rs.init_kwargs = init_kwargs or {}
-                rs.scale_to(n, init_args, init_kwargs)
                 self._sets[name] = rs
             else:
                 # Rolling update: replace replicas with the new version
@@ -122,13 +122,18 @@ class ServeController:
                 existing.version += 1
                 old = existing.replicas
                 existing.replicas = []
-                existing.scale_to(n, init_args, init_kwargs)
-                for r in old:
-                    try:
-                        ray_kill(r)
-                    except Exception:  # noqa: BLE001
-                        pass
-            return name
+                rs = existing
+        # Replica creation blocks on actor placement and old-version
+        # teardown is network-visible — neither may hold the
+        # controller lock (same discipline as _reconcile/_autoscale:
+        # every other RPC queues behind it).
+        rs.scale_to(n, init_args, init_kwargs)
+        for r in old:
+            try:
+                ray_kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        return name
 
     def delete(self, name: str):
         with self._lock:

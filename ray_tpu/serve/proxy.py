@@ -70,11 +70,15 @@ class HttpProxy:
             self._routes.pop(prefix.strip("/"), None)
 
     def start(self):
-        if self._thread is not None:
-            return
-        self._thread = threading.Thread(
-            target=self._serve, daemon=True, name="serve-http-proxy")
-        self._thread.start()
+        # Decide-and-spawn under the lock so concurrent callers can't
+        # double-start; the startup wait happens OUTSIDE it (it can
+        # take seconds and every route update shares this lock).
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._serve, daemon=True,
+                    name="serve-http-proxy")
+                self._thread.start()
         if not self._started.wait(10):
             raise RuntimeError("HTTP proxy failed to start")
 

@@ -213,14 +213,19 @@ def prometheus_text() -> str:
             with m._lock:
                 for tags, (buckets, total, count) in m._values.items():
                     acc = 0
+                    # `le` built outside the f-string: a backslash in
+                    # an f-string expression is a SyntaxError before
+                    # Python 3.12.
                     for i, b in enumerate(m._bounds):
                         acc += buckets[i]
+                        le = 'le="%s"' % b
                         out.append(
                             f"{name}_bucket"
-                            f"{_fmt_tags(tags, f'le=\"{b}\"')} {acc}")
+                            f"{_fmt_tags(tags, le)} {acc}")
                     acc += buckets[-1]
+                    le_inf = 'le="+Inf"'
                     out.append(
-                        f"{name}_bucket{_fmt_tags(tags, 'le=\"+Inf\"')} "
+                        f"{name}_bucket{_fmt_tags(tags, le_inf)} "
                         f"{acc}")
                     out.append(f"{name}_sum{_fmt_tags(tags)} {_fmt_value(total)}")
                     out.append(f"{name}_count{_fmt_tags(tags)} {count}")

@@ -140,7 +140,7 @@ class HTTPEventProvider:
         if self._loop is not None:
             self._loop.close()  # release the selector fd
             self._loop = None
-        self._runner = None
+        self._runner = None  # raylint: disable=unguarded-handle-teardown -- stop() awaits runner.cleanup() on the loop and joins the server thread before clearing
         self._started.clear()
 
     def _serve(self) -> None:

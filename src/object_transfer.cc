@@ -58,6 +58,25 @@ bool send_all(int fd, const void* data, uint64_t n) {
   return true;
 }
 
+// Timed cv wait. Under TSAN this routes through a system_clock
+// wait_until → pthread_cond_timedwait: gcc-10's libtsan has no
+// interceptor for the pthread_cond_clockwait that libstdc++'s
+// wait_for uses, so TSAN misses the wait's internal unlock and
+// reports bogus double-locks/races on everything the lock guards.
+template <typename Pred>
+bool cv_wait_for_ms(std::condition_variable& cv,
+                    std::unique_lock<std::mutex>& lk, int ms,
+                    Pred pred) {
+#if defined(__SANITIZE_THREAD__)
+  return cv.wait_until(lk,
+                       std::chrono::system_clock::now() +
+                           std::chrono::milliseconds(ms),
+                       pred);
+#else
+  return cv.wait_for(lk, std::chrono::milliseconds(ms), pred);
+#endif
+}
+
 bool recv_all(int fd, void* data, uint64_t n) {
   char* p = static_cast<char*>(data);
   while (n > 0) {
@@ -444,23 +463,21 @@ struct WorkerConns {
 // eligible op exists (caller re-waits).
 PullOp* next_op_locked(PullMgr* m) {
   if (m->queues.empty()) return nullptr;
-  std::vector<uint64_t> keys;
-  keys.reserve(m->queues.size());
-  for (auto& kv : m->queues) keys.push_back(kv.first);
-  size_t start = 0;
-  for (size_t i = 0; i < keys.size(); i++) {
-    if (keys[i] >= m->rr_key) {
-      start = i;
-      break;
-    }
-  }
-  for (size_t k = 0; k < keys.size(); k++) {
-    uint64_t key = keys[(start + k) % keys.size()];
-    auto it = m->queues.find(key);
-    if (it == m->queues.end() || it->second.empty()) continue;
+  // Walk the ordered map in place starting at the round-robin cursor
+  // (lower_bound + wrap) instead of materializing a key vector per
+  // pick — the pick runs under m->mu on every worker dispatch.
+  const size_t n = m->queues.size();
+  auto it = m->queues.lower_bound(m->rr_key);
+  for (size_t k = 0; k < n; ++k, ++it) {
+    if (it == m->queues.end()) it = m->queues.begin();
+    if (it->second.empty()) continue;
     PullOp* op = it->second.front();
-    if (m->ep_active[op->ep] >= m->ep_cap) continue;
+    // find(), not operator[]: a saturation probe must not plant
+    // permanent zero-count entries for every endpoint it skips.
+    auto ea = m->ep_active.find(op->ep);
+    if (ea != m->ep_active.end() && ea->second >= m->ep_cap) continue;
     it->second.pop_front();
+    uint64_t key = it->first;
     if (it->second.empty()) m->queues.erase(it);
     m->rr_key = key + 1;
     m->ep_active[op->ep]++;
@@ -498,7 +515,7 @@ void pull_worker(PullMgr* m) {
       // saturated, the predicate is true yet nothing is runnable — the
       // timeout turns that state into a cheap poll; completions also
       // notify, so pickup is normally immediate.
-      m->work_cv.wait_for(lk, std::chrono::milliseconds(50), [m] {
+      cv_wait_for_ms(m->work_cv, lk, 50, [m] {
         return m->stopping || m->queued_ops > 0;
       });
       if (m->stopping) break;
@@ -666,8 +683,7 @@ int rtp_wait(void* handle, uint64_t ticket, int timeout_ms) {
   bool timed_out = false;
   if (timeout_ms < 0) {
     m->done_cv.wait(lk, pred);
-  } else if (!m->done_cv.wait_for(
-                 lk, std::chrono::milliseconds(timeout_ms), pred)) {
+  } else if (!cv_wait_for_ms(m->done_cv, lk, timeout_ms, pred)) {
     timed_out = true;
   }
   m->wait_refs--;

@@ -54,6 +54,40 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
 
 
+# -- runtime lock-discipline checking (RAY_TPU_LOCKTRACE=1) -----------
+# Arms ray_tpu.devtools.locktrace for the whole session: every lock
+# created during the run records per-thread held sets; blocking calls
+# under a lock and lock-order inversions are collected and reported
+# (as a hard failure) at session end.
+_LOCKTRACE_ON = os.environ.get("RAY_TPU_LOCKTRACE") == "1"
+
+if _LOCKTRACE_ON:
+    from ray_tpu.devtools import locktrace as _locktrace
+
+    _locktrace.install()
+
+    @pytest.fixture(autouse=True)
+    def _locktrace_guard(request):
+        yield
+        # Per-test attribution: tag fresh violations with the test id
+        # so the session-end report points at the offender.
+        for v in _locktrace.violations():
+            if not getattr(v, "_attributed", False):
+                v._attributed = True
+                v.detail += f" [test: {request.node.nodeid}]"
+
+    def pytest_sessionfinish(session, exitstatus):
+        _locktrace.uninstall()
+        vs = _locktrace.violations()
+        if vs:
+            tr = session.config.pluginmanager.get_plugin(
+                "terminalreporter")
+            if tr is not None:
+                tr.write_sep("=", "locktrace violations")
+                tr.write_line(_locktrace.report())
+            session.exitstatus = 1
+
+
 @pytest.fixture
 def ray_start():
     """A fresh runtime per test (4 CPUs, no TPU)."""

@@ -1,0 +1,24 @@
+"""raylint fixtures: naked-get-in-actor and unserializable-capture
+seeded violations."""
+
+import threading
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class BlockingActor:
+    def fan_in(self, refs):
+        return ray_tpu.get(refs)  # no timeout=: deadlock if cyclic
+
+    def bounded(self, refs):
+        return ray_tpu.get(refs, timeout=30)  # fine: has timeout=
+
+
+_GLOBAL_LOCK = threading.Lock()
+
+
+@ray_tpu.remote
+def captures_lock(x):
+    with _GLOBAL_LOCK:  # cloudpickle cannot serialize a lock
+        return x + 1

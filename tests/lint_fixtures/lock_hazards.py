@@ -1,0 +1,40 @@
+"""raylint fixtures: blocking-under-lock and lock-order-inversion
+seeded violations (plus an UNJUSTIFIED suppression, which must itself
+be reported)."""
+
+import threading
+import time
+
+
+class SleepsUnderLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def slow_path(self):
+        with self._lock:
+            time.sleep(0.5)  # every other acquirer stalls here
+
+
+class OrderInverter:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:  # opposite order: deadlock window
+                pass
+
+
+class UnjustifiedSuppression:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def quiet(self):
+        with self._lock:
+            time.sleep(0.1)  # raylint: disable=blocking-under-lock

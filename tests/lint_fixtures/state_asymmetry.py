@@ -1,0 +1,26 @@
+"""raylint regression fixture: the PRE-FIX shape of the dropped-PRNG-
+key bug (ADVICE finding 4, fixed across ray_tpu/rl/). setup() creates
+self._key, select_arm() reassigns it, get_state() omits it — a
+restored run silently diverges. state-roundtrip-asymmetry must fire.
+"""
+
+
+def _split(key):
+    return key + 1, key + 2
+
+
+class KeyDroppingAlgo:
+    def setup(self, seed):
+        self._key = seed
+        self.iteration = 0
+
+    def step(self):
+        self._key, sub = _split(self._key)
+        self.iteration += 1
+        return sub
+
+    def get_state(self):
+        return {"iteration": self.iteration}
+
+    def set_state(self, state):
+        self.iteration = state["iteration"]

@@ -1,0 +1,46 @@
+"""raylint regression fixture: the PRE-FIX shape of the PullManager
+teardown race (ADVICE finding 1, fixed in
+ray_tpu/_native/object_transfer.py via HandleGuard). stop() frees and
+nulls the native handle with no lock shared with wait() — the exact
+use-after-free raylint's unguarded-handle-teardown rule must flag.
+
+NOT collected by pytest (no test_ prefix); linted by
+tests/test_lint_clean.py which asserts the rule fires here.
+"""
+
+
+def _native_wait(handle, ticket):
+    return 0
+
+
+def _native_stop(handle):
+    pass
+
+
+class UnguardedManager:
+    def __init__(self):
+        self._h = object()
+
+    def wait(self, ticket):
+        return _native_wait(self._h, ticket)
+
+    def stop(self):
+        if self._h:
+            _native_stop(self._h)
+            self._h = None
+
+
+class SuppressedManager:
+    """Same shape, suppression honored: lint_clean asserts this one
+    does NOT appear among active findings."""
+
+    def __init__(self):
+        self._h = object()
+
+    def wait(self, ticket):
+        return _native_wait(self._h, ticket)
+
+    def stop(self):
+        if self._h:
+            _native_stop(self._h)
+            self._h = None  # raylint: disable=unguarded-handle-teardown -- fixture: demonstrates a justified suppression

@@ -135,3 +135,33 @@ def test_cpp_task_and_actor_submission():
         assert lines[2] == 'OK actor_state=["a", "b"]'
     finally:
         cluster.shutdown()
+
+
+def test_cpp_threaded_pipelining():
+    """Several threads share ONE TaskClient, each pipelining async
+    submissions and claiming its own tickets. Validates the
+    designated-reader Wait(): the socket read happens with the client
+    mutex dropped, so other threads keep submitting (and waiting)
+    while one blocks in recv — the old Wait held the mutex across
+    recv, serializing every thread behind the first waiter."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import RealCluster
+
+    ray_tpu.shutdown()
+    cluster = RealCluster()
+    try:
+        cluster.add_node(num_cpus=1)
+        client = cluster.control_client()
+        try:
+            nodes = client.list_nodes()
+            meta = json.loads(nodes[0]["meta"])
+        finally:
+            client.close()
+        out = subprocess.run(
+            [SMOKE, "tasks-threaded", "-", meta["host"],
+             str(meta["dispatch_port"])],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "OK threaded=32" in out.stdout
+    finally:
+        cluster.shutdown()

@@ -1,0 +1,168 @@
+"""Tier-1 gate for the raylint static-analysis pass.
+
+Two directions:
+- the whole installed ``ray_tpu`` tree must be CLEAN (zero unsuppressed
+  findings, every suppression justified) — new code that reintroduces a
+  lock-discipline/teardown/state-roundtrip hazard fails the suite;
+- every rule must actually FIRE on its seeded violation in
+  tests/lint_fixtures/ (and honor disable comments), so a regression in
+  the analyzer itself cannot silently turn the gate into a no-op.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu.devtools import raylint
+from ray_tpu.devtools.raylint import RULES, lint_paths
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+PKG = os.path.join(REPO, "ray_tpu")
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+
+def _active(path, select=None):
+    return [f for f in lint_paths([path], select) if not f.suppressed]
+
+
+def test_rule_registry_complete():
+    expected = {
+        "blocking-under-lock", "unguarded-handle-teardown",
+        "state-roundtrip-asymmetry", "naked-get-in-actor",
+        "unserializable-capture", "lock-order-inversion",
+    }
+    assert expected <= set(RULES), sorted(RULES)
+    assert len(RULES) >= 6
+
+
+def test_ray_tpu_tree_is_clean():
+    active = _active(PKG)
+    assert not active, "raylint findings in ray_tpu/:\n" + "\n".join(
+        f.render() for f in active)
+
+
+def test_every_suppression_is_justified():
+    findings = lint_paths([PKG])
+    bad = [f for f in findings if f.rule == "unjustified-suppression"]
+    assert not bad, "\n".join(f.render() for f in bad)
+
+
+def test_teardown_rule_fires_on_prefix_shape():
+    """The PRE-FIX PullManager stop()/wait() race shape must be
+    flagged — and the suppressed twin class must not be."""
+    path = os.path.join(FIXTURES, "teardown_race.py")
+    active = [f for f in _active(path)
+              if f.rule == "unguarded-handle-teardown"]
+    assert len(active) == 1, [f.render() for f in _active(path)]
+    suppressed = [f for f in lint_paths([path])
+                  if f.rule == "unguarded-handle-teardown"
+                  and f.suppressed]
+    assert len(suppressed) == 1  # disable comment honored
+
+
+def test_state_roundtrip_rule_fires_on_prefix_shape():
+    """The PRE-FIX dropped-PRNG-key shape (ADVICE finding 4)."""
+    path = os.path.join(FIXTURES, "state_asymmetry.py")
+    active = [f for f in _active(path)
+              if f.rule == "state-roundtrip-asymmetry"]
+    assert len(active) == 1
+    assert "_key" in active[0].message
+
+
+def test_blocking_and_order_rules_fire():
+    path = os.path.join(FIXTURES, "lock_hazards.py")
+    active = _active(path)
+    rules = {f.rule for f in active}
+    assert "blocking-under-lock" in rules
+    assert "lock-order-inversion" in rules
+    # the `# raylint: disable=...` WITHOUT a justification is itself
+    # a finding (the suppression machinery demands a reason)
+    assert "unjustified-suppression" in rules
+
+
+def test_actor_rules_fire():
+    path = os.path.join(FIXTURES, "actor_hazards.py")
+    active = _active(path)
+    naked = [f for f in active if f.rule == "naked-get-in-actor"]
+    assert len(naked) == 1  # the timeout= variant must NOT be flagged
+    captures = [f for f in active if f.rule == "unserializable-capture"]
+    assert len(captures) == 1
+    assert "_GLOBAL_LOCK" in captures[0].message
+
+
+def test_exit_codes_and_json():
+    """CLI contract: nonzero on findings, zero on a clean tree, JSON
+    report parses."""
+    import json as _json
+
+    dirty = os.path.join(FIXTURES, "lock_hazards.py")
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.raylint", dirty,
+         "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1, r.stderr
+    report = _json.loads(r.stdout)
+    assert report["total"] >= 2
+
+    clean = os.path.join(PKG, "devtools", "__init__.py")
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.raylint", clean],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_subcommand_wired():
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "raylint",
+         "--list-rules"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    assert "blocking-under-lock" in r.stdout
+
+
+def test_locktrace_detects_and_clears():
+    """Runtime checker: blocking-under-lock and order inversion are
+    caught live; a Condition.wait under its own lock is not."""
+    import queue
+    import threading
+    import time
+
+    from ray_tpu.devtools import locktrace
+
+    locktrace.clear_violations()
+    locktrace.install()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            time.sleep(0.01)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        cv = threading.Condition()
+
+        def poke():
+            time.sleep(0.05)
+            with cv:
+                cv.notify()
+
+        t = threading.Thread(target=poke)
+        t.start()
+        with cv:
+            cv.wait(timeout=2)
+        t.join()
+        q = queue.Queue()
+        q.put(1)
+        assert q.get() == 1
+    finally:
+        locktrace.uninstall()
+    kinds = {v.kind for v in locktrace.violations()}
+    assert kinds == {"blocking-under-lock", "lock-order-inversion"}, (
+        locktrace.report())
+    locktrace.clear_violations()
+    assert not locktrace.violations()
