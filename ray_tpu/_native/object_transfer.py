@@ -104,6 +104,16 @@ def _check_id(object_id: bytes) -> bytes:
     return object_id
 
 
+def _record(event: str, **fields) -> None:
+    """Flight-recorder breadcrumb (lazy import: _native must stay
+    importable before the package finishes initialising)."""
+    try:
+        from ..observability import get_recorder
+        get_recorder().record("object_transfer", event, **fields)
+    except Exception:  # noqa: BLE001 - diagnostics must not break transfers
+        pass
+
+
 class TransferServer:
     """Serve this node's arena to peers (one per node). bind_all=True
     listens on 0.0.0.0 for real multi-host topologies; the default
@@ -150,9 +160,12 @@ class TransferClient:
             rc = _load().rto_pull(self._conn, self._store,
                                   _check_id(object_id))
         if rc == 0:
+            _record("pull_done", object_id=object_id.hex()[:16])
             return True
         if rc == -4:
             return False
+        _record("pull_failed", object_id=object_id.hex()[:16],
+                error=_ERRORS.get(rc, str(rc)))
         raise TransferError(
             f"pull failed: {_ERRORS.get(rc, rc)}")
 
@@ -164,8 +177,11 @@ class TransferClient:
             rc = _load().rto_push(self._conn, self._store,
                                   _check_id(object_id))
         if rc != 0:
+            _record("push_failed", object_id=object_id.hex()[:16],
+                    error=_ERRORS.get(rc, str(rc)))
             raise TransferError(
                 f"push failed: {_ERRORS.get(rc, rc)}")
+        _record("push_done", object_id=object_id.hex()[:16])
 
     def close(self) -> None:
         lib = _load()
@@ -273,6 +289,8 @@ class PullManager:
             if rc != -5:
                 break  # completed (or failed) within this slice
         if rc != 0:
+            _record("managed_transfer_failed", ticket=int(ticket),
+                    error=_MGR_ERRORS.get(rc, str(rc)))
             raise TransferError(
                 f"transfer failed: {_MGR_ERRORS.get(rc, rc)}")
 

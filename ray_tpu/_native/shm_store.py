@@ -16,6 +16,8 @@ import os
 import time
 from typing import Optional, Tuple
 
+from .handle_guard import HandleGuard
+
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libshm_store.so")
 
 ID_LEN = 28
@@ -114,6 +116,9 @@ class ShmStore:
                         name.encode(), capacity, 1)
         if not self._handle:
             raise ShmStoreError(f"Failed to attach shm store {name!r}")
+        # Read side around every native call, write side around close():
+        # a call racing teardown would deref a freed handle in C.
+        self._guard = HandleGuard()
         # mmap the same arena for zero-copy buffer views.
         fd = os.open(f"/dev/shm{name}", os.O_RDWR)
         try:
@@ -142,60 +147,70 @@ class ShmStore:
         parts = list(parts)  # sized twice below; generators must not drain
         total = sum(len(p) for p in parts)
         off = ctypes.c_uint64()
-        rc = lib().rts_create(self._h(), object_id, total,
-                              ctypes.byref(off))
-        if rc == -1:
-            raise ObjectExistsError(object_id.hex())
-        if rc == -2:
-            raise StoreFullError(
-                f"{total} bytes do not fit "
-                f"(used {self.used()}/{self.capacity()})")
-        if rc != 0:
-            raise ShmStoreError(f"create failed rc={rc}")
-        pos = off.value
-        for p in parts:
-            n = len(p)
-            self._map[pos:pos + n] = p
-            pos += n
-        if lib().rts_seal(self._h(), object_id) != 0:
-            raise ShmStoreError("seal failed")
+        with self._guard.read():
+            rc = lib().rts_create(self._h(), object_id, total,
+                                  ctypes.byref(off))
+            if rc == -1:
+                raise ObjectExistsError(object_id.hex())
+            if rc == -2:
+                raise StoreFullError(
+                    f"{total} bytes do not fit "
+                    f"(used {lib().rts_used(self._h())}"
+                    f"/{lib().rts_capacity(self._h())})")
+            if rc != 0:
+                raise ShmStoreError(f"create failed rc={rc}")
+            pos = off.value
+            for p in parts:
+                n = len(p)
+                self._map[pos:pos + n] = p
+                pos += n
+            if lib().rts_seal(self._h(), object_id) != 0:
+                raise ShmStoreError("seal failed")
 
     def get(self, object_id: bytes, *, pin: bool = False
             ) -> Optional[memoryview]:
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
-        rc = lib().rts_get(self._h(), object_id, ctypes.byref(off),
-                           ctypes.byref(size), 1 if pin else 0)
-        if rc != 0:
-            return None
-        return memoryview(self._map)[off.value:off.value + size.value]
+        with self._guard.read():
+            rc = lib().rts_get(self._h(), object_id, ctypes.byref(off),
+                               ctypes.byref(size), 1 if pin else 0)
+            if rc != 0:
+                return None
+            return memoryview(self._map)[off.value:off.value + size.value]
 
     def release(self, object_id: bytes) -> None:
-        lib().rts_release(self._h(), object_id)
+        with self._guard.read():
+            lib().rts_release(self._h(), object_id)
 
     def contains(self, object_id: bytes) -> bool:
-        return bool(lib().rts_contains(self._h(), object_id))
+        with self._guard.read():
+            return bool(lib().rts_contains(self._h(), object_id))
 
     def reclaim_dead_pins(self) -> int:
         """Drop pins recorded by crashed processes; returns how many
         were reclaimed (reference: plasma client-disconnect cleanup).
         The allocator also does this lazily under memory pressure —
         call it eagerly when a worker death is observed."""
-        return int(lib().rts_reclaim_dead_pins(self._h()))
+        with self._guard.read():
+            return int(lib().rts_reclaim_dead_pins(self._h()))
 
     def delete(self, object_id: bytes) -> bool:
-        if not self._handle:
-            return False
-        return lib().rts_delete(self._handle, object_id) == 0
+        with self._guard.read():
+            if not self._handle:
+                return False
+            return lib().rts_delete(self._handle, object_id) == 0
 
     def used(self) -> int:
-        return lib().rts_used(self._h())
+        with self._guard.read():
+            return lib().rts_used(self._h())
 
     def capacity(self) -> int:
-        return lib().rts_capacity(self._h())
+        with self._guard.read():
+            return lib().rts_capacity(self._h())
 
     def num_objects(self) -> int:
-        return lib().rts_num_objects(self._h())
+        with self._guard.read():
+            return lib().rts_num_objects(self._h())
 
     # -- mutable channel objects -----------------------------------------
     def channel_create(self, object_id: bytes, max_size: int) -> None:
@@ -207,8 +222,9 @@ class ShmStore:
                 f"(got {len(object_id)}: a short id makes the native "
                 "side hash past the buffer)")
         off = ctypes.c_uint64()
-        rc = lib().rts_ch_create(self._h(), object_id, max_size,
-                                 ctypes.byref(off))
+        with self._guard.read():
+            rc = lib().rts_ch_create(self._h(), object_id, max_size,
+                                     ctypes.byref(off))
         if rc == -1:
             raise ObjectExistsError(object_id.hex())
         if rc != 0:
@@ -218,13 +234,14 @@ class ShmStore:
         if len(object_id) != ID_LEN:
             raise ValueError(f"channel id must be {ID_LEN} bytes")
         off = ctypes.c_uint64()
-        rc = lib().rts_ch_write_acquire(
-            self._h(), object_id, len(data), ctypes.byref(off))
-        if rc != 0:
-            raise ShmStoreError(f"write_acquire failed rc={rc}")
-        self._map[off.value:off.value + len(data)] = data
-        if lib().rts_ch_write_release(self._h(), object_id) != 0:
-            raise ShmStoreError("write_release failed")
+        with self._guard.read():
+            rc = lib().rts_ch_write_acquire(
+                self._h(), object_id, len(data), ctypes.byref(off))
+            if rc != 0:
+                raise ShmStoreError(f"write_acquire failed rc={rc}")
+            self._map[off.value:off.value + len(data)] = data
+            if lib().rts_ch_write_release(self._h(), object_id) != 0:
+                raise ShmStoreError("write_release failed")
 
     def channel_read(self, object_id: bytes, *, min_version: int = -1,
                      timeout: float = 10.0) -> Tuple[bytes, int]:
@@ -243,42 +260,52 @@ class ShmStore:
         deadline = time.monotonic() + timeout
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
-        seen = lib().rts_ch_wait(self._h(), object_id, 0xFFFFFFFF, 0)
+        with self._guard.read():
+            seen = lib().rts_ch_wait(self._h(), object_id, 0xFFFFFFFF, 0)
+        # Guard per iteration, not around the whole loop: each futex
+        # wait is bounded to 0.5s, so a close() (write side) waits at
+        # most one slice instead of the full read deadline.
         while True:
-            v = lib().rts_ch_read(self._h(), object_id,
-                                  ctypes.byref(off), ctypes.byref(size))
-            if v >= 0 and v > min_version and size.value > 0:
-                data = bytes(
-                    self._map[off.value:off.value + size.value])
-                # seqlock re-check: version must be unchanged after copy
-                v2 = lib().rts_ch_read(self._h(), object_id,
-                                       ctypes.byref(off),
-                                       ctypes.byref(size))
-                if v2 == v:
-                    return data, int(v)
-            if v == -1:
-                raise ShmStoreError("channel missing")
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise TimeoutError("channel read timed out")
-            if seen == -1:
-                # Initial sample raced channel creation (the channel
-                # exists now — rts_ch_read just found it): re-sample
-                # without blocking and re-check the version first.
-                seen = lib().rts_ch_wait(self._h(), object_id,
-                                         0xFFFFFFFF, 0)
-                continue
-            # Block until the next write (bounded so the deadline
-            # holds); re-sample the counter for the next iteration.
-            seen = lib().rts_ch_wait(
-                self._h(), object_id, seen,
-                max(1, int(min(remaining, 0.5) * 1000)))
+            with self._guard.read():
+                v = lib().rts_ch_read(self._h(), object_id,
+                                      ctypes.byref(off),
+                                      ctypes.byref(size))
+                if v >= 0 and v > min_version and size.value > 0:
+                    data = bytes(
+                        self._map[off.value:off.value + size.value])
+                    # seqlock re-check: version must be unchanged after
+                    # the copy
+                    v2 = lib().rts_ch_read(self._h(), object_id,
+                                           ctypes.byref(off),
+                                           ctypes.byref(size))
+                    if v2 == v:
+                        return data, int(v)
+                if v == -1:
+                    raise ShmStoreError("channel missing")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("channel read timed out")
+                if seen == -1:
+                    # Initial sample raced channel creation (the channel
+                    # exists now — rts_ch_read just found it): re-sample
+                    # without blocking and re-check the version first.
+                    seen = lib().rts_ch_wait(self._h(), object_id,
+                                             0xFFFFFFFF, 0)
+                    continue
+                # Block until the next write (bounded so the deadline
+                # holds); re-sample the counter for the next iteration.
+                seen = lib().rts_ch_wait(
+                    self._h(), object_id, seen,
+                    max(1, int(min(remaining, 0.5) * 1000)))
 
     def close(self):
-        if self._handle:
-            lib().rts_disconnect(self._handle)
-            self._handle = None  # raylint: disable=unguarded-handle-teardown -- close() runs at runtime shutdown after users quiesce; migrating _native clients to HandleGuard is a ROADMAP open item
-            self._map.close()
+        # Write side: drains in-flight native calls and blocks new ones
+        # before the handle is freed and the mapping unmapped.
+        with self._guard.write():
+            if self._handle:
+                lib().rts_disconnect(self._handle)
+                self._handle = None
+                self._map.close()
 
     @staticmethod
     def unlink(name: str):

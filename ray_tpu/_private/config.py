@@ -133,6 +133,19 @@ _D("enable_timeline", bool, True, "Record task timeline events.")
 _D("log_to_driver", bool, True,
    "Tail spawned-worker logs back to the driver's stderr.")
 _D("task_event_buffer_max", int, 100_000, "Max buffered task state events.")
+_D("flight_recorder_enabled", bool, True,
+   "Always-on bounded ring of structured runtime events (scheduler, "
+   "object transfer, serve, autoscaler) dumped on unhandled failures "
+   "and via `ray_tpu debug dump`.")
+_D("flight_recorder_max_events", int, 4096,
+   "Ring-buffer capacity of the flight recorder; oldest events drop.")
+_D("flight_recorder_dir", str, "",
+   "Directory for automatic flight-recorder dumps "
+   "('' = <session_dir>/flight_recorder, or the system tempdir when "
+   "no runtime is alive).")
+_D("flight_recorder_auto_dump_min_interval_s", float, 5.0,
+   "Rate limit between automatic crash dumps (a crash storm must not "
+   "turn the recorder into a disk-filling loop).")
 _D("gang_schedule_timeout_s", float, 60.0,
    "Timeout for atomically acquiring all bundles of a placement group.")
 _D("cluster_poll_interval_s", float, 0.5,

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 
 from ..core.resources import ResourceSet
 from ..core.scheduler import NodeState
+from ..observability import get_recorder
 
 logger = logging.getLogger("ray_tpu")
 
@@ -167,6 +168,8 @@ class StandardAutoscaler:
 
     def _launched(self, node_id: str) -> None:
         self._pending_launch[node_id] = time.monotonic()
+        get_recorder().record("autoscaler", "node_launched",
+                              node=node_id)
         if self._on_node_launched is not None:
             try:
                 self._on_node_launched(node_id)
@@ -357,6 +360,9 @@ class StandardAutoscaler:
                 if (now - since >= self.config.idle_timeout_s
                         and n_alive - term_t > tc.min_workers):
                     self.provider.terminate_node(node_id)
+                    get_recorder().record(
+                        "autoscaler", "node_terminated", node=node_id,
+                        node_type=t, reason="idle")
                     self._idle_since.pop(node_id, None)
                     terminated += 1
                     term_t += 1
@@ -401,6 +407,9 @@ class StandardAutoscaler:
             if (now - since >= self.config.idle_timeout_s
                     and n_alive - terminated > self.config.min_workers):
                 self.provider.terminate_node(node_id)
+                get_recorder().record(
+                    "autoscaler", "node_terminated", node=node_id,
+                    reason="idle")
                 self._idle_since.pop(node_id, None)
                 terminated += 1
         return {"launched": launched, "terminated": terminated}

@@ -17,6 +17,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .._private.config import config
+from ..observability import get_recorder, record_task_metrics
+from ..util import tracing as _tracing
 from .exceptions import (
     ActorDiedError,
     ObjectLostError,
@@ -185,6 +187,19 @@ class ActorState:
             self._death_done = True
         self.dead.set()
         self.ready.set()
+        # Flight-recorder bookkeeping OUTSIDE the death lock (auto_dump
+        # does file IO). Deliberate exits (kill / exit_actor) are
+        # recorded but don't trigger a crash dump.
+        cause = self.death_cause
+        deliberate = isinstance(cause, ActorDiedError) and any(
+            s in str(cause) for s in ("Killed via", "exit_actor"))
+        rec = get_recorder()
+        rec.record("scheduler", "actor_died",
+                   actor=self.name or self.actor_id.hex(),
+                   cause=repr(cause)[:200] if cause else "shutdown",
+                   restarts=self.restarts)
+        if cause is not None and not deliberate:
+            rec.auto_dump("actor_died")
         # Drain all mailboxes (+ redelivery queue) with death errors.
         drains = [self.redeliver_q, self.mailbox,
                   *self.group_mailboxes.values()]
@@ -260,6 +275,12 @@ class ActorState:
                     continue
             if spec is ActorState._WAKE:
                 continue
+            if spec is None and not self.dead.is_set():
+                # Stale kill sentinel: the previous generation exited on
+                # the dead flag without consuming it and the restart
+                # already cleared dead — honoring it would kill the
+                # fresh generation with no cause.
+                continue
             if spec is None or self.dead.is_set():
                 # A real spec popped in the same race as the kill must
                 # reach the death drain — breaking here would drop it
@@ -316,6 +337,8 @@ class ActorState:
                 if spec is ActorState._WAKE:
                     continue
                 if spec is None:
+                    if not self.dead.is_set():
+                        continue  # stale kill sentinel (see _sync_main)
                     break
 
                 async def run_one(s=spec):
@@ -348,9 +371,37 @@ class ActorState:
         method = getattr(self.instance, spec.method_name)
         return method
 
+    def _enter_method_trace(self, spec: TaskSpec) -> contextlib.ExitStack:
+        """Lifecycle stamps + trace re-entry shared by the sync/async/
+        proc method runners. Mailbox pickup = scheduled; now = running."""
+        spec.timing.setdefault("scheduled", time.time())
+        spec.timing["running"] = time.time()
+        stack = contextlib.ExitStack()
+        if spec.trace_id:
+            stack.enter_context(_tracing.trace_context(
+                spec.trace_id, spec.parent_span_id))
+            stack.enter_context(_tracing.span(
+                f"actor:{spec.display_name()}", "actor_execute",
+                task_id=spec.task_id.hex(),
+                actor_id=self.actor_id.hex()))
+        return stack
+
+    def _finish_method(self, spec: TaskSpec, t0: float,
+                       failed: bool) -> None:
+        spec.timing["finished"] = time.time()
+        self.rt._task_finished(spec)
+        record_task_metrics(spec.timing,
+                            "FAILED" if failed else "FINISHED")
+        self.rt.events.record(
+            spec.display_name(), t0, time.monotonic(),
+            self.node.node_id, spec.task_id.hex(),
+            timing=spec.timing, trace_id=spec.trace_id)
+
     def _run_method(self, spec: TaskSpec):
         _ctx.task_id = spec.task_id
         t0 = time.monotonic()
+        failed = False
+        trace_cm = self._enter_method_trace(spec)
         try:
             method = self._bind_method(spec)
             args, kwargs = self.rt._materialize_args(spec)
@@ -363,14 +414,18 @@ class ActorState:
                 self.actor_id.hex(), "exit_actor() was called.")
             self.dead.set()
         except BaseException as e:  # noqa: BLE001
+            failed = True
             self.rt._store_error(spec, _wrap(spec, e), t0)
         finally:
+            trace_cm.close()
             _ctx.task_id = None
-            self.rt._task_finished(spec)
+            self._finish_method(spec, t0, failed)
 
     async def _run_method_async(self, spec: TaskSpec):
         _ctx.task_id = spec.task_id
         t0 = time.monotonic()
+        failed = False
+        trace_cm = self._enter_method_trace(spec)
         try:
             method = self._bind_method(spec)
             args, kwargs = self.rt._materialize_args(spec)
@@ -385,10 +440,12 @@ class ActorState:
                 self.actor_id.hex(), "exit_actor() was called.")
             self.dead.set()
         except BaseException as e:  # noqa: BLE001
+            failed = True
             self.rt._store_error(spec, _wrap(spec, e), t0)
         finally:
+            trace_cm.close()
             _ctx.task_id = None
-            self.rt._task_finished(spec)
+            self._finish_method(spec, t0, failed)
 
 
 class ProcActorState(ActorState):
@@ -444,6 +501,8 @@ class ProcActorState(ActorState):
             if self.runtime_env:
                 create_msg["runtime_env"] = self.runtime_env
             reply = w.run_task(create_msg)
+            for ev in reply.get("spans") or ():
+                self.rt.events.record_raw(ev)
             if reply.get("error") is not None:
                 raise self.rt._unpack_error(reply["error"])
             self._worker = w
@@ -471,8 +530,10 @@ class ProcActorState(ActorState):
         spec.redelivered = False  # fresh delivery (incl. retry passes)
         _ctx.task_id = spec.task_id
         t0 = time.monotonic()
+        failed = False
         streaming = spec.num_returns in ("streaming", "dynamic")
         gst = self.rt._generators.get(spec.task_id) if streaming else None
+        trace_cm = self._enter_method_trace(spec)
         try:
             msg = {
                 "type": "actor_call",
@@ -486,6 +547,10 @@ class ProcActorState(ActorState):
                 "return_ids": [oid.binary() for oid in spec.return_ids],
                 "streaming": streaming,
             }
+            if spec.trace_id:
+                msg["trace_id"] = spec.trace_id
+                msg["parent_span_id"] = (_tracing.current_span_id()
+                                         or spec.parent_span_id)
             if streaming and gst is not None:
                 msg["backpressure"] = \
                     config.generator_backpressure_max_items
@@ -513,6 +578,10 @@ class ProcActorState(ActorState):
                 if gst is not None:
                     with gst.cv:
                         gst.ack_cb = None
+            # Merge worker-side spans BEFORE the error check — failed
+            # calls keep their trace.
+            for ev in reply.get("spans") or ():
+                self.rt.events.record_raw(ev)
             if reply.get("error") is not None:
                 err = self.rt._unpack_error(reply["error"])
                 if isinstance(err, _ActorExit):
@@ -535,6 +604,11 @@ class ProcActorState(ActorState):
             if left is None:
                 left = self.max_task_retries
             will_restart = self.restarts < self.max_restarts
+            get_recorder().record(
+                "scheduler", "actor_worker_crashed",
+                actor=self.name or self.actor_id.hex(),
+                method=spec.method_name or "",
+                will_restart=will_restart)
             self.death_cause = ActorDiedError(
                 self.actor_id.hex(), f"worker process died: {e}")
             self._restartable_kill = True  # honor max_restarts
@@ -554,14 +628,17 @@ class ProcActorState(ActorState):
                     self.mailbox.put_nowait(ActorState._WAKE)
                 self.dead.set()
                 return
+            failed = True
             self.rt._store_error(spec, _wrap(spec, e), t0)
             self.dead.set()
         except BaseException as e:  # noqa: BLE001
+            failed = True
             self.rt._store_error(spec, _wrap(spec, e), t0)
         finally:
+            trace_cm.close()
             _ctx.task_id = None
             if not spec.redelivered:
-                self.rt._task_finished(spec)
+                self._finish_method(spec, t0, failed)
 
     def _die(self, gen: int):
         super()._die(gen)

@@ -41,6 +41,8 @@ from .resources import CPU, TPU, ResourceSet
 from .runtime_env import applied as _renv_applied
 from .scheduler import NodeState, Scheduler
 from .task import FunctionDescriptor, TaskSpec, TaskType
+from ..observability import get_recorder, record_task_metrics
+from ..util import tracing as _tracing
 
 logger = logging.getLogger("ray_tpu")
 
@@ -459,13 +461,21 @@ class Runtime:
         )
         spec.retries_left = spec.max_retries
         gen_state = None
-        if streaming:
-            gen_state = _GeneratorState()
-            self._generators[task_id] = gen_state
-        self._record_lineage(spec)
-        with self._pending_lock:
-            self._pending_tasks[task_id] = spec
-        self._submit_when_ready(spec)
+        # Submission span: roots a trace (or joins the caller's), and
+        # its id becomes the parent of the downstream execution spans —
+        # the Dapper propagation chain starts here.
+        with _tracing.span(f"submit:{spec.display_name()}",
+                           "task_submit", task_id=task_id.hex()) as sid:
+            spec.trace_id = _tracing.current_trace_id()
+            spec.parent_span_id = sid
+            spec.timing["submitted"] = time.time()
+            if streaming:
+                gen_state = _GeneratorState()
+                self._generators[task_id] = gen_state
+            self._record_lineage(spec)
+            with self._pending_lock:
+                self._pending_tasks[task_id] = spec
+            self._submit_when_ready(spec)
         if streaming:
             return ObjectRefGenerator(task_id, gen_state)
         refs = [self.register_ref(ObjectRef(oid)) for oid in spec.return_ids]
@@ -663,16 +673,24 @@ class Runtime:
         if streaming:
             gst = _GeneratorState()
             self._generators[task_id] = gst
-        self._record_lineage(spec)
-        with self._pending_lock:
-            self._pending_tasks[task_id] = spec
-        # Concurrency-group routing (validated above). Actors without
-        # dedicated group pools (proc/async) collapse groups into the
-        # single ordered mailbox.
-        if group is not None and st._group_pools():
-            st.group_mailboxes[group].put(spec)
-        else:
-            st.mailbox.put(spec)
+        with _tracing.span(f"submit:{spec.display_name()}",
+                           "task_submit", task_id=task_id.hex()) as sid:
+            spec.trace_id = _tracing.current_trace_id()
+            spec.parent_span_id = sid
+            spec.timing["submitted"] = time.time()
+            self._record_lineage(spec)
+            with self._pending_lock:
+                self._pending_tasks[task_id] = spec
+            # Queued = handed to the actor's mailbox (actor calls bypass
+            # the scheduler; the mailbox IS their queue).
+            spec.timing["queued"] = time.time()
+            # Concurrency-group routing (validated above). Actors without
+            # dedicated group pools (proc/async) collapse groups into the
+            # single ordered mailbox.
+            if group is not None and st._group_pools():
+                st.group_mailboxes[group].put(spec)
+            else:
+                st.mailbox.put(spec)
         if streaming:
             return ObjectRefGenerator(task_id, gst)
         refs = [self.register_ref(ObjectRef(oid)) for oid in spec.return_ids]
@@ -863,6 +881,13 @@ class Runtime:
             "return_ids": [oid.binary() for oid in spec.return_ids],
             "streaming": streaming,
         }
+        if spec.trace_id:
+            # Trace propagation across the process boundary: the worker
+            # re-enters this trace, parented to the driver-side span
+            # active at pack time (the execute span).
+            msg["trace_id"] = spec.trace_id
+            msg["parent_span_id"] = (_tracing.current_span_id()
+                                     or spec.parent_span_id)
         if streaming and spec.task_id in self._generators:
             # Only with a LIVE consumer: reconstruction re-runs have
             # nobody sending credits — a watermark would deadlock them.
@@ -886,6 +911,10 @@ class Runtime:
         else:
             self.store.put(
                 oid, serialization.SerializedObject.from_bytes(payload))
+        get_recorder().record(
+            "object_transfer", "result_stored",
+            object_id=oid.hex()[:16], kind=kind,
+            node=node_id or "local")
 
     def _unpack_error(self, packed) -> BaseException:
         _, payload = packed
@@ -936,11 +965,22 @@ class Runtime:
         from .worker_proc import WorkerCrashedError
 
         t0 = time.monotonic()
+        spec.timing["running"] = time.time()
         retried = False
+        failed = False
         worker = None
         ran_on_worker = False
         streaming = spec.num_returns in ("streaming", "dynamic")
         gst = self._generators.get(spec.task_id) if streaming else None
+        # Re-enter the submission trace so the driver-side execute span
+        # (and via _pack_task_msg, the worker-side spans) link to it.
+        trace_cm = contextlib.ExitStack()
+        if spec.trace_id:
+            trace_cm.enter_context(_tracing.trace_context(
+                spec.trace_id, spec.parent_span_id))
+            trace_cm.enter_context(_tracing.span(
+                f"execute:{spec.display_name()}", "task_execute",
+                task_id=spec.task_id.hex(), node=node.node_id))
         try:
             if spec.task_id in self._cancelled:
                 raise TaskCancelledError(spec.display_name())
@@ -977,6 +1017,10 @@ class Runtime:
                     with gst.cv:
                         gst.ack_cb = None
             worker.exported_fns.add(msg["fid"])
+            # Merge worker-side spans BEFORE the error check — a failed
+            # task's trace is the one someone will actually read.
+            for ev in reply.get("spans") or ():
+                self.events.record_raw(ev)
             if reply.get("error") is not None:
                 raise self._unpack_error(reply["error"])
             if streaming and gst is not None:
@@ -989,13 +1033,22 @@ class Runtime:
                     self._store_packed(oid, packed)
         except WorkerCrashedError as e:
             retried = self._maybe_retry_system(spec, e)
+            rec = get_recorder()
+            rec.record("scheduler", "worker_crashed",
+                       task=spec.display_name(),
+                       task_id=spec.task_id.hex(), node=node.node_id,
+                       retried=retried)
             if not retried:
+                failed = True
                 self._store_error(spec, _wrap(spec, e), t0)
+                rec.auto_dump("worker_crashed")
         except BaseException as e:  # noqa: BLE001
             retried = self._maybe_retry(spec, e)
             if not retried:
+                failed = True
                 self._store_error(spec, _wrap(spec, e), t0)
         finally:
+            trace_cm.close()
             with self._running_lock:
                 self._running_proc.pop(spec.task_id, None)
             if worker is not None:
@@ -1014,17 +1067,30 @@ class Runtime:
                 else:
                     node.pool.release(worker)
             if not retried:
+                spec.timing["finished"] = time.time()
                 self._task_finished(spec)
+                record_task_metrics(
+                    spec.timing, "FAILED" if failed else "FINISHED")
             self.scheduler.release_task(spec, node.node_id)
             self.events.record(
                 spec.display_name(), t0, time.monotonic(),
-                node.node_id, spec.task_id.hex())
+                node.node_id, spec.task_id.hex(),
+                timing=spec.timing, trace_id=spec.trace_id)
 
     def _execute(self, spec: TaskSpec, node: NodeState):
         t0 = time.monotonic()
+        spec.timing["running"] = time.time()
         prev_task, prev_node = _ctx.task_id, _ctx.node_id
         _ctx.task_id, _ctx.node_id = spec.task_id, node.node_id
         retried = False
+        failed = False
+        trace_cm = contextlib.ExitStack()
+        if spec.trace_id:
+            trace_cm.enter_context(_tracing.trace_context(
+                spec.trace_id, spec.parent_span_id))
+            trace_cm.enter_context(_tracing.span(
+                f"execute:{spec.display_name()}", "task_execute",
+                task_id=spec.task_id.hex(), node=node.node_id))
         try:
             if spec.task_id in self._cancelled:
                 raise TaskCancelledError(spec.display_name())
@@ -1036,15 +1102,21 @@ class Runtime:
         except BaseException as e:  # noqa: BLE001
             retried = self._maybe_retry(spec, e)
             if not retried:
+                failed = True
                 self._store_error(spec, _wrap(spec, e), t0)
         finally:
+            trace_cm.close()
             _ctx.task_id, _ctx.node_id = prev_task, prev_node
             if not retried:
+                spec.timing["finished"] = time.time()
                 self._task_finished(spec)
+                record_task_metrics(
+                    spec.timing, "FAILED" if failed else "FINISHED")
             self.scheduler.release_task(spec, node.node_id)
             self.events.record(
                 spec.display_name(), t0, time.monotonic(),
-                node.node_id, spec.task_id.hex())
+                node.node_id, spec.task_id.hex(),
+                timing=spec.timing, trace_id=spec.trace_id)
 
     def _maybe_retry(self, spec: TaskSpec, e: BaseException) -> bool:
         if isinstance(e, (TaskCancelledError, _ActorExit)):
@@ -1171,6 +1243,14 @@ class Runtime:
         err = exc if isinstance(exc, TaskError) else TaskError(
             spec.display_name(),
             RuntimeError(f"ray_tpu internal error: {exc!r}"))
+        try:
+            rec = get_recorder()
+            rec.record("scheduler", "task_internal_failure",
+                       task=spec.display_name(),
+                       task_id=spec.task_id.hex(), error=repr(exc)[:200])
+            rec.auto_dump("task_internal_failure")
+        except Exception:  # noqa: BLE001 - recorder must not block failing
+            pass
         try:
             self._store_error(spec, err)
         except BaseException:  # noqa: BLE001 - e.g. err unpicklable

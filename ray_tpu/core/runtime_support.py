@@ -87,12 +87,22 @@ class TaskEventBuffer:
         self._events: List[dict] = []
 
     def record(self, name: str, phase_start: float, phase_end: float,
-               node_id: str, task_id: str, category: str = "task"):
-        self.record_raw({
+               node_id: str, task_id: str, category: str = "task",
+               *, timing: Optional[Dict[str, float]] = None,
+               trace_id: Optional[str] = None):
+        ev = {
             "name": name, "cat": category, "ph": "X",
             "ts": phase_start * 1e6, "dur": (phase_end - phase_start) * 1e6,
             "pid": node_id, "tid": task_id,
-        })
+        }
+        if timing or trace_id:
+            args: Dict[str, Any] = {}
+            if timing:
+                args["timing"] = dict(timing)
+            if trace_id:
+                args["trace_id"] = trace_id
+            ev["args"] = args
+        self.record_raw(ev)
 
     def record_raw(self, ev: dict) -> None:
         """Append a pre-built chrome-trace event (tasks + tracing spans).

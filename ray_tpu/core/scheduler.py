@@ -19,11 +19,13 @@ from __future__ import annotations
 
 import random
 import threading
+import time as _time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
 from .._private.config import config
+from ..observability import get_recorder
 from .resources import ResourceSet
 from .task import (
     NodeAffinitySchedulingStrategy,
@@ -192,6 +194,12 @@ class Scheduler:
         return tuple(sorted(spec.resources.to_dict().items()))
 
     def submit(self, spec: TaskSpec) -> None:
+        # Timestamp + recorder OUTSIDE the lock: observability work
+        # (however cheap) has no business under the scheduler lock.
+        spec.timing.setdefault("queued", _time.time())
+        get_recorder().record(
+            "scheduler", "task_queued", task=spec.display_name(),
+            task_id=spec.task_id.hex())
         with self._lock:
             self._queue.append(spec)
             if self._shape_key(spec) in self._barren_shapes:
@@ -378,6 +386,10 @@ class Scheduler:
                 if not q:
                     del self._parked[key]
         for spec, node in granted:
+            spec.timing.setdefault("scheduled", _time.time())
+            get_recorder().record(
+                "scheduler", "task_granted", task=spec.display_name(),
+                task_id=spec.task_id.hex(), node=node.node_id)
             self._dispatch(spec, node)
 
     def _feasible_anywhere(self, spec: TaskSpec) -> bool:

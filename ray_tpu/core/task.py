@@ -169,6 +169,15 @@ class TaskSpec:
     # set for actor-creation tasks
     actor_class: Any = None
     actor_creation_opts: Optional[Dict[str, Any]] = None
+    # distributed tracing (Dapper-style): set at submission when a trace
+    # is active; carried through scheduling into worker execution so
+    # cross-process spans link into one trace.
+    trace_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
+    # lifecycle timestamps (time.time() epoch): submitted/queued/
+    # scheduled/running/finished, stamped as the spec moves through the
+    # pipeline and surfaced via state.list_tasks / summarize_tasks.
+    timing: Dict[str, float] = field(default_factory=dict)
 
     def is_actor_task(self) -> bool:
         return self.task_type == TaskType.ACTOR_TASK

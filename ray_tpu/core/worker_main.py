@@ -17,6 +17,7 @@ guarantees — there is a single caller, the driver).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 import socket
 import sys
@@ -132,6 +133,21 @@ def main() -> None:
     send_msg(sock, {"type": "hello", "worker_id": args.worker_id,
                     "pid": os.getpid()})
 
+    # Worker-side tracing: there is no Runtime in this process, so
+    # finished spans buffer here and piggyback on result replies — the
+    # driver merges them into its event buffer, giving `ray_tpu
+    # timeline` a multi-process trace.
+    from ray_tpu.util import tracing as _tracing
+
+    _tracing.set_process_label(str(os.getpid()))
+    _span_buf: list = []
+    _tracing.setup_tracing(_span_buf.append)
+
+    def _drain_spans():
+        out = list(_span_buf)
+        _span_buf.clear()
+        return out
+
     fn_cache: Dict[bytes, Any] = {}
     actors: Dict[bytes, Any] = {}
 
@@ -156,12 +172,29 @@ def main() -> None:
             continue
 
         task_id = msg.get("task_id")
+        # Re-enter the driver's trace: the outer span covers unpack +
+        # user code in THIS process, parented to the driver's execute
+        # span; an inner span isolates the user call itself.
+        traced = msg.get("trace_id") is not None
+        trace_cm = contextlib.ExitStack()
+        if traced:
+            trace_cm.enter_context(_tracing.trace_context(
+                msg["trace_id"], msg.get("parent_span_id")))
+            trace_cm.enter_context(_tracing.span(
+                f"worker:{mtype}", "worker_execute",
+                task_id=task_id.hex() if task_id is not None else None))
+
+        def _run_span(label):
+            return (_tracing.span(f"run:{label}", "worker_run")
+                    if traced else contextlib.nullcontext())
+
         try:
             if mtype == "task":
                 fn = get_fn(msg)
                 call_args, call_kwargs = _unpack_args(
                     msg["args"], msg["kwargs"], shm)
-                with _runtime_env(msg.get("runtime_env")):
+                with _runtime_env(msg.get("runtime_env")), \
+                        _run_span(getattr(fn, "__qualname__", "task")):
                     result = fn(*call_args, **call_kwargs)
             elif mtype == "actor_create":
                 import cloudpickle
@@ -169,7 +202,8 @@ def main() -> None:
                 cls = cloudpickle.loads(msg["cls"])
                 call_args, call_kwargs = _unpack_args(
                     msg["args"], msg["kwargs"], shm)
-                with _runtime_env(msg.get("runtime_env")):
+                with _runtime_env(msg.get("runtime_env")), \
+                        _run_span(getattr(cls, "__qualname__", "actor")):
                     actors[msg["actor_id"]] = cls(*call_args, **call_kwargs)
                 result = None
             elif mtype == "actor_call":
@@ -186,7 +220,8 @@ def main() -> None:
                     method = getattr(inst, msg["method"])
                 call_args, call_kwargs = _unpack_args(
                     msg["args"], msg["kwargs"], shm)
-                with _runtime_env(msg.get("runtime_env")):
+                with _runtime_env(msg.get("runtime_env")), \
+                        _run_span(msg["method"]):
                     result = method(*call_args, **call_kwargs)
             elif mtype == "actor_kill":
                 actors.pop(msg["actor_id"], None)
@@ -200,9 +235,12 @@ def main() -> None:
 
                 result = asyncio.run(result)
         except BaseException as e:  # noqa: BLE001 — user code may raise anything
+            trace_cm.close()
             send_msg(sock, {"type": "result", "task_id": task_id,
-                            "error": _pack_error(e)})
+                            "error": _pack_error(e),
+                            "spans": _drain_spans()})
             continue
+        trace_cm.close()
 
         streaming = msg.get("streaming", False)
         if streaming and hasattr(result, "__next__"):
@@ -234,10 +272,11 @@ def main() -> None:
                         # anything else mid-stream is unexpected; skip
                 send_msg(sock, {"type": "result", "task_id": task_id,
                                 "error": None, "returns": [],
-                                "gen_count": i})
+                                "gen_count": i, "spans": _drain_spans()})
             except BaseException as e:  # noqa: BLE001
                 send_msg(sock, {"type": "result", "task_id": task_id,
-                                "error": _pack_error(e), "gen_count": i})
+                                "error": _pack_error(e), "gen_count": i,
+                                "spans": _drain_spans()})
             continue
 
         n = msg.get("num_returns", 1)
@@ -254,12 +293,14 @@ def main() -> None:
                     "type": "result", "task_id": task_id,
                     "error": _pack_error(ValueError(
                         f"declared num_returns={n} but returned "
-                        f"{len(values)} values"))})
+                        f"{len(values)} values")),
+                    "spans": _drain_spans()})
                 continue
             returns = [_pack_value(v, shm, args.inline_max, return_ids[i])
                        for i, v in enumerate(values)]
         send_msg(sock, {"type": "result", "task_id": task_id,
-                        "error": None, "returns": returns})
+                        "error": None, "returns": returns,
+                        "spans": _drain_spans()})
 
 
 if __name__ == "__main__":

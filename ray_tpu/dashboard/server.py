@@ -307,6 +307,11 @@ class DashboardServer:
 
             return _json(global_runtime().timeline())
 
+        async def flight_recorder(_):
+            from ..observability import get_recorder
+
+            return _json(get_recorder().snapshot())
+
         async def prom_metrics(_):
             return web.Response(text=metrics_mod.prometheus_text(),
                                 content_type="text/plain")
@@ -689,6 +694,7 @@ class DashboardServer:
         r.add_post("/api/profile", capture_profile)
         r.add_post("/api/kill_random_node", kill_random_node)
         r.add_get("/api/timeline", timeline)
+        r.add_get("/api/debug/flight_recorder", flight_recorder)
         r.add_get("/api/node_stats", node_stats)
         r.add_get("/metrics", prom_metrics)
         r.add_post("/api/jobs/", submit_job)

@@ -32,6 +32,11 @@ Rules (registry below; ``raylint --list-rules`` prints this table):
 - ``lock-order-inversion``    — two locks acquired in opposite nested
   orders across methods of one class (or one module's functions): a
   deadlock the moment both paths run concurrently.
+- ``ref-leak-in-loop``        — a ``.remote(...)`` result appended to
+  a list inside a ``while`` loop that never drains or bounds the list:
+  every retained ObjectRef pins its object in the store, so a
+  long-running producer loop fills the arena (the unbounded
+  in-flight-refs class).
 
 Suppressions are per line, must name the rule, and must carry a
 justification after ``--``::
@@ -672,6 +677,73 @@ def _check_unserializable_capture(ctx: FileContext) -> List[Finding]:
             visit(child, merged)
 
     visit(ctx.tree, {})
+    return out
+
+
+def _is_remote_submit(value: ast.AST) -> bool:
+    """True for ``f.remote(...)`` / ``f.options(...).remote(...)``."""
+    return (isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and value.func.attr == "remote")
+
+
+@rule("ref-leak-in-loop",
+      "ObjectRefs appended to a list inside a `while` loop that never "
+      "drains or bounds it")
+def _check_ref_leak_in_loop(ctx: FileContext) -> List[Finding]:
+    out = []
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, ast.While):
+            continue
+        # Names bound to `.remote(...)` results inside this loop body —
+        # `r = f.remote(); refs.append(r)` leaks the same way the
+        # direct `refs.append(f.remote())` does.
+        remote_names = set()
+        for n in ast.walk(loop):
+            if isinstance(n, ast.Assign) and _is_remote_submit(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        remote_names.add(t.id)
+        # A loop condition that reads the list bounds it (`while
+        # len(refs) < k:` is an accumulate-to-target, not a leak).
+        test_keys = {_expr_key(n) for n in ast.walk(loop.test)
+                     if isinstance(n, (ast.Name, ast.Attribute))}
+        appends: Dict[str, ast.AST] = {}
+        drained = set()
+        for n in ast.walk(loop):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)):
+                recv = _expr_key(n.func.value)
+                if n.func.attr == "append" and n.args:
+                    a = n.args[0]
+                    if (_is_remote_submit(a)
+                            or (isinstance(a, ast.Name)
+                                and a.id in remote_names)):
+                        appends.setdefault(recv, n)
+                elif n.func.attr in ("pop", "popleft", "clear", "remove"):
+                    drained.add(recv)
+            elif isinstance(n, ast.Delete):
+                for t in n.targets:
+                    if isinstance(t, ast.Subscript):
+                        drained.add(_expr_key(t.value))
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Subscript):
+                        # `refs[:k] = []` slice-drains in place
+                        drained.add(_expr_key(t.value))
+                    elif isinstance(t, (ast.Name, ast.Attribute)):
+                        # `refs = refs[k:]` rebinding
+                        drained.add(_expr_key(t))
+        for recv, node in appends.items():
+            if recv in drained or recv in test_keys:
+                continue
+            out.append(ctx.finding(
+                node, "ref-leak-in-loop",
+                f"`{recv}.append(<.remote() result>)` in a `while` loop "
+                f"that never drains `{recv}` — every retained ref pins "
+                f"its object in the store, so the arena fills for as "
+                f"long as the loop runs; pop/slice consumed refs or "
+                f"bound the loop on len({recv})"))
     return out
 
 

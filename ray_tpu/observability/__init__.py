@@ -1,0 +1,28 @@
+"""Observability: distributed tracing glue, task-lifecycle statistics,
+and the always-on flight recorder.
+
+The runtime's debuggability story (reference: Ray's task-event buffer
+feeding `ray timeline`, the state API, and dashboard metrics; Dapper's
+cross-process trace propagation) lives here:
+
+- recorder: bounded ring of structured events from the scheduler,
+  object transfer, serve, and autoscaler; dumped automatically on
+  unhandled worker/actor failure and on demand via `ray_tpu debug dump`.
+- taskstats: p50/p95/p99 latency breakdowns over task lifecycle
+  timestamps plus the ray_tpu_task_* metric series.
+"""
+
+from .recorder import FlightRecorder, get_recorder
+from .taskstats import (
+    latency_breakdown,
+    percentiles,
+    record_task_metrics,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "get_recorder",
+    "latency_breakdown",
+    "percentiles",
+    "record_task_metrics",
+]

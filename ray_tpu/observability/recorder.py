@@ -1,0 +1,149 @@
+"""Always-on flight recorder.
+
+A bounded ring buffer of structured events from the runtime's moving
+parts (scheduler decisions, object transfers, serve requests,
+autoscaler actions). It is cheap enough to leave on in production —
+recording is one deque append under a lock — and when something
+crashes or deadlocks the last few thousand events are the history that
+explains it (the black-box-recorder idea; reference: Ray's task event
+buffer + event aggregator, src/ray/core_worker/task_event_buffer.h).
+
+Dumps happen automatically on unhandled worker/actor failure
+(rate-limited so a crash storm can't fill the disk) and on demand via
+`ray_tpu debug dump` / the dashboard's /api/debug/flight_recorder.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .._private.config import config
+
+
+class FlightRecorder:
+    """Bounded ring of structured events; thread-safe, never raises
+    out of record()/auto_dump() — observability must not break the
+    thing it observes."""
+
+    def __init__(self, max_events: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._maxlen = int(max_events or config.flight_recorder_max_events)
+        self._ring: "collections.deque[Dict[str, Any]]" = \
+            collections.deque(maxlen=self._maxlen)
+        self._dropped = 0
+        self._last_auto_dump = 0.0
+
+    def record(self, component: str, event: str, **fields: Any) -> None:
+        """Append one event. No-op when disabled; O(1); lock held only
+        for the deque append."""
+        if not config.flight_recorder_enabled:
+            return
+        ev = {"ts": time.time(), "component": component, "event": event}
+        if fields:
+            ev.update(fields)
+        with self._lock:
+            if self._ring.maxlen != config.flight_recorder_max_events:
+                # Config changed since construction (tests tuning the
+                # bound): rebuild keeping the newest events.
+                self._maxlen = int(config.flight_recorder_max_events)
+                self._ring = collections.deque(self._ring,
+                                               maxlen=self._maxlen)
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            self._ring.append(ev)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "max_events": self._maxlen,
+                "dropped": self._dropped,
+                "events": list(self._ring),
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- dumping ---------------------------------------------------------
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "manual") -> str:
+        """Write the ring to a JSON file; → the path written."""
+        snap = self.snapshot()
+        snap["reason"] = reason
+        snap["dumped_at"] = time.time()
+        if path is None:
+            path = os.path.join(
+                _dump_dir(),
+                f"flight-{int(snap['dumped_at'] * 1000)}.json")
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+    def auto_dump(self, reason: str) -> Optional[str]:
+        """Crash-path dump: rate-limited, never raises. → path or None
+        (disabled / rate-limited / write failed)."""
+        if not config.flight_recorder_enabled:
+            return None
+        now = time.time()
+        with self._lock:
+            if (now - self._last_auto_dump
+                    < config.flight_recorder_auto_dump_min_interval_s):
+                return None
+            self._last_auto_dump = now
+        try:
+            path = self.dump(reason=reason)
+        except Exception:  # noqa: BLE001 - crash handling must not crash
+            return None
+        import logging
+        logging.getLogger("ray_tpu").warning(
+            "flight recorder dumped to %s (%s)", path, reason)
+        return path
+
+
+def _dump_dir() -> str:
+    if config.flight_recorder_dir:
+        return config.flight_recorder_dir
+    from ..core.runtime import global_runtime_or_none
+
+    rt = global_runtime_or_none()
+    session_dir = getattr(rt, "session_dir", None) if rt else None
+    if session_dir:
+        return os.path.join(session_dir, "flight_recorder")
+    return os.path.join(tempfile.gettempdir(), "ray_tpu_flight")
+
+
+def latest_dump_path() -> Optional[str]:
+    """Newest auto-dump file in the active dump dir, if any."""
+    d = _dump_dir()
+    try:
+        files = [os.path.join(d, n) for n in os.listdir(d)
+                 if n.startswith("flight-") and n.endswith(".json")]
+    except OSError:
+        return None
+    return max(files, key=os.path.getmtime) if files else None
+
+
+# Process-wide singleton: the recorder outlives runtime restarts so a
+# dump after shutdown still holds the pre-crash history.
+_RECORDER = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _RECORDER

@@ -1,0 +1,108 @@
+"""Task-lifecycle statistics.
+
+Tasks stamp submitted/queued/scheduled/running/finished timestamps into
+their spec as they move through the pipeline (TaskSpec.timing); the
+finish path reports them here, which (a) feeds the ray_tpu_task_*
+metric series on /metrics and (b) gives state.summarize_tasks its
+p50/p95/p99 queued/running latency breakdowns.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+_METRICS: Dict[str, Any] = {}
+_METRICS_LOCK = threading.Lock()
+
+_LATENCY_BOUNDS = [0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0]
+
+
+def percentiles(values: Sequence[float],
+                pcts: Sequence[float] = (50, 95, 99)) -> Dict[str, float]:
+    """{p50: ..., p95: ..., p99: ...} via nearest-rank (no numpy dep on
+    the state-API path)."""
+    if not values:
+        return {}
+    ordered = sorted(values)
+    out = {}
+    for p in pcts:
+        idx = min(len(ordered) - 1,
+                  max(0, int(round(p / 100.0 * len(ordered) + 0.5)) - 1))
+        out[f"p{int(p)}"] = ordered[idx]
+    return out
+
+
+def phase_latencies(timing: Dict[str, float]) -> Dict[str, float]:
+    """Per-phase durations (seconds) from lifecycle timestamps; only
+    phases whose endpoints were both stamped appear."""
+    out = {}
+    for label, start, end in (
+            ("queued_s", "queued", "scheduled"),
+            ("scheduled_s", "scheduled", "running"),
+            ("running_s", "running", "finished"),
+            ("total_s", "submitted", "finished")):
+        a, b = timing.get(start), timing.get(end)
+        if a is not None and b is not None and b >= a:
+            out[label] = b - a
+    return out
+
+
+def latency_breakdown(events: Iterable[dict]) -> Dict[str, Dict[str, float]]:
+    """Aggregate p50/p95/p99 per lifecycle phase over task events that
+    carry args.timing (the shape state.summarize_tasks exposes)."""
+    buckets: Dict[str, List[float]] = {}
+    for ev in events:
+        timing = (ev.get("args") or {}).get("timing")
+        if not timing:
+            continue
+        for label, dur in phase_latencies(timing).items():
+            buckets.setdefault(label, []).append(dur)
+    return {label: {**percentiles(vals), "count": len(vals)}
+            for label, vals in sorted(buckets.items())}
+
+
+def record_task_metrics(timing: Dict[str, float],
+                        status: str = "FINISHED") -> None:
+    """Emit the ray_tpu_task_* series for one finished task. Never
+    raises — metrics must not break task execution."""
+    try:
+        from ..util import metrics as metrics_mod
+
+        with _METRICS_LOCK:
+            if not _METRICS:
+                # Build ALL before publishing any: a partial init would
+                # silently drop part of the series forever.
+                try:
+                    finished = metrics_mod.Counter(
+                        "ray_tpu_task_finished_total",
+                        "Tasks reaching a terminal state",
+                        tag_keys=("status",))
+                    queued = metrics_mod.Histogram(
+                        "ray_tpu_task_queued_latency_s",
+                        "Submission-to-grant scheduler latency",
+                        boundaries=_LATENCY_BOUNDS)
+                    running = metrics_mod.Histogram(
+                        "ray_tpu_task_running_latency_s",
+                        "Execution wall time",
+                        boundaries=_LATENCY_BOUNDS)
+                except ValueError:
+                    return  # registry clash (tests clearing registries)
+                _METRICS["finished"] = finished
+                _METRICS["queued"] = queued
+                _METRICS["running"] = running
+        _METRICS["finished"].inc(tags={"status": status})
+        lat = phase_latencies(timing or {})
+        if "queued_s" in lat:
+            _METRICS["queued"].observe(lat["queued_s"])
+        if "running_s" in lat:
+            _METRICS["running"].observe(lat["running_s"])
+    except Exception:  # noqa: BLE001 - observability must not break tasks
+        pass
+
+
+def reset_metrics_cache() -> None:
+    """Test hook: forget cached metric objects so a cleared registry
+    re-registers them."""
+    with _METRICS_LOCK:
+        _METRICS.clear()

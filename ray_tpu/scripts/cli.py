@@ -246,6 +246,39 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_debug_dump(args) -> int:
+    """Dump the flight recorder: live runtime ring if one exists in
+    this process, a remote cluster's via --address, else the latest
+    automatic crash dump on disk."""
+    from ray_tpu.observability import recorder as _rec
+
+    if args.address:
+        snap = _fetch(args.address, "/api/debug/flight_recorder")
+        out = args.output or "flight_recorder.json"
+        with open(out, "w") as f:
+            json.dump(snap, f, indent=1)
+        print(f"Wrote {len(snap.get('events', []))} events to {out}")
+        return 0
+    from ray_tpu.core.runtime import global_runtime_or_none
+
+    rec = _rec.get_recorder()
+    if global_runtime_or_none() is not None or len(rec):
+        path = rec.dump(args.output, reason="cli")
+        print(f"Wrote {len(rec)} events to {path}")
+        return 0
+    latest = _rec.latest_dump_path()
+    if latest is None:
+        print("No live runtime and no flight-recorder dumps found")
+        return 1
+    if args.output:
+        import shutil
+
+        shutil.copyfile(latest, args.output)
+        latest = args.output
+    print(f"Latest flight-recorder dump: {latest}")
+    return 0
+
+
 def cmd_logs(args) -> int:
     """List or print session logs (reference: `ray logs` state CLI)."""
     import glob as _glob
@@ -526,9 +559,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("kind", choices=["tasks", "actors", "objects"])
     sp.set_defaults(fn=cmd_summary)
 
-    tp = sub.add_parser("timeline")
-    tp.add_argument("--output", default=None)
+    tp = sub.add_parser("timeline",
+                        help="export the merged multi-process chrome "
+                             "trace (open in Perfetto / chrome://tracing)")
+    tp.add_argument("--output", "--out", dest="output", default=None)
     tp.set_defaults(fn=cmd_timeline)
+
+    dbg = sub.add_parser("debug",
+                         help="debugging utilities (flight recorder)")
+    dbg_sub = dbg.add_subparsers(dest="debug_cmd", required=True)
+    dd = dbg_sub.add_parser("dump",
+                            help="dump the flight-recorder ring "
+                                 "(scheduler/transfer/serve/autoscaler "
+                                 "event history) to a JSON file")
+    dd.add_argument("--output", "--out", dest="output", default=None)
+    dd.set_defaults(fn=cmd_debug_dump)
 
     sub.add_parser("memory").set_defaults(fn=cmd_memory)
 

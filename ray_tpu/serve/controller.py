@@ -51,7 +51,7 @@ class _ReplicaSet:
         while len(self.replicas) < n:
             self.replicas.append(ReplicaActor.remote(
                 self.target_bytes, tuple(init_args), init_kwargs or {},
-                cfg.user_config))
+                cfg.user_config, self.deployment.name))
         while len(self.replicas) > n:
             victim = self.replicas.pop()
             try:

@@ -8,12 +8,32 @@ lower queue length wins; local ongoing-request accounting)."""
 
 from __future__ import annotations
 
+import contextvars
 import random
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from .. import get as ray_get
+
+# Propagated serve request id (Dapper-style): the proxy sets it for the
+# duration of routing; handle.remote() forwards it to the replica so
+# replica-side spans carry the same id the proxy logged.
+_request_id: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("ray_tpu_serve_request_id", default=None)
+
+
+def set_request_id(request_id: Optional[str]):
+    """→ reset token (contextvars.Token)."""
+    return _request_id.set(request_id)
+
+
+def reset_request_id(token) -> None:
+    _request_id.reset(token)
+
+
+def current_request_id() -> Optional[str]:
+    return _request_id.get()
 
 
 class Router:
@@ -123,12 +143,15 @@ class DeploymentHandle:
         self._router.maybe_refresh()
         replica = self._router.pick()
         method = "__call__" if self._method == "__call__" else self._method
+        request_id = current_request_id()
         if self._stream:
             gen = replica.handle_request_streaming.options(
-                num_returns="streaming").remote(method, args, kwargs)
+                num_returns="streaming").remote(
+                    method, args, kwargs, request_id)
             self._router.done(replica)
             return gen
-        ref = replica.handle_request.remote(method, args, kwargs)
+        ref = replica.handle_request.remote(method, args, kwargs,
+                                            request_id)
         fut = _ResponseFuture(ref, self._router, replica)
         # Auto-release the slot when the result lands (async accounting).
         from ..core.runtime import global_runtime

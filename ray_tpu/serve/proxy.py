@@ -13,7 +13,11 @@ import asyncio
 import json
 import threading
 import time as _time
+import uuid
 from typing import Any, Dict, Optional
+
+from ..observability import get_recorder
+from .handle import reset_request_id, set_request_id
 
 _METRICS = {}
 _METRICS_LOCK = threading.Lock()
@@ -98,9 +102,13 @@ class HttpProxy:
 
         from ..util import metrics as _metrics
 
+        from ..util.tracing import span as _span
+
         async def handler(request: "web.Request"):
             t0 = _time.perf_counter()
             name = request.match_info.get("app", "").strip("/")
+            request_id = (request.headers.get("X-Request-Id")
+                          or uuid.uuid4().hex[:16])
             with self._lock:
                 handle = self._routes.get(name)
             if handle is None:
@@ -115,21 +123,45 @@ class HttpProxy:
             else:
                 payload = dict(request.query)
             loop = asyncio.get_running_loop()
+            get_recorder().record("serve", "request_received",
+                                  application=name, request_id=request_id)
+            status = "200"
+            token = set_request_id(request_id)
             try:
-                fut = handle.remote(payload)
+                # Proxy-side span; handle.remote() runs in this
+                # coroutine context, so the request id (contextvar) and
+                # the trace both propagate to the chosen replica.
+                with _span(f"proxy:{name}", "serve_proxy",
+                           request_id=request_id):
+                    fut = handle.remote(payload)
                 result = await loop.run_in_executor(
                     None, lambda: fut.result(timeout=30))
             except BaseException as e:  # noqa: BLE001
+                status = "500"
                 _request_metrics(_metrics, name, "500",
                                  _time.perf_counter() - t0)
+                get_recorder().record(
+                    "serve", "request_failed", application=name,
+                    request_id=request_id, error=str(e)[:200])
                 return web.json_response(
-                    {"error": str(e)[:500]}, status=500)
+                    {"error": str(e)[:500]}, status=500,
+                    headers={"X-Request-Id": request_id})
+            finally:
+                reset_request_id(token)
+                get_recorder().record(
+                    "serve", "request_done", application=name,
+                    request_id=request_id, status=status,
+                    latency_s=round(_time.perf_counter() - t0, 6))
             _request_metrics(_metrics, name, "200",
                              _time.perf_counter() - t0)
             try:
-                return web.json_response({"result": result})
+                return web.json_response({"result": result},
+                                         headers={"X-Request-Id":
+                                                  request_id})
             except TypeError:
-                return web.json_response({"result": str(result)})
+                return web.json_response({"result": str(result)},
+                                         headers={"X-Request-Id":
+                                                  request_id})
 
         async def health(_request):
             return web.json_response({"status": "ok"})

@@ -9,16 +9,56 @@ from __future__ import annotations
 
 import inspect
 import threading
+import time as _time
 from typing import Any, Dict, Optional
+
+_METRICS: Dict[str, Any] = {}
+_METRICS_LOCK = threading.Lock()
+
+
+def _replica_metrics(deployment: str, status: str,
+                     latency_s: float) -> None:
+    """Per-deployment replica-side request metrics (reference: serve's
+    serve_deployment_processing_latency_ms / request counter)."""
+    try:
+        from ..util import metrics as metrics_mod
+
+        with _METRICS_LOCK:
+            if not _METRICS:
+                # Build BOTH before publishing either: a partial init
+                # would silently drop latency recording forever.
+                try:
+                    count = metrics_mod.Counter(
+                        "ray_tpu_serve_request_total",
+                        "Serve requests handled by replicas",
+                        tag_keys=("deployment", "status"))
+                    latency = metrics_mod.Histogram(
+                        "ray_tpu_serve_request_latency_s",
+                        "Replica-side request handling latency",
+                        boundaries=[0.001, 0.005, 0.02, 0.1, 0.5, 2.0],
+                        tag_keys=("deployment",))
+                except ValueError:
+                    return  # registry clash (tests clearing registries)
+                _METRICS["count"] = count
+                _METRICS["latency"] = latency
+        _METRICS["count"].inc(
+            tags={"deployment": deployment, "status": status})
+        if latency_s > 0:
+            _METRICS["latency"].observe(
+                latency_s, tags={"deployment": deployment})
+    except Exception:  # noqa: BLE001 - metrics must not break serving
+        pass
 
 
 class Replica:
     def __init__(self, target_bytes: bytes, init_args: tuple,
                  init_kwargs: dict,
-                 user_config: Optional[Dict[str, Any]] = None):
+                 user_config: Optional[Dict[str, Any]] = None,
+                 deployment_name: str = ""):
         import cloudpickle
 
         target = cloudpickle.loads(target_bytes)
+        self._deployment = deployment_name
         self._is_function = not inspect.isclass(target)
         if self._is_function:
             self._callable = target
@@ -48,20 +88,38 @@ class Replica:
         with self._lock:
             self._ongoing -= 1
 
-    def handle_request(self, method_name: str, args, kwargs):
+    def handle_request(self, method_name: str, args, kwargs,
+                       request_id: Optional[str] = None):
+        from ..util.tracing import span
+
         self._enter()
+        t0 = _time.perf_counter()
+        status = "200"
         try:
-            fn = (self._callable if self._is_function
-                  else getattr(self._callable, method_name))
-            result = fn(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                import asyncio
-                result = asyncio.get_event_loop().run_until_complete(result)
-            return result
+            # Replica-side span carries the proxy's propagated request
+            # id — proxy → replica → handler link into one trace.
+            with span(f"replica:{self._deployment or 'deployment'}"
+                      f".{method_name}", "serve_replica",
+                      request_id=request_id,
+                      deployment=self._deployment):
+                fn = (self._callable if self._is_function
+                      else getattr(self._callable, method_name))
+                result = fn(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    import asyncio
+                    result = asyncio.get_event_loop() \
+                        .run_until_complete(result)
+                return result
+        except BaseException:
+            status = "500"
+            raise
         finally:
             self._exit()
+            _replica_metrics(self._deployment or "?", status,
+                             _time.perf_counter() - t0)
 
-    def handle_request_streaming(self, method_name: str, args, kwargs):
+    def handle_request_streaming(self, method_name: str, args, kwargs,
+                                 request_id: Optional[str] = None):
         self._enter()
         try:
             fn = (self._callable if self._is_function

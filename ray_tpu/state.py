@@ -99,13 +99,27 @@ def list_tasks(*, filters: Optional[Sequence[Filter]] = None,
             "required_resources": spec.resources.to_dict(),
         })
     for ev in rt.events.dump()[-limit:]:
-        rows.append({
+        if "span:" in str(ev.get("tid", "")):
+            continue  # tracing spans are not task rows
+        row = {
             "task_id": ev.get("tid"),
             "name": ev.get("name"),
             "state": "FINISHED",
             "type": "TASK_EVENT",
             "duration_ms": round(ev.get("dur", 0) / 1000, 3),
-        })
+        }
+        args = ev.get("args") or {}
+        timing = args.get("timing")
+        if timing:
+            from .observability.taskstats import phase_latencies
+
+            # Absolute lifecycle timestamps + derived per-phase ms.
+            row["timing"] = dict(timing)
+            for label, dur in phase_latencies(timing).items():
+                row[label.replace("_s", "_ms")] = round(dur * 1000, 3)
+        if args.get("trace_id"):
+            row["trace_id"] = args["trace_id"]
+        rows.append(row)
     return _apply_filters(rows, filters, limit)
 
 
@@ -169,12 +183,21 @@ def list_placement_groups(*, filters: Optional[Sequence[Filter]] = None,
 # ---------------------------------------------------------------------------
 
 def summarize_tasks() -> Dict[str, Any]:
+    from .observability.taskstats import latency_breakdown
+
     rows = list_tasks(limit=10_000)
     by_name: Dict[str, Dict[str, int]] = {}
     for r in rows:
         d = by_name.setdefault(r["name"] or "?", {})
         d[r["state"]] = d.get(r["state"], 0) + 1
-    return {"total": len(rows), "by_func_name": by_name}
+    rt = _rt()
+    return {
+        "total": len(rows),
+        "by_func_name": by_name,
+        # p50/p95/p99 per lifecycle phase (queued_s/scheduled_s/
+        # running_s/total_s) over events carrying lifecycle stamps.
+        "latency_percentiles": latency_breakdown(rt.events.dump()),
+    }
 
 
 def summarize_actors() -> Dict[str, Any]:

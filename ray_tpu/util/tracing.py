@@ -8,6 +8,10 @@ dashboard's py-spy hooks for CPU profiles):
 
 - span(name): context manager recording a chrome-trace span into the
   runtime's task-event buffer, with parent links via a contextvar.
+  Spans root a Dapper-style trace: the first span in a context mints a
+  trace_id, nested spans inherit it, and trace_context() re-installs a
+  propagated (trace_id, parent_span_id) pair on the far side of a
+  process boundary so worker-side spans link into the driver's trace.
 - setup_tracing(hook): register an exporter callback invoked with every
   finished span (the reference's _tracing_startup_hook analog); also
   reads RAY_TPU_TRACING_HOOK="module:function" at init.
@@ -28,10 +32,25 @@ from typing import Any, Callable, Dict, List, Optional
 
 _current_span: contextvars.ContextVar[Optional[str]] = \
     contextvars.ContextVar("ray_tpu_span", default=None)
+_current_trace: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("ray_tpu_trace", default=None)
 
 _hooks: List[Callable[[Dict[str, Any]], None]] = []
 _hooks_lock = threading.Lock()
 _env_hook_added = False
+# enable_timeline value before the first setup_tracing() flipped it;
+# None = tracing never set up (nothing to restore).
+_prev_enable_timeline: Optional[bool] = None
+
+# Chrome-trace `pid` for spans from this process. The driver keeps the
+# stable label "driver"; worker processes call set_process_label() at
+# startup so a merged trace separates processes.
+_process_label: str = "driver"
+
+
+def set_process_label(label: str) -> None:
+    global _process_label
+    _process_label = str(label)
 
 
 def setup_tracing(hook: Optional[Callable[[Dict[str, Any]], None]] = None
@@ -40,8 +59,10 @@ def setup_tracing(hook: Optional[Callable[[Dict[str, Any]], None]] = None
     span. Also honors RAY_TPU_TRACING_HOOK=module:function."""
     from .._private.config import config
 
-    global _env_hook_added
+    global _env_hook_added, _prev_enable_timeline
 
+    if _prev_enable_timeline is None:
+        _prev_enable_timeline = bool(config.enable_timeline)
     config.enable_timeline = True
     with _hooks_lock:
         if hook is not None:
@@ -57,17 +78,31 @@ def setup_tracing(hook: Optional[Callable[[Dict[str, Any]], None]] = None
 
 
 def clear_tracing() -> None:
-    global _env_hook_added
+    """Fully reset exporter state: drop all hooks (including the env
+    hook, so a later setup_tracing() re-registers it) and restore
+    enable_timeline to its pre-setup value."""
+    from .._private.config import config
+
+    global _env_hook_added, _prev_enable_timeline
     with _hooks_lock:
         _hooks.clear()
         _env_hook_added = False
+    if _prev_enable_timeline is not None:
+        config.enable_timeline = _prev_enable_timeline
+        _prev_enable_timeline = None
 
 
 @contextlib.contextmanager
 def span(name: str, category: str = "span", **attributes):
-    """Record a chrome-trace span; nests via contextvar parent links."""
+    """Record a chrome-trace span; nests via contextvar parent links.
+    The outermost span in a context roots a new trace id."""
     span_id = uuid.uuid4().hex[:16]
     parent = _current_span.get()
+    trace_id = _current_trace.get()
+    trace_token = None
+    if trace_id is None:
+        trace_id = uuid.uuid4().hex[:16]
+        trace_token = _current_trace.set(trace_id)
     token = _current_span.set(span_id)
     t0 = time.time()
     try:
@@ -75,13 +110,36 @@ def span(name: str, category: str = "span", **attributes):
     finally:
         t1 = time.time()
         _current_span.reset(token)
+        if trace_token is not None:
+            _current_trace.reset(trace_token)
         ev = {
             "name": name, "cat": category, "ph": "X",
             "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
-            "pid": "driver", "tid": f"span:{span_id}",
-            "args": {"parent": parent, **attributes},
+            "pid": _process_label, "tid": f"span:{span_id}",
+            "args": {"parent": parent, "trace_id": trace_id,
+                     **attributes},
         }
         _record(ev)
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str],
+                  parent_span_id: Optional[str] = None):
+    """Re-enter a propagated trace on the receiving side of a process
+    or task boundary: spans opened inside the block carry `trace_id`
+    and parent-link to `parent_span_id`."""
+    if trace_id is None:
+        yield
+        return
+    trace_token = _current_trace.set(trace_id)
+    span_token = _current_span.set(parent_span_id) \
+        if parent_span_id is not None else None
+    try:
+        yield
+    finally:
+        if span_token is not None:
+            _current_span.reset(span_token)
+        _current_trace.reset(trace_token)
 
 
 def _record(ev: Dict[str, Any]) -> None:
@@ -101,6 +159,10 @@ def _record(ev: Dict[str, Any]) -> None:
 
 def current_span_id() -> Optional[str]:
     return _current_span.get()
+
+
+def current_trace_id() -> Optional[str]:
+    return _current_trace.get()
 
 
 def export_chrome_trace(path: str) -> int:

@@ -1,0 +1,54 @@
+"""raylint regression fixture: the unbounded in-flight-refs shape the
+``ref-leak-in-loop`` rule must flag — a producer loop appending
+``.remote()`` results to a list it never drains, so every retained
+ObjectRef pins its object in the store for the life of the loop.
+
+NOT collected by pytest (no test_ prefix); linted by
+tests/test_lint_clean.py which asserts the rule fires here.
+"""
+
+
+class _Task:
+    @staticmethod
+    def remote(x):
+        return object()
+
+
+produce = _Task()
+
+
+def leaky_producer(stop):
+    refs = []
+    while not stop.is_set():
+        refs.append(produce.remote(1))  # leak: never drained
+
+
+def leaky_via_name(stop):
+    refs = []
+    while not stop.is_set():
+        r = produce.remote(1)
+        refs.append(r)  # raylint: disable=ref-leak-in-loop -- fixture twin: suppression honored, asserted by test_lint_clean
+
+
+def bounded_by_test():
+    refs = []
+    while len(refs) < 32:  # accumulate-to-target, not a leak
+        refs.append(produce.remote(1))
+    return refs
+
+
+def drained_window(tasks):
+    window = []
+    results = []
+    while tasks or window:
+        if tasks:
+            window.append(produce.remote(tasks.pop()))
+        results.append(window.pop(0))  # drained: pop keeps it bounded
+    return results
+
+
+def sliced_window(stop):
+    refs = []
+    while not stop.is_set():
+        refs.append(produce.remote(1))
+        refs = refs[-8:]  # rebound each iteration: bounded

@@ -84,6 +84,84 @@ class TestWorkerKiller:
             ray_tpu.shutdown()
 
 
+class TestFlightRecorderOnCrash:
+    def test_actor_crash_auto_dumps_history(self, tmp_path, capsys):
+        """An induced actor crash auto-dumps the flight recorder: the
+        dump holds scheduler and object-transfer events that PRECEDE
+        the crash, and `ray_tpu debug dump` exports the same ring."""
+        import json
+
+        from ray_tpu._private.config import config
+        from ray_tpu.core.task import NodeAffinitySchedulingStrategy
+        from ray_tpu.observability import get_recorder
+        from ray_tpu.observability.recorder import latest_dump_path
+        from ray_tpu.scripts.cli import main
+
+        ray_tpu.shutdown()
+        rec = get_recorder()
+        rec.clear()
+        prev_dir = config.flight_recorder_dir
+        prev_interval = config.flight_recorder_auto_dump_min_interval_s
+        config.flight_recorder_dir = str(tmp_path / "fr")
+        config.flight_recorder_auto_dump_min_interval_s = 0.0
+        ray_tpu.init(num_cpus=2, num_tpus=0, num_worker_procs=1)
+        strategy = NodeAffinitySchedulingStrategy(
+            node_id="node-procs", soft=False)
+        try:
+            @ray_tpu.remote
+            def produce():
+                return 41
+
+            # Seed pre-crash history: scheduling decisions + the
+            # proc-plane result transfer leave recorder breadcrumbs.
+            assert ray_tpu.get(produce.options(
+                scheduling_strategy=strategy).remote(), timeout=60) == 41
+
+            @ray_tpu.remote(scheduling_strategy=strategy)
+            class Bomb:
+                def boom(self):
+                    import os
+
+                    os._exit(1)
+
+            b = Bomb.remote()
+            with pytest.raises(Exception):
+                ray_tpu.get(b.boom.remote(), timeout=60)
+
+            deadline = time.time() + 15
+            dump = latest_dump_path()
+            while dump is None and time.time() < deadline:
+                time.sleep(0.1)
+                dump = latest_dump_path()
+            assert dump, "actor crash produced no flight-recorder dump"
+            data = json.load(open(dump))
+            comps = {e["component"] for e in data["events"]}
+            assert "scheduler" in comps, comps
+            assert "object_transfer" in comps, comps
+            crash_ts = max(
+                e["ts"] for e in data["events"]
+                if e["event"] in ("actor_worker_crashed", "actor_died"))
+            assert any(e["component"] == "scheduler"
+                       and e["event"] == "task_queued"
+                       and e["ts"] <= crash_ts for e in data["events"])
+            assert any(e["component"] == "object_transfer"
+                       and e["ts"] <= crash_ts for e in data["events"])
+
+            # On-demand export of the same ring via the CLI.
+            out = str(tmp_path / "cli-dump.json")
+            assert main(["debug", "dump", "--output", out]) == 0
+            cli_data = json.load(open(out))
+            assert any(e["event"] in ("actor_worker_crashed",
+                                      "actor_died")
+                       for e in cli_data["events"])
+        finally:
+            ray_tpu.shutdown()
+            config.flight_recorder_dir = prev_dir
+            config.flight_recorder_auto_dump_min_interval_s = \
+                prev_interval
+            rec.clear()
+
+
 class TestKillRandomNodeEndpoint:
     def test_dashboard_endpoint_and_cli(self, ray_start_cluster, capsys):
         import json

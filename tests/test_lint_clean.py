@@ -32,9 +32,10 @@ def test_rule_registry_complete():
         "blocking-under-lock", "unguarded-handle-teardown",
         "state-roundtrip-asymmetry", "naked-get-in-actor",
         "unserializable-capture", "lock-order-inversion",
+        "ref-leak-in-loop",
     }
     assert expected <= set(RULES), sorted(RULES)
-    assert len(RULES) >= 6
+    assert len(RULES) >= 7
 
 
 def test_ray_tpu_tree_is_clean():
@@ -69,6 +70,19 @@ def test_state_roundtrip_rule_fires_on_prefix_shape():
               if f.rule == "state-roundtrip-asymmetry"]
     assert len(active) == 1
     assert "_key" in active[0].message
+
+
+def test_ref_leak_rule_fires_on_producer_shape():
+    """The unbounded in-flight-refs producer loop must be flagged;
+    the bounded/drained/sliced variants and the suppressed twin must
+    not appear among active findings."""
+    path = os.path.join(FIXTURES, "ref_leak.py")
+    active = [f for f in _active(path) if f.rule == "ref-leak-in-loop"]
+    assert len(active) == 1, [f.render() for f in _active(path)]
+    assert "refs" in active[0].message
+    suppressed = [f for f in lint_paths([path])
+                  if f.rule == "ref-leak-in-loop" and f.suppressed]
+    assert len(suppressed) == 1  # disable comment honored
 
 
 def test_blocking_and_order_rules_fire():

@@ -42,32 +42,42 @@ class TestExportBundle:
         assert (tmp_path / "prometheus.yml").exists()
 
     def test_panel_series_match_published_names(self):
-        """Every node-level panel expression references a series the
-        dashboard sampler actually publishes (guards against silent
-        renames on either side)."""
+        """Every panel expression references a series some publisher
+        actually registers (guards against silent renames on either
+        side): node gauges from the dashboard sampler, task-lifecycle
+        series from observability.taskstats, serve series from the
+        serve data plane (proxy ingress + replica)."""
         import inspect
 
         from ray_tpu.dashboard import server as srv
         from ray_tpu.dashboard.metrics_export import DEFAULT_PANELS
+        from ray_tpu.observability import taskstats
+        from ray_tpu.serve import proxy, replica
 
-        publish_src = inspect.getsource(
-            srv.MetricsHistory._publish_prom)
+        publish_src = "\n".join([
+            inspect.getsource(srv.MetricsHistory._publish_prom),
+            inspect.getsource(taskstats),
+            inspect.getsource(proxy),
+            inspect.getsource(replica),
+        ])
         for _title, expr, _unit in DEFAULT_PANELS:
-            m = re.search(r"(ray_tpu_[a-z_]+)", expr)
-            if m:  # serve_* series come from serve/proxy.py instead
+            m = re.search(r"(ray_tpu_[a-z_]+?)(_bucket)?(?:[^a-z_]|$)",
+                          expr)
+            if m:
                 assert m.group(1) in publish_src, expr
 
     def test_serve_series_match_proxy_names(self):
         import inspect
 
         from ray_tpu.dashboard.metrics_export import DEFAULT_PANELS
-        from ray_tpu.serve import proxy
+        from ray_tpu.serve import proxy, replica
 
-        proxy_src = inspect.getsource(proxy)
+        serve_src = (inspect.getsource(proxy)
+                     + inspect.getsource(replica))
         for _t, expr, _u in DEFAULT_PANELS:
             m = re.search(r"(serve_[a-z_]+?)(_bucket)?\[", expr)
             if m:
-                assert m.group(1) in proxy_src, expr
+                assert m.group(1) in serve_src, expr
 
 
 class TestNodeGaugeExport:

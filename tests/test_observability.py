@@ -157,6 +157,216 @@ def test_export_chrome_trace(ray_start, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Distributed tracing (otrace): propagation, lifecycle timing,
+# flight recorder, CLI
+# ---------------------------------------------------------------------------
+
+def test_trace_propagates_across_chained_task_and_actor(
+        ray_start_cluster):
+    """One driver-rooted trace follows f.remote() through a spawned
+    worker PROCESS and into a chained actor call: every span carries
+    the same trace id, parent links form a tree, and the span set
+    covers >= 2 OS processes."""
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0, num_worker_procs=1)
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        @ray_tpu.remote
+        class Adder:
+            def add(self, x):
+                return x + 10
+
+        strategy = ray_tpu.NodeAffinitySchedulingStrategy(
+            node_id="node-procs", soft=False)
+        with tracing.span("chain-root", "test") as root_sid:
+            trace_id = tracing.current_trace_id()
+            ref = f.options(scheduling_strategy=strategy).remote(1)
+            a = Adder.remote()
+            assert ray_tpu.get(a.add.remote(ref), timeout=30) == 12
+        assert trace_id
+        # Actor-side spans close in a finally that can trail the
+        # result store by a beat — poll the timeline.
+        deadline = time.time() + 10
+        while True:
+            events = ray_tpu.timeline()
+            spans = [e for e in events
+                     if str(e.get("tid", "")).startswith("span:")
+                     and e.get("args", {}).get("trace_id") == trace_id]
+            pids = {e.get("pid") for e in spans}
+            if len(spans) >= 4 and len(pids) >= 2:
+                break
+            assert time.time() < deadline, (
+                f"{len(spans)} spans / pids={pids}: "
+                + str([e['name'] for e in spans]))
+            time.sleep(0.1)
+        # Parent links: every span except the root points at another
+        # span of the SAME trace.
+        ids = {e["tid"].split("span:")[1] for e in spans}
+        assert root_sid in ids
+        for e in spans:
+            sid = e["tid"].split("span:")[1]
+            if sid == root_sid:
+                continue
+            assert e["args"].get("parent") in ids, e
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_task_timing_in_list_tasks_and_summary(ray_start):
+    import ray_tpu
+    from ray_tpu import state
+
+    @ray_tpu.remote
+    def work():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    # The task event is recorded in the executor thread's finally,
+    # which can trail get() by a beat — poll.
+    deadline = time.time() + 5
+    while True:
+        rows = [r for r in state.list_tasks() if "timing" in r]
+        if len(rows) >= 3:
+            break
+        assert time.time() < deadline, "no task rows carried timing"
+        time.sleep(0.05)
+    t = rows[0]["timing"]
+    assert (t["submitted"] <= t["queued"] <= t["scheduled"]
+            <= t["running"] <= t["finished"])
+    assert rows[0]["running_ms"] >= 40
+    assert rows[0]["trace_id"]
+    summ = state.summarize_tasks()
+    pct = summ["latency_percentiles"]
+    assert pct["running_s"]["count"] >= 3
+    for label in ("queued_s", "running_s", "total_s"):
+        assert pct[label]["p50"] <= pct[label]["p99"]
+
+
+def test_flight_recorder_ring_bounded_and_dumps(tmp_path):
+    from ray_tpu._private.config import config
+    from ray_tpu.observability import get_recorder
+
+    rec = get_recorder()
+    rec.clear()
+    prev = config.flight_recorder_max_events
+    config.flight_recorder_max_events = 16
+    try:
+        for i in range(50):
+            rec.record("test", "tick", i=i)
+        assert len(rec) == 16  # ring stays bounded
+        snap = rec.snapshot()
+        assert snap["dropped"] >= 34
+        assert snap["events"][-1]["i"] == 49  # newest kept
+        path = rec.dump(str(tmp_path / "flight.json"), reason="test")
+        data = json.load(open(path))
+        assert data["reason"] == "test"
+        assert len(data["events"]) == 16
+        assert {"ts", "component", "event"} <= set(data["events"][0])
+    finally:
+        config.flight_recorder_max_events = prev
+        rec.clear()
+
+
+def test_flight_recorder_captures_scheduler_events(ray_start):
+    import ray_tpu
+    from ray_tpu.observability import get_recorder
+
+    get_recorder().clear()
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    comps = {e["component"] for e in
+             get_recorder().snapshot()["events"]}
+    assert "scheduler" in comps
+
+
+def test_clear_tracing_restores_exporter_state():
+    """The clear_tracing() bugfix: hooks drop, the env-hook latch
+    resets, and config.enable_timeline reverts to its pre-setup
+    value."""
+    from ray_tpu._private.config import config
+    from ray_tpu.util import tracing
+
+    prev = config.enable_timeline
+    config.enable_timeline = False
+    out = []
+    try:
+        tracing.setup_tracing(out.append)
+        assert config.enable_timeline is True  # setup turns it on
+        tracing.clear_tracing()
+        assert config.enable_timeline is False  # restored
+        with tracing.span("after-clear"):
+            pass
+        assert not out  # hook deregistered
+        tracing.setup_tracing(out.append)  # re-setup after clear works
+        with tracing.span("again"):
+            pass
+        assert any(e["name"] == "again" for e in out)
+        tracing.clear_tracing()
+    finally:
+        tracing.clear_tracing()
+        config.enable_timeline = prev
+
+
+def test_timeline_cli_merges_processes(ray_start_cluster, tmp_path,
+                                       capsys):
+    """`ray_tpu timeline --out` on a live runtime writes a valid
+    chrome trace whose span events cover >= 2 pids."""
+    import ray_tpu
+    from ray_tpu.scripts.cli import main
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0, num_worker_procs=1)
+    try:
+        @ray_tpu.remote
+        def g():
+            return os.getpid()
+
+        strategy = ray_tpu.NodeAffinitySchedulingStrategy(
+            node_id="node-procs", soft=False)
+        wpid = ray_tpu.get(
+            g.options(scheduling_strategy=strategy).remote(),
+            timeout=30)
+        assert wpid != os.getpid()
+        out = str(tmp_path / "tl.json")
+        assert main(["timeline", "--out", out]) == 0
+        events = json.load(open(out))
+        assert events
+        assert all("ph" in e and "ts" in e for e in events)
+        pids = {e.get("pid") for e in events
+                if str(e.get("tid", "")).startswith("span:")}
+        assert len(pids) >= 2, pids
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_debug_dump_cli(ray_start, tmp_path, capsys):
+    import ray_tpu
+    from ray_tpu.scripts.cli import main
+
+    @ray_tpu.remote
+    def h():
+        return 1
+
+    ray_tpu.get(h.remote())
+    out = str(tmp_path / "flight.json")
+    assert main(["debug", "dump", "--output", out]) == 0
+    data = json.load(open(out))
+    comps = {e["component"] for e in data["events"]}
+    assert "scheduler" in comps
+
+
+# ---------------------------------------------------------------------------
 # Usage stats
 # ---------------------------------------------------------------------------
 
