@@ -636,8 +636,21 @@ def main() -> None:
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="record the run's tracing spans and write a "
                          "chrome://tracing JSON to PATH")
+    ap.add_argument("--profile", nargs="?", const="bench.profile.collapsed",
+                    default=None, metavar="PATH",
+                    help="sample this process's stacks for the whole "
+                         "run and write a collapsed flamegraph to PATH "
+                         "(default bench.profile.collapsed); also "
+                         "reports the sampler's measured overhead")
     args = ap.parse_args()
 
+    if args.profile:
+        _run_profiled(args)
+    else:
+        _maybe_traced_run(args)
+
+
+def _maybe_traced_run(args) -> None:
     if args.trace:
         from ray_tpu.util import tracing
 
@@ -657,6 +670,63 @@ def main() -> None:
                   file=sys.stderr)
     else:
         _run(args)
+
+
+def _sampler_overhead(interval_s: float = 0.01) -> tuple:
+    """(off_s, on_s) wall time of a fixed-work busy loop without/with
+    the sampler armed. Measured on synthetic work, NOT by running the
+    bench twice — a second real run would double-push BENCH_HISTORY
+    and pay minutes of wall clock for one percentage."""
+    import time as _time
+
+    from ray_tpu.observability import StackSampler
+
+    def busy() -> int:
+        x = 0
+        for i in range(2_000_000):
+            x += i * i
+        return x
+
+    busy()  # warm caches/JIT-free but stabilizes first-run noise
+    t0 = _time.perf_counter()
+    busy()
+    off = _time.perf_counter() - t0
+    sampler = StackSampler(interval_s=interval_s)
+    sampler.start()
+    try:
+        t0 = _time.perf_counter()
+        busy()
+        on = _time.perf_counter() - t0
+    finally:
+        sampler.stop()
+    return off, on
+
+
+def _run_profiled(args) -> None:
+    """Arm the on-demand stack sampler around one real bench pass and
+    write the flamegraph next to the results."""
+    import time as _time
+
+    from ray_tpu.observability import StackSampler
+    from ray_tpu.observability.stack_sampler import to_collapsed
+
+    off, on = _sampler_overhead()
+    overhead_pct = max(0.0, (on - off) / off * 100.0) if off else 0.0
+    sampler = StackSampler(interval_s=0.01)
+    sampler.start()
+    t0 = _time.perf_counter()
+    try:
+        _maybe_traced_run(args)
+    finally:
+        wall = _time.perf_counter() - t0
+        samples = sampler.stop()
+        with open(args.profile, "w") as f:
+            f.write(to_collapsed(samples))
+        print(f"wrote {len(samples)} unique stacks to {args.profile} "
+              f"(run wall {wall:.1f}s; sampler overhead on a "
+              f"synthetic busy loop: {overhead_pct:.1f}% — "
+              f"{off * 1e3:.0f}ms off vs {on * 1e3:.0f}ms on)",
+              file=sys.stderr)
 
 
 def _run(args) -> None:

@@ -7,22 +7,77 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
+# psutil's cpu_percent(interval=None) measures since the PREVIOUS call
+# and always reports 0.0 on its first call in a process. Prime it once
+# here so the daemon's first heartbeat carries a real number (the
+# dashboard head primes separately at server startup for its own
+# sampling loop).
+_cpu_primed = False
+
+
+def _accelerator_stats() -> Dict[str, Any]:
+    """Accelerator fields riding the same heartbeat schema: device
+    count/kind from the env-probing helpers, per-device memory from
+    jax when a backend is actually up. Never raises; absent hardware
+    contributes nothing."""
+    out: Dict[str, Any] = {}
+    try:
+        from ray_tpu._private import accelerators
+
+        chips = accelerators.num_chips_per_host()
+        if chips:
+            out["accelerator_count"] = chips
+        kind = accelerators.accelerator_type()
+        if kind:
+            out["accelerator_type"] = kind
+    except Exception:  # noqa: BLE001 — probe must not break heartbeats
+        return out
+    try:
+        import sys
+
+        # Only consult an ALREADY-IMPORTED jax: importing it here would
+        # drag backend init into every heartbeat path.
+        jax = sys.modules.get("jax")
+        if jax is not None and out.get("accelerator_count"):
+            stats = jax.local_devices()[0].memory_stats() or {}
+            limit = stats.get("bytes_limit")
+            in_use = stats.get("bytes_in_use")
+            if limit:
+                out["accelerator_mem_total"] = int(limit)
+            if in_use is not None:
+                out["accelerator_mem_used"] = int(in_use)
+    except Exception:  # noqa: BLE001 — cpu-only jax, no memory_stats
+        pass
+    return out
+
 
 def collect_host_stats() -> Dict[str, Any]:
-    """cpu/mem/disk snapshot; {} when psutil is unavailable."""
+    """cpu/mem/disk (+ accelerator) snapshot; {} when psutil is
+    unavailable."""
+    global _cpu_primed
     try:
         import psutil
     except Exception:  # noqa: BLE001 — optional dep
         return {}
     try:
+        if not _cpu_primed:
+            # One-time short blocking sample: interval=None's first
+            # call in a process always returns 0.0, and a prime-then-
+            # read pair measures a ~0s window (equally meaningless).
+            cpu = psutil.cpu_percent(interval=0.05)
+            _cpu_primed = True
+        else:
+            cpu = psutil.cpu_percent(interval=None)
         vm = psutil.virtual_memory()
         du = psutil.disk_usage("/")
-        return {
-            "cpu_percent": psutil.cpu_percent(interval=None),
+        stats = {
+            "cpu_percent": cpu,
             "cpu_count": psutil.cpu_count(),
             "mem_total": vm.total,
             "mem_percent": vm.percent,
             "disk_percent": du.percent,
         }
+        stats.update(_accelerator_stats())
+        return stats
     except Exception:  # noqa: BLE001 — platform quirk
         return {}

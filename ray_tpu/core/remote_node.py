@@ -403,6 +403,12 @@ class RemotePlane:
                           and not _is_constrained(
                               spec.scheduling_strategy)),
         }
+        if getattr(spec, "trace_id", None):
+            # Trace context crosses the control-plane socket: the
+            # daemon re-enters it, interposes its dispatch span, and
+            # the worker's spans nest under that.
+            msg["trace_id"] = spec.trace_id
+            msg["parent_span_id"] = spec.parent_span_id
         excl = getattr(spec, "_spill_excluded", None)
         if msg["spillable"] and excl:
             # Nodes that already refused this task: a refusing daemon's
@@ -467,6 +473,12 @@ class RemotePlane:
                 if not reply.get("need_fn"):
                     break
             node.exported_fids.add(spec.descriptor.function_id)
+            # Merge daemon/worker-side spans BEFORE any error handling —
+            # failed executions have spans too, and they are the
+            # interesting ones.
+            for ev in reply.get("spans") or ():
+                with contextlib.suppress(Exception):
+                    rt.events.record_raw(ev)
             if reply.get("spillback"):
                 # The daemon is saturated (another driver raced us for
                 # its capacity — our heartbeat view was stale). In one
@@ -828,6 +840,9 @@ def remote_actor_state_cls():
                     "streaming": streaming,
                     "fetch": fetch,
                 }
+                if getattr(spec, "trace_id", None):
+                    msg["trace_id"] = spec.trace_id
+                    msg["parent_span_id"] = spec.parent_span_id
                 if streaming and gst is not None:
                     msg["backpressure"] = \
                         config.generator_backpressure_max_items
@@ -857,6 +872,9 @@ def remote_actor_state_cls():
                     if gst is not None:
                         with gst.cv:
                             gst.ack_cb = None
+                for ev in reply.get("spans") or ():
+                    with contextlib.suppress(Exception):
+                        rt.events.record_raw(ev)
                 if reply.get("crashed"):
                     raise WorkerCrashedError(reply["crashed"])
                 if reply.get("fetch_failed"):

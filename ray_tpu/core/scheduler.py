@@ -298,9 +298,16 @@ class Scheduler:
                 self._pump_again = True
                 return
             self._pumping = True
+        from ..observability import event_stats as _estats
+
         while True:
             try:
-                self._pump_once()
+                # Timed OUTSIDE self._lock (observability work never
+                # rides inside the scheduler lock): the scheduler
+                # loop's entry in the event_stats.h-equivalent
+                # registry, surfaced at /api/event_stats.
+                with _estats.timed("scheduler", "pump_once"):
+                    self._pump_once()
             except BaseException:
                 with self._pump_state_lock:
                     self._pumping = False

@@ -170,6 +170,24 @@ def main() -> None:
         if mtype == "gen_ack":
             # Late consumption credit from a finished stream — ignore.
             continue
+        if mtype == "profile":
+            # On-demand stack capture for the cluster profiler: sample
+            # this worker's threads for the requested duration and
+            # reply terminally ("profile_result" ends the request like
+            # a "result" frame does).
+            from ray_tpu.observability.stack_sampler import sample_stacks
+
+            try:
+                samples = sample_stacks(
+                    min(float(msg.get("duration_s") or 2.0), 60.0),
+                    float(msg.get("interval_s") or 0.01))
+                send_msg(sock, {"type": "profile_result",
+                                "pid": os.getpid(), "samples": samples})
+            except Exception as e:  # noqa: BLE001 — report, stay alive
+                send_msg(sock, {"type": "profile_result",
+                                "pid": os.getpid(), "samples": {},
+                                "error": f"{type(e).__name__}: {e}"})
+            continue
 
         task_id = msg.get("task_id")
         # Re-enter the driver's trace: the outer span covers unpack +

@@ -14,6 +14,7 @@ Endpoints:
   GET  /api/workers            GET  /api/placement_groups
   GET  /api/timeline           GET  /healthz
   GET  /metrics                (Prometheus text)
+  GET  /api/event_stats        POST /api/profile (stack | kind=tpu)
   POST /api/jobs/              GET  /api/jobs/
   GET  /api/jobs/{id}          GET  /api/jobs/{id}/logs
   POST /api/jobs/{id}/stop
@@ -213,6 +214,12 @@ class MetricsHistory:
         put("mem_percent", point.get("mem_percent"), head_id)
         put("object_store_bytes", point.get("object_store_bytes"), head_id)
         put("pending_tasks", point.get("pending_tasks"), head_id)
+        # Loop-handler latency series (ray_tpu_loop_handler_*): the
+        # head process's own registry, plus every daemon's snapshot
+        # riding its heartbeat below.
+        from ..observability import event_stats as _estats
+
+        _estats.publish_prometheus(node_id=head_id)
         if rt is None:
             return
         live = {head_id}
@@ -227,6 +234,9 @@ class MetricsHistory:
             put("disk_percent", host.get("disk_percent"), node.node_id)
             put("queued", load.get("queued"), node.node_id)
             put("running", load.get("running"), node.node_id)
+            if load.get("event_stats"):
+                _estats.publish_prometheus(load["event_stats"],
+                                           node_id=node.node_id)
             # The load report carries a cumulative count; the exported
             # counter advances by the delta (a restarted daemon resets
             # its count — treat a decrease as a fresh start).
@@ -284,7 +294,28 @@ class DashboardServer:
         from ..job.manager import job_manager
         from ..util import metrics as metrics_mod
 
-        app = web.Application()
+        from ..observability import event_stats as _estats
+
+        @web.middleware
+        async def timing_middleware(request, handler):
+            # Per-route latency into the dashboard loop's event-stats
+            # registry (the event_stats.h analog for aiohttp). The
+            # route TEMPLATE (canonical) is the key, not the raw path —
+            # /api/jobs/{job_id} stays one series, not one per job.
+            t0 = time.perf_counter()
+            try:
+                return await handler(request)
+            finally:
+                try:
+                    resource = request.match_info.route.resource
+                    name = resource.canonical if resource is not None \
+                        else request.path
+                except Exception:  # noqa: BLE001
+                    name = request.path
+                _estats.record("dashboard", name,
+                               time.perf_counter() - t0)
+
+        app = web.Application(middlewares=[timing_middleware])
         r = app.router
 
         async def version(_):
@@ -581,9 +612,49 @@ class DashboardServer:
             return web.Response(text=tail, content_type="text/plain")
 
         async def capture_profile(request):
-            # On-demand accelerator profile (reference: dashboard
-            # reporter's py-spy/memray buttons — the TPU-native answer
-            # is the jax/XLA profiler, util/tracing.profile_tpu).
+            # On-demand cluster CPU profile (reference: dashboard
+            # reporter's py-spy buttons — here the pure-Python stack
+            # sampler fans out to driver + workers + daemons and the
+            # merged flamegraph comes back). `kind=tpu` keeps the
+            # accelerator path (jax/XLA profiler, tracing.profile_tpu).
+            if request.query.get("kind") == "tpu":
+                return await _capture_tpu_profile(request)
+            from ..core.runtime import global_runtime_or_none
+            from ..observability.stack_sampler import (
+                profile_cluster,
+                to_collapsed,
+            )
+
+            rt = global_runtime_or_none()
+            if rt is None:
+                return _json({"error": "no running runtime"})
+            try:
+                duration_s = min(
+                    float(request.query.get("duration", "2")), 60.0)
+                interval_s = float(request.query.get("interval", "0.01"))
+            except ValueError:
+                return _json({"error": "bad duration/interval"})
+            node = request.query.get("node") or None
+            pid = request.query.get("pid")
+            pid = int(pid) if pid else None
+            # The capture blocks for its full duration — keep it off
+            # the event loop (same rule as _daemon_call).
+            result = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: profile_cluster(
+                    rt, duration_s=duration_s, interval_s=interval_s,
+                    node=node, pid=pid))
+            return _json({
+                "duration_s": result["duration_s"],
+                "interval_s": result["interval_s"],
+                "processes": sorted(result["processes"]),
+                "merged": result["merged"],
+                "collapsed": to_collapsed(result["merged"]),
+            })
+
+        async def _capture_tpu_profile(request):
+            # Accelerator profile (reference: dashboard reporter's
+            # memray button — the TPU-native answer is the jax/XLA
+            # profiler, util/tracing.profile_tpu).
             duration_ms = int(request.query.get("duration_ms", "1000"))
             duration_ms = min(duration_ms, 60_000)
             from .._private import session as _session
@@ -604,6 +675,23 @@ class DashboardServer:
                 files += [os.path.join(root, n) for n in names]
             return _json({"logdir": logdir, "files": files,
                           "hint": "view with tensorboard --logdir"})
+
+        async def event_stats_view(_):
+            # Per-handler loop latency across the cluster: the head
+            # process's registry plus each daemon's snapshot from its
+            # last heartbeat (the debug-state dump of event_stats.h).
+            from ..core.runtime import global_runtime_or_none
+
+            out = {"head": _estats.snapshot()}
+            rt = global_runtime_or_none()
+            if rt is not None:
+                nodes = {}
+                for node in rt.scheduler.nodes():
+                    load = getattr(node, "last_load", None)
+                    if load and load.get("event_stats"):
+                        nodes[node.node_id] = load["event_stats"]
+                out["nodes"] = nodes
+            return _json(out)
 
         async def cluster_node_stats(_):
             # Per-node host stats collected from daemon heartbeats
@@ -692,6 +780,7 @@ class DashboardServer:
         r.add_get("/api/logs", list_logs)
         r.add_get("/api/logs/{name}", tail_log)
         r.add_post("/api/profile", capture_profile)
+        r.add_get("/api/event_stats", event_stats_view)
         r.add_post("/api/kill_random_node", kill_random_node)
         r.add_get("/api/timeline", timeline)
         r.add_get("/api/debug/flight_recorder", flight_recorder)

@@ -37,6 +37,13 @@ Rules (registry below; ``raylint --list-rules`` prints this table):
   every retained ObjectRef pins its object in the store, so a
   long-running producer loop fills the arena (the unbounded
   in-flight-refs class).
+- ``await-under-lock``        — ``await`` inside a coroutine while a
+  non-async lock's ``with`` block is held: the coroutine suspends
+  mid-critical-section, so every other task on the loop (and every
+  thread) contending that lock stalls behind an arbitrary-latency
+  resume — and the loop deadlocks outright if the resume needs a task
+  that is itself waiting on the lock. ``async with`` locks release
+  cooperatively and are exempt.
 
 Suppressions are per line, must name the rule, and must carry a
 justification after ``--``::
@@ -744,6 +751,90 @@ def _check_ref_leak_in_loop(ctx: FileContext) -> List[Finding]:
                 f"its object in the store, so the arena fills for as "
                 f"long as the loop runs; pop/slice consumed refs or "
                 f"bound the loop on len({recv})"))
+    return out
+
+
+@rule("await-under-lock",
+      "`await` inside a coroutine while a non-async lock's `with` "
+      "block is held")
+def _check_await_under_lock(ctx: FileContext) -> List[Finding]:
+    out = []
+
+    def awaits_in(node: ast.AST) -> Iterable[ast.Await]:
+        # Nested defs/lambdas run in another context with their own
+        # scan (coroutines among them get their own held=[] pass).
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _SKIP_NODES):
+                continue
+            if isinstance(n, ast.Await):
+                yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def flag(node: ast.AST, held: List[str], fname: str) -> None:
+        for aw in awaits_in(node):
+            out.append(ctx.finding(
+                aw, "await-under-lock",
+                f"`await` while holding `{held[-1]}` in {fname}(): the "
+                f"coroutine suspends mid-critical-section, so every "
+                f"other task on the loop (and every thread) contending "
+                f"the lock stalls until this resumes — release before "
+                f"awaiting, or use an asyncio.Lock with `async with`"))
+
+    def process(stmts: List[ast.stmt], held: List[str],
+                fname: str) -> None:
+        # Mirrors _scan_function's traversal: compound statements scan
+        # only their HEADER expressions at this level and recurse into
+        # bodies, so each await is judged against the lock state that
+        # is actually in effect where it runs.
+        for stmt in stmts:
+            if isinstance(stmt, _SKIP_NODES):
+                continue
+            if isinstance(stmt, ast.With):
+                taken = []
+                for item in stmt.items:
+                    if held:
+                        flag(item.context_expr, held, fname)
+                    if _lockish(item.context_expr):
+                        key = _expr_key(item.context_expr)
+                        held.append(key)
+                        taken.append(key)
+                process(stmt.body, held, fname)
+                for _ in taken:
+                    held.pop()
+                continue
+            if isinstance(stmt, ast.AsyncWith):
+                # An async lock releases cooperatively across its
+                # awaits — holding one is not the hazard. Awaits in
+                # the body still count against any OUTER sync lock.
+                process(stmt.body, held, fname)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                if held:
+                    flag(stmt.test, held, fname)
+                process(stmt.body, held, fname)
+                process(stmt.orelse, held, fname)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if held:
+                    flag(stmt.iter, held, fname)
+                process(stmt.body, held, fname)
+                process(stmt.orelse, held, fname)
+                continue
+            if isinstance(stmt, ast.Try):
+                process(stmt.body, held, fname)
+                for h in stmt.handlers:
+                    process(h.body, held, fname)
+                process(stmt.orelse, held, fname)
+                process(stmt.finalbody, held, fname)
+                continue
+            if held:
+                flag(stmt, held, fname)
+
+    for fn in _functions(ctx.tree):
+        if isinstance(fn, ast.AsyncFunctionDef):
+            process(fn.body, [], fn.name)
     return out
 
 

@@ -41,6 +41,7 @@ import socket
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("ray_tpu.node")
@@ -183,6 +184,13 @@ class NodeDaemon:
         # Daemon-wide function cache: fid -> cloudpickled bytes.
         self._fn_cache: Dict[bytes, bytes] = {}
         self._fn_lock = threading.Lock()
+        # Daemon-side spans (dispatch spans opened by _handle_exec)
+        # buffer here and piggyback on subsequent result/pong replies,
+        # mirroring worker-side span piggybacking. Only populated when
+        # the daemon runs standalone (_enable_tracing from main()); an
+        # in-process daemon's spans reach the driver's event buffer
+        # directly through the normal _record path.
+        self._span_buf: deque = deque(maxlen=2048)
         # Runtime-env materialization (the reference's per-node agent
         # role): pkg:// URIs from the control plane's KV are extracted
         # into a local size-evicted cache before tasks reach workers.
@@ -262,6 +270,12 @@ class NodeDaemon:
 
     def _load_report(self) -> dict:
         host = self._host_stats()
+        from ray_tpu.observability import event_stats as _estats
+
+        # Per-handler loop latency (event_stats.h equivalent) rides the
+        # heartbeat so the head's /api/event_stats and the
+        # ray_tpu_loop_handler_* series cover every node.
+        estats = _estats.snapshot()
         with self._avail_lock:
             return {
                 "available": self.available.to_dict(),
@@ -270,6 +284,7 @@ class NodeDaemon:
                 "running": self._running,
                 "spilled": self._spilled,
                 "host": host,
+                "event_stats": estats,
             }
 
     def _recommend_spill_target(self, res, exclude) -> Optional[str]:
@@ -422,84 +437,24 @@ class NodeDaemon:
 
     def _serve_conn(self, conn: socket.socket):
         """One request in flight per connection; actor connections are
-        long-lived and serial, which preserves per-actor call order."""
-        recv_msg, send_msg = self._recv_any, self._send_msg
+        long-lived and serial, which preserves per-actor call order.
+        Every dispatched message is timed into the node_daemon loop's
+        event-stats registry (the event_stats.h analog)."""
+        from ray_tpu.observability import event_stats as _estats
+
         conn_actors: list = []  # actors created over this connection
         try:
             while not self._stop.is_set():
                 try:
-                    msg = recv_msg(conn)
+                    msg = self._recv_any(conn)
                 except (self._WorkerCrashedError, OSError, EOFError):
                     return
                 mtype = msg.get("type")
-                if mtype == "shutdown":
-                    self.stop()
+                with _estats.timed("node_daemon", str(mtype)):
+                    alive = self._dispatch_one(conn, msg, mtype,
+                                               conn_actors)
+                if not alive:
                     return
-                if mtype == "ping":
-                    reply = {"type": "pong", "node_id": self.node_id,
-                             "load": self._load_report()}
-                    if msg.get("_json"):
-                        self._send_json(conn, reply)
-                    else:
-                        send_msg(conn, reply)
-                    continue
-                if mtype == "actor_kill":
-                    entry = self._kill_actor(msg.get("actor_id"))
-                    if entry is not None and len(entry) > 2 and entry[2]:
-                        # Explicit kill of a detached actor: drop its
-                        # persisted spec so no reconstruction path can
-                        # resurrect it (reference: GCS removes a killed
-                        # detached actor from the table for good).
-                        aid_hex = msg["actor_id"].hex()
-                        with contextlib.suppress(Exception):
-                            self.control.kv_del(
-                                "detached_spec/" + aid_hex)
-                    send_msg(conn, {"type": "result", "error": None,
-                                    "returns": []})
-                    continue
-                if mtype == "gen_ack":
-                    # Late consumption credit from a finished stream.
-                    continue
-                if mtype in ("log_list", "log_tail"):
-                    # Remote log flow for the head's dashboard
-                    # (reference: dashboard agents serving per-node
-                    # worker logs, dashboard/agent.py:28).
-                    reply = self._handle_logs(mtype, msg)
-                    if msg.get("_json"):
-                        self._send_json(conn, reply)
-                    else:
-                        send_msg(conn, reply)
-                    continue
-                if mtype in ("task_xlang", "actor_create_xlang",
-                             "actor_call_xlang"):
-                    self._handle_xlang(conn, msg, conn_actors)
-                    continue
-                if mtype in ("task", "actor_create", "actor_call"):
-                    try:
-                        self._handle_exec(conn, msg, conn_actors)
-                    except (self._WorkerCrashedError, OSError, EOFError):
-                        return  # the connection itself is gone
-                    except Exception as e:  # noqa: BLE001
-                        # A handler bug must degrade to ONE failed
-                        # request, not kill this conn thread — the
-                        # driver reads a dead dedicated conn as a dead
-                        # ACTOR, and repeated conn deaths as a dead
-                        # NODE (cascading a single bad request into a
-                        # spurious cluster-membership change).
-                        with contextlib.suppress(Exception):
-                            send_msg(conn, {
-                                "type": "result",
-                                "task_id": msg.get("task_id"),
-                                "crashed": f"daemon handler error: "
-                                           f"{type(e).__name__}: {e}"})
-                    continue
-                reply = {"type": "result",
-                         "error": f"unknown message {mtype!r}",
-                         "crashed": f"unknown message {mtype!r}"}
-                if msg.get("_json"):
-                    self._send_json(conn, reply)
-                else:
-                    send_msg(conn, reply)
         finally:
             with contextlib.suppress(OSError):
                 conn.close()
@@ -508,6 +463,145 @@ class NodeDaemon:
             # deliberate kill arrives as actor_kill first).
             for aid in conn_actors:
                 self._kill_actor(aid)
+
+    def _dispatch_one(self, conn, msg, mtype, conn_actors) -> bool:
+        """Handle one control-plane message. → False when this
+        connection is finished (shutdown, or the conn itself died)."""
+        send_msg = self._send_msg
+        if mtype == "shutdown":
+            self.stop()
+            return False
+        if mtype == "ping":
+            reply = {"type": "pong", "node_id": self.node_id,
+                     "load": self._load_report()}
+            self._drain_spans(reply)
+            if msg.get("_json"):
+                self._send_json(conn, reply)
+            else:
+                send_msg(conn, reply)
+            return True
+        if mtype == "actor_kill":
+            entry = self._kill_actor(msg.get("actor_id"))
+            if entry is not None and len(entry) > 2 and entry[2]:
+                # Explicit kill of a detached actor: drop its
+                # persisted spec so no reconstruction path can
+                # resurrect it (reference: GCS removes a killed
+                # detached actor from the table for good).
+                aid_hex = msg["actor_id"].hex()
+                with contextlib.suppress(Exception):
+                    self.control.kv_del("detached_spec/" + aid_hex)
+            send_msg(conn, {"type": "result", "error": None,
+                            "returns": []})
+            return True
+        if mtype == "gen_ack":
+            # Late consumption credit from a finished stream.
+            return True
+        if mtype in ("log_list", "log_tail"):
+            # Remote log flow for the head's dashboard
+            # (reference: dashboard agents serving per-node
+            # worker logs, dashboard/agent.py:28).
+            reply = self._handle_logs(mtype, msg)
+            if msg.get("_json"):
+                self._send_json(conn, reply)
+            else:
+                send_msg(conn, reply)
+            return True
+        if mtype == "profile":
+            # On-demand stack capture of this daemon (and its idle
+            # workers) for the cluster profiler — the reference's
+            # py-spy reporter path, built on sys._current_frames.
+            reply = self._handle_profile(msg)
+            if msg.get("_json"):
+                self._send_json(conn, reply)
+            else:
+                send_msg(conn, reply)
+            return True
+        if mtype in ("task_xlang", "actor_create_xlang",
+                     "actor_call_xlang"):
+            self._handle_xlang(conn, msg, conn_actors)
+            return True
+        if mtype in ("task", "actor_create", "actor_call"):
+            try:
+                self._handle_exec(conn, msg, conn_actors)
+            except (self._WorkerCrashedError, OSError, EOFError):
+                return False  # the connection itself is gone
+            except Exception as e:  # noqa: BLE001
+                # A handler bug must degrade to ONE failed
+                # request, not kill this conn thread — the
+                # driver reads a dead dedicated conn as a dead
+                # ACTOR, and repeated conn deaths as a dead
+                # NODE (cascading a single bad request into a
+                # spurious cluster-membership change).
+                with contextlib.suppress(Exception):
+                    send_msg(conn, {
+                        "type": "result",
+                        "task_id": msg.get("task_id"),
+                        "crashed": f"daemon handler error: "
+                                   f"{type(e).__name__}: {e}"})
+            return True
+        reply = {"type": "result",
+                 "error": f"unknown message {mtype!r}",
+                 "crashed": f"unknown message {mtype!r}"}
+        if msg.get("_json"):
+            self._send_json(conn, reply)
+        else:
+            send_msg(conn, reply)
+        return True
+
+    def _handle_profile(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Sample this daemon's threads (heartbeat / accept / conn
+        serving / transfer) and its idle workers for the requested
+        duration; busy workers are skipped so live task traffic is
+        never stalled."""
+        try:
+            import types
+
+            from ray_tpu.observability import stack_sampler as _ss
+
+            duration_s = min(float(msg.get("duration_s") or 2.0), 60.0)
+            interval_s = float(msg.get("interval_s") or 0.01)
+            out: Dict[str, Dict[str, int]] = {}
+            shim = types.SimpleNamespace(worker_pool=self.pool)
+            workers_t = threading.Thread(
+                target=_ss._profile_local_workers,
+                args=(shim, duration_s, interval_s,
+                      msg.get("pid"), out),
+                daemon=True)
+            workers_t.start()
+            out[f"daemon:{self.node_id}"] = _ss.sample_stacks(
+                duration_s, interval_s)
+            workers_t.join(timeout=duration_s + 10)
+            return {"type": "profile_result", "ok": True,
+                    "node_id": self.node_id, "processes": out}
+        except Exception as e:  # noqa: BLE001 — report, don't kill conn
+            return {"type": "profile_result", "ok": False,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    def _drain_spans(self, reply: Dict[str, Any]) -> None:
+        """Move buffered daemon-side spans onto an outgoing reply (the
+        worker-span piggyback pattern): a dispatch span closes after
+        its own reply went out, so it rides the next one."""
+        if not self._span_buf:
+            return
+        spans = list(reply.get("spans") or [])
+        while True:
+            try:
+                spans.append(self._span_buf.popleft())
+            except IndexError:
+                break
+        if spans:
+            reply["spans"] = spans
+
+    def _enable_tracing(self) -> None:
+        """Standalone-process wiring (called from main()): label spans
+        as this daemon's, buffer them for reply piggybacking, and honor
+        RAY_TPU_OTLP_ENDPOINT / RAY_TPU_TRACING_HOOK. Not done in
+        __init__: an in-process daemon (tests) shares the driver's
+        tracing globals and must not relabel or double-record them."""
+        from ray_tpu.util import tracing as _tracing
+
+        _tracing.set_process_label(f"daemon:{self.node_id}")
+        _tracing.setup_tracing(self._span_buf.append)
 
     def _handle_logs(self, mtype: str, msg: Dict[str, Any]
                      ) -> Dict[str, Any]:
@@ -857,14 +951,31 @@ class NodeDaemon:
                 return
 
         msg["type"] = mtype
-        if mtype == "actor_call":
-            self._run_actor_call(conn, msg)
-            return
-        if mtype == "actor_create":
-            self._run_actor_create(conn, msg, res, conn_actors)
-            return
-        self._run_task(conn, msg, res, max_calls, fid, retriable,
-                       precharged=precharged)
+        # Control-plane trace propagation (closes the ROADMAP gap): the
+        # driver stamped trace_id/parent_span_id into the socket msg;
+        # re-enter that trace here and interpose a daemon dispatch span
+        # so the tree reads submit → daemon:<type> → worker execution.
+        # The span closes after the reply went out; it reaches the
+        # driver on the NEXT reply via _drain_spans, or the OTLP
+        # exporter directly.
+        with contextlib.ExitStack() as trace_cm:
+            if msg.get("trace_id") is not None:
+                from ray_tpu.util import tracing as _tracing
+
+                trace_cm.enter_context(_tracing.trace_context(
+                    msg.get("trace_id"), msg.get("parent_span_id")))
+                sid = trace_cm.enter_context(_tracing.span(
+                    f"daemon:{mtype}", "daemon_dispatch",
+                    node_id=self.node_id))
+                msg["parent_span_id"] = sid
+            if mtype == "actor_call":
+                self._run_actor_call(conn, msg)
+                return
+            if mtype == "actor_create":
+                self._run_actor_create(conn, msg, res, conn_actors)
+                return
+            self._run_task(conn, msg, res, max_calls, fid, retriable,
+                           precharged=precharged)
 
     def _memory_victims(self):
         with self._running_lock:
@@ -1179,6 +1290,7 @@ class NodeDaemon:
                 reply = worker.run_task(
                     msg, on_stream=lambda item: send_msg(conn, item))
                 done()
+                self._drain_spans(reply)
                 send_msg(conn, reply)
             if fid is not None:
                 worker.exported_fns.add(fid)
@@ -1236,6 +1348,7 @@ class NodeDaemon:
                 else:
                     reply = worker.run_task(
                         msg, on_stream=lambda item: send_msg(conn, item))
+                    self._drain_spans(reply)
                     send_msg(conn, reply)
         except self._WorkerCrashedError as e:
             was_detached = len(entry) > 2 and entry[2]
@@ -1298,6 +1411,11 @@ class NodeDaemon:
             ShmStore.unlink(self.shm_name)
         with contextlib.suppress(Exception):
             self.control.close()
+        # Last daemon spans must not die in the OTLP batch buffer.
+        with contextlib.suppress(Exception):
+            from ray_tpu.util.tracing import flush_otlp
+
+            flush_otlp()
 
 
 def main() -> None:
@@ -1335,6 +1453,7 @@ def main() -> None:
         bind_all=args.bind_all,
         session_dir=args.session_dir,
     )
+    daemon._enable_tracing()
     # Graceful SIGTERM (`ray-tpu stop`): run stop() so the shm arena is
     # unlinked and workers are torn down.
     import signal

@@ -10,9 +10,16 @@ cross-process trace propagation) lives here:
   unhandled worker/actor failure and on demand via `ray_tpu debug dump`.
 - taskstats: p50/p95/p99 latency breakdowns over task lifecycle
   timestamps plus the ray_tpu_task_* metric series.
+- event_stats: per-(loop, handler) latency registry (the reference's
+  event_stats.h equivalent) behind /api/event_stats and the
+  ray_tpu_loop_handler_* metric series.
+- stack_sampler: on-demand sys._current_frames profiler behind
+  `ray_tpu profile` and POST /api/profile — flamegraphs without py-spy.
 """
 
+from .event_stats import EventStats, get_event_stats
 from .recorder import FlightRecorder, get_recorder
+from .stack_sampler import StackSampler, profile_cluster, sample_stacks
 from .taskstats import (
     latency_breakdown,
     percentiles,
@@ -20,9 +27,14 @@ from .taskstats import (
 )
 
 __all__ = [
+    "EventStats",
     "FlightRecorder",
+    "StackSampler",
+    "get_event_stats",
     "get_recorder",
     "latency_breakdown",
     "percentiles",
+    "profile_cluster",
     "record_task_metrics",
+    "sample_stacks",
 ]

@@ -1,0 +1,182 @@
+"""Per-handler event-loop latency stats.
+
+Capability-equivalent of the reference's ``src/ray/common/event_stats.h``
+(every C++ event loop records per-handler count/total/max latency,
+surfaced in debug-state dumps): each process keeps one global registry
+of ``(loop, handler) -> count / total / max / p95`` and the hot paths —
+the driver's scheduler pump, the node daemon's dispatch loop, serve's
+proxy/replica handlers, the dashboard's aiohttp routes — time
+themselves into it.
+
+Surfacing:
+- ``GET /api/event_stats`` on the dashboard (head registry + every
+  daemon's registry riding its heartbeat load report);
+- ``ray_tpu status --verbose``;
+- ``ray_tpu_loop_handler_*`` Prometheus gauges via
+  :func:`publish_prometheus` (called from the dashboard's metrics
+  sampling loop), charted by the ``metrics_export`` Grafana bundle.
+
+Recording must be cheap and must never raise: a telemetry bug must not
+take down the loop it observes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from .taskstats import percentiles
+
+# How many recent samples back the p95 estimate (per handler). A ring —
+# not a full history — keeps a long-lived loop's memory bounded and the
+# percentile responsive to current behavior.
+_RECENT_WINDOW = 256
+
+
+class _HandlerStat:
+    __slots__ = ("count", "total_s", "max_s", "recent")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.recent: deque = deque(maxlen=_RECENT_WINDOW)
+
+    def add(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        if seconds > self.max_s:
+            self.max_s = seconds
+        self.recent.append(seconds)
+
+    def to_dict(self) -> Dict[str, Any]:
+        p95 = percentiles(list(self.recent), pcts=(95,)).get("p95", 0.0)
+        return {
+            "count": self.count,
+            "total_s": round(self.total_s, 6),
+            "max_s": round(self.max_s, 6),
+            "p95_s": round(p95, 6),
+        }
+
+
+class EventStats:
+    """Process-global registry of per-(loop, handler) latency stats."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._stats: Dict[tuple, _HandlerStat] = {}
+
+    def record(self, loop: str, handler: str, seconds: float) -> None:
+        try:
+            key = (str(loop), str(handler))
+            with self._mu:
+                stat = self._stats.get(key)
+                if stat is None:
+                    stat = self._stats[key] = _HandlerStat()
+                stat.add(float(seconds))
+        except Exception:  # noqa: BLE001 — telemetry must not break loops
+            pass
+
+    @contextlib.contextmanager
+    def timed(self, loop: str, handler: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(loop, handler, time.perf_counter() - t0)
+
+    def snapshot(self) -> Dict[str, Dict[str, Dict[str, Any]]]:
+        """{loop: {handler: {count, total_s, max_s, p95_s}}}."""
+        with self._mu:
+            items = list(self._stats.items())
+        out: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        for (loop, handler), stat in items:
+            out.setdefault(loop, {})[handler] = stat.to_dict()
+        return out
+
+    def reset(self) -> None:
+        """Test hook: drop all accumulated stats."""
+        with self._mu:
+            self._stats.clear()
+
+
+_GLOBAL = EventStats()
+
+
+def get_event_stats() -> EventStats:
+    return _GLOBAL
+
+
+def record(loop: str, handler: str, seconds: float) -> None:
+    _GLOBAL.record(loop, handler, seconds)
+
+
+def timed(loop: str, handler: str):
+    return _GLOBAL.timed(loop, handler)
+
+
+def snapshot() -> Dict[str, Dict[str, Dict[str, Any]]]:
+    return _GLOBAL.snapshot()
+
+
+# -- Prometheus exposition ---------------------------------------------------
+
+_PROM: Dict[str, Any] = {}
+_PROM_LOCK = threading.Lock()
+
+
+def publish_prometheus(stats: Optional[dict] = None,
+                       node_id: str = "head") -> None:
+    """Export a registry snapshot as ``ray_tpu_loop_handler_*`` gauges
+    tagged (node_id, loop, handler). The dashboard's sampling loop
+    calls this for the head registry and for every daemon snapshot that
+    rode a heartbeat. Never raises."""
+    try:
+        from ..util import metrics as mm
+
+        with _PROM_LOCK:
+            if not _PROM:
+                # Build ALL before publishing any: a partial init would
+                # silently drop part of the series forever.
+                tag = ("node_id", "loop", "handler")
+                try:
+                    gauges = {
+                        "count": mm.Gauge(
+                            "ray_tpu_loop_handler_count",
+                            "Handler invocations observed", tag),
+                        "total_s": mm.Gauge(
+                            "ray_tpu_loop_handler_total_s",
+                            "Cumulative handler latency", tag),
+                        "max_s": mm.Gauge(
+                            "ray_tpu_loop_handler_max_s",
+                            "Max observed handler latency", tag),
+                        "p95_s": mm.Gauge(
+                            "ray_tpu_loop_handler_p95_s",
+                            "p95 handler latency over the recent window",
+                            tag),
+                    }
+                except ValueError:
+                    return  # registry clash (tests clearing registries)
+                _PROM.update(gauges)
+        if stats is None:
+            stats = snapshot()
+        for loop, handlers in stats.items():
+            for handler, row in handlers.items():
+                tags = {"node_id": node_id, "loop": loop,
+                        "handler": handler}
+                for key in ("count", "total_s", "max_s", "p95_s"):
+                    val = row.get(key)
+                    if val is not None:
+                        _PROM[key].set(float(val), tags)
+    except Exception:  # noqa: BLE001 — exposition must not break sampling
+        pass
+
+
+def reset_prometheus_cache() -> None:
+    """Test hook: forget cached gauge objects so a cleared registry
+    re-registers them."""
+    with _PROM_LOCK:
+        _PROM.clear()

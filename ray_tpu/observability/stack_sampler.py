@@ -1,0 +1,297 @@
+"""On-demand, pure-Python stack sampling for every process in a cluster.
+
+The reference dashboard shells out to py-spy for flamegraphs; we cannot
+assume external profilers exist in the container, so this module builds
+the same capability on ``sys._current_frames``: a daemon thread wakes
+every ``interval_s``, snapshots every other thread's stack, and
+aggregates root-first collapsed stacks (``file:func;file:func;...``)
+with sample counts — exactly the text format flamegraph.pl /
+speedscope / inferno consume.
+
+Remote capture rides the existing planes rather than adding one:
+
+- workers answer a ``{"type": "profile"}`` message on their UNIX-socket
+  command loop (``core/worker_main.py``);
+- node daemons answer the same message on the framed-TCP control plane
+  (``node/daemon.py``), sampling their own heartbeat/accept/connection
+  threads and, transitively, their workers;
+- the driver samples itself in-process.
+
+:func:`profile_cluster` fans the request out in parallel, prefixes each
+process's stacks with a ``driver`` / ``worker:<pid>`` /
+``daemon:<node>`` label, and merges everything into one flamegraph so a
+single capture shows where the *cluster* spends its time.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Any, Dict, Optional
+
+
+class StackSampler:
+    """Background sampler aggregating collapsed stacks of all threads.
+
+    The sampler excludes only its own thread, so a caller blocked in
+    :meth:`join` shows up honestly as a waiting stack rather than
+    vanishing from its own profile.
+    """
+
+    def __init__(self, interval_s: float = 0.01) -> None:
+        self.interval_s = max(0.001, float(interval_s))
+        self._samples: Counter = Counter()
+        self._nsamples = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "StackSampler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="ray-tpu-stack-sampler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> Dict[str, int]:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        self._thread = None
+        return dict(self._samples)
+
+    # -- sampling ------------------------------------------------------
+
+    def _run(self) -> None:
+        me = threading.get_ident()
+        while not self._stop.is_set():
+            self._sample_once(exclude={me})
+            self._stop.wait(self.interval_s)
+
+    def _sample_once(self, exclude=()) -> None:
+        try:
+            frames = sys._current_frames()
+        except Exception:  # noqa: BLE001 — never break the host process
+            return
+        self._nsamples += 1
+        for tid, frame in frames.items():
+            if tid in exclude:
+                continue
+            stack = collapse_frame(frame)
+            if stack:
+                self._samples[stack] += 1
+
+    @property
+    def samples(self) -> Dict[str, int]:
+        return dict(self._samples)
+
+    @property
+    def nsamples(self) -> int:
+        return self._nsamples
+
+
+def collapse_frame(frame) -> str:
+    """Render one thread's stack root-first as ``file:func;file:func``."""
+    parts = []
+    depth = 0
+    while frame is not None and depth < 128:
+        code = frame.f_code
+        fname = code.co_filename.rsplit("/", 1)[-1]
+        parts.append(f"{fname}:{code.co_name}")
+        frame = frame.f_back
+        depth += 1
+    parts.reverse()
+    return ";".join(parts)
+
+
+def sample_stacks(duration_s: float,
+                  interval_s: float = 0.01) -> Dict[str, int]:
+    """Blocking helper: sample this process for ``duration_s``."""
+    sampler = StackSampler(interval_s=interval_s).start()
+    deadline = time.monotonic() + max(0.0, float(duration_s))
+    while time.monotonic() < deadline:
+        time.sleep(min(0.05, interval_s))
+    return sampler.stop()
+
+
+# -- merging / output formats -----------------------------------------------
+
+
+def merge_samples(per_process: Dict[str, Dict[str, int]]) -> Dict[str, int]:
+    """Merge ``{label: {stack: count}}`` into one flamegraph namespace by
+    prefixing each stack with its process label."""
+    merged: Counter = Counter()
+    for label, samples in per_process.items():
+        for stack, count in (samples or {}).items():
+            merged[f"{label};{stack}"] += int(count)
+    return dict(merged)
+
+
+def to_collapsed(samples: Dict[str, int]) -> str:
+    """Render as flamegraph.pl collapsed-stack lines (``stack count``)."""
+    lines = [f"{stack} {count}"
+             for stack, count in sorted(samples.items())]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def to_chrome_trace(samples: Dict[str, int],
+                    interval_s: float = 0.01) -> Dict[str, Any]:
+    """Render sampled stacks as a chrome://tracing document.
+
+    Each unique stack becomes a run of nested "X" events whose duration
+    is proportional to its sample count, laid out sequentially — a
+    time-ordered view is impossible from aggregated counts, but the
+    inclusive-time proportions (what a flamegraph shows) survive.
+    """
+    events = []
+    cursor_us = 0.0
+    for stack, count in sorted(samples.items(),
+                               key=lambda kv: -kv[1]):
+        dur_us = count * interval_s * 1e6
+        frames = stack.split(";")
+        for depth, name in enumerate(frames):
+            events.append({
+                "name": name, "cat": "sampled", "ph": "X",
+                "ts": cursor_us, "dur": dur_us,
+                "pid": "profile", "tid": depth,
+                "args": {"samples": count},
+            })
+        cursor_us += dur_us
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- cluster orchestration ---------------------------------------------------
+
+
+def _profile_local_workers(rt, duration_s: float, interval_s: float,
+                           pid: Optional[int],
+                           out: Dict[str, Dict[str, int]]) -> None:
+    """Arm the sampler in every idle local worker via its command socket.
+
+    Workers are drained from the pool first so nothing else can write on
+    a socket mid-capture, then released. Busy workers are skipped — a
+    profile request must never stall or corrupt live task traffic.
+    """
+    pool = getattr(rt, "worker_pool", None)
+    if pool is None:
+        return
+    held = []
+    try:
+        while True:
+            try:
+                held.append(pool.acquire(timeout=0.05))
+            except Exception:  # noqa: BLE001 — pool drained / timeout
+                break
+        threads = []
+        lock = threading.Lock()
+
+        def _one(w):
+            try:
+                reply = w.run_task({
+                    "type": "profile",
+                    "duration_s": duration_s,
+                    "interval_s": interval_s,
+                })
+                if reply.get("type") == "profile_result":
+                    with lock:
+                        out[f"worker:{reply.get('pid')}"] = (
+                            reply.get("samples") or {})
+            except Exception:  # noqa: BLE001 — dead worker: skip it
+                pass
+
+        for w in held:
+            wpid = getattr(w, "pid", None)
+            if pid is not None and wpid != pid:
+                continue
+            t = threading.Thread(target=_one, args=(w,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=duration_s + 10)
+    finally:
+        for w in held:
+            try:
+                pool.release(w)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _profile_daemons(rt, duration_s: float, interval_s: float,
+                     node: Optional[str],
+                     out: Dict[str, Dict[str, int]]) -> None:
+    """Fan the profile request out to remote node daemons in parallel."""
+    try:
+        nodes = rt.scheduler.nodes()
+    except Exception:  # noqa: BLE001 — no scheduler yet
+        return
+    threads = []
+    lock = threading.Lock()
+
+    def _one(n):
+        try:
+            reply = n.client.call({
+                "type": "profile",
+                "duration_s": duration_s,
+                "interval_s": interval_s,
+            })
+            if isinstance(reply, dict) and reply.get("ok"):
+                with lock:
+                    for label, samples in (
+                            reply.get("processes") or {}).items():
+                        out[label] = samples or {}
+        except Exception:  # noqa: BLE001 — unreachable node: skip it
+            pass
+
+    for n in nodes:
+        client = getattr(n, "client", None)
+        if client is None:
+            continue  # in-process NodeState: covered by the driver sample
+        nid = getattr(n, "node_id", None)
+        if node is not None and nid != node:
+            continue
+        t = threading.Thread(target=_one, args=(n,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=duration_s + 15)
+
+
+def profile_cluster(rt, duration_s: float = 2.0,
+                    interval_s: float = 0.01,
+                    node: Optional[str] = None,
+                    pid: Optional[int] = None) -> Dict[str, Any]:
+    """Sample the driver, local workers, and remote daemons concurrently.
+
+    Returns ``{"processes": {label: {stack: count}}, "merged": {...},
+    "duration_s": ..., "interval_s": ...}``. ``node``/``pid`` restrict
+    capture to one daemon or one local worker; the driver is always
+    included so a merged graph never comes back empty.
+    """
+    out: Dict[str, Dict[str, int]] = {}
+    duration_s = max(0.05, float(duration_s))
+    interval_s = max(0.001, float(interval_s))
+
+    workers_t = threading.Thread(
+        target=_profile_local_workers,
+        args=(rt, duration_s, interval_s, pid, out), daemon=True)
+    daemons_t = threading.Thread(
+        target=_profile_daemons,
+        args=(rt, duration_s, interval_s, node, out), daemon=True)
+    workers_t.start()
+    daemons_t.start()
+    out["driver"] = sample_stacks(duration_s, interval_s)
+    workers_t.join(timeout=duration_s + 15)
+    daemons_t.join(timeout=duration_s + 20)
+
+    return {
+        "processes": out,
+        "merged": merge_samples(out),
+        "duration_s": duration_s,
+        "interval_s": interval_s,
+    }

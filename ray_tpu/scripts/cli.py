@@ -7,7 +7,8 @@ ray summary). Commands that inspect a LIVE cluster take --address of a
 running dashboard (the reference talks to GCS the same way); without an
 address they start a local throwaway runtime.
 
-  ray-tpu status [--address URL]
+  ray-tpu status [--address URL] [--verbose]
+  ray-tpu profile [--duration S] [--node ID | --pid PID]
   ray-tpu list {nodes,actors,tasks,objects,workers,placement-groups}
   ray-tpu summary {tasks,actors,objects}
   ray-tpu timeline [--output FILE]
@@ -19,6 +20,7 @@ address they start a local throwaway runtime.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -202,10 +204,23 @@ def cmd_status(args) -> int:
             client.close()
         return 0
     if args.address:
-        _print(_fetch(args.address, "/api/cluster_status"))
+        status = _fetch(args.address, "/api/cluster_status")
+        if getattr(args, "verbose", False):
+            # Per-handler loop latency (event_stats plane) rides along
+            # so a wedged loop is visible from `status` alone.
+            with contextlib.suppress(Exception):
+                status["event_stats"] = _fetch(args.address,
+                                               "/api/event_stats")
+        _print(status)
         return 0
     state = _local_state()
-    _print(state.cluster_status())
+    status = state.cluster_status()
+    if getattr(args, "verbose", False):
+        from ray_tpu.observability import event_stats as _estats
+
+        status = dict(status)
+        status["event_stats"] = {"head": _estats.snapshot()}
+    _print(status)
     return 0
 
 
@@ -386,6 +401,48 @@ def cmd_kill_random_node(args) -> int:
     return 0 if killed else 1
 
 
+def cmd_profile(args) -> int:
+    """On-demand cluster flamegraph (reference: `ray stack` + the
+    dashboard reporter's py-spy endpoints): POST /api/profile arms the
+    pure-Python stack sampler in the driver, its local workers, and
+    every node daemon, and merges the collapsed stacks."""
+    address = args.address or "http://127.0.0.1:8265"
+    qs = [f"duration={args.duration}", f"interval={args.interval}"]
+    if args.node:
+        qs.append(f"node={args.node}")
+    if args.pid is not None:
+        qs.append(f"pid={args.pid}")
+    req = urllib.request.Request(
+        address.rstrip("/") + "/api/profile?" + "&".join(qs),
+        method="POST")
+    timeout = max(60.0, float(args.duration) * 3 + 30)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        out = json.loads(resp.read().decode())
+    if out.get("error"):
+        print(out["error"], file=sys.stderr)
+        return 1
+    merged = out.get("merged") or {}
+    if args.format == "chrome":
+        from ray_tpu.observability.stack_sampler import to_chrome_trace
+
+        path = args.output or "profile.trace.json"
+        doc = to_chrome_trace(
+            merged, interval_s=float(out.get("interval_s") or 0.01))
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    else:
+        path = args.output or "profile.collapsed"
+        with open(path, "w") as f:
+            f.write(out.get("collapsed") or "")
+    procs = out.get("processes") or []
+    print(f"sampled {len(procs)} processes "
+          f"({', '.join(procs)}): {len(merged)} unique stacks -> {path}")
+    if args.format == "collapsed":
+        print("render: flamegraph.pl / speedscope / inferno "
+              f"< {path}")
+    return 0 if merged else 1
+
+
 def cmd_memory(args) -> int:
     if args.address:
         _print(_fetch(args.address, "/api/summary/objects"))
@@ -546,7 +603,32 @@ def build_parser() -> argparse.ArgumentParser:
     stat.add_argument("--cluster", default=None,
                       help="control plane host:port — read node/"
                            "load/demand state directly (no dashboard)")
+    stat.add_argument("--verbose", "-v", action="store_true",
+                      help="include per-handler event-loop latency "
+                           "stats (/api/event_stats)")
     stat.set_defaults(fn=cmd_status)
+
+    pf = sub.add_parser("profile",
+                        help="on-demand cluster flamegraph: the stack "
+                             "sampler fans out to driver + workers + "
+                             "node daemons and merges the stacks")
+    pf.add_argument("--duration", type=float, default=2.0,
+                    help="seconds to sample (default 2)")
+    pf.add_argument("--interval", type=float, default=0.01,
+                    help="sampling interval in seconds (default 0.01)")
+    pf.add_argument("--node", default=None,
+                    help="restrict remote capture to one node id")
+    pf.add_argument("--pid", type=int, default=None,
+                    help="restrict worker capture to one local pid")
+    pf.add_argument("--output", "--out", "-o", dest="output",
+                    default=None,
+                    help="output path (default profile.collapsed / "
+                         "profile.trace.json)")
+    pf.add_argument("--format", choices=("collapsed", "chrome"),
+                    default="collapsed",
+                    help="collapsed stacks (flamegraph.pl/speedscope) "
+                         "or chrome://tracing JSON")
+    pf.set_defaults(fn=cmd_profile)
 
     lp = sub.add_parser("list")
     lp.add_argument("kind", choices=[

@@ -100,6 +100,7 @@ class HttpProxy:
     def _serve(self):
         from aiohttp import web
 
+        from ..observability import event_stats as _estats
         from ..util import metrics as _metrics
 
         from ..util.tracing import span as _span
@@ -113,6 +114,8 @@ class HttpProxy:
                 handle = self._routes.get(name)
             if handle is None:
                 _request_metrics(_metrics, name, "404", 0.0)
+                _estats.record("serve_proxy", "unknown_app",
+                               _time.perf_counter() - t0)
                 return web.json_response(
                     {"error": f"no app {name!r}"}, status=404)
             if request.method == "POST":
@@ -148,6 +151,10 @@ class HttpProxy:
                     headers={"X-Request-Id": request_id})
             finally:
                 reset_request_id(token)
+                # Asyncio-handler latency into the serve_proxy loop's
+                # event-stats registry (event_stats.h equivalent).
+                _estats.record("serve_proxy", name or "/",
+                               _time.perf_counter() - t0)
                 get_recorder().record(
                     "serve", "request_done", application=name,
                     request_id=request_id, status=status,

@@ -117,6 +117,12 @@ class Replica:
             self._exit()
             _replica_metrics(self._deployment or "?", status,
                              _time.perf_counter() - t0)
+            from ..observability import event_stats as _estats
+
+            _estats.record(
+                "serve_replica",
+                f"{self._deployment or 'deployment'}.{method_name}",
+                _time.perf_counter() - t0)
 
     def handle_request_streaming(self, method_name: str, args, kwargs,
                                  request_id: Optional[str] = None):

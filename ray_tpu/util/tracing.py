@@ -14,7 +14,17 @@ dashboard's py-spy hooks for CPU profiles):
   process boundary so worker-side spans link into the driver's trace.
 - setup_tracing(hook): register an exporter callback invoked with every
   finished span (the reference's _tracing_startup_hook analog); also
-  reads RAY_TPU_TRACING_HOOK="module:function" at init.
+  reads RAY_TPU_TRACING_HOOK="module:function" at init and, when
+  RAY_TPU_OTLP_ENDPOINT is set, auto-registers the OTLP exporter —
+  workers and daemons inherit the env from the driver, so one variable
+  wires the whole cluster.
+- trace_sampled(trace_id): head-based sampling (RAY_TPU_TRACE_SAMPLE).
+  The decision is a pure hash of the trace id, so every process in the
+  cluster independently reaches the same keep/drop verdict and a trace
+  is exported whole or not at all.
+- OTLPSpanExporter: dependency-free OTLP/HTTP JSON exporter (stdlib
+  urllib), batched with a background flusher; the analog of the
+  reference's opentelemetry exporter wiring without the dependency.
 - profile_tpu(logdir): the TPU-native profiler — wraps jax.profiler
   (xprof/tensorboard trace), replacing the reference's py-spy path.
 - export_chrome_trace(path): dump everything `ray timeline`-style.
@@ -24,6 +34,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import hashlib
 import os
 import threading
 import time
@@ -47,19 +58,53 @@ _prev_enable_timeline: Optional[bool] = None
 # startup so a merged trace separates processes.
 _process_label: str = "driver"
 
+# Process-wide OTLP exporter auto-registered from RAY_TPU_OTLP_ENDPOINT
+# by setup_tracing(); torn down by clear_tracing().
+_otlp_exporter: Optional["OTLPSpanExporter"] = None
+
 
 def set_process_label(label: str) -> None:
     global _process_label
     _process_label = str(label)
 
 
+def trace_sampled(trace_id: Optional[str],
+                  rate: Optional[float] = None) -> bool:
+    """Head-based sampling verdict for a trace id.
+
+    Deterministic and PYTHONHASHSEED-independent (sha1, not hash()), so
+    the driver, every worker, and every daemon agree on keep-vs-drop for
+    the same trace_id without coordination — a sampled-out trace
+    produces zero spans anywhere, a sampled-in trace stays complete.
+    Rate comes from RAY_TPU_TRACE_SAMPLE (default 1.0 = keep all).
+    """
+    if rate is None:
+        raw = os.environ.get("RAY_TPU_TRACE_SAMPLE")
+        if not raw:
+            return True
+        try:
+            rate = float(raw)
+        except ValueError:
+            return True
+    rate = min(1.0, max(0.0, float(rate)))
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    if not trace_id:
+        return True
+    bucket = int(hashlib.sha1(trace_id.encode()).hexdigest()[:8], 16)
+    return bucket / 0xFFFFFFFF < rate
+
+
 def setup_tracing(hook: Optional[Callable[[Dict[str, Any]], None]] = None
                   ) -> None:
     """Enable span export. `hook(span_dict)` runs for every finished
-    span. Also honors RAY_TPU_TRACING_HOOK=module:function."""
+    span. Also honors RAY_TPU_TRACING_HOOK=module:function and
+    RAY_TPU_OTLP_ENDPOINT=http://collector:4318/v1/traces."""
     from .._private.config import config
 
-    global _env_hook_added, _prev_enable_timeline
+    global _env_hook_added, _prev_enable_timeline, _otlp_exporter
 
     if _prev_enable_timeline is None:
         _prev_enable_timeline = bool(config.enable_timeline)
@@ -75,18 +120,27 @@ def setup_tracing(hook: Optional[Callable[[Dict[str, Any]], None]] = None
         with _hooks_lock:
             _hooks.append(getattr(importlib.import_module(mod), fn))
             _env_hook_added = True
+    endpoint = os.environ.get("RAY_TPU_OTLP_ENDPOINT")
+    if endpoint and _otlp_exporter is None:
+        exporter = OTLPSpanExporter(endpoint)
+        with _hooks_lock:
+            _hooks.append(exporter.export)
+        _otlp_exporter = exporter
 
 
 def clear_tracing() -> None:
     """Fully reset exporter state: drop all hooks (including the env
-    hook, so a later setup_tracing() re-registers it) and restore
-    enable_timeline to its pre-setup value."""
+    hook, so a later setup_tracing() re-registers it), flush + drop the
+    OTLP exporter, and restore enable_timeline to its pre-setup value."""
     from .._private.config import config
 
-    global _env_hook_added, _prev_enable_timeline
+    global _env_hook_added, _prev_enable_timeline, _otlp_exporter
     with _hooks_lock:
         _hooks.clear()
         _env_hook_added = False
+    exporter, _otlp_exporter = _otlp_exporter, None
+    if exporter is not None:
+        exporter.shutdown()
     if _prev_enable_timeline is not None:
         config.enable_timeline = _prev_enable_timeline
         _prev_enable_timeline = None
@@ -119,7 +173,12 @@ def span(name: str, category: str = "span", **attributes):
             "args": {"parent": parent, "trace_id": trace_id,
                      **attributes},
         }
-        _record(ev)
+        # Record-time sampling gate: the trace id always propagates so
+        # every hop can evaluate the same deterministic verdict; only
+        # the recording is skipped. (No `return` here — a bare return
+        # in this finally would swallow in-flight exceptions.)
+        if trace_sampled(trace_id):
+            _record(ev)
 
 
 @contextlib.contextmanager
@@ -155,6 +214,135 @@ def _record(ev: Dict[str, Any]) -> None:
             h(ev)
         except Exception:  # noqa: BLE001 - exporters must not break apps
             pass
+
+
+class OTLPSpanExporter:
+    """Dependency-free OTLP/HTTP JSON span exporter (stdlib urllib).
+
+    Spans batch in memory and a background thread flushes them to the
+    collector endpoint; flush() forces a drain (tests and shutdown).
+    Network errors are swallowed — an unreachable collector must never
+    affect the application.
+    """
+
+    def __init__(self, endpoint: str, *,
+                 service_name: str = "ray_tpu",
+                 batch_size: int = 64,
+                 flush_interval_s: float = 2.0) -> None:
+        self.endpoint = endpoint
+        self.service_name = service_name
+        self.batch_size = max(1, int(batch_size))
+        self._buf: List[Dict[str, Any]] = []
+        self._buf_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, args=(float(flush_interval_s),),
+            name="ray-tpu-otlp-flush", daemon=True)
+        self._flusher.start()
+
+    def export(self, ev: Dict[str, Any]) -> None:
+        """Span hook: enqueue one finished span (chrome-ev dict)."""
+        flush_now = False
+        with self._buf_lock:
+            self._buf.append(ev)
+            if len(self._buf) >= self.batch_size:
+                flush_now = True
+        if flush_now:
+            self.flush()
+
+    def flush(self) -> int:
+        """Drain the buffer to the collector. → spans posted."""
+        with self._buf_lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return 0
+        self._post(batch)
+        return len(batch)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.flush()
+        self._flusher.join(timeout=2)
+
+    def _flush_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(max(0.1, interval_s)):
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 - exporter must not die
+                pass
+
+    # -- OTLP/HTTP JSON encoding --------------------------------------
+
+    def _post(self, batch: List[Dict[str, Any]]) -> None:
+        import json
+        import urllib.request
+
+        try:
+            payload = json.dumps(self._encode(batch)).encode()
+            req = urllib.request.Request(
+                self.endpoint, data=payload,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+        except Exception:  # noqa: BLE001 - collector down: drop batch
+            pass
+
+    def _encode(self, batch: List[Dict[str, Any]]) -> Dict[str, Any]:
+        spans = [self._encode_span(ev) for ev in batch]
+        resource_attrs = [
+            {"key": "service.name",
+             "value": {"stringValue": self.service_name}},
+            {"key": "process.label",
+             "value": {"stringValue": str(_process_label)}},
+        ]
+        return {"resourceSpans": [{
+            "resource": {"attributes": resource_attrs},
+            "scopeSpans": [{
+                "scope": {"name": "ray_tpu"},
+                "spans": spans,
+            }],
+        }]}
+
+    @staticmethod
+    def _encode_span(ev: Dict[str, Any]) -> Dict[str, Any]:
+        args = ev.get("args") or {}
+        tid = str(ev.get("tid") or "")
+        span_id = tid.split(":", 1)[1] if ":" in tid else tid
+        start_ns = int(float(ev.get("ts", 0)) * 1000)  # µs → ns
+        end_ns = start_ns + int(float(ev.get("dur", 0)) * 1000)
+        attributes = [
+            {"key": "category",
+             "value": {"stringValue": str(ev.get("cat", ""))}},
+        ]
+        for k, v in args.items():
+            if k in ("parent", "trace_id"):
+                continue
+            attributes.append(
+                {"key": str(k), "value": {"stringValue": str(v)}})
+        out = {
+            "traceId": str(args.get("trace_id") or "").rjust(32, "0"),
+            "spanId": span_id.rjust(16, "0"),
+            "name": str(ev.get("name", "")),
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": attributes,
+        }
+        parent = args.get("parent")
+        if parent:
+            out["parentSpanId"] = str(parent).rjust(16, "0")
+        return out
+
+
+def get_otlp_exporter() -> Optional[OTLPSpanExporter]:
+    return _otlp_exporter
+
+
+def flush_otlp() -> int:
+    """Force-drain the env-registered OTLP exporter. → spans posted."""
+    exporter = _otlp_exporter
+    return exporter.flush() if exporter is not None else 0
 
 
 def current_span_id() -> Optional[str]:

@@ -1,0 +1,32 @@
+"""raylint fixtures: await-under-lock seeded violation (plus a
+justified suppression twin, which must be honored, and the clean
+``async with`` pattern, which must NOT fire)."""
+
+import asyncio
+import threading
+
+
+class AwaitsUnderLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alock = asyncio.Lock()
+        self._state = {}
+
+    async def bad_refresh(self):
+        with self._lock:
+            self._state["v"] = await fetch()  # loop-wide convoy
+
+    async def suppressed_refresh(self):
+        with self._lock:
+            self._state["v"] = await fetch()  # raylint: disable=await-under-lock -- fixture twin: suppression must silence the seeded hazard
+
+    async def good_refresh(self):
+        # asyncio.Lock releases cooperatively across awaits — the
+        # designed pattern, exempt from the rule.
+        async with self._alock:
+            self._state["v"] = await fetch()
+
+
+async def fetch():
+    await asyncio.sleep(0)
+    return 1

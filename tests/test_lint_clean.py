@@ -32,10 +32,10 @@ def test_rule_registry_complete():
         "blocking-under-lock", "unguarded-handle-teardown",
         "state-roundtrip-asymmetry", "naked-get-in-actor",
         "unserializable-capture", "lock-order-inversion",
-        "ref-leak-in-loop",
+        "ref-leak-in-loop", "await-under-lock",
     }
     assert expected <= set(RULES), sorted(RULES)
-    assert len(RULES) >= 7
+    assert len(RULES) >= 8
 
 
 def test_ray_tpu_tree_is_clean():
@@ -94,6 +94,19 @@ def test_blocking_and_order_rules_fire():
     # the `# raylint: disable=...` WITHOUT a justification is itself
     # a finding (the suppression machinery demands a reason)
     assert "unjustified-suppression" in rules
+
+
+def test_await_under_lock_rule_fires():
+    """`await` inside a held threading.Lock `with` block must be
+    flagged; the justified suppression twin and the `async with`
+    asyncio.Lock pattern must not appear among active findings."""
+    path = os.path.join(FIXTURES, "async_hazards.py")
+    active = [f for f in _active(path) if f.rule == "await-under-lock"]
+    assert len(active) == 1, [f.render() for f in _active(path)]
+    assert "_lock" in active[0].message
+    suppressed = [f for f in lint_paths([path])
+                  if f.rule == "await-under-lock" and f.suppressed]
+    assert len(suppressed) == 1  # disable comment honored
 
 
 def test_actor_rules_fire():

@@ -46,12 +46,13 @@ class TestExportBundle:
         actually registers (guards against silent renames on either
         side): node gauges from the dashboard sampler, task-lifecycle
         series from observability.taskstats, serve series from the
-        serve data plane (proxy ingress + replica)."""
+        serve data plane (proxy ingress + replica), loop-handler
+        gauges from observability.event_stats."""
         import inspect
 
         from ray_tpu.dashboard import server as srv
         from ray_tpu.dashboard.metrics_export import DEFAULT_PANELS
-        from ray_tpu.observability import taskstats
+        from ray_tpu.observability import event_stats, taskstats
         from ray_tpu.serve import proxy, replica
 
         publish_src = "\n".join([
@@ -59,6 +60,7 @@ class TestExportBundle:
             inspect.getsource(taskstats),
             inspect.getsource(proxy),
             inspect.getsource(replica),
+            inspect.getsource(event_stats),
         ])
         for _title, expr, _unit in DEFAULT_PANELS:
             m = re.search(r"(ray_tpu_[a-z_]+?)(_bucket)?(?:[^a-z_]|$)",

@@ -499,3 +499,53 @@ print(ray.get(ref), flush=True)
             ray.get(holder2, timeout=30)
         finally:
             saturator.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Profiling plane (profplane) over the daemon control socket
+# ---------------------------------------------------------------------------
+
+def test_daemon_profile_and_event_stats(cluster2):
+    """{"type": "profile"} over the control plane returns the daemon's
+    own sampled stacks, and load reports carry the daemon loop's
+    per-handler event stats."""
+    node = next(n for n in _rt().scheduler.nodes() if n.is_remote)
+    reply = node.client.call({"type": "profile", "duration_s": 0.4,
+                              "interval_s": 0.01})
+    assert reply.get("ok"), reply
+    procs = reply.get("processes") or {}
+    label = f"daemon:{node.node_id}"
+    assert procs.get(label), sorted(procs)
+    # heartbeat/accept/conn threads show real frames
+    assert any(";" in stack for stack in procs[label])
+    load = node.client.call({"type": "ping"})["load"]
+    estats = load.get("event_stats") or {}
+    assert estats.get("node_daemon"), estats
+
+
+def test_daemon_dispatch_spans_reach_driver(cluster2):
+    """Trace propagation through the daemon plane: a dispatched task
+    opens a daemon:task span parent-linked to the driver's submit
+    span; the span closes after its own reply went out and rides a
+    LATER reply back into the driver timeline."""
+    @ray.remote
+    def traced():
+        return 1
+
+    spans = []
+    deadline = time.time() + 20
+    while time.time() < deadline and not spans:
+        assert ray.get(traced.remote()) == 1
+        spans = [e for e in ray.timeline()
+                 if e.get("cat") == "daemon_dispatch"]
+    assert spans, "no daemon_dispatch spans reached the driver"
+    sp = spans[-1]
+    assert str(sp.get("pid", "")).startswith("daemon:"), sp
+    trace_id = sp["args"].get("trace_id")
+    assert trace_id
+    submits = [e for e in ray.timeline()
+               if e.get("cat") == "task_submit"
+               and e["args"].get("trace_id") == trace_id]
+    assert submits, "daemon span's trace has no driver submit root"
+    assert sp["args"].get("parent") == \
+        submits[-1]["tid"].split(":", 1)[1]

@@ -1,0 +1,353 @@
+"""Tier-1 tests for the profiling plane (profplane): on-demand stack
+sampling (driver + worker), per-loop handler event stats, the
+dependency-free OTLP exporter against an in-process HTTP sink, and
+whole-trace head-based sampling determinism."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# Stack sampler
+# ---------------------------------------------------------------------------
+
+def _busy_marker_fn(stop):
+    x = 0
+    while not stop.is_set():
+        x += sum(i * i for i in range(500))
+    return x
+
+
+def test_sample_stacks_captures_named_thread():
+    from ray_tpu.observability import sample_stacks
+
+    stop = threading.Event()
+    t = threading.Thread(target=_busy_marker_fn, args=(stop,),
+                         daemon=True)
+    t.start()
+    try:
+        samples = sample_stacks(0.3, interval_s=0.005)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert samples, "no stacks captured"
+    assert any("_busy_marker_fn" in stack for stack in samples), (
+        sorted(samples)[:5])
+
+
+def test_collapsed_and_chrome_outputs():
+    from ray_tpu.observability.stack_sampler import (
+        merge_samples, to_chrome_trace, to_collapsed)
+
+    merged = merge_samples({
+        "driver": {"a.py:f;b.py:g": 3},
+        "worker:42": {"a.py:f": 2},
+    })
+    assert merged == {"driver;a.py:f;b.py:g": 3, "worker:42;a.py:f": 2}
+    text = to_collapsed(merged)
+    assert "driver;a.py:f;b.py:g 3" in text.splitlines()
+    doc = to_chrome_trace(merged, interval_s=0.01)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"driver", "a.py:f", "b.py:g", "worker:42"} <= names
+
+
+def test_profile_cluster_merges_driver_and_worker_stacks():
+    """The acceptance-bar capture: frames from >= 2 distinct processes
+    in one merged flamegraph (driver samples itself; the worker answers
+    {"type": "profile"} on its command socket)."""
+    import ray_tpu
+    from ray_tpu.core.runtime import global_runtime
+    from ray_tpu.observability import profile_cluster
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0, num_worker_procs=1)
+    try:
+        out = profile_cluster(global_runtime(), duration_s=0.6,
+                              interval_s=0.01)
+        labels = {k for k, v in out["processes"].items() if v}
+        assert "driver" in labels, labels
+        assert any(lbl.startswith("worker:") for lbl in labels), labels
+        prefixes = {s.split(";", 1)[0] for s in out["merged"]}
+        assert len(prefixes) >= 2, prefixes
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Event-loop handler stats
+# ---------------------------------------------------------------------------
+
+def test_event_stats_accounting_under_concurrency():
+    from ray_tpu.observability.event_stats import EventStats
+
+    es = EventStats()
+
+    def hammer():
+        for _ in range(200):
+            es.record("loopA", "handler_x", 0.001)
+        with es.timed("loopA", "handler_y"):
+            pass
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = es.snapshot()
+    hx = snap["loopA"]["handler_x"]
+    assert hx["count"] == 8 * 200
+    assert hx["total_s"] == pytest.approx(8 * 200 * 0.001, rel=0.01)
+    assert hx["max_s"] >= 0.001 - 1e-9
+    assert hx["p95_s"] >= 0.0
+    assert snap["loopA"]["handler_y"]["count"] == 8
+    es.reset()
+    assert es.snapshot() == {}
+
+
+def test_event_stats_records_from_instrumented_loops():
+    """Running tasks through the scheduler must tick the scheduler
+    loop's pump handler in the module-level registry."""
+    import ray_tpu
+    from ray_tpu.observability import event_stats
+
+    event_stats.get_event_stats().reset()
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    try:
+        @ray_tpu.remote
+        def one():
+            return 1
+
+        assert ray_tpu.get(one.remote()) == 1
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            snap = event_stats.snapshot()
+            if snap.get("scheduler", {}).get("pump_once", {}).get(
+                    "count", 0) > 0:
+                break
+            time.sleep(0.05)
+        snap = event_stats.snapshot()
+        assert snap["scheduler"]["pump_once"]["count"] > 0, snap
+    finally:
+        ray_tpu.shutdown()
+        event_stats.get_event_stats().reset()
+
+
+# ---------------------------------------------------------------------------
+# OTLP export
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def otlp_sink():
+    """In-process HTTP sink collecting decoded OTLP JSON payloads."""
+    import http.server
+
+    bodies = []
+
+    class Sink(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            bodies.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Sink)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}/v1/traces", bodies
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _otlp_spans(bodies):
+    return [s for b in bodies
+            for rs in b.get("resourceSpans", [])
+            for ss in rs.get("scopeSpans", [])
+            for s in ss.get("spans", [])]
+
+
+def test_otlp_exporter_roundtrip(otlp_sink, monkeypatch):
+    endpoint, bodies = otlp_sink
+    from ray_tpu.util import tracing
+
+    monkeypatch.delenv("RAY_TPU_TRACE_SAMPLE", raising=False)
+    monkeypatch.setenv("RAY_TPU_OTLP_ENDPOINT", endpoint)
+    tracing.clear_tracing()
+    tracing.setup_tracing()
+    try:
+        assert tracing.get_otlp_exporter() is not None
+        with tracing.span("otlp-root", "test"):
+            with tracing.span("otlp-child", "test"):
+                pass
+        tracing.flush_otlp()
+        spans = _otlp_spans(bodies)
+        names = {s["name"] for s in spans}
+        assert {"otlp-root", "otlp-child"} <= names, names
+        child = next(s for s in spans if s["name"] == "otlp-child")
+        root = next(s for s in spans if s["name"] == "otlp-root")
+        # Parent-linked, same 32-hex trace id, nanosecond timestamps.
+        assert child["parentSpanId"] == root["spanId"]
+        assert child["traceId"] == root["traceId"]
+        assert len(root["traceId"]) == 32
+        assert int(child["endTimeUnixNano"]) >= int(
+            child["startTimeUnixNano"])
+    finally:
+        tracing.clear_tracing()
+
+
+def test_otlp_exporter_survives_dead_endpoint():
+    """Export toward nothing must never raise (fire-and-forget)."""
+    from ray_tpu.util.tracing import OTLPSpanExporter
+
+    exp = OTLPSpanExporter("http://127.0.0.1:9/v1/traces",
+                           flush_interval_s=60.0)
+    try:
+        exp.export({"name": "x", "cat": "test", "ts": 1.0, "dur": 2.0,
+                    "pid": "driver", "tid": "span:abc", "args": {}})
+        exp.flush()
+    finally:
+        exp.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Whole-trace head sampling
+# ---------------------------------------------------------------------------
+
+def test_trace_sampled_deterministic():
+    from ray_tpu.util.tracing import trace_sampled
+
+    ids = [f"trace-{i:05d}" for i in range(400)]
+    v1 = [trace_sampled(t, 0.5) for t in ids]
+    v2 = [trace_sampled(t, 0.5) for t in ids]
+    assert v1 == v2
+    kept = sum(v1)
+    assert 0 < kept < len(ids)  # sha1 buckets actually split the set
+    assert all(trace_sampled(t, 1.0) for t in ids)
+    assert not any(trace_sampled(t, 0.0) for t in ids)
+    assert trace_sampled(None, 0.5)  # no id -> keep (can't bucket)
+
+
+def test_trace_sampled_agrees_across_processes():
+    """The keep/drop verdict must be identical in a fresh interpreter
+    (PYTHONHASHSEED-independent), or distributed traces would be
+    recorded in some processes and dropped in others."""
+    from ray_tpu.util.tracing import trace_sampled
+
+    ids = [f"xproc-{i:03d}" for i in range(64)]
+    local = [trace_sampled(t) for t in ids]
+    code = (
+        "import json, sys\n"
+        "from ray_tpu.util.tracing import trace_sampled\n"
+        "ids = json.loads(sys.argv[1])\n"
+        "print(json.dumps([trace_sampled(t) for t in ids]))\n")
+    env = dict(os.environ)
+    env["RAY_TPU_TRACE_SAMPLE"] = "0.5"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c", code, json.dumps(ids)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr
+    remote = json.loads(r.stdout.strip().splitlines()[-1])
+    expect = [trace_sampled(t, 0.5) for t in ids]
+    assert remote == expect
+    del local  # env-driven default (unset here) keeps everything
+
+
+def test_sampled_out_trace_produces_zero_spans(monkeypatch):
+    """Record-time gate: a sampled-out trace id silences every span in
+    its context; a sampled-in id exports the complete parent-linked
+    tree."""
+    from ray_tpu.util import tracing
+
+    monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE", "0.5")
+    candidates = [f"gate-{i:04d}" for i in range(256)]
+    kept_id = next(t for t in candidates if tracing.trace_sampled(t))
+    dropped_id = next(
+        t for t in candidates if not tracing.trace_sampled(t))
+    events = []
+    tracing.clear_tracing()
+    tracing.setup_tracing(events.append)
+    try:
+        with tracing.trace_context(dropped_id):
+            with tracing.span("gate-a", "test"):
+                with tracing.span("gate-b", "test"):
+                    pass
+        assert events == [], events
+
+        with tracing.trace_context(kept_id, "feedbeef00000000"):
+            with tracing.span("gate-a", "test"):
+                with tracing.span("gate-b", "test"):
+                    pass
+        assert len(events) == 2, events
+        by_name = {e["name"]: e for e in events}
+        a, b = by_name["gate-a"], by_name["gate-b"]
+        assert all(e["args"]["trace_id"] == kept_id for e in events)
+        assert a["args"]["parent"] == "feedbeef00000000"
+        assert b["args"]["parent"] == a["tid"].split(":", 1)[1]
+    finally:
+        tracing.clear_tracing()
+
+
+def test_span_exceptions_survive_sampling_gate(monkeypatch):
+    """The gate lives in span()'s finally — it must not swallow
+    in-flight exceptions for either verdict."""
+    from ray_tpu.util import tracing
+
+    monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE", "0.5")
+    candidates = [f"exc-{i:04d}" for i in range(256)]
+    for tid in (next(t for t in candidates
+                     if tracing.trace_sampled(t)),
+                next(t for t in candidates
+                     if not tracing.trace_sampled(t))):
+        with pytest.raises(RuntimeError, match="boom"):
+            with tracing.trace_context(tid):
+                with tracing.span("exploding", "test"):
+                    raise RuntimeError("boom")
+
+
+# ---------------------------------------------------------------------------
+# Worker-side profile handler (command-socket protocol)
+# ---------------------------------------------------------------------------
+
+def test_worker_profile_message_roundtrip():
+    """A worker answers {"type": "profile"} with its own pid and
+    non-empty samples, and keeps serving tasks afterwards."""
+    import ray_tpu
+    from ray_tpu.core.runtime import global_runtime
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0, num_worker_procs=1)
+    try:
+        pool = global_runtime().worker_pool
+        w = pool.acquire(timeout=10)
+        try:
+            reply = w.run_task({"type": "profile", "duration_s": 0.3,
+                                "interval_s": 0.005})
+            assert reply["type"] == "profile_result"
+            assert reply["pid"] == w.pid
+            assert reply["samples"], reply
+        finally:
+            pool.release(w)
+
+        @ray_tpu.remote
+        def two():
+            return 2
+
+        strategy = ray_tpu.NodeAffinitySchedulingStrategy(
+            node_id="node-procs", soft=False)
+        assert ray_tpu.get(
+            two.options(scheduling_strategy=strategy).remote()) == 2
+    finally:
+        ray_tpu.shutdown()

@@ -405,10 +405,21 @@ def test_dashboard_log_endpoints(dashboard, ray_start):
 
 
 def test_dashboard_profile_capture(dashboard, ray_start):
+    """POST /api/profile defaults to the cluster stack sampler;
+    ?kind=tpu keeps the jax/XLA device-profiler path."""
     import urllib.request as _rq
 
-    req = _rq.Request(dashboard.address + "/api/profile?duration_ms=200",
+    req = _rq.Request(dashboard.address + "/api/profile?duration=0.3",
                       method="POST")
+    with _rq.urlopen(req, timeout=60) as r:
+        out = json.loads(r.read().decode())
+    assert out["merged"], out
+    assert "driver" in out["processes"]
+    assert out["collapsed"].strip()
+
+    req = _rq.Request(
+        dashboard.address + "/api/profile?kind=tpu&duration_ms=200",
+        method="POST")
     with _rq.urlopen(req, timeout=60) as r:
         out = json.loads(r.read().decode())
     assert "logdir" in out
