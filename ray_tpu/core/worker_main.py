@@ -114,6 +114,12 @@ WORKER_SHM = None
 
 def main() -> None:
     global WORKER_SHM
+    # Cross-process lock tracing: arm BEFORE any lock is created so the
+    # worker's order graph is complete. No-op unless
+    # RAY_TPU_LOCKTRACE_DIR is set (see devtools/locktrace.py).
+    from ray_tpu.devtools.locktrace import maybe_install_from_env
+
+    maybe_install_from_env()
     ap = argparse.ArgumentParser()
     ap.add_argument("--socket", required=True)
     ap.add_argument("--worker-id", type=int, required=True)

@@ -253,9 +253,14 @@ class WorkerPool:
                         with contextlib.suppress(OSError):
                             conn.close()
                         continue
-                    got = hello.get("worker_id") if isinstance(
-                        hello, dict) else None
-                    if got == wid:
+                    # Only a typed hello registers a worker: anything
+                    # else on this socket (a stray client, a worker
+                    # speaking a future protocol) must not be mistaken
+                    # for the spawn we are waiting on.
+                    is_hello = (isinstance(hello, dict)
+                                and hello.get("type") == "hello")
+                    got = hello.get("worker_id") if is_hello else None
+                    if got is not None and got == wid:
                         return conn
                     if not isinstance(got, int):
                         with contextlib.suppress(OSError):

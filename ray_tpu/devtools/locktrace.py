@@ -24,11 +24,27 @@ autouse fixture in ``tests/conftest.py`` when ``RAY_TPU_LOCKTRACE=1``:
 ``threading.Condition.wait`` *releases* its lock while waiting, so a
 condition-variable wait under its own lock is not flagged — only waits
 under *other* traced locks are.
+
+**Cross-process merge.** A lock-order inversion split across processes
+(the driver nests A->B, a daemon nests B->A over the same code paths)
+is invisible to any single process's graph. Lock names are keyed by
+*creation site* (``Lock@file:line``), which is stable across processes
+running the same code, so per-process graphs are mergeable: set
+``RAY_TPU_LOCKTRACE_DIR=<dir>`` and call
+``maybe_install_from_env()`` early in each process (the worker and
+daemon mains do) — every process dumps its order graph to
+``<dir>/lockgraph-<pid>.json`` at exit, and ``merge_graphs(dir)``
+reports edges whose reverse only exists in *another* process. This is
+the runtime twin of the static ``xp-lock-order-inversion`` pass: the
+static side sees call chains it can resolve, this side sees whatever
+actually ran.
 """
 
 from __future__ import annotations
 
+import atexit
 import functools
+import json
 import os
 import queue
 import sys
@@ -36,7 +52,7 @@ import threading
 import time
 import traceback
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple, Union
 
 # Violations are only REPORTED when the offending call site lives in
 # this repo (package root's parent, which also covers tests/): stdlib
@@ -49,6 +65,8 @@ _SCOPE = os.path.dirname(os.path.dirname(os.path.dirname(
 __all__ = [
     "install", "uninstall", "is_installed", "violations",
     "clear_violations", "report", "TracedLock",
+    "dump_graph", "merge_graphs", "merged_report",
+    "maybe_install_from_env",
 ]
 
 _STATE_LOCK = threading.Lock()  # raylint: disable=lock-order-inversion -- tracer-internal; never held across user code
@@ -333,3 +351,112 @@ def report() -> str:
     lines = [f"locktrace: {len(vs)} violation(s)"]
     lines += [f"  {v.render()}" for v in vs]
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Cross-process merge
+# ---------------------------------------------------------------------------
+
+_ENV_DIR = "RAY_TPU_LOCKTRACE_DIR"
+
+
+def dump_graph(path: Optional[str] = None) -> Optional[str]:
+    """Write this process's lock-order graph to ``path`` (default:
+    ``$RAY_TPU_LOCKTRACE_DIR/lockgraph-<pid>.json``). Returns the path
+    written, or None when there is nowhere to write."""
+    if path is None:
+        d = os.environ.get(_ENV_DIR)
+        if not d:
+            return None
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"lockgraph-{os.getpid()}.json")
+    with _STATE_LOCK:
+        edges = [[a, b, site] for (a, b), site in _order_edges.items()]
+    payload = {
+        "pid": os.getpid(),
+        "label": os.path.basename(sys.argv[0]) or "python",
+        "edges": edges,
+    }
+    # Atomic write: a merger scanning the directory mid-dump must see
+    # either nothing or a complete graph, never a truncated one.
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+def merge_graphs(
+        source: Union[str, Iterable[str]]) -> List[Violation]:
+    """Merge per-process graph dumps and report lock-order inversions
+    that only exist ACROSS processes: edge (a, b) in one dump, (b, a)
+    in another, and no single dump holding both directions (those were
+    already reported live by the process that saw them). ``source`` is
+    a dump directory or an iterable of dump paths."""
+    if isinstance(source, str):
+        paths = sorted(
+            os.path.join(source, fn) for fn in os.listdir(source)
+            if fn.startswith("lockgraph-") and fn.endswith(".json"))
+    else:
+        paths = list(source)
+    graphs = []
+    for p in paths:
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        edges = {(a, b): site for a, b, site in data.get("edges", [])}
+        graphs.append((data.get("pid"), data.get("label", "?"), edges))
+
+    out: List[Violation] = []
+    seen: Set[Tuple[str, str]] = set()
+    for i, (pid_a, label_a, edges_a) in enumerate(graphs):
+        for (a, b), site_ab in edges_a.items():
+            if (b, a) in edges_a:
+                continue  # intra-process: reported live already
+            for pid_b, label_b, edges_b in graphs[i + 1:]:
+                site_ba = edges_b.get((b, a))
+                if site_ba is None or (a, b) in edges_b:
+                    continue
+                # The inversion only matters when OUR code took at
+                # least one side; a pair living entirely in stdlib /
+                # third-party frames is their discipline, not ours.
+                if not (site_ab.startswith(_SCOPE)
+                        or site_ba.startswith(_SCOPE)):
+                    continue
+                key = (min(a, b), max(a, b))
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(Violation(
+                    kind="lock-order-inversion",
+                    thread=f"pid {pid_a}({label_a}) vs "
+                           f"pid {pid_b}({label_b})",
+                    detail=(f"{b} acquired while {a} held at {site_ab} "
+                            f"(pid {pid_a}), but the reverse order was "
+                            f"taken at {site_ba} (pid {pid_b})"),
+                    site=site_ab,
+                    held=(a,)))
+    return out
+
+
+def merged_report(source: Union[str, Iterable[str]]) -> str:
+    vs = merge_graphs(source)
+    if not vs:
+        return "locktrace: no cross-process violations"
+    lines = [f"locktrace: {len(vs)} cross-process violation(s)"]
+    lines += [f"  {v.render()}" for v in vs]
+    return "\n".join(lines)
+
+
+def maybe_install_from_env() -> bool:
+    """Arm tracing + an at-exit graph dump iff ``RAY_TPU_LOCKTRACE_DIR``
+    is set. Called from the worker and daemon mains so every process in
+    a traced cluster contributes a graph; a no-op otherwise (plain
+    ``RAY_TPU_LOCKTRACE=1`` single-process behavior is unchanged)."""
+    if not os.environ.get(_ENV_DIR):
+        return False
+    install()
+    atexit.register(dump_graph)
+    return True

@@ -905,44 +905,116 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--select", default=None,
                     help="comma-separated rule names to run")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable JSON report")
+                    help="machine-readable JSON report "
+                         "(same as --format json)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default=None, dest="fmt",
+                    help="report format (default: text)")
+    ap.add_argument("--xp", action="store_true",
+                    help="also run the whole-program passes "
+                         "(cross-file lock-order, wire-protocol "
+                         "conformance)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON for whole-program findings "
+                         "(default with --xp: the checked-in "
+                         "devtools/xp/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the default baseline")
+    ap.add_argument("--proto-inventory", action="store_true",
+                    help="print the wire-protocol inventory table "
+                         "(implies --xp) and exit")
+    ap.add_argument("--out", default=None,
+                    help="write the report to this file instead of "
+                         "stdout")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="include suppressed findings in the report")
     args = ap.parse_args(argv)
 
     if args.list_rules:
+        from .xp import XP_RULES
         for name, r in sorted(RULES.items()):
             print(f"{name:28s} {r.doc}")
+        for name, doc in sorted(XP_RULES.items()):
+            print(f"{name:28s} [xp] {doc}")
         return 0
 
     paths = args.paths
     if not paths:
         paths = [os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))]
+    run_xp_passes = args.xp or args.proto_inventory
     select = None
     if args.select:
+        from .xp import XP_RULES
         select = [s.strip() for s in args.select.split(",") if s.strip()]
-        unknown = [s for s in select if s not in RULES]
+        unknown = [s for s in select
+                   if s not in RULES and s not in XP_RULES]
         if unknown:
             print(f"unknown rule(s): {', '.join(unknown)}",
                   file=sys.stderr)
             return 2
 
-    findings = lint_paths(paths, select)
+    per_file_select = ([s for s in select if s in RULES]
+                       if select else None)
+    if select and not per_file_select:
+        findings = []
+    else:
+        findings = lint_paths(paths, per_file_select)
+    inventory = None
+    if run_xp_passes:
+        from .xp import (XP_RULES, apply_baseline,
+                         default_baseline_path, run_xp)
+        xp_findings, inventory = run_xp(paths, select)
+        findings.extend(xp_findings)
+        baseline = args.baseline
+        if baseline is None and not args.no_baseline:
+            baseline = default_baseline_path()
+        if baseline:
+            findings.extend(apply_baseline(findings, baseline))
+
+    if args.proto_inventory:
+        from .xp.report import inventory_table
+        out = inventory_table(inventory or [])
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as fh:
+                fh.write(out + "\n")
+        else:
+            print(out)
+        return 0
+
+    fmt = args.fmt or ("json" if args.as_json else "text")
     active = [f for f in findings if not f.suppressed]
     shown = findings if args.show_suppressed else active
-    if args.as_json:
-        print(json.dumps({
-            "findings": [f.to_dict() for f in shown],
-            "total": len(active),
-            "suppressed": sum(1 for f in findings if f.suppressed),
-        }, indent=2))
+    if fmt == "json":
+        from .xp.report import to_json
+        report = to_json(shown if args.show_suppressed else active,
+                         inventory)
+        # keep totals over ALL findings, not just the shown subset
+        payload = json.loads(report)
+        payload["total"] = len(active)
+        payload["suppressed"] = sum(
+            1 for f in findings if f.suppressed)
+        report = json.dumps(payload, indent=2)
+    elif fmt == "sarif":
+        from .xp import XP_RULES
+        from .xp.report import to_sarif
+        docs = {name: r.doc for name, r in RULES.items()}
+        docs.update(XP_RULES)
+        docs["unjustified-suppression"] = (
+            "a raylint disable comment without a justification")
+        report = to_sarif(findings, docs)
     else:
-        for f in shown:
-            print(f.render())
-        print(f"raylint: {len(active)} finding(s), "
-              f"{sum(1 for f in findings if f.suppressed)} suppressed")
+        lines = [f.render() for f in shown]
+        lines.append(
+            f"raylint: {len(active)} finding(s), "
+            f"{sum(1 for f in findings if f.suppressed)} suppressed")
+        report = "\n".join(lines)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(report + "\n")
+    else:
+        print(report)
     return 1 if active else 0
 
 

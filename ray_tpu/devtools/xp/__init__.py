@@ -1,0 +1,103 @@
+"""raylint-xp — whole-program analysis on top of the per-file pass.
+
+The per-file rules in ``raylint`` see one module at a time; this
+package builds a project-wide index (module/symbol table, call graph,
+per-function lock summaries) and runs the analyses that need it:
+
+- ``xp-lock-order-inversion`` — two locks acquired in opposite orders
+  along *call chains that cross functions and files*. The per-file
+  rule only sees both orders when they are nested directly inside one
+  module; here held-lock sets are propagated through the call graph,
+  so ``A.flush()`` holding ``A_LOCK`` while calling into ``b.push()``
+  (which takes ``B_LOCK``) conflicts with ``b.deliver()`` holding
+  ``B_LOCK`` while calling back into ``a.apply_update()``.
+- ``proto-orphan-sent`` — a ``{"type": X, ...}`` message flows into a
+  send call site but no handler anywhere dispatches on ``X``.
+- ``proto-orphan-handled`` — a handler dispatches on ``X`` but no
+  send site in the tree ever produces it (dead protocol arms — or a
+  sender that lives outside Python, which belongs in the baseline
+  with a reason).
+- ``proto-missing-field`` — the handler path for type ``X`` reads
+  ``msg["k"]`` (a KeyError on absence) but no sender of ``X`` ever
+  provides ``k``.
+
+Whole-program findings cannot be suppressed with inline comments (no
+single line owns them); the checked-in baseline
+(``devtools/xp/baseline.json``) is their suppression mechanism, and
+every entry must carry a reason. ``stale-baseline`` keeps the file
+honest: an entry that matches nothing is itself a finding.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .index import ProjectIndex
+from . import lockgraph, protocol, report
+from .report import (apply_baseline, default_baseline_path, to_json,
+                     to_sarif)
+
+# name -> one-line doc, mirrored by `raylint --list-rules`
+XP_RULES: Dict[str, str] = {
+    "xp-lock-order-inversion":
+        "two locks acquired in opposite orders along call chains "
+        "crossing functions/files (held-set propagation)",
+    "proto-orphan-sent":
+        "a {\"type\": X} message is sent but no handler dispatches "
+        "on X",
+    "proto-orphan-handled":
+        "a handler dispatches on X but no send site produces it",
+    "proto-missing-field":
+        "handler for X hard-reads msg[\"k\"] that no sender of X "
+        "provides",
+    "stale-baseline":
+        "a baseline entry that no longer matches any finding",
+    "xp-parse-error":
+        "a file the whole-program index could not parse",
+}
+
+__all__ = [
+    "XP_RULES", "ProjectIndex", "run_xp", "apply_baseline",
+    "default_baseline_path", "to_json", "to_sarif",
+]
+
+
+def _roots(paths: Iterable[str]) -> List[str]:
+    """Whole-program roots: directories as-is, files -> their dir."""
+    out = []
+    for p in paths:
+        p = os.path.abspath(p)
+        out.append(p if os.path.isdir(p) else os.path.dirname(p))
+    # drop roots nested under another root (one index each is enough)
+    out = sorted(set(out))
+    kept: List[str] = []
+    for p in out:
+        if not any(p != q and p.startswith(q + os.sep) for q in out):
+            kept.append(p)
+    return kept
+
+
+def run_xp(paths: Iterable[str], select: Optional[Iterable[str]] = None,
+           ) -> Tuple[list, List[dict]]:
+    """Run every whole-program pass over the package(s) rooted at
+    `paths`. Returns (findings, wire-protocol inventory rows)."""
+    from ..raylint import Finding  # late import; raylint imports us too
+
+    wanted = set(select) if select else set(XP_RULES)
+    findings: List[Finding] = []
+    inventory: List[dict] = []
+    for root in _roots(paths):
+        idx = ProjectIndex.build(root)
+        for path, line, msg in idx.errors:
+            findings.append(Finding(path, line, "xp-parse-error", msg))
+        if "xp-lock-order-inversion" in wanted:
+            findings.extend(lockgraph.check(idx))
+        proto_rules = {"proto-orphan-sent", "proto-orphan-handled",
+                       "proto-missing-field"}
+        if proto_rules & wanted:
+            pfind, inv = protocol.check(idx)
+            findings.extend(f for f in pfind if f.rule in wanted)
+            inventory.extend(inv)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, inventory

@@ -1,0 +1,366 @@
+"""Project-wide index: modules, symbols, imports, attribute types,
+and a call-graph resolver.
+
+Everything downstream (cross-file lock-order propagation, protocol
+conformance) runs on this. Resolution is deliberately conservative —
+an unresolved call contributes nothing rather than guessing — so the
+whole-program rules err toward missing an edge over inventing one.
+
+What the resolver can follow:
+
+- bare names: nested defs of the enclosing function(s), then module
+  functions/classes, then ``from x import name`` targets;
+- ``self.meth()`` / ``cls.meth()``: the owning class, then its
+  project base classes;
+- ``mod.fn()`` / ``mod.sub.fn()``: imported project modules;
+- ``self.attr.meth()`` and module-level ``INSTANCE.meth()``: attr
+  types inferred from ``self.attr = ClassName(...)`` constructor
+  assignments (the ``self.pool = WorkerPool(...)`` pattern);
+- constructor calls resolve to the class's ``__init__``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclass
+class FuncInfo:
+    qual: str
+    module: "ModuleInfo"
+    node: ast.AST
+    cls: Optional["ClassInfo"] = None
+    parent: Optional["FuncInfo"] = None
+    nested: Dict[str, "FuncInfo"] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def path(self) -> str:
+        return self.module.path
+
+    def param_names(self) -> List[str]:
+        a = self.node.args
+        return ([p.arg for p in a.posonlyargs]
+                + [p.arg for p in a.args])
+
+
+@dataclass
+class ClassInfo:
+    qual: str
+    name: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    base_exprs: List[ast.expr] = field(default_factory=list)
+    base_quals: List[str] = field(default_factory=list)
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)
+    # self.X = ClassName(...) -> class qual
+    attr_types: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    modname: str
+    path: str
+    tree: ast.Module
+    is_pkg: bool = False
+    imports: Dict[str, str] = field(default_factory=dict)
+    functions: Dict[str, FuncInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    # module-level NAME = ClassName(...) -> class qual
+    attr_types: Dict[str, str] = field(default_factory=dict)
+
+
+class ProjectIndex:
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.errors: List[Tuple[str, int, str]] = []
+
+    # -- construction -------------------------------------------------
+
+    @classmethod
+    def build(cls, root: str) -> "ProjectIndex":
+        idx = cls()
+        root = os.path.abspath(root)
+        pkg = os.path.basename(root.rstrip(os.sep))
+        for dirpath, dirs, names in os.walk(root):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for name in sorted(names):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                rel = os.path.relpath(path, root)
+                parts = rel[:-3].split(os.sep)
+                is_pkg = parts[-1] == "__init__"
+                if is_pkg:
+                    parts = parts[:-1]
+                modname = ".".join([pkg] + parts) if parts else pkg
+                idx._load_module(modname, path, is_pkg)
+        for mi in idx.modules.values():
+            idx._index_symbols(mi)
+        for mi in idx.modules.values():
+            idx._index_imports(mi)
+        for mi in idx.modules.values():
+            idx._index_types(mi)
+        return idx
+
+    def _load_module(self, modname: str, path: str,
+                     is_pkg: bool) -> None:
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            self.errors.append(
+                (path, getattr(e, "lineno", 0) or 0, str(e)))
+            return
+        self.modules[modname] = ModuleInfo(modname, path, tree,
+                                           is_pkg=is_pkg)
+
+    def _index_symbols(self, mi: ModuleInfo) -> None:
+        def walk(node: ast.AST, cls: Optional[ClassInfo],
+                 parent: Optional[FuncInfo], prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    qual = f"{prefix}.{child.name}"
+                    ci = ClassInfo(qual, child.name, mi, child,
+                                   base_exprs=list(child.bases))
+                    self.classes[qual] = ci
+                    if cls is None and parent is None:
+                        mi.classes[child.name] = ci
+                    walk(child, ci, None, qual)
+                elif isinstance(child, _FUNC_NODES):
+                    qual = f"{prefix}.{child.name}"
+                    fi = FuncInfo(qual, mi, child, cls=cls,
+                                  parent=parent)
+                    self.functions[qual] = fi
+                    if parent is not None:
+                        parent.nested[child.name] = fi
+                    elif cls is not None:
+                        cls.methods[child.name] = fi
+                    else:
+                        mi.functions[child.name] = fi
+                    # `self` keeps meaning the method's class inside
+                    # closures, so cls flows into nested defs too
+                    walk(child, cls, fi, qual)
+                else:
+                    walk(child, cls, parent, prefix)
+
+        walk(mi.tree, None, None, mi.modname)
+
+    def _index_imports(self, mi: ModuleInfo) -> None:
+        parts = mi.modname.split(".")
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    mi.imports[local] = target
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    drop = (node.level - 1 if mi.is_pkg
+                            else node.level)
+                    if drop > len(parts):
+                        continue
+                    base = parts[:len(parts) - drop]
+                else:
+                    base = []
+                mod = node.module.split(".") if node.module else []
+                prefix = ".".join(base + mod)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    mi.imports[local] = (f"{prefix}.{a.name}"
+                                         if prefix else a.name)
+
+    def _index_types(self, mi: ModuleInfo) -> None:
+        # base classes first, then constructor-assignment attr types
+        for ci in mi.classes.values():
+            for b in ci.base_exprs:
+                q = self._resolve_class_expr(b, mi)
+                if q is not None:
+                    ci.base_quals.append(q.qual)
+        for ci in self.classes.values():
+            if ci.module is not mi:
+                continue
+            for m in ci.methods.values():
+                for n in ast.walk(m.node):
+                    if not isinstance(n, ast.Assign):
+                        continue
+                    t = self._ctor_class(n.value, mi)
+                    if t is None:
+                        continue
+                    for tgt in n.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            ci.attr_types.setdefault(tgt.attr, t.qual)
+        for stmt in mi.tree.body:
+            if isinstance(stmt, ast.Assign):
+                t = self._ctor_class(stmt.value, mi)
+                if t is None:
+                    continue
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        mi.attr_types.setdefault(tgt.id, t.qual)
+
+    def _ctor_class(self, value: ast.AST,
+                    mi: ModuleInfo) -> Optional[ClassInfo]:
+        if not isinstance(value, ast.Call):
+            return None
+        return self._resolve_class_expr(value.func, mi)
+
+    def _resolve_class_expr(self, expr: ast.AST,
+                            mi: ModuleInfo) -> Optional[ClassInfo]:
+        if isinstance(expr, ast.Name):
+            if expr.id in mi.classes:
+                return mi.classes[expr.id]
+            target = mi.imports.get(expr.id)
+            if target is not None:
+                return self.classes.get(target)
+            return None
+        if isinstance(expr, ast.Attribute):
+            mod = self._module_of(expr.value, mi)
+            if mod is not None:
+                return mod.classes.get(expr.attr)
+        return None
+
+    def _module_of(self, expr: ast.AST,
+                   mi: ModuleInfo) -> Optional[ModuleInfo]:
+        """Resolve a Name/Attribute chain to an imported project
+        module (`mod` or `pkg.sub`)."""
+        dotted = _dotted(expr)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        target = mi.imports.get(head)
+        if target is None:
+            return None
+        full = f"{target}.{rest}" if rest else target
+        return self.modules.get(full)
+
+    # -- lookup -------------------------------------------------------
+
+    def find_method(self, cls_qual: str,
+                    name: str) -> Optional[FuncInfo]:
+        seen = set()
+        queue = [cls_qual]
+        while queue:
+            q = queue.pop(0)
+            if q in seen:
+                continue
+            seen.add(q)
+            ci = self.classes.get(q)
+            if ci is None:
+                continue
+            if name in ci.methods:
+                return ci.methods[name]
+            queue.extend(ci.base_quals)
+        return None
+
+    def attr_type(self, cls_qual: str, attr: str) -> Optional[str]:
+        seen = set()
+        queue = [cls_qual]
+        while queue:
+            q = queue.pop(0)
+            if q in seen:
+                continue
+            seen.add(q)
+            ci = self.classes.get(q)
+            if ci is None:
+                continue
+            if attr in ci.attr_types:
+                return ci.attr_types[attr]
+            queue.extend(ci.base_quals)
+        return None
+
+    def resolve_call(self, func_expr: ast.AST,
+                     scope: FuncInfo) -> Optional[FuncInfo]:
+        """The FuncInfo a call expression lands in, or None."""
+        mi = scope.module
+        if isinstance(func_expr, ast.Name):
+            r = self._resolve_name(func_expr.id, scope)
+            return self._as_func(r)
+        if not isinstance(func_expr, ast.Attribute):
+            return None
+        attr, value = func_expr.attr, func_expr.value
+        if (isinstance(value, ast.Name) and value.id in ("self", "cls")
+                and scope.cls is not None):
+            return self.find_method(scope.cls.qual, attr)
+        if isinstance(value, ast.Name):
+            # imported module / imported-or-local class / instance
+            mod = self.modules.get(mi.imports.get(value.id, ""))
+            if mod is not None:
+                return self._as_func(
+                    mod.functions.get(attr) or mod.classes.get(attr))
+            r = self._resolve_name(value.id, scope)
+            if isinstance(r, ClassInfo):
+                return self.find_method(r.qual, attr)
+            inst = mi.attr_types.get(value.id)
+            if inst is not None:
+                return self.find_method(inst, attr)
+            return None
+        if isinstance(value, ast.Attribute):
+            # self.X.meth() via inferred attr type
+            if (isinstance(value.value, ast.Name)
+                    and value.value.id == "self"
+                    and scope.cls is not None):
+                t = self.attr_type(scope.cls.qual, value.attr)
+                if t is not None:
+                    return self.find_method(t, attr)
+                return None
+            mod = self._module_of(value, mi)
+            if mod is not None:
+                return self._as_func(
+                    mod.functions.get(attr) or mod.classes.get(attr))
+        return None
+
+    def _resolve_name(
+            self, name: str, scope: FuncInfo,
+    ) -> Optional[Union[FuncInfo, ClassInfo]]:
+        fn: Optional[FuncInfo] = scope
+        while fn is not None:
+            if name in fn.nested:
+                return fn.nested[name]
+            fn = fn.parent
+        mi = scope.module
+        if name in mi.functions:
+            return mi.functions[name]
+        if name in mi.classes:
+            return mi.classes[name]
+        target = mi.imports.get(name)
+        if target is not None:
+            got = self.functions.get(target) or self.classes.get(target)
+            if got is not None:
+                return got
+        return None
+
+    def _as_func(self, r) -> Optional[FuncInfo]:
+        if isinstance(r, FuncInfo):
+            return r
+        if isinstance(r, ClassInfo):
+            return self.find_method(r.qual, "__init__")
+        return None
+
+    def all_functions(self) -> List[FuncInfo]:
+        return list(self.functions.values())
+
+
+def _dotted(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = _dotted(expr.value)
+        return None if base is None else f"{base}.{expr.attr}"
+    return None

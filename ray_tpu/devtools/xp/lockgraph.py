@@ -1,0 +1,288 @@
+"""Cross-file lock-order inversion detection.
+
+The per-file ``lock-order-inversion`` rule sees both orders only when
+each is nested directly inside one module. This pass closes the gap
+ROADMAP item 5 deferred: it normalizes lock identities project-wide
+(``self._lock`` in class C of module m -> ``m.C._lock``; a module
+global -> ``m._LOCK``), records which locks every function acquires,
+computes the transitive closure of acquisition over the call graph,
+and then adds an order edge ``H -> L`` for every call site that runs
+with ``H`` held into a callee whose closure acquires ``L``. A pair
+with edges in both directions — where at least one side needed the
+call graph to see — is a deadlock the per-file rule cannot catch.
+
+Identity is class-level, not instance-level: two *distinct* instances
+of one class can legally nest ``a._lock`` inside ``b._lock`` in both
+orders without deadlocking, but code doing that is already beyond
+what a static pass can bless, and the runtime ``locktrace`` checker
+(which tracks real lock objects) adjudicates those. Locks that stay
+function-local (``lk = threading.Lock()`` in a body) get a
+function-scoped key, so they self-order but never create cross-file
+edges.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..raylint import _expr_key, _lockish
+from .index import FuncInfo, ProjectIndex
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SKIP_NODES = _FUNC_NODES + (ast.ClassDef, ast.Lambda)
+
+
+@dataclass
+class CallSite:
+    callee: Optional[str]      # resolved callee qual, or None
+    held: Tuple[str, ...]
+    line: int
+
+
+@dataclass
+class FnLocks:
+    fn: FuncInfo
+    acquires: List[Tuple[str, int]] = field(default_factory=list)
+    edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, _SKIP_NODES) and n is not fn:
+            continue
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(n.target, ast.Name):
+                out.add(n.target.id)
+    args = getattr(fn, "args", None)
+    if args is not None:
+        out.update(p.arg for p in args.posonlyargs + args.args
+                   + args.kwonlyargs)
+    return out
+
+
+def _norm_lock(expr: ast.AST, fi: FuncInfo, idx: ProjectIndex,
+               local: Set[str]) -> str:
+    """Project-wide identity for a lock expression."""
+    if isinstance(expr, ast.Attribute):
+        v = expr.value
+        if (isinstance(v, ast.Name) and v.id in ("self", "cls")
+                and fi.cls is not None):
+            return f"{fi.cls.qual}.{expr.attr}"
+        if isinstance(v, ast.Name):
+            mod = idx.modules.get(
+                fi.module.imports.get(v.id, ""))
+            if mod is not None:
+                return f"{mod.modname}.{expr.attr}"
+            t = fi.module.attr_types.get(v.id)
+            if t is not None:
+                return f"{t}.{expr.attr}"
+        if (isinstance(v, ast.Attribute)
+                and isinstance(v.value, ast.Name)
+                and v.value.id == "self" and fi.cls is not None):
+            t = idx.attr_type(fi.cls.qual, v.attr)
+            if t is not None:
+                return f"{t}.{expr.attr}"
+    elif isinstance(expr, ast.Name):
+        if expr.id not in local:
+            return f"{fi.module.modname}.{expr.id}"
+    # function-local / unresolvable: scope the key to this function
+    return f"{fi.qual}:{_expr_key(expr)}"
+
+
+def _scan(fi: FuncInfo, idx: ProjectIndex) -> FnLocks:
+    out = FnLocks(fi)
+    local = _local_names(fi.node)
+    held: List[str] = []
+
+    def norm(expr: ast.AST) -> str:
+        return _norm_lock(expr, fi, idx, local)
+
+    def record_acquire(key: str, line: int) -> None:
+        out.acquires.append((key, line))
+        for outer in held:
+            if outer != key:
+                out.edges.append((outer, key, line))
+
+    def note_calls(node: ast.AST) -> None:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _SKIP_NODES):
+                continue
+            if isinstance(n, ast.Call):
+                callee = idx.resolve_call(n.func, fi)
+                out.calls.append(CallSite(
+                    callee.qual if callee else None, tuple(held),
+                    getattr(n, "lineno", 0)))
+            stack.extend(ast.iter_child_nodes(n))
+
+    def process(stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, _SKIP_NODES):
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(
+                    stmt.value, ast.Call):
+                c = stmt.value
+                if (isinstance(c.func, ast.Attribute)
+                        and c.func.attr in ("acquire", "release")
+                        and _lockish(c.func.value)):
+                    key = norm(c.func.value)
+                    if c.func.attr == "acquire":
+                        record_acquire(key, c.lineno)
+                        held.append(key)
+                    elif key in held:
+                        for i in range(len(held) - 1, -1, -1):
+                            if held[i] == key:
+                                del held[i]
+                                break
+                    continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                taken = []
+                for item in stmt.items:
+                    note_calls(item.context_expr)
+                    if _lockish(item.context_expr):
+                        key = norm(item.context_expr)
+                        record_acquire(key,
+                                       item.context_expr.lineno)
+                        held.append(key)
+                        taken.append(key)
+                process(stmt.body)
+                for _ in taken:
+                    held.pop()
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                note_calls(stmt.test)
+                process(stmt.body)
+                process(stmt.orelse)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                note_calls(stmt.iter)
+                process(stmt.body)
+                process(stmt.orelse)
+                continue
+            if isinstance(stmt, ast.Try):
+                process(stmt.body)
+                for h in stmt.handlers:
+                    process(h.body)
+                process(stmt.orelse)
+                process(stmt.finalbody)
+                continue
+            note_calls(stmt)
+
+    process(list(getattr(fi.node, "body", [])))
+    return out
+
+
+@dataclass
+class Witness:
+    direct: bool
+    fn: str
+    path: str
+    line: int
+    desc: str
+
+
+def check(idx: ProjectIndex) -> List:
+    """Run the pass; returns raylint Findings."""
+    from ..raylint import Finding
+
+    scans: Dict[str, FnLocks] = {
+        fi.qual: _scan(fi, idx) for fi in idx.all_functions()}
+
+    # closure[f][lock] = (callee qual | None, line where introduced)
+    closure: Dict[str, Dict[str, Tuple[Optional[str], int]]] = {
+        q: {k: (None, ln) for k, ln in s.acquires}
+        for q, s in scans.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, s in scans.items():
+            mine = closure[q]
+            for c in s.calls:
+                if c.callee is None or c.callee == q:
+                    continue
+                for lock in closure.get(c.callee, ()):
+                    if lock not in mine:
+                        mine[lock] = (c.callee, c.line)
+                        changed = True
+
+    def chain(fn_qual: str, lock: str, depth: int = 0) -> str:
+        if depth > 12:
+            return "..."
+        via, line = closure[fn_qual][lock]
+        if via is None:
+            return f"{fn_qual}:{line} acquires `{lock}`"
+        return f"{fn_qual}:{line} -> {chain(via, lock, depth + 1)}"
+
+    edges: Dict[Tuple[str, str], List[Witness]] = {}
+
+    def add(a: str, b: str, w: Witness) -> None:
+        edges.setdefault((a, b), []).append(w)
+
+    for q, s in scans.items():
+        fi = s.fn
+        owner = fi.cls.name if fi.cls else None
+        for a, b, line in s.edges:
+            add(a, b, Witness(
+                True, q, fi.path, line,
+                f"{q}() acquires `{b}` at {fi.path}:{line} while "
+                f"holding `{a}`"))
+        for c in s.calls:
+            if c.callee is None or not c.held:
+                continue
+            for lock in closure.get(c.callee, ()):
+                for h in c.held:
+                    if h != lock:
+                        add(h, lock, Witness(
+                            False, q, fi.path, c.line,
+                            f"{q}() holds `{h}` at {fi.path}:"
+                            f"{c.line} while calling "
+                            f"{chain(c.callee, lock)} -> takes "
+                            f"`{lock}`"))
+
+    # function-local keys never pair across functions; drop them from
+    # the global graph entirely (they contain a ':').
+    pairs = {(a, b) for (a, b) in edges
+             if ":" not in a and ":" not in b}
+
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str]] = set()
+    owner_of: Dict[str, Tuple[str, Optional[str]]] = {
+        q: (s.fn.path, s.fn.cls.name if s.fn.cls else None)
+        for q, s in scans.items()}
+    for (a, b) in sorted(pairs):
+        if (b, a) not in pairs or (b, a) in seen:
+            continue
+        seen.add((a, b))
+        fwd, rev = edges[(a, b)], edges[(b, a)]
+        # both orders nested directly in one file's class/module group
+        # is the per-file rule's finding; only report what NEEDED the
+        # call graph.
+        same_group_direct = any(
+            wf.direct and wr.direct
+            and owner_of[wf.fn] == owner_of[wr.fn]
+            for wf in fwd for wr in rev)
+        cross = [(wf, wr) for wf in fwd for wr in rev
+                 if not (wf.direct and wr.direct
+                         and owner_of[wf.fn] == owner_of[wr.fn])]
+        if same_group_direct and not cross:
+            continue
+        if not cross:
+            continue
+        # prefer a witness pair where at least one side crossed a call
+        cross.sort(key=lambda p: (p[0].direct + p[1].direct))
+        wf, wr = cross[0]
+        findings.append(Finding(
+            wf.path, wf.line, "xp-lock-order-inversion",
+            f"`{a}` -> `{b}`: {wf.desc}; but the opposite order "
+            f"exists: {wr.desc} — deadlock when both paths run "
+            f"concurrently"))
+    return findings

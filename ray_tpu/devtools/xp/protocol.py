@@ -1,0 +1,668 @@
+"""Wire-protocol conformance: senders vs handlers of typed messages.
+
+The control plane speaks untyped dicts — ``{"type": "task", ...}``
+framed over TCP (driver <-> daemon) and unix sockets (daemon/driver
+<-> worker). Nothing checks statically that both ends agree, and a
+disagreement surfaces as a hang or a silently-dead protocol arm, not
+a crash. This pass extracts both ends from the AST:
+
+**Send sites** — a dict literal with a constant ``"type"`` key that
+flows into a send-like call (``send_msg``, ``_send_json``,
+``request``, ``call``, ``run_task``, ...): directly as an argument,
+via a local variable (tracking ``msg["k"] = v`` field augmentation),
+via a function that returns the message and a caller that sends the
+result (the daemon's ``reply = self._handle_profile(msg)`` then
+``send_msg(conn, reply)`` two-step), or via a parameter of a helper
+that itself forwards into a send call (the dashboard's
+``_daemon_call(node, {...})``).
+
+**Handlers** — any comparison/membership test/match of a message's
+``"type"`` against string constants, including through an alias
+variable (``mtype = msg.get("type")``) and through a callee parameter
+(``_dispatch_one(conn, msg, mtype, ...)`` dispatching on a type
+computed by its caller).
+
+**Field reads** — inside a handler branch for type ``T``, hard reads
+``msg["k"]`` / ``msg.pop("k")`` (KeyError on absence) are demanded of
+``T``'s senders; ``.get()``-style reads are tolerant. Reads made by
+helpers the message is forwarded to are attributed through the call
+graph. Any ``v["k"] = ...`` augmentation on a variable whose message
+type is not statically known counts as potentially providing ``k``
+(the daemon injects ``msg["fn"]`` into a relayed task this way), so
+the missing-field rule only fires when *no* code path can provide
+the field.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .index import FuncInfo, ProjectIndex
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SKIP_NODES = _FUNC_NODES + (ast.ClassDef, ast.Lambda)
+
+# Terminal callable names that put a dict on a wire/queue toward
+# another process. Wrappers reached through parameter forwarding are
+# discovered automatically; this is the primitive set.
+SEND_FUNCS = {"send_msg", "_send_json", "send_json", "request",
+              "call", "run_task", "send"}
+
+
+@dataclass
+class MsgLit:
+    type: str
+    fields: Set[str]
+    path: str
+    line: int
+    sent: bool = False
+
+
+# env/arg descriptors:
+#   ("lit", MsgLit)          a tracked message literal
+#   ("param", name)          the enclosing function's parameter
+#   ("call", qual|None, i)   slot i of a call result
+#   ("name", varname)        an untracked variable
+
+
+@dataclass
+class CallEvent:
+    callee: Optional[str]          # resolved qual
+    callee_is_method: bool
+    terminal: str
+    args: List[tuple]              # positional descs
+    kwargs: Dict[str, tuple]
+    arg_names: Dict[int, str]      # pos -> raw var name
+    constraints: Dict[int, FrozenSet[str]]   # pos -> active types
+    alias_pairs: List[Tuple[int, int]]       # (msg pos, type pos)
+    line: int
+
+
+@dataclass
+class FnScan:
+    fi: FuncInfo
+    roles: Tuple[Tuple[str, str], ...]       # ((typeparam, msgparam),)
+    literals: List[MsgLit] = field(default_factory=list)
+    # var -> type|'*' -> field -> (line, hard)
+    reads: Dict[str, Dict[str, Dict[str, Tuple[int, bool]]]] = \
+        field(default_factory=dict)
+    handled: List[Tuple[str, int]] = field(default_factory=list)
+    sends: List[tuple] = field(default_factory=list)      # descs
+    calls: List[CallEvent] = field(default_factory=list)
+    returns: List[List[tuple]] = field(default_factory=list)
+    provided_any: Set[str] = field(default_factory=set)
+
+
+def _type_expr_var(node: ast.AST) -> Optional[str]:
+    """var name when `node` reads var's "type" field."""
+    if (isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and isinstance(node.slice, ast.Constant)
+            and node.slice.value == "type"):
+        return node.value.id
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("get", "pop")
+            and isinstance(node.func.value, ast.Name)
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value == "type"):
+        return node.func.value.id
+    return None
+
+
+def _const_types(node: ast.AST) -> Optional[FrozenSet[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset((node.value,))
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        vals = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            vals.append(e.value)
+        return frozenset(vals)
+    return None
+
+
+def _msg_literal(node: ast.AST, path: str) -> Optional[MsgLit]:
+    if not isinstance(node, ast.Dict):
+        return None
+    mtype, fields = None, set()
+    for k, v in zip(node.keys, node.values):
+        if k is None or not (isinstance(k, ast.Constant)
+                             and isinstance(k.value, str)):
+            continue
+        fields.add(k.value)
+        if k.value == "type":
+            if not (isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                return None          # dynamic type: not a wire literal
+            mtype = v.value
+    if mtype is None:
+        return None
+    return MsgLit(mtype, fields, path, node.lineno)
+
+
+class _Walker:
+    def __init__(self, idx: ProjectIndex, fi: FuncInfo,
+                 roles: Tuple[Tuple[str, str], ...]):
+        self.idx = idx
+        self.fi = fi
+        self.out = FnScan(fi, roles)
+        self.env: Dict[str, tuple] = {
+            p: ("param", p) for p in fi.param_names()}
+        self.alias: Dict[str, str] = dict(
+            (tp, mp) for tp, mp in roles)
+
+    # -- recording ----------------------------------------------------
+
+    def _read(self, var: str, fld: str, line: int, hard: bool,
+              constraints: Dict[str, FrozenSet[str]]) -> None:
+        if fld == "type":
+            return
+        slots = self.out.reads.setdefault(var, {})
+        keys = constraints.get(var) or ("*",)
+        for t in keys:
+            slots.setdefault(t, {}).setdefault(fld, (line, hard))
+
+    def _desc(self, node: ast.AST) -> Optional[tuple]:
+        lit = _msg_literal(node, self.fi.path)
+        if lit is not None:
+            self.out.literals.append(lit)
+            return ("lit", lit)
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, ("name", node.id))
+        return None
+
+    # -- expression scan ----------------------------------------------
+
+    def scan_expr(self, node: ast.AST,
+                  constraints: Dict[str, FrozenSet[str]]) -> None:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            # lambdas stay in the enclosing dataflow (they close over
+            # the same message vars: the dashboard ships its request
+            # through `lambda: node.client.call(msg)`); real nested
+            # defs get their own scan.
+            if n is None or isinstance(n, _FUNC_NODES + (ast.ClassDef,)):
+                continue
+            if (isinstance(n, ast.Subscript)
+                    and isinstance(n.ctx, ast.Load)
+                    and isinstance(n.value, ast.Name)
+                    and isinstance(n.slice, ast.Constant)
+                    and isinstance(n.slice.value, str)):
+                self._read(n.value.id, n.slice.value, n.lineno,
+                           True, constraints)
+            elif isinstance(n, ast.Compare) and len(n.ops) == 1:
+                # ANY comparison of a message's type against string
+                # constants is dispatch — `ok = m.get("type") == "x"`
+                # counts the same as `if m.get("type") == "x":`.
+                if self._test_var(n.left) is not None:
+                    ts = _const_types(n.comparators[0])
+                    if ts is not None:
+                        for t in sorted(ts):
+                            self.out.handled.append((t, n.lineno))
+            elif isinstance(n, ast.Call):
+                self._on_call(n, constraints)
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _on_call(self, call: ast.Call,
+                 constraints: Dict[str, FrozenSet[str]]) -> None:
+        f = call.func
+        if (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.attr in ("get", "pop", "setdefault")
+                and call.args
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            hard = (f.attr == "pop" and len(call.args) == 1
+                    and not call.keywords)
+            self._read(f.value.id, call.args[0].value, call.lineno,
+                       hard, constraints)
+            return
+        terminal = (f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute)
+                    else "")
+        resolved = self.idx.resolve_call(f, self.fi)
+        args, arg_names, cons = [], {}, {}
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                args.append(None)
+                continue
+            args.append(self._desc(a))
+            if isinstance(a, ast.Name):
+                arg_names[i] = a.id
+                if a.id in constraints:
+                    cons[i] = constraints[a.id]
+        kwargs = {}
+        for kw in call.keywords:
+            if kw.arg is not None:
+                d = self._desc(kw.value)
+                if d is not None:
+                    kwargs[kw.arg] = d
+        alias_pairs = []
+        for i, name in arg_names.items():
+            m = self.alias.get(name)
+            if m is None:
+                continue
+            for j, mname in arg_names.items():
+                if mname == m and j != i:
+                    alias_pairs.append((j, i))
+        if terminal in SEND_FUNCS:
+            for d in list(args) + list(kwargs.values()):
+                if d is not None and d[0] in ("lit", "param", "call"):
+                    self.out.sends.append(d)
+        self.out.calls.append(CallEvent(
+            resolved.qual if resolved else None,
+            bool(resolved and resolved.cls is not None
+                 and isinstance(f, ast.Attribute)),
+            terminal, args, kwargs, arg_names, cons, alias_pairs,
+            call.lineno))
+
+    # -- statement walk -----------------------------------------------
+
+    def _bind_assign(self, stmt: ast.Assign,
+                     constraints: Dict[str, FrozenSet[str]]) -> None:
+        value = stmt.value
+        lit = _msg_literal(value, self.fi.path)
+        tvar = _type_expr_var(value)
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name):
+                self.alias.pop(tgt.id, None)
+                if lit is not None:
+                    self.out.literals.append(lit)
+                    self.env[tgt.id] = ("lit", lit)
+                elif tvar is not None:
+                    self.alias[tgt.id] = tvar
+                    self.env.pop(tgt.id, None)
+                elif isinstance(value, ast.Call):
+                    r = self.idx.resolve_call(value.func, self.fi)
+                    self.env[tgt.id] = (
+                        "call", r.qual if r else None, 0)
+                else:
+                    self.env.pop(tgt.id, None)
+            elif (isinstance(tgt, ast.Tuple)
+                    and isinstance(value, ast.Call)):
+                r = self.idx.resolve_call(value.func, self.fi)
+                for i, e in enumerate(tgt.elts):
+                    if isinstance(e, ast.Name):
+                        self.alias.pop(e.id, None)
+                        self.env[e.id] = (
+                            "call", r.qual if r else None, i)
+            elif (isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                    and isinstance(tgt.slice, ast.Constant)
+                    and isinstance(tgt.slice.value, str)):
+                d = self.env.get(tgt.value.id)
+                if d is not None and d[0] == "lit":
+                    d[1].fields.add(tgt.slice.value)
+                else:
+                    self.out.provided_any.add(tgt.slice.value)
+
+    def _type_test(self, test: ast.AST,
+                   ) -> Optional[Tuple[str, FrozenSet[str], bool]]:
+        """(msg var, types, positive) for a type-dispatch test.
+        (Handled-type recording happens in scan_expr, which sees
+        every comparison including these.)"""
+        if isinstance(test, ast.BoolOp):
+            found = None
+            for v in test.values:
+                r = self._type_test(v)
+                if r is None:
+                    continue
+                if found is None:
+                    found = r
+                elif (isinstance(test.op, ast.Or)
+                        and r[0] == found[0] and r[2] and found[2]):
+                    found = (found[0], found[1] | r[1], True)
+            if found is not None and isinstance(test.op, ast.Or):
+                return found
+            # `t == "x" and <more>` still narrows the branch
+            return found
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+            return None
+        var = self._test_var(test.left)
+        if var is None:
+            return None
+        types = _const_types(test.comparators[0])
+        if types is None:
+            return None
+        positive = isinstance(test.ops[0], (ast.Eq, ast.In))
+        return (var, types, positive)
+
+    def _test_var(self, left: ast.AST) -> Optional[str]:
+        if isinstance(left, ast.Name):
+            return self.alias.get(left.id)
+        return _type_expr_var(left)
+
+    def process(self, stmts: List[ast.stmt],
+                constraints: Dict[str, FrozenSet[str]]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, _SKIP_NODES):
+                continue
+            if isinstance(stmt, ast.Assign):
+                self.scan_expr(stmt.value, constraints)
+                self._bind_assign(stmt, constraints)
+                continue
+            if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    self.scan_expr(stmt.value, constraints)
+                continue
+            if isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    self.scan_expr(stmt.value, constraints)
+                    if isinstance(stmt.value, ast.Tuple):
+                        slots = [self._desc(e)
+                                 for e in stmt.value.elts]
+                    else:
+                        slots = [self._desc(stmt.value)]
+                    self.out.returns.append(slots)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                tt = self._type_test(stmt.test)
+                self.scan_expr(stmt.test, constraints)
+                inner = dict(constraints)
+                if tt is not None and tt[2]:
+                    inner[tt[0]] = tt[1]
+                self.process(stmt.body, inner)
+                self.process(stmt.orelse, constraints)
+                continue
+            if isinstance(stmt, ast.Match):
+                var = self._test_var(stmt.subject)
+                if var is None:
+                    var = _type_expr_var(stmt.subject)
+                self.scan_expr(stmt.subject, constraints)
+                for case in stmt.cases:
+                    inner = dict(constraints)
+                    if (var is not None
+                            and isinstance(case.pattern,
+                                           ast.MatchValue)):
+                        ts = _const_types(case.pattern.value)
+                        if ts is not None:
+                            for t in sorted(ts):
+                                self.out.handled.append(
+                                    (t, case.pattern.value.lineno))
+                            inner[var] = ts
+                    self.process(case.body, inner)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self.scan_expr(stmt.iter, constraints)
+                self.process(stmt.body, constraints)
+                self.process(stmt.orelse, constraints)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self.scan_expr(item.context_expr, constraints)
+                self.process(stmt.body, constraints)
+                continue
+            if isinstance(stmt, ast.Try):
+                self.process(stmt.body, constraints)
+                for h in stmt.handlers:
+                    self.process(h.body, constraints)
+                self.process(stmt.orelse, constraints)
+                self.process(stmt.finalbody, constraints)
+                continue
+            for child in ast.iter_child_nodes(stmt):
+                self.scan_expr(child, constraints)
+
+    def run(self) -> FnScan:
+        self.process(list(getattr(self.fi.node, "body", [])), {})
+        return self.out
+
+
+# ---------------------------------------------------------------------
+# Whole-program resolution
+# ---------------------------------------------------------------------
+
+
+class _Analyzer:
+    def __init__(self, idx: ProjectIndex):
+        self.idx = idx
+        self.scans: Dict[Tuple[str, tuple], FnScan] = {}
+        self._reads_memo: Dict[tuple, dict] = {}
+
+    def scan(self, fi: FuncInfo,
+             roles: Tuple[Tuple[str, str], ...] = ()) -> FnScan:
+        key = (fi.qual, roles)
+        if key not in self.scans:
+            self.scans[key] = _Walker(self.idx, fi, roles).run()
+        return self.scans[key]
+
+    def _callee_param(self, ev: CallEvent,
+                      pos: int) -> Optional[Tuple[FuncInfo, str]]:
+        fi = self.idx.functions.get(ev.callee or "")
+        if fi is None:
+            return None
+        params = fi.param_names()
+        idx = pos + (1 if ev.callee_is_method else 0)
+        if idx >= len(params):
+            return None
+        return fi, params[idx]
+
+    def run(self):
+        # phase 1: base scans + role scans via a worklist
+        work = [(fi, ()) for fi in self.idx.all_functions()]
+        seen = set()
+        while work:
+            fi, roles = work.pop()
+            if (fi.qual, roles) in seen:
+                continue
+            seen.add((fi.qual, roles))
+            s = self.scan(fi, roles)
+            for ev in s.calls:
+                for mpos, tpos in ev.alias_pairs:
+                    got_m = self._callee_param(ev, mpos)
+                    got_t = self._callee_param(ev, tpos)
+                    if got_m is None or got_t is None:
+                        continue
+                    cfi, mparam = got_m
+                    _, tparam = got_t
+                    work.append((cfi, ((tparam, mparam),)))
+
+        # phase 2: which (fn, param) forward into a send call
+        sent_params: Set[Tuple[str, str]] = set()
+        changed = True
+        while changed:
+            changed = False
+            for (qual, roles), s in self.scans.items():
+                for d in s.sends:
+                    if d[0] == "param" and (qual, d[1]) not in \
+                            sent_params:
+                        sent_params.add((qual, d[1]))
+                        changed = True
+                for ev in s.calls:
+                    items = list(enumerate(ev.args))
+                    for pos, d in items:
+                        if d is None or d[0] != "param":
+                            continue
+                        got = self._callee_param(ev, pos)
+                        if got is None:
+                            continue
+                        cfi, pname = got
+                        if ((cfi.qual, pname) in sent_params
+                                and (qual, d[1]) not in sent_params):
+                            sent_params.add((qual, d[1]))
+                            changed = True
+
+        # phase 3: mark literals as sent (3 passes resolve
+        # literal -> returned -> sent-by-caller chains)
+        returns: Dict[str, List[List[tuple]]] = {}
+        for (qual, roles), s in self.scans.items():
+            if not roles:
+                returns.setdefault(qual, []).extend(s.returns)
+
+        def mark(d: Optional[tuple], depth: int = 0) -> None:
+            if d is None or depth > 3:
+                return
+            if d[0] == "lit":
+                d[1].sent = True
+            elif d[0] == "call":
+                for slots in returns.get(d[1] or "", []):
+                    if d[2] < len(slots):
+                        mark(slots[d[2]], depth + 1)
+
+        for _ in range(2):
+            for (qual, roles), s in self.scans.items():
+                for d in s.sends:
+                    mark(d)
+                for ev in s.calls:
+                    for pos, d in enumerate(ev.args):
+                        got = self._callee_param(ev, pos)
+                        if got and (got[0].qual, got[1]) in \
+                                sent_params:
+                            mark(d)
+                    for kwname, d in ev.kwargs.items():
+                        fi = self.idx.functions.get(ev.callee or "")
+                        if fi and (fi.qual, kwname) in sent_params:
+                            mark(d)
+
+        # phase 4: global read/handled/sent aggregation
+        handled: Dict[str, List[Tuple[str, int]]] = {}
+        reads: Dict[str, Dict[str, Tuple[str, int, bool]]] = {}
+        senders: Dict[str, List[MsgLit]] = {}
+        provided_any: Set[str] = set()
+        lit_seen: Set[Tuple[str, int, str]] = set()
+
+        def add_reads(t: str, fields: Dict[str, Tuple[int, bool]],
+                      path: str) -> None:
+            if t == "*":
+                return
+            slot = reads.setdefault(t, {})
+            for fld, (line, hard) in fields.items():
+                prev = slot.get(fld)
+                if prev is None or (hard and not prev[2]):
+                    slot[fld] = (path, line, hard)
+
+        for (qual, roles), s in self.scans.items():
+            provided_any |= s.provided_any
+            for t, line in s.handled:
+                handled.setdefault(t, []).append((s.fi.path, line))
+            for lit in s.literals:
+                if not lit.sent:
+                    continue
+                key = (lit.path, lit.line, lit.type)
+                if key in lit_seen:
+                    continue
+                lit_seen.add(key)
+                senders.setdefault(lit.type, []).append(lit)
+            for var, by_type in s.reads.items():
+                for t, fields in by_type.items():
+                    add_reads(t, fields, s.fi.path)
+            # forward constrained message vars into callee reads
+            for ev in s.calls:
+                for pos, ts in ev.constraints.items():
+                    got = self._callee_param(ev, pos)
+                    if got is None:
+                        continue
+                    cfi, pname = got
+                    child = self.param_reads(cfi, pname, ())
+                    for ct, fields in child.items():
+                        if ct == "*":
+                            for t in ts:
+                                add_reads(t, fields, cfi.path)
+                        else:
+                            add_reads(ct, fields, cfi.path)
+        return senders, handled, reads, provided_any
+
+    def param_reads(self, fi: FuncInfo, pname: str, roles: tuple,
+                    _stack: Optional[frozenset] = None) -> dict:
+        """{type|'*': {field: (line, hard)}} for a message param,
+        including reads by callees it is forwarded to."""
+        key = (fi.qual, pname, roles)
+        if key in self._reads_memo:
+            return self._reads_memo[key]
+        stack = _stack or frozenset()
+        if key in stack:
+            return {}
+        stack = stack | {key}
+        s = self.scan(fi, roles)
+        out: Dict[str, Dict[str, Tuple[int, bool]]] = {}
+        for t, fields in s.reads.get(pname, {}).items():
+            out.setdefault(t, {}).update(fields)
+        for ev in s.calls:
+            for pos, d in enumerate(ev.args):
+                if ev.arg_names.get(pos) != pname:
+                    continue
+                got = self._callee_param(ev, pos)
+                if got is None:
+                    continue
+                cfi, cpname = got
+                # derive roles when the callee also gets the alias
+                croles: tuple = ()
+                for mpos, tpos in ev.alias_pairs:
+                    if mpos == pos:
+                        got_t = self._callee_param(ev, tpos)
+                        if got_t is not None:
+                            croles = ((got_t[1], cpname),)
+                child = self.param_reads(cfi, cpname, croles, stack)
+                ts = ev.constraints.get(pos)
+                for ct, fields in child.items():
+                    if ct == "*" and ts:
+                        for t in ts:
+                            out.setdefault(t, {}).update(fields)
+                    else:
+                        out.setdefault(ct, {}).update(fields)
+        self._reads_memo[key] = out
+        return out
+
+
+def check(idx: ProjectIndex):
+    """Returns (findings, inventory rows)."""
+    from ..raylint import Finding
+
+    senders, handled, reads, provided_any = _Analyzer(idx).run()
+    findings: List[Finding] = []
+
+    for t in sorted(senders):
+        if t in handled:
+            continue
+        lit = senders[t][0]
+        findings.append(Finding(
+            lit.path, lit.line, "proto-orphan-sent",
+            f'message type "{t}" is sent here but no handler in the '
+            f'tree dispatches on it — the receiver will hit its '
+            f'unknown-type path (or hang a caller awaiting a typed '
+            f'reply)'))
+    for t in sorted(handled):
+        if t in senders:
+            continue
+        path, line = handled[t][0]
+        findings.append(Finding(
+            path, line, "proto-orphan-handled",
+            f'handler dispatches on message type "{t}" but no send '
+            f'site in the tree produces it — dead protocol arm, or '
+            f'a sender outside this tree (baseline it with the '
+            f'sender\'s location as the reason)'))
+    for t in sorted(set(senders) & set(handled)):
+        provided: Set[str] = set()
+        for lit in senders[t]:
+            provided |= lit.fields
+        for fld, (path, line, hard) in sorted(
+                reads.get(t, {}).items()):
+            if not hard or fld in provided or fld in provided_any:
+                continue
+            findings.append(Finding(
+                path, line, "proto-missing-field",
+                f'handler for "{t}" hard-reads msg["{fld}"] but no '
+                f'sender of "{t}" provides it — KeyError (or a dead '
+                f'branch) the first time this path runs'))
+
+    inventory: List[dict] = []
+    for t in sorted(set(senders) | set(handled)):
+        provided = set()
+        for lit in senders.get(t, []):
+            provided |= lit.fields
+        inventory.append({
+            "type": t,
+            "senders": [f"{lit.path}:{lit.line}"
+                        for lit in senders.get(t, [])],
+            "handlers": [f"{p}:{ln}"
+                         for p, ln in handled.get(t, [])],
+            "fields": sorted(provided - {"type"}),
+            "reads": sorted(reads.get(t, {})),
+        })
+    return findings, inventory

@@ -1,0 +1,157 @@
+"""Machine-readable reports (JSON / SARIF 2.1.0) and the baseline.
+
+Whole-program findings have no single line to hang an inline
+suppression on, so their suppression mechanism is a checked-in
+baseline file::
+
+    {
+      "version": 1,
+      "entries": [
+        {"rule": "proto-orphan-handled",
+         "path": "ray_tpu/node/daemon.py",
+         "contains": "task_xlang",
+         "reason": "sent by the C++ client (cpp/src/client.cc)"}
+      ]
+    }
+
+An entry matches a finding when the rule is equal, the finding's path
+ends with ``path``, and ``contains`` is a substring of the message.
+``reason`` is mandatory — an entry without one is reported, and so is
+an entry that matches nothing (``stale-baseline``), so the file can
+only shrink as findings are fixed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def apply_baseline(findings: list, baseline_path: str) -> list:
+    """Mark baselined findings suppressed (annotating the reason);
+    returns extra findings for malformed/stale entries."""
+    from ..raylint import Finding
+
+    extra: List[Finding] = []
+    try:
+        with open(baseline_path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return extra
+    except (OSError, ValueError) as e:
+        return [Finding(baseline_path, 0, "stale-baseline",
+                        f"unreadable baseline: {e}")]
+    for i, entry in enumerate(data.get("entries", [])):
+        rule = entry.get("rule", "")
+        path_sfx = entry.get("path", "")
+        contains = entry.get("contains", "")
+        reason = entry.get("reason", "").strip()
+        if not reason:
+            extra.append(Finding(
+                baseline_path, 0, "stale-baseline",
+                f"baseline entry #{i} ({rule}: {contains!r}) has no "
+                f"reason — every suppression must say why"))
+            continue
+        matched = False
+        for f in findings:
+            if (f.rule == rule and f.path.endswith(path_sfx)
+                    and contains in f.message and not f.suppressed):
+                f.suppressed = True
+                f.message += f" [baselined: {reason}]"
+                matched = True
+        if not matched:
+            extra.append(Finding(
+                baseline_path, 0, "stale-baseline",
+                f"baseline entry #{i} ({rule}: {contains!r}) matches "
+                f"no finding — remove it (the hazard is fixed) or fix "
+                f"the entry"))
+    return extra
+
+
+def to_json(findings: list, inventory: Optional[list] = None) -> str:
+    active = [f for f in findings if not f.suppressed]
+    payload = {
+        "findings": [f.to_dict() for f in findings],
+        "total": len(active),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+    }
+    if inventory is not None:
+        payload["protocol"] = inventory
+    return json.dumps(payload, indent=2)
+
+
+def _rel(path: str) -> str:
+    try:
+        rel = os.path.relpath(path)
+    except ValueError:
+        return path
+    return path if rel.startswith("..") else rel.replace(os.sep, "/")
+
+
+def to_sarif(findings: list, rule_docs: Dict[str, str]) -> str:
+    rules_seen = sorted({f.rule for f in findings})
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "note" if f.suppressed else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": _rel(f.path)},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+        if f.suppressed:
+            result["suppressions"] = [{"kind": "external"}]
+        results.append(result)
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "raylint",
+                "informationUri":
+                    "https://example.invalid/ray_tpu/devtools",
+                "rules": [{
+                    "id": r,
+                    "shortDescription":
+                        {"text": rule_docs.get(r, r)},
+                } for r in rules_seen],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
+
+
+def inventory_table(inventory: Iterable[dict]) -> str:
+    """The wire-protocol inventory as a markdown table."""
+    lines = [
+        "| type | senders | handlers | fields |",
+        "|------|---------|----------|--------|",
+    ]
+    for row in inventory:
+        def sites(key):
+            items = [_rel(s) for s in row[key]]
+            if not items:
+                return "—"
+            shown = ", ".join(items[:3])
+            if len(items) > 3:
+                shown += f", … ({len(items)} total)"
+            return shown
+        lines.append(
+            f"| `{row['type']}` | {sites('senders')} | "
+            f"{sites('handlers')} | "
+            f"{', '.join(row['fields']) or '—'} |")
+    return "\n".join(lines)

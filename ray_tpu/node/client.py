@@ -124,7 +124,15 @@ class NodeClient:
         return NodeConn(self.host, self.dispatch_port)
 
     def ping(self) -> Dict[str, Any]:
-        return self.call({"type": "ping"})
+        reply = self.call({"type": "ping"})
+        # A daemon that answers with anything but a pong is not
+        # healthy — callers treat ping() returning as "alive", so a
+        # mistyped reply must raise here, not pass as health.
+        if reply.get("type") != "pong":
+            raise ConnectionError(
+                f"ping to {self.host}:{self.dispatch_port} returned "
+                f"message type {reply.get('type')!r}, expected 'pong'")
+        return reply
 
     def close(self) -> None:
         with self._lock:

@@ -1419,6 +1419,11 @@ class NodeDaemon:
 
 
 def main() -> None:
+    # Cross-process lock tracing: arm BEFORE the daemon (and its locks)
+    # exist. No-op unless RAY_TPU_LOCKTRACE_DIR is set.
+    from ray_tpu.devtools.locktrace import maybe_install_from_env
+
+    maybe_install_from_env()
     # SIGUSR1 → thread dump on stderr (live-debugging a wedged daemon).
     import faulthandler
     import signal

@@ -563,6 +563,18 @@ def cmd_raylint(args) -> int:
         argv += ["--select", args.select]
     if args.list_rules:
         argv.append("--list-rules")
+    if args.xp:
+        argv.append("--xp")
+    if args.format:
+        argv += ["--format", args.format]
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    if args.proto_inventory:
+        argv.append("--proto-inventory")
+    if args.out:
+        argv += ["--out", args.out]
     return raylint.main(argv)
 
 
@@ -740,6 +752,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also print suppressed findings")
     rl.add_argument("--list-rules", action="store_true",
                     help="list registered rules and exit")
+    rl.add_argument("--xp", action="store_true",
+                    help="run the whole-program passes too "
+                         "(cross-file lock order, wire-protocol "
+                         "conformance)")
+    rl.add_argument("--format", choices=("text", "json", "sarif"),
+                    default=None, help="report format")
+    rl.add_argument("--baseline", default=None,
+                    help="baseline JSON for whole-program findings")
+    rl.add_argument("--no-baseline", action="store_true",
+                    help="ignore the checked-in baseline")
+    rl.add_argument("--proto-inventory", action="store_true",
+                    help="print the wire-protocol inventory table")
+    rl.add_argument("--out", default=None,
+                    help="write the report to a file")
     rl.set_defaults(fn=cmd_raylint)
     return p
 

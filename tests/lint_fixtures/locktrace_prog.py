@@ -1,0 +1,32 @@
+"""Fixture program for the locktrace cross-process merge test.
+
+Usage: python locktrace_prog.py {ab|ba} <dump-path>
+
+Installs locktrace, creates two locks at FIXED creation sites (the
+cross-process join key), nests them in the order given by argv[1], and
+dumps the order graph to argv[2]. The test runs it twice — once "ab",
+once "ba" — and asserts merge_graphs() flags the inversion that no
+single run could see.
+"""
+
+import sys
+
+from ray_tpu.devtools import locktrace
+
+
+def main():
+    order, dump_path = sys.argv[1], sys.argv[2]
+    locktrace.install()
+    import threading
+
+    lock_a = threading.Lock()  # creation site = join key across runs
+    lock_b = threading.Lock()  # creation site = join key across runs
+    first, second = (lock_a, lock_b) if order == "ab" else (lock_b, lock_a)
+    with first:
+        with second:
+            pass
+    locktrace.dump_graph(dump_path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+# Fixture package: cross-file lock-order inversion for raylint --xp.
+# a.flush() holds A_LOCK and calls b.push() (takes B_LOCK);
+# b.deliver() holds B_LOCK and calls a.apply_update() (takes A_LOCK).
+# Neither file shows an inversion alone — only the project-wide call
+# graph does.

@@ -1,0 +1,18 @@
+import threading
+
+from . import b
+
+A_LOCK = threading.Lock()
+_pending = []
+
+
+def flush():
+    # A_LOCK -> (via b.push) B_LOCK
+    with A_LOCK:
+        _pending.clear()
+        b.push()
+
+
+def apply_update():
+    with A_LOCK:
+        _pending.append("update")

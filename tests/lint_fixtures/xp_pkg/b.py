@@ -1,0 +1,18 @@
+import threading
+
+from . import a
+
+B_LOCK = threading.Lock()
+_queue = []
+
+
+def push():
+    with B_LOCK:
+        _queue.append("item")
+
+
+def deliver():
+    # B_LOCK -> (via a.apply_update) A_LOCK: reverse of a.flush()
+    with B_LOCK:
+        _queue.clear()
+        a.apply_update()

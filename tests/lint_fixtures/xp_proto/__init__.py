@@ -1,0 +1,7 @@
+# Fixture package: wire-protocol conformance for raylint --xp.
+# Expected findings:
+#   proto-orphan-sent    — "orphan_cmd" sent in sender.py, no handler;
+#   proto-orphan-handled — "never_sent" dispatched in handler.py, no
+#                          sender anywhere;
+#   proto-missing-field  — handler.py hard-reads msg["payload"] for
+#                          "task" but sender.py's task literal lacks it.

@@ -1,0 +1,8 @@
+from .wire import send_msg
+
+
+def push_all(sock):
+    send_msg(sock, {"type": "orphan_cmd", "payload": 1})
+    msg = {"type": "task", "task_id": 7}
+    msg["extra"] = 1
+    send_msg(sock, msg)

@@ -1,0 +1,2 @@
+def send_msg(sock, msg):
+    sock.sendall(msg)

@@ -1,14 +1,21 @@
 """Tier-1 gate for the raylint static-analysis pass.
 
-Two directions:
+Three directions:
 - the whole installed ``ray_tpu`` tree must be CLEAN (zero unsuppressed
   findings, every suppression justified) — new code that reintroduces a
-  lock-discipline/teardown/state-roundtrip hazard fails the suite;
+  lock-discipline/teardown/state-roundtrip hazard fails the suite; this
+  now includes the whole-program ``--xp`` passes (cross-file lock-order
+  graph + wire-protocol conformance) against the checked-in baseline;
 - every rule must actually FIRE on its seeded violation in
-  tests/lint_fixtures/ (and honor disable comments), so a regression in
-  the analyzer itself cannot silently turn the gate into a no-op.
+  tests/lint_fixtures/ (and honor disable comments / the baseline), so
+  a regression in the analyzer itself cannot silently turn the gate
+  into a no-op;
+- report formats round-trip (JSON keys stable, SARIF 2.1.0 parses and
+  mirrors the JSON findings), and the gate leaves a SARIF artifact at
+  /tmp/_t1_raylint.sarif next to the tier-1 log.
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -17,6 +24,10 @@ import pytest
 
 from ray_tpu.devtools import raylint
 from ray_tpu.devtools.raylint import RULES, lint_paths
+from ray_tpu.devtools.xp import XP_RULES, run_xp
+from ray_tpu.devtools.xp.report import (apply_baseline,
+                                        default_baseline_path, to_json,
+                                        to_sarif)
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 PKG = os.path.join(REPO, "ray_tpu")
@@ -193,3 +204,180 @@ def test_locktrace_detects_and_clears():
         locktrace.report())
     locktrace.clear_violations()
     assert not locktrace.violations()
+
+
+# ---------------------------------------------------------------------------
+# Whole-program (--xp) passes
+# ---------------------------------------------------------------------------
+
+
+def test_xp_rule_registry_complete():
+    expected = {
+        "xp-lock-order-inversion", "proto-orphan-sent",
+        "proto-orphan-handled", "proto-missing-field",
+        "stale-baseline",
+    }
+    assert expected <= set(XP_RULES), sorted(XP_RULES)
+    # the registries must not collide: one namespace for --select
+    assert not set(XP_RULES) & set(RULES)
+
+
+def test_xp_tree_is_clean():
+    """ray_tpu/ has zero unbaselined whole-program findings — the core
+    acceptance gate for the xp passes."""
+    findings, _ = run_xp([PKG], None)
+    findings += apply_baseline(findings, default_baseline_path())
+    active = [f for f in findings if not f.suppressed]
+    assert not active, "raylint --xp findings in ray_tpu/:\n" + "\n".join(
+        f.render() for f in active)
+
+
+def test_xp_lock_inversion_fires_cross_file():
+    """Two modules each take their own lock then call into the other:
+    neither file alone shows an inversion, only the project graph."""
+    findings, _ = run_xp([os.path.join(FIXTURES, "xp_pkg")], None)
+    inv = [f for f in findings if f.rule == "xp-lock-order-inversion"]
+    assert len(inv) == 1, [f.render() for f in findings]
+    msg = inv[0].message
+    assert "A_LOCK" in msg and "B_LOCK" in msg
+    # both witness chains are part of the message
+    assert "opposite order" in msg
+
+
+def test_xp_protocol_rules_fire():
+    findings, inventory = run_xp(
+        [os.path.join(FIXTURES, "xp_proto")], None)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    sent = by_rule.get("proto-orphan-sent", [])
+    assert len(sent) == 1 and '"orphan_cmd"' in sent[0].message, (
+        [f.render() for f in findings])
+    handled = by_rule.get("proto-orphan-handled", [])
+    assert len(handled) == 1 and '"never_sent"' in handled[0].message
+    missing = by_rule.get("proto-missing-field", [])
+    assert len(missing) == 1 and '"payload"' in missing[0].message
+    assert '"task"' in missing[0].message
+    # inventory accounts for every type seen in the fixture
+    types = {row["type"] for row in inventory}
+    assert {"orphan_cmd", "task", "never_sent"} <= types
+
+
+def test_xp_inventory_accounts_for_control_plane():
+    """The protocol pass must see the real control-plane vocabulary —
+    if a refactor renames send helpers out of its reach, this fails
+    instead of the gate silently going blind."""
+    _, inventory = run_xp([PKG], None)
+    types = {row["type"] for row in inventory}
+    expected = {"task", "actor_create", "actor_call", "ping", "pong",
+                "shutdown", "gen_ack", "gen_item", "hello", "result"}
+    assert expected <= types, sorted(types)
+    by_type = {row["type"]: row for row in inventory}
+    # both directions populated for the core RPC pair
+    assert by_type["ping"]["senders"] and by_type["ping"]["handlers"]
+    assert by_type["hello"]["senders"] and by_type["hello"]["handlers"]
+
+
+def test_xp_baseline_suppresses_and_flags_stale(tmp_path):
+    """A matching baseline entry (with a reason) suppresses; an entry
+    matching nothing — or lacking a reason — becomes an active
+    stale-baseline finding."""
+    findings, _ = run_xp([os.path.join(FIXTURES, "xp_proto")], None)
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "proto-orphan-sent", "path": "xp_proto/sender.py",
+         "contains": '"orphan_cmd"', "reason": "fixture: seeded orphan"},
+        {"rule": "proto-orphan-sent", "path": "no/such/file.py",
+         "contains": "nothing", "reason": "points at nothing"},
+    ]}))
+    extra = apply_baseline(findings, str(base))
+    sent = [f for f in findings if f.rule == "proto-orphan-sent"]
+    assert sent and all(f.suppressed for f in sent)
+    assert "seeded orphan" in sent[0].message
+    stale = [f for f in extra if f.rule == "stale-baseline"]
+    assert len(stale) == 1 and "'nothing'" in stale[0].message
+    assert "matches no finding" in stale[0].message
+    # other findings stay active
+    assert any(not f.suppressed for f in findings
+               if f.rule == "proto-missing-field")
+
+
+def test_xp_sarif_json_round_trip():
+    """SARIF output is valid 2.1.0, carries the same findings as the
+    JSON report, and declares every rule it references."""
+    findings, inventory = run_xp(
+        [os.path.join(FIXTURES, "xp_proto")], None)
+    jrep = json.loads(to_json(findings, inventory))
+    assert set(jrep) >= {"findings", "total", "suppressed", "protocol"}
+    assert jrep["total"] == len(findings)
+
+    docs = dict(XP_RULES)
+    sarif = json.loads(to_sarif(findings, docs))
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    results = run["results"]
+    assert len(results) == len(findings)
+    from ray_tpu.devtools.xp.report import _rel
+
+    locs = set()
+    for res in results:
+        assert res["ruleId"] in declared
+        loc = res["locations"][0]["physicalLocation"]
+        locs.add((loc["artifactLocation"]["uri"],
+                  loc["region"]["startLine"]))
+    assert locs == {(_rel(f.path), f.line) for f in findings}
+
+
+def test_xp_cli_emits_sarif_artifact():
+    """The tier-1 gate run: `raylint ray_tpu --xp --format sarif --out`
+    exits 0 on the baselined tree and leaves a parseable artifact next
+    to the tier-1 log."""
+    out = "/tmp/_t1_raylint.sarif"
+    if os.path.exists(out):
+        os.unlink(out)
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.raylint", PKG,
+         "--xp", "--format", "sarif", "--out", out],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out, "r", encoding="utf-8") as f:
+        sarif = json.load(f)
+    assert sarif["version"] == "2.1.0"
+    # the xlang baseline suppressions ride along as "note"-level
+    # results with an external suppression attached
+    suppressed = [res for res in sarif["runs"][0]["results"]
+                  if res.get("suppressions")]
+    assert suppressed, "expected baselined findings in the artifact"
+
+
+def test_xp_proto_inventory_cli():
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.raylint", PKG,
+         "--proto-inventory"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "| type |" in r.stdout and "ping" in r.stdout
+
+
+def test_locktrace_cross_process_merge(tmp_path):
+    """Each order-graph dump is clean on its own; only the merge sees
+    the A->B (process 1) vs B->A (process 2) inversion."""
+    from ray_tpu.devtools import locktrace
+
+    prog = os.path.join(FIXTURES, "locktrace_prog.py")
+    env = {**os.environ, "PYTHONPATH": REPO}
+    dumps = []
+    for order in ("ab", "ba"):
+        path = tmp_path / f"lockgraph-{order}.json"
+        r = subprocess.run([sys.executable, prog, order, str(path)],
+                           capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stderr
+        dumps.append(str(path))
+    # single-process view: no inversion
+    assert not locktrace.merge_graphs([dumps[0]])
+    vs = locktrace.merge_graphs(tmp_path.as_posix())
+    assert len(vs) == 1, locktrace.merged_report(dumps)
+    assert vs[0].kind == "lock-order-inversion"
+    assert "reverse order" in vs[0].detail
+    assert "no cross-process" not in locktrace.merged_report(dumps)
