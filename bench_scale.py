@@ -14,6 +14,7 @@ Numbers are recorded in PARITY.md §perf beside the reference's.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import time
@@ -135,10 +136,19 @@ def bench_broadcast(n_nodes: int, mib: int) -> None:
     from ray_tpu.cluster_utils import RealCluster
 
     ray_tpu.shutdown()
-    cluster = RealCluster()
+    # Generous health timeout: n_nodes concurrent GiB-scale memcpys on
+    # a small box starve daemon heartbeat threads for seconds at a
+    # time, and a spurious death mid-broadcast scrubs that node's
+    # locations and forces re-pulls. This measures the transfer plane;
+    # failure detection has its own tests (tests/test_chaos.py).
+    cluster = RealCluster(health_timeout_ms=60_000)
     # Each daemon's arena must hold the broadcast object (+ headroom).
-    env = {"RAY_TPU_OBJECT_STORE_MEMORY_BYTES":
-           str(int(mib * 1024**2 * 1.5) + (64 << 20))}
+    # The DRIVER arena is sized from the driver's own environment, not
+    # the add_node env dict — set it too, or the driver-side get() of
+    # the produced object cannot admit it.
+    arena = str(int(mib * 1024**2 * 1.5) + (64 << 20))
+    env = {"RAY_TPU_OBJECT_STORE_MEMORY_BYTES": arena}
+    os.environ["RAY_TPU_OBJECT_STORE_MEMORY_BYTES"] = arena
     try:
         for _ in range(n_nodes):
             cluster.add_node(num_cpus=1, env=env)
@@ -159,8 +169,21 @@ def bench_broadcast(n_nodes: int, mib: int) -> None:
         out = ray.get([consume.remote(ref) for _ in range(n_nodes)])
         dt = time.perf_counter() - t0
         assert out == [1.0] * n_nodes
+        # Per-source pull counts from the object directory's
+        # pull_complete reports: a relay-tree broadcast spreads the
+        # counts across many sources; a star would put everything on
+        # the producer's endpoint.
+        sources = {}
+        with contextlib.suppress(Exception):
+            from ray_tpu.core.runtime import global_runtime_or_none
+
+            rt = global_runtime_or_none()
+            if rt is not None and rt.remote_plane is not None:
+                sources = rt.remote_plane.pull_source_counts()
         emit("broadcast", dt, "s", nodes=n_nodes, mib=mib,
-             agg_gbps=round(mib * n_nodes / 1024 / dt, 2))
+             agg_gbps=round(mib * n_nodes / 1024 / dt, 2),
+             pull_sources=sources,
+             distinct_sources=len(sources))
     finally:
         cluster.shutdown()
 

@@ -67,6 +67,28 @@ def _load():
                                   ctypes.POINTER(ctypes.c_uint64),
                                   ctypes.POINTER(ctypes.c_uint64)]
         lib.rtp_stop.argtypes = [ctypes.c_void_p]
+    # Multi-location pulls + relay (chunk-pipelined OP_PULL2).
+    # Separately guarded: a pre-relay .so keeps the single-source
+    # manager path working.
+    if hasattr(lib, "rtp_submit_multi"):
+        lib.rtp_submit_multi.restype = ctypes.c_uint64
+        lib.rtp_submit_multi.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_uint64,
+                                         ctypes.c_char_p,
+                                         ctypes.c_char_p]
+        lib.rtp_wait_src.restype = ctypes.c_int
+        lib.rtp_wait_src.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                     ctypes.c_int, ctypes.c_char_p,
+                                     ctypes.c_int]
+        lib.rtp_ep_stats.restype = ctypes.c_int
+        lib.rtp_ep_stats.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
+        lib.rto_pull2.restype = ctypes.c_int
+        lib.rto_pull2.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_char_p, ctypes.c_char_p]
+        lib.rto_serve_stats.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_uint64),
+                                        ctypes.POINTER(ctypes.c_uint64)]
     # This library embeds its own store core — rts_connect et al for
     # attaching the LOCAL arena the transfer functions operate on.
     lib.rts_connect.restype = ctypes.c_void_p
@@ -114,6 +136,46 @@ def _record(event: str, **fields) -> None:
         pass
 
 
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TransferError("connection closed mid-stream")
+        buf += chunk
+    return bytes(buf)
+
+
+def fetch_object_bytes(host: str, port: int, object_id: bytes,
+                       timeout: float = 30.0) -> Optional[bytes]:
+    """Stream one object off a peer's transfer port into MEMORY — the
+    chunk-framed OP_PULL2 wire protocol spoken directly, with no local
+    arena residency. The driver uses this for objects larger than its
+    own arena: the value reaches the caller while the location
+    directory (and lineage) stay untouched. Returns None on a miss;
+    raises TransferError on a wire error or sender-side abort."""
+    import socket as _socket
+    import struct as _struct
+
+    _check_id(object_id)
+    with _socket.create_connection((host, port),
+                                   timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        sock.sendall(bytes([4]) + object_id)  # OP_PULL2
+        (total,) = _struct.unpack("<q", _recv_exact(sock, 8))
+        if total < 0:
+            return None
+        out = bytearray()
+        while len(out) < total:
+            (ln,) = _struct.unpack("<I", _recv_exact(sock, 4))
+            if ln == 0xFFFFFFFF:  # kErrFrame: source failed mid-relay
+                raise TransferError("sender aborted mid-stream")
+            out += _recv_exact(sock, ln)
+        _record("fetch_inline_done", object_id=object_id.hex()[:16],
+                bytes=total)
+        return bytes(out)
+
+
 class TransferServer:
     """Serve this node's arena to peers (one per node). bind_all=True
     listens on 0.0.0.0 for real multi-host topologies; the default
@@ -122,6 +184,7 @@ class TransferServer:
     def __init__(self, shm_name: str, port: int = 0,
                  bind_all: bool = False):
         lib = _load()
+        self._lock = HandleGuard()
         self._h = lib.rto_serve(shm_name.encode(), 0, port,
                                 1 if bind_all else 0)
         if not self._h:
@@ -129,10 +192,25 @@ class TransferServer:
                 f"failed to serve transfer plane for {shm_name}")
         self.port = lib.rto_port(self._h)
 
+    def stats(self) -> dict:
+        """Server-side counters: payload bytes served and how many
+        pulls were answered from a mid-pull relay entry."""
+        lib = _load()
+        with self._lock.read():
+            if not self._h or not hasattr(lib, "rto_serve_stats"):
+                return {}
+            bytes_out = ctypes.c_uint64()
+            relay = ctypes.c_uint64()
+            lib.rto_serve_stats(self._h, ctypes.byref(bytes_out),
+                                ctypes.byref(relay))
+        return {"bytes_out": bytes_out.value,
+                "relay_served": relay.value}
+
     def stop(self) -> None:
-        if self._h:
-            _load().rto_stop(self._h)
-            self._h = None
+        with self._lock.write():
+            if self._h:
+                _load().rto_stop(self._h)
+                self._h = None
 
 
 class TransferClient:
@@ -266,12 +344,8 @@ class PullManager:
     # via rtp_stop) could never proceed.
     _WAIT_SLICE_MS = 50
 
-    def wait(self, ticket: int, timeout_ms: int = -1) -> None:
-        """Block until the ticketed transfer completes; raises
-        TransferError (with the failure cause) on anything but
-        success. A timed-out wait CANCELS the ticket (the transfer
-        itself keeps running for any coalesced waiters) so abandoned
-        tickets cannot accumulate in a long-lived daemon."""
+    def _wait_loop(self, ticket: int, timeout_ms: int,
+                   srcbuf=None) -> int:
         deadline = (None if timeout_ms < 0
                     else time.monotonic() + timeout_ms / 1000.0)
         while True:
@@ -281,23 +355,108 @@ class PullManager:
                 remaining = int((deadline - time.monotonic()) * 1000)
                 chunk = max(0, min(self._WAIT_SLICE_MS, remaining))
             with self._lock.read():
-                rc = _load().rtp_wait(self._handle(), ticket, chunk)
+                if srcbuf is None:
+                    rc = _load().rtp_wait(self._handle(), ticket,
+                                          chunk)
+                else:
+                    rc = _load().rtp_wait_src(self._handle(), ticket,
+                                              chunk, srcbuf,
+                                              len(srcbuf))
                 if rc == -5 and (deadline is not None
                                  and time.monotonic() >= deadline):
                     _load().rtp_cancel(self._h, ticket)
                     break
             if rc != -5:
                 break  # completed (or failed) within this slice
+        return rc
+
+    def wait(self, ticket: int, timeout_ms: int = -1) -> None:
+        """Block until the ticketed transfer completes; raises
+        TransferError (with the failure cause) on anything but
+        success. A timed-out wait CANCELS the ticket (the transfer
+        itself keeps running for any coalesced waiters) so abandoned
+        tickets cannot accumulate in a long-lived daemon."""
+        rc = self._wait_loop(ticket, timeout_ms)
         if rc != 0:
             _record("managed_transfer_failed", ticket=int(ticket),
                     error=_MGR_ERRORS.get(rc, str(rc)))
             raise TransferError(
                 f"transfer failed: {_MGR_ERRORS.get(rc, rc)}")
 
+    def wait_src(self, ticket: int, timeout_ms: int = -1) -> str:
+        """wait(), returning the winning source endpoint
+        ("host:port", or "local" for an arena hit) on success."""
+        srcbuf = ctypes.create_string_buffer(128)
+        rc = self._wait_loop(ticket, timeout_ms, srcbuf)
+        if rc != 0:
+            _record("managed_transfer_failed", ticket=int(ticket),
+                    error=_MGR_ERRORS.get(rc, str(rc)))
+            raise TransferError(
+                f"transfer failed: {_MGR_ERRORS.get(rc, rc)}")
+        return srcbuf.value.decode("utf-8", "replace")
+
     def pull(self, requester: int, host: str, port: int,
              object_id: bytes, timeout_ms: int = -1) -> None:
         self.wait(self.submit_pull(requester, host, port, object_id),
                   timeout_ms)
+
+    @property
+    def supports_multi(self) -> bool:
+        return hasattr(_load(), "rtp_submit_multi")
+
+    def submit_pull_multi(self, requester: int, endpoints,
+                          object_id: bytes) -> int:
+        """Submit a pull with a fallback-ordered source list
+        (`endpoints` = [(host, port), ...]). The manager dispatches to
+        the least-loaded source and falls back through the rest on a
+        miss or wire failure."""
+        eps = ",".join(f"{h}:{p}" for h, p in endpoints)
+        with self._lock.read():
+            ticket = _load().rtp_submit_multi(
+                self._handle(), requester, eps.encode(),
+                _check_id(object_id))
+        if ticket == 0:
+            raise TransferError(f"bad endpoint list: {eps!r}")
+        return ticket
+
+    def pull_multi(self, requester: int, endpoints,
+                   object_id: bytes, timeout_ms: int = -1) -> str:
+        """Multi-source pull; returns the winning source endpoint."""
+        src = self.wait_src(
+            self.submit_pull_multi(requester, endpoints, object_id),
+            timeout_ms)
+        _record("pull_source", object_id=object_id.hex()[:16],
+                source=src,
+                candidates=[f"{h}:{p}" for h, p in endpoints])
+        return src
+
+    def ep_stats(self) -> dict:
+        """Per-source accounting: {"total_bytes_in": N, "sources":
+        {ep: {"inflight": .., "active": .., "bytes": ..}}}."""
+        lib = _load()
+        if not hasattr(lib, "rtp_ep_stats"):
+            return {}
+        cap = 4096
+        with self._lock.read():
+            h = self._handle()
+            while True:
+                buf = ctypes.create_string_buffer(cap)
+                need = lib.rtp_ep_stats(h, buf, cap)
+                if need < cap:
+                    break
+                cap = need + 1
+        out = {"total_bytes_in": 0, "sources": {}}
+        for line in buf.value.decode("utf-8", "replace").splitlines():
+            parts = line.split()
+            if len(parts) == 2 and parts[0] == "total":
+                out["total_bytes_in"] = int(parts[1])
+            elif len(parts) == 4:
+                out["sources"][parts[0]] = {
+                    "inflight": int(parts[1]),
+                    "active": int(parts[2]),
+                    "bytes": int(parts[3]),
+                }
+        return out
 
     def stats(self) -> dict:
         inflight = ctypes.c_uint64()

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
-from typing import Dict, Hashable, Tuple
+from typing import Dict, Hashable, List, Tuple
 
 
 class PullClientPool:
@@ -24,6 +24,10 @@ class PullClientPool:
         self._clients: Dict[Hashable, object] = {}
         self._locks: Dict[Hashable, threading.Lock] = {}
         self._lock = threading.Lock()
+        # Single-flight per key: two tasks needing the same object must
+        # not stream it twice (the loser of the native create races
+        # would drain a full duplicate copy off the wire).
+        self._inflight: Dict[Hashable, threading.Event] = {}
         self._mgr = None
         try:
             from .object_transfer import PullManager
@@ -41,18 +45,68 @@ class PullClientPool:
         arena. Raises on failure. `key` doubles as the fairness bucket:
         requests from different keys round-robin, so one peer's (or
         consumer's) flood cannot starve the rest."""
+        self.pull_multi(key, [endpoint], object_id)
+
+    def pull_multi(self, key: Hashable,
+                   endpoints: List[Tuple[str, int]],
+                   object_id: bytes) -> str:
+        """Pull from the first source that can serve the object,
+        preferring the least-loaded (native path); `endpoints` is the
+        fallback-ordered location list. Returns the winning source
+        ("host:port", or "local" when the object was already here)."""
+        if not endpoints:
+            raise ValueError("pull_multi: empty endpoint list")
+        while True:
+            with self._lock:
+                ev = self._inflight.get(key)
+                if ev is None:
+                    ev = self._inflight[key] = threading.Event()
+                    break
+            # Another thread is fetching this key; once it lands, our
+            # own attempt resolves instantly via the local-arena check.
+            ev.wait()
+        try:
+            return self._pull_multi_locked(key, endpoints, object_id)
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+            ev.set()
+
+    def _pull_multi_locked(self, key: Hashable,
+                           endpoints: List[Tuple[str, int]],
+                           object_id: bytes) -> str:
         if self._mgr is not None:
-            self._mgr.pull(hash(key) & 0x7FFFFFFFFFFFFFFF,
-                           endpoint[0], endpoint[1], object_id)
-            return
-        self._pull_fallback(key, endpoint, object_id)
+            requester = hash(key) & 0x7FFFFFFFFFFFFFFF
+            if self._mgr.supports_multi:
+                return self._mgr.pull_multi(requester, endpoints,
+                                            object_id)
+            last: Exception | None = None
+            for host, port in endpoints:
+                try:
+                    self._mgr.pull(requester, host, port, object_id)
+                    return f"{host}:{port}"
+                except Exception as e:  # noqa: BLE001 - try next source
+                    last = e
+            raise last if last is not None else RuntimeError(
+                "pull_multi: no endpoints")
+        last = None
+        for endpoint in endpoints:
+            try:
+                transferred = self._pull_fallback(key, endpoint,
+                                                  object_id)
+                return (f"{endpoint[0]}:{endpoint[1]}"
+                        if transferred else "local")
+            except Exception as e:  # noqa: BLE001 - try next source
+                last = e
+        raise last if last is not None else RuntimeError(
+            "pull_multi: no endpoints")
 
     def _pull_fallback(self, key: Hashable, endpoint: Tuple[str, int],
-                       object_id: bytes) -> None:
+                       object_id: bytes) -> bool:
         """Per-peer serial client (pre-manager behavior). Connecting
         happens under the PER-KEY lock only — one unreachable peer
         (kernel connect timeout) must not serialize pulls to healthy
-        peers."""
+        peers. Returns True when bytes moved (False = local hit)."""
         from .object_transfer import TransferClient
 
         with self._lock:
@@ -68,14 +122,17 @@ class PullClientPool:
                                             self._shm_name)
                     with self._lock:
                         self._clients[key] = client
-                client.pull(object_id)
+                return client.pull(object_id)
         except Exception:
             self.drop(key)
             raise
 
     def stats(self) -> dict:
         if self._mgr is not None:
-            return self._mgr.stats()
+            out = self._mgr.stats()
+            with contextlib.suppress(Exception):
+                out.update(self._mgr.ep_stats())
+            return out
         return {}
 
     def drop(self, key: Hashable) -> None:

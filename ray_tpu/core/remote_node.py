@@ -24,7 +24,9 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .._private.config import config
-from .exceptions import ActorDiedError, ObjectLostError, TaskCancelledError
+from ..observability import get_recorder
+from .exceptions import (ActorDiedError, ObjectLostError,
+                         ObjectStoreFullError, TaskCancelledError)
 from .ids import ObjectID
 from .object_ref import ObjectRef
 from .resources import ResourceSet
@@ -102,6 +104,15 @@ class RemotePlane:
 
         # node_id -> (host, object_port): survives until node death.
         self._endpoints: Dict[str, Tuple[str, int]] = {}
+        # Multi-location directory bookkeeping: reverse index
+        # node_id -> {ObjectID} of markers listing that node as a
+        # location (so node death scrubs them in O(node's objects)),
+        # and per-source pull counts aggregated from the daemons'
+        # pull_complete reports (bench/dashboard proof the broadcast
+        # is a relay tree, not a star).
+        self._located: Dict[str, set] = {}
+        self._located_lock = threading.Lock()
+        self._pull_source_counts: Dict[str, int] = {}
         from .._native.pull_pool import PullClientPool
 
         self._pulls = (PullClientPool(rt._shm_name)
@@ -179,6 +190,10 @@ class RemotePlane:
                 total = ResourceSet(meta.get("resources", {"CPU": 1.0}))
                 node = RemoteNodeState(nid, total, meta)
                 node.labels.update(meta.get("labels") or {})
+                # Daemons report completed pulls out-of-band on the
+                # dispatch socket; those reports feed the object
+                # directory's location sets.
+                node.client.on_pull_complete = self._on_pull_complete
                 self._known.add(nid)
                 self._endpoints[nid] = (node.host, node.object_port)
                 self.rt.scheduler.add_node(node)
@@ -226,6 +241,7 @@ class RemotePlane:
         self._endpoints.pop(node_id, None)
         if self._pulls is not None:
             self._pulls.drop(node_id)
+        self._deregister_node_locations(node_id)
         node = self.rt.scheduler.remove_node(node_id)
         logger.warning("remote node %s died", node_id)
         # Placement groups with bundles on the dead node re-place them
@@ -268,10 +284,56 @@ class RemotePlane:
             pass
 
     # -- arg packing ------------------------------------------------------
-    def pack_arg(self, v, fetch: List[Tuple[bytes, str, int]],
+    def _fetch_candidates(self, d, target) -> List[Tuple[str, int]]:
+        """Fallback-ordered source endpoints for marker `d` as pulled
+        BY `target`: [relay-tree parent, confirmed locations...,
+        primary owner]. The parent comes first so a broadcast forms
+        pipelined chains (the parent serves committed chunks while its
+        own tail is still arriving); the primary comes last as the
+        always-correct anchor. The daemon tries them least-loaded-first
+        with per-source fallback, so a stale or dead entry costs one
+        failed attempt, never the pull."""
+        cands: List[Tuple[str, int]] = []
+        seen: set = set()
+
+        def add(ep) -> None:
+            if ep is not None and ep not in seen:
+                seen.add(ep)
+                cands.append(ep)
+
+        pend = getattr(d, "pending", None)
+        if pend is not None and target is not None:
+            tid = target.node_id
+            try:
+                i = pend.index(tid)
+            except ValueError:
+                i = len(pend)
+                pend.append(tid)
+                with self._located_lock:
+                    self._located.setdefault(tid, set()).add(
+                        ObjectID(d.key))
+            if i > 0:
+                # Binary tree over dispatch order: consumer i's parent
+                # is consumer (i-1)//2 → producer fan-out is 2, total
+                # producer bytes ~O(log N) of the star cost.
+                add(self._endpoints.get(pend[(i - 1) // 2]))
+        for nid in list(getattr(d, "locations", ()) or ()):
+            add(self._endpoints.get(nid))
+        loc = getattr(d, "node_id", None)
+        if loc is None:
+            if self.rt.shm is not None and self.rt.shm.contains(d.key):
+                add((self.advertise_host, self.object_port))
+        else:
+            add(self._endpoints.get(loc))
+        return cands
+
+    def pack_arg(self, v, fetch: List[Tuple[bytes, list]],
                  target: RemoteNodeState):
         """ObjectRef → wire marker + fetch hint. Mirrors
-        Runtime._pack_arg but payloads may live on ANY node's arena."""
+        Runtime._pack_arg but payloads may live on ANY node's arena.
+        Fetch entries are (key, [(host, port), ...]) — a fallback-
+        ordered multi-source location list — and are deduped per
+        message by key (two args sharing one object need one pull)."""
         from ..core.runtime import _ShmMarker
         from .worker_proc import SerArg, ShmArg
 
@@ -287,23 +349,19 @@ class RemotePlane:
             d = stored.data
             if not isinstance(d, _ShmMarker):
                 return SerArg(d.to_bytes(), stored.is_error)
-            loc = getattr(d, "node_id", None)
-            if loc is None:
-                # Owner-local (driver arena): daemon pulls from us.
-                if rt.shm is not None and rt.shm.contains(d.key):
-                    fetch.append((d.key, self.advertise_host,
-                                  self.object_port))
+            for key, _eps in fetch:
+                if key == d.key:
                     return ShmArg(d.key, stored.is_error)
-            else:
-                # Remote arena — including the target's own: the fetch
-                # entry makes the daemon CHECK presence (contains()
-                # short-circuits a self-pull), so a payload evicted on
-                # the target surfaces as fetch_failed → reconstruction
-                # instead of a user-visible KeyError in the worker.
-                ep = self._endpoints.get(loc)
-                if ep is not None:
-                    fetch.append((d.key, ep[0], ep[1]))
-                    return ShmArg(d.key, stored.is_error)
+            # The candidate list may include the target's own endpoint:
+            # the fetch entry makes the daemon CHECK presence
+            # (contains() short-circuits a self-pull), so a payload
+            # evicted on the target surfaces as fetch_failed →
+            # reconstruction instead of a user-visible KeyError in the
+            # worker.
+            cands = self._fetch_candidates(d, target)
+            if cands:
+                fetch.append((d.key, cands))
+                return ShmArg(d.key, stored.is_error)
             # Payload gone (evicted locally / node dead) — reconstruct.
             rt.store.delete([v.id()])
             rt._require_recoverable(v.id())
@@ -378,7 +436,7 @@ class RemotePlane:
         import cloudpickle
 
         streaming = spec.num_returns in ("streaming", "dynamic")
-        fetch: List[Tuple[bytes, str, int]] = []
+        fetch: List[Tuple[bytes, list]] = []
         msg = {
             "type": "task", "task_id": spec.task_id,
             "fid": spec.descriptor.function_id,
@@ -565,23 +623,136 @@ class RemotePlane:
             rt.events.record(spec.display_name(), t0, time.monotonic(),
                              node.node_id, spec.task_id.hex())
 
+    # -- object directory (multi-location) -------------------------------
+    def _on_pull_complete(self, node_id: str, reply: Dict[str, Any]
+                          ) -> None:
+        """A daemon finished pulling objects for a task: register it
+        as an additional source for each (reference:
+        ownership_based_object_directory.h — the owner's location set
+        grows as copies spread). Runs on connection-reader threads;
+        must never raise (the caller suppresses, but a failure here
+        only loses a hint)."""
+        nid = reply.get("node_id") or node_id
+        for item in reply.get("pulls") or ():
+            try:
+                key, src = item[0], item[1]
+                self._register_location(nid, bytes(key), str(src))
+            except Exception:  # noqa: BLE001 — malformed entry
+                continue
+
+    def _register_location(self, node_id: str, key: bytes,
+                           src: str) -> None:
+        from ..core.runtime import _ShmMarker
+
+        oid = ObjectID(key)
+        stored = self.rt.store.get_if_exists(oid)
+        if stored is None or not isinstance(stored.data, _ShmMarker):
+            return
+        stored.data.add_location(node_id)
+        with self._located_lock:
+            self._located.setdefault(node_id, set()).add(oid)
+            self._pull_source_counts[src] = \
+                self._pull_source_counts.get(src, 0) + 1
+        get_recorder().record(
+            "object_transfer", "location_added",
+            object_id=oid.hex()[:16], node=node_id, source=src)
+
+    def _deregister_node_locations(self, node_id: str) -> None:
+        """Node death: its arena is gone — scrub it from every marker
+        that listed it (as confirmed location or relay-tree pending)
+        so no future fetch hint points at a dead endpoint."""
+        from ..core.runtime import _ShmMarker
+
+        with self._located_lock:
+            oids = self._located.pop(node_id, set())
+        for oid in oids:
+            stored = self.rt.store.get_if_exists(oid)
+            if stored is not None and isinstance(stored.data,
+                                                _ShmMarker):
+                stored.data.discard_location(node_id)
+        if oids:
+            get_recorder().record(
+                "object_transfer", "locations_scrubbed",
+                node=node_id, count=len(oids))
+
+    def pull_source_counts(self) -> Dict[str, int]:
+        """source endpoint -> completed-pull count, aggregated from
+        daemon pull_complete reports (proves broadcast shape)."""
+        with self._located_lock:
+            return dict(self._pull_source_counts)
+
     # -- cross-node object pulls (driver get) ----------------------------
     def ensure_local(self, marker) -> None:
-        """Pull a remote-located object into the driver's arena.
-        Raises KeyError when it cannot be fetched (→ reconstruction)."""
+        """Pull a remote-located object into the driver's arena from
+        ANY live location (confirmed secondaries first-class, primary
+        as anchor), with per-source fallback. Raises KeyError when it
+        cannot be fetched (→ reconstruction)."""
         rt = self.rt
         if rt.shm is None or self._pulls is None:
             raise KeyError(marker.key)
         if rt.shm.contains(marker.key):
             return
-        ep = self._endpoints.get(marker.node_id)
-        if ep is None:
+        eps: List[Tuple[str, int]] = []
+        seen: set = set()
+        for nid in list(getattr(marker, "locations", ()) or ()):
+            ep = self._endpoints.get(nid)
+            if ep is not None and ep not in seen:
+                seen.add(ep)
+                eps.append(ep)
+        if marker.node_id is not None:
+            ep = self._endpoints.get(marker.node_id)
+            if ep is not None and ep not in seen:
+                eps.append(ep)
+        if not eps:
             raise KeyError(marker.key)
         try:
-            self._pulls.pull(marker.node_id, ep, marker.key)
-        except Exception:  # noqa: BLE001 — node died mid-pull
+            # The object key is the dedup/fairness bucket: concurrent
+            # gets of the same object coalesce into one wire transfer.
+            self._pulls.pull_multi(marker.key, eps, marker.key)
+        except Exception as e:  # noqa: BLE001 — all sources died mid-pull
             if not rt.shm.contains(marker.key):
+                if "store full" in str(e):
+                    # Sources are alive; OUR arena can't admit the
+                    # object. Not an eviction — callers may stream the
+                    # bytes inline (fetch_inline) instead of burning
+                    # the location set on a reconstruction.
+                    raise ObjectStoreFullError(
+                        f"local arena cannot admit "
+                        f"{marker.key.hex()[:16]}") from e
                 raise KeyError(marker.key) from None
+
+    def fetch_inline(self, marker) -> Optional[bytes]:
+        """Stream an object's bytes straight off a holder's transfer
+        port into driver memory — no local arena residency. Fallback
+        for objects larger than the driver's arena: the marker (and
+        its location directory) stay intact. Returns None when no
+        source can serve it."""
+        from .._native.object_transfer import (TransferError,
+                                               fetch_object_bytes)
+
+        eps: List[Tuple[str, int]] = []
+        seen: set = set()
+        for nid in list(getattr(marker, "locations", ()) or ()):
+            ep = self._endpoints.get(nid)
+            if ep is not None and ep not in seen:
+                seen.add(ep)
+                eps.append(ep)
+        if marker.node_id is not None:
+            ep = self._endpoints.get(marker.node_id)
+            if ep is not None and ep not in seen:
+                eps.append(ep)
+        for host, port in eps:
+            try:
+                blob = fetch_object_bytes(host, port, marker.key)
+            except (TransferError, OSError):
+                continue  # source died mid-stream: next candidate
+            if blob is not None:
+                get_recorder().record(
+                    "object_transfer", "fetch_inline",
+                    object_id=marker.key.hex()[:16],
+                    source=f"{host}:{port}", bytes=len(blob))
+                return blob
+        return None
 
     # -- cross-driver actors ----------------------------------------------
     def attach_named_actor(self, scoped: str):
@@ -740,7 +911,7 @@ def remote_actor_state_cls():
                     self.node = node
                 conn = None
                 try:
-                    fetch: List[Tuple[bytes, str, int]] = []
+                    fetch: List[Tuple[bytes, list]] = []
                     msg = {
                         "type": "actor_create", "task_id": None,
                         "actor_id": self.actor_id.binary(),
@@ -825,7 +996,7 @@ def remote_actor_state_cls():
             streaming = spec.num_returns in ("streaming", "dynamic")
             gst = rt._generators.get(spec.task_id) if streaming else None
             try:
-                fetch: List[Tuple[bytes, str, int]] = []
+                fetch: List[Tuple[bytes, list]] = []
                 msg = {
                     "type": "actor_call", "task_id": spec.task_id,
                     "actor_id": self.actor_id.binary(),

@@ -30,6 +30,7 @@ from . import serialization
 from .exceptions import (
     ActorDiedError,
     ObjectLostError,
+    ObjectStoreFullError,
     TaskCancelledError,
     TaskError,
 )
@@ -71,14 +72,39 @@ class _ShmMarker:
     node_id records which node's arena holds the payload (None = this
     process's own arena) — the ownership-based object directory of the
     multi-host plane (reference: ownership_based_object_directory.h:
-    the owner knows each object's locations)."""
+    the owner knows each object's locations). `locations` extends the
+    directory to MULTI-location: every node that confirmed a completed
+    pull (via the daemon's pull_complete report) is an additional
+    source, so later consumers spread their pulls instead of starring
+    the primary. `pending` is the dispatch-ordered list of nodes a
+    fetch hint was handed to — the relay tree is built over it (a new
+    consumer's preferred source is pending[(i-1)//2], its binary-tree
+    parent), so an N-node broadcast forms pipelined chains instead of
+    N direct pulls from the producer.
 
-    __slots__ = ("key", "contained_refs", "node_id")
+    Mutated from dispatcher + connection-reader threads; the per-field
+    operations below are single bytecode-level set/list mutations
+    (atomic under the GIL), and every reader treats the contents as
+    fallback-ordered hints — a stale entry costs one extra candidate
+    attempt, never correctness."""
+
+    __slots__ = ("key", "contained_refs", "node_id", "locations",
+                 "pending")
 
     def __init__(self, key: bytes, node_id: Optional[str] = None):
         self.key = key
         self.node_id = node_id
         self.contained_refs = ()
+        self.locations: set = set()
+        self.pending: list = []
+
+    def add_location(self, node_id: str) -> None:
+        self.locations.add(node_id)
+
+    def discard_location(self, node_id: str) -> None:
+        self.locations.discard(node_id)
+        with contextlib.suppress(ValueError):
+            self.pending.remove(node_id)
 
     def total_bytes(self) -> int:
         return len(self.key)  # marker itself is tiny; payload is in shm
@@ -344,10 +370,22 @@ class Runtime:
             return d
         # Remote-located payload (multi-host plane): pull it into the
         # local arena first (reference: raylet PullManager restoring a
-        # needed object from its remote location).
-        if (d.node_id is not None and self.remote_plane is not None
+        # needed object from its remote location). Any marker with a
+        # primary OR confirmed secondary locations is fetchable.
+        if ((d.node_id is not None or getattr(d, "locations", None))
+                and self.remote_plane is not None
                 and (self.shm is None or not self.shm.contains(d.key))):
-            self.remote_plane.ensure_local(d)
+            try:
+                self.remote_plane.ensure_local(d)
+            except ObjectStoreFullError:
+                # The object is alive on remote nodes but won't fit in
+                # OUR arena. Stream it straight into memory instead:
+                # the marker and its location directory stay intact, so
+                # no destructive delete + lineage re-execution.
+                blob = self.remote_plane.fetch_inline(d)
+                if blob is None:
+                    raise KeyError(d.key) from None
+                return serialization.SerializedObject.from_bytes(blob)
         # Pin while copying out: an unpinned region can be evicted and
         # its bytes reused by a concurrent put mid-read.
         view = self.shm.get(d.key, pin=True) if self.shm is not None else None
@@ -840,7 +878,9 @@ class Runtime:
             if isinstance(d, _ShmMarker):
                 if self.shm is not None and self.shm.contains(d.key):
                     return ShmArg(d.key, stored.is_error)
-                if d.node_id is not None and self.remote_plane is not None:
+                if ((d.node_id is not None
+                        or getattr(d, "locations", None))
+                        and self.remote_plane is not None):
                     # Remote-located (multi-host plane): pull it into
                     # the local arena for the local worker.
                     try:

@@ -69,7 +69,14 @@ class SerializedObject:
         return b"".join(self.frames())
 
     @classmethod
-    def from_bytes(cls, data: memoryview | bytes) -> "SerializedObject":
+    def from_bytes(cls, data: memoryview | bytes, *,
+                   copy: bool = True) -> "SerializedObject":
+        """Parse the flat frame. copy=False keeps the raw buffers as
+        read-only views of `data` — zero-copy, so a GiB-scale object
+        deserializes without faulting in a second copy — but the result
+        (and values deserialized from it) is only valid while the
+        backing memory is; callers own that lifetime (the worker pins
+        the shm span for the duration of the task)."""
         mv = memoryview(data)
         nbuf = int.from_bytes(mv[:4], "little")
         off = 4
@@ -81,7 +88,10 @@ class SerializedObject:
         for _ in range(nbuf):
             blen = int.from_bytes(mv[off:off + 8], "little")
             off += 8
-            bufs.append(bytes(mv[off:off + blen]))
+            if copy:
+                bufs.append(bytes(mv[off:off + blen]))
+            else:
+                bufs.append(mv[off:off + blen].toreadonly())
             off += blen
         return cls(payload, bufs, [])
 

@@ -48,7 +48,16 @@ def _setup(args):
     return sock, shm
 
 
-def _unpack_args(packed_args, packed_kwargs, shm):
+def _unpack_args(packed_args, packed_kwargs, shm, pinned=None):
+    """Resolve wire args. With `pinned` (a list), shm-resident args
+    deserialize ZERO-COPY — numpy values are read-only views straight
+    into the arena, no GiB-scale copy on the consume path — and their
+    keys are appended for the caller to shm.release() once the task
+    AND its result packing are done (the pin keeps eviction off the
+    span while user code can still see it). Without `pinned`, buffers
+    are copied out and the pin drops immediately — actor messages use
+    this, since an actor may legitimately stash an arg in its state
+    long past the call."""
     from ray_tpu.core import serialization
     from ray_tpu.core.worker_proc import SerArg, ShmArg
 
@@ -60,11 +69,18 @@ def _unpack_args(packed_args, packed_kwargs, shm):
                 view = shm.get(v.key, pin=True)
                 if view is None:
                     raise KeyError(v.key.hex())
-                try:
-                    data = serialization.SerializedObject.from_bytes(view)
+                if pinned is not None:
+                    pinned.append(v.key)
+                    data = serialization.SerializedObject.from_bytes(
+                        view, copy=False)
                     value = serialization.deserialize(data)
-                finally:
-                    shm.release(v.key)
+                else:
+                    try:
+                        data = serialization.SerializedObject.from_bytes(
+                            view)
+                        value = serialization.deserialize(data)
+                    finally:
+                        shm.release(v.key)
             else:
                 value = serialization.deserialize(
                     serialization.SerializedObject.from_bytes(v.data))
@@ -90,8 +106,20 @@ def _pack_value(value, shm, inline_max: int, key: bytes):
         try:
             shm.put(key, blob)
             return ("shm", key)
-        except Exception:  # noqa: BLE001 — store full/dup: ship inline
-            pass
+        except Exception as e:  # noqa: BLE001 — store full/dup: ship inline
+            # Re-executed task (lineage reconstruction): the arena may
+            # already hold this key from the first run — the put fails
+            # duplicate, but the shm reference is still valid.
+            try:
+                if shm.contains(key):
+                    return ("shm", key)
+            except Exception:  # noqa: BLE001 — fall through to inline
+                pass
+            # Inlining a large payload silently turns the transfer
+            # plane into a dispatch-socket push — loud breadcrumb.
+            print(f"worker: shm put of {len(blob)} B result failed "
+                  f"({type(e).__name__}: {e}); shipping inline",
+                  file=sys.stderr, flush=True)
     return ("ser", blob)
 
 
@@ -196,6 +224,16 @@ def main() -> None:
             continue
 
         task_id = msg.get("task_id")
+        # Arena spans pinned for this message's zero-copy args —
+        # released only after the result (which may serialize views of
+        # those spans) is on the wire.
+        pinned: list = []
+
+        def _release_pins(pinned=pinned, shm=shm):
+            while pinned:
+                with contextlib.suppress(Exception):
+                    shm.release(pinned.pop())
+
         # Re-enter the driver's trace: the outer span covers unpack +
         # user code in THIS process, parented to the driver's execute
         # span; an inner span isolates the user call itself.
@@ -216,7 +254,7 @@ def main() -> None:
             if mtype == "task":
                 fn = get_fn(msg)
                 call_args, call_kwargs = _unpack_args(
-                    msg["args"], msg["kwargs"], shm)
+                    msg["args"], msg["kwargs"], shm, pinned)
                 with _runtime_env(msg.get("runtime_env")), \
                         _run_span(getattr(fn, "__qualname__", "task")):
                     result = fn(*call_args, **call_kwargs)
@@ -263,6 +301,7 @@ def main() -> None:
             send_msg(sock, {"type": "result", "task_id": task_id,
                             "error": _pack_error(e),
                             "spans": _drain_spans()})
+            _release_pins()
             continue
         trace_cm.close()
 
@@ -301,6 +340,8 @@ def main() -> None:
                 send_msg(sock, {"type": "result", "task_id": task_id,
                                 "error": _pack_error(e), "gen_count": i,
                                 "spans": _drain_spans()})
+            finally:
+                _release_pins()
             continue
 
         n = msg.get("num_returns", 1)
@@ -319,12 +360,14 @@ def main() -> None:
                         f"declared num_returns={n} but returned "
                         f"{len(values)} values")),
                     "spans": _drain_spans()})
+                _release_pins()
                 continue
             returns = [_pack_value(v, shm, args.inline_max, return_ids[i])
                        for i, v in enumerate(values)]
         send_msg(sock, {"type": "result", "task_id": task_id,
                         "error": None, "returns": returns,
                         "spans": _drain_spans()})
+        _release_pins()
 
 
 if __name__ == "__main__":

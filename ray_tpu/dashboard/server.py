@@ -164,6 +164,7 @@ class MetricsHistory:
     _prom_gauges = None
     _published_nodes: set = frozenset()
     _spilled_seen: dict
+    _transfer_seen: dict
 
     def _publish_prom(self, point, rt) -> None:
         """Re-export the sampled series (head + every daemon's heartbeat
@@ -201,7 +202,21 @@ class MetricsHistory:
                 "pending_tasks": mm.Gauge(
                     "ray_tpu_scheduler_pending_tasks",
                     "Tasks queued in this driver's scheduler", tag),
+                "transfer_bytes": mm.Counter(
+                    "ray_tpu_transfer_bytes_total",
+                    "Object-transfer bytes moved, by pulling node, "
+                    "source endpoint and direction",
+                    ("node_id", "source", "direction")),
+                "transfer_inflight": mm.Gauge(
+                    "ray_tpu_transfer_inflight_bytes",
+                    "Bytes currently streaming from each source "
+                    "endpoint", ("node_id", "source")),
+                "relay_served": mm.Counter(
+                    "ray_tpu_transfer_relay_served_total",
+                    "Pulls served from a mid-pull relay (chunk-"
+                    "pipelined broadcast hits)", tag),
             }
+            self._transfer_seen = {}
         g = self._prom_gauges
         head_id = getattr(rt, "head_node_id", None) or "head" \
             if rt is not None else "head"
@@ -222,6 +237,13 @@ class MetricsHistory:
         _estats.publish_prometheus(node_id=head_id)
         if rt is None:
             return
+        plane = getattr(rt, "remote_plane", None)
+        if plane is not None and getattr(plane, "_pulls", None) is not None:
+            with contextlib.suppress(Exception):
+                t = dict(plane._pulls.stats())
+                if plane.transfer_server is not None:
+                    t.update(plane.transfer_server.stats())
+                self._publish_transfer(head_id, t)
         live = {head_id}
         for node in rt.scheduler.nodes():
             load = getattr(node, "last_load", None)
@@ -248,6 +270,8 @@ class MetricsHistory:
                 self._spilled_seen[node.node_id] = float(cum)
                 if delta > 0:
                     g["spilled"].inc(delta, {"node_id": node.node_id})
+            if load.get("transfer"):
+                self._publish_transfer(node.node_id, load["transfer"])
         # Dead/removed nodes must stop being exported, or their last
         # cpu/mem/queued values freeze in the scrape forever.
         for node_id in self._published_nodes - live:
@@ -261,6 +285,44 @@ class MetricsHistory:
             # reports the same cumulative count, and forgetting the
             # prior value would re-add its whole history to the counter.
         self._published_nodes = live
+
+    def _transfer_counter(self, key, cum, labels) -> None:
+        """Heartbeats carry cumulative byte counts; the exported
+        counter advances by the delta (daemon restart resets the
+        cumulative — a decrease re-bases, same policy as `spilled`)."""
+        prev = self._transfer_seen.get(key, 0.0)
+        delta = float(cum) - prev if float(cum) >= prev else float(cum)
+        self._transfer_seen[key] = float(cum)
+        if delta > 0:
+            self._prom_gauges["transfer_bytes"].inc(delta, labels)
+
+    def _publish_transfer(self, node_id: str, t: dict) -> None:
+        try:
+            for src, s in (t.get("sources") or {}).items():
+                self._transfer_counter(
+                    (node_id, src, "in"), s.get("bytes", 0),
+                    {"node_id": node_id, "source": src,
+                     "direction": "in"})
+                self._prom_gauges["transfer_inflight"].set(
+                    float(s.get("inflight", 0)),
+                    {"node_id": node_id, "source": src})
+            if t.get("bytes_out") is not None:
+                self._transfer_counter(
+                    (node_id, "serve", "out"), t["bytes_out"],
+                    {"node_id": node_id, "source": "serve",
+                     "direction": "out"})
+            cum = t.get("relay_served")
+            if cum is not None:
+                key = (node_id, "relay_served")
+                prev = self._transfer_seen.get(key, 0.0)
+                delta = float(cum) - prev if float(cum) >= prev \
+                    else float(cum)
+                self._transfer_seen[key] = float(cum)
+                if delta > 0:
+                    self._prom_gauges["relay_served"].inc(
+                        delta, {"node_id": node_id})
+        except Exception:  # noqa: BLE001 — malformed heartbeat stats
+            pass
 
     def dump(self, limit: int = 0):
         with self._lock:
@@ -686,11 +748,28 @@ class DashboardServer:
             rt = global_runtime_or_none()
             if rt is not None:
                 nodes = {}
+                transfer = {}
                 for node in rt.scheduler.nodes():
                     load = getattr(node, "last_load", None)
                     if load and load.get("event_stats"):
                         nodes[node.node_id] = load["event_stats"]
+                    # Transfer-plane (rtp_*) stats ride the same
+                    # heartbeat: per-source inflight/bytes, serve-side
+                    # bytes_out and relay hit counts.
+                    if load and load.get("transfer"):
+                        transfer[node.node_id] = load["transfer"]
                 out["nodes"] = nodes
+                out["transfer"] = transfer
+                plane = getattr(rt, "remote_plane", None)
+                if plane is not None:
+                    with contextlib.suppress(Exception):
+                        head_t = dict(plane._pulls.stats()
+                                      if plane._pulls is not None else {})
+                        if plane.transfer_server is not None:
+                            head_t.update(plane.transfer_server.stats())
+                        head_t["pull_source_counts"] = \
+                            plane.pull_source_counts()
+                        out["transfer"][rt.head_node_id] = head_t
             return _json(out)
 
         async def cluster_node_stats(_):

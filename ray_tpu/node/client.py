@@ -24,11 +24,16 @@ class NodeDispatchError(RuntimeError):
 class NodeConn:
     """One TCP connection; one request in flight at a time."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 on_pull_complete: Optional[Callable] = None):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.settimeout(None)
         self.alive = True
+        # Out-of-band frame: the daemon reports which objects it pulled
+        # (and from where) before the task reply — the driver's object
+        # directory registers the node as an additional source.
+        self.on_pull_complete = on_pull_complete
         # Consumer threads send gen_ack credits while request()'s
         # thread is reading the stream — sends must not interleave.
         self._send_lock = threading.Lock()
@@ -61,6 +66,14 @@ class NodeConn:
                             self.close()
                             raise
                     continue
+                if reply.get("type") == "pull_complete":
+                    # Location report, not the reply — consume it and
+                    # keep waiting. Directory updates must never fail
+                    # the request they rode in on.
+                    if self.on_pull_complete is not None:
+                        with contextlib.suppress(Exception):
+                            self.on_pull_complete(reply)
+                    continue
                 return reply
         except (WorkerCrashedError, OSError, EOFError) as e:
             self.alive = False
@@ -82,6 +95,15 @@ class NodeClient:
         self._idle: List[NodeConn] = []
         self._lock = threading.Lock()
         self._closed = False
+        # Set by the owning plane after construction; threaded into
+        # every connection (conns are created lazily, so late binding
+        # covers them all).
+        self.on_pull_complete: Optional[Callable] = None
+
+    def _pull_complete(self, reply: Dict[str, Any]) -> None:
+        cb = self.on_pull_complete
+        if cb is not None:
+            cb(self.node_id, reply)
 
     def _get_conn(self) -> NodeConn:
         with self._lock:
@@ -90,7 +112,8 @@ class NodeClient:
             if self._idle:
                 return self._idle.pop()
         try:
-            return NodeConn(self.host, self.dispatch_port)
+            return NodeConn(self.host, self.dispatch_port,
+                            on_pull_complete=self._pull_complete)
         except OSError as e:
             raise NodeDispatchError(
                 f"cannot reach node {self.node_id}: {e}") from e
@@ -121,7 +144,8 @@ class NodeClient:
 
     def open_conn(self) -> NodeConn:
         """Dedicated connection (actor lifetime); caller owns closing."""
-        return NodeConn(self.host, self.dispatch_port)
+        return NodeConn(self.host, self.dispatch_port,
+                        on_pull_complete=self._pull_complete)
 
     def ping(self) -> Dict[str, Any]:
         reply = self.call({"type": "ping"})

@@ -276,6 +276,16 @@ class NodeDaemon:
         # heartbeat so the head's /api/event_stats and the
         # ray_tpu_loop_handler_* series cover every node.
         estats = _estats.snapshot()
+        # Transfer-plane accounting rides the heartbeat: per-source
+        # pull bytes/inflight from the pull manager plus the node's
+        # serve-side counters (bytes out, relay hits) — the dashboard
+        # publishes these as ray_tpu_transfer_* series.
+        transfer: dict = {}
+        try:
+            transfer = dict(self._pulls.stats())
+            transfer.update(self.transfer.stats())
+        except Exception:  # noqa: BLE001 — stats must not kill heartbeats
+            pass
         with self._avail_lock:
             return {
                 "available": self.available.to_dict(),
@@ -285,6 +295,7 @@ class NodeDaemon:
                 "spilled": self._spilled,
                 "host": host,
                 "event_stats": estats,
+                "transfer": transfer,
             }
 
     def _recommend_spill_target(self, res, exclude) -> Optional[str]:
@@ -381,18 +392,42 @@ class NodeDaemon:
             self._running -= 1
 
     # -- object fetching -------------------------------------------------
-    def _ensure_local(self, fetch) -> Optional[bytes]:
-        """Pull each (key, host, port) into the local arena. Returns the
-        first key that could not be fetched (for the error reply)."""
-        for key, host, port in fetch or ():
+    def _ensure_local(self, fetch):
+        """Pull every fetch entry into the local arena. Entries are
+        either the legacy (key, host, port) triple or the
+        multi-location (key, [(host, port), ...]) shape — a
+        fallback-ordered list of registered sources. Entries are
+        DEDUPED BY KEY (a task taking the same ref twice pulls once),
+        and the key is the pull-plane dedup/fairness bucket so two
+        tasks wanting one object share a single transfer regardless of
+        which sources each was told about.
+
+        Returns (missing, pulled): the first key that could not be
+        fetched (None when all landed) and [(key, source_ep), ...] for
+        the keys that actually moved — the driver's directory registers
+        this node as an additional source from them (pull_complete)."""
+        seen = set()
+        pulled = []
+        for entry in fetch or ():
+            if len(entry) == 3 and not isinstance(entry[1], (list,
+                                                             tuple)):
+                key, endpoints = entry[0], [(entry[1], entry[2])]
+            else:
+                key, endpoints = entry[0], [tuple(ep)
+                                            for ep in entry[1]]
+            if key in seen:
+                continue
+            seen.add(key)
             if self.shm.contains(key):
                 continue
             try:
-                self._pulls.pull((host, port), (host, port), key)
-            except Exception:  # noqa: BLE001 — source gone/evicted
+                src = self._pulls.pull_multi(key, endpoints, key)
+                if src and src != "local":
+                    pulled.append((key, src))
+            except Exception:  # noqa: BLE001 — all sources gone/evicted
                 if not self.shm.contains(key):
-                    return key
-        return None
+                    return key, pulled
+        return None, pulled
 
     # -- dispatch server -------------------------------------------------
     def _accept_loop(self):
@@ -923,13 +958,25 @@ class NodeDaemon:
             with self._avail_lock:
                 self.available = self.available.add(res)
 
-        missing = self._ensure_local(fetch)
+        missing, pulled = self._ensure_local(fetch)
         if missing is not None:
             if precharged:
                 unreserve()
             send_msg(conn, {"type": "result", "task_id": msg.get("task_id"),
                             "fetch_failed": missing})
             return
+        if pulled:
+            # Multi-location directory feedback (reference:
+            # OwnershipBasedObjectDirectory location updates): report
+            # completed pulls on the dispatch socket so the driver
+            # registers this node as an additional source for those
+            # objects — later consumers spread across holders instead
+            # of starring the producer. Streamed like gen_item frames;
+            # the client loop consumes it before the terminal reply.
+            with contextlib.suppress(Exception):
+                send_msg(conn, {"type": "pull_complete",
+                                "node_id": self.node_id,
+                                "pulls": [(k, s) for k, s in pulled]})
 
         if msg.get("runtime_env"):
             from ray_tpu.core.runtime_env_packaging import (

@@ -27,6 +27,7 @@
 #include <condition_variable>
 #include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -43,6 +44,27 @@ constexpr uint8_t OP_PUSH = 2;
 constexpr uint8_t OP_STAT = 3;  // size query (no payload) — the pull
                                 // manager's admission control needs the
                                 // size BEFORE committing budget
+constexpr uint8_t OP_PULL2 = 4;  // chunk-framed pull; the sender may
+                                 // RELAY an object it is itself still
+                                 // receiving (committed chunks stream
+                                 // onward while the tail arrives)
+constexpr uint32_t kErrFrame = 0xFFFFFFFFu;  // OP_PULL2 abort marker
+constexpr int kRelayDrainMs = 60000;  // writer waits this long for
+                                      // relay readers to leave the raw
+                                      // span before seal/abort
+
+// Chunk-sized kernel socket buffers on every transfer socket: with the
+// default ~208 KiB buffers a 4 MiB chunk needs ~20 alternating
+// sender/receiver wakeups, and on an oversubscribed host that
+// context-switch ping-pong — multiplied down a relay pipeline — is the
+// throughput floor, not the copies. A full chunk in flight lets each
+// side run a whole chunk per scheduling quantum. Best-effort: the
+// kernel clamps to {w,r}mem_max.
+void set_socket_buffers(int fd) {
+  int sz = static_cast<int>(kChunk);
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof(sz));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
+}
 
 bool send_all(int fd, const void* data, uint64_t n) {
   const char* p = static_cast<const char*>(data);
@@ -91,8 +113,78 @@ bool recv_all(int fd, void* data, uint64_t n) {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// Relay registry — process-local directory of objects currently being
+// PULLED into an arena of this process (reference: chunked transfer +
+// in-flight chunk availability in object_manager's Push pipelining).
+// The receiving side of an OP_PULL2 registers here; the SAME process's
+// TransferServer (daemons and the driver both run server + pull manager
+// in one process) finds the entry and streams committed chunks onward
+// while the tail is still arriving — an N-node broadcast chains through
+// mid-pull nodes at ~O(log N) producer bandwidth instead of O(N).
+// Keyed by arena name + id: one process can host several arenas.
+// ---------------------------------------------------------------------------
+
+struct Relay {
+  std::mutex mu;
+  std::condition_variable cv;
+  uint64_t off = 0;        // arena offset of the created span (immutable)
+  uint64_t total = 0;      // full object size (immutable)
+  uint64_t committed = 0;  // bytes received so far (monotonic, under mu)
+  int readers = 0;         // relay streams currently on the raw span
+  bool failed = false;     // writer's source died mid-stream
+  bool done = false;       // all bytes landed (seal follows drain)
+};
+
+std::mutex g_relay_mu;
+std::unordered_map<std::string, std::shared_ptr<Relay>> g_relay;
+
+std::string relay_id_key(const std::string& arena, const uint8_t* id) {
+  return arena + "/" +
+         std::string(reinterpret_cast<const char*>(id), kIdLen);
+}
+
+void relay_register(const std::string& arena, const uint8_t* id,
+                    std::shared_ptr<Relay> rel) {
+  std::lock_guard<std::mutex> lk(g_relay_mu);
+  g_relay[relay_id_key(arena, id)] = rel;
+}
+
+void relay_erase(const std::string& arena, const uint8_t* id) {
+  std::lock_guard<std::mutex> lk(g_relay_mu);
+  g_relay.erase(relay_id_key(arena, id));
+}
+
+// Reader acquisition increments `readers` while still holding the
+// registry lock: the writer erases the entry (registry lock) BEFORE
+// waiting on readers == 0, so a reader that found the entry is always
+// counted before the writer's drain check can pass.
+std::shared_ptr<Relay> relay_acquire_reader(const std::string& arena,
+                                            const uint8_t* id) {
+  std::lock_guard<std::mutex> lk(g_relay_mu);
+  auto it = g_relay.find(relay_id_key(arena, id));
+  if (it == g_relay.end()) return nullptr;
+  std::lock_guard<std::mutex> lk2(it->second->mu);
+  it->second->readers++;
+  return it->second;
+}
+
+// Size of an in-flight relay object (-1 when none): OP_STAT treats a
+// mid-pull object as present so the manager's admission control — and
+// source selection at the next hop down a broadcast chain — works
+// before the object seals.
+int64_t relay_total(const std::string& arena, const uint8_t* id) {
+  std::lock_guard<std::mutex> lk(g_relay_mu);
+  auto it = g_relay.find(relay_id_key(arena, id));
+  if (it == g_relay.end()) return -1;
+  return static_cast<int64_t>(it->second->total);
+}
+
 struct TransferServer {
   void* store = nullptr;     // rts_connect handle (owned)
+  std::string arena;         // shm name (relay registry key space)
+  std::atomic<uint64_t> bytes_out{0};     // payload bytes served
+  std::atomic<uint64_t> relay_served{0};  // OP_PULL2 answered mid-pull
   int listen_fd = -1;
   int port = 0;
   std::atomic<bool> stopping{false};
@@ -114,6 +206,90 @@ void drain(int fd, uint64_t left) {
     if (!recv_all(fd, sink.data(), n)) return;
     left -= n;
   }
+}
+
+// OP_PULL2 service: sealed objects stream pinned from the arena; an
+// object this process is still PULLING streams its committed chunks as
+// they land (relay pipelining). Frames are [u32 len][payload]; a
+// kErrFrame marker tells the receiver the upstream source died (the
+// connection stays cleanly framed either way). Returns false when the
+// connection itself is dead.
+bool serve_pull2(TransferServer* ts, int fd, const uint8_t* id) {
+  Store* st = reinterpret_cast<Store*>(ts->store);
+  uint64_t off = 0, size = 0;
+  bool pinned = rts_get(ts->store, id, &off, &size, 1) == 0;
+  std::shared_ptr<Relay> rel;
+  if (!pinned) {
+    rel = relay_acquire_reader(ts->arena, id);
+    // The in-flight pull may have sealed between the two probes
+    // (writer erases the entry before sealing) — re-check sealed.
+    if (!rel) pinned = rts_get(ts->store, id, &off, &size, 1) == 0;
+  }
+  if (pinned) {
+    int64_t rsize = static_cast<int64_t>(size);
+    bool ok = send_all(fd, &rsize, 8);
+    uint64_t sent = 0;
+    while (ok && sent < size) {
+      uint32_t len = static_cast<uint32_t>(
+          std::min(kChunk, size - sent));
+      ok = send_all(fd, &len, 4) &&
+           send_all(fd, st->base + off + sent, len);
+      if (ok) sent += len;
+    }
+    rts_release(ts->store, id);
+    ts->bytes_out.fetch_add(sent);
+    return ok;
+  }
+  if (rel == nullptr) {
+    int64_t rsize = -1;
+    return send_all(fd, &rsize, 8);
+  }
+  ts->relay_served.fetch_add(1);
+  int64_t rsize = static_cast<int64_t>(rel->total);
+  bool ok = send_all(fd, &rsize, 8);
+  uint64_t sent = 0;
+  bool src_failed = false;
+  while (ok && sent < rel->total) {
+    uint64_t avail = 0;
+    {
+      std::unique_lock<std::mutex> lk(rel->mu);
+      cv_wait_for_ms(rel->cv, lk, 100, [&] {
+        return rel->failed || rel->committed > sent;
+      });
+      if (rel->failed) {
+        src_failed = true;
+        break;
+      }
+      avail = rel->committed;
+    }
+    if (avail <= sent) {
+      if (ts->stopping.load()) {  // poll keeps stop() from wedging
+        src_failed = true;
+        break;
+      }
+      continue;
+    }
+    // Bytes below `committed` are stable (the writer only appends and
+    // publishes the watermark under rel->mu) — stream without the lock.
+    while (ok && sent < avail) {
+      uint32_t len = static_cast<uint32_t>(
+          std::min(kChunk, avail - sent));
+      ok = send_all(fd, &len, 4) &&
+           send_all(fd, st->base + rel->off + sent, len);
+      if (ok) sent += len;
+    }
+  }
+  if (ok && src_failed) {
+    uint32_t err = kErrFrame;
+    ok = send_all(fd, &err, 4);
+  }
+  {
+    std::lock_guard<std::mutex> lk(rel->mu);
+    rel->readers--;
+    rel->cv.notify_all();  // writer drains on readers == 0
+  }
+  ts->bytes_out.fetch_add(sent);
+  return ok;
 }
 
 void serve_conn(TransferServer* ts, int fd) {
@@ -139,13 +315,18 @@ void serve_conn(TransferServer* ts, int fd) {
       if (pinned) {
         ok = send_all(fd, st->base + off, size);
         rts_release(ts->store, id);
+        if (ok) ts->bytes_out.fetch_add(size);
       }
       if (!ok) break;
+    } else if (op == OP_PULL2) {
+      if (!serve_pull2(ts, fd, id)) break;
     } else if (op == OP_STAT) {
       uint64_t off = 0, size = 0;
       int64_t rsize = -1;
       if (rts_get(ts->store, id, &off, &size, 0) == 0)
         rsize = static_cast<int64_t>(size);
+      else
+        rsize = relay_total(ts->arena, id);  // mid-pull counts as held
       if (!send_all(fd, &rsize, 8)) break;
     } else if (op == OP_PUSH) {
       uint64_t size = 0;
@@ -175,12 +356,139 @@ void serve_conn(TransferServer* ts, int fd) {
   close(fd);
 }
 
+// Receiver side of OP_PULL2. Registers the in-flight object in the
+// relay registry as chunks land, so this process's own TransferServer
+// can stream them onward mid-pull. Returns rto_pull's codes: 0 ok,
+// -1 remote miss, -2 local store full, -3 wire/source error, -4 dup.
+int pull2_into(int fd, void* local_store, const std::string& arena,
+               const uint8_t* id) {
+  Store* st = reinterpret_cast<Store*>(local_store);
+  uint8_t op = OP_PULL2;
+  if (!send_all(fd, &op, 1) || !send_all(fd, id, kIdLen)) return -3;
+  int64_t total;
+  if (!recv_all(fd, &total, 8)) return -3;
+  if (total < 0) return -1;
+  uint64_t off = 0;
+  int crc = rts_create(local_store, id, static_cast<uint64_t>(total),
+                       &off);
+  bool discard = crc != 0;
+  std::shared_ptr<Relay> rel;
+  if (!discard) {
+    rel = std::make_shared<Relay>();
+    rel->off = off;
+    rel->total = static_cast<uint64_t>(total);
+    relay_register(arena, id, rel);
+  }
+  uint64_t cum = 0;
+  bool wire_ok = true, peer_err = false;
+  std::vector<char> sink;
+  while (cum < static_cast<uint64_t>(total)) {
+    uint32_t len;
+    if (!recv_all(fd, &len, 4)) {
+      wire_ok = false;
+      break;
+    }
+    if (len == kErrFrame) {  // upstream source died at the sender
+      peer_err = true;
+      break;
+    }
+    if (len == 0 || len > kChunk ||
+        cum + len > static_cast<uint64_t>(total)) {
+      wire_ok = false;
+      break;
+    }
+    char* dst;
+    if (discard) {
+      // Duplicate / store-full: the frames are in flight — consume
+      // them so the persistent connection stays framed.
+      sink.resize(len);
+      dst = sink.data();
+    } else {
+      dst = reinterpret_cast<char*>(st->base + off + cum);
+    }
+    if (!recv_all(fd, dst, len)) {
+      wire_ok = false;
+      break;
+    }
+    cum += len;
+    if (rel) {
+      std::lock_guard<std::mutex> lk(rel->mu);
+      rel->committed = cum;
+      rel->cv.notify_all();
+    }
+  }
+  if (discard) {
+    if (!wire_ok) return -3;
+    if (peer_err) return -3;
+    return crc == -1 ? -4 : -2;
+  }
+  if (wire_ok && !peer_err && cum == static_cast<uint64_t>(total)) {
+    // Publish completion, close the entry to NEW readers, let the
+    // in-flight ones leave the raw span, then seal: a sealed unpinned
+    // object is evictable, and relay readers stream straight from the
+    // arena offset without a pin.
+    {
+      std::lock_guard<std::mutex> lk(rel->mu);
+      rel->done = true;
+      rel->cv.notify_all();
+    }
+    relay_erase(arena, id);
+    {
+      std::unique_lock<std::mutex> lk(rel->mu);
+      cv_wait_for_ms(rel->cv, lk, kRelayDrainMs,
+                     [&] { return rel->readers == 0; });
+    }
+    rts_seal(local_store, id);
+    return 0;
+  }
+  // Source died mid-stream: fail fast to relay readers (they forward
+  // the error marker down the chain), let them drain, then abort the
+  // partial object so a retry from another source can re-create it.
+  {
+    std::lock_guard<std::mutex> lk(rel->mu);
+    rel->failed = true;
+    rel->cv.notify_all();
+  }
+  relay_erase(arena, id);
+  bool drained;
+  {
+    std::unique_lock<std::mutex> lk(rel->mu);
+    drained = cv_wait_for_ms(rel->cv, lk, kRelayDrainMs,
+                             [&] { return rel->readers == 0; });
+  }
+  // Not drained (wedged reader past its send timeout): leave the slot
+  // CREATED — owner-death repair reclaims it; freeing the span under
+  // a live reader would corrupt its stream.
+  if (drained) rts_abort(local_store, id);
+  return -3;
+}
+
 }  // namespace
 
 extern "C" {
 
 // Abort a created-but-unsealed object (receiver-side failure path).
+// rts_delete refuses SLOT_CREATED because a foreign writer may still
+// be mid-write into the span — but the abort caller IS that writer,
+// declaring its write over. Free the span when this process owns the
+// creation (otherwise a failed pull leaks the slot until owner-death
+// repair, and a retry would find the stale CREATED slot and
+// misreport the object as a local duplicate).
 int rts_abort(void* handle, const uint8_t* id) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  Lock(h);
+  Slot* s = FindSlot(h, id, false);
+  if (s && s->state == SLOT_CREATED &&
+      s->owner_pid == static_cast<int32_t>(getpid()) &&
+      s->owner_start == OwnStartTime()) {
+    FreeLocked(st, s->offset, s->alloc_size);
+    s->state = SLOT_TOMBSTONE;
+    h->num_objects--;
+    pthread_mutex_unlock(&h->mu);
+    return 0;
+  }
+  pthread_mutex_unlock(&h->mu);
   return rts_delete(handle, id);
 }
 
@@ -209,6 +517,7 @@ void* rto_serve(const char* shm_name, uint64_t capacity, int port,
 
   TransferServer* ts = new TransferServer();
   ts->store = store;
+  ts->arena = shm_name;
   ts->listen_fd = fd;
   ts->port = ntohs(addr.sin_port);
   ts->acceptor = std::thread([ts]() {
@@ -221,6 +530,14 @@ void* rto_serve(const char* shm_name, uint64_t capacity, int port,
       }
       int one = 1;
       setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      set_socket_buffers(cfd);
+      // Send timeout only: a wedged receiver must not pin a relay
+      // reader (and through it the relay writer's drain wait) forever.
+      // NO receive timeout — idle persistent connections legitimately
+      // block in the op-header recv between requests.
+      timeval stv{};
+      stv.tv_sec = 30;
+      setsockopt(cfd, SOL_SOCKET, SO_SNDTIMEO, &stv, sizeof(stv));
       {
         std::lock_guard<std::mutex> lock(ts->fd_mu);
         if (ts->stopping.load()) {
@@ -281,6 +598,7 @@ void* rto_connect(const char* host, int port) {
   }
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  set_socket_buffers(fd);
   return reinterpret_cast<void*>(static_cast<intptr_t>(fd) + 1);
 }
 
@@ -344,6 +662,25 @@ int rto_push(void* conn, void* local_store, const uint8_t* id) {
   return status == 0 ? 0 : -2;
 }
 
+// Chunk-framed pull (OP_PULL2): like rto_pull, but the peer may relay
+// an object it is itself still receiving, and THIS side registers the
+// in-flight object so its own server can relay it onward. `shm_name`
+// names the receiving arena in the process-local relay registry.
+int rto_pull2(void* conn, void* local_store, const char* shm_name,
+              const uint8_t* id) {
+  int fd = static_cast<int>(reinterpret_cast<intptr_t>(conn)) - 1;
+  return pull2_into(fd, local_store, shm_name, id);
+}
+
+// Server-side transfer counters (observability: bytes served and how
+// many pulls were answered from a mid-pull relay entry).
+void rto_serve_stats(void* handle, uint64_t* bytes_out,
+                     uint64_t* relay_served) {
+  TransferServer* ts = reinterpret_cast<TransferServer*>(handle);
+  if (bytes_out) *bytes_out = ts->bytes_out.load();
+  if (relay_served) *relay_served = ts->relay_served.load();
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
@@ -371,11 +708,20 @@ int rto_push(void* conn, void* local_store, const uint8_t* id) {
 
 namespace {
 
+struct Cand {
+  std::string host;
+  int port;
+  std::string ep;  // "host:port"
+};
+
 struct PullOp {
   uint64_t requester;
   std::string host;
   int port;
-  std::string ep;                   // "host:port" concurrency bucket
+  std::string ep;                   // CURRENT "host:port" bucket
+  std::vector<Cand> cands;          // fallback sources, [0] = current
+  std::string ckey;                 // by_id key (pulls; covers all eps)
+  std::string src;                  // winning source after success
   uint8_t id[kIdLen];
   bool is_push;
   std::atomic<int> status{1};       // 1 = pending/running
@@ -385,8 +731,15 @@ struct PullOp {
 
 struct PullMgr {
   void* store = nullptr;            // local arena (owned)
+  std::string arena;                // shm name (relay registry key)
   uint64_t budget;
   uint64_t inflight = 0;
+  // Per-source accounting: admitted in-flight bytes drive least-loaded
+  // source selection (reference: PullManager's location-aware pull
+  // scheduling); cumulative bytes feed the transfer metrics.
+  std::unordered_map<std::string, uint64_t> ep_inflight;
+  std::unordered_map<std::string, uint64_t> ep_bytes;
+  uint64_t bytes_in = 0;            // total payload bytes pulled
   int timeout_ms;
   int retries;
   int ep_cap = 3;  // max workers on ONE endpoint: a dead peer's
@@ -472,10 +825,36 @@ PullOp* next_op_locked(PullMgr* m) {
     if (it == m->queues.end()) it = m->queues.begin();
     if (it->second.empty()) continue;
     PullOp* op = it->second.front();
-    // find(), not operator[]: a saturation probe must not plant
-    // permanent zero-count entries for every endpoint it skips.
-    auto ea = m->ep_active.find(op->ep);
-    if (ea != m->ep_active.end() && ea->second >= m->ep_cap) continue;
+    // Least-loaded source selection: among the op's candidate
+    // endpoints under the per-endpoint worker cap, pick the one with
+    // the fewest admitted in-flight bytes (ties: fewer active workers,
+    // then the submitter's preference order — for a relay chain that
+    // is the assigned parent). Skip the op only when EVERY candidate
+    // is saturated. find(), not operator[]: a saturation probe must
+    // not plant permanent zero-count entries for endpoints it skips.
+    int best = -1;
+    uint64_t best_load = 0;
+    int best_active = 0;
+    for (size_t ci = 0; ci < op->cands.size(); ci++) {
+      const std::string& ep = op->cands[ci].ep;
+      auto ea = m->ep_active.find(ep);
+      int act = ea == m->ep_active.end() ? 0 : ea->second;
+      if (act >= m->ep_cap) continue;
+      auto ei = m->ep_inflight.find(ep);
+      uint64_t load = ei == m->ep_inflight.end() ? 0 : ei->second;
+      if (best < 0 || load < best_load ||
+          (load == best_load && act < best_active)) {
+        best = static_cast<int>(ci);
+        best_load = load;
+        best_active = act;
+      }
+    }
+    if (best < 0) continue;
+    if (best != 0)
+      std::swap(op->cands[0], op->cands[static_cast<size_t>(best)]);
+    op->host = op->cands[0].host;
+    op->port = op->cands[0].port;
+    op->ep = op->cands[0].ep;
     it->second.pop_front();
     uint64_t key = it->first;
     if (it->second.empty()) m->queues.erase(it);
@@ -489,7 +868,7 @@ PullOp* next_op_locked(PullMgr* m) {
 void finish_op_locked(PullMgr* m, PullOp* op, int status) {
   op->status.store(status);
   if (!op->is_push) {
-    m->by_id.erase(coalesce_key(op->id, op->ep));
+    m->by_id.erase(op->ckey);
   }
   auto ea = m->ep_active.find(op->ep);
   if (ea != m->ep_active.end() && --ea->second <= 0)
@@ -503,6 +882,68 @@ void finish_op_locked(PullMgr* m, PullOp* op, int status) {
     // manager's lifetime.
     delete op;
   }
+}
+
+// Shared submit path: `cands` is the fallback-ordered source list
+// (one entry = the classic single-source submit). Pulls coalesce on
+// id + the full candidate list — a pull naming a DIFFERENT source set
+// must not inherit another submit's failure, but identical broadcasts
+// share one transfer (reference: PullManager object deduplication).
+uint64_t submit_locked(PullMgr* m, uint64_t requester,
+                       std::vector<Cand> cands, const uint8_t* id,
+                       int is_push) {
+  uint64_t t = m->next_ticket++;
+  std::string ckey;
+  if (!is_push) {
+    std::string joined;
+    for (const Cand& c : cands) {
+      if (!joined.empty()) joined += ",";
+      joined += c.ep;
+    }
+    ckey = coalesce_key(id, joined);
+    auto it = m->by_id.find(ckey);
+    if (it != m->by_id.end()) {
+      it->second->tickets.push_back(t);
+      m->tickets[t] = it->second;
+      return t;
+    }
+  }
+  PullOp* op = new PullOp();
+  op->requester = requester;
+  op->cands = std::move(cands);
+  op->host = op->cands[0].host;
+  op->port = op->cands[0].port;
+  op->ep = op->cands[0].ep;
+  op->ckey = ckey;
+  memcpy(op->id, id, kIdLen);
+  op->is_push = is_push != 0;
+  op->tickets.push_back(t);
+  if (!is_push) m->by_id[ckey] = op;
+  m->tickets[t] = op;
+  m->queues[requester].push_back(op);
+  m->queued_ops++;
+  m->work_cv.notify_one();
+  return t;
+}
+
+void release_ep_inflight_locked(PullMgr* m, const std::string& ep,
+                                uint64_t n) {
+  auto it = m->ep_inflight.find(ep);
+  if (it == m->ep_inflight.end()) return;
+  it->second = it->second > n ? it->second - n : 0;
+  if (it->second == 0) m->ep_inflight.erase(it);
+}
+
+// Move the op's active-worker slot to the next fallback candidate.
+void switch_ep_locked(PullMgr* m, PullOp* op, const Cand& c) {
+  auto ea = m->ep_active.find(op->ep);
+  if (ea != m->ep_active.end() && --ea->second <= 0)
+    m->ep_active.erase(ea);
+  op->host = c.host;
+  op->port = c.port;
+  op->ep = c.ep;
+  m->ep_active[op->ep]++;
+  m->work_cv.notify_all();  // old endpoint's slot freed
 }
 
 void pull_worker(PullMgr* m) {
@@ -527,74 +968,112 @@ void pull_worker(PullMgr* m) {
     }
 
     int rc = -3;
+    int64_t got_size = 0;
     uint64_t admitted = 0;
-    for (int attempt = 0; attempt <= m->retries; attempt++) {
-      // Local-presence FIRST: an object already in the local arena
-      // must succeed even when its source peer is dead (no connect).
-      if (!op->is_push && rts_contains(m->store, op->id)) {
-        rc = 0;
-        break;
+    std::string admitted_ep;
+    std::string local_hit;  // op->src is written under m->mu at finish
+    // Candidate fallback: run the retry loop against the selected
+    // source; on a miss or exhausted wire retries, move to the next
+    // registered location (a broadcast chain survives a dead or
+    // already-evicted relay parent by falling back toward the
+    // producer). next_op_locked put the least-loaded candidate first.
+    for (size_t ci = 0; ci < op->cands.size(); ci++) {
+      if (ci > 0) {
+        std::lock_guard<std::mutex> lk(m->mu);
+        switch_ep_locked(m, op, op->cands[ci]);
       }
-      void* conn = conns.get(op->host, op->port, m->timeout_ms);
-      if (conn == nullptr) {
-        rc = -3;
-        continue;  // connect refused/timed out — retry
-      }
-      int64_t size;
-      if (op->is_push) {
-        uint64_t off = 0, sz = 0;
-        if (rts_get(m->store, op->id, &off, &sz, 0) != 0) {
-          rc = -1;
-          break;  // local miss: nothing to push, no retry will help
-        }
-        size = static_cast<int64_t>(sz);
-      } else {
-        size = rto_stat(conn, op->id);
-        if (size == -1) {
-          rc = -1;
-          break;  // remote miss is authoritative, not retryable here
-        }
-        if (size < 0) {
-          conns.drop(op->host, op->port);
-          rc = -3;
-          continue;
-        }
-      }
-      {
-        std::unique_lock<std::mutex> lk(m->mu);
-        uint64_t need = static_cast<uint64_t>(size);
-        m->budget_cv.wait(lk, [m, need] {
-          return m->stopping || m->inflight + need <= m->budget ||
-                 m->inflight == 0;  // oversized: admit alone
-        });
-        if (m->stopping) {
-          rc = -6;
+      rc = -3;
+      for (int attempt = 0; attempt <= m->retries; attempt++) {
+        // Local-presence FIRST: an object already in the local arena
+        // must succeed even when its source peer is dead (no connect).
+        if (!op->is_push && rts_contains(m->store, op->id)) {
+          rc = 0;
+          local_hit = "local";
           break;
         }
-        m->inflight += need;
-        admitted = need;
+        void* conn = conns.get(op->host, op->port, m->timeout_ms);
+        if (conn == nullptr) {
+          rc = -3;
+          continue;  // connect refused/timed out — retry
+        }
+        int64_t size;
+        if (op->is_push) {
+          uint64_t off = 0, sz = 0;
+          if (rts_get(m->store, op->id, &off, &sz, 0) != 0) {
+            rc = -1;
+            break;  // local miss: nothing to push, no retry will help
+          }
+          size = static_cast<int64_t>(sz);
+        } else {
+          size = rto_stat(conn, op->id);
+          if (size == -1) {
+            rc = -1;
+            break;  // miss at THIS source — fall back to the next one
+          }
+          if (size < 0) {
+            conns.drop(op->host, op->port);
+            rc = -3;
+            continue;
+          }
+        }
+        {
+          std::unique_lock<std::mutex> lk(m->mu);
+          uint64_t need = static_cast<uint64_t>(size);
+          m->budget_cv.wait(lk, [m, need] {
+            return m->stopping || m->inflight + need <= m->budget ||
+                   m->inflight == 0;  // oversized: admit alone
+          });
+          if (m->stopping) {
+            rc = -6;
+            break;
+          }
+          m->inflight += need;
+          m->ep_inflight[op->ep] += need;
+          admitted = need;
+          admitted_ep = op->ep;
+        }
+        rc = op->is_push
+                 ? rto_push(conn, m->store, op->id)
+                 : pull2_into(static_cast<int>(
+                                  reinterpret_cast<intptr_t>(conn)) -
+                                  1,
+                              m->store, m->arena, op->id);
+        got_size = size;
+        {
+          std::lock_guard<std::mutex> lk(m->mu);
+          m->inflight -= admitted;
+          release_ep_inflight_locked(m, admitted_ep, admitted);
+          admitted = 0;
+          m->budget_cv.notify_all();
+        }
+        if (rc == -4) rc = 0;  // already present locally = success
+        if (rc != -3) break;   // success or non-wire error: done
+        // Wire error (sender died / timed out mid-transfer): the
+        // partial local object was aborted inside pull2_into;
+        // reconnect and retry.
+        conns.drop(op->host, op->port);
       }
-      rc = op->is_push ? rto_push(conn, m->store, op->id)
-                       : rto_pull(conn, m->store, op->id);
-      {
-        std::lock_guard<std::mutex> lk(m->mu);
-        m->inflight -= admitted;
-        admitted = 0;
-        m->budget_cv.notify_all();
-      }
-      if (rc == -4) rc = 0;  // already present locally = success
-      if (rc != -3) break;   // success or non-wire error: done
-      // Wire error (sender died / timed out mid-transfer): the partial
-      // local object was aborted inside rto_pull; reconnect and retry.
-      conns.drop(op->host, op->port);
+      // -2 (local store full) and -6 (stopping) won't improve at
+      // another source; pushes are single-candidate.
+      if (rc == 0 || rc == -2 || rc == -6 || op->is_push) break;
     }
     if (admitted) {
       std::lock_guard<std::mutex> lk(m->mu);
       m->inflight -= admitted;
+      release_ep_inflight_locked(m, admitted_ep, admitted);
       m->budget_cv.notify_all();
     }
     {
       std::lock_guard<std::mutex> lk(m->mu);
+      if (rc == 0 && !op->is_push) {
+        if (local_hit.empty()) {
+          op->src = op->ep;
+          m->ep_bytes[op->ep] += static_cast<uint64_t>(got_size);
+          m->bytes_in += static_cast<uint64_t>(got_size);
+        } else {
+          op->src = local_hit;
+        }
+      }
       finish_op_locked(m, op, rc);
     }
   }
@@ -615,6 +1094,7 @@ void* rtp_start(const char* shm_name, uint64_t budget_bytes,
   if (store == nullptr) return nullptr;
   PullMgr* m = new PullMgr();
   m->store = store;
+  m->arena = shm_name;
   m->budget = budget_bytes ? budget_bytes : rts_capacity(store) / 2;
   m->timeout_ms = timeout_ms > 0 ? timeout_ms : 30000;
   m->retries = retries >= 0 ? retries : 2;
@@ -634,44 +1114,50 @@ void* rtp_start(const char* shm_name, uint64_t budget_bytes,
 uint64_t rtp_submit(void* handle, uint64_t requester, const char* host,
                     int port, const uint8_t* id, int is_push) {
   PullMgr* m = reinterpret_cast<PullMgr*>(handle);
-  std::string ep = std::string(host) + ":" + std::to_string(port);
+  std::vector<Cand> cands;
+  cands.push_back(
+      {host, port, std::string(host) + ":" + std::to_string(port)});
   std::lock_guard<std::mutex> lk(m->mu);
-  uint64_t t = m->next_ticket++;
-  if (!is_push) {
-    // Coalesce onto an in-flight pull of the same object FROM THE
-    // SAME endpoint (a healthy alternate source must not inherit a
-    // dead source's failure).
-    auto it = m->by_id.find(coalesce_key(id, ep));
-    if (it != m->by_id.end()) {
-      it->second->tickets.push_back(t);
-      m->tickets[t] = it->second;
-      return t;
+  return submit_locked(m, requester, std::move(cands), id, is_push);
+}
+
+// Multi-source pull: `endpoints` is a comma-separated,
+// fallback-ordered "host:port,host:port,..." list of registered
+// locations (a relay parent first, the producer last). The manager
+// picks the least-loaded source at dispatch and falls back through
+// the rest on miss or wire failure. Returns 0 on a malformed or
+// empty endpoint list, else a ticket for rtp_wait / rtp_wait_src.
+uint64_t rtp_submit_multi(void* handle, uint64_t requester,
+                          const char* endpoints, const uint8_t* id) {
+  PullMgr* m = reinterpret_cast<PullMgr*>(handle);
+  std::vector<Cand> cands;
+  std::string s = endpoints ? endpoints : "";
+  size_t pos = 0;
+  while (pos <= s.size()) {
+    size_t comma = s.find(',', pos);
+    std::string ep = s.substr(
+        pos, comma == std::string::npos ? std::string::npos
+                                        : comma - pos);
+    size_t colon = ep.rfind(':');
+    if (!ep.empty() && colon != std::string::npos && colon > 0) {
+      int port = atoi(ep.c_str() + colon + 1);
+      if (port > 0 && port < 65536)
+        cands.push_back({ep.substr(0, colon), port, ep});
     }
+    if (comma == std::string::npos) break;
+    pos = comma + 1;
   }
-  PullOp* op = new PullOp();
-  op->requester = requester;
-  op->host = host;
-  op->port = port;
-  op->ep = std::move(ep);
-  memcpy(op->id, id, kIdLen);
-  op->is_push = is_push != 0;
-  op->tickets.push_back(t);
-  if (!is_push) {
-    m->by_id[coalesce_key(id, op->ep)] = op;
-  }
-  m->tickets[t] = op;
-  m->queues[requester].push_back(op);
-  m->queued_ops++;
-  m->work_cv.notify_one();
-  return t;
+  if (cands.empty()) return 0;
+  std::lock_guard<std::mutex> lk(m->mu);
+  return submit_locked(m, requester, std::move(cands), id, 0);
 }
 
 // Block until the ticket's transfer completes (or timeout_ms passes).
 // Returns the transfer status (0 ok, -1 miss, -2 store full, -3 wire
 // error after retries, -6 manager stopping) or -5 on wait timeout.
 // A completed ticket is consumed; the op is freed with its last ticket.
-int rtp_wait(void* handle, uint64_t ticket, int timeout_ms) {
-  PullMgr* m = reinterpret_cast<PullMgr*>(handle);
+static int rtp_wait_impl(PullMgr* m, uint64_t ticket, int timeout_ms,
+                         char* src, int src_cap) {
   std::unique_lock<std::mutex> lk(m->mu);
   auto it = m->tickets.find(ticket);
   if (it == m->tickets.end()) return -7;  // unknown/already consumed
@@ -691,11 +1177,33 @@ int rtp_wait(void* handle, uint64_t ticket, int timeout_ms) {
   if (timed_out) return -5;
   int st = op->status.load();
   if (st == 1) st = -6;  // woken by stop while still pending
+  if (src != nullptr && src_cap > 0) {
+    // Winning source endpoint ("host:port", or "local" when the
+    // object was already in the arena) — written by the worker under
+    // m->mu before the status flipped, so this read is ordered.
+    size_t n = std::min(op->src.size(),
+                        static_cast<size_t>(src_cap - 1));
+    memcpy(src, op->src.data(), n);
+    src[n] = '\0';
+  }
   m->tickets.erase(ticket);
   auto& tk = op->tickets;
   tk.erase(std::remove(tk.begin(), tk.end(), ticket), tk.end());
   if (tk.empty()) delete op;
   return st;
+}
+
+int rtp_wait(void* handle, uint64_t ticket, int timeout_ms) {
+  return rtp_wait_impl(reinterpret_cast<PullMgr*>(handle), ticket,
+                       timeout_ms, nullptr, 0);
+}
+
+// rtp_wait + the winning source endpoint (for the directory's
+// pull_complete report and per-source pull counting).
+int rtp_wait_src(void* handle, uint64_t ticket, int timeout_ms,
+                 char* src, int src_cap) {
+  return rtp_wait_impl(reinterpret_cast<PullMgr*>(handle), ticket,
+                       timeout_ms, src, src_cap);
 }
 
 // Abandon a ticket (e.g. after a wait timeout the caller will not
@@ -726,6 +1234,46 @@ void rtp_stats(void* handle, uint64_t* inflight_bytes,
   if (inflight_bytes) *inflight_bytes = m->inflight;
   if (queued) *queued = m->queued_ops;
   if (active) *active = m->active_ops;
+}
+
+// Per-source transfer stats as text, one line per source:
+//   "total <bytes_in>\n" then "<ep> <inflight> <active> <bytes>\n".
+// Returns the full length needed (snprintf-style; the caller retries
+// with a bigger buffer if the return >= cap).
+int rtp_ep_stats(void* handle, char* buf, int cap) {
+  PullMgr* m = reinterpret_cast<PullMgr*>(handle);
+  std::string out;
+  {
+    std::lock_guard<std::mutex> lk(m->mu);
+    out = "total " + std::to_string(m->bytes_in) + "\n";
+    // Union of the maps: a source with historical bytes but nothing
+    // in flight still reports (the bench's per-source pull spread).
+    std::map<std::string, int> eps;
+    for (const auto& kv : m->ep_bytes) eps[kv.first] = 1;
+    for (const auto& kv : m->ep_inflight) eps[kv.first] = 1;
+    for (const auto& kv : m->ep_active) eps[kv.first] = 1;
+    for (const auto& kv : eps) {
+      auto fi = m->ep_inflight.find(kv.first);
+      auto fa = m->ep_active.find(kv.first);
+      auto fb = m->ep_bytes.find(kv.first);
+      out += kv.first + " " +
+             std::to_string(
+                 fi == m->ep_inflight.end() ? 0 : fi->second) +
+             " " +
+             std::to_string(
+                 fa == m->ep_active.end() ? 0 : fa->second) +
+             " " +
+             std::to_string(
+                 fb == m->ep_bytes.end() ? 0 : fb->second) +
+             "\n";
+    }
+  }
+  if (buf != nullptr && cap > 0) {
+    size_t n = std::min(out.size(), static_cast<size_t>(cap - 1));
+    memcpy(buf, out.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int>(out.size());
 }
 
 void rtp_stop(void* handle) {

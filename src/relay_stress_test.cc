@@ -1,0 +1,267 @@
+// Sanitizer stress harness for the RELAY path of the object-transfer
+// plane: daemons that are mid-pull serve committed chunks onward, so a
+// broadcast forms a tree instead of a star (see serve_pull2's relay
+// branch in object_transfer.cc). Build + run via `make -C src asan`
+// / `make -C src tsan`.
+//
+// Topology (all loopback, in-process): one producer arena seeds
+// multi-chunk objects; two relay nodes pull them through their own
+// PullManagers while four consumers concurrently pull the SAME ids
+// from the relays — racing the relays' in-flight pulls so serve_pull2
+// alternates between the sealed fast path and relay_acquire_reader.
+// Chaos on top:
+//  - relay submissions list a dead endpoint first (fallback path);
+//  - a disruptor opens OP_PULL2 streams against relay 1, reads a few
+//    bytes, and slams the connection shut (reader teardown while the
+//    relay entry is still filling);
+//  - a stopper kills the producer's server mid-traffic, so relays see
+//    src_failed and their downstream readers get kErrFrame, forcing
+//    consumers onto the surviving relay (multi-source fallback).
+// Every successful consumer pull is integrity-checked byte-for-byte.
+
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+
+extern "C" {
+void* rts_connect(const char* name, uint64_t capacity, int create);
+void rts_disconnect(void* handle);
+int rts_unlink(const char* name);
+int rts_create(void* h, const uint8_t* id, uint64_t size, uint64_t* off);
+int rts_seal(void* h, const uint8_t* id);
+int rts_get(void* h, const uint8_t* id, uint64_t* off, uint64_t* size);
+int rts_release(void* h, const uint8_t* id);
+uint8_t* rts_base(void* h);
+void* rto_serve(const char* shm, uint64_t cap, int port, int bind_all);
+int rto_port(void* h);
+void rto_stop(void* h);
+void rto_serve_stats(void* h, uint64_t* bytes_out, uint64_t* relay_served);
+void* rtp_start(const char* shm, uint64_t budget, int workers,
+                int timeout_ms, int retries);
+uint64_t rtp_submit_multi(void* h, uint64_t requester,
+                          const char* endpoints, const uint8_t* id);
+int rtp_wait(void* h, uint64_t ticket, int timeout_ms);
+void rtp_stop(void* h);
+}
+
+namespace {
+
+constexpr int kObjects = 10;
+constexpr int kRelays = 2;
+constexpr int kConsumers = 4;
+// Multi-chunk objects (chunk = 4 MiB): 5..8 MiB so every pull streams
+// at least two frames and relays spend real time mid-pull.
+constexpr uint64_t kMinObj = 5ull << 20;
+
+char g_producer[64];
+char g_relay[kRelays][64];
+char g_cons[kConsumers][64];
+int g_producer_port = 0;
+int g_relay_port[kRelays];
+void* g_relay_mgr[kRelays];
+void* g_cons_mgr[kConsumers];
+uint64_t g_obj_size[kObjects];
+
+void make_id(uint8_t* id, int tag) {
+  memset(id, 0, 28);
+  memcpy(id, &tag, sizeof(tag));
+}
+
+uint8_t pattern_byte(int tag, uint64_t i) {
+  return static_cast<uint8_t>((tag * 131 + i * 2654435761ull) & 0xff);
+}
+
+// Relay node: pull every object from {dead endpoint, producer}. After
+// the stopper kills the producer these legitimately fail (-1/-3).
+void* relay_puller(void* arg) {
+  long r = reinterpret_cast<long>(arg);
+  unsigned seed = static_cast<unsigned>(r) * 7919 + 11;
+  char eps[128];
+  snprintf(eps, sizeof(eps), "127.0.0.1:1,127.0.0.1:%d",
+           g_producer_port);
+  for (int i = 0; i < kObjects; i++) {
+    uint8_t id[28];
+    make_id(id, (i + static_cast<int>(r) * 3) % kObjects);
+    uint64_t t = rtp_submit_multi(g_relay_mgr[r], 100 + r, eps, id);
+    if (t == 0) abort();
+    int rc = rtp_wait(g_relay_mgr[r], t, 60000);
+    if (rc != 0 && rc != -1 && rc != -2 && rc != -3 && rc != -6) {
+      fprintf(stderr, "relay pull rc=%d\n", rc);
+      abort();
+    }
+    if (rand_r(&seed) % 4 == 0) usleep(1000 * (rand_r(&seed) % 5));
+  }
+  return nullptr;
+}
+
+// Consumer: pull every object preferring the relays; verify payload.
+void* consumer(void* arg) {
+  long c = reinterpret_cast<long>(arg);
+  unsigned seed = static_cast<unsigned>(c) * 31337 + 5;
+  void* store = rts_connect(g_cons[c], 0, 0);
+  if (store == nullptr) abort();
+  char eps[192];
+  snprintf(eps, sizeof(eps), "127.0.0.1:%d,127.0.0.1:%d,127.0.0.1:%d",
+           g_relay_port[c % kRelays], g_relay_port[(c + 1) % kRelays],
+           g_producer_port);
+  for (int i = 0; i < kObjects; i++) {
+    int tag = (i + static_cast<int>(c)) % kObjects;
+    uint8_t id[28];
+    make_id(id, tag);
+    uint64_t t = rtp_submit_multi(g_cons_mgr[c], 200 + c, eps, id);
+    if (t == 0) abort();
+    int rc = rtp_wait(g_cons_mgr[c], t, 60000);
+    if (rc != 0 && rc != -1 && rc != -2 && rc != -3 && rc != -6) {
+      fprintf(stderr, "consumer pull rc=%d tag=%d\n", rc, tag);
+      abort();
+    }
+    if (rc == 0) {
+      uint64_t off = 0, size = 0;
+      if (rts_get(store, id, &off, &size) != 0) abort();
+      if (size != g_obj_size[tag]) abort();
+      const uint8_t* base = rts_base(store);
+      for (uint64_t j = 0; j < size; j += 4093)
+        if (base[off + j] != pattern_byte(tag, j)) {
+          fprintf(stderr, "payload corrupt tag=%d at %llu\n", tag,
+                  static_cast<unsigned long long>(j));
+          abort();
+        }
+      rts_release(store, id);
+    }
+    if (rand_r(&seed) % 3 == 0) usleep(1000 * (rand_r(&seed) % 3));
+  }
+  rts_disconnect(store);
+  return nullptr;
+}
+
+// Disruptor: open a raw OP_PULL2 stream against relay 1, read only the
+// header + a sliver of the first frame, then RST the connection —
+// tearing a relay reader down while the entry may still be filling.
+void* disruptor(void*) {
+  unsigned seed = 99;
+  for (int i = 0; i < 40; i++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in a{};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(static_cast<uint16_t>(g_relay_port[0]));
+    inet_pton(AF_INET, "127.0.0.1", &a.sin_addr);
+    if (connect(fd, reinterpret_cast<sockaddr*>(&a), sizeof(a)) == 0) {
+      uint8_t req[29];
+      req[0] = 4;  // OP_PULL2
+      make_id(req + 1, rand_r(&seed) % kObjects);
+      if (write(fd, req, sizeof(req)) == sizeof(req)) {
+        char sink[512];
+        (void)!read(fd, sink, sizeof(sink));
+      }
+      struct linger lg {1, 0};  // RST on close
+      setsockopt(fd, SOL_SOCKET, SO_LINGER, &lg, sizeof(lg));
+    }
+    close(fd);
+    usleep(1000 * (rand_r(&seed) % 8));
+  }
+  return nullptr;
+}
+
+// Stopper: kill the producer's server mid-traffic. In-flight relay
+// pulls observe src_failed; their downstream readers get kErrFrame
+// and fall back to the other relay.
+void* stopper(void* arg) {
+  usleep(150 * 1000);
+  rto_stop(arg);
+  return nullptr;
+}
+
+}  // namespace
+
+int main() {
+  snprintf(g_producer, sizeof(g_producer), "/rto_relay_p_%d",
+           getpid());
+  void* prod = rts_connect(g_producer, 128ull << 20, 1);
+  if (prod == nullptr) return 1;
+  uint8_t* base = rts_base(prod);
+  unsigned seed = 2;
+  for (int i = 0; i < kObjects; i++) {
+    uint8_t id[28];
+    make_id(id, i);
+    uint64_t off = 0;
+    uint64_t n = kMinObj + (rand_r(&seed) % (3ull << 20));
+    g_obj_size[i] = n;
+    if (rts_create(prod, id, n, &off) != 0) return 1;
+    for (uint64_t j = 0; j < n; j++)
+      base[off + j] = pattern_byte(i, j);
+    rts_seal(prod, id);
+  }
+  void* srv_prod = rto_serve(g_producer, 0, 0, 0);
+  if (srv_prod == nullptr) return 1;
+  g_producer_port = rto_port(srv_prod);
+
+  void* relay_store[kRelays];
+  void* srv_relay[kRelays];
+  for (int r = 0; r < kRelays; r++) {
+    snprintf(g_relay[r], sizeof(g_relay[r]), "/rto_relay_r%d_%d", r,
+             getpid());
+    relay_store[r] = rts_connect(g_relay[r], 128ull << 20, 1);
+    if (relay_store[r] == nullptr) return 1;
+    srv_relay[r] = rto_serve(g_relay[r], 0, 0, 0);
+    if (srv_relay[r] == nullptr) return 1;
+    g_relay_port[r] = rto_port(srv_relay[r]);
+    g_relay_mgr[r] = rtp_start(g_relay[r], 32ull << 20, 3, 10000, 1);
+    if (g_relay_mgr[r] == nullptr) return 1;
+  }
+  void* cons_store[kConsumers];
+  for (int c = 0; c < kConsumers; c++) {
+    snprintf(g_cons[c], sizeof(g_cons[c]), "/rto_relay_c%d_%d", c,
+             getpid());
+    cons_store[c] = rts_connect(g_cons[c], 128ull << 20, 1);
+    if (cons_store[c] == nullptr) return 1;
+    g_cons_mgr[c] = rtp_start(g_cons[c], 32ull << 20, 3, 10000, 1);
+    if (g_cons_mgr[c] == nullptr) return 1;
+  }
+
+  pthread_t threads[kRelays + kConsumers + 2];
+  int t = 0;
+  for (long r = 0; r < kRelays; r++)
+    pthread_create(&threads[t++], nullptr, relay_puller,
+                   reinterpret_cast<void*>(r));
+  for (long c = 0; c < kConsumers; c++)
+    pthread_create(&threads[t++], nullptr, consumer,
+                   reinterpret_cast<void*>(c));
+  pthread_create(&threads[t++], nullptr, disruptor, nullptr);
+  pthread_create(&threads[t++], nullptr, stopper, srv_prod);
+  for (int i = 0; i < t; i++) pthread_join(threads[i], nullptr);
+
+  uint64_t relay_served_total = 0;
+  for (int r = 0; r < kRelays; r++) {
+    uint64_t out = 0, served = 0;
+    rto_serve_stats(srv_relay[r], &out, &served);
+    relay_served_total += served;
+  }
+
+  // Stop with work still queued on a relay manager (stop-path races).
+  for (int i = 0; i < 8; i++) {
+    uint8_t id[28];
+    make_id(id, i);
+    char eps[64];
+    snprintf(eps, sizeof(eps), "127.0.0.1:%d", g_relay_port[1]);
+    rtp_submit_multi(g_relay_mgr[0], 999, eps, id);
+  }
+  for (int r = 0; r < kRelays; r++) rtp_stop(g_relay_mgr[r]);
+  for (int c = 0; c < kConsumers; c++) rtp_stop(g_cons_mgr[c]);
+  for (int r = 0; r < kRelays; r++) rto_stop(srv_relay[r]);
+  for (int r = 0; r < kRelays; r++) rts_disconnect(relay_store[r]);
+  for (int c = 0; c < kConsumers; c++) rts_disconnect(cons_store[c]);
+  rts_disconnect(prod);
+  rts_unlink(g_producer);
+  for (int r = 0; r < kRelays; r++) rts_unlink(g_relay[r]);
+  for (int c = 0; c < kConsumers; c++) rts_unlink(g_cons[c]);
+  printf("OK relay stress (relay_served=%llu)\n",
+         static_cast<unsigned long long>(relay_served_total));
+  return 0;
+}

@@ -42,6 +42,8 @@ void* rtp_start(const char* shm, uint64_t budget, int workers,
                 int timeout_ms, int retries);
 uint64_t rtp_submit(void* h, uint64_t requester, const char* host,
                     int port, const uint8_t* id, int is_push);
+uint64_t rtp_submit_multi(void* h, uint64_t requester,
+                          const char* endpoints, const uint8_t* id);
 int rtp_wait(void* h, uint64_t ticket, int timeout_ms);
 void rtp_stats(void* h, uint64_t* inflight, uint64_t* queued,
                uint64_t* active);
@@ -69,8 +71,18 @@ void* submitter(void* arg) {
     // 1 in 4 targets a missing object (error path).
     int tag = rand_r(&seed) % (kObjects + kObjects / 4);
     make_id(id, tag);
-    uint64_t t = rtp_submit(g_mgr, static_cast<uint64_t>(tid),
-                            "127.0.0.1", g_src_port, id, 0);
+    uint64_t t;
+    if (rand_r(&seed) % 3 == 0) {
+      // Multi-endpoint submit: dead candidate first, so the worker
+      // exercises the per-endpoint fallback before reaching src.
+      char eps[64];
+      snprintf(eps, sizeof(eps), "127.0.0.1:1,127.0.0.1:%d",
+               g_src_port);
+      t = rtp_submit_multi(g_mgr, static_cast<uint64_t>(tid), eps, id);
+    } else {
+      t = rtp_submit(g_mgr, static_cast<uint64_t>(tid),
+                     "127.0.0.1", g_src_port, id, 0);
+    }
     int rc = rtp_wait(g_mgr, t, 30000);
     if (rc != 0 && rc != -1 && rc != -2 && rc != -6) {
       fprintf(stderr, "pull rc=%d tag=%d\n", rc, tag);

@@ -188,3 +188,107 @@ class TestKillRandomNodeEndpoint:
         from ray_tpu.scripts.cli import main
 
         assert main(["kill-random-node"]) == 2
+
+
+class TestTransferSourceChaos:
+    """Multi-location object directory under node death: a pull whose
+    source dies mid-broadcast completes from a fallback location; an
+    object whose EVERY source is dead reconstructs from lineage
+    instead of hanging (reference: object_recovery_manager.h)."""
+
+    def _wait(self, pred, timeout=20.0, msg="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.1)
+        raise TimeoutError(msg)
+
+    def test_pull_falls_back_to_secondary_location(self):
+        from ray_tpu.cluster_utils import RealCluster
+
+        ray_tpu.shutdown()
+        cluster = RealCluster()
+        env = {"RAY_TPU_OBJECT_STORE_MEMORY_BYTES": str(256 << 20)}
+        try:
+            src = cluster.add_node(num_cpus=1,
+                                   resources={"src": 1}, env=env)
+            mid = cluster.add_node(num_cpus=1,
+                                   resources={"mid": 1}, env=env)
+            late = cluster.add_node(num_cpus=1,
+                                    resources={"late": 1}, env=env)
+            ray = cluster.connect()
+
+            @ray.remote(resources={"src": 1})
+            def make():
+                return np.ones(4 << 20, dtype=np.float64)  # 32 MiB
+
+            @ray.remote(num_cpus=1, resources={"mid": 1})
+            def consume_mid(a):
+                return float(a.sum())
+
+            @ray.remote(num_cpus=1, resources={"late": 1})
+            def consume_late(a):
+                return float(a.sum())
+
+            ref = make.remote()
+            expect = ray.get(consume_mid.remote(ref))
+            # Wait for mid's pull_complete to register it as a
+            # location in the owner's directory.
+            from ray_tpu.core.runtime import global_runtime_or_none
+            rt = global_runtime_or_none()
+            stored = rt.store.get_if_exists(ref.id())
+            self._wait(lambda: mid in stored.data.locations,
+                       msg="pull_complete never registered mid")
+            # Kill the PRIMARY source; drop it from the driver's view.
+            cluster.kill_node(src)
+            self._wait(lambda: rt.scheduler.get_node(src) is None
+                       or not rt.remote_plane._endpoints.get(src),
+                       msg="dead source never dropped")
+            rt.remote_plane._drop_node(src)
+            # The late consumer's only live candidate is mid's copy.
+            assert ray.get(consume_late.remote(ref),
+                           timeout=60) == expect
+        finally:
+            cluster.shutdown()
+
+    def test_all_sources_dead_reconstructs_not_hangs(self):
+        from ray_tpu.cluster_utils import RealCluster
+
+        ray_tpu.shutdown()
+        cluster = RealCluster()
+        env = {"RAY_TPU_OBJECT_STORE_MEMORY_BYTES": str(256 << 20)}
+        try:
+            # 2 CPUs per node: the consumer HOLDS one while its
+            # dispatch blocks on reconstruction — the re-executed
+            # producer needs a free slot on the survivor.
+            cluster.add_node(num_cpus=2, env=env)
+            cluster.add_node(num_cpus=2, env=env)
+            ray = cluster.connect()
+
+            @ray.remote(max_retries=3)
+            def make():
+                return np.full(1 << 20, 3.0)  # 8 MiB
+
+            @ray.remote(num_cpus=1)
+            def consume(a):
+                return float(a[0])
+
+            ref = make.remote()
+            ray.get(ref, timeout=60)
+            # Kill whichever node holds the ONLY copy — the producer
+            # stays schedulable on the survivor, so lineage can rerun.
+            from ray_tpu.core.runtime import global_runtime_or_none
+            rt = global_runtime_or_none()
+            holder = rt.store.get_if_exists(ref.id()).data.node_id
+            assert holder is not None
+            cluster.kill_node(holder)
+            self._wait(lambda: rt.scheduler.get_node(holder) is None
+                       or holder not in rt.remote_plane._known,
+                       msg="dead source never dropped")
+            rt.remote_plane._drop_node(holder)
+            # Lineage re-executes make() on the survivor; the consumer
+            # completes instead of hanging on a dead endpoint.
+            assert ray.get(consume.remote(ref), timeout=90) == 3.0
+        finally:
+            cluster.shutdown()

@@ -270,12 +270,17 @@ def test_xp_inventory_accounts_for_control_plane():
     _, inventory = run_xp([PKG], None)
     types = {row["type"] for row in inventory}
     expected = {"task", "actor_create", "actor_call", "ping", "pong",
-                "shutdown", "gen_ack", "gen_item", "hello", "result"}
+                "shutdown", "gen_ack", "gen_item", "hello", "result",
+                "pull_complete"}
     assert expected <= types, sorted(types)
     by_type = {row["type"]: row for row in inventory}
     # both directions populated for the core RPC pair
     assert by_type["ping"]["senders"] and by_type["ping"]["handlers"]
     assert by_type["hello"]["senders"] and by_type["hello"]["handlers"]
+    # the object directory's location report has both ends too (daemon
+    # sends on the dispatch socket, driver-side NodeConn consumes)
+    assert (by_type["pull_complete"]["senders"]
+            and by_type["pull_complete"]["handlers"])
 
 
 def test_xp_baseline_suppresses_and_flags_stale(tmp_path):
