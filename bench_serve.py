@@ -1,0 +1,192 @@
+"""Serve overload bench — bounded latency under 2× capacity, not
+collapse.
+
+Drives a multi-replica deployment with a mixed-priority open-loop burst
+at twice its measured capacity, kills a replica mid-burst, and records:
+
+  - unloaded p99 TTFT (baseline)
+  - p99 TTFT of ADMITTED high-priority requests under overload
+    (gate: ≤ 3× unloaded p99 — the SLO the priority lane exists for)
+  - shed rate (bounded queues shedding instead of queueing forever)
+  - goodput (admitted completions/s) and retries (replica-kill replays)
+  - hung clients (gate: 0 — every request resolves: result, 429, or a
+    typed unavailability error)
+
+Prints one JSON line per metric:
+  {"metric": ..., "value": N, "unit": ...}
+
+Run:  python bench_serve.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def emit(metric: str, value: float, unit: str, **extra) -> None:
+    print(json.dumps({"metric": metric, "value": round(value, 4),
+                      "unit": unit, **extra}), flush=True)
+    try:
+        import bench
+
+        bench.push_history("serve_" + metric, value, unit,
+                           match={}, extra=extra)
+    except Exception:  # noqa: BLE001 - recording must not fail the run
+        pass
+
+
+def _p(sorted_xs, q):
+    if not sorted_xs:
+        return 0.0
+    return sorted_xs[min(len(sorted_xs) - 1, int(len(sorted_xs) * q))]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--service-time-s", type=float, default=0.05)
+    ap.add_argument("--burst-s", type=float, default=None)
+    args = ap.parse_args()
+    burst_s = args.burst_s or (4.0 if args.quick else 10.0)
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=max(4, args.replicas + 1), num_tpus=0)
+    service_s = args.service_time_s
+
+    @serve.deployment(num_replicas=args.replicas,
+                      max_ongoing_requests=2,
+                      max_queued_requests=8,
+                      max_request_retries=4)
+    def infer(_payload):
+        time.sleep(service_s)
+        return {"ok": True}
+
+    handle = serve.run(infer.bind(), name="infer", http=False)
+
+    # -- unloaded baseline: sequential requests, p99 "TTFT" ------------
+    lat = []
+    for _ in range(40 if args.quick else 100):
+        t0 = time.perf_counter()
+        handle.remote({}).result(timeout=30)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    unloaded_p99 = _p(lat, 0.99)
+    emit("unloaded_p99_ttft", unloaded_p99, "s")
+
+    # Measured capacity: max_ongoing × replicas slots, each serving one
+    # request per service time.
+    capacity_rps = (2 * args.replicas) / service_s
+    offered_rps = 2.0 * capacity_rps
+    emit("offered_load", offered_rps, "req/s",
+         capacity=round(capacity_rps, 1))
+
+    # -- 2× capacity mixed-priority burst + replica kill mid-burst -----
+    results = {"hi": [], "lo": []}   # latencies of admitted completions
+    shed = {"hi": 0, "lo": 0}
+    errors = 0
+    hung = 0
+    lock = threading.Lock()
+    threads = []
+    stop_at = time.monotonic() + burst_s
+
+    def client(priority_name: str, priority: int):
+        nonlocal errors, hung
+        h = handle.options(priority=priority)
+        t0 = time.perf_counter()
+        try:
+            fut = h.remote({})
+        except serve.BackPressureError:
+            with lock:
+                shed[priority_name] += 1
+            return
+        try:
+            fut.result(timeout=60)
+            with lock:
+                results[priority_name].append(
+                    time.perf_counter() - t0)
+        except serve.BackPressureError:
+            with lock:
+                shed[priority_name] += 1
+        except (serve.ReplicaUnavailableError,
+                serve.DeploymentUnavailableError):
+            with lock:
+                errors += 1
+        except Exception:  # noqa: BLE001 — incl. GetTimeoutError
+            with lock:
+                hung += 1
+
+    interval = 1.0 / offered_rps
+    killed = False
+    n_sent = 0
+    t_start = time.monotonic()
+    while time.monotonic() < stop_at:
+        # 20% high priority, 80% low — deterministic interleave.
+        pri = ("hi", 1) if n_sent % 5 == 0 else ("lo", 0)
+        t = threading.Thread(target=client, args=pri, daemon=True)
+        t.start()
+        threads.append(t)
+        n_sent += 1
+        if not killed and time.monotonic() - t_start > burst_s / 2:
+            # Replica kill mid-burst: in-flight requests replay, the
+            # controller replaces the corpse, zero clients hang.
+            controller = handle._controller
+            replicas, _ = ray_tpu.get(
+                controller.get_replicas.remote("infer"))
+            ray_tpu.kill(replicas[0])
+            killed = True
+            emit("replica_killed_at", time.monotonic() - t_start, "s")
+        time.sleep(interval)
+    for t in threads:
+        t.join(timeout=90)
+        if t.is_alive():
+            hung += 1
+    wall = time.monotonic() - t_start
+
+    hi = sorted(results["hi"])
+    lo = sorted(results["lo"])
+    total_shed = shed["hi"] + shed["lo"]
+    admitted = len(hi) + len(lo)
+    loaded_p99_hi = _p(hi, 0.99)
+    emit("loaded_p99_ttft_high_priority", loaded_p99_hi, "s",
+         n=len(hi))
+    emit("loaded_p99_ttft_low_priority", _p(lo, 0.99), "s", n=len(lo))
+    emit("shed_rate", total_shed / max(1, n_sent), "fraction",
+         shed_hi=shed["hi"], shed_lo=shed["lo"], sent=n_sent)
+    emit("goodput", admitted / wall, "req/s")
+    emit("unavailable_errors", errors, "count")
+    emit("hung_clients", hung, "count")
+    snap = handle._router.admission.snapshot()
+    emit("leaked_ongoing", snap["ongoing"] + snap["queued"], "count")
+
+    ok = True
+    if hung:
+        print(f"FAIL: {hung} hung clients", flush=True)
+        ok = False
+    if snap["ongoing"] or snap["queued"]:
+        print(f"FAIL: admission leak {snap}", flush=True)
+        ok = False
+    if unloaded_p99 > 0 and hi and loaded_p99_hi > 3 * unloaded_p99:
+        print(f"FAIL: high-priority p99 {loaded_p99_hi:.3f}s exceeds "
+              f"3x unloaded p99 {unloaded_p99:.3f}s", flush=True)
+        ok = False
+    if total_shed == 0:
+        print("WARN: no shedding at 2x capacity (burst too short?)",
+              flush=True)
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    print("PASS" if ok else "FAIL", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

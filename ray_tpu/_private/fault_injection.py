@@ -88,6 +88,78 @@ def kill_random_node(exclude_head: bool = True) -> Optional[str]:
     return node.node_id
 
 
+class ServeFaultInjector:
+    """Serve-plane fault points, armed per replica through the
+    Replica.inject_fault actor method (serve/replica.py): `stall`
+    delays every request (overload scripting), `crash_on_request` makes
+    the replica die as if its process was killed on the next N requests
+    (exercises handle-side retry + controller replacement), and
+    `slow_health_probe` delays health_check() past the controller's
+    timeout (exercises health-check-driven restart). Deterministic —
+    chaos tests script exact failure interleavings instead of racing a
+    background killer."""
+
+    def __init__(self, controller):
+        self._controller = controller
+        self.armed: List[tuple] = []
+
+    def _replicas(self, deployment: str):
+        from .. import get as ray_get
+
+        replicas, _ = ray_get(
+            self._controller.get_replicas.remote(deployment))
+        return replicas
+
+    def _arm(self, deployment: str, kind: str, value,
+             replica_index: Optional[int]) -> int:
+        from .. import get as ray_get
+
+        replicas = self._replicas(deployment)
+        targets = (replicas if replica_index is None
+                   else [replicas[replica_index]])
+        for r in targets:
+            ray_get(r.inject_fault.remote(kind, value), timeout=10)
+        self.armed.append((deployment, kind, value, replica_index))
+        return len(targets)
+
+    def stall(self, deployment: str, stall_s: float,
+              replica_index: Optional[int] = None) -> int:
+        """Every request to the target replica(s) sleeps `stall_s`
+        before running. → replicas armed."""
+        return self._arm(deployment, "stall_s", float(stall_s),
+                         replica_index)
+
+    def crash_on_request(self, deployment: str, count: int = 1,
+                         replica_index: Optional[int] = 0) -> int:
+        """The target replica dies (actor-death semantics, not a user
+        exception) on its next `count` requests. → replicas armed."""
+        return self._arm(deployment, "crash_on_request", int(count),
+                         replica_index)
+
+    def slow_health_probe(self, deployment: str, delay_s: float,
+                          replica_index: Optional[int] = 0) -> int:
+        """health_check() on the target replica(s) sleeps `delay_s` —
+        set it past health_check_timeout_s to trigger the controller's
+        unhealthy → restart path. → replicas armed."""
+        return self._arm(deployment, "health_probe_delay_s",
+                         float(delay_s), replica_index)
+
+    def clear(self, deployment: str) -> None:
+        """Disarm every fault on every live replica (replicas replaced
+        since arming never had faults)."""
+        from .. import get as ray_get
+
+        for r in self._replicas(deployment):
+            for kind in ("stall_s", "crash_on_request",
+                         "health_probe_delay_s"):
+                try:
+                    ray_get(r.inject_fault.remote(kind, None),
+                            timeout=10)
+                except Exception:  # noqa: BLE001 — dead replica
+                    pass
+        self.armed = [a for a in self.armed if a[0] != deployment]
+
+
 class WorkerKiller:
     """Kills random spawned worker PROCESSES mid-task (reference:
     ResourceKillerActor targeting workers): exercises worker-crash

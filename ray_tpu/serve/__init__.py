@@ -13,6 +13,11 @@ from .deployment import (
     DeploymentConfig,
     deployment,
 )
+from .exceptions import (
+    BackPressureError,
+    DeploymentUnavailableError,
+    ReplicaUnavailableError,
+)
 from .handle import DeploymentHandle
 from .llm import GenRequest, LLMEngine, LLMServer
 
@@ -21,4 +26,6 @@ __all__ = [
     "Application", "run", "delete", "shutdown", "status",
     "get_deployment_handle", "DeploymentHandle", "batch", "multiplexed",
     "LLMEngine", "LLMServer", "GenRequest",
+    "BackPressureError", "ReplicaUnavailableError",
+    "DeploymentUnavailableError",
 ]

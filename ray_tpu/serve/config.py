@@ -68,6 +68,10 @@ def _apply_overrides(app: Application,
         node.deployment = node.deployment.options(
             num_replicas=ov.get("num_replicas"),
             max_ongoing_requests=ov.get("max_ongoing_requests"),
+            max_queued_requests=ov.get("max_queued_requests"),
+            max_request_retries=ov.get("max_request_retries"),
+            health_check_period_s=ov.get("health_check_period_s"),
+            health_check_timeout_s=ov.get("health_check_timeout_s"),
             autoscaling_config=ov.get("autoscaling_config"),
             ray_actor_options=ov.get("ray_actor_options"),
             user_config=ov.get("user_config"))

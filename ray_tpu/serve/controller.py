@@ -16,8 +16,14 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import get as ray_get, kill as ray_kill, remote
+from ..core.exceptions import GetTimeoutError
 from .deployment import AutoscalingConfig, Deployment
 from .replica import Replica
+
+
+def _rkey(replica: Any) -> str:
+    aid = getattr(replica, "_actor_id", None)
+    return aid.hex() if aid is not None else f"local:{id(replica)}"
 
 
 class _ReplicaSet:
@@ -31,6 +37,15 @@ class _ReplicaSet:
         now = time.monotonic()
         self._last_scale_up = now
         self._last_scale_down = now
+        # Routing signals: per-replica stats (ongoing, latency/TTFT
+        # EWMAs) polled off the control loop and served to routers via
+        # routing_state(). Keys are actor-id hex.
+        self.stats_cache: Dict[str, Dict[str, Any]] = {}
+        self._stats_pending: Dict[str, Any] = {}
+        self._last_stats_poll = 0.0
+        # Health-probe state machine per replica:
+        # {key: {"ref", "deadline", "fails", "last"}}.
+        self._hc: Dict[str, Dict[str, Any]] = {}
 
     def scale_to(self, n: int, init_args=(), init_kwargs=None):
         from ..core.task import SpreadSchedulingStrategy
@@ -167,6 +182,29 @@ class ServeController:
                 raise KeyError(f"No deployment {name!r}")
             return list(rs.replicas), rs.version
 
+    def routing_state(self, name: str) -> Dict[str, Any]:
+        """Everything a router needs in one RPC: live replica handles,
+        version, polled per-replica stats (queue depth / latency EWMA
+        for SLO-aware power-of-two), and the admission-control config.
+        get_replicas() stays for callers that only want membership."""
+        with self._lock:
+            rs = self._sets.get(name)
+            if rs is None:
+                raise KeyError(f"No deployment {name!r}")
+            cfg = rs.deployment.config
+            live = {_rkey(r) for r in rs.replicas}
+            return {
+                "replicas": list(rs.replicas),
+                "version": rs.version,
+                "stats": {k: dict(v) for k, v in rs.stats_cache.items()
+                          if k in live},
+                "config": {
+                    "max_ongoing_requests": cfg.max_ongoing_requests,
+                    "max_queued_requests": cfg.max_queued_requests,
+                    "max_request_retries": cfg.max_request_retries,
+                },
+            }
+
     def set_route(self, route: str, deployment_name: str):
         """Bind an HTTP route to a deployment; the control loop keeps
         the shared route table (control-plane KV) pointing at the live
@@ -285,9 +323,20 @@ class ServeController:
             routes = dict(self._routes)
         table = {}
         for route, dep in routes.items():
+            with self._lock:
+                rs = self._sets.get(dep)
+                cfg = rs.deployment.config if rs else None
+                stats = ({k: dict(v) for k, v in rs.stats_cache.items()}
+                         if rs else {})
             table[route] = {
                 "deployment": dep,
                 "replicas": self.replica_locations(dep),
+                "stats": stats,
+                "config": ({
+                    "max_ongoing_requests": cfg.max_ongoing_requests,
+                    "max_queued_requests": cfg.max_queued_requests,
+                    "max_request_retries": cfg.max_request_retries,
+                } if cfg else {}),
             }
         try:
             from .node_proxy import publish_routes
@@ -318,6 +367,10 @@ class ServeController:
             ticks += 1
             with self._lock:
                 sets = list(self._sets.values())
+            try:
+                self._probe_replicas(sets)
+            except Exception:  # noqa: BLE001
+                pass
             for rs in sets:
                 asc = rs.deployment.config.autoscaling_config
                 if asc is None:
@@ -337,6 +390,114 @@ class ServeController:
                     self.ensure_proxies()
                 except Exception:  # noqa: BLE001
                     pass
+
+    STATS_POLL_S = 0.5
+    HC_CONSECUTIVE_FAILS = 2
+
+    def _probe_replicas(self, sets: List[_ReplicaSet]):
+        """Stats polling + health checks, fire-and-harvest: probes are
+        fired without waiting and collected with timeout=0 on later
+        ticks, so one stalled replica never stalls the control loop
+        (reference: controller health checks in deployment_state.py —
+        probe every health_check_period_s, a probe that errors or
+        exceeds health_check_timeout_s marks the replica unhealthy;
+        here two consecutive failures trigger a restart)."""
+        now = time.monotonic()
+        for rs in sets:
+            cfg = rs.deployment.config
+            with self._lock:
+                replicas = list(rs.replicas)
+            live = {_rkey(r): r for r in replicas}
+            # Drop state for replaced replicas.
+            for k in list(rs.stats_cache):
+                if k not in live:
+                    rs.stats_cache.pop(k, None)
+                    rs._stats_pending.pop(k, None)
+            for k in list(rs._hc):
+                if k not in live:
+                    rs._hc.pop(k, None)
+            # -- stats ---------------------------------------------------
+            fire_stats = now - rs._last_stats_poll >= self.STATS_POLL_S
+            if fire_stats:
+                rs._last_stats_poll = now
+            for key, r in live.items():
+                ref = rs._stats_pending.get(key)
+                if ref is not None:
+                    try:
+                        rs.stats_cache[key] = ray_get(ref, timeout=0)
+                        rs._stats_pending.pop(key, None)
+                    except GetTimeoutError:
+                        continue  # still running; harvest next tick
+                    except Exception:  # noqa: BLE001 - dead → reconcile
+                        rs._stats_pending.pop(key, None)
+                elif fire_stats:
+                    try:
+                        rs._stats_pending[key] = r.stats.remote()
+                    except Exception:  # noqa: BLE001
+                        pass
+            # -- health checks -------------------------------------------
+            period = cfg.health_check_period_s
+            if period is None or period <= 0:
+                continue
+            unhealthy = []
+            for key, r in live.items():
+                hc = rs._hc.setdefault(
+                    key, {"ref": None, "deadline": 0.0, "fails": 0,
+                          "last": now})
+                if hc["ref"] is None:
+                    if now - hc["last"] >= period:
+                        hc["last"] = now
+                        hc["deadline"] = now + cfg.health_check_timeout_s
+                        try:
+                            hc["ref"] = r.health_check.remote()
+                        except Exception:  # noqa: BLE001
+                            hc["fails"] += 1
+                else:
+                    failed = False
+                    try:
+                        ray_get(hc["ref"], timeout=0)
+                        hc["fails"] = 0
+                        hc["ref"] = None
+                    except GetTimeoutError:
+                        if now > hc["deadline"]:
+                            failed = True  # probe overran its timeout
+                    except Exception:  # noqa: BLE001 - probe errored
+                        failed = True
+                    if failed:
+                        hc["ref"] = None
+                        hc["fails"] += 1
+                if hc["fails"] >= self.HC_CONSECUTIVE_FAILS:
+                    unhealthy.append((key, r))
+            if unhealthy:
+                self._restart_unhealthy(rs, unhealthy)
+
+    def _restart_unhealthy(self, rs: _ReplicaSet, unhealthy):
+        """Kill replicas that flunked consecutive health probes and
+        replace them. Kill + scale are network-visible: only membership
+        mutation happens under the lock."""
+        victims = []
+        with self._lock:
+            keys = {k for k, _ in unhealthy}
+            keep = []
+            for r in rs.replicas:
+                (victims if _rkey(r) in keys else keep).append(r)
+            if not victims:
+                return
+            target = len(rs.replicas)
+            rs.replicas = keep
+            for k in keys:
+                rs._hc.pop(k, None)
+                rs.stats_cache.pop(k, None)
+                rs._stats_pending.pop(k, None)
+        for v in victims:
+            try:
+                ray_kill(v)
+            except Exception:  # noqa: BLE001
+                pass
+        rs.scale_to(target,
+                    getattr(rs, "init_args", ()),
+                    getattr(rs, "init_kwargs", {}))
+        self._publish_routes()
 
     def _reconcile(self):
         """Replace replicas that died for good (restarts exhausted) —

@@ -28,6 +28,19 @@ class AutoscalingConfig:
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 100
+    # Admission control (reference: serve's max_queued_requests):
+    # requests beyond max_ongoing_requests × replicas queue up to this
+    # bound, then shed with BackPressureError / HTTP 429. -1 = unbounded
+    # queue (no shedding).
+    max_queued_requests: int = 200
+    # Handle-side transparent replays when a replica dies mid-call
+    # (idempotent, non-streaming requests only).
+    max_request_retries: int = 3
+    # Controller-driven replica health checks: probe every period; a
+    # probe that errors/times out twice in a row marks the replica
+    # unhealthy and restarts it. period <= 0 disables.
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 5.0
     autoscaling_config: Optional[AutoscalingConfig] = None
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     user_config: Optional[Dict[str, Any]] = None
@@ -43,6 +56,10 @@ class Deployment:
 
     def options(self, *, num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
+                max_queued_requests: Optional[int] = None,
+                max_request_retries: Optional[int] = None,
+                health_check_period_s: Optional[float] = None,
+                health_check_timeout_s: Optional[float] = None,
                 autoscaling_config: Optional[Any] = None,
                 ray_actor_options: Optional[Dict[str, Any]] = None,
                 user_config: Optional[Dict[str, Any]] = None,
@@ -54,6 +71,14 @@ class Deployment:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
+        if max_request_retries is not None:
+            cfg.max_request_retries = max_request_retries
+        if health_check_period_s is not None:
+            cfg.health_check_period_s = health_check_period_s
+        if health_check_timeout_s is not None:
+            cfg.health_check_timeout_s = health_check_timeout_s
         if autoscaling_config is not None:
             if isinstance(autoscaling_config, dict):
                 autoscaling_config = AutoscalingConfig(**autoscaling_config)
@@ -107,6 +132,10 @@ class Application:
 def deployment(target: Optional[Callable] = None, *,
                name: Optional[str] = None, num_replicas: int = 1,
                max_ongoing_requests: int = 100,
+               max_queued_requests: int = 200,
+               max_request_retries: int = 3,
+               health_check_period_s: float = 2.0,
+               health_check_timeout_s: float = 5.0,
                autoscaling_config: Optional[Any] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                user_config: Optional[Dict[str, Any]] = None,
@@ -120,6 +149,10 @@ def deployment(target: Optional[Callable] = None, *,
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
+            max_request_retries=max_request_retries,
+            health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
             autoscaling_config=asc,
             ray_actor_options=ray_actor_options or {},
             user_config=user_config,

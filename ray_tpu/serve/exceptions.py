@@ -1,0 +1,77 @@
+"""Typed serve-plane errors.
+
+The front door's failure modes are part of its API (reference:
+python/ray/serve/exceptions.py BackPressureError / RayServeException;
+the proxy maps them to HTTP status codes): overload sheds with a 429
+carrying a Retry-After estimate, replica death mid-call surfaces as a
+retryable typed error, and a deployment with no live replicas fails
+FAST with a typed error instead of hanging the client.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ..core.exceptions import RayTpuError
+
+
+class BackPressureError(RayTpuError):
+    """Request shed by admission control (queue full). Maps to HTTP
+    429; `retry_after_s` is computed from the observed service rate so
+    well-behaved clients back off just long enough."""
+
+    def __init__(self, deployment: str, retry_after_s: float = 1.0,
+                 priority: int = 0, queued: int = 0):
+        self.deployment = deployment
+        self.retry_after_s = max(0.0, float(retry_after_s))
+        self.priority = int(priority)
+        self.queued = int(queued)
+        super().__init__(
+            f"Deployment {deployment!r} is at capacity "
+            f"({queued} queued, priority {priority}); retry after "
+            f"~{self.retry_after_s:.1f}s")
+
+    @property
+    def retry_after_header(self) -> str:
+        """Retry-After is integer seconds on the wire (RFC 9110)."""
+        return str(max(1, int(math.ceil(self.retry_after_s))))
+
+    def __reduce__(self):
+        return (BackPressureError,
+                (self.deployment, self.retry_after_s, self.priority,
+                 self.queued))
+
+
+class ReplicaUnavailableError(RayTpuError):
+    """A replica died mid-request and the request could not (or must
+    not) be transparently replayed — non-idempotent calls, streaming
+    calls past their first token, or retries exhausted."""
+
+    def __init__(self, deployment: str, reason: str = "",
+                 attempts: int = 0,
+                 cause: Optional[BaseException] = None):
+        self.deployment = deployment
+        self.attempts = int(attempts)
+        self.cause = cause
+        super().__init__(
+            f"Replica of {deployment!r} unavailable after "
+            f"{attempts} attempt(s). {reason}".strip())
+
+    def __reduce__(self):
+        return (ReplicaUnavailableError,
+                (self.deployment, "", self.attempts, None))
+
+
+class DeploymentUnavailableError(RayTpuError):
+    """No live replicas exist for the deployment (all dead or the
+    deployment was deleted): fail fast, never hang."""
+
+    def __init__(self, deployment: str, reason: str = ""):
+        self.deployment = deployment
+        super().__init__(
+            f"Deployment {deployment!r} has no available replicas. "
+            f"{reason}".strip())
+
+    def __reduce__(self):
+        return (DeploymentUnavailableError, (self.deployment,))

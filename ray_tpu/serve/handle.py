@@ -1,20 +1,52 @@
-"""DeploymentHandle + router.
+"""DeploymentHandle + router + admission control.
 
 Capability-equivalent to the reference's handle/router pair
 (reference: python/ray/serve/handle.py:827 DeploymentHandle,
 serve/_private/router.py:924 Router with
 PowerOfTwoChoicesReplicaScheduler :295 — two random replicas probed,
-lower queue length wins; local ongoing-request accounting)."""
+lower queue length wins), upgraded into the production front door:
+
+- admission control: per-deployment bounded queues
+  (max_ongoing_requests × replicas in flight, max_queued_requests
+  waiting); when full, requests shed with BackPressureError carrying a
+  Retry-After computed from the observed service rate. Priority lanes:
+  a higher-priority arrival preempts (sheds) the lowest-priority queued
+  request instead of being rejected itself.
+- SLO-aware power-of-two: replica choice scores local in-flight counts
+  PLUS the controller-published per-replica stats (global ongoing,
+  recent-latency/TTFT EWMA) so two handles/proxies sharing a replica
+  set converge instead of herding.
+- prefix affinity: prompts matching a registered/auto-captured prefix
+  route to the replica already holding that prefix's KV
+  (serve/llm.py register_prefix machinery), with load-based spillover.
+- fault recovery: a replica death mid-call is retried on a healthy
+  replica with jittered exponential backoff (idempotent, non-streaming
+  requests), excluding the dead replica; streaming calls surface a
+  typed ReplicaUnavailableError; no live replicas fails FAST with
+  DeploymentUnavailableError instead of hanging.
+"""
 
 from __future__ import annotations
 
 import contextvars
+import heapq
 import random
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import get as ray_get
+from ..core.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+)
+from .exceptions import (
+    BackPressureError,
+    DeploymentUnavailableError,
+    ReplicaUnavailableError,
+)
 
 # Propagated serve request id (Dapper-style): the proxy sets it for the
 # duration of routing; handle.remote() forwards it to the replica so
@@ -36,101 +68,641 @@ def current_request_id() -> Optional[str]:
     return _request_id.get()
 
 
-class Router:
-    def __init__(self, controller, deployment_name: str):
-        self._controller = controller
-        self._name = deployment_name
-        self._replicas: List[Any] = []
-        self._version = -1
-        self._lock = threading.Lock()
-        self._ongoing: Dict[Any, int] = {}
-        self._rng = random.Random()
+# -- overload / retry metrics ------------------------------------------------
 
-    def _refresh(self):
-        replicas, version = ray_get(
-            self._controller.get_replicas.remote(self._name))
-        with self._lock:
-            self._replicas = replicas
-            self._version = version
-            self._ongoing = {id(r): self._ongoing.get(id(r), 0)
-                             for r in replicas}
-            self._by_id = {id(r): r for r in replicas}
+_METRICS: Dict[str, Any] = {}
+_METRICS_LOCK = threading.Lock()
 
-    def pick(self):
-        """Power-of-two-choices on local ongoing counts."""
-        with self._lock:
-            replicas = list(self._replicas)
-        if not replicas:
-            self._refresh()
-            with self._lock:
-                replicas = list(self._replicas)
-            if not replicas:
-                raise RuntimeError(
-                    f"Deployment {self._name!r} has no replicas")
-        if len(replicas) == 1:
-            chosen = replicas[0]
-        else:
-            a, b = self._rng.sample(replicas, 2)
-            with self._lock:
-                chosen = (a if self._ongoing.get(id(a), 0)
-                          <= self._ongoing.get(id(b), 0) else b)
-        with self._lock:
-            self._ongoing[id(chosen)] = self._ongoing.get(id(chosen), 0) + 1
-        return chosen
 
-    def done(self, replica):
-        with self._lock:
-            if id(replica) in self._ongoing:
-                self._ongoing[id(replica)] = max(
-                    0, self._ongoing[id(replica)] - 1)
+def _overload_metrics() -> Dict[str, Any]:
+    """Shed counter + queue-depth gauge + retry counter (lazy, shared
+    across every router in the process; same init discipline as the
+    proxy/replica metric helpers)."""
+    with _METRICS_LOCK:
+        if not _METRICS:
+            try:
+                from ..util import metrics as m
 
-    def maybe_refresh(self):
+                shed = m.Counter(
+                    "ray_tpu_serve_shed_total",
+                    "Requests shed by serve admission control",
+                    tag_keys=("app", "priority"))
+                depth = m.Gauge(
+                    "ray_tpu_serve_queue_depth",
+                    "Admission queue depth per deployment",
+                    tag_keys=("app",))
+                retries = m.Counter(
+                    "ray_tpu_serve_retries_total",
+                    "Handle-side request replays after replica death",
+                    tag_keys=("app",))
+            except Exception:  # noqa: BLE001 - registry clash in tests
+                return {}
+            _METRICS.update(shed=shed, depth=depth, retries=retries)
+    return _METRICS
+
+
+def _record_shed(app: str, priority: int) -> None:
+    m = _overload_metrics()
+    if m:
         try:
-            self._refresh()
+            m["shed"].inc(tags={"app": app, "priority": str(priority)})
+        except Exception:  # noqa: BLE001 - metrics must not break serving
+            pass
+
+
+def _record_depth(app: str, depth: int) -> None:
+    m = _overload_metrics()
+    if m:
+        try:
+            m["depth"].set(depth, tags={"app": app})
         except Exception:  # noqa: BLE001
             pass
 
 
-class _ResponseFuture:
-    """Wraps the underlying ObjectRef; `.result()` / ray-get-able."""
-
-    def __init__(self, ref, router: Router, replica):
-        self._ref = ref
-        self._router = router
-        self._replica = replica
-        self._done = False
-
-    def result(self, timeout: Optional[float] = None):
+def _record_retry(app: str) -> None:
+    m = _overload_metrics()
+    if m:
         try:
-            return ray_get(self._ref, timeout=timeout)
-        finally:
-            self._mark()
+            m["retries"].inc(tags={"app": app})
+        except Exception:  # noqa: BLE001
+            pass
 
-    def _mark(self):
-        if not self._done:
-            self._done = True
-            self._router.done(self._replica)
+
+class AdmissionController:
+    """Per-deployment bounded-queue admission (reference: serve's
+    max_queued_requests + num_router_requests shedding).
+
+    Capacity = max_ongoing_requests × live replicas. Requests beyond
+    capacity queue (priority-ordered, FIFO within a priority) up to
+    max_queued_requests, then shed. A high-priority arrival into a full
+    queue preempts the lowest-priority queued request. The Retry-After
+    estimate comes from an EWMA of the observed completion rate: the
+    backlog ahead of a shed client divided by how fast it drains."""
+
+    def __init__(self, deployment: str):
+        self._name = deployment
+        self._lock = threading.Lock()
+        self._max_ongoing = 100
+        self._max_queued = -1       # -1 = unbounded (no shedding)
+        self._replicas = 1
+        self._ongoing = 0
+        self._queue: List[Tuple[int, int, Any]] = []  # (-prio, seq, fut)
+        self._seq = 0
+        self._rate = 0.0            # completions/s EWMA
+        self._last_done = 0.0
+        self.shed_total = 0
+
+    def configure(self, max_ongoing: int, max_queued: int,
+                  replicas: int) -> None:
+        with self._lock:
+            self._max_ongoing = max(1, int(max_ongoing))
+            self._max_queued = int(max_queued)
+            self._replicas = max(1, int(replicas))
+
+    def _capacity_locked(self) -> int:
+        return self._max_ongoing * self._replicas
+
+    def _retry_after_locked(self, extra_backlog: int = 1) -> float:
+        backlog = len(self._queue) + extra_backlog
+        if self._rate <= 1e-3:
+            # No completions observed yet: fall back to one "queue
+            # drain" at one request per capacity-slot-second.
+            return min(60.0, max(1.0, backlog /
+                                 max(1, self._capacity_locked())))
+        return min(60.0, max(0.5, backlog / self._rate))
+
+    def submit(self, fut: "_ResponseFuture", priority: int) -> None:
+        """Admit (dispatch now or enqueue) or shed. Sheds raise
+        BackPressureError synchronously; a preempted queued request is
+        failed with BackPressureError on its own future."""
+        dispatch_now = evicted = None
+        shed_err = None
+        with self._lock:
+            if self._ongoing < self._capacity_locked():
+                self._ongoing += 1
+                fut._slot_held = True
+                dispatch_now = fut
+            elif self._max_queued < 0 or len(self._queue) < self._max_queued:
+                self._seq += 1
+                heapq.heappush(self._queue, (-priority, self._seq, fut))
+            else:
+                # Full house: preempt the lowest-priority queued request
+                # (latest arrival among ties) if strictly lower priority
+                # than the newcomer; otherwise shed the newcomer.
+                victim_i = None
+                if self._queue:
+                    victim_i = max(
+                        range(len(self._queue)),
+                        key=lambda i: (self._queue[i][0],
+                                       self._queue[i][1]))
+                    if -self._queue[victim_i][0] >= priority:
+                        victim_i = None
+                if victim_i is not None:
+                    vprio, _, vfut = self._queue.pop(victim_i)
+                    heapq.heapify(self._queue)
+                    evicted = (vfut, BackPressureError(
+                        self._name, self._retry_after_locked(),
+                        priority=-vprio, queued=len(self._queue)))
+                    self._seq += 1
+                    heapq.heappush(self._queue,
+                                   (-priority, self._seq, fut))
+                else:
+                    shed_err = BackPressureError(
+                        self._name, self._retry_after_locked(),
+                        priority=priority, queued=len(self._queue))
+                self.shed_total += 1
+            depth = len(self._queue)
+        _record_depth(self._name, depth)
+        if evicted is not None:
+            vfut, verr = evicted
+            _record_shed(self._name, verr.priority)
+            vfut._shed(verr)
+        if shed_err is not None:
+            _record_shed(self._name, priority)
+            raise shed_err
+        if dispatch_now is not None:
+            dispatch_now._dispatch_now()
+
+    def release(self) -> None:
+        """One admitted request reached its final outcome: free the
+        slot and dispatch the highest-priority queued request."""
+        to_dispatch = None
+        now = time.monotonic()
+        with self._lock:
+            self._ongoing = max(0, self._ongoing - 1)
+            if self._last_done > 0:
+                dt = now - self._last_done
+                if dt > 1e-6:
+                    inst = 1.0 / dt
+                    self._rate = (inst if self._rate == 0.0
+                                  else 0.8 * self._rate + 0.2 * inst)
+            self._last_done = now
+            if self._queue and self._ongoing < self._capacity_locked():
+                _, _, fut = heapq.heappop(self._queue)
+                self._ongoing += 1
+                fut._slot_held = True
+                to_dispatch = fut
+            depth = len(self._queue)
+        _record_depth(self._name, depth)
+        if to_dispatch is not None:
+            to_dispatch._dispatch_now()
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"ongoing": self._ongoing,
+                    "queued": len(self._queue),
+                    "capacity": self._capacity_locked(),
+                    "shed_total": self.shed_total}
+
+
+def _looks_like_tokens(x: Any) -> bool:
+    """Token-id prompt heuristic for prefix-affinity routing: a
+    non-trivial list/tuple of ints (the LLM serving payload shape)."""
+    if not isinstance(x, (list, tuple)) or len(x) < 8:
+        return False
+    probe = x[:4] + x[-4:] if len(x) >= 8 else x
+    return all(isinstance(t, int) and not isinstance(t, bool)
+               for t in probe)
+
+
+class Router:
+    """Replica chooser for one deployment, shared by every handle
+    derived from the same original handle."""
+
+    REFRESH_INTERVAL_S = 0.5
+    # Prefix-affinity block lengths mirror the engine's
+    # auto_prefix_lens default (serve/llm.py) plus a short lane so test
+    # / CPU-sized prompts participate.
+    PREFIX_LENS = (16, 64, 128, 256, 512)
+    PREFIX_MIN_HITS = 3
+    MAX_PREFIX_PINS = 32
+
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._lock = threading.Lock()
+        self._replicas: List[Any] = []
+        self._by_key: Dict[str, Any] = {}
+        self._version = -1
+        self._ongoing: Dict[str, int] = {}
+        self._latency_ewma: Dict[str, float] = {}  # handle-side observed
+        self._stats: Dict[str, Dict[str, Any]] = {}  # controller-published
+        self._dead: Set[str] = set()
+        self._last_refresh = 0.0
+        self._rng = random.Random()
+        self._cfg: Dict[str, Any] = {}
+        self.admission = AdmissionController(deployment_name)
+        # prefix affinity: token-prefix tuple -> replica key
+        self._prefix_pins: "OrderedDict[tuple, str]" = OrderedDict()
+        self._prefix_counts: "OrderedDict[tuple, int]" = OrderedDict()
+
+    @staticmethod
+    def _key_of(replica: Any) -> str:
+        aid = getattr(replica, "_actor_id", None)
+        return aid.hex() if aid is not None else f"local:{id(replica)}"
+
+    # -- membership ------------------------------------------------------
+    def _refresh(self):
+        try:
+            state = ray_get(
+                self._controller.routing_state.remote(self._name))
+        except KeyError:
+            # Deployment deleted: fail fast, don't serve a stale set.
+            with self._lock:
+                self._replicas, self._by_key = [], {}
+                self._version = -1
+            raise DeploymentUnavailableError(
+                self._name, "deployment was deleted") from None
+        replicas = state["replicas"]
+        with self._lock:
+            self._replicas = replicas
+            self._by_key = {self._key_of(r): r for r in replicas}
+            self._version = state["version"]
+            self._ongoing = {k: self._ongoing.get(k, 0)
+                             for k in self._by_key}
+            self._stats = state.get("stats") or {}
+            self._cfg = state.get("config") or {}
+            # Keys gone from the live set are no longer "dead" — they
+            # were replaced; drop stale exclusions and pins.
+            self._dead &= set(self._by_key)
+            for pkey, rkey in list(self._prefix_pins.items()):
+                if rkey not in self._by_key or rkey in self._dead:
+                    del self._prefix_pins[pkey]
+        if self._cfg:
+            self.admission.configure(
+                self._cfg.get("max_ongoing_requests", 100),
+                self._cfg.get("max_queued_requests", -1),
+                len(replicas))
+
+    def maybe_refresh(self, force: bool = False):
+        now = time.monotonic()
+        if (not force and self._version >= 0
+                and now - self._last_refresh < self.REFRESH_INTERVAL_S):
+            return
+        try:
+            self._refresh()
+            self._last_refresh = now
+        except DeploymentUnavailableError:
+            raise
+        except Exception:  # noqa: BLE001 — transient controller hiccup
+            pass
+
+    def config(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._cfg)
+
+    # -- scoring ---------------------------------------------------------
+    def _score_locked(self, key: str) -> float:
+        """Queue-depth-aware load score: this handle's in-flight count
+        plus the replica's self-reported global ongoing (captures load
+        from OTHER handles/proxies sharing the replica set)."""
+        st = self._stats.get(key) or {}
+        return (self._ongoing.get(key, 0)
+                + float(st.get("ongoing", 0)))
+
+    def _ewma_locked(self, key: str) -> float:
+        """Recent-latency tiebreak: handle-side observed EWMA first
+        (freshest), replica-reported (TTFT for LLM replicas) second."""
+        own = self._latency_ewma.get(key)
+        if own is not None:
+            return own
+        st = self._stats.get(key) or {}
+        return float(st.get("ewma_ttft_s", st.get("ewma_latency_s", 0.0)))
+
+    # -- prefix affinity -------------------------------------------------
+    def _affinity_locked(self, prompt, pool: List[str]) -> Optional[str]:
+        """Longest pinned prefix matching `prompt` whose replica is in
+        `pool`, unless that replica is overloaded relative to the
+        least-loaded one (spillover: a hot prefix must not melt its
+        home replica while others idle)."""
+        for pkey in sorted(self._prefix_pins, key=len, reverse=True):
+            if len(prompt) <= len(pkey):
+                continue
+            if tuple(prompt[:len(pkey)]) != pkey:
+                continue
+            rkey = self._prefix_pins[pkey]
+            if rkey not in pool:
+                continue
+            self._prefix_pins.move_to_end(pkey)
+            best = min(self._score_locked(k) for k in pool)
+            if self._score_locked(rkey) > 2 * best + 2:
+                return None  # spill to power-of-two
+            return rkey
+        return None
+
+    def _note_prompt_locked(self, prompt, chosen: str) -> None:
+        """Auto-capture (mirrors the engine's auto_prefix_min_hits):
+        count block-length prompt prefixes; one that repeats
+        PREFIX_MIN_HITS times pins to the replica chosen for its last
+        occurrence — from then on the engine on that replica sees every
+        repeat and its own auto-registration fires."""
+        lens = [L for L in self.PREFIX_LENS if L < len(prompt)]
+        if not lens:
+            return
+        key = tuple(prompt[:lens[-1]])
+        for pkey in self._prefix_pins:
+            if len(pkey) <= len(key) and key[:len(pkey)] == pkey:
+                return  # already covered by a pin
+        n = self._prefix_counts.get(key, 0) + 1
+        if n >= self.PREFIX_MIN_HITS:
+            self._prefix_counts.pop(key, None)
+            self._prefix_pins[key] = chosen
+            while len(self._prefix_pins) > self.MAX_PREFIX_PINS:
+                self._prefix_pins.popitem(last=False)
+        else:
+            self._prefix_counts[key] = n
+            self._prefix_counts.move_to_end(key)
+            while len(self._prefix_counts) > 512:
+                self._prefix_counts.popitem(last=False)
+
+    def pin_prefix(self, tokens, replica_key: str) -> None:
+        """Explicit pin (register_prefix routed through this handle)."""
+        with self._lock:
+            self._prefix_pins[tuple(int(t) for t in tokens)] = replica_key
+            while len(self._prefix_pins) > self.MAX_PREFIX_PINS:
+                self._prefix_pins.popitem(last=False)
+
+    # -- choice ----------------------------------------------------------
+    def pick(self, prompt=None,
+             exclude: Optional[Set[str]] = None) -> Tuple[str, Any]:
+        """Choose a replica: prefix affinity first, then queue-depth +
+        recent-latency-aware power-of-two. Returns (key, handle).
+        Raises DeploymentUnavailableError when no live replica exists
+        even after a forced refresh."""
+        exclude = exclude or set()
+
+        def _pool() -> List[str]:
+            return [k for k in self._by_key
+                    if k not in self._dead and k not in exclude]
+
+        with self._lock:
+            pool = _pool()
+        if not pool:
+            self.maybe_refresh(force=True)
+            with self._lock:
+                pool = _pool()
+            if not pool:
+                raise DeploymentUnavailableError(
+                    self._name, "all replicas dead or excluded")
+        with self._lock:
+            pool = [k for k in pool if k in self._by_key]
+            if not pool:
+                raise DeploymentUnavailableError(
+                    self._name, "all replicas dead or excluded")
+            chosen = None
+            if prompt is not None:
+                chosen = self._affinity_locked(prompt, pool)
+            if chosen is None:
+                if len(pool) == 1:
+                    chosen = pool[0]
+                else:
+                    a, b = self._rng.sample(pool, 2)
+                    chosen = min(
+                        (a, b),
+                        key=lambda k: (self._score_locked(k),
+                                       self._ewma_locked(k)))
+                if prompt is not None:
+                    self._note_prompt_locked(prompt, chosen)
+            self._ongoing[chosen] = self._ongoing.get(chosen, 0) + 1
+            return chosen, self._by_key[chosen]
+
+    def done(self, key: str, latency_s: Optional[float] = None):
+        with self._lock:
+            if key in self._ongoing:
+                self._ongoing[key] = max(0, self._ongoing[key] - 1)
+            if latency_s is not None and latency_s >= 0:
+                prev = self._latency_ewma.get(key)
+                self._latency_ewma[key] = (
+                    latency_s if prev is None
+                    else 0.8 * prev + 0.2 * latency_s)
+
+    def on_replica_death(self, key: str) -> None:
+        """Exclude a replica observed dead until a refresh shows the
+        controller replaced it; unpin its prefixes."""
+        with self._lock:
+            self._dead.add(key)
+            for pkey, rkey in list(self._prefix_pins.items()):
+                if rkey == key:
+                    del self._prefix_pins[pkey]
+
+    def ongoing_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._ongoing)
+
+
+class _ResponseFuture:
+    """One logical request: dispatch → (retry on replica death) →
+    final outcome. `.result()` blocks on the outcome; the state machine
+    itself is driven by object-store readiness callbacks so replays
+    happen even if nobody is blocked in result() yet."""
+
+    def __init__(self, router: Router, method: str, args, kwargs,
+                 request_id: Optional[str], *, prompt=None,
+                 priority: int = 0, max_retries: int = 3,
+                 idempotent: bool = True):
+        self._router = router
+        self._method = method
+        self._args = args
+        self._kwargs = kwargs
+        self._request_id = request_id
+        self._prompt = prompt
+        self._priority = priority
+        self._max_retries = max_retries
+        self._idempotent = idempotent
+        self._lock = threading.Lock()
+        self._evt = threading.Event()
+        self._ref = None
+        self._replica_key: Optional[str] = None
+        self._error: Optional[BaseException] = None
+        self._finished = False
+        self._attempts = 0
+        self._excluded: Set[str] = set()
+        self._dispatch_t0 = 0.0
+        self._slot_held = False      # set by AdmissionController
+        self._released = False
+
+    # -- state machine ---------------------------------------------------
+    def _dispatch_now(self) -> None:
+        try:
+            key, replica = self._router.pick(
+                prompt=self._prompt, exclude=self._excluded)
+        except BaseException as e:  # noqa: BLE001
+            self._finish(error=e)
+            return
+        self._attempts += 1
+        self._dispatch_t0 = time.monotonic()
+        is_register = self._method == "register_prefix"
+        try:
+            ref = replica.handle_request.remote(
+                self._method, self._args, self._kwargs, self._request_id)
+        except BaseException as e:  # noqa: BLE001 — dead-on-dispatch
+            self._router.done(key)
+            if isinstance(e, (ActorDiedError, ActorUnavailableError)):
+                self._handle_death(key, e)
+            else:
+                self._finish(error=e)
+            return
+        with self._lock:
+            self._ref = ref
+            self._replica_key = key
+        if is_register and self._args:
+            tokens = self._args[0]
+            if _looks_like_tokens(tokens) or (
+                    isinstance(tokens, (list, tuple)) and tokens):
+                self._router.pin_prefix(tokens, key)
+        from ..core.runtime import global_runtime
+
+        global_runtime().store.on_ready(
+            ref.id(), lambda _oid, r=ref, k=key: self._on_ready(r, k))
+
+    def _on_ready(self, ref, key: str) -> None:
+        latency = time.monotonic() - self._dispatch_t0
+        err: Optional[BaseException] = None
+        if self._ref_is_error(ref):
+            try:
+                ray_get(ref, timeout=5)
+            except BaseException as e:  # noqa: BLE001
+                err = e
+        if isinstance(err, (ActorDiedError, ActorUnavailableError)):
+            self._router.done(key)
+            self._handle_death(key, err)
+            return
+        # Success or a user-level error: both are final; result()
+        # re-raises user errors through ray_get.
+        self._router.done(key, latency_s=latency)
+        self._finish(ref=ref)
+
+    @staticmethod
+    def _ref_is_error(ref) -> bool:
+        """Cheap error peek — avoids deserializing large successful
+        results on the replica's own thread."""
+        from ..core.runtime import global_runtime_or_none
+
+        rt = global_runtime_or_none()
+        if rt is None:
+            return True  # can't peek: classify via ray_get
+        store = rt.store
+        with store._lock:
+            obj = store._objects.get(ref.id())
+        return bool(obj is not None and getattr(obj, "is_error", False))
+
+    def _handle_death(self, key: str, exc: BaseException) -> None:
+        self._router.on_replica_death(key)
+        self._excluded.add(key)
+        if not self._idempotent or self._attempts > self._max_retries:
+            self._finish(error=ReplicaUnavailableError(
+                self._router._name, str(exc)[:200],
+                attempts=self._attempts, cause=exc))
+            return
+        _record_retry(self._router._name)
+        # Jittered exponential backoff before replaying on a healthy
+        # replica (reference: router retry policy).
+        delay = min(2.0, 0.05 * (2 ** (self._attempts - 1)))
+        delay *= 0.5 + random.random()
+        timer = threading.Timer(delay, self._redispatch)
+        timer.daemon = True
+        timer.start()
+
+    def _redispatch(self) -> None:
+        try:
+            self._router.maybe_refresh(force=True)
+        except BaseException as e:  # noqa: BLE001 — deployment deleted
+            self._finish(error=e)
+            return
+        self._dispatch_now()
+
+    def _shed(self, err: BackPressureError) -> None:
+        """Admission preempted this queued request (slot never held)."""
+        self._finish(error=err)
+
+    def _finish(self, ref=None, error: Optional[BaseException] = None
+                ) -> None:
+        with self._lock:
+            if self._finished:
+                return
+            self._finished = True
+            if ref is not None:
+                self._ref = ref
+            self._error = error
+        self._release_slot()
+        self._evt.set()
+
+    def _release_slot(self) -> None:
+        with self._lock:
+            if not self._slot_held or self._released:
+                return
+            self._released = True
+        self._router.admission.release()
+
+    # -- public ----------------------------------------------------------
+    def result(self, timeout: Optional[float] = None):
+        if not self._evt.wait(timeout):
+            raise GetTimeoutError(
+                f"Request to {self._router._name!r} not completed "
+                f"within {timeout}s "
+                f"(attempts={self._attempts})")
+        if self._error is not None:
+            raise self._error
+        return ray_get(self._ref, timeout=timeout)
 
     @property
     def ref(self):
         return self._ref
 
 
+class _StreamingResponse:
+    """Wraps a streaming ObjectRefGenerator; iteration yields the
+    underlying refs, converting replica death mid-stream into a typed
+    ReplicaUnavailableError (reference: streaming generators surfacing
+    replica failure after first token)."""
+
+    def __init__(self, gen, deployment: str):
+        self._gen = gen
+        self._deployment = deployment
+        self._yielded = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            raise
+        except (ActorDiedError, ActorUnavailableError) as e:
+            raise ReplicaUnavailableError(
+                self._deployment,
+                f"replica died mid-stream after {self._yielded} chunks",
+                attempts=1, cause=e) from e
+        self._yielded += 1
+        return ref
+
+    def __getattr__(self, name):
+        return getattr(self._gen, name)
+
+
 class DeploymentHandle:
     def __init__(self, controller, deployment_name: str,
-                 method_name: str = "__call__", stream: bool = False):
+                 method_name: str = "__call__", stream: bool = False,
+                 priority: int = 0, idempotent: bool = True):
         self._controller = controller
         self._name = deployment_name
         self._method = method_name
         self._stream = stream
+        self._priority = priority
+        self._idempotent = idempotent
         self._router = Router(controller, deployment_name)
 
     def options(self, *, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                priority: Optional[int] = None,
+                idempotent: Optional[bool] = None) -> "DeploymentHandle":
         h = DeploymentHandle(
             self._controller, self._name,
             method_name or self._method,
-            self._stream if stream is None else stream)
+            self._stream if stream is None else stream,
+            self._priority if priority is None else int(priority),
+            self._idempotent if idempotent is None else bool(idempotent))
         h._router = self._router
         return h
 
@@ -139,26 +711,43 @@ class DeploymentHandle:
             raise AttributeError(name)
         return self.options(method_name=name)
 
+    @staticmethod
+    def _extract_prompt(args, kwargs):
+        """Best-effort token-prompt extraction for prefix-affinity
+        routing: first positional arg or `prompt=` kwarg that looks
+        like a token-id list."""
+        cand = kwargs.get("prompt")
+        if cand is None and args:
+            cand = args[0]
+        return list(cand) if _looks_like_tokens(cand) else None
+
     def remote(self, *args, **kwargs):
-        self._router.maybe_refresh()
-        replica = self._router.pick()
+        try:
+            self._router.maybe_refresh()
+        except DeploymentUnavailableError:
+            raise
         method = "__call__" if self._method == "__call__" else self._method
         request_id = current_request_id()
+        prompt = self._extract_prompt(args, kwargs)
         if self._stream:
-            gen = replica.handle_request_streaming.options(
-                num_returns="streaming").remote(
-                    method, args, kwargs, request_id)
-            self._router.done(replica)
-            return gen
-        ref = replica.handle_request.remote(method, args, kwargs,
-                                            request_id)
-        fut = _ResponseFuture(ref, self._router, replica)
-        # Auto-release the slot when the result lands (async accounting).
-        from ..core.runtime import global_runtime
-
-        global_runtime().store.on_ready(ref.id(), lambda _oid: fut._mark())
+            key, replica = self._router.pick(prompt=prompt)
+            try:
+                gen = replica.handle_request_streaming.options(
+                    num_returns="streaming").remote(
+                        method, args, kwargs, request_id)
+            finally:
+                self._router.done(key)
+            return _StreamingResponse(gen, self._name)
+        cfg = self._router.config()
+        fut = _ResponseFuture(
+            self._router, method, args, kwargs, request_id,
+            prompt=prompt, priority=self._priority,
+            max_retries=int(cfg.get("max_request_retries", 3)),
+            idempotent=self._idempotent)
+        self._router.admission.submit(fut, self._priority)
         return fut
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self._controller, self._name, self._method, self._stream))
+                (self._controller, self._name, self._method, self._stream,
+                 self._priority, self._idempotent))

@@ -235,6 +235,10 @@ class LLMEngine:
         self.decode_ticks = 0
         self.tokens_out = 0
         self.finished: List[Dict[str, float]] = []
+        # Recent-TTFT EWMA: the router's SLO-aware tiebreak signal
+        # (cheap to read every stats poll, unlike the sorted
+        # percentiles in stats()).
+        self._ttft_ewma: Optional[float] = None
 
     def _mesh_ctx(self):
         """Ambient-mesh context for every device dispatch: the in-jit
@@ -454,6 +458,9 @@ class LLMEngine:
             "latency_s": req.latency_s,
             "new_tokens": new_tokens,
         })
+        self._ttft_ewma = (
+            req.ttft_s if self._ttft_ewma is None
+            else 0.8 * self._ttft_ewma + 0.2 * req.ttft_s)
 
     def _finish(self, idx: int) -> None:
         slot = self.slots[idx]
@@ -864,6 +871,17 @@ class LLMEngine:
             out["ttft_p50_s"] = ttfts[len(ttfts) // 2]
             out["ttft_p99_s"] = ttfts[min(len(ttfts) - 1,
                                           int(len(ttfts) * 0.99))]
+        if self._ttft_ewma is not None:
+            out["ewma_ttft_s"] = self._ttft_ewma
+        return out
+
+    def serve_routing_stats(self) -> Dict[str, Any]:
+        """Routing signals the serve Replica wrapper merges into its
+        stats() payload (controller polls it, routers use it for
+        queue-depth + TTFT-aware replica choice)."""
+        out: Dict[str, Any] = {"engine_queue": len(self.waiting)}
+        if self._ttft_ewma is not None:
+            out["ewma_ttft_s"] = self._ttft_ewma
         return out
 
 
@@ -909,3 +927,7 @@ class LLMServer:
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()
+
+    def serve_routing_stats(self) -> Dict[str, Any]:
+        """Merged into Replica.stats() → controller poll → router."""
+        return self.engine.serve_routing_stats()

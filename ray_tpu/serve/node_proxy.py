@@ -16,15 +16,56 @@ replica_scheduler locality-aware routing).
 from __future__ import annotations
 
 import contextlib
+import heapq
 import json
 import os
 import random
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 ROUTES_KEY = "serve/routes"
 PROXY_PREFIX = "serve/proxy/"
+
+
+class _RouteAdmission:
+    """Admission state for one route, owned by the proxy's event loop
+    (single-threaded — no locks). Mirrors the handle-side
+    AdmissionController: bounded priority queue, shed with Retry-After
+    from the observed completion rate, preemption of lower-priority
+    queued requests."""
+
+    def __init__(self):
+        self.ongoing = 0
+        self.queue: List[Tuple[int, int, Any]] = []  # (-prio, seq, fut)
+        self.seq = 0
+        self.rate = 0.0
+        self.last_done = 0.0
+        self.shed_total = 0
+
+    def retry_after(self) -> float:
+        backlog = len(self.queue) + 1
+        if self.rate <= 1e-3:
+            return min(60.0, max(1.0, float(backlog)))
+        return min(60.0, max(0.5, backlog / self.rate))
+
+    def note_done(self) -> None:
+        now = time.monotonic()
+        if self.last_done > 0:
+            dt = now - self.last_done
+            if dt > 1e-6:
+                inst = 1.0 / dt
+                self.rate = (inst if self.rate == 0.0
+                             else 0.8 * self.rate + 0.2 * inst)
+        self.last_done = now
+
+
+class _Preempted(Exception):
+    """A queued request was evicted by a higher-priority arrival."""
+
+    def __init__(self, retry_after_s: float):
+        self.retry_after_s = retry_after_s
+        super().__init__("preempted by higher-priority request")
 
 
 def publish_routes(control, table: Dict[str, Any]) -> None:
@@ -158,6 +199,9 @@ class NodeProxy:
         self._olock = threading.Lock()
         self._rng = random.Random()
         self._stop = threading.Event()
+        # Per-route admission state, touched only from the proxy's
+        # event loop.
+        self._admission: Dict[str, _RouteAdmission] = {}
 
         import asyncio
 
@@ -168,6 +212,13 @@ class NodeProxy:
         self.bound_port: int = 0
 
         async def handler(request: "web.Request"):
+            from ..util.tracing import (
+                format_traceparent,
+                parse_traceparent,
+                span as _span,
+                trace_context,
+            )
+
             path = request.path.strip("/")
             route = path.split("/", 1)[0]
             info = self._routes.get(route)
@@ -187,7 +238,14 @@ class NodeProxy:
             if not replicas:
                 return web.json_response(
                     {"error": "no replicas"}, status=503)
-            entry = self._pick(replicas)
+            cfg = info.get("config") or {}
+            try:
+                priority = int(request.headers.get(
+                    "X-Serve-Priority", "0"))
+            except ValueError:
+                priority = 0
+            tp = parse_traceparent(request.headers.get("traceparent"))
+            resp_headers: Dict[str, str] = {}
             if request.can_read_body:
                 try:
                     body = await request.json()
@@ -196,23 +254,124 @@ class NodeProxy:
                         errors="replace")
             else:
                 body = dict(request.query)
-            aid = entry[0]
-            with self._olock:
-                self._ongoing[aid] = self._ongoing.get(aid, 0) + 1
+            # -- admission (event-loop-owned, lock-free) ----------------
+            adm = self._admission.setdefault(route, _RouteAdmission())
+            cap = (int(cfg.get("max_ongoing_requests", 100))
+                   * max(1, len(replicas)))
+            maxq = int(cfg.get("max_queued_requests", -1))
+            loop = asyncio.get_event_loop()
+            if adm.ongoing >= cap:
+                if maxq >= 0 and len(adm.queue) >= maxq:
+                    victim_i = None
+                    if adm.queue:
+                        victim_i = max(
+                            range(len(adm.queue)),
+                            key=lambda i: (adm.queue[i][0],
+                                           adm.queue[i][1]))
+                        if -adm.queue[victim_i][0] >= priority:
+                            victim_i = None
+                    adm.shed_total += 1
+                    if victim_i is None:
+                        self._note_shed(route, priority)
+                        resp_headers["Retry-After"] = str(
+                            max(1, int(adm.retry_after() + 0.999)))
+                        return web.json_response(
+                            {"error": f"route {route!r} at capacity",
+                             "retry_after_s": adm.retry_after()},
+                            status=429, headers=resp_headers)
+                    vprio, _, vfut = adm.queue.pop(victim_i)
+                    heapq.heapify(adm.queue)
+                    self._note_shed(route, -vprio)
+                    if not vfut.done():
+                        vfut.set_exception(
+                            _Preempted(adm.retry_after()))
+                adm.seq += 1
+                fut = loop.create_future()
+                heapq.heappush(adm.queue, (-priority, adm.seq, fut))
+                try:
+                    # The releaser charges the slot BEFORE waking us, so
+                    # a request arriving between wake and resume can't
+                    # steal it.
+                    await fut
+                except _Preempted as p:
+                    resp_headers["Retry-After"] = str(
+                        max(1, int(p.retry_after_s + 0.999)))
+                    return web.json_response(
+                        {"error": f"route {route!r} at capacity "
+                                  "(preempted by higher priority)",
+                         "retry_after_s": p.retry_after_s},
+                        status=429, headers=resp_headers)
+            else:
+                adm.ongoing += 1
+            # -- dispatch with replica-death retry ----------------------
+            stats = info.get("stats") or {}
+            max_retries = int(cfg.get("max_request_retries", 3))
+            failed: Set[str] = set()
+            attempts = 0
             try:
-                result = await asyncio.get_event_loop().run_in_executor(
-                    None, self._call.call, entry, "handle_request",
-                    ("__call__", (body,), {}), {})
-            except Exception as e:  # noqa: BLE001
-                return web.json_response({"error": str(e)}, status=500)
+                with trace_context(
+                        tp["trace_id"] if tp else None,
+                        tp["parent_span_id"] if tp else None):
+                    with _span(f"node_proxy:{route}",
+                               "serve_proxy") as span_id:
+                        out_tp = format_traceparent(span_id=span_id)
+                        if out_tp:
+                            resp_headers["traceparent"] = out_tp
+                        while True:
+                            pool = [r for r in replicas
+                                    if r[0] not in failed]
+                            if not pool:
+                                return web.json_response(
+                                    {"error": "no replicas available "
+                                              f"for {route!r}"},
+                                    status=503, headers=resp_headers)
+                            entry = self._pick(pool, stats)
+                            aid = entry[0]
+                            with self._olock:
+                                self._ongoing[aid] = \
+                                    self._ongoing.get(aid, 0) + 1
+                            try:
+                                result = await loop.run_in_executor(
+                                    None, self._call.call, entry,
+                                    "handle_request",
+                                    ("__call__", (body,), {}), {})
+                                break
+                            except Exception as e:  # noqa: BLE001
+                                retryable = not str(e).startswith(
+                                    "replica error")
+                                attempts += 1
+                                if (not retryable
+                                        or attempts > max_retries):
+                                    code = 500 if not retryable else 503
+                                    return web.json_response(
+                                        {"error": str(e)[:500]},
+                                        status=code,
+                                        headers=resp_headers)
+                                failed.add(aid)
+                                self._note_retry(route)
+                                delay = min(
+                                    2.0, 0.05 * (2 ** (attempts - 1)))
+                                await asyncio.sleep(
+                                    delay * (0.5 + self._rng.random()))
+                            finally:
+                                with self._olock:
+                                    self._ongoing[aid] = max(
+                                        0, self._ongoing.get(aid, 1) - 1)
             finally:
-                with self._olock:
-                    self._ongoing[aid] = max(
-                        0, self._ongoing.get(aid, 1) - 1)
+                adm.ongoing = max(0, adm.ongoing - 1)
+                adm.note_done()
+                while adm.queue and adm.ongoing < cap:
+                    _, _, nxt = heapq.heappop(adm.queue)
+                    if not nxt.done():
+                        adm.ongoing += 1  # slot charged to the waiter
+                        nxt.set_result(True)
+                        break
             if isinstance(result, (dict, list, int, float, str,
                                    type(None))):
-                return web.json_response({"result": result})
-            return web.Response(body=repr(result).encode())
+                return web.json_response({"result": result},
+                                         headers=resp_headers)
+            return web.Response(body=repr(result).encode(),
+                                headers=resp_headers)
 
         async def health(_request):
             return web.Response(text="ok")
@@ -250,17 +409,39 @@ class NodeProxy:
         self._poller.start()
 
     # -- routing ---------------------------------------------------------
-    def _pick(self, replicas: List[tuple]) -> tuple:
+    def _pick(self, replicas: List[tuple],
+              stats: Optional[Dict[str, Any]] = None) -> tuple:
         """Locality-preferring power-of-two: same-node replicas first
-        (ICI/host-local latency), fall back to the whole set."""
+        (ICI/host-local latency), fall back to the whole set. Scored on
+        local in-flight + the controller-published per-replica ongoing
+        (load from other proxies/handles), tie-broken on the replica's
+        recent latency/TTFT EWMA."""
         local = [r for r in replicas if r[1] == self.node_id]
         pool = local or list(replicas)
         if len(pool) == 1:
             return pool[0]
+        stats = stats or {}
+
+        def score(r):
+            st = stats.get(r[0]) or {}
+            with self._olock:
+                mine = self._ongoing.get(r[0], 0)
+            return (mine + float(st.get("ongoing", 0)),
+                    float(st.get("ewma_ttft_s",
+                                 st.get("ewma_latency_s", 0.0))))
+
         a, b = self._rng.sample(pool, 2)
-        with self._olock:
-            return (a if self._ongoing.get(a[0], 0)
-                    <= self._ongoing.get(b[0], 0) else b)
+        return min((a, b), key=score)
+
+    def _note_shed(self, route: str, priority: int) -> None:
+        from .handle import _record_shed
+
+        _record_shed(route, priority)
+
+    def _note_retry(self, route: str) -> None:
+        from .handle import _record_retry
+
+        _record_retry(route)
 
     def _poll_routes(self) -> None:
         while not self._stop.wait(0.5):

@@ -17,6 +17,11 @@ import uuid
 from typing import Any, Dict, Optional
 
 from ..observability import get_recorder
+from .exceptions import (
+    BackPressureError,
+    DeploymentUnavailableError,
+    ReplicaUnavailableError,
+)
 from .handle import reset_request_id, set_request_id
 
 _METRICS = {}
@@ -103,7 +108,12 @@ class HttpProxy:
         from ..observability import event_stats as _estats
         from ..util import metrics as _metrics
 
-        from ..util.tracing import span as _span
+        from ..util.tracing import (
+            format_traceparent,
+            parse_traceparent,
+            span as _span,
+            trace_context,
+        )
 
         async def handler(request: "web.Request"):
             t0 = _time.perf_counter()
@@ -125,20 +135,63 @@ class HttpProxy:
                     payload = (await request.read()).decode()
             else:
                 payload = dict(request.query)
+            # W3C trace interop: join the caller's trace so the proxy
+            # and replica spans parent under the external span; echo a
+            # traceparent back so the caller can link our trace.
+            tp = parse_traceparent(request.headers.get("traceparent"))
+            # Priority lane (admission control): higher sheds last.
+            try:
+                priority = int(request.headers.get(
+                    "X-Serve-Priority", "0"))
+            except ValueError:
+                priority = 0
+            if priority:
+                handle = handle.options(priority=priority)
             loop = asyncio.get_running_loop()
             get_recorder().record("serve", "request_received",
                                   application=name, request_id=request_id)
             status = "200"
+            resp_headers = {"X-Request-Id": request_id}
             token = set_request_id(request_id)
             try:
                 # Proxy-side span; handle.remote() runs in this
                 # coroutine context, so the request id (contextvar) and
                 # the trace both propagate to the chosen replica.
-                with _span(f"proxy:{name}", "serve_proxy",
-                           request_id=request_id):
-                    fut = handle.remote(payload)
+                with trace_context(
+                        tp["trace_id"] if tp else None,
+                        tp["parent_span_id"] if tp else None):
+                    with _span(f"proxy:{name}", "serve_proxy",
+                               request_id=request_id) as span_id:
+                        out_tp = format_traceparent(span_id=span_id)
+                        if out_tp:
+                            resp_headers["traceparent"] = out_tp
+                        fut = handle.remote(payload)
                 result = await loop.run_in_executor(
                     None, lambda: fut.result(timeout=30))
+            except BackPressureError as e:
+                status = "429"
+                _request_metrics(_metrics, name, "429",
+                                 _time.perf_counter() - t0)
+                get_recorder().record(
+                    "serve", "request_shed", application=name,
+                    request_id=request_id, priority=priority,
+                    retry_after_s=e.retry_after_s)
+                resp_headers["Retry-After"] = e.retry_after_header
+                return web.json_response(
+                    {"error": str(e)[:500],
+                     "retry_after_s": e.retry_after_s},
+                    status=429, headers=resp_headers)
+            except (DeploymentUnavailableError,
+                    ReplicaUnavailableError) as e:
+                status = "503"
+                _request_metrics(_metrics, name, "503",
+                                 _time.perf_counter() - t0)
+                get_recorder().record(
+                    "serve", "request_failed", application=name,
+                    request_id=request_id, error=str(e)[:200])
+                return web.json_response(
+                    {"error": str(e)[:500]}, status=503,
+                    headers=resp_headers)
             except BaseException as e:  # noqa: BLE001
                 status = "500"
                 _request_metrics(_metrics, name, "500",
@@ -148,7 +201,7 @@ class HttpProxy:
                     request_id=request_id, error=str(e)[:200])
                 return web.json_response(
                     {"error": str(e)[:500]}, status=500,
-                    headers={"X-Request-Id": request_id})
+                    headers=resp_headers)
             finally:
                 reset_request_id(token)
                 # Asyncio-handler latency into the serve_proxy loop's
@@ -163,12 +216,10 @@ class HttpProxy:
                              _time.perf_counter() - t0)
             try:
                 return web.json_response({"result": result},
-                                         headers={"X-Request-Id":
-                                                  request_id})
+                                         headers=resp_headers)
             except TypeError:
                 return web.json_response({"result": str(result)},
-                                         headers={"X-Request-Id":
-                                                  request_id})
+                                         headers=resp_headers)
 
         async def health(_request):
             return web.json_response({"status": "ok"})

@@ -70,14 +70,92 @@ class Replica:
         self._ongoing = 0
         self._lock = threading.Lock()
         self._total = 0
+        self._latency_ewma: Optional[float] = None
+        # Scriptable fault points (chaos tests / bench):
+        #   stall_s            — sleep before handling each request
+        #   crash_on_request   — die (as if the process was killed) on
+        #                        the next N requests
+        #   health_probe_delay_s — sleep inside health_check()
+        self._faults: Dict[str, Any] = {}
 
     def reconfigure(self, user_config: Dict[str, Any]):
         if not self._is_function and hasattr(self._callable, "reconfigure"):
             self._callable.reconfigure(user_config)
 
+    def inject_fault(self, kind: str, value: Any = True) -> None:
+        """Arm a deterministic serve-plane fault
+        (_private/fault_injection.py drives this)."""
+        with self._lock:
+            if value in (None, False, 0):
+                self._faults.pop(kind, None)
+            else:
+                self._faults[kind] = value
+
+    def _maybe_fault(self):
+        with self._lock:
+            stall = self._faults.get("stall_s")
+            crash = self._faults.get("crash_on_request", 0)
+            if crash:
+                crash = int(crash) - 1
+                if crash <= 0:
+                    self._faults.pop("crash_on_request", None)
+                else:
+                    self._faults["crash_on_request"] = crash
+                do_crash = True
+            else:
+                do_crash = False
+        if stall:
+            _time.sleep(float(stall))
+        if do_crash:
+            self._crash()
+
+    def _crash(self):
+        """Die as if the hosting process was killed: kill our own actor
+        (mailbox drains with ActorDiedError for queued callers) and
+        raise ActorDiedError for THIS call — _wrap() passes it through
+        unwrapped, so the handle sees exactly what a real process death
+        looks like and exercises its retry path."""
+        from ..core.exceptions import ActorDiedError
+        from ..core.ids import ActorID
+        from ..core.runtime import RuntimeContext, global_runtime_or_none
+
+        aid = None
+        try:
+            aid = RuntimeContext().get_actor_id()
+        except Exception:  # noqa: BLE001 - not in an actor (direct call)
+            pass
+        rt = global_runtime_or_none()
+        if aid is not None and rt is not None:
+            try:
+                rt.kill_actor(ActorID(bytes.fromhex(aid)),
+                              no_restart=True)
+            except Exception:  # noqa: BLE001 - worker-process fallback
+                import os
+                os._exit(1)
+        raise ActorDiedError(
+            aid or "?", "Replica crashed (injected fault).")
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {"ongoing": self._ongoing, "total": self._total}
+            out = {"ongoing": self._ongoing, "total": self._total}
+            if self._latency_ewma is not None:
+                out["ewma_latency_s"] = self._latency_ewma
+        # LLM replicas publish TTFT percentiles; surface the EWMA the
+        # router's tiebreak wants without forcing every user callable
+        # to implement it.
+        if not self._is_function and hasattr(
+                self._callable, "serve_routing_stats"):
+            try:
+                out.update(self._callable.serve_routing_stats())
+            except Exception:  # noqa: BLE001 - stats must not break serving
+                pass
+        return out
+
+    def _note_latency(self, latency_s: float) -> None:
+        with self._lock:
+            self._latency_ewma = (
+                latency_s if self._latency_ewma is None
+                else 0.8 * self._latency_ewma + 0.2 * latency_s)
 
     def _enter(self):
         with self._lock:
@@ -96,6 +174,7 @@ class Replica:
         t0 = _time.perf_counter()
         status = "200"
         try:
+            self._maybe_fault()
             # Replica-side span carries the proxy's propagated request
             # id — proxy → replica → handler link into one trace.
             with span(f"replica:{self._deployment or 'deployment'}"
@@ -115,6 +194,7 @@ class Replica:
             raise
         finally:
             self._exit()
+            self._note_latency(_time.perf_counter() - t0)
             _replica_metrics(self._deployment or "?", status,
                              _time.perf_counter() - t0)
             from ..observability import event_stats as _estats
@@ -128,6 +208,7 @@ class Replica:
                                  request_id: Optional[str] = None):
         self._enter()
         try:
+            self._maybe_fault()
             fn = (self._callable if self._is_function
                   else getattr(self._callable, method_name))
             yield from fn(*args, **kwargs)
@@ -135,6 +216,10 @@ class Replica:
             self._exit()
 
     def health_check(self) -> bool:
+        with self._lock:
+            probe_delay = self._faults.get("health_probe_delay_s")
+        if probe_delay:
+            _time.sleep(float(probe_delay))
         if not self._is_function and hasattr(
                 self._callable, "check_health"):
             self._callable.check_health()

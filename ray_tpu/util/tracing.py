@@ -345,6 +345,53 @@ def flush_otlp() -> int:
     return exporter.flush() if exporter is not None else 0
 
 
+def parse_traceparent(header: Optional[str]
+                      ) -> Optional[Dict[str, str]]:
+    """Parse a W3C `traceparent` header (version 00:
+    `00-<32hex trace-id>-<16hex parent-id>-<2hex flags>`) into
+    {"trace_id", "parent_span_id", "flags"}, or None if malformed /
+    all-zero ids (the spec says treat those as absent). Internal ids
+    are 16-hex, so the incoming 32-hex trace id is kept verbatim —
+    trace_context() and the OTLP exporter both handle either width."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, parent_id, flags = parts[0], parts[1], \
+        parts[2], parts[3]
+    if version == "ff" or len(version) != 2:
+        return None
+    if len(trace_id) != 32 or len(parent_id) != 16:
+        return None
+    try:
+        int(version, 16)
+        int(trace_id, 16)
+        int(parent_id, 16)
+        int(flags[:2], 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None
+    return {"trace_id": trace_id, "parent_span_id": parent_id,
+            "flags": flags[:2]}
+
+
+def format_traceparent(trace_id: Optional[str] = None,
+                       span_id: Optional[str] = None,
+                       sampled: bool = True) -> Optional[str]:
+    """Format the current (or given) trace/span as a W3C `traceparent`
+    for outbound propagation / response echo. Internal 16-hex ids are
+    left-padded to the wire widths. → None when there is no trace."""
+    trace_id = trace_id or _current_trace.get()
+    span_id = span_id or _current_span.get()
+    if not trace_id or not span_id:
+        return None
+    t = str(trace_id).rjust(32, "0")[-32:]
+    s = str(span_id).rjust(16, "0")[-16:]
+    return f"00-{t}-{s}-{'01' if sampled else '00'}"
+
+
 def current_span_id() -> Optional[str]:
     return _current_span.get()
 

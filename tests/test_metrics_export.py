@@ -46,20 +46,21 @@ class TestExportBundle:
         actually registers (guards against silent renames on either
         side): node gauges from the dashboard sampler, task-lifecycle
         series from observability.taskstats, serve series from the
-        serve data plane (proxy ingress + replica), loop-handler
-        gauges from observability.event_stats."""
+        serve data plane (proxy ingress + replica + handle admission),
+        loop-handler gauges from observability.event_stats."""
         import inspect
 
         from ray_tpu.dashboard import server as srv
         from ray_tpu.dashboard.metrics_export import DEFAULT_PANELS
         from ray_tpu.observability import event_stats, taskstats
-        from ray_tpu.serve import proxy, replica
+        from ray_tpu.serve import handle, proxy, replica
 
         publish_src = "\n".join([
             inspect.getsource(srv.MetricsHistory._publish_prom),
             inspect.getsource(taskstats),
             inspect.getsource(proxy),
             inspect.getsource(replica),
+            inspect.getsource(handle),
             inspect.getsource(event_stats),
         ])
         for _title, expr, _unit in DEFAULT_PANELS:
@@ -72,10 +73,11 @@ class TestExportBundle:
         import inspect
 
         from ray_tpu.dashboard.metrics_export import DEFAULT_PANELS
-        from ray_tpu.serve import proxy, replica
+        from ray_tpu.serve import handle, proxy, replica
 
         serve_src = (inspect.getsource(proxy)
-                     + inspect.getsource(replica))
+                     + inspect.getsource(replica)
+                     + inspect.getsource(handle))
         for _t, expr, _u in DEFAULT_PANELS:
             m = re.search(r"(serve_[a-z_]+?)(_bucket)?\[", expr)
             if m:

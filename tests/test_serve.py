@@ -322,3 +322,215 @@ def test_http_proxy_records_metrics(ray_start):
         assert 'application="mx"' in text
     finally:
         serve.shutdown()
+
+
+# -- admission control / load shedding / SLO routing / fault recovery ----
+
+
+def test_admission_shed_429_retry_after(serve):
+    """Overload past max_ongoing × replicas + max_queued sheds with
+    HTTP 429 + a Retry-After the client can honor to then succeed."""
+    import json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=1)
+    def slow(payload):
+        time.sleep(0.4)
+        return {"ok": payload}
+
+    serve.run(slow.bind(), name="slow", http=True, http_port=18232)
+    codes, retry_afters = [], []
+    lock = threading.Lock()
+
+    def hit():
+        req = urllib.request.Request(
+            "http://127.0.0.1:18232/slow",
+            data=json.dumps({"x": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                with lock:
+                    codes.append(resp.status)
+        except urllib.error.HTTPError as e:
+            with lock:
+                codes.append(e.code)
+                if e.code == 429:
+                    retry_afters.append(e.headers.get("Retry-After"))
+
+    threads = [threading.Thread(target=hit) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert codes.count(200) >= 2  # admitted requests complete
+    assert 429 in codes  # overload shed, not queued forever
+    assert retry_afters and all(
+        ra is not None and int(ra) >= 1 for ra in retry_afters)
+    # Honoring Retry-After: capacity has drained, request succeeds.
+    time.sleep(max(int(r) for r in retry_afters))
+    req = urllib.request.Request(
+        "http://127.0.0.1:18232/slow",
+        data=json.dumps({"x": 2}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+
+
+def test_priority_lane_preempts_low_priority(serve):
+    """A high-priority arrival into a full queue preempts a queued
+    low-priority request (which sheds with BackPressureError) and is
+    served before remaining low-priority work."""
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=2)
+    class Ordered:
+        def __init__(self):
+            self.seen = []
+
+        def __call__(self, tag):
+            time.sleep(0.3)
+            self.seen.append(tag)
+            return tag
+
+        def order(self):
+            return list(self.seen)
+
+    handle = serve.run(Ordered.bind())
+    futs = {}
+    futs["a"] = handle.remote("a")          # occupies the one slot
+    time.sleep(0.05)                        # a dispatches first
+    futs["b"] = handle.remote("b")          # queued (prio 0)
+    futs["c"] = handle.remote("c")          # queued (prio 0) — victim
+    hi = handle.options(priority=5)
+    futs["d"] = hi.remote("d")              # preempts c, jumps queue
+    with pytest.raises(serve.BackPressureError):
+        futs["c"].result(timeout=10)
+    assert futs["a"].result(timeout=10) == "a"
+    assert futs["d"].result(timeout=10) == "d"
+    assert futs["b"].result(timeout=10) == "b"
+    order = handle.order.remote().result(timeout=10)
+    assert order.index("d") < order.index("b"), order
+    # Shed request never leaked an admission slot.
+    snap = handle._router.admission.snapshot()
+    assert snap["ongoing"] == 0 and snap["queued"] == 0
+
+
+def test_prefix_affinity_routing(serve):
+    """Prompts matching a registered prefix route to the replica that
+    holds its KV; unrelated prompts still spread."""
+    from ray_tpu.core.runtime import RuntimeContext
+
+    @serve.deployment(num_replicas=3)
+    class Gen:
+        def register_prefix(self, tokens):
+            return RuntimeContext().get_actor_id()
+
+        def generate(self, prompt):
+            return RuntimeContext().get_actor_id()
+
+    handle = serve.run(Gen.bind())
+    prefix = list(range(64))
+    pinned = handle.register_prefix.remote(prefix).result(timeout=10)
+    gen = handle.options(method_name="generate")
+    routed = {gen.remote(prefix + [1000 + i]).result(timeout=10)
+              for i in range(8)}
+    assert routed == {pinned}
+    others = {gen.remote(list(range(700 + 97 * i, 800 + 97 * i)))
+              .result(timeout=10) for i in range(12)}
+    assert len(others) >= 2  # non-matching prompts aren't pinned
+
+
+def test_health_check_driven_restart(serve):
+    """A replica whose health probe overruns the timeout twice is
+    killed and replaced by the controller."""
+    import ray_tpu
+    from ray_tpu._private.fault_injection import ServeFaultInjector
+
+    @serve.deployment(num_replicas=2, health_check_period_s=0.3,
+                      health_check_timeout_s=0.4)
+    def echo(x):
+        return x
+
+    handle = serve.run(echo.bind())
+    controller = handle._controller
+    replicas, _ = ray_tpu.get(controller.get_replicas.remote("echo"))
+    victim_id = replicas[0]._actor_id.hex()
+    ServeFaultInjector(controller).slow_health_probe(
+        "echo", 5.0, replica_index=0)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        now, _ = ray_tpu.get(controller.get_replicas.remote("echo"))
+        ids = {r._actor_id.hex() for r in now}
+        if victim_id not in ids and len(ids) == 2:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("unhealthy replica was not replaced")
+    assert handle.remote("still up").result(timeout=10) == "still up"
+
+
+def test_traceparent_roundtrip_proxy_to_replica(serve):
+    """W3C traceparent interop: an external trace id joins the proxy →
+    replica span chain and is echoed on the response."""
+    import json
+    import urllib.request
+
+    from ray_tpu.util.tracing import clear_tracing, setup_tracing
+
+    spans = []
+    setup_tracing(spans.append)
+    try:
+        @serve.deployment
+        def traced(payload):
+            return {"ok": True}
+
+        serve.run(traced.bind(), name="traced", http=True,
+                  http_port=18233)
+        trace_id = "af7651916cd43dd8448eb211c80319c6"
+        parent = "b7ad6b7169203331"
+        req = urllib.request.Request(
+            "http://127.0.0.1:18233/traced",
+            data=json.dumps({}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": f"00-{trace_id}-{parent}-01"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            echoed = resp.headers.get("traceparent")
+        assert echoed and echoed.startswith(f"00-{trace_id}-")
+        assert echoed.split("-")[2] != parent  # proxy minted its span
+        by_trace = [s for s in spans
+                    if (s.get("args") or {}).get("trace_id") == trace_id]
+        cats = {s["cat"] for s in by_trace}
+        assert "serve_proxy" in cats, cats
+        assert "serve_replica" in cats, cats
+    finally:
+        clear_tracing()
+
+
+def test_shed_metrics_exported(serve):
+    """ray_tpu_serve_shed_total / queue_depth / retries_total appear in
+    the Prometheus exposition once shedding happens."""
+    from ray_tpu.util import metrics
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=0)
+    def busy(x):
+        time.sleep(0.3)
+        return x
+
+    handle = serve.run(busy.bind())
+    shed = 0
+    futs = []
+    for i in range(4):
+        try:
+            futs.append(handle.remote(i))
+        except serve.BackPressureError:
+            shed += 1
+    for f in futs:
+        f.result(timeout=10)
+    assert shed >= 1
+    text = metrics.prometheus_text()
+    assert "ray_tpu_serve_shed_total" in text
+    assert "ray_tpu_serve_queue_depth" in text

@@ -111,3 +111,74 @@ def test_serve_across_daemons_with_kill(serve_cluster):
 
     out = _get(f"http://{survivor_addr}/who")
     assert out["result"]["node"] == "daemon-1"
+
+
+def test_node_proxy_admission_shed_429(serve_cluster):
+    """The per-daemon proxies enforce the deployment's admission config
+    from the published route table: overload sheds with 429 +
+    Retry-After while admitted requests complete."""
+    import threading
+    import urllib.error
+
+    from ray_tpu import serve
+    from ray_tpu.serve.node_proxy import list_proxies
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=0,
+                      ray_actor_options={"num_cpus": 0.4})
+    def crawl(_payload=None):
+        time.sleep(0.5)
+        return {"ok": True}
+
+    serve.run(crawl.bind(), name="crawl", route_prefix="crawl",
+              http=False)
+    cli = serve_cluster.control_client()
+    try:
+        proxies = list_proxies(cli)
+    finally:
+        cli.close()
+    assert proxies, "no node proxies registered"
+    addr = sorted(proxies.values())[0]
+    # Route table (with admission config) must reach the proxy poller.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            out = _get(f"http://{addr}/crawl")
+            if "result" in out:
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    else:
+        pytest.fail("route never became servable through node proxy")
+
+    codes, retry_afters = [], []
+    lock = threading.Lock()
+
+    def hit():
+        req = urllib.request.Request(
+            f"http://{addr}/crawl",
+            data=json.dumps({}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                with lock:
+                    codes.append(resp.status)
+        except urllib.error.HTTPError as e:
+            with lock:
+                codes.append(e.code)
+                if e.code == 429:
+                    retry_afters.append(e.headers.get("Retry-After"))
+        except Exception:
+            with lock:
+                codes.append(-1)
+
+    threads = [threading.Thread(target=hit) for _ in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert codes.count(200) >= 1, codes
+    assert 429 in codes, codes
+    assert retry_afters and all(
+        ra is not None and int(ra) >= 1 for ra in retry_afters)
