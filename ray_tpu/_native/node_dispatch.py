@@ -1,0 +1,266 @@
+"""ctypes binding for the native dispatch plane (libnode_dispatch.so).
+
+The C loop (src/node_dispatch.cc) owns the node daemon's dispatch
+socket: accept, framing, JSON admission headers, check-and-charge
+against the resource ledger, spillback refusal and reply writing — all
+off the GIL. Python drains a bounded ready queue (``next_event``) for
+the work that needs policy: worker placement and task hand-off.
+
+Every call here releases the GIL for its native duration (plain
+``ctypes.CDLL``), which is the entire point: N drainer threads plus
+the C loop thread overlap with task execution instead of serializing
+on the interpreter lock.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .handle_guard import HandleGuard
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__),
+                         "libnode_dispatch.so")
+
+_lib = None
+
+# Event flags (mirrors node_dispatch.cc).
+FLAG_PRECHARGED = 1
+FLAG_JSON = 2
+
+EV_MESSAGE = 0
+EV_CLOSED = 1
+
+
+def available() -> bool:
+    return os.path.exists(_LIB_PATH)
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.nd_create.restype = ctypes.c_void_p
+    lib.nd_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                              ctypes.c_ulonglong, ctypes.c_int]
+    lib.nd_port.restype = ctypes.c_int
+    lib.nd_port.argtypes = [ctypes.c_void_p]
+    lib.nd_start.restype = ctypes.c_int
+    lib.nd_start.argtypes = [ctypes.c_void_p]
+    lib.nd_next.restype = ctypes.c_int
+    lib.nd_next.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_ulonglong), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_uint), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_ulonglong)]
+    lib.nd_free.restype = None
+    lib.nd_free.argtypes = [ctypes.c_void_p]
+    lib.nd_send.restype = ctypes.c_int
+    lib.nd_send.argtypes = [ctypes.c_void_p, ctypes.c_ulonglong,
+                            ctypes.c_char_p, ctypes.c_ulonglong]
+    for name in ("nd_set_node_id", "nd_set_load_tail"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.nd_set_peers_json.restype = ctypes.c_int
+    lib.nd_set_peers_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.nd_set_ping_native.restype = None
+    lib.nd_set_ping_native.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    for name in ("nd_ledger_set", "nd_ledger_try_charge",
+                 "nd_ledger_charge", "nd_ledger_release"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.nd_ledger_get.restype = ctypes.c_int
+    lib.nd_ledger_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int]
+    lib.nd_stats_json.restype = ctypes.c_int
+    lib.nd_stats_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int]
+    lib.nd_spilled.restype = ctypes.c_ulonglong
+    lib.nd_spilled.argtypes = [ctypes.c_void_p]
+    lib.nd_stop.restype = None
+    lib.nd_stop.argtypes = [ctypes.c_void_p]
+    lib.nd_destroy.restype = None
+    lib.nd_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class NativeDispatch:
+    """One native dispatch server (the daemon's dispatch socket).
+
+    Handle lifecycle follows the ``_native`` convention: every native
+    call holds ``_guard.read()`` and ``destroy()`` nulls the handle
+    under ``_guard.write()``, so a call racing teardown sees a clean
+    "already destroyed" (no-op / timeout / StopIteration) instead of
+    dereferencing freed memory in C.
+    """
+
+    def __init__(self, port: int = 0, bind_all: bool = False,
+                 max_frame: int = 1 << 31, queue_cap: int = 1024):
+        import json as _json
+
+        self._json = _json
+        self._lib = _load_lib()
+        self._h = self._lib.nd_create(port, 1 if bind_all else 0,
+                                      max_frame, queue_cap)
+        if not self._h:
+            raise OSError("nd_create failed (port in use?)")
+        self._guard = HandleGuard()
+        self.port = self._lib.nd_port(self._h)
+        self._stopped = False
+
+    def start(self) -> None:
+        with self._guard.read():
+            if self._h:
+                self._lib.nd_start(self._h)
+
+    # -- ready queue ----------------------------------------------------
+    def next_event(self, timeout_ms: int = 200
+                   ) -> Optional[Tuple[int, int, int, Optional[bytes]]]:
+        """Block up to timeout_ms for one event.
+
+        Returns (conn_id, kind, flags, body) — body is None for
+        EV_CLOSED — or None on timeout. Raises StopIteration once the
+        server has been stopped (drainers use it to exit)."""
+        conn_id = ctypes.c_ulonglong()
+        kind = ctypes.c_int()
+        flags = ctypes.c_uint()
+        data = ctypes.c_void_p()
+        length = ctypes.c_ulonglong()
+        with self._guard.read():
+            if not self._h:
+                raise StopIteration
+            rc = self._lib.nd_next(self._h, timeout_ms,
+                                   ctypes.byref(conn_id),
+                                   ctypes.byref(kind),
+                                   ctypes.byref(flags), ctypes.byref(data),
+                                   ctypes.byref(length))
+            if rc == 0:
+                return None
+            if rc < 0:
+                raise StopIteration
+            body = None
+            if kind.value == EV_MESSAGE:
+                try:
+                    body = ctypes.string_at(data.value, length.value)
+                finally:
+                    self._lib.nd_free(data)
+        return conn_id.value, kind.value, flags.value, body
+
+    def send(self, conn_id: int, payload: bytes) -> bool:
+        """Queue one reply frame; False if the server is stopped (a
+        vanished conn is silently dropped, as with a closed socket)."""
+        with self._guard.read():
+            if not self._h:
+                return False
+            return self._lib.nd_send(self._h, conn_id, payload,
+                                     len(payload)) == 0
+
+    # -- Python-pushed reply context -------------------------------------
+    def set_node_id(self, node_id: str) -> None:
+        with self._guard.read():
+            if self._h:
+                self._lib.nd_set_node_id(self._h, node_id.encode())
+
+    def set_load_report(self, report: Dict) -> None:
+        """Push the heartbeat load report for natively-written pong and
+        refusal replies. "available" is stripped — the C side splices
+        in its own (always-fresh) ledger availability."""
+        rest = {k: v for k, v in report.items() if k != "available"}
+        tail = self._json.dumps(rest)[1:]  # drop the leading '{'
+        with self._guard.read():
+            if self._h:
+                self._lib.nd_set_load_tail(self._h, tail.encode())
+
+    def set_peers(self, peers: List[Dict]) -> None:
+        """Push the spill-target digest: [{"id", "queued", "headroom",
+        "avail": {...}}] — pre-filtered to alive, non-draining peers."""
+        data = self._json.dumps(peers).encode()
+        with self._guard.read():
+            if self._h:
+                self._lib.nd_set_peers_json(self._h, data)
+
+    def set_ping_native(self, enabled: bool) -> None:
+        with self._guard.read():
+            if self._h:
+                self._lib.nd_set_ping_native(self._h, 1 if enabled else 0)
+
+    # -- resource ledger -------------------------------------------------
+    def ledger_set(self, amounts: Dict[str, float]) -> None:
+        data = self._json.dumps(amounts).encode()
+        with self._guard.read():
+            if self._h:
+                self._lib.nd_ledger_set(self._h, data)
+
+    def ledger_try_charge(self, amounts: Dict[str, float]) -> bool:
+        data = self._json.dumps(amounts).encode()
+        with self._guard.read():
+            if not self._h:
+                return False
+            return self._lib.nd_ledger_try_charge(self._h, data) == 1
+
+    def ledger_charge(self, amounts: Dict[str, float]) -> None:
+        data = self._json.dumps(amounts).encode()
+        with self._guard.read():
+            if not self._h:
+                return
+            rc = self._lib.nd_ledger_charge(self._h, data)
+        if rc == -1:
+            # Same contract as ResourceSet.subtract.
+            raise ValueError("resource would go negative")
+
+    def ledger_release(self, amounts: Dict[str, float]) -> None:
+        data = self._json.dumps(amounts).encode()
+        with self._guard.read():
+            if self._h:
+                self._lib.nd_ledger_release(self._h, data)
+
+    def ledger_available(self) -> Dict[str, float]:
+        buf = ctypes.create_string_buffer(1 << 16)
+        with self._guard.read():
+            if not self._h:
+                return {}
+            rc = self._lib.nd_ledger_get(self._h, buf, len(buf))
+        if rc < 0:
+            return {}
+        return self._json.loads(buf.value.decode())
+
+    # -- stats -----------------------------------------------------------
+    def spilled(self) -> int:
+        with self._guard.read():
+            if not self._h:
+                return 0
+            return int(self._lib.nd_spilled(self._h))
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        buf = ctypes.create_string_buffer(1 << 18)
+        with self._guard.read():
+            if not self._h:
+                return {}
+            rc = self._lib.nd_stats_json(self._h, buf, len(buf))
+        if rc < 0:
+            return {}
+        return self._json.loads(buf.value.decode())
+
+    # -- lifecycle -------------------------------------------------------
+    def stop(self) -> None:
+        with self._guard.read():
+            if self._stopped or not self._h:
+                return
+            self._stopped = True
+            self._lib.nd_stop(self._h)
+
+    def destroy(self) -> None:
+        """Free the handle. Only after stop() AND after every drainer
+        thread has exited next_event (in-flight readers are drained by
+        the guard's write side; stop() above unblocks any thread parked
+        in nd_next so the drain is bounded by one timeout)."""
+        self.stop()
+        with self._guard.write():
+            if self._h:
+                self._lib.nd_destroy(self._h)
+                self._h = None

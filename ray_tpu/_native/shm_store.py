@@ -60,6 +60,9 @@ def _load_lib():
     lib.rts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.rts_reclaim_dead_pins.restype = ctypes.c_int64
     lib.rts_reclaim_dead_pins.argtypes = [ctypes.c_void_p]
+    lib.rts_pin_stats_json.restype = ctypes.c_int
+    lib.rts_pin_stats_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int]
     for name in ("rts_used", "rts_capacity", "rts_num_objects"):
         fn = getattr(lib, name)
         fn.restype = ctypes.c_uint64
@@ -193,6 +196,22 @@ class ShmStore:
         call it eagerly when a worker death is observed."""
         with self._guard.read():
             return int(lib().rts_reclaim_dead_pins(self._h()))
+
+    def pin_stats(self) -> dict:
+        """Per-pid arena holdings from the slot table's pin records:
+        {"pin_overflows": N, "pids": {"<pid>": {"pinned_bytes": ...,
+        "pinned_objects": ..., "pins": ..., "creating_bytes": ...,
+        "creating_objects": ...}}}. Each pinner is charged the full
+        alloc_size of every object it pins (pins are shares of whole
+        objects); SLOT_CREATED spans are charged to their writer."""
+        import json
+
+        with self._guard.read():
+            buf = ctypes.create_string_buffer(1 << 20)
+            rc = lib().rts_pin_stats_json(self._h(), buf, len(buf))
+        if rc < 0:
+            return {"pin_overflows": 0, "pids": {}}
+        return json.loads(buf.value.decode())
 
     def delete(self, object_id: bytes) -> bool:
         with self._guard.read():

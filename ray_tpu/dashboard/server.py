@@ -749,6 +749,7 @@ class DashboardServer:
             if rt is not None:
                 nodes = {}
                 transfer = {}
+                shm_pins = {}
                 for node in rt.scheduler.nodes():
                     load = getattr(node, "last_load", None)
                     if load and load.get("event_stats"):
@@ -758,8 +759,14 @@ class DashboardServer:
                     # bytes_out and relay hit counts.
                     if load and load.get("transfer"):
                         transfer[node.node_id] = load["transfer"]
+                    # Per-pid/per-task arena holdings from each node's
+                    # slot-table pin records (who is holding the object
+                    # store, labeled daemon/actor/task/worker).
+                    if load and load.get("shm_pins"):
+                        shm_pins[node.node_id] = load["shm_pins"]
                 out["nodes"] = nodes
                 out["transfer"] = transfer
+                out["shm_pins"] = shm_pins
                 plane = getattr(rt, "remote_plane", None)
                 if plane is not None:
                     with contextlib.suppress(Exception):

@@ -50,6 +50,24 @@ _SKIP_NODES = _FUNC_NODES + (ast.ClassDef, ast.Lambda)
 SEND_FUNCS = {"send_msg", "_send_json", "send_json", "request",
               "call", "run_task", "send"}
 
+# Dispatch-socket ops with a second implementation in the native C++
+# front end (src/node_dispatch.cc) — outside the Python tree this pass
+# indexes, recorded statically the same way the C++ client's *_xlang
+# senders are baselined, so the inventory stays honest about which
+# plane can answer when RAY_TPU_NATIVE_DISPATCH=1. Keyed by message
+# type; the value names what the native loop does with it.
+NATIVE_PLANE = {
+    "ping": "handled off-GIL (pong written natively unless tracing)",
+    "pong": "sent natively with live ledger availability spliced in",
+    "task": "admission header parsed; check-and-charge + spillback "
+            "refusal natively, body handed to Python on admission",
+    "result": "spillback refusals written natively (retry_at from "
+              "the pushed peer digest)",
+    "gen_ack": "framed natively, routed to the owning stream's "
+               "drainer without per-handler timing",
+    "pull_complete": "framed natively without per-handler timing",
+}
+
 
 @dataclass
 class MsgLit:
@@ -656,7 +674,7 @@ def check(idx: ProjectIndex):
         provided = set()
         for lit in senders.get(t, []):
             provided |= lit.fields
-        inventory.append({
+        row = {
             "type": t,
             "senders": [f"{lit.path}:{lit.line}"
                         for lit in senders.get(t, [])],
@@ -664,5 +682,8 @@ def check(idx: ProjectIndex):
                          for p, ln in handled.get(t, [])],
             "fields": sorted(provided - {"type"}),
             "reads": sorted(reads.get(t, {})),
-        })
+        }
+        if t in NATIVE_PLANE:
+            row["native"] = NATIVE_PLANE[t]
+        inventory.append(row)
     return findings, inventory

@@ -136,10 +136,14 @@ def to_sarif(findings: list, rule_docs: Dict[str, str]) -> str:
 
 
 def inventory_table(inventory: Iterable[dict]) -> str:
-    """The wire-protocol inventory as a markdown table."""
+    """The wire-protocol inventory as a markdown table. The "native
+    plane" column marks dispatch-socket ops the C++ front end
+    (src/node_dispatch.cc) also implements — the AST pass can't see
+    C++, so they're recorded statically (protocol.NATIVE_PLANE), like
+    the baselined *_xlang C++-client senders."""
     lines = [
-        "| type | senders | handlers | fields |",
-        "|------|---------|----------|--------|",
+        "| type | senders | handlers | fields | native plane |",
+        "|------|---------|----------|--------|--------------|",
     ]
     for row in inventory:
         def sites(key):
@@ -153,5 +157,6 @@ def inventory_table(inventory: Iterable[dict]) -> str:
         lines.append(
             f"| `{row['type']}` | {sites('senders')} | "
             f"{sites('handlers')} | "
-            f"{', '.join(row['fields']) or '—'} |")
+            f"{', '.join(row['fields']) or '—'} | "
+            f"{row.get('native', '—')} |")
     return "\n".join(lines)

@@ -10,15 +10,64 @@ actor submit-queue contract, direct_actor_task_submitter.h).
 from __future__ import annotations
 
 import contextlib
+import json
 import socket
+import struct
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
-from ..core.worker_proc import WorkerCrashedError, recv_msg, send_msg
+from ..core.worker_proc import WorkerCrashedError, _recv_exact
+
+_LEN = struct.Struct("!Q")
+_HLEN = struct.Struct("<I")
 
 
 class NodeDispatchError(RuntimeError):
     """The daemon (or the network to it) failed mid-request."""
+
+
+def hybrid_frame(msg: Dict[str, Any]) -> bytes:
+    """Frame a dispatch message as `0x01 | u32-LE header len | JSON
+    admission header | cloudpickle body`. The header duplicates only
+    what the daemon's NATIVE front end (src/node_dispatch.cc) needs to
+    admit or refuse off the GIL — type, task id, resources, spillback
+    eligibility — while the body stays an opaque pickle the Python
+    policy plane decodes. The pure-Python daemon accepts the same frame
+    (it skips the header), so one client speaks to both dispatch
+    planes."""
+    import cloudpickle
+
+    body = cloudpickle.dumps(msg)
+    header: Dict[str, Any] = {"type": msg.get("type")}
+    tid = msg.get("task_id")
+    if isinstance(tid, bytes) and tid:
+        header["tid"] = tid.hex()
+    res = msg.get("resources")
+    if res:
+        header["res"] = res
+    if msg.get("spillable"):
+        header["spillable"] = True
+    exclude = msg.get("spill_exclude")
+    if exclude:
+        header["exclude"] = sorted(exclude)
+    h = json.dumps(header).encode()
+    payload_len = 1 + _HLEN.size + len(h) + len(body)
+    return b"".join((_LEN.pack(payload_len), b"\x01",
+                     _HLEN.pack(len(h)), h, body))
+
+
+def recv_reply(sock: socket.socket) -> Dict[str, Any]:
+    """Read one reply frame. The native dispatch plane writes its
+    replies (pong, spillback refusal) as JSON; the Python plane writes
+    pickle — sniff by first byte, like the daemon's _recv_any."""
+    header = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(header)
+    payload = _recv_exact(sock, n)
+    if payload[:1] == b"{":
+        return json.loads(payload.decode())
+    import pickle
+
+    return pickle.loads(payload)
 
 
 class NodeConn:
@@ -43,7 +92,8 @@ class NodeConn:
         (generator backpressure); the daemon relays it to the worker."""
         try:
             with self._send_lock:
-                send_msg(self.sock, {"type": "gen_ack", "n": n})
+                self.sock.sendall(hybrid_frame({"type": "gen_ack",
+                                                "n": n}))
         except OSError:
             self.alive = False
 
@@ -51,9 +101,9 @@ class NodeConn:
                 on_stream: Optional[Callable] = None) -> Dict[str, Any]:
         try:
             with self._send_lock:
-                send_msg(self.sock, msg)
+                self.sock.sendall(hybrid_frame(msg))
             while True:
-                reply = recv_msg(self.sock)
+                reply = recv_reply(self.sock)
                 if reply.get("type") == "gen_item":
                     if on_stream is not None:
                         try:

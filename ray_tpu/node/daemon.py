@@ -34,10 +34,12 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import errno
 import json
 import logging
 import os
 import socket
+import struct
 import threading
 import time
 import uuid
@@ -61,6 +63,46 @@ def _load_modules():
 
     return cc, TransferClient, TransferServer, ShmStore, WorkerPool, \
         WorkerCrashedError, recv_msg, send_msg
+
+
+_FRAME = struct.Struct("!Q")
+
+
+class _NdConn:
+    """Socket-like reply adapter for one native-loop connection.
+
+    Handlers write framed replies through sendall() exactly as they do
+    to a real socket; the adapter strips the 8-byte length prefix (the
+    C loop re-adds its own) and queues each payload on the loop's
+    outbox. Raises OSError once the connection closed — the same
+    signal handlers already treat as a dead driver."""
+
+    __slots__ = ("_nd", "conn_id", "closed", "_buf")
+
+    def __init__(self, nd, conn_id: int):
+        self._nd = nd
+        self.conn_id = conn_id
+        self.closed = False
+        self._buf = b""
+
+    def sendall(self, data) -> None:
+        if self.closed:
+            raise OSError(errno.EPIPE, "native dispatch conn closed")
+        self._buf += bytes(data)
+        while len(self._buf) >= _FRAME.size:
+            (n,) = _FRAME.unpack_from(self._buf)
+            if len(self._buf) < _FRAME.size + n:
+                return
+            payload = self._buf[_FRAME.size:_FRAME.size + n]
+            self._buf = self._buf[_FRAME.size + n:]
+            if not self._nd.send(self.conn_id, payload):
+                self.closed = True
+                raise OSError(errno.EPIPE, "native dispatch stopped")
+
+    def close(self) -> None:
+        # The C loop owns the fd; marking closed is enough to fail
+        # later writes from a handler that outlived the conn.
+        self.closed = True
 
 
 class NodeDaemon:
@@ -145,12 +187,19 @@ class NodeDaemon:
         total.update(resources or {})
         self.total = ResourceSet(total)
         self._avail_lock = threading.Lock()
-        self.available = self.total
+        # Availability ledger: lives HERE (under _avail_lock) on the
+        # pure-Python plane, or inside the native dispatch loop (which
+        # does check-and-charge admission off the GIL) when it owns the
+        # socket. All mutations go through _ledger_* so the two planes
+        # cannot drift.
+        self._avail_py = self.total
         self._queued = 0          # tasks waiting for a worker
         self._running = 0
         self._spilled = 0         # spillable tasks refused (stats)
         self._host_stats_cache: Dict[str, Any] = {}
         self._host_stats_ts = -1e9
+        self._shm_attr_cache: Dict[str, Any] = {}
+        self._shm_attr_ts = -1e9
         # Peer view for spillback redirection (control-plane node table +
         # heartbeat loads), refreshed lazily on refusal.
         self._peer_view: List[dict] = []
@@ -199,12 +248,49 @@ class NodeDaemon:
         self._renv_cache = URICache(
             os.path.join(session_dir, "runtime_env_cache"))
 
-        # Dispatch server.
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("" if bind_all else "127.0.0.1", dispatch_port))
-        self._listener.listen(128)
-        self.dispatch_port = self._listener.getsockname()[1]
+        # Dispatch server: the native epoll front end
+        # (src/node_dispatch.cc) owns the socket when the library is
+        # built — accept, framing, admission and refusal run off the
+        # GIL, and Python drains a bounded ready queue for placement
+        # policy + task hand-off. RAY_TPU_NATIVE_DISPATCH=0 forces the
+        # pure-Python thread-per-connection fallback (parity-testable).
+        self._nd = None
+        self._listener = None
+        # Native-plane conn-scoped state, keyed by the loop's conn id:
+        # reply adapters, actors created over a conn, live stream
+        # relays (for gen_ack credit routing).
+        self._nd_state_lock = threading.Lock()
+        self._nd_conns: Dict[int, Any] = {}
+        self._nd_conn_actors: Dict[int, list] = {}
+        self._nd_streams: Dict[int, Any] = {}
+        self._drainer_lock = threading.Lock()
+        self._drainers: List[threading.Thread] = []
+        self._drainer_busy = 0
+        self._drainer_cap = max(64, 4 * n_workers)
+        if os.environ.get("RAY_TPU_NATIVE_DISPATCH", "1") != "0":
+            try:
+                from ray_tpu._native import node_dispatch as _ndmod
+
+                if _ndmod.available():
+                    self._nd = _ndmod.NativeDispatch(
+                        dispatch_port, bind_all=bind_all)
+            except Exception:  # noqa: BLE001 — stale .so etc.
+                logger.exception(
+                    "native dispatch unavailable; Python fallback")
+                self._nd = None
+        if self._nd is not None:
+            self.dispatch_port = self._nd.port
+            self._nd.set_node_id(self.node_id)
+            self._nd.ledger_set(self.total.to_dict())
+        else:
+            self._listener = socket.socket(socket.AF_INET,
+                                           socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEADDR, 1)
+            self._listener.bind(("" if bind_all else "127.0.0.1",
+                                 dispatch_port))
+            self._listener.listen(128)
+            self.dispatch_port = self._listener.getsockname()[1]
 
         # Control plane registration + heartbeats.
         host, _, port = control_address.partition(":")
@@ -242,9 +328,22 @@ class NodeDaemon:
         self._hb_thread = threading.Thread(
             target=self._hb_loop, daemon=True, name="node-heartbeat")
         self._hb_thread.start()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name="node-accept")
-        self._accept_thread.start()
+        self._accept_thread = None
+        if self._nd is not None:
+            with contextlib.suppress(Exception):
+                self._nd.set_load_report(self._load_report())
+            self._push_nd_peers()
+            self._nd.start()
+            # Drainer pool: grows on demand (a long-running call — an
+            # actor method, a streamed task — occupies its drainer for
+            # the call's duration, like the fallback's per-conn
+            # threads), bounded by _drainer_cap.
+            for _ in range(2):
+                self._spawn_drainer()
+        else:
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, daemon=True, name="node-accept")
+            self._accept_thread.start()
         logger.info("node daemon %s up: dispatch=%s:%d object=%d cpus=%s",
                     self.node_id, advertise_host, self.dispatch_port,
                     self.transfer.port, num_cpus)
@@ -268,6 +367,48 @@ class NodeDaemon:
             self._host_stats_ts = now
         return self._host_stats_cache
 
+    def _shm_attribution(self) -> dict:
+        """Per-process arena holdings from the slot table's pin records,
+        labeled with what each pid is doing here (the daemon itself, an
+        actor, a running task, an idle pool worker, or an external
+        pinner). Rides the heartbeat into /api/event_stats and
+        `ray_tpu status --verbose` so "who is holding the object store"
+        is answerable without a debugger. Sampled on the host-stats
+        cadence — the 64K-slot scan under the arena mutex is cheap but
+        not heartbeat-cheap."""
+        now = time.monotonic()
+        if now - self._shm_attr_ts < 5.0:
+            return self._shm_attr_cache
+        try:
+            raw = self.shm.pin_stats()
+        except Exception:  # noqa: BLE001 — stats must not kill heartbeats
+            return self._shm_attr_cache
+        labels: Dict[int, str] = {os.getpid(): "daemon"}
+        with contextlib.suppress(Exception):
+            for w in self.pool.workers():
+                labels.setdefault(w.pid, "worker")
+        with self._actors_lock:
+            for aid, entry in self._actors.items():
+                labels[entry[0].pid] = f"actor:{aid.hex()}"
+        with self._running_lock:
+            for _seq, _retriable, worker, label in \
+                    self._running_tasks.values():
+                labels[worker.pid] = f"task:{label}"
+        holders = []
+        for pid_s, rec in raw.get("pids", {}).items():
+            pid = int(pid_s)
+            holders.append({"pid": pid,
+                            "label": labels.get(pid, "external"),
+                            **rec})
+        holders.sort(key=lambda h: -(h.get("pinned_bytes", 0)
+                                     + h.get("creating_bytes", 0)))
+        self._shm_attr_cache = {
+            "pin_overflows": raw.get("pin_overflows", 0),
+            "holders": holders,
+        }
+        self._shm_attr_ts = now
+        return self._shm_attr_cache
+
     def _load_report(self) -> dict:
         host = self._host_stats()
         from ray_tpu.observability import event_stats as _estats
@@ -286,16 +427,34 @@ class NodeDaemon:
             transfer.update(self.transfer.stats())
         except Exception:  # noqa: BLE001 — stats must not kill heartbeats
             pass
+        # Native-plane merges: the C loop times its own handlers (ping,
+        # admission, refusal, reply write) off the GIL; surfacing them
+        # as one more event-stats loop puts the native front end in the
+        # head's /api/event_stats and the ray_tpu_loop_handler_*
+        # series. Refusals it wrote natively count toward spilled.
+        spilled_native = 0
+        if self._nd is not None:
+            try:
+                nstats = self._nd.stats()
+                if nstats:
+                    estats = dict(estats)
+                    estats["node_dispatch_native"] = nstats
+                spilled_native = self._nd.spilled()
+            except Exception:  # noqa: BLE001
+                pass
+        avail = self.available.to_dict()  # property: takes its own lock
+        shm_pins = self._shm_attribution()  # takes actor/running locks
         with self._avail_lock:
             return {
-                "available": self.available.to_dict(),
+                "available": avail,
                 "total": self.total.to_dict(),
                 "queued": self._queued,
                 "running": self._running,
-                "spilled": self._spilled,
+                "spilled": self._spilled + spilled_native,
                 "host": host,
                 "event_stats": estats,
                 "transfer": transfer,
+                "shm_pins": shm_pins,
             }
 
     def _recommend_spill_target(self, res, exclude) -> Optional[str]:
@@ -348,10 +507,23 @@ class NodeDaemon:
 
     def _hb_loop(self):
         fenced = False
+        tick = 0
         while not self._stop.wait(self._hb_interval):
+            tick += 1
             try:
+                report = self._load_report()
+                if self._nd is not None:
+                    # Keep the C loop's natively-written replies (pong,
+                    # refusal) carrying a fresh load report and a fresh
+                    # retry_at digest — a refusal must be able to name
+                    # a peer as soon as one is registered (the digest
+                    # rides the cached control-plane view, so this is
+                    # at most one list_nodes per refresh window).
+                    with contextlib.suppress(Exception):
+                        self._nd.set_load_report(report)
+                    self._push_nd_peers()
                 self.control.heartbeat(
-                    self.node_id, load=json.dumps(self._load_report()))
+                    self.node_id, load=json.dumps(report))
                 self._hb_failures = 0
                 fenced = False
             except Exception:  # noqa: BLE001 — control plane hiccup
@@ -369,9 +541,44 @@ class NodeDaemon:
                                      daemon=True,
                                      name="fence-partition").start()
 
-    def _charge(self, res) -> None:
+    # -- resource ledger (one implementation, two backing stores) -------
+    @property
+    def available(self):
+        from ray_tpu.core.resources import ResourceSet
+
+        if self._nd is not None:
+            return ResourceSet(self._nd.ledger_available())
         with self._avail_lock:
-            self.available = self.available.subtract(res)
+            return self._avail_py
+
+    def _ledger_try_charge(self, res) -> bool:
+        if self._nd is not None:
+            return self._nd.ledger_try_charge(res.to_dict())
+        with self._avail_lock:
+            if not res.fits(self._avail_py):
+                return False
+            self._avail_py = self._avail_py.subtract(res)
+        return True
+
+    def _ledger_charge(self, res) -> None:
+        """Unconditional charge; raises ValueError when it would drive
+        availability negative (ResourceSet.subtract's contract)."""
+        if self._nd is not None:
+            self._nd.ledger_charge(res.to_dict())
+            return
+        with self._avail_lock:
+            self._avail_py = self._avail_py.subtract(res)
+
+    def _ledger_release(self, res) -> None:
+        if self._nd is not None:
+            self._nd.ledger_release(res.to_dict())
+            return
+        with self._avail_lock:
+            self._avail_py = self._avail_py.add(res)
+
+    def _charge(self, res) -> None:
+        self._ledger_charge(res)
+        with self._avail_lock:
             self._running += 1
 
     def _try_charge(self, res) -> bool:
@@ -379,16 +586,15 @@ class NodeDaemon:
         reply, never an exception — a driver's stale view can race a
         kill's release, and unwinding the conn thread on that race
         reads as a daemon death driver-side."""
+        if not self._ledger_try_charge(res):
+            return False
         with self._avail_lock:
-            if not res.fits(self.available):
-                return False
-            self.available = self.available.subtract(res)
             self._running += 1
         return True
 
     def _uncharge(self, res) -> None:
+        self._ledger_release(res)
         with self._avail_lock:
-            self.available = self.available.add(res)
             self._running -= 1
 
     # -- object fetching -------------------------------------------------
@@ -440,6 +646,152 @@ class NodeDaemon:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="node-conn").start()
 
+    # -- native dispatch plane (src/node_dispatch.cc) --------------------
+    def _push_nd_peers(self) -> None:
+        """Refresh the native loop's spill-target digest from the
+        control plane's node table — pre-filtered (alive, non-draining,
+        not self) and pre-scored (queued, normalized headroom, avail)
+        so the C side's refusal path can pick retry_at without ever
+        taking the GIL. Shares _recommend_spill_target's cached view so
+        pushing every heartbeat doesn't stampede list_nodes."""
+        if self._nd is None:
+            return
+        from ray_tpu.core.resources import ResourceSet
+
+        now = time.monotonic()
+        with self._peer_view_lock:
+            if now - self._peer_view_ts > 0.5 * self._hb_interval + 0.1:
+                try:
+                    self._peer_view = self.control.list_nodes()
+                    self._peer_view_ts = now
+                except Exception:  # noqa: BLE001 — control plane away
+                    return
+            peers = list(self._peer_view)
+        digest = []
+        for n in peers:
+            if not n.get("alive") or n.get("draining"):
+                continue
+            nid = n.get("node_id")
+            if not nid or nid == self.node_id:
+                continue
+            try:
+                load = json.loads(n["load"]) if n.get("load") else {}
+            except (ValueError, TypeError):
+                continue
+            avail = load.get("available") or {}
+            total = ResourceSet(load.get("total") or {}).to_dict()
+            fracs = [avail.get(k, 0.0) / v
+                     for k, v in total.items() if v > 0]
+            headroom = sum(fracs) / len(fracs) if fracs else 0.0
+            digest.append({"id": nid,
+                           "queued": int(load.get("queued") or 0),
+                           "headroom": headroom,
+                           "avail": avail})
+        with contextlib.suppress(Exception):
+            self._nd.set_peers(digest)
+
+    def _spawn_drainer(self) -> None:
+        with self._drainer_lock:
+            if (self._stop.is_set()
+                    or len(self._drainers) >= self._drainer_cap):
+                return
+            t = threading.Thread(
+                target=self._drain_loop, daemon=True,
+                name=f"nd-drain-{len(self._drainers)}")
+            self._drainers.append(t)
+        t.start()
+
+    def _drain_loop(self) -> None:
+        """One ready-queue consumer. The pool grows on demand: a
+        long-running hand-off (an actor method, a streamed task)
+        occupies its drainer for the call's duration — exactly like the
+        fallback's per-connection threads — so when every drainer is
+        busy one more is spawned, up to _drainer_cap."""
+        from ray_tpu._native import node_dispatch as _ndmod
+
+        while not self._stop.is_set():
+            try:
+                ev = self._nd.next_event(timeout_ms=200)
+            except StopIteration:
+                return
+            if ev is None:
+                continue
+            conn_id, kind, flags, body = ev
+            if kind == _ndmod.EV_CLOSED:
+                self._nd_conn_closed(conn_id)
+                continue
+            with self._drainer_lock:
+                self._drainer_busy += 1
+                idle = len(self._drainers) - self._drainer_busy
+            try:
+                if idle <= 0:
+                    self._spawn_drainer()
+                self._nd_handle(conn_id, flags, body)
+            finally:
+                with self._drainer_lock:
+                    self._drainer_busy -= 1
+
+    def _nd_handle(self, conn_id: int, flags: int, body: bytes) -> None:
+        import pickle
+
+        from ray_tpu._native import node_dispatch as _ndmod
+        from ray_tpu.observability import event_stats as _estats
+
+        if flags & _ndmod.FLAG_JSON:
+            msg = json.loads(body.decode())
+            msg["_json"] = True
+        elif body[:1] == b"\x01":
+            (hlen,) = struct.unpack_from("<I", body, 1)
+            msg = pickle.loads(body[5 + hlen:])
+        else:
+            msg = pickle.loads(body)
+        mtype = msg.get("type")
+        if mtype == "gen_ack":
+            # Consumption credit for a LIVE stream: the relaying
+            # drainer only reads the worker (the C loop owns the driver
+            # socket), so credits are routed to the producer here.
+            with self._nd_state_lock:
+                worker = self._nd_streams.get(conn_id)
+            if worker is not None:
+                with contextlib.suppress(Exception):
+                    with worker._send_lock:
+                        self._send_msg(worker.sock, msg)
+            return
+        if flags & _ndmod.FLAG_PRECHARGED:
+            msg["_nd_precharged"] = True
+        with self._nd_state_lock:
+            conn = self._nd_conns.get(conn_id)
+            if conn is None:
+                conn = _NdConn(self._nd, conn_id)
+                self._nd_conns[conn_id] = conn
+            actors = self._nd_conn_actors.setdefault(conn_id, [])
+        try:
+            with _estats.timed("node_daemon", str(mtype)):
+                self._dispatch_one(conn, msg, mtype, actors)
+        except (self._WorkerCrashedError, OSError, EOFError):
+            pass  # conn died mid-reply; EV_CLOSED does the cleanup
+        except Exception:  # noqa: BLE001 — one bad request, not a drainer
+            logger.exception("native dispatch handler error (%s)", mtype)
+
+    def _nd_conn_closed(self, conn_id: int) -> None:
+        with self._nd_state_lock:
+            conn = self._nd_conns.pop(conn_id, None)
+            actors = self._nd_conn_actors.pop(conn_id, [])
+            worker = self._nd_streams.get(conn_id)
+        if conn is not None:
+            conn.closed = True
+        if worker is not None:
+            # Driver died mid-stream: unwedge the producer (it may be
+            # blocked on credits); the relaying drainer drains it back
+            # to a clean pool state.
+            with contextlib.suppress(Exception):
+                worker.send_ack(1 << 30)
+        # Driver hung up: actors created over this connection die with
+        # it, same contract as the fallback's _serve_conn finally.
+        for aid in actors:
+            with contextlib.suppress(Exception):
+                self._kill_actor(aid)
+
     def _recv_any(self, conn):
         """Frame decode with cross-language support: JSON frames (first
         byte '{') from non-Python clients, cloudpickle otherwise
@@ -460,6 +812,13 @@ class NodeDaemon:
             return msg
         import pickle
 
+        if payload[:1] == b"\x01":
+            # Hybrid frame (node/client.py hybrid_frame): a JSON
+            # admission header for the native front end, then the
+            # pickled message. The Python fallback plane admits from
+            # the body's own fields, so the header is just skipped.
+            (hlen,) = _struct.Struct("<I").unpack(payload[1:5])
+            return pickle.loads(payload[5 + hlen:])
         return pickle.loads(payload)
 
     @staticmethod
@@ -637,6 +996,13 @@ class NodeDaemon:
 
         _tracing.set_process_label(f"daemon:{self.node_id}")
         _tracing.setup_tracing(self._span_buf.append)
+        if self._nd is not None:
+            # Standalone daemons piggyback buffered spans on pong
+            # replies (_drain_spans); the C loop's GIL-free pong can't
+            # carry them, so hand pings back to Python here. In-process
+            # daemons never call this and keep the native fast path
+            # (their span buffer stays empty).
+            self._nd.set_ping_native(False)
 
     def _handle_logs(self, mtype: str, msg: Dict[str, Any]
                      ) -> Dict[str, Any]:
@@ -931,15 +1297,17 @@ class NodeDaemon:
         # task. The reservation holds no _running/_queued count yet —
         # _run_task takes those over (no double-counting in the load
         # report while the task waits for a worker).
-        precharged = False
-        if mtype == "task" and spillable and not res.is_empty():
-            with self._avail_lock:
-                ok = res.fits(self.available)
-                if ok:
-                    self.available = self.available.subtract(res)
-                else:
-                    self._spilled += 1
+        # The native front end may have ALREADY charged admission (the
+        # C loop's check-and-charge, flagged through the ready queue as
+        # FLAG_PRECHARGED → _nd_precharged); a natively-refused task
+        # never reaches this method at all.
+        precharged = bool(msg.pop("_nd_precharged", False))
+        if (not precharged and mtype == "task" and spillable
+                and not res.is_empty()):
+            ok = self._ledger_try_charge(res)
             if not ok:
+                with self._avail_lock:
+                    self._spilled += 1
                 # Refuse WITH a redirect (reference: the spillback reply's
                 # retry_at_raylet_address, node_manager.proto:365-379): this
                 # daemon names a feasible peer off its OWN control-plane
@@ -955,8 +1323,7 @@ class NodeDaemon:
             precharged = True
 
         def unreserve():
-            with self._avail_lock:
-                self.available = self.available.add(res)
+            self._ledger_release(res)
 
         missing, pulled = self._ensure_local(fetch)
         if missing is not None:
@@ -1229,6 +1596,9 @@ class NodeDaemon:
         Raises WorkerCrashedError on worker death."""
         import selectors
 
+        if isinstance(conn, _NdConn):
+            self._relay_streaming_native(conn, worker, msg)
+            return
         recv_msg, send_msg = self._recv_msg, self._send_msg
         with worker._send_lock:
             send_msg(worker.sock, msg)
@@ -1273,6 +1643,35 @@ class NodeDaemon:
         finally:
             sel.close()
 
+    def _relay_streaming_native(self, conn, worker, msg) -> None:
+        """Native-plane stream relay. The C loop owns the driver
+        socket, so gen_ack credits arrive as ready-queue events on
+        OTHER drainers — _nd_handle routes them to this worker through
+        _nd_streams. This thread only reads the worker and forwards
+        its frames; a closed driver conn (the adapter raises, or the
+        EV_CLOSED handler pre-unwedged) turns into a drain-to-terminal
+        so the worker re-enters the pool clean."""
+        recv_msg, send_msg = self._recv_msg, self._send_msg
+        with self._nd_state_lock:
+            self._nd_streams[conn.conn_id] = worker
+        try:
+            with worker._send_lock:
+                send_msg(worker.sock, msg)
+            while True:
+                reply = recv_msg(worker.sock)  # raises on worker crash
+                try:
+                    send_msg(conn, reply)
+                except OSError:
+                    worker.send_ack(1 << 30)
+                    while reply.get("type") != "result":
+                        reply = recv_msg(worker.sock)
+                    return
+                if reply.get("type") == "result":
+                    return
+        finally:
+            with self._nd_state_lock:
+                self._nd_streams.pop(conn.conn_id, None)
+
     def _run_task(self, conn, msg, res, max_calls, fid,
                   retriable: bool = False,
                   precharged: bool = False) -> None:
@@ -1285,8 +1684,8 @@ class NodeDaemon:
         except Exception as e:  # noqa: BLE001 — pool exhausted/shutdown
             with self._avail_lock:
                 self._queued -= 1
-                if precharged:
-                    self.available = self.available.add(res)
+            if precharged:
+                self._ledger_release(res)
             send_msg(conn, {"type": "result",
                             "task_id": msg.get("task_id"),
                             "crashed": f"no worker available: {e}"})
@@ -1435,8 +1834,14 @@ class NodeDaemon:
         self._stop.set()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
-        with contextlib.suppress(OSError):
-            self._listener.close()
+        if self._nd is not None:
+            # Stop the C loop first: in-flight conns close, nd_next
+            # returns "stopped" and the drainer pool exits.
+            with contextlib.suppress(Exception):
+                self._nd.stop()
+        if self._listener is not None:
+            with contextlib.suppress(OSError):
+                self._listener.close()
         with self._actors_lock:
             actors = list(self._actors.values())
             self._actors.clear()
@@ -1458,6 +1863,28 @@ class NodeDaemon:
             ShmStore.unlink(self.shm_name)
         with contextlib.suppress(Exception):
             self.control.close()
+        if self._nd is not None:
+            # Free the native handle only once every drainer has left
+            # nd_next. stop() can be CALLED from a drainer (a wire
+            # "shutdown" message) — that thread is skipped, and if any
+            # drainer is still inside a hand-off after the deadline the
+            # handle is leaked rather than freed under a live reader
+            # (the process is exiting anyway).
+            cur = threading.current_thread()
+            with self._drainer_lock:
+                drainers = list(self._drainers)
+            deadline = time.monotonic() + 5.0
+            all_joined = True
+            for t in drainers:
+                if t is cur:
+                    all_joined = False
+                    continue
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+                if t.is_alive():
+                    all_joined = False
+            if all_joined:
+                with contextlib.suppress(Exception):
+                    self._nd.destroy()
         # Last daemon spans must not die in the OTLP batch buffer.
         with contextlib.suppress(Exception):
             from ray_tpu.util.tracing import flush_otlp

@@ -206,8 +206,9 @@ def cmd_status(args) -> int:
     if args.address:
         status = _fetch(args.address, "/api/cluster_status")
         if getattr(args, "verbose", False):
-            # Per-handler loop latency (event_stats plane) rides along
-            # so a wedged loop is visible from `status` alone.
+            # Per-handler loop latency (event_stats plane) and per-pid
+            # shm-arena holdings (shm_pins) ride along so a wedged loop
+            # or an arena hog is visible from `status` alone.
             with contextlib.suppress(Exception):
                 status["event_stats"] = _fetch(args.address,
                                                "/api/event_stats")

@@ -1,0 +1,1209 @@
+// node_dispatch.cc — native dispatch front end for the node daemon.
+//
+// Grows control_plane.cc's single-threaded epoll substrate into the
+// daemon's dispatch-socket hot loop (reference: the raylet keeps accept/
+// frame/admission in C++ and calls Python only for policy,
+// src/ray/raylet/node_manager.cc). The loop owns:
+//
+//   - accept + nonblocking conn lifecycle (one epoll thread, no
+//     thread-per-connection, nothing here touches the GIL);
+//   - wire framing: 8-byte big-endian length + payload, same protocol
+//     the Python daemon speaks (worker_proc._LEN);
+//   - payload classification: '{' = JSON message (cross-language
+//     clients), 0x01 = hybrid frame (u32-LE header length + JSON
+//     admission header + opaque cloudpickle body — the Python driver's
+//     NodeConn emits these), anything else = opaque legacy pickle;
+//   - task-queue admission: check-and-charge against the resource
+//     ledger (same 1/10000 fixed-point model as core/resources.py) for
+//     driver-marked spillable tasks, with the refusal reply — peer
+//     redirect hint + authoritative load — written natively;
+//   - "ping" answered natively from the Python-pushed load report;
+//   - a bounded ready queue the Python side drains (nd_next), with
+//     EPOLLIN backpressure when Python falls behind: paused conns stop
+//     being read, so TCP pushes back on the drivers instead of the
+//     queue growing without bound;
+//   - per-(loop,handler) count/total/max/p95 latency stats (the
+//     event_stats.h analog), measured from frame arrival to the first
+//     reply byte queued for that request.
+//
+// Everything Python needs crosses a narrow C ABI (nd_*) loaded via
+// ctypes — every call releases the GIL for its duration.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+double seconds_since(Clock::time_point t0, Clock::time_point t1) {
+  return std::chrono::duration<double>(t1 - t0).count();
+}
+
+// ---------------------------------------------------------------------
+// Minimal JSON: enough for admission headers, resource dicts and the
+// peer digest. Parses into a tagged value; no exceptions escape.
+// ---------------------------------------------------------------------
+
+struct JValue {
+  enum Kind { NUL, BOOL, NUM, STR, ARR, OBJ } kind = NUL;
+  bool b = false;
+  double num = 0.0;
+  std::string str;
+  std::vector<JValue> arr;
+  std::vector<std::pair<std::string, JValue>> obj;
+
+  const JValue* get(const char* key) const {
+    for (const auto& kv : obj)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+};
+
+class JParser {
+ public:
+  JParser(const char* p, size_t n) : p_(p), end_(p + n) {}
+
+  bool parse(JValue* out) {
+    skip_ws();
+    if (!value(out)) return false;
+    skip_ws();
+    return p_ == end_;
+  }
+
+ private:
+  const char* p_;
+  const char* end_;
+
+  void skip_ws() {
+    while (p_ < end_ && (*p_ == ' ' || *p_ == '\t' || *p_ == '\n' ||
+                         *p_ == '\r'))
+      p_++;
+  }
+
+  bool lit(const char* s, size_t n) {
+    if (static_cast<size_t>(end_ - p_) < n || memcmp(p_, s, n) != 0)
+      return false;
+    p_ += n;
+    return true;
+  }
+
+  bool value(JValue* out) {
+    skip_ws();
+    if (p_ >= end_) return false;
+    switch (*p_) {
+      case '{':
+        return object(out);
+      case '[':
+        return array(out);
+      case '"':
+        out->kind = JValue::STR;
+        return string(&out->str);
+      case 't':
+        out->kind = JValue::BOOL;
+        out->b = true;
+        return lit("true", 4);
+      case 'f':
+        out->kind = JValue::BOOL;
+        out->b = false;
+        return lit("false", 5);
+      case 'n':
+        out->kind = JValue::NUL;
+        return lit("null", 4);
+      default:
+        return number(out);
+    }
+  }
+
+  bool number(JValue* out) {
+    const char* start = p_;
+    if (p_ < end_ && (*p_ == '-' || *p_ == '+')) p_++;
+    while (p_ < end_ && ((*p_ >= '0' && *p_ <= '9') || *p_ == '.' ||
+                         *p_ == 'e' || *p_ == 'E' || *p_ == '-' ||
+                         *p_ == '+'))
+      p_++;
+    if (p_ == start) return false;
+    std::string tmp(start, p_ - start);
+    char* endp = nullptr;
+    out->num = strtod(tmp.c_str(), &endp);
+    out->kind = JValue::NUM;
+    return endp == tmp.c_str() + tmp.size();
+  }
+
+  bool hex4(unsigned* out) {
+    if (end_ - p_ < 4) return false;
+    unsigned v = 0;
+    for (int i = 0; i < 4; i++) {
+      char c = p_[i];
+      v <<= 4;
+      if (c >= '0' && c <= '9')
+        v |= static_cast<unsigned>(c - '0');
+      else if (c >= 'a' && c <= 'f')
+        v |= static_cast<unsigned>(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F')
+        v |= static_cast<unsigned>(c - 'A' + 10);
+      else
+        return false;
+    }
+    p_ += 4;
+    *out = v;
+    return true;
+  }
+
+  void append_utf8(std::string* s, unsigned cp) {
+    if (cp < 0x80) {
+      s->push_back(static_cast<char>(cp));
+    } else if (cp < 0x800) {
+      s->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+      s->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      s->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+      s->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      s->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+      s->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      s->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      s->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      s->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  bool string(std::string* out) {
+    if (p_ >= end_ || *p_ != '"') return false;
+    p_++;
+    out->clear();
+    while (p_ < end_) {
+      char c = *p_++;
+      if (c == '"') return true;
+      if (c != '\\') {
+        out->push_back(c);
+        continue;
+      }
+      if (p_ >= end_) return false;
+      char e = *p_++;
+      switch (e) {
+        case '"': out->push_back('"'); break;
+        case '\\': out->push_back('\\'); break;
+        case '/': out->push_back('/'); break;
+        case 'b': out->push_back('\b'); break;
+        case 'f': out->push_back('\f'); break;
+        case 'n': out->push_back('\n'); break;
+        case 'r': out->push_back('\r'); break;
+        case 't': out->push_back('\t'); break;
+        case 'u': {
+          unsigned cp = 0;
+          if (!hex4(&cp)) return false;
+          if (cp >= 0xD800 && cp <= 0xDBFF && end_ - p_ >= 6 &&
+              p_[0] == '\\' && p_[1] == 'u') {
+            p_ += 2;
+            unsigned lo = 0;
+            if (!hex4(&lo)) return false;
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+          }
+          append_utf8(out, cp);
+          break;
+        }
+        default:
+          return false;
+      }
+    }
+    return false;
+  }
+
+  bool array(JValue* out) {
+    out->kind = JValue::ARR;
+    p_++;  // '['
+    skip_ws();
+    if (p_ < end_ && *p_ == ']') {
+      p_++;
+      return true;
+    }
+    while (p_ < end_) {
+      out->arr.emplace_back();
+      if (!value(&out->arr.back())) return false;
+      skip_ws();
+      if (p_ < end_ && *p_ == ',') {
+        p_++;
+        continue;
+      }
+      if (p_ < end_ && *p_ == ']') {
+        p_++;
+        return true;
+      }
+      return false;
+    }
+    return false;
+  }
+
+  bool object(JValue* out) {
+    out->kind = JValue::OBJ;
+    p_++;  // '{'
+    skip_ws();
+    if (p_ < end_ && *p_ == '}') {
+      p_++;
+      return true;
+    }
+    while (p_ < end_) {
+      skip_ws();
+      std::string key;
+      if (!string(&key)) return false;
+      skip_ws();
+      if (p_ >= end_ || *p_ != ':') return false;
+      p_++;
+      out->obj.emplace_back(std::move(key), JValue());
+      if (!value(&out->obj.back().second)) return false;
+      skip_ws();
+      if (p_ < end_ && *p_ == ',') {
+        p_++;
+        continue;
+      }
+      if (p_ < end_ && *p_ == '}') {
+        p_++;
+        return true;
+      }
+      return false;
+    }
+    return false;
+  }
+};
+
+void json_escape(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (char c : s) {
+    switch (c) {
+      case '"': out->append("\\\""); break;
+      case '\\': out->append("\\\\"); break;
+      case '\n': out->append("\\n"); break;
+      case '\r': out->append("\\r"); break;
+      case '\t': out->append("\\t"); break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x",
+                   static_cast<unsigned char>(c));
+          out->append(buf);
+        } else {
+          out->push_back(c);
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void json_number(double v, std::string* out) {
+  char buf[40];
+  if (v == static_cast<int64_t>(v) && v > -1e15 && v < 1e15) {
+    snprintf(buf, sizeof(buf), "%lld.0",
+             static_cast<long long>(v));
+  } else {
+    snprintf(buf, sizeof(buf), "%.12g", v);
+  }
+  out->append(buf);
+}
+
+// ---------------------------------------------------------------------
+// Resource ledger: 1/10000 fixed-point, exactly core/resources.py.
+// ---------------------------------------------------------------------
+
+constexpr int64_t kGranularity = 10000;
+
+int64_t to_fixed(double v) {
+  return static_cast<int64_t>(v * kGranularity + (v >= 0 ? 0.5 : -0.5));
+}
+
+using ResMap = std::map<std::string, int64_t>;
+
+bool parse_res(const JValue& obj, ResMap* out) {
+  if (obj.kind != JValue::OBJ) return false;
+  for (const auto& kv : obj.obj) {
+    if (kv.second.kind != JValue::NUM) return false;
+    int64_t f = to_fixed(kv.second.num);
+    if (f != 0) (*out)[kv.first] = f;
+  }
+  return true;
+}
+
+bool parse_res_str(const char* s, ResMap* out) {
+  if (s == nullptr) return false;
+  JValue v;
+  JParser p(s, strlen(s));
+  if (!p.parse(&v)) return false;
+  return parse_res(v, out);
+}
+
+void res_to_json(const ResMap& r, std::string* out) {
+  out->push_back('{');
+  bool first = true;
+  for (const auto& kv : r) {
+    if (kv.second == 0) continue;  // to_dict() drops zero entries
+    if (!first) out->push_back(',');
+    first = false;
+    json_escape(kv.first, out);
+    out->push_back(':');
+    json_number(static_cast<double>(kv.second) / kGranularity, out);
+  }
+  out->push_back('}');
+}
+
+bool res_fits(const ResMap& req, const ResMap& avail) {
+  for (const auto& kv : req) {
+    auto it = avail.find(kv.first);
+    if ((it == avail.end() ? 0 : it->second) < kv.second) return false;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------
+// Per-handler latency stats (event_stats.py registry shape).
+// ---------------------------------------------------------------------
+
+struct Stat {
+  uint64_t count = 0;
+  double total_s = 0.0;
+  double max_s = 0.0;
+  uint32_t ring_us[256];
+  int ring_n = 0;
+  int ring_pos = 0;
+};
+
+// ---------------------------------------------------------------------
+// Loop state.
+// ---------------------------------------------------------------------
+
+constexpr uint32_t kFlagPrecharged = 1u;
+constexpr uint32_t kFlagJson = 2u;
+
+struct Event {
+  uint64_t conn_id = 0;
+  int kind = 0;  // 0 = message, 1 = conn closed
+  uint32_t flags = 0;
+  char* data = nullptr;  // malloc'd; freed by nd_free (Python side)
+  uint64_t len = 0;
+};
+
+struct Conn {
+  uint64_t id = 0;
+  int fd = -1;
+  std::string inbuf;
+  size_t in_off = 0;
+  std::deque<std::string> outq;
+  size_t out_off = 0;
+  bool want_write = false;
+  bool paused = false;
+  // Request timer: set when a frame is admitted, closed by the first
+  // reply queued for this conn (the protocol is one request in flight
+  // per connection, so first-reply attribution is exact for unary
+  // requests and time-to-first-frame for streams).
+  bool timing = false;
+  std::string timing_handler;
+  Clock::time_point timing_t0;
+};
+
+struct Outgoing {
+  uint64_t conn_id;
+  std::string payload;  // unframed; the loop adds the length prefix
+  Clock::time_point t;
+};
+
+struct Peer {
+  std::string id;
+  int64_t queued = 0;
+  double headroom = 0.0;
+  ResMap avail;
+};
+
+struct NdServer {
+  int listen_fd = -1;
+  int ep_fd = -1;
+  int event_fd = -1;
+  int port = 0;
+  uint64_t max_frame = 1ull << 31;
+  size_t queue_cap = 1024;
+  std::thread loop_thread;
+  std::atomic<bool> stop{false};
+  std::atomic<bool> ping_native{true};
+  std::atomic<int> paused_count{0};
+  std::atomic<uint64_t> spilled{0};
+
+  // Ready queue (Python drains via nd_next).
+  std::mutex qmu;
+  std::condition_variable qcv;
+  std::deque<Event> queue;
+
+  // Replies queued by Python (nd_send); the loop owns the conns.
+  std::mutex omu;
+  std::vector<Outgoing> outbox;
+
+  // Resource ledger.
+  std::mutex lmu;
+  ResMap avail;
+
+  // Stats.
+  std::mutex smu;
+  std::map<std::string, Stat> stats;
+
+  // Python-pushed context for natively-written replies. load_tail is
+  // the daemon's load report serialized WITHOUT its "available" entry
+  // and without the leading '{' — the loop splices in the ledger's
+  // own (always-fresh) availability when it builds a pong/refusal.
+  std::mutex cfgmu;
+  std::string node_id;
+  std::string load_tail = "}";
+  std::vector<Peer> peers;
+
+  // Loop-thread-only state.
+  std::unordered_map<int, Conn*> conns;
+  uint64_t next_conn_id = 1;
+};
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  if (fl >= 0) fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void record_stat(NdServer* s, const std::string& handler, double dt_s) {
+  std::lock_guard<std::mutex> g(s->smu);
+  Stat& st = s->stats[handler];
+  st.count++;
+  st.total_s += dt_s;
+  if (dt_s > st.max_s) st.max_s = dt_s;
+  uint32_t us = dt_s >= 4294.0
+                    ? 0xFFFFFFFFu
+                    : static_cast<uint32_t>(dt_s * 1e6);
+  st.ring_us[st.ring_pos] = us;
+  st.ring_pos = (st.ring_pos + 1) % 256;
+  if (st.ring_n < 256) st.ring_n++;
+}
+
+void arm_events(NdServer* s, Conn* c) {
+  epoll_event ev{};
+  ev.events = (c->paused ? 0u : static_cast<uint32_t>(EPOLLIN)) |
+              (c->outq.empty() ? 0u : static_cast<uint32_t>(EPOLLOUT)) |
+              EPOLLRDHUP;
+  ev.data.fd = c->fd;
+  epoll_ctl(s->ep_fd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+void push_event(NdServer* s, Event&& e) {
+  {
+    std::lock_guard<std::mutex> g(s->qmu);
+    s->queue.push_back(std::move(e));
+  }
+  s->qcv.notify_one();
+}
+
+bool queue_full(NdServer* s) {
+  std::lock_guard<std::mutex> g(s->qmu);
+  return s->queue.size() >= s->queue_cap;
+}
+
+void close_conn(NdServer* s, Conn* c) {
+  epoll_ctl(s->ep_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  s->conns.erase(c->fd);
+  if (c->paused) s->paused_count.fetch_sub(1);
+  // The close event always lands (never subject to the queue cap):
+  // Python cleans up conn-scoped state (actors created over the conn,
+  // live stream relays) from it.
+  Event e;
+  e.conn_id = c->id;
+  e.kind = 1;
+  push_event(s, std::move(e));
+  delete c;
+}
+
+// Flush as much of the outq as the socket accepts. Returns false when
+// the conn died (already closed + freed).
+bool handle_writable(NdServer* s, Conn* c) {
+  while (!c->outq.empty()) {
+    const std::string& front = c->outq.front();
+    ssize_t w = send(c->fd, front.data() + c->out_off,
+                     front.size() - c->out_off, MSG_NOSIGNAL);
+    if (w > 0) {
+      c->out_off += static_cast<size_t>(w);
+      if (c->out_off == front.size()) {
+        c->outq.pop_front();
+        c->out_off = 0;
+      }
+      continue;
+    }
+    if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    close_conn(s, c);
+    return false;
+  }
+  bool want = !c->outq.empty();
+  if (want != c->want_write) {
+    c->want_write = want;
+    arm_events(s, c);
+  }
+  return true;
+}
+
+// Queue one reply frame (adds the 8-byte big-endian length prefix) and
+// try an immediate opportunistic write.
+bool queue_frame(NdServer* s, Conn* c, const char* payload, size_t n) {
+  std::string buf;
+  buf.reserve(8 + n);
+  for (int i = 7; i >= 0; i--)
+    buf.push_back(static_cast<char>((static_cast<uint64_t>(n) >>
+                                     (8 * i)) & 0xFF));
+  buf.append(payload, n);
+  c->outq.push_back(std::move(buf));
+  return handle_writable(s, c);
+}
+
+std::string header_str(const JValue* header, const char* key) {
+  if (header == nullptr) return std::string();
+  const JValue* v = header->get(key);
+  return (v != nullptr && v->kind == JValue::STR) ? v->str
+                                                  : std::string();
+}
+
+// Build the natively-written spillback refusal / pong payloads. The
+// load report is spliced from the Python-pushed tail with the ledger's
+// live availability, so a refusal always carries an authoritative
+// "available" even between heartbeats.
+void append_load(NdServer* s, std::string* out) {
+  std::string avail_json;
+  {
+    std::lock_guard<std::mutex> g(s->lmu);
+    res_to_json(s->avail, &avail_json);
+  }
+  out->append("{\"available\":");
+  out->append(avail_json);
+  std::lock_guard<std::mutex> g(s->cfgmu);
+  if (s->load_tail != "}") out->push_back(',');
+  out->append(s->load_tail);
+}
+
+std::string pick_spill_target(NdServer* s, const ResMap& res,
+                              const std::set<std::string>& exclude) {
+  std::lock_guard<std::mutex> g(s->cfgmu);
+  const Peer* best = nullptr;
+  for (const Peer& p : s->peers) {
+    if (exclude.count(p.id) != 0) continue;
+    if (!res_fits(res, p.avail)) continue;
+    if (best == nullptr || p.queued < best->queued ||
+        (p.queued == best->queued && p.headroom > best->headroom))
+      best = &p;
+  }
+  return best != nullptr ? best->id : std::string();
+}
+
+// Classify + handle one complete frame payload. Returns false when the
+// conn was closed (malformed frame).
+bool handle_frame(NdServer* s, Conn* c, const char* payload, size_t n) {
+  Clock::time_point now = Clock::now();
+  const char* body = payload;
+  size_t body_len = n;
+  JValue header;
+  bool has_header = false;
+  uint32_t flags = 0;
+
+  if (n > 0 && payload[0] == '{') {
+    // Cross-language JSON frame: the whole payload is the message.
+    JParser p(payload, n);
+    if (!p.parse(&header) || header.kind != JValue::OBJ) {
+      close_conn(s, c);
+      return false;
+    }
+    has_header = true;
+    flags |= kFlagJson;
+  } else if (n > 0 && payload[0] == 0x01) {
+    // Hybrid frame: 0x01 | u32-LE header len | JSON header | body.
+    if (n < 5) {
+      close_conn(s, c);
+      return false;
+    }
+    uint32_t hlen = 0;
+    memcpy(&hlen, payload + 1, 4);
+    if (5 + static_cast<uint64_t>(hlen) > n) {
+      close_conn(s, c);
+      return false;
+    }
+    JParser p(payload + 5, hlen);
+    if (!p.parse(&header) || header.kind != JValue::OBJ) {
+      close_conn(s, c);
+      return false;
+    }
+    has_header = true;
+    body = payload + 5 + hlen;
+    body_len = n - 5 - hlen;
+  }
+  // else: opaque legacy pickle — Python handles everything.
+
+  std::string mtype =
+      has_header ? header_str(&header, "type") : std::string("opaque");
+
+  // -- natively-handled fast paths ------------------------------------
+  if (has_header && mtype == "ping" && s->ping_native.load()) {
+    std::string reply = "{\"type\":\"pong\",\"node_id\":";
+    {
+      std::lock_guard<std::mutex> g(s->cfgmu);
+      json_escape(s->node_id, &reply);
+    }
+    reply.append(",\"load\":");
+    append_load(s, &reply);
+    reply.push_back('}');
+    record_stat(s, "ping", seconds_since(now, Clock::now()));
+    return queue_frame(s, c, reply.data(), reply.size());
+  }
+
+  if (has_header && mtype == "task") {
+    const JValue* sp = header.get("spillable");
+    const JValue* resv = header.get("res");
+    ResMap res;
+    if (sp != nullptr && sp->kind == JValue::BOOL && sp->b &&
+        resv != nullptr && parse_res(*resv, &res) && !res.empty()) {
+      // Atomic check-and-charge (the Python daemon's admission block,
+      // verbatim semantics): refusal never queues the task here.
+      bool ok;
+      {
+        std::lock_guard<std::mutex> g(s->lmu);
+        ok = res_fits(res, s->avail);
+        if (ok)
+          for (const auto& kv : res) s->avail[kv.first] -= kv.second;
+      }
+      if (!ok) {
+        s->spilled.fetch_add(1);
+        std::set<std::string> exclude;
+        {
+          std::lock_guard<std::mutex> g(s->cfgmu);
+          exclude.insert(s->node_id);
+        }
+        const JValue* ex = header.get("exclude");
+        if (ex != nullptr && ex->kind == JValue::ARR)
+          for (const JValue& v : ex->arr)
+            if (v.kind == JValue::STR) exclude.insert(v.str);
+        std::string reply = "{\"type\":\"result\",\"task_id\":";
+        std::string tid = header_str(&header, "tid");
+        if (tid.empty())
+          reply.append("null");
+        else
+          json_escape(tid, &reply);
+        reply.append(",\"spillback\":true,\"retry_at\":");
+        std::string target = pick_spill_target(s, res, exclude);
+        if (target.empty())
+          reply.append("null");
+        else
+          json_escape(target, &reply);
+        reply.append(",\"load\":");
+        append_load(s, &reply);
+        reply.push_back('}');
+        record_stat(s, "spill_refusal",
+                    seconds_since(now, Clock::now()));
+        return queue_frame(s, c, reply.data(), reply.size());
+      }
+      flags |= kFlagPrecharged;
+    }
+  }
+
+  // -- hand off to Python ---------------------------------------------
+  // Request timing: close on the first reply nd_send queues for this
+  // conn. Credit/notification types never get a reply — no timer.
+  if (mtype != "gen_ack" && mtype != "pull_complete") {
+    c->timing = true;
+    c->timing_handler = mtype;
+    c->timing_t0 = now;
+  }
+  Event e;
+  e.conn_id = c->id;
+  e.kind = 0;
+  e.flags = flags;
+  e.data = static_cast<char*>(malloc(body_len > 0 ? body_len : 1));
+  if (e.data == nullptr) {
+    close_conn(s, c);
+    return false;
+  }
+  memcpy(e.data, body, body_len);
+  e.len = body_len;
+  push_event(s, std::move(e));
+  return true;
+}
+
+// Extract complete frames from the conn's inbuf. Pauses the conn
+// (EPOLLIN off → TCP backpressure on the driver) when the ready queue
+// is full. Returns false when the conn died.
+bool parse_frames(NdServer* s, Conn* c) {
+  for (;;) {
+    size_t have = c->inbuf.size() - c->in_off;
+    if (have < 8) break;
+    const unsigned char* hp = reinterpret_cast<const unsigned char*>(
+        c->inbuf.data() + c->in_off);
+    uint64_t flen = 0;
+    for (int i = 0; i < 8; i++) flen = (flen << 8) | hp[i];
+    if (flen == 0 || flen > s->max_frame) {
+      close_conn(s, c);
+      return false;
+    }
+    if (have < 8 + flen) break;
+    if (queue_full(s)) {
+      if (!c->paused) {
+        c->paused = true;
+        s->paused_count.fetch_add(1);
+        arm_events(s, c);
+      }
+      return true;  // frame stays buffered until Python catches up
+    }
+    // Consume the frame before handling: handle_frame may close the
+    // conn (and free c) on malformed input.
+    size_t off = c->in_off;
+    c->in_off += 8 + flen;
+    bool alive = handle_frame(s, c, c->inbuf.data() + off + 8,
+                              static_cast<size_t>(flen));
+    if (!alive) return false;
+  }
+  if (c->in_off > 0 && c->in_off == c->inbuf.size()) {
+    c->inbuf.clear();
+    c->in_off = 0;
+  } else if (c->in_off > (1u << 20)) {
+    c->inbuf.erase(0, c->in_off);
+    c->in_off = 0;
+  }
+  return true;
+}
+
+void handle_readable(NdServer* s, Conn* c) {
+  char buf[65536];
+  for (;;) {
+    if (c->paused) return;  // stop pulling bytes while Python is behind
+    ssize_t r = recv(c->fd, buf, sizeof(buf), 0);
+    if (r > 0) {
+      c->inbuf.append(buf, static_cast<size_t>(r));
+      if (!parse_frames(s, c)) return;
+      if (static_cast<size_t>(r) < sizeof(buf)) return;
+      continue;
+    }
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+    close_conn(s, c);  // EOF or hard error
+    return;
+  }
+}
+
+void process_outbox(NdServer* s) {
+  std::vector<Outgoing> batch;
+  {
+    std::lock_guard<std::mutex> g(s->omu);
+    batch.swap(s->outbox);
+  }
+  for (Outgoing& o : batch) {
+    Conn* c = nullptr;
+    for (auto& kv : s->conns)
+      if (kv.second->id == o.conn_id) {
+        c = kv.second;
+        break;
+      }
+    if (c == nullptr) continue;  // conn gone; reply dropped (as today)
+    if (c->timing) {
+      c->timing = false;
+      record_stat(s, c->timing_handler,
+                  seconds_since(c->timing_t0, o.t));
+    }
+    queue_frame(s, c, o.payload.data(), o.payload.size());
+  }
+}
+
+void resume_paused(NdServer* s) {
+  if (s->paused_count.load() == 0 || queue_full(s)) return;
+  // Collect first: parse_frames may close (and erase) conns.
+  std::vector<Conn*> paused;
+  for (auto& kv : s->conns)
+    if (kv.second->paused) paused.push_back(kv.second);
+  for (Conn* c : paused) {
+    if (queue_full(s)) break;
+    c->paused = false;
+    s->paused_count.fetch_sub(1);
+    arm_events(s, c);
+    parse_frames(s, c);
+  }
+}
+
+void accept_ready(NdServer* s) {
+  for (;;) {
+    int fd = accept4(s->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+    if (fd < 0) return;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    Conn* c = new Conn();
+    c->fd = fd;
+    c->id = s->next_conn_id++;
+    s->conns[fd] = c;
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLRDHUP;
+    ev.data.fd = fd;
+    epoll_ctl(s->ep_fd, EPOLL_CTL_ADD, fd, &ev);
+  }
+}
+
+void loop_main(NdServer* s) {
+  epoll_event evs[64];
+  while (!s->stop.load()) {
+    int n = epoll_wait(s->ep_fd, evs, 64, 200);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int i = 0; i < n; i++) {
+      int fd = evs[i].data.fd;
+      if (fd == s->listen_fd) {
+        accept_ready(s);
+        continue;
+      }
+      if (fd == s->event_fd) {
+        uint64_t junk;
+        while (read(s->event_fd, &junk, 8) == 8) {
+        }
+        continue;
+      }
+      auto it = s->conns.find(fd);
+      if (it == s->conns.end()) continue;
+      Conn* c = it->second;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        close_conn(s, c);
+        continue;
+      }
+      if (evs[i].events & EPOLLOUT) {
+        if (!handle_writable(s, c)) continue;
+      }
+      if (evs[i].events & (EPOLLIN | EPOLLRDHUP)) handle_readable(s, c);
+    }
+    process_outbox(s);
+    resume_paused(s);
+  }
+  // Drain: wake any nd_next waiters so drainers exit.
+  s->qcv.notify_all();
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------
+// C ABI (ctypes; every call releases the GIL while it runs).
+// ---------------------------------------------------------------------
+
+extern "C" {
+
+void* nd_create(int port, int bind_all, unsigned long long max_frame,
+                int queue_cap) {
+  NdServer* s = new NdServer();
+  if (max_frame > 0) s->max_frame = max_frame;
+  if (queue_cap > 0) s->queue_cap = static_cast<size_t>(queue_cap);
+  s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = bind_all ? htonl(INADDR_ANY)
+                                  : htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+           sizeof(addr)) < 0 ||
+      listen(s->listen_fd, 128) < 0) {
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  set_nonblock(s->listen_fd);
+  s->ep_fd = epoll_create1(0);
+  s->event_fd = eventfd(0, EFD_NONBLOCK);
+  if (s->ep_fd < 0 || s->event_fd < 0) {
+    if (s->ep_fd >= 0) close(s->ep_fd);
+    if (s->event_fd >= 0) close(s->event_fd);
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = s->listen_fd;
+  epoll_ctl(s->ep_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  ev.events = EPOLLIN;
+  ev.data.fd = s->event_fd;
+  epoll_ctl(s->ep_fd, EPOLL_CTL_ADD, s->event_fd, &ev);
+  return s;
+}
+
+int nd_port(void* h) {
+  return h != nullptr ? static_cast<NdServer*>(h)->port : -1;
+}
+
+int nd_start(void* h) {
+  NdServer* s = static_cast<NdServer*>(h);
+  if (s == nullptr) return -1;
+  s->loop_thread = std::thread(loop_main, s);
+  return 0;
+}
+
+void nd_wake(NdServer* s) {
+  uint64_t one = 1;
+  ssize_t rc = write(s->event_fd, &one, 8);
+  (void)rc;
+}
+
+int nd_next(void* h, int timeout_ms, unsigned long long* conn_id,
+            int* kind, unsigned int* flags, char** data,
+            unsigned long long* len) {
+  NdServer* s = static_cast<NdServer*>(h);
+  if (s == nullptr) return -1;
+  Event e;
+  {
+    std::unique_lock<std::mutex> g(s->qmu);
+    // system_clock deadline on purpose: with a steady_clock wait_for,
+    // libstdc++ uses pthread_cond_clockwait, which gcc's TSAN runtime
+    // does not intercept — every wait would look like a held mutex. A
+    // clock jump only stretches one 200ms poll tick.
+    if (!s->qcv.wait_until(
+            g,
+            std::chrono::system_clock::now() +
+                std::chrono::milliseconds(timeout_ms),
+            [&] { return s->stop.load() || !s->queue.empty(); }))
+      return 0;  // timeout
+    if (s->queue.empty()) return -1;  // stopped
+    e = std::move(s->queue.front());
+    s->queue.pop_front();
+  }
+  if (s->paused_count.load() > 0) nd_wake(s);  // room freed: resume
+  *conn_id = e.conn_id;
+  *kind = e.kind;
+  *flags = e.flags;
+  *data = e.data;
+  *len = e.len;
+  return 1;
+}
+
+void nd_free(char* data) { free(data); }
+
+int nd_send(void* h, unsigned long long conn_id, const char* data,
+            unsigned long long len) {
+  NdServer* s = static_cast<NdServer*>(h);
+  if (s == nullptr || s->stop.load()) return -1;
+  Outgoing o;
+  o.conn_id = conn_id;
+  o.payload.assign(data, static_cast<size_t>(len));
+  o.t = Clock::now();
+  {
+    std::lock_guard<std::mutex> g(s->omu);
+    s->outbox.push_back(std::move(o));
+  }
+  nd_wake(s);
+  return 0;
+}
+
+void nd_set_node_id(void* h, const char* node_id) {
+  NdServer* s = static_cast<NdServer*>(h);
+  std::lock_guard<std::mutex> g(s->cfgmu);
+  s->node_id = node_id != nullptr ? node_id : "";
+}
+
+void nd_set_load_tail(void* h, const char* tail) {
+  NdServer* s = static_cast<NdServer*>(h);
+  std::lock_guard<std::mutex> g(s->cfgmu);
+  s->load_tail = (tail != nullptr && tail[0] != '\0') ? tail : "}";
+}
+
+int nd_set_peers_json(void* h, const char* json) {
+  NdServer* s = static_cast<NdServer*>(h);
+  JValue v;
+  JParser p(json, json != nullptr ? strlen(json) : 0);
+  if (json == nullptr || !p.parse(&v) || v.kind != JValue::ARR)
+    return -1;
+  std::vector<Peer> peers;
+  for (const JValue& pv : v.arr) {
+    if (pv.kind != JValue::OBJ) return -1;
+    Peer peer;
+    const JValue* id = pv.get("id");
+    if (id == nullptr || id->kind != JValue::STR) return -1;
+    peer.id = id->str;
+    const JValue* q = pv.get("queued");
+    if (q != nullptr && q->kind == JValue::NUM)
+      peer.queued = static_cast<int64_t>(q->num);
+    const JValue* hr = pv.get("headroom");
+    if (hr != nullptr && hr->kind == JValue::NUM) peer.headroom = hr->num;
+    const JValue* av = pv.get("avail");
+    if (av != nullptr && !parse_res(*av, &peer.avail)) return -1;
+    peers.push_back(std::move(peer));
+  }
+  std::lock_guard<std::mutex> g(s->cfgmu);
+  s->peers.swap(peers);
+  return 0;
+}
+
+void nd_set_ping_native(void* h, int enabled) {
+  static_cast<NdServer*>(h)->ping_native.store(enabled != 0);
+}
+
+// -- resource ledger ---------------------------------------------------
+
+int nd_ledger_set(void* h, const char* json_res) {
+  NdServer* s = static_cast<NdServer*>(h);
+  ResMap r;
+  if (!parse_res_str(json_res, &r)) return -1;
+  std::lock_guard<std::mutex> g(s->lmu);
+  s->avail.swap(r);
+  return 0;
+}
+
+int nd_ledger_try_charge(void* h, const char* json_res) {
+  NdServer* s = static_cast<NdServer*>(h);
+  ResMap r;
+  if (!parse_res_str(json_res, &r)) return -1;
+  std::lock_guard<std::mutex> g(s->lmu);
+  if (!res_fits(r, s->avail)) return 0;
+  for (const auto& kv : r) s->avail[kv.first] -= kv.second;
+  return 1;
+}
+
+// Unconditional subtract — except it must not drive availability
+// negative silently: ResourceSet.subtract raises, so the Python
+// wrapper turns -1 into the same ValueError.
+int nd_ledger_charge(void* h, const char* json_res) {
+  NdServer* s = static_cast<NdServer*>(h);
+  ResMap r;
+  if (!parse_res_str(json_res, &r)) return -2;
+  std::lock_guard<std::mutex> g(s->lmu);
+  for (const auto& kv : r) {
+    auto it = s->avail.find(kv.first);
+    if ((it == s->avail.end() ? 0 : it->second) < kv.second) return -1;
+  }
+  for (const auto& kv : r) s->avail[kv.first] -= kv.second;
+  return 0;
+}
+
+int nd_ledger_release(void* h, const char* json_res) {
+  NdServer* s = static_cast<NdServer*>(h);
+  ResMap r;
+  if (!parse_res_str(json_res, &r)) return -1;
+  std::lock_guard<std::mutex> g(s->lmu);
+  for (const auto& kv : r) s->avail[kv.first] += kv.second;
+  return 0;
+}
+
+int nd_ledger_get(void* h, char* buf, int cap) {
+  NdServer* s = static_cast<NdServer*>(h);
+  std::string out;
+  {
+    std::lock_guard<std::mutex> g(s->lmu);
+    res_to_json(s->avail, &out);
+  }
+  if (static_cast<int>(out.size()) + 1 > cap) return -1;
+  memcpy(buf, out.data(), out.size());
+  buf[out.size()] = '\0';
+  return static_cast<int>(out.size());
+}
+
+// -- stats -------------------------------------------------------------
+
+unsigned long long nd_spilled(void* h) {
+  return static_cast<NdServer*>(h)->spilled.load();
+}
+
+int nd_stats_json(void* h, char* buf, int cap) {
+  NdServer* s = static_cast<NdServer*>(h);
+  std::string out = "{";
+  {
+    std::lock_guard<std::mutex> g(s->smu);
+    bool first = true;
+    for (const auto& kv : s->stats) {
+      if (!first) out.push_back(',');
+      first = false;
+      json_escape(kv.first, &out);
+      char num[160];
+      uint32_t ring[256];
+      const Stat& st = kv.second;
+      memcpy(ring, st.ring_us,
+             sizeof(uint32_t) * static_cast<size_t>(st.ring_n));
+      double p95 = 0.0;
+      if (st.ring_n > 0) {
+        std::sort(ring, ring + st.ring_n);
+        int idx = static_cast<int>(0.95 * (st.ring_n - 1) + 0.5);
+        p95 = ring[idx] / 1e6;
+      }
+      snprintf(num, sizeof(num),
+               ":{\"count\":%llu,\"total_s\":%.9g,\"max_s\":%.9g,"
+               "\"p95_s\":%.9g}",
+               static_cast<unsigned long long>(st.count), st.total_s,
+               st.max_s, p95);
+      out.append(num);
+    }
+  }
+  out.push_back('}');
+  if (static_cast<int>(out.size()) + 1 > cap) return -1;
+  memcpy(buf, out.data(), out.size());
+  buf[out.size()] = '\0';
+  return static_cast<int>(out.size());
+}
+
+// -- lifecycle ---------------------------------------------------------
+
+void nd_stop(void* h) {
+  NdServer* s = static_cast<NdServer*>(h);
+  if (s == nullptr || s->stop.exchange(true)) return;
+  nd_wake(s);
+  if (s->loop_thread.joinable()) s->loop_thread.join();
+  for (auto& kv : s->conns) {
+    close(kv.second->fd);
+    delete kv.second;
+  }
+  s->conns.clear();
+  close(s->listen_fd);
+  close(s->ep_fd);
+  close(s->event_fd);
+  // Free any undrained message bodies.
+  std::lock_guard<std::mutex> g(s->qmu);
+  for (Event& e : s->queue) free(e.data);
+  s->queue.clear();
+  s->qcv.notify_all();
+}
+
+// Safe only after nd_stop AND after every drainer thread has returned
+// from nd_next — the Python side joins its drainers first.
+void nd_destroy(void* h) {
+  NdServer* s = static_cast<NdServer*>(h);
+  if (s == nullptr) return;
+#if defined(__SANITIZE_THREAD__)
+  // libstdc++'s std::mutex / condition_variable destructors are
+  // trivial on Linux, so TSAN never sees them die; a later server
+  // allocated at the same address would inherit their sync state and
+  // report phantom double-locks. Make the destruction visible.
+  pthread_cond_destroy(s->qcv.native_handle());
+  pthread_mutex_destroy(s->qmu.native_handle());
+  pthread_mutex_destroy(s->omu.native_handle());
+  pthread_mutex_destroy(s->lmu.native_handle());
+  pthread_mutex_destroy(s->smu.native_handle());
+  pthread_mutex_destroy(s->cfgmu.native_handle());
+#endif
+  delete s;
+}
+
+}  // extern "C"
+

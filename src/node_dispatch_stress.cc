@@ -1,0 +1,340 @@
+// Stress harness for the native dispatch plane (node_dispatch.cc),
+// built in-process under ASAN and TSAN (see src/Makefile).
+//
+// Shape (mirrors shm_stress_test / transfer_stress_test): responder
+// threads drain the ready queue like the daemon's drainer pool while
+// valid clients push JSON pings, hybrid admission frames and opaque
+// frames — concurrently with hostile clients (mid-frame disconnects,
+// oversized frames, slow-loris dribble) and a config thread hammering
+// the ledger / load-tail / peers / stats surfaces the heartbeat and
+// handlers touch from other threads. Three full create→stop→destroy
+// cycles stress lifecycle teardown with events still queued.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* nd_create(int port, int bind_all, unsigned long long max_frame,
+                int queue_cap);
+int nd_port(void* h);
+int nd_start(void* h);
+int nd_next(void* h, int timeout_ms, unsigned long long* conn_id,
+            int* kind, unsigned int* flags, char** data,
+            unsigned long long* len);
+void nd_free(char* data);
+int nd_send(void* h, unsigned long long conn_id, const char* data,
+            unsigned long long len);
+void nd_set_node_id(void* h, const char* node_id);
+void nd_set_load_tail(void* h, const char* tail);
+int nd_set_peers_json(void* h, const char* json);
+void nd_set_ping_native(void* h, int enabled);
+int nd_ledger_set(void* h, const char* json_res);
+int nd_ledger_try_charge(void* h, const char* json_res);
+int nd_ledger_charge(void* h, const char* json_res);
+int nd_ledger_release(void* h, const char* json_res);
+int nd_ledger_get(void* h, char* buf, int cap);
+unsigned long long nd_spilled(void* h);
+int nd_stats_json(void* h, char* buf, int cap);
+void nd_stop(void* h);
+void nd_destroy(void* h);
+}
+
+namespace {
+
+constexpr unsigned kFlagPrecharged = 1;
+constexpr int kEvClosed = 1;
+constexpr unsigned long long kMaxFrame = 1ull << 20;
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = write(fd, p, n);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+int dial(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+std::string frame(const std::string& payload) {
+  std::string out;
+  uint64_t n = payload.size();
+  for (int i = 7; i >= 0; i--)
+    out.push_back(static_cast<char>((n >> (8 * i)) & 0xff));
+  out.append(payload);
+  return out;
+}
+
+// 0x01 | u32-LE header len | JSON header | body (client.hybrid_frame).
+std::string hybrid(const std::string& header, const std::string& body) {
+  std::string payload;
+  payload.push_back(0x01);
+  uint32_t hlen = static_cast<uint32_t>(header.size());
+  payload.append(reinterpret_cast<const char*>(&hlen), 4);
+  payload.append(header);
+  payload.append(body);
+  return frame(payload);
+}
+
+bool read_reply(int fd, std::string* out) {
+  unsigned char hdr[8];
+  if (!read_all(fd, hdr, 8)) return false;
+  uint64_t n = 0;
+  for (int i = 0; i < 8; i++) n = (n << 8) | hdr[i];
+  if (n > kMaxFrame) return false;
+  out->resize(n);
+  return read_all(fd, out->empty() ? nullptr : &(*out)[0], n);
+}
+
+struct Counters {
+  std::atomic<uint64_t> pongs{0};
+  std::atomic<uint64_t> admitted{0};
+  std::atomic<uint64_t> refused{0};
+  std::atomic<uint64_t> echoes{0};
+  std::atomic<uint64_t> closes_seen{0};
+};
+
+// The daemon's drainer-pool analog: pop events, release admission
+// charges, echo message bodies back as the "reply".
+void responder(void* h, Counters* ctr) {
+  for (;;) {
+    unsigned long long conn_id = 0, len = 0;
+    int kind = 0;
+    unsigned flags = 0;
+    char* data = nullptr;
+    int rc = nd_next(h, 50, &conn_id, &kind, &flags, &data, &len);
+    if (rc < 0) return;  // stopped
+    if (rc == 0) continue;
+    if (kind == kEvClosed) {
+      ctr->closes_seen.fetch_add(1);
+      continue;
+    }
+    if ((flags & kFlagPrecharged) != 0) {
+      nd_ledger_release(h, "{\"CPU\": 1.0}");
+      ctr->admitted.fetch_add(1);
+    }
+    std::string reply(data, static_cast<size_t>(len));
+    nd_free(data);
+    nd_send(h, conn_id, reply.data(), reply.size());
+  }
+}
+
+void valid_client(int port, int rounds, Counters* ctr) {
+  int fd = dial(port);
+  if (fd < 0) return;
+  const std::string task_hdr =
+      "{\"type\": \"task\", \"tid\": \"ab12\", "
+      "\"res\": {\"CPU\": 1.0}, \"spillable\": true, "
+      "\"exclude\": [\"node-x\"]}";
+  for (int i = 0; i < rounds; i++) {
+    std::string reply;
+    // Natively-answered ping.
+    std::string ping = frame("{\"type\": \"ping\"}");
+    if (!write_all(fd, ping.data(), ping.size()) ||
+        !read_reply(fd, &reply))
+      break;
+    if (reply.find("\"pong\"") != std::string::npos)
+      ctr->pongs.fetch_add(1);
+    // Hybrid admission frame: either charged + echoed by a responder
+    // or refused natively with a spillback reply — one reply either
+    // way, so the serial protocol holds.
+    std::string body(64 + (i % 64), static_cast<char>(0x80));
+    std::string t = hybrid(task_hdr, body);
+    if (!write_all(fd, t.data(), t.size()) || !read_reply(fd, &reply))
+      break;
+    if (reply.find("\"spillback\"") != std::string::npos)
+      ctr->refused.fetch_add(1);
+    else if (reply == body)
+      ctr->echoes.fetch_add(1);
+    // Opaque frame → straight passthrough echo.
+    std::string op = frame(std::string(32, '\x02'));
+    if (!write_all(fd, op.data(), op.size()) || !read_reply(fd, &reply))
+      break;
+    if (reply == std::string(32, '\x02')) ctr->echoes.fetch_add(1);
+  }
+  close(fd);
+}
+
+void midframe_disconnector(int port, int rounds) {
+  for (int i = 0; i < rounds; i++) {
+    int fd = dial(port);
+    if (fd < 0) return;
+    // Partial header, partial payload, or header promising more bytes
+    // than ever arrive — then vanish.
+    std::string full = frame("{\"type\": \"ping\"}");
+    size_t cut = 1 + static_cast<size_t>(i) % (full.size() - 1);
+    write_all(fd, full.data(), cut);
+    close(fd);
+  }
+}
+
+void oversize_sender(int port, int rounds) {
+  for (int i = 0; i < rounds; i++) {
+    int fd = dial(port);
+    if (fd < 0) return;
+    uint64_t n = kMaxFrame + 1 + static_cast<uint64_t>(i);
+    unsigned char hdr[8];
+    for (int b = 7; b >= 0; b--) {
+      hdr[7 - b] = static_cast<unsigned char>((n >> (8 * b)) & 0xff);
+    }
+    write_all(fd, hdr, 8);
+    // The loop must close on the header alone; reading EOF proves it.
+    char c;
+    read(fd, &c, 1);
+    close(fd);
+  }
+}
+
+void slow_loris(int port, std::atomic<bool>* done) {
+  int fd = dial(port);
+  if (fd < 0) return;
+  std::string full = frame("{\"type\": \"ping\"}");
+  size_t off = 0;
+  while (!done->load() && off < full.size()) {
+    write_all(fd, full.data() + off, 1);
+    off++;
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  }
+  close(fd);
+}
+
+// Heartbeat analog: hammer every config/ledger/stats surface the
+// Python side touches while the loop thread reads them.
+void config_churn(void* h, std::atomic<bool>* done) {
+  int i = 0;
+  char buf[1 << 16];
+  while (!done->load()) {
+    nd_set_load_tail(h, "\"queued\": 0, \"running\": 1}");
+    nd_set_peers_json(
+        h,
+        "[{\"id\": \"peer-a\", \"queued\": 1, \"headroom\": 0.5, "
+        "\"avail\": {\"CPU\": 2.0}}, "
+        "{\"id\": \"peer-b\", \"queued\": 0, \"headroom\": 0.25, "
+        "\"avail\": {\"CPU\": 1.0}}]");
+    if (nd_ledger_try_charge(h, "{\"CPU\": 0.5}") == 1)
+      nd_ledger_release(h, "{\"CPU\": 0.5}");
+    if (i % 4 == 0 && nd_ledger_try_charge(h, "{\"CPU\": 3.5}") == 1) {
+      // Hold nearly the whole ledger briefly: concurrent admission
+      // frames race into the native refusal path.
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+      nd_ledger_release(h, "{\"CPU\": 3.5}");
+    }
+    nd_ledger_get(h, buf, sizeof(buf));
+    nd_stats_json(h, buf, sizeof(buf));
+    nd_spilled(h);
+    nd_set_ping_native(h, (i++ % 8) != 0);
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  nd_set_ping_native(h, 1);
+}
+
+int run_cycle(int cycle) {
+  // Small queue cap so backpressure pausing gets exercised too.
+  void* h = nd_create(0, 0, kMaxFrame, 64);
+  if (h == nullptr) {
+    fprintf(stderr, "nd_create failed\n");
+    return 1;
+  }
+  nd_set_node_id(h, "stress-node");
+  nd_ledger_set(h, "{\"CPU\": 4.0}");
+  nd_set_load_tail(h, "\"queued\": 0}");
+  if (nd_start(h) != 0) {
+    fprintf(stderr, "nd_start failed\n");
+    return 1;
+  }
+  int port = nd_port(h);
+
+  Counters ctr;
+  std::atomic<bool> done{false};
+  std::vector<std::thread> threads;
+  threads.emplace_back(responder, h, &ctr);
+  threads.emplace_back(responder, h, &ctr);
+  threads.emplace_back(config_churn, h, &done);
+  std::vector<std::thread> clients;
+  for (int i = 0; i < 4; i++)
+    clients.emplace_back(valid_client, port, 40, &ctr);
+  clients.emplace_back(midframe_disconnector, port, 20);
+  clients.emplace_back(oversize_sender, port, 10);
+  clients.emplace_back(slow_loris, port, &done);
+
+  for (size_t i = 0; i + 1 < clients.size(); i++) clients[i].join();
+  done.store(true);
+  clients.back().join();
+
+  // Stop with the responders possibly mid-nd_next and with whatever
+  // the loris left half-buffered: teardown must free it all.
+  nd_stop(h);
+  threads[0].join();
+  threads[1].join();
+  threads[2].join();
+  nd_destroy(h);
+
+  uint64_t pongs = ctr.pongs.load();
+  uint64_t handled = ctr.admitted.load() + ctr.refused.load();
+  uint64_t echoes = ctr.echoes.load();
+  printf("cycle %d: pongs=%llu admitted=%llu refused=%llu echoes=%llu "
+         "closes=%llu\n",
+         cycle, (unsigned long long)pongs,
+         (unsigned long long)ctr.admitted.load(),
+         (unsigned long long)ctr.refused.load(),
+         (unsigned long long)echoes,
+         (unsigned long long)ctr.closes_seen.load());
+  // Hostile traffic must not have starved the valid clients: every
+  // ping got a pong and every task frame was admitted or refused.
+  if (pongs < 4 * 40 / 2 || handled == 0 || echoes == 0) {
+    fprintf(stderr, "FAIL: valid traffic starved\n");
+    return 1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main() {
+  for (int cycle = 0; cycle < 3; cycle++) {
+    int rc = run_cycle(cycle);
+    if (rc != 0) return rc;
+  }
+  printf("node_dispatch_stress: PASS\n");
+  return 0;
+}

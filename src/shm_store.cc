@@ -34,6 +34,7 @@
 #include <sys/time.h>
 
 #include <algorithm>
+#include <string>
 #include <vector>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -697,6 +698,77 @@ uint64_t rts_capacity(void* handle) {
 
 uint64_t rts_num_objects(void* handle) {
   return reinterpret_cast<Store*>(handle)->hdr->num_objects;
+}
+
+// Per-process arena holdings, from the slot table's pin records (the
+// same data crash reclaim walks): for every live slot, each recorded
+// pinner is charged the slot's full alloc_size (pins are shares of the
+// whole object, not byte ranges), and SLOT_CREATED spans are charged
+// to their writer. Written as JSON into buf:
+//   {"pin_overflows":N,
+//    "pids":{"<pid>":{"pinned_bytes":B,"pinned_objects":O,"pins":P,
+//                     "creating_bytes":C,"creating_objects":M}, ...}}
+// Returns bytes written (excluding NUL), or -1 if cap is too small.
+int rts_pin_stats_json(void* handle, char* buf, int cap) {
+  Store* st = reinterpret_cast<Store*>(handle);
+  Header* h = st->hdr;
+  struct Agg {
+    int32_t pid;
+    uint64_t pinned_bytes, pinned_objects, pins;
+    uint64_t creating_bytes, creating_objects;
+  };
+  std::vector<Agg> aggs;
+  auto agg_of = [&aggs](int32_t pid) -> Agg* {
+    for (Agg& a : aggs)
+      if (a.pid == pid) return &a;
+    aggs.push_back({pid, 0, 0, 0, 0, 0});
+    return &aggs.back();
+  };
+  Lock(h);
+  uint64_t overflows = h->pin_overflows;
+  for (uint32_t i = 0; i < kMaxObjects; i++) {
+    Slot* s = &h->slots[i];
+    if (s->state == SLOT_FREE || s->state == SLOT_TOMBSTONE) continue;
+    if (s->state == SLOT_CREATED && s->owner_pid > 0) {
+      Agg* a = agg_of(s->owner_pid);
+      a->creating_bytes += s->alloc_size;
+      a->creating_objects++;
+    }
+    for (int j = 0; j < kPinnersPerSlot; j++) {
+      const PinRec& p = s->pinners[j];
+      if (p.pid <= 0 || p.count <= 0) continue;
+      Agg* a = agg_of(p.pid);
+      a->pinned_bytes += s->alloc_size;
+      a->pinned_objects++;
+      a->pins += static_cast<uint64_t>(p.count);
+    }
+  }
+  pthread_mutex_unlock(&h->mu);
+  std::string out;
+  char num[256];
+  snprintf(num, sizeof(num), "{\"pin_overflows\":%llu,\"pids\":{",
+           static_cast<unsigned long long>(overflows));
+  out.append(num);
+  bool first = true;
+  for (const Agg& a : aggs) {
+    if (!first) out.push_back(',');
+    first = false;
+    snprintf(num, sizeof(num),
+             "\"%d\":{\"pinned_bytes\":%llu,\"pinned_objects\":%llu,"
+             "\"pins\":%llu,\"creating_bytes\":%llu,"
+             "\"creating_objects\":%llu}",
+             a.pid, static_cast<unsigned long long>(a.pinned_bytes),
+             static_cast<unsigned long long>(a.pinned_objects),
+             static_cast<unsigned long long>(a.pins),
+             static_cast<unsigned long long>(a.creating_bytes),
+             static_cast<unsigned long long>(a.creating_objects));
+    out.append(num);
+  }
+  out.append("}}");
+  if (static_cast<int>(out.size()) + 1 > cap) return -1;
+  memcpy(buf, out.data(), out.size());
+  buf[out.size()] = '\0';
+  return static_cast<int>(out.size());
 }
 
 // ---------------------------------------------------------------------------

@@ -1,0 +1,399 @@
+"""Dispatch-plane parity: native C++ front end vs pure-Python fallback.
+
+The node daemon's dispatch socket has two implementations — the C++
+epoll loop (src/node_dispatch.cc, default) and the pure-Python accept
+loop (`RAY_TPU_NATIVE_DISPATCH=0`). They must be observationally
+identical: same task/actor results, same typed errors, same spillback
+refusal replies (retry_at included), same load-report vocabulary. The
+parametrized cluster fixture runs every scenario under both planes;
+the chaos test then crashes workers mid-dispatch and requires the
+native loop to keep serving with an intact resource ledger.
+
+Direct NativeDispatch unit tests (no cluster) cover the binding's
+wire handling: framing, native pong, admission/refusal, ledger
+semantics vs ResourceSet, oversized-frame teardown, destroy guard.
+"""
+
+import json
+import socket
+import struct
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import NodeAffinitySchedulingStrategy
+from ray_tpu.cluster_utils import RealCluster
+from ray_tpu.core import runtime as _runtime
+
+
+def _rt():
+    return _runtime.global_runtime()
+
+
+@pytest.fixture(scope="class", params=["0", "1"], ids=["py", "native"])
+def pcluster(request):
+    """Two 1-CPU daemons, both forced onto one dispatch plane; the
+    driver head contributes no CPUs so every scenario crosses the
+    dispatch socket under test."""
+    ray.shutdown()
+    cluster = RealCluster()
+    env = {"RAY_TPU_NATIVE_DISPATCH": request.param}
+    try:
+        cluster.add_node(num_cpus=1, env=env)
+        cluster.add_node(num_cpus=1, env=env)
+        cluster.connect(num_cpus=0)
+        yield cluster
+    finally:
+        cluster.shutdown()
+
+
+class TestDispatchParity:
+    def test_tasks_and_typed_task_error(self, pcluster):
+        @ray.remote
+        def double(x):
+            return 2 * x
+
+        assert ray.get([double.remote(i) for i in range(6)],
+                       timeout=60) == [0, 2, 4, 6, 8, 10]
+
+        @ray.remote(max_retries=0)
+        def boom():
+            raise ValueError("boom-parity")
+
+        with pytest.raises(ray.TaskError) as ei:
+            ray.get(boom.remote(), timeout=60)
+        assert "boom-parity" in str(ei.value)
+
+    def test_actor_lifecycle_and_typed_errors(self, pcluster):
+        @ray.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+            def fail(self):
+                raise KeyError("actor-boom")
+
+        c = Counter.remote()
+        assert ray.get([c.inc.remote() for _ in range(3)],
+                       timeout=60) == [1, 2, 3]
+        with pytest.raises(ray.TaskError) as ei:
+            ray.get(c.fail.remote(), timeout=60)
+        assert "actor-boom" in str(ei.value)
+        # the error left the actor alive — state intact
+        assert ray.get(c.inc.remote(), timeout=60) == 4
+        # release the actor's charge (an alive actor holds a running
+        # slot; the ledger test below asserts full quiescence)
+        ray.kill(c)
+
+    def test_actor_death_typed_error(self, pcluster):
+        @ray.remote
+        class Mortal:
+            def die(self):
+                import os
+
+                os._exit(1)
+
+            def ping(self):
+                return "alive"
+
+        a = Mortal.remote()
+        assert ray.get(a.ping.remote(), timeout=60) == "alive"
+        with pytest.raises((ray.TaskError, ray.ActorDiedError)):
+            ray.get(a.die.remote(), timeout=60)
+        with pytest.raises(ray.ActorDiedError):
+            ray.get(a.ping.remote(), timeout=60)
+
+    def test_worker_crash_task_typed_error(self, pcluster):
+        @ray.remote(max_retries=0)
+        def die():
+            import os
+
+            os._exit(1)
+
+        with pytest.raises(ray.TaskError):
+            ray.get(die.remote(), timeout=60)
+
+    def test_spillback_refusal_and_load_vocabulary(self, pcluster):
+        """A crafted spillable push to a saturated daemon must be
+        refused the same way by both planes: spillback=True, retry_at
+        naming the idle peer, and a load report speaking the full
+        heartbeat vocabulary (shm_pins attribution included)."""
+
+        @ray.remote(num_cpus=1, scheduling_strategy=(
+            NodeAffinitySchedulingStrategy("daemon-1", soft=False)))
+        class Holder:
+            def ready(self):
+                return True
+
+        h = Holder.remote()
+        assert ray.get(h.ready.remote(), timeout=60) is True
+        # Let one heartbeat land so the daemon's peer view (native: the
+        # pushed digest) knows daemon-2 is idle.
+        time.sleep(0.6)
+        node1 = _rt().scheduler.get_node("daemon-1")
+        r = node1.client.call({
+            "type": "task", "task_id": b"probe-parity",
+            "args": (), "kwargs": {}, "num_returns": 1,
+            "return_ids": [], "resources": {"CPU": 1.0},
+            "spillable": True, "spill_exclude": [],
+        })
+        assert r.get("spillback") is True
+        assert r.get("retry_at") == "daemon-2"
+        load = r.get("load")
+        assert load is not None
+        assert {"available", "total", "queued", "running", "spilled",
+                "host", "event_stats", "transfer",
+                "shm_pins"} <= set(load)
+        assert load["available"].get("CPU", 0.0) == 0.0
+        # shm attribution carries labeled per-pid holders
+        assert "holders" in load["shm_pins"]
+        for holder in load["shm_pins"]["holders"]:
+            assert {"pid", "label", "pinned_bytes"} <= set(holder)
+        ray.kill(h)
+
+    def test_ledger_restored_after_errors(self, pcluster):
+        """Typed failures above must not leak admission charges: both
+        daemons report a fully available ledger once quiesced."""
+        deadline = time.monotonic() + 30
+        while True:
+            loads = [
+                _rt().scheduler.get_node(nid).client.call(
+                    {"type": "ping"})["load"]
+                for nid in ("daemon-1", "daemon-2")]
+            if all(ld["available"].get("CPU") == 1.0
+                   and ld["running"] == 0 for ld in loads):
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(f"ledger leaked: {loads}")
+            time.sleep(0.2)
+
+
+class TestNativeChaos:
+    """Worker crashes mid-dispatch with the native loop in front: the
+    C plane must survive, keep answering, and not strand ledger
+    charges."""
+
+    @pytest.fixture(scope="class")
+    def chaos_cluster(self):
+        ray.shutdown()
+        cluster = RealCluster()
+        try:
+            cluster.add_node(num_cpus=1,
+                             env={"RAY_TPU_NATIVE_DISPATCH": "1"})
+            cluster.connect(num_cpus=0)
+            yield cluster
+        finally:
+            cluster.shutdown()
+
+    def test_native_loop_survives_worker_crashes(self, chaos_cluster):
+        @ray.remote(max_retries=0)
+        def die():
+            import os
+
+            os._exit(1)
+
+        @ray.remote
+        def ok(x):
+            return x + 1
+
+        # Interleave crashers with healthy tasks: each crash kills the
+        # worker process while the native loop has admitted (and
+        # precharged) the task.
+        crashers = [die.remote() for _ in range(3)]
+        for ref in crashers:
+            with pytest.raises(ray.TaskError):
+                ray.get(ref, timeout=60)
+        assert ray.get([ok.remote(i) for i in range(4)],
+                       timeout=120) == [1, 2, 3, 4]
+        # The daemon still answers protocol-level pings natively and
+        # the crashes released their admission charges.
+        node = _rt().scheduler.get_node("daemon-1")
+        deadline = time.monotonic() + 30
+        while True:
+            load = node.client.call({"type": "ping"})["load"]
+            if (load["available"].get("CPU") == 1.0
+                    and load["running"] == 0):
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(f"ledger leaked: {load}")
+            time.sleep(0.2)
+        # and the native handler stats saw real traffic
+        native = load["event_stats"].get("node_dispatch_native", {})
+        assert native, load["event_stats"].keys()
+
+
+# ---------------------------------------------------------------------------
+# Direct NativeDispatch unit coverage (no cluster)
+# ---------------------------------------------------------------------------
+
+_LEN = struct.Struct("!Q")
+_HLEN = struct.Struct("<I")
+
+
+def _frame(payload: bytes) -> bytes:
+    return _LEN.pack(len(payload)) + payload
+
+
+def _hybrid(header: dict, body: bytes) -> bytes:
+    h = json.dumps(header).encode()
+    return _frame(b"\x01" + _HLEN.pack(len(h)) + h + body)
+
+
+def _read_frame(sock) -> bytes:
+    buf = b""
+    while len(buf) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(buf))
+        if not chunk:
+            raise EOFError
+        buf += chunk
+    (n,) = _LEN.unpack(buf)
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise EOFError
+        out += chunk
+    return out
+
+
+@pytest.fixture
+def nd():
+    from ray_tpu._native.node_dispatch import NativeDispatch
+
+    srv = NativeDispatch(queue_cap=16)
+    try:
+        yield srv
+    finally:
+        srv.stop()
+        srv.destroy()
+
+
+class TestNativeDispatchUnit:
+    def test_native_pong_and_refusal(self, nd):
+        nd.set_node_id("unit-node")
+        nd.ledger_set({"CPU": 1.0})
+        nd.set_load_report({"available": {"CPU": 1.0}, "queued": 0,
+                            "spilled": 0})
+        nd.set_peers([{"id": "peer-a", "queued": 0, "headroom": 1.0,
+                       "avail": {"CPU": 1.0}}])
+        nd.set_ping_native(True)
+        nd.start()
+        with socket.create_connection(("127.0.0.1", nd.port),
+                                      timeout=5) as s:
+            s.sendall(_frame(json.dumps({"type": "ping"}).encode()))
+            pong = json.loads(_read_frame(s))
+            assert pong["type"] == "pong"
+            assert pong["node_id"] == "unit-node"
+            assert pong["load"]["available"] == {"CPU": 1.0}
+            # saturate, then push a spillable task: refused natively,
+            # redirected to the pushed peer digest's feasible candidate
+            nd.ledger_charge({"CPU": 1.0})
+            s.sendall(_hybrid(
+                {"type": "task", "tid": "ab12",
+                 "res": {"CPU": 1.0}, "spillable": True},
+                b"\x00" * 16))
+            refusal = json.loads(_read_frame(s))
+            assert refusal["type"] == "result"
+            assert refusal["spillback"] is True
+            assert refusal["retry_at"] == "peer-a"
+            # a task no peer can fit is refused with no redirect —
+            # same feasibility rule as _recommend_spill_target
+            s.sendall(_hybrid(
+                {"type": "task", "tid": "ab13",
+                 "res": {"CPU": 2.0}, "spillable": True},
+                b"\x00" * 16))
+            refusal2 = json.loads(_read_frame(s))
+            assert refusal2["spillback"] is True
+            assert refusal2["retry_at"] is None
+        assert nd.spilled() == 2
+
+    def test_admission_precharge_and_reply(self, nd):
+        from ray_tpu._native.node_dispatch import (EV_MESSAGE,
+                                                   FLAG_PRECHARGED)
+
+        nd.ledger_set({"CPU": 1.0})
+        nd.start()
+        with socket.create_connection(("127.0.0.1", nd.port),
+                                      timeout=5) as s:
+            body = b"opaque-task-body"
+            s.sendall(_hybrid(
+                {"type": "task", "tid": "cd34",
+                 "res": {"CPU": 1.0}, "spillable": True}, body))
+            ev = None
+            deadline = time.monotonic() + 5
+            while ev is None and time.monotonic() < deadline:
+                ev = nd.next_event(timeout_ms=200)
+            assert ev is not None
+            conn_id, kind, flags, payload = ev
+            assert kind == EV_MESSAGE
+            assert flags & FLAG_PRECHARGED
+            assert payload.endswith(body)  # header rides in front
+            # the admitted charge is visible in the live ledger (zero
+            # entries drop, matching ResourceSet.to_dict)
+            assert nd.ledger_available() == {}
+            nd.ledger_release({"CPU": 1.0})
+            assert nd.send(conn_id,
+                           json.dumps({"type": "result",
+                                       "result": "done"}).encode())
+            reply = json.loads(_read_frame(s))
+            assert reply == {"type": "result", "result": "done"}
+
+    def test_ledger_matches_resource_set_semantics(self, nd):
+        nd.ledger_set({"CPU": 2.0, "mem": 0.5})
+        assert nd.ledger_available() == {"CPU": 2.0, "mem": 0.5}
+        assert nd.ledger_try_charge({"CPU": 1.5}) is True
+        assert nd.ledger_try_charge({"CPU": 1.0}) is False  # atomic: no debit
+        assert nd.ledger_available()["CPU"] == 0.5
+        # charge() mirrors ResourceSet.subtract: raises on underflow
+        with pytest.raises(ValueError):
+            nd.ledger_charge({"CPU": 1.0})
+        nd.ledger_release({"CPU": 1.5})
+        assert nd.ledger_available() == {"CPU": 2.0, "mem": 0.5}
+
+    def test_oversized_frame_closes_connection(self):
+        from ray_tpu._native.node_dispatch import (EV_CLOSED,
+                                                   NativeDispatch)
+
+        srv = NativeDispatch(max_frame=1 << 12, queue_cap=16)
+        try:
+            srv.start()
+            with socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=5) as s:
+                s.sendall(_LEN.pack((1 << 12) + 1))
+                s.sendall(b"x" * 64)
+                s.settimeout(5)
+                assert s.recv(1) == b""  # server hung up
+            ev = None
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                got = srv.next_event(timeout_ms=200)
+                if got is not None and got[1] == EV_CLOSED:
+                    ev = got
+                    break
+            assert ev is not None, "no EV_CLOSED for torn-down conn"
+        finally:
+            srv.stop()
+            srv.destroy()
+
+    def test_destroy_guard_makes_calls_safe(self):
+        from ray_tpu._native.node_dispatch import NativeDispatch
+
+        srv = NativeDispatch(queue_cap=16)
+        srv.start()
+        srv.stop()
+        with pytest.raises(StopIteration):
+            while True:
+                srv.next_event(timeout_ms=50)
+        srv.destroy()
+        srv.destroy()  # idempotent
+        assert srv.send(1, b"x") is False
+        assert srv.stats() == {}
+        assert srv.ledger_available() == {}
+        assert srv.spilled() == 0
+        with pytest.raises(StopIteration):
+            srv.next_event(timeout_ms=50)

@@ -283,6 +283,24 @@ def test_xp_inventory_accounts_for_control_plane():
             and by_type["pull_complete"]["handlers"])
 
 
+def test_xp_inventory_marks_native_plane():
+    """Dispatch-socket ops the C++ front end (src/node_dispatch.cc)
+    also implements must carry the static native-plane annotation —
+    the AST pass can't see C++, and an unannotated native op would
+    make the inventory lie about which plane answers it."""
+    from ray_tpu.devtools.xp.protocol import NATIVE_PLANE
+
+    _, inventory = run_xp([PKG], None)
+    by_type = {row["type"]: row for row in inventory}
+    for t in ("ping", "pong", "task", "result"):
+        assert t in NATIVE_PLANE
+        assert by_type[t].get("native") == NATIVE_PLANE[t]
+    # and the annotation never outlives the Python vocabulary: every
+    # NATIVE_PLANE key must still be a real message type
+    assert set(NATIVE_PLANE) <= set(by_type), (
+        set(NATIVE_PLANE) - set(by_type))
+
+
 def test_xp_baseline_suppresses_and_flags_stale(tmp_path):
     """A matching baseline entry (with a reason) suppresses; an entry
     matching nothing — or lacking a reason — becomes an active

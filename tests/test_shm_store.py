@@ -86,6 +86,33 @@ def test_pinned_objects_not_evicted(store):
     store.release(_id(200))
 
 
+def test_pin_stats_attribution(store):
+    """pin_stats() walks the slot table: this process's pins show up
+    under its pid with whole-object byte charges, and drain on
+    release (the daemon joins these to task/actor labels for
+    /api/event_stats)."""
+    blob = b"a" * (256 * 1024)
+    store.put(_id(700), blob)
+    store.put(_id(701), blob)
+    store.get(_id(700), pin=True)
+    store.get(_id(700), pin=True)  # second pin, same object
+    store.get(_id(701), pin=True)
+    stats = store.pin_stats()
+    me = stats["pids"].get(str(os.getpid()))
+    assert me is not None, stats
+    assert me["pinned_objects"] == 2
+    assert me["pins"] == 3
+    # whole-object attribution: each pinned object charges its full
+    # (alignment-rounded) allocation once
+    assert me["pinned_bytes"] >= 2 * len(blob)
+    store.release(_id(700))
+    store.release(_id(700))
+    store.release(_id(701))
+    after = store.pin_stats()
+    assert str(os.getpid()) not in after["pids"]
+    assert after["pin_overflows"] == 0
+
+
 def test_store_full_when_all_pinned(store):
     blob = b"f" * (1024 * 1024)
     ids = []
