@@ -681,6 +681,51 @@ class RemotePlane:
         with self._located_lock:
             return dict(self._pull_source_counts)
 
+    def prefetch_objects(self, refs, node_ids) -> Dict[str, int]:
+        """Pre-stage objects on target nodes ahead of the calls that
+        consume them (the RLHF weight-refresh plane): each node's
+        daemon gets a `weight_refresh` message with relay fetch hints
+        and pulls immediately, so by the time the generator actors'
+        refresh calls arrive their arg fetches short-circuit on
+        contains(). Dispatch order walks `node_ids` as given and
+        `_fetch_candidates` enrolls each node in the marker's relay
+        tree as it goes — the prefetch wave IS the broadcast tree.
+        Best-effort: a node that cannot prefetch reports -1 and its
+        actor-call args fall back to the normal pull path.
+        Returns node_id -> prefetched-object count."""
+        from ..core.runtime import _ShmMarker
+
+        markers = []
+        for ref in refs:
+            stored = self.rt.store.get_if_exists(ref.id())
+            if stored is not None and isinstance(stored.data,
+                                                _ShmMarker):
+                markers.append(stored.data)
+        out: Dict[str, int] = {}
+        if not markers:
+            return out
+        for nid in node_ids:
+            node = self.rt.scheduler.get_node(nid)
+            if not isinstance(node, RemoteNodeState):
+                continue
+            fetch = [(d.key, self._fetch_candidates(d, node))
+                     for d in markers]
+            fetch = [(k, eps) for k, eps in fetch if eps]
+            if not fetch:
+                continue
+            try:
+                reply = node.client.call({"type": "weight_refresh",
+                                          "fetch": fetch})
+                out[nid] = int(reply.get("pulled", 0))
+            except Exception:  # noqa: BLE001 — prefetch is advisory
+                out[nid] = -1
+        if out:
+            get_recorder().record(
+                "rlhf", "weight_refresh_prefetch",
+                objects=len(markers), nodes=len(out),
+                pulled=sum(v for v in out.values() if v > 0))
+        return out
+
     # -- cross-node object pulls (driver get) ----------------------------
     def ensure_local(self, marker) -> None:
         """Pull a remote-located object into the driver's arena from

@@ -455,6 +455,26 @@ class Runtime:
         id_to_ref = {r.id(): r for r in refs}
         ready, not_ready = self.store.wait(
             [r.id() for r in refs], num_returns, timeout)
+        if fetch_local:
+            # Reference semantics (ray.wait fetch_local=True): a ready
+            # ref's VALUE must be resident in the local store, not just
+            # located somewhere in the cluster. Pull remote-only
+            # payloads down before reporting them ready; a pull that
+            # fails leaves the ref ready — get() owns the
+            # reconstruction/inline-stream fallback path.
+            for oid in ready:
+                stored = self.store.get_if_exists(oid)
+                d = stored.data if stored is not None else None
+                if (isinstance(d, _ShmMarker)
+                        and self.remote_plane is not None
+                        and (d.node_id is not None
+                             or getattr(d, "locations", None))
+                        and (self.shm is None
+                             or not self.shm.contains(d.key))):
+                    try:
+                        self.remote_plane.ensure_local(d)
+                    except (KeyError, ObjectStoreFullError):
+                        pass
         return ([id_to_ref[i] for i in ready], [id_to_ref[i] for i in not_ready])
 
     def as_future(self, ref: ObjectRef):

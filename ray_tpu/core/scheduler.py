@@ -135,6 +135,7 @@ class Scheduler:
         self._infeasible: List[TaskSpec] = []
         self._dispatch = dispatch
         self._rng = random.Random(0)
+        self._spread_seq = 0
 
     # -- topology ---------------------------------------------------------
     def add_node(self, node: NodeState) -> None:
@@ -487,7 +488,14 @@ class Scheduler:
             return self._hybrid(fitting) if strat.soft else None
 
         if isinstance(strat, SpreadSchedulingStrategy):
-            return self._least_loaded(fitting)
+            # Round-robin, not least-loaded (reference:
+            # spread_scheduling_policy.cc next_spread_node_index_):
+            # actors hold 0 CPUs while alive, so a least-loaded min()
+            # ties on every node and packs all spread actors onto the
+            # first one.
+            self._spread_seq += 1
+            ordered = sorted(fitting, key=lambda n: n.node_id)
+            return ordered[self._spread_seq % len(ordered)]
 
         return self._hybrid(fitting)
 

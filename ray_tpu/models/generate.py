@@ -222,21 +222,22 @@ def prefill_sample(cfg: TransformerConfig, params, cache: KVCache,
     return cache, tok
 
 
-@partial(jax.jit, static_argnums=(0, 6), donate_argnums=(2,))
-def prefill_sample_batch(cfg: TransformerConfig, params, cache: KVCache,
-                         tokens: jax.Array, lengths: jax.Array,
-                         slots: jax.Array, top_k: int,
-                         temps: jax.Array, key: jax.Array
-                         ) -> Tuple[KVCache, jax.Array]:
-    """Prefill a BATCH of padded prompts (W, S_bucket) into their cache
-    slots and sample each one's first token in ONE dispatch.
+def token_logp(logits: jax.Array, toks: jax.Array) -> jax.Array:
+    """log π(tok): log_softmax of the RAW logits (no temperature, no
+    top-k mask) gathered at the sampled token — the policy probability
+    an RLHF ratio term needs, matching rl/grpo.py's token_logp over
+    forward logits. (..., V), (...,) int -> (...,) float32."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(
+        lp, toks[..., None].astype(jnp.int32), axis=-1)[..., 0]
 
-    Admission waves are the engine's second-largest device cost: each
-    single-sequence prefill streams the full weights from HBM, so W
-    serial prefills cost ~W× one batched prefill (memory-bound). Rows
-    whose slot index is out of range (the fixed-W tile's padding) are
-    dropped by the scatter and their sampled token is garbage the
-    caller ignores. Compiles once per (W, S_bucket)."""
+
+def _prefill_batch_core(cfg: TransformerConfig, params, cache: KVCache,
+                        tokens: jax.Array, lengths: jax.Array,
+                        slots: jax.Array) -> Tuple[KVCache, jax.Array]:
+    """Batched-prefill body shared by the sampling wrappers: write each
+    prompt's KV into its slot, return (cache', last-real-token logits
+    (W, V))."""
     W, S = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]          # (W, S, D)
     sin, cos = rope_tables(cfg, S)
@@ -257,8 +258,42 @@ def prefill_sample_batch(cfg: TransformerConfig, params, cache: KVCache,
     head = (params["embed"].T if cfg.tie_embeddings
             else params["lm_head"]).astype(cfg.dtype)
     logits = (last @ head).astype(jnp.float32)[:, 0]       # (W, V)
+    return KVCache(k=k, v=v, seq_lens=seq_lens), logits
+
+
+@partial(jax.jit, static_argnums=(0, 6), donate_argnums=(2,))
+def prefill_sample_batch(cfg: TransformerConfig, params, cache: KVCache,
+                         tokens: jax.Array, lengths: jax.Array,
+                         slots: jax.Array, top_k: int,
+                         temps: jax.Array, key: jax.Array
+                         ) -> Tuple[KVCache, jax.Array]:
+    """Prefill a BATCH of padded prompts (W, S_bucket) into their cache
+    slots and sample each one's first token in ONE dispatch.
+
+    Admission waves are the engine's second-largest device cost: each
+    single-sequence prefill streams the full weights from HBM, so W
+    serial prefills cost ~W× one batched prefill (memory-bound). Rows
+    whose slot index is out of range (the fixed-W tile's padding) are
+    dropped by the scatter and their sampled token is garbage the
+    caller ignores. Compiles once per (W, S_bucket)."""
+    cache, logits = _prefill_batch_core(cfg, params, cache, tokens,
+                                        lengths, slots)
     toks = sample(logits, key, temperature=temps, top_k=top_k)
-    return KVCache(k=k, v=v, seq_lens=seq_lens), toks
+    return cache, toks
+
+
+@partial(jax.jit, static_argnums=(0, 6), donate_argnums=(2,))
+def prefill_sample_batch_lp(cfg: TransformerConfig, params,
+                            cache: KVCache, tokens: jax.Array,
+                            lengths: jax.Array, slots: jax.Array,
+                            top_k: int, temps: jax.Array, key: jax.Array
+                            ) -> Tuple[KVCache, jax.Array, jax.Array]:
+    """prefill_sample_batch that ALSO returns each sampled token's
+    log-probability (W,) — the rollout plane's ratio-term capture."""
+    cache, logits = _prefill_batch_core(cfg, params, cache, tokens,
+                                        lengths, slots)
+    toks = sample(logits, key, temperature=temps, top_k=top_k)
+    return cache, toks, token_logp(logits, toks)
 
 
 def _suffix_layer(cfg: TransformerConfig, q_offset: int, sin, cos,
@@ -303,17 +338,23 @@ def _suffix_forward(cfg: TransformerConfig, params, prefix_k, prefix_v,
     return rms_norm(x, params["final_norm"], cfg.norm_eps), ks, vs
 
 
-def _last_token_sample(cfg: TransformerConfig, params, x, lens, temps,
-                       top_k, key):
-    """Sample one token per row from the last REAL position of a
-    final-normed batch (W, S, D)."""
+def _last_token_logits(cfg: TransformerConfig, params, x, lens):
+    """Head logits from the last REAL position of a final-normed batch
+    (W, S, D) -> (W, V)."""
     W = x.shape[0]
     idx = (lens - 1).astype(jnp.int32)[:, None, None]
     last = jnp.take_along_axis(
         x, jnp.broadcast_to(idx, (W, 1, x.shape[2])), axis=1)
     head = (params["embed"].T if cfg.tie_embeddings
             else params["lm_head"]).astype(cfg.dtype)
-    logits = (last @ head).astype(jnp.float32)[:, 0]
+    return (last @ head).astype(jnp.float32)[:, 0]
+
+
+def _last_token_sample(cfg: TransformerConfig, params, x, lens, temps,
+                       top_k, key):
+    """Sample one token per row from the last REAL position of a
+    final-normed batch (W, S, D)."""
+    logits = _last_token_logits(cfg, params, x, lens)
     return sample(logits, key, temperature=temps, top_k=top_k)
 
 
@@ -337,6 +378,16 @@ def prefill_suffix_batch(cfg: TransformerConfig, params, cache: KVCache,
     suffix_lens: REAL suffix token counts (>= 1; the engine never
     routes an exact-prefix prompt here). Returns (cache', first tokens
     (W,)). Compiles once per (W, Sp, Sq_bucket)."""
+    cache, logits = _prefill_suffix_core(
+        cfg, params, cache, prefix_k, prefix_v, tokens, suffix_lens,
+        slots)
+    toks = sample(logits, key, temperature=temps, top_k=top_k)
+    return cache, toks
+
+
+def _prefill_suffix_core(cfg: TransformerConfig, params, cache: KVCache,
+                         prefix_k, prefix_v, tokens, suffix_lens, slots
+                         ) -> Tuple[KVCache, jax.Array]:
     W, Sq = tokens.shape
     Sp = prefix_k.shape[1]
     # 1. Prefix KV into the slot rows (broadcast copy; padding rows
@@ -359,10 +410,25 @@ def prefill_suffix_batch(cfg: TransformerConfig, params, cache: KVCache,
     seq_lens = cache.seq_lens.at[slots].set(
         Sp + suffix_lens, mode="drop")
 
-    # 4. First token from the last REAL suffix position.
-    toks = _last_token_sample(cfg, params, x, suffix_lens, temps,
-                              top_k, key)
-    return KVCache(k=k, v=v, seq_lens=seq_lens), toks
+    # 4. Logits at the last REAL suffix position.
+    logits = _last_token_logits(cfg, params, x, suffix_lens)
+    return KVCache(k=k, v=v, seq_lens=seq_lens), logits
+
+
+@partial(jax.jit, static_argnums=(0, 8), donate_argnums=(2,))
+def prefill_suffix_batch_lp(cfg: TransformerConfig, params,
+                            cache: KVCache, prefix_k: jax.Array,
+                            prefix_v: jax.Array, tokens: jax.Array,
+                            suffix_lens: jax.Array, slots: jax.Array,
+                            top_k: int, temps: jax.Array, key: jax.Array
+                            ) -> Tuple[KVCache, jax.Array, jax.Array]:
+    """prefill_suffix_batch that ALSO returns each first token's
+    log-probability (W,)."""
+    cache, logits = _prefill_suffix_core(
+        cfg, params, cache, prefix_k, prefix_v, tokens, suffix_lens,
+        slots)
+    toks = sample(logits, key, temperature=temps, top_k=top_k)
+    return cache, toks, token_logp(logits, toks)
 
 
 @partial(jax.jit, static_argnums=(0, 7))
@@ -380,6 +446,22 @@ def first_token_suffix_sample(cfg: TransformerConfig, params,
     x, _, _ = _suffix_forward(cfg, params, prefix_k, prefix_v, tokens)
     return _last_token_sample(cfg, params, x, suffix_lens, temps,
                               top_k, key)
+
+
+@partial(jax.jit, static_argnums=(0, 7))
+def first_token_suffix_sample_lp(cfg: TransformerConfig, params,
+                                 prefix_k: jax.Array,
+                                 prefix_v: jax.Array,
+                                 tokens: jax.Array,
+                                 suffix_lens: jax.Array,
+                                 temps: jax.Array, top_k: int,
+                                 key: jax.Array
+                                 ) -> Tuple[jax.Array, jax.Array]:
+    """first_token_suffix_sample + per-token log-probability (W,)."""
+    x, _, _ = _suffix_forward(cfg, params, prefix_k, prefix_v, tokens)
+    logits = _last_token_logits(cfg, params, x, suffix_lens)
+    toks = sample(logits, key, temperature=temps, top_k=top_k)
+    return toks, token_logp(logits, toks)
 
 
 def compute_prefix_kv(cfg: TransformerConfig, params,
@@ -409,6 +491,11 @@ def first_token_sample(cfg: TransformerConfig, params, tokens: jax.Array,
     and decode continues from this token (the engine overrides the
     slot's cur_token), so no recomputed sample can diverge from what
     the client already saw."""
+    logits = _first_token_logits(cfg, params, tokens, lengths)
+    return sample(logits, key, temperature=temps, top_k=top_k)
+
+
+def _first_token_logits(cfg: TransformerConfig, params, tokens, lengths):
     from .transformer import _lm_head, forward_hidden
 
     # forward_hidden output is ALREADY final-norm'd — apply the head
@@ -418,8 +505,18 @@ def first_token_sample(cfg: TransformerConfig, params, tokens: jax.Array,
     idx = (lengths - 1).astype(jnp.int32)[:, None, None]
     last = jnp.take_along_axis(
         x, jnp.broadcast_to(idx, (x.shape[0], 1, x.shape[2])), axis=1)
-    logits = (last @ _lm_head(cfg, params)).astype(jnp.float32)[:, 0]
-    return sample(logits, key, temperature=temps, top_k=top_k)
+    return (last @ _lm_head(cfg, params)).astype(jnp.float32)[:, 0]
+
+
+@partial(jax.jit, static_argnums=(0, 5))
+def first_token_sample_lp(cfg: TransformerConfig, params,
+                          tokens: jax.Array, lengths: jax.Array,
+                          temps: jax.Array, top_k: int, key: jax.Array
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """first_token_sample + per-token log-probability (W,)."""
+    logits = _first_token_logits(cfg, params, tokens, lengths)
+    toks = sample(logits, key, temperature=temps, top_k=top_k)
+    return toks, token_logp(logits, toks)
 
 
 def _decode_core(cfg: TransformerConfig, params, cache: KVCache,
@@ -476,6 +573,27 @@ def decode_multi(cfg: TransformerConfig, params, cache: KVCache,
     subs = jax.random.split(key, num_steps)
     (cache, _), toks = lax.scan(body, (cache, tokens), subs)
     return cache, toks
+
+
+@partial(jax.jit, static_argnums=(0, 5, 6), donate_argnums=(2,))
+def decode_multi_lp(cfg: TransformerConfig, params, cache: KVCache,
+                    tokens: jax.Array, temps: jax.Array, num_steps: int,
+                    top_k: int, key: jax.Array
+                    ) -> Tuple[KVCache, jax.Array, jax.Array]:
+    """decode_multi that ALSO returns each sampled token's
+    log-probability (num_steps, B) — per-token logp capture for the
+    RLHF rollout plane's ratio term. One extra log_softmax + gather per
+    fused tick; engines that don't need it keep using decode_multi."""
+
+    def body(carry, sub):
+        cache, tok = carry
+        cache, logits = _decode_core(cfg, params, cache, tok)
+        tok = sample(logits, sub, temperature=temps, top_k=top_k)
+        return (cache, tok), (tok, token_logp(logits, tok))
+
+    subs = jax.random.split(key, num_steps)
+    (cache, _), (toks, lps) = lax.scan(body, (cache, tokens), subs)
+    return cache, toks, lps
 
 
 def sample(logits: jax.Array, key: jax.Array, *,

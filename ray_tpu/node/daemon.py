@@ -910,6 +910,29 @@ class NodeDaemon:
             else:
                 send_msg(conn, reply)
             return True
+        if mtype == "weight_refresh":
+            # RLHF refresh prefetch: pull the published param blocks
+            # into this node's arena BEFORE the generator actors'
+            # refresh calls arrive — the later actor-call fetch
+            # entries short-circuit on contains(), so the transfer
+            # overlaps with whatever the actors are still finishing.
+            # The hints carry relay-tree parents, so the prefetch wave
+            # IS the broadcast tree, not a producer star.
+            missing, pulled = self._ensure_local(msg.get("fetch"))
+            if pulled:
+                with contextlib.suppress(Exception):
+                    send_msg(conn, {"type": "pull_complete",
+                                    "node_id": self.node_id,
+                                    "pulls": [(k, s) for k, s in pulled]})
+            reply = {"type": "result",
+                     "pulled": len(pulled),
+                     "fetch_failed": (None if missing is None
+                                      else bytes(missing).hex())}
+            if msg.get("_json"):
+                self._send_json(conn, reply)
+            else:
+                send_msg(conn, reply)
+            return True
         if mtype in ("task_xlang", "actor_create_xlang",
                      "actor_call_xlang"):
             self._handle_xlang(conn, msg, conn_actors)

@@ -467,7 +467,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
         use_pallas = False
     # pallas interpret mode (CPU tests) can't run under shard_map's
     # varying-axes checks — those tests exercise the jnp reference.
-    if interpret and jax.typeof(qt).vma:
+    # (jax.typeof is newer-jax only; without it there are no vma checks
+    # to trip, so the guard is moot.)
+    _typeof = getattr(jax, "typeof", None)
+    if interpret and _typeof is not None and _typeof(qt).vma:
         use_pallas = False
     offs = jnp.asarray([[q_offset, kv_offset]], jnp.float32)
     out = _flash(qt, kt, vt, offs, causal, sm_scale, bq, bk, use_pallas,

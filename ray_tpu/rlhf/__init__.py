@@ -1,0 +1,23 @@
+"""End-to-end RLHF on the TPU-native stack (north-star config 5).
+
+Three planes wired into one loop: generator actors rolling out through
+the continuous-batching serve engine with sampling-time logp capture
+(`rollout.RolloutWorker`), a ParallelPlan-sharded GRPO learner
+(`learner.GRPOLearner`), and learner→generator weight refresh through
+the relay-broadcast object plane (`pipeline.RLHFPipeline`).
+"""
+
+from .learner import (
+    GRPOLearner,
+    GRPOLearnerConfig,
+    aot_compile_grpo_step,
+    make_grpo_step,
+)
+from .pipeline import RLHFConfig, RLHFPipeline
+from .rollout import RolloutWorker
+
+__all__ = [
+    "GRPOLearner", "GRPOLearnerConfig", "make_grpo_step",
+    "aot_compile_grpo_step", "RLHFConfig", "RLHFPipeline",
+    "RolloutWorker",
+]

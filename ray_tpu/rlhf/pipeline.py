@@ -1,0 +1,416 @@
+"""RLHFPipeline — the three-plane GRPO loop (north-star config 5).
+
+    rollout plane      N generator actors, each an LLMEngine with
+                       continuous batching + shared-system-prompt
+                       prefix cache + sampling-time logp capture
+    learner plane      GRPOLearner over a ParallelPlan mesh (dp/fsdp):
+                       in-jit advantage normalization + clipped update
+    refresh plane      learner put()s byte-balanced param blocks; the
+                       generators' arg-plane pulls ride the relay
+                       broadcast tree (~O(log N) producer copies)
+
+One `train_iteration()` = rollout → reward → update → refresh, each
+phase a flight-recorder event and a chrome-trace span (`ray_tpu
+timeline`), with generator death survived at any point — including
+mid-refresh — by respawn + re-refresh + re-issue.
+
+Reference capability: RLlib's learner/rollout-worker split
+(rllib/core/learner/learner_group.py) wired around external LLM
+trainers; here the whole loop is in-framework on the TPU-native stack.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.exceptions import ActorDiedError, ActorError, RayTpuError
+from ..models.transformer import TransformerConfig
+from ..observability import get_recorder
+from ..parallel.plan import ParallelPlan
+from ..util import tracing as _tracing
+from .learner import GRPOLearner, GRPOLearnerConfig
+from .rollout import RolloutWorker
+
+
+@dataclass(frozen=True)
+class RLHFConfig:
+    model: TransformerConfig = field(
+        default_factory=lambda: TransformerConfig(
+            vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=4, d_ff=128, max_seq_len=64,
+            dtype=jnp.float32, param_dtype=jnp.float32, remat=False))
+    plan: ParallelPlan = field(default_factory=ParallelPlan)
+    num_generators: int = 4
+    # Per iteration, across all generators; must divide evenly.
+    num_prompts: int = 8
+    prompt_len: int = 8
+    group_size: int = 4
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+    eos_token: Optional[int] = None
+    # reward_fn: completions (N, max_new) int32 -> (N,) float. Ignored
+    # when reward_model is set — any object (or actor handle) with
+    # .score(completions, lengths) -> (N,) float, the scored-reward /
+    # reward-model hook.
+    reward_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None
+    reward_model: Optional[Any] = None
+    # Tokens every prompt starts with; registered as an engine prefix
+    # so its KV prefills once per generator, not once per request.
+    system_prompt: Optional[Sequence[int]] = None
+    clip_eps: float = 0.2
+    kl_coef: float = 0.02
+    lr: float = 1e-4
+    warmup_steps: int = 5
+    total_steps: int = 1000
+    refresh_blocks: int = 8
+    num_slots: int = 4
+    decode_block: int = 16
+    seed: int = 0
+    checkpoint_path: Optional[str] = None
+    checkpoint_every: int = 0
+    num_to_keep: int = 2
+
+
+_ITER_GAUGE = None
+_REFRESH_BYTES = None
+
+
+def _metrics():
+    """Lazy singletons: the metric registry rejects re-registration,
+    and two pipelines in one process should share the series."""
+    global _ITER_GAUGE, _REFRESH_BYTES
+    if _ITER_GAUGE is None:
+        from ..util import metrics as mm
+
+        _ITER_GAUGE = mm.Gauge(
+            "ray_tpu_rlhf_iteration_seconds",
+            "Wall-clock seconds of the last RLHF train iteration",
+            tag_keys=("phase",))
+        _REFRESH_BYTES = mm.Counter(
+            "ray_tpu_rlhf_refresh_bytes_total",
+            "Total param bytes shipped through weight refresh")
+    return _ITER_GAUGE, _REFRESH_BYTES
+
+
+class RLHFPipeline:
+    def __init__(self, cfg: RLHFConfig, *,
+                 generator_options: Optional[Dict[str, Any]] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.cfg = cfg
+        if cfg.num_prompts % cfg.num_generators:
+            raise ValueError(
+                f"num_prompts={cfg.num_prompts} must divide across "
+                f"{cfg.num_generators} generators")
+        if cfg.reward_fn is None and cfg.reward_model is None:
+            raise ValueError("need reward_fn or reward_model")
+        self.learner = GRPOLearner(
+            GRPOLearnerConfig(
+                model=cfg.model, group_size=cfg.group_size,
+                clip_eps=cfg.clip_eps, kl_coef=cfg.kl_coef, lr=cfg.lr,
+                warmup_steps=cfg.warmup_steps,
+                total_steps=cfg.total_steps, seed=cfg.seed),
+            cfg.plan)
+        self._rng = np.random.default_rng(cfg.seed)
+        from ..core.task import SpreadSchedulingStrategy
+
+        self._gen_opts = dict(generator_options or {})
+        # Generators default to SPREAD (same default as serve
+        # replicas): one node death costs a fraction of the rollout
+        # fleet, and the weight-refresh relay gets >1 pulling node.
+        self._gen_opts.setdefault(
+            "scheduling_strategy", SpreadSchedulingStrategy())
+        self._gen_cls = ray_tpu.remote(**self._gen_opts)(RolloutWorker)
+        self.generators: List[Any] = [
+            self._spawn_generator(i) for i in range(cfg.num_generators)]
+        self.iteration = 0
+        self._version = -1
+        self._last_refresh: List[Any] = []  # refs, for respawn catch-up
+        self.respawns = 0
+        self._ckpt = None
+        if cfg.checkpoint_path:
+            from ..train.checkpoint import CheckpointManager
+
+            self._ckpt = CheckpointManager(
+                cfg.checkpoint_path, num_to_keep=cfg.num_to_keep)
+        # Generators start on seed weights: publish version 0 first so
+        # the first rollout already samples the learner's policy.
+        self.refresh_weights()
+
+    # -- generator lifecycle -------------------------------------------
+
+    def _spawn_generator(self, i: int):
+        return self._gen_cls.remote(
+            self.cfg.model, num_slots=self.cfg.num_slots,
+            seed=self.cfg.seed + 1000 + i,
+            decode_block=self.cfg.decode_block,
+            system_prompt=self.cfg.system_prompt)
+
+    def _revive_generator(self, i: int) -> None:
+        """Replace a dead generator and bring it to the current policy
+        version before it serves anything (a revived generator on seed
+        weights would silently poison the next batch's logps)."""
+        import ray_tpu
+
+        self.respawns += 1
+        get_recorder().record("rlhf", "generator_respawn", index=i,
+                              version=self._version)
+        self.generators[i] = self._spawn_generator(i)
+        if self._last_refresh:
+            ray_tpu.get(self.generators[i].refresh_weights.remote(
+                self._version, *self._last_refresh))
+
+    def _get_with_revival(self, i: int, submit: Callable[[], Any]):
+        """ray_tpu.get(submit()) with one respawn-and-retry on actor
+        death — the chaos contract: a generator killed at ANY phase
+        costs one retry of its own work, never the iteration."""
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(submit())
+        except (ActorDiedError, ActorError, RayTpuError):
+            self._revive_generator(i)
+            return ray_tpu.get(submit())
+
+    # -- weight refresh ------------------------------------------------
+
+    def refresh_weights(self) -> Dict[str, float]:
+        """Publish the learner's params as block objects and fan them
+        to every generator. The blocks go through put() once; each
+        generator's refresh call carries the refs, so on a daemon
+        cluster the pulls form the relay broadcast tree."""
+        import ray_tpu
+
+        _, refresh_counter = _metrics()
+        t0 = time.perf_counter()
+        version = self._version + 1
+        with _tracing.span("rlhf.refresh", version=version):
+            blocks = self.learner.param_blocks(self.cfg.refresh_blocks)
+            refs = [ray_tpu.put(b) for b in blocks]
+            self._last_refresh = refs
+            self._version = version
+            self._prefetch_to_generator_nodes(refs)
+            # An already-dead generator raises at SUBMIT, one that dies
+            # mid-refresh raises at get — both cost a revive (which
+            # re-refreshes from the same refs), never the fleet.
+            futures = []
+            for g in self.generators:
+                try:
+                    futures.append(
+                        g.refresh_weights.remote(version, *refs))
+                except (ActorDiedError, ActorError, RayTpuError):
+                    futures.append(None)
+            total_bytes = 0
+            for i, fut in enumerate(futures):
+                try:
+                    if fut is None:
+                        raise ActorDiedError(
+                            f"generator {i} dead at refresh submit")
+                    res = ray_tpu.get(fut)
+                except (ActorDiedError, ActorError, RayTpuError):
+                    self._revive_generator(i)
+                    res = ray_tpu.get(
+                        self.generators[i].weight_version.remote())
+                    res = {"version": res, "bytes": 0}
+                total_bytes += int(res.get("bytes", 0))
+        dt = time.perf_counter() - t0
+        refresh_counter.inc(total_bytes)
+        get_recorder().record("rlhf", "refresh", version=version,
+                              bytes=total_bytes, seconds=dt,
+                              generators=len(self.generators))
+        return {"seconds": dt, "bytes": total_bytes,
+                "version": version}
+
+    def _prefetch_to_generator_nodes(self, refs) -> None:
+        """On a daemon cluster, pre-stage the published blocks on every
+        generator's node via the control plane's `weight_refresh`
+        prefetch — the pulls (relay-tree shaped) start before the
+        actors' refresh calls even dispatch. No-op single-node."""
+        import ray_tpu
+
+        from ..core import runtime as _rtmod
+
+        rt = _rtmod.global_runtime()
+        if rt.remote_plane is None:
+            return
+        try:
+            nids = ray_tpu.get(
+                [g.node_id.remote() for g in self.generators],
+                timeout=30)
+        except Exception:  # noqa: BLE001 — prefetch is advisory
+            return
+        nids = list(dict.fromkeys(n for n in nids if n))
+        if nids:
+            with _tracing.span("rlhf.refresh_prefetch", nodes=len(nids)):
+                rt.remote_plane.prefetch_objects(refs, nids)
+
+    # -- reward hook ---------------------------------------------------
+
+    def _score(self, completions: np.ndarray,
+               lengths: np.ndarray) -> np.ndarray:
+        import ray_tpu
+
+        rm = self.cfg.reward_model
+        if rm is not None:
+            score = getattr(rm, "score", None)
+            if score is not None and hasattr(score, "remote"):
+                rewards = ray_tpu.get(score.remote(completions, lengths))
+            elif score is not None:
+                rewards = score(completions, lengths)
+            else:
+                raise TypeError(
+                    f"reward_model {type(rm).__name__} has no .score")
+        else:
+            rewards = self.cfg.reward_fn(completions)
+        rewards = np.asarray(rewards, np.float32).reshape(-1)
+        if rewards.shape[0] != completions.shape[0]:
+            raise ValueError(
+                f"reward hook returned {rewards.shape[0]} scores for "
+                f"{completions.shape[0]} completions")
+        return rewards
+
+    # -- the loop ------------------------------------------------------
+
+    def sample_prompts(self) -> np.ndarray:
+        cfg = self.cfg
+        base = self._rng.integers(
+            0, cfg.model.vocab_size,
+            size=(cfg.num_prompts, cfg.prompt_len), dtype=np.int64)
+        if cfg.system_prompt:
+            sys_row = np.asarray(list(cfg.system_prompt), np.int64)
+            base = np.concatenate(
+                [np.tile(sys_row, (cfg.num_prompts, 1)), base], axis=1)
+        return base.astype(np.int32)
+
+    def train_iteration(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        iter_gauge, _ = _metrics()
+        t0 = time.perf_counter()
+        with _tracing.span("rlhf.iteration", iteration=self.iteration):
+            # -- rollout: contiguous prompt chunks, one per generator
+            prompts = self.sample_prompts()
+            per_gen = cfg.num_prompts // cfg.num_generators
+            with _tracing.span("rlhf.rollout_fanout"):
+                t_roll = time.perf_counter()
+                chunks = [prompts[i * per_gen:(i + 1) * per_gen]
+                          for i in range(cfg.num_generators)]
+
+                def _roll(i):
+                    return self.generators[i].rollout.remote(
+                        chunks[i], group_size=cfg.group_size,
+                        max_new_tokens=cfg.max_new_tokens,
+                        temperature=cfg.temperature,
+                        eos_token=cfg.eos_token)
+
+                results = [
+                    self._get_with_revival(i, lambda i=i: _roll(i))
+                    for i in range(cfg.num_generators)]
+                rollout_s = time.perf_counter() - t_roll
+            seqs = np.concatenate([r["seqs"] for r in results])
+            logprobs = np.concatenate([r["logprobs"] for r in results])
+            lengths = np.concatenate([r["lengths"] for r in results])
+            P = results[0]["prompt_len"]
+            tokens_out = int(lengths.sum())
+
+            # -- reward
+            completions = seqs[:, P:]
+            rewards = self._score(completions, lengths)
+
+            # -- learn: logps/mask land on the shifted (S-1) axis —
+            # generated token t sits at sequence position P + t, so its
+            # logp/mask index is P + t - 1.
+            N, S = seqs.shape
+            T = S - P
+            old_logp = np.zeros((N, S - 1), np.float32)
+            comp_mask = np.zeros((N, S - 1), np.float32)
+            old_logp[:, P - 1:P - 1 + T] = logprobs
+            comp_mask[:, P - 1:P - 1 + T] = (
+                np.arange(T)[None, :] < lengths[:, None])
+            with _tracing.span("rlhf.learn"):
+                t_learn = time.perf_counter()
+                metrics = self.learner.update(
+                    seqs, old_logp, rewards, comp_mask)
+                learn_s = time.perf_counter() - t_learn
+            get_recorder().record("rlhf", "learn",
+                                  iteration=self.iteration,
+                                  loss=metrics["loss"],
+                                  seconds=learn_s)
+
+            # -- refresh
+            refresh = self.refresh_weights()
+
+        self.iteration += 1
+        dt = time.perf_counter() - t0
+        iter_gauge.set(dt, tags={"phase": "total"})
+        iter_gauge.set(rollout_s, tags={"phase": "rollout"})
+        iter_gauge.set(learn_s, tags={"phase": "learn"})
+        iter_gauge.set(refresh["seconds"], tags={"phase": "refresh"})
+        get_recorder().record("rlhf", "iteration",
+                              iteration=self.iteration, seconds=dt,
+                              tokens=tokens_out)
+        out = {
+            "iteration": self.iteration,
+            "reward_mean": float(rewards.mean()),
+            "reward_std": float(rewards.std()),
+            "tokens": tokens_out,
+            "rollout_s": rollout_s,
+            "learn_s": learn_s,
+            "refresh_s": refresh["seconds"],
+            "refresh_bytes": refresh["bytes"],
+            "iteration_s": dt,
+            "tokens_per_s": tokens_out / max(rollout_s, 1e-9),
+            **metrics,
+        }
+        if (self._ckpt is not None and cfg.checkpoint_every
+                and self.iteration % cfg.checkpoint_every == 0):
+            self.save_checkpoint(out)
+        return out
+
+    def train(self, iterations: int) -> List[Dict[str, Any]]:
+        return [self.train_iteration() for _ in range(iterations)]
+
+    # -- checkpointing -------------------------------------------------
+
+    def save_checkpoint(self,
+                        metrics: Optional[Dict[str, Any]] = None):
+        if self._ckpt is None:
+            raise RuntimeError("no checkpoint_path configured")
+        from ..train.checkpoint import Checkpoint
+
+        state = self.learner.get_state()
+        state["iteration"] = self.iteration
+        state["version"] = self._version
+        return self._ckpt.register(Checkpoint.from_pytree(state),
+                                   dict(metrics or {}))
+
+    def restore_latest(self) -> bool:
+        """Restore learner state from the newest checkpoint and push
+        it to the generators. → False when none exists."""
+        if self._ckpt is None:
+            raise RuntimeError("no checkpoint_path configured")
+        ckpt = self._ckpt.latest()
+        if ckpt is None:
+            return False
+        state = ckpt.to_pytree()
+        self.iteration = int(state.pop("iteration"))
+        state.pop("version", None)
+        self.learner.set_state(state)
+        self.refresh_weights()
+        return True
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        for g in self.generators:
+            try:
+                ray_tpu.kill(g)
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
+        self.generators = []

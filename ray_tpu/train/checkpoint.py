@@ -182,6 +182,30 @@ class CheckpointManager:
             if storage_path.startswith("file://"):
                 self.storage_path = storage_path[len("file://"):]
             os.makedirs(self.storage_path, exist_ok=True)
+            self._load_manifest()
+
+    def _load_manifest(self) -> None:
+        """Resume a prior manager's records from manifest.json so a
+        fresh process pointing at the same storage_path can
+        latest()/best() across restarts (the RLHF pipeline's
+        restore_latest path). Local-dir managers only; a missing or
+        stale manifest just means starting empty — dead paths are
+        filtered by _exists at read time."""
+        try:
+            with open(os.path.join(self.storage_path,
+                                   "manifest.json")) as f:
+                records = json.load(f)
+        except Exception:  # noqa: BLE001 — no/corrupt manifest
+            return
+        for rec in records:
+            if isinstance(rec, dict) and "path" in rec:
+                rec.setdefault("metrics", {})
+                rec.setdefault("index", 0)
+                rec["alive"] = True
+                self._records.append(rec)
+        if self._records:
+            self._next_index = max(
+                int(r["index"]) for r in self._records) + 1
 
     def _exists(self, rec_or_path) -> bool:
         """Liveness of a record/path. Remote records carry a local

@@ -45,6 +45,34 @@ def make_optimizer(lr: float = 3e-4, *, warmup_steps: int = 100,
     )
 
 
+def opt_state_shardings(optimizer, params, p_shardings, mesh):
+    """Target shardings for optimizer.init's output: leaves that mirror
+    a param (adam mu/nu, ...) inherit that param's sharding, scalars
+    (schedule/clip counts) replicate. Sharding CANNOT be left to GSPMD
+    propagation here — optimizer.init is pure zeros_like with no data
+    dependence on the params, so XLA drops the unused sharded inputs
+    and the state comes back single-device (un-ZeRO'd, then relaid out
+    + recompiled on the first step). Mirroring is keyed by tree-path
+    suffix: the mu['layers']['wq'] leaf ends with the params'
+    ['layers']['wq'] path; bracketed keys make suffix matches exact."""
+    import jax.tree_util as jtu
+
+    replicated = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec())
+    p_leaves = jtu.tree_flatten_with_path(p_shardings)[0]
+    p_map = sorted(((jtu.keystr(path), sh) for path, sh in p_leaves),
+                   key=lambda kv: -len(kv[0]))
+    struct = jax.eval_shape(optimizer.init, params)
+    flat, treedef = jtu.tree_flatten_with_path(struct)
+    out = []
+    for path, leaf in flat:
+        ks = jtu.keystr(path)
+        sh = next((psh for pk, psh in p_map if ks.endswith(pk)),
+                  replicated)
+        out.append(sh if getattr(leaf, "ndim", 0) else replicated)
+    return jtu.tree_unflatten(treedef, out)
+
+
 def init_state(cfg: TransformerConfig, mesh, optimizer,
                seed: int = 0) -> TrainState:
     """Initialize params directly into their target shardings (no host
@@ -57,9 +85,10 @@ def init_state(cfg: TransformerConfig, mesh, optimizer,
 
     with jax.sharding.set_mesh(mesh):
         params = _init(jax.random.key(seed))
-        # GSPMD propagates param shardings into the zeros_like-shaped
-        # optimizer state leaves.
-        opt_state = jax.jit(optimizer.init)(params)
+        o_shardings = opt_state_shardings(
+            optimizer, params, p_shardings, mesh)
+        opt_state = jax.jit(
+            optimizer.init, out_shardings=o_shardings)(params)
         step = jnp.zeros((), jnp.int32)
     return TrainState(step=step, params=params, opt_state=opt_state)
 
@@ -125,7 +154,10 @@ def init_pp_state(cfg: TransformerConfig, mesh, optimizer, *, pp: int,
 
     with jax.sharding.set_mesh(mesh):
         params = _init(jax.random.key(seed))
-        opt_state = jax.jit(optimizer.init)(params)
+        o_shardings = opt_state_shardings(
+            optimizer, params, p_shardings, mesh)
+        opt_state = jax.jit(
+            optimizer.init, out_shardings=o_shardings)(params)
         step = jnp.zeros((), jnp.int32)
     return TrainState(step=step, params=params, opt_state=opt_state)
 

@@ -1,0 +1,62 @@
+"""Compatibility shims for older jax releases.
+
+The codebase targets the current jax mesh API (`jax.sharding.set_mesh`
+ambient-mesh context, `jax.sharding.get_abstract_mesh`); on older
+runtimes (jax 0.4.x) the same semantics exist under different names —
+`Mesh` is itself a context manager that installs the thread resource
+env, and the ambient mesh is readable from
+`jax._src.mesh.thread_resources`. `install()` backfills the missing
+attributes once, at `ray_tpu` import, and is a no-op on jax versions
+that already provide them.
+
+Deliberately NOT a general polyfill layer: each shim exists because a
+call site in this repo needs it, with the mapping documented here.
+"""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    import jax
+
+    # `with jax.sharding.set_mesh(mesh):` — on 0.4.x `with mesh:`
+    # installs the same ambient resource env that bare-PartitionSpec
+    # with_sharding_constraint calls resolve against.
+    if not hasattr(jax.sharding, "set_mesh"):
+        jax.sharding.set_mesh = lambda mesh: mesh
+
+    # `jax.sharding.get_abstract_mesh()` — callers only read `.shape`
+    # (a mapping; empty when no mesh is ambient), which the 0.4.x
+    # thread-resource physical mesh provides directly.
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        def _get_abstract_mesh():
+            from jax._src import mesh as _mesh_lib
+
+            return _mesh_lib.thread_resources.env.physical_mesh
+
+        jax.sharding.get_abstract_mesh = _get_abstract_mesh
+
+    # `jax.shard_map(f, mesh=..., in_specs=..., out_specs=...,
+    # check_vma=...)` — on 0.4.x the function lives at
+    # jax.experimental.shard_map.shard_map and the replication-check
+    # kwarg is spelled `check_rep`.
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def _compat_shard_map(f, *, mesh, in_specs, out_specs,
+                              check_vma=True):
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
+        jax.shard_map = _compat_shard_map
+
+    # `jax.lax.axis_size(name)` — on 0.4.x `lax.psum(1, name)` of a
+    # Python scalar constant-folds to the axis size as a concrete int.
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+
+    # `jax.lax.pvary(x, axes)` marks a replicated value as varying for
+    # the vma type system; 0.4.x has no vma tracking, so values carry no
+    # replication type and the marker is an identity.
+    if not hasattr(jax.lax, "pvary"):
+        jax.lax.pvary = lambda x, axis_name: x

@@ -39,6 +39,7 @@ SLOW_FILES = {
     "test_memory_monitor.py",
     "test_node_daemon.py",
     "test_object_transfer.py",
+    "test_rlhf_cluster.py",
     "test_runtime_env_isolation.py",
     "test_runtime_env_pip.py",
     "test_serve_cluster.py",

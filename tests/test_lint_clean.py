@@ -271,9 +271,13 @@ def test_xp_inventory_accounts_for_control_plane():
     types = {row["type"] for row in inventory}
     expected = {"task", "actor_create", "actor_call", "ping", "pong",
                 "shutdown", "gen_ack", "gen_item", "hello", "result",
-                "pull_complete"}
+                "pull_complete", "weight_refresh"}
     assert expected <= types, sorted(types)
     by_type = {row["type"]: row for row in inventory}
+    # the RLHF refresh-prefetch has both ends (RemotePlane sends,
+    # daemon handles)
+    assert (by_type["weight_refresh"]["senders"]
+            and by_type["weight_refresh"]["handlers"])
     # both directions populated for the core RPC pair
     assert by_type["ping"]["senders"] and by_type["ping"]["handlers"]
     assert by_type["hello"]["senders"] and by_type["hello"]["handlers"]

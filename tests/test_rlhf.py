@@ -1,0 +1,361 @@
+"""RLHF pipeline unit tests (north-star config 5).
+
+Fast coverage of the three planes: the engine's sampling-time logp
+capture is token-exact against the reference generation path, the
+GRPO learner round-trips its state under a real dp/fsdp mesh without
+losing the ZeRO sharding layout, `wait(fetch_local=...)` honors the
+reference semantics the rollout plane leans on, and the composed
+pipeline improves a verifiable reward in 30 iterations while
+surviving a generator kill. Cross-daemon relay-broadcast refresh
+lives in test_rlhf_cluster.py (slow).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import TransformerConfig, init_params
+
+
+def _tiny_cfg(vocab: int = 64) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=vocab, d_model=32, n_layers=1, n_heads=4,
+        n_kv_heads=4, d_ff=64, max_seq_len=64, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False)
+
+
+# -- logp capture vs the reference generation path ---------------------
+
+
+def test_engine_logprobs_token_exact_vs_generate():
+    """Greedy engine decode must reproduce greedy_generate's tokens
+    exactly, and the sampling-time logps must equal log_softmax of a
+    full forward pass at those positions — the GRPO ratio term is only
+    meaningful if old_logp really is log pi_old(token)."""
+    from ray_tpu.models.generate import greedy_generate
+    from ray_tpu.models.transformer import forward
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg = _tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = LLMEngine(cfg, params, num_slots=2, seed=0,
+                       capture_logprobs=True)
+    prompt = [3, 14, 15, 9, 2, 6]
+    T = 8
+    out = engine.generate(prompt, max_new_tokens=T, temperature=0.0,
+                          return_logprobs=True)
+    ref = greedy_generate(cfg, params,
+                          jnp.asarray(prompt, jnp.int32), T)
+    assert out["tokens"] == [int(t) for t in ref], (
+        f"engine {out['tokens']} != reference {list(map(int, ref))}")
+
+    # Reference logps: one full forward over prompt + completion; the
+    # logp of generated token t (at sequence position P + t) comes
+    # from the logits at position P + t - 1.
+    P = len(prompt)
+    seq = jnp.asarray([prompt + out["tokens"]], jnp.int32)
+    logits, _aux = forward(cfg, params, seq)
+    lp_ref = jax.nn.log_softmax(
+        logits[0, P - 1:P - 1 + T].astype(jnp.float32), axis=-1)
+    want = np.asarray(
+        [lp_ref[t, tok] for t, tok in enumerate(out["tokens"])])
+    got = np.asarray(out["logprobs"], np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_rollout_worker_buffers_and_alignment(ray_start):
+    """RolloutWorker returns fixed-shape group-major buffers with
+    logps zeroed past each completion's length."""
+    from ray_tpu.rlhf import RolloutWorker
+
+    cfg = _tiny_cfg()
+    w = RolloutWorker(cfg, num_slots=4, seed=1)
+    prompts = np.arange(8, dtype=np.int32).reshape(2, 4) % cfg.vocab_size
+    out = w.rollout(prompts, group_size=3, max_new_tokens=6,
+                    temperature=1.0)
+    N = 2 * 3
+    assert out["seqs"].shape == (N, 4 + 6)
+    assert out["logprobs"].shape == (N, 6)
+    assert out["prompt_len"] == 4
+    assert (out["lengths"] >= 1).all() and (out["lengths"] <= 6).all()
+    for i in range(N):
+        L = int(out["lengths"][i])
+        assert np.all(out["logprobs"][i, L:] == 0.0)
+        # captured logps are log-probabilities of sampled tokens
+        assert np.all(out["logprobs"][i, :L] <= 1e-6)
+    # group-major: each prompt's G rows share the prompt prefix
+    assert np.array_equal(out["seqs"][:3, :4],
+                          np.tile(prompts[0], (3, 1)))
+
+
+# -- wait(fetch_local=...) ---------------------------------------------
+
+
+class _RecordingPlane:
+    def __init__(self, fail=False):
+        self.calls = []
+        self.fail = fail
+
+    def ensure_local(self, marker):
+        self.calls.append(bytes(marker.key))
+        if self.fail:
+            raise KeyError("no source")
+
+
+def test_wait_fetch_local_pulls_remote_marker(ray_start):
+    """A ready ref whose payload lives only on a remote node must be
+    pulled local before wait() reports it ready (reference ray.wait
+    fetch_local=True semantics); fetch_local=False skips the pull."""
+    from ray_tpu.core import runtime as rtmod
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_ref import ObjectRef
+    from ray_tpu.core.runtime import _ShmMarker
+
+    rt = rtmod.global_runtime()
+    oid = ObjectID.from_random()
+    marker = _ShmMarker(oid.binary(), node_id="daemon-9")
+    rt.store.put(oid, marker)
+    plane = _RecordingPlane()
+    saved = rt.remote_plane
+    rt.remote_plane = plane
+    try:
+        ready, not_ready = rt.wait([ObjectRef(oid)], 1, None,
+                                   fetch_local=False)
+        assert len(ready) == 1 and not plane.calls
+
+        ready, not_ready = rt.wait([ObjectRef(oid)], 1, None,
+                                   fetch_local=True)
+        assert len(ready) == 1
+        assert plane.calls == [oid.binary()]
+
+        # A failed pull leaves the ref ready — get() owns the
+        # reconstruction fallback, wait() must not wedge or raise.
+        plane2 = _RecordingPlane(fail=True)
+        rt.remote_plane = plane2
+        ready, _ = rt.wait([ObjectRef(oid)], 1, None, fetch_local=True)
+        assert len(ready) == 1 and plane2.calls
+    finally:
+        rt.remote_plane = saved
+
+
+def test_wait_fetch_local_api_passthrough(ray_start):
+    """Public ray_tpu.wait exposes fetch_local and local values stay
+    untouched by it."""
+    import ray_tpu
+
+    ref = ray_tpu.put({"x": 1})
+    ready, not_ready = ray_tpu.wait([ref], fetch_local=True)
+    assert ready == [ref] and not_ready == []
+    ready, not_ready = ray_tpu.wait([ref], fetch_local=False)
+    assert ready == [ref]
+    assert ray_tpu.get(ref) == {"x": 1}
+
+
+# -- learner: sharded update + state round-trip ------------------------
+
+
+def test_grpo_learner_state_roundtrip_preserves_sharding(cpu_mesh8):
+    """get_state/set_state under a dp=2/fsdp=2 plan: a restored
+    learner holds identical values in the SAME sharded layout (ZeRO
+    opt state stays sharded, not silently replicated), and continues
+    training from the restored step."""
+    from ray_tpu.parallel import ParallelPlan
+    from ray_tpu.rlhf import GRPOLearner, GRPOLearnerConfig
+
+    cfg = GRPOLearnerConfig(model=_tiny_cfg(), group_size=4, lr=1e-3,
+                            warmup_steps=1, total_steps=20)
+    plan = ParallelPlan(dp=2, fsdp=2)
+    learner = GRPOLearner(cfg, plan, devices=cpu_mesh8[:4])
+
+    rng = np.random.default_rng(0)
+    N, S, P = 8, 24, 12
+    tokens = rng.integers(0, 64, (N, S)).astype(np.int32)
+    old_logp = np.zeros((N, S - 1), np.float32)
+    old_logp[:, P - 1:] = -2.0
+    comp_mask = np.zeros((N, S - 1), np.float32)
+    comp_mask[:, P - 1:] = 1.0
+    rewards = rng.normal(size=N).astype(np.float32)
+    m = learner.update(tokens, old_logp, rewards, comp_mask)
+    assert np.isfinite(m["loss"])
+
+    snap = learner.get_state()
+    assert snap["step"] == 1
+
+    def spec_strs(tree):
+        # Compare semantic layout, not repr: the jitted step
+        # canonicalizes PartitionSpec(None, 'fsdp', None) to
+        # PartitionSpec(None, 'fsdp') — same sharding.
+        def norm(x):
+            sh = getattr(x, "sharding", None)
+            spec = getattr(sh, "spec", None)
+            if spec is None:
+                return type(sh).__name__
+            parts = list(spec)
+            while parts and parts[-1] is None:
+                parts.pop()
+            return str(tuple(parts))
+        return jax.tree.map(norm, tree)
+
+    before = spec_strs((learner.state.params, learner.state.opt_state))
+    # opt state must actually be sharded under fsdp, or the roundtrip
+    # "preservation" claim is vacuous
+    assert any(
+        getattr(x, "sharding", None) is not None
+        and hasattr(x.sharding, "spec")
+        and not x.sharding.is_fully_replicated
+        for x in jax.tree.leaves(learner.state.opt_state))
+
+    fresh = GRPOLearner(cfg, plan, devices=cpu_mesh8[:4])
+    fresh.set_state(snap)
+    after = spec_strs((fresh.state.params, fresh.state.opt_state))
+    assert before == after
+    assert fresh.step_count == 1
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(fresh.params_host())[0]),
+        np.asarray(jax.tree.leaves(learner.params_host())[0]))
+
+    # the restored learner keeps training (same jitted step signature,
+    # no relayout recompile surprise)
+    m2 = fresh.update(tokens, old_logp, rewards, comp_mask)
+    assert np.isfinite(m2["loss"]) and fresh.step_count == 2
+
+
+def test_param_blocks_cover_and_balance():
+    from ray_tpu.rlhf import GRPOLearner, GRPOLearnerConfig
+
+    learner = GRPOLearner(
+        GRPOLearnerConfig(model=_tiny_cfg(), group_size=2))
+    blocks = learner.param_blocks(4)
+    idxs = sorted(i for b in blocks for i, _ in b)
+    n_leaves = len(jax.tree.leaves(learner.state.params))
+    assert idxs == list(range(n_leaves))
+    assert 1 <= len(blocks) <= 4
+
+
+# -- the composed pipeline ---------------------------------------------
+
+
+def _pipe_cfg(**kw):
+    from ray_tpu.rlhf import RLHFConfig
+
+    base = dict(
+        model=_tiny_cfg(), num_generators=2, num_prompts=4,
+        prompt_len=4, group_size=4, max_new_tokens=8,
+        temperature=1.0, lr=5e-3, warmup_steps=2, total_steps=60,
+        reward_fn=lambda comp: (comp == 7).mean(axis=1),
+        refresh_blocks=4, seed=0)
+    base.update(kw)
+    return RLHFConfig(**base)
+
+
+def test_rlhf_pipeline_reward_improves(ray_start):
+    """The 30-iteration sanity gate: GRPO on 'emit token 7' must lift
+    the mean reward from near-uniform to visibly above it. Exercises
+    all three planes every iteration (rollout fan-out, sharded-free
+    learner update, versioned weight refresh)."""
+    from ray_tpu.rlhf import RLHFPipeline
+
+    pipe = RLHFPipeline(_pipe_cfg())
+    try:
+        hist = pipe.train(30)
+    finally:
+        pipe.shutdown()
+    rewards = [h["reward_mean"] for h in hist]
+    first, last = np.mean(rewards[:5]), np.mean(rewards[-5:])
+    assert last > first + 0.02, (
+        f"no reward improvement: first5={first:.4f} last5={last:.4f}")
+    # weight refresh really shipped bytes and advanced versions
+    assert hist[-1]["refresh_bytes"] > 0
+    assert pipe._version == 30  # v0 at init + one per iteration
+
+
+def test_rlhf_pipeline_survives_generator_kill(ray_start):
+    """Chaos contract: a generator killed between phases costs a
+    respawn + retry of its own work, never the iteration — both in
+    the rollout fan-out and inside the refresh fan-out."""
+    import ray_tpu
+    from ray_tpu.rlhf import RLHFPipeline
+
+    pipe = RLHFPipeline(_pipe_cfg())
+    try:
+        out1 = pipe.train_iteration()
+        assert out1["tokens"] > 0
+
+        # kill before rollout: the fan-out hits a dead actor
+        ray_tpu.kill(pipe.generators[0])
+        out2 = pipe.train_iteration()
+        assert out2["tokens"] > 0
+        assert pipe.respawns >= 1
+
+        # kill before refresh: the refresh fan-out hits a dead actor;
+        # the revived generator must come back AT the new version
+        ray_tpu.kill(pipe.generators[1])
+        res = pipe.refresh_weights()
+        assert res["version"] == pipe._version
+        versions = ray_tpu.get(
+            [g.weight_version.remote() for g in pipe.generators])
+        assert versions == [pipe._version] * len(versions)
+        assert pipe.respawns >= 2
+    finally:
+        pipe.shutdown()
+
+
+def test_rlhf_checkpoint_roundtrip(ray_start, tmp_path):
+    """save_checkpoint/restore_latest round-trips learner state,
+    iteration count and policy version through train/checkpoint.py."""
+    from ray_tpu.rlhf import RLHFPipeline
+
+    cfg = _pipe_cfg(checkpoint_path=str(tmp_path / "ck"))
+    pipe = RLHFPipeline(cfg)
+    try:
+        pipe.train(2)
+        pipe.save_checkpoint({"reward_mean": 0.5})
+        w0 = jax.tree.leaves(pipe.learner.params_host())[0]
+        it, ver = pipe.iteration, pipe._version
+    finally:
+        pipe.shutdown()
+
+    pipe2 = RLHFPipeline(cfg)
+    try:
+        assert pipe2.restore_latest()
+        assert pipe2.iteration == it
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(pipe2.learner.params_host())[0]),
+            np.asarray(w0))
+        # restore pushed the restored policy to the generators
+        import ray_tpu
+
+        versions = ray_tpu.get(
+            [g.weight_version.remote() for g in pipe2.generators])
+        assert all(v == pipe2._version for v in versions)
+        del ver
+    finally:
+        pipe2.shutdown()
+
+
+def test_rlhf_metrics_and_recorder_events(ray_start):
+    """The iteration publishes the gauge/counter rows and flight-
+    recorder events ISSUE satellite (f) names."""
+    from ray_tpu.observability import get_recorder
+    from ray_tpu.rlhf import RLHFPipeline
+    from ray_tpu.util.metrics import prometheus_text, snapshot_scalars
+
+    pipe = RLHFPipeline(_pipe_cfg())
+    try:
+        pipe.train_iteration()
+    finally:
+        pipe.shutdown()
+    scalars = snapshot_scalars()
+    assert "ray_tpu_rlhf_iteration_seconds" in scalars
+    assert scalars.get("ray_tpu_rlhf_refresh_bytes_total", 0) > 0
+    text = prometheus_text()
+    for phase in ("total", "rollout", "learn", "refresh"):
+        assert (f'ray_tpu_rlhf_iteration_seconds{{phase="{phase}"}}'
+                in text), f"missing phase gauge {phase}:\n{text}"
+    events = get_recorder().snapshot()["events"]
+    kinds = {e["event"] for e in events
+             if e.get("component") == "rlhf"}
+    assert {"iteration", "rollout", "learn", "refresh",
+            "weight_refresh"} <= kinds
