@@ -169,6 +169,81 @@ def push_history(metric: str, value: float, unit: str, match: dict,
     return prev
 
 
+# Config-identity fields a BENCH_HISTORY row may carry: two rows are
+# comparable only when all of these agree (same reasoning as
+# push_history's `match`).
+_IDENTITY_KEYS = ("unit", "platform", "batch", "seq", "model", "steps")
+
+# Direction by unit: a throughput drop and a latency rise are both
+# regressions.
+_HIGHER_BETTER = {"tok/s", "tokens/s", "img/s", "images/s", "req/s",
+                  "tasks/s", "GB/s", "x"}
+_LOWER_BETTER = {"s", "ms", "seconds", "%"}
+
+
+def check_regressions(threshold_pct: float = 10.0,
+                      hist_path: str | None = None,
+                      min_prior: int = 2,
+                      trailing: int = 5) -> list:
+    """Compare each metric's freshest BENCH_HISTORY row against the
+    trailing median of its prior comparable rows (same metric + config
+    identity + platform). The median — not the previous row — is the
+    bar, so one noisy run neither hides nor fakes a regression.
+
+    → list of regression dicts (empty = clean). Groups with fewer than
+    `min_prior` prior rows are reported as "insufficient history", not
+    failed."""
+    path = hist_path or os.path.join(os.path.dirname(__file__),
+                                     "BENCH_HISTORY.json")
+    try:
+        history = json.load(open(path))
+    except Exception:  # noqa: BLE001
+        print(f"no readable history at {path}", file=sys.stderr)
+        return []
+    groups: dict = {}
+    for row in history:
+        if not isinstance(row, dict) or "metric" not in row:
+            continue
+        key = (row["metric"],) + tuple(
+            (k, row.get(k)) for k in _IDENTITY_KEYS)
+        groups.setdefault(key, []).append(row)
+    regressions = []
+    for key, rows in sorted(groups.items()):
+        metric, unit = key[0], rows[-1].get("unit")
+        last, prior = rows[-1], rows[:-1]
+        label = metric + "".join(
+            f" {k}={v}" for k, v in key[1:]
+            if v is not None and k != "unit")
+        if unit in _HIGHER_BETTER:
+            sign = 1.0
+        elif unit in _LOWER_BETTER:
+            sign = -1.0
+        else:  # booleans ("ok") and unknown units aren't trendable
+            continue
+        if len(prior) < min_prior:
+            print(f"  SKIP {label}: {len(prior)} prior rows "
+                  f"(need {min_prior})", file=sys.stderr)
+            continue
+        vals = sorted(r["value"] for r in prior[-trailing:])
+        n = len(vals)
+        med = (vals[n // 2] if n % 2 else
+               (vals[n // 2 - 1] + vals[n // 2]) / 2.0)
+        if med == 0:
+            continue
+        delta_pct = sign * (last["value"] - med) / abs(med) * 100.0
+        status = "ok"
+        if delta_pct < -threshold_pct:
+            status = "REGRESSION"
+            regressions.append({
+                "metric": metric, "unit": unit, "value": last["value"],
+                "trailing_median": med, "delta_pct": delta_pct,
+                "label": label})
+        print(f"  {status:>10} {label}: {last['value']:.6g} {unit} "
+              f"vs trailing median {med:.6g} "
+              f"({delta_pct:+.1f}%)", file=sys.stderr)
+    return regressions
+
+
 def _chip_peak_flops(device) -> float:
     """Stated peak dense FLOP/s for the chip (bf16), so the MFU claim
     is checkable. Override with RAY_TPU_CHIP_PEAK_FLOPS when the table
@@ -727,7 +802,30 @@ def main() -> None:
                          "run and write a collapsed flamegraph to PATH "
                          "(default bench.profile.collapsed); also "
                          "reports the sampler's measured overhead")
+    ap.add_argument("--check-regressions", action="store_true",
+                    help="no new run: compare the freshest "
+                         "BENCH_HISTORY.json row of each metric/config "
+                         "group against the trailing median of its "
+                         "prior rows; exit 1 on any regression beyond "
+                         "the threshold")
+    ap.add_argument("--regression-threshold", type=float, default=10.0,
+                    metavar="PCT",
+                    help="regression tolerance in percent (default 10)")
+    ap.add_argument("--history", default=None, metavar="PATH",
+                    help="BENCH_HISTORY.json override "
+                         "(--check-regressions)")
     args = ap.parse_args()
+
+    if args.check_regressions:
+        regs = check_regressions(
+            threshold_pct=args.regression_threshold,
+            hist_path=args.history)
+        if regs:
+            print(f"{len(regs)} regression(s) beyond "
+                  f"{args.regression_threshold:.0f}%", file=sys.stderr)
+            sys.exit(1)
+        print("no regressions", file=sys.stderr)
+        return
 
     if args.profile:
         _run_profiled(args)
@@ -787,16 +885,66 @@ def _sampler_overhead(interval_s: float = 0.01) -> tuple:
     return off, on
 
 
+def _contprof_overhead(reps: int = 12) -> tuple:
+    """(off_s, on_s) wall time of fixed busy work without/with the
+    CONTINUOUS profiler armed — same synthetic-work rationale as
+    _sampler_overhead, but against the always-on duty-cycled loop.
+    Measured at a 5% duty cycle (1s interval, 50ms capture), which
+    upper-bounds the production ~3% (2s every 60s)."""
+    import tempfile
+    import time as _time
+
+    from ray_tpu.observability.continuous import ContinuousProfiler
+
+    def busy() -> int:
+        x = 0
+        for i in range(2_000_000):
+            x += i * i
+        return x
+
+    busy()
+    t0 = _time.perf_counter()
+    for _ in range(reps):
+        busy()
+    off = _time.perf_counter() - t0
+    with tempfile.TemporaryDirectory() as d:
+        prof = ContinuousProfiler(
+            "bench", directory=d, interval_s=1.0, duration_s=0.05,
+            sample_interval_s=0.01).start()
+        try:
+            t0 = _time.perf_counter()
+            for _ in range(reps):
+                busy()
+            on = _time.perf_counter() - t0
+        finally:
+            prof.stop()
+    return off, on
+
+
 def _run_profiled(args) -> None:
     """Arm the on-demand stack sampler around one real bench pass and
     write the flamegraph next to the results."""
     import time as _time
+
+    import jax
 
     from ray_tpu.observability import StackSampler
     from ray_tpu.observability.stack_sampler import to_collapsed
 
     off, on = _sampler_overhead()
     overhead_pct = max(0.0, (on - off) / off * 100.0) if off else 0.0
+    # Always-on-vs-off row: the continuous profiler's claim is that it
+    # can be left on forever; the scoreboard holds it to <=3%.
+    coff, con = _contprof_overhead()
+    cont_pct = max(0.0, (con - coff) / coff * 100.0) if coff else 0.0
+    push_history("contprof_overhead_pct", cont_pct, "%",
+                 match={"platform": jax.devices()[0].platform},
+                 extra={"off_s": round(coff, 4), "on_s": round(con, 4)})
+    verdict = "OK (<=3%)" if cont_pct <= 3.0 else "FAIL (>3%)"
+    print(f"continuous profiler overhead on a synthetic busy loop: "
+          f"{cont_pct:.2f}% {verdict} "
+          f"({coff * 1e3:.0f}ms off vs {con * 1e3:.0f}ms on)",
+          file=sys.stderr)
     sampler = StackSampler(interval_s=0.01)
     sampler.start()
     t0 = _time.perf_counter()

@@ -154,6 +154,51 @@ _D("cluster_poll_interval_s", float, 0.5,
 _D("actor_replace_timeout_s", float, 10.0,
    "How long a restarting actor waits for a surviving node with "
    "capacity before giving up (multi-host actor recovery).")
+# --- continuous observability plane ---
+_D("contprof_enabled", bool, True,
+   "Always-on low-duty-cycle profiler in every long-lived process "
+   "(driver, node daemons, workers): periodic short StackSampler "
+   "captures retained on disk for postmortem flamegraphs.")
+_D("contprof_interval_s", float, 60.0,
+   "Seconds between continuous-profiler captures.")
+_D("contprof_duration_s", float, 2.0,
+   "Length of each continuous-profiler capture (duty cycle = "
+   "duration / interval; defaults give ~3%).")
+_D("contprof_sample_interval_s", float, 0.01,
+   "Stack-sampling period within a capture.")
+_D("contprof_retention_count", int, 240,
+   "Max retained profile snapshots per process role dir; oldest "
+   "evicted first.")
+_D("contprof_retention_bytes", int, 32 * 1024**2,
+   "Max total bytes of retained profile snapshots; oldest evicted "
+   "first.")
+_D("contprof_dir", str, "",
+   "Directory for retained profile snapshots ('' = "
+   "<session_dir>/contprof). Daemons propagate their resolved dir to "
+   "workers so one node shares one ring.")
+_D("metrics_history_enabled", bool, True,
+   "Embedded metrics TSDB: a scraper thread samples the metrics "
+   "registry into fixed-size per-series ring buffers, queryable via "
+   "/api/metrics/history and `ray_tpu obs`.")
+_D("metrics_history_resolution_s", float, 10.0,
+   "Seconds between metrics-history scrapes.")
+_D("metrics_history_window_s", float, 3600.0,
+   "Per-series history window; ring capacity = window / resolution.")
+_D("anomaly_detection_enabled", bool, True,
+   "Per-plane straggler/outlier watchdogs (RLHF rollout tok/s, serve "
+   "replica TTFT, dispatch-handler p95 spikes) feeding "
+   "ray_tpu_anomaly_total and flight-recorder `anomaly` events.")
+_D("anomaly_mad_k", float, 3.0,
+   "Robust outlier threshold: flag values more than k median absolute "
+   "deviations below/above the fleet median.")
+_D("anomaly_ewma_alpha", float, 0.3,
+   "Smoothing factor for per-subject EWMAs fed to the detectors.")
+_D("anomaly_min_samples", int, 4,
+   "Detectors stay silent until a cohort has at least this many "
+   "subjects/samples (MAD of 2 points is noise).")
+_D("anomaly_p95_spike_factor", float, 3.0,
+   "Dispatch-loop watchdog: flag a handler whose current p95 exceeds "
+   "this multiple of its trailing-window median p95.")
 # --- TPU / device ---
 _D("tpu_devices_per_host", int, 0, "0 = autodetect via jax.local_devices().")
 _D("prefetch_to_device_buffers", int, 2,

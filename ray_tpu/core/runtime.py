@@ -145,6 +145,24 @@ class Runtime:
         from .._private import session as _session
 
         self.session_dir = _session.new_session()
+        # Continuous observability: the driver carries its own always-on
+        # profiler ring + metrics-history scraper; local pool workers
+        # share the ring via RAY_TPU_CONTPROF_DIR below.
+        self.contprof_dir = (config.contprof_dir
+                             or os.path.join(self.session_dir, "contprof"))
+        self._contprof = None
+        self._tsdb = None
+        try:
+            from ..observability import continuous as _contmod
+            from ..observability import tsdb as _tsdbmod
+
+            if config.contprof_enabled:
+                self._contprof = _contmod.start_continuous_profiler(
+                    "driver", directory=self.contprof_dir)
+            if config.metrics_history_enabled:
+                self._tsdb = _tsdbmod.start_scraper()
+        except Exception:  # noqa: BLE001 — observability must not stop init
+            pass
         spiller = None
         if config.memory_store_spill_threshold_bytes > 0:
             from .spilling import ObjectSpiller
@@ -256,7 +274,8 @@ class Runtime:
                 # driver drives; loading the TPU plugin at startup
                 # risks concurrent-registration segfaults (see
                 # node/daemon.py worker_env).
-                env={"PALLAS_AXON_POOL_IPS": ""})
+                env={"PALLAS_AXON_POOL_IPS": "",
+                     "RAY_TPU_CONTPROF_DIR": self.contprof_dir})
             self.scheduler.add_node(ProcNodeState(
                 "node-procs", ResourceSet({CPU: float(num_worker_procs)}),
                 self.worker_pool))
@@ -1101,7 +1120,8 @@ class Runtime:
             if not retried:
                 failed = True
                 self._store_error(spec, _wrap(spec, e), t0)
-                rec.auto_dump("worker_crashed")
+                rec.auto_dump("worker_crashed",
+                              crash_pid=getattr(worker, "pid", None))
         except BaseException as e:  # noqa: BLE001
             retried = self._maybe_retry(spec, e)
             if not retried:
@@ -1433,6 +1453,18 @@ class Runtime:
 
     def shutdown(self):
         self._shutdown = True
+        try:
+            from ..observability import continuous as _contmod
+            from ..observability import tsdb as _tsdbmod
+
+            if self._contprof is not None:
+                _contmod.stop_continuous_profiler()
+                self._contprof = None
+            if self._tsdb is not None:
+                _tsdbmod.stop_scraper()
+                self._tsdb = None
+        except Exception:  # noqa: BLE001
+            pass
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
             self.memory_monitor = None

@@ -177,6 +177,18 @@ def main() -> None:
     _span_buf: list = []
     _tracing.setup_tracing(_span_buf.append)
 
+    # Always-on low-duty-cycle profiler: retained snapshots under the
+    # node's shared contprof ring (the daemon exports its resolved dir
+    # via RAY_TPU_CONTPROF_DIR) so a postmortem can ask what this
+    # worker was doing minutes before it died.
+    try:
+        from ray_tpu.observability.continuous import (
+            start_continuous_profiler)
+
+        start_continuous_profiler("worker")
+    except Exception:  # noqa: BLE001 — observability must not stop boot
+        pass
+
     def _drain_spans():
         out = list(_span_buf)
         _span_buf.clear()

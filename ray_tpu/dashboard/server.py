@@ -15,6 +15,8 @@ Endpoints:
   GET  /api/timeline           GET  /healthz
   GET  /metrics                (Prometheus text)
   GET  /api/event_stats        POST /api/profile (stack | kind=tpu)
+  GET  /api/profile/history    GET  /api/metrics/history
+  GET  /api/anomalies
   POST /api/jobs/              GET  /api/jobs/
   GET  /api/jobs/{id}          GET  /api/jobs/{id}/logs
   POST /api/jobs/{id}/stop
@@ -152,6 +154,23 @@ class MetricsHistory:
         try:
             self._publish_prom(point, rt)
         except Exception:  # noqa: BLE001 — exposition must not kill sampling
+            pass
+        # Cluster-merge the metrics TSDB: each daemon's latest scrape
+        # rides its heartbeat load report; fold it into the driver-side
+        # per-series rings tagged with the source node so
+        # /api/metrics/history answers for the whole cluster.
+        try:
+            from .._private.config import config as _config
+            from ..observability.tsdb import get_tsdb
+
+            if _config.metrics_history_enabled and rt is not None:
+                db = get_tsdb()
+                for node in rt.scheduler.nodes():
+                    load = getattr(node, "last_load", None)
+                    if load and load.get("metrics_history"):
+                        db.merge_remote(node.node_id,
+                                        load["metrics_history"])
+        except Exception:  # noqa: BLE001 — merge must not kill sampling
             pass
         with self._lock:
             self._ring.append(point)
@@ -603,6 +622,86 @@ class DashboardServer:
             limit = int(request.query.get("limit", "0"))
             return _json(self.history.dump(limit))
 
+        async def metrics_history_series(request):
+            # Per-series TSDB view (vs /api/metrics_history's flat
+            # point dump): ?name= one metric (all nodes), ?since= a
+            # lookback ("10m", "300s", or plain seconds), ?node= one
+            # node ("" = the head process's own scrape).
+            from ..observability.continuous import parse_lookback
+            from ..observability.tsdb import get_tsdb
+
+            name = request.query.get("name") or None
+            node = request.query.get("node")
+            since = None
+            if request.query.get("since"):
+                try:
+                    since = time.time() - parse_lookback(
+                        request.query["since"])
+                except ValueError:
+                    return _json({"error": "bad since"})
+            db = get_tsdb()
+            return _json({
+                "resolution_s": db.resolution_s,
+                "window_s": db.window_s,
+                "names": db.names(),
+                "series": db.query(name=name, since=since, node=node),
+            })
+
+        async def profile_history(request):
+            # Retained continuous-profiler snapshots merged across the
+            # cluster: ?since= lookback (default 10m), ?role=/?pid=
+            # filters, ?fmt=collapsed|chrome|json.
+            from ..core.runtime import global_runtime_or_none
+            from ..observability import continuous
+            from ..observability.stack_sampler import (
+                to_chrome_trace,
+                to_collapsed,
+            )
+
+            rt = global_runtime_or_none()
+            try:
+                since_s = continuous.parse_lookback(
+                    request.query.get("since", "10m"))
+            except ValueError:
+                return _json({"error": "bad since"})
+            role = request.query.get("role") or None
+            pid = request.query.get("pid")
+            pid = int(pid) if pid else None
+            result = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: continuous.profile_history_cluster(
+                    rt, since_s, role=role, pid=pid))
+            fmt = request.query.get("fmt", "json")
+            if fmt == "collapsed":
+                return web.Response(
+                    text=to_collapsed(result["merged"]),
+                    content_type="text/plain")
+            if fmt == "chrome":
+                return _json(to_chrome_trace(result["merged"]))
+            return _json({
+                "since_s": result["since_s"],
+                "count": len(result["snapshots"]),
+                "processes": sorted({
+                    f"{s.get('role')}:{s.get('pid')}"
+                    for s in result["snapshots"]}),
+                "snapshots": result["snapshots"],
+                "merged": result["merged"],
+                "collapsed": to_collapsed(result["merged"]),
+            })
+
+        async def anomalies(request):
+            from ..observability.continuous import parse_lookback
+            from ..observability.tsdb import get_anomaly_registry
+
+            since = None
+            if request.query.get("since"):
+                try:
+                    since = time.time() - parse_lookback(
+                        request.query["since"])
+                except ValueError:
+                    return _json({"error": "bad since"})
+            return _json(
+                {"anomalies": get_anomaly_registry().recent(since)})
+
         async def worker_stats(_):
             # Per-worker process stats (reference: dashboard
             # modules/reporter — per-node agents reporting worker
@@ -862,6 +961,9 @@ class DashboardServer:
         r.add_get("/api/nodes/{node_id}/logs", remote_logs)
         r.add_get("/api/nodes/{node_id}/logs/{name}", remote_log_tail)
         r.add_get("/api/metrics_history", metrics_history)
+        r.add_get("/api/metrics/history", metrics_history_series)
+        r.add_get("/api/profile/history", profile_history)
+        r.add_get("/api/anomalies", anomalies)
         r.add_get("/api/worker_stats", worker_stats)
         r.add_get("/api/logs", list_logs)
         r.add_get("/api/logs/{name}", tail_log)

@@ -838,6 +838,85 @@ def _check_await_under_lock(ctx: FileContext) -> List[Finding]:
     return out
 
 
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+# inventory path -> set of names (None sentinel cached for "no file")
+_METRIC_INVENTORY_CACHE: Dict[str, Optional[set]] = {}
+
+
+def _metrics_inventory(start: str) -> Optional[set]:
+    """Registered metric names: every backticked identifier in the
+    nearest ``docs/METRICS.md`` walking up from the linted file. None
+    when no inventory exists (rule stays silent — an installed copy of
+    the package without docs/ must not fail)."""
+    d = os.path.dirname(os.path.abspath(start))
+    while True:
+        cand = os.path.join(d, "docs", "METRICS.md")
+        if cand in _METRIC_INVENTORY_CACHE:
+            got = _METRIC_INVENTORY_CACHE[cand]
+            if got is not None:
+                return got
+        elif os.path.isfile(cand):
+            try:
+                with open(cand) as f:
+                    names = {m for m in re.findall(r"`([a-z0-9_]+)`",
+                                                   f.read())
+                             if _METRIC_NAME_RE.match(m)}
+            except OSError:
+                names = set()
+            _METRIC_INVENTORY_CACHE[cand] = names
+            return names
+        else:
+            _METRIC_INVENTORY_CACHE[cand] = None
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+@rule("metric-name-registry",
+      "Counter/Gauge/Histogram registered under a name missing from "
+      "the docs/METRICS.md inventory")
+def _check_metric_name_registry(ctx: FileContext) -> List[Finding]:
+    """An unregistered metric name is a dashboard nobody will find:
+    Grafana panels, the metrics-history CLI, and operators grep the
+    inventory, not the source. Every constructor call with a constant
+    name must have that name in the checked-in table."""
+    inventory = _metrics_inventory(ctx.path)
+    if inventory is None:
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _terminal_name(node.func) not in _METRIC_CLASSES:
+            continue
+        # Discriminate against collections.Counter(iterable): the
+        # metrics API always carries a description — a second
+        # positional string or a description= keyword.
+        has_desc = (
+            len(node.args) >= 2
+            and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)) or any(
+                kw.arg == "description" for kw in node.keywords)
+        if not has_desc or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        name = first.value
+        if not _METRIC_NAME_RE.match(name):
+            continue
+        if name not in inventory:
+            out.append(ctx.finding(
+                node, "metric-name-registry",
+                f"metric `{name}` is not in the docs/METRICS.md "
+                f"inventory — add a row (name, type, tags, meaning) "
+                f"so dashboards and the obs CLI can find it"))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------

@@ -159,9 +159,31 @@ class NodeDaemon:
 
         self._pulls = PullClientPool(self.shm_name)
 
+        # Continuous observability: this daemon and every worker it
+        # spawns share one on-disk profile-snapshot ring (workers pick
+        # the dir up via RAY_TPU_CONTPROF_DIR), and a scraper thread
+        # keeps a local metrics-history window whose latest scrape
+        # rides the load report to the driver.
+        self.contprof_dir = (config.contprof_dir
+                             or os.path.join(session_dir, "contprof"))
+        self._tsdb = None
+        self._contprof = None
+        try:
+            from ray_tpu.observability import continuous, tsdb
+
+            if config.contprof_enabled:
+                self._contprof = continuous.ContinuousProfiler(
+                    "daemon", node_id=self.node_id,
+                    directory=self.contprof_dir).start()
+            if config.metrics_history_enabled:
+                self._tsdb = tsdb.get_tsdb().start()
+        except Exception:  # noqa: BLE001 — observability must not stop boot
+            logger.exception("continuous observability disabled")
+
         # Execution plane: real OS worker processes.
         n_workers = max(1, int(num_cpus))
-        worker_env = {"RAY_TPU_NODE_ID": self.node_id}
+        worker_env = {"RAY_TPU_NODE_ID": self.node_id,
+                      "RAY_TPU_CONTPROF_DIR": self.contprof_dir}
         if not num_tpus:
             # CPU-only node: workers must not load the TPU plugin at
             # interpreter startup (the sitecustomize registers it in
@@ -442,6 +464,14 @@ class NodeDaemon:
                 spilled_native = self._nd.spilled()
             except Exception:  # noqa: BLE001
                 pass
+        # Latest metrics scrape rides the heartbeat (one float per
+        # series) so the driver's TSDB holds cluster-merged history.
+        metrics_history: dict = {}
+        if self._tsdb is not None:
+            try:
+                metrics_history = self._tsdb.latest()
+            except Exception:  # noqa: BLE001 — stats must not kill heartbeats
+                pass
         avail = self.available.to_dict()  # property: takes its own lock
         shm_pins = self._shm_attribution()  # takes actor/running locks
         with self._avail_lock:
@@ -455,6 +485,7 @@ class NodeDaemon:
                 "event_stats": estats,
                 "transfer": transfer,
                 "shm_pins": shm_pins,
+                "metrics_history": metrics_history,
             }
 
     def _recommend_spill_target(self, res, exclude) -> Optional[str]:
@@ -975,6 +1006,17 @@ class NodeDaemon:
 
             from ray_tpu.observability import stack_sampler as _ss
 
+            if msg.get("since_s") is not None:
+                # History mode: return this node's retained
+                # continuous-profiler snapshots (daemon + workers share
+                # one ring dir) instead of live-sampling.
+                from ray_tpu.observability import continuous
+
+                snaps = continuous.load_snapshots(
+                    since_s=float(msg["since_s"]),
+                    directory=self.contprof_dir)
+                return {"type": "profile_result", "ok": True,
+                        "node_id": self.node_id, "snapshots": snaps}
             duration_s = min(float(msg.get("duration_s") or 2.0), 60.0)
             interval_s = float(msg.get("interval_s") or 0.01)
             out: Dict[str, Dict[str, int]] = {}
@@ -1855,6 +1897,12 @@ class NodeDaemon:
         if self._stop.is_set():
             return
         self._stop.set()
+        if self._contprof is not None:
+            with contextlib.suppress(Exception):
+                self._contprof.stop()
+        if self._tsdb is not None:
+            with contextlib.suppress(Exception):
+                self._tsdb.stop()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
         if self._nd is not None:

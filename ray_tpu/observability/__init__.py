@@ -15,8 +15,18 @@ cross-process trace propagation) lives here:
   ray_tpu_loop_handler_* metric series.
 - stack_sampler: on-demand sys._current_frames profiler behind
   `ray_tpu profile` and POST /api/profile — flamegraphs without py-spy.
+- continuous: always-on low-duty-cycle profiler with on-disk retention
+  (`ray_tpu profile --since`, GET /api/profile/history).
+- tsdb: embedded metrics history (per-series ring buffers scraped from
+  the metrics registry) plus the anomaly registry feeding
+  ray_tpu_anomaly_total and flight-recorder `anomaly` events.
 """
 
+from .continuous import (
+    ContinuousProfiler,
+    start_continuous_profiler,
+    stop_continuous_profiler,
+)
 from .event_stats import EventStats, get_event_stats
 from .recorder import FlightRecorder, get_recorder
 from .stack_sampler import StackSampler, profile_cluster, sample_stacks
@@ -25,16 +35,23 @@ from .taskstats import (
     percentiles,
     record_task_metrics,
 )
+from .tsdb import MetricsTSDB, get_anomaly_registry, get_tsdb
 
 __all__ = [
+    "ContinuousProfiler",
     "EventStats",
     "FlightRecorder",
+    "MetricsTSDB",
     "StackSampler",
+    "get_anomaly_registry",
     "get_event_stats",
     "get_recorder",
+    "get_tsdb",
     "latency_breakdown",
     "percentiles",
     "profile_cluster",
     "record_task_metrics",
     "sample_stacks",
+    "start_continuous_profiler",
+    "stop_continuous_profiler",
 ]

@@ -1,0 +1,349 @@
+"""Always-on continuous profiler with on-disk retention.
+
+Google-Wide Profiling posture (Ren et al., 2010): every long-lived
+process — driver, node daemon, worker — runs a background
+low-duty-cycle capture (default 2 s of 10 ms sampling every 60 s,
+duty ~3%) on top of :mod:`stack_sampler`, and writes each capture as a
+collapsed-stack snapshot tagged ``{role, pid, node_id, ts}`` into a
+bounded ring directory under the session dir. Retention is enforced by
+count AND bytes, oldest-first, so the ring can be left on forever.
+
+"What was the cluster doing five minutes ago?" is then answerable after
+the fact: ``ray_tpu profile --since 10m`` and
+``GET /api/profile/history`` load the retained snapshots (all roles and
+pids that shared the ring dir), prefix each with its ``role:pid``
+label, and merge them through the existing collapsed/chrome-trace
+renderers.
+
+The ring dir is ``config.contprof_dir`` or ``<session_dir>/contprof``;
+daemons export their resolved dir to spawned workers via
+``RAY_TPU_CONTPROF_DIR`` so one node shares one ring.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .._private.config import config
+from .stack_sampler import StackSampler, merge_samples
+
+_SNAP_PREFIX = "prof-"
+_SNAP_SUFFIX = ".json"
+
+
+def profile_dir() -> str:
+    """Resolved snapshot ring directory (not created)."""
+    if config.contprof_dir:
+        return config.contprof_dir
+    from .._private.session import session_dir
+    return os.path.join(session_dir(), "contprof")
+
+
+class ContinuousProfiler:
+    """Background duty-cycled capture loop for one process."""
+
+    def __init__(self, role: str, node_id: Optional[str] = None,
+                 directory: Optional[str] = None,
+                 interval_s: Optional[float] = None,
+                 duration_s: Optional[float] = None,
+                 sample_interval_s: Optional[float] = None,
+                 retention_count: Optional[int] = None,
+                 retention_bytes: Optional[int] = None):
+        self.role = str(role)
+        self.node_id = node_id or os.environ.get("RAY_TPU_NODE_ID") or ""
+        self.directory = directory or profile_dir()
+        self.interval_s = max(1.0, float(
+            interval_s if interval_s is not None
+            else config.contprof_interval_s))
+        self.duration_s = max(0.05, float(
+            duration_s if duration_s is not None
+            else config.contprof_duration_s))
+        self.sample_interval_s = max(0.001, float(
+            sample_interval_s if sample_interval_s is not None
+            else config.contprof_sample_interval_s))
+        self.retention_count = int(
+            retention_count if retention_count is not None
+            else config.contprof_retention_count)
+        self.retention_bytes = int(
+            retention_bytes if retention_bytes is not None
+            else config.contprof_retention_bytes)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._captures = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "ContinuousProfiler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="ray-tpu-contprof", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.duration_s + 2)
+        self._thread = None
+
+    @property
+    def captures(self) -> int:
+        return self._captures
+
+    # -- capture loop --------------------------------------------------
+
+    def _run(self) -> None:
+        # First capture after a fraction of the interval so a
+        # short-lived process still leaves at least one snapshot, but a
+        # storm of worker starts doesn't sample in lockstep (pid skew).
+        self._stop.wait(min(5.0, self.interval_s / 4.0)
+                        + (os.getpid() % 100) / 100.0)
+        while not self._stop.is_set():
+            try:
+                self.capture_once()
+            except Exception:  # noqa: BLE001 — must never kill the host
+                pass
+            self._stop.wait(max(0.0, self.interval_s - self.duration_s))
+
+    def capture_once(self) -> Optional[str]:
+        """One duty-cycle capture → written snapshot path (or None)."""
+        sampler = StackSampler(interval_s=self.sample_interval_s).start()
+        self._stop.wait(self.duration_s)
+        samples = sampler.stop()
+        self._captures += 1
+        if not samples:
+            return None
+        return write_snapshot(
+            samples, role=self.role, node_id=self.node_id,
+            directory=self.directory,
+            duration_s=self.duration_s,
+            sample_interval_s=self.sample_interval_s,
+            retention_count=self.retention_count,
+            retention_bytes=self.retention_bytes)
+
+
+# -- snapshot ring I/O -------------------------------------------------------
+
+
+def write_snapshot(samples: Dict[str, int], role: str,
+                   node_id: str = "", directory: Optional[str] = None,
+                   ts: Optional[float] = None,
+                   duration_s: float = 0.0,
+                   sample_interval_s: float = 0.0,
+                   pid: Optional[int] = None,
+                   retention_count: Optional[int] = None,
+                   retention_bytes: Optional[int] = None) -> str:
+    """Atomically write one tagged snapshot, then enforce retention."""
+    d = directory or profile_dir()
+    os.makedirs(d, exist_ok=True)
+    ts = time.time() if ts is None else float(ts)
+    pid = os.getpid() if pid is None else int(pid)
+    doc = {
+        "role": role, "pid": pid, "node_id": node_id, "ts": ts,
+        "duration_s": duration_s, "interval_s": sample_interval_s,
+        "samples": samples,
+    }
+    # Millisecond ts + pid in the name keeps it unique and sortable by
+    # capture time even when mtimes are coarse.
+    path = os.path.join(
+        d, f"{_SNAP_PREFIX}{int(ts * 1000):015d}-{role}-{pid}"
+           f"{_SNAP_SUFFIX}")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    enforce_retention(d, retention_count, retention_bytes)
+    return path
+
+
+def _ring_files(directory: str) -> List[str]:
+    """Snapshot files oldest-first (name embeds the capture ts)."""
+    try:
+        names = [n for n in os.listdir(directory)
+                 if n.startswith(_SNAP_PREFIX)
+                 and n.endswith(_SNAP_SUFFIX)]
+    except OSError:
+        return []
+    return [os.path.join(directory, n) for n in sorted(names)]
+
+
+def enforce_retention(directory: str,
+                      retention_count: Optional[int] = None,
+                      retention_bytes: Optional[int] = None) -> int:
+    """Delete oldest snapshots until both caps hold. → files deleted."""
+    max_count = int(retention_count if retention_count is not None
+                    else config.contprof_retention_count)
+    max_bytes = int(retention_bytes if retention_bytes is not None
+                    else config.contprof_retention_bytes)
+    files = _ring_files(directory)
+    sizes = []
+    for p in files:
+        try:
+            sizes.append(os.path.getsize(p))
+        except OSError:
+            sizes.append(0)
+    total = sum(sizes)
+    deleted = 0
+    i = 0
+    # Keep at least the newest snapshot even if it alone busts the
+    # byte cap — an empty ring answers nothing.
+    while i < len(files) - 1 and (len(files) - i > max_count
+                                  or total > max_bytes):
+        try:
+            os.remove(files[i])
+        except OSError:
+            pass
+        total -= sizes[i]
+        deleted += 1
+        i += 1
+    return deleted
+
+
+def load_snapshots(since_s: Optional[float] = None,
+                   directory: Optional[str] = None,
+                   role: Optional[str] = None,
+                   pid: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Retained snapshots newest-last. ``since_s`` is a *lookback*
+    (seconds before now); ``role``/``pid`` filter."""
+    d = directory or profile_dir()
+    cutoff = None if since_s is None else time.time() - float(since_s)
+    out: List[Dict[str, Any]] = []
+    for path in _ring_files(d):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if cutoff is not None and float(doc.get("ts", 0)) < cutoff:
+            continue
+        if role is not None and doc.get("role") != role:
+            continue
+        if pid is not None and doc.get("pid") != pid:
+            continue
+        out.append(doc)
+    return out
+
+
+def latest_snapshot(pid: Optional[int] = None,
+                    directory: Optional[str] = None,
+                    max_age_s: Optional[float] = None
+                    ) -> Optional[Dict[str, Any]]:
+    """Most recent retained snapshot (optionally for one pid) — what
+    the flight recorder bundles next to the event ring on a crash."""
+    snaps = load_snapshots(since_s=max_age_s, directory=directory,
+                           pid=pid)
+    return snaps[-1] if snaps else None
+
+
+def merge_history(snaps: List[Dict[str, Any]]) -> Dict[str, int]:
+    """Merge retained snapshots into one flamegraph namespace, each
+    process prefixed ``role:pid`` (matching profile_cluster labels)."""
+    per_process: Dict[str, Dict[str, int]] = {}
+    for doc in snaps:
+        label = f"{doc.get('role', 'proc')}:{doc.get('pid', '?')}"
+        acc = per_process.setdefault(label, {})
+        for stack, count in (doc.get("samples") or {}).items():
+            acc[stack] = acc.get(stack, 0) + int(count)
+    return merge_samples(per_process)
+
+
+def profile_history_cluster(rt, since_s: float,
+                            role: Optional[str] = None,
+                            pid: Optional[int] = None
+                            ) -> Dict[str, Any]:
+    """Retained snapshots across the cluster: the local ring (driver +
+    local pool workers) plus each remote daemon's ring (the daemon
+    answers ``{"type": "profile", "since_s": ...}`` with its retained
+    snapshots — see node/daemon.py::_handle_profile).
+
+    → ``{"snapshots": [...], "merged": {stack: count},
+    "since_s": ...}`` — merged is the flamegraph namespace.
+    """
+    local_dir = getattr(rt, "contprof_dir", None) if rt else None
+    snaps = load_snapshots(since_s=since_s, directory=local_dir,
+                           role=role, pid=pid)
+    seen = {(s.get("role"), s.get("pid"), s.get("ts")) for s in snaps}
+    nodes = []
+    try:
+        nodes = list(rt.scheduler.nodes()) if rt else []
+    except Exception:  # noqa: BLE001 — no scheduler yet
+        nodes = []
+    threads = []
+    lock = threading.Lock()
+
+    def _one(n):
+        try:
+            reply = n.client.call({"type": "profile",
+                                   "since_s": float(since_s)})
+            if not (isinstance(reply, dict) and reply.get("ok")):
+                return
+            with lock:
+                for doc in reply.get("snapshots") or ():
+                    key = (doc.get("role"), doc.get("pid"),
+                           doc.get("ts"))
+                    if key in seen:
+                        continue  # daemon shares the local ring dir
+                    if role is not None and doc.get("role") != role:
+                        continue
+                    if pid is not None and doc.get("pid") != pid:
+                        continue
+                    seen.add(key)
+                    snaps.append(doc)
+        except Exception:  # noqa: BLE001 — unreachable node: skip it
+            pass
+
+    for n in nodes:
+        if getattr(n, "client", None) is None:
+            continue
+        t = threading.Thread(target=_one, args=(n,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=10)
+    snaps.sort(key=lambda d: d.get("ts", 0))
+    return {"snapshots": snaps, "merged": merge_history(snaps),
+            "since_s": float(since_s)}
+
+
+def parse_lookback(text: str) -> float:
+    """'10m' / '90s' / '2h' / plain seconds → seconds (float)."""
+    s = str(text).strip().lower()
+    mult = 1.0
+    if s.endswith(("s", "m", "h", "d")):
+        mult = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}[s[-1]]
+        s = s[:-1]
+    return float(s) * mult
+
+
+# -- process-wide singleton --------------------------------------------------
+
+_PROFILER: Optional[ContinuousProfiler] = None
+_PROFILER_LOCK = threading.Lock()
+
+
+def start_continuous_profiler(role: str,
+                              **kwargs: Any
+                              ) -> Optional[ContinuousProfiler]:
+    """Idempotent per-process start; honors ``contprof_enabled``."""
+    global _PROFILER
+    if not config.contprof_enabled:
+        return None
+    with _PROFILER_LOCK:
+        if _PROFILER is None:
+            _PROFILER = ContinuousProfiler(role, **kwargs).start()
+        return _PROFILER
+
+
+def stop_continuous_profiler() -> None:
+    global _PROFILER
+    with _PROFILER_LOCK:
+        prof, _PROFILER = _PROFILER, None
+    if prof is not None:
+        prof.stop()

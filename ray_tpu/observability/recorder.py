@@ -78,11 +78,21 @@ class FlightRecorder:
     # -- dumping ---------------------------------------------------------
 
     def dump(self, path: Optional[str] = None,
-             reason: str = "manual") -> str:
-        """Write the ring to a JSON file; → the path written."""
+             reason: str = "manual",
+             crash_pid: Optional[int] = None) -> str:
+        """Write the ring to a JSON file; → the path written.
+
+        The bundle is a complete postmortem, not just the event ring:
+        it carries the in-memory metrics-history window and the most
+        recent retained profile snapshot of the crashing process
+        (``crash_pid``, falling back to this process) so "what were the
+        metrics / where was it spending time" survives the crash.
+        """
         snap = self.snapshot()
         snap["reason"] = reason
         snap["dumped_at"] = time.time()
+        snap["metrics_history"] = _metrics_history_window()
+        snap["profile_snapshot"] = _latest_profile_snapshot(crash_pid)
         if path is None:
             path = os.path.join(
                 _dump_dir(),
@@ -96,9 +106,11 @@ class FlightRecorder:
         os.replace(tmp, path)
         return path
 
-    def auto_dump(self, reason: str) -> Optional[str]:
+    def auto_dump(self, reason: str,
+                  crash_pid: Optional[int] = None) -> Optional[str]:
         """Crash-path dump: rate-limited, never raises. → path or None
-        (disabled / rate-limited / write failed)."""
+        (disabled / rate-limited / write failed). ``crash_pid`` selects
+        which process's retained profile snapshot rides the bundle."""
         if not config.flight_recorder_enabled:
             return None
         now = time.time()
@@ -108,13 +120,36 @@ class FlightRecorder:
                 return None
             self._last_auto_dump = now
         try:
-            path = self.dump(reason=reason)
+            path = self.dump(reason=reason, crash_pid=crash_pid)
         except Exception:  # noqa: BLE001 - crash handling must not crash
             return None
         import logging
         logging.getLogger("ray_tpu").warning(
             "flight recorder dumped to %s (%s)", path, reason)
         return path
+
+
+def _metrics_history_window(window_s: float = 600.0):
+    """Trailing metrics-history window for the dump bundle (never
+    raises; [] when the TSDB is off/empty)."""
+    try:
+        from .tsdb import get_tsdb
+        return get_tsdb().window(window_s)
+    except Exception:  # noqa: BLE001 - crash handling must not crash
+        return []
+
+
+def _latest_profile_snapshot(crash_pid: Optional[int]):
+    """Most recent retained continuous-profile snapshot for the
+    crashing pid (falling back to the newest from any process)."""
+    try:
+        from .continuous import latest_snapshot
+        snap = None
+        if crash_pid is not None:
+            snap = latest_snapshot(pid=crash_pid)
+        return snap if snap is not None else latest_snapshot()
+    except Exception:  # noqa: BLE001 - crash handling must not crash
+        return None
 
 
 def _dump_dir() -> str:

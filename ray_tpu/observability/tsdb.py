@@ -1,0 +1,416 @@
+"""Embedded metrics history: per-series ring buffers + anomaly registry.
+
+The runtime's metrics were point-in-time only — `/metrics` renders the
+registry *now*, so a postmortem after a stalled RLHF iteration or a
+dead replica had no history to look at. This module is the
+retained-history half (the Monarch/Prometheus idea, without requiring
+an external collector): a scraper thread samples
+``util/metrics.snapshot_scalars()`` every ``resolution_s`` seconds into
+fixed-size per-series rings (``window_s / resolution_s`` points), so
+every long-lived process carries its own ~1 h of 10 s-resolution
+history at a few KB per series.
+
+Cluster merge rides the existing load-report plane: node daemons attach
+their latest scrape to heartbeats (``node/daemon.py::_load_report``)
+and the driver-side dashboard feeds those into its own TSDB tagged with
+the source node, so ``GET /api/metrics/history`` and ``ray_tpu obs``
+answer for the whole cluster.
+
+The anomaly registry on top is the shared sink for the per-plane
+watchdogs (RLHF rollout stragglers, serve TTFT outliers, dispatch-loop
+p95 spikes): one call increments ``ray_tpu_anomaly_total{plane,kind}``,
+records a flight-recorder ``anomaly`` event, and keeps a bounded recent
+list for ``ray_tpu status --verbose`` / ``/api/anomalies``.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .._private.config import config
+
+LOCAL_NODE = ""  # node tag for series scraped in-process
+
+
+class MetricsTSDB:
+    """Fixed-size per-series history of scalar metrics.
+
+    Series are keyed ``(name, node)`` — ``node=""`` for samples scraped
+    from this process's registry, a node id for samples merged off the
+    load-report plane — so the same metric name from two processes never
+    collides and a query can still ask for "all nodes of this name".
+    """
+
+    def __init__(self, resolution_s: Optional[float] = None,
+                 window_s: Optional[float] = None):
+        self.resolution_s = max(0.05, float(
+            resolution_s if resolution_s is not None
+            else config.metrics_history_resolution_s))
+        self.window_s = max(self.resolution_s, float(
+            window_s if window_s is not None
+            else config.metrics_history_window_s))
+        self._capacity = max(2, int(round(self.window_s
+                                          / self.resolution_s)))
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, str],
+                           "collections.deque[Tuple[float, float]]"] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- recording -----------------------------------------------------
+
+    def record(self, name: str, value: float, ts: Optional[float] = None,
+               node: str = LOCAL_NODE) -> None:
+        key = (name, node)
+        with self._lock:
+            ring = self._series.get(key)
+            if ring is None:
+                ring = self._series[key] = collections.deque(
+                    maxlen=self._capacity)
+            ring.append((float(ts if ts is not None else time.time()),
+                         float(value)))
+
+    def scrape_once(self, ts: Optional[float] = None) -> int:
+        """Sample the live metrics registry; → number of series seen."""
+        from ..util.metrics import snapshot_scalars
+
+        try:
+            scalars = snapshot_scalars()
+        except Exception:  # noqa: BLE001 — observer must not throw
+            return 0
+        now = ts if ts is not None else time.time()
+        for name, value in scalars.items():
+            self.record(name, value, ts=now)
+        return len(scalars)
+
+    def merge_remote(self, node: str, samples: Dict[str, float],
+                     ts: Optional[float] = None) -> None:
+        """Fold one remote process's scrape (off a load report) in,
+        tagged with its node id. Re-recording the same heartbeat twice
+        within a resolution step is collapsed to one point."""
+        if not samples:
+            return
+        now = ts if ts is not None else time.time()
+        with self._lock:
+            for name, value in samples.items():
+                key = (str(name), str(node))
+                ring = self._series.get(key)
+                if ring is None:
+                    ring = self._series[key] = collections.deque(
+                        maxlen=self._capacity)
+                if ring and now - ring[-1][0] < self.resolution_s:
+                    ring[-1] = (ring[-1][0], float(value))
+                else:
+                    ring.append((now, float(value)))
+
+    # -- scraper thread ------------------------------------------------
+
+    def start(self) -> "MetricsTSDB":
+        if self._thread is not None or not config.metrics_history_enabled:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="ray-tpu-metrics-tsdb", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.scrape_once()
+            try:
+                check_event_stats_spikes()
+            except Exception:  # noqa: BLE001
+                pass
+            self._stop.wait(self.resolution_s)
+
+    # -- querying ------------------------------------------------------
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted({name for name, _ in self._series})
+
+    def query(self, name: Optional[str] = None,
+              since: Optional[float] = None,
+              node: Optional[str] = None) -> List[Dict[str, Any]]:
+        """→ ``[{"name", "node", "points": [[ts, value], ...]}, ...]``.
+
+        ``name`` filters to one metric (all nodes unless ``node`` is
+        given); ``since`` is an absolute unix timestamp lower bound.
+        """
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            items = sorted(self._series.items())
+        for (sname, snode), ring in items:
+            if name is not None and sname != name:
+                continue
+            if node is not None and snode != node:
+                continue
+            pts = [[ts, v] for ts, v in ring
+                   if since is None or ts >= since]
+            if pts:
+                out.append({"name": sname, "node": snode, "points": pts})
+        return out
+
+    def latest(self, node: str = LOCAL_NODE) -> Dict[str, float]:
+        """Newest value per local series — what daemons ship on the
+        load-report path (small: one float per metric name)."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            for (sname, snode), ring in self._series.items():
+                if snode == node and ring:
+                    out[sname] = ring[-1][1]
+        return out
+
+    def window(self, window_s: float) -> List[Dict[str, Any]]:
+        """All series restricted to the trailing ``window_s`` seconds —
+        the crash-dump bundle payload."""
+        return self.query(since=time.time() - max(0.0, float(window_s)))
+
+    def summary(self, name: str, node: Optional[str] = None,
+                since: Optional[float] = None) -> Dict[str, Any]:
+        """min/max/mean/last over one metric's merged points."""
+        pts = [p for s in self.query(name=name, since=since, node=node)
+               for p in s["points"]]
+        if not pts:
+            return {"name": name, "n": 0}
+        vals = [v for _, v in pts]
+        return {"name": name, "n": len(vals), "min": min(vals),
+                "max": max(vals), "mean": sum(vals) / len(vals),
+                "last": sorted(pts)[-1][1]}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+# -- robust statistics helpers ----------------------------------------------
+
+
+def median(values: List[float]) -> float:
+    s = sorted(values)
+    n = len(s)
+    if not n:
+        return float("nan")
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def mad(values: List[float], center: Optional[float] = None) -> float:
+    """Median absolute deviation (unscaled)."""
+    if not values:
+        return float("nan")
+    c = median(values) if center is None else center
+    return median([abs(v - c) for v in values])
+
+
+def ewma_update(prev: Optional[float], value: float,
+                alpha: Optional[float] = None) -> float:
+    a = config.anomaly_ewma_alpha if alpha is None else alpha
+    return float(value) if prev is None else (
+        a * float(value) + (1.0 - a) * prev)
+
+
+def mad_outliers(values: Dict[str, float], k: Optional[float] = None,
+                 side: str = "low",
+                 min_samples: Optional[int] = None) -> Dict[str, float]:
+    """Robust cohort outlier test: → ``{subject: deviation}`` for
+    subjects more than ``k`` MADs below (``side="low"``), above
+    (``"high"``), or away from (``"both"``) the cohort median.
+
+    MAD==0 (a perfectly uniform cohort) falls back to 5% of the median
+    as the deviation unit so a single wildly-slow subject in an
+    otherwise identical fleet is still caught.
+    """
+    k = config.anomaly_mad_k if k is None else float(k)
+    need = (config.anomaly_min_samples if min_samples is None
+            else int(min_samples))
+    vals = {s: float(v) for s, v in values.items()
+            if isinstance(v, (int, float)) and math.isfinite(float(v))}
+    if len(vals) < max(2, need):
+        return {}
+    med = median(list(vals.values()))
+    spread = mad(list(vals.values()), center=med)
+    if spread <= 0:
+        spread = abs(med) * 0.05
+    if spread <= 0:
+        return {}
+    out: Dict[str, float] = {}
+    for subject, v in vals.items():
+        dev = (v - med) / spread
+        if side == "low" and dev < -k:
+            out[subject] = dev
+        elif side == "high" and dev > k:
+            out[subject] = dev
+        elif side == "both" and abs(dev) > k:
+            out[subject] = dev
+    return out
+
+
+# -- anomaly registry --------------------------------------------------------
+
+
+class AnomalyRegistry:
+    """Shared sink for the per-plane watchdogs. One ``flag()`` call:
+    counter + flight-recorder event + bounded recent list. Repeated
+    flags for the same (plane, kind, subject) are rate-limited so a
+    persistently slow generator doesn't melt the counter."""
+
+    def __init__(self, max_recent: int = 256,
+                 min_repeat_interval_s: float = 30.0):
+        self._lock = threading.Lock()
+        self._recent: "collections.deque[Dict[str, Any]]" = \
+            collections.deque(maxlen=max_recent)
+        self._last_flag: Dict[Tuple[str, str, str], float] = {}
+        self._min_repeat_s = min_repeat_interval_s
+
+    def flag(self, plane: str, kind: str, subject: str,
+             **fields: Any) -> bool:
+        """→ True if recorded, False if suppressed/disabled."""
+        if not config.anomaly_detection_enabled:
+            return False
+        now = time.time()
+        key = (plane, kind, subject)
+        with self._lock:
+            last = self._last_flag.get(key, 0.0)
+            if now - last < self._min_repeat_s:
+                return False
+            self._last_flag[key] = now
+            ev = {"ts": now, "plane": plane, "kind": kind,
+                  "subject": subject}
+            ev.update(fields)
+            self._recent.append(ev)
+        try:
+            _anomaly_counter().inc(tags={"plane": plane, "kind": kind})
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from .recorder import get_recorder
+            get_recorder().record("anomaly", kind, plane=plane,
+                                  subject=subject, **fields)
+        except Exception:  # noqa: BLE001
+            pass
+        return True
+
+    def recent(self, since: Optional[float] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            evs = list(self._recent)
+        if since is not None:
+            evs = [e for e in evs if e["ts"] >= since]
+        return evs
+
+    def clear(self) -> None:
+        with self._lock:
+            self._recent.clear()
+            self._last_flag.clear()
+
+
+_ANOMALY_COUNTER = None
+_ANOMALY_COUNTER_LOCK = threading.Lock()
+
+
+def _anomaly_counter():
+    """Lazy so `clear_registry()` in tests doesn't orphan the series."""
+    global _ANOMALY_COUNTER
+    from ..util import metrics
+    with _ANOMALY_COUNTER_LOCK:
+        if (_ANOMALY_COUNTER is None or
+                metrics._REGISTRY.get("ray_tpu_anomaly_total")
+                is not _ANOMALY_COUNTER):
+            _ANOMALY_COUNTER = metrics.Counter(
+                "ray_tpu_anomaly_total",
+                "Watchdog-flagged anomalies (stragglers, TTFT outliers, "
+                "handler p95 spikes).",
+                tag_keys=("plane", "kind"))
+        return _ANOMALY_COUNTER
+
+
+# -- dispatch-loop p95 spike watchdog ----------------------------------------
+
+_P95_LOCK = threading.Lock()
+_P95_TRAIL: Dict[Tuple[str, str], "collections.deque[float]"] = {}
+
+
+def check_event_stats_spikes() -> List[str]:
+    """Compare each (loop, handler)'s current p95 against its trailing
+    window median; flag >factor spikes. Called from the scraper loop.
+    → list of flagged 'loop.handler' names (for tests)."""
+    if not config.anomaly_detection_enabled:
+        return []
+    from . import event_stats
+
+    try:
+        snap = event_stats.snapshot()
+    except Exception:  # noqa: BLE001
+        return []
+    factor = config.anomaly_p95_spike_factor
+    need = max(2, config.anomaly_min_samples)
+    flagged: List[str] = []
+    for loop, handlers in snap.items():
+        for handler, st in handlers.items():
+            p95 = float(st.get("p95_s") or 0.0)
+            key = (loop, handler)
+            with _P95_LOCK:
+                trail = _P95_TRAIL.get(key)
+                if trail is None:
+                    trail = _P95_TRAIL[key] = collections.deque(maxlen=30)
+                history = list(trail)
+                trail.append(p95)
+            if len(history) < need:
+                continue
+            base = median(history)
+            if base > 0 and p95 > factor * base:
+                name = f"{loop}.{handler}"
+                if get_anomaly_registry().flag(
+                        "dispatch", "handler_p95_spike", name,
+                        p95_s=p95, trailing_median_s=base):
+                    flagged.append(name)
+    return flagged
+
+
+def reset_spike_trail() -> None:
+    """Test hook."""
+    with _P95_LOCK:
+        _P95_TRAIL.clear()
+
+
+# -- process-wide singletons -------------------------------------------------
+
+_TSDB: Optional[MetricsTSDB] = None
+_TSDB_LOCK = threading.Lock()
+_ANOMALIES = AnomalyRegistry()
+
+
+def get_tsdb() -> MetricsTSDB:
+    global _TSDB
+    with _TSDB_LOCK:
+        if _TSDB is None:
+            _TSDB = MetricsTSDB()
+        return _TSDB
+
+
+def get_anomaly_registry() -> AnomalyRegistry:
+    return _ANOMALIES
+
+
+def start_scraper() -> MetricsTSDB:
+    """Idempotent: build-and-start the process-wide TSDB scraper."""
+    return get_tsdb().start()
+
+
+def stop_scraper() -> None:
+    global _TSDB
+    with _TSDB_LOCK:
+        db, _TSDB = _TSDB, None
+    if db is not None:
+        db.stop()

@@ -31,6 +31,7 @@ import numpy as np
 from ..core.exceptions import ActorDiedError, ActorError, RayTpuError
 from ..models.transformer import TransformerConfig
 from ..observability import get_recorder
+from ..observability import tsdb as _tsdb
 from ..parallel.plan import ParallelPlan
 from ..util import tracing as _tracing
 from .learner import GRPOLearner, GRPOLearnerConfig
@@ -134,6 +135,10 @@ class RLHFPipeline:
         self._version = -1
         self._last_refresh: List[Any] = []  # refs, for respawn catch-up
         self.respawns = 0
+        # Per-generator tok/s EWMA across iterations — straggler
+        # detection compares each against the fleet (MAD cohort test).
+        self._gen_tps: List[Optional[float]] = (
+            [None] * cfg.num_generators)
         self._ckpt = None
         if cfg.checkpoint_path:
             from ..train.checkpoint import CheckpointManager
@@ -163,9 +168,30 @@ class RLHFPipeline:
         get_recorder().record("rlhf", "generator_respawn", index=i,
                               version=self._version)
         self.generators[i] = self._spawn_generator(i)
+        self._gen_tps[i] = None  # fresh actor, fresh throughput history
         if self._last_refresh:
             ray_tpu.get(self.generators[i].refresh_weights.remote(
                 self._version, *self._last_refresh))
+
+    def _detect_stragglers(self) -> List[int]:
+        """Generators whose tok/s EWMA sits k MADs below the fleet —
+        the slow-node signal (thermal throttle, noisy neighbor, bad
+        HBM) that per-iteration totals average away."""
+        from .._private.config import config
+
+        if not config.anomaly_detection_enabled:
+            return []
+        fleet = {str(i): tps for i, tps in enumerate(self._gen_tps)
+                 if tps is not None}
+        out = _tsdb.mad_outliers(fleet, side="low")
+        stragglers = sorted(int(i) for i in out)
+        reg = _tsdb.get_anomaly_registry()
+        for i in stragglers:
+            reg.flag("rlhf", "straggler", f"generator:{i}",
+                     tokens_per_s=round(self._gen_tps[i], 3),
+                     deviation=round(out[str(i)], 3),
+                     iteration=self.iteration)
+        return stragglers
 
     def _get_with_revival(self, i: int, submit: Callable[[], Any]):
         """ray_tpu.get(submit()) with one respawn-and-retry on actor
@@ -309,10 +335,17 @@ class RLHFPipeline:
                         temperature=cfg.temperature,
                         eos_token=cfg.eos_token)
 
-                results = [
-                    self._get_with_revival(i, lambda i=i: _roll(i))
-                    for i in range(cfg.num_generators)]
+                results = []
+                for i in range(cfg.num_generators):
+                    t_gen = time.perf_counter()
+                    r = self._get_with_revival(i, lambda i=i: _roll(i))
+                    gen_s = time.perf_counter() - t_gen
+                    results.append(r)
+                    gen_tok = int(r["lengths"].sum())
+                    self._gen_tps[i] = _tsdb.ewma_update(
+                        self._gen_tps[i], gen_tok / max(gen_s, 1e-9))
                 rollout_s = time.perf_counter() - t_roll
+            stragglers = self._detect_stragglers()
             seqs = np.concatenate([r["seqs"] for r in results])
             logprobs = np.concatenate([r["logprobs"] for r in results])
             lengths = np.concatenate([r["lengths"] for r in results])
@@ -366,6 +399,7 @@ class RLHFPipeline:
             "refresh_bytes": refresh["bytes"],
             "iteration_s": dt,
             "tokens_per_s": tokens_out / max(rollout_s, 1e-9),
+            "stragglers": stragglers,
             **metrics,
         }
         if (self._ckpt is not None and cfg.checkpoint_every

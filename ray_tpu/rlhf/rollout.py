@@ -45,8 +45,19 @@ class RolloutWorker:
                                 capture_logprobs=True)
         self._version = -1  # seed weights; refresh installs version >= 0
         self._refresh_bytes = 0
+        self._inject_delay_s = 0.0
         if system_prompt:
             self.engine.register_prefix(list(system_prompt))
+
+    def inject_fault(self, kind: str, value) -> None:
+        """Chaos hook (same contract as serve Replica.inject_fault):
+        `rollout_delay_s` makes this generator a deterministic
+        straggler — every rollout sleeps first, the slow-node shape
+        the anomaly watchdog must flag."""
+        if kind == "rollout_delay_s":
+            self._inject_delay_s = float(value)
+        else:
+            raise ValueError(f"unknown fault kind: {kind}")
 
     # -- weight refresh ------------------------------------------------
 
@@ -100,6 +111,8 @@ class RolloutWorker:
         max_new) sampling-time logp per generated token, "lengths"
         (N,) completion lengths, and the policy "version" sampled
         from."""
+        if self._inject_delay_s > 0:
+            time.sleep(self._inject_delay_s)
         prompts = np.asarray(prompts, np.int32)
         n, P = prompts.shape
         grouped = np.repeat(prompts, group_size, axis=0)

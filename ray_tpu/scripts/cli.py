@@ -24,6 +24,7 @@ import contextlib
 import json
 import os
 import sys
+import urllib.parse
 import urllib.request
 from typing import Any, Optional
 
@@ -212,17 +213,42 @@ def cmd_status(args) -> int:
             with contextlib.suppress(Exception):
                 status["event_stats"] = _fetch(args.address,
                                                "/api/event_stats")
+            # Watchdog-flagged anomalies (RLHF stragglers, serve TTFT
+            # outliers, handler p95 spikes) — a degraded-but-alive
+            # cluster is visible from `status` alone.
+            with contextlib.suppress(Exception):
+                status["anomalies"] = _fetch(
+                    args.address, "/api/anomalies").get("anomalies")
         _print(status)
+        _print_anomaly_lines(status.get("anomalies"))
         return 0
     state = _local_state()
     status = state.cluster_status()
     if getattr(args, "verbose", False):
         from ray_tpu.observability import event_stats as _estats
+        from ray_tpu.observability.tsdb import get_anomaly_registry
 
         status = dict(status)
         status["event_stats"] = {"head": _estats.snapshot()}
+        status["anomalies"] = get_anomaly_registry().recent()
     _print(status)
+    _print_anomaly_lines(status.get("anomalies"))
     return 0
+
+
+def _print_anomaly_lines(anomalies) -> None:
+    """Human-scannable one-liners after the JSON blob (only under
+    --verbose, which is the only path that sets the key)."""
+    if not anomalies:
+        return
+    print(f"\n{len(anomalies)} anomaly event(s):", file=sys.stderr)
+    for ev in anomalies[-20:]:
+        detail = ", ".join(
+            f"{k}={v}" for k, v in sorted(ev.items())
+            if k not in ("ts", "plane", "kind", "subject"))
+        print(f"  [{ev.get('plane')}/{ev.get('kind')}] "
+              f"{ev.get('subject')}" + (f" ({detail})" if detail else ""),
+              file=sys.stderr)
 
 
 def cmd_list(args) -> int:
@@ -406,19 +432,27 @@ def cmd_profile(args) -> int:
     """On-demand cluster flamegraph (reference: `ray stack` + the
     dashboard reporter's py-spy endpoints): POST /api/profile arms the
     pure-Python stack sampler in the driver, its local workers, and
-    every node daemon, and merges the collapsed stacks."""
+    every node daemon, and merges the collapsed stacks.
+
+    With --since, no new capture is armed: the continuous profiler's
+    retained snapshot ring is queried instead (GET
+    /api/profile/history), answering "what was the cluster doing ten
+    minutes ago" after the fact."""
     address = args.address or "http://127.0.0.1:8265"
-    qs = [f"duration={args.duration}", f"interval={args.interval}"]
-    if args.node:
-        qs.append(f"node={args.node}")
-    if args.pid is not None:
-        qs.append(f"pid={args.pid}")
-    req = urllib.request.Request(
-        address.rstrip("/") + "/api/profile?" + "&".join(qs),
-        method="POST")
-    timeout = max(60.0, float(args.duration) * 3 + 30)
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        out = json.loads(resp.read().decode())
+    if getattr(args, "since", None):
+        out = _profile_history(address, args)
+    else:
+        qs = [f"duration={args.duration}", f"interval={args.interval}"]
+        if args.node:
+            qs.append(f"node={args.node}")
+        if args.pid is not None:
+            qs.append(f"pid={args.pid}")
+        req = urllib.request.Request(
+            address.rstrip("/") + "/api/profile?" + "&".join(qs),
+            method="POST")
+        timeout = max(60.0, float(args.duration) * 3 + 30)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out = json.loads(resp.read().decode())
     if out.get("error"):
         print(out["error"], file=sys.stderr)
         return 1
@@ -432,16 +466,124 @@ def cmd_profile(args) -> int:
         with open(path, "w") as f:
             json.dump(doc, f)
     else:
+        from ray_tpu.observability.stack_sampler import to_collapsed
+
         path = args.output or "profile.collapsed"
         with open(path, "w") as f:
-            f.write(out.get("collapsed") or "")
+            f.write(out.get("collapsed") or to_collapsed(merged))
     procs = out.get("processes") or []
-    print(f"sampled {len(procs)} processes "
+    verb = "merged" if getattr(args, "since", None) else "sampled"
+    print(f"{verb} {len(procs)} processes "
           f"({', '.join(procs)}): {len(merged)} unique stacks -> {path}")
     if args.format == "collapsed":
         print("render: flamegraph.pl / speedscope / inferno "
               f"< {path}")
     return 0 if merged else 1
+
+
+def _profile_history(address: str, args) -> dict:
+    """--since path: fetch retained snapshots. Dashboard first; when no
+    dashboard answers, read the newest local session's ring directly so
+    post-mortem profiling works on a dead cluster."""
+    from ray_tpu.observability import continuous
+
+    since_s = continuous.parse_lookback(args.since)
+    qs = [f"since={since_s}", "fmt=json"]
+    if args.pid is not None:
+        qs.append(f"pid={args.pid}")
+    try:
+        return _fetch(address, "/api/profile/history?" + "&".join(qs))
+    except Exception:  # noqa: BLE001 — dashboard down: local ring
+        pass
+    snaps = continuous.load_snapshots(
+        since_s=since_s, directory=_latest_session_contprof_dir(),
+        pid=args.pid)
+    merged = continuous.merge_history(snaps)
+    procs = sorted({f"{s.get('role')}:{s.get('pid')}" for s in snaps})
+    return {"merged": merged, "processes": procs,
+            "snapshots": snaps, "since_s": since_s}
+
+
+def _latest_session_contprof_dir() -> Optional[str]:
+    from ray_tpu._private.config import config
+    from ray_tpu._private.session import BASE
+
+    if config.contprof_dir:
+        return config.contprof_dir
+    path = os.path.join(BASE, "session_latest", "contprof")
+    return path if os.path.isdir(path) else None
+
+
+def cmd_obs(args) -> int:
+    """Embedded metrics history (`obs top` / `obs plot`): query the
+    dashboard's in-memory TSDB — no Prometheus required."""
+    address = args.address or "http://127.0.0.1:8265"
+    qs = []
+    if getattr(args, "name", None):
+        qs.append("name=" + urllib.parse.quote(args.name))
+    if getattr(args, "since", None):
+        qs.append("since=" + urllib.parse.quote(args.since))
+    path = "/api/metrics/history" + ("?" + "&".join(qs) if qs else "")
+    try:
+        out = _fetch(address, path)
+    except Exception as exc:  # noqa: BLE001
+        print(f"error: cannot reach dashboard at {address}: {exc}",
+              file=sys.stderr)
+        return 1
+    series = out.get("series") or []
+    if args.obs_cmd == "plot":
+        if not series:
+            print(f"no history for {args.name!r}", file=sys.stderr)
+            return 1
+        for s in series:
+            _plot_series(s, width=args.width)
+        return 0
+    # top: one summary row per series, sorted by name then node.
+    rows = []
+    for s in series:
+        pts = s.get("points") or []
+        if not pts:
+            continue
+        vals = [p[1] for p in pts]
+        rows.append((s.get("name"), s.get("node") or "local",
+                     len(pts), min(vals), max(vals), vals[-1]))
+    rows.sort()
+    if not rows:
+        print("no metrics history yet", file=sys.stderr)
+        return 1
+    wname = max(len(r[0]) for r in rows)
+    wnode = max(max(len(r[1]) for r in rows), 4)
+    print(f"{'name':<{wname}}  {'node':<{wnode}}  {'n':>5}  "
+          f"{'min':>12}  {'max':>12}  {'last':>12}")
+    for name, node, n, lo, hi, last in rows:
+        print(f"{name:<{wname}}  {node:<{wnode}}  {n:>5}  "
+              f"{lo:>12.4g}  {hi:>12.4g}  {last:>12.4g}")
+    return 0
+
+
+def _plot_series(series: dict, width: int = 72, height: int = 8) -> None:
+    """ASCII plot of one series (terminal-only; Grafana does the rest)."""
+    pts = series.get("points") or []
+    name = series.get("name")
+    node = series.get("node") or "local"
+    if not pts:
+        print(f"{name} [{node}]: (empty)")
+        return
+    vals = [p[1] for p in pts]
+    if len(vals) > width:  # downsample to terminal width, keep shape
+        step = len(vals) / width
+        vals = [vals[int(i * step)] for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    print(f"{name} [{node}]  n={len(pts)}  "
+          f"min={lo:.4g} max={hi:.4g} last={pts[-1][1]:.4g}")
+    rows = [[" "] * len(vals) for _ in range(height)]
+    for x, v in enumerate(vals):
+        y = int(round((v - lo) / span * (height - 1)))
+        rows[height - 1 - y][x] = "*"
+    for r in rows:
+        print("  |" + "".join(r))
+    print("  +" + "-" * len(vals))
 
 
 def cmd_memory(args) -> int:
@@ -641,7 +783,34 @@ def build_parser() -> argparse.ArgumentParser:
                     default="collapsed",
                     help="collapsed stacks (flamegraph.pl/speedscope) "
                          "or chrome://tracing JSON")
+    pf.add_argument("--since", default=None, metavar="LOOKBACK",
+                    help="no new capture: merge the continuous "
+                         "profiler's retained snapshots from the last "
+                         "LOOKBACK ('10m', '90s', '2h', or seconds)")
     pf.set_defaults(fn=cmd_profile)
+
+    ob = sub.add_parser("obs",
+                        help="embedded metrics history (no Prometheus "
+                             "needed): top = summary table, plot = "
+                             "ASCII chart of one metric")
+    ob_sub = ob.add_subparsers(dest="obs_cmd", required=True)
+    ot = ob_sub.add_parser("top",
+                           help="one row per retained series: "
+                                "n/min/max/last")
+    ot.add_argument("--name", default=None,
+                    help="restrict to one metric name")
+    ot.add_argument("--since", default=None, metavar="LOOKBACK",
+                    help="only points from the last LOOKBACK "
+                         "('10m', '1h', or seconds)")
+    ot.set_defaults(fn=cmd_obs)
+    op = ob_sub.add_parser("plot",
+                           help="ASCII plot of one metric's history, "
+                                "one chart per node series")
+    op.add_argument("--name", required=True,
+                    help="metric name, e.g. ray_tpu_serve_queue_depth")
+    op.add_argument("--since", default=None, metavar="LOOKBACK")
+    op.add_argument("--width", type=int, default=72)
+    op.set_defaults(fn=cmd_obs)
 
     lp = sub.add_parser("list")
     lp.add_argument("kind", choices=[

@@ -26,6 +26,27 @@ def _rkey(replica: Any) -> str:
     return aid.hex() if aid is not None else f"local:{id(replica)}"
 
 
+_TTFT_GAUGE = None
+
+
+def _ttft_gauge():
+    """Lazy singleton (registry rejects re-registration): per-replica
+    TTFT EWMA republished from the stats harvest so Grafana and the
+    metrics-history TSDB see the outlier the router routes around."""
+    global _TTFT_GAUGE
+    if _TTFT_GAUGE is None:
+        try:
+            from ..util import metrics as mm
+
+            _TTFT_GAUGE = mm.Gauge(
+                "ray_tpu_serve_ttft_s",
+                "Per-replica time-to-first-token EWMA",
+                tag_keys=("deployment", "replica"))
+        except Exception:  # noqa: BLE001 — name taken by another owner
+            return None
+    return _TTFT_GAUGE
+
+
 class _ReplicaSet:
     def __init__(self, deployment: Deployment):
         import cloudpickle
@@ -394,6 +415,32 @@ class ServeController:
     STATS_POLL_S = 0.5
     HC_CONSECUTIVE_FAILS = 2
 
+    def _check_ttft_outliers(self, rs: _ReplicaSet) -> None:
+        """Replicas whose TTFT EWMA sits k MADs above the cohort —
+        the degraded-replica signal the mean-latency router smooths
+        over. Flagged, not restarted: the health check owns killing."""
+        from ray_tpu._private.config import config as _cfg
+        from ray_tpu.observability import tsdb as _tsdb
+
+        if not _cfg.anomaly_detection_enabled:
+            return
+        ttfts = {key: s["ewma_ttft_s"]
+                 for key, s in rs.stats_cache.items()
+                 if isinstance(s, dict)
+                 and (s.get("ewma_ttft_s") or 0) > 0}
+        gauge = _ttft_gauge()
+        if gauge is not None:
+            for key, v in ttfts.items():
+                gauge.set(v, tags={"deployment": rs.deployment.name,
+                                   "replica": str(key)})
+        out = _tsdb.mad_outliers(ttfts, side="high")
+        reg = _tsdb.get_anomaly_registry()
+        for key, dev in out.items():
+            reg.flag("serve", "ttft_outlier",
+                     f"{rs.deployment.name}:{key}",
+                     ewma_ttft_s=round(ttfts[key], 6),
+                     deviation=round(dev, 3))
+
     def _probe_replicas(self, sets: List[_ReplicaSet]):
         """Stats polling + health checks, fire-and-harvest: probes are
         fired without waiting and collected with timeout=0 on later
@@ -435,6 +482,8 @@ class ServeController:
                         rs._stats_pending[key] = r.stats.remote()
                     except Exception:  # noqa: BLE001
                         pass
+            if fire_stats:
+                self._check_ttft_outliers(rs)
             # -- health checks -------------------------------------------
             period = cfg.health_check_period_s
             if period is None or period <= 0:

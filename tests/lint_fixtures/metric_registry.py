@@ -1,0 +1,31 @@
+"""Seeded violations for the ``metric-name-registry`` rule.
+
+One unregistered metric name (must FIRE), one suppressed twin (must
+count as suppressed, not active), one registered name and one
+``collections.Counter`` look-alike (must stay silent).
+"""
+
+from collections import Counter as TokenCounter
+
+from ray_tpu.util import metrics as mm
+
+
+def registered_ok():
+    # In docs/METRICS.md: silent.
+    return mm.Counter("ray_tpu_anomaly_total", "watchdog anomalies",
+                      tag_keys=("plane", "kind"))
+
+
+def unregistered_fires():
+    return mm.Counter("ray_tpu_never_inventoried_total",
+                      "missing from docs/METRICS.md")
+
+
+def suppressed_twin():
+    return mm.Gauge("ray_tpu_also_not_inventoried", "twin")  # raylint: disable=metric-name-registry -- fixture: exercising the suppression path
+
+
+def not_a_metric():
+    # collections.Counter takes an iterable, not (name, description):
+    # the description discriminator keeps this silent.
+    return TokenCounter("aabbcc")

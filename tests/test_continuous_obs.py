@@ -1,0 +1,519 @@
+"""Continuous observability plane: always-on profiler ring + retention,
+embedded metrics-history TSDB, anomaly/straggler watchdogs, crash-dump
+bundling, and the bench regression gate.
+
+Fast by construction: profiler duty cycles and TSDB windows are
+overridden to milliseconds via config.apply; the only real-cluster
+piece (RLHF straggler flagging) runs on the in-process runtime with a
+tiny model. Multi-daemon soaks stay in the slow-marked cluster files.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.config import config
+from ray_tpu.observability import continuous, tsdb
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Each test sees fresh singletons and default knobs."""
+    tsdb.get_tsdb().clear()
+    tsdb.get_anomaly_registry().clear()
+    tsdb.reset_spike_trail()
+    yield
+    tsdb.get_tsdb().clear()
+    tsdb.get_anomaly_registry().clear()
+    tsdb.reset_spike_trail()
+
+
+# ---------------------------------------------------------------------------
+# Snapshot ring + retention
+# ---------------------------------------------------------------------------
+
+
+def _write(dirpath, ts, role="worker", pid=1, nstacks=1):
+    return continuous.write_snapshot(
+        {f"main;f{i}": 1 + i for i in range(nstacks)},
+        role=role, node_id="n1", directory=str(dirpath), ts=ts, pid=pid,
+        retention_count=10 ** 6, retention_bytes=10 ** 9)
+
+
+def test_ring_retention_count_deletes_oldest_first(tmp_path):
+    for i in range(8):
+        _write(tmp_path, ts=100.0 + i)
+    deleted = continuous.enforce_retention(
+        str(tmp_path), retention_count=3, retention_bytes=10 ** 9)
+    assert deleted == 5
+    snaps = continuous.load_snapshots(directory=str(tmp_path))
+    assert [s["ts"] for s in snaps] == [105.0, 106.0, 107.0]
+
+
+def test_ring_retention_byte_cap_keeps_newest(tmp_path):
+    paths = [_write(tmp_path, ts=100.0 + i, nstacks=50)
+             for i in range(6)]
+    one = os.path.getsize(paths[0])
+    # Cap at ~2 files' worth: everything but the newest two goes.
+    continuous.enforce_retention(str(tmp_path), retention_count=100,
+                                 retention_bytes=int(one * 2.5))
+    snaps = continuous.load_snapshots(directory=str(tmp_path))
+    assert [s["ts"] for s in snaps] == [104.0, 105.0]
+    # A cap smaller than any single file still keeps the newest one.
+    continuous.enforce_retention(str(tmp_path), retention_count=100,
+                                 retention_bytes=1)
+    snaps = continuous.load_snapshots(directory=str(tmp_path))
+    assert [s["ts"] for s in snaps] == [105.0]
+
+
+def test_load_snapshots_lookback_and_filters(tmp_path):
+    now = time.time()
+    _write(tmp_path, ts=now - 3600, role="daemon", pid=10)
+    _write(tmp_path, ts=now - 5, role="worker", pid=20)
+    _write(tmp_path, ts=now - 2, role="worker", pid=30)
+    assert len(continuous.load_snapshots(directory=str(tmp_path))) == 3
+    recent = continuous.load_snapshots(since_s=60,
+                                       directory=str(tmp_path))
+    assert [s["pid"] for s in recent] == [20, 30]
+    assert [s["pid"] for s in continuous.load_snapshots(
+        directory=str(tmp_path), role="worker")] == [20, 30]
+    assert [s["pid"] for s in continuous.load_snapshots(
+        directory=str(tmp_path), pid=10)] == [10]
+    latest = continuous.latest_snapshot(directory=str(tmp_path))
+    assert latest["pid"] == 30
+    assert continuous.latest_snapshot(directory=str(tmp_path),
+                                      pid=20)["pid"] == 20
+
+
+def test_merge_history_prefixes_role_pid(tmp_path):
+    now = time.time()
+    _write(tmp_path, ts=now - 3, role="driver", pid=1)
+    _write(tmp_path, ts=now - 2, role="driver", pid=1)
+    _write(tmp_path, ts=now - 1, role="worker", pid=2)
+    snaps = continuous.load_snapshots(directory=str(tmp_path))
+    merged = continuous.merge_history(snaps)
+    assert any(k.startswith("driver:1;") for k in merged)
+    assert any(k.startswith("worker:2;") for k in merged)
+    # Two driver snapshots of the same stack accumulate counts.
+    assert merged["driver:1;main;f0"] == 2
+
+
+def test_continuous_profiler_capture_once_tags_snapshot(tmp_path):
+    prof = continuous.ContinuousProfiler(
+        "testrole", node_id="nodeX", directory=str(tmp_path),
+        interval_s=60.0, duration_s=0.1, sample_interval_s=0.005)
+    path = prof.capture_once()
+    assert path is not None and os.path.exists(path)
+    doc = json.load(open(path))
+    assert doc["role"] == "testrole"
+    assert doc["node_id"] == "nodeX"
+    assert doc["pid"] == os.getpid()
+    assert doc["samples"]  # this very test thread was on-CPU
+
+
+def test_continuous_profiler_background_loop(tmp_path):
+    prof = continuous.ContinuousProfiler(
+        "bg", directory=str(tmp_path), interval_s=1.0, duration_s=0.05,
+        sample_interval_s=0.005).start()
+    try:
+        deadline = time.monotonic() + 10
+        while not os.listdir(str(tmp_path)):
+            assert time.monotonic() < deadline, "no snapshot written"
+            time.sleep(0.05)
+    finally:
+        prof.stop()
+    assert continuous.load_snapshots(directory=str(tmp_path))
+
+
+def test_parse_lookback():
+    assert continuous.parse_lookback("10m") == 600.0
+    assert continuous.parse_lookback("90s") == 90.0
+    assert continuous.parse_lookback("2h") == 7200.0
+    assert continuous.parse_lookback("1d") == 86400.0
+    assert continuous.parse_lookback("300") == 300.0
+
+
+# ---------------------------------------------------------------------------
+# Metrics-history TSDB
+# ---------------------------------------------------------------------------
+
+
+def test_tsdb_ring_bounds_and_since_filter():
+    db = tsdb.MetricsTSDB(resolution_s=1.0, window_s=10.0)
+    for i in range(25):
+        db.record("m", float(i), ts=1000.0 + i)
+    (series,) = db.query("m")
+    assert len(series["points"]) == 10  # window/resolution cap
+    assert series["points"][0] == [1015.0, 15.0]
+    (recent,) = db.query("m", since=1020.0)
+    assert [p[0] for p in recent["points"]] == [1020.0 + i
+                                               for i in range(5)]
+
+
+def test_tsdb_merge_remote_separates_nodes_and_collapses():
+    db = tsdb.MetricsTSDB(resolution_s=10.0, window_s=100.0)
+    db.record("q", 1.0, ts=1000.0)
+    db.merge_remote("nodeB", {"q": 5.0}, ts=1001.0)
+    db.merge_remote("nodeB", {"q": 7.0}, ts=1002.0)  # same step
+    series = db.query("q")
+    assert {s["node"] for s in series} == {tsdb.LOCAL_NODE, "nodeB"}
+    remote = next(s for s in series if s["node"] == "nodeB")
+    # Re-records within one resolution step collapse to one point
+    # carrying the latest value.
+    assert remote["points"] == [[1001.0, 7.0]]
+    assert db.latest(node="nodeB") == {"q": 7.0}
+
+
+def test_tsdb_scrape_once_reads_metric_registry():
+    from ray_tpu.util import metrics as mm
+
+    g = None
+    try:
+        g = mm.Gauge("contobs_test_gauge", "test")
+    except ValueError:
+        pass  # already registered by an earlier test run
+    if g is not None:
+        g.set(42.0)
+    db = tsdb.MetricsTSDB(resolution_s=0.5, window_s=60.0)
+    db.scrape_once(ts=2000.0)
+    got = db.query("contobs_test_gauge")
+    if g is not None:
+        assert got and got[0]["points"][-1][1] == 42.0
+
+
+def test_mad_outliers_sides_and_gates():
+    vals = {"a": 100.0, "b": 101.0, "c": 99.0, "d": 40.0}
+    low = tsdb.mad_outliers(vals, k=3.0, side="low", min_samples=4)
+    assert set(low) == {"d"} and low["d"] < -3.0
+    assert tsdb.mad_outliers(vals, k=3.0, side="high",
+                             min_samples=4) == {}
+    hi = dict(vals, d=200.0)
+    assert set(tsdb.mad_outliers(hi, k=3.0, side="high",
+                                 min_samples=4)) == {"d"}
+    # Cohort smaller than min_samples: silent.
+    assert tsdb.mad_outliers({"a": 1.0, "b": 100.0}, k=1.0,
+                             side="both", min_samples=4) == {}
+    # MAD==0 (identical cohort) falls back to 5% of median.
+    z = {"a": 100.0, "b": 100.0, "c": 100.0, "d": 50.0}
+    assert set(tsdb.mad_outliers(z, k=3.0, side="low",
+                                 min_samples=4)) == {"d"}
+
+
+def test_anomaly_registry_counter_recorder_and_rate_limit():
+    from ray_tpu.observability import get_recorder
+    from ray_tpu.util import metrics
+
+    reg = tsdb.AnomalyRegistry(min_repeat_interval_s=30.0)
+    get_recorder().clear()
+    assert reg.flag("rlhf", "straggler", "generator:2",
+                    tokens_per_s=12.5)
+    assert not reg.flag("rlhf", "straggler", "generator:2")  # limited
+    assert reg.flag("rlhf", "straggler", "generator:3")  # new subject
+    recent = reg.recent()
+    assert len(recent) == 2
+    assert recent[0]["subject"] == "generator:2"
+    assert recent[0]["tokens_per_s"] == 12.5
+    events = get_recorder().snapshot()["events"]
+    assert sum(1 for e in events
+               if e.get("component") == "anomaly") == 2
+    counter = metrics.snapshot_scalars().get("ray_tpu_anomaly_total")
+    assert counter is not None and counter >= 2
+
+
+def test_check_event_stats_spikes_flags_p95_jump():
+    from ray_tpu.observability import event_stats
+
+    event_stats.get_event_stats().reset()
+    # Build a calm trailing window, then spike the handler.
+    for _ in range(config.anomaly_min_samples + 2):
+        for _ in range(30):
+            event_stats.record("testloop", "handler", 0.010)
+        assert tsdb.check_event_stats_spikes() == []
+    for _ in range(200):
+        event_stats.record("testloop", "handler", 0.500)
+    flagged = tsdb.check_event_stats_spikes()
+    assert "testloop.handler" in flagged
+    kinds = {(e["plane"], e["kind"])
+             for e in tsdb.get_anomaly_registry().recent()}
+    assert ("dispatch", "handler_p95_spike") in kinds
+    event_stats.get_event_stats().reset()
+
+
+# ---------------------------------------------------------------------------
+# Crash-dump bundling (flight recorder bugfix)
+# ---------------------------------------------------------------------------
+
+
+def test_flight_dump_bundles_metrics_history_and_profile(tmp_path):
+    from ray_tpu.observability.recorder import FlightRecorder
+
+    ring = tmp_path / "contprof"
+    now = time.time()
+    _write(ring, ts=now - 10, role="worker", pid=111)
+    _write(ring, ts=now - 5, role="worker", pid=222)
+    db = tsdb.get_tsdb()
+    db.record("crash_metric", 3.0, ts=now - 30)
+    db.record("crash_metric", 4.0, ts=now - 1)
+    old = config.contprof_dir
+    config.apply({"contprof_dir": str(ring)})
+    try:
+        rec = FlightRecorder()
+        rec.record("scheduler", "task_failed", task="t1")
+        path = rec.dump(str(tmp_path / "dump.json"), reason="crash",
+                        crash_pid=111)
+        snap = json.load(open(path))
+        # Unknown pid falls back to the newest retained snapshot.
+        path2 = rec.dump(str(tmp_path / "dump2.json"), reason="crash",
+                         crash_pid=999)
+        snap2 = json.load(open(path2))
+    finally:
+        config.apply({"contprof_dir": old})
+    assert snap["events"]
+    hist = {s["name"]: s for s in snap["metrics_history"]}
+    assert [p[1] for p in hist["crash_metric"]["points"]] == [3.0, 4.0]
+    # The crashing pid's own snapshot wins over the newer one.
+    assert snap["profile_snapshot"]["pid"] == 111
+    assert snap2["profile_snapshot"]["pid"] == 222
+
+
+# ---------------------------------------------------------------------------
+# Cluster surfaces: dashboard endpoints, CLI, profile history
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def dashboard(ray_start):
+    from ray_tpu.dashboard import start_dashboard
+
+    server = start_dashboard(port=0)
+    yield server
+    server.stop()
+
+
+def _get(server, path):
+    import urllib.request
+
+    with urllib.request.urlopen(server.address + path,
+                                timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+def test_api_metrics_history_two_sources(dashboard, ray_start):
+    """The history endpoint must return the head's own series AND a
+    remote node's merged series as distinct entries — the two-process
+    shape (driver + daemon) without paying for a real daemon here
+    (the wire path itself is covered in the slow cluster files)."""
+    now = time.time()
+    db = tsdb.get_tsdb()
+    db.record("obs_q_depth", 2.0, ts=now - 20)
+    db.record("obs_q_depth", 3.0, ts=now - 1)
+    db.merge_remote("node-far", {"obs_q_depth": 9.0}, ts=now - 1)
+    out = _get(dashboard, "/api/metrics/history?name=obs_q_depth")
+    assert "obs_q_depth" in out["names"]
+    by_node = {s["node"]: s for s in out["series"]}
+    assert by_node[""]["points"][-1][1] == 3.0
+    assert by_node["node-far"]["points"][-1][1] == 9.0
+    # since= is a lookback: the 20s-old local point filters out.
+    out = _get(dashboard,
+               "/api/metrics/history?name=obs_q_depth&since=10s")
+    assert len(by_node[""]["points"]) == 2
+    assert all(len(s["points"]) == 1 for s in out["series"])
+
+
+def test_api_profile_history_merges_ring(dashboard, ray_start):
+    from ray_tpu.core.runtime import global_runtime_or_none
+
+    rt = global_runtime_or_none()
+    _write(rt.contprof_dir, ts=time.time() - 5, role="driver",
+           pid=os.getpid())
+    out = _get(dashboard, "/api/profile/history?since=10m")
+    assert out["count"] >= 1
+    assert any(k.startswith("driver:") for k in out["merged"])
+    assert out["collapsed"]
+
+
+def test_api_anomalies_endpoint(dashboard, ray_start):
+    tsdb.get_anomaly_registry().flag("serve", "ttft_outlier", "dep:r1",
+                                     ewma_ttft_s=1.25)
+    out = _get(dashboard, "/api/anomalies")
+    assert [e["subject"] for e in out["anomalies"]] == ["dep:r1"]
+
+
+def test_cli_obs_and_status_surfaces(dashboard, ray_start, capsys):
+    from ray_tpu.scripts.cli import main
+
+    now = time.time()
+    tsdb.get_tsdb().record("obs_cli_metric", 7.5, ts=now - 1)
+    assert main(["--address", dashboard.address, "obs", "top"]) == 0
+    assert "obs_cli_metric" in capsys.readouterr().out
+    assert main(["--address", dashboard.address, "obs", "plot",
+                 "--name", "obs_cli_metric"]) == 0
+    assert "obs_cli_metric" in capsys.readouterr().out
+    tsdb.get_anomaly_registry().flag("rlhf", "straggler", "generator:1")
+    assert main(["--address", dashboard.address, "status", "-v"]) == 0
+    captured = capsys.readouterr()
+    assert "generator:1" in captured.out + captured.err
+
+
+def test_cli_profile_since_writes_collapsed(dashboard, ray_start,
+                                            tmp_path, capsys):
+    from ray_tpu.core.runtime import global_runtime_or_none
+    from ray_tpu.scripts.cli import main
+
+    rt = global_runtime_or_none()
+    _write(rt.contprof_dir, ts=time.time() - 30, role="driver",
+           pid=os.getpid())
+    out_file = str(tmp_path / "hist.collapsed")
+    rc = main(["--address", dashboard.address, "profile",
+               "--since", "10m", "--output", out_file])
+    assert rc == 0
+    body = open(out_file).read()
+    assert "driver:" in body and body.strip()
+
+
+def test_profile_history_cluster_local_ring(ray_start, tmp_path):
+    """profile_history_cluster on a daemonless runtime returns the
+    local ring's snapshots (the driver + pool-worker share)."""
+    from ray_tpu.core.runtime import global_runtime_or_none
+
+    rt = global_runtime_or_none()
+    assert rt is not None
+    _write(rt.contprof_dir, ts=time.time() - 3, role="driver",
+           pid=os.getpid())
+    out = continuous.profile_history_cluster(rt, since_s=600.0)
+    assert any(s["role"] == "driver" and s["pid"] == os.getpid()
+               for s in out["snapshots"])
+    assert any(k.startswith("driver:") for k in out["merged"])
+
+
+# ---------------------------------------------------------------------------
+# RLHF straggler detection
+# ---------------------------------------------------------------------------
+
+
+def test_rlhf_straggler_flagged_with_injected_slow_generator(ray_start):
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import TransformerConfig
+    from ray_tpu.rlhf import RLHFConfig, RLHFPipeline
+    from ray_tpu.util import metrics
+
+    cfg = RLHFConfig(
+        model=TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=1, n_heads=4,
+            n_kv_heads=4, d_ff=64, max_seq_len=64, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False),
+        num_generators=4, num_prompts=4, prompt_len=4, group_size=1,
+        max_new_tokens=4, total_steps=50,
+        reward_fn=lambda comps: np.zeros(len(comps), np.float32))
+    pipe = RLHFPipeline(cfg)
+    try:
+        import ray_tpu
+
+        ray_tpu.get(pipe.generators[0].inject_fault.remote(
+            "rollout_delay_s", 0.6))
+        before = tsdb.get_tsdb()  # keep singleton import-warm
+        assert before is not None
+        stats = None
+        for _ in range(3):
+            stats = pipe.train_iteration()
+            if stats["stragglers"]:
+                break
+        assert stats["stragglers"] == [0], stats
+        recent = tsdb.get_anomaly_registry().recent()
+        assert any(e["kind"] == "straggler"
+                   and e["subject"] == "generator:0" for e in recent)
+        total = metrics.snapshot_scalars().get("ray_tpu_anomaly_total")
+        assert total is not None and total >= 1
+    finally:
+        pipe.shutdown()
+
+
+def test_rlhf_straggler_ewma_resets_on_revival(ray_start):
+    """A revived generator must not inherit the dead one's EWMA —
+    fresh hardware gets a fresh baseline."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import TransformerConfig
+    from ray_tpu.rlhf import RLHFConfig, RLHFPipeline
+
+    cfg = RLHFConfig(
+        model=TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=1, n_heads=4,
+            n_kv_heads=4, d_ff=64, max_seq_len=64, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False),
+        num_generators=2, num_prompts=2, prompt_len=4, group_size=1,
+        max_new_tokens=4, total_steps=50,
+        reward_fn=lambda comps: np.zeros(len(comps), np.float32))
+    pipe = RLHFPipeline(cfg)
+    try:
+        pipe.train_iteration()
+        assert all(t is not None for t in pipe._gen_tps)
+        pipe._revive_generator(0)
+        assert pipe._gen_tps[0] is None
+        assert pipe._gen_tps[1] is not None
+    finally:
+        pipe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bench --check-regressions
+# ---------------------------------------------------------------------------
+
+
+def _run_check(rows, tmp_path, threshold=None):
+    hist = tmp_path / "hist.json"
+    hist.write_text(json.dumps(rows))
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+           "--check-regressions", "--history", str(hist)]
+    if threshold is not None:
+        cmd += ["--regression-threshold", str(threshold)]
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=REPO, timeout=120)
+
+
+def _rows(metric, unit, values, **ident):
+    return [{"metric": metric, "value": v, "unit": unit, "ts": float(i),
+             **ident} for i, v in enumerate(values)]
+
+
+def test_check_regressions_fails_on_throughput_drop(tmp_path):
+    r = _run_check(_rows("tok_s", "tok/s", [100, 101, 99, 60],
+                         platform="cpu"), tmp_path)
+    assert r.returncode == 1, r.stderr
+    assert "REGRESSION" in r.stderr
+
+
+def test_check_regressions_passes_within_threshold(tmp_path):
+    r = _run_check(_rows("tok_s", "tok/s", [100, 101, 99, 97],
+                         platform="cpu"), tmp_path)
+    assert r.returncode == 0, r.stderr
+    assert "no regressions" in r.stderr
+
+
+def test_check_regressions_latency_direction_and_identity(tmp_path):
+    # Latency RISE is the regression; and rows with different config
+    # identity must not be compared against each other.
+    rows = (_rows("ttft", "s", [0.10, 0.11, 0.10, 0.30],
+                  platform="cpu")
+            + _rows("tok_s", "tok/s", [100], platform="cpu", batch=8)
+            + _rows("tok_s", "tok/s", [50], platform="cpu", batch=16))
+    r = _run_check(rows, tmp_path)
+    assert r.returncode == 1
+    assert "REGRESSION" in r.stderr and "ttft" in r.stderr
+    assert "tok_s" not in r.stderr.split("REGRESSION", 1)[1].split(
+        "\n")[0]
+
+
+def test_check_regressions_skips_thin_history(tmp_path):
+    r = _run_check(_rows("tok_s", "tok/s", [100, 50], platform="cpu"),
+                   tmp_path)
+    assert r.returncode == 0
+    assert "SKIP" in r.stderr

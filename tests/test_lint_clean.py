@@ -44,9 +44,10 @@ def test_rule_registry_complete():
         "state-roundtrip-asymmetry", "naked-get-in-actor",
         "unserializable-capture", "lock-order-inversion",
         "ref-leak-in-loop", "await-under-lock",
+        "metric-name-registry",
     }
     assert expected <= set(RULES), sorted(RULES)
-    assert len(RULES) >= 8
+    assert len(RULES) >= 9
 
 
 def test_ray_tpu_tree_is_clean():
@@ -93,6 +94,21 @@ def test_ref_leak_rule_fires_on_producer_shape():
     assert "refs" in active[0].message
     suppressed = [f for f in lint_paths([path])
                   if f.rule == "ref-leak-in-loop" and f.suppressed]
+    assert len(suppressed) == 1  # disable comment honored
+
+
+def test_metric_name_registry_rule_fires():
+    """A Counter/Gauge/Histogram whose constant name is missing from
+    docs/METRICS.md must be flagged; the inventoried name, the
+    collections.Counter look-alike, and the suppressed twin must not
+    appear among active findings."""
+    path = os.path.join(FIXTURES, "metric_registry.py")
+    active = [f for f in _active(path)
+              if f.rule == "metric-name-registry"]
+    assert len(active) == 1, [f.render() for f in _active(path)]
+    assert "ray_tpu_never_inventoried_total" in active[0].message
+    suppressed = [f for f in lint_paths([path])
+                  if f.rule == "metric-name-registry" and f.suppressed]
     assert len(suppressed) == 1  # disable comment honored
 
 

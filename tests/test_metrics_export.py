@@ -47,13 +47,15 @@ class TestExportBundle:
         side): node gauges from the dashboard sampler, task-lifecycle
         series from observability.taskstats, serve series from the
         serve data plane (proxy ingress + replica + handle admission),
-        loop-handler gauges from observability.event_stats."""
+        loop-handler gauges from observability.event_stats, anomaly
+        counter from observability.tsdb, TTFT gauge from the serve
+        controller's stats harvest."""
         import inspect
 
         from ray_tpu.dashboard import server as srv
         from ray_tpu.dashboard.metrics_export import DEFAULT_PANELS
-        from ray_tpu.observability import event_stats, taskstats
-        from ray_tpu.serve import handle, proxy, replica
+        from ray_tpu.observability import event_stats, taskstats, tsdb
+        from ray_tpu.serve import controller, handle, proxy, replica
 
         publish_src = "\n".join([
             inspect.getsource(srv.MetricsHistory._publish_prom),
@@ -62,6 +64,8 @@ class TestExportBundle:
             inspect.getsource(replica),
             inspect.getsource(handle),
             inspect.getsource(event_stats),
+            inspect.getsource(tsdb),
+            inspect.getsource(controller),
         ])
         for _title, expr, _unit in DEFAULT_PANELS:
             m = re.search(r"(ray_tpu_[a-z_]+?)(_bucket)?(?:[^a-z_]|$)",
