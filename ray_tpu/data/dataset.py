@@ -229,7 +229,9 @@ class Dataset:
     def materialize(self) -> "Dataset":
         from .. import get as ray_get, put as ray_put
 
-        blocks = [ray_put(ray_get(r)) for r in self._refs()]
+        # one batched get: a per-ref get would block on each block in
+        # submission order while later ones sit ready
+        blocks = [ray_put(b) for b in ray_get(list(self._refs()))]
         out = Dataset(FromBlocks(blocks, "materialized"))
         out._materialized = blocks
         return out
@@ -249,10 +251,8 @@ class Dataset:
     def count(self) -> int:
         from .. import get as ray_get
 
-        total = 0
-        for ref in self._refs():
-            total += BlockAccessor.for_block(ray_get(ref)).num_rows()
-        return total
+        return sum(BlockAccessor.for_block(b).num_rows()
+                   for b in ray_get(list(self._refs())))
 
     def schema(self):
         from .. import get as ray_get
@@ -266,7 +266,7 @@ class Dataset:
     def to_pandas(self):
         from .. import get as ray_get
 
-        blocks = [ray_get(r) for r in self._refs()]
+        blocks = ray_get(list(self._refs()))
         return concat_blocks(blocks).to_pandas()
 
     def split(self, n: int) -> List["Dataset"]:

@@ -917,6 +917,64 @@ def _check_metric_name_registry(ctx: FileContext) -> List[Finding]:
     return out
 
 
+_LINTS_DOC_CACHE: Dict[str, Optional[set]] = {}
+
+
+def _lints_inventory(start: str) -> Optional[set]:
+    """Documented rule ids: every backticked kebab-case identifier in
+    the nearest ``docs/LINTS.md`` walking up from the linted file.
+    None when no inventory exists (the meta-rule stays silent — an
+    installed copy of the package without docs/ must not fail)."""
+    d = os.path.dirname(os.path.abspath(start))
+    while True:
+        cand = os.path.join(d, "docs", "LINTS.md")
+        if cand in _LINTS_DOC_CACHE:
+            got = _LINTS_DOC_CACHE[cand]
+            if got is not None:
+                return got
+        elif os.path.isfile(cand):
+            try:
+                with open(cand) as f:
+                    ids = set(re.findall(r"`([a-z][a-z0-9\-]+)`",
+                                         f.read()))
+            except OSError:
+                ids = set()
+            _LINTS_DOC_CACHE[cand] = ids
+            return ids
+        else:
+            _LINTS_DOC_CACHE[cand] = None
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+@rule("rule-doc-registry",
+      "a registered lint rule id missing from the docs/LINTS.md "
+      "inventory")
+def _check_rule_doc_registry(ctx: FileContext) -> List[Finding]:
+    """An undocumented rule is a finding nobody can act on: the doc
+    carries the rationale and the suppression recipe. Anchored to the
+    registry module so it fires exactly once per tree lint."""
+    if not ctx.path.replace(os.sep, "/").endswith(
+            "devtools/raylint.py"):
+        return []
+    inventory = _lints_inventory(ctx.path)
+    if inventory is None:
+        return []
+    from .xp import XP_RULES
+    registered = set(RULES) | set(XP_RULES) | {
+        "unjustified-suppression", "parse-error"}
+    missing = sorted(registered - inventory)
+    if not missing:
+        return []
+    return [ctx.finding(
+        1, "rule-doc-registry",
+        f"rule id(s) missing from docs/LINTS.md: "
+        f"{', '.join(missing)} — every registered rule needs a row "
+        f"(id, severity, rationale, example, suppression recipe)")]
+
+
 # ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
@@ -974,6 +1032,62 @@ def lint_paths(paths: Iterable[str],
     return findings
 
 
+def changed_files(paths: Iterable[str], base: str) -> Optional[set]:
+    """Absolute paths of files changed vs `base` (plus untracked
+    files) in the git repo containing the first lint path; None when
+    git is unavailable or the tree is not a repo."""
+    import subprocess
+    anchor = os.path.abspath(next(iter(paths), "."))
+    if not os.path.isdir(anchor):
+        anchor = os.path.dirname(anchor)
+    try:
+        top = subprocess.run(
+            ["git", "-C", anchor, "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30)
+        if top.returncode != 0:
+            return None
+        root = top.stdout.strip()
+        out: set = set()
+        for cmd in (["diff", "--name-only", base],
+                    ["ls-files", "--others", "--exclude-standard"]):
+            got = subprocess.run(
+                ["git", "-C", root] + cmd,
+                capture_output=True, text=True, timeout=30)
+            if got.returncode != 0:
+                return None
+            out |= {os.path.abspath(os.path.join(root, line.strip()))
+                    for line in got.stdout.splitlines() if line.strip()}
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _render_stats(xp_stats: Optional[dict],
+                  findings: List[Finding]) -> str:
+    """The one-line CI summary behind --stats."""
+    per_file = [0, 0]
+    for f in findings:
+        if f.rule in RULES or f.rule in ("unjustified-suppression",
+                                         "parse-error"):
+            per_file[1 if f.suppressed else 0] += 1
+    parts = [f"per-file {per_file[0]} finding(s) "
+             f"({per_file[1]} suppressed)"]
+    if xp_stats is not None:
+        from .xp import ANALYSIS_RULES
+        parts.insert(0, f"{xp_stats.get('files', 0)} files indexed, "
+                        f"{xp_stats.get('call_edges', 0)} call edges")
+        owner = {r: a for a, rs in ANALYSIS_RULES.items() for r in rs}
+        per: Dict[str, List[int]] = {}
+        for f in findings:
+            a = owner.get(f.rule)
+            if a is not None:
+                per.setdefault(a, [0, 0])[1 if f.suppressed else 0] += 1
+        for a in sorted(xp_stats.get("analyses", {})):
+            act, sup = per.get(a, [0, 0])
+            parts.append(f"{a} {act} finding(s) ({sup} suppressed)")
+    return "raylint --stats: " + "; ".join(parts)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="raylint",
@@ -1008,6 +1122,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="include suppressed findings in the report")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD",
+                    default=None, metavar="BASE",
+                    help="restrict findings to files changed vs BASE "
+                         "(git diff --name-only; default HEAD) — the "
+                         "whole program is still indexed, so "
+                         "cross-file findings in changed files "
+                         "remain visible")
+    ap.add_argument("--stats", action="store_true",
+                    help="print an index/analysis summary line to "
+                         "stderr (files indexed, call edges, "
+                         "per-analysis finding/suppression counts)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -1034,23 +1159,49 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
 
+    changed = None
+    if args.changed_only is not None:
+        changed = changed_files(paths, args.changed_only)
+        if changed is None:
+            print("raylint: --changed-only: git diff unavailable; "
+                  "linting everything", file=sys.stderr)
+        elif run_xp_passes and not args.select:
+            print("raylint: --changed-only: graph analyses "
+                  "(lockgraph/protocol) deferred to the full run; "
+                  "pass --select to force them", file=sys.stderr)
+
     per_file_select = ([s for s in select if s in RULES]
                        if select else None)
     if select and not per_file_select:
         findings = []
     else:
-        findings = lint_paths(paths, per_file_select)
+        lint_inputs = paths
+        if changed is not None:
+            lint_inputs = [p for p in iter_python_files(paths)
+                           if os.path.abspath(p) in changed]
+        findings = lint_paths(lint_inputs, per_file_select)
     inventory = None
+    xp_stats = {} if args.stats else None
     if run_xp_passes:
         from .xp import (XP_RULES, apply_baseline,
                          default_baseline_path, run_xp)
-        xp_findings, inventory = run_xp(paths, select)
+        xp_findings, inventory = run_xp(paths, select, stats=xp_stats,
+                                        only=changed)
         findings.extend(xp_findings)
         baseline = args.baseline
         if baseline is None and not args.no_baseline:
             baseline = default_baseline_path()
         if baseline:
             findings.extend(apply_baseline(findings, baseline))
+    if changed is not None:
+        # whole-program passes still indexed everything; the REPORT is
+        # what narrows to the diff (stale-baseline rows included —
+        # they belong to full runs, not the pre-commit path)
+        findings = [f for f in findings
+                    if os.path.abspath(f.path) in changed]
+    if args.stats:
+        print(_render_stats(xp_stats if run_xp_passes else None,
+                            findings), file=sys.stderr)
 
     if args.proto_inventory:
         from .xp.report import inventory_table

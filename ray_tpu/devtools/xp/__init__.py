@@ -20,6 +20,19 @@ per-function lock summaries) and runs the analyses that need it:
 - ``proto-missing-field`` — the handler path for type ``X`` reads
   ``msg["k"]`` (a KeyError on absence) but no sender of ``X`` ever
   provides ``k``.
+- the **remote-call contract checker** (``contracts``): every
+  ``fn.remote(...)`` / ``Cls.remote(...)`` /
+  ``handle.method.remote(...)`` site resolved against the decorated
+  def — arity/kwargs/missing-args, ``.options(...)`` keys against the
+  runtime's real option tables, and ``num_returns`` against the
+  tuple-unpack arity at the site.
+- the **ObjectRef lifetime analysis** (``reflife``): refs born from
+  ``put()``/``.remote()`` and never consumed (fire-and-forget leaks),
+  and the ``get()``-per-ref-inside-a-loop serialization anti-pattern.
+- the **jit-purity / host-sync detector** (``jitlint``): the call
+  graph walked from every ``jax.jit``/``pjit`` entry point; device->
+  host syncs, Python-side mutation under trace, and broken
+  ``static_argnums`` pins flag with the traced call chain attached.
 
 Whole-program findings cannot be suppressed with inline comments (no
 single line owns them); the checked-in baseline
@@ -51,15 +64,54 @@ XP_RULES: Dict[str, str] = {
     "proto-missing-field":
         "handler for X hard-reads msg[\"k\"] that no sender of X "
         "provides",
+    "xp-remote-signature":
+        "a .remote(...) call that does not fit the decorated "
+        "signature (arity, unknown kwarg, missing required arg, or "
+        "a method the actor class never defines)",
+    "xp-remote-options":
+        ".options(...)/@remote(...) keys outside the runtime's real "
+        "option tables, or task-only options on actors (and vice "
+        "versa)",
+    "xp-remote-num-returns":
+        "tuple-unpack arity at a .remote() call site disagrees with "
+        "the declared num_returns",
+    "xp-ref-leak":
+        "an ObjectRef from put()/.remote() that is never consumed "
+        "(discarded expression or a binding with no later use)",
+    "xp-ref-get-in-loop":
+        "get(one_ref) inside a loop over a list of refs — serializes "
+        "the fan-out behind one round-trip per element",
+    "xp-jit-host-sync":
+        "a device->host sync (.item()/np.asarray/print/float-cast) "
+        "reachable from a jax.jit entry point",
+    "xp-jit-impure-mutation":
+        "self.<attr> or global/nonlocal mutation inside jit-traced "
+        "code (runs at trace time only)",
+    "xp-jit-static-args":
+        "static_argnums/static_argnames out of range, naming a "
+        "missing parameter, or receiving an unhashable literal",
     "stale-baseline":
         "a baseline entry that no longer matches any finding",
     "xp-parse-error":
         "a file the whole-program index could not parse",
 }
 
+# analysis name -> the rule ids it owns (drives --select routing and
+# the --stats per-analysis summary)
+ANALYSIS_RULES: Dict[str, frozenset] = {
+    "lockgraph": frozenset({"xp-lock-order-inversion"}),
+    "protocol": frozenset({"proto-orphan-sent", "proto-orphan-handled",
+                           "proto-missing-field"}),
+    "contracts": frozenset({"xp-remote-signature", "xp-remote-options",
+                            "xp-remote-num-returns"}),
+    "reflife": frozenset({"xp-ref-leak", "xp-ref-get-in-loop"}),
+    "jitlint": frozenset({"xp-jit-host-sync", "xp-jit-impure-mutation",
+                          "xp-jit-static-args"}),
+}
+
 __all__ = [
-    "XP_RULES", "ProjectIndex", "run_xp", "apply_baseline",
-    "default_baseline_path", "to_json", "to_sarif",
+    "XP_RULES", "ANALYSIS_RULES", "ProjectIndex", "run_xp",
+    "apply_baseline", "default_baseline_path", "to_json", "to_sarif",
 ]
 
 
@@ -79,25 +131,66 @@ def _roots(paths: Iterable[str]) -> List[str]:
 
 
 def run_xp(paths: Iterable[str], select: Optional[Iterable[str]] = None,
-           ) -> Tuple[list, List[dict]]:
+           stats: Optional[dict] = None,
+           only: Optional[set] = None) -> Tuple[list, List[dict]]:
     """Run every whole-program pass over the package(s) rooted at
-    `paths`. Returns (findings, wire-protocol inventory rows)."""
+    `paths`. Returns (findings, wire-protocol inventory rows). When
+    `stats` is a dict it is filled in place with index size, call-graph
+    edge count, and per-analysis finding counts. `only` (a set of
+    absolute file paths — the --changed-only diff) keeps indexing and
+    provenance whole-program but restricts the per-site scans of the
+    site-anchored analyses (contracts/reflife/jitlint) to functions in
+    those files; the graph analyses (lockgraph/protocol) still run in
+    full, since their table builds are their scans."""
     from ..raylint import Finding  # late import; raylint imports us too
+    from . import contracts, jitlint, reflife
+    from .dataflow import CallGraph, RemoteResolver
 
     wanted = set(select) if select else set(XP_RULES)
     findings: List[Finding] = []
     inventory: List[dict] = []
+
+    def record(analysis: str, got: List[Finding]) -> None:
+        kept = [f for f in got if f.rule in wanted]
+        findings.extend(kept)
+        if stats is not None:
+            per = stats.setdefault("analyses", {})
+            per[analysis] = per.get(analysis, 0) + len(kept)
+
     for root in _roots(paths):
         idx = ProjectIndex.build(root)
+        graph = CallGraph(idx)
+        if stats is not None:
+            stats["files"] = stats.get("files", 0) + len(idx.modules)
+            stats["call_edges"] = (stats.get("call_edges", 0)
+                                   + graph.edge_count)
         for path, line, msg in idx.errors:
             findings.append(Finding(path, line, "xp-parse-error", msg))
-        if "xp-lock-order-inversion" in wanted:
-            findings.extend(lockgraph.check(idx))
-        proto_rules = {"proto-orphan-sent", "proto-orphan-handled",
-                       "proto-missing-field"}
-        if proto_rules & wanted:
+        # The graph analyses' whole-tree scans ARE their table builds,
+        # so scoping buys them nothing — in the incremental pre-commit
+        # path they are skipped (the tier-1 gate runs them in full).
+        # An explicit --select overrides the skip.
+        run_graph = only is None or select is not None
+        if ANALYSIS_RULES["lockgraph"] & wanted and run_graph:
+            record("lockgraph", lockgraph.check(idx))
+        if ANALYSIS_RULES["protocol"] & wanted and run_graph:
             pfind, inv = protocol.check(idx)
-            findings.extend(f for f in pfind if f.rule in wanted)
+            record("protocol", pfind)
             inventory.extend(inv)
+        resolver = None
+        if (ANALYSIS_RULES["contracts"] | ANALYSIS_RULES["reflife"]) \
+                & wanted:
+            # one resolver (and one provenance fixed point) shared by
+            # both handle-flow analyses — building it dominates their
+            # cost
+            resolver = RemoteResolver(idx)
+        if ANALYSIS_RULES["contracts"] & wanted:
+            record("contracts",
+                   contracts.check(idx, resolver=resolver, only=only))
+        if ANALYSIS_RULES["reflife"] & wanted:
+            record("reflife",
+                   reflife.check(idx, resolver=resolver, only=only))
+        if ANALYSIS_RULES["jitlint"] & wanted:
+            record("jitlint", jitlint.check(idx, graph=graph, only=only))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, inventory

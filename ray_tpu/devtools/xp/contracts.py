@@ -1,0 +1,214 @@
+"""Remote-call contract checking.
+
+A ``.remote(...)`` call crosses a process boundary, so Python's own
+TypeError for a bad call fires *inside the worker*, seconds later and
+in another traceback — or never, when the submission path validates
+lazily. This pass resolves every ``fn.remote(...)`` /
+``Cls.remote(...)`` / ``handle.method.remote(...)`` site through the
+project index (plus local/attribute actor-handle provenance from the
+dataflow engine) and checks three contracts at the call site:
+
+- **signature** (``xp-remote-signature``): arity, unknown keyword
+  arguments, missing required arguments, duplicate coverage — against
+  the *decorated* def (``self`` stripped for actor methods and
+  ``__init__``). A call through an actor handle to a method the class
+  does not define is reported too (the signature-drift class: a method
+  renamed while a caller kept the old name).
+- **options** (``xp-remote-options``): every ``.options(...)`` key
+  and ``@remote(...)`` decorator key validated against the runtime's
+  *real* option tables (``core.task._VALID`` / ``_TASK_ONLY`` /
+  ``_ACTOR_ONLY`` — imported, not copied, so the rule can never drift
+  from the implementation). Task-only options on actors (and vice
+  versa) are the same errors ``validate_options`` would raise at
+  runtime. Actor-method ``.options`` accept only what
+  ``submit_actor_task`` reads: ``num_returns`` / ``concurrency_group``
+  / ``name``.
+- **num_returns vs unpack** (``xp-remote-num-returns``): a tuple
+  unpack of a ``.remote()`` result must match the declared
+  ``num_returns`` (call-site ``.options`` beats ``@method``/decorator
+  defaults, default 1). ``a, b = f.remote()`` with one return raises
+  TypeError only when the ref is iterated — at use time, far from the
+  bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .dataflow import (ClassInfo, FuncInfo, RemoteResolver,
+                       RemoteSite, Signature, _stmt_bodies,
+                       remote_sites)
+from .index import ProjectIndex
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SKIP_NODES = _FUNC_NODES + (ast.ClassDef, ast.Lambda)
+
+# Keys submit_actor_task actually reads from ActorMethod options.
+_ACTOR_METHOD_OPTS = {"num_returns", "concurrency_group", "name"}
+
+
+def _option_tables():
+    from ...core.task import _ACTOR_ONLY, _TASK_ONLY, _VALID
+    return _VALID, _TASK_ONLY, _ACTOR_ONLY
+
+
+def _target_signature(site: RemoteSite,
+                      idx: ProjectIndex) -> Optional[Signature]:
+    if site.kind == "task" and isinstance(site.target, FuncInfo):
+        return Signature.of(site.target, strip_self=False)
+    if site.kind == "actor_create" and isinstance(site.target,
+                                                  ClassInfo):
+        init = idx.find_method(site.target.qual, "__init__")
+        if init is None:
+            # default __init__: zero args beyond self
+            if site.call.args or any(k.arg for k in
+                                     site.call.keywords):
+                return Signature("__init__", [], 0, [], [], False,
+                                 False, 0)
+            return None
+        return Signature.of(init, strip_self=True)
+    if site.kind == "actor_method" and site.method is not None:
+        return Signature.of(site.method, strip_self=True)
+    return None
+
+
+def _has_getattr(idx: ProjectIndex, cls: ClassInfo) -> bool:
+    return idx.find_method(cls.qual, "__getattr__") is not None
+
+
+def _num_returns_of(site: RemoteSite) -> Optional[object]:
+    nr = site.options.get("num_returns")
+    if nr is None:
+        return 1
+    if isinstance(nr, ast.Constant):
+        return nr.value
+    return None      # dynamic expression: unknown
+
+
+def check(idx: ProjectIndex, resolver: Optional[RemoteResolver] = None,
+          only: Optional[set] = None) -> List:
+    from ..raylint import Finding
+
+    valid, task_only, actor_only = _option_tables()
+    resolver = resolver or RemoteResolver(idx)
+    findings: List[Finding] = []
+    sites = remote_sites(idx, resolver, only=only)
+
+    for site in sites:
+        path = site.scope.path
+
+        # -- signature ------------------------------------------------
+        if (site.kind == "actor_method" and site.method is None
+                and isinstance(site.target, ClassInfo)
+                and not _has_getattr(idx, site.target)):
+            findings.append(Finding(
+                path, site.line, "xp-remote-signature",
+                f"{site.target.name}.{site.method_name}.remote(): "
+                f"class {site.target.name} defines no method "
+                f"{site.method_name!r} — the call fails inside the "
+                f"worker with AttributeError (a renamed method left "
+                f"this caller behind?)"))
+        sig = _target_signature(site, idx)
+        if sig is not None:
+            for problem in sig.check_call(site.call):
+                findings.append(Finding(
+                    path, site.line, "xp-remote-signature",
+                    f"{site.describe()}(): {problem} — the TypeError "
+                    f"surfaces in the worker, not at this call site"))
+
+        # -- options --------------------------------------------------
+        is_actor = site.kind == "actor_create"
+        opt_line = (site.option_calls[0].lineno
+                    if site.option_calls else site.line)
+        for key in sorted(site.options):
+            if site.kind == "actor_method":
+                if key not in _ACTOR_METHOD_OPTS:
+                    findings.append(Finding(
+                        path, opt_line, "xp-remote-options",
+                        f"{site.describe()}: actor-method "
+                        f".options() key {key!r} is ignored by "
+                        f"submit_actor_task — supported: "
+                        f"{sorted(_ACTOR_METHOD_OPTS)}"))
+                continue
+            if key not in valid:
+                findings.append(Finding(
+                    path, opt_line, "xp-remote-options",
+                    f"{site.describe()}: unknown option {key!r} — "
+                    f"validate_options raises ValueError at "
+                    f"submission (valid: see core.task._VALID)"))
+            elif is_actor and key in task_only:
+                findings.append(Finding(
+                    path, opt_line, "xp-remote-options",
+                    f"{site.describe()}: option {key!r} is task-only "
+                    f"but this is an actor creation — "
+                    f"validate_options raises ValueError"))
+            elif not is_actor and key in actor_only:
+                findings.append(Finding(
+                    path, opt_line, "xp-remote-options",
+                    f"{site.describe()}: option {key!r} is actor-only "
+                    f"but this is a task submission — "
+                    f"validate_options raises ValueError"))
+
+    # -- num_returns vs tuple unpack ---------------------------------
+    for fi in idx.all_functions():
+        if only is not None and fi.path not in only:
+            continue
+        findings.extend(_check_unpacks(fi, resolver, idx))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings
+
+
+def _check_unpacks(fi: FuncInfo, resolver: RemoteResolver,
+                   idx: ProjectIndex) -> List:
+    from ..raylint import Finding
+
+    out: List[Finding] = []
+    env = resolver.seed_env(fi)
+
+    def handle_assign(stmt: ast.Assign) -> None:
+        v = stmt.value
+        if not (isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "remote"):
+            return
+        site = resolver.site(v, fi, env)
+        if site is None or site.kind == "actor_create":
+            return
+        nr = _num_returns_of(site)
+        for tgt in stmt.targets:
+            if not isinstance(tgt, (ast.Tuple, ast.List)):
+                continue
+            if any(isinstance(e, ast.Starred) for e in tgt.elts):
+                continue
+            n_want = len(tgt.elts)
+            if nr in ("streaming", "dynamic") or nr is None:
+                continue
+            if nr == 1:
+                out.append(Finding(
+                    fi.path, stmt.lineno, "xp-remote-num-returns",
+                    f"{site.describe()}() returns ONE ObjectRef "
+                    f"(num_returns=1) but the result is unpacked "
+                    f"into {n_want} names — declare "
+                    f".options(num_returns={n_want}) or take the "
+                    f"single ref"))
+            elif isinstance(nr, int) and nr != n_want:
+                out.append(Finding(
+                    fi.path, stmt.lineno, "xp-remote-num-returns",
+                    f"{site.describe()}() declares num_returns={nr} "
+                    f"but the result is unpacked into {n_want} "
+                    f"names — the unpack raises at use time"))
+
+    def walk(stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, _SKIP_NODES):
+                continue
+            if isinstance(stmt, ast.Assign):
+                handle_assign(stmt)
+            resolver.bind_stmt(env, stmt, fi)
+            for body in _stmt_bodies(stmt):
+                walk(body)
+
+    walk(list(getattr(fi.node, "body", [])))
+    return out

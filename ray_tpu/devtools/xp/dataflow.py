@@ -1,0 +1,1042 @@
+"""Interprocedural dataflow substrate for the whole-program analyses.
+
+The lock/protocol passes each grew their own ad-hoc walkers; the three
+API-surface analyses (remote-call contracts, ObjectRef lifetime,
+jit-purity) all need the same three capabilities, so they live here
+once:
+
+- **value resolution** (`resolve_value`): what project symbol a
+  Name/Attribute chain denotes — a function, a class, a module — using
+  the index's import/attr-type tables. This is `ProjectIndex
+  .resolve_call`'s logic exposed for *non-call* expressions, so
+  ``fn.remote(...)`` can resolve ``fn`` to the decorated def.
+- **value provenance for call results** (`RemoteResolver` +
+  `LocalEnv`): what a local name *holds* — an actor handle of a known
+  class (bound from ``Cls.remote()``, from a call to a function whose
+  returns are handle creations, from subscripting a known handle
+  list, or from iterating one), a remote-wrapped alias
+  (``Worker = remote(num_cpus=2)(_MapWorker)``), or a list of
+  handles. Class attributes get the same treatment
+  (``self.w = Worker.remote()`` in any method types ``self.w``
+  everywhere in the class), and function return types are solved to a
+  fixed point so ``controller = _get_or_create_controller()`` types
+  ``controller`` through the helper.
+- **a resolved call graph** (`CallGraph`): qual -> callee quals using
+  `resolve_call` over every call site, with reachability — the
+  jit-purity pass walks it from every jit entry point, and `--stats`
+  reports its edge count.
+
+Remote-call *sites* — every ``X.remote(...)`` with the resolved
+target, the accumulated ``.options(...)`` keys, decorator options, and
+the assignment shape at the site — are extracted here too
+(`remote_sites`), because both the contract checker and the lifetime
+pass consume them. Resolution is conservative by construction: an
+unresolved receiver contributes *nothing* (no finding), matching the
+index's err-toward-missing-an-edge philosophy.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple, Union
+
+from .index import ClassInfo, FuncInfo, ModuleInfo, ProjectIndex
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SKIP_NODES = _FUNC_NODES + (ast.ClassDef, ast.Lambda)
+_COMP_NODES = (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+
+Resolved = Union[FuncInfo, ClassInfo, ModuleInfo]
+
+_RAY_MODULES = {"ray", "ray_tpu", "rt"}
+
+
+def resolve_value(expr: ast.AST, scope: FuncInfo,
+                  idx: ProjectIndex) -> Optional[Resolved]:
+    """What project symbol `expr` (a Name / dotted Attribute) denotes
+    in `scope` — function, class, or module — or None."""
+    mi = scope.module
+    if isinstance(expr, ast.Name):
+        r = _resolve_name(expr.id, scope, idx)
+        if r is not None:
+            return r
+        mod = idx.modules.get(mi.imports.get(expr.id, ""))
+        return mod
+    if isinstance(expr, ast.Attribute):
+        base = resolve_value(expr.value, scope, idx)
+        if isinstance(base, ModuleInfo):
+            return (base.functions.get(expr.attr)
+                    or base.classes.get(expr.attr)
+                    or idx.modules.get(f"{base.modname}.{expr.attr}"))
+        if isinstance(base, ClassInfo):
+            return idx.find_method(base.qual, expr.attr)
+    return None
+
+
+def _resolve_name(name: str, scope: FuncInfo,
+                  idx: ProjectIndex) -> Optional[Resolved]:
+    fn: Optional[FuncInfo] = scope
+    while fn is not None:
+        if name in fn.nested:
+            return fn.nested[name]
+        if name in fn.nested_classes:
+            return fn.nested_classes[name]
+        fn = fn.parent
+    mi = scope.module
+    if name in mi.functions:
+        return mi.functions[name]
+    if name in mi.classes:
+        return mi.classes[name]
+    target = mi.imports.get(name)
+    if target is not None:
+        return (idx.functions.get(target) or idx.classes.get(target)
+                or idx.modules.get(target))
+    return None
+
+
+# ---------------------------------------------------------------------
+# Remote decorations
+# ---------------------------------------------------------------------
+
+_REMOTE_NAMES = {"remote"}
+
+
+def remote_decoration(node: ast.AST) -> Optional[Dict[str, ast.expr]]:
+    """None when `node` is not @remote-decorated; otherwise the
+    decorator's keyword options (``@remote(num_returns=2)`` ->
+    {"num_returns": <Constant 2>}, bare ``@remote`` -> {})."""
+    for dec in getattr(node, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = (target.id if isinstance(target, ast.Name)
+                else target.attr if isinstance(target, ast.Attribute)
+                else None)
+        if name in _REMOTE_NAMES:
+            if isinstance(dec, ast.Call):
+                return {kw.arg: kw.value for kw in dec.keywords
+                        if kw.arg is not None}
+            return {}
+    return None
+
+
+def method_decoration(node: ast.AST) -> Dict[str, ast.expr]:
+    """@method(num_returns=...) per-method defaults on an actor
+    method (empty when undecorated)."""
+    for dec in getattr(node, "decorator_list", []):
+        if not isinstance(dec, ast.Call):
+            continue
+        target = dec.func
+        name = (target.id if isinstance(target, ast.Name)
+                else target.attr if isinstance(target, ast.Attribute)
+                else None)
+        if name == "method":
+            return {kw.arg: kw.value for kw in dec.keywords
+                    if kw.arg is not None}
+    return {}
+
+
+def _is_remote_callable(expr: ast.AST) -> bool:
+    """``remote`` / ``ray.remote`` / ``ray_tpu.remote`` as a value —
+    the decorator used in call form."""
+    if isinstance(expr, ast.Name):
+        return expr.id in _REMOTE_NAMES
+    return (isinstance(expr, ast.Attribute)
+            and expr.attr in _REMOTE_NAMES
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in _RAY_MODULES)
+
+
+# ---------------------------------------------------------------------
+# Remote-call sites
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class RemoteSite:
+    """One ``X.remote(...)`` call, resolved."""
+    call: ast.Call
+    scope: FuncInfo
+    kind: str                     # "task" | "actor_create" | "actor_method"
+    target: Optional[Resolved]    # FuncInfo / ClassInfo when resolved
+    # method FuncInfo for actor_method (target is then the ClassInfo)
+    method: Optional[FuncInfo] = None
+    method_name: Optional[str] = None
+    # merged keyword options: decorator opts overlaid with every
+    # .options(...) hop at the site (literal keywords only)
+    options: Dict[str, ast.expr] = field(default_factory=dict)
+    # .options(...) call nodes at the site, for line numbers
+    option_calls: List[ast.Call] = field(default_factory=list)
+    options_dynamic: bool = False   # .options(**kw) seen: keys unknown
+
+    @property
+    def line(self) -> int:
+        return self.call.lineno
+
+    def describe(self) -> str:
+        if self.kind == "actor_method" and self.method_name:
+            base = self.target.name if isinstance(
+                self.target, ClassInfo) else "<actor>"
+            return f"{base}.{self.method_name}.remote"
+        if self.target is not None:
+            return f"{self.target.name}.remote"
+        return "<unresolved>.remote"
+
+
+def _unwrap_options(expr: ast.AST) -> Tuple[ast.AST, List[ast.Call], bool]:
+    """Peel ``.options(...)`` hops off a receiver chain; returns the
+    base expression, the option calls outermost-first, and whether any
+    hop used **kwargs (keys then unknowable)."""
+    calls: List[ast.Call] = []
+    dynamic = False
+    while (isinstance(expr, ast.Call)
+           and isinstance(expr.func, ast.Attribute)
+           and expr.func.attr == "options"):
+        calls.append(expr)
+        if any(kw.arg is None for kw in expr.keywords) or expr.args:
+            dynamic = True
+        expr = expr.func.value
+    return expr, calls, dynamic
+
+
+@dataclass
+class LocalEnv:
+    """Per-function provenance: what each local name holds."""
+    # name -> actor class the name is a handle on
+    handles: Dict[str, ClassInfo] = field(default_factory=dict)
+    # name -> actor class for lists/dicts whose VALUES are handles
+    handle_lists: Dict[str, ClassInfo] = field(default_factory=dict)
+    # name -> (remote-wrapped fn/cls, wrap options):
+    # ``W = remote(num_cpus=2)(Worker)``
+    aliases: Dict[str, Tuple[Resolved, Dict[str, ast.expr]]] = \
+        field(default_factory=dict)
+    # names rebound to something we can't type: they shadow any
+    # interprocedural (parameter) typing until rebound to a handle
+    killed: Set[str] = field(default_factory=set)
+
+    def clear_name(self, name: str) -> None:
+        self.handles.pop(name, None)
+        self.handle_lists.pop(name, None)
+        self.aliases.pop(name, None)
+        self.killed.add(name)
+
+    def mark(self, name: str) -> None:
+        self.killed.discard(name)
+
+
+class _Provenance:
+    """Handle provenance that crosses function boundaries:
+
+    - ``self.w = Worker.remote(...)`` in any method of class C types
+      ``self.w`` as a Worker handle everywhere in C (attr lists of
+      handles likewise);
+    - a function whose return statements produce actor handles types
+      every ``x = that_fn()`` call result (solved to a fixed point so
+      one helper can defer to another).
+    """
+
+    def __init__(self, resolver: "RemoteResolver"):
+        self.resolver = resolver
+        self.idx = resolver.idx
+        # class qual -> attr -> actor ClassInfo
+        self.cls_attrs: Dict[str, Dict[str, ClassInfo]] = {}
+        # class qual -> attr -> element class for handle containers
+        self.cls_attr_lists: Dict[str, Dict[str, ClassInfo]] = {}
+        # class qual -> attr -> remote-wrap alias stored on self
+        # (``self._gen_cls = remote(**opts)(RolloutWorker)``)
+        self.cls_attr_aliases: Dict[
+            str, Dict[str, Tuple[Resolved, Dict[str, ast.expr]]]] = {}
+        # function qual -> actor class its returns create
+        self.fn_returns: Dict[str, ClassInfo] = {}
+        # function qual -> param name -> actor class flowing in from
+        # every resolved call site (None = conflicting sites: unknown)
+        self.fn_params: Dict[str, Dict[str, Optional[ClassInfo]]] = {}
+        # id(call) -> resolved callee (or None); resolution is
+        # env-independent, and the fixed point re-resolves every call
+        # each pass
+        self._callee_memo: Dict[int, Optional[object]] = {}
+        # the build's own scans resolve through the resolver, which
+        # reads resolver.handles — install self before building
+        resolver.handles = self
+        self._build()
+
+    def _fingerprint(self):
+        return (
+            {q: {a: c.qual for a, c in t.items()}
+             for q, t in self.cls_attrs.items()},
+            {q: {a: c.qual for a, c in t.items()}
+             for q, t in self.cls_attr_lists.items()},
+            {q: sorted(t) for q, t in self.cls_attr_aliases.items()},
+            {q: c.qual for q, c in self.fn_returns.items()},
+            {q: {a: (c.qual if c else None) for a, c in t.items()}
+             for q, t in self.fn_params.items()},
+        )
+
+    def _build(self) -> None:
+        # Fixed point: each pass sees handles discovered by the last
+        # (attr alias -> helper return -> attr list -> param typing is
+        # a real 4-deep chain in the rlhf pipeline), capped to bound
+        # the cost on adversarial inputs.
+        for _ in range(5):
+            before = self._fingerprint()
+            for fi in self.idx.all_functions():
+                self._scan(fi)
+            if self._fingerprint() == before:
+                break
+
+    def _scan(self, fi: FuncInfo) -> None:
+        res = self.resolver
+        # closure seeding: handles bound in enclosing functions are
+        # visible (and meaningful) inside nested defs
+        env = res.seed_env(fi)
+
+        def self_attr(tgt: ast.AST) -> Optional[str]:
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self" and fi.cls is not None):
+                return tgt.attr
+            return None
+
+        def stmt_pass(plan: List) -> None:
+            for stmt, gens, calls in plan:
+                res.bind_gens(env, gens, fi)
+                for call in calls:
+                    self._learn_params(call, fi, env)
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    res.bind(env, stmt, fi)
+                    targets = (stmt.targets
+                               if isinstance(stmt, ast.Assign)
+                               else [stmt.target])
+                    if stmt.value is None:
+                        targets = []
+                    for tgt in targets:
+                        attr = self_attr(tgt)
+                        if attr is None:
+                            continue
+                        cls = res.creation_class(stmt.value, fi, env)
+                        if cls is not None:
+                            self.cls_attrs.setdefault(
+                                fi.cls.qual, {}).setdefault(attr, cls)
+                        lcls = res.handle_list_class(stmt.value,
+                                                     fi, env)
+                        if lcls is not None:
+                            self.cls_attr_lists.setdefault(
+                                fi.cls.qual, {}).setdefault(attr, lcls)
+                        alias = res.remote_alias(stmt.value, fi)
+                        if alias is not None:
+                            self.cls_attr_aliases.setdefault(
+                                fi.cls.qual, {}).setdefault(attr, alias)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    res.bind_for(env, stmt, fi)
+                elif isinstance(stmt, ast.Return) and stmt.value:
+                    cls = res.creation_class(stmt.value, fi, env)
+                    if cls is not None:
+                        self.fn_returns.setdefault(fi.qual, cls)
+                elif isinstance(stmt, ast.Expr):
+                    grown = res.bind_append(env, stmt.value, fi)
+                    if grown is not None:
+                        recv, cls = grown
+                        attr = self_attr(recv)
+                        if attr is not None:
+                            self.cls_attr_lists.setdefault(
+                                fi.cls.qual, {}).setdefault(attr, cls)
+
+        stmt_pass(res.stmt_plan(fi))
+
+    def _learn_params(self, call: ast.Call, fi: FuncInfo,
+                      env: "LocalEnv") -> None:
+        """Flow handle types from a call site into the callee's
+        parameters (``self._submit(runner_handle)`` types ``runner``
+        inside ``_submit``)."""
+        key = id(call)
+        if key in self._callee_memo:
+            callee = self._callee_memo[key]
+        else:
+            callee = self.idx.resolve_call(call.func, fi)
+            self._callee_memo[key] = callee
+        if not isinstance(callee, FuncInfo):
+            return
+        params = callee.param_names()
+        if params and params[0] in ("self", "cls") \
+                and callee.cls is not None:
+            params = params[1:]
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break           # positions beyond a * are unknown
+            if i >= len(params):
+                break
+            cls = self.resolver.creation_class(arg, fi, env)
+            if cls is not None:
+                self._record_param(callee.qual, params[i], cls)
+        names = set(params)
+        names.update(a.arg for a in callee.node.args.kwonlyargs)
+        for kw in call.keywords:
+            if kw.arg is None or kw.arg not in names:
+                continue
+            cls = self.resolver.creation_class(kw.value, fi, env)
+            if cls is not None:
+                self._record_param(callee.qual, kw.arg, cls)
+
+    def _record_param(self, qual: str, name: str,
+                      cls: ClassInfo) -> None:
+        tbl = self.fn_params.setdefault(qual, {})
+        if name not in tbl:
+            tbl[name] = cls
+        elif tbl[name] is not None and tbl[name].qual != cls.qual:
+            tbl[name] = None    # conflicting call sites: poison
+
+    def param_actor(self, fn_qual: str,
+                    name: str) -> Optional[ClassInfo]:
+        return self.fn_params.get(fn_qual, {}).get(name)
+
+    def _bfs_attr(self, table: Dict[str, Dict[str, ClassInfo]],
+                  cls_qual: str, attr: str) -> Optional[ClassInfo]:
+        seen: Set[str] = set()
+        queue = [cls_qual]
+        while queue:
+            q = queue.pop(0)
+            if q in seen:
+                continue
+            seen.add(q)
+            got = table.get(q, {}).get(attr)
+            if got is not None:
+                return got
+            ci = self.idx.classes.get(q)
+            if ci is not None:
+                queue.extend(ci.base_quals)
+        return None
+
+    def attr_actor(self, cls_qual: str,
+                   attr: str) -> Optional[ClassInfo]:
+        return self._bfs_attr(self.cls_attrs, cls_qual, attr)
+
+    def attr_actor_list(self, cls_qual: str,
+                        attr: str) -> Optional[ClassInfo]:
+        return self._bfs_attr(self.cls_attr_lists, cls_qual, attr)
+
+    def attr_alias(self, cls_qual: str, attr: str,
+                   ) -> Optional[Tuple[Resolved, Dict[str, ast.expr]]]:
+        return self._bfs_attr(self.cls_attr_aliases, cls_qual, attr)
+
+
+class RemoteResolver:
+    """Resolves ``.remote(...)`` receivers through the index plus
+    dataflow provenance (local handle bindings, remote-wrap aliases,
+    handle-returning helpers, handle containers)."""
+
+    def __init__(self, idx: ProjectIndex):
+        self.idx = idx
+        # memo tables keyed by id(node) — AST nodes live as long as the
+        # index, so ids are stable. The provenance fixed point and the
+        # per-analysis scans re-walk the same expressions several
+        # times; caching the walk products (not the bindings, which
+        # depend on the env) makes passes 2..n nearly free.
+        self._comp_memo: Dict[int, List[ast.comprehension]] = {}
+        self._call_memo: Dict[int, List[ast.Call]] = {}
+        # function qual -> pre-order (stmt, [expr children]) plan; the
+        # provenance fixed point revisits every function each pass and
+        # the traversal itself is most of a pass's cost
+        self._plan_memo: Dict[str, List] = {}
+        self.handles = _Provenance(self)
+
+    def stmt_plan(self, fi: FuncInfo) -> List:
+        """Pre-order (stmt, comprehension generators, calls) triples
+        for the function body — the walk products the provenance fixed
+        point needs, computed once so repeat passes traverse no AST."""
+        plan = self._plan_memo.get(fi.qual)
+        if plan is None:
+            plan = []
+
+            def flatten(stmts: List[ast.stmt]) -> None:
+                for stmt in stmts:
+                    if isinstance(stmt, _SKIP_NODES):
+                        continue
+                    gens: List[ast.comprehension] = []
+                    calls: List[ast.Call] = []
+                    for c in ast.iter_child_nodes(stmt):
+                        if isinstance(c, (ast.stmt, ast.excepthandler)):
+                            continue
+                        # one fused walk per expr child; within an
+                        # expression there are no def/class nodes to
+                        # prune, so this matches _iter_calls exactly
+                        for node in ast.walk(c):
+                            if isinstance(node, ast.Call):
+                                calls.append(node)
+                            elif isinstance(node, _COMP_NODES):
+                                gens.extend(node.generators)
+                    plan.append((stmt, gens, calls))
+                    for body in _stmt_bodies(stmt):
+                        flatten(body)
+
+            flatten(list(getattr(fi.node, "body", [])))
+            self._plan_memo[fi.qual] = plan
+        return plan
+
+    def comp_gens(self, expr: ast.AST) -> List[ast.comprehension]:
+        gens = self._comp_memo.get(id(expr))
+        if gens is None:
+            gens = [gen for node in ast.walk(expr)
+                    if isinstance(node, (ast.ListComp, ast.SetComp,
+                                         ast.GeneratorExp, ast.DictComp))
+                    for gen in node.generators]
+            self._comp_memo[id(expr)] = gens
+        return gens
+
+    def calls_in(self, node: ast.AST) -> List[ast.Call]:
+        got = self._call_memo.get(id(node))
+        if got is None:
+            got = list(_iter_calls(node))
+            self._call_memo[id(node)] = got
+        return got
+
+    # -- site resolution ---------------------------------------------
+
+    def site(self, call: ast.Call, scope: FuncInfo,
+             env: Optional[LocalEnv] = None) -> Optional[RemoteSite]:
+        """A RemoteSite when `call` is ``X.remote(...)``, else None.
+        `env` carries the caller's statement-walk provenance."""
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "remote"):
+            return None
+        base, opt_calls, dynamic = _unwrap_options(f.value)
+        options: Dict[str, ast.expr] = {}
+        for oc in reversed(opt_calls):
+            for kw in oc.keywords:
+                if kw.arg is not None:
+                    options[kw.arg] = kw.value
+
+        def finish(kind: str, target: Optional[Resolved],
+                   method: Optional[FuncInfo] = None,
+                   method_name: Optional[str] = None,
+                   deco_opts: Optional[Dict[str, ast.expr]] = None,
+                   ) -> RemoteSite:
+            merged = dict(deco_opts or {})
+            merged.update(options)
+            return RemoteSite(call, scope, kind, target, method,
+                              method_name, merged, opt_calls, dynamic)
+
+        # remote-wrap alias: W = remote(...)(Worker); W.remote(...)
+        if (env is not None and isinstance(base, ast.Name)
+                and base.id in env.aliases):
+            target, deco = env.aliases[base.id]
+            kind = ("actor_create" if isinstance(target, ClassInfo)
+                    else "task")
+            return finish(kind, target, deco_opts=deco)
+
+        # inline wrap: remote(num_cpus=1)(fn).remote(...)
+        wrapped = self.remote_alias(base, scope)
+        if wrapped is not None:
+            target, deco = wrapped
+            kind = ("actor_create" if isinstance(target, ClassInfo)
+                    else "task")
+            return finish(kind, target, deco_opts=deco)
+
+        # direct: fn.remote / Cls.remote / mod.fn.remote
+        r = resolve_value(base, scope, self.idx)
+        if r is not None and not isinstance(r, ModuleInfo):
+            deco = remote_decoration(r.node)
+            if deco is None:
+                return None       # .remote on a non-@remote symbol:
+                                  # contracts.py reports separately
+            if isinstance(r, ClassInfo):
+                return finish("actor_create", r, deco_opts=deco)
+            return finish("task", r, deco_opts=deco)
+
+        # remote-wrap alias stored on self:
+        # self._cls = remote(**opts)(Worker); self._cls.remote(...)
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and scope.cls is not None):
+            al = self.handles.attr_alias(scope.cls.qual, base.attr)
+            if al is not None:
+                target, deco = al
+                kind = ("actor_create" if isinstance(target, ClassInfo)
+                        else "task")
+                return finish(kind, target, deco_opts=deco)
+
+        # actor method: handle.meth.remote
+        if isinstance(base, ast.Attribute):
+            actor = self._handle_of(base.value, scope, env)
+            if actor is not None:
+                m = self.idx.find_method(actor.qual, base.attr)
+                deco = method_decoration(m.node) if m else {}
+                return finish("actor_method", actor, m, base.attr,
+                              deco_opts=deco)
+        return None
+
+    def _handle_of(self, expr: ast.AST, scope: FuncInfo,
+                   env: Optional[LocalEnv]) -> Optional[ClassInfo]:
+        """The actor class `expr` is a handle on, or None."""
+        if isinstance(expr, ast.Name):
+            if env and expr.id in env.handles:
+                return env.handles[expr.id]
+            # a parameter typed by its (consistent) call sites —
+            # unless a local rebinding shadowed it
+            if env is None or expr.id not in env.killed:
+                return self.handles.param_actor(scope.qual, expr.id)
+            return None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and scope.cls is not None):
+            return self.handles.attr_actor(scope.cls.qual, expr.attr)
+        if isinstance(expr, ast.Subscript):
+            return self._list_elem_of(expr.value, scope, env)
+        return None
+
+    def _list_elem_of(self, expr: ast.AST, scope: FuncInfo,
+                      env: Optional[LocalEnv]) -> Optional[ClassInfo]:
+        """Element class when `expr` is a known handle container."""
+        if isinstance(expr, ast.Name):
+            if env and expr.id in env.handle_lists:
+                return env.handle_lists[expr.id]
+            return None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and scope.cls is not None):
+            return self.handles.attr_actor_list(scope.cls.qual,
+                                                expr.attr)
+        return None
+
+    # -- provenance classification -----------------------------------
+
+    def remote_alias(self, value: ast.AST, scope: FuncInfo,
+                     ) -> Optional[Tuple[Resolved,
+                                         Dict[str, ast.expr]]]:
+        """(wrapped target, wrap options) for the call-form decorator:
+        ``remote(fn)`` or ``remote(num_cpus=2)(fn)``."""
+        if not isinstance(value, ast.Call):
+            return None
+        opts: Dict[str, ast.expr] = {}
+        if (isinstance(value.func, ast.Call)
+                and _is_remote_callable(value.func.func)):
+            opts = {kw.arg: kw.value for kw in value.func.keywords
+                    if kw.arg is not None}
+            target_expr = value.args[0] if value.args else None
+        elif _is_remote_callable(value.func):
+            target_expr = value.args[0] if value.args else None
+            if target_expr is None or value.keywords:
+                # remote(num_cpus=2) alone is a partial decorator,
+                # not a wrapped callable
+                return None
+        else:
+            return None
+        if target_expr is None:
+            return None
+        r = resolve_value(target_expr, scope, self.idx)
+        if isinstance(r, (FuncInfo, ClassInfo)):
+            return r, opts
+        return None
+
+    def creation_class(self, value: ast.AST, scope: FuncInfo,
+                       env: LocalEnv) -> Optional[ClassInfo]:
+        """The actor class when `value` evaluates to a handle."""
+        if isinstance(value, ast.Call):
+            if (isinstance(value.func, ast.Attribute)
+                    and value.func.attr == "remote"):
+                s = self.site(value, scope, env)
+                if (s is not None and s.kind == "actor_create"
+                        and isinstance(s.target, ClassInfo)):
+                    return s.target
+                return None
+            callee = self.idx.resolve_call(value.func, scope)
+            if callee is not None:
+                return self.handles.fn_returns.get(callee.qual)
+            return None
+        if isinstance(value, ast.Subscript):
+            return self._list_elem_of(value.value, scope, env)
+        if isinstance(value, (ast.Name, ast.Attribute)):
+            return self._handle_of(value, scope, env)
+        return None
+
+    def handle_list_class(self, value: ast.AST, scope: FuncInfo,
+                          env: LocalEnv) -> Optional[ClassInfo]:
+        """Element class when `value` builds a container of handles."""
+        if isinstance(value, (ast.ListComp, ast.SetComp)):
+            return self.creation_class(value.elt, scope, env)
+        if isinstance(value, ast.DictComp):
+            return self.creation_class(value.value, scope, env)
+        if isinstance(value, (ast.List, ast.Set, ast.Tuple)):
+            classes = [self.creation_class(e, scope, env)
+                       for e in value.elts]
+            if classes and all(c is not None for c in classes) \
+                    and len({c.qual for c in classes}) == 1:
+                return classes[0]
+            return None
+        if isinstance(value, (ast.Name, ast.Attribute)):
+            return self._list_elem_of(value, scope, env)
+        return None
+
+    # -- statement-walk binding helpers ------------------------------
+
+    def bind(self, env: LocalEnv, stmt: ast.stmt,
+             scope: FuncInfo) -> None:
+        """Update `env` for one (possibly annotated) assignment."""
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None \
+                    and isinstance(stmt.target, ast.Name):
+                self._bind_one(env, stmt.target.id, stmt.value, scope)
+            return
+        v = stmt.value
+        for tgt in stmt.targets:
+            if (isinstance(tgt, ast.Tuple) and isinstance(v, ast.Tuple)
+                    and len(tgt.elts) == len(v.elts)
+                    and not any(isinstance(e, ast.Starred)
+                                for e in tgt.elts)):
+                # s1, s2 = Stage.remote(), Stage.remote()
+                for t_i, v_i in zip(tgt.elts, v.elts):
+                    if isinstance(t_i, ast.Name):
+                        self._bind_one(env, t_i.id, v_i, scope)
+            elif isinstance(tgt, ast.Name):
+                self._bind_one(env, tgt.id, v, scope)
+
+    def _bind_one(self, env: LocalEnv, name: str, value: ast.AST,
+                  scope: FuncInfo) -> None:
+        alias = self.remote_alias(value, scope)
+        cls = self.creation_class(value, scope, env)
+        lcls = self.handle_list_class(value, scope, env)
+        env.clear_name(name)
+        if alias is not None:
+            env.aliases[name] = alias
+        elif cls is not None:
+            env.handles[name] = cls
+        elif lcls is not None:
+            env.handle_lists[name] = lcls
+        else:
+            return          # unknown value: name stays killed
+        env.mark(name)
+
+    def _unwrap_iter(self, it: ast.AST,
+                     tgt: Optional[ast.AST]) -> Tuple[ast.AST,
+                                                      Optional[ast.AST]]:
+        """Peel transparent wrappers off a loop iterable:
+        ``enumerate(xs)`` (shifting a 2-tuple target), ``list/sorted/
+        tuple/reversed(xs)``, ``xs.values()``."""
+        for _ in range(3):
+            if (isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name) and it.args):
+                if it.func.id == "enumerate":
+                    it = it.args[0]
+                    if (isinstance(tgt, ast.Tuple)
+                            and len(tgt.elts) == 2):
+                        tgt = tgt.elts[1]
+                    continue
+                if (it.func.id in ("list", "sorted", "tuple",
+                                   "reversed")
+                        and len(it.args) == 1):
+                    it = it.args[0]
+                    continue
+            if (isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Attribute)
+                    and it.func.attr == "values" and not it.args):
+                it = it.func.value
+                continue
+            break
+        return it, tgt
+
+    def bind_for(self, env: LocalEnv, stmt: ast.stmt,
+                 scope: FuncInfo) -> None:
+        """Type the loop variable when iterating a handle container
+        (``for r in self.runners:`` / ``enumerate(actors)``)."""
+        it, tgt = self._unwrap_iter(stmt.iter, stmt.target)
+        cls = self._list_elem_of(it, scope, env)
+        if cls is None:
+            return
+        if isinstance(tgt, ast.Name):
+            env.clear_name(tgt.id)
+            env.handles[tgt.id] = cls
+            env.mark(tgt.id)
+
+    def bind_comps(self, env: LocalEnv, expr: ast.AST,
+                   scope: FuncInfo) -> None:
+        """Type comprehension loop variables ranging over handle
+        containers (``[r.sample.remote(...) for r in self.runners]``).
+        Comprehension scoping is ignored — the binding outlives the
+        expression — but only typed names are recorded, so the
+        over-approximation can only resolve more receivers."""
+        self.bind_gens(env, self.comp_gens(expr), scope)
+
+    def bind_gens(self, env: LocalEnv,
+                  gens: List[ast.comprehension],
+                  scope: FuncInfo) -> None:
+        for gen in gens:
+            it, tgt = self._unwrap_iter(gen.iter, gen.target)
+            cls = self._list_elem_of(it, scope, env)
+            if cls is not None and isinstance(tgt, ast.Name):
+                env.handles[tgt.id] = cls
+                env.mark(tgt.id)
+
+    def bind_append(self, env: LocalEnv, value: ast.AST,
+                    scope: FuncInfo,
+                    ) -> Optional[Tuple[ast.AST, ClassInfo]]:
+        """``xs.append(Worker.remote(...))`` grows a handle container;
+        returns (receiver expr, element class) so the provenance pass
+        can record ``self.X.append(...)`` too."""
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr in ("append", "add", "insert")
+                and value.args):
+            return None
+        cls = self.creation_class(value.args[-1], scope, env)
+        if cls is None:
+            return None
+        recv = value.func.value
+        if isinstance(recv, ast.Name):
+            env.handle_lists.setdefault(recv.id, cls)
+            env.mark(recv.id)
+        return recv, cls
+
+    def bind_stmt(self, env: LocalEnv, stmt: ast.stmt,
+                  scope: FuncInfo) -> None:
+        """One-stop binding for a statement-ordered walk."""
+        for child in ast.iter_child_nodes(stmt):
+            if not isinstance(child, (ast.stmt, ast.excepthandler)):
+                self.bind_comps(env, child, scope)
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            self.bind(env, stmt, scope)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.bind_for(env, stmt, scope)
+        elif isinstance(stmt, ast.Expr):
+            self.bind_append(env, stmt.value, scope)
+
+    def seed_env(self, scope: FuncInfo) -> LocalEnv:
+        """A LocalEnv pre-seeded with bindings from enclosing
+        functions — nested defs close over the parent's handles
+        (``actor = Pong.remote()`` above, ``actor.ping.remote()``
+        inside the nested benchmark body). Late rebinding is ignored;
+        only typed names carry over, so the cost of the
+        over-approximation is an extra resolved receiver, never a
+        missed one."""
+        if scope.parent is None:
+            return LocalEnv()
+        chain: List[FuncInfo] = []
+        fn = scope.parent
+        while fn is not None:
+            chain.append(fn)
+            fn = fn.parent
+        env = LocalEnv()
+        for anc in reversed(chain):
+            self._bind_pass(env, list(getattr(anc.node, "body", [])),
+                            anc)
+        return env
+
+    def _bind_pass(self, env: LocalEnv, stmts: List[ast.stmt],
+                   scope: FuncInfo) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, _SKIP_NODES):
+                continue
+            self.bind_stmt(env, stmt, scope)
+            for body in _stmt_bodies(stmt):
+                self._bind_pass(env, body, scope)
+
+
+def _iter_calls(node: ast.AST) -> Iterable[ast.Call]:
+    """Calls in `node`, not descending into nested defs/classes
+    (lambdas and comprehensions stay — they share the dataflow)."""
+    stack = list(ast.iter_child_nodes(node))
+    if isinstance(node, ast.Call):
+        yield node
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _FUNC_NODES + (ast.ClassDef,)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def remote_sites(idx: ProjectIndex,
+                 resolver: Optional[RemoteResolver] = None,
+                 only: Optional[Set[str]] = None) -> List[RemoteSite]:
+    """Every resolved ``.remote(...)`` site in the project, with
+    function-local provenance applied (``w = Worker.remote();
+    w.ping.remote()``). `only` restricts the scan to functions in
+    those files (resolution stays whole-program)."""
+    resolver = resolver or RemoteResolver(idx)
+    out: List[RemoteSite] = []
+    for fi in idx.all_functions():
+        if only is not None and fi.path not in only:
+            continue
+        out.extend(_sites_in(fi, resolver, idx))
+    return out
+
+
+def _sites_in(fi: FuncInfo, resolver: RemoteResolver,
+              idx: ProjectIndex) -> List[RemoteSite]:
+    out: List[RemoteSite] = []
+    env = resolver.seed_env(fi)
+    seen: Set[ast.Call] = set()
+
+    def visit_expr(expr: ast.AST) -> None:
+        resolver.bind_comps(env, expr, fi)
+        for call in _iter_calls(expr):
+            if call in seen:
+                continue
+            seen.add(call)
+            site = resolver.site(call, fi, env)
+            if site is not None:
+                out.append(site)
+
+    def walk(stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, _SKIP_NODES):
+                continue
+            for child in ast.iter_child_nodes(stmt):
+                # statements and except-handlers are walked in order
+                # below — visiting them here would scan their bodies
+                # before the bindings they depend on exist
+                if not isinstance(child, (ast.stmt, ast.excepthandler)):
+                    visit_expr(child)
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                resolver.bind(env, stmt, fi)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                resolver.bind_for(env, stmt, fi)
+            elif isinstance(stmt, ast.Expr):
+                resolver.bind_append(env, stmt.value, fi)
+            for body in _stmt_bodies(stmt):
+                walk(body)
+
+    walk(list(getattr(fi.node, "body", [])))
+    return out
+
+
+def _stmt_bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    out = []
+    for attr in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, attr, None)
+        if isinstance(b, list) and b and isinstance(b[0], ast.stmt):
+            out.append(b)
+    for h in getattr(stmt, "handlers", []):
+        out.append(h.body)
+    return out
+
+
+# ---------------------------------------------------------------------
+# Call graph + reachability
+# ---------------------------------------------------------------------
+
+
+class CallGraph:
+    """qual -> resolved callee quals, built once per index."""
+
+    def __init__(self, idx: ProjectIndex):
+        self.idx = idx
+        self.edges: Dict[str, Set[str]] = {}
+        # (caller, callee) -> first call line
+        self.lines: Dict[Tuple[str, str], int] = {}
+        for fi in idx.all_functions():
+            outs = self.edges.setdefault(fi.qual, set())
+            for n in ast.walk(fi.node):
+                if isinstance(n, _FUNC_NODES) and n is not fi.node:
+                    continue
+                if not isinstance(n, ast.Call):
+                    continue
+                callee = idx.resolve_call(n.func, fi)
+                if callee is None:
+                    continue
+                outs.add(callee.qual)
+                self.lines.setdefault((fi.qual, callee.qual),
+                                      getattr(n, "lineno", 0))
+
+    @property
+    def edge_count(self) -> int:
+        return sum(len(v) for v in self.edges.values())
+
+    def reachable(self, roots: Iterable[str]) -> Dict[str, List[str]]:
+        """qual -> call chain (root first) for everything reachable
+        from `roots` (roots included, chain [root])."""
+        out: Dict[str, List[str]] = {}
+        queue: List[Tuple[str, List[str]]] = [
+            (r, [r]) for r in roots]
+        while queue:
+            q, chain = queue.pop(0)
+            if q in out:
+                continue
+            out[q] = chain
+            for callee in sorted(self.edges.get(q, ())):
+                if callee not in out:
+                    queue.append((callee, chain + [callee]))
+        return out
+
+
+# ---------------------------------------------------------------------
+# Signature model (for the contract checker)
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class Signature:
+    name: str
+    pos_params: List[str]          # posonly + regular, self stripped
+    n_required_pos: int            # without defaults
+    kwonly: List[str]
+    kwonly_required: List[str]
+    has_vararg: bool
+    has_kwarg: bool
+    posonly_count: int
+
+    @classmethod
+    def of(cls, fi: FuncInfo, *, strip_self: bool) -> "Signature":
+        a = fi.node.args
+        posonly = [p.arg for p in a.posonlyargs]
+        regular = [p.arg for p in a.args]
+        pos = posonly + regular
+        n_defaults = len(a.defaults)
+        n_required = len(pos) - n_defaults
+        posonly_count = len(posonly)
+        if strip_self and pos and pos[0] in ("self", "cls"):
+            pos = pos[1:]
+            n_required = max(0, n_required - 1)
+            posonly_count = max(0, posonly_count - 1)
+        kwonly = [p.arg for p in a.kwonlyargs]
+        kwonly_required = [p.arg for p, d in
+                           zip(a.kwonlyargs, a.kw_defaults)
+                           if d is None]
+        return cls(fi.name, pos, n_required, kwonly, kwonly_required,
+                   a.vararg is not None, a.kwarg is not None,
+                   posonly_count)
+
+    def check_call(self, call: ast.Call) -> List[str]:
+        """Human-readable contract violations for `call`'s args."""
+        problems: List[str] = []
+        n_pos = 0
+        has_star = False
+        for arg in call.args:
+            if isinstance(arg, ast.Starred):
+                has_star = True
+            else:
+                n_pos += 1
+        kw_names: List[str] = []
+        has_kwstar = False
+        for kw in call.keywords:
+            if kw.arg is None:
+                has_kwstar = True
+            else:
+                kw_names.append(kw.arg)
+        if (not has_star and not self.has_vararg
+                and n_pos > len(self.pos_params)):
+            problems.append(
+                f"takes at most {len(self.pos_params)} positional "
+                f"argument(s) but {n_pos} are passed")
+        if not self.has_kwarg:
+            named = set(self.pos_params[self.posonly_count:]) | set(
+                self.kwonly)
+            for k in kw_names:
+                if k not in named:
+                    problems.append(f"got an unexpected keyword "
+                                    f"argument {k!r}")
+        if not has_star and not has_kwstar:
+            covered = set(self.pos_params[:n_pos]) | set(kw_names)
+            missing = [p for p in self.pos_params[:self.n_required_pos]
+                       if p not in covered]
+            missing += [p for p in self.kwonly_required
+                        if p not in kw_names]
+            if missing:
+                problems.append(
+                    "missing required argument(s): "
+                    + ", ".join(repr(m) for m in missing))
+            dup = [k for k in kw_names
+                   if k in set(self.pos_params[:n_pos])]
+            if dup:
+                problems.append(
+                    "got multiple values for argument(s): "
+                    + ", ".join(repr(d) for d in dup))
+        return problems

@@ -29,6 +29,26 @@ from typing import Dict, List, Optional, Tuple, Union
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 
+def _child_stmts(node: ast.AST):
+    """Direct statement children (plus except/match arms, which carry
+    their own bodies). Defs, imports, and assigns only ever live in
+    statement lists, so indexing passes need not descend into
+    expression subtrees — that's most of an AST by node count."""
+    for fld in ("body", "orelse", "finalbody"):
+        yield from getattr(node, fld, ())
+    yield from getattr(node, "handlers", ())
+    yield from getattr(node, "cases", ())
+
+
+def _walk_stmts(root: ast.AST):
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        for child in _child_stmts(node):
+            yield child
+            stack.append(child)
+
+
 @dataclass
 class FuncInfo:
     qual: str
@@ -37,6 +57,9 @@ class FuncInfo:
     cls: Optional["ClassInfo"] = None
     parent: Optional["FuncInfo"] = None
     nested: Dict[str, "FuncInfo"] = field(default_factory=dict)
+    # classes DEFINED inside this function body (benchmark/fixture
+    # style: ``@remote\nclass Pong`` inside a driver function)
+    nested_classes: Dict[str, "ClassInfo"] = field(default_factory=dict)
 
     @property
     def name(self) -> str:
@@ -130,13 +153,15 @@ class ProjectIndex:
     def _index_symbols(self, mi: ModuleInfo) -> None:
         def walk(node: ast.AST, cls: Optional[ClassInfo],
                  parent: Optional[FuncInfo], prefix: str) -> None:
-            for child in ast.iter_child_nodes(node):
+            for child in _child_stmts(node):
                 if isinstance(child, ast.ClassDef):
                     qual = f"{prefix}.{child.name}"
                     ci = ClassInfo(qual, child.name, mi, child,
                                    base_exprs=list(child.bases))
                     self.classes[qual] = ci
-                    if cls is None and parent is None:
+                    if parent is not None:
+                        parent.nested_classes[child.name] = ci
+                    elif cls is None:
                         mi.classes[child.name] = ci
                     walk(child, ci, None, qual)
                 elif isinstance(child, _FUNC_NODES):
@@ -160,7 +185,7 @@ class ProjectIndex:
 
     def _index_imports(self, mi: ModuleInfo) -> None:
         parts = mi.modname.split(".")
-        for node in ast.walk(mi.tree):
+        for node in _walk_stmts(mi.tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
                     local = a.asname or a.name.split(".")[0]
@@ -195,7 +220,7 @@ class ProjectIndex:
             if ci.module is not mi:
                 continue
             for m in ci.methods.values():
-                for n in ast.walk(m.node):
+                for n in _walk_stmts(m.node):
                     if not isinstance(n, ast.Assign):
                         continue
                     t = self._ctor_class(n.value, mi)

@@ -1,0 +1,383 @@
+"""jit-purity / host-sync detection over the traced call graph.
+
+``jax.jit`` traces a function once per (shape, static-arg) signature;
+everything the trace reaches runs under tracer semantics. Three bug
+classes hide there, all invisible per-file because the offending code
+usually sits in a helper far from the ``@jit`` line:
+
+- **xp-jit-host-sync** — a device->host synchronization inside traced
+  code: ``.item()`` / ``.tolist()`` / ``np.asarray`` / ``np.array`` /
+  ``jax.device_get`` / ``float()``/``int()``/``bool()`` on a traced
+  parameter, or plain ``print`` of traced values. Under trace these
+  either raise ``ConcretizationTypeError`` at compile time or — worse
+  — silently bake a trace-time constant into the compiled program.
+  ``jax.debug.print`` / ``jax.debug.callback`` /
+  ``io_callback`` are the sanctioned escapes and do not fire.
+- **xp-jit-impure-mutation** — assignment to ``self.<attr>`` or to a
+  ``global``/``nonlocal`` name inside traced code. The mutation runs
+  at TRACE time only: the first call per signature performs it, every
+  later call skips it (the compiled program has no Python), so state
+  drifts apart between the first and the N-th step.
+- **xp-jit-static-args** — a ``static_argnums`` index out of range of
+  the target's positional parameters, a ``static_argnames`` name that
+  is not a parameter (both: the drift class where a refactor reorders
+  parameters and the decorator silently pins the WRONG argument —
+  retrace storms or shape errors), or a call site passing a
+  list/dict/set literal at a static position (unhashable ->
+  TypeError at first call).
+
+Entry points: defs decorated ``@jax.jit`` / ``@jit`` / ``@pjit`` /
+``@partial(jax.jit, ...)``, and project functions wrapped by a
+``jax.jit(fn, ...)`` call expression. The traced region is the
+entry's reachable set over the resolved call graph; each finding
+carries the entry and call chain so the reader can see HOW the line
+is reached under trace.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .dataflow import CallGraph, FuncInfo, resolve_value
+from .index import ProjectIndex
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_JIT_NAMES = {"jit", "pjit"}
+_HOST_SYNC_METHODS = {"item", "tolist"}
+_HOST_ARRAY_FUNCS = {"asarray", "array"}   # on numpy receivers
+_NUMPY_NAMES = {"np", "numpy", "onp"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+def _dotted_tail(expr: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """(receiver name, attr) for ``recv.attr`` / (None, name) for a
+    bare name."""
+    if isinstance(expr, ast.Name):
+        return None, expr.id
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name):
+            return expr.value.id, expr.attr
+        if isinstance(expr.value, ast.Attribute):
+            return expr.value.attr, expr.attr
+    return None, None
+
+
+def _is_jit_callable(expr: ast.AST) -> bool:
+    recv, name = _dotted_tail(expr)
+    return name in _JIT_NAMES and recv in (None, "jax")
+
+
+@dataclass
+class JitEntry:
+    fi: FuncInfo
+    line: int
+    static_argnums: Optional[List[int]]      # None = dynamic/unknown
+    static_argnames: Optional[List[str]]
+
+
+def _const_int_tuple(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def _const_str_tuple(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def _jit_kwargs(call: ast.Call) -> Tuple[Optional[List[int]],
+                                         Optional[List[str]]]:
+    nums = names = None
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = _const_int_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _const_str_tuple(kw.value)
+    return nums, names
+
+
+def find_entries(idx: ProjectIndex) -> List[JitEntry]:
+    entries: List[JitEntry] = []
+    seen: Set[str] = set()
+
+    def add(fi: FuncInfo, line: int, nums, names) -> None:
+        if fi.qual in seen:
+            return
+        seen.add(fi.qual)
+        entries.append(JitEntry(fi, line, nums, names))
+
+    for fi in idx.all_functions():
+        for dec in getattr(fi.node, "decorator_list", []):
+            if _is_jit_callable(dec):
+                add(fi, dec.lineno, None, None)
+            elif isinstance(dec, ast.Call):
+                if _is_jit_callable(dec.func):
+                    nums, names = _jit_kwargs(dec)
+                    add(fi, dec.lineno, nums, names)
+                    continue
+                _, fname = _dotted_tail(dec.func)
+                if (fname == "partial" and dec.args
+                        and _is_jit_callable(dec.args[0])):
+                    nums, names = _jit_kwargs(dec)
+                    add(fi, dec.lineno, nums, names)
+        # jax.jit(fn, ...) wrap-call form
+        for n in ast.walk(fi.node):
+            if not (isinstance(n, ast.Call)
+                    and _is_jit_callable(n.func) and n.args):
+                continue
+            target = n.args[0]
+            if (isinstance(target, ast.Call)
+                    and isinstance(target.func, ast.Name)
+                    and target.func.id == "partial" and target.args):
+                target = target.args[0]
+            r = resolve_value(target, fi, idx)
+            if isinstance(r, FuncInfo):
+                nums, names = _jit_kwargs(n)
+                add(r, n.lineno, nums, names)
+    return entries
+
+
+def _chain_str(chain: List[str]) -> str:
+    shown = chain if len(chain) <= 4 else chain[:2] + ["..."] + chain[-1:]
+    return " -> ".join(q.rsplit(".", 1)[-1] + "()" for q in shown)
+
+
+class _TracedScan:
+    """Host-sync + impurity findings inside one traced function."""
+
+    def __init__(self, fi: FuncInfo, entry: JitEntry,
+                 chain: List[str]):
+        self.fi = fi
+        self.entry = entry
+        self.chain = chain
+        self.out: List[tuple] = []
+        # traced parameter names: the entry's non-static positionals
+        # (for callees everything is possibly traced; we only apply
+        # the float()/int() cast check to the ENTRY's own params,
+        # where tracedness is certain)
+        self.traced_params: Set[str] = set()
+        if fi is entry.fi:
+            pos = fi.param_names()
+            static = set(entry.static_argnums or [])
+            statics = {pos[i] for i in static if i < len(pos)}
+            statics |= set(entry.static_argnames or [])
+            self.traced_params = {
+                p for i, p in enumerate(pos)
+                if p not in statics and i not in static
+                and p not in ("self", "cls")}
+
+    def _flag(self, line: int, rule: str, what: str, why: str) -> None:
+        via = ""
+        if len(self.chain) > 1:
+            via = f" [traced via {_chain_str(self.chain)}]"
+        self.out.append((
+            line, rule,
+            f"{what} inside jit-traced code (entry "
+            f"{self.entry.fi.name}() at "
+            f"{self.entry.fi.path.rsplit('/', 1)[-1]}:"
+            f"{self.entry.line}){via} — {why}"))
+
+    def run(self) -> List[tuple]:
+        fn = self.fi.node
+        # global/nonlocal declarations + whether declared names are
+        # assigned in this function
+        declared: Dict[str, int] = {}
+        assigned: Set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, _FUNC_NODES) and n is not fn:
+                continue
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                for name in n.names:
+                    declared.setdefault(name, n.lineno)
+            elif isinstance(n, ast.Name) and isinstance(
+                    n.ctx, (ast.Store,)):
+                assigned.add(n.id)
+            elif isinstance(n, ast.AugAssign) and isinstance(
+                    n.target, ast.Name):
+                assigned.add(n.target.id)
+        for name, line in sorted(declared.items()):
+            if name in assigned:
+                self._flag(
+                    line, "xp-jit-impure-mutation",
+                    f"assignment to {name!r} declared "
+                    f"global/nonlocal",
+                    "the mutation happens at TRACE time only — the "
+                    "compiled program never re-runs it, so state "
+                    "diverges after the first call per signature")
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        self._flag(
+                            n.lineno, "xp-jit-impure-mutation",
+                            f"mutation of self.{t.attr}",
+                            "runs at trace time only; later calls "
+                            "reuse the compiled program and skip it "
+                            "— carry state through function "
+                            "arguments/returns instead")
+            elif isinstance(n, ast.Call):
+                self._call(n)
+        return self.out
+
+    def _call(self, call: ast.Call) -> None:
+        f = call.func
+        recv, name = _dotted_tail(f)
+        if (isinstance(f, ast.Attribute)
+                and f.attr in _HOST_SYNC_METHODS and not call.args):
+            self._flag(
+                call.lineno, "xp-jit-host-sync",
+                f".{f.attr}() call",
+                "forces a device->host sync; under trace it raises "
+                "ConcretizationTypeError or freezes a trace-time "
+                "constant into the program")
+            return
+        if name in _HOST_ARRAY_FUNCS and recv in _NUMPY_NAMES:
+            self._flag(
+                call.lineno, "xp-jit-host-sync",
+                f"{recv}.{name}() materialization",
+                "pulls the traced value to host numpy — use jnp "
+                "inside jit and convert at the boundary")
+            return
+        if name == "device_get" and recv == "jax":
+            self._flag(
+                call.lineno, "xp-jit-host-sync",
+                "jax.device_get() call",
+                "explicit device->host transfer inside the trace")
+            return
+        if (isinstance(f, ast.Name) and f.id == "print"):
+            self._flag(
+                call.lineno, "xp-jit-host-sync",
+                "print() of traced values",
+                "prints tracer reprs once at trace time, then never "
+                "again — use jax.debug.print for runtime values")
+            return
+        if (isinstance(f, ast.Name) and f.id in _CAST_BUILTINS
+                and len(call.args) == 1
+                and isinstance(call.args[0], ast.Name)
+                and call.args[0].id in self.traced_params):
+            self._flag(
+                call.lineno, "xp-jit-host-sync",
+                f"{f.id}({call.args[0].id}) on a traced parameter",
+                "concretizes the tracer — ConcretizationTypeError "
+                "at compile time; mark the argument static or keep "
+                "the math in jnp")
+
+
+def check(idx: ProjectIndex,
+          graph: Optional[CallGraph] = None,
+          only: Optional[Set[str]] = None) -> List:
+    from ..raylint import Finding
+
+    entries = find_entries(idx)
+    graph = graph or CallGraph(idx)
+    findings: List[Finding] = []
+    # (path, line, rule): report each site once, for the shortest chain
+    seen: Set[Tuple[str, int, str]] = set()
+
+    for entry in entries:
+        reach = graph.reachable([entry.fi.qual])
+        # static_argnums sanity on the entry itself
+        if only is None or entry.fi.path in only:
+            findings.extend(_check_static_args(entry, idx))
+        for qual, chain in sorted(reach.items()):
+            fi = idx.functions.get(qual)
+            if fi is None:
+                continue
+            # reachability stays global (a changed helper can be
+            # traced from an unchanged entry); only the scan of each
+            # reached body is scoped
+            if only is not None and fi.path not in only:
+                continue
+            for line, rule, msg in _TracedScan(fi, entry, chain).run():
+                key = (fi.path, line, rule)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(fi.path, line, rule, msg))
+
+    # unhashable literals at static positions of resolved entry calls
+    entry_by_qual = {e.fi.qual: e for e in entries}
+    for fi in idx.all_functions():
+        if only is not None and fi.path not in only:
+            continue
+        for n in ast.walk(fi.node):
+            if not isinstance(n, ast.Call):
+                continue
+            callee = idx.resolve_call(n.func, fi)
+            if callee is None:
+                continue
+            e = entry_by_qual.get(callee.qual)
+            if e is None or not e.static_argnums:
+                continue
+            for i in e.static_argnums:
+                if i < len(n.args) and isinstance(
+                        n.args[i], (ast.List, ast.Dict, ast.Set,
+                                    ast.ListComp, ast.DictComp,
+                                    ast.SetComp)):
+                    key = (fi.path, n.lineno, "xp-jit-static-args")
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(Finding(
+                        fi.path, n.lineno, "xp-jit-static-args",
+                        f"{callee.name}() marks argument {i} static "
+                        f"but this call passes an unhashable "
+                        f"literal there — jit raises TypeError on "
+                        f"the first call; pass a hashable "
+                        f"(tuple/frozen) value"))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings
+
+
+def _check_static_args(entry: JitEntry, idx: ProjectIndex) -> List:
+    from ..raylint import Finding
+
+    out: List[Finding] = []
+    fi = entry.fi
+    args = fi.node.args
+    pos = fi.param_names()
+    has_vararg = args.vararg is not None
+    for i in entry.static_argnums or []:
+        if i >= len(pos) and not has_vararg:
+            out.append(Finding(
+                fi.path, entry.line, "xp-jit-static-args",
+                f"static_argnums={i} but {fi.name}() has only "
+                f"{len(pos)} positional parameter(s) — IndexError "
+                f"at the first call (a reordered signature left the "
+                f"decorator behind?)"))
+    kw = {p.arg for p in args.kwonlyargs}
+    for name in entry.static_argnames or []:
+        if name not in pos and name not in kw:
+            out.append(Finding(
+                fi.path, entry.line, "xp-jit-static-args",
+                f"static_argnames={name!r} is not a parameter of "
+                f"{fi.name}() — the pin silently does nothing, so "
+                f"the intended-static argument retraces on every "
+                f"new value"))
+    return out

@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..raylint import _expr_key, _lockish
-from .index import FuncInfo, ProjectIndex
+from .index import FuncInfo, ProjectIndex, _child_stmts
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 _SKIP_NODES = _FUNC_NODES + (ast.ClassDef, ast.Lambda)
@@ -51,16 +51,22 @@ class FnLocks:
 
 def _local_names(fn: ast.AST) -> Set[str]:
     out: Set[str] = set()
-    for n in ast.walk(fn):
-        if isinstance(n, _SKIP_NODES) and n is not fn:
-            continue
-        if isinstance(n, ast.Assign):
-            for t in n.targets:
-                if isinstance(t, ast.Name):
-                    out.add(t.id)
-        elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
-            if isinstance(n.target, ast.Name):
-                out.add(n.target.id)
+    # assignments are statements — a statement-list walk (not a full
+    # AST walk) sees them all, skipping nested defs like before
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        for n in _child_stmts(node):
+            if isinstance(n, _SKIP_NODES):
+                continue
+            stack.append(n)
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+            elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+                if isinstance(n.target, ast.Name):
+                    out.add(n.target.id)
     args = getattr(fn, "args", None)
     if args is not None:
         out.update(p.arg for p in args.posonlyargs + args.args

@@ -1,0 +1,265 @@
+"""ObjectRef lifetime analysis: leaks and serialization anti-patterns.
+
+Every ObjectRef pins its object in the store until the ref is
+dropped AND consumed; a ref that is produced and then forgotten is a
+silent leak (the object lives until the producer process exits), and
+a ``get()`` per ref inside a loop serializes a fan-out behind one
+round-trip per element. Def-use over each function body (statement
+order, provenance from the dataflow engine) finds both:
+
+- **xp-ref-leak** — a ref produced by ``put()`` / ``.remote()`` that
+  is never *consumed*: not passed to ``get``/``wait``/``cancel``, not
+  passed as any call argument, not returned/yielded, not stored into
+  a container/attribute, not even loaded again. Two shapes fire:
+  a bare expression statement discarding the result
+  (``h.update.remote(x)`` fire-and-forget — the returned ref pins the
+  result object forever), and a bound name with zero later loads
+  anywhere in the function (nested defs count as loads). A load of
+  any kind counts as consumption — passing a ref onward transfers
+  ownership interprocedurally, and judging the consumer again there
+  keeps the rule per-function without losing the chain.
+  ``num_returns=0`` submissions return None and are exempt, and that
+  is also the documented fix for intentional fire-and-forget;
+  anything else intentional belongs in the baseline with a reason.
+- **xp-ref-get-in-loop** — ``for r in refs: ... get(r)`` where
+  ``refs`` is known (by provenance) to hold remote-call results —
+  a comprehension over ``.remote()``, a literal list of remote
+  calls, or a list that only ``append``s remote results: each
+  iteration blocks on one ref while the rest sit ready, so a fan-out
+  of N tasks costs N sequential round-trips. ``get(refs)`` fetches
+  them in one call (or ``wait()`` harvests them as they finish).
+
+Only refs born inside the function are judged — parameters and
+attributes may be owned elsewhere, and a whole-program ownership
+model would drown the rule in uncertainty.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .dataflow import (FuncInfo, RemoteResolver,
+                       _iter_calls, _resolve_name, _stmt_bodies)
+from .index import ProjectIndex
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SKIP_NODES = _FUNC_NODES + (ast.ClassDef, ast.Lambda)
+
+_RAY_MODULES = ("ray", "ray_tpu", "rt")
+
+
+def _bare_is_rays(name: str, scope: FuncInfo,
+                  idx: ProjectIndex) -> bool:
+    """A bare ``put``/``get`` name counts as the ray API only when
+    nothing in the project shadows it: a nested/module-level def named
+    ``put`` (the dashboard has one) must win over the import."""
+    r = _resolve_name(name, scope, idx)
+    if r is None:
+        return True       # imported from outside the index
+    return isinstance(r, FuncInfo) and r.path.endswith("__init__.py")
+
+
+def _is_put(call: ast.Call, scope: FuncInfo,
+            idx: ProjectIndex) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "put":
+        return _bare_is_rays("put", scope, idx)
+    return (isinstance(f, ast.Attribute) and f.attr == "put"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in _RAY_MODULES)
+
+
+def _is_remote(call: ast.Call) -> bool:
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "remote")
+
+
+def _is_get(call: ast.Call, scope: FuncInfo,
+            idx: ProjectIndex) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "get":
+        return _bare_is_rays("get", scope, idx)
+    return (isinstance(f, ast.Attribute) and f.attr == "get"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in _RAY_MODULES)
+
+
+class _FnScan:
+    """One statement-ordered pass over a function body."""
+
+    def __init__(self, fi: FuncInfo, resolver: RemoteResolver,
+                 idx: ProjectIndex):
+        self.fi = fi
+        self.resolver = resolver
+        self.idx = idx
+        self.findings: List[tuple] = []   # (line, rule, message)
+        # names that are known ref containers (lists of remote results)
+        self.ref_containers: Set[str] = set()
+        self.env = resolver.seed_env(fi)
+        # every Name load anywhere in the fn — a load in a nested
+        # def/lambda/comprehension still counts as a use, and an
+        # explicit `del r` is a deliberate early free, not a leak
+        self.all_loads = {
+            n.id for n in ast.walk(fi.node)
+            if isinstance(n, ast.Name)
+            and isinstance(n.ctx, (ast.Load, ast.Del))}
+
+    # -- classification -----------------------------------------------
+
+    def _ref_producing(self, value: ast.AST) -> Optional[str]:
+        """A description when `value` produces ObjectRef(s) whose
+        loss would leak, else None."""
+        if not isinstance(value, ast.Call):
+            return None
+        if _is_put(value, self.fi, self.idx):
+            return "put()"
+        if _is_remote(value):
+            site = self.resolver.site(value, self.fi, self.env)
+            if site is None:
+                return None       # unresolved: stay silent
+            if site.kind == "actor_create":
+                return None       # handles are not refs
+            nr = site.options.get("num_returns")
+            if isinstance(nr, ast.Constant) and nr.value == 0:
+                return None       # declared fire-and-forget
+            return f"{site.describe()}()"
+        return None
+
+    def _container_of_refs(self, value: ast.AST) -> bool:
+        if isinstance(value, (ast.ListComp, ast.SetComp)):
+            return self._yields_ref(value.elt)
+        if isinstance(value, (ast.List, ast.Set, ast.Tuple)):
+            return bool(value.elts) and all(
+                self._yields_ref(e) for e in value.elts)
+        return False
+
+    def _yields_ref(self, e: ast.AST) -> bool:
+        if isinstance(e, ast.Call) and (
+                _is_remote(e) or _is_put(e, self.fi, self.idx)):
+            return self._ref_producing(e) is not None
+        return False
+
+    # -- walk ---------------------------------------------------------
+
+    def run(self) -> List[tuple]:
+        self._walk(list(getattr(self.fi.node, "body", [])))
+        return self.findings
+
+    def _walk(self, stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, _SKIP_NODES):
+                continue
+            self._stmt(stmt)
+            for body in _stmt_bodies(stmt):
+                self._walk(body)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        for child in ast.iter_child_nodes(stmt):
+            if not isinstance(child, (ast.stmt, ast.excepthandler)):
+                self.resolver.bind_comps(self.env, child, self.fi)
+        if isinstance(stmt, ast.Assign):
+            self._bind(stmt)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            self.resolver.bind(self.env, stmt, self.fi)
+            return
+        if isinstance(stmt, ast.Expr):
+            v = stmt.value
+            self.resolver.bind_append(self.env, v, self.fi)
+            # `refs.append(f.remote(x))` grows a ref container
+            if (isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Attribute)
+                    and v.func.attr in ("append", "add")
+                    and isinstance(v.func.value, ast.Name)
+                    and v.args and self._yields_ref(v.args[0])):
+                self.ref_containers.add(v.func.value.id)
+                return
+            desc = self._ref_producing(v)
+            if desc is not None:
+                self.findings.append((
+                    stmt.lineno, "xp-ref-leak",
+                    f"result of {desc} is discarded — the returned "
+                    f"ObjectRef pins the task's result in the store "
+                    f"with no way to ever free or read it; bind and "
+                    f"consume the ref, or declare "
+                    f".options(num_returns=0) for true "
+                    f"fire-and-forget"))
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.resolver.bind_for(self.env, stmt, self.fi)
+            self._check_get_loop(stmt)
+
+    def _bind(self, stmt: ast.Assign) -> None:
+        v = stmt.value
+        # provenance first: handles/aliases/handle lists feed site()
+        self.resolver.bind(self.env, stmt, self.fi)
+        if isinstance(v, ast.Call) and _is_remote(v):
+            site = self.resolver.site(v, self.fi, self.env)
+            if site is not None and site.kind == "actor_create":
+                return
+        desc = self._ref_producing(v)
+        is_container = self._container_of_refs(v)
+        for tgt in stmt.targets:
+            if not isinstance(tgt, ast.Name):
+                continue        # stored into container/attr: consumed
+            self.ref_containers.discard(tgt.id)
+            if is_container:
+                self.ref_containers.add(tgt.id)
+            if desc is not None and tgt.id not in self.all_loads:
+                self.findings.append((
+                    stmt.lineno, "xp-ref-leak",
+                    f"ref from {desc} bound to `{tgt.id}` is never "
+                    f"consumed (no get/wait, never passed on, "
+                    f"returned, or stored) — the result object stays "
+                    f"pinned in the store until this process exits; "
+                    f"consume the ref or declare "
+                    f".options(num_returns=0)"))
+
+    def _check_get_loop(self, stmt: ast.stmt) -> None:
+        it = stmt.iter
+        iter_name = it.id if isinstance(it, ast.Name) else None
+        if iter_name is None or iter_name not in self.ref_containers:
+            return
+        tgt = stmt.target
+        if not isinstance(tgt, ast.Name):
+            return
+        for call in _iter_calls_in_body(stmt.body):
+            if not _is_get(call, self.fi, self.idx):
+                continue
+            if any(isinstance(a, ast.Name) and a.id == tgt.id
+                   for a in call.args):
+                self.findings.append((
+                    call.lineno, "xp-ref-get-in-loop",
+                    f"get({tgt.id}) inside a loop over "
+                    f"`{iter_name}` serializes the fan-out: each "
+                    f"iteration blocks on ONE ref while the rest sit "
+                    f"ready — fetch once with get({iter_name}), or "
+                    f"harvest completions with wait()"))
+                return
+
+
+def _iter_calls_in_body(stmts: List[ast.stmt]):
+    for stmt in stmts:
+        if isinstance(stmt, _SKIP_NODES):
+            continue
+        for child in ast.iter_child_nodes(stmt):
+            if not isinstance(child, ast.stmt):
+                yield from _iter_calls(child)
+        for body in _stmt_bodies(stmt):
+            yield from _iter_calls_in_body(body)
+
+
+def check(idx: ProjectIndex, resolver: Optional[RemoteResolver] = None,
+          only: Optional[Set[str]] = None) -> List:
+    from ..raylint import Finding
+
+    resolver = resolver or RemoteResolver(idx)
+    findings: List[Finding] = []
+    for fi in idx.all_functions():
+        if only is not None and fi.path not in only:
+            continue
+        for line, rule, msg in _FnScan(fi, resolver, idx).run():
+            findings.append(Finding(fi.path, line, rule, msg))
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings

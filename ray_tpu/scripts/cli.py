@@ -718,6 +718,10 @@ def cmd_raylint(args) -> int:
         argv.append("--proto-inventory")
     if args.out:
         argv += ["--out", args.out]
+    if args.changed_only is not None:
+        argv += ["--changed-only", args.changed_only]
+    if args.stats:
+        argv.append("--stats")
     return raylint.main(argv)
 
 
@@ -936,6 +940,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="print the wire-protocol inventory table")
     rl.add_argument("--out", default=None,
                     help="write the report to a file")
+    rl.add_argument("--changed-only", nargs="?", const="HEAD",
+                    default=None, metavar="BASE",
+                    help="restrict findings to files changed vs BASE "
+                         "(default HEAD); the whole program is still "
+                         "indexed")
+    rl.add_argument("--stats", action="store_true",
+                    help="print files-indexed/call-edge/per-analysis "
+                         "counts to stderr")
     rl.set_defaults(fn=cmd_raylint)
     return p
 

@@ -583,7 +583,9 @@ class ServeController:
                 # the severed connection at the next call — poke it so
                 # restart-with-replacement moves it to a survivor NOW.
                 try:
-                    r.stats.remote()
+                    # num_returns=0: the poke's result is meaningless —
+                    # a discarded ref would pin the stats dict forever
+                    r.stats.options(num_returns=0).remote()
                 except Exception:  # noqa: BLE001
                     pass
             if dead:

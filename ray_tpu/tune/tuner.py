@@ -367,11 +367,13 @@ class Tuner:
                                     item.metrics[tc.metric],
                                     config, tr.checkpoint)
                                 if decision == STOP:
-                                    worker.request_stop.remote()
+                                    worker.request_stop.options(
+                                        num_returns=0).remote()
                                 elif (isinstance(decision, tuple)
                                       and decision[0] == EXPLOIT):
                                     exploit = decision[1:]
-                                    worker.request_stop.remote()
+                                    worker.request_stop.options(
+                                        num_returns=0).remote()
                     finally:
                         try:
                             ray_kill(worker)

@@ -1,0 +1,4 @@
+# Fixture package: remote-call contract violations for raylint --xp.
+# bad.py calls @remote functions/actors with the wrong arity, unknown
+# kwargs, invalid .options keys, and num_returns/unpack mismatches;
+# clean.py makes the same calls correctly and must produce nothing.

@@ -1,0 +1,41 @@
+"""Every contract the checker owns, violated once."""
+
+from somewhere import method, remote
+
+
+@remote
+def add(a, b, *, scale=1.0):
+    return (a + b) * scale
+
+
+@remote(num_returns=2)
+def pair(x):
+    return x, x
+
+
+@remote
+class Worker:
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    @method(num_returns=2)
+    def split(self, x):
+        return x, x
+
+    def work(self, x, y=1):
+        return x + y
+
+
+def bad_calls():
+    r1 = add.remote(1, 2, 3)                 # arity: too many positional
+    r2 = add.remote(1, 2, bogus=3)           # unknown kwarg
+    r3 = add.remote(1)                       # missing required b
+    r4 = add.options(lifetime="detached").remote(1, 2)  # actor-only opt
+    r5 = add.options(frobnicate=1).remote(1, 2)         # unknown option
+    a, b = add.remote(1, 2)                  # num_returns=1, unpacked to 2
+    w = Worker.remote()                      # missing required cfg
+    q = w.work.remote(1, 2, 3)               # method arity
+    z = w.gone.remote()                      # no such method
+    v = w.work.options(max_restarts=2).remote(1)  # bad actor-method opt
+    x, y, zz = pair.remote(1)                # declared 2, unpacked to 3
+    return [r1, r2, r3, r4, r5, a, b, w, q, z, v, x, y, zz]

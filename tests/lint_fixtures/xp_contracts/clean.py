@@ -1,0 +1,38 @@
+"""The same API surface used correctly: zero findings expected."""
+
+from somewhere import method, remote
+
+
+@remote
+def add(a, b, *, scale=1.0):
+    return (a + b) * scale
+
+
+@remote(num_returns=2)
+def pair(x):
+    return x, x
+
+
+@remote
+class Worker:
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    @method(num_returns=2)
+    def split(self, x):
+        return x, x
+
+    def work(self, x, y=1):
+        return x + y
+
+
+def good_calls():
+    r1 = add.remote(1, 2)
+    r2 = add.remote(1, 2, scale=2.0)
+    r3 = add.options(num_cpus=1).remote(1, 2)
+    a, b = pair.remote(1)                       # declared 2, unpacked 2
+    w = Worker.remote({"k": 1})
+    q = w.work.remote(1, 2)
+    s1, s2 = w.split.remote(3)                  # @method default honored
+    v = w.work.options(num_returns=1, name="call").remote(1)
+    return [r1, r2, r3, a, b, w, q, s1, s2, v]

@@ -1,0 +1,5 @@
+# Fixture package: jit-purity / host-sync hazards for raylint --xp.
+# bad.py puts device->host syncs, trace-time mutation, and broken
+# static_argnums inside jit-traced code (including one sync reached
+# only through the call graph); clean.py keeps the math in jnp, uses
+# jax.debug.print, and declares statics correctly — zero findings.

@@ -1,0 +1,29 @@
+"""Host syncs, trace-time mutation, bad statics — one of each."""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@partial(jax.jit, static_argnums=(5,))       # index out of range
+def step(params, batch):
+    loss = float(params)                     # concretizes a traced arg
+    print(loss)                              # prints tracer reprs once
+    v = batch.item()                         # device->host sync
+    arr = np.asarray(params)                 # host materialization
+    return helper(arr) + v
+
+
+def helper(x):
+    return x.item()                          # reached via call graph
+
+
+COUNT = 0
+
+
+@jax.jit
+def impure(x):
+    global COUNT
+    COUNT = COUNT + 1                        # trace-time only mutation
+    return x

@@ -1,0 +1,30 @@
+"""Pure jitted code with correct statics: zero findings expected."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(2,))
+def scaled_loss(params, batch, power):
+    err = jnp.square(params - batch)
+    jax.debug.print("loss={l}", l=err.sum())     # runtime-safe print
+    return jnp.power(err.mean(), power)
+
+
+@partial(jax.jit, static_argnames=("reduce",))
+def reduce_loss(params, batch, reduce="mean"):
+    err = jnp.abs(params - batch)
+    return err.mean() if reduce == "mean" else err.sum()
+
+
+@jax.jit
+def update(params, grads):
+    return jax.tree_util.tree_map(
+        lambda p, g: p - 0.1 * g, params, grads)
+
+
+def driver(params, batch):
+    # literal at the STATIC position is fine; hashable as required
+    return scaled_loss(params, batch, 2)

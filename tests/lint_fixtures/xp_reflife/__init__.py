@@ -1,0 +1,5 @@
+# Fixture package: ObjectRef lifetime hazards for raylint --xp.
+# bad.py leaks refs (discarded put/.remote results, a never-consumed
+# binding) and serializes a fan-out with get-inside-a-loop; clean.py
+# shows the sanctioned shapes (consume, num_returns=0, del, batched
+# get, wait-harvest) and must produce nothing.

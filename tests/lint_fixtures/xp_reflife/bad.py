@@ -1,0 +1,22 @@
+"""Ref leaks and the serialized fan-out, one of each shape."""
+
+from somewhere import get, put, remote
+
+
+@remote
+def work(x):
+    return x * 2
+
+
+def leaks():
+    put(41)                                  # discarded put() ref
+    r = work.remote(1)                       # bound, never consumed
+    return None
+
+
+def serialized_fanout():
+    refs = [work.remote(i) for i in range(8)]
+    out = []
+    for ref in refs:
+        out.append(get(ref))                 # one blocking get per ref
+    return out

@@ -1,0 +1,48 @@
+"""Every sanctioned way to produce and retire a ref: zero findings."""
+
+from somewhere import get, put, remote, wait
+
+
+@remote
+def work(x):
+    return x * 2
+
+
+@remote
+class Sink:
+    def push(self, x):
+        return True
+
+
+def consumed():
+    r = work.remote(1)
+    return get(r)                            # consumed via get
+
+
+def forwarded(out):
+    r = work.remote(2)
+    out.append(r)                            # ownership transferred
+    ref = put(3)
+    return work.remote(ref)                  # passed as an argument
+
+
+def declared_fire_and_forget():
+    s = Sink.remote()
+    s.push.options(num_returns=0).remote(7)
+    return s
+
+
+def deliberate_free():
+    r = put(b"x" * 1024)
+    del r                                    # explicit early free
+
+
+def batched_fanout():
+    refs = [work.remote(i) for i in range(8)]
+    return get(refs)                         # one batched fetch
+
+
+def harvested_fanout():
+    refs = [work.remote(i) for i in range(8)]
+    done, _ = wait(refs, num_returns=len(refs))
+    return get(done)

@@ -428,3 +428,30 @@ class TestConcurrencyGroups:
 
         a = Aio.remote()
         assert ray.get(a.f.remote(), timeout=10) == "async-ok"
+
+
+def test_actor_fire_and_forget_returns_no_ref(ray_start):
+    """num_returns=0 on an actor-method call: the method still runs
+    but no ObjectRef is produced. This is the sanctioned
+    fire-and-forget shape — tune's stop requests and serve's
+    dead-node pokes rely on it; a bare discarded ref would pin the
+    result in the object store forever."""
+    ray = ray_start
+
+    @ray.remote
+    class Sink:
+        def __init__(self):
+            self.n = 0
+
+        def poke(self):
+            self.n += 1
+            return "ignored"
+
+        def value(self):
+            return self.n
+
+    s = Sink.remote()
+    assert s.poke.options(num_returns=0).remote() is None
+    assert s.poke.options(num_returns=0).remote() is None
+    # mailbox ordering: both pokes land before the value read
+    assert ray.get(s.value.remote()) == 2

@@ -69,3 +69,37 @@ def test_checkpoint_register_worst_score_returns_none(ray_start, tmp_path):
     assert worst is None  # evicted immediately — not handed back
     assert mgr.best() is not None
     assert os.path.exists(mgr.best().path)
+
+
+def test_fanout_reads_use_one_batched_get(ray_start, monkeypatch):
+    """count/to_pandas/materialize fetch all blocks with ONE
+    get(list) instead of one round-trip per block (regression: the
+    per-ref loop blocked on each block in submission order while
+    later ones sat ready)."""
+    import ray_tpu
+    import ray_tpu.data as rd
+
+    ds = rd.range(32, parallelism=4).materialize()  # pre-execute plan
+    real_get = ray_tpu.get
+    calls = []
+
+    def counting_get(refs, *a, **kw):
+        calls.append(refs)
+        return real_get(refs, *a, **kw)
+
+    monkeypatch.setattr(ray_tpu, "get", counting_get)
+
+    assert ds.count() == 32
+    assert len(calls) == 1 and isinstance(calls[0], list)
+
+    calls.clear()
+    df = ds.to_pandas()
+    assert len(df) == 32
+    assert len(calls) == 1 and isinstance(calls[0], list)
+
+    calls.clear()
+    mat = ds.materialize()
+    gets = [c for c in calls if isinstance(c, list)]
+    assert len(gets) == 1  # the block fetch itself is batched
+    monkeypatch.undo()
+    assert mat.count() == 32

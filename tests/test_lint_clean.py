@@ -38,6 +38,16 @@ def _active(path, select=None):
     return [f for f in lint_paths([path], select) if not f.suppressed]
 
 
+@pytest.fixture(scope="session")
+def xp_tree():
+    """One whole-program index/analysis run of ray_tpu/ shared by
+    every gate test — building the project index is the expensive
+    part, and the findings are pure functions of the tree."""
+    stats = {}
+    findings, inventory = run_xp([PKG], None, stats=stats)
+    return findings, inventory, stats
+
+
 def test_rule_registry_complete():
     expected = {
         "blocking-under-lock", "unguarded-handle-teardown",
@@ -232,20 +242,50 @@ def test_xp_rule_registry_complete():
         "xp-lock-order-inversion", "proto-orphan-sent",
         "proto-orphan-handled", "proto-missing-field",
         "stale-baseline",
+        "xp-remote-signature", "xp-remote-options",
+        "xp-remote-num-returns",
+        "xp-ref-leak", "xp-ref-get-in-loop",
+        "xp-jit-host-sync", "xp-jit-impure-mutation",
+        "xp-jit-static-args",
     }
     assert expected <= set(XP_RULES), sorted(XP_RULES)
     # the registries must not collide: one namespace for --select
     assert not set(XP_RULES) & set(RULES)
+    # every analysis claims only registered rules, and the dataflow
+    # trio are all claimed by exactly one analysis
+    from ray_tpu.devtools.xp import ANALYSIS_RULES
+
+    claimed = [r for rules in ANALYSIS_RULES.values() for r in rules]
+    assert len(claimed) == len(set(claimed))
+    assert set(claimed) <= set(XP_RULES)
+    for name in ("contracts", "reflife", "jitlint"):
+        assert ANALYSIS_RULES[name], name
 
 
-def test_xp_tree_is_clean():
+def test_xp_tree_is_clean(xp_tree):
     """ray_tpu/ has zero unbaselined whole-program findings — the core
     acceptance gate for the xp passes."""
-    findings, _ = run_xp([PKG], None)
+    findings, _, _ = xp_tree
+    findings = list(findings)
     findings += apply_baseline(findings, default_baseline_path())
     active = [f for f in findings if not f.suppressed]
     assert not active, "raylint --xp findings in ray_tpu/:\n" + "\n".join(
         f.render() for f in active)
+
+
+def test_xp_stats_populated(xp_tree):
+    """--stats plumbing: the run fills index size, call-graph edge
+    count, and a per-analysis findings ledger."""
+    _, _, stats = xp_tree
+    assert stats["files"] > 100
+    assert stats["call_edges"] > 1000
+    for name in ("lockgraph", "protocol", "contracts", "reflife",
+                 "jitlint"):
+        assert name in stats["analyses"], sorted(stats["analyses"])
+        # pre-suppression kept-finding count; suppression splits are
+        # computed downstream by _render_stats
+        assert isinstance(stats["analyses"][name], int)
+        assert stats["analyses"][name] >= 0
 
 
 def test_xp_lock_inversion_fires_cross_file():
@@ -279,11 +319,11 @@ def test_xp_protocol_rules_fire():
     assert {"orphan_cmd", "task", "never_sent"} <= types
 
 
-def test_xp_inventory_accounts_for_control_plane():
+def test_xp_inventory_accounts_for_control_plane(xp_tree):
     """The protocol pass must see the real control-plane vocabulary —
     if a refactor renames send helpers out of its reach, this fails
     instead of the gate silently going blind."""
-    _, inventory = run_xp([PKG], None)
+    _, inventory, _ = xp_tree
     types = {row["type"] for row in inventory}
     expected = {"task", "actor_create", "actor_call", "ping", "pong",
                 "shutdown", "gen_ack", "gen_item", "hello", "result",
@@ -303,14 +343,14 @@ def test_xp_inventory_accounts_for_control_plane():
             and by_type["pull_complete"]["handlers"])
 
 
-def test_xp_inventory_marks_native_plane():
+def test_xp_inventory_marks_native_plane(xp_tree):
     """Dispatch-socket ops the C++ front end (src/node_dispatch.cc)
     also implements must carry the static native-plane annotation —
     the AST pass can't see C++, and an unannotated native op would
     make the inventory lie about which plane answers it."""
     from ray_tpu.devtools.xp.protocol import NATIVE_PLANE
 
-    _, inventory = run_xp([PKG], None)
+    _, inventory, _ = xp_tree
     by_type = {row["type"]: row for row in inventory}
     for t in ("ping", "pong", "task", "result"):
         assert t in NATIVE_PLANE
@@ -372,16 +412,106 @@ def test_xp_sarif_json_round_trip():
     assert locs == {(_rel(f.path), f.line) for f in findings}
 
 
+def test_xp_contract_rules_fire():
+    """Every remote-call contract violation in the fixture is caught;
+    the correct twin file stays silent."""
+    findings, _ = run_xp([os.path.join(FIXTURES, "xp_contracts")],
+                         None)
+    bad = [f for f in findings if f.path.endswith("bad.py")]
+    by_rule = {}
+    for f in bad:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert len(by_rule.get("xp-remote-signature", [])) == 6, (
+        [f.render() for f in bad])
+    assert len(by_rule.get("xp-remote-options", [])) == 3
+    assert len(by_rule.get("xp-remote-num-returns", [])) == 2
+    # the renamed-method drift class calls out the missing method
+    drift = [f for f in by_rule["xp-remote-signature"]
+             if "defines no method" in f.message]
+    assert len(drift) == 1 and "'gone'" in drift[0].message
+    clean = [f for f in findings if f.path.endswith("clean.py")]
+    assert not clean, [f.render() for f in clean]
+
+
+def test_xp_reflife_rules_fire():
+    """Both leak shapes and the serialized fan-out are caught; every
+    sanctioned consumption shape in the clean twin stays silent."""
+    findings, _ = run_xp([os.path.join(FIXTURES, "xp_reflife")], None)
+    bad = [f for f in findings if f.path.endswith("bad.py")]
+    leaks = [f for f in bad if f.rule == "xp-ref-leak"]
+    assert len(leaks) == 2, [f.render() for f in bad]
+    assert any("discarded" in f.message for f in leaks)
+    assert any("`r`" in f.message for f in leaks)
+    loops = [f for f in bad if f.rule == "xp-ref-get-in-loop"]
+    assert len(loops) == 1 and "get(refs)" in loops[0].message
+    clean = [f for f in findings if f.path.endswith("clean.py")]
+    assert not clean, [f.render() for f in clean]
+
+
+def test_xp_jitlint_rules_fire():
+    """Host syncs (incl. one reached only via the call graph), the
+    trace-time mutation, and the broken static_argnums are caught; the
+    pure twin with jax.debug.print stays silent."""
+    findings, _ = run_xp([os.path.join(FIXTURES, "xp_jit")], None)
+    bad = [f for f in findings if f.path.endswith("bad.py")]
+    by_rule = {}
+    for f in bad:
+        by_rule.setdefault(f.rule, []).append(f)
+    syncs = by_rule.get("xp-jit-host-sync", [])
+    assert len(syncs) == 5, [f.render() for f in bad]
+    assert any("traced via" in f.message for f in syncs), (
+        "interprocedural sync (helper reached through the call graph) "
+        "must carry its call chain")
+    assert len(by_rule.get("xp-jit-impure-mutation", [])) == 1
+    statics = by_rule.get("xp-jit-static-args", [])
+    assert len(statics) == 1 and "only 2 positional" in statics[0].message
+    clean = [f for f in findings if f.path.endswith("clean.py")]
+    assert not clean, [f.render() for f in clean]
+
+
+def test_rule_doc_inventory_complete():
+    """docs/LINTS.md inventories every registered rule id — the
+    rule-doc-registry meta-rule enforces this on raylint.py, and this
+    test enforces it directly so a deleted doc fails loudly instead of
+    making the meta-rule silently vacuous."""
+    doc = os.path.join(REPO, "docs", "LINTS.md")
+    assert os.path.exists(doc), "docs/LINTS.md missing"
+    inv = raylint._lints_inventory(PKG)
+    assert inv is not None
+    every = (set(RULES) | set(XP_RULES)
+             | {"unjustified-suppression", "parse-error"})
+    missing = sorted(every - inv)
+    assert not missing, f"rules not documented in docs/LINTS.md: {missing}"
+
+
+def test_changed_only_restricts_report():
+    """--changed-only <base> keeps whole-program indexing but filters
+    the report to changed files; with base == HEAD the set is small,
+    and the flag must not break the exit-code contract."""
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.raylint", PKG,
+         "--xp", "--changed-only", "HEAD", "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode in (0, 1), r.stdout + r.stderr
+    assert "Traceback" not in r.stderr
+    report = json.loads(r.stdout)
+    changed = raylint.changed_files([PKG], "HEAD")
+    if changed is not None:     # not a git checkout -> filter disabled
+        for f in report["findings"]:
+            assert os.path.abspath(os.path.join(REPO, f["path"])) \
+                in changed, f
+
+
 def test_xp_cli_emits_sarif_artifact():
-    """The tier-1 gate run: `raylint ray_tpu --xp --format sarif --out`
-    exits 0 on the baselined tree and leaves a parseable artifact next
-    to the tier-1 log."""
+    """The tier-1 gate run: `raylint ray_tpu --xp --stats --format
+    sarif --out` exits 0 on the baselined tree, leaves a parseable
+    artifact next to the tier-1 log, and prints the stats summary."""
     out = "/tmp/_t1_raylint.sarif"
     if os.path.exists(out):
         os.unlink(out)
     r = subprocess.run(
         [sys.executable, "-m", "ray_tpu.devtools.raylint", PKG,
-         "--xp", "--format", "sarif", "--out", out],
+         "--xp", "--stats", "--format", "sarif", "--out", out],
         capture_output=True, text=True, cwd=REPO)
     assert r.returncode == 0, r.stdout + r.stderr
     with open(out, "r", encoding="utf-8") as f:
@@ -392,6 +522,10 @@ def test_xp_cli_emits_sarif_artifact():
     suppressed = [res for res in sarif["runs"][0]["results"]
                   if res.get("suppressions")]
     assert suppressed, "expected baselined findings in the artifact"
+    # --stats lands on stderr so the SARIF on stdout stays parseable
+    assert "files indexed" in r.stderr and "call edges" in r.stderr
+    for name in ("contracts", "reflife", "jitlint"):
+        assert name in r.stderr, r.stderr
 
 
 def test_xp_proto_inventory_cli():
