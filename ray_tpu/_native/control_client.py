@@ -23,15 +23,15 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 _BIN = os.path.join(os.path.dirname(__file__), "control_plane")
 
 # Ops — keep in sync with control_plane.cc.
-OP_PING = 0
+OP_PING = 0  # cxx-const: OP_PING
 OP_KV_PUT, OP_KV_GET, OP_KV_DEL, OP_KV_KEYS, OP_KV_EXISTS = 1, 2, 3, 4, 5
 OP_SUBSCRIBE, OP_UNSUBSCRIBE, OP_PUBLISH = 10, 11, 12
 OP_REGISTER_NODE, OP_HEARTBEAT, OP_LIST_NODES, OP_DRAIN_NODE = 20, 21, 22, 23
 OP_REGISTER_ACTOR, OP_UPDATE_ACTOR, OP_GET_ACTOR = 30, 31, 32
 OP_LIST_ACTORS, OP_GET_NAMED_ACTOR = 33, 34
 OP_ADD_JOB, OP_LIST_JOBS = 40, 41
-OP_STATS = 50
-OP_SNAPSHOT = 60
+OP_STATS = 50  # cxx-const: OP_STATS
+OP_SNAPSHOT = 60  # cxx-const: OP_SNAPSHOT
 
 ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_BAD_REQUEST = 0, 1, 2, 3
 
@@ -175,11 +175,11 @@ class ControlClient:
     def _read_loop(self) -> None:
         try:
             while not self._closed.is_set():
-                (length,) = struct.unpack("<I", self._read_exact(4))
+                (length,) = struct.unpack("<I", self._read_exact(4))  # cxx-wire: cp-frame-len
                 body = self._read_exact(length)
                 ftype = body[0]
                 if ftype == 0:  # response
-                    (req_id,) = struct.unpack_from("<Q", body, 1)
+                    (req_id,) = struct.unpack_from("<Q", body, 1)  # cxx-wire: cp-req-id
                     with self._plock:
                         resp = self._pending.pop(req_id, None)
                     if resp is not None:

@@ -15,7 +15,9 @@ from typing import Optional, Sequence
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libmetrics.so")
 
-KIND_COUNTER, KIND_GAUGE, KIND_HISTOGRAM = 0, 1, 2
+KIND_COUNTER = 0  # cxx-const: KIND_COUNTER
+KIND_GAUGE = 1  # cxx-const: KIND_GAUGE
+KIND_HISTOGRAM = 2  # cxx-const: KIND_HISTOGRAM
 
 _lib = None
 

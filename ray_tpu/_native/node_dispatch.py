@@ -25,9 +25,9 @@ _LIB_PATH = os.path.join(os.path.dirname(__file__),
 
 _lib = None
 
-# Event flags (mirrors node_dispatch.cc).
-FLAG_PRECHARGED = 1
-FLAG_JSON = 2
+# Event flags (mirrors node_dispatch.cc; raylint --xp checks the pins).
+FLAG_PRECHARGED = 1  # cxx-const: kFlagPrecharged
+FLAG_JSON = 2  # cxx-const: kFlagJson
 
 EV_MESSAGE = 0
 EV_CLOSED = 1

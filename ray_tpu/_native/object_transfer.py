@@ -21,6 +21,8 @@ from .shm_store import ID_LEN
 _LIB_PATH = os.path.join(os.path.dirname(__file__),
                          "libobject_transfer.so")
 
+OP_PULL2 = 4  # cxx-const: OP_PULL2
+
 _lib = None
 
 
@@ -161,13 +163,13 @@ def fetch_object_bytes(host: str, port: int, object_id: bytes,
     with _socket.create_connection((host, port),
                                    timeout=timeout) as sock:
         sock.settimeout(timeout)
-        sock.sendall(bytes([4]) + object_id)  # OP_PULL2
-        (total,) = _struct.unpack("<q", _recv_exact(sock, 8))
+        sock.sendall(bytes([OP_PULL2]) + object_id)
+        (total,) = _struct.unpack("<q", _recv_exact(sock, 8))  # cxx-wire: rto-pull2-total
         if total < 0:
             return None
         out = bytearray()
         while len(out) < total:
-            (ln,) = _struct.unpack("<I", _recv_exact(sock, 4))
+            (ln,) = _struct.unpack("<I", _recv_exact(sock, 4))  # cxx-wire: rto-pull2-chunk
             if ln == 0xFFFFFFFF:  # kErrFrame: source failed mid-relay
                 raise TransferError("sender aborted mid-stream")
             out += _recv_exact(sock, ln)

@@ -20,7 +20,7 @@ from .handle_guard import HandleGuard
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libshm_store.so")
 
-ID_LEN = 28
+ID_LEN = 28  # cxx-const: kIdLen
 
 
 class ShmStoreError(Exception):

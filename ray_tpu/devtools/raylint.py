@@ -1074,8 +1074,12 @@ def _render_stats(xp_stats: Optional[dict],
              f"({per_file[1]} suppressed)"]
     if xp_stats is not None:
         from .xp import ANALYSIS_RULES
-        parts.insert(0, f"{xp_stats.get('files', 0)} files indexed, "
-                        f"{xp_stats.get('call_edges', 0)} call edges")
+        head = (f"{xp_stats.get('files', 0)} files indexed, "
+                f"{xp_stats.get('call_edges', 0)} call edges")
+        if "cxx_files" in xp_stats:
+            head += (f", {xp_stats['cxx_files']} C++ file(s) "
+                     f"({xp_stats.get('cxx_exports', 0)} exports)")
+        parts.insert(0, head)
         owner = {r: a for a, rs in ANALYSIS_RULES.items() for r in rs}
         per: Dict[str, List[int]] = {}
         for f in findings:
@@ -1167,8 +1171,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "linting everything", file=sys.stderr)
         elif run_xp_passes and not args.select:
             print("raylint: --changed-only: graph analyses "
-                  "(lockgraph/protocol) deferred to the full run; "
-                  "pass --select to force them", file=sys.stderr)
+                  "(lockgraph/protocol/cross-language) deferred to "
+                  "the full run; pass --select to force them",
+                  file=sys.stderr)
 
     per_file_select = ([s for s in select if s in RULES]
                        if select else None)
@@ -1195,10 +1200,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings.extend(apply_baseline(findings, baseline))
     if changed is not None:
         # whole-program passes still indexed everything; the REPORT is
-        # what narrows to the diff (stale-baseline rows included —
-        # they belong to full runs, not the pre-commit path)
+        # what narrows to the diff. stale-baseline rows are dropped
+        # outright: with the graph analyses deferred, their findings
+        # are absent and every graph-rule entry would read as stale —
+        # baseline hygiene belongs to full runs, not pre-commit.
         findings = [f for f in findings
-                    if os.path.abspath(f.path) in changed]
+                    if os.path.abspath(f.path) in changed
+                    and f.rule != "stale-baseline"]
     if args.stats:
         print(_render_stats(xp_stats if run_xp_passes else None,
                             findings), file=sys.stderr)

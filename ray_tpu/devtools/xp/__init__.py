@@ -33,6 +33,15 @@ per-function lock summaries) and runs the analyses that need it:
   graph walked from every ``jax.jit``/``pjit`` entry point; device->
   host syncs, Python-side mutation under trace, and broken
   ``static_argnums`` pins flag with the traced call chain attached.
+- the **native-boundary analyses** (``ffi_sig``/``ffi_layout``/
+  ``xlang``): a clang-free C++ extractor (:mod:`.cxx`) parses the
+  ``extern "C"`` surface, struct layouts, lock/blocking behavior and
+  message dispatch of ``src/``+``cpp/``; :mod:`.ffi` checks every
+  ctypes ``argtypes``/``restype`` declaration, ``Structure`` mirror,
+  pinned constant and wire-frame format against it, and derives the
+  ``NATIVE_PLANE`` protocol annotation instead of trusting it;
+  :func:`.lockgraph.check_xlang` propagates held-lock sets across the
+  boundary in both directions.
 
 Whole-program findings cannot be suppressed with inline comments (no
 single line owns them); the checked-in baseline
@@ -90,10 +99,30 @@ XP_RULES: Dict[str, str] = {
     "xp-jit-static-args":
         "static_argnums/static_argnames out of range, naming a "
         "missing parameter, or receiving an unhashable literal",
+    "xp-ffi-signature":
+        "a ctypes argtypes/restype declaration that disagrees with "
+        "the parsed extern \"C\" signature (arity, width/signedness, "
+        "pointer-vs-value, undeclared export, or a call with no "
+        "declaration at all)",
+    "xp-ffi-layout":
+        "a ctypes.Structure mirror, # cxx-const pin, or # cxx-wire "
+        "frame format that drifted from the C++ struct layout, "
+        "constant value, or wire annotation",
+    "xp-xlang-protocol":
+        "a NATIVE_PLANE annotation key no C++ dispatch arm mentions "
+        "(stale), or a natively-dispatched type the annotation "
+        "misses",
+    "xp-xlang-lock":
+        "a lock held across the FFI boundary into unbounded blocking "
+        "(Python lock -> joining native export; C++ mutex -> "
+        "PyGILState_Ensure)",
     "stale-baseline":
         "a baseline entry that no longer matches any finding",
     "xp-parse-error":
         "a file the whole-program index could not parse",
+    "cxx-parse-error":
+        "an extern \"C\" declaration the C++ extractor could not "
+        "parse (the boundary checks are blind to it)",
 }
 
 # analysis name -> the rule ids it owns (drives --select routing and
@@ -107,7 +136,14 @@ ANALYSIS_RULES: Dict[str, frozenset] = {
     "reflife": frozenset({"xp-ref-leak", "xp-ref-get-in-loop"}),
     "jitlint": frozenset({"xp-jit-host-sync", "xp-jit-impure-mutation",
                           "xp-jit-static-args"}),
+    "ffi_sig": frozenset({"xp-ffi-signature"}),
+    "ffi_layout": frozenset({"xp-ffi-layout"}),
+    "xlang": frozenset({"xp-xlang-protocol", "xp-xlang-lock"}),
 }
+
+# rules that need the C++ side parsed at all
+_CXX_RULES = (ANALYSIS_RULES["ffi_sig"] | ANALYSIS_RULES["ffi_layout"]
+              | ANALYSIS_RULES["xlang"] | {"cxx-parse-error"})
 
 __all__ = [
     "XP_RULES", "ANALYSIS_RULES", "ProjectIndex", "run_xp",
@@ -143,7 +179,7 @@ def run_xp(paths: Iterable[str], select: Optional[Iterable[str]] = None,
     those files; the graph analyses (lockgraph/protocol) still run in
     full, since their table builds are their scans."""
     from ..raylint import Finding  # late import; raylint imports us too
-    from . import contracts, jitlint, reflife
+    from . import contracts, cxx, ffi, jitlint, reflife
     from .dataflow import CallGraph, RemoteResolver
 
     wanted = set(select) if select else set(XP_RULES)
@@ -171,12 +207,45 @@ def run_xp(paths: Iterable[str], select: Optional[Iterable[str]] = None,
         # path they are skipped (the tier-1 gate runs them in full).
         # An explicit --select overrides the skip.
         run_graph = only is None or select is not None
+        cxx_idx = None
+        if (_CXX_RULES | ANALYSIS_RULES["protocol"]) & wanted \
+                and run_graph:
+            cxx_idx = cxx.build(root)
+            if stats is not None:
+                stats["cxx_files"] = (stats.get("cxx_files", 0)
+                                      + len(cxx_idx.files))
+                stats["cxx_exports"] = (
+                    stats.get("cxx_exports", 0)
+                    + sum(1 for n in cxx_idx.functions
+                          if (f := cxx_idx.lookup(n)) is not None
+                          and f.exported))
+            if "cxx-parse-error" in wanted:
+                for path, line, msg in cxx_idx.errors:
+                    findings.append(
+                        Finding(path, line, "cxx-parse-error", msg))
+        lock_scans = None
+        if (ANALYSIS_RULES["lockgraph"]
+                | ANALYSIS_RULES["xlang"]) & wanted and run_graph:
+            lock_scans = lockgraph.scan_all(idx)
         if ANALYSIS_RULES["lockgraph"] & wanted and run_graph:
-            record("lockgraph", lockgraph.check(idx))
+            record("lockgraph", lockgraph.check(idx, scans=lock_scans))
         if ANALYSIS_RULES["protocol"] & wanted and run_graph:
-            pfind, inv = protocol.check(idx)
+            pfind, inv = protocol.check(idx, cxx_idx=cxx_idx)
             record("protocol", pfind)
             inventory.extend(inv)
+        if _CXX_RULES & wanted and run_graph and cxx_idx is not None:
+            pyscan = ffi.scan_python(idx)
+            if ANALYSIS_RULES["ffi_sig"] & wanted:
+                record("ffi_sig",
+                       ffi.check_signatures(idx, cxx_idx, pyscan))
+            if ANALYSIS_RULES["ffi_layout"] & wanted:
+                record("ffi_layout",
+                       ffi.check_layouts(idx, cxx_idx, pyscan))
+            if ANALYSIS_RULES["xlang"] & wanted:
+                xl = ffi.check_protocol(idx, cxx_idx, pyscan)
+                xl += lockgraph.check_xlang(idx, cxx_idx,
+                                            scans=lock_scans)
+                record("xlang", xl)
         resolver = None
         if (ANALYSIS_RULES["contracts"] | ANALYSIS_RULES["reflife"]) \
                 & wanted:

@@ -1,0 +1,739 @@
+"""Heuristic C/C++ extractor for the native-boundary analyses.
+
+``src/*.cc`` is a plain C ABI behind ~100 hand-written ctypes
+declarations; nothing checks the two sides against each other until a
+stress run (or an outage) does. This module parses just enough C++ —
+without a clang dependency, which the image does not carry — to feed
+the cross-language passes in :mod:`.ffi` and :mod:`.lockgraph`:
+
+- ``extern "C"`` blocks: exported function signatures (return type,
+  parameter types classified by width/signedness/pointer depth);
+- struct definitions whose fields are all fixed-width (layout mirrors
+  for ``ctypes.Structure`` / ``struct.pack`` checking);
+- integer constants (``constexpr``/``const``/``#define``/enums) so a
+  Python literal can be pinned to its C++ twin with ``# cxx-const:``;
+- ``// cxx-wire: <name> <fmt>`` frame annotations next to the C++
+  read/write code, referenced from Python with ``# cxx-wire:``;
+- the message-type string dispatch in each handler loop (``mtype ==
+  "ping"``) and natively-constructed ``{"type": ...}`` reply
+  literals, from which ``protocol.NATIVE_PLANE`` is derived;
+- per-function ``std::mutex`` acquisitions plus unbounded blocking
+  ops (thread joins, untimed condition-variable waits) and
+  ``PyGILState_Ensure`` calls, for cross-boundary lock propagation.
+
+The parser is deliberately shallow: it understands the disciplined
+C++ this tree writes (and the fixtures exercise), not the language.
+An ``extern "C"`` declaration it cannot parse is an error — surfaced
+as ``cxx-parse-error`` under ``raylint --xp`` and a non-zero exit
+from ``make -C src lint`` — so drift toward unparseable exports is
+loud instead of silently unchecked.
+
+Pure stdlib, importable standalone: ``make -C src lint`` runs this
+file directly (``python3 .../cxx.py <dirs-or-files>``) without
+importing the ``ray_tpu`` package.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+_EXTS = (".c", ".cc", ".cpp", ".cxx", ".h", ".hpp")
+
+# ---------------------------------------------------------------------------
+# Type model
+# ---------------------------------------------------------------------------
+
+# kind: "void" | "int" | "uint" | "float" | "ptr" | "opaque"
+#   "int"/"uint": width in bits; width 8 is the sign-agnostic byte
+#   class (char / uint8_t — interchangeable across the FFI).
+#   "ptr": pointee is another CType ("opaque" pointee for types we do
+#   not model, e.g. a forward-declared struct).
+
+
+@dataclass(frozen=True)
+class CType:
+    kind: str
+    width: int = 0
+    pointee: Optional["CType"] = None
+    spelled: str = ""
+
+    def pretty(self) -> str:
+        return self.spelled or self.kind
+
+
+_BASE_TYPES: Dict[str, Tuple[str, int]] = {
+    "void": ("void", 0),
+    "bool": ("uint", 8),
+    "char": ("int", 8),
+    "int8_t": ("int", 8),
+    "uint8_t": ("uint", 8),
+    "short": ("int", 16),
+    "int16_t": ("int", 16),
+    "uint16_t": ("uint", 16),
+    "int": ("int", 32),
+    "int32_t": ("int", 32),
+    "uint32_t": ("uint", 32),
+    "long": ("int", 64),          # LP64 — the only ABI this tree runs
+    "int64_t": ("int", 64),
+    "long long": ("int", 64),
+    "uint64_t": ("uint", 64),
+    "unsigned": ("uint", 32),
+    "unsigned int": ("uint", 32),
+    "unsigned char": ("uint", 8),
+    "unsigned short": ("uint", 16),
+    "unsigned long": ("uint", 64),
+    "unsigned long long": ("uint", 64),
+    "size_t": ("uint", 64),
+    "ssize_t": ("int", 64),
+    "intptr_t": ("int", 64),
+    "uintptr_t": ("uint", 64),
+    "float": ("float", 32),
+    "double": ("float", 64),
+}
+
+
+def parse_ctype(text: str) -> Optional[CType]:
+    """Parse a C parameter/return type spelling into a CType."""
+    spelled = " ".join(text.replace("*", " * ").split())
+    toks = spelled.split()
+    stars = toks.count("*")
+    toks = [t for t in toks
+            if t not in ("*", "const", "volatile", "struct", "enum")]
+    if not toks:
+        return None
+    base = " ".join(toks)
+    if base in _BASE_TYPES:
+        kind, width = _BASE_TYPES[base]
+        out = CType(kind, width, spelled=base)
+    elif re.fullmatch(r"[A-Za-z_][\w:<>]*", base):
+        out = CType("opaque", spelled=base)
+    else:
+        return None
+    for _ in range(stars):
+        out = CType("ptr", 64, pointee=out, spelled=spelled)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Extracted entities
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CFunc:
+    name: str
+    path: str
+    line: int
+    ret: CType
+    params: List[CType]
+    param_names: List[str]
+    is_definition: bool = False
+    # `static` helpers inside an extern "C" block have internal
+    # linkage: scanned for lock/blocking propagation, never part of
+    # the exported ABI surface
+    exported: bool = True
+    # mutex identities this body locks (lock_guard/unique_lock/
+    # pthread_mutex_lock arguments, normalized to the member name)
+    locks: List[str] = field(default_factory=list)
+    # unbounded blocking ops: (description, line)
+    blocking: List[Tuple[str, int]] = field(default_factory=list)
+    # calls PyGILState_Ensure (re-enters the interpreter)
+    gil_line: int = 0
+    calls: List[str] = field(default_factory=list)
+
+    def sig(self) -> str:
+        return (f"{self.ret.pretty()} {self.name}("
+                + ", ".join(p.pretty() for p in self.params) + ")")
+
+
+@dataclass
+class CField:
+    name: str
+    ctype: CType
+    count: int = 1          # >1 for fixed-size arrays
+    line: int = 0
+
+
+@dataclass
+class CStruct:
+    name: str
+    path: str
+    line: int
+    fields: List[CField]
+    mirrorable: bool        # every field fixed-width (no ptr/opaque)
+
+
+@dataclass
+class CxxIndex:
+    files: List[str] = field(default_factory=list)
+    # symbol -> every extern "C" occurrence (definitions + hand-copied
+    # declarations in harnesses/clients — drift between them is a
+    # finding in ffi.check_signatures)
+    functions: Dict[str, List[CFunc]] = field(default_factory=dict)
+    structs: Dict[str, CStruct] = field(default_factory=dict)
+    constants: Dict[str, Tuple[int, str, int]] = field(
+        default_factory=dict)                  # name -> (value, path, line)
+    wire: Dict[str, Tuple[str, str, int]] = field(
+        default_factory=dict)                  # name -> (fmt, path, line)
+    # message types the native plane dispatches on / constructs, keyed
+    # by type -> (path, line). `dispatch` covers ==/!= string compares
+    # in handler loops; `sent` covers {"type": ...} literals the C++
+    # itself builds. `surface_sent` restricts `sent` to files that
+    # also dispatch (the native *plane*, not mere C++ clients).
+    dispatch: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    sent: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    surface_sent: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    errors: List[Tuple[str, int, str]] = field(default_factory=list)
+
+    def lookup(self, name: str) -> Optional[CFunc]:
+        """The definition if one was parsed, else the first decl."""
+        occ = self.functions.get(name)
+        if not occ:
+            return None
+        for f in occ:
+            if f.is_definition:
+                return f
+        return occ[0]
+
+
+# ---------------------------------------------------------------------------
+# Lexing helpers
+# ---------------------------------------------------------------------------
+
+
+def _blank(text: str) -> str:
+    """Comments and string/char-literal contents replaced by spaces
+    (newlines preserved) so structural parsing never trips on either."""
+    out = list(text)
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            while i < n and text[i] != "\n":
+                out[i] = " "
+                i += 1
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            out[i] = out[i + 1] = " "
+            i += 2
+            while i < n and not (text[i] == "*" and i + 1 < n
+                                 and text[i + 1] == "/"):
+                if text[i] != "\n":
+                    out[i] = " "
+                i += 1
+            if i < n:
+                out[i] = out[i + 1] = " "
+                i += 2
+        elif c in "\"'":
+            quote = c
+            i += 1
+            while i < n and text[i] != quote:
+                if text[i] == "\\":
+                    out[i] = " "
+                    i += 1
+                if i < n and text[i] != "\n":
+                    out[i] = " "
+                i += 1
+            i += 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+def _match_brace(text: str, open_pos: int) -> int:
+    """Index just past the brace matching text[open_pos] ('{')."""
+    depth = 0
+    for i in range(open_pos, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(text)
+
+
+def _lineno(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+_INT_RE = re.compile(r"^(0[xX][0-9a-fA-F]+|\d+)[uUlL]*$")
+
+
+def _const_value(expr: str) -> Optional[int]:
+    """Evaluate the constant-expression subset this tree uses:
+    integer literals and `A << B` shifts of them."""
+    expr = expr.strip().rstrip(";").strip()
+    if "<<" in expr:
+        lhs, _, rhs = expr.partition("<<")
+        a, b = _const_value(lhs), _const_value(rhs)
+        return a << b if a is not None and b is not None else None
+    expr = expr.strip("() ")
+    m = _INT_RE.match(expr)
+    if not m:
+        return None
+    return int(m.group(1), 0)
+
+
+# ---------------------------------------------------------------------------
+# Per-file parsing
+# ---------------------------------------------------------------------------
+
+# matched against the blanked text, where the literal's content has
+# been replaced by a space — accept both spellings
+_EXTERN_RE = re.compile(r'extern\s+"[C ]"')
+_LOCK_RE = re.compile(
+    r"std::(?:lock_guard|unique_lock|scoped_lock)\s*<[^>]*>\s*\w+"
+    r"\s*[({]\s*([^,)}]+)[,)}]")
+_PTHREAD_LOCK_RE = re.compile(r"pthread_mutex_lock\s*\(\s*([^)]+)\)")
+_JOIN_RE = re.compile(r"\b([\w.>-]+)\.join\s*\(\s*\)")
+_CV_WAIT_RE = re.compile(r"\b([\w.>-]+)\.wait\s*\(")
+_PTHREAD_WAIT_RE = re.compile(r"\bpthread_cond_wait\s*\(")
+_GIL_RE = re.compile(r"\bPyGILState_Ensure\s*\(")
+_CALL_RE = re.compile(r"\b([A-Za-z_]\w*)\s*\(")
+_CPP_KEYWORDS = frozenset((
+    "if while for switch return sizeof static_cast reinterpret_cast"
+    " const_cast dynamic_cast new delete catch alignof defined assert"
+    " snprintf memcpy memset malloc free close").split())
+
+_DISPATCH_RE = re.compile(
+    r'\b([A-Za-z_]\w*)\s*[!=]=\s*"([A-Za-z0-9_.-]+)"')
+_SENT_RE = re.compile(r'\\"type\\":\s*\\"([A-Za-z0-9_.-]+)\\"')
+_WIRE_RE = re.compile(r"//\s*cxx-wire:\s*([\w-]+)\s+(\S+)")
+def _parse_field(raw: str) -> Optional[Tuple[str, str, Optional[str]]]:
+    """``TYPE name;`` / ``TYPE name[COUNT];`` -> (type, name, count)."""
+    raw = raw.strip()
+    if not raw.endswith(";"):
+        return None
+    raw = raw[:-1].strip()
+    count = None
+    am = re.search(r"\[\s*(\w+)\s*\]$", raw)
+    if am:
+        count = am.group(1)
+        raw = raw[:am.start()].strip()
+    toks = raw.replace("*", " * ").split()
+    if len(toks) < 2 or not re.fullmatch(r"[A-Za-z_]\w*", toks[-1]):
+        return None
+    return " ".join(toks[:-1]), toks[-1], count
+_CONST_RE = re.compile(
+    r"\b(?:static\s+)?(?:constexpr|const)\s+[\w:]+\s+"
+    r"(\w+)\s*=\s*([^;]+);")
+_DEFINE_RE = re.compile(r"^\s*#\s*define\s+(\w+)\s+(\S+)\s*$",
+                        re.MULTILINE)
+_ENUM_RE = re.compile(
+    r"\benum\s+(?:class\s+)?\w*\s*(?::\s*[\w:]+\s*)?\{([^}]*)\}")
+
+
+def _is_harness(path: str) -> bool:
+    base = os.path.basename(path)
+    return "stress" in base or "test" in base
+
+
+def _split_params(text: str) -> List[str]:
+    out, depth, cur = [], 0, []
+    for ch in text:
+        if ch in "(<[":
+            depth += 1
+        elif ch in ")>]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if "".join(cur).strip():
+        out.append("".join(cur))
+    return out
+
+
+def _parse_signature(sig: str) -> Optional[Tuple[CType, str, List[CType],
+                                                 List[str]]]:
+    """``ret name(params)`` -> (ret, name, param types, param names)."""
+    lp = sig.find("(")
+    rp = sig.rfind(")")
+    if lp < 0 or rp < lp:
+        return None
+    head = sig[:lp].replace("*", " * ").split()
+    if len(head) < 2:
+        return None
+    name = head[-1]
+    if not re.fullmatch(r"[A-Za-z_]\w*", name):
+        return None
+    ret = parse_ctype(" ".join(head[:-1]))
+    if ret is None:
+        return None
+    params: List[CType] = []
+    names: List[str] = []
+    body = sig[lp + 1:rp].strip()
+    if body and body != "void":
+        for raw in _split_params(body):
+            toks = raw.replace("*", " * ").split()
+            # trailing identifier is the parameter name unless the
+            # param is abstract (`void*`, `int`)
+            pname = ""
+            if (len(toks) >= 2 and re.fullmatch(r"[A-Za-z_]\w*", toks[-1])
+                    and toks[-1] not in _BASE_TYPES):
+                pname = toks[-1]
+                toks = toks[:-1]
+            pt = parse_ctype(" ".join(toks))
+            if pt is None:
+                return None
+            params.append(pt)
+            names.append(pname)
+    return ret, name, params, names
+
+
+def _scan_body(body: str, path: str, body_line: int, fn: CFunc) -> None:
+    clean = body  # body already comes from the blanked text
+    for m in _LOCK_RE.finditer(clean):
+        lock = m.group(1).strip()
+        lock = lock.split("->")[-1].split(".")[-1].strip("&* ")
+        if lock:
+            fn.locks.append(lock)
+    for m in _PTHREAD_LOCK_RE.finditer(clean):
+        lock = m.group(1).strip()
+        lock = lock.split("->")[-1].split(".")[-1].strip("&* ")
+        fn.locks.append(lock)
+    for m in _JOIN_RE.finditer(clean):
+        fn.blocking.append((f"joins `{m.group(1).split('.')[-1]}`",
+                            body_line + clean.count("\n", 0, m.start())))
+    for m in _CV_WAIT_RE.finditer(clean):
+        # `.wait(` only — wait_for/wait_until are bounded, and the
+        # futex path takes an explicit timeout argument.
+        fn.blocking.append((
+            f"waits on `{m.group(1).split('.')[-1].split('>')[-1]}` "
+            f"with no timeout",
+            body_line + clean.count("\n", 0, m.start())))
+    if _PTHREAD_WAIT_RE.search(clean):
+        m = _PTHREAD_WAIT_RE.search(clean)
+        fn.blocking.append(("waits on a pthread condition with no "
+                            "timeout",
+                            body_line + clean.count("\n", 0, m.start())))
+    m = _GIL_RE.search(clean)
+    if m:
+        fn.gil_line = body_line + clean.count("\n", 0, m.start())
+    for m in _CALL_RE.finditer(clean):
+        callee = m.group(1)
+        if callee not in _CPP_KEYWORDS and callee != fn.name:
+            fn.calls.append(callee)
+
+
+def _parse_extern_block(clean: str, start: int, end: int, path: str,
+                        idx: "CxxIndex") -> None:
+    """Parse declarations/definitions between ``start`` and ``end``
+    (the content of one ``extern "C" { ... }`` region)."""
+    i = start
+    while i < end:
+        # next chunk: up to `;` at depth 0, or a `{` opening a body
+        while i < end and clean[i] in " \t\r\n;":
+            i += 1
+        if i >= end:
+            break
+        j, depth = i, 0
+        body_open = -1
+        while j < end:
+            c = clean[j]
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+            elif c == ";" and depth == 0:
+                break
+            elif c == "{" and depth == 0:
+                body_open = j
+                break
+            j += 1
+        sig_text = clean[i:j].strip()
+        line = _lineno(clean, i)
+        if sig_text.startswith("#"):            # preprocessor line
+            i = clean.index("\n", i) + 1 if "\n" in clean[i:j] else j + 1
+            continue
+        if body_open >= 0:
+            body_end = _match_brace(clean, body_open)
+        exported = True
+        if re.match(r"(static|inline)\b", sig_text):
+            exported = False
+            sig_text = re.sub(r"^(?:static|inline)\s+", "", sig_text)
+        parsed = _parse_signature(sig_text) if sig_text else None
+        if parsed is None:
+            if sig_text and "(" in sig_text:
+                idx.errors.append((
+                    path, line,
+                    f'unparseable extern "C" declaration: '
+                    f'`{" ".join(sig_text.split())[:80]}`'))
+            i = (body_end if body_open >= 0 else j + 1)
+            continue
+        ret, name, params, pnames = parsed
+        fn = CFunc(name, path, line, ret, params, pnames,
+                   is_definition=body_open >= 0, exported=exported)
+        if body_open >= 0:
+            _scan_body(clean[body_open:body_end], path,
+                       _lineno(clean, body_open), fn)
+            i = body_end
+        else:
+            i = j + 1
+        idx.functions.setdefault(name, []).append(fn)
+
+
+def _parse_structs(clean: str, path: str, idx: "CxxIndex") -> None:
+    for m in re.finditer(r"\bstruct\s+(\w+)\s*\{", clean):
+        name = m.group(1)
+        body_start = m.end() - 1
+        body_end = _match_brace(clean, body_start)
+        body = clean[body_start + 1:body_end - 1]
+        if "{" in body:            # nested types/methods — not a mirror
+            continue
+        line = _lineno(clean, m.start())
+        fields: List[CField] = []
+        mirrorable = True
+        for off, raw in enumerate(body.split("\n")):
+            raw = raw.strip()
+            if not raw or raw.startswith("#"):
+                continue
+            fm = _parse_field(raw)
+            if fm is None:
+                if "(" in raw:      # method/constructor
+                    mirrorable = False
+                continue
+            ftext, fname, fcount = fm
+            ftype = parse_ctype(ftext)
+            if ftype is None:
+                mirrorable = False
+                continue
+            count = 1
+            if fcount:
+                count = _const_value(fcount)
+                if count is None:
+                    count = idx.constants.get(fcount, (0,))[0]
+                if not count:
+                    mirrorable = False
+                    count = 1
+            if ftype.kind == "opaque":
+                sub = idx.structs.get(ftype.spelled)
+                if sub is None or not sub.mirrorable:
+                    mirrorable = False
+            elif ftype.kind == "ptr":
+                mirrorable = False
+            fields.append(CField(fname, ftype, count,
+                                 _lineno(clean, m.end()) + off))
+        if fields and name not in idx.structs:
+            idx.structs[name] = CStruct(name, path, line, fields,
+                                        mirrorable)
+
+
+def _parse_constants(clean: str, path: str, idx: "CxxIndex") -> None:
+    for m in _CONST_RE.finditer(clean):
+        val = _const_value(m.group(2))
+        if val is not None and m.group(1) not in idx.constants:
+            idx.constants[m.group(1)] = (val, path,
+                                         _lineno(clean, m.start()))
+    for m in _DEFINE_RE.finditer(clean):
+        val = _const_value(m.group(2))
+        if val is not None and m.group(1) not in idx.constants:
+            idx.constants[m.group(1)] = (val, path,
+                                         _lineno(clean, m.start()))
+    for m in _ENUM_RE.finditer(clean):
+        nxt = 0
+        for part in m.group(1).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" in part:
+                ename, _, expr = part.partition("=")
+                val = _const_value(expr)
+                if val is None:
+                    continue
+                nxt = val
+            else:
+                ename = part
+                val = nxt
+            ename = ename.strip()
+            if re.fullmatch(r"\w+", ename) and ename not in idx.constants:
+                idx.constants[ename] = (
+                    val, path,
+                    _lineno(clean, m.start()) +
+                    m.group(1)[:m.group(1).find(ename)].count("\n"))
+            nxt += 1
+
+
+def parse_file(path: str, idx: CxxIndex) -> None:
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            raw = f.read()
+    except OSError as e:
+        idx.errors.append((path, 0, f"unreadable: {e}"))
+        return
+    idx.files.append(path)
+    clean = _blank(raw)
+    harness = _is_harness(path)
+
+    if not harness:
+        _parse_constants(clean, path, idx)
+        _parse_structs(clean, path, idx)
+
+    pos = 0
+    while True:
+        m = _EXTERN_RE.search(clean, pos)
+        if m is None:
+            break
+        after = clean[m.end():].lstrip()
+        if after.startswith("{"):
+            open_pos = clean.index("{", m.end())
+            end = _match_brace(clean, open_pos)
+            _parse_extern_block(clean, open_pos + 1, end - 1, path, idx)
+            pos = end
+        else:
+            semi = clean.find(";", m.end())
+            semi = len(clean) if semi < 0 else semi
+            _parse_extern_block(clean, m.end(), semi + 1, path, idx)
+            pos = semi + 1
+
+    if harness:
+        return
+
+    # wire-frame annotations live in comments -> raw text
+    for m in _WIRE_RE.finditer(raw):
+        if m.group(1) not in idx.wire:
+            idx.wire[m.group(1)] = (m.group(2), path,
+                                    _lineno(raw, m.start()))
+    has_dispatch = False
+    for m in _DISPATCH_RE.finditer(raw):
+        var, mtype = m.group(1), m.group(2)
+        if "type" not in var.lower():
+            continue
+        has_dispatch = True
+        idx.dispatch.setdefault(mtype, (path, _lineno(raw, m.start())))
+    for m in _SENT_RE.finditer(raw):
+        site = (path, _lineno(raw, m.start()))
+        idx.sent.setdefault(m.group(1), site)
+        if has_dispatch:
+            idx.surface_sent.setdefault(m.group(1), site)
+
+
+# ---------------------------------------------------------------------------
+# Index construction
+# ---------------------------------------------------------------------------
+
+
+def find_sources(root: str) -> List[str]:
+    """C/C++ sources belonging to the tree rooted at ``root``: files
+    inside the root itself, plus the conventional sibling ``src/`` and
+    ``cpp/`` directories (the Python package and its native plane are
+    siblings in this repo: ``ray_tpu/`` next to ``src/``)."""
+    roots = [root]
+    parent = os.path.dirname(os.path.abspath(root))
+    for sib in ("src", "cpp"):
+        cand = os.path.join(parent, sib)
+        if os.path.isdir(cand) and os.path.abspath(cand) != \
+                os.path.abspath(root):
+            roots.append(cand)
+    out: List[str] = []
+    for r in roots:
+        for dirpath, dirnames, filenames in os.walk(r):
+            dirnames[:] = [d for d in dirnames
+                           if d not in (".git", "__pycache__")]
+            for fn in sorted(filenames):
+                if fn.endswith(_EXTS):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _propagate(idx: CxxIndex) -> None:
+    """Close blocking/lock info over the intra-C++ call graph so an
+    export that stops via a static helper still reads as blocking."""
+    changed = True
+    rounds = 0
+    while changed and rounds < 10:
+        changed = False
+        rounds += 1
+        for occ in idx.functions.values():
+            for fn in occ:
+                if not fn.is_definition:
+                    continue
+                for callee in fn.calls:
+                    tgt = idx.lookup(callee)
+                    if tgt is None or tgt is fn or not tgt.is_definition:
+                        continue
+                    for b in tgt.blocking:
+                        via = (f"{b[0]} (via {callee}())", b[1])
+                        if via not in fn.blocking and \
+                                b not in fn.blocking:
+                            fn.blocking.append(via)
+                            changed = True
+                    if tgt.gil_line and not fn.gil_line:
+                        fn.gil_line = tgt.gil_line
+                        changed = True
+
+
+def build(root: str, files: Optional[List[str]] = None) -> CxxIndex:
+    idx = CxxIndex()
+    srcs = files if files is not None else find_sources(root)
+    # constants/structs first so struct fields can resolve array sizes
+    # and nested struct types declared in the same pass; two passes
+    # keep it order-independent.
+    for path in srcs:
+        parse_file(path, idx)
+    if idx.files:
+        reparse = CxxIndex()
+        reparse.constants = idx.constants
+        for path in list(idx.files):
+            if not _is_harness(path):
+                _parse_structs(_blank(_read(path)), path, reparse)
+        idx.structs = reparse.structs
+    _propagate(idx)
+    return idx
+
+
+def _read(path: str) -> str:
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# Standalone entry point (`make -C src lint`)
+# ---------------------------------------------------------------------------
+
+
+def main(argv: List[str]) -> int:
+    targets: List[str] = []
+    for a in argv or ["."]:
+        if os.path.isdir(a):
+            for dirpath, dirnames, filenames in os.walk(a):
+                dirnames[:] = [d for d in dirnames
+                               if d not in (".git", "__pycache__")]
+                for fn in sorted(filenames):
+                    if fn.endswith(_EXTS):
+                        targets.append(os.path.join(dirpath, fn))
+        else:
+            targets.append(a)
+    idx = build(".", files=targets)
+    ndef = sum(1 for occ in idx.functions.values()
+               for f in occ if f.is_definition and f.exported)
+    ndecl = sum(1 for occ in idx.functions.values()
+                for f in occ if f.exported) - ndef
+    print(f"cxx: {len(idx.files)} file(s): {ndef} extern \"C\" "
+          f"definition(s) (+{ndecl} redeclarations), "
+          f"{len(idx.structs)} struct layout(s), "
+          f"{len(idx.constants)} constant(s), "
+          f"{len(idx.wire)} wire frame(s), "
+          f"{len(idx.dispatch)} dispatched + {len(idx.sent)} sent "
+          f"message type(s)")
+    for name in sorted(idx.functions):
+        fn = idx.lookup(name)
+        if fn.exported:
+            print(f"  {fn.sig()}  "
+                  f"[{os.path.basename(fn.path)}:{fn.line}]")
+    for path, line, msg in idx.errors:
+        print(f"{path}:{line}: cxx-parse-error: {msg}",
+              file=sys.stderr)
+    return 1 if idx.errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

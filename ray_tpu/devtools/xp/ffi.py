@@ -1,0 +1,668 @@
+"""Cross-language FFI analyses: the ctypes side of the native boundary.
+
+:mod:`.cxx` parses the C++ half (``extern "C"`` signatures, struct
+layouts, constants, wire-frame annotations, message dispatch); this
+module parses the Python half — every ``lib.foo.argtypes``/``restype``
+declaration, every call through a ctypes handle, ``ctypes.Structure``
+mirrors, ``# cxx-const:`` / ``# cxx-wire:`` pins — and checks the two
+against each other:
+
+- ``xp-ffi-signature`` — arity, width/signedness class and
+  pointer-vs-value of every declaration vs the parsed ``extern "C"``
+  signature; declarations for exports no C++ file defines; Python
+  calls to exports that declare neither ``argtypes`` nor ``restype``
+  (ctypes' int defaults truncate 64-bit handles); and two ``extern
+  "C"`` declarations of one symbol that disagree (hand-copied blocks
+  in harnesses/clients drifting from the definition).
+- ``xp-ffi-layout`` — ``ctypes.Structure`` mirrors vs the C struct
+  layout (field count, byte width, array length); ``# cxx-const:``
+  pins vs the C++ constant value; ``# cxx-wire:`` struct format
+  strings vs the ``// cxx-wire:`` frame annotation next to the C++
+  read/write code (endianness prefix included).
+- ``xp-xlang-protocol`` — every ``NATIVE_PLANE``-style annotation
+  dict checked against the *derived* C++ dispatch inventory: a key no
+  handler loop mentions is stale; a message type the native plane
+  dispatches or constructs without an annotation is missing.
+
+Cross-boundary lock propagation (the third leg of the cxx tentpole)
+lives in :func:`.lockgraph.check_xlang`, which consumes the same C++
+index.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .cxx import CType, CxxIndex
+from .index import ProjectIndex
+
+# ---------------------------------------------------------------------------
+# ctypes type model (mapped onto cxx.CType)
+# ---------------------------------------------------------------------------
+
+_CT_SCALARS: Dict[str, Tuple[str, int]] = {
+    "c_bool": ("uint", 8),
+    "c_char": ("int", 8),
+    "c_byte": ("int", 8),
+    "c_ubyte": ("uint", 8),
+    "c_int8": ("int", 8),
+    "c_uint8": ("uint", 8),
+    "c_short": ("int", 16),
+    "c_ushort": ("uint", 16),
+    "c_int16": ("int", 16),
+    "c_uint16": ("uint", 16),
+    "c_int": ("int", 32),
+    "c_uint": ("uint", 32),
+    "c_int32": ("int", 32),
+    "c_uint32": ("uint", 32),
+    "c_long": ("int", 64),          # LP64, like the C side
+    "c_ulong": ("uint", 64),
+    "c_longlong": ("int", 64),
+    "c_ulonglong": ("uint", 64),
+    "c_int64": ("int", 64),
+    "c_uint64": ("uint", 64),
+    "c_size_t": ("uint", 64),
+    "c_ssize_t": ("int", 64),
+    "c_float": ("float", 32),
+    "c_double": ("float", 64),
+}
+
+_LIBISH = re.compile(r"(^|_)(lib|dll|cdll|so)$", re.IGNORECASE)
+
+
+def _terminal(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Call):
+        return _terminal(expr.func)
+    return None
+
+
+def _libish(expr: ast.AST) -> bool:
+    name = _terminal(expr)
+    return bool(name and _LIBISH.search(name) or name == "_load")
+
+
+@dataclass
+class PyType:
+    ctype: Optional[CType]       # None -> unparseable expression
+    count: int = 1               # >1 for `c_uint8 * 28` array mirrors
+    spelled: str = ""
+
+
+def _py_ctype(node: ast.AST) -> PyType:
+    """A ctypes type expression -> PyType."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return PyType(CType("void"), spelled="None")
+    name = None
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    if name is not None:
+        if name in _CT_SCALARS:
+            kind, width = _CT_SCALARS[name]
+            return PyType(CType(kind, width, spelled=name), spelled=name)
+        if name == "c_char_p":
+            return PyType(CType("ptr", 64, CType("int", 8),
+                                spelled=name), spelled=name)
+        if name == "c_void_p":
+            return PyType(CType("ptr", 64, CType("void"),
+                                spelled=name), spelled=name)
+        if name == "c_wchar_p":
+            return PyType(CType("ptr", 64, CType("opaque"),
+                                spelled=name), spelled=name)
+        # a Structure subclass (or anything else) by name
+        return PyType(CType("opaque", spelled=name), spelled=name)
+    if isinstance(node, ast.Call) and _terminal(node.func) == "POINTER" \
+            and len(node.args) == 1:
+        inner = _py_ctype(node.args[0])
+        sp = f"POINTER({inner.spelled})"
+        if inner.ctype is None:
+            return PyType(None, spelled=sp)
+        return PyType(CType("ptr", 64, inner.ctype, spelled=sp),
+                      spelled=sp)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        elem = _py_ctype(node.left)
+        if isinstance(node.right, ast.Constant) \
+                and isinstance(node.right.value, int) \
+                and elem.ctype is not None:
+            return PyType(elem.ctype, count=node.right.value,
+                          spelled=f"{elem.spelled} * {node.right.value}")
+    try:
+        sp = ast.unparse(node)
+    except Exception:
+        sp = "<?>"
+    return PyType(None, spelled=sp)
+
+
+# ---------------------------------------------------------------------------
+# Python-side scan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PyDecl:
+    sym: str
+    path: str
+    argtypes: Optional[List[PyType]] = None
+    restype: Optional[PyType] = None      # None -> never assigned
+    arg_line: int = 0
+    res_line: int = 0
+
+    @property
+    def line(self) -> int:
+        return self.arg_line or self.res_line
+
+
+@dataclass
+class PyScan:
+    decls: Dict[str, List[PyDecl]] = field(default_factory=dict)
+    calls: Dict[str, List[Tuple[str, int]]] = field(default_factory=dict)
+    # NATIVE_PLANE-style dicts: (path, line, {key: key_line})
+    native_planes: List[Tuple[str, int, Dict[str, int]]] = \
+        field(default_factory=list)
+    # (path, line, python name, value, C++ constant name)
+    const_pins: List[Tuple[str, int, str, object, str]] = \
+        field(default_factory=list)
+    # (path, line, frame name, fmt or None)
+    wire_pins: List[Tuple[str, int, str, Optional[str]]] = \
+        field(default_factory=list)
+    # (path, line, class name, [(field name, PyType)] or None)
+    mirrors: List[Tuple[str, int, str,
+                        Optional[List[Tuple[str, PyType]]]]] = \
+        field(default_factory=list)
+
+
+def _decl_for(scan: PyScan, sym: str, path: str) -> PyDecl:
+    for d in scan.decls.setdefault(sym, []):
+        if d.path == path:
+            return d
+    d = PyDecl(sym, path)
+    scan.decls[sym].append(d)
+    return d
+
+
+_CONST_PIN_RE = re.compile(r"#\s*cxx-const:\s*(\w+)")
+_WIRE_PIN_RE = re.compile(r"#\s*cxx-wire:\s*([\w-]+)")
+_FMT_RE = re.compile(r"""["']([<>=!@]?[0-9xcbBhHiIlLqQnNefdspP]+)["']""")
+
+
+def _scan_module_source(path: str, tree: ast.Module,
+                        scan: PyScan) -> None:
+    """Comment-pin extraction (# cxx-const / # cxx-wire) — needs the
+    raw source, the AST does not carry comments."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    except OSError:
+        return
+    if "cxx-const:" not in src and "cxx-wire:" not in src:
+        return
+    # line -> (name, int value) for simple constant assignments
+    consts: Dict[int, Tuple[str, object]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, (int, bytes, str)):
+            consts[node.lineno] = (node.targets[0].id, node.value.value)
+    for i, text in enumerate(src.splitlines(), start=1):
+        m = _CONST_PIN_RE.search(text)
+        if m is not None:
+            if i in consts:
+                pyname, val = consts[i]
+                scan.const_pins.append((path, i, pyname, val, m.group(1)))
+            else:
+                scan.const_pins.append((path, i, "", None, m.group(1)))
+        m = _WIRE_PIN_RE.search(text)
+        if m is not None:
+            fm = _FMT_RE.search(text.split("#")[0])
+            scan.wire_pins.append(
+                (path, i, m.group(1), fm.group(1) if fm else None))
+
+
+def scan_python(idx: ProjectIndex) -> PyScan:
+    scan = PyScan()
+    for mod in idx.modules.values():
+        tree = mod.tree
+        path = mod.path
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                # lib.foo.argtypes = [...] / lib.foo.restype = ...
+                if isinstance(tgt, ast.Attribute) \
+                        and tgt.attr in ("argtypes", "restype") \
+                        and isinstance(tgt.value, ast.Attribute) \
+                        and _libish(tgt.value.value):
+                    d = _decl_for(scan, tgt.value.attr, path)
+                    if tgt.attr == "argtypes":
+                        if isinstance(node.value, (ast.List, ast.Tuple)):
+                            d.argtypes = [_py_ctype(e)
+                                          for e in node.value.elts]
+                        else:
+                            d.argtypes = []
+                        d.arg_line = node.lineno
+                    else:
+                        d.restype = _py_ctype(node.value)
+                        d.res_line = node.lineno
+                # NATIVE_PLANE = {...}
+                elif isinstance(tgt, ast.Name) \
+                        and tgt.id == "NATIVE_PLANE" \
+                        and isinstance(node.value, ast.Dict):
+                    keys: Dict[str, int] = {}
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            keys[k.value] = k.lineno
+                    scan.native_planes.append(
+                        (path, node.lineno, keys))
+            # for name in ("a", "b"): fn = getattr(lib, name); fn...
+            elif isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Name) \
+                    and isinstance(node.iter, (ast.Tuple, ast.List)):
+                syms = [e.value for e in node.iter.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+                if not syms:
+                    continue
+                alias = None
+                for stmt in node.body:
+                    if not isinstance(stmt, ast.Assign) \
+                            or len(stmt.targets) != 1:
+                        continue
+                    tgt = stmt.targets[0]
+                    if isinstance(tgt, ast.Name) \
+                            and isinstance(stmt.value, ast.Call) \
+                            and _terminal(stmt.value.func) == "getattr" \
+                            and len(stmt.value.args) == 2 \
+                            and _libish(stmt.value.args[0]):
+                        alias = tgt.id
+                    elif alias is not None \
+                            and isinstance(tgt, ast.Attribute) \
+                            and tgt.attr in ("argtypes", "restype") \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == alias:
+                        for sym in syms:
+                            d = _decl_for(scan, sym, path)
+                            if tgt.attr == "argtypes":
+                                if isinstance(stmt.value,
+                                              (ast.List, ast.Tuple)):
+                                    d.argtypes = [
+                                        _py_ctype(e)
+                                        for e in stmt.value.elts]
+                                else:
+                                    d.argtypes = []
+                                d.arg_line = stmt.lineno
+                            else:
+                                d.restype = _py_ctype(stmt.value)
+                                d.res_line = stmt.lineno
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr not in ("argtypes", "restype") \
+                    and _libish(node.func.value):
+                scan.calls.setdefault(node.func.attr, []).append(
+                    (path, node.lineno))
+        for cls in mod.classes.values():
+            bases = [_terminal(b) for b in cls.base_exprs]
+            if "Structure" not in bases:
+                continue
+            fields: Optional[List[Tuple[str, PyType]]] = None
+            for stmt in cls.node.body:
+                if isinstance(stmt, ast.Assign) \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name) \
+                        and stmt.targets[0].id == "_fields_" \
+                        and isinstance(stmt.value, (ast.List, ast.Tuple)):
+                    fields = []
+                    for e in stmt.value.elts:
+                        if isinstance(e, (ast.Tuple, ast.List)) \
+                                and len(e.elts) >= 2 \
+                                and isinstance(e.elts[0], ast.Constant):
+                            fields.append((e.elts[0].value,
+                                           _py_ctype(e.elts[1])))
+            scan.mirrors.append(
+                (path, cls.node.lineno, cls.name, fields))
+        _scan_module_source(path, tree, scan)
+    return scan
+
+
+# ---------------------------------------------------------------------------
+# Type compatibility
+# ---------------------------------------------------------------------------
+
+
+def _pointee_compat(py: CType, c: CType) -> Optional[str]:
+    if py.kind in ("void", "opaque") or c.kind in ("void", "opaque"):
+        return None
+    if py.kind == "ptr" and c.kind == "ptr":
+        return None
+    if py.kind == "ptr" or c.kind == "ptr":
+        return (f"points at {py.pretty()} but C expects a pointer to "
+                f"{c.pretty()}")
+    if py.width == 8 and c.width == 8:
+        return None                      # char* ~ uint8_t*: byte class
+    if py.width != c.width:
+        return (f"points at a {py.width}-bit value but C expects a "
+                f"pointer to a {c.width}-bit {c.pretty()}")
+    if py.kind != c.kind and py.kind in ("int", "uint") \
+            and c.kind in ("int", "uint"):
+        return (f"pointee signedness differs ({py.pretty()} vs "
+                f"{c.pretty()})")
+    return None
+
+
+def _compat(py: CType, c: CType) -> Optional[str]:
+    """None when compatible, else a short mismatch description."""
+    if c.kind == "opaque" or py.kind == "opaque":
+        return None
+    if c.kind == "void":
+        return None if py.kind == "void" else \
+            f"declares {py.pretty()} but C returns void"
+    if py.kind == "void":
+        return f"declares None but C has {c.pretty()}"
+    if c.kind == "ptr" and py.kind != "ptr":
+        return (f"passes {py.pretty()} by value but C expects "
+                f"{c.pretty()} (pointer-vs-value)")
+    if py.kind == "ptr" and c.kind != "ptr":
+        return (f"passes a pointer ({py.pretty()}) but C expects "
+                f"{c.pretty()} by value (pointer-vs-value)")
+    if c.kind == "ptr":
+        sub = _pointee_compat(py.pointee, c.pointee)
+        return None if sub is None else f"{py.pretty()} {sub}"
+    if c.kind == "float" or py.kind == "float":
+        if c.kind != py.kind:
+            return f"{py.pretty()} vs {c.pretty()} (class mismatch)"
+        return None if c.width == py.width else \
+            f"{py.pretty()} is {py.width}-bit, C {c.pretty()} is " \
+            f"{c.width}-bit"
+    # both integer classes
+    if py.width != c.width:
+        return (f"{py.pretty()} is {py.width}-bit but C {c.pretty()} "
+                f"is {c.width}-bit (width mismatch)")
+    if c.width > 8 and py.kind != c.kind:
+        return (f"{py.pretty()} vs {c.pretty()}: signedness differs")
+    return None
+
+
+def _site(path: str, line: int) -> str:
+    return f"{os.path.relpath(path)}:{line}" if os.path.isabs(path) \
+        else f"{path}:{line}"
+
+
+# ---------------------------------------------------------------------------
+# Analyses
+# ---------------------------------------------------------------------------
+
+
+def check_signatures(idx: ProjectIndex, cxx_idx: CxxIndex,
+                     scan: Optional[PyScan] = None) -> List:
+    from ..raylint import Finding
+
+    scan = scan if scan is not None else scan_python(idx)
+    findings: List[Finding] = []
+
+    # drift between extern "C" occurrences of one symbol (definition
+    # vs the hand-copied declaration blocks in harnesses/clients)
+    for sym in sorted(cxx_idx.functions):
+        occ = [f for f in cxx_idx.functions[sym] if f.exported]
+        base = cxx_idx.lookup(sym)
+        if base is None:
+            continue
+        for other in occ:
+            if other is base:
+                continue
+            why = None
+            if len(other.params) != len(base.params):
+                why = (f"{len(other.params)} parameter(s) vs "
+                       f"{len(base.params)}")
+            else:
+                for i, (a, b) in enumerate(
+                        zip(other.params, base.params)):
+                    sub = _compat(a, b)
+                    if sub:
+                        why = f"parameter {i + 1}: {sub}"
+                        break
+                if why is None:
+                    sub = _compat(other.ret, base.ret)
+                    if sub:
+                        why = f"return type: {sub}"
+            if why:
+                findings.append(Finding(
+                    other.path, other.line, "xp-ffi-signature",
+                    f'conflicting extern "C" declarations of '
+                    f"`{sym}`: this one says `{other.sig()}` but the "
+                    f"definition at {_site(base.path, base.line)} is "
+                    f"`{base.sig()}` — {why}"))
+
+    for sym in sorted(scan.decls):
+        cf = cxx_idx.lookup(sym)
+        for d in scan.decls[sym]:
+            if cf is None or not cf.exported:
+                findings.append(Finding(
+                    d.path, d.line, "xp-ffi-signature",
+                    f"ctypes declaration for `{sym}` but no extern "
+                    f'"C" symbol with that name exists in '
+                    f"{_cc_summary(cxx_idx)} (undeclared export — "
+                    f"typo, or the C side was removed)"))
+                continue
+            if d.argtypes is not None \
+                    and len(d.argtypes) != len(cf.params):
+                findings.append(Finding(
+                    d.path, d.arg_line, "xp-ffi-signature",
+                    f"`{sym}` declares {len(d.argtypes)} argtypes but "
+                    f"the C signature at {_site(cf.path, cf.line)} is "
+                    f"`{cf.sig()}` ({len(cf.params)} parameter(s)) — "
+                    f"arity mismatch"))
+            elif d.argtypes is not None:
+                for i, (py, c) in enumerate(zip(d.argtypes, cf.params)):
+                    if py.ctype is None:
+                        continue
+                    sub = _compat(py.ctype, c)
+                    if sub:
+                        pname = (cf.param_names[i]
+                                 if i < len(cf.param_names) else "")
+                        pdesc = f"`{pname}` " if pname else ""
+                        findings.append(Finding(
+                            d.path, d.arg_line, "xp-ffi-signature",
+                            f"`{sym}` argtypes[{i}]: {sub} — C "
+                            f"parameter {i + 1} {pdesc}at "
+                            f"{_site(cf.path, cf.line)} is "
+                            f"`{cf.params[i].pretty()}`"))
+            if d.restype is not None and d.restype.ctype is not None:
+                sub = _compat(d.restype.ctype, cf.ret)
+                if sub:
+                    findings.append(Finding(
+                        d.path, d.res_line, "xp-ffi-signature",
+                        f"`{sym}` restype: {sub} — C returns "
+                        f"`{cf.ret.pretty()}` at "
+                        f"{_site(cf.path, cf.line)}"))
+            elif d.restype is None \
+                    and (cf.ret.kind == "ptr"
+                         or (cf.ret.kind in ("int", "uint")
+                             and cf.ret.width > 32)):
+                findings.append(Finding(
+                    d.path, d.line, "xp-ffi-signature",
+                    f"`{sym}` declares argtypes but no restype — C "
+                    f"returns `{cf.ret.pretty()}` at "
+                    f"{_site(cf.path, cf.line)} and ctypes' default "
+                    f"c_int restype truncates it to 32 bits"))
+
+    for sym in sorted(scan.calls):
+        if sym in scan.decls:
+            continue
+        cf = cxx_idx.lookup(sym)
+        path, line = scan.calls[sym][0]
+        if cf is not None and cf.exported:
+            findings.append(Finding(
+                path, line, "xp-ffi-signature",
+                f"call to `{sym}` but no argtypes/restype are ever "
+                f"declared for it — ctypes applies int defaults "
+                f"(64-bit values truncate) against `{cf.sig()}` at "
+                f"{_site(cf.path, cf.line)}"))
+        elif cxx_idx.files:
+            findings.append(Finding(
+                path, line, "xp-ffi-signature",
+                f"call to `{sym}` through a ctypes handle but no "
+                f'extern "C" symbol with that name exists in '
+                f"{_cc_summary(cxx_idx)} (undeclared export)"))
+    return findings
+
+
+def _cc_summary(cxx_idx: CxxIndex) -> str:
+    names = sorted({os.path.basename(p) for p in cxx_idx.files
+                    if not p.endswith(".h")})
+    if len(names) > 4:
+        names = names[:4] + ["…"]
+    return "/".join(names) if names else "the C++ sources"
+
+
+def _struct_flat(cxx_idx: CxxIndex, name: str):
+    st = cxx_idx.structs.get(name)
+    return st
+
+
+def check_layouts(idx: ProjectIndex, cxx_idx: CxxIndex,
+                  scan: Optional[PyScan] = None) -> List:
+    from ..raylint import Finding
+
+    scan = scan if scan is not None else scan_python(idx)
+    findings: List[Finding] = []
+
+    for path, line, pyname, val, cname in scan.const_pins:
+        if cname not in cxx_idx.constants:
+            findings.append(Finding(
+                path, line, "xp-ffi-layout",
+                f"`# cxx-const: {cname}` pins a constant no C++ "
+                f"source defines (checked {_cc_summary(cxx_idx)})"))
+            continue
+        cval, cpath, cline = cxx_idx.constants[cname]
+        if val is None:
+            findings.append(Finding(
+                path, line, "xp-ffi-layout",
+                f"`# cxx-const: {cname}` must annotate a simple "
+                f"`NAME = <literal>` assignment on the same line"))
+        elif val != cval:
+            findings.append(Finding(
+                path, line, "xp-ffi-layout",
+                f"`{pyname}` = {val!r} but C++ `{cname}` = {cval} at "
+                f"{_site(cpath, cline)} — layout/protocol drift"))
+
+    for path, line, frame, fmt in scan.wire_pins:
+        if frame not in cxx_idx.wire:
+            findings.append(Finding(
+                path, line, "xp-ffi-layout",
+                f"`# cxx-wire: {frame}` references a frame no "
+                f"`// cxx-wire:` annotation in the C++ sources "
+                f"declares"))
+            continue
+        cfmt, cpath, cline = cxx_idx.wire[frame]
+        # "!" (network order) and ">" are the same layout
+        norm = (lambda s: s.replace("!", ">") if s else s)
+        if fmt is None:
+            findings.append(Finding(
+                path, line, "xp-ffi-layout",
+                f"`# cxx-wire: {frame}` must sit on the line with "
+                f"the struct format string"))
+        elif norm(fmt) != norm(cfmt):
+            findings.append(Finding(
+                path, line, "xp-ffi-layout",
+                f'wire frame "{frame}" uses format "{fmt}" but the '
+                f'C++ side at {_site(cpath, cline)} declares "{cfmt}"'
+                f" — byte order/width drift on the wire"))
+
+    for path, line, clsname, fields in scan.mirrors:
+        st = cxx_idx.structs.get(clsname)
+        if st is None:
+            if cxx_idx.files:
+                findings.append(Finding(
+                    path, line, "xp-ffi-layout",
+                    f"ctypes.Structure `{clsname}` mirrors no C++ "
+                    f"struct of that name (checked "
+                    f"{_cc_summary(cxx_idx)})"))
+            continue
+        if fields is None:
+            continue
+        if not st.mirrorable:
+            findings.append(Finding(
+                path, line, "xp-ffi-layout",
+                f"ctypes.Structure `{clsname}` mirrors C++ struct "
+                f"`{st.name}` at {_site(st.path, st.line)} whose "
+                f"layout is not fixed-width (pointers/opaque "
+                f"members) — it cannot be mirrored safely"))
+            continue
+        if len(fields) != len(st.fields):
+            findings.append(Finding(
+                path, line, "xp-ffi-layout",
+                f"`{clsname}` declares {len(fields)} field(s) but "
+                f"C++ `{st.name}` at {_site(st.path, st.line)} has "
+                f"{len(st.fields)} — struct layout drift"))
+            continue
+        for (pname, pt), cf in zip(fields, st.fields):
+            where = (f"field `{cf.name}` of `{st.name}` "
+                     f"({_site(st.path, cf.line)})")
+            if pt.ctype is None:
+                continue
+            if pt.count != cf.count:
+                findings.append(Finding(
+                    path, line, "xp-ffi-layout",
+                    f"`{clsname}.{pname}` is an array of {pt.count} "
+                    f"but {where} has {cf.count} element(s)"))
+                continue
+            if cf.ctype.kind == "opaque":
+                if pt.ctype.kind == "opaque" \
+                        and pt.ctype.spelled == cf.ctype.spelled:
+                    continue
+                findings.append(Finding(
+                    path, line, "xp-ffi-layout",
+                    f"`{clsname}.{pname}` ({pt.spelled}) does not "
+                    f"match the nested struct type "
+                    f"`{cf.ctype.pretty()}` of {where}"))
+                continue
+            sub = _compat(pt.ctype, cf.ctype)
+            if sub:
+                findings.append(Finding(
+                    path, line, "xp-ffi-layout",
+                    f"`{clsname}.{pname}`: {sub} — {where}"))
+    return findings
+
+
+def check_protocol(idx: ProjectIndex, cxx_idx: CxxIndex,
+                   scan: Optional[PyScan] = None) -> List:
+    from ..raylint import Finding
+
+    scan = scan if scan is not None else scan_python(idx)
+    findings: List[Finding] = []
+    native_types = set(cxx_idx.dispatch) | set(cxx_idx.surface_sent)
+
+    annotated = set()
+    for path, line, keys in scan.native_planes:
+        annotated |= set(keys)
+        for key, kline in sorted(keys.items()):
+            if key not in native_types and key not in cxx_idx.sent:
+                findings.append(Finding(
+                    path, kline, "xp-xlang-protocol",
+                    f'NATIVE_PLANE annotates "{key}" but no C++ '
+                    f"dispatch arm or native send in "
+                    f"{_cc_summary(cxx_idx)} mentions it — stale "
+                    f"annotation (the native plane no longer "
+                    f"implements this type)"))
+    if scan.native_planes:
+        np_path, np_line, _ = scan.native_planes[0]
+        for t in sorted(native_types - annotated):
+            cpath, cline = cxx_idx.dispatch.get(
+                t, cxx_idx.surface_sent.get(t, ("", 0)))
+            findings.append(Finding(
+                cpath, cline, "xp-xlang-protocol",
+                f'the native plane dispatches/constructs "{t}" here '
+                f"but the NATIVE_PLANE annotation at "
+                f"{_site(np_path, np_line)} does not record it — "
+                f"missing annotation (the inventory under-reports "
+                f"the native plane)"))
+    return findings

@@ -27,7 +27,7 @@ import ast
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..raylint import _expr_key, _lockish
+from ..raylint import _expr_key, _lockish, _terminal_name
 from .index import FuncInfo, ProjectIndex, _child_stmts
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -39,6 +39,10 @@ class CallSite:
     callee: Optional[str]      # resolved callee qual, or None
     held: Tuple[str, ...]
     line: int
+    # unresolved attribute calls keep their shape so the cross-language
+    # pass can spot `lib.nd_stop(...)`-style calls into the native lib
+    attr: Optional[str] = None
+    recv: Optional[str] = None
 
 
 @dataclass
@@ -125,9 +129,13 @@ def _scan(fi: FuncInfo, idx: ProjectIndex) -> FnLocks:
                 continue
             if isinstance(n, ast.Call):
                 callee = idx.resolve_call(n.func, fi)
+                attr = recv = None
+                if isinstance(n.func, ast.Attribute):
+                    attr = n.func.attr
+                    recv = _terminal_name(n.func.value)
                 out.calls.append(CallSite(
                     callee.qual if callee else None, tuple(held),
-                    getattr(n, "lineno", 0)))
+                    getattr(n, "lineno", 0), attr, recv))
             stack.extend(ast.iter_child_nodes(n))
 
     def process(stmts: List[ast.stmt]) -> None:
@@ -196,12 +204,18 @@ class Witness:
     desc: str
 
 
-def check(idx: ProjectIndex) -> List:
+def scan_all(idx: ProjectIndex) -> Dict[str, FnLocks]:
+    """Per-function lock scans, shared by :func:`check` and
+    :func:`check_xlang` so ``run_xp`` only walks the tree once."""
+    return {fi.qual: _scan(fi, idx) for fi in idx.all_functions()}
+
+
+def check(idx: ProjectIndex,
+          scans: Optional[Dict[str, FnLocks]] = None) -> List:
     """Run the pass; returns raylint Findings."""
     from ..raylint import Finding
 
-    scans: Dict[str, FnLocks] = {
-        fi.qual: _scan(fi, idx) for fi in idx.all_functions()}
+    scans = scans if scans is not None else scan_all(idx)
 
     # closure[f][lock] = (callee qual | None, line where introduced)
     closure: Dict[str, Dict[str, Tuple[Optional[str], int]]] = {
@@ -291,4 +305,125 @@ def check(idx: ProjectIndex) -> List:
             f"`{a}` -> `{b}`: {wf.desc}; but the opposite order "
             f"exists: {wr.desc} — deadlock when both paths run "
             f"concurrently"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Cross-language extension: held sets across the ctypes boundary
+# ---------------------------------------------------------------------------
+
+_NATIVE_RECV = ("lib", "_lib", "dll", "_dll", "cdll", "_load", "so",
+                "_so")
+
+
+def _pretty_lock(key: str) -> str:
+    # function-scoped keys read better without the qual prefix
+    return key.rsplit(":", 1)[-1] if ":" in key else key
+
+
+def check_xlang(idx: ProjectIndex, cxx_idx,
+                scans: Optional[Dict[str, FnLocks]] = None) -> List:
+    """Held-set propagation across the FFI boundary, both directions.
+
+    Forward: a Python function that holds a lock (including
+    ``HandleGuard`` read/write sections) while calling — directly or
+    through the Python call graph — a native export whose body blocks
+    unboundedly (``thread.join()``, an untimed condition-variable
+    wait) stalls every other thread contending for that lock for as
+    long as the native side takes.
+
+    Reverse: a C++ function that acquires ``PyGILState_Ensure`` while
+    holding a ``std::mutex`` deadlocks against any Python thread that
+    holds the GIL and re-enters the library through an export that
+    takes the same mutex.
+    """
+    from ..raylint import Finding
+
+    findings: List[Finding] = []
+
+    for occs in cxx_idx.functions.values():
+        for fn in occs:
+            if fn.is_definition and fn.gil_line and fn.locks:
+                findings.append(Finding(
+                    fn.path, fn.gil_line, "xp-xlang-lock",
+                    f"`{fn.name}` ({fn.path}:{fn.line}) calls "
+                    f"PyGILState_Ensure while holding "
+                    f"`{fn.locks[0]}` — deadlocks against a Python "
+                    f"thread that re-enters the library under the "
+                    f"GIL and contends for the same mutex"))
+
+    blocking = {}
+    for name in cxx_idx.functions:
+        cf = cxx_idx.lookup(name)
+        if cf is not None and cf.exported and cf.blocking:
+            blocking[name] = cf
+
+    if not blocking:
+        return findings
+
+    scans = scans if scans is not None else scan_all(idx)
+
+    # native[f][sym] = (via callee qual | None, line) — which blocking
+    # exports each Python function can reach, closed over the call
+    # graph like the lock closure above.
+    native: Dict[str, Dict[str, Tuple[Optional[str], int]]] = {}
+    for q, s in scans.items():
+        mine: Dict[str, Tuple[Optional[str], int]] = {}
+        for c in s.calls:
+            if (c.attr in blocking and c.recv is not None
+                    and c.recv in _NATIVE_RECV):
+                mine.setdefault(c.attr, (None, c.line))
+        native[q] = mine
+    changed = True
+    while changed:
+        changed = False
+        for q, s in scans.items():
+            mine = native[q]
+            for c in s.calls:
+                if c.callee is None or c.callee == q:
+                    continue
+                for sym in native.get(c.callee, ()):
+                    if sym not in mine:
+                        mine[sym] = (c.callee, c.line)
+                        changed = True
+
+    def chain(fn_qual: str, sym: str, depth: int = 0) -> str:
+        if depth > 12:
+            return "..."
+        via, line = native[fn_qual][sym]
+        if via is None:
+            return f"{fn_qual}:{line} calls `{sym}`"
+        return f"{fn_qual}:{line} -> {chain(via, sym, depth + 1)}"
+
+    seen: Set[Tuple[str, str, str]] = set()
+    for q, s in scans.items():
+        fi = s.fn
+        for c in s.calls:
+            if not c.held:
+                continue
+            reach: Dict[str, Tuple[Optional[str], int]] = {}
+            if (c.attr in blocking and c.recv is not None
+                    and c.recv in _NATIVE_RECV):
+                reach[c.attr] = (None, c.line)
+            elif c.callee is not None and c.callee != q:
+                for sym in native.get(c.callee, ()):
+                    reach[sym] = (c.callee, c.line)
+            for sym in sorted(reach):
+                cf = blocking[sym]
+                bdesc, bline = cf.blocking[0]
+                for h in c.held:
+                    key = (q, sym, h)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    via, _ = reach[sym]
+                    route = (f"`{sym}`" if via is None else
+                             f"{chain(via, sym)} -> `{sym}`")
+                    findings.append(Finding(
+                        fi.path, c.line, "xp-xlang-lock",
+                        f"{q}() holds `{_pretty_lock(h)}` at "
+                        f"{fi.path}:{c.line} while calling {route}, "
+                        f"which {bdesc} at {cf.path}:{bline} — the "
+                        f"native call can block unboundedly with the "
+                        f"lock held"))
     return findings

@@ -51,11 +51,13 @@ SEND_FUNCS = {"send_msg", "_send_json", "send_json", "request",
               "call", "run_task", "send"}
 
 # Dispatch-socket ops with a second implementation in the native C++
-# front end (src/node_dispatch.cc) — outside the Python tree this pass
-# indexes, recorded statically the same way the C++ client's *_xlang
-# senders are baselined, so the inventory stays honest about which
-# plane can answer when RAY_TPU_NATIVE_DISPATCH=1. Keyed by message
-# type; the value names what the native loop does with it.
+# front end (src/node_dispatch.cc), so the inventory stays honest
+# about which plane can answer when RAY_TPU_NATIVE_DISPATCH=1. Keyed
+# by message type; the value names what the native loop does with it.
+# The key set is no longer trusted: ffi.check_protocol derives the
+# native dispatch surface from the C++ sources and reports any key
+# here that drifted from it (stale) or any native arm this dict
+# misses (xp-xlang-protocol).
 NATIVE_PLANE = {
     "ping": "handled off-GIL (pong written natively unless tracing)",
     "pong": "sent natively with live ledger availability spliced in",
@@ -628,15 +630,25 @@ class _Analyzer:
         return out
 
 
-def check(idx: ProjectIndex):
-    """Returns (findings, inventory rows)."""
+def check(idx: ProjectIndex, cxx_idx=None):
+    """Returns (findings, inventory rows).
+
+    When `cxx_idx` (a :class:`.cxx.CxxIndex`) is given, the C++
+    sources join the protocol graph: native ``"{\\"type\\": ...}"``
+    constructions count as senders (so handler arms for messages the
+    C++ client produces are no longer orphans needing baseline
+    entries), native dispatch arms count as handlers, and types the
+    C++ side sends are exempt from ``proto-missing-field`` (their
+    field sets are not statically visible from here)."""
     from ..raylint import Finding
 
     senders, handled, reads, provided_any = _Analyzer(idx).run()
     findings: List[Finding] = []
+    cxx_sent = dict(cxx_idx.sent) if cxx_idx is not None else {}
+    cxx_handled = dict(cxx_idx.dispatch) if cxx_idx is not None else {}
 
     for t in sorted(senders):
-        if t in handled:
+        if t in handled or t in cxx_handled:
             continue
         lit = senders[t][0]
         findings.append(Finding(
@@ -646,7 +658,7 @@ def check(idx: ProjectIndex):
             f'unknown-type path (or hang a caller awaiting a typed '
             f'reply)'))
     for t in sorted(handled):
-        if t in senders:
+        if t in senders or t in cxx_sent:
             continue
         path, line = handled[t][0]
         findings.append(Finding(
@@ -656,6 +668,8 @@ def check(idx: ProjectIndex):
             f'a sender outside this tree (baseline it with the '
             f'sender\'s location as the reason)'))
     for t in sorted(set(senders) & set(handled)):
+        if t in cxx_sent:
+            continue
         provided: Set[str] = set()
         for lit in senders[t]:
             provided |= lit.fields
@@ -670,7 +684,8 @@ def check(idx: ProjectIndex):
                 f'branch) the first time this path runs'))
 
     inventory: List[dict] = []
-    for t in sorted(set(senders) | set(handled)):
+    for t in sorted(set(senders) | set(handled)
+                    | set(cxx_sent) | set(cxx_handled)):
         provided = set()
         for lit in senders.get(t, []):
             provided |= lit.fields
@@ -683,7 +698,16 @@ def check(idx: ProjectIndex):
             "fields": sorted(provided - {"type"}),
             "reads": sorted(reads.get(t, {})),
         }
+        if t in cxx_sent:
+            p, ln = cxx_sent[t]
+            row["senders"].append(f"{p}:{ln} (C++)")
+        if t in cxx_handled:
+            p, ln = cxx_handled[t]
+            row["handlers"].append(f"{p}:{ln} (C++)")
         if t in NATIVE_PLANE:
             row["native"] = NATIVE_PLANE[t]
+            site = cxx_handled.get(t) or cxx_sent.get(t)
+            if site is not None:
+                row["native_site"] = f"{site[0]}:{site[1]}"
         inventory.append(row)
     return findings, inventory

@@ -138,9 +138,10 @@ def to_sarif(findings: list, rule_docs: Dict[str, str]) -> str:
 def inventory_table(inventory: Iterable[dict]) -> str:
     """The wire-protocol inventory as a markdown table. The "native
     plane" column marks dispatch-socket ops the C++ front end
-    (src/node_dispatch.cc) also implements — the AST pass can't see
-    C++, so they're recorded statically (protocol.NATIVE_PLANE), like
-    the baselined *_xlang C++-client senders."""
+    (src/node_dispatch.cc) also implements — the annotation
+    (protocol.NATIVE_PLANE) is derived from the parsed C++ dispatch
+    arms and send sites and checked by xp-xlang-protocol, and the
+    column carries the C++ site the extractor found."""
     lines = [
         "| type | senders | handlers | fields | native plane |",
         "|------|---------|----------|--------|--------------|",
@@ -154,9 +155,12 @@ def inventory_table(inventory: Iterable[dict]) -> str:
             if len(items) > 3:
                 shown += f", … ({len(items)} total)"
             return shown
+        native = row.get("native", "—")
+        if row.get("native_site"):
+            native = f"{native} ({_rel(row['native_site'])})"
         lines.append(
             f"| `{row['type']}` | {sites('senders')} | "
             f"{sites('handlers')} | "
             f"{', '.join(row['fields']) or '—'} | "
-            f"{row.get('native', '—')} |")
+            f"{native} |")
     return "\n".join(lines)

@@ -18,8 +18,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..core.worker_proc import WorkerCrashedError, _recv_exact
 
-_LEN = struct.Struct("!Q")
-_HLEN = struct.Struct("<I")
+_LEN = struct.Struct("!Q")  # cxx-wire: nd-frame-len
+_HLEN = struct.Struct("<I")  # cxx-wire: nd-hybrid-hlen
 
 
 class NodeDispatchError(RuntimeError):

@@ -65,7 +65,7 @@ def _load_modules():
         WorkerCrashedError, recv_msg, send_msg
 
 
-_FRAME = struct.Struct("!Q")
+_FRAME = struct.Struct("!Q")  # cxx-wire: nd-frame-len
 
 
 class _NdConn:
@@ -772,7 +772,7 @@ class NodeDaemon:
             msg = json.loads(body.decode())
             msg["_json"] = True
         elif body[:1] == b"\x01":
-            (hlen,) = struct.unpack_from("<I", body, 1)
+            (hlen,) = struct.unpack_from("<I", body, 1)  # cxx-wire: nd-hybrid-hlen
             msg = pickle.loads(body[5 + hlen:])
         else:
             msg = pickle.loads(body)

@@ -461,7 +461,7 @@ void queue_frame(Server& s, Conn& c, uint8_t type,
                  const std::vector<uint8_t>& body) {
   std::vector<uint8_t> frame(5 + body.size());
   uint32_t len = static_cast<uint32_t>(1 + body.size());
-  memcpy(&frame[0], &len, 4);
+  memcpy(&frame[0], &len, 4);  // cxx-wire: cp-frame-len <I
   frame[4] = type;
   memcpy(frame.data() + 5, body.data(), body.size());
   c.outq.push_back(std::move(frame));
@@ -502,7 +502,7 @@ void publish(Server& s, const std::string& channel,
 // Request dispatch
 // ---------------------------------------------------------------------------
 void dispatch(Server& s, Conn& c, Reader& r) {
-  uint64_t req_id = r.u64();
+  uint64_t req_id = r.u64();  // cxx-wire: cp-req-id <Q
   uint8_t op = r.u8();
   Writer w;
   w.u64(req_id);

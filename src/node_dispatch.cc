@@ -640,7 +640,7 @@ bool handle_frame(NdServer* s, Conn* c, const char* payload, size_t n) {
       return false;
     }
     uint32_t hlen = 0;
-    memcpy(&hlen, payload + 1, 4);
+    memcpy(&hlen, payload + 1, 4);  // cxx-wire: nd-hybrid-hlen <I
     if (5 + static_cast<uint64_t>(hlen) > n) {
       close_conn(s, c);
       return false;
@@ -754,7 +754,7 @@ bool parse_frames(NdServer* s, Conn* c) {
     if (have < 8) break;
     const unsigned char* hp = reinterpret_cast<const unsigned char*>(
         c->inbuf.data() + c->in_off);
-    uint64_t flen = 0;
+    uint64_t flen = 0;  // cxx-wire: nd-frame-len >Q
     for (int i = 0; i < 8; i++) flen = (flen << 8) | hp[i];
     if (flen == 0 || flen > s->max_frame) {
       close_conn(s, c);

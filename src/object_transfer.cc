@@ -227,12 +227,12 @@ bool serve_pull2(TransferServer* ts, int fd, const uint8_t* id) {
   }
   if (pinned) {
     int64_t rsize = static_cast<int64_t>(size);
-    bool ok = send_all(fd, &rsize, 8);
+    bool ok = send_all(fd, &rsize, 8);  // cxx-wire: rto-pull2-total <q
     uint64_t sent = 0;
     while (ok && sent < size) {
       uint32_t len = static_cast<uint32_t>(
           std::min(kChunk, size - sent));
-      ok = send_all(fd, &len, 4) &&
+      ok = send_all(fd, &len, 4) &&  // cxx-wire: rto-pull2-chunk <I
            send_all(fd, st->base + off + sent, len);
       if (ok) sent += len;
     }

@@ -36,7 +36,8 @@ void rts_disconnect(void* handle);
 int rts_unlink(const char* name);
 int rts_create(void* h, const uint8_t* id, uint64_t size, uint64_t* off);
 int rts_seal(void* h, const uint8_t* id);
-int rts_get(void* h, const uint8_t* id, uint64_t* off, uint64_t* size);
+int rts_get(void* h, const uint8_t* id, uint64_t* off, uint64_t* size,
+            int pin);
 int rts_release(void* h, const uint8_t* id);
 uint8_t* rts_base(void* h);
 void* rto_serve(const char* shm, uint64_t cap, int port, int bind_all);
@@ -124,7 +125,9 @@ void* consumer(void* arg) {
     }
     if (rc == 0) {
       uint64_t off = 0, size = 0;
-      if (rts_get(store, id, &off, &size) != 0) abort();
+      // pin: the payload scan below must not race an LRU eviction,
+      // and the rts_release after it pairs with this pin
+      if (rts_get(store, id, &off, &size, 1) != 0) abort();
       if (size != g_obj_size[tag]) abort();
       const uint8_t* base = rts_base(store);
       for (uint64_t j = 0; j < size; j += 4093)

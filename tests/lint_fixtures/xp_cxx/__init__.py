@@ -1,0 +1,3 @@
+# Fixture package for the cross-language (C++/ctypes) xp analyses:
+# bad.c + bad_wrapper.py seed one mismatch per rule facet; clean.c +
+# clean_wrapper.py mirror each other exactly and must stay silent.

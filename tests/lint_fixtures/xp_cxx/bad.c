@@ -1,0 +1,78 @@
+// Firing half of the cross-language fixture pair. Never compiled —
+// parsed by devtools/xp/cxx.py. Every drift here is deliberate and
+// paired with a declaration in bad_wrapper.py; the gate tests pin the
+// exact findings.
+#include <stdint.h>
+
+#define BX_MAGIC 7
+constexpr int kBxSlots = 64;
+
+struct BxState;
+
+extern "C" {
+
+// layout the wrapper mirrors (two fields drifted over there)
+struct BxRec {
+  uint64_t seq;
+  uint32_t flags;
+  uint8_t tag[4];
+};
+
+void* bx_open(const char* name, uint64_t cap) {  // wrapper: no restype
+  (void)name; (void)cap;
+  return nullptr;
+}
+
+// wrapper declares 3 argtypes (arity drift)
+int bx_put(void* h, const uint8_t* id, uint64_t size, int pin) {
+  (void)h; (void)id; (void)size; (void)pin;
+  return 0;
+}
+
+// wrapper declares c_ushort for `flags` (width drift)
+int bx_width(void* h, unsigned int flags) {
+  (void)h; (void)flags;
+  return 0;
+}
+
+// wrapper passes uint64 by value (pointer-vs-value drift)
+void bx_byref(void* h, uint64_t* out) {
+  (void)h; *out = 0;
+}
+
+// wrapper calls this without ever declaring argtypes/restype
+int bx_undeclared_on_py(void* h) {
+  (void)h;
+  return 0;
+}
+
+int bx_mangled(@);  // unparseable on purpose: cxx-parse-error
+
+void bx_join_stop(void* h) {
+  BxState* s = reinterpret_cast<BxState*>(h);
+  s->worker.join();  // unbounded: the wrapper calls this under a lock
+}
+
+int bx_gil_reenter(void* h) {
+  (void)h;
+  std::lock_guard<std::mutex> lk(g_mu);
+  PyGILState_STATE st = PyGILState_Ensure();  // mutex held: deadlock
+  PyGILState_Release(st);
+  return 0;
+}
+
+void bx_dispatch(void* h, const char* t) {
+  (void)h;
+  std::string mtype(t);
+  if (mtype == "bx_task") {  // arm missing from NATIVE_PLANE
+    return;
+  }
+}
+
+void bx_frame_read(const unsigned char* p) {
+  uint32_t len = 0;
+  __builtin_memcpy(&len, p, 4);  // cxx-wire: bx-frame <I
+  (void)len;
+}
+
+}  // extern "C"

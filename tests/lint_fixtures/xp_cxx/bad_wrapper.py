@@ -1,0 +1,63 @@
+"""Firing half of the cross-language fixture pair (see bad.c).
+
+Never imported — parsed by the xp analyses. One seeded drift per rule
+facet; the gate tests in tests/test_lint_clean.py pin the findings.
+"""
+
+import ctypes
+import struct
+import threading
+
+lib = ctypes.CDLL("libbx.so")
+
+BX_MAGIC = 8  # cxx-const: BX_MAGIC
+
+_LOCK = threading.Lock()
+
+# no restype: bx_open returns void* and the c_int default truncates it
+lib.bx_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+
+# arity drift: the C signature has 4 parameters
+lib.bx_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                       ctypes.c_uint64]
+lib.bx_put.restype = ctypes.c_int
+
+# width drift: `flags` is unsigned int (32-bit) on the C side
+lib.bx_width.argtypes = [ctypes.c_void_p, ctypes.c_ushort]
+lib.bx_width.restype = ctypes.c_int
+
+# pointer-vs-value drift: `out` is uint64_t* on the C side
+lib.bx_byref.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+
+# undeclared export: no extern "C" symbol of this name exists
+lib.bx_missing.argtypes = [ctypes.c_void_p]
+
+lib.bx_join_stop.argtypes = [ctypes.c_void_p]
+
+NATIVE_PLANE = {
+    "bx_gone": "stale: no dispatch arm mentions this type",
+}
+
+
+class BxRec(ctypes.Structure):
+    # flags: c_uint16 vs uint32_t (width); tag: 8 vs [4] (array len)
+    _fields_ = [
+        ("seq", ctypes.c_uint64),
+        ("flags", ctypes.c_uint16),
+        ("tag", ctypes.c_uint8 * 8),
+    ]
+
+
+def read_frame(buf: bytes) -> int:
+    (length,) = struct.unpack("<Q", buf[:8])  # cxx-wire: bx-frame
+    return length
+
+
+def poke(h) -> int:
+    # call with no argtypes/restype declaration anywhere
+    return lib.bx_undeclared_on_py(h)
+
+
+def stop(h) -> None:
+    with _LOCK:
+        lib.bx_join_stop(h)

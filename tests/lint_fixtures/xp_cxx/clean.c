@@ -1,0 +1,44 @@
+// Silent half of the cross-language fixture pair: every declaration,
+// constant, struct layout and wire frame here matches
+// clean_wrapper.py exactly. Never compiled — parsed by cxx.py.
+#include <stdint.h>
+
+#define CW_MAGIC 7
+
+extern "C" {
+
+struct CwRec {
+  uint64_t seq;
+  uint32_t flags;
+  uint8_t tag[4];
+};
+
+void* cw_open(const char* name, uint64_t cap) {
+  (void)name; (void)cap;
+  return nullptr;
+}
+
+int cw_put(void* h, const uint8_t* id, uint64_t size, int pin) {
+  (void)h; (void)id; (void)size; (void)pin;
+  return 0;
+}
+
+uint32_t cw_count(void* h) {
+  (void)h;
+  return 0;
+}
+
+// locks but never blocks unboundedly: no finding even when the
+// wrapper calls it under a lock
+void cw_touch(void* h) {
+  (void)h;
+  std::lock_guard<std::mutex> lk(g_cw_mu);
+}
+
+void cw_frame_read(const unsigned char* p) {
+  uint32_t len = 0;
+  __builtin_memcpy(&len, p, 4);  // cxx-wire: cw-frame <I
+  (void)len;
+}
+
+}  // extern "C"

@@ -1,0 +1,40 @@
+"""Silent half of the cross-language fixture pair (see clean.c):
+declarations, mirror, const pin and wire pin all match exactly."""
+
+import ctypes
+import struct
+import threading
+
+lib = ctypes.CDLL("libcw.so")
+
+CW_MAGIC = 7  # cxx-const: CW_MAGIC
+
+_LOCK = threading.Lock()
+
+lib.cw_open.restype = ctypes.c_void_p
+lib.cw_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+lib.cw_put.restype = ctypes.c_int
+lib.cw_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                       ctypes.c_uint64, ctypes.c_int]
+lib.cw_count.restype = ctypes.c_uint32
+lib.cw_count.argtypes = [ctypes.c_void_p]
+lib.cw_touch.argtypes = [ctypes.c_void_p]
+
+
+class CwRec(ctypes.Structure):
+    _fields_ = [
+        ("seq", ctypes.c_uint64),
+        ("flags", ctypes.c_uint32),
+        ("tag", ctypes.c_uint8 * 4),
+    ]
+
+
+def read_frame(buf: bytes) -> int:
+    (length,) = struct.unpack("<I", buf[:4])  # cxx-wire: cw-frame
+    return length
+
+
+def touch(h) -> None:
+    # lock held across the boundary into a BOUNDED native call: silent
+    with _LOCK:
+        lib.cw_touch(h)

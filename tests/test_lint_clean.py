@@ -48,6 +48,15 @@ def xp_tree():
     return findings, inventory, stats
 
 
+@pytest.fixture(scope="session")
+def cxx_tree():
+    """One C++ index of src/ + cpp/ shared by the native-boundary
+    gate tests, for the same reason as xp_tree."""
+    from ray_tpu.devtools.xp import cxx
+
+    return cxx.build(PKG)
+
+
 def test_rule_registry_complete():
     expected = {
         "blocking-under-lock", "unguarded-handle-teardown",
@@ -247,6 +256,8 @@ def test_xp_rule_registry_complete():
         "xp-ref-leak", "xp-ref-get-in-loop",
         "xp-jit-host-sync", "xp-jit-impure-mutation",
         "xp-jit-static-args",
+        "xp-ffi-signature", "xp-ffi-layout",
+        "xp-xlang-protocol", "xp-xlang-lock", "cxx-parse-error",
     }
     assert expected <= set(XP_RULES), sorted(XP_RULES)
     # the registries must not collide: one namespace for --select
@@ -258,7 +269,8 @@ def test_xp_rule_registry_complete():
     claimed = [r for rules in ANALYSIS_RULES.values() for r in rules]
     assert len(claimed) == len(set(claimed))
     assert set(claimed) <= set(XP_RULES)
-    for name in ("contracts", "reflife", "jitlint"):
+    for name in ("contracts", "reflife", "jitlint", "ffi_sig",
+                 "ffi_layout", "xlang"):
         assert ANALYSIS_RULES[name], name
 
 
@@ -279,8 +291,11 @@ def test_xp_stats_populated(xp_tree):
     _, _, stats = xp_tree
     assert stats["files"] > 100
     assert stats["call_edges"] > 1000
+    # the cross-language pass parsed the native plane's sources
+    assert stats["cxx_files"] >= 8, stats
+    assert stats["cxx_exports"] >= 50, stats
     for name in ("lockgraph", "protocol", "contracts", "reflife",
-                 "jitlint"):
+                 "jitlint", "ffi_sig", "ffi_layout", "xlang"):
         assert name in stats["analyses"], sorted(stats["analyses"])
         # pre-suppression kept-finding count; suppression splits are
         # computed downstream by _render_stats
@@ -343,11 +358,12 @@ def test_xp_inventory_accounts_for_control_plane(xp_tree):
             and by_type["pull_complete"]["handlers"])
 
 
-def test_xp_inventory_marks_native_plane(xp_tree):
+def test_xp_inventory_marks_native_plane(xp_tree, cxx_tree):
     """Dispatch-socket ops the C++ front end (src/node_dispatch.cc)
-    also implements must carry the static native-plane annotation —
-    the AST pass can't see C++, and an unannotated native op would
-    make the inventory lie about which plane answers it."""
+    also implements carry the native-plane annotation — and since the
+    cxx pass, that annotation is DERIVED-and-checked: its key set must
+    equal the dispatch surface parsed out of the C++ sources, and each
+    inventory row records the C++ site it came from."""
     from ray_tpu.devtools.xp.protocol import NATIVE_PLANE
 
     _, inventory, _ = xp_tree
@@ -355,10 +371,18 @@ def test_xp_inventory_marks_native_plane(xp_tree):
     for t in ("ping", "pong", "task", "result"):
         assert t in NATIVE_PLANE
         assert by_type[t].get("native") == NATIVE_PLANE[t]
+        assert "node_dispatch.cc" in by_type[t].get("native_site", ""), (
+            by_type[t])
     # and the annotation never outlives the Python vocabulary: every
     # NATIVE_PLANE key must still be a real message type
     assert set(NATIVE_PLANE) <= set(by_type), (
         set(NATIVE_PLANE) - set(by_type))
+    # the derivation itself: annotation keys == the parsed native
+    # dispatch surface (a drift either way is an xp-xlang-protocol
+    # finding, which test_xp_tree_is_clean would also catch)
+    derived = set(cxx_tree.dispatch) | set(cxx_tree.surface_sent)
+    assert set(NATIVE_PLANE) == derived, (
+        set(NATIVE_PLANE) ^ derived)
 
 
 def test_xp_baseline_suppresses_and_flags_stale(tmp_path):
@@ -467,6 +491,107 @@ def test_xp_jitlint_rules_fire():
     assert len(statics) == 1 and "only 2 positional" in statics[0].message
     clean = [f for f in findings if f.path.endswith("clean.py")]
     assert not clean, [f.render() for f in clean]
+
+
+def test_xp_cxx_rules_fire():
+    """Every seeded cross-language drift in the bad.c/bad_wrapper.py
+    pair is caught with both sides of the boundary in the message; the
+    clean pair stays silent."""
+    findings, _ = run_xp([os.path.join(FIXTURES, "xp_cxx")], None)
+    bad = [f for f in findings
+           if "bad" in os.path.basename(f.path)]
+    by_rule = {}
+    for f in bad:
+        by_rule.setdefault(f.rule, []).append(f)
+
+    sig = by_rule.get("xp-ffi-signature", [])
+    assert len(sig) == 6, [f.render() for f in bad]
+    msgs = "\n".join(f.message for f in sig)
+    assert "arity mismatch" in msgs                      # bx_put
+    assert "width mismatch" in msgs                      # bx_width
+    assert "pointer-vs-value" in msgs                    # bx_byref
+    assert "no extern \"C\" symbol" in msgs              # bx_missing
+    assert "no argtypes/restype are ever declared" in msgs
+    assert "truncates it to 32 bits" in msgs             # bx_open
+    # both sides of the boundary are in the message (file:line of the
+    # C signature next to the Python declaration's own anchor)
+    assert all("bad.c:" in f.message for f in sig
+               if "no extern" not in f.message)
+
+    layout = by_rule.get("xp-ffi-layout", [])
+    assert len(layout) == 4, [f.render() for f in bad]
+    lmsgs = "\n".join(f.message for f in layout)
+    assert "`BX_MAGIC` = 7" in lmsgs                     # const pin
+    assert "array of 8" in lmsgs                         # tag[4]
+    assert "c_uint16 is 16-bit but C uint32_t" in lmsgs  # flags
+    assert '"<Q"' in lmsgs and '"<I"' in lmsgs           # wire fmt
+
+    proto = by_rule.get("xp-xlang-protocol", [])
+    assert len(proto) == 2, [f.render() for f in bad]
+    stale = [f for f in proto if "stale annotation" in f.message]
+    assert len(stale) == 1 and '"bx_gone"' in stale[0].message
+    assert stale[0].path.endswith("bad_wrapper.py")
+    missing = [f for f in proto if "missing annotation" in f.message]
+    assert len(missing) == 1 and '"bx_task"' in missing[0].message
+    assert missing[0].path.endswith("bad.c")             # C++ anchor
+
+    lock = by_rule.get("xp-xlang-lock", [])
+    assert len(lock) == 2, [f.render() for f in bad]
+    fwd = [f for f in lock if "bx_join_stop" in f.message]
+    assert len(fwd) == 1 and "_LOCK" in fwd[0].message
+    assert "joins" in fwd[0].message and "bad.c:" in fwd[0].message
+    rev = [f for f in lock if "PyGILState_Ensure" in f.message]
+    assert len(rev) == 1 and "g_mu" in rev[0].message
+
+    perr = by_rule.get("cxx-parse-error", [])
+    assert len(perr) == 1 and "bx_mangled" in perr[0].message
+
+    assert len(bad) == 15, [f.render() for f in bad]
+    clean = [f for f in findings
+             if "clean" in os.path.basename(f.path)]
+    assert not clean, [f.render() for f in clean]
+
+
+def test_cxx_extractor_parses_native_surface(cxx_tree):
+    """The clang-free extractor reads the real native plane: every
+    extern "C" block parses, the hot exports carry full signatures,
+    and the hand-copied harness declarations agree with the
+    definitions (the relay_stress_test.cc rts_get declaration once
+    dropped the `pin` parameter — ABI drift this pins down)."""
+    idx = cxx_tree
+    assert not idx.errors, idx.errors
+    assert len(idx.files) >= 8
+    get = idx.lookup("rts_get")
+    assert get is not None and len(get.params) == 5, get
+    for occ in idx.functions["rts_get"]:
+        if occ.exported:
+            assert len(occ.params) == 5, (
+                f"{occ.path}:{occ.line} drifted from the rts_get "
+                f"definition")
+    # struct layout extraction: the shm slot table is mirrorable and
+    # its id field is kIdLen bytes wide
+    slot = idx.structs["Slot"]
+    assert slot.mirrorable
+    id_field = slot.fields[0]
+    assert id_field.name == "id" and id_field.count == 28
+    assert idx.constants["kIdLen"][0] == 28
+    # lock/blocking summaries drive the xlang pass
+    nd_stop = idx.lookup("nd_stop")
+    assert nd_stop.blocking and "join" in nd_stop.blocking[0][0]
+
+
+def test_src_make_lint_target():
+    """`make -C src lint` runs the extractor standalone and exits 0 on
+    the current sources (nonzero would mean an unparseable extern "C"
+    block slipped in)."""
+    import shutil
+
+    if shutil.which("make") is None:
+        pytest.skip("make not available")
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "src"),
+                        "lint"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'extern "C" definition(s)' in r.stdout
 
 
 def test_rule_doc_inventory_complete():
