@@ -24,10 +24,21 @@ loop thread) holds aggregate flat-to-down as threads rise; the native
 plane (src/node_dispatch.cc: epoll + off-GIL admission) should let
 concurrent round-trips overlap.
 
+A third shape, DISPATCH LATENCY, is a single client doing sequential
+round-trips and recording p50/p99 — the per-task dispatch cost the
+native worker hand-off (ISSUE 15) is meant to shrink. On the native
+plane the daemon's task_native_handoff stat (admission→worker-write)
+rides in the row so the C-side slice of the latency is attributable.
+
 Both shapes run under RAY_TPU_NATIVE_DISPATCH=1 and =0 and record
-scale_multiclient_* / scale_threadstorm_* rows in BENCH_HISTORY.json
-with a `dispatch` match key, so native and Python curves form separate
-comparable series.
+scale_multiclient_* / scale_threadstorm_* / scale_dispatch_latency_*
+rows in BENCH_HISTORY.json with a `dispatch` match key, so native and
+Python curves form separate comparable series. Every row carries
+cpu_count, per-plane CPU seconds (client_cpu_s from the driver
+processes, daemon_cpu_s from the daemons' own rusage via the load
+report) and the drainer busy-fraction, so a reader can tell protocol
+effects from core saturation: on a 1-core box (loud stderr caveat)
+the aggregate ceiling is the core, not the protocol.
 
 Run: python bench_multiclient.py [--quick] [--dispatch native|python|both]
 """
@@ -58,9 +69,11 @@ def noop():
     return None
 
 ray.get([noop.remote() for _ in range(16)])  # warm dispatch + workers
+c0 = time.process_time()
 t0 = time.perf_counter()
 ray.get([noop.remote() for _ in range(n_tasks)])
 task_dt = time.perf_counter() - t0
+task_cpu = time.process_time() - c0
 
 @ray.remote
 class Echo:
@@ -69,11 +82,14 @@ class Echo:
 
 a = Echo.remote()
 ray.get(a.ping.remote())
+c0 = time.process_time()
 t0 = time.perf_counter()
 ray.get([a.ping.remote() for _ in range(n_calls)])
 act_dt = time.perf_counter() - t0
+act_cpu = time.process_time() - c0
 print(json.dumps({"tasks_s": n_tasks / task_dt,
-                  "actor_calls_s": n_calls / act_dt}))
+                  "actor_calls_s": n_calls / act_dt,
+                  "cpu_s": task_cpu + act_cpu}))
 """
 
 _STORM_CHILD = r"""
@@ -111,11 +127,41 @@ threads = [threading.Thread(target=storm, args=(i,), daemon=True)
 for t in threads:
     t.start()
 gate.wait()
+c0 = time.process_time()
 t0 = time.perf_counter()
 for t in threads:
     t.join()
 dt = time.perf_counter() - t0
-print(json.dumps({"tasks_s": sum(counts) / dt}))
+print(json.dumps({"tasks_s": sum(counts) / dt,
+                  "cpu_s": time.process_time() - c0}))
+"""
+
+_LAT_CHILD = r"""
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.getcwd())  # parent sets cwd to the repo root
+import ray_tpu as ray
+
+addr, n = sys.argv[1], int(sys.argv[2])
+ray.init(address=addr, num_cpus=0, num_tpus=0)
+
+@ray.remote
+def noop():
+    return None
+
+ray.get([noop.remote() for _ in range(16)])  # warm dispatch + workers
+lats = []
+c0 = time.process_time()
+for _ in range(n):
+    t0 = time.perf_counter()
+    ray.get(noop.remote())
+    lats.append(time.perf_counter() - t0)
+cpu = time.process_time() - c0
+lats.sort()
+print(json.dumps({
+    "p50_us": lats[len(lats) // 2] * 1e6,
+    "p99_us": lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e6,
+    "n": n, "cpu_s": cpu}))
 """
 
 
@@ -138,6 +184,7 @@ def run_clients(addr: str, n_clients: int, n_tasks: int,
         "agg_actor_calls_s": sum(o["actor_calls_s"] for o in outs),
         "per_client_actor_calls_s": [round(o["actor_calls_s"], 1)
                                      for o in outs],
+        "client_cpu_s": round(sum(o["cpu_s"] for o in outs), 3),
     }
 
 
@@ -150,13 +197,82 @@ def run_storm(addr: str, n_threads: int, per_thread: int) -> dict:
     out, _ = p.communicate(timeout=600)
     line = out.strip().splitlines()[-1]
     r = json.loads(line)
-    return {"threads": n_threads, "agg_tasks_s": r["tasks_s"]}
+    return {"threads": n_threads, "agg_tasks_s": r["tasks_s"],
+            "client_cpu_s": round(r["cpu_s"], 3)}
+
+
+def run_latency(addr: str, n: int) -> dict:
+    p = subprocess.Popen(
+        [sys.executable, "-c", _LAT_CHILD, addr, str(n)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    out, _ = p.communicate(timeout=600)
+    r = json.loads(out.strip().splitlines()[-1])
+    return {"p50_us": round(r["p50_us"], 1),
+            "p99_us": round(r["p99_us"], 1), "n": r["n"],
+            "client_cpu_s": round(r["cpu_s"], 3)}
+
+
+def _daemon_sample(node_ids) -> dict:
+    """Daemon-side CPU accounting over the load report: summed
+    process CPU seconds (rusage) and drainer busy seconds across the
+    cluster's daemons. Deltas around a measured section give the
+    per-plane cost of that section; the drainer busy delta divided by
+    wall time is the busy-fraction (≈0 on the native warm path, where
+    the drainer never runs for plain tasks)."""
+    from ray_tpu.core import runtime as _runtime
+
+    rt = _runtime.global_runtime()
+    cpu = 0.0
+    busy = 0.0
+    handoff: dict = {}
+    for nid in node_ids:
+        load = rt.scheduler.get_node(nid).client.call(
+            {"type": "ping"})["load"]
+        cpu += load.get("proc_cpu_s", 0.0)
+        busy += load.get("drainers", {}).get("busy_s_total", 0.0)
+        for k, v in (load.get("native_handoff") or {}).items():
+            handoff[k] = handoff.get(k, 0) + v
+    return {"cpu_s": cpu, "drainer_busy_s": busy, "handoff": handoff}
+
+
+class _PlaneMeter:
+    """Wraps one measured section: wall clock + daemon CPU deltas."""
+
+    def __init__(self, node_ids):
+        self.node_ids = node_ids
+
+    def __enter__(self):
+        import time as _time
+
+        self._t0 = _time.perf_counter()
+        self._s0 = _daemon_sample(self.node_ids)
+        return self
+
+    def __exit__(self, *exc):
+        import time as _time
+
+        s1 = _daemon_sample(self.node_ids)
+        self.wall_s = _time.perf_counter() - self._t0
+        self.daemon_cpu_s = round(s1["cpu_s"] - self._s0["cpu_s"], 3)
+        self.drainer_busy_frac = round(
+            (s1["drainer_busy_s"] - self._s0["drainer_busy_s"])
+            / max(self.wall_s, 1e-9), 4)
+        self.handoff = s1["handoff"]
+        return False
+
+    def row_extra(self) -> dict:
+        return {"cpu_count": os.cpu_count(),
+                "daemon_cpu_s": self.daemon_cpu_s,
+                "drainer_busy_frac": self.drainer_busy_frac}
 
 
 def run_suite(dispatch: str, n_tasks: int, n_calls: int,
-              per_thread: int, record: bool = True) -> None:
-    """One full pass (multiclient + thread storm) under one dispatch
-    plane; nodes inherit RAY_TPU_NATIVE_DISPATCH via the env overlay."""
+              per_thread: int, n_lat: int,
+              record: bool = True) -> None:
+    """One full pass (multiclient + thread storm + dispatch latency)
+    under one dispatch plane; nodes inherit RAY_TPU_NATIVE_DISPATCH
+    via the env overlay."""
     from ray_tpu.cluster_utils import RealCluster
 
     env = {"RAY_TPU_NATIVE_DISPATCH":
@@ -168,13 +284,19 @@ def run_suite(dispatch: str, n_tasks: int, n_calls: int,
         except Exception:  # noqa: BLE001
             bench = None
 
+    node_ids = ("daemon-1", "daemon-2")
     cluster = RealCluster()
     try:
         for _ in range(2):
             cluster.add_node(num_cpus=4, env=env)
+        # The parent joins with no resources purely to sample the
+        # daemons' load reports (proc_cpu_s, drainer busy seconds)
+        # around each measured section.
+        cluster.connect(num_cpus=0)
         base = None
         for n in (1, 2, 4):
-            r = run_clients(cluster.address, n, n_tasks, n_calls)
+            with _PlaneMeter(node_ids) as m:
+                r = run_clients(cluster.address, n, n_tasks, n_calls)
             if base is None:
                 base = r
             # Degradation: per-client rate vs the single-client rate.
@@ -187,6 +309,7 @@ def run_suite(dispatch: str, n_tasks: int, n_calls: int,
             # aggregate >= 90% of 1-driver aggregate, native).
             r["agg_vs_1client"] = round(
                 r["agg_tasks_s"] / base["agg_tasks_s"], 3)
+            r.update(m.row_extra())
             print(json.dumps({
                 "metric": f"multiclient_{n}", "dispatch": dispatch,
                 "value": round(r["agg_tasks_s"], 1),
@@ -199,32 +322,66 @@ def run_suite(dispatch: str, n_tasks: int, n_calls: int,
                     match={"dispatch": dispatch},
                     extra={"per_client": r["per_client_tasks_s"],
                            "vs_1client": r["tasks_per_client_vs_1"],
-                           "agg_vs_1client": r["agg_vs_1client"]})
+                           "agg_vs_1client": r["agg_vs_1client"],
+                           "client_cpu_s": r["client_cpu_s"],
+                           **m.row_extra()})
                 bench.push_history(
                     f"scale_multiclient_{n}_actor_calls_s",
                     r["agg_actor_calls_s"], "calls/s",
                     match={"dispatch": dispatch},
                     extra={"per_client": r["per_client_actor_calls_s"],
                            "vs_1client":
-                               r["actor_calls_per_client_vs_1"]})
+                               r["actor_calls_per_client_vs_1"],
+                           "client_cpu_s": r["client_cpu_s"],
+                           **m.row_extra()})
         storm_base = None
         for n in (1, 4, 8):
-            s = run_storm(cluster.address, n, per_thread)
+            with _PlaneMeter(node_ids) as m:
+                s = run_storm(cluster.address, n, per_thread)
             if storm_base is None:
                 storm_base = s
             s["agg_vs_1thread"] = round(
                 s["agg_tasks_s"] / storm_base["agg_tasks_s"], 3)
+            s.update(m.row_extra())
             print(json.dumps({
                 "metric": f"threadstorm_{n}", "dispatch": dispatch,
                 "value": round(s["agg_tasks_s"], 1),
                 "unit": "tasks/s",
-                "agg_vs_1thread": s["agg_vs_1thread"]}), flush=True)
+                **{k: v for k, v in s.items()
+                   if k != "threads"}}), flush=True)
             if bench is not None:
                 bench.push_history(
                     f"scale_threadstorm_{n}_tasks_s",
                     s["agg_tasks_s"], "tasks/s",
                     match={"dispatch": dispatch},
-                    extra={"agg_vs_1thread": s["agg_vs_1thread"]})
+                    extra={"agg_vs_1thread": s["agg_vs_1thread"],
+                           "client_cpu_s": s["client_cpu_s"],
+                           **m.row_extra()})
+        # Dispatch latency: single client, sequential round-trips.
+        # p50 is the headline (the native hand-off's target); p99
+        # catches scheduling jitter. On the native plane the daemon's
+        # admission→worker-write stat attributes the C-side slice.
+        with _PlaneMeter(node_ids) as m:
+            lat = run_latency(cluster.address, n_lat)
+        extra = {"p99_us": lat["p99_us"], "n": lat["n"],
+                 "client_cpu_s": lat["client_cpu_s"], **m.row_extra()}
+        if dispatch == "native":
+            extra["handoff"] = m.handoff
+            from ray_tpu.core import runtime as _runtime
+            es = _runtime.global_runtime().scheduler.get_node(
+                "daemon-1").client.call(
+                    {"type": "ping"})["load"]["event_stats"]
+            extra["handoff_stats"] = es.get(
+                "node_dispatch_native", {}).get("task_native_handoff")
+        print(json.dumps({
+            "metric": "dispatch_latency", "dispatch": dispatch,
+            "value": lat["p50_us"], "unit": "us_p50", **extra}),
+            flush=True)
+        if bench is not None:
+            bench.push_history("scale_dispatch_latency_us",
+                               lat["p50_us"], "us_p50",
+                               match={"dispatch": dispatch},
+                               extra=extra)
     finally:
         cluster.shutdown()
 
@@ -238,11 +395,23 @@ def main() -> None:
     n_tasks = 200 if args.quick else 2000
     n_calls = 200 if args.quick else 2000
     per_thread = 50 if args.quick else 250
+    n_lat = 100 if args.quick else 1000
+
+    if (os.cpu_count() or 1) == 1:
+        print("=" * 70, file=sys.stderr)
+        print("WARNING: os.cpu_count() == 1 — clients, daemons, and "
+              "workers all\nshare one core. Aggregate throughput and "
+              "retention on this box\nmeasure core-sharing fairness, "
+              "NOT protocol scaling; treat absolute\nnumbers and "
+              "cross-plane deltas accordingly (per-plane CPU seconds\n"
+              "in each row show where the core actually went).",
+              file=sys.stderr)
+        print("=" * 70, file=sys.stderr)
 
     modes = (["native", "python"] if args.dispatch == "both"
              else [args.dispatch])
     for mode in modes:
-        run_suite(mode, n_tasks, n_calls, per_thread,
+        run_suite(mode, n_tasks, n_calls, per_thread, n_lat,
                   record=not args.quick)
 
 

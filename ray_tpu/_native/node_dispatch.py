@@ -31,6 +31,7 @@ FLAG_JSON = 2  # cxx-const: kFlagJson
 
 EV_MESSAGE = 0
 EV_CLOSED = 1
+EV_WORKER_DEAD = 2  # cxx-const: kEvWorkerDead
 
 
 def available() -> bool:
@@ -79,6 +80,24 @@ def _load_lib():
     lib.nd_stats_json.restype = ctypes.c_int
     lib.nd_stats_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                   ctypes.c_int]
+    lib.nd_worker_register.restype = ctypes.c_int
+    lib.nd_worker_register.argtypes = [
+        ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p]
+    lib.nd_worker_unregister.restype = ctypes.c_int
+    lib.nd_worker_unregister.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_ulonglong]
+    lib.nd_worker_acquire.restype = ctypes.c_longlong
+    lib.nd_worker_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.nd_worker_release.restype = ctypes.c_int
+    lib.nd_worker_release.argtypes = [ctypes.c_void_p,
+                                      ctypes.c_ulonglong, ctypes.c_char_p]
+    lib.nd_workers_json.restype = ctypes.c_int
+    lib.nd_workers_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int]
+    lib.nd_handoff_json.restype = ctypes.c_int
+    lib.nd_handoff_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int]
     lib.nd_spilled.restype = ctypes.c_ulonglong
     lib.nd_spilled.argtypes = [ctypes.c_void_p]
     lib.nd_stop.restype = None
@@ -225,6 +244,74 @@ class NativeDispatch:
             if not self._h:
                 return {}
             rc = self._lib.nd_ledger_get(self._h, buf, len(buf))
+        if rc < 0:
+            return {}
+        return self._json.loads(buf.value.decode())
+
+    # -- idle-worker registry (native hand-off) --------------------------
+    def worker_register(self, wid: int, fd: int, pid: int,
+                        fids: List[bytes] = ()) -> bool:
+        """Hand a worker socket to the native loop. The C side dups the
+        fd (Python keeps its socket object for cold-path runs after
+        ``worker_acquire``); ``fids`` lists the fn ids the worker has
+        cached, matched against the driver's hybrid-frame header."""
+        csv = ",".join(f.hex() for f in fids).encode()
+        with self._guard.read():
+            if not self._h:
+                return False
+            return self._lib.nd_worker_register(self._h, wid, fd, pid,
+                                                csv) == 0
+
+    def worker_unregister(self, wid: int) -> bool:
+        """Deliberate removal (retire/discard): no death event fires."""
+        with self._guard.read():
+            if not self._h:
+                return False
+            return self._lib.nd_worker_unregister(self._h, wid) == 1
+
+    def worker_acquire(self, timeout_ms: int = 200):
+        """Check an idle worker out for the Python cold path. Returns
+        its wid (>= 0 — ids start at 0, so the sentinels are negative),
+        or None on timeout. Raises StopIteration once stopped."""
+        with self._guard.read():
+            if not self._h:
+                raise StopIteration
+            rc = self._lib.nd_worker_acquire(self._h, timeout_ms)
+        if rc == -1:
+            return None
+        if rc < 0:
+            raise StopIteration
+        return int(rc)
+
+    def worker_release(self, wid: int, fids: List[bytes] = ()) -> bool:
+        """Return an acquired worker. False when the wid is unknown —
+        the caller re-registers instead (fresh spawn, stale entry)."""
+        csv = ",".join(f.hex() for f in fids).encode()
+        with self._guard.read():
+            if not self._h:
+                return False
+            return self._lib.nd_worker_release(self._h, wid, csv) == 1
+
+    def workers(self) -> List[Dict]:
+        """Registry snapshot: [{"wid","pid","state","tid"?}] — "tid" is
+        the hex task id on busy entries (shm attribution labels)."""
+        buf = ctypes.create_string_buffer(1 << 16)
+        with self._guard.read():
+            if not self._h:
+                return []
+            rc = self._lib.nd_workers_json(self._h, buf, len(buf))
+        if rc < 0:
+            return []
+        return self._json.loads(buf.value.decode())
+
+    def handoff(self) -> Dict[str, int]:
+        """Hand-off plane counters: workers/idle/busy/py_owned/pending
+        gauges plus handoffs/completed/worker_deaths/overflow totals."""
+        buf = ctypes.create_string_buffer(1024)
+        with self._guard.read():
+            if not self._h:
+                return {}
+            rc = self._lib.nd_handoff_json(self._h, buf, len(buf))
         if rc < 0:
             return {}
         return self._json.loads(buf.value.decode())

@@ -205,6 +205,12 @@ def main() -> None:
             fn_cache[fid] = cloudpickle.loads(msg["fn"])
         return fn_cache[fid]
 
+    # Strictly read-one/reply-one over the dedicated daemon socket:
+    # one task is in flight per worker at a time. The native hand-off
+    # plane (src/node_dispatch.cc) relies on this — replies carry no
+    # connection tag because the loop can attribute each reply to the
+    # single driver connection whose task the worker is running. Any
+    # future pipelining here would need a conn-id echoed in replies.
     while True:
         msg = recv_msg(sock)
         mtype = msg.get("type")

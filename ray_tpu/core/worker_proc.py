@@ -195,6 +195,21 @@ class WorkerPool:
         self._lock = threading.Lock()
         self._next_id = 0
         self._closed = False
+        # Native-dispatch hooks (set by the node daemon after its C
+        # loop starts; None = pure-Python pool, unchanged behavior):
+        #   idle_sink(w) -> bool    consume an idling worker (register
+        #                           its socket with the native loop);
+        #                           False = keep it in _idle
+        #   idle_source(timeout) -> WorkerProcess | None
+        #                           one bounded wait for an idle worker
+        #                           owned by the native loop; acquire()
+        #                           loops on None
+        #   on_discard(w)           worker leaving the pool for good
+        #                           (retire/discard) — unregister it
+        self.idle_sink: Optional[Callable[[WorkerProcess], bool]] = None
+        self.idle_source: Optional[
+            Callable[[Optional[float]], Optional[WorkerProcess]]] = None
+        self.on_discard: Optional[Callable[[WorkerProcess], None]] = None
 
         self._sock_dir = tempfile.mkdtemp(prefix="ray_tpu_")
         self._sock_path = os.path.join(self._sock_dir, "workers.sock")
@@ -325,7 +340,9 @@ class WorkerPool:
 
     def _spawn(self) -> WorkerProcess:
         w = self._spawn_proc()
-        self._idle.put(w)
+        sink = self.idle_sink
+        if sink is None or not sink(w):
+            self._idle.put(w)
         return w
 
     def spawn_dedicated(self) -> WorkerProcess:
@@ -341,10 +358,18 @@ class WorkerPool:
         pool capacity."""
         with self._lock:
             self._all.pop(w.worker_id, None)
+        cb = self.on_discard
+        if cb is not None:
+            with contextlib.suppress(Exception):
+                cb(w)
         try:
             w.shutdown()
         except Exception:  # noqa: BLE001
             pass
+
+    def get_worker(self, wid: int) -> Optional[WorkerProcess]:
+        with self._lock:
+            return self._all.get(wid)
 
     def acquire(self, timeout: Optional[float] = None) -> WorkerProcess:
         deadline = time.monotonic() + timeout if timeout else None
@@ -352,7 +377,13 @@ class WorkerPool:
             left = (deadline - time.monotonic()) if deadline else None
             if left is not None and left <= 0:
                 raise TimeoutError("no idle worker")
-            w = self._idle.get(timeout=left)
+            src = self.idle_source
+            if src is not None:
+                w = src(left)
+                if w is None:
+                    continue
+            else:
+                w = self._idle.get(timeout=left)
             if w.alive and w.proc.poll() is None:
                 return w
             self._discard(w)
@@ -368,7 +399,9 @@ class WorkerPool:
         if self._closed:
             return
         if w.alive and w.proc.poll() is None:
-            self._idle.put(w)
+            sink = self.idle_sink
+            if sink is None or not sink(w):
+                self._idle.put(w)
         else:
             self._discard(w)
 
@@ -378,6 +411,10 @@ class WorkerPool:
         only; dedicated actor workers are replaced by actor restart)."""
         with self._lock:
             self._all.pop(w.worker_id, None)
+        cb = self.on_discard
+        if cb is not None:
+            with contextlib.suppress(Exception):
+                cb(w)
         try:
             w.shutdown()
         except Exception:  # noqa: BLE001

@@ -62,9 +62,12 @@ NATIVE_PLANE = {
     "ping": "handled off-GIL (pong written natively unless tracing)",
     "pong": "sent natively with live ledger availability spliced in",
     "task": "admission header parsed; check-and-charge + spillback "
-            "refusal natively, body handed to Python on admission",
-    "result": "spillback refusals written natively (retry_at from "
-              "the pushed peer digest)",
+            "refusal natively; plain tasks handed straight to an "
+            "idle worker's socket (zero daemon-side Python), others "
+            "to Python on admission",
+    "result": "spillback refusals, worker-death crash replies, and "
+              "natively handed-off task results written natively "
+              "(retry_at from the pushed peer digest)",
     "gen_ack": "framed natively, routed to the owning stream's "
                "drainer without per-handler timing",
     "pull_complete": "framed natively without per-handler timing",

@@ -40,7 +40,12 @@ def hybrid_frame(msg: Dict[str, Any]) -> bytes:
     body = cloudpickle.dumps(msg)
     header: Dict[str, Any] = {"type": msg.get("type")}
     tid = msg.get("task_id")
-    if isinstance(tid, bytes) and tid:
+    if not isinstance(tid, bytes):
+        # The driver puts a TaskID object in the message; the header
+        # wants raw bytes.
+        binary = getattr(tid, "binary", None)
+        tid = binary() if callable(binary) else None
+    if tid:
         header["tid"] = tid.hex()
     res = msg.get("resources")
     if res:
@@ -50,6 +55,27 @@ def hybrid_frame(msg: Dict[str, Any]) -> bytes:
     exclude = msg.get("spill_exclude")
     if exclude:
         header["exclude"] = sorted(exclude)
+    # "plain" marks the task eligible for the daemon's native worker
+    # hand-off: the C loop may forward the body straight to an idle
+    # worker with zero daemon-side Python. Anything needing Python
+    # policy — streaming, prefetch, runtime_env, max_calls recycling,
+    # placement-constrained (non-spillable) tasks — stays cold.
+    # Traced tasks DO go warm: the worker's execution spans ride the
+    # forwarded reply verbatim, so the only loss is the daemon's own
+    # dispatch span (the trace shows submit → execute with no
+    # daemon:task node in between). plain ⇒ spillable, so a nonempty
+    # res is precharged (or refused) by the native admission block
+    # before hand-off.
+    fid = msg.get("fid")
+    if (msg.get("type") == "task" and msg.get("spillable")
+            and not msg.get("streaming") and not msg.get("fetch")
+            and not msg.get("runtime_env") and not msg.get("max_calls")
+            and isinstance(tid, bytes) and tid
+            and isinstance(fid, bytes) and fid):
+        header["plain"] = True
+        header["fid"] = fid.hex()
+        if msg.get("fn") is not None:
+            header["has_fn"] = True
     h = json.dumps(header).encode()
     payload_len = 1 + _HLEN.size + len(h) + len(body)
     return b"".join((_LEN.pack(payload_len), b"\x01",

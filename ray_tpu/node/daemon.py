@@ -289,6 +289,12 @@ class NodeDaemon:
         self._drainers: List[threading.Thread] = []
         self._drainer_busy = 0
         self._drainer_cap = max(64, 4 * n_workers)
+        # Warm-path accounting: _py_exec_tasks counts tasks the PYTHON
+        # plane executed (the parity suite's zero-Python assertion
+        # reads it from load reports); _drainer_busy_s accumulates
+        # drainer wall-time (the bench's GIL-contention proxy).
+        self._py_exec_tasks = 0
+        self._drainer_busy_s = 0.0
         if os.environ.get("RAY_TPU_NATIVE_DISPATCH", "1") != "0":
             try:
                 from ray_tpu._native import node_dispatch as _ndmod
@@ -356,6 +362,15 @@ class NodeDaemon:
                 self._nd.set_load_report(self._load_report())
             self._push_nd_peers()
             self._nd.start()
+            # Warm path: idle workers live in the C loop's registry so
+            # plain tasks are forwarded straight to a worker socket with
+            # zero daemon-side Python. The hooks keep pool.acquire()
+            # (cold path, profiler) working transparently — a checkout
+            # un-epolls the socket so Python may speak on it.
+            self.pool.idle_sink = self._nd_idle_sink
+            self.pool.idle_source = self._nd_idle_source
+            self.pool.on_discard = self._nd_on_discard
+            self._nd_seed_workers()
             # Drainer pool: grows on demand (a long-running call — an
             # actor method, a streamed task — occupies its drainer for
             # the call's duration, like the fallback's per-conn
@@ -416,6 +431,15 @@ class NodeDaemon:
             for _seq, _retriable, worker, label in \
                     self._running_tasks.values():
                 labels[worker.pid] = f"task:{label}"
+        if self._nd is not None:
+            # Natively handed-off tasks never enter _running_tasks;
+            # label their workers from the loop's own registry so
+            # shm_pins attribution stays complete on the warm path.
+            with contextlib.suppress(Exception):
+                for went in self._nd.workers():
+                    if went.get("state") == "busy" and went.get("pid"):
+                        labels[int(went["pid"])] = (
+                            "task:" + str(went.get("tid") or "native"))
         holders = []
         for pid_s, rec in raw.get("pids", {}).items():
             pid = int(pid_s)
@@ -455,6 +479,7 @@ class NodeDaemon:
         # head's /api/event_stats and the ray_tpu_loop_handler_*
         # series. Refusals it wrote natively count toward spilled.
         spilled_native = 0
+        native_handoff: dict = {}
         if self._nd is not None:
             try:
                 nstats = self._nd.stats()
@@ -462,6 +487,11 @@ class NodeDaemon:
                     estats = dict(estats)
                     estats["node_dispatch_native"] = nstats
                 spilled_native = self._nd.spilled()
+                # Warm-path hand-off counters (workers registered with
+                # the loop, tasks forwarded natively, pending depth):
+                # natively-running tasks never touch _running/_queued,
+                # so the load report folds them back in below.
+                native_handoff = self._nd.handoff()
             except Exception:  # noqa: BLE001
                 pass
         # Latest metrics scrape rides the heartbeat (one float per
@@ -474,13 +504,30 @@ class NodeDaemon:
                 pass
         avail = self.available.to_dict()  # property: takes its own lock
         shm_pins = self._shm_attribution()  # takes actor/running locks
+        import resource as _resource
+
+        ru = _resource.getrusage(_resource.RUSAGE_SELF)
+        with self._drainer_lock:
+            drainers = {"count": len(self._drainers),
+                        "busy": self._drainer_busy,
+                        "busy_s_total": round(self._drainer_busy_s, 6)}
         with self._avail_lock:
             return {
                 "available": avail,
                 "total": self.total.to_dict(),
-                "queued": self._queued,
-                "running": self._running,
+                "queued": (self._queued
+                           + int(native_handoff.get("pending") or 0)),
+                "running": (self._running
+                            + int(native_handoff.get("busy") or 0)),
                 "spilled": self._spilled + spilled_native,
+                # Warm-path observability: py_exec_tasks is the
+                # zero-Python proof counter, drainers the bench's
+                # GIL-contention proxy, proc_cpu_s the per-plane CPU
+                # accounting (daemon process user+sys seconds).
+                "py_exec_tasks": self._py_exec_tasks,
+                "drainers": drainers,
+                "proc_cpu_s": round(ru.ru_utime + ru.ru_stime, 6),
+                "native_handoff": native_handoff,
                 "host": host,
                 "event_stats": estats,
                 "transfer": transfer,
@@ -721,6 +768,97 @@ class NodeDaemon:
         with contextlib.suppress(Exception):
             self._nd.set_peers(digest)
 
+    # -- native idle-worker registry (warm-path hand-off) ----------------
+    def _nd_idle_sink(self, w) -> bool:
+        """Pool hook: an idling worker's socket goes to the C loop's
+        registry, making it a native hand-off target. False → the pool
+        keeps the worker in its own idle queue (loop stopping, or the
+        registration itself failed)."""
+        nd = self._nd
+        if nd is None or self._stop.is_set() or w.dedicated \
+                or not w.alive:
+            return False
+        fids = list(w.exported_fns)
+        try:
+            # release() re-arms a worker the loop already holds as
+            # py-owned (a cold-path checkout going back); register
+            # covers first entry and re-entry after the loop dropped
+            # it (worker death bookkeeping, stale-entry cleanup).
+            if nd.worker_release(w.worker_id, fids):
+                return True
+            return nd.worker_register(w.worker_id, w.sock.fileno(),
+                                      w.pid, fids)
+        except Exception:  # noqa: BLE001 — handle destroyed mid-stop
+            return False
+
+    def _nd_idle_source(self, timeout):
+        """Pool hook: one bounded wait for an idle worker, preferring
+        the native registry (the checkout un-epolls the socket so the
+        caller may speak on it); falls back to the pool's own queue —
+        workers land there when registration fails or the loop is
+        stopping. acquire() loops on None until its deadline."""
+        import queue as _q
+
+        nd = self._nd
+        slice_s = 0.2 if timeout is None else max(0.001,
+                                                  min(0.2, timeout))
+        if nd is not None and not self._stop.is_set():
+            try:
+                wid = nd.worker_acquire(timeout_ms=int(slice_s * 1000))
+            except Exception:  # noqa: BLE001 — loop stopped
+                wid = None
+            if wid is not None:
+                w = self.pool.get_worker(wid)
+                if w is not None:
+                    return w
+                # Registry entry the pool no longer knows: drop it so
+                # its dup'd fd cannot leak.
+                with contextlib.suppress(Exception):
+                    self._nd.worker_unregister(wid)
+                return None
+            with contextlib.suppress(_q.Empty):
+                return self.pool._idle.get_nowait()
+            return None
+        try:
+            return self.pool._idle.get(timeout=slice_s)
+        except _q.Empty:
+            return None
+
+    def _nd_on_discard(self, w) -> None:
+        """Pool hook: a worker leaving the pool for good must leave the
+        native registry too (closes the loop's dup'd fd)."""
+        nd = self._nd
+        if nd is not None:
+            with contextlib.suppress(Exception):
+                nd.worker_unregister(w.worker_id)
+
+    def _nd_seed_workers(self) -> None:
+        """Move workers the pool spawned before the hooks existed from
+        its idle queue into the native registry."""
+        import queue as _q
+
+        while True:
+            try:
+                w = self.pool._idle.get_nowait()
+            except _q.Empty:
+                return
+            if not self._nd_idle_sink(w):
+                self.pool._idle.put(w)
+                return
+
+    def _nd_worker_dead(self, wid: int) -> None:
+        """The C loop saw a registered worker's socket die (EOF, or a
+        failed hand-off write). The loop already released the in-flight
+        task's charge and wrote the typed crashed reply; Python's job
+        is pool bookkeeping — drop the corpse, respawn replacement
+        capacity, and unstrand the dead process's arena pins."""
+        w = self.pool.get_worker(wid)
+        if w is not None:
+            w.alive = False
+            self.pool._discard(w, respawn_in_background=True)
+        with contextlib.suppress(Exception):
+            self.shm.reclaim_dead_pins()
+
     def _spawn_drainer(self) -> None:
         with self._drainer_lock:
             if (self._stop.is_set()
@@ -751,9 +889,14 @@ class NodeDaemon:
             if kind == _ndmod.EV_CLOSED:
                 self._nd_conn_closed(conn_id)
                 continue
+            if kind == _ndmod.EV_WORKER_DEAD:
+                # conn_id carries the worker id for this event kind.
+                self._nd_worker_dead(conn_id)
+                continue
             with self._drainer_lock:
                 self._drainer_busy += 1
                 idle = len(self._drainers) - self._drainer_busy
+            t0 = time.monotonic()
             try:
                 if idle <= 0:
                     self._spawn_drainer()
@@ -761,6 +904,7 @@ class NodeDaemon:
             finally:
                 with self._drainer_lock:
                     self._drainer_busy -= 1
+                    self._drainer_busy_s += time.monotonic() - t0
 
     def _nd_handle(self, conn_id: int, flags: int, body: bytes) -> None:
         import pickle
@@ -1743,6 +1887,10 @@ class NodeDaemon:
         send_msg = self._send_msg
         with self._avail_lock:
             self._queued += 1
+            # Warm-path proof: every task the PYTHON plane executes
+            # bumps this; the parity suite submits plain tasks under
+            # native dispatch and asserts it stays frozen.
+            self._py_exec_tasks += 1
         worker = None
         try:
             worker = self.pool.acquire(timeout=300)

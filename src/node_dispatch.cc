@@ -24,7 +24,16 @@
 //     queue growing without bound;
 //   - per-(loop,handler) count/total/max/p95 latency stats (the
 //     event_stats.h analog), measured from frame arrival to the first
-//     reply byte queued for that request.
+//     reply byte queued for that request;
+//   - an idle-worker registry (nd_worker_*): Python registers worker
+//     sockets + their cached fn ids, and the loop hands admitted
+//     "plain" task frames straight onto an idle worker's socket (the
+//     wire body is forwarded, never re-encoded) and forwards the
+//     worker's single result frame back to the driver conn with the
+//     ledger released first — the warm path runs zero Python
+//     bytecode. Cold paths (fn spreading, actors, streaming, fetch
+//     hints, spawn/scale-up, every error) still flow through the
+//     ready queue to the Python drainers.
 //
 // Everything Python needs crosses a narrow C ABI (nd_*) loaded via
 // ctypes — every call releases the GIL for its duration.
@@ -399,11 +408,15 @@ constexpr uint32_t kFlagJson = 2u;
 
 struct Event {
   uint64_t conn_id = 0;
-  int kind = 0;  // 0 = message, 1 = conn closed
+  // 0 = message, 1 = conn closed, 2 = registered worker died (conn_id
+  // carries the worker id; Python respawns it).
+  int kind = 0;
   uint32_t flags = 0;
   char* data = nullptr;  // malloc'd; freed by nd_free (Python side)
   uint64_t len = 0;
 };
+
+constexpr int kEvWorkerDead = 2;
 
 struct Conn {
   uint64_t id = 0;
@@ -434,6 +447,52 @@ struct Peer {
   int64_t queued = 0;
   double headroom = 0.0;
   ResMap avail;
+};
+
+// ---------------------------------------------------------------------
+// Idle-worker registry: the native hand-off substrate. A registered
+// worker's socket (a dup of Python's fd — the registry owns its copy)
+// is epoll'd by the same loop; an IDLE worker can take a plain task
+// frame directly, a PY_OWNED worker was checked out via
+// nd_worker_acquire and its fd is NOT watched (Python speaks on the
+// socket until nd_worker_release).
+// ---------------------------------------------------------------------
+
+constexpr int kWIdle = 0;
+constexpr int kWBusy = 1;
+constexpr int kWPyOwned = 2;
+
+// An admitted plain task waiting for a capable idle worker. Holds the
+// raw cloudpickle body (forwarded verbatim) and, when precharged, the
+// ledger charge to release on completion/death.
+struct PendingTask {
+  uint64_t conn_id = 0;
+  std::string tid;  // hex task id (for the typed death error)
+  std::string fid;  // hex fn id (capability matching)
+  bool has_fn = false;  // body carries the fn: any worker can take it
+  ResMap res;
+  std::string body;
+  Clock::time_point t0;  // frame arrival (latency attribution)
+};
+
+struct Worker {
+  uint64_t wid = 0;
+  int fd = -1;  // dup'd from Python; closed on unregister/death/stop
+  int pid = 0;
+  int state = kWIdle;
+  std::set<std::string> fids;  // hex fn ids this worker has cached
+  // In-flight native task (state == kWBusy).
+  uint64_t task_conn = 0;
+  std::string task_tid;
+  ResMap task_res;
+  Clock::time_point task_t0;
+  // Socket buffers. ALL worker-socket IO happens under wmu (loop
+  // thread for epoll events, a Python thread inside nd_worker_release
+  // when serving the pending queue) — the lock is the serializer.
+  std::string inbuf;
+  size_t in_off = 0;
+  std::deque<std::string> outq;
+  size_t out_off = 0;
 };
 
 struct NdServer {
@@ -474,6 +533,22 @@ struct NdServer {
   std::string node_id;
   std::string load_tail = "}";
   std::vector<Peer> peers;
+
+  // Idle-worker registry + native hand-off state. wmu is the
+  // OUTERMOST lock in this file: wmu→lmu (ledger release on
+  // completion/death), wmu→smu (record_stat), wmu→qmu (push_event)
+  // and wmu→omu (driver-bound replies) all occur; never the reverse.
+  std::mutex wmu;
+  std::condition_variable wcv;  // nd_worker_acquire waiters
+  std::map<uint64_t, Worker*> workers;        // wid → worker
+  std::unordered_map<int, uint64_t> wfd;      // worker fd → wid
+  std::deque<PendingTask> pending;            // waiting for a worker
+  size_t pending_cap = 1024;
+  std::atomic<size_t> pending_count{0};       // mirrors pending.size()
+  std::atomic<uint64_t> handoffs{0};          // frames written natively
+  std::atomic<uint64_t> native_done{0};       // results forwarded
+  std::atomic<uint64_t> worker_deaths{0};
+  std::atomic<uint64_t> handoff_overflow{0};  // pending full → Python
 
   // Loop-thread-only state.
   std::unordered_map<int, Conn*> conns;
@@ -516,9 +591,16 @@ void push_event(NdServer* s, Event&& e) {
   s->qcv.notify_one();
 }
 
+// Backpressure gate: the Python-bound ready queue and the native
+// pending queue share one budget, so all-workers-busy churn engages
+// the same EPOLLIN pause as a slow drainer.
 bool queue_full(NdServer* s) {
-  std::lock_guard<std::mutex> g(s->qmu);
-  return s->queue.size() >= s->queue_cap;
+  size_t qn;
+  {
+    std::lock_guard<std::mutex> g(s->qmu);
+    qn = s->queue.size();
+  }
+  return qn + s->pending_count.load() >= s->queue_cap;
 }
 
 void close_conn(NdServer* s, Conn* c) {
@@ -614,6 +696,329 @@ std::string pick_spill_target(NdServer* s, const ResMap& res,
   return best != nullptr ? best->id : std::string();
 }
 
+// ---------------------------------------------------------------------
+// Native worker hand-off. Every function below expects wmu held unless
+// noted; none touches s->conns (driver-bound bytes go through the
+// shared outbox so Python-thread callers can produce replies too).
+// ---------------------------------------------------------------------
+
+void nd_wake_fd(NdServer* s) {
+  uint64_t one = 1;
+  ssize_t rc = write(s->event_fd, &one, 8);
+  (void)rc;
+}
+
+// Any thread. Queue a driver-bound payload; the loop frames + writes it.
+void send_to_driver(NdServer* s, uint64_t conn_id, std::string&& payload) {
+  Outgoing o;
+  o.conn_id = conn_id;
+  o.payload = std::move(payload);
+  o.t = Clock::now();
+  {
+    std::lock_guard<std::mutex> g(s->omu);
+    s->outbox.push_back(std::move(o));
+  }
+  nd_wake_fd(s);
+}
+
+void parse_csv(const char* csv, std::set<std::string>* out) {
+  if (csv == nullptr) return;
+  const char* p = csv;
+  while (*p) {
+    const char* e = strchr(p, ',');
+    size_t n = e != nullptr ? static_cast<size_t>(e - p) : strlen(p);
+    if (n > 0) out->insert(std::string(p, n));
+    p += n;
+    if (*p == ',') p++;
+  }
+}
+
+// Flush the worker outq. Returns false when the socket failed (caller
+// must run worker_died).
+bool worker_flush(NdServer* s, Worker* w) {
+  (void)s;
+  while (!w->outq.empty()) {
+    const std::string& front = w->outq.front();
+    // MSG_DONTWAIT, not O_NONBLOCK: the fd is dup'd from Python's
+    // blocking worker socket and dup() SHARES file-status flags —
+    // flipping O_NONBLOCK here would break the cold path's blocking
+    // reads on the original fd.
+    ssize_t n = send(w->fd, front.data() + w->out_off,
+                     front.size() - w->out_off,
+                     MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (n > 0) {
+      w->out_off += static_cast<size_t>(n);
+      if (w->out_off == front.size()) {
+        w->outq.pop_front();
+        w->out_off = 0;
+      }
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    return false;
+  }
+  return true;
+}
+
+void worker_arm(NdServer* s, Worker* w) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLRDHUP |
+              (w->outq.empty() ? 0u : static_cast<uint32_t>(EPOLLOUT));
+  ev.data.fd = w->fd;
+  epoll_ctl(s->ep_fd, EPOLL_CTL_MOD, w->fd, &ev);
+}
+
+// Hand one admitted plain-task body to an idle worker: the wire body
+// is forwarded with a fresh length prefix, never re-encoded. Returns
+// false when the worker socket failed mid-write (caller runs
+// worker_died — the typed error reaches the driver from there).
+bool start_native_task(NdServer* s, Worker* w, uint64_t conn_id,
+                       const std::string& tid, const std::string& fid,
+                       ResMap&& res, const char* body, size_t body_len,
+                       Clock::time_point t0) {
+  w->state = kWBusy;
+  w->task_conn = conn_id;
+  w->task_tid = tid;
+  w->task_res = std::move(res);
+  w->task_t0 = t0;
+  // The worker caches the fn from the body on first sight of the fid
+  // (get_fn in core/worker_main.py), so record it now either way.
+  w->fids.insert(fid);
+  std::string buf;
+  buf.reserve(8 + body_len);
+  for (int i = 7; i >= 0; i--)  // cxx-wire: nd-frame-len >Q
+    buf.push_back(static_cast<char>(
+        (static_cast<uint64_t>(body_len) >> (8 * i)) & 0xFF));
+  buf.append(body, body_len);
+  w->outq.push_back(std::move(buf));
+  s->handoffs.fetch_add(1);
+  record_stat(s, "task_native_handoff", seconds_since(t0, Clock::now()));
+  if (!worker_flush(s, w)) return false;
+  worker_arm(s, w);
+  return true;
+}
+
+// Pending entries no surviving worker can run fall back to the Python
+// cold path: the stored body is the raw pickle the drainer already
+// understands, and an existing charge rides kFlagPrecharged.
+void requeue_unrunnable_pending(NdServer* s) {
+  std::deque<PendingTask> keep;
+  for (PendingTask& p : s->pending) {
+    bool runnable = p.has_fn && !s->workers.empty();
+    if (!runnable)
+      for (auto& kv : s->workers)
+        if (kv.second->fids.count(p.fid) != 0) {
+          runnable = true;
+          break;
+        }
+    if (runnable) {
+      keep.push_back(std::move(p));
+      continue;
+    }
+    Event e;
+    e.conn_id = p.conn_id;
+    e.kind = 0;
+    e.flags = p.res.empty() ? 0u : kFlagPrecharged;
+    e.data = static_cast<char*>(
+        malloc(p.body.size() > 0 ? p.body.size() : 1));
+    if (e.data == nullptr) {  // drop, but never leak the charge
+      if (!p.res.empty()) {
+        std::lock_guard<std::mutex> g(s->lmu);
+        for (const auto& kv : p.res) s->avail[kv.first] += kv.second;
+      }
+      continue;
+    }
+    memcpy(e.data, p.body.data(), p.body.size());
+    e.len = p.body.size();
+    push_event(s, std::move(e));
+  }
+  s->pending.swap(keep);
+  s->pending_count.store(s->pending.size());
+}
+
+// Tear down a registered worker. An in-flight native task gets the
+// typed error the Python path produces for WorkerCrashedError, with
+// the ledger released first (same ordering as _run_task's done()).
+// notify_python=false for deliberate unregister (retire/discard).
+void worker_died(NdServer* s, Worker* w, bool notify_python) {
+  s->workers.erase(w->wid);
+  s->wfd.erase(w->fd);
+  epoll_ctl(s->ep_fd, EPOLL_CTL_DEL, w->fd, nullptr);
+  close(w->fd);
+  if (notify_python) s->worker_deaths.fetch_add(1);
+  if (w->state == kWBusy) {
+    if (!w->task_res.empty()) {
+      std::lock_guard<std::mutex> g(s->lmu);
+      for (const auto& kv : w->task_res) s->avail[kv.first] += kv.second;
+    }
+    std::string reply = "{\"type\":\"result\",\"task_id\":";
+    if (w->task_tid.empty())
+      reply.append("null");
+    else
+      json_escape(w->task_tid, &reply);
+    reply.append(",\"crashed\":\"worker died during native hand-off\"}");
+    record_stat(s, "task_native", seconds_since(w->task_t0, Clock::now()));
+    send_to_driver(s, w->task_conn, std::move(reply));
+  }
+  if (notify_python) {
+    Event e;
+    e.conn_id = w->wid;  // worker id, not a conn id, for kind=2
+    e.kind = kEvWorkerDead;
+    push_event(s, std::move(e));
+  }
+  delete w;
+  requeue_unrunnable_pending(s);
+}
+
+// Worker finished (or Python released it): serve the first runnable
+// pending task, else park idle and wake an nd_worker_acquire waiter.
+// Returns false when the worker died serving (w freed).
+bool worker_now_idle(NdServer* s, Worker* w) {
+  w->state = kWIdle;
+  w->task_conn = 0;
+  w->task_tid.clear();
+  w->task_res.clear();
+  for (auto it = s->pending.begin(); it != s->pending.end(); ++it) {
+    if (!(it->has_fn || w->fids.count(it->fid) != 0)) continue;
+    PendingTask p = std::move(*it);
+    s->pending.erase(it);
+    s->pending_count.store(s->pending.size());
+    nd_wake_fd(s);  // pending shrank: loop re-checks paused conns
+    if (!start_native_task(s, w, p.conn_id, p.tid, p.fid,
+                           std::move(p.res), p.body.data(),
+                           p.body.size(), p.t0)) {
+      worker_died(s, w, true);
+      return false;
+    }
+    return true;
+  }
+  s->wcv.notify_one();
+  return true;
+}
+
+// Drain complete frames off a BUSY worker. Exactly one result frame
+// per plain task (core/worker_main.py sends gen_item only under
+// streaming, which never routes here) — ledger released, frame
+// forwarded verbatim, worker recycled. Returns false when w was freed.
+bool worker_parse_frames(NdServer* s, Worker* w) {
+  for (;;) {
+    size_t have = w->inbuf.size() - w->in_off;
+    if (have < 8) break;
+    const unsigned char* hp = reinterpret_cast<const unsigned char*>(
+        w->inbuf.data() + w->in_off);
+    uint64_t flen = 0;  // cxx-wire: nd-frame-len >Q
+    for (int i = 0; i < 8; i++) flen = (flen << 8) | hp[i];
+    if (flen == 0 || flen > s->max_frame || w->state != kWBusy) {
+      worker_died(s, w, true);  // protocol violation
+      return false;
+    }
+    if (have < 8 + flen) break;
+    std::string payload(w->inbuf.data() + w->in_off + 8,
+                        static_cast<size_t>(flen));
+    w->in_off += 8 + flen;
+    if (!w->task_res.empty()) {
+      // Release BEFORE the reply can reach the driver, matching the
+      // Python path (done() frees capacity, then replies).
+      std::lock_guard<std::mutex> g(s->lmu);
+      for (const auto& kv : w->task_res) s->avail[kv.first] += kv.second;
+      w->task_res.clear();
+    }
+    record_stat(s, "task_native", seconds_since(w->task_t0, Clock::now()));
+    s->native_done.fetch_add(1);
+    send_to_driver(s, w->task_conn, std::move(payload));
+    if (!worker_now_idle(s, w)) return false;
+  }
+  if (w->in_off > 0 && w->in_off == w->inbuf.size()) {
+    w->inbuf.clear();
+    w->in_off = 0;
+  }
+  return true;
+}
+
+// Loop thread, wmu held. Returns false when the worker was freed.
+bool worker_readable(NdServer* s, Worker* w) {
+  char buf[65536];
+  for (;;) {
+    // MSG_DONTWAIT for the same dup()-shared-flags reason as
+    // worker_flush: the description must stay blocking for Python.
+    ssize_t r = recv(w->fd, buf, sizeof(buf), MSG_DONTWAIT);
+    if (r > 0) {
+      w->inbuf.append(buf, static_cast<size_t>(r));
+      if (!worker_parse_frames(s, w)) return false;
+      if (static_cast<size_t>(r) < sizeof(buf)) return true;
+      continue;
+    }
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return true;
+    worker_died(s, w, true);  // EOF or hard error
+    return false;
+  }
+}
+
+// Loop thread (from handle_frame). Native hand-off of an admitted
+// plain task: "plain" is stamped by the driver's hybrid_frame only for
+// non-streaming, non-traced, fetch-free, runtime_env-free tasks, so a
+// nonempty res here was already precharged (a refusal returned before
+// this point). Returns true when the frame was consumed natively —
+// forwarded to an idle worker or parked on the bounded pending queue;
+// false routes it to the Python cold path.
+bool try_native_handoff(NdServer* s, Conn* c, const JValue& header,
+                        const char* body, size_t body_len,
+                        uint32_t flags, ResMap&& res,
+                        Clock::time_point t0) {
+  const JValue* pl = header.get("plain");
+  if (pl == nullptr || pl->kind != JValue::BOOL || !pl->b) return false;
+  if (body_len == 0) return false;
+  if (!res.empty() && (flags & kFlagPrecharged) == 0) return false;
+  std::string fid = header_str(&header, "fid");
+  if (fid.empty()) return false;
+  const JValue* hf = header.get("has_fn");
+  bool has_fn = hf != nullptr && hf->kind == JValue::BOOL && hf->b;
+  std::string tid = header_str(&header, "tid");
+
+  std::lock_guard<std::mutex> g(s->wmu);
+  if (s->workers.empty()) return false;
+  Worker* pick = nullptr;
+  bool idle_seen = false;
+  bool fid_known = false;
+  for (auto& kv : s->workers) {
+    Worker* w = kv.second;
+    bool knows = w->fids.count(fid) != 0;
+    if (knows) fid_known = true;
+    if (w->state != kWIdle) continue;
+    idle_seen = true;
+    if (knows) {
+      pick = w;  // prefer a fid-warm worker
+      break;
+    }
+    if (has_fn && pick == nullptr) pick = w;
+  }
+  if (pick != nullptr) {
+    if (!start_native_task(s, pick, c->id, tid, fid, std::move(res),
+                           body, body_len, t0))
+      worker_died(s, pick, true);  // driver gets the typed error
+    return true;
+  }
+  // An idle worker without the fn: Python's cold path spreads the fn
+  // (fn injection); nd_worker_release reports the fid back afterward.
+  if (idle_seen) return false;
+  if (!has_fn && !fid_known) return false;  // nobody can run it natively
+  if (s->pending.size() >= s->pending_cap) {
+    s->handoff_overflow.fetch_add(1);
+    return false;  // overflow: the Python drainer pool absorbs the burst
+  }
+  PendingTask p;
+  p.conn_id = c->id;
+  p.tid = tid;
+  p.fid = fid;
+  p.has_fn = has_fn;
+  p.res = std::move(res);
+  p.body.assign(body, body_len);
+  p.t0 = t0;
+  s->pending.push_back(std::move(p));
+  s->pending_count.store(s->pending.size());
+  return true;
+}
+
 // Classify + handle one complete frame payload. Returns false when the
 // conn was closed (malformed frame).
 bool handle_frame(NdServer* s, Conn* c, const char* payload, size_t n) {
@@ -673,10 +1078,10 @@ bool handle_frame(NdServer* s, Conn* c, const char* payload, size_t n) {
     return queue_frame(s, c, reply.data(), reply.size());
   }
 
+  ResMap res;
   if (has_header && mtype == "task") {
     const JValue* sp = header.get("spillable");
     const JValue* resv = header.get("res");
-    ResMap res;
     if (sp != nullptr && sp->kind == JValue::BOOL && sp->b &&
         resv != nullptr && parse_res(*resv, &res) && !res.empty()) {
       // Atomic check-and-charge (the Python daemon's admission block,
@@ -721,6 +1126,12 @@ bool handle_frame(NdServer* s, Conn* c, const char* payload, size_t n) {
       flags |= kFlagPrecharged;
     }
   }
+
+  // -- native worker hand-off (warm path: zero Python bytecode) -------
+  if (has_header && mtype == "task" &&
+      try_native_handoff(s, c, header, body, body_len, flags,
+                         std::move(res), now))
+    return true;
 
   // -- hand off to Python ---------------------------------------------
   // Request timing: close on the first reply nd_send queues for this
@@ -880,7 +1291,33 @@ void loop_main(NdServer* s) {
         continue;
       }
       auto it = s->conns.find(fd);
-      if (it == s->conns.end()) continue;
+      if (it == s->conns.end()) {
+        // Registered worker socket? (PY_OWNED fds were epoll-DELed at
+        // acquire; a stale event from this batch is skipped by state.)
+        std::lock_guard<std::mutex> g(s->wmu);
+        auto wit = s->wfd.find(fd);
+        if (wit == s->wfd.end()) continue;
+        auto wmi = s->workers.find(wit->second);
+        if (wmi == s->workers.end()) continue;
+        Worker* w = wmi->second;
+        if (w->state == kWPyOwned) continue;
+        if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+          worker_died(s, w, true);
+          continue;
+        }
+        bool alive = true;
+        if (evs[i].events & EPOLLOUT) {
+          if (!worker_flush(s, w)) {
+            worker_died(s, w, true);
+            alive = false;
+          } else {
+            worker_arm(s, w);
+          }
+        }
+        if (alive && (evs[i].events & (EPOLLIN | EPOLLRDHUP)))
+          worker_readable(s, w);
+        continue;
+      }
       Conn* c = it->second;
       if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
         close_conn(s, c);
@@ -911,6 +1348,7 @@ void* nd_create(int port, int bind_all, unsigned long long max_frame,
   NdServer* s = new NdServer();
   if (max_frame > 0) s->max_frame = max_frame;
   if (queue_cap > 0) s->queue_cap = static_cast<size_t>(queue_cap);
+  s->pending_cap = s->queue_cap;  // one shared backpressure budget
   s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
     delete s;
@@ -1062,6 +1500,179 @@ void nd_set_ping_native(void* h, int enabled) {
   static_cast<NdServer*>(h)->ping_native.store(enabled != 0);
 }
 
+// -- idle-worker registry (native hand-off) ----------------------------
+// The worker speaks the daemon↔worker framed-pickle protocol on fd:
+// 8-byte big-endian length + cloudpickle payload, one result frame per
+// plain task (core/worker_proc.py).  // cxx-wire: nd-frame-len >Q
+
+// Register a worker socket. The registry dups fd (Python keeps its
+// own), epoll-adds it, and the worker is immediately eligible — it may
+// start serving the pending queue before this returns. fids_csv is a
+// comma-separated list of hex fn ids the worker has cached.
+int nd_worker_register(void* h, unsigned long long wid, int fd, int pid,
+                       const char* fids_csv) {
+  NdServer* s = static_cast<NdServer*>(h);
+  if (s == nullptr || s->stop.load() || fd < 0) return -1;
+  int dupfd = dup(fd);
+  if (dupfd < 0) return -1;
+  // NO set_nonblock: dup() shares file-status flags with Python's
+  // blocking socket object; the loop uses MSG_DONTWAIT per call.
+  Worker* w = new Worker();
+  w->wid = wid;
+  w->fd = dupfd;
+  w->pid = pid;
+  parse_csv(fids_csv, &w->fids);
+  std::lock_guard<std::mutex> g(s->wmu);
+  auto old = s->workers.find(wid);
+  if (old != s->workers.end()) {  // re-register: drop the stale entry
+    Worker* ow = old->second;
+    s->wfd.erase(ow->fd);
+    epoll_ctl(s->ep_fd, EPOLL_CTL_DEL, ow->fd, nullptr);
+    close(ow->fd);
+    s->workers.erase(old);
+    delete ow;
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLRDHUP;
+  ev.data.fd = w->fd;
+  if (epoll_ctl(s->ep_fd, EPOLL_CTL_ADD, w->fd, &ev) != 0) {
+    close(w->fd);
+    delete w;
+    return -1;
+  }
+  s->workers[wid] = w;
+  s->wfd[w->fd] = wid;
+  worker_now_idle(s, w);  // may serve pending right away
+  return 0;
+}
+
+// Deliberate removal (retire/discard): no death event, but an
+// in-flight native task still gets its typed error + ledger release.
+// Returns 1 removed, 0 unknown wid.
+int nd_worker_unregister(void* h, unsigned long long wid) {
+  NdServer* s = static_cast<NdServer*>(h);
+  if (s == nullptr) return -1;
+  std::lock_guard<std::mutex> g(s->wmu);
+  auto it = s->workers.find(wid);
+  if (it == s->workers.end()) return 0;
+  worker_died(s, it->second, false);
+  return 1;
+}
+
+// Check an idle worker out for the Python cold path. Its fd leaves the
+// epoll set (Python speaks on the socket until release/unregister).
+// Returns the wid (>= 0 — ids start at 0, so sentinels are negative):
+// -1 on timeout, -2 when stopped.
+long long nd_worker_acquire(void* h, int timeout_ms) {
+  NdServer* s = static_cast<NdServer*>(h);
+  if (s == nullptr) return -2;
+  std::unique_lock<std::mutex> g(s->wmu);
+  Worker* w = nullptr;
+  auto find_idle = [&s]() -> Worker* {
+    for (auto& kv : s->workers)
+      if (kv.second->state == kWIdle) return kv.second;
+    return nullptr;
+  };
+  // system_clock deadline on purpose — same TSAN rationale as nd_next.
+  if (!s->wcv.wait_until(
+          g,
+          std::chrono::system_clock::now() +
+              std::chrono::milliseconds(timeout_ms),
+          [&] { return s->stop.load() || (w = find_idle()) != nullptr; }))
+    return -1;
+  if (w == nullptr) return -2;  // stopped
+  w->state = kWPyOwned;
+  epoll_ctl(s->ep_fd, EPOLL_CTL_DEL, w->fd, nullptr);
+  return static_cast<long long>(w->wid);
+}
+
+// Return a PY_OWNED worker to the registry (fids_csv syncs fn ids the
+// Python run exported). May serve the pending queue from the calling
+// thread. Returns 1 when known, 0 when the wid is not registered (the
+// caller falls back to nd_worker_register).
+int nd_worker_release(void* h, unsigned long long wid,
+                      const char* fids_csv) {
+  NdServer* s = static_cast<NdServer*>(h);
+  if (s == nullptr || s->stop.load()) return 0;
+  std::lock_guard<std::mutex> g(s->wmu);
+  auto it = s->workers.find(wid);
+  if (it == s->workers.end()) return 0;
+  Worker* w = it->second;
+  parse_csv(fids_csv, &w->fids);
+  epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLRDHUP;
+  ev.data.fd = w->fd;
+  epoll_ctl(s->ep_fd, EPOLL_CTL_ADD, w->fd, &ev);
+  worker_now_idle(s, w);
+  return 1;
+}
+
+// Per-worker snapshot for shm attribution: BUSY entries carry the hex
+// task id so natively-running tasks stay labeled in load reports.
+int nd_workers_json(void* h, char* buf, int cap) {
+  NdServer* s = static_cast<NdServer*>(h);
+  std::string out = "[";
+  {
+    std::lock_guard<std::mutex> g(s->wmu);
+    bool first = true;
+    for (const auto& kv : s->workers) {
+      const Worker* w = kv.second;
+      if (!first) out.push_back(',');
+      first = false;
+      char head[96];
+      snprintf(head, sizeof(head), "{\"wid\":%llu,\"pid\":%d,\"state\":",
+               static_cast<unsigned long long>(w->wid), w->pid);
+      out.append(head);
+      out.append(w->state == kWBusy
+                     ? "\"busy\""
+                     : (w->state == kWPyOwned ? "\"py\"" : "\"idle\""));
+      if (w->state == kWBusy) {
+        out.append(",\"tid\":");
+        json_escape(w->task_tid, &out);
+      }
+      out.push_back('}');
+    }
+  }
+  out.push_back(']');
+  if (static_cast<int>(out.size()) + 1 > cap) return -1;
+  memcpy(buf, out.data(), out.size());
+  buf[out.size()] = '\0';
+  return static_cast<int>(out.size());
+}
+
+// Hand-off plane counters (load-report merge + the zero-Python test).
+int nd_handoff_json(void* h, char* buf, int cap) {
+  NdServer* s = static_cast<NdServer*>(h);
+  size_t idle = 0, busy = 0, py = 0, nworkers = 0, npending = 0;
+  {
+    std::lock_guard<std::mutex> g(s->wmu);
+    nworkers = s->workers.size();
+    npending = s->pending.size();
+    for (const auto& kv : s->workers) {
+      if (kv.second->state == kWBusy)
+        busy++;
+      else if (kv.second->state == kWPyOwned)
+        py++;
+      else
+        idle++;
+    }
+  }
+  char out[320];
+  int n = snprintf(
+      out, sizeof(out),
+      "{\"workers\":%zu,\"idle\":%zu,\"busy\":%zu,\"py_owned\":%zu,"
+      "\"pending\":%zu,\"handoffs\":%llu,\"completed\":%llu,"
+      "\"worker_deaths\":%llu,\"overflow\":%llu}",
+      nworkers, idle, busy, py, npending,
+      static_cast<unsigned long long>(s->handoffs.load()),
+      static_cast<unsigned long long>(s->native_done.load()),
+      static_cast<unsigned long long>(s->worker_deaths.load()),
+      static_cast<unsigned long long>(s->handoff_overflow.load()));
+  if (n < 0 || n + 1 > cap) return -1;
+  memcpy(buf, out, static_cast<size_t>(n) + 1);
+  return n;
+}
+
 // -- resource ledger ---------------------------------------------------
 
 int nd_ledger_set(void* h, const char* json_res) {
@@ -1169,12 +1780,24 @@ void nd_stop(void* h) {
   NdServer* s = static_cast<NdServer*>(h);
   if (s == nullptr || s->stop.exchange(true)) return;
   nd_wake(s);
+  s->wcv.notify_all();  // nd_worker_acquire waiters see stop
   if (s->loop_thread.joinable()) s->loop_thread.join();
   for (auto& kv : s->conns) {
     close(kv.second->fd);
     delete kv.second;
   }
   s->conns.clear();
+  {
+    std::lock_guard<std::mutex> g(s->wmu);
+    for (auto& kv : s->workers) {
+      close(kv.second->fd);
+      delete kv.second;
+    }
+    s->workers.clear();
+    s->wfd.clear();
+    s->pending.clear();
+    s->pending_count.store(0);
+  }
   close(s->listen_fd);
   close(s->ep_fd);
   close(s->event_fd);
@@ -1196,11 +1819,13 @@ void nd_destroy(void* h) {
   // allocated at the same address would inherit their sync state and
   // report phantom double-locks. Make the destruction visible.
   pthread_cond_destroy(s->qcv.native_handle());
+  pthread_cond_destroy(s->wcv.native_handle());
   pthread_mutex_destroy(s->qmu.native_handle());
   pthread_mutex_destroy(s->omu.native_handle());
   pthread_mutex_destroy(s->lmu.native_handle());
   pthread_mutex_destroy(s->smu.native_handle());
   pthread_mutex_destroy(s->cfgmu.native_handle());
+  pthread_mutex_destroy(s->wmu.native_handle());
 #endif
   delete s;
 }

@@ -9,6 +9,16 @@
 // the ledger / load-tail / peers / stats surfaces the heartbeat and
 // handlers touch from other threads. Three full create→stop→destroy
 // cycles stress lifecycle teardown with events still queued.
+//
+// The native hand-off plane rides the same cycles: fake workers on
+// socketpairs (registered via nd_worker_register, answering framed
+// task bodies like worker_main's serve loop) absorb plain-task
+// frames end-to-end with no responder involvement, a checkout-churn
+// thread races nd_worker_acquire/nd_worker_release against the
+// loop's own hand-off picks (the daemon's cold-path pool analog),
+// and one worker is wired to die mid-task — the driver connection
+// must still get exactly one (crashed) reply and Python must see the
+// typed worker-dead event.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -48,6 +58,14 @@ int nd_ledger_release(void* h, const char* json_res);
 int nd_ledger_get(void* h, char* buf, int cap);
 unsigned long long nd_spilled(void* h);
 int nd_stats_json(void* h, char* buf, int cap);
+int nd_worker_register(void* h, unsigned long long wid, int fd, int pid,
+                       const char* fids_csv);
+int nd_worker_unregister(void* h, unsigned long long wid);
+long long nd_worker_acquire(void* h, int timeout_ms);
+int nd_worker_release(void* h, unsigned long long wid,
+                      const char* fids_csv);
+int nd_workers_json(void* h, char* buf, int cap);
+int nd_handoff_json(void* h, char* buf, int cap);
 void nd_stop(void* h);
 void nd_destroy(void* h);
 }
@@ -56,6 +74,7 @@ namespace {
 
 constexpr unsigned kFlagPrecharged = 1;
 constexpr int kEvClosed = 1;
+constexpr int kEvWorkerDead = 2;
 constexpr unsigned long long kMaxFrame = 1ull << 20;
 
 bool write_all(int fd, const void* buf, size_t n) {
@@ -132,6 +151,10 @@ struct Counters {
   std::atomic<uint64_t> refused{0};
   std::atomic<uint64_t> echoes{0};
   std::atomic<uint64_t> closes_seen{0};
+  std::atomic<uint64_t> native_ok{0};
+  std::atomic<uint64_t> crashed{0};
+  std::atomic<uint64_t> cold{0};
+  std::atomic<uint64_t> worker_dead{0};
 };
 
 // The daemon's drainer-pool analog: pop events, release admission
@@ -149,6 +172,12 @@ void responder(void* h, Counters* ctr) {
       ctr->closes_seen.fetch_add(1);
       continue;
     }
+    if (kind == kEvWorkerDead) {
+      // conn_id carries the worker id; the daemon discards + respawns
+      // here. Counting it proves the typed event reaches Python.
+      ctr->worker_dead.fetch_add(1);
+      continue;
+    }
     if ((flags & kFlagPrecharged) != 0) {
       nd_ledger_release(h, "{\"CPU\": 1.0}");
       ctr->admitted.fetch_add(1);
@@ -156,6 +185,102 @@ void responder(void* h, Counters* ctr) {
     std::string reply(data, static_cast<size_t>(len));
     nd_free(data);
     nd_send(h, conn_id, reply.data(), reply.size());
+  }
+}
+
+// A fake worker process on one end of a socketpair: reads framed task
+// bodies (the loop's start_native_task forwards the pickle verbatim
+// under a fresh length prefix) and answers each with a framed result,
+// like worker_main's serve loop. The socket stays BLOCKING — the
+// daemon's Python side never sets O_NONBLOCK on its copy, and the
+// loop's dup shares file-status flags, so this mirrors production.
+// die_after >= 0 injects a mid-task death: read the frame, then close
+// without replying.
+void fake_worker(int fd, int die_after, std::atomic<uint64_t>* served) {
+  int answered = 0;
+  for (;;) {
+    std::string task;
+    if (!read_reply(fd, &task)) break;
+    if (die_after >= 0 && answered >= die_after) break;
+    std::string reply = frame(
+        "{\"type\": \"result\", \"tid\": \"ab12\", "
+        "\"marker\": \"native-ok\"}");
+    if (!write_all(fd, reply.data(), reply.size())) break;
+    answered++;
+    served->fetch_add(1);
+  }
+  close(fd);
+}
+
+// Plain-task client: every frame is hand-off eligible, so the common
+// case is a fake worker's reply forwarded with zero responder
+// involvement. Cold fall-through (all workers checked out or pending
+// overflow) gets the responder's body echo instead — one reply either
+// way, so the serial protocol holds under both paths.
+void native_client(int port, int rounds, Counters* ctr) {
+  int fd = dial(port);
+  if (fd < 0) return;
+  const std::string hdr =
+      "{\"type\": \"task\", \"tid\": \"ab12\", \"plain\": true, "
+      "\"fid\": \"cafe\", \"has_fn\": true, "
+      "\"res\": {\"CPU\": 1.0}, \"spillable\": true}";
+  for (int i = 0; i < rounds; i++) {
+    std::string body(48 + (i % 32), static_cast<char>(0x81));
+    std::string t = hybrid(hdr, body);
+    std::string reply;
+    if (!write_all(fd, t.data(), t.size()) || !read_reply(fd, &reply))
+      break;
+    if (reply.find("native-ok") != std::string::npos)
+      ctr->native_ok.fetch_add(1);
+    else if (reply.find("crashed") != std::string::npos)
+      ctr->crashed.fetch_add(1);
+    else if (reply == body ||
+             reply.find("\"spillback\"") != std::string::npos)
+      ctr->cold.fetch_add(1);
+  }
+  close(fd);
+}
+
+// Targets the death-wired worker (unique fid → fid-warm preference
+// picks it whenever idle) until the crash surfaces: the worker dies
+// mid-task and the driver connection must still get exactly one
+// reply, typed crashed, with the ledger charge released.
+void death_client(int port, Counters* ctr) {
+  int fd = dial(port);
+  if (fd < 0) return;
+  const std::string hdr =
+      "{\"type\": \"task\", \"tid\": \"ab12\", \"plain\": true, "
+      "\"fid\": \"dead\", \"has_fn\": true, "
+      "\"res\": {\"CPU\": 1.0}, \"spillable\": true}";
+  for (int i = 0; i < 200 && ctr->crashed.load() == 0; i++) {
+    std::string body(32, static_cast<char>(0x82));
+    std::string t = hybrid(hdr, body);
+    std::string reply;
+    if (!write_all(fd, t.data(), t.size()) || !read_reply(fd, &reply))
+      break;
+    if (reply.find("crashed") != std::string::npos)
+      ctr->crashed.fetch_add(1);
+    else if (reply.find("native-ok") != std::string::npos)
+      ctr->native_ok.fetch_add(1);
+    else if (reply == body ||
+             reply.find("\"spillback\"") != std::string::npos)
+      ctr->cold.fetch_add(1);
+  }
+  close(fd);
+}
+
+// The daemon's cold-path pool analog: check workers out of the native
+// registry (py-owned, epoll-DELed) and hand them back, racing the
+// loop's own hand-off picks and the injected death.
+void checkout_churn(void* h, std::atomic<bool>* done) {
+  while (!done->load()) {
+    long long wid = nd_worker_acquire(h, 5);
+    if (wid == -2) return;  // stopped
+    if (wid >= 0) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+      nd_worker_release(h, static_cast<unsigned long long>(wid),
+                        "cafe");
+    }
   }
 }
 
@@ -261,6 +386,8 @@ void config_churn(void* h, std::atomic<bool>* done) {
     }
     nd_ledger_get(h, buf, sizeof(buf));
     nd_stats_json(h, buf, sizeof(buf));
+    nd_workers_json(h, buf, sizeof(buf));
+    nd_handoff_json(h, buf, sizeof(buf));
     nd_spilled(h);
     nd_set_ping_native(h, (i++ % 8) != 0);
     std::this_thread::sleep_for(std::chrono::milliseconds(1));
@@ -286,13 +413,43 @@ int run_cycle(int cycle) {
 
   Counters ctr;
   std::atomic<bool> done{false};
+
+  // Native hand-off plane: fake workers on socketpairs, the daemon's
+  // end registered with the loop (which dups it, like production
+  // against the pool's Python-held sockets). Worker 2 is wired to
+  // die after two replies; it registers an extra fid so the death
+  // client can target it through fid-warm preference.
+  std::atomic<uint64_t> served{0};
+  std::vector<std::thread> workers;
+  std::vector<int> wfds;
+  for (int i = 0; i < 3; i++) {
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+      fprintf(stderr, "socketpair failed\n");
+      return 1;
+    }
+    int die_after = (i == 2) ? 2 : -1;
+    workers.emplace_back(fake_worker, sv[0], die_after, &served);
+    const char* fids = (i == 2) ? "cafe,dead" : "cafe";
+    if (nd_worker_register(h, static_cast<unsigned long long>(i),
+                           sv[1], 1000 + i, fids) != 0) {
+      fprintf(stderr, "nd_worker_register failed\n");
+      return 1;
+    }
+    wfds.push_back(sv[1]);
+  }
+
   std::vector<std::thread> threads;
   threads.emplace_back(responder, h, &ctr);
   threads.emplace_back(responder, h, &ctr);
   threads.emplace_back(config_churn, h, &done);
+  threads.emplace_back(checkout_churn, h, &done);
   std::vector<std::thread> clients;
   for (int i = 0; i < 4; i++)
     clients.emplace_back(valid_client, port, 40, &ctr);
+  for (int i = 0; i < 3; i++)
+    clients.emplace_back(native_client, port, 40, &ctr);
+  clients.emplace_back(death_client, port, &ctr);
   clients.emplace_back(midframe_disconnector, port, 20);
   clients.emplace_back(oversize_sender, port, 10);
   clients.emplace_back(slow_loris, port, &done);
@@ -301,28 +458,59 @@ int run_cycle(int cycle) {
   done.store(true);
   clients.back().join();
 
+  // The worker-dead event is queued at death time (before the death
+  // client's crashed reply is even read); give the responders a
+  // bounded window to pop it before stop.
+  for (int i = 0; i < 200 && ctr.worker_dead.load() == 0; i++)
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+
+  char hjson[512];
+  hjson[0] = '\0';
+  nd_handoff_json(h, hjson, sizeof(hjson));
+
+  // Unregister before stop (the live path); the dead worker's id is
+  // already gone, so its unregister exercising the unknown-wid return
+  // is deliberate. Closing our fd copies after unregister drops the
+  // last reference and EOFs the fake workers.
+  for (int i = 0; i < 3; i++)
+    nd_worker_unregister(h, static_cast<unsigned long long>(i));
+  for (int fd : wfds) close(fd);
+  for (auto& w : workers) w.join();
+
   // Stop with the responders possibly mid-nd_next and with whatever
   // the loris left half-buffered: teardown must free it all.
   nd_stop(h);
-  threads[0].join();
-  threads[1].join();
-  threads[2].join();
+  for (auto& t : threads) t.join();
   nd_destroy(h);
 
   uint64_t pongs = ctr.pongs.load();
   uint64_t handled = ctr.admitted.load() + ctr.refused.load();
   uint64_t echoes = ctr.echoes.load();
   printf("cycle %d: pongs=%llu admitted=%llu refused=%llu echoes=%llu "
-         "closes=%llu\n",
+         "closes=%llu native_ok=%llu crashed=%llu cold=%llu "
+         "worker_dead=%llu served=%llu handoff=%s\n",
          cycle, (unsigned long long)pongs,
          (unsigned long long)ctr.admitted.load(),
          (unsigned long long)ctr.refused.load(),
          (unsigned long long)echoes,
-         (unsigned long long)ctr.closes_seen.load());
+         (unsigned long long)ctr.closes_seen.load(),
+         (unsigned long long)ctr.native_ok.load(),
+         (unsigned long long)ctr.crashed.load(),
+         (unsigned long long)ctr.cold.load(),
+         (unsigned long long)ctr.worker_dead.load(),
+         (unsigned long long)served.load(), hjson);
   // Hostile traffic must not have starved the valid clients: every
   // ping got a pong and every task frame was admitted or refused.
   if (pongs < 4 * 40 / 2 || handled == 0 || echoes == 0) {
     fprintf(stderr, "FAIL: valid traffic starved\n");
+    return 1;
+  }
+  // The hand-off plane must have carried real traffic: warm-path
+  // replies flowed, the injected death surfaced as a typed crashed
+  // reply, and the worker-dead event reached the event queue.
+  if (ctr.native_ok.load() == 0 || ctr.crashed.load() == 0 ||
+      ctr.worker_dead.load() == 0) {
+    fprintf(stderr, "FAIL: native hand-off plane not exercised\n");
     return 1;
   }
   return 0;
