@@ -30,6 +30,7 @@ from .core.exceptions import (
     TaskCancelledError,
     TaskError,
 )
+from .core.graphable import graphable, is_graphable
 from .core.object_ref import ObjectRef
 from .core.placement_group import (
     PlacementGroup,
@@ -48,6 +49,7 @@ from .core.task import (
 __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "get",
     "put", "wait", "cancel", "kill", "get_actor", "exit_actor", "method",
+    "graphable", "is_graphable",
     "ObjectRef",
     "ObjectRefGenerator", "ActorClass", "ActorHandle", "RemoteFunction",
     "PlacementGroup", "placement_group", "remove_placement_group",

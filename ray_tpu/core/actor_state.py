@@ -395,7 +395,8 @@ class ActorState:
         self.rt.events.record(
             spec.display_name(), t0, time.monotonic(),
             self.node.node_id, spec.task_id.hex(),
-            timing=spec.timing, trace_id=spec.trace_id)
+            timing=spec.timing, trace_id=spec.trace_id,
+            deps=spec.dep_ids(), returns=spec.return_hexes())
 
     def _run_method(self, spec: TaskSpec):
         _ctx.task_id = spec.task_id

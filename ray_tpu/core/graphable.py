@@ -1,0 +1,50 @@
+"""``@graphable`` — declares a function safe to capture as a task graph.
+
+A graphable function is one whose submission structure (the ``.remote()``
+calls and dag binds it performs, and the ref dataflow between them) is
+meant to be captured once and replayed as pre-encoded frames by the
+compiled-dag / dispatch-replay plane (ROADMAP item 3). The marker is a
+declaration of intent, not a behavior change: decorated callables run
+exactly as before. What it buys:
+
+- ``raylint --xp`` treats the function as a graph-capture entry point:
+  the ``effects``/``graphcap`` analyses verify that everything reachable
+  from it is pure enough to replay (no wall-clock/randomness reads, no
+  global or ``self`` mutation, no I/O, no control flow on runtime
+  values) and extract its static task graph (``--graph-out``).
+- the static↔dynamic verifier (tests/test_graph_capture.py) asserts the
+  extracted graph matches what one real execution actually submits.
+
+Use it on the per-iteration driver of a steady-state pipeline (an RLHF
+training step, a serve app builder) — not on setup/teardown code, whose
+effects are the point.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+__all__ = ["graphable", "is_graphable"]
+
+_MARK = "__ray_tpu_graphable__"
+
+
+def graphable(fn: Optional[Callable] = None, *,
+              name: Optional[str] = None):
+    """Mark ``fn`` as a graph-capture entry point.
+
+    Supports both ``@graphable`` and ``@graphable(name="step")``. The
+    optional ``name`` overrides the entry label in graph artifacts.
+    """
+
+    def mark(f: Callable) -> Callable:
+        setattr(f, _MARK, name or getattr(f, "__qualname__", f.__name__))
+        return f
+
+    if fn is not None:
+        return mark(fn)
+    return mark
+
+
+def is_graphable(obj: Any) -> bool:
+    return getattr(obj, _MARK, None) is not None

@@ -621,7 +621,10 @@ class RemotePlane:
             if not released:
                 rt.scheduler.release_task(spec, node.node_id)
             rt.events.record(spec.display_name(), t0, time.monotonic(),
-                             node.node_id, spec.task_id.hex())
+                             node.node_id, spec.task_id.hex(),
+                             timing=spec.timing, trace_id=spec.trace_id,
+                             deps=spec.dep_ids(),
+                             returns=spec.return_hexes())
 
     # -- object directory (multi-location) -------------------------------
     def _on_pull_complete(self, node_id: str, reply: Dict[str, Any]

@@ -631,8 +631,14 @@ class Runtime:
             name=name, actor_id=actor_id, actor_class=cls,
             actor_creation_opts=opts,
         )
+        # Creation joins the caller's trace like task/method submission
+        # does — without it, trace-scoped task-graph reconstruction
+        # (state.list_tasks deps/returns) drops actor-creation nodes.
+        spec.trace_id = _tracing.current_trace_id()
+        spec.timing["submitted"] = time.time()
 
         def on_placed(node: NodeState):
+            t0 = time.monotonic()
             try:
                 if node.is_remote:
                     from .remote_node import remote_actor_state_cls
@@ -685,6 +691,15 @@ class Runtime:
                     except Exception:  # noqa: BLE001 — best-effort
                         pass
                 box["ok"] = True
+                # Creation-task event (reference: creation tasks appear
+                # in the task table): makes actor nodes reconstructable
+                # from state.list_tasks like plain tasks.
+                spec.timing["finished"] = time.time()
+                self.events.record(
+                    spec.display_name(), t0, time.monotonic(),
+                    node.node_id, spec.task_id.hex(),
+                    timing=spec.timing, trace_id=spec.trace_id,
+                    deps=spec.dep_ids())
             except BaseException as e:  # noqa: BLE001
                 box["err"] = e
             finally:
@@ -1155,7 +1170,8 @@ class Runtime:
             self.events.record(
                 spec.display_name(), t0, time.monotonic(),
                 node.node_id, spec.task_id.hex(),
-                timing=spec.timing, trace_id=spec.trace_id)
+                timing=spec.timing, trace_id=spec.trace_id,
+                deps=spec.dep_ids(), returns=spec.return_hexes())
 
     def _execute(self, spec: TaskSpec, node: NodeState):
         t0 = time.monotonic()
@@ -1196,7 +1212,8 @@ class Runtime:
             self.events.record(
                 spec.display_name(), t0, time.monotonic(),
                 node.node_id, spec.task_id.hex(),
-                timing=spec.timing, trace_id=spec.trace_id)
+                timing=spec.timing, trace_id=spec.trace_id,
+                deps=spec.dep_ids(), returns=spec.return_hexes())
 
     def _maybe_retry(self, spec: TaskSpec, e: BaseException) -> bool:
         if isinstance(e, (TaskCancelledError, _ActorExit)):

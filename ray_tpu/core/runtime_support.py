@@ -89,18 +89,26 @@ class TaskEventBuffer:
     def record(self, name: str, phase_start: float, phase_end: float,
                node_id: str, task_id: str, category: str = "task",
                *, timing: Optional[Dict[str, float]] = None,
-               trace_id: Optional[str] = None):
+               trace_id: Optional[str] = None,
+               deps: Optional[List[str]] = None,
+               returns: Optional[List[str]] = None):
         ev = {
             "name": name, "cat": category, "ph": "X",
             "ts": phase_start * 1e6, "dur": (phase_end - phase_start) * 1e6,
             "pid": node_id, "tid": task_id,
         }
-        if timing or trace_id:
+        if timing or trace_id or deps or returns:
             args: Dict[str, Any] = {}
             if timing:
                 args["timing"] = dict(timing)
             if trace_id:
                 args["trace_id"] = trace_id
+            # object-graph stamps: dep/return ids let state.list_tasks
+            # reconstruct the dynamic task graph after the fact
+            if deps:
+                args["deps"] = list(deps)
+            if returns:
+                args["returns"] = list(returns)
             ev["args"] = args
         self.record_raw(ev)
 

@@ -185,6 +185,28 @@ class TaskSpec:
     def display_name(self) -> str:
         return self.name or self.descriptor.name()
 
+    def dep_ids(self) -> List[str]:
+        """Hex ids of top-level ObjectRef args/kwargs — the object
+        edges of the dynamic task graph. Matches the dependency set
+        the dispatcher waits on (`_submit_when_ready` scans exactly
+        the top-level positions); refs nested inside containers are
+        resolved by value at materialization and are not graph edges
+        here. Deduped, submission order preserved."""
+        from .object_ref import ObjectRef
+
+        out: List[str] = []
+        seen = set()
+        for a in list(self.args) + list(self.kwargs.values()):
+            if isinstance(a, ObjectRef):
+                h = a.id().hex()
+                if h not in seen:
+                    seen.add(h)
+                    out.append(h)
+        return out
+
+    def return_hexes(self) -> List[str]:
+        return [r.hex() for r in self.return_ids]
+
 
 def build_resources(opts: Dict[str, Any], *, is_actor: bool) -> ResourceSet:
     # Actors default to 1 CPU for creation-task placement but 0 HELD

@@ -1079,6 +1079,11 @@ def _render_stats(xp_stats: Optional[dict],
         if "cxx_files" in xp_stats:
             head += (f", {xp_stats['cxx_files']} C++ file(s) "
                      f"({xp_stats.get('cxx_exports', 0)} exports)")
+        if "graph_entries" in xp_stats:
+            head += (f", {xp_stats['graph_entries']} graph entry "
+                     f"point(s) ({xp_stats.get('graph_nodes', 0)} "
+                     f"nodes, {xp_stats.get('graph_edges', 0)} edges "
+                     f"captured)")
         parts.insert(0, head)
         owner = {r: a for a, rs in ANALYSIS_RULES.items() for r in rs}
         per: Dict[str, List[int]] = {}
@@ -1120,6 +1125,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--proto-inventory", action="store_true",
                     help="print the wire-protocol inventory table "
                          "(implies --xp) and exit")
+    ap.add_argument("--graph-out", default=None, metavar="PATH",
+                    help="write the per-entry-point captured task "
+                         "graphs (JSON) to this path (implies --xp)")
     ap.add_argument("--out", default=None,
                     help="write the report to this file instead of "
                          "stdout")
@@ -1151,7 +1159,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not paths:
         paths = [os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))]
-    run_xp_passes = args.xp or args.proto_inventory
+    run_xp_passes = (args.xp or args.proto_inventory
+                     or args.graph_out is not None)
     select = None
     if args.select:
         from .xp import XP_RULES
@@ -1190,9 +1199,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     if run_xp_passes:
         from .xp import (XP_RULES, apply_baseline,
                          default_baseline_path, run_xp)
+        graphs = [] if args.graph_out else None
         xp_findings, inventory = run_xp(paths, select, stats=xp_stats,
-                                        only=changed)
+                                        only=changed, graphs=graphs)
         findings.extend(xp_findings)
+        if args.graph_out:
+            with open(args.graph_out, "w", encoding="utf-8") as fh:
+                json.dump({"version": 1, "entries": graphs}, fh,
+                          indent=2)
+                fh.write("\n")
         baseline = args.baseline
         if baseline is None and not args.no_baseline:
             baseline = default_baseline_path()

@@ -116,6 +116,24 @@ XP_RULES: Dict[str, str] = {
         "a lock held across the FFI boundary into unbounded blocking "
         "(Python lock -> joining native export; C++ mutex -> "
         "PyGILState_Ensure)",
+    "xp-graph-unsafe-capture":
+        "a side effect (self/global mutation, wall-clock or "
+        "randomness read, I/O) reachable from a graph-capture entry "
+        "point — replayed frames skip the Python between "
+        "submissions, so the effect runs at capture time only",
+    "xp-graph-shape-drift":
+        "the captured graph's shape depends on runtime values: a "
+        "branch/loop bound on a get()-derived value guarding "
+        "submissions, a feedback edge, an edge out of a "
+        "num_returns=0 producer, or a resource annotation that can "
+        "never be scheduled as captured",
+    "xp-graph-ref-escape":
+        "a captured ref stashed into global/self — on replay the "
+        "stash aliases the capture iteration's (stale) channel",
+    "xp-graph-actor-order":
+        "two branches submit to the same actors in opposite orders "
+        "— capture fixes one submission order per actor, so "
+        "replaying the other branch reorders cross-actor effects",
     "stale-baseline":
         "a baseline entry that no longer matches any finding",
     "xp-parse-error":
@@ -136,6 +154,10 @@ ANALYSIS_RULES: Dict[str, frozenset] = {
     "reflife": frozenset({"xp-ref-leak", "xp-ref-get-in-loop"}),
     "jitlint": frozenset({"xp-jit-host-sync", "xp-jit-impure-mutation",
                           "xp-jit-static-args"}),
+    "effects": frozenset({"xp-graph-unsafe-capture"}),
+    "graphcap": frozenset({"xp-graph-shape-drift",
+                           "xp-graph-ref-escape",
+                           "xp-graph-actor-order"}),
     "ffi_sig": frozenset({"xp-ffi-signature"}),
     "ffi_layout": frozenset({"xp-ffi-layout"}),
     "xlang": frozenset({"xp-xlang-protocol", "xp-xlang-lock"}),
@@ -168,18 +190,23 @@ def _roots(paths: Iterable[str]) -> List[str]:
 
 def run_xp(paths: Iterable[str], select: Optional[Iterable[str]] = None,
            stats: Optional[dict] = None,
-           only: Optional[set] = None) -> Tuple[list, List[dict]]:
+           only: Optional[set] = None,
+           graphs: Optional[list] = None) -> Tuple[list, List[dict]]:
     """Run every whole-program pass over the package(s) rooted at
     `paths`. Returns (findings, wire-protocol inventory rows). When
     `stats` is a dict it is filled in place with index size, call-graph
     edge count, and per-analysis finding counts. `only` (a set of
     absolute file paths — the --changed-only diff) keeps indexing and
     provenance whole-program but restricts the per-site scans of the
-    site-anchored analyses (contracts/reflife/jitlint) to functions in
-    those files; the graph analyses (lockgraph/protocol) still run in
-    full, since their table builds are their scans."""
+    site-anchored analyses (contracts/reflife/jitlint/effects/graphcap)
+    to functions in those files; the graph analyses
+    (lockgraph/protocol) still run in full, since their table builds
+    are their scans. When `graphs` is a list it is filled in place
+    with the per-entry task-graph artifacts from graph capture
+    (``raylint --graph-out``)."""
     from ..raylint import Finding  # late import; raylint imports us too
-    from . import contracts, cxx, ffi, jitlint, reflife
+    from . import contracts, cxx, effects, ffi, graphcap, jitlint, \
+        reflife
     from .dataflow import CallGraph, RemoteResolver
 
     wanted = set(select) if select else set(XP_RULES)
@@ -247,10 +274,11 @@ def run_xp(paths: Iterable[str], select: Optional[Iterable[str]] = None,
                                             scans=lock_scans)
                 record("xlang", xl)
         resolver = None
-        if (ANALYSIS_RULES["contracts"] | ANALYSIS_RULES["reflife"]) \
-                & wanted:
+        if (ANALYSIS_RULES["contracts"] | ANALYSIS_RULES["reflife"]
+                | ANALYSIS_RULES["effects"]
+                | ANALYSIS_RULES["graphcap"]) & wanted:
             # one resolver (and one provenance fixed point) shared by
-            # both handle-flow analyses — building it dominates their
+            # all handle-flow analyses — building it dominates their
             # cost
             resolver = RemoteResolver(idx)
         if ANALYSIS_RULES["contracts"] & wanted:
@@ -261,5 +289,29 @@ def run_xp(paths: Iterable[str], select: Optional[Iterable[str]] = None,
                    reflife.check(idx, resolver=resolver, only=only))
         if ANALYSIS_RULES["jitlint"] & wanted:
             record("jitlint", jitlint.check(idx, graph=graph, only=only))
+        if ANALYSIS_RULES["graphcap"] & wanted or graphs is not None:
+            # capture runs whenever its rules are wanted OR an
+            # artifact was requested; stats always get the graph
+            # counts so --stats parity holds without --graph-out
+            glist = graphs if graphs is not None else []
+            n0 = len(glist)
+            got = graphcap.check(idx, graph=graph, resolver=resolver,
+                                 only=only, graphs=glist)
+            if ANALYSIS_RULES["graphcap"] & wanted:
+                record("graphcap", got)
+            if stats is not None:
+                new = glist[n0:]
+                stats["graph_entries"] = (stats.get("graph_entries", 0)
+                                          + len(new))
+                stats["graph_nodes"] = (
+                    stats.get("graph_nodes", 0)
+                    + sum(len(g["nodes"]) for g in new))
+                stats["graph_edges"] = (
+                    stats.get("graph_edges", 0)
+                    + sum(len(g["edges"]) for g in new))
+        if ANALYSIS_RULES["effects"] & wanted:
+            record("effects",
+                   effects.check(idx, graph=graph, resolver=resolver,
+                                 only=only))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, inventory

@@ -1,0 +1,212 @@
+"""Interprocedural effect-and-purity analysis for graph capture.
+
+A captured task graph replays DRIVER code as pre-encoded frames: the
+submissions re-fire, but the Python between them does not re-run. Any
+side effect in that Python is therefore executed at CAPTURE time only
+— the first iteration performs it, every replayed iteration skips it.
+This pass classifies every function reachable from a capture-intent
+entry point (``@ray_tpu.graphable`` defs and ``compile_dag``/
+``experimental_compile`` callers — plain ``.bind()`` builders declare
+no replay intent and are exempt) for the effect classes that make
+replay unsound, and flags each as ``xp-graph-unsafe-capture``:
+
+- **mutation** — assignment to ``self.<attr>`` or to a declared
+  ``global``/``nonlocal`` name: iteration counters, version bumps and
+  caches freeze at their capture-time values.
+- **clock** — wall-time reads (``time.time``/``perf_counter``/
+  ``monotonic``, ``datetime.now``): timings and timeouts measured at
+  capture get baked into every replay.
+- **random** — ``random.*`` / ``np.random.*`` / RNG-object draws /
+  ``uuid.uuid4``/``secrets.*``: the capture iteration's samples replay
+  verbatim (an RLHF pipeline would train on the same prompts forever).
+- **io** — ``open()``/``print``/``subprocess``/file writes: logs and
+  checkpoints stop happening after the first iteration.
+
+Data-dependent control flow on ``get()``-derived values is the fifth
+classification from the effect model; it is *shape*-changing rather
+than merely state-changing, so :mod:`.graphcap` owns its rule
+(``xp-graph-shape-drift``) where the guarded submissions are visible.
+
+Findings aggregate per (reached function, effect class) with up to
+three line witnesses — one reviewable row per decision, not one per
+line — and carry the call chain from the entry, jitlint-style. The
+reachable set is pruned at the runtime plane (see
+:func:`graphcap.capture_reach`): replay replaces the dispatch
+machinery, so only driver code is judged.
+
+Every intentional effect belongs in the baseline with a reason that
+names the replay plan for it (feed it as frame input, hoist it out of
+the captured region, or accept the loss) — that reviewed list IS the
+capture contract for the replay PR.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .dataflow import CallGraph, FuncInfo, RemoteResolver
+from .graphcap import capture_reach, find_entries
+from .index import ProjectIndex
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_CLOCK_ATTRS = {
+    ("time", "time"), ("time", "monotonic"), ("time", "perf_counter"),
+    ("time", "time_ns"), ("time", "monotonic_ns"),
+    ("time", "perf_counter_ns"), ("time", "clock_gettime"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("date", "today"),
+}
+_RANDOM_RECVS = {"random", "secrets"}
+_RNG_METHODS = {"integers", "random", "normal", "uniform", "choice",
+                "permutation", "shuffle", "standard_normal", "randint"}
+_UUID_ATTRS = {"uuid1", "uuid4"}
+_IO_BARE = {"open", "print", "input"}
+_IO_ATTRS = {
+    ("os", "remove"), ("os", "unlink"), ("os", "rename"),
+    ("os", "makedirs"), ("os", "mkdir"), ("os", "rmdir"),
+    ("os", "system"), ("os", "urandom"),
+    ("subprocess", "run"), ("subprocess", "Popen"),
+    ("subprocess", "check_call"), ("subprocess", "check_output"),
+    ("shutil", "rmtree"), ("shutil", "copy"), ("shutil", "copytree"),
+}
+_IO_METHODS = {"write_text", "write_bytes"}
+
+_WHY = {
+    "mutation": ("replay skips the Python between submissions, so the "
+                 "state freezes at its capture-time value"),
+    "clock": ("the capture iteration's timestamp is baked into every "
+              "replay — timings, timeouts and rate metrics go stale"),
+    "random": ("the capture iteration's samples replay verbatim — "
+               "every 'random' draw repeats forever"),
+    "io": ("the write/log happens at capture time only; replayed "
+           "iterations are silent"),
+}
+
+
+def _dotted_tail(expr: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    if isinstance(expr, ast.Name):
+        return None, expr.id
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name):
+            return expr.value.id, expr.attr
+        if isinstance(expr.value, ast.Attribute):
+            return expr.value.attr, expr.attr
+    return None, None
+
+
+def classify(fi: FuncInfo) -> Dict[str, List[Tuple[int, str]]]:
+    """effect class -> [(line, witness)] for one function body.
+    Nested defs are separate functions in the call graph and are
+    skipped; lambdas share the body and are included."""
+    out: Dict[str, List[Tuple[int, str]]] = {}
+
+    def add(kind: str, line: int, what: str) -> None:
+        out.setdefault(kind, []).append((line, what))
+
+    fn = fi.node
+    declared: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, _FUNC_NODES) and n is not fn:
+            continue
+        if isinstance(n, (ast.Global, ast.Nonlocal)):
+            declared.update(n.names)
+    for n in ast.walk(fn):
+        if isinstance(n, _FUNC_NODES) and n is not fn:
+            continue
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (n.targets if isinstance(n, ast.Assign)
+                       else [n.target])
+            for t in targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    add("mutation", n.lineno, f"self.{t.attr} assigned")
+                elif (isinstance(t, ast.Name) and t.id in declared):
+                    add("mutation", n.lineno,
+                        f"global/nonlocal {t.id} assigned")
+        elif isinstance(n, ast.Call):
+            _classify_call(n, add)
+    for kind in out:
+        out[kind].sort()
+    return out
+
+
+def _classify_call(call: ast.Call, add) -> None:
+    recv, name = _dotted_tail(call.func)
+    if recv is None:
+        if name in _IO_BARE:
+            add("io", call.lineno, f"{name}() call")
+        elif name in ("perf_counter", "monotonic", "time_ns"):
+            add("clock", call.lineno, f"{name}() call")
+        return
+    if (recv, name) in _CLOCK_ATTRS:
+        add("clock", call.lineno, f"{recv}.{name}() call")
+        return
+    if (recv, name) in _IO_ATTRS or name in _IO_METHODS:
+        add("io", call.lineno, f"{recv}.{name}() call")
+        return
+    if recv in _RANDOM_RECVS or (recv == "uuid" and name in _UUID_ATTRS):
+        add("random", call.lineno, f"{recv}.{name}() call")
+        return
+    # RNG objects: self._rng.integers(...), rng.choice(...)
+    if name in _RNG_METHODS and "rng" in recv.lower():
+        add("random", call.lineno, f"{recv}.{name}() draw")
+
+
+def _chain_str(chain: List[str]) -> str:
+    shown = chain if len(chain) <= 4 else chain[:2] + ["..."] + chain[-1:]
+    return " -> ".join(q.rsplit(".", 1)[-1] + "()" for q in shown)
+
+
+def check(idx: ProjectIndex, graph: Optional[CallGraph] = None,
+          resolver: Optional[RemoteResolver] = None,
+          only: Optional[Set[str]] = None) -> List:
+    from ..raylint import Finding
+
+    resolver = resolver or RemoteResolver(idx)
+    graph = graph or CallGraph(idx)
+    entries = [e for e in find_entries(idx, resolver)
+               if e.kind in ("graphable", "compile")]
+    effects_memo: Dict[str, Dict[str, List[Tuple[int, str]]]] = {}
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()   # (path, anchor, kind)
+
+    for entry in entries:
+        reach = capture_reach(graph, idx, entry.fi.qual)
+        for qual, chain in sorted(reach.items()):
+            fi = idx.functions.get(qual)
+            if fi is None:
+                continue
+            # reachability stays global; the scan of each reached
+            # body is scoped to the diff
+            if only is not None and fi.path not in only:
+                continue
+            eff = effects_memo.get(qual)
+            if eff is None:
+                eff = classify(fi)
+                effects_memo[qual] = eff
+            for kind, witnesses in sorted(eff.items()):
+                anchor = witnesses[0][0]
+                key = (fi.path, anchor, kind)
+                if key in seen:
+                    continue
+                seen.add(key)
+                shown = ", ".join(
+                    f"line {ln} ({what})"
+                    for ln, what in witnesses[:3])
+                more = (f" and {len(witnesses) - 3} more"
+                        if len(witnesses) > 3 else "")
+                via = ""
+                if len(chain) > 1:
+                    via = f" [captured via {_chain_str(chain)}]"
+                findings.append(Finding(
+                    fi.path, anchor, "xp-graph-unsafe-capture",
+                    f"{kind} effect in {fi.name}() inside the "
+                    f"captured graph of entry {entry.fi.name}() at "
+                    f"{entry.fi.path.rsplit('/', 1)[-1]}:"
+                    f"{entry.line}{via} — {_WHY[kind]}; witnesses: "
+                    f"{shown}{more}"))
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings

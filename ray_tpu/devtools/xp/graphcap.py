@@ -1,0 +1,869 @@
+"""Static task-graph extraction + capture-soundness checks.
+
+ROADMAP item 3 (compiled task graphs / dispatch-plane replay) needs a
+trustworthy answer to "is this pipeline safe to capture once and replay
+as pre-encoded frames?". This pass extracts the graph a capture entry
+point would record — **nodes** are resolved ``.remote()`` sites and dag
+``.bind()`` sites (task, actor creation, actor method, deployment),
+**edges** are function-local ObjectRef dataflow between them (a name
+bound from one site consumed as an argument of a later site, including
+through tuple unpacks, containers and comprehensions) — and verifies
+the structural soundness replay depends on:
+
+- **acyclicity** — a site that consumes its own output across loop
+  iterations is a feedback edge; the unrolled shape depends on the
+  runtime trip count (``xp-graph-shape-drift``).
+- **edge arity** — a ``num_returns=0`` producer returns ``None``, so an
+  "edge" out of it carries no ref: the consumer would be encoded with a
+  dependency that never materializes (``xp-graph-shape-drift``).
+- **annotation feasibility** — a node whose resource annotation can
+  never be scheduled as captured: negative demands, or ``num_gpus > 0``
+  (the TPU runtime rejects it at submit time) — the captured frame
+  would fail on every replay (``xp-graph-shape-drift``).
+- **runtime-value control flow** — an ``if``/``while``/``for`` whose
+  test or bound derives from ``get()`` of a captured ref, guarding
+  further submissions: the replayed frame bakes in the captured branch
+  direction (``xp-graph-shape-drift``).
+- **ref escapes** — a captured ref stored into ``self``/a global: on
+  replay the stash aliases the capture iteration's channel, so later
+  reads see stale objects (``xp-graph-ref-escape``).
+- **same-actor submission order across branches** — two branches that
+  submit to the same actors in opposite orders; captured execution
+  fixes ONE order per actor, so replaying the other branch reorders
+  cross-actor effects (``xp-graph-actor-order``).
+
+Entry points (``find_entries``): functions decorated
+``@ray_tpu.graphable`` ("graphable"), functions that call
+``compile_dag``/``.experimental_compile()`` ("compile"), and functions
+that build a dag/serve graph with ``.bind(...)`` ("bind" — discovered
+by running the capture and keeping functions that yield bind nodes, so
+``socket.bind()`` look-alikes stay out). The captured region is the
+entry's reachable set over the resolved call graph, pruned at the
+runtime plane: replay replaces the dispatch machinery, so the analysis
+walks DRIVER code and stops where `core/`, `dag/`, the serve runtime
+and the public API begin. Edges stay function-local (a ref passed into
+a helper is consumption, not an edge) — conservative by construction,
+like the rest of the dataflow tier.
+
+Per-entry graphs are emitted as artifacts (``raylint --graph-out``) for
+the dispatch-plane replay PR and for the static↔dynamic verifier
+(tests/test_graph_capture.py), which reconstructs the dynamic graph
+from task lifecycle stamps and asserts the capture matches reality.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .dataflow import (CallGraph, ClassInfo, FuncInfo, RemoteResolver,
+                       _iter_calls, _stmt_bodies, remote_decoration,
+                       resolve_value)
+from .index import ProjectIndex
+from .reflife import _is_get, _is_put
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SKIP_NODES = _FUNC_NODES + (ast.ClassDef, ast.Lambda)
+
+# The capture boundary: replay replaces the dispatch plane, so graph
+# extraction walks driver code and stops where the runtime begins.
+# Entries themselves are never pruned — only traversal beyond them.
+_RUNTIME_PLANE = (
+    "ray_tpu.core", "ray_tpu.util", "ray_tpu.observability",
+    "ray_tpu.devtools", "ray_tpu.dag", "ray_tpu._private",
+    "ray_tpu._native", "ray_tpu.state", "ray_tpu.client",
+    "ray_tpu.dashboard", "ray_tpu.autoscaler", "ray_tpu.node",
+    "ray_tpu.serve.api", "ray_tpu.serve.controller",
+    "ray_tpu.serve.handle", "ray_tpu.serve.router",
+    "ray_tpu.serve.replica", "ray_tpu.serve.proxy",
+    "ray_tpu.serve.node_proxy", "ray_tpu.serve.grpc_proxy",
+    "ray_tpu.serve.deployment", "ray_tpu.serve.batching",
+)
+
+
+def _runtime_module(modname: str) -> bool:
+    if modname == "ray_tpu":
+        return True
+    return any(modname == p or modname.startswith(p + ".")
+               for p in _RUNTIME_PLANE)
+
+
+def capture_reach(graph: CallGraph, idx: ProjectIndex,
+                  root: str) -> Dict[str, List[str]]:
+    """`CallGraph.reachable`, pruned at the runtime plane.
+
+    Traversal stops at callees living in dispatch-machinery modules
+    (replay replaces those; judging them would flag the runtime for
+    doing its job) — except the entry's own module, which is driver
+    code by declaration even when it happens to sit under a runtime
+    package (e.g. the ``_private.perf`` benchmark driver).
+    """
+    root_fi = idx.functions.get(root)
+    home = root_fi.module.modname if root_fi is not None else None
+    out: Dict[str, List[str]] = {}
+    queue: List[Tuple[str, List[str]]] = [(root, [root])]
+    while queue:
+        q, chain = queue.pop(0)
+        if q in out:
+            continue
+        out[q] = chain
+        for callee in sorted(graph.edges.get(q, ())):
+            if callee in out:
+                continue
+            fi = idx.functions.get(callee)
+            if (fi is not None and fi.module.modname != home
+                    and _runtime_module(fi.module.modname)):
+                continue
+            queue.append((callee, chain + [callee]))
+    return out
+
+
+# ---------------------------------------------------------------------
+# Entry discovery
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class GraphEntry:
+    fi: FuncInfo
+    line: int
+    kind: str        # "graphable" | "compile" | "bind"
+
+
+def _dec_name(dec: ast.AST) -> Optional[str]:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    return None
+
+
+def is_graphable_def(node: ast.AST) -> bool:
+    return any(_dec_name(d) == "graphable"
+               for d in getattr(node, "decorator_list", []))
+
+
+def _compile_line(fi: FuncInfo,
+                  resolver: RemoteResolver) -> Optional[int]:
+    """Line of the first compile_dag()/.experimental_compile() call."""
+    for call in resolver.calls_in(fi.node):
+        f = call.func
+        name = (f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else None)
+        if name in ("compile_dag", "experimental_compile"):
+            return call.lineno
+    return None
+
+
+def _has_bind_call(fi: FuncInfo, resolver: RemoteResolver) -> bool:
+    return any(isinstance(c.func, ast.Attribute) and c.func.attr == "bind"
+               for c in resolver.calls_in(fi.node))
+
+
+def find_entries(idx: ProjectIndex,
+                 resolver: RemoteResolver) -> List[GraphEntry]:
+    """Graph-capture entry points, "bind" candidates included — a bind
+    candidate is confirmed only if its capture yields a bind node."""
+    entries: List[GraphEntry] = []
+    seen: Set[str] = set()
+
+    def add(fi: FuncInfo, line: int, kind: str) -> None:
+        if fi.qual in seen:
+            return
+        seen.add(fi.qual)
+        entries.append(GraphEntry(fi, line, kind))
+
+    for fi in idx.all_functions():
+        for dec in getattr(fi.node, "decorator_list", []):
+            if _dec_name(dec) == "graphable":
+                add(fi, dec.lineno, "graphable")
+        if fi.qual in seen:
+            continue
+        cl = _compile_line(fi, resolver)
+        if cl is not None:
+            add(fi, cl, "compile")
+        elif _has_bind_call(fi, resolver):
+            add(fi, fi.node.lineno, "bind")
+    return entries
+
+
+# ---------------------------------------------------------------------
+# Graph model
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class GraphNode:
+    id: str
+    kind: str        # task|actor_create|actor_method|bind_*|deploy
+    label: str
+    path: str
+    line: int
+    conditional: bool
+    void: bool = False                 # num_returns=0 producer
+    options: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class Graph:
+    entry: GraphEntry
+    nodes: List[GraphNode] = field(default_factory=list)
+    edges: List[Tuple[str, str]] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        e = self.entry
+        return {
+            "entry": e.fi.qual,
+            "kind": e.kind,
+            "path": e.fi.path,
+            "line": e.line,
+            "nodes": [{
+                "id": n.id, "kind": n.kind, "label": n.label,
+                "path": n.path, "line": n.line,
+                "conditional": n.conditional,
+                **({"void": True} if n.void else {}),
+                **({"options": n.options} if n.options else {}),
+            } for n in self.nodes],
+            "edges": [list(p) for p in self.edges],
+        }
+
+
+def _literal_options(options: Dict[str, ast.expr]) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for k, v in options.items():
+        try:
+            out[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            out[k] = "<dynamic>"
+    return out
+
+
+# ---------------------------------------------------------------------
+# Per-function capture
+# ---------------------------------------------------------------------
+
+
+class _FnCapture:
+    """Statement-ordered capture of one reached function: creates
+    nodes for submission/bind sites, wires ref-dataflow edges, and
+    raises the structural findings that are local to this body."""
+
+    def __init__(self, g: Graph, fi: FuncInfo, entry: GraphEntry,
+                 resolver: RemoteResolver, idx: ProjectIndex,
+                 is_entry_fn: bool):
+        self.g = g
+        self.fi = fi
+        self.entry = entry
+        self.resolver = resolver
+        self.idx = idx
+        self.is_entry_fn = is_entry_fn
+        self.env = resolver.seed_env(fi)
+        self.findings: List[tuple] = []   # (line, rule, message)
+        # name -> producing node ids (refs or dag nodes the name holds)
+        self.made: Dict[str, Set[str]] = {}
+        # name -> node ids whose ref was get()-materialized into it
+        self.derived: Dict[str, Set[str]] = {}
+        # names holding bare put() refs (no producing node)
+        self.put_names: Set[str] = set()
+        # name -> deployment label, for X = deployment(...) locals
+        self.deployments: Dict[str, str] = {}
+        self.branch_depth = 0
+        self.loop_depth = 0
+        self._node_memo: Dict[int, Optional[Set[str]]] = {}
+
+    # -- node/edge creation -------------------------------------------
+
+    def _new_node(self, kind: str, label: str, line: int,
+                  options: Optional[Dict[str, ast.expr]] = None,
+                  void: bool = False) -> str:
+        nid = f"n{len(self.g.nodes)}"
+        conditional = (not self.is_entry_fn or self.branch_depth > 0
+                       or self.loop_depth > 0)
+        self.g.nodes.append(GraphNode(
+            nid, kind, label, self.fi.path, line, conditional, void,
+            _literal_options(options or {})))
+        return nid
+
+    def _edge(self, src: str, dst: str) -> None:
+        if (src, dst) not in self.g.edges:
+            self.g.edges.append((src, dst))
+        node = next(n for n in self.g.nodes if n.id == src)
+        if node.void:
+            self.findings.append((
+                node.line, "xp-graph-shape-drift",
+                f"edge out of a num_returns=0 producer "
+                f"({node.label}) in the captured graph of "
+                f"{self.entry.fi.name}() — the submission returns "
+                f"None, so the consumer would be encoded with a "
+                f"dependency that never materializes; drop "
+                f"num_returns=0 or stop passing the result on"))
+
+    def _check_options(self, nid: str, label: str, line: int,
+                       options: Dict[str, ast.expr]) -> None:
+        for key in ("num_cpus", "num_tpus", "memory"):
+            v = options.get(key)
+            if (isinstance(v, ast.Constant)
+                    and isinstance(v.value, (int, float))
+                    and v.value < 0):
+                self.findings.append((
+                    line, "xp-graph-shape-drift",
+                    f"node {label} in the captured graph of "
+                    f"{self.entry.fi.name}() demands {key}={v.value} "
+                    f"— a negative demand can never be scheduled, so "
+                    f"every replay of the captured frame fails"))
+        g = options.get("num_gpus")
+        if (isinstance(g, ast.Constant)
+                and isinstance(g.value, (int, float)) and g.value > 0):
+            self.findings.append((
+                line, "xp-graph-shape-drift",
+                f"node {label} in the captured graph of "
+                f"{self.entry.fi.name}() is annotated "
+                f"num_gpus={g.value} — the TPU runtime rejects "
+                f"num_gpus at submit time (use num_tpus), so the "
+                f"captured frame cannot be scheduled as annotated"))
+
+    # -- expression evaluation ----------------------------------------
+
+    def eval_expr(self, expr: ast.AST) -> Set[str]:
+        """Node ids the expression's value carries (refs/dag nodes)."""
+        if isinstance(expr, ast.Await):
+            return self.eval_expr(expr.value)
+        if isinstance(expr, ast.Name):
+            return set(self.made.get(expr.id, ()))
+        if isinstance(expr, ast.Starred):
+            return self.eval_expr(expr.value)
+        if isinstance(expr, (ast.List, ast.Tuple, ast.Set)):
+            out: Set[str] = set()
+            for e in expr.elts:
+                out |= self.eval_expr(e)
+            return out
+        if isinstance(expr, ast.IfExp):
+            return self.eval_expr(expr.body) | self.eval_expr(expr.orelse)
+        if isinstance(expr, ast.Subscript):
+            # refs[i] — element of a list a site produced
+            return self.eval_expr(expr.value)
+        if isinstance(expr, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp)):
+            self.resolver.bind_comps(self.env, expr, self.fi)
+            return self.eval_expr(expr.elt)
+        if isinstance(expr, ast.DictComp):
+            self.resolver.bind_comps(self.env, expr, self.fi)
+            return self.eval_expr(expr.value)
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr)
+        return set()
+
+    def _eval_call(self, call: ast.Call) -> Set[str]:
+        memo = self._node_memo.get(id(call))
+        if memo is not None:
+            return memo
+        # arguments first (post-order: inner sites become nodes and
+        # feed edges into this one)
+        inputs: Set[str] = set()
+        for a in call.args:
+            inputs |= self.eval_expr(a)
+        for kw in call.keywords:
+            inputs |= self.eval_expr(kw.value)
+
+        nids = self._classify(call, inputs)
+        if nids is None:
+            # not a graph site: a helper swallowing a ref is
+            # consumption, not an edge
+            nids = set()
+        self._node_memo[id(call)] = nids
+        return nids
+
+    def _classify(self, call: ast.Call,
+                  inputs: Set[str]) -> Optional[Set[str]]:
+        f = call.func
+        # X.remote(...)
+        if isinstance(f, ast.Attribute) and f.attr == "remote":
+            site = self.resolver.site(call, self.fi, self.env)
+            if site is None:
+                return None
+            if site.kind == "actor_method":
+                base = (site.target.name if isinstance(
+                    site.target, ClassInfo) else "<actor>")
+                label = f"{base}.{site.method_name}"
+            elif site.kind == "actor_create":
+                label = site.target.name if site.target else "<actor>"
+            else:
+                label = site.target.name if site.target else "<task>"
+            nr = site.options.get("num_returns")
+            void = isinstance(nr, ast.Constant) and nr.value == 0
+            nid = self._new_node(site.kind, label, call.lineno,
+                                 site.options, void)
+            self._check_options(nid, label, call.lineno, site.options)
+            for src in inputs:
+                self._edge(src, nid)
+            return {nid}
+        # X.bind(...) — dag node or serve deployment bind
+        if isinstance(f, ast.Attribute) and f.attr == "bind":
+            return self._bind_node(call, f.value, inputs)
+        # InputNode()/MultiOutputNode(...) are graph plumbing, not
+        # tasks: pass inputs through so bind chains stay connected
+        name = (f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else None)
+        if name in ("InputNode", "MultiOutputNode"):
+            return inputs
+        return None
+
+    def _bind_node(self, call: ast.Call, recv: ast.AST,
+                   inputs: Set[str]) -> Optional[Set[str]]:
+        made: Optional[Tuple[str, str]] = None     # (kind, label)
+        # deployment local: dep = deployment(Model); dep.bind(...)
+        if isinstance(recv, ast.Name) and recv.id in self.deployments:
+            made = ("deploy", f"deploy:{self.deployments[recv.id]}")
+        if made is None and isinstance(recv, ast.Attribute):
+            # method bind on a live handle / class node:
+            # d.double.bind(x) — receiver typed by provenance
+            actor = self.resolver._handle_of(recv.value, self.fi,
+                                             self.env)
+            if actor is not None:
+                made = ("bind_method", f"{actor.name}.{recv.attr}")
+        if made is None:
+            r = resolve_value(recv, self.fi, self.idx)
+            if r is not None and not isinstance(r, FuncInfo) \
+                    and not isinstance(r, ClassInfo):
+                r = None
+            if r is not None:
+                dep = self._deployment_name(r)
+                if dep is not None:
+                    made = ("deploy", f"deploy:{dep}")
+                elif remote_decoration(r.node) is not None:
+                    kind = ("bind_class" if isinstance(r, ClassInfo)
+                            else "bind_function")
+                    made = (kind, r.name)
+        # deployment(...).bind(...) inline
+        if made is None and isinstance(recv, ast.Call):
+            dep = self._deployment_call_name(recv)
+            if dep is not None:
+                made = ("deploy", f"deploy:{dep}")
+        if made is None:
+            return None              # socket.bind() and friends
+        kind, label = made
+        nid = self._new_node(kind, label, call.lineno)
+        for src in inputs:
+            self._edge(src, nid)
+        return {nid}
+
+    def _deployment_name(self, r) -> Optional[str]:
+        """Deployment name when `r` is an @deployment-decorated def."""
+        for dec in getattr(r.node, "decorator_list", []):
+            if _dec_name(dec) != "deployment":
+                continue
+            if isinstance(dec, ast.Call):
+                for kw in dec.keywords:
+                    if kw.arg == "name" and isinstance(
+                            kw.value, ast.Constant):
+                        return str(kw.value.value)
+            return r.name
+        return None
+
+    def _deployment_call_name(self, call: ast.AST) -> Optional[str]:
+        """Name for ``deployment(Model, name=...)`` / with ``.options``
+        hops, or None when `call` is not a deployment factory call."""
+        for _ in range(4):
+            if not isinstance(call, ast.Call):
+                return None
+            f = call.func
+            name = (f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute)
+                    else None)
+            if name == "options" and isinstance(f, ast.Attribute):
+                for kw in call.keywords:
+                    if kw.arg == "name" and isinstance(
+                            kw.value, ast.Constant):
+                        return str(kw.value.value)
+                call = f.value
+                continue
+            if name != "deployment":
+                return None
+            for kw in call.keywords:
+                if kw.arg == "name" and isinstance(
+                        kw.value, ast.Constant):
+                    return str(kw.value.value)
+            if call.args:
+                r = resolve_value(call.args[0], self.fi, self.idx)
+                got = getattr(r, "name", None) if r is not None else None
+                if got:
+                    return got
+                if isinstance(call.args[0], ast.Name):
+                    return call.args[0].id
+            return None
+        return None
+
+    # -- statement walk -----------------------------------------------
+
+    def run(self) -> List[tuple]:
+        self._walk(list(getattr(self.fi.node, "body", [])),
+                   branch=False, loop=False)
+        return self.findings
+
+    def _walk(self, stmts: List[ast.stmt], branch: bool,
+              loop: bool) -> None:
+        if branch:
+            self.branch_depth += 1
+        if loop:
+            self.loop_depth += 1
+        try:
+            for stmt in stmts:
+                if isinstance(stmt, _SKIP_NODES):
+                    continue
+                self._stmt(stmt)
+                self._recurse(stmt)
+        finally:
+            if branch:
+                self.branch_depth -= 1
+            if loop:
+                self.loop_depth -= 1
+
+    def _recurse(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.If):
+            self._check_drift(stmt.test, stmt)
+            self._check_actor_order(stmt)
+            self._walk(stmt.body, branch=True, loop=False)
+            self._walk(stmt.orelse, branch=True, loop=False)
+            return
+        if isinstance(stmt, ast.While):
+            self._check_drift(stmt.test, stmt)
+            self._walk(stmt.body, branch=True, loop=True)
+            self._walk(stmt.orelse, branch=True, loop=False)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_drift(stmt.iter, stmt)
+            self._walk(stmt.body, branch=False, loop=True)
+            self._walk(stmt.orelse, branch=True, loop=False)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk(stmt.body, branch=False, loop=False)
+            for h in stmt.handlers:
+                self._walk(h.body, branch=True, loop=False)
+            self._walk(stmt.orelse, branch=False, loop=False)
+            self._walk(stmt.finalbody, branch=False, loop=False)
+            return
+        for body in _stmt_bodies(stmt):     # With etc.
+            self._walk(body, branch=False, loop=False)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        # comprehension provenance for every expression child
+        for child in ast.iter_child_nodes(stmt):
+            if not isinstance(child, (ast.stmt, ast.excepthandler)):
+                self.resolver.bind_comps(self.env, child, self.fi)
+        if isinstance(stmt, ast.Assign):
+            self._assign(stmt)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            self.resolver.bind(self.env, stmt, self.fi)
+            if stmt.value is not None and isinstance(
+                    stmt.target, ast.Name):
+                self._bind_value(stmt.target.id, stmt.value)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.resolver.bind_for(self.env, stmt, self.fi)
+            self.eval_expr(stmt.iter)
+            # for r in refs: — loop var carries the producer ids
+            it = stmt.iter
+            if (isinstance(it, ast.Name) and it.id in self.made
+                    and isinstance(stmt.target, ast.Name)):
+                self.made[stmt.target.id] = set(self.made[it.id])
+            return
+        if isinstance(stmt, ast.Expr):
+            self.resolver.bind_append(self.env, stmt.value, self.fi)
+            self._expr_stmt(stmt.value)
+            return
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            if getattr(stmt, "value", None) is not None:
+                self.eval_expr(stmt.value)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self.eval_expr(stmt.test)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if not isinstance(child, (ast.stmt, ast.excepthandler)):
+                self.eval_expr(child)
+
+    def _expr_stmt(self, v: ast.AST) -> None:
+        # xs.append(site(...)) — container membership; self-container
+        # appends of made refs are escapes
+        if (isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr in ("append", "add", "insert")
+                and v.args):
+            ids = self.eval_expr(v.args[-1])
+            recv = v.func.value
+            if isinstance(recv, ast.Name):
+                if ids:
+                    self.made.setdefault(recv.id, set()).update(ids)
+                return
+            if self._is_self_attr(recv) and self._ref_ids(ids):
+                self._escape(v.lineno, f"self.{recv.attr}",
+                             "appended to")
+            return
+        self.eval_expr(v)
+
+    def _assign(self, stmt: ast.Assign) -> None:
+        self.resolver.bind(self.env, stmt, self.fi)
+        v = stmt.value
+        ids = self.eval_expr(v)
+        is_put = self._put_value(v)
+        get_ids = self._get_source_ids(v)
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name):
+                self._bind_name(tgt.id, ids, is_put, get_ids)
+            elif isinstance(tgt, ast.Tuple):
+                for e in tgt.elts:
+                    if isinstance(e, ast.Name):
+                        self._bind_name(e.id, ids, is_put, get_ids)
+            elif self._is_self_attr(tgt):
+                if self._ref_ids(ids) or is_put:
+                    self._escape(stmt.lineno, f"self.{tgt.attr}",
+                                 "stored into")
+
+    def _bind_value(self, name: str, v: ast.AST) -> None:
+        self._bind_name(name, self.eval_expr(v), self._put_value(v),
+                        self._get_source_ids(v))
+
+    def _bind_name(self, name: str, ids: Set[str], is_put: bool,
+                   get_ids: Set[str]) -> None:
+        self.made.pop(name, None)
+        self.derived.pop(name, None)
+        self.put_names.discard(name)
+        if ids:
+            self.made[name] = set(ids)
+        if is_put:
+            self.put_names.add(name)
+        if get_ids:
+            self.derived[name] = set(get_ids)
+
+    def _put_value(self, v: ast.AST) -> bool:
+        if isinstance(v, ast.Name) and v.id in self.put_names:
+            return True
+        if isinstance(v, ast.Call) and _is_put(v, self.fi, self.idx):
+            return True
+        if isinstance(v, (ast.ListComp, ast.SetComp)):
+            return self._put_value(v.elt)
+        if isinstance(v, (ast.List, ast.Tuple, ast.Set)):
+            return bool(v.elts) and any(
+                self._put_value(e) for e in v.elts)
+        return False
+
+    def _get_source_ids(self, v: ast.AST) -> Set[str]:
+        """Producer node ids when `v` is get(<made name>)."""
+        if isinstance(v, ast.Call) and _is_get(v, self.fi, self.idx) \
+                and v.args:
+            return self.eval_expr(v.args[0])
+        if isinstance(v, ast.Subscript):
+            return self._get_source_ids(v.value)
+        return set()
+
+    @staticmethod
+    def _is_self_attr(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    # -- findings -----------------------------------------------------
+
+    def _ref_ids(self, ids: Set[str]) -> Set[str]:
+        """Subset of `ids` whose nodes produce ObjectRefs."""
+        by_id = {n.id: n for n in self.g.nodes}
+        return {i for i in ids
+                if by_id[i].kind in ("task", "actor_method")
+                and not by_id[i].void}
+
+    def _escape(self, line: int, where: str, how: str) -> None:
+        self.findings.append((
+            line, "xp-graph-ref-escape",
+            f"captured ref {how} {where} inside the graph of "
+            f"{self.entry.fi.name}() — on replay the stash still "
+            f"points at the capture iteration's channel, so reads "
+            f"after replay see stale objects; thread the ref through "
+            f"the graph instead, or keep the stash outside the "
+            f"captured region"))
+
+    def _check_drift(self, test: ast.AST, stmt: ast.stmt) -> None:
+        names = {n.id for n in ast.walk(test)
+                 if isinstance(n, ast.Name)
+                 and isinstance(n.ctx, ast.Load)}
+        hot = sorted(names & set(self.derived))
+        if not hot:
+            return
+        if not self._guards_submission(stmt):
+            return
+        word = ("loop bound" if isinstance(
+            stmt, (ast.While, ast.For, ast.AsyncFor)) else "branch")
+        self.findings.append((
+            stmt.lineno, "xp-graph-shape-drift",
+            f"{word} on `{hot[0]}` (get()-materialized from a "
+            f"captured submission) guards further submissions in the "
+            f"graph of {self.entry.fi.name}() — the replayed frame "
+            f"bakes in the captured direction, so an iteration whose "
+            f"runtime value differs diverges from the capture; make "
+            f"the shape static or leave this pipeline uncaptured"))
+
+    def _guards_submission(self, stmt: ast.stmt) -> bool:
+        for body in _stmt_bodies(stmt):
+            for s in body:
+                for call in _iter_calls(s):
+                    f = call.func
+                    if isinstance(f, ast.Attribute) \
+                            and f.attr in ("remote", "bind"):
+                        return True
+        return False
+
+    def _check_actor_order(self, stmt: ast.If) -> None:
+        if not stmt.orelse:
+            return
+        a = self._branch_order(stmt.body)
+        b = self._branch_order(stmt.orelse)
+        common = [k for k in a if k in b]
+        for i, x in enumerate(common):
+            for y in common[i + 1:]:
+                if (a[x] < a[y]) != (b[x] < b[y]):
+                    self.findings.append((
+                        stmt.lineno, "xp-graph-actor-order",
+                        f"the two branches submit to the same actors "
+                        f"({x}, {y}) in opposite orders inside the "
+                        f"captured graph of {self.entry.fi.name}() — "
+                        f"capture fixes ONE submission order per "
+                        f"actor, so replaying the other branch "
+                        f"reorders cross-actor effects; hoist the "
+                        f"submissions out of the branch or make the "
+                        f"order consistent"))
+                    return
+
+    def _branch_order(self, stmts: List[ast.stmt]) -> Dict[str, int]:
+        """receiver-expr -> first submission index, in order."""
+        order: Dict[str, int] = {}
+        i = 0
+        for s in stmts:
+            if isinstance(s, _SKIP_NODES):
+                continue
+            for call in _iter_calls(s):
+                f = call.func
+                if not (isinstance(f, ast.Attribute)
+                        and f.attr == "remote"):
+                    continue
+                site = self.resolver.site(call, self.fi, self.env)
+                if site is None or site.kind != "actor_method":
+                    continue
+                recv = ast.unparse(f.value.value) if isinstance(
+                    f.value, ast.Attribute) else ast.unparse(f.value)
+                if recv not in order:
+                    order[recv] = i
+                i += 1
+        return order
+
+
+# ---------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------
+
+
+def capture_entry(entry: GraphEntry, idx: ProjectIndex,
+                  graph: CallGraph,
+                  resolver: RemoteResolver) -> Tuple[Graph, List[tuple]]:
+    """(captured graph, raw findings) for one entry point. Findings
+    are (path, line, rule, message) tuples."""
+    g = Graph(entry)
+    raw: List[tuple] = []
+    reach = capture_reach(graph, idx, entry.fi.qual)
+    ordered = sorted(reach.items(), key=lambda kv: (len(kv[1]), kv[0]))
+    for qual, _chain in ordered:
+        fi = idx.functions.get(qual)
+        if fi is None:
+            continue
+        cap = _FnCapture(g, fi, entry, resolver, idx,
+                         is_entry_fn=(qual == entry.fi.qual))
+        # deployment factory locals need a pre-pass: dep = deployment(X)
+        _seed_deployments(cap)
+        for line, rule, msg in cap.run():
+            raw.append((fi.path, line, rule, msg))
+    raw.extend(_cycle_findings(g))
+    return g, raw
+
+
+def _seed_deployments(cap: _FnCapture) -> None:
+    for n in ast.walk(cap.fi.node):
+        if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and isinstance(n.value, ast.Call)):
+            continue
+        dep = cap._deployment_call_name(n.value)
+        if dep is not None:
+            cap.deployments[n.targets[0].id] = dep
+
+
+def _cycle_findings(g: Graph) -> List[tuple]:
+    """DFS cycle check over the captured edges."""
+    adj: Dict[str, List[str]] = {}
+    for s, d in g.edges:
+        adj.setdefault(s, []).append(d)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n.id: WHITE for n in g.nodes}
+    labels = {n.id: n for n in g.nodes}
+    out: List[tuple] = []
+
+    def dfs(u: str) -> Optional[str]:
+        color[u] = GREY
+        for v in adj.get(u, ()):
+            if color[v] == GREY:
+                return v
+            if color[v] == WHITE:
+                got = dfs(v)
+                if got is not None:
+                    return got
+        color[u] = BLACK
+        return None
+
+    for n in g.nodes:
+        if color[n.id] != WHITE:
+            continue
+        hit = dfs(n.id)
+        if hit is not None:
+            node = labels[hit]
+            out.append((
+                node.path, node.line, "xp-graph-shape-drift",
+                f"feedback edge: {node.label} consumes its own "
+                f"output across loop iterations in the captured "
+                f"graph of {g.entry.fi.name}() — the unrolled shape "
+                f"depends on the runtime trip count, so a fixed "
+                f"captured frame cannot represent it"))
+            return out
+    return out
+
+
+def check(idx: ProjectIndex, graph: Optional[CallGraph] = None,
+          resolver: Optional[RemoteResolver] = None,
+          only: Optional[Set[str]] = None,
+          graphs: Optional[List[dict]] = None) -> List:
+    """Findings for all entries; when `graphs` is a list it is filled
+    in place with per-entry graph artifacts (bind candidates that
+    yield no bind node are dropped)."""
+    from ..raylint import Finding
+
+    resolver = resolver or RemoteResolver(idx)
+    graph = graph or CallGraph(idx)
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for entry in find_entries(idx, resolver):
+        g, raw = capture_entry(entry, idx, graph, resolver)
+        if entry.kind == "bind" and not any(
+                n.kind.startswith("bind") or n.kind == "deploy"
+                for n in g.nodes):
+            continue                      # socket.bind look-alike
+        if graphs is not None:
+            graphs.append(g.to_dict())
+        for path, line, rule, msg in raw:
+            if only is not None and path not in only:
+                continue
+            key = (path, line, rule)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(path, line, rule, msg))
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.exceptions import ActorDiedError, ActorError, RayTpuError
+from ..core.graphable import graphable
 from ..models.transformer import TransformerConfig
 from ..observability import get_recorder
 from ..observability import tsdb as _tsdb
@@ -315,6 +316,7 @@ class RLHFPipeline:
                 [np.tile(sys_row, (cfg.num_prompts, 1)), base], axis=1)
         return base.astype(np.int32)
 
+    @graphable(name="rlhf.train_iteration")
     def train_iteration(self) -> Dict[str, Any]:
         cfg = self.cfg
         iter_gauge, _ = _metrics()

@@ -370,6 +370,30 @@ class ServeController:
         with self._lock:
             return list(self._sets)
 
+    def app_graph(self) -> Dict[str, List[str]]:
+        """deployment name -> names of deployments it holds handles to.
+
+        `serve.run` replaces nested Applications with DeploymentHandles
+        before deploying, so scanning each replica set's init args for
+        handles recovers the dynamic deployment graph — the runtime
+        counterpart of the statically captured `.bind()` composition
+        (tests/test_graph_capture.py checks they agree)."""
+        from .handle import DeploymentHandle
+
+        def handle_names(args, kwargs) -> List[str]:
+            out = []
+            for v in list(args) + list(kwargs.values()):
+                if isinstance(v, DeploymentHandle):
+                    out.append(v._name)
+            return out
+
+        with self._lock:
+            return {
+                name: handle_names(getattr(rs, "init_args", ()),
+                                   getattr(rs, "init_kwargs", {}))
+                for name, rs in self._sets.items()
+            }
+
     def status(self) -> Dict[str, Any]:
         with self._lock:
             return {

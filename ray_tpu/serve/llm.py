@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.graphable import graphable
 from ..models.generate import (
     KVCache,
     compute_prefix_kv,
@@ -1075,3 +1076,48 @@ class LLMServer:
     def serve_routing_stats(self) -> Dict[str, Any]:
         """Merged into Replica.stats() → controller poll → router."""
         return self.engine.serve_routing_stats()
+
+
+class LLMIngress:
+    """Front deployment of the two-stage LLM app: takes the server's
+    DeploymentHandle as an init arg (serve.run resolves the nested
+    Application into the handle) and forwards generation requests —
+    the composition pattern of the reference's serving app graphs
+    (router/ingress -> engine deployment)."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def generate(self, prompt: Sequence[int], *,
+                 max_new_tokens: int = 64, temperature: float = 0.0,
+                 eos_token: Optional[int] = None,
+                 timeout: Optional[float] = 60.0) -> Dict[str, Any]:
+        return self.server.generate.remote(
+            prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            eos_token=eos_token).result(timeout=timeout)
+
+    def stats(self, *, timeout: Optional[float] = 60.0) -> Dict[str, Any]:
+        return self.server.stats.remote().result(timeout=timeout)
+
+
+@graphable(name="serve.llm_app")
+def build_llm_app(cfg: TransformerConfig, *, num_slots: int = 4,
+                  num_replicas: int = 1, seed: int = 0,
+                  auto_prefix_min_hits: int = 0):
+    """Build the LLM serving application graph: ingress -> server.
+
+    The composition is declared with `.bind()` and materialized by
+    `serve.run(...)`; `@graphable` marks it as a capture entry so
+    raylint's graphcap pass extracts the deployment graph statically
+    and tests/test_graph_capture.py verifies it against the
+    controller's dynamic `app_graph()` view.
+    """
+    from .deployment import deployment
+
+    server_dep = deployment(LLMServer, name="llm_server",
+                            num_replicas=num_replicas)
+    server_app = server_dep.bind(cfg, num_slots=num_slots, seed=seed,
+                                 auto_prefix_min_hits=auto_prefix_min_hits)
+    ingress_dep = deployment(LLMIngress, name="llm_ingress")
+    return ingress_dep.bind(server_app)

@@ -119,6 +119,15 @@ def list_tasks(*, filters: Optional[Sequence[Filter]] = None,
                 row[label.replace("_s", "_ms")] = round(dur * 1000, 3)
         if args.get("trace_id"):
             row["trace_id"] = args["trace_id"]
+        # Object-graph stamps: ids this task consumed (top-level
+        # ObjectRef args) and produced (its return ids). Joining
+        # returns->deps across rows reconstructs the dynamic task
+        # graph (tests/test_graph_capture.py verifies it against the
+        # statically captured one).
+        if args.get("deps"):
+            row["deps"] = list(args["deps"])
+        if args.get("returns"):
+            row["returns"] = list(args["returns"])
         rows.append(row)
     return _apply_filters(rows, filters, limit)
 

@@ -1,0 +1,59 @@
+"""Demo pipelines for static<->dynamic graph verification.
+
+tests/test_graph_capture.py captures these statically (raylint's
+graphcap pass over this directory) AND runs them dynamically,
+then asserts the two task graphs are isomorphic. Keep submissions
+here structural — every `.remote()`/`.bind()` below is part of the
+verified graph shape.
+"""
+
+import ray_tpu
+from ray_tpu.dag import InputNode, compile_dag
+
+
+@ray_tpu.remote
+def preprocess(x):
+    return x + 1
+
+
+@ray_tpu.remote
+def combine(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+class Stage:
+    def __init__(self, scale=2):
+        self.scale = scale
+
+    def work(self, x):
+        return self.scale * x
+
+
+@ray_tpu.graphable
+def fanin_pipeline(x):
+    """Dynamic-dispatch pipeline: two preprocess tasks fan into
+    combine, whose result feeds an actor stage — every edge is ref
+    dataflow visible to both static capture and the task-event
+    dep/return stamps."""
+    a = preprocess.remote(x)
+    b = preprocess.remote(x + 1)
+    c = combine.remote(a, b)
+    s = Stage.remote()
+    out = s.work.remote(c)
+    return ray_tpu.get(out)
+
+
+@ray_tpu.graphable
+def compiled_pipeline(values):
+    """Compiled-dag pipeline: the two-stage shape of the compiled-dag
+    tests declared with `.bind()`; returns the results and the DAG
+    object so the verifier can walk the dynamically built node graph."""
+    s1, s2 = Stage.remote(), Stage.remote()
+    with InputNode() as inp:
+        dag = s2.work.bind(s1.work.bind(inp))
+    cdag = compile_dag(dag)
+    try:
+        return [cdag.execute(v) for v in values], dag
+    finally:
+        cdag.teardown()

@@ -1,0 +1,88 @@
+"""Fixture: graph-capture violations — every xp-graph rule fires.
+
+Exact counts asserted by tests/test_lint_clean.py::test_xp_graph_rules_fire:
+
+  xp-graph-unsafe-capture  4  clock + mutation in step(), io + random
+                              in _log() (reached via the call graph)
+  xp-graph-shape-drift     3  get()-guarded branch, num_gpus demand,
+                              edge out of a num_returns=0 producer
+  xp-graph-ref-escape      1  made ref stored into self._stash
+  xp-graph-actor-order     1  branches submit to two actors in
+                              opposite orders
+"""
+
+import random
+import time
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def load(x):
+    return x
+
+
+@ray_tpu.remote
+def fuse(a, b):
+    return (a or 0) + (b or 0)
+
+
+@ray_tpu.remote
+def notify(x):
+    return None
+
+
+@ray_tpu.remote
+class Sink:
+    def push(self, v):
+        return v
+
+
+@ray_tpu.remote
+class Meter:
+    def tick(self, v):
+        return v
+
+
+class Trainer:
+    def __init__(self):
+        self._stash = None
+        self.steps = 0
+
+    @ray_tpu.graphable
+    def step(self, x):
+        t0 = time.time()                 # clock effect
+        a = load.remote(x)
+        b = load.remote(x + 1)
+        v = ray_tpu.get(a)
+        if v > 0:                        # drift: get-derived guard
+            c = fuse.remote(a, b)
+        else:
+            c = fuse.remote(b, a)
+        self._stash = c                  # ref escape + mutation
+        self.steps = self.steps + 1      # mutation (same finding)
+        self._log(time.time() - t0)      # clock (same finding)
+        return ray_tpu.get(c)
+
+    def _log(self, dt):
+        if random.random() < 0.5:        # random effect
+            print("step took", dt)       # io effect
+
+
+@ray_tpu.graphable
+def fanout(x):
+    n = notify.options(num_returns=0).remote(x)   # void producer
+    g = load.options(num_gpus=1).remote(x)        # drift: num_gpus
+    return fuse.remote(n, g)                      # drift: void edge
+
+
+@ray_tpu.graphable
+def ordered(flag, x):
+    s = Sink.remote()
+    m = Meter.remote()
+    if flag:                             # actor-order: s,m vs m,s
+        s.push.remote(x)
+        m.tick.remote(x)
+    else:
+        m.tick.remote(x)
+        s.push.remote(x)

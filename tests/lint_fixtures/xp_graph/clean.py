@@ -1,0 +1,61 @@
+"""Fixture twin: capture-clean pipelines — zero xp-graph findings.
+
+Also holds a legitimately dynamic pipeline (adaptive_driver) that is
+NOT marked @graphable: data-dependent shapes are fine as long as they
+stay out of the captured set, and the analyses must not chase them.
+"""
+
+import random
+import time
+
+import ray_tpu
+from ray_tpu.serve.deployment import deployment
+
+
+@ray_tpu.remote
+def stage_a(x):
+    return x + 1
+
+
+@ray_tpu.remote
+def stage_b(x):
+    return x * 2
+
+
+class Model:
+    def __call__(self, x):
+        return x
+
+
+class Front:
+    def __init__(self, model):
+        self.model = model
+
+
+@ray_tpu.graphable
+def pure_pipeline(x):
+    """Pure two-stage chain: the only effects are submissions."""
+    a = stage_a.remote(x)
+    b = stage_b.remote(a)
+    return ray_tpu.get(b)
+
+
+@ray_tpu.graphable
+def build_app():
+    """Deployment-composition builder: bind edges, no task effects."""
+    model = deployment(Model, name="clean_model")
+    front = deployment(Front, name="clean_front")
+    model_app = model.bind()
+    return front.bind(model_app)
+
+
+def adaptive_driver(xs):
+    """Data-dependent pipeline — intentionally left uncaptured."""
+    t0 = time.time()
+    out = []
+    r = stage_a.remote(random.choice(xs))
+    while ray_tpu.get(r) % 2:
+        r = stage_a.remote(random.choice(xs))
+        out.append(r)
+    print("drove", len(out), "stages in", time.time() - t0)
+    return [ray_tpu.get(x) for x in out]
