@@ -764,6 +764,225 @@ def bench_rlhf(quick: bool, model: str = "gpt2-125m") -> None:
     }))
 
 
+def bench_soak(quick: bool, minutes: float = 5.0,
+               load_s: float | None = None) -> dict:
+    """Leak-ledger soak gate (README "Leak ledger & soak gating").
+
+    Drives mixed unary/streaming serve load plus out-of-process task
+    storms while periodically killing a replica mid-stream
+    (ServeFaultInjector.crash_on_request) and SIGKILLing a busy
+    worker, then quiesces. PASS requires, at quiescence:
+
+      1. cross-plane reconciliation green, and
+      2. zero LIVE leak suspects (chaos-churned entries must all have
+         been reclaimed or released);
+
+    then proves the detector itself works: a dropped slot release
+    (`AdmissionController.inject_fault("drop_release")`) must be
+    flagged as a leak suspect — attributed to THIS file's acquisition
+    site — within one reconciliation period of crossing the age
+    threshold. Exits nonzero on failure; one JSON line on success.
+    `--quick` is the ~60s tier-1 smoke; the full run load-cycles for
+    `minutes` (--soak-minutes)."""
+    import random
+    import signal
+    import threading
+
+    import ray_tpu
+    import ray_tpu.serve as serve
+    from ray_tpu._private.config import config
+    from ray_tpu._private.fault_injection import ServeFaultInjector
+    from ray_tpu.core.task import NodeAffinitySchedulingStrategy
+    from ray_tpu.observability.ledger import get_ledger
+
+    # Tight cadence so the smoke observes several reconciliation
+    # passes; the leak floor is dropped so the injected leak crosses
+    # its threshold in seconds instead of the production 30.
+    interval_s, leak_floor_s = 1.0, 3.0
+    config.apply({"ledger_interval_s": interval_s,
+                  "ledger_leak_min_age_s": leak_floor_s,
+                  "ledger_leak_k": 8.0})
+    if load_s is None:
+        load_s = 12.0 if quick else max(60.0, minutes * 60.0)
+    kill_every_s = min(4.0 if quick else 15.0, max(1.0, load_s / 3))
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0, num_worker_procs=2)
+    lg = get_ledger()
+    proc = NodeAffinitySchedulingStrategy(node_id="node-procs",
+                                          soft=False)
+
+    @serve.deployment(num_replicas=2, max_request_retries=3)
+    class SoakApp:
+        def __call__(self, x):
+            time.sleep(0.01)
+            return x * 2
+
+        def stream(self, n):
+            for i in range(n):
+                time.sleep(0.002)
+                yield i
+
+    @ray_tpu.remote(scheduling_strategy=proc, max_retries=3)
+    def storm(i):
+        return os.getpid()
+
+    handle = serve.run(SoakApp.bind())
+    injector = ServeFaultInjector(handle._controller)
+    stop = threading.Event()
+    stats = {"unary": 0, "stream": 0, "storm": 0, "errors": 0}
+    stats_lock = threading.Lock()
+
+    def _count(key, n=1):
+        with stats_lock:
+            stats[key] += n
+
+    def unary_loop():
+        while not stop.is_set():
+            futs = [handle.remote(i) for i in range(8)]
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                    _count("unary")
+                except Exception:  # noqa: BLE001 — chaos in flight
+                    _count("errors")
+
+    def stream_loop():
+        sh = handle.options(method_name="stream", stream=True)
+        while not stop.is_set():
+            try:
+                for r in sh.remote(20):
+                    ray_tpu.get(r)
+                _count("stream")
+            except Exception:  # noqa: BLE001 — replica died mid-stream
+                _count("errors")
+
+    def storm_loop():
+        while not stop.is_set():
+            refs = [storm.remote(i) for i in range(16)]
+            try:
+                ray_tpu.get(refs, timeout=60)
+                _count("storm", 16)
+            except Exception:  # noqa: BLE001 — worker killed mid-task
+                _count("errors")
+
+    threads = [threading.Thread(target=fn, daemon=True)
+               for fn in (unary_loop, stream_loop, storm_loop)]
+    for t in threads:
+        t.start()
+
+    rng = random.Random(0)
+    t_end = time.monotonic() + load_s
+    next_kill, kill_replica = time.monotonic() + kill_every_s, True
+    kills = {"replica": 0, "worker": 0}
+    while time.monotonic() < t_end:
+        time.sleep(0.25)
+        if time.monotonic() < next_kill:
+            continue
+        next_kill = time.monotonic() + kill_every_s
+        try:
+            if kill_replica:
+                # Replica dies on its next request — mid-stream, given
+                # the streaming loop's constant pressure.
+                injector.crash_on_request(
+                    "SoakApp", count=1, replica_index=rng.randrange(2))
+                kills["replica"] += 1
+            else:
+                # SIGKILL a live worker process mid-hand-off.
+                pid = ray_tpu.get(storm.remote(0), timeout=30)
+                os.kill(pid, signal.SIGKILL)
+                kills["worker"] += 1
+        except Exception:  # noqa: BLE001 — racing prior chaos
+            pass
+        kill_replica = not kill_replica
+    stop.set()
+    for t in threads:
+        t.join(timeout=90)
+
+    # Load can end with a crash still armed (it fires on the NEXT
+    # request) or a replica mid-replacement; drain that before gating —
+    # the probe absorbs the armed crash and proves the door is healthy.
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            handle.remote(-1).result(timeout=10)
+            break
+        except Exception:  # noqa: BLE001 — replacement in progress
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.5)
+
+    # Quiescence: all load stopped; give the planes a few snapshot
+    # periods to drain, then demand green + zero live suspects.
+    verdict, live = None, None
+    deadline = time.monotonic() + max(20.0, 10 * interval_s)
+    while time.monotonic() < deadline:
+        time.sleep(interval_s)
+        rep = lg.snapshot()
+        verdict, live = rep["reconciliation"], lg.live_suspects()
+        if verdict["green"] and not live:
+            break
+    ok_quiesce = bool(verdict and verdict["green"] and not live)
+    if not ok_quiesce:
+        print(json.dumps({"metric": "soak", "pass": False,
+                          "phase": "quiescence",
+                          "reconciliation": verdict,
+                          "live_suspects": live, "stats": stats,
+                          "kills": kills}))
+        serve.shutdown()
+        ray_tpu.shutdown()
+        sys.exit(1)
+
+    # Injected leak: drop the NEXT slot release on the handle — the
+    # slot and its ledger entry stay held forever. The detector must
+    # flag it within one reconciliation period of crossing the age
+    # threshold, attributed to this file.
+    handle._router.admission.inject_fault("drop_release", 1)
+    handle.remote(99).result(timeout=60)
+    t_inj = time.time()
+    threshold = lg.detector.threshold_s("serve.handle")
+    flagged = None
+    deadline = t_inj + threshold + 3 * interval_s + 10.0
+    while time.time() < deadline and flagged is None:
+        time.sleep(interval_s / 2)
+        lg.snapshot()
+        for s in lg.live_suspects():
+            if s.get("plane") == "serve.handle":
+                flagged = s
+                break
+    detect_s = time.time() - t_inj
+    site = (flagged or {}).get("site", "")
+    ok_leak = flagged is not None and "bench" in site
+    serve.shutdown()
+    ray_tpu.shutdown()
+    if not ok_leak:
+        print(json.dumps({"metric": "soak", "pass": False,
+                          "phase": "injected_leak", "flagged": flagged,
+                          "threshold_s": threshold,
+                          "waited_s": round(detect_s, 1)}))
+        sys.exit(1)
+
+    out = {
+        "metric": "soak", "pass": True, "quick": quick,
+        "load_s": load_s, "stats": stats, "kills": kills,
+        "leak_detect_s": round(detect_s, 2),
+        "leak_threshold_s": round(threshold, 2),
+        "leak_site": site,
+    }
+    # Gate the lag PAST the age threshold, not raw detection time: the
+    # threshold is learned from the run's own hold history, so raw
+    # detect_s varies with load shape while the lag should always be
+    # about one reconciliation period.
+    push_history("soak_leak_detection_lag_s",
+                 max(0.0, detect_s - threshold), "s",
+                 match={"quick": quick},
+                 extra={"detect_s": round(detect_s, 2),
+                        "threshold_s": round(threshold, 2),
+                        "kills": kills})
+    print(json.dumps(out))
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -793,6 +1012,16 @@ def main() -> None:
                     help="end-to-end GRPO RLHF loop (north-star "
                          "config 5): rollout tokens/s, iteration "
                          "wall-clock, weight-refresh seconds")
+    ap.add_argument("--soak", action="store_true",
+                    help="leak-ledger soak gate: mixed serve load + "
+                         "task storms + replica/worker kills; passes "
+                         "only if reconciliation is green and zero "
+                         "leak suspects remain at quiescence, and an "
+                         "injected dropped release is detected and "
+                         "site-attributed (--quick = ~60s smoke)")
+    ap.add_argument("--soak-minutes", type=float, default=5.0,
+                    help="load duration for the full --soak run "
+                         "(ignored under --quick)")
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="record the run's tracing spans and write a "
                          "chrome://tracing JSON to PATH")
@@ -977,6 +1206,9 @@ def _run(args) -> None:
     core_api_smoke()
     print("core API smoke OK", file=sys.stderr)
 
+    if args.soak:
+        bench_soak(args.quick, minutes=args.soak_minutes)
+        return
     if args.serve_prefix:
         bench_serve_prefix(args.quick, model=args.model or "llama-654m")
         return

@@ -293,8 +293,10 @@ class NativeDispatch:
             return self._lib.nd_worker_release(self._h, wid, csv) == 1
 
     def workers(self) -> List[Dict]:
-        """Registry snapshot: [{"wid","pid","state","tid"?}] — "tid" is
-        the hex task id on busy entries (shm attribution labels)."""
+        """Registry snapshot: [{"wid","pid","state","age_s","tid"?}] —
+        "tid" is the hex task id on busy entries (shm attribution
+        labels); "age_s" is seconds since the last state transition
+        (the outstanding-resource ledger's acquire-age)."""
         buf = ctypes.create_string_buffer(1 << 16)
         with self._guard.read():
             if not self._h:
@@ -306,7 +308,8 @@ class NativeDispatch:
 
     def handoff(self) -> Dict[str, int]:
         """Hand-off plane counters: workers/idle/busy/py_owned/pending
-        gauges plus handoffs/completed/worker_deaths/overflow totals."""
+        gauges (plus oldest_pending_s, the ledger's queue acquire-age)
+        and handoffs/completed/worker_deaths/overflow totals."""
         buf = ctypes.create_string_buffer(1024)
         with self._guard.read():
             if not self._h:

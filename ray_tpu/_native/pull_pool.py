@@ -15,7 +15,17 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from typing import Dict, Hashable, List, Tuple
+
+
+def _site() -> str:
+    try:
+        from ray_tpu.observability.ledger import acquisition_site
+
+        return acquisition_site()
+    except Exception:  # noqa: BLE001
+        return ""
 
 
 class PullClientPool:
@@ -28,6 +38,15 @@ class PullClientPool:
         # not stream it twice (the loser of the native create races
         # would drain a full duplicate copy off the wire).
         self._inflight: Dict[Hashable, threading.Event] = {}
+        # Outstanding-resource ledger view of the in-flight set:
+        # key -> (t0, acquisition site, object id hex).
+        self._inflight_meta: Dict[Hashable, Tuple[float, str, str]] = {}
+        try:
+            from ray_tpu.observability.ledger import register_collector
+
+            register_collector("pull", self._ledger_entries, owner=self)
+        except Exception:  # noqa: BLE001 — ledger is optional here
+            pass
         self._mgr = None
         try:
             from .object_transfer import PullManager
@@ -61,6 +80,8 @@ class PullClientPool:
                 ev = self._inflight.get(key)
                 if ev is None:
                     ev = self._inflight[key] = threading.Event()
+                    self._inflight_meta[key] = (
+                        time.time(), _site(), object_id.hex())
                     break
             # Another thread is fetching this key; once it lands, our
             # own attempt resolves instantly via the local-arena check.
@@ -70,6 +91,7 @@ class PullClientPool:
         finally:
             with self._lock:
                 self._inflight.pop(key, None)
+                self._inflight_meta.pop(key, None)
             ev.set()
 
     def _pull_multi_locked(self, key: Hashable,
@@ -126,6 +148,17 @@ class PullClientPool:
         except Exception:
             self.drop(key)
             raise
+
+    def _ledger_entries(self) -> list:
+        """Outstanding pulls for the resource ledger: one entry per
+        in-flight (single-flight) fetch, aged from request time."""
+        from ray_tpu.observability.ledger import entry
+
+        with self._lock:
+            meta = list(self._inflight_meta.items())
+        return [entry("pull", "inflight", f"pull:{oid}", str(key),
+                      t0, site=site)
+                for key, (t0, site, oid) in meta]
 
     def stats(self) -> dict:
         if self._mgr is not None:

@@ -199,6 +199,31 @@ _D("anomaly_min_samples", int, 4,
 _D("anomaly_p95_spike_factor", float, 3.0,
    "Dispatch-loop watchdog: flag a handler whose current p95 exceeds "
    "this multiple of its trailing-window median p95.")
+# --- outstanding-resource ledger ---
+_D("ledger_enabled", bool, True,
+   "Cluster-wide outstanding-resource ledger: periodic snapshots of "
+   "every plane's held-resource set (serve admission slots, dispatch "
+   "ledger charges, worker checkouts, shm pins, inflight pulls, live "
+   "task/actor rows) with owner, age, and acquisition site, plus "
+   "cross-plane reconciliation and age-based leak detection.")
+_D("ledger_interval_s", float, 5.0,
+   "Seconds between ledger snapshot + reconciliation passes.")
+_D("ledger_leak_k", float, 8.0,
+   "Age-based leak detection: flag an entry whose age exceeds its "
+   "plane's observed p99 hold time multiplied by this factor.")
+_D("ledger_leak_min_age_s", float, 30.0,
+   "Floor below which an entry is never a leak suspect, regardless of "
+   "the plane's p99 (young planes have noisy percentiles).")
+_D("ledger_capture_sites", bool, True,
+   "Stamp each acquisition with its call site (file:line:function) so "
+   "leak findings carry the acquisition backtrace. Cheap (one "
+   "sys._getframe walk per acquisition); disable for micro-benches.")
+_D("ledger_invariant_patience", int, 2,
+   "Consecutive failing snapshots before a reconciliation invariant "
+   "turns red (tolerates heartbeat skew and in-flight churn).")
+_D("ledger_max_entries_per_plane", int, 512,
+   "Bound on ledger entries shipped per plane per snapshot (oldest "
+   "kept — they are the leak candidates).")
 # --- TPU / device ---
 _D("tpu_devices_per_host", int, 0, "0 = autodetect via jax.local_devices().")
 _D("prefetch_to_device_buffers", int, 2,

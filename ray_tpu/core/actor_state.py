@@ -60,6 +60,10 @@ class ActorState:
                  detached: bool = False):
         self.rt = rt
         self.actor_id = actor_id
+        # Creation stamp: the outstanding-resource ledger ages actor
+        # rows from it (a PENDING_CREATION stuck past the leak
+        # threshold becomes a suspect; ALIVE is leak-exempt).
+        self.created_at = time.time()
         # lifetime="detached": survives this driver (reference:
         # gcs_actor_manager.h detached actors); on the daemon plane the
         # hosting worker outlives the creator's connection.

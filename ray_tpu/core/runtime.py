@@ -152,6 +152,7 @@ class Runtime:
                              or os.path.join(self.session_dir, "contprof"))
         self._contprof = None
         self._tsdb = None
+        self._ledger = None
         try:
             from ..observability import continuous as _contmod
             from ..observability import tsdb as _tsdbmod
@@ -161,6 +162,10 @@ class Runtime:
                     "driver", directory=self.contprof_dir)
             if config.metrics_history_enabled:
                 self._tsdb = _tsdbmod.start_scraper()
+            if config.ledger_enabled:
+                from ..observability import ledger as _ledgermod
+
+                self._ledger = _ledgermod.start_ledger()
         except Exception:  # noqa: BLE001 — observability must not stop init
             pass
         spiller = None
@@ -1480,6 +1485,11 @@ class Runtime:
             if self._tsdb is not None:
                 _tsdbmod.stop_scraper()
                 self._tsdb = None
+            if self._ledger is not None:
+                from ..observability import ledger as _ledgermod
+
+                _ledgermod.stop_ledger()
+                self._ledger = None
         except Exception:  # noqa: BLE001
             pass
         if self.memory_monitor is not None:

@@ -421,8 +421,15 @@ class DashboardServer:
 
         async def flight_recorder(_):
             from ..observability import get_recorder
+            from ..observability.recorder import _ledger_summary
 
-            return _json(get_recorder().snapshot())
+            snap = get_recorder().snapshot()
+            # `ray_tpu debug dump --address` writes this blob verbatim
+            # — carry the ledger verdict like the on-disk bundles do.
+            loop = asyncio.get_running_loop()
+            snap["ledger"] = await loop.run_in_executor(
+                None, _ledger_summary)
+            return _json(snap)
 
         async def prom_metrics(_):
             return web.Response(text=metrics_mod.prometheus_text(),
@@ -878,6 +885,25 @@ class DashboardServer:
                         out["transfer"][rt.head_node_id] = head_t
             return _json(out)
 
+        async def ledger_view(request):
+            # Outstanding-resource ledger: latest snapshot (entries
+            # with owner/age/site, reconciliation verdict, leak
+            # suspects). ?fresh=1 forces a new collection pass instead
+            # of serving the periodic thread's last report.
+            from ..observability.ledger import get_ledger
+
+            lg = get_ledger()
+            loop = asyncio.get_running_loop()
+            if request.query.get("fresh"):
+                # Collection calls into actors (serve controller) and
+                # takes plane locks — keep it off the event loop.
+                rep = await loop.run_in_executor(None, lg.snapshot)
+            else:
+                rep = lg.last()
+                if rep is None:
+                    rep = await loop.run_in_executor(None, lg.snapshot)
+            return _json(rep)
+
         async def cluster_node_stats(_):
             # Per-node host stats collected from daemon heartbeats
             # (reference: dashboard agents + modules/reporter — here
@@ -969,6 +995,7 @@ class DashboardServer:
         r.add_get("/api/logs/{name}", tail_log)
         r.add_post("/api/profile", capture_profile)
         r.add_get("/api/event_stats", event_stats_view)
+        r.add_get("/api/ledger", ledger_view)
         r.add_post("/api/kill_random_node", kill_random_node)
         r.add_get("/api/timeline", timeline)
         r.add_get("/api/debug/flight_recorder", flight_recorder)

@@ -43,6 +43,7 @@ import struct
 import threading
 import time
 import uuid
+import weakref
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -222,6 +223,13 @@ class NodeDaemon:
         self._host_stats_ts = -1e9
         self._shm_attr_cache: Dict[str, Any] = {}
         self._shm_attr_ts = -1e9
+        # Outstanding-resource ledger bookkeeping: wid -> (t0, site)
+        # for workers checked out of the native registry (py-owned),
+        # and pid -> first-seen stamp for shm pin holders (pin records
+        # carry no timestamps; age is measured from first observation).
+        self._checkouts: Dict[int, Tuple[float, str]] = {}
+        self._checkouts_lock = threading.Lock()
+        self._pin_first_seen: Dict[int, float] = {}
         # Peer view for spillback redirection (control-plane node table +
         # heartbeat loads), refreshed lazily on refusal.
         self._peer_view: List[dict] = []
@@ -381,6 +389,21 @@ class NodeDaemon:
             self._accept_thread = threading.Thread(
                 target=self._accept_loop, daemon=True, name="node-accept")
             self._accept_thread.start()
+        # An in-process daemon (unit harnesses, head-colocated node)
+        # serves the ledger reconciler directly through a context
+        # provider; a standalone daemon's context rides the heartbeat
+        # instead. Weak-ref'd so a stopped daemon silently drops out.
+        from ray_tpu.observability import ledger as _ledger_mod
+
+        _self = weakref.ref(self)
+
+        def _dispatch_ctx():
+            d = _self()
+            if d is None or d._stop.is_set():
+                return None
+            return (d._ledger_section() or {}).get("dispatch")
+
+        _ledger_mod.register_context_provider("dispatch", _dispatch_ctx)
         logger.info("node daemon %s up: dispatch=%s:%d object=%d cpus=%s",
                     self.node_id, advertise_host, self.dispatch_port,
                     self.transfer.port, num_cpus)
@@ -455,6 +478,94 @@ class NodeDaemon:
         self._shm_attr_ts = now
         return self._shm_attr_cache
 
+    def _ledger_section(self) -> dict:
+        """Outstanding-resource ledger entries + dispatch context for
+        this node, shipped on the heartbeat load report and merged
+        head-side (observability/ledger.py). Entries carry owner, age
+        and acquisition site; the dispatch context carries the charge
+        totals and the native py-owned worker set the reconciler
+        cross-checks against the checkout records."""
+        from ray_tpu.observability import ledger as _ledger
+
+        if not config.ledger_enabled:
+            return {}
+        now = time.time()
+        cap = max(16, int(config.ledger_max_entries_per_plane))
+        # Collectors registered in THIS process (pull pool, etc.).
+        entries = _ledger.local_snapshot()
+        # Cold-path worker checkouts (py-owned by this daemon).
+        with self._checkouts_lock:
+            checkouts = list(self._checkouts.items())
+        for wid, (t0, site) in checkouts[:cap]:
+            entries.append(_ledger.entry(
+                "dispatch.checkout", "checkout", f"co:{wid}",
+                str(wid), t0, site=site, now=now))
+        # Native plane: per-worker busy charges (acquire-age stamped by
+        # the loop) and the authoritative py-owned set.
+        handoff: Dict[str, Any] = {}
+        py_owned_wids: List[int] = []
+        if self._nd is not None:
+            with contextlib.suppress(Exception):
+                handoff = self._nd.handoff()
+            with contextlib.suppress(Exception):
+                for went in self._nd.workers():
+                    state = went.get("state")
+                    if state == "py":
+                        py_owned_wids.append(int(went["wid"]))
+                    elif state == "busy":
+                        age = float(went.get("age_s") or 0.0)
+                        entries.append(_ledger.entry(
+                            "dispatch.ledger", "charge",
+                            f"busy:{went['wid']}",
+                            str(went.get("tid") or went["wid"]),
+                            now - age,
+                            site="src/node_dispatch.cc:"
+                                 "start_native_task", now=now))
+        # Shm pins: one entry per holding pid; a pid that no longer
+        # exists flags its pins as kind="dead_pin" (the reconciler's
+        # shm_pins_have_live_holders invariant). Pin records carry no
+        # stamps, so age runs from first observation here.
+        live_pids = set()
+        for h in self._shm_attribution().get("holders", ()):
+            try:
+                pid = int(h.get("pid", 0))
+            except (TypeError, ValueError):
+                continue
+            amount = (float(h.get("pinned_bytes") or 0)
+                      + float(h.get("creating_bytes") or 0))
+            live_pids.add(pid)
+            t0 = self._pin_first_seen.setdefault(pid, now)
+            kind = "pin"
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                kind = "dead_pin"
+            entries.append(_ledger.entry(
+                "shm.pin", kind, f"pin:{pid}",
+                str(h.get("label") or pid), t0,
+                site=f"pid:{pid}", amount=amount, now=now))
+        for pid in [p for p in self._pin_first_seen
+                    if p not in live_pids]:
+            del self._pin_first_seen[pid]
+        avail = self.available.to_dict()
+        total = self.total.to_dict()
+        with self._actors_lock:
+            n_actors = len(self._actors)
+        disp = {
+            "charged_cpu": round(total.get("CPU", 0.0)
+                                 - avail.get("CPU", 0.0), 6),
+            "busy": int(handoff.get("busy") or 0),
+            "pending": int(handoff.get("pending") or 0),
+            "py_owned": int(handoff.get("py_owned") or 0),
+            "oldest_pending_s": float(
+                handoff.get("oldest_pending_s") or 0.0),
+            "queued": self._queued,
+            "running_py": self._running,
+            "actors": n_actors,
+            "py_owned_wids": py_owned_wids,
+        }
+        return {"entries": entries[:8 * cap], "dispatch": disp}
+
     def _load_report(self) -> dict:
         host = self._host_stats()
         from ray_tpu.observability import event_stats as _estats
@@ -504,6 +615,11 @@ class NodeDaemon:
                 pass
         avail = self.available.to_dict()  # property: takes its own lock
         shm_pins = self._shm_attribution()  # takes actor/running locks
+        ledger_sec: dict = {}
+        try:  # takes _avail_lock via .available — stay outside it
+            ledger_sec = self._ledger_section()
+        except Exception:  # noqa: BLE001 — stats must not kill heartbeats
+            pass
         import resource as _resource
 
         ru = _resource.getrusage(_resource.RUSAGE_SELF)
@@ -533,6 +649,7 @@ class NodeDaemon:
                 "transfer": transfer,
                 "shm_pins": shm_pins,
                 "metrics_history": metrics_history,
+                "ledger": ledger_sec,
             }
 
     def _recommend_spill_target(self, res, exclude) -> Optional[str]:
@@ -784,6 +901,9 @@ class NodeDaemon:
             # py-owned (a cold-path checkout going back); register
             # covers first entry and re-entry after the loop dropped
             # it (worker death bookkeeping, stale-entry cleanup).
+            # Either way the checkout is over — close its ledger entry.
+            with self._checkouts_lock:
+                self._checkouts.pop(w.worker_id, None)
             if nd.worker_release(w.worker_id, fids):
                 return True
             return nd.worker_register(w.worker_id, w.sock.fileno(),
@@ -810,6 +930,13 @@ class NodeDaemon:
             if wid is not None:
                 w = self.pool.get_worker(wid)
                 if w is not None:
+                    from ray_tpu.observability.ledger import (
+                        acquisition_site,
+                    )
+
+                    with self._checkouts_lock:
+                        self._checkouts[wid] = (time.time(),
+                                                acquisition_site())
                     return w
                 # Registry entry the pool no longer knows: drop it so
                 # its dup'd fd cannot leak.
@@ -828,6 +955,8 @@ class NodeDaemon:
         """Pool hook: a worker leaving the pool for good must leave the
         native registry too (closes the loop's dup'd fd)."""
         nd = self._nd
+        with self._checkouts_lock:
+            self._checkouts.pop(w.worker_id, None)
         if nd is not None:
             with contextlib.suppress(Exception):
                 nd.worker_unregister(w.worker_id)
@@ -852,6 +981,8 @@ class NodeDaemon:
         task's charge and wrote the typed crashed reply; Python's job
         is pool bookkeeping — drop the corpse, respawn replacement
         capacity, and unstrand the dead process's arena pins."""
+        with self._checkouts_lock:
+            self._checkouts.pop(wid, None)
         w = self.pool.get_worker(wid)
         if w is not None:
             w.alive = False

@@ -1,0 +1,756 @@
+"""Cluster-wide outstanding-resource ledger.
+
+Every plane in the runtime keeps private bookkeeping for resources it
+holds on someone's behalf — serve admission slots (`_ongoing`), native
+dispatch ledger charges, worker checkouts, shm pins, inflight pulls,
+pending task/actor rows — and before this module nothing ever
+cross-checked them, so a leaked slot was invisible until memory ran
+out. This is the checked-invariant layer on top (the capability of the
+reference's ownership/reference-counting plane, PAPER.md §L1–L2, recast
+as an observer): periodic snapshots of every plane's held-resource set
+with *owner, age, and acquisition site*, cross-plane reconciliation
+invariants, and age-based leak detection.
+
+Three pieces:
+
+- **Collectors**: each plane registers a zero-arg callable returning
+  its outstanding entries (`register_collector`). Registration is
+  weak-ref'd through the owner object so a dead plane silently drops
+  out. Daemons additionally ship a pre-collected ``"ledger"`` section
+  on the load-report plane (``node/daemon.py::_load_report``), merged
+  head-side off ``node.last_load`` — same transport as the metrics
+  TSDB.
+- **Reconciliation**: invariants comparing planes pairwise (every
+  dispatch charge maps to a live task; every shm pin maps to a live
+  pid; Σ replica `_ongoing` == handle/proxy inflight; native worker
+  checkouts == daemon checkout records). An invariant only turns red
+  after ``ledger_invariant_patience`` consecutive failing snapshots —
+  heartbeat skew and in-flight churn make any single observation racy.
+- **Leak detection**: per-plane hold-time history is learned from
+  entries that *disappear* between snapshots (last observed age ≈ hold
+  time); an entry older than ``max(floor, p99 × k)`` becomes a leak
+  suspect: ``ray_tpu_leak_suspect_total{plane}`` + flight-recorder
+  event + anomaly-registry finding carrying the acquisition site.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .._private.config import config
+
+# Entry shape (plain dict so it serializes over the load-report plane):
+#   plane   str   — "serve.handle" | "serve.proxy" | "dispatch.ledger"
+#                   | "dispatch.checkout" | "shm.pin" | "pull" | "task"
+#                   | "actor" | ...
+#   kind    str   — entry subtype within the plane ("ongoing", "queued",
+#                   "charge", "pin", ...)
+#   eid     str   — stable identity across snapshots (leak ages track it)
+#   owner   str   — who holds it (deployment, wid, pid, task id, ...)
+#   age_s   float — seconds held at snapshot time
+#   site    str   — acquisition site "file:line:function" ("" if unknown)
+#   amount  float — optional magnitude (bytes, slots, resource units)
+#   node    str   — filled in by the merge layer ("" = this process)
+
+
+def acquisition_site(depth: int = 2) -> str:
+    """Best-effort caller site for leak attribution. ``depth`` skips
+    the instrumentation frames (1 = caller of this function)."""
+    if not config.ledger_capture_sites:
+        return ""
+    try:
+        f = sys._getframe(depth)
+        # Walk out of this package's own frames so the site names the
+        # *user* of the plane, not the plane internals.
+        for _ in range(6):
+            fn = f.f_code.co_filename
+            if "/ray_tpu/" not in fn.replace("\\", "/"):
+                break
+            nxt = f.f_back
+            if nxt is None:
+                break
+            f = nxt
+        return (f"{f.f_code.co_filename.rsplit('/', 1)[-1]}"
+                f":{f.f_lineno}:{f.f_code.co_name}")
+    except Exception:  # noqa: BLE001 — attribution must never break a plane
+        return ""
+
+
+def entry(plane: str, kind: str, eid: str, owner: str, t0: float,
+          site: str = "", amount: float = 0.0,
+          now: Optional[float] = None) -> Dict[str, Any]:
+    return {"plane": plane, "kind": kind, "eid": str(eid),
+            "owner": str(owner),
+            "age_s": round(max(0.0, (now if now is not None
+                                     else time.time()) - t0), 3),
+            "site": site, "amount": float(amount)}
+
+
+# -- collector registry ------------------------------------------------------
+
+# plane -> {token -> (weakref-to-owner-or-None, collector)}; owner=None
+# pins the collector for the process lifetime (module-level planes).
+_COLLECTORS: Dict[str, Dict[int, Tuple[Optional[weakref.ref],
+                                       Callable[[], List[Dict[str, Any]]]]]] \
+    = {}
+_COLLECTORS_LOCK = threading.Lock()
+_TOKEN = 0
+
+
+def register_collector(plane: str,
+                       collector: Callable[[], List[Dict[str, Any]]],
+                       owner: Any = None) -> int:
+    """Register a zero-arg callable returning a plane's outstanding
+    entries. If ``owner`` is given the registration lives only as long
+    as the owner object (weak-ref'd — dead planes drop out silently).
+    → token usable with ``unregister_collector``."""
+    global _TOKEN
+    with _COLLECTORS_LOCK:
+        _TOKEN += 1
+        token = _TOKEN
+        ref = None
+        if owner is not None:
+            ref = weakref.ref(owner, lambda _r, p=plane, t=token:
+                              unregister_collector(p, t))
+            if getattr(collector, "__self__", None) is owner:
+                # A bound method stored strongly would pin its owner in
+                # this registry forever, defeating the weak lifetime.
+                wm = weakref.WeakMethod(collector)
+
+                def collector():  # noqa: F811 — deliberate rebind
+                    fn = wm()
+                    return fn() if fn is not None else []
+        _COLLECTORS.setdefault(plane, {})[token] = (ref, collector)
+        return token
+
+
+def unregister_collector(plane: str, token: int) -> None:
+    with _COLLECTORS_LOCK:
+        d = _COLLECTORS.get(plane)
+        if d is not None:
+            d.pop(token, None)
+            if not d:
+                _COLLECTORS.pop(plane, None)
+
+
+def local_snapshot() -> List[Dict[str, Any]]:
+    """All registered planes' outstanding entries, bounded per plane.
+    Never raises; a throwing collector contributes nothing."""
+    with _COLLECTORS_LOCK:
+        planes = {p: list(d.values()) for p, d in _COLLECTORS.items()}
+    cap = max(1, int(config.ledger_max_entries_per_plane))
+    out: List[Dict[str, Any]] = []
+    for plane, colls in planes.items():
+        rows: List[Dict[str, Any]] = []
+        for ref, fn in colls:
+            if ref is not None and ref() is None:
+                continue
+            try:
+                rows.extend(fn() or [])
+            except Exception:  # noqa: BLE001
+                continue
+        if len(rows) > cap:
+            # Keep the oldest — they are the leak candidates.
+            rows.sort(key=lambda r: -float(r.get("age_s", 0.0)))
+            rows = rows[:cap]
+        out.extend(rows)
+    return out
+
+
+# -- metrics -----------------------------------------------------------------
+
+_METRICS_LOCK = threading.Lock()
+_METRICS: Dict[str, Any] = {}
+
+
+def _metric(name: str, kind: str, desc: str, tag_keys=()):
+    """Lazy + registry-clash tolerant (tests call clear_registry())."""
+    from ..util import metrics
+    with _METRICS_LOCK:
+        m = _METRICS.get(name)
+        if m is None or metrics._REGISTRY.get(name) is not m:
+            cls = {"counter": metrics.Counter, "gauge": metrics.Gauge}[kind]
+            m = _METRICS[name] = cls(name, desc, tag_keys=tag_keys)
+        return m
+
+
+def _leak_counter():
+    return _metric("ray_tpu_leak_suspect_total", "counter",
+                   "Ledger entries that outlived their plane's p99 hold "
+                   "time × k (age-based leak suspects).", ("plane",))
+
+
+def _entries_gauge():
+    return _metric("ray_tpu_ledger_entries", "gauge",
+                   "Outstanding ledger entries per plane at the last "
+                   "snapshot.", ("plane",))
+
+
+def _oldest_gauge():
+    return _metric("ray_tpu_ledger_oldest_age_seconds", "gauge",
+                   "Age of the oldest outstanding entry per plane.",
+                   ("plane",))
+
+
+def _invariant_gauge():
+    return _metric("ray_tpu_ledger_invariant_violations", "gauge",
+                   "Cross-plane reconciliation invariants currently "
+                   "red (failed ≥ patience consecutive snapshots).")
+
+
+def _recon_counter():
+    return _metric("ray_tpu_ledger_reconcile_total", "counter",
+                   "Ledger snapshot + reconciliation passes run.")
+
+
+# -- leak detection ----------------------------------------------------------
+
+
+class LeakDetector:
+    """Age-based leak detection with learned per-plane hold times.
+
+    Tracks every (plane, eid) first-seen time across snapshots. An
+    entry that disappears contributes its last observed age to the
+    plane's hold-time history; an entry whose age exceeds
+    ``max(ledger_leak_min_age_s, p99(hold) × ledger_leak_k)`` is
+    flagged once (re-flagged only through the anomaly registry's own
+    rate limit).
+    """
+
+    HISTORY = 512
+    # Kinds that are outstanding by design, for as long as the user
+    # likes — aging them into suspects would only make noise. They
+    # still ride snapshots (the /api/ledger view stays complete).
+    EXEMPT_KINDS = frozenset({("actor", "alive")})
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (plane, eid) -> last observed entry (with age_s)
+        self._live: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._hold: Dict[str, List[float]] = {}
+        self._flagged: Dict[Tuple[str, str], float] = {}
+
+    def threshold_s(self, plane: str) -> float:
+        with self._lock:
+            hist = sorted(self._hold.get(plane, ()))
+        floor = float(config.ledger_leak_min_age_s)
+        if not hist:
+            return floor
+        p99 = hist[min(len(hist) - 1, int(len(hist) * 0.99))]
+        return max(floor, p99 * float(config.ledger_leak_k))
+
+    def observe(self, entries: List[Dict[str, Any]]) \
+            -> List[Dict[str, Any]]:
+        """Feed one snapshot; → newly flagged leak suspects."""
+        now = time.time()
+        seen: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        for e in entries:
+            key = (str(e.get("plane", "?")), str(e.get("eid", "")))
+            prev = seen.get(key)
+            if prev is None or e.get("age_s", 0) > prev.get("age_s", 0):
+                seen[key] = e
+        suspects: List[Dict[str, Any]] = []
+        with self._lock:
+            # Entries that disappeared → hold-time history.
+            for key, old in list(self._live.items()):
+                if key not in seen:
+                    hist = self._hold.setdefault(key[0], [])
+                    hist.append(float(old.get("age_s", 0.0)))
+                    if len(hist) > self.HISTORY:
+                        del hist[:len(hist) - self.HISTORY]
+                    del self._live[key]
+                    self._flagged.pop(key, None)
+            self._live.update(seen)
+        for key, e in seen.items():
+            plane = key[0]
+            if (plane, str(e.get("kind", ""))) in self.EXEMPT_KINDS:
+                continue
+            age = float(e.get("age_s", 0.0))
+            if age < self.threshold_s(plane):
+                continue
+            with self._lock:
+                if key in self._flagged:
+                    continue
+                self._flagged[key] = now
+            suspects.append(dict(e))
+        return suspects
+
+    def live_flagged(self) -> List[Dict[str, Any]]:
+        """Flagged entries whose (plane, eid) is still live."""
+        with self._lock:
+            return [dict(self._live[k]) for k in self._flagged
+                    if k in self._live]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._live.clear()
+            self._hold.clear()
+            self._flagged.clear()
+
+
+# -- reconciliation ----------------------------------------------------------
+
+
+def _num(x: Any, default: float = 0.0) -> float:
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return default
+
+
+class Reconciler:
+    """Cross-plane invariants with patience.
+
+    Each invariant callable returns ``None`` when it holds or a detail
+    string when it doesn't; a red verdict requires
+    ``ledger_invariant_patience`` *consecutive* failures so heartbeat
+    skew / in-flight churn can't flip a healthy cluster red.
+    """
+
+    def __init__(self):
+        self._streak: Dict[str, int] = {}
+        self._detail: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def run(self, entries: List[Dict[str, Any]],
+            context: Dict[str, Any]) -> Dict[str, Any]:
+        by_plane: Dict[str, List[Dict[str, Any]]] = {}
+        for e in entries:
+            by_plane.setdefault(str(e.get("plane", "?")), []).append(e)
+        checks = {
+            "dispatch_charges_have_tasks":
+                self._check_charges(by_plane, context),
+            "shm_pins_have_live_holders":
+                self._check_pins(by_plane, context),
+            "serve_ongoing_balanced":
+                self._check_serve(by_plane, context),
+            "checkouts_match_native":
+                self._check_checkouts(by_plane, context),
+        }
+        patience = max(1, int(config.ledger_invariant_patience))
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for name, detail in checks.items():
+                if detail is None:
+                    self._streak[name] = 0
+                    self._detail.pop(name, None)
+                    out[name] = {"ok": True}
+                else:
+                    self._streak[name] = self._streak.get(name, 0) + 1
+                    self._detail[name] = detail
+                    red = self._streak[name] >= patience
+                    out[name] = {"ok": not red, "detail": detail,
+                                 "streak": self._streak[name]}
+        out["green"] = all(v["ok"] for v in out.values()
+                           if isinstance(v, dict))
+        return out
+
+    # Invariant: every native dispatch ledger charge maps to a live
+    # task (running on a worker or pending admission) on that node.
+    @staticmethod
+    def _check_charges(by_plane, context) -> Optional[str]:
+        bad: List[str] = []
+        for node, disp in (context.get("dispatch") or {}).items():
+            charged = _num(disp.get("charged_cpu"), -1.0)
+            if charged < 0:
+                continue
+            live = sum(_num(disp.get(k), 0) for k in
+                       ("busy", "pending", "py_owned", "queued",
+                        "running_py", "actors"))
+            if charged > 0 and live == 0:
+                bad.append(f"{node or 'local'}: {charged} cpu charged "
+                           f"with no live task/actor/checkout")
+        return "; ".join(bad) or None
+
+    # Invariant: every shm pin belongs to a live pid.
+    @staticmethod
+    def _check_pins(by_plane, context) -> Optional[str]:
+        bad = [e for e in by_plane.get("shm.pin", ())
+               if e.get("kind") == "dead_pin"]
+        if bad:
+            return (f"{len(bad)} pins held by dead pids: " +
+                    ", ".join(sorted({e['owner'] for e in bad})[:4]))
+        return None
+
+    # Invariant: Σ replica ongoing == handle/proxy inflight (per
+    # deployment, summed cluster-wide). A client slot is held strictly
+    # longer than replica execution (admission → retries → outcome), so
+    # mid-load the counts legitimately diverge; what can never persist
+    # is one side nonzero while the other is zero — an orphaned replica
+    # counter, or a client slot whose request left the data plane long
+    # ago (e.g. a dropped release).
+    @staticmethod
+    def _check_serve(by_plane, context) -> Optional[str]:
+        replica = context.get("replica_ongoing")
+        if not isinstance(replica, dict):
+            return None  # no serve controller visible — vacuous
+        settle = max(2.0, float(config.ledger_interval_s))
+        client: Dict[str, float] = {}
+        client_settled: Dict[str, float] = {}
+        for e in (by_plane.get("serve.handle", []) +
+                  by_plane.get("serve.proxy", [])):
+            if e.get("kind") == "ongoing":
+                d = str(e.get("owner", "?"))
+                client[d] = client.get(d, 0.0) + 1.0
+                if _num(e.get("age_s"), 0) >= settle:
+                    client_settled[d] = client_settled.get(d, 0.0) + 1.0
+        bad: List[str] = []
+        for dep in set(replica) | set(client):
+            r = _num(replica.get(dep), 0)
+            c = client.get(dep, 0.0)
+            if r > 0 and c == 0:
+                bad.append(f"{dep}: replicas report {r:g} ongoing but "
+                           f"no client holds a slot")
+            elif r == 0 and client_settled.get(dep, 0.0) > 0:
+                bad.append(f"{dep}: clients hold "
+                           f"{client_settled[dep]:g} settled slots but "
+                           f"no replica reports ongoing work")
+        return "; ".join(bad) or None
+
+    # Invariant: native py-owned workers == daemon checkout records.
+    @staticmethod
+    def _check_checkouts(by_plane, context) -> Optional[str]:
+        bad: List[str] = []
+        for node, disp in (context.get("dispatch") or {}).items():
+            native = disp.get("py_owned_wids")
+            if native is None:
+                continue
+            recorded = {str(e.get("eid")).rsplit(":", 1)[-1]
+                        for e in by_plane.get("dispatch.checkout", ())
+                        if (e.get("node") or "") == (node or "")}
+            native = {str(w) for w in native}
+            if native != recorded:
+                orphans = sorted(native - recorded)[:4]
+                stale = sorted(recorded - native)[:4]
+                parts = []
+                if orphans:
+                    parts.append(f"native-owned w/o record: {orphans}")
+                if stale:
+                    parts.append(f"recorded but not native: {stale}")
+                bad.append(f"{node or 'local'}: " + "; ".join(parts))
+        return "; ".join(bad) or None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._streak.clear()
+            self._detail.clear()
+
+
+# -- the ledger engine -------------------------------------------------------
+
+
+class OutstandingLedger:
+    """Snapshot + reconcile + leak-detect, on demand or periodically.
+
+    Runs in the head/driver process: local collectors + per-daemon
+    ``"ledger"`` load-report sections merged off ``node.last_load``.
+    Daemons run collection-only (their entries ride heartbeats).
+    """
+
+    def __init__(self):
+        self.detector = LeakDetector()
+        self.reconciler = Reconciler()
+        self._lock = threading.Lock()
+        self._last: Optional[Dict[str, Any]] = None
+        self._suspects: List[Dict[str, Any]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- cluster merge -------------------------------------------------
+
+    def _cluster_entries(self) -> Tuple[List[Dict[str, Any]],
+                                        Dict[str, Any]]:
+        entries = [dict(e, node=e.get("node", "")) for e in
+                   local_snapshot()]
+        context: Dict[str, Any] = {"dispatch": {}}
+        from ..core.runtime import global_runtime_or_none
+
+        rt = global_runtime_or_none()
+        if rt is not None:
+            try:
+                for node in rt.scheduler.nodes():
+                    load = getattr(node, "last_load", None) or {}
+                    sec = load.get("ledger") or {}
+                    for e in sec.get("entries", ()):
+                        e = dict(e)
+                        e["node"] = node.node_id
+                        entries.append(e)
+                    disp = sec.get("dispatch")
+                    if disp:
+                        context["dispatch"][node.node_id] = disp
+            except Exception:  # noqa: BLE001
+                pass
+            context["replica_ongoing"] = _replica_ongoing(rt)
+            entries.extend(_driver_entries(rt))
+        local_disp = _local_dispatch_context()
+        if local_disp is not None:
+            context["dispatch"][""] = local_disp
+        return entries, context
+
+    # -- one pass ------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Collect + reconcile + leak-detect once; → the full report."""
+        now = time.time()
+        entries, context = self._cluster_entries()
+        verdict = self.reconciler.run(entries, context)
+        suspects = self.detector.observe(entries)
+        self._publish_metrics(entries, verdict)
+        for s in suspects:
+            self._flag_suspect(s)
+        by_plane: Dict[str, Dict[str, Any]] = {}
+        for e in entries:
+            p = str(e.get("plane", "?"))
+            d = by_plane.setdefault(p, {"count": 0, "oldest_age_s": 0.0})
+            d["count"] += 1
+            d["oldest_age_s"] = max(d["oldest_age_s"],
+                                    float(e.get("age_s", 0.0)))
+        with self._lock:
+            self._suspects.extend(suspects)
+            del self._suspects[:-256]
+            report = {
+                "ts": now,
+                "entries": entries,
+                "planes": by_plane,
+                "reconciliation": verdict,
+                "leak_suspects": list(self._suspects),
+                "new_leak_suspects": suspects,
+                "thresholds_s": {p: self.detector.threshold_s(p)
+                                 for p in by_plane},
+            }
+            self._last = report
+        try:
+            _recon_counter().inc()
+        except Exception:  # noqa: BLE001
+            pass
+        return report
+
+    def _publish_metrics(self, entries, verdict) -> None:
+        try:
+            counts: Dict[str, int] = {}
+            oldest: Dict[str, float] = {}
+            for e in entries:
+                p = str(e.get("plane", "?"))
+                counts[p] = counts.get(p, 0) + 1
+                oldest[p] = max(oldest.get(p, 0.0),
+                                float(e.get("age_s", 0.0)))
+            for p, n in counts.items():
+                _entries_gauge().set(n, tags={"plane": p})
+                _oldest_gauge().set(oldest[p], tags={"plane": p})
+            red = sum(1 for k, v in verdict.items()
+                      if isinstance(v, dict) and not v.get("ok", True))
+            _invariant_gauge().set(red)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _flag_suspect(self, e: Dict[str, Any]) -> None:
+        plane = str(e.get("plane", "?"))
+        try:
+            _leak_counter().inc(tags={"plane": plane})
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from .recorder import get_recorder
+            get_recorder().record(
+                "ledger", "leak_suspect", plane=plane,
+                eid=e.get("eid"), owner=e.get("owner"),
+                age_s=e.get("age_s"), site=e.get("site"),
+                node=e.get("node", ""))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from .tsdb import get_anomaly_registry
+            get_anomaly_registry().flag(
+                "ledger", "leak_suspect",
+                f"{plane}:{e.get('eid')}",
+                owner=e.get("owner"), age_s=e.get("age_s"),
+                site=e.get("site", ""), node=e.get("node", ""))
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- queries -------------------------------------------------------
+
+    def last(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._last
+
+    def suspects(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._suspects)
+
+    def live_suspects(self) -> List[Dict[str, Any]]:
+        """Flagged entries still outstanding as of the last snapshot —
+        the quiescence gate: a healthy run's suspects all clear (their
+        entries get released); a leak's suspect stays live forever."""
+        return self.detector.live_flagged()
+
+    def dump_summary(self) -> Dict[str, Any]:
+        """Compact blob for crash dumps / `debug dump` bundles."""
+        last = self.last()
+        if last is None:
+            try:
+                last = self.snapshot()
+            except Exception:  # noqa: BLE001
+                return {"available": False}
+        return {
+            "available": True,
+            "ts": last["ts"],
+            "planes": last["planes"],
+            "reconciliation": last["reconciliation"],
+            "leak_suspects": last["leak_suspects"][-32:],
+        }
+
+    # -- periodic engine -----------------------------------------------
+
+    def start(self) -> "OutstandingLedger":
+        if self._thread is not None or not config.ledger_enabled:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="ray-tpu-ledger", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(max(0.25, float(config.ledger_interval_s)))
+            if self._stop.is_set():
+                return
+            try:
+                self.snapshot()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def clear(self) -> None:
+        with self._lock:
+            self._last = None
+            self._suspects.clear()
+        self.detector.clear()
+        self.reconciler.clear()
+
+
+# -- driver-side context helpers ---------------------------------------------
+
+
+def _replica_ongoing(rt) -> Optional[Dict[str, float]]:
+    """Per-deployment Σ replica `_ongoing` from the serve controller's
+    cached stats (local actor call — no network on a single node)."""
+    try:
+        from .. import get as ray_get, get_actor
+        controller = get_actor("serve::controller")
+    except Exception:  # noqa: BLE001
+        return None
+    try:
+        status = ray_get(controller.status.remote(), timeout=2)
+    except Exception:  # noqa: BLE001
+        return None
+    out: Dict[str, float] = {}
+    for name in (status or {}):
+        try:
+            state = ray_get(
+                controller.routing_state.remote(name), timeout=2)
+        except Exception:  # noqa: BLE001
+            continue
+        out[name] = sum(
+            _num(st.get("ongoing"), 0)
+            for st in (state.get("stats") or {}).values()
+            if isinstance(st, dict))
+    return out
+
+
+def _driver_entries(rt) -> List[Dict[str, Any]]:
+    """Driver-plane outstanding rows: pending/running task specs (aged
+    from their ``submitted`` lifecycle stamp) and live actors (aged
+    from creation; ALIVE actors are leak-exempt — outstanding by
+    design)."""
+    out: List[Dict[str, Any]] = []
+    now = time.time()
+    cap = max(16, int(config.ledger_max_entries_per_plane))
+    try:
+        with rt._pending_lock:
+            pending = list(rt._pending_tasks.values())
+        for spec in pending[:cap]:
+            t0 = float((spec.timing or {}).get("submitted", now))
+            out.append(entry("task", "pending",
+                             f"task:{spec.task_id.hex()}",
+                             spec.display_name(), t0, now=now))
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        with rt._actors_lock:
+            actors = list(rt._actors.items())
+        for aid, st in actors[:cap]:
+            if st.dead.is_set():
+                continue
+            kind = "alive" if st.ready.is_set() else "pending_creation"
+            t0 = float(getattr(st, "created_at", now))
+            out.append(entry("actor", kind, f"actor:{aid.hex()}",
+                             st.cls.__qualname__, t0, now=now))
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def _local_dispatch_context() -> Optional[Dict[str, Any]]:
+    """Dispatch-plane numbers when a native dispatcher runs in-process
+    (daemon role); None on the driver."""
+    coll = _CONTEXT_PROVIDERS.get("dispatch")
+    if coll is None:
+        return None
+    try:
+        return coll()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+# Named context providers (richer than entry lists): daemons install
+# a "dispatch" provider so the reconciler can see charged totals and
+# native py-owned wid sets.
+_CONTEXT_PROVIDERS: Dict[str, Callable[[], Dict[str, Any]]] = {}
+
+
+def register_context_provider(name: str,
+                              fn: Callable[[], Dict[str, Any]]) -> None:
+    _CONTEXT_PROVIDERS[name] = fn
+
+
+def unregister_context_provider(name: str) -> None:
+    _CONTEXT_PROVIDERS.pop(name, None)
+
+
+# -- process-wide singleton --------------------------------------------------
+
+_LEDGER: Optional[OutstandingLedger] = None
+_LEDGER_LOCK = threading.Lock()
+
+
+def get_ledger() -> OutstandingLedger:
+    global _LEDGER
+    with _LEDGER_LOCK:
+        if _LEDGER is None:
+            _LEDGER = OutstandingLedger()
+        return _LEDGER
+
+
+def start_ledger() -> OutstandingLedger:
+    """Idempotent: build-and-start the periodic snapshot thread."""
+    return get_ledger().start()
+
+
+def stop_ledger() -> None:
+    global _LEDGER
+    with _LEDGER_LOCK:
+        lg, _LEDGER = _LEDGER, None
+    if lg is not None:
+        lg.stop()

@@ -93,6 +93,7 @@ class FlightRecorder:
         snap["dumped_at"] = time.time()
         snap["metrics_history"] = _metrics_history_window()
         snap["profile_snapshot"] = _latest_profile_snapshot(crash_pid)
+        snap["ledger"] = _ledger_summary()
         if path is None:
             path = os.path.join(
                 _dump_dir(),
@@ -127,6 +128,18 @@ class FlightRecorder:
         logging.getLogger("ray_tpu").warning(
             "flight recorder dumped to %s (%s)", path, reason)
         return path
+
+
+def _ledger_summary():
+    """Latest outstanding-resource ledger snapshot + reconciliation
+    verdict for the dump bundle (never raises; {} when the ledger is
+    off/never ran): "what was still held, by whom, since when" is the
+    first postmortem question."""
+    try:
+        from .ledger import get_ledger
+        return get_ledger().dump_summary()
+    except Exception:  # noqa: BLE001 - crash handling must not crash
+        return {}
 
 
 def _metrics_history_window(window_s: float = 600.0):

@@ -219,8 +219,14 @@ def cmd_status(args) -> int:
             with contextlib.suppress(Exception):
                 status["anomalies"] = _fetch(
                     args.address, "/api/anomalies").get("anomalies")
+            # Outstanding-resource ledger: reconciliation verdict +
+            # leak suspects, so "what is still held and by whom" is
+            # answerable from `status` alone.
+            with contextlib.suppress(Exception):
+                status["ledger"] = _fetch(args.address, "/api/ledger")
         _print(status)
         _print_anomaly_lines(status.get("anomalies"))
+        _print_ledger_lines(status.get("ledger"))
         return 0
     state = _local_state()
     status = state.cluster_status()
@@ -231,8 +237,13 @@ def cmd_status(args) -> int:
         status = dict(status)
         status["event_stats"] = {"head": _estats.snapshot()}
         status["anomalies"] = get_anomaly_registry().recent()
+        with contextlib.suppress(Exception):
+            from ray_tpu.observability.ledger import get_ledger
+
+            status["ledger"] = get_ledger().dump_summary()
     _print(status)
     _print_anomaly_lines(status.get("anomalies"))
+    _print_ledger_lines(status.get("ledger"))
     return 0
 
 
@@ -249,6 +260,26 @@ def _print_anomaly_lines(anomalies) -> None:
         print(f"  [{ev.get('plane')}/{ev.get('kind')}] "
               f"{ev.get('subject')}" + (f" ({detail})" if detail else ""),
               file=sys.stderr)
+
+
+def _print_ledger_lines(ledger) -> None:
+    """Leak-suspect and red-invariant one-liners after the JSON blob
+    (only under --verbose, which is the only path that sets the key)."""
+    if not isinstance(ledger, dict):
+        return
+    recon = ledger.get("reconciliation") or {}
+    red = {k: v for k, v in recon.items()
+           if isinstance(v, dict) and not v.get("ok", True)}
+    suspects = ledger.get("leak_suspects") or []
+    for name, v in sorted(red.items()):
+        print(f"  [ledger/invariant] {name}: {v.get('detail', '?')} "
+              f"(streak={v.get('streak')})", file=sys.stderr)
+    if suspects:
+        print(f"\n{len(suspects)} leak suspect(s):", file=sys.stderr)
+    for s in suspects[-20:]:
+        print(f"  [ledger/leak] {s.get('plane')}:{s.get('eid')} "
+              f"owner={s.get('owner')} age={s.get('age_s')}s "
+              f"site={s.get('site') or '?'}", file=sys.stderr)
 
 
 def cmd_list(args) -> int:

@@ -33,6 +33,7 @@ import heapq
 import random
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -128,6 +129,32 @@ def _record_retry(app: str) -> None:
             pass
 
 
+# Live admission controllers, weak so the ledger's "serve.handle"
+# collector can snapshot every deployment's outstanding slots without
+# keeping dead routers alive.
+_ADMISSIONS: "weakref.WeakSet" = weakref.WeakSet()
+_ADM_COLLECTOR_DONE = False
+_ADM_REG_LOCK = threading.Lock()
+
+
+def _collect_admission_entries() -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for adm in list(_ADMISSIONS):
+        out.extend(adm.ledger_entries())
+    return out
+
+
+def _register_admission(adm: "AdmissionController") -> None:
+    global _ADM_COLLECTOR_DONE
+    _ADMISSIONS.add(adm)
+    with _ADM_REG_LOCK:
+        if not _ADM_COLLECTOR_DONE:
+            from ..observability.ledger import register_collector
+
+            register_collector("serve.handle", _collect_admission_entries)
+            _ADM_COLLECTOR_DONE = True
+
+
 class AdmissionController:
     """Per-deployment bounded-queue admission (reference: serve's
     max_queued_requests + num_router_requests shedding).
@@ -151,6 +178,11 @@ class AdmissionController:
         self._rate = 0.0            # completions/s EWMA
         self._last_done = 0.0
         self.shed_total = 0
+        # Outstanding-slot ledger: id(fut) -> fut for every admitted
+        # request; each fut is stamped with _adm_t0/_adm_site at submit.
+        self._inflight: Dict[int, Any] = {}
+        self._drop_releases = 0     # fault injection: leak N releases
+        _register_admission(self)
 
     def configure(self, max_ongoing: int, max_queued: int,
                   replicas: int) -> None:
@@ -175,12 +207,17 @@ class AdmissionController:
         """Admit (dispatch now or enqueue) or shed. Sheds raise
         BackPressureError synchronously; a preempted queued request is
         failed with BackPressureError on its own future."""
+        from ..observability.ledger import acquisition_site
+
+        fut._adm_t0 = time.time()
+        fut._adm_site = acquisition_site()
         dispatch_now = evicted = None
         shed_err = None
         with self._lock:
             if self._ongoing < self._capacity_locked():
                 self._ongoing += 1
                 fut._slot_held = True
+                self._inflight[id(fut)] = fut
                 dispatch_now = fut
             elif self._max_queued < 0 or len(self._queue) < self._max_queued:
                 self._seq += 1
@@ -223,12 +260,21 @@ class AdmissionController:
         if dispatch_now is not None:
             dispatch_now._dispatch_now()
 
-    def release(self) -> None:
+    def release(self, holder: Any = None) -> None:
         """One admitted request reached its final outcome: free the
-        slot and dispatch the highest-priority queued request."""
+        slot and dispatch the highest-priority queued request.
+        ``holder`` is the releasing future (drops its ledger entry)."""
         to_dispatch = None
         now = time.monotonic()
         with self._lock:
+            if self._drop_releases > 0:
+                # Fault injection: leak the slot AND its ledger entry
+                # (the entry keeps aging — the ledger must flag it and
+                # attribute the acquisition site).
+                self._drop_releases -= 1
+                return
+            if holder is not None:
+                self._inflight.pop(id(holder), None)
             self._ongoing = max(0, self._ongoing - 1)
             if self._last_done > 0:
                 dt = now - self._last_done
@@ -241,11 +287,21 @@ class AdmissionController:
                 _, _, fut = heapq.heappop(self._queue)
                 self._ongoing += 1
                 fut._slot_held = True
+                self._inflight[id(fut)] = fut
                 to_dispatch = fut
             depth = len(self._queue)
         _record_depth(self._name, depth)
         if to_dispatch is not None:
             to_dispatch._dispatch_now()
+
+    def inject_fault(self, kind: str, value: int = 1) -> None:
+        """Chaos hook mirroring Replica.inject_fault: "drop_release"
+        leaks the next ``value`` slot releases on purpose so tests can
+        prove the ledger detects and attributes them."""
+        if kind != "drop_release":
+            raise ValueError(f"unknown fault kind {kind!r}")
+        with self._lock:
+            self._drop_releases += int(value)
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
@@ -253,6 +309,28 @@ class AdmissionController:
                     "queued": len(self._queue),
                     "capacity": self._capacity_locked(),
                     "shed_total": self.shed_total}
+
+    def ledger_entries(self) -> List[Dict[str, Any]]:
+        """Outstanding admission slots + queued requests with owner,
+        age, and acquisition site (the ledger's serve.handle plane)."""
+        from ..observability.ledger import entry
+
+        now = time.time()
+        with self._lock:
+            ongoing = list(self._inflight.values())
+            queued = [fut for _, _, fut in self._queue]
+        out: List[Dict[str, Any]] = []
+        for fut in ongoing:
+            out.append(entry(
+                "serve.handle", "ongoing", f"{self._name}:{id(fut)}",
+                self._name, getattr(fut, "_adm_t0", now),
+                getattr(fut, "_adm_site", ""), now=now))
+        for fut in queued:
+            out.append(entry(
+                "serve.handle", "queued", f"{self._name}:q:{id(fut)}",
+                self._name, getattr(fut, "_adm_t0", now),
+                getattr(fut, "_adm_site", ""), now=now))
+        return out
 
 
 def _looks_like_tokens(x: Any) -> bool:
@@ -441,6 +519,13 @@ class Router:
             self.maybe_refresh(force=True)
             with self._lock:
                 pool = _pool()
+                if not pool and self._dead:
+                    # Every live key is excluded. A replica the runtime
+                    # restarted in place keeps its actor id, so death
+                    # exclusion would never age out — reset and let
+                    # on_replica_death re-learn actual corpses.
+                    self._dead.clear()
+                    pool = _pool()
             if not pool:
                 raise DeploymentUnavailableError(
                     self._name, "all replicas dead or excluded")
@@ -632,7 +717,7 @@ class _ResponseFuture:
             if not self._slot_held or self._released:
                 return
             self._released = True
-        self._router.admission.release()
+        self._router.admission.release(self)
 
     # -- public ----------------------------------------------------------
     def result(self, timeout: Optional[float] = None):

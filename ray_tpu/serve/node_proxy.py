@@ -202,6 +202,15 @@ class NodeProxy:
         # Per-route admission state, touched only from the proxy's
         # event loop.
         self._admission: Dict[str, _RouteAdmission] = {}
+        # Outstanding-request ledger: token -> (deployment, t0, site).
+        # Written by the event loop, read by the ledger collector
+        # thread — single-key dict ops are atomic under the GIL.
+        self._inflight: Dict[int, Tuple[str, float, str]] = {}
+        self._inflight_seq = 0
+        from ..observability.ledger import register_collector
+
+        register_collector("serve.proxy", self._ledger_entries,
+                           owner=self)
 
         import asyncio
 
@@ -303,6 +312,11 @@ class NodeProxy:
                         status=429, headers=resp_headers)
             else:
                 adm.ongoing += 1
+            self._inflight_seq += 1
+            tok = self._inflight_seq
+            self._inflight[tok] = (
+                str(info.get("deployment", route)), time.time(),
+                f"http:{request.remote or '?'}:{request.path}")
             # -- dispatch with replica-death retry ----------------------
             stats = info.get("stats") or {}
             max_retries = int(cfg.get("max_request_retries", 3))
@@ -358,6 +372,7 @@ class NodeProxy:
                                     self._ongoing[aid] = max(
                                         0, self._ongoing.get(aid, 1) - 1)
             finally:
+                self._inflight.pop(tok, None)
                 adm.ongoing = max(0, adm.ongoing - 1)
                 adm.note_done()
                 while adm.queue and adm.ongoing < cap:
@@ -432,6 +447,19 @@ class NodeProxy:
 
         a, b = self._rng.sample(pool, 2)
         return min((a, b), key=score)
+
+    def _ledger_entries(self) -> List[Dict[str, Any]]:
+        """Outstanding proxied requests (the ledger's serve.proxy
+        plane); site is the remote peer + path that acquired the slot."""
+        from ..observability.ledger import entry
+
+        now = time.time()
+        out: List[Dict[str, Any]] = []
+        for tok, (dep, t0, site) in list(self._inflight.items()):
+            out.append(entry("serve.proxy", "ongoing",
+                             f"{self.node_id}:{tok}", dep, t0, site,
+                             now=now))
+        return out
 
     def _note_shed(self, route: str, priority: int) -> None:
         from .handle import _record_shed

@@ -480,6 +480,9 @@ struct Worker {
   int fd = -1;  // dup'd from Python; closed on unregister/death/stop
   int pid = 0;
   int state = kWIdle;
+  // Stamp of the last state transition: the outstanding-resource
+  // ledger reads it back as the busy/checkout acquire-age.
+  Clock::time_point state_t0 = Clock::now();
   std::set<std::string> fids;  // hex fn ids this worker has cached
   // In-flight native task (state == kWBusy).
   uint64_t task_conn = 0;
@@ -777,6 +780,7 @@ bool start_native_task(NdServer* s, Worker* w, uint64_t conn_id,
                        ResMap&& res, const char* body, size_t body_len,
                        Clock::time_point t0) {
   w->state = kWBusy;
+  w->state_t0 = Clock::now();
   w->task_conn = conn_id;
   w->task_tid = tid;
   w->task_res = std::move(res);
@@ -875,6 +879,7 @@ void worker_died(NdServer* s, Worker* w, bool notify_python) {
 // Returns false when the worker died serving (w freed).
 bool worker_now_idle(NdServer* s, Worker* w) {
   w->state = kWIdle;
+  w->state_t0 = Clock::now();
   w->task_conn = 0;
   w->task_tid.clear();
   w->task_res.clear();
@@ -1582,6 +1587,7 @@ long long nd_worker_acquire(void* h, int timeout_ms) {
     return -1;
   if (w == nullptr) return -2;  // stopped
   w->state = kWPyOwned;
+  w->state_t0 = Clock::now();
   epoll_ctl(s->ep_fd, EPOLL_CTL_DEL, w->fd, nullptr);
   return static_cast<long long>(w->wid);
 }
@@ -1609,8 +1615,11 @@ int nd_worker_release(void* h, unsigned long long wid,
 
 // Per-worker snapshot for shm attribution: BUSY entries carry the hex
 // task id so natively-running tasks stay labeled in load reports.
+// Every entry carries the seconds since its last state transition
+// ("age_s") — the outstanding-resource ledger's acquire-age.
 int nd_workers_json(void* h, char* buf, int cap) {
   NdServer* s = static_cast<NdServer*>(h);
+  Clock::time_point now = Clock::now();
   std::string out = "[";
   {
     std::lock_guard<std::mutex> g(s->wmu);
@@ -1626,6 +1635,10 @@ int nd_workers_json(void* h, char* buf, int cap) {
       out.append(w->state == kWBusy
                      ? "\"busy\""
                      : (w->state == kWPyOwned ? "\"py\"" : "\"idle\""));
+      char age[40];
+      snprintf(age, sizeof(age), ",\"age_s\":%.3f",
+               seconds_since(w->state_t0, now));
+      out.append(age);
       if (w->state == kWBusy) {
         out.append(",\"tid\":");
         json_escape(w->task_tid, &out);
@@ -1643,11 +1656,17 @@ int nd_workers_json(void* h, char* buf, int cap) {
 // Hand-off plane counters (load-report merge + the zero-Python test).
 int nd_handoff_json(void* h, char* buf, int cap) {
   NdServer* s = static_cast<NdServer*>(h);
+  Clock::time_point now = Clock::now();
   size_t idle = 0, busy = 0, py = 0, nworkers = 0, npending = 0;
+  double oldest_pending = 0.0;
   {
     std::lock_guard<std::mutex> g(s->wmu);
     nworkers = s->workers.size();
     npending = s->pending.size();
+    for (const auto& p : s->pending) {
+      double age = seconds_since(p.t0, now);
+      if (age > oldest_pending) oldest_pending = age;
+    }
     for (const auto& kv : s->workers) {
       if (kv.second->state == kWBusy)
         busy++;
@@ -1657,13 +1676,13 @@ int nd_handoff_json(void* h, char* buf, int cap) {
         idle++;
     }
   }
-  char out[320];
+  char out[384];
   int n = snprintf(
       out, sizeof(out),
       "{\"workers\":%zu,\"idle\":%zu,\"busy\":%zu,\"py_owned\":%zu,"
-      "\"pending\":%zu,\"handoffs\":%llu,\"completed\":%llu,"
-      "\"worker_deaths\":%llu,\"overflow\":%llu}",
-      nworkers, idle, busy, py, npending,
+      "\"pending\":%zu,\"oldest_pending_s\":%.3f,\"handoffs\":%llu,"
+      "\"completed\":%llu,\"worker_deaths\":%llu,\"overflow\":%llu}",
+      nworkers, idle, busy, py, npending, oldest_pending,
       static_cast<unsigned long long>(s->handoffs.load()),
       static_cast<unsigned long long>(s->native_done.load()),
       static_cast<unsigned long long>(s->worker_deaths.load()),

@@ -356,6 +356,27 @@ class TestServeChaos:
             handle.remote(2).result(timeout=30)
         assert time.monotonic() - t0 < 15  # bounded, not a hang
 
+    def test_router_exclusion_resets_for_restarted_replicas(self, serve):
+        """A replica the runtime restarts in place keeps its actor id,
+        so death exclusion can never age out via membership change; if
+        every key ends up excluded the router must reset the exclusion
+        set and re-learn actual corpses instead of reporting a
+        permanent outage (found by the leak-ledger soak gate: enough
+        kill cycles excluded every healthy replica forever)."""
+
+        @serve.deployment(num_replicas=2)
+        def g(x):
+            return x + 1
+
+        handle = serve.run(g.bind())
+        assert handle.remote(1).result(timeout=10) == 2
+        router = handle._router
+        for key in list(router._by_key):
+            router.on_replica_death(key)
+        # Both replicas healthy but excluded — pick must self-heal.
+        assert handle.remote(2).result(timeout=10) == 3
+        assert not router._dead
+
     def test_shed_requests_never_leak_ongoing(self, serve):
         """A shed storm leaves every accounting counter at zero: shed
         requests must not hold router or admission slots."""

@@ -65,13 +65,23 @@ def pkg_graphs():
 # dynamic reconstruction
 # ---------------------------------------------------------------------
 
-def _dyn_tasks(trace_id):
-    """Trace-scoped finished task rows."""
+def _dyn_tasks(trace_id, expect_names=0, timeout_s=5.0):
+    """Trace-scoped finished task rows. Task events are recorded after
+    results publish, so a read racing a fresh result must settle:
+    polls until at least `expect_names` distinct task names appear."""
+    import time
+
     from ray_tpu import state
 
-    return [r for r in state.list_tasks(limit=1000)
-            if r.get("state") == "FINISHED"
-            and r.get("trace_id") == trace_id]
+    deadline = time.monotonic() + timeout_s
+    while True:
+        rows = [r for r in state.list_tasks(limit=1000)
+                if r.get("state") == "FINISHED"
+                and r.get("trace_id") == trace_id]
+        if (len({r["name"] for r in rows}) >= expect_names
+                or time.monotonic() >= deadline):
+            return rows
+        time.sleep(0.05)
 
 
 def _dyn_graph(rows):
@@ -138,7 +148,8 @@ def test_fanin_static_dynamic_isomorphism(ray_start, demo_graphs):
         trace_id = tracing.current_trace_id()
         assert dagdemo.fanin_pipeline(3) == 2 * (4 + 5)
 
-    rows = _dyn_tasks(trace_id)
+    # preprocess + combine + Stage creation + Stage.work
+    rows = _dyn_tasks(trace_id, expect_names=4)
     names, edges = _dyn_graph(rows)
     static_labels, static_edges = _quotient(g)
     _assert_label_isomorphic(static_labels, static_edges, names, edges)
@@ -221,7 +232,9 @@ def test_rlhf_iteration_contained_in_capture(ray_start, pkg_graphs):
         pipe.shutdown()
     assert out["tokens"] > 0
 
-    rows = _dyn_tasks(trace_id)
+    # rollout + refresh_weights at minimum (containment only, so just
+    # settle until both must-run phases have rows)
+    rows = _dyn_tasks(trace_id, expect_names=2)
     names, _ = _dyn_graph(rows)
     assert names, "no trace-scoped task rows from the iteration"
     # containment: every dynamically traced task is a captured node

@@ -1,0 +1,453 @@
+"""Outstanding-resource ledger: collectors, leak detection,
+cross-plane reconciliation, chaos reclamation, and the soak smoke.
+
+The ledger (ray_tpu/observability/ledger.py) snapshots every plane's
+held-resource set with owner/age/acquisition-site, reconciles planes
+pairwise, and flags entries that outlive the learned hold-time
+threshold. These tests cover the engine in isolation (detector,
+reconciler, registry), the live local runtime (snapshot green, API
+endpoint, crash-dump bundling), the serve chaos contract (a replica
+killed mid-stream must not strand `_ongoing` entries; a dropped
+release MUST be flagged and site-attributed), and the daemon plane
+(ledger section rides heartbeats; a SIGKILLed worker's charges are
+reclaimed).
+"""
+
+import contextlib
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import config
+from ray_tpu.observability import ledger as L
+
+
+@contextlib.contextmanager
+def _cfg(**overrides):
+    """Apply config overrides, restoring the old values on exit
+    (config is process-wide; leaked overrides would skew later tests)."""
+    old = {k: getattr(config, k) for k in overrides}
+    config.apply(overrides)
+    try:
+        yield
+    finally:
+        config.apply(old)
+
+
+def _settle(predicate, timeout_s=15.0, interval_s=0.1):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        out = predicate()
+        if out or time.monotonic() >= deadline:
+            return out
+        time.sleep(interval_s)
+
+
+# ---------------------------------------------------------------------
+# engine units: sites, entries, registry
+# ---------------------------------------------------------------------
+
+def test_acquisition_site_escapes_ray_tpu():
+    """The site walk must land on the first frame OUTSIDE ray_tpu/ —
+    the user-attributable acquisition point."""
+    site = L.acquisition_site(depth=1)
+    assert "test_ledger.py" in site
+    assert ":test_acquisition_site_escapes_ray_tpu" in site
+
+
+def test_entry_shape_and_age():
+    t0 = time.time() - 2.5
+    e = L.entry("serve.handle", "ongoing", "d:1", "d", t0,
+                site="f.py:1:g", amount=3.0)
+    assert e["plane"] == "serve.handle" and e["eid"] == "d:1"
+    assert 2.0 < e["age_s"] < 10.0
+    assert e["site"] == "f.py:1:g" and e["amount"] == 3.0
+    json.dumps(e)  # must ride the load-report plane
+
+
+def test_collector_registry_weakref_drop():
+    class Plane:
+        def entries(self):
+            return [L.entry("task", "x", "t:1", "me", time.time())]
+
+    p = Plane()
+    tok = L.register_collector("task", p.entries, owner=p)
+    try:
+        assert any(e["eid"] == "t:1" for e in L.local_snapshot())
+        del p  # owner dies -> collector must silently drop out
+        import gc
+
+        gc.collect()
+        assert not any(e["eid"] == "t:1" for e in L.local_snapshot())
+    finally:
+        L.unregister_collector("task", tok)
+
+
+def test_local_snapshot_caps_per_plane_keeping_oldest():
+    now = time.time()
+
+    def flood():
+        return [L.entry("pull", "inflight", f"p:{i}", "x", now - i)
+                for i in range(50)]
+
+    tok = L.register_collector("pull", flood)
+    try:
+        with _cfg(ledger_max_entries_per_plane=16):
+            got = [e for e in L.local_snapshot()
+                   if e["plane"] == "pull"]
+        assert len(got) == 16
+        # oldest kept: they are the leak candidates
+        assert max(e["age_s"] for e in got) >= 49 - 1
+    finally:
+        L.unregister_collector("pull", tok)
+
+
+# ---------------------------------------------------------------------
+# leak detector: threshold learning + one-shot flagging
+# ---------------------------------------------------------------------
+
+def test_leak_detector_flags_old_entry_once():
+    det = L.LeakDetector()
+    with _cfg(ledger_leak_min_age_s=1.0, ledger_leak_k=8.0):
+        old = L.entry("shm.pin", "pin", "pin:9", "w", time.time() - 60)
+        young = L.entry("shm.pin", "pin", "pin:8", "w", time.time())
+        first = det.observe([old, young])
+        assert [s["eid"] for s in first] == ["pin:9"]
+        # already flagged -> not re-reported while it stays live
+        assert det.observe([old, young]) == []
+        assert [s["eid"] for s in det.live_flagged()] == ["pin:9"]
+        # release clears the flag and feeds the hold history
+        det.observe([young])
+        assert det.live_flagged() == []
+
+
+def test_leak_detector_learns_hold_times():
+    det = L.LeakDetector()
+    with _cfg(ledger_leak_min_age_s=1.0, ledger_leak_k=2.0):
+        assert det.threshold_s("pull") == 1.0  # floor before history
+        # 20 entries held ~30s each appear then disappear
+        batch = [L.entry("pull", "inflight", f"p:{i}", "x",
+                         time.time() - 30) for i in range(20)]
+        det.observe(batch)
+        det.observe([])
+        # p99(~30) * 2 ≈ 60: long holds are normal for this plane now
+        assert det.threshold_s("pull") > 50.0
+
+
+# ---------------------------------------------------------------------
+# reconciler: invariants + patience
+# ---------------------------------------------------------------------
+
+def _recon_run(rec, entries, context):
+    return rec.run(entries, context)
+
+
+def test_reconciler_checkouts_patience_and_recovery():
+    rec = L.Reconciler()
+    bad_ctx = {"dispatch": {"n1": {"py_owned_wids": [7]}}}
+    with _cfg(ledger_invariant_patience=2):
+        v1 = _recon_run(rec, [], bad_ctx)
+        # first failing snapshot: streak 1 -> still ok (patience)
+        assert v1["checkouts_match_native"]["ok"]
+        assert v1["checkouts_match_native"]["streak"] == 1
+        v2 = _recon_run(rec, [], bad_ctx)
+        assert not v2["checkouts_match_native"]["ok"]
+        assert not v2["green"]
+        assert "7" in v2["checkouts_match_native"]["detail"]
+        # matching checkout record heals it immediately
+        good = [dict(L.entry("dispatch.checkout", "checkout", "co:7",
+                             "7", time.time()), node="n1")]
+        v3 = _recon_run(rec, good, bad_ctx)
+        assert v3["checkouts_match_native"]["ok"] and v3["green"]
+
+
+def test_reconciler_charges_count_actors_and_py_tasks():
+    rec = L.Reconciler()
+    with _cfg(ledger_invariant_patience=1):
+        # charge with an idle-but-alive actor holding it: fine
+        ctx = {"dispatch": {"n1": {"charged_cpu": 1.0, "busy": 0,
+                                   "pending": 0, "py_owned": 0,
+                                   "queued": 0, "running_py": 0,
+                                   "actors": 1}}}
+        assert _recon_run(rec, [], ctx)["dispatch_charges_have_tasks"][
+            "ok"]
+        # charge with NOTHING live anywhere: red
+        ctx["dispatch"]["n1"]["actors"] = 0
+        v = _recon_run(rec, [], ctx)
+        assert not v["dispatch_charges_have_tasks"]["ok"]
+
+
+def test_reconciler_serve_directional():
+    rec = L.Reconciler()
+    with _cfg(ledger_invariant_patience=1, ledger_interval_s=0.2):
+        # replica busy with no client slot: orphaned counter
+        v = _recon_run(rec, [], {"dispatch": {},
+                                 "replica_ongoing": {"app": 2.0}})
+        assert not v["serve_ongoing_balanced"]["ok"]
+        # client slot young, replica idle: in-flight churn, NOT red
+        young = L.entry("serve.handle", "ongoing", "app:1", "app",
+                        time.time())
+        v = _recon_run(rec, [young], {"dispatch": {},
+                                      "replica_ongoing": {"app": 0.0}})
+        assert v["serve_ongoing_balanced"]["ok"]
+        # client slot old with replica idle: the dropped-release shape
+        stale = L.entry("serve.handle", "ongoing", "app:2", "app",
+                        time.time() - 30)
+        v = _recon_run(rec, [stale], {"dispatch": {},
+                                      "replica_ongoing": {"app": 0.0}})
+        assert not v["serve_ongoing_balanced"]["ok"]
+
+
+def test_reconciler_dead_pins_red():
+    rec = L.Reconciler()
+    with _cfg(ledger_invariant_patience=1):
+        dead = L.entry("shm.pin", "dead_pin", "pin:999999", "worker",
+                       time.time() - 5)
+        v = _recon_run(rec, [dead], {"dispatch": {}})
+        assert not v["shm_pins_have_live_holders"]["ok"]
+        assert "worker" in v["shm_pins_have_live_holders"]["detail"]
+
+
+# ---------------------------------------------------------------------
+# live local runtime: snapshot, API endpoint, dump bundling
+# ---------------------------------------------------------------------
+
+def test_snapshot_green_on_live_runtime(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def f(x):
+        return x + 1
+
+    @ray.remote
+    class Holder:
+        def ping(self):
+            return "ok"
+
+    h = Holder.remote()
+    assert ray.get([f.remote(i) for i in range(4)]) == [1, 2, 3, 4]
+    assert ray.get(h.ping.remote()) == "ok"
+    rep = L.get_ledger().snapshot()
+    assert rep["reconciliation"]["green"], rep["reconciliation"]
+    assert rep["planes"].get("actor", {}).get("count", 0) >= 1
+    alive = [e for e in rep["entries"] if e["plane"] == "actor"]
+    assert alive and "Holder" in alive[0]["owner"]
+    assert L.get_ledger().live_suspects() == []
+
+
+def test_api_ledger_endpoint(ray_start):
+    from ray_tpu.dashboard import start_dashboard
+
+    server = start_dashboard(port=0)
+    try:
+        with urllib.request.urlopen(
+                server.address + "/api/ledger?fresh=1", timeout=30) as r:
+            rep = json.loads(r.read().decode())
+        assert "reconciliation" in rep and "entries" in rep
+        assert rep["reconciliation"]["green"]
+        # cached path serves the report just taken
+        with urllib.request.urlopen(
+                server.address + "/api/ledger", timeout=30) as r:
+            again = json.loads(r.read().decode())
+        assert again["ts"] >= 0
+    finally:
+        server.stop()
+
+
+def test_debug_dump_bundles_ledger(ray_start, tmp_path):
+    from ray_tpu.observability import get_recorder
+
+    L.get_ledger().snapshot()
+    path = get_recorder().dump(str(tmp_path / "flight.json"),
+                               reason="test")
+    with open(path) as f:
+        snap = json.load(f)
+    assert snap["ledger"]["available"]
+    assert "reconciliation" in snap["ledger"]
+    assert "planes" in snap["ledger"]
+
+
+# ---------------------------------------------------------------------
+# serve chaos: reclamation + injected-leak attribution (satellite 3)
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def serve(ray_start):
+    import ray_tpu.serve as serve
+
+    yield serve
+    serve.shutdown()
+
+
+def test_replica_kill_mid_stream_reclaimed_or_flagged(serve):
+    """A replica killed while streaming must not strand its admission
+    entries: within one reconciliation period of quiescence the
+    serve.handle plane is empty again (reclaimed) or the stragglers
+    are flagged as leak suspects — never a silent leak."""
+    from ray_tpu._private.fault_injection import ServeFaultInjector
+
+    @serve.deployment(num_replicas=2, max_request_retries=3)
+    class Streamer:
+        def stream(self, n):
+            for i in range(n):
+                time.sleep(0.005)
+                yield i
+
+    handle = serve.run(Streamer.bind())
+    ServeFaultInjector(handle._controller).crash_on_request(
+        "Streamer", count=1, replica_index=0)
+    sh = handle.options(method_name="stream", stream=True)
+    done = 0
+    for _ in range(6):  # one of these hits the armed replica mid-
+        try:            # stream; a mid-stream death surfaces as an
+            for r in sh.remote(10):  # error (streams aren't replayed)
+                ray_tpu.get(r)
+            done += 1
+        except Exception:  # noqa: BLE001
+            pass
+    assert done >= 1  # the survivor replica kept serving
+    # The controller replaces the corpse; streams recover.
+    deadline = time.monotonic() + 25
+    recovered = False
+    while time.monotonic() < deadline and not recovered:
+        try:
+            assert [ray_tpu.get(r) for r in sh.remote(3)] == [0, 1, 2]
+            recovered = True
+        except Exception:  # noqa: BLE001
+            time.sleep(0.5)
+    assert recovered
+    lg = L.get_ledger()
+
+    def _reclaimed():
+        rep = lg.snapshot()
+        held = rep["planes"].get("serve.handle", {}).get("count", 0)
+        return held == 0 or lg.live_suspects()
+
+    with _cfg(ledger_interval_s=0.2):
+        out = _settle(_reclaimed, timeout_s=10.0)
+    assert out, "orphaned _ongoing entries neither reclaimed nor flagged"
+
+
+def test_dropped_release_flagged_with_site(serve):
+    """The acceptance-criteria self-test: a fault hook drops one slot
+    release; the ledger must flag the stranded entry within one
+    reconciliation period of crossing the age threshold AND attribute
+    it to the acquisition site (this file)."""
+
+    @serve.deployment
+    def app(x):
+        return x
+
+    handle = serve.run(app.bind())
+    lg = L.get_ledger()
+    with _cfg(ledger_leak_min_age_s=0.6, ledger_leak_k=50.0,
+              ledger_interval_s=0.2):
+        handle._router.admission.inject_fault("drop_release", 1)
+        assert handle.remote(7).result(timeout=30) == 7
+        t0 = time.time()
+        threshold = lg.detector.threshold_s("serve.handle")
+
+        def _flagged():
+            lg.snapshot()
+            return [s for s in lg.live_suspects()
+                    if s["plane"] == "serve.handle"]
+
+        sus = _settle(_flagged, timeout_s=threshold + 5.0,
+                      interval_s=0.2)
+        assert sus, "dropped release never flagged"
+        assert time.time() - t0 <= threshold + 2.0, \
+            "flagged, but later than one reconciliation period"
+        assert "test_ledger.py" in sus[0]["site"]
+        assert sus[0]["owner"] == "app"
+    # the flag also landed in the anomaly registry with the site
+    from ray_tpu.observability import get_anomaly_registry
+
+    evs = [e for e in get_anomaly_registry().recent()
+           if e.get("plane") == "ledger"]
+    assert evs and "test_ledger.py" in evs[-1].get("site", "")
+
+
+def test_worker_kill_mid_task_reclaimed(ray_start):
+    """SIGKILL a busy out-of-process worker: its dispatch charges and
+    task rows must drain from the ledger once retries finish — the
+    dispatch-parity worker-death path feeding the ledger planes."""
+    import os
+    import signal
+
+    from ray_tpu.core.task import NodeAffinitySchedulingStrategy
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0, num_worker_procs=2)
+    proc = NodeAffinitySchedulingStrategy(node_id="node-procs",
+                                          soft=False)
+
+    @ray_tpu.remote(scheduling_strategy=proc, max_retries=3)
+    def work(i):
+        time.sleep(0.05)
+        return os.getpid()
+
+    pid = ray_tpu.get(work.remote(0), timeout=30)
+    refs = [work.remote(i) for i in range(8)]
+    os.kill(pid, signal.SIGKILL)
+    pids = ray_tpu.get(refs, timeout=60)  # retries heal the storm
+    assert len(pids) == 8
+    lg = L.get_ledger()
+
+    def _clean():
+        rep = lg.snapshot()
+        tasks = rep["planes"].get("task", {}).get("count", 0)
+        return (tasks == 0 and rep["reconciliation"]["green"]
+                and not lg.live_suspects())
+
+    with _cfg(ledger_interval_s=0.2):
+        assert _settle(_clean, timeout_s=10.0), lg.last()
+
+
+# ---------------------------------------------------------------------
+# soak gate (satellite 5): tier-1 smoke + slow full run
+# ---------------------------------------------------------------------
+
+def _bench():
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", _os.path.join(_os.path.dirname(__file__), "..",
+                               "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_soak_quick_smoke():
+    """The `bench.py --soak --quick` gate, trimmed to a short load
+    phase for the fast tier: chaos + quiescence must reconcile green
+    with zero live suspects, and the injected dropped release must be
+    flagged and attributed."""
+    keys = ("ledger_interval_s", "ledger_leak_min_age_s",
+            "ledger_leak_k")
+    old = {k: getattr(config, k) for k in keys}
+    try:
+        out = _bench().bench_soak(quick=True, load_s=5.0)
+    finally:
+        config.apply(old)
+        ray_tpu.shutdown()
+    assert out["pass"]
+    assert "bench" in out["leak_site"] or "test_" in out["leak_site"]
+
+
+@pytest.mark.slow
+def test_soak_full():
+    """The release-gate shape: minutes of mixed load + kill cycles."""
+    keys = ("ledger_interval_s", "ledger_leak_min_age_s",
+            "ledger_leak_k")
+    old = {k: getattr(config, k) for k in keys}
+    try:
+        out = _bench().bench_soak(quick=False, minutes=2.0)
+    finally:
+        config.apply(old)
+        ray_tpu.shutdown()
+    assert out["pass"] and out["kills"]["replica"] >= 2

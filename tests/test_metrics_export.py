@@ -49,12 +49,14 @@ class TestExportBundle:
         serve data plane (proxy ingress + replica + handle admission),
         loop-handler gauges from observability.event_stats, anomaly
         counter from observability.tsdb, TTFT gauge from the serve
-        controller's stats harvest."""
+        controller's stats harvest, outstanding-resource series from
+        observability.ledger."""
         import inspect
 
         from ray_tpu.dashboard import server as srv
         from ray_tpu.dashboard.metrics_export import DEFAULT_PANELS
-        from ray_tpu.observability import event_stats, taskstats, tsdb
+        from ray_tpu.observability import (event_stats, ledger,
+                                           taskstats, tsdb)
         from ray_tpu.serve import controller, handle, proxy, replica
 
         publish_src = "\n".join([
@@ -66,6 +68,7 @@ class TestExportBundle:
             inspect.getsource(event_stats),
             inspect.getsource(tsdb),
             inspect.getsource(controller),
+            inspect.getsource(ledger),
         ])
         for _title, expr, _unit in DEFAULT_PANELS:
             m = re.search(r"(ray_tpu_[a-z_]+?)(_bucket)?(?:[^a-z_]|$)",

@@ -15,6 +15,18 @@ from ray_tpu import state
 from ray_tpu.util import metrics
 
 
+def _settle(predicate, timeout_s=5.0, interval_s=0.05):
+    """Poll until `predicate()` is truthy; → its last value. Task
+    events are recorded after results publish, so observability reads
+    racing a fresh `ray.get` must settle (wide window on 1-core CI)."""
+    deadline = time.monotonic() + timeout_s
+    value = predicate()
+    while not value and time.monotonic() < deadline:
+        time.sleep(interval_s)
+        value = predicate()
+    return value
+
+
 # ---------------------------------------------------------------------------
 # State API
 # ---------------------------------------------------------------------------
@@ -71,8 +83,13 @@ def test_list_tasks_records_finished(ray_start):
         return 1
 
     ray.get([f.remote() for _ in range(3)])
-    rows = state.list_tasks(limit=50)
-    finished = [r for r in rows if r["state"] == "FINISHED"]
+
+    def _finished():
+        rows = [r for r in state.list_tasks(limit=50)
+                if r["state"] == "FINISHED"]
+        return rows if len(rows) >= 3 else None
+
+    finished = _settle(_finished) or []
     assert len(finished) >= 3
 
 
@@ -519,7 +536,7 @@ def test_dashboard_task_detail_and_log_search(dashboard, ray_start):
         return 1
 
     ray.get(traced.remote())
-    tasks = _get(dashboard, "/api/tasks")
+    tasks = _settle(lambda: _get(dashboard, "/api/tasks"))
     assert tasks, "no tasks listed"
     tid = tasks[-1]["task_id"]
     detail = _get(dashboard, f"/api/tasks/{tid}")
