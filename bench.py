@@ -764,6 +764,155 @@ def bench_rlhf(quick: bool, model: str = "gpt2-125m") -> None:
     }))
 
 
+def bench_critpath(quick: bool, model: str = "gpt2-125m") -> None:
+    """Critical-path attribution scoreboard (the baseline ROADMAP
+    item 3's compiled task graphs must move). Two rows:
+
+    * ``rlhf_dispatch_share_of_critical_path`` — one traced RLHF train
+      iteration analyzed by observability.critpath: the % of the
+      iteration's critical path attributed to the dispatch planes
+      (driver submit + admission + dispatch queue + native handoff).
+      "%" is lower-better, so check_regressions flags dispatch-share
+      growth automatically.
+    * ``serve_ttft_queue_share`` — TTFT waterfall from the
+      continuous-batching engine's per-request queue/prefill/decode
+      stamps: the % of median TTFT spent queued before admission.
+
+    Prints one JSON line (second row rides under extra_metrics)."""
+    from dataclasses import replace
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.models import configs
+    from ray_tpu.models.transformer import init_params
+    from ray_tpu.observability import critpath
+    from ray_tpu.rlhf import RLHFConfig, RLHFPipeline
+    from ray_tpu.serve.llm import LLMEngine
+    from ray_tpu.util import tracing
+
+    if quick:
+        mcfg = configs.tiny_test(vocab=128)
+        num_gen, num_prompts, group = 2, 4, 2
+        prompt_len, max_new = 4, 8
+    else:
+        mcfg = configs.get(model)
+        num_gen, num_prompts, group = 4, 8, 4
+        prompt_len, max_new = 16, 16
+
+    cfg = RLHFConfig(
+        model=mcfg, num_generators=num_gen, num_prompts=num_prompts,
+        prompt_len=prompt_len, group_size=group,
+        max_new_tokens=max_new,
+        reward_fn=lambda comp: (comp == 7).mean(axis=1),
+        lr=1e-4, warmup_steps=2, total_steps=100)
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=max(2, num_gen), num_tpus=0)
+    spans: list = []
+    tracing.setup_tracing(spans.append)
+    trace_id = None
+    try:
+        pipe = RLHFPipeline(cfg)
+        try:
+            pipe.train_iteration()  # warmup: compile + first refresh
+            with tracing.span("rlhf_iteration", "bench"):
+                trace_id = tracing.current_trace_id()
+                pipe.train_iteration()
+        finally:
+            pipe.shutdown()
+        from ray_tpu.core.runtime import global_runtime
+
+        events = global_runtime().timeline()
+    finally:
+        tracing.clear_tracing()
+        ray_tpu.shutdown()
+
+    report = critpath.analyze(events, trace_id)
+    critpath.record_plane_metrics(report)
+    share_pct = report.get("dispatch_share", 0.0) * 100.0
+
+    run_match = {"platform": jax.devices()[0].platform,
+                 "num_generators": num_gen, "num_prompts": num_prompts,
+                 "group_size": group, "prompt_len": prompt_len,
+                 "max_new_tokens": max_new}
+    metric = "rlhf_dispatch_share_of_critical_path"
+    prev = push_history(
+        metric, share_pct, "%", match=run_match,
+        extra={"kind": report.get("kind"),
+               "makespan_s": round(report.get("makespan_s", 0.0), 4),
+               "critical_path_len": len(report.get("critical_path", [])),
+               "planes": {p: round(v, 4)
+                          for p, v in
+                          (report.get("planes") or {}).items()}})
+    base = pinned_baseline(metric, run_match) or prev
+
+    # --- serve TTFT waterfall row -------------------------------------
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if quick or not on_tpu:
+        scfg, n_req, slots = configs.tiny_test(), 12, 4
+        sprompt_len, smax_new, max_seq = 8, 8, 128
+        scfg = replace(scfg, max_seq_len=max_seq)
+    else:
+        scfg = configs.get(model)
+        n_req, slots = 64, 16
+        sprompt_len, smax_new, max_seq = 64, 32, 1024
+        scfg = replace(scfg, param_dtype=jnp.bfloat16,
+                       max_seq_len=max_seq)
+    params = init_params(scfg, jax.random.key(0))
+    engine = LLMEngine(scfg, params, num_slots=slots,
+                       max_seq_len=max_seq)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, scfg.vocab_size,
+                            size=sprompt_len).tolist()
+               for _ in range(n_req)]
+    engine.start()
+    try:
+        engine.submit(prompts[0], max_new_tokens=smax_new).result()
+        # Oversubscribed burst (n_req > slots): the queue plane must be
+        # nonzero or the waterfall row measures nothing.
+        reqs = [engine.submit(p, max_new_tokens=smax_new)
+                for p in prompts]
+        for r in reqs:
+            r.result()
+    finally:
+        engine.stop()
+
+    def med(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2] if xs else 0.0
+
+    q50 = med([r.queue_s for r in reqs])
+    p50 = med([r.prefill_s for r in reqs])
+    d50 = med([r.decode_s for r in reqs])
+    t50 = med([r.ttft_s for r in reqs if r.ttft_s is not None])
+    queue_share = 100.0 * q50 / t50 if t50 > 0 else 0.0
+    serve_match = {"platform": jax.devices()[0].platform,
+                   "n_req": n_req, "slots": slots,
+                   "prompt_len": sprompt_len, "max_new": smax_new}
+    metric2 = "serve_ttft_queue_share"
+    push_history(metric2, queue_share, "%", match=serve_match,
+                 extra={"queue_p50_s": round(q50, 4),
+                        "prefill_p50_s": round(p50, 4),
+                        "decode_p50_s": round(d50, 4),
+                        "ttft_p50_s": round(t50, 4)})
+
+    print(json.dumps({
+        "metric": metric, "value": round(share_pct, 2), "unit": "%",
+        "vs_baseline": round(share_pct / base, 3) if base else 1.0,
+        "kind": report.get("kind"),
+        "makespan_s": round(report.get("makespan_s", 0.0), 4),
+        "critical_path": (report.get("critical_names")
+                          or report.get("critical_path") or [])[:8],
+        "extra_metrics": [
+            {"metric": metric2, "value": round(queue_share, 2),
+             "unit": "%", "queue_p50_ms": round(q50 * 1e3, 2),
+             "prefill_p50_ms": round(p50 * 1e3, 2),
+             "decode_p50_ms": round(d50 * 1e3, 2)}],
+    }))
+
+
 def bench_soak(quick: bool, minutes: float = 5.0,
                load_s: float | None = None) -> dict:
     """Leak-ledger soak gate (README "Leak ledger & soak gating").
@@ -1012,6 +1161,11 @@ def main() -> None:
                     help="end-to-end GRPO RLHF loop (north-star "
                          "config 5): rollout tokens/s, iteration "
                          "wall-clock, weight-refresh seconds")
+    ap.add_argument("--critpath", action="store_true",
+                    help="critical-path attribution scoreboard: traced "
+                         "RLHF iteration's dispatch share of the "
+                         "critical path + serve TTFT queue share "
+                         "(the ROADMAP item 3 baseline)")
     ap.add_argument("--soak", action="store_true",
                     help="leak-ledger soak gate: mixed serve load + "
                          "task storms + replica/worker kills; passes "
@@ -1221,6 +1375,9 @@ def _run(args) -> None:
         return
     if args.rlhf:
         bench_rlhf(args.quick, model=args.model)
+        return
+    if args.critpath:
+        bench_critpath(args.quick, model=args.model)
         return
 
     out = bench_train(model=args.model, quick=args.quick,

@@ -36,6 +36,59 @@ from .task import TaskSpec, TaskType
 logger = logging.getLogger("ray_tpu")
 
 
+def apply_native_dispatch_timing(timing: Dict[str, float],
+                                 nd: Dict[str, Any], *,
+                                 trace_id: Optional[str] = None,
+                                 parent_span_id: Optional[str] = None,
+                                 node_id: str = "",
+                                 now: Optional[float] = None
+                                 ) -> Optional[dict]:
+    """Fold a native ``dispatch_timing`` frame into a warm task's
+    lifecycle stamps and build the synthetic daemon dispatch span.
+
+    Warm tasks run zero daemon-side Python, so the daemon never opens
+    its ``daemon:task`` span and never stamps ``running`` — the trace
+    showed submit → execute with a hole. The C loop's wall-clock
+    stamps (admission arrival / worker write / reply forward) close
+    it: ``running`` back-fills from the worker-write stamp and the
+    dispatch span is synthesized driver-side in the exact shape
+    util.tracing.span records. Daemon clocks can skew from the
+    driver's, so stamps are clamped into the task's own
+    scheduled→now window instead of trusted blindly. Returns the span
+    event (caller records it), or None when the stamps are unusable.
+    Pure — unit tested without a cluster."""
+    try:
+        recv = float(nd.get("recv_ts") or 0.0)
+        write = float(nd.get("write_ts") or 0.0)
+        fwd = float(nd.get("forward_ts") or 0.0)
+    except (TypeError, ValueError):
+        return None
+    if not (recv > 0.0 and write >= recv and fwd >= write):
+        return None
+    now = time.time() if now is None else now
+    lo = timing.get("scheduled") or timing.get("queued") \
+        or timing.get("submitted")
+    hi = timing.get("finished") or now
+    if lo is not None:
+        span_lo = max(min(recv, hi), lo)
+        span_hi = max(min(write, hi), span_lo)
+    else:
+        span_lo, span_hi = recv, write
+    timing.setdefault("scheduled", span_lo)
+    timing.setdefault("running", span_hi)
+    import uuid
+
+    span_id = uuid.uuid4().hex[:16]
+    return {
+        "name": "daemon:task", "cat": "daemon_dispatch", "ph": "X",
+        "ts": span_lo * 1e6, "dur": (span_hi - span_lo) * 1e6,
+        "pid": f"daemon:{node_id}", "tid": f"span:{span_id}",
+        "args": {"parent": parent_span_id, "trace_id": trace_id,
+                 "node_id": node_id, "native": True,
+                 "task_id": nd.get("tid"), "forward_ts": fwd},
+    }
+
+
 class _FetchLost(Exception):
     """An arg's payload is on a node that is gone — reconstruct."""
 
@@ -462,11 +515,19 @@ class RemotePlane:
                               spec.scheduling_strategy)),
         }
         if getattr(spec, "trace_id", None):
-            # Trace context crosses the control-plane socket: the
-            # daemon re-enters it, interposes its dispatch span, and
-            # the worker's spans nest under that.
+            # Trace context crosses the control-plane socket. Cold
+            # path: the daemon re-enters it and interposes its
+            # dispatch span. Warm path: no daemon Python runs, so
+            # want_timing asks the C loop for wall-clock dispatch
+            # stamps and the driver synthesizes the equivalent span
+            # (apply_native_dispatch_timing).
             msg["trace_id"] = spec.trace_id
             msg["parent_span_id"] = spec.parent_span_id
+            msg["want_timing"] = True
+        elif config.enable_timeline:
+            # Untraced but timeline-enabled runs still want warm-path
+            # lifecycle back-fill for `ray_tpu timeline` / list_tasks.
+            msg["want_timing"] = True
         excl = getattr(spec, "_spill_excluded", None)
         if msg["spillable"] and excl:
             # Nodes that already refused this task: a refusing daemon's
@@ -537,6 +598,25 @@ class RemotePlane:
             for ev in reply.get("spans") or ():
                 with contextlib.suppress(Exception):
                     rt.events.record_raw(ev)
+            nd_tm = reply.get("_nd_timing")
+            if nd_tm:
+                # Warm-path dispatch stamps: back-fill the lifecycle
+                # phases the native hand-off skipped and synthesize
+                # the daemon dispatch span the Python plane would have
+                # recorded.
+                with contextlib.suppress(Exception):
+                    span_ev = apply_native_dispatch_timing(
+                        spec.timing, nd_tm, trace_id=spec.trace_id,
+                        parent_span_id=spec.parent_span_id,
+                        node_id=node.node_id)
+                    if span_ev is not None:
+                        from ..util import tracing as _tracing
+
+                        # Same record-time sampling verdict every
+                        # other span in the trace got.
+                        if spec.trace_id is None or \
+                                _tracing.trace_sampled(spec.trace_id):
+                            rt.events.record_raw(span_ev)
             if reply.get("spillback"):
                 # The daemon is saturated (another driver raced us for
                 # its capacity — our heartbeat view was stale). In one
@@ -617,6 +697,10 @@ class RemotePlane:
                 rt._store_error(spec, _wrap(spec, e), t0)
         finally:
             if not retried:
+                # Remote executions finish here, not in the local
+                # worker loop — stamp it so phase_durations gets a
+                # total even when intermediate phases were skipped.
+                spec.timing.setdefault("finished", time.time())
                 rt._task_finished(spec)
             if not released:
                 rt.scheduler.release_task(spec, node.node_id)

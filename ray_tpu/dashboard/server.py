@@ -13,6 +13,7 @@ Endpoints:
   GET  /api/tasks              GET  /api/objects
   GET  /api/workers            GET  /api/placement_groups
   GET  /api/timeline           GET  /healthz
+  GET  /api/critpath           (per-trace critical-path attribution)
   GET  /metrics                (Prometheus text)
   GET  /api/event_stats        POST /api/profile (stack | kind=tpu)
   GET  /api/profile/history    GET  /api/metrics/history
@@ -418,6 +419,27 @@ class DashboardServer:
             from ..core.runtime import global_runtime
 
             return _json(global_runtime().timeline())
+
+        async def critpath_view(request):
+            # Critical-path attribution for one completed trace:
+            # waterfall segments + plane-time budget, computed over
+            # the runtime's task events off the event loop. Feeds the
+            # ray_tpu_critpath_plane_seconds series on every query.
+            from ..core.runtime import global_runtime
+            from ..observability import critpath
+
+            trace = request.query.get("trace")
+            if not trace:
+                return _json({"error": "missing ?trace=<id>"})
+
+            def compute():
+                events = global_runtime().timeline()
+                report = critpath.analyze(events, trace)
+                critpath.record_plane_metrics(report)
+                return report
+
+            loop = asyncio.get_running_loop()
+            return _json(await loop.run_in_executor(None, compute))
 
         async def flight_recorder(_):
             from ..observability import get_recorder
@@ -998,6 +1020,7 @@ class DashboardServer:
         r.add_get("/api/ledger", ledger_view)
         r.add_post("/api/kill_random_node", kill_random_node)
         r.add_get("/api/timeline", timeline)
+        r.add_get("/api/critpath", critpath_view)
         r.add_get("/api/debug/flight_recorder", flight_recorder)
         r.add_get("/api/node_stats", node_stats)
         r.add_get("/metrics", prom_metrics)

@@ -71,6 +71,10 @@ NATIVE_PLANE = {
     "gen_ack": "framed natively, routed to the owning stream's "
                "drainer without per-handler timing",
     "pull_complete": "framed natively without per-handler timing",
+    "dispatch_timing": "wall-clock dispatch stamps for a warm task "
+                       "(admission arrival, worker write, reply "
+                       "forward) sent ahead of the result frame when "
+                       "the admission header carried tm",
 }
 
 

@@ -61,11 +61,15 @@ def hybrid_frame(msg: Dict[str, Any]) -> bytes:
     # policy — streaming, prefetch, runtime_env, max_calls recycling,
     # placement-constrained (non-spillable) tasks — stays cold.
     # Traced tasks DO go warm: the worker's execution spans ride the
-    # forwarded reply verbatim, so the only loss is the daemon's own
-    # dispatch span (the trace shows submit → execute with no
-    # daemon:task node in between). plain ⇒ spillable, so a nonempty
-    # res is precharged (or refused) by the native admission block
-    # before hand-off.
+    # forwarded reply verbatim, and "tm" asks the C loop to precede
+    # the result with a dispatch_timing frame (arrival / worker-write /
+    # forward wall-clock stamps) so the driver can synthesize the
+    # daemon dispatch span — warm traces show no submit→execute hole
+    # and the hot path stays Python-free. plain ⇒ spillable, so a
+    # nonempty res is precharged (or refused) by the native admission
+    # block before hand-off.
+    if msg.get("want_timing"):
+        header["tm"] = 1
     fid = msg.get("fid")
     if (msg.get("type") == "task" and msg.get("spillable")
             and not msg.get("streaming") and not msg.get("fetch")
@@ -128,8 +132,15 @@ class NodeConn:
         try:
             with self._send_lock:
                 self.sock.sendall(hybrid_frame(msg))
+            nd_timing = None
             while True:
                 reply = recv_reply(self.sock)
+                if reply.get("type") == "dispatch_timing":
+                    # Native dispatch stamps for the reply that follows
+                    # on this conn (the daemon's outbox is FIFO per
+                    # connection) — stash and keep reading.
+                    nd_timing = reply
+                    continue
                 if reply.get("type") == "gen_item":
                     if on_stream is not None:
                         try:
@@ -150,6 +161,8 @@ class NodeConn:
                         with contextlib.suppress(Exception):
                             self.on_pull_complete(reply)
                     continue
+                if nd_timing is not None and isinstance(reply, dict):
+                    reply["_nd_timing"] = nd_timing
                 return reply
         except (WorkerCrashedError, OSError, EOFError) as e:
             self.alive = False

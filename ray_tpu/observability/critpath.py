@@ -1,0 +1,479 @@
+"""Critical-path attribution for completed traces.
+
+Answers "where did the time go?" for any trace id: an RLHF iteration,
+a serve request, a compiled-dag replay. The dep/return-stamped task
+events (PR 17's dynamic task graph) plus span timestamps give a
+weighted DAG of everything the trace executed; this module
+
+- reconstructs that DAG (``build_trace_graph``),
+- runs classic CPM over it (``cpm``: ES/EF/LS/LF + per-node slack,
+  critical path = the zero-slack chain through the latest finish),
+- attributes every second of the critical path's wall-clock window to
+  a plane bucket (``analyze``): driver submit, scheduler admission,
+  dispatch queue, native hand-off, worker exec, object transfer —
+  with serve route/queue and prefill/decode buckets when the trace is
+  span-only (a serve request never submits tasks under the request
+  trace).
+
+Buckets are constructed to sum EXACTLY to the critical path's
+wall-clock window (consecutive node windows are clamped so overlap is
+never double-counted and inter-node gaps land in ``object_transfer``),
+so the report is an honest decomposition, not a sampling estimate.
+
+Surfaces: ``ray_tpu critpath --trace <id>`` (terminal waterfall +
+JSON), ``GET /api/critpath?trace=<id>`` on the dashboard, the
+``ray_tpu_critpath_plane_seconds{plane}`` series, and the
+``bench.py --critpath`` rows (``rlhf_dispatch_share_of_critical_path``
+is the baseline the compiled-graph work — ROADMAP item 3 — must move).
+
+Warm-path honesty: native hand-offs run zero daemon-side Python, so
+their dispatch timing comes from the C loop's wall-clock stamps
+(dispatch_timing reply frames → back-filled lifecycle phases + the
+synthesized ``daemon:task`` span, core/remote_node.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Plane buckets, in waterfall order. DISPATCH_PLANES is the "overhead"
+# subset whose share of the critical path the compiled-graph work must
+# drive down (the bench.py --critpath headline number).
+PLANES = ("driver_submit", "admission", "dispatch_queue",
+          "native_handoff", "worker_exec", "object_transfer",
+          "serve_route", "serve_queue", "prefill", "decode", "other")
+DISPATCH_PLANES = ("driver_submit", "admission", "dispatch_queue",
+                   "native_handoff")
+
+_METRICS: Dict[str, Any] = {}
+_METRICS_LOCK = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# Trace-graph reconstruction
+# ---------------------------------------------------------------------------
+
+def _is_span(ev: dict) -> bool:
+    return "span:" in str(ev.get("tid", ""))
+
+
+def build_trace_graph(events: Iterable[dict], trace_id: str
+                      ) -> Tuple[Dict[str, dict], List[Tuple[str, str]],
+                                 List[dict]]:
+    """(nodes, edges, spans) for one trace.
+
+    nodes: task_id → {name, timing, deps, returns} for task events
+    stamped with this trace id and usable endpoints (submitted +
+    finished). edges: (producer, consumer) via dep/return id joins —
+    the same reconstruction state.list_tasks documents and
+    tests/test_graph_capture.py verifies against static capture.
+    spans: the trace's span events (waterfall refinement + the
+    span-only fallback)."""
+    nodes: Dict[str, dict] = {}
+    spans: List[dict] = []
+    producer: Dict[str, str] = {}
+    for ev in events:
+        args = ev.get("args") or {}
+        if args.get("trace_id") != trace_id:
+            continue
+        if _is_span(ev):
+            spans.append(ev)
+            continue
+        timing = args.get("timing") or {}
+        tid = str(ev.get("tid"))
+        if timing.get("submitted") is None or \
+                timing.get("finished") is None:
+            continue
+        nodes[tid] = {
+            "task_id": tid,
+            "name": ev.get("name"),
+            "timing": dict(timing),
+            "deps": list(args.get("deps") or ()),
+            "returns": list(args.get("returns") or ()),
+        }
+        for ret in nodes[tid]["returns"]:
+            producer[ret] = tid
+    edges = []
+    for tid, node in nodes.items():
+        for dep in node["deps"]:
+            src = producer.get(dep)
+            if src is not None and src != tid:
+                edges.append((src, tid))
+    return nodes, sorted(set(edges)), spans
+
+
+# ---------------------------------------------------------------------------
+# CPM (critical-path method) over explicit durations
+# ---------------------------------------------------------------------------
+
+def cpm(durations: Dict[str, float],
+        edges: Sequence[Tuple[str, str]]) -> Dict[str, dict]:
+    """Classic forward/backward CPM pass. Returns per-node
+    {es, ef, ls, lf, slack, critical}; the critical path is the
+    zero-slack chain (walk ``critical_path`` for the ordered ids).
+    Cycles (impossible for a real trace, possible for corrupt input)
+    degrade gracefully: back-edges are dropped in visit order."""
+    preds: Dict[str, List[str]] = {n: [] for n in durations}
+    succs: Dict[str, List[str]] = {n: [] for n in durations}
+    for a, b in edges:
+        if a in durations and b in durations:
+            preds[b].append(a)
+            succs[a].append(b)
+    # Kahn topo order; nodes stuck in a cycle are appended at the end
+    # with their remaining in-edges ignored.
+    indeg = {n: len(preds[n]) for n in durations}
+    order = [n for n in durations if indeg[n] == 0]
+    seen = set(order)
+    i = 0
+    while i < len(order):
+        for b in succs[order[i]]:
+            indeg[b] -= 1
+            if indeg[b] == 0 and b not in seen:
+                order.append(b)
+                seen.add(b)
+        i += 1
+    order.extend(n for n in durations if n not in seen)
+
+    es: Dict[str, float] = {}
+    ef: Dict[str, float] = {}
+    for n in order:
+        es[n] = max((ef[p] for p in preds[n] if p in ef), default=0.0)
+        ef[n] = es[n] + durations[n]
+    makespan = max(ef.values(), default=0.0)
+    lf: Dict[str, float] = {}
+    ls: Dict[str, float] = {}
+    for n in reversed(order):
+        lf[n] = min((ls[q] for q in succs[n] if q in ls),
+                    default=makespan)
+        ls[n] = lf[n] - durations[n]
+    out = {}
+    for n in durations:
+        slack = ls[n] - es[n]
+        out[n] = {"es": es[n], "ef": ef[n], "ls": ls[n], "lf": lf[n],
+                  "slack": slack, "critical": slack < 1e-9}
+    return out
+
+
+def critical_path(durations: Dict[str, float],
+                  edges: Sequence[Tuple[str, str]],
+                  nodes_cpm: Optional[Dict[str, dict]] = None
+                  ) -> List[str]:
+    """Ordered ids of the longest chain: start from the max-EF node
+    and walk back through the predecessor whose EF gates each ES."""
+    if not durations:
+        return []
+    info = nodes_cpm or cpm(durations, edges)
+    preds: Dict[str, List[str]] = {n: [] for n in durations}
+    for a, b in edges:
+        if a in durations and b in durations:
+            preds[b].append(a)
+    cur = max(durations, key=lambda n: (info[n]["ef"], n))
+    path = [cur]
+    while True:
+        cands = [p for p in preds[cur]
+                 if abs(info[p]["ef"] - info[cur]["es"]) < 1e-9]
+        if not cands:
+            break
+        cur = max(cands, key=lambda n: (durations[n], n))
+        path.append(cur)
+    path.reverse()
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Plane attribution
+# ---------------------------------------------------------------------------
+
+def _clamp(v: float, lo: float, hi: float) -> float:
+    return min(max(v, lo), hi)
+
+
+def _native_stamps(node: dict, spans: Sequence[dict]
+                   ) -> Tuple[Optional[float], Optional[float],
+                              Optional[float]]:
+    """(recv, write, forward) wall stamps for a task, from its
+    synthesized ``daemon:task`` span (matched by task_id when stamped,
+    else by containment in the scheduled→running window)."""
+    timing = node["timing"]
+    for ev in spans:
+        args = ev.get("args") or {}
+        if ev.get("cat") != "daemon_dispatch":
+            continue
+        t0 = ev.get("ts", 0.0) / 1e6
+        t1 = t0 + ev.get("dur", 0.0) / 1e6
+        if args.get("task_id") == node["task_id"]:
+            return t0, t1, args.get("forward_ts")
+        sched = timing.get("scheduled")
+        run = timing.get("running")
+        if args.get("task_id") is None and sched is not None \
+                and run is not None and t0 >= sched - 1e-6 \
+                and t1 <= run + 1e-6:
+            return t0, t1, args.get("forward_ts")
+    return None, None, None
+
+
+def _attribute_node(node: dict, w0: float, w1: float,
+                    spans: Sequence[dict],
+                    planes: Dict[str, float],
+                    segments: List[dict]) -> None:
+    """Split one critical node's clamped window [w0, w1] into plane
+    buckets. Boundaries are the present lifecycle stamps (skip-
+    tolerant, like taskstats.phase_durations) refined by native
+    dispatch stamps; every boundary is clamped into [w0, w1] so the
+    buckets sum exactly to w1 - w0."""
+    timing = node["timing"]
+    recv, write, fwd = _native_stamps(node, spans)
+    # (plane, boundary-start) in canonical order; each plane runs to
+    # the next present boundary.
+    bounds: List[Tuple[str, float]] = [("driver_submit", w0)]
+
+    def mark(plane: str, t: Optional[float]) -> None:
+        if t is not None:
+            bounds.append((plane, _clamp(t, w0, w1)))
+
+    mark("admission", timing.get("queued"))
+    mark("dispatch_queue", timing.get("scheduled"))
+    if recv is not None and write is not None:
+        mark("native_handoff", recv)
+        mark("worker_exec", write)
+    else:
+        mark("worker_exec", timing.get("running"))
+    if fwd is not None:
+        mark("object_transfer", fwd)
+    bounds.sort(key=lambda bt: bt[1])
+    for (plane, t0), (_nx, t1) in zip(bounds, bounds[1:]):
+        if t1 > t0:
+            planes[plane] = planes.get(plane, 0.0) + (t1 - t0)
+            segments.append({"task_id": node["task_id"],
+                             "name": node["name"], "plane": plane,
+                             "start": t0, "end": t1})
+    last_plane, last_t = bounds[-1]
+    if w1 > last_t:
+        planes[last_plane] = planes.get(last_plane, 0.0) + (w1 - last_t)
+        segments.append({"task_id": node["task_id"],
+                         "name": node["name"], "plane": last_plane,
+                         "start": last_t, "end": w1})
+
+
+# Span-name → plane heuristics for span-only traces (serve requests,
+# LLM generations): first substring match wins, else "other".
+_SPAN_PLANE_HINTS = (
+    ("route", "serve_route"), ("proxy", "serve_route"),
+    ("queue", "serve_queue"), ("admission", "serve_queue"),
+    ("prefill", "prefill"), ("first_token", "prefill"),
+    ("decode", "decode"), ("token", "decode"),
+    ("dispatch", "dispatch_queue"), ("daemon", "dispatch_queue"),
+    ("submit", "driver_submit"),
+)
+
+
+def _span_plane(ev: dict) -> str:
+    label = (str(ev.get("name", "")) + " " + str(ev.get("cat", ""))
+             ).lower()
+    for hint, plane in _SPAN_PLANE_HINTS:
+        if hint in label:
+            return plane
+    return "other"
+
+
+def _analyze_spans_only(spans: List[dict], trace_id: str) -> dict:
+    """Fallback waterfall for traces with no task nodes (a serve
+    request's lifetime lives in spans). The root (longest) span is the
+    window; child spans paint their plane over it in start order, the
+    unpainted remainder is worker_exec-agnostic ``other``."""
+    ordered = sorted(spans, key=lambda e: (e.get("ts", 0.0)))
+    if not ordered:
+        return {"trace_id": trace_id, "error": "trace not found",
+                "makespan_s": 0.0, "planes": {}, "critical_path": [],
+                "nodes": [], "segments": []}
+    root = max(ordered, key=lambda e: e.get("dur", 0.0))
+    w0 = root.get("ts", 0.0) / 1e6
+    w1 = w0 + root.get("dur", 0.0) / 1e6
+    planes: Dict[str, float] = {}
+    segments: List[dict] = []
+    cursor = w0
+    for ev in ordered:
+        if ev is root:
+            continue
+        t0 = _clamp(ev.get("ts", 0.0) / 1e6, cursor, w1)
+        t1 = _clamp(t0 + ev.get("dur", 0.0) / 1e6, cursor, w1)
+        if t1 <= t0:
+            continue
+        if t0 > cursor:
+            planes["other"] = planes.get("other", 0.0) + (t0 - cursor)
+            segments.append({"name": "(gap)", "plane": "other",
+                             "start": cursor, "end": t0})
+        plane = _span_plane(ev)
+        planes[plane] = planes.get(plane, 0.0) + (t1 - t0)
+        segments.append({"name": ev.get("name"), "plane": plane,
+                         "start": t0, "end": t1})
+        cursor = t1
+    if w1 > cursor:
+        planes["other"] = planes.get("other", 0.0) + (w1 - cursor)
+        segments.append({"name": "(tail)", "plane": "other",
+                         "start": cursor, "end": w1})
+    makespan = w1 - w0
+    return {"trace_id": trace_id, "kind": "spans",
+            "makespan_s": makespan, "planes": planes,
+            "shares": _shares(planes, makespan),
+            "dispatch_share": _dispatch_share(planes, makespan),
+            "critical_path": [root.get("name")], "nodes": [],
+            "segments": segments}
+
+
+def _shares(planes: Dict[str, float], makespan: float
+            ) -> Dict[str, float]:
+    if makespan <= 0:
+        return {}
+    return {p: v / makespan for p, v in planes.items()}
+
+
+def _dispatch_share(planes: Dict[str, float], makespan: float) -> float:
+    if makespan <= 0:
+        return 0.0
+    return sum(planes.get(p, 0.0) for p in DISPATCH_PLANES) / makespan
+
+
+# ---------------------------------------------------------------------------
+# Top-level analysis
+# ---------------------------------------------------------------------------
+
+def analyze(events: Iterable[dict], trace_id: str) -> dict:
+    """Full critical-path report for one trace id over raw runtime
+    events (``global_runtime().timeline()`` shape)."""
+    nodes, edges, spans = build_trace_graph(events, trace_id)
+    if not nodes:
+        return _analyze_spans_only(spans, trace_id)
+
+    durations = {tid: max(0.0, n["timing"]["finished"]
+                          - n["timing"]["submitted"])
+                 for tid, n in nodes.items()}
+    info = cpm(durations, edges)
+    path = critical_path(durations, edges, info)
+
+    planes: Dict[str, float] = {}
+    segments: List[dict] = []
+    # Clamped waterfall over the observed wall clock: node i's window
+    # starts no earlier than node i-1's finish; the gap between them
+    # (dep result movement + driver turnaround) is object_transfer.
+    prev_end: Optional[float] = None
+    for tid in path:
+        t = nodes[tid]["timing"]
+        w0 = t["submitted"] if prev_end is None \
+            else max(t["submitted"], prev_end)
+        w1 = max(t["finished"], w0)
+        if prev_end is not None and w0 > prev_end:
+            planes["object_transfer"] = \
+                planes.get("object_transfer", 0.0) + (w0 - prev_end)
+            segments.append({"task_id": tid, "name": nodes[tid]["name"],
+                             "plane": "object_transfer",
+                             "start": prev_end, "end": w0})
+        _attribute_node(nodes[tid], w0, w1, spans, planes, segments)
+        prev_end = w1
+
+    first = nodes[path[0]]["timing"]["submitted"] if path else 0.0
+    makespan = (prev_end - first) if prev_end is not None else 0.0
+    node_rows = []
+    for tid, n in nodes.items():
+        row = {"task_id": tid, "name": n["name"],
+               "duration_s": durations[tid], **info[tid]}
+        node_rows.append(row)
+    node_rows.sort(key=lambda r: r["es"])
+    return {
+        "trace_id": trace_id,
+        "kind": "tasks",
+        "makespan_s": makespan,
+        "planes": planes,
+        "shares": _shares(planes, makespan),
+        "dispatch_share": _dispatch_share(planes, makespan),
+        "critical_path": path,
+        "critical_names": [nodes[t]["name"] for t in path],
+        "nodes": node_rows,
+        "edges": edges,
+        "segments": segments,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rendering + metrics
+# ---------------------------------------------------------------------------
+
+def render_waterfall(report: dict, width: int = 64) -> str:
+    """Terminal waterfall: one bar per critical-path segment plus the
+    plane-time budget table."""
+    lines = [f"trace {report.get('trace_id')}  "
+             f"makespan {report.get('makespan_s', 0.0) * 1e3:.3f} ms  "
+             f"dispatch share "
+             f"{report.get('dispatch_share', 0.0) * 100:.1f}%"]
+    segs = report.get("segments") or []
+    if segs:
+        t0 = min(s["start"] for s in segs)
+        t1 = max(s["end"] for s in segs)
+        scale = (t1 - t0) or 1.0
+        for s in segs:
+            x0 = int((s["start"] - t0) / scale * width)
+            x1 = max(x0 + 1, int((s["end"] - t0) / scale * width))
+            bar = " " * x0 + "█" * (x1 - x0)
+            label = s.get("name") or s.get("task_id", "")
+            dur_ms = (s["end"] - s["start"]) * 1e3
+            lines.append(f"{str(label)[:24]:24s} {bar:{width}s} "
+                         f"{s['plane']:>15s} {dur_ms:9.3f} ms")
+    planes = report.get("planes") or {}
+    if planes:
+        lines.append("")
+        lines.append(f"{'plane':>15s} {'seconds':>12s} {'share':>7s}")
+        shares = report.get("shares") or {}
+        for plane in PLANES:
+            if plane not in planes:
+                continue
+            lines.append(f"{plane:>15s} {planes[plane]:12.6f} "
+                         f"{shares.get(plane, 0.0) * 100:6.1f}%")
+    slack_rows = [r for r in report.get("nodes") or ()
+                  if not r.get("critical")]
+    if slack_rows:
+        lines.append("")
+        lines.append("off-path slack:")
+        for r in sorted(slack_rows, key=lambda r: -r["slack"])[:8]:
+            lines.append(f"  {str(r['name'])[:32]:32s} "
+                         f"slack {r['slack'] * 1e3:9.3f} ms")
+    return "\n".join(lines)
+
+
+def record_plane_metrics(report: dict) -> None:
+    """Feed the report into the metric registry: the
+    ray_tpu_critpath_plane_seconds counter (per plane) and the
+    dispatch-share gauge, sampled into the TSDB/Grafana like every
+    other series. Never raises."""
+    try:
+        from ..util import metrics as metrics_mod
+
+        with _METRICS_LOCK:
+            if not _METRICS:
+                try:
+                    plane_s = metrics_mod.Counter(
+                        "ray_tpu_critpath_plane_seconds",
+                        "Critical-path seconds attributed to each "
+                        "plane bucket across analyzed traces",
+                        tag_keys=("plane",))
+                    share = metrics_mod.Gauge(
+                        "ray_tpu_critpath_dispatch_share",
+                        "Dispatch-plane share of the last analyzed "
+                        "trace's critical path (0..1)")
+                except ValueError:
+                    return  # registry clash (tests clearing registries)
+                _METRICS["plane_s"] = plane_s
+                _METRICS["share"] = share
+        for plane, sec in (report.get("planes") or {}).items():
+            if sec > 0:
+                _METRICS["plane_s"].inc(sec, tags={"plane": plane})
+        _METRICS["share"].set(report.get("dispatch_share", 0.0))
+    except Exception:  # noqa: BLE001 — observability must not break
+        pass
+
+
+def reset_metrics_cache() -> None:
+    """Test hook: forget cached metric objects so a cleared registry
+    re-registers them."""
+    with _METRICS_LOCK:
+        _METRICS.clear()

@@ -35,7 +35,9 @@ def percentiles(values: Sequence[float],
 
 def phase_latencies(timing: Dict[str, float]) -> Dict[str, float]:
     """Per-phase durations (seconds) from lifecycle timestamps; only
-    phases whose endpoints were both stamped appear."""
+    phases whose endpoints were both stamped appear. Strict-endpoint
+    semantics — prefer phase_durations for rows that must survive
+    skipped phases (warm-path tasks)."""
     out = {}
     for label, start, end in (
             ("queued_s", "queued", "scheduled"),
@@ -48,6 +50,36 @@ def phase_latencies(timing: Dict[str, float]) -> Dict[str, float]:
     return out
 
 
+# Canonical lifecycle order; phase_durations walks only the stamps
+# actually present so a skipped phase never drops the whole row.
+_PHASE_ORDER = ("submitted", "queued", "scheduled", "running", "finished")
+_PHASE_LABEL = {"queued": "queued_s", "scheduled": "scheduled_s",
+                "running": "running_s"}
+
+
+def phase_durations(timing: Dict[str, float]) -> Dict[str, float]:
+    """Skip-tolerant per-phase durations: each present stamp's phase
+    ends at the NEXT present stamp. Warm-path tasks executed entirely
+    by the native dispatch loop have no Python `scheduled`/`running`
+    stamps (until the reply back-fills them from native timestamps) —
+    with strict endpoints they would yield no latency rows at all;
+    here `queued_s` simply extends to whatever stamp comes next. For
+    fully-stamped (cold) tasks this matches phase_latencies exactly."""
+    if not timing:
+        return {}
+    present = [(name, timing[name]) for name in _PHASE_ORDER
+               if timing.get(name) is not None]
+    out = {}
+    for (name, t0), (_nxt, t1) in zip(present, present[1:]):
+        label = _PHASE_LABEL.get(name)
+        if label and t1 >= t0:
+            out[label] = t1 - t0
+    a, b = timing.get("submitted"), timing.get("finished")
+    if a is not None and b is not None and b >= a:
+        out["total_s"] = b - a
+    return out
+
+
 def latency_breakdown(events: Iterable[dict]) -> Dict[str, Dict[str, float]]:
     """Aggregate p50/p95/p99 per lifecycle phase over task events that
     carry args.timing (the shape state.summarize_tasks exposes)."""
@@ -56,7 +88,7 @@ def latency_breakdown(events: Iterable[dict]) -> Dict[str, Dict[str, float]]:
         timing = (ev.get("args") or {}).get("timing")
         if not timing:
             continue
-        for label, dur in phase_latencies(timing).items():
+        for label, dur in phase_durations(timing).items():
             buckets.setdefault(label, []).append(dur)
     return {label: {**percentiles(vals), "count": len(vals)}
             for label, vals in sorted(buckets.items())}
@@ -92,7 +124,7 @@ def record_task_metrics(timing: Dict[str, float],
                 _METRICS["queued"] = queued
                 _METRICS["running"] = running
         _METRICS["finished"].inc(tags={"status": status})
-        lat = phase_latencies(timing or {})
+        lat = phase_durations(timing or {})
         if "queued_s" in lat:
             _METRICS["queued"].observe(lat["queued_s"])
         if "running_s" in lat:

@@ -12,6 +12,7 @@ address they start a local throwaway runtime.
   ray-tpu list {nodes,actors,tasks,objects,workers,placement-groups}
   ray-tpu summary {tasks,actors,objects}
   ray-tpu timeline [--output FILE]
+  ray-tpu critpath --trace ID [--json | --output FILE]
   ray-tpu memory
   ray-tpu microbenchmark
   ray-tpu job submit -- <entrypoint...>   / status / logs / stop / list
@@ -316,6 +317,37 @@ def cmd_timeline(args) -> int:
         json.dump(events, f)
     print(f"Wrote {len(events)} events to {out} "
           "(chrome://tracing compatible)")
+    return 0
+
+
+def cmd_critpath(args) -> int:
+    """Critical-path attribution for one trace: terminal waterfall
+    (default) or the raw report JSON (--json / --output)."""
+    from ray_tpu.observability import critpath
+
+    if args.address:
+        report = _fetch(args.address,
+                        f"/api/critpath?trace={args.trace}")
+    else:
+        import ray_tpu
+        from ray_tpu.core.runtime import global_runtime
+
+        ray_tpu.init(num_cpus=1, num_tpus=0)
+        report = critpath.analyze(global_runtime().timeline(),
+                                  args.trace)
+        critpath.record_plane_metrics(report)
+    if report.get("error"):
+        print(f"critpath: {report['error']}", file=sys.stderr)
+        return 1
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"Wrote critical-path report to {args.output}")
+        return 0
+    if args.json:
+        _print(report)
+        return 0
+    print(critpath.render_waterfall(report))
     return 0
 
 
@@ -863,6 +895,20 @@ def build_parser() -> argparse.ArgumentParser:
                              "trace (open in Perfetto / chrome://tracing)")
     tp.add_argument("--output", "--out", dest="output", default=None)
     tp.set_defaults(fn=cmd_timeline)
+
+    cpp = sub.add_parser("critpath",
+                         help="critical-path attribution for one "
+                              "completed trace: terminal waterfall + "
+                              "per-plane time budget")
+    cpp.add_argument("--trace", required=True,
+                     help="trace id (tracing.current_trace_id() / "
+                          "span args.trace_id)")
+    cpp.add_argument("--json", action="store_true",
+                     help="print the raw report instead of the "
+                          "waterfall")
+    cpp.add_argument("--output", "--out", dest="output", default=None,
+                     help="write the report JSON to a file")
+    cpp.set_defaults(fn=cmd_critpath)
 
     dbg = sub.add_parser("debug",
                          help="debugging utilities (flight recorder)")

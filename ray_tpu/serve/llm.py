@@ -91,6 +91,11 @@ class GenRequest:
     # filled by the engine
     id: int = 0
     submit_ts: float = 0.0
+    # First time engine compute touched the request (slot admission or
+    # the queue-side early-first-token pass): splits TTFT into
+    # queue_s (submit→admit) vs prefill_s (admit→first token) for the
+    # critpath/TTFT waterfall.
+    admit_ts: float = 0.0
     first_token_ts: float = 0.0
     finish_ts: float = 0.0
     stream: "queue.Queue" = field(default_factory=queue.Queue)
@@ -113,6 +118,27 @@ class GenRequest:
     @property
     def latency_s(self) -> float:
         return self.finish_ts - self.submit_ts
+
+    @property
+    def queue_s(self) -> float:
+        """Admission-queue wait (0.0 until admitted)."""
+        if self.admit_ts == 0.0:
+            return 0.0
+        return self.admit_ts - self.submit_ts
+
+    @property
+    def prefill_s(self) -> float:
+        """Admission → first token (0.0 until the first token)."""
+        if self.admit_ts == 0.0 or self.first_token_ts == 0.0:
+            return 0.0
+        return self.first_token_ts - self.admit_ts
+
+    @property
+    def decode_s(self) -> float:
+        """First token → finish (0.0 until finished)."""
+        if self.first_token_ts == 0.0 or self.finish_ts == 0.0:
+            return 0.0
+        return self.finish_ts - self.first_token_ts
 
     def __iter__(self) -> Iterator[int]:
         if self._done:
@@ -336,7 +362,10 @@ class LLMEngine:
                 self.step()
         tokens = req.result(timeout=timeout)
         out: Dict[str, Any] = {"tokens": tokens, "ttft_s": req.ttft_s,
-                               "latency_s": req.latency_s}
+                               "latency_s": req.latency_s,
+                               "queue_s": req.queue_s,
+                               "prefill_s": req.prefill_s,
+                               "decode_s": req.decode_s}
         if return_logprobs:
             out["logprobs"] = list(req.logprobs)
         return out
@@ -547,6 +576,11 @@ class LLMEngine:
             "id": req.id,
             "ttft_s": req.ttft_s,
             "latency_s": req.latency_s,
+            # TTFT waterfall: queue wait vs prefill vs decode (the
+            # serve row bench.py --critpath records).
+            "queue_s": req.queue_s,
+            "prefill_s": req.prefill_s,
+            "decode_s": req.decode_s,
             "new_tokens": new_tokens,
         })
         self._ttft_ewma = (
@@ -599,6 +633,10 @@ class LLMEngine:
                 take.append(self.waiting.popleft())
         if not take:
             return []
+        now = time.monotonic()
+        for req in take:
+            if req.admit_ts == 0.0:
+                req.admit_ts = now
 
         admitted: List = []  # (idx, tok_dev, lp_dev|None) — pending
         # Route: prompts strictly extending a registered prefix go
@@ -699,6 +737,12 @@ class LLMEngine:
                     if r.first_token_ts == 0.0]
         if not todo:
             return []
+        now = time.monotonic()
+        for r in todo:
+            if r.admit_ts == 0.0:
+                # Queue-side compute IS this request's admission for
+                # TTFT-waterfall purposes (prefill starts here).
+                r.admit_ts = now
         outs = []
         full, suffix = self._group_by_route(todo, lambda r: r.prompt)
         for bucket, chunk in full:

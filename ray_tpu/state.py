@@ -111,11 +111,12 @@ def list_tasks(*, filters: Optional[Sequence[Filter]] = None,
         args = ev.get("args") or {}
         timing = args.get("timing")
         if timing:
-            from .observability.taskstats import phase_latencies
+            from .observability.taskstats import phase_durations
 
-            # Absolute lifecycle timestamps + derived per-phase ms.
+            # Absolute lifecycle timestamps + derived per-phase ms
+            # (skip-tolerant: warm-path tasks lack some stamps).
             row["timing"] = dict(timing)
-            for label, dur in phase_latencies(timing).items():
+            for label, dur in phase_durations(timing).items():
                 row[label.replace("_s", "_ms")] = round(dur * 1000, 3)
         if args.get("trace_id"):
             row["trace_id"] = args["trace_id"]

@@ -73,6 +73,14 @@ double seconds_since(Clock::time_point t0, Clock::time_point t1) {
   return std::chrono::duration<double>(t1 - t0).count();
 }
 
+// Wall-clock epoch seconds (CLOCK_REALTIME): directly comparable with
+// the driver's time.time() lifecycle stamps, so warm-path dispatch
+// timestamps slot into the same timeline as Python-stamped phases.
+double wall_now() {
+  return std::chrono::duration<double>(
+      std::chrono::system_clock::now().time_since_epoch()).count();
+}
+
 // ---------------------------------------------------------------------
 // Minimal JSON: enough for admission headers, resource dicts and the
 // peer digest. Parses into a tagged value; no exceptions escape.
@@ -473,6 +481,11 @@ struct PendingTask {
   ResMap res;
   std::string body;
   Clock::time_point t0;  // frame arrival (latency attribution)
+  // Driver asked for dispatch timestamps ("tm" admission-header key):
+  // the result forward is preceded by a dispatch_timing frame carrying
+  // wall-clock arrival/worker-write/forward stamps.
+  bool want_tm = false;
+  double recv_wall = 0.0;
 };
 
 struct Worker {
@@ -489,6 +502,11 @@ struct Worker {
   std::string task_tid;
   ResMap task_res;
   Clock::time_point task_t0;
+  // Wall-clock dispatch stamps for the in-flight task (only filled
+  // when the driver sent "tm" in the admission header).
+  bool task_tm = false;
+  double task_recv_wall = 0.0;
+  double task_write_wall = 0.0;
   // Socket buffers. ALL worker-socket IO happens under wmu (loop
   // thread for epoll events, a Python thread inside nd_worker_release
   // when serving the pending queue) — the lock is the serializer.
@@ -778,13 +796,19 @@ void worker_arm(NdServer* s, Worker* w) {
 bool start_native_task(NdServer* s, Worker* w, uint64_t conn_id,
                        const std::string& tid, const std::string& fid,
                        ResMap&& res, const char* body, size_t body_len,
-                       Clock::time_point t0) {
+                       Clock::time_point t0, bool want_tm,
+                       double recv_wall) {
   w->state = kWBusy;
   w->state_t0 = Clock::now();
   w->task_conn = conn_id;
   w->task_tid = tid;
   w->task_res = std::move(res);
   w->task_t0 = t0;
+  w->task_tm = want_tm;
+  w->task_recv_wall = recv_wall;
+  // Worker-write stamp: the hand-off point where the body leaves the
+  // dispatch plane (queueing before this is admission + idle-wait).
+  w->task_write_wall = want_tm ? wall_now() : 0.0;
   // The worker caches the fn from the body on first sight of the fid
   // (get_fn in core/worker_main.py), so record it now either way.
   w->fids.insert(fid);
@@ -883,6 +907,9 @@ bool worker_now_idle(NdServer* s, Worker* w) {
   w->task_conn = 0;
   w->task_tid.clear();
   w->task_res.clear();
+  w->task_tm = false;
+  w->task_recv_wall = 0.0;
+  w->task_write_wall = 0.0;
   for (auto it = s->pending.begin(); it != s->pending.end(); ++it) {
     if (!(it->has_fn || w->fids.count(it->fid) != 0)) continue;
     PendingTask p = std::move(*it);
@@ -891,7 +918,8 @@ bool worker_now_idle(NdServer* s, Worker* w) {
     nd_wake_fd(s);  // pending shrank: loop re-checks paused conns
     if (!start_native_task(s, w, p.conn_id, p.tid, p.fid,
                            std::move(p.res), p.body.data(),
-                           p.body.size(), p.t0)) {
+                           p.body.size(), p.t0, p.want_tm,
+                           p.recv_wall)) {
       worker_died(s, w, true);
       return false;
     }
@@ -930,6 +958,24 @@ bool worker_parse_frames(NdServer* s, Worker* w) {
     }
     record_stat(s, "task_native", seconds_since(w->task_t0, Clock::now()));
     s->native_done.fetch_add(1);
+    if (w->task_tm) {
+      // Out-of-band dispatch timestamps, queued ahead of the result on
+      // the same conn (the outbox is FIFO per connection): the driver
+      // stashes the frame and attaches it to the reply it precedes —
+      // warm tasks get daemon dispatch timing with zero Python here.
+      char nums[160];
+      snprintf(nums, sizeof(nums),
+               "\"recv_ts\":%.6f,\"write_ts\":%.6f,\"forward_ts\":%.6f}",
+               w->task_recv_wall, w->task_write_wall, wall_now());
+      std::string tmf = "{\"type\":\"dispatch_timing\",\"tid\":";
+      if (w->task_tid.empty())
+        tmf.append("null");
+      else
+        json_escape(w->task_tid, &tmf);
+      tmf.append(",");
+      tmf.append(nums);
+      send_to_driver(s, w->task_conn, std::move(tmf));
+    }
     send_to_driver(s, w->task_conn, std::move(payload));
     if (!worker_now_idle(s, w)) return false;
   }
@@ -979,6 +1025,17 @@ bool try_native_handoff(NdServer* s, Conn* c, const JValue& header,
   const JValue* hf = header.get("has_fn");
   bool has_fn = hf != nullptr && hf->kind == JValue::BOOL && hf->b;
   std::string tid = header_str(&header, "tid");
+  // "tm": the driver wants dispatch wall-clock stamps (traced or
+  // timeline-enabled runs); the untraced hot path never pays for the
+  // extra clock reads or the timing frame.
+  const JValue* tm = header.get("tm");
+  bool want_tm = tm != nullptr &&
+                 ((tm->kind == JValue::NUM && tm->num != 0) ||
+                  (tm->kind == JValue::BOOL && tm->b));
+  // Map the steady-clock arrival stamp onto the wall clock so the
+  // reported recv_ts is the frame's true arrival, not this call.
+  double recv_wall =
+      want_tm ? wall_now() - seconds_since(t0, Clock::now()) : 0.0;
 
   std::lock_guard<std::mutex> g(s->wmu);
   if (s->workers.empty()) return false;
@@ -999,7 +1056,7 @@ bool try_native_handoff(NdServer* s, Conn* c, const JValue& header,
   }
   if (pick != nullptr) {
     if (!start_native_task(s, pick, c->id, tid, fid, std::move(res),
-                           body, body_len, t0))
+                           body, body_len, t0, want_tm, recv_wall))
       worker_died(s, pick, true);  // driver gets the typed error
     return true;
   }
@@ -1019,6 +1076,8 @@ bool try_native_handoff(NdServer* s, Conn* c, const JValue& header,
   p.res = std::move(res);
   p.body.assign(body, body_len);
   p.t0 = t0;
+  p.want_tm = want_tm;
+  p.recv_wall = recv_wall;
   s->pending.push_back(std::move(p));
   s->pending_count.store(s->pending.size());
   return true;

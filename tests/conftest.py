@@ -77,7 +77,7 @@ if _LOCKTRACE_ON:
                 v._attributed = True
                 v.detail += f" [test: {request.node.nodeid}]"
 
-    def pytest_sessionfinish(session, exitstatus):
+    def _locktrace_sessionfinish(session):
         _locktrace.uninstall()
         vs = _locktrace.violations()
         if vs:
@@ -87,6 +87,36 @@ if _LOCKTRACE_ON:
                 tr.write_sep("=", "locktrace violations")
                 tr.write_line(_locktrace.report())
             session.exitstatus = 1
+
+
+# -- tier-1 wall-clock budget ledger ----------------------------------
+# Every run records per-test durations (setup+call+teardown) to a JSON
+# ledger; tests/test_tier1_budget.py gates the NEXT run on the previous
+# total so tier-1 growth past the verify flow's timeout budget fails
+# loudly instead of as an opaque `timeout` kill.
+_T1_DURATIONS: dict = {}
+_T1_LEDGER = os.environ.get("RAY_TPU_T1_DURATIONS_FILE",
+                            "/tmp/_t1_durations.json")
+
+
+def pytest_runtest_logreport(report):
+    _T1_DURATIONS[report.nodeid] = (
+        _T1_DURATIONS.get(report.nodeid, 0.0)
+        + getattr(report, "duration", 0.0))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import json
+
+    try:
+        tests = {k: round(v, 3) for k, v in _T1_DURATIONS.items()}
+        with open(_T1_LEDGER, "w") as f:
+            json.dump({"total_s": round(sum(tests.values()), 3),
+                       "count": len(tests), "tests": tests}, f)
+    except OSError:
+        pass  # read-only /tmp must not fail the suite
+    if _LOCKTRACE_ON:
+        _locktrace_sessionfinish(session)
 
 
 @pytest.fixture

@@ -1,0 +1,340 @@
+"""Critical-path attribution (observability/critpath.py).
+
+Unit coverage: CPM math (chain, diamond fan-in, off-path slack),
+skip-tolerant phase durations for warm and cold lifecycle shapes,
+native dispatch-timing back-fill (the warm-path blind-spot fix), the
+span-only fallback, and exact plane-bucket accounting on synthetic
+traces. End-to-end: the dagdemo fan-in pipeline runs for real and the
+reported critical path must be its structurally longest chain
+(preprocess → combine → Stage.work) with buckets summing to the trace's
+wall-clock window within 5%.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from ray_tpu.observability import critpath  # noqa: E402
+
+
+# ---------------------------------------------------------------------
+# CPM math
+# ---------------------------------------------------------------------
+
+class TestCPM:
+    def test_chain(self):
+        dur = {"a": 1.0, "b": 2.0, "c": 3.0}
+        edges = [("a", "b"), ("b", "c")]
+        info = critpath.cpm(dur, edges)
+        assert info["a"]["es"] == 0.0 and info["a"]["ef"] == 1.0
+        assert info["b"]["es"] == 1.0 and info["b"]["ef"] == 3.0
+        assert info["c"]["es"] == 3.0 and info["c"]["ef"] == 6.0
+        assert all(info[n]["slack"] == pytest.approx(0.0) for n in dur)
+        assert critpath.critical_path(dur, edges) == ["a", "b", "c"]
+
+    def test_diamond_fanin_picks_long_arm(self):
+        # a fans into b (long) and c (short); both join at d.
+        dur = {"a": 1.0, "b": 2.0, "c": 5.0, "d": 1.0}
+        edges = [("a", "b"), ("a", "c"), ("b", "d"), ("c", "d")]
+        info = critpath.cpm(dur, edges)
+        assert critpath.critical_path(dur, edges, info) == \
+            ["a", "c", "d"]
+        assert info["c"]["critical"] and info["d"]["critical"]
+        assert not info["b"]["critical"]
+
+    def test_off_path_branch_slack(self):
+        dur = {"a": 1.0, "b": 2.0, "c": 5.0, "d": 1.0}
+        edges = [("a", "b"), ("a", "c"), ("b", "d"), ("c", "d")]
+        info = critpath.cpm(dur, edges)
+        # b may start at es=1 but only must finish by ls(d)=6 → slack 3.
+        assert info["b"]["slack"] == pytest.approx(3.0)
+        assert info["a"]["slack"] == pytest.approx(0.0)
+
+    def test_empty_and_cycle_tolerance(self):
+        assert critpath.critical_path({}, []) == []
+        # corrupt input with a cycle must not hang or raise
+        dur = {"a": 1.0, "b": 1.0}
+        info = critpath.cpm(dur, [("a", "b"), ("b", "a")])
+        assert set(info) == {"a", "b"}
+
+
+# ---------------------------------------------------------------------
+# Skip-tolerant phase durations (warm vs cold lifecycle shapes)
+# ---------------------------------------------------------------------
+
+class TestPhaseDurations:
+    def test_cold_shape_all_stamps(self):
+        from ray_tpu.observability.taskstats import phase_durations
+
+        t = 1000.0
+        out = phase_durations({"submitted": t, "queued": t + 1,
+                               "scheduled": t + 2, "running": t + 4,
+                               "finished": t + 9})
+        assert out == {"queued_s": pytest.approx(1.0),
+                       "scheduled_s": pytest.approx(2.0),
+                       "running_s": pytest.approx(5.0),
+                       "total_s": pytest.approx(9.0)}
+
+    def test_warm_shape_skips_missing_stamps(self):
+        """A warm-path task (pre-back-fill) has no scheduled/running:
+        queued must span to the NEXT PRESENT stamp, not vanish or
+        produce a negative."""
+        from ray_tpu.observability.taskstats import phase_durations
+
+        t = 1000.0
+        out = phase_durations({"submitted": t, "queued": t + 0.5,
+                               "finished": t + 3.0})
+        assert out == {"queued_s": pytest.approx(2.5),
+                       "total_s": pytest.approx(3.0)}
+
+    def test_empty_and_unordered(self):
+        from ray_tpu.observability.taskstats import phase_durations
+
+        assert phase_durations({}) == {}
+        assert phase_durations(None) == {}
+        # clock skew (negative interval) drops the pair, keeps total
+        out = phase_durations({"submitted": 10.0, "queued": 12.0,
+                               "scheduled": 11.0, "finished": 13.0})
+        assert "queued_s" not in out
+        assert out["total_s"] == pytest.approx(3.0)
+
+
+# ---------------------------------------------------------------------
+# Native dispatch-timing back-fill (warm-path blind spot)
+# ---------------------------------------------------------------------
+
+class TestNativeDispatchTiming:
+    def test_backfills_and_synthesizes_span(self):
+        from ray_tpu.core.remote_node import apply_native_dispatch_timing
+
+        timing = {"submitted": 100.0, "queued": 100.01,
+                  "finished": 100.2}
+        nd = {"recv_ts": 100.02, "write_ts": 100.05,
+              "forward_ts": 100.19, "tid": "ab12cd"}
+        ev = apply_native_dispatch_timing(
+            timing, nd, trace_id="t1", parent_span_id="p1",
+            node_id="n1", now=100.3)
+        assert ev is not None
+        # lifecycle hole is closed: scheduled/running back-filled
+        assert timing["scheduled"] == pytest.approx(100.02)
+        assert timing["running"] == pytest.approx(100.05)
+        # span in the exact util.tracing shape
+        assert ev["cat"] == "daemon_dispatch"
+        assert ev["name"] == "daemon:task"
+        assert ev["tid"].startswith("span:")
+        assert ev["ts"] == pytest.approx(100.02e6)
+        assert ev["dur"] == pytest.approx(0.03e6)
+        assert ev["args"]["task_id"] == "ab12cd"
+        assert ev["args"]["trace_id"] == "t1"
+        assert ev["args"]["forward_ts"] == pytest.approx(100.19)
+
+    def test_does_not_clobber_existing_stamps(self):
+        from ray_tpu.core.remote_node import apply_native_dispatch_timing
+
+        timing = {"submitted": 100.0, "scheduled": 100.015,
+                  "running": 100.04, "finished": 100.2}
+        apply_native_dispatch_timing(
+            timing, {"recv_ts": 100.02, "write_ts": 100.05,
+                     "forward_ts": 100.19}, now=100.3)
+        assert timing["scheduled"] == pytest.approx(100.015)
+        assert timing["running"] == pytest.approx(100.04)
+
+    def test_clamps_skewed_daemon_clock(self):
+        from ray_tpu.core.remote_node import apply_native_dispatch_timing
+
+        # daemon clock runs 1h ahead: stamps clamp into the task's own
+        # window instead of producing a span in the future
+        timing = {"submitted": 100.0, "queued": 100.01,
+                  "finished": 100.2}
+        ev = apply_native_dispatch_timing(
+            timing, {"recv_ts": 3700.0, "write_ts": 3700.1,
+                     "forward_ts": 3700.2}, now=100.3)
+        assert ev is not None
+        assert timing["scheduled"] <= 100.2
+        assert timing["running"] <= 100.2
+
+    def test_rejects_unusable_stamps(self):
+        from ray_tpu.core.remote_node import apply_native_dispatch_timing
+
+        bad = [
+            {},                                             # missing
+            {"recv_ts": 0.0, "write_ts": 1.0, "forward_ts": 2.0},
+            {"recv_ts": 5.0, "write_ts": 4.0, "forward_ts": 6.0},
+            {"recv_ts": 5.0, "write_ts": 6.0, "forward_ts": 5.5},
+            {"recv_ts": "x", "write_ts": 1.0, "forward_ts": 2.0},
+        ]
+        for nd in bad:
+            t = {"submitted": 1.0, "finished": 2.0}
+            assert apply_native_dispatch_timing(t, nd, now=3.0) is None
+            assert "running" not in t
+
+
+# ---------------------------------------------------------------------
+# Synthetic-trace analysis: exact bucket accounting
+# ---------------------------------------------------------------------
+
+def _task_ev(tid, name, trace_id, timing, deps=(), returns=()):
+    return {"name": name, "cat": "task", "ph": "X", "tid": tid,
+            "args": {"trace_id": trace_id, "timing": dict(timing),
+                     "deps": list(deps), "returns": list(returns)}}
+
+
+class TestAnalyze:
+    def test_chain_buckets_sum_exactly_to_makespan(self):
+        t = 1000.0
+        events = [
+            _task_ev("t1", "stage_a", "tr", {
+                "submitted": t, "queued": t + 0.01,
+                "scheduled": t + 0.02, "running": t + 0.05,
+                "finished": t + 1.0}, returns=["o1"]),
+            _task_ev("t2", "stage_b", "tr", {
+                "submitted": t + 1.1, "queued": t + 1.11,
+                "scheduled": t + 1.12, "running": t + 1.15,
+                "finished": t + 2.0}, deps=["o1"], returns=["o2"]),
+        ]
+        report = critpath.analyze(events, "tr")
+        assert report["kind"] == "tasks"
+        assert report["critical_names"] == ["stage_a", "stage_b"]
+        assert report["makespan_s"] == pytest.approx(2.0)
+        total = sum(report["planes"].values())
+        assert total == pytest.approx(report["makespan_s"], rel=1e-9)
+        # the submit→finish gap between the two tasks is transfer time
+        assert report["planes"]["object_transfer"] >= 0.1 - 1e-9
+        assert 0.0 <= report["dispatch_share"] <= 1.0
+        for seg in report["segments"]:
+            assert seg["end"] >= seg["start"]
+
+    def test_fanin_off_path_node_has_slack(self):
+        t = 1000.0
+        events = [
+            _task_ev("a", "a", "tr",
+                     {"submitted": t, "finished": t + 1.0},
+                     returns=["oa"]),
+            _task_ev("b", "b_long", "tr",
+                     {"submitted": t + 1.0, "finished": t + 4.0},
+                     deps=["oa"], returns=["ob"]),
+            _task_ev("c", "c_short", "tr",
+                     {"submitted": t + 1.0, "finished": t + 2.0},
+                     deps=["oa"], returns=["oc"]),
+            _task_ev("d", "join", "tr",
+                     {"submitted": t + 4.0, "finished": t + 5.0},
+                     deps=["ob", "oc"], returns=["od"]),
+        ]
+        report = critpath.analyze(events, "tr")
+        assert report["critical_names"] == ["a", "b_long", "join"]
+        rows = {r["name"]: r for r in report["nodes"]}
+        assert rows["c_short"]["slack"] == pytest.approx(2.0)
+        assert not rows["c_short"]["critical"]
+        assert rows["b_long"]["critical"]
+
+    def test_other_trace_ids_ignored(self):
+        t = 1000.0
+        events = [
+            _task_ev("t1", "mine", "tr",
+                     {"submitted": t, "finished": t + 1.0}),
+            _task_ev("tx", "other", "different",
+                     {"submitted": t, "finished": t + 50.0}),
+        ]
+        report = critpath.analyze(events, "tr")
+        assert report["critical_names"] == ["mine"]
+        assert report["makespan_s"] == pytest.approx(1.0)
+
+    def test_span_only_fallback(self):
+        """A serve-style trace (no tasks) still yields a waterfall via
+        span-name plane hints."""
+        t = 1000.0
+
+        def sp(name, cat, ts, dur):
+            return {"name": name, "cat": cat, "ph": "X",
+                    "ts": ts * 1e6, "dur": dur * 1e6, "pid": "driver",
+                    "tid": "span:x", "args": {"trace_id": "tr"}}
+
+        events = [
+            sp("request", "serve", t, 1.0),           # root window
+            sp("route", "serve", t, 0.1),
+            sp("prefill", "serve", t + 0.1, 0.3),
+            sp("decode", "serve", t + 0.4, 0.5),
+        ]
+        report = critpath.analyze(events, "tr")
+        assert report["kind"] == "spans"
+        assert report["makespan_s"] == pytest.approx(1.0)
+        assert report["planes"]["serve_route"] == pytest.approx(0.1)
+        assert report["planes"]["prefill"] == pytest.approx(0.3)
+        assert report["planes"]["decode"] == pytest.approx(0.5)
+        total = sum(report["planes"].values())
+        assert total == pytest.approx(report["makespan_s"], rel=1e-9)
+
+    def test_trace_not_found(self):
+        report = critpath.analyze([], "missing")
+        assert report.get("error")
+        assert report["makespan_s"] == 0.0
+
+    def test_render_and_metrics_never_raise(self):
+        t = 1000.0
+        events = [_task_ev("t1", "solo", "tr", {
+            "submitted": t, "queued": t + 0.1, "scheduled": t + 0.2,
+            "running": t + 0.3, "finished": t + 1.0})]
+        report = critpath.analyze(events, "tr")
+        text = critpath.render_waterfall(report)
+        assert "solo" in text and "dispatch share" in text.lower()
+        critpath.reset_metrics_cache()
+        critpath.record_plane_metrics(report)
+        critpath.record_plane_metrics(report)  # cached-path re-entry
+
+
+# ---------------------------------------------------------------------
+# End-to-end: dagdemo fan-in pipeline
+# ---------------------------------------------------------------------
+
+def test_e2e_fanin_critical_path(ray_start):
+    """Run the demo fan-in pipeline for real; the reported critical
+    path must be the structurally longest chain (preprocess → combine
+    → Stage.work) and the plane buckets must account for the trace's
+    wall-clock window within 5%."""
+    from ray_tpu.util import tracing
+
+    from graph_pipelines import dagdemo
+
+    spans: list = []
+    tracing.setup_tracing(spans.append)
+    try:
+        with tracing.span("test.critpath_fanin"):
+            trace_id = tracing.current_trace_id()
+            assert dagdemo.fanin_pipeline(3) == 2 * (4 + 5)
+    finally:
+        tracing.clear_tracing()
+
+    # task events publish after results; poll until the chain is there
+    from ray_tpu.core.runtime import global_runtime
+
+    deadline = time.monotonic() + 5.0
+    report = None
+    while time.monotonic() < deadline:
+        report = critpath.analyze(global_runtime().timeline(), trace_id)
+        if len(report.get("critical_path") or []) >= 3:
+            break
+        time.sleep(0.05)
+    assert report is not None and report["kind"] == "tasks"
+
+    names = report["critical_names"]
+    assert len(names) == 3, report
+    assert names[0].endswith("preprocess")
+    assert names[1].endswith("combine")
+    assert names[2].endswith("Stage.work")
+
+    makespan = report["makespan_s"]
+    assert makespan > 0.0
+    total = sum(report["planes"].values())
+    assert total == pytest.approx(makespan, rel=0.05)
+    # every critical-path second has a home; the exec plane is nonzero
+    assert report["planes"].get("worker_exec", 0.0) > 0.0
+    assert 0.0 <= report["dispatch_share"] <= 1.0
+
+    # off-path branch (the second preprocess arm) shows positive slack
+    slacks = [r["slack"] for r in report["nodes"]
+              if r["task_id"] not in report["critical_path"]]
+    assert any(s > 0.0 for s in slacks) or len(report["nodes"]) == 3

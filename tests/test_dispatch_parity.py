@@ -665,6 +665,60 @@ class TestNativeWarmPath:
         assert "task_native_handoff" in native
         assert native["task_native"]["count"] >= 8
 
+    def test_warm_dispatch_span_closes_timeline_hole(self, warm_cluster):
+        """A native hand-off runs zero daemon-side Python, so the
+        daemon never opens its dispatch span — yet the trace must NOT
+        show a submit→execute hole. The C loop's dispatch_timing reply
+        stamps (admission arrival / worker write / reply forward)
+        back-fill the lifecycle phases and synthesize the
+        daemon_dispatch span driver-side."""
+        from ray_tpu.util import tracing
+
+        @ray.remote
+        def stamped(x):
+            return x + 1
+
+        spans: list = []
+        tracing.setup_tracing(spans.append)
+        try:
+            # first call exports the fn; the next one is a pure native
+            # hand-off (the shape the blind spot hid)
+            assert ray.get(stamped.remote(1), timeout=60) == 2
+            with tracing.span("warm_root"):
+                trace_id = tracing.current_trace_id()
+                assert ray.get(stamped.remote(2), timeout=60) == 3
+        finally:
+            tracing.clear_tracing()
+
+        deadline = time.time() + 10
+        native_spans = []
+        while time.time() < deadline and not native_spans:
+            native_spans = [
+                e for e in ray.timeline()
+                if e.get("cat") == "daemon_dispatch"
+                and (e.get("args") or {}).get("native")
+                and (e.get("args") or {}).get("trace_id") == trace_id]
+            time.sleep(0.05)
+        assert native_spans, \
+            "warm task produced no synthesized dispatch span"
+        sp = native_spans[-1]
+        assert str(sp.get("pid", "")).startswith("daemon:")
+        assert sp["args"].get("task_id")
+        assert sp.get("dur", -1.0) >= 0.0
+
+        # lifecycle closure: the warm task's timing has scheduled AND
+        # running back-filled from the native stamps — no hole between
+        # submit and finish
+        task_evs = [e for e in ray.timeline()
+                    if (e.get("args") or {}).get("trace_id") == trace_id
+                    and (e.get("args") or {}).get("timing")]
+        assert task_evs, "warm task left no task event in the timeline"
+        timing = task_evs[-1]["args"]["timing"]
+        for stamp in ("submitted", "scheduled", "running", "finished"):
+            assert timing.get(stamp) is not None, (stamp, timing)
+        assert timing["submitted"] <= timing["scheduled"] \
+            <= timing["running"] <= timing["finished"]
+
     def test_actor_and_streaming_stay_python(self, warm_cluster):
         before = self._load()
 

@@ -50,13 +50,14 @@ class TestExportBundle:
         loop-handler gauges from observability.event_stats, anomaly
         counter from observability.tsdb, TTFT gauge from the serve
         controller's stats harvest, outstanding-resource series from
-        observability.ledger."""
+        observability.ledger, critical-path plane series from
+        observability.critpath."""
         import inspect
 
         from ray_tpu.dashboard import server as srv
         from ray_tpu.dashboard.metrics_export import DEFAULT_PANELS
-        from ray_tpu.observability import (event_stats, ledger,
-                                           taskstats, tsdb)
+        from ray_tpu.observability import (critpath, event_stats,
+                                           ledger, taskstats, tsdb)
         from ray_tpu.serve import controller, handle, proxy, replica
 
         publish_src = "\n".join([
@@ -69,12 +70,25 @@ class TestExportBundle:
             inspect.getsource(tsdb),
             inspect.getsource(controller),
             inspect.getsource(ledger),
+            inspect.getsource(critpath),
         ])
         for _title, expr, _unit in DEFAULT_PANELS:
             m = re.search(r"(ray_tpu_[a-z_]+?)(_bucket)?(?:[^a-z_]|$)",
                           expr)
             if m:
                 assert m.group(1) in publish_src, expr
+
+    def test_panel_count_pinned(self):
+        """Panel-count pin: adding or removing a default Grafana panel
+        must be deliberate (update this number with the panel list).
+        33 = 31 pre-critpath panels + plane-time budget + dispatch
+        share."""
+        from ray_tpu.dashboard.metrics_export import DEFAULT_PANELS
+
+        assert len(DEFAULT_PANELS) == 33
+        titles = [t for t, _e, _u in DEFAULT_PANELS]
+        assert "Critical-path plane budget" in titles
+        assert "Critical-path dispatch share" in titles
 
     def test_serve_series_match_proxy_names(self):
         import inspect

@@ -199,6 +199,30 @@ def test_dashboard_endpoints(dashboard, ray_start):
     assert isinstance(_get(dashboard, "/api/actors"), list)
     assert isinstance(_get(dashboard, "/api/timeline"), list)
 
+    # critical-path attribution endpoint: missing param errors cleanly,
+    # a traced task analyzes into a plane-bucket report
+    assert _get(dashboard, "/api/critpath").get("error")
+    from ray_tpu.util import tracing
+
+    tracing.setup_tracing()
+    try:
+        with tracing.span("dash_root"):
+            trace_id = tracing.current_trace_id()
+            ray.get(f.remote())
+    finally:
+        tracing.clear_tracing()
+    deadline = time.monotonic() + 5
+    report = {}
+    while time.monotonic() < deadline:
+        report = _get(dashboard, f"/api/critpath?trace={trace_id}")
+        if report.get("critical_path"):
+            break
+        time.sleep(0.05)
+    assert report.get("critical_path"), report
+    assert report["makespan_s"] > 0
+    assert sum(report["planes"].values()) == \
+        pytest.approx(report["makespan_s"], rel=0.05)
+
     metrics.clear_registry()
     metrics.Counter("dash_hits", tag_keys=()).inc()
     with urllib.request.urlopen(dashboard.address + "/metrics",
